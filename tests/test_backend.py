@@ -1,0 +1,82 @@
+from tpu9.backend import BackendDB
+from tpu9.types import StubConfig, StubType
+
+
+async def test_workspace_token_flow():
+    db = BackendDB()
+    ws = await db.create_workspace("acme")
+    assert (await db.get_workspace(ws.workspace_id)).name == "acme"
+    assert (await db.get_workspace_by_name("acme")).workspace_id == ws.workspace_id
+
+    tok = await db.create_token(ws.workspace_id)
+    auth = await db.authorize_token(tok.key)
+    assert auth and auth.workspace_id == ws.workspace_id
+    assert await db.authorize_token("nope") is None
+    await db.revoke_token(tok.token_id)
+    assert await db.authorize_token(tok.key) is None
+
+
+async def test_stub_dedupe_and_deployments():
+    db = BackendDB()
+    ws = await db.create_workspace("w")
+    cfg = StubConfig(handler="app:fn")
+    s1 = await db.get_or_create_stub(ws.workspace_id, "f", StubType.FUNCTION.value, cfg)
+    s2 = await db.get_or_create_stub(ws.workspace_id, "f", StubType.FUNCTION.value, cfg)
+    assert s1.stub_id == s2.stub_id  # identical config dedupes
+
+    cfg2 = StubConfig(handler="app:fn2")
+    s3 = await db.get_or_create_stub(ws.workspace_id, "f", StubType.FUNCTION.value, cfg2)
+    assert s3.stub_id != s1.stub_id
+
+    d1 = await db.create_deployment(ws.workspace_id, "api", s1.stub_id)
+    d2 = await db.create_deployment(ws.workspace_id, "api", s3.stub_id)
+    assert d2.version == d1.version + 1
+    active = await db.get_deployment(ws.workspace_id, "api")
+    assert active.deployment_id == d2.deployment_id
+    old = await db.get_deployment(ws.workspace_id, "api", version=1)
+    assert old.deployment_id == d1.deployment_id and not (await db.get_deployment_by_id(d1.deployment_id)).active
+    by_sub = await db.get_deployment_by_subdomain(d2.subdomain)
+    assert by_sub.deployment_id == d2.deployment_id
+
+
+async def test_secrets_roundtrip():
+    db = BackendDB()
+    ws = await db.create_workspace("w")
+    await db.upsert_secret(ws.workspace_id, "API_KEY", "hunter2")
+    assert await db.get_secret(ws.workspace_id, "API_KEY") == "hunter2"
+    await db.upsert_secret(ws.workspace_id, "API_KEY", "hunter3")
+    assert await db.get_secret(ws.workspace_id, "API_KEY") == "hunter3"
+    assert await db.list_secrets(ws.workspace_id) == ["API_KEY"]
+    assert await db.delete_secret(ws.workspace_id, "API_KEY")
+    assert await db.get_secret(ws.workspace_id, "API_KEY") is None
+
+
+async def test_checkpoints_and_images():
+    db = BackendDB()
+    ws = await db.create_workspace("w")
+    ck = await db.create_checkpoint("stub-1", ws.workspace_id, "c-1")
+    assert await db.latest_checkpoint("stub-1") is None  # pending not returned
+    await db.update_checkpoint(ck, "available", remote_key="k", size=10)
+    latest = await db.latest_checkpoint("stub-1")
+    assert latest["checkpoint_id"] == ck
+
+    await db.upsert_image("img-1", ws.workspace_id, {"python_packages": ["jax"]},
+                          status="ready", manifest_hash="abc", size=5)
+    img = await db.get_image("img-1")
+    assert img["status"] == "ready" and img["spec"]["python_packages"] == ["jax"]
+
+
+async def test_tasks_and_volumes():
+    db = BackendDB()
+    ws = await db.create_workspace("w")
+    await db.record_task("t1", "s1", ws.workspace_id, "pending")
+    await db.update_task_status("t1", "complete", container_id="c9")
+    tasks = await db.list_tasks(ws.workspace_id)
+    assert tasks[0]["status"] == "complete" and tasks[0]["container_id"] == "c9"
+    assert tasks[0]["ended_at"] > 0
+
+    v = await db.get_or_create_volume(ws.workspace_id, "data")
+    v2 = await db.get_or_create_volume(ws.workspace_id, "data")
+    assert v["volume_id"] == v2["volume_id"]
+    assert len(await db.list_volumes(ws.workspace_id)) == 1
+    assert await db.delete_volume(ws.workspace_id, "data")

@@ -1,0 +1,54 @@
+import json
+
+from tpu9.config import AppConfig, load_config
+
+
+def test_defaults():
+    cfg = load_config(environ={})
+    assert cfg.scheduler.loop_interval_s == 0.05
+    assert cfg.pools[0].name == "default"
+
+
+def test_file_overlay(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("""
+gateway:
+  http_port: 9000
+pools:
+  - name: tpu
+    mode: gce-tpu
+    tpu_type: v5e-8
+  - name: cpu
+""")
+    cfg = load_config(path=str(p), environ={})
+    assert cfg.gateway.http_port == 9000
+    assert len(cfg.pools) == 2
+    assert cfg.pools[0].tpu_type == "v5e-8"
+    assert cfg.pools[1].name == "cpu"
+
+
+def test_env_overrides():
+    cfg = load_config(environ={
+        "TPU9_GATEWAY__HTTP_PORT": "8123",
+        "TPU9_DEBUG": "true",
+        "TPU9_SCHEDULER__LOOP_INTERVAL_S": "0.2",
+    })
+    assert cfg.gateway.http_port == 8123
+    assert cfg.debug is True
+    assert cfg.scheduler.loop_interval_s == 0.2
+
+
+def test_config_json_layer():
+    cfg = load_config(environ={
+        "TPU9_CONFIG_JSON": json.dumps({"cluster_name": "prod",
+                                        "worker": {"keepalive_ttl_s": 30}}),
+    })
+    assert cfg.cluster_name == "prod"
+    assert cfg.worker.keepalive_ttl_s == 30
+
+
+def test_overrides_win():
+    cfg = load_config(environ={"TPU9_GATEWAY__HTTP_PORT": "1"},
+                      overrides={"gateway": {"http_port": 2}})
+    assert cfg.gateway.http_port == 2
+    assert isinstance(cfg, AppConfig)

@@ -1,0 +1,94 @@
+from tpu9.repository import ContainerRepository, TaskRepository, WorkerRepository
+from tpu9.statestore import MemoryStore
+from tpu9.types import (ContainerRequest, ContainerState, ContainerStatus,
+                        TaskMessage, WorkerState, WorkerStatus)
+
+
+def make_worker(worker_id="w1", chips=8, pool="default"):
+    return WorkerState(
+        worker_id=worker_id, pool=pool, status=WorkerStatus.AVAILABLE.value,
+        total_cpu_millicores=8000, total_memory_mb=32768,
+        free_cpu_millicores=8000, free_memory_mb=32768,
+        tpu_generation="v5e" if chips else "", tpu_chip_count=chips,
+        tpu_free_chips=chips, address="127.0.0.1:1000")
+
+
+async def test_worker_register_capacity():
+    repo = WorkerRepository(MemoryStore(), keepalive_ttl_s=5)
+    await repo.register(make_worker())
+    w = await repo.get("w1")
+    assert w.tpu_free_chips == 8
+    assert await repo.is_alive("w1")
+
+    assert await repo.adjust_capacity("w1", cpu_millicores=-2000, tpu_chips=-8)
+    w = await repo.get("w1")
+    assert w.free_cpu_millicores == 6000 and w.tpu_free_chips == 0
+    # over-release clamps at totals
+    assert await repo.adjust_capacity("w1", tpu_chips=8)
+    assert not await repo.adjust_capacity("w1", tpu_chips=-9)  # insufficient
+    assert (await repo.get("w1")).tpu_free_chips == 8
+
+    workers = await repo.list(alive_only=True)
+    assert [x.worker_id for x in workers] == ["w1"]
+    await repo.deregister("w1")
+    assert await repo.get("w1") is None
+
+
+async def test_worker_request_stream():
+    repo = WorkerRepository(MemoryStore())
+    await repo.register(make_worker())
+    req = ContainerRequest(container_id="c1", stub_id="s1", tpu="v5e-8")
+    await repo.push_request("w1", req)
+    got = await repo.read_requests("w1", last_id="0", timeout=0.2)
+    assert len(got) == 1
+    entry_id, r = got[0]
+    assert r.container_id == "c1" and r.tpu_spec().chips == 8
+    assert await repo.read_requests("w1", last_id=entry_id, timeout=0.05) == []
+    assert await repo.worker_container_ids("w1") == ["c1"]
+
+
+async def test_container_state_and_discovery():
+    repo = ContainerRepository(MemoryStore())
+    st = ContainerState(container_id="c1", stub_id="s1",
+                        status=ContainerStatus.RUNNING.value)
+    await repo.update_state(st)
+    await repo.set_address("c1", "127.0.0.1:9000")
+    found = await repo.containers_by_stub("s1", status=ContainerStatus.RUNNING.value)
+    assert len(found) == 1
+    assert await repo.get_address("c1") == "127.0.0.1:9000"
+
+    st.status = ContainerStatus.STOPPED.value
+    await repo.update_state(st)
+    assert await repo.containers_by_stub("s1") == []
+
+
+async def test_request_tokens():
+    repo = ContainerRepository(MemoryStore())
+    assert await repo.acquire_request_token("s1", "c1", limit=2)
+    assert await repo.acquire_request_token("s1", "c1", limit=2)
+    assert not await repo.acquire_request_token("s1", "c1", limit=2)
+    await repo.release_request_token("s1", "c1")
+    assert await repo.acquire_request_token("s1", "c1", limit=2)
+    assert await repo.in_flight("s1", "c1") == 2
+
+
+async def test_task_repo_flow():
+    repo = TaskRepository(MemoryStore())
+    msg = TaskMessage(task_id="t1", stub_id="s1", workspace_id="w1",
+                      executor="taskqueue", handler_args=[1])
+    await repo.put_message(msg)
+    await repo.enqueue("w1", "s1", "t1")
+    assert await repo.queue_depth("w1", "s1") == 1
+    assert await repo.tasks_in_flight("s1") == 1
+
+    task_id = await repo.dequeue("w1", "s1")
+    assert task_id == "t1"
+    await repo.claim("c1", "t1", 123.0)
+    assert "t1" in await repo.claims("c1")
+
+    await repo.set_status("t1", "complete")
+    assert await repo.tasks_in_flight("s1") == 0
+    await repo.store_result("t1", {"ok": True})
+    assert (await repo.get_result("t1"))["ok"] is True
+    await repo.unclaim("c1", "t1")
+    assert await repo.claims("c1") == {}

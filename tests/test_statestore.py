@@ -1,0 +1,132 @@
+import asyncio
+
+import pytest
+
+from tpu9.statestore import MemoryStore, RemoteStore, StateServer
+
+
+async def exercise_store(s):
+    # kv + ttl + nx
+    assert await s.set("k", "v")
+    assert await s.get("k") == "v"
+    assert not await s.set("k", "w", nx=True)
+    await s.set("tmp", 1, ttl=0.05)
+    assert await s.exists("tmp")
+    await asyncio.sleep(0.08)
+    assert not await s.exists("tmp")
+    assert await s.incr("ctr", 5) == 5
+    assert await s.incr("ctr", -2) == 3
+
+    # hash
+    await s.hmset("h", {"a": 1, "b": 2})
+    assert await s.hget("h", "a") == 1
+    assert (await s.hgetall("h"))["b"] == 2
+    assert await s.hdel("h", "a") == 1
+    assert await s.hincr("h", "b", 3) == 5
+
+    # zset
+    await s.zadd("z", "m1", 2.0)
+    await s.zadd("z", "m2", 1.0)
+    assert await s.zcard("z") == 2
+    popped = await s.zpopmin("z", 1)
+    assert popped[0][0] == "m2"
+    assert await s.zrange("z") == ["m1"]
+
+    # list + blpop
+    await s.rpush("l", "a", "b")
+    assert await s.llen("l") == 2
+    assert await s.lpop("l") == "a"
+    assert await s.blpop("l", timeout=0.5) == "b"
+    assert await s.blpop("l", timeout=0.05) is None
+
+    async def push_later():
+        await asyncio.sleep(0.03)
+        await s.rpush("l2", "x")
+
+    t = asyncio.create_task(push_later())
+    assert await s.blpop("l2", timeout=1.0) == "x"
+    await t
+
+    # stream
+    eid1 = await s.xadd("st", {"n": 1})
+    await s.xadd("st", {"n": 2})
+    entries = await s.xread("st", last_id="0")
+    assert [e["n"] for _, e in entries] == [1, 2]
+    entries = await s.xread("st", last_id=eid1)
+    assert [e["n"] for _, e in entries] == [2]
+
+    async def add_later():
+        await asyncio.sleep(0.03)
+        await s.xadd("st2", {"n": 3})
+
+    t = asyncio.create_task(add_later())
+    entries = await s.xread("st2", last_id="0", timeout=1.0)
+    assert entries and entries[0][1]["n"] == 3
+    await t
+
+    # locks
+    assert await s.acquire_lock("res", "tok1", ttl=5)
+    assert not await s.acquire_lock("res", "tok2", ttl=5)
+    assert not await s.release_lock("res", "tok2")
+    assert await s.release_lock("res", "tok1")
+    assert await s.acquire_lock("res", "tok2", ttl=5)
+
+    # keys pattern
+    ks = await s.keys("h*")
+    assert "h" in ks
+
+
+async def test_memory_store():
+    await exercise_store(MemoryStore())
+
+
+async def test_remote_store_over_tcp():
+    server = await StateServer(port=0).start()
+    client = await RemoteStore(server.address).connect()
+    try:
+        await exercise_store(client)
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_remote_pubsub():
+    server = await StateServer(port=0).start()
+    client = await RemoteStore(server.address).connect()
+    try:
+        sub = client.subscribe("events:*")
+        await asyncio.sleep(0.05)  # let subscribe register server-side
+        await client.publish("events:test", {"hello": 1})
+        msg = await sub.get(timeout=2.0)
+        assert msg is not None
+        channel, payload = msg
+        assert channel == "events:test" and payload["hello"] == 1
+        sub.close()
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_memory_pubsub():
+    s = MemoryStore()
+    sub = s.subscribe("c:*")
+    await s.publish("c:1", "m")
+    got = await sub.get(timeout=1.0)
+    assert got == ("c:1", "m")
+    sub.close()
+    assert await s.publish("c:1", "m2") == 0
+
+
+async def test_server_auth():
+    server = await StateServer(port=0, auth_token="sekret").start()
+    good = RemoteStore(server.address, auth_token="sekret")
+    await good.connect()
+    assert await good.set("a", 1)
+    await good.close()
+
+    bad = RemoteStore(server.address, auth_token="wrong")
+    with pytest.raises(Exception):
+        await bad.connect()
+        await bad.set("a", 2)
+    await bad.close()
+    await server.stop()

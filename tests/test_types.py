@@ -1,0 +1,67 @@
+import pytest
+
+from tpu9.types import (ContainerRequest, GangInfo, InvalidTpuSpec, Mount,
+                        Stub, StubConfig, TaskMessage, TPU_REGISTRY,
+                        parse_tpu_spec)
+
+
+def test_tpu_registry_shapes():
+    v5e8 = parse_tpu_spec("v5e-8")
+    assert v5e8.chips == 8 and v5e8.hosts == 1 and v5e8.chips_per_host == 8
+    assert v5e8.mesh_shape() == (2, 4)
+    assert not v5e8.multi_host
+
+    v5p64 = parse_tpu_spec("v5p-64")
+    assert v5p64.chips == 64 and v5p64.hosts == 16
+    assert v5p64.chips_per_host == 4
+    assert v5p64.multi_host
+    assert v5p64.mesh_shape() == (4, 4, 4)
+
+
+def test_registry_consistency():
+    for name, spec in TPU_REGISTRY.items():
+        assert spec.name == name
+        assert spec.chips % spec.hosts == 0
+        prod = 1
+        for d in spec.mesh_shape():
+            prod *= d
+        assert prod == spec.chips, f"{name}: topology {spec.topology} != chips {spec.chips}"
+
+
+def test_parse_tpu_spec_errors():
+    assert parse_tpu_spec("") is None
+    assert parse_tpu_spec(None) is None
+    with pytest.raises(InvalidTpuSpec):
+        parse_tpu_spec("v9z-3")
+
+
+def test_container_request_roundtrip():
+    req = ContainerRequest(
+        container_id="c-1", stub_id="s-1", workspace_id="w-1", tpu="v5e-4",
+        mounts=[Mount(source="/a", target="/b")],
+        gang=GangInfo(gang_id="g-1", size=2, rank=1),
+        env={"A": "1"},
+    )
+    d = req.to_dict()
+    back = ContainerRequest.from_dict(d)
+    assert back.gang.size == 2 and back.gang.rank == 1
+    assert back.mounts[0].target == "/b"
+    assert back.tpu_spec().chips == 4
+
+
+def test_stub_config_roundtrip():
+    cfg = StubConfig(handler="app:fn")
+    cfg.runtime.tpu = "v5e-1"
+    cfg.autoscaler.max_containers = 5
+    stub = Stub(stub_id="s", name="n", config=cfg)
+    back = Stub.from_dict(stub.to_dict())
+    assert back.config.runtime.tpu_spec().chips == 1
+    assert back.config.autoscaler.max_containers == 5
+
+
+def test_task_message_roundtrip():
+    msg = TaskMessage(task_id="t1", stub_id="s1", handler_args=[1, "x"],
+                      handler_kwargs={"k": 2})
+    back = TaskMessage.from_dict(msg.to_dict())
+    assert back.handler_args == [1, "x"]
+    assert back.policy.max_retries == 3

@@ -1,0 +1,55 @@
+"""tpu9 — a TPU-native serverless AI runtime.
+
+Built from scratch with the capabilities of beam-cloud/beta9 (see SURVEY.md),
+re-designed TPU-first: slice-topology-aware scheduling with gang placement,
+`/dev/accel*`-native workers, JAX/XLA runner images, and a compute layer
+(models/ops/parallel/serving/train) that maps directly onto the MXU/ICI.
+
+The public SDK surface mirrors the reference's
+(``sdk/src/beta9/__init__.py:4-60``): decorators and resource classes are
+re-exported here lazily to keep ``import tpu9`` cheap inside containers.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+__version__ = "0.1.0"
+
+# name -> (module, attr)
+_EXPORTS: dict[str, tuple[str, str]] = {
+    "endpoint": ("tpu9.sdk.endpoint", "endpoint"),
+    "asgi": ("tpu9.sdk.endpoint", "asgi"),
+    "realtime": ("tpu9.sdk.endpoint", "realtime"),
+    "function": ("tpu9.sdk.function", "function"),
+    "schedule": ("tpu9.sdk.function", "schedule"),
+    "task_queue": ("tpu9.sdk.taskqueue", "task_queue"),
+    "Image": ("tpu9.sdk.image", "Image"),
+    "Volume": ("tpu9.sdk.volume", "Volume"),
+    "CloudBucket": ("tpu9.sdk.volume", "CloudBucket"),
+    "Pod": ("tpu9.sdk.pod", "Pod"),
+    "Sandbox": ("tpu9.sdk.sandbox", "Sandbox"),
+    "Map": ("tpu9.sdk.map", "Map"),
+    "Queue": ("tpu9.sdk.queue", "Queue"),
+    "Output": ("tpu9.sdk.output", "Output"),
+    "Secret": ("tpu9.sdk.secret", "Secret"),
+    "Signal": ("tpu9.sdk.signal", "Signal"),
+    "QueueDepthAutoscaler": ("tpu9.sdk.autoscaler", "QueueDepthAutoscaler"),
+    "TokenPressureAutoscaler": ("tpu9.sdk.autoscaler", "TokenPressureAutoscaler"),
+    "TpuSpec": ("tpu9.types", "TpuSpec"),
+    "parse_tpu_spec": ("tpu9.types", "parse_tpu_spec"),
+}
+
+__all__ = sorted(_EXPORTS) + ["__version__"]
+
+
+def __getattr__(name: str) -> Any:
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module 'tpu9' has no attribute {name!r}") from None
+    module = importlib.import_module(module_name)
+    value = getattr(module, attr)
+    globals()[name] = value
+    return value

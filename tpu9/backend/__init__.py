@@ -1,0 +1,4 @@
+from .db import BackendDB
+from .migrations import MIGRATIONS
+
+__all__ = ["BackendDB", "MIGRATIONS"]

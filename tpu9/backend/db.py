@@ -1,0 +1,402 @@
+"""Durable backend over SQLite.
+
+Plays the role of the reference's ``BackendRepository`` (Postgres,
+``pkg/repository/backend_postgres.go``): workspaces, tokens, apps, stubs,
+deployments, tasks, images, secrets, checkpoints, volumes. SQLite keeps the
+single-binary deployment story (the SQL is standard enough to swap a Postgres
+driver in via the same interface).
+
+All methods are async; SQLite calls are microseconds at our scale and run
+under a single connection guarded by a lock, in WAL mode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import secrets as pysecrets
+import sqlite3
+import threading
+from typing import Any, Optional
+
+from ..types import (Deployment, Stub, StubConfig, TaskStatus, Token,
+                     Workspace, new_id, now)
+from .migrations import MIGRATIONS
+
+
+def _xor_cipher(data: bytes, key: bytes) -> bytes:
+    # Secrets-at-rest obfuscation; production swaps in KMS-backed AES via the
+    # same hook (reference stores AES-encrypted secrets in Postgres).
+    return bytes(b ^ key[i % len(key)] for i, b in enumerate(data))
+
+
+class BackendDB:
+    def __init__(self, path: str = ":memory:", secret_key: str = "tpu9-dev-key") -> None:
+        self.path = path
+        self._secret_key = hashlib.sha256(secret_key.encode()).digest()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.Lock()
+        if path != ":memory:":
+            self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._migrate()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _migrate(self) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS schema_migrations (version INTEGER PRIMARY KEY, name TEXT, applied_at REAL)")
+            applied = {r[0] for r in self._conn.execute("SELECT version FROM schema_migrations")}
+            for version, name, sql in MIGRATIONS:
+                if version in applied:
+                    continue
+                self._conn.executescript(sql)
+                self._conn.execute(
+                    "INSERT INTO schema_migrations VALUES (?, ?, ?)", (version, name, now()))
+
+    def _exec(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
+        with self._lock, self._conn:
+            return self._conn.execute(sql, params)
+
+    def _query(self, sql: str, params: tuple = ()) -> list[sqlite3.Row]:
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()
+
+    async def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- workspaces / tokens ------------------------------------------------
+
+    async def create_workspace(self, name: str) -> Workspace:
+        ws = Workspace(workspace_id=new_id("ws"), name=name)
+        self._exec(
+            "INSERT INTO workspaces (workspace_id, name, storage_bucket, concurrency_limit_cpu, concurrency_limit_chips, created_at) VALUES (?,?,?,?,?,?)",
+            (ws.workspace_id, ws.name, ws.storage_bucket, 0, 0, ws.created_at))
+        return ws
+
+    async def get_workspace(self, workspace_id: str) -> Optional[Workspace]:
+        rows = self._query("SELECT * FROM workspaces WHERE workspace_id=?", (workspace_id,))
+        return self._row_to_workspace(rows[0]) if rows else None
+
+    async def get_workspace_by_name(self, name: str) -> Optional[Workspace]:
+        rows = self._query("SELECT * FROM workspaces WHERE name=?", (name,))
+        return self._row_to_workspace(rows[0]) if rows else None
+
+    def _row_to_workspace(self, r: sqlite3.Row) -> Workspace:
+        return Workspace(workspace_id=r["workspace_id"], name=r["name"],
+                         storage_bucket=r["storage_bucket"],
+                         concurrency_limit_cpu=r["concurrency_limit_cpu"],
+                         concurrency_limit_chips=r["concurrency_limit_chips"],
+                         created_at=r["created_at"])
+
+    async def create_token(self, workspace_id: str, token_type: str = "workspace") -> Token:
+        tok = Token(token_id=new_id("tok"), key=pysecrets.token_urlsafe(32),
+                    workspace_id=workspace_id, token_type=token_type)
+        self._exec(
+            "INSERT INTO tokens (token_id, key, workspace_id, token_type, active, created_at) VALUES (?,?,?,?,1,?)",
+            (tok.token_id, tok.key, tok.workspace_id, tok.token_type, tok.created_at))
+        return tok
+
+    async def authorize_token(self, key: str) -> Optional[Token]:
+        rows = self._query("SELECT * FROM tokens WHERE key=? AND active=1", (key,))
+        if not rows:
+            return None
+        r = rows[0]
+        return Token(token_id=r["token_id"], key=r["key"], workspace_id=r["workspace_id"],
+                     token_type=r["token_type"], active=bool(r["active"]),
+                     created_at=r["created_at"])
+
+    async def revoke_token(self, token_id: str) -> bool:
+        cur = self._exec("UPDATE tokens SET active=0 WHERE token_id=?", (token_id,))
+        return cur.rowcount > 0
+
+    async def list_tokens(self, workspace_id: str) -> list[Token]:
+        rows = self._query("SELECT * FROM tokens WHERE workspace_id=?", (workspace_id,))
+        return [Token(token_id=r["token_id"], key=r["key"], workspace_id=r["workspace_id"],
+                      token_type=r["token_type"], active=bool(r["active"]),
+                      created_at=r["created_at"]) for r in rows]
+
+    # -- apps ---------------------------------------------------------------
+
+    async def get_or_create_app(self, workspace_id: str, name: str) -> str:
+        rows = self._query("SELECT app_id FROM apps WHERE workspace_id=? AND name=?",
+                           (workspace_id, name))
+        if rows:
+            return rows[0]["app_id"]
+        app_id = new_id("app")
+        self._exec("INSERT INTO apps (app_id, workspace_id, name, created_at) VALUES (?,?,?,?)",
+                   (app_id, workspace_id, name, now()))
+        return app_id
+
+    async def list_apps(self, workspace_id: str) -> list[dict[str, Any]]:
+        rows = self._query("SELECT * FROM apps WHERE workspace_id=?", (workspace_id,))
+        return [dict(r) for r in rows]
+
+    # -- objects (synced code archives) --------------------------------------
+
+    async def create_object(self, workspace_id: str, obj_hash: str, size: int,
+                            path: str) -> str:
+        object_id = new_id("obj")
+        self._exec(
+            "INSERT INTO objects (object_id, workspace_id, hash, size, path, created_at) VALUES (?,?,?,?,?,?)",
+            (object_id, workspace_id, obj_hash, size, path, now()))
+        return object_id
+
+    async def find_object_by_hash(self, workspace_id: str, obj_hash: str) -> Optional[dict]:
+        rows = self._query(
+            "SELECT * FROM objects WHERE workspace_id=? AND hash=? ORDER BY created_at DESC LIMIT 1",
+            (workspace_id, obj_hash))
+        return dict(rows[0]) if rows else None
+
+    async def get_object(self, object_id: str) -> Optional[dict]:
+        rows = self._query("SELECT * FROM objects WHERE object_id=?", (object_id,))
+        return dict(rows[0]) if rows else None
+
+    # -- stubs --------------------------------------------------------------
+
+    async def get_or_create_stub(self, workspace_id: str, name: str, stub_type: str,
+                                 config: StubConfig, object_id: str = "",
+                                 app_name: str = "", force_create: bool = False) -> Stub:
+        config_json = json.dumps(config.to_dict(), sort_keys=True)
+        if not force_create:
+            rows = self._query(
+                "SELECT * FROM stubs WHERE workspace_id=? AND name=? AND stub_type=? AND config_json=? AND object_id=? ORDER BY created_at DESC LIMIT 1",
+                (workspace_id, name, stub_type, config_json, object_id))
+            if rows:
+                return self._row_to_stub(rows[0])
+        app_id = await self.get_or_create_app(workspace_id, app_name or name)
+        stub = Stub(stub_id=new_id("stub"), name=name, stub_type=stub_type,
+                    workspace_id=workspace_id, app_id=app_id, object_id=object_id,
+                    config=config)
+        self._exec(
+            "INSERT INTO stubs (stub_id, name, stub_type, workspace_id, app_id, object_id, config_json, created_at) VALUES (?,?,?,?,?,?,?,?)",
+            (stub.stub_id, stub.name, stub.stub_type, stub.workspace_id, stub.app_id,
+             stub.object_id, config_json, stub.created_at))
+        return stub
+
+    def _row_to_stub(self, r: sqlite3.Row) -> Stub:
+        return Stub(stub_id=r["stub_id"], name=r["name"], stub_type=r["stub_type"],
+                    workspace_id=r["workspace_id"], app_id=r["app_id"],
+                    object_id=r["object_id"],
+                    config=StubConfig.from_dict(json.loads(r["config_json"])),
+                    created_at=r["created_at"])
+
+    async def get_stub(self, stub_id: str) -> Optional[Stub]:
+        rows = self._query("SELECT * FROM stubs WHERE stub_id=?", (stub_id,))
+        return self._row_to_stub(rows[0]) if rows else None
+
+    async def list_stubs(self, workspace_id: str) -> list[Stub]:
+        rows = self._query("SELECT * FROM stubs WHERE workspace_id=? ORDER BY created_at DESC",
+                           (workspace_id,))
+        return [self._row_to_stub(r) for r in rows]
+
+    # -- deployments --------------------------------------------------------
+
+    async def create_deployment(self, workspace_id: str, name: str, stub_id: str,
+                                app_id: str = "") -> Deployment:
+        rows = self._query(
+            "SELECT MAX(version) AS v FROM deployments WHERE workspace_id=? AND name=?",
+            (workspace_id, name))
+        version = (rows[0]["v"] or 0) + 1
+        dep = Deployment(deployment_id=new_id("dep"), name=name, stub_id=stub_id,
+                         workspace_id=workspace_id, app_id=app_id, version=version,
+                         subdomain=f"{name}-{version}")
+        with self._lock, self._conn:
+            self._conn.execute(
+                "UPDATE deployments SET active=0 WHERE workspace_id=? AND name=?",
+                (workspace_id, name))
+            self._conn.execute(
+                "INSERT INTO deployments (deployment_id, name, stub_id, workspace_id, app_id, version, active, subdomain, created_at) VALUES (?,?,?,?,?,?,1,?,?)",
+                (dep.deployment_id, dep.name, dep.stub_id, dep.workspace_id, dep.app_id,
+                 dep.version, dep.subdomain, dep.created_at))
+        return dep
+
+    def _row_to_deployment(self, r: sqlite3.Row) -> Deployment:
+        return Deployment(deployment_id=r["deployment_id"], name=r["name"],
+                          stub_id=r["stub_id"], workspace_id=r["workspace_id"],
+                          app_id=r["app_id"], version=r["version"],
+                          active=bool(r["active"]), subdomain=r["subdomain"],
+                          created_at=r["created_at"])
+
+    async def get_deployment(self, workspace_id: str, name: str,
+                             version: int = 0) -> Optional[Deployment]:
+        if version:
+            rows = self._query(
+                "SELECT * FROM deployments WHERE workspace_id=? AND name=? AND version=?",
+                (workspace_id, name, version))
+        else:
+            rows = self._query(
+                "SELECT * FROM deployments WHERE workspace_id=? AND name=? AND active=1 ORDER BY version DESC LIMIT 1",
+                (workspace_id, name))
+        return self._row_to_deployment(rows[0]) if rows else None
+
+    async def get_deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        rows = self._query("SELECT * FROM deployments WHERE deployment_id=?", (deployment_id,))
+        return self._row_to_deployment(rows[0]) if rows else None
+
+    async def get_deployment_by_subdomain(self, subdomain: str) -> Optional[Deployment]:
+        rows = self._query(
+            "SELECT * FROM deployments WHERE subdomain=? AND active=1", (subdomain,))
+        return self._row_to_deployment(rows[0]) if rows else None
+
+    async def list_deployments(self, workspace_id: str,
+                               active_only: bool = False) -> list[Deployment]:
+        sql = "SELECT * FROM deployments WHERE workspace_id=?"
+        if active_only:
+            sql += " AND active=1"
+        rows = self._query(sql + " ORDER BY created_at DESC", (workspace_id,))
+        return [self._row_to_deployment(r) for r in rows]
+
+    async def list_active_deployments(self) -> list[Deployment]:
+        rows = self._query("SELECT * FROM deployments WHERE active=1", ())
+        return [self._row_to_deployment(r) for r in rows]
+
+    async def set_deployment_active(self, deployment_id: str, active: bool) -> None:
+        self._exec("UPDATE deployments SET active=? WHERE deployment_id=?",
+                   (1 if active else 0, deployment_id))
+
+    async def delete_deployment(self, deployment_id: str) -> None:
+        self._exec("DELETE FROM deployments WHERE deployment_id=?", (deployment_id,))
+
+    # -- tasks (durable record; hot state lives in the state store) ----------
+
+    async def record_task(self, task_id: str, stub_id: str, workspace_id: str,
+                          status: str) -> None:
+        self._exec(
+            "INSERT INTO tasks (task_id, stub_id, workspace_id, status, created_at) VALUES (?,?,?,?,?) "
+            "ON CONFLICT(task_id) DO UPDATE SET status=excluded.status",
+            (task_id, stub_id, workspace_id, status, now()))
+
+    async def update_task_status(self, task_id: str, status: str,
+                                 container_id: str = "") -> None:
+        ended = now() if TaskStatus(status).terminal else 0
+        self._exec(
+            "UPDATE tasks SET status=?, container_id=COALESCE(NULLIF(?, ''), container_id), ended_at=? WHERE task_id=?",
+            (status, container_id, ended, task_id))
+
+    async def list_tasks(self, workspace_id: str, stub_id: str = "",
+                         limit: int = 100) -> list[dict]:
+        if stub_id:
+            rows = self._query(
+                "SELECT * FROM tasks WHERE workspace_id=? AND stub_id=? ORDER BY created_at DESC LIMIT ?",
+                (workspace_id, stub_id, limit))
+        else:
+            rows = self._query(
+                "SELECT * FROM tasks WHERE workspace_id=? ORDER BY created_at DESC LIMIT ?",
+                (workspace_id, limit))
+        return [dict(r) for r in rows]
+
+    # -- secrets ------------------------------------------------------------
+
+    async def upsert_secret(self, workspace_id: str, name: str, value: str) -> str:
+        enc = _xor_cipher(value.encode(), self._secret_key)
+        self._exec(
+            "INSERT INTO secrets (secret_id, workspace_id, name, value_enc, created_at, updated_at) VALUES (?,?,?,?,?,?) "
+            "ON CONFLICT(workspace_id, name) DO UPDATE SET value_enc=excluded.value_enc, updated_at=excluded.updated_at",
+            (new_id("sec"), workspace_id, name, enc, now(), now()))
+        rows = self._query("SELECT secret_id FROM secrets WHERE workspace_id=? AND name=?",
+                           (workspace_id, name))
+        return rows[0]["secret_id"]
+
+    async def get_secret(self, workspace_id: str, name: str) -> Optional[str]:
+        rows = self._query("SELECT value_enc FROM secrets WHERE workspace_id=? AND name=?",
+                           (workspace_id, name))
+        if not rows:
+            return None
+        return _xor_cipher(rows[0]["value_enc"], self._secret_key).decode()
+
+    async def list_secrets(self, workspace_id: str) -> list[str]:
+        rows = self._query("SELECT name FROM secrets WHERE workspace_id=? ORDER BY name",
+                           (workspace_id,))
+        return [r["name"] for r in rows]
+
+    async def delete_secret(self, workspace_id: str, name: str) -> bool:
+        cur = self._exec("DELETE FROM secrets WHERE workspace_id=? AND name=?",
+                         (workspace_id, name))
+        return cur.rowcount > 0
+
+    # -- images -------------------------------------------------------------
+
+    async def upsert_image(self, image_id: str, workspace_id: str, spec: dict,
+                           status: str = "pending", manifest_hash: str = "",
+                           size: int = 0) -> None:
+        self._exec(
+            "INSERT INTO images (image_id, workspace_id, manifest_hash, size, status, spec_json, created_at) VALUES (?,?,?,?,?,?,?) "
+            "ON CONFLICT(image_id) DO UPDATE SET manifest_hash=excluded.manifest_hash, size=excluded.size, status=excluded.status",
+            (image_id, workspace_id, manifest_hash, size, status,
+             json.dumps(spec, sort_keys=True), now()))
+
+    async def get_image(self, image_id: str) -> Optional[dict]:
+        rows = self._query("SELECT * FROM images WHERE image_id=?", (image_id,))
+        if not rows:
+            return None
+        d = dict(rows[0])
+        d["spec"] = json.loads(d.pop("spec_json"))
+        return d
+
+    # -- checkpoints --------------------------------------------------------
+
+    async def create_checkpoint(self, stub_id: str, workspace_id: str,
+                                container_id: str, kind: str = "jax") -> str:
+        checkpoint_id = new_id("ckpt")
+        self._exec(
+            "INSERT INTO checkpoints (checkpoint_id, stub_id, workspace_id, container_id, status, kind, created_at) VALUES (?,?,?,?, 'pending', ?, ?)",
+            (checkpoint_id, stub_id, workspace_id, container_id, kind, now()))
+        return checkpoint_id
+
+    async def update_checkpoint(self, checkpoint_id: str, status: str,
+                                remote_key: str = "", size: int = 0) -> None:
+        self._exec(
+            "UPDATE checkpoints SET status=?, remote_key=?, size=? WHERE checkpoint_id=?",
+            (status, remote_key, size, checkpoint_id))
+
+    async def latest_checkpoint(self, stub_id: str) -> Optional[dict]:
+        rows = self._query(
+            "SELECT * FROM checkpoints WHERE stub_id=? AND status='available' ORDER BY created_at DESC LIMIT 1",
+            (stub_id,))
+        return dict(rows[0]) if rows else None
+
+    # -- volumes ------------------------------------------------------------
+
+    async def get_or_create_volume(self, workspace_id: str, name: str) -> dict:
+        rows = self._query("SELECT * FROM volumes WHERE workspace_id=? AND name=?",
+                           (workspace_id, name))
+        if rows:
+            return dict(rows[0])
+        volume_id = new_id("vol")
+        self._exec(
+            "INSERT INTO volumes (volume_id, workspace_id, name, size, created_at) VALUES (?,?,?,0,?)",
+            (volume_id, workspace_id, name, now()))
+        return {"volume_id": volume_id, "workspace_id": workspace_id, "name": name,
+                "size": 0, "created_at": now()}
+
+    async def list_volumes(self, workspace_id: str) -> list[dict]:
+        rows = self._query("SELECT * FROM volumes WHERE workspace_id=?", (workspace_id,))
+        return [dict(r) for r in rows]
+
+    async def delete_volume(self, workspace_id: str, name: str) -> bool:
+        cur = self._exec("DELETE FROM volumes WHERE workspace_id=? AND name=?",
+                         (workspace_id, name))
+        return cur.rowcount > 0
+
+    # -- schedules ----------------------------------------------------------
+
+    async def upsert_schedule(self, stub_id: str, workspace_id: str, cron: str) -> str:
+        self._exec(
+            "INSERT INTO schedules (schedule_id, stub_id, workspace_id, cron, active, created_at) VALUES (?,?,?,?,1,?) "
+            "ON CONFLICT(stub_id) DO UPDATE SET cron=excluded.cron, active=1",
+            (new_id("sched"), stub_id, workspace_id, cron, now()))
+        rows = self._query("SELECT schedule_id FROM schedules WHERE stub_id=?", (stub_id,))
+        return rows[0]["schedule_id"]
+
+    async def list_schedules(self, active_only: bool = True) -> list[dict]:
+        sql = "SELECT * FROM schedules" + (" WHERE active=1" if active_only else "")
+        return [dict(r) for r in self._query(sql, ())]
+
+    async def mark_schedule_fired(self, schedule_id: str, at: float) -> None:
+        self._exec("UPDATE schedules SET last_fired_at=? WHERE schedule_id=?",
+                   (at, schedule_id))

@@ -1,0 +1,159 @@
+"""Numbered schema migrations for the durable backend.
+
+The reference runs 46 goose migrations against Postgres
+(``pkg/repository/backend_postgres_migrations/``); tpu9 uses the same
+pattern over SQLite (swappable for Postgres in production via the same SQL
+subset). Each migration is (version, name, sql).
+"""
+
+MIGRATIONS: list[tuple[int, str, str]] = [
+    (1, "workspaces", """
+        CREATE TABLE workspaces (
+            workspace_id TEXT PRIMARY KEY,
+            name TEXT UNIQUE NOT NULL,
+            storage_bucket TEXT DEFAULT '',
+            concurrency_limit_cpu INTEGER DEFAULT 0,
+            concurrency_limit_chips INTEGER DEFAULT 0,
+            created_at REAL NOT NULL
+        );
+    """),
+    (2, "tokens", """
+        CREATE TABLE tokens (
+            token_id TEXT PRIMARY KEY,
+            key TEXT UNIQUE NOT NULL,
+            workspace_id TEXT NOT NULL,
+            token_type TEXT DEFAULT 'workspace',
+            active INTEGER DEFAULT 1,
+            created_at REAL NOT NULL
+        );
+        CREATE INDEX idx_tokens_workspace ON tokens(workspace_id);
+    """),
+    (3, "apps", """
+        CREATE TABLE apps (
+            app_id TEXT PRIMARY KEY,
+            workspace_id TEXT NOT NULL,
+            name TEXT NOT NULL,
+            created_at REAL NOT NULL,
+            UNIQUE(workspace_id, name)
+        );
+    """),
+    (4, "objects", """
+        CREATE TABLE objects (
+            object_id TEXT PRIMARY KEY,
+            workspace_id TEXT NOT NULL,
+            hash TEXT NOT NULL,
+            size INTEGER NOT NULL,
+            path TEXT NOT NULL,
+            created_at REAL NOT NULL
+        );
+        CREATE INDEX idx_objects_ws_hash ON objects(workspace_id, hash);
+    """),
+    (5, "stubs", """
+        CREATE TABLE stubs (
+            stub_id TEXT PRIMARY KEY,
+            name TEXT NOT NULL,
+            stub_type TEXT NOT NULL,
+            workspace_id TEXT NOT NULL,
+            app_id TEXT DEFAULT '',
+            object_id TEXT DEFAULT '',
+            config_json TEXT NOT NULL,
+            created_at REAL NOT NULL
+        );
+        CREATE INDEX idx_stubs_workspace ON stubs(workspace_id);
+    """),
+    (6, "deployments", """
+        CREATE TABLE deployments (
+            deployment_id TEXT PRIMARY KEY,
+            name TEXT NOT NULL,
+            stub_id TEXT NOT NULL,
+            workspace_id TEXT NOT NULL,
+            app_id TEXT DEFAULT '',
+            version INTEGER NOT NULL,
+            active INTEGER DEFAULT 1,
+            subdomain TEXT DEFAULT '',
+            created_at REAL NOT NULL,
+            UNIQUE(workspace_id, name, version)
+        );
+        CREATE INDEX idx_deployments_name ON deployments(workspace_id, name);
+        CREATE INDEX idx_deployments_subdomain ON deployments(subdomain);
+    """),
+    (7, "tasks", """
+        CREATE TABLE tasks (
+            task_id TEXT PRIMARY KEY,
+            stub_id TEXT NOT NULL,
+            workspace_id TEXT NOT NULL,
+            status TEXT NOT NULL,
+            container_id TEXT DEFAULT '',
+            started_at REAL DEFAULT 0,
+            ended_at REAL DEFAULT 0,
+            created_at REAL NOT NULL
+        );
+        CREATE INDEX idx_tasks_stub ON tasks(stub_id, status);
+        CREATE INDEX idx_tasks_ws ON tasks(workspace_id, created_at);
+    """),
+    (8, "images", """
+        CREATE TABLE images (
+            image_id TEXT PRIMARY KEY,
+            workspace_id TEXT DEFAULT '',
+            manifest_hash TEXT DEFAULT '',
+            size INTEGER DEFAULT 0,
+            status TEXT DEFAULT 'pending',
+            spec_json TEXT DEFAULT '{}',
+            created_at REAL NOT NULL
+        );
+    """),
+    (9, "secrets", """
+        CREATE TABLE secrets (
+            secret_id TEXT PRIMARY KEY,
+            workspace_id TEXT NOT NULL,
+            name TEXT NOT NULL,
+            value_enc BLOB NOT NULL,
+            created_at REAL NOT NULL,
+            updated_at REAL NOT NULL,
+            UNIQUE(workspace_id, name)
+        );
+    """),
+    (10, "checkpoints", """
+        CREATE TABLE checkpoints (
+            checkpoint_id TEXT PRIMARY KEY,
+            stub_id TEXT NOT NULL,
+            workspace_id TEXT NOT NULL,
+            container_id TEXT DEFAULT '',
+            status TEXT DEFAULT 'pending',
+            kind TEXT DEFAULT 'jax',
+            remote_key TEXT DEFAULT '',
+            size INTEGER DEFAULT 0,
+            created_at REAL NOT NULL
+        );
+        CREATE INDEX idx_checkpoints_stub ON checkpoints(stub_id, created_at);
+    """),
+    (11, "volumes", """
+        CREATE TABLE volumes (
+            volume_id TEXT PRIMARY KEY,
+            workspace_id TEXT NOT NULL,
+            name TEXT NOT NULL,
+            size INTEGER DEFAULT 0,
+            created_at REAL NOT NULL,
+            UNIQUE(workspace_id, name)
+        );
+    """),
+    (12, "task_stats", """
+        CREATE TABLE task_stats (
+            stub_id TEXT PRIMARY KEY,
+            complete INTEGER DEFAULT 0,
+            error INTEGER DEFAULT 0,
+            total_duration_s REAL DEFAULT 0
+        );
+    """),
+    (13, "schedules", """
+        CREATE TABLE schedules (
+            schedule_id TEXT PRIMARY KEY,
+            stub_id TEXT NOT NULL UNIQUE,
+            workspace_id TEXT NOT NULL,
+            cron TEXT NOT NULL,
+            active INTEGER DEFAULT 1,
+            last_fired_at REAL DEFAULT 0,
+            created_at REAL NOT NULL
+        );
+    """),
+]

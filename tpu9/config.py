@@ -1,0 +1,205 @@
+"""Layered configuration.
+
+Analogue of the reference's koanf-based ConfigManager (``pkg/common/config.go``)
+loading baked-in defaults (``pkg/common/config.default.yaml``) overlaid by a
+``CONFIG_PATH`` file then ``CONFIG_JSON``/env vars. tpu9 keeps the same layering
+with typed dataclasses instead of a YAML schema: defaults in code → optional
+YAML/JSON file at ``TPU9_CONFIG_PATH`` → ``TPU9_CONFIG_JSON`` → ``TPU9_*`` env
+overrides (dotted path, e.g. ``TPU9_GATEWAY__HTTP_PORT=8080``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+import yaml
+
+
+@dataclass
+class DatabaseConfig:
+    # durable backend (sqlite file; ":memory:" for tests)
+    path: str = "tpu9.db"
+    # hot state bus: "memory" (embedded) or "host:port" of a StateServer
+    state_addr: str = "memory"
+    state_auth_token: str = ""
+
+
+@dataclass
+class GatewayConfig:
+    host: str = "127.0.0.1"
+    http_port: int = 1994
+    state_port: int = 14950        # embedded StateServer port (0 = disabled)
+    external_url: str = ""
+    shutdown_drain_s: float = 30.0
+    invoke_base_path: str = ""     # subdomain-less route prefix
+
+
+@dataclass
+class SchedulerConfig:
+    loop_interval_s: float = 0.05   # reference: 50ms batch loop scheduler.go:28
+    batch_size: int = 512
+    max_retries: int = 3
+    backlog_warning_depth: int = 1000
+    gang_reservation_ttl_s: float = 30.0
+
+
+@dataclass
+class WorkerPoolConfig:
+    name: str = "default"
+    mode: str = "process"           # process | runc | gce-tpu
+    tpu_type: str = ""              # slice shape this pool provisions ("" = CPU)
+    min_free_cpu_millicores: int = 0
+    min_free_memory_mb: int = 0
+    min_free_tpu_chips: int = 0
+    max_workers: int = 10
+    runtime: str = "process"
+    priority: int = 0
+    # gce-tpu pool knobs
+    gcp_project: str = ""
+    gcp_zone: str = ""
+    runtime_version: str = "tpu-ubuntu2204-base"
+    reserved: bool = False
+    spot: bool = False
+
+
+@dataclass
+class WorkerConfig:
+    keepalive_ttl_s: float = 15.0   # reference worker.go:1026 TTL keys
+    heartbeat_interval_s: float = 5.0
+    idle_shutdown_s: float = 300.0
+    start_concurrency: int = 4
+    images_dir: str = "/tmp/tpu9/images"
+    containers_dir: str = "/tmp/tpu9/containers"
+    logs_dir: str = "/tmp/tpu9/logs"
+    checkpoint_dir: str = "/tmp/tpu9/checkpoints"
+    failover_max_pending: int = 10
+    failover_max_scheduling_latency_ms: float = 5000.0
+
+
+@dataclass
+class CacheConfig:
+    enabled: bool = True
+    data_dir: str = "/tmp/tpu9/cache"
+    max_bytes: int = 32 * 1024**3
+    chunk_bytes: int = 4 * 1024**2
+    port: int = 0                   # 0 = auto
+    replicas: int = 1               # HRW replication factor
+    prefetch_window: int = 8
+
+
+@dataclass
+class StorageConfig:
+    mode: str = "local"             # local | gcs
+    local_root: str = "/tmp/tpu9/workspaces"
+    gcs_bucket: str = ""
+
+
+@dataclass
+class ImageConfig:
+    registry_dir: str = "/tmp/tpu9/registry"   # content-addressed image store
+    build_timeout_s: float = 1800.0
+    python_version: str = "python3.11"
+
+
+@dataclass
+class MonitoringConfig:
+    metrics_enabled: bool = True
+    metrics_push_url: str = ""
+    events_sink: str = "state"      # state | http | none
+    events_http_url: str = ""
+    log_level: str = "INFO"
+    container_log_lines_per_hour: int = 200000
+
+
+@dataclass
+class AppConfig:
+    cluster_name: str = "tpu9"
+    database: DatabaseConfig = field(default_factory=DatabaseConfig)
+    gateway: GatewayConfig = field(default_factory=GatewayConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    worker: WorkerConfig = field(default_factory=WorkerConfig)
+    pools: list[WorkerPoolConfig] = field(default_factory=lambda: [WorkerPoolConfig()])
+    cache: CacheConfig = field(default_factory=CacheConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    image: ImageConfig = field(default_factory=ImageConfig)
+    monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
+    debug: bool = False
+
+
+def _merge_into(obj: Any, data: dict[str, Any]) -> Any:
+    """Recursively overlay dict values onto a dataclass instance."""
+    if not dataclasses.is_dataclass(obj):
+        return data
+    names = {f.name: f for f in dataclasses.fields(obj)}
+    for key, value in data.items():
+        if key not in names:
+            continue
+        cur = getattr(obj, key)
+        if dataclasses.is_dataclass(cur) and isinstance(value, dict):
+            _merge_into(cur, value)
+        elif key == "pools" and isinstance(value, list):
+            pools = []
+            for item in value:
+                p = WorkerPoolConfig()
+                _merge_into(p, item if isinstance(item, dict) else {})
+                pools.append(p)
+            setattr(obj, key, pools)
+        else:
+            setattr(obj, key, value)
+    return obj
+
+
+def _coerce(cur: Any, raw: str) -> Any:
+    if isinstance(cur, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(cur, int):
+        return int(raw)
+    if isinstance(cur, float):
+        return float(raw)
+    return raw
+
+
+def _apply_env(cfg: AppConfig, environ: dict[str, str]) -> None:
+    for key, raw in environ.items():
+        if not key.startswith("TPU9_") or key in ("TPU9_CONFIG_PATH", "TPU9_CONFIG_JSON"):
+            continue
+        path = key[len("TPU9_"):].lower().split("__")
+        obj: Any = cfg
+        ok = True
+        for part in path[:-1]:
+            if dataclasses.is_dataclass(obj) and hasattr(obj, part):
+                obj = getattr(obj, part)
+            else:
+                ok = False
+                break
+        leaf = path[-1]
+        if ok and dataclasses.is_dataclass(obj) and hasattr(obj, leaf):
+            setattr(obj, leaf, _coerce(getattr(obj, leaf), raw))
+
+
+def load_config(path: Optional[str] = None,
+                overrides: Optional[dict[str, Any]] = None,
+                environ: Optional[dict[str, str]] = None) -> AppConfig:
+    environ = environ if environ is not None else dict(os.environ)
+    cfg = AppConfig()
+    file_path = path or environ.get("TPU9_CONFIG_PATH")
+    if file_path:
+        if not Path(file_path).exists():
+            # fail fast: an explicitly-configured path that doesn't exist is a
+            # misconfiguration, not a request for defaults
+            raise FileNotFoundError(f"config file not found: {file_path}")
+        with open(file_path) as f:
+            data = yaml.safe_load(f) or {}
+        _merge_into(cfg, data)
+    blob = environ.get("TPU9_CONFIG_JSON")
+    if blob:
+        _merge_into(cfg, json.loads(blob))
+    _apply_env(cfg, environ)
+    if overrides:
+        _merge_into(cfg, overrides)
+    return cfg

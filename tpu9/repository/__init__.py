@@ -1,0 +1,6 @@
+from .keys import Keys
+from .worker_repo import WorkerRepository
+from .container_repo import ContainerRepository
+from .task_repo import TaskRepository
+
+__all__ = ["Keys", "WorkerRepository", "ContainerRepository", "TaskRepository"]

@@ -1,0 +1,130 @@
+"""Container hot state over the state store.
+
+Reference analogue: ``pkg/repository/container_redis.go`` — container state
+hashes with TTL semantics, container-address keys used by request buffers for
+discovery (``pkg/abstractions/endpoint/buffer.go:303``), exit codes, and the
+per-stub container index the autoscalers read.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..statestore import StateStore
+from ..types import ContainerRequest, ContainerState, ContainerStatus
+from .keys import Keys
+
+# Containers must refresh state within this horizon or be considered lost
+CONTAINER_STATE_TTL_S = 60.0
+
+
+class ContainerRepository:
+    def __init__(self, store: StateStore) -> None:
+        self.store = store
+
+    async def set_request(self, request: ContainerRequest) -> None:
+        await self.store.set(Keys.container_request(request.container_id),
+                             json.dumps(request.to_dict()))
+
+    async def get_request(self, container_id: str) -> Optional[ContainerRequest]:
+        raw = await self.store.get(Keys.container_request(container_id))
+        return ContainerRequest.from_dict(json.loads(raw)) if raw else None
+
+    async def update_state(self, state: ContainerState) -> None:
+        key = Keys.container_state(state.container_id)
+        await self.store.hmset(key, state.to_dict())
+        await self.store.expire(key, CONTAINER_STATE_TTL_S)
+        await self.store.hset(Keys.stub_containers(state.stub_id),
+                              state.container_id, state.status)
+        if ContainerStatus(state.status) in (ContainerStatus.STOPPED,
+                                             ContainerStatus.FAILED):
+            await self.store.hdel(Keys.stub_containers(state.stub_id),
+                                  state.container_id)
+
+    async def refresh_ttl(self, container_id: str) -> None:
+        await self.store.expire(Keys.container_state(container_id),
+                                CONTAINER_STATE_TTL_S)
+
+    async def get_state(self, container_id: str) -> Optional[ContainerState]:
+        data = await self.store.hgetall(Keys.container_state(container_id))
+        return ContainerState.from_dict(data) if data else None
+
+    async def delete_state(self, container_id: str, stub_id: str = "") -> None:
+        state = await self.get_state(container_id)
+        stub = stub_id or (state.stub_id if state else "")
+        await self.store.delete(Keys.container_state(container_id),
+                                Keys.container_address(container_id),
+                                Keys.container_request(container_id))
+        if stub:
+            await self.store.hdel(Keys.stub_containers(stub), container_id)
+
+    # -- discovery ----------------------------------------------------------
+
+    async def set_address(self, container_id: str, address: str) -> None:
+        await self.store.set(Keys.container_address(container_id), address)
+
+    async def get_address(self, container_id: str) -> Optional[str]:
+        return await self.store.get(Keys.container_address(container_id))
+
+    async def containers_by_stub(self, stub_id: str,
+                                 status: Optional[str] = None) -> list[ContainerState]:
+        index = await self.store.hgetall(Keys.stub_containers(stub_id))
+        out = []
+        for container_id in index:
+            state = await self.get_state(container_id)
+            if state is None:
+                # state TTL'd out → container lost; drop from index
+                await self.store.hdel(Keys.stub_containers(stub_id), container_id)
+                continue
+            if status is None or state.status == status:
+                out.append(state)
+        return out
+
+    async def active_count_by_stub(self, stub_id: str) -> int:
+        return len(await self.containers_by_stub(stub_id))
+
+    # -- exit codes ---------------------------------------------------------
+
+    async def set_exit_code(self, container_id: str, code: int,
+                            reason: str = "") -> None:
+        await self.store.set(Keys.container_exit(container_id),
+                             json.dumps({"code": code, "reason": reason}),
+                             ttl=300.0)
+
+    async def get_exit(self, container_id: str) -> Optional[dict]:
+        raw = await self.store.get(Keys.container_exit(container_id))
+        return json.loads(raw) if raw else None
+
+    # -- concurrency tokens (request buffer admission) -----------------------
+
+    async def acquire_request_token(self, stub_id: str, container_id: str,
+                                    limit: int) -> bool:
+        key = Keys.stub_concurrency(stub_id, container_id)
+        cur = await self.store.incr(key)
+        if cur > limit:
+            await self.store.incr(key, -1)
+            return False
+        return True
+
+    async def release_request_token(self, stub_id: str, container_id: str) -> None:
+        key = Keys.stub_concurrency(stub_id, container_id)
+        cur = await self.store.incr(key, -1)
+        if cur < 0:
+            await self.store.set(key, 0)
+
+    async def in_flight(self, stub_id: str, container_id: str) -> int:
+        val = await self.store.get(Keys.stub_concurrency(stub_id, container_id))
+        return int(val or 0)
+
+    # -- logs ---------------------------------------------------------------
+
+    async def append_log(self, container_id: str, line: str,
+                         stream: str = "stdout") -> None:
+        await self.store.xadd(Keys.container_logs(container_id),
+                              {"line": line, "stream": stream}, maxlen=10000)
+
+    async def read_logs(self, container_id: str, last_id: str = "0",
+                        timeout: float = 0) -> list[tuple[str, dict]]:
+        return await self.store.xread(Keys.container_logs(container_id),
+                                      last_id=last_id, timeout=timeout)

@@ -1,0 +1,88 @@
+"""State-store key schema (analogue of the reference's Redis key helpers in
+``pkg/common/keys.go``). One place so repos/tests agree on layout."""
+
+
+class Keys:
+    # scheduler
+    BACKLOG = "scheduler:backlog"                      # zset of request json by priority
+    GANG_PREFIX = "scheduler:gang:"                    # gang reservation hashes
+
+    @staticmethod
+    def worker_state(worker_id: str) -> str:
+        return f"worker:state:{worker_id}"
+
+    @staticmethod
+    def worker_keepalive(worker_id: str) -> str:
+        return f"worker:keepalive:{worker_id}"
+
+    @staticmethod
+    def worker_requests(worker_id: str) -> str:        # stream of ContainerRequest
+        return f"worker:requests:{worker_id}"
+
+    @staticmethod
+    def worker_containers(worker_id: str) -> str:      # hash container_id -> 1
+        return f"worker:containers:{worker_id}"
+
+    @staticmethod
+    def container_state(container_id: str) -> str:
+        return f"container:state:{container_id}"
+
+    @staticmethod
+    def container_address(container_id: str) -> str:
+        return f"container:addr:{container_id}"
+
+    @staticmethod
+    def container_request(container_id: str) -> str:
+        return f"container:request:{container_id}"
+
+    @staticmethod
+    def container_exit(container_id: str) -> str:
+        return f"container:exit:{container_id}"
+
+    @staticmethod
+    def container_logs(container_id: str) -> str:      # stream
+        return f"container:logs:{container_id}"
+
+    @staticmethod
+    def stub_containers(stub_id: str) -> str:          # hash container_id -> status
+        return f"stub:containers:{stub_id}"
+
+    @staticmethod
+    def stub_concurrency(stub_id: str, container_id: str) -> str:
+        return f"stub:tokens:{stub_id}:{container_id}"
+
+    @staticmethod
+    def task_message(task_id: str) -> str:
+        return f"task:msg:{task_id}"
+
+    @staticmethod
+    def task_result(task_id: str) -> str:
+        return f"task:result:{task_id}"
+
+    @staticmethod
+    def task_queue(workspace_id: str, stub_id: str) -> str:   # list
+        return f"task:queue:{workspace_id}:{stub_id}"
+
+    @staticmethod
+    def task_claims(container_id: str) -> str:                # hash task_id -> ts
+        return f"task:claims:{container_id}"
+
+    @staticmethod
+    def task_index(stub_id: str) -> str:                      # hash task_id -> status
+        return f"task:index:{stub_id}"
+
+    @staticmethod
+    def events_channel(kind: str) -> str:
+        return f"events:{kind}"
+
+    @staticmethod
+    def gang(gang_id: str) -> str:
+        return f"{Keys.GANG_PREFIX}{gang_id}"
+
+    @staticmethod
+    def signal(workspace_id: str, name: str) -> str:
+        return f"signal:{workspace_id}:{name}"
+
+    @staticmethod
+    def pool_state(pool: str) -> str:
+        return f"pool:state:{pool}"

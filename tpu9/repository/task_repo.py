@@ -1,0 +1,98 @@
+"""Task hot state: messages, per-stub queues, claims, results.
+
+Reference analogue: ``pkg/repository/task_redis.go`` + the task-queue client's
+Redis list ops (``pkg/abstractions/taskqueue/client.go:29`` RPUSH,
+``taskqueue.go:236`` long-poll pop). Results round-trip through the state
+store with a TTL like the reference's Dispatcher.StoreTaskResult
+(``pkg/task/dispatch.go:120``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from ..statestore import StateStore
+from ..types import TaskMessage, TaskStatus
+from .keys import Keys
+
+RESULT_TTL_S = 24 * 3600.0
+
+
+class TaskRepository:
+    def __init__(self, store: StateStore) -> None:
+        self.store = store
+
+    # -- message lifecycle ---------------------------------------------------
+
+    async def put_message(self, msg: TaskMessage) -> None:
+        await self.store.set(Keys.task_message(msg.task_id),
+                             json.dumps(msg.to_dict()))
+        await self.store.hset(Keys.task_index(msg.stub_id), msg.task_id, msg.status)
+
+    async def get_message(self, task_id: str) -> Optional[TaskMessage]:
+        raw = await self.store.get(Keys.task_message(task_id))
+        return TaskMessage.from_dict(json.loads(raw)) if raw else None
+
+    async def set_status(self, task_id: str, status: str,
+                         container_id: str = "") -> Optional[TaskMessage]:
+        msg = await self.get_message(task_id)
+        if msg is None:
+            return None
+        msg.status = status
+        if container_id:
+            msg.container_id = container_id
+        await self.put_message(msg)
+        if TaskStatus(status).terminal:
+            await self.store.hdel(Keys.task_index(msg.stub_id), task_id)
+        return msg
+
+    async def delete_message(self, task_id: str) -> None:
+        msg = await self.get_message(task_id)
+        if msg:
+            await self.store.hdel(Keys.task_index(msg.stub_id), task_id)
+        await self.store.delete(Keys.task_message(task_id))
+
+    async def tasks_in_flight(self, stub_id: str) -> int:
+        return len(await self.store.hgetall(Keys.task_index(stub_id)))
+
+    # -- queues --------------------------------------------------------------
+
+    async def enqueue(self, workspace_id: str, stub_id: str, task_id: str) -> int:
+        return await self.store.rpush(Keys.task_queue(workspace_id, stub_id), task_id)
+
+    async def dequeue(self, workspace_id: str, stub_id: str,
+                      timeout: float = 0) -> Optional[str]:
+        if timeout:
+            return await self.store.blpop(Keys.task_queue(workspace_id, stub_id),
+                                          timeout=timeout)
+        return await self.store.lpop(Keys.task_queue(workspace_id, stub_id))
+
+    async def queue_depth(self, workspace_id: str, stub_id: str) -> int:
+        return await self.store.llen(Keys.task_queue(workspace_id, stub_id))
+
+    async def remove_from_queue(self, workspace_id: str, stub_id: str,
+                                task_id: str) -> int:
+        return await self.store.lrem(Keys.task_queue(workspace_id, stub_id), task_id)
+
+    # -- claims (processing locks per container) -----------------------------
+
+    async def claim(self, container_id: str, task_id: str, ts: float) -> None:
+        await self.store.hset(Keys.task_claims(container_id), task_id, ts)
+
+    async def unclaim(self, container_id: str, task_id: str) -> None:
+        await self.store.hdel(Keys.task_claims(container_id), task_id)
+
+    async def claims(self, container_id: str) -> dict[str, float]:
+        raw = await self.store.hgetall(Keys.task_claims(container_id))
+        return {k: float(v) for k, v in raw.items()}
+
+    # -- results -------------------------------------------------------------
+
+    async def store_result(self, task_id: str, payload: Any) -> None:
+        await self.store.set(Keys.task_result(task_id), json.dumps(payload),
+                             ttl=RESULT_TTL_S)
+
+    async def get_result(self, task_id: str) -> Optional[Any]:
+        raw = await self.store.get(Keys.task_result(task_id))
+        return json.loads(raw) if raw is not None else None

@@ -1,0 +1,127 @@
+"""Worker registry over the state store.
+
+Reference analogue: ``pkg/repository/worker_redis.go`` — worker state hashes,
+keepalive TTL keys (``pkg/worker/worker.go:1026``), capacity updates under a
+per-worker lock, and per-worker container-request streams
+(``pkg/scheduler/scheduler.go:632-666``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from ..statestore import StateStore
+from ..types import ContainerRequest, WorkerState, new_id
+from .keys import Keys
+
+
+class WorkerRepository:
+    def __init__(self, store: StateStore, keepalive_ttl_s: float = 15.0) -> None:
+        self.store = store
+        self.keepalive_ttl_s = keepalive_ttl_s
+
+    async def register(self, state: WorkerState) -> None:
+        await self.store.hmset(Keys.worker_state(state.worker_id), state.to_dict())
+        await self.touch_keepalive(state.worker_id)
+
+    async def deregister(self, worker_id: str) -> None:
+        await self.store.delete(
+            Keys.worker_state(worker_id),
+            Keys.worker_keepalive(worker_id),
+            Keys.worker_requests(worker_id),
+            Keys.worker_containers(worker_id),
+        )
+
+    async def touch_keepalive(self, worker_id: str) -> None:
+        await self.store.set(Keys.worker_keepalive(worker_id), "1",
+                             ttl=self.keepalive_ttl_s)
+
+    async def is_alive(self, worker_id: str) -> bool:
+        return await self.store.exists(Keys.worker_keepalive(worker_id))
+
+    async def get(self, worker_id: str) -> Optional[WorkerState]:
+        data = await self.store.hgetall(Keys.worker_state(worker_id))
+        if not data:
+            return None
+        return WorkerState.from_dict(data)
+
+    async def list(self, pool: str = "", alive_only: bool = False) -> list[WorkerState]:
+        keys = await self.store.keys("worker:state:*")
+        out = []
+        for key in keys:
+            data = await self.store.hgetall(key)
+            if not data:
+                continue
+            ws = WorkerState.from_dict(data)
+            if pool and ws.pool != pool:
+                continue
+            if alive_only and not await self.is_alive(ws.worker_id):
+                continue
+            out.append(ws)
+        return out
+
+    async def update_status(self, worker_id: str, status: str) -> None:
+        await self.store.hset(Keys.worker_state(worker_id), "status", status)
+
+    async def adjust_capacity(self, worker_id: str, cpu_millicores: int = 0,
+                              memory_mb: int = 0, tpu_chips: int = 0) -> bool:
+        """Atomically reserve (negative deltas) or release capacity. Returns
+        False only if the worker is gone or the reservation would go negative.
+        Lock contention is retried so a capacity *release* is never dropped
+        (dropping one would leak chips until worker re-registration).
+        Guarded by a per-worker lock like the reference's UpdateWorkerCapacity.
+        """
+        key = Keys.worker_state(worker_id)
+        token = new_id("captok")
+        for _ in range(50):
+            if await self.store.acquire_lock(f"workercap:{worker_id}", token, ttl=5.0):
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise TimeoutError(f"could not lock capacity for worker {worker_id}")
+        try:
+            data = await self.store.hgetall(key)
+            if not data:
+                return False
+            free_cpu = int(data.get("free_cpu_millicores", 0)) + cpu_millicores
+            free_mem = int(data.get("free_memory_mb", 0)) + memory_mb
+            free_chips = int(data.get("tpu_free_chips", 0)) + tpu_chips
+            if free_cpu < 0 or free_mem < 0 or free_chips < 0:
+                return False
+            total_cpu = int(data.get("total_cpu_millicores", 0))
+            total_mem = int(data.get("total_memory_mb", 0))
+            total_chips = int(data.get("tpu_chip_count", 0))
+            await self.store.hmset(key, {
+                "free_cpu_millicores": min(free_cpu, total_cpu),
+                "free_memory_mb": min(free_mem, total_mem),
+                "tpu_free_chips": min(free_chips, total_chips),
+            })
+            return True
+        finally:
+            await self.store.release_lock(f"workercap:{worker_id}", token)
+
+    # -- request delivery ---------------------------------------------------
+
+    async def push_request(self, worker_id: str, request: ContainerRequest) -> None:
+        await self.store.xadd(Keys.worker_requests(worker_id),
+                              {"request": json.dumps(request.to_dict())})
+        await self.store.hset(Keys.worker_containers(worker_id),
+                              request.container_id, "assigned")
+
+    async def read_requests(self, worker_id: str, last_id: str = "0",
+                            timeout: float = 1.0) -> list[tuple[str, ContainerRequest]]:
+        entries = await self.store.xread(Keys.worker_requests(worker_id),
+                                         last_id=last_id, timeout=timeout)
+        out = []
+        for entry_id, entry in entries:
+            req = ContainerRequest.from_dict(json.loads(entry["request"]))
+            out.append((entry_id, req))
+        return out
+
+    async def worker_container_ids(self, worker_id: str) -> list[str]:
+        return list((await self.store.hgetall(Keys.worker_containers(worker_id))).keys())
+
+    async def remove_worker_container(self, worker_id: str, container_id: str) -> None:
+        await self.store.hdel(Keys.worker_containers(worker_id), container_id)

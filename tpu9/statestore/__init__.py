@@ -1,0 +1,25 @@
+"""tpu9 hot-state bus.
+
+The reference keeps all scheduler/container/task hot state in Redis (sorted-set
+backlog ``pkg/scheduler/backlog.go:16``, per-worker request streams
+``pkg/scheduler/scheduler.go:658``, pubsub events, TTL keepalive keys
+``pkg/worker/worker.go:1026``). tpu9 replaces that external dependency with an
+embedded state bus exposing the same primitive families:
+
+- KV with TTL (worker keepalive, container addresses, locks)
+- hashes (container state, token-pressure snapshots)
+- sorted sets (scheduler backlog)
+- lists with blocking pop (task queues)
+- streams (per-worker container-request streams, log shipping)
+- pubsub (events, signals)
+
+Backends: :class:`MemoryStore` (in-process; also the unit-test double, playing
+the role miniredis plays in the reference ``pkg/repository/testutils.go:15``)
+and a msgpack-over-TCP server/client pair for multi-host deployments.
+"""
+
+from .store import MemoryStore, StateStore
+from .client import RemoteStore
+from .server import StateServer
+
+__all__ = ["StateStore", "MemoryStore", "RemoteStore", "StateServer"]

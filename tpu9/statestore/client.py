@@ -1,0 +1,174 @@
+"""Remote StateStore client (msgpack-TCP) with the same interface as
+MemoryStore, so repositories are backend-agnostic."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Any, AsyncIterator, Optional
+
+from . import wire
+from .store import StateStore
+
+
+class RemoteSubscription:
+    def __init__(self, client: "RemoteStore", sub_id: int):
+        self._client = client
+        self.sub_id = sub_id
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def __aiter__(self) -> AsyncIterator[tuple[str, Any]]:
+        return self
+
+    async def __anext__(self) -> tuple[str, Any]:
+        return await self.queue.get()
+
+    async def get(self, timeout: Optional[float] = None) -> Optional[tuple[str, Any]]:
+        try:
+            return await asyncio.wait_for(self.queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    def close(self) -> None:
+        self._client._subs.pop(self.sub_id, None)
+        self._client._fire_and_forget("unsubscribe", self.sub_id)
+
+
+class RemoteStore(StateStore):
+    def __init__(self, address: str, auth_token: str = "") -> None:
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.auth_token = auth_token
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._subs: dict[int, RemoteSubscription] = {}
+        self._ids = itertools.count(1)
+        self._read_task: Optional[asyncio.Task] = None
+        self._write_lock = asyncio.Lock()
+        self._connect_lock = asyncio.Lock()
+
+    async def connect(self) -> "RemoteStore":
+        async with self._connect_lock:
+            if self._writer is not None:
+                return self
+            self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+            self._read_task = asyncio.create_task(self._read_loop())
+            if self.auth_token:
+                await self._call("auth", self.auth_token)
+        return self
+
+    async def close(self) -> None:
+        if self._read_task:
+            self._read_task.cancel()
+            self._read_task = None
+        if self._writer:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+            self._writer = None
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("state store connection closed"))
+        self._pending.clear()
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await wire.read_frame(self._reader)
+                if "push" in msg:
+                    sub = self._subs.get(msg["sub"])
+                    if sub:
+                        sub.queue.put_nowait(tuple(msg["push"]))
+                    continue
+                fut = self._pending.pop(msg["id"], None)
+                if fut and not fut.done():
+                    if msg.get("ok"):
+                        fut.set_result(msg.get("value"))
+                    else:
+                        fut.set_exception(RuntimeError(msg.get("error", "state store error")))
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # any transport/protocol failure kills the connection
+            pass
+        finally:
+            # mark the connection dead so the next _call reconnects instead of
+            # writing into a dead transport and awaiting forever
+            if self._writer is not None:
+                self._writer.close()
+            self._writer = None
+            self._read_task = None
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("state store connection lost"))
+            self._pending.clear()
+
+    async def _call(self, op: str, *args: Any, **kwargs: Any) -> Any:
+        if self._writer is None or (self._read_task is not None and self._read_task.done()):
+            await self.close()
+            await self.connect()
+        assert self._writer is not None
+        rid = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        frame = wire.pack({"id": rid, "op": op, "args": list(args), "kwargs": kwargs})
+        async with self._write_lock:
+            self._writer.write(frame)
+            await self._writer.drain()
+        return await fut
+
+    def _fire_and_forget(self, op: str, *args: Any) -> None:
+        if self._writer is None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        loop.create_task(self._call(op, *args))
+
+    def subscribe(self, pattern: str):
+        # register synchronously with a reserved id; server uses request id
+        rid = next(self._ids)
+        sub = RemoteSubscription(self, rid)
+        self._subs[rid] = sub
+
+        async def do_subscribe() -> None:
+            if self._writer is None:
+                await self.connect()
+            assert self._writer is not None
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending[rid] = fut
+            frame = wire.pack({"id": rid, "op": "subscribe", "args": [pattern]})
+            async with self._write_lock:
+                self._writer.write(frame)
+                await self._writer.drain()
+            await fut
+
+        try:
+            loop = asyncio.get_running_loop()
+            loop.create_task(do_subscribe())
+        except RuntimeError:
+            raise RuntimeError("RemoteStore.subscribe requires a running event loop")
+        return sub
+
+
+def _make_proxy(op: str):
+    async def proxy(self: RemoteStore, *args: Any, **kwargs: Any) -> Any:
+        value = await self._call(op, *args, **kwargs)
+        if op in ("zpopmin", "zrange", "xread") and isinstance(value, list):
+            return [tuple(v) if isinstance(v, list) else v for v in value]
+        return value
+
+    proxy.__name__ = op
+    return proxy
+
+
+for _op in ("set", "get", "delete", "exists", "keys", "expire", "ttl", "incr",
+            "hset", "hmset", "hget", "hgetall", "hdel", "hincr",
+            "zadd", "zpopmin", "zrange", "zcard", "zrem", "zscore",
+            "rpush", "lpush", "lpop", "blpop", "llen", "lrange", "lrem",
+            "xadd", "xread", "xlen", "publish", "acquire_lock", "release_lock"):
+    setattr(RemoteStore, _op, _make_proxy(_op))

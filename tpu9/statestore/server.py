@@ -1,0 +1,133 @@
+"""TCP server exposing a MemoryStore to remote gateway/worker processes.
+
+In the reference, workers avoid direct Redis access by calling repo services
+over gRPC on the gateway (``pkg/gateway/gateway.go:353-364``). tpu9 keeps one
+authoritative state bus per cluster: the gateway embeds this server and
+workers connect with :class:`tpu9.statestore.client.RemoteStore`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from . import wire
+from .store import MemoryStore
+
+log = logging.getLogger("tpu9.statestore")
+
+# ops a remote client may invoke (everything on StateStore except subscribe,
+# which has dedicated handling below)
+_OPS = {
+    "set", "get", "delete", "exists", "keys", "expire", "ttl", "incr",
+    "hset", "hmset", "hget", "hgetall", "hdel", "hincr",
+    "zadd", "zpopmin", "zrange", "zcard", "zrem", "zscore",
+    "rpush", "lpush", "lpop", "blpop", "llen", "lrange", "lrem",
+    "xadd", "xread", "xlen", "publish",
+    "acquire_lock", "release_lock",
+}
+
+
+class StateServer:
+    def __init__(self, store: Optional[MemoryStore] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 auth_token: str = "") -> None:
+        self.store = store or MemoryStore()
+        self.host = host
+        self.port = port
+        self.auth_token = auth_token
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> "StateServer":
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("state server listening on %s", self.address)
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        write_lock = asyncio.Lock()
+        subs: dict[int, tuple] = {}  # sub_id -> (Subscription, pump task)
+        authed = not self.auth_token
+        tasks: set[asyncio.Task] = set()
+
+        async def send(obj) -> None:
+            async with write_lock:
+                writer.write(wire.pack(obj))
+                await writer.drain()
+
+        async def pump(sub_id: int, sub) -> None:
+            async for channel, message in sub:
+                await send({"sub": sub_id, "push": [channel, message]})
+
+        async def dispatch(req: dict) -> None:
+            rid = req.get("id")
+            op = req.get("op", "")
+            args = req.get("args", [])
+            kwargs = req.get("kwargs", {})
+            try:
+                nonlocal authed
+                if op == "auth":
+                    authed = (args[0] == self.auth_token) or not self.auth_token
+                    if not authed:
+                        raise PermissionError("bad auth token")
+                    value = True
+                elif not authed:
+                    raise PermissionError("unauthenticated")
+                elif op == "subscribe":
+                    sub = self.store.subscribe(args[0])
+                    sub_id = rid
+                    t = asyncio.create_task(pump(sub_id, sub))
+                    subs[sub_id] = (sub, t)
+                    value = sub_id
+                elif op == "unsubscribe":
+                    entry = subs.pop(args[0], None)
+                    if entry:
+                        entry[0].close()
+                        entry[1].cancel()
+                    value = True
+                elif op in _OPS:
+                    value = await getattr(self.store, op)(*args, **kwargs)
+                else:
+                    raise ValueError(f"unknown op {op!r}")
+                await send({"id": rid, "ok": True, "value": value})
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - protocol boundary
+                try:
+                    await send({"id": rid, "ok": False, "error": str(exc)})
+                except Exception:
+                    pass
+
+        try:
+            while True:
+                try:
+                    req = await wire.read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                # blocking ops (blpop/xread) must not stall the read loop
+                t = asyncio.create_task(dispatch(req))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+        finally:
+            for sub, t in subs.values():
+                sub.close()
+                t.cancel()
+            for t in tasks:
+                t.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass

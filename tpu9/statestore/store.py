@@ -1,0 +1,394 @@
+"""In-process async state store.
+
+Primitive semantics mirror the subset of Redis the reference depends on, so
+the repository layer (tpu9.repository) can express the same patterns the
+reference builds on Redis: TTL'd keepalive keys, sorted-set backlogs, blocking
+list pops for task queues, streams for container-request delivery, pubsub for
+events. All operations are atomic with respect to each other (single event
+loop; mutations never await while holding partial state).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import time
+from collections import defaultdict
+from typing import Any, AsyncIterator, Optional
+
+
+class StateStore:
+    """Abstract interface. All methods are coroutines so the remote client can
+    implement the same surface."""
+
+    # -- kv
+    async def set(self, key: str, value: Any, ttl: Optional[float] = None,
+                  nx: bool = False) -> bool: raise NotImplementedError
+    async def get(self, key: str) -> Any: raise NotImplementedError
+    async def delete(self, *keys: str) -> int: raise NotImplementedError
+    async def exists(self, key: str) -> bool: raise NotImplementedError
+    async def keys(self, pattern: str = "*") -> list[str]: raise NotImplementedError
+    async def expire(self, key: str, ttl: float) -> bool: raise NotImplementedError
+    async def ttl(self, key: str) -> float: raise NotImplementedError
+    async def incr(self, key: str, by: int = 1) -> int: raise NotImplementedError
+
+    # -- hash
+    async def hset(self, key: str, field: str, value: Any) -> None: raise NotImplementedError
+    async def hmset(self, key: str, mapping: dict[str, Any]) -> None: raise NotImplementedError
+    async def hget(self, key: str, field: str) -> Any: raise NotImplementedError
+    async def hgetall(self, key: str) -> dict[str, Any]: raise NotImplementedError
+    async def hdel(self, key: str, *fields: str) -> int: raise NotImplementedError
+    async def hincr(self, key: str, field: str, by: float = 1) -> float: raise NotImplementedError
+
+    # -- sorted set
+    async def zadd(self, key: str, member: str, score: float) -> None: raise NotImplementedError
+    async def zpopmin(self, key: str, count: int = 1) -> list[tuple[str, float]]: raise NotImplementedError
+    async def zrange(self, key: str, start: int = 0, stop: int = -1,
+                     with_scores: bool = False) -> list: raise NotImplementedError
+    async def zcard(self, key: str) -> int: raise NotImplementedError
+    async def zrem(self, key: str, *members: str) -> int: raise NotImplementedError
+    async def zscore(self, key: str, member: str) -> Optional[float]: raise NotImplementedError
+
+    # -- list
+    async def rpush(self, key: str, *values: Any) -> int: raise NotImplementedError
+    async def lpush(self, key: str, *values: Any) -> int: raise NotImplementedError
+    async def lpop(self, key: str) -> Any: raise NotImplementedError
+    async def blpop(self, key: str, timeout: float = 0) -> Any: raise NotImplementedError
+    async def llen(self, key: str) -> int: raise NotImplementedError
+    async def lrange(self, key: str, start: int = 0, stop: int = -1) -> list: raise NotImplementedError
+    async def lrem(self, key: str, value: Any) -> int: raise NotImplementedError
+
+    # -- stream
+    async def xadd(self, key: str, entry: dict[str, Any], maxlen: int = 0) -> str: raise NotImplementedError
+    async def xread(self, key: str, last_id: str = "0",
+                    timeout: float = 0) -> list[tuple[str, dict[str, Any]]]: raise NotImplementedError
+    async def xlen(self, key: str) -> int: raise NotImplementedError
+
+    # -- pubsub
+    async def publish(self, channel: str, message: Any) -> int: raise NotImplementedError
+    def subscribe(self, pattern: str) -> "Subscription": raise NotImplementedError
+
+    # -- locks
+    async def acquire_lock(self, key: str, token: str, ttl: float = 10.0) -> bool:
+        return await self.set(f"lock:{key}", token, ttl=ttl, nx=True)
+
+    async def release_lock(self, key: str, token: str) -> bool:
+        cur = await self.get(f"lock:{key}")
+        if cur == token:
+            await self.delete(f"lock:{key}")
+            return True
+        return False
+
+    async def close(self) -> None:
+        pass
+
+
+class Subscription:
+    """Async-iterable pubsub subscription handle."""
+
+    def __init__(self, store: "MemoryStore", pattern: str):
+        self._store = store
+        self._pattern = pattern
+        self._queue: asyncio.Queue = asyncio.Queue()
+        store._subs[pattern].append(self._queue)
+
+    def __aiter__(self) -> AsyncIterator[tuple[str, Any]]:
+        return self
+
+    async def __anext__(self) -> tuple[str, Any]:
+        return await self._queue.get()
+
+    async def get(self, timeout: Optional[float] = None) -> Optional[tuple[str, Any]]:
+        try:
+            return await asyncio.wait_for(self._queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    def close(self) -> None:
+        subs = self._store._subs.get(self._pattern)
+        if subs and self._queue in subs:
+            subs.remove(self._queue)
+            if not subs:
+                del self._store._subs[self._pattern]
+
+
+class MemoryStore(StateStore):
+    def __init__(self) -> None:
+        self._kv: dict[str, Any] = {}
+        self._expiry: dict[str, float] = {}
+        self._hashes: dict[str, dict[str, Any]] = defaultdict(dict)
+        self._zsets: dict[str, dict[str, float]] = defaultdict(dict)
+        self._lists: dict[str, list] = defaultdict(list)
+        self._streams: dict[str, list[tuple[str, dict[str, Any]]]] = defaultdict(list)
+        self._stream_seq: dict[str, int] = defaultdict(int)
+        self._list_waiters: dict[str, list[asyncio.Event]] = defaultdict(list)
+        self._stream_waiters: dict[str, list[asyncio.Event]] = defaultdict(list)
+        self._subs: dict[str, list[asyncio.Queue]] = defaultdict(list)
+
+    # -- expiry helpers -----------------------------------------------------
+    def _expired(self, key: str) -> bool:
+        exp = self._expiry.get(key)
+        if exp is not None and exp <= time.monotonic():
+            self._purge(key)
+            return True
+        return False
+
+    def _purge(self, key: str) -> None:
+        self._kv.pop(key, None)
+        self._hashes.pop(key, None)
+        self._zsets.pop(key, None)
+        self._lists.pop(key, None)
+        self._streams.pop(key, None)
+        self._expiry.pop(key, None)
+
+    def _live_keys(self) -> set[str]:
+        all_keys = (set(self._kv) | set(self._hashes) | set(self._zsets)
+                    | set(self._lists) | set(self._streams))
+        return {k for k in all_keys if not self._expired(k)}
+
+    # -- kv -----------------------------------------------------------------
+    async def set(self, key, value, ttl=None, nx=False):
+        if nx and not self._expired(key) and key in self._kv:
+            return False
+        self._kv[key] = value
+        if ttl is not None:
+            self._expiry[key] = time.monotonic() + ttl
+        else:
+            self._expiry.pop(key, None)
+        return True
+
+    async def get(self, key):
+        if self._expired(key):
+            return None
+        return self._kv.get(key)
+
+    async def delete(self, *keys):
+        n = 0
+        for key in keys:
+            if key in self._live_keys():
+                n += 1
+            self._purge(key)
+        return n
+
+    async def exists(self, key):
+        return key in self._live_keys()
+
+    async def keys(self, pattern="*"):
+        return sorted(k for k in self._live_keys() if fnmatch.fnmatchcase(k, pattern))
+
+    async def expire(self, key, ttl):
+        if key not in self._live_keys():
+            return False
+        self._expiry[key] = time.monotonic() + ttl
+        return True
+
+    async def ttl(self, key):
+        if key not in self._live_keys():
+            return -2.0
+        exp = self._expiry.get(key)
+        return -1.0 if exp is None else max(0.0, exp - time.monotonic())
+
+    async def incr(self, key, by=1):
+        if self._expired(key):
+            pass
+        cur = int(self._kv.get(key, 0)) + by
+        self._kv[key] = cur
+        return cur
+
+    # -- hash ---------------------------------------------------------------
+    async def hset(self, key, field, value):
+        self._expired(key)
+        self._hashes[key][field] = value
+
+    async def hmset(self, key, mapping):
+        self._expired(key)
+        self._hashes[key].update(mapping)
+
+    async def hget(self, key, field):
+        if self._expired(key):
+            return None
+        return self._hashes.get(key, {}).get(field)
+
+    async def hgetall(self, key):
+        if self._expired(key):
+            return {}
+        return dict(self._hashes.get(key, {}))
+
+    async def hdel(self, key, *fields):
+        h = self._hashes.get(key, {})
+        n = 0
+        for f in fields:
+            if f in h:
+                del h[f]
+                n += 1
+        if not h:
+            self._hashes.pop(key, None)
+        return n
+
+    async def hincr(self, key, field, by=1):
+        self._expired(key)
+        cur = float(self._hashes[key].get(field, 0)) + by
+        self._hashes[key][field] = cur
+        return cur
+
+    # -- zset ---------------------------------------------------------------
+    async def zadd(self, key, member, score):
+        self._expired(key)
+        self._zsets[key][member] = score
+
+    async def zpopmin(self, key, count=1):
+        if self._expired(key):
+            return []
+        z = self._zsets.get(key, {})
+        items = sorted(z.items(), key=lambda kv: (kv[1], kv[0]))[:count]
+        for m, _ in items:
+            del z[m]
+        return items
+
+    async def zrange(self, key, start=0, stop=-1, with_scores=False):
+        if self._expired(key):
+            return []
+        items = sorted(self._zsets.get(key, {}).items(), key=lambda kv: (kv[1], kv[0]))
+        stop_i = len(items) if stop == -1 else stop + 1
+        sel = items[start:stop_i]
+        return sel if with_scores else [m for m, _ in sel]
+
+    async def zcard(self, key):
+        if self._expired(key):
+            return 0
+        return len(self._zsets.get(key, {}))
+
+    async def zrem(self, key, *members):
+        z = self._zsets.get(key, {})
+        n = 0
+        for m in members:
+            if m in z:
+                del z[m]
+                n += 1
+        return n
+
+    async def zscore(self, key, member):
+        if self._expired(key):
+            return None
+        return self._zsets.get(key, {}).get(member)
+
+    # -- list ---------------------------------------------------------------
+    def _notify_list(self, key: str) -> None:
+        for ev in self._list_waiters.get(key, []):
+            ev.set()
+
+    async def rpush(self, key, *values):
+        self._expired(key)
+        self._lists[key].extend(values)
+        self._notify_list(key)
+        return len(self._lists[key])
+
+    async def lpush(self, key, *values):
+        self._expired(key)
+        for v in values:
+            self._lists[key].insert(0, v)
+        self._notify_list(key)
+        return len(self._lists[key])
+
+    async def lpop(self, key):
+        if self._expired(key):
+            return None
+        lst = self._lists.get(key)
+        if not lst:
+            return None
+        return lst.pop(0)
+
+    async def blpop(self, key, timeout=0):
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            v = await self.lpop(key)
+            if v is not None:
+                return v
+            ev = asyncio.Event()
+            self._list_waiters[key].append(ev)
+            try:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return None
+            finally:
+                self._list_waiters[key].remove(ev)
+
+    async def llen(self, key):
+        if self._expired(key):
+            return 0
+        return len(self._lists.get(key, []))
+
+    async def lrange(self, key, start=0, stop=-1):
+        if self._expired(key):
+            return []
+        lst = self._lists.get(key, [])
+        stop_i = len(lst) if stop == -1 else stop + 1
+        return list(lst[start:stop_i])
+
+    async def lrem(self, key, value):
+        lst = self._lists.get(key, [])
+        n = lst.count(value)
+        self._lists[key] = [v for v in lst if v != value]
+        return n
+
+    # -- stream -------------------------------------------------------------
+    async def xadd(self, key, entry, maxlen=0):
+        self._expired(key)
+        self._stream_seq[key] += 1
+        entry_id = f"{self._stream_seq[key]}"
+        self._streams[key].append((entry_id, dict(entry)))
+        if maxlen and len(self._streams[key]) > maxlen:
+            self._streams[key] = self._streams[key][-maxlen:]
+        for ev in self._stream_waiters.get(key, []):
+            ev.set()
+        return entry_id
+
+    async def xread(self, key, last_id="0", timeout=0):
+        last = int(last_id)
+
+        def collect() -> list[tuple[str, dict[str, Any]]]:
+            if self._expired(key):
+                return []
+            return [(eid, e) for eid, e in self._streams.get(key, [])
+                    if int(eid) > last]
+
+        out = collect()
+        if out or not timeout:
+            return out
+        deadline = time.monotonic() + timeout
+        while True:
+            ev = asyncio.Event()
+            self._stream_waiters[key].append(ev)
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return []
+            finally:
+                self._stream_waiters[key].remove(ev)
+            out = collect()
+            if out:
+                return out
+
+    async def xlen(self, key):
+        if self._expired(key):
+            return 0
+        return len(self._streams.get(key, []))
+
+    # -- pubsub -------------------------------------------------------------
+    async def publish(self, channel, message):
+        n = 0
+        for pattern, queues in list(self._subs.items()):
+            if fnmatch.fnmatchcase(channel, pattern):
+                for q in queues:
+                    q.put_nowait((channel, message))
+                    n += 1
+        return n
+
+    def subscribe(self, pattern):
+        return Subscription(self, pattern)

@@ -1,0 +1,35 @@
+"""Framing for the state-bus TCP protocol.
+
+Length-prefixed msgpack frames. Request: ``{"id": n, "op": name, "args": [...],
+"kwargs": {...}}``. Response: ``{"id": n, "ok": true, "value": ...}`` or
+``{"id": n, "ok": false, "error": msg}``. Pubsub pushes arrive as
+``{"sub": subscription_id, "push": [channel, message]}``.
+
+Analogue of the reference's Redis wire usage; the raw-TCP style follows its
+cache transport (``pkg/cache/raw_transport.go``) rather than RESP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def pack(obj: Any) -> bytes:
+    payload = msgpack.packb(obj, use_bin_type=True)
+    return _LEN.pack(len(payload)) + payload
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    payload = await reader.readexactly(length)
+    return msgpack.unpackb(payload, raw=False)

@@ -1,0 +1,550 @@
+"""Core domain types for tpu9.
+
+This is the TPU-native analogue of the reference's ``pkg/types`` package
+(beam-cloud/beta9). Where the reference models accelerators as GPU counts
+(``pkg/types/gpu.go:80-111``) and containers as single-host placements
+(``pkg/types/scheduler.go:254-294``), tpu9 models **slice topologies**: a
+workload asks for a ``TpuSpec`` (e.g. ``v5e-8`` = one host, 8 chips over a
+2x4 ICI mesh; ``v5p-64`` = an 8-host gang sharing one ICI domain), and the
+scheduler places whole slices, gang-scheduling one container per host for
+multi-host slices.
+
+Everything here is a plain dataclass with dict round-tripping so the same
+types flow through the JSON control-plane protocol, the state store, and the
+durable backend without codegen.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------------------
+# TPU topology registry (replaces reference pkg/types/gpu.go GPU enum)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TpuSpec:
+    """A schedulable TPU slice shape.
+
+    ``chips`` is the total chip count in the slice; ``hosts`` how many worker
+    hosts share the slice's ICI domain.  ``topology`` is the physical mesh
+    (e.g. "2x4", "4x4x4") — the scheduler uses it for slice-compatible
+    placement and the runner uses it to build the default ``jax.sharding.Mesh``.
+    """
+
+    name: str                 # canonical request string, e.g. "v5e-8"
+    generation: str           # v4 | v5e | v5p | v6e
+    chips: int                # total chips in slice
+    hosts: int                # hosts in the gang (1 == single-host slice)
+    topology: str             # ICI mesh, e.g. "2x4"
+    hbm_gb_per_chip: int
+    bf16_tflops_per_chip: float
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // self.hosts
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+    @property
+    def total_hbm_gb(self) -> int:
+        return self.hbm_gb_per_chip * self.chips
+
+    def mesh_shape(self) -> tuple[int, ...]:
+        return tuple(int(x) for x in self.topology.split("x"))
+
+
+def _v5e(name: str, chips: int, hosts: int, topo: str) -> TpuSpec:
+    return TpuSpec(name, "v5e", chips, hosts, topo, hbm_gb_per_chip=16,
+                   bf16_tflops_per_chip=197.0)
+
+
+def _v5p(name: str, chips: int, hosts: int, topo: str) -> TpuSpec:
+    return TpuSpec(name, "v5p", chips, hosts, topo, hbm_gb_per_chip=95,
+                   bf16_tflops_per_chip=459.0)
+
+
+def _v4(name: str, chips: int, hosts: int, topo: str) -> TpuSpec:
+    return TpuSpec(name, "v4", chips, hosts, topo, hbm_gb_per_chip=32,
+                   bf16_tflops_per_chip=275.0)
+
+
+def _v6e(name: str, chips: int, hosts: int, topo: str) -> TpuSpec:
+    return TpuSpec(name, "v6e", chips, hosts, topo, hbm_gb_per_chip=32,
+                   bf16_tflops_per_chip=918.0)
+
+
+# v5e: 8 chips/host; v5p: 4 chips/host (named by core count upstream, we name
+# by chip count for uniformity); v4: 4 chips/host; v6e: 8 chips/host.
+TPU_REGISTRY: dict[str, TpuSpec] = {
+    s.name: s
+    for s in [
+        _v5e("v5e-1", 1, 1, "1x1"),
+        _v5e("v5e-4", 4, 1, "2x2"),
+        _v5e("v5e-8", 8, 1, "2x4"),
+        _v5e("v5e-16", 16, 2, "4x4"),
+        _v5e("v5e-32", 32, 4, "4x8"),
+        _v5e("v5e-64", 64, 8, "8x8"),
+        _v5e("v5e-128", 128, 16, "8x16"),
+        _v5e("v5e-256", 256, 32, "16x16"),
+        _v5p("v5p-4", 4, 1, "2x2x1"),
+        _v5p("v5p-8", 8, 2, "2x2x2"),
+        _v5p("v5p-16", 16, 4, "2x2x4"),
+        _v5p("v5p-32", 32, 8, "2x4x4"),
+        _v5p("v5p-64", 64, 16, "4x4x4"),
+        _v5p("v5p-128", 128, 32, "4x4x8"),
+        _v4("v4-8", 4, 1, "2x2x1"),
+        _v4("v4-16", 8, 2, "2x2x2"),
+        _v4("v4-32", 16, 4, "2x2x4"),
+        _v6e("v6e-1", 1, 1, "1x1"),
+        _v6e("v6e-4", 4, 1, "2x2"),
+        _v6e("v6e-8", 8, 1, "2x4"),
+        _v6e("v6e-16", 16, 2, "4x4"),
+        _v6e("v6e-32", 32, 4, "4x8"),
+    ]
+}
+
+
+class InvalidTpuSpec(ValueError):
+    pass
+
+
+def parse_tpu_spec(spec: Optional[str]) -> Optional[TpuSpec]:
+    """Parse a user-facing ``tpu=`` string into a TpuSpec (None == CPU-only)."""
+    if not spec:
+        return None
+    key = spec.strip().lower()
+    try:
+        return TPU_REGISTRY[key]
+    except KeyError:
+        raise InvalidTpuSpec(
+            f"unknown tpu spec {spec!r}; known: {', '.join(sorted(TPU_REGISTRY))}"
+        ) from None
+
+
+# ---------------------------------------------------------------------------
+# Serialization base
+# ---------------------------------------------------------------------------
+
+
+class _Serializable:
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for f in dataclasses.fields(self):  # type: ignore[arg-type]
+            v = getattr(self, f.name)
+            if isinstance(v, enum.Enum):
+                v = v.value
+            elif isinstance(v, _Serializable):
+                v = v.to_dict()
+            elif isinstance(v, TpuSpec):
+                v = v.name
+            elif isinstance(v, list) and v and isinstance(v[0], _Serializable):
+                v = [x.to_dict() for x in v]
+            out[f.name] = v
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]):
+        kwargs: dict[str, Any] = {}
+        hints = {f.name: f for f in dataclasses.fields(cls)}  # type: ignore[arg-type]
+        for name, f in hints.items():
+            if name not in data:
+                continue
+            kwargs[name] = cls._decode_field(f, data[name])
+        return cls(**kwargs)
+
+    @classmethod
+    def _decode_field(cls, f: dataclasses.Field, v: Any) -> Any:
+        return v
+
+
+def new_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:12]}"
+
+
+def now() -> float:
+    return time.time()
+
+
+# ---------------------------------------------------------------------------
+# Stubs (deployable unit definitions) — reference pkg/types/types.go stubs
+# ---------------------------------------------------------------------------
+
+
+class StubType(str, enum.Enum):
+    ENDPOINT = "endpoint"
+    ASGI = "asgi"
+    REALTIME = "realtime"
+    FUNCTION = "function"
+    SCHEDULE = "schedule"
+    TASK_QUEUE = "taskqueue"
+    POD = "pod"
+    SANDBOX = "sandbox"
+    SHELL = "shell"
+    IMAGE_BUILD = "image_build"
+
+    @property
+    def serve_suffix(self) -> str:
+        return self.value
+
+
+class AutoscalerType(str, enum.Enum):
+    QUEUE_DEPTH = "queue_depth"
+    TOKEN_PRESSURE = "token_pressure"  # LLM-aware (reference pod/llm.go)
+
+
+@dataclass
+class AutoscalerConfig(_Serializable):
+    type: str = AutoscalerType.QUEUE_DEPTH.value
+    max_containers: int = 1
+    tasks_per_container: int = 1
+    min_containers: int = 0
+    # token-pressure knobs (LLM routing)
+    max_token_pressure: float = 0.85
+    max_active_streams: int = 64
+
+
+class CheckpointTrigger(str, enum.Enum):
+    """When to snapshot a running container (reference pkg/types/scheduler.go:297-303)."""
+
+    READINESS = "readiness"
+    HTTP_PATH = "http_path"
+    INTERVAL = "interval"
+    MANUAL = "manual"
+
+
+@dataclass
+class CheckpointConfig(_Serializable):
+    enabled: bool = False
+    trigger: str = CheckpointTrigger.READINESS.value
+    http_path: str = ""
+    interval_s: float = 0.0
+
+
+@dataclass
+class Runtime(_Serializable):
+    """Resource request attached to a stub (reference sdk base/runner.py:373-535)."""
+
+    cpu_millicores: int = 1000
+    memory_mb: int = 1024
+    tpu: str = ""                 # "" == CPU-only; else a TPU_REGISTRY key
+    image_id: str = ""
+    ephemeral_disk_mb: int = 4096
+
+    def tpu_spec(self) -> Optional[TpuSpec]:
+        return parse_tpu_spec(self.tpu)
+
+
+@dataclass
+class StubConfig(_Serializable):
+    """Full deployable definition. The JSON analogue of the reference's
+    ``StubConfigV1`` (pkg/types/types.go) carried inside stub rows."""
+
+    runtime: Runtime = field(default_factory=Runtime)
+    handler: str = ""             # "module:function" inside the synced workspace
+    python_version: str = "python3.11"
+    concurrent_requests: int = 1  # per-container concurrency tokens
+    keep_warm_seconds: float = 60.0
+    timeout_s: float = 180.0
+    retries: int = 0
+    workers: int = 1              # runner worker processes per container
+    autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    env: dict[str, str] = field(default_factory=dict)
+    secrets: list[str] = field(default_factory=list)
+    volumes: list[dict[str, Any]] = field(default_factory=list)
+    entrypoint: list[str] = field(default_factory=list)  # pod-style override
+    ports: list[int] = field(default_factory=list)
+    authorized: bool = True
+    callback_url: str = ""
+    task_policy: dict[str, Any] = field(default_factory=dict)
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def _decode_field(cls, f: dataclasses.Field, v: Any) -> Any:
+        if f.name == "runtime" and isinstance(v, dict):
+            return Runtime.from_dict(v)
+        if f.name == "autoscaler" and isinstance(v, dict):
+            return AutoscalerConfig.from_dict(v)
+        if f.name == "checkpoint" and isinstance(v, dict):
+            return CheckpointConfig.from_dict(v)
+        return v
+
+
+@dataclass
+class Stub(_Serializable):
+    stub_id: str = ""
+    name: str = ""
+    stub_type: str = StubType.FUNCTION.value
+    workspace_id: str = ""
+    app_id: str = ""
+    object_id: str = ""           # synced workspace code archive
+    config: StubConfig = field(default_factory=StubConfig)
+    created_at: float = field(default_factory=now)
+
+    @classmethod
+    def _decode_field(cls, f: dataclasses.Field, v: Any) -> Any:
+        if f.name == "config" and isinstance(v, dict):
+            return StubConfig.from_dict(v)
+        return v
+
+
+@dataclass
+class Deployment(_Serializable):
+    deployment_id: str = ""
+    name: str = ""
+    stub_id: str = ""
+    workspace_id: str = ""
+    app_id: str = ""
+    version: int = 1
+    active: bool = True
+    subdomain: str = ""
+    created_at: float = field(default_factory=now)
+
+
+# ---------------------------------------------------------------------------
+# Containers & scheduling
+# ---------------------------------------------------------------------------
+
+
+class ContainerStatus(str, enum.Enum):
+    PENDING = "pending"
+    SCHEDULED = "scheduled"
+    RUNNING = "running"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+    FAILED = "failed"
+
+
+class StopReason(str, enum.Enum):
+    USER = "user"
+    TTL = "ttl"
+    SCALE_DOWN = "scale_down"
+    OOM = "oom"
+    EXIT = "exit"
+    SCHEDULER_FAILED = "scheduler_failed"
+    WORKER_LOST = "worker_lost"
+    GANG_PEER_FAILED = "gang_peer_failed"
+
+
+@dataclass
+class Mount(_Serializable):
+    source: str = ""
+    target: str = ""
+    read_only: bool = False
+    kind: str = "bind"            # bind | volume | cache
+
+
+@dataclass
+class GangInfo(_Serializable):
+    """Multi-host slice gang membership. No reference analogue — the
+    reference schedules single workers only (pkg/scheduler/scheduler.go:1138);
+    TPU multi-host slices need all-or-nothing placement with shared fate."""
+
+    gang_id: str = ""
+    size: int = 1
+    rank: int = 0
+    peer_container_ids: list[str] = field(default_factory=list)
+    coordinator_addr: str = ""    # host:port of rank 0 (JAX coordinator)
+
+
+@dataclass
+class ContainerRequest(_Serializable):
+    """One container placement ask. Reference: pkg/types/scheduler.go
+    ContainerRequest (:254-294), with GPU fields replaced by slice fields."""
+
+    container_id: str = ""
+    stub_id: str = ""
+    workspace_id: str = ""
+    stub_type: str = StubType.FUNCTION.value
+    cpu_millicores: int = 1000
+    memory_mb: int = 1024
+    tpu: str = ""                 # TPU_REGISTRY key or ""
+    image_id: str = ""
+    object_id: str = ""
+    entrypoint: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    mounts: list[Mount] = field(default_factory=list)
+    ports: list[int] = field(default_factory=list)
+    gang: Optional[GangInfo] = None
+    pool_selector: str = ""
+    priority: int = 0
+    checkpoint_id: str = ""       # restore-from if set
+    retry_count: int = 0
+    timestamp: float = field(default_factory=now)
+
+    def tpu_spec(self) -> Optional[TpuSpec]:
+        return parse_tpu_spec(self.tpu)
+
+    @classmethod
+    def _decode_field(cls, f: dataclasses.Field, v: Any) -> Any:
+        if f.name == "mounts" and isinstance(v, list):
+            return [Mount.from_dict(x) if isinstance(x, dict) else x for x in v]
+        if f.name == "gang" and isinstance(v, dict):
+            return GangInfo.from_dict(v)
+        return v
+
+
+@dataclass
+class ContainerState(_Serializable):
+    container_id: str = ""
+    stub_id: str = ""
+    workspace_id: str = ""
+    status: str = ContainerStatus.PENDING.value
+    worker_id: str = ""
+    address: str = ""             # host:port of the runner server once RUNNING
+    ports: dict[str, int] = field(default_factory=dict)
+    exit_code: int = -1
+    stop_reason: str = ""
+    gang_id: str = ""
+    started_at: float = 0.0
+    scheduled_at: float = 0.0
+    updated_at: float = field(default_factory=now)
+
+
+# ---------------------------------------------------------------------------
+# Workers
+# ---------------------------------------------------------------------------
+
+
+class WorkerStatus(str, enum.Enum):
+    AVAILABLE = "available"
+    PENDING = "pending"
+    DRAINING = "draining"
+    DISABLED = "disabled"
+
+
+@dataclass
+class WorkerState(_Serializable):
+    """A registered worker host. ``tpu_hosts`` describes the slice this host
+    belongs to: single-host slices advertise the full chip count; multi-host
+    slice members share a ``slice_id`` and the scheduler gangs across them."""
+
+    worker_id: str = ""
+    pool: str = "default"
+    status: str = WorkerStatus.PENDING.value
+    total_cpu_millicores: int = 0
+    total_memory_mb: int = 0
+    free_cpu_millicores: int = 0
+    free_memory_mb: int = 0
+    tpu_generation: str = ""      # "" == CPU-only worker
+    tpu_chip_count: int = 0       # chips physically on this host
+    tpu_free_chips: int = 0
+    slice_id: str = ""            # shared by all hosts of one multi-host slice
+    slice_topology: str = ""      # e.g. "4x4x4" for the whole slice
+    slice_host_rank: int = 0
+    slice_host_count: int = 1
+    address: str = ""             # worker control address (host:port)
+    version: str = ""
+    priority: int = 0
+    build_capable: bool = True
+    updated_at: float = field(default_factory=now)
+
+    @property
+    def cpu_only(self) -> bool:
+        return self.tpu_chip_count == 0
+
+
+# ---------------------------------------------------------------------------
+# Tasks
+# ---------------------------------------------------------------------------
+
+
+class TaskStatus(str, enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    COMPLETE = "complete"
+    ERROR = "error"
+    CANCELLED = "cancelled"
+    TIMEOUT = "timeout"
+    RETRY = "retry"
+    EXPIRED = "expired"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (TaskStatus.COMPLETE, TaskStatus.ERROR,
+                        TaskStatus.CANCELLED, TaskStatus.TIMEOUT,
+                        TaskStatus.EXPIRED)
+
+
+@dataclass
+class TaskPolicy(_Serializable):
+    """Reference pkg/types TaskPolicy: timeout/retries/ttl."""
+
+    timeout_s: float = 3600.0
+    max_retries: int = 3
+    ttl_s: float = 24 * 3600.0
+    expires_s: float = 0.0        # pending expiry (0 == never)
+
+
+@dataclass
+class TaskMessage(_Serializable):
+    task_id: str = ""
+    stub_id: str = ""
+    workspace_id: str = ""
+    executor: str = ""            # abstraction that owns execution
+    handler_args: list[Any] = field(default_factory=list)
+    handler_kwargs: dict[str, Any] = field(default_factory=dict)
+    policy: TaskPolicy = field(default_factory=TaskPolicy)
+    status: str = TaskStatus.PENDING.value
+    container_id: str = ""
+    retry_count: int = 0
+    created_at: float = field(default_factory=now)
+
+    @classmethod
+    def _decode_field(cls, f: dataclasses.Field, v: Any) -> Any:
+        if f.name == "policy" and isinstance(v, dict):
+            return TaskPolicy.from_dict(v)
+        return v
+
+
+# ---------------------------------------------------------------------------
+# Workspaces / auth
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Workspace(_Serializable):
+    workspace_id: str = ""
+    name: str = ""
+    storage_bucket: str = ""
+    concurrency_limit_cpu: int = 0     # 0 == unlimited
+    concurrency_limit_chips: int = 0
+    created_at: float = field(default_factory=now)
+
+
+@dataclass
+class Token(_Serializable):
+    token_id: str = ""
+    key: str = ""
+    workspace_id: str = ""
+    token_type: str = "workspace"      # workspace | worker | machine
+    active: bool = True
+    created_at: float = field(default_factory=now)
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle phase ids (cold-start breakdown; reference types.ContainerLifecycle*)
+# ---------------------------------------------------------------------------
+
+
+class LifecyclePhase(str, enum.Enum):
+    REQUEST_QUEUED = "request.queued"
+    REQUEST_SCHEDULED = "request.scheduled"
+    WORKER_RECEIVED = "worker.received"
+    IMAGE_READY = "worker.image_ready"
+    STORAGE_READY = "worker.storage_ready"
+    DEVICES_READY = "worker.devices_ready"
+    SPEC_READY = "worker.spec_ready"
+    RUNTIME_STARTED = "worker.runtime_started"
+    CHECKPOINT_RESTORED = "worker.checkpoint_restored"
+    CONTAINER_READY = "container.ready"
