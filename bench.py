@@ -1,0 +1,179 @@
+#!/usr/bin/env python3
+"""tpu9 benchmark — prints ONE JSON line.
+
+Two phases, mirroring BASELINE.md's north star ("container cold-start p50 +
+tokens/sec/chip"):
+
+1. **Serving cold start** through the real local stack (gateway + scheduler +
+   worker + process runtime + runner): deploy a CPU endpoint, force scale-to-
+   zero between trials, measure deploy→first-response p50.
+2. **LLM decode throughput**: Llama-architecture model (bf16) on the default
+   backend (TPU chip when present), batched decode steady-state tokens/sec
+   per chip.
+
+Primary metric: cold_start_p50_s with ``vs_baseline`` = 1.0 / p50 against the
+reference's headline "under a second" cold-start claim (README.md:39 of
+beam-cloud/beta9) — >1.0 means beating it. Decode throughput is attached in
+``extra``.
+
+Usage: python3 bench.py [--quick] [--skip-coldstart] [--skip-llm]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+
+def bench_llm_decode(quick: bool = False) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu9.models import decoder_forward, init_decoder, init_kv_cache
+    from tpu9.models.llama import LLAMA_PRESETS
+    from tpu9.ops.sampling import sample_logits
+
+    backend = jax.default_backend()
+    n_chips = jax.device_count()
+    preset = "llama-tiny" if (quick or backend == "cpu") else "llama-1b"
+    cfg = LLAMA_PRESETS[preset]
+
+    batch, prompt_len, decode_steps = (4, 64, 16) if quick or backend == "cpu" \
+        else (8, 1024, 64)
+    max_len = prompt_len + decode_steps + 8
+
+    params = init_decoder(jax.random.PRNGKey(0), cfg)
+    cache = init_kv_cache(cfg, batch, max_len)
+
+    @jax.jit
+    def prefill(params, tokens, cache):
+        logits, cache = decoder_forward(params, tokens, cfg, kv_cache=cache)
+        return logits[:, -1:].argmax(-1).astype(jnp.int32), cache
+
+    def decode(params, cache, tok, cache_len, rng):
+        positions = cache_len[:, None]
+        logits, cache = decoder_forward(params, tok, cfg, positions=positions,
+                                        kv_cache=cache, cache_len=cache_len + 1,
+                                        decode=True)
+        rng, sub = jax.random.split(rng)
+        nxt = sample_logits(logits[:, -1], sub, temperature=0.0)
+        return nxt[:, None].astype(jnp.int32), cache, cache_len + 1, rng
+
+    decode = jax.jit(decode, donate_argnums=(1,))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab_size)
+    # compile + warmup
+    t0 = time.perf_counter()
+    tok, cache = prefill(params, tokens, cache)
+    tok.block_until_ready()
+    prefill_compile_s = time.perf_counter() - t0
+
+    cache_len = jnp.full((batch,), prompt_len, jnp.int32)
+    rng = jax.random.PRNGKey(2)
+    t0 = time.perf_counter()
+    tok, cache, cache_len, rng = decode(params, cache, tok, cache_len, rng)
+    tok.block_until_ready()
+    decode_compile_s = time.perf_counter() - t0
+
+    # steady state
+    t0 = time.perf_counter()
+    for _ in range(decode_steps):
+        tok, cache, cache_len, rng = decode(params, cache, tok, cache_len, rng)
+    tok.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    toks_per_sec = batch * decode_steps / elapsed
+    return {
+        "backend": backend,
+        "model": preset,
+        "n_chips": n_chips,
+        "batch": batch,
+        "decode_tokens_per_sec": round(toks_per_sec, 2),
+        "decode_tokens_per_sec_per_chip": round(toks_per_sec / max(n_chips, 1), 2),
+        "decode_step_ms": round(1000 * elapsed / decode_steps, 3),
+        "prefill_compile_s": round(prefill_compile_s, 2),
+        "decode_compile_s": round(decode_compile_s, 2),
+    }
+
+
+def bench_cold_start(quick: bool = False) -> dict:
+    """Deploy→first-response p50 through the local stack (import-gated: phases
+    of the stack land incrementally)."""
+    import asyncio
+
+    from tpu9.testing.localstack import LocalStack  # noqa: WPS433
+
+    trials = 3 if quick else 5
+
+    async def run() -> dict:
+        times = []
+        async with LocalStack() as stack:
+            name = "bench-echo"
+            deploy = await stack.deploy_echo_endpoint(name)
+            for _ in range(trials):
+                await stack.scale_to_zero(deploy)
+                t0 = time.perf_counter()
+                resp = await stack.invoke(deploy, {"ping": 1})
+                assert resp is not None
+                times.append(time.perf_counter() - t0)
+        return {
+            "cold_start_p50_s": round(statistics.median(times), 4),
+            "cold_start_min_s": round(min(times), 4),
+            "cold_start_max_s": round(max(times), 4),
+            "trials": trials,
+        }
+
+    return asyncio.run(run())
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (local verification)")
+    ap.add_argument("--skip-coldstart", action="store_true")
+    ap.add_argument("--skip-llm", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        from tpu9.utils import force_cpu
+        force_cpu(host_devices=8)
+
+    extra: dict = {}
+    cold = None
+    if not args.skip_coldstart:
+        try:
+            cold = bench_cold_start(quick=args.quick)
+            extra.update(cold)
+        except Exception as exc:  # stack not ready / runtime failure
+            extra["cold_start_error"] = f"{type(exc).__name__}: {exc}"
+    if not args.skip_llm:
+        try:
+            extra.update(bench_llm_decode(quick=args.quick))
+        except Exception as exc:
+            extra["llm_error"] = f"{type(exc).__name__}: {exc}"
+
+    if cold and "cold_start_p50_s" in cold:
+        value = cold["cold_start_p50_s"]
+        line = {"metric": "cold_start_p50_s", "value": value, "unit": "s",
+                "vs_baseline": round(1.0 / max(value, 1e-9), 3),
+                "extra": extra}
+    elif "decode_tokens_per_sec_per_chip" in extra:
+        line = {"metric": "decode_tokens_per_sec_per_chip",
+                "value": extra["decode_tokens_per_sec_per_chip"],
+                "unit": "tok/s/chip", "vs_baseline": 0.0, "extra": extra}
+    else:
+        line = {"metric": "bench_failed", "value": 0, "unit": "",
+                "vs_baseline": 0.0, "extra": extra}
+        print(json.dumps(line))
+        sys.exit(1)
+
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,13 +9,16 @@ import os
 import sys
 from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 os.environ.setdefault("TPU9_TEST", "1")
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+# Force CPU even when the image pre-imports jax with a TPU platform latched
+# (a sitecustomize registers a TPU PJRT plugin in every process; env mutation
+# after interpreter start is too late, so the live config must be overridden).
+from tpu9.utils import force_cpu  # noqa: E402
+
+force_cpu(host_devices=8)
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -1,0 +1,121 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu9.ops import (apply_rope, decode_attention, flash_attention, rms_norm,
+                      rope_table, sample_logits, xla_attention)
+
+
+def rand(shape, seed=0, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype=dtype)
+
+
+class TestAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_matches_xla(self, causal):
+        B, T, H, KH, D = 2, 256, 4, 2, 64
+        q, k, v = rand((B, T, H, D)), rand((B, T, KH, D), 1), rand((B, T, KH, D), 2)
+        ref = xla_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_flash_rectangular_blocks(self):
+        B, T, H, D = 1, 256, 2, 64
+        q, k, v = rand((B, T, H, D)), rand((B, T, H, D), 1), rand((B, T, H, D), 2)
+        ref = xla_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=128,
+                              interpret=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_decode_attention_masks_cache(self):
+        B, S, H, D = 2, 64, 4, 32
+        kc, vc = rand((B, S, H, D), 1), rand((B, S, H, D), 2)
+        q = rand((B, 1, H, D))
+        lens = jnp.array([10, 37])
+        out = decode_attention(q, kc, vc, lens)
+        # manually truncate for seq 0
+        ref = xla_attention(q[:1], kc[:1, :10], vc[:1, :10], causal=False)
+        np.testing.assert_allclose(out[0], ref[0], atol=1e-5)
+        # changing cache contents beyond the valid length must not matter
+        kc2 = kc.at[:, 50:].set(99.0)
+        out2 = decode_attention(q, kc2, vc, lens)
+        np.testing.assert_allclose(out, out2, atol=1e-6)
+
+    def test_kv_offset_prefix_consistency(self):
+        # attending with kv_offset equals slicing rows from the full result
+        B, T, H, D = 1, 32, 2, 16
+        q = rand((B, T, H, D))
+        k, v = rand((B, T, H, D), 1), rand((B, T, H, D), 2)
+        full = xla_attention(q, k, v, causal=True)
+        tail = xla_attention(q[:, 16:], k, v, causal=True, kv_offset=16)
+        np.testing.assert_allclose(full[:, 16:], tail, atol=1e-5)
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        sin, cos = rope_table(128, 32)
+        x = rand((2, 16, 4, 32))
+        pos = jnp.broadcast_to(jnp.arange(16), (2, 16))
+        y = apply_rope(x, pos, sin, cos)
+        np.testing.assert_allclose(jnp.linalg.norm(y, axis=-1),
+                                   jnp.linalg.norm(x, axis=-1), rtol=1e-5)
+
+    def test_position_zero_identity(self):
+        sin, cos = rope_table(8, 16)
+        x = rand((1, 1, 2, 16))
+        y = apply_rope(x, jnp.zeros((1, 1), jnp.int32), sin, cos)
+        np.testing.assert_allclose(y, x, atol=1e-6)
+
+    def test_relative_property(self):
+        # <rope(q, m), rope(k, n)> depends only on m - n
+        sin, cos = rope_table(64, 32)
+        q, k = rand((1, 1, 1, 32)), rand((1, 1, 1, 32), 1)
+
+        def dot_at(m, n):
+            qr = apply_rope(q, jnp.array([[m]]), sin, cos)
+            kr = apply_rope(k, jnp.array([[n]]), sin, cos)
+            return float(jnp.sum(qr * kr))
+
+        assert abs(dot_at(5, 3) - dot_at(10, 8)) < 1e-4
+
+
+class TestNormSampling:
+    def test_rms_norm(self):
+        x = rand((4, 32))
+        w = jnp.ones((32,))
+        y = rms_norm(x, w)
+        rms = jnp.sqrt(jnp.mean(y * y, axis=-1))
+        np.testing.assert_allclose(rms, 1.0, rtol=1e-3)
+
+    def test_gemma_offset_norm(self):
+        x = rand((4, 32))
+        w = jnp.zeros((32,))  # gemma stores w-1; offset=1 → scale 1
+        y = rms_norm(x, w, offset=1.0)
+        rms = jnp.sqrt(jnp.mean(y * y, axis=-1))
+        np.testing.assert_allclose(rms, 1.0, rtol=1e-3)
+
+    def test_greedy_sampling(self):
+        logits = jnp.array([[0.1, 5.0, 0.2], [3.0, 0.0, 0.1]])
+        out = sample_logits(logits, jax.random.PRNGKey(0), temperature=0.0)
+        assert out.tolist() == [1, 0]
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.array([[0.0, 1.0, 2.0, 3.0]])
+        rng = jax.random.PRNGKey(0)
+        seen = set()
+        for i in range(50):
+            tok = int(sample_logits(logits, jax.random.fold_in(rng, i),
+                                    temperature=1.0, top_k=2)[0])
+            seen.add(tok)
+        assert seen <= {2, 3}
+
+    def test_top_p_restricts_support(self):
+        logits = jnp.array([[10.0, 9.0, -10.0, -10.0]])
+        rng = jax.random.PRNGKey(0)
+        seen = set()
+        for i in range(50):
+            tok = int(sample_logits(logits, jax.random.fold_in(rng, i),
+                                    temperature=1.0, top_p=0.9)[0])
+            seen.add(tok)
+        assert seen <= {0, 1}

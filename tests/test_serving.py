@@ -1,0 +1,78 @@
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+
+from tpu9.models import init_decoder
+from tpu9.models.llama import LLAMA_PRESETS
+from tpu9.serving import EngineConfig, InferenceEngine
+
+TINY = replace(LLAMA_PRESETS["llama-tiny"], dtype=jnp.float32)
+
+
+def make_engine(max_batch=2, max_seq_len=128):
+    params = init_decoder(jax.random.PRNGKey(0), TINY)
+    ecfg = EngineConfig(max_batch=max_batch, max_seq_len=max_seq_len,
+                        prefill_buckets=(16, 64), temperature=0.0)
+    return InferenceEngine(params, TINY, ecfg)
+
+
+async def test_single_generate_deterministic():
+    eng = make_engine()
+    await eng.start()
+    try:
+        out1 = await eng.generate([5, 3, 9], max_new_tokens=8)
+        out2 = await eng.generate([5, 3, 9], max_new_tokens=8)
+        assert out1 == out2
+        assert len(out1) == 8
+        assert all(0 <= t < TINY.vocab_size for t in out1)
+    finally:
+        await eng.stop()
+
+
+async def test_concurrent_matches_sequential():
+    import asyncio
+    eng = make_engine(max_batch=4)
+    await eng.start()
+    try:
+        prompts = [[1, 2, 3], [9, 8, 7, 6], [42]]
+        seq_results = []
+        for p in prompts:
+            seq_results.append(await eng.generate(p, max_new_tokens=6))
+        # now fire them concurrently — continuous batching must not change
+        # greedy results
+        conc = await asyncio.gather(
+            *[eng.generate(p, max_new_tokens=6) for p in prompts])
+        assert list(conc) == seq_results
+    finally:
+        await eng.stop()
+
+
+async def test_streaming():
+    eng = make_engine()
+    await eng.start()
+    try:
+        req = await eng.generate([4, 4, 4], max_new_tokens=5, stream=True)
+        toks = []
+        while True:
+            t = await req.queue.get()
+            if t is None:
+                break
+            toks.append(t)
+        assert len(toks) == 5
+        assert toks == req.generated
+    finally:
+        await eng.stop()
+
+
+async def test_stats_and_pressure():
+    eng = make_engine()
+    await eng.start()
+    try:
+        await eng.generate([1, 2], max_new_tokens=4)
+        s = eng.stats()
+        assert s["tokens_generated"] >= 3
+        assert 0.0 <= s["token_pressure"] <= 1.0
+        assert s["active_streams"] == 0
+    finally:
+        await eng.stop()

@@ -1,0 +1,96 @@
+"""Small bidirectional text classifier (baseline config #1: distilbert-style
+sentiment endpoint on CPU-only containers). Six-layer encoder, mean-pool,
+linear head — small enough that CPU containers serve it at interactive
+latency."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TextClassifierConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 6
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    max_len: int = 512
+    n_classes: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+
+
+TEXTCLS_TINY = TextClassifierConfig(vocab_size=1024, dim=64, n_layers=2,
+                                    n_heads=4, hidden_dim=128, max_len=128)
+
+
+def _dense(rng, i, o, dtype):
+    return (jax.random.normal(rng, (i, o), dtype=jnp.float32)
+            * (2.0 / (i + o)) ** 0.5).astype(dtype)
+
+
+def init_classifier(rng: jax.Array, cfg: TextClassifierConfig) -> Params:
+    rngs = jax.random.split(rng, cfg.n_layers * 4 + 4)
+    it = iter(rngs)
+    dt = cfg.dtype
+    params: Params = {
+        "embed": (jax.random.normal(next(it), (cfg.vocab_size, cfg.dim),
+                                    dtype=jnp.float32) * 0.02).astype(dt),
+        "pos_embed": (jax.random.normal(next(it), (cfg.max_len, cfg.dim),
+                                        dtype=jnp.float32) * 0.02).astype(dt),
+        "head": _dense(next(it), cfg.dim, cfg.n_classes, dt),
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "wqkv": _dense(next(it), cfg.dim, 3 * cfg.dim, dt),
+            "wo": _dense(next(it), cfg.dim, cfg.dim, dt),
+            "w1": _dense(next(it), cfg.dim, cfg.hidden_dim, dt),
+            "w2": _dense(next(it), cfg.hidden_dim, cfg.dim, dt),
+            "ln1_scale": jnp.ones((cfg.dim,), jnp.float32),
+            "ln1_bias": jnp.zeros((cfg.dim,), jnp.float32),
+            "ln2_scale": jnp.ones((cfg.dim,), jnp.float32),
+            "ln2_bias": jnp.zeros((cfg.dim,), jnp.float32),
+        })
+    return params
+
+
+def _ln(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return (((xf - mean) * jax.lax.rsqrt(var + eps)) * scale + bias).astype(x.dtype)
+
+
+def classifier_forward(params: Params, tokens: jnp.ndarray,
+                       mask: jnp.ndarray, cfg: TextClassifierConfig) -> jnp.ndarray:
+    """tokens [B, T] int32, mask [B, T] {0,1} → logits [B, n_classes]."""
+    b, t = tokens.shape
+    head_dim = cfg.dim // cfg.n_heads
+    x = params["embed"][tokens] + params["pos_embed"][None, :t]
+    bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30)  # [B,1,1,T]
+
+    for layer in params["layers"]:
+        qkv = (x @ layer["wqkv"]).reshape(b, t, 3, cfg.n_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        logits = jnp.einsum("bthd,bshd->bhts",
+                            q.astype(jnp.float32) * head_dim ** -0.5,
+                            k.astype(jnp.float32)) + bias
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhts,bshd->bthd", probs,
+                          v.astype(jnp.float32)).astype(x.dtype)
+        x = _ln(x + attn.reshape(b, t, cfg.dim) @ layer["wo"],
+                layer["ln1_scale"], layer["ln1_bias"], cfg.norm_eps)
+        h = jax.nn.gelu(x @ layer["w1"], approximate=True) @ layer["w2"]
+        x = _ln(x + h, layer["ln2_scale"], layer["ln2_bias"], cfg.norm_eps)
+
+    denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1)
+    pooled = (x * mask[..., None]).sum(axis=1) / denom
+    return (pooled @ params["head"]).astype(jnp.float32)

@@ -1,0 +1,126 @@
+"""CLIP vision transformer (baseline config #3: ViT-L/14 image embedding
+fan-out across N×v5e-1 task-queue workers).
+
+Encoder-only ViT: conv patch embed (expressed as a reshaped matmul so it hits
+the MXU rather than a conv kernel), pre-norm transformer, final layernorm +
+projection to the shared embedding space.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ClipVisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    dim: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    hidden_dim: int = 4096
+    embed_dim: int = 768           # output projection dim
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CLIP_VIT_L14 = ClipVisionConfig()
+CLIP_VIT_TINY = ClipVisionConfig(image_size=28, patch_size=14, dim=64,
+                                 n_layers=2, n_heads=4, hidden_dim=128,
+                                 embed_dim=32)
+
+
+def _dense(rng, i, o, dtype):
+    return (jax.random.normal(rng, (i, o), dtype=jnp.float32)
+            * (2.0 / (i + o)) ** 0.5).astype(dtype)
+
+
+def init_clip_vision(rng: jax.Array, cfg: ClipVisionConfig) -> Params:
+    rngs = jax.random.split(rng, cfg.n_layers * 6 + 4)
+    it = iter(rngs)
+    dt = cfg.dtype
+    patch_dim = 3 * cfg.patch_size * cfg.patch_size
+    params: Params = {
+        "patch_proj": _dense(next(it), patch_dim, cfg.dim, dt),
+        "cls_token": jnp.zeros((1, 1, cfg.dim), dtype=dt),
+        "pos_embed": (jax.random.normal(next(it), (cfg.n_patches + 1, cfg.dim),
+                                        dtype=jnp.float32) * 0.02).astype(dt),
+        "ln_pre": {"scale": jnp.ones((cfg.dim,), jnp.float32),
+                   "bias": jnp.zeros((cfg.dim,), jnp.float32)},
+        "ln_post": {"scale": jnp.ones((cfg.dim,), jnp.float32),
+                    "bias": jnp.zeros((cfg.dim,), jnp.float32)},
+        "proj": _dense(next(it), cfg.dim, cfg.embed_dim, dt),
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "ln1": {"scale": jnp.ones((cfg.dim,), jnp.float32),
+                    "bias": jnp.zeros((cfg.dim,), jnp.float32)},
+            "ln2": {"scale": jnp.ones((cfg.dim,), jnp.float32),
+                    "bias": jnp.zeros((cfg.dim,), jnp.float32)},
+            "wqkv": _dense(next(it), cfg.dim, 3 * cfg.dim, dt),
+            "wo": _dense(next(it), cfg.dim, cfg.dim, dt),
+            "w1": _dense(next(it), cfg.dim, cfg.hidden_dim, dt),
+            "w2": _dense(next(it), cfg.hidden_dim, cfg.dim, dt),
+        })
+    return params
+
+
+def _layer_norm(x, p, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return (((xf - mean) * jax.lax.rsqrt(var + eps)) * p["scale"]
+            + p["bias"]).astype(x.dtype)
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """[B, H, W, 3] -> [B, n_patches, patch*patch*3] (row-major patches)."""
+    b, h, w, c = images.shape
+    gh, gw = h // patch, w // patch
+    x = images.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def clip_vision_forward(params: Params, images: jnp.ndarray,
+                        cfg: ClipVisionConfig) -> jnp.ndarray:
+    """images [B, H, W, 3] (f32 0..1) → L2-normalized embeddings [B, embed_dim]."""
+    b = images.shape[0]
+    x = patchify(images.astype(jnp.float32), cfg.patch_size).astype(cfg.dtype)
+    x = x @ params["patch_proj"]
+    cls = jnp.broadcast_to(params["cls_token"], (b, 1, cfg.dim))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+    x = _layer_norm(x, params["ln_pre"], cfg.norm_eps)
+
+    for layer in params["layers"]:
+        h = _layer_norm(x, layer["ln1"], cfg.norm_eps)
+        qkv = (h @ layer["wqkv"]).reshape(b, -1, 3, cfg.n_heads, cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scale = cfg.head_dim ** -0.5
+        logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale,
+                            k.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+        attn = attn.astype(x.dtype).reshape(b, -1, cfg.dim)
+        x = x + attn @ layer["wo"]
+        h = _layer_norm(x, layer["ln2"], cfg.norm_eps)
+        x = x + jax.nn.gelu(h @ layer["w1"], approximate=True) @ layer["w2"]
+
+    cls_out = _layer_norm(x[:, 0], params["ln_post"], cfg.norm_eps)
+    emb = (cls_out @ params["proj"]).astype(jnp.float32)
+    return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)

@@ -1,0 +1,63 @@
+"""LoRA adapters over the decoder param tree (baseline config #5: Gemma-7B
+LoRA fine-tune).
+
+Functional design: adapters live in a *separate* pytree shaped like
+``{"layers": [{"wq": {"a": ..., "b": ...}, ...}]}`` — pure arrays, so the tree
+is directly differentiable/optimizable. The ``alpha/rank`` scale is a static
+float passed alongside. ``merge`` folds adapters into the base weights for
+serving; training takes grads wrt the adapter tree only (the base stays
+frozen — the property that makes multi-host FSDP fine-tunes cheap in
+optimizer memory)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def init_lora(rng: jax.Array, params: Params, rank: int = 8,
+              targets=DEFAULT_TARGETS) -> Params:
+    adapters: Params = {"layers": []}
+    for layer in params["layers"]:
+        entry = {}
+        for name in targets:
+            if name not in layer:
+                continue
+            w = layer[name]
+            rng, ra = jax.random.split(rng)
+            entry[name] = {
+                "a": (jax.random.normal(ra, (w.shape[0], rank),
+                                        dtype=jnp.float32) / rank),
+                "b": jnp.zeros((rank, w.shape[1]), dtype=jnp.float32),
+            }
+        adapters["layers"].append(entry)
+    return adapters
+
+
+def lora_scale(rank: int, alpha: float = 16.0) -> float:
+    return alpha / rank
+
+
+def merge(params: Params, adapters: Params, scale: float = 2.0) -> Params:
+    """Return a new param tree with LoRA deltas folded into the base weights."""
+    merged_layers = []
+    for layer, ad_layer in zip(params["layers"], adapters["layers"]):
+        new_layer = dict(layer)
+        for name, ad in ad_layer.items():
+            delta = (ad["a"] @ ad["b"]) * scale
+            new_layer[name] = (layer[name].astype(jnp.float32)
+                               + delta).astype(layer[name].dtype)
+        merged_layers.append(new_layer)
+    out = dict(params)
+    out["layers"] = merged_layers
+    return out
+
+
+def trainable_count(adapters: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(adapters))

@@ -1,0 +1,221 @@
+"""Decoder-only transformer core shared by the Llama and Gemma families.
+
+Functional style: ``init_decoder`` builds a param pytree (nested dicts with
+stable path names the sharding rules in ``tpu9.parallel.sharding`` pattern-
+match), ``decoder_forward`` runs prefill/train/decode from the same code path
+with static shapes (XLA traces one graph per (batch, seq) bucket).
+
+Weight layout is MXU-friendly: all projections stored as [in, out] so the
+forward pass is plain ``x @ w`` row-major matmuls in bf16.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention, decode_attention
+from ..ops.norms import rms_norm
+from ..ops.rotary import apply_rope, rope_table
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    hidden_dim: int = 14336
+    norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    max_seq_len: int = 8192
+    # family switches
+    act: str = "silu"              # silu (llama) | gelu (gemma)
+    norm_offset: float = 0.0       # 1.0 for gemma's (1+w) RMSNorm
+    embed_scale: bool = False      # gemma scales embeddings by sqrt(dim)
+    logit_softcap: float = 0.0     # gemma-2 style; 0 = off
+    tie_embeddings: bool = False   # output head = embed^T
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+
+def _dense_init(rng, in_dim: int, out_dim: int, dtype) -> jnp.ndarray:
+    scale = (2.0 / (in_dim + out_dim)) ** 0.5
+    return (jax.random.normal(rng, (in_dim, out_dim), dtype=jnp.float32)
+            * scale).astype(dtype)
+
+
+def init_decoder(rng: jax.Array, cfg: DecoderConfig) -> Params:
+    n_rngs = cfg.n_layers * 7 + 3
+    rngs = jax.random.split(rng, n_rngs)
+    it = iter(range(n_rngs))
+    dt = cfg.dtype
+
+    def nxt():
+        return rngs[next(it)]
+
+    params: Params = {
+        "embed": (jax.random.normal(nxt(), (cfg.vocab_size, cfg.dim),
+                                    dtype=jnp.float32) * 0.02).astype(dt),
+        "final_norm": jnp.ones((cfg.dim,), dtype=jnp.float32) - cfg.norm_offset,
+        "layers": [],
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(nxt(), cfg.dim, cfg.vocab_size, dt)
+    else:
+        nxt()
+
+    q_dim = cfg.n_heads * cfg.head_dim
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    for _ in range(cfg.n_layers):
+        layer = {
+            "attn_norm": jnp.ones((cfg.dim,), dtype=jnp.float32) - cfg.norm_offset,
+            "mlp_norm": jnp.ones((cfg.dim,), dtype=jnp.float32) - cfg.norm_offset,
+            "wq": _dense_init(nxt(), cfg.dim, q_dim, dt),
+            "wk": _dense_init(nxt(), cfg.dim, kv_dim, dt),
+            "wv": _dense_init(nxt(), cfg.dim, kv_dim, dt),
+            "wo": _dense_init(nxt(), q_dim, cfg.dim, dt),
+            "w_gate": _dense_init(nxt(), cfg.dim, cfg.hidden_dim, dt),
+            "w_up": _dense_init(nxt(), cfg.dim, cfg.hidden_dim, dt),
+            "w_down": _dense_init(nxt(), cfg.hidden_dim, cfg.dim, dt),
+        }
+        params["layers"].append(layer)
+    return params
+
+
+def init_kv_cache(cfg: DecoderConfig, batch: int, max_len: int = 0,
+                  dtype=None) -> Params:
+    """Contiguous per-sequence KV cache: k/v [L, B, S, KH, D]."""
+    s = max_len or cfg.max_seq_len
+    dt = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, s, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype=dt), "v": jnp.zeros(shape, dtype=dt)}
+
+
+def _act(x: jnp.ndarray, kind: str) -> jnp.ndarray:
+    if kind == "silu":
+        return jax.nn.silu(x)
+    if kind == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(kind)
+
+
+def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
+                positions: jnp.ndarray, sin, cos,
+                kv_cache: Optional[Params], layer_idx: int,
+                cache_len: Optional[jnp.ndarray], decode: bool):
+    b, t, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps, cfg.norm_offset)
+    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, sin, cos)
+    k = apply_rope(k, positions, sin, cos)
+
+    new_cache = None
+    if kv_cache is None:
+        out = attention(q, k, v, causal=True)
+    elif decode:
+        # scatter this token's k/v at positions, then attend over the prefix
+        k_cache = jax.lax.dynamic_update_slice(
+            kv_cache["k"][layer_idx], k,
+            (0, positions[0, 0], 0, 0)) if b == 1 else _scatter_kv(
+                kv_cache["k"][layer_idx], k, positions)
+        v_cache = jax.lax.dynamic_update_slice(
+            kv_cache["v"][layer_idx], v,
+            (0, positions[0, 0], 0, 0)) if b == 1 else _scatter_kv(
+                kv_cache["v"][layer_idx], v, positions)
+        out = decode_attention(q, k_cache, v_cache, cache_len)
+        new_cache = (k_cache, v_cache)
+    else:
+        # prefill: write [0, t) then causal-attend within the prefix
+        k_cache = jax.lax.dynamic_update_slice(
+            kv_cache["k"][layer_idx], k, (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            kv_cache["v"][layer_idx], v, (0, 0, 0, 0))
+        out = attention(q, k, v, causal=True)
+        new_cache = (k_cache, v_cache)
+
+    out = out.reshape(b, t, cfg.n_heads * cfg.head_dim)
+    return x + out @ layer["wo"], new_cache
+
+
+def _scatter_kv(cache: jnp.ndarray, kv: jnp.ndarray,
+                positions: jnp.ndarray) -> jnp.ndarray:
+    """Per-sequence scatter of one token: cache [B,S,KH,D], kv [B,1,KH,D],
+    positions [B,1]."""
+    b = cache.shape[0]
+    idx = positions[:, 0]
+
+    def write_one(c, item, i):
+        return jax.lax.dynamic_update_slice(c, item, (i, 0, 0))
+
+    return jax.vmap(write_one)(cache, kv, idx)
+
+
+def _mlp_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, cfg.norm_offset)
+    gated = _act(h @ layer["w_gate"], cfg.act) * (h @ layer["w_up"])
+    return x + gated @ layer["w_down"]
+
+
+def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
+                    positions: Optional[jnp.ndarray] = None,
+                    kv_cache: Optional[Params] = None,
+                    cache_len: Optional[jnp.ndarray] = None,
+                    decode: bool = False,
+                    return_hidden: bool = False):
+    """Run the decoder.
+
+    - train/eval: ``decoder_forward(params, tokens, cfg)`` → logits [B,T,V]
+    - prefill:   pass ``kv_cache`` (positions default to arange) → (logits, cache)
+    - decode:    ``decode=True`` with tokens [B,1], positions [B,1], cache_len [B]
+                 → (logits [B,1,V], cache)
+    """
+    b, t = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+
+    x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.dim ** 0.5, dtype=cfg.dtype)
+
+    sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+
+    new_k, new_v = [], []
+    for i, layer in enumerate(params["layers"]):
+        x, updated = _attn_block(layer, x, cfg, positions, sin, cos,
+                                 kv_cache, i, cache_len, decode)
+        if updated is not None:
+            new_k.append(updated[0])
+            new_v.append(updated[1])
+        x = _mlp_block(layer, x, cfg)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps, cfg.norm_offset)
+    if return_hidden:
+        logits = None
+    else:
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+        if cfg.logit_softcap > 0:
+            logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+
+    out = x if return_hidden else logits
+    if kv_cache is not None:
+        cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        return out, cache
+    return out
+
+
+def count_params(params: Params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,14 @@
+"""TPU-native ops: pallas kernels for the hot paths, XLA fallbacks everywhere.
+
+The reference platform runs accelerator math inside user containers (CUDA);
+tpu9 ships these ops in the runner image so workloads hit the MXU with
+bf16-friendly, statically-shaped kernels.
+"""
+
+from .norms import rms_norm
+from .rotary import apply_rope, rope_table
+from .attention import flash_attention, xla_attention, decode_attention
+from .sampling import sample_logits
+
+__all__ = ["rms_norm", "apply_rope", "rope_table", "flash_attention",
+           "xla_attention", "decode_attention", "sample_logits"]

@@ -1,0 +1,207 @@
+"""Attention: blocked flash attention as a Pallas TPU kernel, with an XLA
+fallback, GQA support, and a decode-step path.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- grid = (batch*q_heads, q_blocks, k_blocks); k is the innermost sequential
+  dimension so VMEM scratch (running max/denominator/accumulator) carries
+  across k blocks — the standard online-softmax flash schedule.
+- blocks are (128, head_dim): MXU-shaped, satisfies bf16 (16,128) tiling.
+- causal blocks fully above the diagonal are skipped via ``pl.when`` so the
+  kernel does ~half the work of the dense path at long sequence lengths.
+- accumulation in f32; inputs may be bf16.
+
+On CPU (tests) the same kernel runs with ``interpret=True``; model code picks
+the XLA path automatically when not on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _expand_gqa(k: jnp.ndarray, q_heads: int) -> jnp.ndarray:
+    """[B, S, KH, D] -> [B, S, QH, D] by repeating kv heads."""
+    kv_heads = k.shape[2]
+    if kv_heads == q_heads:
+        return k
+    group = q_heads // kv_heads
+    return jnp.repeat(k, group, axis=2)
+
+
+def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  causal: bool = True,
+                  kv_offset: int = 0) -> jnp.ndarray:
+    """Reference/fallback attention. q: [B, T, QH, D], k/v: [B, S, KH, D].
+
+    ``kv_offset`` positions q tokens at absolute offset within the kv sequence
+    (prefill-with-cache and chunked prefill).
+    """
+    q_heads = q.shape[2]
+    k = _expand_gqa(k, q_heads)
+    v = _expand_gqa(v, q_heads)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+    if causal:
+        t, s = q.shape[1], k.shape[1]
+        q_pos = jnp.arange(t)[:, None] + kv_offset
+        k_pos = jnp.arange(s)[None, :]
+        mask = k_pos <= q_pos
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
+                  num_kb: int):
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)                  # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)                  # [Bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Bq, Bk]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+        m_prev = m_scratch[...]                           # [Bq, 128]
+        l_prev = l_scratch[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)        # [Bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)                   # rescale factor
+        p = jnp.exp(s - m_new[:, :1])                     # [Bq, Bk]
+        l_new = alpha * l_prev + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
+        acc_scratch[...] = acc_scratch[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scratch[...] = m_new
+        l_scratch[...] = l_new
+
+    if causal:
+        # skip blocks fully above the diagonal
+        below_diag = kb * block_k <= qb * block_q + (block_q - 1)
+        pl.when(below_diag)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l = l_scratch[...][:, :1]
+        o_ref[0] = (acc_scratch[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False) -> jnp.ndarray:
+    """Flash attention. q: [B, T, QH, D]; k/v: [B, S, KH, D] with KH | QH.
+
+    T and S must be multiples of the block sizes (model code pads); head_dim
+    should be a multiple of 128 for MXU tiling (64 works but underutilizes).
+    """
+    batch, t, q_heads, head_dim = q.shape
+    s = k.shape[1]
+    kv_heads = k.shape[2]
+    assert q_heads % kv_heads == 0
+    group = q_heads // kv_heads
+    assert t % block_q == 0 and s % block_k == 0, (t, s, block_q, block_k)
+
+    # layout: [B*QH, T, D] so the grid's leading axis walks batch*heads
+    qt = q.transpose(0, 2, 1, 3).reshape(batch * q_heads, t, head_dim)
+    kt = k.transpose(0, 2, 1, 3).reshape(batch * kv_heads, s, head_dim)
+    vt = v.transpose(0, 2, 1, 3).reshape(batch * kv_heads, s, head_dim)
+
+    num_qb = t // block_q
+    num_kb = s // block_k
+    grid = (batch * q_heads, num_qb, num_kb)
+
+    def q_index(bh, qb, kb):
+        return (bh, qb, 0)
+
+    def kv_index(bh, qb, kb):
+        return (bh // group, kb, 0)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=head_dim ** -0.5, causal=causal,
+        block_q=block_q, block_k=block_k, num_kb=num_kb)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), q_index),
+            pl.BlockSpec((1, block_k, head_dim), kv_index),
+            pl.BlockSpec((1, block_k, head_dim), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), q_index),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((block_q, head_dim), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+
+    return out.reshape(batch, q_heads, t, head_dim).transpose(0, 2, 1, 3)
+
+
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              causal: bool = True, kv_offset: int = 0) -> jnp.ndarray:
+    """Dispatch: pallas flash on TPU for block-aligned shapes, XLA otherwise."""
+    on_tpu = jax.default_backend() == "tpu"
+    t, s = q.shape[1], k.shape[1]
+    if (on_tpu and kv_offset == 0 and t % 128 == 0 and s % 128 == 0
+            and q.shape[-1] in (64, 128, 256)):
+        return flash_attention(q, k, v, causal=causal)
+    return xla_attention(q, k, v, causal=causal, kv_offset=kv_offset)
+
+
+def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                     cache_len: jnp.ndarray) -> jnp.ndarray:
+    """Single-token decode attention against a contiguous KV cache.
+
+    q: [B, 1, QH, D]; k_cache/v_cache: [B, S_max, KH, D]; cache_len: [B]
+    (valid prefix length per sequence, including the current token).
+
+    One fused XLA graph: masked softmax over the cache. At decode the op is
+    HBM-bandwidth-bound reading the cache, which XLA handles well; a paged
+    pallas kernel is the follow-up optimization.
+    """
+    q_heads = q.shape[2]
+    k = _expand_gqa(k_cache, q_heads)
+    v = _expand_gqa(v_cache, q_heads)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))       # [B, H, 1, S]
+    s_max = k.shape[1]
+    mask = jnp.arange(s_max)[None, :] < cache_len[:, None]       # [B, S]
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,18 @@
+"""Normalization ops. RMSNorm runs in f32 regardless of input dtype (matching
+standard Llama/Gemma numerics) and casts back, letting XLA fuse it into the
+surrounding matmuls."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5,
+             offset: float = 0.0) -> jnp.ndarray:
+    """``offset=1.0`` gives Gemma-style (1 + w) scaling."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * (offset + weight.astype(jnp.float32))).astype(dtype)

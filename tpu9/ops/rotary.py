@@ -1,0 +1,37 @@
+"""Rotary position embeddings (RoPE), precomputed-table style.
+
+The table is computed once per model (static shapes, f32) and gathered by
+position ids — decode steps index it with dynamic positions without
+recomputing sin/cos, keeping the decode graph tiny for XLA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(max_len: int, head_dim: int,
+               theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (sin, cos), each [max_len, head_dim//2], f32."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = jnp.arange(max_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               sin: jnp.ndarray, cos: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` [..., T, H, D] by per-token ``positions`` [..., T].
+
+    Uses the split-halves convention (x = [x1, x2]; rotate pairs (x1_i, x2_i))
+    — the layout used by Llama/Gemma reference JAX implementations.
+    """
+    dtype = x.dtype
+    s = sin[positions].astype(jnp.float32)   # [..., T, D/2]
+    c = cos[positions].astype(jnp.float32)
+    # broadcast over the heads axis: x is [..., T, H, D], tables [..., T, D/2]
+    s = s[..., None, :]
+    c = c[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

@@ -1,0 +1,19 @@
+"""Parallelism layer: device meshes, sharding rules, ring attention, and
+multi-host (DCN) wiring.
+
+The reference has no in-framework parallelism (SURVEY.md §2.10) — multi-GPU is
+device injection and NCCL lives inside user containers. tpu9 makes this layer
+first-class: the scheduler hands a container a slice; this package turns that
+slice into a ``jax.sharding.Mesh`` with tp/fsdp/dp/sp axes and the collectives
+ride ICI via XLA.
+"""
+
+from .mesh import make_mesh, mesh_for_spec, MeshAxes
+from .sharding import (decoder_param_specs, fsdp_specs, shard_params,
+                       constrain, replicate_specs)
+from .ring import ring_attention
+from .distributed import multihost_env, initialize_multihost
+
+__all__ = ["make_mesh", "mesh_for_spec", "MeshAxes", "decoder_param_specs",
+           "fsdp_specs", "shard_params", "constrain", "replicate_specs",
+           "ring_attention", "multihost_env", "initialize_multihost"]

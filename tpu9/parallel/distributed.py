@@ -1,0 +1,52 @@
+"""Multi-host (DCN) wiring.
+
+The tpu9 worker injects gang env the way the reference injects GPU env
+(``pkg/worker/nvidia.go:289-440``): ``TPU9_GANG_RANK``, ``TPU9_GANG_SIZE``,
+``TPU9_COORDINATOR_ADDR`` (rank 0's address), plus libtpu's own
+``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``. This module is the runner-side
+consumer: call ``initialize_multihost()`` first thing in a multi-host workload
+and every host joins one jax.distributed job spanning the slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger("tpu9.parallel")
+
+
+@dataclass(frozen=True)
+class MultihostEnv:
+    rank: int
+    size: int
+    coordinator: str
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.rank == 0
+
+
+def multihost_env(environ: Optional[dict] = None) -> Optional[MultihostEnv]:
+    env = environ if environ is not None else os.environ
+    size = int(env.get("TPU9_GANG_SIZE", "1"))
+    if size <= 1:
+        return None
+    return MultihostEnv(rank=int(env.get("TPU9_GANG_RANK", "0")), size=size,
+                        coordinator=env.get("TPU9_COORDINATOR_ADDR", ""))
+
+
+def initialize_multihost(environ: Optional[dict] = None) -> Optional[MultihostEnv]:
+    """Join the slice-wide jax.distributed job if gang env is present."""
+    info = multihost_env(environ)
+    if info is None:
+        return None
+    import jax
+    jax.distributed.initialize(coordinator_address=info.coordinator,
+                               num_processes=info.size,
+                               process_id=info.rank)
+    log.info("joined multihost job rank=%d/%d coordinator=%s",
+             info.rank, info.size, info.coordinator)
+    return info

@@ -1,0 +1,47 @@
+"""Device mesh construction from TPU slice topology.
+
+Axis convention (order matters — outer axes map to slower interconnect):
+
+- ``dp``   data parallel (across slices / DCN when multi-pod)
+- ``fsdp`` fully-sharded data parallel (params+grads sharded, ICI)
+- ``sp``   sequence/context parallel (ring attention)
+- ``tp``   tensor parallel (innermost, fastest ICI links)
+
+``mesh_for_spec`` lays tp within a host's chips so TP collectives never
+cross hosts on multi-host slices (the scaling-book recipe: keep the
+bandwidth-hungriest axis on the shortest links).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..types import TpuSpec
+
+MeshAxes = ("dp", "fsdp", "sp", "tp")
+
+
+def make_mesh(dp: int = 1, fsdp: int = 1, sp: int = 1, tp: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else list(jax.devices())
+    need = dp * fsdp * sp * tp
+    if need > len(devs):
+        raise ValueError(f"mesh needs {need} devices, have {len(devs)}")
+    grid = np.array(devs[:need]).reshape(dp, fsdp, sp, tp)
+    return Mesh(grid, MeshAxes)
+
+
+def mesh_for_spec(spec: TpuSpec, tp: Optional[int] = None, sp: int = 1,
+                  dp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Default mesh for a slice: tp defaults to chips_per_host (TP stays
+    on-host), fsdp absorbs the remaining chips."""
+    chips = spec.chips
+    tp = tp if tp is not None else min(spec.chips_per_host, chips)
+    assert chips % (dp * sp * tp) == 0, (chips, dp, sp, tp)
+    fsdp = chips // (dp * sp * tp)
+    return make_mesh(dp=dp, fsdp=fsdp, sp=sp, tp=tp, devices=devices)

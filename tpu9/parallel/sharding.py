@@ -1,0 +1,112 @@
+"""Sharding rules for the model param trees.
+
+Megatron-style TP layout for the decoder (column-parallel up-projections,
+row-parallel down-projections so each layer needs one all-reduce per block),
+optionally combined with FSDP sharding of the remaining dimension. GSPMD
+inserts the collectives; these specs are the whole "distributed backend".
+
+Layout table (decoder params from tpu9.models.transformer):
+
+  embed   [V, D]   P(fsdp, None)        (vocab rows sharded by fsdp)
+  lm_head [D, V]   P(fsdp, tp)          (column-parallel logits)
+  wq/wk/wv[D, HDh] P(fsdp, tp)          (column-parallel heads)
+  wo      [HDh, D] P(tp, fsdp)          (row-parallel → psum)
+  w_gate  [D, F]   P(fsdp, tp)
+  w_up    [D, F]   P(fsdp, tp)
+  w_down  [F, D]   P(tp, fsdp)
+  norms   [D]      replicated
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = dict[str, Any]
+
+
+def _layer_specs(tp: str, fsdp: Optional[str]) -> dict[str, P]:
+    return {
+        "attn_norm": P(),
+        "mlp_norm": P(),
+        "wq": P(fsdp, tp),
+        "wk": P(fsdp, tp),
+        "wv": P(fsdp, tp),
+        "wo": P(tp, fsdp),
+        "w_gate": P(fsdp, tp),
+        "w_up": P(fsdp, tp),
+        "w_down": P(tp, fsdp),
+    }
+
+
+def decoder_param_specs(params: Params, tp: str = "tp",
+                        fsdp: Optional[str] = "fsdp") -> Params:
+    """PartitionSpec tree matching a decoder param tree."""
+    specs: Params = {
+        "embed": P(fsdp, None),
+        "final_norm": P(),
+        "layers": [_layer_specs(tp, fsdp) for _ in params["layers"]],
+    }
+    if "lm_head" in params:
+        specs["lm_head"] = P(fsdp, tp)
+    return specs
+
+
+def fsdp_specs(params: Params, axis: str = "fsdp",
+               min_size: int = 2 ** 14) -> Params:
+    """Generic FSDP rule for any pytree: shard the largest divisible dim of
+    every big tensor along ``axis``; small tensors replicate. Used for
+    adapter/optimizer trees where no TP layout applies."""
+
+    def rule(x):
+        if not hasattr(x, "shape") or x.size < min_size or x.ndim == 0:
+            return P()
+        dims = [None] * x.ndim
+        largest = max(range(x.ndim), key=lambda i: x.shape[i])
+        dims[largest] = axis
+        return P(*dims)
+
+    return jax.tree_util.tree_map(rule, params)
+
+
+def replicate_specs(tree: Params) -> Params:
+    return jax.tree_util.tree_map(lambda _: P(), tree)
+
+
+def shard_params(params: Params, mesh: Mesh, specs: Params) -> Params:
+    """Device-put a param tree according to a spec tree. Dims not divisible by
+    the mesh axis fall back to replication on that dim (keeps tiny test models
+    working on any mesh)."""
+
+    def place(x, spec):
+        if not hasattr(x, "shape"):
+            return x
+        fixed = _fit_spec(x, spec, mesh)
+        return jax.device_put(x, NamedSharding(mesh, fixed))
+
+    return jax.tree_util.tree_map(place, params, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def _fit_spec(x, spec: P, mesh: Mesh) -> P:
+    dims = []
+    for i, axis in enumerate(spec):
+        if axis is None or i >= x.ndim:
+            dims.append(None)
+            continue
+        size = mesh.shape[axis] if isinstance(axis, str) else 1
+        dims.append(axis if x.shape[i] % size == 0 else None)
+    while len(dims) < x.ndim:
+        dims.append(None)
+    return P(*dims)
+
+
+def constrain(x: jnp.ndarray, spec: P) -> jnp.ndarray:
+    """Activation sharding hint inside jit (no-op outside a mesh context)."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:
+        return x

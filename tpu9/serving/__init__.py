@@ -1,0 +1,3 @@
+from .engine import EngineConfig, InferenceEngine
+
+__all__ = ["EngineConfig", "InferenceEngine"]

@@ -1,0 +1,254 @@
+"""LLM inference engine: continuous batching over jitted prefill/decode.
+
+TPU-first rationale: the engine compiles exactly two graphs per shape bucket —
+``prefill(tokens[1, Tpad])`` and ``decode(tokens[B,1])`` — and keeps the KV
+cache as a persistent on-device buffer donated through every decode step, so
+steady-state decoding is one fused XLA computation per token across the whole
+batch with zero host↔device traffic except the sampled ids.
+
+Slots: fixed max_batch decode lanes. New requests prefill (bucketed lengths to
+bound compile count), then join the decode batch at their slot index. This is
+the same admission shape the reference's LLM-aware pod router assumes
+(``pkg/abstractions/pod/llm.go`` token-pressure/active-streams), which the
+gateway reads from the engine's ``stats()``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import (DecoderConfig, decoder_forward,
+                                  init_kv_cache)
+from ..ops.sampling import sample_logits
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    max_batch: int = 8
+    max_seq_len: int = 2048
+    prefill_buckets: tuple = (128, 512, 2048)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: int = -1              # -1 disables EOS stopping
+
+
+@dataclass
+class _Request:
+    request_id: str
+    prompt: list[int]
+    max_new_tokens: int
+    slot: int = -1
+    generated: list[int] = field(default_factory=list)
+    done: asyncio.Event = field(default_factory=asyncio.Event)
+    queue: Optional[asyncio.Queue] = None   # set for streaming requests
+
+
+class InferenceEngine:
+    """Continuous-batching engine around a decoder model."""
+
+    def __init__(self, params: Params, cfg: DecoderConfig,
+                 engine_cfg: EngineConfig = EngineConfig()):
+        self.params = params
+        self.cfg = cfg
+        self.ecfg = engine_cfg
+        b, s = engine_cfg.max_batch, engine_cfg.max_seq_len
+        self.kv_cache = init_kv_cache(cfg, b, s)
+        self.cache_len = jnp.zeros((b,), jnp.int32)     # valid prefix per slot
+        self.active = np.zeros((b,), dtype=bool)
+        self.slot_req: list[Optional[_Request]] = [None] * b
+        self.last_token = jnp.zeros((b, 1), jnp.int32)
+        self._rng = jax.random.PRNGKey(0)
+        self._queue: asyncio.Queue[_Request] = asyncio.Queue()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._compiled: dict[int, Any] = {}
+        self._decode_fn = self._build_decode()
+        self._stats = {"active_streams": 0, "queued": 0, "tokens_generated": 0,
+                       "decode_steps": 0}
+
+    # -- compiled steps ------------------------------------------------------
+
+    def _build_decode(self):
+        cfg, ecfg = self.cfg, self.ecfg
+
+        def decode(params, kv_cache, last_token, cache_len, active, rng):
+            positions = cache_len[:, None]              # next position per slot
+            logits, kv_cache = decoder_forward(
+                params, last_token, cfg, positions=positions,
+                kv_cache=kv_cache, cache_len=cache_len + 1, decode=True)
+            rng, sub = jax.random.split(rng)
+            next_tok = sample_logits(logits[:, -1], sub,
+                                     temperature=ecfg.temperature,
+                                     top_k=ecfg.top_k, top_p=ecfg.top_p)
+            # only live slots advance; idle lanes stay parked at 0 so the
+            # token-pressure signal reflects real cache occupancy
+            new_len = cache_len + active.astype(jnp.int32)
+            return next_tok[:, None].astype(jnp.int32), kv_cache, new_len, rng
+
+        return jax.jit(decode, donate_argnums=(1,))
+
+    def _prefill_fn(self, bucket: int):
+        if bucket in self._compiled:
+            return self._compiled[bucket]
+        cfg = self.cfg
+
+        def prefill(params, tokens, length):
+            # tokens [1, bucket] padded; returns logits at the last real token
+            # and the per-layer k/v for the prefix.
+            logits, cache = decoder_forward(
+                params, tokens, cfg,
+                kv_cache=init_kv_cache(cfg, 1, bucket), decode=False)
+            last = logits[0, length - 1]
+            return last, cache
+
+        fn = jax.jit(prefill)
+        self._compiled[bucket] = fn
+        return fn
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.ecfg.prefill_buckets:
+            if n <= b:
+                return b
+        return self.ecfg.prefill_buckets[-1]
+
+    # -- public API ----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._loop_task is None:
+            self._loop_task = asyncio.create_task(self._serve_loop())
+
+    async def stop(self) -> None:
+        if self._loop_task:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None
+
+    async def generate(self, prompt: list[int], max_new_tokens: int = 32,
+                       request_id: str = "", stream: bool = False):
+        limit = min(self.ecfg.prefill_buckets[-1], self.ecfg.max_seq_len - 1)
+        if len(prompt) > limit:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds engine limit {limit}")
+        if not prompt:
+            raise ValueError("empty prompt")
+        req = _Request(request_id=request_id or f"r{time.monotonic_ns()}",
+                       prompt=list(prompt), max_new_tokens=max_new_tokens,
+                       queue=asyncio.Queue() if stream else None)
+        await self._queue.put(req)
+        self._stats["queued"] = self._queue.qsize()
+        if stream:
+            return req  # caller iterates req.queue
+        await req.done.wait()
+        return req.generated
+
+    def stats(self) -> dict:
+        out = dict(self._stats)
+        out["active_streams"] = int(self.active.sum())
+        out["queued"] = self._queue.qsize()
+        out["token_pressure"] = float(
+            np.asarray(jax.device_get(self.cache_len)).sum()
+            / (self.ecfg.max_batch * self.ecfg.max_seq_len))
+        return out
+
+    # -- engine loop ---------------------------------------------------------
+
+    def _admit(self, req: _Request, slot: int) -> None:
+        n = len(req.prompt)
+        bucket = self._bucket_for(n)
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, :n] = req.prompt[:bucket]
+        last, cache = self._prefill_fn(bucket)(
+            self.params, jnp.asarray(tokens), n)
+        # copy prefix cache into the slot's lanes
+        k = self.kv_cache["k"]
+        v = self.kv_cache["v"]
+        k = jax.lax.dynamic_update_slice(
+            k, cache["k"][:, :, :bucket], (0, slot, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(
+            v, cache["v"][:, :, :bucket], (0, slot, 0, 0, 0))
+        self.kv_cache = {"k": k, "v": v}
+        self.cache_len = self.cache_len.at[slot].set(n)
+        # sample the first generated token from the prefill logits
+        self._rng, sub = jax.random.split(self._rng)
+        first = int(sample_logits(last, sub, temperature=self.ecfg.temperature,
+                                  top_k=self.ecfg.top_k, top_p=self.ecfg.top_p))
+        self.last_token = self.last_token.at[slot, 0].set(first)
+        req.slot = slot
+        req.generated.append(first)
+        if req.queue is not None:
+            req.queue.put_nowait(first)
+        self.active[slot] = True
+        self.slot_req[slot] = req
+        # the prefill-sampled token may already satisfy the stop conditions
+        if (req.max_new_tokens <= 1
+                or (self.ecfg.eos_id >= 0 and first == self.ecfg.eos_id)):
+            self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        req = self.slot_req[slot]
+        self.active[slot] = False
+        self.slot_req[slot] = None
+        self.cache_len = self.cache_len.at[slot].set(0)
+        if req is not None:
+            if req.queue is not None:
+                req.queue.put_nowait(None)
+            req.done.set()
+
+    async def _serve_loop(self) -> None:
+        while True:
+            # admit as many queued requests as there are free slots
+            admitted = False
+            while not self._queue.empty() and not self.active.all():
+                req = self._queue.get_nowait()
+                slot = int(np.argmin(self.active))
+                self._admit(req, slot)
+                admitted = True
+
+            if not self.active.any():
+                # idle: block for work
+                req = await self._queue.get()
+                slot = 0
+                self._admit(req, slot)
+                admitted = True
+
+            if not self.active.any():
+                continue
+
+            # one decode step for the whole batch
+            (self.last_token, self.kv_cache,
+             self.cache_len, self._rng) = self._decode_fn(
+                self.params, self.kv_cache, self.last_token,
+                self.cache_len, jnp.asarray(self.active), self._rng)
+            self._stats["decode_steps"] += 1
+
+            tokens = np.asarray(jax.device_get(self.last_token))[:, 0]
+            lens = np.asarray(jax.device_get(self.cache_len))
+            for slot in range(self.ecfg.max_batch):
+                if not self.active[slot]:
+                    continue
+                req = self.slot_req[slot]
+                tok = int(tokens[slot])
+                req.generated.append(tok)
+                self._stats["tokens_generated"] += 1
+                if req.queue is not None:
+                    req.queue.put_nowait(tok)
+                hit_eos = (self.ecfg.eos_id >= 0 and tok == self.ecfg.eos_id)
+                # prompt + generated must fit the cache
+                out_of_room = lens[slot] >= self.ecfg.max_seq_len - 1
+                if (len(req.generated) >= req.max_new_tokens or hit_eos
+                        or out_of_room):
+                    self._retire(slot)
+            # yield to the event loop so new requests can land
+            await asyncio.sleep(0)

@@ -12,9 +12,10 @@ from .store import StateStore
 
 
 class RemoteSubscription:
-    def __init__(self, client: "RemoteStore", sub_id: int):
+    def __init__(self, client: "RemoteStore", sub_id: int, pattern: str):
         self._client = client
         self.sub_id = sub_id
+        self.pattern = pattern
         self.queue: asyncio.Queue = asyncio.Queue()
 
     def __aiter__(self) -> AsyncIterator[tuple[str, Any]]:
@@ -56,6 +57,10 @@ class RemoteStore(StateStore):
             self._read_task = asyncio.create_task(self._read_loop())
             if self.auth_token:
                 await self._call("auth", self.auth_token)
+            # replay live subscriptions on the fresh connection (a reconnect
+            # would otherwise leave pubsub consumers permanently silent)
+            for sub in list(self._subs.values()):
+                await self._send_subscribe(sub)
         return self
 
     async def close(self) -> None:
@@ -129,23 +134,34 @@ class RemoteStore(StateStore):
             return
         loop.create_task(self._call(op, *args))
 
+    async def _send_subscribe(self, sub: "RemoteSubscription") -> None:
+        assert self._writer is not None
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[sub.sub_id] = fut
+        frame = wire.pack({"id": sub.sub_id, "op": "subscribe",
+                           "args": [sub.pattern]})
+        async with self._write_lock:
+            self._writer.write(frame)
+            await self._writer.drain()
+        await fut
+
     def subscribe(self, pattern: str):
         # register synchronously with a reserved id; server uses request id
         rid = next(self._ids)
-        sub = RemoteSubscription(self, rid)
+        sub = RemoteSubscription(self, rid, pattern)
         self._subs[rid] = sub
 
         async def do_subscribe() -> None:
-            if self._writer is None:
-                await self.connect()
-            assert self._writer is not None
-            fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._pending[rid] = fut
-            frame = wire.pack({"id": rid, "op": "subscribe", "args": [pattern]})
-            async with self._write_lock:
-                self._writer.write(frame)
-                await self._writer.drain()
-            await fut
+            try:
+                if self._writer is None:
+                    await self.connect()  # connect() replays self._subs
+                else:
+                    await self._send_subscribe(sub)
+            except Exception:
+                # poison the queue so the consumer observes the failure
+                # instead of blocking forever
+                self._subs.pop(rid, None)
+                sub.queue.put_nowait((None, None))
 
         try:
             loop = asyncio.get_running_loop()

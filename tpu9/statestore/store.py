@@ -162,16 +162,22 @@ class MemoryStore(StateStore):
             return None
         return self._kv.get(key)
 
+    def _present(self, key: str) -> bool:
+        if self._expired(key):
+            return False
+        return (key in self._kv or key in self._hashes or key in self._zsets
+                or key in self._lists or key in self._streams)
+
     async def delete(self, *keys):
         n = 0
         for key in keys:
-            if key in self._live_keys():
+            if self._present(key):
                 n += 1
             self._purge(key)
         return n
 
     async def exists(self, key):
-        return key in self._live_keys()
+        return self._present(key)
 
     async def keys(self, pattern="*"):
         return sorted(k for k in self._live_keys() if fnmatch.fnmatchcase(k, pattern))

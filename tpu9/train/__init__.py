@@ -1,0 +1,4 @@
+from .trainer import TrainState, build_train_step, causal_lm_loss, build_lora_train_step
+
+__all__ = ["TrainState", "build_train_step", "causal_lm_loss",
+           "build_lora_train_step"]

@@ -1,0 +1,3 @@
+from .platform import force_cpu, device_kind
+
+__all__ = ["force_cpu", "device_kind"]

@@ -1,0 +1,27 @@
+"""JAX platform selection helpers.
+
+Some images pre-import jax via sitecustomize and pin a TPU(-relay) platform
+into ``jax_platforms`` at interpreter startup; mutating ``os.environ`` after
+that is too late. ``force_cpu()`` flips the live config instead — call it
+before the first jax computation in any process that must not touch the TPU
+(unit tests, CPU-only runner containers, scheduler/gateway processes)."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(host_devices: int = 0) -> None:
+    if host_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={host_devices}".strip())
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def device_kind() -> str:
+    import jax
+    return jax.devices()[0].device_kind if jax.devices() else "none"
