@@ -1,0 +1,122 @@
+"""End-to-end: deploy → autoscale from zero → real runner subprocess →
+request forwarding → scale down. This exercises the full call stack of
+SURVEY.md §3.2/§3.3 (minus OCI/caching): gateway HTTP → endpoint instance →
+request buffer → scheduler backlog → worker selection → pool scale-up →
+process runtime spawn → readiness probe → reverse proxy → response.
+"""
+
+import asyncio
+
+import pytest
+
+from tpu9.testing.localstack import LocalStack
+from tpu9.types import ContainerStatus
+
+pytestmark = pytest.mark.e2e
+
+SLOW_HANDLER = """
+import time, os
+def handler(**kwargs):
+    time.sleep(kwargs.get("sleep", 0))
+    return {"pid": os.getpid(), "got": kwargs}
+"""
+
+FAILING_IMPORT = """
+raise RuntimeError("boom at import")
+"""
+
+
+async def test_endpoint_cold_start_and_echo():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_echo_endpoint("echo")
+        out = await stack.invoke(dep, {"x": 1, "y": "z"})
+        assert out["echo"] == {"x": 1, "y": "z"}
+        # a second request hits the warm container (same pid)
+        out2 = await stack.invoke(dep, {"x": 2})
+        assert out2["pid"] == out["pid"]
+        # exactly one container running
+        running = await stack.running_containers(dep["stub_id"])
+        assert len(running) == 1
+
+
+async def test_scale_to_zero_and_back():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_echo_endpoint("scaler")
+        out1 = await stack.invoke(dep, {"n": 1})
+        await stack.scale_to_zero(dep)
+        assert await stack.running_containers(dep["stub_id"]) == []
+        # next request cold-starts a fresh container
+        out2 = await stack.invoke(dep, {"n": 2})
+        assert out2["pid"] != out1["pid"]
+
+
+async def test_concurrent_requests_fan_out():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint(
+            "fan", {"app.py": SLOW_HANDLER}, "app:handler",
+            config_extra={"concurrent_requests": 1,
+                          "autoscaler": {"max_containers": 3,
+                                         "tasks_per_container": 1}})
+        results = await asyncio.gather(*[
+            stack.invoke(dep, {"sleep": 1.0, "i": i}) for i in range(3)])
+        pids = {r["pid"] for r in results}
+        assert len(pids) >= 2, f"expected fan-out across containers, got {pids}"
+
+
+async def test_worker_reports_failure_on_bad_handler():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint(
+            "broken", {"app.py": FAILING_IMPORT}, "app:handler",
+            config_extra={"timeout_s": 10.0})
+        status, _ = await stack.api("POST", "/endpoint/broken",
+                                    json_body={}, timeout=30.0)
+        # request cannot be served: readiness never passes → 504 from buffer
+        assert status in (502, 504)
+
+
+async def test_handler_error_returns_500():
+    bad = """
+def handler(**kwargs):
+    raise ValueError("user bug")
+"""
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint("oops", {"app.py": bad},
+                                          "app:handler")
+        status, out = await stack.api("POST", "/endpoint/oops", json_body={})
+        assert status == 500
+        assert "user bug" in out["error"]
+
+
+async def test_auth_enforced():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_echo_endpoint("private")
+        # no token → 401
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{stack.base_url}/endpoint/private",
+                              json={}) as resp:
+                assert resp.status == 401
+            async with s.get(f"{stack.base_url}/api/v1/worker") as resp:
+                assert resp.status == 401
+
+
+async def test_rest_api_surfaces():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_echo_endpoint("api-test")
+        await stack.invoke(dep, {"a": 1})
+        status, deployments = await stack.api("GET", "/api/v1/deployment")
+        assert status == 200 and deployments[0]["name"] == "api-test"
+        status, containers = await stack.api("GET", "/api/v1/container")
+        assert status == 200 and len(containers) == 1
+        status, workers = await stack.api("GET", "/api/v1/worker")
+        assert status == 200 and len(workers) >= 1 and workers[0]["alive"]
+        container_id = containers[0]["container_id"]
+        status, logs = await stack.api(
+            "GET", f"/api/v1/container/{container_id}/logs")
+        assert status == 200
+        # secrets CRUD
+        status, _ = await stack.api("POST", "/api/v1/secret",
+                                    json_body={"name": "K", "value": "v"})
+        assert status == 200
+        status, names = await stack.api("GET", "/api/v1/secret")
+        assert names == ["K"]

@@ -1,0 +1,197 @@
+import asyncio
+
+from tpu9.config import SchedulerConfig, WorkerPoolConfig
+from tpu9.repository import ContainerRepository, WorkerRepository
+from tpu9.scheduler import LocalProcessPool, Scheduler, select_worker
+from tpu9.scheduler.selector import filter_workers, find_slice_gang
+from tpu9.statestore import MemoryStore
+from tpu9.types import (ContainerRequest, ContainerStatus, WorkerState,
+                        WorkerStatus, parse_tpu_spec)
+
+
+def W(worker_id, chips=0, gen="", cpu=8000, mem=32768, pool="default",
+      slice_id="", rank=0, hosts=1, status=WorkerStatus.AVAILABLE.value):
+    return WorkerState(
+        worker_id=worker_id, pool=pool, status=status,
+        total_cpu_millicores=cpu, total_memory_mb=mem,
+        free_cpu_millicores=cpu, free_memory_mb=mem,
+        tpu_generation=gen, tpu_chip_count=chips, tpu_free_chips=chips,
+        slice_id=slice_id, slice_host_rank=rank, slice_host_count=hosts,
+        address=f"10.0.0.{rank}:80")
+
+
+class TestSelector:
+    def test_cpu_request_avoids_tpu_workers(self):
+        workers = [W("cpu1"), W("tpu1", chips=8, gen="v5e")]
+        req = ContainerRequest(cpu_millicores=1000, memory_mb=1024)
+        got = filter_workers(workers, req)
+        assert [w.worker_id for w in got] == ["cpu1"]
+
+    def test_tpu_request_matches_generation_and_chips(self):
+        workers = [W("a", chips=4, gen="v5e"), W("b", chips=8, gen="v5e"),
+                   W("c", chips=8, gen="v5p"), W("cpu")]
+        req = ContainerRequest(cpu_millicores=100, memory_mb=128, tpu="v5e-8")
+        got = filter_workers(workers, req)
+        assert [w.worker_id for w in got] == ["b"]
+
+    def test_binpack_prefers_tightest_fit(self):
+        workers = [W("big", chips=8, gen="v5e"), W("tight", chips=1, gen="v5e")]
+        req = ContainerRequest(cpu_millicores=100, memory_mb=128, tpu="v5e-1")
+        chosen = select_worker(workers, req)
+        assert chosen.worker_id == "tight"
+
+    def test_resource_exhaustion_filters(self):
+        w = W("a", cpu=1000, mem=512)
+        req = ContainerRequest(cpu_millicores=2000, memory_mb=128)
+        assert filter_workers([w], req) == []
+
+    def test_gang_discovery(self):
+        spec = parse_tpu_spec("v5p-8")  # 2 hosts x 4 chips
+        workers = [
+            W("h0", chips=4, gen="v5p", slice_id="s1", rank=0, hosts=2),
+            W("h1", chips=4, gen="v5p", slice_id="s1", rank=1, hosts=2),
+            W("lone", chips=4, gen="v5p", slice_id="s2", rank=0, hosts=2),
+        ]
+        req = ContainerRequest(cpu_millicores=100, memory_mb=128, tpu="v5p-8")
+        gang = find_slice_gang(workers, spec, req)
+        assert gang is not None
+        assert [w.worker_id for w in gang] == ["h0", "h1"]
+
+    def test_gang_all_or_nothing(self):
+        spec = parse_tpu_spec("v5p-8")
+        h1 = W("h1", chips=4, gen="v5p", slice_id="s1", rank=1, hosts=2)
+        h1.tpu_free_chips = 0   # busy host poisons the slice
+        workers = [W("h0", chips=4, gen="v5p", slice_id="s1", rank=0, hosts=2),
+                   h1]
+        req = ContainerRequest(cpu_millicores=100, memory_mb=128, tpu="v5p-8")
+        assert find_slice_gang(workers, spec, req) is None
+
+
+class TestScheduler:
+    async def _scheduler(self, pools=None):
+        store = MemoryStore()
+        cfg = SchedulerConfig(loop_interval_s=0.01)
+        sched = Scheduler(store, cfg, pools=pools or {})
+        return store, sched
+
+    async def test_schedules_to_worker_stream(self):
+        store, sched = await self._scheduler()
+        workers = WorkerRepository(store)
+        await workers.register(W("w1", cpu=4000, mem=8192))
+        await sched.start()
+        try:
+            req = ContainerRequest(container_id="c1", stub_id="s1",
+                                   cpu_millicores=1000, memory_mb=1024)
+            await sched.run(req)
+            got = []
+            for _ in range(100):
+                got = await workers.read_requests("w1", timeout=0.05)
+                if got:
+                    break
+            assert got and got[0][1].container_id == "c1"
+            # capacity was reserved
+            w = await workers.get("w1")
+            assert w.free_cpu_millicores == 3000
+            st = await ContainerRepository(store).get_state("c1")
+            assert st.status == ContainerStatus.SCHEDULED.value
+        finally:
+            await sched.stop()
+
+    async def test_gang_scheduling_atomic(self):
+        store, sched = await self._scheduler()
+        workers = WorkerRepository(store)
+        for rank in range(2):
+            await workers.register(
+                W(f"h{rank}", chips=4, gen="v5p", slice_id="s1", rank=rank,
+                  hosts=2, cpu=4000, mem=8192))
+        await sched.start()
+        try:
+            req = ContainerRequest(container_id="g1", stub_id="s1",
+                                   cpu_millicores=500, memory_mb=512,
+                                   tpu="v5p-8")
+            await sched.run(req)
+            for _ in range(200):
+                if sched.stats["gangs_scheduled"]:
+                    break
+                await asyncio.sleep(0.01)
+            assert sched.stats["gangs_scheduled"] == 1
+            r0 = await workers.read_requests("h0", timeout=0.5)
+            r1 = await workers.read_requests("h1", timeout=0.5)
+            assert r0 and r1
+            g0, g1 = r0[0][1].gang, r1[0][1].gang
+            assert g0.gang_id == g1.gang_id
+            assert {g0.rank, g1.rank} == {0, 1}
+            assert g0.coordinator_addr == g1.coordinator_addr
+            assert g0.coordinator_addr.startswith("10.0.0.0:")
+            # chips reserved on both hosts
+            assert (await workers.get("h0")).tpu_free_chips == 0
+            assert (await workers.get("h1")).tpu_free_chips == 0
+        finally:
+            await sched.stop()
+
+    async def test_retry_then_fail(self):
+        store, sched = await self._scheduler()
+        sched.cfg.max_retries = 2
+        await sched.start()
+        try:
+            req = ContainerRequest(container_id="c1", stub_id="s1",
+                                   cpu_millicores=1000, memory_mb=1024,
+                                   pool_selector="nope")
+            await sched.run(req)
+            for _ in range(300):
+                if sched.stats["failed"]:
+                    break
+                await asyncio.sleep(0.02)
+            assert sched.stats["failed"] == 1
+            exit_info = await ContainerRepository(store).get_exit("c1")
+            assert "scheduler_failed" in exit_info["reason"]
+        finally:
+            await sched.stop()
+
+    async def test_pool_scale_up_called(self):
+        calls = []
+
+        class FakePool:
+            async def can_host(self, request):
+                return True
+
+            async def add_worker(self, request):
+                calls.append(request.container_id)
+
+        store, sched = await self._scheduler(pools={"default": FakePool()})
+        await sched.start()
+        try:
+            req = ContainerRequest(container_id="c1", stub_id="s1",
+                                   cpu_millicores=100, memory_mb=128)
+            await sched.run(req)
+            for _ in range(100):
+                if calls:
+                    break
+                await asyncio.sleep(0.01)
+            assert "c1" in calls
+        finally:
+            await sched.stop()
+
+
+class TestLocalPool:
+    async def test_multihost_scaleup_creates_slice(self):
+        created = []
+
+        async def factory(**kw):
+            created.append(kw)
+
+            class FakeWorker:
+                async def stop(self):
+                    pass
+            return FakeWorker()
+
+        pool = LocalProcessPool(
+            WorkerPoolConfig(name="tpu", tpu_type="v5p-64", max_workers=64),
+            factory)
+        req = ContainerRequest(tpu="v5p-8", cpu_millicores=100, memory_mb=128)
+        assert await pool.can_host(req)
+        await pool.add_worker(req)
+        assert len(created) == 2
+        assert created[0]["slice_id"] == created[1]["slice_id"]
+        assert [c["slice_host_rank"] for c in created] == [0, 1]
+        await pool.shutdown()

@@ -1,0 +1,6 @@
+from .autoscaler import Autoscaler, AutoscaleResult, AutoscaleSample
+from .instance import AutoscaledInstance
+from .buffer import RequestBuffer
+
+__all__ = ["Autoscaler", "AutoscaleResult", "AutoscaleSample",
+           "AutoscaledInstance", "RequestBuffer"]

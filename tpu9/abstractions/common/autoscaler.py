@@ -1,0 +1,126 @@
+"""Generic autoscaler.
+
+Reference analogue: ``pkg/abstractions/common/autoscaler.go:13-60`` — generic
+``Autoscaler[I,S]`` sampling at 1 Hz into a 60-sample window and emitting
+desired-container counts. Sampling and deciding are injected callables so
+every abstraction (endpoint queue depth, task-queue depth/ratio, pod LLM
+token pressure) reuses the same loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional
+
+SAMPLE_HZ = 1.0
+WINDOW = 60
+
+
+@dataclass
+class AutoscaleSample:
+    queue_depth: int = 0
+    active_containers: int = 0
+    pressure: float = 0.0
+    ts: float = 0.0
+
+
+@dataclass
+class AutoscaleResult:
+    desired: int
+    reason: str = ""
+
+
+SampleFn = Callable[[], Awaitable[AutoscaleSample]]
+DecideFn = Callable[[deque], AutoscaleResult]
+ApplyFn = Callable[[AutoscaleResult], Awaitable[None]]
+
+
+def queue_depth_policy(max_containers: int, tasks_per_container: int = 1,
+                       min_containers: int = 0) -> DecideFn:
+    """Desired = ceil(backlog / tasks_per_container), clamped. The sample's
+    queue depth already includes in-flight work for endpoint buffers."""
+
+    def decide(samples: deque) -> AutoscaleResult:
+        if not samples:
+            return AutoscaleResult(desired=min_containers, reason="no samples")
+        latest = samples[-1]
+        need = -(-latest.queue_depth // max(tasks_per_container, 1))
+        desired = max(min_containers, min(max_containers, need))
+        return AutoscaleResult(desired=desired,
+                               reason=f"depth={latest.queue_depth}")
+
+    return decide
+
+
+def token_pressure_policy(max_containers: int, max_pressure: float = 0.85,
+                          min_containers: int = 0) -> DecideFn:
+    """LLM-aware policy (reference pod/llm.go + LLMTokenPressureAutoscaler,
+    sdk type.py:309): scale up while observed KV-pressure exceeds the
+    threshold, scale down when the fleet is cold."""
+
+    def decide(samples: deque) -> AutoscaleResult:
+        if not samples:
+            return AutoscaleResult(desired=min_containers, reason="no samples")
+        latest = samples[-1]
+        desired = latest.active_containers
+        if latest.pressure > max_pressure or (
+                latest.active_containers == 0 and latest.queue_depth > 0):
+            desired = latest.active_containers + 1
+        elif latest.pressure < max_pressure / 4 and latest.queue_depth == 0:
+            desired = latest.active_containers - 1
+        desired = max(min_containers, min(max_containers, desired))
+        return AutoscaleResult(desired=desired,
+                               reason=f"pressure={latest.pressure:.2f}")
+
+    return decide
+
+
+class Autoscaler:
+    def __init__(self, sample: SampleFn, decide: DecideFn, apply: ApplyFn,
+                 interval_s: float = 1.0 / SAMPLE_HZ):
+        self.sample = sample
+        self.decide = decide
+        self.apply = apply
+        self.interval_s = interval_s
+        self.samples: deque = deque(maxlen=WINDOW)
+        self._task: Optional[asyncio.Task] = None
+        self.last_result: Optional[AutoscaleResult] = None
+
+    async def start(self) -> "Autoscaler":
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def step(self) -> AutoscaleResult:
+        """One sample→decide→apply cycle (tests drive this directly)."""
+        s = await self.sample()
+        s.ts = time.time()
+        self.samples.append(s)
+        result = self.decide(self.samples)
+        self.last_result = result
+        await self.apply(result)
+        return result
+
+    async def _loop(self) -> None:
+        import logging
+        log = logging.getLogger("tpu9.abstractions")
+        while True:
+            try:
+                await self.step()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("autoscaler step failed")
+            await asyncio.sleep(self.interval_s)

@@ -1,0 +1,169 @@
+"""Request buffer: holds client requests, discovers ready containers, and
+forwards with per-container concurrency admission.
+
+Reference analogue: ``pkg/abstractions/endpoint/buffer.go`` — request ring,
+container discovery via address keys + health probes (:303,334,359),
+per-container concurrency tokens (:457-506), reverse proxying (:666). tpu9's
+buffer forwards JSON/bytes bodies over aiohttp and exposes wait-slots the
+autoscaler samples as queue depth.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import aiohttp
+
+from ...repository import ContainerRepository
+from ...types import ContainerStatus, Stub
+
+log = logging.getLogger("tpu9.abstractions")
+
+
+@dataclass
+class BufferedRequest:
+    method: str = "POST"
+    path: str = "/"
+    headers: dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+    enqueued_at: float = field(default_factory=time.monotonic)
+    future: Optional[asyncio.Future] = None
+
+
+@dataclass
+class ForwardResult:
+    status: int
+    body: bytes
+    headers: dict[str, str] = field(default_factory=dict)
+    container_id: str = ""
+
+
+class RequestBuffer:
+    def __init__(self, stub: Stub, containers: ContainerRepository,
+                 request_timeout_s: float = 180.0):
+        self.stub = stub
+        self.containers = containers
+        self.request_timeout_s = request_timeout_s
+        self._queue: asyncio.Queue[BufferedRequest] = asyncio.Queue()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._task: Optional[asyncio.Task] = None
+        self._inflight = 0
+        self._open = 0     # unresolved requests: queued + in-hand + in-flight
+
+    @property
+    def depth(self) -> int:
+        """Open (unresolved) requests — the autoscaler's queue-depth signal.
+        Counts requests the loop is holding between queue and container too,
+        otherwise a request waiting for the first container to exist is
+        invisible and scale-from-zero never triggers."""
+        return self._open
+
+    async def start(self) -> "RequestBuffer":
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        if self._task is None:
+            self._task = asyncio.create_task(self._process_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._session:
+            await self._session.close()
+            self._session = None
+
+    # -- public forwarding API -----------------------------------------------
+
+    async def forward(self, method: str = "POST", path: str = "/",
+                      headers: Optional[dict[str, str]] = None,
+                      body: bytes = b"") -> ForwardResult:
+        req = BufferedRequest(method=method, path=path,
+                              headers=dict(headers or {}), body=body,
+                              future=asyncio.get_running_loop().create_future())
+        self._open += 1
+        req.future.add_done_callback(lambda _f: self._dec_open())
+        await self._queue.put(req)
+        try:
+            return await asyncio.wait_for(req.future, self.request_timeout_s)
+        except asyncio.TimeoutError:
+            if not req.future.done():
+                req.future.cancel()
+            return ForwardResult(status=504, body=b'{"error":"request timed out"}')
+
+    def _dec_open(self) -> None:
+        self._open -= 1
+
+    # -- hot loop --------------------------------------------------------------
+
+    async def _process_loop(self) -> None:
+        assert self._session is not None
+        while True:
+            req = await self._queue.get()
+            if req.future is not None and req.future.done():
+                continue   # caller gave up (timeout/cancel) while queued
+            if (time.monotonic() - req.enqueued_at) > self.request_timeout_s:
+                if req.future and not req.future.done():
+                    req.future.set_result(ForwardResult(
+                        status=504, body=b'{"error":"expired in queue"}'))
+                continue
+            target = await self._acquire_container()
+            if target is None:
+                # no capacity yet — requeue and give the autoscaler a beat
+                await asyncio.sleep(0.05)
+                await self._queue.put(req)
+                continue
+            container_id, address = target
+            self._inflight += 1
+            asyncio.create_task(self._forward_one(req, container_id, address))
+
+    async def _acquire_container(self) -> Optional[tuple[str, str]]:
+        """Discover RUNNING containers and grab a concurrency token on one
+        (random order → load spread; token caps per-container concurrency)."""
+        states = await self.containers.containers_by_stub(
+            self.stub.stub_id, status=ContainerStatus.RUNNING.value)
+        random.shuffle(states)
+        limit = max(self.stub.config.concurrent_requests, 1)
+        for s in states:
+            address = s.address or await self.containers.get_address(
+                s.container_id)
+            if not address:
+                continue
+            if await self.containers.acquire_request_token(
+                    self.stub.stub_id, s.container_id, limit):
+                return s.container_id, address
+        return None
+
+    async def _forward_one(self, req: BufferedRequest, container_id: str,
+                           address: str) -> None:
+        assert self._session is not None
+        url = f"http://{address}{req.path}"
+        try:
+            async with self._session.request(
+                    req.method, url, data=req.body or None,
+                    headers=req.headers,
+                    timeout=aiohttp.ClientTimeout(total=self.request_timeout_s)
+            ) as resp:
+                body = await resp.read()
+                result = ForwardResult(status=resp.status, body=body,
+                                       headers=dict(resp.headers),
+                                       container_id=container_id)
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
+            result = ForwardResult(status=502,
+                                   body=f'{{"error":"{type(exc).__name__}"}}'.encode(),
+                                   container_id=container_id)
+        finally:
+            self._inflight -= 1
+            await self.containers.release_request_token(self.stub.stub_id,
+                                                        container_id)
+        if req.future and not req.future.done():
+            req.future.set_result(result)

@@ -1,0 +1,132 @@
+"""AutoscaledInstance: reconciles desired container count for one deployment.
+
+Reference analogue: ``pkg/abstractions/common/instance.go:57,217,284`` —
+holds the stub, tracks running containers, reacts to autoscaler decisions by
+starting containers through the scheduler or stopping surplus ones, and
+enforces keep-warm TTLs. The InstanceController that re-hydrates instances on
+gateway restart lives in the gateway service.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ...repository import ContainerRepository
+from ...scheduler import Scheduler
+from ...types import (ContainerRequest, ContainerStatus, Stub, StopReason,
+                      new_id)
+from .autoscaler import Autoscaler, AutoscaleResult, AutoscaleSample
+
+log = logging.getLogger("tpu9.abstractions")
+
+
+class AutoscaledInstance:
+    def __init__(self, stub: Stub, scheduler: Scheduler,
+                 containers: ContainerRepository,
+                 decide_policy, sample_extra=None,
+                 entrypoint: Optional[list[str]] = None,
+                 pool_selector: str = ""):
+        self.stub = stub
+        self.scheduler = scheduler
+        self.containers = containers
+        self.pool_selector = pool_selector
+        self.entrypoint = entrypoint or []
+        self._sample_extra = sample_extra   # async () -> (queue_depth, pressure)
+        self.autoscaler = Autoscaler(self._sample, decide_policy, self._apply)
+        self._last_active = time.monotonic()
+        self.failure_streak = 0
+
+    # -- sampling ------------------------------------------------------------
+
+    async def _sample(self) -> AutoscaleSample:
+        active = await self.containers.active_count_by_stub(self.stub.stub_id)
+        depth, pressure = 0, 0.0
+        if self._sample_extra is not None:
+            depth, pressure = await self._sample_extra()
+        if depth > 0:
+            # warmth is traffic, not container existence — refreshing on
+            # active>0 would block scale-to-zero forever
+            self._last_active = time.monotonic()
+        return AutoscaleSample(queue_depth=depth, active_containers=active,
+                               pressure=pressure)
+
+    # -- reconciliation ------------------------------------------------------
+
+    async def _apply(self, result: AutoscaleResult) -> None:
+        states = await self.containers.containers_by_stub(self.stub.stub_id)
+        running = [s for s in states
+                   if s.status in (ContainerStatus.RUNNING.value,
+                                   ContainerStatus.SCHEDULED.value,
+                                   ContainerStatus.PENDING.value)]
+        current = len(running)
+        desired = result.desired
+
+        # keep-warm: don't scale to zero until idle for keep_warm_seconds
+        cfg = self.stub.config
+        if desired == 0 and current > 0:
+            idle = time.monotonic() - self._last_active
+            if idle < cfg.keep_warm_seconds:
+                desired = min(current, max(1, cfg.autoscaler.min_containers))
+
+        if desired > current:
+            for _ in range(desired - current):
+                await self.start_container()
+        elif desired < current:
+            # stop not-yet-started containers first, then the newest RUNNING
+            # ones (oldest are warmest); PENDING has scheduled_at == 0 and
+            # must sort before any RUNNING container, not after
+            def stop_order(s):
+                not_started = s.status != ContainerStatus.RUNNING.value
+                return (not not_started, -s.scheduled_at)
+
+            surplus = sorted(running, key=stop_order)[: current - desired]
+            for s in surplus:
+                await self.scheduler.stop_container(
+                    s.container_id, reason=StopReason.SCALE_DOWN.value)
+
+    async def start_container(self) -> str:
+        cfg = self.stub.config
+        request = ContainerRequest(
+            container_id=new_id("ct"),
+            stub_id=self.stub.stub_id,
+            workspace_id=self.stub.workspace_id,
+            stub_type=self.stub.stub_type,
+            cpu_millicores=cfg.runtime.cpu_millicores,
+            memory_mb=cfg.runtime.memory_mb,
+            tpu=cfg.runtime.tpu,
+            image_id=cfg.runtime.image_id,
+            object_id=self.stub.object_id,
+            entrypoint=self.entrypoint,
+            env=self._runner_env(),
+            pool_selector=self.pool_selector,
+        )
+        await self.scheduler.run(request)
+        return request.container_id
+
+    def _runner_env(self) -> dict[str, str]:
+        cfg = self.stub.config
+        env = dict(cfg.env)
+        env.update({
+            "TPU9_HANDLER": cfg.handler,
+            "TPU9_STUB_TYPE": self.stub.stub_type,
+            "TPU9_CONCURRENT_REQUESTS": str(cfg.concurrent_requests),
+            "TPU9_WORKERS": str(cfg.workers),
+            "TPU9_TIMEOUT_S": str(cfg.timeout_s),
+        })
+        return env
+
+    async def start(self) -> "AutoscaledInstance":
+        await self.autoscaler.start()
+        return self
+
+    async def stop(self) -> None:
+        await self.autoscaler.stop()
+
+    async def drain(self) -> None:
+        await self.stop()
+        for s in await self.containers.containers_by_stub(self.stub.stub_id):
+            await self.scheduler.stop_container(
+                s.container_id, reason=StopReason.SCALE_DOWN.value)

@@ -1,0 +1,104 @@
+"""Endpoint abstraction: synchronous HTTP serving with autoscale-from-zero.
+
+Reference analogue: ``pkg/abstractions/endpoint/`` — HTTP routes per
+deployment (http.go:20-30), lazy instance creation (endpoint.go:241),
+RequestBuffer forwarding, queue-depth autoscaler. ASGI/realtime stubs ride
+the same path (the runner hosts the user app; websockets proxy through the
+gateway route).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..backend import BackendDB
+from ..repository import ContainerRepository
+from ..scheduler import Scheduler
+from ..types import AutoscalerType, Stub
+from .common.autoscaler import queue_depth_policy, token_pressure_policy
+from .common.buffer import ForwardResult, RequestBuffer
+from .common.instance import AutoscaledInstance
+
+log = logging.getLogger("tpu9.abstractions")
+
+
+class EndpointService:
+    def __init__(self, backend: BackendDB, scheduler: Scheduler,
+                 containers: ContainerRepository):
+        self.backend = backend
+        self.scheduler = scheduler
+        self.containers = containers
+        self.instances: dict[str, "EndpointInstance"] = {}
+        self._locks: dict[str, asyncio.Lock] = {}
+
+    async def get_or_create_instance(self, stub: Stub) -> "EndpointInstance":
+        inst = self.instances.get(stub.stub_id)
+        if inst is not None:
+            return inst
+        lock = self._locks.setdefault(stub.stub_id, asyncio.Lock())
+        async with lock:
+            inst = self.instances.get(stub.stub_id)
+            if inst is None:
+                inst = EndpointInstance(stub, self.scheduler, self.containers)
+                await inst.start()
+                self.instances[stub.stub_id] = inst
+        return inst
+
+    async def forward(self, stub: Stub, method: str, path: str,
+                      headers: dict, body: bytes) -> ForwardResult:
+        inst = await self.get_or_create_instance(stub)
+        return await inst.buffer.forward(method=method, path=path,
+                                         headers=headers, body=body)
+
+    async def drain_stub(self, stub_id: str) -> None:
+        inst = self.instances.pop(stub_id, None)
+        if inst:
+            await inst.shutdown()
+
+    async def shutdown(self) -> None:
+        for stub_id in list(self.instances):
+            await self.drain_stub(stub_id)
+
+
+class EndpointInstance:
+    """One deployment's serving state: buffer + autoscaled containers."""
+
+    def __init__(self, stub: Stub, scheduler: Scheduler,
+                 containers: ContainerRepository):
+        self.stub = stub
+        self.buffer = RequestBuffer(stub, containers,
+                                    request_timeout_s=stub.config.timeout_s)
+        a = stub.config.autoscaler
+        if a.type == AutoscalerType.TOKEN_PRESSURE.value:
+            policy = token_pressure_policy(a.max_containers,
+                                           a.max_token_pressure,
+                                           a.min_containers)
+        else:
+            policy = queue_depth_policy(a.max_containers,
+                                        a.tasks_per_container,
+                                        a.min_containers)
+        self.instance = AutoscaledInstance(
+            stub, scheduler, containers, policy,
+            sample_extra=self._sample_extra)
+        self._containers = containers
+
+    async def _sample_extra(self):
+        """Queue depth + pressure. Pressure = fleet saturation: open requests
+        over total concurrency slots (LLM runners additionally report real
+        KV-cache pressure through their health stats, which supersedes this
+        proxy when present)."""
+        depth = self.buffer.depth
+        active = await self._containers.active_count_by_stub(self.stub.stub_id)
+        slots = max(active, 1) * max(self.stub.config.concurrent_requests, 1)
+        pressure = min(depth / slots, 1.0) if active else (1.0 if depth else 0.0)
+        return depth, pressure
+
+    async def start(self) -> None:
+        await self.buffer.start()
+        await self.instance.start()
+
+    async def shutdown(self) -> None:
+        await self.buffer.stop()
+        await self.instance.drain()

@@ -43,7 +43,7 @@ class GatewayConfig:
 class SchedulerConfig:
     loop_interval_s: float = 0.05   # reference: 50ms batch loop scheduler.go:28
     batch_size: int = 512
-    max_retries: int = 3
+    max_retries: int = 12           # with backoff ≈ 1 min of provisioning grace
     backlog_warning_depth: int = 1000
     gang_reservation_ttl_s: float = 30.0
 

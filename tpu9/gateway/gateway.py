@@ -1,0 +1,365 @@
+"""The tpu9 gateway (control plane).
+
+Reference analogue: ``pkg/gateway/gateway.go`` — boots repositories,
+scheduler, abstraction services; serves the SDK API + REST + invoke routes;
+re-hydrates deployments on restart (InstanceController, instance.go:444);
+drains before shutdown. One process, one port, embedded state server for
+workers to join (the reference serves repos to workers over gRPC the same
+way, gateway.go:353).
+
+Route map:
+  /api/v1/...                REST management API (auth: workspace token)
+  /rpc/...                   SDK RPC (JSON bodies; auth: workspace token)
+  /endpoint/{name}[/...]     invoke active deployment by name
+  /health                    unauthenticated liveness
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from ..abstractions.endpoint import EndpointService
+from ..backend import BackendDB
+from ..config import AppConfig
+from ..repository import ContainerRepository, TaskRepository, WorkerRepository
+from ..scheduler import Scheduler
+from ..statestore import MemoryStore, RemoteStore, StateServer, StateStore
+from ..types import Stub, StubConfig, StubType, Workspace
+
+log = logging.getLogger("tpu9.gateway")
+
+
+class Gateway:
+    def __init__(self, cfg: AppConfig,
+                 store: Optional[StateStore] = None,
+                 backend: Optional[BackendDB] = None,
+                 pools: Optional[dict] = None):
+        self.cfg = cfg
+        self.store = store or MemoryStore()
+        self.backend = backend or BackendDB(cfg.database.path)
+        self.scheduler = Scheduler(self.store, cfg.scheduler, pools=pools or {})
+        self.workers = WorkerRepository(self.store, cfg.worker.keepalive_ttl_s)
+        self.containers = ContainerRepository(self.store)
+        self.tasks = TaskRepository(self.store)
+        self.endpoints = EndpointService(self.backend, self.scheduler,
+                                         self.containers)
+        self.extra_services: dict[str, object] = {}
+        self.state_server: Optional[StateServer] = None
+        self._runner: Optional[web.AppRunner] = None
+        self.port = cfg.gateway.http_port
+        self.app = self._build_app()
+
+    # ------------------------------------------------------------------
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._auth_middleware],
+                              client_max_size=512 * 1024 * 1024)
+        r = app.router
+        r.add_get("/health", self._health)
+        # SDK RPC
+        r.add_post("/rpc/auth/check", self._rpc_auth_check)
+        r.add_post("/rpc/stub/get-or-create", self._rpc_get_or_create_stub)
+        r.add_post("/rpc/object/put", self._rpc_put_object)
+        r.add_post("/rpc/deploy", self._rpc_deploy)
+        r.add_post("/rpc/serve", self._rpc_serve)
+        # REST v1 (management)
+        r.add_get("/api/v1/deployment", self._list_deployments)
+        r.add_delete("/api/v1/deployment/{id}", self._delete_deployment)
+        r.add_get("/api/v1/container", self._list_containers)
+        r.add_post("/api/v1/container/{id}/stop", self._stop_container)
+        r.add_get("/api/v1/container/{id}/logs", self._container_logs)
+        r.add_get("/api/v1/task", self._list_tasks)
+        r.add_get("/api/v1/worker", self._list_workers)
+        r.add_get("/api/v1/stub", self._list_stubs)
+        r.add_get("/api/v1/secret", self._list_secrets)
+        r.add_post("/api/v1/secret", self._upsert_secret)
+        r.add_delete("/api/v1/secret/{name}", self._delete_secret)
+        r.add_get("/api/v1/scheduler/stats", self._scheduler_stats)
+        # invoke
+        r.add_route("*", "/endpoint/{name}", self._invoke)
+        r.add_route("*", "/endpoint/{name}/{tail:.*}", self._invoke)
+        return app
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> "Gateway":
+        if isinstance(self.store, RemoteStore):
+            await self.store.connect()
+        elif isinstance(self.store, MemoryStore) and self.cfg.gateway.state_port:
+            # expose the embedded store to out-of-process workers
+            # (state_port 0 disables; -1 means "any free port")
+            port = max(self.cfg.gateway.state_port, 0)
+            self.state_server = await StateServer(
+                store=self.store, host=self.cfg.gateway.host, port=port,
+                auth_token=self.cfg.database.state_auth_token).start()
+        await self.scheduler.start()
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.cfg.gateway.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        await self._ensure_default_workspace()
+        await self._rehydrate_deployments()
+        log.info("gateway on %s:%d", self.cfg.gateway.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        await self.endpoints.shutdown()
+        await self.scheduler.stop()
+        if self._runner:
+            await self._runner.cleanup()
+        if self.state_server:
+            await self.state_server.stop()
+        await self.backend.close()
+
+    async def _ensure_default_workspace(self) -> None:
+        """Dev bootstrap: a default workspace + token, printed once
+        (the reference seeds via migrations/CLI config flow)."""
+        ws = await self.backend.get_workspace_by_name("default")
+        if ws is None:
+            ws = await self.backend.create_workspace("default")
+            tok = await self.backend.create_token(ws.workspace_id)
+            self.default_token = tok.key
+            log.info("created default workspace; token=%s", tok.key)
+        else:
+            toks = await self.backend.list_tokens(ws.workspace_id)
+            self.default_token = toks[0].key if toks else ""
+        self.default_workspace = ws
+
+    async def _rehydrate_deployments(self) -> None:
+        """Re-create autoscaled instances for active deployments after a
+        restart (instance.go:444-530)."""
+        for dep in await self.backend.list_active_deployments():
+            stub = await self.backend.get_stub(dep.stub_id)
+            if stub and stub.stub_type in (StubType.ENDPOINT.value,
+                                           StubType.ASGI.value,
+                                           StubType.REALTIME.value):
+                await self.endpoints.get_or_create_instance(stub)
+
+    # -- auth ----------------------------------------------------------------
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        if request.path in ("/health",):
+            return await handler(request)
+        token = ""
+        auth = request.headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            token = auth[len("Bearer "):]
+        tok = await self.backend.authorize_token(token) if token else None
+        if tok is None:
+            # invoke routes may be public when the stub is unauthorized
+            if request.path.startswith("/endpoint/"):
+                request["workspace"] = None
+                return await handler(request)
+            return web.json_response({"error": "unauthorized"}, status=401)
+        request["workspace"] = await self.backend.get_workspace(tok.workspace_id)
+        return await handler(request)
+
+    def _ws(self, request: web.Request) -> Workspace:
+        ws = request.get("workspace")
+        if ws is None:
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"error": "unauthorized"}),
+                content_type="application/json")
+        return ws
+
+    # -- handlers: health/misc ----------------------------------------------
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "ok": True,
+            "backlog": await self.scheduler.backlog_depth(),
+            "workers": len(await self.workers.list()),
+        })
+
+    async def _scheduler_stats(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        return web.json_response(self.scheduler.stats)
+
+    # -- handlers: SDK RPC ----------------------------------------------------
+
+    async def _rpc_auth_check(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        return web.json_response({"workspace_id": ws.workspace_id,
+                                  "workspace_name": ws.name})
+
+    async def _rpc_get_or_create_stub(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        data = await request.json()
+        config = StubConfig.from_dict(data.get("config", {}))
+        stub = await self.backend.get_or_create_stub(
+            workspace_id=ws.workspace_id,
+            name=data["name"],
+            stub_type=data["stub_type"],
+            config=config,
+            object_id=data.get("object_id", ""),
+            app_name=data.get("app_name", ""),
+            force_create=data.get("force_create", False))
+        return web.json_response({"stub_id": stub.stub_id})
+
+    async def _rpc_put_object(self, request: web.Request) -> web.Response:
+        """Workspace code upload (reference PutObjectStream, gateway.proto:36).
+        Body: raw zip bytes; dedupe by hash."""
+        ws = self._ws(request)
+        body = await request.read()
+        obj_hash = hashlib.sha256(body).hexdigest()
+        existing = await self.backend.find_object_by_hash(ws.workspace_id,
+                                                          obj_hash)
+        if existing:
+            return web.json_response({"object_id": existing["object_id"],
+                                      "deduped": True})
+        objects_dir = os.path.join(self.cfg.storage.local_root,
+                                   ws.workspace_id, "objects")
+        os.makedirs(objects_dir, exist_ok=True)
+        path = os.path.join(objects_dir, f"{obj_hash}.zip")
+        with open(path, "wb") as f:
+            f.write(body)
+        object_id = await self.backend.create_object(ws.workspace_id, obj_hash,
+                                                     len(body), path)
+        return web.json_response({"object_id": object_id, "deduped": False})
+
+    async def _rpc_deploy(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        data = await request.json()
+        stub = await self.backend.get_stub(data["stub_id"])
+        if stub is None or stub.workspace_id != ws.workspace_id:
+            return web.json_response({"error": "stub not found"}, status=404)
+        dep = await self.backend.create_deployment(
+            ws.workspace_id, data["name"], stub.stub_id, app_id=stub.app_id)
+        # warm the instance immediately (InstanceController warmup)
+        if stub.stub_type in (StubType.ENDPOINT.value, StubType.ASGI.value,
+                              StubType.REALTIME.value):
+            await self.endpoints.get_or_create_instance(stub)
+        invoke_url = (f"http://{self.cfg.gateway.host}:{self.port}"
+                      f"/endpoint/{dep.name}")
+        return web.json_response({"deployment_id": dep.deployment_id,
+                                  "version": dep.version,
+                                  "invoke_url": invoke_url})
+
+    async def _rpc_serve(self, request: web.Request) -> web.Response:
+        """Ephemeral serve session (dev loop): like deploy but not persisted
+        as active; returns the stub routing handle."""
+        ws = self._ws(request)
+        data = await request.json()
+        stub = await self.backend.get_stub(data["stub_id"])
+        if stub is None or stub.workspace_id != ws.workspace_id:
+            return web.json_response({"error": "stub not found"}, status=404)
+        await self.endpoints.get_or_create_instance(stub)
+        return web.json_response({"ok": True, "stub_id": stub.stub_id})
+
+    # -- handlers: invoke ------------------------------------------------------
+
+    async def _invoke(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        tail = request.match_info.get("tail", "")
+        ws = request.get("workspace")
+        workspace_id = ws.workspace_id if ws else None
+
+        dep = None
+        if workspace_id:
+            dep = await self.backend.get_deployment(workspace_id, name)
+        if dep is None:
+            dep = await self.backend.get_deployment_by_subdomain(name)
+        if dep is None and not workspace_id:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        if dep is None:
+            return web.json_response({"error": f"no deployment {name!r}"},
+                                     status=404)
+        stub = await self.backend.get_stub(dep.stub_id)
+        if stub is None:
+            return web.json_response({"error": "stub missing"}, status=500)
+        if stub.config.authorized and (ws is None or
+                                       ws.workspace_id != stub.workspace_id):
+            return web.json_response({"error": "unauthorized"}, status=401)
+
+        body = await request.read()
+        result = await self.endpoints.forward(
+            stub, request.method, "/" + tail if tail else "/",
+            {"Content-Type": request.headers.get("Content-Type",
+                                                 "application/json")},
+            body)
+        # preserve the container's content type (ASGI apps return HTML/SSE/…)
+        content_type = result.headers.get("Content-Type", "application/json")
+        resp = web.Response(status=result.status, body=result.body)
+        resp.headers["Content-Type"] = content_type
+        return resp
+
+    # -- handlers: REST v1 ----------------------------------------------------
+
+    async def _list_deployments(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        deps = await self.backend.list_deployments(ws.workspace_id)
+        return web.json_response([d.to_dict() for d in deps])
+
+    async def _delete_deployment(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        dep = await self.backend.get_deployment_by_id(request.match_info["id"])
+        if dep is None or dep.workspace_id != ws.workspace_id:
+            return web.json_response({"error": "not found"}, status=404)
+        await self.backend.set_deployment_active(dep.deployment_id, False)
+        await self.endpoints.drain_stub(dep.stub_id)
+        return web.json_response({"ok": True})
+
+    async def _list_containers(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        out = []
+        for stub in await self.backend.list_stubs(ws.workspace_id):
+            for st in await self.containers.containers_by_stub(stub.stub_id):
+                out.append(st.to_dict())
+        return web.json_response(out)
+
+    async def _stop_container(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        ok = await self.scheduler.stop_container(request.match_info["id"])
+        return web.json_response({"ok": ok})
+
+    async def _container_logs(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        entries = await self.containers.read_logs(request.match_info["id"])
+        return web.json_response(
+            [{"id": eid, **e} for eid, e in entries])
+
+    async def _list_tasks(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        return web.json_response(await self.backend.list_tasks(ws.workspace_id))
+
+    async def _list_workers(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        workers = await self.workers.list()
+        out = []
+        for w in workers:
+            d = w.to_dict()
+            d["alive"] = await self.workers.is_alive(w.worker_id)
+            out.append(d)
+        return web.json_response(out)
+
+    async def _list_stubs(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        return web.json_response(
+            [s.to_dict() for s in await self.backend.list_stubs(ws.workspace_id)])
+
+    async def _list_secrets(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        return web.json_response(await self.backend.list_secrets(ws.workspace_id))
+
+    async def _upsert_secret(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        data = await request.json()
+        await self.backend.upsert_secret(ws.workspace_id, data["name"],
+                                         data["value"])
+        return web.json_response({"ok": True})
+
+    async def _delete_secret(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        ok = await self.backend.delete_secret(ws.workspace_id,
+                                              request.match_info["name"])
+        return web.json_response({"ok": ok})

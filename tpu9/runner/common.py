@@ -1,0 +1,119 @@
+"""Runner plumbing shared by endpoint/taskqueue/function containers.
+
+Reference analogue: ``sdk/src/beta9/runner/common.py`` — FunctionHandler
+(loads the user handler from the synced workspace), lifecycle hooks
+(on_start), config from env. The worker injects TPU9_* env
+(lifecycle.py:_spec_from_request); this module is the consumer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+import inspect
+import json
+import os
+import sys
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class RunnerConfig:
+    container_id: str = ""
+    stub_id: str = ""
+    workspace_id: str = ""
+    stub_type: str = "endpoint"
+    handler: str = ""              # "module:function"
+    port: int = 8000
+    workdir: str = ""
+    concurrent_requests: int = 1
+    workers: int = 1
+    timeout_s: float = 180.0
+    extra: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "RunnerConfig":
+        e = env if env is not None else os.environ
+        return cls(
+            container_id=e.get("TPU9_CONTAINER_ID", ""),
+            stub_id=e.get("TPU9_STUB_ID", ""),
+            workspace_id=e.get("TPU9_WORKSPACE_ID", ""),
+            stub_type=e.get("TPU9_STUB_TYPE", "endpoint"),
+            handler=e.get("TPU9_HANDLER", ""),
+            port=int(e.get("TPU9_PORT", "8000")),
+            workdir=e.get("TPU9_WORKDIR", os.getcwd()),
+            concurrent_requests=int(e.get("TPU9_CONCURRENT_REQUESTS", "1")),
+            workers=int(e.get("TPU9_WORKERS", "1")),
+            timeout_s=float(e.get("TPU9_TIMEOUT_S", "180")),
+        )
+
+
+class FunctionHandler:
+    """Loads and invokes the user handler with on_start lifecycle support."""
+
+    def __init__(self, cfg: RunnerConfig):
+        self.cfg = cfg
+        self.fn: Optional[Callable] = None
+        self.context: Any = None
+
+    def load(self) -> Callable:
+        if self.fn is not None:
+            return self.fn
+        if self.cfg.workdir and self.cfg.workdir not in sys.path:
+            sys.path.insert(0, self.cfg.workdir)
+        module_name, _, attr = self.cfg.handler.partition(":")
+        if not module_name or not attr:
+            raise ValueError(f"bad handler spec {self.cfg.handler!r}")
+        module = importlib.import_module(module_name)
+        target = getattr(module, attr)
+        # unwrap SDK decorator objects to the raw callable
+        fn = getattr(target, "func", None) or getattr(target, "__wrapped__",
+                                                      None) or target
+        if not callable(fn):
+            raise TypeError(f"handler {self.cfg.handler!r} is not callable")
+        on_start = getattr(target, "on_start", None)
+        if callable(on_start):
+            self.context = on_start()
+        self.fn = fn
+        return fn
+
+    async def call(self, *args: Any, **kwargs: Any) -> Any:
+        fn = self.load()
+        sig_kwargs = dict(kwargs)
+        if self.context is not None:
+            try:
+                if "context" in inspect.signature(fn).parameters:
+                    sig_kwargs["context"] = self.context
+            except (TypeError, ValueError):
+                pass
+        if inspect.iscoroutinefunction(fn):
+            return await fn(*args, **sig_kwargs)
+        return await asyncio.to_thread(fn, *args, **sig_kwargs)
+
+
+def error_payload(exc: BaseException) -> dict:
+    return {"error": f"{type(exc).__name__}: {exc}",
+            "traceback": traceback.format_exc(limit=20)}
+
+
+def json_default(obj: Any) -> Any:
+    """Serialize common scientific types transparently."""
+    try:
+        import numpy as np
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        if isinstance(obj, (np.integer,)):
+            return int(obj)
+        if isinstance(obj, (np.floating,)):
+            return float(obj)
+    except ImportError:
+        pass
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    raise TypeError(f"not JSON serializable: {type(obj)}")
+
+
+def dumps(obj: Any) -> str:
+    return json.dumps(obj, default=json_default)

@@ -1,0 +1,92 @@
+"""Endpoint runner: the HTTP server the worker execs inside an endpoint
+container.
+
+Reference analogue: ``sdk/src/beta9/runner/endpoint.py`` (gunicorn+uvicorn
+ASGI host). tpu9's variant is a single aiohttp process (workers>1 scales via
+containers, which is where TPU workloads want isolation anyway):
+
+- ``POST /``      → call the user handler with the JSON body as kwargs
+- ``GET /health`` → 200 once the handler (and its on_start) is loaded
+- ASGI stubs: if the loaded object is an ASGI app, requests are dispatched
+  through it instead of the function path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import sys
+
+from aiohttp import web
+
+from .common import FunctionHandler, RunnerConfig, dumps, error_payload
+
+log = logging.getLogger("tpu9.runner")
+
+
+def build_app(cfg: RunnerConfig) -> web.Application:
+    handler = FunctionHandler(cfg)
+    state = {"ready": False, "inflight": 0}
+
+    async def on_startup(app):
+        # load (and run on_start) off the event loop, then flip readiness —
+        # the worker's readiness probe gates traffic on this
+        def load():
+            handler.load()
+        await asyncio.to_thread(load)
+        state["ready"] = True
+        log.info("handler %s ready", cfg.handler)
+
+    async def health(request: web.Request) -> web.Response:
+        if not state["ready"]:
+            return web.json_response({"ready": False}, status=503)
+        return web.json_response({"ready": True, "inflight": state["inflight"]})
+
+    async def invoke(request: web.Request) -> web.Response:
+        if not state["ready"]:
+            return web.json_response({"error": "not ready"}, status=503)
+        try:
+            raw = await request.read()
+            payload = json.loads(raw) if raw else {}
+            if not isinstance(payload, dict):
+                payload = {"input": payload}
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        state["inflight"] += 1
+        try:
+            result = await asyncio.wait_for(handler.call(**payload),
+                                            timeout=cfg.timeout_s)
+            return web.Response(text=dumps(result),
+                                content_type="application/json")
+        except asyncio.TimeoutError:
+            return web.json_response({"error": "handler timed out"}, status=504)
+        except TypeError as exc:
+            return web.json_response({"error": f"bad arguments: {exc}"},
+                                     status=400)
+        except Exception as exc:  # user-code failure → 500 with traceback
+            return web.json_response(error_payload(exc), status=500)
+        finally:
+            state["inflight"] -= 1
+
+    app = web.Application(client_max_size=512 * 1024 * 1024)
+    app.on_startup.append(on_startup)
+    app.router.add_get("/health", health)
+    app.router.add_route("*", "/", invoke)
+    app.router.add_route("*", "/{tail:.*}", invoke)
+    return app
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    cfg = RunnerConfig.from_env()
+    if not cfg.handler:
+        print("TPU9_HANDLER not set", file=sys.stderr)
+        sys.exit(2)
+    app = build_app(cfg)
+    web.run_app(app, host="127.0.0.1", port=cfg.port, print=None,
+                handle_signals=True)
+
+
+if __name__ == "__main__":
+    main()

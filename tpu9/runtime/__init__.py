@@ -1,0 +1,16 @@
+from .base import ContainerHandle, Runtime, RuntimeState
+from .process import ProcessRuntime
+from .runc import RuncRuntime
+
+__all__ = ["Runtime", "ContainerHandle", "RuntimeState", "ProcessRuntime",
+           "RuncRuntime"]
+
+
+def new_runtime(kind: str, **kw) -> Runtime:
+    """Factory, analogue of the reference's ``runtime.New``
+    (pkg/runtime/runtime.go:141)."""
+    if kind == "process":
+        return ProcessRuntime(**kw)
+    if kind == "runc":
+        return RuncRuntime(**kw)
+    raise ValueError(f"unknown runtime {kind!r}")

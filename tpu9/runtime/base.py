@@ -1,0 +1,76 @@
+"""Container runtime abstraction.
+
+Reference analogue: the ``Runtime`` interface
+(``pkg/runtime/runtime.go:87-128``: Run/Exec/Kill/Delete/State/Events/
+Checkpoint/Restore/Capabilities) backed by runc/runsc/docker. tpu9 ships two
+implementations:
+
+- :class:`tpu9.runtime.process.ProcessRuntime` — containers as supervised
+  host processes in per-container sandboxes (rootless dev/test/bench path;
+  also how BYOC hosts without runc run).
+- :class:`tpu9.runtime.runc.RuncRuntime` — OCI containers via a runc binary
+  with synthesized specs (the production path on TPU VM workers).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class RuntimeState(str, enum.Enum):
+    CREATING = "creating"
+    RUNNING = "running"
+    STOPPED = "stopped"
+    FAILED = "failed"
+
+
+@dataclass
+class ContainerSpec:
+    """Runtime-agnostic spec, synthesized by the worker lifecycle from a
+    ContainerRequest (analogue of OCI-spec synthesis, lifecycle.go:766)."""
+
+    container_id: str
+    entrypoint: list[str]
+    env: dict[str, str] = field(default_factory=dict)
+    workdir: str = "/"
+    rootfs: str = ""                  # image bundle dir ("" = host fs)
+    mounts: list[tuple[str, str, bool]] = field(default_factory=list)  # (src, dst, ro)
+    cpu_millicores: int = 0
+    memory_mb: int = 0
+    devices: list[str] = field(default_factory=list)   # e.g. /dev/accel0
+    ports: dict[int, int] = field(default_factory=dict)  # container -> host
+
+
+@dataclass
+class ContainerHandle:
+    container_id: str
+    pid: int = 0
+    state: RuntimeState = RuntimeState.CREATING
+    exit_code: Optional[int] = None
+    meta: dict[str, Any] = field(default_factory=dict)
+
+
+class Runtime:
+    name = "base"
+
+    async def run(self, spec: ContainerSpec, log_cb=None) -> ContainerHandle:
+        """Start the container; ``log_cb(line, stream)`` receives output."""
+        raise NotImplementedError
+
+    async def kill(self, container_id: str, signal_num: int = 15) -> bool:
+        raise NotImplementedError
+
+    async def state(self, container_id: str) -> Optional[ContainerHandle]:
+        raise NotImplementedError
+
+    async def wait(self, container_id: str) -> int:
+        """Block until exit; returns exit code."""
+        raise NotImplementedError
+
+    async def exec(self, container_id: str, cmd: list[str]) -> tuple[int, str]:
+        raise NotImplementedError
+
+    def capabilities(self) -> set[str]:
+        return set()

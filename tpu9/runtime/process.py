@@ -1,0 +1,161 @@
+"""Process runtime: containers as supervised host subprocesses.
+
+Each container gets a private sandbox dir (scratch + workspace), its env is
+fully specified (no inheritance beyond an allowlist), stdout/stderr stream to
+the worker's log callback, and resource limits are applied via RLIMIT where
+the platform allows. This is the rootless path the test suite, the bench
+cold-start harness, and dev machines use; runc swaps in transparently on
+real workers (same ContainerSpec).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import resource
+import shutil
+import signal
+from typing import Optional
+
+from .base import ContainerHandle, ContainerSpec, Runtime, RuntimeState
+
+_ENV_ALLOWLIST = ("PATH", "HOME", "LANG", "TERM")
+
+
+class ProcessRuntime(Runtime):
+    name = "process"
+
+    def __init__(self, base_dir: str = "/tmp/tpu9/containers") -> None:
+        self.base_dir = base_dir
+        self._procs: dict[str, asyncio.subprocess.Process] = {}
+        self._handles: dict[str, ContainerHandle] = {}
+        self._waiters: dict[str, asyncio.Task] = {}
+        self._log_tasks: dict[str, list[asyncio.Task]] = {}
+
+    def sandbox_dir(self, container_id: str) -> str:
+        return os.path.join(self.base_dir, container_id)
+
+    async def run(self, spec: ContainerSpec, log_cb=None) -> ContainerHandle:
+        sandbox = self.sandbox_dir(spec.container_id)
+        os.makedirs(sandbox, exist_ok=True)
+
+        env = {k: v for k in _ENV_ALLOWLIST
+               if (v := os.environ.get(k)) is not None}
+        env.update(spec.env)
+        env.setdefault("TPU9_SANDBOX", sandbox)
+
+        workdir = spec.workdir if spec.workdir not in ("", "/") else sandbox
+
+        limit_bytes = spec.memory_mb * 1024 * 1024 if spec.memory_mb else 0
+
+        def preexec() -> None:
+            os.setsid()  # own process group so kill() reaps the whole tree
+            if limit_bytes:
+                try:
+                    resource.setrlimit(resource.RLIMIT_AS,
+                                       (limit_bytes, limit_bytes))
+                except (ValueError, OSError):
+                    pass
+
+        proc = await asyncio.create_subprocess_exec(
+            *spec.entrypoint, cwd=workdir, env=env,
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
+            preexec_fn=preexec)
+
+        handle = ContainerHandle(container_id=spec.container_id, pid=proc.pid,
+                                 state=RuntimeState.RUNNING)
+        self._procs[spec.container_id] = proc
+        self._handles[spec.container_id] = handle
+
+        async def pump(stream, name):
+            while True:
+                line = await stream.readline()
+                if not line:
+                    break
+                if log_cb is not None:
+                    try:
+                        log_cb(line.decode(errors="replace").rstrip("\n"), name)
+                    except Exception:
+                        pass
+
+        self._log_tasks[spec.container_id] = [
+            asyncio.create_task(pump(proc.stdout, "stdout")),
+            asyncio.create_task(pump(proc.stderr, "stderr")),
+        ]
+
+        async def reap():
+            code = await proc.wait()
+            for t in self._log_tasks.get(spec.container_id, []):
+                try:
+                    await asyncio.wait_for(t, timeout=2.0)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    t.cancel()
+            handle.exit_code = code
+            handle.state = (RuntimeState.STOPPED if code == 0
+                            else RuntimeState.FAILED)
+
+        self._waiters[spec.container_id] = asyncio.create_task(reap())
+        return handle
+
+    async def kill(self, container_id: str, signal_num: int = 15) -> bool:
+        proc = self._procs.get(container_id)
+        if proc is None or proc.returncode is not None:
+            return False
+        try:
+            os.killpg(os.getpgid(proc.pid), signal_num)
+        except ProcessLookupError:
+            return False
+        if signal_num != signal.SIGKILL:
+            # escalate if it ignores the polite signal
+            async def escalate():
+                try:
+                    await asyncio.wait_for(proc.wait(), timeout=10.0)
+                except asyncio.TimeoutError:
+                    try:
+                        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+            asyncio.create_task(escalate())
+        return True
+
+    async def state(self, container_id: str) -> Optional[ContainerHandle]:
+        return self._handles.get(container_id)
+
+    async def wait(self, container_id: str) -> int:
+        proc = self._procs.get(container_id)
+        if proc is None:
+            handle = self._handles.get(container_id)
+            return handle.exit_code if handle and handle.exit_code is not None else -1
+        code = await proc.wait()
+        waiter = self._waiters.get(container_id)
+        if waiter:
+            try:
+                await waiter
+            except asyncio.CancelledError:
+                pass
+        return code
+
+    async def exec(self, container_id: str, cmd: list[str]) -> tuple[int, str]:
+        """Run a command in the container's sandbox/env context."""
+        handle = self._handles.get(container_id)
+        if handle is None or handle.state != RuntimeState.RUNNING:
+            return (-1, "container not running")
+        proc = await asyncio.create_subprocess_exec(
+            *cmd, cwd=self.sandbox_dir(container_id),
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
+        out, _ = await proc.communicate()
+        return (proc.returncode or 0, out.decode(errors="replace"))
+
+    async def cleanup(self, container_id: str, remove_sandbox: bool = True) -> None:
+        self._procs.pop(container_id, None)
+        self._handles.pop(container_id, None)
+        waiter = self._waiters.pop(container_id, None)
+        if waiter:
+            waiter.cancel()
+        for t in self._log_tasks.pop(container_id, []):
+            t.cancel()
+        if remove_sandbox:
+            shutil.rmtree(self.sandbox_dir(container_id), ignore_errors=True)
+
+    def capabilities(self) -> set[str]:
+        return {"exec", "logs"}

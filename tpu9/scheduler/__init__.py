@@ -1,0 +1,6 @@
+from .scheduler import Scheduler
+from .selector import filter_workers, score_worker, select_worker
+from .pools import LocalProcessPool, WorkerPoolController
+
+__all__ = ["Scheduler", "filter_workers", "score_worker", "select_worker",
+           "LocalProcessPool", "WorkerPoolController"]

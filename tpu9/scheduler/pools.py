@@ -1,0 +1,197 @@
+"""Worker pool controllers.
+
+Reference analogue: ``pkg/scheduler/pool.go:52`` WorkerPoolController and its
+implementations (k8s Jobs ``pool_local.go``, provider VMs
+``pool_provider.go``). tpu9 ships:
+
+- :class:`LocalProcessPool` — workers as in-process asyncio objects (dev,
+  tests, the bench cold-start harness; also the single-binary deployment).
+- :class:`GceTpuPool` — shapes the GCP queued-resources/TPU-VM API calls for
+  provisioning v5e/v5p slices with ICI-topology awareness. Network calls are
+  behind an injected transport so the control flow is testable in a
+  zero-egress image; on a real deployment the transport is aiohttp → GCP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Optional
+
+from ..config import WorkerPoolConfig
+from ..types import ContainerRequest, TPU_REGISTRY, new_id, parse_tpu_spec
+
+log = logging.getLogger("tpu9.scheduler")
+
+
+class WorkerPoolController:
+    name = "base"
+
+    async def can_host(self, request: ContainerRequest) -> bool:
+        raise NotImplementedError
+
+    async def add_worker(self, request: ContainerRequest) -> None:
+        """Provision capacity able to host ``request`` (async; the scheduler
+        retries the request until the worker registers)."""
+        raise NotImplementedError
+
+    async def worker_count(self) -> int:
+        raise NotImplementedError
+
+    async def shutdown(self) -> None:
+        pass
+
+
+class LocalProcessPool(WorkerPoolController):
+    """Spawns Worker objects in-process on demand.
+
+    ``worker_factory(tpu_chips)`` builds+starts a worker; the pool tracks and
+    later drains them. For multi-host specs it spawns ``spec.hosts`` workers
+    sharing a fresh slice_id (virtual slice — exactly how multi-host gangs are
+    exercised without metal)."""
+
+    name = "local"
+
+    def __init__(self, cfg: WorkerPoolConfig,
+                 worker_factory: Callable[..., Awaitable]):
+        self.cfg = cfg
+        self.worker_factory = worker_factory
+        self.workers: list = []
+        self._lock = asyncio.Lock()
+
+    async def can_host(self, request: ContainerRequest) -> bool:
+        if len(self.workers) >= self.cfg.max_workers:
+            return False
+        spec = request.tpu_spec()
+        if spec is None:
+            return True
+        pool_spec = parse_tpu_spec(self.cfg.tpu_type) if self.cfg.tpu_type else None
+        if pool_spec is None:
+            return False
+        return (pool_spec.generation == spec.generation
+                and pool_spec.chips_per_host >= spec.chips_per_host)
+
+    async def add_worker(self, request: ContainerRequest) -> None:
+        spec = request.tpu_spec()
+        async with self._lock:
+            if len(self.workers) >= self.cfg.max_workers:
+                return
+            if spec is None or not spec.multi_host:
+                chips = spec.chips_per_host if spec else 0
+                w = await self.worker_factory(
+                    pool=self.cfg.name, tpu_chips=chips,
+                    tpu_generation=spec.generation if spec else "")
+                self.workers.append(w)
+                return
+            # virtual multi-host slice: N workers sharing a slice id
+            slice_id = new_id("slice")
+            for rank in range(spec.hosts):
+                w = await self.worker_factory(
+                    pool=self.cfg.name, tpu_chips=spec.chips_per_host,
+                    tpu_generation=spec.generation, slice_id=slice_id,
+                    slice_topology=spec.topology, slice_host_rank=rank,
+                    slice_host_count=spec.hosts)
+                self.workers.append(w)
+
+    async def worker_count(self) -> int:
+        return len(self.workers)
+
+    async def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                await w.stop()
+            except Exception:
+                pass
+        self.workers.clear()
+
+
+class GceTpuPool(WorkerPoolController):
+    """GCP TPU-VM slice provisioner (reference: provider VM pools,
+    ``pool_provider.go:53`` + ``pkg/providers``).
+
+    Maps a request's slice shape to a queued-resource create call:
+    ``v5p-64`` → accelerator_type=v5p-64 (16 hosts share the slice; each host
+    boots a tpu9 worker via startup script that joins this cluster with
+    slice_id = the queued resource name). ``transport(method, url, body)`` is
+    injected; tests assert on the calls, production passes an authed client.
+    """
+
+    name = "gce-tpu"
+
+    def __init__(self, cfg: WorkerPoolConfig,
+                 transport: Optional[Callable[..., Awaitable[dict]]] = None,
+                 startup_script: str = ""):
+        self.cfg = cfg
+        self.transport = transport
+        self.startup_script = startup_script
+        self.pending: list[dict] = []
+
+    def _base_url(self) -> str:
+        return (f"https://tpu.googleapis.com/v2alpha1/projects/"
+                f"{self.cfg.gcp_project}/locations/{self.cfg.gcp_zone}")
+
+    async def can_host(self, request: ContainerRequest) -> bool:
+        spec = request.tpu_spec()
+        if spec is None:
+            return False
+        pool_spec = parse_tpu_spec(self.cfg.tpu_type) if self.cfg.tpu_type else None
+        if pool_spec and pool_spec.generation != spec.generation:
+            return False
+        if len(self.pending) >= self.cfg.max_workers:
+            return False
+        # slices take minutes to become ACTIVE — don't provision another one
+        # for every scheduler retry of the same shape
+        if any(p["spec"] == spec.name for p in self.pending):
+            return False
+        return self.transport is not None
+
+    async def add_worker(self, request: ContainerRequest) -> None:
+        spec = request.tpu_spec()
+        assert spec is not None
+        node_id = new_id("tpu9-node")
+        body = {
+            "tpu": {"node_spec": [{
+                "parent": f"projects/{self.cfg.gcp_project}/locations/{self.cfg.gcp_zone}",
+                "node_id": node_id,
+                "node": {
+                    "accelerator_type": spec.name,
+                    "runtime_version": self.cfg.runtime_version,
+                    "network_config": {"enable_external_ips": False},
+                    "metadata": {"startup-script": self.startup_script,
+                                 "tpu9-slice-id": node_id,
+                                 "tpu9-slice-topology": spec.topology,
+                                 "tpu9-pool": self.cfg.name},
+                },
+            }]},
+            "queueing_policy": ({"valid_until_duration": "600s"}
+                                if not self.cfg.reserved else {}),
+        }
+        if self.cfg.spot:
+            body["tpu"]["node_spec"][0]["node"]["scheduling_config"] = {
+                "preemptible": True}
+        self.pending.append({"node_id": node_id, "spec": spec.name})
+        assert self.transport is not None
+        await self.transport(
+            "POST", f"{self._base_url()}/queuedResources?queued_resource_id={node_id}",
+            body)
+
+    async def worker_count(self) -> int:
+        return len(self.pending)
+
+    async def reconcile(self) -> None:
+        """Poll queued-resource states and drop failed/long-pending entries
+        (analogue of provider Reconcile, providers/provider.go:26)."""
+        if self.transport is None:
+            return
+        still = []
+        for entry in self.pending:
+            resp = await self.transport(
+                "GET", f"{self._base_url()}/queuedResources/{entry['node_id']}",
+                None)
+            state = (resp or {}).get("state", {}).get("state", "")
+            if state in ("FAILED", "SUSPENDED"):
+                log.warning("queued resource %s entered %s", entry["node_id"], state)
+                continue
+            if state != "ACTIVE":
+                still.append(entry)
+        self.pending = still

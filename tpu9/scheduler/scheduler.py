@@ -1,0 +1,264 @@
+"""The slice scheduler.
+
+Reference analogue: ``pkg/scheduler/scheduler.go`` — sorted-set backlog
+(backlog.go:16), 50 ms batch loop popping up to 512 requests
+(scheduler.go:28-33,589), filter+score selection, capacity reservation,
+per-worker request streams, retry/requeue with failure accounting, pool
+scale-up when nothing fits.
+
+New beyond the reference: **gang scheduling** for multi-host slices — a
+v5p-64 request atomically reserves all 16 hosts of one slice, stamps each
+container with its gang rank/coordinator, and failure of any member stops the
+others (shared fate).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+from ..config import SchedulerConfig
+from ..repository import ContainerRepository, Keys, WorkerRepository
+from ..statestore import StateStore
+from ..types import (ContainerRequest, ContainerState, ContainerStatus,
+                     GangInfo, StopReason, new_id)
+from .pools import WorkerPoolController
+from .selector import find_slice_gang, select_worker
+
+log = logging.getLogger("tpu9.scheduler")
+
+
+class SchedulingFailed(Exception):
+    pass
+
+
+class Scheduler:
+    def __init__(self, store: StateStore, cfg: Optional[SchedulerConfig] = None,
+                 pools: Optional[dict[str, WorkerPoolController]] = None):
+        self.cfg = cfg or SchedulerConfig()
+        self.store = store
+        self.workers = WorkerRepository(store)
+        self.containers = ContainerRepository(store)
+        self.pools = pools or {}
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = asyncio.Event()
+        self.stats = {"scheduled": 0, "retries": 0, "failed": 0,
+                      "gangs_scheduled": 0, "pool_scaleups": 0}
+
+    # -- public API ----------------------------------------------------------
+
+    async def run(self, request: ContainerRequest) -> None:
+        """Accept a placement request (reference Scheduler.Run,
+        scheduler.go:367): persist + enqueue; the loop does the rest."""
+        if not request.container_id:
+            request.container_id = new_id("ct")
+        request.timestamp = time.time()
+        await self.containers.set_request(request)
+        state = ContainerState(
+            container_id=request.container_id, stub_id=request.stub_id,
+            workspace_id=request.workspace_id,
+            status=ContainerStatus.PENDING.value)
+        await self.containers.update_state(state)
+        await self._push_backlog(request)
+
+    async def stop_container(self, container_id: str,
+                             reason: str = StopReason.USER.value) -> bool:
+        """Ask the owning worker to stop a container."""
+        state = await self.containers.get_state(container_id)
+        if state is None:
+            return False
+        if state.status == ContainerStatus.PENDING.value:
+            await self.store.zrem(Keys.BACKLOG, container_id)
+            await self.containers.delete_state(container_id, state.stub_id)
+            return True
+        await self.store.publish(f"container:stop:{state.worker_id}",
+                                 {"container_id": container_id,
+                                  "reason": reason})
+        return True
+
+    async def start(self) -> "Scheduler":
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    # -- backlog -------------------------------------------------------------
+
+    async def _push_backlog(self, request: ContainerRequest) -> None:
+        # score: priority first (lower score pops first), then FIFO by time
+        score = -request.priority * 1e12 + request.timestamp
+        await self.store.zadd(Keys.BACKLOG, request.container_id, score)
+
+    async def backlog_depth(self) -> int:
+        return await self.store.zcard(Keys.BACKLOG)
+
+    # -- loop ----------------------------------------------------------------
+
+    async def _loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                processed = await self._process_batch()
+            except Exception:
+                log.exception("scheduler batch failed")
+                processed = 0
+            if not processed:
+                await asyncio.sleep(self.cfg.loop_interval_s)
+
+    async def _process_batch(self) -> int:
+        popped = await self.store.zpopmin(Keys.BACKLOG, self.cfg.batch_size)
+        if not popped:
+            return 0
+        now = time.time()
+        workers = await self.workers.list()
+        alive = {w.worker_id for w in workers
+                 if await self.workers.is_alive(w.worker_id)}
+        processed = 0
+        for container_id, score in popped:
+            request = await self.containers.get_request(container_id)
+            if request is None:
+                continue
+            # retry entries carry a future not-before time folded into the
+            # score (minus the priority offset); park them back without
+            # consuming an attempt (backoff while pools provision)
+            not_before = score + request.priority * 1e12
+            if not_before > now:
+                await self.store.zadd(Keys.BACKLOG, container_id, score)
+                continue
+            processed += 1
+            try:
+                await self._schedule_one(request, workers, alive)
+            except SchedulingFailed as exc:
+                await self._requeue(request, str(exc))
+            except Exception as exc:   # never let one request drop the batch
+                log.exception("scheduling %s errored", request.container_id)
+                await self._requeue(request, f"internal: {exc}")
+        return processed
+
+    async def _schedule_one(self, request: ContainerRequest,
+                            workers: list, alive: set[str]) -> None:
+        spec = request.tpu_spec()
+        if spec is not None and spec.multi_host:
+            await self._schedule_gang(request, workers, alive, spec)
+            return
+
+        worker = select_worker(workers, request, alive)
+        if worker is None:
+            await self._try_scale_up(request)
+            raise SchedulingFailed("no eligible worker")
+
+        chips = spec.chips_per_host if spec else 0
+        ok = await self.workers.adjust_capacity(
+            worker.worker_id, cpu_millicores=-request.cpu_millicores,
+            memory_mb=-request.memory_mb, tpu_chips=-chips)
+        if not ok:
+            raise SchedulingFailed("capacity race lost")
+
+        await self._dispatch(worker.worker_id, request)
+
+    async def _schedule_gang(self, request: ContainerRequest, workers: list,
+                             alive: set[str], spec) -> None:
+        members = find_slice_gang(workers, spec, request, alive)
+        if members is None:
+            await self._try_scale_up(request)
+            raise SchedulingFailed(
+                f"no {spec.name} slice with {spec.hosts} free hosts")
+
+        gang_id = new_id("gang")
+        reserved: list[str] = []
+        per_host_chips = spec.chips_per_host
+        try:
+            for m in members:
+                ok = await self.workers.adjust_capacity(
+                    m.worker_id, cpu_millicores=-request.cpu_millicores,
+                    memory_mb=-request.memory_mb, tpu_chips=-per_host_chips)
+                if not ok:
+                    raise SchedulingFailed(
+                        f"gang reservation lost on {m.worker_id}")
+                reserved.append(m.worker_id)
+        except SchedulingFailed:
+            # all-or-nothing: roll back partial reservations
+            for worker_id in reserved:
+                await self.workers.adjust_capacity(
+                    worker_id, cpu_millicores=request.cpu_millicores,
+                    memory_mb=request.memory_mb, tpu_chips=per_host_chips)
+            raise
+
+        # rank 0's host is the jax coordinator
+        coordinator = f"{members[0].address.split(':')[0]}:8476"
+        container_ids = [request.container_id] + [
+            new_id("ct") for _ in range(1, len(members))]
+        await self.store.hmset(Keys.gang(gang_id), {
+            "size": len(members),
+            "containers": json.dumps(container_ids),
+            "stub_id": request.stub_id,
+        })
+
+        for rank, (m, container_id) in enumerate(zip(members, container_ids)):
+            member_req = ContainerRequest.from_dict(request.to_dict())
+            member_req.container_id = container_id
+            member_req.gang = GangInfo(
+                gang_id=gang_id, size=len(members), rank=rank,
+                peer_container_ids=container_ids,
+                coordinator_addr=coordinator)
+            await self.containers.set_request(member_req)
+            await self._dispatch(m.worker_id, member_req)
+        self.stats["gangs_scheduled"] += 1
+
+    async def _dispatch(self, worker_id: str, request: ContainerRequest) -> None:
+        state = await self.containers.get_state(request.container_id)
+        if state is None:
+            state = ContainerState(container_id=request.container_id,
+                                   stub_id=request.stub_id,
+                                   workspace_id=request.workspace_id)
+        state.status = ContainerStatus.SCHEDULED.value
+        state.worker_id = worker_id
+        state.scheduled_at = time.time()
+        await self.containers.update_state(state)
+        await self.workers.push_request(worker_id, request)
+        self.stats["scheduled"] += 1
+
+    async def _requeue(self, request: ContainerRequest, reason: str) -> None:
+        request.retry_count += 1
+        if request.retry_count > self.cfg.max_retries:
+            log.warning("giving up on %s after %d attempts (%s)",
+                        request.container_id, request.retry_count, reason)
+            self.stats["failed"] += 1
+            state = await self.containers.get_state(request.container_id)
+            if state:
+                state.status = ContainerStatus.FAILED.value
+                state.stop_reason = StopReason.SCHEDULER_FAILED.value
+                await self.containers.update_state(state)
+            await self.containers.set_exit_code(
+                request.container_id, -1,
+                f"{StopReason.SCHEDULER_FAILED.value}: {reason}")
+            return
+        self.stats["retries"] += 1
+        await self.containers.set_request(request)
+        # exponential not-before backoff (pool provisioning takes seconds to
+        # minutes; reference: provisioning_backoff.go), preserving the
+        # priority component of the original score
+        delay = min(0.25 * (1.7 ** request.retry_count), 15.0)
+        score = -request.priority * 1e12 + time.time() + delay
+        await self.store.zadd(Keys.BACKLOG, request.container_id, score)
+
+    async def _try_scale_up(self, request: ContainerRequest) -> None:
+        for name, pool in self.pools.items():
+            if request.pool_selector and name != request.pool_selector:
+                continue
+            if await pool.can_host(request):
+                try:
+                    await pool.add_worker(request)
+                    self.stats["pool_scaleups"] += 1
+                    return
+                except Exception as exc:
+                    log.warning("pool %s scale-up failed: %s", name, exc)

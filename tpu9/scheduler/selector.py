@@ -1,0 +1,91 @@
+"""Worker selection: filter chain + scored bin-packing.
+
+Reference analogue: ``pkg/scheduler/scheduler.go:1012-1176``
+(filterWorkersByPoolSelector/Resources, scheduleRequest's status-ordered
+scoring). The TPU twist: requests carry slice shapes, so the resource filter
+matches generation + per-host chip count, and multi-host requests filter to
+slice members (handled by the gang path in scheduler.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..types import ContainerRequest, TpuSpec, WorkerState, WorkerStatus
+
+
+def filter_workers(workers: list[WorkerState], request: ContainerRequest,
+                   alive: Optional[set[str]] = None) -> list[WorkerState]:
+    spec = request.tpu_spec()
+    out = []
+    for w in workers:
+        if w.status not in (WorkerStatus.AVAILABLE.value,):
+            continue
+        if alive is not None and w.worker_id not in alive:
+            continue
+        if request.pool_selector and w.pool != request.pool_selector:
+            continue
+        if w.free_cpu_millicores < request.cpu_millicores:
+            continue
+        if w.free_memory_mb < request.memory_mb:
+            continue
+        if spec is not None:
+            if w.tpu_generation != spec.generation:
+                continue
+            if w.tpu_free_chips < spec.chips_per_host:
+                continue
+            # single-host slices must fit one host entirely
+            if spec.hosts == 1 and w.tpu_chip_count < spec.chips:
+                continue
+        else:
+            # CPU request: don't burn TPU hosts unless pool-pinned
+            if w.tpu_chip_count > 0 and not request.pool_selector:
+                continue
+        out.append(w)
+    return out
+
+
+def score_worker(w: WorkerState, request: ContainerRequest) -> float:
+    """Higher is better. Bin-pack: prefer the tightest fit (least leftover
+    chips, then least leftover cpu), prefer higher-priority pools, and prefer
+    workers already warm (fewer free == more packed)."""
+    spec = request.tpu_spec()
+    score = float(w.priority) * 1000.0
+    if spec is not None:
+        leftover_chips = w.tpu_free_chips - spec.chips_per_host
+        score -= leftover_chips * 100.0
+    leftover_cpu = w.free_cpu_millicores - request.cpu_millicores
+    score -= leftover_cpu / 1000.0
+    leftover_mem = w.free_memory_mb - request.memory_mb
+    score -= leftover_mem / 10240.0
+    return score
+
+
+def select_worker(workers: list[WorkerState], request: ContainerRequest,
+                  alive: Optional[set[str]] = None) -> Optional[WorkerState]:
+    candidates = filter_workers(workers, request, alive)
+    if not candidates:
+        return None
+    return max(candidates, key=lambda w: score_worker(w, request))
+
+
+def find_slice_gang(workers: list[WorkerState], spec: TpuSpec,
+                    request: ContainerRequest,
+                    alive: Optional[set[str]] = None) -> Optional[list[WorkerState]]:
+    """Find a full slice (all hosts sharing one slice_id) that can host a
+    multi-host gang. All-or-nothing: every member host must pass the filters.
+    No reference analogue — the reference schedules single workers only."""
+    by_slice: dict[str, list[WorkerState]] = {}
+    for w in workers:
+        if w.slice_id and w.tpu_generation == spec.generation:
+            by_slice.setdefault(w.slice_id, []).append(w)
+
+    for slice_id, members in sorted(by_slice.items()):
+        if len(members) != spec.hosts:
+            continue
+        if any(m.slice_host_count != spec.hosts for m in members):
+            continue
+        eligible = filter_workers(members, request, alive)
+        if len(eligible) == len(members):
+            return sorted(members, key=lambda m: m.slice_host_rank)
+    return None

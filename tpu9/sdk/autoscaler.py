@@ -1,0 +1,25 @@
+"""User-facing autoscaler configs (reference sdk type.py:304-318)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class QueueDepthAutoscaler:
+    max_containers: int = 1
+    tasks_per_container: int = 1
+    min_containers: int = 0
+    type: str = "queue_depth"
+
+
+@dataclass
+class TokenPressureAutoscaler:
+    """LLM-aware scaling on KV-cache pressure (reference
+    LLMTokenPressureAutoscaler, sdk type.py:309 + pod/llm.go)."""
+
+    max_containers: int = 1
+    max_token_pressure: float = 0.85
+    min_containers: int = 0
+    tasks_per_container: int = 1
+    type: str = "token_pressure"

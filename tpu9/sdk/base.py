@@ -1,0 +1,140 @@
+"""RunnerAbstraction: shared base for all SDK decorators.
+
+Reference analogue: ``sdk/src/beta9/abstractions/base/runner.py``
+(cpu/mem/gpu parsing :373-535, prepare_runtime :569, stub request :699) and
+the DeployableMixin (mixins.py:42). ``tpu=`` replaces ``gpu=`` end to end.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, Callable, Optional
+
+from ..types import (AutoscalerConfig, CheckpointConfig, Runtime, StubConfig,
+                     parse_tpu_spec)
+from .autoscaler import QueueDepthAutoscaler
+from .client import GatewayClient
+from .sync import build_archive
+
+
+def parse_cpu(value) -> int:
+    """'1000m' | 1.5 | 2 → millicores."""
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if v.endswith("m"):
+            return int(v[:-1])
+        return int(float(v) * 1000)
+    return int(float(value) * 1000)
+
+
+def parse_memory(value) -> int:
+    """'512Mi' | '8Gi' | 1024 (MB) → MB."""
+    if isinstance(value, str):
+        v = value.strip()
+        for suffix, mult in (("Gi", 1024), ("Mi", 1), ("G", 1000), ("M", 1)):
+            if v.endswith(suffix):
+                return int(float(v[: -len(suffix)]) * mult)
+        return int(v)
+    return int(value)
+
+
+class RunnerAbstraction:
+    stub_type = "function"
+
+    def __init__(self, func: Optional[Callable] = None, *,
+                 cpu: Any = 1.0, memory: Any = 1024, tpu: str = "",
+                 image: Any = None, name: str = "",
+                 concurrent_requests: int = 1, keep_warm_seconds: float = 60.0,
+                 timeout: float = 180.0, retries: int = 0, workers: int = 1,
+                 autoscaler: Optional[QueueDepthAutoscaler] = None,
+                 checkpoint_enabled: bool = False,
+                 env: Optional[dict] = None, secrets: Optional[list] = None,
+                 volumes: Optional[list] = None, authorized: bool = True,
+                 on_start: Optional[Callable] = None):
+        self.func = func
+        self.name = name
+        self.on_start = on_start
+        parse_tpu_spec(tpu)  # validate early, client-side
+        self._image = image
+        self.config = StubConfig(
+            runtime=Runtime(cpu_millicores=parse_cpu(cpu),
+                            memory_mb=parse_memory(memory), tpu=tpu),
+            concurrent_requests=concurrent_requests,
+            keep_warm_seconds=keep_warm_seconds,
+            timeout_s=timeout, retries=retries, workers=workers,
+            env=dict(env or {}), secrets=list(secrets or []),
+            volumes=[v.to_dict() if hasattr(v, "to_dict") else v
+                     for v in (volumes or [])],
+            authorized=authorized,
+        )
+        if autoscaler is not None:
+            self.config.autoscaler = AutoscalerConfig(
+                type=autoscaler.type,
+                max_containers=autoscaler.max_containers,
+                tasks_per_container=autoscaler.tasks_per_container,
+                min_containers=autoscaler.min_containers,
+                max_token_pressure=getattr(autoscaler, "max_token_pressure",
+                                           0.85),
+            )
+        if checkpoint_enabled:
+            self.config.checkpoint = CheckpointConfig(enabled=True)
+        self._stub_id: Optional[str] = None
+        self._client: Optional[GatewayClient] = None
+
+    # -- decorator plumbing --------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        if self.func is None and len(args) == 1 and callable(args[0]) \
+                and not kwargs:
+            self.func = args[0]
+            return self
+        if self.func is None:
+            raise TypeError("decorator not bound to a function yet")
+        return self.func(*args, **kwargs)
+
+    @property
+    def handler_spec(self) -> str:
+        if self.func is None:
+            return self.config.handler
+        module = inspect.getmodule(self.func)
+        mod_name = getattr(module, "__name__", "__main__")
+        if mod_name == "__main__":
+            import __main__
+            path = getattr(__main__, "__file__", "")
+            mod_name = os.path.splitext(os.path.basename(path))[0] or "app"
+        return f"{mod_name}:{self.func.__name__}"
+
+    # -- deployment ----------------------------------------------------------
+
+    @property
+    def client(self) -> GatewayClient:
+        if self._client is None:
+            self._client = GatewayClient()
+        return self._client
+
+    def prepare_runtime(self, force: bool = False,
+                        sync_root: str = ".") -> str:
+        """Image verify/build + code sync + stub registration
+        (runner.py:569 flow). Returns stub_id."""
+        if self._stub_id is not None and not force:
+            return self._stub_id
+        if self._image is not None and hasattr(self._image, "ensure_built"):
+            image_id = self._image.ensure_built(self.client)
+            self.config.runtime.image_id = image_id
+        archive = build_archive(sync_root)
+        object_id = self.client.put_object(archive)
+        self.config.handler = self.handler_spec
+        self._stub_id = self.client.get_or_create_stub(
+            name=self.name or self.handler_spec,
+            stub_type=self.stub_type,
+            config=self.config.to_dict(),
+            object_id=object_id,
+            app_name=self.name or "",
+        )
+        return self._stub_id
+
+    def deploy(self, name: str = "", sync_root: str = ".") -> dict:
+        stub_id = self.prepare_runtime(sync_root=sync_root)
+        return self.client.deploy(stub_id, name or self.name
+                                  or self.handler_spec.replace(":", "-"))

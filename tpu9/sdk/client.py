@@ -1,0 +1,159 @@
+"""Gateway client used by the SDK decorators and the CLI.
+
+Reference analogue: ``sdk/src/beta9/channel.py`` + ``clients/`` (gRPC stubs
+with auth metadata). tpu9 speaks the gateway's JSON-RPC-over-HTTP surface;
+sync (requests from user scripts) wraps the async core.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Optional
+
+import aiohttp
+import yaml
+
+DEFAULT_CONTEXT_PATH = "~/.tpu9/config.yaml"
+
+
+@dataclass
+class Context:
+    gateway_url: str = "http://127.0.0.1:1994"
+    token: str = ""
+    name: str = "default"
+
+    @classmethod
+    def load(cls, name: str = "", path: str = DEFAULT_CONTEXT_PATH) -> "Context":
+        # env wins (containers, CI), then the context file
+        env_url = os.environ.get("TPU9_GATEWAY_URL")
+        env_token = os.environ.get("TPU9_TOKEN")
+        if env_url:
+            return cls(gateway_url=env_url, token=env_token or "")
+        p = Path(path).expanduser()
+        if p.exists():
+            data = yaml.safe_load(p.read_text()) or {}
+            contexts = data.get("contexts", {})
+            name = name or data.get("active", "default")
+            if name in contexts:
+                c = contexts[name]
+                return cls(gateway_url=c.get("gateway_url", cls.gateway_url),
+                           token=c.get("token", ""), name=name)
+        return cls(token=env_token or "")
+
+    def save(self, path: str = DEFAULT_CONTEXT_PATH) -> None:
+        p = Path(path).expanduser()
+        p.parent.mkdir(parents=True, exist_ok=True)
+        data: dict = {"contexts": {}, "active": self.name}
+        if p.exists():
+            data = yaml.safe_load(p.read_text()) or data
+        data.setdefault("contexts", {})[self.name] = {
+            "gateway_url": self.gateway_url, "token": self.token}
+        data["active"] = self.name
+        p.write_text(yaml.safe_dump(data))
+
+
+class AsyncGatewayClient:
+    def __init__(self, ctx: Optional[Context] = None):
+        self.ctx = ctx or Context.load()
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def _ensure(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                headers={"Authorization": f"Bearer {self.ctx.token}"})
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    async def request(self, method: str, path: str,
+                      json_body: Any = None, data: bytes = None) -> Any:
+        session = await self._ensure()
+        url = self.ctx.gateway_url.rstrip("/") + path
+        async with session.request(method, url, json=json_body,
+                                   data=data) as resp:
+            text = await resp.text()
+            try:
+                payload = json.loads(text) if text else {}
+            except json.JSONDecodeError:
+                payload = {"raw": text}
+            if resp.status >= 400:
+                raise GatewayError(resp.status, payload)
+            return payload
+
+    # -- typed helpers -----------------------------------------------------
+
+    async def auth_check(self) -> dict:
+        return await self.request("POST", "/rpc/auth/check", json_body={})
+
+    async def put_object(self, data: bytes) -> str:
+        out = await self.request("POST", "/rpc/object/put", data=data)
+        return out["object_id"]
+
+    async def get_or_create_stub(self, name: str, stub_type: str,
+                                 config: dict, object_id: str = "",
+                                 app_name: str = "",
+                                 force_create: bool = False) -> str:
+        out = await self.request("POST", "/rpc/stub/get-or-create", json_body={
+            "name": name, "stub_type": stub_type, "config": config,
+            "object_id": object_id, "app_name": app_name,
+            "force_create": force_create})
+        return out["stub_id"]
+
+    async def deploy(self, stub_id: str, name: str) -> dict:
+        return await self.request("POST", "/rpc/deploy",
+                                  json_body={"stub_id": stub_id, "name": name})
+
+    async def invoke(self, name: str, payload: Any, path: str = "") -> Any:
+        return await self.request("POST", f"/endpoint/{name}{path}",
+                                  json_body=payload)
+
+
+class GatewayError(RuntimeError):
+    def __init__(self, status: int, payload: Any):
+        super().__init__(f"gateway error {status}: {payload}")
+        self.status = status
+        self.payload = payload
+
+
+class GatewayClient:
+    """Sync facade over AsyncGatewayClient for user scripts and the CLI."""
+
+    def __init__(self, ctx: Optional[Context] = None):
+        self.ctx = ctx or Context.load()
+
+    def _run(self, coro):
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return asyncio.run(self._with_client(coro))
+        raise RuntimeError(
+            "GatewayClient is sync-only; use AsyncGatewayClient inside an "
+            "event loop")
+
+    async def _with_client(self, fn):
+        client = AsyncGatewayClient(self.ctx)
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    def auth_check(self) -> dict:
+        return self._run(lambda c: c.auth_check())
+
+    def put_object(self, data: bytes) -> str:
+        return self._run(lambda c: c.put_object(data))
+
+    def get_or_create_stub(self, **kw) -> str:
+        return self._run(lambda c: c.get_or_create_stub(**kw))
+
+    def deploy(self, stub_id: str, name: str) -> dict:
+        return self._run(lambda c: c.deploy(stub_id, name))
+
+    def invoke(self, name: str, payload: Any) -> Any:
+        return self._run(lambda c: c.invoke(name, payload))

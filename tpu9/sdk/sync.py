@@ -1,0 +1,66 @@
+"""Workspace code sync: zip the project dir for upload.
+
+Reference analogue: ``sdk/src/beta9/sync.py`` FileSyncer — snapshot the
+working directory (minus ignore patterns), content-hash it, upload once.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import zipfile
+from pathlib import Path
+
+DEFAULT_IGNORES = {
+    ".git", "__pycache__", ".venv", "venv", "node_modules", ".pytest_cache",
+    ".mypy_cache", ".DS_Store", ".tpu9", "*.pyc", "*.pyo", "*.egg-info",
+}
+
+MAX_SYNC_BYTES = 256 * 1024 * 1024
+
+
+def _ignored(name: str) -> bool:
+    for pat in DEFAULT_IGNORES:
+        if pat.startswith("*"):
+            if name.endswith(pat[1:]):
+                return True
+        elif name == pat:
+            return True
+    return False
+
+
+def build_archive(root: str = ".") -> bytes:
+    """Deterministic zip of the workspace (sorted entries, zeroed times) so
+    identical trees dedupe server-side by hash."""
+    root_path = Path(root).resolve()
+    entries = []
+    total = 0
+    for dirpath, dirnames, filenames in os.walk(root_path):
+        dirnames[:] = sorted(d for d in dirnames if not _ignored(d))
+        for fn in sorted(filenames):
+            if _ignored(fn):
+                continue
+            full = Path(dirpath) / fn
+            rel = full.relative_to(root_path)
+            try:
+                size = full.stat().st_size
+            except OSError:
+                continue
+            total += size
+            if total > MAX_SYNC_BYTES:
+                raise ValueError(
+                    f"workspace exceeds {MAX_SYNC_BYTES >> 20} MB sync limit")
+            entries.append((str(rel), full))
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for rel, full in entries:
+            info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+            info.external_attr = (full.stat().st_mode & 0xFFFF) << 16
+            z.writestr(info, full.read_bytes())
+    return buf.getvalue()
+
+
+def archive_hash(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()

@@ -1,0 +1,3 @@
+from .localstack import LocalStack
+
+__all__ = ["LocalStack"]

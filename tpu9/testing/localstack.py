@@ -1,0 +1,208 @@
+"""LocalStack: a full single-process tpu9 cluster for tests, dev, and the
+cold-start bench.
+
+Boots: MemoryStore + Gateway (HTTP on a random port) + Scheduler +
+LocalProcessPool whose workers run containers as real subprocesses
+(ProcessRuntime) — the runner server is the genuine article, so the
+deploy→schedule→spawn→probe→forward path is exactly production's minus OCI
+isolation. The analogue of the reference's k3d+helm local cluster
+(``make setup``) collapsed into an object.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import tempfile
+import time
+import zipfile
+from typing import Any, Optional
+
+import aiohttp
+
+from ..backend import BackendDB
+from ..config import AppConfig, WorkerPoolConfig
+from ..gateway import Gateway
+from ..runtime import ProcessRuntime
+from ..scheduler import LocalProcessPool
+from ..statestore import MemoryStore
+from ..types import ContainerStatus, StubType
+from ..worker import Worker
+
+ECHO_HANDLER = """
+def handler(**kwargs):
+    return {"echo": kwargs, "pid": __import__("os").getpid()}
+"""
+
+
+class LocalStack:
+    def __init__(self, pool_tpu_type: str = "", fake_chips: int = 0,
+                 max_workers: int = 4, worker_idle_shutdown_s: float = 300.0):
+        self.tmp = tempfile.TemporaryDirectory(prefix="tpu9-stack-")
+        cfg = AppConfig()
+        cfg.gateway.http_port = 0
+        cfg.gateway.state_port = 0          # in-proc workers share the store
+        cfg.database.path = ":memory:"
+        cfg.storage.local_root = os.path.join(self.tmp.name, "workspaces")
+        cfg.worker.containers_dir = os.path.join(self.tmp.name, "containers")
+        cfg.worker.idle_shutdown_s = worker_idle_shutdown_s
+        cfg.scheduler.loop_interval_s = 0.02
+        self.cfg = cfg
+        self.store = MemoryStore()
+        self.backend = BackendDB(":memory:")
+        self.pool_tpu_type = pool_tpu_type
+        self.fake_chips = fake_chips
+        self.max_workers = max_workers
+        self.gateway: Optional[Gateway] = None
+        self.pool: Optional[LocalProcessPool] = None
+        self.workers: list[Worker] = []
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def __aenter__(self) -> "LocalStack":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    async def start(self) -> "LocalStack":
+        pool_cfg = WorkerPoolConfig(name="default", mode="process",
+                                    tpu_type=self.pool_tpu_type,
+                                    max_workers=self.max_workers)
+        self.pool = LocalProcessPool(pool_cfg, self._worker_factory)
+        self.gateway = Gateway(self.cfg, store=self.store,
+                               backend=self.backend,
+                               pools={"default": self.pool})
+        await self.gateway.start()
+        self._session = aiohttp.ClientSession(headers={
+            "Authorization": f"Bearer {self.gateway.default_token}"})
+        return self
+
+    async def stop(self) -> None:
+        if self._session:
+            await self._session.close()
+        if self.pool:
+            await self.pool.shutdown()
+        if self.gateway:
+            await self.gateway.stop()
+        self.tmp.cleanup()
+
+    async def _worker_factory(self, pool: str = "default", tpu_chips: int = 0,
+                              tpu_generation: str = "", **slice_kw) -> Worker:
+        if tpu_chips:
+            os.environ["TPU9_FAKE_TPU_CHIPS"] = str(tpu_chips)
+        else:
+            os.environ.pop("TPU9_FAKE_TPU_CHIPS", None)
+        runtime = ProcessRuntime(base_dir=self.cfg.worker.containers_dir)
+        worker = Worker(
+            self.store, runtime, cfg=self.cfg.worker, pool=pool,
+            cpu_millicores=16000, memory_mb=32768,   # virtual capacity: these
+            # workers time-share the host the way k8s test nodes do
+            tpu_generation=tpu_generation,
+            object_resolver=self._resolve_object, **slice_kw)
+        await worker.start()
+        self.workers.append(worker)
+        return worker
+
+    async def _resolve_object(self, object_id: str) -> str:
+        obj = await self.backend.get_object(object_id)
+        return obj["path"] if obj else ""
+
+    # -- client helpers --------------------------------------------------------
+
+    @property
+    def base_url(self) -> str:
+        assert self.gateway is not None
+        return f"http://{self.cfg.gateway.host}:{self.gateway.port}"
+
+    async def api(self, method: str, path: str, json_body: Any = None,
+                  data: bytes = None, timeout: float = 60.0) -> Any:
+        assert self._session is not None
+        async with self._session.request(
+                method, self.base_url + path, json=json_body, data=data,
+                timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
+            text = await resp.text()
+            payload = json.loads(text) if text else {}
+            return resp.status, payload
+
+    async def upload_workspace(self, files: dict[str, str]) -> str:
+        buf_path = os.path.join(self.tmp.name, f"ws-{time.monotonic_ns()}.zip")
+        with zipfile.ZipFile(buf_path, "w") as z:
+            for name, content in files.items():
+                z.writestr(name, content)
+        with open(buf_path, "rb") as f:
+            status, out = await self.api("POST", "/rpc/object/put",
+                                         data=f.read())
+        assert status == 200, out
+        return out["object_id"]
+
+    async def deploy_endpoint(self, name: str, files: dict[str, str],
+                              handler: str, config_extra: Optional[dict] = None,
+                              stub_type: str = StubType.ENDPOINT.value) -> dict:
+        object_id = await self.upload_workspace(files)
+        config = {
+            "handler": handler,
+            "keep_warm_seconds": 2.0,
+            "autoscaler": {"max_containers": 3},
+        }
+        if config_extra:
+            config.update(config_extra)
+        status, out = await self.api("POST", "/rpc/stub/get-or-create", json_body={
+            "name": name, "stub_type": stub_type, "config": config,
+            "object_id": object_id})
+        assert status == 200, out
+        status, dep = await self.api("POST", "/rpc/deploy", json_body={
+            "stub_id": out["stub_id"], "name": name})
+        assert status == 200, dep
+        dep["stub_id"] = out["stub_id"]
+        return dep
+
+    async def deploy_echo_endpoint(self, name: str) -> dict:
+        return await self.deploy_endpoint(name, {"app.py": ECHO_HANDLER},
+                                          "app:handler")
+
+    async def invoke(self, deploy: dict, payload: Any,
+                     timeout: float = 120.0) -> Any:
+        name = deploy.get("name") or deploy["invoke_url"].rsplit("/", 1)[-1]
+        status, out = await self.api("POST", f"/endpoint/{name}",
+                                     json_body=payload, timeout=timeout)
+        assert status == 200, (status, out)
+        return out
+
+    # -- state helpers --------------------------------------------------------
+
+    async def running_containers(self, stub_id: str) -> list:
+        assert self.gateway is not None
+        return await self.gateway.containers.containers_by_stub(
+            stub_id, status=ContainerStatus.RUNNING.value)
+
+    async def scale_to_zero(self, deploy: dict, timeout: float = 30.0) -> None:
+        """Stop all containers for a deployment and wait until gone."""
+        assert self.gateway is not None
+        stub_id = deploy["stub_id"]
+        inst = self.gateway.endpoints.instances.get(stub_id)
+        if inst:
+            # reset warmth so the autoscaler doesn't immediately re-warm
+            inst.instance._last_active = -1e9
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            states = await self.gateway.containers.containers_by_stub(stub_id)
+            if not states:
+                return
+            for s in states:
+                await self.gateway.scheduler.stop_container(
+                    s.container_id, reason="scale_down")
+            await asyncio.sleep(0.1)
+        raise TimeoutError("containers did not stop")
+
+    async def wait_running(self, stub_id: str, n: int = 1,
+                           timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(await self.running_containers(stub_id)) >= n:
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError(f"never reached {n} running containers")

@@ -1,0 +1,4 @@
+from .worker import Worker
+from .tpu_manager import TpuDeviceManager
+
+__all__ = ["Worker", "TpuDeviceManager"]

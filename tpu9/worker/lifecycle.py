@@ -1,0 +1,255 @@
+"""Container cold-start orchestration with per-phase metrics.
+
+Reference analogue: ``pkg/worker/lifecycle.go`` — RunContainer's parallel
+image-load ∥ storage-mount, port reservation, spec synthesis, device inject,
+spawn, readiness, address publish; each phase timed
+(``metrics.RecordWorkerStartupPhase``). The phase names here mirror
+:class:`tpu9.types.LifecyclePhase` so the startup report tooling can build the
+same p50/p95 breakdown the reference's ``sandbox_startup_report.py`` does.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import socket
+import sys
+import time
+from typing import Awaitable, Callable, Optional
+
+import aiohttp
+
+from ..config import WorkerConfig
+from ..repository import ContainerRepository
+from ..runtime.base import ContainerSpec, Runtime
+from ..types import (ContainerRequest, ContainerState, ContainerStatus,
+                     LifecyclePhase, StopReason, StubType)
+from .tpu_manager import TpuDeviceManager
+
+log = logging.getLogger("tpu9.worker")
+
+READINESS_TIMEOUT_S = 120.0
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ContainerLifecycle:
+    def __init__(self, worker_id: str, cfg: WorkerConfig, runtime: Runtime,
+                 containers: ContainerRepository, tpu: TpuDeviceManager,
+                 object_resolver: Optional[Callable[[str], Awaitable[str]]] = None,
+                 image_resolver: Optional[Callable[[str], Awaitable[str]]] = None,
+                 phase_cb: Optional[Callable[[str, str, float], None]] = None):
+        self.worker_id = worker_id
+        self.cfg = cfg
+        self.runtime = runtime
+        self.containers = containers
+        self.tpu = tpu
+        self.object_resolver = object_resolver
+        self.image_resolver = image_resolver
+        self.phase_cb = phase_cb
+        self._active: dict[str, asyncio.Task] = {}
+        self._exited: dict[str, int] = {}
+
+    def _phase(self, container_id: str, phase: LifecyclePhase, t0: float) -> None:
+        if self.phase_cb:
+            self.phase_cb(container_id, phase.value, time.monotonic() - t0)
+
+    # ------------------------------------------------------------------
+
+    async def run_container(self, request: ContainerRequest) -> None:
+        """Full cold-start; returns once the container is RUNNING (or failed).
+        Exit supervision continues in a background task."""
+        t0 = time.monotonic()
+        container_id = request.container_id
+        state = ContainerState(
+            container_id=container_id, stub_id=request.stub_id,
+            workspace_id=request.workspace_id, worker_id=self.worker_id,
+            status=ContainerStatus.SCHEDULED.value,
+            gang_id=request.gang.gang_id if request.gang else "")
+        await self.containers.update_state(state)
+        self._phase(container_id, LifecyclePhase.WORKER_RECEIVED, t0)
+
+        try:
+            # image materialization ∥ workspace fetch (lifecycle.go:355-368)
+            image_task = asyncio.create_task(self._prepare_image(request))
+            object_task = asyncio.create_task(self._prepare_workspace(request))
+            rootfs = await image_task
+            self._phase(container_id, LifecyclePhase.IMAGE_READY, t0)
+            workdir = await object_task
+            self._phase(container_id, LifecyclePhase.STORAGE_READY, t0)
+
+            assignment = self.tpu.assign(request)
+            self._phase(container_id, LifecyclePhase.DEVICES_READY, t0)
+
+            port = free_port()
+            spec = self._spec_from_request(request, rootfs, workdir, port,
+                                           assignment)
+            self._phase(container_id, LifecyclePhase.SPEC_READY, t0)
+
+            def log_cb(line: str, stream: str) -> None:
+                # invoked from the runtime's pump coroutine → loop is running
+                asyncio.get_running_loop().create_task(
+                    self.containers.append_log(container_id, line, stream))
+
+            handle = await self.runtime.run(spec, log_cb=log_cb)
+            self._phase(container_id, LifecyclePhase.RUNTIME_STARTED, t0)
+
+            address = f"127.0.0.1:{port}"
+            needs_probe = request.stub_type in (
+                StubType.ENDPOINT.value, StubType.ASGI.value,
+                StubType.REALTIME.value, StubType.TASK_QUEUE.value,
+                StubType.FUNCTION.value, StubType.SCHEDULE.value)
+            if needs_probe:
+                ready = await self._wait_ready(container_id, address)
+                if not ready:
+                    raise RuntimeError("container failed readiness probe")
+
+            state.status = ContainerStatus.RUNNING.value
+            state.address = address
+            state.started_at = time.time()
+            await self.containers.set_address(container_id, address)
+            await self.containers.update_state(state)
+            self._phase(container_id, LifecyclePhase.CONTAINER_READY, t0)
+
+            self._active[container_id] = asyncio.create_task(
+                self._supervise(request, state))
+        except Exception as exc:
+            log.warning("container %s failed to start: %s", container_id, exc)
+            # reap the spawned process if it exists — otherwise it leaks and
+            # keeps holding the chips we're about to hand out again
+            try:
+                await self.runtime.kill(container_id, 9)
+            except Exception:
+                pass
+            self.tpu.release(container_id)
+            state.status = ContainerStatus.FAILED.value
+            state.stop_reason = StopReason.EXIT.value
+            state.exit_code = 1
+            await self.containers.update_state(state)
+            await self.containers.set_exit_code(container_id, 1, str(exc))
+            raise
+
+    async def _supervise(self, request: ContainerRequest,
+                         state: ContainerState) -> None:
+        container_id = request.container_id
+        code = await self.runtime.wait(container_id)
+        self._exited[container_id] = code
+        self.tpu.release(container_id)
+        state.status = (ContainerStatus.STOPPED.value if code == 0
+                        else ContainerStatus.FAILED.value)
+        # normalize 137 → OOM the way the reference does (lifecycle.go:1539)
+        state.stop_reason = (StopReason.OOM.value if code == 137
+                             else state.stop_reason or StopReason.EXIT.value)
+        state.exit_code = code
+        await self.containers.update_state(state)
+        await self.containers.set_exit_code(container_id, code,
+                                            state.stop_reason)
+        self._active.pop(container_id, None)
+
+    async def stop_container(self, container_id: str,
+                             reason: str = StopReason.USER.value) -> bool:
+        state = await self.containers.get_state(container_id)
+        if state:
+            state.status = ContainerStatus.STOPPING.value
+            state.stop_reason = reason
+            await self.containers.update_state(state)
+        return await self.runtime.kill(container_id, 15)
+
+    def active_ids(self) -> list[str]:
+        return list(self._active.keys())
+
+    # ------------------------------------------------------------------
+
+    async def _prepare_image(self, request: ContainerRequest) -> str:
+        """Resolve the image bundle for the request. v0: the host environment
+        is the image when no image_id is set; the image system (lazy index +
+        cache) plugs in through image_resolver."""
+        if request.image_id and self.image_resolver:
+            return await self.image_resolver(request.image_id)
+        return ""
+
+    async def _prepare_workspace(self, request: ContainerRequest) -> str:
+        """Materialize the synced user code into the sandbox workdir."""
+        base = os.path.join(self.cfg.containers_dir, request.container_id,
+                            "workspace")
+        os.makedirs(base, exist_ok=True)
+        if request.object_id and self.object_resolver:
+            archive = await self.object_resolver(request.object_id)
+            if archive and os.path.exists(archive):
+                import zipfile
+                await asyncio.to_thread(
+                    lambda: zipfile.ZipFile(archive).extractall(base))
+        return base
+
+    def _spec_from_request(self, request: ContainerRequest, rootfs: str,
+                           workdir: str, port: int, assignment) -> ContainerSpec:
+        env = dict(request.env)
+        env.update({
+            "TPU9_CONTAINER_ID": request.container_id,
+            "TPU9_STUB_ID": request.stub_id,
+            "TPU9_WORKSPACE_ID": request.workspace_id,
+            "TPU9_PORT": str(port),
+            "TPU9_WORKDIR": workdir,
+            "PYTHONPATH": workdir + os.pathsep + env.get("PYTHONPATH", ""),
+            "PYTHONUNBUFFERED": "1",
+        })
+        devices: list[str] = []
+        if assignment is not None:
+            env.update(assignment.env)
+            devices = assignment.devices
+        else:
+            # CPU-only containers must not grab the TPU backend
+            env.setdefault("JAX_PLATFORMS", "cpu")
+
+        entrypoint = list(request.entrypoint)
+        if not entrypoint:
+            runner_mod = {
+                StubType.ENDPOINT.value: "tpu9.runner.endpoint",
+                StubType.ASGI.value: "tpu9.runner.endpoint",
+                StubType.REALTIME.value: "tpu9.runner.endpoint",
+                StubType.TASK_QUEUE.value: "tpu9.runner.taskqueue",
+                StubType.FUNCTION.value: "tpu9.runner.function",
+                StubType.SCHEDULE.value: "tpu9.runner.function",
+            }.get(request.stub_type, "tpu9.runner.endpoint")
+            entrypoint = [sys.executable, "-m", runner_mod]
+            # the runner package must be importable inside the sandbox
+            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            env["PYTHONPATH"] = env["PYTHONPATH"] + os.pathsep + repo_root
+
+        return ContainerSpec(
+            container_id=request.container_id,
+            entrypoint=entrypoint,
+            env=env,
+            workdir=workdir,
+            rootfs=rootfs,
+            cpu_millicores=request.cpu_millicores,
+            memory_mb=request.memory_mb,
+            devices=devices,
+            ports={port: port},
+        )
+
+    async def _wait_ready(self, container_id: str, address: str) -> bool:
+        """Poll the runner's /health endpoint (buffer.go:334 equivalent)."""
+        deadline = time.monotonic() + READINESS_TIMEOUT_S
+        url = f"http://{address}/health"
+        async with aiohttp.ClientSession() as session:
+            while time.monotonic() < deadline:
+                handle = await self.runtime.state(container_id)
+                if handle is not None and handle.exit_code is not None:
+                    return False
+                try:
+                    async with session.get(
+                            url, timeout=aiohttp.ClientTimeout(total=1.0)) as r:
+                        if r.status == 200:
+                            return True
+                except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                    pass
+                await asyncio.sleep(0.05)
+        return False

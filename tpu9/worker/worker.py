@@ -1,0 +1,188 @@
+"""Worker agent: one per host (or per TPU-slice host).
+
+Reference analogue: ``pkg/worker/worker.go`` — registers with the control
+plane, streams container requests, keeps a TTL'd keepalive, accounts
+capacity, and drains on shutdown. tpu9 workers read their request stream from
+the state bus (the reference uses a Redis stream per worker,
+``scheduler.go:658``) and advertise slice membership for gang scheduling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional
+
+import psutil
+
+from ..config import WorkerConfig
+from ..repository import ContainerRepository, WorkerRepository
+from ..runtime.base import Runtime
+from ..statestore import StateStore
+from ..types import (ContainerRequest, StopReason, WorkerState, WorkerStatus,
+                     new_id)
+from .lifecycle import ContainerLifecycle
+from .tpu_manager import TpuDeviceManager
+
+log = logging.getLogger("tpu9.worker")
+
+
+class Worker:
+    def __init__(self, store: StateStore, runtime: Runtime,
+                 cfg: Optional[WorkerConfig] = None,
+                 worker_id: str = "", pool: str = "default",
+                 cpu_millicores: int = 0, memory_mb: int = 0,
+                 tpu_generation: str = "", slice_id: str = "",
+                 slice_topology: str = "", slice_host_rank: int = 0,
+                 slice_host_count: int = 1,
+                 object_resolver=None, image_resolver=None,
+                 phase_cb=None) -> None:
+        self.cfg = cfg or WorkerConfig()
+        self.worker_id = worker_id or new_id("worker")
+        self.pool = pool
+        self.store = store
+        self.workers = WorkerRepository(store, self.cfg.keepalive_ttl_s)
+        self.containers = ContainerRepository(store)
+        self.tpu = TpuDeviceManager(generation=tpu_generation)
+        self.runtime = runtime
+        self.lifecycle = ContainerLifecycle(
+            self.worker_id, self.cfg, runtime, self.containers, self.tpu,
+            object_resolver=object_resolver, image_resolver=image_resolver,
+            phase_cb=phase_cb)
+        self.slice_id = slice_id
+        self.slice_topology = slice_topology
+        self.slice_host_rank = slice_host_rank
+        self.slice_host_count = slice_host_count
+
+        self.total_cpu = cpu_millicores or (psutil.cpu_count() or 1) * 1000
+        self.total_mem = memory_mb or int(psutil.virtual_memory().total / 2**20)
+
+        self._tasks: list[asyncio.Task] = []
+        self._stopping = asyncio.Event()
+        self._start_sem = asyncio.Semaphore(self.cfg.start_concurrency)
+        self._last_activity = time.monotonic()
+
+    # ------------------------------------------------------------------
+
+    def _state(self) -> WorkerState:
+        return WorkerState(
+            worker_id=self.worker_id, pool=self.pool,
+            status=WorkerStatus.AVAILABLE.value,
+            total_cpu_millicores=self.total_cpu,
+            total_memory_mb=self.total_mem,
+            free_cpu_millicores=self.total_cpu,
+            free_memory_mb=self.total_mem,
+            tpu_generation=self.tpu.generation,
+            tpu_chip_count=self.tpu.chip_count,
+            tpu_free_chips=self.tpu.chip_count,
+            slice_id=self.slice_id,
+            slice_topology=self.slice_topology,
+            slice_host_rank=self.slice_host_rank,
+            slice_host_count=self.slice_host_count,
+            address=f"pid:{os.getpid()}",
+        )
+
+    async def start(self) -> "Worker":
+        await self.workers.register(self._state())
+        self._tasks = [
+            asyncio.create_task(self._heartbeat_loop()),
+            asyncio.create_task(self._request_loop()),
+            asyncio.create_task(self._stop_loop()),
+        ]
+        log.info("worker %s started (pool=%s chips=%d)", self.worker_id,
+                 self.pool, self.tpu.chip_count)
+        return self
+
+    async def stop(self, drain: bool = True) -> None:
+        self._stopping.set()
+        if drain:
+            for container_id in self.lifecycle.active_ids():
+                await self.lifecycle.stop_container(
+                    container_id, reason=StopReason.WORKER_LOST.value)
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.workers.deregister(self.worker_id)
+
+    # ------------------------------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._stopping.is_set():
+            await self.workers.touch_keepalive(self.worker_id)
+            for container_id in self.lifecycle.active_ids():
+                await self.containers.refresh_ttl(container_id)
+            await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    async def _request_loop(self) -> None:
+        last_id = "0"
+        while not self._stopping.is_set():
+            try:
+                entries = await self.workers.read_requests(
+                    self.worker_id, last_id=last_id, timeout=1.0)
+            except (ConnectionError, RuntimeError) as exc:
+                log.warning("request stream error: %s", exc)
+                await asyncio.sleep(1.0)
+                continue
+            for entry_id, request in entries:
+                last_id = entry_id
+                self._last_activity = time.monotonic()
+                asyncio.create_task(self._handle_request(request))
+
+    async def _stop_loop(self) -> None:
+        """Scheduler-initiated stops arrive over pubsub
+        (scheduler.stop_container publishes to container:stop:<worker>)."""
+        sub = self.store.subscribe(f"container:stop:{self.worker_id}")
+        try:
+            while not self._stopping.is_set():
+                msg = await sub.get(timeout=1.0)
+                if msg is None:
+                    continue
+                _, payload = msg
+                if payload is None:
+                    break
+                await self.lifecycle.stop_container(
+                    payload["container_id"],
+                    reason=payload.get("reason", StopReason.USER.value))
+        finally:
+            sub.close()
+
+    async def _handle_request(self, request: ContainerRequest) -> None:
+        async with self._start_sem:   # start-concurrency cap (worker.go:594)
+            try:
+                await self.lifecycle.run_container(request)
+                asyncio.create_task(self._release_on_exit(request))
+            except Exception:
+                # release the capacity the scheduler reserved for this request
+                await self._release_capacity(request)
+                await self.workers.remove_worker_container(
+                    self.worker_id, request.container_id)
+
+    async def _release_on_exit(self, request: ContainerRequest) -> None:
+        await self.runtime.wait(request.container_id)
+        await self._release_capacity(request)
+        await self.workers.remove_worker_container(self.worker_id,
+                                                   request.container_id)
+        self._last_activity = time.monotonic()
+
+    async def _release_capacity(self, request: ContainerRequest) -> None:
+        spec = request.tpu_spec()
+        chips = spec.chips_per_host if spec else 0
+        try:
+            await self.workers.adjust_capacity(
+                self.worker_id, cpu_millicores=request.cpu_millicores,
+                memory_mb=request.memory_mb, tpu_chips=chips)
+        except TimeoutError:
+            log.error("capacity release timed out for %s", request.container_id)
+
+    # ------------------------------------------------------------------
+
+    def idle_for(self) -> float:
+        if self.lifecycle.active_ids():
+            return 0.0
+        return time.monotonic() - self._last_activity
+
+    def should_shut_down(self) -> bool:
+        """Spindown policy (worker.go:789)."""
+        return self.idle_for() > self.cfg.idle_shutdown_s
