@@ -1,0 +1,106 @@
+import io
+import zipfile
+
+import pytest
+
+from tpu9.sdk.autoscaler import QueueDepthAutoscaler, TokenPressureAutoscaler
+from tpu9.sdk.base import RunnerAbstraction, parse_cpu, parse_memory
+from tpu9.sdk.endpoint import Endpoint, endpoint
+from tpu9.sdk.function import Function, Schedule, function, schedule
+from tpu9.sdk.taskqueue import TaskQueue, task_queue
+from tpu9.sdk.sync import archive_hash, build_archive
+from tpu9.types import InvalidTpuSpec
+
+
+def test_parse_cpu():
+    assert parse_cpu("1000m") == 1000
+    assert parse_cpu("250m") == 250
+    assert parse_cpu(2) == 2000
+    assert parse_cpu(0.5) == 500
+    assert parse_cpu("1.5") == 1500
+
+
+def test_parse_memory():
+    assert parse_memory("512Mi") == 512
+    assert parse_memory("8Gi") == 8192
+    assert parse_memory("2G") == 2000
+    assert parse_memory(1024) == 1024
+
+
+def test_decorator_forms():
+    @endpoint
+    def f1():
+        return 1
+
+    @endpoint(cpu="500m", tpu="v5e-1")
+    def f2():
+        return 2
+
+    assert isinstance(f1, Endpoint) and f1() == 1
+    assert isinstance(f2, Endpoint) and f2() == 2
+    assert f2.config.runtime.cpu_millicores == 500
+    assert f2.config.runtime.tpu == "v5e-1"
+    assert f1.handler_spec.endswith(":f1")
+
+
+def test_invalid_tpu_rejected_client_side():
+    with pytest.raises(InvalidTpuSpec):
+        endpoint(tpu="v99-1")(lambda: None)
+
+
+def test_function_and_queue_decorators():
+    @function(cpu=1)
+    def f():
+        pass
+
+    @task_queue(autoscaler=QueueDepthAutoscaler(max_containers=5,
+                                                tasks_per_container=2))
+    def q():
+        pass
+
+    @schedule(when="*/5 * * * *")
+    def s():
+        pass
+
+    assert isinstance(f, Function) and f.stub_type == "function"
+    assert isinstance(q, TaskQueue)
+    assert q.config.autoscaler.max_containers == 5
+    assert q.config.autoscaler.tasks_per_container == 2
+    assert isinstance(s, Schedule) and s.when == "*/5 * * * *"
+    with pytest.raises(ValueError):
+        schedule()(lambda: None)
+
+
+def test_token_pressure_autoscaler_config():
+    @endpoint(autoscaler=TokenPressureAutoscaler(max_containers=4,
+                                                 max_token_pressure=0.7))
+    def f():
+        pass
+
+    assert f.config.autoscaler.type == "token_pressure"
+    assert f.config.autoscaler.max_token_pressure == 0.7
+
+
+def test_build_archive_deterministic(tmp_path):
+    (tmp_path / "app.py").write_text("x = 1\n")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "m.py").write_text("y = 2\n")
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.pyc").write_text("junk")
+    (tmp_path / ".git").mkdir()
+    (tmp_path / ".git" / "config").write_text("git")
+
+    a1 = build_archive(str(tmp_path))
+    a2 = build_archive(str(tmp_path))
+    assert archive_hash(a1) == archive_hash(a2)
+    names = zipfile.ZipFile(io.BytesIO(a1)).namelist()
+    assert sorted(names) == ["app.py", "sub/m.py"]
+
+
+def test_runner_abstraction_volumes_serialized():
+    class FakeVol:
+        def to_dict(self):
+            return {"name": "v", "mount_path": "/data"}
+
+    r = RunnerAbstraction(lambda: None, volumes=[FakeVol()])
+    assert r.config.volumes == [{"name": "v", "mount_path": "/data"}]

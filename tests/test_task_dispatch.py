@@ -1,0 +1,149 @@
+import asyncio
+
+from tpu9.backend import BackendDB
+from tpu9.statestore import MemoryStore
+from tpu9.task import Dispatcher
+from tpu9.types import TaskPolicy, TaskStatus
+
+
+async def make_dispatcher(monitor_interval=0.05):
+    store = MemoryStore()
+    backend = BackendDB()
+    ws = await backend.create_workspace("w")
+    d = Dispatcher(store, backend, monitor_interval_s=monitor_interval)
+    return d, ws, backend
+
+
+async def test_send_claim_complete():
+    d, ws, backend = await make_dispatcher()
+    msg = await d.send("taskqueue", "stub1", ws.workspace_id, [1], {"k": 2})
+    assert msg.status == TaskStatus.PENDING.value
+    assert await d.tasks.queue_depth(ws.workspace_id, "stub1") == 1
+
+    task_id = await d.tasks.dequeue(ws.workspace_id, "stub1")
+    claimed = await d.claim(task_id, "c1")
+    assert claimed.status == TaskStatus.RUNNING.value
+
+    await d.complete(task_id, result={"ok": 1})
+    result = await d.retrieve(task_id, timeout=1)
+    assert result == {"result": {"ok": 1}}
+    rows = await backend.list_tasks(ws.workspace_id)
+    assert rows[0]["status"] == "complete"
+
+
+async def test_error_and_cancel():
+    d, ws, _ = await make_dispatcher()
+    m1 = await d.send("taskqueue", "s", ws.workspace_id, [], {})
+    await d.claim(m1.task_id, "c1")
+    await d.complete(m1.task_id, error="boom")
+    assert (await d.retrieve(m1.task_id, timeout=1))["error"] == "boom"
+
+    m2 = await d.send("taskqueue", "s", ws.workspace_id, [], {})
+    assert await d.cancel(m2.task_id)
+    assert not await d.cancel(m2.task_id)  # already terminal
+    # claim removed m1 from the queue, cancel removed m2
+    assert await d.tasks.queue_depth(ws.workspace_id, "s") == 0
+    # a completed task cannot be resurrected by a stale complete
+    assert await d.complete(m1.task_id, result="late") is None
+    # a second container cannot steal a running task
+    m3 = await d.send("taskqueue", "s", ws.workspace_id, [], {})
+    assert await d.claim(m3.task_id, "cA") is not None
+    assert await d.claim(m3.task_id, "cB") is None
+    assert await d.claim(m3.task_id, "cA") is not None  # idempotent for owner
+
+
+async def test_timeout_retries_then_fails():
+    d, ws, _ = await make_dispatcher()
+    await d.start()
+    try:
+        msg = await d.send("taskqueue", "s", ws.workspace_id, [], {},
+                           policy=TaskPolicy(timeout_s=0.1, max_retries=1))
+        task_id = await d.tasks.dequeue(ws.workspace_id, "s")
+        await d.claim(task_id, "c1")
+        # monitor should requeue once (retry), then on second timeout fail
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            m = await d.tasks.get_message(task_id)
+            if m.status == TaskStatus.PENDING.value:
+                break
+        m = await d.tasks.get_message(task_id)
+        assert m.retry_count == 1
+        # claim again; let it time out to exhaustion
+        await d.tasks.dequeue(ws.workspace_id, "s")
+        await d.claim(task_id, "c2")
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            m = await d.tasks.get_message(task_id)
+            if TaskStatus(m.status).terminal:
+                break
+        assert m.status == TaskStatus.TIMEOUT.value
+    finally:
+        await d.stop()
+
+
+async def test_requeue_lost_container():
+    d, ws, _ = await make_dispatcher()
+    msg = await d.send("taskqueue", "s", ws.workspace_id, [7], {})
+    task_id = await d.tasks.dequeue(ws.workspace_id, "s")
+    await d.claim(task_id, "c1")
+    n = await d.requeue_lost("c1")
+    assert n == 1
+    m = await d.tasks.get_message(task_id)
+    assert m.status == TaskStatus.PENDING.value and m.retry_count == 1
+    assert await d.tasks.queue_depth(ws.workspace_id, "s") == 1
+
+
+async def test_exit_event_triggers_requeue():
+    store = MemoryStore()
+    backend = BackendDB()
+    ws = await backend.create_workspace("w")
+    d = Dispatcher(store, backend, monitor_interval_s=0.05)
+    await d.start()
+    try:
+        await d.send("taskqueue", "s", ws.workspace_id, [], {})
+        task_id = await d.tasks.dequeue(ws.workspace_id, "s")
+        await d.claim(task_id, "c9")
+        await store.publish("events:container_exit",
+                            {"container_id": "c9", "stub_id": "s"})
+        for _ in range(50):
+            await asyncio.sleep(0.02)
+            m = await d.tasks.get_message(task_id)
+            if m.status == TaskStatus.PENDING.value:
+                break
+        assert m.status == TaskStatus.PENDING.value
+    finally:
+        await d.stop()
+
+
+async def test_pending_expiry():
+    d, ws, _ = await make_dispatcher()
+    await d.start()
+    try:
+        msg = await d.send("taskqueue", "s", ws.workspace_id, [], {},
+                           policy=TaskPolicy(expires_s=0.1))
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            m = await d.tasks.get_message(msg.task_id)
+            if TaskStatus(m.status).terminal:
+                break
+        assert m.status == TaskStatus.EXPIRED.value
+        assert await d.tasks.queue_depth(ws.workspace_id, "s") == 0
+    finally:
+        await d.stop()
+
+
+def test_cron_matcher():
+    import time
+    from tpu9.abstractions.function import cron_matches
+
+    t = time.struct_time((2026, 7, 28, 14, 30, 0, 1, 209, 0))  # Tue 14:30
+    assert cron_matches("* * * * *", t)
+    assert cron_matches("30 14 * * *", t)
+    assert not cron_matches("31 14 * * *", t)
+    assert cron_matches("*/15 * * * *", t)
+    assert cron_matches("* * * * 2", t)          # Tuesday
+    assert not cron_matches("* * * * 3", t)
+    assert cron_matches("0-45 14 28 7 *", t)
+    import pytest
+    with pytest.raises(ValueError):
+        cron_matches("* * *", t)

@@ -34,6 +34,7 @@ class AutoscaledInstance:
         self.containers = containers
         self.pool_selector = pool_selector
         self.entrypoint = entrypoint or []
+        self.extra_env: dict[str, str] = {}   # abstraction-specific env
         self._sample_extra = sample_extra   # async () -> (queue_depth, pressure)
         self.autoscaler = Autoscaler(self._sample, decide_policy, self._apply)
         self._last_active = time.monotonic()
@@ -109,6 +110,7 @@ class AutoscaledInstance:
     def _runner_env(self) -> dict[str, str]:
         cfg = self.stub.config
         env = dict(cfg.env)
+        env.update(self.extra_env)
         env.update({
             "TPU9_HANDLER": cfg.handler,
             "TPU9_STUB_TYPE": self.stub.stub_type,

@@ -1,0 +1,182 @@
+"""Function abstraction: one-shot remote invocation, ``.map()`` fan-out, and
+cron schedules.
+
+Reference analogue: ``pkg/abstractions/function/`` (FunctionInvoke
+function.go:115, schedules via task policies) + SDK ``function.py:294``
+(.map) / ``:444`` (Schedule). Each task gets a dedicated one-shot container
+(env-pinned TPU9_TASK_ID); the runner fetches args, executes, posts the
+result, and exits. Schedules fire through an in-gateway cron loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Optional
+
+from ..backend import BackendDB
+from ..repository import ContainerRepository
+from ..scheduler import Scheduler
+from ..task import Dispatcher
+from ..types import (ContainerRequest, Stub, TaskMessage, TaskPolicy,
+                     TaskStatus, new_id)
+
+log = logging.getLogger("tpu9.abstractions")
+
+EXECUTOR = "function"
+
+
+def cron_matches(expr: str, t: Optional[time.struct_time] = None) -> bool:
+    """Minimal 5-field cron matcher (min hour dom mon dow) supporting
+    ``*``, ``*/n``, ``a,b,c``, ``a-b``."""
+    t = t or time.localtime()
+    values = [t.tm_min, t.tm_hour, t.tm_mday, t.tm_mon,
+              (t.tm_wday + 1) % 7]       # cron dow: 0=Sunday
+    fields = expr.split()
+    if len(fields) != 5:
+        raise ValueError(f"bad cron expression {expr!r}")
+
+    def field_matches(field: str, value: int) -> bool:
+        for part in field.split(","):
+            if part == "*":
+                return True
+            if part.startswith("*/"):
+                if value % int(part[2:]) == 0:
+                    return True
+            elif "-" in part:
+                lo, hi = part.split("-")
+                if int(lo) <= value <= int(hi):
+                    return True
+            elif part.isdigit() and int(part) == value:
+                return True
+        return False
+
+    return all(field_matches(f, v) for f, v in zip(fields, values))
+
+
+class FunctionService:
+    def __init__(self, backend: BackendDB, scheduler: Scheduler,
+                 containers: ContainerRepository, dispatcher: Dispatcher,
+                 runner_env: Optional[dict[str, str]] = None):
+        self.backend = backend
+        self.scheduler = scheduler
+        self.containers = containers
+        self.dispatcher = dispatcher
+        self.runner_env = runner_env if runner_env is not None else {}
+        self._tokens: dict[str, str] = {}
+        self._cron_task: Optional[asyncio.Task] = None
+        self.dispatcher.register(EXECUTOR, self._requeue)
+
+    async def start(self) -> "FunctionService":
+        if self._cron_task is None:
+            self._cron_task = asyncio.create_task(self._cron_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._cron_task:
+            self._cron_task.cancel()
+            try:
+                await self._cron_task
+            except asyncio.CancelledError:
+                pass
+            self._cron_task = None
+
+    async def _runner_token(self, workspace_id: str) -> str:
+        tok = self._tokens.get(workspace_id)
+        if tok is None:
+            t = await self.backend.create_token(workspace_id,
+                                                token_type="runner")
+            tok = self._tokens[workspace_id] = t.key
+        return tok
+
+    # -- invocation ------------------------------------------------------------
+
+    async def invoke(self, stub: Stub, args: list[Any],
+                     kwargs: dict[str, Any],
+                     policy: Optional[TaskPolicy] = None) -> TaskMessage:
+        tp = policy or TaskPolicy(timeout_s=stub.config.timeout_s or 3600.0,
+                                  max_retries=stub.config.retries)
+        msg = await self.dispatcher.send(EXECUTOR, stub.stub_id,
+                                         stub.workspace_id, args, kwargs, tp,
+                                         enqueue=False)
+        await self._start_task_container(stub, msg.task_id)
+        return msg
+
+    async def _start_task_container(self, stub: Stub, task_id: str) -> str:
+        cfg = stub.config
+        env = dict(cfg.env)
+        env.update(self.runner_env)
+        env.update({
+            "TPU9_HANDLER": cfg.handler,
+            "TPU9_STUB_TYPE": stub.stub_type,
+            "TPU9_TASK_ID": task_id,
+            "TPU9_TIMEOUT_S": str(cfg.timeout_s),
+            "TPU9_TOKEN": await self._runner_token(stub.workspace_id),
+        })
+        request = ContainerRequest(
+            container_id=new_id("ct"),
+            stub_id=stub.stub_id,
+            workspace_id=stub.workspace_id,
+            stub_type=stub.stub_type,
+            cpu_millicores=cfg.runtime.cpu_millicores,
+            memory_mb=cfg.runtime.memory_mb,
+            tpu=cfg.runtime.tpu,
+            image_id=cfg.runtime.image_id,
+            object_id=stub.object_id,
+            env=env,
+        )
+        await self.scheduler.run(request)
+        return request.container_id
+
+    async def _requeue(self, msg: TaskMessage) -> None:
+        """Dispatcher retry hook: a retried function task needs a fresh
+        one-shot container."""
+        stub = await self.backend.get_stub(msg.stub_id)
+        if stub is not None:
+            await self._start_task_container(stub, msg.task_id)
+
+    async def get_task_payload(self, task_id: str) -> Optional[dict]:
+        """Runner-facing: fetch args for the pinned task."""
+        msg = await self.dispatcher.tasks.get_message(task_id)
+        if msg is None:
+            return None
+        return {"task_id": msg.task_id, "args": msg.handler_args,
+                "kwargs": msg.handler_kwargs, "status": msg.status}
+
+    # -- schedules -------------------------------------------------------------
+
+    async def register_schedule(self, stub: Stub, cron: str) -> str:
+        cron_matches(cron)  # validate
+        return await self.backend.upsert_schedule(stub.stub_id,
+                                                  stub.workspace_id, cron)
+
+    async def _cron_loop(self) -> None:
+        last_minute = -1
+        while True:
+            try:
+                now = time.localtime()
+                minute_key = now.tm_yday * 1440 + now.tm_hour * 60 + now.tm_min
+                if minute_key != last_minute:
+                    last_minute = minute_key
+                    await self._fire_due(now)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("cron pass failed")
+            await asyncio.sleep(5.0)
+
+    async def _fire_due(self, now: time.struct_time) -> None:
+        for row in await self.backend.list_schedules(active_only=True):
+            try:
+                if not cron_matches(row["cron"], now):
+                    continue
+            except ValueError:
+                continue
+            stub = await self.backend.get_stub(row["stub_id"])
+            if stub is None:
+                continue
+            log.info("cron fire %s (%s)", stub.name, row["cron"])
+            await self.invoke(stub, [], {})
+            await self.backend.mark_schedule_fired(row["schedule_id"],
+                                                   time.time())

@@ -1,0 +1,117 @@
+"""Task-queue abstraction: async task execution with queue-depth autoscaling.
+
+Reference analogue: ``pkg/abstractions/taskqueue/`` — push via API, Redis list
+per stub (client.go:29), containers long-poll pop (taskqueue.go:236),
+completion + monitoring, queue-depth autoscaler. tpu9 runners long-poll over
+the gateway's HTTP RPC (the reference uses gRPC streams; same shape).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Optional
+
+from ..backend import BackendDB
+from ..repository import ContainerRepository, TaskRepository
+from ..scheduler import Scheduler
+from ..task import Dispatcher
+from ..types import Stub, TaskMessage, TaskPolicy, TaskStatus
+from .common.autoscaler import queue_depth_policy
+from .common.instance import AutoscaledInstance
+
+log = logging.getLogger("tpu9.abstractions")
+
+EXECUTOR = "taskqueue"
+
+
+class TaskQueueService:
+    def __init__(self, backend: BackendDB, scheduler: Scheduler,
+                 containers: ContainerRepository, dispatcher: Dispatcher,
+                 runner_env: Optional[dict[str, str]] = None):
+        self.backend = backend
+        self.scheduler = scheduler
+        self.containers = containers
+        self.dispatcher = dispatcher
+        self.tasks: TaskRepository = dispatcher.tasks
+        self.runner_env = runner_env if runner_env is not None else {}
+        self.instances: dict[str, AutoscaledInstance] = {}
+        self._locks: dict[str, asyncio.Lock] = {}
+        self._tokens: dict[str, str] = {}
+
+    async def _runner_token(self, workspace_id: str) -> str:
+        tok = self._tokens.get(workspace_id)
+        if tok is None:
+            t = await self.backend.create_token(workspace_id,
+                                                token_type="runner")
+            tok = self._tokens[workspace_id] = t.key
+        return tok
+
+    async def get_or_create_instance(self, stub: Stub) -> AutoscaledInstance:
+        inst = self.instances.get(stub.stub_id)
+        if inst is not None:
+            return inst
+        lock = self._locks.setdefault(stub.stub_id, asyncio.Lock())
+        async with lock:
+            inst = self.instances.get(stub.stub_id)
+            if inst is None:
+                a = stub.config.autoscaler
+                policy = queue_depth_policy(a.max_containers,
+                                            a.tasks_per_container,
+                                            a.min_containers)
+
+                async def sample_extra():
+                    depth = await self.tasks.queue_depth(stub.workspace_id,
+                                                         stub.stub_id)
+                    in_flight = await self.tasks.tasks_in_flight(stub.stub_id)
+                    return depth + max(in_flight - depth, 0), 0.0
+
+                inst = AutoscaledInstance(stub, self.scheduler,
+                                          self.containers, policy,
+                                          sample_extra=sample_extra)
+                inst.extra_env = dict(self.runner_env)
+                inst.extra_env["TPU9_TOKEN"] = await self._runner_token(
+                    stub.workspace_id)
+                await inst.start()
+                self.instances[stub.stub_id] = inst
+        return inst
+
+    # -- API used by gateway routes -------------------------------------------
+
+    async def put(self, stub: Stub, args: list[Any], kwargs: dict[str, Any],
+                  policy: Optional[TaskPolicy] = None) -> TaskMessage:
+        await self.get_or_create_instance(stub)
+        tp = policy or TaskPolicy(timeout_s=stub.config.timeout_s or 3600.0,
+                                  max_retries=stub.config.retries)
+        return await self.dispatcher.send(EXECUTOR, stub.stub_id,
+                                          stub.workspace_id, args, kwargs, tp)
+
+    async def pop(self, workspace_id: str, stub_id: str, container_id: str,
+                  timeout: float = 25.0) -> Optional[TaskMessage]:
+        """Long-poll pop + claim (runner-facing)."""
+        task_id = await self.tasks.dequeue(workspace_id, stub_id,
+                                           timeout=timeout)
+        if task_id is None:
+            return None
+        msg = await self.dispatcher.claim(task_id, container_id)
+        if msg is None:
+            return None
+        return msg
+
+    async def complete(self, task_id: str, result: Any = None,
+                       error: Optional[str] = None) -> bool:
+        return await self.dispatcher.complete(task_id, result, error) is not None
+
+    async def queue_status(self, stub: Stub) -> dict:
+        return {
+            "depth": await self.tasks.queue_depth(stub.workspace_id,
+                                                  stub.stub_id),
+            "in_flight": await self.tasks.tasks_in_flight(stub.stub_id),
+            "containers": await self.containers.active_count_by_stub(
+                stub.stub_id),
+        }
+
+    async def shutdown(self) -> None:
+        for inst in self.instances.values():
+            await inst.drain()
+        self.instances.clear()

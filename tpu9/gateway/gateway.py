@@ -26,12 +26,15 @@ from typing import Optional
 from aiohttp import web
 
 from ..abstractions.endpoint import EndpointService
+from ..abstractions.function import FunctionService
+from ..abstractions.taskqueue import TaskQueueService
 from ..backend import BackendDB
 from ..config import AppConfig
 from ..repository import ContainerRepository, TaskRepository, WorkerRepository
 from ..scheduler import Scheduler
 from ..statestore import MemoryStore, RemoteStore, StateServer, StateStore
-from ..types import Stub, StubConfig, StubType, Workspace
+from ..task import Dispatcher
+from ..types import Stub, StubConfig, StubType, TaskPolicy, Workspace
 
 log = logging.getLogger("tpu9.gateway")
 
@@ -50,6 +53,20 @@ class Gateway:
         self.tasks = TaskRepository(self.store)
         self.endpoints = EndpointService(self.backend, self.scheduler,
                                          self.containers)
+        # containers read this to reach us; filled once the port is bound
+        self.runner_env: dict[str, str] = {}
+        self.dispatcher = Dispatcher(self.store, self.backend)
+
+        async def _container_alive(container_id: str) -> bool:
+            return await self.containers.get_state(container_id) is not None
+
+        self.dispatcher.container_alive = _container_alive
+        self.taskqueues = TaskQueueService(self.backend, self.scheduler,
+                                           self.containers, self.dispatcher,
+                                           runner_env=self.runner_env)
+        self.functions = FunctionService(self.backend, self.scheduler,
+                                         self.containers, self.dispatcher,
+                                         runner_env=self.runner_env)
         self.extra_services: dict[str, object] = {}
         self.state_server: Optional[StateServer] = None
         self._runner: Optional[web.AppRunner] = None
@@ -69,6 +86,17 @@ class Gateway:
         r.add_post("/rpc/object/put", self._rpc_put_object)
         r.add_post("/rpc/deploy", self._rpc_deploy)
         r.add_post("/rpc/serve", self._rpc_serve)
+        # tasks / queues / functions
+        r.add_post("/rpc/taskqueue/put", self._rpc_tq_put)
+        r.add_post("/rpc/taskqueue/pop", self._rpc_tq_pop)
+        r.add_get("/rpc/taskqueue/status/{stub_id}", self._rpc_tq_status)
+        r.add_post("/rpc/function/invoke", self._rpc_fn_invoke)
+        r.add_post("/rpc/schedule/register", self._rpc_schedule_register)
+        r.add_get("/rpc/task/{task_id}", self._rpc_task_get)
+        r.add_get("/rpc/task/{task_id}/result", self._rpc_task_result)
+        r.add_post("/rpc/task/{task_id}/claim", self._rpc_task_claim)
+        r.add_post("/rpc/task/{task_id}/complete", self._rpc_task_complete)
+        r.add_post("/rpc/task/{task_id}/cancel", self._rpc_task_cancel)
         # REST v1 (management)
         r.add_get("/api/v1/deployment", self._list_deployments)
         r.add_delete("/api/v1/deployment/{id}", self._delete_deployment)
@@ -100,12 +128,17 @@ class Gateway:
                 store=self.store, host=self.cfg.gateway.host, port=port,
                 auth_token=self.cfg.database.state_auth_token).start()
         await self.scheduler.start()
+        await self.dispatcher.start()
+        await self.functions.start()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.cfg.gateway.host, self.port)
         await site.start()
         if self.port == 0:
             self.port = self._runner.addresses[0][1]
+        self.runner_env["TPU9_GATEWAY_URL"] = (
+            self.cfg.gateway.external_url
+            or f"http://{self.cfg.gateway.host}:{self.port}")
         await self._ensure_default_workspace()
         await self._rehydrate_deployments()
         log.info("gateway on %s:%d", self.cfg.gateway.host, self.port)
@@ -113,6 +146,9 @@ class Gateway:
 
     async def stop(self) -> None:
         await self.endpoints.shutdown()
+        await self.taskqueues.shutdown()
+        await self.functions.stop()
+        await self.dispatcher.stop()
         await self.scheduler.stop()
         if self._runner:
             await self._runner.cleanup()
@@ -139,10 +175,14 @@ class Gateway:
         restart (instance.go:444-530)."""
         for dep in await self.backend.list_active_deployments():
             stub = await self.backend.get_stub(dep.stub_id)
-            if stub and stub.stub_type in (StubType.ENDPOINT.value,
-                                           StubType.ASGI.value,
-                                           StubType.REALTIME.value):
+            if stub is None:
+                continue
+            if stub.stub_type in (StubType.ENDPOINT.value,
+                                  StubType.ASGI.value,
+                                  StubType.REALTIME.value):
                 await self.endpoints.get_or_create_instance(stub)
+            elif stub.stub_type == StubType.TASK_QUEUE.value:
+                await self.taskqueues.get_or_create_instance(stub)
 
     # -- auth ----------------------------------------------------------------
 
@@ -239,6 +279,8 @@ class Gateway:
         if stub.stub_type in (StubType.ENDPOINT.value, StubType.ASGI.value,
                               StubType.REALTIME.value):
             await self.endpoints.get_or_create_instance(stub)
+        elif stub.stub_type == StubType.TASK_QUEUE.value:
+            await self.taskqueues.get_or_create_instance(stub)
         invoke_url = (f"http://{self.cfg.gateway.host}:{self.port}"
                       f"/endpoint/{dep.name}")
         return web.json_response({"deployment_id": dep.deployment_id,
@@ -255,6 +297,118 @@ class Gateway:
             return web.json_response({"error": "stub not found"}, status=404)
         await self.endpoints.get_or_create_instance(stub)
         return web.json_response({"ok": True, "stub_id": stub.stub_id})
+
+    # -- handlers: tasks / queues / functions ---------------------------------
+
+    async def _stub_for(self, request: web.Request, stub_id: str) -> Stub:
+        ws = self._ws(request)
+        stub = await self.backend.get_stub(stub_id)
+        if stub is None or stub.workspace_id != ws.workspace_id:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "stub not found"}),
+                content_type="application/json")
+        return stub
+
+    async def _rpc_tq_put(self, request: web.Request) -> web.Response:
+        data = await request.json()
+        stub = await self._stub_for(request, data["stub_id"])
+        msg = await self.taskqueues.put(stub, data.get("args", []),
+                                        data.get("kwargs", {}))
+        return web.json_response({"task_id": msg.task_id})
+
+    async def _rpc_tq_pop(self, request: web.Request) -> web.Response:
+        data = await request.json()
+        stub = await self._stub_for(request, data["stub_id"])
+        msg = await self.taskqueues.pop(
+            stub.workspace_id, stub.stub_id, data.get("container_id", ""),
+            timeout=min(float(data.get("timeout", 25.0)), 30.0))
+        if msg is None:
+            return web.json_response({"task": None})
+        return web.json_response({"task": {
+            "task_id": msg.task_id, "args": msg.handler_args,
+            "kwargs": msg.handler_kwargs, "retry_count": msg.retry_count}})
+
+    async def _rpc_tq_status(self, request: web.Request) -> web.Response:
+        stub = await self._stub_for(request, request.match_info["stub_id"])
+        return web.json_response(await self.taskqueues.queue_status(stub))
+
+    async def _rpc_fn_invoke(self, request: web.Request) -> web.Response:
+        data = await request.json()
+        stub = await self._stub_for(request, data["stub_id"])
+        policy = None
+        if "policy" in data:
+            policy = TaskPolicy.from_dict(data["policy"])
+        msg = await self.functions.invoke(stub, data.get("args", []),
+                                          data.get("kwargs", {}), policy)
+        if not data.get("wait", True):
+            return web.json_response({"task_id": msg.task_id})
+        # cap the blocking wait under client/proxy timeouts; callers poll the
+        # result route with the task_id after a 504
+        wait_s = float(data.get("timeout") or stub.config.timeout_s or 60.0)
+        result = await self.dispatcher.retrieve(msg.task_id,
+                                                timeout=min(max(wait_s, 1.0),
+                                                            110.0))
+        if result is None:
+            return web.json_response({"task_id": msg.task_id,
+                                      "error": "timeout waiting for result"},
+                                     status=504)
+        return web.json_response({"task_id": msg.task_id, **result})
+
+    async def _rpc_schedule_register(self, request: web.Request) -> web.Response:
+        data = await request.json()
+        stub = await self._stub_for(request, data["stub_id"])
+        try:
+            schedule_id = await self.functions.register_schedule(
+                stub, data["cron"])
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"schedule_id": schedule_id})
+
+    async def _task_for(self, request: web.Request):
+        """Workspace-scoped task lookup (404 on missing or foreign tasks)."""
+        ws = self._ws(request)
+        task_id = request.match_info["task_id"]
+        msg = await self.dispatcher.tasks.get_message(task_id)
+        if msg is None or msg.workspace_id != ws.workspace_id:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "task not found"}),
+                content_type="application/json")
+        return msg
+
+    async def _rpc_task_get(self, request: web.Request) -> web.Response:
+        msg = await self._task_for(request)
+        return web.json_response({"task_id": msg.task_id, "status": msg.status,
+                                  "args": msg.handler_args,
+                                  "kwargs": msg.handler_kwargs,
+                                  "container_id": msg.container_id})
+
+    async def _rpc_task_result(self, request: web.Request) -> web.Response:
+        msg = await self._task_for(request)
+        timeout = min(float(request.query.get("timeout", "0")), 110.0)
+        result = await self.dispatcher.retrieve(msg.task_id, timeout=timeout)
+        if result is None:
+            return web.json_response({"pending": True}, status=202)
+        return web.json_response(result)
+
+    async def _rpc_task_claim(self, request: web.Request) -> web.Response:
+        msg = await self._task_for(request)
+        data = await request.json()
+        claimed = await self.dispatcher.claim(msg.task_id,
+                                              data.get("container_id", ""))
+        return web.json_response({"ok": claimed is not None})
+
+    async def _rpc_task_complete(self, request: web.Request) -> web.Response:
+        msg = await self._task_for(request)
+        data = await request.json()
+        ok = await self.dispatcher.complete(
+            msg.task_id, result=data.get("result"),
+            error=data.get("error"),
+            container_id=data.get("container_id", "")) is not None
+        return web.json_response({"ok": ok})
+
+    async def _rpc_task_cancel(self, request: web.Request) -> web.Response:
+        msg = await self._task_for(request)
+        return web.json_response({"ok": await self.dispatcher.cancel(msg.task_id)})
 
     # -- handlers: invoke ------------------------------------------------------
 

@@ -1,0 +1,96 @@
+"""Function runner: executes exactly one pinned task, reports, exits.
+
+Reference analogue: ``sdk/src/beta9/runner/function.py:231``. The worker
+spawns this with ``TPU9_TASK_ID``; it fetches args from the gateway, runs the
+handler, posts the result, and exits 0 (the scheduler/abstraction treat exit
+as completion; failures surface through the task result + exit code).
+
+A minimal /health server satisfies the worker's readiness probe (readiness ==
+handler loaded, mirroring the endpoint runner).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+
+import aiohttp
+from aiohttp import web
+
+from .common import FunctionHandler, RunnerConfig, error_payload
+
+log = logging.getLogger("tpu9.runner")
+
+
+async def run() -> int:
+    cfg = RunnerConfig.from_env()
+    task_id = os.environ.get("TPU9_TASK_ID", "")
+    gateway_url = os.environ.get("TPU9_GATEWAY_URL", "")
+    token = os.environ.get("TPU9_TOKEN", "")
+    if not (cfg.handler and task_id and gateway_url):
+        print("missing TPU9_HANDLER/TPU9_TASK_ID/TPU9_GATEWAY_URL",
+              file=sys.stderr)
+        return 2
+
+    handler = FunctionHandler(cfg)
+    state = {"ready": False}
+
+    app = web.Application()
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"ready": state["ready"]},
+                                 status=200 if state["ready"] else 503)
+
+    app.router.add_get("/health", health)
+    app_runner = web.AppRunner(app)
+    await app_runner.setup()
+    await web.TCPSite(app_runner, "127.0.0.1", cfg.port).start()
+
+    async with aiohttp.ClientSession(
+            headers={"Authorization": f"Bearer {token}"}) as session:
+
+        async def api(method: str, path: str, body=None):
+            async with session.request(
+                    method, gateway_url + path, json=body,
+                    timeout=aiohttp.ClientTimeout(total=60)) as resp:
+                return resp.status, await resp.json()
+
+        status, payload = await api("GET", f"/rpc/task/{task_id}")
+        if status != 200:
+            log.error("task fetch failed: %s", payload)
+            return 1
+        await api("POST", f"/rpc/task/{task_id}/claim",
+                  {"container_id": cfg.container_id})
+
+        await asyncio.to_thread(handler.load)
+        state["ready"] = True
+
+        try:
+            result = await asyncio.wait_for(
+                handler.call(*payload.get("args", []),
+                             **payload.get("kwargs", {})),
+                timeout=cfg.timeout_s)
+            body = {"result": result}
+            code = 0
+        except Exception as exc:  # noqa: BLE001 — user code boundary
+            body = {"error": error_payload(exc)["error"]}
+            code = 1
+        body["container_id"] = cfg.container_id
+        try:
+            await api("POST", f"/rpc/task/{task_id}/complete", body)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+            log.error("completion report failed: %s", exc)
+            return 1
+    await app_runner.cleanup()
+    return code
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    sys.exit(asyncio.run(run()))
+
+
+if __name__ == "__main__":
+    main()

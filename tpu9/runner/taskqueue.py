@@ -1,0 +1,119 @@
+"""Task-queue runner: long-polls the gateway for tasks and executes them.
+
+Reference analogue: ``sdk/src/beta9/runner/taskqueue.py:166,298`` —
+multiprocess pollers with a watchdog. tpu9 runs ``TPU9_WORKERS`` concurrent
+poller coroutines in one process (handler calls execute in threads), plus the
+same /health server the worker's readiness probe expects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+
+import aiohttp
+from aiohttp import web
+
+from .common import FunctionHandler, RunnerConfig, dumps, error_payload
+
+log = logging.getLogger("tpu9.runner")
+
+
+class TaskQueueWorker:
+    def __init__(self, cfg: RunnerConfig):
+        self.cfg = cfg
+        self.handler = FunctionHandler(cfg)
+        self.gateway_url = os.environ.get("TPU9_GATEWAY_URL", "")
+        self.token = os.environ.get("TPU9_TOKEN", "")
+        self.ready = False
+        self.processed = 0
+        self._session: aiohttp.ClientSession | None = None
+
+    async def _api(self, method: str, path: str, body: dict) -> dict:
+        assert self._session is not None
+        async with self._session.request(
+                method, self.gateway_url + path, json=body,
+                timeout=aiohttp.ClientTimeout(total=60)) as resp:
+            return await resp.json()
+
+    async def poll_loop(self, idx: int) -> None:
+        while True:
+            try:
+                out = await self._api("POST", "/rpc/taskqueue/pop", {
+                    "stub_id": self.cfg.stub_id,
+                    "container_id": self.cfg.container_id,
+                    "timeout": 25.0})
+            except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+                log.warning("pop failed: %s", exc)
+                await asyncio.sleep(1.0)
+                continue
+            task = out.get("task")
+            if not task:
+                continue
+            await self.run_task(task)
+
+    async def run_task(self, task: dict) -> None:
+        task_id = task["task_id"]
+        try:
+            result = await asyncio.wait_for(
+                self.handler.call(*task.get("args", []),
+                                  **task.get("kwargs", {})),
+                timeout=self.cfg.timeout_s)
+            body = {"result": _jsonable(result)}
+        except Exception as exc:  # noqa: BLE001 — user code boundary
+            body = {"error": error_payload(exc)["error"]}
+        body["container_id"] = self.cfg.container_id
+        self.processed += 1
+        try:
+            await self._api("POST", f"/rpc/task/{task_id}/complete", body)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+            log.error("failed to report completion for %s: %s", task_id, exc)
+
+    async def main(self) -> None:
+        self._session = aiohttp.ClientSession(
+            headers={"Authorization": f"Bearer {self.token}"})
+        # health server first so the worker's readiness probe can pass once
+        # the handler is loaded
+        app = web.Application()
+
+        async def health(request: web.Request) -> web.Response:
+            if not self.ready:
+                return web.json_response({"ready": False}, status=503)
+            return web.json_response({"ready": True,
+                                      "processed": self.processed})
+
+        app.router.add_get("/health", health)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", self.cfg.port).start()
+
+        await asyncio.to_thread(self.handler.load)
+        self.ready = True
+        log.info("taskqueue runner ready (%d pollers)", self.cfg.workers)
+        await asyncio.gather(*[self.poll_loop(i)
+                               for i in range(max(self.cfg.workers, 1))])
+
+
+def _jsonable(obj):
+    import json
+    try:
+        json.dumps(obj)
+        return obj
+    except TypeError:
+        from .common import json_default
+        return json_default(obj)
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    cfg = RunnerConfig.from_env()
+    if not cfg.handler:
+        print("TPU9_HANDLER not set", file=sys.stderr)
+        sys.exit(2)
+    asyncio.run(TaskQueueWorker(cfg).main())
+
+
+if __name__ == "__main__":
+    main()

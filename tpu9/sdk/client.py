@@ -113,6 +113,37 @@ class AsyncGatewayClient:
         return await self.request("POST", f"/endpoint/{name}{path}",
                                   json_body=payload)
 
+    async def taskqueue_put(self, stub_id: str, args: list, kwargs: dict) -> str:
+        out = await self.request("POST", "/rpc/taskqueue/put", json_body={
+            "stub_id": stub_id, "args": args, "kwargs": kwargs})
+        return out["task_id"]
+
+    async def function_invoke(self, stub_id: str, args: list, kwargs: dict,
+                              wait: bool = True, timeout: float = 0) -> dict:
+        body = {"stub_id": stub_id, "args": args, "kwargs": kwargs,
+                "wait": wait}
+        if timeout:
+            body["timeout"] = timeout
+        return await self.request("POST", "/rpc/function/invoke",
+                                  json_body=body)
+
+    async def task_result(self, task_id: str, timeout: float = 0) -> Any:
+        return await self.request(
+            "GET", f"/rpc/task/{task_id}/result?timeout={timeout}")
+
+    async def task_status(self, task_id: str) -> dict:
+        return await self.request("GET", f"/rpc/task/{task_id}")
+
+    async def task_cancel(self, task_id: str) -> bool:
+        out = await self.request("POST", f"/rpc/task/{task_id}/cancel",
+                                 json_body={})
+        return out.get("ok", False)
+
+    async def schedule_register(self, stub_id: str, cron: str) -> str:
+        out = await self.request("POST", "/rpc/schedule/register", json_body={
+            "stub_id": stub_id, "cron": cron})
+        return out["schedule_id"]
+
 
 class GatewayError(RuntimeError):
     def __init__(self, status: int, payload: Any):
@@ -157,3 +188,23 @@ class GatewayClient:
 
     def invoke(self, name: str, payload: Any) -> Any:
         return self._run(lambda c: c.invoke(name, payload))
+
+    def taskqueue_put(self, stub_id: str, args: list, kwargs: dict) -> str:
+        return self._run(lambda c: c.taskqueue_put(stub_id, args, kwargs))
+
+    def function_invoke(self, stub_id: str, args: list, kwargs: dict,
+                        wait: bool = True, timeout: float = 0) -> dict:
+        return self._run(lambda c: c.function_invoke(stub_id, args, kwargs,
+                                                     wait, timeout))
+
+    def task_result(self, task_id: str, timeout: float = 0) -> Any:
+        return self._run(lambda c: c.task_result(task_id, timeout))
+
+    def task_status(self, task_id: str) -> dict:
+        return self._run(lambda c: c.task_status(task_id))
+
+    def task_cancel(self, task_id: str) -> bool:
+        return self._run(lambda c: c.task_cancel(task_id))
+
+    def schedule_register(self, stub_id: str, cron: str) -> str:
+        return self._run(lambda c: c.schedule_register(stub_id, cron))

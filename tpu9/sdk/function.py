@@ -1,0 +1,114 @@
+"""@function and @schedule decorators.
+
+Reference analogue: ``sdk/src/beta9/abstractions/function.py`` —
+``Function.remote()`` (:208), ``.map()`` fan-out (:294), ``Schedule`` (:444).
+
+    from tpu9 import function, schedule
+
+    @function(cpu=2, tpu="v5e-1")
+    def embed(batch):
+        return model(batch)
+
+    embed.remote([1, 2, 3])                # blocking remote call
+    list(embed.map(batches))               # fan-out across containers
+
+    @schedule(when="*/5 * * * *")
+    def cleanup():
+        ...
+    cleanup.deploy("cleanup")              # registers the cron
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any, Iterable, Iterator, Optional
+
+from .base import RunnerAbstraction
+
+
+class TaskHandle:
+    def __init__(self, task_id: str, client):
+        self.task_id = task_id
+        self._client = client
+
+    def result(self, timeout: float = 0) -> Any:
+        out = self._client.task_result(self.task_id, timeout=timeout)
+        if isinstance(out, dict) and "error" in out:
+            raise RemoteError(out["error"])
+        return out.get("result") if isinstance(out, dict) else out
+
+    def status(self) -> str:
+        return self._client.task_status(self.task_id)["status"]
+
+    def cancel(self) -> bool:
+        return self._client.task_cancel(self.task_id)
+
+
+class RemoteError(RuntimeError):
+    pass
+
+
+class Function(RunnerAbstraction):
+    stub_type = "function"
+
+    def remote(self, *args: Any, **kwargs: Any) -> Any:
+        """Execute remotely, block for the result."""
+        stub_id = self.prepare_runtime()
+        out = self.client.function_invoke(stub_id, list(args), kwargs,
+                                          wait=True,
+                                          timeout=self.config.timeout_s)
+        if "error" in out:
+            raise RemoteError(out["error"])
+        return out.get("result")
+
+    def submit(self, *args: Any, **kwargs: Any) -> TaskHandle:
+        """Fire-and-forget; returns a handle to poll."""
+        stub_id = self.prepare_runtime()
+        out = self.client.function_invoke(stub_id, list(args), kwargs,
+                                          wait=False)
+        return TaskHandle(out["task_id"], self.client)
+
+    def map(self, inputs: Iterable[Any], max_parallel: int = 16) -> Iterator[Any]:
+        """Fan out one container per input; yield results in input order
+        (reference function.py:294)."""
+        self.prepare_runtime()
+        handles = [self.submit(item) for item in inputs]
+        with concurrent.futures.ThreadPoolExecutor(max_parallel) as pool:
+            futs = [pool.submit(h.result, self.config.timeout_s or 3600)
+                    for h in handles]
+            for fut in futs:
+                yield fut.result()
+
+
+class Schedule(Function):
+    stub_type = "schedule"
+
+    def __init__(self, func=None, *, when: str = "", **kwargs):
+        super().__init__(func, **kwargs)
+        self.when = when
+
+    def deploy(self, name: str = "", sync_root: str = ".") -> dict:
+        stub_id = self.prepare_runtime(sync_root=sync_root)
+        schedule_id = self.client.schedule_register(stub_id, self.when)
+        out = self.client.deploy(stub_id, name or self.name
+                                 or self.handler_spec.replace(":", "-"))
+        out["schedule_id"] = schedule_id
+        return out
+
+
+def function(func=None, **kwargs):
+    if func is not None and callable(func) and not kwargs:
+        return Function(func)
+    def inner(f):
+        return Function(f, **kwargs)
+    return inner
+
+
+def schedule(func=None, *, when: str = "", **kwargs):
+    if not when:
+        raise ValueError("schedule requires when='<cron expr>'")
+    def inner(f):
+        return Schedule(f, when=when, **kwargs)
+    if func is not None and callable(func):
+        return inner(func)
+    return inner

@@ -1,0 +1,39 @@
+"""@task_queue decorator.
+
+Reference analogue: ``sdk/src/beta9/abstractions/taskqueue.py``. Producers
+``.put()`` tasks; consumer containers run the same handler via the taskqueue
+runner and autoscale on queue depth.
+
+    from tpu9 import task_queue, QueueDepthAutoscaler
+
+    @task_queue(cpu=1, tpu="v5e-1",
+                autoscaler=QueueDepthAutoscaler(max_containers=8))
+    def embed_image(url: str):
+        ...
+
+    embed_image.put("https://...")
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .base import RunnerAbstraction
+from .function import TaskHandle
+
+
+class TaskQueue(RunnerAbstraction):
+    stub_type = "taskqueue"
+
+    def put(self, *args: Any, **kwargs: Any) -> TaskHandle:
+        stub_id = self.prepare_runtime()
+        task_id = self.client.taskqueue_put(stub_id, list(args), kwargs)
+        return TaskHandle(task_id, self.client)
+
+
+def task_queue(func=None, **kwargs):
+    if func is not None and callable(func) and not kwargs:
+        return TaskQueue(func)
+    def inner(f):
+        return TaskQueue(f, **kwargs)
+    return inner

@@ -1,0 +1,249 @@
+"""Task dispatcher: executor-keyed task lifecycle with policies.
+
+Reference analogue: ``pkg/task/dispatch.go`` — Register/Send/Retrieve with a
+monitor goroutine enforcing TaskPolicy (timeout, retries, pending expiry) and
+re-queuing work lost to dead containers. Durable record in the backend,
+hot state in the task repository.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Awaitable, Callable, Optional
+
+from ..backend import BackendDB
+from ..repository import TaskRepository
+from ..statestore import StateStore
+from ..types import TaskMessage, TaskPolicy, TaskStatus, new_id
+
+log = logging.getLogger("tpu9.task")
+
+# executor callbacks: async (msg) -> None, used by monitor-driven requeues
+ExecutorFn = Callable[[TaskMessage], Awaitable[None]]
+
+
+class Dispatcher:
+    def __init__(self, store: StateStore, backend: BackendDB,
+                 monitor_interval_s: float = 1.0):
+        self.store = store
+        self.tasks = TaskRepository(store)
+        self.backend = backend
+        # liveness oracle for claimed containers (gateway wires the container
+        # repo in); safety net for workers that die without publishing exits
+        self.container_alive = None   # async (container_id) -> bool
+        self.monitor_interval_s = monitor_interval_s
+        self._executors: dict[str, ExecutorFn] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._exit_task: Optional[asyncio.Task] = None
+
+    def register(self, executor: str, requeue: ExecutorFn) -> None:
+        self._executors[executor] = requeue
+
+    async def start(self) -> "Dispatcher":
+        if self._task is None:
+            # subscribe before spawning the loop so no exit event published
+            # between start() and the task's first run is missed
+            self._exit_sub = self.store.subscribe("events:container_exit")
+            self._task = asyncio.create_task(self._monitor_loop())
+            self._exit_task = asyncio.create_task(self._exit_loop())
+        return self
+
+    async def stop(self) -> None:
+        for t in (self._task, self._exit_task):
+            if t:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+        self._task = self._exit_task = None
+
+    async def _exit_loop(self) -> None:
+        """Requeue tasks claimed by containers that exit (container-lost
+        recovery without waiting for the task timeout)."""
+        sub = self._exit_sub
+        try:
+            while True:
+                msg = await sub.get(timeout=1.0)
+                if msg is None:
+                    continue
+                _, payload = msg
+                if payload and payload.get("container_id"):
+                    await self.requeue_lost(payload["container_id"])
+        except asyncio.CancelledError:
+            raise
+        finally:
+            sub.close()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def send(self, executor: str, stub_id: str, workspace_id: str,
+                   args: list[Any], kwargs: dict[str, Any],
+                   policy: Optional[TaskPolicy] = None,
+                   enqueue: bool = True) -> TaskMessage:
+        """``enqueue=False`` for executor-pinned tasks (function containers
+        receive their task id via env instead of popping a queue)."""
+        msg = TaskMessage(
+            task_id=new_id("task"), stub_id=stub_id, workspace_id=workspace_id,
+            executor=executor, handler_args=args, handler_kwargs=kwargs,
+            policy=policy or TaskPolicy())
+        await self.tasks.put_message(msg)
+        if enqueue:
+            await self.tasks.enqueue(workspace_id, stub_id, msg.task_id)
+        await self.backend.record_task(msg.task_id, stub_id, workspace_id,
+                                       TaskStatus.PENDING.value)
+        return msg
+
+    async def claim(self, task_id: str, container_id: str) -> Optional[TaskMessage]:
+        msg = await self.tasks.get_message(task_id)
+        if msg is None or TaskStatus(msg.status).terminal:
+            return None
+        if msg.status == TaskStatus.RUNNING.value:
+            # idempotent for the owning container; a second container must
+            # not steal a running task (duplicate execution)
+            return msg if msg.container_id == container_id else None
+        # a claim always removes the task from the queue, so a claim that
+        # races a queue pop can't double-execute
+        await self.tasks.remove_from_queue(msg.workspace_id, msg.stub_id,
+                                           task_id)
+        msg = await self.tasks.set_status(task_id, TaskStatus.RUNNING.value,
+                                          container_id=container_id)
+        await self.tasks.claim(container_id, task_id, time.time())
+        await self.backend.update_task_status(task_id, TaskStatus.RUNNING.value,
+                                              container_id)
+        return msg
+
+    async def complete(self, task_id: str, result: Any = None,
+                       error: Optional[str] = None,
+                       container_id: str = "") -> Optional[TaskMessage]:
+        msg = await self.tasks.get_message(task_id)
+        if msg is None:
+            return None
+        if TaskStatus(msg.status).terminal:
+            return None   # cancelled/expired attempts must not resurrect
+        if container_id and msg.container_id and msg.container_id != container_id:
+            # stale attempt from a container the monitor already replaced
+            await self.tasks.unclaim(container_id, task_id)
+            return None
+        status = TaskStatus.ERROR.value if error else TaskStatus.COMPLETE.value
+        payload = {"error": error} if error else {"result": result}
+        await self.tasks.store_result(task_id, payload)
+        out = await self.tasks.set_status(task_id, status)
+        if msg.container_id:
+            await self.tasks.unclaim(msg.container_id, task_id)
+        await self.backend.update_task_status(task_id, status)
+        return out
+
+    async def cancel(self, task_id: str) -> bool:
+        msg = await self.tasks.get_message(task_id)
+        if msg is None or TaskStatus(msg.status).terminal:
+            return False
+        await self.tasks.remove_from_queue(msg.workspace_id, msg.stub_id,
+                                           task_id)
+        await self.tasks.set_status(task_id, TaskStatus.CANCELLED.value)
+        if msg.container_id:
+            await self.tasks.unclaim(msg.container_id, task_id)
+        await self.backend.update_task_status(task_id,
+                                              TaskStatus.CANCELLED.value)
+        return True
+
+    async def retrieve(self, task_id: str, timeout: float = 0,
+                       poll_s: float = 0.05) -> Optional[dict]:
+        """Wait up to ``timeout`` seconds for a terminal result payload
+        (``timeout=0`` = single non-blocking check). Returns None while the
+        task is still pending/running."""
+        deadline = time.monotonic() + timeout
+        while True:
+            result = await self.tasks.get_result(task_id)
+            if result is not None:
+                return result
+            msg = await self.tasks.get_message(task_id)
+            if msg is not None and TaskStatus(msg.status).terminal:
+                return {"error": f"task {msg.status}"}
+            if time.monotonic() >= deadline:
+                return None
+            await asyncio.sleep(poll_s)
+
+    # -- monitor -------------------------------------------------------------
+
+    async def _monitor_loop(self) -> None:
+        while True:
+            try:
+                await self._monitor_pass()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("task monitor pass failed")
+            await asyncio.sleep(self.monitor_interval_s)
+
+    async def _monitor_pass(self) -> None:
+        now = time.time()
+        keys = await self.tasks.store.keys("task:msg:*")
+        for key in keys:
+            task_id = key.rsplit(":", 1)[-1]
+            msg = await self.tasks.get_message(task_id)
+            if msg is None or TaskStatus(msg.status).terminal:
+                continue
+            policy = msg.policy
+            age = now - msg.created_at
+            if msg.status == TaskStatus.PENDING.value:
+                if policy.expires_s and age > policy.expires_s:
+                    await self.tasks.remove_from_queue(
+                        msg.workspace_id, msg.stub_id, task_id)
+                    await self._finalize(msg, TaskStatus.EXPIRED.value,
+                                         "pending past expiry")
+                continue
+            # RUNNING: enforce timeout
+            if policy.timeout_s and age > policy.timeout_s:
+                await self._retry_or_fail(msg, TaskStatus.TIMEOUT.value,
+                                          "timed out")
+        # crashed-worker safety net: claims whose container state vanished
+        # (worker died before publishing an exit event)
+        if self.container_alive is not None:
+            for key in await self.tasks.store.keys("task:claims:*"):
+                container_id = key.rsplit(":", 1)[-1]
+                if not await self.tasks.claims(container_id):
+                    continue
+                if not await self.container_alive(container_id):
+                    await self.requeue_lost(container_id)
+
+    async def requeue_lost(self, container_id: str) -> int:
+        """Container died — re-queue its claimed tasks (monitor hook called by
+        abstractions on container-exit events)."""
+        n = 0
+        for task_id in await self.tasks.claims(container_id):
+            msg = await self.tasks.get_message(task_id)
+            await self.tasks.unclaim(container_id, task_id)
+            if msg is None or TaskStatus(msg.status).terminal:
+                continue
+            await self._retry_or_fail(msg, TaskStatus.ERROR.value,
+                                      "container lost")
+            n += 1
+        return n
+
+    async def _retry_or_fail(self, msg: TaskMessage, fail_status: str,
+                             reason: str) -> None:
+        if msg.retry_count < msg.policy.max_retries:
+            msg.retry_count += 1
+            msg.status = TaskStatus.RETRY.value
+            msg.created_at = time.time()
+            msg.container_id = ""
+            await self.tasks.put_message(msg)
+            await self.tasks.set_status(msg.task_id, TaskStatus.PENDING.value)
+            await self.tasks.enqueue(msg.workspace_id, msg.stub_id,
+                                     msg.task_id)
+            executor = self._executors.get(msg.executor)
+            if executor is not None:
+                await executor(msg)
+            log.info("task %s requeued (%s, attempt %d)", msg.task_id, reason,
+                     msg.retry_count)
+        else:
+            await self._finalize(msg, fail_status, reason)
+
+    async def _finalize(self, msg: TaskMessage, status: str, reason: str) -> None:
+        await self.tasks.store_result(msg.task_id, {"error": reason})
+        await self.tasks.set_status(msg.task_id, status)
+        await self.backend.update_task_status(msg.task_id, status)
+        log.info("task %s → %s (%s)", msg.task_id, status, reason)

@@ -164,6 +164,10 @@ class Worker:
         await self._release_capacity(request)
         await self.workers.remove_worker_container(self.worker_id,
                                                    request.container_id)
+        # let task owners reclaim work lost with this container
+        await self.store.publish("events:container_exit",
+                                 {"container_id": request.container_id,
+                                  "stub_id": request.stub_id})
         self._last_activity = time.monotonic()
 
     async def _release_capacity(self, request: ContainerRequest) -> None:
