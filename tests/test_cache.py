@@ -1,0 +1,139 @@
+import asyncio
+import os
+
+from tpu9.cache import CacheClient, ChunkServer, DiskStore, hrw_order
+from tpu9.cache.store import chunk_hash
+
+
+async def test_disk_store_roundtrip(tmp_path):
+    store = DiskStore(str(tmp_path), max_bytes=1 << 20)
+    data = b"hello chunk"
+    digest = await store.put(data)
+    assert digest == chunk_hash(data)
+    assert store.has(digest)
+    assert await store.get(digest) == data
+    assert await store.get("0" * 64) is None
+    assert store.stats["hits"] == 1 and store.stats["misses"] == 1
+
+
+async def test_disk_store_eviction(tmp_path):
+    store = DiskStore(str(tmp_path), max_bytes=10_000)
+    digests = []
+    for i in range(20):
+        digests.append(await store.put(bytes([i]) * 1000))
+    await asyncio.sleep(0.01)
+    assert store.used_bytes <= 10_000
+    assert store.stats["evictions"] > 0
+    # newest entries survive
+    assert store.has(digests[-1])
+
+
+def test_hrw_deterministic_and_balanced():
+    peers = [f"10.0.0.{i}:70" for i in range(4)]
+    assert hrw_order("abc", peers) == hrw_order("abc", peers)
+    # removing a peer must not reshuffle the others' relative order
+    full = hrw_order("abc", peers)
+    without = hrw_order("abc", peers[:3])
+    assert [p for p in full if p in without] == without
+    # distribution: each peer is primary for some chunks
+    primaries = {hrw_order(f"chunk{i}", peers)[0] for i in range(100)}
+    assert len(primaries) == 4
+
+
+async def test_chunk_server_and_client_peer_path(tmp_path):
+    store_a = DiskStore(str(tmp_path / "a"))
+    server_a = await ChunkServer(store_a).start()
+    data = b"x" * 100_000
+    digest = await store_a.put(data)
+
+    store_b = DiskStore(str(tmp_path / "b"))
+
+    async def peers():
+        return [server_a.address]
+
+    client_b = CacheClient(store_b, peers)
+    try:
+        got = await client_b.get(digest)
+        assert got == data
+        assert client_b.stats["peer_hits"] == 1
+        # second read is a local hit
+        await client_b.get(digest)
+        assert client_b.stats["local_hits"] == 1
+        # missing chunk: peer miss + no source → None
+        assert await client_b.get("f" * 64) is None
+    finally:
+        await client_b.close()
+        await server_a.stop()
+
+
+async def test_client_source_fallback_and_seed(tmp_path):
+    store_a = DiskStore(str(tmp_path / "a"))
+    server_a = await ChunkServer(store_a).start()
+    store_b = DiskStore(str(tmp_path / "b"))
+    blob = b"source data" * 1000
+    digest = chunk_hash(blob)
+
+    async def peers():
+        return [server_a.address]
+
+    async def source(d):
+        return blob if d == digest else None
+
+    client = CacheClient(store_b, peers, source=source)
+    try:
+        got = await client.get(digest)
+        assert got == blob
+        assert client.stats["source_fetches"] == 1
+        await asyncio.sleep(0.1)   # background seed of the HRW primary
+        assert store_a.has(digest)
+    finally:
+        await client.close()
+        await server_a.stop()
+
+
+async def test_client_put_replicates(tmp_path):
+    store_a = DiskStore(str(tmp_path / "a"))
+    server_a = await ChunkServer(store_a).start()
+    store_b = DiskStore(str(tmp_path / "b"))
+
+    async def peers():
+        return [server_a.address]
+
+    client = CacheClient(store_b, peers, replicas=1)
+    try:
+        digest = await client.put(b"replicate me")
+        assert store_b.has(digest)
+        assert store_a.has(digest)
+    finally:
+        await client.close()
+        await server_a.stop()
+
+
+async def test_corrupt_peer_data_rejected(tmp_path):
+    """A peer returning bytes that don't match the digest must be ignored."""
+    store_a = DiskStore(str(tmp_path / "a"))
+    server_a = await ChunkServer(store_a).start()
+    good = b"good data"
+    digest = chunk_hash(good)
+    # poison peer store: wrong content under the right name
+    evil_path = store_a._path(digest)
+    os.makedirs(os.path.dirname(evil_path), exist_ok=True)
+    with open(evil_path, "wb") as f:
+        f.write(b"evil data")
+
+    store_b = DiskStore(str(tmp_path / "b"))
+
+    async def peers():
+        return [server_a.address]
+
+    async def source(d):
+        return good if d == digest else None
+
+    client = CacheClient(store_b, peers, source=source)
+    try:
+        got = await client.get(digest)
+        assert got == good                      # fell through to source
+        assert client.stats["source_fetches"] == 1
+    finally:
+        await client.close()
+        await server_a.stop()

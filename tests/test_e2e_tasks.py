@@ -25,9 +25,11 @@ def handler(marker=""):
 """
 
 
-async def deploy_tq(stack, name, files, handler, **extra):
+async def deploy_tq(stack, name, files, handler, retries=0, timeout_s=180.0,
+                    **extra):
     object_id = await stack.upload_workspace(files)
     config = {"handler": handler, "keep_warm_seconds": 2.0,
+              "retries": retries, "timeout_s": timeout_s,
               "autoscaler": {"max_containers": 3, "tasks_per_container": 1},
               **extra}
     status, out = await stack.api("POST", "/rpc/stub/get-or-create", json_body={
@@ -88,6 +90,21 @@ async def test_function_invoke_roundtrip():
                                          timeout=120)
         assert status == 200, result
         assert result["result"] == {"square": 81}
+
+
+async def test_taskqueue_handler_error_retries_then_succeeds():
+    """A handler that fails once succeeds on the retry (complete(error)
+    honors TaskPolicy.max_retries)."""
+    async with LocalStack() as stack:
+        stub_id = await deploy_tq(stack, "flaky", {"app.py": FLAKY},
+                                  "app:handler", retries=2, timeout_s=60.0)
+        _, out = await stack.api("POST", "/rpc/taskqueue/put", json_body={
+            "stub_id": stub_id, "kwargs": {"marker": "flaky-e2e"}})
+        status, result = await stack.api(
+            "GET", f"/rpc/task/{out['task_id']}/result?timeout=90",
+            timeout=100)
+        assert status == 200, result
+        assert result["result"] == {"attempt": 2}
 
 
 async def test_function_error_reported():

@@ -1,0 +1,90 @@
+import os
+
+import pytest
+
+from tpu9.cache import CacheClient, DiskStore
+from tpu9.images import ImageBuilder, ImageManifest, ImagePuller, ImageSpec
+from tpu9.images.manifest import materialize, snapshot_dir
+
+
+def test_spec_id_deterministic():
+    a = ImageSpec(python_packages=["jax", "flax"], commands=["echo hi"])
+    b = ImageSpec(python_packages=["jax", "flax"], commands=["echo hi"])
+    c = ImageSpec(python_packages=["jax"])
+    assert a.image_id == b.image_id != c.image_id
+
+
+def test_snapshot_and_materialize_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_bytes(b"A" * 10)
+    big = os.urandom(3 * 1024 * 1024)
+    (src / "sub" / "big.bin").write_bytes(big)
+    os.chmod(src / "a.txt", 0o640)
+    os.symlink("a.txt", src / "link.txt")
+
+    chunks: dict[str, bytes] = {}
+    manifest = snapshot_dir(str(src), chunk_bytes=1 << 20,
+                            put_chunk=lambda d, h: chunks.__setitem__(h, d))
+    assert manifest.total_bytes == 10 + len(big)
+    big_entry = next(f for f in manifest.files if f.path.endswith("big.bin"))
+    assert len(big_entry.chunks) == 3
+    link = next(f for f in manifest.files if f.path == "link.txt")
+    assert link.link_target == "a.txt"
+
+    dest = tmp_path / "dest"
+    materialize(manifest, str(dest), chunks.get)
+    assert (dest / "a.txt").read_bytes() == b"A" * 10
+    assert (dest / "sub" / "big.bin").read_bytes() == big
+    assert oct((dest / "a.txt").stat().st_mode & 0o777) == "0o640"
+    assert os.readlink(dest / "link.txt") == "a.txt"
+
+    # manifest json roundtrip
+    back = ImageManifest.from_json(manifest.to_json())
+    assert back.manifest_hash == manifest.manifest_hash
+
+
+async def test_builder_commands_and_dedupe(tmp_path):
+    builder = ImageBuilder(str(tmp_path / "registry"))
+    spec = ImageSpec(commands=["mkdir -p env && echo marker > env/file.txt"])
+    logs = []
+    m1 = await builder.build(spec, log_cb=logs.append)
+    assert builder.has_image(spec.image_id)
+    assert any("file.txt" in f.path for f in m1.files)
+    # second build returns cached manifest without running commands
+    m2 = await builder.build(spec)
+    assert m2.manifest_hash == m1.manifest_hash
+
+
+async def test_builder_failure_surfaces(tmp_path):
+    from tpu9.images.builder import BuildError
+    builder = ImageBuilder(str(tmp_path / "registry"))
+    spec = ImageSpec(commands=["exit 3"])
+    with pytest.raises(BuildError):
+        await builder.build(spec)
+    assert not builder.has_image(spec.image_id)
+
+
+async def test_puller_end_to_end(tmp_path):
+    builder = ImageBuilder(str(tmp_path / "registry"))
+    spec = ImageSpec(commands=["mkdir -p env && echo data > env/x.txt"],
+                     env={"IMGVAR": "1"})
+    manifest = await builder.build(spec)
+
+    store = DiskStore(str(tmp_path / "cache"))
+
+    async def peers():
+        return []
+
+    async def source(digest):
+        return builder.read_chunk(digest)
+
+    client = CacheClient(store, peers, source=source)
+    puller = ImagePuller(client, str(tmp_path / "bundles"))
+    bundle = await puller.pull(spec.image_id, manifest=manifest)
+    assert os.path.exists(os.path.join(bundle, "env", "x.txt"))
+    assert os.path.exists(os.path.join(bundle, ".tpu9-env.json"))
+    # second pull is a no-op fast path
+    bundle2 = await puller.pull(spec.image_id, manifest=manifest)
+    assert bundle2 == bundle
+    await client.close()

@@ -33,10 +33,11 @@ async def test_send_claim_complete():
 
 async def test_error_and_cancel():
     d, ws, _ = await make_dispatcher()
-    m1 = await d.send("taskqueue", "s", ws.workspace_id, [], {})
+    m1 = await d.send("taskqueue", "s", ws.workspace_id, [], {},
+                      policy=TaskPolicy(max_retries=0))
     await d.claim(m1.task_id, "c1")
     await d.complete(m1.task_id, error="boom")
-    assert (await d.retrieve(m1.task_id, timeout=1))["error"] == "boom"
+    assert "boom" in (await d.retrieve(m1.task_id, timeout=1))["error"]
 
     m2 = await d.send("taskqueue", "s", ws.workspace_id, [], {})
     assert await d.cancel(m2.task_id)
@@ -45,6 +46,16 @@ async def test_error_and_cancel():
     assert await d.tasks.queue_depth(ws.workspace_id, "s") == 0
     # a completed task cannot be resurrected by a stale complete
     assert await d.complete(m1.task_id, result="late") is None
+    # error with retries remaining re-queues instead of finalizing
+    m4 = await d.send("taskqueue", "s", ws.workspace_id, [], {},
+                      policy=TaskPolicy(max_retries=2))
+    await d.tasks.dequeue(ws.workspace_id, "s")   # drain m3's entry
+    await d.tasks.dequeue(ws.workspace_id, "s")   # drain m4's entry
+    await d.claim(m4.task_id, "c1")
+    out = await d.complete(m4.task_id, error="flaky")
+    assert out is not None and out.status == TaskStatus.PENDING.value
+    assert out.retry_count == 1
+    assert await d.tasks.queue_depth(ws.workspace_id, "s") == 1
     # a second container cannot steal a running task
     m3 = await d.send("taskqueue", "s", ws.workspace_id, [], {})
     assert await d.claim(m3.task_id, "cA") is not None

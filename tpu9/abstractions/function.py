@@ -42,17 +42,28 @@ def cron_matches(expr: str, t: Optional[time.struct_time] = None) -> bool:
             if part == "*":
                 return True
             if part.startswith("*/"):
-                if value % int(part[2:]) == 0:
+                step = part[2:]
+                if not step.isdigit() or int(step) == 0:
+                    raise ValueError(f"bad cron step {part!r} in {expr!r}")
+                if value % int(step) == 0:
                     return True
             elif "-" in part:
-                lo, hi = part.split("-")
+                lo, _, hi = part.partition("-")
+                if not (lo.isdigit() and hi.isdigit()):
+                    raise ValueError(f"bad cron range {part!r} in {expr!r}")
                 if int(lo) <= value <= int(hi):
                     return True
-            elif part.isdigit() and int(part) == value:
-                return True
+            elif part.isdigit():
+                if int(part) == value:
+                    return True
+            else:
+                raise ValueError(f"bad cron token {part!r} in {expr!r}")
         return False
 
-    return all(field_matches(f, v) for f, v in zip(fields, values))
+    # evaluate every field so malformed tokens raise even on non-matching
+    # expressions (validation path relies on this)
+    results = [field_matches(f, v) for f, v in zip(fields, values)]
+    return all(results)
 
 
 class FunctionService:

@@ -1,0 +1,78 @@
+"""Image build service (gateway side).
+
+Reference analogue: ``pkg/abstractions/image/build.go`` — the build gRPC
+service that validates/dedupes specs and streams build logs. tpu9 v1 executes
+builds in-process on the control-plane host (a build-pool worker execution
+mode slots in behind the same API; the reference runs builds in containers on
+a build pool, build.go:340).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..backend import BackendDB
+from ..images import ImageBuilder, ImageSpec
+
+log = logging.getLogger("tpu9.abstractions")
+
+
+class ImageService:
+    def __init__(self, backend: BackendDB, builder: ImageBuilder):
+        self.backend = backend
+        self.builder = builder
+        self._builds: dict[str, asyncio.Task] = {}
+        self._logs: dict[str, list[str]] = {}
+
+    async def verify(self, spec: ImageSpec) -> dict:
+        """Does this spec already have a built image? (VerifyImageBuild)"""
+        return {"image_id": spec.image_id,
+                "exists": self.builder.has_image(spec.image_id)}
+
+    async def build(self, workspace_id: str, spec: ImageSpec) -> dict:
+        image_id = spec.image_id
+        if self.builder.has_image(image_id):
+            return {"image_id": image_id, "status": "ready"}
+        if image_id not in self._builds or self._builds[image_id].done():
+            self._logs[image_id] = []
+            await self.backend.upsert_image(image_id, workspace_id,
+                                            spec.to_dict(), status="building")
+            self._builds[image_id] = asyncio.create_task(
+                self._run_build(workspace_id, spec))
+        return {"image_id": image_id, "status": "building"}
+
+    async def _run_build(self, workspace_id: str, spec: ImageSpec) -> None:
+        image_id = spec.image_id
+
+        def log_cb(line: str) -> None:
+            self._logs.setdefault(image_id, []).append(line)
+
+        try:
+            manifest = await self.builder.build(spec, log_cb=log_cb)
+            await self.backend.upsert_image(
+                image_id, workspace_id, spec.to_dict(), status="ready",
+                manifest_hash=manifest.manifest_hash,
+                size=manifest.total_bytes)
+        except Exception as exc:
+            log.warning("build %s failed: %s", image_id, exc)
+            log_cb(f"BUILD FAILED: {exc}")
+            await self.backend.upsert_image(image_id, workspace_id,
+                                            spec.to_dict(), status="failed")
+
+    async def status(self, image_id: str) -> dict:
+        if self.builder.has_image(image_id):
+            return {"image_id": image_id, "status": "ready",
+                    "logs": self._logs.get(image_id, [])}
+        row = await self.backend.get_image(image_id)
+        status = row["status"] if row else "unknown"
+        return {"image_id": image_id, "status": status,
+                "logs": self._logs.get(image_id, [])}
+
+    def manifest_json(self, image_id: str) -> Optional[str]:
+        m = self.builder.load_manifest(image_id)
+        return m.to_json() if m else None
+
+    def chunk(self, digest: str) -> Optional[bytes]:
+        return self.builder.read_chunk(digest)

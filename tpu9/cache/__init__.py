@@ -1,0 +1,20 @@
+"""Embedded distributed content cache.
+
+Reference analogue: ``pkg/cache/`` (~18k LoC) — the peer-to-peer
+content-addressed cache behind image pulls, volume reads, and checkpoint
+artifacts: rendezvous/HRW client (client.go:187), raw-TCP server
+(raw_transport.go), disk store with eviction (storage.go:71), prefetcher.
+
+tpu9's design (protocol ideas, not a port): chunks are sha256-addressed blobs
+(default 4 MiB). Every worker runs a ChunkServer over its DiskStore; clients
+route by HRW over the live peer set from the worker registry, fall back to
+any holder, then to the source-of-truth store (the gateway registry dir /
+object storage). The TCP framing is shared with the state bus (msgpack
+header + raw payload) so one wire stack serves both.
+"""
+
+from .store import DiskStore
+from .server import ChunkServer
+from .client import CacheClient, hrw_order
+
+__all__ = ["DiskStore", "ChunkServer", "CacheClient", "hrw_order"]

@@ -1,0 +1,158 @@
+"""Cache client with rendezvous (HRW) routing.
+
+Reference analogue: ``pkg/cache/client.go:187,272`` — highest-random-weight
+hashing over discovered hosts picks the canonical holder for each chunk;
+reads try local disk, then the HRW-ordered peers, then the source of truth;
+writes land locally and on the primary peer. Peer discovery is injected (the
+worker registry advertises cache addresses), so the client is transport-pure
+and unit-testable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+from typing import Awaitable, Callable, Optional, Sequence
+
+from ..statestore import wire
+from .store import DiskStore, chunk_hash
+
+log = logging.getLogger("tpu9.cache")
+
+# async () -> list of peer addresses ("host:port")
+PeerFn = Callable[[], Awaitable[Sequence[str]]]
+# async (hash) -> bytes | None — source of truth (registry dir, GCS, ...)
+SourceFn = Callable[[str], Awaitable[Optional[bytes]]]
+
+
+def hrw_order(digest: str, peers: Sequence[str]) -> list[str]:
+    """Peers ordered by highest-random-weight for this chunk."""
+    def weight(peer: str) -> int:
+        return int.from_bytes(
+            hashlib.sha256(f"{digest}|{peer}".encode()).digest()[:8], "big")
+
+    return sorted(peers, key=weight, reverse=True)
+
+
+class CacheClient:
+    def __init__(self, store: DiskStore, peers: PeerFn,
+                 source: Optional[SourceFn] = None,
+                 self_address: str = "", replicas: int = 1,
+                 connect_timeout: float = 2.0):
+        self.store = store
+        self.peers = peers
+        self.source = source
+        self.self_address = self_address
+        self.replicas = replicas
+        self.connect_timeout = connect_timeout
+        self._conns: dict[str, tuple[asyncio.StreamReader,
+                                     asyncio.StreamWriter]] = {}
+        self._conn_locks: dict[str, asyncio.Lock] = {}
+        self.stats = {"local_hits": 0, "peer_hits": 0, "source_fetches": 0,
+                      "peer_errors": 0}
+
+    async def close(self) -> None:
+        for _, writer in self._conns.values():
+            writer.close()
+        self._conns.clear()
+
+    # -- wire ---------------------------------------------------------------
+
+    async def _conn(self, peer: str):
+        entry = self._conns.get(peer)
+        if entry is not None and not entry[1].is_closing():
+            return entry
+        host, _, port = peer.rpartition(":")
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), self.connect_timeout)
+        self._conns[peer] = (reader, writer)
+        return reader, writer
+
+    async def _peer_get(self, peer: str, digest: str) -> Optional[bytes]:
+        lock = self._conn_locks.setdefault(peer, asyncio.Lock())
+        async with lock:
+            try:
+                reader, writer = await self._conn(peer)
+                writer.write(wire.pack({"op": "get", "hash": digest}))
+                await writer.drain()
+                head = await wire.read_frame(reader)
+                if not head.get("ok"):
+                    return None
+                return await reader.readexactly(int(head["len"]))
+            except (OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError) as exc:
+                self.stats["peer_errors"] += 1
+                self._conns.pop(peer, None)
+                log.debug("peer %s get failed: %s", peer, exc)
+                return None
+
+    async def _peer_put(self, peer: str, digest: str, data: bytes) -> bool:
+        lock = self._conn_locks.setdefault(peer, asyncio.Lock())
+        async with lock:
+            try:
+                reader, writer = await self._conn(peer)
+                writer.write(wire.pack({"op": "put", "hash": digest,
+                                        "len": len(data)}))
+                writer.write(data)
+                await writer.drain()
+                head = await wire.read_frame(reader)
+                return bool(head.get("ok"))
+            except (OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError):
+                self.stats["peer_errors"] += 1
+                self._conns.pop(peer, None)
+                return False
+
+    # -- public API ---------------------------------------------------------
+
+    async def get(self, digest: str) -> Optional[bytes]:
+        """local → HRW peers → source (populating local + primary)."""
+        data = await self.store.get(digest)
+        if data is not None:
+            self.stats["local_hits"] += 1
+            return data
+
+        peers = [p for p in await self.peers() if p != self.self_address]
+        for peer in hrw_order(digest, peers)[: max(self.replicas, 1) + 1]:
+            data = await self._peer_get(peer, digest)
+            if data is not None and chunk_hash(data) == digest:
+                self.stats["peer_hits"] += 1
+                await self.store.put(data, digest)
+                return data
+
+        if self.source is not None:
+            data = await self.source(digest)
+            if data is not None:
+                self.stats["source_fetches"] += 1
+                await self.store.put(data, digest)
+                # seed the canonical holder so the next reader hits a peer
+                ordered = hrw_order(digest, peers)
+                if ordered:
+                    asyncio.create_task(self._peer_put(ordered[0], digest,
+                                                       data))
+                return data
+        return None
+
+    async def put(self, data: bytes, digest: str = "") -> str:
+        digest = digest or chunk_hash(data)
+        await self.store.put(data, digest)
+        peers = [p for p in await self.peers() if p != self.self_address]
+        ordered = hrw_order(digest, peers)[: self.replicas]
+        for peer in ordered:
+            await self._peer_put(peer, digest, data)
+        return digest
+
+    async def get_many(self, digests: Sequence[str],
+                       max_parallel: int = 8) -> dict[str, Optional[bytes]]:
+        """Parallel fetch with bounded concurrency (prefetch window —
+        reference prefetcher.go:49)."""
+        sem = asyncio.Semaphore(max_parallel)
+        out: dict[str, Optional[bytes]] = {}
+
+        async def one(d: str) -> None:
+            async with sem:
+                out[d] = await self.get(d)
+
+        await asyncio.gather(*[one(d) for d in dict.fromkeys(digests)])
+        return out

@@ -1,0 +1,113 @@
+"""Chunk server: raw-TCP data plane over a DiskStore.
+
+Protocol (shares the state-bus framing): request frame
+``{"op": "get"|"put"|"has"|"stats", "hash": ..., "len": n}``; for ``put`` the
+raw chunk bytes follow the header frame; ``get`` replies
+``{"ok": true, "len": n}`` then n raw bytes (zero-copy from the store file
+via loop.sendfile when the transport supports it — the reference uses
+sendfile(2) the same way, pkg/cache/sendfile_linux.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+from ..statestore import wire
+from .store import DiskStore, chunk_hash
+
+log = logging.getLogger("tpu9.cache")
+
+MAX_CHUNK = 64 * 1024 * 1024
+
+
+class ChunkServer:
+    def __init__(self, store: DiskStore, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.store = store
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> "ChunkServer":
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    req = await wire.read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                op = req.get("op")
+                if op == "get":
+                    await self._serve_get(req.get("hash", ""), writer)
+                elif op == "put":
+                    n = int(req.get("len", 0))
+                    if n > MAX_CHUNK:
+                        writer.write(wire.pack({"ok": False,
+                                                "error": "chunk too large"}))
+                        await writer.drain()
+                        break
+                    data = await reader.readexactly(n)
+                    digest = await self.store.put(data, req.get("hash") or
+                                                  chunk_hash(data))
+                    writer.write(wire.pack({"ok": True, "hash": digest}))
+                elif op == "has":
+                    writer.write(wire.pack({"ok": True,
+                                            "has": self.store.has(
+                                                req.get("hash", ""))}))
+                elif op == "stats":
+                    writer.write(wire.pack({"ok": True,
+                                            "used": self.store.used_bytes,
+                                            **self.store.stats}))
+                else:
+                    writer.write(wire.pack({"ok": False,
+                                            "error": f"bad op {op!r}"}))
+                await writer.drain()
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _serve_get(self, digest: str,
+                         writer: asyncio.StreamWriter) -> None:
+        path = self.store.get_path(digest)
+        if path is None:
+            writer.write(wire.pack({"ok": False, "error": "not found"}))
+            return
+        size = os.path.getsize(path)
+        writer.write(wire.pack({"ok": True, "len": size}))
+        await writer.drain()
+        loop = asyncio.get_running_loop()
+        transport = writer.transport
+        try:
+            with open(path, "rb") as f:
+                await loop.sendfile(transport, f, fallback=True)
+        except (NotImplementedError, AttributeError, RuntimeError):
+            # transport without sendfile: stream manually
+            with open(path, "rb") as f:
+                while True:
+                    block = f.read(1 << 20)
+                    if not block:
+                        break
+                    writer.write(block)
+                    await writer.drain()

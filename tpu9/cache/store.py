@@ -1,0 +1,123 @@
+"""Content-addressed disk store with LRU eviction.
+
+Layout: ``<root>/aa/<sha256>`` (2-hex fan-out). Eviction walks by access
+time once usage crosses ``max_bytes`` (reference: pkg/cache/storage.go:71 +
+storage_eviction.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import tempfile
+import time
+from typing import Optional
+
+
+def chunk_hash(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class DiskStore:
+    def __init__(self, root: str, max_bytes: int = 32 * 1024 ** 3):
+        self.root = root
+        self.max_bytes = max_bytes
+        os.makedirs(root, exist_ok=True)
+        self._used = 0
+        self._scan_usage()
+        self._lock = asyncio.Lock()
+        self.stats = {"hits": 0, "misses": 0, "puts": 0, "evictions": 0}
+
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.root, digest[:2], digest)
+
+    def _scan_usage(self) -> None:
+        total = 0
+        for dirpath, _dirnames, filenames in os.walk(self.root):
+            for fn in filenames:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, fn))
+                except OSError:
+                    pass
+        self._used = total
+
+    @property
+    def used_bytes(self) -> int:
+        return self._used
+
+    def has(self, digest: str) -> bool:
+        return os.path.exists(self._path(digest))
+
+    def get_path(self, digest: str) -> Optional[str]:
+        """Path for zero-copy reads (sendfile/hardlink); touches atime."""
+        p = self._path(digest)
+        if not os.path.exists(p):
+            self.stats["misses"] += 1
+            return None
+        now = time.time()
+        try:
+            os.utime(p, (now, os.path.getmtime(p)))
+        except OSError:
+            pass
+        self.stats["hits"] += 1
+        return p
+
+    async def get(self, digest: str) -> Optional[bytes]:
+        p = self.get_path(digest)
+        if p is None:
+            return None
+        return await asyncio.to_thread(lambda: open(p, "rb").read())
+
+    async def put(self, data: bytes, digest: str = "") -> str:
+        digest = digest or chunk_hash(data)
+        p = self._path(digest)
+        if os.path.exists(p):
+            return digest
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+
+        def write() -> None:
+            # atomic publish: tmp + rename so concurrent readers never see a
+            # partial chunk (reference guards this with mount locks)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(p))
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                os.rename(tmp, p)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+
+        await asyncio.to_thread(write)
+        self._used += len(data)
+        self.stats["puts"] += 1
+        if self._used > self.max_bytes:
+            async with self._lock:
+                await asyncio.to_thread(self._evict)
+        return digest
+
+    def _evict(self) -> None:
+        """Drop least-recently-accessed chunks to 90% of budget."""
+        entries = []
+        for dirpath, _d, filenames in os.walk(self.root):
+            for fn in filenames:
+                p = os.path.join(dirpath, fn)
+                try:
+                    st = os.stat(p)
+                    entries.append((st.st_atime, st.st_size, p))
+                except OSError:
+                    pass
+        entries.sort()
+        target = int(self.max_bytes * 0.9)
+        for _atime, size, p in entries:
+            if self._used <= target:
+                break
+            try:
+                os.unlink(p)
+                self._used -= size
+                self.stats["evictions"] += 1
+            except OSError:
+                pass

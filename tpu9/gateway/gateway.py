@@ -27,7 +27,9 @@ from aiohttp import web
 
 from ..abstractions.endpoint import EndpointService
 from ..abstractions.function import FunctionService
+from ..abstractions.image import ImageService
 from ..abstractions.taskqueue import TaskQueueService
+from ..images import ImageBuilder, ImageSpec
 from ..backend import BackendDB
 from ..config import AppConfig
 from ..repository import ContainerRepository, TaskRepository, WorkerRepository
@@ -67,6 +69,10 @@ class Gateway:
         self.functions = FunctionService(self.backend, self.scheduler,
                                          self.containers, self.dispatcher,
                                          runner_env=self.runner_env)
+        self.images = ImageService(
+            self.backend,
+            ImageBuilder(cfg.image.registry_dir,
+                         network_ok=not os.environ.get("TPU9_NO_EGRESS")))
         self.extra_services: dict[str, object] = {}
         self.state_server: Optional[StateServer] = None
         self._runner: Optional[web.AppRunner] = None
@@ -97,6 +103,12 @@ class Gateway:
         r.add_post("/rpc/task/{task_id}/claim", self._rpc_task_claim)
         r.add_post("/rpc/task/{task_id}/complete", self._rpc_task_complete)
         r.add_post("/rpc/task/{task_id}/cancel", self._rpc_task_cancel)
+        # images
+        r.add_post("/rpc/image/verify", self._rpc_image_verify)
+        r.add_post("/rpc/image/build", self._rpc_image_build)
+        r.add_get("/rpc/image/status/{image_id}", self._rpc_image_status)
+        r.add_get("/rpc/image/manifest/{image_id}", self._rpc_image_manifest)
+        r.add_get("/rpc/image/chunk/{digest}", self._rpc_image_chunk)
         # REST v1 (management)
         r.add_get("/api/v1/deployment", self._list_deployments)
         r.add_delete("/api/v1/deployment/{id}", self._delete_deployment)
@@ -357,6 +369,11 @@ class Gateway:
     async def _rpc_schedule_register(self, request: web.Request) -> web.Response:
         data = await request.json()
         stub = await self._stub_for(request, data["stub_id"])
+        if stub.stub_type not in (StubType.SCHEDULE.value,
+                                  StubType.FUNCTION.value):
+            return web.json_response(
+                {"error": f"schedules require a function/schedule stub, "
+                          f"got {stub.stub_type}"}, status=400)
         try:
             schedule_id = await self.functions.register_schedule(
                 stub, data["cron"])
@@ -409,6 +426,39 @@ class Gateway:
     async def _rpc_task_cancel(self, request: web.Request) -> web.Response:
         msg = await self._task_for(request)
         return web.json_response({"ok": await self.dispatcher.cancel(msg.task_id)})
+
+    # -- handlers: images ------------------------------------------------------
+
+    async def _rpc_image_verify(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        spec = ImageSpec.from_dict(await request.json())
+        return web.json_response(await self.images.verify(spec))
+
+    async def _rpc_image_build(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        spec = ImageSpec.from_dict(await request.json())
+        return web.json_response(await self.images.build(ws.workspace_id,
+                                                         spec))
+
+    async def _rpc_image_status(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        return web.json_response(
+            await self.images.status(request.match_info["image_id"]))
+
+    async def _rpc_image_manifest(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        blob = self.images.manifest_json(request.match_info["image_id"])
+        if blob is None:
+            return web.json_response({"error": "image not found"}, status=404)
+        return web.Response(text=blob, content_type="application/json")
+
+    async def _rpc_image_chunk(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        data = self.images.chunk(request.match_info["digest"])
+        if data is None:
+            return web.json_response({"error": "chunk not found"}, status=404)
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
 
     # -- handlers: invoke ------------------------------------------------------
 

@@ -1,0 +1,7 @@
+from .spec import ImageSpec
+from .manifest import ImageManifest, FileEntry
+from .builder import ImageBuilder
+from .puller import ImagePuller
+
+__all__ = ["ImageSpec", "ImageManifest", "FileEntry", "ImageBuilder",
+           "ImagePuller"]

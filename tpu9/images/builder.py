@@ -1,0 +1,147 @@
+"""Image builder: spec → environment dir → chunked manifest in the registry.
+
+Reference analogue: the build service (pkg/abstractions/image/build.go:62)
+which synthesizes a dockerfile from steps and runs it in a build container.
+tpu9 builds an **env snapshot** instead: venv creation + ``pip install`` +
+arbitrary commands executed in a scratch dir, then ``snapshot_dir`` chunks
+the result into the content store. Zero-egress environments (CI, this image)
+use ``pip --no-index`` against a local wheel dir or skip package install;
+the build degrades explicitly, never silently.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+from .manifest import ImageManifest, snapshot_dir
+from .spec import ImageSpec
+
+log = logging.getLogger("tpu9.images")
+
+
+class BuildError(RuntimeError):
+    pass
+
+
+class ImageBuilder:
+    def __init__(self, registry_dir: str, wheel_dir: str = "",
+                 network_ok: bool = True):
+        self.registry_dir = registry_dir
+        self.wheel_dir = wheel_dir
+        self.network_ok = network_ok
+        os.makedirs(os.path.join(registry_dir, "manifests"), exist_ok=True)
+        os.makedirs(os.path.join(registry_dir, "chunks"), exist_ok=True)
+
+    # -- registry ------------------------------------------------------------
+
+    def manifest_path(self, image_id: str) -> str:
+        return os.path.join(self.registry_dir, "manifests",
+                            f"{image_id}.json")
+
+    def chunk_path(self, digest: str) -> str:
+        return os.path.join(self.registry_dir, "chunks", digest[:2], digest)
+
+    def has_image(self, image_id: str) -> bool:
+        return os.path.exists(self.manifest_path(image_id))
+
+    def load_manifest(self, image_id: str) -> Optional[ImageManifest]:
+        p = self.manifest_path(image_id)
+        if not os.path.exists(p):
+            return None
+        return ImageManifest.from_json(open(p).read())
+
+    def read_chunk(self, digest: str) -> Optional[bytes]:
+        p = self.chunk_path(digest)
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    def _store_chunk(self, data: bytes, digest: str) -> None:
+        p = self.chunk_path(digest)
+        if os.path.exists(p):
+            return
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.rename(tmp, p)
+
+    # -- building ------------------------------------------------------------
+
+    async def build(self, spec: ImageSpec,
+                    log_cb=None) -> ImageManifest:
+        """Build (or return the cached) image for a spec."""
+        existing = self.load_manifest(spec.image_id)
+        if existing is not None:
+            return existing
+        return await asyncio.to_thread(self._build_sync, spec, log_cb)
+
+    def _build_sync(self, spec: ImageSpec, log_cb=None) -> ImageManifest:
+        def emit(line: str) -> None:
+            log.info("[build %s] %s", spec.image_id, line)
+            if log_cb:
+                log_cb(line)
+
+        scratch = tempfile.mkdtemp(prefix="tpu9-build-")
+        try:
+            env_dir = os.path.join(scratch, "env")
+            os.makedirs(env_dir)
+
+            if spec.python_packages:
+                self._install_packages(spec, env_dir, emit)
+
+            for cmd in spec.commands:
+                emit(f"RUN {cmd}")
+                proc = subprocess.run(cmd, shell=True, cwd=scratch,
+                                      capture_output=True, text=True,
+                                      timeout=1800)
+                if proc.stdout:
+                    emit(proc.stdout[-2000:])
+                if proc.returncode != 0:
+                    raise BuildError(
+                        f"command failed ({proc.returncode}): {cmd}\n"
+                        f"{proc.stderr[-2000:]}")
+
+            emit("snapshotting environment")
+            manifest = snapshot_dir(scratch, put_chunk=self._store_chunk)
+            manifest.image_id = spec.image_id
+            manifest.python_version = spec.python_version
+            manifest.env = dict(spec.env)
+            if spec.python_packages:
+                manifest.env.setdefault(
+                    "TPU9_IMAGE_SITE", "env/site-packages")
+            with open(self.manifest_path(spec.image_id), "w") as f:
+                f.write(manifest.to_json())
+            emit(f"built {spec.image_id}: {len(manifest.files)} files, "
+                 f"{manifest.total_bytes >> 20} MiB")
+            return manifest
+        finally:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+    def _install_packages(self, spec: ImageSpec, env_dir: str, emit) -> None:
+        site = os.path.join(env_dir, "site-packages")
+        os.makedirs(site, exist_ok=True)
+        cmd = [sys.executable, "-m", "pip", "install", "--target", site,
+               "--no-compile"]
+        if not self.network_ok:
+            if not self.wheel_dir:
+                raise BuildError(
+                    "package install requested but the builder has no network "
+                    "and no wheel_dir configured")
+            cmd += ["--no-index", "--find-links", self.wheel_dir]
+        elif self.wheel_dir:
+            cmd += ["--find-links", self.wheel_dir]
+        cmd += spec.python_packages
+        emit(f"pip install {' '.join(spec.python_packages)}")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800)
+        if proc.returncode != 0:
+            raise BuildError(f"pip install failed:\n{proc.stderr[-3000:]}")

@@ -1,0 +1,140 @@
+"""Chunked image manifests — tpu9's lazy image format (CLIP analogue).
+
+Reference analogue: the external ``beam-cloud/clip`` archive format mounted
+over FUSE (pkg/worker/image.go:274). tpu9's manifest is a flat JSON document:
+every file carries mode/size and the sha256 list of its chunks; content is
+deduplicated in the distributed cache. Materialization can be eager
+(hardlink/copy all chunks) or sparse (fetch only requested prefixes), and a
+FUSE frontend can mount the same manifest without format changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+DEFAULT_CHUNK = 4 * 1024 * 1024
+
+
+@dataclass
+class FileEntry:
+    path: str                  # relative path in the bundle
+    mode: int
+    size: int
+    chunks: list[str] = field(default_factory=list)
+    link_target: str = ""      # symlink destination ("" = regular file)
+
+
+@dataclass
+class ImageManifest:
+    image_id: str = ""
+    files: list[FileEntry] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    python_version: str = ""
+    total_bytes: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "image_id": self.image_id,
+            "python_version": self.python_version,
+            "env": self.env,
+            "total_bytes": self.total_bytes,
+            "files": [{"path": f.path, "mode": f.mode, "size": f.size,
+                       "chunks": f.chunks, "link_target": f.link_target}
+                      for f in self.files],
+        }, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, blob: str) -> "ImageManifest":
+        d = json.loads(blob)
+        return cls(
+            image_id=d["image_id"],
+            python_version=d.get("python_version", ""),
+            env=d.get("env", {}),
+            total_bytes=d.get("total_bytes", 0),
+            files=[FileEntry(**f) for f in d.get("files", [])],
+        )
+
+    @property
+    def manifest_hash(self) -> str:
+        return hashlib.sha256(self.to_json().encode()).hexdigest()
+
+    def all_chunks(self) -> Iterator[str]:
+        for f in self.files:
+            yield from f.chunks
+
+
+def snapshot_dir(root: str, chunk_bytes: int = DEFAULT_CHUNK,
+                 put_chunk=None) -> ImageManifest:
+    """Walk ``root`` and build a manifest; ``put_chunk(data, digest)`` stores
+    each chunk (sync callback so the walk can run in a thread)."""
+    manifest = ImageManifest()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root)
+            try:
+                st = os.lstat(full)
+            except OSError:
+                continue
+            if os.path.islink(full):
+                manifest.files.append(FileEntry(
+                    path=rel, mode=st.st_mode & 0xFFFF, size=0,
+                    link_target=os.readlink(full)))
+                continue
+            if not os.path.isfile(full):
+                continue
+            chunks = []
+            size = 0
+            with open(full, "rb") as f:
+                while True:
+                    data = f.read(chunk_bytes)
+                    if not data:
+                        break
+                    digest = hashlib.sha256(data).hexdigest()
+                    if put_chunk is not None:
+                        put_chunk(data, digest)
+                    chunks.append(digest)
+                    size += len(data)
+            manifest.files.append(FileEntry(path=rel,
+                                            mode=st.st_mode & 0xFFFF,
+                                            size=size, chunks=chunks))
+            manifest.total_bytes += size
+    return manifest
+
+
+def materialize(manifest: ImageManifest, dest: str, get_chunk,
+                link_from: Optional[str] = None) -> None:
+    """Write the manifest's tree under ``dest``. ``get_chunk(digest) ->
+    bytes`` (sync). When ``link_from`` holds a chunk file path resolver,
+    single-chunk files are hardlinked instead of copied (zero-copy warm
+    start)."""
+    for entry in manifest.files:
+        target = os.path.join(dest, entry.path)
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        if entry.link_target:
+            try:
+                os.symlink(entry.link_target, target)
+            except FileExistsError:
+                pass
+            continue
+        if link_from is not None and len(entry.chunks) == 1:
+            src = link_from(entry.chunks[0])
+            if src is not None:
+                try:
+                    os.link(src, target)
+                    os.chmod(target, entry.mode & 0o777)
+                    continue
+                except OSError:
+                    pass
+        with open(target, "wb") as f:
+            for digest in entry.chunks:
+                data = get_chunk(digest)
+                if data is None:
+                    raise IOError(f"missing chunk {digest} for {entry.path}")
+                f.write(data)
+        os.chmod(target, entry.mode & 0o777)

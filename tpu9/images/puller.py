@@ -1,0 +1,108 @@
+"""Image puller: materialize a manifest into a worker-local bundle via the
+distributed cache.
+
+Reference analogue: the worker's CLIP pull path (pkg/worker/image.go:274
+PullLazy + content routing). tpu9 pull: manifest (small JSON) from the
+registry, chunks through the CacheClient (local disk → HRW peers → source),
+single-chunk files hardlinked straight out of the chunk store so warm pulls
+are metadata-speed. Bundles are refcount-shared across containers on a host.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import shutil
+from typing import Optional
+
+from ..cache import CacheClient
+from .manifest import ImageManifest, materialize
+
+log = logging.getLogger("tpu9.images")
+
+
+class ImagePuller:
+    def __init__(self, cache: CacheClient, bundles_dir: str,
+                 manifest_fetch=None):
+        """``manifest_fetch(image_id) -> ImageManifest | None`` (async)."""
+        self.cache = cache
+        self.bundles_dir = bundles_dir
+        self.manifest_fetch = manifest_fetch
+        os.makedirs(bundles_dir, exist_ok=True)
+        self._locks: dict[str, asyncio.Lock] = {}
+        self._refs: dict[str, int] = {}
+
+    def bundle_path(self, image_id: str) -> str:
+        return os.path.join(self.bundles_dir, image_id)
+
+    async def pull(self, image_id: str,
+                   manifest: Optional[ImageManifest] = None) -> str:
+        """Materialize (once) and return the bundle dir."""
+        lock = self._locks.setdefault(image_id, asyncio.Lock())
+        async with lock:
+            dest = self.bundle_path(image_id)
+            done_marker = os.path.join(dest, ".tpu9-complete")
+            if os.path.exists(done_marker):
+                self._refs[image_id] = self._refs.get(image_id, 0) + 1
+                return dest
+            if manifest is None:
+                if self.manifest_fetch is None:
+                    raise IOError(f"no manifest source for {image_id}")
+                manifest = await self.manifest_fetch(image_id)
+                if manifest is None:
+                    raise IOError(f"image {image_id} not found")
+
+            # prefetch every chunk into the local store (bounded parallel),
+            # then materialize with hardlinks from the store
+            chunks = list(dict.fromkeys(manifest.all_chunks()))
+            fetched = await self.cache.get_many(chunks)
+            missing = [d for d, v in fetched.items() if v is None]
+            if missing:
+                raise IOError(
+                    f"image {image_id}: {len(missing)} chunks unavailable")
+
+            tmp = dest + ".partial"
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+
+            def get_chunk(digest: str) -> Optional[bytes]:
+                return fetched.get(digest)
+
+            await asyncio.to_thread(
+                materialize, manifest, tmp, get_chunk,
+                self.cache.store.get_path)
+            os.makedirs(tmp, exist_ok=True)
+            # runtime metadata the lifecycle reads when wiring the container
+            import json
+            with open(os.path.join(tmp, ".tpu9-env.json"), "w") as f:
+                json.dump({"env": manifest.env,
+                           "python_version": manifest.python_version}, f)
+            with open(os.path.join(tmp, ".tpu9-complete"), "w") as f:
+                f.write(manifest.manifest_hash)
+            shutil.rmtree(dest, ignore_errors=True)
+            os.rename(tmp, dest)
+            self._refs[image_id] = self._refs.get(image_id, 0) + 1
+            log.info("pulled %s: %d files, %d chunks", image_id,
+                     len(manifest.files), len(chunks))
+            return dest
+
+    def release(self, image_id: str) -> None:
+        if image_id in self._refs:
+            self._refs[image_id] -= 1
+
+    async def gc(self, keep: int = 4) -> int:
+        """Drop unreferenced bundles beyond ``keep`` most-recent."""
+        entries = []
+        for name in os.listdir(self.bundles_dir):
+            p = self.bundle_path(name)
+            if self._refs.get(name, 0) > 0 or not os.path.isdir(p):
+                continue
+            entries.append((os.path.getmtime(p), name))
+        entries.sort(reverse=True)
+        removed = 0
+        for _mtime, name in entries[keep:]:
+            shutil.rmtree(self.bundle_path(name), ignore_errors=True)
+            self._refs.pop(name, None)
+            removed += 1
+        return removed

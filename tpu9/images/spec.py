@@ -1,0 +1,40 @@
+"""Image specs: the user-facing build recipe.
+
+Reference analogue: the SDK ``Image`` builder DSL (sdk image.py, 912 LoC) +
+the build service's dockerfile-from-steps synthesis
+(pkg/abstractions/image/build.go:369-567). tpu9 images are **environment
+snapshots**, not OCI layers: a spec deterministically hashes to an image_id,
+the builder materializes the env (venv + packages + commands) and snapshots
+it into a chunked content-addressed manifest — the lazy-load format that
+replaces CLIP.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class ImageSpec:
+    python_version: str = "python3.11"
+    python_packages: list[str] = field(default_factory=list)
+    commands: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    base_image: str = ""                 # optional base manifest to extend
+    include_host_site_packages: bool = False
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ImageSpec":
+        return cls(**{k: v for k, v in d.items()
+                      if k in cls.__dataclass_fields__})
+
+    @property
+    def image_id(self) -> str:
+        """Deterministic id: same spec → same image (dedupe at build)."""
+        blob = json.dumps(self.to_dict(), sort_keys=True).encode()
+        return "img-" + hashlib.sha256(blob).hexdigest()[:16]

@@ -47,6 +47,9 @@ class TaskRepository:
             await self.store.hdel(Keys.task_index(msg.stub_id), task_id)
         return msg
 
+    async def expire_message(self, task_id: str, ttl_s: float) -> None:
+        await self.store.expire(Keys.task_message(task_id), max(ttl_s, 60.0))
+
     async def delete_message(self, task_id: str) -> None:
         msg = await self.get_message(task_id)
         if msg:

@@ -117,3 +117,16 @@ def json_default(obj: Any) -> Any:
 
 def dumps(obj: Any) -> str:
     return json.dumps(obj, default=json_default)
+
+
+def jsonable(obj: Any) -> Any:
+    """Coerce a handler result into a JSON-safe value (numpy arrays etc.);
+    falls back to repr rather than crashing the runner."""
+    try:
+        json.dumps(obj)
+        return obj
+    except TypeError:
+        try:
+            return json.loads(dumps(obj))
+        except TypeError:
+            return repr(obj)

@@ -19,7 +19,7 @@ import sys
 import aiohttp
 from aiohttp import web
 
-from .common import FunctionHandler, RunnerConfig, error_payload
+from .common import FunctionHandler, RunnerConfig, error_payload, jsonable
 
 log = logging.getLogger("tpu9.runner")
 
@@ -61,8 +61,14 @@ async def run() -> int:
         if status != 200:
             log.error("task fetch failed: %s", payload)
             return 1
-        await api("POST", f"/rpc/task/{task_id}/claim",
-                  {"container_id": cfg.container_id})
+        _, claim = await api("POST", f"/rpc/task/{task_id}/claim",
+                             {"container_id": cfg.container_id})
+        if not claim.get("ok"):
+            # task cancelled or owned by a replacement container: user code
+            # must not run unowned (duplicate side effects)
+            log.info("claim denied for %s; exiting", task_id)
+            await app_runner.cleanup()
+            return 0
 
         await asyncio.to_thread(handler.load)
         state["ready"] = True
@@ -72,7 +78,7 @@ async def run() -> int:
                 handler.call(*payload.get("args", []),
                              **payload.get("kwargs", {})),
                 timeout=cfg.timeout_s)
-            body = {"result": result}
+            body = {"result": jsonable(result)}
             code = 0
         except Exception as exc:  # noqa: BLE001 — user code boundary
             body = {"error": error_payload(exc)["error"]}

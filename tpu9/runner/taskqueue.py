@@ -16,7 +16,7 @@ import sys
 import aiohttp
 from aiohttp import web
 
-from .common import FunctionHandler, RunnerConfig, dumps, error_payload
+from .common import FunctionHandler, RunnerConfig, error_payload, jsonable
 
 log = logging.getLogger("tpu9.runner")
 
@@ -61,7 +61,7 @@ class TaskQueueWorker:
                 self.handler.call(*task.get("args", []),
                                   **task.get("kwargs", {})),
                 timeout=self.cfg.timeout_s)
-            body = {"result": _jsonable(result)}
+            body = {"result": jsonable(result)}
         except Exception as exc:  # noqa: BLE001 — user code boundary
             body = {"error": error_payload(exc)["error"]}
         body["container_id"] = self.cfg.container_id
@@ -94,16 +94,6 @@ class TaskQueueWorker:
         log.info("taskqueue runner ready (%d pollers)", self.cfg.workers)
         await asyncio.gather(*[self.poll_loop(i)
                                for i in range(max(self.cfg.workers, 1))])
-
-
-def _jsonable(obj):
-    import json
-    try:
-        json.dumps(obj)
-        return obj
-    except TypeError:
-        from .common import json_default
-        return json_default(obj)
 
 
 def main() -> None:

@@ -26,16 +26,34 @@ from typing import Any, Iterable, Iterator, Optional
 from .base import RunnerAbstraction
 
 
+class TaskPending(RuntimeError):
+    pass
+
+
 class TaskHandle:
     def __init__(self, task_id: str, client):
         self.task_id = task_id
         self._client = client
 
     def result(self, timeout: float = 0) -> Any:
-        out = self._client.task_result(self.task_id, timeout=timeout)
-        if isinstance(out, dict) and "error" in out:
-            raise RemoteError(out["error"])
-        return out.get("result") if isinstance(out, dict) else out
+        """Block up to ``timeout`` seconds (0 = single non-blocking check).
+        Raises TaskPending if the task hasn't finished in time — never
+        returns None for a still-running task. The gateway caps each wait at
+        ~110s, so long waits poll in slices."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while True:
+            remaining = max(deadline - _time.monotonic(), 0.0)
+            out = self._client.task_result(self.task_id,
+                                           timeout=min(remaining, 100.0))
+            if isinstance(out, dict) and out.get("pending"):
+                if _time.monotonic() >= deadline:
+                    raise TaskPending(
+                        f"task {self.task_id} still running after {timeout}s")
+                continue
+            if isinstance(out, dict) and "error" in out:
+                raise RemoteError(out["error"])
+            return out.get("result") if isinstance(out, dict) else out
 
     def status(self) -> str:
         return self._client.task_status(self.task_id)["status"]

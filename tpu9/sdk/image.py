@@ -1,0 +1,99 @@
+"""SDK Image builder DSL.
+
+Reference analogue: ``sdk/src/beta9/abstractions/image.py`` (912 LoC DSL:
+``.add_python_packages``, ``.add_commands``, ``.with_envs``, micromamba,
+dockerfile import...). tpu9 images are env snapshots (see tpu9.images.spec);
+the DSL builds an ImageSpec and ``ensure_built`` drives the gateway build
+API, polling to readiness.
+
+    from tpu9 import Image, endpoint
+
+    image = (Image(python_version="python3.11")
+             .add_python_packages(["jax[tpu]", "flax"])
+             .add_commands(["echo hello > /tmp/marker"])
+             .with_envs({"XLA_FLAGS": "--xla_cpu_enable_fast_math=true"}))
+
+    @endpoint(image=image, tpu="v5e-1")
+    def serve(...): ...
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..images.spec import ImageSpec
+
+
+class ImageBuildFailed(RuntimeError):
+    pass
+
+
+class Image:
+    def __init__(self, python_version: str = "python3.11",
+                 base_image: str = ""):
+        self.spec = ImageSpec(python_version=python_version,
+                              base_image=base_image)
+
+    # -- DSL (chainable) ----------------------------------------------------
+
+    def add_python_packages(self, packages: list[str]) -> "Image":
+        self.spec.python_packages.extend(packages)
+        return self
+
+    def add_commands(self, commands: list[str]) -> "Image":
+        self.spec.commands.extend(commands)
+        return self
+
+    def with_envs(self, env: dict[str, str]) -> "Image":
+        self.spec.env.update(env)
+        return self
+
+    def micromamba(self) -> "Image":
+        """Parity shim: micromamba environments resolve to pip-equivalent
+        specs in tpu9 (conda-forge channel synthesis is not supported)."""
+        return self
+
+    @classmethod
+    def from_dockerfile(cls, path: str) -> "Image":
+        """Parse the RUN/ENV subset of a Dockerfile into an env-snapshot spec
+        (FROM layers outside the python env are not replicated)."""
+        img = cls()
+        for raw in open(path).read().splitlines():
+            line = raw.strip()
+            if line.upper().startswith("RUN "):
+                img.spec.commands.append(line[4:])
+            elif line.upper().startswith("ENV "):
+                parts = line[4:].split("=", 1)
+                if len(parts) == 2:
+                    img.spec.env[parts[0].strip()] = parts[1].strip()
+        return img
+
+    # -- build driving -------------------------------------------------------
+
+    @property
+    def image_id(self) -> str:
+        return self.spec.image_id
+
+    def ensure_built(self, client, timeout: float = 1800.0,
+                     poll_s: float = 1.0) -> str:
+        """Build if needed; block until ready. Returns image_id."""
+        out = client._run(lambda c: c.request(
+            "POST", "/rpc/image/verify", json_body=self.spec.to_dict()))
+        if out.get("exists"):
+            return self.image_id
+        client._run(lambda c: c.request("POST", "/rpc/image/build",
+                                        json_body=self.spec.to_dict()))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = client._run(lambda c: c.request(
+                "GET", f"/rpc/image/status/{self.image_id}"))
+            if st["status"] == "ready":
+                return self.image_id
+            if st["status"] == "failed":
+                raise ImageBuildFailed("\n".join(st.get("logs", [])[-20:]))
+            time.sleep(poll_s)
+        raise ImageBuildFailed(f"build timed out after {timeout}s")
+
+    def to_dict(self) -> dict:
+        return self.spec.to_dict()

@@ -127,13 +127,19 @@ class Dispatcher:
             # stale attempt from a container the monitor already replaced
             await self.tasks.unclaim(container_id, task_id)
             return None
+        if msg.container_id:
+            await self.tasks.unclaim(msg.container_id, task_id)
+        if error and msg.retry_count < msg.policy.max_retries:
+            # handler failures honor the retry policy like timeouts do
+            await self._retry_or_fail(msg, TaskStatus.ERROR.value,
+                                      f"handler error: {error}")
+            return await self.tasks.get_message(task_id)
         status = TaskStatus.ERROR.value if error else TaskStatus.COMPLETE.value
         payload = {"error": error} if error else {"result": result}
         await self.tasks.store_result(task_id, payload)
         out = await self.tasks.set_status(task_id, status)
-        if msg.container_id:
-            await self.tasks.unclaim(msg.container_id, task_id)
         await self.backend.update_task_status(task_id, status)
+        await self.tasks.expire_message(task_id, msg.policy.ttl_s)
         return out
 
     async def cancel(self, task_id: str) -> bool:
@@ -147,6 +153,7 @@ class Dispatcher:
             await self.tasks.unclaim(msg.container_id, task_id)
         await self.backend.update_task_status(task_id,
                                               TaskStatus.CANCELLED.value)
+        await self.tasks.expire_message(task_id, msg.policy.ttl_s)
         return True
 
     async def retrieve(self, task_id: str, timeout: float = 0,
@@ -195,8 +202,15 @@ class Dispatcher:
                     await self._finalize(msg, TaskStatus.EXPIRED.value,
                                          "pending past expiry")
                 continue
-            # RUNNING: enforce timeout
-            if policy.timeout_s and age > policy.timeout_s:
+            # RUNNING: timeout measured from claim time, not enqueue time —
+            # queue wait must not eat the execution budget
+            claim_ts = None
+            if msg.container_id:
+                claim_ts = (await self.tasks.claims(msg.container_id)
+                            ).get(msg.task_id)
+            run_age = now - (claim_ts if claim_ts is not None
+                             else msg.created_at)
+            if policy.timeout_s and run_age > policy.timeout_s:
                 await self._retry_or_fail(msg, TaskStatus.TIMEOUT.value,
                                           "timed out")
         # crashed-worker safety net: claims whose container state vanished
@@ -246,4 +260,7 @@ class Dispatcher:
         await self.tasks.store_result(msg.task_id, {"error": reason})
         await self.tasks.set_status(msg.task_id, status)
         await self.backend.update_task_status(msg.task_id, status)
+        # terminal messages expire so monitor scans and store size stay
+        # bounded (results keep their own TTL)
+        await self.tasks.expire_message(msg.task_id, msg.policy.ttl_s)
         log.info("task %s → %s (%s)", msg.task_id, status, reason)

@@ -24,11 +24,13 @@ import aiohttp
 from ..backend import BackendDB
 from ..config import AppConfig, WorkerPoolConfig
 from ..gateway import Gateway
+from ..repository import WorkerRepository
 from ..runtime import ProcessRuntime
 from ..scheduler import LocalProcessPool
 from ..statestore import MemoryStore
 from ..types import ContainerStatus, StubType
 from ..worker import Worker
+from ..worker.cache_manager import WorkerCache
 
 ECHO_HANDLER = """
 def handler(**kwargs):
@@ -47,6 +49,8 @@ class LocalStack:
         cfg.storage.local_root = os.path.join(self.tmp.name, "workspaces")
         cfg.worker.containers_dir = os.path.join(self.tmp.name, "containers")
         cfg.worker.idle_shutdown_s = worker_idle_shutdown_s
+        cfg.cache.data_dir = os.path.join(self.tmp.name, "cache")
+        cfg.image.registry_dir = os.path.join(self.tmp.name, "registry")
         cfg.scheduler.loop_interval_s = 0.02
         self.cfg = cfg
         self.store = MemoryStore()
@@ -97,11 +101,15 @@ class LocalStack:
         else:
             os.environ.pop("TPU9_FAKE_TPU_CHIPS", None)
         runtime = ProcessRuntime(base_dir=self.cfg.worker.containers_dir)
+        cache = WorkerCache(
+            self.cfg.cache, f"wc{len(self.workers)}",
+            WorkerRepository(self.store),
+            source=self._chunk_source, manifest_fetch=self._manifest_fetch)
         worker = Worker(
             self.store, runtime, cfg=self.cfg.worker, pool=pool,
             cpu_millicores=16000, memory_mb=32768,   # virtual capacity: these
             # workers time-share the host the way k8s test nodes do
-            tpu_generation=tpu_generation,
+            tpu_generation=tpu_generation, cache=cache,
             object_resolver=self._resolve_object, **slice_kw)
         await worker.start()
         self.workers.append(worker)
@@ -110,6 +118,14 @@ class LocalStack:
     async def _resolve_object(self, object_id: str) -> str:
         obj = await self.backend.get_object(object_id)
         return obj["path"] if obj else ""
+
+    async def _chunk_source(self, digest: str):
+        return self.gateway.images.chunk(digest)
+
+    async def _manifest_fetch(self, image_id: str):
+        from ..images import ImageManifest
+        blob = self.gateway.images.manifest_json(image_id)
+        return ImageManifest.from_json(blob) if blob else None
 
     # -- client helpers --------------------------------------------------------
 

@@ -444,6 +444,7 @@ class WorkerState(_Serializable):
     slice_host_rank: int = 0
     slice_host_count: int = 1
     address: str = ""             # worker control address (host:port)
+    cache_address: str = ""       # chunk-server address ("" = no cache)
     version: str = ""
     priority: int = 0
     build_capable: bool = True

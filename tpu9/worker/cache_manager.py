@@ -1,0 +1,60 @@
+"""Per-worker cache wiring: disk store + chunk server + HRW client + puller.
+
+Reference analogue: ``pkg/worker/cache_manager.go:129`` (embedded cache
+server per node, peer discovery, content reconciliation). Peers come from the
+worker registry (every worker advertises ``cache_address``); the source of
+truth is injected (registry dir in single-host mode, gateway HTTP/object
+store in clusters).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Awaitable, Callable, Optional
+
+from ..cache import CacheClient, ChunkServer, DiskStore
+from ..config import CacheConfig
+from ..images import ImageManifest, ImagePuller
+from ..repository import WorkerRepository
+
+log = logging.getLogger("tpu9.worker")
+
+
+class WorkerCache:
+    def __init__(self, cfg: CacheConfig, worker_id: str,
+                 workers: WorkerRepository,
+                 source: Optional[Callable[[str], Awaitable[Optional[bytes]]]] = None,
+                 manifest_fetch: Optional[Callable[[str], Awaitable[Optional[ImageManifest]]]] = None,
+                 bundles_dir: str = ""):
+        self.cfg = cfg
+        self.worker_id = worker_id
+        self.workers = workers
+        data_dir = os.path.join(cfg.data_dir, worker_id)
+        self.store = DiskStore(data_dir, max_bytes=cfg.max_bytes)
+        self.server = ChunkServer(self.store, port=cfg.port)
+        self.client = CacheClient(self.store, self._peers, source=source,
+                                  replicas=cfg.replicas)
+        self.puller = ImagePuller(self.client,
+                                  bundles_dir or os.path.join(cfg.data_dir,
+                                                              "bundles"),
+                                  manifest_fetch=manifest_fetch)
+
+    async def _peers(self) -> list[str]:
+        out = []
+        for w in await self.workers.list(alive_only=True):
+            if w.cache_address and w.worker_id != self.worker_id:
+                out.append(w.cache_address)
+        return out
+
+    async def start(self) -> "WorkerCache":
+        await self.server.start()
+        self.client.self_address = self.server.address
+        return self
+
+    async def stop(self) -> None:
+        await self.server.stop()
+        await self.client.close()
+
+    async def resolve_image(self, image_id: str) -> str:
+        return await self.puller.pull(image_id)

@@ -190,6 +190,21 @@ class ContainerLifecycle:
     def _spec_from_request(self, request: ContainerRequest, rootfs: str,
                            workdir: str, port: int, assignment) -> ContainerSpec:
         env = dict(request.env)
+        image_site = ""
+        if rootfs:
+            # env-snapshot image bundles ship runtime metadata (puller writes
+            # .tpu9-env.json); apply image env under the request's env
+            meta_path = os.path.join(rootfs, ".tpu9-env.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                for k, v in meta.get("env", {}).items():
+                    env.setdefault(k, v)
+                site_rel = meta.get("env", {}).get("TPU9_IMAGE_SITE",
+                                                   "env/site-packages")
+                site_abs = os.path.join(rootfs, site_rel)
+                if os.path.isdir(site_abs):
+                    image_site = site_abs
         env.update({
             "TPU9_CONTAINER_ID": request.container_id,
             "TPU9_STUB_ID": request.stub_id,
@@ -199,6 +214,8 @@ class ContainerLifecycle:
             "PYTHONPATH": workdir + os.pathsep + env.get("PYTHONPATH", ""),
             "PYTHONUNBUFFERED": "1",
         })
+        if image_site:
+            env["PYTHONPATH"] = (env["PYTHONPATH"] + os.pathsep + image_site)
         devices: list[str] = []
         if assignment is not None:
             env.update(assignment.env)

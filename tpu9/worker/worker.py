@@ -38,7 +38,7 @@ class Worker:
                  slice_topology: str = "", slice_host_rank: int = 0,
                  slice_host_count: int = 1,
                  object_resolver=None, image_resolver=None,
-                 phase_cb=None) -> None:
+                 cache=None, phase_cb=None) -> None:
         self.cfg = cfg or WorkerConfig()
         self.worker_id = worker_id or new_id("worker")
         self.pool = pool
@@ -47,6 +47,9 @@ class Worker:
         self.containers = ContainerRepository(store)
         self.tpu = TpuDeviceManager(generation=tpu_generation)
         self.runtime = runtime
+        self.cache = cache          # Optional[WorkerCache]
+        if image_resolver is None and cache is not None:
+            image_resolver = cache.resolve_image
         self.lifecycle = ContainerLifecycle(
             self.worker_id, self.cfg, runtime, self.containers, self.tpu,
             object_resolver=object_resolver, image_resolver=image_resolver,
@@ -82,9 +85,13 @@ class Worker:
             slice_host_rank=self.slice_host_rank,
             slice_host_count=self.slice_host_count,
             address=f"pid:{os.getpid()}",
+            cache_address=(self.cache.server.address
+                           if self.cache and self.cache.server.port else ""),
         )
 
     async def start(self) -> "Worker":
+        if self.cache is not None:
+            await self.cache.start()
         await self.workers.register(self._state())
         self._tasks = [
             asyncio.create_task(self._heartbeat_loop()),
@@ -104,6 +111,8 @@ class Worker:
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self.cache is not None:
+            await self.cache.stop()
         await self.workers.deregister(self.worker_id)
 
     # ------------------------------------------------------------------
