@@ -1,0 +1,70 @@
+"""E2E: pods (arbitrary entrypoint + proxy) and sandboxes (exec)."""
+
+import sys
+
+import pytest
+
+from tpu9.testing.localstack import LocalStack
+
+pytestmark = pytest.mark.e2e
+
+HTTP_POD = ("import http.server, os, json\n"
+            "class H(http.server.BaseHTTPRequestHandler):\n"
+            "    def do_GET(self):\n"
+            "        body = json.dumps({'pod': True, 'path': self.path}).encode()\n"
+            "        self.send_response(200)\n"
+            "        self.send_header('Content-Type', 'application/json')\n"
+            "        self.end_headers()\n"
+            "        self.wfile.write(body)\n"
+            "    def log_message(self, *a):\n"
+            "        pass\n"
+            "http.server.HTTPServer(('127.0.0.1', int(os.environ['TPU9_PORT'])), H).serve_forever()\n")
+
+
+async def make_pod_stub(stack, stub_type="pod", entrypoint=None):
+    status, out = await stack.api("POST", "/rpc/stub/get-or-create", json_body={
+        "name": stub_type, "stub_type": stub_type,
+        "config": {"entrypoint": entrypoint or [],
+                   "runtime": {"cpu_millicores": 500, "memory_mb": 512}}})
+    assert status == 200, out
+    return out["stub_id"]
+
+
+async def test_pod_entrypoint_and_proxy():
+    async with LocalStack() as stack:
+        stub_id = await make_pod_stub(
+            stack, "pod", [sys.executable, "-c", HTTP_POD])
+        status, out = await stack.api("POST", "/rpc/pod/create",
+                                      json_body={"stub_id": stub_id},
+                                      timeout=90)
+        assert status == 200 and out["running"], out
+        container_id = out["container_id"]
+        # proxy through the gateway
+        status, resp = await stack.api("GET", f"/pod/{container_id}/hello")
+        assert status == 200
+        assert resp == {"pod": True, "path": "/hello"}
+        # status route
+        status, st = await stack.api("GET", f"/rpc/pod/{container_id}/status")
+        assert st["status"] == "running"
+
+
+async def test_sandbox_exec():
+    async with LocalStack() as stack:
+        stub_id = await make_pod_stub(stack, "sandbox")
+        status, out = await stack.api("POST", "/rpc/pod/create",
+                                      json_body={"stub_id": stub_id},
+                                      timeout=90)
+        assert status == 200 and out["running"], out
+        container_id = out["container_id"]
+        status, result = await stack.api(
+            "POST", f"/rpc/pod/{container_id}/exec",
+            json_body={"cmd": [sys.executable, "-c", "print(40 + 2)"]},
+            timeout=90)
+        assert status == 200, result
+        assert result["exit_code"] == 0
+        assert result["output"].strip() == "42"
+        # failing command reports exit code
+        status, bad = await stack.api(
+            "POST", f"/rpc/pod/{container_id}/exec",
+            json_body={"cmd": [sys.executable, "-c", "raise SystemExit(3)"]})
+        assert bad["exit_code"] == 3

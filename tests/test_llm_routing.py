@@ -1,0 +1,75 @@
+import json
+
+from tpu9.abstractions.llm import LlmRouter, prefix_hash
+from tpu9.statestore import MemoryStore
+from tpu9.types import ContainerState
+
+
+def S(cid):
+    return ContainerState(container_id=cid, stub_id="s", status="running",
+                          address=f"127.0.0.1:{hash(cid) % 1000 + 2000}")
+
+
+def test_prefix_hash_stability():
+    a = prefix_hash(json.dumps({"prompt": "hello world", "temp": 0.7}).encode())
+    b = prefix_hash(json.dumps({"prompt": "hello world", "temp": 0.1}).encode())
+    c = prefix_hash(json.dumps({"prompt": "different"}).encode())
+    assert a == b != c
+    # non-JSON bodies hash raw bytes
+    assert prefix_hash(b"raw") == prefix_hash(b"raw")
+
+
+async def test_admission_excludes_saturated():
+    store = MemoryStore()
+    r = LlmRouter(store, max_token_pressure=0.8, max_active_streams=4)
+    await r.record_pressure("hot", 0.95, 2)
+    await r.record_pressure("busy", 0.2, 10)
+    await r.record_pressure("cool", 0.1, 1)
+    ranked = await r.rank("s", [S("hot"), S("busy"), S("cool")])
+    # cool first (only admissible), saturated last
+    assert ranked[0].container_id == "cool"
+    assert {ranked[1].container_id, ranked[2].container_id} == {"hot", "busy"}
+
+
+async def test_prefix_affinity_preferred():
+    store = MemoryStore()
+    r = LlmRouter(store)
+    await r.record_pressure("a", 0.5, 1)
+    await r.record_pressure("b", 0.1, 1)
+    body = json.dumps({"prompt": "the quick brown fox"}).encode()
+    await r.record_served("s", prefix_hash(body), "a")
+    for _ in range(5):
+        ranked = await r.rank("s", [S("a"), S("b")], body)
+        assert ranked[0].container_id == "a"   # affinity beats lower pressure
+
+
+async def test_affinity_skipped_when_saturated():
+    store = MemoryStore()
+    r = LlmRouter(store, max_token_pressure=0.8)
+    await r.record_pressure("a", 0.95, 1)   # affinity target saturated
+    await r.record_pressure("b", 0.1, 1)
+    body = json.dumps({"prompt": "xyz"}).encode()
+    await r.record_served("s", prefix_hash(body), "a")
+    ranked = await r.rank("s", [S("a"), S("b")], body)
+    assert ranked[0].container_id == "b"
+
+
+async def test_p2c_prefers_lighter():
+    store = MemoryStore()
+    r = LlmRouter(store)
+    await r.record_pressure("heavy", 0.7, 1)
+    await r.record_pressure("light", 0.1, 1)
+    firsts = set()
+    for _ in range(20):
+        ranked = await r.rank("s", [S("heavy"), S("light")])
+        firsts.add(ranked[0].container_id)
+    assert firsts == {"light"}   # two candidates → always picks lighter
+
+
+async def test_mean_pressure():
+    store = MemoryStore()
+    r = LlmRouter(store)
+    await r.record_pressure("a", 0.4, 1)
+    await r.record_pressure("b", 0.6, 1)
+    assert abs(await r.mean_pressure(["a", "b"]) - 0.5) < 1e-9
+    assert await r.mean_pressure(["nope"]) == 0.0

@@ -1,0 +1,76 @@
+import asyncio
+
+import pytest
+
+from tpu9.abstractions.primitives import (MapService, OutputService,
+                                          PrimitiveError, QueueService,
+                                          SignalService, VolumeFiles)
+from tpu9.backend import BackendDB
+from tpu9.statestore import MemoryStore
+
+
+async def test_map_service():
+    m = MapService(MemoryStore())
+    await m.set("w", "cfg", "a", {"x": 1})
+    await m.set("w", "cfg", "b", [1, 2])
+    assert await m.get("w", "cfg", "a") == {"x": 1}
+    assert await m.keys("w", "cfg") == ["a", "b"]
+    assert await m.items("w", "cfg") == {"a": {"x": 1}, "b": [1, 2]}
+    assert await m.delete("w", "cfg", "a")
+    assert await m.get("w", "cfg", "a") is None
+    # workspace isolation
+    assert await m.get("other", "cfg", "b") is None
+    with pytest.raises(PrimitiveError):
+        await m.set("w", "cfg", "big", "x" * (1 << 21))
+
+
+async def test_queue_service():
+    q = QueueService(MemoryStore())
+    await q.push("w", "jobs", 1)
+    await q.push("w", "jobs", 2)
+    assert await q.depth("w", "jobs") == 2
+    assert await q.pop("w", "jobs") == 1
+    assert await q.pop("w", "jobs", timeout=0.2) == 2
+    assert await q.pop("w", "jobs") is None
+
+
+async def test_signal_service():
+    s = SignalService(MemoryStore())
+    assert not await s.is_set("w", "go")
+    await s.set("w", "go")
+    assert await s.is_set("w", "go")
+    assert await s.wait("w", "go", timeout=0.1)
+    await s.clear("w", "go")
+    assert not await s.is_set("w", "go")
+
+    async def fire_later():
+        await asyncio.sleep(0.05)
+        await s.set("w", "go")
+
+    t = asyncio.create_task(fire_later())
+    assert await s.wait("w", "go", timeout=2.0)
+    await t
+
+
+async def test_output_service(tmp_path):
+    o = OutputService(BackendDB(), str(tmp_path))
+    output_id = await o.save("w", "report.txt", b"hello")
+    p = await o.path("w", output_id)
+    assert p and open(p, "rb").read() == b"hello"
+    assert await o.path("w", "out-nope") is None
+    with pytest.raises(PrimitiveError):
+        await o.save("w", "../evil", b"x")
+
+
+async def test_volume_files(tmp_path):
+    v = VolumeFiles(BackendDB(), str(tmp_path))
+    await v.write("w", "models", "sub/weights.bin", b"W" * 100)
+    data = await v.read("w", "models", "sub/weights.bin")
+    assert data == b"W" * 100
+    listing = await v.list("w", "models")
+    assert listing[0]["path"] == "sub/weights.bin"
+    assert listing[0]["size"] == 100
+    assert await v.delete("w", "models", "sub/weights.bin")
+    assert await v.read("w", "models", "sub/weights.bin") is None
+    with pytest.raises(PrimitiveError):
+        await v.read("w", "models", "../../../etc/passwd")

@@ -26,15 +26,15 @@ _EXPORTS: dict[str, tuple[str, str]] = {
     "schedule": ("tpu9.sdk.function", "schedule"),
     "task_queue": ("tpu9.sdk.taskqueue", "task_queue"),
     "Image": ("tpu9.sdk.image", "Image"),
-    "Volume": ("tpu9.sdk.volume", "Volume"),
-    "CloudBucket": ("tpu9.sdk.volume", "CloudBucket"),
+    "Volume": ("tpu9.sdk.primitives", "Volume"),
+    "CloudBucket": ("tpu9.sdk.primitives", "CloudBucket"),
     "Pod": ("tpu9.sdk.pod", "Pod"),
-    "Sandbox": ("tpu9.sdk.sandbox", "Sandbox"),
-    "Map": ("tpu9.sdk.map", "Map"),
-    "Queue": ("tpu9.sdk.queue", "Queue"),
-    "Output": ("tpu9.sdk.output", "Output"),
-    "Secret": ("tpu9.sdk.secret", "Secret"),
-    "Signal": ("tpu9.sdk.signal", "Signal"),
+    "Sandbox": ("tpu9.sdk.pod", "Sandbox"),
+    "Map": ("tpu9.sdk.primitives", "Map"),
+    "Queue": ("tpu9.sdk.primitives", "Queue"),
+    "Output": ("tpu9.sdk.primitives", "Output"),
+    "Secret": ("tpu9.sdk.primitives", "Secret"),
+    "Signal": ("tpu9.sdk.primitives", "Signal"),
     "QueueDepthAutoscaler": ("tpu9.sdk.autoscaler", "QueueDepthAutoscaler"),
     "TokenPressureAutoscaler": ("tpu9.sdk.autoscaler", "TokenPressureAutoscaler"),
     "TpuSpec": ("tpu9.types", "TpuSpec"),

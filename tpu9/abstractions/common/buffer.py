@@ -45,9 +45,10 @@ class ForwardResult:
 
 class RequestBuffer:
     def __init__(self, stub: Stub, containers: ContainerRepository,
-                 request_timeout_s: float = 180.0):
+                 request_timeout_s: float = 180.0, router=None):
         self.stub = stub
         self.containers = containers
+        self.router = router    # optional LlmRouter for pressure/affinity
         self.request_timeout_s = request_timeout_s
         self._queue: asyncio.Queue[BufferedRequest] = asyncio.Queue()
         self._session: Optional[aiohttp.ClientSession] = None
@@ -116,7 +117,7 @@ class RequestBuffer:
                     req.future.set_result(ForwardResult(
                         status=504, body=b'{"error":"expired in queue"}'))
                 continue
-            target = await self._acquire_container()
+            target = await self._acquire_container(req.body)
             if target is None:
                 # no capacity yet — requeue and give the autoscaler a beat
                 await asyncio.sleep(0.05)
@@ -126,12 +127,21 @@ class RequestBuffer:
             self._inflight += 1
             asyncio.create_task(self._forward_one(req, container_id, address))
 
-    async def _acquire_container(self) -> Optional[tuple[str, str]]:
-        """Discover RUNNING containers and grab a concurrency token on one
-        (random order → load spread; token caps per-container concurrency)."""
+    async def _acquire_container(self,
+                                 body: bytes = b"") -> Optional[tuple[str, str]]:
+        """Discover RUNNING containers and grab a concurrency token on one.
+        Plain stubs spread randomly; LLM stubs route by pressure + prefix
+        affinity through the router."""
         states = await self.containers.containers_by_stub(
             self.stub.stub_id, status=ContainerStatus.RUNNING.value)
-        random.shuffle(states)
+        phash = ""
+        if self.router is not None:
+            from ..llm import prefix_hash
+            phash = prefix_hash(body) if body else ""
+            states = await self.router.rank(self.stub.stub_id, states, body,
+                                            phash=phash)
+        else:
+            random.shuffle(states)
         limit = max(self.stub.config.concurrent_requests, 1)
         for s in states:
             address = s.address or await self.containers.get_address(
@@ -140,6 +150,9 @@ class RequestBuffer:
                 continue
             if await self.containers.acquire_request_token(
                     self.stub.stub_id, s.container_id, limit):
+                if self.router is not None and phash:
+                    await self.router.record_served(self.stub.stub_id, phash,
+                                                    s.container_id)
                 return s.container_id, address
         return None
 

@@ -16,11 +16,18 @@ from typing import Optional
 
 from ...repository import ContainerRepository
 from ...scheduler import Scheduler
-from ...types import (ContainerRequest, ContainerStatus, Stub, StopReason,
-                      new_id)
+from ...types import (ContainerRequest, ContainerStatus, Mount, Stub,
+                      StopReason, StubConfig, new_id)
 from .autoscaler import Autoscaler, AutoscaleResult, AutoscaleSample
 
 log = logging.getLogger("tpu9.abstractions")
+
+
+def volume_mounts(cfg: StubConfig) -> list[Mount]:
+    """Stub volume declarations → container mount list."""
+    return [Mount(source=v.get("name", ""), target=v.get("mount_path", ""),
+                  kind="volume") for v in cfg.volumes if v.get("name")]
+
 
 
 class AutoscaledInstance:
@@ -102,6 +109,7 @@ class AutoscaledInstance:
             object_id=self.stub.object_id,
             entrypoint=self.entrypoint,
             env=self._runner_env(),
+            mounts=volume_mounts(cfg),
             pool_selector=self.pool_selector,
         )
         await self.scheduler.run(request)
@@ -118,6 +126,8 @@ class AutoscaledInstance:
             "TPU9_WORKERS": str(cfg.workers),
             "TPU9_TIMEOUT_S": str(cfg.timeout_s),
         })
+        if cfg.extra.get("runner"):
+            env["TPU9_RUNNER"] = cfg.extra["runner"]
         return env
 
     async def start(self) -> "AutoscaledInstance":

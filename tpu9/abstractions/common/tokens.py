@@ -1,0 +1,20 @@
+"""Per-workspace runner-token cache shared by the abstraction services
+(containers authenticate to the gateway with these)."""
+
+from __future__ import annotations
+
+from ...backend import BackendDB
+
+
+class RunnerTokenCache:
+    def __init__(self, backend: BackendDB):
+        self.backend = backend
+        self._tokens: dict[str, str] = {}
+
+    async def get(self, workspace_id: str) -> str:
+        tok = self._tokens.get(workspace_id)
+        if tok is None:
+            t = await self.backend.create_token(workspace_id,
+                                                token_type="runner")
+            tok = self._tokens[workspace_id] = t.key
+        return tok

@@ -20,6 +20,7 @@ from ..types import AutoscalerType, Stub
 from .common.autoscaler import queue_depth_policy, token_pressure_policy
 from .common.buffer import ForwardResult, RequestBuffer
 from .common.instance import AutoscaledInstance
+from .llm import LlmRouter
 
 log = logging.getLogger("tpu9.abstractions")
 
@@ -68,10 +69,12 @@ class EndpointInstance:
     def __init__(self, stub: Stub, scheduler: Scheduler,
                  containers: ContainerRepository):
         self.stub = stub
-        self.buffer = RequestBuffer(stub, containers,
-                                    request_timeout_s=stub.config.timeout_s)
         a = stub.config.autoscaler
+        self.router = None
         if a.type == AutoscalerType.TOKEN_PRESSURE.value:
+            self.router = LlmRouter(scheduler.store,
+                                    max_token_pressure=a.max_token_pressure,
+                                    max_active_streams=a.max_active_streams)
             policy = token_pressure_policy(a.max_containers,
                                            a.max_token_pressure,
                                            a.min_containers)
@@ -79,18 +82,27 @@ class EndpointInstance:
             policy = queue_depth_policy(a.max_containers,
                                         a.tasks_per_container,
                                         a.min_containers)
+        self.buffer = RequestBuffer(stub, containers,
+                                    request_timeout_s=stub.config.timeout_s,
+                                    router=self.router)
         self.instance = AutoscaledInstance(
             stub, scheduler, containers, policy,
             sample_extra=self._sample_extra)
         self._containers = containers
 
     async def _sample_extra(self):
-        """Queue depth + pressure. Pressure = fleet saturation: open requests
-        over total concurrency slots (LLM runners additionally report real
-        KV-cache pressure through their health stats, which supersedes this
-        proxy when present)."""
+        """Queue depth + pressure. Pressure prefers the engines' reported
+        KV-cache pressure (heartbeated into the router's table); the
+        saturation proxy (open requests over concurrency slots) covers stubs
+        without reporting runners."""
         depth = self.buffer.depth
-        active = await self._containers.active_count_by_stub(self.stub.stub_id)
+        states = await self._containers.containers_by_stub(self.stub.stub_id)
+        active = len(states)
+        if self.router is not None and active:
+            reported = await self.router.mean_pressure(
+                [s.container_id for s in states])
+            if reported > 0:
+                return depth, reported
         slots = max(active, 1) * max(self.stub.config.concurrent_requests, 1)
         pressure = min(depth / slots, 1.0) if active else (1.0 if depth else 0.0)
         return depth, pressure

@@ -21,6 +21,7 @@ from ..scheduler import Scheduler
 from ..task import Dispatcher
 from ..types import (ContainerRequest, Stub, TaskMessage, TaskPolicy,
                      TaskStatus, new_id)
+from .common.tokens import RunnerTokenCache
 
 log = logging.getLogger("tpu9.abstractions")
 
@@ -71,11 +72,11 @@ class FunctionService:
                  containers: ContainerRepository, dispatcher: Dispatcher,
                  runner_env: Optional[dict[str, str]] = None):
         self.backend = backend
+        self.runner_tokens = RunnerTokenCache(backend)
         self.scheduler = scheduler
         self.containers = containers
         self.dispatcher = dispatcher
         self.runner_env = runner_env if runner_env is not None else {}
-        self._tokens: dict[str, str] = {}
         self._cron_task: Optional[asyncio.Task] = None
         self.dispatcher.register(EXECUTOR, self._requeue)
 
@@ -92,14 +93,6 @@ class FunctionService:
             except asyncio.CancelledError:
                 pass
             self._cron_task = None
-
-    async def _runner_token(self, workspace_id: str) -> str:
-        tok = self._tokens.get(workspace_id)
-        if tok is None:
-            t = await self.backend.create_token(workspace_id,
-                                                token_type="runner")
-            tok = self._tokens[workspace_id] = t.key
-        return tok
 
     # -- invocation ------------------------------------------------------------
 
@@ -123,8 +116,9 @@ class FunctionService:
             "TPU9_STUB_TYPE": stub.stub_type,
             "TPU9_TASK_ID": task_id,
             "TPU9_TIMEOUT_S": str(cfg.timeout_s),
-            "TPU9_TOKEN": await self._runner_token(stub.workspace_id),
+            "TPU9_TOKEN": await self.runner_tokens.get(stub.workspace_id),
         })
+        from .common.instance import volume_mounts
         request = ContainerRequest(
             container_id=new_id("ct"),
             stub_id=stub.stub_id,
@@ -136,6 +130,7 @@ class FunctionService:
             image_id=cfg.runtime.image_id,
             object_id=stub.object_id,
             env=env,
+            mounts=volume_mounts(cfg),
         )
         await self.scheduler.run(request)
         return request.container_id

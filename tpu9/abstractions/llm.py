@@ -1,0 +1,139 @@
+"""LLM-aware request routing.
+
+Reference analogue: ``pkg/abstractions/pod/llm.go`` — token-pressure
+admission (:124,147), prefix-affinity + power-of-two-choices scoring
+(:211,316), per-container pressure snapshots in Redis (:460-472). tpu9 keeps
+the same three mechanisms, fed by the serving engine's stats
+(tpu9.serving.engine.stats()) which runners heartbeat to the gateway:
+
+- **pressure table**: per-container {token_pressure, active_streams} with TTL
+- **admission**: containers above max_token_pressure / max_active_streams are
+  not eligible (requests queue; the token-pressure autoscaler reads the same
+  table and scales out)
+- **prefix affinity**: requests hashing to a known prompt prefix prefer the
+  container that served that prefix (KV-cache reuse); ties broken by
+  power-of-two-choices on pressure
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+from typing import Optional
+
+from ..statestore import StateStore
+from ..types import ContainerState
+
+PRESSURE_TTL_S = 15.0
+AFFINITY_TTL_S = 300.0
+PREFIX_BYTES = 256
+
+
+def prefix_hash(body: bytes) -> str:
+    """Stable hash of the prompt prefix. JSON bodies hash the ``prompt`` /
+    ``messages`` field when present so formatting noise doesn't break
+    affinity."""
+    try:
+        payload = json.loads(body)
+        for key in ("prompt", "messages", "input", "text"):
+            if key in payload:
+                body = json.dumps(payload[key])[:PREFIX_BYTES].encode()
+                break
+    except (ValueError, TypeError):
+        pass
+    return hashlib.sha256(body[:PREFIX_BYTES]).hexdigest()[:16]
+
+
+class LlmRouter:
+    def __init__(self, store: StateStore, max_token_pressure: float = 0.85,
+                 max_active_streams: int = 64):
+        self.store = store
+        self.max_token_pressure = max_token_pressure
+        self.max_active_streams = max_active_streams
+
+    # -- pressure table ------------------------------------------------------
+
+    def _pkey(self, container_id: str) -> str:
+        return f"llm:pressure:{container_id}"
+
+    async def record_pressure(self, container_id: str, token_pressure: float,
+                              active_streams: int,
+                              extra: Optional[dict] = None) -> None:
+        key = self._pkey(container_id)
+        await self.store.hmset(key, {
+            "token_pressure": token_pressure,
+            "active_streams": active_streams,
+            "ts": time.time(), **(extra or {})})
+        await self.store.expire(key, PRESSURE_TTL_S)
+
+    async def pressure(self, container_id: str) -> Optional[dict]:
+        data = await self.store.hgetall(self._pkey(container_id))
+        return data or None
+
+    async def mean_pressure(self, container_ids: list[str]) -> float:
+        vals = []
+        for container_id in container_ids:
+            p = await self.pressure(container_id)
+            if p is not None:
+                vals.append(float(p.get("token_pressure", 0)))
+        return sum(vals) / len(vals) if vals else 0.0
+
+    # -- affinity ------------------------------------------------------------
+
+    def _akey(self, stub_id: str, phash: str) -> str:
+        return f"llm:prefix:{stub_id}:{phash}"
+
+    async def record_served(self, stub_id: str, phash: str,
+                            container_id: str) -> None:
+        await self.store.set(self._akey(stub_id, phash), container_id,
+                             ttl=AFFINITY_TTL_S)
+
+    # -- selection -----------------------------------------------------------
+
+    async def rank(self, stub_id: str, states: list[ContainerState],
+                   body: bytes = b"", phash: str = "") -> list[ContainerState]:
+        """Order candidates: affinity target first (if admissible), then
+        power-of-two-choices by pressure among admissible containers, then
+        the over-pressure remainder (the buffer's concurrency tokens still
+        cap them)."""
+        admissible, saturated = [], []
+        pressures: dict[str, float] = {}
+        for s in states:
+            p = await self.pressure(s.container_id)
+            tp = float(p.get("token_pressure", 0.0)) if p else 0.0
+            streams = int(float(p.get("active_streams", 0))) if p else 0
+            pressures[s.container_id] = tp
+            if tp >= self.max_token_pressure or streams >= self.max_active_streams:
+                saturated.append(s)
+            else:
+                admissible.append(s)
+
+        ordered: list[ContainerState] = []
+        if not phash and body:
+            phash = prefix_hash(body)
+        if phash and admissible:
+            target = await self.store.get(self._akey(stub_id, phash))
+            for s in admissible:
+                if s.container_id == target:
+                    ordered.append(s)
+                    admissible = [x for x in admissible
+                                  if x.container_id != target]
+                    break
+
+        # power-of-two-choices repeatedly: sample 2, take the lighter
+        pool = list(admissible)
+        random.shuffle(pool)
+        while pool:
+            if len(pool) == 1:
+                ordered.append(pool.pop())
+                break
+            a, b = pool[0], pool[1]
+            lighter = a if pressures[a.container_id] <= pressures[b.container_id] else b
+            ordered.append(lighter)
+            pool.remove(lighter)
+
+        ordered.extend(sorted(saturated,
+                              key=lambda s: pressures[s.container_id]))
+        return ordered

@@ -1,0 +1,108 @@
+"""Pod + Sandbox abstractions: arbitrary-entrypoint containers.
+
+Reference analogue: ``pkg/abstractions/pod/`` — user-specified entrypoint
+containers with exposed ports, HTTP/TCP proxying, keep-warm; sandbox mode
+adds interactive exec (the reference bind-mounts the goproc supervisor as
+PID 1; tpu9's process runtime execs directly, and the C++ t9proc supervisor
+covers the OCI path).
+
+Exec transport: request/reply over the state bus pubsub — gateway publishes
+to ``container:exec:<worker>``, the owning worker runs the command in the
+container and replies on a per-request channel (the reference uses a
+worker-local gRPC server, container_server.go:169).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Optional
+
+from ..backend import BackendDB
+from ..repository import ContainerRepository
+from ..scheduler import Scheduler
+from ..statestore import StateStore
+from ..types import (ContainerRequest, ContainerStatus, Stub, new_id)
+from .common.instance import volume_mounts
+from .common.tokens import RunnerTokenCache
+
+log = logging.getLogger("tpu9.abstractions")
+
+
+class PodService:
+    def __init__(self, backend: BackendDB, scheduler: Scheduler,
+                 containers: ContainerRepository, store: StateStore,
+                 runner_env: Optional[dict[str, str]] = None):
+        self.backend = backend
+        self.runner_tokens = RunnerTokenCache(backend)
+        self.scheduler = scheduler
+        self.containers = containers
+        self.store = store
+        self.runner_env = runner_env if runner_env is not None else {}
+
+    async def create(self, stub: Stub, name: str = "") -> dict:
+        """Run one pod container; returns its id (address resolves once
+        RUNNING)."""
+        cfg = stub.config
+        env = dict(cfg.env)
+        env.update(self.runner_env)
+        env["TPU9_TOKEN"] = await self.runner_tokens.get(stub.workspace_id)
+        entrypoint = list(cfg.entrypoint)
+        if stub.stub_type == "sandbox" and not entrypoint:
+            # sandboxes idle until exec'd into
+            import sys
+            entrypoint = [sys.executable, "-c",
+                          "import time\nwhile True: time.sleep(3600)"]
+        request = ContainerRequest(
+            container_id=new_id("pod"),
+            stub_id=stub.stub_id,
+            workspace_id=stub.workspace_id,
+            stub_type=stub.stub_type,
+            cpu_millicores=cfg.runtime.cpu_millicores,
+            memory_mb=cfg.runtime.memory_mb,
+            tpu=cfg.runtime.tpu,
+            image_id=cfg.runtime.image_id,
+            object_id=stub.object_id,
+            entrypoint=entrypoint,
+            env=env,
+            ports=list(cfg.ports),
+            mounts=volume_mounts(cfg),
+        )
+        await self.scheduler.run(request)
+        return {"container_id": request.container_id}
+
+    async def wait_running(self, container_id: str,
+                           timeout: float = 60.0) -> Optional[str]:
+        """Wait for RUNNING; returns the container address."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            state = await self.containers.get_state(container_id)
+            if state is not None:
+                if state.status == ContainerStatus.RUNNING.value:
+                    return state.address
+                if state.status in (ContainerStatus.FAILED.value,
+                                    ContainerStatus.STOPPED.value):
+                    return None
+            await asyncio.sleep(0.05)
+        return None
+
+    # -- exec (sandboxes) ----------------------------------------------------
+
+    async def exec(self, container_id: str, cmd: list[str],
+                   timeout: float = 60.0) -> dict:
+        state = await self.containers.get_state(container_id)
+        if state is None or not state.worker_id:
+            return {"error": "container not found", "exit_code": -1}
+        reply_channel = f"execreply:{new_id('x')}"
+        sub = self.store.subscribe(reply_channel)
+        try:
+            await self.store.publish(f"container:exec:{state.worker_id}", {
+                "container_id": container_id, "cmd": cmd,
+                "reply": reply_channel})
+            msg = await sub.get(timeout=timeout)
+            if msg is None:
+                return {"error": "exec timed out", "exit_code": -1}
+            return msg[1]
+        finally:
+            sub.close()

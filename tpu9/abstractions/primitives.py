@@ -1,0 +1,215 @@
+"""Distributed primitives for user code: Map, Queue, Signal, Output, Volume
+file ops.
+
+Reference analogue: ``pkg/abstractions/map`` (Redis dict), ``queue`` (FIFO),
+``experimental/signal`` (named cross-container events), ``output`` (artifact
+files with public URLs), ``volume`` (workspace file shares). All are
+workspace-scoped; values are JSON blobs capped at 1 MiB (parity with the
+reference's practical payload limits).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+from ..backend import BackendDB
+from ..statestore import StateStore
+from ..types import new_id
+
+MAX_VALUE_BYTES = 1 << 20
+
+
+class PrimitiveError(ValueError):
+    pass
+
+
+def _check_size(value: Any) -> str:
+    blob = json.dumps(value)
+    if len(blob) > MAX_VALUE_BYTES:
+        raise PrimitiveError(f"value exceeds {MAX_VALUE_BYTES} bytes")
+    return blob
+
+
+class MapService:
+    def __init__(self, store: StateStore):
+        self.store = store
+
+    def _key(self, workspace_id: str, name: str) -> str:
+        return f"map:{workspace_id}:{name}"
+
+    async def set(self, workspace_id: str, name: str, field: str,
+                  value: Any) -> None:
+        await self.store.hset(self._key(workspace_id, name), field,
+                              _check_size(value))
+
+    async def get(self, workspace_id: str, name: str, field: str) -> Any:
+        raw = await self.store.hget(self._key(workspace_id, name), field)
+        return json.loads(raw) if raw is not None else None
+
+    async def delete(self, workspace_id: str, name: str, field: str) -> bool:
+        return await self.store.hdel(self._key(workspace_id, name), field) > 0
+
+    async def keys(self, workspace_id: str, name: str) -> list[str]:
+        return sorted((await self.store.hgetall(
+            self._key(workspace_id, name))).keys())
+
+    async def items(self, workspace_id: str, name: str) -> dict[str, Any]:
+        raw = await self.store.hgetall(self._key(workspace_id, name))
+        return {k: json.loads(v) for k, v in raw.items()}
+
+
+class QueueService:
+    def __init__(self, store: StateStore):
+        self.store = store
+
+    def _key(self, workspace_id: str, name: str) -> str:
+        return f"uq:{workspace_id}:{name}"
+
+    async def push(self, workspace_id: str, name: str, value: Any) -> int:
+        return await self.store.rpush(self._key(workspace_id, name),
+                                      _check_size(value))
+
+    async def pop(self, workspace_id: str, name: str,
+                  timeout: float = 0) -> Any:
+        key = self._key(workspace_id, name)
+        raw = (await self.store.blpop(key, timeout=timeout) if timeout
+               else await self.store.lpop(key))
+        return json.loads(raw) if raw is not None else None
+
+    async def depth(self, workspace_id: str, name: str) -> int:
+        return await self.store.llen(self._key(workspace_id, name))
+
+
+class SignalService:
+    """Named cross-container signals (reference experimental/signal)."""
+
+    def __init__(self, store: StateStore):
+        self.store = store
+
+    def _key(self, workspace_id: str, name: str) -> str:
+        return f"signal:{workspace_id}:{name}"
+
+    async def set(self, workspace_id: str, name: str,
+                  ttl: Optional[float] = None) -> None:
+        await self.store.set(self._key(workspace_id, name), time.time(),
+                             ttl=ttl)
+        await self.store.publish(f"signalfire:{workspace_id}:{name}", 1)
+
+    async def clear(self, workspace_id: str, name: str) -> None:
+        await self.store.delete(self._key(workspace_id, name))
+
+    async def is_set(self, workspace_id: str, name: str) -> bool:
+        return await self.store.exists(self._key(workspace_id, name))
+
+    async def wait(self, workspace_id: str, name: str,
+                   timeout: float = 30.0) -> bool:
+        if await self.is_set(workspace_id, name):
+            return True
+        sub = self.store.subscribe(f"signalfire:{workspace_id}:{name}")
+        try:
+            if await self.is_set(workspace_id, name):  # re-check post-sub
+                return True
+            return await sub.get(timeout=timeout) is not None
+        finally:
+            sub.close()
+
+
+class OutputService:
+    """Task output artifacts saved under workspace storage with shareable
+    ids (reference pkg/abstractions/output)."""
+
+    def __init__(self, backend: BackendDB, storage_root: str):
+        self.backend = backend
+        self.storage_root = storage_root
+
+    def _dir(self, workspace_id: str) -> str:
+        return os.path.join(self.storage_root, workspace_id, "outputs")
+
+    async def save(self, workspace_id: str, filename: str,
+                   data: bytes) -> str:
+        if "/" in filename or filename.startswith("."):
+            raise PrimitiveError(f"bad output filename {filename!r}")
+        output_id = new_id("out")
+        d = os.path.join(self._dir(workspace_id), output_id)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, filename), "wb") as f:
+            f.write(data)
+        return output_id
+
+    async def path(self, workspace_id: str, output_id: str) -> Optional[str]:
+        d = os.path.join(self._dir(workspace_id), output_id)
+        if not os.path.isdir(d):
+            return None
+        names = os.listdir(d)
+        return os.path.join(d, names[0]) if names else None
+
+
+class VolumeFiles:
+    """Workspace volume file ops (upload/download/list/delete) on the storage
+    backend (reference volume.go RPCs + multipart client)."""
+
+    def __init__(self, backend: BackendDB, storage_root: str):
+        self.backend = backend
+        self.storage_root = storage_root
+
+    def volume_dir(self, workspace_id: str, volume_name: str) -> str:
+        return os.path.join(self.storage_root, workspace_id, "volumes",
+                            volume_name)
+
+    def _safe(self, workspace_id: str, volume_name: str, rel: str) -> str:
+        base = os.path.realpath(self.volume_dir(workspace_id, volume_name))
+        full = os.path.realpath(os.path.join(base, rel.lstrip("/")))
+        if not (full == base or full.startswith(base + os.sep)):
+            raise PrimitiveError(f"path escapes volume: {rel!r}")
+        return full
+
+    async def ensure(self, workspace_id: str, volume_name: str) -> dict:
+        vol = await self.backend.get_or_create_volume(workspace_id,
+                                                      volume_name)
+        os.makedirs(self.volume_dir(workspace_id, volume_name), exist_ok=True)
+        return vol
+
+    async def write(self, workspace_id: str, volume_name: str, rel: str,
+                    data: bytes) -> int:
+        await self.ensure(workspace_id, volume_name)
+        full = self._safe(workspace_id, volume_name, rel)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        with open(full, "wb") as f:
+            f.write(data)
+        return len(data)
+
+    async def read(self, workspace_id: str, volume_name: str,
+                   rel: str) -> Optional[bytes]:
+        full = self._safe(workspace_id, volume_name, rel)
+        if not os.path.isfile(full):
+            return None
+        with open(full, "rb") as f:
+            return f.read()
+
+    async def list(self, workspace_id: str, volume_name: str,
+                   prefix: str = "") -> list[dict]:
+        base = self.volume_dir(workspace_id, volume_name)
+        if not os.path.isdir(base):
+            return []
+        out = []
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in files:
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, base)
+                if prefix and not rel.startswith(prefix):
+                    continue
+                st = os.stat(full)
+                out.append({"path": rel, "size": st.st_size,
+                            "mtime": st.st_mtime})
+        return sorted(out, key=lambda e: e["path"])
+
+    async def delete(self, workspace_id: str, volume_name: str,
+                     rel: str) -> bool:
+        full = self._safe(workspace_id, volume_name, rel)
+        if os.path.isfile(full):
+            os.unlink(full)
+            return True
+        return False

@@ -19,6 +19,7 @@ from ..task import Dispatcher
 from ..types import Stub, TaskMessage, TaskPolicy, TaskStatus
 from .common.autoscaler import queue_depth_policy
 from .common.instance import AutoscaledInstance
+from .common.tokens import RunnerTokenCache
 
 log = logging.getLogger("tpu9.abstractions")
 
@@ -30,6 +31,7 @@ class TaskQueueService:
                  containers: ContainerRepository, dispatcher: Dispatcher,
                  runner_env: Optional[dict[str, str]] = None):
         self.backend = backend
+        self.runner_tokens = RunnerTokenCache(backend)
         self.scheduler = scheduler
         self.containers = containers
         self.dispatcher = dispatcher
@@ -37,15 +39,6 @@ class TaskQueueService:
         self.runner_env = runner_env if runner_env is not None else {}
         self.instances: dict[str, AutoscaledInstance] = {}
         self._locks: dict[str, asyncio.Lock] = {}
-        self._tokens: dict[str, str] = {}
-
-    async def _runner_token(self, workspace_id: str) -> str:
-        tok = self._tokens.get(workspace_id)
-        if tok is None:
-            t = await self.backend.create_token(workspace_id,
-                                                token_type="runner")
-            tok = self._tokens[workspace_id] = t.key
-        return tok
 
     async def get_or_create_instance(self, stub: Stub) -> AutoscaledInstance:
         inst = self.instances.get(stub.stub_id)
@@ -70,7 +63,7 @@ class TaskQueueService:
                                           self.containers, policy,
                                           sample_extra=sample_extra)
                 inst.extra_env = dict(self.runner_env)
-                inst.extra_env["TPU9_TOKEN"] = await self._runner_token(
+                inst.extra_env["TPU9_TOKEN"] = await self.runner_tokens.get(
                     stub.workspace_id)
                 await inst.start()
                 self.instances[stub.stub_id] = inst

@@ -75,6 +75,7 @@ class WorkerConfig:
     start_concurrency: int = 4
     images_dir: str = "/tmp/tpu9/images"
     containers_dir: str = "/tmp/tpu9/containers"
+    storage_root: str = "/tmp/tpu9/workspaces"   # volume/object share
     logs_dir: str = "/tmp/tpu9/logs"
     checkpoint_dir: str = "/tmp/tpu9/checkpoints"
     failover_max_pending: int = 10

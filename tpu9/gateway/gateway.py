@@ -28,6 +28,10 @@ from aiohttp import web
 from ..abstractions.endpoint import EndpointService
 from ..abstractions.function import FunctionService
 from ..abstractions.image import ImageService
+from ..abstractions.pod import PodService
+from ..abstractions.primitives import (MapService, OutputService,
+                                       PrimitiveError, QueueService,
+                                       SignalService, VolumeFiles)
 from ..abstractions.taskqueue import TaskQueueService
 from ..images import ImageBuilder, ImageSpec
 from ..backend import BackendDB
@@ -73,6 +77,13 @@ class Gateway:
             self.backend,
             ImageBuilder(cfg.image.registry_dir,
                          network_ok=not os.environ.get("TPU9_NO_EGRESS")))
+        self.pods = PodService(self.backend, self.scheduler, self.containers,
+                               self.store, runner_env=self.runner_env)
+        self.maps = MapService(self.store)
+        self.queues = QueueService(self.store)
+        self.signals = SignalService(self.store)
+        self.outputs = OutputService(self.backend, cfg.storage.local_root)
+        self.volume_files = VolumeFiles(self.backend, cfg.storage.local_root)
         self.extra_services: dict[str, object] = {}
         self.state_server: Optional[StateServer] = None
         self._runner: Optional[web.AppRunner] = None
@@ -103,6 +114,25 @@ class Gateway:
         r.add_post("/rpc/task/{task_id}/claim", self._rpc_task_claim)
         r.add_post("/rpc/task/{task_id}/complete", self._rpc_task_complete)
         r.add_post("/rpc/task/{task_id}/cancel", self._rpc_task_cancel)
+        r.add_post("/rpc/llm/pressure", self._rpc_llm_pressure)
+        # pods / sandboxes
+        r.add_post("/rpc/pod/create", self._rpc_pod_create)
+        r.add_get("/rpc/pod/{container_id}/status", self._rpc_pod_status)
+        r.add_post("/rpc/pod/{container_id}/exec", self._rpc_pod_exec)
+        r.add_route("*", "/pod/{container_id}/{tail:.*}", self._pod_proxy)
+        # primitives
+        r.add_post("/rpc/map/{name}", self._rpc_map)
+        r.add_post("/rpc/queue/{name}", self._rpc_queue)
+        r.add_post("/rpc/signal/{name}", self._rpc_signal)
+        r.add_post("/rpc/output/save", self._rpc_output_save)
+        r.add_get("/rpc/output/{output_id}", self._rpc_output_get)
+        r.add_get("/api/v1/volume", self._list_volumes)
+        r.add_post("/api/v1/volume/{name}", self._create_volume)
+        r.add_delete("/api/v1/volume/{name}", self._delete_volume)
+        r.add_get("/rpc/volume/{name}/files", self._volume_list)
+        r.add_put("/rpc/volume/{name}/files/{path:.+}", self._volume_put)
+        r.add_get("/rpc/volume/{name}/files/{path:.+}", self._volume_get)
+        r.add_delete("/rpc/volume/{name}/files/{path:.+}", self._volume_delete)
         # images
         r.add_post("/rpc/image/verify", self._rpc_image_verify)
         r.add_post("/rpc/image/build", self._rpc_image_build)
@@ -426,6 +456,241 @@ class Gateway:
     async def _rpc_task_cancel(self, request: web.Request) -> web.Response:
         msg = await self._task_for(request)
         return web.json_response({"ok": await self.dispatcher.cancel(msg.task_id)})
+
+    async def _rpc_llm_pressure(self, request: web.Request) -> web.Response:
+        """Engine pressure heartbeat from LLM runners (pod/llm.go:460
+        equivalent). Workspace-scoped: a tenant can only report pressure for
+        its own containers."""
+        ws = self._ws(request)
+        d = await request.json()
+        state = await self.containers.get_state(d.get("container_id", ""))
+        if state is None or state.workspace_id != ws.workspace_id:
+            return web.json_response({"error": "container not found"},
+                                     status=404)
+        from ..abstractions.llm import LlmRouter
+        router = LlmRouter(self.store)
+        await router.record_pressure(
+            state.container_id, float(d.get("token_pressure", 0.0)),
+            int(d.get("active_streams", 0)), extra=d.get("extra"))
+        return web.json_response({"ok": True})
+
+    # -- handlers: pods ---------------------------------------------------------
+
+    async def _pod_container_for(self, request: web.Request):
+        ws = self._ws(request)
+        container_id = request.match_info["container_id"]
+        state = await self.containers.get_state(container_id)
+        if state is None or state.workspace_id != ws.workspace_id:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "container not found"}),
+                content_type="application/json")
+        return state
+
+    async def _rpc_pod_create(self, request: web.Request) -> web.Response:
+        data = await request.json()
+        stub = await self._stub_for(request, data["stub_id"])
+        out = await self.pods.create(stub, name=data.get("name", ""))
+        if data.get("wait", True):
+            address = await self.pods.wait_running(
+                out["container_id"],
+                timeout=min(float(data.get("timeout", 60.0)), 110.0))
+            out["address"] = address
+            out["running"] = address is not None
+        return web.json_response(out)
+
+    async def _rpc_pod_status(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        return web.json_response(state.to_dict())
+
+    async def _rpc_pod_exec(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        data = await request.json()
+        out = await self.pods.exec(state.container_id,
+                                   list(data.get("cmd", [])),
+                                   timeout=min(float(data.get("timeout", 60)),
+                                               110.0))
+        return web.json_response(out)
+
+    async def _pod_proxy(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        if not state.address:
+            return web.json_response({"error": "pod not running"}, status=503)
+        import aiohttp as _aiohttp
+        tail = request.match_info.get("tail", "")
+        url = f"http://{state.address}/{tail}"
+        if request.query_string:
+            url += f"?{request.query_string}"
+        # forward end-to-end headers, not hop-by-hop/host ones
+        fwd_headers = {k: v for k, v in request.headers.items()
+                       if k.lower() not in ("host", "connection",
+                                            "transfer-encoding",
+                                            "content-length",
+                                            "authorization")}
+        body = await request.read()
+        try:
+            async with _aiohttp.ClientSession() as session:
+                async with session.request(
+                        request.method, url, data=body or None,
+                        headers=fwd_headers,
+                        timeout=_aiohttp.ClientTimeout(total=110)) as resp:
+                    out = await resp.read()
+                    proxied = web.Response(status=resp.status, body=out)
+                    proxied.headers["Content-Type"] = resp.headers.get(
+                        "Content-Type", "application/octet-stream")
+                    return proxied
+        except (_aiohttp.ClientError, asyncio.TimeoutError) as exc:
+            return web.json_response({"error": type(exc).__name__},
+                                     status=502)
+
+    # -- handlers: primitives ---------------------------------------------------
+
+    async def _rpc_map(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        name = request.match_info["name"]
+        d = await request.json()
+        op = d.get("op")
+        try:
+            if op == "set":
+                await self.maps.set(ws.workspace_id, name, d["field"],
+                                    d.get("value"))
+                return web.json_response({"ok": True})
+            if op == "get":
+                return web.json_response({"value": await self.maps.get(
+                    ws.workspace_id, name, d["field"])})
+            if op == "delete":
+                return web.json_response({"ok": await self.maps.delete(
+                    ws.workspace_id, name, d["field"])})
+            if op == "keys":
+                return web.json_response({"keys": await self.maps.keys(
+                    ws.workspace_id, name)})
+            if op == "items":
+                return web.json_response({"items": await self.maps.items(
+                    ws.workspace_id, name)})
+        except PrimitiveError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"error": f"bad op {op!r}"}, status=400)
+
+    async def _rpc_queue(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        name = request.match_info["name"]
+        d = await request.json()
+        op = d.get("op")
+        try:
+            if op == "push":
+                depth = await self.queues.push(ws.workspace_id, name,
+                                               d.get("value"))
+                return web.json_response({"depth": depth})
+            if op == "pop":
+                value = await self.queues.pop(
+                    ws.workspace_id, name,
+                    timeout=min(float(d.get("timeout", 0)), 30.0))
+                return web.json_response({"value": value})
+            if op == "depth":
+                return web.json_response({"depth": await self.queues.depth(
+                    ws.workspace_id, name)})
+        except PrimitiveError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"error": f"bad op {op!r}"}, status=400)
+
+    async def _rpc_signal(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        name = request.match_info["name"]
+        d = await request.json()
+        op = d.get("op")
+        if op == "set":
+            await self.signals.set(ws.workspace_id, name, ttl=d.get("ttl"))
+            return web.json_response({"ok": True})
+        if op == "clear":
+            await self.signals.clear(ws.workspace_id, name)
+            return web.json_response({"ok": True})
+        if op == "is_set":
+            return web.json_response({"set": await self.signals.is_set(
+                ws.workspace_id, name)})
+        if op == "wait":
+            fired = await self.signals.wait(
+                ws.workspace_id, name,
+                timeout=min(float(d.get("timeout", 30.0)), 60.0))
+            return web.json_response({"set": fired})
+        return web.json_response({"error": f"bad op {op!r}"}, status=400)
+
+    async def _rpc_output_save(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        filename = request.query.get("filename", "output.bin")
+        data = await request.read()
+        try:
+            output_id = await self.outputs.save(ws.workspace_id, filename,
+                                                data)
+        except PrimitiveError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({
+            "output_id": output_id,
+            "url": f"/rpc/output/{output_id}"})
+
+    async def _rpc_output_get(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        path = await self.outputs.path(ws.workspace_id,
+                                       request.match_info["output_id"])
+        if path is None:
+            return web.json_response({"error": "output not found"},
+                                     status=404)
+        return web.FileResponse(path)
+
+    async def _list_volumes(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        return web.json_response(await self.backend.list_volumes(
+            ws.workspace_id))
+
+    async def _create_volume(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        vol = await self.volume_files.ensure(ws.workspace_id,
+                                             request.match_info["name"])
+        return web.json_response(vol)
+
+    async def _delete_volume(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        ok = await self.backend.delete_volume(ws.workspace_id,
+                                              request.match_info["name"])
+        return web.json_response({"ok": ok})
+
+    async def _volume_list(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        return web.json_response(await self.volume_files.list(
+            ws.workspace_id, request.match_info["name"],
+            prefix=request.query.get("prefix", "")))
+
+    async def _volume_put(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        data = await request.read()
+        try:
+            n = await self.volume_files.write(
+                ws.workspace_id, request.match_info["name"],
+                request.match_info["path"], data)
+        except PrimitiveError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"size": n})
+
+    async def _volume_get(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        try:
+            data = await self.volume_files.read(
+                ws.workspace_id, request.match_info["name"],
+                request.match_info["path"])
+        except PrimitiveError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        if data is None:
+            return web.json_response({"error": "file not found"}, status=404)
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def _volume_delete(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        try:
+            ok = await self.volume_files.delete(
+                ws.workspace_id, request.match_info["name"],
+                request.match_info["path"])
+        except PrimitiveError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"ok": ok})
 
     # -- handlers: images ------------------------------------------------------
 

@@ -1,0 +1,140 @@
+"""LLM serving runner: hosts an InferenceEngine behind the endpoint protocol.
+
+This is the runner image for baseline configs #2/#4 (Llama on v5e-1 /
+Llama-70B TP on v5e-8): the worker spawns it with a handler that returns
+either an :class:`tpu9.serving.InferenceEngine` or a ``(params, cfg)`` pair /
+preset name; it serves:
+
+- ``POST /``            {"tokens": [...], "max_new_tokens": n} → {"tokens": [...]}
+- ``POST /generate``    same (alias)
+- ``GET /health``       readiness + engine stats
+
+and heartbeats token-pressure/active-streams to the gateway so the
+token-pressure autoscaler and the prefix-affinity router see real engine
+load (reference pod/llm.go's per-container snapshots).
+
+Multi-host gangs call ``initialize_multihost()`` before touching jax, so a
+v5p-64 deployment's 16 runners join one jax.distributed job.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import sys
+
+import aiohttp
+from aiohttp import web
+
+from .common import FunctionHandler, RunnerConfig, error_payload
+
+log = logging.getLogger("tpu9.runner")
+
+
+def _build_engine(obj):
+    """Accept an InferenceEngine, a (params, cfg) pair, or a preset name."""
+    from ..serving import EngineConfig, InferenceEngine
+    if hasattr(obj, "generate") and hasattr(obj, "stats"):
+        return obj
+    if isinstance(obj, tuple) and len(obj) in (2, 3):
+        params, cfg = obj[0], obj[1]
+        ecfg = obj[2] if len(obj) == 3 else EngineConfig()
+        return InferenceEngine(params, cfg, ecfg)
+    if isinstance(obj, str):
+        import jax
+        from ..models import init_decoder
+        from ..models.llama import LLAMA_PRESETS
+        cfg = LLAMA_PRESETS[obj]
+        params = init_decoder(jax.random.PRNGKey(0), cfg)
+        return InferenceEngine(params, cfg, EngineConfig())
+    raise TypeError(f"handler must return an engine, (params, cfg) or a "
+                    f"preset name; got {type(obj)}")
+
+
+async def amain() -> None:
+    cfg = RunnerConfig.from_env()
+    gateway_url = os.environ.get("TPU9_GATEWAY_URL", "")
+    token = os.environ.get("TPU9_TOKEN", "")
+
+    # multi-host gang? join the slice-wide jax.distributed job first
+    from ..parallel.distributed import initialize_multihost
+    initialize_multihost()
+
+    state = {"ready": False, "engine": None}
+
+    async def health(request: web.Request) -> web.Response:
+        if not state["ready"]:
+            return web.json_response({"ready": False}, status=503)
+        return web.json_response({"ready": True,
+                                  **state["engine"].stats()})
+
+    async def generate(request: web.Request) -> web.Response:
+        if not state["ready"]:
+            return web.json_response({"error": "not ready"}, status=503)
+        try:
+            payload = json.loads(await request.read() or b"{}")
+            tokens = payload.get("tokens") or payload.get("prompt_tokens")
+            if not isinstance(tokens, list) or not tokens:
+                return web.json_response(
+                    {"error": "body must include 'tokens': [int, ...]"},
+                    status=400)
+            out = await state["engine"].generate(
+                [int(t) for t in tokens],
+                max_new_tokens=int(payload.get("max_new_tokens", 32)))
+            return web.json_response({"tokens": out})
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response(error_payload(exc), status=500)
+
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app.router.add_get("/health", health)
+    app.router.add_post("/", generate)
+    app.router.add_post("/generate", generate)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", cfg.port).start()
+
+    # build the engine off the loop (model init / weight load can be slow)
+    handler = FunctionHandler(cfg)
+    result = await handler.call()
+    engine = _build_engine(result)
+    await engine.start()
+    state["engine"] = engine
+    state["ready"] = True
+    log.info("llm engine ready")
+
+    async def pressure_loop() -> None:
+        if not gateway_url:
+            return
+        async with aiohttp.ClientSession(
+                headers={"Authorization": f"Bearer {token}"}) as session:
+            while True:
+                try:
+                    stats = engine.stats()
+                    await session.post(
+                        gateway_url + "/rpc/llm/pressure",
+                        json={"container_id": cfg.container_id,
+                              "token_pressure": stats["token_pressure"],
+                              "active_streams": stats["active_streams"]},
+                        timeout=aiohttp.ClientTimeout(total=5))
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    pass
+                await asyncio.sleep(2.0)
+
+    await pressure_loop() if gateway_url else await asyncio.Event().wait()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    cfg = RunnerConfig.from_env()
+    if not cfg.handler:
+        print("TPU9_HANDLER not set", file=sys.stderr)
+        sys.exit(2)
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

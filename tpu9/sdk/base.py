@@ -51,7 +51,7 @@ class RunnerAbstraction:
                  checkpoint_enabled: bool = False,
                  env: Optional[dict] = None, secrets: Optional[list] = None,
                  volumes: Optional[list] = None, authorized: bool = True,
-                 on_start: Optional[Callable] = None):
+                 runner: str = "", on_start: Optional[Callable] = None):
         self.func = func
         self.name = name
         self.on_start = on_start
@@ -68,6 +68,8 @@ class RunnerAbstraction:
                      for v in (volumes or [])],
             authorized=authorized,
         )
+        if runner:
+            self.config.extra["runner"] = runner
         if autoscaler is not None:
             self.config.autoscaler = AutoscalerConfig(
                 type=autoscaler.type,

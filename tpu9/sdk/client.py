@@ -71,6 +71,15 @@ class AsyncGatewayClient:
         if self._session and not self._session.closed:
             await self._session.close()
 
+    async def request_bytes(self, method: str, path: str) -> bytes:
+        session = await self._ensure()
+        url = self.ctx.gateway_url.rstrip("/") + path
+        async with session.request(method, url) as resp:
+            body = await resp.read()
+            if resp.status >= 400:
+                raise GatewayError(resp.status, body[:500])
+            return body
+
     async def request(self, method: str, path: str,
                       json_body: Any = None, data: bytes = None) -> Any:
         session = await self._ensure()

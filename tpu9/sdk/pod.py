@@ -1,0 +1,103 @@
+"""SDK Pod: arbitrary-entrypoint containers with proxied ports.
+
+Reference analogue: ``sdk/src/beta9/abstractions/pod.py``.
+
+The pod's server must either bind the port tpu9 assigns (read the
+``TPU9_PORT`` env var — preferred, collision-free) or declare a fixed port
+via ``ports=[...]`` which the worker then assigns verbatim:
+
+    from tpu9 import Pod
+
+    pod = Pod(entrypoint=["sh", "-c",
+                          "python3 -m http.server $TPU9_PORT"],
+              cpu=1, memory="1Gi", tpu="v5e-1")
+    handle = pod.create()
+    print(handle.url)       # gateway proxy URL
+    handle.terminate()
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import RunnerAbstraction
+
+
+class PodHandle:
+    def __init__(self, container_id: str, client, gateway_url: str,
+                 address: Optional[str]):
+        self.container_id = container_id
+        self._client = client
+        self.address = address
+        self.url = f"{gateway_url}/pod/{container_id}/"
+
+    def status(self) -> dict:
+        return self._client._run(lambda c: c.request(
+            "GET", f"/rpc/pod/{self.container_id}/status"))
+
+    def exec(self, cmd: list[str], timeout: float = 60.0) -> dict:
+        return self._client._run(lambda c: c.request(
+            "POST", f"/rpc/pod/{self.container_id}/exec",
+            json_body={"cmd": cmd, "timeout": timeout}))
+
+    def terminate(self) -> bool:
+        out = self._client._run(lambda c: c.request(
+            "POST", f"/api/v1/container/{self.container_id}/stop",
+            json_body={}))
+        return out.get("ok", False)
+
+
+class Pod(RunnerAbstraction):
+    stub_type = "pod"
+
+    def __init__(self, entrypoint: Optional[list[str]] = None,
+                 ports: Optional[list[int]] = None, **kwargs):
+        kwargs.setdefault("name", self.stub_type)
+        super().__init__(None, **kwargs)
+        self.config.entrypoint = list(entrypoint or [])
+        self.config.ports = list(ports or [])
+
+    @property
+    def handler_spec(self) -> str:
+        return self.config.handler  # pods have no python handler
+
+    def create(self, wait: bool = True, timeout: float = 60.0) -> PodHandle:
+        stub_id = self.prepare_runtime()
+        out = self.client._run(lambda c: c.request(
+            "POST", "/rpc/pod/create",
+            json_body={"stub_id": stub_id, "wait": wait,
+                       "timeout": timeout}))
+        return PodHandle(out["container_id"], self.client,
+                         self.client.ctx.gateway_url, out.get("address"))
+
+
+class Sandbox(Pod):
+    """Interactive compute sandbox (reference sdk sandbox.py): an idle
+    container you exec into.
+
+        sb = Sandbox(cpu=1).create()
+        out = sb.exec(["python3", "-c", "print(40+2)"])
+        assert out["output"].strip() == "42"
+    """
+
+    stub_type = "sandbox"
+
+    def run_code(self, code: str, timeout: float = 60.0) -> dict:
+        import sys
+        return self.exec_default([sys.executable, "-c", code],
+                                 timeout=timeout)
+
+    def exec_default(self, cmd: list[str], timeout: float = 60.0) -> dict:
+        if not hasattr(self, "_handle"):
+            raise RuntimeError("call create() first")
+        return self._handle.exec(cmd, timeout=timeout)
+
+    def create(self, wait: bool = True, timeout: float = 60.0) -> "Sandbox":
+        self._handle = super().create(wait=wait, timeout=timeout)
+        return self
+
+    def exec(self, cmd: list[str], timeout: float = 60.0) -> dict:
+        return self.exec_default(cmd, timeout=timeout)
+
+    def terminate(self) -> bool:
+        return self._handle.terminate()

@@ -1,0 +1,189 @@
+"""SDK distributed primitives: Map, Queue, Signal, Output, Secret, Volume,
+CloudBucket.
+
+Reference analogue: sdk abstractions ``map.py``, ``queue.py``, ``signal``,
+``output.py``, ``volume.py``. All back onto gateway RPC; usable from user
+machines and inside containers (runner env provides the context).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+from .client import GatewayClient
+
+
+class _Bound:
+    def __init__(self, name: str):
+        self.name = name
+        self._client: Optional[GatewayClient] = None
+
+    @property
+    def client(self) -> GatewayClient:
+        if self._client is None:
+            self._client = GatewayClient()
+        return self._client
+
+    def _rpc(self, path: str, body: dict) -> dict:
+        return self.client._run(lambda c: c.request("POST", path,
+                                                    json_body=body))
+
+
+class Map(_Bound):
+    """Distributed dict: ``Map(name="state")["k"] = {"x": 1}``."""
+
+    def __setitem__(self, field: str, value: Any) -> None:
+        self._rpc(f"/rpc/map/{self.name}", {"op": "set", "field": field,
+                                            "value": value})
+
+    def __getitem__(self, field: str) -> Any:
+        out = self._rpc(f"/rpc/map/{self.name}", {"op": "get",
+                                                  "field": field})
+        return out.get("value")
+
+    get = __getitem__
+
+    def __delitem__(self, field: str) -> None:
+        self._rpc(f"/rpc/map/{self.name}", {"op": "delete", "field": field})
+
+    def keys(self) -> list[str]:
+        return self._rpc(f"/rpc/map/{self.name}", {"op": "keys"})["keys"]
+
+    def items(self) -> dict[str, Any]:
+        return self._rpc(f"/rpc/map/{self.name}", {"op": "items"})["items"]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys())
+
+
+class Queue(_Bound):
+    """Distributed FIFO: ``Queue(name="jobs").put(x)`` / ``.pop()``."""
+
+    def put(self, value: Any) -> int:
+        return self._rpc(f"/rpc/queue/{self.name}",
+                         {"op": "push", "value": value})["depth"]
+
+    def pop(self, timeout: float = 0) -> Any:
+        return self._rpc(f"/rpc/queue/{self.name}",
+                         {"op": "pop", "timeout": timeout})["value"]
+
+    def __len__(self) -> int:
+        return self._rpc(f"/rpc/queue/{self.name}", {"op": "depth"})["depth"]
+
+
+class Signal(_Bound):
+    """Named cross-container event."""
+
+    def set(self, ttl: Optional[float] = None) -> None:
+        self._rpc(f"/rpc/signal/{self.name}", {"op": "set", "ttl": ttl})
+
+    def clear(self) -> None:
+        self._rpc(f"/rpc/signal/{self.name}", {"op": "clear"})
+
+    def is_set(self) -> bool:
+        return self._rpc(f"/rpc/signal/{self.name}", {"op": "is_set"})["set"]
+
+    def wait(self, timeout: float = 30.0) -> bool:
+        return self._rpc(f"/rpc/signal/{self.name}",
+                         {"op": "wait", "timeout": timeout})["set"]
+
+
+class Output:
+    """Save an artifact and mint a retrieval URL."""
+
+    def __init__(self, path: str = "", data: bytes = b""):
+        self.path = path
+        self.data = data
+        self._client: Optional[GatewayClient] = None
+
+    @property
+    def client(self) -> GatewayClient:
+        if self._client is None:
+            self._client = GatewayClient()
+        return self._client
+
+    def save(self) -> str:
+        data = self.data or open(self.path, "rb").read()
+        import os
+        filename = os.path.basename(self.path) or "output.bin"
+        out = self.client._run(lambda c: c.request(
+            "POST", f"/rpc/output/save?filename={filename}", data=data))
+        self.output_id = out["output_id"]
+        return out["url"]
+
+
+class Secret:
+    """Workspace secret reference; the value is injected as env at runtime
+    (declare in the decorator's ``secrets=[...]``)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def set(self, value: str) -> None:
+        GatewayClient()._run(lambda c: c.request(
+            "POST", "/api/v1/secret",
+            json_body={"name": self.name, "value": value}))
+
+    def delete(self) -> None:
+        GatewayClient()._run(lambda c: c.request(
+            "DELETE", f"/api/v1/secret/{self.name}"))
+
+
+class Volume(_Bound):
+    """Workspace file share mounted into containers.
+
+        vol = Volume(name="models", mount_path="/models")
+        @endpoint(volumes=[vol]) ...
+
+    Outside containers, ``upload``/``download``/``ls`` operate via the
+    gateway (reference volume RPCs + multipart transfers).
+    """
+
+    def __init__(self, name: str, mount_path: str = ""):
+        super().__init__(name)
+        self.mount_path = mount_path or f"/volumes/{name}"
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "mount_path": self.mount_path}
+
+    @staticmethod
+    def _q(path: str) -> str:
+        from urllib.parse import quote
+        return quote(path, safe="/")
+
+    def upload(self, local_path: str, remote_path: str = "") -> int:
+        remote = remote_path or local_path.rsplit("/", 1)[-1]
+        data = open(local_path, "rb").read()
+        out = self.client._run(lambda c: c.request(
+            "PUT", f"/rpc/volume/{self.name}/files/{self._q(remote)}",
+            data=data))
+        return out["size"]
+
+    def download(self, remote_path: str) -> bytes:
+        return self.client._run(lambda c: c.request_bytes(
+            "GET", f"/rpc/volume/{self.name}/files/{self._q(remote_path)}"))
+
+    def ls(self, prefix: str = "") -> list[dict]:
+        from urllib.parse import quote
+        return self.client._run(lambda c: c.request(
+            "GET", f"/rpc/volume/{self.name}/files?prefix={quote(prefix)}"))
+
+    def rm(self, remote_path: str) -> bool:
+        return self.client._run(lambda c: c.request(
+            "DELETE",
+            f"/rpc/volume/{self.name}/files/{self._q(remote_path)}"))["ok"]
+
+
+class CloudBucket(Volume):
+    """External object-store bucket mounted like a volume (reference
+    CloudBucket). v1 routes through the same volume API with the bucket
+    synced server-side; direct GCS mounting lands with the storage backend."""
+
+    def __init__(self, name: str, bucket: str, mount_path: str = ""):
+        super().__init__(name, mount_path)
+        self.bucket = bucket
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["bucket"] = self.bucket
+        return d

@@ -48,6 +48,7 @@ class LocalStack:
         cfg.database.path = ":memory:"
         cfg.storage.local_root = os.path.join(self.tmp.name, "workspaces")
         cfg.worker.containers_dir = os.path.join(self.tmp.name, "containers")
+        cfg.worker.storage_root = cfg.storage.local_root
         cfg.worker.idle_shutdown_s = worker_idle_shutdown_s
         cfg.cache.data_dir = os.path.join(self.tmp.name, "cache")
         cfg.image.registry_dir = os.path.join(self.tmp.name, "registry")

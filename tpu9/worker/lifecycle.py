@@ -87,7 +87,9 @@ class ContainerLifecycle:
             assignment = self.tpu.assign(request)
             self._phase(container_id, LifecyclePhase.DEVICES_READY, t0)
 
-            port = free_port()
+            # user-pinned port (pods whose entrypoint binds a fixed port)
+            # wins; otherwise allocate a free one and pass it via TPU9_PORT
+            port = request.ports[0] if request.ports else free_port()
             spec = self._spec_from_request(request, rootfs, workdir, port,
                                            assignment)
             self._phase(container_id, LifecyclePhase.SPEC_READY, t0)
@@ -109,6 +111,10 @@ class ContainerLifecycle:
                 ready = await self._wait_ready(container_id, address)
                 if not ready:
                     raise RuntimeError("container failed readiness probe")
+            elif request.stub_type == StubType.POD.value:
+                # pods with a server: best-effort TCP readiness so the proxy
+                # doesn't race the bind; batch pods just time out the probe
+                await self._wait_tcp(container_id, address, budget_s=15.0)
 
             state.status = ContainerStatus.RUNNING.value
             state.address = address
@@ -175,7 +181,9 @@ class ContainerLifecycle:
         return ""
 
     async def _prepare_workspace(self, request: ContainerRequest) -> str:
-        """Materialize the synced user code into the sandbox workdir."""
+        """Materialize the synced user code into the sandbox workdir and link
+        workspace volumes at their mount paths (process runtime: symlinks
+        under the workdir; runc: real bind mounts from the same sources)."""
         base = os.path.join(self.cfg.containers_dir, request.container_id,
                             "workspace")
         os.makedirs(base, exist_ok=True)
@@ -185,6 +193,17 @@ class ContainerLifecycle:
                 import zipfile
                 await asyncio.to_thread(
                     lambda: zipfile.ZipFile(archive).extractall(base))
+        for mount in request.mounts:
+            if mount.kind != "volume" or not mount.target:
+                continue
+            host_dir = os.path.join(self.cfg.storage_root,
+                                    request.workspace_id, "volumes",
+                                    mount.source)
+            os.makedirs(host_dir, exist_ok=True)
+            link = os.path.join(base, mount.target.lstrip("/"))
+            os.makedirs(os.path.dirname(link), exist_ok=True)
+            if not os.path.lexists(link):
+                os.symlink(host_dir, link)
         return base
 
     def _spec_from_request(self, request: ContainerRequest, rootfs: str,
@@ -226,19 +245,33 @@ class ContainerLifecycle:
 
         entrypoint = list(request.entrypoint)
         if not entrypoint:
-            runner_mod = {
-                StubType.ENDPOINT.value: "tpu9.runner.endpoint",
-                StubType.ASGI.value: "tpu9.runner.endpoint",
-                StubType.REALTIME.value: "tpu9.runner.endpoint",
-                StubType.TASK_QUEUE.value: "tpu9.runner.taskqueue",
-                StubType.FUNCTION.value: "tpu9.runner.function",
-                StubType.SCHEDULE.value: "tpu9.runner.function",
-            }.get(request.stub_type, "tpu9.runner.endpoint")
+            if env.get("TPU9_RUNNER") == "llm":
+                runner_mod = "tpu9.runner.llm"
+            else:
+                runner_mod = {
+                    StubType.ENDPOINT.value: "tpu9.runner.endpoint",
+                    StubType.ASGI.value: "tpu9.runner.endpoint",
+                    StubType.REALTIME.value: "tpu9.runner.endpoint",
+                    StubType.TASK_QUEUE.value: "tpu9.runner.taskqueue",
+                    StubType.FUNCTION.value: "tpu9.runner.function",
+                    StubType.SCHEDULE.value: "tpu9.runner.function",
+                }.get(request.stub_type, "tpu9.runner.endpoint")
             entrypoint = [sys.executable, "-m", runner_mod]
             # the runner package must be importable inside the sandbox
             repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))))
             env["PYTHONPATH"] = env["PYTHONPATH"] + os.pathsep + repo_root
+
+        spec_mounts = []
+        for mount in request.mounts:
+            if mount.kind == "volume":
+                host_dir = os.path.join(self.cfg.storage_root,
+                                        request.workspace_id, "volumes",
+                                        mount.source)
+                spec_mounts.append((host_dir, mount.target, mount.read_only))
+            elif mount.kind == "bind":
+                spec_mounts.append((mount.source, mount.target,
+                                    mount.read_only))
 
         return ContainerSpec(
             container_id=request.container_id,
@@ -246,11 +279,29 @@ class ContainerLifecycle:
             env=env,
             workdir=workdir,
             rootfs=rootfs,
+            mounts=spec_mounts,
             cpu_millicores=request.cpu_millicores,
             memory_mb=request.memory_mb,
             devices=devices,
             ports={port: port},
         )
+
+    async def _wait_tcp(self, container_id: str, address: str,
+                        budget_s: float = 15.0) -> bool:
+        host, _, port = address.rpartition(":")
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            handle = await self.runtime.state(container_id)
+            if handle is not None and handle.exit_code is not None:
+                return False
+            try:
+                _r, w = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port)), 0.5)
+                w.close()
+                return True
+            except (OSError, asyncio.TimeoutError):
+                await asyncio.sleep(0.05)
+        return False
 
     async def _wait_ready(self, container_id: str, address: str) -> bool:
         """Poll the runner's /health endpoint (buffer.go:334 equivalent)."""

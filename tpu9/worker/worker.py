@@ -97,6 +97,7 @@ class Worker:
             asyncio.create_task(self._heartbeat_loop()),
             asyncio.create_task(self._request_loop()),
             asyncio.create_task(self._stop_loop()),
+            asyncio.create_task(self._exec_loop()),
         ]
         log.info("worker %s started (pool=%s chips=%d)", self.worker_id,
                  self.pool, self.tpu.chip_count)
@@ -156,6 +157,32 @@ class Worker:
                     reason=payload.get("reason", StopReason.USER.value))
         finally:
             sub.close()
+
+    async def _exec_loop(self) -> None:
+        """Sandbox exec requests over pubsub (container_server.go:169
+        equivalent): run the command in the container, reply on the given
+        channel."""
+        sub = self.store.subscribe(f"container:exec:{self.worker_id}")
+        try:
+            while not self._stopping.is_set():
+                msg = await sub.get(timeout=1.0)
+                if msg is None:
+                    continue
+                _, payload = msg
+                if not payload:
+                    continue
+                asyncio.create_task(self._handle_exec(payload))
+        finally:
+            sub.close()
+
+    async def _handle_exec(self, payload: dict) -> None:
+        try:
+            code, output = await self.runtime.exec(
+                payload["container_id"], list(payload.get("cmd", [])))
+        except Exception as exc:  # noqa: BLE001 — reply instead of crash
+            code, output = -1, f"exec failed: {exc}"
+        await self.store.publish(payload.get("reply", ""),
+                                 {"exit_code": code, "output": output[-65536:]})
 
     async def _handle_request(self, request: ContainerRequest) -> None:
         async with self._start_sem:   # start-concurrency cap (worker.go:594)
