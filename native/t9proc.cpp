@@ -1,0 +1,282 @@
+// t9proc — minimal PID-1 process supervisor for tpu9 sandbox containers.
+//
+// Reference analogue: the external beam-cloud/goproc binary the reference
+// bind-mounts as sandbox PID 1 (pkg/worker/lifecycle.go:1299-1325) and talks
+// to over gRPC. t9proc speaks newline-delimited JSON on stdin/stdout (no
+// proto toolchain needed inside minimal containers):
+//
+//   → {"op": "spawn", "id": "t1", "argv": ["sh", "-c", "echo hi"]}
+//   ← {"event": "spawned", "id": "t1", "pid": 123}
+//   ← {"event": "stdout", "id": "t1", "data": "hi\n"}
+//   ← {"event": "exit", "id": "t1", "code": 0}
+//   → {"op": "signal", "id": "t1", "signum": 15}
+//   → {"op": "list"}
+//   ← {"event": "list", "procs": [{"id": "t1", "pid": 123}]}
+//
+// As PID 1 it also reaps orphaned zombies (the classic init duty containers
+// need). JSON parsing is a tiny purpose-built scanner — inputs come from the
+// trusted worker, not end users.
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+struct Proc {
+  pid_t pid = -1;
+  int out_fd = -1;
+  std::string id;
+};
+
+std::map<std::string, Proc> procs;       // id -> proc
+std::map<int, std::string> fd_to_id;     // stdout fd -> id
+
+void emit(const std::string& line) {
+  fputs(line.c_str(), stdout);
+  fputc('\n', stdout);
+  fflush(stdout);
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+// --- minimal JSON field extraction (flat objects, string/array values) ----
+
+std::string get_string(const std::string& line, const std::string& key) {
+  std::string pat = "\"" + key + "\"";
+  size_t k = line.find(pat);
+  if (k == std::string::npos) return "";
+  size_t q1 = line.find('"', line.find(':', k + pat.size()));
+  if (q1 == std::string::npos) return "";
+  std::string out;
+  for (size_t i = q1 + 1; i < line.size(); ++i) {
+    char c = line[i];
+    if (c == '\\' && i + 1 < line.size()) {
+      char n = line[++i];
+      out += (n == 'n') ? '\n' : (n == 't') ? '\t' : n;
+    } else if (c == '"') {
+      return out;
+    } else {
+      out += c;
+    }
+  }
+  return out;
+}
+
+long get_number(const std::string& line, const std::string& key, long dflt) {
+  std::string pat = "\"" + key + "\"";
+  size_t k = line.find(pat);
+  if (k == std::string::npos) return dflt;
+  size_t colon = line.find(':', k + pat.size());
+  if (colon == std::string::npos) return dflt;
+  return strtol(line.c_str() + colon + 1, nullptr, 10);
+}
+
+std::vector<std::string> get_array(const std::string& line,
+                                   const std::string& key) {
+  std::vector<std::string> out;
+  std::string pat = "\"" + key + "\"";
+  size_t k = line.find(pat);
+  if (k == std::string::npos) return out;
+  size_t open = line.find('[', k);
+  if (open == std::string::npos) return out;
+  size_t i = open + 1;
+  while (i < line.size() && line[i] != ']') {
+    if (line[i] == '"') {
+      std::string item;
+      ++i;
+      while (i < line.size() && line[i] != '"') {
+        if (line[i] == '\\' && i + 1 < line.size()) {
+          char n = line[++i];
+          item += (n == 'n') ? '\n' : (n == 't') ? '\t' : n;
+        } else {
+          item += line[i];
+        }
+        ++i;
+      }
+      out.push_back(item);
+    }
+    ++i;
+  }
+  return out;
+}
+
+// --- ops ------------------------------------------------------------------
+
+void do_spawn(const std::string& line) {
+  std::string id = get_string(line, "id");
+  std::vector<std::string> argv = get_array(line, "argv");
+  if (id.empty() || argv.empty()) {
+    emit("{\"event\": \"error\", \"message\": \"spawn needs id and argv\"}");
+    return;
+  }
+  if (procs.count(id) != 0) {
+    emit("{\"event\": \"error\", \"id\": \"" + json_escape(id) +
+         "\", \"message\": \"id in use\"}");
+    return;
+  }
+  int pipefd[2];
+  if (pipe(pipefd) != 0) {
+    emit("{\"event\": \"error\", \"message\": \"pipe failed\"}");
+    return;
+  }
+  pid_t pid = fork();
+  if (pid == 0) {
+    close(pipefd[0]);
+    dup2(pipefd[1], STDOUT_FILENO);
+    dup2(pipefd[1], STDERR_FILENO);
+    close(pipefd[1]);
+    std::vector<char*> cargv;
+    for (auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+    cargv.push_back(nullptr);
+    execvp(cargv[0], cargv.data());
+    fprintf(stderr, "exec failed: %s\n", strerror(errno));
+    _exit(127);
+  }
+  close(pipefd[1]);
+  fcntl(pipefd[0], F_SETFL, O_NONBLOCK);
+  Proc p;
+  p.pid = pid;
+  p.out_fd = pipefd[0];
+  p.id = id;
+  procs[id] = p;
+  fd_to_id[pipefd[0]] = id;
+  char buf[160];
+  snprintf(buf, sizeof buf, "{\"event\": \"spawned\", \"id\": \"%s\", \"pid\": %d}",
+           json_escape(id).c_str(), pid);
+  emit(buf);
+}
+
+void do_signal(const std::string& line) {
+  std::string id = get_string(line, "id");
+  long signum = get_number(line, "signum", SIGTERM);
+  auto it = procs.find(id);
+  if (it == procs.end()) {
+    emit("{\"event\": \"error\", \"id\": \"" + json_escape(id) +
+         "\", \"message\": \"unknown id\"}");
+    return;
+  }
+  kill(it->second.pid, static_cast<int>(signum));
+  emit("{\"event\": \"signaled\", \"id\": \"" + json_escape(id) + "\"}");
+}
+
+void do_list() {
+  std::string out = "{\"event\": \"list\", \"procs\": [";
+  bool first = true;
+  for (auto& kv : procs) {
+    if (!first) out += ",";
+    first = false;
+    out += "{\"id\": \"" + json_escape(kv.first) + "\", \"pid\": " +
+           std::to_string(kv.second.pid) + "}";
+  }
+  out += "]}";
+  emit(out);
+}
+
+void pump_fd(int fd) {
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof buf)) > 0) {
+    auto it = fd_to_id.find(fd);
+    if (it == fd_to_id.end()) continue;
+    emit("{\"event\": \"stdout\", \"id\": \"" + json_escape(it->second) +
+         "\", \"data\": \"" + json_escape(std::string(buf, n)) + "\"}");
+  }
+}
+
+void reap() {
+  int status;
+  pid_t pid;
+  while ((pid = waitpid(-1, &status, WNOHANG)) > 0) {
+    for (auto it = procs.begin(); it != procs.end(); ++it) {
+      if (it->second.pid != pid) continue;
+      pump_fd(it->second.out_fd);  // drain trailing output
+      int code = WIFEXITED(status) ? WEXITSTATUS(status)
+                                   : 128 + WTERMSIG(status);
+      emit("{\"event\": \"exit\", \"id\": \"" + json_escape(it->first) +
+           "\", \"code\": " + std::to_string(code) + "}");
+      close(it->second.out_fd);
+      fd_to_id.erase(it->second.out_fd);
+      procs.erase(it);
+      break;
+    }
+    // unknown pids (orphans re-parented to PID 1) are silently reaped
+  }
+}
+
+}  // namespace
+
+int main() {
+  signal(SIGPIPE, SIG_IGN);
+  emit("{\"event\": \"ready\", \"pid\": " + std::to_string(getpid()) + "}");
+
+  std::string inbuf;
+  char chunk[4096];
+  bool stdin_open = true;
+  while (stdin_open || !procs.empty()) {
+    std::vector<pollfd> fds;
+    if (stdin_open) fds.push_back({STDIN_FILENO, POLLIN, 0});
+    for (auto& kv : procs) fds.push_back({kv.second.out_fd, POLLIN, 0});
+    int rc = poll(fds.data(), fds.size(), 200);
+    if (rc > 0) {
+      for (auto& pfd : fds) {
+        if (!(pfd.revents & (POLLIN | POLLHUP))) continue;
+        if (pfd.fd == STDIN_FILENO) {
+          ssize_t n = read(STDIN_FILENO, chunk, sizeof chunk);
+          if (n <= 0) {
+            stdin_open = false;
+            continue;
+          }
+          inbuf.append(chunk, n);
+          size_t nl;
+          while ((nl = inbuf.find('\n')) != std::string::npos) {
+            std::string line = inbuf.substr(0, nl);
+            inbuf.erase(0, nl + 1);
+            std::string op = get_string(line, "op");
+            if (op == "spawn") do_spawn(line);
+            else if (op == "signal") do_signal(line);
+            else if (op == "list") do_list();
+            else if (op == "shutdown") { stdin_open = false; }
+            else if (!line.empty())
+              emit("{\"event\": \"error\", \"message\": \"unknown op\"}");
+          }
+        } else {
+          pump_fd(pfd.fd);
+        }
+      }
+    }
+    reap();
+  }
+  return 0;
+}

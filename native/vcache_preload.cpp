@@ -1,0 +1,171 @@
+// vcache_preload.so — LD_PRELOAD shim routing volume file reads to the
+// worker's local content cache.
+//
+// Reference analogue: the prebuilt bin/volume_cache_{x86,arm}.so C shim the
+// reference injects with LD_PRELOAD + VOLUME_CACHE_MAP
+// (pkg/worker/file_cache.go:21-24) so container reads of network-volume files
+// hit the node's distributed cache instead of the object store. The source of
+// that shim is not vendored upstream; this is tpu9's own implementation.
+//
+// Contract (set by the worker when a container has cached volumes):
+//   TPU9_VCACHE_MAP=/volumes/models=/cache/vol/models:/volumes/data=/cache/vol/data
+//     (colon-separated "<mount-prefix>=<cache-dir>" pairs)
+//   TPU9_VCACHE_STATS=/tmp/vcache-stats   (optional; hit/miss counters
+//                                          appended on process exit)
+//
+// open()/open64()/fopen()/stat() of a path under a mapped prefix is
+// redirected to the cache copy when one exists (the worker materializes hot
+// volume files into the cache dir via hardlinks, so a hit is a local-disk
+// open). Writes and missing files fall through to the real path — the shim
+// is a read accelerator, never a correctness layer.
+
+#include <atomic>
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Mapping {
+  std::string prefix;
+  std::string cache_dir;
+};
+
+std::vector<Mapping>* mappings = nullptr;
+std::atomic<long> g_hits{0};
+std::atomic<long> g_misses{0};
+
+using open_fn = int (*)(const char*, int, ...);
+using fopen_fn = FILE* (*)(const char*, const char*);
+using stat_fn = int (*)(const char*, struct stat*);
+
+open_fn real_open = nullptr;
+open_fn real_open64 = nullptr;
+fopen_fn real_fopen = nullptr;
+fopen_fn real_fopen64 = nullptr;
+
+void init_once() {
+  if (mappings != nullptr) return;
+  auto* m = new std::vector<Mapping>();
+  const char* raw = getenv("TPU9_VCACHE_MAP");
+  if (raw != nullptr) {
+    std::string spec(raw);
+    size_t start = 0;
+    while (start < spec.size()) {
+      size_t end = spec.find(':', start);
+      if (end == std::string::npos) end = spec.size();
+      std::string pair = spec.substr(start, end - start);
+      size_t eq = pair.find('=');
+      if (eq != std::string::npos && eq > 0) {
+        m->push_back({pair.substr(0, eq), pair.substr(eq + 1)});
+      }
+      start = end + 1;
+    }
+  }
+  real_open = reinterpret_cast<open_fn>(dlsym(RTLD_NEXT, "open"));
+  real_open64 = reinterpret_cast<open_fn>(dlsym(RTLD_NEXT, "open64"));
+  real_fopen = reinterpret_cast<fopen_fn>(dlsym(RTLD_NEXT, "fopen"));
+  real_fopen64 = reinterpret_cast<fopen_fn>(dlsym(RTLD_NEXT, "fopen64"));
+  mappings = m;
+}
+
+// Returns the cache path when `path` is under a mapped prefix AND the cache
+// copy exists; empty string otherwise.
+std::string redirect(const char* path, bool write_mode) {
+  if (path == nullptr || write_mode) return "";
+  init_once();
+  for (const auto& map : *mappings) {
+    size_t n = map.prefix.size();
+    if (strncmp(path, map.prefix.c_str(), n) == 0 &&
+        (path[n] == '/' || path[n] == '\0')) {
+      std::string candidate = map.cache_dir + (path + n);
+      struct stat st;
+      if (::stat(candidate.c_str(), &st) == 0 && S_ISREG(st.st_mode)) {
+        g_hits.fetch_add(1, std::memory_order_relaxed);
+        return candidate;
+      }
+      g_misses.fetch_add(1, std::memory_order_relaxed);
+      return "";
+    }
+  }
+  return "";
+}
+
+bool flags_write(int flags) {
+  return (flags & (O_WRONLY | O_RDWR | O_CREAT | O_TRUNC | O_APPEND)) != 0;
+}
+
+bool mode_write(const char* mode) {
+  return mode != nullptr && (strchr(mode, 'w') || strchr(mode, 'a') ||
+                             strchr(mode, '+'));
+}
+
+struct StatsDumper {
+  ~StatsDumper() {
+    const char* stats = getenv("TPU9_VCACHE_STATS");
+    if (stats == nullptr) return;
+    FILE* f = real_fopen != nullptr ? real_fopen(stats, "a")
+                                    : ::fopen(stats, "a");
+    if (f != nullptr) {
+      fprintf(f, "{\"hits\": %ld, \"misses\": %ld}\n", g_hits.load(),
+              g_misses.load());
+      fclose(f);
+    }
+  }
+} g_stats_dumper;
+
+}  // namespace
+
+extern "C" {
+
+int open(const char* path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & O_CREAT) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  init_once();
+  std::string alt = redirect(path, flags_write(flags));
+  const char* target = alt.empty() ? path : alt.c_str();
+  return real_open(target, flags, mode);
+}
+
+int open64(const char* path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & O_CREAT) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  init_once();
+  std::string alt = redirect(path, flags_write(flags));
+  const char* target = alt.empty() ? path : alt.c_str();
+  return (real_open64 != nullptr ? real_open64 : real_open)(target, flags,
+                                                            mode);
+}
+
+FILE* fopen(const char* path, const char* mode) {
+  init_once();
+  std::string alt = redirect(path, mode_write(mode));
+  return real_fopen(alt.empty() ? path : alt.c_str(), mode);
+}
+
+FILE* fopen64(const char* path, const char* mode) {
+  init_once();
+  std::string alt = redirect(path, mode_write(mode));
+  return (real_fopen64 != nullptr ? real_fopen64 : real_fopen)(
+      alt.empty() ? path : alt.c_str(), mode);
+}
+
+}  // extern "C"

@@ -1,0 +1,81 @@
+"""E2E: checkpoint on readiness → restore on next cold start.
+
+The handler simulates expensive init (writes a build artifact). First
+container builds + checkpoints; after scale-to-zero the next container must
+restore the snapshot (artifact present without rebuilding, TPU9_RESTORED
+set)."""
+
+import asyncio
+
+import pytest
+
+from tpu9.testing.localstack import LocalStack
+
+pytestmark = pytest.mark.e2e
+
+EXPENSIVE = """
+import os, time, pathlib
+
+ART = pathlib.Path("model_artifact.bin")
+
+def _build():
+    # "expensive" init: only ever done when no checkpoint exists
+    time.sleep(0.5)
+    ART.write_bytes(b"weights-v1")
+    return ART.read_bytes()
+
+if ART.exists():
+    WEIGHTS = ART.read_bytes()
+    BUILT = False
+else:
+    WEIGHTS = _build()
+    BUILT = True
+
+def handler(**kw):
+    return {"weights": WEIGHTS.decode(), "built": BUILT,
+            "restored": os.environ.get("TPU9_RESTORED", "0")}
+"""
+
+
+async def test_checkpoint_restore_cycle():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint(
+            "ckpt", {"app.py": EXPENSIVE}, "app:handler",
+            config_extra={"checkpoint": {"enabled": True}})
+        out1 = await stack.invoke(dep, {})
+        assert out1["built"] is True and out1["restored"] == "0"
+
+        # wait for the readiness checkpoint to land
+        for _ in range(100):
+            row = await stack.backend.latest_checkpoint(dep["stub_id"])
+            if row:
+                break
+            await asyncio.sleep(0.1)
+        assert row, "checkpoint never became available"
+
+        await stack.scale_to_zero(dep)
+        out2 = await stack.invoke(dep, {})
+        # restored container found the artifact: no rebuild
+        assert out2["restored"] == "1"
+        assert out2["built"] is False
+        assert out2["weights"] == "weights-v1"
+
+
+async def test_checkpoint_restore_fallback_to_cold_boot():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint(
+            "ckpt2", {"app.py": EXPENSIVE}, "app:handler",
+            config_extra={"checkpoint": {"enabled": True}})
+        await stack.invoke(dep, {})
+        for _ in range(100):
+            row = await stack.backend.latest_checkpoint(dep["stub_id"])
+            if row:
+                break
+            await asyncio.sleep(0.1)
+        assert row
+        # poison the manifest so restore fails → cold boot must still work
+        import os
+        os.unlink(stack._ckpt_path(row["checkpoint_id"]))
+        await stack.scale_to_zero(dep)
+        out = await stack.invoke(dep, {})
+        assert out["built"] is True     # rebuilt from scratch, no crash

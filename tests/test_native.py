@@ -1,0 +1,108 @@
+"""Native C++ components: vcache LD_PRELOAD shim and t9proc supervisor.
+
+Builds via make (g++ baked into the image); tests drive the real binaries.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def built():
+    subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                   capture_output=True)
+    return BUILD_DIR
+
+
+def test_vcache_redirects_cached_reads(built, tmp_path):
+    vol = tmp_path / "volumes" / "models"
+    cache = tmp_path / "cache" / "models"
+    vol.mkdir(parents=True)
+    cache.mkdir(parents=True)
+    (vol / "weights.bin").write_text("SLOW-ORIGINAL")
+    (cache / "weights.bin").write_text("FAST-CACHED")
+    (vol / "uncached.txt").write_text("ONLY-IN-VOLUME")
+
+    stats = tmp_path / "stats.jsonl"
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": os.path.join(built, "vcache_preload.so"),
+        "TPU9_VCACHE_MAP": f"{vol}={cache}",
+        "TPU9_VCACHE_STATS": str(stats),
+    })
+    code = (
+        f"data = open({str(vol / 'weights.bin')!r}).read()\n"
+        f"other = open({str(vol / 'uncached.txt')!r}).read()\n"
+        "print(data); print(other)\n"
+        # writes must NOT be redirected
+        f"open({str(vol / 'new.txt')!r}, 'w').write('NEW')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stderr
+    lines = out.stdout.strip().splitlines()
+    assert lines[0] == "FAST-CACHED"          # cached read redirected
+    assert lines[1] == "ONLY-IN-VOLUME"       # miss falls through
+    assert (vol / "new.txt").read_text() == "NEW"   # write hit the volume
+    assert not (cache / "new.txt").exists()
+    stat = json.loads(stats.read_text().splitlines()[-1])
+    assert stat["hits"] >= 1 and stat["misses"] >= 1
+
+
+def test_t9proc_spawn_reap_signal(built):
+    proc = subprocess.Popen([os.path.join(built, "t9proc")],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            text=True, bufsize=1)
+    try:
+        events = []
+
+        def read_until(kind, limit=50):
+            for _ in range(limit):
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                e = json.loads(line)
+                events.append(e)
+                if e.get("event") == kind:
+                    return e
+            raise AssertionError(f"never saw {kind}: {events}")
+
+        assert read_until("ready")["pid"] == proc.pid
+
+        proc.stdin.write(json.dumps(
+            {"op": "spawn", "id": "t1",
+             "argv": ["sh", "-c", "echo hello-from-t9proc"]}) + "\n")
+        spawned = read_until("spawned")
+        assert spawned["id"] == "t1" and spawned["pid"] > 0
+        out = read_until("stdout")
+        assert "hello-from-t9proc" in out["data"]
+        assert read_until("exit")["code"] == 0
+
+        # long-running child + signal
+        proc.stdin.write(json.dumps(
+            {"op": "spawn", "id": "t2", "argv": ["sleep", "30"]}) + "\n")
+        read_until("spawned")
+        proc.stdin.write(json.dumps({"op": "list"}) + "\n")
+        listing = read_until("list")
+        assert [p["id"] for p in listing["procs"]] == ["t2"]
+        proc.stdin.write(json.dumps(
+            {"op": "signal", "id": "t2", "signum": 9}) + "\n")
+        read_until("signaled")
+        assert read_until("exit")["code"] == 137   # 128 + SIGKILL
+
+        proc.stdin.write(json.dumps({"op": "shutdown"}) + "\n")
+        proc.stdin.close()
+        assert proc.wait(timeout=10) == 0
+    finally:
+        proc.kill()

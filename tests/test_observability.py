@@ -1,0 +1,80 @@
+import asyncio
+
+from tpu9.config import WorkerPoolConfig
+from tpu9.observability import EventBus, Metrics
+from tpu9.repository import WorkerRepository
+from tpu9.scheduler.pool_health import PoolMonitor
+from tpu9.statestore import MemoryStore
+from tpu9.types import WorkerState, WorkerStatus
+
+
+def test_metrics_registry():
+    m = Metrics()
+    m.inc("reqs", labels={"route": "/x"})
+    m.inc("reqs", 2, labels={"route": "/x"})
+    m.set_gauge("depth", 7)
+    for v in [0.1, 0.2, 0.3, 0.9]:
+        m.observe("lat", v)
+    d = m.to_dict()
+    assert d["counters"]['reqs{route="/x"}'] == 3
+    assert d["gauges"]["depth"] == 7
+    assert d["summaries"]["lat"]["count"] == 4
+    assert 0.1 <= d["summaries"]["lat"]["p50"] <= 0.3
+    assert d["summaries"]["lat"]["max"] == 0.9
+    text = m.prometheus_text()
+    assert 'reqs{route="/x"} 3' in text
+    assert "lat_p95" in text
+
+
+def test_metrics_timer():
+    import time
+    m = Metrics()
+    with m.timer("op"):
+        time.sleep(0.01)
+    assert m.to_dict()["summaries"]["op"]["max"] >= 0.01
+
+
+async def test_event_bus_emit_and_query():
+    store = MemoryStore()
+    bus = EventBus(store)
+    await bus.emit("container.started", {"container_id": "c1"}, "w1")
+    await bus.emit("container.exited", {"container_id": "c1"}, "w1")
+    await bus.emit("worker.registered", {"worker_id": "w"}, "")
+    rows = await bus.query()
+    assert len(rows) == 3
+    containers_only = await bus.query(kind_prefix="container")
+    assert len(containers_only) == 2
+    assert containers_only[0]["data"]["container_id"] == "c1"
+
+
+async def test_pool_monitor_reaps_dead_and_warms():
+    store = MemoryStore()
+    workers = WorkerRepository(store, keepalive_ttl_s=0.1)
+    alive = WorkerState(worker_id="alive", pool="p",
+                        status=WorkerStatus.AVAILABLE.value,
+                        total_cpu_millicores=4000, free_cpu_millicores=4000,
+                        total_memory_mb=8192, free_memory_mb=8192)
+    dead = WorkerState(worker_id="dead", pool="p",
+                       status=WorkerStatus.AVAILABLE.value)
+    await workers.register(alive)
+    await workers.register(dead)
+    # let dead's keepalive lapse; keep alive fresh
+    await asyncio.sleep(0.15)
+    await workers.touch_keepalive("alive")
+
+    added = []
+
+    class FakePool:
+        async def can_host(self, request):
+            return True
+
+        async def add_worker(self, request):
+            added.append(request)
+
+    cfg = WorkerPoolConfig(name="p", min_free_tpu_chips=4)
+    mon = PoolMonitor(store, {"p": FakePool()}, {"p": cfg},
+                      interval_s=0.05)
+    await mon.tick()
+    assert mon.status["p"].alive == 1
+    assert await workers.get("dead") is None          # reaped
+    assert added, "warm-pool sizing should have requested a worker"

@@ -24,9 +24,21 @@ log = logging.getLogger("tpu9.abstractions")
 
 
 def volume_mounts(cfg: StubConfig) -> list[Mount]:
-    """Stub volume declarations → container mount list."""
-    return [Mount(source=v.get("name", ""), target=v.get("mount_path", ""),
-                  kind="volume") for v in cfg.volumes if v.get("name")]
+    """Stub volume declarations → container mount list.
+
+    Names/targets are validated here AND at the worker (defense in depth):
+    a volume name is a single path component; a mount path may not traverse.
+    """
+    out = []
+    for v in cfg.volumes:
+        name = v.get("name", "")
+        target = v.get("mount_path", "")
+        if not name or "/" in name or "\\" in name or name in (".", ".."):
+            raise ValueError(f"invalid volume name {name!r}")
+        if ".." in target.split("/"):
+            raise ValueError(f"invalid mount path {target!r}")
+        out.append(Mount(source=name, target=target, kind="volume"))
+    return out
 
 
 
@@ -35,17 +47,22 @@ class AutoscaledInstance:
                  containers: ContainerRepository,
                  decide_policy, sample_extra=None,
                  entrypoint: Optional[list[str]] = None,
-                 pool_selector: str = ""):
+                 pool_selector: str = "", checkpoint_lookup=None):
         self.stub = stub
         self.scheduler = scheduler
         self.containers = containers
         self.pool_selector = pool_selector
         self.entrypoint = entrypoint or []
         self.extra_env: dict[str, str] = {}   # abstraction-specific env
+        # async (stub_id) -> checkpoint_id | "" (scheduler/checkpoint.go:36)
+        self.checkpoint_lookup = checkpoint_lookup
         self._sample_extra = sample_extra   # async () -> (queue_depth, pressure)
         self.autoscaler = Autoscaler(self._sample, decide_policy, self._apply)
         self._last_active = time.monotonic()
-        self.failure_streak = 0
+        # start-failure circuit breaker: if we keep launching containers and
+        # none ever reaches RUNNING, pause before burning more capacity
+        self._recent_starts: list[float] = []
+        self._breaker_until = 0.0
 
     # -- sampling ------------------------------------------------------------
 
@@ -80,7 +97,22 @@ class AutoscaledInstance:
                 desired = min(current, max(1, cfg.autoscaler.min_containers))
 
         if desired > current:
+            now = time.monotonic()
+            self._recent_starts = [t for t in self._recent_starts
+                                   if now - t < 30.0]
+            any_running = any(s.status == ContainerStatus.RUNNING.value
+                              for s in running)
+            if (not any_running and len(self._recent_starts) >= 3
+                    and now >= self._breaker_until):
+                self._breaker_until = now + 15.0
+                log.warning(
+                    "stub %s: %d starts in 30s with none RUNNING — pausing "
+                    "starts 15s", self.stub.stub_id,
+                    len(self._recent_starts))
+            if now < self._breaker_until and not any_running:
+                return
             for _ in range(desired - current):
+                self._recent_starts.append(now)
                 await self.start_container()
         elif desired < current:
             # stop not-yet-started containers first, then the newest RUNNING
@@ -97,6 +129,9 @@ class AutoscaledInstance:
 
     async def start_container(self) -> str:
         cfg = self.stub.config
+        checkpoint_id = ""
+        if cfg.checkpoint.enabled and self.checkpoint_lookup is not None:
+            checkpoint_id = await self.checkpoint_lookup(self.stub.stub_id) or ""
         request = ContainerRequest(
             container_id=new_id("ct"),
             stub_id=self.stub.stub_id,
@@ -111,6 +146,7 @@ class AutoscaledInstance:
             env=self._runner_env(),
             mounts=volume_mounts(cfg),
             pool_selector=self.pool_selector,
+            checkpoint_id=checkpoint_id,
         )
         await self.scheduler.run(request)
         return request.container_id
@@ -128,6 +164,8 @@ class AutoscaledInstance:
         })
         if cfg.extra.get("runner"):
             env["TPU9_RUNNER"] = cfg.extra["runner"]
+        if cfg.checkpoint.enabled:
+            env["TPU9_CHECKPOINT_ENABLED"] = "1"
         return env
 
     async def start(self) -> "AutoscaledInstance":

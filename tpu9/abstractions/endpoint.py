@@ -27,10 +27,14 @@ log = logging.getLogger("tpu9.abstractions")
 
 class EndpointService:
     def __init__(self, backend: BackendDB, scheduler: Scheduler,
-                 containers: ContainerRepository):
+                 containers: ContainerRepository,
+                 runner_env: Optional[dict[str, str]] = None,
+                 runner_tokens=None):
         self.backend = backend
         self.scheduler = scheduler
         self.containers = containers
+        self.runner_env = runner_env if runner_env is not None else {}
+        self.runner_tokens = runner_tokens
         self.instances: dict[str, "EndpointInstance"] = {}
         self._locks: dict[str, asyncio.Lock] = {}
 
@@ -42,7 +46,18 @@ class EndpointService:
         async with lock:
             inst = self.instances.get(stub.stub_id)
             if inst is None:
-                inst = EndpointInstance(stub, self.scheduler, self.containers)
+                async def latest_ckpt(stub_id: str) -> str:
+                    row = await self.backend.latest_checkpoint(stub_id)
+                    return row["checkpoint_id"] if row else ""
+
+                inst = EndpointInstance(stub, self.scheduler, self.containers,
+                                        checkpoint_lookup=latest_ckpt)
+                # runner env + token so LLM runners can heartbeat pressure
+                # and reach the gateway like taskqueue/function runners do
+                inst.instance.extra_env = dict(self.runner_env)
+                if self.runner_tokens is not None:
+                    inst.instance.extra_env["TPU9_TOKEN"] = \
+                        await self.runner_tokens.get(stub.workspace_id)
                 await inst.start()
                 self.instances[stub.stub_id] = inst
         return inst
@@ -67,7 +82,7 @@ class EndpointInstance:
     """One deployment's serving state: buffer + autoscaled containers."""
 
     def __init__(self, stub: Stub, scheduler: Scheduler,
-                 containers: ContainerRepository):
+                 containers: ContainerRepository, checkpoint_lookup=None):
         self.stub = stub
         a = stub.config.autoscaler
         self.router = None
@@ -87,7 +102,8 @@ class EndpointInstance:
                                     router=self.router)
         self.instance = AutoscaledInstance(
             stub, scheduler, containers, policy,
-            sample_extra=self._sample_extra)
+            sample_extra=self._sample_extra,
+            checkpoint_lookup=checkpoint_lookup)
         self._containers = containers
 
     async def _sample_extra(self):

@@ -70,9 +70,10 @@ def cron_matches(expr: str, t: Optional[time.struct_time] = None) -> bool:
 class FunctionService:
     def __init__(self, backend: BackendDB, scheduler: Scheduler,
                  containers: ContainerRepository, dispatcher: Dispatcher,
-                 runner_env: Optional[dict[str, str]] = None):
+                 runner_env: Optional[dict[str, str]] = None,
+                 runner_tokens: Optional[RunnerTokenCache] = None):
         self.backend = backend
-        self.runner_tokens = RunnerTokenCache(backend)
+        self.runner_tokens = runner_tokens or RunnerTokenCache(backend)
         self.scheduler = scheduler
         self.containers = containers
         self.dispatcher = dispatcher

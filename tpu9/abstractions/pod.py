@@ -32,9 +32,10 @@ log = logging.getLogger("tpu9.abstractions")
 class PodService:
     def __init__(self, backend: BackendDB, scheduler: Scheduler,
                  containers: ContainerRepository, store: StateStore,
-                 runner_env: Optional[dict[str, str]] = None):
+                 runner_env: Optional[dict[str, str]] = None,
+                 runner_tokens: Optional[RunnerTokenCache] = None):
         self.backend = backend
-        self.runner_tokens = RunnerTokenCache(backend)
+        self.runner_tokens = runner_tokens or RunnerTokenCache(backend)
         self.scheduler = scheduler
         self.containers = containers
         self.store = store

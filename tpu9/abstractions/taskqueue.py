@@ -29,9 +29,10 @@ EXECUTOR = "taskqueue"
 class TaskQueueService:
     def __init__(self, backend: BackendDB, scheduler: Scheduler,
                  containers: ContainerRepository, dispatcher: Dispatcher,
-                 runner_env: Optional[dict[str, str]] = None):
+                 runner_env: Optional[dict[str, str]] = None,
+                 runner_tokens: Optional[RunnerTokenCache] = None):
         self.backend = backend
-        self.runner_tokens = RunnerTokenCache(backend)
+        self.runner_tokens = runner_tokens or RunnerTokenCache(backend)
         self.scheduler = scheduler
         self.containers = containers
         self.dispatcher = dispatcher

@@ -1,0 +1,406 @@
+"""tpu9 CLI.
+
+Reference analogue: the ``beta9`` click CLI (``sdk/src/beta9/cli/``, 21
+modules: deploy/serve/run/task/container/machine/pool/worker/volume/secret/
+token/config/shell/...). Same command surface, tpu9 semantics.
+
+Server commands (the reference ships separate gateway/worker binaries;
+tpu9's single wheel serves both):
+
+    tpu9 gateway --config cluster.yaml
+    tpu9 worker  --gateway-state 10.0.0.1:14950 --tpu v5e-8
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+import click
+
+from ..config import load_config
+from ..sdk.client import Context, GatewayClient
+
+
+def _client() -> GatewayClient:
+    return GatewayClient()
+
+
+@click.group()
+def cli() -> None:
+    """tpu9 — TPU-native serverless AI runtime."""
+
+
+# ---------------------------------------------------------------------------
+# config / auth
+# ---------------------------------------------------------------------------
+
+@cli.group()
+def config() -> None:
+    """Manage gateway contexts."""
+
+
+@config.command("set")
+@click.option("--name", default="default")
+@click.option("--gateway-url", required=True)
+@click.option("--token", required=True)
+def config_set(name: str, gateway_url: str, token: str) -> None:
+    ctx = Context(gateway_url=gateway_url, token=token, name=name)
+    ctx.save()
+    click.echo(f"context {name!r} saved")
+
+
+@config.command("show")
+def config_show() -> None:
+    ctx = Context.load()
+    click.echo(json.dumps({"name": ctx.name, "gateway_url": ctx.gateway_url,
+                           "token": ctx.token[:8] + "..."}, indent=2))
+
+
+@cli.command()
+def whoami() -> None:
+    """Check auth against the gateway."""
+    click.echo(json.dumps(_client().auth_check(), indent=2))
+
+
+# ---------------------------------------------------------------------------
+# deploy / invoke
+# ---------------------------------------------------------------------------
+
+@cli.command()
+@click.argument("target")          # module.py:object
+@click.option("--name", default="")
+def deploy(target: str, name: str) -> None:
+    """Deploy a decorated object: ``tpu9 deploy app.py:handler``."""
+    obj = _load_target(target)
+    out = obj.deploy(name or obj.name or target.split(":")[-1])
+    click.echo(json.dumps(out, indent=2))
+
+
+@cli.command()
+@click.argument("name")
+@click.argument("payload", default="{}")
+def invoke(name: str, payload: str) -> None:
+    """Invoke a deployment: ``tpu9 invoke my-endpoint '{"x": 1}'``."""
+    click.echo(json.dumps(_client().invoke(name, json.loads(payload)),
+                          indent=2))
+
+
+def _load_target(target: str):
+    path, _, attr = target.partition(":")
+    if not attr:
+        raise click.UsageError("target must be path.py:object")
+    import importlib.util
+    # module name must match what the runner will import from the synced
+    # workspace (handler_spec is derived from it)
+    mod_name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(mod_name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[mod_name] = module
+    spec.loader.exec_module(module)
+    return getattr(module, attr)
+
+
+# ---------------------------------------------------------------------------
+# resources
+# ---------------------------------------------------------------------------
+
+@cli.group()
+def task() -> None:
+    """Inspect and manage tasks."""
+
+
+@task.command("list")
+def task_list() -> None:
+    out = _client()._run(lambda c: c.request("GET", "/api/v1/task"))
+    click.echo(json.dumps(out, indent=2))
+
+
+@task.command("status")
+@click.argument("task_id")
+def task_status(task_id: str) -> None:
+    click.echo(json.dumps(_client().task_status(task_id), indent=2))
+
+
+@task.command("result")
+@click.argument("task_id")
+@click.option("--timeout", default=0.0)
+def task_result(task_id: str, timeout: float) -> None:
+    click.echo(json.dumps(_client().task_result(task_id, timeout), indent=2))
+
+
+@task.command("cancel")
+@click.argument("task_id")
+def task_cancel(task_id: str) -> None:
+    click.echo(json.dumps({"ok": _client().task_cancel(task_id)}))
+
+
+@cli.group()
+def container() -> None:
+    """Inspect and manage containers."""
+
+
+@container.command("list")
+def container_list() -> None:
+    out = _client()._run(lambda c: c.request("GET", "/api/v1/container"))
+    click.echo(json.dumps(out, indent=2))
+
+
+@container.command("stop")
+@click.argument("container_id")
+def container_stop(container_id: str) -> None:
+    out = _client()._run(lambda c: c.request(
+        "POST", f"/api/v1/container/{container_id}/stop", json_body={}))
+    click.echo(json.dumps(out))
+
+
+@container.command("logs")
+@click.argument("container_id")
+def container_logs(container_id: str) -> None:
+    out = _client()._run(lambda c: c.request(
+        "GET", f"/api/v1/container/{container_id}/logs"))
+    for entry in out:
+        click.echo(f"[{entry.get('stream','')}] {entry.get('line','')}")
+
+
+@cli.command("workers")
+def workers_list() -> None:
+    out = _client()._run(lambda c: c.request("GET", "/api/v1/worker"))
+    click.echo(json.dumps(out, indent=2))
+
+
+@cli.command("pools")
+def pools_status() -> None:
+    out = _client()._run(lambda c: c.request("GET", "/api/v1/pools"))
+    click.echo(json.dumps(out, indent=2))
+
+
+@cli.command("deployments")
+def deployments_list() -> None:
+    out = _client()._run(lambda c: c.request("GET", "/api/v1/deployment"))
+    click.echo(json.dumps(out, indent=2))
+
+
+@cli.group()
+def secret() -> None:
+    """Workspace secrets."""
+
+
+@secret.command("set")
+@click.argument("name")
+@click.argument("value")
+def secret_set(name: str, value: str) -> None:
+    _client()._run(lambda c: c.request("POST", "/api/v1/secret",
+                                       json_body={"name": name,
+                                                  "value": value}))
+    click.echo("ok")
+
+
+@secret.command("list")
+def secret_list() -> None:
+    click.echo(json.dumps(
+        _client()._run(lambda c: c.request("GET", "/api/v1/secret"))))
+
+
+@secret.command("delete")
+@click.argument("name")
+def secret_delete(name: str) -> None:
+    _client()._run(lambda c: c.request("DELETE", f"/api/v1/secret/{name}"))
+    click.echo("ok")
+
+
+@cli.group()
+def volume() -> None:
+    """Workspace volumes."""
+
+
+@volume.command("list")
+def volume_list() -> None:
+    click.echo(json.dumps(
+        _client()._run(lambda c: c.request("GET", "/api/v1/volume")),
+        indent=2))
+
+
+@volume.command("ls")
+@click.argument("name")
+def volume_ls(name: str) -> None:
+    from ..sdk.primitives import Volume
+    click.echo(json.dumps(Volume(name).ls(), indent=2))
+
+
+@volume.command("upload")
+@click.argument("name")
+@click.argument("local_path")
+@click.option("--remote", default="")
+def volume_upload(name: str, local_path: str, remote: str) -> None:
+    from ..sdk.primitives import Volume
+    n = Volume(name).upload(local_path, remote)
+    click.echo(f"uploaded {n} bytes")
+
+
+@volume.command("download")
+@click.argument("name")
+@click.argument("remote_path")
+@click.argument("local_path")
+def volume_download(name: str, remote_path: str, local_path: str) -> None:
+    from ..sdk.primitives import Volume
+    data = Volume(name).download(remote_path)
+    with open(local_path, "wb") as f:
+        f.write(data)
+    click.echo(f"wrote {len(data)} bytes to {local_path}")
+
+
+@cli.group()
+def image() -> None:
+    """Container images."""
+
+
+@image.command("build")
+@click.option("--packages", "-p", multiple=True)
+@click.option("--command", "-c", "commands", multiple=True)
+def image_build(packages, commands) -> None:
+    from ..sdk.image import Image
+    img = Image().add_python_packages(list(packages)).add_commands(
+        list(commands))
+    image_id = img.ensure_built(_client())
+    click.echo(image_id)
+
+
+@cli.command("metrics")
+@click.option("--prometheus", is_flag=True)
+def metrics_cmd(prometheus: bool) -> None:
+    path = "/api/v1/metrics" + ("?format=prometheus" if prometheus else "")
+    if prometheus:
+        click.echo(_client()._run(lambda c: c.request_bytes(
+            "GET", path)).decode())
+    else:
+        click.echo(json.dumps(
+            _client()._run(lambda c: c.request("GET", path)), indent=2))
+
+
+# ---------------------------------------------------------------------------
+# servers
+# ---------------------------------------------------------------------------
+
+@cli.command()
+@click.option("--config", "config_path", default="")
+def gateway(config_path: str) -> None:
+    """Run the control plane (gateway + scheduler + state server)."""
+    from ..gateway import Gateway
+    from ..scheduler import LocalProcessPool
+
+    cfg = load_config(config_path or None)
+
+    async def main() -> None:
+        gw = Gateway(cfg)
+        await gw.start()
+        click.echo(f"gateway:      http://{cfg.gateway.host}:{gw.port}")
+        click.echo(f"token:        {gw.default_token}")
+        click.echo(f"worker-token: {gw.worker_token}")
+        if gw.state_server:
+            click.echo(f"state:        {gw.state_server.address}")
+        await asyncio.Event().wait()
+
+    asyncio.run(main())
+
+
+@cli.command()
+@click.option("--gateway-state", required=True,
+              help="state-server address host:port")
+@click.option("--gateway-url", default="",
+              help="gateway HTTP URL (for object/image fetches)")
+@click.option("--token", "worker_token", default="",
+              help="worker token (printed at gateway boot)")
+@click.option("--pool", default="default")
+@click.option("--tpu", "tpu_gen", default="",
+              help="TPU generation on this host (v5e, v5p, ...)")
+@click.option("--runtime", "runtime_kind", default="process",
+              type=click.Choice(["process", "runc"]))
+@click.option("--slice-id", default="")
+@click.option("--slice-rank", default=0)
+@click.option("--slice-hosts", default=1)
+@click.option("--config", "config_path", default="")
+def worker(gateway_state: str, gateway_url: str, worker_token: str,
+           pool: str, tpu_gen: str, runtime_kind: str,
+           slice_id: str, slice_rank: int, slice_hosts: int,
+           config_path: str) -> None:
+    """Run a worker host agent joined to a gateway."""
+    import tempfile
+
+    import aiohttp
+
+    from ..images import ImageManifest
+    from ..repository import WorkerRepository
+    from ..runtime import new_runtime
+    from ..statestore import RemoteStore
+    from ..worker import Worker
+    from ..worker.cache_manager import WorkerCache
+
+    cfg = load_config(config_path or None)
+
+    async def main() -> None:
+        store = await RemoteStore(
+            gateway_state,
+            auth_token=cfg.database.state_auth_token).connect()
+        runtime = new_runtime(runtime_kind,
+                              base_dir=cfg.worker.containers_dir)
+
+        object_resolver = None
+        chunk_source = None
+        manifest_fetch = None
+        if gateway_url and worker_token:
+            session = aiohttp.ClientSession(
+                headers={"Authorization": f"Bearer {worker_token}"})
+            objects_dir = tempfile.mkdtemp(prefix="tpu9-objects-")
+
+            async def object_resolver(object_id: str) -> str:
+                path = os.path.join(objects_dir, f"{object_id}.zip")
+                if os.path.exists(path):
+                    return path
+                async with session.get(
+                        f"{gateway_url}/rpc/object/{object_id}") as resp:
+                    if resp.status != 200:
+                        return ""
+                    with open(path, "wb") as f:
+                        f.write(await resp.read())
+                return path
+
+            async def chunk_source(digest: str):
+                async with session.get(
+                        f"{gateway_url}/rpc/image/chunk/{digest}") as resp:
+                    return await resp.read() if resp.status == 200 else None
+
+            async def manifest_fetch(image_id: str):
+                async with session.get(
+                        f"{gateway_url}/rpc/image/manifest/{image_id}") as resp:
+                    if resp.status != 200:
+                        return None
+                    return ImageManifest.from_json(await resp.text())
+
+        from ..types import new_id
+        cache = WorkerCache(cfg.cache, new_id("wc"), WorkerRepository(store),
+                            source=chunk_source,
+                            manifest_fetch=manifest_fetch)
+        w = Worker(store, runtime, cfg=cfg.worker, pool=pool,
+                   tpu_generation=tpu_gen, slice_id=slice_id,
+                   slice_host_rank=slice_rank, slice_host_count=slice_hosts,
+                   cache=cache, object_resolver=object_resolver)
+        await w.start()
+        click.echo(f"worker {w.worker_id} joined (pool={pool}, "
+                   f"chips={w.tpu.chip_count})")
+        try:
+            while True:
+                await asyncio.sleep(5)
+                if w.should_shut_down():
+                    click.echo("idle; shutting down")
+                    break
+        finally:
+            await w.stop()
+
+    asyncio.run(main())
+
+
+if __name__ == "__main__":
+    cli()

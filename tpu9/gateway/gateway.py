@@ -29,6 +29,8 @@ from ..abstractions.endpoint import EndpointService
 from ..abstractions.function import FunctionService
 from ..abstractions.image import ImageService
 from ..abstractions.pod import PodService
+from ..observability import EventBus, metrics
+from ..scheduler.pool_health import PoolMonitor
 from ..abstractions.primitives import (MapService, OutputService,
                                        PrimitiveError, QueueService,
                                        SignalService, VolumeFiles)
@@ -57,10 +59,14 @@ class Gateway:
         self.workers = WorkerRepository(self.store, cfg.worker.keepalive_ttl_s)
         self.containers = ContainerRepository(self.store)
         self.tasks = TaskRepository(self.store)
-        self.endpoints = EndpointService(self.backend, self.scheduler,
-                                         self.containers)
+        from ..abstractions.common.tokens import RunnerTokenCache
+        self.runner_tokens = RunnerTokenCache(self.backend)
         # containers read this to reach us; filled once the port is bound
         self.runner_env: dict[str, str] = {}
+        self.endpoints = EndpointService(self.backend, self.scheduler,
+                                         self.containers,
+                                         runner_env=self.runner_env,
+                                         runner_tokens=self.runner_tokens)
         self.dispatcher = Dispatcher(self.store, self.backend)
 
         async def _container_alive(container_id: str) -> bool:
@@ -69,23 +75,33 @@ class Gateway:
         self.dispatcher.container_alive = _container_alive
         self.taskqueues = TaskQueueService(self.backend, self.scheduler,
                                            self.containers, self.dispatcher,
-                                           runner_env=self.runner_env)
+                                           runner_env=self.runner_env,
+                                           runner_tokens=self.runner_tokens)
         self.functions = FunctionService(self.backend, self.scheduler,
                                          self.containers, self.dispatcher,
-                                         runner_env=self.runner_env)
+                                         runner_env=self.runner_env,
+                                         runner_tokens=self.runner_tokens)
         self.images = ImageService(
             self.backend,
             ImageBuilder(cfg.image.registry_dir,
                          network_ok=not os.environ.get("TPU9_NO_EGRESS")))
         self.pods = PodService(self.backend, self.scheduler, self.containers,
-                               self.store, runner_env=self.runner_env)
+                               self.store, runner_env=self.runner_env,
+                               runner_tokens=self.runner_tokens)
         self.maps = MapService(self.store)
         self.queues = QueueService(self.store)
         self.signals = SignalService(self.store)
         self.outputs = OutputService(self.backend, cfg.storage.local_root)
         self.volume_files = VolumeFiles(self.backend, cfg.storage.local_root)
+        self.events = EventBus(self.store, sink_url=cfg.monitoring.events_http_url
+                               if cfg.monitoring.events_sink == "http" else "",
+                               cluster=cfg.cluster_name)
+        self.pool_monitor = PoolMonitor(
+            self.store, pools or {},
+            {p.name: p for p in cfg.pools}) if pools is not None else None
         self.extra_services: dict[str, object] = {}
         self.state_server: Optional[StateServer] = None
+        self._proxy_session = None     # shared pod-proxy ClientSession
         self._runner: Optional[web.AppRunner] = None
         self.port = cfg.gateway.http_port
         self.app = self._build_app()
@@ -101,6 +117,7 @@ class Gateway:
         r.add_post("/rpc/auth/check", self._rpc_auth_check)
         r.add_post("/rpc/stub/get-or-create", self._rpc_get_or_create_stub)
         r.add_post("/rpc/object/put", self._rpc_put_object)
+        r.add_get("/rpc/object/{object_id}", self._rpc_get_object)
         r.add_post("/rpc/deploy", self._rpc_deploy)
         r.add_post("/rpc/serve", self._rpc_serve)
         # tasks / queues / functions
@@ -152,6 +169,9 @@ class Gateway:
         r.add_post("/api/v1/secret", self._upsert_secret)
         r.add_delete("/api/v1/secret/{name}", self._delete_secret)
         r.add_get("/api/v1/scheduler/stats", self._scheduler_stats)
+        r.add_get("/api/v1/metrics", self._metrics)
+        r.add_get("/api/v1/events", self._events)
+        r.add_get("/api/v1/pools", self._pools)
         # invoke
         r.add_route("*", "/endpoint/{name}", self._invoke)
         r.add_route("*", "/endpoint/{name}/{tail:.*}", self._invoke)
@@ -172,6 +192,8 @@ class Gateway:
         await self.scheduler.start()
         await self.dispatcher.start()
         await self.functions.start()
+        if self.pool_monitor is not None:
+            await self.pool_monitor.start()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.cfg.gateway.host, self.port)
@@ -187,11 +209,15 @@ class Gateway:
         return self
 
     async def stop(self) -> None:
+        if self.pool_monitor is not None:
+            await self.pool_monitor.stop()
         await self.endpoints.shutdown()
         await self.taskqueues.shutdown()
         await self.functions.stop()
         await self.dispatcher.stop()
         await self.scheduler.stop()
+        if self._proxy_session is not None and not self._proxy_session.closed:
+            await self._proxy_session.close()
         if self._runner:
             await self._runner.cleanup()
         if self.state_server:
@@ -199,8 +225,8 @@ class Gateway:
         await self.backend.close()
 
     async def _ensure_default_workspace(self) -> None:
-        """Dev bootstrap: a default workspace + token, printed once
-        (the reference seeds via migrations/CLI config flow)."""
+        """Dev bootstrap: a default workspace + user/worker tokens, printed
+        once (the reference seeds via migrations/CLI config flow)."""
         ws = await self.backend.get_workspace_by_name("default")
         if ws is None:
             ws = await self.backend.create_workspace("default")
@@ -209,7 +235,16 @@ class Gateway:
             log.info("created default workspace; token=%s", tok.key)
         else:
             toks = await self.backend.list_tokens(ws.workspace_id)
-            self.default_token = toks[0].key if toks else ""
+            user = [t for t in toks if t.token_type == "workspace"]
+            self.default_token = user[0].key if user else ""
+        worker_toks = [t for t in await self.backend.list_tokens(ws.workspace_id)
+                       if t.token_type == "worker"]
+        if worker_toks:
+            self.worker_token = worker_toks[0].key
+        else:
+            wt = await self.backend.create_token(ws.workspace_id,
+                                                 token_type="worker")
+            self.worker_token = wt.key
         self.default_workspace = ws
 
     async def _rehydrate_deployments(self) -> None:
@@ -244,6 +279,9 @@ class Gateway:
                 return await handler(request)
             return web.json_response({"error": "unauthorized"}, status=401)
         request["workspace"] = await self.backend.get_workspace(tok.workspace_id)
+        # worker tokens may read cross-workspace artifacts (objects, chunks)
+        # the way the reference serves repos to workers over gRPC
+        request["is_worker"] = tok.token_type == "worker"
         return await handler(request)
 
     def _ws(self, request: web.Request) -> Workspace:
@@ -266,6 +304,35 @@ class Gateway:
     async def _scheduler_stats(self, request: web.Request) -> web.Response:
         self._ws(request)
         return web.json_response(self.scheduler.stats)
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        if request.query.get("format") == "prometheus":
+            return web.Response(text=metrics.prometheus_text(),
+                                content_type="text/plain")
+        out = metrics.to_dict()
+        # merge worker-shipped registries (fleet view)
+        out["workers"] = {}
+        for key in await self.store.keys("worker:metrics:*"):
+            raw = await self.store.get(key)
+            if raw:
+                out["workers"][key.rsplit(":", 1)[-1]] = json.loads(raw)
+        return web.json_response(out)
+
+    async def _events(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        rows = await self.events.query(
+            kind_prefix=request.query.get("kind", ""),
+            since=float(request.query.get("since", "0")),
+            limit=int(request.query.get("limit", "500")))
+        return web.json_response(rows)
+
+    async def _pools(self, request: web.Request) -> web.Response:
+        self._ws(request)
+        if self.pool_monitor is None:
+            return web.json_response({})
+        return web.json_response({
+            name: vars(st) for name, st in self.pool_monitor.status.items()})
 
     # -- handlers: SDK RPC ----------------------------------------------------
 
@@ -308,6 +375,17 @@ class Gateway:
         object_id = await self.backend.create_object(ws.workspace_id, obj_hash,
                                                      len(body), path)
         return web.json_response({"object_id": object_id, "deduped": False})
+
+    async def _rpc_get_object(self, request: web.Request) -> web.Response:
+        """Workers (cross-workspace, worker token) and owners download synced
+        code archives here (reference: repo-over-gRPC object access)."""
+        ws = self._ws(request)
+        obj = await self.backend.get_object(request.match_info["object_id"])
+        if obj is None or (not request.get("is_worker")
+                           and obj["workspace_id"] != ws.workspace_id):
+            return web.json_response({"error": "object not found"},
+                                     status=404)
+        return web.FileResponse(obj["path"])
 
     async def _rpc_deploy(self, request: web.Request) -> web.Response:
         ws = self._ws(request)
@@ -527,17 +605,18 @@ class Gateway:
                                             "content-length",
                                             "authorization")}
         body = await request.read()
+        if self._proxy_session is None or self._proxy_session.closed:
+            self._proxy_session = _aiohttp.ClientSession()
         try:
-            async with _aiohttp.ClientSession() as session:
-                async with session.request(
-                        request.method, url, data=body or None,
-                        headers=fwd_headers,
-                        timeout=_aiohttp.ClientTimeout(total=110)) as resp:
-                    out = await resp.read()
-                    proxied = web.Response(status=resp.status, body=out)
-                    proxied.headers["Content-Type"] = resp.headers.get(
-                        "Content-Type", "application/octet-stream")
-                    return proxied
+            async with self._proxy_session.request(
+                    request.method, url, data=body or None,
+                    headers=fwd_headers,
+                    timeout=_aiohttp.ClientTimeout(total=110)) as resp:
+                out = await resp.read()
+                proxied = web.Response(status=resp.status, body=out)
+                proxied.headers["Content-Type"] = resp.headers.get(
+                    "Content-Type", "application/octet-stream")
+                return proxied
         except (_aiohttp.ClientError, asyncio.TimeoutError) as exc:
             return web.json_response({"error": type(exc).__name__},
                                      status=502)

@@ -1,0 +1,4 @@
+from .metrics import Metrics, metrics
+from .events import EventBus
+
+__all__ = ["Metrics", "metrics", "EventBus"]

@@ -1,0 +1,69 @@
+"""Cluster event bus.
+
+Reference analogue: the CloudEvents pipeline (``pkg/repository/events_s2.go``
+→ S2 stream store / HTTP sink, worker relay ``events_worker.go``) and the
+queryable events REST API (``pkg/api/v1/events.go``). tpu9 events land on a
+state-store stream (bounded) and optionally fan out to an HTTP sink; the
+gateway serves them at ``/api/v1/events``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+from ..statestore import StateStore
+
+STREAM_KEY = "events:log"
+MAX_EVENTS = 50_000
+
+
+class EventBus:
+    def __init__(self, store: StateStore, sink_url: str = "",
+                 cluster: str = "tpu9"):
+        self.store = store
+        self.sink_url = sink_url
+        self.cluster = cluster
+
+    async def emit(self, kind: str, data: Optional[dict[str, Any]] = None,
+                   workspace_id: str = "") -> None:
+        event = {
+            "specversion": "1.0",            # CloudEvents-shaped
+            "type": f"tpu9.{kind}",
+            "source": self.cluster,
+            "time": time.time(),
+            "workspace_id": workspace_id,
+            "data": json.dumps(data or {}),
+        }
+        await self.store.xadd(STREAM_KEY, event, maxlen=MAX_EVENTS)
+        await self.store.publish(f"events:{kind}", data or {})
+        if self.sink_url:
+            await self._post_sink(event)
+
+    async def _post_sink(self, event: dict) -> None:
+        try:
+            import aiohttp
+            async with aiohttp.ClientSession() as session:
+                await session.post(self.sink_url, json=event,
+                                   timeout=aiohttp.ClientTimeout(total=5))
+        except Exception:
+            pass  # sinks are best-effort (reference HTTP sink behaves the same)
+
+    async def query(self, kind_prefix: str = "", since: float = 0.0,
+                    limit: int = 500) -> list[dict]:
+        entries = await self.store.xread(STREAM_KEY, last_id="0")
+        out = []
+        for _eid, e in entries:
+            if kind_prefix and not e.get("type", "").startswith(
+                    f"tpu9.{kind_prefix}"):
+                continue
+            if since and float(e.get("time", 0)) < since:
+                continue
+            row = dict(e)
+            try:
+                row["data"] = json.loads(row.get("data", "{}"))
+            except json.JSONDecodeError:
+                pass
+            out.append(row)
+        return out[-limit:]

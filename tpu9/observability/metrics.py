@@ -1,0 +1,124 @@
+"""Metrics registry: counters, gauges, and streaming percentile summaries.
+
+Reference analogue: ``pkg/metrics/metrics.go`` (VictoriaMetrics push gauges
+for scheduler/worker/cache internals) + the per-phase cold-start latencies
+(``RecordWorkerStartupPhase``) consumed by ``sandbox_startup_report.py``.
+tpu9 keeps an in-process registry, exports Prometheus text + JSON via the
+gateway, and can push to any remote write URL (gated; zero-egress safe).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+
+class _Summary:
+    """Bounded reservoir giving p50/p95/max (enough for phase reports)."""
+
+    def __init__(self, cap: int = 2048):
+        self.cap = cap
+        self.values: list[float] = []
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        if len(self.values) >= self.cap:
+            # reservoir: replace a pseudo-random slot (deterministic walk)
+            self.values[self.count % self.cap] = v
+            self.values.sort()
+        else:
+            bisect.insort(self.values, v)
+
+    def quantile(self, q: float) -> float:
+        if not self.values:
+            return 0.0
+        idx = min(int(q * len(self.values)), len(self.values) - 1)
+        return self.values[idx]
+
+    def snapshot(self) -> dict:
+        return {"count": self.count,
+                "mean": self.total / self.count if self.count else 0.0,
+                "p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "max": self.values[-1] if self.values else 0.0}
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = defaultdict(float)
+        self.gauges: dict[str, float] = {}
+        self.summaries: dict[str, _Summary] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[dict] = None) -> str:
+        if not labels:
+            return name
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{inner}}}"
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self.counters[self._key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self.gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value: float,
+                labels: Optional[dict] = None) -> None:
+        with self._lock:
+            key = self._key(name, labels)
+            if key not in self.summaries:
+                self.summaries[key] = _Summary()
+            self.summaries[key].observe(value)
+
+    def timer(self, name: str, labels: Optional[dict] = None):
+        start = time.perf_counter()
+
+        class _Timer:
+            def __enter__(timer_self):
+                return timer_self
+
+            def __exit__(timer_self, *exc):
+                self.observe(name, time.perf_counter() - start, labels)
+
+        return _Timer()
+
+    # -- export ---------------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "summaries": {k: s.snapshot()
+                              for k, s in self.summaries.items()},
+            }
+
+    def prometheus_text(self) -> str:
+        lines = []
+        with self._lock:
+            for key, v in sorted(self.counters.items()):
+                lines.append(f"{key} {v}")
+            for key, v in sorted(self.gauges.items()):
+                lines.append(f"{key} {v}")
+            for key, s in sorted(self.summaries.items()):
+                base, _, labels = key.partition("{")
+                labels = ("{" + labels) if labels else ""
+                snap = s.snapshot()
+                for stat in ("p50", "p95", "max", "mean"):
+                    lines.append(f"{base}_{stat}{labels} {snap[stat]}")
+                lines.append(f"{base}_count{labels} {snap['count']}")
+        return "\n".join(lines) + "\n"
+
+
+# process-global registry (modules record without plumbing)
+metrics = Metrics()

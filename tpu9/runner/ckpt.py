@@ -1,0 +1,79 @@
+"""Runner-side checkpoint hooks: save/restore model state inside the
+container workdir so the worker's filesystem snapshot carries it.
+
+Reference analogue: the SDK runner's ``wait_for_checkpoint`` cooperation
+(``sdk/src/beta9/runner/common.py``) — here inverted for TPUs: instead of
+CRIU freezing the process, the runner persists the expensive-to-rebuild state
+(model params via orbax, plus anything the handler adds) and marks readiness;
+a restored container finds the state and skips re-initialization.
+
+Handler usage:
+
+    from tpu9.runner import ckpt
+
+    def load_model():
+        params = ckpt.maybe_restore(lambda: init_decoder(rng, cfg))
+        ...
+        ckpt.mark_ready()          # worker snapshots after this
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Callable
+
+log = logging.getLogger("tpu9.runner")
+
+CKPT_DIR_NAME = ".tpu9-ckpt"
+
+
+def ckpt_dir() -> str:
+    base = os.environ.get("TPU9_WORKDIR", os.getcwd())
+    d = os.path.join(base, CKPT_DIR_NAME)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def is_restored() -> bool:
+    return os.path.exists(os.path.join(ckpt_dir(), "READY"))
+
+
+def mark_ready(meta: dict | None = None) -> None:
+    with open(os.path.join(ckpt_dir(), "meta.json"), "w") as f:
+        json.dump(meta or {}, f)
+    with open(os.path.join(ckpt_dir(), "READY"), "w") as f:
+        f.write("1")
+
+
+def save_params(params: Any, name: str = "params") -> str:
+    """Persist a jax pytree with orbax (async-barrier'd, overwrite-safe)."""
+    import orbax.checkpoint as ocp
+    path = os.path.join(ckpt_dir(), name)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, params, force=True)
+    return path
+
+
+def load_params(name: str = "params", template: Any = None) -> Any:
+    import orbax.checkpoint as ocp
+    path = os.path.join(ckpt_dir(), name)
+    ckptr = ocp.PyTreeCheckpointer()
+    if template is not None:
+        return ckptr.restore(path, item=template)
+    return ckptr.restore(path)
+
+
+def maybe_restore(init_fn: Callable[[], Any], name: str = "params") -> Any:
+    """Restore saved params when running from a checkpoint; otherwise init
+    and save them so the next cold start restores."""
+    path = os.path.join(ckpt_dir(), name)
+    if is_restored() and os.path.exists(path):
+        log.info("restoring %s from checkpoint", name)
+        return load_params(name)
+    params = init_fn()
+    if os.environ.get("TPU9_CHECKPOINT_ENABLED") == "1":
+        log.info("saving %s for future restores", name)
+        save_params(params, name)
+    return params

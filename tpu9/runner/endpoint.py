@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import sys
 
 from aiohttp import web
@@ -36,6 +37,11 @@ def build_app(cfg: RunnerConfig) -> web.Application:
             handler.load()
         await asyncio.to_thread(load)
         state["ready"] = True
+        if os.environ.get("TPU9_CHECKPOINT_ENABLED") == "1":
+            # handler state is loaded (and saved via ckpt.maybe_restore if
+            # the handler opted in) — let the worker snapshot now
+            from . import ckpt
+            ckpt.mark_ready({"handler": cfg.handler})
         log.info("handler %s ready", cfg.handler)
 
     async def health(request: web.Request) -> web.Response:

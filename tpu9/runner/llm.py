@@ -104,24 +104,36 @@ async def amain() -> None:
     await engine.start()
     state["engine"] = engine
     state["ready"] = True
+    if os.environ.get("TPU9_CHECKPOINT_ENABLED") == "1":
+        from . import ckpt
+        ckpt.mark_ready({"handler": cfg.handler})
     log.info("llm engine ready")
 
     async def pressure_loop() -> None:
         if not gateway_url:
             return
+        rejected_logged = False
         async with aiohttp.ClientSession(
                 headers={"Authorization": f"Bearer {token}"}) as session:
             while True:
                 try:
                     stats = engine.stats()
-                    await session.post(
-                        gateway_url + "/rpc/llm/pressure",
-                        json={"container_id": cfg.container_id,
-                              "token_pressure": stats["token_pressure"],
-                              "active_streams": stats["active_streams"]},
-                        timeout=aiohttp.ClientTimeout(total=5))
-                except (aiohttp.ClientError, asyncio.TimeoutError):
-                    pass
+                    async with session.post(
+                            gateway_url + "/rpc/llm/pressure",
+                            json={"container_id": cfg.container_id,
+                                  "token_pressure": stats["token_pressure"],
+                                  "active_streams": stats["active_streams"]},
+                            timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                        if resp.status >= 400 and not rejected_logged:
+                            rejected_logged = True
+                            log.warning(
+                                "pressure heartbeat rejected (%d): %s — "
+                                "router/autoscaler will see no engine load",
+                                resp.status, (await resp.text())[:200])
+                        elif resp.status < 400:
+                            rejected_logged = False
+                except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+                    log.debug("pressure heartbeat failed: %s", exc)
                 await asyncio.sleep(2.0)
 
     await pressure_loop() if gateway_url else await asyncio.Event().wait()

@@ -1,0 +1,169 @@
+"""Pool sizing, health monitoring, and stale-worker cleanup.
+
+Reference analogue: ``pkg/scheduler/pool_sizing.go`` (keep min free
+CPU/GPU/mem warm), ``pool_health.go:41-305`` (pool status from worker/
+container state), ``pool_cleaner.go:28-207`` (prune stale workers). The TPU
+twist: sizing counts free chips per slice shape, and pruning a dead gang
+member marks its whole gang lost (shared fate) so peers are reaped too.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..config import WorkerPoolConfig
+from ..observability import metrics
+from ..repository import ContainerRepository, WorkerRepository
+from ..statestore import StateStore
+from ..types import ContainerRequest, StopReason, WorkerStatus
+
+log = logging.getLogger("tpu9.scheduler")
+
+
+@dataclass
+class PoolStatus:
+    name: str
+    healthy: bool
+    workers: int
+    alive: int
+    free_cpu_millicores: int = 0
+    free_memory_mb: int = 0
+    free_chips: int = 0
+    reason: str = ""
+
+
+class PoolMonitor:
+    """One loop per cluster: sizes warm pools, degrades unhealthy ones, and
+    reaps workers whose keepalive lapsed."""
+
+    def __init__(self, store: StateStore,
+                 pools: dict[str, "WorkerPoolController"],
+                 pool_cfgs: dict[str, WorkerPoolConfig],
+                 interval_s: float = 5.0):
+        self.workers = WorkerRepository(store)
+        self.containers = ContainerRepository(store)
+        self.store = store
+        self.pools = pools
+        self.pool_cfgs = pool_cfgs
+        self.interval_s = interval_s
+        self.status: dict[str, PoolStatus] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "PoolMonitor":
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("pool monitor tick failed")
+            await asyncio.sleep(self.interval_s)
+
+    async def tick(self) -> None:
+        all_workers = await self.workers.list()
+        by_pool: dict[str, list] = {}
+        for w in all_workers:
+            by_pool.setdefault(w.pool, []).append(w)
+
+        for name, cfg in self.pool_cfgs.items():
+            members = by_pool.get(name, [])
+            alive = []
+            for w in members:
+                if await self.workers.is_alive(w.worker_id):
+                    alive.append(w)
+                else:
+                    await self._reap(w)
+            status = PoolStatus(
+                name=name, workers=len(members), alive=len(alive),
+                free_cpu_millicores=sum(w.free_cpu_millicores for w in alive),
+                free_memory_mb=sum(w.free_memory_mb for w in alive),
+                free_chips=sum(w.tpu_free_chips for w in alive),
+                healthy=True)
+            if members and not alive:
+                status.healthy = False
+                status.reason = "no live workers"
+            self.status[name] = status
+            metrics.set_gauge("tpu9_pool_workers", len(alive),
+                              {"pool": name})
+            metrics.set_gauge("tpu9_pool_free_chips", status.free_chips,
+                              {"pool": name})
+            await self._maybe_warm(name, cfg, status)
+
+    async def _maybe_warm(self, name: str, cfg: WorkerPoolConfig,
+                          status: PoolStatus) -> None:
+        """Keep-warm sizing (pool_sizing.go:45 semantics)."""
+        need = ((cfg.min_free_cpu_millicores and
+                 status.free_cpu_millicores < cfg.min_free_cpu_millicores)
+                or (cfg.min_free_memory_mb and
+                    status.free_memory_mb < cfg.min_free_memory_mb)
+                or (cfg.min_free_tpu_chips and
+                    status.free_chips < cfg.min_free_tpu_chips))
+        if not need:
+            return
+        pool = self.pools.get(name)
+        if pool is None:
+            return
+        request = ContainerRequest(tpu=cfg.tpu_type,
+                                   cpu_millicores=cfg.min_free_cpu_millicores,
+                                   memory_mb=cfg.min_free_memory_mb,
+                                   pool_selector=name)
+        if await pool.can_host(request):
+            log.info("pool %s below warm threshold; adding worker", name)
+            metrics.inc("tpu9_pool_warmups", labels={"pool": name})
+            await pool.add_worker(request)
+
+    async def _reap(self, worker) -> None:
+        """Keepalive lapsed: fail its containers (gang peers share the fate)
+        and drop the registration (pool_cleaner.go semantics)."""
+        log.warning("reaping dead worker %s (pool %s)", worker.worker_id,
+                    worker.pool)
+        metrics.inc("tpu9_workers_reaped", labels={"pool": worker.pool})
+        container_ids = await self.workers.worker_container_ids(
+            worker.worker_id)
+        gang_ids = set()
+        for container_id in container_ids:
+            state = await self.containers.get_state(container_id)
+            if state is not None and state.gang_id:
+                gang_ids.add(state.gang_id)
+            await self._fail_container(container_id,
+                                       StopReason.WORKER_LOST.value)
+        # shared fate: stop every member of affected gangs
+        for gang_id in gang_ids:
+            raw = await self.store.hgetall(f"scheduler:gang:{gang_id}")
+            import json as _json
+            for peer_id in _json.loads(raw.get("containers", "[]")):
+                state = await self.containers.get_state(peer_id)
+                if state is not None and state.worker_id:
+                    await self.store.publish(
+                        f"container:stop:{state.worker_id}",
+                        {"container_id": peer_id,
+                         "reason": StopReason.GANG_PEER_FAILED.value})
+        await self.workers.deregister(worker.worker_id)
+
+    async def _fail_container(self, container_id: str, reason: str) -> None:
+        state = await self.containers.get_state(container_id)
+        if state is None:
+            return
+        await self.containers.set_exit_code(container_id, -1, reason)
+        await self.containers.delete_state(container_id, state.stub_id)
+        await self.store.publish("events:container_exit",
+                                 {"container_id": container_id,
+                                  "stub_id": state.stub_id})

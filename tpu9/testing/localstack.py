@@ -31,6 +31,7 @@ from ..statestore import MemoryStore
 from ..types import ContainerStatus, StubType
 from ..worker import Worker
 from ..worker.cache_manager import WorkerCache
+from ..worker.checkpoint import CheckpointManager
 
 ECHO_HANDLER = """
 def handler(**kwargs):
@@ -106,11 +107,18 @@ class LocalStack:
             self.cfg.cache, f"wc{len(self.workers)}",
             WorkerRepository(self.store),
             source=self._chunk_source, manifest_fetch=self._manifest_fetch)
+        checkpoints = CheckpointManager(
+            cache.client,
+            record=self._ckpt_record, update=self.backend.update_checkpoint,
+            fetch_manifest=self._ckpt_fetch,
+            store_manifest=self._ckpt_store,
+            marker_timeout_s=20.0)
         worker = Worker(
             self.store, runtime, cfg=self.cfg.worker, pool=pool,
             cpu_millicores=16000, memory_mb=32768,   # virtual capacity: these
             # workers time-share the host the way k8s test nodes do
             tpu_generation=tpu_generation, cache=cache,
+            checkpoints=checkpoints,
             object_resolver=self._resolve_object, **slice_kw)
         await worker.start()
         self.workers.append(worker)
@@ -119,6 +127,23 @@ class LocalStack:
     async def _resolve_object(self, object_id: str) -> str:
         obj = await self.backend.get_object(object_id)
         return obj["path"] if obj else ""
+
+    async def _ckpt_record(self, stub_id, workspace_id, container_id):
+        return await self.backend.create_checkpoint(stub_id, workspace_id,
+                                                    container_id)
+
+    def _ckpt_path(self, checkpoint_id: str) -> str:
+        d = os.path.join(self.cfg.image.registry_dir, "checkpoints")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{checkpoint_id}.json")
+
+    async def _ckpt_store(self, checkpoint_id: str, blob: str) -> None:
+        with open(self._ckpt_path(checkpoint_id), "w") as f:
+            f.write(blob)
+
+    async def _ckpt_fetch(self, checkpoint_id: str):
+        p = self._ckpt_path(checkpoint_id)
+        return open(p).read() if os.path.exists(p) else None
 
     async def _chunk_source(self, digest: str):
         return self.gateway.images.chunk(digest)

@@ -1,0 +1,132 @@
+"""Checkpoint/restore — the cold-start accelerator, TPU-style.
+
+Reference analogue: the CRIU manager (``pkg/worker/criu.go``: auto-checkpoint
+after readiness :392, filesystem snapshot + upload :668, restore with
+cold-boot fallback :429). CRIU cannot snapshot TPU device state, so tpu9
+implements the same *UX* at the JAX level (SURVEY.md §7.6):
+
+1. **Filesystem snapshot**: after a container passes readiness (and its
+   runner has written model state into ``.tpu9-ckpt/``), the workdir is
+   chunked into the content-addressed cache with the image-manifest format.
+2. **Restore**: a scheduled request carrying ``checkpoint_id`` materializes
+   that snapshot instead of re-extracting the code archive — the runner
+   finds saved params + marker and skips model re-init.
+3. **XLA compile cache**: every container gets
+   ``JAX_COMPILATION_CACHE_DIR`` on a worker-persistent path, so jit
+   recompiles (the real TPU cold-start tail) are cross-container hits.
+
+Triggers mirror ``types.CheckpointTrigger`` (readiness / manual / interval).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Awaitable, Callable, Optional
+
+from ..cache import CacheClient
+from ..images.manifest import ImageManifest, materialize, snapshot_dir
+
+log = logging.getLogger("tpu9.worker")
+
+CKPT_DIR_NAME = ".tpu9-ckpt"
+READY_MARKER = "READY"
+
+# async (stub_id, workspace_id, container_id) -> checkpoint_id
+RecordFn = Callable[[str, str, str], Awaitable[str]]
+# async (checkpoint_id, status, remote_key, size) -> None
+UpdateFn = Callable[[str, str, str, int], Awaitable[None]]
+# async (checkpoint_id) -> manifest json | None
+FetchFn = Callable[[str], Awaitable[Optional[str]]]
+
+
+class CheckpointManager:
+    def __init__(self, cache: CacheClient,
+                 record: Optional[RecordFn] = None,
+                 update: Optional[UpdateFn] = None,
+                 fetch_manifest: Optional[FetchFn] = None,
+                 store_manifest=None,
+                 marker_timeout_s: float = 300.0):
+        self.cache = cache
+        self.record = record
+        self.update = update
+        self.fetch_manifest = fetch_manifest
+        self.store_manifest = store_manifest   # async (ckpt_id, json) -> None
+        self.marker_timeout_s = marker_timeout_s
+
+    # -- create ---------------------------------------------------------------
+
+    async def auto_checkpoint(self, stub_id: str, workspace_id: str,
+                              container_id: str, workdir: str) -> Optional[str]:
+        """Readiness-trigger checkpoint: wait for the runner's READY marker
+        (it appears once model state is saved), snapshot the workdir."""
+        if self.record is None:
+            return None
+        marker = os.path.join(workdir, CKPT_DIR_NAME, READY_MARKER)
+        deadline = time.monotonic() + self.marker_timeout_s
+        while not os.path.exists(marker):
+            if time.monotonic() > deadline:
+                log.info("checkpoint marker never appeared for %s",
+                         container_id)
+                return None
+            await asyncio.sleep(0.25)
+        return await self.create(stub_id, workspace_id, container_id, workdir)
+
+    async def create(self, stub_id: str, workspace_id: str, container_id: str,
+                     workdir: str) -> Optional[str]:
+        checkpoint_id = await self.record(stub_id, workspace_id, container_id)
+        try:
+            chunks: list[tuple[bytes, str]] = []
+
+            def put_chunk(data: bytes, digest: str) -> None:
+                chunks.append((data, digest))
+
+            manifest = await asyncio.to_thread(
+                snapshot_dir, workdir, 4 * 1024 * 1024, put_chunk)
+            manifest.image_id = checkpoint_id
+            for data, digest in chunks:
+                await self.cache.put(data, digest)
+            if self.store_manifest is not None:
+                await self.store_manifest(checkpoint_id, manifest.to_json())
+            if self.update is not None:
+                await self.update(checkpoint_id, "available",
+                                  manifest.manifest_hash,
+                                  manifest.total_bytes)
+            log.info("checkpoint %s: %d files, %d MiB", checkpoint_id,
+                     len(manifest.files), manifest.total_bytes >> 20)
+            return checkpoint_id
+        except Exception as exc:
+            log.warning("checkpoint create failed for %s: %s", container_id,
+                        exc)
+            if self.update is not None:
+                await self.update(checkpoint_id, "failed", "", 0)
+            return None
+
+    # -- restore --------------------------------------------------------------
+
+    async def restore(self, checkpoint_id: str, workdir: str) -> bool:
+        """Materialize a snapshot into the workdir; False → cold boot
+        (reference attemptRestoreCheckpoint's fallback)."""
+        if self.fetch_manifest is None:
+            return False
+        try:
+            blob = await self.fetch_manifest(checkpoint_id)
+            if blob is None:
+                return False
+            manifest = ImageManifest.from_json(blob)
+            fetched = await self.cache.get_many(
+                list(dict.fromkeys(manifest.all_chunks())))
+            if any(v is None for v in fetched.values()):
+                log.warning("checkpoint %s missing chunks; cold booting",
+                            checkpoint_id)
+                return False
+            await asyncio.to_thread(
+                materialize, manifest, workdir, fetched.get,
+                self.cache.store.get_path)
+            return True
+        except Exception as exc:
+            log.warning("checkpoint restore %s failed: %s (cold boot)",
+                        checkpoint_id, exc)
+            return False

@@ -44,6 +44,7 @@ class ContainerLifecycle:
                  containers: ContainerRepository, tpu: TpuDeviceManager,
                  object_resolver: Optional[Callable[[str], Awaitable[str]]] = None,
                  image_resolver: Optional[Callable[[str], Awaitable[str]]] = None,
+                 checkpoints=None,
                  phase_cb: Optional[Callable[[str, str, float], None]] = None):
         self.worker_id = worker_id
         self.cfg = cfg
@@ -52,6 +53,7 @@ class ContainerLifecycle:
         self.tpu = tpu
         self.object_resolver = object_resolver
         self.image_resolver = image_resolver
+        self.checkpoints = checkpoints   # Optional[CheckpointManager]
         self.phase_cb = phase_cb
         self._active: dict[str, asyncio.Task] = {}
         self._exited: dict[str, int] = {}
@@ -113,8 +115,14 @@ class ContainerLifecycle:
                     raise RuntimeError("container failed readiness probe")
             elif request.stub_type == StubType.POD.value:
                 # pods with a server: best-effort TCP readiness so the proxy
-                # doesn't race the bind; batch pods just time out the probe
+                # doesn't race the bind; batch pods just time out the probe —
+                # but a pod whose process already exited is a hard failure
                 await self._wait_tcp(container_id, address, budget_s=15.0)
+                handle = await self.runtime.state(container_id)
+                if handle is not None and handle.exit_code not in (None, 0):
+                    raise RuntimeError(
+                        f"pod entrypoint exited with {handle.exit_code} "
+                        f"before becoming ready")
 
             state.status = ContainerStatus.RUNNING.value
             state.address = address
@@ -122,6 +130,14 @@ class ContainerLifecycle:
             await self.containers.set_address(container_id, address)
             await self.containers.update_state(state)
             self._phase(container_id, LifecyclePhase.CONTAINER_READY, t0)
+
+            # readiness-trigger checkpoint (criu.go:392 analogue): snapshot
+            # once the runner marks its state saved — skipped for restores
+            if (self.checkpoints is not None and not request.checkpoint_id
+                    and request.env.get("TPU9_CHECKPOINT_ENABLED") == "1"):
+                asyncio.create_task(self.checkpoints.auto_checkpoint(
+                    request.stub_id, request.workspace_id, container_id,
+                    spec.workdir))
 
             self._active[container_id] = asyncio.create_task(
                 self._supervise(request, state))
@@ -187,7 +203,15 @@ class ContainerLifecycle:
         base = os.path.join(self.cfg.containers_dir, request.container_id,
                             "workspace")
         os.makedirs(base, exist_ok=True)
-        if request.object_id and self.object_resolver:
+        restored = False
+        if request.checkpoint_id and self.checkpoints is not None:
+            restored = await self.checkpoints.restore(request.checkpoint_id,
+                                                      base)
+            if restored:
+                self._phase(request.container_id,
+                            LifecyclePhase.CHECKPOINT_RESTORED,
+                            time.monotonic())
+        if not restored and request.object_id and self.object_resolver:
             archive = await self.object_resolver(request.object_id)
             if archive and os.path.exists(archive):
                 import zipfile
@@ -196,15 +220,32 @@ class ContainerLifecycle:
         for mount in request.mounts:
             if mount.kind != "volume" or not mount.target:
                 continue
-            host_dir = os.path.join(self.cfg.storage_root,
-                                    request.workspace_id, "volumes",
-                                    mount.source)
+            host_dir = self._safe_volume_dir(request.workspace_id,
+                                             mount.source)
             os.makedirs(host_dir, exist_ok=True)
-            link = os.path.join(base, mount.target.lstrip("/"))
+            link = os.path.realpath(
+                os.path.join(base, mount.target.lstrip("/")))
+            if not link.startswith(os.path.realpath(base) + os.sep):
+                raise ValueError(
+                    f"mount path escapes workdir: {mount.target!r}")
             os.makedirs(os.path.dirname(link), exist_ok=True)
             if not os.path.lexists(link):
                 os.symlink(host_dir, link)
         return base
+
+    def _safe_volume_dir(self, workspace_id: str, name: str) -> str:
+        """Volume name must be a single path component inside the workspace's
+        volume root (same containment contract as VolumeFiles._safe — a
+        crafted name like '../../<other-ws>/volumes/x' must never resolve
+        cross-tenant)."""
+        if not name or "/" in name or "\\" in name or name in (".", ".."):
+            raise ValueError(f"invalid volume name {name!r}")
+        base = os.path.realpath(os.path.join(self.cfg.storage_root,
+                                             workspace_id, "volumes"))
+        full = os.path.realpath(os.path.join(base, name))
+        if not (full == base or full.startswith(base + os.sep)):
+            raise ValueError(f"volume path escapes workspace: {name!r}")
+        return full
 
     def _spec_from_request(self, request: ContainerRequest, rootfs: str,
                            workdir: str, port: int, assignment) -> ContainerSpec:
@@ -233,6 +274,13 @@ class ContainerLifecycle:
             "PYTHONPATH": workdir + os.pathsep + env.get("PYTHONPATH", ""),
             "PYTHONUNBUFFERED": "1",
         })
+        # persistent XLA compile cache: jit recompiles are the real TPU
+        # cold-start tail; share them across containers on this host
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(self.cfg.containers_dir, "..",
+                                    "xla-cache"))
+        if request.checkpoint_id:
+            env["TPU9_RESTORED"] = "1"
         if image_site:
             env["PYTHONPATH"] = (env["PYTHONPATH"] + os.pathsep + image_site)
         devices: list[str] = []
@@ -265,9 +313,8 @@ class ContainerLifecycle:
         spec_mounts = []
         for mount in request.mounts:
             if mount.kind == "volume":
-                host_dir = os.path.join(self.cfg.storage_root,
-                                        request.workspace_id, "volumes",
-                                        mount.source)
+                host_dir = self._safe_volume_dir(request.workspace_id,
+                                                 mount.source)
                 spec_mounts.append((host_dir, mount.target, mount.read_only))
             elif mount.kind == "bind":
                 spec_mounts.append((mount.source, mount.target,

@@ -38,7 +38,7 @@ class Worker:
                  slice_topology: str = "", slice_host_rank: int = 0,
                  slice_host_count: int = 1,
                  object_resolver=None, image_resolver=None,
-                 cache=None, phase_cb=None) -> None:
+                 cache=None, checkpoints=None, phase_cb=None) -> None:
         self.cfg = cfg or WorkerConfig()
         self.worker_id = worker_id or new_id("worker")
         self.pool = pool
@@ -48,12 +48,14 @@ class Worker:
         self.tpu = TpuDeviceManager(generation=tpu_generation)
         self.runtime = runtime
         self.cache = cache          # Optional[WorkerCache]
+        if phase_cb is None:
+            phase_cb = self._default_phase_cb
         if image_resolver is None and cache is not None:
             image_resolver = cache.resolve_image
         self.lifecycle = ContainerLifecycle(
             self.worker_id, self.cfg, runtime, self.containers, self.tpu,
             object_resolver=object_resolver, image_resolver=image_resolver,
-            phase_cb=phase_cb)
+            checkpoints=checkpoints, phase_cb=phase_cb)
         self.slice_id = slice_id
         self.slice_topology = slice_topology
         self.slice_host_rank = slice_host_rank
@@ -68,6 +70,13 @@ class Worker:
         self._last_activity = time.monotonic()
 
     # ------------------------------------------------------------------
+
+    def _default_phase_cb(self, container_id: str, phase: str,
+                          elapsed_s: float) -> None:
+        """Cold-start phase timeline → metrics (RecordWorkerStartupPhase
+        equivalent; the startup report reads these summaries)."""
+        from ..observability import metrics
+        metrics.observe("tpu9_startup_phase_s", elapsed_s, {"phase": phase})
 
     def _state(self) -> WorkerState:
         return WorkerState(
@@ -119,10 +128,32 @@ class Worker:
     # ------------------------------------------------------------------
 
     async def _heartbeat_loop(self) -> None:
+        from ..observability import metrics
         while not self._stopping.is_set():
             await self.workers.touch_keepalive(self.worker_id)
             for container_id in self.lifecycle.active_ids():
                 await self.containers.refresh_ttl(container_id)
+                # per-container usage sampling (usage.go equivalent)
+                handle = await self.runtime.state(container_id)
+                if handle is not None and handle.pid:
+                    try:
+                        p = psutil.Process(handle.pid)
+                        metrics.set_gauge(
+                            "tpu9_container_rss_mb",
+                            p.memory_info().rss / 2**20,
+                            {"container": container_id})
+                    except (psutil.NoSuchProcess, psutil.AccessDenied):
+                        pass
+            metrics.set_gauge("tpu9_worker_active_containers",
+                              len(self.lifecycle.active_ids()),
+                              {"worker": self.worker_id})
+            # ship this process's registry to the state bus so the gateway's
+            # /api/v1/metrics shows the whole fleet (VictoriaMetrics-push
+            # equivalent, pkg/metrics/metrics.go:29)
+            import json as _json
+            await self.store.set(f"worker:metrics:{self.worker_id}",
+                                 _json.dumps(metrics.to_dict()),
+                                 ttl=self.cfg.keepalive_ttl_s * 2)
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
 
     async def _request_loop(self) -> None:
