@@ -1,0 +1,65 @@
+"""E2E: @asgi apps and @realtime websockets through the gateway."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from tpu9.testing.localstack import LocalStack
+
+pytestmark = pytest.mark.e2e
+
+ASGI_APP = """
+async def app(scope, receive, send):
+    assert scope["type"] == "http"
+    event = await receive()
+    body = event.get("body", b"")
+    await send({"type": "http.response.start", "status": 201,
+                "headers": [(b"content-type", b"text/plain"),
+                            (b"x-path", scope["path"].encode())]})
+    await send({"type": "http.response.body",
+                "body": b"asgi:" + body})
+"""
+
+ECHO_RT = """
+def handler(text=""):
+    return {"upper": text.upper()}
+"""
+
+
+async def test_asgi_app_served():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint(
+            "myasgi", {"app.py": ASGI_APP}, "app:app",
+            stub_type="asgi")
+        assert stack._session is not None
+        async with stack._session.post(
+                f"{stack.base_url}/endpoint/myasgi/sub/path",
+                data=b"hello") as resp:
+            assert resp.status == 201
+            body = await resp.read()
+            assert body == b"asgi:hello"
+            assert resp.headers.get("x-path") == "/sub/path"
+
+
+async def test_realtime_websocket_roundtrip():
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint(
+            "rt", {"app.py": ECHO_RT}, "app:handler",
+            stub_type="realtime")
+        headers = {"Authorization":
+                   f"Bearer {stack.gateway.default_token}"}
+        async with aiohttp.ClientSession(headers=headers) as session:
+            async with session.ws_connect(
+                    f"{stack.base_url}/endpoint/rt",
+                    timeout=aiohttp.ClientWSTimeout(ws_close=60)) as ws:
+                await ws.send_str(json.dumps({"text": "stream me"}))
+                msg = await asyncio.wait_for(ws.receive(), 60)
+                out = json.loads(msg.data)
+                assert out == {"upper": "STREAM ME"}
+                # several messages over one socket (session affinity)
+                for i in range(3):
+                    await ws.send_str(json.dumps({"text": f"m{i}"}))
+                    msg = await asyncio.wait_for(ws.receive(), 30)
+                    assert json.loads(msg.data)["upper"] == f"M{i}"

@@ -11,6 +11,7 @@ autoscaler samples as queue depth.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import random
 import time
@@ -103,6 +104,18 @@ class RequestBuffer:
 
     def _dec_open(self) -> None:
         self._open -= 1
+
+    @contextlib.contextmanager
+    def hold_demand(self):
+        """Register demand with the autoscaler without a buffered request.
+        Websocket sessions hold this for their WHOLE lifetime — demand is
+        what keeps the autoscaler from scaling the serving container away
+        mid-session (request tokens do not influence scale-down)."""
+        self._open += 1
+        try:
+            yield
+        finally:
+            self._dec_open()
 
     # -- hot loop --------------------------------------------------------------
 

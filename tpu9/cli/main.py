@@ -268,6 +268,39 @@ def image_build(packages, commands) -> None:
     click.echo(image_id)
 
 
+@cli.command()
+@click.argument("container_id")
+def shell(container_id: str) -> None:
+    """Interactive shell into a running container (reference
+    pkg/abstractions/shell: dropbear ssh; tpu9 runs a command loop over the
+    worker exec channel)."""
+    client = _client()
+    click.echo(f"tpu9 shell → {container_id} (exit with Ctrl-D or 'exit')")
+    while True:
+        try:
+            line = input("$ ")
+        except (EOFError, KeyboardInterrupt):
+            click.echo()
+            break
+        if line.strip() in ("exit", "quit"):
+            break
+        if not line.strip():
+            continue
+        try:
+            out = client._run(lambda c: c.request(
+                "POST", f"/rpc/pod/{container_id}/exec",
+                json_body={"cmd": ["sh", "-c", line], "timeout": 60}))
+        except Exception as exc:  # keep the REPL alive on RPC errors
+            click.echo(f"[error] {exc}")
+            continue
+        if out.get("output"):
+            click.echo(out["output"], nl=False)
+            if not out["output"].endswith("\n"):
+                click.echo()
+        if out.get("exit_code", 0) != 0:
+            click.echo(f"[exit {out.get('exit_code')}]")
+
+
 @cli.command("metrics")
 @click.option("--prometheus", is_flag=True)
 def metrics_cmd(prometheus: bool) -> None:

@@ -829,17 +829,82 @@ class Gateway:
                                        ws.workspace_id != stub.workspace_id):
             return web.json_response({"error": "unauthorized"}, status=401)
 
+        if (stub.stub_type == StubType.REALTIME.value
+                and request.headers.get("Upgrade", "").lower() == "websocket"):
+            return await self._ws_proxy(stub, request)
+
         body = await request.read()
         result = await self.endpoints.forward(
             stub, request.method, "/" + tail if tail else "/",
             {"Content-Type": request.headers.get("Content-Type",
                                                  "application/json")},
             body)
-        # preserve the container's content type (ASGI apps return HTML/SSE/…)
-        content_type = result.headers.get("Content-Type", "application/json")
+        # preserve the container's response headers (ASGI apps set their own
+        # content types and custom headers); drop hop-by-hop ones
         resp = web.Response(status=result.status, body=result.body)
-        resp.headers["Content-Type"] = content_type
+        # content-encoding excluded: the buffer's client session already
+        # decompressed the body, so forwarding the header would corrupt it
+        skip = {"connection", "transfer-encoding", "content-length", "server",
+                "date", "content-encoding"}
+        for k, v in result.headers.items():
+            if k.lower() not in skip:
+                resp.headers[k] = v
+        resp.headers.setdefault("Content-Type", "application/json")
         return resp
+
+    async def _ws_proxy(self, stub: Stub, request: web.Request) -> web.StreamResponse:
+        """Bidirectional websocket proxy for @realtime deployments
+        (endpoint/buffer.go:644 equivalent). Holds a concurrency token on the
+        chosen container for the socket's lifetime."""
+        import aiohttp as _aiohttp
+
+        inst = await self.endpoints.get_or_create_instance(stub)
+        # demand is held for the WHOLE session: it both triggers
+        # scale-from-zero and prevents keep-warm scale-down from killing the
+        # serving container while the socket is open
+        with inst.buffer.hold_demand():
+            target = None
+            admission_deadline = asyncio.get_running_loop().time() + min(
+                stub.config.timeout_s, 30.0)
+            while asyncio.get_running_loop().time() < admission_deadline:
+                target = await inst.buffer._acquire_container()
+                if target is not None:
+                    break
+                await asyncio.sleep(0.25)
+            if target is None:
+                return web.json_response({"error": "no capacity"}, status=503)
+            container_id, address = target
+
+            ws_client = web.WebSocketResponse()
+            try:
+                await ws_client.prepare(request)
+                if self._proxy_session is None or self._proxy_session.closed:
+                    self._proxy_session = _aiohttp.ClientSession()
+                async with self._proxy_session.ws_connect(
+                        f"http://{address}/") as ws_upstream:
+
+                    async def pump_up():
+                        async for msg in ws_client:
+                            if msg.type == web.WSMsgType.TEXT:
+                                await ws_upstream.send_str(msg.data)
+                            elif msg.type == web.WSMsgType.BINARY:
+                                await ws_upstream.send_bytes(msg.data)
+                        await ws_upstream.close()
+
+                    async def pump_down():
+                        async for msg in ws_upstream:
+                            if msg.type == _aiohttp.WSMsgType.TEXT:
+                                await ws_client.send_str(msg.data)
+                            elif msg.type == _aiohttp.WSMsgType.BINARY:
+                                await ws_client.send_bytes(msg.data)
+                        await ws_client.close()
+
+                    await asyncio.gather(pump_up(), pump_down(),
+                                         return_exceptions=True)
+            finally:
+                await self.containers.release_request_token(stub.stub_id,
+                                                            container_id)
+        return ws_client
 
     # -- handlers: REST v1 ----------------------------------------------------
 

@@ -1,0 +1,85 @@
+"""Minimal ASGI-over-aiohttp adapter for @asgi stubs.
+
+Reference analogue: the reference hosts user ASGI apps under
+gunicorn+uvicorn (``sdk/src/beta9/runner/endpoint.py:70-90``). Neither is in
+the tpu9 runner image, so this adapter translates aiohttp requests into ASGI
+http scope events for the user's app (FastAPI/Starlette/raw ASGI). Covers the
+http protocol incl. streaming bodies; websocket ASGI apps use the realtime
+runner path instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from aiohttp import web
+
+
+async def run_asgi_http(app: Any, request: web.Request) -> web.Response:
+    """Drive one request through an ASGI app; returns the aiohttp response."""
+    body = await request.read()
+    scope = {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.method,
+        "scheme": "http",
+        "path": request.path,
+        "raw_path": request.raw_path.encode(),
+        "query_string": request.query_string.encode(),
+        "root_path": "",
+        "headers": [(k.lower().encode(), v.encode())
+                    for k, v in request.headers.items()],
+        "client": (request.remote or "127.0.0.1", 0),
+        "server": ("127.0.0.1", 0),
+    }
+
+    received = {"sent": False}
+
+    async def receive() -> dict:
+        if received["sent"]:
+            return {"type": "http.disconnect"}
+        received["sent"] = True
+        return {"type": "http.request", "body": body, "more_body": False}
+
+    state: dict = {"status": 500, "headers": [], "chunks": []}
+
+    async def send(message: dict) -> None:
+        if message["type"] == "http.response.start":
+            state["status"] = message["status"]
+            state["headers"] = message.get("headers", [])
+        elif message["type"] == "http.response.body":
+            chunk = message.get("body", b"")
+            if chunk:
+                state["chunks"].append(chunk)
+
+    await app(scope, receive, send)
+
+    # multidict: duplicate headers (multiple Set-Cookie) must survive
+    from multidict import CIMultiDict
+    headers: CIMultiDict = CIMultiDict()
+    for k, v in state["headers"]:
+        name = k.decode() if isinstance(k, bytes) else k
+        value = v.decode() if isinstance(v, bytes) else v
+        if name.lower() == "content-length":
+            continue
+        headers.add(name, value)
+    return web.Response(status=state["status"], body=b"".join(state["chunks"]),
+                        headers=headers)
+
+
+def looks_like_asgi(obj: Any) -> bool:
+    """ASGI apps are callables taking (scope, receive, send)."""
+    import inspect
+    if not callable(obj):
+        return False
+    try:
+        target = obj if inspect.isfunction(obj) or inspect.ismethod(obj) \
+            else obj.__call__
+        params = inspect.signature(target).parameters
+        names = [p for p in params
+                 if params[p].kind in (params[p].POSITIONAL_ONLY,
+                                       params[p].POSITIONAL_OR_KEYWORD)]
+        return len(names) >= 3
+    except (ValueError, TypeError):
+        return False

@@ -7,8 +7,10 @@ containers, which is where TPU workloads want isolation anyway):
 
 - ``POST /``      → call the user handler with the JSON body as kwargs
 - ``GET /health`` → 200 once the handler (and its on_start) is loaded
-- ASGI stubs: if the loaded object is an ASGI app, requests are dispatched
-  through it instead of the function path.
+- @asgi stubs: a handler that IS (or returns) an ASGI app is served through
+  the adapter in tpu9.runner.asgi instead of the function path
+- @realtime stubs: websocket upgrade on any route; each incoming text/json
+  message is passed to the handler and the result sent back
 """
 
 from __future__ import annotations
@@ -28,7 +30,7 @@ log = logging.getLogger("tpu9.runner")
 
 def build_app(cfg: RunnerConfig) -> web.Application:
     handler = FunctionHandler(cfg)
-    state = {"ready": False, "inflight": 0}
+    state = {"ready": False, "inflight": 0, "asgi_app": None}
 
     async def on_startup(app):
         # load (and run on_start) off the event loop, then flip readiness —
@@ -36,6 +38,13 @@ def build_app(cfg: RunnerConfig) -> web.Application:
         def load():
             handler.load()
         await asyncio.to_thread(load)
+        if cfg.stub_type == "asgi":
+            from .asgi import looks_like_asgi
+            target = handler.fn
+            if not looks_like_asgi(target):
+                # factory style: handler() returns the app
+                target = await handler.call()
+            state["asgi_app"] = target
         state["ready"] = True
         if os.environ.get("TPU9_CHECKPOINT_ENABLED") == "1":
             # handler state is loaded (and saved via ckpt.maybe_restore if
@@ -49,9 +58,33 @@ def build_app(cfg: RunnerConfig) -> web.Application:
             return web.json_response({"ready": False}, status=503)
         return web.json_response({"ready": True, "inflight": state["inflight"]})
 
+    async def realtime(request: web.Request) -> web.StreamResponse:
+        """Websocket serving for @realtime stubs (reference RealtimeASGI,
+        endpoint/buffer.go:644): one handler call per incoming message."""
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        async for msg in ws:
+            if msg.type != web.WSMsgType.TEXT:
+                continue
+            try:
+                payload = json.loads(msg.data)
+                if not isinstance(payload, dict):
+                    payload = {"input": payload}
+                result = await handler.call(**payload)
+                await ws.send_str(dumps(result))
+            except Exception as exc:  # noqa: BLE001 — keep the socket alive
+                await ws.send_str(dumps(error_payload(exc)))
+        return ws
+
     async def invoke(request: web.Request) -> web.Response:
         if not state["ready"]:
             return web.json_response({"error": "not ready"}, status=503)
+        if (cfg.stub_type == "realtime"
+                and request.headers.get("Upgrade", "").lower() == "websocket"):
+            return await realtime(request)
+        if state["asgi_app"] is not None:
+            from .asgi import run_asgi_http
+            return await run_asgi_http(state["asgi_app"], request)
         try:
             raw = await request.read()
             payload = json.loads(raw) if raw else {}
