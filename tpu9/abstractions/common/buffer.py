@@ -16,7 +16,7 @@ import logging
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Optional  # noqa: F401
 
 import aiohttp
 
@@ -30,7 +30,7 @@ log = logging.getLogger("tpu9.abstractions")
 class BufferedRequest:
     method: str = "POST"
     path: str = "/"
-    headers: dict[str, str] = field(default_factory=dict)
+    headers: Any = None            # CIMultiDict (duplicates preserved)
     body: bytes = b""
     enqueued_at: float = field(default_factory=time.monotonic)
     future: Optional[asyncio.Future] = None
@@ -40,7 +40,9 @@ class BufferedRequest:
 class ForwardResult:
     status: int
     body: bytes
-    headers: dict[str, str] = field(default_factory=dict)
+    # list of (name, value) pairs: duplicate response headers (multiple
+    # Set-Cookie) must survive the proxy hop
+    headers: list = field(default_factory=list)
     container_id: str = ""
 
 
@@ -87,10 +89,12 @@ class RequestBuffer:
     # -- public forwarding API -----------------------------------------------
 
     async def forward(self, method: str = "POST", path: str = "/",
-                      headers: Optional[dict[str, str]] = None,
-                      body: bytes = b"") -> ForwardResult:
+                      headers=None, body: bytes = b"") -> ForwardResult:
+        """``headers`` may be a dict or a list of (name, value) pairs
+        (duplicates preserved)."""
+        from multidict import CIMultiDict
         req = BufferedRequest(method=method, path=path,
-                              headers=dict(headers or {}), body=body,
+                              headers=CIMultiDict(headers or {}), body=body,
                               future=asyncio.get_running_loop().create_future())
         self._open += 1
         req.future.add_done_callback(lambda _f: self._dec_open())
@@ -140,6 +144,19 @@ class RequestBuffer:
             self._inflight += 1
             asyncio.create_task(self._forward_one(req, container_id, address))
 
+    async def acquire(self, deadline_s: float = 30.0,
+                      body: bytes = b"") -> Optional[tuple[str, str]]:
+        """Public admission: poll for a container with a concurrency token
+        until ``deadline_s`` elapses (websocket sessions and other direct
+        consumers; HTTP requests ride the buffered _process_loop)."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            target = await self._acquire_container(body)
+            if target is not None:
+                return target
+            await asyncio.sleep(0.25)
+        return None
+
     async def _acquire_container(self,
                                  body: bytes = b"") -> Optional[tuple[str, str]]:
         """Discover RUNNING containers and grab a concurrency token on one.
@@ -181,7 +198,7 @@ class RequestBuffer:
             ) as resp:
                 body = await resp.read()
                 result = ForwardResult(status=resp.status, body=body,
-                                       headers=dict(resp.headers),
+                                       headers=list(resp.headers.items()),
                                        container_id=container_id)
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
             result = ForwardResult(status=502,

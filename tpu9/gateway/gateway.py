@@ -834,21 +834,27 @@ class Gateway:
             return await self._ws_proxy(stub, request)
 
         body = await request.read()
-        result = await self.endpoints.forward(
-            stub, request.method, "/" + tail if tail else "/",
-            {"Content-Type": request.headers.get("Content-Type",
-                                                 "application/json")},
-            body)
+        # forward the full request surface (query string + end-to-end
+        # headers) — ASGI apps depend on both; hop-by-hop headers stay
+        path = "/" + tail if tail else "/"
+        if request.query_string:
+            path += f"?{request.query_string}"
+        skip_req = {"host", "connection", "transfer-encoding",
+                    "content-length"}
+        fwd_headers = [(k, v) for k, v in request.headers.items()
+                       if k.lower() not in skip_req]
+        result = await self.endpoints.forward(stub, request.method, path,
+                                              fwd_headers, body)
         # preserve the container's response headers (ASGI apps set their own
-        # content types and custom headers); drop hop-by-hop ones
+        # content types and custom headers, incl. duplicates like
+        # Set-Cookie); drop hop-by-hop ones. content-encoding excluded: the
+        # buffer's client session already decompressed the body.
         resp = web.Response(status=result.status, body=result.body)
-        # content-encoding excluded: the buffer's client session already
-        # decompressed the body, so forwarding the header would corrupt it
         skip = {"connection", "transfer-encoding", "content-length", "server",
                 "date", "content-encoding"}
-        for k, v in result.headers.items():
+        for k, v in result.headers:
             if k.lower() not in skip:
-                resp.headers[k] = v
+                resp.headers.add(k, v)
         resp.headers.setdefault("Content-Type", "application/json")
         return resp
 
@@ -863,14 +869,8 @@ class Gateway:
         # scale-from-zero and prevents keep-warm scale-down from killing the
         # serving container while the socket is open
         with inst.buffer.hold_demand():
-            target = None
-            admission_deadline = asyncio.get_running_loop().time() + min(
-                stub.config.timeout_s, 30.0)
-            while asyncio.get_running_loop().time() < admission_deadline:
-                target = await inst.buffer._acquire_container()
-                if target is not None:
-                    break
-                await asyncio.sleep(0.25)
+            target = await inst.buffer.acquire(
+                deadline_s=min(stub.config.timeout_s, 30.0))
             if target is None:
                 return web.json_response({"error": "no capacity"}, status=503)
             container_id, address = target

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention, decode_attention
 from ..ops.norms import rms_norm
+from ..ops.quant import maybe_matmul
 from ..ops.rotary import apply_rope, rope_table
 
 Params = dict[str, Any]
@@ -116,9 +117,9 @@ def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
                 cache_len: Optional[jnp.ndarray], decode: bool):
     b, t, _ = x.shape
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps, cfg.norm_offset)
-    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = maybe_matmul(h, layer["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = maybe_matmul(h, layer["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = maybe_matmul(h, layer["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, sin, cos)
     k = apply_rope(k, positions, sin, cos)
 
@@ -147,7 +148,7 @@ def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
         new_cache = (k_cache, v_cache)
 
     out = out.reshape(b, t, cfg.n_heads * cfg.head_dim)
-    return x + out @ layer["wo"], new_cache
+    return x + maybe_matmul(out, layer["wo"]), new_cache
 
 
 def _scatter_kv(cache: jnp.ndarray, kv: jnp.ndarray,
@@ -165,8 +166,8 @@ def _scatter_kv(cache: jnp.ndarray, kv: jnp.ndarray,
 
 def _mlp_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, cfg.norm_offset)
-    gated = _act(h @ layer["w_gate"], cfg.act) * (h @ layer["w_up"])
-    return x + gated @ layer["w_down"]
+    gated = _act(maybe_matmul(h, layer["w_gate"]), cfg.act) * maybe_matmul(h, layer["w_up"])
+    return x + maybe_matmul(gated, layer["w_down"])
 
 
 def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
@@ -205,8 +206,10 @@ def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
     if return_hidden:
         logits = None
     else:
-        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-        logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+        if cfg.tie_embeddings:
+            logits = (x @ params["embed"].T.astype(cfg.dtype)).astype(jnp.float32)
+        else:
+            logits = maybe_matmul(x, params["lm_head"]).astype(jnp.float32)
         if cfg.logit_softcap > 0:
             logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
 

@@ -28,8 +28,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 Params = dict[str, Any]
 
 
-def _layer_specs(tp: str, fsdp: Optional[str]) -> dict[str, P]:
-    return {
+def _quant_aware(spec: P, leaf) -> Any:
+    """int8-quantized weights are {"q": [in,out] int8, "scale": [1,out]} —
+    shard q like the dense weight and scale along the output axis."""
+    if isinstance(leaf, dict) and "q" in leaf:
+        out_axis = spec[1] if len(spec) > 1 else None
+        return {"q": spec, "scale": P(None, out_axis)}
+    return spec
+
+
+def _layer_specs(layer: Params, tp: str, fsdp: Optional[str]) -> dict:
+    base = {
         "attn_norm": P(),
         "mlp_norm": P(),
         "wq": P(fsdp, tp),
@@ -40,18 +49,22 @@ def _layer_specs(tp: str, fsdp: Optional[str]) -> dict[str, P]:
         "w_up": P(fsdp, tp),
         "w_down": P(tp, fsdp),
     }
+    return {name: _quant_aware(spec, layer.get(name))
+            for name, spec in base.items() if name in layer}
 
 
 def decoder_param_specs(params: Params, tp: str = "tp",
                         fsdp: Optional[str] = "fsdp") -> Params:
-    """PartitionSpec tree matching a decoder param tree."""
+    """PartitionSpec tree matching a decoder param tree (dense or int8-
+    quantized)."""
     specs: Params = {
         "embed": P(fsdp, None),
         "final_norm": P(),
-        "layers": [_layer_specs(tp, fsdp) for _ in params["layers"]],
+        "layers": [_layer_specs(layer, tp, fsdp)
+                   for layer in params["layers"]],
     }
     if "lm_head" in params:
-        specs["lm_head"] = P(fsdp, tp)
+        specs["lm_head"] = _quant_aware(P(fsdp, tp), params["lm_head"])
     return specs
 
 

@@ -3,9 +3,12 @@
 Reference analogue: the reference hosts user ASGI apps under
 gunicorn+uvicorn (``sdk/src/beta9/runner/endpoint.py:70-90``). Neither is in
 the tpu9 runner image, so this adapter translates aiohttp requests into ASGI
-http scope events for the user's app (FastAPI/Starlette/raw ASGI). Covers the
-http protocol incl. streaming bodies; websocket ASGI apps use the realtime
-runner path instead.
+http scope events for the user's app (FastAPI/Starlette/raw ASGI).
+
+Scope: the http protocol with buffered request/response bodies. Incremental
+streaming (SSE/chunked) and websocket ASGI apps are not yet supported —
+responses are delivered when the app completes (see ROADMAP.md); @realtime
+covers the websocket use case.
 """
 
 from __future__ import annotations
@@ -35,10 +38,14 @@ async def run_asgi_http(app: Any, request: web.Request) -> web.Response:
     }
 
     received = {"sent": False}
+    import asyncio
 
     async def receive() -> dict:
         if received["sent"]:
-            return {"type": "http.disconnect"}
+            # ASGI: http.disconnect only when the client actually goes away;
+            # apps (e.g. Starlette's listen_for_disconnect) block here —
+            # returning disconnect early would cancel streaming responses
+            await asyncio.Event().wait()
         received["sent"] = True
         return {"type": "http.request", "body": body, "more_body": False}
 

@@ -64,6 +64,12 @@ def build_app(cfg: RunnerConfig) -> web.Application:
         ws = web.WebSocketResponse()
         await ws.prepare(request)
         async for msg in ws:
+            if msg.type == web.WSMsgType.BINARY:
+                # explicit error beats a silent drop (client would hang
+                # awaiting a reply that never comes)
+                await ws.send_str(dumps(
+                    {"error": "binary frames not supported; send JSON text"}))
+                continue
             if msg.type != web.WSMsgType.TEXT:
                 continue
             try:
