@@ -119,3 +119,50 @@ class TestNormSampling:
                                     temperature=1.0, top_p=0.9)[0])
             seen.add(tok)
         assert seen <= {0, 1}
+
+
+def _masked_decode_reference(q, k, v, lens):
+    """Independent dense reference (never dispatches to the kernel, unlike
+    decode_attention on TPU hosts)."""
+    from tpu9.ops.attention import _expand_gqa, NEG_INF
+    qh = q.shape[2]
+    k = _expand_gqa(k, qh)
+    v = _expand_gqa(v, qh)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+    mask = jnp.arange(k.shape[1])[None, :] < lens[:, None]
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+
+class TestRaggedDecode:
+    def test_matches_masked_reference(self):
+        from tpu9.ops.paged_attention import ragged_decode_attention
+        B, S, QH, KH, D = 3, 512, 8, 2, 64
+        q = rand((B, 1, QH, D))
+        k = rand((B, S, KH, D), 1)
+        v = rand((B, S, KH, D), 2)
+        lens = jnp.array([10, 256, 511])
+        ref = _masked_decode_reference(q, k, v, lens)
+        out = ragged_decode_attention(q, k, v, lens, block_s=128,
+                                      interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_garbage_beyond_len_ignored(self):
+        from tpu9.ops.paged_attention import ragged_decode_attention
+        B, S, H, D = 1, 256, 2, 64
+        q = rand((B, 1, H, D))
+        k = rand((B, S, H, D), 1)
+        v = rand((B, S, H, D), 2)
+        lens = jnp.array([100])
+        out1 = ragged_decode_attention(q, k, v, lens, block_s=128,
+                                       interpret=True)
+        k2 = k.at[:, 128:].set(1e6)   # poison blocks past the valid prefix
+        v2 = v.at[:, 128:].set(-1e6)
+        out2 = ragged_decode_attention(q, k2, v2, lens, block_s=128,
+                                       interpret=True)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                                   atol=1e-6)

@@ -189,10 +189,16 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     q: [B, 1, QH, D]; k_cache/v_cache: [B, S_max, KH, D]; cache_len: [B]
     (valid prefix length per sequence, including the current token).
 
-    One fused XLA graph: masked softmax over the cache. At decode the op is
-    HBM-bandwidth-bound reading the cache, which XLA handles well; a paged
-    pallas kernel is the follow-up optimization.
+    On TPU with aligned shapes this dispatches to the ragged pallas kernel
+    (reads only each sequence's valid prefix — decode is HBM-bound, so
+    skipped blocks are saved bandwidth); otherwise one fused XLA graph with
+    a masked softmax over the full cache.
     """
+    s_max = k_cache.shape[1]
+    if (jax.default_backend() == "tpu" and s_max >= 512 and s_max % 256 == 0
+            and q.shape[-1] in (64, 128, 256)):
+        from .paged_attention import ragged_decode_attention
+        return ragged_decode_attention(q, k_cache, v_cache, cache_len)
     q_heads = q.shape[2]
     k = _expand_gqa(k_cache, q_heads)
     v = _expand_gqa(v_cache, q_heads)

@@ -1,0 +1,125 @@
+"""Ragged decode attention: a pallas kernel that reads only each sequence's
+valid cache prefix.
+
+Decode attention is HBM-bandwidth-bound: the XLA fallback
+(`tpu9.ops.attention.decode_attention`) streams the FULL [S_max] cache per
+step and masks. With continuous batching, sequences mostly occupy a small
+prefix, so skipping blocks past ``cache_len`` cuts decode HBM traffic by
+~S_max/len̄ (the idea behind ragged/paged attention in TPU serving stacks).
+
+How the skipping actually works: the per-sequence length is a scalar-prefetch
+operand, and the k/v BlockSpec index maps CLAMP the block index to the last
+valid block — Mosaic elides the copy when consecutive grid steps map to the
+same block, so clamped (out-of-range) steps issue no DMA; ``pl.when`` then
+skips their compute. The kernel consumes the cache in its native
+[B, S, KH, D] layout (blocking the S axis directly) — no transpose/copy of
+the cache is ever materialized.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+            scale: float, block_s: int, num_sb: int):
+    b = pl.program_id(0)
+    sb = pl.program_id(2)
+    seq_len = len_ref[b]
+
+    @pl.when(sb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(sb * block_s < seq_len)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale     # [group, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)       # [block_s, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = sb * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        p = jnp.where(pos < seq_len, p, 0.0)
+        l_scr[...] = alpha * l_prev + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(sb == num_sb - 1)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        o_ref[0, 0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
+def ragged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                            v_cache: jnp.ndarray, cache_len: jnp.ndarray,
+                            block_s: int = 256,
+                            interpret: bool = False) -> jnp.ndarray:
+    """q [B,1,QH,D]; k/v_cache [B,S,KH,D] (S % block_s == 0); cache_len [B]
+    counts valid positions incl. the current token. Returns [B,1,QH,D]."""
+    batch, _, q_heads, head_dim = q.shape
+    s_max = k_cache.shape[1]
+    kv_heads = k_cache.shape[2]
+    assert q_heads % kv_heads == 0 and s_max % block_s == 0
+    group = q_heads // kv_heads
+    num_sb = s_max // block_s
+
+    # [B, KH, group, D]: query heads sharing a kv head form the q rows
+    # (pure reshape of contiguous [B, 1, QH, D] — no data movement)
+    qt = q.reshape(batch, kv_heads, group, head_dim)
+
+    grid = (batch, kv_heads, num_sb)
+    kernel = functools.partial(_kernel, scale=head_dim ** -0.5,
+                               block_s=block_s, num_sb=num_sb)
+
+    def kv_index(b, h, sb, lens):
+        # clamp past-the-end steps to the last valid block: same index as the
+        # previous step ⇒ Mosaic skips the DMA ⇒ only ceil(len/block_s)
+        # blocks of cache are actually read per sequence
+        last = jnp.maximum(
+            jax.lax.div(lens[b] + block_s - 1, block_s) - 1, 0)
+        return (b, jnp.minimum(sb, last), h, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, group, head_dim),
+                             lambda b, h, sb, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, block_s, 1, head_dim), kv_index),
+                pl.BlockSpec((1, block_s, 1, head_dim), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, 1, group, head_dim),
+                                   lambda b, h, sb, lens: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, head_dim), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(cache_len.astype(jnp.int32), qt, k_cache, v_cache)
+
+    return out.reshape(batch, 1, q_heads, head_dim)
