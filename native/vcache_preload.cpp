@@ -13,17 +13,23 @@
 //   TPU9_VCACHE_STATS=/tmp/vcache-stats   (optional; hit/miss counters
 //                                          appended on process exit)
 //
-// open()/open64()/fopen()/stat() of a path under a mapped prefix is
+// open()/open64()/fopen()/fopen64() of a path under a mapped prefix is
 // redirected to the cache copy when one exists (the worker materializes hot
 // volume files into the cache dir via hardlinks, so a hit is a local-disk
 // open). Writes and missing files fall through to the real path — the shim
 // is a read accelerator, never a correctness layer.
+//
+// The stat() family is intentionally NOT interposed: cache entries must be
+// byte-identical materializations (hardlinks) of the volume file so
+// stat-then-read consumers see consistent sizes. Mismatched cache copies are
+// an operator error.
 
 #include <atomic>
 #include <cstdarg>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -52,8 +58,9 @@ open_fn real_open64 = nullptr;
 fopen_fn real_fopen = nullptr;
 fopen_fn real_fopen64 = nullptr;
 
-void init_once() {
-  if (mappings != nullptr) return;
+std::once_flag g_init_flag;
+
+void init_impl() {
   auto* m = new std::vector<Mapping>();
   const char* raw = getenv("TPU9_VCACHE_MAP");
   if (raw != nullptr) {
@@ -74,8 +81,12 @@ void init_once() {
   real_open64 = reinterpret_cast<open_fn>(dlsym(RTLD_NEXT, "open64"));
   real_fopen = reinterpret_cast<fopen_fn>(dlsym(RTLD_NEXT, "fopen"));
   real_fopen64 = reinterpret_cast<fopen_fn>(dlsym(RTLD_NEXT, "fopen64"));
-  mappings = m;
+  mappings = m;   // publish last: readers go through init_once's call_once
 }
+
+// Thread-safe: concurrent first opens from multiple threads must not observe
+// a half-built mapping table or null function pointers.
+void init_once() { std::call_once(g_init_flag, init_impl); }
 
 // Returns the cache path when `path` is under a mapped prefix AND the cache
 // copy exists; empty string otherwise.

@@ -78,6 +78,10 @@ class WorkerConfig:
     storage_root: str = "/tmp/tpu9/workspaces"   # volume/object share
     logs_dir: str = "/tmp/tpu9/logs"
     checkpoint_dir: str = "/tmp/tpu9/checkpoints"
+    # path to the built vcache_preload.so; when set, containers with volume
+    # mounts read volume files through the node cache (LD_PRELOAD shim)
+    vcache_so: str = ""
+    vcache_dir: str = "/tmp/tpu9/vcache"
     failover_max_pending: int = 10
     failover_max_scheduling_latency_ms: float = 5000.0
 

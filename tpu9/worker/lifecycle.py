@@ -283,6 +283,31 @@ class ContainerLifecycle:
             env["TPU9_RESTORED"] = "1"
         if image_site:
             env["PYTHONPATH"] = (env["PYTHONPATH"] + os.pathsep + image_site)
+
+        # volume-cache LD_PRELOAD shim (reference file_cache.go:21-24 injects
+        # volume_cache.so + VOLUME_CACHE_MAP the same way): reads of volume
+        # files hit the node-local cache copy when one exists
+        volume_targets = [m for m in request.mounts
+                          if m.kind == "volume" and m.target]
+        # ProcessRuntime only: under runc the .so and cache dirs live outside
+        # the rootfs — injecting host paths would just make ld.so error on
+        # every exec (bind-mount wiring for OCI is in ROADMAP.md)
+        if self.cfg.vcache_so and os.path.exists(self.cfg.vcache_so) \
+                and volume_targets and self.runtime.name == "process":
+            pairs = []
+            for m in volume_targets:
+                if "/" in m.source or m.source in ("", ".", ".."):
+                    continue   # same containment contract as _safe_volume_dir
+                cache_dir = os.path.join(self.cfg.vcache_dir,
+                                         request.workspace_id, m.source)
+                os.makedirs(cache_dir, exist_ok=True)
+                # the shim sees the path as the container does: under the
+                # workdir for the process runtime
+                container_path = os.path.join(workdir, m.target.lstrip("/"))
+                pairs.append(f"{container_path}={cache_dir}")
+            env["LD_PRELOAD"] = (self.cfg.vcache_so + ":"
+                                 + env.get("LD_PRELOAD", "")).rstrip(":")
+            env["TPU9_VCACHE_MAP"] = ":".join(pairs)
         devices: list[str] = []
         if assignment is not None:
             env.update(assignment.env)
