@@ -120,3 +120,32 @@ async def test_rest_api_surfaces():
         assert status == 200
         status, names = await stack.api("GET", "/api/v1/secret")
         assert names == ["K"]
+
+
+async def test_subdomain_routing():
+    """Host-header routing (reference middleware/subdomain.go): the
+    deployment's subdomain resolves without a path-based route or token when
+    the stub is public."""
+    import aiohttp
+    async with LocalStack() as stack:
+        object_id = await stack.upload_workspace(
+            {"app.py": "def handler(**kw):\n    return {'via': 'subdomain'}\n"})
+        _, out = await stack.api("POST", "/rpc/stub/get-or-create", json_body={
+            "name": "pub", "stub_type": "endpoint",
+            "config": {"handler": "app:handler", "authorized": False,
+                       "keep_warm_seconds": 2.0},
+            "object_id": object_id})
+        _, dep = await stack.api("POST", "/rpc/deploy", json_body={
+            "stub_id": out["stub_id"], "name": "pub"})
+        sub = dep["subdomain"]          # globally unique: name-version-wstag
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{stack.base_url}/",
+                              headers={"Host": f"{sub}.tpu9.example"},
+                              json={}) as resp:
+                assert resp.status == 200, await resp.text()
+                assert (await resp.json()) == {"via": "subdomain"}
+            # unknown subdomain → 404
+            async with s.post(f"{stack.base_url}/",
+                              headers={"Host": "nope-9.tpu9.example"},
+                              json={}) as resp:
+                assert resp.status == 404

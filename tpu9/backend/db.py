@@ -202,9 +202,12 @@ class BackendDB:
             "SELECT MAX(version) AS v FROM deployments WHERE workspace_id=? AND name=?",
             (workspace_id, name))
         version = (rows[0]["v"] or 0) + 1
+        # subdomain must be globally unique: two workspaces deploying the
+        # same name must not collide on the public Host-header route
+        ws_tag = hashlib.sha256(workspace_id.encode()).hexdigest()[:6]
         dep = Deployment(deployment_id=new_id("dep"), name=name, stub_id=stub_id,
                          workspace_id=workspace_id, app_id=app_id, version=version,
-                         subdomain=f"{name}-{version}")
+                         subdomain=f"{name}-{version}-{ws_tag}")
         with self._lock, self._conn:
             self._conn.execute(
                 "UPDATE deployments SET active=0 WHERE workspace_id=? AND name=?",

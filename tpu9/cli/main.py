@@ -301,6 +301,41 @@ def shell(container_id: str) -> None:
             click.echo(f"[exit {out.get('exit_code')}]")
 
 
+@cli.command("startup-report")
+def startup_report() -> None:
+    """Cold-start phase latency report across the fleet (reference
+    benchmarks/sandbox_startup_report.py): p50/p95/max per lifecycle phase."""
+    data = _client()._run(lambda c: c.request("GET", "/api/v1/metrics"))
+    rows: dict[str, dict] = {}
+    # embedded-worker topologies share one registry: the gateway's top-level
+    # view already contains the shipped worker snapshots — don't double-count
+    worker_ids = set(data.get("workers", {}).keys())
+    top_gauges = data.get("gauges", {})
+    embedded = any(f'worker="{wid}"' in g for wid in worker_ids
+                   for g in top_gauges)
+    sources = list(data.get("workers", {}).values())
+    if not embedded:
+        sources.append(data)
+    for src in sources:
+        for key, snap in src.get("summaries", {}).items():
+            if "tpu9_startup_phase_s" not in key:
+                continue
+            phase = key.split('phase="')[-1].rstrip('"}')
+            cur = rows.setdefault(phase, {"count": 0, "p50": 0.0,
+                                          "p95": 0.0, "max": 0.0})
+            cur["count"] += snap["count"]
+            cur["p50"] = max(cur["p50"], snap["p50"])
+            cur["p95"] = max(cur["p95"], snap["p95"])
+            cur["max"] = max(cur["max"], snap["max"])
+    if not rows:
+        click.echo("no startup phases recorded yet")
+        return
+    click.echo(f"{'phase':<28}{'count':>7}{'p50':>10}{'p95':>10}{'max':>10}")
+    for phase, r in sorted(rows.items(), key=lambda kv: kv[1]['p50']):
+        click.echo(f"{phase:<28}{r['count']:>7}{r['p50']*1000:>9.1f}ms"
+                   f"{r['p95']*1000:>9.1f}ms{r['max']*1000:>9.1f}ms")
+
+
 @cli.command("metrics")
 @click.option("--prometheus", is_flag=True)
 def metrics_cmd(prometheus: bool) -> None:

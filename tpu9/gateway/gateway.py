@@ -175,6 +175,10 @@ class Gateway:
         # invoke
         r.add_route("*", "/endpoint/{name}", self._invoke)
         r.add_route("*", "/endpoint/{name}/{tail:.*}", self._invoke)
+        # subdomain routing (reference middleware/subdomain.go:30): a request
+        # whose Host is <subdomain>.<anything> hits its deployment directly.
+        # Registered last so explicit routes win.
+        r.add_route("*", "/{tail:.*}", self._subdomain_invoke)
         return app
 
     # -- lifecycle -----------------------------------------------------------
@@ -273,8 +277,14 @@ class Gateway:
             token = auth[len("Bearer "):]
         tok = await self.backend.authorize_token(token) if token else None
         if tok is None:
-            # invoke routes may be public when the stub is unauthorized
-            if request.path.startswith("/endpoint/"):
+            # explicit allowlist of maybe-public surfaces: named invoke
+            # routes and the subdomain catch-all (which 404s unknown hosts);
+            # everything else is auth-required by default (fail closed)
+            route_handler = getattr(request.match_info, "handler", None)
+            # bound-method comparison needs ==, not `is` (fresh object per
+            # attribute access)
+            if (request.path.startswith("/endpoint/")
+                    or route_handler == self._subdomain_invoke):
                 request["workspace"] = None
                 return await handler(request)
             return web.json_response({"error": "unauthorized"}, status=401)
@@ -405,6 +415,7 @@ class Gateway:
                       f"/endpoint/{dep.name}")
         return web.json_response({"deployment_id": dep.deployment_id,
                                   "version": dep.version,
+                                  "subdomain": dep.subdomain,
                                   "invoke_url": invoke_url})
 
     async def _rpc_serve(self, request: web.Request) -> web.Response:
@@ -806,6 +817,16 @@ class Gateway:
 
     # -- handlers: invoke ------------------------------------------------------
 
+    async def _subdomain_invoke(self, request: web.Request) -> web.Response:
+        host = request.headers.get("Host", "").split(":")[0]
+        sub = host.split(".")[0] if "." in host else ""
+        dep = await self.backend.get_deployment_by_subdomain(sub) if sub \
+            else None
+        if dep is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return await self._serve_deployment(
+            request, dep, request.match_info.get("tail", ""))
+
     async def _invoke(self, request: web.Request) -> web.Response:
         name = request.match_info["name"]
         tail = request.match_info.get("tail", "")
@@ -822,6 +843,11 @@ class Gateway:
         if dep is None:
             return web.json_response({"error": f"no deployment {name!r}"},
                                      status=404)
+        return await self._serve_deployment(request, dep, tail)
+
+    async def _serve_deployment(self, request: web.Request, dep,
+                                tail: str) -> web.Response:
+        ws = request.get("workspace")
         stub = await self.backend.get_stub(dep.stub_id)
         if stub is None:
             return web.json_response({"error": "stub missing"}, status=500)
