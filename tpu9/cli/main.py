@@ -80,6 +80,98 @@ def deploy(target: str, name: str) -> None:
 
 
 @cli.command()
+@click.argument("target")
+@click.option("--name", default="dev")
+@click.option("--watch/--no-watch", default=True)
+def serve(target: str, name: str, watch: bool) -> None:
+    """Hot-reload dev loop (reference ``beta9 serve``): start an ephemeral
+    serve session, tail its container logs, re-sync on source change. Uses
+    /rpc/serve (no persistent deployment rows) and survives broken edits."""
+    import time as _time
+
+    from ..sdk.sync import _ignored
+
+    client = _client()
+
+    def snapshot(root: str = ".") -> dict:
+        # watch exactly what build_archive would sync (sync.py ignore rules)
+        out = {}
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if not _ignored(d)]
+            for fn in filenames:
+                if _ignored(fn):
+                    continue
+                p = os.path.join(dirpath, fn)
+                try:
+                    out[p] = os.path.getmtime(p)
+                except OSError:
+                    pass
+        return out
+
+    session_deployments: list[str] = []
+
+    def do_serve():
+        obj = _load_target(target)
+        stub_id = obj.prepare_runtime(force=True)
+        # a deployment row gives /endpoint/<name> routability; the session
+        # deactivates its rows on exit so dev churn doesn't accumulate
+        out = client.deploy(stub_id, name)
+        session_deployments.append(out["deployment_id"])
+        click.echo(f"→ serving {name} v{out['version']} at "
+                   f"{out['invoke_url']}")
+        return obj, stub_id
+
+    mtimes = snapshot()
+    obj, stub_id = do_serve()
+    seen_logs: dict[str, str] = {}
+    last_error = ""
+    click.echo("watching for changes (Ctrl-C to stop)...")
+    try:
+        while True:
+            _time.sleep(1.0)
+            # tail logs of this stub's containers (incremental via since=)
+            try:
+                containers = client._run(lambda c: c.request(
+                    "GET", "/api/v1/container"))
+                for ct in containers:
+                    if ct.get("stub_id") != stub_id:
+                        continue
+                    cid = ct["container_id"]
+                    since = seen_logs.get(cid, "0")
+                    logs = client._run(lambda c: c.request(
+                        "GET", f"/api/v1/container/{cid}/logs?since={since}"))
+                    for entry in logs:
+                        click.echo(f"[{cid[:10]}] {entry['line']}")
+                        seen_logs[cid] = entry["id"]
+                last_error = ""
+            except Exception as exc:
+                msg = f"{type(exc).__name__}: {exc}"
+                if msg != last_error:   # surface once, don't spam
+                    click.echo(f"[serve] log tail failing: {msg}")
+                    last_error = msg
+            if watch:
+                now = snapshot()
+                if now != mtimes:
+                    mtimes = now        # baseline BEFORE deploying so edits
+                    click.echo("… change detected, reloading")
+                    try:                # during deploy retrigger next tick
+                        obj, stub_id = do_serve()
+                    except Exception as exc:
+                        # broken edit or transient gateway error: keep
+                        # watching; the next save retries
+                        click.echo(f"[serve] reload failed: "
+                                   f"{type(exc).__name__}: {exc}")
+    except KeyboardInterrupt:
+        click.echo("\nserve loop stopped; cleaning up session deployments")
+        for dep_id in session_deployments:
+            try:
+                client._run(lambda c: c.request(
+                    "DELETE", f"/api/v1/deployment/{dep_id}"))
+            except Exception:
+                pass
+
+
+@cli.command()
 @click.argument("name")
 @click.argument("payload", default="{}")
 def invoke(name: str, payload: str) -> None:

@@ -963,7 +963,9 @@ class Gateway:
 
     async def _container_logs(self, request: web.Request) -> web.Response:
         self._ws(request)
-        entries = await self.containers.read_logs(request.match_info["id"])
+        since = request.query.get("since", "0")
+        entries = await self.containers.read_logs(request.match_info["id"],
+                                                  last_id=since)
         return web.json_response(
             [{"id": eid, **e} for eid, e in entries])
 
