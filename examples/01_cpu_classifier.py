@@ -1,0 +1,43 @@
+"""Baseline config #1: CPU-only sentiment endpoint (distilbert-class model),
+single container, scale-to-zero.
+
+    tpu9 deploy examples/01_cpu_classifier.py:classify --name sentiment
+    curl -X POST $GW/endpoint/sentiment -H "Authorization: Bearer $TOK" \
+         -d '{"text": "tpu9 is great"}'
+"""
+
+from tpu9 import endpoint
+
+
+def load_model():
+    """Loads once per container (on_start); HF pipeline when the image
+    bundles transformers + weights, tiny JAX classifier otherwise."""
+    import os
+    try:
+        # no network retries when the hub cache is cold (zero-egress images)
+        os.environ.setdefault("HF_HUB_OFFLINE", "1")
+        from transformers import pipeline
+        return pipeline("sentiment-analysis",
+                        model="distilbert-base-uncased-finetuned-sst-2-english")
+    except Exception:
+        import jax
+        from tpu9.models.classifier import (TEXTCLS_TINY, classifier_forward,
+                                            init_classifier)
+        params = init_classifier(jax.random.PRNGKey(0), TEXTCLS_TINY)
+
+        def tiny(text: str):
+            import jax.numpy as jnp
+            tokens = jnp.array([[hash(w) % TEXTCLS_TINY.vocab_size
+                                 for w in text.split()[:32]] or [0]])
+            mask = jnp.ones_like(tokens)
+            logits = classifier_forward(params, tokens, mask, TEXTCLS_TINY)
+            label = int(logits.argmax())
+            return [{"label": ["NEGATIVE", "POSITIVE"][label],
+                     "score": float(jax.nn.softmax(logits)[0, label])}]
+
+        return tiny
+
+
+@endpoint(cpu=1, memory="2Gi", keep_warm_seconds=60, on_start=load_model)
+def classify(text: str = "", context=None):
+    return {"prediction": context(text)[0]}

@@ -1,0 +1,43 @@
+"""Baseline config #4: Llama-3-70B pjit tensor-parallel on a v5e-8 slice,
+token-pressure autoscaled.
+
+The worker hands the container all 8 chips of the host slice; the handler
+builds a tp=8 mesh from the slice topology and shards the params with the
+Megatron-style specs — GSPMD inserts the ICI collectives.
+
+    tpu9 deploy examples/04_llama70b_tp_v5e8.py:llama70b --name llama70b
+"""
+
+from tpu9 import TokenPressureAutoscaler, Volume, endpoint
+
+
+def load_engine():
+    import jax
+    from tpu9.models import init_decoder
+    from tpu9.models.llama import LLAMA_PRESETS
+    from tpu9.parallel import decoder_param_specs, mesh_for_spec, shard_params
+    from tpu9.serving import EngineConfig, InferenceEngine
+    from tpu9.types import parse_tpu_spec
+
+    cfg = LLAMA_PRESETS["llama3-70b"]
+    spec = parse_tpu_spec("v5e-8")
+    mesh = mesh_for_spec(spec)          # tp=8 on the host's ICI
+
+    params = init_decoder(jax.random.PRNGKey(0), cfg)   # volume loader IRL
+    params = shard_params(params, mesh, decoder_param_specs(params))
+
+    # the engine's jitted prefill/decode inherit the param shardings; each
+    # request is served by all 8 chips cooperatively
+    engine = InferenceEngine(params, cfg, EngineConfig(
+        max_batch=16, max_seq_len=4096, prefill_buckets=(512, 2048, 4096)))
+    engine.mesh = mesh
+    return engine
+
+
+llama70b = endpoint(
+    tpu="v5e-8", cpu=16, memory="100Gi", runner="llm",
+    keep_warm_seconds=600,
+    autoscaler=TokenPressureAutoscaler(max_containers=4,
+                                       max_token_pressure=0.85),
+    volumes=[Volume(name="llama3-70b", mount_path="/models/llama3-70b")],
+)(load_engine)

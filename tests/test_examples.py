@@ -1,0 +1,66 @@
+"""The five baseline-config examples must at least construct valid stubs
+(import-time decorator validation: tpu specs, autoscalers, volumes)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def load(name):
+    path = os.path.join(EXAMPLES, name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name[:-3]] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cpu_classifier_config():
+    mod = load("01_cpu_classifier.py")
+    assert mod.classify.stub_type == "endpoint"
+    assert mod.classify.config.runtime.tpu == ""
+    assert mod.classify.config.runtime.cpu_millicores == 1000
+    # the fallback tiny model path must actually work (no transformers net)
+    ctx = mod.load_model()
+    out = ctx("great stuff") if not hasattr(ctx, "task") else None
+    if out is not None:
+        assert out[0]["label"] in ("POSITIVE", "NEGATIVE")
+
+
+def test_llama_v5e1_config():
+    mod = load("02_llama_v5e1.py")
+    assert mod.llama.config.runtime.tpu == "v5e-1"
+    assert mod.llama.config.extra["runner"] == "llm"
+    assert mod.llama.config.checkpoint.enabled
+    assert mod.llama.config.volumes[0]["mount_path"] == "/models/llama3-8b"
+
+
+def test_clip_fanout_config():
+    mod = load("03_clip_fanout.py")
+    assert mod.embed_image.stub_type == "taskqueue"
+    assert mod.embed_image.config.runtime.tpu == "v5e-1"
+    assert mod.embed_image.config.autoscaler.max_containers == 16
+    assert mod.embed_image.config.autoscaler.tasks_per_container == 4
+
+
+def test_llama70b_tp_config():
+    mod = load("04_llama70b_tp_v5e8.py")
+    assert mod.llama70b.config.runtime.tpu == "v5e-8"
+    assert mod.llama70b.config.autoscaler.type == "token_pressure"
+    from tpu9.types import parse_tpu_spec
+    assert parse_tpu_spec(mod.llama70b.config.runtime.tpu).chips == 8
+
+
+def test_gemma_lora_config():
+    mod = load("05_gemma_lora_v5p64.py")
+    assert mod.finetune.stub_type == "function"
+    spec_ = mod.finetune.config.runtime
+    assert spec_.tpu == "v5p-64"
+    from tpu9.types import parse_tpu_spec
+    s = parse_tpu_spec(spec_.tpu)
+    assert s.hosts == 16 and s.multi_host
+    assert mod.finetune.config.timeout_s == 4 * 3600

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import asyncio
 import os
-import resource
 import shutil
 import signal
 from typing import Optional
@@ -46,16 +45,13 @@ class ProcessRuntime(Runtime):
 
         workdir = spec.workdir if spec.workdir not in ("", "/") else sandbox
 
-        limit_bytes = spec.memory_mb * 1024 * 1024 if spec.memory_mb else 0
-
         def preexec() -> None:
             os.setsid()  # own process group so kill() reaps the whole tree
-            if limit_bytes:
-                try:
-                    resource.setrlimit(resource.RLIMIT_AS,
-                                       (limit_bytes, limit_bytes))
-                except (ValueError, OSError):
-                    pass
+            # NOTE: no RLIMIT_AS — jax/TF reserve address space far beyond
+            # their RSS, so an AS cap spuriously kills ML containers at
+            # import. Memory is enforced as RSS by the worker's OOM watcher
+            # (reference pkg/runtime/oom_watcher.go), which SIGKILLs over-
+            # limit containers → exit 137 → normalized to an OOM stop reason.
 
         proc = await asyncio.create_subprocess_exec(
             *spec.entrypoint, cwd=workdir, env=env,

@@ -57,6 +57,16 @@ class ContainerLifecycle:
         self.phase_cb = phase_cb
         self._active: dict[str, asyncio.Task] = {}
         self._exited: dict[str, int] = {}
+        # containers being started or running, with their memory limits —
+        # the OOM watcher polices this set from the moment of spawn
+        self.memory_limits: dict[str, int] = {}
+        # stop reasons decided in-process (OOM watcher, stop_container)
+        # consumed by the supervisor at exit — avoids read-modify-write races
+        # on the shared container state
+        self._pending_reasons: dict[str, str] = {}
+
+    def note_stop_reason(self, container_id: str, reason: str) -> None:
+        self._pending_reasons[container_id] = reason
 
     def _phase(self, container_id: str, phase: LifecyclePhase, t0: float) -> None:
         if self.phase_cb:
@@ -76,6 +86,7 @@ class ContainerLifecycle:
             gang_id=request.gang.gang_id if request.gang else "")
         await self.containers.update_state(state)
         self._phase(container_id, LifecyclePhase.WORKER_RECEIVED, t0)
+        self.memory_limits[container_id] = request.memory_mb
 
         try:
             # image materialization ∥ workspace fetch (lifecycle.go:355-368)
@@ -150,6 +161,7 @@ class ContainerLifecycle:
             except Exception:
                 pass
             self.tpu.release(container_id)
+            self.memory_limits.pop(container_id, None)
             state.status = ContainerStatus.FAILED.value
             state.stop_reason = StopReason.EXIT.value
             state.exit_code = 1
@@ -163,19 +175,30 @@ class ContainerLifecycle:
         code = await self.runtime.wait(container_id)
         self._exited[container_id] = code
         self.tpu.release(container_id)
+        # the authoritative stop reason: locally-noted (OOM watcher / stop
+        # requests) wins, then the live state's, then exit-code inference
+        live = await self.containers.get_state(container_id)
+        if live is not None:
+            state = live
+        noted = self._pending_reasons.pop(container_id, "")
         state.status = (ContainerStatus.STOPPED.value if code == 0
                         else ContainerStatus.FAILED.value)
-        # normalize 137 → OOM the way the reference does (lifecycle.go:1539)
-        state.stop_reason = (StopReason.OOM.value if code == 137
-                             else state.stop_reason or StopReason.EXIT.value)
+        reason = noted or state.stop_reason
+        if not reason and code in (137, -9):
+            # normalize SIGKILL exits → OOM like the reference's 137
+            # handling (lifecycle.go:1539); asyncio reports them as -signum
+            reason = StopReason.OOM.value
+        state.stop_reason = reason or StopReason.EXIT.value
         state.exit_code = code
         await self.containers.update_state(state)
         await self.containers.set_exit_code(container_id, code,
                                             state.stop_reason)
         self._active.pop(container_id, None)
+        self.memory_limits.pop(container_id, None)
 
     async def stop_container(self, container_id: str,
                              reason: str = StopReason.USER.value) -> bool:
+        self.note_stop_reason(container_id, reason)
         state = await self.containers.get_state(container_id)
         if state:
             state.status = ContainerStatus.STOPPING.value

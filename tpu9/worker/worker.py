@@ -131,19 +131,19 @@ class Worker:
         from ..observability import metrics
         while not self._stopping.is_set():
             await self.workers.touch_keepalive(self.worker_id)
-            for container_id in self.lifecycle.active_ids():
-                await self.containers.refresh_ttl(container_id)
-                # per-container usage sampling (usage.go equivalent)
-                handle = await self.runtime.state(container_id)
-                if handle is not None and handle.pid:
-                    try:
-                        p = psutil.Process(handle.pid)
-                        metrics.set_gauge(
-                            "tpu9_container_rss_mb",
-                            p.memory_info().rss / 2**20,
-                            {"container": container_id})
-                    except (psutil.NoSuchProcess, psutil.AccessDenied):
-                        pass
+            # police every container with a known limit — including ones
+            # still cold-starting (registered at spawn, before readiness)
+            for container_id, limit in list(
+                    self.lifecycle.memory_limits.items()):
+                try:
+                    if container_id in self.lifecycle.active_ids():
+                        await self.containers.refresh_ttl(container_id)
+                    await self._police_container(container_id, limit, metrics)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:   # keepalive must survive hiccups
+                    log.debug("usage sample failed for %s: %s", container_id,
+                              exc)
             metrics.set_gauge("tpu9_worker_active_containers",
                               len(self.lifecycle.active_ids()),
                               {"worker": self.worker_id})
@@ -155,6 +155,36 @@ class Worker:
                                  _json.dumps(metrics.to_dict()),
                                  ttl=self.cfg.keepalive_ttl_s * 2)
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    async def _police_container(self, container_id: str, limit: int,
+                                metrics) -> None:
+        """RSS usage sampling + OOM enforcement
+        (usage.go + pkg/runtime/oom_watcher.go): resident memory of the
+        process tree, not address space, is the limit."""
+        handle = await self.runtime.state(container_id)
+        if handle is None or not handle.pid or handle.exit_code is not None:
+            return
+        try:
+            p = psutil.Process(handle.pid)
+            rss = p.memory_info().rss
+            for child in p.children(recursive=True):
+                try:
+                    rss += child.memory_info().rss
+                except (psutil.NoSuchProcess, psutil.AccessDenied):
+                    pass
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            return
+        rss_mb = rss / 2**20
+        metrics.set_gauge("tpu9_container_rss_mb", rss_mb,
+                          {"container": container_id})
+        if limit and rss_mb > limit:
+            log.warning("container %s over memory limit (%.0f/%d MB) — "
+                        "OOM kill", container_id, rss_mb, limit)
+            # note the reason only if we actually delivered the kill — a
+            # clean exit racing the sample must not be recorded as OOM
+            if await self.runtime.kill(container_id, 9):
+                self.lifecycle.note_stop_reason(container_id,
+                                                StopReason.OOM.value)
 
     async def _request_loop(self) -> None:
         last_id = "0"
