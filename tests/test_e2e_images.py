@@ -59,16 +59,19 @@ async def test_image_chunks_served_via_cache_peers():
             "commands":
                 ["mkdir -p env && head -c 3000000 /dev/urandom > env/blob.bin"]})
         w1 = await stack._worker_factory()
-        w2 = await stack._worker_factory()
         manifest = await stack._manifest_fetch(image_id)
         # give each worker a private bundle dir so both actually pull
         w1.cache.puller.bundles_dir = os.path.join(stack.tmp.name, "b1")
-        w2.cache.puller.bundles_dir = os.path.join(stack.tmp.name, "b2")
         os.makedirs(w1.cache.puller.bundles_dir, exist_ok=True)
-        os.makedirs(w2.cache.puller.bundles_dir, exist_ok=True)
 
         b1 = await w1.cache.puller.pull(image_id, manifest=manifest)
         assert w1.cache.client.stats["source_fetches"] > 0
+        # w2 joins only now: had it been registered during w1's pull, w1's
+        # source fetch would asynchronously seed the canonical HRW holder
+        # (often w2), turning w2's read into a local hit at random
+        w2 = await stack._worker_factory()
+        w2.cache.puller.bundles_dir = os.path.join(stack.tmp.name, "b2")
+        os.makedirs(w2.cache.puller.bundles_dir, exist_ok=True)
         b2 = await w2.cache.puller.pull(image_id, manifest=manifest)
         assert w2.cache.client.stats["peer_hits"] > 0, w2.cache.client.stats
         assert filecmp.cmp(os.path.join(b1, "env", "blob.bin"),

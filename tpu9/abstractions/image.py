@@ -26,13 +26,19 @@ class ImageService:
         self._builds: dict[str, asyncio.Task] = {}
         self._logs: dict[str, list[str]] = {}
 
-    async def verify(self, spec: ImageSpec) -> dict:
-        """Does this spec already have a built image? (VerifyImageBuild)"""
-        return {"image_id": spec.image_id,
-                "exists": self.builder.has_image(spec.image_id)}
+    async def verify(self, spec: ImageSpec,
+                     workspace_id: str = "") -> dict:
+        """Does this spec already have a built image? (VerifyImageBuild)
+        Knowing the full spec proves buildability, so a dedupe hit grants the
+        caller's workspace read access to the shared image."""
+        exists = self.builder.has_image(spec.image_id)
+        if exists and workspace_id:
+            await self.backend.grant_image_access(spec.image_id, workspace_id)
+        return {"image_id": spec.image_id, "exists": exists}
 
     async def build(self, workspace_id: str, spec: ImageSpec) -> dict:
         image_id = spec.image_id
+        await self.backend.grant_image_access(image_id, workspace_id)
         if self.builder.has_image(image_id):
             return {"image_id": image_id, "status": "ready"}
         if image_id not in self._builds or self._builds[image_id].done():

@@ -78,12 +78,17 @@ class PodService:
         import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            state = await self.containers.get_state(container_id)
+            # follow gang-rollback reschedules: the id we handed out may have
+            # been retired in favour of a fresh one
+            live_id = await self.containers.resolve(container_id)
+            state = await self.containers.get_state(live_id)
             if state is not None:
                 if state.status == ContainerStatus.RUNNING.value:
                     return state.address
-                if state.status in (ContainerStatus.FAILED.value,
-                                    ContainerStatus.STOPPED.value):
+                if (state.status in (ContainerStatus.FAILED.value,
+                                     ContainerStatus.STOPPED.value)
+                        and live_id == await self.containers.resolve(
+                            container_id)):
                     return None
             await asyncio.sleep(0.05)
         return None
@@ -92,6 +97,7 @@ class PodService:
 
     async def exec(self, container_id: str, cmd: list[str],
                    timeout: float = 60.0) -> dict:
+        container_id = await self.containers.resolve(container_id)
         state = await self.containers.get_state(container_id)
         if state is None or not state.worker_id:
             return {"error": "container not found", "exit_code": -1}

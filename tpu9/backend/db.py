@@ -341,6 +341,22 @@ class BackendDB:
         d["spec"] = json.loads(d.pop("spec_json"))
         return d
 
+    async def grant_image_access(self, image_id: str,
+                                 workspace_id: str) -> None:
+        """Images dedupe globally by content-derived id; a workspace whose
+        build deduped onto an existing image gets an access row instead of a
+        second owner row."""
+        self._exec(
+            "INSERT OR IGNORE INTO image_access (image_id, workspace_id, created_at) VALUES (?,?,?)",
+            (image_id, workspace_id, now()))
+
+    async def has_image_access(self, image_id: str,
+                               workspace_id: str) -> bool:
+        rows = self._query(
+            "SELECT 1 FROM image_access WHERE image_id=? AND workspace_id=?",
+            (image_id, workspace_id))
+        return bool(rows)
+
     # -- checkpoints --------------------------------------------------------
 
     async def create_checkpoint(self, stub_id: str, workspace_id: str,

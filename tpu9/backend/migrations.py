@@ -156,4 +156,12 @@ MIGRATIONS: list[tuple[int, str, str]] = [
             created_at REAL NOT NULL
         );
     """),
+    (14, "image_access", """
+        CREATE TABLE image_access (
+            image_id TEXT NOT NULL,
+            workspace_id TEXT NOT NULL,
+            created_at REAL NOT NULL,
+            PRIMARY KEY (image_id, workspace_id)
+        );
+    """),
 ]

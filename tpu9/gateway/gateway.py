@@ -566,14 +566,8 @@ class Gateway:
     # -- handlers: pods ---------------------------------------------------------
 
     async def _pod_container_for(self, request: web.Request):
-        ws = self._ws(request)
-        container_id = request.match_info["container_id"]
-        state = await self.containers.get_state(container_id)
-        if state is None or state.workspace_id != ws.workspace_id:
-            raise web.HTTPNotFound(
-                text=json.dumps({"error": "container not found"}),
-                content_type="application/json")
-        return state
+        return await self._container_for(request, key="container_id",
+                                         allow_worker=False)
 
     async def _rpc_pod_create(self, request: web.Request) -> web.Response:
         data = await request.json()
@@ -785,9 +779,10 @@ class Gateway:
     # -- handlers: images ------------------------------------------------------
 
     async def _rpc_image_verify(self, request: web.Request) -> web.Response:
-        self._ws(request)
+        ws = self._ws(request)
         spec = ImageSpec.from_dict(await request.json())
-        return web.json_response(await self.images.verify(spec))
+        return web.json_response(
+            await self.images.verify(spec, workspace_id=ws.workspace_id))
 
     async def _rpc_image_build(self, request: web.Request) -> web.Response:
         ws = self._ws(request)
@@ -795,21 +790,53 @@ class Gateway:
         return web.json_response(await self.images.build(ws.workspace_id,
                                                          spec))
 
+    async def _image_access_ok(self, request: web.Request,
+                               image_id: str) -> bool:
+        """Workspace scoping for image reads: worker tokens (the pullers)
+        see everything, user tokens only their own workspace's images.
+        Manifests bake in spec.env so cross-tenant reads leak secrets."""
+        if request.get("is_worker"):
+            return True
+        ws = self._ws(request)
+        row = await self.backend.get_image(image_id)
+        if row is not None and row["workspace_id"] == ws.workspace_id:
+            return True
+        # dedupe case: the build/verify call granted an access row even
+        # though another workspace owns the image record
+        return await self.backend.has_image_access(image_id, ws.workspace_id)
+
     async def _rpc_image_status(self, request: web.Request) -> web.Response:
-        self._ws(request)
-        return web.json_response(
-            await self.images.status(request.match_info["image_id"]))
+        image_id = request.match_info["image_id"]
+        if not await self._image_access_ok(request, image_id):
+            return web.json_response({"error": "image not found"}, status=404)
+        return web.json_response(await self.images.status(image_id))
 
     async def _rpc_image_manifest(self, request: web.Request) -> web.Response:
-        self._ws(request)
-        blob = self.images.manifest_json(request.match_info["image_id"])
+        image_id = request.match_info["image_id"]
+        if not await self._image_access_ok(request, image_id):
+            return web.json_response({"error": "image not found"}, status=404)
+        blob = self.images.manifest_json(image_id)
         if blob is None:
             return web.json_response({"error": "image not found"}, status=404)
         return web.Response(text=blob, content_type="application/json")
 
     async def _rpc_image_chunk(self, request: web.Request) -> web.Response:
+        # Chunks are content-addressed and shared across images, so a bare
+        # digest can't be workspace-scoped. Workers (the only pull path) may
+        # read any chunk; user tokens must name an image they own whose
+        # manifest actually contains the digest.
         self._ws(request)
-        data = self.images.chunk(request.match_info["digest"])
+        digest = request.match_info["digest"]
+        if not request.get("is_worker"):
+            image_id = request.query.get("image_id", "")
+            if not await self._image_access_ok(request, image_id):
+                return web.json_response({"error": "chunk not found"},
+                                         status=404)
+            m = self.images.builder.load_manifest(image_id)
+            if m is None or digest not in m.all_chunks():
+                return web.json_response({"error": "chunk not found"},
+                                         status=404)
+        data = self.images.chunk(digest)
         if data is None:
             return web.json_response({"error": "chunk not found"}, status=404)
         return web.Response(body=data,
@@ -956,15 +983,43 @@ class Gateway:
                 out.append(st.to_dict())
         return web.json_response(out)
 
+    async def _container_for(self, request: web.Request, key: str = "id",
+                             allow_worker: bool = True):
+        """Workspace-scoped container lookup — 404 on missing or foreign
+        containers. ``allow_worker`` lets worker tokens act cross-workspace
+        like the reference's repo-over-gRPC services."""
+        ws = self._ws(request)
+        container_id = await self.containers.resolve(
+            request.match_info[key])
+        state = await self.containers.get_state(container_id)
+        worker_ok = allow_worker and request.get("is_worker")
+        if state is None or (not worker_ok
+                             and state.workspace_id != ws.workspace_id):
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "container not found"}),
+                content_type="application/json")
+        return state
+
     async def _stop_container(self, request: web.Request) -> web.Response:
-        self._ws(request)
-        ok = await self.scheduler.stop_container(request.match_info["id"])
+        state = await self._container_for(request)
+        ok = await self.scheduler.stop_container(state.container_id)
         return web.json_response({"ok": ok})
 
     async def _container_logs(self, request: web.Request) -> web.Response:
-        self._ws(request)
+        # post-mortem reads must outlive the 60 s state TTL: fall back to the
+        # durable ownership key when state is gone but logs remain
+        ws = self._ws(request)
+        container_id = await self.containers.resolve(request.match_info["id"])
+        state = await self.containers.get_state(container_id)
+        owner = (state.workspace_id if state is not None
+                 else await self.containers.get_owner(container_id))
+        if owner is None or (not request.get("is_worker")
+                             and owner != ws.workspace_id):
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "container not found"}),
+                content_type="application/json")
         since = request.query.get("since", "0")
-        entries = await self.containers.read_logs(request.match_info["id"],
+        entries = await self.containers.read_logs(container_id,
                                                   last_id=since)
         return web.json_response(
             [{"id": eid, **e} for eid, e in entries])

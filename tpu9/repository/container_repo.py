@@ -17,6 +17,9 @@ from .keys import Keys
 
 # Containers must refresh state within this horizon or be considered lost
 CONTAINER_STATE_TTL_S = 60.0
+# Ownership outlives state so post-mortem log reads stay authorized after
+# the state key expires (logs themselves are capped streams, not TTL'd)
+CONTAINER_OWNER_TTL_S = 86400.0
 
 
 class ContainerRepository:
@@ -35,6 +38,10 @@ class ContainerRepository:
         key = Keys.container_state(state.container_id)
         await self.store.hmset(key, state.to_dict())
         await self.store.expire(key, CONTAINER_STATE_TTL_S)
+        if state.workspace_id:
+            await self.store.set(Keys.container_owner(state.container_id),
+                                 state.workspace_id,
+                                 ttl=CONTAINER_OWNER_TTL_S)
         await self.store.hset(Keys.stub_containers(state.stub_id),
                               state.container_id, state.status)
         if ContainerStatus(state.status) in (ContainerStatus.STOPPED,
@@ -49,6 +56,29 @@ class ContainerRepository:
     async def get_state(self, container_id: str) -> Optional[ContainerState]:
         data = await self.store.hgetall(Keys.container_state(container_id))
         return ContainerState.from_dict(data) if data else None
+
+    async def get_owner(self, container_id: str) -> Optional[str]:
+        """Workspace that owned the container, surviving state expiry."""
+        return await self.store.get(Keys.container_owner(container_id))
+
+    # -- reschedule redirects ------------------------------------------------
+
+    async def set_redirect(self, old_id: str, new_id: str) -> None:
+        """A request requeued under a fresh id (gang rollback) leaves a
+        pointer so clients holding the original id can follow it."""
+        await self.store.set(Keys.container_redirect(old_id), new_id,
+                             ttl=3600.0)
+
+    async def resolve(self, container_id: str) -> str:
+        """Follow reschedule redirects (bounded against cycles)."""
+        seen = 0
+        while seen < 8:
+            nxt = await self.store.get(Keys.container_redirect(container_id))
+            if not nxt:
+                break
+            container_id = nxt
+            seen += 1
+        return container_id
 
     async def delete_state(self, container_id: str, stub_id: str = "") -> None:
         state = await self.get_state(container_id)
@@ -108,10 +138,10 @@ class ContainerRepository:
         return True
 
     async def release_request_token(self, stub_id: str, container_id: str) -> None:
+        # floor-at-zero inside the store's single atomic op: an incr-then-set
+        # clamp here would race a concurrent acquire and erase its increment
         key = Keys.stub_concurrency(stub_id, container_id)
-        cur = await self.store.incr(key, -1)
-        if cur < 0:
-            await self.store.set(key, 0)
+        await self.store.incr(key, -1, floor=0)
 
     async def in_flight(self, stub_id: str, container_id: str) -> int:
         val = await self.store.get(Keys.stub_concurrency(stub_id, container_id))

@@ -44,6 +44,14 @@ class Keys:
         return f"container:logs:{container_id}"
 
     @staticmethod
+    def container_owner(container_id: str) -> str:     # workspace_id, long TTL
+        return f"container:owner:{container_id}"
+
+    @staticmethod
+    def container_redirect(container_id: str) -> str:  # rescheduled-as id
+        return f"container:redirect:{container_id}"
+
+    @staticmethod
     def stub_containers(stub_id: str) -> str:          # hash container_id -> status
         return f"stub:containers:{stub_id}"
 

@@ -163,7 +163,16 @@ class Scheduler:
         if not ok:
             raise SchedulingFailed("capacity race lost")
 
-        await self._dispatch(worker.worker_id, request)
+        try:
+            await self._dispatch(worker.worker_id, request)
+        except Exception as exc:
+            # dispatch failed after capacity was reserved (state-store /
+            # push error): release the reservation before the requeue, or
+            # the capacity leaks until the worker re-registers
+            await self.workers.adjust_capacity(
+                worker.worker_id, cpu_millicores=request.cpu_millicores,
+                memory_mb=request.memory_mb, tpu_chips=chips)
+            raise SchedulingFailed(f"dispatch failed: {exc}") from exc
 
     async def _schedule_gang(self, request: ContainerRequest, workers: list,
                              alive: set[str], spec) -> None:
@@ -203,15 +212,81 @@ class Scheduler:
             "stub_id": request.stub_id,
         })
 
-        for rank, (m, container_id) in enumerate(zip(members, container_ids)):
-            member_req = ContainerRequest.from_dict(request.to_dict())
-            member_req.container_id = container_id
-            member_req.gang = GangInfo(
-                gang_id=gang_id, size=len(members), rank=rank,
-                peer_container_ids=container_ids,
-                coordinator_addr=coordinator)
-            await self.containers.set_request(member_req)
-            await self._dispatch(m.worker_id, member_req)
+        dispatched: list[tuple[str, str]] = []   # (worker_id, container_id)
+        try:
+            for rank, (m, container_id) in enumerate(zip(members,
+                                                         container_ids)):
+                member_req = ContainerRequest.from_dict(request.to_dict())
+                member_req.container_id = container_id
+                member_req.gang = GangInfo(
+                    gang_id=gang_id, size=len(members), rank=rank,
+                    peer_container_ids=container_ids,
+                    coordinator_addr=coordinator)
+                await self.containers.set_request(member_req)
+                await self._dispatch(m.worker_id, member_req)
+                dispatched.append((m.worker_id, container_id))
+        except Exception as exc:
+            # all-or-nothing extends through dispatch: stop members already
+            # sent to workers, release reservations, drop the gang key, then
+            # requeue the original request — otherwise earlier ranks run as a
+            # half-gang while a duplicate gang gets scheduled later.
+            # The id rename comes FIRST and each cleanup step is isolated:
+            # a store outage mid-rollback must not requeue under an id whose
+            # stop marker would cancel the rescheduled incarnation.
+            dispatched_ids = {cid for _, cid in dispatched}
+            old_id = request.container_id
+            if old_id in dispatched_ids:
+                # rank 0 (the original id) already reached a worker and will
+                # be told to stop — recycle the requeued request under a
+                # fresh id, leaving a redirect so clients that hold the
+                # original id (pod create) can follow the reschedule
+                request.container_id = new_id("ct")
+                try:
+                    await self.containers.set_redirect(old_id,
+                                                       request.container_id)
+                except Exception:
+                    log.warning("gang rollback: redirect %s failed", old_id)
+            for worker_id, container_id in dispatched:
+                try:
+                    await self.store.publish(
+                        f"container:stop:{worker_id}",
+                        {"container_id": container_id,
+                         "reason": StopReason.SCHEDULER_FAILED.value})
+                except Exception:
+                    log.warning("gang rollback: stop %s on %s failed",
+                                container_id, worker_id)
+            # capacity: release only NON-dispatched members here — a request
+            # that reached a worker's stream is released by that worker
+            # (release-on-exit / failed-start path); releasing it twice would
+            # over-credit a host that also runs unrelated containers
+            for m, container_id in zip(members, container_ids):
+                if container_id not in dispatched_ids:
+                    try:
+                        await self.workers.adjust_capacity(
+                            m.worker_id,
+                            cpu_millicores=request.cpu_millicores,
+                            memory_mb=request.memory_mb,
+                            tpu_chips=per_host_chips)
+                    except Exception:
+                        log.warning("gang rollback: release on %s failed "
+                                    "(recovers at worker re-register)",
+                                    m.worker_id)
+            # drop phantom SCHEDULED state/request records for members no
+            # worker will ever see (the failing rank and later ones)
+            for container_id in container_ids:
+                if (container_id not in dispatched_ids
+                        and container_id != old_id):
+                    try:
+                        await self.containers.delete_state(container_id,
+                                                           request.stub_id)
+                    except Exception:
+                        log.warning("gang rollback: state cleanup %s failed",
+                                    container_id)
+            try:
+                await self.store.delete(Keys.gang(gang_id))
+            except Exception:
+                log.warning("gang rollback: gang key cleanup failed")
+            raise SchedulingFailed(f"gang dispatch failed: {exc}") from exc
         self.stats["gangs_scheduled"] += 1
 
     async def _dispatch(self, worker_id: str, request: ContainerRequest) -> None:

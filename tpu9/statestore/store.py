@@ -30,7 +30,8 @@ class StateStore:
     async def keys(self, pattern: str = "*") -> list[str]: raise NotImplementedError
     async def expire(self, key: str, ttl: float) -> bool: raise NotImplementedError
     async def ttl(self, key: str) -> float: raise NotImplementedError
-    async def incr(self, key: str, by: int = 1) -> int: raise NotImplementedError
+    async def incr(self, key: str, by: int = 1,
+                   floor: Optional[int] = None) -> int: raise NotImplementedError
 
     # -- hash
     async def hset(self, key: str, field: str, value: Any) -> None: raise NotImplementedError
@@ -194,10 +195,12 @@ class MemoryStore(StateStore):
         exp = self._expiry.get(key)
         return -1.0 if exp is None else max(0.0, exp - time.monotonic())
 
-    async def incr(self, key, by=1):
+    async def incr(self, key, by=1, floor=None):
         if self._expired(key):
             pass
         cur = int(self._kv.get(key, 0)) + by
+        if floor is not None and cur < floor:
+            cur = floor
         self._kv[key] = cur
         return cur
 

@@ -64,6 +64,11 @@ class ContainerLifecycle:
         # consumed by the supervisor at exit — avoids read-modify-write races
         # on the shared container state
         self._pending_reasons: dict[str, str] = {}
+        # stops that arrived while (or before) the container was cold-starting:
+        # runtime.kill is a no-op until the process spawns, so run_container
+        # checks this at phase boundaries and aborts instead of starting a
+        # container the scheduler already rolled back
+        self._stop_requested: dict[str, float] = {}
 
     def note_stop_reason(self, container_id: str, reason: str) -> None:
         self._pending_reasons[container_id] = reason
@@ -88,7 +93,12 @@ class ContainerLifecycle:
         self._phase(container_id, LifecyclePhase.WORKER_RECEIVED, t0)
         self.memory_limits[container_id] = request.memory_mb
 
+        def check_aborted() -> None:
+            if container_id in self._stop_requested:
+                raise RuntimeError("stopped before start")
+
         try:
+            check_aborted()
             # image materialization ∥ workspace fetch (lifecycle.go:355-368)
             image_task = asyncio.create_task(self._prepare_image(request))
             object_task = asyncio.create_task(self._prepare_workspace(request))
@@ -96,6 +106,7 @@ class ContainerLifecycle:
             self._phase(container_id, LifecyclePhase.IMAGE_READY, t0)
             workdir = await object_task
             self._phase(container_id, LifecyclePhase.STORAGE_READY, t0)
+            check_aborted()
 
             assignment = self.tpu.assign(request)
             self._phase(container_id, LifecyclePhase.DEVICES_READY, t0)
@@ -112,8 +123,12 @@ class ContainerLifecycle:
                 asyncio.get_running_loop().create_task(
                     self.containers.append_log(container_id, line, stream))
 
+            check_aborted()
             handle = await self.runtime.run(spec, log_cb=log_cb)
             self._phase(container_id, LifecyclePhase.RUNTIME_STARTED, t0)
+            # a stop that raced the spawn: the kill may have hit nothing, so
+            # re-check now that the process exists (the except path reaps it)
+            check_aborted()
 
             address = f"127.0.0.1:{port}"
             needs_probe = request.stub_type in (
@@ -162,8 +177,12 @@ class ContainerLifecycle:
                 pass
             self.tpu.release(container_id)
             self.memory_limits.pop(container_id, None)
+            self._stop_requested.pop(container_id, None)
             state.status = ContainerStatus.FAILED.value
-            state.stop_reason = StopReason.EXIT.value
+            # an abort requested by the scheduler/user is not a crash —
+            # preserve the noted reason so monitors don't count it as one
+            state.stop_reason = (self._pending_reasons.pop(container_id, "")
+                                 or StopReason.EXIT.value)
             state.exit_code = 1
             await self.containers.update_state(state)
             await self.containers.set_exit_code(container_id, 1, str(exc))
@@ -195,10 +214,18 @@ class ContainerLifecycle:
                                             state.stop_reason)
         self._active.pop(container_id, None)
         self.memory_limits.pop(container_id, None)
+        self._stop_requested.pop(container_id, None)
 
     async def stop_container(self, container_id: str,
                              reason: str = StopReason.USER.value) -> bool:
         self.note_stop_reason(container_id, reason)
+        now = time.monotonic()
+        self._stop_requested[container_id] = now
+        # bound the tombstone set: entries older than 10 min belong to
+        # containers that either aborted long ago or never arrived
+        for cid, ts in list(self._stop_requested.items()):
+            if now - ts > 600.0:
+                del self._stop_requested[cid]
         state = await self.containers.get_state(container_id)
         if state:
             state.status = ContainerStatus.STOPPING.value
