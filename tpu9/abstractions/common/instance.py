@@ -61,8 +61,9 @@ class AutoscaledInstance:
         self._last_active = time.monotonic()
         # start-failure circuit breaker: if we keep launching containers and
         # none ever reaches RUNNING, pause before burning more capacity
-        self._recent_starts: list[float] = []
+        self._recent_starts: list[tuple[float, str]] = []  # (ts, container_id)
         self._breaker_until = 0.0
+        self.backoff_events = 0   # breaker trips (bench asserts 0 when clean)
 
     # -- sampling ------------------------------------------------------------
 
@@ -96,24 +97,41 @@ class AutoscaledInstance:
             if idle < cfg.keep_warm_seconds:
                 desired = min(current, max(1, cfg.autoscaler.min_containers))
 
+        any_running = any(s.status == ContainerStatus.RUNNING.value
+                          for s in running)
+        if any_running:
+            # a launch that reached RUNNING proves the stub is startable —
+            # reset the crash window. (Round-1 bug: counting successful
+            # starts let rapid scale-to-zero→cold-start cycles trip a
+            # spurious 15 s pause, the bench's 30 s cold-start tail.)
+            self._recent_starts.clear()
+
         if desired > current:
             now = time.monotonic()
-            self._recent_starts = [t for t in self._recent_starts
-                                   if now - t < 30.0]
-            any_running = any(s.status == ContainerStatus.RUNNING.value
-                              for s in running)
-            if (not any_running and len(self._recent_starts) >= 3
+            self._recent_starts = [(t, cid) for (t, cid) in
+                                   self._recent_starts if now - t < 30.0]
+            # the 1 Hz sampler can miss a short-lived RUNNING entirely, so
+            # the breaker counts starts whose container demonstrably
+            # CRASHED (exit record with a non-deliberate reason) — not
+            # merely "started while nothing is running right now"
+            crashed = 0
+            for _, cid in self._recent_starts:
+                ex = await self.containers.get_exit(cid)
+                if ex and ex.get("code") != 0 and not self._deliberate(
+                        str(ex.get("reason", ""))):
+                    crashed += 1
+            if (not any_running and crashed >= 3
                     and now >= self._breaker_until):
                 self._breaker_until = now + 15.0
+                self.backoff_events += 1
                 log.warning(
-                    "stub %s: %d starts in 30s with none RUNNING — pausing "
-                    "starts 15s", self.stub.stub_id,
-                    len(self._recent_starts))
+                    "stub %s: %d crashed starts in 30s with none RUNNING — "
+                    "pausing starts 15s", self.stub.stub_id, crashed)
             if now < self._breaker_until and not any_running:
                 return
             for _ in range(desired - current):
-                self._recent_starts.append(now)
-                await self.start_container()
+                cid = await self.start_container()
+                self._recent_starts.append((now, cid))
         elif desired < current:
             # stop not-yet-started containers first, then the newest RUNNING
             # ones (oldest are warmest); PENDING has scheduled_at == 0 and
@@ -126,6 +144,17 @@ class AutoscaledInstance:
             for s in surplus:
                 await self.scheduler.stop_container(
                     s.container_id, reason=StopReason.SCALE_DOWN.value)
+
+    @staticmethod
+    def _deliberate(reason: str) -> bool:
+        """Exit reasons that are operator intent, not a failure (reason
+        strings may carry ': detail' suffixes). Involuntary ends —
+        crashes, OOM, placement failure (scheduler_failed), lost workers,
+        gang co-failure — all count toward the breaker: an unschedulable
+        stub must throttle, not retry-loop at reconcile rate."""
+        head = reason.split(":", 1)[0].strip()
+        return head in (StopReason.USER.value, StopReason.SCALE_DOWN.value,
+                        StopReason.TTL.value)
 
     async def start_container(self) -> str:
         cfg = self.stub.config

@@ -174,9 +174,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               causal: bool = True, kv_offset: int = 0) -> jnp.ndarray:
     """Dispatch: pallas flash on TPU for block-aligned shapes, XLA otherwise."""
-    on_tpu = jax.default_backend() == "tpu"
+    from ..utils import on_tpu as _on_tpu
     t, s = q.shape[1], k.shape[1]
-    if (on_tpu and kv_offset == 0 and t % 128 == 0 and s % 128 == 0
+    if (_on_tpu() and kv_offset == 0 and t % 128 == 0 and s % 128 == 0
             and q.shape[-1] in (64, 128, 256)):
         return flash_attention(q, k, v, causal=causal)
     return xla_attention(q, k, v, causal=causal, kv_offset=kv_offset)
@@ -195,10 +195,20 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     a masked softmax over the full cache.
     """
     s_max = k_cache.shape[1]
-    if (jax.default_backend() == "tpu" and s_max >= 512 and s_max % 256 == 0
+    from ..utils import on_tpu as _on_tpu
+    if (_on_tpu() and s_max >= 512 and s_max % 256 == 0
             and q.shape[-1] in (64, 128, 256)):
         from .paged_attention import ragged_decode_attention
         return ragged_decode_attention(q, k_cache, v_cache, cache_len)
+    return xla_decode_attention(q, k_cache, v_cache, cache_len)
+
+
+def xla_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                         v_cache: jnp.ndarray,
+                         cache_len: jnp.ndarray) -> jnp.ndarray:
+    """Reference/fallback decode graph: masked softmax over the full cache.
+    Also the correctness oracle the bench validates the ragged pallas
+    kernel against — keep semantics in lockstep with it."""
     q_heads = q.shape[2]
     k = _expand_gqa(k_cache, q_heads)
     v = _expand_gqa(v_cache, q_heads)

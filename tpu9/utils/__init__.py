@@ -1,3 +1,3 @@
-from .platform import force_cpu, device_kind
+from .platform import force_cpu, device_kind, on_tpu
 
-__all__ = ["force_cpu", "device_kind"]
+__all__ = ["force_cpu", "device_kind", "on_tpu"]

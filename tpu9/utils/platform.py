@@ -25,3 +25,23 @@ def force_cpu(host_devices: int = 0) -> None:
 def device_kind() -> str:
     import jax
     return jax.devices()[0].device_kind if jax.devices() else "none"
+
+
+def on_tpu() -> bool:
+    """True when the default backend is TPU hardware, including via relay
+    backends whose platform name isn't literally "tpu" (a TPU tunnel
+    registers as e.g. "axon" but its devices report a TPU device_kind).
+    Kernel dispatch must use this, not ``jax.default_backend() == "tpu"``,
+    or pallas kernels silently fall back to XLA on relayed chips."""
+    import jax
+    backend = (jax.default_backend() or "").lower()
+    if backend == "cpu":
+        return False
+    if "tpu" in backend:
+        return True
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return ("tpu" in (getattr(dev, "platform", "") or "").lower()
+            or "tpu" in (getattr(dev, "device_kind", "") or "").lower())

@@ -185,7 +185,10 @@ class ContainerLifecycle:
                                  or StopReason.EXIT.value)
             state.exit_code = 1
             await self.containers.update_state(state)
-            await self.containers.set_exit_code(container_id, 1, str(exc))
+            # reason prefix is machine-readable (breakers distinguish
+            # deliberate stops from crashes); the exception text follows
+            await self.containers.set_exit_code(
+                container_id, 1, f"{state.stop_reason}: {exc}")
             raise
 
     async def _supervise(self, request: ContainerRequest,
