@@ -247,15 +247,27 @@ def _run_phase(phase: str, quick: bool, cpu: bool) -> dict:
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
                             start_new_session=True)
-    try:
-        out, err = proc.communicate(timeout=PHASE_TIMEOUT_S[phase])
-    except subprocess.TimeoutExpired:
-        _kill_group(proc)
-        out, err = proc.communicate()
+    # setsid'd runner containers leave the group AND reparent to init when
+    # the phase dies, so pids must be snapshotted WHILE the phase is alive —
+    # a post-exit walk from a dead pid finds nothing
+    seen_pids: set[int] = set()
+    deadline = time.monotonic() + PHASE_TIMEOUT_S[phase]
+    timed_out = False
+    while True:
+        try:
+            out, err = proc.communicate(timeout=2)
+            break
+        except subprocess.TimeoutExpired:
+            seen_pids.update(_descendants(proc.pid))
+            if time.monotonic() > deadline:
+                timed_out = True
+                _kill_group(proc, seen_pids)
+                out, err = proc.communicate()
+                break
+    _kill_group(proc, seen_pids)
+    if timed_out:
         return {f"{phase}_error": f"timeout after {PHASE_TIMEOUT_S[phase]}s",
                 f"{phase}_stderr_tail": err[-500:] if err else ""}
-    finally:
-        _kill_group(proc)
 
     for line in reversed(out.strip().splitlines()):
         try:
@@ -291,19 +303,27 @@ def _descendants(root_pid: int) -> list[int]:
     return out
 
 
-def _kill_group(proc: subprocess.Popen) -> None:
-    """SIGKILL the phase's process tree — collected BEFORE the group kill so
-    setsid'd runner containers (own sessions, outside the group) die too."""
-    kids = _descendants(proc.pid)
+def _kill_group(proc: subprocess.Popen, extra_pids: set[int] = frozenset()) -> None:
+    """SIGKILL the phase's process group plus every pid snapshotted while
+    the phase was alive (setsid'd runner containers sit outside the group
+    and reparent to init on phase death — the snapshot is the only handle)."""
+    kids = set(_descendants(proc.pid)) | set(extra_pids)
+    kids.discard(proc.pid)
     try:
         os.killpg(proc.pid, signal.SIGKILL)
     except (ProcessLookupError, PermissionError):
         pass
     for pid in kids:
+        # snapshot pids may have died and been REUSED by unrelated
+        # processes — only kill ones that are verifiably ours (runner
+        # containers carry TPU9_* env)
         try:
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                if b"TPU9_" not in f.read():
+                    continue
             os.kill(pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
+        except (OSError, ProcessLookupError, PermissionError):
+            continue
 
 
 def _tpu_alive(timeout_s: float = 120.0) -> bool:

@@ -1,0 +1,167 @@
+"""The multi-host contract, for real: TWO separate worker processes (not
+in-process objects) joined over the state server, one v5p-8 gang scheduled
+across them, and each runner calling ``jax.distributed.initialize()`` off
+the gang env on the CPU backend. Both ranks must observe the GLOBAL device
+count — this is what proves the rank/coordinator wiring tpu9 injects
+(tpu_manager._env_for) actually drives a jax.distributed cluster, which no
+amount of in-process gang testing can show."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import aiohttp
+import pytest
+
+from tpu9.backend import BackendDB
+from tpu9.config import AppConfig
+from tpu9.gateway import Gateway
+from tpu9.statestore import MemoryStore
+from tpu9.types import ContainerRequest, ContainerStatus
+
+pytestmark = pytest.mark.e2e
+
+# jax.distributed.initialize must run before the backend is initialized, so
+# it happens at handler-module import inside the runner container process.
+DIST_HANDLER = """
+import os
+from tpu9.utils import force_cpu
+force_cpu()
+import jax
+jax.distributed.initialize(
+    coordinator_address=os.environ["TPU9_COORDINATOR_ADDR"],
+    num_processes=int(os.environ["TPU9_GANG_SIZE"]),
+    process_id=int(os.environ["TPU9_GANG_RANK"]))
+# backend init (the first device query) performs the cluster-wide device
+# exchange and blocks until EVERY process reaches it — do it at import so
+# both ranks rendezvous during container start, not inside one handler call
+GLOBAL_DEVICES = jax.device_count()
+LOCAL_DEVICES = jax.local_device_count()
+
+def handler(**kw):
+    return {
+        "rank": int(os.environ["TPU9_GANG_RANK"]),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": GLOBAL_DEVICES,
+        "local_devices": LOCAL_DEVICES,
+    }
+"""
+
+
+async def _wait(predicate, timeout=90.0, interval=0.2, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = await predicate()
+        if out:
+            return out
+        await asyncio.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+async def test_two_process_gang_jax_distributed(tmp_path):
+    cfg = AppConfig()
+    cfg.gateway.http_port = 0
+    cfg.gateway.state_port = -1          # any free port: workers join remotely
+    cfg.database.path = ":memory:"
+    cfg.storage.local_root = str(tmp_path / "ws")
+    cfg.worker.containers_dir = str(tmp_path / "containers")
+    cfg.scheduler.loop_interval_s = 0.02
+
+    gw = Gateway(cfg, store=MemoryStore())
+    await gw.start()
+    procs: list[subprocess.Popen] = []
+    session = aiohttp.ClientSession(headers={
+        "Authorization": f"Bearer {gw.default_token}"})
+    try:
+        state_addr = gw.state_server.address
+        base = f"http://127.0.0.1:{gw.port}"
+        for rank in range(2):
+            env = dict(os.environ)
+            env["TPU9_FAKE_TPU_CHIPS"] = "4"
+            env["PYTHONPATH"] = "/root/repo"
+            env["JAX_PLATFORMS"] = "cpu"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tpu9.cli.main", "worker",
+                 "--gateway-state", state_addr,
+                 "--gateway-url", base,
+                 "--token", gw.worker_token,
+                 "--tpu", "v5p",
+                 "--slice-id", "slice-mp",
+                 "--slice-rank", str(rank),
+                 "--slice-hosts", "2"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+
+        async def workers_up():
+            ws = await gw.workers.list()
+            return ws if len(ws) >= 2 else None
+
+        await _wait(workers_up, what="2 workers to register")
+
+        # upload the distributed handler workspace
+        import zipfile
+        zpath = tmp_path / "ws.zip"
+        with zipfile.ZipFile(zpath, "w") as z:
+            z.writestr("app.py", DIST_HANDLER)
+        async with session.post(f"{base}/rpc/object/put",
+                                data=zpath.read_bytes()) as resp:
+            assert resp.status == 200
+            object_id = (await resp.json())["object_id"]
+
+        async with session.post(f"{base}/rpc/stub/get-or-create", json={
+            "name": "distfn", "stub_type": "endpoint",
+            "config": {"handler": "app:handler",
+                       "keep_warm_seconds": 30.0, "timeout_s": 120.0,
+                       "runtime": {"tpu": "v5p-8", "cpu_millicores": 500,
+                                   "memory_mb": 1024}},
+            "object_id": object_id}) as resp:
+            assert resp.status == 200
+            stub_id = (await resp.json())["stub_id"]
+
+        req = ContainerRequest(
+            stub_id=stub_id,
+            workspace_id=gw.default_workspace.workspace_id,
+            stub_type="endpoint", cpu_millicores=500, memory_mb=1024,
+            tpu="v5p-8", object_id=object_id,
+            env={"TPU9_HANDLER": "app:handler", "TPU9_STUB_TYPE": "endpoint",
+                 "TPU9_CONCURRENT_REQUESTS": "1", "TPU9_WORKERS": "1",
+                 "TPU9_TIMEOUT_S": "120"})
+        await gw.scheduler.run(req)
+
+        async def both_running():
+            states = await gw.containers.containers_by_stub(
+                stub_id, status=ContainerStatus.RUNNING.value)
+            return states if len(states) == 2 else None
+
+        # jax import + distributed rendezvous inside each runner is slow
+        states = await _wait(both_running, timeout=180.0,
+                             what="both gang members RUNNING")
+
+        results = []
+        for s in states:
+            async with session.post(f"http://{s.address}/", json={}) as resp:
+                assert resp.status == 200, await resp.text()
+                results.append(await resp.json())
+
+        ranks = sorted(r["rank"] for r in results)
+        assert ranks == [0, 1]
+        for r in results:
+            assert r["process_count"] == 2, r
+            assert r["process_index"] == r["rank"], r
+            # THE multi-host assertion: each process sees every process's
+            # devices through the jax.distributed cluster, not just its own
+            assert r["global_devices"] == 2 * r["local_devices"], r
+    finally:
+        await session.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        await gw.stop()

@@ -47,7 +47,8 @@ class AutoscaledInstance:
                  containers: ContainerRepository,
                  decide_policy, sample_extra=None,
                  entrypoint: Optional[list[str]] = None,
-                 pool_selector: str = "", checkpoint_lookup=None):
+                 pool_selector: str = "", checkpoint_lookup=None,
+                 secret_env_fn=None):
         self.stub = stub
         self.scheduler = scheduler
         self.containers = containers
@@ -56,6 +57,9 @@ class AutoscaledInstance:
         self.extra_env: dict[str, str] = {}   # abstraction-specific env
         # async (stub_id) -> checkpoint_id | "" (scheduler/checkpoint.go:36)
         self.checkpoint_lookup = checkpoint_lookup
+        # async () -> dict: declared workspace secrets resolved fresh at
+        # every container start (rotation applies on next cold start)
+        self.secret_env_fn = secret_env_fn
         self._sample_extra = sample_extra   # async () -> (queue_depth, pressure)
         self.autoscaler = Autoscaler(self._sample, decide_policy, self._apply)
         self._last_active = time.monotonic()
@@ -161,6 +165,12 @@ class AutoscaledInstance:
         checkpoint_id = ""
         if cfg.checkpoint.enabled and self.checkpoint_lookup is not None:
             checkpoint_id = await self.checkpoint_lookup(self.stub.stub_id) or ""
+        # secrets take lowest precedence: explicit stub env and TPU9_*
+        # system vars must never be shadowed by a secret of the same name
+        env = {}
+        if cfg.secrets and self.secret_env_fn is not None:
+            env.update(await self.secret_env_fn())
+        env.update(self._runner_env())
         request = ContainerRequest(
             container_id=new_id("ct"),
             stub_id=self.stub.stub_id,
@@ -172,7 +182,7 @@ class AutoscaledInstance:
             image_id=cfg.runtime.image_id,
             object_id=self.stub.object_id,
             entrypoint=self.entrypoint,
-            env=self._runner_env(),
+            env=env,
             mounts=volume_mounts(cfg),
             pool_selector=self.pool_selector,
             checkpoint_id=checkpoint_id,

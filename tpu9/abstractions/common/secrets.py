@@ -1,0 +1,47 @@
+"""Secret-to-env resolution at container request build time.
+
+Reference analogue: the reference resolves workspace secrets into the OCI
+spec's env during synthesis (``pkg/worker/lifecycle.go:766``-adjacent
+secrets-to-env in ``pkg/abstractions/common/``) — values are read fresh at
+each container start, so rotating a secret takes effect on the next
+cold start without redeploying.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+log = logging.getLogger("tpu9.abstractions")
+
+
+async def stub_secret_env(backend, stub) -> dict[str, str]:
+    """Resolve a stub's declared secrets (empty dict when none declared).
+    The single injection point all abstractions share — semantics changes
+    (fail-closed, caching, auditing) happen here once."""
+    if not stub.config.secrets:
+        return {}
+    return await secret_env(backend, stub.workspace_id, stub.config.secrets)
+
+
+def stub_secret_env_fn(backend, stub):
+    """Closure form for AutoscaledInstance's per-start resolution hook."""
+    async def resolve() -> dict[str, str]:
+        return await stub_secret_env(backend, stub)
+    return resolve
+
+
+async def secret_env(backend, workspace_id: str,
+                     names: Iterable[str]) -> dict[str, str]:
+    """Resolve declared secret names to an env mapping. Unknown names are
+    skipped with a warning (matching the reference's lenient injection) —
+    the container still starts, the variable is simply absent."""
+    env: dict[str, str] = {}
+    for name in names:
+        value = await backend.get_secret(workspace_id, name)
+        if value is None:
+            log.warning("secret %r not found in workspace %s — skipping",
+                        name, workspace_id)
+            continue
+        env[name] = value
+    return env

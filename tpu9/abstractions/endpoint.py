@@ -50,8 +50,11 @@ class EndpointService:
                     row = await self.backend.latest_checkpoint(stub_id)
                     return row["checkpoint_id"] if row else ""
 
-                inst = EndpointInstance(stub, self.scheduler, self.containers,
-                                        checkpoint_lookup=latest_ckpt)
+                from .common.secrets import stub_secret_env_fn
+                inst = EndpointInstance(
+                    stub, self.scheduler, self.containers,
+                    checkpoint_lookup=latest_ckpt,
+                    secret_env_fn=stub_secret_env_fn(self.backend, stub))
                 # runner env + token so LLM runners can heartbeat pressure
                 # and reach the gateway like taskqueue/function runners do
                 inst.instance.extra_env = dict(self.runner_env)
@@ -82,7 +85,8 @@ class EndpointInstance:
     """One deployment's serving state: buffer + autoscaled containers."""
 
     def __init__(self, stub: Stub, scheduler: Scheduler,
-                 containers: ContainerRepository, checkpoint_lookup=None):
+                 containers: ContainerRepository, checkpoint_lookup=None,
+                 secret_env_fn=None):
         self.stub = stub
         a = stub.config.autoscaler
         self.router = None
@@ -103,7 +107,8 @@ class EndpointInstance:
         self.instance = AutoscaledInstance(
             stub, scheduler, containers, policy,
             sample_extra=self._sample_extra,
-            checkpoint_lookup=checkpoint_lookup)
+            checkpoint_lookup=checkpoint_lookup,
+            secret_env_fn=secret_env_fn)
         self._containers = containers
 
     async def _sample_extra(self):

@@ -110,7 +110,10 @@ class FunctionService:
 
     async def _start_task_container(self, stub: Stub, task_id: str) -> str:
         cfg = stub.config
-        env = dict(cfg.env)
+        from .common.secrets import stub_secret_env
+        # secrets lowest precedence — stub env must win name clashes
+        env = await stub_secret_env(self.backend, stub)
+        env.update(cfg.env)
         env.update(self.runner_env)
         env.update({
             "TPU9_HANDLER": cfg.handler,

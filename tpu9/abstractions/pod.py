@@ -45,7 +45,10 @@ class PodService:
         """Run one pod container; returns its id (address resolves once
         RUNNING)."""
         cfg = stub.config
-        env = dict(cfg.env)
+        from .common.secrets import stub_secret_env
+        # secrets lowest precedence — stub env must win name clashes
+        env = await stub_secret_env(self.backend, stub)
+        env.update(cfg.env)
         env.update(self.runner_env)
         env["TPU9_TOKEN"] = await self.runner_tokens.get(stub.workspace_id)
         entrypoint = list(cfg.entrypoint)

@@ -60,9 +60,11 @@ class TaskQueueService:
                     in_flight = await self.tasks.tasks_in_flight(stub.stub_id)
                     return depth + max(in_flight - depth, 0), 0.0
 
-                inst = AutoscaledInstance(stub, self.scheduler,
-                                          self.containers, policy,
-                                          sample_extra=sample_extra)
+                from .common.secrets import stub_secret_env_fn
+                inst = AutoscaledInstance(
+                    stub, self.scheduler, self.containers, policy,
+                    sample_extra=sample_extra,
+                    secret_env_fn=stub_secret_env_fn(self.backend, stub))
                 inst.extra_env = dict(self.runner_env)
                 inst.extra_env["TPU9_TOKEN"] = await self.runner_tokens.get(
                     stub.workspace_id)

@@ -26,9 +26,34 @@ from .migrations import MIGRATIONS
 
 
 def _xor_cipher(data: bytes, key: bytes) -> bytes:
-    # Secrets-at-rest obfuscation; production swaps in KMS-backed AES via the
-    # same hook (reference stores AES-encrypted secrets in Postgres).
+    # Legacy (pre-v1) at-rest obfuscation — kept ONLY so rows written by
+    # round-1 databases still decrypt; all new writes are AES-GCM.
     return bytes(b ^ key[i % len(key)] for i, b in enumerate(data))
+
+
+# value_enc = header || 12-byte nonce || ct+tag. The 5-byte magic makes the
+# format unmistakable: a single version byte would misroute ~1/256 of legacy
+# XOR rows (first ciphertext byte == 0x01) into the AES path; 5 bytes puts a
+# collision at 2^-40 while tampered AES rows still fail closed on the tag.
+_AESGCM_VERSION = b"\x01AGCM"
+
+
+def _encrypt_secret(plaintext: bytes, key: bytes) -> bytes:
+    """AES-256-GCM (key = sha256 of the configured secret key; the reference
+    stores AES-encrypted secrets in Postgres the same way). Nonce is random
+    per write; the GCM tag authenticates, so tampered rows fail closed."""
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    import os as _os
+    nonce = _os.urandom(12)
+    return _AESGCM_VERSION + nonce + AESGCM(key).encrypt(nonce, plaintext, None)
+
+
+def _decrypt_secret(blob: bytes, key: bytes) -> bytes:
+    h = len(_AESGCM_VERSION)
+    if blob[:h] == _AESGCM_VERSION:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        return AESGCM(key).decrypt(blob[h:h + 12], blob[h + 12:], None)
+    return _xor_cipher(blob, key)    # legacy rows
 
 
 class BackendDB:
@@ -296,7 +321,7 @@ class BackendDB:
     # -- secrets ------------------------------------------------------------
 
     async def upsert_secret(self, workspace_id: str, name: str, value: str) -> str:
-        enc = _xor_cipher(value.encode(), self._secret_key)
+        enc = _encrypt_secret(value.encode(), self._secret_key)
         self._exec(
             "INSERT INTO secrets (secret_id, workspace_id, name, value_enc, created_at, updated_at) VALUES (?,?,?,?,?,?) "
             "ON CONFLICT(workspace_id, name) DO UPDATE SET value_enc=excluded.value_enc, updated_at=excluded.updated_at",
@@ -310,7 +335,7 @@ class BackendDB:
                            (workspace_id, name))
         if not rows:
             return None
-        return _xor_cipher(rows[0]["value_enc"], self._secret_key).decode()
+        return _decrypt_secret(rows[0]["value_enc"], self._secret_key).decode()
 
     async def list_secrets(self, workspace_id: str) -> list[str]:
         rows = self._query("SELECT name FROM secrets WHERE workspace_id=? ORDER BY name",

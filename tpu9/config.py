@@ -27,6 +27,9 @@ class DatabaseConfig:
     # hot state bus: "memory" (embedded) or "host:port" of a StateServer
     state_addr: str = "memory"
     state_auth_token: str = ""
+    # secrets-at-rest AES key material (production: inject from a KMS);
+    # the AES-256 key is sha256 of this string
+    secret_key: str = "tpu9-dev-key"
 
 
 @dataclass

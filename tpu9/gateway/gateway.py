@@ -54,7 +54,8 @@ class Gateway:
                  pools: Optional[dict] = None):
         self.cfg = cfg
         self.store = store or MemoryStore()
-        self.backend = backend or BackendDB(cfg.database.path)
+        self.backend = backend or BackendDB(
+            cfg.database.path, secret_key=cfg.database.secret_key)
         self.scheduler = Scheduler(self.store, cfg.scheduler, pools=pools or {})
         self.workers = WorkerRepository(self.store, cfg.worker.keepalive_ttl_s)
         self.containers = ContainerRepository(self.store)

@@ -15,6 +15,7 @@ others (shared fate).
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import logging
 import time
@@ -202,8 +203,12 @@ class Scheduler:
                     memory_mb=request.memory_mb, tpu_chips=per_host_chips)
             raise
 
-        # rank 0's host is the jax coordinator
-        coordinator = f"{members[0].address.split(':')[0]}:8476"
+        # rank 0's host is the jax coordinator; the port is derived from the
+        # gang id so two gangs sharing a host never fight over one port
+        coord_host = members[0].address.rsplit(":", 1)[0]
+        coord_port = 8476 + (int(hashlib.sha1(gang_id.encode())
+                                 .hexdigest(), 16) % 1000)
+        coordinator = f"{coord_host}:{coord_port}"
         container_ids = [request.container_id] + [
             new_id("ct") for _ in range(1, len(members))]
         await self.store.hmset(Keys.gang(gang_id), {

@@ -29,6 +29,22 @@ from .tpu_manager import TpuDeviceManager
 log = logging.getLogger("tpu9.worker")
 
 
+def _detect_host() -> str:
+    """This host's routable IP (the trick sends no packets: connecting a UDP
+    socket just selects the outbound interface). Falls back to loopback for
+    single-host/dev setups."""
+    import socket
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
 class Worker:
     def __init__(self, store: StateStore, runtime: Runtime,
                  cfg: Optional[WorkerConfig] = None,
@@ -60,6 +76,9 @@ class Worker:
         self.slice_topology = slice_topology
         self.slice_host_rank = slice_host_rank
         self.slice_host_count = slice_host_count
+        # the registered address's host part becomes the gang coordinator
+        # host for rank-0 members — it must resolve from peer hosts
+        self.host = os.environ.get("TPU9_WORKER_HOST", "") or _detect_host()
 
         self.total_cpu = cpu_millicores or (psutil.cpu_count() or 1) * 1000
         self.total_mem = memory_mb or int(psutil.virtual_memory().total / 2**20)
@@ -93,7 +112,7 @@ class Worker:
             slice_topology=self.slice_topology,
             slice_host_rank=self.slice_host_rank,
             slice_host_count=self.slice_host_count,
-            address=f"pid:{os.getpid()}",
+            address=f"{self.host}:{os.getpid()}",
             cache_address=(self.cache.server.address
                            if self.cache and self.cache.server.port else ""),
         )
