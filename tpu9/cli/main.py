@@ -229,6 +229,91 @@ def task_cancel(task_id: str) -> None:
     click.echo(json.dumps({"ok": _client().task_cancel(task_id)}))
 
 
+@cli.command()
+@click.argument("container_id")
+@click.option("--cmd", default="", help="command instead of a shell")
+def shell(container_id: str, cmd: str) -> None:
+    """Interactive shell into a running container (shell/shell.go:53
+    analogue over the gateway websocket instead of dropbear+TCP tunnel).
+    Works with a real TTY (raw mode) or piped stdin for scripted use."""
+    import base64
+    import sys
+
+    import aiohttp
+
+    ctx = Context.load()
+    url = (ctx.gateway_url.rstrip("/")
+           + f"/api/v1/container/{container_id}/shell")
+
+    interactive = sys.stdin.isatty() and not cmd
+
+    async def run() -> int:
+        exit_code = 0
+        # scripted modes (piped/redirected stdin or --cmd) run one-shot
+        # under the PTY: deterministic exit code, no prompt noise, no
+        # readline EOF timing games
+        script = cmd
+        if not interactive and not script:
+            script = sys.stdin.read()
+        async with aiohttp.ClientSession(headers={
+                "Authorization": f"Bearer {ctx.token}"}) as session:
+            async with session.ws_connect(url) as ws:
+                loop = asyncio.get_running_loop()
+                restore = None
+                reader_installed = False
+
+                def on_stdin() -> None:
+                    data = os.read(sys.stdin.fileno(), 65536)
+                    if not data:
+                        loop.remove_reader(sys.stdin.fileno())
+                        data = b"\x04"   # PTY EOF: Ctrl-D
+                    asyncio.ensure_future(ws.send_json(
+                        {"d": base64.b64encode(data).decode()}))
+
+                try:
+                    if interactive:
+                        import termios
+                        import tty
+                        restore = termios.tcgetattr(sys.stdin.fileno())
+                        tty.setraw(sys.stdin.fileno())
+                        sz = os.get_terminal_size()
+                        await ws.send_json(
+                            {"resize": [sz.lines, sz.columns]})
+                        loop.add_reader(sys.stdin.fileno(), on_stdin)
+                        reader_installed = True
+                    else:
+                        await ws.send_json(
+                            {"cmd": ["/bin/sh", "-c", script]})
+
+                    async for msg in ws:
+                        if msg.type != aiohttp.WSMsgType.TEXT:
+                            break
+                        entry = json.loads(msg.data)
+                        if entry.get("d"):
+                            sys.stdout.buffer.write(
+                                base64.b64decode(entry["d"]))
+                            sys.stdout.buffer.flush()
+                        if entry.get("error"):
+                            print(f"shell error: {entry['error']}",
+                                  file=sys.stderr)
+                        if "exit" in entry:
+                            exit_code = int(entry["exit"])
+                            break
+                finally:
+                    if reader_installed:
+                        try:
+                            loop.remove_reader(sys.stdin.fileno())
+                        except (OSError, ValueError):
+                            pass
+                    if restore is not None:
+                        import termios
+                        termios.tcsetattr(sys.stdin.fileno(),
+                                          termios.TCSADRAIN, restore)
+        return exit_code
+
+    raise SystemExit(asyncio.run(run()))
+
+
 @cli.group()
 def container() -> None:
     """Inspect and manage containers."""
@@ -358,39 +443,6 @@ def image_build(packages, commands) -> None:
         list(commands))
     image_id = img.ensure_built(_client())
     click.echo(image_id)
-
-
-@cli.command()
-@click.argument("container_id")
-def shell(container_id: str) -> None:
-    """Interactive shell into a running container (reference
-    pkg/abstractions/shell: dropbear ssh; tpu9 runs a command loop over the
-    worker exec channel)."""
-    client = _client()
-    click.echo(f"tpu9 shell → {container_id} (exit with Ctrl-D or 'exit')")
-    while True:
-        try:
-            line = input("$ ")
-        except (EOFError, KeyboardInterrupt):
-            click.echo()
-            break
-        if line.strip() in ("exit", "quit"):
-            break
-        if not line.strip():
-            continue
-        try:
-            out = client._run(lambda c: c.request(
-                "POST", f"/rpc/pod/{container_id}/exec",
-                json_body={"cmd": ["sh", "-c", line], "timeout": 60}))
-        except Exception as exc:  # keep the REPL alive on RPC errors
-            click.echo(f"[error] {exc}")
-            continue
-        if out.get("output"):
-            click.echo(out["output"], nl=False)
-            if not out["output"].endswith("\n"):
-                click.echo()
-        if out.get("exit_code", 0) != 0:
-            click.echo(f"[exit {out.get('exit_code')}]")
 
 
 @cli.command("startup-report")

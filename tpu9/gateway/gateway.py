@@ -163,6 +163,7 @@ class Gateway:
         r.add_get("/api/v1/container", self._list_containers)
         r.add_post("/api/v1/container/{id}/stop", self._stop_container)
         r.add_get("/api/v1/container/{id}/logs", self._container_logs)
+        r.add_get("/api/v1/container/{id}/shell", self._container_shell)
         r.add_get("/api/v1/task", self._list_tasks)
         r.add_get("/api/v1/worker", self._list_workers)
         r.add_get("/api/v1/stub", self._list_stubs)
@@ -1024,6 +1025,80 @@ class Gateway:
                                                   last_id=since)
         return web.json_response(
             [{"id": eid, **e} for eid, e in entries])
+
+    async def _container_shell(self, request: web.Request) -> web.StreamResponse:
+        """Interactive shell: websocket ⇄ worker PTY over the state bus
+        (reference: shell abstraction's gateway TCP tunnel, shell/http.go).
+        Client sends JSON {d: b64} input / {resize: [rows, cols]}; receives
+        JSON {d: b64} output and a final {exit: code}."""
+        state = await self._container_for(request)
+        if not state.worker_id:
+            return web.json_response({"error": "container has no worker"},
+                                     status=409)
+        session_id = f"shell-{hashlib.sha1(os.urandom(16)).hexdigest()[:12]}"
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+
+        # first-frame protocol: a client may open with {"cmd": [...]} to run
+        # a one-shot command under the PTY instead of an interactive shell
+        # (scripted `tpu9 shell` with piped stdin). Interactive clients send
+        # a resize first, which simply forwards as normal input below.
+        cmd = None
+        first_payload = None
+        try:
+            first = await ws.receive(timeout=2.0)
+            if first.type == web.WSMsgType.TEXT:
+                first_payload = json.loads(first.data)
+                if isinstance(first_payload.get("cmd"), list):
+                    cmd = first_payload["cmd"]
+                    first_payload = None
+        except (asyncio.TimeoutError, json.JSONDecodeError):
+            pass
+
+        publish_payload = {
+            "container_id": state.container_id, "session": session_id,
+        }
+        if cmd:
+            publish_payload["cmd"] = cmd
+        subscribers = await self.store.publish(
+            f"container:shell:{state.worker_id}", publish_payload)
+        if not subscribers:
+            # pubsub is fire-and-forget: zero subscribers means the worker
+            # is down/restarting — error now instead of hanging the client
+            await ws.send_json({"error": "worker unavailable", "exit": -1})
+            await ws.close()
+            return ws
+        out_key = f"shell:out:{session_id}"
+
+        async def pump_down() -> None:
+            last_id = "0"
+            while not ws.closed:
+                entries = await self.containers.store.xread(
+                    out_key, last_id=last_id, timeout=1.0)
+                for eid, entry in entries:
+                    last_id = eid
+                    await ws.send_json(entry)
+                    if "exit" in entry:
+                        await ws.close()
+                        return
+
+        down = asyncio.create_task(pump_down())
+        try:
+            if first_payload is not None:
+                await self.store.xadd(f"shell:in:{session_id}",
+                                      first_payload)
+            async for msg in ws:
+                if msg.type != web.WSMsgType.TEXT:
+                    continue
+                try:
+                    payload = json.loads(msg.data)
+                except json.JSONDecodeError:
+                    continue
+                await self.store.xadd(f"shell:in:{session_id}", payload)
+        finally:
+            await self.store.xadd(f"shell:in:{session_id}", {"close": True})
+            down.cancel()
+        return ws
 
     async def _list_tasks(self, request: web.Request) -> web.Response:
         ws = self._ws(request)

@@ -1,9 +1,9 @@
-from .base import ContainerHandle, Runtime, RuntimeState
+from .base import ContainerHandle, Runtime, RuntimeState, ShellSession
 from .process import ProcessRuntime
 from .runc import RuncRuntime
 
-__all__ = ["Runtime", "ContainerHandle", "RuntimeState", "ProcessRuntime",
-           "RuncRuntime"]
+__all__ = ["Runtime", "ContainerHandle", "RuntimeState", "ShellSession",
+           "ProcessRuntime", "RuncRuntime"]
 
 
 def new_runtime(kind: str, **kw) -> Runtime:

@@ -52,6 +52,29 @@ class ContainerHandle:
     meta: dict[str, Any] = field(default_factory=dict)
 
 
+class ShellSession:
+    """An interactive exec attached to a PTY inside a container (reference:
+    the shell abstraction starts dropbear in-container, shell/shell.go:53;
+    tpu9 attaches a PTY through the runtime instead — no sshd needed).
+
+    ``output`` yields bytes chunks until process exit (None terminator);
+    ``write`` feeds the PTY's input; ``resize`` propagates terminal size."""
+
+    def __init__(self) -> None:
+        import asyncio
+        self.output: "asyncio.Queue[Optional[bytes]]" = asyncio.Queue()
+        self.exit_code: Optional[int] = None
+
+    async def write(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def resize(self, rows: int, cols: int) -> None:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        raise NotImplementedError
+
+
 class Runtime:
     name = "base"
 
@@ -70,6 +93,11 @@ class Runtime:
         raise NotImplementedError
 
     async def exec(self, container_id: str, cmd: list[str]) -> tuple[int, str]:
+        raise NotImplementedError
+
+    async def exec_stream(self, container_id: str,
+                          cmd: Optional[list[str]] = None) -> ShellSession:
+        """Interactive PTY exec in the container (tpu9 shell)."""
         raise NotImplementedError
 
     def capabilities(self) -> set[str]:

@@ -16,7 +16,8 @@ import shutil
 import signal
 from typing import Optional
 
-from .base import ContainerHandle, ContainerSpec, Runtime, RuntimeState
+from .base import (ContainerHandle, ContainerSpec, Runtime, RuntimeState,
+                   ShellSession)
 
 _ENV_ALLOWLIST = ("PATH", "HOME", "LANG", "TERM")
 
@@ -30,6 +31,7 @@ class ProcessRuntime(Runtime):
         self._handles: dict[str, ContainerHandle] = {}
         self._waiters: dict[str, asyncio.Task] = {}
         self._log_tasks: dict[str, list[asyncio.Task]] = {}
+        self._specs: dict[str, ContainerSpec] = {}
 
     def sandbox_dir(self, container_id: str) -> str:
         return os.path.join(self.base_dir, container_id)
@@ -62,6 +64,7 @@ class ProcessRuntime(Runtime):
                                  state=RuntimeState.RUNNING)
         self._procs[spec.container_id] = proc
         self._handles[spec.container_id] = handle
+        self._specs[spec.container_id] = spec
 
         async def pump(stream, name):
             while True:
@@ -142,9 +145,35 @@ class ProcessRuntime(Runtime):
         out, _ = await proc.communicate()
         return (proc.returncode or 0, out.decode(errors="replace"))
 
+    async def exec_stream(self, container_id: str,
+                          cmd: Optional[list[str]] = None) -> "_PtySession":
+        """Interactive PTY exec in the container's sandbox/env context
+        (the `tpu9 shell` transport)."""
+        handle = self._handles.get(container_id)
+        if handle is None or handle.state != RuntimeState.RUNNING:
+            raise RuntimeError("container not running")
+        spec = self._specs.get(container_id)
+        env = {k: v for k in _ENV_ALLOWLIST
+               if (v := os.environ.get(k)) is not None}
+        if spec is not None:
+            env.update(spec.env)
+        env.setdefault("TERM", "xterm")
+        env["PS1"] = r"tpu9:\w$ "
+        cmd = cmd or [shutil.which("bash") or "/bin/sh", "-i"]
+
+        import pty as _pty
+        master, slave = _pty.openpty()
+        proc = await asyncio.create_subprocess_exec(
+            *cmd, cwd=self.sandbox_dir(container_id), env=env,
+            stdin=slave, stdout=slave, stderr=slave,
+            preexec_fn=os.setsid, close_fds=True)
+        os.close(slave)
+        return _PtySession(master, proc)
+
     async def cleanup(self, container_id: str, remove_sandbox: bool = True) -> None:
         self._procs.pop(container_id, None)
         self._handles.pop(container_id, None)
+        self._specs.pop(container_id, None)
         waiter = self._waiters.pop(container_id, None)
         if waiter:
             waiter.cancel()
@@ -154,4 +183,82 @@ class ProcessRuntime(Runtime):
             shutil.rmtree(self.sandbox_dir(container_id), ignore_errors=True)
 
     def capabilities(self) -> set[str]:
-        return {"exec", "logs"}
+        return {"exec", "exec_stream", "logs"}
+
+
+class _PtySession(ShellSession):
+    """PTY master wired into the event loop; output chunks land on the
+    queue, writes go straight to the master fd."""
+
+    def __init__(self, master_fd: int, proc: asyncio.subprocess.Process):
+        super().__init__()
+        self._fd = master_fd
+        self._proc = proc
+        self._loop = asyncio.get_running_loop()
+        self._closed = False
+        self._finished = False
+        self._loop.add_reader(master_fd, self._on_readable)
+        self._exit_task = asyncio.create_task(self._watch_exit())
+
+    def _on_readable(self) -> None:
+        try:
+            data = os.read(self._fd, 65536)
+        except OSError:          # EIO: slave side closed (process exited)
+            data = b""
+        if data:
+            self.output.put_nowait(data)
+        else:
+            # fd EOF only closes the pipe; the None terminator comes from
+            # the exit watcher AFTER exit_code is known — otherwise the
+            # consumer reads the terminator with exit_code still unset
+            self._close_fd()
+
+    async def _watch_exit(self) -> None:
+        self.exit_code = await self._proc.wait()
+        # give the reader a beat to drain buffered output, then finish
+        await asyncio.sleep(0.05)
+        self._close_fd()
+        self._finish()
+
+    def _close_fd(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._loop.remove_reader(self._fd)
+            os.close(self._fd)
+        except OSError:
+            pass
+
+    def _finish(self) -> None:
+        if not self._finished:
+            self._finished = True
+            self.output.put_nowait(None)
+
+    async def write(self, data: bytes) -> None:
+        if not self._closed:
+            try:
+                os.write(self._fd, data)
+            except OSError:
+                self._close_fd()
+
+    def resize(self, rows: int, cols: int) -> None:
+        if self._closed:
+            return
+        import fcntl
+        import struct
+        import termios
+        try:
+            fcntl.ioctl(self._fd, termios.TIOCSWINSZ,
+                        struct.pack("HHHH", rows, cols, 0, 0))
+        except OSError:
+            pass
+
+    async def close(self) -> None:
+        if self._proc.returncode is None:
+            try:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        self._close_fd()
+        # the exit watcher records the code and emits the terminator

@@ -167,5 +167,25 @@ class RuncRuntime(Runtime):
         out, _ = await proc.communicate()
         return (proc.returncode or 0, out.decode(errors="replace"))
 
+    async def exec_stream(self, container_id: str,
+                          cmd: Optional[list[str]] = None):
+        """Interactive shell via ``runc exec -t`` attached to a PTY (the
+        `tpu9 shell` transport on the OCI path)."""
+        import os as _os
+        import pty as _pty
+
+        from .process import _PtySession
+        handle = self._handles.get(container_id)
+        if handle is None:
+            raise RuntimeError("container not running")
+        cmd = cmd or ["/bin/sh", "-i"]
+        master, slave = _pty.openpty()
+        proc = await asyncio.create_subprocess_exec(
+            self.runc, "exec", "-t", container_id, *cmd,
+            stdin=slave, stdout=slave, stderr=slave,
+            preexec_fn=_os.setsid, close_fds=True)
+        _os.close(slave)
+        return _PtySession(master, proc)
+
     def capabilities(self) -> set[str]:
-        return {"exec", "logs", "oci", "devices"}
+        return {"exec", "exec_stream", "logs", "oci", "devices"}

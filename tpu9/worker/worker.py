@@ -126,6 +126,7 @@ class Worker:
             asyncio.create_task(self._request_loop()),
             asyncio.create_task(self._stop_loop()),
             asyncio.create_task(self._exec_loop()),
+            asyncio.create_task(self._shell_loop()),
         ]
         log.info("worker %s started (pool=%s chips=%d)", self.worker_id,
                  self.pool, self.tpu.chip_count)
@@ -254,6 +255,77 @@ class Worker:
                 asyncio.create_task(self._handle_exec(payload))
         finally:
             sub.close()
+
+    async def _shell_loop(self) -> None:
+        """Interactive shell attach requests (the reference uploads dropbear
+        into the container and tunnels TCP, shell/shell.go:53; tpu9 attaches
+        a runtime PTY and pumps it over the state bus)."""
+        sub = self.store.subscribe(f"container:shell:{self.worker_id}")
+        try:
+            while not self._stopping.is_set():
+                msg = await sub.get(timeout=1.0)
+                if msg is None:
+                    continue
+                _, payload = msg
+                if not payload:
+                    continue
+                asyncio.create_task(self._handle_shell(payload))
+        finally:
+            sub.close()
+
+    async def _handle_shell(self, payload: dict) -> None:
+        import base64
+        session_id = payload.get("session", "")
+        out_key = f"shell:out:{session_id}"
+        try:
+            shell = await self.runtime.exec_stream(
+                payload["container_id"], payload.get("cmd") or None)
+        except Exception as exc:   # noqa: BLE001 — reply instead of crash
+            await self.store.xadd(out_key, {"error": str(exc), "exit": -1})
+            return
+
+        # input rides a STREAM, not pubsub: the client's first keystrokes
+        # can land before this subscription exists, and streams replay
+        in_key = f"shell:in:{session_id}"
+
+        async def pump_in() -> None:
+            last_id = "0"
+            while shell.exit_code is None:
+                entries = await self.store.xread(in_key, last_id=last_id,
+                                                 timeout=1.0)
+                for eid, m in entries:
+                    last_id = eid
+                    if m.get("close"):
+                        await shell.close()
+                        return
+                    # client payloads are untrusted: a malformed frame must
+                    # not kill the pump (that would orphan the PTY forever)
+                    try:
+                        if m.get("resize"):
+                            rows, cols = m["resize"][:2]
+                            shell.resize(int(rows), int(cols))
+                        if m.get("d"):
+                            await shell.write(base64.b64decode(m["d"]))
+                    except Exception as exc:   # noqa: BLE001
+                        log.debug("shell %s: bad input frame %r: %s",
+                                  session_id, m, exc)
+
+        pump_task = asyncio.create_task(pump_in())
+        try:
+            while True:
+                chunk = await shell.output.get()
+                if chunk is None:
+                    break
+                await self.store.xadd(
+                    out_key, {"d": base64.b64encode(chunk).decode()},
+                    maxlen=4096)
+            await self.store.xadd(
+                out_key, {"exit": shell.exit_code
+                          if shell.exit_code is not None else -1})
+        finally:
+            pump_task.cancel()
+            await self.store.expire(out_key, 300.0)
+            await self.store.expire(in_key, 300.0)
 
     async def _handle_exec(self, payload: dict) -> None:
         try:
