@@ -1,29 +1,48 @@
 """Image build service (gateway side).
 
 Reference analogue: ``pkg/abstractions/image/build.go`` — the build gRPC
-service that validates/dedupes specs and streams build logs. tpu9 v1 executes
-builds in-process on the control-plane host (a build-pool worker execution
-mode slots in behind the same API; the reference runs builds in containers on
-a build pool, build.go:340).
+service that validates/dedupes specs and schedules builds **in build
+containers on workers** (build.go:62,340). Round 1 executed builds on the
+control-plane host; that handed tenants code execution on the gateway, so
+builds now ride the normal scheduler path: a ``build`` container runs
+``tpu9.runner.build`` which executes the steps in its own sandbox and
+uploads the chunked result through the authenticated image API.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
+import sys
+import time
 from typing import Optional
 
 from ..backend import BackendDB
 from ..images import ImageBuilder, ImageSpec
+from ..types import ContainerRequest, new_id
 
 log = logging.getLogger("tpu9.abstractions")
 
-
 class ImageService:
-    def __init__(self, backend: BackendDB, builder: ImageBuilder):
+    def __init__(self, backend: BackendDB, builder: ImageBuilder,
+                 scheduler=None, runner_env: Optional[dict] = None,
+                 runner_tokens=None, build_mode: str = "worker",
+                 build_cpu_millicores: int = 1000, build_memory_mb: int = 2048,
+                 build_timeout_s: float = 1800.0):
         self.backend = backend
         self.builder = builder
+        self.scheduler = scheduler
+        self.runner_env = runner_env if runner_env is not None else {}
+        self.runner_tokens = runner_tokens
+        # "worker": schedule build containers (production; reference shape).
+        # "local": legacy in-process build — single-tenant dev ONLY.
+        self.build_mode = build_mode if scheduler is not None else "local"
+        self.build_cpu = build_cpu_millicores
+        self.build_mem = build_memory_mb
+        self.build_timeout_s = build_timeout_s
         self._builds: dict[str, asyncio.Task] = {}
+        self._containers: dict[str, str] = {}    # image_id -> container_id
         self._logs: dict[str, list[str]] = {}
 
     async def verify(self, spec: ImageSpec,
@@ -41,15 +60,70 @@ class ImageService:
         await self.backend.grant_image_access(image_id, workspace_id)
         if self.builder.has_image(image_id):
             return {"image_id": image_id, "status": "ready"}
-        if image_id not in self._builds or self._builds[image_id].done():
-            self._logs[image_id] = []
-            await self.backend.upsert_image(image_id, workspace_id,
-                                            spec.to_dict(), status="building")
+        row = await self.backend.get_image(image_id)
+        if (row is not None and row["status"] == "building"
+                and await self._build_in_flight(image_id)):
+            return {"image_id": image_id, "status": "building"}
+        self._logs[image_id] = []
+        # mark in-flight BEFORE the first await below — two concurrent build
+        # calls must not both pass the in-flight check and schedule twice
+        if self.build_mode == "worker":
+            request = self._build_request(workspace_id, spec)
+            self._containers[image_id] = request.container_id
+        else:
             self._builds[image_id] = asyncio.create_task(
-                self._run_build(workspace_id, spec))
+                self._run_build_local(workspace_id, spec))
+        await self.backend.upsert_image(image_id, workspace_id,
+                                        spec.to_dict(), status="building")
+        if self.build_mode == "worker":
+            await self._finish_schedule(workspace_id, spec, request)
         return {"image_id": image_id, "status": "building"}
 
-    async def _run_build(self, workspace_id: str, spec: ImageSpec) -> None:
+    async def _build_in_flight(self, image_id: str) -> bool:
+        """Is some build for this image actually still alive? A build
+        container that died without reporting (OOM, worker lost) must not
+        block rebuilds forever."""
+        task = self._builds.get(image_id)
+        if task is not None and not task.done():
+            return True
+        container_id = self._containers.get(image_id)
+        if container_id and self.scheduler is not None:
+            state = await self.scheduler.containers.get_state(container_id)
+            if state is not None and state.status not in ("failed", "stopped"):
+                return True
+            self._containers.pop(image_id, None)
+        return False
+
+    def _build_request(self, workspace_id: str,
+                       spec: ImageSpec) -> ContainerRequest:
+        return ContainerRequest(
+            container_id=new_id("bld"),
+            stub_id=f"build-{spec.image_id}",
+            workspace_id=workspace_id,
+            stub_type="build",
+            cpu_millicores=self.build_cpu,
+            memory_mb=self.build_mem,
+            # no explicit entrypoint: the lifecycle resolves stub_type
+            # "build" to tpu9.runner.build and wires PYTHONPATH for it
+        )
+
+    async def _finish_schedule(self, workspace_id: str, spec: ImageSpec,
+                               request: ContainerRequest) -> None:
+        """Run the build in a container on a worker (build.go:62)."""
+        env = dict(self.runner_env)
+        env["TPU9_BUILD_SPEC"] = json.dumps(spec.to_dict())
+        if self.runner_tokens is not None:
+            env["TPU9_TOKEN"] = await self.runner_tokens.get(workspace_id)
+        import os
+        for passthrough in ("TPU9_NO_EGRESS", "TPU9_WHEEL_DIR"):
+            if os.environ.get(passthrough):
+                env[passthrough] = os.environ[passthrough]
+        request.env = env
+        await self.scheduler.run(request)
+
+    async def _run_build_local(self, workspace_id: str,
+                               spec: ImageSpec) -> None:
+        """Legacy in-process build (dev-only fallback when no scheduler)."""
         image_id = spec.image_id
 
         def log_cb(line: str) -> None:
@@ -67,12 +141,57 @@ class ImageService:
             await self.backend.upsert_image(image_id, workspace_id,
                                             spec.to_dict(), status="failed")
 
+    # -- upload API (called by the build runner through the gateway) --------
+
+    def accept_chunk(self, digest: str, data: bytes) -> bool:
+        return self.builder.store_chunk_verified(data, digest)
+
+    async def accept_manifest(self, image_id: str, workspace_id: str,
+                              blob: str) -> dict:
+        from ..images import ImageManifest
+        if self.builder.has_image(image_id):
+            # first writer wins: a built image is immutable (content-derived
+            # id); an overwrite could only be a duplicate or an attack
+            return {"error": "image already built"}
+        try:
+            manifest = ImageManifest.from_json(blob)
+        except Exception as exc:   # noqa: BLE001 — invalid upload is a 400
+            return {"error": f"bad manifest: {exc}"}
+        if manifest.image_id != image_id:
+            return {"error": "manifest image_id mismatch"}
+        missing = self.builder.store_manifest(image_id, manifest)
+        if missing:
+            return {"error": f"{len(missing)} chunks missing",
+                    "missing": missing[:10]}
+        row = await self.backend.get_image(image_id)
+        spec = row["spec"] if row else {}
+        await self.backend.upsert_image(
+            image_id, workspace_id, spec, status="ready",
+            manifest_hash=manifest.manifest_hash,
+            size=manifest.total_bytes)
+        return {"ok": True}
+
+    async def complete(self, image_id: str, workspace_id: str, ok: bool,
+                       logs: list[str]) -> None:
+        self._logs.setdefault(image_id, []).extend(logs)
+        self._containers.pop(image_id, None)
+        if not ok:
+            row = await self.backend.get_image(image_id)
+            spec = row["spec"] if row else {}
+            await self.backend.upsert_image(image_id, workspace_id, spec,
+                                            status="failed")
+
     async def status(self, image_id: str) -> dict:
         if self.builder.has_image(image_id):
             return {"image_id": image_id, "status": "ready",
                     "logs": self._logs.get(image_id, [])}
         row = await self.backend.get_image(image_id)
         status = row["status"] if row else "unknown"
+        if status == "building":
+            # a build whose container died without reporting must not poll
+            # forever: surface staleness through the record's age
+            if time.time() - row.get("created_at", 0) > self.build_timeout_s:
+                status = "failed"
         return {"image_id": image_id, "status": status,
                 "logs": self._logs.get(image_id, [])}
 

@@ -354,7 +354,7 @@ class BackendDB:
                            size: int = 0) -> None:
         self._exec(
             "INSERT INTO images (image_id, workspace_id, manifest_hash, size, status, spec_json, created_at) VALUES (?,?,?,?,?,?,?) "
-            "ON CONFLICT(image_id) DO UPDATE SET manifest_hash=excluded.manifest_hash, size=excluded.size, status=excluded.status",
+            "ON CONFLICT(image_id) DO UPDATE SET manifest_hash=excluded.manifest_hash, size=excluded.size, status=excluded.status, created_at=excluded.created_at",
             (image_id, workspace_id, manifest_hash, size, status,
              json.dumps(spec, sort_keys=True), now()))
 

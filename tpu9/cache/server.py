@@ -30,6 +30,7 @@ class ChunkServer:
         self.host = host
         self.port = port
         self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set[asyncio.StreamWriter] = set()
 
     @property
     def address(self) -> str:
@@ -44,11 +45,26 @@ class ChunkServer:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+            # peers hold PERSISTENT connections (CacheClient._conns), and
+            # Server.wait_closed (≥3.12.1) waits for every live handler —
+            # stopping a worker must not deadlock on another live worker's
+            # idle connection, so sever them first
+            for w in list(self._conns):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            try:
+                await asyncio.wait_for(self._server.wait_closed(),
+                                       timeout=5.0)
+            except asyncio.TimeoutError:
+                log.warning("chunk server close timed out with "
+                            "%d connections", len(self._conns))
             self._server = None
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
         try:
             while True:
                 try:
@@ -82,6 +98,7 @@ class ChunkServer:
                                             "error": f"bad op {op!r}"}))
                 await writer.drain()
         finally:
+            self._conns.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()

@@ -112,6 +112,13 @@ class ImageConfig:
     registry_dir: str = "/tmp/tpu9/registry"   # content-addressed image store
     build_timeout_s: float = 1800.0
     python_version: str = "python3.11"
+    # "worker": builds run in scheduled build containers (production);
+    # "local": in-process on the gateway host — single-tenant dev ONLY
+    build_mode: str = "worker"
+    # build-container sizing (reference build pools use dedicated sizing);
+    # defaults fit a 1-core dev host — raise for heavy pip graphs
+    build_cpu_millicores: int = 1000
+    build_memory_mb: int = 2048
 
 
 @dataclass

@@ -85,7 +85,14 @@ class Gateway:
         self.images = ImageService(
             self.backend,
             ImageBuilder(cfg.image.registry_dir,
-                         network_ok=not os.environ.get("TPU9_NO_EGRESS")))
+                         network_ok=not os.environ.get("TPU9_NO_EGRESS")),
+            scheduler=self.scheduler,
+            runner_env=self.runner_env,
+            runner_tokens=self.runner_tokens,
+            build_mode=cfg.image.build_mode,
+            build_timeout_s=cfg.image.build_timeout_s,
+            build_cpu_millicores=cfg.image.build_cpu_millicores,
+            build_memory_mb=cfg.image.build_memory_mb)
         self.pods = PodService(self.backend, self.scheduler, self.containers,
                                self.store, runner_env=self.runner_env,
                                runner_tokens=self.runner_tokens)
@@ -157,6 +164,12 @@ class Gateway:
         r.add_get("/rpc/image/status/{image_id}", self._rpc_image_status)
         r.add_get("/rpc/image/manifest/{image_id}", self._rpc_image_manifest)
         r.add_get("/rpc/image/chunk/{digest}", self._rpc_image_chunk)
+        # build-runner upload API (runner/worker tokens)
+        r.add_post("/rpc/image/chunk/{digest}", self._rpc_image_chunk_put)
+        r.add_post("/rpc/image/manifest/{image_id}",
+                   self._rpc_image_manifest_put)
+        r.add_post("/rpc/image/complete/{image_id}",
+                   self._rpc_image_complete)
         # REST v1 (management)
         r.add_get("/api/v1/deployment", self._list_deployments)
         r.add_delete("/api/v1/deployment/{id}", self._delete_deployment)
@@ -843,6 +856,55 @@ class Gateway:
             return web.json_response({"error": "chunk not found"}, status=404)
         return web.Response(body=data,
                             content_type="application/octet-stream")
+
+    async def _image_uploader_ws(self, request: web.Request,
+                                 image_id: str) -> Optional[str]:
+        """Authorize a build-runner upload. STRICTER than read access: only
+        the workspace that owns the image ROW (the build requester — whose
+        runner token the build container carries) may upload, not
+        dedupe-granted readers; otherwise any tenant who proves knowledge of
+        a spec could overwrite the shared image other tenants execute.
+        Returns the workspace id to record, or None → 404."""
+        ws = self._ws(request)
+        if request.get("is_worker"):
+            return ws.workspace_id
+        row = await self.backend.get_image(image_id)
+        if row is not None and row["workspace_id"] == ws.workspace_id:
+            return ws.workspace_id
+        return None
+
+    async def _rpc_image_chunk_put(self, request: web.Request) -> web.Response:
+        # chunks are content-addressed and verified against their digest, so
+        # any authenticated runner may contribute them (a bad upload can't
+        # poison another image — mismatches are rejected)
+        self._ws(request)
+        digest = request.match_info["digest"]
+        data = await request.read()
+        if not self.images.accept_chunk(digest, data):
+            return web.json_response({"error": "digest mismatch"}, status=400)
+        return web.json_response({"ok": True})
+
+    async def _rpc_image_manifest_put(self, request: web.Request) -> web.Response:
+        image_id = request.match_info["image_id"]
+        workspace_id = await self._image_uploader_ws(request, image_id)
+        if workspace_id is None:
+            return web.json_response({"error": "image not found"}, status=404)
+        out = await self.images.accept_manifest(
+            image_id, workspace_id, await request.text())
+        if "error" in out:
+            return web.json_response(out, status=400)
+        return web.json_response(out)
+
+    async def _rpc_image_complete(self, request: web.Request) -> web.Response:
+        image_id = request.match_info["image_id"]
+        workspace_id = await self._image_uploader_ws(request, image_id)
+        if workspace_id is None:
+            return web.json_response({"error": "image not found"}, status=404)
+        data = await request.json()
+        await self.images.complete(image_id, workspace_id,
+                                   bool(data.get("ok")),
+                                   list(data.get("logs", [])))
+        return web.json_response({"ok": True})
 
     # -- handlers: invoke ------------------------------------------------------
 

@@ -74,6 +74,31 @@ class ImageBuilder:
             f.write(data)
         os.rename(tmp, p)
 
+    def store_chunk_verified(self, data: bytes, digest: str) -> bool:
+        """Store an uploaded chunk iff its content matches the digest —
+        content addressing makes tampered uploads self-evident."""
+        import hashlib
+        if hashlib.sha256(data).hexdigest() != digest:
+            return False
+        self._store_chunk(data, digest)
+        return True
+
+    def store_manifest(self, image_id: str, manifest: ImageManifest) -> list[str]:
+        """Persist an uploaded manifest; returns digests it references that
+        are NOT in the chunk store (callers reject incomplete uploads)."""
+        missing = [d for d in dict.fromkeys(manifest.all_chunks())
+                   if not os.path.exists(self.chunk_path(d))]
+        if missing:
+            return missing
+        # atomic like _store_chunk: a torn manifest would read as a "ready"
+        # image that crashes every puller with no rebuild path
+        path = self.manifest_path(image_id)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(manifest.to_json())
+        os.rename(tmp, path)
+        return []
+
     # -- building ------------------------------------------------------------
 
     async def build(self, spec: ImageSpec,
@@ -90,6 +115,12 @@ class ImageBuilder:
             if log_cb:
                 log_cb(line)
 
+        if spec.from_registry:
+            # the OCI pull lives in the build runner (worker mode) only —
+            # succeeding here without the rootfs would mark a broken image
+            # ready
+            raise BuildError(
+                "from_registry images require build_mode='worker'")
         scratch = tempfile.mkdtemp(prefix="tpu9-build-")
         try:
             env_dir = os.path.join(scratch, "env")

@@ -35,6 +35,10 @@ class ImageManifest:
     env: dict[str, str] = field(default_factory=dict)
     python_version: str = ""
     total_bytes: int = 0
+    # "env" = snapshot overlaying the host fs; "oci" = full root filesystem
+    # under rootfs/ (runc chroots into it — decided at build time, never
+    # inferred from directory layout)
+    kind: str = "env"
 
     def to_json(self) -> str:
         return json.dumps({
@@ -42,6 +46,7 @@ class ImageManifest:
             "python_version": self.python_version,
             "env": self.env,
             "total_bytes": self.total_bytes,
+            "kind": self.kind,
             "files": [{"path": f.path, "mode": f.mode, "size": f.size,
                        "chunks": f.chunks, "link_target": f.link_target}
                       for f in self.files],
@@ -55,6 +60,7 @@ class ImageManifest:
             python_version=d.get("python_version", ""),
             env=d.get("env", {}),
             total_bytes=d.get("total_bytes", 0),
+            kind=d.get("kind", "env"),
             files=[FileEntry(**f) for f in d.get("files", [])],
         )
 

@@ -77,7 +77,8 @@ class ImagePuller:
             import json
             with open(os.path.join(tmp, ".tpu9-env.json"), "w") as f:
                 json.dump({"env": manifest.env,
-                           "python_version": manifest.python_version}, f)
+                           "python_version": manifest.python_version,
+                           "kind": manifest.kind}, f)
             with open(os.path.join(tmp, ".tpu9-complete"), "w") as f:
                 f.write(manifest.manifest_hash)
             shutil.rmtree(dest, ignore_errors=True)

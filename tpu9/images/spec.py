@@ -24,6 +24,9 @@ class ImageSpec:
     env: dict[str, str] = field(default_factory=dict)
     base_image: str = ""                 # optional base manifest to extend
     include_host_site_packages: bool = False
+    # OCI registry ref ("python:3.12", "127.0.0.1:5000/app:v1") — layers are
+    # pulled and unpacked into a rootfs/ tree before commands run
+    from_registry: str = ""
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -35,6 +38,12 @@ class ImageSpec:
 
     @property
     def image_id(self) -> str:
-        """Deterministic id: same spec → same image (dedupe at build)."""
-        blob = json.dumps(self.to_dict(), sort_keys=True).encode()
+        """Deterministic id: same spec → same image (dedupe at build).
+        Fields added after round 1 join the hash only when set, so every
+        previously built image keeps its id across upgrades."""
+        d = self.to_dict()
+        for late_field in ("from_registry",):
+            if not d.get(late_field):
+                d.pop(late_field, None)
+        blob = json.dumps(d, sort_keys=True).encode()
         return "img-" + hashlib.sha256(blob).hexdigest()[:16]

@@ -1,0 +1,158 @@
+"""Build runner: executes an image build INSIDE a scheduled container.
+
+Reference analogue: ``pkg/abstractions/image/build.go:62,279`` — the build
+service schedules a build container on a worker and drives the steps there.
+Round 1 ran builds on the gateway host (``asyncio.to_thread`` + subprocess),
+which handed tenants arbitrary code execution on the control plane; this
+runner restores the reference's isolation: the commands run in THIS
+container's sandbox on a worker, and the result is chunked and uploaded to
+the gateway's registry over the authenticated image API.
+
+Env contract (set by ImageService when scheduling the build):
+  TPU9_BUILD_SPEC    image spec JSON
+  TPU9_GATEWAY_URL   gateway base url
+  TPU9_TOKEN         runner token (workspace-scoped)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import aiohttp
+
+from ..images import ImageSpec
+from ..images.manifest import snapshot_dir
+
+
+async def amain() -> int:
+    spec = ImageSpec.from_dict(json.loads(os.environ["TPU9_BUILD_SPEC"]))
+    gateway = os.environ["TPU9_GATEWAY_URL"].rstrip("/")
+    token = os.environ["TPU9_TOKEN"]
+    image_id = spec.image_id
+    scratch = os.path.join(os.getcwd(), "build")
+    os.makedirs(scratch, exist_ok=True)
+    log_lines: list[str] = []
+
+    def emit(line: str) -> None:
+        log_lines.append(line)
+        print(line, flush=True)
+
+    async with aiohttp.ClientSession(headers={
+            "Authorization": f"Bearer {token}"}) as session:
+
+        async def finish(ok: bool) -> None:
+            await session.post(
+                f"{gateway}/rpc/image/complete/{image_id}",
+                json={"ok": ok, "logs": log_lines[-200:]})
+
+        try:
+            env_dir = os.path.join(scratch, "env")
+            os.makedirs(env_dir, exist_ok=True)
+            oci_env: dict[str, str] = {}
+
+            if spec.from_registry:
+                from ..images.oci import OciClient, aiohttp_transport
+                rootfs = os.path.join(scratch, "rootfs")
+                # NOT the gateway session: its Authorization header (runner
+                # token) must never reach a registry
+                client = OciClient(aiohttp_transport())
+                config = await client.pull(spec.from_registry, rootfs,
+                                           log_cb=emit)
+                for kv in config.get("Env") or []:
+                    k, _, v = kv.partition("=")
+                    oci_env[k] = v
+
+            if spec.python_packages:
+                site = os.path.join(env_dir, "site-packages")
+                os.makedirs(site, exist_ok=True)
+                cmd = [sys.executable, "-m", "pip", "install", "--target",
+                       site, "--no-compile"]
+                wheel_dir = os.environ.get("TPU9_WHEEL_DIR", "")
+                if os.environ.get("TPU9_NO_EGRESS"):
+                    if not wheel_dir:
+                        raise RuntimeError(
+                            "package install requested but no network and "
+                            "no wheel dir")
+                    cmd += ["--no-index", "--find-links", wheel_dir]
+                elif wheel_dir:
+                    cmd += ["--find-links", wheel_dir]
+                cmd += spec.python_packages
+                emit(f"pip install {' '.join(spec.python_packages)}")
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=1800)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"pip install failed:\n{proc.stderr[-3000:]}")
+
+            for cmd_line in spec.commands:
+                emit(f"RUN {cmd_line}")
+                proc = subprocess.run(cmd_line, shell=True, cwd=scratch,
+                                      capture_output=True, text=True,
+                                      timeout=1800)
+                if proc.stdout:
+                    emit(proc.stdout[-2000:])
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"command failed ({proc.returncode}): {cmd_line}\n"
+                        f"{proc.stderr[-2000:]}")
+
+            emit("snapshotting environment")
+            pending: list[tuple[str, bytes]] = []
+
+            def put_chunk(data: bytes, digest: str) -> None:
+                pending.append((digest, data))
+
+            manifest = snapshot_dir(scratch, put_chunk=put_chunk)
+            manifest.image_id = image_id
+            manifest.python_version = spec.python_version
+            manifest.kind = "oci" if spec.from_registry else "env"
+            # precedence: OCI config env < spec env (user declarations win)
+            manifest.env = {**oci_env, **spec.env}
+            if spec.python_packages:
+                manifest.env.setdefault("TPU9_IMAGE_SITE",
+                                        "env/site-packages")
+
+            emit(f"uploading {len(pending)} chunks")
+            sem = asyncio.Semaphore(8)
+
+            async def upload(digest: str, data: bytes) -> None:
+                async with sem:
+                    async with session.post(
+                            f"{gateway}/rpc/image/chunk/{digest}",
+                            data=data) as resp:
+                        if resp.status != 200:
+                            raise RuntimeError(
+                                f"chunk upload {digest[:12]} failed: "
+                                f"{resp.status} {await resp.text()}")
+
+            await asyncio.gather(*[upload(d, b) for d, b in pending])
+            async with session.post(
+                    f"{gateway}/rpc/image/manifest/{image_id}",
+                    data=manifest.to_json()) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"manifest upload failed: {resp.status} "
+                        f"{await resp.text()}")
+            emit(f"built {image_id}: {len(manifest.files)} files, "
+                 f"{manifest.total_bytes >> 20} MiB")
+            await finish(True)
+            return 0
+        except Exception as exc:   # noqa: BLE001 — report, don't crash silent
+            emit(f"BUILD FAILED: {exc}")
+            try:
+                await finish(False)
+            except Exception:
+                pass
+            return 1
+
+
+def main() -> None:
+    sys.exit(asyncio.run(amain()))
+
+
+if __name__ == "__main__":
+    main()

@@ -82,7 +82,11 @@ def oci_spec_from(spec: ContainerSpec) -> dict:
                              ("bounding", "effective", "permitted")},
             "noNewPrivileges": False,
         },
-        "root": {"path": spec.rootfs or "rootfs", "readonly": False},
+        # OCI-pulled snapshots chroot into <bundle>/rootfs; env snapshots
+        # use the bundle dir itself. Decided by build-time metadata, not
+        # directory layout — a user build step creating a rootfs/ dir must
+        # not hijack the container root.
+        "root": {"path": _root_path(spec.rootfs), "readonly": False},
         "hostname": spec.container_id,
         "mounts": mounts,
         "linux": {
@@ -92,6 +96,19 @@ def oci_spec_from(spec: ContainerSpec) -> dict:
                            ("pid", "ipc", "uts", "mount")],
         },
     }
+
+
+def _root_path(bundle: str) -> str:
+    if not bundle:
+        return "rootfs"
+    meta = os.path.join(bundle, ".tpu9-env.json")
+    try:
+        with open(meta) as f:
+            if json.load(f).get("kind") == "oci":
+                return os.path.join(bundle, "rootfs")
+    except (OSError, ValueError):
+        pass
+    return bundle
 
 
 class RuncRuntime(Runtime):

@@ -55,6 +55,17 @@ class Image:
         return self
 
     @classmethod
+    def from_registry(cls, ref: str,
+                      python_version: str = "python3.11") -> "Image":
+        """An OCI registry image ('python:3.12', 'my.registry/app:v1') —
+        layers are pulled into a rootfs/ tree by the build container and
+        snapshotted through the same chunked manifest as every other image
+        (reference: Image.from_registry / skopeo path)."""
+        img = cls(python_version=python_version)
+        img.spec.from_registry = ref
+        return img
+
+    @classmethod
     def from_dockerfile(cls, path: str) -> "Image":
         """Parse the RUN/ENV subset of a Dockerfile into an env-snapshot spec
         (FROM layers outside the python env are not replicated)."""

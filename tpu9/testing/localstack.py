@@ -90,8 +90,19 @@ class LocalStack:
     async def stop(self) -> None:
         if self._session:
             await self._session.close()
+        # workers created directly via _worker_factory in tests are not in
+        # the pool's list — stop them too or their runner subprocesses and
+        # cache servers outlive the test (snapshot before shutdown clears it)
+        pool_workers = set(id(w) for w in (self.pool.workers
+                                           if self.pool else []))
         if self.pool:
             await self.pool.shutdown()
+        for w in self.workers:
+            if id(w) not in pool_workers:
+                try:
+                    await w.stop()
+                except Exception:
+                    pass
         if self.gateway:
             await self.gateway.stop()
         self.tmp.cleanup()

@@ -53,8 +53,10 @@ class WorkerCache:
         return self
 
     async def stop(self) -> None:
-        await self.server.stop()
+        # client first: our outgoing peer connections close before the
+        # server starts severing inbound ones
         await self.client.close()
+        await self.server.stop()
 
     async def resolve_image(self, image_id: str) -> str:
         return await self.puller.pull(image_id)
