@@ -1,0 +1,270 @@
+// t9container — namespace/chroot container launcher for tpu9's NativeRuntime.
+//
+// Reference analogue: the forked runc binary the reference worker drives
+// (pkg/runtime/runc.go; docker/Dockerfile.worker builds beam-cloud/runc).
+// tpu9 implements the containment primitives directly instead of shelling
+// out to an OCI runtime: new pid/mount/uts/ipc namespaces, optional join of
+// a pre-created network namespace, pivot_root into an (overlayfs) rootfs,
+// bind mounts, /proc + /dev essentials, then exec of the entrypoint as the
+// namespace's PID 1 (or under t9proc when a supervisor is requested).
+//
+// Invocation (trusted worker only — arguments are not an end-user surface):
+//   t9container --rootfs DIR [--workdir DIR] [--hostname NAME]
+//               [--netns NAME] [--bind SRC:DST[:ro]]... [--env-file FILE]
+//               [--dev PATH]... -- ARGV...
+//
+// env-file: NUL-separated KEY=VALUE entries (values may contain anything
+// but NUL). The child starts with a clean environment.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sched.h>
+#include <sys/mount.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+[[noreturn]] void die(const char* what) {
+  fprintf(stderr, "t9container: %s: %s\n", what, strerror(errno));
+  exit(111);
+}
+
+struct Bind {
+  std::string src, dst;
+  bool ro = false;
+};
+
+struct Opts {
+  std::string rootfs, workdir = "/", hostname, netns, env_file;
+  std::vector<Bind> binds;
+  std::vector<std::string> devices;
+  std::vector<char*> argv;
+  std::vector<std::string> env;   // loaded BEFORE pivot_root hides the file
+};
+
+Opts parse(int argc, char** argv) {
+  Opts o;
+  int i = 1;
+  for (; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) { fprintf(stderr, "missing value for %s\n", a.c_str()); exit(2); }
+      return argv[++i];
+    };
+    if (a == "--rootfs") o.rootfs = next();
+    else if (a == "--workdir") o.workdir = next();
+    else if (a == "--hostname") o.hostname = next();
+    else if (a == "--netns") o.netns = next();
+    else if (a == "--env-file") o.env_file = next();
+    else if (a == "--dev") o.devices.push_back(next());
+    else if (a == "--bind") {
+      std::string spec = next();
+      Bind b;
+      size_t p1 = spec.find(':');
+      size_t p2 = spec.find(':', p1 == std::string::npos ? p1 : p1 + 1);
+      if (p1 == std::string::npos) { fprintf(stderr, "bad --bind %s\n", spec.c_str()); exit(2); }
+      b.src = spec.substr(0, p1);
+      b.dst = p2 == std::string::npos ? spec.substr(p1 + 1)
+                                      : spec.substr(p1 + 1, p2 - p1 - 1);
+      b.ro = p2 != std::string::npos && spec.substr(p2 + 1) == "ro";
+      o.binds.push_back(b);
+    } else if (a == "--") { i++; break; }
+    else { fprintf(stderr, "unknown flag %s\n", a.c_str()); exit(2); }
+  }
+  for (; i < argc; i++) o.argv.push_back(argv[i]);
+  o.argv.push_back(nullptr);
+  if (o.rootfs.empty() || o.argv.size() < 2) {
+    fprintf(stderr, "usage: t9container --rootfs DIR [...] -- ARGV...\n");
+    exit(2);
+  }
+  return o;
+}
+
+std::vector<std::string> read_env_file(const std::string& path) {
+  std::vector<std::string> out;
+  if (path.empty()) return out;
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) die("open env-file");
+  std::string cur;
+  int c;
+  while ((c = fgetc(f)) != EOF) {
+    if (c == '\0') { if (!cur.empty()) out.push_back(cur); cur.clear(); }
+    else cur.push_back(static_cast<char>(c));
+  }
+  if (!cur.empty()) out.push_back(cur);
+  fclose(f);
+  return out;
+}
+
+void join_netns(const std::string& name) {
+  std::string path = "/run/netns/" + name;
+  int fd = open(path.c_str(), O_RDONLY | O_CLOEXEC);
+  if (fd < 0) die("open netns");
+  if (setns(fd, CLONE_NEWNET) != 0) die("setns net");
+  close(fd);
+}
+
+void mkdir_p(const std::string& path, mode_t mode) {
+  std::string cur;
+  for (size_t i = 0; i < path.size(); i++) {
+    cur.push_back(path[i]);
+    if ((path[i] == '/' && i > 0) || i + 1 == path.size()) {
+      if (mkdir(cur.c_str(), mode) != 0 && errno != EEXIST) die("mkdir");
+    }
+  }
+}
+
+void bind_mount(const std::string& src, const std::string& dst, bool ro) {
+  struct stat st{};
+  if (stat(src.c_str(), &st) != 0) die("bind source missing");
+  if (S_ISDIR(st.st_mode)) {
+    mkdir_p(dst, 0755);
+  } else {
+    mkdir_p(dst.substr(0, dst.rfind('/')), 0755);
+    int fd = open(dst.c_str(), O_CREAT | O_WRONLY | O_CLOEXEC, 0644);
+    if (fd >= 0) close(fd);
+  }
+  if (mount(src.c_str(), dst.c_str(), nullptr, MS_BIND | MS_REC, nullptr) != 0)
+    die("bind mount");
+  if (ro && mount(nullptr, dst.c_str(), nullptr,
+                  MS_BIND | MS_REMOUNT | MS_RDONLY, nullptr) != 0)
+    die("bind remount ro");
+}
+
+int child_main(void* arg) {
+  Opts& o = *static_cast<Opts*>(arg);
+
+  if (!o.hostname.empty() &&
+      sethostname(o.hostname.c_str(), o.hostname.size()) != 0)
+    die("sethostname");
+
+  // private mount propagation so nothing we do leaks to the host
+  if (mount(nullptr, "/", nullptr, MS_REC | MS_PRIVATE, nullptr) != 0)
+    die("make / private");
+
+  // rootfs must be a mount point for pivot_root
+  if (mount(o.rootfs.c_str(), o.rootfs.c_str(), nullptr, MS_BIND | MS_REC,
+            nullptr) != 0)
+    die("bind rootfs");
+
+  const std::string root = o.rootfs;
+  // /dev: tmpfs with the handful of nodes every runtime needs, bound from
+  // the host (mknod is blocked in many kernels' userns; bind is universal)
+  mkdir_p(root + "/dev", 0755);
+  if (mount("tmpfs", (root + "/dev").c_str(), "tmpfs", MS_NOSUID,
+            "mode=755,size=65536k") != 0)
+    die("mount /dev");
+  for (const char* n : {"null", "zero", "full", "random", "urandom", "tty"})
+    bind_mount(std::string("/dev/") + n, root + "/dev/" + n, false);
+  mkdir_p(root + "/dev/shm", 01777);
+  mount("tmpfs", (root + "/dev/shm").c_str(), "tmpfs", MS_NOSUID | MS_NODEV,
+        "mode=1777,size=268435456");
+  mkdir_p(root + "/dev/pts", 0755);
+  mount("devpts", (root + "/dev/pts").c_str(), "devpts", MS_NOSUID | MS_NOEXEC,
+        "newinstance,ptmxmode=0666,mode=0620");
+  // accelerator devices (TPU chips: /dev/accel*, vfio) requested explicitly
+  for (const auto& dev : o.devices)
+    bind_mount(dev, root + dev, false);
+
+  // /tmp BEFORE binds: a bind target under /tmp must land on top of the
+  // container's tmpfs, not get shadowed by it
+  mkdir_p(root + "/tmp", 01777);
+  mount("tmpfs", (root + "/tmp").c_str(), "tmpfs", MS_NOSUID | MS_NODEV,
+        "mode=1777");
+
+  for (const auto& b : o.binds) bind_mount(b.src, root + b.dst, b.ro);
+
+  // pivot into the rootfs
+  const std::string put_old = root + "/.t9-oldroot";
+  mkdir_p(put_old, 0700);
+  if (syscall(SYS_pivot_root, root.c_str(), put_old.c_str()) != 0)
+    die("pivot_root");
+  if (chdir("/") != 0) die("chdir /");
+  if (umount2("/.t9-oldroot", MNT_DETACH) != 0) die("umount oldroot");
+  rmdir("/.t9-oldroot");
+
+  // fresh /proc for the new pid namespace
+  mkdir_p("/proc", 0555);
+  if (mount("proc", "/proc", "proc", MS_NOSUID | MS_NOEXEC | MS_NODEV,
+            nullptr) != 0)
+    die("mount /proc");
+  mkdir_p("/sys", 0555);
+  // RO sysfs scoped to the container's netns (best effort: some kernels
+  // refuse sysfs mounts inside nested namespaces)
+  mount("sysfs", "/sys", "sysfs",
+        MS_RDONLY | MS_NOSUID | MS_NOEXEC | MS_NODEV, nullptr);
+
+  if (chdir(o.workdir.c_str()) != 0 && chdir("/") != 0) die("chdir workdir");
+
+  std::vector<char*> envp;
+  envp.reserve(o.env.size() + 1);
+  for (auto& e : o.env) envp.push_back(e.data());
+  envp.push_back(nullptr);
+
+  execvpe(o.argv[0], o.argv.data(), envp.data());
+  die("execvpe");
+}
+
+}  // namespace
+
+pid_t g_child = -1;
+
+void forward_signal(int sig) {
+  if (g_child <= 0) return;
+  // a pid-namespace init ignores signals it has no handler for, even from
+  // the parent namespace — forward the polite signal, then guarantee death
+  // with SIGKILL (always deliverable from an ancestor ns) after a grace
+  // period so a graceful stop can never orphan the workload
+  kill(g_child, sig);
+  if (sig == SIGTERM || sig == SIGINT) alarm(10);
+}
+
+void on_alarm(int) {
+  if (g_child > 0) kill(g_child, SIGKILL);
+}
+
+int main(int argc, char** argv) {
+  static Opts o = parse(argc, argv);
+  o.env = read_env_file(o.env_file);   // before pivot_root hides the path
+
+  // the netns join happens in the parent side of clone so the child's other
+  // namespaces nest inside it cleanly
+  if (!o.netns.empty()) join_netns(o.netns);
+
+  constexpr size_t kStack = 1 << 20;
+  static char stack[kStack];
+  int flags = CLONE_NEWPID | CLONE_NEWNS | CLONE_NEWUTS | CLONE_NEWIPC |
+              SIGCHLD;
+  pid_t pid = clone(child_main, stack + kStack, flags, &o);
+  if (pid < 0) die("clone");
+  g_child = pid;
+
+  struct sigaction sa{};
+  sa.sa_handler = forward_signal;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+  sigaction(SIGHUP, &sa, nullptr);
+  struct sigaction saa{};
+  saa.sa_handler = on_alarm;
+  sigaction(SIGALRM, &saa, nullptr);
+
+  int status = 0;
+  for (;;) {
+    pid_t got = waitpid(pid, &status, 0);
+    if (got == pid) break;
+    if (got < 0 && errno != EINTR) die("waitpid");
+  }
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
+  if (WIFSIGNALED(status)) return 128 + WTERMSIG(status);
+  return 1;
+}

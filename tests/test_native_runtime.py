@@ -1,0 +1,156 @@
+"""NativeRuntime: real containment (t9container namespaces + pivot_root +
+netns/veth + userspace port proxy + overlay). Root-gated — the reference
+gates its worker/network tests on privileges the same way
+(pkg/worker/network_test.go)."""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+from tpu9.runtime import NativeRuntime
+from tpu9.runtime.base import ContainerSpec
+
+pytestmark = [
+    pytest.mark.e2e,
+    pytest.mark.skipif(not NativeRuntime.supported(),
+                       reason="needs root + t9container + iproute2"),
+]
+
+
+def _spec(container_id: str, entrypoint, workdir: str = "",
+          ports=None) -> ContainerSpec:
+    return ContainerSpec(container_id=container_id, entrypoint=entrypoint,
+                         env={"TPU9_MARK": "native"}, workdir=workdir,
+                         ports=ports or {})
+
+
+async def _run_and_wait(rt: NativeRuntime, spec: ContainerSpec,
+                        timeout: float = 60.0):
+    lines: list[str] = []
+    rt_handle = await rt.run(spec, log_cb=lambda line, s: lines.append(line))
+    code = await asyncio.wait_for(rt.wait(spec.container_id), timeout)
+    return code, lines
+
+
+async def test_pid_hostname_env_isolation(tmp_path):
+    rt = NativeRuntime(base_dir=str(tmp_path))
+    code, lines = await _run_and_wait(rt, _spec(
+        "nat-iso1", ["/bin/sh", "-c",
+                     "echo pid=$$; hostname; echo mark=$TPU9_MARK; "
+                     "ls /tmp | wc -l"]))
+    try:
+        assert code == 0, lines
+        assert "pid=1" in lines            # PID namespace: entrypoint is init
+        assert "nat-iso1" in lines         # UTS namespace: own hostname
+        assert "mark=native" in lines      # env file delivered
+        assert lines[-1].strip() == "0"    # fresh /tmp — host's is invisible
+    finally:
+        await rt.cleanup("nat-iso1")
+
+
+async def test_workdir_bind_rw(tmp_path):
+    rt = NativeRuntime(base_dir=str(tmp_path / "rt"))
+    work = tmp_path / "work"
+    work.mkdir()
+    code, lines = await _run_and_wait(rt, _spec(
+        "nat-wd", ["/bin/sh", "-c", "pwd && echo out > result.txt"],
+        workdir=str(work)))
+    try:
+        assert code == 0, lines
+        assert (work / "result.txt").read_text().strip() == "out"
+    finally:
+        await rt.cleanup("nat-wd")
+
+
+async def test_egress_blocked_but_host_reachable(tmp_path):
+    """The netns reaches the host veth peer, and nothing beyond — the
+    reference's egress blocking (network.go:275) by construction."""
+    rt = NativeRuntime(base_dir=str(tmp_path))
+
+    # host-side listener bound to the veth address must be reachable;
+    # 1.1.1.1 must not (no route at all, fails fast)
+    probe = (
+        "import socket, sys\n"
+        "host = sys.argv[1]\n"
+        "s = socket.socket(); s.settimeout(3)\n"
+        "try:\n"
+        "    s.connect((host, int(sys.argv[2]))); print('CONNECT-OK')\n"
+        "except OSError as e: print('CONNECT-FAIL', type(e).__name__)\n"
+    )
+    server = await asyncio.start_server(
+        lambda r, w: w.close(), "0.0.0.0", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        spec = _spec("nat-net", ["/bin/sh", "-c", (
+            f"{sys.executable} -c \"{probe}\" $TPU9_HOST_IP {port}; "
+            f"{sys.executable} -c \"{probe}\" 1.1.1.1 80")])
+        code, lines = await _run_and_wait(rt, spec)
+        assert code == 0, lines
+        assert "CONNECT-OK" in lines, lines          # host reachable
+        assert any("CONNECT-FAIL" in l for l in lines), lines  # egress dead
+    finally:
+        server.close()
+        await rt.cleanup("nat-net")
+
+
+async def test_port_proxy_round_trip(tmp_path):
+    rt = NativeRuntime(base_dir=str(tmp_path))
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv = (
+        "import http.server, functools\n"
+        "h = http.server.SimpleHTTPRequestHandler\n"
+        f"http.server.HTTPServer(('0.0.0.0', {port}), h).serve_forever()\n"
+    )
+    spec = _spec("nat-proxy", [sys.executable, "-c", srv],
+                 ports={port: port})
+    await rt.run(spec, log_cb=lambda l, s: None)
+    try:
+        import aiohttp
+        ok = False
+        async with aiohttp.ClientSession() as session:
+            for _ in range(60):
+                try:
+                    async with session.get(
+                            f"http://127.0.0.1:{port}/") as resp:
+                        ok = resp.status == 200
+                        break
+                except aiohttp.ClientError:
+                    await asyncio.sleep(0.25)
+        assert ok, "proxied HTTP request never succeeded"
+    finally:
+        await rt.kill("nat-proxy", 9)
+        await rt.wait("nat-proxy")
+        await rt.cleanup("nat-proxy")
+
+
+async def test_exec_in_namespaces(tmp_path):
+    rt = NativeRuntime(base_dir=str(tmp_path))
+    spec = _spec("nat-exec", ["/bin/sh", "-c", "sleep 30"])
+    await rt.run(spec, log_cb=lambda l, s: None)
+    try:
+        await asyncio.sleep(0.5)
+        code, out = await rt.exec("nat-exec", ["hostname"])
+        assert code == 0
+        assert out.strip() == "nat-exec"
+    finally:
+        await rt.kill("nat-exec", 9)
+        await rt.wait("nat-exec")
+        await rt.cleanup("nat-exec")
+
+
+async def test_e2e_endpoint_under_native_runtime(tmp_path, monkeypatch):
+    """The flagship check from VERDICT item 3: the serving path runs under
+    real containment."""
+    monkeypatch.setenv("TPU9_RUNTIME", "native")
+    from tpu9.testing.localstack import LocalStack
+    async with LocalStack() as stack:
+        dep = await stack.deploy_echo_endpoint("native-echo")
+        out = await stack.invoke(dep, {"x": 42}, timeout=120.0)
+        assert out["echo"] == {"x": 42}
+        running = await stack.running_containers(dep["stub_id"])
+        assert len(running) == 1

@@ -44,6 +44,7 @@ class ImageService:
         self._builds: dict[str, asyncio.Task] = {}
         self._containers: dict[str, str] = {}    # image_id -> container_id
         self._logs: dict[str, list[str]] = {}
+        self._locks: dict[str, asyncio.Lock] = {}   # per-image build gate
 
     async def verify(self, spec: ImageSpec,
                      workspace_id: str = "") -> dict:
@@ -58,25 +59,27 @@ class ImageService:
     async def build(self, workspace_id: str, spec: ImageSpec) -> dict:
         image_id = spec.image_id
         await self.backend.grant_image_access(image_id, workspace_id)
-        if self.builder.has_image(image_id):
-            return {"image_id": image_id, "status": "ready"}
-        row = await self.backend.get_image(image_id)
-        if (row is not None and row["status"] == "building"
-                and await self._build_in_flight(image_id)):
-            return {"image_id": image_id, "status": "building"}
-        self._logs[image_id] = []
-        # mark in-flight BEFORE the first await below — two concurrent build
-        # calls must not both pass the in-flight check and schedule twice
-        if self.build_mode == "worker":
-            request = self._build_request(workspace_id, spec)
-            self._containers[image_id] = request.container_id
-        else:
-            self._builds[image_id] = asyncio.create_task(
-                self._run_build_local(workspace_id, spec))
-        await self.backend.upsert_image(image_id, workspace_id,
-                                        spec.to_dict(), status="building")
-        if self.build_mode == "worker":
-            await self._finish_schedule(workspace_id, spec, request)
+        # one build decision at a time per image: concurrent calls must not
+        # both conclude "nothing in flight" and schedule duplicate builds
+        lock = self._locks.setdefault(image_id, asyncio.Lock())
+        async with lock:
+            if self.builder.has_image(image_id):
+                return {"image_id": image_id, "status": "ready"}
+            row = await self.backend.get_image(image_id)
+            if (row is not None and row["status"] == "building"
+                    and await self._build_in_flight(image_id)):
+                return {"image_id": image_id, "status": "building"}
+            self._logs[image_id] = []
+            if self.build_mode == "worker":
+                request = self._build_request(workspace_id, spec)
+                self._containers[image_id] = request.container_id
+            else:
+                self._builds[image_id] = asyncio.create_task(
+                    self._run_build_local(workspace_id, spec))
+            await self.backend.upsert_image(image_id, workspace_id,
+                                            spec.to_dict(), status="building")
+            if self.build_mode == "worker":
+                await self._finish_schedule(workspace_id, spec, request)
         return {"image_id": image_id, "status": "building"}
 
     async def _build_in_flight(self, image_id: str) -> bool:
@@ -89,6 +92,9 @@ class ImageService:
         container_id = self._containers.get(image_id)
         if container_id and self.scheduler is not None:
             state = await self.scheduler.containers.get_state(container_id)
+            # scheduler.run writes PENDING state synchronously (and the
+            # build lock covers schedule-to-return), so a missing state
+            # means the TTL expired — the build is dead, not "too new"
             if state is not None and state.status not in ("failed", "stopped"):
                 return True
             self._containers.pop(image_id, None)

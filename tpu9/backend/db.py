@@ -354,7 +354,10 @@ class BackendDB:
                            size: int = 0) -> None:
         self._exec(
             "INSERT INTO images (image_id, workspace_id, manifest_hash, size, status, spec_json, created_at) VALUES (?,?,?,?,?,?,?) "
-            "ON CONFLICT(image_id) DO UPDATE SET manifest_hash=excluded.manifest_hash, size=excluded.size, status=excluded.status, created_at=excluded.created_at",
+            # workspace_id follows the LATEST build requester: a workspace
+            # rescheduling a dead dedupe'd build must be able to upload its
+            # result (uploader auth compares against this row)
+            "ON CONFLICT(image_id) DO UPDATE SET manifest_hash=excluded.manifest_hash, size=excluded.size, status=excluded.status, created_at=excluded.created_at, workspace_id=excluded.workspace_id",
             (image_id, workspace_id, manifest_hash, size, status,
              json.dumps(spec, sort_keys=True), now()))
 

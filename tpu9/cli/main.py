@@ -529,7 +529,7 @@ def gateway(config_path: str) -> None:
 @click.option("--tpu", "tpu_gen", default="",
               help="TPU generation on this host (v5e, v5p, ...)")
 @click.option("--runtime", "runtime_kind", default="process",
-              type=click.Choice(["process", "runc"]))
+              type=click.Choice(["process", "native", "runc"]))
 @click.option("--slice-id", default="")
 @click.option("--slice-rank", default=0)
 @click.option("--slice-hosts", default=1)

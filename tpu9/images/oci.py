@@ -178,38 +178,48 @@ def _rm(path: str) -> None:
 
 def aiohttp_transport(session=None) -> Transport:
     """Default transport over aiohttp (handles Docker Hub's anonymous token
-    dance transparently on 401)."""
+    dance transparently on 401). One ClientSession is shared across requests
+    — an N-layer pull must not pay N connector/TLS setups; callers without
+    their own session should ``await transport.aclose()`` when done."""
     import aiohttp
 
-    async def fetch(method: str, url: str, headers: dict,
-                    _tokens: dict = {}) -> tuple[int, dict, bytes]:
-        own = session or aiohttp.ClientSession()
-        try:
-            hdrs = dict(headers)
-            realm_key = url.split("/v2/")[0]
-            if realm_key in _tokens:
-                hdrs["Authorization"] = f"Bearer {_tokens[realm_key]}"
-            async with own.request(method, url, headers=hdrs) as resp:
-                body = await resp.read()
-                if resp.status == 401 and "Www-Authenticate" in resp.headers:
-                    # anonymous pull token
-                    import re
-                    chal = resp.headers["Www-Authenticate"]
-                    m = dict(re.findall(r'(\w+)="([^"]*)"', chal))
-                    if "realm" in m:
-                        token_url = (f"{m['realm']}?service={m.get('service', '')}"
-                                     f"&scope={m.get('scope', '')}")
-                        async with own.get(token_url) as tr:
-                            tok = (await tr.json()).get("token", "")
-                        _tokens[realm_key] = tok
-                        hdrs["Authorization"] = f"Bearer {tok}"
-                        async with own.request(method, url,
-                                               headers=hdrs) as resp2:
-                            return (resp2.status, dict(resp2.headers),
-                                    await resp2.read())
-                return resp.status, dict(resp.headers), body
-        finally:
-            if session is None:
-                await own.close()
+    state: dict = {"session": session, "tokens": {}}
 
+    def _session() -> "aiohttp.ClientSession":
+        if state["session"] is None or state["session"].closed:
+            state["session"] = aiohttp.ClientSession()
+        return state["session"]
+
+    async def fetch(method: str, url: str,
+                    headers: dict) -> tuple[int, dict, bytes]:
+        own = _session()
+        hdrs = dict(headers)
+        realm_key = url.split("/v2/")[0]
+        if realm_key in state["tokens"]:
+            hdrs["Authorization"] = f"Bearer {state['tokens'][realm_key]}"
+        async with own.request(method, url, headers=hdrs) as resp:
+            body = await resp.read()
+            if resp.status == 401 and "Www-Authenticate" in resp.headers:
+                # anonymous pull token
+                import re
+                chal = resp.headers["Www-Authenticate"]
+                m = dict(re.findall(r'(\w+)="([^"]*)"', chal))
+                if "realm" in m:
+                    token_url = (f"{m['realm']}?service={m.get('service', '')}"
+                                 f"&scope={m.get('scope', '')}")
+                    async with own.get(token_url) as tr:
+                        tok = (await tr.json()).get("token", "")
+                    state["tokens"][realm_key] = tok
+                    hdrs["Authorization"] = f"Bearer {tok}"
+                    async with own.request(method, url,
+                                           headers=hdrs) as resp2:
+                        return (resp2.status, dict(resp2.headers),
+                                await resp2.read())
+            return resp.status, dict(resp.headers), body
+
+    async def aclose() -> None:
+        if session is None and state["session"] is not None:
+            await state["session"].close()
+
+    fetch.aclose = aclose
     return fetch

@@ -59,9 +59,12 @@ async def amain() -> int:
                 rootfs = os.path.join(scratch, "rootfs")
                 # NOT the gateway session: its Authorization header (runner
                 # token) must never reach a registry
-                client = OciClient(aiohttp_transport())
-                config = await client.pull(spec.from_registry, rootfs,
-                                           log_cb=emit)
+                transport = aiohttp_transport()
+                try:
+                    config = await OciClient(transport).pull(
+                        spec.from_registry, rootfs, log_cb=emit)
+                finally:
+                    await transport.aclose()
                 for kv in config.get("Env") or []:
                     k, _, v = kv.partition("=")
                     oci_env[k] = v
@@ -101,10 +104,19 @@ async def amain() -> int:
                         f"{proc.stderr[-2000:]}")
 
             emit("snapshotting environment")
-            pending: list[tuple[str, bytes]] = []
+            # chunks spool to DISK, not memory: a multi-GB site-packages or
+            # OCI rootfs must not scale the build container's RSS with image
+            # size (the worker's OOM watcher would kill every attempt)
+            spool = os.path.join(os.getcwd(), ".chunk-spool")
+            os.makedirs(spool, exist_ok=True)
+            digests: list[str] = []
 
             def put_chunk(data: bytes, digest: str) -> None:
-                pending.append((digest, data))
+                p = os.path.join(spool, digest)
+                if not os.path.exists(p):
+                    with open(p, "wb") as f:
+                        f.write(data)
+                    digests.append(digest)
 
             manifest = snapshot_dir(scratch, put_chunk=put_chunk)
             manifest.image_id = image_id
@@ -116,11 +128,13 @@ async def amain() -> int:
                 manifest.env.setdefault("TPU9_IMAGE_SITE",
                                         "env/site-packages")
 
-            emit(f"uploading {len(pending)} chunks")
+            emit(f"uploading {len(digests)} chunks")
             sem = asyncio.Semaphore(8)
 
-            async def upload(digest: str, data: bytes) -> None:
-                async with sem:
+            async def upload(digest: str) -> None:
+                async with sem:   # bounded: ≤8 chunks in memory at once
+                    with open(os.path.join(spool, digest), "rb") as f:
+                        data = f.read()
                     async with session.post(
                             f"{gateway}/rpc/image/chunk/{digest}",
                             data=data) as resp:
@@ -129,7 +143,7 @@ async def amain() -> int:
                                 f"chunk upload {digest[:12]} failed: "
                                 f"{resp.status} {await resp.text()}")
 
-            await asyncio.gather(*[upload(d, b) for d, b in pending])
+            await asyncio.gather(*[upload(d) for d in digests])
             async with session.post(
                     f"{gateway}/rpc/image/manifest/{image_id}",
                     data=manifest.to_json()) as resp:

@@ -129,8 +129,10 @@ def main() -> None:
         print("TPU9_HANDLER not set", file=sys.stderr)
         sys.exit(2)
     app = build_app(cfg)
-    web.run_app(app, host="127.0.0.1", port=cfg.port, print=None,
-                handle_signals=True)
+    # netns containers (NativeRuntime) are reached over their veth, so the
+    # worker sets TPU9_BIND_HOST=0.0.0.0; host-shared runtimes stay loopback
+    web.run_app(app, host=os.environ.get("TPU9_BIND_HOST", "127.0.0.1"),
+                port=cfg.port, print=None, handle_signals=True)
 
 
 if __name__ == "__main__":

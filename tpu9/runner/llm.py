@@ -95,7 +95,8 @@ async def amain() -> None:
     app.router.add_post("/generate", generate)
     runner = web.AppRunner(app)
     await runner.setup()
-    await web.TCPSite(runner, "127.0.0.1", cfg.port).start()
+    await web.TCPSite(runner, os.environ.get("TPU9_BIND_HOST", "127.0.0.1"),
+                      cfg.port).start()
 
     # build the engine off the loop (model init / weight load can be slow)
     handler = FunctionHandler(cfg)

@@ -1,9 +1,10 @@
 from .base import ContainerHandle, Runtime, RuntimeState, ShellSession
+from .native import NativeRuntime
 from .process import ProcessRuntime
 from .runc import RuncRuntime
 
 __all__ = ["Runtime", "ContainerHandle", "RuntimeState", "ShellSession",
-           "ProcessRuntime", "RuncRuntime"]
+           "NativeRuntime", "ProcessRuntime", "RuncRuntime"]
 
 
 def new_runtime(kind: str, **kw) -> Runtime:
@@ -11,6 +12,8 @@ def new_runtime(kind: str, **kw) -> Runtime:
     (pkg/runtime/runtime.go:141)."""
     if kind == "process":
         return ProcessRuntime(**kw)
+    if kind == "native":
+        return NativeRuntime(**kw)
     if kind == "runc":
         return RuncRuntime(**kw)
     raise ValueError(f"unknown runtime {kind!r}")

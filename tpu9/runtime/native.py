@@ -1,0 +1,453 @@
+"""NativeRuntime: real container isolation via the t9container binary.
+
+Reference analogue: the patched-runc path (``pkg/runtime/runc.go`` + the
+``beam-cloud/runc`` fork) and the per-container network manager
+(``pkg/worker/network.go:64,193-215,275-399`` — netns + veth + port
+forwarding + egress blocking). tpu9 implements the same containment
+natively instead of shelling out to an OCI runtime:
+
+- namespaces (pid/mount/uts/ipc) + pivot_root via ``native/t9container``
+- per-container network namespace with a /30 veth pair; egress beyond the
+  host is blocked by construction (no NAT, no default route)
+- userspace host→container port proxy (the reference's agent port proxy,
+  ``container_port_proxy.go``), so discovery/probes keep using
+  127.0.0.1:<port> exactly like the process runtime
+- rootfs: OCI image snapshots get an overlayfs upper over the pulled
+  ``rootfs/`` tree (lifecycle.go:1996's createOverlay analogue); env
+  snapshots get a host-backed root (RO system binds + RW sandbox)
+
+Root required; ``NativeRuntime.supported()`` gates tests and factory use.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import shutil
+import signal
+from typing import Optional
+
+from .base import ContainerHandle, ContainerSpec, Runtime, RuntimeState
+
+log = logging.getLogger("tpu9.runtime")
+
+_NATIVE_BIN = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "build",
+    "t9container")
+
+# host dirs bound read-only into env-snapshot containers (the "image" only
+# overlays the python env; the OS comes from the host like ProcessRuntime,
+# but now behind a private mount namespace + pivot_root)
+_SYSTEM_BINDS = ("/usr", "/bin", "/sbin", "/lib", "/lib64", "/etc", "/opt")
+
+
+def _run(cmd: list[str]) -> None:
+    import subprocess
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"{' '.join(cmd)}: {proc.stderr.strip()}")
+
+
+class NativeRuntime(Runtime):
+    name = "native"
+
+    def __init__(self, base_dir: str = "/tmp/tpu9/native",
+                 subnet_base: str = "10.77"):
+        self.base_dir = base_dir
+        self.subnet_base = subnet_base
+        if self.supported():
+            swept = self.sweep_orphans()
+            if swept:
+                log.info("swept %d orphaned container netns", swept)
+        self._procs: dict[str, asyncio.subprocess.Process] = {}
+        self._handles: dict[str, ContainerHandle] = {}
+        self._specs: dict[str, ContainerSpec] = {}
+        self._log_tasks: dict[str, list[asyncio.Task]] = {}
+        self._proxies: dict[str, list[asyncio.base_events.Server]] = {}
+        self._slots: dict[str, int] = {}      # container -> /30 slot index
+        self._ifnames: dict[str, str] = {}    # container -> host veth name
+        self._bg: set[asyncio.Task] = set()   # reap/escalate keepalives
+
+    @staticmethod
+    def supported() -> bool:
+        return (os.geteuid() == 0 and os.path.exists(_NATIVE_BIN)
+                and shutil.which("ip") is not None)
+
+    @staticmethod
+    def sweep_orphans() -> int:
+        """Delete t9-* network namespaces with no live processes — leftovers
+        of workers that died before their reap tasks ran (the netns is host
+        state and outlives the worker process). Deleting the netns tears
+        down its veth pair end-to-end. Called at worker startup, like the
+        reference's preallocated-slot reconciliation (network.go:193)."""
+        import subprocess
+        out = subprocess.run(["ip", "netns", "list"], capture_output=True,
+                             text=True).stdout
+        removed = 0
+        for line in out.splitlines():
+            ns = line.split()[0] if line.split() else ""
+            if not ns.startswith("t9-"):
+                continue
+            pids = subprocess.run(["ip", "netns", "pids", ns],
+                                  capture_output=True, text=True).stdout
+            if not pids.strip():
+                subprocess.run(["ip", "netns", "del", ns],
+                               capture_output=True)
+                removed += 1
+        return removed
+
+    # -- paths / net ---------------------------------------------------------
+
+    def sandbox_dir(self, container_id: str) -> str:
+        return os.path.join(self.base_dir, container_id)
+
+    def _netns(self, container_id: str) -> str:
+        return f"t9-{container_id[-12:]}"
+
+    def _ips(self, slot: int) -> tuple[str, str]:
+        """(host, container) addrs of the /30 for this slot."""
+        hi, lo = divmod(slot, 64)
+        base = 4 * lo
+        return (f"{self.subnet_base}.{hi}.{base + 1}",
+                f"{self.subnet_base}.{hi}.{base + 2}")
+
+    def _setup_net(self, container_id: str) -> tuple[str, str]:
+        """Slot (veth names + /30 subnet) derives from the container id so
+        multiple NativeRuntime instances on one host (multi-worker tests,
+        several worker processes) can't collide on 't9h1'; hash collisions
+        retry with a salt."""
+        import hashlib
+        ns = self._netns(container_id)
+        last_err: Optional[Exception] = None
+        for salt in range(4):
+            digest = hashlib.sha1(
+                f"{container_id}:{salt}".encode()).hexdigest()
+            slot = int(digest[:6], 16) % 16000
+            host_if = f"t9h{digest[:8]}"
+            cont_if = f"t9c{digest[:8]}"
+            host_ip, cont_ip = self._ips(slot)
+            try:
+                _run(["ip", "netns", "add", ns])
+            except RuntimeError as exc:
+                if "File exists" not in str(exc):
+                    raise
+            try:
+                _run(["ip", "link", "add", host_if, "type", "veth",
+                      "peer", "name", cont_if])
+                _run(["ip", "link", "set", cont_if, "netns", ns])
+                _run(["ip", "addr", "add", f"{host_ip}/30", "dev", host_if])
+                _run(["ip", "link", "set", host_if, "up"])
+                _run(["ip", "netns", "exec", ns, "ip", "addr", "add",
+                      f"{cont_ip}/30", "dev", cont_if])
+                _run(["ip", "netns", "exec", ns, "ip", "link", "set",
+                      cont_if, "up"])
+                _run(["ip", "netns", "exec", ns, "ip", "link", "set",
+                      "lo", "up"])
+            except RuntimeError as exc:
+                last_err = exc
+                import subprocess
+                subprocess.run(["ip", "link", "del", host_if],
+                               capture_output=True)
+                continue
+            self._slots[container_id] = slot
+            self._ifnames[container_id] = host_if
+            # deliberately NO default route and NO NAT: the container
+            # reaches the host side of its veth (gateway, cache) and
+            # nothing else — egress blocking by construction
+            # (network.go:275's BlockNetwork)
+            return host_ip, cont_ip
+        raise RuntimeError(f"veth setup failed for {container_id}: "
+                           f"{last_err}")
+
+    def _teardown_net(self, container_id: str) -> None:
+        import subprocess
+        self._slots.pop(container_id, None)
+        ifname = self._ifnames.pop(container_id, None)
+        if ifname:
+            subprocess.run(["ip", "link", "del", ifname],
+                           capture_output=True)
+        subprocess.run(["ip", "netns", "del", self._netns(container_id)],
+                       capture_output=True)
+
+    async def _proxy_port(self, container_id: str, host_port: int,
+                          cont_ip: str, cont_port: int) -> None:
+        """Userspace forward 127.0.0.1:host_port → cont_ip:cont_port."""
+        async def handle(reader, writer):
+            try:
+                up_r, up_w = await asyncio.open_connection(cont_ip, cont_port)
+            except OSError:
+                writer.close()
+                return
+
+            async def pump(src, dst):
+                try:
+                    while True:
+                        data = await src.read(65536)
+                        if not data:
+                            break
+                        dst.write(data)
+                        await dst.drain()
+                except (ConnectionError, asyncio.CancelledError):
+                    pass
+                finally:
+                    try:
+                        dst.close()
+                    except Exception:
+                        pass
+
+            await asyncio.gather(pump(reader, up_w), pump(up_r, writer),
+                                 return_exceptions=True)
+
+        server = await asyncio.start_server(handle, "127.0.0.1", host_port)
+        self._proxies.setdefault(container_id, []).append(server)
+
+    # -- rootfs --------------------------------------------------------------
+
+    def _prepare_rootfs(self, spec: ContainerSpec,
+                        sandbox: str) -> tuple[str, list[str]]:
+        """Returns (rootfs_dir, extra --bind specs)."""
+        binds: list[str] = []
+        bundle = spec.rootfs
+        is_oci = False
+        if bundle:
+            meta = os.path.join(bundle, ".tpu9-env.json")
+            try:
+                with open(meta) as f:
+                    is_oci = json.load(f).get("kind") == "oci"
+            except (OSError, ValueError):
+                pass
+        if is_oci:
+            # overlay upper over the pulled image tree: container writes
+            # never touch the shared bundle (lifecycle.go:1996)
+            lower = os.path.join(bundle, "rootfs")
+            upper = os.path.join(sandbox, "overlay-upper")
+            work = os.path.join(sandbox, "overlay-work")
+            merged = os.path.join(sandbox, "rootfs")
+            for d in (upper, work, merged):
+                os.makedirs(d, exist_ok=True)
+            _run(["mount", "-t", "overlay", "overlay",
+                  "-o", f"lowerdir={lower},upperdir={upper},workdir={work}",
+                  merged])
+            return merged, binds
+        # env snapshot / no image: host-backed root behind a private mount
+        # ns. The bundle and workdir keep their HOST paths inside the
+        # container — the lifecycle computed PYTHONPATH/TPU9_IMAGE_SITE
+        # against those absolute paths
+        root = os.path.join(sandbox, "rootfs")
+        os.makedirs(root, exist_ok=True)
+        for d in _SYSTEM_BINDS:
+            if os.path.isdir(d):
+                binds.append(f"{d}:{d}:ro")
+        # the tpu9 package itself: runner entrypoints import it by absolute
+        # path (the lifecycle appends this root to PYTHONPATH)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        if not any(repo_root.startswith(d + os.sep) or repo_root == d
+                   for d in _SYSTEM_BINDS):
+            binds.append(f"{repo_root}:{repo_root}:ro")
+        if bundle:
+            binds.append(f"{bundle}:{bundle}:ro")
+        return root, binds
+
+    # -- Runtime interface ---------------------------------------------------
+
+    async def run(self, spec: ContainerSpec, log_cb=None) -> ContainerHandle:
+        sandbox = self.sandbox_dir(spec.container_id)
+        os.makedirs(sandbox, exist_ok=True)
+
+        host_ip, cont_ip = self._setup_net(spec.container_id)
+        rootfs, binds = self._prepare_rootfs(spec, sandbox)
+
+        env = dict(spec.env)
+        env.setdefault("PATH", "/usr/local/bin:/usr/bin:/bin")
+        env.setdefault("HOME", "/root")
+        # the runner must bind an interface the veth proxy can reach
+        env["TPU9_BIND_HOST"] = "0.0.0.0"
+        env["TPU9_HOST_IP"] = host_ip      # the veth's host side
+        # 127.0.0.1 means "this netns" inside the container: control-plane
+        # URLs the worker injected must point at the host side of the veth
+        for key, val in list(env.items()):
+            if isinstance(val, str) and "127.0.0.1" in val and key.startswith(
+                    "TPU9_"):
+                env[key] = val.replace("127.0.0.1", host_ip)
+
+        workdir = spec.workdir or "/"
+        if workdir not in ("", "/"):
+            # the lifecycle's workspace dir rides into the container at its
+            # host path, read-write
+            binds.append(f"{workdir}:{workdir}")
+        env_file = os.path.join(sandbox, ".t9env")
+        with open(env_file, "wb") as f:
+            for k, v in env.items():
+                f.write(f"{k}={v}".encode() + b"\0")
+
+        cmd = [_NATIVE_BIN, "--rootfs", rootfs, "--workdir", workdir,
+               "--hostname", spec.container_id[:32],
+               "--netns", self._netns(spec.container_id),
+               "--env-file", env_file]
+        for b in binds:
+            cmd += ["--bind", b]
+        for mount_src, mount_dst, ro in spec.mounts:
+            cmd += ["--bind",
+                    f"{mount_src}:{mount_dst}{':ro' if ro else ''}"]
+        for dev in spec.devices:
+            cmd += ["--dev", dev]
+        cmd += ["--"] + list(spec.entrypoint)
+
+        proc = await asyncio.create_subprocess_exec(
+            *cmd, stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+            preexec_fn=os.setsid)
+
+        handle = ContainerHandle(container_id=spec.container_id,
+                                 pid=proc.pid, state=RuntimeState.RUNNING,
+                                 meta={"host_ip": host_ip,
+                                       "cont_ip": cont_ip})
+        self._procs[spec.container_id] = proc
+        self._handles[spec.container_id] = handle
+        self._specs[spec.container_id] = spec
+
+        async def pump(stream, name):
+            while True:
+                line = await stream.readline()
+                if not line:
+                    break
+                if log_cb is not None:
+                    try:
+                        log_cb(line.decode(errors="replace").rstrip("\n"),
+                               name)
+                    except Exception:
+                        pass
+
+        self._log_tasks[spec.container_id] = [
+            asyncio.create_task(pump(proc.stdout, "stdout")),
+            asyncio.create_task(pump(proc.stderr, "stderr")),
+        ]
+
+        # host-port → container-port proxies (same port number inside)
+        for cont_port, host_port in (spec.ports or {}).items():
+            await self._proxy_port(spec.container_id, host_port or cont_port,
+                                   cont_ip, cont_port)
+
+        async def reap():
+            code = await proc.wait()
+            handle.exit_code = code
+            handle.state = (RuntimeState.STOPPED if code == 0
+                            else RuntimeState.FAILED)
+            await self._close_proxies(spec.container_id)
+            self._teardown_net(spec.container_id)
+            self._cleanup_mounts(spec.container_id)
+
+        # hold a strong ref: the loop only weakly references tasks, and a
+        # GC'd reap would leak the netns/veth/overlay of a dead container
+        t = asyncio.create_task(reap())
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+        return handle
+
+    async def _close_proxies(self, container_id: str) -> None:
+        for server in self._proxies.pop(container_id, []):
+            server.close()
+            try:
+                await server.wait_closed()
+            except Exception:
+                pass
+
+    def _cleanup_mounts(self, container_id: str) -> None:
+        merged = os.path.join(self.sandbox_dir(container_id), "rootfs")
+        import subprocess
+        subprocess.run(["umount", "-l", merged], capture_output=True)
+
+    def _container_pid(self, container_id: str) -> Optional[int]:
+        """PID of the container's init (t9container's child)."""
+        proc = self._procs.get(container_id)
+        if proc is None or proc.returncode is not None:
+            return None
+        try:
+            kids = open(f"/proc/{proc.pid}/task/{proc.pid}/children").read()
+            return int(kids.split()[0]) if kids.split() else None
+        except (OSError, ValueError, IndexError):
+            return None
+
+    async def kill(self, container_id: str, signal_num: int = 15) -> bool:
+        proc = self._procs.get(container_id)
+        if proc is None or proc.returncode is not None:
+            return False
+        try:
+            os.killpg(os.getpgid(proc.pid), signal_num)
+        except ProcessLookupError:
+            return False
+        if signal_num != signal.SIGKILL:
+            async def escalate():
+                try:
+                    await asyncio.wait_for(proc.wait(), timeout=10.0)
+                except asyncio.TimeoutError:
+                    try:
+                        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+            t = asyncio.create_task(escalate())
+            self._bg.add(t)
+            t.add_done_callback(self._bg.discard)
+        return True
+
+    async def state(self, container_id: str) -> Optional[ContainerHandle]:
+        return self._handles.get(container_id)
+
+    async def wait(self, container_id: str) -> int:
+        proc = self._procs.get(container_id)
+        if proc is None:
+            handle = self._handles.get(container_id)
+            return (handle.exit_code if handle
+                    and handle.exit_code is not None else -1)
+        return await proc.wait()
+
+    def _nsenter(self, container_id: str) -> Optional[list[str]]:
+        pid = self._container_pid(container_id)
+        if pid is None:
+            return None
+        return ["nsenter", "-t", str(pid), "-m", "-u", "-i", "-p", "-n",
+                "-r", "-w"]
+
+    async def exec(self, container_id: str, cmd: list[str]) -> tuple[int, str]:
+        enter = self._nsenter(container_id)
+        if enter is None:
+            return (-1, "container not running")
+        proc = await asyncio.create_subprocess_exec(
+            *enter, *cmd,
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
+        out, _ = await proc.communicate()
+        return (proc.returncode or 0, out.decode(errors="replace"))
+
+    async def exec_stream(self, container_id: str,
+                          cmd: Optional[list[str]] = None):
+        from .process import _PtySession
+        enter = self._nsenter(container_id)
+        if enter is None:
+            raise RuntimeError("container not running")
+        cmd = cmd or ["/bin/sh", "-i"]
+        import pty as _pty
+        master, slave = _pty.openpty()
+        proc = await asyncio.create_subprocess_exec(
+            *enter, *cmd, stdin=slave, stdout=slave, stderr=slave,
+            preexec_fn=os.setsid, close_fds=True)
+        os.close(slave)
+        return _PtySession(master, proc)
+
+    async def cleanup(self, container_id: str,
+                      remove_sandbox: bool = True) -> None:
+        await self._close_proxies(container_id)
+        self._teardown_net(container_id)
+        self._cleanup_mounts(container_id)
+        self._procs.pop(container_id, None)
+        self._handles.pop(container_id, None)
+        self._specs.pop(container_id, None)
+        for t in self._log_tasks.pop(container_id, []):
+            t.cancel()
+        if remove_sandbox:
+            shutil.rmtree(self.sandbox_dir(container_id), ignore_errors=True)
+
+    def capabilities(self) -> set[str]:
+        return {"exec", "exec_stream", "logs", "netns", "overlay", "devices"}

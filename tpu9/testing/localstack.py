@@ -113,7 +113,11 @@ class LocalStack:
             os.environ["TPU9_FAKE_TPU_CHIPS"] = str(tpu_chips)
         else:
             os.environ.pop("TPU9_FAKE_TPU_CHIPS", None)
-        runtime = ProcessRuntime(base_dir=self.cfg.worker.containers_dir)
+        # TPU9_RUNTIME=native runs the suite under real containment
+        # (netns + pivot_root; root-gated) — VERDICT round-1 item 3
+        kind = os.environ.get("TPU9_RUNTIME", "process")
+        from ..runtime import new_runtime
+        runtime = new_runtime(kind, base_dir=self.cfg.worker.containers_dir)
         cache = WorkerCache(
             self.cfg.cache, f"wc{len(self.workers)}",
             WorkerRepository(self.store),
