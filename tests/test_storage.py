@@ -1,0 +1,275 @@
+"""Object-store volume backends: local + GCS shapes, multipart transfer,
+restart persistence, cross-host worker sync (reference: pkg/storage/ +
+sdk multipart.py + worker storage_manager.go)."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from tpu9.storage import GcsObjectStore, LocalObjectStore
+from tpu9.storage.objstore import ObjectStoreError
+from tpu9.testing.localstack import LocalStack
+
+
+class TestLocalObjectStore:
+    async def test_round_trip_list_delete(self, tmp_path):
+        s = LocalObjectStore(str(tmp_path))
+        await s.put("ws1/volumes/v/one.txt", b"1")
+        await s.put("ws1/volumes/v/sub/two.txt", b"22")
+        assert await s.get("ws1/volumes/v/one.txt") == b"1"
+        assert await s.list("ws1/volumes/v/") == [
+            "ws1/volumes/v/one.txt", "ws1/volumes/v/sub/two.txt"]
+        st = await s.stat("ws1/volumes/v/sub/two.txt")
+        assert st["size"] == 2
+        assert await s.delete("ws1/volumes/v/one.txt")
+        assert await s.get("ws1/volumes/v/one.txt") is None
+
+    async def test_traversal_rejected(self, tmp_path):
+        s = LocalObjectStore(str(tmp_path / "root"))
+        with pytest.raises(ObjectStoreError):
+            await s.put("../evil", b"x")
+
+    async def test_multipart_compose(self, tmp_path):
+        s = LocalObjectStore(str(tmp_path))
+        mp = s.multipart("big.bin")
+        await mp.put_part(1, b"BBBB")
+        await mp.put_part(0, b"AAAA")
+        size = await mp.complete(2)
+        assert size == 8
+        assert await s.get("big.bin") == b"AAAABBBB"
+        assert await s.list(".mp/") == []       # parts cleaned
+
+
+class TestGcsShapes:
+    """GCS JSON-API client against a recording fake transport (the
+    GceTpuPool pattern: real shapes, injected wire)."""
+
+    def _fake(self, objects: dict):
+        calls = []
+
+        async def transport(method, url, headers, body):
+            calls.append((method, url))
+            if "/upload/storage/v1/" in url and method == "POST":
+                from urllib.parse import parse_qs, urlparse
+                name = parse_qs(urlparse(url).query)["name"][0]
+                objects[name] = body
+                return 200, {}, b"{}"
+            if method == "GET" and "alt=media" in url:
+                from urllib.parse import unquote, urlparse
+                key = unquote(urlparse(url).path.split("/o/", 1)[1])
+                if key not in objects:
+                    return 404, {}, b""
+                return 200, {}, objects[key]
+            if method == "GET" and "/o?" in url:
+                from urllib.parse import parse_qs, urlparse
+                prefix = parse_qs(urlparse(url).query).get("prefix", [""])[0]
+                items = [{"name": k} for k in sorted(objects)
+                         if k.startswith(prefix)]
+                return 200, {}, json.dumps({"items": items}).encode()
+            if method == "GET":
+                from urllib.parse import unquote, urlparse
+                key = unquote(urlparse(url).path.split("/o/", 1)[1])
+                if key not in objects:
+                    return 404, {}, b""
+                return 200, {}, json.dumps(
+                    {"size": str(len(objects[key]))}).encode()
+            if method == "POST" and url.endswith("/compose"):
+                from urllib.parse import unquote, urlparse
+                dest = unquote(urlparse(url).path.split("/o/", 1)[1]
+                               ).rsplit("/compose", 1)[0]
+                doc = json.loads(body)
+                objects[dest] = b"".join(
+                    objects[s["name"]] for s in doc["sourceObjects"])
+                return 200, {}, b"{}"
+            if method == "DELETE":
+                from urllib.parse import unquote, urlparse
+                key = unquote(urlparse(url).path.split("/o/", 1)[1])
+                objects.pop(key, None)
+                return 204, {}, b""
+            return 400, {}, b""
+
+        return transport, calls
+
+    async def test_put_get_list_stat_delete(self):
+        objects: dict = {}
+        transport, calls = self._fake(objects)
+        s = GcsObjectStore("bkt", transport)
+        await s.put("a/b.txt", b"hello")
+        assert objects["a/b.txt"] == b"hello"
+        assert await s.get("a/b.txt") == b"hello"
+        assert await s.get("missing") is None
+        assert await s.list("a/") == ["a/b.txt"]
+        assert (await s.stat("a/b.txt"))["size"] == 5
+        assert await s.delete("a/b.txt")
+        assert any("/upload/storage/v1/b/bkt/o" in u for _, u in calls)
+
+    async def test_multipart_uses_server_side_compose(self):
+        objects: dict = {}
+        transport, calls = self._fake(objects)
+        s = GcsObjectStore("bkt", transport)
+        mp = s.multipart("model.bin")
+        await mp.put_part(0, b"xx")
+        await mp.put_part(1, b"yy")
+        assert await mp.complete(2) == 4
+        assert objects["model.bin"] == b"xxyy"
+        assert any(u.endswith("/compose") for _, u in calls)
+        assert not any(k.startswith(".mp/") for k in objects)
+
+    async def test_list_meta_single_round_trip(self):
+        objects = {"v/a": b"123", "v/b": b"4"}
+        transport, calls = self._fake(objects)
+        s = GcsObjectStore("bkt", transport)
+        # patch the fake list to include size fields like real GCS
+        meta = await s.list_meta("v/")
+        assert [e["name"] for e in meta] == ["v/a", "v/b"]
+
+
+class TestVolumesE2E:
+    async def test_multipart_large_file_round_trip(self, tmp_path):
+        """SDK upload of a file over the multipart threshold → download
+        byte-identical (VERDICT item 8's large-file round trip)."""
+        async with LocalStack() as stack:
+            big = tmp_path / "weights.bin"
+            payload = os.urandom(3 * 1024 * 1024)
+            big.write_bytes(payload)
+
+            import tpu9.sdk.primitives as prim
+            from tpu9.sdk.client import Context, GatewayClient
+            ctx = Context(gateway_url=stack.base_url,
+                          token=stack.gateway.default_token)
+            vol = prim.Volume(name="models")
+            vol._client = GatewayClient(ctx)
+            # force the multipart path at small size for the test
+            vol.MULTIPART_THRESHOLD = 1024 * 1024
+            vol.MULTIPART_PART_SIZE = 512 * 1024
+
+            # run the sync SDK in a thread (it drives its own event loop)
+            size = await asyncio.to_thread(vol.upload, str(big), "w.bin")
+            assert size == len(payload)
+            got = await asyncio.to_thread(vol.download, "w.bin")
+            assert got == payload
+
+    async def test_volume_survives_gateway_restart(self, tmp_path):
+        """Volumes are object-store state, not gateway memory."""
+        from tpu9.backend import BackendDB
+        from tpu9.config import AppConfig
+        from tpu9.gateway import Gateway
+        from tpu9.statestore import MemoryStore
+        import aiohttp
+
+        cfg = AppConfig()
+        cfg.gateway.http_port = 0
+        cfg.gateway.state_port = 0
+        cfg.database.path = str(tmp_path / "gw.db")
+        cfg.storage.local_root = str(tmp_path / "ws")
+
+        gw = Gateway(cfg, store=MemoryStore())
+        await gw.start()
+        tok = gw.default_token
+        async with aiohttp.ClientSession(headers={
+                "Authorization": f"Bearer {tok}"}) as s:
+            async with s.put(
+                    f"http://127.0.0.1:{gw.port}/rpc/volume/data/files/"
+                    f"model.txt", data=b"persisted") as resp:
+                assert resp.status == 200
+        await gw.stop()
+
+        gw2 = Gateway(cfg, store=MemoryStore())
+        await gw2.start()
+        try:
+            async with aiohttp.ClientSession(headers={
+                    "Authorization": f"Bearer {tok}"}) as s:
+                async with s.get(
+                        f"http://127.0.0.1:{gw2.port}/rpc/volume/data/"
+                        f"files/model.txt") as resp:
+                    assert resp.status == 200
+                    assert await resp.read() == b"persisted"
+        finally:
+            await gw2.stop()
+
+
+class TestCrossHostVolumeSync:
+    async def test_lifecycle_syncs_remote_volume(self, tmp_path):
+        """A worker without the gateway's storage root pulls volume files
+        through its volume_sync hook at container start."""
+        from tpu9.config import WorkerConfig
+        from tpu9.repository import ContainerRepository
+        from tpu9.runtime import ProcessRuntime
+        from tpu9.statestore import MemoryStore
+        from tpu9.types import ContainerRequest, Mount
+        from tpu9.worker.lifecycle import ContainerLifecycle
+        from tpu9.worker.tpu_manager import TpuDeviceManager
+
+        synced = tmp_path / "synced-vol"
+        synced.mkdir()
+        (synced / "weights.txt").write_text("remote-weights")
+        calls = []
+
+        async def volume_sync(workspace_id: str, name: str) -> str:
+            calls.append((workspace_id, name))
+            return str(synced)
+
+        cfg = WorkerConfig(containers_dir=str(tmp_path / "c"),
+                           storage_root=str(tmp_path / "unshared"),
+                           storage_shared=False)
+        lc = ContainerLifecycle(
+            "w1", cfg, ProcessRuntime(base_dir=cfg.containers_dir),
+            ContainerRepository(MemoryStore()), TpuDeviceManager(),
+            volume_sync=volume_sync)
+        req = ContainerRequest(
+            container_id="c-sync", stub_id="s", workspace_id="wsX",
+            mounts=[Mount(source="models", target="/vol/models",
+                          kind="volume")])
+        base = await lc._prepare_workspace(req)
+        assert calls == [("wsX", "models")]
+        linked = os.path.join(base, "vol/models/weights.txt")
+        assert open(linked).read() == "remote-weights"
+
+    async def test_container_writes_push_back_on_exit(self, tmp_path):
+        """Cross-host mode: writes into a synced volume reach the object
+        store when the container exits (no silent data loss)."""
+        from tpu9.config import WorkerConfig
+        from tpu9.repository import ContainerRepository
+        from tpu9.runtime import ProcessRuntime
+        from tpu9.statestore import MemoryStore
+        from tpu9.types import ContainerRequest, Mount
+        from tpu9.worker.lifecycle import ContainerLifecycle
+        from tpu9.worker.tpu_manager import TpuDeviceManager
+        import sys
+
+        synced = tmp_path / "synced-vol"
+        synced.mkdir()
+
+        async def volume_sync(workspace_id: str, name: str) -> str:
+            return str(synced)
+
+        pushed = []
+
+        async def volume_push(workspace_id, name, local_dir):
+            pushed.append((workspace_id, name, local_dir))
+
+        cfg = WorkerConfig(containers_dir=str(tmp_path / "c"),
+                           storage_root=str(tmp_path / "unshared"),
+                           storage_shared=False)
+        lc = ContainerLifecycle(
+            "w1", cfg, ProcessRuntime(base_dir=cfg.containers_dir),
+            ContainerRepository(MemoryStore()), TpuDeviceManager(),
+            volume_sync=volume_sync)
+        lc.volume_push = volume_push
+        req = ContainerRequest(
+            container_id="c-push", stub_id="s", workspace_id="wsX",
+            stub_type="pod",
+            entrypoint=[sys.executable, "-c",
+                        "open('vol/out/result.txt', 'w').write('computed')"],
+            mounts=[Mount(source="out", target="/vol/out", kind="volume")])
+        await lc.run_container(req)
+        await lc.runtime.wait("c-push")
+        # let the supervisor finish (it runs the push)
+        for _ in range(100):
+            if pushed:
+                break
+            await asyncio.sleep(0.05)
+        assert pushed == [("wsX", "out", str(synced))]
+        assert (synced / "result.txt").read_text() == "computed"

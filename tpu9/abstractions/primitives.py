@@ -148,68 +148,99 @@ class OutputService:
 
 
 class VolumeFiles:
-    """Workspace volume file ops (upload/download/list/delete) on the storage
-    backend (reference volume.go RPCs + multipart client)."""
+    """Workspace volume file ops (upload/download/list/delete + multipart)
+    over an ObjectStore backend (reference: volume.go RPCs + the SDK's
+    multipart.py, with geesefs/S3 behind them; tpu9 volumes live in an
+    object store — local dir in dev, GCS bucket in production — and
+    workers sync them at container start)."""
 
-    def __init__(self, backend: BackendDB, storage_root: str):
+    def __init__(self, backend: BackendDB, storage_root: str, store=None):
+        from ..storage import LocalObjectStore
         self.backend = backend
         self.storage_root = storage_root
+        self.store = store or LocalObjectStore(storage_root)
+        self._multiparts: dict[str, tuple] = {}   # upload_id -> (mp, meta)
 
     def volume_dir(self, workspace_id: str, volume_name: str) -> str:
+        """Host path of a volume — the single-host fast path (workers on
+        this host symlink it). Only meaningful for LocalObjectStore."""
         return os.path.join(self.storage_root, workspace_id, "volumes",
                             volume_name)
 
-    def _safe(self, workspace_id: str, volume_name: str, rel: str) -> str:
-        base = os.path.realpath(self.volume_dir(workspace_id, volume_name))
-        full = os.path.realpath(os.path.join(base, rel.lstrip("/")))
-        if not (full == base or full.startswith(base + os.sep)):
+    def _key(self, workspace_id: str, volume_name: str, rel: str) -> str:
+        rel = rel.lstrip("/")
+        parts = rel.split("/")
+        if any(p in ("", ".", "..") for p in parts):
             raise PrimitiveError(f"path escapes volume: {rel!r}")
-        return full
+        return f"{workspace_id}/volumes/{volume_name}/{rel}"
+
+    def _prefix(self, workspace_id: str, volume_name: str) -> str:
+        return f"{workspace_id}/volumes/{volume_name}/"
 
     async def ensure(self, workspace_id: str, volume_name: str) -> dict:
         vol = await self.backend.get_or_create_volume(workspace_id,
                                                       volume_name)
-        os.makedirs(self.volume_dir(workspace_id, volume_name), exist_ok=True)
         return vol
 
     async def write(self, workspace_id: str, volume_name: str, rel: str,
                     data: bytes) -> int:
         await self.ensure(workspace_id, volume_name)
-        full = self._safe(workspace_id, volume_name, rel)
-        os.makedirs(os.path.dirname(full), exist_ok=True)
-        with open(full, "wb") as f:
-            f.write(data)
+        await self.store.put(self._key(workspace_id, volume_name, rel), data)
         return len(data)
 
     async def read(self, workspace_id: str, volume_name: str,
                    rel: str) -> Optional[bytes]:
-        full = self._safe(workspace_id, volume_name, rel)
-        if not os.path.isfile(full):
-            return None
-        with open(full, "rb") as f:
-            return f.read()
+        return await self.store.get(
+            self._key(workspace_id, volume_name, rel))
 
     async def list(self, workspace_id: str, volume_name: str,
                    prefix: str = "") -> list[dict]:
-        base = self.volume_dir(workspace_id, volume_name)
-        if not os.path.isdir(base):
-            return []
-        out = []
-        for dirpath, _dirs, files in os.walk(base):
-            for fn in files:
-                full = os.path.join(dirpath, fn)
-                rel = os.path.relpath(full, base)
-                if prefix and not rel.startswith(prefix):
-                    continue
-                st = os.stat(full)
-                out.append({"path": rel, "size": st.st_size,
-                            "mtime": st.st_mtime})
-        return sorted(out, key=lambda e: e["path"])
+        base = self._prefix(workspace_id, volume_name)
+        return [{"path": e["name"][len(base):], "size": e["size"],
+                 "mtime": e["mtime"]}
+                for e in await self.store.list_meta(base + prefix)]
 
     async def delete(self, workspace_id: str, volume_name: str,
                      rel: str) -> bool:
-        full = self._safe(workspace_id, volume_name, rel)
-        if os.path.isfile(full):
-            os.unlink(full)
+        return await self.store.delete(
+            self._key(workspace_id, volume_name, rel))
+
+    # -- multipart (reference sdk multipart.py / volume.go presigned flow) --
+
+    MULTIPART_TTL_S = 6 * 3600.0
+
+    async def multipart_initiate(self, workspace_id: str, volume_name: str,
+                                 rel: str) -> str:
+        await self.ensure(workspace_id, volume_name)
+        # reclaim uploads abandoned past the TTL (client died mid-transfer)
+        import time as _time
+        now = _time.time()
+        for uid, (mp, _ws, t0) in list(self._multiparts.items()):
+            if now - t0 > self.MULTIPART_TTL_S:
+                self._multiparts.pop(uid, None)
+                await mp.abort()
+        mp = self.store.multipart(self._key(workspace_id, volume_name, rel))
+        self._multiparts[mp.upload_id] = (mp, workspace_id, now)
+        return mp.upload_id
+
+    async def multipart_put_part(self, workspace_id: str, upload_id: str,
+                                 index: int, data: bytes) -> None:
+        entry = self._multiparts.get(upload_id)
+        if entry is None or entry[1] != workspace_id:
+            raise PrimitiveError("unknown upload")
+        await entry[0].put_part(index, data)
+
+    async def multipart_complete(self, workspace_id: str, upload_id: str,
+                                 n_parts: int) -> int:
+        entry = self._multiparts.pop(upload_id, None)
+        if entry is None or entry[1] != workspace_id:
+            raise PrimitiveError("unknown upload")
+        return await entry[0].complete(n_parts)
+
+    async def multipart_abort(self, workspace_id: str,
+                              upload_id: str) -> bool:
+        entry = self._multiparts.pop(upload_id, None)
+        if entry is not None and entry[1] == workspace_id:
+            await entry[0].abort()
             return True
         return False

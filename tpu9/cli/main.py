@@ -562,6 +562,8 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
         object_resolver = None
         chunk_source = None
         manifest_fetch = None
+        volume_sync = None
+        volume_push = None
         if gateway_url and worker_token:
             session = aiohttp.ClientSession(
                 headers={"Authorization": f"Bearer {worker_token}"})
@@ -591,6 +593,80 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                         return None
                     return ImageManifest.from_json(await resp.text())
 
+            volumes_dir = os.path.join(cfg.worker.containers_dir,
+                                       "volume-sync")
+
+            def _vol_dest(workspace_id: str, name: str) -> str:
+                # single-component names only — mirrors the lifecycle's
+                # validation so a crafted name can't traverse volumes_dir
+                for part in (workspace_id, name):
+                    if (not part or "/" in part or "\\" in part
+                            or part in (".", "..")):
+                        raise ValueError(f"invalid volume path part {part!r}")
+                return os.path.join(volumes_dir, workspace_id, name)
+
+            async def volume_sync(workspace_id: str, name: str) -> str:
+                """Pull a workspace volume from the gateway's object store
+                into a local dir (cross-host mode). A file re-downloads when
+                missing, size differs, or the remote mtime moved past the
+                last sync (same-size updates must not serve stale bytes)."""
+                from urllib.parse import quote
+                dest = _vol_dest(workspace_id, name)
+                os.makedirs(dest, exist_ok=True)
+                base = (f"{gateway_url}/rpc/internal/volume/"
+                        f"{workspace_id}/{name}/files")
+                async with session.get(base) as resp:
+                    if resp.status != 200:
+                        return dest
+                    entries = await resp.json()
+                for e in entries:
+                    rel = e["path"]
+                    local = os.path.realpath(os.path.join(dest, rel))
+                    if not local.startswith(os.path.realpath(dest) + os.sep):
+                        continue
+                    remote_mtime = e.get("mtime") or 0
+                    if (os.path.isfile(local)
+                            and os.path.getsize(local) == e["size"]
+                            and isinstance(remote_mtime, (int, float))
+                            and os.path.getmtime(local) >= remote_mtime):
+                        continue
+                    os.makedirs(os.path.dirname(local), exist_ok=True)
+                    async with session.get(
+                            f"{base}/{quote(rel, safe='/')}") as resp:
+                        if resp.status == 200:
+                            with open(local, "wb") as f:
+                                f.write(await resp.read())
+                return dest
+
+            async def volume_push(workspace_id: str, name: str,
+                                  local_dir: str) -> None:
+                """Push container writes back to the object store on exit
+                (last-writer-wins; deletions are not propagated)."""
+                from urllib.parse import quote
+                base = (f"{gateway_url}/rpc/internal/volume/"
+                        f"{workspace_id}/{name}/files")
+                remote: dict[str, dict] = {}
+                async with session.get(base) as resp:
+                    if resp.status == 200:
+                        remote = {e["path"]: e for e in await resp.json()}
+                root = os.path.realpath(local_dir)
+                for dirpath, _dirs, files in os.walk(root):
+                    for fn in files:
+                        full = os.path.join(dirpath, fn)
+                        rel = os.path.relpath(full, root).replace(
+                            os.sep, "/")
+                        st = os.stat(full)
+                        r = remote.get(rel)
+                        r_mtime = (r or {}).get("mtime") or 0
+                        if (r is not None and r["size"] == st.st_size
+                                and isinstance(r_mtime, (int, float))
+                                and r_mtime >= st.st_mtime):
+                            continue
+                        with open(full, "rb") as f:
+                            data = f.read()
+                        await session.put(
+                            f"{base}/{quote(rel, safe='/')}", data=data)
+
         from ..types import new_id
         cache = WorkerCache(cfg.cache, new_id("wc"), WorkerRepository(store),
                             source=chunk_source,
@@ -598,7 +674,8 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
         w = Worker(store, runtime, cfg=cfg.worker, pool=pool,
                    tpu_generation=tpu_gen, slice_id=slice_id,
                    slice_host_rank=slice_rank, slice_host_count=slice_hosts,
-                   cache=cache, object_resolver=object_resolver)
+                   cache=cache, object_resolver=object_resolver,
+                   volume_sync=volume_sync, volume_push=volume_push)
         await w.start()
         click.echo(f"worker {w.worker_id} joined (pool={pool}, "
                    f"chips={w.tpu.chip_count})")

@@ -79,6 +79,10 @@ class WorkerConfig:
     images_dir: str = "/tmp/tpu9/images"
     containers_dir: str = "/tmp/tpu9/containers"
     storage_root: str = "/tmp/tpu9/workspaces"   # volume/object share
+    # True when this worker sees the gateway's storage root (same host or a
+    # shared mount); False makes workers SYNC volumes from the gateway's
+    # object store at container start (multi-host TPU VMs)
+    storage_shared: bool = True
     logs_dir: str = "/tmp/tpu9/logs"
     checkpoint_dir: str = "/tmp/tpu9/checkpoints"
     # path to the built vcache_preload.so; when set, containers with volume

@@ -100,7 +100,9 @@ class Gateway:
         self.queues = QueueService(self.store)
         self.signals = SignalService(self.store)
         self.outputs = OutputService(self.backend, cfg.storage.local_root)
-        self.volume_files = VolumeFiles(self.backend, cfg.storage.local_root)
+        from ..storage import make_store
+        self.volume_files = VolumeFiles(self.backend, cfg.storage.local_root,
+                                        store=make_store(cfg.storage))
         self.events = EventBus(self.store, sink_url=cfg.monitoring.events_http_url
                                if cfg.monitoring.events_sink == "http" else "",
                                cluster=cfg.cluster_name)
@@ -158,6 +160,23 @@ class Gateway:
         r.add_put("/rpc/volume/{name}/files/{path:.+}", self._volume_put)
         r.add_get("/rpc/volume/{name}/files/{path:.+}", self._volume_get)
         r.add_delete("/rpc/volume/{name}/files/{path:.+}", self._volume_delete)
+        # multipart volume transfer (reference sdk multipart.py)
+        # worker-token volume reads for cross-host sync (repo-over-gRPC
+        # semantics: workers act on behalf of any workspace)
+        r.add_get("/rpc/internal/volume/{workspace_id}/{name}/files",
+                  self._internal_volume_list)
+        r.add_get("/rpc/internal/volume/{workspace_id}/{name}/files/{path:.+}",
+                  self._internal_volume_get)
+        r.add_put("/rpc/internal/volume/{workspace_id}/{name}/files/{path:.+}",
+                  self._internal_volume_put)
+        r.add_post("/rpc/volume/{name}/multipart/initiate/{path:.+}",
+                   self._volume_mp_initiate)
+        r.add_put("/rpc/volume/{name}/multipart/{upload_id}/{index}",
+                  self._volume_mp_part)
+        r.add_post("/rpc/volume/{name}/multipart/{upload_id}/complete",
+                   self._volume_mp_complete)
+        r.add_delete("/rpc/volume/{name}/multipart/{upload_id}",
+                     self._volume_mp_abort)
         # images
         r.add_post("/rpc/image/verify", self._rpc_image_verify)
         r.add_post("/rpc/image/build", self._rpc_image_build)
@@ -789,6 +808,81 @@ class Gateway:
                 request.match_info["path"])
         except PrimitiveError as exc:
             return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"ok": ok})
+
+    def _require_worker(self, request: web.Request) -> None:
+        self._ws(request)
+        if not request.get("is_worker"):
+            raise web.HTTPForbidden(
+                text=json.dumps({"error": "worker token required"}),
+                content_type="application/json")
+
+    async def _internal_volume_list(self, request: web.Request) -> web.Response:
+        self._require_worker(request)
+        entries = await self.volume_files.list(
+            request.match_info["workspace_id"], request.match_info["name"])
+        return web.json_response(entries)
+
+    async def _internal_volume_get(self, request: web.Request) -> web.Response:
+        self._require_worker(request)
+        try:
+            data = await self.volume_files.read(
+                request.match_info["workspace_id"],
+                request.match_info["name"], request.match_info["path"])
+        except PrimitiveError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        if data is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def _internal_volume_put(self, request: web.Request) -> web.Response:
+        self._require_worker(request)
+        data = await request.read()
+        try:
+            n = await self.volume_files.write(
+                request.match_info["workspace_id"],
+                request.match_info["name"], request.match_info["path"], data)
+        except PrimitiveError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"size": n})
+
+    async def _volume_mp_initiate(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        try:
+            upload_id = await self.volume_files.multipart_initiate(
+                ws.workspace_id, request.match_info["name"],
+                request.match_info["path"])
+        except PrimitiveError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"upload_id": upload_id})
+
+    async def _volume_mp_part(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        data = await request.read()
+        try:
+            await self.volume_files.multipart_put_part(
+                ws.workspace_id, request.match_info["upload_id"],
+                int(request.match_info["index"]), data)
+        except (PrimitiveError, ValueError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"ok": True, "size": len(data)})
+
+    async def _volume_mp_complete(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        body = await request.json()
+        try:
+            size = await self.volume_files.multipart_complete(
+                ws.workspace_id, request.match_info["upload_id"],
+                int(body.get("parts", 0)))
+        except (PrimitiveError, ValueError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"ok": True, "size": size})
+
+    async def _volume_mp_abort(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        ok = await self.volume_files.multipart_abort(
+            ws.workspace_id, request.match_info["upload_id"])
         return web.json_response({"ok": ok})
 
     # -- handlers: images ------------------------------------------------------

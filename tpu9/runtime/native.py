@@ -27,6 +27,7 @@ import logging
 import os
 import shutil
 import signal
+import time
 from typing import Optional
 
 from .base import ContainerHandle, ContainerSpec, Runtime, RuntimeState
@@ -90,6 +91,14 @@ class NativeRuntime(Runtime):
             ns = line.split()[0] if line.split() else ""
             if not ns.startswith("t9-"):
                 continue
+            # age gate: another runtime may have just created this netns and
+            # not yet started its container — only reap cold leftovers
+            try:
+                age = time.time() - os.stat(f"/run/netns/{ns}").st_ctime
+            except OSError:
+                continue
+            if age < 120.0:
+                continue
             pids = subprocess.run(["ip", "netns", "pids", ns],
                                   capture_output=True, text=True).stdout
             if not pids.strip():
@@ -121,12 +130,15 @@ class NativeRuntime(Runtime):
         import hashlib
         ns = self._netns(container_id)
         last_err: Optional[Exception] = None
-        for salt in range(4):
+        for salt in range(8):
             digest = hashlib.sha1(
                 f"{container_id}:{salt}".encode()).hexdigest()
             slot = int(digest[:6], 16) % 16000
-            host_if = f"t9h{digest[:8]}"
-            cont_if = f"t9c{digest[:8]}"
+            # the ifname ENCODES the slot: two containers hashing to the
+            # same /30 collide on the interface name and retry with a new
+            # salt, instead of silently double-assigning the same IPs
+            host_if = f"t9h{slot}"
+            cont_if = f"t9c{slot}"
             host_ip, cont_ip = self._ips(slot)
             try:
                 _run(["ip", "netns", "add", ns])

@@ -151,13 +151,66 @@ class Volume(_Bound):
         from urllib.parse import quote
         return quote(path, safe="/")
 
+    # files beyond this ride multipart (parallel parts; the gateway's
+    # single-shot body cap is 512 MB — reference sdk multipart.py)
+    MULTIPART_THRESHOLD = 32 * 1024 * 1024
+    MULTIPART_PART_SIZE = 16 * 1024 * 1024
+
     def upload(self, local_path: str, remote_path: str = "") -> int:
+        import os
         remote = remote_path or local_path.rsplit("/", 1)[-1]
+        size = os.path.getsize(local_path)
+        if size > self.MULTIPART_THRESHOLD:
+            return self._upload_multipart(local_path, remote, size)
         data = open(local_path, "rb").read()
         out = self.client._run(lambda c: c.request(
             "PUT", f"/rpc/volume/{self.name}/files/{self._q(remote)}",
             data=data))
         return out["size"]
+
+    def _upload_multipart(self, local_path: str, remote: str,
+                          size: int) -> int:
+        import asyncio
+
+        part = self.MULTIPART_PART_SIZE
+        n_parts = (size + part - 1) // part
+
+        async def run(c) -> int:
+            out = await c.request(
+                "POST",
+                f"/rpc/volume/{self.name}/multipart/initiate/"
+                f"{self._q(remote)}")
+            upload_id = out["upload_id"]
+            sem = asyncio.Semaphore(4)
+
+            async def put(i: int) -> None:
+                async with sem:
+                    with open(local_path, "rb") as f:
+                        f.seek(i * part)
+                        data = f.read(part)
+                    await c.request(
+                        "PUT",
+                        f"/rpc/volume/{self.name}/multipart/"
+                        f"{upload_id}/{i}", data=data)
+
+            try:
+                await asyncio.gather(*[put(i) for i in range(n_parts)])
+                done = await c.request(
+                    "POST",
+                    f"/rpc/volume/{self.name}/multipart/{upload_id}/"
+                    f"complete", json_body={"parts": n_parts})
+            except Exception:
+                # reclaim the parts instead of leaking .mp/ objects
+                try:
+                    await c.request(
+                        "DELETE",
+                        f"/rpc/volume/{self.name}/multipart/{upload_id}")
+                except Exception:
+                    pass
+                raise
+            return done["size"]
+
+        return self.client._run(run)
 
     def download(self, remote_path: str) -> bytes:
         return self.client._run(lambda c: c.request_bytes(

@@ -1,0 +1,5 @@
+from .objstore import (GcsObjectStore, LocalObjectStore, MultipartUpload,
+                       ObjectStore, make_store)
+
+__all__ = ["ObjectStore", "LocalObjectStore", "GcsObjectStore",
+           "MultipartUpload", "make_store"]

@@ -39,11 +39,17 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def _validate_volume_name(name: str) -> None:
+    if not name or "/" in name or "\\" in name or name in (".", ".."):
+        raise ValueError(f"invalid volume name {name!r}")
+
+
 class ContainerLifecycle:
     def __init__(self, worker_id: str, cfg: WorkerConfig, runtime: Runtime,
                  containers: ContainerRepository, tpu: TpuDeviceManager,
                  object_resolver: Optional[Callable[[str], Awaitable[str]]] = None,
                  image_resolver: Optional[Callable[[str], Awaitable[str]]] = None,
+                 volume_sync=None,
                  checkpoints=None,
                  phase_cb: Optional[Callable[[str, str, float], None]] = None):
         self.worker_id = worker_id
@@ -53,6 +59,15 @@ class ContainerLifecycle:
         self.tpu = tpu
         self.object_resolver = object_resolver
         self.image_resolver = image_resolver
+        # async (workspace_id, volume_name) -> local dir: pulls volume
+        # contents from the gateway's object store when this worker doesn't
+        # share the storage root (cfg.storage_shared False; geesefs analogue
+        # without FUSE — sync-down at start, push-back at exit)
+        self.volume_sync = volume_sync
+        # async (workspace_id, volume_name, local_dir) -> None
+        self.volume_push = None
+        # container -> [(workspace_id, volume_name, local_dir)] to push back
+        self._synced_volumes: dict[str, list[tuple[str, str, str]]] = {}
         self.checkpoints = checkpoints   # Optional[CheckpointManager]
         self.phase_cb = phase_cb
         self._active: dict[str, asyncio.Task] = {}
@@ -138,6 +153,18 @@ class ContainerLifecycle:
             if needs_probe:
                 ready = await self._wait_ready(container_id, address)
                 if not ready:
+                    # one-shot containers (function/schedule) can finish
+                    # their whole job before the probe ever succeeds — a
+                    # clean exit is completion, not a failed start. Hand
+                    # straight to the supervisor (exit bookkeeping, volume
+                    # push-back) instead of the failure path.
+                    h = await self.runtime.state(container_id)
+                    if (request.stub_type in (StubType.FUNCTION.value,
+                                              StubType.SCHEDULE.value)
+                            and h is not None and h.exit_code == 0):
+                        self._active[container_id] = asyncio.create_task(
+                            self._supervise(request, state))
+                        return
                     raise RuntimeError("container failed readiness probe")
             elif request.stub_type == StubType.POD.value:
                 # pods with a server: best-effort TCP readiness so the proxy
@@ -218,6 +245,18 @@ class ContainerLifecycle:
         self._active.pop(container_id, None)
         self.memory_limits.pop(container_id, None)
         self._stop_requested.pop(container_id, None)
+        # cross-host volumes: push container writes back to the object store
+        # (last-writer-wins, like the reference's S3-FUSE semantics)
+        for ws_id, vol_name, local_dir in self._synced_volumes.pop(
+                container_id, []):
+            if self.volume_push is not None:
+                try:
+                    await self.volume_push(ws_id, vol_name, local_dir)
+                    log.info("volume %s/%s pushed back from %s",
+                             ws_id, vol_name, container_id)
+                except Exception as exc:    # noqa: BLE001
+                    log.warning("volume push %s/%s failed: %s",
+                                ws_id, vol_name, exc)
 
     async def stop_container(self, container_id: str,
                              reason: str = StopReason.USER.value) -> bool:
@@ -273,8 +312,19 @@ class ContainerLifecycle:
         for mount in request.mounts:
             if mount.kind != "volume" or not mount.target:
                 continue
-            host_dir = self._safe_volume_dir(request.workspace_id,
-                                             mount.source)
+            # worker-side name validation stays on BOTH branches (defense in
+            # depth with volume_mounts(): a crafted source must never become
+            # a path outside the volume root)
+            _validate_volume_name(mount.source)
+            if not self.cfg.storage_shared and self.volume_sync is not None:
+                host_dir = await self.volume_sync(request.workspace_id,
+                                                  mount.source)
+                self._synced_volumes.setdefault(
+                    request.container_id, []).append(
+                        (request.workspace_id, mount.source, host_dir))
+            else:
+                host_dir = self._safe_volume_dir(request.workspace_id,
+                                                 mount.source)
             os.makedirs(host_dir, exist_ok=True)
             link = os.path.realpath(
                 os.path.join(base, mount.target.lstrip("/")))
@@ -291,8 +341,7 @@ class ContainerLifecycle:
         volume root (same containment contract as VolumeFiles._safe — a
         crafted name like '../../<other-ws>/volumes/x' must never resolve
         cross-tenant)."""
-        if not name or "/" in name or "\\" in name or name in (".", ".."):
-            raise ValueError(f"invalid volume name {name!r}")
+        _validate_volume_name(name)
         base = os.path.realpath(os.path.join(self.cfg.storage_root,
                                              workspace_id, "volumes"))
         full = os.path.realpath(os.path.join(base, name))

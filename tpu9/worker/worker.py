@@ -54,6 +54,7 @@ class Worker:
                  slice_topology: str = "", slice_host_rank: int = 0,
                  slice_host_count: int = 1,
                  object_resolver=None, image_resolver=None,
+                 volume_sync=None, volume_push=None,
                  cache=None, checkpoints=None, phase_cb=None) -> None:
         self.cfg = cfg or WorkerConfig()
         self.worker_id = worker_id or new_id("worker")
@@ -71,7 +72,9 @@ class Worker:
         self.lifecycle = ContainerLifecycle(
             self.worker_id, self.cfg, runtime, self.containers, self.tpu,
             object_resolver=object_resolver, image_resolver=image_resolver,
+            volume_sync=volume_sync,
             checkpoints=checkpoints, phase_cb=phase_cb)
+        self.lifecycle.volume_push = volume_push
         self.slice_id = slice_id
         self.slice_topology = slice_topology
         self.slice_host_rank = slice_host_rank
