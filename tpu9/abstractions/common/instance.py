@@ -30,14 +30,15 @@ def volume_mounts(cfg: StubConfig) -> list[Mount]:
     a volume name is a single path component; a mount path may not traverse.
     """
     out = []
-    for v in cfg.volumes:
-        name = v.get("name", "")
-        target = v.get("mount_path", "")
-        if not name or "/" in name or "\\" in name or name in (".", ".."):
-            raise ValueError(f"invalid volume name {name!r}")
-        if ".." in target.split("/"):
-            raise ValueError(f"invalid mount path {target!r}")
-        out.append(Mount(source=name, target=target, kind="volume"))
+    for kind, entries in (("volume", cfg.volumes), ("disk", cfg.disks)):
+        for v in entries:
+            name = v.get("name", "")
+            target = v.get("mount_path", "")
+            if not name or "/" in name or "\\" in name or name in (".", ".."):
+                raise ValueError(f"invalid {kind} name {name!r}")
+            if ".." in target.split("/"):
+                raise ValueError(f"invalid mount path {target!r}")
+            out.append(Mount(source=name, target=target, kind=kind))
     return out
 
 
@@ -48,7 +49,7 @@ class AutoscaledInstance:
                  decide_policy, sample_extra=None,
                  entrypoint: Optional[list[str]] = None,
                  pool_selector: str = "", checkpoint_lookup=None,
-                 secret_env_fn=None):
+                 secret_env_fn=None, disks=None):
         self.stub = stub
         self.scheduler = scheduler
         self.containers = containers
@@ -60,6 +61,7 @@ class AutoscaledInstance:
         # async () -> dict: declared workspace secrets resolved fresh at
         # every container start (rotation applies on next cold start)
         self.secret_env_fn = secret_env_fn
+        self.disks = disks               # Optional[DiskService]
         self._sample_extra = sample_extra   # async () -> (queue_depth, pressure)
         self.autoscaler = Autoscaler(self._sample, decide_policy, self._apply)
         self._last_active = time.monotonic()
@@ -187,6 +189,8 @@ class AutoscaledInstance:
             pool_selector=self.pool_selector,
             checkpoint_id=checkpoint_id,
         )
+        if cfg.disks and self.disks is not None:
+            await self.disks.decorate_request(request, cfg.disks)
         await self.scheduler.run(request)
         return request.container_id
 

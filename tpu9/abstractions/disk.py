@@ -1,0 +1,95 @@
+"""Durable-disk service (gateway side).
+
+Reference analogue: ``pkg/abstractions/disk/`` + ``pkg/worker/
+durable_disk.go`` — persistent host disks with snapshots. The gateway CRUDs
+disk records, routes snapshot requests to the worker currently holding the
+live dir (the ``disk:loc`` key written at attach), and decorates container
+requests with the latest snapshot id + placement affinity."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..backend import BackendDB
+from ..statestore import StateStore
+from ..types import new_id
+
+log = logging.getLogger("tpu9.abstractions")
+
+
+class DiskService:
+    def __init__(self, backend: BackendDB, store: StateStore):
+        self.backend = backend
+        self.store = store
+
+    async def ensure(self, workspace_id: str, name: str) -> dict:
+        return await self.backend.get_or_create_disk(workspace_id, name)
+
+    async def list(self, workspace_id: str) -> list[dict]:
+        return await self.backend.list_disks(workspace_id)
+
+    async def location(self, workspace_id: str, name: str) -> Optional[str]:
+        return await self.store.get(f"disk:loc:{workspace_id}:{name}")
+
+    async def latest_snapshot(self, workspace_id: str,
+                              name: str) -> str:
+        row = await self.backend.get_disk(workspace_id, name)
+        return (row or {}).get("snapshot_id", "") or ""
+
+    async def decorate_request(self, request, disks: list[dict]) -> None:
+        """Attach snapshot ids + placement affinity for a request mounting
+        these disks (scheduler prefers the live holder; a fresh worker
+        restores from the latest snapshot)."""
+        for d in disks:
+            name = d.get("name", "")
+            if not name:
+                continue
+            await self.ensure(request.workspace_id, name)
+            snap = await self.latest_snapshot(request.workspace_id, name)
+            if snap:
+                request.disk_snapshots[name] = snap
+            loc = await self.location(request.workspace_id, name)
+            if loc and not request.disk_affinity:
+                request.disk_affinity = loc
+
+    async def snapshot(self, workspace_id: str, name: str,
+                       timeout: float = 120.0) -> dict:
+        """Ask the owning worker to snapshot the disk (durable_disk.go:263)."""
+        row = await self.backend.get_disk(workspace_id, name)
+        if row is None:
+            return {"error": "disk not found"}
+        worker_id = await self.location(workspace_id, name)
+        if not worker_id:
+            return {"error": "disk has no live worker (never attached?)"}
+        reply = f"diskreply:{new_id('dr')}"
+        sub = self.store.subscribe(reply)
+        try:
+            n = await self.store.publish(f"disk:snap:{worker_id}", {
+                "workspace_id": workspace_id, "name": name, "reply": reply})
+            if not n:
+                return {"error": f"worker {worker_id} unreachable"}
+            msg = await sub.get(timeout=timeout)
+            if msg is None:
+                return {"error": "snapshot timed out"}
+            return msg[1]
+        finally:
+            sub.close()
+
+    async def delete(self, workspace_id: str, name: str) -> bool:
+        # clear the LIVE dir on the holding worker too — a future disk with
+        # the same name must start empty, not resurrect deleted data
+        worker_id = await self.location(workspace_id, name)
+        if worker_id:
+            reply = f"diskreply:{new_id('dr')}"
+            sub = self.store.subscribe(reply)
+            try:
+                n = await self.store.publish(f"disk:snap:{worker_id}", {
+                    "op": "delete", "workspace_id": workspace_id,
+                    "name": name, "reply": reply})
+                if n:
+                    await sub.get(timeout=30.0)
+            finally:
+                sub.close()
+        await self.store.delete(f"disk:loc:{workspace_id}:{name}")
+        return await self.backend.delete_disk(workspace_id, name)

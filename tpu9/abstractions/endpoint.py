@@ -54,7 +54,8 @@ class EndpointService:
                 inst = EndpointInstance(
                     stub, self.scheduler, self.containers,
                     checkpoint_lookup=latest_ckpt,
-                    secret_env_fn=stub_secret_env_fn(self.backend, stub))
+                    secret_env_fn=stub_secret_env_fn(self.backend, stub),
+                    disks=getattr(self, "disks", None))
                 # runner env + token so LLM runners can heartbeat pressure
                 # and reach the gateway like taskqueue/function runners do
                 inst.instance.extra_env = dict(self.runner_env)
@@ -86,7 +87,7 @@ class EndpointInstance:
 
     def __init__(self, stub: Stub, scheduler: Scheduler,
                  containers: ContainerRepository, checkpoint_lookup=None,
-                 secret_env_fn=None):
+                 secret_env_fn=None, disks=None):
         self.stub = stub
         a = stub.config.autoscaler
         self.router = None
@@ -108,7 +109,7 @@ class EndpointInstance:
             stub, scheduler, containers, policy,
             sample_extra=self._sample_extra,
             checkpoint_lookup=checkpoint_lookup,
-            secret_env_fn=secret_env_fn)
+            secret_env_fn=secret_env_fn, disks=disks)
         self._containers = containers
 
     async def _sample_extra(self):

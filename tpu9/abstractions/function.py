@@ -123,6 +123,7 @@ class FunctionService:
             "TPU9_TOKEN": await self.runner_tokens.get(stub.workspace_id),
         })
         from .common.instance import volume_mounts
+        disks_svc = getattr(self, "disks", None)
         request = ContainerRequest(
             container_id=new_id("ct"),
             stub_id=stub.stub_id,
@@ -136,6 +137,8 @@ class FunctionService:
             env=env,
             mounts=volume_mounts(cfg),
         )
+        if cfg.disks and disks_svc is not None:
+            await disks_svc.decorate_request(request, cfg.disks)
         await self.scheduler.run(request)
         return request.container_id
 

@@ -72,6 +72,9 @@ class PodService:
             ports=list(cfg.ports),
             mounts=volume_mounts(cfg),
         )
+        if cfg.disks and getattr(self, "disks", None) is not None:
+            # latest snapshot + live-holder affinity (durable_disk placement)
+            await self.disks.decorate_request(request, cfg.disks)
         await self.scheduler.run(request)
         return {"container_id": request.container_id}
 

@@ -232,10 +232,14 @@ class VolumeFiles:
 
     async def multipart_complete(self, workspace_id: str, upload_id: str,
                                  n_parts: int) -> int:
-        entry = self._multiparts.pop(upload_id, None)
+        entry = self._multiparts.get(upload_id)
         if entry is None or entry[1] != workspace_id:
             raise PrimitiveError("unknown upload")
-        return await entry[0].complete(n_parts)
+        # pop only on SUCCESS: a failed complete (missing part) must leave
+        # the entry so the client's abort can still reclaim the parts
+        size = await entry[0].complete(n_parts)
+        self._multiparts.pop(upload_id, None)
+        return size
 
     async def multipart_abort(self, workspace_id: str,
                               upload_id: str) -> bool:

@@ -64,7 +64,8 @@ class TaskQueueService:
                 inst = AutoscaledInstance(
                     stub, self.scheduler, self.containers, policy,
                     sample_extra=sample_extra,
-                    secret_env_fn=stub_secret_env_fn(self.backend, stub))
+                    secret_env_fn=stub_secret_env_fn(self.backend, stub),
+                    disks=getattr(self, "disks", None))
                 inst.extra_env = dict(self.runner_env)
                 inst.extra_env["TPU9_TOKEN"] = await self.runner_tokens.get(
                     stub.workspace_id)

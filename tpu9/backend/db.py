@@ -385,6 +385,53 @@ class BackendDB:
             (image_id, workspace_id))
         return bool(rows)
 
+    # -- durable disks ------------------------------------------------------
+
+    async def get_or_create_disk(self, workspace_id: str, name: str) -> dict:
+        rows = self._query(
+            "SELECT * FROM disks WHERE workspace_id=? AND name=?",
+            (workspace_id, name))
+        if rows:
+            return dict(rows[0])
+        disk_id = new_id("disk")
+        self._exec(
+            "INSERT INTO disks (disk_id, workspace_id, name, created_at, updated_at) VALUES (?,?,?,?,?)",
+            (disk_id, workspace_id, name, now(), now()))
+        return dict(self._query("SELECT * FROM disks WHERE disk_id=?",
+                                (disk_id,))[0])
+
+    async def get_disk(self, workspace_id: str, name: str) -> Optional[dict]:
+        rows = self._query(
+            "SELECT * FROM disks WHERE workspace_id=? AND name=?",
+            (workspace_id, name))
+        return dict(rows[0]) if rows else None
+
+    async def list_disks(self, workspace_id: str) -> list[dict]:
+        rows = self._query(
+            "SELECT disk_id, name, status, snapshot_id, size, created_at, updated_at FROM disks WHERE workspace_id=? ORDER BY name",
+            (workspace_id,))
+        return [dict(r) for r in rows]
+
+    async def set_disk_snapshot(self, workspace_id: str, name: str,
+                                snapshot_id: str, manifest_json: str,
+                                size: int) -> None:
+        self._exec(
+            "UPDATE disks SET snapshot_id=?, snapshot_manifest=?, size=?, updated_at=? WHERE workspace_id=? AND name=?",
+            (snapshot_id, manifest_json, size, now(), workspace_id, name))
+
+    async def get_disk_snapshot_manifest(
+            self, snapshot_id: str) -> Optional[str]:
+        rows = self._query(
+            "SELECT snapshot_manifest FROM disks WHERE snapshot_id=?",
+            (snapshot_id,))
+        return rows[0]["snapshot_manifest"] if rows else None
+
+    async def delete_disk(self, workspace_id: str, name: str) -> bool:
+        cur = self._exec(
+            "DELETE FROM disks WHERE workspace_id=? AND name=?",
+            (workspace_id, name))
+        return cur.rowcount > 0
+
     # -- checkpoints --------------------------------------------------------
 
     async def create_checkpoint(self, stub_id: str, workspace_id: str,

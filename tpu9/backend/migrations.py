@@ -164,4 +164,18 @@ MIGRATIONS: list[tuple[int, str, str]] = [
             PRIMARY KEY (image_id, workspace_id)
         );
     """),
+    (15, "disks", """
+        CREATE TABLE disks (
+            disk_id TEXT PRIMARY KEY,
+            workspace_id TEXT NOT NULL,
+            name TEXT NOT NULL,
+            status TEXT DEFAULT 'ready',
+            snapshot_id TEXT DEFAULT '',
+            snapshot_manifest TEXT DEFAULT '',
+            size INTEGER DEFAULT 0,
+            created_at REAL NOT NULL,
+            updated_at REAL NOT NULL,
+            UNIQUE(workspace_id, name)
+        );
+    """),
 ]

@@ -551,6 +551,12 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
     from ..worker.cache_manager import WorkerCache
 
     cfg = load_config(config_path or None)
+    if cfg.storage.mode == "gcs" and cfg.worker.storage_shared:
+        # a GCS-backed gateway with a "shared"-storage worker silently
+        # splits volumes into two disjoint stores — force sync mode
+        click.echo("storage.mode=gcs: forcing worker.storage_shared=false "
+                   "(volumes sync from the bucket)", err=True)
+        cfg.worker.storage_shared = False
 
     async def main() -> None:
         store = await RemoteStore(
@@ -667,6 +673,46 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                         await session.put(
                             f"{base}/{quote(rel, safe='/')}", data=data)
 
+        disks = None
+        if gateway_url and worker_token:
+            from ..worker.disks import DiskManager
+
+            async def disk_chunk_put(data: bytes, digest: str) -> None:
+                async with session.post(
+                        f"{gateway_url}/rpc/image/chunk/{digest}",
+                        data=data) as resp:
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"disk chunk upload failed: {resp.status}")
+
+            async def disk_chunk_get(digest: str):
+                async with session.get(
+                        f"{gateway_url}/rpc/image/chunk/{digest}") as resp:
+                    return await resp.read() if resp.status == 200 else None
+
+            async def disk_manifest_put(workspace_id, name, snapshot_id,
+                                        manifest_json, size) -> None:
+                async with session.post(
+                        f"{gateway_url}/rpc/internal/disk/{workspace_id}/"
+                        f"{name}/manifest/{snapshot_id}",
+                        data=manifest_json) as resp:
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"disk manifest upload failed: {resp.status}")
+
+            async def disk_manifest_get(snapshot_id: str):
+                async with session.get(
+                        f"{gateway_url}/rpc/internal/disk/manifest/"
+                        f"{snapshot_id}") as resp:
+                    return (await resp.text() if resp.status == 200
+                            else None)
+
+            disks = DiskManager(cfg.worker.disks_dir,
+                                chunk_put=disk_chunk_put,
+                                chunk_get=disk_chunk_get,
+                                manifest_put=disk_manifest_put,
+                                manifest_get=disk_manifest_get)
+
         from ..types import new_id
         cache = WorkerCache(cfg.cache, new_id("wc"), WorkerRepository(store),
                             source=chunk_source,
@@ -675,7 +721,8 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                    tpu_generation=tpu_gen, slice_id=slice_id,
                    slice_host_rank=slice_rank, slice_host_count=slice_hosts,
                    cache=cache, object_resolver=object_resolver,
-                   volume_sync=volume_sync, volume_push=volume_push)
+                   volume_sync=volume_sync, volume_push=volume_push,
+                   disks=disks)
         await w.start()
         click.echo(f"worker {w.worker_id} joined (pool={pool}, "
                    f"chips={w.tpu.chip_count})")

@@ -85,6 +85,7 @@ class WorkerConfig:
     storage_shared: bool = True
     logs_dir: str = "/tmp/tpu9/logs"
     checkpoint_dir: str = "/tmp/tpu9/checkpoints"
+    disks_dir: str = "/tmp/tpu9/disks"      # durable-disk host dirs
     # path to the built vcache_preload.so; when set, containers with volume
     # mounts read volume files through the node cache (LD_PRELOAD shim)
     vcache_so: str = ""

@@ -96,6 +96,14 @@ class Gateway:
         self.pods = PodService(self.backend, self.scheduler, self.containers,
                                self.store, runner_env=self.runner_env,
                                runner_tokens=self.runner_tokens)
+        from ..abstractions.disk import DiskService
+        self.disks = DiskService(self.backend, self.store)
+        # every request-building service decorates disk mounts with
+        # snapshot ids + placement affinity
+        self.pods.disks = self.disks
+        self.endpoints.disks = self.disks
+        self.taskqueues.disks = self.disks
+        self.functions.disks = self.disks
         self.maps = MapService(self.store)
         self.queues = QueueService(self.store)
         self.signals = SignalService(self.store)
@@ -153,6 +161,16 @@ class Gateway:
         r.add_post("/rpc/signal/{name}", self._rpc_signal)
         r.add_post("/rpc/output/save", self._rpc_output_save)
         r.add_get("/rpc/output/{output_id}", self._rpc_output_get)
+        # durable disks
+        r.add_get("/api/v1/disk", self._list_disks)
+        r.add_post("/api/v1/disk/{name}/snapshot", self._disk_snapshot)
+        r.add_delete("/api/v1/disk/{name}", self._disk_delete)
+        # worker-token disk internals (manifest store/fetch + chunk sink
+        # ride the image chunk registry)
+        r.add_post("/rpc/internal/disk/{workspace_id}/{name}/manifest/"
+                   "{snapshot_id}", self._internal_disk_manifest_put)
+        r.add_get("/rpc/internal/disk/manifest/{snapshot_id}",
+                  self._internal_disk_manifest_get)
         r.add_get("/api/v1/volume", self._list_volumes)
         r.add_post("/api/v1/volume/{name}", self._create_volume)
         r.add_delete("/api/v1/volume/{name}", self._delete_volume)
@@ -1255,6 +1273,45 @@ class Gateway:
             await self.store.xadd(f"shell:in:{session_id}", {"close": True})
             down.cancel()
         return ws
+
+    async def _list_disks(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        return web.json_response(await self.disks.list(ws.workspace_id))
+
+    async def _disk_snapshot(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        out = await self.disks.snapshot(ws.workspace_id,
+                                        request.match_info["name"])
+        status = 200 if "error" not in out else 409
+        return web.json_response(out, status=status)
+
+    async def _disk_delete(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        ok = await self.disks.delete(ws.workspace_id,
+                                     request.match_info["name"])
+        return web.json_response({"ok": ok})
+
+    async def _internal_disk_manifest_put(self, request: web.Request) -> web.Response:
+        self._require_worker(request)
+        blob = await request.text()
+        from ..images import ImageManifest
+        try:
+            manifest = ImageManifest.from_json(blob)
+        except Exception as exc:   # noqa: BLE001
+            return web.json_response({"error": f"bad manifest: {exc}"},
+                                     status=400)
+        await self.backend.set_disk_snapshot(
+            request.match_info["workspace_id"], request.match_info["name"],
+            request.match_info["snapshot_id"], blob, manifest.total_bytes)
+        return web.json_response({"ok": True})
+
+    async def _internal_disk_manifest_get(self, request: web.Request) -> web.Response:
+        self._require_worker(request)
+        blob = await self.backend.get_disk_snapshot_manifest(
+            request.match_info["snapshot_id"])
+        if blob is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(text=blob, content_type="application/json")
 
     async def _list_tasks(self, request: web.Request) -> web.Response:
         ws = self._ws(request)

@@ -134,13 +134,26 @@ class ProcessRuntime(Runtime):
                 pass
         return code
 
+    def _exec_cwd(self, container_id: str) -> str:
+        """Exec runs where the container's entrypoint does (its workdir,
+        where volume/disk mounts are linked), not the runtime scratch dir."""
+        spec = self._specs.get(container_id)
+        if spec is not None and spec.workdir not in ("", "/"):
+            return spec.workdir
+        return self.sandbox_dir(container_id)
+
     async def exec(self, container_id: str, cmd: list[str]) -> tuple[int, str]:
         """Run a command in the container's sandbox/env context."""
         handle = self._handles.get(container_id)
         if handle is None or handle.state != RuntimeState.RUNNING:
             return (-1, "container not running")
+        spec = self._specs.get(container_id)
+        env = {k: v for k in _ENV_ALLOWLIST
+               if (v := os.environ.get(k)) is not None}
+        if spec is not None:
+            env.update(spec.env)
         proc = await asyncio.create_subprocess_exec(
-            *cmd, cwd=self.sandbox_dir(container_id),
+            *cmd, cwd=self._exec_cwd(container_id), env=env,
             stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
         out, _ = await proc.communicate()
         return (proc.returncode or 0, out.decode(errors="replace"))
@@ -164,7 +177,7 @@ class ProcessRuntime(Runtime):
         import pty as _pty
         master, slave = _pty.openpty()
         proc = await asyncio.create_subprocess_exec(
-            *cmd, cwd=self.sandbox_dir(container_id), env=env,
+            *cmd, cwd=self._exec_cwd(container_id), env=env,
             stdin=slave, stdout=slave, stderr=slave,
             preexec_fn=os.setsid, close_fds=True)
         os.close(slave)

@@ -152,7 +152,15 @@ class Scheduler:
             await self._schedule_gang(request, workers, alive, spec)
             return
 
-        worker = select_worker(workers, request, alive)
+        worker = None
+        if request.disk_affinity:
+            # durable-disk placement: the worker holding the live disk dir
+            # wins when it fits; otherwise any worker restores the snapshot
+            preferred = [w for w in workers
+                         if w.worker_id == request.disk_affinity]
+            worker = select_worker(preferred, request, alive)
+        if worker is None:
+            worker = select_worker(workers, request, alive)
         if worker is None:
             await self._try_scale_up(request)
             raise SchedulingFailed("no eligible worker")

@@ -50,7 +50,8 @@ class RunnerAbstraction:
                  autoscaler: Optional[QueueDepthAutoscaler] = None,
                  checkpoint_enabled: bool = False,
                  env: Optional[dict] = None, secrets: Optional[list] = None,
-                 volumes: Optional[list] = None, authorized: bool = True,
+                 volumes: Optional[list] = None,
+                 disks: Optional[list] = None, authorized: bool = True,
                  runner: str = "", on_start: Optional[Callable] = None):
         self.func = func
         self.name = name
@@ -66,6 +67,8 @@ class RunnerAbstraction:
             env=dict(env or {}), secrets=list(secrets or []),
             volumes=[v.to_dict() if hasattr(v, "to_dict") else v
                      for v in (volumes or [])],
+            disks=[d.to_dict() if hasattr(d, "to_dict") else d
+                   for d in (disks or [])],
             authorized=authorized,
         )
         if runner:

@@ -129,6 +129,30 @@ class Secret:
             "DELETE", f"/api/v1/secret/{self.name}"))
 
 
+class Disk(_Bound):
+    """Durable disk: worker-persistent directory with snapshots (reference
+    disk abstraction + durable_disk.go).
+
+        disk = Disk(name="scratch", mount_path="/disk")
+        @endpoint(disks=[disk]) / Pod(disks=[disk]) ...
+        disk.snapshot()          # chunk + persist the live dir
+    """
+
+    def __init__(self, name: str, mount_path: str = ""):
+        super().__init__(name)
+        self.mount_path = mount_path or f"/disks/{name}"
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "mount_path": self.mount_path}
+
+    def snapshot(self) -> dict:
+        return self.client._run(lambda c: c.request(
+            "POST", f"/api/v1/disk/{self.name}/snapshot"))
+
+    def status(self) -> list[dict]:
+        return self.client._run(lambda c: c.request("GET", "/api/v1/disk"))
+
+
 class Volume(_Bound):
     """Workspace file share mounted into containers.
 

@@ -35,6 +35,21 @@ class ObjectStoreError(RuntimeError):
     pass
 
 
+def _rfc3339_to_epoch(value) -> float:
+    """GCS 'updated' timestamps → epoch float so sync-skip comparisons work
+    identically across backends (a string mtime silently disables them)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    if not value:
+        return 0.0
+    from datetime import datetime
+    try:
+        return datetime.fromisoformat(str(value).replace("Z", "+00:00")
+                                      ).timestamp()
+    except ValueError:
+        return 0.0
+
+
 class MultipartUpload:
     def __init__(self, store: "ObjectStore", key: str, upload_id: str):
         self.store = store
@@ -256,7 +271,7 @@ class GcsObjectStore(ObjectStore):
             raise ObjectStoreError(f"GCS stat {key}: {status}")
         doc = _json.loads(body)
         return {"size": int(doc.get("size", 0)),
-                "mtime": doc.get("updated", 0)}
+                "mtime": _rfc3339_to_epoch(doc.get("updated", 0))}
 
     async def list_meta(self, prefix: str = "") -> list[dict]:
         import json as _json
@@ -274,7 +289,7 @@ class GcsObjectStore(ObjectStore):
             doc = _json.loads(body or b"{}")
             out.extend({"name": item["name"],
                         "size": int(item.get("size", 0)),
-                        "mtime": item.get("updated", 0)}
+                        "mtime": _rfc3339_to_epoch(item.get("updated", 0))}
                        for item in doc.get("items", []))
             page = doc.get("nextPageToken", "")
             if not page:

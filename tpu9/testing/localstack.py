@@ -128,12 +128,32 @@ class LocalStack:
             fetch_manifest=self._ckpt_fetch,
             store_manifest=self._ckpt_store,
             marker_timeout_s=20.0)
+
+        from ..worker.disks import DiskManager
+
+        async def disk_chunk_put(data: bytes, digest: str) -> None:
+            self.gateway.images.builder.store_chunk_verified(data, digest)
+
+        async def disk_chunk_get(digest: str):
+            return self.gateway.images.chunk(digest)
+
+        async def disk_manifest_put(workspace_id, name, snapshot_id,
+                                    manifest_json, size) -> None:
+            await self.backend.set_disk_snapshot(workspace_id, name,
+                                                 snapshot_id, manifest_json,
+                                                 size)
+
+        disks = DiskManager(
+            os.path.join(self.tmp.name, f"disks-{len(self.workers)}"),
+            chunk_put=disk_chunk_put, chunk_get=disk_chunk_get,
+            manifest_put=disk_manifest_put,
+            manifest_get=self.backend.get_disk_snapshot_manifest)
         worker = Worker(
             self.store, runtime, cfg=self.cfg.worker, pool=pool,
             cpu_millicores=16000, memory_mb=32768,   # virtual capacity: these
             # workers time-share the host the way k8s test nodes do
             tpu_generation=tpu_generation, cache=cache,
-            checkpoints=checkpoints,
+            checkpoints=checkpoints, disks=disks,
             object_resolver=self._resolve_object, **slice_kw)
         await worker.start()
         self.workers.append(worker)

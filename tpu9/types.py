@@ -262,6 +262,7 @@ class StubConfig(_Serializable):
     env: dict[str, str] = field(default_factory=dict)
     secrets: list[str] = field(default_factory=list)
     volumes: list[dict[str, Any]] = field(default_factory=list)
+    disks: list[dict[str, Any]] = field(default_factory=list)
     entrypoint: list[str] = field(default_factory=list)  # pod-style override
     ports: list[int] = field(default_factory=list)
     authorized: bool = True
@@ -341,7 +342,7 @@ class Mount(_Serializable):
     source: str = ""
     target: str = ""
     read_only: bool = False
-    kind: str = "bind"            # bind | volume | cache
+    kind: str = "bind"            # bind | volume | cache | disk
 
 
 @dataclass
@@ -379,6 +380,11 @@ class ContainerRequest(_Serializable):
     pool_selector: str = ""
     priority: int = 0
     checkpoint_id: str = ""       # restore-from if set
+    # durable disks (durable_disk.go analogue): latest snapshot per disk
+    # name (restore source on a fresh worker) + preferred worker holding
+    # the live disk dir (scheduler affinity)
+    disk_snapshots: dict[str, str] = field(default_factory=dict)
+    disk_affinity: str = ""
     retry_count: int = 0
     timestamp: float = field(default_factory=now)
 

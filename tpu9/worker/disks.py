@@ -1,0 +1,136 @@
+"""Durable disks: worker-local persistent dirs with snapshot/restore.
+
+Reference analogue: ``pkg/worker/durable_disk.go:37,159,263`` — host-dir
+disks attached to containers, snapshotted to S3 with a manifest and
+restored on other hosts. tpu9 disks reuse the chunked-manifest machinery
+images/checkpoints use: a snapshot walks the disk dir into content-
+addressed chunks (pushed through injected hooks — the distributed cache
+and/or the gateway chunk registry), the manifest lands in the backend disk
+row, and a fresh worker materializes the latest snapshot at attach time.
+
+Attachment is exclusive per disk per worker; the scheduler prefers the
+worker that holds the live dir (request.disk_affinity)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Awaitable, Callable, Optional
+
+from ..images.manifest import ImageManifest, materialize, snapshot_dir
+from ..types import new_id
+
+log = logging.getLogger("tpu9.worker")
+
+# async (data, digest) -> None — durable chunk sink (gateway registry/cache)
+ChunkPut = Callable[[bytes, str], Awaitable[None]]
+# async (digest) -> bytes | None
+ChunkGet = Callable[[str], Awaitable[Optional[bytes]]]
+# async (workspace_id, name, snapshot_id, manifest_json, size) -> None
+ManifestPut = Callable[..., Awaitable[None]]
+# async (snapshot_id) -> manifest json | None
+ManifestGet = Callable[[str], Awaitable[Optional[str]]]
+
+
+class DiskManager:
+    def __init__(self, disks_dir: str,
+                 chunk_put: Optional[ChunkPut] = None,
+                 chunk_get: Optional[ChunkGet] = None,
+                 manifest_put: Optional[ManifestPut] = None,
+                 manifest_get: Optional[ManifestGet] = None):
+        self.disks_dir = disks_dir
+        self.chunk_put = chunk_put
+        self.chunk_get = chunk_get
+        self.manifest_put = manifest_put
+        self.manifest_get = manifest_get
+        self._locks: dict[str, asyncio.Lock] = {}
+
+    def disk_dir(self, workspace_id: str, name: str) -> str:
+        for part in (workspace_id, name):
+            if (not part or "/" in part or "\\" in part
+                    or part in (".", "..")):
+                raise ValueError(f"invalid disk path part {part!r}")
+        return os.path.join(self.disks_dir, workspace_id, name)
+
+    def _lock(self, key: str) -> asyncio.Lock:
+        return self._locks.setdefault(key, asyncio.Lock())
+
+    async def attach(self, workspace_id: str, name: str,
+                     snapshot_id: str = "") -> str:
+        """Return the disk's local dir, restoring the latest snapshot first
+        when this worker has never seen the disk (attach-on-schedule,
+        durable_disk.go:159)."""
+        d = self.disk_dir(workspace_id, name)
+        async with self._lock(d):
+            if os.path.isdir(d):
+                return d
+            os.makedirs(d, exist_ok=True)
+            if snapshot_id and self.manifest_get and self.chunk_get:
+                try:
+                    blob = await self.manifest_get(snapshot_id)
+                    if blob:
+                        manifest = ImageManifest.from_json(blob)
+                        # chunk fetches stream on demand from inside the
+                        # materialize thread — restore memory stays O(chunk),
+                        # not O(disk)
+                        loop = asyncio.get_running_loop()
+
+                        def get_chunk(digest: str) -> Optional[bytes]:
+                            return asyncio.run_coroutine_threadsafe(
+                                self.chunk_get(digest), loop).result()
+
+                        await asyncio.to_thread(materialize, manifest, d,
+                                                get_chunk, None)
+                        log.info("disk %s/%s restored from %s",
+                                 workspace_id, name, snapshot_id)
+                except Exception as exc:    # noqa: BLE001 — empty > dead
+                    log.warning("disk restore %s failed: %s (empty attach)",
+                                snapshot_id, exc)
+                    # never hand out a half-restored disk
+                    import shutil
+                    await asyncio.to_thread(shutil.rmtree, d, True)
+                    os.makedirs(d, exist_ok=True)
+            return d
+
+    async def remove(self, workspace_id: str, name: str) -> bool:
+        """Delete the live dir — a later same-named disk must start empty,
+        not resurrect deleted data."""
+        import shutil
+        d = self.disk_dir(workspace_id, name)
+        async with self._lock(d):
+            if os.path.isdir(d):
+                await asyncio.to_thread(shutil.rmtree, d, True)
+                return True
+            return False
+
+    async def snapshot(self, workspace_id: str, name: str) -> dict:
+        """Chunk the disk dir and persist manifest + chunks through the
+        hooks (durable_disk.go:263's snapshot-to-S3)."""
+        d = self.disk_dir(workspace_id, name)
+        if not os.path.isdir(d):
+            return {"error": "disk not present on this worker"}
+        if self.chunk_put is None or self.manifest_put is None:
+            return {"error": "worker has no snapshot sink"}
+        async with self._lock(d):
+            snapshot_id = new_id("dsnap")
+            # uploads stream from inside the walking thread — snapshot
+            # memory stays O(chunk) whatever the disk size
+            loop = asyncio.get_running_loop()
+
+            def put_chunk(data: bytes, digest: str) -> None:
+                asyncio.run_coroutine_threadsafe(
+                    self.chunk_put(data, digest), loop).result()
+
+            manifest = await asyncio.to_thread(snapshot_dir, d,
+                                               4 * 1024 * 1024, put_chunk)
+            manifest.image_id = snapshot_id
+            await self.manifest_put(workspace_id, name, snapshot_id,
+                                    manifest.to_json(),
+                                    manifest.total_bytes)
+            log.info("disk %s/%s snapshot %s: %d files, %d MiB",
+                     workspace_id, name, snapshot_id, len(manifest.files),
+                     manifest.total_bytes >> 20)
+            return {"snapshot_id": snapshot_id,
+                    "size": manifest.total_bytes,
+                    "files": len(manifest.files)}

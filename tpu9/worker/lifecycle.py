@@ -66,6 +66,9 @@ class ContainerLifecycle:
         self.volume_sync = volume_sync
         # async (workspace_id, volume_name, local_dir) -> None
         self.volume_push = None
+        # durable disks (set by the Worker): DiskManager + attach notifier
+        self.disks = None
+        self.disk_attached = None
         # container -> [(workspace_id, volume_name, local_dir)] to push back
         self._synced_volumes: dict[str, list[tuple[str, str, str]]] = {}
         self.checkpoints = checkpoints   # Optional[CheckpointManager]
@@ -205,6 +208,7 @@ class ContainerLifecycle:
             self.tpu.release(container_id)
             self.memory_limits.pop(container_id, None)
             self._stop_requested.pop(container_id, None)
+            self._synced_volumes.pop(container_id, None)
             state.status = ContainerStatus.FAILED.value
             # an abort requested by the scheduler/user is not a crash —
             # preserve the noted reason so monitors don't count it as one
@@ -310,6 +314,24 @@ class ContainerLifecycle:
                 await asyncio.to_thread(
                     lambda: zipfile.ZipFile(archive).extractall(base))
         for mount in request.mounts:
+            if mount.kind == "disk" and mount.target:
+                if self.disks is None:
+                    raise RuntimeError("worker has no disk manager")
+                disk_dir = await self.disks.attach(
+                    request.workspace_id, mount.source,
+                    request.disk_snapshots.get(mount.source, ""))
+                if self.disk_attached is not None:
+                    await self.disk_attached(request.workspace_id,
+                                             mount.source)
+                link = os.path.realpath(
+                    os.path.join(base, mount.target.lstrip("/")))
+                if not link.startswith(os.path.realpath(base) + os.sep):
+                    raise ValueError(
+                        f"mount path escapes workdir: {mount.target!r}")
+                os.makedirs(os.path.dirname(link), exist_ok=True)
+                if not os.path.lexists(link):
+                    os.symlink(disk_dir, link)
+                continue
             if mount.kind != "volume" or not mount.target:
                 continue
             # worker-side name validation stays on BOTH branches (defense in
@@ -444,6 +466,10 @@ class ContainerLifecycle:
                 host_dir = self._safe_volume_dir(request.workspace_id,
                                                  mount.source)
                 spec_mounts.append((host_dir, mount.target, mount.read_only))
+            elif mount.kind == "disk" and self.disks is not None:
+                spec_mounts.append((self.disks.disk_dir(request.workspace_id,
+                                                        mount.source),
+                                    mount.target, mount.read_only))
             elif mount.kind == "bind":
                 spec_mounts.append((mount.source, mount.target,
                                     mount.read_only))

@@ -55,7 +55,8 @@ class Worker:
                  slice_host_count: int = 1,
                  object_resolver=None, image_resolver=None,
                  volume_sync=None, volume_push=None,
-                 cache=None, checkpoints=None, phase_cb=None) -> None:
+                 cache=None, checkpoints=None, disks=None,
+                 phase_cb=None) -> None:
         self.cfg = cfg or WorkerConfig()
         self.worker_id = worker_id or new_id("worker")
         self.pool = pool
@@ -75,6 +76,9 @@ class Worker:
             volume_sync=volume_sync,
             checkpoints=checkpoints, phase_cb=phase_cb)
         self.lifecycle.volume_push = volume_push
+        self.disks = disks              # Optional[DiskManager]
+        self.lifecycle.disks = disks
+        self.lifecycle.disk_attached = self._note_disk_attached
         self.slice_id = slice_id
         self.slice_topology = slice_topology
         self.slice_host_rank = slice_host_rank
@@ -130,6 +134,7 @@ class Worker:
             asyncio.create_task(self._stop_loop()),
             asyncio.create_task(self._exec_loop()),
             asyncio.create_task(self._shell_loop()),
+            asyncio.create_task(self._disk_loop()),
         ]
         log.info("worker %s started (pool=%s chips=%d)", self.worker_id,
                  self.pool, self.tpu.chip_count)
@@ -329,6 +334,43 @@ class Worker:
             pump_task.cancel()
             await self.store.expire(out_key, 300.0)
             await self.store.expire(in_key, 300.0)
+
+    async def _note_disk_attached(self, workspace_id: str,
+                                  name: str) -> None:
+        """Record this worker as the disk's live location — the scheduler
+        routes future attachments here (durable-disk placement)."""
+        await self.store.set(f"disk:loc:{workspace_id}:{name}",
+                             self.worker_id)
+
+    async def _disk_loop(self) -> None:
+        """Disk snapshot requests over pubsub (gateway → owning worker)."""
+        sub = self.store.subscribe(f"disk:snap:{self.worker_id}")
+        try:
+            while not self._stopping.is_set():
+                msg = await sub.get(timeout=1.0)
+                if msg is None:
+                    continue
+                _, payload = msg
+                if not payload:
+                    continue
+                asyncio.create_task(self._handle_disk_snapshot(payload))
+        finally:
+            sub.close()
+
+    async def _handle_disk_snapshot(self, payload: dict) -> None:
+        if self.disks is None:
+            out = {"error": "worker has no disk manager"}
+        else:
+            try:
+                if payload.get("op") == "delete":
+                    out = {"ok": await self.disks.remove(
+                        payload["workspace_id"], payload["name"])}
+                else:
+                    out = await self.disks.snapshot(payload["workspace_id"],
+                                                    payload["name"])
+            except Exception as exc:    # noqa: BLE001 — reply, don't crash
+                out = {"error": str(exc)}
+        await self.store.publish(payload.get("reply", ""), out)
 
     async def _handle_exec(self, payload: dict) -> None:
         try:
