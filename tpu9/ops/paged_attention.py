@@ -29,9 +29,9 @@ NEG_INF = -1e30
 
 
 def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-            scale: float, block_s: int, num_sb: int):
+            scale: float, block_s: int, num_sb: int, kv_heads: int):
     b = pl.program_id(0)
-    sb = pl.program_id(2)
+    sb = pl.program_id(1)
     seq_len = len_ref[b]
 
     @pl.when(sb == 0)
@@ -42,31 +42,39 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(sb * block_s < seq_len)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale     # [group, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)       # [block_s, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        pos = sb * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < seq_len, s, NEG_INF)
+        # static unroll over kv heads: Mosaic wants 2D dots, and a KH-sized
+        # head block is what makes the k/v BlockSpec tile-legal on TPU (the
+        # last two block dims must equal the array's [KH, D])
+        for h in range(kv_heads):
+            q = q_ref[0, h].astype(jnp.float32) * scale     # [group, D]
+            k = k_ref[0, :, h, :].astype(jnp.float32)       # [block_s, D]
+            v = v_ref[0, :, h, :].astype(jnp.float32)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            pos = sb * block_s + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(pos < seq_len, s, NEG_INF)
 
-        m_prev = m_scr[...]
-        l_prev = l_scr[...]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, :1])
-        p = jnp.where(pos < seq_len, p, 0.0)
-        l_scr[...] = alpha * l_prev + jnp.broadcast_to(
-            jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
-        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        m_scr[...] = m_new
+            m_prev = m_scr[h]
+            l_prev = l_scr[h]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, :1])
+            p = jnp.where(pos < seq_len, p, 0.0)
+            l_scr[h] = alpha * l_prev + jnp.broadcast_to(
+                jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
+            acc_scr[h] = acc_scr[h] * alpha[:, :1] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[h] = m_new
 
     @pl.when(sb == num_sb - 1)
     def _finalize():
-        l = l_scr[...][:, :1]
-        o_ref[0, 0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        for h in range(kv_heads):
+            l = l_scr[h][:, :1]
+            o_ref[0, h] = (acc_scr[h] / jnp.maximum(l, 1e-30)).astype(
+                o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
@@ -87,17 +95,18 @@ def ragged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     # (pure reshape of contiguous [B, 1, QH, D] — no data movement)
     qt = q.reshape(batch, kv_heads, group, head_dim)
 
-    grid = (batch, kv_heads, num_sb)
+    grid = (batch, num_sb)
     kernel = functools.partial(_kernel, scale=head_dim ** -0.5,
-                               block_s=block_s, num_sb=num_sb)
+                               block_s=block_s, num_sb=num_sb,
+                               kv_heads=kv_heads)
 
-    def kv_index(b, h, sb, lens):
+    def kv_index(b, sb, lens):
         # clamp past-the-end steps to the last valid block: same index as the
         # previous step ⇒ Mosaic skips the DMA ⇒ only ceil(len/block_s)
         # blocks of cache are actually read per sequence
         last = jnp.maximum(
             jax.lax.div(lens[b] + block_s - 1, block_s) - 1, 0)
-        return (b, jnp.minimum(sb, last), h, 0)
+        return (b, jnp.minimum(sb, last), 0, 0)
 
     out = pl.pallas_call(
         kernel,
@@ -105,17 +114,17 @@ def ragged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, group, head_dim),
-                             lambda b, h, sb, lens: (b, h, 0, 0)),
-                pl.BlockSpec((1, block_s, 1, head_dim), kv_index),
-                pl.BlockSpec((1, block_s, 1, head_dim), kv_index),
+                pl.BlockSpec((1, kv_heads, group, head_dim),
+                             lambda b, sb, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, block_s, kv_heads, head_dim), kv_index),
+                pl.BlockSpec((1, block_s, kv_heads, head_dim), kv_index),
             ],
-            out_specs=pl.BlockSpec((1, 1, group, head_dim),
-                                   lambda b, h, sb, lens: (b, h, 0, 0)),
+            out_specs=pl.BlockSpec((1, kv_heads, group, head_dim),
+                                   lambda b, sb, lens: (b, 0, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((group, 128), jnp.float32),
-                pltpu.VMEM((group, 128), jnp.float32),
-                pltpu.VMEM((group, head_dim), jnp.float32),
+                pltpu.VMEM((kv_heads, group, 128), jnp.float32),
+                pltpu.VMEM((kv_heads, group, 128), jnp.float32),
+                pltpu.VMEM((kv_heads, group, head_dim), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
