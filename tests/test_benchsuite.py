@@ -1,0 +1,145 @@
+"""Bench-suite tests: suites run end-to-end AND the anti-fooling validators
+actually reject fooled runs (a validator that never fires is decoration)."""
+
+import json
+import os
+
+import pytest
+
+from tpu9.benchsuite.model import Measurement, RunReport, latency_stats
+from tpu9.benchsuite.validators import validate_all
+
+
+# ---------------------------------------------------------------------------
+# validators: positive + negative (anti-fooling must FIRE)
+# ---------------------------------------------------------------------------
+
+class TestValidators:
+    def _m(self, **kw):
+        base = dict(suite="s", scenario="sc", measurement="m")
+        base.update(kw)
+        return Measurement(**base)
+
+    def test_clean_measurement_passes(self):
+        m = self._m(value=10, unit="MB/s",
+                    tags={"requires_sha": True, "min_mbps": 5.0},
+                    evidence={"sha_ok": True})
+        assert validate_all([m]) == []
+
+    def test_missing_sha_proof_fails(self):
+        m = self._m(tags={"requires_sha": True}, evidence={})
+        assert any("SHA" in f for f in validate_all([m]))
+
+    def test_source_read_during_hot_scenario_fails(self):
+        m = self._m(tags={"reject_source_read": True},
+                    evidence={"source_fetches": 3})
+        assert any("source read" in f for f in validate_all([m]))
+
+    def test_no_cache_hit_fails(self):
+        m = self._m(tags={"requires_cache_hit": True},
+                    evidence={"local_hits": 0, "peer_hits": 0})
+        assert any("no cache hit" in f for f in validate_all([m]))
+
+    def test_peer_hit_required(self):
+        m = self._m(tags={"requires_peer_hit": True},
+                    evidence={"local_hits": 5, "peer_hits": 0})
+        assert any("peer" in f for f in validate_all([m]))
+
+    def test_backoff_pollution_fails(self):
+        m = self._m(tags={"reject_backoff": True},
+                    evidence={"backoff_events": 2})
+        assert any("backoff" in f for f in validate_all([m]))
+
+    def test_throughput_floor(self):
+        m = self._m(value=10.0, unit="MB/s", tags={"min_mbps": 100.0},
+                    evidence={})
+        assert any("below" in f for f in validate_all([m]))
+
+    def test_error_rate_ceiling(self):
+        m = self._m(tags={"max_error_rate": 0.01},
+                    evidence={"error_rate": 0.5})
+        assert any("error rate" in f for f in validate_all([m]))
+
+    def test_error_status_fails(self):
+        m = self._m(status="error", error="boom")
+        assert any("boom" in f for f in validate_all([m]))
+
+    def test_served_proof_fails_when_counter_short(self):
+        m = self._m(tags={"requires_served_proof": True},
+                    evidence={"served_ok": False, "served_detail": "x"})
+        assert any("served-count" in f for f in validate_all([m]))
+
+
+def test_latency_stats_nearest_rank():
+    xs = [0.1 * i for i in range(1, 11)]
+    st = latency_stats(xs)
+    assert st["p50_s"] == pytest.approx(0.55)
+    assert st["p95_s"] == pytest.approx(1.0)   # nearest-rank: never optimistic
+    assert st["max_s"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# report plumbing
+# ---------------------------------------------------------------------------
+
+def test_report_writes_artifacts(tmp_path):
+    rep = RunReport(str(tmp_path / "run"), "unit")
+    rep.add(Measurement(suite="unit", scenario="a", measurement="x",
+                        value=1.0, unit="s"))
+    summary = rep.finalize()
+    assert summary["passed"] is True
+    lines = (tmp_path / "run" / "metrics.jsonl").read_text().splitlines()
+    assert json.loads(lines[0])["measurement"] == "x"
+    assert (tmp_path / "run" / "summary.md").exists()
+    assert json.loads((tmp_path / "run" / "summary.json").read_text())[
+        "measurements"] == 1
+
+
+def test_report_fails_on_validation(tmp_path):
+    rep = RunReport(str(tmp_path / "run"), "unit")
+    rep.add(Measurement(suite="unit", scenario="a", measurement="x",
+                        tags={"requires_sha": True}, evidence={}))
+    summary = rep.finalize()
+    assert summary["passed"] is False
+    assert summary["validation_failures"]
+
+
+# ---------------------------------------------------------------------------
+# real suites (quick mode) — these drive the genuine stack/cache
+# ---------------------------------------------------------------------------
+
+async def test_cache_suite_end_to_end(tmp_path):
+    from tpu9.benchsuite.cache_suite import run_cache_suite
+    rep = RunReport(str(tmp_path / "run"), "cache")
+    await run_cache_suite(rep, quick=True)
+    summary = rep.finalize()
+    assert summary["passed"], summary["validation_failures"]
+    by_scenario = {m.scenario: m for m in rep.measurements}
+    # path evidence: hot scenario saw only local hits, peer scenario saw
+    # only peer hits — and neither touched the source
+    assert by_scenario["hot-local"].evidence["local_hits"] > 0
+    assert by_scenario["hot-local"].evidence["source_fetches"] == 0
+    assert by_scenario["peer"].evidence["peer_hits"] > 0
+    assert by_scenario["peer"].evidence["source_fetches"] == 0
+
+
+async def test_load_suite_end_to_end(tmp_path):
+    from tpu9.benchsuite.load_suite import run_load_suite
+    rep = RunReport(str(tmp_path / "run"), "load")
+    await run_load_suite(rep, quick=True)
+    summary = rep.finalize()
+    assert summary["passed"], summary["validation_failures"]
+    rps = [m for m in rep.measurements if m.measurement == "invoke_rps"]
+    assert rps and all(m.evidence["sha_ok"] for m in rps)
+    assert all(m.evidence["served_ok"] for m in rps)
+
+
+async def test_startup_suite_end_to_end(tmp_path):
+    from tpu9.benchsuite.startup_suite import run_startup_suite
+    rep = RunReport(str(tmp_path / "run"), "startup")
+    await run_startup_suite(rep, quick=True)
+    summary = rep.finalize()
+    assert summary["passed"], summary["validation_failures"]
+    m = rep.measurements[0]
+    assert m.evidence["backoff_events"] == 0
+    assert m.value > 0

@@ -1,0 +1,15 @@
+"""Structured benchmark suites with anti-fooling validators.
+
+Reference analogue: ``benchmarks/b9bench`` — suites run through one
+measurement model and emit stable JSONL metrics plus correctness/path
+evidence (``benchmarks/b9bench/README.md:1-55``, ``validators.py:6``).
+tpu9's suites drive the real LocalStack (gateway + scheduler + worker +
+subprocess runners) and the real cache client/server, and every headline
+number carries machine-checkable evidence that the measured path is the
+claimed path (SHA round-trips, cache stats deltas, zero-source-read proofs).
+"""
+
+from .model import Measurement, RunReport
+from .validators import Validator, validate_all
+
+__all__ = ["Measurement", "RunReport", "Validator", "validate_all"]

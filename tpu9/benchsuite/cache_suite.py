@@ -1,0 +1,132 @@
+"""Cache suite: cold/hot/peer read throughput with path-evidence proofs.
+
+Reference analogue: ``benchmarks/b9bench/cache_suite.py`` + the 2000 MB/s
+cache thresholds in BASELINE.md — re-imagined over tpu9's HRW cache
+(`tpu9/cache/client.py:109` local → peer → source fallthrough).
+
+Anti-fooling design: every scenario records the *stats deltas* of the exact
+client/store objects under test. A "hot local read" measurement whose delta
+shows ``source_fetches > 0`` is rejected by the validator
+(``reject_source_read``) — the number cannot quietly come from re-reading
+the source. Content is additionally re-hashed on every read and compared to
+its digest (the cache is content-addressed; a wrong body fails ``sha_ok``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import tempfile
+import time
+
+from ..cache.client import CacheClient
+from ..cache.server import ChunkServer
+from ..cache.store import DiskStore, chunk_hash
+from .model import Measurement, RunReport
+
+
+def _delta(before: dict, after: dict) -> dict:
+    return {k: after[k] - before.get(k, 0) for k in after}
+
+
+async def _timed_reads(client: CacheClient, digests: list[str],
+                       blob_bytes: int) -> tuple[float, bool]:
+    """Read all digests, verifying content addressing; returns (MB/s, sha_ok)."""
+    sha_ok = True
+    t0 = time.perf_counter()
+    for d in digests:
+        data = await client.get(d)
+        if data is None or chunk_hash(data) != d:
+            sha_ok = False
+    wall = time.perf_counter() - t0
+    mbps = (len(digests) * blob_bytes / 1e6) / wall if wall > 0 else 0.0
+    return mbps, sha_ok
+
+
+async def run_cache_suite(report: RunReport, quick: bool = False) -> None:
+    n_blobs = 8 if quick else 32
+    blob_bytes = (1 if quick else 4) * 1024 * 1024
+
+    with tempfile.TemporaryDirectory(prefix="tpu9-bench-cache-") as tmp:
+        store_a = DiskStore(os.path.join(tmp, "a"))
+        store_b = DiskStore(os.path.join(tmp, "b"))
+        server_a = await ChunkServer(store_a).start()
+
+        source_blobs: dict[str, bytes] = {}
+        source_reads = {"n": 0}
+
+        async def source(digest: str):
+            source_reads["n"] += 1
+            return source_blobs.get(digest)
+
+        async def no_peers() -> list[str]:
+            return []
+
+        async def peers_a() -> list[str]:
+            return [server_a.address]
+
+        client_a = CacheClient(store_a, no_peers, source=source)
+        client_b = CacheClient(store_b, peers_a, source=source)
+        try:
+            digests = []
+            for i in range(n_blobs):
+                blob = os.urandom(blob_bytes)
+                d = chunk_hash(blob)
+                source_blobs[d] = blob
+                digests.append(d)
+
+            # -- cold: every read must come from source ----------------------
+            before = dict(client_a.stats)
+            mbps, sha_ok = await _timed_reads(client_a, digests, blob_bytes)
+            delta = _delta(before, client_a.stats)
+            report.add(Measurement(
+                suite=report.suite, scenario="cold", measurement="source_read",
+                value=mbps, unit="MB/s",
+                tags={"requires_sha": True},
+                evidence={"sha_ok": sha_ok, **delta,
+                          "source_reads_observed": source_reads["n"]}))
+
+            # -- hot local: zero source reads allowed ------------------------
+            before = dict(client_a.stats)
+            src_before = source_reads["n"]
+            mbps, sha_ok = await _timed_reads(client_a, digests, blob_bytes)
+            delta = _delta(before, client_a.stats)
+            report.add(Measurement(
+                suite=report.suite, scenario="hot-local",
+                measurement="local_cache_read", value=mbps, unit="MB/s",
+                tags={"requires_sha": True, "requires_cache_hit": True,
+                      "reject_source_read": True, "min_mbps": 100.0},
+                evidence={"sha_ok": sha_ok, **delta,
+                          "source_reads_observed":
+                              source_reads["n"] - src_before}))
+
+            # -- peer: client B's store is empty; reads must ride the TCP
+            #    peer path to A, never the source -------------------------
+            before = dict(client_b.stats)
+            src_before = source_reads["n"]
+            mbps, sha_ok = await _timed_reads(client_b, digests, blob_bytes)
+            delta = _delta(before, client_b.stats)
+            report.add(Measurement(
+                suite=report.suite, scenario="peer",
+                measurement="remote_cache_socket_read", value=mbps,
+                unit="MB/s",
+                tags={"requires_sha": True, "requires_peer_hit": True,
+                      "reject_source_read": True, "min_mbps": 50.0},
+                evidence={"sha_ok": sha_ok, **delta,
+                          "source_reads_observed":
+                              source_reads["n"] - src_before}))
+
+            # -- hot peer-populated local: B re-reads from its own disk ------
+            before = dict(client_b.stats)
+            mbps, sha_ok = await _timed_reads(client_b, digests, blob_bytes)
+            delta = _delta(before, client_b.stats)
+            report.add(Measurement(
+                suite=report.suite, scenario="hot-after-peer",
+                measurement="local_cache_read", value=mbps, unit="MB/s",
+                tags={"requires_sha": True, "requires_cache_hit": True,
+                      "reject_source_read": True, "min_mbps": 100.0},
+                evidence={"sha_ok": sha_ok, **delta}))
+        finally:
+            await client_a.close()
+            await client_b.close()
+            await server_a.stop()

@@ -1,0 +1,129 @@
+"""Load suite: concurrent invoke ramp against a real deployed endpoint.
+
+Reference analogue: ``e2e/load_tests/throughput.js:12-21`` (k6 ramp stages)
+and ``benchmarks/b9bench`` sandbox suites — re-imagined over the tpu9
+LocalStack so the measured path is the production path (gateway auth →
+request buffer → concurrency tokens → subprocess runner → user handler).
+
+Anti-fooling design:
+- every request carries a fresh nonce; the container's handler returns
+  ``sha256(nonce)`` computed *inside user code* — a proxy shortcut, cached
+  response, or mocked container cannot produce it (``sha_ok`` evidence);
+- the handler keeps a per-process monotonic served counter; after each stage
+  the suite sums the counters across serving pids and requires
+  ``served >= client-observed successes`` (``served_ok`` evidence) — numbers
+  cannot come from responses the containers never actually handled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import time
+import uuid
+
+from .model import Measurement, RunReport, latency_stats
+
+PROOF_HANDLER = """
+import hashlib, itertools, os
+_served = itertools.count(1)
+
+def handler(**kwargs):
+    nonce = kwargs.get("nonce", "")
+    return {
+        "proof": hashlib.sha256(nonce.encode()).hexdigest(),
+        "pid": os.getpid(),
+        "served": next(_served),
+    }
+"""
+
+
+async def _one_request(stack, deploy, results: list) -> None:
+    nonce = uuid.uuid4().hex
+    want = hashlib.sha256(nonce.encode()).hexdigest()
+    t0 = time.perf_counter()
+    try:
+        resp = await stack.invoke(deploy, {"nonce": nonce}, timeout=60.0)
+        elapsed = time.perf_counter() - t0
+        results.append({
+            "ok": True, "latency_s": elapsed,
+            "sha_ok": resp.get("proof") == want,
+            "pid": resp.get("pid"), "served": resp.get("served", 0),
+        })
+    except Exception as exc:   # noqa: BLE001 — failures are data here
+        results.append({"ok": False, "latency_s": time.perf_counter() - t0,
+                        "sha_ok": False, "error": str(exc)})
+
+
+async def _run_stage(stack, deploy, concurrency: int,
+                     total_requests: int) -> dict:
+    results: list[dict] = []
+    sem = asyncio.Semaphore(concurrency)
+
+    async def bounded() -> None:
+        async with sem:
+            await _one_request(stack, deploy, results)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[bounded() for _ in range(total_requests)])
+    wall = time.perf_counter() - t0
+
+    oks = [r for r in results if r["ok"]]
+    # container-side proof: per-pid max 'served' must cover every response
+    # that pid produced (the counter is monotonic per handler process)
+    per_pid_seen: dict[int, int] = {}
+    per_pid_max: dict[int, int] = {}
+    for r in oks:
+        pid = r.get("pid")
+        if pid is not None:
+            per_pid_seen[pid] = per_pid_seen.get(pid, 0) + 1
+            per_pid_max[pid] = max(per_pid_max.get(pid, 0),
+                                   r.get("served", 0))
+    served_ok = bool(oks) and all(per_pid_max.get(p, 0) >= n
+                                  for p, n in per_pid_seen.items())
+    return {
+        "wall_s": wall,
+        "rps": len(oks) / wall if wall > 0 else 0.0,
+        "error_rate": 1.0 - len(oks) / max(len(results), 1),
+        "sha_ok": bool(oks) and all(r["sha_ok"] for r in oks),
+        "served_ok": served_ok,
+        "served_detail": f"pids={len(per_pid_seen)} "
+                         f"seen={sum(per_pid_seen.values())}",
+        "latencies": [r["latency_s"] for r in oks],
+        "pids": sorted(per_pid_seen),
+    }
+
+
+async def run_load_suite(report: RunReport, quick: bool = False) -> None:
+    from ..testing.localstack import LocalStack
+
+    stages = [(1, 8), (4, 16)] if quick else [(1, 20), (4, 40), (16, 80)]
+    async with LocalStack() as stack:
+        deploy = await stack.deploy_endpoint(
+            "bench-load", {"app.py": PROOF_HANDLER}, "app:handler",
+            config_extra={"concurrent_requests": 8,
+                          "keep_warm_seconds": 60.0,
+                          "autoscaler": {"max_containers": 3}})
+        # warm one container so stage 1 measures serving, not cold start
+        await stack.invoke(deploy, {"nonce": "warmup"})
+
+        for concurrency, n in stages:
+            stage = await _run_stage(stack, deploy, concurrency, n)
+            stats = latency_stats(stage["latencies"])
+            report.add(Measurement(
+                suite=report.suite, scenario=f"ramp-c{concurrency}",
+                measurement="invoke_rps", value=stage["rps"], unit="req/s",
+                tags={"requires_sha": True, "requires_served_proof": True,
+                      "max_error_rate": 0.01},
+                evidence={"sha_ok": stage["sha_ok"],
+                          "served_ok": stage["served_ok"],
+                          "served_detail": stage["served_detail"],
+                          "error_rate": stage["error_rate"],
+                          "containers": len(stage["pids"]),
+                          **stats}))
+            report.add(Measurement(
+                suite=report.suite, scenario=f"ramp-c{concurrency}",
+                measurement="invoke_latency_p95", unit="s",
+                value=stats.get("p95_s", 0.0),
+                tags={}, evidence=stats))

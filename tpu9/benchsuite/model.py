@@ -1,0 +1,130 @@
+"""Measurement model + run reports for the bench suites.
+
+Reference analogue: ``benchmarks/b9bench/model.py`` / ``reports.py`` — one
+metric per JSONL line with suite/scenario/measurement identity, tags that
+declare what must be proven, and evidence that proves it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class Measurement:
+    suite: str
+    scenario: str
+    measurement: str
+    value: float = 0.0
+    unit: str = ""
+    status: str = "ok"                 # ok | error | skipped
+    error: str = ""
+    # tags declare the proof obligations validators enforce
+    # (requires_sha, reject_source_read, requires_cache_hit, requires_peer_hit,
+    #  min_mbps, max_error_rate, max_p95_s, reject_backoff, requires_served_proof)
+    tags: dict[str, Any] = field(default_factory=dict)
+    # evidence carries what the probe actually observed
+    evidence: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def mbps(self) -> float:
+        return self.value if self.unit == "MB/s" else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "suite": self.suite, "scenario": self.scenario,
+            "measurement": self.measurement, "value": round(self.value, 4),
+            "unit": self.unit, "status": self.status, "error": self.error,
+            "tags": self.tags, "evidence": self.evidence,
+        }
+
+
+def latency_stats(samples_s: list[float]) -> dict[str, float]:
+    """p50/p95/p99/max over latency samples; p95/p99 are nearest-rank
+    (never an optimistic lower percentile for small n)."""
+    if not samples_s:
+        return {}
+    xs = sorted(samples_s)
+
+    def rank(p: int) -> float:
+        return xs[max(0, -(-p * len(xs) // 100) - 1)]
+
+    return {
+        "p50_s": round(statistics.median(xs), 4),
+        "p95_s": round(rank(95), 4),
+        "p99_s": round(rank(99), 4),
+        "min_s": round(xs[0], 4),
+        "max_s": round(xs[-1], 4),
+        "n": len(xs),
+    }
+
+
+class RunReport:
+    """Collects measurements, validates, and writes
+    ``metrics.jsonl`` + ``summary.json`` + ``summary.md`` into a run dir."""
+
+    def __init__(self, out_dir: str, suite: str):
+        self.suite = suite
+        self.out_dir = out_dir
+        self.measurements: list[Measurement] = []
+        self.started_at = time.time()
+        os.makedirs(out_dir, exist_ok=True)
+
+    def add(self, m: Measurement) -> Measurement:
+        self.measurements.append(m)
+        return m
+
+    def error(self, scenario: str, measurement: str, exc: Exception) -> None:
+        self.add(Measurement(suite=self.suite, scenario=scenario,
+                             measurement=measurement, status="error",
+                             error=f"{type(exc).__name__}: {exc}"))
+
+    def finalize(self) -> dict:
+        from .validators import validate_all
+        failures = validate_all(self.measurements)
+        summary = {
+            "suite": self.suite,
+            "started_at": self.started_at,
+            "duration_s": round(time.time() - self.started_at, 2),
+            "measurements": len(self.measurements),
+            "errors": sum(1 for m in self.measurements
+                          if m.status == "error"),
+            "validation_failures": failures,
+            "passed": not failures and all(m.status != "error"
+                                           for m in self.measurements),
+        }
+        with open(os.path.join(self.out_dir, "metrics.jsonl"), "w") as f:
+            for m in self.measurements:
+                f.write(json.dumps(m.to_dict()) + "\n")
+        with open(os.path.join(self.out_dir, "summary.json"), "w") as f:
+            json.dump({**summary,
+                       "metrics": [m.to_dict() for m in self.measurements]},
+                      f, indent=2)
+        with open(os.path.join(self.out_dir, "summary.md"), "w") as f:
+            f.write(self._markdown(summary))
+        return summary
+
+    def _markdown(self, summary: dict) -> str:
+        lines = [f"# bench-suite: {self.suite}", "",
+                 f"- duration: {summary['duration_s']} s",
+                 f"- passed: **{summary['passed']}**", "",
+                 "| scenario | measurement | value | unit | status |",
+                 "|---|---|---|---|---|"]
+        for m in self.measurements:
+            lines.append(f"| {m.scenario} | {m.measurement} | "
+                         f"{round(m.value, 4)} | {m.unit} | {m.status} |")
+        if summary["validation_failures"]:
+            lines += ["", "## Validation failures", ""]
+            lines += [f"- {x}" for x in summary["validation_failures"]]
+        return "\n".join(lines) + "\n"
+
+
+def default_run_dir(suite: str, root: Optional[str] = None) -> str:
+    root = root or os.path.join(os.getcwd(), "benchruns")
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    return os.path.join(root, f"{stamp}-{suite}")

@@ -1,0 +1,40 @@
+"""Suite runner: executes suites, writes run dirs, returns summaries.
+
+Reference analogue: ``benchmarks/b9bench/runner.py`` / ``cli.py`` — one
+entrypoint per suite plus ``full``; every run leaves
+``metrics.jsonl + summary.json + summary.md`` in its run dir.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from .cache_suite import run_cache_suite
+from .load_suite import run_load_suite
+from .model import RunReport, default_run_dir
+from .startup_suite import run_startup_suite
+
+SUITES = {
+    "load": run_load_suite,
+    "cache": run_cache_suite,
+    "startup": run_startup_suite,
+}
+
+
+async def run_suite_async(name: str, out_dir: Optional[str] = None,
+                          quick: bool = False) -> dict:
+    names = list(SUITES) if name == "full" else [name]
+    out_dir = out_dir or default_run_dir(name)
+    report = RunReport(out_dir, name)
+    for n in names:
+        try:
+            await SUITES[n](report, quick=quick)
+        except Exception as exc:   # noqa: BLE001 — suite crash is a result
+            report.error(n, "suite", exc)
+    return report.finalize()
+
+
+def run_suite(name: str, out_dir: Optional[str] = None,
+              quick: bool = False) -> dict:
+    return asyncio.run(run_suite_async(name, out_dir=out_dir, quick=quick))

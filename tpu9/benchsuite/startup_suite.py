@@ -1,0 +1,42 @@
+"""Startup suite: cold-start distribution with backoff-pollution rejection.
+
+Reference analogue: ``benchmarks/sandbox_startup_report.py:161`` (per-phase
+startup breakdown) — tpu9 measures deploy→first-response through the real
+local stack and *rejects the run* if the serving instance recorded any
+circuit-breaker backoff events during the trials (the round-1 failure mode:
+a crash loop inflated max to 30.9 s while the median looked healthy).
+"""
+
+from __future__ import annotations
+
+import time
+
+from .model import Measurement, RunReport, latency_stats
+
+
+async def run_startup_suite(report: RunReport, quick: bool = False) -> None:
+    from ..testing.localstack import LocalStack
+
+    trials = 3 if quick else 12
+    times: list[float] = []
+    backoffs = 0
+    async with LocalStack() as stack:
+        deploy = await stack.deploy_echo_endpoint("bench-startup")
+        await stack.invoke(deploy, {"warm": 1})
+        for _ in range(trials):
+            await stack.scale_to_zero(deploy)
+            t0 = time.perf_counter()
+            resp = await stack.invoke(deploy, {"ping": 1})
+            assert resp is not None
+            times.append(time.perf_counter() - t0)
+        inst = stack.gateway.endpoints.instances.get(deploy["stub_id"])
+        if inst is not None:
+            backoffs = getattr(inst.instance, "backoff_events", 0)
+
+    stats = latency_stats(times)
+    report.add(Measurement(
+        suite=report.suite, scenario="cold-start",
+        measurement="deploy_to_first_response_p50",
+        value=stats["p50_s"], unit="s",
+        tags={"reject_backoff": True, "max_p95_s": 5.0},
+        evidence={"backoff_events": backoffs, **stats}))

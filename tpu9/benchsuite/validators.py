@@ -1,0 +1,66 @@
+"""Anti-fooling validators: a metric without its proof is a failure.
+
+Reference analogue: ``benchmarks/b9bench/validators.py:6-60`` — the idea
+(not the code) that every measurement's tags declare proof obligations and
+validators fail the run when the evidence doesn't back the number:
+a "cache hit" benchmark that silently read from source, a load test whose
+responses were never computed by the container, a cold start that rode a
+circuit-breaker backoff — all get rejected, not averaged in.
+"""
+
+from __future__ import annotations
+
+from .model import Measurement
+
+
+class Validator:
+    def validate(self, ms: list[Measurement]) -> list[str]:
+        out: list[str] = []
+        for m in ms:
+            out.extend(self._one(m))
+        return out
+
+    def _one(self, m: Measurement) -> list[str]:
+        ident = f"{m.suite}/{m.scenario}/{m.measurement}"
+        fails: list[str] = []
+        if m.status == "error":
+            fails.append(f"{ident}: error ({m.error})")
+            return fails
+        if m.status == "skipped":
+            return fails
+        t, ev = m.tags, m.evidence
+
+        if t.get("requires_sha") and ev.get("sha_ok") is not True:
+            fails.append(f"{ident}: missing SHA round-trip proof")
+        if t.get("requires_served_proof") and ev.get("served_ok") is not True:
+            fails.append(f"{ident}: container-side served-count proof missing"
+                         f" ({ev.get('served_detail', 'no detail')})")
+        if t.get("requires_cache_hit") and not (
+                ev.get("local_hits", 0) > 0 or ev.get("peer_hits", 0) > 0):
+            fails.append(f"{ident}: no cache hit observed")
+        if t.get("requires_peer_hit") and ev.get("peer_hits", 0) <= 0:
+            fails.append(f"{ident}: no peer cache hit observed")
+        if t.get("reject_source_read") and ev.get("source_fetches", 0) > 0:
+            fails.append(f"{ident}: {ev['source_fetches']} source read(s) "
+                         f"during a hot-cache scenario")
+        if t.get("reject_backoff") and ev.get("backoff_events", 0) > 0:
+            fails.append(f"{ident}: {ev['backoff_events']} circuit-breaker "
+                         f"backoff event(s) polluted the run")
+
+        min_mbps = t.get("min_mbps")
+        if min_mbps is not None and m.mbps < float(min_mbps):
+            fails.append(f"{ident}: {m.mbps:.2f} MB/s below "
+                         f"{float(min_mbps):.2f} MB/s floor")
+        max_err = t.get("max_error_rate")
+        if max_err is not None and ev.get("error_rate", 0.0) > float(max_err):
+            fails.append(f"{ident}: error rate {ev.get('error_rate'):.4f} "
+                         f"above {float(max_err):.4f}")
+        max_p95 = t.get("max_p95_s")
+        if max_p95 is not None and ev.get("p95_s", 0.0) > float(max_p95):
+            fails.append(f"{ident}: p95 {ev.get('p95_s'):.3f}s above "
+                         f"{float(max_p95):.3f}s SLO")
+        return fails
+
+
+def validate_all(ms: list[Measurement]) -> list[str]:
+    return Validator().validate(ms)
