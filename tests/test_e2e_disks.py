@@ -59,9 +59,10 @@ async def test_disk_write_snapshot_restore_on_fresh_worker():
             await w.stop()
         for w in stack.workers:
             await stack.gateway.workers.deregister(w.worker_id)
-        # clear the live-location pointer the stopped worker left behind
+        # the stopped worker releases its live-location pointer itself
+        # (and the pointer carries a TTL as the crash backstop)
         ws = stack.gateway.default_workspace.workspace_id
-        await stack.store.delete(f"disk:loc:{ws}:scratch")
+        assert await stack.store.get(f"disk:loc:{ws}:scratch") is None
         stack.workers.clear()
 
         # a NEW pod on a NEW worker restores the snapshot at attach
@@ -92,3 +93,50 @@ async def test_disk_placement_affinity():
         out = await _exec(stack, pod2, ["/bin/sh", "-c",
                                         "cat disk/f"])
         assert "x" in out["output"]
+
+
+async def test_deleted_disk_never_resurrects_from_stale_dir():
+    """Delete → recreate mints a fresh disk incarnation (disk_id): even if a
+    stale dir survived on some worker (unreachable at delete time), the new
+    disk starts empty — resurrection is structurally impossible."""
+    async with LocalStack() as stack:
+        pod1 = await _make_disk_pod(stack, "resbox")
+        await _exec(stack, pod1, [
+            "/bin/sh", "-c", "echo secret > disk/leak.txt"])
+        # simulate an unreachable holder: drop the live-location pointer so
+        # delete cannot route the dir-clear message to the worker
+        ws = stack.gateway.default_workspace.workspace_id
+        await stack.store.delete(f"disk:loc:{ws}:scratch")
+        status, _ = await stack.api("DELETE", "/api/v1/disk/scratch")
+        assert status == 200
+        # recreate: same name, new incarnation — the stale dir is still on
+        # the worker's filesystem but must NOT be re-attached
+        pod2 = await _make_disk_pod(stack, "resbox2")
+        out = await _exec(stack, pod2, [
+            "/bin/sh", "-c", "ls disk/ | wc -l"])
+        assert out["exit_code"] == 0, out
+        assert out["output"].strip().splitlines()[-1].strip() == "0", out
+
+
+async def test_failed_restore_fails_container_start():
+    """A disk whose snapshot cannot be restored must fail the attach (and
+    the container start) — not run on a silently-empty disk whose next
+    snapshot would clobber the only good one."""
+    import os
+    from tpu9.worker.disks import DiskManager, DiskRestoreError
+
+    async def bad_manifest_get(snapshot_id):
+        return '{"not-a-manifest": true'      # corrupt
+
+    async def chunk_get(digest):
+        return None
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        mgr = DiskManager(tmp, manifest_get=bad_manifest_get,
+                          chunk_get=chunk_get)
+        with pytest.raises(DiskRestoreError):
+            await mgr.attach("ws1", "d1", snapshot_id="dsnap-x",
+                             disk_id="disk-1")
+        # nothing half-restored left behind
+        assert not os.path.exists(mgr.disk_dir("ws1", "d1", "disk-1"))

@@ -130,3 +130,27 @@ async def test_server_auth():
         await bad.set("a", 2)
     await bad.close()
     await server.stop()
+
+
+async def test_cas_atomic_ownership():
+    """cas writes only when the current value matches (None = set-if-absent)
+    — the primitive disk live-location refresh relies on to never steal an
+    ownership handoff."""
+    s = MemoryStore()
+    assert await s.cas("own", None, "worker-a", ttl=60)       # claim
+    assert await s.get("own") == "worker-a"
+    assert await s.cas("own", "worker-a", "worker-a", ttl=60)  # refresh
+    assert not await s.cas("own", "worker-x", "worker-x")      # steal fails
+    assert await s.get("own") == "worker-a"
+    assert await s.cas("own", "worker-a", "worker-b")          # handoff
+    assert await s.get("own") == "worker-b"
+    # and over TCP
+    server = await StateServer(port=0).start()
+    r = RemoteStore(server.address)
+    await r.connect()
+    assert await r.cas("k", None, "v1", ttl=30)
+    assert not await r.cas("k", "nope", "v2")
+    assert await r.cas("k", "v1", "v2")
+    assert await r.get("k") == "v2"
+    await r.close()
+    await server.stop()

@@ -245,3 +245,44 @@ class TestTokenClamp:
         assert await repo.in_flight("s", "c") == 0
         assert await repo.acquire_request_token("s", "c", limit=1)
         assert not await repo.acquire_request_token("s", "c", limit=1)
+
+
+class TestCrossTenantSandbox:
+    async def test_foreign_sandbox_ops_404(self):
+        """Sandbox proc/fs/snapshot surfaces are workspace-gated, and a
+        foreign workspace cannot restore another tenant's snapshot."""
+        from tests.test_e2e_sandbox import make_sandbox
+
+        async with LocalStack() as stack:
+            cid = await make_sandbox(stack)
+            status, snap = await stack.api("POST",
+                                           f"/rpc/pod/{cid}/snapshot")
+            assert status == 200 and snap.get("snapshot_id")
+
+            _, intruder = await _second_workspace(stack)
+            try:
+                for method, tail, body in (
+                        ("POST", "/proc", {"cmd": ["true"]}),
+                        ("GET", "/proc", None),
+                        ("POST", "/fs", {"op": "ls", "path": "."}),
+                        ("POST", "/snapshot", None)):
+                    status, _ = await _req(
+                        intruder, method,
+                        f"{stack.base_url}/rpc/pod/{cid}{tail}",
+                        json=body)
+                    assert status == 404, (method, tail, status)
+
+                # foreign snapshot restore 404s at create
+                status, out = await _req(
+                    intruder, "POST", f"{stack.base_url}/rpc/stub/get-or-create",
+                    json={"name": "sbx-x", "stub_type": "sandbox",
+                          "config": {"runtime": {"cpu_millicores": 100,
+                                                 "memory_mb": 128}}})
+                assert status == 200
+                status, _ = await _req(
+                    intruder, "POST", f"{stack.base_url}/rpc/pod/create",
+                    json={"stub_id": out["stub_id"], "wait": False,
+                          "from_snapshot": snap["snapshot_id"]})
+                assert status == 404
+            finally:
+                await intruder.close()

@@ -18,6 +18,7 @@ from ...repository import ContainerRepository
 from ...scheduler import Scheduler
 from ...types import (ContainerRequest, ContainerStatus, Mount, Stub,
                       StopReason, StubConfig, new_id)
+from ...utils.paths import validate_path_part
 from .autoscaler import Autoscaler, AutoscaleResult, AutoscaleSample
 
 log = logging.getLogger("tpu9.abstractions")
@@ -34,8 +35,7 @@ def volume_mounts(cfg: StubConfig) -> list[Mount]:
         for v in entries:
             name = v.get("name", "")
             target = v.get("mount_path", "")
-            if not name or "/" in name or "\\" in name or name in (".", ".."):
-                raise ValueError(f"invalid {kind} name {name!r}")
+            validate_path_part(name, f"{kind} name")
             if ".." in target.split("/"):
                 raise ValueError(f"invalid mount path {target!r}")
             out.append(Mount(source=name, target=target, kind=kind))

@@ -45,8 +45,9 @@ class DiskService:
             name = d.get("name", "")
             if not name:
                 continue
-            await self.ensure(request.workspace_id, name)
-            snap = await self.latest_snapshot(request.workspace_id, name)
+            row = await self.ensure(request.workspace_id, name)
+            request.disk_ids[name] = row.get("disk_id", "")
+            snap = row.get("snapshot_id") or ""
             if snap:
                 request.disk_snapshots[name] = snap
             loc = await self.location(request.workspace_id, name)
@@ -66,7 +67,8 @@ class DiskService:
         sub = self.store.subscribe(reply)
         try:
             n = await self.store.publish(f"disk:snap:{worker_id}", {
-                "workspace_id": workspace_id, "name": name, "reply": reply})
+                "workspace_id": workspace_id, "name": name,
+                "disk_id": row.get("disk_id", ""), "reply": reply})
             if not n:
                 return {"error": f"worker {worker_id} unreachable"}
             msg = await sub.get(timeout=timeout)

@@ -41,9 +41,11 @@ class PodService:
         self.store = store
         self.runner_env = runner_env if runner_env is not None else {}
 
-    async def create(self, stub: Stub, name: str = "") -> dict:
+    async def create(self, stub: Stub, name: str = "",
+                     from_snapshot: str = "") -> dict:
         """Run one pod container; returns its id (address resolves once
-        RUNNING)."""
+        RUNNING). ``from_snapshot`` seeds the workdir from a sandbox
+        snapshot (sandbox.py:916-equivalent restore)."""
         cfg = stub.config
         from .common.secrets import stub_secret_env
         # secrets lowest precedence — stub env must win name clashes
@@ -71,6 +73,7 @@ class PodService:
             env=env,
             ports=list(cfg.ports),
             mounts=volume_mounts(cfg),
+            workdir_snapshot_id=from_snapshot,
         )
         if cfg.disks and getattr(self, "disks", None) is not None:
             # latest snapshot + live-holder affinity (durable_disk placement)
@@ -119,3 +122,47 @@ class PodService:
             return msg[1]
         finally:
             sub.close()
+
+    # -- sandbox agent ops (process mgr / fs / snapshots) --------------------
+
+    async def sbx(self, container_id: str, payload: dict,
+                  timeout: float = 60.0) -> dict:
+        """Round-trip a sandbox-agent op to the owning worker
+        (container_server.go:169's worker gRPC, redesigned over the bus)."""
+        container_id = await self.containers.resolve(container_id)
+        state = await self.containers.get_state(container_id)
+        if state is None or not state.worker_id:
+            return {"error": "container not found"}
+        reply_channel = f"sbxreply:{new_id('x')}"
+        sub = self.store.subscribe(reply_channel)
+        try:
+            payload = dict(payload, container_id=container_id,
+                           reply=reply_channel)
+            n = await self.store.publish(
+                f"container:sbx:{state.worker_id}", payload)
+            if not n:
+                return {"error": f"worker {state.worker_id} unreachable"}
+            msg = await sub.get(timeout=timeout)
+            if msg is None:
+                return {"error": "sandbox op timed out"}
+            return msg[1]
+        finally:
+            sub.close()
+
+    async def proc_output(self, proc_id: str, last_id: str = "0",
+                          timeout: float = 0) -> dict:
+        """Read a spawned process's output stream directly from the state
+        bus — no worker round-trip per poll."""
+        import base64
+        entries = await self.store.xread(f"sbx:out:{proc_id}",
+                                         last_id=last_id, timeout=timeout)
+        chunks, exit_code, new_last = [], None, last_id
+        for entry_id, fields in entries:
+            new_last = entry_id
+            if "data" in fields:
+                chunks.append(fields["data"])
+            if "exit" in fields:
+                exit_code = int(fields["exit"])
+        data = b"".join(base64.b64decode(c) for c in chunks)
+        return {"data": base64.b64encode(data).decode(),
+                "last_id": new_last, "exit_code": exit_code}

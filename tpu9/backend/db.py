@@ -454,6 +454,27 @@ class BackendDB:
             (stub_id,))
         return dict(rows[0]) if rows else None
 
+    # -- sandbox snapshots ---------------------------------------------------
+
+    async def put_sandbox_snapshot(self, snapshot_id: str, workspace_id: str,
+                                   container_id: str, manifest: str,
+                                   size: int) -> None:
+        self._exec(
+            "INSERT INTO sandbox_snapshots (snapshot_id, workspace_id, container_id, manifest, size, created_at) VALUES (?,?,?,?,?,?)",
+            (snapshot_id, workspace_id, container_id, manifest, size, now()))
+
+    async def get_sandbox_snapshot(self, snapshot_id: str) -> Optional[dict]:
+        rows = self._query(
+            "SELECT * FROM sandbox_snapshots WHERE snapshot_id=?",
+            (snapshot_id,))
+        return dict(rows[0]) if rows else None
+
+    async def list_sandbox_snapshots(self, workspace_id: str) -> list[dict]:
+        rows = self._query(
+            "SELECT snapshot_id, container_id, size, created_at FROM sandbox_snapshots WHERE workspace_id=? ORDER BY created_at DESC",
+            (workspace_id,))
+        return [dict(r) for r in rows]
+
     # -- volumes ------------------------------------------------------------
 
     async def get_or_create_volume(self, workspace_id: str, name: str) -> dict:

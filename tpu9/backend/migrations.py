@@ -178,4 +178,14 @@ MIGRATIONS: list[tuple[int, str, str]] = [
             UNIQUE(workspace_id, name)
         );
     """),
+    (16, "sandbox_snapshots", """
+        CREATE TABLE sandbox_snapshots (
+            snapshot_id TEXT PRIMARY KEY,
+            workspace_id TEXT NOT NULL,
+            container_id TEXT DEFAULT '',
+            manifest TEXT NOT NULL,
+            size INTEGER DEFAULT 0,
+            created_at REAL NOT NULL
+        );
+    """),
 ]

@@ -480,6 +480,25 @@ def startup_report() -> None:
                    f"{r['p95']*1000:>9.1f}ms{r['max']*1000:>9.1f}ms")
 
 
+@cli.command("bench-suite")
+@click.argument("suite", type=click.Choice(["load", "cache", "startup",
+                                            "full"]))
+@click.option("--out-dir", default="", help="run directory (default "
+              "benchruns/<timestamp>-<suite>)")
+@click.option("--quick", is_flag=True, help="small stages for smoke runs")
+def bench_suite(suite: str, out_dir: str, quick: bool) -> None:
+    """Structured load/cache/startup benchmarks with anti-fooling validators
+    (reference benchmarks/b9bench): every headline number carries
+    machine-checked SHA/cache-path/backoff evidence; a metric whose proof is
+    missing FAILS the run. Writes metrics.jsonl + summary.json + summary.md."""
+    from ..benchsuite.runner import run_suite
+    summary = run_suite(suite, out_dir=out_dir or None, quick=quick)
+    click.echo(json.dumps({k: v for k, v in summary.items()
+                           if k != "metrics"}, indent=2))
+    if not summary["passed"]:
+        raise SystemExit(1)
+
+
 @cli.command("metrics")
 @click.option("--prometheus", is_flag=True)
 def metrics_cmd(prometheus: bool) -> None:
@@ -605,10 +624,9 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
             def _vol_dest(workspace_id: str, name: str) -> str:
                 # single-component names only — mirrors the lifecycle's
                 # validation so a crafted name can't traverse volumes_dir
+                from ..utils.paths import validate_path_part
                 for part in (workspace_id, name):
-                    if (not part or "/" in part or "\\" in part
-                            or part in (".", "..")):
-                        raise ValueError(f"invalid volume path part {part!r}")
+                    validate_path_part(part, "volume path part")
                 return os.path.join(volumes_dir, workspace_id, name)
 
             async def volume_sync(workspace_id: str, name: str) -> str:
@@ -674,6 +692,7 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                             f"{base}/{quote(rel, safe='/')}", data=data)
 
         disks = None
+        sandboxes = None
         if gateway_url and worker_token:
             from ..worker.disks import DiskManager
 
@@ -713,7 +732,37 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                                 manifest_put=disk_manifest_put,
                                 manifest_get=disk_manifest_get)
 
+            from ..worker.sandbox import SandboxAgent
+
+            async def sbxsnap_put(snapshot_id, workspace_id, container_id,
+                                  manifest_json, size) -> None:
+                async with session.post(
+                        f"{gateway_url}/rpc/internal/sbxsnap/{workspace_id}/"
+                        f"{container_id}/{snapshot_id}",
+                        data=manifest_json) as resp:
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"sandbox snapshot upload failed: {resp.status}")
+
+            async def sbxsnap_get(snapshot_id: str):
+                async with session.get(
+                        f"{gateway_url}/rpc/internal/sbxsnap/manifest/"
+                        f"{snapshot_id}") as resp:
+                    return (await resp.text() if resp.status == 200
+                            else None)
+
+            sandboxes = SandboxAgent(runtime, store,
+                                     chunk_put=disk_chunk_put,
+                                     chunk_get=disk_chunk_get,
+                                     snap_put=sbxsnap_put,
+                                     snap_get=sbxsnap_get)
+
         from ..types import new_id
+        if sandboxes is None:
+            # no gateway sink: process manager + fs API still work,
+            # snapshots report "no snapshot sink"
+            from ..worker.sandbox import SandboxAgent
+            sandboxes = SandboxAgent(runtime, store)
         cache = WorkerCache(cfg.cache, new_id("wc"), WorkerRepository(store),
                             source=chunk_source,
                             manifest_fetch=manifest_fetch)
@@ -722,7 +771,7 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                    slice_host_rank=slice_rank, slice_host_count=slice_hosts,
                    cache=cache, object_resolver=object_resolver,
                    volume_sync=volume_sync, volume_push=volume_push,
-                   disks=disks)
+                   disks=disks, sandboxes=sandboxes)
         await w.start()
         click.echo(f"worker {w.worker_id} joined (pool={pool}, "
                    f"chips={w.tpu.chip_count})")

@@ -120,6 +120,9 @@ class Gateway:
         self.extra_services: dict[str, object] = {}
         self.state_server: Optional[StateServer] = None
         self._proxy_session = None     # shared pod-proxy ClientSession
+        # verified (proc_id → container_id) pairings for sandbox output
+        # polls: one worker round-trip per proc, then bus reads only
+        self._sbx_proc_owner: dict[str, str] = {}
         self._runner: Optional[web.AppRunner] = None
         self.port = cfg.gateway.http_port
         self.app = self._build_app()
@@ -154,6 +157,22 @@ class Gateway:
         r.add_post("/rpc/pod/create", self._rpc_pod_create)
         r.add_get("/rpc/pod/{container_id}/status", self._rpc_pod_status)
         r.add_post("/rpc/pod/{container_id}/exec", self._rpc_pod_exec)
+        # sandbox depth: process manager / fs API / snapshots
+        # (reference sdk sandbox.py:137,376,916)
+        r.add_post("/rpc/pod/{container_id}/proc", self._rpc_sbx_spawn)
+        r.add_get("/rpc/pod/{container_id}/proc", self._rpc_sbx_ps)
+        r.add_get("/rpc/pod/{container_id}/proc/{proc_id}",
+                  self._rpc_sbx_status)
+        r.add_post("/rpc/pod/{container_id}/proc/{proc_id}/stdin",
+                   self._rpc_sbx_stdin)
+        r.add_post("/rpc/pod/{container_id}/proc/{proc_id}/kill",
+                   self._rpc_sbx_kill)
+        r.add_get("/rpc/pod/{container_id}/proc/{proc_id}/out",
+                  self._rpc_sbx_out)
+        r.add_post("/rpc/pod/{container_id}/fs", self._rpc_sbx_fs)
+        r.add_post("/rpc/pod/{container_id}/snapshot",
+                   self._rpc_sbx_snapshot)
+        r.add_get("/rpc/pod/snapshots", self._rpc_sbx_snapshots)
         r.add_route("*", "/pod/{container_id}/{tail:.*}", self._pod_proxy)
         # primitives
         r.add_post("/rpc/map/{name}", self._rpc_map)
@@ -171,6 +190,10 @@ class Gateway:
                    "{snapshot_id}", self._internal_disk_manifest_put)
         r.add_get("/rpc/internal/disk/manifest/{snapshot_id}",
                   self._internal_disk_manifest_get)
+        r.add_post("/rpc/internal/sbxsnap/{workspace_id}/{container_id}/"
+                   "{snapshot_id}", self._internal_sbxsnap_put)
+        r.add_get("/rpc/internal/sbxsnap/manifest/{snapshot_id}",
+                  self._internal_sbxsnap_get)
         r.add_get("/api/v1/volume", self._list_volumes)
         r.add_post("/api/v1/volume/{name}", self._create_volume)
         r.add_delete("/api/v1/volume/{name}", self._delete_volume)
@@ -624,7 +647,15 @@ class Gateway:
     async def _rpc_pod_create(self, request: web.Request) -> web.Response:
         data = await request.json()
         stub = await self._stub_for(request, data["stub_id"])
-        out = await self.pods.create(stub, name=data.get("name", ""))
+        from_snapshot = data.get("from_snapshot", "")
+        if from_snapshot:
+            # snapshots are workspace-scoped: a foreign id must 404
+            snap = await self.backend.get_sandbox_snapshot(from_snapshot)
+            if snap is None or snap["workspace_id"] != stub.workspace_id:
+                return web.json_response({"error": "snapshot not found"},
+                                         status=404)
+        out = await self.pods.create(stub, name=data.get("name", ""),
+                                     from_snapshot=from_snapshot)
         if data.get("wait", True):
             address = await self.pods.wait_running(
                 out["container_id"],
@@ -645,6 +676,81 @@ class Gateway:
                                    timeout=min(float(data.get("timeout", 60)),
                                                110.0))
         return web.json_response(out)
+
+    # -- handlers: sandbox depth (process mgr / fs / snapshots) --------------
+
+    async def _rpc_sbx_spawn(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        data = await request.json()
+        out = await self.pods.sbx(state.container_id, {
+            "op": "spawn", "cmd": list(data.get("cmd", []))})
+        return web.json_response(out)
+
+    async def _rpc_sbx_ps(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        return web.json_response(
+            await self.pods.sbx(state.container_id, {"op": "ps"}))
+
+    async def _rpc_sbx_status(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        return web.json_response(await self.pods.sbx(
+            state.container_id,
+            {"op": "status", "proc_id": request.match_info["proc_id"]}))
+
+    async def _rpc_sbx_stdin(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        data = await request.json()
+        return web.json_response(await self.pods.sbx(
+            state.container_id,
+            {"op": "stdin", "proc_id": request.match_info["proc_id"],
+             "data": data.get("data", "")}))
+
+    async def _rpc_sbx_kill(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        return web.json_response(await self.pods.sbx(
+            state.container_id,
+            {"op": "kill", "proc_id": request.match_info["proc_id"]}))
+
+    async def _rpc_sbx_out(self, request: web.Request) -> web.Response:
+        # tenancy: the container lookup gates access, and the proc must
+        # belong to that container. Pairing is verified against the worker
+        # ONCE and cached — subsequent output polls read straight off the
+        # state bus with no worker round-trip (wait() polls at ~5 Hz).
+        state = await self._pod_container_for(request)
+        proc_id = request.match_info["proc_id"]
+        if self._sbx_proc_owner.get(proc_id) != state.container_id:
+            check = await self.pods.sbx(
+                state.container_id, {"op": "status", "proc_id": proc_id})
+            if check.get("error"):
+                return web.json_response(check, status=404)
+            if len(self._sbx_proc_owner) > 10000:
+                self._sbx_proc_owner.clear()
+            self._sbx_proc_owner[proc_id] = state.container_id
+        out = await self.pods.proc_output(
+            proc_id,
+            last_id=request.query.get("last_id", "0"),
+            timeout=min(float(request.query.get("timeout", 0)), 30.0))
+        return web.json_response(out)
+
+    async def _rpc_sbx_fs(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        data = await request.json()
+        out = await self.pods.sbx(state.container_id, {
+            "op": "fs", "fs_op": data.get("op", ""),
+            "path": data.get("path", ""), "data": data.get("data", "")})
+        return web.json_response(out)
+
+    async def _rpc_sbx_snapshot(self, request: web.Request) -> web.Response:
+        state = await self._pod_container_for(request)
+        out = await self.pods.sbx(state.container_id, {
+            "op": "snapshot", "workspace_id": state.workspace_id},
+            timeout=120.0)
+        return web.json_response(out)
+
+    async def _rpc_sbx_snapshots(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        return web.json_response(
+            await self.backend.list_sandbox_snapshots(ws.workspace_id))
 
     async def _pod_proxy(self, request: web.Request) -> web.Response:
         state = await self._pod_container_for(request)
@@ -1312,6 +1418,30 @@ class Gateway:
         if blob is None:
             return web.json_response({"error": "not found"}, status=404)
         return web.Response(text=blob, content_type="application/json")
+
+    async def _internal_sbxsnap_put(self, request: web.Request) -> web.Response:
+        self._require_worker(request)
+        blob = await request.text()
+        from ..images import ImageManifest
+        try:
+            manifest = ImageManifest.from_json(blob)
+        except Exception as exc:   # noqa: BLE001
+            return web.json_response({"error": f"bad manifest: {exc}"},
+                                     status=400)
+        await self.backend.put_sandbox_snapshot(
+            request.match_info["snapshot_id"],
+            request.match_info["workspace_id"],
+            request.match_info["container_id"], blob, manifest.total_bytes)
+        return web.json_response({"ok": True})
+
+    async def _internal_sbxsnap_get(self, request: web.Request) -> web.Response:
+        self._require_worker(request)
+        snap = await self.backend.get_sandbox_snapshot(
+            request.match_info["snapshot_id"])
+        if snap is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(text=snap["manifest"],
+                            content_type="application/json")
 
     async def _list_tasks(self, request: web.Request) -> web.Response:
         ws = self._ws(request)

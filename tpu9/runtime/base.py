@@ -100,5 +100,11 @@ class Runtime:
         """Interactive PTY exec in the container (tpu9 shell)."""
         raise NotImplementedError
 
+    def fs_root(self, container_id: str) -> Optional[str]:
+        """Host-visible path of the container's working tree (the sandbox
+        fs API operates here: upload/download/ls without exec round-trips).
+        None when the container is unknown."""
+        return None
+
     def capabilities(self) -> set[str]:
         return set()

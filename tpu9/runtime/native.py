@@ -423,6 +423,16 @@ class NativeRuntime(Runtime):
         return ["nsenter", "-t", str(pid), "-m", "-u", "-i", "-p", "-n",
                 "-r", "-w"]
 
+    def fs_root(self, container_id: str):
+        spec = self._specs.get(container_id)
+        if spec is None:
+            return None
+        # the workspace dir rides into the container bind-mounted at its
+        # host path, so the host path IS the container's working tree
+        if spec.workdir not in ("", "/"):
+            return spec.workdir
+        return os.path.join(self.sandbox_dir(container_id), "rootfs")
+
     async def exec(self, container_id: str, cmd: list[str]) -> tuple[int, str]:
         enter = self._nsenter(container_id)
         if enter is None:

@@ -142,6 +142,11 @@ class ProcessRuntime(Runtime):
             return spec.workdir
         return self.sandbox_dir(container_id)
 
+    def fs_root(self, container_id: str):
+        if container_id not in self._handles:
+            return None
+        return self._exec_cwd(container_id)
+
     async def exec(self, container_id: str, cmd: list[str]) -> tuple[int, str]:
         """Run a command in the container's sandbox/env context."""
         handle = self._handles.get(container_id)

@@ -61,26 +61,140 @@ class Pod(RunnerAbstraction):
     def handler_spec(self) -> str:
         return self.config.handler  # pods have no python handler
 
+    def _create_body(self, stub_id: str, wait: bool,
+                     timeout: float) -> dict:
+        return {"stub_id": stub_id, "wait": wait, "timeout": timeout}
+
     def create(self, wait: bool = True, timeout: float = 60.0) -> PodHandle:
         stub_id = self.prepare_runtime()
+        body = self._create_body(stub_id, wait, timeout)
         out = self.client._run(lambda c: c.request(
-            "POST", "/rpc/pod/create",
-            json_body={"stub_id": stub_id, "wait": wait,
-                       "timeout": timeout}))
+            "POST", "/rpc/pod/create", json_body=body))
         return PodHandle(out["container_id"], self.client,
                          self.client.ctx.gateway_url, out.get("address"))
 
 
+class SandboxProcess:
+    """Handle to a long-running process spawned in a sandbox (reference
+    sandbox.py:376's process manager). Output streams through the state bus;
+    ``read_output`` is incremental (pass the previous ``last_id``)."""
+
+    def __init__(self, sandbox: "Sandbox", proc_id: str):
+        self._sb = sandbox
+        self.proc_id = proc_id
+        self._last_id = "0"
+        self.exit_code = None
+
+    def status(self) -> dict:
+        return self._sb._rpc("GET", f"/proc/{self.proc_id}")
+
+    def running(self) -> bool:
+        return bool(self.status().get("running"))
+
+    def read_output(self, timeout: float = 0) -> bytes:
+        """New output since the last read (empty when none)."""
+        import base64
+        out = self._sb._rpc(
+            "GET", f"/proc/{self.proc_id}/out"
+                   f"?last_id={self._last_id}&timeout={timeout}")
+        self._last_id = out.get("last_id", self._last_id)
+        if out.get("exit_code") is not None:
+            self.exit_code = out["exit_code"]
+        return base64.b64decode(out.get("data", ""))
+
+    def write_stdin(self, data: bytes) -> dict:
+        import base64
+        return self._sb._rpc(
+            "POST", f"/proc/{self.proc_id}/stdin",
+            json_body={"data": base64.b64encode(data).decode()})
+
+    def wait(self, timeout: float = 60.0, poll_s: float = 0.2) -> int:
+        """Drain output until exit; returns the exit code."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.read_output(timeout=min(poll_s * 5, 2.0))
+            if self.exit_code is not None:
+                return self.exit_code
+            time.sleep(poll_s)
+        raise TimeoutError(f"process {self.proc_id} did not exit")
+
+    def kill(self) -> dict:
+        return self._sb._rpc("POST", f"/proc/{self.proc_id}/kill")
+
+
+class SandboxFSError(RuntimeError):
+    """A sandbox fs operation failed for a reason other than a missing
+    path (containment violation, size cap, not-a-directory...)."""
+
+
+class SandboxFS:
+    """Sandbox filesystem API (reference sandbox.py:916): direct file
+    transfer against the container's working tree — no exec round-trips."""
+
+    def __init__(self, sandbox: "Sandbox"):
+        self._sb = sandbox
+
+    def _op(self, op: str, path: str, data: bytes = b"") -> dict:
+        import base64
+        out = self._sb._rpc("POST", "/fs", json_body={
+            "op": op, "path": path,
+            "data": base64.b64encode(data).decode() if data else ""})
+        err = out.get("error")
+        if err:
+            # FileNotFoundError strictly means "missing path" — callers
+            # catching it must not swallow containment/size-cap failures
+            if err == "not found":
+                raise FileNotFoundError(f"{op} {path}: {err}")
+            raise SandboxFSError(f"{op} {path}: {err}")
+        return out
+
+    def upload(self, path: str, data: bytes) -> dict:
+        return self._op("write", path, data)
+
+    def download(self, path: str) -> bytes:
+        import base64
+        return base64.b64decode(self._op("read", path).get("data", ""))
+
+    def ls(self, path: str = ".") -> list[dict]:
+        return self._op("ls", path).get("entries", [])
+
+    def stat(self, path: str) -> dict:
+        return self._op("stat", path)
+
+    def mkdir(self, path: str) -> dict:
+        return self._op("mkdir", path)
+
+    def rm(self, path: str) -> dict:
+        return self._op("rm", path)
+
+
 class Sandbox(Pod):
-    """Interactive compute sandbox (reference sdk sandbox.py): an idle
-    container you exec into.
+    """Interactive compute sandbox (reference sdk sandbox.py:137): an idle
+    container with code exec, a process manager, a filesystem API, and
+    working-tree snapshots.
 
         sb = Sandbox(cpu=1).create()
         out = sb.exec(["python3", "-c", "print(40+2)"])
         assert out["output"].strip() == "42"
+
+        proc = sb.spawn(["python3", "server.py"])     # long-running
+        sb.fs.upload("data.txt", b"hello")
+        snap = sb.snapshot()                          # working-tree snapshot
+        sb2 = Sandbox(cpu=1, from_snapshot=snap).create()
     """
 
     stub_type = "sandbox"
+
+    def __init__(self, *args, from_snapshot: str = "", **kwargs):
+        super().__init__(*args, **kwargs)
+        self.from_snapshot = from_snapshot
+        self.fs = SandboxFS(self)
+
+    def _rpc(self, method: str, tail: str, json_body=None) -> dict:
+        cid = self._handle.container_id
+        return self.client._run(lambda c: c.request(
+            method, f"/rpc/pod/{cid}{tail}", json_body=json_body))
 
     def run_code(self, code: str, timeout: float = 60.0) -> dict:
         import sys
@@ -92,12 +206,37 @@ class Sandbox(Pod):
             raise RuntimeError("call create() first")
         return self._handle.exec(cmd, timeout=timeout)
 
+    def _create_body(self, stub_id: str, wait: bool,
+                     timeout: float) -> dict:
+        body = super()._create_body(stub_id, wait, timeout)
+        body["from_snapshot"] = self.from_snapshot
+        return body
+
     def create(self, wait: bool = True, timeout: float = 60.0) -> "Sandbox":
-        self._handle = super().create(wait=wait, timeout=timeout)
+        self._handle = Pod.create(self, wait=wait, timeout=timeout)
         return self
 
     def exec(self, cmd: list[str], timeout: float = 60.0) -> dict:
         return self.exec_default(cmd, timeout=timeout)
+
+    # -- process manager -----------------------------------------------------
+
+    def spawn(self, cmd: list[str]) -> SandboxProcess:
+        out = self._rpc("POST", "/proc", json_body={"cmd": cmd})
+        if out.get("error"):
+            raise RuntimeError(f"spawn failed: {out['error']}")
+        return SandboxProcess(self, out["proc_id"])
+
+    def procs(self) -> list[dict]:
+        return self._rpc("GET", "/proc").get("procs", [])
+
+    # -- snapshots -----------------------------------------------------------
+
+    def snapshot(self) -> str:
+        out = self._rpc("POST", "/snapshot")
+        if out.get("error"):
+            raise RuntimeError(f"snapshot failed: {out['error']}")
+        return out["snapshot_id"]
 
     def terminate(self) -> bool:
         return self._handle.terminate()

@@ -183,6 +183,7 @@ def _make_proxy(op: str):
 
 
 for _op in ("set", "get", "delete", "exists", "keys", "expire", "ttl", "incr",
+            "cas",
             "hset", "hmset", "hget", "hgetall", "hdel", "hincr",
             "zadd", "zpopmin", "zrange", "zcard", "zrem", "zscore",
             "rpush", "lpush", "lpop", "blpop", "llen", "lrange", "lrem",

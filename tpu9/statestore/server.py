@@ -20,7 +20,7 @@ log = logging.getLogger("tpu9.statestore")
 # ops a remote client may invoke (everything on StateStore except subscribe,
 # which has dedicated handling below)
 _OPS = {
-    "set", "get", "delete", "exists", "keys", "expire", "ttl", "incr",
+    "set", "get", "delete", "exists", "keys", "expire", "ttl", "incr", "cas",
     "hset", "hmset", "hget", "hgetall", "hdel", "hincr",
     "zadd", "zpopmin", "zrange", "zcard", "zrem", "zscore",
     "rpush", "lpush", "lpop", "blpop", "llen", "lrange", "lrem",

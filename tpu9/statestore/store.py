@@ -32,6 +32,13 @@ class StateStore:
     async def ttl(self, key: str) -> float: raise NotImplementedError
     async def incr(self, key: str, by: int = 1,
                    floor: Optional[int] = None) -> int: raise NotImplementedError
+    async def cas(self, key: str, expected: Any, value: Any,
+                  ttl: Optional[float] = None) -> bool:
+        """Atomic compare-and-set: write ``value`` only if the current value
+        equals ``expected`` (``expected=None`` means set-if-absent). The
+        single atomic read-modify-write ownership handoffs need (disk live-
+        location refresh must not steal the pointer back from a new holder)."""
+        raise NotImplementedError
 
     # -- hash
     async def hset(self, key: str, field: str, value: Any) -> None: raise NotImplementedError
@@ -203,6 +210,17 @@ class MemoryStore(StateStore):
             cur = floor
         self._kv[key] = cur
         return cur
+
+    async def cas(self, key, expected, value, ttl=None):
+        current = None if self._expired(key) else self._kv.get(key)
+        if current != expected:
+            return False
+        self._kv[key] = value
+        if ttl is not None:
+            self._expiry[key] = time.monotonic() + ttl
+        else:
+            self._expiry.pop(key, None)
+        return True
 
     # -- hash ---------------------------------------------------------------
     async def hset(self, key, field, value):

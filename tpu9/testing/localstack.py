@@ -148,12 +148,28 @@ class LocalStack:
             chunk_put=disk_chunk_put, chunk_get=disk_chunk_get,
             manifest_put=disk_manifest_put,
             manifest_get=self.backend.get_disk_snapshot_manifest)
+
+        from ..worker.sandbox import SandboxAgent
+
+        async def sbxsnap_put(snapshot_id, workspace_id, container_id,
+                              manifest_json, size) -> None:
+            await self.backend.put_sandbox_snapshot(
+                snapshot_id, workspace_id, container_id, manifest_json, size)
+
+        async def sbxsnap_get(snapshot_id: str):
+            snap = await self.backend.get_sandbox_snapshot(snapshot_id)
+            return snap["manifest"] if snap else None
+
+        sandboxes = SandboxAgent(runtime, self.store,
+                                 chunk_put=disk_chunk_put,
+                                 chunk_get=disk_chunk_get,
+                                 snap_put=sbxsnap_put, snap_get=sbxsnap_get)
         worker = Worker(
             self.store, runtime, cfg=self.cfg.worker, pool=pool,
             cpu_millicores=16000, memory_mb=32768,   # virtual capacity: these
             # workers time-share the host the way k8s test nodes do
             tpu_generation=tpu_generation, cache=cache,
-            checkpoints=checkpoints, disks=disks,
+            checkpoints=checkpoints, disks=disks, sandboxes=sandboxes,
             object_resolver=self._resolve_object, **slice_kw)
         await worker.start()
         self.workers.append(worker)

@@ -380,10 +380,17 @@ class ContainerRequest(_Serializable):
     pool_selector: str = ""
     priority: int = 0
     checkpoint_id: str = ""       # restore-from if set
+    # sandbox-from-snapshot: materialize this sandbox snapshot's working
+    # tree into the workdir before the entrypoint starts
+    workdir_snapshot_id: str = ""
     # durable disks (durable_disk.go analogue): latest snapshot per disk
     # name (restore source on a fresh worker) + preferred worker holding
     # the live disk dir (scheduler affinity)
     disk_snapshots: dict[str, str] = field(default_factory=dict)
+    # backend row id per disk name: dirs on workers are keyed by incarnation
+    # (name@disk_id) so a deleted+recreated disk can never re-attach a stale
+    # dir left by the old incarnation
+    disk_ids: dict[str, str] = field(default_factory=dict)
     disk_affinity: str = ""
     retry_count: int = 0
     timestamp: float = field(default_factory=now)

@@ -1,0 +1,17 @@
+"""Shared single-path-component validation.
+
+One definition for every place a tenant-supplied name becomes a filesystem
+path segment (volume mounts, disk dirs, CLI destinations) — the defenses
+must tighten in lockstep, not diverge per call site.
+"""
+
+from __future__ import annotations
+
+
+def validate_path_part(part: str, what: str = "path part") -> str:
+    """Reject anything that could traverse outside its parent directory
+    when joined as a single component."""
+    if (not part or "/" in part or "\\" in part or "\x00" in part
+            or part in (".", "..")):
+        raise ValueError(f"invalid {what}: {part!r}")
+    return part

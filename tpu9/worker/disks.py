@@ -20,8 +20,15 @@ from typing import Awaitable, Callable, Optional
 
 from ..images.manifest import ImageManifest, materialize, snapshot_dir
 from ..types import new_id
+from ..utils.paths import validate_path_part
 
 log = logging.getLogger("tpu9.worker")
+
+
+class DiskRestoreError(RuntimeError):
+    """Snapshot restore failed — the container start must fail rather than
+    silently run on an empty disk (whose next snapshot would overwrite the
+    only good one)."""
 
 # async (data, digest) -> None — durable chunk sink (gateway registry/cache)
 ChunkPut = Callable[[bytes, str], Awaitable[None]]
@@ -46,22 +53,29 @@ class DiskManager:
         self.manifest_get = manifest_get
         self._locks: dict[str, asyncio.Lock] = {}
 
-    def disk_dir(self, workspace_id: str, name: str) -> str:
-        for part in (workspace_id, name):
-            if (not part or "/" in part or "\\" in part
-                    or part in (".", "..")):
-                raise ValueError(f"invalid disk path part {part!r}")
-        return os.path.join(self.disks_dir, workspace_id, name)
+    def disk_dir(self, workspace_id: str, name: str,
+                 disk_id: str = "") -> str:
+        """Disk dirs are keyed by *incarnation* (``name@disk_id``): deleting
+        and recreating a disk mints a fresh backend row id, so a stale dir
+        left by the deleted incarnation on some other worker can never be
+        re-attached — resurrection is prevented structurally, not by
+        best-effort delete broadcasts."""
+        validate_path_part(workspace_id, "disk workspace")
+        validate_path_part(name, "disk name")
+        if disk_id:
+            validate_path_part(disk_id, "disk id")
+        leaf = f"{name}@{disk_id}" if disk_id else name
+        return os.path.join(self.disks_dir, workspace_id, leaf)
 
     def _lock(self, key: str) -> asyncio.Lock:
         return self._locks.setdefault(key, asyncio.Lock())
 
     async def attach(self, workspace_id: str, name: str,
-                     snapshot_id: str = "") -> str:
+                     snapshot_id: str = "", disk_id: str = "") -> str:
         """Return the disk's local dir, restoring the latest snapshot first
         when this worker has never seen the disk (attach-on-schedule,
         durable_disk.go:159)."""
-        d = self.disk_dir(workspace_id, name)
+        d = self.disk_dir(workspace_id, name, disk_id)
         async with self._lock(d):
             if os.path.isdir(d):
                 return d
@@ -84,30 +98,48 @@ class DiskManager:
                                                 get_chunk, None)
                         log.info("disk %s/%s restored from %s",
                                  workspace_id, name, snapshot_id)
-                except Exception as exc:    # noqa: BLE001 — empty > dead
-                    log.warning("disk restore %s failed: %s (empty attach)",
-                                snapshot_id, exc)
-                    # never hand out a half-restored disk
+                except Exception as exc:
+                    # never hand out a half-restored (or empty) disk: the
+                    # container start must FAIL — an empty dir registered as
+                    # the live holder would let the next snapshot overwrite
+                    # the only good one with nothing
                     import shutil
                     await asyncio.to_thread(shutil.rmtree, d, True)
-                    os.makedirs(d, exist_ok=True)
+                    raise DiskRestoreError(
+                        f"disk {workspace_id}/{name} restore from "
+                        f"{snapshot_id} failed: {exc}") from exc
             return d
 
     async def remove(self, workspace_id: str, name: str) -> bool:
-        """Delete the live dir — a later same-named disk must start empty,
-        not resurrect deleted data."""
+        """Best-effort space reclamation on the live holder: every
+        incarnation dir for this name goes (``name`` and ``name@*``).
+        Correctness against resurrection does not depend on this — stale
+        incarnations on unreachable workers are unreferenceable because a
+        recreated disk carries a fresh ``disk_id``."""
         import shutil
-        d = self.disk_dir(workspace_id, name)
-        async with self._lock(d):
-            if os.path.isdir(d):
-                await asyncio.to_thread(shutil.rmtree, d, True)
-                return True
-            return False
+        validate_path_part(workspace_id, "disk workspace")
+        validate_path_part(name, "disk name")
+        ws_dir = os.path.join(self.disks_dir, workspace_id)
+        removed = False
+        if os.path.isdir(ws_dir):
+            for leaf in os.listdir(ws_dir):
+                # exact incarnation match: split off the final "@<disk_id>"
+                # (disk names may themselves contain '@' — a prefix match
+                # would delete disk "db@prod"'s dirs when removing "db")
+                if leaf != name and leaf.rsplit("@", 1)[0] != name:
+                    continue
+                d = os.path.join(ws_dir, leaf)
+                async with self._lock(d):
+                    if os.path.isdir(d):
+                        await asyncio.to_thread(shutil.rmtree, d, True)
+                        removed = True
+        return removed
 
-    async def snapshot(self, workspace_id: str, name: str) -> dict:
+    async def snapshot(self, workspace_id: str, name: str,
+                       disk_id: str = "") -> dict:
         """Chunk the disk dir and persist manifest + chunks through the
         hooks (durable_disk.go:263's snapshot-to-S3)."""
-        d = self.disk_dir(workspace_id, name)
+        d = self.disk_dir(workspace_id, name, disk_id)
         if not os.path.isdir(d):
             return {"error": "disk not present on this worker"}
         if self.chunk_put is None or self.manifest_put is None:

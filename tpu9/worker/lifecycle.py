@@ -26,6 +26,7 @@ from ..repository import ContainerRepository
 from ..runtime.base import ContainerSpec, Runtime
 from ..types import (ContainerRequest, ContainerState, ContainerStatus,
                      LifecyclePhase, StopReason, StubType)
+from ..utils.paths import validate_path_part
 from .tpu_manager import TpuDeviceManager
 
 log = logging.getLogger("tpu9.worker")
@@ -40,8 +41,7 @@ def free_port() -> int:
 
 
 def _validate_volume_name(name: str) -> None:
-    if not name or "/" in name or "\\" in name or name in (".", ".."):
-        raise ValueError(f"invalid volume name {name!r}")
+    validate_path_part(name, "volume name")
 
 
 class ContainerLifecycle:
@@ -69,6 +69,8 @@ class ContainerLifecycle:
         # durable disks (set by the Worker): DiskManager + attach notifier
         self.disks = None
         self.disk_attached = None
+        # sandbox agent (set by the Worker): workdir snapshot restores
+        self.sandboxes = None
         # container -> [(workspace_id, volume_name, local_dir)] to push back
         self._synced_volumes: dict[str, list[tuple[str, str, str]]] = {}
         self.checkpoints = checkpoints   # Optional[CheckpointManager]
@@ -313,13 +315,20 @@ class ContainerLifecycle:
                 import zipfile
                 await asyncio.to_thread(
                     lambda: zipfile.ZipFile(archive).extractall(base))
+        if request.workdir_snapshot_id and self.sandboxes is not None:
+            # sandbox-from-snapshot: materialize the parent sandbox's working
+            # tree before the entrypoint starts (raises on failure — never
+            # silently start empty)
+            await self.sandboxes.restore_into(base,
+                                              request.workdir_snapshot_id)
         for mount in request.mounts:
             if mount.kind == "disk" and mount.target:
                 if self.disks is None:
                     raise RuntimeError("worker has no disk manager")
                 disk_dir = await self.disks.attach(
                     request.workspace_id, mount.source,
-                    request.disk_snapshots.get(mount.source, ""))
+                    request.disk_snapshots.get(mount.source, ""),
+                    disk_id=request.disk_ids.get(mount.source, ""))
                 if self.disk_attached is not None:
                     await self.disk_attached(request.workspace_id,
                                              mount.source)
@@ -467,9 +476,10 @@ class ContainerLifecycle:
                                                  mount.source)
                 spec_mounts.append((host_dir, mount.target, mount.read_only))
             elif mount.kind == "disk" and self.disks is not None:
-                spec_mounts.append((self.disks.disk_dir(request.workspace_id,
-                                                        mount.source),
-                                    mount.target, mount.read_only))
+                spec_mounts.append((self.disks.disk_dir(
+                    request.workspace_id, mount.source,
+                    request.disk_ids.get(mount.source, "")),
+                    mount.target, mount.read_only))
             elif mount.kind == "bind":
                 spec_mounts.append((mount.source, mount.target,
                                     mount.read_only))

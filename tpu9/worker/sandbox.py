@@ -1,0 +1,295 @@
+"""Sandbox agent: process manager + filesystem API + workdir snapshots for
+sandbox containers, served worker-side over the state bus.
+
+Reference analogue: the Sandbox surface of ``sdk/src/beta9/abstractions/
+sandbox.py:137,376,916`` (process manager, fs API, code exec, snapshots)
+backed by goproc-as-PID-1 + worker gRPC (``pkg/worker/sandbox.go:148``,
+``container_server.go:169-614``). tpu9 re-designs this around what the
+worker already owns:
+
+- **processes** are runtime ``exec_stream`` sessions (the same PTY path the
+  shell uses) tracked in a per-worker table; their output rides state-bus
+  streams (``sbx:out:<proc_id>``) that the gateway reads directly — no
+  worker round-trip per output poll;
+- **fs ops** act on the container's host-visible working tree
+  (``Runtime.fs_root``) with path containment — upload/download never pay
+  an exec round-trip;
+- **snapshots** reuse the content-addressed chunk manifest machinery images
+  /disks use: the working tree chunks into the cache/registry, the manifest
+  lands in the backend, and a new sandbox materializes it before its
+  entrypoint starts (request.workdir_snapshot_id).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import logging
+import os
+import time
+from typing import Awaitable, Callable, Optional
+
+from ..images.manifest import ImageManifest, materialize, snapshot_dir
+from ..types import new_id
+
+log = logging.getLogger("tpu9.worker")
+
+OUT_STREAM_MAXLEN = 10000
+# async (data, digest) -> None / (digest) -> bytes|None — chunk sink/source
+ChunkPut = Callable[[bytes, str], Awaitable[None]]
+ChunkGet = Callable[[str], Awaitable[Optional[bytes]]]
+# async (snapshot_id, workspace_id, container_id, manifest_json, size)
+SnapPut = Callable[..., Awaitable[None]]
+# async (snapshot_id) -> manifest json | None
+SnapGet = Callable[[str], Awaitable[Optional[str]]]
+
+
+class SandboxProcess:
+    def __init__(self, proc_id: str, container_id: str, cmd: list[str]):
+        self.proc_id = proc_id
+        self.container_id = container_id
+        self.cmd = cmd
+        self.session = None           # ShellSession
+        self.started_at = time.time()
+        self.exit_code: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {"proc_id": self.proc_id, "container_id": self.container_id,
+                "cmd": self.cmd, "started_at": self.started_at,
+                "running": self.exit_code is None,
+                "exit_code": self.exit_code}
+
+
+class SandboxAgent:
+    def __init__(self, runtime, store,
+                 chunk_put: Optional[ChunkPut] = None,
+                 chunk_get: Optional[ChunkGet] = None,
+                 snap_put: Optional[SnapPut] = None,
+                 snap_get: Optional[SnapGet] = None):
+        self.runtime = runtime
+        self.store = store
+        self.chunk_put = chunk_put
+        self.chunk_get = chunk_get
+        self.snap_put = snap_put
+        self.snap_get = snap_get
+        self.procs: dict[str, SandboxProcess] = {}
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def handle(self, payload: dict) -> dict:
+        op = payload.get("op", "")
+        try:
+            if op == "spawn":
+                return await self.spawn(payload)
+            if op == "ps":
+                return self.ps(payload)
+            if op == "status":
+                return self.status(payload)
+            if op == "stdin":
+                return await self.stdin(payload)
+            if op == "kill":
+                return await self.kill_proc(payload)
+            if op == "fs":
+                return await self.fs(payload)
+            if op == "snapshot":
+                return await self.snapshot(payload)
+            return {"error": f"unknown sandbox op {op!r}"}
+        except Exception as exc:   # noqa: BLE001 — reply, don't crash worker
+            log.warning("sandbox op %s failed: %s", op, exc)
+            return {"error": f"{type(exc).__name__}: {exc}"}
+
+    # -- process manager -----------------------------------------------------
+
+    async def spawn(self, payload: dict) -> dict:
+        container_id = payload["container_id"]
+        cmd = list(payload.get("cmd", []))
+        if not cmd:
+            return {"error": "empty command"}
+        proc = SandboxProcess(new_id("sp"), container_id, cmd)
+        session = await self.runtime.exec_stream(container_id, cmd)
+        proc.session = session
+        self.procs[proc.proc_id] = proc
+        asyncio.create_task(self._pump_output(proc))
+        return {"proc_id": proc.proc_id}
+
+    async def _pump_output(self, proc: SandboxProcess) -> None:
+        key = f"sbx:out:{proc.proc_id}"
+        try:
+            while True:
+                chunk = await proc.session.output.get()
+                if chunk is None:
+                    break
+                await self.store.xadd(
+                    key, {"data": base64.b64encode(chunk).decode()},
+                    maxlen=OUT_STREAM_MAXLEN)
+        except Exception as exc:   # noqa: BLE001 — a store hiccup must not
+            # leave the proc reported running forever with no exit marker;
+            # the process itself is killed so reported state stays truthful
+            log.warning("sandbox output pump for %s failed: %s",
+                        proc.proc_id, exc)
+            try:
+                await proc.session.close()
+            except Exception:   # noqa: BLE001
+                pass
+        finally:
+            proc.exit_code = (proc.session.exit_code
+                              if proc.session.exit_code is not None else -1)
+            try:
+                await self.store.xadd(key, {"exit": proc.exit_code})
+                await self.store.expire(key, 600.0)
+            except Exception:   # noqa: BLE001 — status() still shows exited
+                log.warning("sandbox exit marker for %s failed",
+                            proc.proc_id)
+
+    def ps(self, payload: dict) -> dict:
+        container_id = payload.get("container_id", "")
+        return {"procs": [p.to_dict() for p in self.procs.values()
+                          if p.container_id == container_id]}
+
+    def _proc_for(self, payload: dict) -> Optional[SandboxProcess]:
+        """Procs are addressed by (container, proc) — a proc id from another
+        container (i.e. another tenant) never resolves."""
+        proc = self.procs.get(payload.get("proc_id", ""))
+        if proc is None or proc.container_id != payload.get("container_id"):
+            return None
+        return proc
+
+    def status(self, payload: dict) -> dict:
+        proc = self._proc_for(payload)
+        if proc is None:
+            return {"error": "no such process"}
+        return proc.to_dict()
+
+    async def stdin(self, payload: dict) -> dict:
+        proc = self._proc_for(payload)
+        if proc is None:
+            return {"error": "no such process"}
+        if proc.exit_code is not None:
+            return {"error": "process exited"}
+        await proc.session.write(base64.b64decode(payload.get("data", "")))
+        return {"ok": True}
+
+    async def kill_proc(self, payload: dict) -> dict:
+        proc = self._proc_for(payload)
+        if proc is None:
+            return {"error": "no such process"}
+        await proc.session.close()
+        return {"ok": True}
+
+    def reap_container(self, container_id: str) -> None:
+        """Drop process records when their container stops."""
+        for pid, proc in list(self.procs.items()):
+            if proc.container_id == container_id:
+                self.procs.pop(pid, None)
+
+    # -- filesystem ----------------------------------------------------------
+
+    def _resolve(self, container_id: str, path: str) -> str:
+        root = self.runtime.fs_root(container_id)
+        if not root:
+            raise RuntimeError("container has no filesystem root")
+        full = os.path.realpath(os.path.join(root, path.lstrip("/")))
+        real_root = os.path.realpath(root)
+        if full != real_root and not full.startswith(real_root + os.sep):
+            raise ValueError(f"path escapes sandbox: {path!r}")
+        return full
+
+    async def fs(self, payload: dict) -> dict:
+        container_id = payload["container_id"]
+        sub = payload.get("fs_op", "")
+        path = payload.get("path", "")
+        full = self._resolve(container_id, path)
+
+        def _stat(p: str) -> dict:
+            st = os.stat(p)
+            return {"path": path, "size": st.st_size,
+                    "mtime": st.st_mtime,
+                    "is_dir": os.path.isdir(p)}
+
+        if sub == "ls":
+            if not os.path.isdir(full):
+                return {"error": "not a directory"}
+            out = []
+            for name in sorted(os.listdir(full)):
+                p = os.path.join(full, name)
+                st = os.lstat(p)
+                out.append({"name": name, "size": st.st_size,
+                            "is_dir": os.path.isdir(p)})
+            return {"entries": out}
+        if sub == "stat":
+            if not os.path.exists(full):
+                return {"error": "not found"}
+            return _stat(full)
+        if sub == "read":
+            if not os.path.isfile(full):
+                return {"error": "not found"}
+            if os.path.getsize(full) > 32 * 1024 * 1024:
+                return {"error": "file too large for inline read (32MiB cap)"}
+            with open(full, "rb") as f:
+                return {"data": base64.b64encode(f.read()).decode()}
+        if sub == "write":
+            os.makedirs(os.path.dirname(full), exist_ok=True)
+            data = base64.b64decode(payload.get("data", ""))
+            with open(full, "wb") as f:
+                f.write(data)
+            return {"ok": True, "size": len(data)}
+        if sub == "mkdir":
+            os.makedirs(full, exist_ok=True)
+            return {"ok": True}
+        if sub == "rm":
+            if os.path.isdir(full):
+                import shutil
+                await asyncio.to_thread(shutil.rmtree, full, True)
+            elif os.path.exists(full):
+                os.unlink(full)
+            else:
+                return {"error": "not found"}
+            return {"ok": True}
+        return {"error": f"unknown fs op {sub!r}"}
+
+    # -- snapshots -----------------------------------------------------------
+
+    async def snapshot(self, payload: dict) -> dict:
+        container_id = payload["container_id"]
+        workspace_id = payload.get("workspace_id", "")
+        if self.chunk_put is None or self.snap_put is None:
+            return {"error": "worker has no snapshot sink"}
+        root = self.runtime.fs_root(container_id)
+        if not root or not os.path.isdir(root):
+            return {"error": "container has no filesystem root"}
+        snapshot_id = new_id("sbxsnap")
+        loop = asyncio.get_running_loop()
+
+        def put_chunk(data: bytes, digest: str) -> None:
+            asyncio.run_coroutine_threadsafe(
+                self.chunk_put(data, digest), loop).result()
+
+        manifest = await asyncio.to_thread(snapshot_dir, root,
+                                           4 * 1024 * 1024, put_chunk)
+        manifest.image_id = snapshot_id
+        await self.snap_put(snapshot_id, workspace_id, container_id,
+                            manifest.to_json(), manifest.total_bytes)
+        log.info("sandbox %s snapshot %s: %d files, %d KiB", container_id,
+                 snapshot_id, len(manifest.files),
+                 manifest.total_bytes >> 10)
+        return {"snapshot_id": snapshot_id, "size": manifest.total_bytes,
+                "files": len(manifest.files)}
+
+    async def restore_into(self, workdir: str, snapshot_id: str) -> None:
+        """Materialize a sandbox snapshot into a fresh container's workdir
+        (before its entrypoint starts). Raises on failure — a sandbox that
+        asked for a snapshot must not silently start empty."""
+        if self.snap_get is None or self.chunk_get is None:
+            raise RuntimeError("worker has no snapshot source")
+        blob = await self.snap_get(snapshot_id)
+        if not blob:
+            raise RuntimeError(f"sandbox snapshot {snapshot_id} not found")
+        manifest = ImageManifest.from_json(blob)
+        loop = asyncio.get_running_loop()
+
+        def get_chunk(digest: str) -> Optional[bytes]:
+            return asyncio.run_coroutine_threadsafe(
+                self.chunk_get(digest), loop).result()
+
+        await asyncio.to_thread(materialize, manifest, workdir, get_chunk,
+                                None)

@@ -28,6 +28,11 @@ from .tpu_manager import TpuDeviceManager
 
 log = logging.getLogger("tpu9.worker")
 
+# Live-disk location pointers expire if the holding worker stops refreshing
+# them (restart/crash) — a dangling pointer would strand snapshots with
+# "worker unreachable" and pin placement to a dead worker id.
+DISK_LOC_TTL_S = 90.0
+
 
 def _detect_host() -> str:
     """This host's routable IP (the trick sends no packets: connecting a UDP
@@ -56,7 +61,7 @@ class Worker:
                  object_resolver=None, image_resolver=None,
                  volume_sync=None, volume_push=None,
                  cache=None, checkpoints=None, disks=None,
-                 phase_cb=None) -> None:
+                 sandboxes=None, phase_cb=None) -> None:
         self.cfg = cfg or WorkerConfig()
         self.worker_id = worker_id or new_id("worker")
         self.pool = pool
@@ -79,6 +84,9 @@ class Worker:
         self.disks = disks              # Optional[DiskManager]
         self.lifecycle.disks = disks
         self.lifecycle.disk_attached = self._note_disk_attached
+        self._attached_disks: set[tuple[str, str]] = set()
+        self.sandboxes = sandboxes      # Optional[SandboxAgent]
+        self.lifecycle.sandboxes = sandboxes
         self.slice_id = slice_id
         self.slice_topology = slice_topology
         self.slice_host_rank = slice_host_rank
@@ -135,6 +143,7 @@ class Worker:
             asyncio.create_task(self._exec_loop()),
             asyncio.create_task(self._shell_loop()),
             asyncio.create_task(self._disk_loop()),
+            asyncio.create_task(self._sbx_loop()),
         ]
         log.info("worker %s started (pool=%s chips=%d)", self.worker_id,
                  self.pool, self.tpu.chip_count)
@@ -151,6 +160,10 @@ class Worker:
         await asyncio.gather(*self._tasks, return_exceptions=True)
         if self.cache is not None:
             await self.cache.stop()
+        try:
+            await self._release_disk_locs()
+        except Exception:   # noqa: BLE001 — TTL expiry is the backstop
+            pass
         await self.workers.deregister(self.worker_id)
 
     # ------------------------------------------------------------------
@@ -159,6 +172,10 @@ class Worker:
         from ..observability import metrics
         while not self._stopping.is_set():
             await self.workers.touch_keepalive(self.worker_id)
+            try:
+                await self._refresh_disk_locs()
+            except Exception as exc:   # keepalive must survive hiccups
+                log.debug("disk-loc refresh failed: %s", exc)
             # police every container with a known limit — including ones
             # still cold-starting (registered at spawn, before readiness)
             for container_id, limit in list(
@@ -338,9 +355,35 @@ class Worker:
     async def _note_disk_attached(self, workspace_id: str,
                                   name: str) -> None:
         """Record this worker as the disk's live location — the scheduler
-        routes future attachments here (durable-disk placement)."""
+        routes future attachments here (durable-disk placement). The key
+        carries a TTL and is refreshed by the heartbeat: a dead or restarted
+        worker's pointer expires instead of dangling forever (stale pointers
+        used to strand snapshots with 'worker unreachable')."""
+        self._attached_disks.add((workspace_id, name))
         await self.store.set(f"disk:loc:{workspace_id}:{name}",
-                             self.worker_id)
+                             self.worker_id, ttl=DISK_LOC_TTL_S)
+
+    async def _refresh_disk_locs(self) -> None:
+        for workspace_id, name in list(self._attached_disks):
+            key = f"disk:loc:{workspace_id}:{name}"
+            # atomic CAS only: a get-then-set could steal the pointer back
+            # from a worker that legitimately took the disk over between the
+            # read and the write
+            if await self.store.cas(key, self.worker_id, self.worker_id,
+                                    ttl=DISK_LOC_TTL_S):
+                continue
+            if await self.store.cas(key, None, self.worker_id,
+                                    ttl=DISK_LOC_TTL_S):
+                continue   # our own key expired while we still hold the dir
+            # another worker took the disk over — stop refreshing
+            self._attached_disks.discard((workspace_id, name))
+
+    async def _release_disk_locs(self) -> None:
+        for workspace_id, name in list(self._attached_disks):
+            key = f"disk:loc:{workspace_id}:{name}"
+            if await self.store.get(key) == self.worker_id:
+                await self.store.delete(key)
+        self._attached_disks.clear()
 
     async def _disk_loop(self) -> None:
         """Disk snapshot requests over pubsub (gateway → owning worker)."""
@@ -363,13 +406,41 @@ class Worker:
         else:
             try:
                 if payload.get("op") == "delete":
+                    # stop refreshing the live-location pointer too, or the
+                    # heartbeat resurrects it within seconds and a recreated
+                    # disk routes snapshots to this dir-less worker
+                    self._attached_disks.discard(
+                        (payload["workspace_id"], payload["name"]))
                     out = {"ok": await self.disks.remove(
                         payload["workspace_id"], payload["name"])}
                 else:
-                    out = await self.disks.snapshot(payload["workspace_id"],
-                                                    payload["name"])
+                    out = await self.disks.snapshot(
+                        payload["workspace_id"], payload["name"],
+                        disk_id=payload.get("disk_id", ""))
             except Exception as exc:    # noqa: BLE001 — reply, don't crash
                 out = {"error": str(exc)}
+        await self.store.publish(payload.get("reply", ""), out)
+
+    async def _sbx_loop(self) -> None:
+        """Sandbox agent ops (process mgr / fs / snapshots) over pubsub."""
+        sub = self.store.subscribe(f"container:sbx:{self.worker_id}")
+        try:
+            while not self._stopping.is_set():
+                msg = await sub.get(timeout=1.0)
+                if msg is None:
+                    continue
+                _, payload = msg
+                if not payload:
+                    continue
+                asyncio.create_task(self._handle_sbx(payload))
+        finally:
+            sub.close()
+
+    async def _handle_sbx(self, payload: dict) -> None:
+        if self.sandboxes is None:
+            out = {"error": "worker has no sandbox agent"}
+        else:
+            out = await self.sandboxes.handle(payload)
         await self.store.publish(payload.get("reply", ""), out)
 
     async def _handle_exec(self, payload: dict) -> None:
@@ -394,6 +465,8 @@ class Worker:
 
     async def _release_on_exit(self, request: ContainerRequest) -> None:
         await self.runtime.wait(request.container_id)
+        if self.sandboxes is not None:
+            self.sandboxes.reap_container(request.container_id)
         await self._release_capacity(request)
         await self.workers.remove_worker_container(self.worker_id,
                                                    request.container_id)
