@@ -140,3 +140,34 @@ async def test_failed_restore_fails_container_start():
                              disk_id="disk-1")
         # nothing half-restored left behind
         assert not os.path.exists(mgr.disk_dir("ws1", "d1", "disk-1"))
+
+
+async def test_preupgrade_bare_dir_migrates_once_into_incarnation():
+    """A dir attached before incarnation keying (bare name, no sibling
+    marker) carries its live data into the first incarnation-keyed attach;
+    marker-bearing stale dirs never migrate (resurrection stays closed)."""
+    import os
+    import tempfile
+    from tpu9.worker.disks import DiskManager
+
+    with tempfile.TemporaryDirectory() as tmp:
+        mgr = DiskManager(tmp)
+        legacy = os.path.join(tmp, "ws1", "data")
+        os.makedirs(legacy)
+        with open(os.path.join(legacy, "live.txt"), "w") as f:
+            f.write("unsnapshotted")
+
+        d = await mgr.attach("ws1", "data", disk_id="disk-new")
+        assert d.endswith("data@disk-new")
+        with open(os.path.join(d, "live.txt")) as f:
+            assert f.read() == "unsnapshotted"
+        assert not os.path.exists(legacy)
+
+        # a marker-bearing dir (post-upgrade incarnation) does NOT migrate
+        await mgr.remove("ws1", "data")
+        stale = os.path.join(tmp, "ws1", "data")
+        os.makedirs(stale)
+        with open(stale + ".diskid", "w") as f:
+            f.write("disk-old")
+        d2 = await mgr.attach("ws1", "data", disk_id="disk-newer")
+        assert os.listdir(d2) == []

@@ -41,6 +41,29 @@ jax.distributed.initialize(
 GLOBAL_DEVICES = jax.device_count()
 LOCAL_DEVICES = jax.local_device_count()
 
+# ONE REAL pjit TRAIN STEP over the global (multi-process) mesh — the actual
+# multi-host training contract: every rank participates in the same SPMD
+# program, gradients reduce across processes over the jax.distributed
+# cluster. Runs at import so both ranks enter the collective together.
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_mesh = Mesh(jax.devices(), ("fsdp",))          # all global devices
+_W = jax.device_put(jnp.ones((GLOBAL_DEVICES, 4), jnp.float32),
+                    NamedSharding(_mesh, P("fsdp", None)))
+_X = jax.device_put(jnp.full((GLOBAL_DEVICES, 4), 2.0, jnp.float32),
+                    NamedSharding(_mesh, P("fsdp", None)))
+
+@jax.jit
+def _step(w, x):
+    loss = jnp.mean((w * x - 1.0) ** 2)
+    grad = jax.grad(lambda w: jnp.mean((w * x - 1.0) ** 2))(w)
+    return loss, w - 0.1 * grad
+
+_loss, _W2 = _step(_W, _X)
+TRAIN_LOSS = float(_loss)                        # implicit cross-process psum
+TRAIN_W_MEAN = float(jnp.mean(_W2))
+
 def handler(**kw):
     return {
         "rank": int(os.environ["TPU9_GANG_RANK"]),
@@ -48,6 +71,8 @@ def handler(**kw):
         "process_count": jax.process_count(),
         "global_devices": GLOBAL_DEVICES,
         "local_devices": LOCAL_DEVICES,
+        "train_loss": TRAIN_LOSS,
+        "train_w_mean": TRAIN_W_MEAN,
     }
 """
 
@@ -155,6 +180,12 @@ async def test_two_process_gang_jax_distributed(tmp_path):
             # THE multi-host assertion: each process sees every process's
             # devices through the jax.distributed cluster, not just its own
             assert r["global_devices"] == 2 * r["local_devices"], r
+        # the pjit step ran as ONE SPMD program: both ranks computed the
+        # same global loss over globally-sharded arrays (mean((1*2-1)^2)=1)
+        losses = {round(r["train_loss"], 6) for r in results}
+        assert losses == {1.0}, results
+        w_means = {round(r["train_w_mean"], 6) for r in results}
+        assert len(w_means) == 1, results
     finally:
         await session.close()
         for p in procs:

@@ -69,3 +69,70 @@ async def test_llm_endpoint_generates_and_heartbeats():
         status, bad = await stack.api("POST", "/endpoint/llm",
                                       json_body={"nope": 1}, timeout=60)
         assert status == 400 and "tokens" in bad["error"]
+
+
+TP_LLM_APP = """
+import os
+from tpu9.utils import force_cpu
+force_cpu(host_devices=8)     # the runner's 8 "chips" (virtual CPU mesh)
+
+def load_engine():
+    import jax
+    from tpu9.models import init_decoder
+    from tpu9.models.llama import llama_config
+    from tpu9.parallel import decoder_param_specs, mesh_for_spec, shard_params
+    from tpu9.serving import EngineConfig, InferenceEngine
+    from tpu9.types import parse_tpu_spec
+
+    # the worker handed this container a full v5e-8 host slice
+    assert os.environ.get("TPU_ACCELERATOR_TYPE") == "v5e-8", \\
+        os.environ.get("TPU_ACCELERATOR_TYPE")
+    assert len(os.environ.get("TPU_VISIBLE_CHIPS", "").split(",")) == 8
+
+    # 70B-SHAPED pjit path at toy dims: same mesh/spec/shard code as
+    # examples/04_llama70b_tp_v5e8.py, tp=8 over the host slice
+    cfg = llama_config(vocab_size=256, dim=128, n_layers=2, n_heads=8,
+                       n_kv_heads=8, head_dim=16, hidden_dim=256,
+                       max_seq_len=128)
+    mesh = mesh_for_spec(parse_tpu_spec("v5e-8"))
+    assert mesh.devices.size == 8, mesh
+    params = init_decoder(jax.random.PRNGKey(0), cfg)
+    params = shard_params(params, mesh, decoder_param_specs(params))
+    engine = InferenceEngine(params, cfg,
+                             EngineConfig(max_batch=2, max_seq_len=128,
+                                          prefill_buckets=(16, 64)))
+    engine.mesh = mesh
+    return engine
+"""
+
+
+async def test_tp8_engine_through_endpoint():
+    """Weak-#5 closure: a tensor-parallel (tp=8) engine — the 70B example's
+    exact mesh/shard path at toy dims — serves through @endpoint tpu=v5e-8
+    on a worker that hands the container the full host slice."""
+    async with LocalStack(pool_tpu_type="v5e-8") as stack:
+        await stack._worker_factory(tpu_chips=8, tpu_generation="v5e")
+        dep = await stack.deploy_endpoint(
+            "llm-tp8", {"app.py": TP_LLM_APP}, "app:load_engine",
+            config_extra={
+                "timeout_s": 240.0,
+                "extra": {"runner": "llm"},
+                "runtime": {"tpu": "v5e-8", "cpu_millicores": 500,
+                            "memory_mb": 1024},
+                "autoscaler": {"max_containers": 1}})
+        status, out = await stack.api(
+            "POST", "/endpoint/llm-tp8",
+            json_body={"tokens": [7, 2, 11], "max_new_tokens": 6},
+            timeout=240)
+        assert status == 200, out
+        assert len(out["tokens"]) == 6
+        # deterministic greedy through the sharded engine
+        status, out2 = await stack.api(
+            "POST", "/endpoint/llm-tp8",
+            json_body={"tokens": [7, 2, 11], "max_new_tokens": 6},
+            timeout=120)
+        assert out2["tokens"] == out["tokens"]
+        # the slice really was reserved for the serving container
+        workers = await stack.gateway.workers.list()
+        assert any(w.tpu_chip_count == 8 and w.tpu_free_chips == 0
+                   for w in workers), [w.to_dict() for w in workers]

@@ -55,6 +55,7 @@ class RequestBuffer:
         self.request_timeout_s = request_timeout_s
         self._queue: asyncio.Queue[BufferedRequest] = asyncio.Queue()
         self._session: Optional[aiohttp.ClientSession] = None
+        self._wake = None
         self._task: Optional[asyncio.Task] = None
         self._inflight = 0
         self._open = 0     # unresolved requests: queued + in-hand + in-flight
@@ -70,6 +71,13 @@ class RequestBuffer:
     async def start(self) -> "RequestBuffer":
         if self._session is None:
             self._session = aiohttp.ClientSession()
+        if self._wake is None:
+            # admission wakeups: token releases + containers turning RUNNING
+            # (published by ContainerRepository) — waiting is event-driven
+            # with a bounded-poll fallback, not a sleep loop
+            from ...repository import Keys
+            self._wake = self.containers.store.subscribe(
+                Keys.stub_wake(self.stub.stub_id))
         if self._task is None:
             self._task = asyncio.create_task(self._process_loop())
         return self
@@ -82,9 +90,20 @@ class RequestBuffer:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if self._wake is not None:
+            self._wake.close()
+            self._wake = None
         if self._session:
             await self._session.close()
             self._session = None
+
+    async def _wait_wake(self, timeout: float) -> None:
+        """Block until an admission signal arrives (or the fallback timeout
+        elapses — the poll guard against a lost wakeup)."""
+        if self._wake is None:
+            await asyncio.sleep(min(timeout, 0.05))
+            return
+        await self._wake.get(timeout=timeout)
 
     # -- public forwarding API -----------------------------------------------
 
@@ -136,9 +155,11 @@ class RequestBuffer:
                 continue
             target = await self._acquire_container(req.body)
             if target is None:
-                # no capacity yet — requeue and give the autoscaler a beat
-                await asyncio.sleep(0.05)
+                # no capacity: requeue, then block on the next admission
+                # signal (token release / container RUNNING) with a 250 ms
+                # fallback poll as the lost-wakeup guard
                 await self._queue.put(req)
+                await self._wait_wake(0.25)
                 continue
             container_id, address = target
             self._inflight += 1
@@ -146,15 +167,17 @@ class RequestBuffer:
 
     async def acquire(self, deadline_s: float = 30.0,
                       body: bytes = b"") -> Optional[tuple[str, str]]:
-        """Public admission: poll for a container with a concurrency token
+        """Public admission: wait for a container with a concurrency token
         until ``deadline_s`` elapses (websocket sessions and other direct
-        consumers; HTTP requests ride the buffered _process_loop)."""
+        consumers; HTTP requests ride the buffered _process_loop). Waiting
+        is driven by admission wakeups, with a bounded fallback poll."""
         deadline = time.monotonic() + deadline_s
         while time.monotonic() < deadline:
             target = await self._acquire_container(body)
             if target is not None:
                 return target
-            await asyncio.sleep(0.25)
+            await self._wait_wake(min(0.25, max(deadline
+                                                - time.monotonic(), 0.01)))
         return None
 
     async def _acquire_container(self,

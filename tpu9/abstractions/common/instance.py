@@ -173,6 +173,10 @@ class AutoscaledInstance:
         if cfg.secrets and self.secret_env_fn is not None:
             env.update(await self.secret_env_fn())
         env.update(self._runner_env())
+        # every container start roots a trace: scheduler + worker cold-start
+        # spans correlate under this id (common/trace.go analogue)
+        from ...observability import new_trace_id
+        env.setdefault("TPU9_TRACE_ID", new_trace_id())
         request = ContainerRequest(
             container_id=new_id("ct"),
             stub_id=self.stub.stub_id,

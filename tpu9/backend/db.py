@@ -454,6 +454,27 @@ class BackendDB:
             (stub_id,))
         return dict(rows[0]) if rows else None
 
+    # -- usage metering ------------------------------------------------------
+
+    async def upsert_usage(self, workspace_id: str, bucket: str, metric: str,
+                           quantity: float) -> None:
+        """Idempotent totals write (the flusher persists current bucket
+        totals, so replays converge instead of double-counting)."""
+        self._exec(
+            "INSERT INTO usage_records (workspace_id, bucket, metric, quantity, updated_at) VALUES (?,?,?,?,?) "
+            "ON CONFLICT(workspace_id, bucket, metric) DO UPDATE SET quantity=MAX(quantity, excluded.quantity), updated_at=excluded.updated_at",
+            (workspace_id, bucket, metric, quantity, now()))
+
+    async def get_usage(self, workspace_id: str,
+                        buckets: list[str]) -> list[dict]:
+        if not buckets:
+            return []
+        marks = ",".join("?" for _ in buckets)
+        rows = self._query(
+            f"SELECT bucket, metric, quantity FROM usage_records WHERE workspace_id=? AND bucket IN ({marks})",
+            (workspace_id, *buckets))
+        return [dict(r) for r in rows]
+
     # -- sandbox snapshots ---------------------------------------------------
 
     async def put_sandbox_snapshot(self, snapshot_id: str, workspace_id: str,

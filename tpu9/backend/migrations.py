@@ -188,4 +188,14 @@ MIGRATIONS: list[tuple[int, str, str]] = [
             created_at REAL NOT NULL
         );
     """),
+    (17, "usage_records", """
+        CREATE TABLE usage_records (
+            workspace_id TEXT NOT NULL,
+            bucket TEXT NOT NULL,
+            metric TEXT NOT NULL,
+            quantity REAL DEFAULT 0,
+            updated_at REAL NOT NULL,
+            PRIMARY KEY (workspace_id, bucket, metric)
+        );
+    """),
 ]

@@ -499,6 +499,37 @@ def bench_suite(suite: str, out_dir: str, quick: bool) -> None:
         raise SystemExit(1)
 
 
+@cli.command("usage")
+@click.option("--hours", default=24)
+def usage_cmd(hours: int) -> None:
+    """Metered usage for this workspace: container-seconds, chip-seconds,
+    requests per hourly bucket (reference usage_openmeter.go meters)."""
+    data = _client()._run(lambda c: c.request(
+        "GET", f"/api/v1/usage?hours={hours}"))
+    click.echo(f"{'bucket':<16}" + "".join(
+        f"{m:>20}" for m in ("container_seconds", "chip_seconds",
+                             "requests")))
+    for bucket, row in data.get("buckets", {}).items():
+        click.echo(f"{bucket:<16}" + "".join(
+            f"{row.get(m, 0):>20.1f}" for m in ("container_seconds",
+                                                "chip_seconds", "requests")))
+    totals = data.get("totals", {})
+    click.echo("totals: " + json.dumps(totals))
+
+
+@cli.command("traces")
+@click.option("--trace-id", default="")
+@click.option("--limit", default=100)
+def traces_cmd(trace_id: str, limit: int) -> None:
+    """Fleet trace spans (gateway + scheduler + worker cold starts)."""
+    q = f"?limit={limit}" + (f"&trace_id={trace_id}" if trace_id else "")
+    data = _client()._run(lambda c: c.request("GET", f"/api/v1/traces{q}"))
+    for sp in data.get("spans", []):
+        indent = "  " if sp.get("parentSpanId") else ""
+        click.echo(f"{indent}{sp['traceId'][:8]} {sp['name']:<24} "
+                   f"{sp['durationMs']:>9.2f}ms  {sp.get('status','')}")
+
+
 @cli.command("metrics")
 @click.option("--prometheus", is_flag=True)
 def metrics_cmd(prometheus: bool) -> None:

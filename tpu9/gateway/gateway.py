@@ -114,6 +114,8 @@ class Gateway:
         self.events = EventBus(self.store, sink_url=cfg.monitoring.events_http_url
                                if cfg.monitoring.events_sink == "http" else "",
                                cluster=cfg.cluster_name)
+        from ..observability import UsageService
+        self.usage = UsageService(self.store, self.backend)
         self.pool_monitor = PoolMonitor(
             self.store, pools or {},
             {p.name: p for p in cfg.pools}) if pools is not None else None
@@ -245,6 +247,8 @@ class Gateway:
         r.add_delete("/api/v1/secret/{name}", self._delete_secret)
         r.add_get("/api/v1/scheduler/stats", self._scheduler_stats)
         r.add_get("/api/v1/metrics", self._metrics)
+        r.add_get("/api/v1/usage", self._usage_report)
+        r.add_get("/api/v1/traces", self._traces)
         r.add_get("/api/v1/events", self._events)
         r.add_get("/api/v1/pools", self._pools)
         # invoke
@@ -271,6 +275,7 @@ class Gateway:
         await self.scheduler.start()
         await self.dispatcher.start()
         await self.functions.start()
+        await self.usage.start()
         if self.pool_monitor is not None:
             await self.pool_monitor.start()
         self._runner = web.AppRunner(self.app)
@@ -295,6 +300,7 @@ class Gateway:
         await self.functions.stop()
         await self.dispatcher.stop()
         await self.scheduler.stop()
+        await self.usage.stop()
         if self._proxy_session is not None and not self._proxy_session.closed:
             await self._proxy_session.close()
         if self._runner:
@@ -389,6 +395,55 @@ class Gateway:
     async def _scheduler_stats(self, request: web.Request) -> web.Response:
         self._ws(request)
         return web.json_response(self.scheduler.stats)
+
+    async def _usage_report(self, request: web.Request) -> web.Response:
+        """Per-workspace metered usage: container-seconds, chip-seconds,
+        requests (usage_openmeter.go:18 analogue, hourly buckets)."""
+        ws = self._ws(request)
+        hours = min(int(request.query.get("hours", 24)), 24 * 31)
+        return web.json_response(
+            await self.usage.query(ws.workspace_id, hours=hours))
+
+    async def _traces(self, request: web.Request) -> web.Response:
+        """Merged fleet traces: this process's span ring + rings workers
+        ship on their heartbeat (common/trace.go:12 analogue). Workspace-
+        scoped: spans are stamped with the workspace they served, and a
+        caller only sees its own."""
+        ws = self._ws(request)
+        from ..observability import tracer
+        trace_id = request.query.get("trace_id", "")
+        since = float(request.query.get("since", 0))
+        limit = min(int(request.query.get("limit", 1000)), 5000)
+
+        def visible(sp: dict) -> bool:
+            if trace_id and sp.get("traceId") != trace_id:
+                return False
+            if sp.get("endTimeUnixNano", 0) / 1e9 < since:
+                return False
+            return (sp.get("attributes", {}).get("workspace_id")
+                    == ws.workspace_id)
+
+        seen: set[str] = set()
+        spans = []
+        for sp in tracer.export(trace_id=trace_id, since=since, limit=limit):
+            if visible(sp) and sp.get("spanId") not in seen:
+                seen.add(sp.get("spanId", ""))
+                spans.append(sp)
+        for key in await self.store.keys("worker:traces:*"):
+            raw = await self.store.get(key)
+            if not raw:
+                continue
+            try:
+                for sp in json.loads(raw):
+                    # dedup by spanId: in-process topologies share one ring,
+                    # so every worker ships the same spans
+                    if visible(sp) and sp.get("spanId") not in seen:
+                        seen.add(sp.get("spanId", ""))
+                        spans.append(sp)
+            except (ValueError, TypeError):
+                continue
+        spans.sort(key=lambda s: s.get("startTimeUnixNano", 0))
+        return web.json_response({"spans": spans[:limit]})
 
     async def _metrics(self, request: web.Request) -> web.Response:
         self._ws(request)
@@ -1178,8 +1233,15 @@ class Gateway:
                     "content-length"}
         fwd_headers = [(k, v) for k, v in request.headers.items()
                        if k.lower() not in skip_req]
-        result = await self.endpoints.forward(stub, request.method, path,
-                                              fwd_headers, body)
+        from ..observability import tracer
+        with tracer.span("gateway.invoke",
+                         attrs={"stub_id": stub.stub_id,
+                                "workspace_id": stub.workspace_id,
+                                "method": request.method}) as sp:
+            result = await self.endpoints.forward(stub, request.method, path,
+                                                  fwd_headers, body)
+            sp.attrs["status"] = result.status
+        await self.usage.record_request(stub.workspace_id)
         # preserve the container's response headers (ASGI apps set their own
         # content types and custom headers, incl. duplicates like
         # Set-Cookie); drop hop-by-hop ones. content-encoding excluded: the

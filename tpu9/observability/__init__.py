@@ -1,4 +1,8 @@
 from .metrics import Metrics, metrics
 from .events import EventBus
+from .loglimit import LogLimiter
+from .trace import Span, Tracer, new_trace_id, tracer
+from .usage import UsageSampler, UsageService
 
-__all__ = ["Metrics", "metrics", "EventBus"]
+__all__ = ["Metrics", "metrics", "EventBus", "LogLimiter", "Span", "Tracer",
+           "new_trace_id", "tracer", "UsageSampler", "UsageService"]

@@ -1,0 +1,44 @@
+"""Per-container log rate limiting: a token bucket in front of the log
+stream so one runaway container can't flood the state bus.
+
+Reference analogue: the worker's log rate limiting in its ContainerLogger
+fan-out (``pkg/worker/logger.go``). Dropped lines are counted and surfaced
+as one marker line per second — silence would hide that throttling
+happened.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class LogLimiter:
+    def __init__(self, rate_per_s: float = 200.0, burst: float = 1000.0):
+        self.rate = rate_per_s
+        self.burst = burst
+        self.tokens = burst
+        self.last = time.monotonic()
+        self.dropped = 0
+        self._last_notice = 0.0
+
+    def admit(self) -> tuple[bool, int]:
+        """Returns (admit_line, dropped_to_report). A non-zero second field
+        means the caller should emit one "N lines dropped" marker covering
+        the drops since the last marker."""
+        now = time.monotonic()
+        self.tokens = min(self.burst, self.tokens + (now - self.last)
+                          * self.rate)
+        self.last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            if self.dropped and now - self._last_notice >= 1.0:
+                n, self.dropped = self.dropped, 0
+                self._last_notice = now
+                return True, n
+            return True, 0
+        self.dropped += 1
+        if now - self._last_notice >= 1.0:
+            n, self.dropped = self.dropped, 0
+            self._last_notice = now
+            return False, n
+        return False, 0

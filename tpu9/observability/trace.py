@@ -1,0 +1,104 @@
+"""Distributed tracing: spans across gateway → scheduler → worker cold
+starts, correlated by a trace id that rides the container request.
+
+Reference analogue: ``pkg/common/trace.go:12-27`` (OTEL span helpers wired
+through gateway/scheduler/worker). tpu9's redesign avoids an OTEL SDK
+dependency (zero-egress image): each process keeps a bounded ring of
+finished spans; workers ship their ring to the state bus alongside the
+metrics snapshot they already publish, and the gateway merges rings at
+query time (``/api/v1/traces``). Span records use OTLP-shaped field names
+so an exporter can forward them verbatim when an endpoint exists.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import time
+import uuid
+from typing import Any, Optional
+
+RING_CAP = 4096
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = \
+    contextvars.ContextVar("tpu9_current_span", default=None)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "start",
+                 "end", "attrs", "status")
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: str,
+                 name: str, attrs: Optional[dict] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start = time.time()
+        self.end = 0.0
+        self.attrs: dict[str, Any] = attrs or {}
+        self.status = "ok"
+
+    def to_dict(self) -> dict:
+        return {"traceId": self.trace_id, "spanId": self.span_id,
+                "parentSpanId": self.parent_id, "name": self.name,
+                "startTimeUnixNano": int(self.start * 1e9),
+                "endTimeUnixNano": int(self.end * 1e9),
+                "durationMs": round((self.end - self.start) * 1000, 3),
+                "attributes": self.attrs, "status": self.status}
+
+
+class Tracer:
+    def __init__(self, service: str = "tpu9"):
+        self.service = service
+        self.finished: collections.deque[Span] = collections.deque(
+            maxlen=RING_CAP)
+
+    @contextlib.contextmanager
+    def span(self, name: str, trace_id: str = "",
+             attrs: Optional[dict] = None):
+        """Start a span as a child of the context's current span (same
+        task/coroutine chain), or as a root of ``trace_id``."""
+        parent = _current_span.get()
+        if parent is not None and not trace_id:
+            trace_id = parent.trace_id
+        sp = Span(trace_id or new_trace_id(), uuid.uuid4().hex[:16],
+                  parent.span_id if parent else "", name, attrs)
+        sp.attrs.setdefault("service", self.service)
+        token = _current_span.set(sp)
+        try:
+            yield sp
+        except BaseException:
+            sp.status = "error"
+            raise
+        finally:
+            _current_span.reset(token)
+            sp.end = time.time()
+            self.finished.append(sp)
+
+    def current_trace_id(self) -> str:
+        sp = _current_span.get()
+        return sp.trace_id if sp else ""
+
+    def export(self, trace_id: str = "", since: float = 0.0,
+               limit: int = 1000) -> list[dict]:
+        out = []
+        for sp in reversed(self.finished):
+            if trace_id and sp.trace_id != trace_id:
+                continue
+            if sp.end < since:
+                continue
+            out.append(sp.to_dict())
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
+
+# process-wide tracer (mirrors the metrics registry pattern)
+tracer = Tracer()

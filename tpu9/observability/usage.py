@@ -1,0 +1,140 @@
+"""Usage metering: per-workspace container-seconds / chip-seconds / request
+counts, aggregated into hourly buckets.
+
+Reference analogue: ``pkg/repository/usage/usage_openmeter.go:18`` and
+``usage_prometheus.go`` — billing meters fed by worker-side usage sampling
+(``pkg/worker/usage.go``). tpu9's redesign: workers hincr hot hourly
+buckets on the state bus from the heartbeat they already run (one
+round-trip per worker per beat, not per event); the gateway serves live
+queries from the hot buckets and a flusher persists closed hours into the
+backend so usage survives restarts. TPU chips replace GPUs as the metered
+accelerator unit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+log = logging.getLogger("tpu9.observability")
+
+BUCKET_FMT = "%Y-%m-%dT%H"          # hourly buckets, UTC
+HOT_TTL_S = 3 * 3600.0              # hot buckets outlive their hour by 2h
+
+METRICS = ("container_seconds", "chip_seconds", "requests", "tasks")
+
+
+def bucket_of(ts: Optional[float] = None) -> str:
+    return time.strftime(BUCKET_FMT, time.gmtime(ts if ts is not None
+                                                 else time.time()))
+
+
+def usage_key(workspace_id: str, bucket: str) -> str:
+    return f"usage:{workspace_id}:{bucket}"
+
+
+class UsageSampler:
+    """Worker side: fold one heartbeat's dt into the hot buckets for every
+    active container (called from the existing heartbeat loop)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    async def sample(self, active: list[tuple[str, int]], dt_s: float) -> None:
+        """``active``: (workspace_id, tpu_chips) per running container."""
+        if not active or dt_s <= 0:
+            return
+        bucket = bucket_of()
+        # one hincr per (workspace, metric), not per container
+        per_ws: dict[str, dict[str, float]] = {}
+        for workspace_id, chips in active:
+            agg = per_ws.setdefault(workspace_id, {"container_seconds": 0.0,
+                                                   "chip_seconds": 0.0})
+            agg["container_seconds"] += dt_s
+            agg["chip_seconds"] += chips * dt_s
+        for workspace_id, agg in per_ws.items():
+            key = usage_key(workspace_id, bucket)
+            for metric, qty in agg.items():
+                if qty:
+                    await self.store.hincr(key, metric, qty)
+            await self.store.expire(key, HOT_TTL_S)
+
+
+class UsageService:
+    """Gateway side: live queries over hot buckets + durable flush of
+    closed hours into the backend (usage_records)."""
+
+    def __init__(self, store, backend, flush_interval_s: float = 60.0):
+        self.store = store
+        self.backend = backend
+        self.flush_interval_s = flush_interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = asyncio.Event()
+
+    async def record_request(self, workspace_id: str, n: int = 1,
+                             metric: str = "requests") -> None:
+        key = usage_key(workspace_id, bucket_of())
+        await self.store.hincr(key, metric, n)
+        await self.store.expire(key, HOT_TTL_S)
+
+    async def query(self, workspace_id: str, hours: int = 24) -> dict:
+        """Merge durable records with hot buckets for the last N hours."""
+        now = time.time()
+        buckets = [bucket_of(now - h * 3600) for h in range(hours)]
+        out: dict[str, dict[str, float]] = {}
+        durable = await self.backend.get_usage(workspace_id, buckets)
+        for row in durable:
+            out.setdefault(row["bucket"], {})[row["metric"]] = row["quantity"]
+        for bucket in buckets:
+            hot = await self.store.hgetall(usage_key(workspace_id, bucket))
+            for metric, qty in (hot or {}).items():
+                cur = out.setdefault(bucket, {})
+                # hot supersedes durable for the same bucket (the flusher
+                # writes totals, not deltas, so max() dedupes overlap)
+                cur[metric] = max(cur.get(metric, 0.0), float(qty))
+        totals: dict[str, float] = {}
+        for per in out.values():
+            for metric, qty in per.items():
+                totals[metric] = totals.get(metric, 0.0) + qty
+        return {"workspace_id": workspace_id, "hours": hours,
+                "buckets": {b: out[b] for b in sorted(out)},
+                "totals": {k: round(v, 3) for k, v in totals.items()}}
+
+    # -- durable flush -------------------------------------------------------
+
+    async def start(self) -> "UsageService":
+        self._task = asyncio.create_task(self._flush_loop())
+        return self
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        await self.flush()
+
+    async def _flush_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                await self.flush()
+            except Exception as exc:   # noqa: BLE001 — metering must not die
+                log.warning("usage flush failed: %s", exc)
+            await asyncio.sleep(self.flush_interval_s)
+
+    async def flush(self) -> int:
+        """Persist every hot bucket's current totals (idempotent upsert —
+        crash-safe; hot keys expire on their own after the hour closes)."""
+        n = 0
+        for key in await self.store.keys("usage:*"):
+            _, workspace_id, bucket = key.split(":", 2)
+            fields = await self.store.hgetall(key)
+            for metric, qty in (fields or {}).items():
+                await self.backend.upsert_usage(workspace_id, bucket, metric,
+                                                float(qty))
+                n += 1
+        return n

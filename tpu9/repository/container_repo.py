@@ -48,6 +48,12 @@ class ContainerRepository:
                                              ContainerStatus.FAILED):
             await self.store.hdel(Keys.stub_containers(state.stub_id),
                                   state.container_id)
+        elif ContainerStatus(state.status) is ContainerStatus.RUNNING:
+            # wake request buffers blocked on "no serving capacity" the
+            # moment a container comes up — admission is event-driven, not
+            # a poll loop (buffer.go's Redis-key polling redesigned)
+            await self.store.publish(Keys.stub_wake(state.stub_id),
+                                     {"event": "running"})
 
     async def refresh_ttl(self, container_id: str) -> None:
         await self.store.expire(Keys.container_state(container_id),
@@ -142,6 +148,9 @@ class ContainerRepository:
         # clamp here would race a concurrent acquire and erase its increment
         key = Keys.stub_concurrency(stub_id, container_id)
         await self.store.incr(key, -1, floor=0)
+        # a freed slot is the other admission signal buffers wait on
+        await self.store.publish(Keys.stub_wake(stub_id),
+                                 {"event": "token"})
 
     async def in_flight(self, stub_id: str, container_id: str) -> int:
         val = await self.store.get(Keys.stub_concurrency(stub_id, container_id))

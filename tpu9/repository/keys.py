@@ -60,6 +60,10 @@ class Keys:
         return f"stub:tokens:{stub_id}:{container_id}"
 
     @staticmethod
+    def stub_wake(stub_id: str) -> str:   # pubsub: admission wakeups
+        return f"stub:wake:{stub_id}"
+
+    @staticmethod
     def task_message(task_id: str) -> str:
         return f"task:msg:{task_id}"
 

@@ -41,6 +41,14 @@ class WorkerRepository:
     async def is_alive(self, worker_id: str) -> bool:
         return await self.store.exists(Keys.worker_keepalive(worker_id))
 
+    async def alive_ids(self) -> set[str]:
+        """All live worker ids in ONE store round-trip (the scheduler's
+        batch loop calls this once per batch — per-worker exists() checks
+        would be O(fleet) awaits per 50 ms tick)."""
+        prefix = Keys.worker_keepalive("")
+        keys = await self.store.keys(prefix + "*")
+        return {k[len(prefix):] for k in keys}
+
     async def get(self, worker_id: str) -> Optional[WorkerState]:
         data = await self.store.hgetall(Keys.worker_state(worker_id))
         if not data:

@@ -121,8 +121,7 @@ class Scheduler:
             return 0
         now = time.time()
         workers = await self.workers.list()
-        alive = {w.worker_id for w in workers
-                 if await self.workers.is_alive(w.worker_id)}
+        alive = await self.workers.alive_ids()
         processed = 0
         for container_id, score in popped:
             request = await self.containers.get_request(container_id)
@@ -147,6 +146,16 @@ class Scheduler:
 
     async def _schedule_one(self, request: ContainerRequest,
                             workers: list, alive: set[str]) -> None:
+        from ..observability import tracer
+        with tracer.span("scheduler.schedule",
+                         trace_id=request.env.get("TPU9_TRACE_ID", ""),
+                         attrs={"container_id": request.container_id,
+                                "workspace_id": request.workspace_id,
+                                "attempt": request.retry_count}):
+            await self._schedule_one_traced(request, workers, alive)
+
+    async def _schedule_one_traced(self, request: ContainerRequest,
+                                   workers: list, alive: set[str]) -> None:
         spec = request.tpu_spec()
         if spec is not None and spec.multi_host:
             await self._schedule_gang(request, workers, alive, spec)

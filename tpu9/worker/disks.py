@@ -24,6 +24,12 @@ from ..utils.paths import validate_path_part
 
 log = logging.getLogger("tpu9.worker")
 
+# sibling marker written next to every incarnation dir (<leaf>.diskid);
+# its absence marks a pre-upgrade dir eligible for the one-time
+# bare-name → name@disk_id migration. Sibling, not in-dir: the dir's
+# contents are the tenant's — snapshots and listings must not see it.
+_MARKER_SUFFIX = ".diskid"
+
 
 class DiskRestoreError(RuntimeError):
     """Snapshot restore failed — the container start must fail rather than
@@ -70,6 +76,14 @@ class DiskManager:
     def _lock(self, key: str) -> asyncio.Lock:
         return self._locks.setdefault(key, asyncio.Lock())
 
+    @staticmethod
+    def _write_marker(d: str, disk_id: str) -> None:
+        try:
+            with open(d + _MARKER_SUFFIX, "w") as f:
+                f.write(disk_id)
+        except OSError:
+            pass
+
     async def attach(self, workspace_id: str, name: str,
                      snapshot_id: str = "", disk_id: str = "") -> str:
         """Return the disk's local dir, restoring the latest snapshot first
@@ -79,7 +93,22 @@ class DiskManager:
         async with self._lock(d):
             if os.path.isdir(d):
                 return d
+            # one-time upgrade: a dir attached before incarnation keying
+            # lives at the bare name — rename it into this incarnation so
+            # its unsnapshotted live data carries over instead of being
+            # orphaned behind an invisible path. Only MARKER-LESS dirs
+            # migrate: post-upgrade dirs carry their incarnation id, so a
+            # stale dir from a deleted incarnation can never ride this path
+            # back to life under a recreated disk's fresh id.
+            if disk_id:
+                legacy = self.disk_dir(workspace_id, name)
+                if (os.path.isdir(legacy)
+                        and not os.path.exists(legacy + _MARKER_SUFFIX)):
+                    os.replace(legacy, d)
+                    self._write_marker(d, disk_id)
+                    return d
             os.makedirs(d, exist_ok=True)
+            self._write_marker(d, disk_id)
             if snapshot_id and self.manifest_get and self.chunk_get:
                 try:
                     blob = await self.manifest_get(snapshot_id)
@@ -133,6 +162,10 @@ class DiskManager:
                     if os.path.isdir(d):
                         await asyncio.to_thread(shutil.rmtree, d, True)
                         removed = True
+                    try:
+                        os.unlink(d + _MARKER_SUFFIX)
+                    except OSError:
+                        pass
         return removed
 
     async def snapshot(self, workspace_id: str, name: str,

@@ -80,6 +80,11 @@ class ContainerLifecycle:
         # containers being started or running, with their memory limits —
         # the OOM watcher polices this set from the moment of spawn
         self.memory_limits: dict[str, int] = {}
+        # live requests (usage metering reads workspace/chips per container)
+        self.requests: dict[str, ContainerRequest] = {}
+        # per-container log token buckets (one runaway container must not
+        # flood the state bus; reference worker logger rate limiting)
+        self._log_limiters: dict[str, "LogLimiter"] = {}
         # stop reasons decided in-process (OOM watcher, stop_container)
         # consumed by the supervisor at exit — avoids read-modify-write races
         # on the shared container state
@@ -112,6 +117,7 @@ class ContainerLifecycle:
         await self.containers.update_state(state)
         self._phase(container_id, LifecyclePhase.WORKER_RECEIVED, t0)
         self.memory_limits[container_id] = request.memory_mb
+        self.requests[container_id] = request
 
         def check_aborted() -> None:
             if container_id in self._stop_requested:
@@ -138,10 +144,22 @@ class ContainerLifecycle:
                                            assignment)
             self._phase(container_id, LifecyclePhase.SPEC_READY, t0)
 
+            from ..observability import LogLimiter
+            limiter = self._log_limiters.setdefault(container_id,
+                                                    LogLimiter())
+
             def log_cb(line: str, stream: str) -> None:
                 # invoked from the runtime's pump coroutine → loop is running
-                asyncio.get_running_loop().create_task(
-                    self.containers.append_log(container_id, line, stream))
+                admit, dropped = limiter.admit()
+                loop = asyncio.get_running_loop()
+                if dropped:
+                    loop.create_task(self.containers.append_log(
+                        container_id,
+                        f"[tpu9] log rate limited: {dropped} lines dropped",
+                        "stderr"))
+                if admit:
+                    loop.create_task(self.containers.append_log(
+                        container_id, line, stream))
 
             check_aborted()
             handle = await self.runtime.run(spec, log_cb=log_cb)
@@ -209,6 +227,8 @@ class ContainerLifecycle:
                 pass
             self.tpu.release(container_id)
             self.memory_limits.pop(container_id, None)
+            self.requests.pop(container_id, None)
+            self._log_limiters.pop(container_id, None)
             self._stop_requested.pop(container_id, None)
             self._synced_volumes.pop(container_id, None)
             state.status = ContainerStatus.FAILED.value
@@ -250,6 +270,8 @@ class ContainerLifecycle:
                                             state.stop_reason)
         self._active.pop(container_id, None)
         self.memory_limits.pop(container_id, None)
+        self.requests.pop(container_id, None)
+        self._log_limiters.pop(container_id, None)
         self._stop_requested.pop(container_id, None)
         # cross-host volumes: push container writes back to the object store
         # (last-writer-wins, like the reference's S3-FUSE semantics)
@@ -315,10 +337,13 @@ class ContainerLifecycle:
                 import zipfile
                 await asyncio.to_thread(
                     lambda: zipfile.ZipFile(archive).extractall(base))
-        if request.workdir_snapshot_id and self.sandboxes is not None:
+        if request.workdir_snapshot_id:
             # sandbox-from-snapshot: materialize the parent sandbox's working
             # tree before the entrypoint starts (raises on failure — never
-            # silently start empty)
+            # silently start empty, same contract as the disk branch below)
+            if self.sandboxes is None:
+                raise RuntimeError("worker has no sandbox agent "
+                                   "(cannot restore workdir snapshot)")
             await self.sandboxes.restore_into(base,
                                               request.workdir_snapshot_id)
         for mount in request.mounts:

@@ -35,6 +35,7 @@ from ..types import new_id
 log = logging.getLogger("tpu9.worker")
 
 OUT_STREAM_MAXLEN = 10000
+FS_INLINE_CAP = 32 * 1024 * 1024      # inline fs read/write payload cap
 # async (data, digest) -> None / (digest) -> bytes|None — chunk sink/source
 ChunkPut = Callable[[bytes, str], Awaitable[None]]
 ChunkGet = Callable[[str], Awaitable[Optional[bytes]]]
@@ -209,13 +210,17 @@ class SandboxAgent:
         if sub == "ls":
             if not os.path.isdir(full):
                 return {"error": "not a directory"}
-            out = []
-            for name in sorted(os.listdir(full)):
-                p = os.path.join(full, name)
-                st = os.lstat(p)
-                out.append({"name": name, "size": st.st_size,
-                            "is_dir": os.path.isdir(p)})
-            return {"entries": out}
+
+            def _ls() -> list[dict]:
+                out = []
+                for name in sorted(os.listdir(full)):
+                    p = os.path.join(full, name)
+                    st = os.lstat(p)
+                    out.append({"name": name, "size": st.st_size,
+                                "is_dir": os.path.isdir(p)})
+                return out
+
+            return {"entries": await asyncio.to_thread(_ls)}
         if sub == "stat":
             if not os.path.exists(full):
                 return {"error": "not found"}
@@ -223,15 +228,31 @@ class SandboxAgent:
         if sub == "read":
             if not os.path.isfile(full):
                 return {"error": "not found"}
-            if os.path.getsize(full) > 32 * 1024 * 1024:
+            if os.path.getsize(full) > FS_INLINE_CAP:
                 return {"error": "file too large for inline read (32MiB cap)"}
-            with open(full, "rb") as f:
-                return {"data": base64.b64encode(f.read()).decode()}
+
+            def _read() -> bytes:
+                with open(full, "rb") as f:
+                    return f.read()
+
+            data = await asyncio.to_thread(_read)
+            return {"data": base64.b64encode(data).decode()}
         if sub == "write":
-            os.makedirs(os.path.dirname(full), exist_ok=True)
-            data = base64.b64decode(payload.get("data", ""))
-            with open(full, "wb") as f:
-                f.write(data)
+            raw = payload.get("data", "")
+            # cap BEFORE decoding: base64 inflates 4/3, so the cheap length
+            # check bounds the decode too (an unbounded write would also
+            # stall the event loop and lapse the worker keepalive)
+            if len(raw) > FS_INLINE_CAP * 4 // 3 + 4:
+                return {"error": "file too large for inline write "
+                                 "(32MiB cap)"}
+            data = base64.b64decode(raw)
+
+            def _write() -> None:
+                os.makedirs(os.path.dirname(full), exist_ok=True)
+                with open(full, "wb") as f:
+                    f.write(data)
+
+            await asyncio.to_thread(_write)
             return {"ok": True, "size": len(data)}
         if sub == "mkdir":
             os.makedirs(full, exist_ok=True)

@@ -199,7 +199,44 @@ class Worker:
             await self.store.set(f"worker:metrics:{self.worker_id}",
                                  _json.dumps(metrics.to_dict()),
                                  ttl=self.cfg.keepalive_ttl_s * 2)
+            try:
+                await self._ship_usage_and_traces()
+            except Exception as exc:   # keepalive must survive hiccups
+                log.debug("usage/trace ship failed: %s", exc)
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    async def _ship_usage_and_traces(self) -> None:
+        """Fold this beat's container/chip seconds into the hot usage
+        buckets (usage_openmeter.go analogue) and publish the span ring so
+        the gateway can merge fleet traces (common/trace.go analogue)."""
+        import json as _json
+
+        from ..observability import UsageSampler, tracer
+        now = time.monotonic()
+        dt = now - getattr(self, "_last_usage_beat", now)
+        self._last_usage_beat = now
+        active = []
+        for container_id in self.lifecycle.active_ids():
+            req = self.lifecycle.requests.get(container_id)
+            if req is not None:
+                spec = req.tpu_spec()
+                active.append((req.workspace_id,
+                               spec.chips_per_host if spec else 0))
+        if dt > 0:
+            await UsageSampler(self.store).sample(active, dt)
+        # limit >= the ring capacity: a smaller limit would advance the
+        # ship marker past spans it silently dropped
+        from ..observability.trace import RING_CAP
+        spans = tracer.export(since=getattr(self, "_last_trace_ship", 0.0),
+                              limit=RING_CAP)
+        if spans:
+            self._last_trace_ship = max(s["endTimeUnixNano"] / 1e9
+                                        for s in spans) + 1e-6
+            key = f"worker:traces:{self.worker_id}"
+            existing = await self.store.get(key)
+            merged = (_json.loads(existing) if existing else [])[-1500:]
+            merged.extend(spans)
+            await self.store.set(key, _json.dumps(merged), ttl=3600.0)
 
     async def _police_container(self, container_id: str, limit: int,
                                 metrics) -> None:
@@ -453,9 +490,17 @@ class Worker:
                                  {"exit_code": code, "output": output[-65536:]})
 
     async def _handle_request(self, request: ContainerRequest) -> None:
+        from ..observability import tracer
         async with self._start_sem:   # start-concurrency cap (worker.go:594)
             try:
-                await self.lifecycle.run_container(request)
+                with tracer.span(
+                        "worker.cold_start",
+                        trace_id=request.env.get("TPU9_TRACE_ID", ""),
+                        attrs={"container_id": request.container_id,
+                               "stub_id": request.stub_id,
+                               "workspace_id": request.workspace_id,
+                               "worker_id": self.worker_id}):
+                    await self.lifecycle.run_container(request)
                 asyncio.create_task(self._release_on_exit(request))
             except Exception:
                 # release the capacity the scheduler reserved for this request
