@@ -137,3 +137,39 @@ async def test_corrupt_peer_data_rejected(tmp_path):
     finally:
         await client.close()
         await server_a.stop()
+
+
+class TestPrefetcher:
+    async def test_window_overlaps_fetches_and_preserves_content(self):
+        import asyncio
+        from tpu9.cache.prefetch import Prefetcher
+
+        inflight = {"now": 0, "peak": 0, "calls": 0}
+        blobs = {f"d{i}": f"blob{i}".encode() for i in range(20)}
+
+        async def fetch(digest):
+            inflight["calls"] += 1
+            inflight["now"] += 1
+            inflight["peak"] = max(inflight["peak"], inflight["now"])
+            await asyncio.sleep(0.01)
+            inflight["now"] -= 1
+            return blobs.get(digest)
+
+        pf = Prefetcher(fetch, list(blobs), window=6)
+        for digest, want in blobs.items():
+            assert await pf.get(digest) == want
+        await pf.close()
+        assert inflight["peak"] > 1, "no read-ahead overlap happened"
+        assert inflight["calls"] == len(blobs)   # each chunk fetched once
+
+    async def test_out_of_order_and_unknown_gets(self):
+        from tpu9.cache.prefetch import Prefetcher
+
+        async def fetch(d):
+            return d.encode() if d.startswith("x") else None
+
+        pf = Prefetcher(fetch, ["x1", "x2", "x3"], window=2)
+        assert await pf.get("x3") == b"x3"      # out of order: on demand
+        assert await pf.get("x1") == b"x1"
+        assert await pf.get("nope") is None     # not in order list at all
+        await pf.close()

@@ -42,10 +42,13 @@ class PodService:
         self.runner_env = runner_env if runner_env is not None else {}
 
     async def create(self, stub: Stub, name: str = "",
-                     from_snapshot: str = "") -> dict:
+                     from_snapshot: str = "",
+                     from_criu_snapshot: str = "") -> dict:
         """Run one pod container; returns its id (address resolves once
         RUNNING). ``from_snapshot`` seeds the workdir from a sandbox
-        snapshot (sandbox.py:916-equivalent restore)."""
+        snapshot (sandbox.py:916-equivalent restore);
+        ``from_criu_snapshot`` boots the container as a process-tree
+        restore (criu.go:429 analogue, CPU containers only)."""
         cfg = stub.config
         from .common.secrets import stub_secret_env
         # secrets lowest precedence — stub env must win name clashes
@@ -74,6 +77,7 @@ class PodService:
             ports=list(cfg.ports),
             mounts=volume_mounts(cfg),
             workdir_snapshot_id=from_snapshot,
+            criu_snapshot_id=from_criu_snapshot,
         )
         if cfg.disks and getattr(self, "disks", None) is not None:
             # latest snapshot + live-holder affinity (durable_disk placement)

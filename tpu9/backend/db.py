@@ -479,10 +479,11 @@ class BackendDB:
 
     async def put_sandbox_snapshot(self, snapshot_id: str, workspace_id: str,
                                    container_id: str, manifest: str,
-                                   size: int) -> None:
+                                   size: int, kind: str = "workdir") -> None:
         self._exec(
-            "INSERT INTO sandbox_snapshots (snapshot_id, workspace_id, container_id, manifest, size, created_at) VALUES (?,?,?,?,?,?)",
-            (snapshot_id, workspace_id, container_id, manifest, size, now()))
+            "INSERT INTO sandbox_snapshots (snapshot_id, workspace_id, container_id, manifest, size, kind, created_at) VALUES (?,?,?,?,?,?,?)",
+            (snapshot_id, workspace_id, container_id, manifest, size, kind,
+             now()))
 
     async def get_sandbox_snapshot(self, snapshot_id: str) -> Optional[dict]:
         rows = self._query(
@@ -492,7 +493,7 @@ class BackendDB:
 
     async def list_sandbox_snapshots(self, workspace_id: str) -> list[dict]:
         rows = self._query(
-            "SELECT snapshot_id, container_id, size, created_at FROM sandbox_snapshots WHERE workspace_id=? ORDER BY created_at DESC",
+            "SELECT snapshot_id, container_id, size, kind, created_at FROM sandbox_snapshots WHERE workspace_id=? ORDER BY created_at DESC",
             (workspace_id,))
         return [dict(r) for r in rows]
 

@@ -198,4 +198,7 @@ MIGRATIONS: list[tuple[int, str, str]] = [
             PRIMARY KEY (workspace_id, bucket, metric)
         );
     """),
+    (18, "sandbox_snapshot_kind", """
+        ALTER TABLE sandbox_snapshots ADD COLUMN kind TEXT DEFAULT 'workdir';
+    """),
 ]

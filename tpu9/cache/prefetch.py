@@ -1,0 +1,78 @@
+"""Sliding-window prefetcher for sequential chunk streams.
+
+Reference analogue: ``pkg/cache/prefetcher.go:49`` — read-ahead so a
+consumer walking chunks in order (manifest materialization: disk/sandbox
+snapshot restores, image pulls) overlaps fetch latency with consumption
+instead of paying one round-trip per chunk serially.
+
+Works over ANY async fetch function — the cache client, the gateway chunk
+HTTP hooks workers use, or a GCS source — because the restore paths are
+hook-injected and don't all go through ``CacheClient``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional, Sequence
+
+Fetch = Callable[[str], Awaitable[Optional[bytes]]]
+
+
+class Prefetcher:
+    """Feed it the ordered digest list once; call ``get`` in (roughly) that
+    order. A window of background fetches runs ahead of the consumer;
+    out-of-order gets still work (they just fetch on demand)."""
+
+    def __init__(self, fetch: Fetch, digests: Sequence[str],
+                 window: int = 8):
+        self.fetch = fetch
+        self.order = list(digests)
+        self.window = max(window, 1)
+        self._tasks: dict[str, asyncio.Task] = {}
+        self._done: set[str] = set()   # consumed — never re-scheduled
+        self._next = 0          # first order-index not yet scheduled
+
+    def _schedule_ahead(self) -> None:
+        while (self._next < len(self.order)
+               and len(self._tasks) < self.window):
+            digest = self.order[self._next]
+            self._next += 1
+            if digest not in self._tasks and digest not in self._done:
+                self._tasks[digest] = asyncio.ensure_future(
+                    self.fetch(digest))
+
+    async def get(self, digest: str) -> Optional[bytes]:
+        self._schedule_ahead()
+        self._done.add(digest)   # out-of-order gets must not refetch later
+        task = self._tasks.pop(digest, None)
+        if task is None:
+            data = await self.fetch(digest)
+        else:
+            data = await task
+        self._schedule_ahead()
+        return data
+
+    async def close(self) -> None:
+        for task in self._tasks.values():
+            task.cancel()
+        await asyncio.gather(*self._tasks.values(), return_exceptions=True)
+        self._tasks.clear()
+
+
+def threadsafe_get(prefetcher: Prefetcher, loop: asyncio.AbstractEventLoop):
+    """Adapter for ``materialize`` running in a worker thread: a sync
+    ``get_chunk`` that drives the prefetcher on the event loop."""
+    def get_chunk(digest: str) -> Optional[bytes]:
+        return asyncio.run_coroutine_threadsafe(
+            prefetcher.get(digest), loop).result()
+    return get_chunk
+
+
+def threadsafe_put(chunk_put, loop: asyncio.AbstractEventLoop):
+    """Write-side twin of ``threadsafe_get``: a sync ``put_chunk`` for
+    ``snapshot_dir`` running in a worker thread, driving an async chunk
+    sink on the event loop (shared by disk/sandbox/criu snapshots)."""
+    def put_chunk(data: bytes, digest: str) -> None:
+        asyncio.run_coroutine_threadsafe(
+            chunk_put(data, digest), loop).result()
+    return put_chunk

@@ -724,6 +724,7 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
 
         disks = None
         sandboxes = None
+        criu = None
         if gateway_url and worker_token:
             from ..worker.disks import DiskManager
 
@@ -766,10 +767,11 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
             from ..worker.sandbox import SandboxAgent
 
             async def sbxsnap_put(snapshot_id, workspace_id, container_id,
-                                  manifest_json, size) -> None:
+                                  manifest_json, size,
+                                  kind: str = "workdir") -> None:
                 async with session.post(
                         f"{gateway_url}/rpc/internal/sbxsnap/{workspace_id}/"
-                        f"{container_id}/{snapshot_id}",
+                        f"{container_id}/{snapshot_id}?kind={kind}",
                         data=manifest_json) as resp:
                     if resp.status != 200:
                         raise RuntimeError(
@@ -788,6 +790,13 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                                      snap_put=sbxsnap_put,
                                      snap_get=sbxsnap_get)
 
+            from ..worker.criu import CriuManager
+            criu = CriuManager(
+                os.path.join(cfg.worker.checkpoint_dir, "criu"),
+                criu_bin=os.environ.get("TPU9_CRIU_BIN", "criu"),
+                chunk_put=disk_chunk_put, chunk_get=disk_chunk_get,
+                snap_put=sbxsnap_put, snap_get=sbxsnap_get)
+
         from ..types import new_id
         if sandboxes is None:
             # no gateway sink: process manager + fs API still work,
@@ -802,7 +811,7 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                    slice_host_rank=slice_rank, slice_host_count=slice_hosts,
                    cache=cache, object_resolver=object_resolver,
                    volume_sync=volume_sync, volume_push=volume_push,
-                   disks=disks, sandboxes=sandboxes)
+                   disks=disks, sandboxes=sandboxes, criu=criu)
         await w.start()
         click.echo(f"worker {w.worker_id} joined (pool={pool}, "
                    f"chips={w.tpu.chip_count})")

@@ -174,6 +174,8 @@ class Gateway:
         r.add_post("/rpc/pod/{container_id}/fs", self._rpc_sbx_fs)
         r.add_post("/rpc/pod/{container_id}/snapshot",
                    self._rpc_sbx_snapshot)
+        r.add_post("/rpc/pod/{container_id}/criu-checkpoint",
+                   self._rpc_criu_checkpoint)
         r.add_get("/rpc/pod/snapshots", self._rpc_sbx_snapshots)
         r.add_route("*", "/pod/{container_id}/{tail:.*}", self._pod_proxy)
         # primitives
@@ -703,14 +705,25 @@ class Gateway:
         data = await request.json()
         stub = await self._stub_for(request, data["stub_id"])
         from_snapshot = data.get("from_snapshot", "")
-        if from_snapshot:
-            # snapshots are workspace-scoped: a foreign id must 404
-            snap = await self.backend.get_sandbox_snapshot(from_snapshot)
-            if snap is None or snap["workspace_id"] != stub.workspace_id:
-                return web.json_response({"error": "snapshot not found"},
-                                         status=404)
+        from_criu = data.get("from_criu_snapshot", "")
+        for snap_id, want_kind in ((from_snapshot, "workdir"),
+                                   (from_criu, "criu")):
+            if snap_id:
+                # snapshots are workspace-scoped (foreign ids 404) AND
+                # kind-checked: feeding a workdir snapshot to criu restore
+                # (or CRIU images to a working tree) must fail loudly here
+                snap = await self.backend.get_sandbox_snapshot(snap_id)
+                if snap is None or snap["workspace_id"] != stub.workspace_id:
+                    return web.json_response({"error": "snapshot not found"},
+                                             status=404)
+                if snap.get("kind", "workdir") != want_kind:
+                    return web.json_response(
+                        {"error": f"snapshot {snap_id} is "
+                                  f"{snap.get('kind')!r}, not {want_kind!r}"},
+                        status=400)
         out = await self.pods.create(stub, name=data.get("name", ""),
-                                     from_snapshot=from_snapshot)
+                                     from_snapshot=from_snapshot,
+                                     from_criu_snapshot=from_criu)
         if data.get("wait", True):
             address = await self.pods.wait_running(
                 out["container_id"],
@@ -800,6 +813,15 @@ class Gateway:
         out = await self.pods.sbx(state.container_id, {
             "op": "snapshot", "workspace_id": state.workspace_id},
             timeout=120.0)
+        return web.json_response(out)
+
+    async def _rpc_criu_checkpoint(self, request: web.Request) -> web.Response:
+        """CPU process-tree checkpoint (criu.go:668 analogue); restore by
+        creating a pod with from_criu_snapshot."""
+        state = await self._pod_container_for(request)
+        out = await self.pods.sbx(state.container_id, {
+            "op": "criu_checkpoint", "workspace_id": state.workspace_id},
+            timeout=300.0)
         return web.json_response(out)
 
     async def _rpc_sbx_snapshots(self, request: web.Request) -> web.Response:
@@ -1490,10 +1512,15 @@ class Gateway:
         except Exception as exc:   # noqa: BLE001
             return web.json_response({"error": f"bad manifest: {exc}"},
                                      status=400)
+        kind = request.query.get("kind", "workdir")
+        if kind not in ("workdir", "criu"):
+            return web.json_response({"error": f"bad kind {kind!r}"},
+                                     status=400)
         await self.backend.put_sandbox_snapshot(
             request.match_info["snapshot_id"],
             request.match_info["workspace_id"],
-            request.match_info["container_id"], blob, manifest.total_bytes)
+            request.match_info["container_id"], blob, manifest.total_bytes,
+            kind=kind)
         return web.json_response({"ok": True})
 
     async def _internal_sbxsnap_get(self, request: web.Request) -> web.Response:

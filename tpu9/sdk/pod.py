@@ -186,9 +186,11 @@ class Sandbox(Pod):
 
     stub_type = "sandbox"
 
-    def __init__(self, *args, from_snapshot: str = "", **kwargs):
+    def __init__(self, *args, from_snapshot: str = "",
+                 from_criu_snapshot: str = "", **kwargs):
         super().__init__(*args, **kwargs)
         self.from_snapshot = from_snapshot
+        self.from_criu_snapshot = from_criu_snapshot
         self.fs = SandboxFS(self)
 
     def _rpc(self, method: str, tail: str, json_body=None) -> dict:
@@ -210,6 +212,7 @@ class Sandbox(Pod):
                      timeout: float) -> dict:
         body = super()._create_body(stub_id, wait, timeout)
         body["from_snapshot"] = self.from_snapshot
+        body["from_criu_snapshot"] = self.from_criu_snapshot
         return body
 
     def create(self, wait: bool = True, timeout: float = 60.0) -> "Sandbox":
@@ -236,6 +239,14 @@ class Sandbox(Pod):
         out = self._rpc("POST", "/snapshot")
         if out.get("error"):
             raise RuntimeError(f"snapshot failed: {out['error']}")
+        return out["snapshot_id"]
+
+    def criu_checkpoint(self) -> str:
+        """Process-tree checkpoint (CPU sandboxes; requires criu on the
+        worker). Restore with ``Sandbox(from_criu_snapshot=<id>)``."""
+        out = self._rpc("POST", "/criu-checkpoint")
+        if out.get("error"):
+            raise RuntimeError(f"criu checkpoint failed: {out['error']}")
         return out["snapshot_id"]
 
     def terminate(self) -> bool:

@@ -152,9 +152,11 @@ class LocalStack:
         from ..worker.sandbox import SandboxAgent
 
         async def sbxsnap_put(snapshot_id, workspace_id, container_id,
-                              manifest_json, size) -> None:
+                              manifest_json, size,
+                              kind: str = "workdir") -> None:
             await self.backend.put_sandbox_snapshot(
-                snapshot_id, workspace_id, container_id, manifest_json, size)
+                snapshot_id, workspace_id, container_id, manifest_json,
+                size, kind=kind)
 
         async def sbxsnap_get(snapshot_id: str):
             snap = await self.backend.get_sandbox_snapshot(snapshot_id)
@@ -164,13 +166,20 @@ class LocalStack:
                                  chunk_put=disk_chunk_put,
                                  chunk_get=disk_chunk_get,
                                  snap_put=sbxsnap_put, snap_get=sbxsnap_get)
+
+        from ..worker.criu import CriuManager
+        criu = CriuManager(
+            os.path.join(self.tmp.name, f"criu-{len(self.workers)}"),
+            criu_bin=os.environ.get("TPU9_CRIU_BIN", "criu"),
+            chunk_put=disk_chunk_put, chunk_get=disk_chunk_get,
+            snap_put=sbxsnap_put, snap_get=sbxsnap_get)
         worker = Worker(
             self.store, runtime, cfg=self.cfg.worker, pool=pool,
             cpu_millicores=16000, memory_mb=32768,   # virtual capacity: these
             # workers time-share the host the way k8s test nodes do
             tpu_generation=tpu_generation, cache=cache,
             checkpoints=checkpoints, disks=disks, sandboxes=sandboxes,
-            object_resolver=self._resolve_object, **slice_kw)
+            criu=criu, object_resolver=self._resolve_object, **slice_kw)
         await worker.start()
         self.workers.append(worker)
         return worker

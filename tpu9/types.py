@@ -383,6 +383,9 @@ class ContainerRequest(_Serializable):
     # sandbox-from-snapshot: materialize this sandbox snapshot's working
     # tree into the workdir before the entrypoint starts
     workdir_snapshot_id: str = ""
+    # CPU-container process restore: materialize this CRIU dump and boot
+    # the container as a foreground `criu restore` (criu.go:429 analogue)
+    criu_snapshot_id: str = ""
     # durable disks (durable_disk.go analogue): latest snapshot per disk
     # name (restore source on a fresh worker) + preferred worker holding
     # the live disk dir (scheduler affinity)

@@ -115,16 +115,20 @@ class DiskManager:
                     if blob:
                         manifest = ImageManifest.from_json(blob)
                         # chunk fetches stream on demand from inside the
-                        # materialize thread — restore memory stays O(chunk),
-                        # not O(disk)
+                        # materialize thread, with a read-ahead window
+                        # overlapping fetch latency (prefetcher.go:49) —
+                        # restore memory stays O(window), not O(disk)
+                        from ..cache.prefetch import (Prefetcher,
+                                                      threadsafe_get)
                         loop = asyncio.get_running_loop()
-
-                        def get_chunk(digest: str) -> Optional[bytes]:
-                            return asyncio.run_coroutine_threadsafe(
-                                self.chunk_get(digest), loop).result()
-
-                        await asyncio.to_thread(materialize, manifest, d,
-                                                get_chunk, None)
+                        pf = Prefetcher(self.chunk_get,
+                                        list(manifest.all_chunks()))
+                        try:
+                            await asyncio.to_thread(
+                                materialize, manifest, d,
+                                threadsafe_get(pf, loop), None)
+                        finally:
+                            await pf.close()
                         log.info("disk %s/%s restored from %s",
                                  workspace_id, name, snapshot_id)
                 except Exception as exc:
@@ -181,14 +185,11 @@ class DiskManager:
             snapshot_id = new_id("dsnap")
             # uploads stream from inside the walking thread — snapshot
             # memory stays O(chunk) whatever the disk size
+            from ..cache.prefetch import threadsafe_put
             loop = asyncio.get_running_loop()
-
-            def put_chunk(data: bytes, digest: str) -> None:
-                asyncio.run_coroutine_threadsafe(
-                    self.chunk_put(data, digest), loop).result()
-
-            manifest = await asyncio.to_thread(snapshot_dir, d,
-                                               4 * 1024 * 1024, put_chunk)
+            manifest = await asyncio.to_thread(
+                snapshot_dir, d, 4 * 1024 * 1024,
+                threadsafe_put(self.chunk_put, loop))
             manifest.image_id = snapshot_id
             await self.manifest_put(workspace_id, name, snapshot_id,
                                     manifest.to_json(),

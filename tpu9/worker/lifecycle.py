@@ -71,6 +71,8 @@ class ContainerLifecycle:
         self.disk_attached = None
         # sandbox agent (set by the Worker): workdir snapshot restores
         self.sandboxes = None
+        # CRIU manager (set by the Worker): CPU-process checkpoint/restore
+        self.criu = None
         # container -> [(workspace_id, volume_name, local_dir)] to push back
         self._synced_volumes: dict[str, list[tuple[str, str, str]]] = {}
         self.checkpoints = checkpoints   # Optional[CheckpointManager]
@@ -142,6 +144,31 @@ class ContainerLifecycle:
             port = request.ports[0] if request.ports else free_port()
             spec = self._spec_from_request(request, rootfs, workdir, port,
                                            assignment)
+            if request.criu_snapshot_id:
+                # CPU-container process restore: boot as a FOREGROUND criu
+                # restore — criu parents the resurrected tree, so the
+                # runtime supervises it like any entrypoint (criu.go:429).
+                # Process-runtime only: rootfs-isolated runtimes would need
+                # criu + the dump dir INSIDE the container (same gating
+                # rationale as the vcache host-path injection).
+                if self.criu is None:
+                    raise RuntimeError("worker has no criu manager "
+                                       "(cannot restore process snapshot)")
+                if self.runtime.name != "process":
+                    raise RuntimeError(
+                        f"criu restore requires the process runtime "
+                        f"(got {self.runtime.name!r})")
+                dump_dir = await self.criu.materialize_into(
+                    container_id, request.criu_snapshot_id)
+                spec.entrypoint = self.criu.restore_entrypoint(dump_dir)
+                # the resurrected sockets live on the CHECKPOINTED port —
+                # readvertise it instead of the fresh allocation
+                restored_port = self.criu.restored_port(dump_dir)
+                if restored_port:
+                    port = restored_port
+                    spec.env["TPU9_PORT"] = str(port)
+                self._phase(container_id,
+                            LifecyclePhase.CHECKPOINT_RESTORED, t0)
             self._phase(container_id, LifecyclePhase.SPEC_READY, t0)
 
             from ..observability import LogLimiter

@@ -279,14 +279,11 @@ class SandboxAgent:
         if not root or not os.path.isdir(root):
             return {"error": "container has no filesystem root"}
         snapshot_id = new_id("sbxsnap")
+        from ..cache.prefetch import threadsafe_put
         loop = asyncio.get_running_loop()
-
-        def put_chunk(data: bytes, digest: str) -> None:
-            asyncio.run_coroutine_threadsafe(
-                self.chunk_put(data, digest), loop).result()
-
-        manifest = await asyncio.to_thread(snapshot_dir, root,
-                                           4 * 1024 * 1024, put_chunk)
+        manifest = await asyncio.to_thread(
+            snapshot_dir, root, 4 * 1024 * 1024,
+            threadsafe_put(self.chunk_put, loop))
         manifest.image_id = snapshot_id
         await self.snap_put(snapshot_id, workspace_id, container_id,
                             manifest.to_json(), manifest.total_bytes)
@@ -306,11 +303,12 @@ class SandboxAgent:
         if not blob:
             raise RuntimeError(f"sandbox snapshot {snapshot_id} not found")
         manifest = ImageManifest.from_json(blob)
+        # read-ahead window over the ordered chunk stream (prefetcher.go:49)
+        from ..cache.prefetch import Prefetcher, threadsafe_get
         loop = asyncio.get_running_loop()
-
-        def get_chunk(digest: str) -> Optional[bytes]:
-            return asyncio.run_coroutine_threadsafe(
-                self.chunk_get(digest), loop).result()
-
-        await asyncio.to_thread(materialize, manifest, workdir, get_chunk,
-                                None)
+        pf = Prefetcher(self.chunk_get, list(manifest.all_chunks()))
+        try:
+            await asyncio.to_thread(materialize, manifest, workdir,
+                                    threadsafe_get(pf, loop), None)
+        finally:
+            await pf.close()

@@ -61,7 +61,7 @@ class Worker:
                  object_resolver=None, image_resolver=None,
                  volume_sync=None, volume_push=None,
                  cache=None, checkpoints=None, disks=None,
-                 sandboxes=None, phase_cb=None) -> None:
+                 sandboxes=None, criu=None, phase_cb=None) -> None:
         self.cfg = cfg or WorkerConfig()
         self.worker_id = worker_id or new_id("worker")
         self.pool = pool
@@ -87,6 +87,8 @@ class Worker:
         self._attached_disks: set[tuple[str, str]] = set()
         self.sandboxes = sandboxes      # Optional[SandboxAgent]
         self.lifecycle.sandboxes = sandboxes
+        self.criu = criu                # Optional[CriuManager]
+        self.lifecycle.criu = criu
         self.slice_id = slice_id
         self.slice_topology = slice_topology
         self.slice_host_rank = slice_host_rank
@@ -474,11 +476,47 @@ class Worker:
             sub.close()
 
     async def _handle_sbx(self, payload: dict) -> None:
-        if self.sandboxes is None:
+        if payload.get("op") == "criu_checkpoint":
+            out = await self._criu_checkpoint(payload)
+        elif self.sandboxes is None:
             out = {"error": "worker has no sandbox agent"}
         else:
             out = await self.sandboxes.handle(payload)
         await self.store.publish(payload.get("reply", ""), out)
+
+    async def _criu_checkpoint(self, payload: dict) -> dict:
+        """Process-tree checkpoint of a CPU container (criu.go:668's
+        createCheckpoint): dump with --leave-running and chunk the image
+        dir into the snapshot store."""
+        if self.criu is None or not await self.criu.available():
+            return {"error": "criu unavailable on this worker"}
+        container_id = payload["container_id"]
+        req = self.lifecycle.requests.get(container_id)
+        if req is None:
+            # fail CLOSED: without the request we can't prove the container
+            # is CPU-only, and CRIU'ing a PJRT client yields garbage
+            return {"error": "container request unknown (cannot verify "
+                             "CPU-only); retry while it is running"}
+        if req.tpu_spec() is not None:
+            return {"error": "criu checkpoint is CPU-only "
+                             "(TPU state checkpoints at the JAX level)"}
+        handle = await self.runtime.state(container_id)
+        if handle is None or not handle.pid or handle.exit_code is not None:
+            return {"error": "container not running"}
+        state = await self.containers.get_state(container_id)
+        port = 0
+        if state is not None and state.address:
+            try:
+                port = int(state.address.rsplit(":", 1)[1])
+            except (ValueError, IndexError):
+                port = 0
+        try:
+            snapshot_id = await self.criu.checkpoint(
+                container_id, handle.pid, payload.get("workspace_id", ""),
+                port=port)
+            return {"snapshot_id": snapshot_id}
+        except Exception as exc:   # noqa: BLE001 — reply, don't crash
+            return {"error": f"{type(exc).__name__}: {exc}"}
 
     async def _handle_exec(self, payload: dict) -> None:
         try:
