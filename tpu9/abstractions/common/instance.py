@@ -136,7 +136,15 @@ class AutoscaledInstance:
             if now < self._breaker_until and not any_running:
                 return
             for _ in range(desired - current):
-                cid = await self.start_container()
+                from ...scheduler.quota import QuotaExceeded
+                try:
+                    cid = await self.start_container()
+                except QuotaExceeded as exc:
+                    # over the workspace cap: stop asking this pass — the
+                    # reconciler retries as in-flight containers finish
+                    log.info("stub %s scale-up capped: %s",
+                             self.stub.stub_id, exc)
+                    break
                 self._recent_starts.append((now, cid))
         elif desired < current:
             # stop not-yet-started containers first, then the newest RUNNING

@@ -101,7 +101,8 @@ class FunctionService:
                      kwargs: dict[str, Any],
                      policy: Optional[TaskPolicy] = None) -> TaskMessage:
         tp = policy or TaskPolicy(timeout_s=stub.config.timeout_s or 3600.0,
-                                  max_retries=stub.config.retries)
+                                  max_retries=stub.config.retries,
+                                  callback_url=stub.config.callback_url)
         msg = await self.dispatcher.send(EXECUTOR, stub.stub_id,
                                          stub.workspace_id, args, kwargs, tp,
                                          enqueue=False)

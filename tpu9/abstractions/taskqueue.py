@@ -79,7 +79,8 @@ class TaskQueueService:
                   policy: Optional[TaskPolicy] = None) -> TaskMessage:
         await self.get_or_create_instance(stub)
         tp = policy or TaskPolicy(timeout_s=stub.config.timeout_s or 3600.0,
-                                  max_retries=stub.config.retries)
+                                  max_retries=stub.config.retries,
+                                  callback_url=stub.config.callback_url)
         return await self.dispatcher.send(EXECUTOR, stub.stub_id,
                                           stub.workspace_id, args, kwargs, tp)
 

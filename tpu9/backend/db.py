@@ -161,6 +161,10 @@ class BackendDB:
         rows = self._query("SELECT * FROM apps WHERE workspace_id=?", (workspace_id,))
         return [dict(r) for r in rows]
 
+    async def delete_app(self, app_id: str) -> bool:
+        cur = self._exec("DELETE FROM apps WHERE app_id=?", (app_id,))
+        return cur.rowcount > 0
+
     # -- objects (synced code archives) --------------------------------------
 
     async def create_object(self, workspace_id: str, obj_hash: str, size: int,
@@ -453,6 +457,29 @@ class BackendDB:
             "SELECT * FROM checkpoints WHERE stub_id=? AND status='available' ORDER BY created_at DESC LIMIT 1",
             (stub_id,))
         return dict(rows[0]) if rows else None
+
+    # -- concurrency limits --------------------------------------------------
+
+    async def set_concurrency_limit(self, workspace_id: str,
+                                    tpu_chip_limit: int = 0,
+                                    cpu_millicore_limit: int = 0) -> None:
+        self._exec(
+            "INSERT INTO concurrency_limits (workspace_id, tpu_chip_limit, cpu_millicore_limit, updated_at) VALUES (?,?,?,?) "
+            "ON CONFLICT(workspace_id) DO UPDATE SET tpu_chip_limit=excluded.tpu_chip_limit, cpu_millicore_limit=excluded.cpu_millicore_limit, updated_at=excluded.updated_at",
+            (workspace_id, tpu_chip_limit, cpu_millicore_limit, now()))
+
+    async def get_concurrency_limit(self,
+                                    workspace_id: str) -> Optional[dict]:
+        rows = self._query(
+            "SELECT * FROM concurrency_limits WHERE workspace_id=?",
+            (workspace_id,))
+        return dict(rows[0]) if rows else None
+
+    async def delete_concurrency_limit(self, workspace_id: str) -> bool:
+        cur = self._exec(
+            "DELETE FROM concurrency_limits WHERE workspace_id=?",
+            (workspace_id,))
+        return cur.rowcount > 0
 
     # -- usage metering ------------------------------------------------------
 

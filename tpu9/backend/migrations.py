@@ -201,4 +201,12 @@ MIGRATIONS: list[tuple[int, str, str]] = [
     (18, "sandbox_snapshot_kind", """
         ALTER TABLE sandbox_snapshots ADD COLUMN kind TEXT DEFAULT 'workdir';
     """),
+    (19, "concurrency_limits", """
+        CREATE TABLE concurrency_limits (
+            workspace_id TEXT PRIMARY KEY,
+            tpu_chip_limit INTEGER DEFAULT 0,
+            cpu_millicore_limit INTEGER DEFAULT 0,
+            updated_at REAL NOT NULL
+        );
+    """),
 ]

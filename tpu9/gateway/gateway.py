@@ -56,7 +56,10 @@ class Gateway:
         self.store = store or MemoryStore()
         self.backend = backend or BackendDB(
             cfg.database.path, secret_key=cfg.database.secret_key)
-        self.scheduler = Scheduler(self.store, cfg.scheduler, pools=pools or {})
+        from ..scheduler.quota import QuotaService
+        self.quota = QuotaService(self.store, self.backend)
+        self.scheduler = Scheduler(self.store, cfg.scheduler,
+                                   pools=pools or {}, quota=self.quota)
         self.workers = WorkerRepository(self.store, cfg.worker.keepalive_ttl_s)
         self.containers = ContainerRepository(self.store)
         self.tasks = TaskRepository(self.store)
@@ -132,7 +135,8 @@ class Gateway:
     # ------------------------------------------------------------------
 
     def _build_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._auth_middleware],
+        app = web.Application(middlewares=[self._quota_middleware,
+                                           self._auth_middleware],
                               client_max_size=512 * 1024 * 1024)
         r = app.router
         r.add_get("/health", self._health)
@@ -251,6 +255,16 @@ class Gateway:
         r.add_get("/api/v1/metrics", self._metrics)
         r.add_get("/api/v1/usage", self._usage_report)
         r.add_get("/api/v1/traces", self._traces)
+        # per-workspace concurrency quotas (reference concurrencylimit.go);
+        # reads are self-service, writes are operator-only
+        r.add_get("/api/v1/concurrency-limit", self._get_concurrency_limit)
+        r.add_post("/api/v1/concurrency-limit/{workspace_id}",
+                   self._set_concurrency_limit)
+        r.add_delete("/api/v1/concurrency-limit/{workspace_id}",
+                     self._delete_concurrency_limit)
+        # apps: deployment grouping (reference /api/v1/app group)
+        r.add_get("/api/v1/app", self._list_apps)
+        r.add_delete("/api/v1/app/{app_id}", self._delete_app)
         r.add_get("/api/v1/events", self._events)
         r.add_get("/api/v1/pools", self._pools)
         # invoke
@@ -347,6 +361,16 @@ class Gateway:
                 await self.endpoints.get_or_create_instance(stub)
             elif stub.stub_type == StubType.TASK_QUEUE.value:
                 await self.taskqueues.get_or_create_instance(stub)
+
+    @web.middleware
+    async def _quota_middleware(self, request: web.Request, handler):
+        """Concurrency-quota rejections surface as 429 wherever the request
+        originated (pod create, task submit, deploy scale-up...)."""
+        from ..scheduler.quota import QuotaExceeded
+        try:
+            return await handler(request)
+        except QuotaExceeded as exc:
+            return web.json_response({"error": str(exc)}, status=429)
 
     # -- auth ----------------------------------------------------------------
 
@@ -486,6 +510,15 @@ class Gateway:
     async def _rpc_get_or_create_stub(self, request: web.Request) -> web.Response:
         ws = self._ws(request)
         data = await request.json()
+        try:
+            StubType(data.get("stub_type", ""))
+        except ValueError:
+            # fail loudly: an unknown type would silently boot the default
+            # runner and e.g. never poll a task queue
+            return web.json_response(
+                {"error": f"unknown stub_type {data.get('stub_type')!r} "
+                          f"(valid: {[t.value for t in StubType]})"},
+                status=400)
         config = StubConfig.from_dict(data.get("config", {}))
         stub = await self.backend.get_or_create_stub(
             workspace_id=ws.workspace_id,
@@ -1340,6 +1373,77 @@ class Gateway:
         await self.backend.set_deployment_active(dep.deployment_id, False)
         await self.endpoints.drain_stub(dep.stub_id)
         return web.json_response({"ok": True})
+
+    # -- concurrency limits + apps -------------------------------------------
+
+    def _require_operator(self, request: web.Request):
+        """Quota writes are operator actions (the reference gates them on
+        cluster-admin tokens); tpu9's operator is the default workspace."""
+        ws = self._ws(request)
+        if ws.workspace_id != self.default_workspace.workspace_id:
+            raise web.HTTPForbidden(
+                text=json.dumps({"error": "operator token required"}),
+                content_type="application/json")
+        return ws
+
+    async def _get_concurrency_limit(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        limit = await self.backend.get_concurrency_limit(ws.workspace_id)
+        cpu, chips = await self.quota.in_use(ws.workspace_id)
+        return web.json_response({
+            "limit": limit, "in_use": {"cpu_millicores": cpu,
+                                       "tpu_chips": chips}})
+
+    async def _set_concurrency_limit(self, request: web.Request) -> web.Response:
+        self._require_operator(request)
+        data = await request.json()
+        await self.backend.set_concurrency_limit(
+            request.match_info["workspace_id"],
+            tpu_chip_limit=int(data.get("tpu_chip_limit", 0)),
+            cpu_millicore_limit=int(data.get("cpu_millicore_limit", 0)))
+        return web.json_response({"ok": True})
+
+    async def _delete_concurrency_limit(self, request: web.Request) -> web.Response:
+        self._require_operator(request)
+        ok = await self.backend.delete_concurrency_limit(
+            request.match_info["workspace_id"])
+        return web.json_response({"ok": ok})
+
+    async def _deployments_by_app(self, workspace_id: str) -> dict[str, list]:
+        """app_id → deployments, one stub fetch per deployment."""
+        grouped: dict[str, list] = {}
+        for dep in await self.backend.list_deployments(workspace_id):
+            stub = await self.backend.get_stub(dep.stub_id)
+            if stub is not None:
+                grouped.setdefault(stub.app_id, []).append(dep)
+        return grouped
+
+    async def _list_apps(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        grouped = await self._deployments_by_app(ws.workspace_id)
+        return web.json_response([
+            {**app, "deployments": [d.to_dict() for d in
+                                    grouped.get(app["app_id"], [])]}
+            for app in await self.backend.list_apps(ws.workspace_id)])
+
+    async def _delete_app(self, request: web.Request) -> web.Response:
+        """Delete an app: deactivate + drain every deployment under it
+        (reference app group's delete semantics)."""
+        ws = self._ws(request)
+        apps = await self.backend.list_apps(ws.workspace_id)
+        app = next((a for a in apps
+                    if a["app_id"] == request.match_info["app_id"]), None)
+        if app is None:
+            return web.json_response({"error": "not found"}, status=404)
+        grouped = await self._deployments_by_app(ws.workspace_id)
+        drained = 0
+        for dep in grouped.get(app["app_id"], []):
+            await self.backend.set_deployment_active(dep.deployment_id,
+                                                     False)
+            await self.endpoints.drain_stub(dep.stub_id)
+            drained += 1
+        await self.backend.delete_app(app["app_id"])
+        return web.json_response({"ok": True, "deployments_drained": drained})
 
     async def _list_containers(self, request: web.Request) -> web.Response:
         ws = self._ws(request)

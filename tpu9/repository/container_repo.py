@@ -48,12 +48,25 @@ class ContainerRepository:
                                              ContainerStatus.FAILED):
             await self.store.hdel(Keys.stub_containers(state.stub_id),
                                   state.container_id)
+            await self.release_quota_charge(state.workspace_id,
+                                            state.container_id)
         elif ContainerStatus(state.status) is ContainerStatus.RUNNING:
             # wake request buffers blocked on "no serving capacity" the
             # moment a container comes up — admission is event-driven, not
             # a poll loop (buffer.go's Redis-key polling redesigned)
             await self.store.publish(Keys.stub_wake(state.stub_id),
                                      {"event": "running"})
+
+    async def release_quota_charge(self, workspace_id: str,
+                                   container_id: str) -> None:
+        """Drop the workspace concurrency-quota charge
+        (scheduler/quota.py's admit wrote it) — the ONE release point every
+        terminal path shares (terminal update_state, delete_state, and the
+        scheduler's give-up path, which must release even when the state
+        record already TTL'd out)."""
+        if workspace_id:
+            await self.store.hdel(Keys.workspace_active(workspace_id),
+                                  container_id)
 
     async def refresh_ttl(self, container_id: str) -> None:
         await self.store.expire(Keys.container_state(container_id),
@@ -94,6 +107,8 @@ class ContainerRepository:
                                 Keys.container_request(container_id))
         if stub:
             await self.store.hdel(Keys.stub_containers(stub), container_id)
+        if state is not None:
+            await self.release_quota_charge(state.workspace_id, container_id)
 
     # -- discovery ----------------------------------------------------------
 

@@ -64,6 +64,11 @@ class Keys:
         return f"stub:wake:{stub_id}"
 
     @staticmethod
+    def workspace_active(workspace_id: str) -> str:
+        """hash container_id → "cpu:chips" — per-workspace quota charges."""
+        return f"ws:active:{workspace_id}"
+
+    @staticmethod
     def task_message(task_id: str) -> str:
         return f"task:msg:{task_id}"
 

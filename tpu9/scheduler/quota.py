@@ -1,0 +1,115 @@
+"""Per-workspace concurrency quotas: chips and CPU in flight.
+
+Reference analogue: ``pkg/api/v1/concurrencylimit.go`` +
+``scheduler.go:388-393`` (``SetContainerStateWithConcurrencyLimit``) — an
+operator caps a workspace's concurrent GPU/CPU footprint; requests over
+the cap are rejected at admission, before they ever reach the backlog.
+tpu9 meters TPU chips instead of GPUs, and a multi-host (gang) request is
+charged its FULL slice cost up front — all hosts' chips, not rank 0's.
+
+Accounting lives in one hot hash per workspace (``ws:active:<id>``:
+container_id → "cpu:chips") added at admission and removed on every
+terminal path through ``ContainerRepository.release_quota_charge`` — the
+same hot-state-with-TTL'd-truth pattern the rest of the scheduler uses.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..repository.keys import Keys
+from ..types import ContainerRequest
+
+log = logging.getLogger("tpu9.scheduler")
+
+
+class QuotaExceeded(Exception):
+    def __init__(self, what: str, in_use: int, limit: int, asking: int):
+        super().__init__(
+            f"workspace {what} quota exceeded: {in_use} in use + "
+            f"{asking} requested > limit {limit}")
+        self.what = what
+
+
+def request_cost(request: ContainerRequest) -> tuple[int, int]:
+    """(cpu_millicores, tpu_chips) a request will occupy — the WHOLE slice
+    for multi-host specs (every gang member runs cpu/memory too, but the
+    defining quota unit is chips; cpu is charged once per request like the
+    reference's CPUMillicoreLimit)."""
+    spec = request.tpu_spec()
+    chips = spec.chips if spec else 0
+    return request.cpu_millicores, chips
+
+
+class QuotaService:
+    def __init__(self, store, backend):
+        self.store = store
+        self.backend = backend
+
+    async def admit(self, request: ContainerRequest) -> None:
+        """Charge the request against its workspace's limits; raises
+        QuotaExceeded (leaving no accounting entry) when over. The
+        read-check-charge runs under a per-workspace store lock — two
+        concurrent admissions must not both observe the pre-charge total
+        and jointly blow the cap."""
+        limit = await self.backend.get_concurrency_limit(
+            request.workspace_id)
+        cpu, chips = request_cost(request)
+        if limit is None:
+            await self.store.hset(
+                Keys.workspace_active(request.workspace_id),
+                request.container_id, f"{cpu}:{chips}")
+            return
+
+        import asyncio
+
+        from ..types import new_id
+        lock_key = f"wsquota:{request.workspace_id}"
+        token = new_id("qtok")
+        for _ in range(100):
+            if await self.store.acquire_lock(lock_key, token, ttl=5.0):
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise TimeoutError(
+                f"could not lock quota for {request.workspace_id}")
+        try:
+            in_use_cpu, in_use_chips = await self.in_use(
+                request.workspace_id)
+            chip_limit = int(limit.get("tpu_chip_limit") or 0)
+            cpu_limit = int(limit.get("cpu_millicore_limit") or 0)
+            if chip_limit and in_use_chips + chips > chip_limit:
+                raise QuotaExceeded("tpu_chip", in_use_chips, chip_limit,
+                                    chips)
+            if cpu_limit and in_use_cpu + cpu > cpu_limit:
+                raise QuotaExceeded("cpu_millicore", in_use_cpu, cpu_limit,
+                                    cpu)
+            await self.store.hset(
+                Keys.workspace_active(request.workspace_id),
+                request.container_id, f"{cpu}:{chips}")
+        finally:
+            await self.store.release_lock(lock_key, token)
+
+    async def rename(self, workspace_id: str, old_id: str,
+                     new_id: str) -> None:
+        """Gang rollback recycles a request under a fresh id — move its
+        charge so the terminal cleanup of the OLD id doesn't strand it."""
+        key = Keys.workspace_active(workspace_id)
+        cost = await self.store.hget(key, old_id)
+        if cost is not None:
+            await self.store.hdel(key, old_id)
+            await self.store.hset(key, new_id, cost)
+
+    async def in_use(self, workspace_id: str) -> tuple[int, int]:
+        entries = await self.store.hgetall(
+            Keys.workspace_active(workspace_id))
+        cpu = chips = 0
+        for cost in (entries or {}).values():
+            try:
+                c, t = str(cost).split(":")
+                cpu += int(c)
+                chips += int(t)
+            except ValueError:
+                continue
+        return cpu, chips

@@ -38,11 +38,13 @@ class SchedulingFailed(Exception):
 
 class Scheduler:
     def __init__(self, store: StateStore, cfg: Optional[SchedulerConfig] = None,
-                 pools: Optional[dict[str, WorkerPoolController]] = None):
+                 pools: Optional[dict[str, WorkerPoolController]] = None,
+                 quota=None):
         self.cfg = cfg or SchedulerConfig()
         self.store = store
         self.workers = WorkerRepository(store)
         self.containers = ContainerRepository(store)
+        self.quota = quota        # Optional[QuotaService]
         self.pools = pools or {}
         self._task: Optional[asyncio.Task] = None
         self._stopping = asyncio.Event()
@@ -53,9 +55,13 @@ class Scheduler:
 
     async def run(self, request: ContainerRequest) -> None:
         """Accept a placement request (reference Scheduler.Run,
-        scheduler.go:367): persist + enqueue; the loop does the rest."""
+        scheduler.go:367): persist + enqueue; the loop does the rest.
+        Raises QuotaExceeded when the workspace is over its concurrency
+        limit (scheduler.go:388's admission-time quota check)."""
         if not request.container_id:
             request.container_id = new_id("ct")
+        if self.quota is not None:
+            await self.quota.admit(request)
         request.timestamp = time.time()
         await self.containers.set_request(request)
         state = ContainerState(
@@ -266,6 +272,10 @@ class Scheduler:
                 try:
                     await self.containers.set_redirect(old_id,
                                                        request.container_id)
+                    if self.quota is not None:
+                        await self.quota.rename(request.workspace_id,
+                                                old_id,
+                                                request.container_id)
                 except Exception:
                     log.warning("gang rollback: redirect %s failed", old_id)
             for worker_id, container_id in dispatched:
@@ -335,6 +345,11 @@ class Scheduler:
                 state.status = ContainerStatus.FAILED.value
                 state.stop_reason = StopReason.SCHEDULER_FAILED.value
                 await self.containers.update_state(state)
+            else:
+                # the 60s state TTL can lapse while a request waits out
+                # pool provisioning — the quota charge must release anyway
+                await self.containers.release_quota_charge(
+                    request.workspace_id, request.container_id)
             await self.containers.set_exit_code(
                 request.container_id, -1,
                 f"{StopReason.SCHEDULER_FAILED.value}: {reason}")

@@ -52,7 +52,8 @@ class RunnerAbstraction:
                  env: Optional[dict] = None, secrets: Optional[list] = None,
                  volumes: Optional[list] = None,
                  disks: Optional[list] = None, authorized: bool = True,
-                 runner: str = "", on_start: Optional[Callable] = None):
+                 runner: str = "", callback_url: str = "",
+                 on_start: Optional[Callable] = None):
         self.func = func
         self.name = name
         self.on_start = on_start
@@ -70,6 +71,7 @@ class RunnerAbstraction:
             disks=[d.to_dict() if hasattr(d, "to_dict") else d
                    for d in (disks or [])],
             authorized=authorized,
+            callback_url=callback_url,
         )
         if runner:
             self.config.extra["runner"] = runner

@@ -9,6 +9,7 @@ hot state in the task repository.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 from typing import Any, Awaitable, Callable, Optional
@@ -37,6 +38,9 @@ class Dispatcher:
         self._executors: dict[str, ExecutorFn] = {}
         self._task: Optional[asyncio.Task] = None
         self._exit_task: Optional[asyncio.Task] = None
+        # strong refs to in-flight webhook sends: the loop only weak-refs
+        # tasks, and a GC'd callback task silently never delivers
+        self._callback_tasks: set[asyncio.Task] = set()
 
     def register(self, executor: str, requeue: ExecutorFn) -> None:
         self._executors[executor] = requeue
@@ -140,6 +144,7 @@ class Dispatcher:
         out = await self.tasks.set_status(task_id, status)
         await self.backend.update_task_status(task_id, status)
         await self.tasks.expire_message(task_id, msg.policy.ttl_s)
+        self._fire_callback(msg, status, payload)
         return out
 
     async def cancel(self, task_id: str) -> bool:
@@ -154,6 +159,10 @@ class Dispatcher:
         await self.backend.update_task_status(task_id,
                                               TaskStatus.CANCELLED.value)
         await self.tasks.expire_message(task_id, msg.policy.ttl_s)
+        # cancellation is terminal too — webhook receivers keyed on the
+        # completion callback must hear about it like any other end state
+        self._fire_callback(msg, TaskStatus.CANCELLED.value,
+                            {"error": "cancelled"})
         return True
 
     async def retrieve(self, task_id: str, timeout: float = 0,
@@ -263,4 +272,53 @@ class Dispatcher:
         # terminal messages expire so monitor scans and store size stay
         # bounded (results keep their own TTL)
         await self.tasks.expire_message(msg.task_id, msg.policy.ttl_s)
+        self._fire_callback(msg, status, {"error": reason})
         log.info("task %s → %s (%s)", msg.task_id, status, reason)
+
+    # -- completion webhooks -------------------------------------------------
+
+    def _fire_callback(self, msg: TaskMessage, status: str,
+                       payload: dict) -> None:
+        """Task completion webhook, HMAC-signed with the workspace signing
+        key (auth/sign.go's outbound-payload contract). Fire-and-forget
+        with one retry — callbacks must never block task finalization."""
+        if not msg.policy.callback_url:
+            return
+        task = asyncio.create_task(self._send_callback(msg, status, payload))
+        self._callback_tasks.add(task)
+        task.add_done_callback(self._callback_tasks.discard)
+
+    async def _send_callback(self, msg: TaskMessage, status: str,
+                             payload: dict) -> None:
+        import aiohttp
+
+        from ..utils.signing import (SIG_HEADER, SIGNING_KEY_SECRET,
+                                     TS_HEADER, mint_signing_key,
+                                     sign_payload)
+        body = json.dumps({"task_id": msg.task_id, "stub_id": msg.stub_id,
+                           "status": status, **payload}).encode()
+        key = await self.backend.get_secret(msg.workspace_id,
+                                            SIGNING_KEY_SECRET)
+        if key is None:
+            key = mint_signing_key()
+            await self.backend.upsert_secret(msg.workspace_id,
+                                             SIGNING_KEY_SECRET, key)
+        ts, sig = sign_payload(body, key)
+        headers = {"Content-Type": "application/json",
+                   TS_HEADER: str(ts), SIG_HEADER: sig}
+        for attempt in (1, 2):
+            try:
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                            msg.policy.callback_url, data=body,
+                            headers=headers,
+                            timeout=aiohttp.ClientTimeout(total=10)) as resp:
+                        if resp.status < 400:
+                            return
+                        log.warning("task %s callback got %d (attempt %d)",
+                                    msg.task_id, resp.status, attempt)
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as exc:
+                log.warning("task %s callback failed: %s (attempt %d)",
+                            msg.task_id, exc, attempt)
+            await asyncio.sleep(1.0)

@@ -495,12 +495,14 @@ class TaskStatus(str, enum.Enum):
 
 @dataclass
 class TaskPolicy(_Serializable):
-    """Reference pkg/types TaskPolicy: timeout/retries/ttl."""
+    """Reference pkg/types TaskPolicy: timeout/retries/ttl + completion
+    webhook (payloads HMAC-signed with the workspace key, auth/sign.go)."""
 
     timeout_s: float = 3600.0
     max_retries: int = 3
     ttl_s: float = 24 * 3600.0
     expires_s: float = 0.0        # pending expiry (0 == never)
+    callback_url: str = ""
 
 
 @dataclass

@@ -1,0 +1,44 @@
+"""Webhook payload signing: HMAC-SHA256 over the payload + timestamp.
+
+Reference analogue: ``pkg/auth/sign.go`` — outbound payloads (task
+completion callbacks) carry a signature an external receiver verifies with
+the workspace's signing key, with a timestamp bound to reject replays.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import secrets
+import time
+
+SIG_HEADER = "X-Tpu9-Signature"
+TS_HEADER = "X-Tpu9-Signature-Timestamp"
+
+# reserved secret name holding the workspace's signing key (rides the
+# secrets table so it is AES-GCM encrypted at rest like any secret)
+SIGNING_KEY_SECRET = "__tpu9_signing_key__"
+
+
+def mint_signing_key() -> str:
+    return secrets.token_urlsafe(32)
+
+
+def _digest(payload: bytes, timestamp: int, key: str) -> str:
+    msg = base64.b64encode(payload) + b":" + str(timestamp).encode()
+    return hmac.new(key.encode(), msg, hashlib.sha256).hexdigest()
+
+
+def sign_payload(payload: bytes, key: str) -> tuple[int, str]:
+    """Returns (timestamp, hex signature) for the headers."""
+    ts = int(time.time())
+    return ts, _digest(payload, ts, key)
+
+
+def verify_payload(payload: bytes, timestamp: int, signature: str,
+                   key: str, max_age_s: float = 300.0) -> bool:
+    """Constant-time verification + freshness bound (replay rejection)."""
+    if abs(time.time() - timestamp) > max_age_s:
+        return False
+    return hmac.compare_digest(_digest(payload, timestamp, key), signature)
