@@ -203,3 +203,76 @@ async def test_apps_list_and_delete_drain_deployments():
         assert status in (404, 503)
         status, apps = await stack.api("GET", "/api/v1/app")
         assert all(a["app_id"] != app["app_id"] for a in apps)
+
+
+# ---------------------------------------------------------------------------
+# reconcile + atomicity hardening
+# ---------------------------------------------------------------------------
+
+async def test_quota_reconcile_releases_orphaned_charges():
+    """A worker host dying hard leaves a charge with no container state and
+    no terminal event; the reconcile sweep must release it (but must NOT
+    touch fresh charges or backlogged requests)."""
+    import tpu9.scheduler.quota as quota_mod
+    from tpu9.repository.keys import Keys
+
+    async with LocalStack() as stack:
+        q = stack.gateway.quota
+        store = stack.gateway.store
+        ws = stack.gateway.default_workspace.workspace_id
+        key = Keys.workspace_active(ws)
+        # orphan: stamped in the past, no state, not in backlog
+        await store.hset(key, "ct-dead", "500:4:1")
+        # fresh: inside the grace window
+        await store.hset(key, "ct-new", f"500:0:{2**62}")
+        # backlogged: old stamp but a live backlog entry
+        await store.hset(key, "ct-queued", "250:0:1")
+        await store.zadd(Keys.BACKLOG, "ct-queued", 1.0)
+        released = await q.reconcile()
+        assert released == 1
+        left = await store.hgetall(key)
+        assert set(left) == {"ct-new", "ct-queued"}
+        # in_use still parses both 2- and 3-part charge values
+        await store.hset(key, "ct-old-fmt", "100:2")
+        cpu, chips = await q.in_use(ws)
+        assert cpu == 850 and chips == 2
+
+
+async def test_function_dispatch_failure_finalizes_task():
+    """Quota rejection AFTER the task record exists must fail the task, not
+    strand it PENDING forever."""
+    async with LocalStack() as stack:
+        ws_id = stack.gateway.default_workspace.workspace_id
+        status, _ = await stack.api(
+            "POST", f"/api/v1/concurrency-limit/{ws_id}",
+            json_body={"cpu_millicore_limit": 100})
+        assert status == 200
+        status, out = await stack.api("POST", "/rpc/stub/get-or-create",
+                                      json_body={
+            "name": "qfn", "stub_type": "function",
+            "config": {"handler": "app:handler",
+                       "runtime": {"cpu_millicores": 500,
+                                   "memory_mb": 128}}})
+        assert status == 200, out
+        status, res = await stack.api("POST", "/rpc/function/invoke",
+                                      json_body={"stub_id": out["stub_id"],
+                                                 "args": [], "kwargs": {},
+                                                 "wait": False})
+        assert status == 429, (status, res)
+        # the task record the dispatcher created must be terminal now
+        tasks = stack.gateway.dispatcher.tasks
+        # find it via the backend task rows
+        rows = await stack.gateway.backend.list_tasks(ws_id)
+        assert rows, "task record should exist"
+        msg = await tasks.get_message(rows[0]["task_id"])
+        assert msg is not None and msg.status == "error"
+
+
+async def test_ensure_secret_is_create_if_absent():
+    async with LocalStack() as stack:
+        backend = stack.gateway.backend
+        ws = stack.gateway.default_workspace.workspace_id
+        v1 = await backend.ensure_secret(ws, "race-key", "first")
+        v2 = await backend.ensure_secret(ws, "race-key", "second")
+        assert v1 == "first" and v2 == "first"
+        assert await backend.get_secret(ws, "race-key") == "first"

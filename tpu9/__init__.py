@@ -39,6 +39,8 @@ _EXPORTS: dict[str, tuple[str, str]] = {
     "TokenPressureAutoscaler": ("tpu9.sdk.autoscaler", "TokenPressureAutoscaler"),
     "TpuSpec": ("tpu9.types", "TpuSpec"),
     "parse_tpu_spec": ("tpu9.types", "parse_tpu_spec"),
+    "Schema": ("tpu9.schema", "Schema"),
+    "schema": ("tpu9.schema", None),
 }
 
 __all__ = sorted(_EXPORTS) + ["__version__"]
@@ -50,6 +52,6 @@ def __getattr__(name: str) -> Any:
     except KeyError:
         raise AttributeError(f"module 'tpu9' has no attribute {name!r}") from None
     module = importlib.import_module(module_name)
-    value = getattr(module, attr)
+    value = module if attr is None else getattr(module, attr)
     globals()[name] = value
     return value

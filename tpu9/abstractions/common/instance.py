@@ -10,6 +10,7 @@ gateway restart lives in the gateway service.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 from typing import Optional
@@ -219,6 +220,10 @@ class AutoscaledInstance:
         })
         if cfg.extra.get("runner"):
             env["TPU9_RUNNER"] = cfg.extra["runner"]
+        if cfg.inputs:
+            env["TPU9_INPUTS"] = json.dumps(cfg.inputs)
+        if cfg.outputs:
+            env["TPU9_OUTPUTS"] = json.dumps(cfg.outputs)
         if cfg.checkpoint.enabled:
             env["TPU9_CHECKPOINT_ENABLED"] = "1"
         return env

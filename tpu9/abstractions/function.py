@@ -11,6 +11,7 @@ result, and exits. Schedules fire through an in-gateway cron loop.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 from typing import Any, Optional
@@ -106,7 +107,15 @@ class FunctionService:
         msg = await self.dispatcher.send(EXECUTOR, stub.stub_id,
                                          stub.workspace_id, args, kwargs, tp,
                                          enqueue=False)
-        await self._start_task_container(stub, msg.task_id)
+        try:
+            await self._start_task_container(stub, msg.task_id)
+        except Exception as exc:
+            # admission (quota) or scheduler failure: kill the task record
+            # before surfacing the error — a PENDING task with no container
+            # and no queue entry would otherwise sit forever
+            await self.dispatcher.fail(msg.task_id,
+                                       f"dispatch failed: {exc}")
+            raise
         return msg
 
     async def _start_task_container(self, stub: Stub, task_id: str) -> str:
@@ -123,6 +132,10 @@ class FunctionService:
             "TPU9_TIMEOUT_S": str(cfg.timeout_s),
             "TPU9_TOKEN": await self.runner_tokens.get(stub.workspace_id),
         })
+        if cfg.inputs:
+            env["TPU9_INPUTS"] = json.dumps(cfg.inputs)
+        if cfg.outputs:
+            env["TPU9_OUTPUTS"] = json.dumps(cfg.outputs)
         from .common.instance import volume_mounts
         disks_svc = getattr(self, "disks", None)
         request = ContainerRequest(

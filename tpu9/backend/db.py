@@ -334,6 +334,20 @@ class BackendDB:
                            (workspace_id, name))
         return rows[0]["secret_id"]
 
+    async def ensure_secret(self, workspace_id: str, name: str,
+                            value: str) -> str:
+        """Atomic create-if-absent: concurrent callers all read back the ONE
+        stored value (first insert wins) — unlike upsert, where the loser's
+        overwrite would invalidate signatures already minted with the
+        winner's key."""
+        enc = _encrypt_secret(value.encode(), self._secret_key)
+        self._exec(
+            "INSERT INTO secrets (secret_id, workspace_id, name, value_enc, created_at, updated_at) VALUES (?,?,?,?,?,?) "
+            "ON CONFLICT(workspace_id, name) DO NOTHING",
+            (new_id("sec"), workspace_id, name, enc, now(), now()))
+        stored = await self.get_secret(workspace_id, name)
+        return stored if stored is not None else value
+
     async def get_secret(self, workspace_id: str, name: str) -> Optional[str]:
         rows = self._query("SELECT value_enc FROM secrets WHERE workspace_id=? AND name=?",
                            (workspace_id, name))

@@ -121,7 +121,8 @@ class Gateway:
         self.usage = UsageService(self.store, self.backend)
         self.pool_monitor = PoolMonitor(
             self.store, pools or {},
-            {p.name: p for p in cfg.pools}) if pools is not None else None
+            {p.name: p for p in cfg.pools},
+            quota=self.quota) if pools is not None else None
         self.extra_services: dict[str, object] = {}
         self.state_server: Optional[StateServer] = None
         self._proxy_session = None     # shared pod-proxy ClientSession

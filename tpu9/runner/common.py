@@ -31,11 +31,21 @@ class RunnerConfig:
     concurrent_requests: int = 1
     workers: int = 1
     timeout_s: float = 180.0
+    inputs: dict = field(default_factory=dict)    # schema spec (tpu9.schema)
+    outputs: dict = field(default_factory=dict)
     extra: dict[str, str] = field(default_factory=dict)
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "RunnerConfig":
         e = env if env is not None else os.environ
+
+        def spec(key: str) -> dict:
+            raw = e.get(key, "")
+            try:
+                return json.loads(raw) if raw else {}
+            except json.JSONDecodeError:
+                return {}
+
         return cls(
             container_id=e.get("TPU9_CONTAINER_ID", ""),
             stub_id=e.get("TPU9_STUB_ID", ""),
@@ -47,6 +57,8 @@ class RunnerConfig:
             concurrent_requests=int(e.get("TPU9_CONCURRENT_REQUESTS", "1")),
             workers=int(e.get("TPU9_WORKERS", "1")),
             timeout_s=float(e.get("TPU9_TIMEOUT_S", "180")),
+            inputs=spec("TPU9_INPUTS"),
+            outputs=spec("TPU9_OUTPUTS"),
         )
 
 
@@ -57,10 +69,18 @@ class FunctionHandler:
         self.cfg = cfg
         self.fn: Optional[Callable] = None
         self.context: Any = None
+        self.in_schema = None
+        self.out_schema = None
 
     def load(self) -> Callable:
         if self.fn is not None:
             return self.fn
+        if self.cfg.inputs or self.cfg.outputs:
+            from ..schema import Schema
+            if self.cfg.inputs:
+                self.in_schema = Schema.from_spec(self.cfg.inputs)
+            if self.cfg.outputs:
+                self.out_schema = Schema.from_spec(self.cfg.outputs)
         if self.cfg.workdir and self.cfg.workdir not in sys.path:
             sys.path.insert(0, self.cfg.workdir)
         module_name, _, attr = self.cfg.handler.partition(":")
@@ -82,6 +102,10 @@ class FunctionHandler:
     async def call(self, *args: Any, **kwargs: Any) -> Any:
         fn = self.load()
         sig_kwargs = dict(kwargs)
+        if self.in_schema is not None and not args:
+            # schema-validated stubs take kwargs-only payloads; coercion
+            # happens here (base64→bytes, nested objects) before user code
+            sig_kwargs = self.in_schema.validate(sig_kwargs)
         if self.context is not None:
             try:
                 if "context" in inspect.signature(fn).parameters:
@@ -89,8 +113,12 @@ class FunctionHandler:
             except (TypeError, ValueError):
                 pass
         if inspect.iscoroutinefunction(fn):
-            return await fn(*args, **sig_kwargs)
-        return await asyncio.to_thread(fn, *args, **sig_kwargs)
+            result = await fn(*args, **sig_kwargs)
+        else:
+            result = await asyncio.to_thread(fn, *args, **sig_kwargs)
+        if self.out_schema is not None and isinstance(result, dict):
+            result = self.out_schema.encode_output(result)
+        return result
 
 
 def error_payload(exc: BaseException) -> dict:

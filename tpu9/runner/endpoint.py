@@ -23,6 +23,7 @@ import sys
 
 from aiohttp import web
 
+from ..schema import ValidationError
 from .common import FunctionHandler, RunnerConfig, dumps, error_payload
 
 log = logging.getLogger("tpu9.runner")
@@ -106,6 +107,8 @@ def build_app(cfg: RunnerConfig) -> web.Application:
                                 content_type="application/json")
         except asyncio.TimeoutError:
             return web.json_response({"error": "handler timed out"}, status=504)
+        except ValidationError as exc:
+            return web.json_response(exc.to_payload(), status=400)
         except TypeError as exc:
             return web.json_response({"error": f"bad arguments: {exc}"},
                                      status=400)

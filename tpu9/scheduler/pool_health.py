@@ -43,13 +43,15 @@ class PoolMonitor:
     def __init__(self, store: StateStore,
                  pools: dict[str, "WorkerPoolController"],
                  pool_cfgs: dict[str, WorkerPoolConfig],
-                 interval_s: float = 5.0):
+                 interval_s: float = 5.0, quota=None):
         self.workers = WorkerRepository(store)
         self.containers = ContainerRepository(store)
         self.store = store
         self.pools = pools
         self.pool_cfgs = pool_cfgs
         self.interval_s = interval_s
+        self.quota = quota            # Optional[QuotaService]
+        self._last_quota_reconcile = 0.0
         self.status: dict[str, PoolStatus] = {}
         self._task: Optional[asyncio.Task] = None
 
@@ -78,6 +80,12 @@ class PoolMonitor:
             await asyncio.sleep(self.interval_s)
 
     async def tick(self) -> None:
+        # orphaned quota-charge sweep, at a much slower cadence than the
+        # worker-health pass (charges only orphan when a host dies hard)
+        if self.quota is not None and \
+                time.time() - self._last_quota_reconcile > 60.0:
+            self._last_quota_reconcile = time.time()
+            await self.quota.reconcile()
         all_workers = await self.workers.list()
         by_pool: dict[str, list] = {}
         for w in all_workers:

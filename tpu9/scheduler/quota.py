@@ -16,12 +16,17 @@ same hot-state-with-TTL'd-truth pattern the rest of the scheduler uses.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 from ..repository.keys import Keys
 from ..types import ContainerRequest
 
 log = logging.getLogger("tpu9.scheduler")
+
+# a charge with no live container AND no backlog entry older than this is
+# orphaned (worker host died before any terminal event could fire)
+RECONCILE_GRACE_S = 120.0
 
 
 class QuotaExceeded(Exception):
@@ -59,7 +64,7 @@ class QuotaService:
         if limit is None:
             await self.store.hset(
                 Keys.workspace_active(request.workspace_id),
-                request.container_id, f"{cpu}:{chips}")
+                request.container_id, f"{cpu}:{chips}:{int(time.time())}")
             return
 
         import asyncio
@@ -87,7 +92,7 @@ class QuotaService:
                                     cpu)
             await self.store.hset(
                 Keys.workspace_active(request.workspace_id),
-                request.container_id, f"{cpu}:{chips}")
+                request.container_id, f"{cpu}:{chips}:{int(time.time())}")
         finally:
             await self.store.release_lock(lock_key, token)
 
@@ -106,10 +111,34 @@ class QuotaService:
             Keys.workspace_active(workspace_id))
         cpu = chips = 0
         for cost in (entries or {}).values():
+            parts = str(cost).split(":")
             try:
-                c, t = str(cost).split(":")
-                cpu += int(c)
-                chips += int(t)
-            except ValueError:
+                cpu += int(parts[0])
+                chips += int(parts[1])
+            except (ValueError, IndexError):
                 continue
         return cpu, chips
+
+    async def reconcile(self) -> int:
+        """Release charges whose container no longer exists anywhere — not
+        as live state, not in the backlog — and is past the grace window.
+        Covers the ungraceful path (worker host dies, state key TTLs out,
+        no terminal event ever fires) that would otherwise inflate
+        ``in_use`` forever. Returns the number of charges released."""
+        released = 0
+        prefix = Keys.workspace_active("")
+        for key in await self.store.keys(prefix + "*"):
+            for cid, cost in (await self.store.hgetall(key) or {}).items():
+                parts = str(cost).split(":")
+                ts = float(parts[2]) if len(parts) > 2 else 0.0
+                if time.time() - ts < RECONCILE_GRACE_S:
+                    continue
+                if await self.store.exists(Keys.container_state(cid)):
+                    continue
+                if await self.store.zscore(Keys.BACKLOG, cid) is not None:
+                    continue
+                released += await self.store.hdel(key, cid)
+        if released:
+            log.info("quota reconcile released %d orphaned charges",
+                     released)
+        return released

@@ -1,0 +1,445 @@
+"""Typed input/output schemas for endpoints, functions, and task queues.
+
+Reference analogue: ``sdk/src/beta9/schema.py`` (SchemaField hierarchy,
+Schema metaclass, dynamic from_dict/to_dict round-trip) wired into the
+runner via stub config (``sdk/src/beta9/runner/common.py:212-221``).
+
+tpu9 redesign: one wheel serves both the SDK and the in-container runner,
+so the schema lives at package top level and serializes through the stub
+config → ``TPU9_INPUTS``/``TPU9_OUTPUTS`` env → runner validation. Fields
+register themselves by ``kind`` via ``__init_subclass__`` (no metaclass on
+the field side), and a Schema subclass collects its fields the same way —
+declaration order preserved, inheritance composed.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+from typing import Any, Optional
+
+
+class ValidationError(Exception):
+    """Raised when a client-supplied value does not satisfy a field or
+    schema. Runners map this to HTTP 400."""
+
+    def __init__(self, message: str, field: str = ""):
+        super().__init__(message)
+        self.message = message
+        self.field = field
+
+    def to_payload(self) -> dict:
+        out = {"error": "validation", "message": self.message}
+        if self.field:
+            out["field"] = self.field
+        return out
+
+
+class OutputValidationError(Exception):
+    """Raised when a *handler's return value* violates the declared output
+    schema — a server-side defect, surfaced as HTTP 500 (never blamed on
+    the client)."""
+
+
+class Field:
+    """Base class for schema fields. Subclasses register by ``kind``."""
+
+    kind = ""
+    _registry: dict[str, type["Field"]] = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if cls.kind:
+            Field._registry[cls.kind] = cls
+
+    def __init__(self, required: bool = True, default: Any = None):
+        self.required = required
+        self.default = default
+
+    # -- the two value-direction hooks --------------------------------------
+    def check(self, value: Any) -> Any:
+        """Validate + coerce an incoming (wire) value to the python value."""
+        return value
+
+    def encode(self, value: Any) -> Any:
+        """Serialize a python value back to a JSON-safe wire value."""
+        return value
+
+    # -- spec round-trip -----------------------------------------------------
+    def params(self) -> dict:
+        """Subclass hook: kind-specific spec parameters."""
+        return {}
+
+    def spec(self) -> dict:
+        out = {"kind": self.kind, **self.params()}
+        if not self.required:
+            out["required"] = False
+            if self.default is not None:
+                out["default"] = self.default
+        return out
+
+    @classmethod
+    def from_spec(cls, data: dict) -> "Field":
+        kind = data.get("kind", "")
+        sub = cls._registry.get(kind)
+        if sub is None:
+            raise ValidationError(f"unknown field kind {kind!r}")
+        params = {k: v for k, v in data.items() if k != "kind"}
+        return sub._from_params(params)
+
+    @classmethod
+    def _from_params(cls, params: dict) -> "Field":
+        return cls(required=params.get("required", True),
+                   default=params.get("default"))
+
+
+class String(Field):
+    kind = "string"
+
+    def __init__(self, max_len: int = 0, **kw):
+        super().__init__(**kw)
+        self.max_len = int(max_len)
+
+    def check(self, value: Any) -> str:
+        if not isinstance(value, str):
+            raise ValidationError(f"expected string, got {type(value).__name__}")
+        if self.max_len and len(value) > self.max_len:
+            raise ValidationError(f"string longer than {self.max_len}")
+        return value
+
+    def params(self) -> dict:
+        return {"max_len": self.max_len} if self.max_len else {}
+
+    @classmethod
+    def _from_params(cls, p: dict) -> "String":
+        return cls(max_len=p.get("max_len", 0),
+                   required=p.get("required", True), default=p.get("default"))
+
+
+class Integer(Field):
+    kind = "integer"
+
+    def check(self, value: Any) -> int:
+        # bool is an int subclass but "true" is never a valid integer input
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ValidationError(
+                f"expected integer, got {type(value).__name__}")
+        if isinstance(value, float) and not value.is_integer():
+            raise ValidationError(f"expected integer, got float {value}")
+        return int(value)
+
+
+class Float(Field):
+    kind = "float"
+
+    def check(self, value: Any) -> float:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ValidationError(f"expected number, got {type(value).__name__}")
+        return float(value)
+
+
+class Boolean(Field):
+    kind = "boolean"
+
+    def check(self, value: Any) -> bool:
+        if not isinstance(value, bool):
+            raise ValidationError(f"expected boolean, got {type(value).__name__}")
+        return value
+
+
+class JSON(Field):
+    """Any JSON value (dict or list)."""
+
+    kind = "json"
+
+    def check(self, value: Any) -> Any:
+        if isinstance(value, str):
+            try:
+                value = json.loads(value)
+            except json.JSONDecodeError as e:
+                raise ValidationError(f"invalid JSON string: {e}") from e
+        if not isinstance(value, (dict, list)):
+            raise ValidationError(
+                f"expected JSON object/array, got {type(value).__name__}")
+        return value
+
+
+class File(Field):
+    """Binary payloads carried as base64 on the wire, bytes in Python."""
+
+    kind = "file"
+
+    def __init__(self, max_bytes: int = 0, **kw):
+        super().__init__(**kw)
+        self.max_bytes = int(max_bytes)
+
+    def check(self, value: Any) -> bytes:
+        if isinstance(value, bytes):
+            data = value
+        elif isinstance(value, str):
+            b64 = value.split(",", 1)[1] if value.startswith("data:") else value
+            try:
+                data = base64.b64decode(b64, validate=True)
+            except (binascii.Error, ValueError) as e:
+                raise ValidationError(f"invalid base64 file: {e}") from e
+        else:
+            raise ValidationError(
+                f"expected file (bytes or base64), got {type(value).__name__}")
+        if self.max_bytes and len(data) > self.max_bytes:
+            raise ValidationError(f"file larger than {self.max_bytes} bytes")
+        return data
+
+    def encode(self, value: Any) -> str:
+        if isinstance(value, str):
+            value = value.encode()
+        return base64.b64encode(value).decode()
+
+    def params(self) -> dict:
+        return {"max_bytes": self.max_bytes} if self.max_bytes else {}
+
+    @classmethod
+    def _from_params(cls, p: dict) -> "File":
+        return cls(max_bytes=p.get("max_bytes", 0),
+                   required=p.get("required", True), default=p.get("default"))
+
+
+class Image(Field):
+    """Images on the wire as base64; decoded to PIL when available, bytes
+    otherwise (PIL is optional — zero hard deps beyond the baked-in set)."""
+
+    kind = "image"
+
+    def __init__(self, max_width: int = 0, max_height: int = 0, **kw):
+        super().__init__(**kw)
+        self.max_width = int(max_width)
+        self.max_height = int(max_height)
+
+    @staticmethod
+    def _pil():
+        try:
+            from PIL import Image as PILImage
+            return PILImage
+        except ImportError:
+            return None
+
+    def check(self, value: Any) -> Any:
+        data = File().check(value)
+        pil = self._pil()
+        if pil is None:
+            return data
+        import io
+        try:
+            img = pil.open(io.BytesIO(data))
+            img.load()
+        except Exception as e:
+            raise ValidationError(f"invalid image: {e}") from e
+        if self.max_width and img.width > self.max_width:
+            raise ValidationError(f"image wider than {self.max_width}")
+        if self.max_height and img.height > self.max_height:
+            raise ValidationError(f"image taller than {self.max_height}")
+        return img
+
+    def encode(self, value: Any) -> str:
+        pil = self._pil()
+        if pil is not None and isinstance(value, pil.Image):
+            import io
+            buf = io.BytesIO()
+            value.save(buf, format=value.format or "PNG")
+            value = buf.getvalue()
+        return File().encode(value)
+
+    def params(self) -> dict:
+        out = {}
+        if self.max_width:
+            out["max_width"] = self.max_width
+        if self.max_height:
+            out["max_height"] = self.max_height
+        return out
+
+    @classmethod
+    def _from_params(cls, p: dict) -> "Image":
+        return cls(max_width=p.get("max_width", 0),
+                   max_height=p.get("max_height", 0),
+                   required=p.get("required", True), default=p.get("default"))
+
+
+class Array(Field):
+    """Homogeneous list of a nested field type."""
+
+    kind = "array"
+
+    def __init__(self, item: Optional[Field] = None, **kw):
+        super().__init__(**kw)
+        self.item = item or JSON()
+
+    def check(self, value: Any) -> list:
+        if not isinstance(value, list):
+            raise ValidationError(f"expected array, got {type(value).__name__}")
+        return [self.item.check(v) for v in value]
+
+    def encode(self, value: Any) -> list:
+        return [self.item.encode(v) for v in value]
+
+    def params(self) -> dict:
+        return {"item": self.item.spec()}
+
+    @classmethod
+    def _from_params(cls, p: dict) -> "Array":
+        item = Field.from_spec(p["item"]) if "item" in p else JSON()
+        return cls(item=item, required=p.get("required", True),
+                   default=p.get("default"))
+
+
+class Object(Field):
+    """Nested schema field."""
+
+    kind = "object"
+
+    def __init__(self, schema: Optional[type["Schema"]] = None, **kw):
+        super().__init__(**kw)
+        self.schema = schema
+
+    def check(self, value: Any) -> dict:
+        if not isinstance(value, dict):
+            raise ValidationError(f"expected object, got {type(value).__name__}")
+        return self.schema.validate(value) if self.schema else value
+
+    def encode(self, value: Any) -> dict:
+        if self.schema and isinstance(value, dict):
+            return self.schema.encode(value)
+        return value
+
+    def params(self) -> dict:
+        return {"fields": self.schema.to_spec()["fields"]} if self.schema \
+            else {}
+
+    @classmethod
+    def _from_params(cls, p: dict) -> "Object":
+        schema = Schema.from_spec({"fields": p["fields"]}) if "fields" in p \
+            else None
+        return cls(schema=schema, required=p.get("required", True),
+                   default=p.get("default"))
+
+
+class Schema:
+    """Declare fields as class attributes::
+
+        class Inputs(tpu9.Schema):
+            prompt = tpu9.schema.String()
+            max_tokens = tpu9.schema.Integer(required=False, default=64)
+
+    The gateway stores ``to_spec()`` in stub config; the runner rebuilds it
+    with ``from_spec()`` and validates every request before the handler runs.
+    """
+
+    _fields: dict[str, Field] = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        fields: dict[str, Field] = {}
+        for base in reversed(cls.__mro__[1:]):
+            fields.update(getattr(base, "_fields", {}))
+        fields.update({k: v for k, v in vars(cls).items()
+                       if isinstance(v, Field)})
+        cls._fields = fields
+
+    def __init__(self, **kwargs):
+        validated = self.validate(kwargs)
+        for k, v in validated.items():
+            setattr(self, k, v)
+        self._data = validated
+
+    # -- validation ----------------------------------------------------------
+    @classmethod
+    def validate(cls, data: Any) -> dict:
+        if not isinstance(data, dict):
+            raise ValidationError(
+                f"expected JSON object, got {type(data).__name__}")
+        out = {}
+        for name, f in cls._fields.items():
+            if name not in data:
+                if f.required:
+                    raise ValidationError(f"missing required field {name!r}",
+                                          field=name)
+                out[name] = f.default
+                continue
+            try:
+                out[name] = f.check(data[name])
+            except ValidationError as e:
+                raise ValidationError(f"{name}: {e.message}",
+                                      field=name) from e
+        return out
+
+    @classmethod
+    def encode(cls, data: dict) -> dict:
+        """Serialize a validated dict back to wire form (outputs path)."""
+        out = {}
+        for name, f in cls._fields.items():
+            if name in data:
+                out[name] = f.encode(data[name])
+        # pass through extras untouched — outputs may carry extra keys
+        for k, v in data.items():
+            if k not in out:
+                out[k] = v
+        return out
+
+    @classmethod
+    def encode_output(cls, data: dict) -> dict:
+        """Outputs path: handler return values are already python-side
+        (PIL images, bytes), so they are encoded — not check()ed, which
+        expects wire form — and any failure is the *handler's* fault."""
+        missing = [n for n, f in cls._fields.items()
+                   if f.required and n not in data]
+        if missing:
+            raise OutputValidationError(
+                f"handler output missing required field(s): {missing}")
+        try:
+            return cls.encode(data)
+        except Exception as e:
+            raise OutputValidationError(
+                f"handler output does not match output schema: {e}") from e
+
+    def dump(self) -> dict:
+        return self.encode(self._data)
+
+    # -- spec round-trip -----------------------------------------------------
+    @classmethod
+    def to_spec(cls) -> dict:
+        return {"fields": {n: f.spec() for n, f in cls._fields.items()}}
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> type["Schema"]:
+        attrs = {n: Field.from_spec(fs)
+                 for n, fs in spec.get("fields", {}).items()}
+        return type("DynamicSchema", (Schema,), attrs)
+
+    @classmethod
+    def object(cls, fields: dict) -> type["Schema"]:
+        """Build a schema class from a plain dict of fields; nested dicts
+        and Schema subclasses become Object fields."""
+        attrs: dict[str, Field] = {}
+        for k, v in fields.items():
+            if isinstance(v, dict):
+                attrs[k] = Object(cls.object(v))
+            elif isinstance(v, type) and issubclass(v, Schema):
+                attrs[k] = Object(v)
+            elif isinstance(v, Field):
+                attrs[k] = v
+            else:
+                raise TypeError(f"field {k!r}: expected Field/Schema/dict, "
+                                f"got {type(v).__name__}")
+        return type("DynamicSchema", (cls,), attrs)
+
+
+def schema_spec(obj: Any) -> Optional[dict]:
+    """Normalize an ``inputs=``/``outputs=`` argument to a spec dict."""
+    if obj is None:
+        return None
+    if isinstance(obj, dict) and "fields" in obj:
+        return obj
+    if isinstance(obj, dict):
+        return Schema.object(obj).to_spec()
+    if isinstance(obj, type) and issubclass(obj, Schema):
+        return obj.to_spec()
+    raise TypeError(f"expected Schema subclass or field dict, got {obj!r}")

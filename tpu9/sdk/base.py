@@ -53,6 +53,7 @@ class RunnerAbstraction:
                  volumes: Optional[list] = None,
                  disks: Optional[list] = None, authorized: bool = True,
                  runner: str = "", callback_url: str = "",
+                 inputs: Any = None, outputs: Any = None,
                  on_start: Optional[Callable] = None):
         self.func = func
         self.name = name
@@ -73,6 +74,10 @@ class RunnerAbstraction:
             authorized=authorized,
             callback_url=callback_url,
         )
+        if inputs is not None or outputs is not None:
+            from ..schema import schema_spec
+            self.config.inputs = schema_spec(inputs) or {}
+            self.config.outputs = schema_spec(outputs) or {}
         if runner:
             self.config.extra["runner"] = runner
         if autoscaler is not None:

@@ -259,11 +259,29 @@ class Dispatcher:
                                      msg.task_id)
             executor = self._executors.get(msg.executor)
             if executor is not None:
-                await executor(msg)
+                try:
+                    await executor(msg)
+                except Exception as exc:  # noqa: BLE001 — QuotaExceeded,
+                    # scheduler/store errors: the retry container can't
+                    # start, so fail the task now rather than stranding it
+                    # PENDING with nothing scheduled to ever run it
+                    await self._finalize(
+                        msg, fail_status,
+                        f"{reason}; retry dispatch failed: {exc}")
+                    return
             log.info("task %s requeued (%s, attempt %d)", msg.task_id, reason,
                      msg.retry_count)
         else:
             await self._finalize(msg, fail_status, reason)
+
+    async def fail(self, task_id: str, reason: str) -> None:
+        """Public terminal-failure path for callers whose dispatch step
+        failed after ``send`` already created the task (e.g. admission
+        rejected the container) — without this the record stays PENDING
+        forever."""
+        msg = await self.tasks.get_message(task_id)
+        if msg is not None and not TaskStatus(msg.status).terminal:
+            await self._finalize(msg, TaskStatus.ERROR.value, reason)
 
     async def _finalize(self, msg: TaskMessage, status: str, reason: str) -> None:
         await self.tasks.store_result(msg.task_id, {"error": reason})
@@ -300,15 +318,16 @@ class Dispatcher:
         key = await self.backend.get_secret(msg.workspace_id,
                                             SIGNING_KEY_SECRET)
         if key is None:
-            key = mint_signing_key()
-            await self.backend.upsert_secret(msg.workspace_id,
-                                             SIGNING_KEY_SECRET, key)
+            # ensure_secret is create-if-absent: concurrent first callbacks
+            # all sign with the one key that actually got stored
+            key = await self.backend.ensure_secret(
+                msg.workspace_id, SIGNING_KEY_SECRET, mint_signing_key())
         ts, sig = sign_payload(body, key)
         headers = {"Content-Type": "application/json",
                    TS_HEADER: str(ts), SIG_HEADER: sig}
-        for attempt in (1, 2):
-            try:
-                async with aiohttp.ClientSession() as session:
+        async with aiohttp.ClientSession() as session:
+            for attempt in (1, 2):
+                try:
                     async with session.post(
                             msg.policy.callback_url, data=body,
                             headers=headers,
@@ -317,8 +336,9 @@ class Dispatcher:
                             return
                         log.warning("task %s callback got %d (attempt %d)",
                                     msg.task_id, resp.status, attempt)
-            except (aiohttp.ClientError, asyncio.TimeoutError,
-                    OSError) as exc:
-                log.warning("task %s callback failed: %s (attempt %d)",
-                            msg.task_id, exc, attempt)
-            await asyncio.sleep(1.0)
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError) as exc:
+                    log.warning("task %s callback failed: %s (attempt %d)",
+                                msg.task_id, exc, attempt)
+                if attempt == 1:
+                    await asyncio.sleep(1.0)

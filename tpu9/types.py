@@ -268,6 +268,8 @@ class StubConfig(_Serializable):
     authorized: bool = True
     callback_url: str = ""
     task_policy: dict[str, Any] = field(default_factory=dict)
+    inputs: dict[str, Any] = field(default_factory=dict)   # schema spec
+    outputs: dict[str, Any] = field(default_factory=dict)  # schema spec
     extra: dict[str, Any] = field(default_factory=dict)
 
     @classmethod
