@@ -1,0 +1,290 @@
+"""Bot abstraction e2e: petri-net transitions firing real task containers.
+
+Reference analogue: ``pkg/abstractions/experimental/bot/`` (marker
+locations, transition tasks, session event history). Drives the full
+stack: push marker → transition fires a one-shot container (function
+runner) → completion hook pushes output markers → cascade fires the next
+transition — plus validation, restore-on-failure, and event-stream checks.
+"""
+
+import asyncio
+
+import pytest
+
+from tpu9.testing.localstack import LocalStack
+
+pytestmark = pytest.mark.e2e
+
+BOT_HANDLERS = """
+def summarize(markers, session_id, transition):
+    doc = markers["docs"][0]
+    return {"summaries": {"text": doc["text"].upper()}}
+
+def archive(markers, session_id, transition):
+    s = markers["summaries"][0]
+    return {"archived": {"text": s["text"] + "!"}}
+
+def explode(markers, session_id, transition):
+    raise RuntimeError("transition bug")
+"""
+
+DOC_SCHEMA = {"fields": {"text": {"kind": "string"}}}
+
+
+def bot_config(transitions: dict) -> dict:
+    return {
+        "runtime": {"cpu_millicores": 250, "memory_mb": 256},
+        "timeout_s": 60.0,
+        "extra": {"bot": {
+            "locations": {"docs": {"schema": DOC_SCHEMA},
+                          "summaries": {"schema": DOC_SCHEMA},
+                          "archived": {"schema": DOC_SCHEMA}},
+            "transitions": transitions,
+        }},
+    }
+
+
+async def deploy_bot(stack, name: str, transitions: dict) -> dict:
+    object_id = await stack.upload_workspace({"app.py": BOT_HANDLERS})
+    status, out = await stack.api("POST", "/rpc/stub/get-or-create",
+                                  json_body={
+        "name": name, "stub_type": "bot",
+        "config": bot_config(transitions), "object_id": object_id})
+    assert status == 200, out
+    return out
+
+
+async def wait_for(fn, timeout=60.0, interval=0.25):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        out = await fn()
+        if out:
+            return out
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError("condition not met")
+        await asyncio.sleep(interval)
+
+
+async def test_bot_cascade_fires_chained_transitions():
+    async with LocalStack() as stack:
+        out = await deploy_bot(stack, "docbot", {
+            "summarize": {"handler": "app:summarize",
+                          "inputs": {"docs": 1}, "outputs": ["summaries"]},
+            "archive": {"handler": "app:archive",
+                        "inputs": {"summaries": 1},
+                        "outputs": ["archived"]},
+        })
+        stub_id = out["stub_id"]
+        status, sess = await stack.api("POST", "/rpc/bot/session",
+                                       json_body={"stub_id": stub_id})
+        assert status == 200, sess
+        sid = sess["session_id"]
+
+        status, push = await stack.api(
+            "POST", f"/rpc/bot/{stub_id}/session/{sid}/push",
+            json_body={"location": "docs", "marker": {"text": "hello"}})
+        assert status == 200, push
+        assert push["fired"] == ["summarize"]
+
+        async def archived_ready():
+            _, st = await stack.api(
+                "GET", f"/rpc/bot/{stub_id}/session/{sid}/state")
+            return st["markers"]["archived"] == 1 and not st["inflight"]
+
+        await wait_for(archived_ready, timeout=90.0)
+        status, popped = await stack.api(
+            "POST", f"/rpc/bot/{stub_id}/session/{sid}/pop",
+            json_body={"location": "archived"})
+        assert status == 200
+        assert popped["marker"] == {"text": "HELLO!"}
+
+        # event history shows the full cascade
+        _, events = await stack.api(
+            "GET", f"/rpc/bot/{stub_id}/session/{sid}/events")
+        kinds = [e["type"] for e in events]
+        assert kinds.count("transition_started") == 2
+        assert kinds.count("transition_completed") == 2
+
+
+async def test_bot_marker_validation_and_unknowns():
+    async with LocalStack() as stack:
+        out = await deploy_bot(stack, "valbot", {
+            "summarize": {"handler": "app:summarize",
+                          "inputs": {"docs": 1}, "outputs": ["summaries"]}})
+        stub_id = out["stub_id"]
+        _, sess = await stack.api("POST", "/rpc/bot/session",
+                                  json_body={"stub_id": stub_id})
+        sid = sess["session_id"]
+        # schema violation → 400, no marker stored
+        status, err = await stack.api(
+            "POST", f"/rpc/bot/{stub_id}/session/{sid}/push",
+            json_body={"location": "docs", "marker": {"text": 42}})
+        assert status == 400, err
+        # unknown location → 400
+        status, _ = await stack.api(
+            "POST", f"/rpc/bot/{stub_id}/session/{sid}/push",
+            json_body={"location": "nope", "marker": {"text": "x"}})
+        assert status == 400
+        # unknown session → 400
+        status, _ = await stack.api(
+            "POST", f"/rpc/bot/{stub_id}/session/bs-nope/push",
+            json_body={"location": "docs", "marker": {"text": "x"}})
+        assert status == 400
+        _, st = await stack.api(
+            "GET", f"/rpc/bot/{stub_id}/session/{sid}/state")
+        assert st["markers"]["docs"] == 0
+
+
+async def test_bot_failed_transition_restores_markers():
+    async with LocalStack() as stack:
+        out = await deploy_bot(stack, "failbot", {
+            "explode": {"handler": "app:explode",
+                        "inputs": {"docs": 2}, "outputs": ["summaries"]}})
+        stub_id = out["stub_id"]
+        _, sess = await stack.api("POST", "/rpc/bot/session",
+                                  json_body={"stub_id": stub_id})
+        sid = sess["session_id"]
+        # first push: below threshold, nothing fires
+        status, push = await stack.api(
+            "POST", f"/rpc/bot/{stub_id}/session/{sid}/push",
+            json_body={"location": "docs", "marker": {"text": "a"}})
+        assert push["fired"] == []
+        status, push = await stack.api(
+            "POST", f"/rpc/bot/{stub_id}/session/{sid}/push",
+            json_body={"location": "docs", "marker": {"text": "b"}})
+        assert push["fired"] == ["explode"]
+
+        async def restored():
+            _, st = await stack.api(
+                "GET", f"/rpc/bot/{stub_id}/session/{sid}/state")
+            return st["markers"]["docs"] == 2 and not st["inflight"]
+
+        await wait_for(restored, timeout=90.0)
+        _, events = await stack.api(
+            "GET", f"/rpc/bot/{stub_id}/session/{sid}/events")
+        kinds = [e["type"] for e in events]
+        assert "transition_failed" in kinds
+        # no refire loop: exactly one start despite markers being restored
+        assert kinds.count("transition_started") == 1
+
+
+async def test_bot_session_lifecycle():
+    async with LocalStack() as stack:
+        out = await deploy_bot(stack, "lcbot", {
+            "summarize": {"handler": "app:summarize",
+                          "inputs": {"docs": 1}, "outputs": ["summaries"]}})
+        stub_id = out["stub_id"]
+        _, s1 = await stack.api("POST", "/rpc/bot/session",
+                                json_body={"stub_id": stub_id})
+        _, s2 = await stack.api("POST", "/rpc/bot/session",
+                                json_body={"stub_id": stub_id})
+        _, sessions = await stack.api("GET", f"/rpc/bot/{stub_id}/sessions")
+        assert {s["session_id"] for s in sessions} == {s1["session_id"],
+                                                       s2["session_id"]}
+        status, d = await stack.api(
+            "DELETE", f"/rpc/bot/{stub_id}/session/{s1['session_id']}")
+        assert d["ok"]
+        _, sessions = await stack.api("GET", f"/rpc/bot/{stub_id}/sessions")
+        assert len(sessions) == 1
+        # a non-bot stub can't create sessions
+        status, out2 = await stack.api("POST", "/rpc/stub/get-or-create",
+                                       json_body={
+            "name": "plain", "stub_type": "function",
+            "config": {"handler": "app:summarize"}})
+        status, err = await stack.api("POST", "/rpc/bot/session",
+                                      json_body={"stub_id": out2["stub_id"]})
+        assert status == 400
+
+
+# ---------------------------------------------------------------------------
+# SDK declaration mechanics (no stack needed)
+# ---------------------------------------------------------------------------
+
+def test_sdk_bot_declaration():
+    import tpu9
+    from tpu9.schema import String
+
+    class Doc(tpu9.Schema):
+        text = String()
+
+    bot = tpu9.Bot(name="declbot",
+                   locations=[tpu9.BotLocation("docs", marker=Doc),
+                              tpu9.BotLocation("out")])
+
+    @bot.transition(inputs={"docs": 2}, outputs=["out"], cpu=2,
+                    memory="512Mi", tpu="v5e-1", retries=1, timeout=30)
+    def crunch(markers, session_id, transition):
+        return {}
+
+    cfg = bot.config.extra["bot"]
+    assert cfg["locations"]["docs"]["schema"]["fields"]["text"]["kind"] \
+        == "string"
+    t = cfg["transitions"]["crunch"]
+    assert t["inputs"] == {"docs": 2} and t["outputs"] == ["out"]
+    assert t["cpu_millicores"] == 2000 and t["memory_mb"] == 512
+    assert t["tpu"] == "v5e-1" and t["retries"] == 1
+    assert t["handler"].endswith(":crunch")
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        bot.transition(inputs={"nope": 1})(lambda **kw: None)
+    with _pytest.raises(ValueError):
+        bot.transition(inputs={})(lambda **kw: None)
+    with _pytest.raises(ValueError):
+        bot.transition(inputs={"docs": 0})(lambda **kw: None)
+
+
+async def test_bot_sessions_are_tenant_scoped():
+    """An attacker with their OWN bot stub (same location names) must not be
+    able to read or pop another workspace's session markers."""
+    import aiohttp
+    import json as _json
+
+    async with LocalStack() as stack:
+        out = await deploy_bot(stack, "victimbot", {
+            "summarize": {"handler": "app:summarize",
+                          "inputs": {"docs": 5},   # never fires in this test
+                          "outputs": ["summaries"]}})
+        stub_id = out["stub_id"]
+        _, sess = await stack.api("POST", "/rpc/bot/session",
+                                  json_body={"stub_id": stub_id})
+        sid = sess["session_id"]
+        await stack.api(
+            "POST", f"/rpc/bot/{stub_id}/session/{sid}/push",
+            json_body={"location": "docs", "marker": {"text": "secret"}})
+
+        ws = await stack.backend.create_workspace("intruder")
+        tok = await stack.backend.create_token(ws.workspace_id)
+        session = aiohttp.ClientSession(
+            headers={"Authorization": f"Bearer {tok.key}"})
+        try:
+            # intruder registers their own bot stub with the same location
+            async with session.post(
+                    f"{stack.base_url}/rpc/stub/get-or-create",
+                    json=_json.loads(_json.dumps({
+                        "name": "evil", "stub_type": "bot",
+                        "config": bot_config({"summarize": {
+                            "handler": "app:summarize",
+                            "inputs": {"docs": 5},
+                            "outputs": ["summaries"]}})}))) as resp:
+                evil = await resp.json()
+            evil_stub = evil["stub_id"]
+            for method, path, body in [
+                    ("POST", f"/rpc/bot/{evil_stub}/session/{sid}/pop",
+                     {"location": "docs"}),
+                    ("GET", f"/rpc/bot/{evil_stub}/session/{sid}/state",
+                     None),
+                    ("GET", f"/rpc/bot/{evil_stub}/session/{sid}/events",
+                     None),
+                    ("POST", f"/rpc/bot/{evil_stub}/session/{sid}/push",
+                     {"location": "docs", "marker": {"text": "x"}})]:
+                async with session.request(
+                        method, stack.base_url + path, json=body) as resp:
+                    assert resp.status in (400, 404), (method, path,
+                                                       resp.status)
+        finally:
+            await session.close()
+        # victim's marker untouched
+        _, st = await stack.api(
+            "GET", f"/rpc/bot/{stub_id}/session/{sid}/state")
+        assert st["markers"]["docs"] == 1

@@ -41,6 +41,8 @@ _EXPORTS: dict[str, tuple[str, str]] = {
     "parse_tpu_spec": ("tpu9.types", "parse_tpu_spec"),
     "Schema": ("tpu9.schema", "Schema"),
     "schema": ("tpu9.schema", None),
+    "Bot": ("tpu9.sdk.bot", "Bot"),
+    "BotLocation": ("tpu9.sdk.bot", "BotLocation"),
 }
 
 __all__ = sorted(_EXPORTS) + ["__version__"]

@@ -1,0 +1,383 @@
+"""Bot abstraction: petri-net workload orchestration.
+
+Reference analogue: ``pkg/abstractions/experimental/bot/`` — networks of
+typed marker *locations* and *transitions* (task containers) that fire when
+their input locations hold enough markers, with per-session state and an
+event stream (bot.go, state.go, task.go).
+
+tpu9 redesign: the petri-net engine runs in the gateway against the state
+store (marker lists per ``(session, location)``), transitions dispatch
+through the SAME task system as @function (one-shot container per firing,
+executor "bot", completion hook pushes output markers and re-evaluates —
+cascades are event-driven, no polling). Marker payloads are validated with
+``tpu9.schema`` specs instead of the reference's pydantic models, and the
+reference's OpenAI chat layer is deliberately out of scope: a tpu9 bot's
+"brain" can itself be a deployed tpu9 LLM endpoint transition, keeping the
+loop on-cluster and TPU-served rather than egressing to a SaaS model.
+
+Failure semantics: a transition that errors terminally (after task-policy
+retries) has its input markers RESTORED, so a flaky transition doesn't eat
+the tokens that triggered it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Optional
+
+from ..backend import BackendDB
+from ..repository import ContainerRepository
+from ..repository.keys import Keys
+from ..scheduler import Scheduler
+from ..schema import Schema, ValidationError
+from ..task import Dispatcher
+from ..types import (ContainerRequest, Stub, TaskPolicy, TaskStatus, new_id)
+from .common.tokens import RunnerTokenCache
+
+log = logging.getLogger("tpu9.abstractions")
+
+EXECUTOR = "bot"
+
+MAX_EVENTS = 512          # per-session event stream cap
+SESSION_TTL_S = 7 * 24 * 3600.0
+
+
+class BotError(ValueError):
+    pass
+
+
+def _bot_config(stub: Stub) -> dict:
+    bot = stub.config.extra.get("bot") or {}
+    if not bot.get("locations") and not bot.get("transitions"):
+        raise BotError(f"stub {stub.stub_id} has no bot network config")
+    return bot
+
+
+def _location_schema(loc_cfg: dict):
+    spec = loc_cfg.get("schema") or {}
+    return Schema.from_spec(spec) if spec.get("fields") else None
+
+
+class BotService:
+    """Petri-net engine + session/marker/event API."""
+
+    def __init__(self, backend: BackendDB, scheduler: Scheduler,
+                 containers: ContainerRepository, dispatcher: Dispatcher,
+                 store, runner_env: Optional[dict[str, str]] = None,
+                 runner_tokens: Optional[RunnerTokenCache] = None):
+        self.backend = backend
+        self.scheduler = scheduler
+        self.containers = containers
+        self.dispatcher = dispatcher
+        self.store = store
+        self.runner_env = runner_env if runner_env is not None else {}
+        self.runner_tokens = runner_tokens or RunnerTokenCache(backend)
+        self.disks = None
+        dispatcher.register(EXECUTOR, self._requeue)
+        dispatcher.on_complete(EXECUTOR, self._on_task_done)
+
+    # -- sessions ------------------------------------------------------------
+
+    async def create_session(self, stub: Stub) -> dict:
+        _bot_config(stub)  # validates the stub IS a bot
+        session = {"session_id": new_id("bs"), "stub_id": stub.stub_id,
+                   "workspace_id": stub.workspace_id,
+                   "created_at": time.time()}
+        await self.store.hset(Keys.bot_sessions(stub.stub_id),
+                              session["session_id"], json.dumps(session))
+        await self._event(session["session_id"], "session_created",
+                          {"stub_id": stub.stub_id})
+        return session
+
+    async def get_session(self, stub: Stub, session_id: str) -> Optional[dict]:
+        raw = await self.store.hget(Keys.bot_sessions(stub.stub_id),
+                                    session_id)
+        return json.loads(raw) if raw else None
+
+    async def list_sessions(self, stub: Stub) -> list[dict]:
+        rows = await self.store.hgetall(Keys.bot_sessions(stub.stub_id))
+        return sorted((json.loads(v) for v in (rows or {}).values()),
+                      key=lambda s: s["created_at"])
+
+    async def delete_session(self, stub: Stub, session_id: str) -> bool:
+        bot = _bot_config(stub)
+        n = await self.store.hdel(Keys.bot_sessions(stub.stub_id), session_id)
+        for loc in bot.get("locations", {}):
+            await self.store.delete(Keys.bot_markers(session_id, loc))
+        await self.store.delete(Keys.bot_events(session_id),
+                                Keys.bot_inflight(session_id))
+        return n > 0
+
+    # -- markers -------------------------------------------------------------
+
+    async def push_marker(self, stub: Stub, session_id: str, location: str,
+                          marker: dict) -> dict:
+        bot = _bot_config(stub)
+        loc_cfg = bot.get("locations", {}).get(location)
+        if loc_cfg is None:
+            raise BotError(f"unknown location {location!r}")
+        if await self.get_session(stub, session_id) is None:
+            raise BotError(f"unknown session {session_id!r}")
+        schema = _location_schema(loc_cfg)
+        if schema is not None:
+            marker = schema.encode(schema.validate(marker))
+        key = Keys.bot_markers(session_id, location)
+        cap = int(loc_cfg.get("max_markers") or 0)
+        if cap and await self.store.llen(key) >= cap:
+            raise BotError(f"location {location!r} is full ({cap} markers)")
+        await self.store.rpush(key, json.dumps(marker))
+        await self._event(session_id, "marker_pushed",
+                          {"location": location})
+        fired = await self.evaluate(stub, session_id)
+        return {"location": location, "fired": fired}
+
+    async def pop_marker(self, stub: Stub, session_id: str,
+                         location: str) -> Optional[dict]:
+        bot = _bot_config(stub)
+        if location not in bot.get("locations", {}):
+            raise BotError(f"unknown location {location!r}")
+        if await self.get_session(stub, session_id) is None:
+            raise BotError(f"unknown session {session_id!r}")
+        # under the fire lock: a pop racing evaluate() could otherwise
+        # drain a marker between the count check and the consume loop,
+        # firing a transition that is no longer enabled
+        async with self._fire_guard(session_id):
+            raw = await self.store.lpop(
+                Keys.bot_markers(session_id, location))
+        return json.loads(raw) if raw else None
+
+    async def session_state(self, stub: Stub, session_id: str) -> dict:
+        bot = _bot_config(stub)
+        if await self.get_session(stub, session_id) is None:
+            raise BotError(f"unknown session {session_id!r}")
+        markers = {}
+        for loc in bot.get("locations", {}):
+            markers[loc] = await self.store.llen(
+                Keys.bot_markers(session_id, loc))
+        inflight = await self.store.hgetall(Keys.bot_inflight(session_id))
+        return {"session_id": session_id, "markers": markers,
+                "inflight": {k: json.loads(v)["task_id"]
+                             for k, v in (inflight or {}).items()},
+                "transitions": {
+                    name: {"inputs": t.get("inputs", {}),
+                           "outputs": t.get("outputs", []),
+                           "description": t.get("description", "")}
+                    for name, t in bot.get("transitions", {}).items()}}
+
+    async def events(self, session_id: str,
+                     last_id: str = "0") -> list[tuple[str, dict]]:
+        return await self.store.xread(Keys.bot_events(session_id),
+                                      last_id=last_id)
+
+    async def _event(self, session_id: str, kind: str, data: dict) -> None:
+        await self.store.xadd(Keys.bot_events(session_id),
+                              {"type": kind, "ts": time.time(), **data},
+                              maxlen=MAX_EVENTS)
+
+    # -- the petri-net core ---------------------------------------------------
+
+    def _fire_guard(self, session_id: str):
+        """Per-session lock over marker accounting. The critical section is
+        kept to store ops only (count → pop → inflight placeholder) so
+        contention is bounded by ms, not by container dispatch."""
+        store = self.store
+        lock_key = Keys.bot_fire_lock(session_id)
+        token = new_id("bft")
+
+        class _Guard:
+            async def __aenter__(self):
+                for _ in range(800):
+                    if await store.acquire_lock(lock_key, token, ttl=5.0):
+                        return self
+                    await asyncio.sleep(0.01)
+                raise TimeoutError(
+                    f"bot session {session_id} fire lock stuck")
+
+            async def __aexit__(self, *exc):
+                await store.release_lock(lock_key, token)
+
+        return _Guard()
+
+    async def evaluate(self, stub: Stub, session_id: str) -> list[str]:
+        """Fire every enabled transition (inputs satisfied, not already in
+        flight for this session). Marker accounting runs under a
+        per-session lock so concurrent pushes/pops can't double-spend;
+        container dispatch happens OUTSIDE the lock (markers are already
+        consumed and the inflight placeholder written, so a concurrent
+        evaluate sees the transition as busy). Returns names fired."""
+        bot = _bot_config(stub)
+        to_fire: list[tuple[str, dict, dict, Any]] = []
+        async with self._fire_guard(session_id):
+            inflight = await self.store.hgetall(
+                Keys.bot_inflight(session_id)) or {}
+            for name, t in bot.get("transitions", {}).items():
+                if name in inflight:
+                    continue
+                inputs: dict[str, int] = {
+                    loc: int(n) for loc, n in (t.get("inputs") or {}).items()}
+                if not inputs:
+                    continue
+                counts = {}
+                for loc in inputs:
+                    counts[loc] = await self.store.llen(
+                        Keys.bot_markers(session_id, loc))
+                if not all(counts[loc] >= n for loc, n in inputs.items()):
+                    continue
+                consumed: dict[str, list[dict]] = {}
+                for loc, n in inputs.items():
+                    consumed[loc] = []
+                    for _ in range(n):
+                        raw = await self.store.lpop(
+                            Keys.bot_markers(session_id, loc))
+                        if raw:
+                            consumed[loc].append(json.loads(raw))
+                policy = TaskPolicy(
+                    timeout_s=float(t.get("timeout_s")
+                                    or stub.config.timeout_s or 600.0),
+                    max_retries=int(t.get("retries") or 0))
+                msg = await self.dispatcher.send(
+                    EXECUTOR, stub.stub_id, stub.workspace_id,
+                    [], {"markers": consumed, "session_id": session_id,
+                         "transition": name},
+                    policy, enqueue=False)
+                await self.store.hset(
+                    Keys.bot_inflight(session_id), name,
+                    json.dumps({"task_id": msg.task_id,
+                                "consumed": consumed,
+                                "fired_at": time.time()}))
+                to_fire.append((name, t, consumed, msg))
+        fired = []
+        for name, t, consumed, msg in to_fire:
+            await self._event(session_id, "transition_started",
+                              {"transition": name, "task_id": msg.task_id})
+            try:
+                await self._start_transition_container(stub, msg.task_id,
+                                                       name, t)
+                fired.append(name)
+            except Exception as exc:  # noqa: BLE001 — dispatch failed:
+                # undo this firing, keep going with the others
+                await self.store.hdel(Keys.bot_inflight(session_id), name)
+                await self._restore_markers(session_id, consumed)
+                await self.dispatcher.fail(msg.task_id,
+                                           f"bot dispatch failed: {exc}")
+                await self._event(session_id, "transition_failed",
+                                  {"transition": name, "error": str(exc)})
+        return fired
+
+    async def _start_transition_container(self, stub: Stub, task_id: str,
+                                          name: str, t: dict) -> str:
+        cfg = stub.config
+        from .common.secrets import stub_secret_env
+        env = await stub_secret_env(self.backend, stub)
+        env.update(cfg.env)
+        env.update(self.runner_env)
+        env.update({
+            "TPU9_HANDLER": t.get("handler") or cfg.handler,
+            "TPU9_STUB_TYPE": "bot",
+            "TPU9_TASK_ID": task_id,
+            "TPU9_TIMEOUT_S": str(cfg.timeout_s),
+            "TPU9_TOKEN": await self.runner_tokens.get(stub.workspace_id),
+        })
+        from .common.instance import volume_mounts
+        request = ContainerRequest(
+            container_id=new_id("ct"),
+            stub_id=stub.stub_id,
+            workspace_id=stub.workspace_id,
+            stub_type="bot",
+            cpu_millicores=int(t.get("cpu_millicores")
+                               or cfg.runtime.cpu_millicores),
+            memory_mb=int(t.get("memory_mb") or cfg.runtime.memory_mb),
+            tpu=t.get("tpu") if t.get("tpu") is not None else cfg.runtime.tpu,
+            image_id=t.get("image_id") or cfg.runtime.image_id,
+            object_id=stub.object_id,
+            env=env,
+            mounts=volume_mounts(cfg),
+        )
+        if cfg.disks and self.disks is not None:
+            await self.disks.decorate_request(request, cfg.disks)
+        await self.scheduler.run(request)
+        return request.container_id
+
+    async def _restore_markers(self, session_id: str,
+                               consumed: dict[str, list[dict]]) -> None:
+        for loc, markers in consumed.items():
+            for m in markers:
+                await self.store.rpush(Keys.bot_markers(session_id, loc),
+                                       json.dumps(m))
+
+    # -- dispatcher hooks -----------------------------------------------------
+
+    async def _requeue(self, msg) -> None:
+        """Retry hook: a retried transition needs a fresh container."""
+        stub = await self.backend.get_stub(msg.stub_id)
+        if stub is None:
+            return
+        name = msg.handler_kwargs.get("transition", "")
+        t = _bot_config(stub).get("transitions", {}).get(name)
+        if t is not None:
+            await self._start_transition_container(stub, msg.task_id, name, t)
+
+    async def _on_task_done(self, msg, status: str, payload: dict) -> None:
+        """Terminal transition task: push declared outputs from the handler
+        result (cascading evaluation), or restore consumed markers on
+        failure."""
+        session_id = msg.handler_kwargs.get("session_id", "")
+        name = msg.handler_kwargs.get("transition", "")
+        if not session_id or not name:
+            return
+        stub = await self.backend.get_stub(msg.stub_id)
+        if stub is None:
+            return
+        if await self.get_session(stub, session_id) is None:
+            # session deleted while the transition ran: dropping the result
+            # (not restoring/pushing) is what keeps delete_session final —
+            # writes here would recreate TTL-less marker keys for a dead
+            # session and could even fire new containers for it
+            return
+        bot = _bot_config(stub)
+        raw = await self.store.hget(Keys.bot_inflight(session_id), name)
+        await self.store.hdel(Keys.bot_inflight(session_id), name)
+        if status != TaskStatus.COMPLETE.value:
+            if raw:
+                await self._restore_markers(session_id,
+                                            json.loads(raw)["consumed"])
+            await self._event(session_id, "transition_failed",
+                              {"transition": name,
+                               "error": str(payload.get("error", status))})
+            # deliberately NO auto-evaluate here: the restored markers would
+            # immediately re-enable the transition that just failed, and the
+            # loop would spin until something external changed. The next
+            # marker push re-evaluates, so recovery stays user-driven.
+            return
+        t = bot.get("transitions", {}).get(name) or {}
+        outputs = list(t.get("outputs") or [])
+        result = payload.get("result")
+        pushed = 0
+        if isinstance(result, dict):
+            for loc in outputs:
+                produced = result.get(loc)
+                if produced is None:
+                    continue
+                if isinstance(produced, dict):
+                    produced = [produced]
+                loc_cfg = bot.get("locations", {}).get(loc) or {}
+                schema = _location_schema(loc_cfg)
+                for m in produced:
+                    try:
+                        if schema is not None:
+                            m = schema.encode(schema.validate(m))
+                    except ValidationError as e:
+                        await self._event(
+                            session_id, "transition_failed",
+                            {"transition": name,
+                             "error": f"bad output marker for {loc}: {e}"})
+                        continue
+                    await self.store.rpush(
+                        Keys.bot_markers(session_id, loc), json.dumps(m))
+                    pushed += 1
+        await self._event(session_id, "transition_completed",
+                          {"transition": name, "pushed": pushed})
+        await self.evaluate(stub, session_id)

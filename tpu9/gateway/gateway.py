@@ -107,6 +107,12 @@ class Gateway:
         self.endpoints.disks = self.disks
         self.taskqueues.disks = self.disks
         self.functions.disks = self.disks
+        from ..abstractions.bot import BotService
+        self.bots = BotService(self.backend, self.scheduler, self.containers,
+                               self.dispatcher, self.store,
+                               runner_env=self.runner_env,
+                               runner_tokens=self.runner_tokens)
+        self.bots.disks = self.disks
         self.maps = MapService(self.store)
         self.queues = QueueService(self.store)
         self.signals = SignalService(self.store)
@@ -160,6 +166,19 @@ class Gateway:
         r.add_post("/rpc/task/{task_id}/complete", self._rpc_task_complete)
         r.add_post("/rpc/task/{task_id}/cancel", self._rpc_task_cancel)
         r.add_post("/rpc/llm/pressure", self._rpc_llm_pressure)
+        # bot (petri-net orchestration)
+        r.add_post("/rpc/bot/session", self._rpc_bot_session_create)
+        r.add_get("/rpc/bot/{stub_id}/sessions", self._rpc_bot_sessions)
+        r.add_delete("/rpc/bot/{stub_id}/session/{session_id}",
+                     self._rpc_bot_session_delete)
+        r.add_post("/rpc/bot/{stub_id}/session/{session_id}/push",
+                   self._rpc_bot_push)
+        r.add_post("/rpc/bot/{stub_id}/session/{session_id}/pop",
+                   self._rpc_bot_pop)
+        r.add_get("/rpc/bot/{stub_id}/session/{session_id}/state",
+                  self._rpc_bot_state)
+        r.add_get("/rpc/bot/{stub_id}/session/{session_id}/events",
+                  self._rpc_bot_events)
         # pods / sandboxes
         r.add_post("/rpc/pod/create", self._rpc_pod_create)
         r.add_get("/rpc/pod/{container_id}/status", self._rpc_pod_status)
@@ -734,6 +753,76 @@ class Gateway:
     async def _pod_container_for(self, request: web.Request):
         return await self._container_for(request, key="container_id",
                                          allow_worker=False)
+
+    # -- bot (petri-net orchestration; pkg/abstractions/experimental/bot) ----
+
+    async def _rpc_bot_session_create(self, request: web.Request) -> web.Response:
+        from ..abstractions.bot import BotError
+        data = await request.json()
+        stub = await self._stub_for(request, data["stub_id"])
+        try:
+            return web.json_response(await self.bots.create_session(stub))
+        except BotError as e:
+            raise web.HTTPBadRequest(text=json.dumps({"error": str(e)}),
+                                     content_type="application/json")
+
+    async def _rpc_bot_sessions(self, request: web.Request) -> web.Response:
+        stub = await self._stub_for(request, request.match_info["stub_id"])
+        return web.json_response(await self.bots.list_sessions(stub))
+
+    async def _rpc_bot_session_delete(self, request: web.Request) -> web.Response:
+        stub = await self._stub_for(request, request.match_info["stub_id"])
+        ok = await self.bots.delete_session(
+            stub, request.match_info["session_id"])
+        return web.json_response({"ok": ok})
+
+    async def _rpc_bot_push(self, request: web.Request) -> web.Response:
+        from ..abstractions.bot import BotError
+        from ..schema import ValidationError
+        stub = await self._stub_for(request, request.match_info["stub_id"])
+        data = await request.json()
+        try:
+            out = await self.bots.push_marker(
+                stub, request.match_info["session_id"],
+                data["location"], data.get("marker", {}))
+        except (BotError, ValidationError) as e:
+            raise web.HTTPBadRequest(text=json.dumps({"error": str(e)}),
+                                     content_type="application/json")
+        return web.json_response(out)
+
+    async def _rpc_bot_pop(self, request: web.Request) -> web.Response:
+        from ..abstractions.bot import BotError
+        stub = await self._stub_for(request, request.match_info["stub_id"])
+        data = await request.json()
+        try:
+            marker = await self.bots.pop_marker(
+                stub, request.match_info["session_id"], data["location"])
+        except BotError as e:
+            raise web.HTTPBadRequest(text=json.dumps({"error": str(e)}),
+                                     content_type="application/json")
+        return web.json_response({"marker": marker})
+
+    async def _rpc_bot_state(self, request: web.Request) -> web.Response:
+        from ..abstractions.bot import BotError
+        stub = await self._stub_for(request, request.match_info["stub_id"])
+        try:
+            return web.json_response(await self.bots.session_state(
+                stub, request.match_info["session_id"]))
+        except BotError as e:
+            raise web.HTTPBadRequest(text=json.dumps({"error": str(e)}),
+                                     content_type="application/json")
+
+    async def _rpc_bot_events(self, request: web.Request) -> web.Response:
+        stub = await self._stub_for(request, request.match_info["stub_id"])
+        # ownership: events are keyed by session, session list is per stub
+        session_id = request.match_info["session_id"]
+        if await self.bots.get_session(stub, session_id) is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "session not found"}),
+                content_type="application/json")
+        entries = await self.bots.events(
+            session_id, last_id=request.query.get("since", "0"))
+        return web.json_response([{"id": eid, **e} for eid, e in entries])
 
     async def _rpc_pod_create(self, request: web.Request) -> web.Response:
         data = await request.json()

@@ -72,6 +72,28 @@ class Keys:
     def task_message(task_id: str) -> str:
         return f"task:msg:{task_id}"
 
+    # -- bot (petri-net orchestration) ---------------------------------------
+
+    @staticmethod
+    def bot_sessions(stub_id: str) -> str:             # hash session_id -> json
+        return f"bot:sessions:{stub_id}"
+
+    @staticmethod
+    def bot_markers(session_id: str, location: str) -> str:  # list of json
+        return f"bot:markers:{session_id}:{location}"
+
+    @staticmethod
+    def bot_events(session_id: str) -> str:            # stream
+        return f"bot:events:{session_id}"
+
+    @staticmethod
+    def bot_inflight(session_id: str) -> str:          # hash transition -> task
+        return f"bot:inflight:{session_id}"
+
+    @staticmethod
+    def bot_fire_lock(session_id: str) -> str:
+        return f"bot:fire:{session_id}"
+
     @staticmethod
     def task_result(task_id: str) -> str:
         return f"task:result:{task_id}"

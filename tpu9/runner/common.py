@@ -41,10 +41,15 @@ class RunnerConfig:
 
         def spec(key: str) -> dict:
             raw = e.get(key, "")
-            try:
-                return json.loads(raw) if raw else {}
-            except json.JSONDecodeError:
+            if not raw:
                 return {}
+            try:
+                return json.loads(raw)
+            except json.JSONDecodeError as err:
+                # fail the container, loudly: silently serving with the
+                # deployer's declared validation OFF would be a security
+                # downgrade no one can see
+                raise ValueError(f"corrupt {key} schema spec: {err}") from err
 
         return cls(
             container_id=e.get("TPU9_CONTAINER_ID", ""),

@@ -1,0 +1,157 @@
+"""Bot SDK: declare petri-net workloads (locations + transitions).
+
+Reference analogue: ``sdk/src/beta9/abstractions/experimental/bot/bot.py``
+(BotLocation, BotTransition with ``inputs={MarkerClass: n}``, Bot runner) —
+tpu9 markers are typed with ``tpu9.Schema`` instead of pydantic, and
+transitions are plain decorated functions on the Bot object::
+
+    import tpu9
+    from tpu9.schema import Integer, Schema, String
+
+    class Doc(tpu9.Schema):
+        text = String()
+
+    class Summary(tpu9.Schema):
+        text = String()
+
+    bot = tpu9.Bot(name="docbot",
+                   locations=[tpu9.BotLocation("docs", marker=Doc),
+                              tpu9.BotLocation("summaries", marker=Summary)])
+
+    @bot.transition(inputs={"docs": 1}, outputs=["summaries"], tpu="v5e-1")
+    def summarize(markers, session_id, transition):
+        doc = markers["docs"][0]
+        return {"summaries": {"text": doc["text"][:100]}}
+
+Deployed, a session is driven by pushing markers::
+
+    s = bot.create_session()
+    bot.push(s, "docs", text="...")     # summarize fires automatically
+    bot.state(s)                        # marker counts / in-flight
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, Optional
+
+from ..schema import schema_spec
+from .base import RunnerAbstraction, parse_cpu, parse_memory
+
+
+class BotLocation:
+    """A typed marker store. ``marker`` is a tpu9.Schema subclass (or field
+    dict) validating every pushed marker; ``max_markers`` caps the queue."""
+
+    def __init__(self, name: str, marker: Any = None, max_markers: int = 0):
+        if not name or "/" in name or ":" in name:
+            raise ValueError(f"bad location name {name!r}")
+        self.name = name
+        self.marker = marker
+        self.max_markers = int(max_markers)
+
+    def to_dict(self) -> dict:
+        return {"schema": schema_spec(self.marker) or {},
+                "max_markers": self.max_markers}
+
+
+class Bot(RunnerAbstraction):
+    """Petri-net orchestration stub (reference Bot, bot.py:222)."""
+
+    stub_type = "bot"
+
+    def __init__(self, *, locations: Optional[list] = None, **kwargs):
+        super().__init__(None, **kwargs)
+        locs = {}
+        for loc in locations or []:
+            if not isinstance(loc, BotLocation):
+                loc = BotLocation(str(loc))
+            locs[loc.name] = loc.to_dict()
+        self._locations = locs
+        self._transitions: dict[str, dict] = {}
+        self.config.extra["bot"] = {"locations": locs,
+                                    "transitions": self._transitions}
+
+    # -- declaration ---------------------------------------------------------
+
+    def transition(self, *, inputs: dict, outputs: Optional[list] = None,
+                   cpu: Any = None, memory: Any = None,
+                   tpu: Optional[str] = None, description: str = "",
+                   retries: int = 0, timeout: float = 0.0):
+        """Register a transition: fires when each input location holds the
+        required marker count; the handler gets ``markers`` (popped input
+        markers by location), ``session_id``, ``transition`` kwargs and
+        returns ``{output_location: marker | [markers]}``."""
+        norm_inputs: dict[str, int] = {}
+        for loc, n in (inputs or {}).items():
+            name = loc.name if isinstance(loc, BotLocation) else str(loc)
+            if name not in self._locations:
+                raise ValueError(f"unknown input location {name!r}")
+            if int(n) < 1:
+                raise ValueError(f"input count for {name!r} must be >= 1")
+            norm_inputs[name] = int(n)
+        if not norm_inputs:
+            raise ValueError("a transition needs at least one input")
+        norm_outputs = []
+        for loc in outputs or []:
+            name = loc.name if isinstance(loc, BotLocation) else str(loc)
+            if name not in self._locations:
+                raise ValueError(f"unknown output location {name!r}")
+            norm_outputs.append(name)
+
+        def wrap(fn):
+            module = inspect.getmodule(fn)
+            mod_name = getattr(module, "__name__", "__main__")
+            if mod_name == "__main__":
+                import __main__
+                path = getattr(__main__, "__file__", "")
+                mod_name = os.path.splitext(os.path.basename(path))[0] \
+                    or "app"
+            t = {"handler": f"{mod_name}:{fn.__name__}",
+                 "inputs": norm_inputs, "outputs": norm_outputs,
+                 "description": description, "retries": int(retries)}
+            if cpu is not None:
+                t["cpu_millicores"] = parse_cpu(cpu)
+            if memory is not None:
+                t["memory_mb"] = parse_memory(memory)
+            if tpu is not None:
+                t["tpu"] = tpu
+            if timeout:
+                t["timeout_s"] = float(timeout)
+            self._transitions[fn.__name__] = t
+            return fn
+
+        return wrap
+
+    # -- session driving ------------------------------------------------------
+
+    def create_session(self) -> str:
+        stub_id = self.prepare_runtime()
+        out = self.client.request("POST", "/rpc/bot/session",
+                                  json_body={"stub_id": stub_id})
+        return out["session_id"]
+
+    def push(self, session_id: str, location: str, **marker) -> dict:
+        stub_id = self.prepare_runtime()
+        return self.client.request(
+            "POST", f"/rpc/bot/{stub_id}/session/{session_id}/push",
+            json_body={"location": location, "marker": marker})
+
+    def pop(self, session_id: str, location: str) -> Optional[dict]:
+        stub_id = self.prepare_runtime()
+        out = self.client.request(
+            "POST", f"/rpc/bot/{stub_id}/session/{session_id}/pop",
+            json_body={"location": location})
+        return out.get("marker")
+
+    def state(self, session_id: str) -> dict:
+        stub_id = self.prepare_runtime()
+        return self.client.request(
+            "GET", f"/rpc/bot/{stub_id}/session/{session_id}/state")
+
+    def events(self, session_id: str, since: str = "0") -> list:
+        stub_id = self.prepare_runtime()
+        return self.client.request(
+            "GET",
+            f"/rpc/bot/{stub_id}/session/{session_id}/events?since={since}")

@@ -186,6 +186,11 @@ class GatewayClient:
     def auth_check(self) -> dict:
         return self._run(lambda c: c.auth_check())
 
+    def request(self, method: str, path: str, json_body: Any = None) -> Any:
+        """Generic RPC passthrough for abstractions without a typed helper."""
+        return self._run(lambda c: c.request(method, path,
+                                             json_body=json_body))
+
     def put_object(self, data: bytes) -> str:
         return self._run(lambda c: c.put_object(data))
 

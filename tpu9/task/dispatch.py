@@ -36,6 +36,10 @@ class Dispatcher:
         self.container_alive = None   # async (container_id) -> bool
         self.monitor_interval_s = monitor_interval_s
         self._executors: dict[str, ExecutorFn] = {}
+        # terminal-status observers keyed by executor: async (msg, status,
+        # payload) -> None. The bot abstraction uses this to push output
+        # markers when a transition task lands.
+        self._completion_hooks: dict[str, Callable] = {}
         self._task: Optional[asyncio.Task] = None
         self._exit_task: Optional[asyncio.Task] = None
         # strong refs to in-flight webhook sends: the loop only weak-refs
@@ -44,6 +48,20 @@ class Dispatcher:
 
     def register(self, executor: str, requeue: ExecutorFn) -> None:
         self._executors[executor] = requeue
+
+    def on_complete(self, executor: str, hook: Callable) -> None:
+        self._completion_hooks[executor] = hook
+
+    async def _fire_completion_hook(self, msg: TaskMessage, status: str,
+                                    payload: dict) -> None:
+        hook = self._completion_hooks.get(msg.executor)
+        if hook is None:
+            return
+        try:
+            await hook(msg, status, payload)
+        except Exception:  # noqa: BLE001 — observer bugs must not corrupt
+            # task finalization (the result is already stored)
+            log.exception("completion hook for %s failed", msg.executor)
 
     async def start(self) -> "Dispatcher":
         if self._task is None:
@@ -145,6 +163,7 @@ class Dispatcher:
         await self.backend.update_task_status(task_id, status)
         await self.tasks.expire_message(task_id, msg.policy.ttl_s)
         self._fire_callback(msg, status, payload)
+        await self._fire_completion_hook(msg, status, payload)
         return out
 
     async def cancel(self, task_id: str) -> bool:
@@ -163,6 +182,8 @@ class Dispatcher:
         # completion callback must hear about it like any other end state
         self._fire_callback(msg, TaskStatus.CANCELLED.value,
                             {"error": "cancelled"})
+        await self._fire_completion_hook(msg, TaskStatus.CANCELLED.value,
+                                         {"error": "cancelled"})
         return True
 
     async def retrieve(self, task_id: str, timeout: float = 0,
@@ -291,6 +312,7 @@ class Dispatcher:
         # bounded (results keep their own TTL)
         await self.tasks.expire_message(msg.task_id, msg.policy.ttl_s)
         self._fire_callback(msg, status, {"error": reason})
+        await self._fire_completion_hook(msg, status, {"error": reason})
         log.info("task %s → %s (%s)", msg.task_id, status, reason)
 
     # -- completion webhooks -------------------------------------------------

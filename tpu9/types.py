@@ -191,6 +191,7 @@ class StubType(str, enum.Enum):
     SANDBOX = "sandbox"
     SHELL = "shell"
     IMAGE_BUILD = "image_build"
+    BOT = "bot"               # petri-net orchestration (transition tasks)
 
     @property
     def serve_suffix(self) -> str:

@@ -513,6 +513,7 @@ class ContainerLifecycle:
                     StubType.TASK_QUEUE.value: "tpu9.runner.taskqueue",
                     StubType.FUNCTION.value: "tpu9.runner.function",
                     StubType.SCHEDULE.value: "tpu9.runner.function",
+                    StubType.BOT.value: "tpu9.runner.function",
                     "build": "tpu9.runner.build",
                 }.get(request.stub_type, "tpu9.runner.endpoint")
             entrypoint = [sys.executable, "-m", runner_mod]

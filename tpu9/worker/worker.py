@@ -183,7 +183,12 @@ class Worker:
             for container_id, limit in list(
                     self.lifecycle.memory_limits.items()):
                 try:
-                    if container_id in self.lifecycle.active_ids():
+                    # cold-starting containers need their state key alive
+                    # too: a long image pull must not let the 60 s TTL lapse
+                    # (the quota reconciler treats a stateless, unbacklogged
+                    # container as dead and releases its charge)
+                    if (container_id in self.lifecycle.active_ids()
+                            or container_id in self.lifecycle.requests):
                         await self.containers.refresh_ttl(container_id)
                     await self._police_container(container_id, limit, metrics)
                 except asyncio.CancelledError:
