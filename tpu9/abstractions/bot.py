@@ -42,6 +42,9 @@ log = logging.getLogger("tpu9.abstractions")
 EXECUTOR = "bot"
 
 MAX_EVENTS = 512          # per-session event stream cap
+# idle-session GC: every per-session key (markers/events/inflight) slides
+# this TTL forward on touch, so abandoned sessions stop consuming the
+# state store without an explicit delete
 SESSION_TTL_S = 7 * 24 * 3600.0
 
 
@@ -126,9 +129,14 @@ class BotService:
             marker = schema.encode(schema.validate(marker))
         key = Keys.bot_markers(session_id, location)
         cap = int(loc_cfg.get("max_markers") or 0)
-        if cap and await self.store.llen(key) >= cap:
-            raise BotError(f"location {location!r} is full ({cap} markers)")
-        await self.store.rpush(key, json.dumps(marker))
+        # cap check + push under the fire lock: two concurrent pushes must
+        # not both observe len < cap and jointly overflow the location
+        async with self._fire_guard(session_id):
+            if cap and await self.store.llen(key) >= cap:
+                raise BotError(
+                    f"location {location!r} is full ({cap} markers)")
+            await self.store.rpush(key, json.dumps(marker))
+            await self.store.expire(key, SESSION_TTL_S)
         await self._event(session_id, "marker_pushed",
                           {"location": location})
         fired = await self.evaluate(stub, session_id)
@@ -173,9 +181,10 @@ class BotService:
                                       last_id=last_id)
 
     async def _event(self, session_id: str, kind: str, data: dict) -> None:
-        await self.store.xadd(Keys.bot_events(session_id),
-                              {"type": kind, "ts": time.time(), **data},
+        key = Keys.bot_events(session_id)
+        await self.store.xadd(key, {"type": kind, "ts": time.time(), **data},
                               maxlen=MAX_EVENTS)
+        await self.store.expire(key, SESSION_TTL_S)
 
     # -- the petri-net core ---------------------------------------------------
 
@@ -243,11 +252,13 @@ class BotService:
                     [], {"markers": consumed, "session_id": session_id,
                          "transition": name},
                     policy, enqueue=False)
+                inflight_key = Keys.bot_inflight(session_id)
                 await self.store.hset(
-                    Keys.bot_inflight(session_id), name,
+                    inflight_key, name,
                     json.dumps({"task_id": msg.task_id,
                                 "consumed": consumed,
                                 "fired_at": time.time()}))
+                await self.store.expire(inflight_key, SESSION_TTL_S)
                 to_fire.append((name, t, consumed, msg))
         fired = []
         for name, t, consumed, msg in to_fire:
@@ -258,13 +269,15 @@ class BotService:
                                                        name, t)
                 fired.append(name)
             except Exception as exc:  # noqa: BLE001 — dispatch failed:
-                # undo this firing, keep going with the others
+                # undo this firing, keep going with the others. The inflight
+                # record goes FIRST so the completion hook (fired inside
+                # dispatcher.fail) sees raw=None: it emits the single
+                # transition_failed event and skips restore — which happens
+                # here, exactly once.
                 await self.store.hdel(Keys.bot_inflight(session_id), name)
                 await self._restore_markers(session_id, consumed)
                 await self.dispatcher.fail(msg.task_id,
                                            f"bot dispatch failed: {exc}")
-                await self._event(session_id, "transition_failed",
-                                  {"transition": name, "error": str(exc)})
         return fired
 
     async def _start_transition_container(self, stub: Stub, task_id: str,
@@ -304,9 +317,11 @@ class BotService:
     async def _restore_markers(self, session_id: str,
                                consumed: dict[str, list[dict]]) -> None:
         for loc, markers in consumed.items():
+            key = Keys.bot_markers(session_id, loc)
             for m in markers:
-                await self.store.rpush(Keys.bot_markers(session_id, loc),
-                                       json.dumps(m))
+                await self.store.rpush(key, json.dumps(m))
+            if markers:
+                await self.store.expire(key, SESSION_TTL_S)
 
     # -- dispatcher hooks -----------------------------------------------------
 

@@ -1,0 +1,3 @@
+from .agent import Agent, preflight
+
+__all__ = ["Agent", "preflight"]

@@ -1,0 +1,233 @@
+"""BYOC machine agent: join a gateway, reconcile local workers.
+
+Reference analogue: ``pkg/agent/`` — a single binary a machine owner runs:
+preflight checks (preflight.go), join with a one-time token (agent.go:17),
+a desired-worker stream, and a reconcile loop supervising worker containers
+(worker_runtime.go:81, worker_docker.go:30).
+
+tpu9 redesign: workers are subprocesses of the agent (``python -m
+tpu9.cli.main worker``) rather than docker containers — the worker binary
+already self-contains the runtime (process/native/runc), so the agent's job
+is supervision only: poll desired slots over plain HTTP (the agent may sit
+behind NAT; outbound-only), spawn/kill to match, restart crashed workers
+with backoff, and heartbeat telemetry. TPU detection mirrors the worker's
+device manager so a v5e host advertises its real chip count.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import logging
+import os
+import socket
+import sys
+import time
+from typing import Optional
+
+import aiohttp
+
+log = logging.getLogger("tpu9.agent")
+
+RESTART_BACKOFF_S = [1.0, 2.0, 5.0, 15.0, 30.0]
+
+
+def preflight() -> dict:
+    """What this machine can offer (reference preflight.go)."""
+    cpu_millicores = (os.cpu_count() or 1) * 1000
+    memory_mb = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    memory_mb = int(line.split()[1]) // 1024
+                    break
+    except OSError:
+        pass
+    chips = len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/[0-9]*"))
+    # generation detection mirrors the worker's TpuManager convention
+    # (tpu_manager.py:39): TPU9_TPU_GEN env set by the operator / VM image
+    generation = os.environ.get("TPU9_TPU_GEN", "") if chips else ""
+    return {"hostname": socket.gethostname(),
+            "cpu_millicores": cpu_millicores, "memory_mb": memory_mb,
+            "tpu_chips": chips, "tpu_generation": generation}
+
+
+class Agent:
+    """Join + reconcile loop. ``spawn_worker`` is injectable for tests."""
+
+    def __init__(self, gateway_url: str, join_token: str,
+                 poll_interval_s: float = 2.0,
+                 worker_args: Optional[list[str]] = None,
+                 spawn_worker=None):
+        self.gateway_url = gateway_url.rstrip("/")
+        self.join_token = join_token
+        self.poll_interval_s = poll_interval_s
+        self.worker_args = worker_args or []
+        self._spawn_override = spawn_worker
+        self.machine_id = ""
+        self.pool = ""
+        self.worker_token = ""
+        self.state_addr = ""
+        self.state_auth_token = ""
+        self.max_workers = 1
+        self.workers: list[asyncio.subprocess.Process] = []
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._task: Optional[asyncio.Task] = None
+        self._crashes = 0
+        self._last_crash_at = 0.0
+        # voluntary exits whose release RPC hasn't succeeded yet — kept
+        # across reconciles so a gateway blip can't leak desired slots
+        self._pending_release = 0
+
+    # -- join ----------------------------------------------------------------
+
+    async def join(self) -> dict:
+        info = preflight()
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{self.gateway_url}/api/v1/machine/join",
+                              json={"token": self.join_token, **info}) as r:
+                out = await r.json()
+                if r.status != 200:
+                    raise RuntimeError(f"join rejected: {out}")
+        self.machine_id = out["machine_id"]
+        self.pool = out["pool"]
+        self.max_workers = int(out.get("max_workers", 1))
+        self.worker_token = out["worker_token"]
+        host = self.gateway_url.split("://", 1)[-1].split("/", 1)[0]
+        host = host.rsplit(":", 1)[0]
+        self.state_addr = f"{host}:{out['state_port']}"
+        self.state_auth_token = out.get("state_auth_token", "")
+        self._session = aiohttp.ClientSession(
+            headers={"Authorization": f"Bearer {self.worker_token}"})
+        log.info("machine %s joined pool %s (%s)", self.machine_id,
+                 self.pool, info)
+        return out
+
+    # -- reconcile -----------------------------------------------------------
+
+    async def start(self) -> "Agent":
+        if not self.machine_id:
+            await self.join()
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        for p in self.workers:
+            if p.returncode is None:
+                p.terminate()
+        for p in self.workers:
+            try:
+                await asyncio.wait_for(p.wait(), timeout=10.0)
+            except asyncio.TimeoutError:
+                p.kill()
+        self.workers.clear()
+        if self._session:
+            await self._session.close()
+            self._session = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.reconcile()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # keep supervising through hiccups
+                log.warning("agent reconcile failed: %s", exc)
+            await asyncio.sleep(self.poll_interval_s)
+
+    async def reconcile(self) -> None:
+        # reap exits first so slots reopen
+        live = []
+        crashed = 0
+        if self._crashes and time.time() - self._last_crash_at > 120.0:
+            self._crashes = 0     # healthy for a while → forgive history
+        for p in self.workers:
+            if p.returncode is None:
+                live.append(p)
+            elif p.returncode == 0:
+                # idle spindown: the platform shut this worker down on
+                # purpose — release the slot instead of respawning forever
+                log.info("worker pid %s spun down", p.pid)
+                self._pending_release += 1
+            else:
+                log.warning("worker pid %s exited rc=%s", p.pid,
+                            p.returncode)
+                self._crashes += 1
+                self._last_crash_at = time.time()
+                crashed += 1
+        self.workers = live
+        if self._pending_release:
+            # only a successful RPC drains the counter — a gateway blip
+            # retries next cycle instead of leaking the slot
+            if await self._release(self._pending_release):
+                self._pending_release = 0
+
+        desired = await self._desired()
+        desired = min(desired, self.max_workers)
+        if crashed:
+            # crash-loop brake: the next spawn waits out a backoff window
+            delay = RESTART_BACKOFF_S[min(self._crashes - 1,
+                                          len(RESTART_BACKOFF_S) - 1)]
+            await asyncio.sleep(delay)
+        while len(self.workers) < desired:
+            self.workers.append(await self._spawn())
+        while len(self.workers) > desired:
+            p = self.workers.pop()
+            if p.returncode is None:
+                p.terminate()
+        await self._heartbeat()
+
+    async def _release(self, count: int) -> bool:
+        try:
+            async with self._session.post(
+                    f"{self.gateway_url}/api/v1/machine/{self.machine_id}"
+                    f"/release", json={"count": count}) as r:
+                if r.status != 200:
+                    log.warning("release got %d", r.status)
+                return r.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
+            log.warning("release failed: %s", exc)
+            return False
+
+    async def _desired(self) -> int:
+        async with self._session.get(
+                f"{self.gateway_url}/api/v1/machine/{self.machine_id}"
+                f"/desired") as r:
+            if r.status != 200:
+                raise RuntimeError(f"desired poll got {r.status}")
+            return int((await r.json())["workers"])
+
+    async def _heartbeat(self) -> None:
+        payload = {"workers_running": len(self.workers),
+                   "crashes": self._crashes,
+                   "load1": os.getloadavg()[0]}
+        async with self._session.post(
+                f"{self.gateway_url}/api/v1/machine/{self.machine_id}"
+                f"/heartbeat", json=payload) as r:
+            if r.status != 200:
+                log.warning("heartbeat got %d", r.status)
+
+    async def _spawn(self) -> asyncio.subprocess.Process:
+        if self._spawn_override is not None:
+            return await self._spawn_override(self)
+        cmd = [sys.executable, "-m", "tpu9.cli.main", "worker",
+               "--gateway-state", self.state_addr,
+               "--gateway-url", self.gateway_url,
+               "--token", self.worker_token,
+               "--pool", self.pool, *self.worker_args]
+        proc = await asyncio.create_subprocess_exec(
+            *cmd, stdout=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.DEVNULL,
+            env={**os.environ,
+                 "TPU9_DATABASE__STATE_AUTH_TOKEN": self.state_auth_token})
+        log.info("spawned worker pid %d", proc.pid)
+        return proc

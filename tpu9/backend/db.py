@@ -578,3 +578,61 @@ class BackendDB:
     async def mark_schedule_fired(self, schedule_id: str, at: float) -> None:
         self._exec("UPDATE schedules SET last_fired_at=? WHERE schedule_id=?",
                    (at, schedule_id))
+
+    # -- machines (BYOC agent fleet; reference pkg/agent + machine API) ------
+
+    async def create_machine(self, name: str, pool: str,
+                             max_workers: int = 1) -> dict:
+        m = {"machine_id": new_id("mach"), "name": name, "pool": pool,
+             "join_token": pysecrets.token_urlsafe(32),
+             "status": "pending", "max_workers": int(max_workers),
+             "created_at": now()}
+        self._exec(
+            "INSERT INTO machines (machine_id, name, pool, join_token, status, max_workers, created_at) "
+            "VALUES (?,?,?,?,?,?,?)",
+            (m["machine_id"], m["name"], m["pool"], m["join_token"],
+             m["status"], m["max_workers"], m["created_at"]))
+        return m
+
+    async def register_machine(self, join_token: str, hostname: str,
+                               cpu_millicores: int, memory_mb: int,
+                               tpu_chips: int,
+                               tpu_generation: str) -> Optional[dict]:
+        """Consume a one-time join token: only a 'pending' machine can
+        register, so a leaked token is useless after first use."""
+        cur = self._exec(
+            "UPDATE machines SET status='registered', hostname=?, "
+            "cpu_millicores=?, memory_mb=?, tpu_chips=?, tpu_generation=?, "
+            "registered_at=?, last_seen=? "
+            "WHERE join_token=? AND status='pending'",
+            (hostname, int(cpu_millicores), int(memory_mb), int(tpu_chips),
+             tpu_generation, now(), now(), join_token))
+        if cur.rowcount == 0:
+            return None
+        rows = self._query("SELECT * FROM machines WHERE join_token=?",
+                           (join_token,))
+        return dict(rows[0]) if rows else None
+
+    async def get_machine(self, machine_id: str) -> Optional[dict]:
+        rows = self._query("SELECT * FROM machines WHERE machine_id=?",
+                           (machine_id,))
+        return dict(rows[0]) if rows else None
+
+    async def list_machines(self, pool: str = "") -> list[dict]:
+        if pool:
+            rows = self._query(
+                "SELECT * FROM machines WHERE pool=? ORDER BY created_at",
+                (pool,))
+        else:
+            rows = self._query("SELECT * FROM machines ORDER BY created_at",
+                               ())
+        return [dict(r) for r in rows]
+
+    async def touch_machine(self, machine_id: str) -> None:
+        self._exec("UPDATE machines SET last_seen=? WHERE machine_id=?",
+                   (now(), machine_id))
+
+    async def delete_machine(self, machine_id: str) -> bool:
+        cur = self._exec("DELETE FROM machines WHERE machine_id=?",
+                         (machine_id,))
+        return cur.rowcount > 0

@@ -209,4 +209,22 @@ MIGRATIONS: list[tuple[int, str, str]] = [
             updated_at REAL NOT NULL
         );
     """),
+    (20, "machines", """
+        CREATE TABLE machines (
+            machine_id TEXT PRIMARY KEY,
+            name TEXT NOT NULL,
+            pool TEXT NOT NULL,
+            join_token TEXT NOT NULL UNIQUE,
+            status TEXT NOT NULL DEFAULT 'pending',
+            hostname TEXT DEFAULT '',
+            cpu_millicores INTEGER DEFAULT 0,
+            memory_mb INTEGER DEFAULT 0,
+            tpu_chips INTEGER DEFAULT 0,
+            tpu_generation TEXT DEFAULT '',
+            max_workers INTEGER DEFAULT 1,
+            created_at REAL NOT NULL,
+            registered_at REAL DEFAULT 0,
+            last_seen REAL DEFAULT 0
+        );
+    """),
 ]

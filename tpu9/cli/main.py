@@ -361,6 +361,73 @@ def deployments_list() -> None:
 
 
 @cli.group()
+def machine() -> None:
+    """BYOC machine fleet (reference pkg/agent + machine API)."""
+
+
+@machine.command("create")
+@click.argument("name")
+@click.option("--pool", default="default")
+@click.option("--max-workers", default=1)
+def machine_create(name: str, pool: str, max_workers: int) -> None:
+    """Register a machine; prints its ONE-TIME join token."""
+    out = _client().request("POST", "/api/v1/machine",
+                            json_body={"name": name, "pool": pool,
+                                       "max_workers": max_workers})
+    click.echo(json.dumps(out, indent=2))
+    click.echo(f"\nOn the machine, run:\n  tpu9 agent join "
+               f"--gateway-url <url> --token {out['join_token']}", err=True)
+
+
+@machine.command("list")
+@click.option("--pool", default="")
+def machine_list(pool: str) -> None:
+    q = f"?pool={pool}" if pool else ""
+    out = _client().request("GET", f"/api/v1/machine{q}")
+    click.echo(json.dumps(out, indent=2))
+
+
+@machine.command("delete")
+@click.argument("machine_id")
+def machine_delete(machine_id: str) -> None:
+    out = _client().request("DELETE", f"/api/v1/machine/{machine_id}")
+    click.echo(json.dumps(out))
+
+
+@cli.group()
+def agent() -> None:
+    """Machine-owner agent (runs ON the BYOC machine)."""
+
+
+@agent.command("join")
+@click.option("--gateway-url", required=True)
+@click.option("--token", "join_token", required=True,
+              help="one-time join token from `tpu9 machine create`")
+@click.option("--poll-interval", default=2.0)
+@click.option("--worker-arg", "worker_args", multiple=True,
+              help="extra args passed to spawned workers "
+                   "(e.g. --worker-arg=--runtime=native)")
+def agent_join(gateway_url: str, join_token: str, poll_interval: float,
+               worker_args: tuple[str, ...]) -> None:
+    """Join the gateway and reconcile local workers forever."""
+    from ..agent import Agent
+
+    async def main() -> None:
+        ag = Agent(gateway_url, join_token,
+                   poll_interval_s=poll_interval,
+                   worker_args=list(worker_args))
+        await ag.start()
+        click.echo(f"machine {ag.machine_id} joined pool {ag.pool} "
+                   f"(max_workers={ag.max_workers})")
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await ag.stop()
+
+    asyncio.run(main())
+
+
+@cli.group()
 def secret() -> None:
     """Workspace secrets."""
 

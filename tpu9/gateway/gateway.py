@@ -21,6 +21,7 @@ import hashlib
 import json
 import logging
 import os
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -39,6 +40,7 @@ from ..images import ImageBuilder, ImageSpec
 from ..backend import BackendDB
 from ..config import AppConfig
 from ..repository import ContainerRepository, TaskRepository, WorkerRepository
+from ..repository.keys import Keys
 from ..scheduler import Scheduler
 from ..statestore import MemoryStore, RemoteStore, StateServer, StateStore
 from ..task import Dispatcher
@@ -58,8 +60,16 @@ class Gateway:
             cfg.database.path, secret_key=cfg.database.secret_key)
         from ..scheduler.quota import QuotaService
         self.quota = QuotaService(self.store, self.backend)
+        # agent-mode pools are self-hosted (machines reconcile against the
+        # backend/store directly), so the gateway can always construct them
+        self._pools_provided = pools is not None
+        pools = dict(pools or {})
+        from ..scheduler.pools import AgentMachinePool
+        for p in cfg.pools:
+            if p.mode == "agent" and p.name not in pools:
+                pools[p.name] = AgentMachinePool(p, self.backend, self.store)
         self.scheduler = Scheduler(self.store, cfg.scheduler,
-                                   pools=pools or {}, quota=self.quota)
+                                   pools=pools, quota=self.quota)
         self.workers = WorkerRepository(self.store, cfg.worker.keepalive_ttl_s)
         self.containers = ContainerRepository(self.store)
         self.tasks = TaskRepository(self.store)
@@ -126,9 +136,9 @@ class Gateway:
         from ..observability import UsageService
         self.usage = UsageService(self.store, self.backend)
         self.pool_monitor = PoolMonitor(
-            self.store, pools or {},
+            self.store, pools,
             {p.name: p for p in cfg.pools},
-            quota=self.quota) if pools is not None else None
+            quota=self.quota) if (self._pools_provided or pools) else None
         self.extra_services: dict[str, object] = {}
         self.state_server: Optional[StateServer] = None
         self._proxy_session = None     # shared pod-proxy ClientSession
@@ -287,6 +297,17 @@ class Gateway:
         r.add_delete("/api/v1/app/{app_id}", self._delete_app)
         r.add_get("/api/v1/events", self._events)
         r.add_get("/api/v1/pools", self._pools)
+        # machines: BYOC agent fleet (reference pkg/agent + /api/v1/machine)
+        r.add_post("/api/v1/machine", self._machine_create)
+        r.add_get("/api/v1/machine", self._machine_list)
+        r.add_delete("/api/v1/machine/{machine_id}", self._machine_delete)
+        r.add_post("/api/v1/machine/join", self._machine_join)
+        r.add_get("/api/v1/machine/{machine_id}/desired",
+                  self._machine_desired)
+        r.add_post("/api/v1/machine/{machine_id}/heartbeat",
+                   self._machine_heartbeat)
+        r.add_post("/api/v1/machine/{machine_id}/release",
+                   self._machine_release)
         # invoke
         r.add_route("*", "/endpoint/{name}", self._invoke)
         r.add_route("*", "/endpoint/{name}/{tail:.*}", self._invoke)
@@ -411,7 +432,10 @@ class Gateway:
             # bound-method comparison needs ==, not `is` (fresh object per
             # attribute access)
             if (request.path.startswith("/endpoint/")
+                    or request.path == "/api/v1/machine/join"
                     or route_handler == self._subdomain_invoke):
+                # machine join authenticates with its one-time join token
+                # in the body, not a workspace bearer token
                 request["workspace"] = None
                 return await handler(request)
             return web.json_response({"error": "unauthorized"}, status=401)
@@ -771,9 +795,14 @@ class Gateway:
         return web.json_response(await self.bots.list_sessions(stub))
 
     async def _rpc_bot_session_delete(self, request: web.Request) -> web.Response:
+        from ..abstractions.bot import BotError
         stub = await self._stub_for(request, request.match_info["stub_id"])
-        ok = await self.bots.delete_session(
-            stub, request.match_info["session_id"])
+        try:
+            ok = await self.bots.delete_session(
+                stub, request.match_info["session_id"])
+        except BotError as e:
+            raise web.HTTPBadRequest(text=json.dumps({"error": str(e)}),
+                                     content_type="application/json")
         return web.json_response({"ok": ok})
 
     async def _rpc_bot_push(self, request: web.Request) -> web.Response:
@@ -1465,6 +1494,110 @@ class Gateway:
         return web.json_response({"ok": True})
 
     # -- concurrency limits + apps -------------------------------------------
+
+    # -- machines (BYOC agents; reference pkg/agent + machine API) -----------
+
+    async def _machine_create(self, request: web.Request) -> web.Response:
+        self._require_operator(request)
+        data = await request.json()
+        if not data.get("name"):
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "name required"}),
+                content_type="application/json")
+        m = await self.backend.create_machine(
+            data["name"], data.get("pool", "default"),
+            max_workers=int(data.get("max_workers", 1)))
+        # the ONLY response that carries the join token — it is one-time
+        return web.json_response(m)
+
+    async def _machine_list(self, request: web.Request) -> web.Response:
+        self._require_operator(request)
+        out = []
+        for m in await self.backend.list_machines(
+                request.query.get("pool", "")):
+            m.pop("join_token", None)
+            hb = await self.store.get(Keys.machine_heartbeat(m["machine_id"]))
+            m["alive"] = hb is not None
+            m["telemetry"] = hb or {}
+            m["desired_workers"] = int(
+                await self.store.get(
+                    Keys.machine_desired(m["machine_id"])) or 0)
+            out.append(m)
+        return web.json_response(out)
+
+    async def _machine_delete(self, request: web.Request) -> web.Response:
+        self._require_operator(request)
+        machine_id = request.match_info["machine_id"]
+        await self.store.delete(Keys.machine_desired(machine_id),
+                                Keys.machine_heartbeat(machine_id))
+        return web.json_response(
+            {"ok": await self.backend.delete_machine(machine_id)})
+
+    async def _machine_join(self, request: web.Request) -> web.Response:
+        data = await request.json()
+        m = await self.backend.register_machine(
+            data.get("token", ""), data.get("hostname", ""),
+            int(data.get("cpu_millicores", 0)),
+            int(data.get("memory_mb", 0)),
+            int(data.get("tpu_chips", 0)),
+            data.get("tpu_generation", ""))
+        if m is None:
+            # invalid OR already-consumed token — indistinguishable on
+            # purpose (don't confirm which tokens once existed)
+            raise web.HTTPForbidden(
+                text=json.dumps({"error": "invalid join token"}),
+                content_type="application/json")
+        return web.json_response({
+            "machine_id": m["machine_id"],
+            "pool": m["pool"],
+            "max_workers": m["max_workers"],
+            "worker_token": self.worker_token,
+            "state_port": self.cfg.gateway.state_port,
+            "state_auth_token": self.cfg.database.state_auth_token,
+        })
+
+    def _machine_for_worker(self, request: web.Request) -> str:
+        if not request.get("is_worker"):
+            raise web.HTTPForbidden(
+                text=json.dumps({"error": "worker token required"}),
+                content_type="application/json")
+        return request.match_info["machine_id"]
+
+    async def _machine_desired(self, request: web.Request) -> web.Response:
+        machine_id = self._machine_for_worker(request)
+        if await self.backend.get_machine(machine_id) is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "machine not found"}),
+                content_type="application/json")
+        n = int(await self.store.get(Keys.machine_desired(machine_id)) or 0)
+        return web.json_response({"workers": n})
+
+    async def _machine_heartbeat(self, request: web.Request) -> web.Response:
+        machine_id = self._machine_for_worker(request)
+        if await self.backend.get_machine(machine_id) is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "machine not found"}),
+                content_type="application/json")
+        data = await request.json()
+        await self.backend.touch_machine(machine_id)
+        await self.store.set(Keys.machine_heartbeat(machine_id),
+                             {"ts": time.time(), **data}, ttl=60.0)
+        return web.json_response({"ok": True})
+
+    async def _machine_release(self, request: web.Request) -> web.Response:
+        """Agent reports voluntary worker exits (idle spindown, rc=0): the
+        desired count drops so the agent doesn't respawn forever what the
+        platform deliberately shut down."""
+        machine_id = self._machine_for_worker(request)
+        if await self.backend.get_machine(machine_id) is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "machine not found"}),
+                content_type="application/json")
+        data = await request.json()
+        n = max(1, int(data.get("count", 1)))
+        left = await self.store.incr(Keys.machine_desired(machine_id),
+                                     by=-n, floor=0)
+        return web.json_response({"workers": left})
 
     def _require_operator(self, request: web.Request):
         """Quota writes are operator actions (the reference gates them on

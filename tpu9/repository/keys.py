@@ -72,6 +72,16 @@ class Keys:
     def task_message(task_id: str) -> str:
         return f"task:msg:{task_id}"
 
+    # -- machines (BYOC agent fleet) -----------------------------------------
+
+    @staticmethod
+    def machine_desired(machine_id: str) -> str:       # int worker slots
+        return f"machine:desired:{machine_id}"
+
+    @staticmethod
+    def machine_heartbeat(machine_id: str) -> str:     # telemetry, TTL'd
+        return f"machine:hb:{machine_id}"
+
     # -- bot (petri-net orchestration) ---------------------------------------
 
     @staticmethod

@@ -1,6 +1,8 @@
 from .scheduler import Scheduler
 from .selector import filter_workers, score_worker, select_worker
-from .pools import LocalProcessPool, WorkerPoolController
+from .pools import (AgentMachinePool, GceTpuPool, LocalProcessPool,
+                    WorkerPoolController)
 
 __all__ = ["Scheduler", "filter_workers", "score_worker", "select_worker",
-           "LocalProcessPool", "WorkerPoolController"]
+           "AgentMachinePool", "GceTpuPool", "LocalProcessPool",
+           "WorkerPoolController"]

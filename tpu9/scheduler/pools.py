@@ -195,3 +195,71 @@ class GceTpuPool(WorkerPoolController):
             if state != "ACTIVE":
                 still.append(entry)
         self.pending = still
+
+
+class AgentMachinePool(WorkerPoolController):
+    """Capacity backed by operator-owned machines running ``tpu9 agent``
+    (reference ``pkg/agent`` + ``pool_agent.go``): each registered machine
+    polls its desired worker-slot count and reconciles local worker
+    processes against it. ``add_worker`` just bumps the least-loaded
+    machine's desired count — the agent does the spawning, and the workers
+    register through the normal path."""
+
+    name = "agent"
+
+    def __init__(self, cfg: WorkerPoolConfig, backend, store):
+        self.cfg = cfg
+        self.backend = backend
+        self.store = store
+
+    async def _machines(self) -> list[dict]:
+        from ..repository.keys import Keys
+        out = []
+        for m in await self.backend.list_machines(self.cfg.name):
+            if m["status"] != "registered":
+                continue
+            hb = await self.store.get(Keys.machine_heartbeat(m["machine_id"]))
+            if hb is None:
+                continue                     # agent not reporting → not usable
+            m["desired"] = int(await self.store.get(
+                Keys.machine_desired(m["machine_id"])) or 0)
+            out.append(m)
+        return out
+
+    async def _eligible(self, request: ContainerRequest) -> list[dict]:
+        """Machines with a free slot that satisfy the request's TPU shape —
+        the ONE eligibility predicate can_host/add_worker share."""
+        spec = request.tpu_spec()
+        if spec is not None and spec.multi_host:
+            return []             # multi-host slices need the GCE pool
+        out = []
+        for m in await self._machines():
+            if m["desired"] >= m["max_workers"]:
+                continue
+            if spec is not None and (
+                    m["tpu_generation"] != spec.generation
+                    or m["tpu_chips"] < spec.chips_per_host):
+                continue
+            out.append(m)
+        return out
+
+    async def can_host(self, request: ContainerRequest) -> bool:
+        return bool(await self._eligible(request))
+
+    async def add_worker(self, request: ContainerRequest) -> None:
+        from ..repository.keys import Keys
+        candidates = await self._eligible(request)
+        if not candidates:
+            log.warning("agent pool %s: no machine can host %s",
+                        self.cfg.name, request.container_id)
+            return
+        target = min(candidates, key=lambda m: m["desired"])
+        await self.store.incr(Keys.machine_desired(target["machine_id"]))
+        log.info("agent pool %s: machine %s desired -> %d",
+                 self.cfg.name, target["machine_id"], target["desired"] + 1)
+
+    async def worker_count(self) -> int:
+        total = 0
+        for m in await self._machines():
+            total += m["desired"]
+        return total
