@@ -1,0 +1,191 @@
+"""Cross-host relay dialing (tpu9/network/relay.py).
+
+Reference analogue: ``pkg/network/`` (tailscale mesh + backend dialer).
+The tests force the "unroutable address" path by stubbing the direct
+probe, proving traffic flows gateway → loopback tunnel → worker relay
+agent → container and back, including a full endpoint invoke through the
+real local stack.
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from tpu9.network import Dialer, RelayAgent, RelayServer
+from tpu9.statestore import MemoryStore
+from tpu9.testing.localstack import LocalStack
+
+pytestmark = pytest.mark.e2e
+
+
+async def _echo_server():
+    async def on_conn(reader, writer):
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                break
+            writer.write(data.upper())
+            await writer.drain()
+        writer.close()
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    return server, f"127.0.0.1:{port}"
+
+
+async def test_relay_tunnel_round_trip():
+    store = MemoryStore()
+    server, target = await _echo_server()
+    relay = await RelayServer(host="127.0.0.1").start()
+    agent = await RelayAgent(store, "w1").start()
+    dialer = Dialer(store, relay, advertise_host="127.0.0.1")
+
+    async def never_direct(address):
+        return False
+
+    dialer._probe = never_direct
+    try:
+        route = await dialer.ensure_route(target, "w1")
+        assert route != target and route.startswith("127.0.0.1:")
+        # second call reuses the same tunnel
+        assert await dialer.ensure_route(target, "w1") == route
+
+        host, _, port = route.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        writer.write(b"hello relay")
+        await writer.drain()
+        out = await asyncio.wait_for(reader.read(4096), timeout=10.0)
+        assert out == b"HELLO RELAY"
+        writer.close()
+    finally:
+        await agent.stop()
+        await dialer.stop()
+        await relay.stop()
+        server.close()
+
+
+async def test_relay_http_through_tunnel():
+    store = MemoryStore()
+
+    async def hello(request):
+        return web.json_response({"via": "relay", "path": request.path})
+
+    app = web.Application()
+    app.router.add_get("/{tail:.*}", hello)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+    target = f"127.0.0.1:{port}"
+
+    relay = await RelayServer(host="127.0.0.1").start()
+    agent = await RelayAgent(store, "w2").start()
+    dialer = Dialer(store, relay, advertise_host="127.0.0.1")
+
+    async def never_direct(address):
+        return False
+
+    dialer._probe = never_direct
+    try:
+        route = await dialer.ensure_route(target, "w2")
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://{route}/some/path") as r:
+                out = await r.json()
+        assert out == {"via": "relay", "path": "/some/path"}
+    finally:
+        await agent.stop()
+        await dialer.stop()
+        await relay.stop()
+        await runner.cleanup()
+
+
+async def test_direct_route_when_reachable():
+    store = MemoryStore()
+    server, target = await _echo_server()
+    relay = await RelayServer(host="127.0.0.1").start()
+    dialer = Dialer(store, relay, advertise_host="127.0.0.1")
+    try:
+        # reachable → address returned untouched, no tunnel created
+        assert await dialer.ensure_route(target, "w1") == target
+        assert not dialer._tunnels
+        # no worker_id → nothing to relay through
+        assert await dialer.ensure_route("10.0.0.9:1", "") == "10.0.0.9:1"
+    finally:
+        await dialer.stop()
+        await relay.stop()
+        server.close()
+
+
+async def test_relay_rejects_unknown_conn_id():
+    relay = await RelayServer(host="127.0.0.1").start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       relay.port)
+        writer.write(b"rconn-bogus\n")
+        await writer.drain()
+        out = await asyncio.wait_for(reader.read(64), timeout=5.0)
+        assert out == b""      # connection dropped
+        writer.close()
+    finally:
+        await relay.stop()
+
+
+async def test_endpoint_invoke_through_relay():
+    """Full stack: force every container address through the relay and
+    serve a real endpoint request."""
+    async with LocalStack() as stack:
+        dialer = stack.gateway.dialer
+        assert dialer is not None, "gateway should start a relay by default"
+
+        async def never_direct(address):
+            return False
+
+        dialer._probe = never_direct
+        dep = await stack.deploy_echo_endpoint("relayed")
+        out = await stack.invoke(dep, {"via": "relay"})
+        assert out["echo"] == {"via": "relay"}
+        # the request really did go through a tunnel
+        assert dialer._tunnels, "no relay tunnel was created"
+
+
+async def test_relay_only_worker_skips_probe():
+    """A NAT'd worker's addresses must never be direct-probed (a bare TCP
+    connect could hit an unrelated host on the gateway's network) — the
+    dialer goes straight to the tunnel."""
+    from tpu9.repository import WorkerRepository
+    from tpu9.types import WorkerState
+
+    store = MemoryStore()
+    server, target = await _echo_server()   # reachable — probe WOULD pass
+    await WorkerRepository(store).register(
+        WorkerState(worker_id="natted", relay_only=True))
+    relay = await RelayServer(host="127.0.0.1").start()
+    agent = await RelayAgent(store, "natted").start()
+    dialer = Dialer(store, relay, advertise_host="127.0.0.1")
+
+    probed = []
+    real_probe = dialer._probe
+
+    async def spy(address):
+        probed.append(address)
+        return await real_probe(address)
+
+    dialer._probe = spy
+    try:
+        route = await dialer.ensure_route(target, "natted")
+        assert route != target          # tunneled despite being reachable
+        assert probed == []             # and never probed
+        host, _, port = route.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        writer.write(b"nat path")
+        await writer.drain()
+        assert await asyncio.wait_for(reader.read(64), 10.0) == b"NAT PATH"
+        writer.close()
+    finally:
+        await agent.stop()
+        await dialer.stop()
+        await relay.stop()
+        server.close()

@@ -48,10 +48,11 @@ class ForwardResult:
 
 class RequestBuffer:
     def __init__(self, stub: Stub, containers: ContainerRepository,
-                 request_timeout_s: float = 180.0, router=None):
+                 request_timeout_s: float = 180.0, router=None, dialer=None):
         self.stub = stub
         self.containers = containers
         self.router = router    # optional LlmRouter for pressure/affinity
+        self.dialer = dialer    # optional cross-host Dialer (network/relay)
         self.request_timeout_s = request_timeout_s
         self._queue: asyncio.Queue[BufferedRequest] = asyncio.Queue()
         self._session: Optional[aiohttp.ClientSession] = None
@@ -203,6 +204,13 @@ class RequestBuffer:
                 continue
             if await self.containers.acquire_request_token(
                     self.stub.stub_id, s.container_id, limit):
+                if self.dialer is not None:
+                    # AFTER winning the token (don't pay probe/tunnel setup
+                    # for candidates we then skip): unroutable addresses
+                    # (BYOC machines behind NAT) come back as loopback
+                    # relay-tunnel endpoints
+                    address = await self.dialer.ensure_route(address,
+                                                             s.worker_id)
                 if self.router is not None and phash:
                     await self.router.record_served(self.stub.stub_id, phash,
                                                     s.container_id)

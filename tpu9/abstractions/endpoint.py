@@ -35,6 +35,7 @@ class EndpointService:
         self.containers = containers
         self.runner_env = runner_env if runner_env is not None else {}
         self.runner_tokens = runner_tokens
+        self.dialer = None       # Optional[tpu9.network.Dialer]
         self.instances: dict[str, "EndpointInstance"] = {}
         self._locks: dict[str, asyncio.Lock] = {}
 
@@ -55,7 +56,8 @@ class EndpointService:
                     stub, self.scheduler, self.containers,
                     checkpoint_lookup=latest_ckpt,
                     secret_env_fn=stub_secret_env_fn(self.backend, stub),
-                    disks=getattr(self, "disks", None))
+                    disks=getattr(self, "disks", None),
+                    dialer=self.dialer)
                 # runner env + token so LLM runners can heartbeat pressure
                 # and reach the gateway like taskqueue/function runners do
                 inst.instance.extra_env = dict(self.runner_env)
@@ -87,7 +89,7 @@ class EndpointInstance:
 
     def __init__(self, stub: Stub, scheduler: Scheduler,
                  containers: ContainerRepository, checkpoint_lookup=None,
-                 secret_env_fn=None, disks=None):
+                 secret_env_fn=None, disks=None, dialer=None):
         self.stub = stub
         a = stub.config.autoscaler
         self.router = None
@@ -104,7 +106,7 @@ class EndpointInstance:
                                         a.min_containers)
         self.buffer = RequestBuffer(stub, containers,
                                     request_timeout_s=stub.config.timeout_s,
-                                    router=self.router)
+                                    router=self.router, dialer=dialer)
         self.instance = AutoscaledInstance(
             stub, scheduler, containers, policy,
             sample_extra=self._sample_extra,

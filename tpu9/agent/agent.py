@@ -228,6 +228,9 @@ class Agent:
             *cmd, stdout=asyncio.subprocess.DEVNULL,
             stderr=asyncio.subprocess.DEVNULL,
             env={**os.environ,
-                 "TPU9_DATABASE__STATE_AUTH_TOKEN": self.state_auth_token})
+                 "TPU9_DATABASE__STATE_AUTH_TOKEN": self.state_auth_token,
+                 # BYOC machines are assumed NAT'd: container addresses are
+                 # private, the gateway must reach them via the relay
+                 "TPU9_RELAY_ONLY": "1"})
         log.info("spawned worker pid %d", proc.pid)
         return proc

@@ -40,6 +40,10 @@ class GatewayConfig:
     external_url: str = ""
     shutdown_drain_s: float = 30.0
     invoke_base_path: str = ""     # subdomain-less route prefix
+    relay_port: int = -1           # cross-host relay (-1 = any free port,
+                                   # 0 = disabled); reference: tailscale mesh
+    advertise_host: str = ""       # host workers use to dial the relay
+                                   # (defaults to gateway.host)
 
 
 @dataclass

@@ -141,6 +141,8 @@ class Gateway:
             quota=self.quota) if (self._pools_provided or pools) else None
         self.extra_services: dict[str, object] = {}
         self.state_server: Optional[StateServer] = None
+        self.relay = None              # Optional[RelayServer]
+        self.dialer = None             # Optional[Dialer]
         self._proxy_session = None     # shared pod-proxy ClientSession
         # verified (proc_id → container_id) pairings for sandbox output
         # polls: one worker round-trip per proc, then bus reads only
@@ -329,6 +331,19 @@ class Gateway:
             self.state_server = await StateServer(
                 store=self.store, host=self.cfg.gateway.host, port=port,
                 auth_token=self.cfg.database.state_auth_token).start()
+        if self.cfg.gateway.relay_port:
+            from ..network import Dialer, RelayServer
+            # bind where the gateway itself binds: loopback-only dev setups
+            # must not grow a world-reachable unauthenticated port
+            self.relay = await RelayServer(
+                host=self.cfg.gateway.host or "0.0.0.0",
+                port=max(self.cfg.gateway.relay_port, 0)).start()
+            adv = (self.cfg.gateway.advertise_host
+                   or self.cfg.gateway.host or "127.0.0.1")
+            self.dialer = await Dialer(self.store, self.relay,
+                                       advertise_host=adv).start()
+            # every container-proxy surface routes through the dialer
+            self.endpoints.dialer = self.dialer
         await self.scheduler.start()
         await self.dispatcher.start()
         await self.functions.start()
@@ -360,6 +375,10 @@ class Gateway:
         await self.usage.stop()
         if self._proxy_session is not None and not self._proxy_session.closed:
             await self._proxy_session.close()
+        if self.dialer is not None:
+            await self.dialer.stop()
+        if self.relay is not None:
+            await self.relay.stop()
         if self._runner:
             await self._runner.cleanup()
         if self.state_server:
@@ -987,7 +1006,10 @@ class Gateway:
             return web.json_response({"error": "pod not running"}, status=503)
         import aiohttp as _aiohttp
         tail = request.match_info.get("tail", "")
-        url = f"http://{state.address}/{tail}"
+        address = state.address
+        if self.dialer is not None:
+            address = await self.dialer.ensure_route(address, state.worker_id)
+        url = f"http://{address}/{tail}"
         if request.query_string:
             url += f"?{request.query_string}"
         # forward end-to-end headers, not hop-by-hop/host ones
@@ -1547,12 +1569,16 @@ class Gateway:
             raise web.HTTPForbidden(
                 text=json.dumps({"error": "invalid join token"}),
                 content_type="application/json")
+        # the ACTUAL bound port, not the configured one — state_port may be
+        # -1 ("any free port") and an agent can't dial 'host:-1'
+        state_port = (self.state_server.port if self.state_server
+                      else self.cfg.gateway.state_port)
         return web.json_response({
             "machine_id": m["machine_id"],
             "pool": m["pool"],
             "max_workers": m["max_workers"],
             "worker_token": self.worker_token,
-            "state_port": self.cfg.gateway.state_port,
+            "state_port": state_port,
             "state_auth_token": self.cfg.database.state_auth_token,
         })
 

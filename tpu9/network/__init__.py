@@ -1,0 +1,3 @@
+from .relay import Dialer, RelayAgent, RelayServer
+
+__all__ = ["Dialer", "RelayAgent", "RelayServer"]

@@ -1,0 +1,368 @@
+"""Cross-host dialing: direct-first with a reverse-tunnel relay fallback.
+
+Reference analogue: ``pkg/network/`` — the reference embeds Tailscale so
+the gateway can reach containers on machines without routable addresses
+(BYOC boxes behind NAT), plus a ``backend_dialer.go`` that resolves
+container addresses across the tailnet.
+
+tpu9 redesign (no external mesh dependency): the WORKER is always able to
+dial out to the gateway (that's how it joined), so unreachable container
+addresses are served through a rendezvous relay:
+
+1. gateway's :class:`Dialer` probes the container address directly (fast
+   path — same network, sub-ms). Reachability is cached.
+2. on failure it opens a :class:`LocalTunnel`: a loopback listener on the
+   gateway whose accepted connections each publish a relay request
+   ``{conn_id, target, relay_addr}`` on the owning worker's pubsub channel.
+3. the worker's :class:`RelayAgent` dials the local container AND dials
+   back out to the gateway's :class:`RelayServer`, identifies the
+   connection with a ``conn_id`` preamble, and pumps bytes both ways.
+4. the Dialer hands callers a plain ``127.0.0.1:port`` address, so every
+   HTTP/websocket proxy in the gateway keeps using ordinary aiohttp — the
+   relay is invisible above this module.
+
+The preamble is newline-framed: ``conn_id\\n`` then raw bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import time
+from typing import Optional
+
+log = logging.getLogger("tpu9.network")
+
+PROBE_TIMEOUT_S = 0.75
+PROBE_CACHE_S = 120.0
+PAIR_TIMEOUT_S = 10.0
+PUMP_BUF = 64 * 1024
+TUNNEL_IDLE_S = 600.0     # GC tunnels (and their listeners) idle this long
+WORKER_CACHE_S = 15.0     # relay_only lookups ride the worker-state TTL
+
+
+def relay_channel(worker_id: str) -> str:
+    return f"relay:open:{worker_id}"
+
+
+async def _pump(reader: asyncio.StreamReader,
+                writer: asyncio.StreamWriter) -> None:
+    try:
+        while True:
+            data = await reader.read(PUMP_BUF)
+            if not data:
+                break
+            writer.write(data)
+            await writer.drain()
+    except (ConnectionError, asyncio.IncompleteReadError, OSError):
+        pass
+    finally:
+        try:
+            writer.close()
+        except Exception:  # noqa: BLE001 — already torn down
+            pass
+
+
+async def pipe(a_reader, a_writer, b_reader, b_writer) -> None:
+    """Bidirectional byte pump until either side closes."""
+    await asyncio.gather(_pump(a_reader, b_writer),
+                         _pump(b_reader, a_writer))
+
+
+class RelayServer:
+    """Gateway-side rendezvous point: workers dial in and present a
+    ``conn_id`` preamble; the matching tunnel claims the connection."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._pending: dict[str, asyncio.Future] = {}
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> "RelayServer":
+        self._server = await asyncio.start_server(self._on_conn, self.host,
+                                                  self.port or 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+
+    def expect(self, conn_id: str) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[conn_id] = fut
+        return fut
+
+    def forget(self, conn_id: str) -> None:
+        self._pending.pop(conn_id, None)
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        try:
+            preamble = await asyncio.wait_for(reader.readline(),
+                                              timeout=PAIR_TIMEOUT_S)
+        except (asyncio.TimeoutError, ConnectionError):
+            writer.close()
+            return
+        conn_id = preamble.decode(errors="replace").strip()
+        fut = self._pending.pop(conn_id, None)
+        if fut is None or fut.done():
+            # unknown/expired conn id — drop (a stray dialer learns nothing)
+            writer.close()
+            return
+        fut.set_result((reader, writer))
+
+
+class LocalTunnel:
+    """A loopback listener whose every accepted connection is relayed to
+    ``target`` on ``worker_id``'s host."""
+
+    def __init__(self, store, relay: RelayServer, relay_advertise: str,
+                 worker_id: str, target: str):
+        self.store = store
+        self.relay = relay
+        self.relay_advertise = relay_advertise
+        self.worker_id = worker_id
+        self.target = target
+        self.port = 0
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.last_used = time.monotonic()
+
+    async def start(self) -> "LocalTunnel":
+        self._server = await asyncio.start_server(self._on_client,
+                                                  "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        self.last_used = time.monotonic()
+        # the conn id is the pairing secret: only the worker that received
+        # the pubsub message can present it, so make it unguessable
+        conn_id = "rconn-" + secrets.token_urlsafe(24)
+        fut = self.relay.expect(conn_id)
+        await self.store.publish(relay_channel(self.worker_id), {
+            "conn_id": conn_id, "target": self.target,
+            "relay": self.relay_advertise})
+        try:
+            w_reader, w_writer = await asyncio.wait_for(
+                fut, timeout=PAIR_TIMEOUT_S)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            self.relay.forget(conn_id)
+            writer.close()
+            return
+        await pipe(reader, writer, w_reader, w_writer)
+
+
+class Dialer:
+    """Address translation for everything that proxies to containers:
+    ``ensure_route(addr, worker_id)`` returns either the address itself
+    (directly reachable) or a loopback tunnel endpoint."""
+
+    def __init__(self, store, relay: RelayServer,
+                 advertise_host: str = "127.0.0.1"):
+        self.store = store
+        self.relay = relay
+        self.advertise_host = advertise_host
+        self._direct: dict[str, tuple[bool, float]] = {}  # addr → (ok, ts)
+        self._tunnels: dict[tuple[str, str], LocalTunnel] = {}
+        self._relay_only: dict[str, tuple[bool, float]] = {}
+        self._lock = asyncio.Lock()
+        self._gc_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "Dialer":
+        if self._gc_task is None:
+            self._gc_task = asyncio.create_task(self._gc_loop())
+        return self
+
+    async def _gc_loop(self) -> None:
+        while True:
+            await asyncio.sleep(60.0)
+            try:
+                now = time.monotonic()
+                async with self._lock:
+                    for key, t in list(self._tunnels.items()):
+                        if now - t.last_used > TUNNEL_IDLE_S:
+                            await t.stop()
+                            del self._tunnels[key]
+                    # the probe cache self-expires by timestamp; just bound it
+                    for addr, (_, ts) in list(self._direct.items()):
+                        if now - ts > PROBE_CACHE_S:
+                            del self._direct[addr]
+                    for wid, (_, ts) in list(self._relay_only.items()):
+                        if now - ts > WORKER_CACHE_S:
+                            del self._relay_only[wid]
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — GC must never die
+                log.exception("dialer gc failed")
+
+    async def _worker_relay_only(self, worker_id: str) -> bool:
+        hit = self._relay_only.get(worker_id)
+        if hit is not None and time.monotonic() - hit[1] < WORKER_CACHE_S:
+            return hit[0]
+        flag = False
+        try:
+            from ..repository import WorkerRepository
+            w = await WorkerRepository(self.store).get(worker_id)
+            flag = bool(w and w.relay_only)
+        except Exception:  # noqa: BLE001 — fall back to probing
+            flag = False
+        self._relay_only[worker_id] = (flag, time.monotonic())
+        return flag
+
+    @property
+    def relay_advertise(self) -> str:
+        return f"{self.advertise_host}:{self.relay.port}"
+
+    async def _probe(self, address: str) -> bool:
+        ok, ts = self._direct.get(address, (False, 0.0))
+        if time.monotonic() - ts < PROBE_CACHE_S:
+            return ok
+        host, _, port = address.rpartition(":")
+        ok = False
+        try:
+            _, w = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)),
+                timeout=PROBE_TIMEOUT_S)
+            w.close()
+            ok = True
+        except (OSError, asyncio.TimeoutError, ValueError):
+            ok = False
+        self._direct[address] = (ok, time.monotonic())
+        return ok
+
+    async def ensure_route(self, address: str, worker_id: str = "") -> str:
+        """Best route to ``address``: itself, or a relay tunnel endpoint.
+        Without a worker_id there is nothing to relay through, so the
+        address is returned as-is."""
+        if not address or not worker_id:
+            return address
+        # NAT'd workers declare relay_only: their private addresses must
+        # NEVER be probed — a bare TCP connect can collide with an unrelated
+        # host on the gateway's own network and mis-route user traffic
+        if not await self._worker_relay_only(worker_id):
+            if await self._probe(address):
+                return address
+        async with self._lock:
+            key = (worker_id, address)
+            tunnel = self._tunnels.get(key)
+            if tunnel is None:
+                tunnel = LocalTunnel(self.store, self.relay,
+                                     self.relay_advertise, worker_id,
+                                     address)
+                await tunnel.start()
+                self._tunnels[key] = tunnel
+                log.info("relay tunnel %s -> %s via %s", tunnel.address,
+                         address, worker_id)
+        tunnel.last_used = time.monotonic()
+        return tunnel.address
+
+    async def stop(self) -> None:
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            try:
+                await self._gc_task
+            except asyncio.CancelledError:
+                pass
+            self._gc_task = None
+        for t in self._tunnels.values():
+            await t.stop()
+        self._tunnels.clear()
+
+
+class RelayAgent:
+    """Worker-side: answers relay requests by dialing the local target and
+    the gateway's relay server, then pumping bytes."""
+
+    def __init__(self, store, worker_id: str):
+        self.store = store
+        self.worker_id = worker_id
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = asyncio.Event()
+        # strong refs: the loop only weak-refs tasks, and a GC'd pump task
+        # would stall a live relayed connection mid-transfer
+        self._conns: set[asyncio.Task] = set()
+
+    async def start(self) -> "RelayAgent":
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        for t in list(self._conns):
+            t.cancel()
+        if self._conns:
+            await asyncio.gather(*self._conns, return_exceptions=True)
+        self._conns.clear()
+
+    async def _loop(self) -> None:
+        sub = self.store.subscribe(relay_channel(self.worker_id))
+        try:
+            while not self._stopping.is_set():
+                msg = await sub.get(timeout=1.0)
+                if msg is None:
+                    continue
+                _, payload = msg
+                if not payload:
+                    continue
+                t = asyncio.create_task(self._open(payload))
+                self._conns.add(t)
+                t.add_done_callback(self._conns.discard)
+        finally:
+            sub.close()
+
+    async def _open(self, payload: dict) -> None:
+        target = payload.get("target", "")
+        relay = payload.get("relay", "")
+        conn_id = payload.get("conn_id", "")
+        if not (target and relay and conn_id):
+            return
+        t_host, _, t_port = target.rpartition(":")
+        r_host, _, r_port = relay.rpartition(":")
+        try:
+            t_reader, t_writer = await asyncio.wait_for(
+                asyncio.open_connection(t_host, int(t_port)), timeout=5.0)
+        except (OSError, asyncio.TimeoutError) as exc:
+            log.warning("relay: target %s unreachable: %s", target, exc)
+            return
+        try:
+            r_reader, r_writer = await asyncio.wait_for(
+                asyncio.open_connection(r_host, int(r_port)), timeout=5.0)
+        except (OSError, asyncio.TimeoutError) as exc:
+            t_writer.close()
+            log.warning("relay: gateway %s unreachable: %s", relay, exc)
+            return
+        r_writer.write(conn_id.encode() + b"\n")
+        await r_writer.drain()
+        await pipe(t_reader, t_writer, r_reader, r_writer)

@@ -253,10 +253,19 @@ class AgentMachinePool(WorkerPoolController):
             log.warning("agent pool %s: no machine can host %s",
                         self.cfg.name, request.container_id)
             return
-        target = min(candidates, key=lambda m: m["desired"])
-        await self.store.incr(Keys.machine_desired(target["machine_id"]))
-        log.info("agent pool %s: machine %s desired -> %d",
-                 self.cfg.name, target["machine_id"], target["desired"] + 1)
+        # incr-then-check: two concurrent scale-ups (scheduler + pool
+        # warmup) may both pass _eligible; the loser undoes its bump and
+        # tries the next machine, so desired can never wedge above max
+        for m in sorted(candidates, key=lambda m: m["desired"]):
+            key = Keys.machine_desired(m["machine_id"])
+            n = await self.store.incr(key)
+            if n <= m["max_workers"]:
+                log.info("agent pool %s: machine %s desired -> %d",
+                         self.cfg.name, m["machine_id"], n)
+                return
+            await self.store.incr(key, by=-1, floor=0)
+        log.warning("agent pool %s: all machines full for %s",
+                    self.cfg.name, request.container_id)
 
     async def worker_count(self) -> int:
         total = 0

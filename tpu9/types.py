@@ -466,6 +466,9 @@ class WorkerState(_Serializable):
     cache_address: str = ""       # chunk-server address ("" = no cache)
     version: str = ""
     priority: int = 0
+    relay_only: bool = False      # host is NAT'd/unroutable: the gateway
+                                  # must never dial its container addresses
+                                  # directly, only via the relay tunnel
     build_capable: bool = True
     updated_at: float = field(default_factory=now)
 

@@ -61,7 +61,8 @@ class Worker:
                  object_resolver=None, image_resolver=None,
                  volume_sync=None, volume_push=None,
                  cache=None, checkpoints=None, disks=None,
-                 sandboxes=None, criu=None, phase_cb=None) -> None:
+                 sandboxes=None, criu=None, phase_cb=None,
+                 relay_only: bool = False) -> None:
         self.cfg = cfg or WorkerConfig()
         self.worker_id = worker_id or new_id("worker")
         self.pool = pool
@@ -100,6 +101,10 @@ class Worker:
         self.total_cpu = cpu_millicores or (psutil.cpu_count() or 1) * 1000
         self.total_mem = memory_mb or int(psutil.virtual_memory().total / 2**20)
 
+        # NAT'd hosts (BYOC agents): container addresses are private —
+        # the gateway must go through the relay, never a direct dial
+        self.relay_only = relay_only or bool(
+            os.environ.get("TPU9_RELAY_ONLY"))
         self._tasks: list[asyncio.Task] = []
         self._stopping = asyncio.Event()
         self._start_sem = asyncio.Semaphore(self.cfg.start_concurrency)
@@ -132,12 +137,17 @@ class Worker:
             address=f"{self.host}:{os.getpid()}",
             cache_address=(self.cache.server.address
                            if self.cache and self.cache.server.port else ""),
+            relay_only=self.relay_only,
         )
 
     async def start(self) -> "Worker":
         if self.cache is not None:
             await self.cache.start()
         await self.workers.register(self._state())
+        # answer gateway relay requests for containers the gateway can't
+        # dial directly (BYOC hosts behind NAT — network/relay.py)
+        from ..network import RelayAgent
+        self._relay = await RelayAgent(self.store, self.worker_id).start()
         self._tasks = [
             asyncio.create_task(self._heartbeat_loop()),
             asyncio.create_task(self._request_loop()),
@@ -160,6 +170,8 @@ class Worker:
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
+        if getattr(self, "_relay", None) is not None:
+            await self._relay.stop()
         if self.cache is not None:
             await self.cache.stop()
         try:
