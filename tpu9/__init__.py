@@ -43,6 +43,7 @@ _EXPORTS: dict[str, tuple[str, str]] = {
     "schema": ("tpu9.schema", None),
     "Bot": ("tpu9.sdk.bot", "Bot"),
     "BotLocation": ("tpu9.sdk.bot", "BotLocation"),
+    "PricingPolicy": ("tpu9.types", "PricingPolicy"),
 }
 
 __all__ = sorted(_EXPORTS) + ["__version__"]

@@ -299,6 +299,10 @@ class Gateway:
         r.add_delete("/api/v1/app/{app_id}", self._delete_app)
         r.add_get("/api/v1/events", self._events)
         r.add_get("/api/v1/pools", self._pools)
+        # workspaces (reference /api/v1/workspace group)
+        r.add_post("/api/v1/workspace", self._workspace_create)
+        r.add_post("/api/v1/workspace/{workspace_id}/token",
+                   self._workspace_token)
         # machines: BYOC agent fleet (reference pkg/agent + /api/v1/machine)
         r.add_post("/api/v1/machine", self._machine_create)
         r.add_get("/api/v1/machine", self._machine_list)
@@ -332,18 +336,30 @@ class Gateway:
                 store=self.store, host=self.cfg.gateway.host, port=port,
                 auth_token=self.cfg.database.state_auth_token).start()
         if self.cfg.gateway.relay_port:
-            from ..network import Dialer, RelayServer
-            # bind where the gateway itself binds: loopback-only dev setups
-            # must not grow a world-reachable unauthenticated port
-            self.relay = await RelayServer(
-                host=self.cfg.gateway.host or "0.0.0.0",
-                port=max(self.cfg.gateway.relay_port, 0)).start()
-            adv = (self.cfg.gateway.advertise_host
-                   or self.cfg.gateway.host or "127.0.0.1")
-            self.dialer = await Dialer(self.store, self.relay,
-                                       advertise_host=adv).start()
-            # every container-proxy surface routes through the dialer
-            self.endpoints.dialer = self.dialer
+            adv = self.cfg.gateway.advertise_host or self.cfg.gateway.host
+            if adv in ("", "0.0.0.0", "::"):
+                # a wildcard bind is not dialable by workers; external_url's
+                # host is the address they actually reach us at
+                ext = self.cfg.gateway.external_url
+                adv = ext.split("://", 1)[-1].split("/", 1)[0] \
+                    .rsplit(":", 1)[0] if ext else ""
+            if adv:
+                from ..network import Dialer, RelayServer
+                # bind where the gateway itself binds: loopback-only dev
+                # setups must not grow a world-reachable port
+                self.relay = await RelayServer(
+                    host=self.cfg.gateway.host or "0.0.0.0",
+                    port=max(self.cfg.gateway.relay_port, 0)).start()
+                self.dialer = await Dialer(self.store, self.relay,
+                                           advertise_host=adv).start()
+                # every container-proxy surface routes through the dialer
+                self.endpoints.dialer = self.dialer
+            else:
+                log.warning(
+                    "relay disabled: gateway binds %r and neither "
+                    "gateway.advertise_host nor gateway.external_url is set "
+                    "— workers could never dial back",
+                    self.cfg.gateway.host)
         await self.scheduler.start()
         await self.dispatcher.start()
         await self.functions.start()
@@ -1411,10 +1427,55 @@ class Gateway:
         stub = await self.backend.get_stub(dep.stub_id)
         if stub is None:
             return web.json_response({"error": "stub missing"}, status=500)
+        pricing = stub.config.pricing
+        external = ws is not None and ws.workspace_id != stub.workspace_id
+        # a priced deployment is invokable by OTHER authenticated workspaces
+        # (reference deployment.go:91: pricing overrides the owner-only
+        # check); anonymous access still requires authorized=False
+        priced_external = external and pricing is not None and pricing.enabled
         if stub.config.authorized and (ws is None or
-                                       ws.workspace_id != stub.workspace_id):
+                                       (external and not priced_external)):
             return web.json_response({"error": "unauthorized"}, status=401)
+        if priced_external:
+            return await self._serve_priced(request, stub, ws, pricing, tail)
+        return await self._serve_stub(request, stub, tail)
 
+    async def _serve_priced(self, request: web.Request, stub: Stub, ws,
+                            pricing, tail: str) -> web.Response:
+        """External pay-per-use call: gate on max_in_flight, serve, then
+        bill the caller and credit the owner (usage.go TrackTaskCost)."""
+        key = f"paid:inflight:{stub.stub_id}"
+        n = await self.store.incr(key)
+        # sliding TTL: a gateway crash mid-request must not leak slots
+        # forever (the finally-decrement never runs on SIGKILL)
+        await self.store.expire(key, 300.0)
+        try:
+            if n > max(1, pricing.max_in_flight):
+                return web.json_response(
+                    {"error": "paid capacity exhausted, retry later"},
+                    status=429)
+            t0 = time.monotonic()
+            resp = await self._serve_stub(request, stub, tail)
+            duration_ms = (time.monotonic() - t0) * 1000.0
+            if resp.status < 500:
+                if pricing.cost_model == "duration":
+                    cents = pricing.cost_per_task_duration_ms * duration_ms \
+                        * 100.0
+                else:
+                    cents = pricing.cost_per_task * 100.0
+                sid = stub.stub_id
+                await self.usage.record_request(
+                    ws.workspace_id, 1, metric=f"paid_tasks:{sid}")
+                await self.usage.record_request(
+                    ws.workspace_id, cents, metric=f"paid_cost_cents:{sid}")
+                await self.usage.record_request(
+                    stub.workspace_id, cents, metric=f"earned_cents:{sid}")
+            return resp
+        finally:
+            await self.store.incr(key, by=-1, floor=0)
+
+    async def _serve_stub(self, request: web.Request, stub: Stub,
+                          tail: str) -> web.Response:
         if (stub.stub_type == StubType.REALTIME.value
                 and request.headers.get("Upgrade", "").lower() == "websocket"):
             return await self._ws_proxy(stub, request)
@@ -1516,6 +1577,38 @@ class Gateway:
         return web.json_response({"ok": True})
 
     # -- concurrency limits + apps -------------------------------------------
+
+    # -- workspaces ----------------------------------------------------------
+
+    async def _workspace_create(self, request: web.Request) -> web.Response:
+        """Operator mints a workspace + its first token (reference
+        /api/v1/workspace)."""
+        self._require_operator(request)
+        data = await request.json()
+        name = data.get("name", "")
+        if not name:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "name required"}),
+                content_type="application/json")
+        if await self.backend.get_workspace_by_name(name) is not None:
+            raise web.HTTPConflict(
+                text=json.dumps({"error": f"workspace {name!r} exists"}),
+                content_type="application/json")
+        ws = await self.backend.create_workspace(name)
+        tok = await self.backend.create_token(ws.workspace_id)
+        return web.json_response({"workspace_id": ws.workspace_id,
+                                  "name": ws.name, "token": tok.key})
+
+    async def _workspace_token(self, request: web.Request) -> web.Response:
+        self._require_operator(request)
+        workspace_id = request.match_info["workspace_id"]
+        if await self.backend.get_workspace(workspace_id) is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "workspace not found"}),
+                content_type="application/json")
+        tok = await self.backend.create_token(workspace_id)
+        return web.json_response({"token": tok.key,
+                                  "token_id": tok.token_id})
 
     # -- machines (BYOC agents; reference pkg/agent + machine API) -----------
 

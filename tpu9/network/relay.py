@@ -113,7 +113,10 @@ class RelayServer:
         try:
             preamble = await asyncio.wait_for(reader.readline(),
                                               timeout=PAIR_TIMEOUT_S)
-        except (asyncio.TimeoutError, ConnectionError):
+        except (asyncio.TimeoutError, ConnectionError, OSError,
+                ValueError, asyncio.LimitOverrunError):
+            # ValueError/LimitOverrunError: >64KB of newline-free garbage on
+            # the unauthenticated port — drop it, never leak the socket
             writer.close()
             return
         conn_id = preamble.decode(errors="replace").strip()
@@ -139,6 +142,7 @@ class LocalTunnel:
         self.port = 0
         self._server: Optional[asyncio.AbstractServer] = None
         self.last_used = time.monotonic()
+        self.active = 0           # live relayed connections through me
 
     async def start(self) -> "LocalTunnel":
         self._server = await asyncio.start_server(self._on_client,
@@ -159,21 +163,26 @@ class LocalTunnel:
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
         self.last_used = time.monotonic()
-        # the conn id is the pairing secret: only the worker that received
-        # the pubsub message can present it, so make it unguessable
-        conn_id = "rconn-" + secrets.token_urlsafe(24)
-        fut = self.relay.expect(conn_id)
-        await self.store.publish(relay_channel(self.worker_id), {
-            "conn_id": conn_id, "target": self.target,
-            "relay": self.relay_advertise})
+        self.active += 1
         try:
-            w_reader, w_writer = await asyncio.wait_for(
-                fut, timeout=PAIR_TIMEOUT_S)
-        except (asyncio.TimeoutError, asyncio.CancelledError):
-            self.relay.forget(conn_id)
-            writer.close()
-            return
-        await pipe(reader, writer, w_reader, w_writer)
+            # the conn id is the pairing secret: only the worker that
+            # received the pubsub message can present it — unguessable
+            conn_id = "rconn-" + secrets.token_urlsafe(24)
+            fut = self.relay.expect(conn_id)
+            await self.store.publish(relay_channel(self.worker_id), {
+                "conn_id": conn_id, "target": self.target,
+                "relay": self.relay_advertise})
+            try:
+                w_reader, w_writer = await asyncio.wait_for(
+                    fut, timeout=PAIR_TIMEOUT_S)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self.relay.forget(conn_id)
+                writer.close()
+                return
+            await pipe(reader, writer, w_reader, w_writer)
+        finally:
+            self.active -= 1
+            self.last_used = time.monotonic()
 
 
 class Dialer:
@@ -202,11 +211,18 @@ class Dialer:
             await asyncio.sleep(60.0)
             try:
                 now = time.monotonic()
+                victims = []
                 async with self._lock:
                     for key, t in list(self._tunnels.items()):
-                        if now - t.last_used > TUNNEL_IDLE_S:
-                            await t.stop()
+                        # active==0 matters: wait_closed() blocks on live
+                        # handlers, and killing a long stream mid-flight is
+                        # exactly what GC must not do
+                        if t.active == 0 and now - t.last_used > TUNNEL_IDLE_S:
+                            victims.append(t)
                             del self._tunnels[key]
+                for t in victims:
+                    await t.stop()
+                async with self._lock:
                     # the probe cache self-expires by timestamp; just bound it
                     for addr, (_, ts) in list(self._direct.items()):
                         if now - ts > PROBE_CACHE_S:
@@ -223,13 +239,15 @@ class Dialer:
         hit = self._relay_only.get(worker_id)
         if hit is not None and time.monotonic() - hit[1] < WORKER_CACHE_S:
             return hit[0]
-        flag = False
         try:
             from ..repository import WorkerRepository
             w = await WorkerRepository(self.store).get(worker_id)
-            flag = bool(w and w.relay_only)
-        except Exception:  # noqa: BLE001 — fall back to probing
-            flag = False
+        except Exception:  # noqa: BLE001 — store hiccup: the SAFE answer
+            # is relay (an unnecessary tunnel fails cleanly; probing a
+            # NAT'd private address can mis-route user traffic to an
+            # unrelated LAN host). Not cached, so recovery is immediate.
+            return True
+        flag = bool(w and w.relay_only)
         self._relay_only[worker_id] = (flag, time.monotonic())
         return flag
 

@@ -73,7 +73,7 @@ class UsageService:
         self._task: Optional[asyncio.Task] = None
         self._stopping = asyncio.Event()
 
-    async def record_request(self, workspace_id: str, n: int = 1,
+    async def record_request(self, workspace_id: str, n: float = 1,
                              metric: str = "requests") -> None:
         key = usage_key(workspace_id, bucket_of())
         await self.store.hincr(key, metric, n)

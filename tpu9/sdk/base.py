@@ -54,6 +54,7 @@ class RunnerAbstraction:
                  disks: Optional[list] = None, authorized: bool = True,
                  runner: str = "", callback_url: str = "",
                  inputs: Any = None, outputs: Any = None,
+                 pricing: Any = None,
                  on_start: Optional[Callable] = None):
         self.func = func
         self.name = name
@@ -78,6 +79,14 @@ class RunnerAbstraction:
             from ..schema import schema_spec
             self.config.inputs = schema_spec(inputs) or {}
             self.config.outputs = schema_spec(outputs) or {}
+        if pricing is not None:
+            from ..types import PricingPolicy
+            if isinstance(pricing, dict):
+                pricing = PricingPolicy.from_dict(pricing)
+            if pricing.cost_model not in ("task", "duration"):
+                raise ValueError(
+                    f"bad pricing cost_model {pricing.cost_model!r}")
+            self.config.pricing = pricing
         if runner:
             self.config.extra["runner"] = runner
         if autoscaler is not None:

@@ -232,6 +232,20 @@ class CheckpointConfig(_Serializable):
 
 
 @dataclass
+class PricingPolicy(_Serializable):
+    """Pay-per-use publishing (reference sdk type.py:435 PricingPolicy +
+    pkg/abstractions/common/usage.go TrackTaskCost): a priced deployment is
+    invokable by OTHER authenticated workspaces; each call bills the caller
+    per task or per duration-ms and credits the owner."""
+
+    enabled: bool = True
+    cost_model: str = "task"            # "task" | "duration"
+    cost_per_task: float = 0.0          # dollars per invocation
+    cost_per_task_duration_ms: float = 0.0   # dollars per served ms
+    max_in_flight: int = 10             # concurrent external calls cap
+
+
+@dataclass
 class Runtime(_Serializable):
     """Resource request attached to a stub (reference sdk base/runner.py:373-535)."""
 
@@ -271,6 +285,7 @@ class StubConfig(_Serializable):
     task_policy: dict[str, Any] = field(default_factory=dict)
     inputs: dict[str, Any] = field(default_factory=dict)   # schema spec
     outputs: dict[str, Any] = field(default_factory=dict)  # schema spec
+    pricing: Optional[PricingPolicy] = None   # None = not publicly priced
     extra: dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -281,6 +296,8 @@ class StubConfig(_Serializable):
             return AutoscalerConfig.from_dict(v)
         if f.name == "checkpoint" and isinstance(v, dict):
             return CheckpointConfig.from_dict(v)
+        if f.name == "pricing" and isinstance(v, dict):
+            return PricingPolicy.from_dict(v)
         return v
 
 

@@ -103,8 +103,9 @@ class Worker:
 
         # NAT'd hosts (BYOC agents): container addresses are private —
         # the gateway must go through the relay, never a direct dial
-        self.relay_only = relay_only or bool(
-            os.environ.get("TPU9_RELAY_ONLY"))
+        self.relay_only = relay_only or (
+            os.environ.get("TPU9_RELAY_ONLY", "").lower()
+            not in ("", "0", "false", "no"))
         self._tasks: list[asyncio.Task] = []
         self._stopping = asyncio.Event()
         self._start_sem = asyncio.Semaphore(self.cfg.start_concurrency)
