@@ -1431,8 +1431,12 @@ class Gateway:
         external = ws is not None and ws.workspace_id != stub.workspace_id
         # a priced deployment is invokable by OTHER authenticated workspaces
         # (reference deployment.go:91: pricing overrides the owner-only
-        # check); anonymous access still requires authorized=False
-        priced_external = external and pricing is not None and pricing.enabled
+        # check). Billing only applies to authorized deployments — a PUBLIC
+        # (authorized=False) endpoint is free for everyone; charging only
+        # the callers who happened to send a token would be both unfair and
+        # trivially bypassed by dropping the header.
+        priced_external = (external and pricing is not None
+                           and pricing.enabled and stub.config.authorized)
         if stub.config.authorized and (ws is None or
                                        (external and not priced_external)):
             return web.json_response({"error": "unauthorized"}, status=401)
@@ -1446,9 +1450,13 @@ class Gateway:
         bill the caller and credit the owner (usage.go TrackTaskCost)."""
         key = f"paid:inflight:{stub.stub_id}"
         n = await self.store.incr(key)
-        # sliding TTL: a gateway crash mid-request must not leak slots
-        # forever (the finally-decrement never runs on SIGKILL)
-        await self.store.expire(key, 300.0)
+        if n == 1:
+            # crash-leak healing: armed ONLY on the first holder — a
+            # sliding refresh would let retry traffic keep a leaked count
+            # alive forever. A leaked key self-expires once the TTL (sized
+            # for the longest legitimate request) runs out.
+            await self.store.expire(
+                key, max(600.0, stub.config.timeout_s * 2))
         try:
             if n > max(1, pricing.max_in_flight):
                 return web.json_response(

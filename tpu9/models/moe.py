@@ -1,0 +1,140 @@
+"""Sparse mixture-of-experts FFN with expert parallelism.
+
+Reference has no in-framework MoE (SURVEY.md §2.10 — parallelism is
+delegated to user containers); this module is part of tpu9's TPU-first
+compute layer alongside TP/FSDP/ring attention.
+
+TPU-first design (GShard/Switch dispatch, not scatter/gather): routing
+builds a dense one-hot dispatch tensor ``[tokens, experts, capacity]`` and
+all data movement is einsums — which XLA lowers to all-to-alls when the
+expert dimension is sharded over the ``ep`` mesh axis, keeping every
+FLOP on the MXU and every transfer on ICI. No dynamic shapes, no host
+control flow: over-capacity tokens are dropped (their residual stream
+passes through untouched), exactly the standard capacity-factor contract.
+
+Params layout: every expert tensor has a leading ``n_experts`` dim sharded
+``P("ep")`` — one ``ep`` shard holds ``n_experts / ep`` full experts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = Any
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    dim: int = 512
+    hidden_dim: int = 1024
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    act: str = "silu"
+    dtype: Any = jnp.bfloat16
+
+
+def init_moe_layer(rng: jax.Array, cfg: MoeConfig) -> Params:
+    r1, r2, r3, r4 = jax.random.split(rng, 4)
+    dt = cfg.dtype
+    e, d, h = cfg.n_experts, cfg.dim, cfg.hidden_dim
+
+    def dense(r, shape, fan):
+        scale = (2.0 / sum(fan)) ** 0.5
+        return (jax.random.normal(r, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "router": dense(r1, (d, e), (d, e)).astype(jnp.float32),
+        "w_gate": dense(r2, (e, d, h), (d, h)),
+        "w_up": dense(r3, (e, d, h), (d, h)),
+        "w_down": dense(r4, (e, h, d), (h, d)),
+    }
+
+
+def moe_param_specs(params: Params) -> Params:
+    """Sharding: router replicated, expert stacks sharded over ``ep``."""
+    return {
+        "router": P(),
+        "w_gate": P("ep", None, None),
+        "w_up": P("ep", None, None),
+        "w_down": P("ep", None, None),
+    }
+
+
+def _capacity(n_tokens: int, cfg: MoeConfig) -> int:
+    cap = int(cfg.top_k * n_tokens * cfg.capacity_factor / cfg.n_experts)
+    # capacity must be static, positive, and lane-friendly
+    return max(8, -(-cap // 8) * 8)
+
+
+def moe_ffn(params: Params, x: jnp.ndarray, cfg: MoeConfig,
+            ep_sharded: bool = True):
+    """x: [B, T, dim] → ([B, T, dim], aux) where aux carries the
+    load-balancing loss (Switch §2.2: E * Σ_e f_e·p_e) and router stats.
+
+    Dropped tokens (over expert capacity) contribute zero here — callers
+    add the residual stream, so they pass through unchanged.
+    """
+    b, t, d = x.shape
+    n = b * t
+    e, k = cfg.n_experts, cfg.top_k
+    c = _capacity(n, cfg)
+    xf = x.reshape(n, d)
+
+    # -- routing (f32 for numerics) ------------------------------------------
+    logits = xf.astype(jnp.float32) @ params["router"]          # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)               # [N, k]
+    # renormalize the chosen gates so outputs are a convex combination
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # one-hot expert assignment per (token, slot): [N, k, E]
+    assign = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)
+
+    # position of each (token, slot) within its expert's buffer: running
+    # count of earlier claims on the same expert (token-major, slot-minor
+    # priority — earlier tokens win capacity, the GShard convention)
+    flat = assign.reshape(n * k, e)
+    pos = jnp.cumsum(flat, axis=0) - flat                        # [N*k, E]
+    pos = (pos * flat).sum(-1).reshape(n, k).astype(jnp.int32)   # [N, k]
+    in_cap = (pos < c).astype(jnp.float32)
+
+    # dispatch [N, E, C]: 1 where token n goes to expert e at slot c
+    slot_oh = jax.nn.one_hot(pos, c, dtype=jnp.float32)          # [N, k, C]
+    dispatch = jnp.einsum("nke,nkc->nec", assign, slot_oh * in_cap[..., None])
+    combine = jnp.einsum("nke,nkc,nk->nec", assign,
+                         slot_oh * in_cap[..., None], gate_vals)
+
+    # -- expert compute (leading E dim sharded over ep) ----------------------
+    xe = jnp.einsum("nec,nd->ecd", dispatch.astype(cfg.dtype),
+                    xf.astype(cfg.dtype))                        # [E, C, d]
+    if ep_sharded:
+        xe = jax.lax.with_sharding_constraint(xe, P("ep", None, None))
+    h = jnp.einsum("ecd,edh->ech", xe, params["w_gate"])
+    if cfg.act == "silu":
+        h = jax.nn.silu(h)
+    else:
+        h = jax.nn.gelu(h, approximate=True)
+    h = h * jnp.einsum("ecd,edh->ech", xe, params["w_up"])
+    ye = jnp.einsum("ech,ehd->ecd", h, params["w_down"])         # [E, C, d]
+    if ep_sharded:
+        ye = jax.lax.with_sharding_constraint(ye, P("ep", None, None))
+
+    out = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), ye)
+
+    # -- aux: load-balance loss + stats --------------------------------------
+    # fraction of tokens whose TOP-1 lands on e, times mean router prob
+    top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+    frac_tokens = top1.mean(0)
+    mean_prob = probs.mean(0)
+    balance_loss = e * jnp.sum(frac_tokens * mean_prob)
+    dropped = 1.0 - in_cap.mean()
+    aux = {"balance_loss": balance_loss, "dropped_frac": dropped,
+           "expert_load": frac_tokens}
+    return out.reshape(b, t, d).astype(x.dtype), aux

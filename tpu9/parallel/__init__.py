@@ -8,12 +8,15 @@ slice into a ``jax.sharding.Mesh`` with tp/fsdp/dp/sp axes and the collectives
 ride ICI via XLA.
 """
 
-from .mesh import make_mesh, mesh_for_spec, MeshAxes
+from .mesh import make_mesh, make_named_mesh, mesh_for_spec, MeshAxes
 from .sharding import (decoder_param_specs, fsdp_specs, shard_params,
                        constrain, replicate_specs)
 from .ring import ring_attention
+from .pipeline import pipeline_forward, stack_layers, stage_specs
 from .distributed import multihost_env, initialize_multihost
 
-__all__ = ["make_mesh", "mesh_for_spec", "MeshAxes", "decoder_param_specs",
+__all__ = ["make_mesh", "make_named_mesh", "mesh_for_spec", "MeshAxes",
+           "pipeline_forward", "stack_layers", "stage_specs",
+           "decoder_param_specs",
            "fsdp_specs", "shard_params", "constrain", "replicate_specs",
            "ring_attention", "multihost_env", "initialize_multihost"]

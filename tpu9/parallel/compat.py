@@ -1,0 +1,21 @@
+"""jax API compatibility shims for the parallel layer."""
+
+from __future__ import annotations
+
+import functools
+
+try:
+    from jax import shard_map as _shard_map
+    _KW = {"check_vma": False}
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _KW = {"check_rep": False}
+
+
+def shard_map(f=None, **kwargs):
+    """``jax.shard_map`` with replication checking off, spelled correctly
+    for whichever jax this is (new API: check_vma; old: check_rep)."""
+    kwargs = {**kwargs, **_KW}
+    if f is None:
+        return functools.partial(_shard_map, **kwargs)
+    return _shard_map(f, **kwargs)

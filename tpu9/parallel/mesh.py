@@ -36,6 +36,21 @@ def make_mesh(dp: int = 1, fsdp: int = 1, sp: int = 1, tp: int = 1,
     return Mesh(grid, MeshAxes)
 
 
+def make_named_mesh(axes: dict, devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh with arbitrary named axes, e.g. ``{"ep": 8}`` for expert
+    parallelism or ``{"pp": 4, "dp": 2}`` for a pipelined data-parallel
+    layout. Axis order in the dict is the device-grid order (outer =
+    slower interconnect, same convention as :func:`make_mesh`)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    need = 1
+    for n in axes.values():
+        need *= n
+    if need > len(devs):
+        raise ValueError(f"mesh needs {need} devices, have {len(devs)}")
+    grid = np.array(devs[:need]).reshape(*axes.values())
+    return Mesh(grid, tuple(axes))
+
+
 def mesh_for_spec(spec: TpuSpec, tp: Optional[int] = None, sp: int = 1,
                   dp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
     """Default mesh for a slice: tp defaults to chips_per_host (TP stays

@@ -19,10 +19,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from .compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
@@ -62,8 +59,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     spec = P(None, axis, None, None)
 
     @functools.partial(
-        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     def _ring(q_blk, k_blk, v_blk):
         idx = jax.lax.axis_index(axis)
         tq = q_blk.shape[1]
