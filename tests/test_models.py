@@ -131,3 +131,58 @@ class TestClassifier:
         l2 = classifier_forward(params, t2, m1, cfg)
         assert l1.shape == (1, cfg.n_classes)
         np.testing.assert_allclose(l1, l2, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# mixtral (sparse-MoE decoder family)
+# ---------------------------------------------------------------------------
+
+def test_mixtral_decoder_paths():
+    from dataclasses import replace
+
+    from tpu9.models import (MIXTRAL_PRESETS, decoder_forward, init_decoder,
+                             init_kv_cache)
+
+    cfg = replace(MIXTRAL_PRESETS["mixtral-tiny"], dtype=jnp.float32)
+    params = init_decoder(jax.random.PRNGKey(0), cfg)
+    assert "moe" in params["layers"][0]
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                              cfg.vocab_size)
+    logits = decoder_forward(params, toks, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+    # balance aux is exposed for training
+    _, aux = decoder_forward(params, toks, cfg, return_moe_aux=True)
+    assert float(aux) >= 1.0 - 1e-4
+
+    # prefill + decode through the kv cache
+    cache = init_kv_cache(cfg, 2, 64)
+    lg, cache = decoder_forward(params, toks[:, :8], cfg, kv_cache=cache)
+    tok = lg[:, -1:].argmax(-1).astype(jnp.int32)
+    lg2, cache = decoder_forward(
+        params, tok, cfg, positions=jnp.full((2, 1), 8, jnp.int32),
+        kv_cache=cache, cache_len=jnp.full((2,), 9, jnp.int32), decode=True)
+    assert lg2.shape == (2, 1, cfg.vocab_size)
+
+
+def test_mixtral_tp_sharded_matches_single_device():
+    from dataclasses import replace
+
+    import numpy as np
+
+    from tpu9.models import MIXTRAL_PRESETS, decoder_forward, init_decoder
+    from tpu9.parallel import decoder_param_specs, make_mesh, shard_params
+
+    cfg = replace(MIXTRAL_PRESETS["mixtral-tiny"], dtype=jnp.float32)
+    params = init_decoder(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                              cfg.vocab_size)
+    ref = decoder_forward(params, toks, cfg)
+
+    mesh = make_mesh(dp=1, fsdp=2, sp=1, tp=4)
+    sharded = shard_params(params, mesh, decoder_param_specs(params))
+    with mesh:
+        out = jax.jit(lambda p, t: decoder_forward(p, t, cfg))(sharded, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

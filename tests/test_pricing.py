@@ -119,10 +119,14 @@ async def test_max_in_flight_gates_external_calls():
                                      "max_in_flight": 1}, name="capped")
         _, session = await _second_ws(stack)
         try:
-            # saturate the single slot artificially
+            # saturate the single slot artificially (a live entry with an
+            # unexpired deadline)
+            import time as _time
             dep = await stack.gateway.backend.get_deployment(
                 stack.gateway.default_workspace.workspace_id, "capped")
-            await stack.gateway.store.incr("paid:inflight:" + dep.stub_id)
+            await stack.gateway.store.hset(
+                "paid:inflight:" + dep.stub_id, "pr-held",
+                _time.time() + 600)
             async with session.post(
                     f"{stack.base_url}/endpoint/{dep.subdomain}",
                     json={}) as r:
@@ -150,6 +154,45 @@ def test_sdk_pricing_declaration():
     with pytest.raises(ValueError):
         tpu9.endpoint(name="bad", pricing={"cost_model": "nope"})(
             lambda **kw: kw)
+
+
+async def test_stale_inflight_entries_are_pruned():
+    """A crash-leaked in-flight entry (deadline passed) must not wedge the
+    cap — the next admission prunes it and serves."""
+    import time as _time
+
+    async with LocalStack() as stack:
+        dep = await _deploy_priced(stack, {"cost_per_task": 0.01,
+                                           "max_in_flight": 1},
+                                   name="healed")
+        row = await stack.gateway.backend.get_deployment(
+            stack.gateway.default_workspace.workspace_id, "healed")
+        # simulate a gateway crash mid-request: entry left with an
+        # already-expired deadline
+        await stack.gateway.store.hset(
+            "paid:inflight:" + row.stub_id, "pr-leaked", _time.time() - 1)
+        _, session = await _second_ws(stack)
+        try:
+            async with session.post(
+                    f"{stack.base_url}/endpoint/{dep['subdomain']}",
+                    json={}, timeout=aiohttp.ClientTimeout(total=120)) as r:
+                assert r.status == 200, await r.text()
+        finally:
+            await session.close()
+        left = await stack.gateway.store.hgetall(
+            "paid:inflight:" + row.stub_id)
+        assert "pr-leaked" not in (left or {})
+
+
+async def test_pricing_requires_authorized():
+    async with LocalStack() as stack:
+        status, out = await stack.api("POST", "/rpc/stub/get-or-create",
+                                      json_body={
+            "name": "freepaid", "stub_type": "endpoint",
+            "config": {"handler": "app:handler", "authorized": False,
+                       "pricing": {"cost_per_task": 0.01}}})
+        assert status == 400, out
+        assert "authorized" in out["error"]
 
 
 async def test_workspace_api_operator_only():

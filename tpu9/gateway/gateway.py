@@ -44,7 +44,8 @@ from ..repository.keys import Keys
 from ..scheduler import Scheduler
 from ..statestore import MemoryStore, RemoteStore, StateServer, StateStore
 from ..task import Dispatcher
-from ..types import Stub, StubConfig, StubType, TaskPolicy, Workspace
+from ..types import (Stub, StubConfig, StubType, TaskPolicy, Workspace,
+                     new_id)
 
 log = logging.getLogger("tpu9.gateway")
 
@@ -599,6 +600,15 @@ class Gateway:
                           f"(valid: {[t.value for t in StubType]})"},
                 status=400)
         config = StubConfig.from_dict(data.get("config", {}))
+        if (config.pricing is not None and config.pricing.enabled
+                and not config.authorized):
+            # pricing only bills authenticated external callers; on a
+            # public endpoint every caller could go anonymous and free —
+            # reject the combination instead of silently giving away
+            # paid compute
+            return web.json_response(
+                {"error": "pricing requires authorized=True (a public "
+                          "endpoint cannot be billed)"}, status=400)
         stub = await self.backend.get_or_create_stub(
             workspace_id=ws.workspace_id,
             name=data["name"],
@@ -1448,20 +1458,37 @@ class Gateway:
                             pricing, tail: str) -> web.Response:
         """External pay-per-use call: gate on max_in_flight, serve, then
         bill the caller and credit the owner (usage.go TrackTaskCost)."""
+        # in-flight tracking as timestamped entries, not a bare counter: a
+        # crash-leaked entry expires individually (its deadline passes and
+        # the next admission prunes it) without the counter-corruption a
+        # whole-key TTL causes under continuous load. Entry count is
+        # bounded by max_in_flight + leaks, so the prune scan stays tiny.
         key = f"paid:inflight:{stub.stub_id}"
-        n = await self.store.incr(key)
-        if n == 1:
-            # crash-leak healing: armed ONLY on the first holder — a
-            # sliding refresh would let retry traffic keep a leaked count
-            # alive forever. A leaked key self-expires once the TTL (sized
-            # for the longest legitimate request) runs out.
-            await self.store.expire(
-                key, max(600.0, stub.config.timeout_s * 2))
+        req_entry = new_id("pr")
+        deadline = time.time() + max(600.0, stub.config.timeout_s * 2)
+        lock_key = key + ":lock"
+        lock_tok = new_id("pl")
+        for _ in range(200):
+            if await self.store.acquire_lock(lock_key, lock_tok, ttl=5.0):
+                break
+            await asyncio.sleep(0.01)
+        else:
+            return web.json_response({"error": "admission lock stuck"},
+                                     status=503)
         try:
-            if n > max(1, pricing.max_in_flight):
+            now_ts = time.time()
+            entries = await self.store.hgetall(key) or {}
+            stale = [k for k, v in entries.items() if float(v) <= now_ts]
+            if stale:
+                await self.store.hdel(key, *stale)
+            if len(entries) - len(stale) >= max(1, pricing.max_in_flight):
                 return web.json_response(
                     {"error": "paid capacity exhausted, retry later"},
                     status=429)
+            await self.store.hset(key, req_entry, deadline)
+        finally:
+            await self.store.release_lock(lock_key, lock_tok)
+        try:
             t0 = time.monotonic()
             resp = await self._serve_stub(request, stub, tail)
             duration_ms = (time.monotonic() - t0) * 1000.0
@@ -1480,7 +1507,7 @@ class Gateway:
                     stub.workspace_id, cents, metric=f"earned_cents:{sid}")
             return resp
         finally:
-            await self.store.incr(key, by=-1, floor=0)
+            await self.store.hdel(key, req_entry)
 
     async def _serve_stub(self, request: web.Request, stub: Stub,
                           tail: str) -> web.Response:

@@ -11,11 +11,13 @@ from .llama import LLAMA_PRESETS, llama_config
 from .gemma import GEMMA_PRESETS, gemma_config
 from .clip_vit import ClipVisionConfig, init_clip_vision, clip_vision_forward, CLIP_VIT_L14
 from .classifier import TextClassifierConfig, init_classifier, classifier_forward
-from . import lora
+from . import lora, moe
+from .mixtral import MIXTRAL_PRESETS, mixtral_config
 
 __all__ = [
     "DecoderConfig", "init_decoder", "decoder_forward", "init_kv_cache",
     "LLAMA_PRESETS", "llama_config", "GEMMA_PRESETS", "gemma_config",
+    "MIXTRAL_PRESETS", "mixtral_config", "moe",
     "ClipVisionConfig", "init_clip_vision", "clip_vision_forward", "CLIP_VIT_L14",
     "TextClassifierConfig", "init_classifier", "classifier_forward", "lora",
 ]

@@ -1,0 +1,32 @@
+"""Mixtral-family (sparse MoE decoder) configs.
+
+Architecture constants follow the public Mixtral-8x7B card (Llama-shaped
+attention + 8-expert top-2 sparse FFN). No reference analogue — the
+reference delegates models to user containers (SURVEY.md §2.10); tpu9
+ships the family so `ep`-sharded serving works out of the box.
+"""
+
+from __future__ import annotations
+
+from .transformer import DecoderConfig
+
+
+def mixtral_config(**kw) -> DecoderConfig:
+    base = dict(act="silu", norm_offset=0.0, rope_theta=1e6,
+                norm_eps=1e-5, tie_embeddings=False,
+                n_experts=8, moe_top_k=2)
+    base.update(kw)
+    return DecoderConfig(**base)
+
+
+MIXTRAL_PRESETS: dict[str, DecoderConfig] = {
+    # test-scale: 4 experts, exercised by unit tests / CPU dry-runs
+    "mixtral-tiny": mixtral_config(vocab_size=512, dim=128, n_layers=2,
+                                   n_heads=4, n_kv_heads=2, head_dim=32,
+                                   hidden_dim=256, max_seq_len=512,
+                                   n_experts=4),
+    # Mixtral-8x7B: 47B total / ~13B active per token
+    "mixtral-8x7b": mixtral_config(vocab_size=32000, dim=4096, n_layers=32,
+                                   n_heads=32, n_kv_heads=8, head_dim=128,
+                                   hidden_dim=14336, max_seq_len=32768),
+}

@@ -43,6 +43,10 @@ class DecoderConfig:
     embed_scale: bool = False      # gemma scales embeddings by sqrt(dim)
     logit_softcap: float = 0.0     # gemma-2 style; 0 = off
     tie_embeddings: bool = False   # output head = embed^T
+    # sparse-MoE FFN (mixtral family): n_experts 0 = dense
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
 
     @property
@@ -78,7 +82,7 @@ def init_decoder(rng: jax.Array, cfg: DecoderConfig) -> Params:
 
     q_dim = cfg.n_heads * cfg.head_dim
     kv_dim = cfg.n_kv_heads * cfg.head_dim
-    for _ in range(cfg.n_layers):
+    for li in range(cfg.n_layers):
         layer = {
             "attn_norm": jnp.ones((cfg.dim,), dtype=jnp.float32) - cfg.norm_offset,
             "mlp_norm": jnp.ones((cfg.dim,), dtype=jnp.float32) - cfg.norm_offset,
@@ -86,12 +90,26 @@ def init_decoder(rng: jax.Array, cfg: DecoderConfig) -> Params:
             "wk": _dense_init(nxt(), cfg.dim, kv_dim, dt),
             "wv": _dense_init(nxt(), cfg.dim, kv_dim, dt),
             "wo": _dense_init(nxt(), q_dim, cfg.dim, dt),
-            "w_gate": _dense_init(nxt(), cfg.dim, cfg.hidden_dim, dt),
-            "w_up": _dense_init(nxt(), cfg.dim, cfg.hidden_dim, dt),
-            "w_down": _dense_init(nxt(), cfg.hidden_dim, cfg.dim, dt),
         }
+        if cfg.n_experts:
+            from .moe import MoeConfig, init_moe_layer
+            layer["moe"] = init_moe_layer(
+                jax.random.fold_in(nxt(), li), _moe_cfg(cfg))
+            nxt(), nxt()   # keep the rng schedule aligned with dense
+        else:
+            layer["w_gate"] = _dense_init(nxt(), cfg.dim, cfg.hidden_dim, dt)
+            layer["w_up"] = _dense_init(nxt(), cfg.dim, cfg.hidden_dim, dt)
+            layer["w_down"] = _dense_init(nxt(), cfg.hidden_dim, cfg.dim, dt)
         params["layers"].append(layer)
     return params
+
+
+def _moe_cfg(cfg: DecoderConfig):
+    from .moe import MoeConfig
+    return MoeConfig(dim=cfg.dim, hidden_dim=cfg.hidden_dim,
+                     n_experts=cfg.n_experts, top_k=cfg.moe_top_k,
+                     capacity_factor=cfg.moe_capacity_factor,
+                     act=cfg.act, dtype=cfg.dtype)
 
 
 def init_kv_cache(cfg: DecoderConfig, batch: int, max_len: int = 0,
@@ -164,10 +182,14 @@ def _scatter_kv(cache: jnp.ndarray, kv: jnp.ndarray,
     return jax.vmap(write_one)(cache, kv, idx)
 
 
-def _mlp_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
+def _mlp_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig):
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, cfg.norm_offset)
+    if cfg.n_experts:
+        from .moe import moe_ffn
+        y, aux = moe_ffn(layer["moe"], h, _moe_cfg(cfg), ep_sharded=False)
+        return x + y, aux
     gated = _act(maybe_matmul(h, layer["w_gate"]), cfg.act) * maybe_matmul(h, layer["w_up"])
-    return x + maybe_matmul(gated, layer["w_down"])
+    return x + maybe_matmul(gated, layer["w_down"]), None
 
 
 def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
@@ -175,7 +197,8 @@ def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
                     kv_cache: Optional[Params] = None,
                     cache_len: Optional[jnp.ndarray] = None,
                     decode: bool = False,
-                    return_hidden: bool = False):
+                    return_hidden: bool = False,
+                    return_moe_aux: bool = False):
     """Run the decoder.
 
     - train/eval: ``decoder_forward(params, tokens, cfg)`` → logits [B,T,V]
@@ -194,13 +217,16 @@ def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
     sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
 
     new_k, new_v = [], []
+    moe_balance = jnp.zeros((), jnp.float32)
     for i, layer in enumerate(params["layers"]):
         x, updated = _attn_block(layer, x, cfg, positions, sin, cos,
                                  kv_cache, i, cache_len, decode)
         if updated is not None:
             new_k.append(updated[0])
             new_v.append(updated[1])
-        x = _mlp_block(layer, x, cfg)
+        x, aux = _mlp_block(layer, x, cfg)
+        if aux is not None:
+            moe_balance = moe_balance + aux["balance_loss"]
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps, cfg.norm_offset)
     if return_hidden:
@@ -214,6 +240,12 @@ def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
             logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
 
     out = x if return_hidden else logits
+    if return_moe_aux:
+        # mean balance loss across layers (training regularizer)
+        aux = moe_balance / max(cfg.n_layers, 1)
+        if kv_cache is not None:
+            return out, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}, aux
+        return out, aux
     if kv_cache is not None:
         cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
         return out, cache

@@ -3,18 +3,28 @@
 from __future__ import annotations
 
 import functools
+import inspect
 
 try:
     from jax import shard_map as _shard_map
-    _KW = {"check_vma": False}
-except ImportError:  # older jax: experimental module, check_rep kwarg
+except ImportError:  # older jax: experimental module
     from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg was renamed check_rep → check_vma; key on the
+# actual signature, not the import location (mid-window jax versions export
+# jax.shard_map while still taking check_rep)
+_PARAMS = inspect.signature(_shard_map).parameters
+if "check_vma" in _PARAMS:
+    _KW = {"check_vma": False}
+elif "check_rep" in _PARAMS:
     _KW = {"check_rep": False}
+else:
+    _KW = {}
 
 
 def shard_map(f=None, **kwargs):
     """``jax.shard_map`` with replication checking off, spelled correctly
-    for whichever jax this is (new API: check_vma; old: check_rep)."""
+    for whichever jax this is."""
     kwargs = {**kwargs, **_KW}
     if f is None:
         return functools.partial(_shard_map, **kwargs)

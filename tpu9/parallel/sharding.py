@@ -37,7 +37,8 @@ def _quant_aware(spec: P, leaf) -> Any:
     return spec
 
 
-def _layer_specs(layer: Params, tp: str, fsdp: Optional[str]) -> dict:
+def _layer_specs(layer: Params, tp: str, fsdp: Optional[str],
+                 moe_axis: Optional[str] = None) -> dict:
     base = {
         "attn_norm": P(),
         "mlp_norm": P(),
@@ -49,18 +50,34 @@ def _layer_specs(layer: Params, tp: str, fsdp: Optional[str]) -> dict:
         "w_up": P(fsdp, tp),
         "w_down": P(tp, fsdp),
     }
-    return {name: _quant_aware(spec, layer.get(name))
-            for name, spec in base.items() if name in layer}
+    out = {name: _quant_aware(spec, layer.get(name))
+           for name, spec in base.items() if name in layer}
+    if moe_axis is None:
+        moe_axis = tp
+    if "moe" in layer:
+        # mixtral layers: the expert (leading) dim shards over ``moe_axis``
+        # — "tp" by default so a plain tp/fsdp serving mesh works; pass
+        # moe_axis="ep" to decoder_param_specs on ep meshes. shard_params
+        # replicates instead when n_experts isn't divisible by the axis
+        # size (e.g. 8 experts on tp=16).
+        out["moe"] = {
+            "router": P(),
+            "w_gate": P(moe_axis, None, None),
+            "w_up": P(moe_axis, None, None),
+            "w_down": P(moe_axis, None, None),
+        }
+    return out
 
 
 def decoder_param_specs(params: Params, tp: str = "tp",
-                        fsdp: Optional[str] = "fsdp") -> Params:
-    """PartitionSpec tree matching a decoder param tree (dense or int8-
-    quantized)."""
+                        fsdp: Optional[str] = "fsdp",
+                        moe_axis: Optional[str] = None) -> Params:
+    """PartitionSpec tree matching a decoder param tree (dense, int8-
+    quantized, or MoE — expert dims shard over ``moe_axis``, default tp)."""
     specs: Params = {
         "embed": P(fsdp, None),
         "final_norm": P(),
-        "layers": [_layer_specs(layer, tp, fsdp)
+        "layers": [_layer_specs(layer, tp, fsdp, moe_axis=moe_axis)
                    for layer in params["layers"]],
     }
     if "lm_head" in params:

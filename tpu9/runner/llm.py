@@ -45,8 +45,14 @@ def _build_engine(obj):
     if isinstance(obj, str):
         import jax
         from ..models import init_decoder
+        from ..models.gemma import GEMMA_PRESETS
         from ..models.llama import LLAMA_PRESETS
-        cfg = LLAMA_PRESETS[obj]
+        from ..models.mixtral import MIXTRAL_PRESETS
+        presets = {**LLAMA_PRESETS, **GEMMA_PRESETS, **MIXTRAL_PRESETS}
+        if obj not in presets:
+            raise KeyError(f"unknown model preset {obj!r}; have "
+                           f"{sorted(presets)}")
+        cfg = presets[obj]
         params = init_decoder(jax.random.PRNGKey(0), cfg)
         return InferenceEngine(params, cfg, EngineConfig())
     raise TypeError(f"handler must return an engine, (params, cfg) or a "
