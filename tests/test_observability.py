@@ -194,3 +194,84 @@ async def test_usage_and_traces_flow_through_stack():
         cold = [s for s in traces["spans"]
                 if s["name"] == "worker.cold_start"][0]
         assert sched["traceId"] == cold["traceId"]
+
+
+# ---------------------------------------------------------------------------
+# OTLP export (reference pkg/common/trace.go OTLP-HTTP exporter)
+# ---------------------------------------------------------------------------
+
+async def test_otlp_exporter_pushes_spans_and_metrics():
+    from tpu9.observability.metrics import Metrics
+    from tpu9.observability.otel import OtlpExporter
+    from tpu9.observability.trace import Tracer
+
+    tracer = Tracer(service="test-svc")
+    registry = Metrics()
+    with tracer.span("outer", attrs={"stub_id": "s1"}):
+        with tracer.span("inner"):
+            pass
+    registry.inc("tpu9_requests", 3, {"route": "invoke"})
+    registry.set_gauge("tpu9_pool_workers", 2, {"pool": "default"})
+    registry.observe("tpu9_startup_phase_s", 0.25, {"phase": "image"})
+
+    pushes = []
+
+    async def transport(path, payload):
+        pushes.append((path, payload))
+        return 200
+
+    exp = OtlpExporter("http://collector:4318", service="test-svc",
+                       transport=transport, tracer=tracer,
+                       registry=registry)
+    exp._last_flush = 0.0   # everything counts as "since last flush"
+    out = await exp.flush()
+    assert out["spans"] == 2
+    assert out["trace_status"] == 200 and out["metrics_status"] == 200
+
+    (tpath, tpayload), (mpath, mpayload) = pushes
+    assert tpath == "/v1/traces" and mpath == "/v1/metrics"
+    spans = tpayload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    names = {s["name"] for s in spans}
+    assert names == {"outer", "inner"}
+    inner = next(s for s in spans if s["name"] == "inner")
+    outer = next(s for s in spans if s["name"] == "outer")
+    assert inner["parentSpanId"] == outer["spanId"]
+    assert {"key": "service.name",
+            "value": {"stringValue": "test-svc"}} in \
+        tpayload["resourceSpans"][0]["resource"]["attributes"]
+
+    ms = mpayload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+    by_name = {m["name"]: m for m in ms}
+    sum_pt = by_name["tpu9_requests"]["sum"]["dataPoints"][0]
+    assert sum_pt["asDouble"] == 3.0
+    assert {"key": "route", "value": {"stringValue": "invoke"}} \
+        in sum_pt["attributes"]
+    assert by_name["tpu9_pool_workers"]["gauge"]["dataPoints"][0][
+        "asDouble"] == 2.0
+    summ_pt = by_name["tpu9_startup_phase_s"]["summary"]["dataPoints"][0]
+    assert summ_pt["count"] == "1"
+
+    # incremental: a second flush with nothing new pushes no spans
+    pushes.clear()
+    out2 = await exp.flush()
+    assert out2["spans"] == 0
+    assert [p for p, _ in pushes] == ["/v1/metrics"]
+
+
+async def test_otlp_flush_survives_transport_failure():
+    from tpu9.observability.metrics import Metrics
+    from tpu9.observability.otel import OtlpExporter
+    from tpu9.observability.trace import Tracer
+
+    calls = []
+
+    async def broken(path, payload):
+        calls.append(path)
+        raise OSError("collector down")
+
+    exp = OtlpExporter("http://x", transport=broken, tracer=Tracer(),
+                       registry=Metrics(), interval_s=0.01)
+    await exp.start()
+    await __import__("asyncio").sleep(0.1)
+    await exp.stop()          # loop survived repeated failures
+    assert calls              # and kept trying

@@ -134,6 +134,8 @@ class ImageConfig:
 class MonitoringConfig:
     metrics_enabled: bool = True
     metrics_push_url: str = ""
+    otlp_endpoint: str = ""         # e.g. http://collector:4318 ("" = off)
+    otlp_interval_s: float = 15.0
     events_sink: str = "state"      # state | http | none
     events_http_url: str = ""
     log_level: str = "INFO"

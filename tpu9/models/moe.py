@@ -56,13 +56,15 @@ def init_moe_layer(rng: jax.Array, cfg: MoeConfig) -> Params:
     }
 
 
-def moe_param_specs(params: Params) -> Params:
-    """Sharding: router replicated, expert stacks sharded over ``ep``."""
+def moe_param_specs(params: Params, axis: str = "ep") -> Params:
+    """Sharding: router replicated, expert stacks sharded over ``axis``
+    (the expert-parallel axis by default; decoder_param_specs passes tp
+    for mixtral layers on plain serving meshes)."""
     return {
         "router": P(),
-        "w_gate": P("ep", None, None),
-        "w_up": P("ep", None, None),
-        "w_down": P("ep", None, None),
+        "w_gate": P(axis, None, None),
+        "w_up": P(axis, None, None),
+        "w_down": P(axis, None, None),
     }
 
 

@@ -1,0 +1,194 @@
+"""OTLP-HTTP export: push spans and metrics to an OpenTelemetry collector.
+
+Reference analogue: ``pkg/common/trace.go:12-40`` (OTLP-HTTP exporter
+enabled per config) and the VictoriaMetrics push path
+(``pkg/metrics/metrics.go:29``). tpu9's tracer/metrics stay in-process by
+default (queryable at /api/v1/traces and /api/v1/metrics); this exporter
+adds the push side: OTLP/JSON over HTTP (`/v1/traces`, `/v1/metrics`) on a
+flush interval, incremental (only spans finished since the last flush).
+
+The HTTP transport is injectable so the wire format is testable in a
+zero-egress image — the same pattern GceTpuPool uses for the GCP API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Optional
+
+from .metrics import metrics as metrics_registry
+from .trace import tracer as global_tracer
+
+log = logging.getLogger("tpu9.observability")
+
+
+def _attr(k: str, v) -> dict:
+    if isinstance(v, bool):
+        return {"key": k, "value": {"boolValue": v}}
+    if isinstance(v, int):
+        return {"key": k, "value": {"intValue": str(v)}}
+    if isinstance(v, float):
+        return {"key": k, "value": {"doubleValue": v}}
+    return {"key": k, "value": {"stringValue": str(v)}}
+
+
+def spans_to_otlp(spans: list[dict], service: str) -> dict:
+    """tpu9 span dicts (trace.py Span.to_dict) → OTLP/JSON ExportTraceServiceRequest."""
+    otlp_spans = []
+    for s in spans:
+        otlp_spans.append({
+            "traceId": s["traceId"],
+            "spanId": s["spanId"],
+            "parentSpanId": s.get("parentSpanId", ""),
+            "name": s["name"],
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(s["startTimeUnixNano"]),
+            "endTimeUnixNano": str(s["endTimeUnixNano"]),
+            "attributes": [_attr(k, v) for k, v in
+                           (s.get("attributes") or {}).items()],
+            "status": {"code": 2 if s.get("status") == "error" else 1},
+        })
+    return {"resourceSpans": [{
+        "resource": {"attributes": [_attr("service.name", service)]},
+        "scopeSpans": [{"scope": {"name": "tpu9"}, "spans": otlp_spans}],
+    }]}
+
+
+def _parse_key(key: str) -> tuple[str, list]:
+    """``name{k="v",k2="v2"}`` (the registry's prometheus-style key) →
+    (name, [attr,...])."""
+    name, _, rest = key.partition("{")
+    attrs = []
+    if rest:
+        for pair in rest.rstrip("}").split(","):
+            k, _, v = pair.partition("=")
+            if k:
+                attrs.append(_attr(k, v.strip('"')))
+    return name, attrs
+
+
+def metrics_to_otlp(snapshot: dict, service: str) -> dict:
+    """Metrics registry ``to_dict()`` → OTLP/JSON
+    ExportMetricsServiceRequest. Counters map to monotonic sums, gauges to
+    gauges, summaries to OTLP summary points with p50/p95 quantiles."""
+    now_ns = str(int(time.time() * 1e9))
+    by_metric: dict[str, dict] = {}
+
+    def entry(name: str, kind: str) -> dict:
+        m = by_metric.setdefault(name, {"name": name})
+        if kind == "sum":
+            return m.setdefault("sum", {
+                "aggregationTemporality": 2,  # CUMULATIVE
+                "isMonotonic": True, "dataPoints": []})
+        if kind == "gauge":
+            return m.setdefault("gauge", {"dataPoints": []})
+        return m.setdefault("summary", {"dataPoints": []})
+
+    for key, v in snapshot.get("counters", {}).items():
+        name, attrs = _parse_key(key)
+        entry(name, "sum")["dataPoints"].append(
+            {"timeUnixNano": now_ns, "asDouble": v, "attributes": attrs})
+    for key, v in snapshot.get("gauges", {}).items():
+        name, attrs = _parse_key(key)
+        entry(name, "gauge")["dataPoints"].append(
+            {"timeUnixNano": now_ns, "asDouble": v, "attributes": attrs})
+    for key, summ in snapshot.get("summaries", {}).items():
+        name, attrs = _parse_key(key)
+        entry(name, "summary")["dataPoints"].append({
+            "timeUnixNano": now_ns, "attributes": attrs,
+            "count": str(int(summ.get("count", 0))),
+            "sum": summ.get("mean", 0.0) * summ.get("count", 0),
+            "quantileValues": [
+                {"quantile": 0.5, "value": summ.get("p50", 0.0)},
+                {"quantile": 0.95, "value": summ.get("p95", 0.0)},
+                {"quantile": 1.0, "value": summ.get("max", 0.0)},
+            ]})
+    return {"resourceMetrics": [{
+        "resource": {"attributes": [_attr("service.name", service)]},
+        "scopeMetrics": [{"scope": {"name": "tpu9"},
+                          "metrics": list(by_metric.values())}],
+    }]}
+
+
+class OtlpExporter:
+    """Flush-loop pusher. ``transport(path, payload) -> status`` is
+    injectable; the default POSTs JSON to ``endpoint + path``."""
+
+    def __init__(self, endpoint: str, service: str = "tpu9",
+                 interval_s: float = 15.0,
+                 transport: Optional[Callable] = None,
+                 tracer=None, registry=None):
+        self.endpoint = endpoint.rstrip("/")
+        self.service = service
+        self.interval_s = interval_s
+        self.transport = transport or self._http_post
+        self.tracer = tracer if tracer is not None else global_tracer
+        self.registry = registry if registry is not None else metrics_registry
+        self._last_flush = time.time()
+        self._task: Optional[asyncio.Task] = None
+        self._session = None
+
+    async def _http_post(self, path: str, payload: dict) -> int:
+        import aiohttp
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        async with self._session.post(
+                self.endpoint + path, json=payload,
+                timeout=aiohttp.ClientTimeout(total=10)) as resp:
+            return resp.status
+
+    async def start(self) -> "OtlpExporter":
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        try:
+            await self.flush()     # final drain
+        except Exception:  # noqa: BLE001 — best-effort on shutdown
+            pass
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+            self._session = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.flush()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — collector outages
+                # must not kill the loop; the next flush retries
+                log.warning("otlp flush failed: %s", exc)
+
+    async def flush(self) -> dict:
+        """Push spans finished since the last flush + a current metrics
+        snapshot. The window only advances after a successful trace push,
+        so a collector outage retries the same window next flush instead
+        of silently dropping it (bounded by the tracer's ring capacity —
+        a long outage still loses the oldest spans, honestly).
+        Returns {spans: n, trace_status, metrics_status}."""
+        cutoff = time.time()
+        spans = self.tracer.export(since=self._last_flush, limit=5000)
+        out = {"spans": len(spans)}
+        if spans:
+            status = await self.transport(
+                "/v1/traces", spans_to_otlp(spans, self.service))
+            out["trace_status"] = status
+            if status >= 400:
+                raise RuntimeError(f"otlp trace push got {status}")
+        self._last_flush = cutoff
+        snap = self.registry.to_dict()
+        out["metrics_status"] = await self.transport(
+            "/v1/metrics", metrics_to_otlp(snap, self.service))
+        return out

@@ -52,20 +52,14 @@ def _layer_specs(layer: Params, tp: str, fsdp: Optional[str],
     }
     out = {name: _quant_aware(spec, layer.get(name))
            for name, spec in base.items() if name in layer}
-    if moe_axis is None:
-        moe_axis = tp
     if "moe" in layer:
         # mixtral layers: the expert (leading) dim shards over ``moe_axis``
         # — "tp" by default so a plain tp/fsdp serving mesh works; pass
         # moe_axis="ep" to decoder_param_specs on ep meshes. shard_params
         # replicates instead when n_experts isn't divisible by the axis
-        # size (e.g. 8 experts on tp=16).
-        out["moe"] = {
-            "router": P(),
-            "w_gate": P(moe_axis, None, None),
-            "w_up": P(moe_axis, None, None),
-            "w_down": P(moe_axis, None, None),
-        }
+        # size (e.g. 8 experts on tp=16). One source of truth: moe.py.
+        from ..models.moe import moe_param_specs
+        out["moe"] = moe_param_specs(layer["moe"], axis=moe_axis or tp)
     return out
 
 
