@@ -205,3 +205,176 @@ async def test_from_registry_build_through_worker():
                 bundle, "rootfs/opt/marker.txt")).read() == "from-oci-layer"
     finally:
         await reg.stop()
+
+
+class PrivateFakeRegistry(FakeRegistry):
+    """FakeRegistry requiring a token obtained by basic-auth'd token dance
+    (the private-pull flow of pkg/registry/credentials.go's basic case)."""
+
+    def __init__(self, *a, user="bob", password="hunter2", **kw):
+        super().__init__(*a, **kw)
+        self.user, self.password = user, password
+        self.granted = "tok-" + hashlib.sha256(password.encode()).hexdigest()[:12]
+
+    async def start(self):
+        await super().start()
+        # re-mount with auth wrappers + token endpoint
+        app = web.Application()
+        app.router.add_get("/token", self._token)
+        app.router.add_get("/v2/{name:.+}/manifests/{ref}", self._authed(self._manifests))
+        app.router.add_get("/v2/{name:.+}/blobs/{digest}", self._authed(self._blob))
+        await self._runner.cleanup()
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = self._runner.addresses[0][1]
+        return self
+
+    def _authed(self, handler):
+        async def wrapped(request):
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {self.granted}":
+                return web.json_response(
+                    {"errors": [{"code": "UNAUTHORIZED"}]}, status=401,
+                    headers={"Www-Authenticate":
+                             f'Bearer realm="http://127.0.0.1:{self.port}'
+                             f'/token",service="fake",scope="pull"'})
+            return await handler(request)
+        return wrapped
+
+    async def _token(self, request):
+        import base64
+        auth = request.headers.get("Authorization", "")
+        if auth.startswith("Basic "):
+            raw = base64.b64decode(auth[6:]).decode()
+            if raw == f"{self.user}:{self.password}":
+                return web.json_response({"token": self.granted})
+        return web.json_response({"error": "bad credentials"}, status=401)
+
+
+async def test_private_registry_pull_with_credentials(tmp_path):
+    from tpu9.images.oci import OciClient, aiohttp_transport
+
+    layer = _tar_layer({"app/secret.txt": b"private bits"})
+    reg = await PrivateFakeRegistry("corp/app", [layer]).start()
+    try:
+        ref = f"127.0.0.1:{reg.port}/corp/app:latest"
+        # without credentials: the token dance fails → pull raises
+        t_anon = aiohttp_transport()
+        try:
+            with pytest.raises(Exception):
+                await OciClient(t_anon).pull(ref, str(tmp_path / "anon"))
+        finally:
+            await t_anon.aclose()
+        # with credentials: basic-auth'd token exchange succeeds
+        t_auth = aiohttp_transport(credentials={
+            f"127.0.0.1:{reg.port}": ("bob", "hunter2")})
+        try:
+            await OciClient(t_auth).pull(ref, str(tmp_path / "ok"))
+        finally:
+            await t_auth.aclose()
+        assert (tmp_path / "ok" / "app" / "secret.txt").read_bytes() \
+            == b"private bits"
+    finally:
+        await reg.stop()
+
+
+async def test_registry_secret_threads_to_build_env():
+    """spec.registry_secret resolves the workspace secret into the build
+    container's env (value never in the spec hash), and a missing secret
+    fails loudly."""
+    from tpu9.images.spec import ImageSpec
+
+    async with LocalStack() as stack:
+        ws = stack.gateway.default_workspace
+        await stack.gateway.backend.upsert_secret(
+            ws.workspace_id, "regcred", "bob:hunter2")
+        spec = ImageSpec(from_registry="example.com/app:v1",
+                         registry_secret="regcred")
+        # a set secret name joins the id; unset stays back-compatible
+        assert spec.image_id != ImageSpec(
+            from_registry="example.com/app:v1").image_id
+        req = stack.gateway.images._build_request(ws.workspace_id, spec)
+        await stack.gateway.images._finish_schedule(ws.workspace_id, spec,
+                                                    req)
+        assert req.env.get("TPU9_REGISTRY_AUTH") == "bob:hunter2"
+        assert "TPU9_REGISTRY_AUTH" not in json.dumps(
+            spec.to_dict())   # value nowhere in the spec
+
+        bad = ImageSpec(from_registry="example.com/app:v1",
+                        registry_secret="missing")
+        req2 = stack.gateway.images._build_request(ws.workspace_id, bad)
+        with pytest.raises(ValueError):
+            await stack.gateway.images._finish_schedule(
+                ws.workspace_id, bad, req2)
+
+
+async def test_private_image_dedupe_requires_credentials(tmp_path):
+    """A foreign workspace with the same (ref, secret NAME) must NOT get
+    dedupe access to a privately-pulled image: verify() reports
+    exists=False and build() demands working credentials."""
+    from tpu9.images.spec import ImageSpec
+
+    async with LocalStack() as stack:
+        svc = stack.gateway.images
+        ws_a = stack.gateway.default_workspace.workspace_id
+        spec = ImageSpec(from_registry="corp.example.com/app:v1",
+                         registry_secret="regcred")
+        # simulate A's completed build
+        svc.builder.has_image = lambda image_id: True
+        await stack.gateway.backend.grant_image_access(spec.image_id, ws_a)
+
+        # A (has access): dedupe fast path works
+        out = await svc.verify(spec, workspace_id=ws_a)
+        assert out["exists"] is True
+
+        # B (no access, guessed the secret name): no dedupe grant
+        ws_b = (await stack.gateway.backend.create_workspace("b")).workspace_id
+        out = await svc.verify(spec, workspace_id=ws_b)
+        assert out["exists"] is False
+        assert not await stack.gateway.backend.has_image_access(
+            spec.image_id, ws_b)
+
+        # B's build without a secret of that name fails loudly
+        with pytest.raises(ValueError):
+            await svc.build(ws_b, spec)
+        # ... and with a secret whose credentials the registry rejects
+        await stack.gateway.backend.upsert_secret(ws_b, "regcred", "x:wrong")
+
+        async def deny(spec_, value):
+            return False
+        svc._check_registry_credentials = deny
+        with pytest.raises(PermissionError):
+            await svc.build(ws_b, spec)
+        assert not await stack.gateway.backend.has_image_access(
+            spec.image_id, ws_b)
+
+        # with verifying credentials, access is granted
+        async def allow(spec_, value):
+            return True
+        svc._check_registry_credentials = allow
+        out = await svc.build(ws_b, spec)
+        assert out["status"] == "ready"
+        assert await stack.gateway.backend.has_image_access(
+            spec.image_id, ws_b)
+
+
+async def test_credential_check_probes_manifest(tmp_path):
+    """_check_registry_credentials does one authenticated manifest GET."""
+    from tpu9.images.spec import ImageSpec
+
+    layer = _tar_layer({"x": b"y"})
+    reg = await PrivateFakeRegistry("corp/app", [layer]).start()
+    try:
+        async with LocalStack() as stack:
+            svc = stack.gateway.images
+            spec = ImageSpec(
+                from_registry=f"127.0.0.1:{reg.port}/corp/app:latest",
+                registry_secret="r")
+            assert await svc._check_registry_credentials(spec,
+                                                         "bob:hunter2")
+            assert not await svc._check_registry_credentials(spec,
+                                                             "bob:wrong")
+    finally:
+        await reg.stop()

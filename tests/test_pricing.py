@@ -215,3 +215,55 @@ async def test_workspace_api_operator_only():
             async with s.post(f"{stack.base_url}/api/v1/workspace",
                               json={"name": "evil"}) as r:
                 assert r.status == 403
+
+
+async def test_token_crud_self_service():
+    async with LocalStack() as stack:
+        status, listed = await stack.api("GET", "/api/v1/token")
+        assert status == 200
+        n0 = len(listed)
+        assert all("key_prefix" in t and "token" not in t and "key" not in t
+                   for t in listed)
+        status, minted = await stack.api("POST", "/api/v1/token")
+        assert status == 200 and minted["token"]
+        status, listed = await stack.api("GET", "/api/v1/token")
+        assert len(listed) == n0 + 1
+        # the minted token authenticates
+        import aiohttp
+        async with aiohttp.ClientSession(headers={
+                "Authorization": f"Bearer {minted['token']}"}) as s:
+            async with s.get(f"{stack.base_url}/api/v1/token") as r:
+                assert r.status == 200
+        # revoke; it stops authenticating
+        status, out = await stack.api(
+            "DELETE", f"/api/v1/token/{minted['token_id']}")
+        assert out["ok"]
+        async with aiohttp.ClientSession(headers={
+                "Authorization": f"Bearer {minted['token']}"}) as s:
+            async with s.get(f"{stack.base_url}/api/v1/token") as r:
+                assert r.status == 401
+        # can't revoke another workspace's token
+        ws2 = await stack.backend.create_workspace("other-tok")
+        t2 = await stack.backend.create_token(ws2.workspace_id)
+        status, _ = await stack.api("DELETE", f"/api/v1/token/{t2.token_id}")
+        assert status == 404
+
+
+async def test_runner_tokens_cannot_manage_tokens():
+    """A runner token (rides inside user-controlled containers) must not
+    mint or revoke workspace tokens — that would be privilege escalation
+    from any build step."""
+    import aiohttp
+
+    async with LocalStack() as stack:
+        ws = stack.gateway.default_workspace
+        runner_tok = await stack.gateway.backend.create_token(
+            ws.workspace_id, token_type="runner")
+        async with aiohttp.ClientSession(headers={
+                "Authorization": f"Bearer {runner_tok.key}"}) as s:
+            for method, path in (("POST", "/api/v1/token"),
+                                 ("GET", "/api/v1/token"),
+                                 ("DELETE", "/api/v1/token/tok-x")):
+                async with s.request(method,
+                                     stack.base_url + path) as r:
+                    assert r.status == 403, (method, path, r.status)

@@ -49,15 +49,39 @@ class ImageService:
     async def verify(self, spec: ImageSpec,
                      workspace_id: str = "") -> dict:
         """Does this spec already have a built image? (VerifyImageBuild)
-        Knowing the full spec proves buildability, so a dedupe hit grants the
-        caller's workspace read access to the shared image."""
+        Knowing the full spec proves buildability, so a dedupe hit grants
+        the caller's workspace read access to the shared image — EXCEPT for
+        private-registry specs: their content came from credentials OUTSIDE
+        the spec (the secret name is guessable), so dedupe reports
+        exists=False to foreign workspaces and they must build with their
+        own credentials to earn access."""
         exists = self.builder.has_image(spec.image_id)
         if exists and workspace_id:
+            if spec.registry_secret and not await self.backend.has_image_access(
+                    spec.image_id, workspace_id):
+                return {"image_id": spec.image_id, "exists": False}
             await self.backend.grant_image_access(spec.image_id, workspace_id)
         return {"image_id": spec.image_id, "exists": exists}
 
     async def build(self, workspace_id: str, spec: ImageSpec) -> dict:
         image_id = spec.image_id
+        if spec.registry_secret:
+            # a dedupe hit must not shortcut the credential check: only a
+            # workspace whose OWN secret authenticates (the build pulls with
+            # it) earns access. Existing access keeps the fast path.
+            if self.builder.has_image(image_id) and \
+                    not await self.backend.has_image_access(image_id,
+                                                            workspace_id):
+                value = await self.backend.get_secret(workspace_id,
+                                                      spec.registry_secret)
+                if value is None:
+                    raise ValueError(
+                        f"registry secret {spec.registry_secret!r} not found")
+                ok = await self._check_registry_credentials(spec, value)
+                if not ok:
+                    raise PermissionError(
+                        "registry credentials do not grant access to "
+                        f"{spec.from_registry!r}")
         await self.backend.grant_image_access(image_id, workspace_id)
         # one build decision at a time per image: concurrent calls must not
         # both conclude "nothing in flight" and schedule duplicate builds
@@ -81,6 +105,28 @@ class ImageService:
             if self.build_mode == "worker":
                 await self._finish_schedule(workspace_id, spec, request)
         return {"image_id": image_id, "status": "building"}
+
+    async def _check_registry_credentials(self, spec: ImageSpec,
+                                          auth_value: str) -> bool:
+        """Do these credentials grant pull access to the spec's ref? One
+        manifest GET with the caller's basic auth — no layer downloads."""
+        from ..images.oci import aiohttp_transport, parse_ref, registry_host
+        user, _, pw = auth_value.partition(":")
+        host = registry_host(spec.from_registry)
+        transport = aiohttp_transport(credentials={host: (user, pw)})
+        try:
+            base, name, tag = parse_ref(spec.from_registry)
+            status, _, _ = await transport(
+                "GET", f"{base}/v2/{name}/manifests/{tag}",
+                {"Accept": "application/vnd.oci.image.index.v1+json, "
+                           "application/vnd.oci.image.manifest.v1+json, "
+                           "application/vnd.docker.distribution.manifest."
+                           "v2+json"})
+            return status == 200
+        except Exception:  # noqa: BLE001 — unreachable registry = no proof
+            return False
+        finally:
+            await transport.aclose()
 
     async def _build_in_flight(self, image_id: str) -> bool:
         """Is some build for this image actually still alive? A build
@@ -120,6 +166,15 @@ class ImageService:
         env["TPU9_BUILD_SPEC"] = json.dumps(spec.to_dict())
         if self.runner_tokens is not None:
             env["TPU9_TOKEN"] = await self.runner_tokens.get(workspace_id)
+        if spec.registry_secret and self.backend is not None:
+            # private-registry credentials: the secret VALUE rides only the
+            # build container's env, never the spec/manifest
+            value = await self.backend.get_secret(workspace_id,
+                                                  spec.registry_secret)
+            if value is None:
+                raise ValueError(
+                    f"registry secret {spec.registry_secret!r} not found")
+            env["TPU9_REGISTRY_AUTH"] = value
         import os
         for passthrough in ("TPU9_NO_EGRESS", "TPU9_WHEEL_DIR"):
             if os.environ.get(passthrough):

@@ -305,6 +305,10 @@ class Gateway:
         r.add_post("/api/v1/workspace", self._workspace_create)
         r.add_post("/api/v1/workspace/{workspace_id}/token",
                    self._workspace_token)
+        # tokens: self-service CRUD (reference /api/v1/token group)
+        r.add_get("/api/v1/token", self._token_list)
+        r.add_post("/api/v1/token", self._token_create)
+        r.add_delete("/api/v1/token/{token_id}", self._token_revoke)
         # machines: BYOC agent fleet (reference pkg/agent + /api/v1/machine)
         r.add_post("/api/v1/machine", self._machine_create)
         r.add_get("/api/v1/machine", self._machine_list)
@@ -488,6 +492,7 @@ class Gateway:
         # worker tokens may read cross-workspace artifacts (objects, chunks)
         # the way the reference serves repos to workers over gRPC
         request["is_worker"] = tok.token_type == "worker"
+        request["token_type"] = tok.token_type
         return await handler(request)
 
     def _ws(self, request: web.Request) -> Workspace:
@@ -1644,6 +1649,51 @@ class Gateway:
         tok = await self.backend.create_token(workspace_id)
         return web.json_response({"token": tok.key,
                                   "token_id": tok.token_id})
+
+    # -- tokens (self-service; reference /api/v1/token) ----------------------
+
+    def _require_user_token(self, request: web.Request):
+        """Token management is for WORKSPACE tokens only. Runner tokens ride
+        inside user-controlled containers (build steps, handlers) — letting
+        one mint a durable workspace key or revoke the owner's tokens would
+        be privilege escalation."""
+        if request.get("token_type") != "workspace":
+            raise web.HTTPForbidden(
+                text=json.dumps({"error": "workspace token required"}),
+                content_type="application/json")
+
+    async def _token_list(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        self._require_user_token(request)
+        out = []
+        for t in await self.backend.list_tokens(ws.workspace_id):
+            out.append({"token_id": t.token_id,
+                        "key_prefix": t.key[:8],     # never the full key
+                        "token_type": t.token_type,
+                        "active": t.active,
+                        "created_at": t.created_at})
+        return web.json_response(out)
+
+    async def _token_create(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        self._require_user_token(request)
+        tok = await self.backend.create_token(ws.workspace_id)
+        # the ONLY response carrying the full key
+        return web.json_response({"token_id": tok.token_id,
+                                  "token": tok.key})
+
+    async def _token_revoke(self, request: web.Request) -> web.Response:
+        ws = self._ws(request)
+        self._require_user_token(request)
+        token_id = request.match_info["token_id"]
+        mine = {t.token_id for t in
+                await self.backend.list_tokens(ws.workspace_id)}
+        if token_id not in mine:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "token not found"}),
+                content_type="application/json")
+        return web.json_response(
+            {"ok": await self.backend.revoke_token(token_id)})
 
     # -- machines (BYOC agents; reference pkg/agent + machine API) -----------
 

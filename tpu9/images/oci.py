@@ -58,6 +58,15 @@ def parse_ref(ref: str) -> tuple[str, str, str]:
     return f"{scheme}://{registry}", rest, tag
 
 
+def registry_host(ref: str) -> str:
+    """The host credentials must be keyed by for ``ref`` — the SAME
+    resolution parse_ref applies (docker-hub shortnames →
+    registry-1.docker.io), so 'python:3.12' creds land on the host the
+    requests actually go to."""
+    base, _, _ = parse_ref(ref)
+    return base.split("://", 1)[-1]
+
+
 class OciClient:
     def __init__(self, transport: Transport):
         self.transport = transport
@@ -176,14 +185,31 @@ def _rm(path: str) -> None:
         os.unlink(path)
 
 
-def aiohttp_transport(session=None) -> Transport:
+def aiohttp_transport(session=None,
+                      credentials: "dict | None" = None) -> Transport:
     """Default transport over aiohttp (handles Docker Hub's anonymous token
     dance transparently on 401). One ClientSession is shared across requests
     — an N-layer pull must not pay N connector/TLS setups; callers without
-    their own session should ``await transport.aclose()`` when done."""
+    their own session should ``await transport.aclose()`` when done.
+
+    ``credentials``: registry host → (user, password) for private
+    registries (reference pkg/registry/credentials.go's basic-auth case) —
+    sent as Basic auth on the token exchange AND on direct requests the
+    registry answers without a token dance."""
+    import base64
+
     import aiohttp
 
     state: dict = {"session": session, "tokens": {}}
+    credentials = credentials or {}
+
+    def _basic(url: str) -> "str | None":
+        host = url.split("://", 1)[-1].split("/", 1)[0]
+        cred = credentials.get(host)
+        if cred is None:
+            return None
+        raw = f"{cred[0]}:{cred[1]}".encode()
+        return "Basic " + base64.b64encode(raw).decode()
 
     def _session() -> "aiohttp.ClientSession":
         if state["session"] is None or state["session"].closed:
@@ -197,6 +223,10 @@ def aiohttp_transport(session=None) -> Transport:
         realm_key = url.split("/v2/")[0]
         if realm_key in state["tokens"]:
             hdrs["Authorization"] = f"Bearer {state['tokens'][realm_key]}"
+        else:
+            basic = _basic(url)
+            if basic:
+                hdrs["Authorization"] = basic
         async with own.request(method, url, headers=hdrs) as resp:
             body = await resp.read()
             if resp.status == 401 and "Www-Authenticate" in resp.headers:
@@ -207,7 +237,14 @@ def aiohttp_transport(session=None) -> Transport:
                 if "realm" in m:
                     token_url = (f"{m['realm']}?service={m.get('service', '')}"
                                  f"&scope={m.get('scope', '')}")
-                    async with own.get(token_url) as tr:
+                    token_hdrs = {}
+                    basic = _basic(url)
+                    if basic:
+                        # private pull: the token endpoint authenticates
+                        # the basic credentials and scopes the bearer token
+                        token_hdrs["Authorization"] = basic
+                    async with own.get(token_url,
+                                       headers=token_hdrs) as tr:
                         tok = (await tr.json()).get("token", "")
                     state["tokens"][realm_key] = tok
                     hdrs["Authorization"] = f"Bearer {tok}"

@@ -27,6 +27,10 @@ class ImageSpec:
     # OCI registry ref ("python:3.12", "127.0.0.1:5000/app:v1") — layers are
     # pulled and unpacked into a rootfs/ tree before commands run
     from_registry: str = ""
+    # workspace-secret NAME holding "user:password" for private registries
+    # (the VALUE never enters the spec/hash — it reaches the build
+    # container as env, like the reference's registry credentials)
+    registry_secret: str = ""
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -42,7 +46,7 @@ class ImageSpec:
         Fields added after round 1 join the hash only when set, so every
         previously built image keeps its id across upgrades."""
         d = self.to_dict()
-        for late_field in ("from_registry",):
+        for late_field in ("from_registry", "registry_secret"):
             if not d.get(late_field):
                 d.pop(late_field, None)
         blob = json.dumps(d, sort_keys=True).encode()

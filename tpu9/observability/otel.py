@@ -189,6 +189,12 @@ class OtlpExporter:
                 raise RuntimeError(f"otlp trace push got {status}")
         self._last_flush = cutoff
         snap = self.registry.to_dict()
-        out["metrics_status"] = await self.transport(
+        status = await self.transport(
             "/v1/metrics", metrics_to_otlp(snap, self.service))
+        out["metrics_status"] = status
+        if status >= 400:
+            # symmetric with the trace path: a persistently-rejecting
+            # collector must surface in the loop's warning log, not die
+            # silently
+            raise RuntimeError(f"otlp metrics push got {status}")
         return out

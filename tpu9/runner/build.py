@@ -55,11 +55,18 @@ async def amain() -> int:
             oci_env: dict[str, str] = {}
 
             if spec.from_registry:
-                from ..images.oci import OciClient, aiohttp_transport
+                from ..images.oci import (OciClient, aiohttp_transport,
+                                          registry_host)
                 rootfs = os.path.join(scratch, "rootfs")
+                creds = None
+                auth = os.environ.get("TPU9_REGISTRY_AUTH", "")
+                if auth and ":" in auth:
+                    user, _, pw = auth.partition(":")
+                    # keyed by the SAME host parse_ref resolves requests to
+                    creds = {registry_host(spec.from_registry): (user, pw)}
                 # NOT the gateway session: its Authorization header (runner
                 # token) must never reach a registry
-                transport = aiohttp_transport()
+                transport = aiohttp_transport(credentials=creds)
                 try:
                     config = await OciClient(transport).pull(
                         spec.from_registry, rootfs, log_cb=emit)

@@ -56,13 +56,16 @@ class Image:
 
     @classmethod
     def from_registry(cls, ref: str,
-                      python_version: str = "python3.11") -> "Image":
+                      python_version: str = "python3.11",
+                      secret: str = "") -> "Image":
         """An OCI registry image ('python:3.12', 'my.registry/app:v1') —
         layers are pulled into a rootfs/ tree by the build container and
         snapshotted through the same chunked manifest as every other image
-        (reference: Image.from_registry / skopeo path)."""
+        (reference: Image.from_registry / skopeo path). ``secret`` names a
+        workspace secret holding "user:password" for private registries."""
         img = cls(python_version=python_version)
         img.spec.from_registry = ref
+        img.spec.registry_secret = secret
         return img
 
     @classmethod
