@@ -40,6 +40,11 @@ class EngineConfig:
     top_k: int = 0
     top_p: float = 1.0
     eos_id: int = -1              # -1 disables EOS stopping
+    # decode-window buckets: K steps run on-device (lax.scan) per host
+    # sync. Each host↔device round-trip costs wall-clock (dramatically so
+    # over a TPU relay), so the loop amortizes it over K tokens; K drops
+    # to 1 whenever requests wait for admission.
+    decode_steps: tuple = (1, 4, 16)
 
 
 @dataclass
@@ -70,17 +75,18 @@ class InferenceEngine:
         self._rng = jax.random.PRNGKey(0)
         self._queue: asyncio.Queue[_Request] = asyncio.Queue()
         self._loop_task: Optional[asyncio.Task] = None
-        self._compiled: dict[int, Any] = {}
-        self._decode_fn = self._build_decode()
+        self._compiled: dict[Any, Any] = {}
+        self._host_len = np.zeros((b,), dtype=np.int64)  # host mirror of
+        # cache_len — the loop must not pay a device round-trip to know room
         self._stats = {"active_streams": 0, "queued": 0, "tokens_generated": 0,
                        "decode_steps": 0}
 
     # -- compiled steps ------------------------------------------------------
 
-    def _build_decode(self):
+    def _build_decode(self, k: int = 1):
         cfg, ecfg = self.cfg, self.ecfg
 
-        def decode(params, kv_cache, last_token, cache_len, active, rng):
+        def one_step(params, kv_cache, last_token, cache_len, active, rng):
             positions = cache_len[:, None]              # next position per slot
             logits, kv_cache = decoder_forward(
                 params, last_token, cfg, positions=positions,
@@ -94,7 +100,48 @@ class InferenceEngine:
             new_len = cache_len + active.astype(jnp.int32)
             return next_tok[:, None].astype(jnp.int32), kv_cache, new_len, rng
 
+        def decode(params, kv_cache, last_token, cache_len, active, rng):
+            def body(carry, _):
+                last, kv, clen, r = carry
+                last, kv, clen, r = one_step(params, kv, last, clen,
+                                             active, r)
+                return (last, kv, clen, r), last[:, 0]
+
+            (last, kv_cache, cache_len, rng), toks = jax.lax.scan(
+                body, (last_token, kv_cache, cache_len, rng), None,
+                length=k)
+            # toks [k, B]: the host consumes the whole window in one sync
+            return last, kv_cache, cache_len, rng, toks
+
         return jax.jit(decode, donate_argnums=(1,))
+
+    def _decode_k(self, k: int):
+        key = ("decode", k)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._compiled[key] = self._build_decode(k)
+        return fn
+
+    def _pick_steps(self) -> int:
+        """Largest decode-window bucket every active slot can absorb: no
+        slot may outrun its max_new_tokens budget past the window (tokens
+        beyond a stop are discarded host-side, so only bounded compute is
+        wasted) nor its cache room. Admission latency wins when work is
+        queued: K=1."""
+        if not self._queue.empty():
+            return self.ecfg.decode_steps[0]
+        limit = max(self.ecfg.decode_steps)
+        for slot in range(self.ecfg.max_batch):
+            req = self.slot_req[slot]
+            if req is None or not self.active[slot]:
+                continue
+            remaining = req.max_new_tokens - len(req.generated)
+            room = self.ecfg.max_seq_len - 1 - self._host_len[slot]
+            limit = min(limit, max(1, remaining), max(1, room))
+        for k in reversed(self.ecfg.decode_steps):
+            if k <= limit:
+                return k
+        return self.ecfg.decode_steps[0]
 
     def _prefill_fn(self, bucket: int):
         if bucket in self._compiled:
@@ -164,7 +211,11 @@ class InferenceEngine:
 
     # -- engine loop ---------------------------------------------------------
 
-    def _admit(self, req: _Request, slot: int) -> None:
+    def _admit(self, req: _Request, slot: int):
+        """Prefill + cache splice for one request. Returns the slot's
+        first-token DEVICE value — the serve loop syncs a whole admission
+        batch in one host round-trip (each blocking ``int()`` here would
+        cost a full RTT, brutal over a TPU relay)."""
         n = len(req.prompt)
         bucket = self._bucket_for(n)
         tokens = np.zeros((1, bucket), dtype=np.int32)
@@ -180,27 +231,32 @@ class InferenceEngine:
             v, cache["v"][:, :, :bucket], (0, slot, 0, 0, 0))
         self.kv_cache = {"k": k, "v": v}
         self.cache_len = self.cache_len.at[slot].set(n)
+        self._host_len[slot] = n
         # sample the first generated token from the prefill logits
         self._rng, sub = jax.random.split(self._rng)
-        first = int(sample_logits(last, sub, temperature=self.ecfg.temperature,
-                                  top_k=self.ecfg.top_k, top_p=self.ecfg.top_p))
+        first = sample_logits(last, sub, temperature=self.ecfg.temperature,
+                              top_k=self.ecfg.top_k, top_p=self.ecfg.top_p)
         self.last_token = self.last_token.at[slot, 0].set(first)
         req.slot = slot
+        self.active[slot] = True
+        self.slot_req[slot] = req
+        return first
+
+    def _deliver_first(self, req: _Request, first: int) -> None:
         req.generated.append(first)
         if req.queue is not None:
             req.queue.put_nowait(first)
-        self.active[slot] = True
-        self.slot_req[slot] = req
         # the prefill-sampled token may already satisfy the stop conditions
         if (req.max_new_tokens <= 1
                 or (self.ecfg.eos_id >= 0 and first == self.ecfg.eos_id)):
-            self._retire(slot)
+            self._retire(req.slot)
 
     def _retire(self, slot: int) -> None:
         req = self.slot_req[slot]
         self.active[slot] = False
         self.slot_req[slot] = None
         self.cache_len = self.cache_len.at[slot].set(0)
+        self._host_len[slot] = 0
         if req is not None:
             if req.queue is not None:
                 req.queue.put_nowait(None)
@@ -208,47 +264,58 @@ class InferenceEngine:
 
     async def _serve_loop(self) -> None:
         while True:
-            # admit as many queued requests as there are free slots
-            admitted = False
+            # admit as many queued requests as there are free slots; ALL
+            # their first tokens sync in one device round-trip at the end
+            pending: list[tuple[_Request, Any]] = []
             while not self._queue.empty() and not self.active.all():
                 req = self._queue.get_nowait()
                 slot = int(np.argmin(self.active))
-                self._admit(req, slot)
-                admitted = True
+                pending.append((req, self._admit(req, slot)))
 
-            if not self.active.any():
+            if not self.active.any() and not pending:
                 # idle: block for work
                 req = await self._queue.get()
-                slot = 0
-                self._admit(req, slot)
-                admitted = True
+                pending.append((req, self._admit(req, 0)))
+
+            if pending:
+                firsts = np.asarray(jax.device_get(
+                    jnp.stack([f for _, f in pending])))
+                for (req, _), first in zip(pending, firsts):
+                    self._deliver_first(req, int(first))
 
             if not self.active.any():
                 continue
 
-            # one decode step for the whole batch
+            # one decode WINDOW for the whole batch: k steps on-device,
+            # one host sync for all k×B tokens
+            k = self._pick_steps()
             (self.last_token, self.kv_cache,
-             self.cache_len, self._rng) = self._decode_fn(
+             self.cache_len, self._rng, toks) = self._decode_k(k)(
                 self.params, self.kv_cache, self.last_token,
                 self.cache_len, jnp.asarray(self.active), self._rng)
-            self._stats["decode_steps"] += 1
-
-            tokens = np.asarray(jax.device_get(self.last_token))[:, 0]
-            lens = np.asarray(jax.device_get(self.cache_len))
-            for slot in range(self.ecfg.max_batch):
-                if not self.active[slot]:
-                    continue
-                req = self.slot_req[slot]
-                tok = int(tokens[slot])
-                req.generated.append(tok)
-                self._stats["tokens_generated"] += 1
-                if req.queue is not None:
-                    req.queue.put_nowait(tok)
-                hit_eos = (self.ecfg.eos_id >= 0 and tok == self.ecfg.eos_id)
-                # prompt + generated must fit the cache
-                out_of_room = lens[slot] >= self.ecfg.max_seq_len - 1
-                if (len(req.generated) >= req.max_new_tokens or hit_eos
-                        or out_of_room):
-                    self._retire(slot)
+            self._stats["decode_steps"] += k
+            window = np.asarray(jax.device_get(toks))        # [k, B]
+            for step in range(k):
+                for slot in range(self.ecfg.max_batch):
+                    if not self.active[slot]:
+                        continue
+                    req = self.slot_req[slot]
+                    tok = int(window[step, slot])
+                    req.generated.append(tok)
+                    self._host_len[slot] += 1
+                    self._stats["tokens_generated"] += 1
+                    if req.queue is not None:
+                        req.queue.put_nowait(tok)
+                    hit_eos = (self.ecfg.eos_id >= 0
+                               and tok == self.ecfg.eos_id)
+                    # prompt + generated must fit the cache
+                    out_of_room = (self._host_len[slot]
+                                   >= self.ecfg.max_seq_len - 1)
+                    if (len(req.generated) >= req.max_new_tokens or hit_eos
+                            or out_of_room):
+                        # remaining window tokens for this slot are noise
+                        # (the device kept decoding); retire discards them
+                        # by flipping active off — the cache lanes reset
+                        self._retire(slot)
             # yield to the event loop so new requests can land
             await asyncio.sleep(0)
