@@ -1,30 +1,48 @@
 #!/usr/bin/env python3
 """tpu9 benchmark — prints ONE JSON line.
 
-Phases mirror BASELINE.md's north star ("container cold-start p50 +
-tokens/sec/chip") plus kernel validation, each in a FRESH subprocess so they
-cannot interfere (round-1 failure mode: the cold-start stack's child
-processes outlived their phase and the TPU tunnel refused the LLM phase):
+Every number in the line is defended by evidence computed in-harness
+(`tpu9/benchsuite/physics.py`), the same evidence-or-fail stance as the
+reference's b9bench validators (`benchmarks/b9bench/validators.py:6-60`):
 
-1. **llm** (chip first, while it's free): Llama-architecture decode
-   steady-state tokens/sec/chip on the default backend. If the TPU backend
-   cannot initialize within the timeout, re-runs forced-CPU and marks
-   ``backend: "cpu"`` honestly rather than hanging the bench.
-2. **kernels**: pallas flash-attention + ragged paged-decode vs the XLA
-   fallback — max abs diff (correctness) and per-step latency on the chip.
-3. **coldstart**: deploy→first-response p50 through the real local stack
-   (gateway + scheduler + worker + subprocess runner), forced CPU. The
-   subprocess runs in its own process group and the group is killed after,
-   so no stack child can leak into later phases or the caller.
+- **Fencing**: all timing windows end in a forced device→host copy of data
+  computed by the window (``np.asarray(jax.device_get(...))``). On the TPU
+  tunnel backend ``block_until_ready()`` returns before execution finishes
+  (measured: 4.4 TFLOP "completing" in 0.24 ms), so it is never used for
+  timing here.
+- **Physics**: model-bandwidth-utilization and MFU are computed for every
+  throughput phase and the phase FAILS if either is >= 1.0 — a number that
+  implies more than HBM bandwidth or MXU peak is a timing bug, not a result.
+- **Linear scaling**: doubling the decode-step count must ~double elapsed
+  time, which catches async backends whose clock stops early.
+- **Engine path**: the headline LLM number comes from the serving
+  InferenceEngine (and, on TPU, through a real ``@endpoint`` deployment of
+  the LLM runner), not a hand-rolled loop.
+
+Phases (each in a fresh subprocess so they cannot interfere, and so only one
+process at a time dials the TPU tunnel):
+
+1. **llm**: Llama3-8B int8 weight-only (bf16 8B = 16.06 GB does not fit a
+   v5e's 16 GiB HBM; int8 is the standard single-chip recipe) — raw decode
+   windows through the engine's own compiled graph, then the engine
+   end-to-end with concurrent requests.
+2. **llm_endpoint** (TPU only): same engine served by ``tpu9.runner.llm``
+   behind ``@endpoint tpu=v5e-1`` through the real gateway/scheduler/worker
+   stack; reports served tokens/sec with a container-side served-count proof.
+3. **kernels**: pallas flash-attention + ragged paged-decode vs the XLA
+   fallback — correctness (max abs diff) + fenced latency + MFU sanity.
+4. **coldstart**: deploy→first-response p50 through the real local stack
+   (gateway + scheduler + worker + subprocess runner), forced CPU.
 
 Primary metric: cold_start_p50_s with ``vs_baseline`` = 1.0 / p50 against
 the reference's headline "under a second" cold-start claim (README.md:39 of
-beam-cloud/beta9) — >1.0 means beating it. Decode throughput + kernel
-numbers ride in ``extra``.
+beam-cloud/beta9). LLM throughput + kernel numbers + their evidence ride in
+``extra``; any number whose evidence fails is REMOVED from extra and
+replaced by a ``*_rejected`` reason.
 
 Usage:
-    python3 bench.py [--quick] [--cpu]          # full orchestrated run
-    python3 bench.py --phase llm|kernels|coldstart   # one phase, in-process
+    python3 bench.py [--quick] [--cpu]               # full orchestrated run
+    python3 bench.py --phase llm|llm_endpoint|kernels|coldstart
 """
 
 from __future__ import annotations
@@ -38,115 +56,389 @@ import subprocess
 import sys
 import time
 
-# generous: first XLA compile through a cold relay can take minutes
-PHASE_TIMEOUT_S = {"llm": 900, "kernels": 900, "coldstart": 900}
+PHASE_TIMEOUT_S = {"llm": 1800, "llm_endpoint": 1800, "kernels": 900,
+                   "coldstart": 900, "coldstart_native": 900,
+                   "coldstart_jax": 900}
+
+# share compiled XLA programs between the in-process llm phase and the
+# runner container in the endpoint phase (identical graphs → second phase
+# skips the multi-minute 8B compiles)
+XLA_CACHE_DIR = "/tmp/tpu9-bench/xla-cache"
+
+# env a runner CONTAINER needs to reach the TPU tunnel backend from a
+# stripped-environment subprocess (ProcessRuntime allowlists env; the
+# gateway/worker stay forced-CPU while only the serving container gets these)
+_TUNNEL_ENV_KEYS = ("JAX_PLATFORMS", "AXON_LOOPBACK_RELAY", "TPU_SKIP_MDS_QUERY",
+                    "PALLAS_AXON_TPU_GEN", "PALLAS_AXON_POOL_IPS",
+                    "PALLAS_AXON_REMOTE_COMPILE")
+
+
+def fence(x) -> float:
+    """Force completion of x's computation by copying a small dependent
+    slice to host. Returns a checksum so callers can accumulate it (keeps
+    the compiler from eliminating the work)."""
+    import jax
+    import numpy as np
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    host = np.asarray(jax.device_get(leaf.ravel()[:8].astype("float32")))
+    return float(host.sum())
 
 
 # ---------------------------------------------------------------------------
-# phase: llm decode throughput
+# phase: llm decode throughput (engine graph + engine e2e)
 # ---------------------------------------------------------------------------
 
-def bench_llm_decode(quick: bool = False) -> dict:
+def _llm_settings(tpu: bool, quick: bool) -> dict:
+    if quick or not tpu:
+        return dict(preset="llama-tiny", batch=4, max_seq=256, ctx0=64,
+                    window_k=8, windows=2, prefill_buckets=(32, 64),
+                    decode_steps=(1, 4, 8), requests=4, max_new=13,
+                    prompt_len=24)
+    # requests == max_batch: with work queued the engine drops to K=1
+    # admission-latency windows — steady-state throughput is all slots busy
+    # with no queue, decoding K=32 windows
+    return dict(preset="llama3-8b-int8", batch=8, max_seq=2048, ctx0=512,
+                window_k=32, windows=4, prefill_buckets=(128,),
+                decode_steps=(1, 8, 32), requests=8, max_new=41,
+                prompt_len=120)
+
+
+def bench_llm(quick: bool = False) -> dict:
+    import asyncio
+
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
-    from tpu9.models import decoder_forward, init_decoder, init_kv_cache
-    from tpu9.models.llama import LLAMA_PRESETS
-    from tpu9.ops.sampling import sample_logits
+    from tpu9.benchsuite.physics import (chip_spec, decode_byte_counts,
+                                         decode_physics,
+                                         linear_scaling_violations,
+                                         physics_violations)
+    from tpu9.serving.presets import load_engine
     from tpu9.utils import on_tpu
 
-    backend = jax.default_backend()
-    n_chips = jax.device_count()
+    os.makedirs(XLA_CACHE_DIR, exist_ok=True)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", XLA_CACHE_DIR)
+
     tpu = on_tpu()
-    preset = "llama-tiny" if (quick or not tpu) else "llama-1b"
-    cfg = LLAMA_PRESETS[preset]
-
-    batch, prompt_len, decode_steps = (4, 64, 16) if quick or not tpu \
-        else (8, 1024, 64)
-    max_len = prompt_len + decode_steps + 8
-    # the ragged pallas decode kernel needs S % 256 == 0 and S >= 512
-    if tpu:
-        max_len = max(512, (max_len + 255) // 256 * 256)
-
-    params = init_decoder(jax.random.PRNGKey(0), cfg)
-    cache = init_kv_cache(cfg, batch, max_len)
-
-    @jax.jit
-    def prefill(params, tokens, cache):
-        logits, cache = decoder_forward(params, tokens, cfg, kv_cache=cache)
-        return logits[:, -1:].argmax(-1).astype(jnp.int32), cache
-
-    def decode(params, cache, tok, cache_len, rng):
-        positions = cache_len[:, None]
-        logits, cache = decoder_forward(params, tok, cfg, positions=positions,
-                                        kv_cache=cache, cache_len=cache_len + 1,
-                                        decode=True)
-        rng, sub = jax.random.split(rng)
-        nxt = sample_logits(logits[:, -1], sub, temperature=0.0)
-        return nxt[:, None].astype(jnp.int32), cache, cache_len + 1, rng
-
-    decode = jax.jit(decode, donate_argnums=(1,))
-
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
-                                0, cfg.vocab_size)
-    # compile + warmup
-    t0 = time.perf_counter()
-    tok, cache = prefill(params, tokens, cache)
-    tok.block_until_ready()
-    prefill_compile_s = time.perf_counter() - t0
-
-    cache_len = jnp.full((batch,), prompt_len, jnp.int32)
-    rng = jax.random.PRNGKey(2)
-    t0 = time.perf_counter()
-    tok, cache, cache_len, rng = decode(params, cache, tok, cache_len, rng)
-    tok.block_until_ready()
-    decode_compile_s = time.perf_counter() - t0
-
-    # steady state
-    t0 = time.perf_counter()
-    for _ in range(decode_steps):
-        tok, cache, cache_len, rng = decode(params, cache, tok, cache_len, rng)
-    tok.block_until_ready()
-    elapsed = time.perf_counter() - t0
-
-    toks_per_sec = batch * decode_steps / elapsed
-    return {
-        "backend": backend,
-        "on_tpu": tpu,
-        "model": preset,
-        "n_chips": n_chips,
-        "batch": batch,
-        "decode_tokens_per_sec": round(toks_per_sec, 2),
-        "decode_tokens_per_sec_per_chip": round(toks_per_sec / max(n_chips, 1), 2),
-        "decode_step_ms": round(1000 * elapsed / decode_steps, 3),
-        "prefill_compile_s": round(prefill_compile_s, 2),
-        "decode_compile_s": round(decode_compile_s, 2),
+    s = _llm_settings(tpu, quick)
+    dev = jax.devices()[0]
+    spec = chip_spec(getattr(dev, "device_kind", ""))
+    out: dict = {
+        "backend": jax.default_backend(), "on_tpu": tpu,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "chip_spec": {"name": spec.name, "hbm_gbps": spec.hbm_gbps,
+                      "peak_bf16_tflops": spec.peak_bf16_tflops},
+        "model": s["preset"], "batch": s["batch"],
+        "max_seq_len": s["max_seq"],
+        "note": ("llama3-8b served int8 weight-only: 8B bf16 = 16.06 GB > "
+                 "16 GiB v5e HBM" if "8b" in s["preset"] else ""),
     }
+    violations: list[str] = []
+
+    t0 = time.perf_counter()
+    engine = load_engine(s["preset"], max_batch=s["batch"],
+                         max_seq_len=s["max_seq"],
+                         prefill_buckets=s["prefill_buckets"],
+                         decode_steps=s["decode_steps"])
+    fence(engine.params["layers"][0]["wq"])
+    out["param_init_s"] = round(time.perf_counter() - t0, 2)
+
+    counts = decode_byte_counts(engine.params, engine.cfg, s["batch"],
+                                s["ctx0"])
+    out["streamed_weight_gb"] = round(counts["streamed_bytes"] / 1e9, 3)
+
+    # --- raw decode windows through the ENGINE's compiled decode graph ----
+    k = s["window_k"]
+    dec = engine._decode_k(k)
+    cache_len = jnp.full((s["batch"],), s["ctx0"], jnp.int32)
+    last = jnp.ones((s["batch"], 1), jnp.int32)
+    active = jnp.ones((s["batch"],), bool)
+    rng = jax.random.PRNGKey(0)
+    kv = engine.kv_cache
+
+    t0 = time.perf_counter()
+    last, kv, cache_len, rng, toks = dec(engine.params, kv, last, cache_len,
+                                         active, rng)
+    checksum = fence(toks)
+    out["decode_compile_s"] = round(time.perf_counter() - t0, 2)
+
+    def run_windows(n: int) -> float:
+        nonlocal last, kv, cache_len, rng, checksum
+        # reset position so every run does identical work
+        cache_len = jnp.full((s["batch"],), s["ctx0"], jnp.int32)
+        checksum += fence(cache_len)                      # start fence
+        t0 = time.perf_counter()
+        for _ in range(n):
+            last, kv, cache_len, rng, toks = dec(
+                engine.params, kv, last, cache_len, active, rng)
+            checksum += fence(toks)                       # window fence
+        return time.perf_counter() - t0
+
+    w = s["windows"]
+    elapsed_1x = run_windows(w)
+    elapsed_2x = run_windows(2 * w)
+    # the raw loop donated the engine's cache through each call — hand the
+    # final buffer back so the engine e2e below starts from a live cache
+    engine.kv_cache = kv
+    out["fence_checksum"] = round(checksum, 2)
+    out["raw_elapsed_1x_s"] = round(elapsed_1x, 4)
+    out["raw_elapsed_2x_s"] = round(elapsed_2x, 4)
+    out["raw_scaling_ratio"] = round(elapsed_2x / max(elapsed_1x, 1e-9), 3)
+
+    steps = w * k
+    step_ms = elapsed_1x / steps * 1e3
+    raw_tps = s["batch"] * steps / elapsed_1x
+    phys = decode_physics(
+        step_ms=step_ms, batch=s["batch"],
+        streamed_bytes=counts["streamed_bytes"],
+        kv_bytes_per_step=counts["kv_bytes_per_step"],
+        matmul_params=counts["matmul_params"],
+        attn_flops_per_step=counts["attn_flops_per_step"], spec=spec)
+    out["raw_decode_step_ms"] = round(step_ms, 3)
+    out["raw_decode_tokens_per_sec"] = round(raw_tps, 1)
+    out["raw_physics"] = phys
+
+    if tpu:
+        violations += physics_violations(phys, what="raw decode")
+        violations += linear_scaling_violations(
+            elapsed_1x, elapsed_2x, what="raw decode")
+
+    # --- engine end-to-end: concurrent requests through generate() --------
+    async def engine_e2e() -> dict:
+        t0 = time.perf_counter()
+        engine.warmup()        # compile all prefill/decode graphs up front
+        await engine.start()
+        prompt = list(range(3, 3 + s["prompt_len"]))
+        await engine.generate(prompt, max_new_tokens=s["max_new"])
+        warm_s = time.perf_counter() - t0
+        before = engine._stats["tokens_generated"] + 1    # + prefill token
+        t0 = time.perf_counter()
+        results = await asyncio.gather(*[
+            engine.generate([p + i for p in prompt],
+                            max_new_tokens=s["max_new"])
+            for i in range(s["requests"])])
+        elapsed = time.perf_counter() - t0
+        await engine.stop()
+        total = sum(len(r) for r in results)
+        # served proof: the engine's own counter must account for every
+        # token the callers received (first tokens come from prefill and are
+        # not in tokens_generated — count them explicitly)
+        counted = (engine._stats["tokens_generated"] + len(results)
+                   + 1) - before
+        return {"warm_s": warm_s, "elapsed": elapsed, "total": total,
+                "counted": counted}
+
+    ee = asyncio.run(engine_e2e())
+    out["engine_warmup_s"] = round(ee["warm_s"], 2)
+    out["engine_requests"] = s["requests"]
+    out["engine_tokens_returned"] = ee["total"]
+    out["engine_elapsed_s"] = round(ee["elapsed"], 3)
+    engine_tps = ee["total"] / ee["elapsed"]
+    out["engine_tokens_per_sec"] = round(engine_tps, 1)
+    out["engine_tokens_per_sec_per_chip"] = round(engine_tps, 1)
+    out["engine_served_proof_ok"] = ee["counted"] >= ee["total"]
+    if not out["engine_served_proof_ok"]:
+        violations.append(
+            f"engine: callers received {ee['total']} tokens but engine "
+            f"counted {ee['counted']}")
+
+    # engine-path physics: requests run in waves of max_batch; per-step
+    # bytes are the same as raw decode (weights stream regardless of
+    # occupancy), so implied step time must also clear the bandwidth bar
+    eng_steps = ee["total"] / s["batch"]                  # lower bound
+    eng_step_ms = ee["elapsed"] / max(eng_steps, 1e-9) * 1e3
+    eng_phys = decode_physics(
+        step_ms=eng_step_ms, batch=s["batch"],
+        streamed_bytes=counts["streamed_bytes"],
+        kv_bytes_per_step=counts["kv_bytes_per_step"],
+        matmul_params=counts["matmul_params"],
+        attn_flops_per_step=counts["attn_flops_per_step"], spec=spec)
+    out["engine_physics"] = eng_phys
+    if tpu:
+        violations += physics_violations(eng_phys, what="engine decode")
+
+    out["violations"] = violations
+    out["valid"] = not violations
+    return out
 
 
 # ---------------------------------------------------------------------------
-# phase: kernel validation (pallas vs XLA: correctness + step time)
+# phase: llm through a real @endpoint deployment (runner container on TPU)
+# ---------------------------------------------------------------------------
+
+LLM_BENCH_APP = """
+from tpu9.serving.presets import load_engine
+
+def load():
+    return load_engine("{preset}", max_batch={batch}, max_seq_len={max_seq},
+                       prefill_buckets={prefill_buckets},
+                       decode_steps={decode_steps})
+"""
+
+
+def bench_llm_endpoint(quick: bool = False) -> dict:
+    """Serve the flagship engine behind ``@endpoint tpu=v5e-1`` through the
+    real gateway/scheduler/worker stack. The gateway/worker processes stay
+    forced-CPU; ONLY the runner container gets the TPU env, mirroring
+    production (the worker injects chip env per assignment)."""
+    import asyncio
+
+    tunnel_env = {k: os.environ[k] for k in _TUNNEL_ENV_KEYS
+                  if k in os.environ}
+    on_real_tpu = bool(tunnel_env.get("JAX_PLATFORMS")) and not quick \
+        and os.environ.get("TPU9_BENCH_CPU") != "1"
+
+    from tpu9.utils import force_cpu
+    force_cpu(host_devices=0)      # this process must never dial the chip
+
+    from tpu9.testing.localstack import LocalStack
+
+    s = _llm_settings(on_real_tpu, quick)
+    os.makedirs(XLA_CACHE_DIR, exist_ok=True)
+
+    container_env = {"JAX_COMPILATION_CACHE_DIR": XLA_CACHE_DIR}
+    if on_real_tpu:
+        container_env.update(tunnel_env)
+        container_env["PYTHONPATH"] = "/root/.axon_site"
+    else:
+        container_env["JAX_PLATFORMS"] = "cpu"
+
+    app = LLM_BENCH_APP.format(
+        preset=s["preset"], batch=s["batch"], max_seq=s["max_seq"],
+        prefill_buckets=tuple(s["prefill_buckets"]),
+        decode_steps=tuple(s["decode_steps"]))
+
+    async def run() -> dict:
+        out: dict = {"endpoint_model": s["preset"],
+                     "endpoint_container_on_tpu": on_real_tpu}
+        violations: list[str] = []
+        async with LocalStack(pool_tpu_type="v5e-1") as stack:
+            await stack._worker_factory(tpu_chips=1, tpu_generation="v5e")
+            dep = await stack.deploy_endpoint(
+                "llm-bench", {"app.py": app}, "app:load",
+                config_extra={
+                    "timeout_s": 1500.0,
+                    "concurrent_requests": 64,
+                    "extra": {"runner": "llm"},
+                    "env": container_env,
+                    "runtime": {"tpu": "v5e-1", "cpu_millicores": 2000,
+                                "memory_mb": 16384},
+                    "autoscaler": {"max_containers": 1}})
+            prompt = list(range(3, 3 + s["prompt_len"]))
+            t0 = time.perf_counter()
+            status, warm = await stack.api(
+                "POST", "/endpoint/llm-bench",
+                json_body={"tokens": prompt, "max_new_tokens": s["max_new"]},
+                timeout=1500)
+            out["endpoint_warmup_s"] = round(time.perf_counter() - t0, 2)
+            if status != 200:
+                return {"llm_endpoint_error": f"warmup status {status}: "
+                        f"{str(warm)[:300]}"}
+            # pre-run served counter: the proof below must cover ONLY the
+            # timed requests, not the warmup's tokens
+            status, h0 = await stack.api("GET", "/endpoint/llm-bench/health")
+            served_before = int(h0.get("tokens_generated", 0)) \
+                if status == 200 else -1
+
+            async def one(i: int):
+                return await stack.api(
+                    "POST", "/endpoint/llm-bench",
+                    json_body={"tokens": [p + i for p in prompt],
+                               "max_new_tokens": s["max_new"]},
+                    timeout=1500)
+
+            t0 = time.perf_counter()
+            results = await asyncio.gather(*[one(i)
+                                             for i in range(s["requests"])])
+            elapsed = time.perf_counter() - t0
+            bad = [r for r in results if r[0] != 200]
+            if bad:
+                return {"llm_endpoint_error":
+                        f"{len(bad)} failed requests: {str(bad[0])[:300]}"}
+            total = sum(len(r[1]["tokens"]) for r in results)
+
+            # container-side served proof via the runner's /health stats:
+            # decode-counter delta + one prefill-sampled token per request
+            status, health = await stack.api("GET",
+                                             "/endpoint/llm-bench/health")
+            served = (int(health.get("tokens_generated", 0)) - served_before
+                      + len(results)) if status == 200 and served_before >= 0 \
+                else -1
+            out["endpoint_requests"] = s["requests"]
+            out["endpoint_tokens_returned"] = total
+            out["endpoint_elapsed_s"] = round(elapsed, 3)
+            tps = total / elapsed
+            out["endpoint_tokens_per_sec"] = round(tps, 1)
+            out["endpoint_tokens_per_sec_per_chip"] = round(tps, 1)
+            out["endpoint_served_proof_ok"] = served >= total
+            if not out["endpoint_served_proof_ok"]:
+                violations.append(
+                    f"endpoint: received {total} tokens but container "
+                    f"reports {served}")
+
+            if on_real_tpu:
+                from tpu9.benchsuite.physics import (chip_spec,
+                                                     decode_physics,
+                                                     physics_violations)
+                from tpu9.serving.presets import resolve_preset
+                cfg, _ = resolve_preset(s["preset"])
+                # weight bytes from config (the engine lives in the
+                # container; recompute analytically at int8 widths)
+                per_layer = (cfg.dim * cfg.n_heads * cfg.head_dim
+                             + 2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim
+                             + cfg.n_heads * cfg.head_dim * cfg.dim
+                             + 3 * cfg.dim * cfg.hidden_dim)
+                matmul_params = (per_layer * cfg.n_layers
+                                 + cfg.dim * cfg.vocab_size)
+                streamed = matmul_params          # int8: 1 byte/param
+                kv_row = cfg.n_kv_heads * cfg.head_dim * 2
+                kv_bytes = 2 * cfg.n_layers * s["batch"] * (
+                    s["prompt_len"] + s["max_new"] // 2) * kv_row
+                eng_step_ms = elapsed / max(total / s["batch"], 1e-9) * 1e3
+                spec = chip_spec(os.environ.get("PALLAS_AXON_TPU_GEN", ""))
+                phys = decode_physics(
+                    step_ms=eng_step_ms, batch=s["batch"],
+                    streamed_bytes=streamed, kv_bytes_per_step=kv_bytes,
+                    matmul_params=matmul_params, spec=spec)
+                out["endpoint_physics"] = phys
+                violations += physics_violations(phys, what="endpoint decode")
+        out["violations"] = violations
+        out["valid"] = not violations
+        return out
+
+    return asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# phase: kernel validation (pallas vs XLA: correctness + fenced step time)
 # ---------------------------------------------------------------------------
 
 def bench_kernels(quick: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
+    from tpu9.benchsuite.physics import (chip_spec, matmul_physics,
+                                         physics_violations)
     from tpu9.ops.attention import flash_attention, xla_attention
     from tpu9.ops.paged_attention import ragged_decode_attention
     from tpu9.utils import on_tpu
 
     tpu = on_tpu()
     interpret = not tpu           # CPU runs the same kernels interpreted
+    dev = jax.devices()[0]
+    spec = chip_spec(getattr(dev, "device_kind", ""))
     out: dict = {"backend": jax.default_backend(), "on_tpu": tpu}
+    violations: list[str] = []
 
     def timeit(fn, *args, iters=3 if quick or not tpu else 20, **kw):
         r = fn(*args, **kw)
-        jax.block_until_ready(r)
+        fence(r)                                  # compile + warmup fence
+        fence(args[0])                            # start fence
         t0 = time.perf_counter()
         for _ in range(iters):
             r = fn(*args, **kw)
-        jax.block_until_ready(r)
+        fence(r)                                  # same-stream order: forces all
         return r, (time.perf_counter() - t0) / iters * 1000
 
     # flash attention: [B, T, H, D]
@@ -164,6 +456,14 @@ def bench_kernels(quick: bool = False) -> dict:
     out["flash_ms"] = round(flash_ms, 3)
     out["flash_xla_ms"] = round(xla_ms, 3)
     out["flash_shape"] = [b, t, h, d]
+    # causal attention: ~0.5 * 4 * B*T^2*H*D FLOPs (half the square masked)
+    flash_flops = 2.0 * b * t * t * h * d
+    flash_bytes = 4 * b * t * h * d * 2           # q,k,v read + out write, bf16
+    fp = matmul_physics(elapsed_ms=flash_ms, flops=flash_flops,
+                        bytes_moved=flash_bytes, spec=spec)
+    out["flash_physics"] = fp
+    if tpu:
+        violations += physics_violations(fp, what="flash attention")
 
     # ragged paged decode: q [B,1,QH,D], cache [B,S,KH,D]
     b, s, qh, kh, d = (2, 512, 8, 2, 64) if quick or not tpu \
@@ -182,6 +482,18 @@ def bench_kernels(quick: bool = False) -> dict:
     out["paged_ms"] = round(paged_ms, 3)
     out["paged_xla_ms"] = round(xla2_ms, 3)
     out["paged_shape"] = [b, s, qh, kh, d]
+    # decode attention is bandwidth-bound: reads mean(lens) K+V rows/seq
+    mean_len = float(jnp.mean(lens))
+    paged_bytes = int(2 * b * mean_len * kh * d * 2)
+    paged_flops = 4.0 * b * mean_len * qh * d
+    pp = matmul_physics(elapsed_ms=paged_ms, flops=paged_flops,
+                        bytes_moved=paged_bytes, spec=spec)
+    out["paged_physics"] = pp
+    if tpu:
+        violations += physics_violations(pp, what="paged decode")
+
+    out["violations"] = violations
+    out["valid"] = not violations
     return out
 
 
@@ -219,7 +531,7 @@ def bench_cold_start(quick: bool = False) -> dict:
         # nearest-rank p95: ceil(0.95*n)-th sample — for small n this is the
         # max, never an optimistic lower percentile mislabeled as p95
         p95_idx = max(0, -(-95 * len(times) // 100) - 1)
-        return {
+        out = {
             "cold_start_p50_s": round(statistics.median(times), 4),
             "cold_start_p95_s": round(times[p95_idx], 4),
             "cold_start_min_s": round(times[0], 4),
@@ -227,8 +539,211 @@ def bench_cold_start(quick: bool = False) -> dict:
             "cold_start_backoff_events": backoffs,
             "trials": trials,
         }
+        out["violations"] = (
+            [f"coldstart: {backoffs} circuit-breaker backoff events "
+             f"polluted the run"] if backoffs else [])
+        out["valid"] = not out["violations"]
+        return out
 
     return asyncio.run(run())
+
+
+def _percentiles(times: list[float]) -> dict:
+    times = sorted(times)
+    p95_idx = max(0, -(-95 * len(times) // 100) - 1)
+    return {"p50": round(statistics.median(times), 4),
+            "p95": round(times[p95_idx], 4),
+            "min": round(times[0], 4), "max": round(times[-1], 4)}
+
+
+def _phase_report() -> dict:
+    """p50/p95/max per lifecycle phase from the worker's startup timeline
+    (reference: benchmarks/sandbox_startup_report.py — per-phase report
+    derived from lifecycle events)."""
+    from tpu9.observability.metrics import metrics as registry
+    out = {}
+    for key, summ in registry.summaries.items():
+        if key.startswith("tpu9_startup_phase_s"):
+            snap = summ.snapshot()
+            phase = key.split('phase="')[-1].rstrip('"}')
+            out[phase] = {"p50": round(snap["p50"], 4),
+                          "p95": round(snap["p95"], 4),
+                          "max": round(snap["max"], 4),
+                          "n": snap["count"]}
+    return out
+
+
+def bench_cold_start_native(quick: bool = False) -> dict:
+    """VERDICT round-2 item #2: the REAL cold-start path — NativeRuntime
+    containers (netns + overlay + pivot_root) started from a chunked image
+    pulled through the content cache, not a bare ProcessRuntime echo.
+
+    Reports three tiers, each with phase-timeline evidence:
+    - warm-node: bundle already materialized (the common autoscale cycle)
+    - cold-pull: bundle deleted between trials, chunks re-fetched through
+      the cache (counters prove the pull happened)
+    """
+    import asyncio
+    import shutil
+
+    if os.geteuid() != 0:
+        return {"coldstart_native_skipped": "requires root for NativeRuntime"}
+
+    os.environ["TPU9_RUNTIME"] = "native"
+    from tpu9.testing.localstack import LocalStack
+
+    payload_mb = 4 if quick else 48
+    warm_trials = 3 if quick else 10
+    pull_trials = 2 if quick else 5
+
+    app = ("import os\n"
+           "def handler(**kwargs):\n"
+           "    sz = os.path.getsize(os.environ['BLOB_PATH'])\n"
+           "    return {'blob_bytes': sz}\n")
+
+    async def run() -> dict:
+        out: dict = {"runtime": "native", "image_payload_mb": payload_mb}
+        violations: list[str] = []
+        async with LocalStack() as stack:
+            status, img = await stack.api("POST", "/rpc/image/build", json_body={
+                "commands": [f"mkdir -p env && head -c {payload_mb*1024*1024} "
+                             f"/dev/urandom > env/blob.bin"]})
+            assert status == 200, img
+            image_id = img["image_id"]
+            for _ in range(600):
+                _, st = await stack.api("GET", f"/rpc/image/status/{image_id}")
+                if st["status"] in ("ready", "failed"):
+                    break
+                await asyncio.sleep(0.1)
+            if st["status"] != "ready":
+                return {"coldstart_native_error": f"image build: {st}"}
+
+            bundle = os.path.join(stack.cfg.cache.data_dir, "bundles",
+                                  image_id)
+            blob = os.path.join(bundle, "env", "blob.bin")
+            dep = await stack.deploy_endpoint(
+                "native-imaged", {"app.py": app}, "app:handler",
+                config_extra={
+                    "runtime": {"image_id": image_id, "cpu_millicores": 1000,
+                                "memory_mb": 1024},
+                    "env": {"BLOB_PATH": blob}})
+
+            t0 = time.perf_counter()
+            first = await stack.invoke(dep, {"n": 0})
+            out["first_deploy_s"] = round(time.perf_counter() - t0, 4)
+            if first.get("blob_bytes") != payload_mb * 1024 * 1024:
+                violations.append(
+                    f"coldstart_native: container did not see the image "
+                    f"payload ({first})")
+
+            warm = []
+            for _ in range(warm_trials):
+                await stack.scale_to_zero(dep)
+                t0 = time.perf_counter()
+                await stack.invoke(dep, {"n": 1})
+                warm.append(time.perf_counter() - t0)
+            out["cold_start_native_warmnode"] = _percentiles(warm)
+            out["cold_start_native_p50_s"] = out[
+                "cold_start_native_warmnode"]["p50"]
+
+            # cold-pull tier: delete the bundle so materialization (from the
+            # node cache store) is back on the path
+            worker = stack.workers[0] if getattr(stack, "workers", None) \
+                else None
+            pulls = []
+            fetch_counts = []
+            for _ in range(pull_trials):
+                await stack.scale_to_zero(dep)
+                shutil.rmtree(bundle, ignore_errors=True)
+                before = dict(worker.cache.client.stats) if worker else {}
+                t0 = time.perf_counter()
+                await stack.invoke(dep, {"n": 2})
+                pulls.append(time.perf_counter() - t0)
+                after = dict(worker.cache.client.stats) if worker else {}
+                fetch_counts.append(
+                    sum(after.values()) - sum(before.values()))
+            out["cold_start_native_pull"] = _percentiles(pulls)
+            out["cold_start_native_pull_p50_s"] = out[
+                "cold_start_native_pull"]["p50"]
+            if worker and not any(c > 0 for c in fetch_counts):
+                violations.append(
+                    "coldstart_native: bundle deleted but zero cache "
+                    "activity during re-pull — the pull did not happen")
+            out["pull_cache_ops_per_trial"] = fetch_counts
+            out["phase_timeline"] = _phase_report()
+        out["violations"] = violations
+        out["valid"] = not violations
+        return out
+
+    return asyncio.run(run())
+
+
+def bench_cold_start_jax(quick: bool = False) -> dict:
+    """Cold start of a JAX container with persistent-compile-cache restore:
+    first boot pays the XLA compile; every later cold start restores the
+    executable from JAX_COMPILATION_CACHE_DIR (the real TPU cold-start tail
+    is compile time — SURVEY.md §7 hard-part #2)."""
+    import asyncio
+    import tempfile
+
+    from tpu9.testing.localstack import LocalStack
+
+    trials = 3 if quick else 10
+    app = (
+        "import os\n"
+        "import jax, jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    for _ in range(8):\n"
+        "        x = jnp.tanh(x @ x.T) + x\n"
+        "    return x.sum()\n"
+        "X = jnp.ones((256, 256))\n"
+        "Y0 = float(f(X))          # compile at import: the cold-start cost\n"
+        "def handler(**kwargs):\n"
+        "    return {'y': float(f(X))}\n")
+
+    cache_dir = tempfile.mkdtemp(prefix="tpu9-bench-jaxcache-")
+
+    async def run() -> dict:
+        out: dict = {}
+        violations: list[str] = []
+        async with LocalStack() as stack:
+            dep = await stack.deploy_endpoint(
+                "jax-restore", {"app.py": app}, "app:handler",
+                config_extra={
+                    "timeout_s": 300.0,
+                    "env": {"JAX_PLATFORMS": "cpu",
+                            "JAX_COMPILATION_CACHE_DIR": cache_dir,
+                            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+                            "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0"}})
+            t0 = time.perf_counter()
+            first = await stack.invoke(dep, {}, timeout=300.0)
+            out["cold_start_jax_first_s"] = round(time.perf_counter() - t0, 4)
+            assert "y" in first, first
+            cached_entries = sum(len(fs) for _, _, fs in os.walk(cache_dir))
+            out["jax_cache_entries"] = cached_entries
+            if cached_entries == 0:
+                violations.append(
+                    "coldstart_jax: no persistent-cache entries written — "
+                    "restore trials would be re-measuring cold compiles")
+            restores = []
+            for _ in range(trials):
+                await stack.scale_to_zero(dep)
+                t0 = time.perf_counter()
+                await stack.invoke(dep, {}, timeout=300.0)
+                restores.append(time.perf_counter() - t0)
+            out["cold_start_jax_restore"] = _percentiles(restores)
+            out["cold_start_jax_restore_p50_s"] = out[
+                "cold_start_jax_restore"]["p50"]
+        out["violations"] = violations
+        out["valid"] = not violations
+        return out
+
+    try:
+        return asyncio.run(run())
+    finally:
+        import shutil
+        shutil.rmtree(cache_dir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -344,6 +859,25 @@ def _tpu_alive(timeout_s: float = 120.0) -> bool:
         _kill_group(proc)
 
 
+def _merge_validated(extra: dict, phase: str, result: dict,
+                     value_keys: tuple[str, ...]) -> None:
+    """Merge a phase result, REMOVING its headline numbers if the phase's
+    own evidence rejected them — BENCH must never carry an un-evidenced
+    number (round-2 failure: a physically impossible tokens/sec shipped)."""
+    result = dict(result)
+    # per-phase valid/violations fold into the shared validation block —
+    # left at top level they'd clobber each other across phases
+    violations = result.pop("violations", [])
+    result.pop("valid", None)
+    if violations:
+        for key in value_keys:
+            result.pop(key, None)
+        result[f"{phase}_rejected"] = "; ".join(violations)
+    extra.setdefault("validation", {}).setdefault("violations", []) \
+        .extend(violations)
+    extra.update(result)
+
+
 def orchestrate(quick: bool, cpu: bool) -> dict:
     extra: dict = {}
 
@@ -358,16 +892,42 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
         # TPU init failed/hung — fall back to CPU so the metric exists
         extra["llm_tpu_error"] = llm["llm_error"]
         llm = _run_phase("llm", quick, True)
-    extra.update(llm)
+    _merge_validated(extra, "llm", llm, (
+        "raw_decode_tokens_per_sec", "engine_tokens_per_sec",
+        "engine_tokens_per_sec_per_chip"))
+
+    # the endpoint phase's PARENT forces itself CPU internally; the runner
+    # container dials the chip (unless the whole bench is CPU-forced, which
+    # --cpu → TPU9_BENCH_CPU=1 propagates into the subprocess)
+    lep = _run_phase("llm_endpoint", quick, cpu)
+    _merge_validated(extra, "llm_endpoint", lep, (
+        "endpoint_tokens_per_sec", "endpoint_tokens_per_sec_per_chip"))
 
     kern = _run_phase("kernels", quick, cpu)
     if "kernels_error" in kern and not cpu:
         extra["kernels_tpu_error"] = kern["kernels_error"]
         kern = _run_phase("kernels", quick, True)
-    extra.update({f"kernel_{k}" if not k.startswith("kernel") else k: v
-                  for k, v in kern.items()})
+    kern = {f"kernel_{k}" if not k.startswith("kernel") else k: v
+            for k, v in kern.items()}
+    kern["violations"] = kern.pop("kernel_violations", [])
+    _merge_validated(extra, "kernels", kern, ("kernel_flash_ms",
+                                              "kernel_paged_ms"))
 
-    extra.update(_run_phase("coldstart", quick, cpu))
+    cs = _run_phase("coldstart", quick, cpu)
+    _merge_validated(extra, "coldstart", cs, ("cold_start_p50_s",))
+
+    csn = _run_phase("coldstart_native", quick, cpu)
+    _merge_validated(extra, "coldstart_native", csn,
+                     ("cold_start_native_p50_s",
+                      "cold_start_native_pull_p50_s"))
+
+    csj = _run_phase("coldstart_jax", quick, cpu)
+    _merge_validated(extra, "coldstart_jax", csj,
+                     ("cold_start_jax_restore_p50_s",))
+
+    v = extra.get("validation", {"violations": []})
+    v["ok"] = not v["violations"]
+    extra["validation"] = v
     return extra
 
 
@@ -376,20 +936,31 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (local verification)")
-    ap.add_argument("--phase", choices=["llm", "kernels", "coldstart"],
+    ap.add_argument("--phase",
+                    choices=["llm", "llm_endpoint", "kernels", "coldstart",
+                             "coldstart_native", "coldstart_jax"],
                     help="run one phase in-process (used by the orchestrator)")
     args = ap.parse_args()
 
     if args.cpu:
-        from tpu9.utils import force_cpu
-        force_cpu(host_devices=8 if args.phase != "coldstart" else 0)
+        # --cpu means force EVERYTHING CPU, including llm_endpoint's runner
+        # container. Without --cpu, llm_endpoint still forces its own parent
+        # process CPU internally while the container gets the chip.
+        os.environ["TPU9_BENCH_CPU"] = "1"
+        if args.phase != "llm_endpoint":   # that phase force_cpu()s itself
+            from tpu9.utils import force_cpu
+            force_cpu(host_devices=8 if args.phase != "coldstart" else 0)
 
     if args.phase:
-        fn = {"llm": bench_llm_decode, "kernels": bench_kernels,
-              "coldstart": bench_cold_start}[args.phase]
+        fn = {"llm": bench_llm, "llm_endpoint": bench_llm_endpoint,
+              "kernels": bench_kernels, "coldstart": bench_cold_start,
+              "coldstart_native": bench_cold_start_native,
+              "coldstart_jax": bench_cold_start_jax}[args.phase]
         try:
             print(json.dumps(fn(quick=args.quick)))
         except Exception as exc:   # noqa: BLE001 — phase errors are data
+            import traceback
+            traceback.print_exc()
             print(json.dumps(
                 {f"{args.phase}_error": f"{type(exc).__name__}: {exc}"}))
             sys.exit(1)
@@ -402,9 +973,9 @@ def main() -> None:
         line = {"metric": "cold_start_p50_s", "value": value, "unit": "s",
                 "vs_baseline": round(1.0 / max(value, 1e-9), 3),
                 "extra": extra}
-    elif "decode_tokens_per_sec_per_chip" in extra:
-        line = {"metric": "decode_tokens_per_sec_per_chip",
-                "value": extra["decode_tokens_per_sec_per_chip"],
+    elif "engine_tokens_per_sec_per_chip" in extra:
+        line = {"metric": "engine_tokens_per_sec_per_chip",
+                "value": extra["engine_tokens_per_sec_per_chip"],
                 "unit": "tok/s/chip", "vs_baseline": 0.0, "extra": extra}
     else:
         line = {"metric": "bench_failed", "value": 0, "unit": "",

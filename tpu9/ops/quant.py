@@ -58,6 +58,63 @@ def quantize_decoder(params: Params) -> Params:
     return out
 
 
+def _random_quantized(rng, in_dim: int, out_dim: int) -> dict:
+    """A random int8 weight entry with realistic scales, built WITHOUT the
+    full-precision intermediate. For benchmark/e2e use where weights are
+    random anyway: an 8B model in bf16 (16 GiB) cannot be materialized on a
+    16 GiB-HBM chip just to quantize it down to 8 GiB."""
+    rq, rs = jax.random.split(rng)
+    q = jax.random.randint(rq, (in_dim, out_dim), -127, 128, dtype=jnp.int8)
+    # per-output-channel scales matching _dense_init's variance:
+    # std = sqrt(2/(in+out)); int8 values ~U[-127,127] have std ~73, so
+    # scale ≈ std/73 reproduces the dense init's magnitude
+    std = (2.0 / (in_dim + out_dim)) ** 0.5
+    scale = (jax.random.uniform(rs, (1, out_dim), jnp.float32,
+                                0.8, 1.2) * std / 73.0)
+    return {"q": q, "scale": scale}
+
+
+def init_quantized_decoder(rng, cfg) -> Params:
+    """``init_decoder``-shaped tree with int8 projections synthesized
+    directly on device. Same tree structure/path names as
+    ``tpu9.models.transformer.init_decoder`` so sharding rules and
+    ``decoder_forward`` apply unchanged."""
+    n_rngs = cfg.n_layers * 7 + 3
+    rngs = jax.random.split(rng, n_rngs)
+    it = iter(range(n_rngs))
+
+    def nxt():
+        return rngs[next(it)]
+
+    dt = cfg.dtype
+    params: Params = {
+        "embed": (jax.random.normal(nxt(), (cfg.vocab_size, cfg.dim),
+                                    dtype=jnp.float32) * 0.02).astype(dt),
+        "final_norm": jnp.ones((cfg.dim,), jnp.float32) - cfg.norm_offset,
+        "layers": [],
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _random_quantized(nxt(), cfg.dim, cfg.vocab_size)
+    else:
+        nxt()
+    q_dim = cfg.n_heads * cfg.head_dim
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    for _ in range(cfg.n_layers):
+        layer = {
+            "attn_norm": jnp.ones((cfg.dim,), jnp.float32) - cfg.norm_offset,
+            "mlp_norm": jnp.ones((cfg.dim,), jnp.float32) - cfg.norm_offset,
+            "wq": _random_quantized(nxt(), cfg.dim, q_dim),
+            "wk": _random_quantized(nxt(), cfg.dim, kv_dim),
+            "wv": _random_quantized(nxt(), cfg.dim, kv_dim),
+            "wo": _random_quantized(nxt(), q_dim, cfg.dim),
+            "w_gate": _random_quantized(nxt(), cfg.dim, cfg.hidden_dim),
+            "w_up": _random_quantized(nxt(), cfg.dim, cfg.hidden_dim),
+            "w_down": _random_quantized(nxt(), cfg.hidden_dim, cfg.dim),
+        }
+        params["layers"].append(layer)
+    return params
+
+
 def maybe_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """Matmul that accepts either a plain array or a quantized entry —
     lets the decoder forward run on mixed trees."""

@@ -43,18 +43,9 @@ def _build_engine(obj):
         ecfg = obj[2] if len(obj) == 3 else EngineConfig()
         return InferenceEngine(params, cfg, ecfg)
     if isinstance(obj, str):
-        import jax
-        from ..models import init_decoder
-        from ..models.gemma import GEMMA_PRESETS
-        from ..models.llama import LLAMA_PRESETS
-        from ..models.mixtral import MIXTRAL_PRESETS
-        presets = {**LLAMA_PRESETS, **GEMMA_PRESETS, **MIXTRAL_PRESETS}
-        if obj not in presets:
-            raise KeyError(f"unknown model preset {obj!r}; have "
-                           f"{sorted(presets)}")
-        cfg = presets[obj]
-        params = init_decoder(jax.random.PRNGKey(0), cfg)
-        return InferenceEngine(params, cfg, EngineConfig())
+        # preset name, optionally "-int8"-suffixed (weight-only quantized)
+        from ..serving.presets import load_engine
+        return load_engine(obj)
     raise TypeError(f"handler must return an engine, (params, cfg) or a "
                     f"preset name; got {type(obj)}")
 
@@ -108,6 +99,12 @@ async def amain() -> None:
     handler = FunctionHandler(cfg)
     result = await handler.call()
     engine = _build_engine(result)
+    # compile every serving graph BEFORE readiness: the first user request
+    # must never pay a multi-second XLA compile (readiness == serveable)
+    timings = await asyncio.get_event_loop().run_in_executor(
+        None, engine.warmup)
+    log.info("engine warmup: %s",
+             {k: round(v, 2) for k, v in timings.items()})
     await engine.start()
     state["engine"] = engine
     state["ready"] = True

@@ -22,9 +22,11 @@ Root required; ``NativeRuntime.supported()`` gates tests and factory use.
 from __future__ import annotations
 
 import asyncio
+import errno
 import json
 import logging
 import os
+import re
 import shutil
 import signal
 import time
@@ -184,8 +186,9 @@ class NativeRuntime(Runtime):
                        capture_output=True)
 
     async def _proxy_port(self, container_id: str, host_port: int,
-                          cont_ip: str, cont_port: int) -> None:
-        """Userspace forward 127.0.0.1:host_port → cont_ip:cont_port."""
+                          cont_ip: str, cont_port: int,
+                          listen_host: str = "127.0.0.1") -> None:
+        """Userspace forward listen_host:host_port → cont_ip:cont_port."""
         async def handle(reader, writer):
             try:
                 up_r, up_w = await asyncio.open_connection(cont_ip, cont_port)
@@ -212,7 +215,7 @@ class NativeRuntime(Runtime):
             await asyncio.gather(pump(reader, up_w), pump(up_r, writer),
                                  return_exceptions=True)
 
-        server = await asyncio.start_server(handle, "127.0.0.1", host_port)
+        server = await asyncio.start_server(handle, listen_host, host_port)
         self._proxies.setdefault(container_id, []).append(server)
 
     # -- rootfs --------------------------------------------------------------
@@ -280,10 +283,28 @@ class NativeRuntime(Runtime):
         env["TPU9_HOST_IP"] = host_ip      # the veth's host side
         # 127.0.0.1 means "this netns" inside the container: control-plane
         # URLs the worker injected must point at the host side of the veth
+        # — AND something must be listening there. Control-plane services
+        # (gateway, cache) bind the host's loopback, so for every rewritten
+        # port a reverse proxy on host_ip forwards into 127.0.0.1 of the
+        # host netns (outbound analogue of the inbound port proxy; the
+        # reference's agent route-proxy plays the same role).
+        cp_ports: set[int] = set()
         for key, val in list(env.items()):
             if isinstance(val, str) and "127.0.0.1" in val and key.startswith(
                     "TPU9_"):
                 env[key] = val.replace("127.0.0.1", host_ip)
+                cp_ports.update(int(p) for p in
+                                re.findall(r"127\.0\.0\.1:(\d+)", val))
+        for port in sorted(cp_ports):
+            try:
+                await self._proxy_port(spec.container_id, port,
+                                       "127.0.0.1", port,
+                                       listen_host=host_ip)
+            except OSError as exc:
+                if exc.errno != errno.EADDRINUSE:
+                    raise
+                # EADDRINUSE alone is benign: a prior container on this /30
+                # slot left its proxy up forwarding to the same place
 
         workdir = spec.workdir or "/"
         if workdir not in ("", "/"):

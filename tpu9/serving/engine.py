@@ -173,6 +173,35 @@ class InferenceEngine:
         if self._loop_task is None:
             self._loop_task = asyncio.create_task(self._serve_loop())
 
+    def warmup(self) -> dict:
+        """Precompile every prefill bucket and decode-window graph.
+
+        Production engines pay XLA compiles at boot, not on the first user
+        request: an 8B decode graph takes ~10 s to compile, and a window
+        size that first occurs mid-traffic (e.g. K=1 when retirements
+        stagger) would stall the whole decode batch behind a compile. Runs
+        each graph once with all-inactive lanes (state is threaded back, so
+        this is a no-op for correctness) and fences with a device→host copy.
+        """
+        import time as _time
+        timings: dict[str, float] = {}
+        for bucket in self.ecfg.prefill_buckets:
+            t0 = _time.perf_counter()
+            tokens = jnp.zeros((1, bucket), jnp.int32)
+            last, _cache = self._prefill_fn(bucket)(self.params, tokens, 1)
+            np.asarray(jax.device_get(last[:4]))
+            timings[f"prefill_{bucket}_s"] = _time.perf_counter() - t0
+        inactive = jnp.zeros((self.ecfg.max_batch,), bool)
+        for k in self.ecfg.decode_steps:
+            t0 = _time.perf_counter()
+            (self.last_token, self.kv_cache, self.cache_len, self._rng,
+             toks) = self._decode_k(k)(
+                self.params, self.kv_cache, self.last_token,
+                self.cache_len, inactive, self._rng)
+            np.asarray(jax.device_get(toks[-1, :4]))
+            timings[f"decode_k{k}_s"] = _time.perf_counter() - t0
+        return timings
+
     async def stop(self) -> None:
         if self._loop_task:
             self._loop_task.cancel()

@@ -1,0 +1,56 @@
+"""Engine construction from model presets, shared by the LLM runner and the
+benchmark harness so the number the bench reports comes from the exact code
+path a ``@endpoint`` deployment serves.
+
+The flagship single-chip serving config is ``llama3-8b`` with int8
+weight-only quantization: 8B params in bf16 are 16.06 GB — more than a
+v5e's 16 GiB HBM — so the reference north-star config #2 (Llama-3-8B on
+v5e-1, BASELINE.md) is served int8 (~8.1 GB weights + bf16 KV cache), the
+standard weight-only recipe for this chip class.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .engine import EngineConfig, InferenceEngine
+
+
+def resolve_preset(name: str):
+    """Return (DecoderConfig, quantized: bool) for a preset name.
+    ``<preset>-int8`` suffixes select int8 weight-only quantization."""
+    from ..models.gemma import GEMMA_PRESETS
+    from ..models.llama import LLAMA_PRESETS
+    from ..models.mixtral import MIXTRAL_PRESETS
+    presets = {**LLAMA_PRESETS, **GEMMA_PRESETS, **MIXTRAL_PRESETS}
+    quantized = name.endswith("-int8")
+    base = name[:-len("-int8")] if quantized else name
+    if base not in presets:
+        raise KeyError(f"unknown model preset {base!r}; have {sorted(presets)}")
+    return presets[base], quantized
+
+
+def build_params(name: str, seed: int = 0):
+    """Random-initialized params for a preset (weight loading from a real
+    checkpoint is ``tpu9.serving.weights``' concern). int8 presets are
+    synthesized directly at int8 so the bf16 intermediate never exists."""
+    import jax
+    cfg, quantized = resolve_preset(name)
+    rng = jax.random.PRNGKey(seed)
+    if quantized:
+        from ..ops.quant import init_quantized_decoder
+        return init_quantized_decoder(rng, cfg), cfg
+    from ..models import init_decoder
+    return init_decoder(rng, cfg), cfg
+
+
+def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
+                prefill_buckets: tuple = (128, 512, 2048),
+                decode_steps: tuple = (1, 8, 32),
+                engine_cfg: Optional[EngineConfig] = None,
+                seed: int = 0) -> InferenceEngine:
+    params, cfg = build_params(name, seed=seed)
+    ecfg = engine_cfg or EngineConfig(
+        max_batch=max_batch, max_seq_len=max_seq_len,
+        prefill_buckets=prefill_buckets, decode_steps=decode_steps)
+    return InferenceEngine(params, cfg, ecfg)
