@@ -647,25 +647,30 @@ def bench_cold_start_native(quick: bool = False) -> dict:
                 "cold_start_native_warmnode"]["p50"]
 
             # cold-pull tier: delete the bundle so materialization (from the
-            # node cache store) is back on the path
-            worker = stack.workers[0] if getattr(stack, "workers", None) \
-                else None
+            # node cache store) is back on the path. Cache stats are summed
+            # across ALL workers — the pool can run several and the timed
+            # container may land on any of them (round-3 advisor finding:
+            # reading workers[0] alone can fake a 'pull did not happen').
+            workers = list(getattr(stack, "workers", None) or [])
+
+            def cache_ops() -> int:
+                return sum(sum(w.cache.client.stats.values())
+                           for w in workers if getattr(w, "cache", None))
+
             pulls = []
             fetch_counts = []
             for _ in range(pull_trials):
                 await stack.scale_to_zero(dep)
                 shutil.rmtree(bundle, ignore_errors=True)
-                before = dict(worker.cache.client.stats) if worker else {}
+                before = cache_ops()
                 t0 = time.perf_counter()
                 await stack.invoke(dep, {"n": 2})
                 pulls.append(time.perf_counter() - t0)
-                after = dict(worker.cache.client.stats) if worker else {}
-                fetch_counts.append(
-                    sum(after.values()) - sum(before.values()))
+                fetch_counts.append(cache_ops() - before)
             out["cold_start_native_pull"] = _percentiles(pulls)
             out["cold_start_native_pull_p50_s"] = out[
                 "cold_start_native_pull"]["p50"]
-            if worker and not any(c > 0 for c in fetch_counts):
+            if workers and not any(c > 0 for c in fetch_counts):
                 violations.append(
                     "coldstart_native: bundle deleted but zero cache "
                     "activity during re-pull — the pull did not happen")
@@ -756,8 +761,10 @@ def _run_phase(phase: str, quick: bool, cpu: bool) -> dict:
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", phase]
     if quick:
         cmd.append("--quick")
-    if cpu or phase == "coldstart":
+    if cpu or phase.startswith("coldstart"):
         # the serving stack and its runner children must never dial the chip
+        # — ALL cold-start stack phases, not just the original one (round-3
+        # advisor finding: coldstart_native/coldstart_jax ran unguarded)
         cmd.append("--cpu")
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
@@ -878,21 +885,32 @@ def _merge_validated(extra: dict, phase: str, result: dict,
     extra.update(result)
 
 
-def orchestrate(quick: bool, cpu: bool) -> dict:
-    extra: dict = {}
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
-    if not cpu and not _tpu_alive():
-        extra["tpu_probe"] = "accelerator backend did not initialize; " \
-                             "falling back to CPU"
-        cpu = True
 
-    # chip phases FIRST, while nothing else has touched the tunnel
+def _persist(name: str, obj: dict) -> None:
+    """Write evidence to a side file IN THE REPO — the driver's tail capture
+    truncated round 3's single output line mid-JSON and the headline was
+    lost (`BENCH_r03.json "parsed": null`). The final stdout line stays
+    compact; everything else lives here."""
+    try:
+        with open(os.path.join(REPO_DIR, name), "w") as f:
+            json.dump(obj, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+
+
+def _run_chip_phases(detail: dict, quick: bool, cpu: bool) -> bool:
+    """llm + llm_endpoint + kernels. Returns False if a TPU attempt errored
+    (caller may retry on CPU later); on a real-TPU success persists a
+    BENCH_TPU.json snapshot IMMEDIATELY so a flaky tunnel window is never
+    wasted (VERDICT r03 next-round #1b)."""
     llm = _run_phase("llm", quick, cpu)
     if "llm_error" in llm and not cpu:
-        # TPU init failed/hung — fall back to CPU so the metric exists
-        extra["llm_tpu_error"] = llm["llm_error"]
-        llm = _run_phase("llm", quick, True)
-    _merge_validated(extra, "llm", llm, (
+        detail["llm_tpu_error"] = llm["llm_error"]
+        return False
+    _merge_validated(detail, "llm", llm, (
         "raw_decode_tokens_per_sec", "engine_tokens_per_sec",
         "engine_tokens_per_sec_per_chip"))
 
@@ -900,35 +918,158 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
     # container dials the chip (unless the whole bench is CPU-forced, which
     # --cpu → TPU9_BENCH_CPU=1 propagates into the subprocess)
     lep = _run_phase("llm_endpoint", quick, cpu)
-    _merge_validated(extra, "llm_endpoint", lep, (
+    _merge_validated(detail, "llm_endpoint", lep, (
         "endpoint_tokens_per_sec", "endpoint_tokens_per_sec_per_chip"))
 
     kern = _run_phase("kernels", quick, cpu)
     if "kernels_error" in kern and not cpu:
-        extra["kernels_tpu_error"] = kern["kernels_error"]
+        detail["kernels_tpu_error"] = kern["kernels_error"]
         kern = _run_phase("kernels", quick, True)
+    # pop the shared validation keys BEFORE prefixing so _merge_validated
+    # sees them (round-3 advisor finding: 'valid' leaked as 'kernel_valid')
+    kern_viol = kern.pop("violations", [])
+    kern.pop("valid", None)
     kern = {f"kernel_{k}" if not k.startswith("kernel") else k: v
             for k, v in kern.items()}
-    kern["violations"] = kern.pop("kernel_violations", [])
-    _merge_validated(extra, "kernels", kern, ("kernel_flash_ms",
-                                              "kernel_paged_ms"))
+    kern["violations"] = kern_viol
+    _merge_validated(detail, "kernels", kern, ("kernel_flash_ms",
+                                               "kernel_paged_ms"))
 
-    cs = _run_phase("coldstart", quick, cpu)
-    _merge_validated(extra, "coldstart", cs, ("cold_start_p50_s",))
+    if not cpu and detail.get("on_tpu"):
+        snap = dict(detail)
+        snap.setdefault("captured_at", time.strftime("%Y-%m-%d %H:%M:%S"))
+        snap["captured_by"] = snap.get("captured_by", "bench.orchestrate")
+        _persist("BENCH_TPU.json", snap)
+    return True
 
-    csn = _run_phase("coldstart_native", quick, cpu)
-    _merge_validated(extra, "coldstart_native", csn,
-                     ("cold_start_native_p50_s",
-                      "cold_start_native_pull_p50_s"))
 
-    csj = _run_phase("coldstart_jax", quick, cpu)
-    _merge_validated(extra, "coldstart_jax", csj,
-                     ("cold_start_jax_restore_p50_s",))
+def orchestrate(quick: bool, cpu: bool) -> dict:
+    detail: dict = {}
 
-    v = extra.get("validation", {"violations": []})
+    tpu_up = (not cpu) and _tpu_alive()
+    if not cpu and not tpu_up:
+        detail["tpu_probe"] = ("accelerator backend did not initialize at "
+                               "start; re-probing between phases")
+
+    chip_done = False
+    tpu_attempts = 0          # a half-alive tunnel (probe ok, phase hangs)
+    MAX_TPU_ATTEMPTS = 2      # must not eat the whole bench budget
+
+    def try_tpu(probe_timeout: float) -> bool:
+        nonlocal chip_done, tpu_attempts
+        if chip_done or cpu or tpu_attempts >= MAX_TPU_ATTEMPTS:
+            return chip_done
+        if _tpu_alive(timeout_s=probe_timeout):
+            tpu_attempts += 1
+            chip_done = _run_chip_phases(detail, quick, cpu=False)
+        return chip_done
+
+    if tpu_up:
+        # chip phases FIRST, while nothing else has touched the tunnel
+        tpu_attempts += 1
+        chip_done = _run_chip_phases(detail, quick, cpu=False)
+
+    # cold-start phases are always forced-CPU; between them, keep probing
+    # for the chip so a tunnel that comes alive mid-run is still captured
+    for phase, keys in (
+            ("coldstart", ("cold_start_p50_s",)),
+            ("coldstart_native", ("cold_start_native_p50_s",
+                                  "cold_start_native_pull_p50_s")),
+            ("coldstart_jax", ("cold_start_jax_restore_p50_s",))):
+        try_tpu(probe_timeout=45)
+        res = _run_phase(phase, quick, cpu)
+        _merge_validated(detail, phase, res, keys)
+
+    if not chip_done:
+        # last chance on TPU (longer probe), else CPU so the metrics exist
+        try_tpu(probe_timeout=180)
+        if not chip_done:
+            _run_chip_phases(detail, quick, cpu=True)
+
+    v = detail.get("validation", {"violations": []})
     v["ok"] = not v["violations"]
-    extra["validation"] = v
-    return extra
+    detail["validation"] = v
+
+    # a mid-round opportunistic capture (scripts/tpu_opportunist.py) may
+    # have caught the chip during an alive-window this run missed; surface
+    # it CLEARLY LABELED as a snapshot — never promoted to this run's
+    # headline numbers
+    if not detail.get("on_tpu"):
+        snap_path = os.path.join(REPO_DIR, "BENCH_TPU.json")
+        if os.path.exists(snap_path):
+            try:
+                with open(snap_path) as f:
+                    snap = json.load(f)
+                if snap.get("on_tpu"):
+                    detail["tpu_snapshot_file"] = "BENCH_TPU.json"
+                    detail["tpu_snapshot_captured_at"] = snap.get(
+                        "captured_at", "")
+                    for k in ("engine_tokens_per_sec_per_chip",
+                              "endpoint_tokens_per_sec_per_chip"):
+                        if k in snap:
+                            detail[f"tpu_snapshot_{k}"] = snap[k]
+            except (OSError, ValueError):
+                pass
+    return detail
+
+
+# compact-extra keys lifted verbatim from the full detail (VERDICT r03
+# next-round #1a: the final line carries headline fields ONLY)
+_COMPACT_KEYS = (
+    "backend", "on_tpu", "device_kind", "model",
+    "engine_tokens_per_sec_per_chip", "engine_served_proof_ok",
+    "endpoint_tokens_per_sec_per_chip", "endpoint_served_proof_ok",
+    "endpoint_container_on_tpu",
+    "cold_start_p50_s", "cold_start_native_p50_s",
+    "cold_start_native_pull_p50_s", "cold_start_jax_restore_p50_s",
+    "kernel_flash_ms", "kernel_paged_ms",
+    "tpu_snapshot_file", "tpu_snapshot_captured_at",
+    "tpu_snapshot_engine_tokens_per_sec_per_chip",
+    "tpu_snapshot_endpoint_tokens_per_sec_per_chip",
+)
+
+
+def compact_line(detail: dict) -> dict:
+    """One SMALL JSON line for the driver: headline metric + a flat summary.
+    Full evidence (physics blocks, timelines, per-trial data) goes to
+    BENCH_DETAIL.json via _persist, never into stdout."""
+    extra: dict = {}
+    for k in _COMPACT_KEYS:
+        if k in detail:
+            extra[k] = detail[k]
+    for phys_key, short in (("engine_physics", "engine"),
+                            ("endpoint_physics", "endpoint")):
+        p = detail.get(phys_key)
+        if isinstance(p, dict):
+            extra[f"{short}_mbu"] = p.get("mbu")
+            extra[f"{short}_mfu"] = p.get("mfu")
+    v = detail.get("validation", {"violations": [], "ok": False})
+    extra["validation_ok"] = v.get("ok", False)
+    extra["violations_n"] = len(v.get("violations", []))
+    extra["detail_file"] = "BENCH_DETAIL.json"
+
+    tps = extra.get("endpoint_tokens_per_sec_per_chip")
+    if tps and extra.get("endpoint_container_on_tpu") \
+            and extra.get("endpoint_served_proof_ok"):
+        # the north-star config #2: llama3-8b int8 through @endpoint on the
+        # chip. No published reference number exists (BASELINE.json
+        # published:{}), so vs_baseline is the fraction of the chip's
+        # physics ceiling achieved (endpoint mbu) — honest and comparable.
+        return {"metric": "endpoint_tokens_per_sec_per_chip", "value": tps,
+                "unit": "tok/s/chip",
+                "vs_baseline": extra.get("endpoint_mbu") or 0.0,
+                "extra": extra}
+    if "cold_start_p50_s" in extra:
+        value = extra["cold_start_p50_s"]
+        return {"metric": "cold_start_p50_s", "value": value, "unit": "s",
+                "vs_baseline": round(1.0 / max(value, 1e-9), 3),
+                "extra": extra}
+    if "engine_tokens_per_sec_per_chip" in extra:
+        return {"metric": "engine_tokens_per_sec_per_chip",
+                "value": extra["engine_tokens_per_sec_per_chip"],
+                "unit": "tok/s/chip", "vs_baseline": 0.0, "extra": extra}
+    return {"metric": "bench_failed", "value": 0, "unit": "",
+            "vs_baseline": 0.0, "extra": extra}
 
 
 def main() -> None:
@@ -949,7 +1090,8 @@ def main() -> None:
         os.environ["TPU9_BENCH_CPU"] = "1"
         if args.phase != "llm_endpoint":   # that phase force_cpu()s itself
             from tpu9.utils import force_cpu
-            force_cpu(host_devices=8 if args.phase != "coldstart" else 0)
+            force_cpu(host_devices=0 if (args.phase or "")
+                      .startswith("coldstart") else 8)
 
     if args.phase:
         fn = {"llm": bench_llm, "llm_endpoint": bench_llm_endpoint,
@@ -966,24 +1108,12 @@ def main() -> None:
             sys.exit(1)
         return
 
-    extra = orchestrate(args.quick, args.cpu)
-
-    if "cold_start_p50_s" in extra:
-        value = extra["cold_start_p50_s"]
-        line = {"metric": "cold_start_p50_s", "value": value, "unit": "s",
-                "vs_baseline": round(1.0 / max(value, 1e-9), 3),
-                "extra": extra}
-    elif "engine_tokens_per_sec_per_chip" in extra:
-        line = {"metric": "engine_tokens_per_sec_per_chip",
-                "value": extra["engine_tokens_per_sec_per_chip"],
-                "unit": "tok/s/chip", "vs_baseline": 0.0, "extra": extra}
-    else:
-        line = {"metric": "bench_failed", "value": 0, "unit": "",
-                "vs_baseline": 0.0, "extra": extra}
-        print(json.dumps(line))
-        sys.exit(1)
-
+    detail = orchestrate(args.quick, args.cpu)
+    _persist("BENCH_DETAIL.json", detail)
+    line = compact_line(detail)
     print(json.dumps(line))
+    if line["metric"] == "bench_failed":
+        sys.exit(1)
 
 
 if __name__ == "__main__":

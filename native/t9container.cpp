@@ -11,10 +11,21 @@
 // Invocation (trusted worker only — arguments are not an end-user surface):
 //   t9container --rootfs DIR [--workdir DIR] [--hostname NAME]
 //               [--netns NAME] [--bind SRC:DST[:ro]]... [--env-file FILE]
-//               [--dev PATH]... -- ARGV...
+//               [--dev PATH]... [--uid N] [--gid N] [--no-seccomp]
+//               -- ARGV...
 //
 // env-file: NUL-separated KEY=VALUE entries (values may contain anything
 // but NUL). The child starts with a clean environment.
+//
+// Privilege containment (reference analogue: the hardened base OCI spec
+// pkg/runtime/base_runc_config.json + the gVisor fork runsc.go:52). After
+// all privileged setup (mounts, pivot_root) and BEFORE exec:
+//   1. no_new_privs — setuid/filecap binaries can never re-escalate
+//   2. capability drop — bounding set cleared of everything dangerous;
+//      with --uid != 0 the cred change additionally zeroes CapEff/CapPrm
+//   3. seccomp deny-list — mount/ptrace/kexec/bpf/module-load/... return
+//      EPERM (default on; --no-seccomp for debugging only)
+//   4. --uid/--gid — setgroups([]) + setgid + setuid to an unprivileged id
 
 #include <cerrno>
 #include <cstdio>
@@ -23,9 +34,15 @@
 #include <string>
 #include <vector>
 
+#include <cstddef>
 #include <fcntl.h>
+#include <grp.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/seccomp.h>
 #include <sched.h>
 #include <sys/mount.h>
+#include <sys/prctl.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
 #include <sys/types.h>
@@ -50,6 +67,9 @@ struct Opts {
   std::vector<std::string> devices;
   std::vector<char*> argv;
   std::vector<std::string> env;   // loaded BEFORE pivot_root hides the file
+  uid_t uid = 0;
+  gid_t gid = 0;
+  bool seccomp = true;
 };
 
 Opts parse(int argc, char** argv) {
@@ -67,6 +87,9 @@ Opts parse(int argc, char** argv) {
     else if (a == "--netns") o.netns = next();
     else if (a == "--env-file") o.env_file = next();
     else if (a == "--dev") o.devices.push_back(next());
+    else if (a == "--uid") o.uid = static_cast<uid_t>(atoi(next().c_str()));
+    else if (a == "--gid") o.gid = static_cast<gid_t>(atoi(next().c_str()));
+    else if (a == "--no-seccomp") o.seccomp = false;
     else if (a == "--bind") {
       std::string spec = next();
       Bind b;
@@ -141,6 +164,141 @@ void bind_mount(const std::string& src, const std::string& dst, bool ro) {
     die("bind remount ro");
 }
 
+// ---- privilege containment -------------------------------------------------
+
+// Capabilities kept in the bounding set when the workload stays uid 0
+// (t9proc supervisor mode needs kill/setuid/setgid to manage children;
+// everything host-threatening — sys_admin, sys_module, sys_ptrace,
+// sys_rawio, net_admin, mknod, sys_boot, syslog, ... — is dropped).
+// With --uid != 0 the setuid() additionally clears CapEff/CapPrm to 0.
+constexpr int kKeepCaps[] = {
+    0 /*chown*/, 1 /*dac_override*/, 3 /*fowner*/, 5 /*kill*/,
+    6 /*setgid*/, 7 /*setuid*/, 10 /*net_bind_service*/, 13 /*net_raw*/,
+};
+
+void drop_bounding_caps() {
+  for (int cap = 0; cap <= 63; cap++) {
+    bool keep = false;
+    for (int k : kKeepCaps) keep |= (cap == k);
+    if (keep) continue;
+    // past the kernel's last cap prctl returns EINVAL — done
+    if (prctl(PR_CAPBSET_DROP, cap, 0, 0, 0) != 0) {
+      if (errno == EINVAL) break;
+      die("capbset drop");
+    }
+  }
+  // no ambient caps survive into the workload
+  prctl(PR_CAP_AMBIENT, PR_CAP_AMBIENT_CLEAR_ALL, 0, 0, 0);
+}
+
+// Deny-list seccomp filter: syscalls that break out of (or subvert) the
+// sandbox return EPERM; everything else is allowed. A deny-list (not
+// allow-list) keeps arbitrary user Python working while removing the
+// kernel-attack/namespace-escape surface the reference blocks via gVisor.
+void install_seccomp() {
+  static const int kDenied[] = {
+      SYS_mount, SYS_umount2, SYS_pivot_root, SYS_chroot, SYS_swapon,
+      SYS_swapoff, SYS_reboot, SYS_kexec_load, SYS_kexec_file_load,
+      SYS_init_module, SYS_finit_module, SYS_delete_module, SYS_bpf,
+      SYS_ptrace, SYS_process_vm_readv, SYS_process_vm_writev,
+      SYS_perf_event_open, SYS_setns, SYS_mknod, SYS_mknodat,
+      SYS_open_by_handle_at, SYS_quotactl, SYS_acct, SYS_settimeofday,
+      SYS_clock_settime, SYS_clock_adjtime, SYS_adjtimex, SYS_sethostname,
+      SYS_setdomainname, SYS_add_key, SYS_request_key, SYS_keyctl,
+      SYS_userfaultfd, SYS_vhangup, SYS_nfsservctl,
+#ifdef SYS_iopl
+      SYS_iopl,
+#endif
+#ifdef SYS_ioperm
+      SYS_ioperm,
+#endif
+#ifdef SYS_lookup_dcookie
+      SYS_lookup_dcookie,
+#endif
+  };
+  constexpr size_t kN = sizeof(kDenied) / sizeof(kDenied[0]);
+
+#if defined(__x86_64__)
+  constexpr uint32_t kArch = AUDIT_ARCH_X86_64;
+#elif defined(__aarch64__)
+  constexpr uint32_t kArch = AUDIT_ARCH_AARCH64;
+#else
+#error "unsupported architecture for seccomp filter"
+#endif
+
+  std::vector<sock_filter> prog;
+  // wrong-arch callers are killed outright
+  prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                          offsetof(seccomp_data, arch)));
+  prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, kArch, 1, 0));
+  prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL_PROCESS));
+  prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                          offsetof(seccomp_data, nr)));
+#if defined(__x86_64__)
+  // x32-ABI syscalls report arch == AUDIT_ARCH_X86_64 with
+  // nr | 0x40000000 — they'd sail past every JEQ below and reopen
+  // mount/ptrace through the x32 entry points. Kill them (Docker's
+  // default profile does the same).
+  prog.push_back(BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, 0x40000000u, 0, 1));
+  prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL_PROCESS));
+#endif
+  // clone3 → ENOSYS so glibc falls back to clone (whose flags we can
+  // inspect; clone3 passes flags in memory where BPF cannot see them)
+#ifdef SYS_clone3
+  prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                          static_cast<uint32_t>(SYS_clone3), 0, 1));
+  prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                          SECCOMP_RET_ERRNO | (ENOSYS & SECCOMP_RET_DATA)));
+#endif
+  // clone with any namespace flag is an escape vector (CLONE_NEWUSER
+  // grants full caps in the child userns, then x32/mount games) — deny;
+  // plain thread/fork clones pass. flags is arg0 on x86_64 and aarch64.
+  constexpr uint32_t kNsFlags =
+      CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET | CLONE_NEWPID |
+      CLONE_NEWIPC | CLONE_NEWUTS | CLONE_NEWCGROUP;
+  prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                          static_cast<uint32_t>(SYS_clone), 0, 4));
+  prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                          offsetof(seccomp_data, args[0])));
+  prog.push_back(BPF_JUMP(BPF_JMP | BPF_JSET | BPF_K, kNsFlags, 0, 1));
+  prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                          SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA)));
+  prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                          offsetof(seccomp_data, nr)));   // restore A = nr
+  for (size_t i = 0; i < kN; i++) {
+    prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                            static_cast<uint32_t>(kDenied[i]), 0, 1));
+    prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                            SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA)));
+  }
+  // unshare with namespace flags is an escape vector; plain unshare(0) or
+  // CLONE_FILES-style uses are harmless but rare — deny it entirely (the
+  // reference's gVisor denies it too)
+  prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                          static_cast<uint32_t>(SYS_unshare), 0, 1));
+  prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                          SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA)));
+  prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW));
+
+  sock_fprog fprog = {static_cast<unsigned short>(prog.size()), prog.data()};
+  if (prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &fprog, 0, 0) != 0)
+    die("seccomp");
+}
+
+void contain_privileges(const Opts& o) {
+  // no_new_privs FIRST: required for unprivileged seccomp and guarantees
+  // setuid binaries in the image can never re-escalate
+  if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) die("no_new_privs");
+  drop_bounding_caps();
+  if (o.gid != 0 || o.uid != 0) {
+    if (setgroups(0, nullptr) != 0) die("setgroups");
+    if (setgid(o.gid) != 0) die("setgid");
+    if (setuid(o.uid) != 0) die("setuid");
+    // with no PR_SET_KEEPCAPS the uid transition zeroed CapEff/CapPrm
+  }
+  if (o.seccomp) install_seccomp();   // last: it would block the above
+}
+
 int child_main(void* arg) {
   Opts& o = *static_cast<Opts*>(arg);
 
@@ -210,6 +368,10 @@ int child_main(void* arg) {
   envp.reserve(o.env.size() + 1);
   for (auto& e : o.env) envp.push_back(e.data());
   envp.push_back(nullptr);
+
+  // all privileged setup is done — contain before handing over to the
+  // (untrusted) workload
+  contain_privileges(o);
 
   execvpe(o.argv[0], o.argv.data(), envp.data());
   die("execvpe");

@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Opportunistic TPU bench capture (VERDICT r03 next-round #1b).
+
+The TPU tunnel in this environment flaps: it can be dead for hours (a dead
+tunnel hangs ``jax.devices()`` indefinitely) and then come alive. This
+watcher probes cheaply in a loop; the moment a probe succeeds it runs the
+chip bench phases through bench.py's own orchestration helpers and persists
+``BENCH_TPU.json`` in-repo — so a mid-round alive-window is captured even if
+the tunnel is dead again by the time the driver runs ``bench.py``.
+
+Usage: python scripts/tpu_opportunist.py [--interval 300] [--once]
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300.0)
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    bench = load_bench()
+    attempt = 0
+    while True:
+        attempt += 1
+        alive = bench._tpu_alive(timeout_s=args.probe_timeout)
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        print(f"[{stamp}] probe {attempt}: tpu_alive={alive}", flush=True)
+        if alive:
+            detail: dict = {"captured_by": "tpu_opportunist",
+                            "captured_at": stamp}
+            ok = bench._run_chip_phases(detail, quick=args.quick, cpu=False)
+            v = detail.get("validation", {"violations": []})
+            v["ok"] = not v["violations"]
+            detail["validation"] = v
+            print(f"chip phases ok={ok} on_tpu={detail.get('on_tpu')} "
+                  f"violations={len(v['violations'])}", flush=True)
+            if ok and detail.get("on_tpu"):
+                bench._persist("BENCH_TPU.json", detail)
+                print(json.dumps(bench.compact_line(detail)), flush=True)
+                return 0
+            # chip answered the probe but the phase failed — keep trying
+        if args.once:
+            return 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Full gateway→scheduler→worker→NativeRuntime stack under real
+containment, asserting the privilege posture tenants actually get
+(VERDICT r03 #2 'Done' criteria: in-container uid != 0, mount fails,
+CapEff ≈ 0 — for the DEFAULT serving path, not a hand-built spec).
+
+Reference analogue: the hardened base OCI spec every reference container
+inherits (pkg/runtime/base_runc_config.json) and the gVisor syscall
+sandbox (pkg/runtime/runsc.go:52).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from tpu9.runtime import NativeRuntime
+
+pytestmark = [
+    pytest.mark.e2e,
+    pytest.mark.skipif(not NativeRuntime.supported(),
+                       reason="needs root + t9container + iproute2"),
+]
+
+PROBE_APP = """
+import os, subprocess
+
+def handler(**kwargs):
+    caps = ""
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith(("CapEff", "NoNewPrivs")):
+                caps += line
+    mount_rc = subprocess.run(
+        ["mount", "-t", "tmpfs", "none", "/tmp"],
+        capture_output=True).returncode
+    # the workspace must stay writable for the dropped identity
+    with open("probe.txt", "w") as f:
+        f.write("ok")
+    return {"uid": os.getuid(), "gid": os.getgid(), "status": caps,
+            "mount_rc": mount_rc}
+"""
+
+
+def test_default_endpoint_runs_unprivileged(monkeypatch):
+    monkeypatch.setenv("TPU9_RUNTIME", "native")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from tpu9.testing.localstack import LocalStack
+
+    async def run():
+        async with LocalStack() as stack:
+            dep = await stack.deploy_endpoint(
+                "priv-probe", {"app.py": PROBE_APP}, "app:handler")
+            return await stack.invoke(dep, {})
+
+    resp = asyncio.run(run())
+    assert resp["uid"] == 65534, resp
+    assert resp["gid"] == 65534, resp
+    assert "CapEff:\t0000000000000000" in resp["status"], resp
+    assert "NoNewPrivs:\t1" in resp["status"], resp
+    assert resp["mount_rc"] != 0, resp

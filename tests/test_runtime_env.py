@@ -1,0 +1,39 @@
+"""Control-plane env rewriting in NativeRuntime (no root needed).
+
+Regression for the round-3 advisor finding: the outbound reverse proxy must
+only be opened for worker-injected control-plane keys, never for
+tenant-supplied TPU9_* env — otherwise a tenant could tunnel out of its
+netns to arbitrary host-loopback ports (other tenants' port proxies,
+worker internals), bypassing gateway auth.
+"""
+
+from tpu9.runtime.native import _rewrite_cp_env
+
+
+def test_cp_keys_rewritten_and_proxied():
+    env = {"TPU9_GATEWAY_URL": "http://127.0.0.1:8311",
+           "TPU9_COORDINATOR_ADDR": "127.0.0.1:9411"}
+    ports = _rewrite_cp_env(
+        env, ["TPU9_GATEWAY_URL", "TPU9_COORDINATOR_ADDR"], "10.77.0.1")
+    assert env["TPU9_GATEWAY_URL"] == "http://10.77.0.1:8311"
+    assert env["TPU9_COORDINATOR_ADDR"] == "10.77.0.1:9411"
+    assert ports == {8311, 9411}
+
+
+def test_tenant_env_never_proxied():
+    # A tenant smuggling a loopback URL under any key — including TPU9_-
+    # prefixed ones it can legitimately set — gets no rewrite and no proxy.
+    env = {"TPU9_EVIL": "http://127.0.0.1:6379",
+           "TPU9_CHECKPOINT_ENABLED": "1",
+           "MY_SERVICE": "http://127.0.0.1:5000"}
+    ports = _rewrite_cp_env(
+        env, ["TPU9_GATEWAY_URL", "TPU9_COORDINATOR_ADDR"], "10.77.0.1")
+    assert ports == set()
+    assert env["TPU9_EVIL"] == "http://127.0.0.1:6379"
+    assert env["MY_SERVICE"] == "http://127.0.0.1:5000"
+
+
+def test_missing_cp_key_is_ignored():
+    env = {}
+    assert _rewrite_cp_env(env, ["TPU9_GATEWAY_URL"], "10.0.0.1") == set()
+    assert env == {}

@@ -41,6 +41,17 @@ class ContainerSpec:
     memory_mb: int = 0
     devices: list[str] = field(default_factory=list)   # e.g. /dev/accel0
     ports: dict[int, int] = field(default_factory=dict)  # container -> host
+    # env keys the WORKER injected that carry control-plane loopback URLs
+    # (gateway, gang coordinator). Only these may be rewritten to the veth
+    # host IP / get an outbound reverse proxy — user-supplied TPU9_* env
+    # must never open tunnels out of the netns (tenant isolation).
+    cp_env_keys: list[str] = field(default_factory=list)
+    # unprivileged identity the workload drops to after namespace/mount
+    # setup (0 = stay root; TPU containers need root to open /dev/accel*).
+    # Seccomp + capability-bounding drop + no_new_privs apply either way
+    # (reference analogue: base_runc_config.json's hardened spec + gVisor).
+    run_as_uid: int = 0
+    run_as_gid: int = 0
 
 
 @dataclass

@@ -46,6 +46,44 @@ _NATIVE_BIN = os.path.join(os.path.dirname(os.path.dirname(
 _SYSTEM_BINDS = ("/usr", "/bin", "/sbin", "/lib", "/lib64", "/etc", "/opt")
 
 
+def _rewrite_cp_env(env: dict, cp_env_keys, host_ip: str) -> set[int]:
+    """Rewrite control-plane loopback URLs to the veth host IP, returning
+    the loopback ports that need an outbound reverse proxy.
+
+    SECURITY: only worker-injected control-plane keys (spec.cp_env_keys) are
+    eligible — the rest of env is tenant-controlled, and a tenant setting
+    TPU9_X=http://127.0.0.1:<p> must NOT get a tunnel out of its netns to
+    host-loopback services (other tenants' port proxies, worker internals)."""
+    cp_ports: set[int] = set()
+    for key in cp_env_keys:
+        val = env.get(key)
+        if isinstance(val, str) and "127.0.0.1" in val:
+            env[key] = val.replace("127.0.0.1", host_ip)
+            cp_ports.update(int(p) for p in
+                            re.findall(r"127\.0\.0\.1:(\d+)", val))
+    return cp_ports
+
+
+def _chown_tree(path: str, uid: int, gid: int) -> None:
+    """Recursive chown that never follows symlinks (a tenant-supplied link
+    in a workspace must not redirect the chown onto host files). The top
+    directory is chowned LAST so its uid doubles as a completion marker —
+    re-starts of an already-handed-over tree (the common autoscale cycle)
+    return in one stat instead of re-walking model-weight-sized trees."""
+    try:
+        if os.lstat(path).st_uid == uid:
+            return
+    except OSError:
+        return
+    for root, dirs, files in os.walk(path):
+        for name in dirs + files:
+            try:
+                os.lchown(os.path.join(root, name), uid, gid)
+            except OSError:
+                continue
+    os.lchown(path, uid, gid)
+
+
 def _run(cmd: list[str]) -> None:
     import subprocess
     proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -288,13 +326,7 @@ class NativeRuntime(Runtime):
         # port a reverse proxy on host_ip forwards into 127.0.0.1 of the
         # host netns (outbound analogue of the inbound port proxy; the
         # reference's agent route-proxy plays the same role).
-        cp_ports: set[int] = set()
-        for key, val in list(env.items()):
-            if isinstance(val, str) and "127.0.0.1" in val and key.startswith(
-                    "TPU9_"):
-                env[key] = val.replace("127.0.0.1", host_ip)
-                cp_ports.update(int(p) for p in
-                                re.findall(r"127\.0\.0\.1:(\d+)", val))
+        cp_ports = _rewrite_cp_env(env, spec.cp_env_keys, host_ip)
         for port in sorted(cp_ports):
             try:
                 await self._proxy_port(spec.container_id, port,
@@ -311,6 +343,10 @@ class NativeRuntime(Runtime):
             # the lifecycle's workspace dir rides into the container at its
             # host path, read-write
             binds.append(f"{workdir}:{workdir}")
+        if spec.run_as_uid:
+            # the dropped identity can't read /root — point HOME (pip/HF/
+            # JAX caches all key off it) at the tenant's write surface
+            env["HOME"] = workdir if workdir not in ("", "/") else "/tmp"
         env_file = os.path.join(sandbox, ".t9env")
         with open(env_file, "wb") as f:
             for k, v in env.items():
@@ -320,6 +356,23 @@ class NativeRuntime(Runtime):
                "--hostname", spec.container_id[:32],
                "--netns", self._netns(spec.container_id),
                "--env-file", env_file]
+        if spec.run_as_uid or spec.run_as_gid:
+            cmd += ["--uid", str(spec.run_as_uid),
+                    "--gid", str(spec.run_as_gid)]
+            # the tenant's write surfaces — workspace workdir plus rw
+            # volume/disk mounts (all extracted/created by the root worker)
+            # are handed to the dropped identity; ro binds stay root-owned.
+            # In an executor: weight-sized trees must not stall the worker's
+            # event loop (heartbeats, other containers' proxies).
+            loop = asyncio.get_running_loop()
+            targets = [workdir] if workdir not in ("", "/") \
+                and os.path.isdir(workdir) else []
+            targets += [src for src, _dst, ro in spec.mounts
+                        if not ro and os.path.isdir(src)]
+            for target in targets:
+                await loop.run_in_executor(
+                    None, _chown_tree, target,
+                    spec.run_as_uid, spec.run_as_gid)
         for b in binds:
             cmd += ["--bind", b]
         for mount_src, mount_dst, ro in spec.mounts:

@@ -33,6 +33,9 @@ log = logging.getLogger("tpu9.worker")
 
 READINESS_TIMEOUT_S = 120.0
 
+# identity tenant serving containers drop to under NativeRuntime ("nobody")
+UNPRIVILEGED_UID = 65534
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -522,6 +525,19 @@ class ContainerLifecycle:
                 os.path.abspath(__file__))))
             env["PYTHONPATH"] = env["PYTHONPATH"] + os.pathsep + repo_root
 
+        # privilege drop (NativeRuntime only; 0 = stay root): tenant
+        # serving/queue/function containers run as an unprivileged uid.
+        # Root is kept where it's load-bearing: TPU containers must open
+        # /dev/accel* (root-owned device nodes), builds write image env
+        # trees, pod/sandbox/bot run arbitrary user entrypoints (the
+        # reference's gVisor runs those as sandboxed root too). Seccomp +
+        # capability-bounding drop + no_new_privs apply to ALL of them.
+        keep_root = (bool(devices)
+                     or request.stub_type in ("build", StubType.POD.value,
+                                              StubType.SANDBOX.value,
+                                              StubType.BOT.value))
+        run_as = 0 if keep_root else UNPRIVILEGED_UID
+
         spec_mounts = []
         for mount in request.mounts:
             if mount.kind == "volume":
@@ -548,6 +564,11 @@ class ContainerLifecycle:
             memory_mb=request.memory_mb,
             devices=devices,
             ports={port: port},
+            # only these keys may be loopback-rewritten/proxied by the
+            # native runtime — they are injected by the control plane
+            # (runner_env / gang env), never taken from tenant env
+            cp_env_keys=["TPU9_GATEWAY_URL", "TPU9_COORDINATOR_ADDR"],
+            run_as_uid=run_as, run_as_gid=run_as,
         )
 
     async def _wait_tcp(self, container_id: str, address: str,
