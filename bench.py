@@ -150,6 +150,14 @@ def bench_llm(quick: bool = False) -> dict:
 
     # --- raw decode windows through the ENGINE's compiled decode graph ----
     k = s["window_k"]
+    if getattr(engine, "paged", False):
+        # paged engine: slots need real physical blocks so the decode
+        # windows move production-shaped HBM traffic
+        engine.bench_reset_slots(
+            s["ctx0"], 3 * s["windows"] * s["window_k"] + max(
+                s["decode_steps"]))
+        out["kv_mode"] = (f"paged(block={engine.ecfg.kv_block_size}, "
+                          f"pool={engine.allocator.n_blocks})")
     dec = engine._decode_k(k)
     cache_len = jnp.full((s["batch"],), s["ctx0"], jnp.int32)
     last = jnp.ones((s["batch"], 1), jnp.int32)
@@ -574,14 +582,18 @@ def _phase_report() -> dict:
 
 
 def bench_cold_start_native(quick: bool = False) -> dict:
-    """VERDICT round-2 item #2: the REAL cold-start path — NativeRuntime
-    containers (netns + overlay + pivot_root) started from a chunked image
-    pulled through the content cache, not a bare ProcessRuntime echo.
+    """VERDICT round-2 item #2 + round-3 item #3: the REAL cold-start path —
+    NativeRuntime containers (netns + overlay + pivot_root) started from a
+    chunked image pulled through the content cache, not a bare
+    ProcessRuntime echo. The image is GB-scale (multi-file) so the lazy
+    path is what's actually measured: a cold pull must go ready on the
+    sparse skeleton while the bulk streams in the background.
 
-    Reports three tiers, each with phase-timeline evidence:
+    Reports, each with phase-timeline evidence:
     - warm-node: bundle already materialized (the common autoscale cycle)
-    - cold-pull: bundle deleted between trials, chunks re-fetched through
-      the cache (counters prove the pull happened)
+    - cold-pull: bundle deleted between trials; READY must precede full
+      materialization, an on-demand faulted read must return real bytes,
+      and cache counters prove chunks were re-fetched
     """
     import asyncio
     import shutil
@@ -592,25 +604,36 @@ def bench_cold_start_native(quick: bool = False) -> dict:
     os.environ["TPU9_RUNTIME"] = "native"
     from tpu9.testing.localstack import LocalStack
 
-    payload_mb = 4 if quick else 48
+    # payload = n_files × file_mb; 1 GiB full-run per VERDICT r03 #3
+    n_files, file_mb = (8, 4) if quick else (256, 4)
+    payload_mb = n_files * file_mb
     warm_trials = 3 if quick else 10
     pull_trials = 2 if quick else 5
 
-    app = ("import os\n"
-           "def handler(**kwargs):\n"
-           "    sz = os.path.getsize(os.environ['BLOB_PATH'])\n"
-           "    return {'blob_bytes': sz}\n")
+    app = ("import hashlib, os\n"
+           "def handler(op='', **kwargs):\n"
+           "    blob = os.environ['BLOB_PATH']\n"
+           "    if op == 'read':\n"
+           "        data = open(blob, 'rb').read()\n"
+           "        return {'sha': hashlib.sha256(data).hexdigest(),\n"
+           "                'n': len(data)}\n"
+           "    return {'blob_bytes': os.path.getsize(blob)}\n")
 
     async def run() -> dict:
-        out: dict = {"runtime": "native", "image_payload_mb": payload_mb}
+        out: dict = {"runtime": "native", "image_payload_mb": payload_mb,
+                     "image_files": n_files}
         violations: list[str] = []
         async with LocalStack() as stack:
+            # quick mode's payload is smaller — keep it above the lazy
+            # threshold either way (the lazy path IS the thing measured)
+            stack.cfg.cache.lazy_threshold_mb = 16 if quick else 64
             status, img = await stack.api("POST", "/rpc/image/build", json_body={
-                "commands": [f"mkdir -p env && head -c {payload_mb*1024*1024} "
-                             f"/dev/urandom > env/blob.bin"]})
+                "commands": [f"mkdir -p env && i=0; while [ $i -lt {n_files} ]"
+                             f"; do head -c {file_mb*1024*1024} /dev/urandom "
+                             f"> env/blob$i.bin; i=$((i+1)); done"]})
             assert status == 200, img
             image_id = img["image_id"]
-            for _ in range(600):
+            for _ in range(6000):
                 _, st = await stack.api("GET", f"/rpc/image/status/{image_id}")
                 if st["status"] in ("ready", "failed"):
                     break
@@ -620,7 +643,7 @@ def bench_cold_start_native(quick: bool = False) -> dict:
 
             bundle = os.path.join(stack.cfg.cache.data_dir, "bundles",
                                   image_id)
-            blob = os.path.join(bundle, "env", "blob.bin")
+            blob = os.path.join(bundle, "env", "blob3.bin")
             dep = await stack.deploy_endpoint(
                 "native-imaged", {"app.py": app}, "app:handler",
                 config_extra={
@@ -631,7 +654,7 @@ def bench_cold_start_native(quick: bool = False) -> dict:
             t0 = time.perf_counter()
             first = await stack.invoke(dep, {"n": 0})
             out["first_deploy_s"] = round(time.perf_counter() - t0, 4)
-            if first.get("blob_bytes") != payload_mb * 1024 * 1024:
+            if first.get("blob_bytes") != file_mb * 1024 * 1024:
                 violations.append(
                     f"coldstart_native: container did not see the image "
                     f"payload ({first})")
@@ -657,15 +680,30 @@ def bench_cold_start_native(quick: bool = False) -> dict:
                 return sum(sum(w.cache.client.stats.values())
                            for w in workers if getattr(w, "cache", None))
 
+            async def fill_of(img):
+                for w in workers:
+                    f = w.cache.puller._fills.get(img)
+                    if f is not None:
+                        return f
+                return None
+
             pulls = []
             fetch_counts = []
-            for _ in range(pull_trials):
+            ready_early = []      # ready BEFORE full materialization?
+            for trial in range(pull_trials):
                 await stack.scale_to_zero(dep)
+                # let any in-flight fill finish before invalidating, so the
+                # rmtree races nothing and each trial is a clean cold pull
+                f = await fill_of(image_id)
+                if f is not None:
+                    await asyncio.wait_for(f.wait(), 300)
                 shutil.rmtree(bundle, ignore_errors=True)
                 before = cache_ops()
                 t0 = time.perf_counter()
                 await stack.invoke(dep, {"n": 2})
                 pulls.append(time.perf_counter() - t0)
+                ready_early.append(not os.path.exists(
+                    os.path.join(bundle, ".tpu9-complete")))
                 fetch_counts.append(cache_ops() - before)
             out["cold_start_native_pull"] = _percentiles(pulls)
             out["cold_start_native_pull_p50_s"] = out[
@@ -675,6 +713,38 @@ def bench_cold_start_native(quick: bool = False) -> dict:
                     "coldstart_native: bundle deleted but zero cache "
                     "activity during re-pull — the pull did not happen")
             out["pull_cache_ops_per_trial"] = fetch_counts
+            # lazy-load proofs (VERDICT r03 #3): readiness must not wait for
+            # the whole image, and a gated on-demand read must return the
+            # real bytes, not placeholder zeros
+            out["pull_ready_before_complete"] = ready_early
+            # at GB scale the fill takes many seconds — ready MUST win the
+            # race; quick mode's small payload can legitimately fill first
+            if not quick and not all(ready_early):
+                violations.append(
+                    "coldstart_native: container.ready waited for full "
+                    "materialization — lazy path not in effect")
+            read = await stack.invoke(dep, {"op": "read"})
+            import hashlib
+            manifest = await stack._manifest_fetch(image_id)
+            entry = next(e for e in manifest.files
+                         if e.path == "env/blob3.bin")
+            want_chunks = []
+            for c in entry.chunks:
+                for w in workers:
+                    data = await w.cache.client.get(c)
+                    if data is not None:
+                        want_chunks.append(data)
+                        break
+            want = hashlib.sha256(b"".join(want_chunks)).hexdigest()
+            out["ondemand_read_sha_ok"] = read.get("sha") == want
+            if not out["ondemand_read_sha_ok"]:
+                violations.append(
+                    "coldstart_native: on-demand faulted read returned "
+                    "wrong bytes")
+            f = await fill_of(image_id)
+            if f is not None:
+                await asyncio.wait_for(f.wait(), 600)
+                out["lazy_fill_stats"] = dict(f.stats)
             out["phase_timeline"] = _phase_report()
         out["violations"] = violations
         out["valid"] = not violations

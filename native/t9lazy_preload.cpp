@@ -1,0 +1,238 @@
+// t9lazy_preload.so — LD_PRELOAD shim gating open() of lazily-materialized
+// image files on the worker's background filler.
+//
+// Reference analogue: the CLIP FUSE mount's page-fault path
+// (pkg/worker/image.go:274 PullLazy; pkg/cache/cachefs.go): the reference
+// blocks a read until the content is fetched from the distributed cache.
+// tpu9 gates at open() granularity instead of page granularity — the bundle
+// skeleton is stat-correct sparse files, so only the first open of a
+// not-yet-filled file pays a round-trip to the filler daemon, and once the
+// bundle's .tpu9-complete marker exists the shim is a single cached check.
+//
+// Contract (set by the worker on containers whose image is still filling):
+//   TPU9_LAZY_DIRS=/bundles/img-a:/bundles/img-b   (lazy bundle roots)
+//   TPU9_LAZY_SOCK=/bundles/.sock/img-a.sock       (fault socket)
+//   TPU9_LAZY_TIMEOUT_S=120                        (optional)
+//
+// Protocol: "REQ <abspath>\n" -> "OK\n" when the file's bytes are real.
+// Fallback: if the socket is unreachable the shim polls for the
+// .tpu9-complete marker until the timeout, then fails the open with EIO —
+// never silently reads placeholder zeros.
+
+#include <atomic>
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+using open_fn = int (*)(const char*, int, ...);
+using openat_fn = int (*)(int, const char*, int, ...);
+using fopen_fn = FILE* (*)(const char*, const char*);
+
+open_fn real_open = nullptr;
+open_fn real_open64 = nullptr;
+openat_fn real_openat = nullptr;
+openat_fn real_openat64 = nullptr;
+fopen_fn real_fopen = nullptr;
+fopen_fn real_fopen64 = nullptr;
+
+std::vector<std::string>* g_roots = nullptr;
+std::string* g_sock = nullptr;
+int g_timeout_s = 120;
+std::atomic<bool> g_all_complete{false};
+std::atomic<long> g_gated{0};
+std::once_flag g_init_flag;
+
+void init_impl() {
+  auto* roots = new std::vector<std::string>();
+  const char* raw = getenv("TPU9_LAZY_DIRS");
+  if (raw != nullptr) {
+    std::string spec(raw);
+    size_t start = 0;
+    while (start < spec.size()) {
+      size_t end = spec.find(':', start);
+      if (end == std::string::npos) end = spec.size();
+      if (end > start) roots->push_back(spec.substr(start, end - start));
+      start = end + 1;
+    }
+  }
+  const char* sock = getenv("TPU9_LAZY_SOCK");
+  g_sock = new std::string(sock != nullptr ? sock : "");
+  const char* to = getenv("TPU9_LAZY_TIMEOUT_S");
+  if (to != nullptr && atoi(to) > 0) g_timeout_s = atoi(to);
+  real_open = reinterpret_cast<open_fn>(dlsym(RTLD_NEXT, "open"));
+  real_open64 = reinterpret_cast<open_fn>(dlsym(RTLD_NEXT, "open64"));
+  real_openat = reinterpret_cast<openat_fn>(dlsym(RTLD_NEXT, "openat"));
+  real_openat64 = reinterpret_cast<openat_fn>(dlsym(RTLD_NEXT, "openat64"));
+  real_fopen = reinterpret_cast<fopen_fn>(dlsym(RTLD_NEXT, "fopen"));
+  real_fopen64 = reinterpret_cast<fopen_fn>(dlsym(RTLD_NEXT, "fopen64"));
+  g_roots = roots;   // publish last
+}
+
+void init_once() { std::call_once(g_init_flag, init_impl); }
+
+// root the path lives under, or nullptr
+const std::string* match_root(const char* path) {
+  if (path == nullptr || g_roots == nullptr || g_roots->empty())
+    return nullptr;
+  for (const auto& root : *g_roots) {
+    size_t n = root.size();
+    if (strncmp(path, root.c_str(), n) == 0 &&
+        (path[n] == '/' || path[n] == '\0'))
+      return &root;
+  }
+  return nullptr;
+}
+
+bool complete_marker(const std::string& root) {
+  struct stat st;
+  return ::stat((root + "/.tpu9-complete").c_str(), &st) == 0;
+}
+
+// Ask the filler daemon to make `path` real. Returns true when safe to
+// open. Blocks (bounded) — that IS the lazy-load semantic.
+bool fault_in(const std::string& root, const char* path) {
+  if (g_all_complete.load(std::memory_order_relaxed)) return true;
+  if (complete_marker(root)) {
+    g_all_complete.store(true, std::memory_order_relaxed);
+    return true;
+  }
+  g_gated.fetch_add(1, std::memory_order_relaxed);
+  struct timespec start;
+  clock_gettime(CLOCK_MONOTONIC, &start);
+  for (;;) {
+    if (!g_sock->empty()) {
+      int fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+      if (fd >= 0) {
+        struct sockaddr_un addr;
+        memset(&addr, 0, sizeof(addr));
+        addr.sun_family = AF_UNIX;
+        strncpy(addr.sun_path, g_sock->c_str(), sizeof(addr.sun_path) - 1);
+        if (::connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+                      sizeof(addr)) == 0) {
+          std::string req = std::string("REQ ") + path + "\n";
+          if (::write(fd, req.data(), req.size()) ==
+              static_cast<ssize_t>(req.size())) {
+            char buf[16];
+            ssize_t n = ::read(fd, buf, sizeof(buf) - 1);
+            ::close(fd);
+            if (n >= 2 && strncmp(buf, "OK", 2) == 0) return true;
+            return false;                    // daemon says unfetchable
+          }
+        }
+        ::close(fd);
+      }
+    }
+    // daemon unreachable (filling finished? worker restarting?) — the
+    // completion marker is the fallback truth
+    if (complete_marker(root)) {
+      g_all_complete.store(true, std::memory_order_relaxed);
+      return true;
+    }
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    if (now.tv_sec - start.tv_sec >= g_timeout_s) return false;
+    usleep(50 * 1000);
+  }
+}
+
+// Returns false when the open must fail with EIO (unfetchable lazy file).
+bool gate(const char* path) {
+  init_once();
+  const std::string* root = match_root(path);
+  if (root == nullptr) return true;
+  return fault_in(*root, path);
+}
+
+}  // namespace
+
+extern "C" {
+
+int open(const char* path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & O_CREAT) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  init_once();
+  if (!gate(path)) { errno = EIO; return -1; }
+  return real_open(path, flags, mode);
+}
+
+int open64(const char* path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & O_CREAT) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  init_once();
+  if (!gate(path)) { errno = EIO; return -1; }
+  return (real_open64 != nullptr ? real_open64 : real_open)(path, flags,
+                                                            mode);
+}
+
+int openat(int dirfd, const char* path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & O_CREAT) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  init_once();
+  // only absolute paths can match a bundle root; AT_FDCWD-relative opens
+  // of bundle files come through as absolute from CPython
+  if (path[0] == '/' && !gate(path)) {
+    errno = EIO;
+    return -1;
+  }
+  return real_openat(dirfd, path, flags, mode);
+}
+
+int openat64(int dirfd, const char* path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & O_CREAT) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  init_once();
+  if (path[0] == '/' && !gate(path)) {
+    errno = EIO;
+    return -1;
+  }
+  return (real_openat64 != nullptr ? real_openat64 : real_openat)(
+      dirfd, path, flags, mode);
+}
+
+FILE* fopen(const char* path, const char* mode) {
+  init_once();
+  if (!gate(path)) { errno = EIO; return nullptr; }
+  return real_fopen(path, mode);
+}
+
+FILE* fopen64(const char* path, const char* mode) {
+  init_once();
+  if (!gate(path)) { errno = EIO; return nullptr; }
+  return (real_fopen64 != nullptr ? real_fopen64 : real_fopen)(path, mode);
+}
+
+}  // extern "C"

@@ -58,3 +58,84 @@ def test_default_endpoint_runs_unprivileged(monkeypatch):
     assert "CapEff:\t0000000000000000" in resp["status"], resp
     assert "NoNewPrivs:\t1" in resp["status"], resp
     assert resp["mount_rc"] != 0, resp
+
+
+LAZY_APP = """
+import hashlib, os
+
+def handler(op="", **kwargs):
+    blob = os.environ["BLOB_PATH"]
+    if op == "read":
+        data = open(blob, "rb").read()
+        return {"sha": hashlib.sha256(data).hexdigest(), "n": len(data)}
+    return {"size": os.path.getsize(blob), "uid": os.getuid()}
+"""
+
+
+def test_lazy_image_under_native_containment(monkeypatch):
+    """Lazy-streamed image + netns + ro bundle bind + dropped uid all at
+    once: the shim's fault socket must be reachable from inside the netns
+    (fs socket over the rw .sock bind) and the gated read must return real
+    bytes."""
+    import hashlib
+    import shutil
+    shim = os.path.join(os.path.dirname(__file__), "..", "native", "build",
+                        "t9lazy_preload.so")
+    if not os.path.exists(shim):
+        pytest.skip("t9lazy_preload.so not built")
+    monkeypatch.setenv("TPU9_RUNTIME", "native")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from tpu9.testing.localstack import LocalStack
+
+    async def run():
+        async with LocalStack() as stack:
+            stack.cfg.cache.lazy_threshold_mb = 8
+            status, out = await stack.api(
+                "POST", "/rpc/image/build", json_body={
+                    "commands": ["mkdir -p env && for i in 1 2 3 4 5 6; do "
+                                 "head -c 2097152 /dev/urandom > env/f$i.bin;"
+                                 " done"]})
+            assert status == 200, out
+            image_id = out["image_id"]
+            for _ in range(600):
+                _, st = await stack.api("GET",
+                                        f"/rpc/image/status/{image_id}")
+                if st["status"] in ("ready", "failed"):
+                    break
+                await asyncio.sleep(0.1)
+            assert st["status"] == "ready", st
+            bundle = os.path.join(stack.cfg.cache.data_dir, "bundles",
+                                  image_id)
+            shutil.rmtree(bundle, ignore_errors=True)
+            blob = os.path.join(bundle, "env", "f2.bin")
+            dep = await stack.deploy_endpoint(
+                "lazy-native", {"app.py": LAZY_APP}, "app:handler",
+                config_extra={"runtime": {"image_id": image_id,
+                                          "cpu_millicores": 500,
+                                          "memory_mb": 512},
+                              "env": {"BLOB_PATH": blob}})
+            first = await stack.invoke(dep, {})
+            ready_early = not os.path.exists(
+                os.path.join(bundle, ".tpu9-complete"))
+            read = await stack.invoke(dep, {"op": "read"})
+            manifest = await stack._manifest_fetch(image_id)
+            entry = next(e for e in manifest.files
+                         if e.path == "env/f2.bin")
+            chunks = []
+            for c in entry.chunks:
+                for w in stack.workers:
+                    blob_data = await w.cache.client.get(c)
+                    if blob_data is not None:
+                        chunks.append(blob_data)
+                        break
+            want = hashlib.sha256(b"".join(chunks)).hexdigest()
+            fill = next((w.cache.puller._fills[image_id]
+                         for w in stack.workers
+                         if image_id in w.cache.puller._fills), None)
+            return first, read, want, ready_early, fill is not None
+
+    first, read, want, ready_early, lazy_used = asyncio.run(run())
+    assert first["size"] == 2097152
+    assert first["uid"] == 65534          # containment stacked on top
+    assert read["sha"] == want
+    assert lazy_used, "pull did not go through the lazy path"

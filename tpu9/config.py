@@ -93,6 +93,10 @@ class WorkerConfig:
     # path to the built vcache_preload.so; when set, containers with volume
     # mounts read volume files through the node cache (LD_PRELOAD shim)
     vcache_so: str = ""
+    # path to the built t9lazy_preload.so; when set, containers whose image
+    # is still streaming gate opens on the lazy-fill fault socket ("" =
+    # auto-discover next to vcache_so / the repo's native/build)
+    lazy_so: str = ""
     vcache_dir: str = "/tmp/tpu9/vcache"
     failover_max_pending: int = 10
     failover_max_scheduling_latency_ms: float = 5000.0
@@ -107,6 +111,9 @@ class CacheConfig:
     port: int = 0                   # 0 = auto
     replicas: int = 1               # HRW replication factor
     prefetch_window: int = 8
+    # images at/above this stream lazily (skeleton-ready + background fill);
+    # below it they materialize eagerly with hardlinks
+    lazy_threshold_mb: int = 64
 
 
 @dataclass

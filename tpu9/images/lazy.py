@@ -1,0 +1,291 @@
+"""Lazy image materialization — containers start while the image streams.
+
+Reference analogue: the CLIP lazy FUSE mount (``/root/reference/pkg/worker/
+image.go:274`` PullLazy + ``pkg/cache/cachefs.go:47``): the reference mounts
+a content-addressed archive and faults pages in from the distributed cache
+on demand, which is its core cold-start weapon (a multi-GB image must not
+gate ``container.ready``).
+
+tpu9's TPU-first redesign keeps the distributed chunk store but swaps the
+FUSE layer for *sparse-skeleton + open-gating*:
+
+1. **Skeleton** — the whole tree is created instantly: directories,
+   symlinks, and every regular file as a sparse placeholder truncated to
+   its final size with its final mode. ``stat``/``readdir``/``access`` are
+   correct from t=0 with zero bytes transferred.
+2. **Background filler** — an asyncio task streams chunks from the
+   CacheClient into the placeholders (manifest order), bounded-parallel,
+   segment-at-a-time so a multi-GB file never sits in RAM.
+3. **Open gating** — the ``t9lazy_preload.so`` LD_PRELOAD shim gates
+   ``open()`` of a not-yet-filled file on a UNIX-socket round-trip to this
+   filler, which *prioritizes* that file and replies when its bytes are
+   real. A file the workload never opens never blocks anything.
+4. **Completion marker** — when every file is filled, ``.tpu9-complete``
+   is written and the shim stops consulting the socket (one cached stat).
+
+Trade-off vs FUSE (documented, same stance as the vcache shim): processes
+that bypass libc's open family (static binaries, direct syscalls) can read
+placeholder zeros until the background fill completes — seconds, not
+correctness-forever; the serving runners are all dynamically-linked
+CPython. In exchange there is no kernel FUSE dependency, no userspace
+page-fault round-trip on the hot path after fill, and the materialized
+bundle is a plain directory eligible for hardlink warm starts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+from ..cache import CacheClient
+from .manifest import FileEntry, ImageManifest
+
+log = logging.getLogger("tpu9.images")
+
+# chunks fetched per write-segment of one file — bounds filler RSS at
+# roughly SEGMENT_CHUNKS * chunk_size (default 8 * 4 MiB = 32 MiB)
+SEGMENT_CHUNKS = 8
+
+LAZY_MARKER = ".tpu9-lazy"
+COMPLETE_MARKER = ".tpu9-complete"
+
+
+class LazyFill:
+    """One in-progress lazy materialization of a manifest into ``dest``."""
+
+    def __init__(self, manifest: ImageManifest, dest: str,
+                 cache: CacheClient, sock_path: str):
+        self.manifest = manifest
+        self.dest = dest
+        self.cache = cache
+        self.sock_path = sock_path
+        self._entries: dict[str, FileEntry] = {
+            e.path: e for e in manifest.files if not e.link_target}
+        self._done: dict[str, asyncio.Event] = {
+            p: asyncio.Event() for p in self._entries}
+        self._claimed: set[str] = set()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._task: Optional[asyncio.Task] = None
+        self.failed: list[str] = []
+        self.stats = {"files_total": len(self._entries), "files_filled": 0,
+                      "faults": 0, "bytes_streamed": 0}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self, write_skeleton: bool = True) -> None:
+        """Write the skeleton, open the fault socket, start the filler.
+        Returns as soon as the bundle is usable (stat-correct).
+        ``write_skeleton=False`` refills an existing tree in place (resume
+        after an abandoned fill while containers still reference it —
+        truncating live files would yank data out from under readers)."""
+        if write_skeleton:
+            await asyncio.to_thread(self._write_skeleton)
+        else:
+            await asyncio.to_thread(self._ensure_tree)
+        os.makedirs(os.path.dirname(self.sock_path), exist_ok=True)
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+        self._server = await asyncio.start_unix_server(
+            self._serve_fault, path=self.sock_path)
+        # any in-container uid (incl. dropped 65534) may fault files in
+        os.chmod(self.sock_path, 0o666)
+        self._task = asyncio.create_task(self._fill_all())
+
+    def _ensure_tree(self) -> None:
+        """Resume path: create only MISSING placeholders (never truncate an
+        existing file — it may be mid-read in a running container)."""
+        os.makedirs(self.dest, exist_ok=True)
+        for entry in self.manifest.files:
+            target = os.path.join(self.dest, entry.path)
+            if os.path.lexists(target):
+                continue
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            if entry.link_target:
+                try:
+                    os.symlink(entry.link_target, target)
+                except FileExistsError:
+                    pass
+                continue
+            with open(target, "wb") as f:
+                f.truncate(entry.size)
+            os.chmod(target, entry.mode & 0o777)
+        with open(os.path.join(self.dest, LAZY_MARKER), "w") as f:
+            f.write(self.manifest.manifest_hash)
+
+    def _write_skeleton(self) -> None:
+        os.makedirs(self.dest, exist_ok=True)
+        for entry in self.manifest.files:
+            target = os.path.join(self.dest, entry.path)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            if entry.link_target:
+                try:
+                    os.symlink(entry.link_target, target)
+                except FileExistsError:
+                    pass
+                continue
+            # sparse placeholder: final size + mode, zero bytes on disk
+            with open(target, "wb") as f:
+                f.truncate(entry.size)
+            os.chmod(target, entry.mode & 0o777)
+        import json
+        with open(os.path.join(self.dest, ".tpu9-env.json"), "w") as f:
+            json.dump({"env": self.manifest.env,
+                       "python_version": self.manifest.python_version,
+                       "kind": self.manifest.kind}, f)
+        with open(os.path.join(self.dest, LAZY_MARKER), "w") as f:
+            f.write(self.manifest.manifest_hash)
+
+    @property
+    def complete(self) -> bool:
+        return self.stats["files_filled"] >= self.stats["files_total"]
+
+    async def wait(self) -> None:
+        if self._task is not None:
+            await self._task
+
+    async def close(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+            self._server = None
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+
+    # -- filling -------------------------------------------------------------
+
+    async def ensure_file(self, rel: str) -> bool:
+        """Fault one file in NOW (jumps the background queue). Returns False
+        for paths outside the manifest (caller passes through)."""
+        entry = self._entries.get(rel)
+        if entry is None:
+            return False
+        ev = self._done[rel]
+        if ev.is_set():
+            return True
+        self.stats["faults"] += 1
+        if rel in self._claimed:           # background filler owns it
+            await ev.wait()
+            return True
+        self._claimed.add(rel)
+        try:
+            await self._fill_one(entry)
+        except (OSError, IOError):
+            self.failed.append(rel)
+            ev.set()                 # release _fill_all's completion wait
+            raise
+        return True
+
+    async def _fill_one(self, entry: FileEntry) -> None:
+        target = os.path.join(self.dest, entry.path)
+        offset = 0
+        for i in range(0, len(entry.chunks), SEGMENT_CHUNKS):
+            seg = entry.chunks[i:i + SEGMENT_CHUNKS]
+            fetched = await self.cache.get_many(seg)
+            datas = []
+            for d in seg:
+                blob = fetched.get(d)
+                if blob is None:
+                    raise IOError(f"missing chunk {d} for {entry.path}")
+                datas.append(blob)
+
+            def write(off: int, blobs: list) -> int:
+                # placeholder already has final size+mode; write in place
+                with open(target, "r+b") as f:
+                    f.seek(off)
+                    for b in blobs:
+                        f.write(b)
+                        off += len(b)
+                return off
+
+            offset = await asyncio.to_thread(write, offset, datas)
+            self.stats["bytes_streamed"] += sum(len(b) for b in datas)
+        self.stats["files_filled"] += 1
+        self._done[entry.path].set()
+
+    async def _fill_all(self) -> None:
+        for entry in self.manifest.files:
+            if entry.link_target:
+                continue
+            ev = self._done[entry.path]
+            if ev.is_set() or entry.path in self._claimed:
+                continue
+            self._claimed.add(entry.path)
+            try:
+                await self._fill_one(entry)
+            except (OSError, IOError) as exc:
+                # bundle deleted underneath us (operator invalidation) or
+                # chunk unavailable: record, release waiters, move on — a
+                # hung filler must never pin active_fill forever
+                log.warning("lazy fill %s failed: %s", entry.path, exc)
+                self.failed.append(entry.path)
+                ev.set()
+        # wait for fault-claimed stragglers, then publish completion —
+        # but ONLY on a fully successful fill; a partial bundle keeps its
+        # lazy marker so the next pull re-skeletons from scratch
+        for ev in self._done.values():
+            await ev.wait()
+        if not self.failed:
+            with open(os.path.join(self.dest, COMPLETE_MARKER), "w") as f:
+                f.write(self.manifest.manifest_hash)
+            try:
+                os.unlink(os.path.join(self.dest, LAZY_MARKER))
+            except OSError:
+                pass
+            log.info("lazy fill of %s complete: %d files, %.1f MB",
+                     self.dest, self.stats["files_filled"],
+                     self.stats["bytes_streamed"] / 1e6)
+        else:
+            log.warning("lazy fill of %s ABANDONED: %d/%d files failed",
+                        self.dest, len(self.failed),
+                        self.stats["files_total"])
+        if self._server is not None:
+            self._server.close()
+
+    # -- fault socket --------------------------------------------------------
+
+    async def _serve_fault(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        """Protocol: ``REQ <abspath>\\n`` → ``OK\\n`` once the file is real
+        (or immediately for paths we don't manage)."""
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                parts = line.decode(errors="replace").strip().split(" ", 1)
+                if len(parts) != 2 or parts[0] != "REQ":
+                    writer.write(b"ERR\n")
+                    await writer.drain()
+                    continue
+                path = os.path.normpath(parts[1])
+                rel = os.path.relpath(path, self.dest) \
+                    if path.startswith(self.dest + os.sep) else path
+                try:
+                    await self.ensure_file(rel)
+                    writer.write(b"OK\n")
+                except IOError as exc:
+                    log.warning("fault %s failed: %s", rel, exc)
+                    writer.write(b"ERR\n")
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass

@@ -17,33 +17,73 @@ import shutil
 from typing import Optional
 
 from ..cache import CacheClient
+from .lazy import LazyFill
 from .manifest import ImageManifest, materialize
 
 log = logging.getLogger("tpu9.images")
 
+# images at/above this size stream lazily by default: the container starts
+# on the sparse skeleton while chunks arrive (reference: PullLazy is the
+# default for ALL images, image.go:274; tpu9 keeps small images eager
+# because a one-shot hardlink materialization beats socket round-trips)
+LAZY_THRESHOLD_BYTES = 64 * 1024 * 1024
+
 
 class ImagePuller:
     def __init__(self, cache: CacheClient, bundles_dir: str,
-                 manifest_fetch=None):
+                 manifest_fetch=None,
+                 lazy_threshold: int = LAZY_THRESHOLD_BYTES):
         """``manifest_fetch(image_id) -> ImageManifest | None`` (async)."""
         self.cache = cache
         self.bundles_dir = bundles_dir
         self.manifest_fetch = manifest_fetch
+        self.lazy_threshold = lazy_threshold
         os.makedirs(bundles_dir, exist_ok=True)
         self._locks: dict[str, asyncio.Lock] = {}
         self._refs: dict[str, int] = {}
+        self._fills: dict[str, LazyFill] = {}
 
     def bundle_path(self, image_id: str) -> str:
         return os.path.join(self.bundles_dir, image_id)
 
+    def lazy_sock(self, image_id: str) -> str:
+        # sockets live OUTSIDE the (read-only-bound) bundle dir: connect(2)
+        # needs write permission on the socket inode, which an ro bind
+        # denies. One subdirectory PER IMAGE — the lifecycle binds exactly
+        # that subdir into containers, so a tenant can only reach its own
+        # image's fault socket (not every image on the node)
+        return os.path.join(self.bundles_dir, ".sock", image_id,
+                            "fill.sock")
+
+    def active_fill(self, image_id: str) -> Optional[LazyFill]:
+        """The in-progress lazy fill for this bundle, if any (the lifecycle
+        wires the open-gating shim into containers while one is active).
+        A fill whose task finished — successfully or abandoned after
+        failures — is not active; an abandoned one lets the next pull
+        re-skeleton from scratch."""
+        fill = self._fills.get(image_id)
+        if fill is None or fill.complete:
+            return None
+        if fill._task is not None and fill._task.done():
+            return None
+        return fill
+
     async def pull(self, image_id: str,
-                   manifest: Optional[ImageManifest] = None) -> str:
-        """Materialize (once) and return the bundle dir."""
+                   manifest: Optional[ImageManifest] = None,
+                   lazy: Optional[bool] = None) -> str:
+        """Materialize (once) and return the bundle dir. With ``lazy`` (the
+        default for large images) the bundle is usable on return — a
+        stat-correct sparse skeleton — while a background :class:`LazyFill`
+        streams content; callers gate opens via the shim + fault socket."""
         lock = self._locks.setdefault(image_id, asyncio.Lock())
         async with lock:
             dest = self.bundle_path(image_id)
             done_marker = os.path.join(dest, ".tpu9-complete")
             if os.path.exists(done_marker):
+                self._refs[image_id] = self._refs.get(image_id, 0) + 1
+                return dest
+            if self.active_fill(image_id) is not None:
+                # another container already started this lazy pull
                 self._refs[image_id] = self._refs.get(image_id, 0) + 1
                 return dest
             if manifest is None:
@@ -52,6 +92,37 @@ class ImagePuller:
                 manifest = await self.manifest_fetch(image_id)
                 if manifest is None:
                     raise IOError(f"image {image_id} not found")
+
+            if lazy is None:
+                # env-kind bundles only: their host paths are what the
+                # shim's TPU9_LAZY_DIRS match and what containers read.
+                # OCI rootfs trees become overlay LOWER dirs after
+                # pivot_root — streaming under a mounted overlay is
+                # undefined and the shim .so isn't in the rootfs.
+                lazy = (manifest.kind == "env"
+                        and manifest.total_bytes >= self.lazy_threshold)
+            if lazy:
+                # an interrupted previous fill leaves placeholders with no
+                # completion marker; restart the fill. Only rebuild the
+                # skeleton when NO running container references the bundle
+                # (rmtree/truncate under a live container's bind mount
+                # yanks files mid-read) — with live refs, refill in place:
+                # writes are idempotent content.
+                stale = self._fills.pop(image_id, None)
+                if stale is not None:
+                    await stale.close()
+                live_refs = self._refs.get(image_id, 0) > 0
+                if not live_refs:
+                    shutil.rmtree(dest, ignore_errors=True)
+                fill = LazyFill(manifest, dest, self.cache,
+                                self.lazy_sock(image_id))
+                await fill.start(write_skeleton=not live_refs)
+                self._fills[image_id] = fill
+                self._refs[image_id] = self._refs.get(image_id, 0) + 1
+                log.info("lazy pull %s: skeleton ready, %d files / %.1f MB "
+                         "streaming", image_id, len(manifest.files),
+                         manifest.total_bytes / 1e6)
+                return dest
 
             # prefetch every chunk into the local store (bounded parallel),
             # then materialize with hardlinks from the store
@@ -92,12 +163,19 @@ class ImagePuller:
         if image_id in self._refs:
             self._refs[image_id] -= 1
 
+    async def close(self) -> None:
+        for fill in list(self._fills.values()):
+            await fill.close()
+        self._fills.clear()
+
     async def gc(self, keep: int = 4) -> int:
         """Drop unreferenced bundles beyond ``keep`` most-recent."""
         entries = []
         for name in os.listdir(self.bundles_dir):
             p = self.bundle_path(name)
-            if self._refs.get(name, 0) > 0 or not os.path.isdir(p):
+            if (name.startswith(".") or self._refs.get(name, 0) > 0
+                    or not os.path.isdir(p)
+                    or self.active_fill(name) is not None):
                 continue
             entries.append((os.path.getmtime(p), name))
         entries.sort(reverse=True)

@@ -66,22 +66,24 @@ def _rewrite_cp_env(env: dict, cp_env_keys, host_ip: str) -> set[int]:
 
 def _chown_tree(path: str, uid: int, gid: int) -> None:
     """Recursive chown that never follows symlinks (a tenant-supplied link
-    in a workspace must not redirect the chown onto host files). The top
-    directory is chowned LAST so its uid doubles as a completion marker —
-    re-starts of an already-handed-over tree (the common autoscale cycle)
-    return in one stat instead of re-walking model-weight-sized trees."""
+    in a workspace must not redirect the chown onto host files). Walks the
+    whole tree every start — the root worker may have ADDED files (volume
+    sync) since the last handoff, so a top-dir completion marker would
+    strand them root-owned — but only dirties inodes whose owner actually
+    differs, so the warm-restart walk is pure metadata reads."""
     try:
-        if os.lstat(path).st_uid == uid:
-            return
+        if os.lstat(path).st_uid != uid:
+            os.lchown(path, uid, gid)
     except OSError:
         return
     for root, dirs, files in os.walk(path):
         for name in dirs + files:
+            p = os.path.join(root, name)
             try:
-                os.lchown(os.path.join(root, name), uid, gid)
+                if os.lstat(p).st_uid != uid:
+                    os.lchown(p, uid, gid)
             except OSError:
                 continue
-    os.lchown(path, uid, gid)
 
 
 def _run(cmd: list[str]) -> None:

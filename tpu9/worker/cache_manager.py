@@ -38,7 +38,9 @@ class WorkerCache:
         self.puller = ImagePuller(self.client,
                                   bundles_dir or os.path.join(cfg.data_dir,
                                                               "bundles"),
-                                  manifest_fetch=manifest_fetch)
+                                  manifest_fetch=manifest_fetch,
+                                  lazy_threshold=cfg.lazy_threshold_mb
+                                  * 1024 * 1024)
 
     async def _peers(self) -> list[str]:
         out = []
@@ -53,6 +55,7 @@ class WorkerCache:
         return self
 
     async def stop(self) -> None:
+        await self.puller.close()
         # client first: our outgoing peer connections close before the
         # server starts severing inbound ones
         await self.client.close()

@@ -74,6 +74,8 @@ class ContainerLifecycle:
         self.disk_attached = None
         # sandbox agent (set by the Worker): workdir snapshot restores
         self.sandboxes = None
+        # ImagePuller (set by the Worker): lazy-fill state for open gating
+        self.image_puller = None
         # CRIU manager (set by the Worker): CPU-process checkpoint/restore
         self.criu = None
         # container -> [(workspace_id, volume_name, local_dir)] to push back
@@ -338,12 +340,31 @@ class ContainerLifecycle:
 
     # ------------------------------------------------------------------
 
+    def _lazy_so_path(self) -> str:
+        return self.cfg.lazy_so or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "native", "build",
+            "t9lazy_preload.so")
+
     async def _prepare_image(self, request: ContainerRequest) -> str:
         """Resolve the image bundle for the request. v0: the host environment
         is the image when no image_id is set; the image system (lazy index +
         cache) plugs in through image_resolver."""
         if request.image_id and self.image_resolver:
-            return await self.image_resolver(request.image_id)
+            rootfs = await self.image_resolver(request.image_id)
+            puller = getattr(self, "image_puller", None)
+            if puller is not None and not os.path.exists(
+                    self._lazy_so_path()):
+                # no open-gating shim on this host → an ungated container
+                # would read placeholder zeros; fall back to waiting for
+                # the background fill (still better than eager: concurrent
+                # pulls of the same image share one stream)
+                fill = puller.active_fill(request.image_id)
+                if fill is not None:
+                    log.warning("t9lazy_preload.so not built; waiting for "
+                                "full fill of %s", request.image_id)
+                    await fill.wait()
+            return rootfs
         return ""
 
     async def _prepare_workspace(self, request: ContainerRequest) -> str:
@@ -496,6 +517,26 @@ class ContainerLifecycle:
             env["LD_PRELOAD"] = (self.cfg.vcache_so + ":"
                                  + env.get("LD_PRELOAD", "")).rstrip(":")
             env["TPU9_VCACHE_MAP"] = ":".join(pairs)
+        # lazy-image open gating: while this image's bundle is still
+        # streaming (puller.active_fill), containers gate open() on the
+        # fill's fault socket via t9lazy_preload.so — container.ready no
+        # longer waits for the whole tree (reference: PullLazy + CLIP FUSE,
+        # image.go:274; tpu9 gates opens instead of mounting FUSE)
+        lazy_sock_bind = ""
+        puller = getattr(self, "image_puller", None)
+        if request.image_id and puller is not None \
+                and puller.active_fill(request.image_id) is not None:
+            lazy_so = self._lazy_so_path()
+            if os.path.exists(lazy_so):
+                sock = puller.lazy_sock(request.image_id)
+                env["TPU9_LAZY_DIRS"] = puller.bundle_path(request.image_id)
+                env["TPU9_LAZY_SOCK"] = sock
+                env["LD_PRELOAD"] = (lazy_so + ":"
+                                     + env.get("LD_PRELOAD", "")).rstrip(":")
+                # the socket dir rides into namespaced containers rw —
+                # connect(2) needs write permission on the socket inode
+                lazy_sock_bind = os.path.dirname(sock)
+
         devices: list[str] = []
         if assignment is not None:
             env.update(assignment.env)
@@ -505,6 +546,21 @@ class ContainerLifecycle:
             env.setdefault("JAX_PLATFORMS", "cpu")
 
         entrypoint = list(request.entrypoint)
+        if not entrypoint and request.stub_type == StubType.SANDBOX.value:
+            # t9proc as PID 1 (reference: goproc bind-mounted as sandbox
+            # init, lifecycle.go:1299-1325): supervised spawn/stdin/kill
+            # through its unix socket on the rw workdir bind + zombie
+            # reaping. Fallback: plain idle loop (exec path still works).
+            t9proc = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))), "native", "build",
+                "t9proc")
+            if os.path.exists(t9proc) and workdir not in ("", "/"):
+                entrypoint = [t9proc, "--sock",
+                              os.path.join(workdir, ".t9proc.sock")]
+            else:
+                entrypoint = [sys.executable, "-c",
+                              "import time\nwhile True: time.sleep(3600)"]
         if not entrypoint:
             if env.get("TPU9_RUNNER") == "llm":
                 runner_mod = "tpu9.runner.llm"
@@ -539,6 +595,8 @@ class ContainerLifecycle:
         run_as = 0 if keep_root else UNPRIVILEGED_UID
 
         spec_mounts = []
+        if lazy_sock_bind:
+            spec_mounts.append((lazy_sock_bind, lazy_sock_bind, False))
         for mount in request.mounts:
             if mount.kind == "volume":
                 host_dir = self._safe_volume_dir(request.workspace_id,
