@@ -1,0 +1,132 @@
+"""Pre-warmed runner zygote (fork-server): fork-safety, env isolation, and
+the cold-start win it exists for (VERDICT r03 #4).
+
+Reference analogue: CRIU auto-checkpoint-after-ready
+(/root/reference/pkg/worker/criu.go:392) — the reference restores a warmed
+runner image instead of cold-booting; tpu9 forks from a warmed template.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from tpu9.runtime.zygote_client import ZygoteClient
+
+pytestmark = pytest.mark.e2e
+
+
+async def _pump_all(reader: asyncio.StreamReader) -> str:
+    out = []
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        out.append(line.decode())
+    return "".join(out)
+
+
+async def test_zygote_spawn_env_cwd_exit(tmp_path):
+    zy = ZygoteClient(str(tmp_path / "zy.sock"))
+    assert await zy.ensure_started()
+    try:
+        # a fake runner module on PYTHONPATH of the CHILD (not the zygote):
+        # proves sys.path mirroring happens post-fork
+        mod_dir = tmp_path / "mods"
+        mod_dir.mkdir()
+        (mod_dir / "fakerunner.py").write_text(
+            "import os, sys\n"
+            "print('env=' + os.environ.get('TPU9_MARK', ''))\n"
+            "print('cwd=' + os.getcwd())\n"
+            "sys.stderr.write('err-stream\\n')\n"
+            "sys.exit(7)\n")
+        wd = tmp_path / "wd"
+        wd.mkdir()
+        proc = await zy.spawn(
+            {"TPU9_MARK": "forked", "PYTHONPATH": str(mod_dir),
+             "PATH": os.environ.get("PATH", "")},
+            str(wd), "fakerunner")
+        assert proc.pid > 0
+        out, err, code = await asyncio.gather(
+            _pump_all(proc.stdout), _pump_all(proc.stderr), proc.wait())
+        assert "env=forked" in out
+        assert f"cwd={wd}" in out
+        assert "err-stream" in err
+        assert code == 7
+    finally:
+        await zy.stop()
+
+
+async def test_zygote_children_are_isolated(tmp_path):
+    """Two forks must not share env mutations or module globals."""
+    zy = ZygoteClient(str(tmp_path / "zy.sock"))
+    assert await zy.ensure_started()
+    try:
+        mod_dir = tmp_path / "mods"
+        mod_dir.mkdir()
+        (mod_dir / "mutator.py").write_text(
+            "import os\n"
+            "import tpu9.runner.common as c\n"
+            "prev = getattr(c, 'ZYGOTE_TAINT', None)\n"
+            "c.ZYGOTE_TAINT = os.environ['WHO']\n"
+            "print(f\"who={os.environ['WHO']} prev={prev}\")\n")
+        env = {"PYTHONPATH": str(mod_dir), "PATH": os.environ.get("PATH", "")}
+        p1 = await zy.spawn({**env, "WHO": "a"}, str(tmp_path), "mutator")
+        out1, _ = await asyncio.gather(_pump_all(p1.stdout), p1.wait())
+        p2 = await zy.spawn({**env, "WHO": "b"}, str(tmp_path), "mutator")
+        out2, _ = await asyncio.gather(_pump_all(p2.stdout), p2.wait())
+        assert "who=a prev=None" in out1
+        # fork isolation: child b must NOT see child a's module mutation
+        assert "who=b prev=None" in out2
+    finally:
+        await zy.stop()
+
+
+async def test_zygote_child_runs_jax(tmp_path):
+    """The whole point: a forked child must be able to init its own CPU
+    backend and jit — with the imports already paid."""
+    zy = ZygoteClient(str(tmp_path / "zy.sock"))
+    assert await zy.ensure_started()
+    try:
+        mod_dir = tmp_path / "mods"
+        mod_dir.mkdir()
+        (mod_dir / "jaxer.py").write_text(
+            "import time\n"
+            "t0 = time.perf_counter()\n"
+            "import jax, jax.numpy as jnp\n"
+            "y = float(jax.jit(lambda x: (x @ x).sum())(jnp.ones((32, 32))))\n"
+            "print(f'y={y} import_and_jit={time.perf_counter()-t0:.3f}')\n")
+        proc = await zy.spawn(
+            {"PYTHONPATH": str(mod_dir), "PATH": os.environ.get("PATH", ""),
+             "JAX_PLATFORMS": "cpu"},
+            str(tmp_path), "jaxer")
+        out, code = await asyncio.gather(_pump_all(proc.stdout), proc.wait())
+        assert code == 0, out
+        assert "y=32768.0" in out
+    finally:
+        await zy.stop()
+
+
+async def test_zygote_kill_and_fallback(tmp_path):
+    """A zygote that dies mid-flight must not wedge the runtime: spawn
+    raises, ProcessRuntime falls back to exec."""
+    import sys
+
+    from tpu9.runtime.base import ContainerSpec
+    from tpu9.runtime.process import ProcessRuntime
+
+    rt = ProcessRuntime(base_dir=str(tmp_path))
+    # break the zygote deliberately
+    rt._zygote._broken = True
+    spec = ContainerSpec(
+        container_id="zy-fb",
+        entrypoint=[sys.executable, "-m", "tpu9.runner.function"],
+        env={"TPU9_HANDLER": "", "PATH": os.environ.get("PATH", ""),
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.dirname(os.path.abspath(__file__))))})
+    # function runner with empty handler exits fast — exec fallback path
+    handle = await rt.run(spec)
+    assert handle.pid > 0
+    code = await asyncio.wait_for(rt.wait("zy-fb"), 60)
+    assert code != 0        # empty handler is an error, but it RAN
+    await rt.cleanup("zy-fb")

@@ -1,0 +1,178 @@
+"""Worker-side client for the runner zygote (pre-warmed fork-server).
+
+The ProcessRuntime uses this to start ``tpu9.runner.*`` containers as forks
+of a process that already paid the jax/numpy/aiohttp imports — the JAX
+cold-start tail (VERDICT r03 #4; reference analogue: CRIU
+auto-checkpoint-after-ready, ``pkg/worker/criu.go:392``). One zygote per
+runtime; the first container pays the zygote's own boot, every later one
+forks in milliseconds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import socket
+import sys
+from typing import Optional
+
+log = logging.getLogger("tpu9.worker")
+
+
+class ZygoteProc:
+    """Duck-type of ``asyncio.subprocess.Process`` for zygote children —
+    the ProcessRuntime's pump/reap/kill paths work unchanged."""
+
+    def __init__(self, pid: int, exit_fut: "asyncio.Future[int]",
+                 stdout: asyncio.StreamReader,
+                 stderr: asyncio.StreamReader):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self._exit_fut = exit_fut
+        self.stdout = stdout
+        self.stderr = stderr
+
+    async def wait(self) -> int:
+        self.returncode = await asyncio.shield(self._exit_fut)
+        return self.returncode
+
+
+class ZygoteClient:
+    def __init__(self, sock_path: str):
+        self.sock_path = sock_path
+        self._proc: Optional[asyncio.subprocess.Process] = None
+        self._lock = asyncio.Lock()
+        self._broken = False
+
+    @property
+    def available(self) -> bool:
+        return not self._broken
+
+    async def ensure_started(self, timeout_s: float = 90.0) -> bool:
+        async with self._lock:
+            if self._proc is not None and self._proc.returncode is None:
+                return True
+            if self._broken:
+                return False
+            os.makedirs(os.path.dirname(self.sock_path), exist_ok=True)
+            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            env = {k: v for k in ("PATH", "HOME", "LANG")
+                   if (v := os.environ.get(k)) is not None}
+            env["PYTHONPATH"] = repo_root
+            env["PYTHONUNBUFFERED"] = "1"
+            # the zygote itself must never dial an accelerator; children
+            # re-pin jax.config from their own env post-fork
+            env["JAX_PLATFORMS"] = "cpu"
+            try:
+                self._proc = await asyncio.create_subprocess_exec(
+                    sys.executable, "-m", "tpu9.runner.zygote",
+                    "--sock", self.sock_path, env=env,
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.DEVNULL,
+                    preexec_fn=os.setsid)
+                line = await asyncio.wait_for(
+                    self._proc.stdout.readline(), timeout_s)
+                if b"ready" not in line:
+                    raise RuntimeError(f"zygote said {line!r}")
+            except (OSError, RuntimeError, asyncio.TimeoutError) as exc:
+                log.warning("zygote unavailable (%s); falling back to "
+                            "subprocess starts", exc)
+                self._broken = True
+                if self._proc is not None:
+                    try:
+                        self._proc.kill()
+                    except ProcessLookupError:
+                        pass
+                    self._proc = None
+                return False
+            log.info("zygote warm at %s (pid %d)", self.sock_path,
+                     self._proc.pid)
+            return True
+
+    async def spawn(self, env: dict, cwd: str, module: str,
+                    argv: Optional[list] = None) -> ZygoteProc:
+        """Fork a runner child; returns a Process-like handle whose
+        stdout/stderr are live pipes."""
+        stdout_r, stdout_w = os.pipe()
+        stderr_r, stderr_w = os.pipe()
+        try:
+            # SCM_RIGHTS needs a raw socket (asyncio's TransportSocket hides
+            # sendmsg): connect + send_fds blocking in a thread, then hand
+            # the connected socket to asyncio for the reply stream
+            payload = json.dumps({"env": env, "cwd": cwd, "module": module,
+                                  "argv": argv or []}).encode() + b"\n"
+
+            def handshake() -> socket.socket:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    s.settimeout(30.0)
+                    s.connect(self.sock_path)
+                    socket.send_fds(s, [payload], [stdout_w, stderr_w])
+                    s.settimeout(None)
+                except OSError:
+                    s.close()
+                    raise
+                return s
+
+            s = await asyncio.to_thread(handshake)
+            reader, writer = await asyncio.open_unix_connection(sock=s)
+            line = await asyncio.wait_for(reader.readline(), 30.0)
+            pid = json.loads(line)["pid"]
+        except (OSError, ValueError, KeyError, asyncio.TimeoutError):
+            for fd in (stdout_r, stderr_r):
+                os.close(fd)
+            raise
+        finally:
+            os.close(stdout_w)
+            os.close(stderr_w)
+
+        loop = asyncio.get_running_loop()
+        exit_fut: "asyncio.Future[int]" = loop.create_future()
+
+        async def watch_exit() -> None:
+            code = 1
+            try:
+                line = await reader.readline()
+                if line:
+                    code = int(json.loads(line).get("exit", 1))
+            except (OSError, ValueError):
+                pass
+            finally:
+                writer.close()
+            if not exit_fut.done():
+                exit_fut.set_result(code)
+
+        watch_task = loop.create_task(watch_exit())
+
+        async def stream_of(fd: int) -> asyncio.StreamReader:
+            r = asyncio.StreamReader()
+            await loop.connect_read_pipe(
+                lambda: asyncio.StreamReaderProtocol(r),
+                os.fdopen(fd, "rb", buffering=0))
+            return r
+
+        proc = ZygoteProc(pid, exit_fut, await stream_of(stdout_r),
+                          await stream_of(stderr_r))
+        # strong ref: the loop holds tasks weakly and a GC'd watcher would
+        # leave exit_fut forever pending (container appears immortal)
+        proc._watch_task = watch_task
+        return proc
+
+    async def stop(self) -> None:
+        if self._proc is not None and self._proc.returncode is None:
+            try:
+                os.killpg(os.getpgid(self._proc.pid), 9)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                await self._proc.wait()
+            except Exception:       # noqa: BLE001
+                pass
+        self._proc = None
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass

@@ -82,6 +82,8 @@ class Worker:
             volume_sync=volume_sync,
             checkpoints=checkpoints, phase_cb=phase_cb)
         self.lifecycle.volume_push = volume_push
+        if cache is not None:
+            self.lifecycle.image_puller = cache.puller
         self.disks = disks              # Optional[DiskManager]
         self.lifecycle.disks = disks
         self.lifecycle.disk_attached = self._note_disk_attached
@@ -173,6 +175,9 @@ class Worker:
         await asyncio.gather(*self._tasks, return_exceptions=True)
         if getattr(self, "_relay", None) is not None:
             await self._relay.stop()
+        zygote = getattr(self.runtime, "_zygote", None)
+        if zygote is not None:
+            await zygote.stop()
         if self.cache is not None:
             await self.cache.stop()
         try:
