@@ -1,0 +1,306 @@
+"""Paged-KV serving engine (VERDICT r03 #5): block-table allocator,
+chunked prefill, engine-level prefix reuse.
+
+Reference analogue: the KV accounting the reference's LLM router assumes
+(pkg/abstractions/pod/llm.go:124 token pressure, :211 prefix affinity) —
+here the engine actually implements the mechanics behind those signals.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu9.models import init_decoder
+from tpu9.models.llama import LLAMA_PRESETS
+from tpu9.serving.engine import EngineConfig, InferenceEngine
+from tpu9.serving.paged_kv import BlockAllocator, PrefixCache, blocks_for
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LLAMA_PRESETS["llama-tiny"]
+    return cfg, init_decoder(jax.random.PRNGKey(0), cfg)
+
+
+def _engine(tiny, **kw):
+    cfg, params = tiny
+    base = dict(max_batch=2, max_seq_len=256, prefill_buckets=(32, 64),
+                decode_steps=(1, 4), kv_block_size=32, kv_pool_blocks=16,
+                prefill_chunk=32)
+    base.update(kw)
+    return InferenceEngine(params, cfg, EngineConfig(**base))
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+def test_allocator_alloc_free_refcounts():
+    a = BlockAllocator(8, 32)
+    got = a.alloc(3)
+    assert len(got) == 3 and a.used_count == 3
+    a.retain(got[:2])                     # shared by a second holder
+    a.release(got)
+    assert a.used_count == 2              # two blocks still held
+    a.release(got[:2])
+    assert a.used_count == 0 and a.free_count == 8
+    assert a.alloc(9) is None             # over capacity → refused, not torn
+
+
+def test_allocator_reservations():
+    a = BlockAllocator(8, 32)
+    assert a.can_reserve(8 * 32)
+    n = a.reserve(8 * 32)
+    assert not a.can_reserve(1)
+    a.unreserve(n)
+    assert a.can_reserve(32)
+    assert blocks_for(33, 32) == 2 and blocks_for(32, 32) == 1
+
+
+def test_prefix_cache_longest_match_and_eviction():
+    a = BlockAllocator(16, 4)
+    pc = PrefixCache(a, max_blocks=3)
+    blocks = a.alloc(3)
+    prompt = list(range(12))              # 3 full blocks of 4
+    pc.insert(prompt, blocks)
+    assert pc.held_blocks == 3
+    hit = pc.lookup(prompt + [99])
+    assert hit is not None and hit.n_tokens == 12
+    # a diverging prompt must not match
+    assert pc.lookup([7] + prompt) is None
+    a.release(blocks)                     # slot retires; cache refs remain
+    assert a.used_count == pc.held_blocks
+
+    # an entry alone bigger than the budget is refused, not flip-flopped
+    big = a.alloc(4)
+    pc.insert(list(range(16)), big)
+    assert pc.held_blocks == 3
+    a.release(big)
+
+    # LRU: inserting another entry evicts the older one past the budget
+    b2 = a.alloc(2)
+    pc.insert(list(range(50, 58)), b2)    # 2 blocks
+    assert pc.held_blocks <= 3
+    a.release(b2)
+
+
+# ---------------------------------------------------------------------------
+# engine behavior
+# ---------------------------------------------------------------------------
+
+def test_paged_matches_dense_greedy(tiny):
+    cfg, params = tiny
+    dense = InferenceEngine(params, cfg, EngineConfig(
+        max_batch=2, max_seq_len=256, prefill_buckets=(32, 64),
+        decode_steps=(1, 4)))
+    paged = _engine(tiny, prefix_cache_blocks=4)
+
+    async def run(engine):
+        await engine.start()
+        a = await engine.generate([3, 1, 4, 1, 5, 9, 2, 6],
+                                  max_new_tokens=8)
+        b = await engine.generate(list(range(2, 40)), max_new_tokens=6)
+        await engine.stop()
+        return a, b
+
+    assert _run(run(dense)) == _run(run(paged))
+
+
+def test_long_prompt_without_full_length_bucket(tiny):
+    """A prompt LONGER than every prefill bucket must serve via chunked
+    prefill — the dense engine rejects it, the paged one chunks it."""
+    cfg, params = tiny
+    prompt = [(i * 7) % 250 + 1 for i in range(150)]   # > max bucket 64
+
+    dense = InferenceEngine(params, cfg, EngineConfig(
+        max_batch=2, max_seq_len=256, prefill_buckets=(32, 64),
+        decode_steps=(1, 4)))
+    with pytest.raises(ValueError):
+        _run(dense.generate(prompt, max_new_tokens=4))
+
+    paged = _engine(tiny)
+
+    async def run():
+        await paged.start()
+        out = await paged.generate(prompt, max_new_tokens=6)
+        await paged.stop()
+        return out
+
+    out = _run(run())
+    assert len(out) == 6
+    # correctness oracle: the full-context forward's argmax continuation
+    from tpu9.models.transformer import decoder_forward
+    toks = jnp.asarray([prompt], jnp.int32)
+    logits = decoder_forward(params, toks, cfg)
+    assert out[0] == int(jnp.argmax(logits[0, len(prompt) - 1]))
+
+
+def test_kv_memory_scales_with_live_tokens(tiny):
+    """The VERDICT 'Done' criterion: allocated blocks track live tokens,
+    not max_batch × max_seq."""
+    paged = _engine(tiny, kv_pool_blocks=16)
+    base = paged.allocator.used_count          # trash block only
+    assert base == 1
+
+    async def run():
+        await paged.start()
+        gen = await paged.generate(list(range(1, 33)),  # 32 = 1 block
+                                   max_new_tokens=4)
+        # DURING decode the slot held ceil((32+4+~k)/32) ≈ 2 blocks —
+        # far below the dense equivalent (256/32 = 8 per slot)
+        await paged.stop()
+        return gen
+
+    _run(run())
+    # after retirement everything is back (no prefix cache configured)
+    assert paged.allocator.used_count == base
+    assert paged.allocator.reserved == 0
+
+
+def test_admission_queues_when_pool_full(tiny):
+    """Pool smaller than two worst-case requests: the second must wait in
+    _wait_room (not crash mid-decode), then complete after the first
+    retires."""
+    paged = _engine(tiny, kv_pool_blocks=3, max_batch=2)
+
+    async def run():
+        await paged.start()
+        a, b = await asyncio.gather(
+            paged.generate(list(range(1, 30)), max_new_tokens=16),
+            paged.generate(list(range(40, 70)), max_new_tokens=16))
+        await paged.stop()
+        return a, b
+
+    a, b = _run(run())
+    assert len(a) == 16 and len(b) == 16
+
+
+def test_oversized_request_fails_loudly(tiny):
+    paged = _engine(tiny, kv_pool_blocks=2)
+
+    async def run():
+        await paged.start()
+        try:
+            with pytest.raises(ValueError, match="KV pool capacity"):
+                await asyncio.wait_for(
+                    paged.generate(list(range(1, 100)),
+                                   max_new_tokens=100), 30)
+        finally:
+            await paged.stop()
+
+    _run(run())
+
+
+def test_prefix_reuse_hits_and_is_correct(tiny):
+    """Second request sharing a 128-token prefix must reuse cached blocks
+    (hit recorded, fewer chunk prefills) and produce the same output as a
+    cold engine."""
+    prefix = [(i * 5) % 200 + 1 for i in range(128)]
+    tail_a = [7, 7, 7]
+    tail_b = [9, 9, 9]
+
+    cold = _engine(tiny, prefix_cache_blocks=0)
+    warm = _engine(tiny, prefix_cache_blocks=8)
+
+    async def run(engine):
+        await engine.start()
+        a = await engine.generate(prefix + tail_a, max_new_tokens=5)
+        b = await engine.generate(prefix + tail_b, max_new_tokens=5)
+        await engine.stop()
+        return a, b
+
+    cold_out = _run(run(cold))
+    warm_out = _run(run(warm))
+    assert cold_out == warm_out
+    st = warm.prefix_cache.stats()
+    assert st["hits"] >= 1
+    assert st["tokens_reused"] >= 96      # ≥ 3 full blocks of the prefix
+
+
+def test_prefix_reuse_is_faster(tiny):
+    """The measured warm-prefix latency win the VERDICT asks for: admission
+    with a cached 192-token prefix must beat cold admission (it skips
+    most chunk-prefill compute)."""
+    import time
+    prefix = [(i * 11) % 199 + 1 for i in range(192)]
+    warm = _engine(tiny, prefix_cache_blocks=8, max_seq_len=256)
+
+    async def run():
+        await warm.start()
+        t0 = time.perf_counter()
+        await warm.generate(prefix + [5], max_new_tokens=2)
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        await warm.generate(prefix + [8], max_new_tokens=2)
+        warm_s = time.perf_counter() - t0
+        await warm.stop()
+        return cold_s, warm_s
+
+    cold_s, warm_s = _run(run())
+    assert warm.prefix_cache.stats()["hits"] >= 1
+    # compile costs are shared (same graphs), so the warm pass should
+    # clearly win; generous factor keeps CI noise out
+    assert warm_s < cold_s, (cold_s, warm_s)
+
+
+def test_chunk_smaller_than_block_rejected(tiny):
+    """Review regression: prefill_chunk < kv_block_size would make the
+    splice a silent no-op (nb == 0) and decode against zero prompt KV."""
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="multiple of"):
+        InferenceEngine(params, cfg, EngineConfig(
+            max_batch=2, max_seq_len=512, kv_block_size=256,
+            prefill_chunk=128))
+
+
+def test_load_engine_defaults_are_consistent(tiny):
+    """load_engine's auto block/chunk choice must always produce a valid
+    paged config — including the quick-bench shape that originally hit
+    the no-op-splice bug (buckets (32, 64) with block 256)."""
+    import asyncio as aio
+
+    from tpu9.serving.presets import load_engine
+
+    eng = load_engine("llama-tiny", max_batch=2, max_seq_len=256,
+                      prefill_buckets=(32, 64), decode_steps=(1, 4))
+    assert eng.paged
+    assert eng._chunk % eng.ecfg.kv_block_size == 0
+
+    dense = load_engine("llama-tiny", max_batch=2, max_seq_len=256,
+                        prefill_buckets=(32, 64), decode_steps=(1, 4),
+                        paged=False)
+
+    async def run(engine):
+        await engine.start()
+        out = await engine.generate(list(range(3, 45)), max_new_tokens=6)
+        await engine.stop()
+        return out
+
+    assert aio.run(run(eng)) == aio.run(run(dense))
+
+
+def test_near_full_cache_prompt_does_not_overflow_table(tiny):
+    """Review regression: a prompt near max_seq_len once made the decode
+    window demand more blocks than the table width (ValueError in
+    _push_table → dead serve loop). The engine must serve it and stop at
+    the cache edge."""
+    paged = _engine(tiny, max_seq_len=128, kv_pool_blocks=8,
+                    decode_steps=(1, 4))
+    prompt = [(i * 3) % 250 + 1 for i in range(120)]   # 120 of 128
+
+    async def run():
+        await paged.start()
+        out = await paged.generate(prompt, max_new_tokens=64)
+        await paged.stop()
+        return out
+
+    out = _run(run())
+    # the cache caps generation: 120 + len(out) <= 128
+    assert 1 <= len(out) <= 8

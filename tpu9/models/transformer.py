@@ -144,6 +144,22 @@ def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
     new_cache = None
     if kv_cache is None:
         out = attention(q, k, v, causal=True)
+    elif decode and "table" in kv_cache:
+        # paged decode: scatter this token's k/v into the slot's physical
+        # pool block, then block-table paged attention over the prefix.
+        # Pool layout [N_BLOCKS, BS, KH, D] is shared by all sequences —
+        # prefix blocks can be referenced by many tables (prefix reuse)
+        from ..ops.attention import paged_attention_dispatch
+        table = kv_cache["table"]                      # [B, MB]
+        bs = kv_cache["k"].shape[2]                    # [L,N,BS,KH,D]
+        pos = positions[:, 0]                          # [B]
+        rows = jnp.arange(b)
+        bi = table[rows, pos // bs]
+        oi = pos % bs
+        k_pool = kv_cache["k"][layer_idx].at[bi, oi].set(k[:, 0])
+        v_pool = kv_cache["v"][layer_idx].at[bi, oi].set(v[:, 0])
+        out = paged_attention_dispatch(q, k_pool, v_pool, table, cache_len)
+        new_cache = (k_pool, v_pool)
     elif decode:
         # scatter this token's k/v at positions, then attend over the prefix
         k_cache = jax.lax.dynamic_update_slice(
@@ -155,6 +171,19 @@ def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
             (0, positions[0, 0], 0, 0)) if b == 1 else _scatter_kv(
                 kv_cache["v"][layer_idx], v, positions)
         out = decode_attention(q, k_cache, v_cache, cache_len)
+        new_cache = (k_cache, v_cache)
+    elif cache_len is not None:
+        # CHUNKED prefill: write this chunk at its offset (positions[0,0];
+        # batch-1 admission path), then attend over prefix + chunk with
+        # the absolute-position mask — graph shapes are (C, S) no matter
+        # how long the prompt is
+        from ..ops.attention import chunk_prefill_attention
+        off = positions[0, 0]
+        k_cache = jax.lax.dynamic_update_slice(
+            kv_cache["k"][layer_idx], k, (0, off, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            kv_cache["v"][layer_idx], v, (0, off, 0, 0))
+        out = chunk_prefill_attention(q, k_cache, v_cache, positions)
         new_cache = (k_cache, v_cache)
     else:
         # prefill: write [0, t) then causal-attend within the prefix
@@ -240,15 +269,21 @@ def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
             logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
 
     out = x if return_hidden else logits
+
+    def _pack_cache():
+        cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        if "table" in (kv_cache or {}):
+            cache["table"] = kv_cache["table"]   # paged: table rides along
+        return cache
+
     if return_moe_aux:
         # mean balance loss across layers (training regularizer)
         aux = moe_balance / max(cfg.n_layers, 1)
         if kv_cache is not None:
-            return out, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}, aux
+            return out, _pack_cache(), aux
         return out, aux
     if kv_cache is not None:
-        cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
-        return out, cache
+        return out, _pack_cache()
     return out
 
 

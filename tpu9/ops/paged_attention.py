@@ -132,3 +132,108 @@ def ragged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     )(cache_len.astype(jnp.int32), qt, k_cache, v_cache)
 
     return out.reshape(batch, 1, q_heads, head_dim)
+
+
+# ---------------------------------------------------------------------------
+# block-table paged decode: cache lives in a shared block POOL
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale: float, block_s: int,
+                  num_sb: int, kv_heads: int):
+    """Same online-softmax body as _kernel; the difference is entirely in
+    the BlockSpec index maps (physical blocks come from the table)."""
+    del table_ref
+    _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+            scale=scale, block_s=block_s, num_sb=num_sb, kv_heads=kv_heads)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, block_table: jnp.ndarray,
+                           cache_len: jnp.ndarray,
+                           interpret: bool = False) -> jnp.ndarray:
+    """Block-table paged decode attention (vLLM-style, TPU-first).
+
+    q [B,1,QH,D]; k/v_pool [N_BLOCKS, BS, KH, D] — a POOL shared by every
+    sequence; block_table [B, MAX_BLOCKS] int32 maps each sequence's logical
+    block i to a physical pool block (entries past the valid prefix are
+    ignored); cache_len [B] valid tokens incl. current. Returns [B,1,QH,D].
+
+    Reference analogue: the engine-side KV management the reference's
+    LLM router assumes (pkg/abstractions/pod/llm.go token pressure); the
+    kernel itself is the TPU equivalent of paged_attention — physical
+    blocks are DMA'd straight from the pool by table lookup in the
+    BlockSpec index map (scalar-prefetch), so fragmentation-free sharing
+    (prefix reuse) costs nothing on the read path.
+    """
+    batch, _, q_heads, head_dim = q.shape
+    n_blocks, block_s, kv_heads, _ = k_pool.shape
+    max_sb = block_table.shape[1]
+    assert q_heads % kv_heads == 0
+    group = q_heads // kv_heads
+
+    qt = q.reshape(batch, kv_heads, group, head_dim)
+    grid = (batch, max_sb)
+    kernel = functools.partial(_paged_kernel, scale=head_dim ** -0.5,
+                               block_s=block_s, num_sb=max_sb,
+                               kv_heads=kv_heads)
+
+    def kv_index(b, sb, table, lens):
+        # physical block from the table; past-the-end steps clamp to the
+        # sequence's LAST valid block (same physical index as the previous
+        # step ⇒ Mosaic elides the DMA), so only ceil(len/BS) pool blocks
+        # are read per sequence regardless of MAX_BLOCKS
+        last = jnp.maximum(
+            jax.lax.div(lens[b] + block_s - 1, block_s) - 1, 0)
+        return (table[b, jnp.minimum(sb, last)], 0, 0, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, kv_heads, group, head_dim),
+                             lambda b, sb, table, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, block_s, kv_heads, head_dim),
+                             lambda b, sb, table, lens: kv_index(
+                                 b, sb, table, lens)),
+                pl.BlockSpec((1, block_s, kv_heads, head_dim),
+                             lambda b, sb, table, lens: kv_index(
+                                 b, sb, table, lens)),
+            ],
+            out_specs=pl.BlockSpec((1, kv_heads, group, head_dim),
+                                   lambda b, sb, table, lens: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((kv_heads, group, 128), jnp.float32),
+                pltpu.VMEM((kv_heads, group, 128), jnp.float32),
+                pltpu.VMEM((kv_heads, group, head_dim), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), cache_len.astype(jnp.int32),
+      qt, k_pool, v_pool)
+
+    return out.reshape(batch, 1, q_heads, head_dim)
+
+
+def gather_paged(pool: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray:
+    """Densify a paged cache: pool [N,BS,KH,D] + table [B,MB] →
+    [B, MB*BS, KH, D]. The XLA fallback path and the chunked-prefill
+    prefix view both use this."""
+    b, mb = block_table.shape
+    _, bs, kh, d = pool.shape
+    return pool[block_table.reshape(-1)].reshape(b, mb * bs, kh, d)
+
+
+def xla_paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                               v_pool: jnp.ndarray,
+                               block_table: jnp.ndarray,
+                               cache_len: jnp.ndarray) -> jnp.ndarray:
+    """Correctness oracle + CPU path: densify then regular ragged decode."""
+    from .attention import xla_decode_attention
+    k = gather_paged(k_pool, block_table)
+    v = gather_paged(v_pool, block_table)
+    return xla_decode_attention(q, k, v, cache_len)

@@ -45,6 +45,20 @@ class EngineConfig:
     # over a TPU relay), so the loop amortizes it over K tokens; K drops
     # to 1 whenever requests wait for admission.
     decode_steps: tuple = (1, 4, 16)
+    # ---- paged KV (VERDICT r03 #5) ----
+    # block size of the shared KV pool; 0 = legacy dense [B, S] cache
+    kv_block_size: int = 0
+    # pool size in blocks; 0 = auto (max_batch * max_seq/block — dense
+    # parity). Set lower to BOUND KV memory: admission then reserves
+    # against it and queues when full.
+    kv_pool_blocks: int = 0
+    # chunked-prefill chunk length (paged mode); long prompts compile
+    # ONE (C, S) graph instead of a full-length bucket. 0 = auto (=
+    # smallest prefill bucket).
+    prefill_chunk: int = 0
+    # pool blocks the engine-level prefix cache may hold for KV reuse
+    # across requests sharing a prompt prefix; 0 disables
+    prefix_cache_blocks: int = 0
 
 
 @dataclass
@@ -56,6 +70,7 @@ class _Request:
     generated: list[int] = field(default_factory=list)
     done: asyncio.Event = field(default_factory=asyncio.Event)
     queue: Optional[asyncio.Queue] = None   # set for streaming requests
+    error: str = ""
 
 
 class InferenceEngine:
@@ -67,7 +82,57 @@ class InferenceEngine:
         self.cfg = cfg
         self.ecfg = engine_cfg
         b, s = engine_cfg.max_batch, engine_cfg.max_seq_len
-        self.kv_cache = init_kv_cache(cfg, b, s)
+        self.paged = engine_cfg.kv_block_size > 0
+        if self.paged:
+            from .paged_kv import BlockAllocator, PrefixCache
+            bs = engine_cfg.kv_block_size
+            if s % bs:
+                raise ValueError(f"max_seq_len {s} % kv_block_size {bs}")
+            chunk = engine_cfg.prefill_chunk \
+                or min(engine_cfg.prefill_buckets)
+            if chunk % bs:
+                # a chunk smaller than a block would make the splice a
+                # silent no-op (nb = chunk//bs = 0) and every token would
+                # decode against zero-filled prompt KV
+                raise ValueError(
+                    f"prefill_chunk {chunk} must be a multiple of "
+                    f"kv_block_size {bs}")
+            # +1: one dedicated TRASH block absorbs splice writes of the
+            # padded tail of a non-block-aligned final chunk
+            n_blocks = (engine_cfg.kv_pool_blocks or (b * s // bs)) + 1
+            self._mb = s // bs                      # table width
+            pool_shape = (cfg.n_layers, n_blocks, bs, cfg.n_kv_heads,
+                          cfg.head_dim)
+            self.kv_cache = {
+                "k": jnp.zeros(pool_shape, cfg.dtype),
+                "v": jnp.zeros(pool_shape, cfg.dtype),
+                "table": jnp.zeros((b, self._mb), jnp.int32),
+            }
+            self.allocator = BlockAllocator(n_blocks, bs)
+            self._trash_block = self.allocator.alloc(1)[0]
+            # inactive decode lanes scatter through their (zero-padded)
+            # table rows every step — _push_table pads rows with the trash
+            # block explicitly, but the freshly-zeroed initial table relies
+            # on the trash block being physical block 0
+            assert self._trash_block == 0, self._trash_block
+            # the trash block is held forever — reservations must not
+            # count on it
+            self.allocator.reserve_capacity = n_blocks - 1
+            self.prefix_cache = PrefixCache(
+                self.allocator, engine_cfg.prefix_cache_blocks)
+            self._slot_blocks: list[list[int]] = [[] for _ in range(b)]
+            self._slot_reserved = [0] * b
+            self._table_np = np.zeros((b, self._mb), dtype=np.int32)
+            self._chunk = engine_cfg.prefill_chunk \
+                or min(engine_cfg.prefill_buckets)
+            # batch-1 dense scratch the chunked prefill writes through
+            # before splicing into pool blocks — ONE lane, not B of them
+            self._scratch = init_kv_cache(cfg, 1, s)
+            self._wait_room: list[_Request] = []
+        else:
+            self.kv_cache = init_kv_cache(cfg, b, s)
+            self.allocator = None
+            self.prefix_cache = None
         self.cache_len = jnp.zeros((b,), jnp.int32)     # valid prefix per slot
         self.active = np.zeros((b,), dtype=bool)
         self.slot_req: list[Optional[_Request]] = [None] * b
@@ -167,6 +232,129 @@ class InferenceEngine:
                 return b
         return self.ecfg.prefill_buckets[-1]
 
+    # -- paged-KV machinery --------------------------------------------------
+
+    def _chunk_fn(self):
+        """Jitted chunked-prefill step: write one C-token chunk into the
+        batch-1 dense scratch at ``offset``, attend over prefix+chunk, and
+        return the logits at ``last_idx`` (the chunk's final real token).
+        Shapes are (C, S) — prompt length never changes the graph."""
+        key = ("chunk", self._chunk)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+
+        def chunk(params, tokens, offset, scratch, last_idx):
+            c = tokens.shape[1]
+            positions = offset + jnp.arange(c)[None, :]
+            logits, scratch = decoder_forward(
+                params, tokens, cfg, positions=positions,
+                kv_cache=scratch, cache_len=offset + c, decode=False)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], last_idx, axis=0, keepdims=False)
+            return last, scratch
+
+        fn = self._compiled[key] = jax.jit(chunk, donate_argnums=(3,))
+        return fn
+
+    def _gather_fn(self):
+        """Jitted densify of ONE slot's table row into the scratch (prefix
+        reuse: cached blocks → scratch so chunk prefill can attend them)."""
+        fn = self._compiled.get("gather")
+        if fn is not None:
+            return fn
+
+        def gather(pool_k, pool_v, row):
+            # pool [L, N, BS, KH, D], row [MB] → dense [L, 1, S, KH, D]
+            def one(pool):
+                g = pool[:, row]                     # [L, MB, BS, KH, D]
+                l, mb, bs, kh, d = g.shape
+                return g.reshape(l, 1, mb * bs, kh, d)
+            return {"k": one(pool_k), "v": one(pool_v)}
+
+        fn = self._compiled["gather"] = jax.jit(gather)
+        return fn
+
+    def _splice_fn(self):
+        """Jitted copy of one chunk's blocks from the scratch into their
+        physical pool blocks. C/BS is static → one graph."""
+        fn = self._compiled.get("splice")
+        if fn is not None:
+            return fn
+        bs = self.ecfg.kv_block_size
+        nb = self._chunk // bs
+
+        def splice(pool_k, pool_v, scratch_k, scratch_v, offset, phys):
+            # scratch [L, 1, S, KH, D]; copy [offset, offset+C) into pool
+            # blocks phys[0..nb)
+            for j in range(nb):
+                blk_k = jax.lax.dynamic_slice_in_dim(
+                    scratch_k[:, 0], offset + j * bs, bs, axis=1)
+                blk_v = jax.lax.dynamic_slice_in_dim(
+                    scratch_v[:, 0], offset + j * bs, bs, axis=1)
+                pool_k = pool_k.at[:, phys[j]].set(blk_k)
+                pool_v = pool_v.at[:, phys[j]].set(blk_v)
+            return pool_k, pool_v
+
+        fn = self._compiled["splice"] = jax.jit(
+            splice, donate_argnums=(0, 1))
+        return fn
+
+    def bench_reset_slots(self, ctx0: int, budget: int) -> None:
+        """Raw-loop benchmarking support: give every slot physical blocks
+        covering [0, ctx0 + budget) so a paged decode window moves the
+        same HBM traffic it would in production (an all-zero table would
+        read one block B times and fake the bandwidth numbers)."""
+        if not self.paged:
+            return
+        for slot in range(self.ecfg.max_batch):
+            if self._slot_blocks[slot]:
+                self.allocator.release(self._slot_blocks[slot])
+                self._slot_blocks[slot] = []
+            self._ensure_slot_blocks(slot, ctx0 + budget + 1)
+            self._host_len[slot] = ctx0
+
+    def _worst_case_tokens(self, req: _Request) -> int:
+        # prompt + full generation budget + one decode window of overshoot
+        return (len(req.prompt) + req.max_new_tokens
+                + max(self.ecfg.decode_steps) + 1)
+
+    def _alloc_blocks(self, n: int) -> list[int]:
+        """Allocate physical blocks; evicts prefix-cache holdings if the
+        free list runs short. Reservations make failure impossible."""
+        if n <= 0:
+            return []
+        got = self.allocator.alloc(n)
+        if got is None:
+            self.prefix_cache.evict_for_space(n)
+            got = self.allocator.alloc(n)
+        if got is None:
+            raise RuntimeError(
+                f"KV pool exhausted: need {n}, free "
+                f"{self.allocator.free_count} (reservation bug)")
+        return got
+
+    def _push_table(self, slot: int) -> None:
+        # pad with the trash block: inactive/overhang lanes write there
+        row = np.full((self._mb,), self._trash_block, dtype=np.int32)
+        blocks = self._slot_blocks[slot]
+        row[:len(blocks)] = blocks
+        self._table_np[slot] = row
+        self.kv_cache["table"] = jnp.asarray(self._table_np)
+
+    def _ensure_slot_blocks(self, slot: int, n_tokens: int) -> bool:
+        """Grow the slot's physical block list to cover ``n_tokens``
+        positions. Returns True when the table changed."""
+        from .paged_kv import blocks_for
+        need = blocks_for(n_tokens, self.ecfg.kv_block_size)
+        have = len(self._slot_blocks[slot])
+        if need <= have:
+            return False
+        self._slot_blocks[slot].extend(self._alloc_blocks(need - have))
+        self._push_table(slot)
+        return True
+
     # -- public API ----------------------------------------------------------
 
     async def start(self) -> None:
@@ -185,12 +373,35 @@ class InferenceEngine:
         """
         import time as _time
         timings: dict[str, float] = {}
-        for bucket in self.ecfg.prefill_buckets:
+        if self.paged:
+            # paged prefill path: chunk + splice + gather graphs
             t0 = _time.perf_counter()
-            tokens = jnp.zeros((1, bucket), jnp.int32)
-            last, _cache = self._prefill_fn(bucket)(self.params, tokens, 1)
+            toks = jnp.zeros((1, self._chunk), jnp.int32)
+            last, scratch = self._chunk_fn()(
+                self.params, toks, 0, self._scratch, 0)
+            self._scratch = scratch
             np.asarray(jax.device_get(last[:4]))
-            timings[f"prefill_{bucket}_s"] = _time.perf_counter() - t0
+            timings[f"chunk_{self._chunk}_s"] = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            bs = self.ecfg.kv_block_size
+            phys = jnp.full((self._chunk // bs,), self._trash_block,
+                            jnp.int32)
+            self.kv_cache["k"], self.kv_cache["v"] = self._splice_fn()(
+                self.kv_cache["k"], self.kv_cache["v"],
+                self._scratch["k"], self._scratch["v"], 0, phys)
+            dense = self._gather_fn()(self.kv_cache["k"],
+                                      self.kv_cache["v"],
+                                      self.kv_cache["table"][0])
+            np.asarray(jax.device_get(dense["k"].ravel()[:4]))
+            timings["splice_gather_s"] = _time.perf_counter() - t0
+        else:
+            for bucket in self.ecfg.prefill_buckets:
+                t0 = _time.perf_counter()
+                tokens = jnp.zeros((1, bucket), jnp.int32)
+                last, _cache = self._prefill_fn(bucket)(self.params,
+                                                        tokens, 1)
+                np.asarray(jax.device_get(last[:4]))
+                timings[f"prefill_{bucket}_s"] = _time.perf_counter() - t0
         inactive = jnp.zeros((self.ecfg.max_batch,), bool)
         for k in self.ecfg.decode_steps:
             t0 = _time.perf_counter()
@@ -213,7 +424,9 @@ class InferenceEngine:
 
     async def generate(self, prompt: list[int], max_new_tokens: int = 32,
                        request_id: str = "", stream: bool = False):
-        limit = min(self.ecfg.prefill_buckets[-1], self.ecfg.max_seq_len - 1)
+        # chunked prefill (paged mode) has no bucket cap — only the cache
+        limit = self.ecfg.max_seq_len - 1 if self.paged else \
+            min(self.ecfg.prefill_buckets[-1], self.ecfg.max_seq_len - 1)
         if len(prompt) > limit:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds engine limit {limit}")
@@ -227,6 +440,8 @@ class InferenceEngine:
         if stream:
             return req  # caller iterates req.queue
         await req.done.wait()
+        if req.error:
+            raise ValueError(req.error)
         return req.generated
 
     def stats(self) -> dict:
@@ -236,15 +451,104 @@ class InferenceEngine:
         out["token_pressure"] = float(
             np.asarray(jax.device_get(self.cache_len)).sum()
             / (self.ecfg.max_batch * self.ecfg.max_seq_len))
+        if self.paged:
+            out["kv_blocks_used"] = self.allocator.used_count
+            out["kv_blocks_free"] = self.allocator.free_count
+            out["kv_blocks_reserved"] = self.allocator.reserved
+            out["queued"] += len(self._wait_room)
+            out["prefix_cache"] = self.prefix_cache.stats()
+            # admission pressure for the router: reserved fraction is the
+            # honest "can I take another request" signal under paging
+            out["token_pressure"] = max(
+                out["token_pressure"],
+                self.allocator.reserved / max(self.allocator.n_blocks, 1))
         return out
 
     # -- engine loop ---------------------------------------------------------
+
+    def _admit_paged(self, req: _Request, slot: int):
+        """Paged admission: reserve budget, reuse any cached prefix blocks,
+        chunk-prefill the suffix through the dense scratch, splice chunks
+        into fresh pool blocks. Returns the first-token device value."""
+        from .paged_kv import blocks_for
+        bs = self.ecfg.kv_block_size
+        n = len(req.prompt)
+        if self._slot_blocks[slot]:
+            # leftovers (bench_reset_slots / defensive): return them first
+            self.allocator.release(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+        self._slot_reserved[slot] = self.allocator.reserve(
+            self._worst_case_tokens(req))
+
+        entry = self.prefix_cache.lookup(req.prompt) \
+            if self.ecfg.prefix_cache_blocks > 0 else None
+        shared: list[int] = list(entry.blocks) if entry else []
+        p = entry.n_tokens if entry else 0
+        self.allocator.retain(shared)
+
+        total_blocks = blocks_for(n + 1, bs)
+        fresh = self._alloc_blocks(total_blocks - len(shared))
+        self._slot_blocks[slot] = shared + fresh
+        self._push_table(slot)
+
+        scratch_k, scratch_v = self._scratch["k"], self._scratch["v"]
+        if p:
+            dense = self._gather_fn()(self.kv_cache["k"],
+                                      self.kv_cache["v"],
+                                      self.kv_cache["table"][slot])
+            scratch_k, scratch_v = dense["k"], dense["v"]
+
+        # chunk loop over the suffix; each chunk is spliced into its
+        # physical blocks right after it is computed
+        c = self._chunk
+        suffix = req.prompt[p:]
+        m = len(suffix)
+        last = None
+        for i in range(0, m, c):
+            chunk_toks = suffix[i:i + c]
+            valid = len(chunk_toks)
+            toks = np.zeros((1, c), dtype=np.int32)
+            toks[0, :valid] = chunk_toks
+            scratch = {"k": scratch_k, "v": scratch_v}
+            last, scratch = self._chunk_fn()(
+                self.params, jnp.asarray(toks), p + i, scratch, valid - 1)
+            scratch_k, scratch_v = scratch["k"], scratch["v"]
+            # physical blocks covering [p+i, p+i+C)
+            first_block = (p + i) // bs
+            phys = np.zeros((c // bs,), dtype=np.int32)
+            for j in range(c // bs):
+                idx = first_block + j
+                # chunk tail past the slot's blocks = padded garbage →
+                # write it to the dedicated trash block, never a real one
+                phys[j] = self._slot_blocks[slot][idx] \
+                    if idx < len(self._slot_blocks[slot]) else \
+                    self._trash_block
+            self.kv_cache["k"], self.kv_cache["v"] = self._splice_fn()(
+                self.kv_cache["k"], self.kv_cache["v"],
+                scratch_k, scratch_v, p + i, jnp.asarray(phys))
+        self._scratch = {"k": scratch_k, "v": scratch_v}
+
+        if self.ecfg.prefix_cache_blocks > 0:
+            self.prefix_cache.insert(req.prompt, self._slot_blocks[slot])
+
+        self.cache_len = self.cache_len.at[slot].set(n)
+        self._host_len[slot] = n
+        self._rng, sub = jax.random.split(self._rng)
+        first = sample_logits(last, sub, temperature=self.ecfg.temperature,
+                              top_k=self.ecfg.top_k, top_p=self.ecfg.top_p)
+        self.last_token = self.last_token.at[slot, 0].set(first)
+        req.slot = slot
+        self.active[slot] = True
+        self.slot_req[slot] = req
+        return first
 
     def _admit(self, req: _Request, slot: int):
         """Prefill + cache splice for one request. Returns the slot's
         first-token DEVICE value — the serve loop syncs a whole admission
         batch in one host round-trip (each blocking ``int()`` here would
         cost a full RTT, brutal over a TPU relay)."""
+        if self.paged:
+            return self._admit_paged(req, slot)
         n = len(req.prompt)
         bucket = self._bucket_for(n)
         tokens = np.zeros((1, bucket), dtype=np.int32)
@@ -286,24 +590,87 @@ class InferenceEngine:
         self.slot_req[slot] = None
         self.cache_len = self.cache_len.at[slot].set(0)
         self._host_len[slot] = 0
+        if self.paged:
+            # physical blocks back to the pool (prefix-cache refs keep
+            # shared prefix blocks alive), worst-case reservation released
+            self.allocator.release(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+            self._push_table(slot)
+            self.allocator.unreserve(self._slot_reserved[slot])
+            self._slot_reserved[slot] = 0
         if req is not None:
             if req.queue is not None:
                 req.queue.put_nowait(None)
             req.done.set()
 
+    def _room_for(self, req: _Request) -> bool:
+        """Paged admission control: a request enters only when the pool can
+        reserve its worst case — so mid-decode allocation can never fail."""
+        return (not self.paged
+                or self.allocator.can_reserve(self._worst_case_tokens(req)))
+
+    def _next_admittable(self) -> Optional[_Request]:
+        if self.paged and self._wait_room:
+            if self._room_for(self._wait_room[0]):
+                return self._wait_room.pop(0)
+            return None                     # FIFO: don't starve the head
+        while not self._queue.empty():
+            req = self._queue.get_nowait()
+            if self._room_for(req):
+                return req
+            self._wait_room.append(req)
+            return None
+        return None
+
     async def _serve_loop(self) -> None:
+        try:
+            await self._serve_loop_inner()
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:      # noqa: BLE001
+            # a dead loop must not leave callers awaiting forever — fail
+            # every known request with the cause
+            import logging
+            logging.getLogger("tpu9.serving").exception("engine loop died")
+            for req in ([r for r in self.slot_req if r is not None]
+                        + list(getattr(self, "_wait_room", []))):
+                req.error = f"engine failure: {exc}"
+                if req.queue is not None:
+                    req.queue.put_nowait(None)
+                req.done.set()
+            while not self._queue.empty():
+                req = self._queue.get_nowait()
+                req.error = f"engine failure: {exc}"
+                req.done.set()
+            raise
+
+    async def _serve_loop_inner(self) -> None:
         while True:
             # admit as many queued requests as there are free slots; ALL
             # their first tokens sync in one device round-trip at the end
             pending: list[tuple[_Request, Any]] = []
-            while not self._queue.empty() and not self.active.all():
-                req = self._queue.get_nowait()
+            while not self.active.all():
+                req = self._next_admittable()
+                if req is None:
+                    break
                 slot = int(np.argmin(self.active))
                 pending.append((req, self._admit(req, slot)))
 
             if not self.active.any() and not pending:
+                if self.paged and self._wait_room:
+                    # engine idle with a waiting head means reservations
+                    # are zero, so the ONLY way it can't admit is being
+                    # bigger than the whole pool — fail it loudly (prefix-
+                    # cache pressure is handled inside _alloc_blocks)
+                    head = self._wait_room.pop(0)
+                    head.error = "request exceeds KV pool capacity"
+                    head.done.set()
+                    continue
                 # idle: block for work
                 req = await self._queue.get()
+                if not self._room_for(req):
+                    self._wait_room.append(req)
+                    continue
                 pending.append((req, self._admit(req, 0)))
 
             if pending:
@@ -318,6 +685,17 @@ class InferenceEngine:
             # one decode WINDOW for the whole batch: k steps on-device,
             # one host sync for all k×B tokens
             k = self._pick_steps()
+            if self.paged:
+                # lazy physical growth: each active slot gets blocks for
+                # this window's writes (covered by its reservation). Clamp
+                # to max_seq_len: _pick_steps already bounds in-window
+                # positions to the cache, and a near-full slot must not
+                # demand a 17th block of a 16-wide table.
+                for slot in range(self.ecfg.max_batch):
+                    if self.active[slot]:
+                        self._ensure_slot_blocks(
+                            slot, min(int(self._host_len[slot]) + k + 1,
+                                      self.ecfg.max_seq_len))
             (self.last_token, self.kv_cache,
              self.cache_len, self._rng, toks) = self._decode_k(k)(
                 self.params, self.kv_cache, self.last_token,

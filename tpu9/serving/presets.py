@@ -47,10 +47,30 @@ def build_params(name: str, seed: int = 0):
 def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
                 prefill_buckets: tuple = (128, 512, 2048),
                 decode_steps: tuple = (1, 8, 32),
+                paged: Optional[bool] = None,
+                kv_block_size: int = 256,
+                kv_pool_blocks: int = 0,
+                prefix_cache_blocks: int = 0,
                 engine_cfg: Optional[EngineConfig] = None,
                 seed: int = 0) -> InferenceEngine:
+    """``paged=None`` (default) enables the paged-KV engine whenever the
+    block size divides max_seq_len — the production serving path (block
+    allocator + chunked prefill + prefix reuse). ``paged=False`` forces
+    the legacy dense cache."""
     params, cfg = build_params(name, seed=seed)
+    # the chunk is the smallest prefill bucket; the block size must divide
+    # it (a chunk smaller than a block would lose prefill KV — the engine
+    # rejects that) AND divide max_seq_len
+    chunk = min(prefill_buckets)
+    block = min(kv_block_size, chunk)
+    if paged is None:
+        paged = (max_seq_len % block == 0 and chunk % block == 0)
     ecfg = engine_cfg or EngineConfig(
         max_batch=max_batch, max_seq_len=max_seq_len,
-        prefill_buckets=prefill_buckets, decode_steps=decode_steps)
+        prefill_buckets=prefill_buckets, decode_steps=decode_steps,
+        kv_block_size=block if paged else 0,
+        kv_pool_blocks=kv_pool_blocks,
+        prefill_chunk=chunk if paged else 0,
+        prefix_cache_blocks=prefix_cache_blocks or
+        (max_seq_len // block if paged else 0))
     return InferenceEngine(params, cfg, ecfg)
