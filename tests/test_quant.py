@@ -60,3 +60,62 @@ def test_quantized_decode_path():
                                   cache_len=jnp.array([4]), decode=True)
     assert step.shape == (1, 1, TINY.vocab_size)
     assert bool(jnp.isfinite(step).all())
+
+
+def test_int8_quality_bound_vs_bf16():
+    """VERDICT r03 #9: a NUMERIC bound on int8 weight-only quality, not
+    just structural checks. Quantize real bf16 params, compare full-model
+    logits and greedy continuations on a fixed prompt set.
+
+    Documented bound (pinned here): per-channel symmetric int8 on
+    llama-tiny keeps max |Δlogit| < 0.25 and softmax top-1 agreement
+    ≥ 90% across prompts; greedy 8-token continuations agree on ≥ 75% of
+    positions. (The deltas scale with dim⁻¹ᐟ²; production 8B is tighter.)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpu9.models import init_decoder
+    from tpu9.models.llama import LLAMA_PRESETS
+    from tpu9.models.transformer import decoder_forward
+    from tpu9.ops.quant import quantize_decoder
+
+    cfg = LLAMA_PRESETS["llama-tiny"]
+    dense = init_decoder(jax.random.PRNGKey(7), cfg)
+    quant = quantize_decoder(dense)
+
+    prompts = [
+        [(i * 13) % 250 + 1 for i in range(24)],
+        [(i * 7 + 3) % 250 + 1 for i in range(24)],
+        [(i * 29 + 11) % 250 + 1 for i in range(24)],
+        [5] * 24,
+    ]
+    toks = jnp.asarray(prompts, jnp.int32)
+    logits_d = decoder_forward(dense, toks, cfg)      # [P, T, V]
+    logits_q = decoder_forward(quant, toks, cfg)
+
+    max_abs = float(jnp.max(jnp.abs(logits_d - logits_q)))
+    assert max_abs < 0.25, f"int8 logit drift {max_abs}"
+
+    top1_d = jnp.argmax(logits_d, axis=-1)
+    top1_q = jnp.argmax(logits_q, axis=-1)
+    agreement = float(jnp.mean(top1_d == top1_q))
+    assert agreement >= 0.90, f"top-1 agreement {agreement}"
+
+    # greedy continuations through the full forward (teacher-forced on
+    # each model's own argmax — end-to-end drift, not single-step)
+    def greedy(params, prompt, steps=8):
+        seq = list(prompt)
+        for _ in range(steps):
+            lg = decoder_forward(params, jnp.asarray([seq], jnp.int32), cfg)
+            seq.append(int(jnp.argmax(lg[0, -1])))
+        return seq[len(prompt):]
+
+    agree_pos = 0
+    total = 0
+    for p in prompts[:2]:
+        gd = greedy(dense, p)
+        gq = greedy(quant, p)
+        agree_pos += sum(a == b for a, b in zip(gd, gq))
+        total += len(gd)
+    assert agree_pos / total >= 0.75, (agree_pos, total)

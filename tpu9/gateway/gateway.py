@@ -57,8 +57,14 @@ class Gateway:
                  pools: Optional[dict] = None):
         self.cfg = cfg
         self.store = store or MemoryStore()
-        self.backend = backend or BackendDB(
-            cfg.database.path, secret_key=cfg.database.secret_key)
+        if backend is None:
+            # database.path accepts a postgresql:// DSN (HA control plane:
+            # concurrent gateways over one Postgres) or a file path
+            # (single-binary SQLite default)
+            from ..backend.pg import open_backend
+            backend = open_backend(cfg.database.path,
+                                   secret_key=cfg.database.secret_key)
+        self.backend = backend
         from ..scheduler.quota import QuotaService
         self.quota = QuotaService(self.store, self.backend)
         # agent-mode pools are self-hosted (machines reconcile against the
@@ -623,6 +629,27 @@ class Gateway:
             return web.json_response(
                 {"error": "pricing requires authorized=True (a public "
                           "endpoint cannot be billed)"}, status=400)
+        # HBM feasibility gate for declarative LLM deployments (VERDICT
+        # r03 #8): weights + KV + scratch must fit the slice's HBM, proven
+        # arithmetically HERE — not discovered as an OOM on real chips.
+        # Applies when the stub declares its model (extra.model); app-code
+        # engines (load() in user code) can't be checked statically.
+        if (config.extra.get("runner") == "llm"
+                and config.extra.get("model") and config.runtime.tpu):
+            from ..serving.feasibility import (InfeasibleDeployment,
+                                               validate_llm_deployment)
+            try:
+                budget = validate_llm_deployment(
+                    config.extra["model"], config.runtime.tpu,
+                    max_batch=int(config.extra.get("max_batch", 8)),
+                    max_seq_len=int(config.extra.get("max_seq_len", 2048)),
+                    tp=int(config.extra.get("tp", 0)))
+            except InfeasibleDeployment as exc:
+                return web.json_response({"error": str(exc)}, status=400)
+            except (KeyError, ValueError) as exc:
+                return web.json_response(
+                    {"error": f"llm config invalid: {exc}"}, status=400)
+            config.extra["hbm_budget"] = budget.as_dict()
         stub = await self.backend.get_or_create_stub(
             workspace_id=ws.workspace_id,
             name=data["name"],

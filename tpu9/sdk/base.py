@@ -52,7 +52,8 @@ class RunnerAbstraction:
                  env: Optional[dict] = None, secrets: Optional[list] = None,
                  volumes: Optional[list] = None,
                  disks: Optional[list] = None, authorized: bool = True,
-                 runner: str = "", callback_url: str = "",
+                 runner: str = "", model: str = "",
+                 extra: Optional[dict] = None, callback_url: str = "",
                  inputs: Any = None, outputs: Any = None,
                  pricing: Any = None,
                  on_start: Optional[Callable] = None):
@@ -87,8 +88,14 @@ class RunnerAbstraction:
                 raise ValueError(
                     f"bad pricing cost_model {pricing.cost_model!r}")
             self.config.pricing = pricing
+        if extra:
+            self.config.extra.update(extra)
         if runner:
             self.config.extra["runner"] = runner
+        if model:
+            # declarative model preset: enables the gateway's deploy-time
+            # HBM feasibility gate (weights + KV must fit the tpu= slice)
+            self.config.extra["model"] = model
         if autoscaler is not None:
             self.config.autoscaler = AutoscalerConfig(
                 type=autoscaler.type,

@@ -1,0 +1,143 @@
+"""HBM feasibility math for LLM deployments (VERDICT r03 #8).
+
+Before a deployment schedules real chips, the weights + KV cache + runtime
+overhead must provably fit the slice's HBM — the reference relies on CUDA
+OOMs at runtime; tpu9 validates at deploy time so config #4 (llama3-70b
+on v5e-8, BASELINE.md) is accepted or rejected with arithmetic, not a
+crashed container.
+
+Accounting (per chip, tensor-parallel over ``tp`` chips):
+- weights: matmul params at 1 B (int8 weight-only) or 2 B (bf16) + scales,
+  embeddings always bf16; all divided by tp (row/col-sharded)
+- KV cache: ``2 (k,v) × layers × max_batch × max_seq × kv_heads × head_dim
+  × 2 B`` divided by tp (head-sharded; n_kv_heads % tp may force
+  replication — accounted)
+- overhead: XLA workspace / fragmentation reserve (default 10%) + the
+  paged engine's batch-1 prefill scratch
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..types import TpuSpec, parse_tpu_spec
+
+
+class InfeasibleDeployment(ValueError):
+    """Raised at deploy time when the model + KV cannot fit the slice."""
+
+
+@dataclass(frozen=True)
+class HbmBudget:
+    tpu: str
+    chips: int
+    tp: int
+    hbm_per_chip_gb: float
+    weight_gb_per_chip: float
+    kv_gb_per_chip: float
+    scratch_gb_per_chip: float
+    overhead_frac: float
+
+    @property
+    def required_gb_per_chip(self) -> float:
+        raw = (self.weight_gb_per_chip + self.kv_gb_per_chip
+               + self.scratch_gb_per_chip)
+        return raw * (1.0 + self.overhead_frac)
+
+    @property
+    def fits(self) -> bool:
+        return self.required_gb_per_chip <= self.hbm_per_chip_gb
+
+    def as_dict(self) -> dict:
+        return {
+            "tpu": self.tpu, "chips": self.chips, "tp": self.tp,
+            "hbm_per_chip_gb": round(self.hbm_per_chip_gb, 2),
+            "weight_gb_per_chip": round(self.weight_gb_per_chip, 3),
+            "kv_gb_per_chip": round(self.kv_gb_per_chip, 3),
+            "scratch_gb_per_chip": round(self.scratch_gb_per_chip, 3),
+            "overhead_frac": self.overhead_frac,
+            "required_gb_per_chip": round(self.required_gb_per_chip, 3),
+            "fits": self.fits,
+        }
+
+
+def matmul_param_count(cfg) -> int:
+    """Per-model matmul parameters (the int8-quantizable set)."""
+    per_layer = (cfg.dim * cfg.n_heads * cfg.head_dim
+                 + 2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim
+                 + cfg.n_heads * cfg.head_dim * cfg.dim)
+    if getattr(cfg, "n_experts", 0):
+        per_layer += 3 * cfg.dim * cfg.hidden_dim * cfg.n_experts
+        per_layer += cfg.dim * cfg.n_experts          # router
+    else:
+        per_layer += 3 * cfg.dim * cfg.hidden_dim
+    total = per_layer * cfg.n_layers
+    if not getattr(cfg, "tie_embeddings", False):
+        total += cfg.dim * cfg.vocab_size             # lm_head
+    return total
+
+
+def weight_bytes(cfg, quantized: bool) -> int:
+    mm = matmul_param_count(cfg)
+    embed = cfg.vocab_size * cfg.dim * 2              # always bf16
+    if quantized:
+        # int8 payload + one f32 scale per output column (≈dim⁻¹ relative)
+        return mm + mm // max(cfg.dim, 1) * 4 + embed
+    return mm * 2 + embed
+
+
+def kv_cache_bytes(cfg, max_batch: int, max_seq: int) -> int:
+    return (2 * cfg.n_layers * max_batch * max_seq
+            * cfg.n_kv_heads * cfg.head_dim * 2)
+
+
+def hbm_budget(preset: str, tpu: "str | TpuSpec", *, max_batch: int = 8,
+               max_seq_len: int = 2048, tp: int = 0,
+               overhead_frac: float = 0.10) -> HbmBudget:
+    """Compute the per-chip HBM budget for serving ``preset`` on ``tpu``
+    with tensor parallelism ``tp`` (default: all chips of the slice)."""
+    from .presets import resolve_preset
+    cfg, quantized = resolve_preset(preset)
+    spec = parse_tpu_spec(tpu) if isinstance(tpu, str) else tpu
+    if spec is None:
+        raise ValueError("feasibility needs a TPU spec")
+    tp = tp or spec.chips
+
+    w = weight_bytes(cfg, quantized) / tp
+    # KV is head-sharded; if tp exceeds kv heads the cache replicates
+    # across tp/n_kv_heads groups
+    kv_shard = min(tp, cfg.n_kv_heads)
+    kv = kv_cache_bytes(cfg, max_batch, max_seq_len) / kv_shard
+    # paged engine's batch-1 dense prefill scratch rides on one chip's
+    # shard of the kv lanes
+    scratch = kv_cache_bytes(cfg, 1, max_seq_len) / kv_shard
+
+    return HbmBudget(
+        tpu=spec.name, chips=spec.chips, tp=tp,
+        hbm_per_chip_gb=float(spec.hbm_gb_per_chip),
+        weight_gb_per_chip=w / 1e9,
+        kv_gb_per_chip=kv / 1e9,
+        scratch_gb_per_chip=scratch / 1e9,
+        overhead_frac=overhead_frac)
+
+
+def validate_llm_deployment(preset: str, tpu: "str | TpuSpec", *,
+                            max_batch: int = 8, max_seq_len: int = 2048,
+                            tp: int = 0) -> HbmBudget:
+    """Deploy-time gate: raises :class:`InfeasibleDeployment` with the
+    arithmetic when the configuration cannot fit; returns the budget when
+    it can. Suggests the standard remedies in the message."""
+    budget = hbm_budget(preset, tpu, max_batch=max_batch,
+                        max_seq_len=max_seq_len, tp=tp)
+    if not budget.fits:
+        d = budget.as_dict()
+        raise InfeasibleDeployment(
+            f"{preset} on {d['tpu']} (tp={d['tp']}) needs "
+            f"{d['required_gb_per_chip']} GB/chip "
+            f"(weights {d['weight_gb_per_chip']} + KV {d['kv_gb_per_chip']}"
+            f" + scratch {d['scratch_gb_per_chip']} + "
+            f"{int(budget.overhead_frac * 100)}% overhead) but the chip "
+            f"has {d['hbm_per_chip_gb']} GB. Remedies: int8 weights "
+            f"(-50% weight bytes), smaller max_batch/max_seq_len (KV "
+            f"scales linearly), or a larger slice.")
+    return budget
