@@ -16,6 +16,15 @@
 // As PID 1 it also reaps orphaned zombies (the classic init duty containers
 // need). JSON parsing is a tiny purpose-built scanner — inputs come from the
 // trusted worker, not end users.
+//
+// Modes:
+//   t9proc                    — stdio protocol (exits when stdin closes)
+//   t9proc --sock PATH        — PID-1 mode: listens on a unix socket, the
+//                               worker (re)connects across its own restarts;
+//                               runs until SIGTERM (kills children first).
+//                               Process stdout/stdin payloads ride base64
+//                               (`data_b64`) so binary output can't corrupt
+//                               the JSON framing.
 
 #include <cerrno>
 #include <csignal>
@@ -28,7 +37,10 @@
 
 #include <fcntl.h>
 #include <poll.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/types.h>
+#include <sys/un.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
@@ -37,16 +49,70 @@ namespace {
 struct Proc {
   pid_t pid = -1;
   int out_fd = -1;
+  int in_fd = -1;                        // child stdin (write end)
   std::string id;
 };
 
 std::map<std::string, Proc> procs;       // id -> proc
 std::map<int, std::string> fd_to_id;     // stdout fd -> id
+int g_ctrl_out = STDOUT_FILENO;          // control channel (stdout or conn)
 
 void emit(const std::string& line) {
-  fputs(line.c_str(), stdout);
-  fputc('\n', stdout);
-  fflush(stdout);
+  std::string buf = line + "\n";
+  size_t off = 0;
+  while (off < buf.size()) {
+    ssize_t n = write(g_ctrl_out, buf.data() + off, buf.size() - off);
+    if (n <= 0) {
+      if (errno == EINTR) continue;
+      return;                            // client gone; drop the event
+    }
+    off += static_cast<size_t>(n);
+  }
+}
+
+// --- base64 (binary-safe stdout/stdin payloads) ---------------------------
+
+const char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string b64_encode(const char* data, size_t n) {
+  std::string out;
+  out.reserve((n + 2) / 3 * 4);
+  for (size_t i = 0; i < n; i += 3) {
+    unsigned v = static_cast<unsigned char>(data[i]) << 16;
+    if (i + 1 < n) v |= static_cast<unsigned char>(data[i + 1]) << 8;
+    if (i + 2 < n) v |= static_cast<unsigned char>(data[i + 2]);
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += (i + 1 < n) ? kB64[(v >> 6) & 63] : '=';
+    out += (i + 2 < n) ? kB64[v & 63] : '=';
+  }
+  return out;
+}
+
+int b64_val(char c) {
+  if (c >= 'A' && c <= 'Z') return c - 'A';
+  if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+  if (c >= '0' && c <= '9') return c - '0' + 52;
+  if (c == '+') return 62;
+  if (c == '/') return 63;
+  return -1;
+}
+
+std::string b64_decode(const std::string& s) {
+  std::string out;
+  int acc = 0, bits = 0;
+  for (char c : s) {
+    int v = b64_val(c);
+    if (v < 0) continue;
+    acc = (acc << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += static_cast<char>((acc >> bits) & 0xFF);
+    }
+  }
+  return out;
 }
 
 std::string json_escape(const std::string& s) {
@@ -147,16 +213,20 @@ void do_spawn(const std::string& line) {
     return;
   }
   int pipefd[2];
-  if (pipe(pipefd) != 0) {
+  int infd[2];
+  if (pipe(pipefd) != 0 || pipe(infd) != 0) {
     emit("{\"event\": \"error\", \"message\": \"pipe failed\"}");
     return;
   }
   pid_t pid = fork();
   if (pid == 0) {
     close(pipefd[0]);
+    close(infd[1]);
+    dup2(infd[0], STDIN_FILENO);
     dup2(pipefd[1], STDOUT_FILENO);
     dup2(pipefd[1], STDERR_FILENO);
     close(pipefd[1]);
+    close(infd[0]);
     std::vector<char*> cargv;
     for (auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
     cargv.push_back(nullptr);
@@ -165,10 +235,15 @@ void do_spawn(const std::string& line) {
     _exit(127);
   }
   close(pipefd[1]);
+  close(infd[0]);
   fcntl(pipefd[0], F_SETFL, O_NONBLOCK);
+  // stdin writes must never block the single-threaded PID-1 loop: a child
+  // that ignores stdin would otherwise wedge every proc in the container
+  fcntl(infd[1], F_SETFL, O_NONBLOCK);
   Proc p;
   p.pid = pid;
   p.out_fd = pipefd[0];
+  p.in_fd = infd[1];
   p.id = id;
   procs[id] = p;
   fd_to_id[pipefd[0]] = id;
@@ -176,6 +251,52 @@ void do_spawn(const std::string& line) {
   snprintf(buf, sizeof buf, "{\"event\": \"spawned\", \"id\": \"%s\", \"pid\": %d}",
            json_escape(id).c_str(), pid);
   emit(buf);
+}
+
+void do_stdin(const std::string& line) {
+  std::string id = get_string(line, "id");
+  auto it = procs.find(id);
+  if (it == procs.end()) {
+    emit("{\"event\": \"error\", \"id\": \"" + json_escape(id) +
+         "\", \"message\": \"unknown id\"}");
+    return;
+  }
+  std::string data = b64_decode(get_string(line, "data_b64"));
+  if (get_number(line, "eof", 0) == 1) {
+    if (it->second.in_fd >= 0) {
+      close(it->second.in_fd);
+      it->second.in_fd = -1;
+    }
+    emit("{\"event\": \"stdin_ok\", \"id\": \"" + json_escape(id) + "\"}");
+    return;
+  }
+  if (it->second.in_fd < 0) {
+    emit("{\"event\": \"error\", \"id\": \"" + json_escape(id) +
+         "\", \"message\": \"stdin closed\"}");
+    return;
+  }
+  size_t off = 0;
+  bool backpressure = false;
+  while (off < data.size()) {
+    ssize_t n = write(it->second.in_fd, data.data() + off,
+                      data.size() - off);
+    if (n > 0) {
+      off += static_cast<size_t>(n);
+    } else if (n < 0 && errno == EINTR) {
+      continue;
+    } else {
+      // EAGAIN: pipe full because the child isn't reading. Dropping with
+      // an explicit error beats wedging every proc in the container.
+      backpressure = true;
+      break;
+    }
+  }
+  if (backpressure)
+    emit("{\"event\": \"error\", \"id\": \"" + json_escape(id) +
+         "\", \"message\": \"stdin backpressure: child not reading (" +
+         std::to_string(data.size() - off) + " bytes dropped)\"}");
+  else
+    emit("{\"event\": \"stdin_ok\", \"id\": \"" + json_escape(id) + "\"}");
 }
 
 void do_signal(const std::string& line) {
@@ -211,7 +332,7 @@ void pump_fd(int fd) {
     auto it = fd_to_id.find(fd);
     if (it == fd_to_id.end()) continue;
     emit("{\"event\": \"stdout\", \"id\": \"" + json_escape(it->second) +
-         "\", \"data\": \"" + json_escape(std::string(buf, n)) + "\"}");
+         "\", \"data_b64\": \"" + b64_encode(buf, n) + "\"}");
   }
 }
 
@@ -227,6 +348,7 @@ void reap() {
       emit("{\"event\": \"exit\", \"id\": \"" + json_escape(it->first) +
            "\", \"code\": " + std::to_string(code) + "}");
       close(it->second.out_fd);
+      if (it->second.in_fd >= 0) close(it->second.in_fd);
       fd_to_id.erase(it->second.out_fd);
       procs.erase(it);
       break;
@@ -235,27 +357,108 @@ void reap() {
   }
 }
 
+bool g_shutdown = false;
+
+// returns false on a shutdown op
+bool handle_line(const std::string& line) {
+  std::string op = get_string(line, "op");
+  if (op == "spawn") do_spawn(line);
+  else if (op == "signal") do_signal(line);
+  else if (op == "stdin") do_stdin(line);
+  else if (op == "list") do_list();
+  else if (op == "shutdown") return false;
+  else if (!line.empty())
+    emit("{\"event\": \"error\", \"message\": \"unknown op\"}");
+  return true;
+}
+
+void on_term(int) { g_shutdown = true; }
+
 }  // namespace
 
-int main() {
+int main(int argc, char** argv) {
   signal(SIGPIPE, SIG_IGN);
-  emit("{\"event\": \"ready\", \"pid\": " + std::to_string(getpid()) + "}");
+  // PID 1 in a pid namespace ignores signals without handlers — install
+  // one so a container stop (SIGTERM from t9container) actually works
+  struct sigaction sa{};
+  sa.sa_handler = on_term;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+
+  const char* sock_path = nullptr;
+  for (int i = 1; i < argc - 1; i++)
+    if (strcmp(argv[i], "--sock") == 0) sock_path = argv[i + 1];
+
+  int listen_fd = -1;
+  int ctrl_fd = -1;                    // connected worker (sock mode)
+  if (sock_path != nullptr) {
+    unlink(sock_path);
+    listen_fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    struct sockaddr_un addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, sock_path, sizeof(addr.sun_path) - 1);
+    if (listen_fd < 0 ||
+        bind(listen_fd, reinterpret_cast<struct sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+        listen(listen_fd, 4) != 0) {
+      fprintf(stderr, "t9proc: socket %s: %s\n", sock_path,
+              strerror(errno));
+      return 111;
+    }
+    chmod(sock_path, 0666);
+    fprintf(stdout, "t9proc: pid1 ready on %s\n", sock_path);
+    fflush(stdout);
+    g_ctrl_out = STDOUT_FILENO;        // until a client connects
+  } else {
+    emit("{\"event\": \"ready\", \"pid\": " + std::to_string(getpid()) +
+         "}");
+  }
 
   std::string inbuf;
   char chunk[4096];
-  bool stdin_open = true;
-  while (stdin_open || !procs.empty()) {
+  bool stdin_open = (sock_path == nullptr);
+  // stdio mode exits when stdin closes and children drain; sock (PID-1)
+  // mode runs until SIGTERM
+  while (!g_shutdown &&
+         (sock_path != nullptr || stdin_open || !procs.empty())) {
     std::vector<pollfd> fds;
-    if (stdin_open) fds.push_back({STDIN_FILENO, POLLIN, 0});
+    int ctrl_in = -1;
+    if (sock_path != nullptr) {
+      if (ctrl_fd >= 0) {
+        ctrl_in = ctrl_fd;
+        fds.push_back({ctrl_fd, POLLIN, 0});
+      }
+      fds.push_back({listen_fd, POLLIN, 0});
+    } else if (stdin_open) {
+      ctrl_in = STDIN_FILENO;
+      fds.push_back({STDIN_FILENO, POLLIN, 0});
+    }
     for (auto& kv : procs) fds.push_back({kv.second.out_fd, POLLIN, 0});
     int rc = poll(fds.data(), fds.size(), 200);
     if (rc > 0) {
       for (auto& pfd : fds) {
         if (!(pfd.revents & (POLLIN | POLLHUP))) continue;
-        if (pfd.fd == STDIN_FILENO) {
-          ssize_t n = read(STDIN_FILENO, chunk, sizeof chunk);
+        if (sock_path != nullptr && pfd.fd == listen_fd) {
+          int c = accept(listen_fd, nullptr, nullptr);
+          if (c >= 0) {
+            if (ctrl_fd >= 0) close(ctrl_fd);  // newest client wins
+            ctrl_fd = c;
+            g_ctrl_out = c;
+            inbuf.clear();
+          }
+          continue;
+        }
+        if (pfd.fd == ctrl_in) {
+          ssize_t n = read(pfd.fd, chunk, sizeof chunk);
           if (n <= 0) {
-            stdin_open = false;
+            if (sock_path != nullptr) {
+              close(ctrl_fd);
+              ctrl_fd = -1;
+              g_ctrl_out = STDOUT_FILENO;   // drop events until reconnect
+            } else {
+              stdin_open = false;
+            }
             continue;
           }
           inbuf.append(chunk, n);
@@ -263,13 +466,10 @@ int main() {
           while ((nl = inbuf.find('\n')) != std::string::npos) {
             std::string line = inbuf.substr(0, nl);
             inbuf.erase(0, nl + 1);
-            std::string op = get_string(line, "op");
-            if (op == "spawn") do_spawn(line);
-            else if (op == "signal") do_signal(line);
-            else if (op == "list") do_list();
-            else if (op == "shutdown") { stdin_open = false; }
-            else if (!line.empty())
-              emit("{\"event\": \"error\", \"message\": \"unknown op\"}");
+            if (!handle_line(line)) {
+              if (sock_path == nullptr) stdin_open = false;
+              else g_shutdown = true;
+            }
           }
         } else {
           pump_fd(pfd.fd);
@@ -278,5 +478,8 @@ int main() {
     }
     reap();
   }
+  // PID-1 teardown: no child survives init
+  for (auto& kv : procs) kill(kv.second.pid, SIGKILL);
+  reap();
   return 0;
 }

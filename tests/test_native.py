@@ -86,7 +86,8 @@ def test_t9proc_spawn_reap_signal(built):
         spawned = read_until("spawned")
         assert spawned["id"] == "t1" and spawned["pid"] > 0
         out = read_until("stdout")
-        assert "hello-from-t9proc" in out["data"]
+        import base64
+        assert b"hello-from-t9proc" in base64.b64decode(out["data_b64"])
         assert read_until("exit")["code"] == 0
 
         # long-running child + signal

@@ -57,11 +57,10 @@ class PodService:
         env.update(self.runner_env)
         env["TPU9_TOKEN"] = await self.runner_tokens.get(stub.workspace_id)
         entrypoint = list(cfg.entrypoint)
-        if stub.stub_type == "sandbox" and not entrypoint:
-            # sandboxes idle until exec'd into
-            import sys
-            entrypoint = [sys.executable, "-c",
-                          "import time\nwhile True: time.sleep(3600)"]
+        # sandbox with no entrypoint stays EMPTY here: the worker lifecycle
+        # starts it under t9proc as PID 1 (supervised processes + zombie
+        # reaping — reference's goproc bind-mount, lifecycle.go:1299),
+        # falling back to an idle loop when the binary isn't built
         request = ContainerRequest(
             container_id=new_id("pod"),
             stub_id=stub.stub_id,

@@ -74,6 +74,27 @@ class SandboxAgent:
         self.snap_put = snap_put
         self.snap_get = snap_get
         self.procs: dict[str, SandboxProcess] = {}
+        self._t9proc: dict[str, "object"] = {}   # container_id -> client
+
+    T9PROC_SOCK = ".t9proc.sock"
+
+    async def _t9proc_client(self, container_id: str):
+        """Connect (once) to the container's PID-1 supervisor when the
+        lifecycle started it under t9proc; None → legacy exec path."""
+        client = self._t9proc.get(container_id)
+        if client is not None and client.connected:
+            return client
+        root = self.runtime.fs_root(container_id)
+        if not root:
+            return None
+        sock = os.path.join(root, self.T9PROC_SOCK)
+        if not os.path.exists(sock):
+            return None
+        from .t9proc_client import T9ProcClient
+        client = T9ProcClient(sock)
+        await client.connect()
+        self._t9proc[container_id] = client
+        return client
 
     # -- dispatch ------------------------------------------------------------
 
@@ -107,7 +128,15 @@ class SandboxAgent:
         if not cmd:
             return {"error": "empty command"}
         proc = SandboxProcess(new_id("sp"), container_id, cmd)
-        session = await self.runtime.exec_stream(container_id, cmd)
+        # PID-1 supervised path (t9proc, reference's goproc analogue):
+        # children are real children of the container's init — zombies are
+        # reaped, signals land inside the namespaces, and stdio is pipe-
+        # framed. Fallback: runtime exec (PTY) when no supervisor runs.
+        client = await self._t9proc_client(container_id)
+        if client is not None:
+            session = await client.spawn(cmd)
+        else:
+            session = await self.runtime.exec_stream(container_id, cmd)
         proc.session = session
         self.procs[proc.proc_id] = proc
         asyncio.create_task(self._pump_output(proc))
@@ -182,6 +211,9 @@ class SandboxAgent:
         for pid, proc in list(self.procs.items()):
             if proc.container_id == container_id:
                 self.procs.pop(pid, None)
+        client = self._t9proc.pop(container_id, None)
+        if client is not None:
+            asyncio.create_task(client.close())
 
     # -- filesystem ----------------------------------------------------------
 

@@ -1,0 +1,151 @@
+"""Worker-side client for t9proc-as-PID-1 sandbox containers.
+
+Reference analogue: the gRPC client the reference worker uses against
+goproc bind-mounted as sandbox PID 1 (``pkg/worker/lifecycle.go:1299-1325``
++ ``pkg/worker/sandbox.go:148``). tpu9's t9proc speaks newline-JSON over a
+unix socket on the container's rw workdir bind, so the worker reaches it
+across the netns boundary without any in-container networking.
+
+Each spawn yields a :class:`T9ProcSession` that duck-types the runtime's
+``ShellSession`` (output queue / write / close) — the SandboxAgent's
+process table, output pumps, and state-bus streams work unchanged whether
+a process runs under PID-1 supervision or a plain exec.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+from typing import Optional
+
+from ..runtime.base import ShellSession
+from ..types import new_id
+
+log = logging.getLogger("tpu9.worker")
+
+
+class T9ProcSession(ShellSession):
+    def __init__(self, client: "T9ProcClient", proc_id: str):
+        super().__init__()
+        self._client = client
+        self._proc_id = proc_id
+
+    async def write(self, data: bytes) -> None:
+        await self._client.send({"op": "stdin", "id": self._proc_id,
+                                 "data_b64": base64.b64encode(data).decode()})
+
+    def resize(self, rows: int, cols: int) -> None:
+        pass                         # pipes, not a PTY
+
+    async def close(self) -> None:
+        if self.exit_code is None:
+            await self._client.send({"op": "signal", "id": self._proc_id,
+                                     "signum": 9})
+
+
+class T9ProcClient:
+    """One connection per container; events are dispatched to sessions."""
+
+    def __init__(self, sock_path: str):
+        self.sock_path = sock_path
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._sessions: dict[str, T9ProcSession] = {}
+        self._spawned: dict[str, asyncio.Future] = {}
+        self._lock = asyncio.Lock()
+        self._dispatch_task: Optional[asyncio.Task] = None
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None and not self._writer.is_closing()
+
+    async def connect(self, timeout_s: float = 15.0) -> None:
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        last: Exception = RuntimeError("t9proc connect failed")
+        while asyncio.get_running_loop().time() < deadline:
+            try:
+                self._reader, self._writer = \
+                    await asyncio.open_unix_connection(self.sock_path)
+                self._dispatch_task = asyncio.create_task(self._dispatch())
+                return
+            except OSError as exc:   # socket not bound yet (t9proc booting)
+                last = exc
+                await asyncio.sleep(0.05)
+        raise last
+
+    async def send(self, obj: dict) -> None:
+        async with self._lock:
+            if not self.connected:
+                raise RuntimeError("t9proc disconnected")
+            self._writer.write(json.dumps(obj).encode() + b"\n")
+            await self._writer.drain()
+
+    async def spawn(self, cmd: list[str]) -> T9ProcSession:
+        proc_id = new_id("t9p")
+        session = T9ProcSession(self, proc_id)
+        self._sessions[proc_id] = session
+        fut = asyncio.get_running_loop().create_future()
+        self._spawned[proc_id] = fut
+        await self.send({"op": "spawn", "id": proc_id, "argv": cmd})
+        try:
+            await asyncio.wait_for(fut, 15.0)
+        finally:
+            self._spawned.pop(proc_id, None)
+        return session
+
+    async def _dispatch(self) -> None:
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                kind = ev.get("event", "")
+                pid = ev.get("id", "")
+                session = self._sessions.get(pid)
+                if kind == "spawned":
+                    fut = self._spawned.get(pid)
+                    if fut is not None and not fut.done():
+                        fut.set_result(ev.get("pid", 0))
+                elif kind == "error":
+                    fut = self._spawned.get(pid)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(
+                            RuntimeError(ev.get("message", "t9proc error")))
+                elif kind == "stdout" and session is not None:
+                    session.output.put_nowait(
+                        base64.b64decode(ev.get("data_b64", "")))
+                elif kind == "exit" and session is not None:
+                    session.exit_code = int(ev.get("code", -1))
+                    session.output.put_nowait(None)
+                    self._sessions.pop(pid, None)
+        except (ConnectionResetError, OSError) as exc:
+            log.debug("t9proc dispatch ended: %s", exc)
+        finally:
+            # container died / socket torn down: release all waiters
+            for session in list(self._sessions.values()):
+                if session.exit_code is None:
+                    session.exit_code = -1
+                session.output.put_nowait(None)
+            self._sessions.clear()
+            for fut in self._spawned.values():
+                if not fut.done():
+                    fut.set_exception(RuntimeError("t9proc disconnected"))
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:     # noqa: BLE001
+                pass
+        if self._dispatch_task is not None:
+            self._dispatch_task.cancel()
+            try:
+                await self._dispatch_task
+            except (asyncio.CancelledError, Exception):
+                pass
