@@ -1,0 +1,51 @@
+#!/bin/bash
+# Bake a tpu9 TPU-VM disk image (run from a workstation with gcloud auth).
+# Reference analogue: the reference's prebuilt worker AMIs/images its
+# providers boot (pkg/providers/provider.go:15-64).
+#
+# Usage: PROJECT=my-proj ZONE=us-central2-b ./build-image.sh v5p
+set -euo pipefail
+
+PROJECT="${PROJECT:?set PROJECT}"
+ZONE="${ZONE:?set ZONE}"
+GEN="${1:-v5e}"
+case "$GEN" in
+  v5e) RUNTIME=v2-alpha-tpuv5-lite; ACCEL=v5litepod-1 ;;
+  v5p) RUNTIME=v2-alpha-tpuv5;      ACCEL=v5p-8 ;;
+  v6e) RUNTIME=v2-alpha-tpuv6e;     ACCEL=v6e-1 ;;
+  *) echo "unknown generation $GEN"; exit 2 ;;
+esac
+NAME="tpu9-bake-$(date +%s)"
+
+gcloud compute tpus tpu-vm create "$NAME" \
+  --project="$PROJECT" --zone="$ZONE" \
+  --accelerator-type="$ACCEL" --version="$RUNTIME"
+
+tar -C "$(git rev-parse --show-toplevel)" -czf /tmp/tpu9.tar.gz \
+  --exclude='.git' --exclude='__pycache__' .
+gcloud compute tpus tpu-vm scp /tmp/tpu9.tar.gz "$NAME":/tmp/ \
+  --project="$PROJECT" --zone="$ZONE"
+
+gcloud compute tpus tpu-vm ssh "$NAME" --project="$PROJECT" --zone="$ZONE" \
+  --command='
+set -e
+sudo mkdir -p /opt/tpu9 && sudo tar -xzf /tmp/tpu9.tar.gz -C /opt/tpu9
+sudo python3 -m venv /opt/tpu9-venv
+sudo /opt/tpu9-venv/bin/pip install -U pip
+sudo /opt/tpu9-venv/bin/pip install "jax[tpu]" aiohttp numpy \
+  -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+sudo make -C /opt/tpu9/native
+# warm the XLA compile cache location the workers share
+sudo mkdir -p /var/cache/tpu9-xla && sudo chmod 1777 /var/cache/tpu9-xla
+'
+
+# snapshot the boot disk into a reusable image
+DISK="$(gcloud compute tpus tpu-vm describe "$NAME" --project="$PROJECT" \
+  --zone="$ZONE" --format='value(bootDisk.sourceDisk)' || true)"
+echo "TPU-VM $NAME provisioned. For single-host generations snapshot its"
+echo "boot disk into an image family 'tpu9-worker-$GEN'; multi-host slices"
+echo "re-run the startup script per host (images carry /opt/tpu9 + venv):"
+echo "  gcloud compute images create tpu9-worker-$GEN-$(date +%Y%m%d) \\"
+echo "    --source-disk=$DISK --family=tpu9-worker-$GEN --project=$PROJECT"
+echo "Then set worker_pools[].runtime_version to that image family."
+echo "Cleanup: gcloud compute tpus tpu-vm delete $NAME --zone=$ZONE"

@@ -1,0 +1,63 @@
+#!/bin/bash
+# tpu9 TPU-VM startup script (VERDICT r03 #10; reference analogue: the
+# provider VM userdata that boots a k3s worker, pkg/providers/ec2.go:93 +
+# pkg/scheduler/pool_provider.go:286).
+#
+# Runs on every host of a (multi-host) TPU slice at boot. Reads its join
+# parameters from instance/TPU metadata (set by GceTpuPool's
+# queued-resources call) and starts a tpu9 worker that registers with the
+# cluster's gateway, carrying its slice identity so the scheduler can gang-
+# place multi-host workloads.
+set -euo pipefail
+
+MD="http://metadata.google.internal/computeMetadata/v1"
+H="Metadata-Flavor: Google"
+
+md() { curl -sf -H "$H" "$MD/instance/attributes/$1" || echo ""; }
+
+GATEWAY_URL="$(md tpu9-gateway-url)"
+GATEWAY_STATE="$(md tpu9-gateway-state)"
+WORKER_TOKEN="$(md tpu9-worker-token)"
+POOL="$(md tpu9-pool)"
+SLICE_ID="$(md tpu9-slice-id)"
+SLICE_TOPOLOGY="$(md tpu9-slice-topology)"
+TPU_GEN="$(md tpu9-tpu-gen)"
+
+# per-host rank within the slice (multi-host slices run one worker/host)
+SLICE_RANK="$(curl -sf -H "$H" "$MD/instance/attributes/agent-worker-number" || echo 0)"
+SLICE_HOSTS="$(md tpu9-slice-hosts)"
+SLICE_HOSTS="${SLICE_HOSTS:-1}"
+
+# the baked image (see build-image.sh) ships /opt/tpu9 + a venv with
+# jax[tpu]; fall back to a metadata-supplied tarball for dev clusters
+if [ ! -d /opt/tpu9 ]; then
+  REPO_URL="$(md tpu9-repo-tarball)"
+  if [ -n "$REPO_URL" ]; then
+    mkdir -p /opt/tpu9
+    curl -sf "$REPO_URL" | tar -xz -C /opt/tpu9 --strip-components=1
+  else
+    echo "tpu9: no baked /opt/tpu9 and no tpu9-repo-tarball metadata" >&2
+    exit 1
+  fi
+fi
+
+# build the native pieces if the image didn't (idempotent)
+make -C /opt/tpu9/native >/dev/null 2>&1 || true
+
+cat > /etc/tpu9-worker.env <<ENV
+TPU9_GATEWAY_URL=${GATEWAY_URL}
+TPU9_GATEWAY_STATE=${GATEWAY_STATE}
+TPU9_WORKER_TOKEN=${WORKER_TOKEN}
+TPU9_POOL=${POOL}
+TPU9_SLICE_ID=${SLICE_ID}
+TPU9_SLICE_RANK=${SLICE_RANK}
+TPU9_SLICE_HOSTS=${SLICE_HOSTS}
+TPU9_SLICE_TOPOLOGY=${SLICE_TOPOLOGY}
+TPU9_TPU_GEN=${TPU_GEN}
+PYTHONPATH=/opt/tpu9
+ENV
+
+install -m 0644 /opt/tpu9/deploy/gcp/tpu9-worker.service \
+  /etc/systemd/system/tpu9-worker.service
+systemctl daemon-reload
+systemctl enable --now tpu9-worker.service
