@@ -1,0 +1,569 @@
+// t9cachefs — read-through FUSE view of content-addressed manifests,
+// speaking the kernel FUSE protocol directly (no libfuse dependency).
+//
+// Reference analogue: the embedded cache's FUSE CacheFS
+// (pkg/cache/cachefs.go:47, cachefs_node.go) and the CLIP lazy image
+// mount (pkg/worker/image.go:274). Those cover the readers tpu9's
+// LD_PRELOAD shims cannot: static binaries, direct syscalls, and mmap —
+// a page fault through this mount reads exactly the chunks it needs.
+//
+// Layout: the mounted tree is described by a chunk manifest (the same
+// JSON format images/volumes/snapshots already use — images/manifest.py):
+// every regular file is a sequence of sha256 chunks. Reads resolve chunks
+// against a local STORE directory (the worker cache's DiskStore layout,
+// <store>/<aa>/<hash>); a missing chunk triggers one round-trip on the
+// worker's fault socket ("CHUNK <digest>\n" → "OK\n" once the store has
+// it) — so cold pages stream from cache peers on demand.
+//
+// Invocation (trusted worker only):
+//   t9cachefs --manifest m.json --store DIR --mount MNT [--sock PATH]
+//             [--foreground]
+//
+// The mount uses allow_other + default_permissions so dropped-uid tenant
+// containers can read through bind mounts of MNT while the kernel
+// enforces the manifest's file modes.
+
+#include <cerrno>
+#include <cstdint>
+#include <dirent.h>
+#include <thread>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <linux/fuse.h>
+#include <sys/mount.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+
+namespace {
+
+[[noreturn]] void die(const char* what) {
+  fprintf(stderr, "t9cachefs: %s: %s\n", what, strerror(errno));
+  exit(111);
+}
+
+// ---- manifest model -------------------------------------------------------
+
+struct Node {
+  uint64_t ino = 0;
+  std::string name;
+  bool is_dir = false;
+  uint32_t mode = 0644;
+  uint64_t size = 0;
+  std::string link_target;               // symlink when non-empty
+  std::vector<std::string> chunks;       // regular files
+  uint32_t chunk_bytes = 4 * 1024 * 1024;
+  std::map<std::string, uint64_t> children;   // name -> ino (dirs)
+};
+
+std::vector<Node> g_nodes;               // index == ino (0 unused)
+std::string g_store;
+std::string g_sock;
+
+Node& node(uint64_t ino) { return g_nodes[ino]; }
+
+uint64_t new_node() {
+  g_nodes.emplace_back();
+  g_nodes.back().ino = g_nodes.size() - 1;
+  return g_nodes.size() - 1;
+}
+
+uint64_t ensure_dir(uint64_t parent, const std::string& name) {
+  auto it = node(parent).children.find(name);
+  if (it != node(parent).children.end()) return it->second;
+  uint64_t ino = new_node();
+  node(ino).name = name;
+  node(ino).is_dir = true;
+  node(ino).mode = 0755;
+  node(parent).children[name] = ino;
+  return ino;
+}
+
+// ---- tiny JSON scanning (same trusted-input stance as t9proc) -------------
+
+std::string read_file(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) die("open manifest");
+  std::string out;
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) out.append(buf, n);
+  fclose(f);
+  return out;
+}
+
+// decode a JSON string starting at the opening quote; advances i past the
+// closing quote. Handles \", \\, \/, \n, \t, \r and \uXXXX (→ UTF-8).
+std::string scan_string(const std::string& s, size_t& i) {
+  std::string out;
+  ++i;                                   // past opening quote
+  while (i < s.size() && s[i] != '"') {
+    char c = s[i];
+    if (c == '\\' && i + 1 < s.size()) {
+      char n = s[++i];
+      switch (n) {
+        case 'n': out += '\n'; break;
+        case 't': out += '\t'; break;
+        case 'r': out += '\r'; break;
+        case 'b': out += '\b'; break;
+        case 'f': out += '\f'; break;
+        case 'u': {
+          if (i + 4 < s.size()) {
+            unsigned cp = static_cast<unsigned>(
+                strtoul(s.substr(i + 1, 4).c_str(), nullptr, 16));
+            i += 4;
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+          }
+          break;
+        }
+        default: out += n;
+      }
+    } else {
+      out += c;
+    }
+    ++i;
+  }
+  ++i;                                   // past closing quote
+  return out;
+}
+
+void skip_ws(const std::string& s, size_t& i) {
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\n' || s[i] == '\t'
+                          || s[i] == '\r' || s[i] == ','))
+    ++i;
+}
+
+// parse ONE file object at s[i] (pointing at '{'): a real key-by-key scan
+// — find()-based extraction would let a crafted filename containing
+// escaped quotes shadow keys like "chunks" (content-injection risk) and
+// brace/escape content would desync the object boundaries
+void parse_file_object(const std::string& s, size_t& i, uint64_t root,
+                       uint32_t chunk_bytes) {
+  ++i;                                   // past '{'
+  std::string rel, link;
+  uint32_t mode = 0644;
+  uint64_t size = 0;
+  std::vector<std::string> chunks;
+  for (;;) {
+    skip_ws(s, i);
+    if (i >= s.size() || s[i] == '}') {
+      ++i;
+      break;
+    }
+    std::string key = scan_string(s, i);
+    skip_ws(s, i);
+    if (i < s.size() && s[i] == ':') ++i;
+    skip_ws(s, i);
+    if (s[i] == '"') {
+      std::string val = scan_string(s, i);
+      if (key == "path") rel = val;
+      else if (key == "link_target") link = val;
+    } else if (s[i] == '[') {
+      ++i;
+      for (;;) {
+        skip_ws(s, i);
+        if (i >= s.size() || s[i] == ']') {
+          ++i;
+          break;
+        }
+        if (s[i] == '"') {
+          std::string item = scan_string(s, i);
+          if (key == "chunks") chunks.push_back(item);
+        } else {
+          ++i;
+        }
+      }
+    } else {                             // number / literal
+      size_t start = i;
+      while (i < s.size() && s[i] != ',' && s[i] != '}') ++i;
+      long v = strtol(s.c_str() + start, nullptr, 10);
+      if (key == "mode") mode = static_cast<uint32_t>(v);
+      else if (key == "size") size = static_cast<uint64_t>(v);
+    }
+  }
+  if (rel.empty()) return;
+  uint64_t parent = root;
+  size_t start = 0, slash;
+  while ((slash = rel.find('/', start)) != std::string::npos) {
+    parent = ensure_dir(parent, rel.substr(start, slash - start));
+    start = slash + 1;
+  }
+  std::string name = rel.substr(start);
+  uint64_t ino = new_node();
+  Node& nd = node(ino);
+  nd.name = name;
+  nd.mode = mode;
+  nd.size = size;
+  nd.link_target = link;
+  nd.chunks = std::move(chunks);
+  nd.chunk_bytes = chunk_bytes;
+  node(parent).children[name] = ino;
+}
+
+void load_manifest(const std::string& path) {
+  std::string blob = read_file(path);
+  new_node();                            // ino 0 unused
+  uint64_t root = new_node();            // ino 1 = root
+  node(root).is_dir = true;
+  node(root).mode = 0755;
+
+  // walk the TOP-LEVEL object properly (string-aware, depth-tracked) to
+  // find the real "files" key and "chunk_bytes" — a tenant env value that
+  // happens to contain '"files"' must not derail the parse
+  uint32_t chunk_bytes = 4 * 1024 * 1024;
+  size_t files_at = std::string::npos;
+  size_t i = blob.find('{');
+  if (i == std::string::npos) die("manifest is not JSON");
+  ++i;
+  int depth = 1;
+  while (i < blob.size() && depth >= 1) {
+    skip_ws(blob, i);
+    if (i >= blob.size()) break;
+    char c = blob[i];
+    if (c == '}') { depth--; ++i; continue; }
+    if (c == '{') { depth++; ++i; continue; }
+    if (c == '[') { ++i; continue; }
+    if (c == ']') { ++i; continue; }
+    if (c == '"') {
+      std::string str = scan_string(blob, i);
+      skip_ws(blob, i);
+      bool is_key = i < blob.size() && blob[i] == ':';
+      if (!is_key) continue;
+      ++i;                               // past ':'
+      skip_ws(blob, i);
+      if (depth == 1 && str == "chunk_bytes") {
+        chunk_bytes = static_cast<uint32_t>(
+            strtol(blob.c_str() + i, nullptr, 10));
+      } else if (depth == 1 && str == "files" && blob[i] == '[') {
+        files_at = i;
+        break;
+      }
+      continue;
+    }
+    ++i;                                 // number/literal char
+  }
+  if (files_at == std::string::npos) die("manifest has no files array");
+
+  i = files_at + 1;                      // past '['
+  for (;;) {
+    skip_ws(blob, i);
+    if (i >= blob.size() || blob[i] == ']') break;
+    if (blob[i] == '{') parse_file_object(blob, i, root, chunk_bytes);
+    else ++i;
+  }
+}
+
+// ---- chunk resolution -----------------------------------------------------
+
+std::string chunk_path(const std::string& digest) {
+  // DiskStore layout: <store>/<first2>/<digest>
+  return g_store + "/" + digest.substr(0, 2) + "/" + digest;
+}
+
+bool fault_chunk(const std::string& digest) {
+  if (g_sock.empty()) return false;
+  int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return false;
+  // bounded round-trip: a hung fault server must surface as EIO to the
+  // reader, never wedge the FUSE request (and with it the mount) forever
+  struct timeval tv;
+  tv.tv_sec = 30;
+  tv.tv_usec = 0;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  struct sockaddr_un addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, g_sock.c_str(), sizeof(addr.sun_path) - 1);
+  bool ok = false;
+  if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) == 0) {
+    std::string req = "CHUNK " + digest + "\n";
+    if (write(fd, req.data(), req.size()) ==
+        static_cast<ssize_t>(req.size())) {
+      char buf[16];
+      ssize_t n = read(fd, buf, sizeof(buf) - 1);
+      ok = n >= 2 && strncmp(buf, "OK", 2) == 0;
+    }
+  }
+  close(fd);
+  return ok;
+}
+
+// read [off, off+want) of a manifest file into out; returns bytes or -errno
+ssize_t read_node(const Node& nd, uint64_t off, uint32_t want, char* out) {
+  if (off >= nd.size) return 0;
+  if (off + want > nd.size) want = static_cast<uint32_t>(nd.size - off);
+  uint32_t done = 0;
+  while (done < want) {
+    uint64_t pos = off + done;
+    size_t ci = pos / nd.chunk_bytes;
+    uint64_t coff = pos % nd.chunk_bytes;
+    if (ci >= nd.chunks.size()) break;
+    const std::string& digest = nd.chunks[ci];
+    std::string path = chunk_path(digest);
+    int fd = open(path.c_str(), O_RDONLY | O_CLOEXEC);
+    if (fd < 0) {
+      if (!fault_chunk(digest)) return -EIO;
+      fd = open(path.c_str(), O_RDONLY | O_CLOEXEC);
+      if (fd < 0) return -EIO;
+    }
+    ssize_t n = pread(fd, out + done, want - done,
+                      static_cast<off_t>(coff));
+    close(fd);
+    if (n < 0) return -errno;
+    if (n == 0) break;                   // short chunk (last one)
+    done += static_cast<uint32_t>(n);
+    if (coff + static_cast<uint64_t>(n) >= nd.chunk_bytes) continue;
+    if (done < want && pos + static_cast<uint64_t>(n) < nd.size &&
+        ci + 1 < nd.chunks.size()) {
+      // short read inside a chunk that is not the last: the store file
+      // is truncated/corrupt — better loud than zeros
+      return -EIO;
+    }
+  }
+  return done;
+}
+
+// ---- FUSE protocol --------------------------------------------------------
+
+int g_fuse_fd = -1;
+
+void fill_attr(const Node& nd, struct fuse_attr* a) {
+  memset(a, 0, sizeof(*a));
+  a->ino = nd.ino;
+  a->size = nd.link_target.empty() ? nd.size : nd.link_target.size();
+  a->blocks = (nd.size + 511) / 512;
+  a->mode = nd.link_target.empty()
+                ? ((nd.is_dir ? S_IFDIR : S_IFREG) | (nd.mode & 07777))
+                : (S_IFLNK | 0777);
+  a->nlink = 1;
+  a->blksize = 4096;
+}
+
+void reply(uint64_t unique, int32_t error, const void* data, size_t n) {
+  struct fuse_out_header h;
+  h.len = static_cast<uint32_t>(sizeof(h) + n);
+  h.error = error;
+  h.unique = unique;
+  struct iovec {
+    const void* base;
+    size_t len;
+  };
+  // writev without <sys/uio.h> struct mismatch: build one buffer
+  std::string buf(reinterpret_cast<char*>(&h), sizeof(h));
+  if (n) buf.append(reinterpret_cast<const char*>(data), n);
+  if (write(g_fuse_fd, buf.data(), buf.size()) < 0 && errno != ENOENT) {
+    // ENOENT = request interrupted; anything else is fatal for the mount
+    if (errno != EINTR) die("fuse write");
+  }
+}
+
+void reply_entry(uint64_t unique, const Node& nd) {
+  struct fuse_entry_out e;
+  memset(&e, 0, sizeof(e));
+  e.nodeid = nd.ino;
+  e.attr_valid = 3600;
+  e.entry_valid = 3600;
+  fill_attr(nd, &e.attr);
+  reply(unique, 0, &e, sizeof(e));
+}
+
+void serve() {
+  // must exceed the negotiated max_write by at least one page of header
+  // space or the kernel rejects the read with EINVAL
+  std::vector<char> buf((1 << 20) + 65536);
+  for (;;) {
+    ssize_t n = read(g_fuse_fd, buf.data(), buf.size());
+    if (n < 0) {
+      if (errno == EINTR || errno == EAGAIN) continue;
+      if (errno == ENODEV) return;       // unmounted
+      die("fuse read");
+    }
+    auto* in = reinterpret_cast<struct fuse_in_header*>(buf.data());
+    char* arg = buf.data() + sizeof(*in);
+    switch (in->opcode) {
+      case FUSE_INIT: {
+        auto* ii = reinterpret_cast<struct fuse_init_in*>(arg);
+        struct fuse_init_out out;
+        memset(&out, 0, sizeof(out));
+        out.major = FUSE_KERNEL_VERSION;
+        out.minor = FUSE_KERNEL_MINOR_VERSION < ii->minor
+                        ? FUSE_KERNEL_MINOR_VERSION
+                        : ii->minor;
+        out.max_readahead = 1 << 20;
+        out.max_write = 1 << 20;
+        reply(in->unique, 0, &out, sizeof(out));
+        break;
+      }
+      case FUSE_GETATTR: {
+        if (in->nodeid >= g_nodes.size()) {
+          reply(in->unique, -ENOENT, nullptr, 0);
+          break;
+        }
+        struct fuse_attr_out out;
+        memset(&out, 0, sizeof(out));
+        out.attr_valid = 3600;
+        fill_attr(node(in->nodeid), &out.attr);
+        reply(in->unique, 0, &out, sizeof(out));
+        break;
+      }
+      case FUSE_LOOKUP: {
+        std::string name(arg);
+        if (in->nodeid >= g_nodes.size() || !node(in->nodeid).is_dir) {
+          reply(in->unique, -ENOENT, nullptr, 0);
+          break;
+        }
+        auto& ch = node(in->nodeid).children;
+        auto it = ch.find(name);
+        if (it == ch.end()) reply(in->unique, -ENOENT, nullptr, 0);
+        else reply_entry(in->unique, node(it->second));
+        break;
+      }
+      case FUSE_READLINK: {
+        const Node& nd = node(in->nodeid);
+        if (nd.link_target.empty()) reply(in->unique, -EINVAL, nullptr, 0);
+        else reply(in->unique, 0, nd.link_target.data(),
+                   nd.link_target.size());
+        break;
+      }
+      case FUSE_OPEN:
+      case FUSE_OPENDIR: {
+        struct fuse_open_out out;
+        memset(&out, 0, sizeof(out));
+        out.open_flags = FOPEN_KEEP_CACHE;
+        reply(in->unique, 0, &out, sizeof(out));
+        break;
+      }
+      case FUSE_READ: {
+        auto* ri = reinterpret_cast<struct fuse_read_in*>(arg);
+        const Node& nd = node(in->nodeid);
+        std::vector<char> out(ri->size);
+        ssize_t got = read_node(nd, ri->offset, ri->size, out.data());
+        if (got < 0) reply(in->unique, static_cast<int32_t>(got),
+                           nullptr, 0);
+        else reply(in->unique, 0, out.data(), got);
+        break;
+      }
+      case FUSE_READDIR: {
+        auto* ri = reinterpret_cast<struct fuse_read_in*>(arg);
+        const Node& nd = node(in->nodeid);
+        std::string out;
+        uint64_t idx = 0;
+        for (auto& kv : nd.children) {
+          idx++;
+          if (idx <= ri->offset) continue;
+          const Node& c = node(kv.second);
+          size_t entlen = FUSE_NAME_OFFSET + kv.first.size();
+          size_t padded = FUSE_DIRENT_ALIGN(entlen);
+          if (out.size() + padded > ri->size) break;
+          struct fuse_dirent d;
+          d.ino = c.ino;
+          d.off = idx;
+          d.namelen = kv.first.size();
+          d.type = c.is_dir ? DT_DIR
+                            : (c.link_target.empty() ? DT_REG : DT_LNK);
+          out.append(reinterpret_cast<char*>(&d), FUSE_NAME_OFFSET);
+          out.append(kv.first);
+          out.append(padded - entlen, '\0');
+        }
+        reply(in->unique, 0, out.data(), out.size());
+        break;
+      }
+      case FUSE_STATFS: {
+        struct fuse_statfs_out out;
+        memset(&out, 0, sizeof(out));
+        out.st.namelen = 255;
+        out.st.bsize = 4096;
+        reply(in->unique, 0, &out, sizeof(out));
+        break;
+      }
+      case FUSE_RELEASE:
+      case FUSE_RELEASEDIR:
+      case FUSE_FLUSH:
+        reply(in->unique, 0, nullptr, 0);
+        break;
+      case FUSE_FORGET:
+      case FUSE_BATCH_FORGET:
+        break;                           // no reply by protocol
+      case FUSE_ACCESS:
+        reply(in->unique, 0, nullptr, 0);
+        break;
+      default:
+        reply(in->unique, -ENOSYS, nullptr, 0);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string manifest, mount_point;
+  bool foreground = false;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) { fprintf(stderr, "missing value\n"); exit(2); }
+      return argv[++i];
+    };
+    if (a == "--manifest") manifest = next();
+    else if (a == "--store") g_store = next();
+    else if (a == "--mount") mount_point = next();
+    else if (a == "--sock") g_sock = next();
+    else if (a == "--foreground") foreground = true;
+  }
+  if (manifest.empty() || g_store.empty() || mount_point.empty()) {
+    fprintf(stderr, "usage: t9cachefs --manifest M --store DIR --mount MNT"
+                    " [--sock PATH] [--foreground]\n");
+    return 2;
+  }
+  load_manifest(manifest);
+
+  g_fuse_fd = open("/dev/fuse", O_RDWR | O_CLOEXEC);
+  if (g_fuse_fd < 0) die("open /dev/fuse");
+  char opts[256];
+  snprintf(opts, sizeof opts,
+           "fd=%d,rootmode=40755,user_id=0,group_id=0,allow_other,"
+           "default_permissions",
+           g_fuse_fd);
+  if (mount("t9cachefs", mount_point.c_str(), "fuse.t9cachefs",
+            MS_NOSUID | MS_NODEV | MS_RDONLY, opts) != 0)
+    die("mount");
+
+  if (!foreground) {
+    // detach: the worker supervises by mountpoint, not pid
+    if (fork() != 0) return 0;
+    setsid();
+  }
+  printf("t9cachefs: serving %zu nodes at %s\n", g_nodes.size() - 2,
+         mount_point.c_str());
+  fflush(stdout);
+  // multithreaded dispatch (the kernel load-balances requests across
+  // /dev/fuse readers): a cold chunk fault blocking one thread must not
+  // stall warm reads from other containers sharing the mount. The node
+  // tree is read-only after load; each reply is a single write(2), which
+  // /dev/fuse treats atomically.
+  std::vector<std::thread> workers;
+  for (int t = 0; t < 3; t++) workers.emplace_back(serve);
+  serve();
+  for (auto& th : workers) th.join();
+  return 0;
+}

@@ -1,0 +1,176 @@
+"""CacheFS: FUSE read-through views of chunk manifests.
+
+Reference analogue: ``pkg/cache/cachefs.go:47`` — the reference mounts a
+FUSE filesystem whose reads pull content from the embedded distributed
+cache. tpu9's mount daemon is ``native/t9cachefs`` (speaks the kernel
+FUSE protocol directly, no libfuse); this manager owns its lifecycle and
+serves its chunk-fault socket: when the filesystem needs a chunk that is
+not yet in the node's DiskStore, it sends ``CHUNK <digest>`` here and the
+CacheClient pulls it (local → HRW peers → source) before the read
+resumes.
+
+This covers the readers the LD_PRELOAD shims cannot: static binaries,
+mmap, direct syscalls — page faults stream exactly the chunks touched.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import time
+from typing import Optional
+
+from ..images.manifest import ImageManifest
+from .client import CacheClient
+
+log = logging.getLogger("tpu9.cache")
+
+_BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "build", "t9cachefs")
+
+
+class CacheFsMount:
+    def __init__(self, mountpoint: str, proc: subprocess.Popen,
+                 server: asyncio.AbstractServer, sock_path: str,
+                 manifest_path: str):
+        self.mountpoint = mountpoint
+        self._proc = proc
+        self._server = server
+        self._sock_path = sock_path
+        self._manifest_path = manifest_path
+        self.stats = {"faults": 0, "fault_failures": 0}
+
+    async def unmount(self) -> None:
+        subprocess.run(["umount", self.mountpoint], capture_output=True)
+        try:
+            self._proc.kill()
+        except ProcessLookupError:
+            pass
+        self._server.close()
+        try:
+            await self._server.wait_closed()
+        except Exception:          # noqa: BLE001
+            pass
+        for p in (self._sock_path, self._manifest_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+class CacheFsManager:
+    def __init__(self, cache: CacheClient, work_dir: str):
+        self.cache = cache
+        self.work_dir = work_dir
+        os.makedirs(work_dir, exist_ok=True)
+        self._mounts: dict[str, CacheFsMount] = {}
+
+    @staticmethod
+    def supported() -> bool:
+        return (os.path.exists("/dev/fuse") and os.path.exists(_BIN)
+                and os.geteuid() == 0)
+
+    async def mount(self, manifest: ImageManifest,
+                    mountpoint: str) -> CacheFsMount:
+        os.makedirs(mountpoint, exist_ok=True)
+        tag = manifest.image_id or manifest.manifest_hash[:12]
+        manifest_path = os.path.join(self.work_dir, f"{tag}.manifest.json")
+        with open(manifest_path, "w") as f:
+            f.write(manifest.to_json())
+        sock_path = os.path.join(self.work_dir, f"{tag}.fault.sock")
+        try:
+            os.unlink(sock_path)
+        except OSError:
+            pass
+
+        mount: Optional[CacheFsMount] = None
+
+        async def serve_fault(reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    parts = line.decode(errors="replace").split()
+                    if len(parts) != 2 or parts[0] != "CHUNK":
+                        writer.write(b"ERR\n")
+                        await writer.drain()
+                        continue
+                    # get() stores the chunk in the DiskStore on the way
+                    # through — exactly where t9cachefs rereads it
+                    data = await self.cache.get(parts[1])
+                    if mount is not None:
+                        mount.stats["faults"] += 1
+                        if data is None:
+                            mount.stats["fault_failures"] += 1
+                    writer.write(b"OK\n" if data is not None else b"ERR\n")
+                    await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:      # noqa: BLE001
+                    pass
+
+        server = await asyncio.start_unix_server(serve_fault,
+                                                 path=sock_path)
+        os.chmod(sock_path, 0o666)
+
+        proc = subprocess.Popen(
+            [_BIN, "--manifest", manifest_path,
+             "--store", self.cache.store.root,
+             "--mount", mountpoint, "--sock", sock_path, "--foreground"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        # wait for the mount to go live: a mounted FUSE root is a DIFFERENT
+        # device than its parent directory (statfs fields are too generic
+        # to distinguish reliably)
+        def _fail_cleanup() -> None:
+            # leave NOTHING behind: a live mount at the bundle path would
+            # wedge every later pull of this image (rmtree can't remove a
+            # read-only mount, rename next to it gets EBUSY)
+            subprocess.run(["umount", "-l", mountpoint],
+                           capture_output=True)
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+            server.close()
+            for p in (sock_path, manifest_path):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+        parent_dev = os.stat(os.path.dirname(mountpoint.rstrip("/"))
+                             or "/").st_dev
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                err = (proc.stderr.read() or b"").decode(errors="replace")
+                _fail_cleanup()
+                raise RuntimeError(f"t9cachefs died: {err.strip()}")
+            try:
+                if os.stat(mountpoint).st_dev != parent_dev:
+                    break
+            except OSError:
+                pass
+            await asyncio.sleep(0.02)
+        else:
+            _fail_cleanup()
+            raise RuntimeError("t9cachefs mount did not come up")
+
+        mount = CacheFsMount(mountpoint, proc, server, sock_path,
+                             manifest_path)
+        self._mounts[mountpoint] = mount
+        log.info("cachefs: %d files mounted at %s", len(manifest.files),
+                 mountpoint)
+        return mount
+
+    async def close(self) -> None:
+        for mount in list(self._mounts.values()):
+            await mount.unmount()
+        self._mounts.clear()

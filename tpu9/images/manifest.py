@@ -39,6 +39,9 @@ class ImageManifest:
     # under rootfs/ (runc chroots into it — decided at build time, never
     # inferred from directory layout)
     kind: str = "env"
+    # chunking granularity the files were split at — readers that seek
+    # (t9cachefs page faults) need it to map offsets to chunk indices
+    chunk_bytes: int = DEFAULT_CHUNK
 
     def to_json(self) -> str:
         return json.dumps({
@@ -47,6 +50,7 @@ class ImageManifest:
             "env": self.env,
             "total_bytes": self.total_bytes,
             "kind": self.kind,
+            "chunk_bytes": self.chunk_bytes,
             "files": [{"path": f.path, "mode": f.mode, "size": f.size,
                        "chunks": f.chunks, "link_target": f.link_target}
                       for f in self.files],
@@ -61,6 +65,7 @@ class ImageManifest:
             env=d.get("env", {}),
             total_bytes=d.get("total_bytes", 0),
             kind=d.get("kind", "env"),
+            chunk_bytes=d.get("chunk_bytes", DEFAULT_CHUNK),
             files=[FileEntry(**f) for f in d.get("files", [])],
         )
 
@@ -77,7 +82,7 @@ def snapshot_dir(root: str, chunk_bytes: int = DEFAULT_CHUNK,
                  put_chunk=None) -> ImageManifest:
     """Walk ``root`` and build a manifest; ``put_chunk(data, digest)`` stores
     each chunk (sync callback so the walk can run in a thread)."""
-    manifest = ImageManifest()
+    manifest = ImageManifest(chunk_bytes=chunk_bytes)
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames.sort()
         for fn in sorted(filenames):

@@ -32,16 +32,23 @@ LAZY_THRESHOLD_BYTES = 64 * 1024 * 1024
 class ImagePuller:
     def __init__(self, cache: CacheClient, bundles_dir: str,
                  manifest_fetch=None,
-                 lazy_threshold: int = LAZY_THRESHOLD_BYTES):
-        """``manifest_fetch(image_id) -> ImageManifest | None`` (async)."""
+                 lazy_threshold: int = LAZY_THRESHOLD_BYTES,
+                 fusefs=None):
+        """``manifest_fetch(image_id) -> ImageManifest | None`` (async).
+        ``fusefs`` (a CacheFsManager) enables lazy OCI rootfs serving:
+        the bundle becomes a FUSE read-through mount that overlayfs uses
+        as its lowerdir, so container starts never wait for the rootfs
+        and page faults stream exactly the chunks touched."""
         self.cache = cache
         self.bundles_dir = bundles_dir
         self.manifest_fetch = manifest_fetch
         self.lazy_threshold = lazy_threshold
+        self.fusefs = fusefs
         os.makedirs(bundles_dir, exist_ok=True)
         self._locks: dict[str, asyncio.Lock] = {}
         self._refs: dict[str, int] = {}
         self._fills: dict[str, LazyFill] = {}
+        self._fuse_mounts: dict[str, object] = {}
 
     def bundle_path(self, image_id: str) -> str:
         return os.path.join(self.bundles_dir, image_id)
@@ -79,6 +86,9 @@ class ImagePuller:
         async with lock:
             dest = self.bundle_path(image_id)
             done_marker = os.path.join(dest, ".tpu9-complete")
+            if image_id in self._fuse_mounts:
+                self._refs[image_id] = self._refs.get(image_id, 0) + 1
+                return dest
             if os.path.exists(done_marker):
                 self._refs[image_id] = self._refs.get(image_id, 0) + 1
                 return dest
@@ -92,6 +102,17 @@ class ImagePuller:
                 manifest = await self.manifest_fetch(image_id)
                 if manifest is None:
                     raise IOError(f"image {image_id} not found")
+
+            # OCI rootfs: lazy = a FUSE read-through mount (overlay
+            # lowerdir); the open-gating skeleton trick can't work under a
+            # mounted overlay, but CacheFS covers every reader incl. mmap
+            if (manifest.kind == "oci" and self.fusefs is not None
+                    and manifest.total_bytes >= self.lazy_threshold
+                    and lazy is not False):
+                mount = await self._mount_oci(image_id, manifest, dest)
+                if mount is not None:
+                    self._refs[image_id] = self._refs.get(image_id, 0) + 1
+                    return dest
 
             if lazy is None:
                 # env-kind bundles only: their host paths are what the
@@ -147,17 +168,55 @@ class ImagePuller:
             # runtime metadata the lifecycle reads when wiring the container
             import json
             with open(os.path.join(tmp, ".tpu9-env.json"), "w") as f:
-                json.dump({"env": manifest.env,
-                           "python_version": manifest.python_version,
-                           "kind": manifest.kind}, f)
+                json.dump(self.runtime_meta(manifest), f)
             with open(os.path.join(tmp, ".tpu9-complete"), "w") as f:
                 f.write(manifest.manifest_hash)
+            # a crashed worker may have left a FUSE mount at dest — rmtree
+            # can't remove a live mount and the rename would get EBUSY
+            import subprocess
+            subprocess.run(["umount", "-l", dest], capture_output=True)
             shutil.rmtree(dest, ignore_errors=True)
             os.rename(tmp, dest)
             self._refs[image_id] = self._refs.get(image_id, 0) + 1
             log.info("pulled %s: %d files, %d chunks", image_id,
                      len(manifest.files), len(chunks))
             return dest
+
+    @staticmethod
+    def runtime_meta(manifest: ImageManifest) -> dict:
+        """The .tpu9-env.json payload the lifecycle reads at container
+        start — ONE definition for the eager and FUSE paths."""
+        return {"env": manifest.env,
+                "python_version": manifest.python_version,
+                "kind": manifest.kind}
+
+    async def _mount_oci(self, image_id: str, manifest: ImageManifest,
+                         dest: str):
+        """FUSE-mount an OCI manifest at the bundle path. The runtime
+        metadata file the lifecycle reads (.tpu9-env.json) is synthesized
+        into the manifest as a content chunk so it exists inside the
+        read-only mount."""
+        import hashlib
+        import json as _json
+
+        from .manifest import FileEntry
+        meta = _json.dumps(self.runtime_meta(manifest)).encode()
+        digest = hashlib.sha256(meta).hexdigest()
+        await self.cache.put(meta, digest)
+        manifest = ImageManifest.from_json(manifest.to_json())  # copy
+        manifest.files.append(FileEntry(
+            path=".tpu9-env.json", mode=0o644, size=len(meta),
+            chunks=[digest]))
+        try:
+            mount = await self.fusefs.mount(manifest, dest)
+        except Exception as exc:      # noqa: BLE001 — fall back to eager
+            log.warning("cachefs mount for %s failed (%s); eager pull",
+                        image_id, exc)
+            return None
+        self._fuse_mounts[image_id] = mount
+        log.info("lazy OCI mount %s: %d files / %.1f MB served on demand",
+                 image_id, len(manifest.files), manifest.total_bytes / 1e6)
+        return mount
 
     def release(self, image_id: str) -> None:
         if image_id in self._refs:
@@ -167,9 +226,18 @@ class ImagePuller:
         for fill in list(self._fills.values()):
             await fill.close()
         self._fills.clear()
+        for image_id, mount in list(self._fuse_mounts.items()):
+            try:
+                await mount.unmount()
+            except Exception:         # noqa: BLE001
+                pass
+        self._fuse_mounts.clear()
 
     async def gc(self, keep: int = 4) -> int:
-        """Drop unreferenced bundles beyond ``keep`` most-recent."""
+        """Drop unreferenced bundles beyond ``keep`` most-recent. FUSE
+        mounts with zero live containers count as candidates too —
+        otherwise a long-lived worker accumulates one daemon + kernel
+        mount per large OCI image forever."""
         entries = []
         for name in os.listdir(self.bundles_dir):
             p = self.bundle_path(name)
@@ -181,6 +249,12 @@ class ImagePuller:
         entries.sort(reverse=True)
         removed = 0
         for _mtime, name in entries[keep:]:
+            mount = self._fuse_mounts.pop(name, None)
+            if mount is not None:
+                try:
+                    await mount.unmount()
+                except Exception:     # noqa: BLE001 — lazy umount below
+                    pass
             shutil.rmtree(self.bundle_path(name), ignore_errors=True)
             self._refs.pop(name, None)
             removed += 1

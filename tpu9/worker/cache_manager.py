@@ -35,12 +35,22 @@ class WorkerCache:
         self.server = ChunkServer(self.store, port=cfg.port)
         self.client = CacheClient(self.store, self._peers, source=source,
                                   replicas=cfg.replicas)
+        fusefs = None
+        try:
+            from ..cache.fusefs import CacheFsManager
+            if CacheFsManager.supported():
+                fusefs = CacheFsManager(
+                    self.client, os.path.join(cfg.data_dir, "fuse"))
+        except Exception:     # noqa: BLE001 — FUSE is strictly optional
+            fusefs = None
+        self.fusefs = fusefs
         self.puller = ImagePuller(self.client,
                                   bundles_dir or os.path.join(cfg.data_dir,
                                                               "bundles"),
                                   manifest_fetch=manifest_fetch,
                                   lazy_threshold=cfg.lazy_threshold_mb
-                                  * 1024 * 1024)
+                                  * 1024 * 1024,
+                                  fusefs=fusefs)
 
     async def _peers(self) -> list[str]:
         out = []

@@ -80,6 +80,9 @@ class ContainerLifecycle:
         self.criu = None
         # container -> [(workspace_id, volume_name, local_dir)] to push back
         self._synced_volumes: dict[str, list[tuple[str, str, str]]] = {}
+        # bundle runtime metadata pre-read off the loop in _prepare_image
+        # (a CacheFS-backed bundle read can fault through this very loop)
+        self._env_meta: dict[str, dict] = {}
         self.checkpoints = checkpoints   # Optional[CheckpointManager]
         self.phase_cb = phase_cb
         self._active: dict[str, asyncio.Task] = {}
@@ -352,6 +355,23 @@ class ContainerLifecycle:
         cache) plugs in through image_resolver."""
         if request.image_id and self.image_resolver:
             rootfs = await self.image_resolver(request.image_id)
+            # pre-read the bundle's runtime metadata OFF the event loop:
+            # for a CacheFS-mounted bundle this read may page-fault a
+            # chunk whose fetch is served BY this loop — a blocking read
+            # here would deadlock the whole worker
+            meta_path = os.path.join(rootfs, ".tpu9-env.json") \
+                if rootfs else ""
+            if meta_path and await asyncio.to_thread(os.path.exists,
+                                                     meta_path):
+                def _read_meta() -> dict:
+                    with open(meta_path) as f:
+                        return json.load(f)
+                try:
+                    self._env_meta[request.container_id] = \
+                        await asyncio.to_thread(_read_meta)
+                except (OSError, ValueError) as exc:
+                    log.warning("image metadata read failed for %s: %s",
+                                request.container_id, exc)
             puller = getattr(self, "image_puller", None)
             if puller is not None and not os.path.exists(
                     self._lazy_so_path()):
@@ -461,12 +481,17 @@ class ContainerLifecycle:
         env = dict(request.env)
         image_site = ""
         if rootfs:
-            # env-snapshot image bundles ship runtime metadata (puller writes
-            # .tpu9-env.json); apply image env under the request's env
-            meta_path = os.path.join(rootfs, ".tpu9-env.json")
-            if os.path.exists(meta_path):
-                with open(meta_path) as f:
-                    meta = json.load(f)
+            # image bundles ship runtime metadata (.tpu9-env.json); apply
+            # image env under the request's env. Pre-read by
+            # _prepare_image OFF the event loop — a CacheFS-backed bundle
+            # read here could fault through the very loop this runs on.
+            meta = self._env_meta.pop(request.container_id, None)
+            if meta is None:
+                meta_path = os.path.join(rootfs, ".tpu9-env.json")
+                if os.path.exists(meta_path):
+                    with open(meta_path) as f:
+                        meta = json.load(f)
+            if meta:
                 for k, v in meta.get("env", {}).items():
                     env.setdefault(k, v)
                 site_rel = meta.get("env", {}).get("TPU9_IMAGE_SITE",
