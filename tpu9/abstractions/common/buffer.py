@@ -46,6 +46,35 @@ class ForwardResult:
     container_id: str = ""
 
 
+class StreamHandle:
+    """A container response relayed incrementally (SSE token streams,
+    chunked downloads). Holds the container's concurrency token and the
+    buffer's demand signal until closed — the autoscaler must not scale
+    the serving container away mid-stream."""
+
+    def __init__(self, resp, container_id: str, release):
+        self._resp = resp
+        self.container_id = container_id
+        self._release = release
+        self.status = resp.status
+        self.headers = list(resp.headers.items())
+        self._closed = False
+
+    async def iter_chunks(self):
+        async for chunk in self._resp.content.iter_any():
+            yield chunk
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._resp.close()
+        except Exception:      # noqa: BLE001
+            pass
+        await self._release()
+
+
 class RequestBuffer:
     def __init__(self, stub: Stub, containers: ContainerRepository,
                  request_timeout_s: float = 180.0, router=None, dialer=None):
@@ -128,6 +157,52 @@ class RequestBuffer:
 
     def _dec_open(self) -> None:
         self._open -= 1
+
+    async def forward_stream(self, method: str = "POST", path: str = "/",
+                             headers=None, body: bytes = b""):
+        """Streaming forward: returns a :class:`StreamHandle` whose chunks
+        arrive as the container produces them (LLM token streams), or a
+        :class:`ForwardResult` on admission/connect failure. The caller
+        MUST ``close()`` the handle (token + demand are held until then)."""
+        from multidict import CIMultiDict
+        # demand registers BEFORE admission: scale-from-zero only triggers
+        # if the autoscaler can see this request waiting (same contract as
+        # the buffered path and _ws_proxy's hold_demand)
+        self._open += 1
+        target = await self.acquire(
+            deadline_s=min(30.0, self.request_timeout_s), body=body)
+        if target is None:
+            self._dec_open()
+            return ForwardResult(status=504,
+                                 body=b'{"error":"no capacity"}')
+        container_id, address = target
+        released = False
+
+        async def release() -> None:
+            nonlocal released
+            if released:
+                return
+            released = True
+            self._dec_open()
+            await self.containers.release_request_token(self.stub.stub_id,
+                                                        container_id)
+
+        try:
+            resp = await self._session.request(
+                method, f"http://{address}{path}", data=body or None,
+                headers=CIMultiDict(headers or {}),
+                # no total timeout: a long generation streams for minutes;
+                # sock_read bounds per-chunk gaps instead
+                timeout=aiohttp.ClientTimeout(
+                    total=None, sock_connect=10.0,
+                    sock_read=self.request_timeout_s))
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
+            await release()
+            return ForwardResult(
+                status=502,
+                body=f'{{"error":"{type(exc).__name__}"}}'.encode(),
+                container_id=container_id)
+        return StreamHandle(resp, container_id, release)
 
     @contextlib.contextmanager
     def hold_demand(self):

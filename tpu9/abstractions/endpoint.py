@@ -74,6 +74,13 @@ class EndpointService:
         return await inst.buffer.forward(method=method, path=path,
                                          headers=headers, body=body)
 
+    async def forward_stream(self, stub: Stub, method: str, path: str,
+                             headers: dict, body: bytes):
+        """StreamHandle (caller closes) or ForwardResult on failure."""
+        inst = await self.get_or_create_instance(stub)
+        return await inst.buffer.forward_stream(method=method, path=path,
+                                                headers=headers, body=body)
+
     async def drain_stub(self, stub_id: str) -> None:
         inst = self.instances.pop(stub_id, None)
         if inst:

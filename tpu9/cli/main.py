@@ -174,8 +174,26 @@ def serve(target: str, name: str, watch: bool) -> None:
 @cli.command()
 @click.argument("name")
 @click.argument("payload", default="{}")
-def invoke(name: str, payload: str) -> None:
+@click.option("--stream", is_flag=True,
+              help="relay SSE events as they arrive (LLM token streams)")
+def invoke(name: str, payload: str, stream: bool) -> None:
     """Invoke a deployment: ``tpu9 invoke my-endpoint '{"x": 1}'``."""
+    if stream:
+        import asyncio as _asyncio
+
+        from ..sdk.client import AsyncGatewayClient
+
+        async def run() -> None:
+            client = AsyncGatewayClient()
+            try:
+                async for event in client.invoke_stream(
+                        name, json.loads(payload)):
+                    click.echo(json.dumps(event))
+            finally:
+                await client.close()
+
+        _asyncio.run(run())
+        return
     click.echo(json.dumps(_client().invoke(name, json.loads(payload)),
                           indent=2))
 

@@ -1557,6 +1557,20 @@ class Gateway:
                     "content-length"}
         fwd_headers = [(k, v) for k, v in request.headers.items()
                        if k.lower() not in skip_req]
+
+        # streaming relay (LLM token streams / SSE): the caller opts in via
+        # Accept OR the JSON body's stream flag — both hops must agree, or
+        # the runner would emit SSE that this proxy buffers whole
+        wants_stream = "text/event-stream" in request.headers.get(
+            "Accept", "")
+        if not wants_stream and b'"stream"' in body[:4096]:
+            try:
+                wants_stream = bool(json.loads(body).get("stream"))
+            except (ValueError, AttributeError):
+                pass
+        if wants_stream:
+            return await self._serve_stub_stream(request, stub, path,
+                                                 fwd_headers, body)
         from ..observability import tracer
         with tracer.span("gateway.invoke",
                          attrs={"stub_id": stub.stub_id,
@@ -1578,6 +1592,46 @@ class Gateway:
                 resp.headers.add(k, v)
         resp.headers.setdefault("Content-Type", "application/json")
         return resp
+
+    async def _serve_stub_stream(self, request: web.Request, stub: Stub,
+                                 path: str, fwd_headers: list,
+                                 body: bytes) -> web.StreamResponse:
+        """Incremental relay: container chunks reach the client as they
+        are produced (buffer.go:666's streaming proxy role). Used for LLM
+        token streams — a buffered proxy would hold every token until the
+        generation finished."""
+        import aiohttp as _aiohttp
+
+        from ..abstractions.common.buffer import ForwardResult
+        handle = await self.endpoints.forward_stream(
+            stub, request.method, path, fwd_headers, body)
+        # usage records for every forwarded attempt, success or failure —
+        # the buffered path does, and metrics/billing must not diverge
+        # between the two for identical client behavior
+        await self.usage.record_request(stub.workspace_id)
+        if isinstance(handle, ForwardResult):
+            return web.Response(status=handle.status, body=handle.body,
+                                content_type="application/json")
+        sr = web.StreamResponse(status=handle.status)
+        skip = {"connection", "transfer-encoding", "content-length",
+                "server", "date", "content-encoding"}
+        for k, v in handle.headers:
+            if k.lower() not in skip:
+                sr.headers.add(k, v)
+        try:
+            await sr.prepare(request)
+            async for chunk in handle.iter_chunks():
+                await sr.write(chunk)
+            await sr.write_eof()
+        except (ConnectionResetError, OSError, _aiohttp.ClientError,
+                asyncio.TimeoutError) as exc:
+            # client went away OR the container died / stalled mid-stream:
+            # the prepared response can only be dropped, not rewritten —
+            # but it must not escape as an unhandled handler exception
+            log.debug("stream relay ended early: %s", exc)
+        finally:
+            await handle.close()
+        return sr
 
     async def _ws_proxy(self, stub: Stub, request: web.Request) -> web.StreamResponse:
         """Bidirectional websocket proxy for @realtime deployments

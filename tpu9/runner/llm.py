@@ -67,7 +67,7 @@ async def amain() -> None:
         return web.json_response({"ready": True,
                                   **state["engine"].stats()})
 
-    async def generate(request: web.Request) -> web.Response:
+    async def generate(request: web.Request) -> web.StreamResponse:
         if not state["ready"]:
             return web.json_response({"error": "not ready"}, status=503)
         try:
@@ -77,14 +77,56 @@ async def amain() -> None:
                 return web.json_response(
                     {"error": "body must include 'tokens': [int, ...]"},
                     status=400)
-            out = await state["engine"].generate(
-                [int(t) for t in tokens],
-                max_new_tokens=int(payload.get("max_new_tokens", 32)))
+            prompt = [int(t) for t in tokens]
+            max_new = int(payload.get("max_new_tokens", 32))
+            if payload.get("stream") or \
+                    "text/event-stream" in request.headers.get("Accept", ""):
+                return await _generate_sse(request, prompt, max_new)
+            out = await state["engine"].generate(prompt,
+                                                 max_new_tokens=max_new)
             return web.json_response({"tokens": out})
         except ValueError as exc:
             return web.json_response({"error": str(exc)}, status=400)
         except Exception as exc:  # noqa: BLE001
             return web.json_response(error_payload(exc), status=500)
+
+    async def _generate_sse(request: web.Request, prompt: list,
+                            max_new: int) -> web.StreamResponse:
+        """Server-sent token stream: one `data: {"token": N}` event per
+        generated token, then `data: {"done": true, "tokens": [...]}` —
+        relayed incrementally by the gateway's streaming proxy."""
+        req = await state["engine"].generate(prompt, max_new_tokens=max_new,
+                                             stream=True)
+        sr = web.StreamResponse(
+            status=200, headers={"Content-Type": "text/event-stream",
+                                 "Cache-Control": "no-cache",
+                                 "X-Accel-Buffering": "no"})
+        await sr.prepare(request)
+        out: list = []
+        try:
+            while True:
+                tok = await req.queue.get()
+                if tok is None:
+                    break
+                out.append(tok)
+                await sr.write(
+                    f"data: {json.dumps({'token': tok})}\n\n".encode())
+            if req.error:
+                await sr.write(
+                    f"data: {json.dumps({'error': req.error})}\n\n".encode())
+            else:
+                await sr.write(
+                    f"data: {json.dumps({'done': True, 'tokens': out})}\n\n"
+                    .encode())
+            await sr.write_eof()
+        except ConnectionResetError:
+            pass                # client went away; engine slot retires
+        except asyncio.CancelledError:
+            # shutdown/disconnect cancellation must propagate — swallowing
+            # it would leave the task "done" while the server is tearing
+            # down and the engine still generating into a dead queue
+            raise
+        return sr
 
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app.router.add_get("/health", health)

@@ -122,6 +122,26 @@ class AsyncGatewayClient:
         return await self.request("POST", f"/endpoint/{name}{path}",
                                   json_body=payload)
 
+    async def invoke_stream(self, name: str, payload: Any, path: str = ""):
+        """Async iterator of SSE data events (dicts) from a streaming
+        deployment (LLM token streams): yields each event as it arrives."""
+        session = await self._ensure()
+        url = (self.ctx.gateway_url.rstrip("/")
+               + f"/endpoint/{name}{path}")
+        async with session.post(
+                url, json=payload, headers={"Accept": "text/event-stream"},
+                timeout=aiohttp.ClientTimeout(total=None, sock_read=600,
+                                              sock_connect=30)) as resp:
+            if resp.status != 200:
+                raise GatewayError(resp.status, await resp.text())
+            buf = b""
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while b"\n\n" in buf:
+                    frame, buf = buf.split(b"\n\n", 1)
+                    if frame.startswith(b"data: "):
+                        yield json.loads(frame[6:])
+
     async def taskqueue_put(self, stub_id: str, args: list, kwargs: dict) -> str:
         out = await self.request("POST", "/rpc/taskqueue/put", json_body={
             "stub_id": stub_id, "args": args, "kwargs": kwargs})
