@@ -98,9 +98,16 @@ def load_engine():
     assert mesh.devices.size == 8, mesh
     params = init_decoder(jax.random.PRNGKey(0), cfg)
     params = shard_params(params, mesh, decoder_param_specs(params))
+    # PAGED KV under tensor parallelism — the config-#4 serving shape
+    # (block pool + tables work on sharded params; verified equal to the
+    # dense engine in test_paged_engine.py)
     engine = InferenceEngine(params, cfg,
                              EngineConfig(max_batch=2, max_seq_len=128,
-                                          prefill_buckets=(16, 64)))
+                                          prefill_buckets=(16, 64),
+                                          kv_block_size=16,
+                                          kv_pool_blocks=20,
+                                          prefill_chunk=16,
+                                          prefix_cache_blocks=4))
     engine.mesh = mesh
     return engine
 """

@@ -38,6 +38,18 @@ def _run(coro):
     return asyncio.run(coro)
 
 
+def _generate(engine, prompt, max_new):
+    """start → generate → stop, the harness every engine test repeats."""
+
+    async def go():
+        await engine.start()
+        out = await engine.generate(list(prompt), max_new_tokens=max_new)
+        await engine.stop()
+        return out
+
+    return _run(go())
+
+
 # ---------------------------------------------------------------------------
 # allocator
 # ---------------------------------------------------------------------------
@@ -264,8 +276,6 @@ def test_load_engine_defaults_are_consistent(tiny):
     """load_engine's auto block/chunk choice must always produce a valid
     paged config — including the quick-bench shape that originally hit
     the no-op-splice bug (buckets (32, 64) with block 256)."""
-    import asyncio as aio
-
     from tpu9.serving.presets import load_engine
 
     eng = load_engine("llama-tiny", max_batch=2, max_seq_len=256,
@@ -277,13 +287,8 @@ def test_load_engine_defaults_are_consistent(tiny):
                         prefill_buckets=(32, 64), decode_steps=(1, 4),
                         paged=False)
 
-    async def run(engine):
-        await engine.start()
-        out = await engine.generate(list(range(3, 45)), max_new_tokens=6)
-        await engine.stop()
-        return out
-
-    assert aio.run(run(eng)) == aio.run(run(dense))
+    assert _generate(eng, range(3, 45), 6) == _generate(dense,
+                                                        range(3, 45), 6)
 
 
 def test_near_full_cache_prompt_does_not_overflow_table(tiny):
@@ -294,13 +299,37 @@ def test_near_full_cache_prompt_does_not_overflow_table(tiny):
     paged = _engine(tiny, max_seq_len=128, kv_pool_blocks=8,
                     decode_steps=(1, 4))
     prompt = [(i * 3) % 250 + 1 for i in range(120)]   # 120 of 128
-
-    async def run():
-        await paged.start()
-        out = await paged.generate(prompt, max_new_tokens=64)
-        await paged.stop()
-        return out
-
-    out = _run(run())
+    out = _generate(paged, prompt, 64)
     # the cache caps generation: 120 + len(out) <= 128
     assert 1 <= len(out) <= 8
+
+
+def test_paged_matches_dense_under_tp8_sharding():
+    """Config #4's serving shape: the paged engine must produce identical
+    greedy outputs to the dense engine when params are tensor-parallel
+    sharded over the 8-device mesh (block pool + tables ride XLA's
+    sharding propagation)."""
+    from tpu9.models.llama import llama_config
+    from tpu9.parallel import (decoder_param_specs, mesh_for_spec,
+                               shard_params)
+    from tpu9.types import parse_tpu_spec
+
+    cfg = llama_config(vocab_size=256, dim=128, n_layers=2, n_heads=8,
+                       n_kv_heads=8, head_dim=16, hidden_dim=256,
+                       max_seq_len=128)
+    mesh = mesh_for_spec(parse_tpu_spec("v5e-8"))
+    assert mesh.devices.size == 8
+    dense_params = init_decoder(jax.random.PRNGKey(0), cfg)
+    params = shard_params(dense_params, mesh,
+                          decoder_param_specs(dense_params))
+
+    def run(paged: bool):
+        eng = InferenceEngine(params, cfg, EngineConfig(
+            max_batch=2, max_seq_len=128, prefill_buckets=(16, 64),
+            decode_steps=(1, 4),
+            kv_block_size=16 if paged else 0,
+            kv_pool_blocks=20 if paged else 0,
+            prefill_chunk=16 if paged else 0))
+        return _generate(eng, range(3, 40), 6)
+
+    assert run(False) == run(True)
