@@ -107,3 +107,87 @@ def test_t9proc_spawn_reap_signal(built):
         assert proc.wait(timeout=10) == 0
     finally:
         proc.kill()
+
+
+def test_t9cdi_spec_generation(built, tmp_path):
+    """CDI spec generator (reference: nvidia-ctk CDI generation,
+    pkg/worker/nvidia.go:92-203): enumerate a fake /dev tree, validate the
+    emitted CDI v0.6.0 JSON shape."""
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    for i in range(4):
+        (dev / f"accel{i}").write_bytes(b"")
+    (dev / "vfio" / "0").write_bytes(b"")
+    (dev / "accelerators").mkdir()       # non-numeric suffix: ignored
+    libtpu = tmp_path / "libtpu.so"
+    libtpu.write_bytes(b"\x7fELF")
+
+    out = tmp_path / "tpu9.json"
+    rc = subprocess.run(
+        [os.path.join(built, "t9cdi"), "--dev-root", str(dev),
+         "--libtpu", str(libtpu), "--out", str(out)],
+        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    assert "4 chips, 1 vfio groups" in rc.stderr
+
+    spec = json.loads(out.read_text())
+    assert spec["cdiVersion"] == "0.6.0"
+    assert spec["kind"] == "tpu9.dev/accel"
+    names = [d["name"] for d in spec["devices"]]
+    assert names == ["0", "1", "2", "3", "all"]
+    dev0 = spec["devices"][0]["containerEdits"]
+    assert dev0["deviceNodes"] == [{"path": str(dev / "accel0")}]
+    assert "TPU_VISIBLE_CHIPS=0" in dev0["env"]
+    alld = spec["devices"][-1]["containerEdits"]
+    node_paths = {n["path"] for n in alld["deviceNodes"]}
+    assert str(dev / "accel3") in node_paths
+    assert str(dev / "vfio" / "0") in node_paths
+    assert "TPU_VISIBLE_CHIPS=0,1,2,3" in alld["env"]
+    assert alld["mounts"][0]["hostPath"] == str(libtpu)
+    assert alld["mounts"][0]["containerPath"] == "/usr/lib/libtpu.so"
+
+
+def test_t9cdi_sparse_and_vfio_only_hosts(built, tmp_path):
+    """Chip ids come from the node suffix (a failed chip must not shift
+    the id↔node mapping); vfio-only hosts still enumerate; zero devices
+    is a refusal, not an empty spec."""
+    # sparse: accel0 + accel2 (chip 1 failed)
+    dev = tmp_path / "sparse"
+    dev.mkdir()
+    (dev / "accel0").write_bytes(b"")
+    (dev / "accel2").write_bytes(b"")
+    rc = subprocess.run([os.path.join(built, "t9cdi"),
+                         "--dev-root", str(dev)],
+                        capture_output=True, text=True)
+    spec = json.loads(rc.stdout)
+    names = [d["name"] for d in spec["devices"]]
+    assert names == ["0", "2", "all"]
+    dev2 = next(d for d in spec["devices"] if d["name"] == "2")
+    assert dev2["containerEdits"]["deviceNodes"][0]["path"] \
+        == str(dev / "accel2")
+    alld = spec["devices"][-1]["containerEdits"]
+    assert "TPU_VISIBLE_CHIPS=0,2" in alld["env"]
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS=1,2,1" in alld["env"]
+
+    # vfio-only
+    dev = tmp_path / "vfio-only"
+    (dev / "vfio").mkdir(parents=True)
+    for i in range(4):
+        (dev / "vfio" / str(i)).write_bytes(b"")
+    rc = subprocess.run([os.path.join(built, "t9cdi"),
+                         "--dev-root", str(dev)],
+                        capture_output=True, text=True)
+    spec = json.loads(rc.stdout)
+    assert len(spec["devices"]) == 5          # 4 chips + all
+    alld = spec["devices"][-1]["containerEdits"]
+    assert "TPU_VISIBLE_CHIPS=0,1,2,3" in alld["env"]
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS=2,2,1" in alld["env"]
+
+    # empty host: refuse loudly
+    empty = tmp_path / "none"
+    empty.mkdir()
+    rc = subprocess.run([os.path.join(built, "t9cdi"),
+                         "--dev-root", str(empty)],
+                        capture_output=True, text=True)
+    assert rc.returncode == 2
+    assert "refusing" in rc.stderr

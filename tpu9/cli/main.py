@@ -631,6 +631,30 @@ def metrics_cmd(prometheus: bool) -> None:
 # servers
 # ---------------------------------------------------------------------------
 
+@cli.command("cdi-generate")
+@click.option("--out", default="/etc/cdi/tpu9.json",
+              help="CDI spec output path ('-' for stdout)")
+@click.option("--dev-root", default="/dev")
+def cdi_generate(out: str, dev_root: str) -> None:
+    """Generate the host's TPU CDI spec (containerd/CRI-O/podman device
+    injection — the nvidia-ctk analogue for TPU hosts)."""
+    import subprocess
+    binary = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native", "build", "t9cdi")
+    if not os.path.exists(binary):
+        raise click.ClickException(
+            f"{binary} not built — run `make -C native`")
+    cmd = [binary, "--dev-root", dev_root]
+    if out != "-":
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        cmd += ["--out", out]
+    rc = subprocess.run(cmd)
+    if rc.returncode != 0:
+        raise click.ClickException(f"t9cdi exited {rc.returncode}")
+    if out != "-":
+        click.echo(f"wrote {out}")
+
+
 @cli.command()
 @click.option("--config", "config_path", default="")
 def gateway(config_path: str) -> None:
