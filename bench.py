@@ -425,6 +425,7 @@ def bench_llm_endpoint(quick: bool = False) -> dict:
 def bench_kernels(quick: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from tpu9.benchsuite.physics import (chip_spec, matmul_physics,
                                          physics_violations)
@@ -490,6 +491,7 @@ def bench_kernels(quick: bool = False) -> dict:
     out["paged_ms"] = round(paged_ms, 3)
     out["paged_xla_ms"] = round(xla2_ms, 3)
     out["paged_shape"] = [b, s, qh, kh, d]
+
     # decode attention is bandwidth-bound: reads mean(lens) K+V rows/seq
     mean_len = float(jnp.mean(lens))
     paged_bytes = int(2 * b * mean_len * kh * d * 2)
@@ -500,6 +502,42 @@ def bench_kernels(quick: bool = False) -> dict:
     if tpu:
         violations += physics_violations(pp, what="paged decode")
 
+    # block-table paged kernel (the serving engine's production read path):
+    # same workload through a scrambled block POOL — correctness against
+    # the densify+XLA oracle and fenced latency vs the dense ragged kernel
+    from tpu9.ops.paged_attention import (paged_decode_attention,
+                                          xla_paged_decode_attention)
+    bs_blk = 128 if (quick or not tpu) else 256
+    mb = s // bs_blk
+    n_pool = b * mb + 4
+    rng_t = np.random.default_rng(5)
+    table_np = rng_t.permutation(n_pool)[:b * mb].reshape(b, mb)
+    table = jnp.asarray(table_np, jnp.int32)
+    pool_k = jnp.zeros((n_pool, bs_blk, kh, d), jnp.bfloat16)
+    pool_v = jnp.zeros((n_pool, bs_blk, kh, d), jnp.bfloat16)
+    kc_blocks = kc.reshape(b * mb, bs_blk, kh, d)
+    vc_blocks = vc.reshape(b * mb, bs_blk, kh, d)
+    pool_k = pool_k.at[table.reshape(-1)].set(kc_blocks)
+    pool_v = pool_v.at[table.reshape(-1)].set(vc_blocks)
+
+    blocktab, blocktab_ms = timeit(paged_decode_attention, q1, pool_k,
+                                   pool_v, table, lens, interpret=interpret)
+    oracle = xla_paged_decode_attention(q1, pool_k, pool_v, table, lens)
+    out["blocktable_max_abs_diff"] = float(jnp.max(jnp.abs(
+        blocktab.astype(jnp.float32) - oracle.astype(jnp.float32))))
+    out["blocktable_ms"] = round(blocktab_ms, 3)
+    out["blocktable_block_size"] = bs_blk
+    bt = matmul_physics(elapsed_ms=blocktab_ms, flops=paged_flops,
+                        bytes_moved=paged_bytes, spec=spec)
+    out["blocktable_physics"] = bt
+    if tpu:
+        violations += physics_violations(bt, what="block-table decode")
+    # the oracle-diff check is backend-independent: a wrong kernel must be
+    # rejected on the interpret path too, not just on-chip
+    if out["blocktable_max_abs_diff"] > 0.05:
+        violations.append(
+            f"block-table kernel diverges from oracle by "
+            f"{out['blocktable_max_abs_diff']}")
     out["violations"] = violations
     out["valid"] = not violations
     return out
@@ -1003,7 +1041,8 @@ def _run_chip_phases(detail: dict, quick: bool, cpu: bool) -> bool:
             for k, v in kern.items()}
     kern["violations"] = kern_viol
     _merge_validated(detail, "kernels", kern, ("kernel_flash_ms",
-                                               "kernel_paged_ms"))
+                                               "kernel_paged_ms",
+                                               "kernel_blocktable_ms"))
 
     if not cpu and detail.get("on_tpu"):
         snap = dict(detail)
