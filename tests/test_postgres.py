@@ -289,6 +289,19 @@ def test_full_backend_against_live_postgres():
         assert await db.get_secret(ws.workspace_id, "k") == "v1"
         await db.upsert_secret(ws.workspace_id, "k", "v2")
         assert await db.get_secret(ws.workspace_id, "k") == "v2"
+        # deployment creation exercises the multi-statement transaction
+        # path (_exec_txn) — the one write that bypasses _exec
+        from tpu9.types import StubConfig
+        stub = await db.get_or_create_stub(
+            workspace_id=ws.workspace_id, name="pg-stub",
+            stub_type="endpoint", config=StubConfig())
+        d1 = await db.create_deployment(ws.workspace_id, "pg-dep",
+                                        stub.stub_id)
+        d2 = await db.create_deployment(ws.workspace_id, "pg-dep",
+                                        stub.stub_id)
+        assert d2.version == d1.version + 1
+        active = await db.get_deployment(ws.workspace_id, "pg-dep")
+        assert active.deployment_id == d2.deployment_id
         await db.close()
         return sid
 

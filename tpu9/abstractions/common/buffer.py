@@ -169,8 +169,11 @@ class RequestBuffer:
         # if the autoscaler can see this request waiting (same contract as
         # the buffered path and _ws_proxy's hold_demand)
         self._open += 1
-        target = await self.acquire(
-            deadline_s=min(30.0, self.request_timeout_s), body=body)
+        # full request timeout for admission, same as the buffered path —
+        # a scale-from-zero LLM cold start routinely exceeds 30s and a
+        # streaming request must ride it out like any other
+        target = await self.acquire(deadline_s=self.request_timeout_s,
+                                    body=body)
         if target is None:
             self._dec_open()
             return ForwardResult(status=504,

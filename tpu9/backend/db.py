@@ -90,6 +90,14 @@ class BackendDB:
         with self._lock:
             return self._conn.execute(sql, params).fetchall()
 
+    def _exec_txn(self, statements: list[tuple[str, tuple]]) -> None:
+        """Several statements in one transaction (the only multi-statement
+        write the backend needs; the Postgres driver overrides this with
+        BEGIN/COMMIT — it must never touch self._conn directly)."""
+        with self._lock, self._conn:
+            for sql, params in statements:
+                self._conn.execute(sql, params)
+
     async def close(self) -> None:
         with self._lock:
             self._conn.close()
@@ -237,14 +245,13 @@ class BackendDB:
         dep = Deployment(deployment_id=new_id("dep"), name=name, stub_id=stub_id,
                          workspace_id=workspace_id, app_id=app_id, version=version,
                          subdomain=f"{name}-{version}-{ws_tag}")
-        with self._lock, self._conn:
-            self._conn.execute(
-                "UPDATE deployments SET active=0 WHERE workspace_id=? AND name=?",
-                (workspace_id, name))
-            self._conn.execute(
-                "INSERT INTO deployments (deployment_id, name, stub_id, workspace_id, app_id, version, active, subdomain, created_at) VALUES (?,?,?,?,?,?,1,?,?)",
-                (dep.deployment_id, dep.name, dep.stub_id, dep.workspace_id, dep.app_id,
-                 dep.version, dep.subdomain, dep.created_at))
+        self._exec_txn([
+            ("UPDATE deployments SET active=0 WHERE workspace_id=? AND name=?",
+             (workspace_id, name)),
+            ("INSERT INTO deployments (deployment_id, name, stub_id, workspace_id, app_id, version, active, subdomain, created_at) VALUES (?,?,?,?,?,?,1,?,?)",
+             (dep.deployment_id, dep.name, dep.stub_id, dep.workspace_id,
+              dep.app_id, dep.version, dep.subdomain, dep.created_at)),
+        ])
         return dep
 
     def _row_to_deployment(self, r: sqlite3.Row) -> Deployment:

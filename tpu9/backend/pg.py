@@ -110,6 +110,17 @@ class PostgresBackendDB(BackendDB):
         with self._lock:
             return self._pg(translate_dialect(sql), params).rows
 
+    def _exec_txn(self, statements: list[tuple[str, tuple]]) -> None:
+        with self._lock:
+            self._pg("BEGIN")
+            try:
+                for sql, params in statements:
+                    self._pg(translate_dialect(sql), params)
+            except Exception:
+                self._pg("ROLLBACK")
+                raise
+            self._pg("COMMIT")
+
     def _migrate(self) -> None:
         with self._lock:
             # serialize competing gateways (advisory lock key is arbitrary

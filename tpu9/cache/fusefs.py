@@ -27,8 +27,9 @@ from .client import CacheClient
 
 log = logging.getLogger("tpu9.cache")
 
-_BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))), "native", "build", "t9cachefs")
+from ..utils import native_binary
+
+_BIN = native_binary("t9cachefs")
 
 
 class CacheFsMount:

@@ -639,8 +639,8 @@ def cdi_generate(out: str, dev_root: str) -> None:
     """Generate the host's TPU CDI spec (containerd/CRI-O/podman device
     injection — the nvidia-ctk analogue for TPU hosts)."""
     import subprocess
-    binary = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), "native", "build", "t9cdi")
+    from ..utils import native_binary
+    binary = native_binary("t9cdi")
     if not os.path.exists(binary):
         raise click.ClickException(
             f"{binary} not built — run `make -C native`")

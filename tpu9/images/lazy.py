@@ -184,9 +184,13 @@ class LazyFill:
         self._claimed.add(rel)
         try:
             await self._fill_one(entry)
-        except (OSError, IOError):
+        except Exception:
+            # ANY failure (chunk transport errors included, not just
+            # OSError) must release _fill_all's completion wait — an unset
+            # event would pin active_fill forever and gate every later
+            # container on a fill that cannot finish
             self.failed.append(rel)
-            ev.set()                 # release _fill_all's completion wait
+            ev.set()
             raise
         return True
 
@@ -227,9 +231,9 @@ class LazyFill:
             self._claimed.add(entry.path)
             try:
                 await self._fill_one(entry)
-            except (OSError, IOError) as exc:
-                # bundle deleted underneath us (operator invalidation) or
-                # chunk unavailable: record, release waiters, move on — a
+            except Exception as exc:     # noqa: BLE001
+                # bundle deleted underneath us, chunk unavailable, or any
+                # transport error: record, release waiters, move on — a
                 # hung filler must never pin active_fill forever
                 log.warning("lazy fill %s failed: %s", entry.path, exc)
                 self.failed.append(entry.path)

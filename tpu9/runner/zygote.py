@@ -205,9 +205,14 @@ def serve(sock_path: str) -> None:
                     conn, _ = srv.accept()
                 except OSError:
                     continue
-                conn.setblocking(True)
+                # bounded handshake: a half-open client must not wedge the
+                # single-threaded fork-server (every later spawn would
+                # stall into its exec fallback, then fork a duplicate
+                # whenever the zygote unwedged)
+                conn.settimeout(10.0)
                 try:
                     req, fds = _recv_request(conn)
+                    conn.settimeout(None)
                 except (OSError, ValueError):
                     conn.close()
                     continue

@@ -36,9 +36,9 @@ from .base import ContainerHandle, ContainerSpec, Runtime, RuntimeState
 
 log = logging.getLogger("tpu9.runtime")
 
-_NATIVE_BIN = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native", "build",
-    "t9container")
+from ..utils import native_binary
+
+_NATIVE_BIN = native_binary("t9container")
 
 # host dirs bound read-only into env-snapshot containers (the "image" only
 # overlays the python env; the OS comes from the host like ProcessRuntime,

@@ -316,9 +316,12 @@ class InferenceEngine:
             self._host_len[slot] = ctx0
 
     def _worst_case_tokens(self, req: _Request) -> int:
-        # prompt + full generation budget + one decode window of overshoot
-        return (len(req.prompt) + req.max_new_tokens
-                + max(self.ecfg.decode_steps) + 1)
+        # prompt + full generation budget + one decode window of overshoot,
+        # clamped to the cache: positions never exceed max_seq_len, so a
+        # near-max prompt must not over-reserve itself into rejection
+        return min(len(req.prompt) + req.max_new_tokens
+                   + max(self.ecfg.decode_steps) + 1,
+                   self.ecfg.max_seq_len)
 
     def _alloc_blocks(self, n: int) -> list[int]:
         """Allocate physical blocks; evicts prefix-cache holdings if the
@@ -641,6 +644,8 @@ class InferenceEngine:
             while not self._queue.empty():
                 req = self._queue.get_nowait()
                 req.error = f"engine failure: {exc}"
+                if req.queue is not None:
+                    req.queue.put_nowait(None)
                 req.done.set()
             raise
 
@@ -664,6 +669,8 @@ class InferenceEngine:
                     # cache pressure is handled inside _alloc_blocks)
                     head = self._wait_room.pop(0)
                     head.error = "request exceeds KV pool capacity"
+                    if head.queue is not None:
+                        head.queue.put_nowait(None)   # release SSE readers
                     head.done.set()
                     continue
                 # idle: block for work

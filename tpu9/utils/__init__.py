@@ -1,4 +1,4 @@
 from .platform import force_cpu, device_kind, on_tpu
-from .paths import validate_path_part
+from .paths import native_binary, repo_root, validate_path_part
 
 __all__ = ["force_cpu", "device_kind", "on_tpu", "validate_path_part"]

@@ -7,6 +7,22 @@ must tighten in lockstep, not diverge per call site.
 
 from __future__ import annotations
 
+import os
+
+
+def repo_root() -> str:
+    """The checkout root (parent of the tpu9 package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def native_binary(name: str) -> str:
+    """Path of a built native component (native/build/<name>) — the ONE
+    definition every consumer (runtimes, lifecycle, cachefs, CLI) uses, so
+    relocating the build dir is a single edit. Callers check existence;
+    missing binaries degrade per-feature."""
+    return os.path.join(repo_root(), "native", "build", name)
+
 
 def validate_path_part(part: str, what: str = "path part") -> str:
     """Reject anything that could traverse outside its parent directory

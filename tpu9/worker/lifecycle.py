@@ -344,10 +344,8 @@ class ContainerLifecycle:
     # ------------------------------------------------------------------
 
     def _lazy_so_path(self) -> str:
-        return self.cfg.lazy_so or os.path.join(
-            os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))), "native", "build",
-            "t9lazy_preload.so")
+        from ..utils import native_binary
+        return self.cfg.lazy_so or native_binary("t9lazy_preload.so")
 
     async def _prepare_image(self, request: ContainerRequest) -> str:
         """Resolve the image bundle for the request. v0: the host environment
@@ -576,10 +574,8 @@ class ContainerLifecycle:
             # init, lifecycle.go:1299-1325): supervised spawn/stdin/kill
             # through its unix socket on the rw workdir bind + zombie
             # reaping. Fallback: plain idle loop (exec path still works).
-            t9proc = os.path.join(
-                os.path.dirname(os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__)))), "native", "build",
-                "t9proc")
+            from ..utils import native_binary
+            t9proc = native_binary("t9proc")
             if os.path.exists(t9proc) and workdir not in ("", "/"):
                 entrypoint = [t9proc, "--sock",
                               os.path.join(workdir, ".t9proc.sock")]
