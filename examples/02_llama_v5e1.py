@@ -1,9 +1,18 @@
 """Baseline config #2: Llama-3-8B JAX inference on a single v5e chip behind
-@endpoint — the continuous-batching engine runner with checkpointed weights.
+@endpoint — the continuous-batching engine runner with checkpointed weights,
+paged KV (block allocator + chunked prefill + prefix reuse), and SSE token
+streaming.
 
     tpu9 deploy examples/02_llama_v5e1.py:llama --name llama8b
     curl -X POST $GW/endpoint/llama8b -H "Authorization: Bearer $TOK" \
          -d '{"tokens": [1, 3124, 310], "max_new_tokens": 64}'
+    # token streaming (one SSE event per token):
+    tpu9 invoke llama8b '{"tokens": [1, 3124, 310], "max_new_tokens": 64,
+                          "stream": true}' --stream
+
+The declarative ``model=`` lets the gateway verify at deploy time that
+weights + KV fit the chip's HBM (an infeasible config is a 400 with the
+arithmetic, not a chip OOM).
 """
 
 from tpu9 import Volume, endpoint
@@ -30,12 +39,17 @@ def load_engine():
     # weight-only int8: halves HBM reads per decode step (8B bf16 ≈ 16 GB is
     # tight next to the KV cache on a 16 GB v5e chip; int8 leaves headroom)
     params = quantize_decoder(params)
+    # paged KV: memory tracks live tokens (not max_batch × max_seq), long
+    # prompts chunk-prefill through one (128, 2048) graph, and requests
+    # sharing a prompt prefix reuse its KV blocks
     return InferenceEngine(params, cfg, EngineConfig(
-        max_batch=8, max_seq_len=2048, prefill_buckets=(128, 512, 2048)))
+        max_batch=8, max_seq_len=2048, prefill_buckets=(128, 512, 2048),
+        kv_block_size=128, prefill_chunk=128, prefix_cache_blocks=16))
 
 
 llama = endpoint(
     tpu="v5e-1", cpu=4, memory="16Gi", runner="llm",
+    model="llama3-8b-int8",      # deploy-time HBM feasibility gate
     checkpoint_enabled=True, keep_warm_seconds=300,
     volumes=[Volume(name="llama3-8b", mount_path="/models/llama3-8b")],
 )(load_engine)

@@ -35,6 +35,11 @@ def test_llama_v5e1_config():
     mod = load("02_llama_v5e1.py")
     assert mod.llama.config.runtime.tpu == "v5e-1"
     assert mod.llama.config.extra["runner"] == "llm"
+    # declarative model → the gateway's deploy-time HBM gate fires, and
+    # the declared config must actually be feasible
+    assert mod.llama.config.extra["model"] == "llama3-8b-int8"
+    from tpu9.serving.feasibility import validate_llm_deployment
+    assert validate_llm_deployment("llama3-8b-int8", "v5e-1").fits
     assert mod.llama.config.checkpoint.enabled
     assert mod.llama.config.volumes[0]["mount_path"] == "/models/llama3-8b"
 
