@@ -104,3 +104,28 @@ def test_runner_abstraction_volumes_serialized():
 
     r = RunnerAbstraction(lambda: None, volumes=[FakeVol()])
     assert r.config.volumes == [{"name": "v", "mount_path": "/data"}]
+
+
+def test_llm_cli_group_surface():
+    """`tpu9 llm` one-command serving (reference `beta9 llm`): deploy
+    pre-validates HBM feasibility client-side; unknown presets and
+    infeasible configs fail before any upload."""
+    from click.testing import CliRunner
+
+    from tpu9.cli.main import cli
+
+    r = CliRunner().invoke(cli, ["llm", "--help"])
+    assert r.exit_code == 0
+    for cmd in ("deploy", "complete", "stats"):
+        assert cmd in r.output
+
+    # infeasible config dies client-side with the arithmetic
+    r = CliRunner().invoke(cli, ["llm", "deploy", "--model", "llama3-70b",
+                                 "--tpu", "v5e-1"])
+    assert r.exit_code != 0
+    assert "GB" in str(r.exception)
+
+    # unknown preset fails fast even without a tpu spec
+    r = CliRunner().invoke(cli, ["llm", "deploy", "--model", "llama-nope",
+                                 "--tpu", ""])
+    assert r.exit_code != 0

@@ -631,6 +631,98 @@ def metrics_cmd(prometheus: bool) -> None:
 # servers
 # ---------------------------------------------------------------------------
 
+@cli.group()
+def llm() -> None:
+    """Native LLM serving (reference ``beta9 llm``: one-command LLM
+    deploys; tpu9 serves its own engine instead of wrapping vllm)."""
+
+
+_LLM_APP_TEMPLATE = '''"""Generated by `tpu9 llm deploy` — the native engine for {model}."""
+from tpu9 import endpoint
+
+
+@endpoint(tpu="{tpu}", runner="llm", model="{model}",
+          extra={{"max_batch": {max_batch}, "max_seq_len": {max_seq_len}}},
+          concurrent_requests={concurrency}, timeout=1800,
+          keep_warm_seconds={keep_warm})
+def load():
+    from tpu9.serving.presets import load_engine
+    return load_engine("{model}", max_batch={max_batch},
+                       max_seq_len={max_seq_len},
+                       prefill_buckets=(128, {max_seq_len}))
+'''
+
+
+@llm.command("deploy")
+@click.option("--model", required=True,
+              help="engine preset (llama3-8b-int8, llama3-70b-int8, "
+                   "gemma-7b, mixtral-8x7b-int8, ...)")
+@click.option("--tpu", default="v5e-1",
+              help="slice spec; '' serves on CPU (local dev)")
+@click.option("--name", default="")
+@click.option("--max-batch", default=8)
+@click.option("--max-seq-len", default=2048)
+@click.option("--concurrency", default=64)
+@click.option("--keep-warm", default=300)
+def llm_deploy(model: str, tpu: str, name: str, max_batch: int,
+               max_seq_len: int, concurrency: int, keep_warm: int) -> None:
+    """One-command LLM serving: generates the engine app, validates HBM
+    feasibility at the gateway, deploys behind @endpoint."""
+    import tempfile
+
+    if tpu:
+        from ..serving.feasibility import validate_llm_deployment
+        # client-side pre-check: the arithmetic BEFORE uploading anything
+        budget = validate_llm_deployment(model, tpu, max_batch=max_batch,
+                                         max_seq_len=max_seq_len)
+        click.echo(f"fits: {budget.as_dict()}", err=True)
+    else:
+        from ..serving.presets import resolve_preset
+        resolve_preset(model)     # unknown presets still fail fast
+
+    app = _LLM_APP_TEMPLATE.format(model=model, tpu=tpu,
+                                   max_batch=max_batch,
+                                   max_seq_len=max_seq_len,
+                                   concurrency=concurrency,
+                                   keep_warm=keep_warm)
+    name = name or model.replace(".", "-")
+    with tempfile.TemporaryDirectory(prefix="tpu9-llm-") as tmp:
+        path = os.path.join(tmp, "llm_app.py")
+        with open(path, "w") as f:
+            f.write(app)
+        obj = _load_target(f"{path}:load")
+        out = obj.deploy(name, sync_root=tmp)
+    click.echo(json.dumps(out, indent=2))
+
+
+@llm.command("complete")
+@click.argument("name")
+@click.option("--tokens", required=True,
+              help="comma-separated prompt token ids")
+@click.option("--max-new-tokens", default=64)
+@click.option("--stream", is_flag=True)
+@click.pass_context
+def llm_complete(ctx, name: str, tokens: str, max_new_tokens: int,
+                 stream: bool) -> None:
+    """Generate from a deployed LLM endpoint."""
+    payload = {"tokens": [int(t) for t in tokens.split(",") if t.strip()],
+               "max_new_tokens": max_new_tokens}
+    if stream:
+        payload["stream"] = True
+    ctx.invoke(invoke, name=name, payload=json.dumps(payload),
+               stream=stream)
+
+
+@llm.command("stats")
+@click.argument("name")
+def llm_stats(name: str) -> None:
+    """Engine stats from the serving container (token pressure, KV block
+    occupancy, prefix-cache hits)."""
+    out = _client()._run(
+        lambda c: c.request("GET", f"/endpoint/{name}/health"))
+    click.echo(json.dumps(out, indent=2))
+
+
 @cli.command("cdi-generate")
 @click.option("--out", default="/etc/cdi/tpu9.json",
               help="CDI spec output path ('-' for stdout)")
