@@ -200,3 +200,56 @@ async def test_small_image_stays_eager(tmp_path):
     assert puller.active_fill(spec.image_id) is None
     assert os.path.exists(os.path.join(bundle, ".tpu9-complete"))
     await client.close()
+
+
+def test_manifest_path_traversal_rejected(tmp_path):
+    """Advisor r04: manifests can arrive over the wire and every writer
+    (materialize / lazy skeleton / lazy fill) runs as root — entries that
+    escape the bundle via '..' or a symlinked parent must be refused."""
+    from tpu9.images.manifest import FileEntry, ImageManifest, safe_join
+
+    dest = tmp_path / "bundle"
+    dest.mkdir()
+    for bad in ("../evil", "/abs/evil", "a/../../evil", ""):
+        with pytest.raises(ValueError):
+            safe_join(str(dest), bad)
+    assert safe_join(str(dest), "ok/fine.txt").startswith(str(dest))
+
+    # symlinked parent: entry 'out' links outside dest; 'out/x' must not
+    # write through it
+    outside = tmp_path / "outside"
+    outside.mkdir()
+    m = ImageManifest(image_id="evil", kind="env", files=[
+        FileEntry(path="out", mode=0o777, size=0,
+                  link_target=str(outside)),
+        FileEntry(path="out/x", mode=0o644, size=4, chunks=["d1"]),
+    ])
+    with pytest.raises(ValueError):
+        materialize(m, str(dest), {"d1": b"evil"}.get)
+    assert not (outside / "x").exists()
+
+
+def test_safe_join_second_pass_with_symlinks(tmp_path):
+    """Review regression: safe_join must NOT resolve through the final
+    component — an absolute-target venv-style symlink ('bin/python' ->
+    /usr/bin/python3) exists after the first pass, and resume
+    (_ensure_tree / re-materialize) must see the LINK path, not its
+    resolved target, or every second pass over the bundle fails."""
+    from tpu9.images.manifest import FileEntry, ImageManifest, safe_join
+
+    dest = tmp_path / "bundle"
+    m = ImageManifest(image_id="venv", kind="env", files=[
+        FileEntry(path="bin/python", mode=0o777, size=0,
+                  link_target="/usr/bin/python3"),
+        FileEntry(path="link.cfg", mode=0o777, size=0,
+                  link_target="real.cfg"),
+        FileEntry(path="real.cfg", mode=0o644, size=2, chunks=["c1"]),
+    ])
+    chunks = {"c1": b"ok"}
+    materialize(m, str(dest), chunks.get)
+    # second pass over the same tree: must not raise and must address the
+    # link itself
+    materialize(m, str(dest), chunks.get)
+    assert os.readlink(dest / "bin" / "python") == "/usr/bin/python3"
+    assert safe_join(str(dest), "link.cfg").endswith("/link.cfg")
+    assert (dest / "real.cfg").read_bytes() == b"ok"

@@ -333,3 +333,42 @@ def test_paged_matches_dense_under_tp8_sharding():
         return _generate(eng, range(3, 40), 6)
 
     assert run(False) == run(True)
+
+
+def test_unaligned_prefix_hit_does_not_corrupt_kv(tiny):
+    """Advisor r04 (medium): a prefix-cache hit at p with p % prefill_chunk
+    != 0 put the final chunk window past max_seq_len; dynamic_update_slice
+    then CLAMPS the write start backwards, silently overwriting valid
+    prefix KV. Block 16 / chunk 32 makes cached prefixes land on 16-token
+    boundaries; the warm engine must still match the cold one exactly."""
+    prompt_a = [(i * 13) % 251 + 1 for i in range(50)]    # caches 48 tokens
+    prompt_b = prompt_a[:48] + [(i * 7) % 251 + 1 for i in range(72)]  # 120
+
+    def make(prefix_blocks):
+        return _engine(tiny, max_seq_len=128, kv_block_size=16,
+                       prefill_chunk=32, kv_pool_blocks=24,
+                       prefix_cache_blocks=prefix_blocks)
+
+    async def run(engine):
+        await engine.start()
+        await engine.generate(prompt_a, max_new_tokens=2)
+        out = await engine.generate(prompt_b, max_new_tokens=6)
+        await engine.stop()
+        return out
+
+    cold = _run(run(make(0)))
+    warm_engine = make(4)
+    warm = _run(run(warm_engine))
+    assert warm_engine.prefix_cache.stats()["hits"] >= 1
+    assert warm == cold
+
+
+def test_max_seq_len_not_chunk_multiple_rejected(tiny):
+    """Advisor r04 (medium): max_seq_len % prefill_chunk != 0 lets the
+    final chunk of even an UNCACHED long prompt clamp past the cache end —
+    the config must be rejected at construction, not corrupt silently."""
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="max_seq_len"):
+        InferenceEngine(params, cfg, EngineConfig(
+            max_batch=2, max_seq_len=192, kv_block_size=64,
+            prefill_chunk=128))

@@ -130,3 +130,53 @@ async def test_zygote_kill_and_fallback(tmp_path):
     code = await asyncio.wait_for(rt.wait("zy-fb"), 60)
     assert code != 0        # empty handler is an error, but it RAN
     await rt.cleanup("zy-fb")
+
+
+async def test_zygote_kills_orphan_on_client_disconnect(tmp_path):
+    """Advisor r04: a spawn whose pid-reply path dies after the handshake
+    left the forked child running unsupervised while the caller fell back
+    to exec (duplicate container). The zygote must SIGKILL the child the
+    moment the reply socket sees EOF."""
+    import json
+    import socket
+
+    zy = ZygoteClient(str(tmp_path / "zy.sock"))
+    assert await zy.ensure_started()
+    try:
+        mod_dir = tmp_path / "mods"
+        mod_dir.mkdir()
+        (mod_dir / "sleeper.py").write_text("import time\ntime.sleep(600)\n")
+        stdout_r, stdout_w = os.pipe()
+        stderr_r, stderr_w = os.pipe()
+        payload = json.dumps(
+            {"env": {"PYTHONPATH": str(mod_dir),
+                     "PATH": os.environ.get("PATH", "")},
+             "cwd": str(tmp_path), "module": "sleeper",
+             "argv": []}).encode() + b"\n"
+
+        def handshake():
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(30.0)
+            s.connect(zy.sock_path)
+            socket.send_fds(s, [payload], [stdout_w, stderr_w])
+            line = s.makefile("rb").readline()
+            return s, json.loads(line)["pid"]
+
+        s, pid = await asyncio.to_thread(handshake)
+        for fd in (stdout_w, stderr_w):
+            os.close(fd)
+        os.kill(pid, 0)                     # child is alive
+        s.close()                           # worker "dies" mid-spawn
+        for _ in range(100):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            import pytest as _pytest
+            _pytest.fail("orphan child survived client disconnect")
+        os.close(stdout_r)
+        os.close(stderr_r)
+    finally:
+        await zy.stop()

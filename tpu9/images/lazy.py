@@ -40,7 +40,7 @@ import os
 from typing import Optional
 
 from ..cache import CacheClient
-from .manifest import FileEntry, ImageManifest
+from .manifest import FileEntry, ImageManifest, safe_join
 
 log = logging.getLogger("tpu9.images")
 
@@ -99,8 +99,9 @@ class LazyFill:
         """Resume path: create only MISSING placeholders (never truncate an
         existing file — it may be mid-read in a running container)."""
         os.makedirs(self.dest, exist_ok=True)
+        dest_real = os.path.realpath(self.dest)
         for entry in self.manifest.files:
-            target = os.path.join(self.dest, entry.path)
+            target = safe_join(self.dest, entry.path, dest_real)
             if os.path.lexists(target):
                 continue
             os.makedirs(os.path.dirname(target), exist_ok=True)
@@ -118,8 +119,9 @@ class LazyFill:
 
     def _write_skeleton(self) -> None:
         os.makedirs(self.dest, exist_ok=True)
+        dest_real = os.path.realpath(self.dest)
         for entry in self.manifest.files:
-            target = os.path.join(self.dest, entry.path)
+            target = safe_join(self.dest, entry.path, dest_real)
             os.makedirs(os.path.dirname(target), exist_ok=True)
             if entry.link_target:
                 try:
@@ -195,7 +197,7 @@ class LazyFill:
         return True
 
     async def _fill_one(self, entry: FileEntry) -> None:
-        target = os.path.join(self.dest, entry.path)
+        target = safe_join(self.dest, entry.path)
         offset = 0
         for i in range(0, len(entry.chunks), SEGMENT_CHUNKS):
             seg = entry.chunks[i:i + SEGMENT_CHUNKS]

@@ -19,6 +19,38 @@ from typing import Iterator, Optional
 DEFAULT_CHUNK = 4 * 1024 * 1024
 
 
+def safe_join(dest: str, rel: str, dest_real: str | None = None) -> str:
+    """Join a manifest-supplied relative path onto ``dest``, refusing
+    absolute paths, ``..`` traversal, and symlinked parents that resolve
+    outside ``dest``. Manifests can arrive over the wire (manifest_fetch)
+    and every materialize/skeleton/fill writer runs with root privileges —
+    a hostile entry must never place a write outside the bundle (advisor
+    r04).
+
+    Only the PARENT directory chain is realpath-resolved; the final
+    component is returned unresolved so an entry that IS a symlink (legit:
+    venv links to absolute host paths) can be re-checked/re-created on a
+    second pass (lazy-fill resume) without being resolved through.
+    Callers looping over a manifest should hoist ``dest_real =
+    os.path.realpath(dest)`` and pass it in (one lstat walk per entry is
+    enough on the cold-start path)."""
+    if not rel or os.path.isabs(rel) or "\x00" in rel:
+        raise ValueError(f"unsafe manifest path: {rel!r}")
+    if dest_real is None:
+        dest_real = os.path.realpath(dest)
+    norm = os.path.normpath(rel)
+    if norm in (".", "..") or norm.startswith(".." + os.sep):
+        raise ValueError(f"manifest path escapes bundle: {rel!r}")
+    full = os.path.join(dest_real, norm)
+    # realpath on the parent resolves ".." and any symlinked intermediate
+    # directory, so a symlink entry pointing outside followed by files
+    # beneath it fails containment instead of writing through the link
+    parent = os.path.realpath(os.path.dirname(full))
+    if parent != dest_real and not parent.startswith(dest_real + os.sep):
+        raise ValueError(f"manifest path escapes bundle: {rel!r}")
+    return os.path.join(parent, os.path.basename(full))
+
+
 @dataclass
 class FileEntry:
     path: str                  # relative path in the bundle
@@ -124,8 +156,9 @@ def materialize(manifest: ImageManifest, dest: str, get_chunk,
     bytes`` (sync). When ``link_from`` holds a chunk file path resolver,
     single-chunk files are hardlinked instead of copied (zero-copy warm
     start)."""
+    dest_real = os.path.realpath(dest)
     for entry in manifest.files:
-        target = os.path.join(dest, entry.path)
+        target = safe_join(dest, entry.path, dest_real)
         os.makedirs(os.path.dirname(target), exist_ok=True)
         if entry.link_target:
             try:

@@ -178,6 +178,16 @@ def serve(sock_path: str) -> None:
     sel.register(srv, selectors.EVENT_READ, "accept")
     children: dict[int, socket.socket] = {}    # pid -> notify conn
 
+    def close_conn(conn: socket.socket) -> None:
+        try:
+            sel.unregister(conn)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
     def reap() -> None:
         while True:
             try:
@@ -192,9 +202,9 @@ def serve(sock_path: str) -> None:
                         else 128 + os.WTERMSIG(status))
                 try:
                     conn.sendall(json.dumps({"exit": code}).encode() + b"\n")
-                    conn.close()
                 except OSError:
                     pass
+                close_conn(conn)
 
     while True:
         events = sel.select(timeout=0.2)
@@ -224,10 +234,40 @@ def serve(sock_path: str) -> None:
                 pid = _spawn(conn, req, fds,
                              [srv] + list(children.values()))
                 children[pid] = conn
+                # watch the worker's end: the protocol has no further
+                # client→zygote traffic, so the only READ event on this
+                # conn is EOF — the worker died or abandoned the spawn
+                # (e.g. its pid-reply read timed out). Its child must not
+                # keep running unsupervised while the worker falls back to
+                # exec and forks a duplicate (advisor r04).
+                sel.register(conn, selectors.EVENT_READ, ("client", pid))
                 try:
                     conn.sendall(json.dumps({"pid": pid}).encode() + b"\n")
                 except OSError:
                     pass
+            else:
+                _kind, pid = key.data
+                conn = key.fileobj
+                try:
+                    data = conn.recv(4096)
+                except OSError:
+                    data = b""
+                if data:
+                    continue               # stray bytes: ignore, stay open
+                if children.pop(pid, None) is not None:
+                    # the child setsid()s at startup (pgid == pid) and
+                    # runner workloads fork their own subprocesses — kill
+                    # the whole group, or the grandchildren survive as the
+                    # very duplicates this path exists to prevent
+                    try:
+                        os.killpg(pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        # pre-setsid race: fall back to the lone pid
+                        try:
+                            os.kill(pid, signal.SIGKILL)
+                        except ProcessLookupError:
+                            pass
+                close_conn(conn)
 
 
 def main() -> None:

@@ -117,11 +117,22 @@ class ZygoteClient:
                     raise
                 return s
 
+            s = None
+            writer = None
             s = await asyncio.to_thread(handshake)
             reader, writer = await asyncio.open_unix_connection(sock=s)
             line = await asyncio.wait_for(reader.readline(), 30.0)
             pid = json.loads(line)["pid"]
         except (OSError, ValueError, KeyError, asyncio.TimeoutError):
+            # post-handshake failure: the zygote may have already forked a
+            # child for this request. Closing the reply socket (never leak
+            # its fd — advisor r04) is the zygote's signal to SIGKILL that
+            # orphan before the caller falls back to exec and starts a
+            # duplicate.
+            if writer is not None:
+                writer.close()
+            elif s is not None:
+                s.close()
             for fd in (stdout_r, stderr_r):
                 os.close(fd)
             raise

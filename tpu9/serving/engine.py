@@ -97,6 +97,15 @@ class InferenceEngine:
                 raise ValueError(
                     f"prefill_chunk {chunk} must be a multiple of "
                     f"kv_block_size {bs}")
+            if s % chunk:
+                # with S % C != 0 the final chunk of a long prompt starts
+                # at an offset where offset + C > S; dynamic_update_slice
+                # CLAMPS the write start backwards, silently overwriting
+                # valid prefix KV (advisor r04). Reject loudly instead.
+                raise ValueError(
+                    f"max_seq_len {s} must be a multiple of "
+                    f"prefill_chunk {chunk}")
+            self._chunk = chunk     # the validated value IS the used value
             # +1: one dedicated TRASH block absorbs splice writes of the
             # padded tail of a non-block-aligned final chunk
             n_blocks = (engine_cfg.kv_pool_blocks or (b * s // bs)) + 1
@@ -123,8 +132,6 @@ class InferenceEngine:
             self._slot_blocks: list[list[int]] = [[] for _ in range(b)]
             self._slot_reserved = [0] * b
             self._table_np = np.zeros((b, self._mb), dtype=np.int32)
-            self._chunk = engine_cfg.prefill_chunk \
-                or min(engine_cfg.prefill_buckets)
             # batch-1 dense scratch the chunked prefill writes through
             # before splicing into pool blocks — ONE lane, not B of them
             self._scratch = init_kv_cache(cfg, 1, s)
@@ -487,6 +494,15 @@ class InferenceEngine:
             if self.ecfg.prefix_cache_blocks > 0 else None
         shared: list[int] = list(entry.blocks) if entry else []
         p = entry.n_tokens if entry else 0
+        # cached prefixes land on BLOCK boundaries, chunk windows on CHUNK
+        # boundaries; an unaligned p would put the final window past
+        # max_seq_len where dynamic_update_slice clamps its start backwards
+        # over valid prefix KV (advisor r04). Round p down to a chunk
+        # multiple: positions [p', p) are recomputed and re-spliced with
+        # bit-identical values (KV at position t depends only on tokens
+        # <= t, which the cached prefix shares), so overwriting the shared
+        # blocks is value-safe.
+        p -= p % self._chunk
         self.allocator.retain(shared)
 
         total_blocks = blocks_for(n + 1, bs)
