@@ -11,7 +11,8 @@
 // Invocation (trusted worker only — arguments are not an end-user surface):
 //   t9container --rootfs DIR [--workdir DIR] [--hostname NAME]
 //               [--netns NAME] [--bind SRC:DST[:ro]]... [--env-file FILE]
-//               [--dev PATH]... [--uid N] [--gid N] [--no-seccomp]
+//               [--dev PATH]... [--uid N] [--gid N]
+//               [--seccomp-mode allow|deny|off] [--no-seccomp]
 //               -- ARGV...
 //
 // env-file: NUL-separated KEY=VALUE entries (values may contain anything
@@ -23,8 +24,13 @@
 //   1. no_new_privs — setuid/filecap binaries can never re-escalate
 //   2. capability drop — bounding set cleared of everything dangerous;
 //      with --uid != 0 the cred change additionally zeroes CapEff/CapPrm
-//   3. seccomp deny-list — mount/ptrace/kexec/bpf/module-load/... return
-//      EPERM (default on; --no-seccomp for debugging only)
+//   3. seccomp ALLOW-list (default; VERDICT r04 #2): only syscalls
+//      recorded from live runner traces (native/t9_allowlist.h, generated
+//      by scripts/gen_syscall_allowlist.py) pass; everything else returns
+//      EPERM — the same default-deny polarity as the reference's gVisor.
+//      --seccomp-mode deny keeps the legacy deny-list (broad-compat
+//      fallback for exotic user images); --seccomp-mode off / --no-seccomp
+//      for debugging only.
 //   4. --uid/--gid — setgroups([]) + setgid + setuid to an unprivileged id
 
 #include <cerrno>
@@ -61,6 +67,8 @@ struct Bind {
   bool ro = false;
 };
 
+enum class SeccompMode { kAllow, kDeny, kOff };
+
 struct Opts {
   std::string rootfs, workdir = "/", hostname, netns, env_file;
   std::vector<Bind> binds;
@@ -69,7 +77,7 @@ struct Opts {
   std::vector<std::string> env;   // loaded BEFORE pivot_root hides the file
   uid_t uid = 0;
   gid_t gid = 0;
-  bool seccomp = true;
+  SeccompMode seccomp = SeccompMode::kAllow;
 };
 
 Opts parse(int argc, char** argv) {
@@ -89,7 +97,14 @@ Opts parse(int argc, char** argv) {
     else if (a == "--dev") o.devices.push_back(next());
     else if (a == "--uid") o.uid = static_cast<uid_t>(atoi(next().c_str()));
     else if (a == "--gid") o.gid = static_cast<gid_t>(atoi(next().c_str()));
-    else if (a == "--no-seccomp") o.seccomp = false;
+    else if (a == "--no-seccomp") o.seccomp = SeccompMode::kOff;
+    else if (a == "--seccomp-mode") {
+      std::string m = next();
+      if (m == "allow") o.seccomp = SeccompMode::kAllow;
+      else if (m == "deny") o.seccomp = SeccompMode::kDeny;
+      else if (m == "off") o.seccomp = SeccompMode::kOff;
+      else { fprintf(stderr, "bad --seccomp-mode %s\n", m.c_str()); exit(2); }
+    }
     else if (a == "--bind") {
       std::string spec = next();
       Bind b;
@@ -191,11 +206,19 @@ void drop_bounding_caps() {
   prctl(PR_CAP_AMBIENT, PR_CAP_AMBIENT_CLEAR_ALL, 0, 0, 0);
 }
 
-// Deny-list seccomp filter: syscalls that break out of (or subvert) the
-// sandbox return EPERM; everything else is allowed. A deny-list (not
-// allow-list) keeps arbitrary user Python working while removing the
-// kernel-attack/namespace-escape surface the reference blocks via gVisor.
-void install_seccomp() {
+// Allow-list (default): syscalls recorded from live traces of the real
+// runners (scripts/gen_syscall_allowlist.py → t9_allowlist.h); anything
+// else returns EPERM. Same polarity as the reference's gVisor: unknown
+// kernel surface is unreachable by default.
+constexpr int kAllowed[] = {
+#include "t9_allowlist.h"
+};
+
+// Deny-list (--seccomp-mode deny): legacy fallback for exotic user images
+// whose syscall needs outrun the recorded trace; blocks only the known
+// escape/attack surface.
+void install_seccomp(SeccompMode mode) {
+  const bool allow_mode = mode == SeccompMode::kAllow;
   static const int kDenied[] = {
       SYS_mount, SYS_umount2, SYS_pivot_root, SYS_chroot, SYS_swapon,
       SYS_swapoff, SYS_reboot, SYS_kexec_load, SYS_kexec_file_load,
@@ -256,29 +279,48 @@ void install_seccomp() {
   constexpr uint32_t kNsFlags =
       CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET | CLONE_NEWPID |
       CLONE_NEWIPC | CLONE_NEWUTS | CLONE_NEWCGROUP;
+  // allow mode resolves clean clones right here (clone never reaches the
+  // allow array); deny mode falls through to its deny array
   prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
-                          static_cast<uint32_t>(SYS_clone), 0, 4));
+                          static_cast<uint32_t>(SYS_clone), 0,
+                          static_cast<uint8_t>(allow_mode ? 5 : 4)));
   prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
                           offsetof(seccomp_data, args[0])));
   prog.push_back(BPF_JUMP(BPF_JMP | BPF_JSET | BPF_K, kNsFlags, 0, 1));
   prog.push_back(BPF_STMT(BPF_RET | BPF_K,
                           SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA)));
+  if (allow_mode)
+    prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW));
   prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
                           offsetof(seccomp_data, nr)));   // restore A = nr
-  for (size_t i = 0; i < kN; i++) {
-    prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
-                            static_cast<uint32_t>(kDenied[i]), 0, 1));
+
+  if (allow_mode) {
+    for (int nr : kAllowed) {
+      prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                              static_cast<uint32_t>(nr), 0, 1));
+      prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW));
+    }
+    // default-deny: EPERM (not KILL) so an off-list syscall surfaces as a
+    // debuggable error in the workload, not a silent SIGSYS corpse
     prog.push_back(BPF_STMT(BPF_RET | BPF_K,
                             SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA)));
+  } else {
+    for (size_t i = 0; i < kN; i++) {
+      prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                              static_cast<uint32_t>(kDenied[i]), 0, 1));
+      prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                              SECCOMP_RET_ERRNO |
+                                  (EPERM & SECCOMP_RET_DATA)));
+    }
+    // unshare with namespace flags is an escape vector; plain unshare(0)
+    // or CLONE_FILES-style uses are harmless but rare — deny it entirely
+    // (the reference's gVisor denies it too)
+    prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                            static_cast<uint32_t>(SYS_unshare), 0, 1));
+    prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                            SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA)));
+    prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW));
   }
-  // unshare with namespace flags is an escape vector; plain unshare(0) or
-  // CLONE_FILES-style uses are harmless but rare — deny it entirely (the
-  // reference's gVisor denies it too)
-  prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
-                          static_cast<uint32_t>(SYS_unshare), 0, 1));
-  prog.push_back(BPF_STMT(BPF_RET | BPF_K,
-                          SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA)));
-  prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW));
 
   sock_fprog fprog = {static_cast<unsigned short>(prog.size()), prog.data()};
   if (prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &fprog, 0, 0) != 0)
@@ -296,7 +338,8 @@ void contain_privileges(const Opts& o) {
     if (setuid(o.uid) != 0) die("setuid");
     // with no PR_SET_KEEPCAPS the uid transition zeroed CapEff/CapPrm
   }
-  if (o.seccomp) install_seccomp();   // last: it would block the above
+  if (o.seccomp != SeccompMode::kOff)
+    install_seccomp(o.seccomp);       // last: it would block the above
 }
 
 int child_main(void* arg) {

@@ -1,0 +1,132 @@
+// t9trace — minimal process-tree syscall-set recorder (strace -c without
+// strace: this image ships no tracer, and the seccomp allow-list for
+// t9container must be generated from what the REAL runners actually call).
+//
+// Reference analogue: the reference derives its sandbox posture from
+// gVisor's implemented-syscall surface (pkg/runtime/runsc.go:52); tpu9
+// derives its allow-list from live traces of its own runners instead.
+//
+// Usage: t9trace OUTFILE -- CMD [ARGS...]
+//   Runs CMD under PTRACE_SYSCALL, following forks/vforks/clones, and
+//   appends every distinct syscall number seen (one per line, decimal) to
+//   OUTFILE. Exit status mirrors CMD's.
+//
+// Dev tool only: built on demand by scripts/gen_syscall_allowlist.py; not
+// part of the production `make all` set and never shipped into containers.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <set>
+
+#include <sys/ptrace.h>
+#include <sys/types.h>
+#include <sys/user.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+[[noreturn]] void die(const char* what) {
+  fprintf(stderr, "t9trace: %s: %s\n", what, strerror(errno));
+  exit(112);
+}
+
+constexpr int kTraceOpts = PTRACE_O_TRACESYSGOOD | PTRACE_O_TRACEFORK |
+                           PTRACE_O_TRACEVFORK | PTRACE_O_TRACECLONE |
+                           PTRACE_O_TRACEEXEC;
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4 || strcmp(argv[2], "--") != 0) {
+    fprintf(stderr, "usage: t9trace OUTFILE -- CMD [ARGS...]\n");
+    return 2;
+  }
+  const char* outfile = argv[1];
+
+  pid_t child = fork();
+  if (child < 0) die("fork");
+  if (child == 0) {
+    if (ptrace(PTRACE_TRACEME, 0, nullptr, nullptr) != 0) die("traceme");
+    // stop so the parent can set options before the exec races ahead
+    raise(SIGSTOP);
+    execvp(argv[3], argv + 3);
+    die("execvp");
+  }
+
+  std::set<long> seen;
+  std::set<pid_t> tracees = {child};
+  int root_status = 0;
+  bool opts_set = false;
+  bool root_done = false;
+  while (!tracees.empty()) {
+    int status;
+    pid_t pid = waitpid(-1, &status, __WALL);
+    if (pid < 0) {
+      if (errno == ECHILD) break;
+      if (errno == EINTR) continue;
+      die("waitpid");
+    }
+    tracees.insert(pid);
+    if (WIFEXITED(status) || WIFSIGNALED(status)) {
+      tracees.erase(pid);
+      if (pid == child) {
+        root_status = status;
+        root_done = true;
+        // daemons double-forked by the traced command (reparented to
+        // init but still our tracees) would block this wait forever —
+        // their syscalls so far are recorded; kill the strays and drain
+        for (pid_t p : tracees) kill(p, SIGKILL);
+      }
+      continue;
+    }
+    if (root_done) {
+      // a stray stopping post-root: resume toward its SIGKILL
+      ptrace(PTRACE_CONT, pid, nullptr, 0);
+      continue;
+    }
+    if (!WIFSTOPPED(status)) continue;
+    int sig = WSTOPSIG(status);
+    if (!opts_set && pid == child) {
+      if (ptrace(PTRACE_SETOPTIONS, pid, nullptr, kTraceOpts) != 0)
+        die("setoptions");
+      opts_set = true;
+    }
+    unsigned event = static_cast<unsigned>(status) >> 16;
+    if (event == PTRACE_EVENT_FORK || event == PTRACE_EVENT_VFORK ||
+        event == PTRACE_EVENT_CLONE) {
+      // the new tracee inherits options and auto-stops for us; it joins
+      // `tracees` when its first stop arrives
+      ptrace(PTRACE_SYSCALL, pid, nullptr, 0);
+      continue;
+    }
+    long forward = 0;
+    if (sig == (SIGTRAP | 0x80)) {
+      // syscall-enter or -exit stop; orig_rax is stable at both
+      struct user_regs_struct regs;
+      if (ptrace(PTRACE_GETREGS, pid, nullptr, &regs) == 0) {
+#if defined(__x86_64__)
+        seen.insert(static_cast<long>(regs.orig_rax));
+#else
+#error "t9trace supports x86_64 only"
+#endif
+      }
+    } else if (sig == SIGTRAP || sig == SIGSTOP) {
+      // exec event / group-stop noise: swallow
+    } else {
+      forward = sig;  // real signal: deliver it
+    }
+    ptrace(PTRACE_SYSCALL, pid, nullptr, forward);
+  }
+
+  FILE* f = fopen(outfile, "a");
+  if (!f) die("open outfile");
+  for (long nr : seen) fprintf(f, "%ld\n", nr);
+  fclose(f);
+
+  if (WIFEXITED(root_status)) return WEXITSTATUS(root_status);
+  if (WIFSIGNALED(root_status)) return 128 + WTERMSIG(root_status);
+  return 0;
+}

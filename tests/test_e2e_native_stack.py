@@ -139,3 +139,67 @@ def test_lazy_image_under_native_containment(monkeypatch):
     assert first["uid"] == 65534          # containment stacked on top
     assert read["sha"] == want
     assert lazy_used, "pull did not go through the lazy path"
+
+
+SECCOMP_PROBE_APP = """
+import ctypes, os
+
+libc = ctypes.CDLL(None, use_errno=True)
+
+def try_sys(nr, *args):
+    ctypes.set_errno(0)
+    r = libc.syscall(ctypes.c_long(nr), *[ctypes.c_long(a) for a in args])
+    return ctypes.get_errno() if r < 0 else 0
+
+def handler(**kwargs):
+    # x86_64 numbers: io_uring_setup=425 (off-list kernel surface),
+    # unshare=272 (namespace escape vector)
+    return {"io_uring_errno": try_sys(425, 4, 0),
+            "unshare_errno": try_sys(272, 0),
+            "pid": os.getpid()}
+"""
+
+
+def test_default_seccomp_is_allowlist(monkeypatch):
+    """VERDICT r04 #2 'Done': an off-list syscall (io_uring_setup) fails
+    EPERM inside the DEFAULT serving container — default-deny polarity —
+    while the endpoint itself (python + asyncio + sockets) runs normally."""
+    monkeypatch.setenv("TPU9_RUNTIME", "native")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from tpu9.testing.localstack import LocalStack
+
+    async def run():
+        async with LocalStack() as stack:
+            dep = await stack.deploy_endpoint(
+                "seccomp-probe", {"app.py": SECCOMP_PROBE_APP},
+                "app:handler")
+            return await stack.invoke(dep, {})
+
+    resp = asyncio.run(run())
+    import errno
+    assert resp["io_uring_errno"] == errno.EPERM, resp
+    assert resp["unshare_errno"] == errno.EPERM, resp
+    assert resp["pid"] > 0
+
+
+def test_seccomp_deny_fallback_mode(monkeypatch):
+    """--seccomp-mode deny (legacy polarity, via TPU9_SECCOMP_MODE): the
+    escape surface (unshare) still EPERMs but an off-list-yet-harmless
+    syscall like io_uring_setup reaches the kernel (errno reflects its own
+    arg validation — EFAULT/EINVAL/ENOSYS — never seccomp's EPERM)."""
+    monkeypatch.setenv("TPU9_RUNTIME", "native")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TPU9_SECCOMP_MODE", "deny")
+    from tpu9.testing.localstack import LocalStack
+
+    async def run():
+        async with LocalStack() as stack:
+            dep = await stack.deploy_endpoint(
+                "seccomp-deny-probe", {"app.py": SECCOMP_PROBE_APP},
+                "app:handler")
+            return await stack.invoke(dep, {})
+
+    resp = asyncio.run(run())
+    import errno
+    assert resp["unshare_errno"] == errno.EPERM, resp
+    assert resp["io_uring_errno"] != errno.EPERM, resp

@@ -52,6 +52,10 @@ class ContainerSpec:
     # (reference analogue: base_runc_config.json's hardened spec + gVisor).
     run_as_uid: int = 0
     run_as_gid: int = 0
+    # seccomp polarity: "" = binary default (allow-list, VERDICT r04 #2);
+    # "deny" = legacy deny-list fallback for user images whose syscall
+    # needs outrun the recorded trace; "off" = debugging only
+    seccomp_mode: str = ""
 
 
 @dataclass

@@ -358,6 +358,8 @@ class NativeRuntime(Runtime):
                "--hostname", spec.container_id[:32],
                "--netns", self._netns(spec.container_id),
                "--env-file", env_file]
+        if spec.seccomp_mode:
+            cmd += ["--seccomp-mode", spec.seccomp_mode]
         if spec.run_as_uid or spec.run_as_gid:
             cmd += ["--uid", str(spec.run_as_uid),
                     "--gid", str(spec.run_as_gid)]

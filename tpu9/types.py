@@ -415,6 +415,10 @@ class ContainerRequest(_Serializable):
     # dir left by the old incarnation
     disk_ids: dict[str, str] = field(default_factory=dict)
     disk_affinity: str = ""
+    # seccomp polarity override for this container: "" = runtime default
+    # (trace-generated allow-list); "deny" = legacy deny-list for images
+    # whose syscall needs outrun the recorded trace (VERDICT r04 #2)
+    seccomp_mode: str = ""
     retry_count: int = 0
     timestamp: float = field(default_factory=now)
 

@@ -648,6 +648,8 @@ class ContainerLifecycle:
             # (runner_env / gang env), never taken from tenant env
             cp_env_keys=["TPU9_GATEWAY_URL", "TPU9_COORDINATOR_ADDR"],
             run_as_uid=run_as, run_as_gid=run_as,
+            seccomp_mode=request.seccomp_mode
+            or os.environ.get("TPU9_SECCOMP_MODE", ""),
         )
 
     async def _wait_tcp(self, container_id: str, address: str,
