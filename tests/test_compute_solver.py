@@ -1,0 +1,139 @@
+"""Marketplace compute solver (VERDICT r04 #9).
+
+Reference analogue: ``pkg/compute/solver.go`` Solve (cost-minimizing
+offer selection over reservations + offers) and ``state.go`` reservation
+lifecycle; tpu9's demand speaks TPU shapes.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tpu9.compute import (Demand, Offer, Plan, Reservation, Solver,
+                          eligible)
+
+
+def _offer(oid, cost, gen="v5e", chips=4, available=2, reliability=1.0,
+           **kw):
+    return Offer(offer_id=oid, tpu_generation=gen, tpu_chips=chips,
+                 hourly_cost_micros=cost, available=available,
+                 reliability=reliability, **kw)
+
+
+def test_solver_picks_cheapest_eligible():
+    offers = [_offer("exp", 5_000_000), _offer("cheap", 1_000_000),
+              _offer("mid", 2_000_000),
+              _offer("wrong-gen", 100, gen="v4")]
+    plan = Solver().solve(Demand(nodes=1, tpu_generation="v5e",
+                                 tpu_chips=4), offers)
+    assert plan.feasible
+    creates = [a for a in plan.actions if a.kind == "create"]
+    assert len(creates) == 1 and creates[0].offer.offer_id == "cheap"
+    assert plan.new_cost_micros == 1_000_000
+
+
+def test_solver_spills_to_next_cheapest_when_availability_runs_out():
+    offers = [_offer("cheap", 1_000_000, available=2),
+              _offer("mid", 2_000_000, available=5)]
+    plan = Solver().solve(Demand(nodes=4, tpu_generation="v5e",
+                                 tpu_chips=4, ttl_hours=2), offers)
+    assert plan.feasible
+    by_offer = {a.offer.offer_id: a.nodes for a in plan.actions
+                if a.kind == "create"}
+    assert by_offer == {"cheap": 2, "mid": 2}
+    # 2 nodes * 1M * 2h + 2 nodes * 2M * 2h
+    assert plan.new_cost_micros == 2 * 1_000_000 * 2 + 2 * 2_000_000 * 2
+
+
+def test_solver_reuses_reservations_before_renting():
+    now = time.time()
+    held = Reservation("r1", _offer("held", 3_000_000), nodes=1,
+                       status="active", hourly_cost_micros=3_000_000)
+    stale = Reservation("r2", _offer("dead", 1, gen="v4"), nodes=1,
+                        status="active")
+    expired = Reservation("r3", _offer("old", 1), nodes=1, status="active",
+                          expires_at=now - 10)
+    plan = Solver().solve(
+        Demand(nodes=2, tpu_generation="v5e", tpu_chips=4),
+        [_offer("cheap", 1_000_000)], [held, stale, expired], now=now)
+    assert plan.feasible
+    kinds = {a.reservation_id or a.offer.offer_id: a.kind
+             for a in plan.actions}
+    assert kinds["r1"] == "keep"
+    assert kinds["r2"] == "delete"      # wrong shape → released
+    assert kinds["r3"] == "delete"      # expired → released
+    assert kinds["cheap"] == "create"   # only ONE new node rented
+    assert plan.existing_nodes == 1 and plan.total_nodes == 2
+
+
+def test_solver_enforces_max_spend_and_capacity():
+    offers = [_offer("only", 10_000_000, available=1)]
+    over = Solver().solve(Demand(nodes=1, tpu_generation="v5e",
+                                 tpu_chips=4, max_spend_micros=5_000_000),
+                          offers)
+    assert not over.feasible and "spend" in over.reason
+    short = Solver().solve(Demand(nodes=3, tpu_generation="v5e",
+                                  tpu_chips=4), offers)
+    assert not short.feasible and "capacity" in short.reason
+
+
+def test_eligibility_filters():
+    o = _offer("x", 100, reliability=0.8, available=1)
+    assert eligible(o, Demand(tpu_generation="v5e", tpu_chips=4))
+    assert not eligible(o, Demand(tpu_generation="v5e", tpu_chips=8))
+    assert not eligible(o, Demand(min_reliability=0.9))
+    assert not eligible(o, Demand(providers=("vendorx",)))
+    assert not eligible(o, Demand(offer_id="other"))
+    assert eligible(o, Demand(offer_id="x"))
+
+
+def test_agent_pool_places_on_cheapest_machine():
+    """The VERDICT 'Done' criterion: a request lands on the cheapest
+    ELIGIBLE machine offer, not the least-loaded one."""
+    from tpu9.config import WorkerPoolConfig
+    from tpu9.repository.keys import Keys
+    from tpu9.scheduler.pools import AgentMachinePool
+    from tpu9.statestore import MemoryStore
+    from tpu9.types import ContainerRequest
+
+    machines = [
+        {"machine_id": "m-exp", "status": "registered", "max_workers": 4,
+         "tpu_generation": "v5e", "tpu_chips": 4,
+         "hourly_cost_micros": 9_000_000, "reliability": 1.0},
+        {"machine_id": "m-cheap", "status": "registered", "max_workers": 1,
+         "tpu_generation": "v5e", "tpu_chips": 4,
+         "hourly_cost_micros": 1_000_000, "reliability": 1.0},
+        {"machine_id": "m-wrong", "status": "registered", "max_workers": 4,
+         "tpu_generation": "v4", "tpu_chips": 4,
+         "hourly_cost_micros": 10, "reliability": 1.0},
+    ]
+
+    class FakeBackend:
+        async def list_machines(self, pool):
+            return [dict(m) for m in machines]
+
+    async def run():
+        store = MemoryStore()
+        for m in machines:
+            await store.set(Keys.machine_heartbeat(m["machine_id"]), "1")
+        pool = AgentMachinePool(WorkerPoolConfig(name="edge"),
+                                FakeBackend(), store)
+        req = ContainerRequest(container_id="ct-1", tpu="v5e-4")
+        assert await pool.can_host(req)
+        # first placement → cheapest machine
+        await pool.add_worker(req)
+        assert int(await store.get(Keys.machine_desired("m-cheap"))) == 1
+        assert await store.get(Keys.machine_desired("m-exp")) is None
+        # cheapest is now full (max_workers=1) → spills to next-cheapest
+        # eligible, never the wrong-generation bargain
+        await pool.add_worker(ContainerRequest(container_id="ct-2",
+                                               tpu="v5e-4"))
+        assert int(await store.get(Keys.machine_desired("m-exp"))) == 1
+        assert await store.get(Keys.machine_desired("m-wrong")) is None
+        # reservations recorded at the committed rate
+        resv = await store.hgetall(Keys.machine_reservations("edge"))
+        rates = sorted(v["hourly_cost_micros"] for v in resv.values())
+        assert rates == [1_000_000, 9_000_000]
+
+    asyncio.run(run())

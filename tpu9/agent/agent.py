@@ -50,7 +50,14 @@ def preflight() -> dict:
     generation = os.environ.get("TPU9_TPU_GEN", "") if chips else ""
     return {"hostname": socket.gethostname(),
             "cpu_millicores": cpu_millicores, "memory_mb": memory_mb,
-            "tpu_chips": chips, "tpu_generation": generation}
+            "tpu_chips": chips, "tpu_generation": generation,
+            # marketplace offer terms, operator-declared (reference
+            # pkg/compute ComputeOffer.HourlyCostMicros/Reliability); the
+            # solver in AgentMachinePool ranks machines by these
+            "hourly_cost_micros": int(
+                os.environ.get("TPU9_HOURLY_COST_MICROS", "0") or 0),
+            "reliability": float(
+                os.environ.get("TPU9_RELIABILITY", "1.0") or 1.0)}
 
 
 class Agent:

@@ -227,4 +227,10 @@ MIGRATIONS: list[tuple[int, str, str]] = [
             last_seen REAL DEFAULT 0
         );
     """),
+    # marketplace pricing on BYOC machines (reference pkg/compute offers;
+    # solver.go cost-minimizing selection reads these as Offer rows)
+    (21, "machine_pricing", """
+        ALTER TABLE machines ADD COLUMN hourly_cost_micros INTEGER DEFAULT 0;
+        ALTER TABLE machines ADD COLUMN reliability REAL DEFAULT 1.0;
+    """),
 ]

@@ -1,0 +1,13 @@
+"""Marketplace compute: offers, reservations, cost-minimizing solver.
+
+Reference analogue: ``/root/reference/pkg/compute/`` (solver, vendor
+adapters, rental state). tpu9 ships the solver core and wires it into
+AgentMachinePool's machine selection; vendor adapters are the declared
+growth point.
+"""
+
+from .solver import (Action, Demand, Offer, Plan, Reservation, Solver,
+                     eligible, offer_sort_key)
+
+__all__ = ["Action", "Demand", "Offer", "Plan", "Reservation", "Solver",
+           "eligible", "offer_sort_key"]

@@ -1,0 +1,209 @@
+"""Marketplace compute solver: cost-minimizing offer selection.
+
+Reference analogue: ``/root/reference/pkg/compute/solver.go:18`` (Solver +
+SolveInput/SolvePlan), ``types.go`` ComputeOffer/ComputeDemand/
+ComputeReservation, and the rental state machine ``state.go:73-109``
+(pending → active → terminating → deleted). The reference fronts GPU
+vendor aggregators (vast.go, hetzner.go); tpu9's offers describe TPU
+hosts — BYOC agent machines with operator-set prices today, cloud vendor
+adapters later — and the demand speaks TPU shapes (generation ×
+chips-per-host) instead of GPU SKU strings.
+
+Design: pure functions over dataclasses (no IO) so the same solver runs
+inside AgentMachinePool (pick the cheapest eligible machine), in a future
+vendor-rental controller, and in unit tests. The reference's bounded
+enumeration (solver.go:259 solveBounded) is replaced by a greedy
+cheapest-cost-per-node pass — optimal whenever offers are independent
+(no cross-offer bundle discounts, which tpu9 does not model), and O(n log
+n) instead of exponential.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Reservation lifecycle (reference state.go:73-109)
+RES_PENDING = "pending"
+RES_ACTIVE = "active"
+RES_TERMINATING = "terminating"
+RES_DELETED = "deleted"
+RES_FAILED = "failed"
+
+
+@dataclass(frozen=True)
+class Offer:
+    """One rentable host shape at a price (reference ComputeOffer)."""
+
+    offer_id: str
+    provider: str = "agent"        # "agent" = BYOC machine; vendor name later
+    region: str = ""
+    instance_type: str = ""
+    tpu_generation: str = ""       # "" = CPU-only host
+    tpu_chips: int = 0             # chips per node
+    cpu_millicores: int = 0
+    memory_mb: int = 0
+    hourly_cost_micros: int = 0    # micro-USD per node-hour; 0 = free (BYOC)
+    reliability: float = 1.0       # 0..1 (vendor SLA / observed uptime)
+    available: int = 1             # rentable node count at this price
+    labels: dict = field(default_factory=dict)
+
+    def cost_per_node(self) -> int:
+        return self.hourly_cost_micros
+
+
+@dataclass(frozen=True)
+class Demand:
+    """What a pool needs (reference ComputeDemand, TPU-shaped)."""
+
+    nodes: int = 1
+    tpu_generation: str = ""       # "" = any/CPU
+    tpu_chips: int = 0             # min chips per node
+    cpu_millicores: int = 0        # min per node
+    memory_mb: int = 0             # min per node
+    ttl_hours: int = 1             # whole lease hours (cost = rate × ttl)
+    max_spend_micros: int = 0      # 0 = unbounded
+    providers: tuple = ()          # restrict to these providers ("" = any)
+    regions: tuple = ()
+    min_reliability: float = 0.0
+    offer_id: str = ""             # pin to one specific offer
+
+
+@dataclass
+class Reservation:
+    """A rented node-set (reference ComputeReservation + state.go)."""
+
+    reservation_id: str
+    offer: Offer
+    nodes: int
+    status: str = RES_PENDING
+    created_at: float = field(default_factory=time.time)
+    expires_at: float = 0.0        # 0 = no expiry
+    hourly_cost_micros: int = 0    # committed rate (nodes × offer rate)
+
+    def usable(self, now: float) -> bool:
+        return (self.status in (RES_PENDING, RES_ACTIVE)
+                and (self.expires_at == 0 or self.expires_at > now))
+
+
+@dataclass(frozen=True)
+class Action:
+    """One step of a plan: keep/delete an existing reservation or create
+    a new one on an offer (reference SolveAction)."""
+
+    kind: str                      # "keep" | "delete" | "create"
+    reservation_id: str = ""
+    offer: Optional[Offer] = None
+    nodes: int = 0
+    cost_micros: int = 0           # lease cost for "create" (rate × ttl)
+
+
+@dataclass
+class Plan:
+    feasible: bool
+    reason: str = ""
+    actions: list = field(default_factory=list)
+    total_nodes: int = 0
+    existing_nodes: int = 0
+    new_cost_micros: int = 0       # this solve's added lease commitment
+    committed_cost_micros: int = 0  # hourly rate already committed (kept)
+
+
+def eligible(offer: Offer, demand: Demand) -> bool:
+    """The one eligibility predicate solve/can_host share (mirrors
+    AgentMachinePool._eligible's role for machines)."""
+    if demand.offer_id and offer.offer_id != demand.offer_id:
+        return False
+    if demand.providers and offer.provider not in demand.providers:
+        return False
+    if demand.regions and offer.region not in demand.regions:
+        return False
+    if offer.reliability < demand.min_reliability:
+        return False
+    if demand.tpu_generation and offer.tpu_generation != demand.tpu_generation:
+        return False
+    if offer.tpu_chips < demand.tpu_chips:
+        return False
+    if offer.cpu_millicores < demand.cpu_millicores:
+        return False
+    if offer.memory_mb < demand.memory_mb:
+        return False
+    return offer.available > 0
+
+
+def offer_sort_key(offer: Offer):
+    """Canonical cost-minimizing ranking — shared by Solver.solve and
+    AgentMachinePool so placement order can never diverge from plan
+    order: cheapest first, then most reliable, then most available."""
+    return (offer.cost_per_node(), -offer.reliability, -offer.available)
+
+
+class Solver:
+    """Cost-minimizing planner (reference solver.go:18 Solve)."""
+
+    def __init__(self, max_offers: int = 32):
+        self.max_offers = max_offers
+
+    def solve(self, demand: Demand, offers: list[Offer],
+              reservations: list[Reservation] = (),
+              now: float = 0.0) -> Plan:
+        now = now or time.time()
+        if demand.nodes <= 0:
+            return Plan(feasible=False, reason="demand.nodes must be > 0")
+
+        # 1) existing reservations: keep what still serves the demand,
+        #    delete what is expired/failed or no longer eligible
+        actions: list[Action] = []
+        existing = 0
+        committed = 0
+        for r in reservations or ():
+            if r.usable(now) and eligible(r.offer, demand):
+                actions.append(Action("keep", reservation_id=r.reservation_id,
+                                      nodes=r.nodes))
+                existing += r.nodes
+                committed += r.hourly_cost_micros
+            else:
+                actions.append(Action("delete",
+                                      reservation_id=r.reservation_id))
+        if existing >= demand.nodes:
+            return Plan(feasible=True, actions=actions,
+                        total_nodes=existing, existing_nodes=existing,
+                        committed_cost_micros=committed)
+
+        # 2) cheapest-first greedy over eligible offers
+        needed = demand.nodes - existing
+        candidates = sorted(
+            (o for o in offers if eligible(o, demand)),
+            key=offer_sort_key)[:self.max_offers]
+        new_cost = 0
+        total_new = 0
+        for o in candidates:
+            if needed <= 0:
+                break
+            take = min(needed, o.available)
+            lease = o.cost_per_node() * take * max(demand.ttl_hours, 1)
+            actions.append(Action("create", offer=o, nodes=take,
+                                  cost_micros=lease))
+            new_cost += lease
+            total_new += take
+            needed -= take
+        if needed > 0:
+            return Plan(feasible=False,
+                        reason="insufficient compatible capacity",
+                        actions=[a for a in actions
+                                 if a.kind != "create"],
+                        existing_nodes=existing,
+                        committed_cost_micros=committed)
+        if demand.max_spend_micros and \
+                committed + new_cost > demand.max_spend_micros:
+            return Plan(feasible=False,
+                        reason="max spend would be exceeded",
+                        actions=[a for a in actions
+                                 if a.kind != "create"],
+                        existing_nodes=existing,
+                        committed_cost_micros=committed)
+        return Plan(feasible=True, actions=actions,
+                    total_nodes=existing + total_new,
+                    existing_nodes=existing, new_cost_micros=new_cost,
+                    committed_cost_micros=committed)

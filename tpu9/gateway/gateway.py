@@ -1821,7 +1821,9 @@ class Gateway:
             int(data.get("cpu_millicores", 0)),
             int(data.get("memory_mb", 0)),
             int(data.get("tpu_chips", 0)),
-            data.get("tpu_generation", ""))
+            data.get("tpu_generation", ""),
+            hourly_cost_micros=int(data.get("hourly_cost_micros", 0)),
+            reliability=float(data.get("reliability", 1.0)))
         if m is None:
             # invalid OR already-consumed token — indistinguishable on
             # purpose (don't confirm which tokens once existed)

@@ -82,6 +82,10 @@ class Keys:
     def machine_heartbeat(machine_id: str) -> str:     # telemetry, TTL'd
         return f"machine:hb:{machine_id}"
 
+    @staticmethod
+    def machine_reservations(pool: str) -> str:        # hash rid -> record
+        return f"machine:resv:{pool}"
+
     # -- bot (petri-net orchestration) ---------------------------------------
 
     @staticmethod

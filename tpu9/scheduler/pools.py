@@ -228,11 +228,19 @@ class AgentMachinePool(WorkerPoolController):
     """Capacity backed by operator-owned machines running ``tpu9 agent``
     (reference ``pkg/agent`` + ``pool_agent.go``): each registered machine
     polls its desired worker-slot count and reconciles local worker
-    processes against it. ``add_worker`` just bumps the least-loaded
-    machine's desired count — the agent does the spawning, and the workers
-    register through the normal path."""
+    processes against it. ``add_worker`` ranks the machines' offers with
+    the marketplace ordering (price + reliability advertised at join —
+    ``tpu9.compute.offer_sort_key``, reference pkg/compute/solver.go:18)
+    and bumps the CHEAPEST eligible machine's desired count — the agent
+    does the spawning, and the workers register through the normal path.
+    Each placement is recorded as a reservation (reference
+    state.go:73-109) in the statestore."""
 
     name = "agent"
+
+    # reservation records live this long past placement — observability
+    # only (billing reads usage metering, not reservations)
+    RESERVATION_TTL_S = 24 * 3600.0
 
     def __init__(self, cfg: WorkerPoolConfig, backend, store):
         self.cfg = cfg
@@ -253,22 +261,40 @@ class AgentMachinePool(WorkerPoolController):
             out.append(m)
         return out
 
+    def _demand(self, request: ContainerRequest):
+        from ..compute import Demand
+        spec = request.tpu_spec()
+        return Demand(
+            nodes=1,
+            tpu_generation=spec.generation if spec is not None else "",
+            tpu_chips=spec.chips_per_host if spec is not None else 0)
+
+    def _offers(self, machines: list[dict]) -> list:
+        from ..compute import Offer
+        return [Offer(offer_id=m["machine_id"], provider="agent",
+                      tpu_generation=m["tpu_generation"],
+                      tpu_chips=m["tpu_chips"],
+                      hourly_cost_micros=int(
+                          m.get("hourly_cost_micros") or 0),
+                      reliability=float(m.get("reliability") or 1.0),
+                      available=m["max_workers"] - m["desired"])
+                for m in machines]
+
     async def _eligible(self, request: ContainerRequest) -> list[dict]:
-        """Machines with a free slot that satisfy the request's TPU shape —
-        the ONE eligibility predicate can_host/add_worker share."""
+        """Machines with a free slot that satisfy the request's TPU shape,
+        CHEAPEST FIRST (solver ranking) — the ONE eligibility+ordering
+        path can_host/add_worker share."""
+        from ..compute import eligible, offer_sort_key
         spec = request.tpu_spec()
         if spec is not None and spec.multi_host:
             return []             # multi-host slices need the GCE pool
-        out = []
-        for m in await self._machines():
-            if m["desired"] >= m["max_workers"]:
-                continue
-            if spec is not None and (
-                    m["tpu_generation"] != spec.generation
-                    or m["tpu_chips"] < spec.chips_per_host):
-                continue
-            out.append(m)
-        return out
+        machines = await self._machines()
+        by_id = {m["machine_id"]: m for m in machines}
+        demand = self._demand(request)
+        ranked = sorted(
+            (o for o in self._offers(machines) if eligible(o, demand)),
+            key=offer_sort_key)
+        return [by_id[o.offer_id] for o in ranked]
 
     async def can_host(self, request: ContainerRequest) -> bool:
         return bool(await self._eligible(request))
@@ -282,17 +308,44 @@ class AgentMachinePool(WorkerPoolController):
             return
         # incr-then-check: two concurrent scale-ups (scheduler + pool
         # warmup) may both pass _eligible; the loser undoes its bump and
-        # tries the next machine, so desired can never wedge above max
-        for m in sorted(candidates, key=lambda m: m["desired"]):
+        # tries the next-cheapest machine, so desired can never wedge
+        # above max
+        for m in candidates:
             key = Keys.machine_desired(m["machine_id"])
             n = await self.store.incr(key)
             if n <= m["max_workers"]:
-                log.info("agent pool %s: machine %s desired -> %d",
-                         self.cfg.name, m["machine_id"], n)
+                log.info("agent pool %s: machine %s desired -> %d "
+                         "(%.2f USD/h)", self.cfg.name, m["machine_id"], n,
+                         int(m.get("hourly_cost_micros") or 0) / 1e6)
+                await self._record_reservation(m, request)
                 return
             await self.store.incr(key, by=-1, floor=0)
         log.warning("agent pool %s: all machines full for %s",
                     self.cfg.name, request.container_id)
+
+    async def _record_reservation(self, machine: dict,
+                                  request: ContainerRequest) -> None:
+        """Rental bookkeeping (reference state.go:73-109): which offer a
+        placement landed on and at what committed rate."""
+        from ..repository.keys import Keys
+        from ..types import new_id, now
+        rid = new_id("resv")
+        key = Keys.machine_reservations(self.cfg.name)
+        await self.store.hset(key, rid, {
+            "reservation_id": rid, "status": "active",
+            "machine_id": machine["machine_id"],
+            "container_id": request.container_id,
+            "hourly_cost_micros": int(
+                machine.get("hourly_cost_micros") or 0),
+            "created_at": now()})
+        # per-RECORD retention: a whole-hash TTL would be reset by every
+        # placement (records accumulating forever on a busy pool) — prune
+        # aged entries at insert instead
+        cutoff = now() - self.RESERVATION_TTL_S
+        stale = [f for f, v in (await self.store.hgetall(key)).items()
+                 if float(v.get("created_at", 0)) < cutoff]
+        if stale:
+            await self.store.hdel(key, *stale)
 
     async def worker_count(self) -> int:
         total = 0
