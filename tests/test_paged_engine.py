@@ -372,3 +372,58 @@ def test_max_seq_len_not_chunk_multiple_rejected(tiny):
         InferenceEngine(params, cfg, EngineConfig(
             max_batch=2, max_seq_len=192, kv_block_size=64,
             prefill_chunk=128))
+
+
+def test_fused_admission_dispatch_count(tiny):
+    """VERDICT r04 #6 'Done': a 2048-token prompt admits in a handful of
+    fused dispatches (16 chunks / group 4 = 4 scans), not 32 chunk+splice
+    calls — and zero host syncs inside admission (the loop's single
+    firsts-sync is the only one)."""
+    cfg, params = tiny
+    paged = InferenceEngine(params, cfg, EngineConfig(
+        max_batch=2, max_seq_len=2048, prefill_buckets=(128,),
+        decode_steps=(1, 4), kv_block_size=128, kv_pool_blocks=40,
+        prefill_chunk=128, admit_group_chunks=4))
+    prompt = [(i * 7) % 250 + 1 for i in range(2048 - 8)]
+    out = _generate(paged, prompt, 4)
+    assert len(out) == 4
+    st = paged.stats()
+    # 2040 tokens / 128 = 16 chunks → 4 fused groups
+    assert st["admit_dispatches"] == 4, st
+
+    # correctness oracle: full-context forward argmax
+    from tpu9.models.transformer import decoder_forward
+    logits = decoder_forward(params, jnp.asarray([prompt], jnp.int32), cfg)
+    assert out[0] == int(jnp.argmax(logits[0, len(prompt) - 1]))
+
+
+def test_decode_interleaves_with_long_admission(tiny):
+    """While a long prompt admits, the already-running stream must keep
+    producing tokens (interleaved decode windows), and outputs must be
+    identical to an engine that never interleaves."""
+    cfg, params = tiny
+
+    def build(group):
+        return InferenceEngine(params, cfg, EngineConfig(
+            max_batch=2, max_seq_len=512, prefill_buckets=(32,),
+            decode_steps=(1, 4), kv_block_size=32, kv_pool_blocks=40,
+            prefill_chunk=32, admit_group_chunks=group))
+
+    long_prompt = [(i * 11) % 250 + 1 for i in range(480)]
+
+    async def run(engine):
+        await engine.start()
+        a_task = asyncio.create_task(
+            engine.generate([5, 6, 7], max_new_tokens=40))
+        await asyncio.sleep(0.05)        # a is decoding
+        b = await engine.generate(long_prompt, max_new_tokens=4)
+        a = await a_task
+        await engine.stop()
+        return a, b
+
+    interleaved = build(4)
+    out_i = _run(run(interleaved))
+    out_serial = _run(run(build(1)))
+    assert out_i == out_serial
+    assert interleaved.stats()["admit_interleaved_windows"] >= 1, \
+        interleaved.stats()

@@ -59,6 +59,12 @@ class EngineConfig:
     # pool blocks the engine-level prefix cache may hold for KV reuse
     # across requests sharing a prompt prefix; 0 disables
     prefix_cache_blocks: int = 0
+    # chunks per fused admission dispatch (VERDICT r04 #6): a group of G
+    # chunks runs as ONE lax.scan graph (chunk prefill + block splice
+    # fused), and the serve loop interleaves a decode window between
+    # groups so a long admission doesn't starve the decode batch.
+    # 1 = one dispatch per chunk (legacy shape, still no per-chunk sync)
+    admit_group_chunks: int = 4
 
 
 @dataclass
@@ -150,8 +156,14 @@ class InferenceEngine:
         self._compiled: dict[Any, Any] = {}
         self._host_len = np.zeros((b,), dtype=np.int64)  # host mirror of
         # cache_len — the loop must not pay a device round-trip to know room
+        # decode windows dispatched DURING admissions (results processed
+        # after the admission sync): [(k, device toks), ...] + steps not
+        # yet host-processed (room accounting must include them)
+        self._deferred_windows: list = []
+        self._inflight_steps = 0
         self._stats = {"active_streams": 0, "queued": 0, "tokens_generated": 0,
-                       "decode_steps": 0}
+                       "decode_steps": 0, "admit_dispatches": 0,
+                       "admit_interleaved_windows": 0}
 
     # -- compiled steps ------------------------------------------------------
 
@@ -241,6 +253,35 @@ class InferenceEngine:
 
     # -- paged-KV machinery --------------------------------------------------
 
+    def _traced_chunk_step(self, params, scratch, tok_row, offset,
+                           last_idx):
+        """Traced body shared by the single-chunk and fused-group graphs
+        (one implementation — the two admission paths must never diverge):
+        prefill one C-token chunk into the scratch at ``offset`` and
+        return the logits at ``last_idx``."""
+        c = self._chunk
+        positions = offset + jnp.arange(c)[None, :]
+        logits, scratch = decoder_forward(
+            params, tok_row[None, :], self.cfg, positions=positions,
+            kv_cache=scratch, cache_len=offset + c, decode=False)
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], last_idx, axis=0, keepdims=False)
+        return last, scratch
+
+    def _traced_splice(self, pool_k, pool_v, scratch_k, scratch_v, offset,
+                       phys):
+        """Traced block copy shared by the splice and fused-group graphs:
+        scratch positions [offset, offset+C) → pool blocks phys[0..C/BS)."""
+        bs = self.ecfg.kv_block_size
+        for j in range(self._chunk // bs):
+            blk_k = jax.lax.dynamic_slice_in_dim(
+                scratch_k[:, 0], offset + j * bs, bs, axis=1)
+            blk_v = jax.lax.dynamic_slice_in_dim(
+                scratch_v[:, 0], offset + j * bs, bs, axis=1)
+            pool_k = pool_k.at[:, phys[j]].set(blk_k)
+            pool_v = pool_v.at[:, phys[j]].set(blk_v)
+        return pool_k, pool_v
+
     def _chunk_fn(self):
         """Jitted chunked-prefill step: write one C-token chunk into the
         batch-1 dense scratch at ``offset``, attend over prefix+chunk, and
@@ -250,17 +291,10 @@ class InferenceEngine:
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
-        cfg = self.cfg
 
         def chunk(params, tokens, offset, scratch, last_idx):
-            c = tokens.shape[1]
-            positions = offset + jnp.arange(c)[None, :]
-            logits, scratch = decoder_forward(
-                params, tokens, cfg, positions=positions,
-                kv_cache=scratch, cache_len=offset + c, decode=False)
-            last = jax.lax.dynamic_index_in_dim(
-                logits[0], last_idx, axis=0, keepdims=False)
-            return last, scratch
+            return self._traced_chunk_step(params, scratch, tokens[0],
+                                           offset, last_idx)
 
         fn = self._compiled[key] = jax.jit(chunk, donate_argnums=(3,))
         return fn
@@ -289,23 +323,41 @@ class InferenceEngine:
         fn = self._compiled.get("splice")
         if fn is not None:
             return fn
-        bs = self.ecfg.kv_block_size
-        nb = self._chunk // bs
-
-        def splice(pool_k, pool_v, scratch_k, scratch_v, offset, phys):
-            # scratch [L, 1, S, KH, D]; copy [offset, offset+C) into pool
-            # blocks phys[0..nb)
-            for j in range(nb):
-                blk_k = jax.lax.dynamic_slice_in_dim(
-                    scratch_k[:, 0], offset + j * bs, bs, axis=1)
-                blk_v = jax.lax.dynamic_slice_in_dim(
-                    scratch_v[:, 0], offset + j * bs, bs, axis=1)
-                pool_k = pool_k.at[:, phys[j]].set(blk_k)
-                pool_v = pool_v.at[:, phys[j]].set(blk_v)
-            return pool_k, pool_v
 
         fn = self._compiled["splice"] = jax.jit(
-            splice, donate_argnums=(0, 1))
+            self._traced_splice, donate_argnums=(0, 1))
+        return fn
+
+    def _chunk_group_fn(self, g: int):
+        """Fused admission graph (VERDICT r04 #6): lax.scan over ``g``
+        chunks — each step chunk-prefills into the scratch AND splices its
+        blocks into the pool. One dispatch replaces 2g, and the per-chunk
+        host bookkeeping (table math, array uploads) collapses into one
+        transfer of [g, ...] arrays. Returns the final chunk's last-token
+        logits so the caller can sample the first output."""
+        key = ("chunkgroup", g)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+
+        def group(params, pool_k, pool_v, scratch, toks, offsets,
+                  last_idxs, phys):
+            # toks [g, C] offsets [g] last_idxs [g] phys [g, C/BS]
+            def body(carry, xs):
+                pool_k, pool_v, scratch = carry
+                tok, off, li, ph = xs
+                last, scratch = self._traced_chunk_step(
+                    params, scratch, tok, off, li)
+                pool_k, pool_v = self._traced_splice(
+                    pool_k, pool_v, scratch["k"], scratch["v"], off, ph)
+                return (pool_k, pool_v, scratch), last
+
+            (pool_k, pool_v, scratch), lasts = jax.lax.scan(
+                body, (pool_k, pool_v, scratch),
+                (toks, offsets, last_idxs, phys))
+            return pool_k, pool_v, scratch, lasts[-1]
+
+        fn = self._compiled[key] = jax.jit(group, donate_argnums=(1, 2, 3))
         return fn
 
     def bench_reset_slots(self, ctx0: int, budget: int) -> None:
@@ -404,6 +456,25 @@ class InferenceEngine:
                                       self.kv_cache["table"][0])
             np.asarray(jax.device_get(dense["k"].ravel()[:4]))
             timings["splice_gather_s"] = _time.perf_counter() - t0
+            g = max(1, self.ecfg.admit_group_chunks)
+            if g > 1:
+                # fused admission graph for the steady-state group size;
+                # partial tail groups (2..g-1 chunks) compile on first use
+                t0 = _time.perf_counter()
+                s = self.ecfg.max_seq_len
+                offs = np.minimum(np.arange(g) * self._chunk,
+                                  s - self._chunk).astype(np.int32)
+                (self.kv_cache["k"], self.kv_cache["v"], self._scratch,
+                 last) = self._chunk_group_fn(g)(
+                    self.params, self.kv_cache["k"], self.kv_cache["v"],
+                    self._scratch,
+                    jnp.zeros((g, self._chunk), jnp.int32),
+                    jnp.asarray(offs),
+                    jnp.full((g,), self._chunk - 1, jnp.int32),
+                    jnp.full((g, self._chunk // bs), self._trash_block,
+                             jnp.int32))
+                np.asarray(jax.device_get(last[:4]))
+                timings[f"chunk_group_{g}_s"] = _time.perf_counter() - t0
         else:
             for bucket in self.ecfg.prefill_buckets:
                 t0 = _time.perf_counter()
@@ -476,10 +547,14 @@ class InferenceEngine:
 
     # -- engine loop ---------------------------------------------------------
 
-    def _admit_paged(self, req: _Request, slot: int):
+    async def _admit_paged(self, req: _Request, slot: int):
         """Paged admission: reserve budget, reuse any cached prefix blocks,
-        chunk-prefill the suffix through the dense scratch, splice chunks
-        into fresh pool blocks. Returns the first-token device value."""
+        chunk-prefill the suffix in FUSED GROUPS of ``admit_group_chunks``
+        (one lax.scan dispatch per group, splice included — VERDICT r04
+        #6), interleaving a decode window between groups so the running
+        batch keeps producing tokens during a long admission. Zero host
+        syncs here; the serve loop syncs the whole admission batch once.
+        Returns the first-token device value."""
         from .paged_kv import blocks_for
         bs = self.ecfg.kv_block_size
         n = len(req.prompt)
@@ -508,48 +583,86 @@ class InferenceEngine:
         total_blocks = blocks_for(n + 1, bs)
         fresh = self._alloc_blocks(total_blocks - len(shared))
         self._slot_blocks[slot] = shared + fresh
-        self._push_table(slot)
+        # the DEVICE table row stays all-trash until admission completes:
+        # decode windows interleaved below scatter every INACTIVE lane's
+        # write through its table row at position 0, which must never be
+        # one of the blocks being spliced here
+        row = np.full((self._mb,), self._trash_block, dtype=np.int32)
+        row[:len(self._slot_blocks[slot])] = self._slot_blocks[slot]
 
-        scratch_k, scratch_v = self._scratch["k"], self._scratch["v"]
+        scratch = self._scratch
         if p:
             dense = self._gather_fn()(self.kv_cache["k"],
-                                      self.kv_cache["v"],
-                                      self.kv_cache["table"][slot])
-            scratch_k, scratch_v = dense["k"], dense["v"]
+                                      self.kv_cache["v"], jnp.asarray(row))
+            scratch = {"k": dense["k"], "v": dense["v"]}
+            self._stats["admit_dispatches"] += 1
 
-        # chunk loop over the suffix; each chunk is spliced into its
-        # physical blocks right after it is computed
+        # per-chunk host arrays, built once (the former per-chunk python
+        # bookkeeping between dispatches was the loop's biggest host-side
+        # overhead — now it's one numpy pass + one transfer per group)
         c = self._chunk
+        nb = c // bs
         suffix = req.prompt[p:]
         m = len(suffix)
-        last = None
-        for i in range(0, m, c):
-            chunk_toks = suffix[i:i + c]
-            valid = len(chunk_toks)
-            toks = np.zeros((1, c), dtype=np.int32)
-            toks[0, :valid] = chunk_toks
-            scratch = {"k": scratch_k, "v": scratch_v}
-            last, scratch = self._chunk_fn()(
-                self.params, jnp.asarray(toks), p + i, scratch, valid - 1)
-            scratch_k, scratch_v = scratch["k"], scratch["v"]
-            # physical blocks covering [p+i, p+i+C)
+        n_chunks = -(-m // c)
+        toks_all = np.zeros((n_chunks, c), dtype=np.int32)
+        offsets = np.zeros((n_chunks,), dtype=np.int32)
+        last_idxs = np.zeros((n_chunks,), dtype=np.int32)
+        # chunk tail past the slot's blocks = padded garbage → write it to
+        # the dedicated trash block, never a real one
+        phys_all = np.full((n_chunks, nb), self._trash_block,
+                           dtype=np.int32)
+        for k_chunk, i in enumerate(range(0, m, c)):
+            valid = min(c, m - i)
+            toks_all[k_chunk, :valid] = suffix[i:i + valid]
+            offsets[k_chunk] = p + i
+            last_idxs[k_chunk] = valid - 1
             first_block = (p + i) // bs
-            phys = np.zeros((c // bs,), dtype=np.int32)
-            for j in range(c // bs):
+            for j in range(nb):
                 idx = first_block + j
-                # chunk tail past the slot's blocks = padded garbage →
-                # write it to the dedicated trash block, never a real one
-                phys[j] = self._slot_blocks[slot][idx] \
-                    if idx < len(self._slot_blocks[slot]) else \
-                    self._trash_block
-            self.kv_cache["k"], self.kv_cache["v"] = self._splice_fn()(
-                self.kv_cache["k"], self.kv_cache["v"],
-                scratch_k, scratch_v, p + i, jnp.asarray(phys))
-        self._scratch = {"k": scratch_k, "v": scratch_v}
+                if idx < len(self._slot_blocks[slot]):
+                    phys_all[k_chunk, j] = self._slot_blocks[slot][idx]
+
+        last = None
+        group = max(1, self.ecfg.admit_group_chunks)
+        k_chunk = 0
+        while k_chunk < n_chunks:
+            # FULL groups use the fused scan graph warmup compiled; a
+            # partial tail (2..group-1 chunks) runs through the warmed
+            # single-chunk graphs instead of JIT-compiling a fresh scan
+            # shape mid-traffic (which would stall every active stream
+            # behind an XLA compile)
+            g = group if n_chunks - k_chunk >= group else 1
+            sl = slice(k_chunk, k_chunk + g)
+            if g > 1:
+                (self.kv_cache["k"], self.kv_cache["v"], scratch,
+                 last) = self._chunk_group_fn(g)(
+                    self.params, self.kv_cache["k"], self.kv_cache["v"],
+                    scratch, jnp.asarray(toks_all[sl]),
+                    jnp.asarray(offsets[sl]), jnp.asarray(last_idxs[sl]),
+                    jnp.asarray(phys_all[sl]))
+                self._stats["admit_dispatches"] += 1
+            else:
+                last, scratch = self._chunk_fn()(
+                    self.params, jnp.asarray(toks_all[sl]),
+                    int(offsets[k_chunk]), scratch, int(last_idxs[k_chunk]))
+                self.kv_cache["k"], self.kv_cache["v"] = self._splice_fn()(
+                    self.kv_cache["k"], self.kv_cache["v"],
+                    scratch["k"], scratch["v"], int(offsets[k_chunk]),
+                    jnp.asarray(phys_all[k_chunk]))
+                self._stats["admit_dispatches"] += 2
+            k_chunk += g
+            if k_chunk < n_chunks:
+                # long admission: keep the decode batch producing tokens
+                # and let streaming consumers drain
+                self._interleave_decode_window()
+                await asyncio.sleep(0)
+        self._scratch = scratch
 
         if self.ecfg.prefix_cache_blocks > 0:
             self.prefix_cache.insert(req.prompt, self._slot_blocks[slot])
 
+        self._push_table(slot)            # real row becomes visible NOW
         self.cache_len = self.cache_len.at[slot].set(n)
         self._host_len[slot] = n
         self._rng, sub = jax.random.split(self._rng)
@@ -561,13 +674,60 @@ class InferenceEngine:
         self.slot_req[slot] = req
         return first
 
-    def _admit(self, req: _Request, slot: int):
+    def _interleave_decode_window(self) -> None:
+        """Dispatch one decode window for the active batch WITHOUT syncing
+        (results processed after the admission sync). Room accounting must
+        include steps already in flight from earlier interleaved windows."""
+        if not self.active.any():
+            return
+        ks = self.ecfg.decode_steps
+        want = ks[1] if len(ks) > 1 else ks[0]
+        # total in-flight overshoot must stay within the max(decode_steps)
+        # +1 slack _worst_case_tokens reserved per slot — past that, block
+        # growth could eat another slot's reservation
+        slack = max(ks) - self._inflight_steps
+        limit = min(want, slack)
+        for slot in range(self.ecfg.max_batch):
+            req = self.slot_req[slot]
+            if req is None or not self.active[slot]:
+                continue
+            # budget is SOFT (same rationale as _pick_steps: overshoot
+            # tokens are discarded host-side at retire, and one nearly-
+            # done stream must not stall interleaving for all the others);
+            # cache room is HARD
+            remaining = (req.max_new_tokens - len(req.generated)
+                         - self._inflight_steps)
+            room = (self.ecfg.max_seq_len - 1 - int(self._host_len[slot])
+                    - self._inflight_steps)
+            limit = min(limit, max(1, remaining), max(0, room))
+        k = 0
+        for cand in ks:
+            if cand <= limit:
+                k = max(k, cand)
+        if k <= 0:
+            return              # out of cache room or reservation slack
+        for slot in range(self.ecfg.max_batch):
+            if self.active[slot]:
+                self._ensure_slot_blocks(
+                    slot, min(int(self._host_len[slot])
+                              + self._inflight_steps + k + 1,
+                              self.ecfg.max_seq_len))
+        (self.last_token, self.kv_cache, self.cache_len, self._rng,
+         toks) = self._decode_k(k)(
+            self.params, self.kv_cache, self.last_token, self.cache_len,
+            jnp.asarray(self.active), self._rng)
+        self._deferred_windows.append((k, toks, self.active.copy()))
+        self._inflight_steps += k
+        self._stats["decode_steps"] += k
+        self._stats["admit_interleaved_windows"] += 1
+
+    async def _admit(self, req: _Request, slot: int):
         """Prefill + cache splice for one request. Returns the slot's
         first-token DEVICE value — the serve loop syncs a whole admission
         batch in one host round-trip (each blocking ``int()`` here would
         cost a full RTT, brutal over a TPU relay)."""
         if self.paged:
-            return self._admit_paged(req, slot)
+            return await self._admit_paged(req, slot)
         n = len(req.prompt)
         bucket = self._bucket_for(n)
         tokens = np.zeros((1, bucket), dtype=np.int32)
@@ -675,7 +835,7 @@ class InferenceEngine:
                 if req is None:
                     break
                 slot = int(np.argmin(self.active))
-                pending.append((req, self._admit(req, slot)))
+                pending.append((req, await self._admit(req, slot)))
 
             if not self.active.any() and not pending:
                 if self.paged and self._wait_room:
@@ -694,13 +854,23 @@ class InferenceEngine:
                 if not self._room_for(req):
                     self._wait_room.append(req)
                     continue
-                pending.append((req, self._admit(req, 0)))
+                pending.append((req, await self._admit(req, 0)))
 
             if pending:
                 firsts = np.asarray(jax.device_get(
                     jnp.stack([f for _, f in pending])))
                 for (req, _), first in zip(pending, firsts):
                     self._deliver_first(req, int(first))
+            # decode windows dispatched during those admissions: their
+            # tokens are ready by now (device work ordered before firsts).
+            # ONE transfer for all of them — N sequential device_gets
+            # would pay N round-trips over a TPU relay
+            if self._deferred_windows:
+                wins, self._deferred_windows = self._deferred_windows, []
+                all_toks = jax.device_get([t for _, t, _ in wins])
+                for (k, _, mask), w in zip(wins, all_toks):
+                    self._inflight_steps -= k
+                    self._process_window_host(k, np.asarray(w), mask)
 
             if not self.active.any():
                 continue
@@ -724,28 +894,37 @@ class InferenceEngine:
                 self.params, self.kv_cache, self.last_token,
                 self.cache_len, jnp.asarray(self.active), self._rng)
             self._stats["decode_steps"] += k
-            window = np.asarray(jax.device_get(toks))        # [k, B]
-            for step in range(k):
-                for slot in range(self.ecfg.max_batch):
-                    if not self.active[slot]:
-                        continue
-                    req = self.slot_req[slot]
-                    tok = int(window[step, slot])
-                    req.generated.append(tok)
-                    self._host_len[slot] += 1
-                    self._stats["tokens_generated"] += 1
-                    if req.queue is not None:
-                        req.queue.put_nowait(tok)
-                    hit_eos = (self.ecfg.eos_id >= 0
-                               and tok == self.ecfg.eos_id)
-                    # prompt + generated must fit the cache
-                    out_of_room = (self._host_len[slot]
-                                   >= self.ecfg.max_seq_len - 1)
-                    if (len(req.generated) >= req.max_new_tokens or hit_eos
-                            or out_of_room):
-                        # remaining window tokens for this slot are noise
-                        # (the device kept decoding); retire discards them
-                        # by flipping active off — the cache lanes reset
-                        self._retire(slot)
+            self._process_window(k, toks, self.active)
             # yield to the event loop so new requests can land
             await asyncio.sleep(0)
+
+    def _process_window(self, k: int, toks, mask) -> None:
+        self._process_window_host(k, np.asarray(jax.device_get(toks)),
+                                  mask)
+
+    def _process_window_host(self, k: int, window, mask) -> None:
+        """Host-side consumption of one decode window [k, B]: ``mask`` is
+        the active set AT DISPATCH (a deferred window must not deliver its
+        position-0 garbage to a slot admitted after it was dispatched)."""
+        for step in range(k):
+            for slot in range(self.ecfg.max_batch):
+                if not (mask[slot] and self.active[slot]):
+                    continue
+                req = self.slot_req[slot]
+                tok = int(window[step, slot])
+                req.generated.append(tok)
+                self._host_len[slot] += 1
+                self._stats["tokens_generated"] += 1
+                if req.queue is not None:
+                    req.queue.put_nowait(tok)
+                hit_eos = (self.ecfg.eos_id >= 0
+                           and tok == self.ecfg.eos_id)
+                # prompt + generated must fit the cache
+                out_of_room = (self._host_len[slot]
+                               >= self.ecfg.max_seq_len - 1)
+                if (len(req.generated) >= req.max_new_tokens or hit_eos
+                        or out_of_room):
+                    # remaining window tokens for this slot are noise
+                    # (the device kept decoding); retire discards them
+                    # by flipping active off — the cache lanes reset
+                    self._retire(slot)
