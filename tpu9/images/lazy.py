@@ -56,11 +56,20 @@ class LazyFill:
     """One in-progress lazy materialization of a manifest into ``dest``."""
 
     def __init__(self, manifest: ImageManifest, dest: str,
-                 cache: CacheClient, sock_path: str):
+                 cache: CacheClient, sock_path: str, boot_gate=None):
         self.manifest = manifest
         self.dest = dest
         self.cache = cache
         self.sock_path = sock_path
+        # async callable: the BACKGROUND filler awaits it between segments
+        # so bulk streaming yields to cold-starting containers (VERDICT
+        # r04 #3); on-demand faults never wait on it
+        self._boot_gate = boot_gate
+        # faults waiting on files the background filler has claimed: the
+        # gate must release immediately or the booting container would
+        # deadlock against the very gate protecting its boot
+        self._pending_faults = 0
+        self._fault_wakeup = asyncio.Event()
         self._entries: dict[str, FileEntry] = {
             e.path: e for e in manifest.files if not e.link_target}
         self._done: dict[str, asyncio.Event] = {
@@ -181,7 +190,14 @@ class LazyFill:
             return True
         self.stats["faults"] += 1
         if rel in self._claimed:           # background filler owns it
-            await ev.wait()
+            self._pending_faults += 1
+            self._fault_wakeup.set()
+            try:
+                await ev.wait()
+            finally:
+                self._pending_faults -= 1
+                if self._pending_faults == 0:
+                    self._fault_wakeup.clear()
             return True
         self._claimed.add(rel)
         try:
@@ -196,10 +212,30 @@ class LazyFill:
             raise
         return True
 
-    async def _fill_one(self, entry: FileEntry) -> None:
+    async def _yield_for_boot(self) -> None:
+        if self._boot_gate is None or self._fault_wakeup.is_set():
+            return
+        gate = asyncio.ensure_future(self._boot_gate())
+        wake = asyncio.ensure_future(self._fault_wakeup.wait())
+        try:
+            await asyncio.wait({gate, wake},
+                               return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            for t in (gate, wake):
+                if not t.done():
+                    t.cancel()
+
+    async def _fill_one(self, entry: FileEntry,
+                        background: bool = False) -> None:
         target = safe_join(self.dest, entry.path)
         offset = 0
         for i in range(0, len(entry.chunks), SEGMENT_CHUNKS):
+            if background:
+                # bulk streaming yields to cold-starting containers at
+                # segment granularity — unless a fault is waiting on a
+                # claimed file, in which case filling IS the boot's
+                # critical path and must continue
+                await self._yield_for_boot()
             seg = entry.chunks[i:i + SEGMENT_CHUNKS]
             fetched = await self.cache.get_many(seg)
             datas = []
@@ -232,7 +268,7 @@ class LazyFill:
                 continue
             self._claimed.add(entry.path)
             try:
-                await self._fill_one(entry)
+                await self._fill_one(entry, background=True)
             except Exception as exc:     # noqa: BLE001
                 # bundle deleted underneath us, chunk unavailable, or any
                 # transport error: record, release waiters, move on — a

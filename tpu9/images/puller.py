@@ -49,6 +49,31 @@ class ImagePuller:
         self._refs: dict[str, int] = {}
         self._fills: dict[str, LazyFill] = {}
         self._fuse_mounts: dict[str, object] = {}
+        # boot gate (VERDICT r04 #3): while ANY container on this worker is
+        # cold-starting, background bulk fills yield — on a small host the
+        # fill's sha256+disk work otherwise contends with runner boot and
+        # the cold-pull p50 pays for bytes nobody needs yet. Faulted reads
+        # (ensure_file) always bypass the gate.
+        self._boots = 0
+        self._boot_clear = asyncio.Event()
+        self._boot_clear.set()
+
+    def boot_started(self) -> None:
+        self._boots += 1
+        self._boot_clear.clear()
+
+    def boot_finished(self) -> None:
+        self._boots = max(0, self._boots - 1)
+        if self._boots == 0:
+            self._boot_clear.set()
+
+    async def boot_gate(self) -> None:
+        """Await until no container is cold-starting — bounded so a wedged
+        boot can never starve fills forever."""
+        try:
+            await asyncio.wait_for(self._boot_clear.wait(), timeout=15.0)
+        except asyncio.TimeoutError:
+            pass
 
     def bundle_path(self, image_id: str) -> str:
         return os.path.join(self.bundles_dir, image_id)
@@ -136,7 +161,8 @@ class ImagePuller:
                 if not live_refs:
                     shutil.rmtree(dest, ignore_errors=True)
                 fill = LazyFill(manifest, dest, self.cache,
-                                self.lazy_sock(image_id))
+                                self.lazy_sock(image_id),
+                                boot_gate=self.boot_gate)
                 await fill.start(write_skeleton=not live_refs)
                 self._fills[image_id] = fill
                 self._refs[image_id] = self._refs.get(image_id, 0) + 1

@@ -133,6 +133,14 @@ class ContainerLifecycle:
             if container_id in self._stop_requested:
                 raise RuntimeError("stopped before start")
 
+        # cold-start boot gate (VERDICT r04 #3): background image fills
+        # yield until this container is ready — their sha256/disk work
+        # otherwise contends with runner boot on the cold-pull critical
+        # path. Faulted reads bypass the gate, so a boot that NEEDS bytes
+        # still gets them immediately.
+        _gate_puller = getattr(self, "image_puller", None)
+        if _gate_puller is not None:
+            _gate_puller.boot_started()
         try:
             check_aborted()
             # image materialization ∥ workspace fetch (lifecycle.go:355-368)
@@ -278,6 +286,9 @@ class ContainerLifecycle:
             await self.containers.set_exit_code(
                 container_id, 1, f"{state.stop_reason}: {exc}")
             raise
+        finally:
+            if _gate_puller is not None:
+                _gate_puller.boot_finished()
 
     async def _supervise(self, request: ContainerRequest,
                          state: ContainerState) -> None:
