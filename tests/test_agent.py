@@ -305,3 +305,94 @@ async def test_agent_releases_slot_on_voluntary_exit(tmp_path):
     finally:
         await op.close()
         await gw.stop()
+
+
+async def test_preflight_fails_loudly_on_broken_tpu_host(tmp_path,
+                                                         monkeypatch):
+    """VERDICT r04 #7 'Done': a BYOC join on a broken host (claims a TPU,
+    has no /dev/accel*) fails with a NAMED preflight error — and the
+    one-time join token survives for a retry after the host is fixed."""
+    from tpu9.agent import PreflightError
+
+    gw = Gateway(_cfg(tmp_path), store=MemoryStore())
+    await gw.start()
+    base = f"http://127.0.0.1:{gw.port}"
+    op = aiohttp.ClientSession(
+        headers={"Authorization": f"Bearer {gw.default_token}"})
+    try:
+        async with op.post(f"{base}/api/v1/machine",
+                           json={"name": "tpuhost", "pool": "edge"}) as r:
+            m = await r.json()
+        monkeypatch.setenv("TPU9_TPU_GEN", "v5e")   # claims TPU, has none
+        ag = Agent(base, m["join_token"])
+        with pytest.raises(PreflightError, match="tpu_devices"):
+            await ag.join()
+        # token NOT consumed: a fixed host joins with the same token
+        monkeypatch.delenv("TPU9_TPU_GEN")
+        ag2 = Agent(base, m["join_token"])
+        out = await ag2.join()
+        assert out["machine_id"] == m["machine_id"]
+        # the passing preflight report is visible to the operator
+        async with op.get(f"{base}/api/v1/machine?pool=edge") as r:
+            listed = await r.json()
+        names = {c["name"]: c["ok"] for c in listed[0]["preflight"]}
+        assert names.get("gateway_reachable") is True
+        await ag2.stop()
+    finally:
+        await op.close()
+        await gw.stop()
+
+
+async def test_agent_log_shipping(tmp_path):
+    """Worker output relayed through the agent lands in the gateway's
+    capped per-machine tail (reference pkg/agent/log_writer.go)."""
+    gw = Gateway(_cfg(tmp_path), store=MemoryStore())
+    await gw.start()
+    base = f"http://127.0.0.1:{gw.port}"
+    op = aiohttp.ClientSession(
+        headers={"Authorization": f"Bearer {gw.default_token}"})
+    try:
+        async with op.post(f"{base}/api/v1/machine",
+                           json={"name": "logbox", "pool": "edge"}) as r:
+            m = await r.json()
+        ag = Agent(base, m["join_token"])
+        await ag.join()
+
+        # a real worker subprocess whose stdout the agent pumps
+        fake_worker = (
+            "import sys\n"
+            "print('worker-line-1'); print('worker-line-2')\n"
+            "sys.stdout.flush()\n")
+
+        async def spawn(agent):
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-c", fake_worker,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT)
+            agent._log_tasks.append(
+                asyncio.create_task(agent._pump_logs(proc)))
+            return proc
+
+        proc = await spawn(ag)
+        await proc.wait()
+        await asyncio.sleep(0.2)          # let the pump drain the pipe
+        await ag._ship_logs()
+
+        async with op.get(
+                f"{base}/api/v1/machine/{m['machine_id']}/logs") as r:
+            out = await r.json()
+        joined = "\n".join(out["lines"])
+        assert "worker-line-1" in joined and "worker-line-2" in joined
+
+        # tenant tokens cannot read machine logs
+        ws2 = await gw.backend.create_workspace("other-logs")
+        tok2 = await gw.backend.create_token(ws2.workspace_id)
+        async with aiohttp.ClientSession(
+                headers={"Authorization": f"Bearer {tok2.key}"}) as s2:
+            async with s2.get(
+                    f"{base}/api/v1/machine/{m['machine_id']}/logs") as r:
+                assert r.status == 403
+        await ag.stop()
+    finally:
+        await op.close()
+        await gw.stop()

@@ -154,3 +154,26 @@ async def test_cas_atomic_ownership():
     assert await r.get("k") == "v2"
     await r.close()
     await server.stop()
+
+
+async def test_ltrim_caps_list_in_one_call():
+    """Redis LTRIM semantics, in-proc and over the wire (the machine-log
+    relay caps per-machine tails with it instead of N lpop round-trips)."""
+    store = MemoryStore()
+    await store.rpush("l", *range(10))
+    await store.ltrim("l", -3, -1)
+    assert await store.lrange("l") == [7, 8, 9]
+    await store.ltrim("l", 0, 0)
+    assert await store.lrange("l") == [7]
+    await store.ltrim("l", 5, 8)          # past the end → empty
+    assert await store.lrange("l") == []
+
+    server = await StateServer(port=0).start()
+    client = await RemoteStore(server.address).connect()
+    try:
+        await client.rpush("r", *range(6))
+        await client.ltrim("r", -2, -1)
+        assert await client.lrange("r") == [4, 5]
+    finally:
+        await client.close()
+        await server.stop()

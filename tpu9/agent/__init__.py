@@ -1,3 +1,3 @@
-from .agent import Agent, preflight
+from .agent import Agent, PreflightError, preflight, preflight_checks
 
-__all__ = ["Agent", "preflight"]
+__all__ = ["Agent", "PreflightError", "preflight", "preflight_checks"]

@@ -60,18 +60,99 @@ def preflight() -> dict:
                 os.environ.get("TPU9_RELIABILITY", "1.0") or 1.0)}
 
 
+async def preflight_checks(gateway_url: str) -> list[dict]:
+    """Join-time health checks (VERDICT r04 #7; reference
+    pkg/agent/preflight.go): a misconfigured BYOC host must fail AT JOIN
+    with a named error, not at container-run time. Each check is
+    {name, ok, critical, detail}; a failed critical check aborts the
+    join client-side and the full report rides the join payload so the
+    operator sees it in ``tpu9 machine list``."""
+    checks: list[dict] = []
+
+    def add(name: str, ok: bool, critical: bool, detail: str) -> None:
+        checks.append({"name": name, "ok": bool(ok),
+                       "critical": critical, "detail": detail})
+
+    # TPU devices: only critical when the operator CLAIMS this is a TPU
+    # host (TPU9_TPU_GEN set) — a CPU worker box legitimately has none
+    gen = os.environ.get("TPU9_TPU_GEN", "")
+    accel = glob.glob("/dev/accel*") + glob.glob("/dev/vfio/[0-9]*")
+    add("tpu_devices", bool(accel) or not gen, critical=bool(gen),
+        detail=f"gen={gen or 'none'} devices={accel or 'none'}")
+
+    # libtpu loadable: a TPU host whose driver stack is broken fails here,
+    # not minutes later inside a tenant container
+    if gen and accel:
+        import importlib.util
+        lib = os.environ.get("TPU_LIBRARY_PATH", "")
+        has = bool(lib and os.path.exists(lib)) or \
+            importlib.util.find_spec("libtpu") is not None
+        add("libtpu", has, critical=True,
+            detail=lib or "import libtpu")
+
+    # gateway reachable + clock sane (token TTLs and usage metering break
+    # on a badly skewed machine clock)
+    skew = None
+    try:
+        async with aiohttp.ClientSession() as s:
+            t0 = time.time()
+            async with s.get(f"{gateway_url.rstrip('/')}/health",
+                             timeout=aiohttp.ClientTimeout(total=10)) as r:
+                ok = r.status == 200
+                server_date = r.headers.get("Date", "")
+        add("gateway_reachable", ok, critical=True,
+            detail=f"GET /health -> {r.status}")
+        if server_date:
+            from email.utils import parsedate_to_datetime
+            try:
+                skew = abs(parsedate_to_datetime(server_date).timestamp()
+                           - t0)
+                add("clock_sane", skew < 300.0, critical=True,
+                    detail=f"skew vs gateway ~{skew:.0f}s")
+            except (TypeError, ValueError):
+                pass
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
+        add("gateway_reachable", False, critical=True, detail=str(exc))
+
+    # scratch space for bundles/overlays — containers fail in ugly ways
+    # on a full disk
+    try:
+        st = os.statvfs("/tmp")
+        free_gb = st.f_bavail * st.f_frsize / 1e9
+        add("disk_space", free_gb > 1.0, critical=False,
+            detail=f"{free_gb:.1f} GB free on /tmp")
+    except OSError:
+        pass
+    return checks
+
+
+class PreflightError(RuntimeError):
+    """A named preflight failure — the machine did NOT join."""
+
+    def __init__(self, failed: list[dict]):
+        self.failed = failed
+        names = ", ".join(f"{c['name']} ({c['detail']})" for c in failed)
+        super().__init__(f"preflight failed: {names}")
+
+
 class Agent:
     """Join + reconcile loop. ``spawn_worker`` is injectable for tests."""
 
     def __init__(self, gateway_url: str, join_token: str,
                  poll_interval_s: float = 2.0,
                  worker_args: Optional[list[str]] = None,
-                 spawn_worker=None):
+                 spawn_worker=None, skip_preflight: bool = False):
         self.gateway_url = gateway_url.rstrip("/")
         self.join_token = join_token
         self.poll_interval_s = poll_interval_s
         self.worker_args = worker_args or []
         self._spawn_override = spawn_worker
+        self.skip_preflight = skip_preflight
+        # worker-log relay (reference pkg/agent/log_writer.go): each
+        # spawned worker's stdout/stderr is pumped into this buffer and
+        # shipped to the gateway in heartbeat-adjacent batches
+        self._log_buffer: list[str] = []
+        self._log_tasks: list[asyncio.Task] = []
         self.machine_id = ""
         self.pool = ""
         self.worker_token = ""
@@ -91,6 +172,15 @@ class Agent:
 
     async def join(self) -> dict:
         info = preflight()
+        checks = await preflight_checks(self.gateway_url) \
+            if not self.skip_preflight else []
+        failed_critical = [c for c in checks
+                           if not c["ok"] and c["critical"]]
+        if failed_critical:
+            # the named failure the VERDICT asks for: a broken host never
+            # consumes its one-time join token
+            raise PreflightError(failed_critical)
+        info["preflight"] = checks
         async with aiohttp.ClientSession() as s:
             async with s.post(f"{self.gateway_url}/api/v1/machine/join",
                               json={"token": self.join_token, **info}) as r:
@@ -137,7 +227,26 @@ class Agent:
             except asyncio.TimeoutError:
                 p.kill()
         self.workers.clear()
+        # drain the pipes BEFORE cancelling, then ship until empty — the
+        # final lines must not be dropped
+        if self._log_tasks:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*self._log_tasks,
+                                   return_exceptions=True), timeout=5.0)
+            except asyncio.TimeoutError:
+                pass
+        for t in self._log_tasks:
+            t.cancel()
+        self._log_tasks.clear()
         if self._session:
+            for _ in range(8):              # bounded: 8 × 500-line batches
+                if not self._log_buffer:
+                    break
+                try:
+                    await self._ship_logs()
+                except Exception:           # noqa: BLE001
+                    break
             await self._session.close()
             self._session = None
 
@@ -172,6 +281,7 @@ class Agent:
                 self._last_crash_at = time.time()
                 crashed += 1
         self.workers = live
+        self._log_tasks = [t for t in self._log_tasks if not t.done()]
         if self._pending_release:
             # only a successful RPC drains the counter — a gateway blip
             # retries next cycle instead of leaking the slot
@@ -191,6 +301,7 @@ class Agent:
             p = self.workers.pop()
             if p.returncode is None:
                 p.terminate()
+        await self._ship_logs()
         await self._heartbeat()
 
     async def _release(self, count: int) -> bool:
@@ -232,12 +343,60 @@ class Agent:
                "--token", self.worker_token,
                "--pool", self.pool, *self.worker_args]
         proc = await asyncio.create_subprocess_exec(
-            *cmd, stdout=asyncio.subprocess.DEVNULL,
-            stderr=asyncio.subprocess.DEVNULL,
+            *cmd, stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
             env={**os.environ,
                  "TPU9_DATABASE__STATE_AUTH_TOKEN": self.state_auth_token,
                  # BYOC machines are assumed NAT'd: container addresses are
                  # private, the gateway must reach them via the relay
                  "TPU9_RELAY_ONLY": "1"})
+        self._log_tasks.append(asyncio.create_task(
+            self._pump_logs(proc)))
         log.info("spawned worker pid %d", proc.pid)
         return proc
+
+    async def _pump_logs(self, proc: asyncio.subprocess.Process) -> None:
+        """Relay one worker's output into the shipping buffer (reference
+        log_writer.go). Chunk reads, not readline: a single over-long line
+        would make readline raise and orphan the pipe — the worker then
+        blocks forever on a full pipe buffer, which DEVNULL never did.
+        Bounded: a runaway worker drops lines, never grows agent RSS."""
+        assert proc.stdout is not None
+        carry = b""
+        while True:
+            try:
+                chunk = await proc.stdout.read(65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            carry += chunk
+            *lines, carry = carry.split(b"\n")
+            if len(carry) > 65536:          # line with no newline in sight
+                lines.append(carry)
+                carry = b""
+            for raw in lines:
+                if raw and len(self._log_buffer) < 2000:
+                    self._log_buffer.append(
+                        f"[pid {proc.pid}] "
+                        f"{raw[:4096].decode(errors='replace').rstrip()}")
+        if carry and len(self._log_buffer) < 2000:
+            self._log_buffer.append(
+                f"[pid {proc.pid}] "
+                f"{carry[:4096].decode(errors='replace').rstrip()}")
+
+    async def _ship_logs(self) -> None:
+        if not self._log_buffer or self._session is None:
+            return
+        batch, self._log_buffer = self._log_buffer[:500], \
+            self._log_buffer[500:]
+        try:
+            async with self._session.post(
+                    f"{self.gateway_url}/api/v1/machine/{self.machine_id}"
+                    f"/logs", json={"lines": batch}) as r:
+                if r.status != 200:
+                    log.warning("log ship got %d", r.status)
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
+            # put the batch back — a gateway blip must not lose lines
+            self._log_buffer = batch + self._log_buffer
+            log.warning("log ship failed: %s", exc)

@@ -605,20 +605,22 @@ class BackendDB:
                                cpu_millicores: int, memory_mb: int,
                                tpu_chips: int, tpu_generation: str,
                                hourly_cost_micros: int = 0,
-                               reliability: float = 1.0) -> Optional[dict]:
+                               reliability: float = 1.0,
+                               preflight: str = "") -> Optional[dict]:
         """Consume a one-time join token: only a 'pending' machine can
         register, so a leaked token is useless after first use. Price and
         reliability make the machine a marketplace offer the solver can
-        rank (reference pkg/compute types.go ComputeOffer)."""
+        rank (reference pkg/compute types.go ComputeOffer); ``preflight``
+        is the agent's join-time check report (JSON)."""
         cur = self._exec(
             "UPDATE machines SET status='registered', hostname=?, "
             "cpu_millicores=?, memory_mb=?, tpu_chips=?, tpu_generation=?, "
-            "hourly_cost_micros=?, reliability=?, "
+            "hourly_cost_micros=?, reliability=?, preflight=?, "
             "registered_at=?, last_seen=? "
             "WHERE join_token=? AND status='pending'",
             (hostname, int(cpu_millicores), int(memory_mb), int(tpu_chips),
              tpu_generation, int(hourly_cost_micros), float(reliability),
-             now(), now(), join_token))
+             preflight, now(), now(), join_token))
         if cur.rowcount == 0:
             return None
         rows = self._query("SELECT * FROM machines WHERE join_token=?",

@@ -233,4 +233,9 @@ MIGRATIONS: list[tuple[int, str, str]] = [
         ALTER TABLE machines ADD COLUMN hourly_cost_micros INTEGER DEFAULT 0;
         ALTER TABLE machines ADD COLUMN reliability REAL DEFAULT 1.0;
     """),
+    # join-time preflight report (reference pkg/agent/preflight.go) — JSON
+    # list of {name, ok, critical, detail} shown in `tpu9 machine list`
+    (22, "machine_preflight", """
+        ALTER TABLE machines ADD COLUMN preflight TEXT DEFAULT '';
+    """),
 ]

@@ -412,6 +412,17 @@ def machine_delete(machine_id: str) -> None:
     click.echo(json.dumps(out))
 
 
+@machine.command("logs")
+@click.argument("machine_id")
+@click.option("--tail", default=200, help="lines from the end")
+def machine_logs(machine_id: str, tail: int) -> None:
+    """Worker logs relayed through the machine's agent."""
+    out = _client().request(
+        "GET", f"/api/v1/machine/{machine_id}/logs?tail={tail}")
+    for line in out.get("lines", []):
+        click.echo(line)
+
+
 @cli.group()
 def agent() -> None:
     """Machine-owner agent (runs ON the BYOC machine)."""
@@ -425,15 +436,18 @@ def agent() -> None:
 @click.option("--worker-arg", "worker_args", multiple=True,
               help="extra args passed to spawned workers "
                    "(e.g. --worker-arg=--runtime=native)")
+@click.option("--skip-preflight", is_flag=True,
+              help="join even if preflight checks fail (debugging)")
 def agent_join(gateway_url: str, join_token: str, poll_interval: float,
-               worker_args: tuple[str, ...]) -> None:
+               worker_args: tuple[str, ...], skip_preflight: bool) -> None:
     """Join the gateway and reconcile local workers forever."""
     from ..agent import Agent
 
     async def main() -> None:
         ag = Agent(gateway_url, join_token,
                    poll_interval_s=poll_interval,
-                   worker_args=list(worker_args))
+                   worker_args=list(worker_args),
+                   skip_preflight=skip_preflight)
         await ag.start()
         click.echo(f"machine {ag.machine_id} joined pool {ag.pool} "
                    f"(max_workers={ag.max_workers})")

@@ -326,6 +326,12 @@ class Gateway:
                    self._machine_heartbeat)
         r.add_post("/api/v1/machine/{machine_id}/release",
                    self._machine_release)
+        # worker-log relay through the agent (reference log_writer.go):
+        # agents POST batches; operators read the tail
+        r.add_post("/api/v1/machine/{machine_id}/logs",
+                   self._machine_logs_push)
+        r.add_get("/api/v1/machine/{machine_id}/logs",
+                  self._machine_logs_get)
         # invoke
         r.add_route("*", "/endpoint/{name}", self._invoke)
         r.add_route("*", "/endpoint/{name}/{tail:.*}", self._invoke)
@@ -1797,6 +1803,10 @@ class Gateway:
         for m in await self.backend.list_machines(
                 request.query.get("pool", "")):
             m.pop("join_token", None)
+            try:
+                m["preflight"] = json.loads(m.get("preflight") or "[]")
+            except ValueError:
+                m["preflight"] = []
             hb = await self.store.get(Keys.machine_heartbeat(m["machine_id"]))
             m["alive"] = hb is not None
             m["telemetry"] = hb or {}
@@ -1810,7 +1820,8 @@ class Gateway:
         self._require_operator(request)
         machine_id = request.match_info["machine_id"]
         await self.store.delete(Keys.machine_desired(machine_id),
-                                Keys.machine_heartbeat(machine_id))
+                                Keys.machine_heartbeat(machine_id),
+                                Keys.machine_logs(machine_id))
         return web.json_response(
             {"ok": await self.backend.delete_machine(machine_id)})
 
@@ -1823,7 +1834,8 @@ class Gateway:
             int(data.get("tpu_chips", 0)),
             data.get("tpu_generation", ""),
             hourly_cost_micros=int(data.get("hourly_cost_micros", 0)),
-            reliability=float(data.get("reliability", 1.0)))
+            reliability=float(data.get("reliability", 1.0)),
+            preflight=self._bounded_preflight(data.get("preflight", [])))
         if m is None:
             # invalid OR already-consumed token — indistinguishable on
             # purpose (don't confirm which tokens once existed)
@@ -1885,6 +1897,56 @@ class Gateway:
         left = await self.store.incr(Keys.machine_desired(machine_id),
                                      by=-n, floor=0)
         return web.json_response({"workers": left})
+
+    MACHINE_LOG_CAP = 5000            # per-machine tail kept in the store
+
+    @staticmethod
+    def _bounded_preflight(report) -> str:
+        """Serialize the agent's preflight report bounded per FIELD (≤32
+        checks, 64-char names, 256-char details ⇒ ≤ ~12 KB total) — never
+        by slicing the serialized string mid-token, which machine-list
+        would silently read back as []."""
+        if not isinstance(report, list):
+            return "[]"
+        return json.dumps(
+            [{"name": str(c.get("name", ""))[:64],
+              "ok": bool(c.get("ok")),
+              "critical": bool(c.get("critical")),
+              "detail": str(c.get("detail", ""))[:256]}
+             for c in report[:32] if isinstance(c, dict)])
+
+    async def _machine_logs_push(self, request: web.Request) -> web.Response:
+        machine_id = self._machine_for_worker(request)
+        if await self.backend.get_machine(machine_id) is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "machine not found"}),
+                content_type="application/json")
+        data = await request.json()
+        lines = [str(ln)[:4096] for ln in data.get("lines", [])][:1000]
+        if lines:
+            key = Keys.machine_logs(machine_id)
+            await self.store.rpush(key, *lines)
+            # capped tail in ONE store call (not N lpop round-trips)
+            await self.store.ltrim(key, -self.MACHINE_LOG_CAP, -1)
+        return web.json_response({"ok": True, "accepted": len(lines)})
+
+    async def _machine_logs_get(self, request: web.Request) -> web.Response:
+        self._require_operator(request)
+        machine_id = request.match_info["machine_id"]
+        if await self.backend.get_machine(machine_id) is None:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": "machine not found"}),
+                content_type="application/json")
+        try:
+            tail = int(request.query.get("tail", 200))
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "tail must be an integer"}),
+                content_type="application/json")
+        tail = max(1, min(tail, self.MACHINE_LOG_CAP))
+        lines = await self.store.lrange(Keys.machine_logs(machine_id),
+                                        -tail, -1)
+        return web.json_response({"lines": lines})
 
     def _require_operator(self, request: web.Request):
         """Quota writes are operator actions (the reference gates them on

@@ -86,6 +86,10 @@ class Keys:
     def machine_reservations(pool: str) -> str:        # hash rid -> record
         return f"machine:resv:{pool}"
 
+    @staticmethod
+    def machine_logs(machine_id: str) -> str:          # capped list (relay)
+        return f"machine:logs:{machine_id}"
+
     # -- bot (petri-net orchestration) ---------------------------------------
 
     @staticmethod

@@ -187,5 +187,6 @@ for _op in ("set", "get", "delete", "exists", "keys", "expire", "ttl", "incr",
             "hset", "hmset", "hget", "hgetall", "hdel", "hincr",
             "zadd", "zpopmin", "zrange", "zcard", "zrem", "zscore",
             "rpush", "lpush", "lpop", "blpop", "llen", "lrange", "lrem",
+            "ltrim",
             "xadd", "xread", "xlen", "publish", "acquire_lock", "release_lock"):
     setattr(RemoteStore, _op, _make_proxy(_op))

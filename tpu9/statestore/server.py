@@ -23,7 +23,7 @@ _OPS = {
     "set", "get", "delete", "exists", "keys", "expire", "ttl", "incr", "cas",
     "hset", "hmset", "hget", "hgetall", "hdel", "hincr",
     "zadd", "zpopmin", "zrange", "zcard", "zrem", "zscore",
-    "rpush", "lpush", "lpop", "blpop", "llen", "lrange", "lrem",
+    "rpush", "lpush", "lpop", "blpop", "llen", "lrange", "lrem", "ltrim",
     "xadd", "xread", "xlen", "publish",
     "acquire_lock", "release_lock",
 }

@@ -65,6 +65,7 @@ class StateStore:
     async def llen(self, key: str) -> int: raise NotImplementedError
     async def lrange(self, key: str, start: int = 0, stop: int = -1) -> list: raise NotImplementedError
     async def lrem(self, key: str, value: Any) -> int: raise NotImplementedError
+    async def ltrim(self, key: str, start: int, stop: int) -> bool: raise NotImplementedError
 
     # -- stream
     async def xadd(self, key: str, entry: dict[str, Any], maxlen: int = 0) -> str: raise NotImplementedError
@@ -324,6 +325,20 @@ class MemoryStore(StateStore):
         if not lst:
             return None
         return lst.pop(0)
+
+    async def ltrim(self, key, start, stop):
+        """Redis LTRIM: keep only [start, stop] inclusive, negatives from
+        the end — one call caps a list (vs N sequential lpops)."""
+        if self._expired(key):
+            return True
+        lst = self._lists.get(key)
+        if lst is None:
+            return True
+        n = len(lst)
+        s = start if start >= 0 else max(0, n + start)
+        e = (stop + 1) if stop >= 0 else n + stop + 1
+        self._lists[key] = lst[s:max(s, e)]
+        return True
 
     async def blpop(self, key, timeout=0):
         deadline = time.monotonic() + timeout if timeout else None
