@@ -193,6 +193,14 @@ class VolumeFiles:
         return await self.store.get(
             self._key(workspace_id, volume_name, rel))
 
+    async def read_range(self, workspace_id: str, volume_name: str,
+                         rel: str, offset: int,
+                         length: int) -> Optional[bytes]:
+        """Ranged read — the volume-manifest chunker walks multi-GB files
+        one chunk at a time instead of buffering them whole."""
+        return await self.store.get_range(
+            self._key(workspace_id, volume_name, rel), offset, length)
+
     async def list(self, workspace_id: str, volume_name: str,
                    prefix: str = "") -> list[dict]:
         base = self._prefix(workspace_id, volume_name)

@@ -196,7 +196,10 @@ class Agent:
         self.state_addr = f"{host}:{out['state_port']}"
         self.state_auth_token = out.get("state_auth_token", "")
         self._session = aiohttp.ClientSession(
-            headers={"Authorization": f"Bearer {self.worker_token}"})
+            headers={"Authorization": f"Bearer {self.worker_token}"},
+            # every agent RPC is small; a black-holed gateway (NAT'd BYOC)
+            # must fail fast, not hang aiohttp's 300s default
+            timeout=aiohttp.ClientTimeout(total=15))
         log.info("machine %s joined pool %s (%s)", self.machine_id,
                  self.pool, info)
         return out
@@ -244,7 +247,8 @@ class Agent:
                 if not self._log_buffer:
                     break
                 try:
-                    await self._ship_logs()
+                    if not await self._ship_logs():
+                        break               # gateway unreachable: stop now
                 except Exception:           # noqa: BLE001
                     break
             await self._session.close()
@@ -385,9 +389,11 @@ class Agent:
                 f"[pid {proc.pid}] "
                 f"{carry[:4096].decode(errors='replace').rstrip()}")
 
-    async def _ship_logs(self) -> None:
+    async def _ship_logs(self) -> bool:
+        """One batch to the gateway; False = transport failure (batch
+        re-queued) so shutdown loops can stop retrying a dead gateway."""
         if not self._log_buffer or self._session is None:
-            return
+            return True
         batch, self._log_buffer = self._log_buffer[:500], \
             self._log_buffer[500:]
         try:
@@ -396,7 +402,9 @@ class Agent:
                     f"/logs", json={"lines": batch}) as r:
                 if r.status != 200:
                     log.warning("log ship got %d", r.status)
+                return r.status == 200
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
             # put the batch back — a gateway blip must not lose lines
             self._log_buffer = batch + self._log_buffer
             log.warning("log ship failed: %s", exc)
+            return False

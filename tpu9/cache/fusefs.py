@@ -41,9 +41,12 @@ class CacheFsMount:
         self._server = server
         self._sock_path = sock_path
         self._manifest_path = manifest_path
+        self._registry: Optional[dict] = None   # manager's mount table
         self.stats = {"faults": 0, "fault_failures": 0}
 
     async def unmount(self) -> None:
+        if self._registry is not None:
+            self._registry.pop(self.mountpoint, None)
         subprocess.run(["umount", self.mountpoint], capture_output=True)
         try:
             self._proc.kill()
@@ -76,7 +79,17 @@ class CacheFsManager:
     async def mount(self, manifest: ImageManifest,
                     mountpoint: str) -> CacheFsMount:
         os.makedirs(mountpoint, exist_ok=True)
+        import hashlib
         tag = manifest.image_id or manifest.manifest_hash[:12]
+        if len(tag) > 32:
+            # the fault socket must fit AF_UNIX's ~108-byte path budget
+            # even under deep work dirs — long ids (volume manifests embed
+            # workspace+name+fingerprint) get a stable digest tag instead
+            tag = hashlib.sha256(tag.encode()).hexdigest()[:16]
+        # the MOUNTPOINT disambiguates concurrent mounts of the same
+        # manifest (two containers sharing a volume): a tag-only path
+        # would make the second mount unlink the first's live fault socket
+        tag += "-" + hashlib.sha256(mountpoint.encode()).hexdigest()[:8]
         manifest_path = os.path.join(self.work_dir, f"{tag}.manifest.json")
         with open(manifest_path, "w") as f:
             f.write(manifest.to_json())
@@ -167,6 +180,7 @@ class CacheFsManager:
         mount = CacheFsMount(mountpoint, proc, server, sock_path,
                              manifest_path)
         self._mounts[mountpoint] = mount
+        mount._registry = self._mounts     # unmount() drops its own entry
         log.info("cachefs: %d files mounted at %s", len(manifest.files),
                  mountpoint)
         return mount

@@ -137,6 +137,10 @@ class Gateway:
         from ..storage import make_store
         self.volume_files = VolumeFiles(self.backend, cfg.storage.local_root,
                                         store=make_store(cfg.storage))
+        # (ws, name) -> (listing fingerprint, manifest json) for CacheFS
+        # volume mounts — re-chunking a stable multi-GB volume per mount
+        # would dwarf the mount itself
+        self._volume_manifest_cache: dict[tuple, tuple[str, str]] = {}
         self.events = EventBus(self.store, sink_url=cfg.monitoring.events_http_url
                                if cfg.monitoring.events_sink == "http" else "",
                                cluster=cfg.cluster_name)
@@ -252,6 +256,8 @@ class Gateway:
         # multipart volume transfer (reference sdk multipart.py)
         # worker-token volume reads for cross-host sync (repo-over-gRPC
         # semantics: workers act on behalf of any workspace)
+        r.add_get("/rpc/internal/volume/{workspace_id}/{name}/manifest",
+                  self._internal_volume_manifest)
         r.add_get("/rpc/internal/volume/{workspace_id}/{name}/files",
                   self._internal_volume_list)
         r.add_get("/rpc/internal/volume/{workspace_id}/{name}/files/{path:.+}",
@@ -1270,6 +1276,59 @@ class Gateway:
         entries = await self.volume_files.list(
             request.match_info["workspace_id"], request.match_info["name"])
         return web.json_response(entries)
+
+    async def _internal_volume_manifest(self,
+                                        request: web.Request) -> web.Response:
+        """Chunk manifest of a workspace volume (VERDICT r04 #5): workers
+        CacheFS-mount it read-through instead of syncing the whole volume
+        down — a container is ready before a multi-GB volume is local, and
+        page faults stream exactly the chunks touched. Chunks land in the
+        same content-addressed store as image chunks (the worker cache's
+        source path already knows how to fetch them). Recomputed only when
+        the volume's listing fingerprint (paths+sizes+mtimes) moves."""
+        self._require_worker(request)
+        ws = request.match_info["workspace_id"]
+        name = request.match_info["name"]
+        entries = await self.volume_files.list(ws, name)
+        import hashlib
+
+        from ..images.manifest import DEFAULT_CHUNK, FileEntry, ImageManifest
+        fingerprint = hashlib.sha256(json.dumps(
+            sorted([e["path"], e["size"], e.get("mtime") or 0]
+                   for e in entries), sort_keys=True,
+            default=str).encode()).hexdigest()
+        cached = self._volume_manifest_cache.get((ws, name))
+        if cached is not None and cached[0] == fingerprint:
+            return web.Response(text=cached[1],
+                                content_type="application/json")
+        manifest = ImageManifest(
+            image_id=f"vol-{ws}-{name}-{fingerprint[:12]}", kind="env")
+
+        def _hash_and_store(blob: bytes) -> str:
+            digest = hashlib.sha256(blob).hexdigest()
+            self.images.accept_chunk(digest, blob)
+            return digest
+
+        for e in entries:
+            # ranged reads + per-chunk thread hops: a multi-GB file never
+            # buffers whole in gateway RAM, and the event loop keeps
+            # serving between chunks
+            chunks = []
+            size = 0
+            for off in range(0, int(e["size"]), DEFAULT_CHUNK):
+                blob = await self.volume_files.read_range(
+                    ws, name, e["path"], off, DEFAULT_CHUNK)
+                if not blob:
+                    break               # file shrank/vanished mid-walk
+                chunks.append(await asyncio.to_thread(_hash_and_store,
+                                                      blob))
+                size += len(blob)
+            manifest.files.append(FileEntry(
+                path=e["path"], mode=0o644, size=size, chunks=chunks))
+            manifest.total_bytes += size
+        blob = manifest.to_json()
+        self._volume_manifest_cache[(ws, name)] = (fingerprint, blob)
+        return web.Response(text=blob, content_type="application/json")
 
     async def _internal_volume_get(self, request: web.Request) -> web.Response:
         self._require_worker(request)

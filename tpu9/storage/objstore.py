@@ -87,6 +87,15 @@ class ObjectStore:
     async def get(self, key: str) -> Optional[bytes]:
         raise NotImplementedError
 
+    async def get_range(self, key: str, offset: int,
+                        length: int) -> Optional[bytes]:
+        """Bytes [offset, offset+length) of the object. Default falls back
+        to a whole-object read (correct but unbounded memory) — backends
+        with cheap ranged reads MUST override (the volume-manifest chunker
+        reads multi-GB files one chunk at a time through this)."""
+        data = await self.get(key)
+        return None if data is None else data[offset:offset + length]
+
     async def delete(self, key: str) -> bool:
         raise NotImplementedError
 
@@ -138,6 +147,20 @@ class LocalObjectStore(ObjectStore):
             return None
         with open(p, "rb") as f:
             return f.read()
+
+    async def get_range(self, key: str, offset: int,
+                        length: int) -> Optional[bytes]:
+        p = self._path(key)
+        if not os.path.isfile(p):
+            return None
+
+        def read() -> bytes:
+            with open(p, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+
+        import asyncio
+        return await asyncio.to_thread(read)
 
     async def delete(self, key: str) -> bool:
         p = self._path(key)

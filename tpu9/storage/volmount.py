@@ -1,0 +1,140 @@
+"""CacheFS-backed read-through volume mounts with overlay write-back.
+
+Reference analogue: the reference FUSE-mounts per-workspace S3 buckets
+into containers (``pkg/storage/storage.go:24-31``, ``geese.go:253``,
+``pkg/worker/storage_manager.go:36``) so a 100 GB dataset volume is
+usable immediately and writes persist. tpu9's sync-down model
+(``tpu9/storage/objstore.py``) copies whole volumes before start — fine
+for small volumes, a size ceiling for big ones (VERDICT r04 #5).
+
+Design: the gateway chunks the volume into the content-addressed cache
+and serves a manifest (``/rpc/internal/volume/.../manifest``); the worker
+mounts it via CacheFS (``native/t9cachefs`` — reads fault exactly the
+chunks touched, local store → HRW peers → gateway) as the LOWER layer of
+an overlayfs whose upper dir captures container writes. On container
+exit only the upper dir — precisely the files the container wrote, by
+overlay copy-up semantics — is pushed back through the existing
+``volume_push`` path. The object store stays the source of truth;
+concurrent writers keep the same last-writer-wins file semantics as
+sync-down.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+log = logging.getLogger("tpu9.storage")
+
+# below this, sync-down wins (one copy beats FUSE round-trips — same
+# rationale as the image puller's lazy threshold)
+DEFAULT_MIN_BYTES = 64 * 1024 * 1024
+
+
+class VolumeMounter:
+    """Per-worker manager of CacheFS+overlay volume mounts."""
+
+    def __init__(self, fusefs, manifest_fetch, push, work_dir: str,
+                 min_bytes: int = DEFAULT_MIN_BYTES):
+        """``fusefs``: CacheFsManager (None → unsupported, callers fall
+        back to sync-down). ``manifest_fetch(ws, name) -> ImageManifest |
+        None`` (async). ``push(ws, name, dir) -> None`` (async; the
+        existing volume_push)."""
+        self.fusefs = fusefs
+        self.manifest_fetch = manifest_fetch
+        self.push = push
+        self.work_dir = work_dir
+        self.min_bytes = min_bytes
+        # container_id -> [(ws, name, CacheFsMount, base_dir)]
+        self._mounts: dict[str, list] = {}
+
+    def supported(self) -> bool:
+        return self.fusefs is not None and self.manifest_fetch is not None
+
+    def mounted_dir(self, container_id: str,
+                    name: str) -> Optional[str]:
+        for ws, vol, _mount, base in self._mounts.get(container_id, []):
+            if vol == name:
+                return os.path.join(base, "merged")
+        return None
+
+    async def try_mount(self, workspace_id: str, name: str,
+                        container_id: str) -> Optional[str]:
+        """Mount the volume read-through + write-back for this container.
+        Returns the merged dir, or None when the mounter doesn't apply
+        (unsupported host, small/empty volume, no manifest) — the caller
+        falls back to sync-down."""
+        if not self.supported():
+            return None
+        try:
+            manifest = await self.manifest_fetch(workspace_id, name)
+        except Exception as exc:            # noqa: BLE001 — fall back
+            log.warning("volume manifest fetch %s/%s failed (%s); "
+                        "falling back to sync-down", workspace_id, name,
+                        exc)
+            return None
+        if manifest is None or not manifest.files \
+                or manifest.total_bytes < self.min_bytes:
+            return None
+        base = os.path.join(self.work_dir, container_id, name)
+        lower = os.path.join(base, "lower")
+        upper = os.path.join(base, "upper")
+        work = os.path.join(base, "work")
+        merged = os.path.join(base, "merged")
+        for d in (lower, upper, work, merged):
+            os.makedirs(d, exist_ok=True)
+        try:
+            mount = await self.fusefs.mount(manifest, lower)
+        except Exception as exc:            # noqa: BLE001 — fall back
+            log.warning("CacheFS mount for volume %s/%s failed (%s); "
+                        "falling back to sync-down", workspace_id, name,
+                        exc)
+            shutil.rmtree(base, ignore_errors=True)
+            return None
+        rc = await asyncio.to_thread(
+            subprocess.run,
+            ["mount", "-t", "overlay", "overlay",
+             "-o", f"lowerdir={lower},upperdir={upper},workdir={work}",
+             merged], **{"capture_output": True})
+        if rc.returncode != 0:
+            await mount.unmount()
+            shutil.rmtree(base, ignore_errors=True)
+            log.warning("overlay mount for volume %s/%s failed: %s",
+                        workspace_id, name, rc.stderr.decode()[-200:])
+            return None
+        self._mounts.setdefault(container_id, []).append(
+            (workspace_id, name, mount, base))
+        log.info("volume %s/%s CacheFS-mounted for %s (%.1f MB, %d files"
+                 " — streaming on fault)", workspace_id, name,
+                 container_id, manifest.total_bytes / 1e6,
+                 len(manifest.files))
+        return merged
+
+    async def release(self, container_id: str, push: bool = True) -> None:
+        """Unmount this container's volume overlays; push each upper dir
+        (exactly the written files) back to the object store."""
+        for ws, name, mount, base in self._mounts.pop(container_id, []):
+            merged = os.path.join(base, "merged")
+            upper = os.path.join(base, "upper")
+            await asyncio.to_thread(
+                subprocess.run, ["umount", merged],
+                **{"capture_output": True})
+            if push and self.push is not None and os.path.isdir(upper) \
+                    and any(os.scandir(upper)):
+                try:
+                    await self.push(ws, name, upper)
+                    log.info("volume %s/%s write-back pushed from %s",
+                             ws, name, container_id)
+                except Exception as exc:    # noqa: BLE001
+                    log.warning("volume %s/%s write-back failed: %s",
+                                ws, name, exc)
+            await mount.unmount()
+            shutil.rmtree(base, ignore_errors=True)
+
+    async def close(self) -> None:
+        for cid in list(self._mounts):
+            await self.release(cid, push=False)
