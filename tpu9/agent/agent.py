@@ -401,8 +401,12 @@ class Agent:
                     f"{self.gateway_url}/api/v1/machine/{self.machine_id}"
                     f"/logs", json={"lines": batch}) as r:
                 if r.status != 200:
+                    # a 5xx blip must not LOSE the batch; re-queue it
+                    # (buffer stays capped by the pump's 2000-line bound)
                     log.warning("log ship got %d", r.status)
-                return r.status == 200
+                    self._log_buffer = batch + self._log_buffer
+                    return False
+                return True
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
             # put the batch back — a gateway blip must not lose lines
             self._log_buffer = batch + self._log_buffer

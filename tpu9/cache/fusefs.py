@@ -41,12 +41,12 @@ class CacheFsMount:
         self._server = server
         self._sock_path = sock_path
         self._manifest_path = manifest_path
-        self._registry: Optional[dict] = None   # manager's mount table
         self.stats = {"faults": 0, "fault_failures": 0}
 
     async def unmount(self) -> None:
-        if self._registry is not None:
-            self._registry.pop(self.mountpoint, None)
+        """Tear down this mount. Callers that went through CacheFsManager
+        should prefer ``manager.unmount(mountpoint)`` so the manager's
+        mount table stays the single source of truth."""
         subprocess.run(["umount", self.mountpoint], capture_output=True)
         try:
             self._proc.kill()
@@ -180,10 +180,16 @@ class CacheFsManager:
         mount = CacheFsMount(mountpoint, proc, server, sock_path,
                              manifest_path)
         self._mounts[mountpoint] = mount
-        mount._registry = self._mounts     # unmount() drops its own entry
         log.info("cachefs: %d files mounted at %s", len(manifest.files),
                  mountpoint)
         return mount
+
+    async def unmount(self, mountpoint: str) -> None:
+        """Drop the registry entry and tear the mount down — keeps the
+        mount table owned in exactly one place."""
+        mount = self._mounts.pop(mountpoint, None)
+        if mount is not None:
+            await mount.unmount()
 
     async def close(self) -> None:
         for mount in list(self._mounts.values()):

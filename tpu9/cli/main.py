@@ -934,6 +934,12 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                 for dirpath, _dirs, files in os.walk(root):
                     for fn in files:
                         full = os.path.join(dirpath, fn)
+                        if not os.path.isfile(full):
+                            # overlay WHITEOUTS (0:0 char devices marking
+                            # deletions in a CacheFS volume's upper dir)
+                            # and other specials: skip — opening one
+                            # raises and would abort the whole write-back
+                            continue
                         rel = os.path.relpath(full, root).replace(
                             os.sep, "/")
                         st = os.stat(full)

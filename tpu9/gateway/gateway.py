@@ -141,6 +141,7 @@ class Gateway:
         # volume mounts — re-chunking a stable multi-GB volume per mount
         # would dwarf the mount itself
         self._volume_manifest_cache: dict[tuple, tuple[str, str]] = {}
+        self._volume_manifest_builds: dict[tuple, asyncio.Task] = {}
         self.events = EventBus(self.store, sink_url=cfg.monitoring.events_http_url
                                if cfg.monitoring.events_sink == "http" else "",
                                cluster=cfg.cluster_name)
@@ -1290,9 +1291,6 @@ class Gateway:
         ws = request.match_info["workspace_id"]
         name = request.match_info["name"]
         entries = await self.volume_files.list(ws, name)
-        import hashlib
-
-        from ..images.manifest import DEFAULT_CHUNK, FileEntry, ImageManifest
         fingerprint = hashlib.sha256(json.dumps(
             sorted([e["path"], e["size"], e.get("mtime") or 0]
                    for e in entries), sort_keys=True,
@@ -1301,6 +1299,29 @@ class Gateway:
         if cached is not None and cached[0] == fingerprint:
             return web.Response(text=cached[1],
                                 content_type="application/json")
+        # chunking a multi-GB volume takes longer than a worker's request
+        # timeout — build in a background task, answer within a bounded
+        # wait, and return 503 if still building (the worker falls back to
+        # sync-down for THIS container; the next mount hits the cache)
+        build = self._volume_manifest_builds.get((ws, name))
+        if build is None or build.done():
+            build = asyncio.create_task(
+                self._build_volume_manifest(ws, name, entries, fingerprint))
+            self._volume_manifest_builds[(ws, name)] = build
+        try:
+            blob = await asyncio.wait_for(asyncio.shield(build),
+                                          timeout=120.0)
+        except asyncio.TimeoutError:
+            return web.json_response(
+                {"error": "manifest build in progress"}, status=503)
+        except Exception as exc:        # noqa: BLE001 — surface, don't 500
+            return web.json_response(
+                {"error": f"manifest build failed: {exc}"}, status=503)
+        return web.Response(text=blob, content_type="application/json")
+
+    async def _build_volume_manifest(self, ws: str, name: str,
+                                     entries: list, fingerprint: str) -> str:
+        from ..images.manifest import DEFAULT_CHUNK, FileEntry, ImageManifest
         manifest = ImageManifest(
             image_id=f"vol-{ws}-{name}-{fingerprint[:12]}", kind="env")
 
@@ -1328,7 +1349,7 @@ class Gateway:
             manifest.total_bytes += size
         blob = manifest.to_json()
         self._volume_manifest_cache[(ws, name)] = (fingerprint, blob)
-        return web.Response(text=blob, content_type="application/json")
+        return blob
 
     async def _internal_volume_get(self, request: web.Request) -> web.Response:
         self._require_worker(request)

@@ -254,7 +254,10 @@ class ImagePuller:
         self._fills.clear()
         for image_id, mount in list(self._fuse_mounts.items()):
             try:
-                await mount.unmount()
+                if self.fusefs is not None:
+                    await self.fusefs.unmount(mount.mountpoint)
+                else:
+                    await mount.unmount()
             except Exception:         # noqa: BLE001
                 pass
         self._fuse_mounts.clear()
@@ -278,7 +281,10 @@ class ImagePuller:
             mount = self._fuse_mounts.pop(name, None)
             if mount is not None:
                 try:
-                    await mount.unmount()
+                    if self.fusefs is not None:
+                        await self.fusefs.unmount(mount.mountpoint)
+                    else:
+                        await mount.unmount()
                 except Exception:     # noqa: BLE001 — lazy umount below
                     pass
             shutil.rmtree(self.bundle_path(name), ignore_errors=True)

@@ -251,6 +251,23 @@ class GcsObjectStore(ObjectStore):
         if status not in (200, 201):
             raise ObjectStoreError(f"GCS put {key}: {status} {body[:200]!r}")
 
+    async def get_range(self, key: str, offset: int,
+                        length: int) -> Optional[bytes]:
+        # Range on the media GET: the volume-manifest chunker walks
+        # multi-GB objects 4 MiB at a time — the base-class whole-object
+        # fallback would transfer size×chunks bytes
+        status, _, body = await self.transport(
+            "GET", self._obj_url(key) + "?alt=media",
+            {"Range": f"bytes={offset}-{offset + length - 1}"}, b"")
+        if status == 404:
+            return None
+        if status == 416:                 # offset past EOF
+            return b""
+        if status not in (200, 206):
+            raise ObjectStoreError(f"GCS get_range {key}: {status}")
+        # a 200 means the server ignored Range (tiny object fits) — slice
+        return body[offset:offset + length] if status == 200 else body
+
     async def get(self, key: str) -> Optional[bytes]:
         status, _, body = await self.transport(
             "GET", self._obj_url(key) + "?alt=media", {}, b"")

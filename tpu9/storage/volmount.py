@@ -132,7 +132,8 @@ class VolumeMounter:
                 except Exception as exc:    # noqa: BLE001
                     log.warning("volume %s/%s write-back failed: %s",
                                 ws, name, exc)
-            await mount.unmount()
+            # manager-owned teardown keeps its mount table authoritative
+            await self.fusefs.unmount(mount.mountpoint)
             shutil.rmtree(base, ignore_errors=True)
 
     async def close(self) -> None:

@@ -659,9 +659,18 @@ class ContainerLifecycle:
             spec_mounts.append((lazy_sock_bind, lazy_sock_bind, False))
         for mount in request.mounts:
             if mount.kind == "volume":
+                # CacheFS overlay first, then a volume_sync'd local dir
+                # (cross-host: _safe_volume_dir under storage_root is
+                # EMPTY on this worker), shared storage last
                 mounted = self.volmount.mounted_dir(
                     request.container_id, mount.source) \
                     if self.volmount is not None else None
+                if mounted is None:
+                    for _ws, vol, local_dir in self._synced_volumes.get(
+                            request.container_id, []):
+                        if vol == mount.source:
+                            mounted = local_dir
+                            break
                 host_dir = mounted or self._safe_volume_dir(
                     request.workspace_id, mount.source)
                 spec_mounts.append((host_dir, mount.target, mount.read_only))
