@@ -137,3 +137,110 @@ def test_agent_pool_places_on_cheapest_machine():
         assert rates == [1_000_000, 9_000_000]
 
     asyncio.run(run())
+
+
+def test_gce_vendor_rental_lifecycle():
+    """Vendor adapter + rental controller (reference ComputeVendor,
+    types.go:51 + vast.go): offers priced from the rate card, the
+    controller creates queued-resource reservations for the cheapest
+    shape, reflects API state transitions, and deletes on shrink."""
+    from tpu9.compute import Demand, GceTpuVendor, VendorRentalController
+
+    calls = []
+    states = {}
+
+    async def transport(method, url, body):
+        calls.append((method, url, body))
+        if method == "POST":
+            rid = url.rsplit("=", 1)[1]
+            states[rid] = "ACCEPTED"
+            return {"name": rid}
+        if method == "GET":
+            rid = url.rsplit("/", 1)[1]
+            return {"state": {"state": states.get(rid, "ACTIVE")}}
+        if method == "DELETE":
+            states.pop(url.rsplit("/", 1)[1], None)
+            return {}
+        return None
+
+    vendor = GceTpuVendor("proj", "us-central2-b", transport, spot=True)
+    ctl = VendorRentalController(vendor)
+    demand = Demand(nodes=2, tpu_generation="v5e", tpu_chips=8,
+                    ttl_hours=2)
+
+    async def run():
+        plan = await ctl.reconcile(demand)
+        assert plan.feasible and plan.total_nodes == 2
+        posts = [c for c in calls if c[0] == "POST"]
+        assert len(posts) == 1
+        body = posts[0][2]
+        specs = body["tpu"]["node_spec"]
+        assert len(specs) == 2
+        # distinct spec dicts with UNIQUE node ids (the API rejects dupes)
+        assert specs[0] is not specs[1]
+        assert specs[0]["node_id"] != specs[1]["node_id"]
+        node = specs[0]["node"]
+        assert node["accelerator_type"] == "v5e-8"
+        assert node["scheduling_config"] == {"preemptible": True}
+
+        # queued resource goes ACTIVE → reservation active, nothing new
+        for rid in list(states):
+            states[rid] = "ACTIVE"
+        plan2 = await ctl.reconcile(demand)
+        assert plan2.feasible and plan2.existing_nodes == 2
+        assert len([c for c in calls if c[0] == "POST"]) == 1
+
+        # demand gone → reconcile to ZERO releases the rental now,
+        # not at TTL
+        plan3 = await ctl.reconcile(Demand(nodes=0))
+        deletes = [c for c in calls if c[0] == "DELETE"]
+        assert len(deletes) == 1          # the v5e rental released
+        assert plan3.feasible and plan3.total_nodes == 0
+        assert not ctl.reservations
+        return plan3
+
+    asyncio.run(run())
+
+
+def test_vendor_spot_pricing_beats_on_demand():
+    from tpu9.compute import Demand, GceTpuVendor
+
+    async def transport(method, url, body):
+        return {}
+
+    async def run():
+        spot = GceTpuVendor("p", "z", transport, spot=True)
+        od = GceTpuVendor("p", "z", transport, spot=False)
+        d = Demand(nodes=1, tpu_generation="v5e", tpu_chips=4)
+        (so,), (oo,) = await spot.list_offers(d), await od.list_offers(d)
+        assert so.hourly_cost_micros < oo.hourly_cost_micros
+        assert so.reliability < oo.reliability   # honesty about spot
+
+    asyncio.run(run())
+
+
+def test_vendor_failed_create_never_counts_as_capacity():
+    """A refused queued-resources POST must yield a FAILED reservation
+    the solver ignores — not phantom PENDING capacity billed until TTL."""
+    from tpu9.compute import Demand, GceTpuVendor, VendorRentalController
+
+    posts = []
+
+    async def transport(method, url, body):
+        if method == "POST":
+            posts.append(url)
+            return None                   # quota/auth refusal
+        return None
+
+    ctl = VendorRentalController(
+        GceTpuVendor("p", "z", transport, spot=True))
+    demand = Demand(nodes=1, tpu_generation="v5e", tpu_chips=8)
+
+    async def run():
+        await ctl.reconcile(demand)
+        # next pass must NOT see the failed rental as existing capacity
+        plan = await ctl.reconcile(demand)
+        assert plan.existing_nodes == 0
+        assert len(posts) >= 2            # it re-attempted the rental
+
+    asyncio.run(run())

@@ -80,6 +80,7 @@ def _deploy_and_invoke(gateway_url: str, token: str, tmp_path) -> dict:
         return json.loads(resp.read())
 
 
+@pytest.mark.slow
 def test_compose_service_commands_boot_without_docker(tmp_path):
     """Run the compose topology's commands as host processes: gateway with
     the shipped config (ports/db redirected to the sandbox), worker with

@@ -27,6 +27,7 @@ def load_engine():
 """
 
 
+@pytest.mark.slow
 async def test_llm_endpoint_generates_and_heartbeats():
     async with LocalStack() as stack:
         dep = await stack.deploy_endpoint(
@@ -113,6 +114,7 @@ def load_engine():
 """
 
 
+@pytest.mark.slow
 async def test_tp8_engine_through_endpoint():
     """Weak-#5 closure: a tensor-parallel (tp=8) engine — the 70B example's
     exact mesh/shard path at toy dims — serves through @endpoint tpu=v5e-8
@@ -201,6 +203,7 @@ async def test_llm_token_streaming_sse():
         assert len(arrival_times) >= 2, arrival_times
 
 
+@pytest.mark.slow
 async def test_llm_streaming_scales_from_zero():
     """Review regression: forward_stream must register autoscaler demand
     BEFORE admission — a streaming request to a scaled-to-zero endpoint

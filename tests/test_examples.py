@@ -19,6 +19,7 @@ def load(name):
     return mod
 
 
+@pytest.mark.slow
 def test_cpu_classifier_config():
     mod = load("01_cpu_classifier.py")
     assert mod.classify.stub_type == "endpoint"

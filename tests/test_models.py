@@ -10,6 +10,7 @@ from tpu9.models.clip_vit import CLIP_VIT_TINY
 from tpu9.models.gemma import GEMMA_PRESETS
 from tpu9.models.llama import LLAMA_PRESETS
 from tpu9.models.transformer import count_params
+import pytest
 
 TINY = LLAMA_PRESETS["llama-tiny"]
 GTINY = GEMMA_PRESETS["gemma-tiny"]
@@ -137,6 +138,7 @@ class TestClassifier:
 # mixtral (sparse-MoE decoder family)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_mixtral_decoder_paths():
     from dataclasses import replace
 

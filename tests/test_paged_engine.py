@@ -272,6 +272,7 @@ def test_chunk_smaller_than_block_rejected(tiny):
             prefill_chunk=128))
 
 
+@pytest.mark.slow
 def test_load_engine_defaults_are_consistent(tiny):
     """load_engine's auto block/chunk choice must always produce a valid
     paged config — including the quick-bench shape that originally hit
@@ -304,6 +305,7 @@ def test_near_full_cache_prompt_does_not_overflow_table(tiny):
     assert 1 <= len(out) <= 8
 
 
+@pytest.mark.slow
 def test_paged_matches_dense_under_tp8_sharding():
     """Config #4's serving shape: the paged engine must produce identical
     greedy outputs to the dense engine when params are tensor-parallel
@@ -335,6 +337,7 @@ def test_paged_matches_dense_under_tp8_sharding():
     assert run(False) == run(True)
 
 
+@pytest.mark.slow
 def test_unaligned_prefix_hit_does_not_corrupt_kv(tiny):
     """Advisor r04 (medium): a prefix-cache hit at p with p % prefill_chunk
     != 0 put the final chunk window past max_seq_len; dynamic_update_slice
@@ -374,6 +377,7 @@ def test_max_seq_len_not_chunk_multiple_rejected(tiny):
             prefill_chunk=128))
 
 
+@pytest.mark.slow
 def test_fused_admission_dispatch_count(tiny):
     """VERDICT r04 #6 'Done': a 2048-token prompt admits in a handful of
     fused dispatches (16 chunks / group 4 = 4 scans), not 32 chunk+splice

@@ -81,6 +81,7 @@ def test_ring_attention_matches_dense():
     np.testing.assert_allclose(np.asarray(out_nc), np.asarray(ref_nc), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_fsdp_train_step_loss_decreases():
     mesh = make_mesh(dp=2, fsdp=2, sp=1, tp=2)
     params = init_decoder(jax.random.PRNGKey(0), TINY)
@@ -99,6 +100,7 @@ def test_fsdp_train_step_loss_decreases():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_lora_fsdp_train_step():
     mesh = make_mesh(dp=1, fsdp=4, sp=1, tp=2)
     params = init_decoder(jax.random.PRNGKey(0), TINY)
@@ -161,6 +163,7 @@ def test_moe_ffn_ep_sharded_matches_unsharded():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_moe_capacity_drops_and_balance_grads():
     from tpu9.models.moe import MoeConfig, init_moe_layer, moe_ffn
 
@@ -258,6 +261,7 @@ def test_pipeline_forward_matches_sequential():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_is_differentiable():
     from tpu9.parallel import (make_named_mesh, pipeline_forward,
                                stack_layers)

@@ -6,6 +6,8 @@ import numpy as np
 
 from tpu9.models import decoder_forward, init_decoder
 from tpu9.models.llama import LLAMA_PRESETS
+import pytest
+
 from tpu9.ops.quant import (dequantize_weight, quantize_decoder,
                             quantize_weight, quantized_bytes,
                             quantized_matmul)
@@ -49,6 +51,7 @@ def test_quantized_decoder_outputs_close_and_smaller():
     assert quantized_bytes(qparams) < 0.55 * quantized_bytes(params)
 
 
+@pytest.mark.slow
 def test_quantized_decode_path():
     from tpu9.models import init_kv_cache
     params = quantize_decoder(init_decoder(jax.random.PRNGKey(0), TINY))
@@ -62,6 +65,7 @@ def test_quantized_decode_path():
     assert bool(jnp.isfinite(step).all())
 
 
+@pytest.mark.slow
 def test_int8_quality_bound_vs_bf16():
     """VERDICT r03 #9: a NUMERIC bound on int8 weight-only quality, not
     just structural checks. Quantize real bf16 params, compare full-model

@@ -11,3 +11,7 @@ from .solver import (Action, Demand, Offer, Plan, Reservation, Solver,
 
 __all__ = ["Action", "Demand", "Offer", "Plan", "Reservation", "Solver",
            "eligible", "offer_sort_key"]
+
+from .vendors import GceTpuVendor, Vendor, VendorRentalController  # noqa: E402
+
+__all__ += ["GceTpuVendor", "Vendor", "VendorRentalController"]

@@ -1,0 +1,248 @@
+"""Compute vendor adapters: rentable TPU capacity behind the Vendor API.
+
+Reference analogue: ``/root/reference/pkg/types/compute.go:51``
+(ComputeVendor interface: ListOffers/CreateReservation/GetReservation/
+ExtendReservation/DeleteReservation) and the concrete adapters
+``pkg/compute/vast.go`` / ``hetzner.go``. The reference rents GPU boxes
+from aggregators; tpu9's capacity market is Cloud TPU itself — the one
+concrete adapter speaks the queued-resources API (same injected-transport
+pattern as ``GceTpuPool``) and prices offers from the public on-demand /
+spot rate card. BYOC machines are the other offer source
+(AgentMachinePool, priced at join).
+
+The rental loop: ``VendorRentalController.reconcile(demand)`` runs the
+cost-minimizing :class:`~tpu9.compute.solver.Solver` over vendor offers +
+held reservations and executes the plan — create on the cheapest
+eligible offers, keep what still serves, delete what expired or no
+longer fits (reference ``state.go:73-109`` lifecycle).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Awaitable, Callable, Optional
+
+from ..types import new_id
+from .solver import (RES_ACTIVE, RES_DELETED, RES_FAILED, RES_PENDING,
+                     Action, Demand, Offer, Plan, Reservation, Solver)
+
+log = logging.getLogger("tpu9.compute")
+
+Transport = Callable[..., Awaitable[Optional[dict]]]
+
+
+def tpu_api_base(project: str, zone: str) -> str:
+    """Queued-resources API root — the ONE place the version/URL shape
+    lives (GceTpuPool and GceTpuVendor both build requests from it)."""
+    return (f"https://tpu.googleapis.com/v2alpha1/projects/"
+            f"{project}/locations/{zone}")
+
+
+class Vendor:
+    """Rentable-capacity source (reference ComputeVendor)."""
+
+    name = "vendor"
+
+    async def list_offers(self, demand: Demand) -> list[Offer]:
+        raise NotImplementedError
+
+    async def create_reservation(self, offer: Offer, nodes: int,
+                                 ttl_hours: int) -> Reservation:
+        raise NotImplementedError
+
+    async def get_reservation(self, reservation_id: str) -> Optional[Reservation]:
+        raise NotImplementedError
+
+    async def extend_reservation(self, reservation_id: str,
+                                 ttl_hours: int) -> bool:
+        raise NotImplementedError
+
+    async def delete_reservation(self, reservation_id: str) -> bool:
+        raise NotImplementedError
+
+
+# Public list prices, micro-USD per chip-hour (us-central, mid-2025 rate
+# card; operators override via config — these seed offers, they are not
+# billing truth).
+TPU_RATES_MICROS = {
+    "v4": 3_220_000,
+    "v5e": 1_200_000,
+    "v5p": 4_200_000,
+    "v6e": 2_700_000,
+}
+SPOT_DISCOUNT = 0.6               # queued spot ≈ 40% off list
+
+
+class GceTpuVendor(Vendor):
+    """Cloud TPU via queued-resources (reference vast.go shape; GCP API).
+
+    ``transport(method, url, body) -> dict`` is injected — tests assert
+    on the calls, production passes an authed client (same contract as
+    GceTpuPool, pools.py:123)."""
+
+    name = "gce-tpu"
+
+    # queued-resource state → reservation lifecycle (state.go:73-109)
+    _STATE_MAP = {
+        "CREATING": RES_PENDING, "ACCEPTED": RES_PENDING,
+        "PROVISIONING": RES_PENDING, "WAITING_FOR_RESOURCES": RES_PENDING,
+        "ACTIVE": RES_ACTIVE,
+        "SUSPENDING": RES_DELETED, "SUSPENDED": RES_DELETED,
+        "DELETING": RES_DELETED, "FAILED": RES_FAILED,
+    }
+
+    def __init__(self, project: str, zone: str, transport: Transport,
+                 spot: bool = True, rates: Optional[dict] = None,
+                 runtime_version: str = "tpu-ubuntu2204-base"):
+        self.project = project
+        self.zone = zone
+        self.transport = transport
+        self.spot = spot
+        self.rates = rates or TPU_RATES_MICROS
+        self.runtime_version = runtime_version
+        self._held: dict[str, Reservation] = {}
+
+    def _base_url(self) -> str:
+        return tpu_api_base(self.project, self.zone)
+
+    async def list_offers(self, demand: Demand) -> list[Offer]:
+        """Offers from the rate card for the demanded shape. Availability
+        is optimistic (the API has no inventory endpoint — a failed
+        create surfaces as a FAILED reservation, which the controller
+        deletes and re-solves around)."""
+        gens = ([demand.tpu_generation] if demand.tpu_generation
+                else list(self.rates))
+        out = []
+        for gen in gens:
+            rate = self.rates.get(gen)
+            if rate is None:
+                continue
+            chips = max(demand.tpu_chips, 1)
+            cost = int(rate * chips * (SPOT_DISCOUNT if self.spot else 1.0))
+            out.append(Offer(
+                offer_id=f"{self.name}:{gen}-{chips}:{self.zone}",
+                provider=self.name, region=self.zone,
+                instance_type=f"{gen}-{chips}",
+                tpu_generation=gen, tpu_chips=chips,
+                hourly_cost_micros=cost,
+                reliability=0.9 if self.spot else 0.99,
+                available=demand.nodes,
+                labels={"spot": str(self.spot).lower()}))
+        return out
+
+    def _node_spec(self, node_id: str, accelerator_type: str) -> dict:
+        spec = {
+            "parent": f"projects/{self.project}/locations/{self.zone}",
+            "node_id": node_id,
+            "node": {
+                "accelerator_type": accelerator_type,
+                "runtime_version": self.runtime_version,
+                "network_config": {"enable_external_ips": False},
+            },
+        }
+        if self.spot:
+            spec["node"]["scheduling_config"] = {"preemptible": True}
+        return spec
+
+    async def create_reservation(self, offer: Offer, nodes: int,
+                                 ttl_hours: int) -> Reservation:
+        rid = new_id("qr")
+        body = {
+            # one DISTINCT spec per node with a unique node_id — a shared
+            # dict (list multiplication) would alias every entry and the
+            # API rejects duplicate ids
+            "tpu": {"node_spec": [
+                self._node_spec(f"{rid}-{i}" if nodes > 1 else rid,
+                                offer.instance_type)
+                for i in range(nodes)]},
+            "queueing_policy": {"valid_until_duration":
+                                f"{ttl_hours * 3600}s"},
+        }
+        resp = await self.transport(
+            "POST",
+            f"{self._base_url()}/queuedResources?queued_resource_id={rid}",
+            body)
+        resv = Reservation(
+            reservation_id=rid, offer=offer, nodes=nodes,
+            # a refused create is FAILED immediately — the solver must
+            # never count phantom capacity ("a failed create surfaces as
+            # a FAILED reservation" is the module contract)
+            status=RES_PENDING if resp is not None else RES_FAILED,
+            expires_at=time.time() + ttl_hours * 3600,
+            hourly_cost_micros=offer.hourly_cost_micros * nodes)
+        self._held[rid] = resv
+        return resv
+
+    async def get_reservation(self, reservation_id: str) -> Optional[Reservation]:
+        resv = self._held.get(reservation_id)
+        if resv is None:
+            return None
+        resp = await self.transport(
+            "GET",
+            f"{self._base_url()}/queuedResources/{reservation_id}", None)
+        state = ((resp or {}).get("state") or {}).get("state", "")
+        resv.status = self._STATE_MAP.get(state, resv.status)
+        return resv
+
+    async def extend_reservation(self, reservation_id: str,
+                                 ttl_hours: int) -> bool:
+        resv = self._held.get(reservation_id)
+        if resv is None:
+            return False
+        # queued resources have no TTL-extend RPC; the lease is tracked
+        # controller-side (the reference's vast adapter does the same —
+        # ExtendReservation is local bookkeeping, vast.go:168)
+        resv.expires_at = time.time() + ttl_hours * 3600
+        return True
+
+    async def delete_reservation(self, reservation_id: str) -> bool:
+        resp = await self.transport(
+            "DELETE",
+            f"{self._base_url()}/queuedResources/{reservation_id}", None)
+        resv = self._held.pop(reservation_id, None)
+        if resv is not None:
+            resv.status = RES_DELETED
+        return resp is not None
+
+
+class VendorRentalController:
+    """Drive a vendor toward a demand with the cost-minimizing solver
+    (reference: the compute controller over state.go reservations)."""
+
+    def __init__(self, vendor: Vendor, solver: Optional[Solver] = None):
+        self.vendor = vendor
+        self.solver = solver or Solver()
+        self.reservations: dict[str, Reservation] = {}
+
+    async def reconcile(self, demand: Demand) -> Plan:
+        # refresh held reservation states first (FAILED/expired ones are
+        # deleted by the plan instead of counting as capacity)
+        for rid in list(self.reservations):
+            live = await self.vendor.get_reservation(rid)
+            if live is not None:
+                self.reservations[rid] = live
+        if demand.nodes <= 0:
+            # demand gone: release every rental NOW, not at TTL (the
+            # solver itself refuses nodes<=0, so handle it here)
+            actions = []
+            for rid in list(self.reservations):
+                await self.vendor.delete_reservation(rid)
+                self.reservations.pop(rid, None)
+                actions.append(Action("delete", reservation_id=rid))
+            return Plan(feasible=True, actions=actions, total_nodes=0)
+        offers = await self.vendor.list_offers(demand)
+        plan = self.solver.solve(demand, offers,
+                                 list(self.reservations.values()))
+        for action in plan.actions:
+            if action.kind == "delete":
+                await self.vendor.delete_reservation(action.reservation_id)
+                self.reservations.pop(action.reservation_id, None)
+            elif action.kind == "create" and plan.feasible:
+                resv = await self.vendor.create_reservation(
+                    action.offer, action.nodes, demand.ttl_hours)
+                self.reservations[resv.reservation_id] = resv
+        log.info("rental reconcile (%s): feasible=%s nodes=%d "
+                 "new_cost=%.2f USD", self.vendor.name, plan.feasible,
+                 plan.total_nodes, plan.new_cost_micros / 1e6)
+        return plan

@@ -161,6 +161,7 @@ class Gateway:
         # polls: one worker round-trip per proc, then bus reads only
         self._sbx_proc_owner: dict[str, str] = {}
         self._runner: Optional[web.AppRunner] = None
+        self._shutting_down = asyncio.Event()
         self.port = cfg.gateway.http_port
         self.app = self._build_app()
 
@@ -397,7 +398,11 @@ class Gateway:
         await self.usage.start()
         if self.pool_monitor is not None:
             await self.pool_monitor.start()
-        self._runner = web.AppRunner(self.app)
+        # shutdown grace: long-polls exit instantly via _bounded_longpoll
+        # (the _shutting_down event), so this bound only backstops
+        # genuinely slow handlers — 15s keeps normal invokes intact while
+        # a stop never waits aiohttp's default 60s
+        self._runner = web.AppRunner(self.app, shutdown_timeout=15.0)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.cfg.gateway.host, self.port)
         await site.start()
@@ -411,7 +416,27 @@ class Gateway:
         log.info("gateway on %s:%d", self.cfg.gateway.host, self.port)
         return self
 
+    async def _bounded_longpoll(self, coro):
+        """Race a long-poll against gateway shutdown: a stop releases every
+        waiting pop/result request immediately with its empty answer
+        (clients retry after reconnect) instead of holding the HTTP drain
+        for the poll's full timeout."""
+        wait = asyncio.ensure_future(coro)
+        stop = asyncio.ensure_future(self._shutting_down.wait())
+        done, _ = await asyncio.wait({wait, stop},
+                                     return_when=asyncio.FIRST_COMPLETED)
+        if wait in done:
+            stop.cancel()
+            return wait.result()
+        wait.cancel()
+        try:
+            await wait
+        except BaseException:           # noqa: BLE001 — cancelled poll
+            pass
+        return None
+
     async def stop(self) -> None:
+        self._shutting_down.set()       # FIRST: releases every long-poll
         if self.pool_monitor is not None:
             await self.pool_monitor.stop()
         await self.endpoints.shutdown()
@@ -758,9 +783,9 @@ class Gateway:
     async def _rpc_tq_pop(self, request: web.Request) -> web.Response:
         data = await request.json()
         stub = await self._stub_for(request, data["stub_id"])
-        msg = await self.taskqueues.pop(
+        msg = await self._bounded_longpoll(self.taskqueues.pop(
             stub.workspace_id, stub.stub_id, data.get("container_id", ""),
-            timeout=min(float(data.get("timeout", 25.0)), 30.0))
+            timeout=min(float(data.get("timeout", 25.0)), 30.0)))
         if msg is None:
             return web.json_response({"task": None})
         return web.json_response({"task": {
@@ -829,7 +854,8 @@ class Gateway:
     async def _rpc_task_result(self, request: web.Request) -> web.Response:
         msg = await self._task_for(request)
         timeout = min(float(request.query.get("timeout", "0")), 110.0)
-        result = await self.dispatcher.retrieve(msg.task_id, timeout=timeout)
+        result = await self._bounded_longpoll(
+            self.dispatcher.retrieve(msg.task_id, timeout=timeout))
         if result is None:
             return web.json_response({"pending": True}, status=202)
         return web.json_response(result)

@@ -146,8 +146,8 @@ class GceTpuPool(WorkerPoolController):
         self.pending: list[dict] = []
 
     def _base_url(self) -> str:
-        return (f"https://tpu.googleapis.com/v2alpha1/projects/"
-                f"{self.cfg.gcp_project}/locations/{self.cfg.gcp_zone}")
+        from ..compute.vendors import tpu_api_base
+        return tpu_api_base(self.cfg.gcp_project, self.cfg.gcp_zone)
 
     async def can_host(self, request: ContainerRequest) -> bool:
         spec = request.tpu_spec()
