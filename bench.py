@@ -1108,12 +1108,39 @@ def _run_chip_phases(detail: dict, quick: bool, cpu: bool) -> bool:
         "raw_decode_tokens_per_sec", "engine_tokens_per_sec",
         "engine_tokens_per_sec_per_chip"))
 
+    # snapshot after EVERY completed chip phase (a flaky tunnel window
+    # must never be wasted — VERDICT r03 #1b): the 8B compiles of the
+    # endpoint phase take minutes over a relay, and a window closing
+    # mid-phase must not lose the numbers already captured
+    def snapshot() -> None:
+        if cpu or not detail.get("on_tpu"):
+            return
+        # MERGE over any prior on-TPU snapshot (an earlier alive-window
+        # may have captured phases this partial run hasn't reached yet —
+        # a plain overwrite would destroy e.g. a captured endpoint number
+        # when this window dies after the llm phase)
+        snap: dict = {}
+        try:
+            with open(os.path.join(REPO_DIR, "BENCH_TPU.json")) as f:
+                prior = json.load(f)
+            if prior.get("on_tpu"):
+                snap.update(prior)
+        except (OSError, ValueError):
+            pass
+        snap.update(detail)
+        snap["captured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+        snap.setdefault("captured_by", "bench.orchestrate")
+        _persist("BENCH_TPU.json", snap)
+
+    snapshot()
+
     # the endpoint phase's PARENT forces itself CPU internally; the runner
     # container dials the chip (unless the whole bench is CPU-forced, which
     # --cpu → TPU9_BENCH_CPU=1 propagates into the subprocess)
     lep = _run_phase("llm_endpoint", quick, cpu)
     _merge_validated(detail, "llm_endpoint", lep, (
         "endpoint_tokens_per_sec", "endpoint_tokens_per_sec_per_chip"))
+    snapshot()
 
     kern = _run_phase("kernels", quick, cpu)
     if "kernels_error" in kern and not cpu:
@@ -1129,19 +1156,11 @@ def _run_chip_phases(detail: dict, quick: bool, cpu: bool) -> bool:
     _merge_validated(detail, "kernels", kern, ("kernel_flash_ms",
                                                "kernel_paged_ms",
                                                "kernel_blocktable_ms"))
+    snapshot()
 
     if not cpu and detail.get("on_tpu"):
-        # snapshot the throughput numbers IMMEDIATELY (a flaky tunnel window
-        # must never be wasted — VERDICT r03 #1b), THEN spend the rest of
-        # the window on the on-chip restore cold start (VERDICT r04 #1) and
-        # refresh the snapshot with its numbers
-        def snapshot() -> None:
-            snap = dict(detail)
-            snap.setdefault("captured_at", time.strftime("%Y-%m-%d %H:%M:%S"))
-            snap["captured_by"] = snap.get("captured_by", "bench.orchestrate")
-            _persist("BENCH_TPU.json", snap)
-
-        snapshot()
+        # spend the rest of the window on the on-chip restore cold start
+        # (VERDICT r04 #1), then refresh the snapshot with its numbers
         cjt = _run_phase("coldstart_jax_tpu", quick, cpu=False)
         # strip the percentile dict and first-invoke time too on rejection —
         # an off-chip number must not survive under ANY _tpu key

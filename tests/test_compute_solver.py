@@ -180,7 +180,9 @@ def test_gce_vendor_rental_lifecycle():
         assert specs[0] is not specs[1]
         assert specs[0]["node_id"] != specs[1]["node_id"]
         node = specs[0]["node"]
-        assert node["accelerator_type"] == "v5e-8"
+        # the WIRE name, not tpu9's chip-count name (v5e-8):
+        # the real API calls 8-chip v5e "v5litepod-8"
+        assert node["accelerator_type"] == "v5litepod-8"
         assert node["scheduling_config"] == {"preemptible": True}
 
         # queued resource goes ACTIVE → reservation active, nothing new

@@ -80,7 +80,7 @@ def test_metadata_keys_cover_script_reads():
     assert md["tpu9-worker-token"] == "tok123"
     assert md["tpu9-slice-hosts"] == "1"
     assert md["startup-script"].startswith("#!/bin/bash")
-    assert node["accelerator_type"] == "v5e-8"
+    assert node["accelerator_type"] == "v5litepod-8"  # API wire name
 
 
 def test_systemd_unit_flags_match_worker_cli():

@@ -86,15 +86,22 @@ class TaskQueueService:
 
     async def pop(self, workspace_id: str, stub_id: str, container_id: str,
                   timeout: float = 25.0) -> Optional[TaskMessage]:
-        """Long-poll pop + claim (runner-facing)."""
+        """Long-poll pop + claim (runner-facing). Cancellation-safe: the
+        only cancel point is the blocking dequeue wait — once a task id is
+        popped (blpop is destructive), losing it to a cancel (gateway
+        shutdown, client disconnect) would strand the task in PENDING
+        until its expiry, so the id is pushed back to the queue HEAD
+        instead."""
         task_id = await self.tasks.dequeue(workspace_id, stub_id,
                                            timeout=timeout)
         if task_id is None:
             return None
-        msg = await self.dispatcher.claim(task_id, container_id)
-        if msg is None:
-            return None
-        return msg
+        try:
+            return await self.dispatcher.claim(task_id, container_id)
+        except asyncio.CancelledError:
+            # head of the queue, not the tail — it was next in line
+            await self.tasks.requeue_front(workspace_id, stub_id, task_id)
+            raise
 
     async def complete(self, task_id: str, result: Any = None,
                        error: Optional[str] = None) -> bool:

@@ -102,6 +102,7 @@ class GceTpuVendor(Vendor):
         self.rates = rates or TPU_RATES_MICROS
         self.runtime_version = runtime_version
         self._held: dict[str, Reservation] = {}
+        self._misses: dict[str, int] = {}   # consecutive GETs with no state
 
     def _base_url(self) -> str:
         return tpu_api_base(self.project, self.zone)
@@ -111,6 +112,7 @@ class GceTpuVendor(Vendor):
         is optimistic (the API has no inventory endpoint — a failed
         create surfaces as a FAILED reservation, which the controller
         deletes and re-solves around)."""
+        from ..types import gce_accelerator_type
         gens = ([demand.tpu_generation] if demand.tpu_generation
                 else list(self.rates))
         out = []
@@ -123,7 +125,9 @@ class GceTpuVendor(Vendor):
             out.append(Offer(
                 offer_id=f"{self.name}:{gen}-{chips}:{self.zone}",
                 provider=self.name, region=self.zone,
-                instance_type=f"{gen}-{chips}",
+                # the API's naming, not tpu9's chip-count naming — the
+                # rate card prices CHIPS, the wire speaks v5litepod/cores
+                instance_type=gce_accelerator_type(gen, chips),
                 tpu_generation=gen, tpu_chips=chips,
                 hourly_cost_micros=cost,
                 reliability=0.9 if self.spot else 0.99,
@@ -182,7 +186,18 @@ class GceTpuVendor(Vendor):
             "GET",
             f"{self._base_url()}/queuedResources/{reservation_id}", None)
         state = ((resp or {}).get("state") or {}).get("state", "")
-        resv.status = self._STATE_MAP.get(state, resv.status)
+        if state:
+            self._misses.pop(reservation_id, None)
+            resv.status = self._STATE_MAP.get(state, resv.status)
+        else:
+            # 404 (deleted out-of-band) and transport blips both land
+            # here; tolerate one miss, then stop counting it as capacity
+            # — a phantom ACTIVE reservation would under-provision the
+            # demand until its TTL
+            n = self._misses.get(reservation_id, 0) + 1
+            self._misses[reservation_id] = n
+            if n >= 2:
+                resv.status = RES_FAILED
         return resv
 
     async def extend_reservation(self, reservation_id: str,
@@ -231,6 +246,18 @@ class VendorRentalController:
                 self.reservations.pop(rid, None)
                 actions.append(Action("delete", reservation_id=rid))
             return Plan(feasible=True, actions=actions, total_nodes=0)
+        # extend still-serving leases BEFORE solving: a reservation under
+        # steady demand must never lapse into delete/re-provision churn
+        # (spot re-queues can wait hours) just because its TTL arrived
+        now = time.time()
+        for resv in self.reservations.values():
+            if (resv.usable(now) and resv.expires_at
+                    and resv.expires_at - now
+                    < demand.ttl_hours * 1800):      # < half a lease left
+                if await self.vendor.extend_reservation(
+                        resv.reservation_id, demand.ttl_hours):
+                    resv.expires_at = now + demand.ttl_hours * 3600
+
         offers = await self.vendor.list_offers(demand)
         plan = self.solver.solve(demand, offers,
                                  list(self.reservations.values()))

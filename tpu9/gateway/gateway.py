@@ -423,17 +423,24 @@ class Gateway:
         for the poll's full timeout."""
         wait = asyncio.ensure_future(coro)
         stop = asyncio.ensure_future(self._shutting_down.wait())
-        done, _ = await asyncio.wait({wait, stop},
-                                     return_when=asyncio.FIRST_COMPLETED)
-        if wait in done:
-            stop.cancel()
-            return wait.result()
-        wait.cancel()
         try:
-            await wait
-        except BaseException:           # noqa: BLE001 — cancelled poll
-            pass
-        return None
+            done, _ = await asyncio.wait({wait, stop},
+                                         return_when=asyncio.FIRST_COMPLETED)
+            if wait in done:
+                return wait.result()
+            return None
+        finally:
+            # runs on BOTH exits AND on handler cancellation (client
+            # disconnect): an orphaned pop would otherwise keep running —
+            # possibly dequeuing a task whose response nobody receives —
+            # and the stray Event waiter would accumulate per request
+            for t in (wait, stop):
+                if not t.done():
+                    t.cancel()
+            try:
+                await wait
+            except BaseException:       # noqa: BLE001 — cancelled poll
+                pass
 
     async def stop(self) -> None:
         self._shutting_down.set()       # FIRST: releases every long-poll

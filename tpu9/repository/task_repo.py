@@ -64,6 +64,13 @@ class TaskRepository:
     async def enqueue(self, workspace_id: str, stub_id: str, task_id: str) -> int:
         return await self.store.rpush(Keys.task_queue(workspace_id, stub_id), task_id)
 
+    async def requeue_front(self, workspace_id: str, stub_id: str,
+                            task_id: str) -> int:
+        """Give back a dequeued-but-unclaimed task (cancelled pop): it was
+        next in line, so it returns to the HEAD."""
+        return await self.store.lpush(Keys.task_queue(workspace_id, stub_id),
+                                      task_id)
+
     async def dequeue(self, workspace_id: str, stub_id: str,
                       timeout: float = 0) -> Optional[str]:
         if timeout:

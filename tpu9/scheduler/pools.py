@@ -173,7 +173,7 @@ class GceTpuPool(WorkerPoolController):
                 "parent": f"projects/{self.cfg.gcp_project}/locations/{self.cfg.gcp_zone}",
                 "node_id": node_id,
                 "node": {
-                    "accelerator_type": spec.name,
+                    "accelerator_type": spec.gce_accelerator_type,
                     "runtime_version": self.cfg.runtime_version,
                     "network_config": {"enable_external_ips": False},
                     "metadata": {"startup-script": self.startup_script,

@@ -62,6 +62,22 @@ class TpuSpec:
     def mesh_shape(self) -> tuple[int, ...]:
         return tuple(int(x) for x in self.topology.split("x"))
 
+    @property
+    def gce_accelerator_type(self) -> str:
+        return gce_accelerator_type(self.generation, self.chips)
+
+
+def gce_accelerator_type(generation: str, chips: int) -> str:
+    """The Cloud TPU API's accelerator_type string for a slice shape.
+    tpu9's canonical names count CHIPS ("v5e-8"); the API's v5e family is
+    named "v5litepod-N" and its v4/v5p names count TENSORCORES (2 per
+    chip) — sending "v5e-8" to queued-resources is a 400."""
+    if generation == "v5e":
+        return f"v5litepod-{chips}"
+    if generation in ("v4", "v5p"):
+        return f"{generation}-{chips * 2}"
+    return f"{generation}-{chips}"
+
 
 def _v5e(name: str, chips: int, hosts: int, topo: str) -> TpuSpec:
     return TpuSpec(name, "v5e", chips, hosts, topo, hbm_gb_per_chip=16,
