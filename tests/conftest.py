@@ -42,12 +42,23 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
-    """Run ``async def`` tests on a fresh event loop (no pytest-asyncio in the
-    image; this hook is our minimal equivalent)."""
+    """Run ``async def`` tests on a fresh event loop (no pytest-asyncio in
+    the image; this hook is our minimal equivalent) — in asyncio DEBUG
+    mode, the `go test -race` analogue SURVEY §5 prescribes: un-awaited
+    coroutines become hard errors and cross-thread loop misuse raises
+    instead of corrupting silently. slow_callback_duration stays high —
+    JAX compiles legitimately block the loop for seconds in tests."""
     fn = pyfuncitem.obj
     if inspect.iscoroutinefunction(fn):
         kwargs = {name: pyfuncitem.funcargs[name]
                   for name in pyfuncitem._fixtureinfo.argnames}
-        asyncio.run(fn(**kwargs))
+
+        async def wrapper():
+            # compiles legitimately block the loop for seconds in tests —
+            # keep the slow-callback log quiet below that
+            asyncio.get_running_loop().slow_callback_duration = 5.0
+            await fn(**kwargs)
+
+        asyncio.run(wrapper(), debug=True)
         return True
     return None

@@ -86,21 +86,35 @@ class TaskQueueService:
 
     async def pop(self, workspace_id: str, stub_id: str, container_id: str,
                   timeout: float = 25.0) -> Optional[TaskMessage]:
-        """Long-poll pop + claim (runner-facing). Cancellation-safe: the
-        only cancel point is the blocking dequeue wait — once a task id is
-        popped (blpop is destructive), losing it to a cancel (gateway
-        shutdown, client disconnect) would strand the task in PENDING
-        until its expiry, so the id is pushed back to the queue HEAD
-        instead."""
+        """Long-poll pop + claim (runner-facing). Cancellation-safe: blpop
+        is destructive, so a cancel (gateway shutdown, client disconnect)
+        after the dequeue must not lose the task — the claim is shielded
+        to completion and then RELEASED (or the unclaimed id pushed back
+        to the queue head). Residual window: a RemoteStore blpop cancelled
+        between the server popping and the client receiving can still
+        drop an id; the dispatcher's expiry monitor is the backstop."""
         task_id = await self.tasks.dequeue(workspace_id, stub_id,
                                            timeout=timeout)
         if task_id is None:
             return None
+        claim = asyncio.ensure_future(
+            self.dispatcher.claim(task_id, container_id))
         try:
-            return await self.dispatcher.claim(task_id, container_id)
+            return await asyncio.shield(claim)
         except asyncio.CancelledError:
-            # head of the queue, not the tail — it was next in line
-            await self.tasks.requeue_front(workspace_id, stub_id, task_id)
+            # the claim has multiple await points — let it FINISH, then
+            # revert whatever it did (a half-reverted claim would strand
+            # the task RUNNING for a container that never saw it)
+            msg = None
+            try:
+                msg = await claim
+            except Exception:           # noqa: BLE001 — claim failed
+                pass
+            if msg is not None:
+                await self.dispatcher.release(task_id, container_id)
+            else:
+                await self.tasks.requeue_front(workspace_id, stub_id,
+                                               task_id)
             raise
 
     async def complete(self, task_id: str, result: Any = None,

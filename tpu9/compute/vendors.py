@@ -191,12 +191,14 @@ class GceTpuVendor(Vendor):
             resv.status = self._STATE_MAP.get(state, resv.status)
         else:
             # 404 (deleted out-of-band) and transport blips both land
-            # here; tolerate one miss, then stop counting it as capacity
-            # — a phantom ACTIVE reservation would under-provision the
+            # here (the transport contract collapses them to None);
+            # tolerate a few misses before declaring the capacity gone —
+            # too eager and an API outage tears down healthy nodes, too
+            # lazy and a phantom ACTIVE reservation under-provisions the
             # demand until its TTL
             n = self._misses.get(reservation_id, 0) + 1
             self._misses[reservation_id] = n
-            if n >= 2:
+            if n >= 3:
                 resv.status = RES_FAILED
         return resv
 
@@ -215,10 +217,16 @@ class GceTpuVendor(Vendor):
         resp = await self.transport(
             "DELETE",
             f"{self._base_url()}/queuedResources/{reservation_id}", None)
+        if resp is None:
+            # transport down: keep tracking so the delete RETRIES — a
+            # dropped handle here would orphan live (billing) capacity
+            # that the API still holds once it recovers
+            return False
+        self._misses.pop(reservation_id, None)
         resv = self._held.pop(reservation_id, None)
         if resv is not None:
             resv.status = RES_DELETED
-        return resp is not None
+        return True
 
 
 class VendorRentalController:
@@ -242,8 +250,8 @@ class VendorRentalController:
             # solver itself refuses nodes<=0, so handle it here)
             actions = []
             for rid in list(self.reservations):
-                await self.vendor.delete_reservation(rid)
-                self.reservations.pop(rid, None)
+                if await self.vendor.delete_reservation(rid):
+                    self.reservations.pop(rid, None)
                 actions.append(Action("delete", reservation_id=rid))
             return Plan(feasible=True, actions=actions, total_nodes=0)
         # extend still-serving leases BEFORE solving: a reservation under
@@ -263,8 +271,12 @@ class VendorRentalController:
                                  list(self.reservations.values()))
         for action in plan.actions:
             if action.kind == "delete":
-                await self.vendor.delete_reservation(action.reservation_id)
-                self.reservations.pop(action.reservation_id, None)
+                if await self.vendor.delete_reservation(
+                        action.reservation_id):
+                    self.reservations.pop(action.reservation_id, None)
+                # else: keep tracking; the delete retries next reconcile
+                # (dropping the handle during an API outage would orphan
+                # live capacity)
             elif action.kind == "create" and plan.feasible:
                 resv = await self.vendor.create_reservation(
                     action.offer, action.nodes, demand.ttl_hours)

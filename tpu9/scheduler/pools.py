@@ -125,7 +125,9 @@ class GceTpuPool(WorkerPoolController):
     ``pool_provider.go:53`` + ``pkg/providers``).
 
     Maps a request's slice shape to a queued-resource create call:
-    ``v5p-64`` → accelerator_type=v5p-64 (16 hosts share the slice; each host
+    ``v5p-64`` → accelerator_type=v5p-128 — the API's v5p/v4 names count
+    TENSORCORES (2/chip) and v5e is "v5litepod-N"; see
+    ``tpu9.types.gce_accelerator_type`` (16 hosts share the slice; each host
     boots a tpu9 worker via startup script that joins this cluster with
     slice_id = the queued resource name). ``transport(method, url, body)`` is
     injected; tests assert on the calls, production passes an authed client.

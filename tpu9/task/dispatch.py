@@ -137,6 +137,25 @@ class Dispatcher:
                                               container_id)
         return msg
 
+    async def release(self, task_id: str, container_id: str) -> bool:
+        """Revert a claim whose pop response was never delivered (the
+        long-poll was cancelled mid-claim): PENDING again, back at the
+        queue HEAD — it was next in line. Without the retry-count bump of
+        ``requeue_lost`` (the container never even saw the task)."""
+        msg = await self.tasks.get_message(task_id)
+        if (msg is None or msg.status != TaskStatus.RUNNING.value
+                or msg.container_id != container_id):
+            return False
+        await self.tasks.unclaim(container_id, task_id)
+        msg.status = TaskStatus.PENDING.value
+        msg.container_id = ""          # set_status keeps a non-empty owner
+        await self.tasks.put_message(msg)
+        await self.backend.update_task_status(
+            task_id, TaskStatus.PENDING.value, "")
+        await self.tasks.requeue_front(msg.workspace_id, msg.stub_id,
+                                       task_id)
+        return True
+
     async def complete(self, task_id: str, result: Any = None,
                        error: Optional[str] = None,
                        container_id: str = "") -> Optional[TaskMessage]:
