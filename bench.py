@@ -736,6 +736,11 @@ def bench_cold_start_native(quick: bool = False) -> dict:
                 if f is not None:
                     await asyncio.wait_for(f.wait(), 300)
                 shutil.rmtree(bundle, ignore_errors=True)
+                # benchmark hygiene: the previous trial's 1 GiB fill
+                # leaves dirty pages whose writeback otherwise bleeds
+                # into this trial's timed window (observed ±0.5 s noise)
+                await asyncio.to_thread(os.sync)
+                await asyncio.sleep(0.3)
                 before = cache_ops()
                 t0 = time.perf_counter()
                 await stack.invoke(dep, {"n": 2})

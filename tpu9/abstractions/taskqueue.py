@@ -104,17 +104,27 @@ class TaskQueueService:
         except asyncio.CancelledError:
             # the claim has multiple await points — let it FINISH, then
             # revert whatever it did (a half-reverted claim would strand
-            # the task RUNNING for a container that never saw it)
-            msg = None
+            # the task RUNNING for a container that never saw it). The
+            # revert runs as its OWN task so a second cancellation (loop
+            # cancel-all at shutdown) cannot abort it half-way — worst
+            # case it completes detached before the loop closes.
+            async def revert() -> None:
+                msg = None
+                try:
+                    msg = await claim
+                except BaseException:   # noqa: BLE001 — incl. cancel
+                    pass
+                if msg is not None:
+                    await self.dispatcher.release(task_id, container_id)
+                else:
+                    await self.tasks.requeue_front(workspace_id, stub_id,
+                                                   task_id)
+
+            t = asyncio.ensure_future(revert())
             try:
-                msg = await claim
-            except Exception:           # noqa: BLE001 — claim failed
-                pass
-            if msg is not None:
-                await self.dispatcher.release(task_id, container_id)
-            else:
-                await self.tasks.requeue_front(workspace_id, stub_id,
-                                               task_id)
+                await asyncio.shield(t)
+            except asyncio.CancelledError:
+                pass                    # revert continues detached
             raise
 
     async def complete(self, task_id: str, result: Any = None,

@@ -252,7 +252,9 @@ class VendorRentalController:
             for rid in list(self.reservations):
                 if await self.vendor.delete_reservation(rid):
                     self.reservations.pop(rid, None)
-                actions.append(Action("delete", reservation_id=rid))
+                    actions.append(Action("delete", reservation_id=rid))
+                # else: handle retained, delete retries next reconcile —
+                # the plan must not claim a teardown that didn't happen
             return Plan(feasible=True, actions=actions, total_nodes=0)
         # extend still-serving leases BEFORE solving: a reservation under
         # steady demand must never lapse into delete/re-provision churn

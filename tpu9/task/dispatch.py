@@ -147,6 +147,13 @@ class Dispatcher:
                 or msg.container_id != container_id):
             return False
         await self.tasks.unclaim(container_id, task_id)
+        # re-read right before the write: a cancel()/complete() landing
+        # between the check above and here must not be RESURRECTED by a
+        # stale PENDING overwrite (same guard complete() applies)
+        msg = await self.tasks.get_message(task_id)
+        if (msg is None or TaskStatus(msg.status).terminal
+                or msg.status != TaskStatus.RUNNING.value):
+            return False
         msg.status = TaskStatus.PENDING.value
         msg.container_id = ""          # set_status keeps a non-empty owner
         await self.tasks.put_message(msg)
