@@ -73,9 +73,16 @@ CURATED = {
     "mlock", "mlock2", "munlock", "mlockall", "munlockall", "msync",
     "mincore", "mremap", "pkey_alloc",
     "pkey_free", "pkey_mprotect", "madvise", "process_madvise",
-    # files — older/newer variants of what python/glibc rotate between
+    # files — older/newer variants of what python/glibc rotate between.
+    # The *at family is what coreutils/tar ACTUALLY issue (mv uses
+    # renameat2 and only falls back on ENOSYS, never EPERM — a missing
+    # entry here breaks `mv` inside every default container)
     "open", "creat", "access", "faccessat", "faccessat2", "stat", "lstat",
     "chmod", "chown", "lchown", "rename", "mkdir", "rmdir", "unlink",
+    "renameat", "renameat2", "mkdirat", "unlinkat", "symlinkat", "linkat",
+    "readlinkat", "fchmod", "fchown", "fchmodat", "fchownat", "fchmodat2",
+    "pipe", "pipe2", "newfstatat", "fstat", "lseek", "fcntl", "chdir",
+    "fchdir", "getcwd", "truncate", "ftruncate",
     "link", "symlink", "readlink", "utime", "utimes", "futimesat",
     "utimensat", "statx", "statfs", "fstatfs", "sync", "syncfs",
     "fsync", "fdatasync", "sync_file_range", "fallocate", "flock",
@@ -155,7 +162,9 @@ WORKLOADS = [
 
 
 def record(tracer: str, trace_path: str) -> None:
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # FULL tier: the slow-marked LLM e2e tests exercise runner syscall
+    # surface the default tier skips
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TPU9_FULL_SUITE="1")
     for cmd in WORKLOADS:
         print(f"[gen_allowlist] tracing: {' '.join(cmd[:6])} ...",
               flush=True)

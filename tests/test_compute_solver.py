@@ -246,3 +246,25 @@ def test_vendor_failed_create_never_counts_as_capacity():
         assert len(posts) >= 2            # it re-attempted the rental
 
     asyncio.run(run())
+
+
+def test_solver_shrinks_surplus_reservations():
+    """Round-5 review (high): when demand drops below held capacity the
+    plan must DELETE the surplus (most expensive first) — a cost-
+    minimizing controller converges to the demanded spend, it doesn't
+    bill surplus rentals until TTL."""
+    held = [
+        Reservation("r-cheap", _offer("a", 1_000_000), nodes=1,
+                    status="active", hourly_cost_micros=1_000_000),
+        Reservation("r-exp", _offer("b", 5_000_000), nodes=1,
+                    status="active", hourly_cost_micros=5_000_000),
+        Reservation("r-mid", _offer("c", 2_000_000), nodes=1,
+                    status="active", hourly_cost_micros=2_000_000),
+    ]
+    plan = Solver().solve(Demand(nodes=1, tpu_generation="v5e",
+                                 tpu_chips=4), [], held)
+    kinds = {a.reservation_id: a.kind for a in plan.actions}
+    assert kinds == {"r-cheap": "keep", "r-exp": "delete",
+                     "r-mid": "delete"}
+    assert plan.total_nodes == 1
+    assert plan.committed_cost_micros == 1_000_000

@@ -154,8 +154,13 @@ def try_sys(nr, *args):
 def handler(**kwargs):
     # x86_64 numbers: io_uring_setup=425 (off-list kernel surface),
     # unshare=272 (namespace escape vector)
+    import subprocess, tempfile
+    d = tempfile.mkdtemp()
+    open(d + "/a", "w").write("x")
+    mv_rc = subprocess.run(["mv", d + "/a", d + "/b"]).returncode
     return {"io_uring_errno": try_sys(425, 4, 0),
             "unshare_errno": try_sys(272, 0),
+            "mv_rc": mv_rc,
             "pid": os.getpid()}
 """
 
@@ -179,6 +184,9 @@ def test_default_seccomp_is_allowlist(monkeypatch):
     import errno
     assert resp["io_uring_errno"] == errno.EPERM, resp
     assert resp["unshare_errno"] == errno.EPERM, resp
+    # coreutils `mv` uses renameat2 with ENOSYS-only fallback — the
+    # allow-list must cover the *at family or everyday userland breaks
+    assert resp["mv_rc"] == 0, resp
     assert resp["pid"] > 0
 
 

@@ -253,3 +253,37 @@ def test_safe_join_second_pass_with_symlinks(tmp_path):
     assert os.readlink(dest / "bin" / "python") == "/usr/bin/python3"
     assert safe_join(str(dest), "link.cfg").endswith("/link.cfg")
     assert (dest / "real.cfg").read_bytes() == b"ok"
+
+
+def test_symlink_then_file_entry_cannot_write_through(tmp_path):
+    """Round-5 review (high): a hostile manifest pairing a symlink entry
+    with a SAME-PATH file entry must not write (or chmod) through the
+    link as root — O_NOFOLLOW writers refuse the swapped-in link."""
+    from tpu9.images.manifest import FileEntry, ImageManifest
+
+    victim = tmp_path / "victim.txt"
+    victim.write_text("precious")
+    dest = tmp_path / "bundle"
+    m = ImageManifest(image_id="evil2", kind="env", files=[
+        FileEntry(path="x", mode=0o777, size=0,
+                  link_target=str(victim)),
+        FileEntry(path="x", mode=0o666, size=4, chunks=["d1"]),
+    ])
+    try:
+        materialize(m, str(dest), {"d1": b"evil"}.get)
+    except OSError:
+        pass                              # refusing loudly is acceptable
+    assert victim.read_text() == "precious"
+    assert oct(victim.stat().st_mode & 0o777) != "0o666"
+
+    # the lazy skeleton writer takes the same O_NOFOLLOW path
+    from tpu9.images.lazy import LazyFill
+
+    fill = LazyFill(m, str(tmp_path / "bundle2"), None,
+                    str(tmp_path / "fill.sock"))
+    try:
+        fill._write_skeleton()
+    except OSError:
+        pass                              # refusing loudly is acceptable
+    assert victim.read_text() == "precious"
+    assert oct(victim.stat().st_mode & 0o777) != "0o666"

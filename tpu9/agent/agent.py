@@ -380,14 +380,20 @@ class Agent:
                 lines.append(carry)
                 carry = b""
             for raw in lines:
-                if raw and len(self._log_buffer) < 2000:
-                    self._log_buffer.append(
-                        f"[pid {proc.pid}] "
-                        f"{raw[:4096].decode(errors='replace').rstrip()}")
-        if carry and len(self._log_buffer) < 2000:
-            self._log_buffer.append(
-                f"[pid {proc.pid}] "
-                f"{carry[:4096].decode(errors='replace').rstrip()}")
+                if raw:
+                    self._buffer_line(proc.pid, raw)
+        if carry:
+            self._buffer_line(proc.pid, carry)
+
+    def _buffer_line(self, pid: int, raw: bytes) -> None:
+        # TAIL semantics under backpressure: when a gateway outage pins
+        # the buffer at cap, drop the OLDEST lines — the operator
+        # debugging the outage needs what the worker logged DURING it,
+        # not the stale pre-outage head
+        if len(self._log_buffer) >= 2000:
+            del self._log_buffer[0]
+        self._log_buffer.append(
+            f"[pid {pid}] {raw[:4096].decode(errors='replace').rstrip()}")
 
     async def _ship_logs(self) -> bool:
         """One batch to the gateway; False = transport failure (batch

@@ -152,14 +152,27 @@ class Solver:
         if demand.nodes <= 0:
             return Plan(feasible=False, reason="demand.nodes must be > 0")
 
-        # 1) existing reservations: keep what still serves the demand,
-        #    delete what is expired/failed or no longer eligible
+        # 1) existing reservations: keep the CHEAPEST that still serve the
+        #    demand, delete the expired/failed/ineligible AND any surplus
+        #    beyond demand.nodes — a cost-minimizing plan must shrink, not
+        #    just grow (keeping every usable rental after demand drops
+        #    would bill the surplus until its TTL)
         actions: list[Action] = []
-        existing = 0
-        committed = 0
+        keepable: list[Reservation] = []
         for r in reservations or ():
             if r.usable(now) and eligible(r.offer, demand):
-                actions.append(Action("keep", reservation_id=r.reservation_id,
+                keepable.append(r)
+            else:
+                actions.append(Action("delete",
+                                      reservation_id=r.reservation_id))
+        keepable.sort(
+            key=lambda r: r.hourly_cost_micros / max(r.nodes, 1))
+        existing = 0
+        committed = 0
+        for r in keepable:
+            if existing < demand.nodes:
+                actions.append(Action("keep",
+                                      reservation_id=r.reservation_id,
                                       nodes=r.nodes))
                 existing += r.nodes
                 committed += r.hourly_cost_micros

@@ -214,13 +214,19 @@ class GceTpuVendor(Vendor):
         return True
 
     async def delete_reservation(self, reservation_id: str) -> bool:
+        held = self._held.get(reservation_id)
         resp = await self.transport(
             "DELETE",
             f"{self._base_url()}/queuedResources/{reservation_id}", None)
-        if resp is None:
+        if resp is None and not (held is not None
+                                 and held.status == RES_FAILED):
             # transport down: keep tracking so the delete RETRIES — a
             # dropped handle here would orphan live (billing) capacity
-            # that the API still holds once it recovers
+            # that the API still holds once it recovers. A FAILED handle
+            # is the exception: its resource never existed (refused
+            # create) or is already confirmed gone (miss-counted), so the
+            # 404-shaped None is expected and the handle must not pile up
+            # re-issuing doomed DELETEs forever.
             return False
         self._misses.pop(reservation_id, None)
         resv = self._held.pop(reservation_id, None)
@@ -256,21 +262,10 @@ class VendorRentalController:
                 # else: handle retained, delete retries next reconcile —
                 # the plan must not claim a teardown that didn't happen
             return Plan(feasible=True, actions=actions, total_nodes=0)
-        # extend still-serving leases BEFORE solving: a reservation under
-        # steady demand must never lapse into delete/re-provision churn
-        # (spot re-queues can wait hours) just because its TTL arrived
-        now = time.time()
-        for resv in self.reservations.values():
-            if (resv.usable(now) and resv.expires_at
-                    and resv.expires_at - now
-                    < demand.ttl_hours * 1800):      # < half a lease left
-                if await self.vendor.extend_reservation(
-                        resv.reservation_id, demand.ttl_hours):
-                    resv.expires_at = now + demand.ttl_hours * 3600
-
         offers = await self.vendor.list_offers(demand)
         plan = self.solver.solve(demand, offers,
                                  list(self.reservations.values()))
+        now = time.time()
         for action in plan.actions:
             if action.kind == "delete":
                 if await self.vendor.delete_reservation(
@@ -279,6 +274,19 @@ class VendorRentalController:
                 # else: keep tracking; the delete retries next reconcile
                 # (dropping the handle during an API outage would orphan
                 # live capacity)
+            elif action.kind == "keep":
+                # extend ONLY what the solve kept (extending before the
+                # solve would renew surplus rentals forever): a kept
+                # reservation under steady demand must never lapse into
+                # delete/re-provision churn (spot re-queues can wait
+                # hours) just because its TTL arrived
+                resv = self.reservations.get(action.reservation_id)
+                if (resv is not None and resv.expires_at
+                        and resv.expires_at - now
+                        < demand.ttl_hours * 1800):  # < half a lease left
+                    if await self.vendor.extend_reservation(
+                            resv.reservation_id, demand.ttl_hours):
+                        resv.expires_at = now + demand.ttl_hours * 3600
             elif action.kind == "create" and plan.feasible:
                 resv = await self.vendor.create_reservation(
                     action.offer, action.nodes, demand.ttl_hours)

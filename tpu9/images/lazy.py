@@ -40,7 +40,7 @@ import os
 from typing import Optional
 
 from ..cache import CacheClient
-from .manifest import FileEntry, ImageManifest, safe_join
+from .manifest import FileEntry, ImageManifest, open_nofollow, safe_join
 
 log = logging.getLogger("tpu9.images")
 
@@ -120,9 +120,9 @@ class LazyFill:
                 except FileExistsError:
                     pass
                 continue
-            with open(target, "wb") as f:
+            with os.fdopen(open_nofollow(target), "wb") as f:
                 f.truncate(entry.size)
-            os.chmod(target, entry.mode & 0o777)
+                os.fchmod(f.fileno(), entry.mode & 0o777)
         with open(os.path.join(self.dest, LAZY_MARKER), "w") as f:
             f.write(self.manifest.manifest_hash)
 
@@ -138,10 +138,13 @@ class LazyFill:
                 except FileExistsError:
                     pass
                 continue
-            # sparse placeholder: final size + mode, zero bytes on disk
-            with open(target, "wb") as f:
+            # sparse placeholder: final size + mode, zero bytes on disk.
+            # O_NOFOLLOW + fchmod: a hostile manifest pairing a symlink
+            # entry with a same-path file entry must not write (or chmod)
+            # through the link as root
+            with os.fdopen(open_nofollow(target, os.O_TRUNC), "wb") as f:
                 f.truncate(entry.size)
-            os.chmod(target, entry.mode & 0o777)
+                os.fchmod(f.fileno(), entry.mode & 0o777)
         import json
         with open(os.path.join(self.dest, ".tpu9-env.json"), "w") as f:
             json.dump({"env": self.manifest.env,
@@ -246,8 +249,11 @@ class LazyFill:
                 datas.append(blob)
 
             def write(off: int, blobs: list) -> int:
-                # placeholder already has final size+mode; write in place
-                with open(target, "r+b") as f:
+                # placeholder already has final size+mode; write in place.
+                # O_NOFOLLOW: a symlink swapped in at this path must fail,
+                # never receive root-privileged chunk bytes
+                fd = os.open(target, os.O_WRONLY | os.O_NOFOLLOW)
+                with os.fdopen(fd, "wb", closefd=True) as f:
                     f.seek(off)
                     for b in blobs:
                         f.write(b)

@@ -51,6 +51,21 @@ def safe_join(dest: str, rel: str, dest_real: str | None = None) -> str:
     return os.path.join(parent, os.path.basename(full))
 
 
+def open_nofollow(target: str, flags: int = 0) -> int:
+    """Open a manifest-addressed file for writing WITHOUT following a
+    symlink at the final component. safe_join leaves that component
+    unresolved (legit symlink entries must stay re-creatable on resume),
+    which would let a hostile manifest place a symlink entry and then a
+    same-path FILE entry whose root-privileged write follows the link
+    anywhere on the host — O_NOFOLLOW (plus clearing any pre-existing
+    non-regular node) closes that, race-free. Returns a raw fd."""
+    if os.path.islink(target) or (os.path.lexists(target)
+                                  and not os.path.isfile(target)):
+        os.unlink(target)
+    return os.open(target,
+                   os.O_WRONLY | os.O_CREAT | os.O_NOFOLLOW | flags, 0o644)
+
+
 @dataclass
 class FileEntry:
     path: str                  # relative path in the bundle
@@ -175,10 +190,13 @@ def materialize(manifest: ImageManifest, dest: str, get_chunk,
                     continue
                 except OSError:
                     pass
-        with open(target, "wb") as f:
+        fd = open_nofollow(target, os.O_TRUNC)
+        with os.fdopen(fd, "wb") as f:
             for digest in entry.chunks:
                 data = get_chunk(digest)
                 if data is None:
                     raise IOError(f"missing chunk {digest} for {entry.path}")
                 f.write(data)
-        os.chmod(target, entry.mode & 0o777)
+            # fchmod on the fd we actually wrote — a path chmod would
+            # follow a racing symlink swap
+            os.fchmod(f.fileno(), entry.mode & 0o777)
