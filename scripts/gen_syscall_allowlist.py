@@ -82,7 +82,7 @@ CURATED = {
     "renameat", "renameat2", "mkdirat", "unlinkat", "symlinkat", "linkat",
     "readlinkat", "fchmod", "fchown", "fchmodat", "fchownat", "fchmodat2",
     "pipe", "pipe2", "newfstatat", "fstat", "lseek", "fcntl", "chdir",
-    "fchdir", "getcwd", "truncate", "ftruncate",
+    "fchdir", "getcwd",
     "link", "symlink", "readlink", "utime", "utimes", "futimesat",
     "utimensat", "statx", "statfs", "fstatfs", "sync", "syncfs",
     "fsync", "fdatasync", "sync_file_range", "fallocate", "flock",

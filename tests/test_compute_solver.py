@@ -268,3 +268,18 @@ def test_solver_shrinks_surplus_reservations():
                      "r-mid": "delete"}
     assert plan.total_nodes == 1
     assert plan.committed_cost_micros == 1_000_000
+
+
+def test_shrink_prefers_keeping_active_over_cheaper_pending():
+    """Review: shrinking must never tear down a SERVING node in favor of
+    a cheaper rental still waiting in the spot queue."""
+    held = [
+        Reservation("r-active", _offer("a", 5_000_000), nodes=1,
+                    status="active", hourly_cost_micros=5_000_000),
+        Reservation("r-pending", _offer("b", 1_000_000), nodes=1,
+                    status="pending", hourly_cost_micros=1_000_000),
+    ]
+    plan = Solver().solve(Demand(nodes=1, tpu_generation="v5e",
+                                 tpu_chips=4), [], held)
+    kinds = {a.reservation_id: a.kind for a in plan.actions}
+    assert kinds == {"r-active": "keep", "r-pending": "delete"}

@@ -165,8 +165,12 @@ class Solver:
             else:
                 actions.append(Action("delete",
                                       reservation_id=r.reservation_id))
+        # ACTIVE before PENDING, then cheapest: shrinking must never tear
+        # down a SERVING node in favor of a cheaper rental still waiting
+        # in a spot queue (which can sit unprovisioned for hours)
         keepable.sort(
-            key=lambda r: r.hourly_cost_micros / max(r.nodes, 1))
+            key=lambda r: (0 if r.status == RES_ACTIVE else 1,
+                           r.hourly_cost_micros / max(r.nodes, 1)))
         existing = 0
         committed = 0
         for r in keepable:

@@ -103,6 +103,11 @@ class GceTpuVendor(Vendor):
         self.runtime_version = runtime_version
         self._held: dict[str, Reservation] = {}
         self._misses: dict[str, int] = {}   # consecutive GETs with no state
+        # reservations whose create POST was REFUSED: the resource never
+        # existed, so their DELETE legitimately 404s and the handle may
+        # drop without API confirmation — unlike miss-counted FAILED,
+        # which can be a pure transport outage over live capacity
+        self._never_created: set[str] = set()
 
     def _base_url(self) -> str:
         return tpu_api_base(self.project, self.zone)
@@ -175,6 +180,8 @@ class GceTpuVendor(Vendor):
             status=RES_PENDING if resp is not None else RES_FAILED,
             expires_at=time.time() + ttl_hours * 3600,
             hourly_cost_micros=offer.hourly_cost_micros * nodes)
+        if resp is None:
+            self._never_created.add(rid)
         self._held[rid] = resv
         return resv
 
@@ -214,20 +221,19 @@ class GceTpuVendor(Vendor):
         return True
 
     async def delete_reservation(self, reservation_id: str) -> bool:
-        held = self._held.get(reservation_id)
         resp = await self.transport(
             "DELETE",
             f"{self._base_url()}/queuedResources/{reservation_id}", None)
-        if resp is None and not (held is not None
-                                 and held.status == RES_FAILED):
+        if resp is None and reservation_id not in self._never_created:
             # transport down: keep tracking so the delete RETRIES — a
             # dropped handle here would orphan live (billing) capacity
-            # that the API still holds once it recovers. A FAILED handle
-            # is the exception: its resource never existed (refused
-            # create) or is already confirmed gone (miss-counted), so the
-            # 404-shaped None is expected and the handle must not pile up
-            # re-issuing doomed DELETEs forever.
+            # that the API still holds once it recovers. (Miss-counted
+            # FAILED is NOT exempt: three missed GETs can be the same
+            # outage that is failing this DELETE.) Only a never-created
+            # resource — its create POST was refused — may drop without
+            # API confirmation, since its DELETE legitimately 404s.
             return False
+        self._never_created.discard(reservation_id)
         self._misses.pop(reservation_id, None)
         resv = self._held.pop(reservation_id, None)
         if resv is not None:

@@ -186,7 +186,13 @@ def materialize(manifest: ImageManifest, dest: str, get_chunk,
             if src is not None:
                 try:
                     os.link(src, target)
-                    os.chmod(target, entry.mode & 0o777)
+                    # fd-based chmod via O_NOFOLLOW — the same racing-
+                    # symlink-swap hardening as the copy path below
+                    fd = os.open(target, os.O_WRONLY | os.O_NOFOLLOW)
+                    try:
+                        os.fchmod(fd, entry.mode & 0o777)
+                    finally:
+                        os.close(fd)
                     continue
                 except OSError:
                     pass
