@@ -97,3 +97,83 @@ async def test_moe_engine_generates():
         assert out == out2
     finally:
         await engine.stop()
+
+
+async def test_bucket_wider_than_cache_is_clamped():
+    """Serving review (high): default buckets (128,512,2048) with a
+    smaller max_seq_len picked a bucket wider than the cache — the splice
+    became a trace-time error that killed the serve loop."""
+    import asyncio
+
+    params = init_decoder(jax.random.PRNGKey(0), TINY)
+    eng = InferenceEngine(params, TINY, EngineConfig(
+        max_batch=2, max_seq_len=64, prefill_buckets=(16, 128),
+        temperature=0.0))
+    await eng.start()
+    try:
+        out = await asyncio.wait_for(
+            eng.generate(list(range(2, 42)), max_new_tokens=4), 60)
+        assert len(out) == 4
+    finally:
+        await eng.stop()
+
+
+async def test_dead_engine_fails_fast_not_hangs():
+    """Serving review (high): after the serve loop dies, generate() must
+    raise immediately (and /health must see engine_dead) — not enqueue
+    into a black hole forever."""
+    import asyncio
+
+    eng = make_engine()
+    await eng.start()
+    try:
+        async def boom(req, slot):
+            raise RuntimeError("injected engine failure")
+
+        eng._admit = boom
+        with __import__("pytest").raises(ValueError, match="engine failure"):
+            await asyncio.wait_for(eng.generate([1, 2, 3]), 30)
+        assert eng.stats()["engine_dead"] is True
+        with __import__("pytest").raises(RuntimeError, match="dead"):
+            await eng.generate([1, 2, 3])
+    finally:
+        await eng.stop()
+
+
+async def test_stop_releases_pending_callers():
+    """Serving review (high): stop() must not strand callers awaiting
+    queued requests."""
+    import asyncio
+
+    eng = make_engine(max_batch=1)
+    await eng.start()
+    a = asyncio.create_task(eng.generate([1, 2, 3], max_new_tokens=64))
+    b = asyncio.create_task(eng.generate([4, 5, 6], max_new_tokens=64))
+    await asyncio.sleep(0.2)
+    await eng.stop()
+    for t in (a, b):
+        with __import__("pytest").raises((ValueError, RuntimeError)):
+            await asyncio.wait_for(t, 10)
+
+
+async def test_cancel_request_frees_slot():
+    """Serving review (high): a client abandoning a stream must free the
+    slot (bounded overshoot), not decode the full budget into a dead
+    queue."""
+    import asyncio
+
+    eng = make_engine(max_batch=1)
+    await eng.start()
+    try:
+        req = await eng.generate([1, 2, 3], max_new_tokens=10_000,
+                                 stream=True)
+        await req.queue.get()              # stream is producing
+        eng.cancel_request(req)
+        await asyncio.wait_for(req.done.wait(), 30)
+        # the slot must come free for new work well before 10k tokens
+        out = await asyncio.wait_for(
+            eng.generate([7, 8, 9], max_new_tokens=4), 60)
+        assert len(out) == 4
+        assert len(req.generated) < 10_000
+    finally:
+        await eng.stop()

@@ -64,8 +64,12 @@ async def amain() -> None:
     async def health(request: web.Request) -> web.Response:
         if not state["ready"]:
             return web.json_response({"ready": False}, status=503)
-        return web.json_response({"ready": True,
-                                  **state["engine"].stats()})
+        stats = state["engine"].stats()
+        if stats.get("engine_dead"):
+            # the serve loop died: stop advertising ready or the gateway
+            # keeps routing requests into a black hole
+            return web.json_response({"ready": False, **stats}, status=503)
+        return web.json_response({"ready": True, **stats})
 
     async def generate(request: web.Request) -> web.StreamResponse:
         if not state["ready"]:
@@ -120,11 +124,14 @@ async def amain() -> None:
                     .encode())
             await sr.write_eof()
         except ConnectionResetError:
-            pass                # client went away; engine slot retires
+            # client went away: tell the ENGINE — otherwise the slot keeps
+            # decoding the full budget into a queue nobody reads, pinning
+            # batch capacity with dead work
+            state["engine"].cancel_request(req)
         except asyncio.CancelledError:
-            # shutdown/disconnect cancellation must propagate — swallowing
-            # it would leave the task "done" while the server is tearing
-            # down and the engine still generating into a dead queue
+            # server teardown / disconnect cancellation: same engine-side
+            # cleanup, but the cancellation must still propagate
+            state["engine"].cancel_request(req)
             raise
         return sr
 

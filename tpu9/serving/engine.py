@@ -77,6 +77,7 @@ class _Request:
     done: asyncio.Event = field(default_factory=asyncio.Event)
     queue: Optional[asyncio.Queue] = None   # set for streaming requests
     error: str = ""
+    cancelled: bool = False                 # client abandoned the request
 
 
 class InferenceEngine:
@@ -141,11 +142,12 @@ class InferenceEngine:
             # batch-1 dense scratch the chunked prefill writes through
             # before splicing into pool blocks — ONE lane, not B of them
             self._scratch = init_kv_cache(cfg, 1, s)
-            self._wait_room: list[_Request] = []
         else:
             self.kv_cache = init_kv_cache(cfg, b, s)
             self.allocator = None
             self.prefix_cache = None
+        self._buckets = sorted({min(bk, s)
+                                for bk in engine_cfg.prefill_buckets})
         self.cache_len = jnp.zeros((b,), jnp.int32)     # valid prefix per slot
         self.active = np.zeros((b,), dtype=bool)
         self.slot_req: list[Optional[_Request]] = [None] * b
@@ -153,6 +155,12 @@ class InferenceEngine:
         self._rng = jax.random.PRNGKey(0)
         self._queue: asyncio.Queue[_Request] = asyncio.Queue()
         self._loop_task: Optional[asyncio.Task] = None
+        self._dead_reason: Optional[str] = None   # loop died: fail fast
+        self._admitting: Optional[_Request] = None
+        # paged admission parks over-budget requests here; dense mode
+        # keeps it empty (shared so failure fan-out/cancel need no mode
+        # branches)
+        self._wait_room: list[_Request] = []
         self._compiled: dict[Any, Any] = {}
         self._host_len = np.zeros((b,), dtype=np.int64)  # host mirror of
         # cache_len — the loop must not pay a device round-trip to know room
@@ -246,10 +254,13 @@ class InferenceEngine:
         return fn
 
     def _bucket_for(self, n: int) -> int:
-        for b in self.ecfg.prefill_buckets:
+        # buckets are CLAMPED to max_seq_len: a configured bucket wider
+        # than the cache (e.g. default (128,512,2048) with max_seq 1024)
+        # would make the splice a trace-time error that kills the loop
+        for b in self._buckets:
             if n <= b:
                 return b
-        return self.ecfg.prefill_buckets[-1]
+        return self._buckets[-1]
 
     # -- paged-KV machinery --------------------------------------------------
 
@@ -476,7 +487,7 @@ class InferenceEngine:
                 np.asarray(jax.device_get(last[:4]))
                 timings[f"chunk_group_{g}_s"] = _time.perf_counter() - t0
         else:
-            for bucket in self.ecfg.prefill_buckets:
+            for bucket in self._buckets:
                 t0 = _time.perf_counter()
                 tokens = jnp.zeros((1, bucket), jnp.int32)
                 last, _cache = self._prefill_fn(bucket)(self.params,
@@ -501,13 +512,36 @@ class InferenceEngine:
                 await self._loop_task
             except asyncio.CancelledError:
                 pass
+            except Exception:      # noqa: BLE001 — loop ALREADY died;
+                pass               # its failure was logged + fanned out
             self._loop_task = None
+        # a clean shutdown must not strand callers: anything still
+        # admitted/waiting/queued gets a terminal answer (the loop's
+        # failure handler only covers Exception, not CancelledError)
+        self._fail_all_requests("engine stopped")
+
+    def cancel_request(self, req: "_Request") -> None:
+        """Abandon a request (client disconnected mid-stream): the serve
+        loop retires its slot at the next host sync instead of decoding
+        the full budget into a queue nobody reads."""
+        req.cancelled = True
+        if req.done.is_set():
+            return
+        if req in self._wait_room:
+            self._wait_room.remove(req)
+            if req.queue is not None:
+                req.queue.put_nowait(None)
+            req.done.set()
 
     async def generate(self, prompt: list[int], max_new_tokens: int = 32,
                        request_id: str = "", stream: bool = False):
+        if self._dead_reason is not None:
+            raise RuntimeError(
+                f"engine is dead: {self._dead_reason} (restart the "
+                "container — requests would hang forever)")
         # chunked prefill (paged mode) has no bucket cap — only the cache
         limit = self.ecfg.max_seq_len - 1 if self.paged else \
-            min(self.ecfg.prefill_buckets[-1], self.ecfg.max_seq_len - 1)
+            min(self._buckets[-1], self.ecfg.max_seq_len - 1)
         if len(prompt) > limit:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds engine limit {limit}")
@@ -529,8 +563,12 @@ class InferenceEngine:
         out = dict(self._stats)
         out["active_streams"] = int(self.active.sum())
         out["queued"] = self._queue.qsize()
+        out["engine_dead"] = self._dead_reason is not None
+        # host mirror, NOT device_get: a blocking read here would stall
+        # the event loop (health checks, SSE) behind the in-flight decode
+        # window
         out["token_pressure"] = float(
-            np.asarray(jax.device_get(self.cache_len)).sum()
+            self._host_len.sum()
             / (self.ecfg.max_batch * self.ecfg.max_seq_len))
         if self.paged:
             out["kv_blocks_used"] = self.allocator.used_count
@@ -734,14 +772,12 @@ class InferenceEngine:
         tokens[0, :n] = req.prompt[:bucket]
         last, cache = self._prefill_fn(bucket)(
             self.params, jnp.asarray(tokens), n)
-        # copy prefix cache into the slot's lanes
-        k = self.kv_cache["k"]
-        v = self.kv_cache["v"]
-        k = jax.lax.dynamic_update_slice(
-            k, cache["k"][:, :, :bucket], (0, slot, 0, 0, 0))
-        v = jax.lax.dynamic_update_slice(
-            v, cache["v"][:, :, :bucket], (0, slot, 0, 0, 0))
-        self.kv_cache = {"k": k, "v": v}
+        # copy prefix cache into the slot's lanes — jitted + donated: the
+        # eager form copied the whole [L,B,S,KH,D] cache twice per
+        # admission (GBs of HBM traffic + a transient second allocation)
+        self.kv_cache["k"], self.kv_cache["v"] = self._dense_splice_fn(
+            bucket)(self.kv_cache["k"], self.kv_cache["v"],
+                    cache["k"], cache["v"], slot)
         self.cache_len = self.cache_len.at[slot].set(n)
         self._host_len[slot] = n
         # sample the first generated token from the prefill logits
@@ -753,6 +789,24 @@ class InferenceEngine:
         self.active[slot] = True
         self.slot_req[slot] = req
         return first
+
+    def _dense_splice_fn(self, bucket: int):
+        """Jitted, cache-donating copy of a prefill's [L,1,bucket,...] KV
+        into one slot's lanes of the dense [L,B,S,...] cache."""
+        key = ("dsplice", bucket)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+
+        def splice(k, v, ck, cv, slot):
+            k = jax.lax.dynamic_update_slice(
+                k, ck[:, :, :bucket], (0, slot, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(
+                v, cv[:, :, :bucket], (0, slot, 0, 0, 0))
+            return k, v
+
+        fn = self._compiled[key] = jax.jit(splice, donate_argnums=(0, 1))
+        return fn
 
     def _deliver_first(self, req: _Request, first: int) -> None:
         req.generated.append(first)
@@ -789,17 +843,44 @@ class InferenceEngine:
                 or self.allocator.can_reserve(self._worst_case_tokens(req)))
 
     def _next_admittable(self) -> Optional[_Request]:
-        if self.paged and self._wait_room:
+        while self.paged and self._wait_room:
+            if self._wait_room[0].cancelled:
+                self._finish(self._wait_room.pop(0))
+                continue
             if self._room_for(self._wait_room[0]):
                 return self._wait_room.pop(0)
             return None                     # FIFO: don't starve the head
         while not self._queue.empty():
             req = self._queue.get_nowait()
+            if req.cancelled:
+                self._finish(req)
+                continue
             if self._room_for(req):
                 return req
             self._wait_room.append(req)
             return None
         return None
+
+    @staticmethod
+    def _finish(req: _Request, error: str = "") -> None:
+        if error and not req.error:
+            req.error = error
+        if req.queue is not None:
+            req.queue.put_nowait(None)
+        req.done.set()
+
+    def _fail_all_requests(self, reason: str) -> None:
+        """Give every known request a terminal answer: admitted slots, the
+        one mid-admission, the wait room, and the queue. A caller left
+        awaiting a dead engine hangs forever."""
+        for req in ([r for r in self.slot_req if r is not None]
+                    + ([self._admitting] if self._admitting else [])
+                    + list(self._wait_room)):
+            self._finish(req, error=reason)
+        self._wait_room.clear()
+        self._admitting = None
+        while not self._queue.empty():
+            self._finish(self._queue.get_nowait(), error=reason)
 
     async def _serve_loop(self) -> None:
         try:
@@ -808,21 +889,13 @@ class InferenceEngine:
             raise
         except Exception as exc:      # noqa: BLE001
             # a dead loop must not leave callers awaiting forever — fail
-            # every known request with the cause
+            # every known request with the cause, and make generate()
+            # fail FAST from now on (the loop is never restarted; the
+            # runner's health surface flips on engine_dead)
             import logging
             logging.getLogger("tpu9.serving").exception("engine loop died")
-            for req in ([r for r in self.slot_req if r is not None]
-                        + list(getattr(self, "_wait_room", []))):
-                req.error = f"engine failure: {exc}"
-                if req.queue is not None:
-                    req.queue.put_nowait(None)
-                req.done.set()
-            while not self._queue.empty():
-                req = self._queue.get_nowait()
-                req.error = f"engine failure: {exc}"
-                if req.queue is not None:
-                    req.queue.put_nowait(None)
-                req.done.set()
+            self._dead_reason = f"{type(exc).__name__}: {exc}"
+            self._fail_all_requests(f"engine failure: {exc}")
             raise
 
     async def _serve_loop_inner(self) -> None:
@@ -835,7 +908,9 @@ class InferenceEngine:
                 if req is None:
                     break
                 slot = int(np.argmin(self.active))
+                self._admitting = req       # failure fan-out must see it
                 pending.append((req, await self._admit(req, slot)))
+                self._admitting = None
 
             if not self.active.any() and not pending:
                 if self.paged and self._wait_room:
@@ -851,10 +926,15 @@ class InferenceEngine:
                     continue
                 # idle: block for work
                 req = await self._queue.get()
+                if req.cancelled:
+                    self._finish(req)
+                    continue
                 if not self._room_for(req):
                     self._wait_room.append(req)
                     continue
+                self._admitting = req
                 pending.append((req, await self._admit(req, 0)))
+                self._admitting = None
 
             if pending:
                 firsts = np.asarray(jax.device_get(
@@ -911,6 +991,11 @@ class InferenceEngine:
                 if not (mask[slot] and self.active[slot]):
                     continue
                 req = self.slot_req[slot]
+                if req.cancelled:
+                    # client gone mid-stream: stop decoding into a queue
+                    # nobody reads and free the slot for live work
+                    self._retire(slot)
+                    continue
                 tok = int(window[step, slot])
                 req.generated.append(tok)
                 self._host_len[slot] += 1

@@ -104,9 +104,11 @@ def hbm_budget(preset: str, tpu: "str | TpuSpec", *, max_batch: int = 8,
     tp = tp or spec.chips
 
     w = weight_bytes(cfg, quantized) / tp
-    # KV is head-sharded; if tp exceeds kv heads the cache replicates
-    # across tp/n_kv_heads groups
-    kv_shard = min(tp, cfg.n_kv_heads)
+    # KV is head-sharded; the EVEN shard is gcd(tp, kv_heads) — min()
+    # would assume a tp=6 mesh splits 8 heads 6 ways and under-count
+    # per-chip KV 3x, approving deploys that OOM at runtime
+    import math
+    kv_shard = math.gcd(tp, cfg.n_kv_heads)
     kv = kv_cache_bytes(cfg, max_batch, max_seq_len) / kv_shard
     # paged engine's batch-1 dense prefill scratch rides on one chip's
     # shard of the kv lanes

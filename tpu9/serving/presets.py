@@ -50,27 +50,33 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
                 paged: Optional[bool] = None,
                 kv_block_size: int = 256,
                 kv_pool_blocks: int = 0,
-                prefix_cache_blocks: int = 0,
+                prefix_cache_blocks: Optional[int] = None,
                 engine_cfg: Optional[EngineConfig] = None,
                 seed: int = 0) -> InferenceEngine:
     """``paged=None`` (default) enables the paged-KV engine whenever the
-    block size divides max_seq_len — the production serving path (block
-    allocator + chunked prefill + prefix reuse). ``paged=False`` forces
-    the legacy dense cache."""
+    alignment invariants hold (block | chunk | max_seq_len) — the
+    production serving path (block allocator + chunked prefill + prefix
+    reuse). ``paged=False`` forces the legacy dense cache.
+    ``prefix_cache_blocks=0`` DISABLES the prefix cache (None = auto)."""
     params, cfg = build_params(name, seed=seed)
     # the chunk is the smallest prefill bucket; the block size must divide
     # it (a chunk smaller than a block would lose prefill KV — the engine
-    # rejects that) AND divide max_seq_len
+    # rejects that) AND divide max_seq_len; max_seq_len must also be a
+    # chunk multiple or the final chunk window would clamp past the cache
     chunk = min(prefill_buckets)
     block = min(kv_block_size, chunk)
     if paged is None:
-        paged = (max_seq_len % block == 0 and chunk % block == 0)
+        paged = (max_seq_len % block == 0 and chunk % block == 0
+                 and max_seq_len % chunk == 0)
     ecfg = engine_cfg or EngineConfig(
         max_batch=max_batch, max_seq_len=max_seq_len,
         prefill_buckets=prefill_buckets, decode_steps=decode_steps,
         kv_block_size=block if paged else 0,
         kv_pool_blocks=kv_pool_blocks,
         prefill_chunk=chunk if paged else 0,
-        prefix_cache_blocks=prefix_cache_blocks or
-        (max_seq_len // block if paged else 0))
+        # `or` would make an explicit 0 (documented: disables) silently
+        # re-enable the auto default
+        prefix_cache_blocks=prefix_cache_blocks
+        if prefix_cache_blocks is not None
+        else (max_seq_len // block if paged else 0))
     return InferenceEngine(params, cfg, ecfg)
