@@ -90,6 +90,11 @@ class Keys:
     def machine_logs(machine_id: str) -> str:          # capped list (relay)
         return f"machine:logs:{machine_id}"
 
+    @staticmethod
+    def container_tombstone(container_id: str) -> str:
+        # stop raced scheduling: the batch loop must not dispatch it
+        return f"container:tomb:{container_id}"
+
     # -- bot (petri-net orchestration) ---------------------------------------
 
     @staticmethod

@@ -78,6 +78,12 @@ class Scheduler:
         if state is None:
             return False
         if state.status == ContainerStatus.PENDING.value:
+            # tombstone FIRST: the batch loop may have already popped this
+            # id from the backlog (zrem below no-ops) and be about to
+            # dispatch it — without the marker it would resurrect a
+            # container the caller was just told is stopped, unmetered
+            await self.store.set(Keys.container_tombstone(container_id),
+                                 "1", ttl=600.0)
             await self.store.zrem(Keys.BACKLOG, container_id)
             await self.containers.delete_state(container_id, state.stub_id)
             return True
@@ -125,30 +131,47 @@ class Scheduler:
         popped = await self.store.zpopmin(Keys.BACKLOG, self.cfg.batch_size)
         if not popped:
             return 0
-        now = time.time()
-        workers = await self.workers.list()
-        alive = await self.workers.alive_ids()
-        processed = 0
-        for container_id, score in popped:
-            request = await self.containers.get_request(container_id)
-            if request is None:
-                continue
-            # retry entries carry a future not-before time folded into the
-            # score (minus the priority offset); park them back without
-            # consuming an attempt (backoff while pools provision)
-            not_before = score + request.priority * 1e12
-            if not_before > now:
-                await self.store.zadd(Keys.BACKLOG, container_id, score)
-                continue
-            processed += 1
-            try:
-                await self._schedule_one(request, workers, alive)
-            except SchedulingFailed as exc:
-                await self._requeue(request, str(exc))
-            except Exception as exc:   # never let one request drop the batch
-                log.exception("scheduling %s errored", request.container_id)
-                await self._requeue(request, f"internal: {exc}")
-        return processed
+        # zpopmin is DESTRUCTIVE: from here until each entry is scheduled
+        # or re-added, a raised store error would strand the whole batch
+        # PENDING forever — put unprocessed entries back on any failure
+        remaining = {cid: score for cid, score in popped}
+        try:
+            now = time.time()
+            workers = await self.workers.list()
+            alive = await self.workers.alive_ids()
+            processed = 0
+            for container_id, score in popped:
+                request = await self.containers.get_request(container_id)
+                if request is None:
+                    remaining.pop(container_id, None)
+                    continue
+                # retry entries carry a future not-before time folded into
+                # the score (minus the priority offset); park them back
+                # without consuming an attempt (backoff while pools
+                # provision)
+                not_before = score + request.priority * 1e12
+                if not_before > now:
+                    await self.store.zadd(Keys.BACKLOG, container_id, score)
+                    remaining.pop(container_id, None)
+                    continue
+                remaining.pop(container_id, None)
+                processed += 1
+                try:
+                    await self._schedule_one(request, workers, alive)
+                except SchedulingFailed as exc:
+                    await self._requeue(request, str(exc))
+                except Exception as exc:   # one request must not drop batch
+                    log.exception("scheduling %s errored",
+                                  request.container_id)
+                    await self._requeue(request, f"internal: {exc}")
+            return processed
+        except BaseException:
+            for cid, score in remaining.items():
+                try:
+                    await self.store.zadd(Keys.BACKLOG, cid, score)
+                except Exception:       # noqa: BLE001 — store still down;
+                    pass                # the quota reconciler is backstop
+            raise
 
     async def _schedule_one(self, request: ContainerRequest,
                             workers: list, alive: set[str]) -> None:
@@ -162,6 +185,14 @@ class Scheduler:
 
     async def _schedule_one_traced(self, request: ContainerRequest,
                                    workers: list, alive: set[str]) -> None:
+        if await self.store.get(
+                Keys.container_tombstone(request.container_id)):
+            # stop_container raced the backlog pop: the caller was told
+            # "stopped" and the quota charge was released — dispatching
+            # now would run an unmetered zombie
+            log.info("dropping %s: stopped while pending",
+                     request.container_id)
+            return
         spec = request.tpu_spec()
         if spec is not None and spec.multi_host:
             await self._schedule_gang(request, workers, alive, spec)
@@ -186,6 +217,13 @@ class Scheduler:
             memory_mb=-request.memory_mb, tpu_chips=-chips)
         if not ok:
             raise SchedulingFailed("capacity race lost")
+        # keep the BATCH's in-memory snapshot honest: without this, every
+        # later request in the same batch keeps picking this (now-full)
+        # worker, losing the store-side capacity race and burning real
+        # retry budget on phantom contention
+        worker.free_cpu_millicores -= request.cpu_millicores
+        worker.free_memory_mb -= request.memory_mb
+        worker.tpu_free_chips -= chips
 
         try:
             await self._dispatch(worker.worker_id, request)
@@ -218,12 +256,21 @@ class Scheduler:
                     raise SchedulingFailed(
                         f"gang reservation lost on {m.worker_id}")
                 reserved.append(m.worker_id)
+                m.free_cpu_millicores -= request.cpu_millicores
+                m.free_memory_mb -= request.memory_mb
+                m.tpu_free_chips -= per_host_chips
         except SchedulingFailed:
-            # all-or-nothing: roll back partial reservations
+            # all-or-nothing: roll back partial reservations (store AND
+            # the batch's in-memory snapshot)
             for worker_id in reserved:
                 await self.workers.adjust_capacity(
                     worker_id, cpu_millicores=request.cpu_millicores,
                     memory_mb=request.memory_mb, tpu_chips=per_host_chips)
+                for m in members:
+                    if m.worker_id == worker_id:
+                        m.free_cpu_millicores += request.cpu_millicores
+                        m.free_memory_mb += request.memory_mb
+                        m.tpu_free_chips += per_host_chips
             raise
 
         # rank 0's host is the jax coordinator; the port is derived from the

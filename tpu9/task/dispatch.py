@@ -91,9 +91,15 @@ class Dispatcher:
                 msg = await sub.get(timeout=1.0)
                 if msg is None:
                     continue
-                _, payload = msg
-                if payload and payload.get("container_id"):
-                    await self.requeue_lost(payload["container_id"])
+                try:
+                    _, payload = msg
+                    if payload and payload.get("container_id"):
+                        await self.requeue_lost(payload["container_id"])
+                except asyncio.CancelledError:
+                    raise
+                except Exception:       # noqa: BLE001 — one bad event or
+                    # store blip must not kill exit recovery forever
+                    log.exception("container-exit requeue failed")
         except asyncio.CancelledError:
             raise
         finally:
@@ -267,6 +273,12 @@ class Dispatcher:
             run_age = now - (claim_ts if claim_ts is not None
                              else msg.created_at)
             if policy.timeout_s and run_age > policy.timeout_s:
+                # drop the old container's claim FIRST: a stale entry in
+                # task:claims:<A> would make A's later exit requeue a task
+                # that is legitimately running its retry on container B
+                # (duplicate execution)
+                if msg.container_id:
+                    await self.tasks.unclaim(msg.container_id, msg.task_id)
                 await self._retry_or_fail(msg, TaskStatus.TIMEOUT.value,
                                           "timed out")
         # crashed-worker safety net: claims whose container state vanished

@@ -389,17 +389,28 @@ class ContainerLifecycle:
             # here would deadlock the whole worker
             meta_path = os.path.join(rootfs, ".tpu9-env.json") \
                 if rootfs else ""
-            if meta_path and await asyncio.to_thread(os.path.exists,
-                                                     meta_path):
+            if meta_path:
                 def _read_meta() -> dict:
+                    # EVERY fs touch of the bundle happens in this thread,
+                    # including the site-dir probe _spec_from_request
+                    # needs — it must never stat a FUSE path on the loop
+                    if not os.path.exists(meta_path):
+                        return {}
                     with open(meta_path) as f:
-                        return json.load(f)
+                        meta = json.load(f)
+                    site_rel = meta.get("env", {}).get(
+                        "TPU9_IMAGE_SITE", "env/site-packages")
+                    site_abs = os.path.join(rootfs, site_rel)
+                    meta["_image_site"] = site_abs \
+                        if os.path.isdir(site_abs) else ""
+                    return meta
                 try:
                     self._env_meta[request.container_id] = \
                         await asyncio.to_thread(_read_meta)
                 except (OSError, ValueError) as exc:
                     log.warning("image metadata read failed for %s: %s",
                                 request.container_id, exc)
+                    self._env_meta[request.container_id] = {}
             puller = getattr(self, "image_puller", None)
             if puller is not None and not os.path.exists(
                     self._lazy_so_path()):
@@ -519,23 +530,14 @@ class ContainerLifecycle:
         image_site = ""
         if rootfs:
             # image bundles ship runtime metadata (.tpu9-env.json); apply
-            # image env under the request's env. Pre-read by
-            # _prepare_image OFF the event loop — a CacheFS-backed bundle
-            # read here could fault through the very loop this runs on.
-            meta = self._env_meta.pop(request.container_id, None)
-            if meta is None:
-                meta_path = os.path.join(rootfs, ".tpu9-env.json")
-                if os.path.exists(meta_path):
-                    with open(meta_path) as f:
-                        meta = json.load(f)
-            if meta:
-                for k, v in meta.get("env", {}).items():
-                    env.setdefault(k, v)
-                site_rel = meta.get("env", {}).get("TPU9_IMAGE_SITE",
-                                                   "env/site-packages")
-                site_abs = os.path.join(rootfs, site_rel)
-                if os.path.isdir(site_abs):
-                    image_site = site_abs
+            # image env under the request's env. ALL bundle reads —
+            # including the site-dir probe — were done by _prepare_image
+            # OFF the event loop: a CacheFS-backed stat here would fault
+            # through the very loop that serves the fault (deadlock)
+            meta = self._env_meta.pop(request.container_id, {}) or {}
+            for k, v in meta.get("env", {}).items():
+                env.setdefault(k, v)
+            image_site = meta.get("_image_site", "")
         env.update({
             "TPU9_CONTAINER_ID": request.container_id,
             "TPU9_STUB_ID": request.stub_id,

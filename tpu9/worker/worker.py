@@ -119,11 +119,24 @@ class Worker:
             os.environ.get("TPU9_RELAY_ONLY", "").lower()
             not in ("", "0", "false", "no"))
         self._tasks: list[asyncio.Task] = []
+        # strong refs for fire-and-forget work: the event loop only
+        # weak-refs tasks, and a GC'd _release_on_exit (alive for the
+        # container's whole lifetime) would leak capacity forever and
+        # drop the container_exit event
+        self._bg_tasks: set[asyncio.Task] = set()
         self._stopping = asyncio.Event()
         self._start_sem = asyncio.Semaphore(self.cfg.start_concurrency)
         self._last_activity = time.monotonic()
 
     # ------------------------------------------------------------------
+
+
+    def _bg(self, coro) -> "asyncio.Task":
+        """Strong-ref'd fire-and-forget task (see _bg_tasks)."""
+        t = asyncio.create_task(coro)
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return t
 
     def _default_phase_cb(self, container_id: str, phase: str,
                           elapsed_s: float) -> None:
@@ -201,44 +214,55 @@ class Worker:
     async def _heartbeat_loop(self) -> None:
         from ..observability import metrics
         while not self._stopping.is_set():
-            await self.workers.touch_keepalive(self.worker_id)
             try:
-                await self._refresh_disk_locs()
-            except Exception as exc:   # keepalive must survive hiccups
-                log.debug("disk-loc refresh failed: %s", exc)
-            # police every container with a known limit — including ones
-            # still cold-starting (registered at spawn, before readiness)
-            for container_id, limit in list(
-                    self.lifecycle.memory_limits.items()):
-                try:
-                    # cold-starting containers need their state key alive
-                    # too: a long image pull must not let the 60 s TTL lapse
-                    # (the quota reconciler treats a stateless, unbacklogged
-                    # container as dead and releases its charge)
-                    if (container_id in self.lifecycle.active_ids()
-                            or container_id in self.lifecycle.requests):
-                        await self.containers.refresh_ttl(container_id)
-                    await self._police_container(container_id, limit, metrics)
-                except asyncio.CancelledError:
-                    raise
-                except Exception as exc:   # keepalive must survive hiccups
-                    log.debug("usage sample failed for %s: %s", container_id,
-                              exc)
-            metrics.set_gauge("tpu9_worker_active_containers",
-                              len(self.lifecycle.active_ids()),
-                              {"worker": self.worker_id})
-            # ship this process's registry to the state bus so the gateway's
-            # /api/v1/metrics shows the whole fleet (VictoriaMetrics-push
-            # equivalent, pkg/metrics/metrics.go:29)
-            import json as _json
-            await self.store.set(f"worker:metrics:{self.worker_id}",
-                                 _json.dumps(metrics.to_dict()),
-                                 ttl=self.cfg.keepalive_ttl_s * 2)
-            try:
-                await self._ship_usage_and_traces()
-            except Exception as exc:   # keepalive must survive hiccups
-                log.debug("usage/trace ship failed: %s", exc)
+                await self._heartbeat_once(metrics)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:    # noqa: BLE001 — a transient store
+                # blip must NOT kill the loop: a lapsed keepalive makes
+                # the scheduler declare this live worker dead and
+                # reschedule its running containers (duplicates)
+                log.warning("heartbeat iteration failed: %s", exc)
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    async def _heartbeat_once(self, metrics) -> None:
+        await self.workers.touch_keepalive(self.worker_id)
+        try:
+            await self._refresh_disk_locs()
+        except Exception as exc:   # keepalive must survive hiccups
+            log.debug("disk-loc refresh failed: %s", exc)
+        # police every container with a known limit — including ones
+        # still cold-starting (registered at spawn, before readiness)
+        for container_id, limit in list(
+                self.lifecycle.memory_limits.items()):
+            try:
+                # cold-starting containers need their state key alive
+                # too: a long image pull must not let the 60 s TTL lapse
+                # (the quota reconciler treats a stateless, unbacklogged
+                # container as dead and releases its charge)
+                if (container_id in self.lifecycle.active_ids()
+                        or container_id in self.lifecycle.requests):
+                    await self.containers.refresh_ttl(container_id)
+                await self._police_container(container_id, limit, metrics)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:   # keepalive must survive hiccups
+                log.debug("usage sample failed for %s: %s", container_id,
+                          exc)
+        metrics.set_gauge("tpu9_worker_active_containers",
+                          len(self.lifecycle.active_ids()),
+                          {"worker": self.worker_id})
+        # ship this process's registry to the state bus so the gateway's
+        # /api/v1/metrics shows the whole fleet (VictoriaMetrics-push
+        # equivalent, pkg/metrics/metrics.go:29)
+        import json as _json
+        await self.store.set(f"worker:metrics:{self.worker_id}",
+                             _json.dumps(metrics.to_dict()),
+                             ttl=self.cfg.keepalive_ttl_s * 2)
+        try:
+            await self._ship_usage_and_traces()
+        except Exception as exc:   # keepalive must survive hiccups
+            log.debug("usage/trace ship failed: %s", exc)
 
     async def _ship_usage_and_traces(self) -> None:
         """Fold this beat's container/chip seconds into the hot usage
@@ -316,7 +340,7 @@ class Worker:
             for entry_id, request in entries:
                 last_id = entry_id
                 self._last_activity = time.monotonic()
-                asyncio.create_task(self._handle_request(request))
+                self._bg(self._handle_request(request))
 
     async def _stop_loop(self) -> None:
         """Scheduler-initiated stops arrive over pubsub
@@ -329,10 +353,19 @@ class Worker:
                     continue
                 _, payload = msg
                 if payload is None:
-                    break
-                await self.lifecycle.stop_container(
-                    payload["container_id"],
-                    reason=payload.get("reason", StopReason.USER.value))
+                    continue            # malformed event ≠ channel close
+                try:
+                    await self.lifecycle.stop_container(
+                        payload["container_id"],
+                        reason=payload.get("reason",
+                                           StopReason.USER.value))
+                except asyncio.CancelledError:
+                    raise
+                except Exception:       # noqa: BLE001 — one bad event or
+                    # store blip must not leave the worker permanently
+                    # DEAF to stop requests (user stops, gang rollbacks,
+                    # keep-warm scale-downs all ride this channel)
+                    log.exception("stop request handling failed")
         finally:
             sub.close()
 
@@ -349,7 +382,7 @@ class Worker:
                 _, payload = msg
                 if not payload:
                     continue
-                asyncio.create_task(self._handle_exec(payload))
+                self._bg(self._handle_exec(payload))
         finally:
             sub.close()
 
@@ -366,7 +399,7 @@ class Worker:
                 _, payload = msg
                 if not payload:
                     continue
-                asyncio.create_task(self._handle_shell(payload))
+                self._bg(self._handle_shell(payload))
         finally:
             sub.close()
 
@@ -468,7 +501,7 @@ class Worker:
                 _, payload = msg
                 if not payload:
                     continue
-                asyncio.create_task(self._handle_disk_snapshot(payload))
+                self._bg(self._handle_disk_snapshot(payload))
         finally:
             sub.close()
 
@@ -504,7 +537,7 @@ class Worker:
                 _, payload = msg
                 if not payload:
                     continue
-                asyncio.create_task(self._handle_sbx(payload))
+                self._bg(self._handle_sbx(payload))
         finally:
             sub.close()
 
@@ -572,7 +605,7 @@ class Worker:
                                "workspace_id": request.workspace_id,
                                "worker_id": self.worker_id}):
                     await self.lifecycle.run_container(request)
-                asyncio.create_task(self._release_on_exit(request))
+                self._bg(self._release_on_exit(request))
             except Exception:
                 # release the capacity the scheduler reserved for this request
                 await self._release_capacity(request)
@@ -599,8 +632,12 @@ class Worker:
             await self.workers.adjust_capacity(
                 self.worker_id, cpu_millicores=request.cpu_millicores,
                 memory_mb=request.memory_mb, tpu_chips=chips)
-        except TimeoutError:
-            log.error("capacity release timed out for %s", request.container_id)
+        except Exception as exc:        # noqa: BLE001 — a ConnectionError
+            # here would otherwise abort _release_on_exit BEFORE the
+            # container-index removal and the exit-event publish, leaking
+            # reserved capacity and stranding claimed tasks
+            log.error("capacity release failed for %s: %s",
+                      request.container_id, exc)
 
     # ------------------------------------------------------------------
 
