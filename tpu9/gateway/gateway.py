@@ -477,10 +477,13 @@ class Gateway:
             log.info("created default workspace; token=%s", tok.key)
         else:
             toks = await self.backend.list_tokens(ws.workspace_id)
-            user = [t for t in toks if t.token_type == "workspace"]
+            # ACTIVE only: a revoked key must not be resurrected as the
+            # printed default (or, worse, handed to every joining machine)
+            user = [t for t in toks
+                    if t.token_type == "workspace" and t.active]
             self.default_token = user[0].key if user else ""
         worker_toks = [t for t in await self.backend.list_tokens(ws.workspace_id)
-                       if t.token_type == "worker"]
+                       if t.token_type == "worker" and t.active]
         if worker_toks:
             self.worker_token = worker_toks[0].key
         else:
@@ -564,14 +567,14 @@ class Gateway:
         })
 
     async def _scheduler_stats(self, request: web.Request) -> web.Response:
-        self._ws(request)
+        self._require_operator(request)   # fleet internals: operator-only
         return web.json_response(self.scheduler.stats)
 
     async def _usage_report(self, request: web.Request) -> web.Response:
         """Per-workspace metered usage: container-seconds, chip-seconds,
         requests (usage_openmeter.go:18 analogue, hourly buckets)."""
         ws = self._ws(request)
-        hours = min(int(request.query.get("hours", 24)), 24 * 31)
+        hours = min(int(self._q_float(request, "hours", 24)), 24 * 31)
         return web.json_response(
             await self.usage.query(ws.workspace_id, hours=hours))
 
@@ -583,8 +586,8 @@ class Gateway:
         ws = self._ws(request)
         from ..observability import tracer
         trace_id = request.query.get("trace_id", "")
-        since = float(request.query.get("since", 0))
-        limit = min(int(request.query.get("limit", 1000)), 5000)
+        since = self._q_float(request, "since", 0)
+        limit = min(int(self._q_float(request, "limit", 1000)), 5000)
 
         def visible(sp: dict) -> bool:
             if trace_id and sp.get("traceId") != trace_id:
@@ -617,7 +620,10 @@ class Gateway:
         return web.json_response({"spans": spans[:limit]})
 
     async def _metrics(self, request: web.Request) -> web.Response:
-        self._ws(request)
+        # fleet-wide registries (every worker's shipped counters) are
+        # infrastructure state, not tenant data — operator-only, like
+        # _traces' workspace scoping but for the whole surface
+        self._require_operator(request)
         if request.query.get("format") == "prometheus":
             return web.Response(text=metrics.prometheus_text(),
                                 content_type="text/plain")
@@ -631,12 +637,38 @@ class Gateway:
         return web.json_response(out)
 
     async def _events(self, request: web.Request) -> web.Response:
-        self._ws(request)
+        ws = self._ws(request)
         rows = await self.events.query(
             kind_prefix=request.query.get("kind", ""),
-            since=float(request.query.get("since", "0")),
-            limit=int(request.query.get("limit", "500")))
+            since=self._q_float(request, "since", 0.0),
+            limit=int(self._q_float(request, "limit", 500)))
+        # workspace scoping (same invariant _traces enforces): only the
+        # operator sees the cluster-wide stream — container/task/deploy
+        # events carry other tenants' ids and payloads
+        if not self._is_operator(request):
+            rows = [r for r in rows
+                    if r.get("workspace_id") in ("", ws.workspace_id)]
         return web.json_response(rows)
+
+    def _is_operator(self, request: web.Request) -> bool:
+        try:
+            self._require_operator(request)
+            return True
+        except web.HTTPForbidden:
+            return False
+
+    @staticmethod
+    def _q_float(request: web.Request, name: str, default: float) -> float:
+        """Query-param float with a 400 (not a 500) on garbage input."""
+        raw = request.query.get(name)
+        if raw is None:
+            return default
+        try:
+            return float(raw)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": f"{name} must be a number"}),
+                content_type="application/json")
 
     async def _pools(self, request: web.Request) -> web.Response:
         self._ws(request)
@@ -860,7 +892,7 @@ class Gateway:
 
     async def _rpc_task_result(self, request: web.Request) -> web.Response:
         msg = await self._task_for(request)
-        timeout = min(float(request.query.get("timeout", "0")), 110.0)
+        timeout = min(self._q_float(request, "timeout", 0.0), 110.0)
         result = await self._bounded_longpoll(
             self.dispatcher.retrieve(msg.task_id, timeout=timeout))
         if result is None:
@@ -1081,7 +1113,7 @@ class Gateway:
         out = await self.pods.proc_output(
             proc_id,
             last_id=request.query.get("last_id", "0"),
-            timeout=min(float(request.query.get("timeout", 0)), 30.0))
+            timeout=min(self._q_float(request, "timeout", 0.0), 30.0))
         return web.json_response(out)
 
     async def _rpc_sbx_fs(self, request: web.Request) -> web.Response:
@@ -1335,12 +1367,18 @@ class Gateway:
         # chunking a multi-GB volume takes longer than a worker's request
         # timeout — build in a background task, answer within a bounded
         # wait, and return 503 if still building (the worker falls back to
-        # sync-down for THIS container; the next mount hits the cache)
-        build = self._volume_manifest_builds.get((ws, name))
-        if build is None or build.done():
+        # sync-down for THIS container; the next mount hits the cache).
+        # Keyed by FINGERPRINT: awaiting an in-flight build for an older
+        # listing would return a stale manifest as if it were current
+        key = (ws, name, fingerprint)
+        for k in [k for k, t in self._volume_manifest_builds.items()
+                  if t.done()]:
+            del self._volume_manifest_builds[k]
+        build = self._volume_manifest_builds.get(key)
+        if build is None:
             build = asyncio.create_task(
                 self._build_volume_manifest(ws, name, entries, fingerprint))
-            self._volume_manifest_builds[(ws, name)] = build
+            self._volume_manifest_builds[key] = build
         try:
             blob = await asyncio.wait_for(asyncio.shield(build),
                                           timeout=120.0)
@@ -1672,8 +1710,11 @@ class Gateway:
         path = "/" + tail if tail else "/"
         if request.query_string:
             path += f"?{request.query_string}"
+        # NEVER forward the platform bearer token into a tenant container
+        # (a priced/public endpoint's app would capture the CALLER'S
+        # workspace credential); runners do no inbound auth of their own
         skip_req = {"host", "connection", "transfer-encoding",
-                    "content-length"}
+                    "content-length", "authorization"}
         fwd_headers = [(k, v) for k, v in request.headers.items()
                        if k.lower() not in skip_req]
 
@@ -2063,9 +2104,14 @@ class Gateway:
 
     def _require_operator(self, request: web.Request):
         """Quota writes are operator actions (the reference gates them on
-        cluster-admin tokens); tpu9's operator is the default workspace."""
+        cluster-admin tokens); tpu9's operator is the default workspace —
+        with a USER token. Runner/worker tokens of the default workspace
+        ride inside user-controlled containers (builds run arbitrary user
+        commands with one); token-type-blind operator checks would be a
+        straight privilege escalation to minting durable keys."""
         ws = self._ws(request)
-        if ws.workspace_id != self.default_workspace.workspace_id:
+        if (ws.workspace_id != self.default_workspace.workspace_id
+                or request.get("token_type") != "workspace"):
             raise web.HTTPForbidden(
                 text=json.dumps({"error": "operator token required"}),
                 content_type="application/json")
@@ -2326,7 +2372,7 @@ class Gateway:
         return web.json_response(await self.backend.list_tasks(ws.workspace_id))
 
     async def _list_workers(self, request: web.Request) -> web.Response:
-        self._ws(request)
+        self._require_operator(request)   # fleet topology: operator-only
         workers = await self.workers.list()
         out = []
         for w in workers:

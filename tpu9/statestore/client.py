@@ -53,17 +53,24 @@ class RemoteStore(StateStore):
         async with self._connect_lock:
             if self._writer is not None:
                 return self
-            self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
-            self._read_task = asyncio.create_task(self._read_loop())
-            if self.auth_token:
-                await self._call("auth", self.auth_token)
-            # replay live subscriptions on the fresh connection (a reconnect
-            # would otherwise leave pubsub consumers permanently silent)
-            for sub in list(self._subs.values()):
-                await self._send_subscribe(sub)
+            await self._connect_locked()
         return self
 
-    async def close(self) -> None:
+    async def _connect_locked(self) -> None:
+        """Establish the connection; caller holds _connect_lock."""
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        self._read_task = asyncio.create_task(self._read_loop())
+        if self.auth_token:
+            await self._call_raw("auth", self.auth_token)
+        # replay live subscriptions on the fresh connection (a reconnect
+        # would otherwise leave pubsub consumers permanently silent)
+        for sub in list(self._subs.values()):
+            await self._send_subscribe(sub)
+
+    async def _teardown(self) -> None:
+        """Close the transport; caller holds _connect_lock (or is the
+        final close())."""
         if self._read_task:
             self._read_task.cancel()
             self._read_task = None
@@ -78,6 +85,10 @@ class RemoteStore(StateStore):
             if not fut.done():
                 fut.set_exception(ConnectionError("state store connection closed"))
         self._pending.clear()
+
+    async def close(self) -> None:
+        async with self._connect_lock:
+            await self._teardown()
 
     async def _read_loop(self) -> None:
         assert self._reader is not None
@@ -113,8 +124,22 @@ class RemoteStore(StateStore):
 
     async def _call(self, op: str, *args: Any, **kwargs: Any) -> Any:
         if self._writer is None or (self._read_task is not None and self._read_task.done()):
-            await self.close()
-            await self.connect()
+            # serialize the whole check-close-reconnect under the connect
+            # lock: two concurrent callers racing here would have the
+            # second one's close() tear down the connection the first just
+            # re-established (and fail its in-flight request). Re-check
+            # inside the lock — the peer that got here first already fixed
+            # the connection.
+            async with self._connect_lock:
+                if self._writer is None or (self._read_task is not None
+                                            and self._read_task.done()):
+                    await self._teardown()
+                    await self._connect_locked()
+        return await self._call_raw(op, *args, **kwargs)
+
+    async def _call_raw(self, op: str, *args: Any, **kwargs: Any) -> Any:
+        """Issue a request on the CURRENT connection, no reconnect check —
+        used by the connect handshake itself (which holds _connect_lock)."""
         assert self._writer is not None
         rid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()

@@ -148,6 +148,10 @@ class MemoryStore(StateStore):
         self._zsets.pop(key, None)
         self._lists.pop(key, None)
         self._streams.pop(key, None)
+        # sequence counters too: shell/sandbox streams mint a unique key
+        # per session — a long-lived control plane would otherwise leak
+        # one entry per session forever
+        self._stream_seq.pop(key, None)
         self._expiry.pop(key, None)
 
     def _live_keys(self) -> set[str]:
@@ -192,13 +196,15 @@ class MemoryStore(StateStore):
         return sorted(k for k in self._live_keys() if fnmatch.fnmatchcase(k, pattern))
 
     async def expire(self, key, ttl):
-        if key not in self._live_keys():
+        # O(1) presence check — a _live_keys() full-store sweep here would
+        # run on EVERY worker-keepalive refresh
+        if not self._present(key):
             return False
         self._expiry[key] = time.monotonic() + ttl
         return True
 
     async def ttl(self, key):
-        if key not in self._live_keys():
+        if not self._present(key):
             return -2.0
         exp = self._expiry.get(key)
         return -1.0 if exp is None else max(0.0, exp - time.monotonic())
