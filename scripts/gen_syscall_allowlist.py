@@ -200,6 +200,13 @@ def emit(numbers: set[int]) -> None:
         raise SystemExit(
             f"generated allowlist is missing {missing} — trace is broken")
 
+    # JSON twin for the RuncRuntime's OCI seccomp profile (same policy,
+    # different wire format — runc consumes JSON, t9container a C header)
+    with open(HEADER.replace(".h", ".json"), "w") as f:
+        json.dump({"allow": allowed,
+                   "never_allow": sorted(NEVER_ALLOW)}, f, indent=1)
+        f.write("\n")
+
     with open(HEADER, "w") as f:
         f.write(
             "// t9_allowlist.h — GENERATED by scripts/"

@@ -225,24 +225,40 @@ class RequestBuffer:
         assert self._session is not None
         while True:
             req = await self._queue.get()
-            if req.future is not None and req.future.done():
-                continue   # caller gave up (timeout/cancel) while queued
-            if (time.monotonic() - req.enqueued_at) > self.request_timeout_s:
-                if req.future and not req.future.done():
-                    req.future.set_result(ForwardResult(
-                        status=504, body=b'{"error":"expired in queue"}'))
-                continue
-            target = await self._acquire_container(req.body)
-            if target is None:
-                # no capacity: requeue, then block on the next admission
-                # signal (token release / container RUNNING) with a 250 ms
-                # fallback poll as the lost-wakeup guard
-                await self._queue.put(req)
+            try:
+                await self._process_one(req)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:    # noqa: BLE001 — one store blip
+                # must not kill forwarding for the STUB forever (a dead
+                # loop = every request 504s until gateway restart);
+                # re-queue the request so the retry path still owns it
+                import logging
+                logging.getLogger("tpu9.abstractions").warning(
+                    "request-buffer pass failed: %s", exc)
+                if req.future is not None and not req.future.done():
+                    await self._queue.put(req)
                 await self._wait_wake(0.25)
-                continue
-            container_id, address = target
-            self._inflight += 1
-            asyncio.create_task(self._forward_one(req, container_id, address))
+
+    async def _process_one(self, req: "BufferedRequest") -> None:
+        if req.future is not None and req.future.done():
+            return     # caller gave up (timeout/cancel) while queued
+        if (time.monotonic() - req.enqueued_at) > self.request_timeout_s:
+            if req.future and not req.future.done():
+                req.future.set_result(ForwardResult(
+                    status=504, body=b'{"error":"expired in queue"}'))
+            return
+        target = await self._acquire_container(req.body)
+        if target is None:
+            # no capacity: requeue, then block on the next admission
+            # signal (token release / container RUNNING) with a 250 ms
+            # fallback poll as the lost-wakeup guard
+            await self._queue.put(req)
+            await self._wait_wake(0.25)
+            return
+        container_id, address = target
+        self._inflight += 1
+        asyncio.create_task(self._forward_one(req, container_id, address))
 
     async def acquire(self, deadline_s: float = 30.0,
                       body: bytes = b"") -> Optional[tuple[str, str]]:

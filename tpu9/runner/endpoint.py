@@ -99,6 +99,20 @@ def build_app(cfg: RunnerConfig) -> web.Application:
                 payload = {"input": payload}
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid JSON body"}, status=400)
+        # argument-binding errors are the CLIENT's 400; a TypeError raised
+        # INSIDE the handler body (len(None), wrong internal arity) is a
+        # user-code 500 — a broad `except TypeError` conflated them and
+        # hid handler crashes from monitoring as "bad arguments"
+        if handler.fn is not None:
+            import inspect
+            try:
+                sig = inspect.signature(handler.fn)
+                sig.bind(**payload)
+            except TypeError as exc:
+                return web.json_response(
+                    {"error": f"bad arguments: {exc}"}, status=400)
+            except ValueError:
+                pass               # builtins without introspectable sigs
         state["inflight"] += 1
         try:
             result = await asyncio.wait_for(handler.call(**payload),
@@ -109,9 +123,6 @@ def build_app(cfg: RunnerConfig) -> web.Application:
             return web.json_response({"error": "handler timed out"}, status=504)
         except ValidationError as exc:
             return web.json_response(exc.to_payload(), status=400)
-        except TypeError as exc:
-            return web.json_response({"error": f"bad arguments: {exc}"},
-                                     status=400)
         except Exception as exc:  # user-code failure → 500 with traceback
             return web.json_response(error_payload(exc), status=500)
         finally:

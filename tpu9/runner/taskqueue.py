@@ -12,6 +12,7 @@ import asyncio
 import logging
 import os
 import sys
+import time
 
 import aiohttp
 from aiohttp import web
@@ -40,19 +41,35 @@ class TaskQueueWorker:
 
     async def poll_loop(self, idx: int) -> None:
         while True:
+            t0 = time.monotonic()
             try:
                 out = await self._api("POST", "/rpc/taskqueue/pop", {
                     "stub_id": self.cfg.stub_id,
                     "container_id": self.cfg.container_id,
                     "timeout": 25.0})
-            except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+                task = out.get("task") if isinstance(out, dict) else None
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:    # noqa: BLE001 — a malformed
+                # gateway response (bad JSON, null body, missing keys)
+                # must not crash EVERY poller and kill the container
                 log.warning("pop failed: %s", exc)
                 await asyncio.sleep(1.0)
                 continue
-            task = out.get("task")
             if not task:
+                # a HEALTHY empty answer is a 25s long-poll timeout; an
+                # INSTANT one (paused stub, error JSON) would hot-spin
+                # TPU9_WORKERS pollers against the gateway
+                if time.monotonic() - t0 < 1.0:
+                    await asyncio.sleep(1.0)
                 continue
-            await self.run_task(task)
+            try:
+                await self.run_task(task)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:    # noqa: BLE001 — run_task guards
+                # user code, but a task dict missing task_id lands here
+                log.warning("task run failed pre-handler: %s", exc)
 
     async def run_task(self, task: dict) -> None:
         task_id = task["task_id"]

@@ -72,7 +72,8 @@ def _chown_tree(path: str, uid: int, gid: int) -> None:
     strand them root-owned — but only dirties inodes whose owner actually
     differs, so the warm-restart walk is pure metadata reads."""
     try:
-        if os.lstat(path).st_uid != uid:
+        st = os.lstat(path)
+        if st.st_uid != uid or st.st_gid != gid:
             os.lchown(path, uid, gid)
     except OSError:
         return
@@ -80,7 +81,11 @@ def _chown_tree(path: str, uid: int, gid: int) -> None:
         for name in dirs + files:
             p = os.path.join(root, name)
             try:
-                if os.lstat(p).st_uid != uid:
+                st = os.lstat(p)
+                # BOTH ids: a matching uid with a stale gid (redeploy with
+                # a new run_as_gid) would skip the fix and break group-
+                # permission workloads inside the container
+                if st.st_uid != uid or st.st_gid != gid:
                     os.lchown(p, uid, gid)
             except OSError:
                 continue
@@ -309,11 +314,37 @@ class NativeRuntime(Runtime):
     # -- Runtime interface ---------------------------------------------------
 
     async def run(self, spec: ContainerSpec, log_cb=None) -> ContainerHandle:
+        try:
+            return await self._run_inner(spec, log_cb)
+        except BaseException:
+            # failure-path teardown: a raise after _setup_net (netns/veth)
+            # or after the process spawned would otherwise leak the netns,
+            # overlay mounts and proxies AND strand the handle RUNNING
+            # (the lifecycle's failure path only runtime.kill()s)
+            proc = self._procs.get(spec.container_id)
+            if proc is not None and proc.returncode is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), 9)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            try:
+                await self.cleanup(spec.container_id, remove_sandbox=False)
+            except Exception:       # noqa: BLE001 — best-effort teardown
+                pass
+            raise
+
+    async def _run_inner(self, spec: ContainerSpec,
+                         log_cb=None) -> ContainerHandle:
         sandbox = self.sandbox_dir(spec.container_id)
         os.makedirs(sandbox, exist_ok=True)
 
-        host_ip, cont_ip = self._setup_net(spec.container_id)
-        rootfs, binds = self._prepare_rootfs(spec, sandbox)
+        # netns/overlay setup shells out to `ip`/`mount` — off the loop,
+        # or every container start stalls heartbeats and every other
+        # container's proxies/log pumps
+        host_ip, cont_ip = await asyncio.to_thread(self._setup_net,
+                                                   spec.container_id)
+        rootfs, binds = await asyncio.to_thread(self._prepare_rootfs,
+                                                spec, sandbox)
 
         env = dict(spec.env)
         env.setdefault("PATH", "/usr/local/bin:/usr/bin:/bin")
@@ -427,8 +458,9 @@ class NativeRuntime(Runtime):
             handle.state = (RuntimeState.STOPPED if code == 0
                             else RuntimeState.FAILED)
             await self._close_proxies(spec.container_id)
-            self._teardown_net(spec.container_id)
-            self._cleanup_mounts(spec.container_id)
+            await asyncio.to_thread(self._teardown_net, spec.container_id)
+            await asyncio.to_thread(self._cleanup_mounts,
+                                    spec.container_id)
 
         # hold a strong ref: the loop only weakly references tasks, and a
         # GC'd reap would leak the netns/veth/overlay of a dead container
@@ -539,8 +571,8 @@ class NativeRuntime(Runtime):
     async def cleanup(self, container_id: str,
                       remove_sandbox: bool = True) -> None:
         await self._close_proxies(container_id)
-        self._teardown_net(container_id)
-        self._cleanup_mounts(container_id)
+        await asyncio.to_thread(self._teardown_net, container_id)
+        await asyncio.to_thread(self._cleanup_mounts, container_id)
         self._procs.pop(container_id, None)
         self._handles.pop(container_id, None)
         self._specs.pop(container_id, None)

@@ -35,6 +35,7 @@ class ProcessRuntime(Runtime):
 
     def __init__(self, base_dir: str = "/tmp/tpu9/containers") -> None:
         self.base_dir = base_dir
+        self._bg_tasks: set[asyncio.Task] = set()
         self._procs: dict[str, asyncio.subprocess.Process] = {}
         self._handles: dict[str, ContainerHandle] = {}
         self._waiters: dict[str, asyncio.Task] = {}
@@ -144,7 +145,10 @@ class ProcessRuntime(Runtime):
         except ProcessLookupError:
             return False
         if signal_num != signal.SIGKILL:
-            # escalate if it ignores the polite signal
+            # escalate if it ignores the polite signal — STRONG ref: the
+            # loop only weak-refs tasks, and a GC'd escalation would let a
+            # SIGTERM-trapping container live forever while the scheduler
+            # believes it stopped
             async def escalate():
                 try:
                     await asyncio.wait_for(proc.wait(), timeout=10.0)
@@ -153,7 +157,9 @@ class ProcessRuntime(Runtime):
                         os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
                     except ProcessLookupError:
                         pass
-            asyncio.create_task(escalate())
+            t = asyncio.create_task(escalate())
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
         return True
 
     async def state(self, container_id: str) -> Optional[ContainerHandle]:

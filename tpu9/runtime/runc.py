@@ -26,6 +26,45 @@ _DEFAULT_CAPS = [
     "CAP_DAC_OVERRIDE", "CAP_FOWNER", "CAP_SETGID", "CAP_SETUID",
 ]
 
+# same mask t9container's BPF inspects: clone with ANY namespace flag is
+# an escape vector (Docker's default profile uses this exact constant)
+_CLONE_NS_FLAGS = 0x7E020000
+
+
+def _seccomp_profile(mode: str) -> Optional[dict]:
+    """OCI seccomp section from the trace-generated allow-list (the same
+    policy t9container compiles into BPF — native/t9_allowlist.json is the
+    JSON twin the generator emits). ``deny`` keeps the legacy polarity;
+    missing profile file → None (no seccomp, logged by the caller)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native",
+        "t9_allowlist.json")
+    try:
+        with open(path) as f:
+            lists = json.load(f)
+    except (OSError, ValueError):
+        return None
+    common = [
+        # clone3 → ENOSYS so libc falls back to clone (flags in memory,
+        # uninspectable); clean clones allowed only with no ns flags
+        {"names": ["clone3"], "action": "SCMP_ACT_ERRNO", "errnoRet": 38},
+        {"names": ["clone"], "action": "SCMP_ACT_ALLOW",
+         "args": [{"index": 0, "value": _CLONE_NS_FLAGS, "valueTwo": 0,
+                   "op": "SCMP_CMP_MASKED_EQ"}]},
+    ]
+    if mode == "deny":
+        return {"defaultAction": "SCMP_ACT_ALLOW",
+                "architectures": ["SCMP_ARCH_X86_64"],
+                "syscalls": common + [
+                    {"names": sorted(set(lists["never_allow"])
+                                     - {"clone3"}),
+                     "action": "SCMP_ACT_ERRNO", "errnoRet": 1}]}
+    allow = [n for n in lists["allow"] if n != "clone"]
+    return {"defaultAction": "SCMP_ACT_ERRNO", "defaultErrnoRet": 1,
+            "architectures": ["SCMP_ARCH_X86_64"],
+            "syscalls": common + [
+                {"names": allow, "action": "SCMP_ACT_ALLOW"}]}
+
 
 def oci_spec_from(spec: ContainerSpec) -> dict:
     """Build the OCI runtime spec dict."""
@@ -70,17 +109,26 @@ def oci_spec_from(spec: ContainerSpec) -> dict:
     if spec.memory_mb:
         resources["memory"] = {"limit": spec.memory_mb * 1024 * 1024}
 
+    linux_extra: dict = {}
+    if spec.seccomp_mode != "off":
+        profile = _seccomp_profile(spec.seccomp_mode or "allow")
+        if profile is not None:
+            linux_extra["seccomp"] = profile
+
     return {
         "ociVersion": "1.0.2",
         "process": {
             "terminal": False,
-            "user": {"uid": 0, "gid": 0},
+            # the spec's identity drop is a CONTRACT (base.py: seccomp +
+            # caps + no_new_privs apply on every runtime) — hardcoding
+            # root here silently discarded it on the production path
+            "user": {"uid": spec.run_as_uid, "gid": spec.run_as_gid},
             "args": spec.entrypoint,
             "env": [f"{k}={v}" for k, v in spec.env.items()],
             "cwd": spec.workdir or "/",
             "capabilities": {k: _DEFAULT_CAPS for k in
                              ("bounding", "effective", "permitted")},
-            "noNewPrivileges": False,
+            "noNewPrivileges": True,
         },
         # OCI-pulled snapshots chroot into <bundle>/rootfs; env snapshots
         # use the bundle dir itself. Decided by build-time metadata, not
@@ -94,6 +142,7 @@ def oci_spec_from(spec: ContainerSpec) -> dict:
             "devices": devices,
             "namespaces": [{"type": t} for t in
                            ("pid", "ipc", "uts", "mount")],
+            **linux_extra,
         },
     }
 
@@ -121,6 +170,7 @@ class RuncRuntime(Runtime):
         self.base_dir = base_dir
         self.runc = runc_path
         self._handles: dict[str, ContainerHandle] = {}
+        self._bg_tasks: set[asyncio.Task] = set()
 
     def bundle_dir(self, container_id: str) -> str:
         return os.path.join(self.base_dir, container_id)
@@ -147,16 +197,21 @@ class RuncRuntime(Runtime):
                 if log_cb:
                     log_cb(line.decode(errors="replace").rstrip("\n"), name)
 
-        asyncio.create_task(pump(proc.stdout, "stdout"))
-        asyncio.create_task(pump(proc.stderr, "stderr"))
-
         async def reap():
             code = await proc.wait()
             handle.exit_code = code
             handle.state = (RuntimeState.STOPPED if code == 0
                             else RuntimeState.FAILED)
 
-        asyncio.create_task(reap())
+        # STRONG refs: the loop only weak-refs tasks — a GC'd reap would
+        # leave the handle RUNNING forever (the lifecycle's early-crash
+        # check and the OOM watcher both key on exit_code), and GC'd
+        # pumps silently stop log streaming (same guard as native._bg)
+        for t in (asyncio.create_task(pump(proc.stdout, "stdout")),
+                  asyncio.create_task(pump(proc.stderr, "stderr")),
+                  asyncio.create_task(reap())):
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
         return handle
 
     async def kill(self, container_id: str, signal_num: int = 15) -> bool:
