@@ -38,13 +38,21 @@ class EndpointService:
         self.dialer = None       # Optional[tpu9.network.Dialer]
         self.instances: dict[str, "EndpointInstance"] = {}
         self._locks: dict[str, asyncio.Lock] = {}
+        self._draining: set[str] = set()
 
     async def get_or_create_instance(self, stub: Stub) -> "EndpointInstance":
+        if stub.stub_id in self._draining:
+            raise RuntimeError("deployment is draining")
         inst = self.instances.get(stub.stub_id)
         if inst is not None:
             return inst
         lock = self._locks.setdefault(stub.stub_id, asyncio.Lock())
         async with lock:
+            if stub.stub_id in self._draining:
+                # delete raced an in-flight forward: creating the instance
+                # NOW would resurrect containers for a deleted deployment
+                # that drain_stub (already returned) will never stop
+                raise RuntimeError("deployment is draining")
             inst = self.instances.get(stub.stub_id)
             if inst is None:
                 async def latest_ckpt(stub_id: str) -> str:
@@ -64,7 +72,17 @@ class EndpointService:
                 if self.runner_tokens is not None:
                     inst.instance.extra_env["TPU9_TOKEN"] = \
                         await self.runner_tokens.get(stub.workspace_id)
-                await inst.start()
+                try:
+                    await inst.start()
+                except BaseException:
+                    # partial start (buffer loop/session up, autoscaler
+                    # raise): tear down what exists, or every retried
+                    # request leaks a loop task + ClientSession + pubsub
+                    try:
+                        await inst.shutdown()
+                    except Exception:   # noqa: BLE001 — best effort
+                        pass
+                    raise
                 self.instances[stub.stub_id] = inst
         return inst
 
@@ -82,9 +100,18 @@ class EndpointService:
                                                 headers=headers, body=body)
 
     async def drain_stub(self, stub_id: str) -> None:
-        inst = self.instances.pop(stub_id, None)
-        if inst:
-            await inst.shutdown()
+        # mark BEFORE popping and take the creation lock: an in-flight
+        # forward mid-create must either finish creating (we shut it down
+        # below) or see the draining mark and refuse
+        self._draining.add(stub_id)
+        try:
+            lock = self._locks.setdefault(stub_id, asyncio.Lock())
+            async with lock:
+                inst = self.instances.pop(stub_id, None)
+            if inst:
+                await inst.shutdown()
+        finally:
+            self._draining.discard(stub_id)
 
     async def shutdown(self) -> None:
         for stub_id in list(self.instances):

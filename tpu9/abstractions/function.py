@@ -204,6 +204,12 @@ class FunctionService:
             if stub is None:
                 continue
             log.info("cron fire %s (%s)", stub.name, row["cron"])
-            await self.invoke(stub, [], {})
-            await self.backend.mark_schedule_fired(row["schedule_id"],
-                                                   time.time())
+            try:
+                await self.invoke(stub, [], {})
+                await self.backend.mark_schedule_fired(row["schedule_id"],
+                                                       time.time())
+            except Exception:   # noqa: BLE001 — per-SCHEDULE isolation:
+                # one tenant over quota must not make every schedule after
+                # it silently skip this minute (the minute key is already
+                # consumed by the caller)
+                log.exception("cron fire failed for %s", stub.name)

@@ -116,9 +116,15 @@ class PodService:
         reply_channel = f"execreply:{new_id('x')}"
         sub = self.store.subscribe(reply_channel)
         try:
-            await self.store.publish(f"container:exec:{state.worker_id}", {
-                "container_id": container_id, "cmd": cmd,
-                "reply": reply_channel})
+            n = await self.store.publish(
+                f"container:exec:{state.worker_id}", {
+                    "container_id": container_id, "cmd": cmd,
+                    "reply": reply_channel})
+            if not n:
+                # nobody listening (worker died; state key hasn't TTL'd
+                # yet): fail FAST like sbx() does, not after the full
+                # timeout — and again after every retry
+                return {"error": "worker unreachable", "exit_code": -1}
             msg = await sub.get(timeout=timeout)
             if msg is None:
                 return {"error": "exec timed out", "exit_code": -1}

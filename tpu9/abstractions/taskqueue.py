@@ -101,6 +101,12 @@ class TaskQueueService:
             self.dispatcher.claim(task_id, container_id))
         try:
             return await asyncio.shield(claim)
+        except Exception:
+            # claim failed outright (store hiccup): the dequeue was
+            # DESTRUCTIVE and tasks never expire by default — without the
+            # requeue the id is lost and the client polls forever
+            await self.tasks.requeue_front(workspace_id, stub_id, task_id)
+            raise
         except asyncio.CancelledError:
             # the claim has multiple await points — let it FINISH, then
             # revert whatever it did (a half-reverted claim would strand

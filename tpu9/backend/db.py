@@ -235,24 +235,37 @@ class BackendDB:
 
     async def create_deployment(self, workspace_id: str, name: str, stub_id: str,
                                 app_id: str = "") -> Deployment:
-        rows = self._query(
-            "SELECT MAX(version) AS v FROM deployments WHERE workspace_id=? AND name=?",
-            (workspace_id, name))
-        version = (rows[0]["v"] or 0) + 1
         # subdomain must be globally unique: two workspaces deploying the
         # same name must not collide on the public Host-header route
         ws_tag = hashlib.sha256(workspace_id.encode()).hexdigest()[:6]
-        dep = Deployment(deployment_id=new_id("dep"), name=name, stub_id=stub_id,
-                         workspace_id=workspace_id, app_id=app_id, version=version,
-                         subdomain=f"{name}-{version}-{ws_tag}")
-        self._exec_txn([
-            ("UPDATE deployments SET active=0 WHERE workspace_id=? AND name=?",
-             (workspace_id, name)),
-            ("INSERT INTO deployments (deployment_id, name, stub_id, workspace_id, app_id, version, active, subdomain, created_at) VALUES (?,?,?,?,?,?,1,?,?)",
-             (dep.deployment_id, dep.name, dep.stub_id, dep.workspace_id,
-              dep.app_id, dep.version, dep.subdomain, dep.created_at)),
-        ])
-        return dep
+        # version race under multi-gateway HA (Postgres backend): two
+        # concurrent deploys reading MAX(version) separately both insert
+        # the same version — one loses on UNIQUE(ws,name,version). Retry
+        # with a fresh read instead of surfacing a 500.
+        last_exc: Optional[Exception] = None
+        for _attempt in range(3):
+            rows = self._query(
+                "SELECT MAX(version) AS v FROM deployments "
+                "WHERE workspace_id=? AND name=?", (workspace_id, name))
+            version = (rows[0]["v"] or 0) + 1
+            dep = Deployment(
+                deployment_id=new_id("dep"), name=name, stub_id=stub_id,
+                workspace_id=workspace_id, app_id=app_id, version=version,
+                subdomain=f"{name}-{version}-{ws_tag}")
+            try:
+                self._exec_txn([
+                    ("UPDATE deployments SET active=0 "
+                     "WHERE workspace_id=? AND name=?",
+                     (workspace_id, name)),
+                    ("INSERT INTO deployments (deployment_id, name, stub_id, workspace_id, app_id, version, active, subdomain, created_at) VALUES (?,?,?,?,?,?,1,?,?)",
+                     (dep.deployment_id, dep.name, dep.stub_id,
+                      dep.workspace_id, dep.app_id, dep.version,
+                      dep.subdomain, dep.created_at)),
+                ])
+                return dep
+            except Exception as exc:    # noqa: BLE001 — unique-violation
+                last_exc = exc          # shape differs per backend driver
+        raise last_exc if last_exc else RuntimeError("deploy race")
 
     def _row_to_deployment(self, r: sqlite3.Row) -> Deployment:
         return Deployment(deployment_id=r["deployment_id"], name=r["name"],

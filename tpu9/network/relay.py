@@ -52,12 +52,18 @@ async def _pump(reader: asyncio.StreamReader,
         while True:
             data = await reader.read(PUMP_BUF)
             if not data:
+                # HALF-close: propagate EOF without killing the opposite
+                # direction (close-delimited protocols send their request,
+                # shutdown(WR), then still expect the response)
+                try:
+                    if writer.can_write_eof():
+                        writer.write_eof()
+                except (OSError, RuntimeError):
+                    pass
                 break
             writer.write(data)
             await writer.drain()
     except (ConnectionError, asyncio.IncompleteReadError, OSError):
-        pass
-    finally:
         try:
             writer.close()
         except Exception:  # noqa: BLE001 — already torn down
@@ -65,9 +71,15 @@ async def _pump(reader: asyncio.StreamReader,
 
 
 async def pipe(a_reader, a_writer, b_reader, b_writer) -> None:
-    """Bidirectional byte pump until either side closes."""
+    """Bidirectional byte pump; EOFs half-close, full teardown once BOTH
+    directions finish."""
     await asyncio.gather(_pump(a_reader, b_writer),
                          _pump(b_reader, a_writer))
+    for w in (a_writer, b_writer):
+        try:
+            w.close()
+        except Exception:  # noqa: BLE001 — already torn down
+            pass
 
 
 class RelayServer:
@@ -164,23 +176,31 @@ class LocalTunnel:
                          writer: asyncio.StreamWriter) -> None:
         self.last_used = time.monotonic()
         self.active += 1
+        conn_id = "rconn-" + secrets.token_urlsafe(24)
+        paired = False
         try:
             # the conn id is the pairing secret: only the worker that
             # received the pubsub message can present it — unguessable
-            conn_id = "rconn-" + secrets.token_urlsafe(24)
             fut = self.relay.expect(conn_id)
             await self.store.publish(relay_channel(self.worker_id), {
                 "conn_id": conn_id, "target": self.target,
                 "relay": self.relay_advertise})
-            try:
-                w_reader, w_writer = await asyncio.wait_for(
-                    fut, timeout=PAIR_TIMEOUT_S)
-            except (asyncio.TimeoutError, asyncio.CancelledError):
-                self.relay.forget(conn_id)
-                writer.close()
-                return
+            w_reader, w_writer = await asyncio.wait_for(
+                fut, timeout=PAIR_TIMEOUT_S)
+            paired = True
             await pipe(reader, writer, w_reader, w_writer)
+        except asyncio.TimeoutError:
+            pass                        # pairing timeout: expected churn
         finally:
+            # ALWAYS drop the pending future and close an unpaired client
+            # socket — a publish failure during a store outage would
+            # otherwise leak one future + FD per retrying proxy attempt
+            self.relay.forget(conn_id)
+            if not paired:
+                try:
+                    writer.close()
+                except Exception:       # noqa: BLE001
+                    pass
             self.active -= 1
             self.last_used = time.monotonic()
 
@@ -295,7 +315,10 @@ class Dialer:
                 self._tunnels[key] = tunnel
                 log.info("relay tunnel %s -> %s via %s", tunnel.address,
                          address, worker_id)
-        tunnel.last_used = time.monotonic()
+            # touch INSIDE the lock: outside it, the GC loop can delete
+            # the idle tunnel between lookup and touch and we'd hand the
+            # caller a closed listener's address
+            tunnel.last_used = time.monotonic()
         return tunnel.address
 
     async def stop(self) -> None:
@@ -381,6 +404,17 @@ class RelayAgent:
             t_writer.close()
             log.warning("relay: gateway %s unreachable: %s", relay, exc)
             return
-        r_writer.write(conn_id.encode() + b"\n")
-        await r_writer.drain()
+        try:
+            r_writer.write(conn_id.encode() + b"\n")
+            await r_writer.drain()
+        except (OSError, ConnectionError) as exc:
+            # preamble failed (gateway restarted under us): close BOTH
+            # sockets or relay churn leaks an FD pair per attempt
+            for w in (t_writer, r_writer):
+                try:
+                    w.close()
+                except Exception:   # noqa: BLE001
+                    pass
+            log.warning("relay: preamble to %s failed: %s", relay, exc)
+            return
         await pipe(t_reader, t_writer, r_reader, r_writer)
