@@ -69,40 +69,64 @@ class CacheClient:
         self._conns[peer] = (reader, writer)
         return reader, writer
 
+    # bound on the WHOLE request/response exchange with a peer: an
+    # established-but-dead connection (peer host hung) would otherwise
+    # block read_frame forever, pin the per-peer lock, and hang every
+    # restore routed through that peer instead of falling to the source
+    IO_TIMEOUT_S = 30.0
+
     async def _peer_get(self, peer: str, digest: str) -> Optional[bytes]:
         lock = self._conn_locks.setdefault(peer, asyncio.Lock())
         async with lock:
             try:
-                reader, writer = await self._conn(peer)
-                writer.write(wire.pack({"op": "get", "hash": digest}))
-                await writer.drain()
-                head = await wire.read_frame(reader)
-                if not head.get("ok"):
-                    return None
-                return await reader.readexactly(int(head["len"]))
+                return await asyncio.wait_for(
+                    self._peer_get_io(peer, digest), self.IO_TIMEOUT_S)
             except (OSError, asyncio.TimeoutError,
                     asyncio.IncompleteReadError) as exc:
                 self.stats["peer_errors"] += 1
-                self._conns.pop(peer, None)
+                self._drop_conn(peer)
                 log.debug("peer %s get failed: %s", peer, exc)
                 return None
+
+    async def _peer_get_io(self, peer: str, digest: str) -> Optional[bytes]:
+        reader, writer = await self._conn(peer)
+        writer.write(wire.pack({"op": "get", "hash": digest}))
+        await writer.drain()
+        head = await wire.read_frame(reader)
+        if not head.get("ok"):
+            return None
+        return await reader.readexactly(int(head["len"]))
+
+    def _drop_conn(self, peer: str) -> None:
+        entry = self._conns.pop(peer, None)
+        if entry is not None:
+            try:
+                entry[1].close()
+            except Exception:   # noqa: BLE001 — already dead
+                pass
 
     async def _peer_put(self, peer: str, digest: str, data: bytes) -> bool:
         lock = self._conn_locks.setdefault(peer, asyncio.Lock())
         async with lock:
             try:
-                reader, writer = await self._conn(peer)
-                writer.write(wire.pack({"op": "put", "hash": digest,
-                                        "len": len(data)}))
-                writer.write(data)
-                await writer.drain()
-                head = await wire.read_frame(reader)
-                return bool(head.get("ok"))
+                return await asyncio.wait_for(
+                    self._peer_put_io(peer, digest, data),
+                    self.IO_TIMEOUT_S)
             except (OSError, asyncio.TimeoutError,
                     asyncio.IncompleteReadError):
                 self.stats["peer_errors"] += 1
-                self._conns.pop(peer, None)
+                self._drop_conn(peer)
                 return False
+
+    async def _peer_put_io(self, peer: str, digest: str,
+                           data: bytes) -> bool:
+        reader, writer = await self._conn(peer)
+        writer.write(wire.pack({"op": "put", "hash": digest,
+                                "len": len(data)}))
+        writer.write(data)
+        await writer.drain()
+        head = await wire.read_frame(reader)
+        return bool(head.get("ok"))
 
     # -- public API ---------------------------------------------------------
 

@@ -82,8 +82,17 @@ class ChunkServer:
                         await writer.drain()
                         break
                     data = await reader.readexactly(n)
-                    digest = await self.store.put(data, req.get("hash") or
-                                                  chunk_hash(data))
+                    computed = chunk_hash(data)
+                    claimed = req.get("hash")
+                    if claimed and claimed != computed:
+                        # NEVER store a digest→data mismatch: a poisoned
+                        # entry would be served as a verification-free
+                        # "local hit" to every later consumer
+                        writer.write(wire.pack({"ok": False,
+                                                "error": "digest mismatch"}))
+                        await writer.drain()
+                        continue
+                    digest = await self.store.put(data, computed)
                     writer.write(wire.pack({"ok": True, "hash": digest}))
                 elif op == "has":
                     writer.write(wire.pack({"ok": True,
@@ -111,7 +120,13 @@ class ChunkServer:
         if path is None:
             writer.write(wire.pack({"ok": False, "error": "not found"}))
             return
-        size = os.path.getsize(path)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            # eviction raced the existence check: a miss, not a dropped
+            # connection
+            writer.write(wire.pack({"ok": False, "error": "not found"}))
+            return
         writer.write(wire.pack({"ok": True, "len": size}))
         await writer.drain()
         loop = asyncio.get_running_loop()

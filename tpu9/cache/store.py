@@ -67,7 +67,18 @@ class DiskStore:
         p = self.get_path(digest)
         if p is None:
             return None
-        return await asyncio.to_thread(lambda: open(p, "rb").read())
+
+        def read() -> Optional[bytes]:
+            try:
+                with open(p, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                # LRU eviction raced between the existence check and this
+                # open: a miss, not an error — the client falls through to
+                # peers/source instead of failing the whole restore
+                return None
+
+        return await asyncio.to_thread(read)
 
     async def put(self, data: bytes, digest: str = "") -> str:
         digest = digest or chunk_hash(data)
@@ -76,14 +87,19 @@ class DiskStore:
             return digest
         os.makedirs(os.path.dirname(p), exist_ok=True)
 
-        def write() -> None:
+        def write() -> bool:
             # atomic publish: tmp + rename so concurrent readers never see a
-            # partial chunk (reference guards this with mount locks)
+            # partial chunk (reference guards this with mount locks).
+            # Returns whether WE published a new file — N concurrent puts
+            # of the same digest must account its bytes once, or _used
+            # drifts upward and eviction starts thrashing hot chunks.
             fd, tmp = tempfile.mkstemp(dir=os.path.dirname(p))
             try:
                 with os.fdopen(fd, "wb") as f:
                     f.write(data)
+                existed = os.path.exists(p)
                 os.rename(tmp, p)
+                return not existed
             except BaseException:
                 try:
                     os.unlink(tmp)
@@ -91,8 +107,8 @@ class DiskStore:
                     pass
                 raise
 
-        await asyncio.to_thread(write)
-        self._used += len(data)
+        if await asyncio.to_thread(write):
+            self._used += len(data)
         self.stats["puts"] += 1
         if self._used > self.max_bytes:
             async with self._lock:

@@ -78,16 +78,15 @@ class CheckpointManager:
                      workdir: str) -> Optional[str]:
         checkpoint_id = await self.record(stub_id, workspace_id, container_id)
         try:
-            chunks: list[tuple[bytes, str]] = []
-
-            def put_chunk(data: bytes, digest: str) -> None:
-                chunks.append((data, digest))
-
+            # STREAM chunks to the cache as the walk produces them — the
+            # buffered form held the entire workdir (tens of GB of params
+            # on the flagship path) in worker RAM before the first put
+            from ..cache.prefetch import threadsafe_put
+            loop = asyncio.get_running_loop()
             manifest = await asyncio.to_thread(
-                snapshot_dir, workdir, 4 * 1024 * 1024, put_chunk)
+                snapshot_dir, workdir, 4 * 1024 * 1024,
+                threadsafe_put(self.cache.put, loop))
             manifest.image_id = checkpoint_id
-            for data, digest in chunks:
-                await self.cache.put(data, digest)
             if self.store_manifest is not None:
                 await self.store_manifest(checkpoint_id, manifest.to_json())
             if self.update is not None:
@@ -116,15 +115,21 @@ class CheckpointManager:
             if blob is None:
                 return False
             manifest = ImageManifest.from_json(blob)
-            fetched = await self.cache.get_many(
-                list(dict.fromkeys(manifest.all_chunks())))
-            if any(v is None for v in fetched.values()):
-                log.warning("checkpoint %s missing chunks; cold booting",
-                            checkpoint_id)
-                return False
-            await asyncio.to_thread(
-                materialize, manifest, workdir, fetched.get,
-                self.cache.store.get_path)
+            # stream chunks through a read-ahead window instead of holding
+            # the WHOLE checkpoint (can be tens of GB of params) in RAM,
+            # and NO link_from: a workdir is mutable — hardlinking cache
+            # chunk files into it would let any in-place write corrupt the
+            # shared content-addressed store (local hits are not verified)
+            from ..cache.prefetch import Prefetcher, threadsafe_get
+            loop = asyncio.get_running_loop()
+            pf = Prefetcher(self.cache.get,
+                            list(dict.fromkeys(manifest.all_chunks())))
+            try:
+                await asyncio.to_thread(
+                    materialize, manifest, workdir,
+                    threadsafe_get(pf, loop), None)
+            finally:
+                await pf.close()
             return True
         except Exception as exc:
             log.warning("checkpoint restore %s failed: %s (cold boot)",

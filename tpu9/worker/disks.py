@@ -109,9 +109,22 @@ class DiskManager:
                     return d
             os.makedirs(d, exist_ok=True)
             self._write_marker(d, disk_id)
+            if snapshot_id and not (self.manifest_get and self.chunk_get):
+                # a snapshot exists but this worker has no restore hooks:
+                # handing out an empty dir would register it as the live
+                # holder and let the next snapshot destroy the good one
+                import shutil
+                await asyncio.to_thread(shutil.rmtree, d, True)
+                raise DiskRestoreError(
+                    f"disk {name}: snapshot {snapshot_id} exists but the "
+                    "worker has no manifest/chunk hooks to restore it")
             if snapshot_id and self.manifest_get and self.chunk_get:
                 try:
                     blob = await self.manifest_get(snapshot_id)
+                    if not blob:
+                        raise DiskRestoreError(
+                            f"disk {name}: snapshot {snapshot_id} manifest "
+                            "not found")
                     if blob:
                         manifest = ImageManifest.from_json(blob)
                         # chunk fetches stream on demand from inside the
@@ -158,8 +171,16 @@ class DiskManager:
             for leaf in os.listdir(ws_dir):
                 # exact incarnation match: split off the final "@<disk_id>"
                 # (disk names may themselves contain '@' — a prefix match
-                # would delete disk "db@prod"'s dirs when removing "db")
-                if leaf != name and leaf.rsplit("@", 1)[0] != name:
+                # would delete disk "db@prod"'s dirs when removing "db").
+                # A dir WITHOUT a .diskid marker is a pre-migration BARE
+                # name: only an exact-name match counts, or removing "db"
+                # would rsplit-match the legacy dir of disk "db@prod"
+                has_marker = os.path.exists(
+                    os.path.join(ws_dir, leaf) + _MARKER_SUFFIX)
+                if has_marker:
+                    if leaf != name and leaf.rsplit("@", 1)[0] != name:
+                        continue
+                elif leaf != name:
                     continue
                 d = os.path.join(ws_dir, leaf)
                 async with self._lock(d):

@@ -122,11 +122,25 @@ class SandboxAgent:
 
     # -- process manager -----------------------------------------------------
 
+    # exited entries kept for `ps`/status; past this, oldest exited are
+    # pruned (a REPL-style sandbox spawning thousands of commands must not
+    # grow worker memory without bound)
+    MAX_PROC_HISTORY = 512
+
+    def _prune_procs(self) -> None:
+        if len(self.procs) <= self.MAX_PROC_HISTORY:
+            return
+        exited = [pid for pid, p in self.procs.items()
+                  if p.exit_code is not None]
+        for pid in exited[:len(self.procs) - self.MAX_PROC_HISTORY]:
+            self.procs.pop(pid, None)
+
     async def spawn(self, payload: dict) -> dict:
         container_id = payload["container_id"]
         cmd = list(payload.get("cmd", []))
         if not cmd:
             return {"error": "empty command"}
+        self._prune_procs()
         proc = SandboxProcess(new_id("sp"), container_id, cmd)
         # PID-1 supervised path (t9proc, reference's goproc analogue):
         # children are real children of the container's init — zombies are
@@ -264,7 +278,11 @@ class SandboxAgent:
                 return {"error": "file too large for inline read (32MiB cap)"}
 
             def _read() -> bytes:
-                with open(full, "rb") as f:
+                # O_NOFOLLOW: the tenant can swap a symlink in between the
+                # realpath containment check and this open — a plain open
+                # would follow it as root (arbitrary host file read)
+                fd = os.open(full, os.O_RDONLY | os.O_NOFOLLOW)
+                with os.fdopen(fd, "rb") as f:
                     return f.read()
 
             data = await asyncio.to_thread(_read)
@@ -281,7 +299,13 @@ class SandboxAgent:
 
             def _write() -> None:
                 os.makedirs(os.path.dirname(full), exist_ok=True)
-                with open(full, "wb") as f:
+                # never write THROUGH a racing symlink swap as root (same
+                # O_NOFOLLOW hardening as images.manifest.open_nofollow)
+                if os.path.islink(full):
+                    os.unlink(full)
+                fd = os.open(full, os.O_WRONLY | os.O_CREAT | os.O_TRUNC
+                             | os.O_NOFOLLOW, 0o644)
+                with os.fdopen(fd, "wb") as f:
                     f.write(data)
 
             await asyncio.to_thread(_write)
