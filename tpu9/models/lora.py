@@ -44,13 +44,18 @@ def lora_scale(rank: int, alpha: float = 16.0) -> float:
     return alpha / rank
 
 
-def merge(params: Params, adapters: Params, scale: float = 2.0) -> Params:
-    """Return a new param tree with LoRA deltas folded into the base weights."""
+def merge(params: Params, adapters: Params,
+          scale: Optional[float] = None) -> Params:
+    """Return a new param tree with LoRA deltas folded into the base
+    weights. ``scale=None`` derives alpha/rank from each adapter's actual
+    rank (a hardcoded default would silently double/halve the deltas the
+    training run optimized whenever rank != alpha/default)."""
     merged_layers = []
     for layer, ad_layer in zip(params["layers"], adapters["layers"]):
         new_layer = dict(layer)
         for name, ad in ad_layer.items():
-            delta = (ad["a"] @ ad["b"]) * scale
+            s = scale if scale is not None else lora_scale(ad["a"].shape[1])
+            delta = (ad["a"] @ ad["b"]) * s
             new_layer[name] = (layer[name].astype(jnp.float32)
                                + delta).astype(layer[name].dtype)
         merged_layers.append(new_layer)

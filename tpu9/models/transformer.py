@@ -173,16 +173,29 @@ def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
         out = decode_attention(q, k_cache, v_cache, cache_len)
         new_cache = (k_cache, v_cache)
     elif cache_len is not None:
-        # CHUNKED prefill: write this chunk at its offset (positions[0,0];
-        # batch-1 admission path), then attend over prefix + chunk with
-        # the absolute-position mask — graph shapes are (C, S) no matter
-        # how long the prompt is
+        # CHUNKED prefill: write this chunk at its PER-ROW offset, then
+        # attend over prefix + chunk with the absolute-position mask —
+        # graph shapes are (C, S) no matter how long the prompt is. The
+        # engine admits chunks at batch 1, but the signature accepts
+        # [B, C] positions: applying row 0's offset to every row would
+        # write other rows' chunks at the wrong cache slots (and their
+        # queries would then mask out their own chunk) — silently wrong
+        # logits, so scatter per row.
         from ..ops.attention import chunk_prefill_attention
-        off = positions[0, 0]
-        k_cache = jax.lax.dynamic_update_slice(
-            kv_cache["k"][layer_idx], k, (0, off, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            kv_cache["v"][layer_idx], v, (0, off, 0, 0))
+        if b == 1:
+            off = positions[0, 0]
+            k_cache = jax.lax.dynamic_update_slice(
+                kv_cache["k"][layer_idx], k, (0, off, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                kv_cache["v"][layer_idx], v, (0, off, 0, 0))
+        else:
+            def write_chunk(c, item, off0):
+                return jax.lax.dynamic_update_slice(c, item, (off0, 0, 0))
+
+            k_cache = jax.vmap(write_chunk)(
+                kv_cache["k"][layer_idx], k, positions[:, 0])
+            v_cache = jax.vmap(write_chunk)(
+                kv_cache["v"][layer_idx], v, positions[:, 0])
         out = chunk_prefill_attention(q, k_cache, v_cache, positions)
         new_cache = (k_cache, v_cache)
     else:
@@ -243,7 +256,18 @@ def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.dim ** 0.5, dtype=cfg.dtype)
 
-    sin, cos = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    # the rope table must cover every cache slot: positions past the table
+    # are CLAMPED by JAX's gather, rotating distinct positions identically
+    # (silent long-context degradation, no error) — catch the static-shape
+    # mismatch at trace time instead
+    rope_len = cfg.max_seq_len
+    if kv_cache is not None and "table" not in kv_cache:
+        cache_s = kv_cache["k"].shape[2]
+        if cache_s > rope_len:
+            raise ValueError(
+                f"kv cache length {cache_s} exceeds rope table "
+                f"{rope_len} — positions past it would alias")
+    sin, cos = rope_table(rope_len, cfg.head_dim, cfg.rope_theta)
 
     new_k, new_v = [], []
     moe_balance = jnp.zeros((), jnp.float32)

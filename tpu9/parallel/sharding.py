@@ -121,7 +121,18 @@ def _fit_spec(x, spec: P, mesh: Mesh) -> P:
         if axis is None or i >= x.ndim:
             dims.append(None)
             continue
-        size = mesh.shape[axis] if isinstance(axis, str) else 1
+        if isinstance(axis, str):
+            size = mesh.shape[axis]
+        elif isinstance(axis, (tuple, list)):
+            # multi-axis entries like P(("tp", "fsdp")) shard over the
+            # PRODUCT of the axes — sizing them as 1 would skip the
+            # divisibility fallback and crash device_put instead of
+            # replicating gracefully
+            size = 1
+            for a in axis:
+                size *= mesh.shape[a]
+        else:
+            size = 1
         dims.append(axis if x.shape[i] % size == 0 else None)
     while len(dims) < x.ndim:
         dims.append(None)
@@ -129,8 +140,11 @@ def _fit_spec(x, spec: P, mesh: Mesh) -> P:
 
 
 def constrain(x: jnp.ndarray, spec: P) -> jnp.ndarray:
-    """Activation sharding hint inside jit (no-op outside a mesh context)."""
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except Exception:
-        return x
+    """Activation sharding hint inside jit (no-op outside a mesh context —
+    but a BAD spec must still raise: swallowing an axis-name typo would
+    silently drop the layout hint and ship a perf/memory regression)."""
+    env = getattr(jax.interpreters.pxla, "thread_resources", None)
+    mesh = getattr(getattr(env, "env", None), "physical_mesh", None)
+    if mesh is None or mesh.empty:
+        return x                     # genuinely outside any mesh context
+    return jax.lax.with_sharding_constraint(x, spec)

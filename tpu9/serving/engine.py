@@ -116,7 +116,13 @@ class InferenceEngine:
             # +1: one dedicated TRASH block absorbs splice writes of the
             # padded tail of a non-block-aligned final chunk
             n_blocks = (engine_cfg.kv_pool_blocks or (b * s // bs)) + 1
-            self._mb = s // bs                      # table width
+            # table width: +1 ALWAYS-TRASH column — a decode write at
+            # position S (cache full; callers should bound it, but a
+            # regression must not corrupt data) computes pos // bs == S/bs
+            # which would otherwise CLAMP onto the last real block and
+            # overwrite valid KV; the extra column absorbs it harmlessly
+            # (attention masks by cache_len, so it is never read)
+            self._mb = s // bs + 1                  # table width
             pool_shape = (cfg.n_layers, n_blocks, bs, cfg.n_kv_heads,
                           cfg.head_dim)
             self.kv_cache = {

@@ -81,8 +81,17 @@ def weight_bytes(cfg, quantized: bool) -> int:
     mm = matmul_param_count(cfg)
     embed = cfg.vocab_size * cfg.dim * 2              # always bf16
     if quantized:
-        # int8 payload + one f32 scale per output column (≈dim⁻¹ relative)
-        return mm + mm // max(cfg.dim, 1) * 4 + embed
+        # int8 payload + one f32 scale per output column (≈dim⁻¹
+        # relative). Stacked MoE expert weights are NOT yet quantized
+        # (ops/quant.py handles 2D mats only) — budgeting them at 1
+        # byte/param would under-count a Mixtral's HBM ~2x and approve
+        # deploys that OOM, the exact failure this gate exists to stop.
+        moe = 0
+        if getattr(cfg, "n_experts", 0):
+            moe = 3 * cfg.dim * cfg.hidden_dim * cfg.n_experts \
+                * cfg.n_layers
+        dense = mm - moe
+        return dense + dense // max(cfg.dim, 1) * 4 + moe * 2 + embed
     return mm * 2 + embed
 
 
