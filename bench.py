@@ -58,7 +58,8 @@ import time
 
 PHASE_TIMEOUT_S = {"llm": 1800, "llm_endpoint": 1800, "kernels": 900,
                    "coldstart": 900, "coldstart_native": 900,
-                   "coldstart_jax": 900, "coldstart_jax_tpu": 900}
+                   "coldstart_jax": 900, "coldstart_jax_tpu": 900,
+                   "coldstart_stream": 900}
 
 # share compiled XLA programs between the in-process llm phase and the
 # runner container in the endpoint phase (identical graphs → second phase
@@ -886,6 +887,153 @@ def bench_cold_start_jax(quick: bool = False) -> dict:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def bench_cold_start_stream(quick: bool = False) -> dict:
+    """Weight-streaming restore (ISSUE 1 tentpole): the same checkpoint
+    restored through the three tiers on one node —
+
+    - **classic**: cache → workdir materialize → re-read → deserialize →
+      ``jax.device_put`` (the chain every restore used to pay)
+    - **streamed**: cache → preallocated host buffer → device, fetch of
+      shard *i+1* overlapped with device transfer of shard *i*
+    - **warm pool**: deserialized host tree already resident (λScale
+      keep-alive) → device only
+
+    Emits per-phase evidence straight from
+    ``CheckpointManager.last_restore_metrics`` (``weight_stream_fetch_s``,
+    ``weight_stream_put_s``, ``warm_pool_hit``) and FAILS itself if the
+    tiers don't strictly order warm < streamed < classic on p50."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    async def run() -> dict:
+        from tpu9.cache import CacheClient, DiskStore
+        from tpu9.serving import weights as wfmt
+        from tpu9.worker.checkpoint import CheckpointManager
+        from tpu9.worker.weightpool import WeightPool
+
+        out: dict = {}
+        violations: list[str] = []
+        tmp = tempfile.mkdtemp(prefix="tpu9-bench-stream-")
+        try:
+            import jax
+
+            rng = np.random.default_rng(0)
+            n_shards = 4 if quick else 8
+            shard_mb = 4 if quick else 8
+            tree = {"model": {"blocks": [
+                rng.standard_normal(shard_mb << 18, dtype=np.float32)
+                for _ in range(n_shards)], "step": 1234}}
+            src = os.path.join(tmp, "src")
+            os.makedirs(src)
+            wfmt.save_params(tree, os.path.join(src, "params.tpu9w"))
+            with open(os.path.join(src, "app.py"), "w") as f:
+                f.write("# handler code rides the classic path\n")
+
+            store = DiskStore(os.path.join(tmp, "cache"),
+                              max_bytes=8 << 30)
+
+            async def peers():
+                return []
+
+            client = CacheClient(store, peers)
+            manifests: dict = {}
+
+            async def record(stub, ws, cid):
+                return "ckpt-stream-bench"
+
+            async def store_manifest(cid, blob):
+                manifests[cid] = blob
+
+            async def fetch_manifest(cid):
+                return manifests.get(cid)
+
+            pool = WeightPool(2 << 30)
+            cm = CheckpointManager(client, record=record,
+                                   store_manifest=store_manifest,
+                                   fetch_manifest=fetch_manifest,
+                                   weight_pool=pool)
+            ckpt = await cm.create("stub", "ws", "c0", src)
+            assert ckpt, "checkpoint create failed"
+            total_bytes = sum(a.nbytes for a in tree["model"]["blocks"])
+            out["weight_stream_checkpoint_mb"] = total_bytes >> 20
+
+            def to_device(tree_or_arrays):
+                dev = jax.device_put(tree_or_arrays)
+                return jax.block_until_ready(dev)
+
+            trials = 3 if quick else 5
+            cm_classic = CheckpointManager(client,
+                                           fetch_manifest=fetch_manifest,
+                                           stream_weights=False)
+            classic = []
+            for i in range(trials):
+                dest = os.path.join(tmp, f"classic{i}")
+                t0 = time.perf_counter()
+                assert await cm_classic.restore(ckpt, dest)
+                loaded = wfmt.load_params(
+                    os.path.join(dest, "params.tpu9w"))
+                to_device(loaded)
+                classic.append(time.perf_counter() - t0)
+                shutil.rmtree(dest)
+            out["cold_start_classic_restore"] = _percentiles(classic)
+            out["cold_start_classic_restore_p50_s"] = out[
+                "cold_start_classic_restore"]["p50"]
+
+            streamed, fetch_s, put_s = [], [], []
+            for i in range(trials):
+                pool.clear()                      # every trial is Nth=1
+                t0 = time.perf_counter()
+                trees, metrics = await cm.restore_params(ckpt)
+                streamed.append(time.perf_counter() - t0)
+                assert trees and not metrics["warm_pool_hit"]
+                fetch_s.append(metrics["weight_stream_fetch_s"])
+                put_s.append(metrics["weight_stream_put_s"])
+            out["cold_start_jax_restore_stream"] = _percentiles(streamed)
+            out["cold_start_jax_restore_stream_p50_s"] = out[
+                "cold_start_jax_restore_stream"]["p50"]
+            out["weight_stream_fetch_s"] = round(
+                statistics.median(fetch_s), 4)
+            out["weight_stream_put_s"] = round(statistics.median(put_s), 4)
+
+            warm, hits = [], []
+            for i in range(trials):               # pool stays warm
+                t0 = time.perf_counter()
+                trees, metrics = await cm.restore_params(ckpt)
+                warm.append(time.perf_counter() - t0)
+                hits.append(bool(metrics["warm_pool_hit"]))
+            out["cold_start_warm_pool_restore"] = _percentiles(warm)
+            out["cold_start_warm_pool_restore_p50_s"] = out[
+                "cold_start_warm_pool_restore"]["p50"]
+            out["warm_pool_hit"] = all(hits)
+            out["weight_pool_stats"] = pool.snapshot()
+
+            if not all(hits):
+                violations.append(
+                    "coldstart_stream: warm-pool trials missed the pool — "
+                    "the keep-alive tier is not engaging")
+            if out["cold_start_warm_pool_restore_p50_s"] >= \
+                    out["cold_start_jax_restore_stream_p50_s"]:
+                violations.append(
+                    "coldstart_stream: warm-pool restore not faster than "
+                    "cold streamed restore")
+            if out["cold_start_jax_restore_stream_p50_s"] >= \
+                    out["cold_start_classic_restore_p50_s"]:
+                violations.append(
+                    "coldstart_stream: streamed restore not faster than "
+                    "the classic workdir chain")
+            await client.close()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        out["violations"] = violations
+        out["valid"] = not violations
+        return out
+
+    return asyncio.run(run())
+
+
 def bench_cold_start_jax_tpu(quick: bool = False) -> dict:
     """On-CHIP JAX restore cold start (VERDICT r04 next-round #1): same
     restore loop as ``bench_cold_start_jax`` but the runner container dials
@@ -1209,7 +1357,13 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
             ("coldstart", ("cold_start_p50_s",)),
             ("coldstart_native", ("cold_start_native_p50_s",
                                   "cold_start_native_pull_p50_s")),
-            ("coldstart_jax", ("cold_start_jax_restore_p50_s",))):
+            ("coldstart_jax", ("cold_start_jax_restore_p50_s",)),
+            ("coldstart_stream", ("cold_start_jax_restore_stream_p50_s",
+                                  "cold_start_warm_pool_restore_p50_s",
+                                  "cold_start_classic_restore_p50_s",
+                                  "weight_stream_fetch_s",
+                                  "weight_stream_put_s",
+                                  "warm_pool_hit"))):
         try_tpu(probe_timeout=45)
         res = _run_phase(phase, quick, cpu)
         _merge_validated(detail, phase, res, keys)
@@ -1256,6 +1410,9 @@ _COMPACT_KEYS = (
     "endpoint_container_on_tpu",
     "cold_start_p50_s", "cold_start_native_p50_s",
     "cold_start_native_pull_p50_s", "cold_start_jax_restore_p50_s",
+    "cold_start_jax_restore_stream_p50_s",
+    "cold_start_warm_pool_restore_p50_s", "warm_pool_hit",
+    "weight_stream_fetch_s", "weight_stream_put_s",
     "cold_start_jax_restore_tpu_p50_s", "jax_restore_tpu_backend",
     "kernel_flash_ms", "kernel_paged_ms",
     "tpu_snapshot_file", "tpu_snapshot_captured_at",
@@ -1315,7 +1472,7 @@ def main() -> None:
     ap.add_argument("--phase",
                     choices=["llm", "llm_endpoint", "kernels", "coldstart",
                              "coldstart_native", "coldstart_jax",
-                             "coldstart_jax_tpu"],
+                             "coldstart_jax_tpu", "coldstart_stream"],
                     help="run one phase in-process (used by the orchestrator)")
     args = ap.parse_args()
 
@@ -1334,7 +1491,8 @@ def main() -> None:
               "kernels": bench_kernels, "coldstart": bench_cold_start,
               "coldstart_native": bench_cold_start_native,
               "coldstart_jax": bench_cold_start_jax,
-              "coldstart_jax_tpu": bench_cold_start_jax_tpu}[args.phase]
+              "coldstart_jax_tpu": bench_cold_start_jax_tpu,
+              "coldstart_stream": bench_cold_start_stream}[args.phase]
         try:
             print(json.dumps(fn(quick=args.quick)))
         except Exception as exc:   # noqa: BLE001 — phase errors are data

@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""Benchmark regression guard (ISSUE 1 satellite).
+
+Diffs the newest round's ``BENCH_r*.json`` cold-start and engine-throughput
+fields against the previous round and exits non-zero on any regression
+worse than the threshold (default 15%). Run it after a bench round:
+
+    python scripts/bench_guard.py                 # repo BENCH_r*.json
+    python scripts/bench_guard.py --base A --current B   # explicit files
+    python scripts/bench_guard.py --report-only   # never fail (CI smoke)
+
+Accepted file shapes: the driver's round capture (``{"parsed": {"extra":
+{...}}}``), a bare compact bench line (``{"extra": {...}}``), or a flat
+metrics dict — whatever ``bench.py`` produced, the guard finds the fields.
+A field missing on either side is skipped (new metrics don't fail old
+rounds); improvements are reported, never fatal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# guarded headline fields → direction ("down" = lower is better)
+GUARDED_FIELDS = {
+    "cold_start_p50_s": "down",
+    "cold_start_native_p50_s": "down",
+    "cold_start_native_pull_p50_s": "down",
+    "cold_start_jax_restore_p50_s": "down",
+    "cold_start_jax_restore_stream_p50_s": "down",
+    "cold_start_warm_pool_restore_p50_s": "down",
+    "kernel_flash_ms": "down",
+    "kernel_paged_ms": "down",
+    "engine_tokens_per_sec_per_chip": "up",
+    "endpoint_tokens_per_sec_per_chip": "up",
+}
+
+
+def extract_metrics(path: str) -> dict:
+    """Pull the guarded fields out of any of the bench output shapes."""
+    with open(path) as f:
+        node = json.load(f)
+    if isinstance(node.get("parsed"), dict):
+        node = node["parsed"]
+    if isinstance(node.get("extra"), dict):
+        node = node["extra"]
+    return {k: float(node[k]) for k in GUARDED_FIELDS
+            if isinstance(node.get(k), (int, float))
+            and not isinstance(node.get(k), bool)}
+
+
+def find_rounds(bench_dir: str) -> list[str]:
+    """BENCH_r*.json paths sorted by round number (oldest first)."""
+    rounds = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(path))
+        if m:
+            rounds.append((int(m.group(1)), path))
+    return [p for _, p in sorted(rounds)]
+
+
+def compare(base: dict, cur: dict, threshold: float) -> tuple[list, list]:
+    """Returns (rows, regressions). Each row is a dict with field/base/
+    current/delta_pct/status; regressions is the failing subset."""
+    rows, regressions = [], []
+    for field, direction in GUARDED_FIELDS.items():
+        if field not in base or field not in cur:
+            continue
+        b, c = base[field], cur[field]
+        if b <= 0:
+            continue
+        delta = (c - b) / b
+        regress_frac = delta if direction == "down" else -delta
+        status = "ok"
+        if regress_frac > threshold:
+            status = "REGRESSION"
+        elif regress_frac < -threshold:
+            status = "improved"
+        row = {"field": field, "base": b, "current": c,
+               "delta_pct": round(delta * 100, 1), "status": status}
+        rows.append(row)
+        if status == "REGRESSION":
+            regressions.append(row)
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--base", help="explicit previous-round file")
+    ap.add_argument("--current", help="explicit current-round file")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="max tolerated regression fraction (default 0.15)")
+    ap.add_argument("--report-only", action="store_true",
+                    help="print the diff but always exit 0")
+    args = ap.parse_args(argv)
+
+    if bool(args.base) != bool(args.current):
+        ap.error("--base and --current must be given together")
+    if args.base:
+        base_path, cur_path = args.base, args.current
+    else:
+        rounds = find_rounds(args.dir)
+        if len(rounds) < 2:
+            print("bench_guard: fewer than two BENCH_r*.json rounds — "
+                  "nothing to compare")
+            return 0
+        base_path, cur_path = rounds[-2], rounds[-1]
+
+    base = extract_metrics(base_path)
+    cur = extract_metrics(cur_path)
+    rows, regressions = compare(base, cur, args.threshold)
+
+    print(f"bench_guard: {os.path.basename(base_path)} → "
+          f"{os.path.basename(cur_path)} "
+          f"(threshold {args.threshold:.0%})")
+    if not rows:
+        print("  no shared guarded fields — nothing to compare")
+        return 0
+    for row in rows:
+        print(f"  {row['status']:>10}  {row['field']}: "
+              f"{row['base']:g} → {row['current']:g} "
+              f"({row['delta_pct']:+.1f}%)")
+    if regressions and not args.report_only:
+        print(f"bench_guard: FAIL — {len(regressions)} field(s) regressed "
+              f"more than {args.threshold:.0%}")
+        return 1
+    print("bench_guard: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,8 +59,53 @@ def pytest_pyfunc_call(pyfuncitem):
             # compiles legitimately block the loop for seconds in tests —
             # keep the slow-callback log quiet below that
             asyncio.get_running_loop().slow_callback_duration = 5.0
-            await fn(**kwargs)
+            task = asyncio.ensure_future(fn(**kwargs))
+            done, pending = await asyncio.wait({task},
+                                               timeout=_TEST_TIMEOUT_S)
+            if pending:
+                # dump BEFORE cancelling — the stuck awaits are the evidence
+                _dump_pending_tasks(pyfuncitem.nodeid)
+                task.cancel()
+                # bounded drain: a test blocked inside a thread (to_thread
+                # / run_in_executor) defers CancelledError until the thread
+                # returns — an unbounded await here would re-hang the suite
+                done2, _ = await asyncio.wait({task}, timeout=30)
+                for t in done2:             # consume; we raise our own
+                    try:
+                        t.exception()
+                    except asyncio.CancelledError:
+                        pass
+                raise asyncio.TimeoutError(
+                    f"test exceeded the {_TEST_TIMEOUT_S:.0f}s watchdog "
+                    f"(pending awaits in /tmp/tpu9-test-hangs.txt)")
+            task.result()
 
         asyncio.run(wrapper(), debug=True)
         return True
     return None
+
+
+# Hard per-test ceiling: a CANCELLABLE await lost to a wedged peer or a
+# missed wakeup (the observed class: py3.10 wait_for cancel races in
+# teardown) becomes ONE failed test instead of an idle loop eating the
+# suite's wall-clock budget. A test blocked inside a thread
+# (to_thread/run_in_executor) is out of scope — asyncio.run's cleanup and
+# the interpreter-exit thread join re-block on it regardless of anything
+# done here. Generously above the slowest legitimate e2e (internal
+# readiness deadlines run up to ~185 s).
+_TEST_TIMEOUT_S = float(os.environ.get("TPU9_TEST_TIMEOUT_S", "300"))
+
+
+def _dump_pending_tasks(nodeid: str) -> None:
+    """Append every pending task's stack to /tmp/tpu9-test-hangs.txt —
+    pytest swallows captured output of a test that never returns, so the
+    evidence of WHAT was awaited has to leave the process another way."""
+    import time
+    try:
+        with open("/tmp/tpu9-test-hangs.txt", "a") as f:
+            f.write(f"\n=== {time.strftime('%F %T')} {nodeid} "
+                    f"timed out after {_TEST_TIMEOUT_S}s ===\n")
+            for task in asyncio.all_tasks():
+                task.print_stack(limit=25, file=f)
+    except OSError:
+        pass

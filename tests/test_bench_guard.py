@@ -1,0 +1,85 @@
+"""scripts/bench_guard.py — the fast regression gate ISSUE 1 wires into the
+default (`-m 'not slow'`) suite run."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import bench_guard  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round(tmp_path, n, extra):
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps({"parsed": {"extra": extra}}))
+    return str(path)
+
+
+BASE = {"cold_start_p50_s": 1.0, "cold_start_jax_restore_p50_s": 0.9,
+        "engine_tokens_per_sec_per_chip": 500.0}
+
+
+def test_guard_passes_within_threshold(tmp_path, capsys):
+    _round(tmp_path, 1, BASE)
+    _round(tmp_path, 2, {"cold_start_p50_s": 1.1,          # +10% < 15%
+                         "cold_start_jax_restore_p50_s": 0.5,   # improved
+                         "engine_tokens_per_sec_per_chip": 460.0})  # -8%
+    assert bench_guard.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "improved" in out and "REGRESSION" not in out
+
+
+def test_guard_fails_on_cold_start_regression(tmp_path, capsys):
+    _round(tmp_path, 1, BASE)
+    _round(tmp_path, 2, {**BASE, "cold_start_p50_s": 1.3})   # +30%
+    assert bench_guard.main(["--dir", str(tmp_path)]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_guard_fails_on_throughput_drop(tmp_path):
+    _round(tmp_path, 1, BASE)
+    _round(tmp_path, 2, {**BASE,
+                         "engine_tokens_per_sec_per_chip": 300.0})  # -40%
+    assert bench_guard.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_guard_skips_fields_missing_on_either_side(tmp_path):
+    # a NEW metric (streamed restore) must not fail against rounds that
+    # predate it, and a dropped metric must not fail either
+    _round(tmp_path, 1, BASE)
+    _round(tmp_path, 2, {"cold_start_p50_s": 1.0,
+                         "cold_start_jax_restore_stream_p50_s": 0.02})
+    assert bench_guard.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_guard_compares_latest_two_rounds(tmp_path):
+    _round(tmp_path, 1, {**BASE, "cold_start_p50_s": 10.0})  # old noise
+    _round(tmp_path, 2, BASE)
+    _round(tmp_path, 10, {**BASE, "cold_start_p50_s": 1.05})  # r02 → r10
+    assert bench_guard.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_guard_single_round_is_a_noop(tmp_path):
+    _round(tmp_path, 1, BASE)
+    assert bench_guard.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_guard_reads_repo_rounds(capsys):
+    """The wiring the satellite asks for: the guard parses the repo's real
+    BENCH_r*.json captures every suite run. Report-only here — historical
+    rounds contain known pre-existing CPU-noise regressions (r04→r05
+    engine tok/s); the failing mode is exercised on synthetic fixtures
+    above, and the driver runs the hard gate after each NEW round."""
+    assert bench_guard.main(["--dir", REPO, "--report-only"]) == 0
+    out = capsys.readouterr().out
+    assert "cold_start_p50_s" in out
+
+
+def test_guard_explicit_base_current(tmp_path):
+    a = _round(tmp_path, 1, BASE)
+    b = _round(tmp_path, 2, {**BASE, "cold_start_p50_s": 0.8})
+    assert bench_guard.main(["--base", a, "--current", b]) == 0
+    assert bench_guard.main(["--base", b, "--current", a]) == 1

@@ -79,3 +79,65 @@ async def test_checkpoint_restore_fallback_to_cold_boot():
         await stack.scale_to_zero(dep)
         out = await stack.invoke(dep, {})
         assert out["built"] is True     # rebuilt from scratch, no crash
+
+
+async def test_gateway_ckpt_rpc_surface():
+    """The worker-token RPC endpoints the standalone worker's
+    CheckpointManager rides (record → manifest put → status → lookup):
+    the same wiring `tpu9 worker` uses against a remote gateway."""
+    import aiohttp
+
+    from tpu9.images.manifest import ImageManifest
+
+    async with LocalStack() as stack:
+        base = stack.base_url
+        wtok = {"Authorization": f"Bearer {stack.gateway.worker_token}"}
+        utok = {"Authorization": f"Bearer {stack.gateway.default_token}"}
+        manifest = ImageManifest(image_id="", files=[],
+                                 chunk_bytes=4 << 20).to_json()
+        async with aiohttp.ClientSession() as s:
+            # record requires the WORKER token — a user token is forbidden
+            async with s.post(f"{base}/rpc/internal/ckpt/ws/stub-1/ct-1",
+                              headers=utok) as r:
+                assert r.status == 403
+            async with s.post(f"{base}/rpc/internal/ckpt/ws/stub-1/ct-1",
+                              headers=wtok) as r:
+                assert r.status == 200
+                ckpt_id = (await r.json())["checkpoint_id"]
+
+            # a pending checkpoint must NOT be handed to the scheduler
+            assert await stack.backend.latest_checkpoint("stub-1") is None
+
+            async with s.post(
+                    f"{base}/rpc/internal/ckpt/manifest/{ckpt_id}",
+                    headers=wtok, data="not json") as r:
+                assert r.status == 400
+            async with s.post(
+                    f"{base}/rpc/internal/ckpt/manifest/{ckpt_id}",
+                    headers=wtok, data=manifest) as r:
+                assert r.status == 200
+            async with s.post(
+                    f"{base}/rpc/internal/ckpt/status/{ckpt_id}",
+                    headers=wtok,
+                    json={"status": "available", "size": 123}) as r:
+                assert r.status == 200
+
+            row = await stack.backend.latest_checkpoint("stub-1")
+            assert row and row["checkpoint_id"] == ckpt_id
+            assert row["size"] == 123
+
+            async with s.get(
+                    f"{base}/rpc/internal/ckpt/manifest/{ckpt_id}",
+                    headers=wtok) as r:
+                assert r.status == 200
+                assert ImageManifest.from_json(await r.text()).chunk_bytes \
+                    == 4 << 20
+            async with s.get(
+                    f"{base}/rpc/internal/ckpt/manifest/ckpt-missing",
+                    headers=wtok) as r:
+                assert r.status == 404
+            # path traversal in the id must be rejected, not resolved
+            async with s.get(
+                    f"{base}/rpc/internal/ckpt/manifest/..%2F..%2Fetc",
+                    headers=wtok) as r:
+                assert r.status in (400, 404)

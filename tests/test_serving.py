@@ -177,3 +177,51 @@ async def test_cancel_request_frees_slot():
         assert len(req.generated) < 10_000
     finally:
         await eng.stop()
+
+
+async def test_compile_ahead_abstract_precompile_then_bind():
+    """ISSUE 1 compile-ahead: every serving graph AOT-compiles from shapes
+    alone (abstract params), and after bind_params the engine serves the
+    SAME tokens as one built the classic way — on both cache layouts."""
+    from tpu9.serving.engine import abstract_params
+
+    params = init_decoder(jax.random.PRNGKey(0), TINY)
+    for paged_kw in ({}, {"kv_block_size": 8, "prefill_chunk": 16,
+                          "admit_group_chunks": 2}):
+        ecfg = EngineConfig(max_batch=2, max_seq_len=128,
+                            prefill_buckets=(16, 64), decode_steps=(1, 4),
+                            temperature=0.0, **paged_kw)
+        ahead = InferenceEngine(abstract_params(params), TINY, ecfg)
+        timings = ahead.precompile()
+        assert timings, "precompile compiled nothing"
+        ahead.bind_params(params)
+        ahead.warmup()
+
+        classic = InferenceEngine(params, TINY, ecfg)
+        await ahead.start()
+        await classic.start()
+        try:
+            want = await classic.generate([5, 3, 9], max_new_tokens=6)
+            got = await ahead.generate([5, 3, 9], max_new_tokens=6)
+            assert got == want, (got, want, paged_kw)
+        finally:
+            await ahead.stop()
+            await classic.stop()
+
+
+async def test_load_engine_compile_ahead_overlaps_weight_build():
+    """presets.load_engine(compile_ahead=True): the engine comes back
+    bound, precompiled (timings recorded), and servable."""
+    from tpu9.serving.presets import load_engine
+
+    eng = load_engine("llama-tiny", max_batch=2, max_seq_len=128,
+                      prefill_buckets=(16, 64), decode_steps=(1, 4),
+                      compile_ahead=True)
+    assert eng.compile_ahead_timings
+    eng.warmup()
+    await eng.start()
+    try:
+        out = await eng.generate([1, 2, 3], max_new_tokens=4)
+        assert len(out) == 4
+    finally:
+        await eng.stop()

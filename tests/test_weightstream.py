@@ -1,0 +1,551 @@
+"""Weight-streaming restore path (ISSUE 1): the `.tpu9w` format, the
+double-buffered shard pipeline, the warm weights pool, hedged peer reads,
+and the CheckpointManager fast path that ties them together."""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpu9.cache import CacheClient, DiskStore
+from tpu9.cache.prefetch import Prefetcher
+from tpu9.cache.store import chunk_hash
+from tpu9.serving import weights as wfmt
+from tpu9.statestore import wire
+from tpu9.worker.checkpoint import CheckpointManager
+from tpu9.worker.weightpool import WeightPool
+from tpu9.worker.weightstream import stream_shards
+
+
+# ---------------------------------------------------------------------------
+# .tpu9w format
+# ---------------------------------------------------------------------------
+
+def _tree():
+    rng = np.random.default_rng(7)
+    return {"embed": rng.standard_normal((32, 16)).astype(np.float32),
+            "layers": [{"w": rng.standard_normal((16, 16)).astype(np.float32),
+                        "scale": np.float32(0.5)} for _ in range(3)],
+            "step": 42, "name": "m", "flag": True, "none": None}
+
+
+def _assert_tree_equal(a, b):
+    if isinstance(a, dict):
+        assert set(a) == set(b)
+        for k in a:
+            _assert_tree_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_tree_equal(x, y)
+    elif isinstance(a, np.ndarray) or hasattr(a, "shape") and a.shape != ():
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    else:
+        # scalars ride the skeleton; np scalar leaves come back as arrays
+        assert np.asarray(a) == np.asarray(b)
+
+
+def test_weights_roundtrip(tmp_path):
+    tree = _tree()
+    dest = str(tmp_path / "m.tpu9w")
+    index = wfmt.save_params(tree, dest)
+    assert index["format"] == wfmt.FORMAT
+    assert wfmt.is_weights_dir(dest)
+    back = wfmt.load_params(dest)
+    _assert_tree_equal(tree, back)
+    # mmap load pages shards lazily but must read identical values
+    _assert_tree_equal(tree, wfmt.load_params(dest, mmap=True))
+
+
+def test_weights_scalars_ride_the_index(tmp_path):
+    dest = str(tmp_path / "s.tpu9w")
+    index = wfmt.save_params({"lr": 0.1, "steps": 10, "w": np.ones(4)}, dest)
+    # only the array leaf became a shard
+    assert len(index["leaves"]) == 1
+    back = wfmt.load_params(dest)
+    assert back["lr"] == 0.1 and back["steps"] == 10
+
+
+def test_weight_group_recognition():
+    assert wfmt.weight_group_of("ck/params.tpu9w/000000.bin") \
+        == "ck/params.tpu9w"
+    assert wfmt.weight_group_of("ck/params.tpu9w/index.json") \
+        == "ck/params.tpu9w"
+    assert wfmt.weight_group_of("ck/code/app.py") is None
+    # a FILE merely named *.tpu9w is not a group (groups are directories)
+    assert wfmt.weight_group_of("ck/params.tpu9w") is None
+
+
+# ---------------------------------------------------------------------------
+# stream_shards: double-buffered pipeline
+# ---------------------------------------------------------------------------
+
+def _shard_entries(arrays):
+    return [{"i": i, "key": f"k{i}", "file": f"{i:06d}.bin",
+             "dtype": a.dtype.name, "shape": list(a.shape),
+             "nbytes": int(a.nbytes)} for i, a in enumerate(arrays)]
+
+
+async def _chunks_of(arrays, chunk=4096, delay=0.0):
+    for a in arrays:
+        raw = a.tobytes()
+        for off in range(0, len(raw), chunk):
+            if delay:
+                await asyncio.sleep(delay)
+            part = raw[off:off + chunk]
+            yield chunk_hash(part), part
+
+
+async def test_stream_shards_reassembles_in_order():
+    rng = np.random.default_rng(0)
+    arrays = [rng.standard_normal(1024).astype(np.float32)
+              for _ in range(4)]
+    out, st = await stream_shards(_shard_entries(arrays),
+                                  _chunks_of(arrays),
+                                  consume=lambda e, a: a.copy())
+    assert st["shards"] == 4
+    assert st["bytes"] == sum(a.nbytes for a in arrays)
+    for want, got in zip(arrays, out):
+        np.testing.assert_array_equal(want, got)
+
+
+async def test_stream_shards_truncated_stream_raises():
+    arrays = [np.ones(256, np.float32)]
+    entries = _shard_entries(arrays)
+    entries[0]["nbytes"] *= 2          # expect more bytes than arrive
+
+    with pytest.raises(IOError, match="ended early"):
+        await stream_shards(entries, _chunks_of(arrays),
+                            consume=lambda e, a: a)
+
+
+async def test_stream_shards_missing_chunk_raises():
+    async def chunks():
+        yield "deadbeef", None
+
+    with pytest.raises(IOError, match="missing chunk"):
+        await stream_shards(_shard_entries([np.ones(8, np.float32)]),
+                            chunks(), consume=lambda e, a: a)
+
+
+async def test_streamed_restore_overlaps_fetch_and_device_put():
+    """The acceptance proof: with an injected slow fetch and slow
+    device-put, streamed wall-clock must be BELOW the sum of the two
+    phases — fetch of shard i+1 overlaps the device transfer of shard i."""
+    n, fetch_d, put_d = 6, 0.04, 0.04
+    arrays = [np.full(64, i, np.float32) for i in range(n)]
+
+    def slow_put(entry, arr):
+        time.sleep(put_d)               # runs in a worker thread
+        return arr
+
+    t0 = time.perf_counter()
+    out, st = await stream_shards(
+        _shard_entries(arrays),
+        _chunks_of(arrays, chunk=1 << 20, delay=fetch_d),
+        consume=slow_put)
+    wall = time.perf_counter() - t0
+    serial = n * (fetch_d + put_d)
+    assert wall < serial * 0.8, (wall, serial, st)
+    # blocked-on-consumer time is a fraction of total consumer work —
+    # the other shards' puts ran while the loop fetched
+    assert st["put_s"] < n * put_d * 0.7, st
+    for want, got in zip(arrays, out):
+        np.testing.assert_array_equal(want, got)
+
+
+# ---------------------------------------------------------------------------
+# warm weights pool
+# ---------------------------------------------------------------------------
+
+def _entry(mb: int):
+    return {"leaves": []}, [np.zeros(mb << 20, np.uint8)]
+
+
+def test_weight_pool_lru_eviction_under_byte_cap():
+    pool = WeightPool(max_bytes=10 << 20)
+    for key, mb in (("a", 4), ("b", 4), ("c", 4)):
+        idx, arrs = _entry(mb)
+        assert pool.put(key, idx, arrs)
+    # inserting c (4 MiB) over the 10 MiB cap evicted LRU "a"
+    assert pool.get("a") is None
+    assert pool.get("b") is not None and pool.get("c") is not None
+    assert pool.used_bytes <= pool.max_bytes
+    assert pool.stats["evictions"] == 1
+
+    # the gets above touched b then c, so b is now LRU; d evicts b
+    idx, arrs = _entry(4)
+    pool.put("d", idx, arrs)
+    assert pool.get("b") is None and pool.get("c") is not None
+
+
+def test_weight_pool_rejects_oversize_group():
+    pool = WeightPool(max_bytes=1 << 20)
+    idx, arrs = _entry(2)
+    assert not pool.put("huge", idx, arrs)
+    assert pool.stats["rejected"] == 1 and len(pool) == 0
+
+
+def test_weight_pool_refresh_same_key_keeps_one_copy():
+    pool = WeightPool(max_bytes=64 << 20)
+    idx, arrs = _entry(4)
+    pool.put("k", idx, arrs)
+    pool.put("k", idx, arrs)
+    assert len(pool) == 1 and pool.used_bytes == arrs[0].nbytes
+    snap = pool.snapshot()
+    assert snap["inserts"] == 2 and snap["entries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher close: no pending tasks / leaked fetches
+# ---------------------------------------------------------------------------
+
+async def test_prefetcher_close_mid_flight_leaves_nothing_pending():
+    release = asyncio.Event()
+    inflight: set = set()
+
+    async def fetch(d):
+        inflight.add(d)
+        try:
+            await release.wait()
+            return d.encode()
+        finally:
+            inflight.discard(d)
+
+    pf = Prefetcher(fetch, [f"d{i}" for i in range(10)], window=4)
+    getter = asyncio.create_task(pf.get("d0"))
+    await asyncio.sleep(0.02)
+    assert len(inflight) == 4          # window filled, all blocked
+    getter.cancel()                    # consumer aborts the restore
+    await asyncio.gather(getter, return_exceptions=True)
+    await pf.close()
+    await asyncio.sleep(0)
+    assert pf._tasks == {}
+    assert not inflight, "close() left fetches running"
+    # close is sticky: a racing get cannot re-open the read-ahead window
+    release.set()
+    assert await pf.get("d5") == b"d5"     # direct fetch still works
+    assert pf._tasks == {}
+
+
+# ---------------------------------------------------------------------------
+# hedged peer reads
+# ---------------------------------------------------------------------------
+
+class FakePeer:
+    """Wire-compatible chunk peer with injectable latency and payloads."""
+
+    def __init__(self, data: dict, delay: float = 0.0):
+        self.data = dict(data)
+        self.delay = delay
+        self.address = ""
+        self.gets = 0
+        self._server = None
+
+    async def start(self) -> "FakePeer":
+        self._server = await asyncio.start_server(self._handle,
+                                                  "127.0.0.1", 0)
+        port = self._server.sockets[0].getsockname()[1]
+        self.address = f"127.0.0.1:{port}"
+        return self
+
+    async def stop(self) -> None:
+        self._server.close()
+        await self._server.wait_closed()
+
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                req = await wire.read_frame(reader)
+                if req.get("op") == "get":
+                    self.gets += 1
+                    await asyncio.sleep(self.delay)
+                    blob = self.data.get(req["hash"])
+                    if blob is None:
+                        writer.write(wire.pack({"ok": False}))
+                    else:
+                        writer.write(wire.pack({"ok": True,
+                                                "len": len(blob)}))
+                        writer.write(blob)
+                    await writer.drain()
+                elif req.get("op") == "put":
+                    blob = await reader.readexactly(int(req["len"]))
+                    self.data[req["hash"]] = blob
+                    writer.write(wire.pack({"ok": True}))
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+
+
+async def test_hedged_read_races_slow_primary(tmp_path):
+    from tpu9.cache.client import hrw_order
+    blob = b"h" * 50_000
+    digest = chunk_hash(blob)
+    p1 = await FakePeer({digest: blob}).start()
+    p2 = await FakePeer({digest: blob}).start()
+    addrs = [p1.address, p2.address]
+    ordered = hrw_order(digest, addrs)
+    by_addr = {p1.address: p1, p2.address: p2}
+    by_addr[ordered[0]].delay = 0.5        # primary is slow
+    by_addr[ordered[1]].delay = 0.0
+
+    client = CacheClient(DiskStore(str(tmp_path)), peers=lambda: _aret(addrs),
+                         hedge_delay_s=0.02)
+    try:
+        t0 = time.perf_counter()
+        got = await client.get(digest)
+        dt = time.perf_counter() - t0
+        assert got == blob
+        assert dt < 0.4, "hedge did not cut the slow primary's latency"
+        assert client.stats["hedged_reads"] >= 1
+        assert client.stats["hedge_wins"] >= 1
+        # the cancelled loser's connection was dropped, not left dirty
+        assert ordered[0] not in client._conns
+        assert not client._bg_tasks
+    finally:
+        await client.close()
+        assert not client._conns, "close() leaked peer connections"
+        await p1.stop()
+        await p2.stop()
+
+
+async def test_hedged_read_never_returns_unverified(tmp_path):
+    from tpu9.cache.client import hrw_order
+    good = b"verified content" * 1000
+    digest = chunk_hash(good)
+    pa = await FakePeer({}).start()
+    pb = await FakePeer({}).start()
+    addrs = [pa.address, pb.address]
+    ordered = hrw_order(digest, addrs)
+    by_addr = {pa.address: pa, pb.address: pb}
+    # fast primary serves CORRUPT bytes; slow hedge has the real thing
+    by_addr[ordered[0]].data[digest] = b"x" * len(good)
+    by_addr[ordered[1]].data[digest] = good
+    by_addr[ordered[1]].delay = 0.05
+
+    client = CacheClient(DiskStore(str(tmp_path)), peers=lambda: _aret(addrs),
+                         hedge_delay_s=0.01)
+    try:
+        assert await client.get(digest) == good
+        # and with NO valid holder anywhere, the read must miss, not lie
+        evil = chunk_hash(b"never stored")
+        pa.data[evil] = b"garbage"
+        pb.data[evil] = b"garbage"
+        assert await client.get(evil) is None
+    finally:
+        await client.close()
+        await pa.stop()
+        await pb.stop()
+
+
+async def test_hedge_disabled_stays_sequential(tmp_path):
+    blob = b"seq" * 1000
+    digest = chunk_hash(blob)
+    p1 = await FakePeer({digest: blob}, delay=0.05).start()
+    client = CacheClient(DiskStore(str(tmp_path)),
+                         peers=lambda: _aret([p1.address]),
+                         hedge_delay_s=-1.0)
+    try:
+        assert await client.get(digest) == blob
+        assert client.stats["hedged_reads"] == 0
+    finally:
+        await client.close()
+        await p1.stop()
+
+
+def _aret(value):
+    fut = asyncio.get_running_loop().create_future()
+    fut.set_result(value)
+    return fut
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: streamed restore + warm pool, end to end
+# ---------------------------------------------------------------------------
+
+class _Ckpts:
+    def __init__(self):
+        self.manifests = {}
+
+    async def record(self, stub, ws, cid):
+        return f"ck-{len(self.manifests)}"
+
+    async def store(self, cid, blob):
+        self.manifests[cid] = blob
+
+    async def fetch(self, cid):
+        return self.manifests.get(cid)
+
+
+async def _make_cm(tmp_path, pool=None, **kw):
+    store = DiskStore(str(tmp_path / "cache"))
+    client = CacheClient(store, peers=lambda: _aret([]))
+    cks = _Ckpts()
+    cm = CheckpointManager(client, record=cks.record,
+                           store_manifest=cks.store,
+                           fetch_manifest=cks.fetch,
+                           weight_pool=pool, **kw)
+    return cm, client
+
+
+def _write_src(tmp_path) -> str:
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    rng = np.random.default_rng(3)
+    tree = {"w": [rng.standard_normal(4096).astype(np.float32)
+                  for _ in range(3)], "bias": rng.standard_normal(7),
+            "step": 9}
+    wfmt.save_params(tree, os.path.join(src, "params.tpu9w"))
+    with open(os.path.join(src, "app.py"), "w") as f:
+        f.write("print('hi')\n")
+    return src
+
+
+async def test_second_replica_restore_hits_warm_pool(tmp_path):
+    pool = WeightPool(1 << 30)
+    cm, client = await _make_cm(tmp_path, pool=pool)
+    src = _write_src(tmp_path)
+    ckpt = await cm.create("stub", "ws", "c0", src)
+    assert ckpt
+
+    try:
+        dest1 = str(tmp_path / "r1")
+        assert await cm.restore(ckpt, dest1)
+        m1 = dict(cm.last_restore_metrics)
+        assert m1["weight_groups"] == 1 and not m1["warm_pool_hit"]
+        assert m1["weight_stream_bytes"] > 0
+
+        dest2 = str(tmp_path / "r2")
+        assert await cm.restore(ckpt, dest2)
+        m2 = dict(cm.last_restore_metrics)
+        assert m2["warm_pool_hit"], "second replica missed the warm pool"
+        assert pool.stats["hits"] == 1 and pool.stats["misses"] == 1
+
+        # both replicas restored byte-identical state, pool or stream
+        for rel in ("params.tpu9w/index.json", "params.tpu9w/000000.bin",
+                    "app.py"):
+            with open(os.path.join(dest1, rel), "rb") as a, \
+                    open(os.path.join(dest2, rel), "rb") as b:
+                assert a.read() == b.read(), rel
+        _assert_tree_equal(
+            wfmt.load_params(os.path.join(dest1, "params.tpu9w")),
+            wfmt.load_params(os.path.join(dest2, "params.tpu9w")))
+    finally:
+        await client.close()
+
+
+async def test_restore_params_direct_to_device(tmp_path):
+    pool = WeightPool(1 << 30)
+    cm, client = await _make_cm(tmp_path, pool=pool)
+    src = _write_src(tmp_path)
+    ckpt = await cm.create("stub", "ws", "c0", src)
+
+    put_calls = []
+
+    def fake_put(entry, arr):
+        put_calls.append(entry["key"])
+        return arr * 2                      # "device" transform
+
+    try:
+        trees, metrics = await cm.restore_params(ckpt, device_put=fake_put)
+        assert not metrics["warm_pool_hit"]
+        assert set(trees) == {"params.tpu9w"}
+        want = wfmt.load_params(os.path.join(src, "params.tpu9w"))
+        got = trees["params.tpu9w"]
+        np.testing.assert_array_equal(got["bias"], np.asarray(want["bias"]) * 2)
+        assert got["step"] == 9
+        assert len(put_calls) == 4          # 3 layer shards + bias
+
+        # Nth replica: pooled host arrays go straight through device_put
+        trees2, metrics2 = await cm.restore_params(ckpt,
+                                                   device_put=fake_put)
+        assert metrics2["warm_pool_hit"]
+        np.testing.assert_array_equal(trees2["params.tpu9w"]["bias"],
+                                      got["bias"])
+    finally:
+        await client.close()
+
+
+async def test_streamed_restore_falls_back_on_corrupt_group(tmp_path):
+    """A weight group whose index is gone from the cache must fall back to
+    classic materialization — never turn a restorable snapshot into a cold
+    boot."""
+    cm, client = await _make_cm(tmp_path)
+    src = _write_src(tmp_path)
+    ckpt = await cm.create("stub", "ws", "c0", src)
+
+    # sabotage the plan: shrink the index entry's size in the manifest so
+    # the group plan rejects it (size mismatch) and classic fallback runs
+    import json as _json
+    from tpu9.images.manifest import ImageManifest
+    blob = await cm.fetch_manifest(ckpt)
+    man = ImageManifest.from_json(blob)
+    for e in man.files:
+        if e.path.endswith("000000.bin"):
+            e.size -= 1
+    cks_blob = man.to_json()
+    assert _json.loads(cks_blob)
+    cm.fetch_manifest = _make_fetch(cks_blob)
+
+    try:
+        dest = str(tmp_path / "r")
+        assert await cm.restore(ckpt, dest)
+        # the shard still restored (classic path), bytes intact
+        with open(os.path.join(src, "params.tpu9w/000000.bin"), "rb") as a, \
+                open(os.path.join(dest, "params.tpu9w/000000.bin"),
+                     "rb") as b:
+            assert a.read() == b.read()
+    finally:
+        await client.close()
+
+
+def _make_fetch(blob):
+    async def fetch(cid):
+        return blob
+    return fetch
+
+
+async def test_restore_params_overlap_with_slow_io(tmp_path):
+    """restore_params-level overlap: slow cache reads + slow device puts →
+    wall below the two phases' serial sum (the prefetch window overlaps
+    chunk fetches with each other AND with the device puts)."""
+    n_shards, fetch_d, put_d = 5, 0.05, 0.05
+
+    class SlowStore(DiskStore):
+        async def get(self, digest):
+            await asyncio.sleep(fetch_d)
+            return await super().get(digest)
+
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    tree = {"w": [np.full(256, i, np.float32) for i in range(n_shards)]}
+    wfmt.save_params(tree, os.path.join(src, "params.tpu9w"))
+
+    store = SlowStore(str(tmp_path / "cache"))
+    client = CacheClient(store, peers=lambda: _aret([]))
+    cks = _Ckpts()
+    cm = CheckpointManager(client, record=cks.record,
+                           store_manifest=cks.store,
+                           fetch_manifest=cks.fetch)
+    ckpt = await cm.create("stub", "ws", "c0", src)
+
+    def slow_put(entry, arr):
+        time.sleep(put_d)
+        return arr
+
+    try:
+        t0 = time.perf_counter()
+        trees, metrics = await cm.restore_params(ckpt, device_put=slow_put)
+        wall = time.perf_counter() - t0
+        assert trees
+        # serial chain: every shard chunk fetched one-by-one, then every
+        # shard device-put one-by-one
+        serial = n_shards * fetch_d + n_shards * put_d
+        assert wall < serial * 0.9, (wall, serial, metrics)
+    finally:
+        await client.close()

@@ -1,11 +1,18 @@
-"""Cache client with rendezvous (HRW) routing.
+"""Cache client with rendezvous (HRW) routing and hedged peer reads.
 
 Reference analogue: ``pkg/cache/client.go:187,272`` — highest-random-weight
 hashing over discovered hosts picks the canonical holder for each chunk;
 reads try local disk, then the HRW-ordered peers, then the source of truth;
-writes land locally and on the primary peer. Peer discovery is injected (the
+writes land locally and on the replica peers. Peer discovery is injected (the
 worker registry advertises cache addresses), so the client is transport-pure
 and unit-testable.
+
+Peer reads are *hedged* (λScale-style tail cutting, arXiv:2502.09922): the
+primary HRW holder gets a short head start (``hedge_delay_s``), then the
+next-ranked peer is raced against it and the first *hash-verified* result
+wins; the loser is cancelled and its connection dropped so a half-read
+response can never poison the persistent per-peer stream. A slow or dead
+primary therefore costs ~25 ms, not a full IO timeout, on the restore path.
 """
 
 from __future__ import annotations
@@ -13,7 +20,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
-from typing import Awaitable, Callable, Optional, Sequence
+from typing import AsyncIterator, Awaitable, Callable, Optional, Sequence
 
 from ..statestore import wire
 from .store import DiskStore, chunk_hash
@@ -39,20 +46,44 @@ class CacheClient:
     def __init__(self, store: DiskStore, peers: PeerFn,
                  source: Optional[SourceFn] = None,
                  self_address: str = "", replicas: int = 1,
-                 connect_timeout: float = 2.0):
+                 connect_timeout: float = 2.0,
+                 hedge_delay_s: float = 0.025):
         self.store = store
         self.peers = peers
         self.source = source
         self.self_address = self_address
         self.replicas = replicas
         self.connect_timeout = connect_timeout
+        # head start the best-ranked peer gets before the next one is raced
+        # against it; < 0 disables hedging (strictly sequential tries).
+        # The effective delay adapts upward to ~2x the observed exchange
+        # time (EWMA) — a healthy 4 MiB transfer on a slow link must not
+        # trip a hedge on every chunk and double cache traffic; only
+        # stragglers relative to this client's own history do.
+        self.hedge_delay_s = hedge_delay_s
+        self._peer_lat_ewma = 0.0
         self._conns: dict[str, tuple[asyncio.StreamReader,
                                      asyncio.StreamWriter]] = {}
         self._conn_locks: dict[str, asyncio.Lock] = {}
+        # fire-and-forget work (source→primary seeding): a bare create_task
+        # holds no strong reference, so the event loop may GC the task
+        # mid-flight — the set keeps it alive and close() drains it
+        self._bg_tasks: set[asyncio.Task] = set()
         self.stats = {"local_hits": 0, "peer_hits": 0, "source_fetches": 0,
-                      "peer_errors": 0}
+                      "peer_errors": 0, "hedged_reads": 0, "hedge_wins": 0}
+
+    def _spawn_bg(self, coro) -> asyncio.Task:
+        task = asyncio.create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     async def close(self) -> None:
+        for task in list(self._bg_tasks):
+            task.cancel()
+        if self._bg_tasks:
+            await asyncio.gather(*self._bg_tasks, return_exceptions=True)
+        self._bg_tasks.clear()
         for _, writer in self._conns.values():
             writer.close()
         self._conns.clear()
@@ -87,6 +118,12 @@ class CacheClient:
                 self._drop_conn(peer)
                 log.debug("peer %s get failed: %s", peer, exc)
                 return None
+            except asyncio.CancelledError:
+                # hedge loser: the request may be mid-exchange — a reused
+                # connection would serve the NEXT caller this response's
+                # leftover bytes. Drop it so the stream is never dirty.
+                self._drop_conn(peer)
+                raise
 
     async def _peer_get_io(self, peer: str, digest: str) -> Optional[bytes]:
         reader, writer = await self._conn(peer)
@@ -117,6 +154,12 @@ class CacheClient:
                 self.stats["peer_errors"] += 1
                 self._drop_conn(peer)
                 return False
+            except asyncio.CancelledError:
+                # same discipline as _peer_get: a put cancelled mid-frame
+                # (parallel replica puts under a cancelled caller) must not
+                # leave half a request on a pooled connection
+                self._drop_conn(peer)
+                raise
 
     async def _peer_put_io(self, peer: str, digest: str,
                            data: bytes) -> bool:
@@ -130,20 +173,83 @@ class CacheClient:
 
     # -- public API ---------------------------------------------------------
 
+    async def _peer_get_verified(self, peer: str,
+                                 digest: str) -> Optional[bytes]:
+        """A peer result counts ONLY if its hash matches — hedged or not,
+        an unverified chunk must never win the race."""
+        import time
+        t0 = time.monotonic()
+        data = await self._peer_get(peer, digest)
+        if data is not None and chunk_hash(data) == digest:
+            dt = time.monotonic() - t0
+            self._peer_lat_ewma = dt if self._peer_lat_ewma == 0.0 \
+                else 0.2 * dt + 0.8 * self._peer_lat_ewma
+            return data
+        return None
+
+    async def _hedged_peer_get(self, ordered: Sequence[str],
+                               digest: str) -> Optional[bytes]:
+        """Race the HRW-ordered peers for one chunk: peer *i+1* launches
+        only after peer *i* has had ``hedge_delay_s`` to answer; the first
+        verified result wins and every other in-flight try is cancelled
+        (with its connection dropped — see ``_peer_get``)."""
+        if not ordered:
+            return None
+        if len(ordered) == 1:
+            # nobody to hedge with — skip the task/wait machinery, which
+            # costs real throughput on the per-chunk hot path
+            return await self._peer_get_verified(ordered[0], digest)
+        tasks: list[asyncio.Task] = []
+        winner: Optional[bytes] = None
+        try:
+            nxt = 0
+            pending: set[asyncio.Task] = set()
+            while winner is None and (pending or nxt < len(ordered)):
+                if nxt < len(ordered) and (not pending
+                                           or self.hedge_delay_s >= 0):
+                    task = asyncio.create_task(
+                        self._peer_get_verified(ordered[nxt], digest))
+                    tasks.append(task)
+                    pending.add(task)
+                    nxt += 1
+                timeout = None if (nxt >= len(ordered)
+                                   or self.hedge_delay_s < 0) \
+                    else max(self.hedge_delay_s, 2.0 * self._peer_lat_ewma)
+                done, pending = await asyncio.wait(
+                    pending, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done and nxt < len(ordered):
+                    self.stats["hedged_reads"] += 1   # launching a hedge
+                for task in done:
+                    try:
+                        data = task.result()
+                    except Exception:   # noqa: BLE001 — a lost racer only
+                        data = None     # loses; the race itself survives
+                    if data is not None and winner is None:
+                        winner = data
+                        if task is not tasks[0]:
+                            self.stats["hedge_wins"] += 1
+            return winner
+        finally:
+            for task in tasks:
+                if not task.done():
+                    task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
     async def get(self, digest: str) -> Optional[bytes]:
-        """local → HRW peers → source (populating local + primary)."""
+        """local → hedged HRW peers → source (populating local + primary)."""
         data = await self.store.get(digest)
         if data is not None:
             self.stats["local_hits"] += 1
             return data
 
         peers = [p for p in await self.peers() if p != self.self_address]
-        for peer in hrw_order(digest, peers)[: max(self.replicas, 1) + 1]:
-            data = await self._peer_get(peer, digest)
-            if data is not None and chunk_hash(data) == digest:
-                self.stats["peer_hits"] += 1
-                await self.store.put(data, digest)
-                return data
+        ordered = hrw_order(digest, peers)[: max(self.replicas, 1) + 1]
+        data = await self._hedged_peer_get(ordered, digest)
+        if data is not None:
+            self.stats["peer_hits"] += 1
+            await self.store.put(data, digest)
+            return data
 
         if self.source is not None:
             data = await self.source(digest)
@@ -153,18 +259,36 @@ class CacheClient:
                 # seed the canonical holder so the next reader hits a peer
                 ordered = hrw_order(digest, peers)
                 if ordered:
-                    asyncio.create_task(self._peer_put(ordered[0], digest,
-                                                       data))
+                    self._spawn_bg(self._peer_put(ordered[0], digest, data))
                 return data
         return None
+
+    async def get_stream(self, digests: Sequence[str],
+                         window: int = 8) -> AsyncIterator[
+                             tuple[str, Optional[bytes]]]:
+        """Yield ``(digest, data)`` in the given (manifest) order through a
+        read-ahead window — the streaming-restore feed: chunk *i+1* is in
+        flight while the consumer deserializes chunk *i*. Duplicate digests
+        are served again (second fetch is a local-store hit)."""
+        from .prefetch import Prefetcher
+        pf = Prefetcher(self.get, list(dict.fromkeys(digests)),
+                        window=window)
+        try:
+            for digest in digests:
+                yield digest, await pf.get(digest)
+        finally:
+            await pf.close()
 
     async def put(self, data: bytes, digest: str = "") -> str:
         digest = digest or chunk_hash(data)
         await self.store.put(data, digest)
         peers = [p for p in await self.peers() if p != self.self_address]
         ordered = hrw_order(digest, peers)[: self.replicas]
-        for peer in ordered:
-            await self._peer_put(peer, digest, data)
+        if ordered:
+            # replica fan-out in parallel: N sequential peer round-trips
+            # serialized every snapshot upload (ISSUE 1 satellite)
+            await asyncio.gather(*[self._peer_put(peer, digest, data)
+                                   for peer in ordered])
         return digest
 
     async def get_many(self, digests: Sequence[str],

@@ -31,9 +31,10 @@ class Prefetcher:
         self._tasks: dict[str, asyncio.Task] = {}
         self._done: set[str] = set()   # consumed — never re-scheduled
         self._next = 0          # first order-index not yet scheduled
+        self._closed = False
 
     def _schedule_ahead(self) -> None:
-        while (self._next < len(self.order)
+        while (not self._closed and self._next < len(self.order)
                and len(self._tasks) < self.window):
             digest = self.order[self._next]
             self._next += 1
@@ -48,11 +49,22 @@ class Prefetcher:
         if task is None:
             data = await self.fetch(digest)
         else:
-            data = await task
+            try:
+                data = await task
+            except asyncio.CancelledError:
+                # consumer aborted mid-await: the popped task is no longer
+                # in _tasks, so close() can't reach it — cancel it here or
+                # the fetch (and its connection) outlives the restore
+                task.cancel()
+                raise
         self._schedule_ahead()
         return data
 
     async def close(self) -> None:
+        """Cancel every in-flight read-ahead and await it out: after close
+        returns there are no pending tasks, and a racing ``get`` can never
+        schedule new ones (the restore path closes mid-stream on failure)."""
+        self._closed = True
         for task in self._tasks.values():
             task.cancel()
         await asyncio.gather(*self._tasks.values(), return_exceptions=True)

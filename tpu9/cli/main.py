@@ -957,8 +957,51 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
         disks = None
         sandboxes = None
         criu = None
+        ckpt_record = None
+        ckpt_update = None
+        ckpt_store = None
+        ckpt_fetch = None
         if gateway_url and worker_token:
             from ..worker.disks import DiskManager
+
+            # container checkpoints: rows + manifests live on the gateway,
+            # chunk payloads ride the distributed worker cache (HRW peers)
+
+            async def ckpt_record(stub_id, workspace_id, container_id):
+                async with session.post(
+                        f"{gateway_url}/rpc/internal/ckpt/{workspace_id}/"
+                        f"{stub_id}/{container_id}") as resp:
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"checkpoint record failed: {resp.status}")
+                    return (await resp.json())["checkpoint_id"]
+
+            async def ckpt_update(checkpoint_id, status,
+                                  remote_key="", size=0) -> None:
+                async with session.post(
+                        f"{gateway_url}/rpc/internal/ckpt/status/"
+                        f"{checkpoint_id}",
+                        json={"status": status, "remote_key": remote_key,
+                              "size": size}) as resp:
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"checkpoint status update failed: {resp.status}")
+
+            async def ckpt_store(checkpoint_id, blob: str) -> None:
+                async with session.post(
+                        f"{gateway_url}/rpc/internal/ckpt/manifest/"
+                        f"{checkpoint_id}", data=blob) as resp:
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"checkpoint manifest upload failed: "
+                            f"{resp.status}")
+
+            async def ckpt_fetch(checkpoint_id):
+                async with session.get(
+                        f"{gateway_url}/rpc/internal/ckpt/manifest/"
+                        f"{checkpoint_id}") as resp:
+                    return (await resp.text() if resp.status == 200
+                            else None)
 
             async def disk_chunk_put(data: bytes, digest: str) -> None:
                 async with session.post(
@@ -1038,12 +1081,26 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
         cache = WorkerCache(cfg.cache, new_id("wc"), WorkerRepository(store),
                             source=chunk_source,
                             manifest_fetch=manifest_fetch)
+        checkpoints = None
+        if ckpt_record is not None:
+            # readiness-trigger checkpoint/restore (ISSUE 1 streaming fast
+            # path) — the warm weights pool keeps deserialized param trees
+            # for same-node replica restores
+            from ..worker.checkpoint import CheckpointManager
+            from ..worker.weightpool import WeightPool
+            weight_pool = (WeightPool(cfg.worker.weight_pool_mb << 20)
+                           if cfg.worker.weight_pool_mb > 0 else None)
+            checkpoints = CheckpointManager(
+                cache.client, record=ckpt_record, update=ckpt_update,
+                store_manifest=ckpt_store, fetch_manifest=ckpt_fetch,
+                weight_pool=weight_pool)
         w = Worker(store, runtime, cfg=cfg.worker, pool=pool,
                    tpu_generation=tpu_gen, slice_id=slice_id,
                    slice_host_rank=slice_rank, slice_host_count=slice_hosts,
                    cache=cache, object_resolver=object_resolver,
                    volume_sync=volume_sync, volume_push=volume_push,
                    volume_manifest=volume_manifest,
+                   checkpoints=checkpoints,
                    disks=disks, sandboxes=sandboxes, criu=criu)
         await w.start()
         click.echo(f"worker {w.worker_id} joined (pool={pool}, "

@@ -100,6 +100,10 @@ class WorkerConfig:
     vcache_dir: str = "/tmp/tpu9/vcache"
     failover_max_pending: int = 10
     failover_max_scheduling_latency_ms: float = 5000.0
+    # warm weights pool cap (MiB): deserialized host param trees kept
+    # alive per node so the Nth replica of a hot model skips cache IO and
+    # deserialization (λScale keep-alive tier). 0 disables the pool.
+    weight_pool_mb: int = 2048
 
 
 @dataclass

@@ -21,6 +21,7 @@ import hashlib
 import json
 import logging
 import os
+import re
 import time
 from typing import Optional
 
@@ -248,6 +249,18 @@ class Gateway:
                    "{snapshot_id}", self._internal_sbxsnap_put)
         r.add_get("/rpc/internal/sbxsnap/manifest/{snapshot_id}",
                   self._internal_sbxsnap_get)
+        # container checkpoints (readiness-trigger restore fast path):
+        # workers record the row, stream chunks into the distributed cache,
+        # then land the manifest here; the scheduler's checkpoint_lookup
+        # only hands out rows the status endpoint marked 'available'
+        r.add_post("/rpc/internal/ckpt/{workspace_id}/{stub_id}/"
+                   "{container_id}", self._internal_ckpt_record)
+        r.add_post("/rpc/internal/ckpt/status/{checkpoint_id}",
+                   self._internal_ckpt_status)
+        r.add_post("/rpc/internal/ckpt/manifest/{checkpoint_id}",
+                   self._internal_ckpt_manifest_put)
+        r.add_get("/rpc/internal/ckpt/manifest/{checkpoint_id}",
+                  self._internal_ckpt_manifest_get)
         r.add_get("/api/v1/volume", self._list_volumes)
         r.add_post("/api/v1/volume/{name}", self._create_volume)
         r.add_delete("/api/v1/volume/{name}", self._delete_volume)
@@ -2357,6 +2370,66 @@ class Gateway:
             request.match_info["container_id"], blob, manifest.total_bytes,
             kind=kind)
         return web.json_response({"ok": True})
+
+    def _ckpt_manifest_path(self, checkpoint_id: str) -> str:
+        # checkpoint manifests are ImageManifests, stored the way the image
+        # registry stores its own (JSON files under registry_dir) — NOT as
+        # backend rows like sandbox snapshots: the registry dir is already
+        # the durability domain for every manifest the scheduler hands out
+        if not re.fullmatch(r"[A-Za-z0-9_.-]+", checkpoint_id):
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "bad checkpoint id"}),
+                content_type="application/json")
+        d = os.path.join(self.cfg.image.registry_dir, "checkpoints")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{checkpoint_id}.json")
+
+    async def _internal_ckpt_record(self, request: web.Request) -> web.Response:
+        self._require_worker(request)
+        checkpoint_id = await self.backend.create_checkpoint(
+            request.match_info["stub_id"],
+            request.match_info["workspace_id"],
+            request.match_info["container_id"])
+        return web.json_response({"checkpoint_id": checkpoint_id})
+
+    async def _internal_ckpt_status(self, request: web.Request) -> web.Response:
+        self._require_worker(request)
+        body = await request.json()
+        await self.backend.update_checkpoint(
+            request.match_info["checkpoint_id"],
+            str(body.get("status", "failed")),
+            str(body.get("remote_key", "")), int(body.get("size", 0)))
+        return web.json_response({"ok": True})
+
+    async def _internal_ckpt_manifest_put(self,
+                                          request: web.Request) -> web.Response:
+        self._require_worker(request)
+        blob = await request.text()
+        from ..images import ImageManifest
+        try:
+            ImageManifest.from_json(blob)
+        except Exception as exc:   # noqa: BLE001
+            return web.json_response({"error": f"bad manifest: {exc}"},
+                                     status=400)
+        path = self._ckpt_manifest_path(request.match_info["checkpoint_id"])
+
+        def _write() -> None:      # multi-MB manifests must not stall the
+            tmp = f"{path}.tmp"    # event loop (every request shares it)
+            with open(tmp, "w") as f:
+                f.write(blob)
+            os.replace(tmp, path)  # readers never see a partial manifest
+
+        await asyncio.to_thread(_write)
+        return web.json_response({"ok": True})
+
+    async def _internal_ckpt_manifest_get(self,
+                                          request: web.Request) -> web.Response:
+        self._require_worker(request)
+        path = self._ckpt_manifest_path(request.match_info["checkpoint_id"])
+        if not os.path.exists(path):
+            return web.json_response({"error": "not found"}, status=404)
+        blob = await asyncio.to_thread(lambda: open(path).read())
+        return web.Response(text=blob, content_type="application/json")
 
     async def _internal_sbxsnap_get(self, request: web.Request) -> web.Response:
         self._require_worker(request)

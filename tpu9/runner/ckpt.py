@@ -4,8 +4,10 @@ container workdir so the worker's filesystem snapshot carries it.
 Reference analogue: the SDK runner's ``wait_for_checkpoint`` cooperation
 (``sdk/src/beta9/runner/common.py``) — here inverted for TPUs: instead of
 CRIU freezing the process, the runner persists the expensive-to-rebuild state
-(model params via orbax, plus anything the handler adds) and marks readiness;
-a restored container finds the state and skips re-initialization.
+(model params as streamable ``.tpu9w`` shards — tpu9.serving.weights — plus
+anything the handler adds) and marks readiness; a restored container finds
+the state and skips re-initialization, and the worker's streaming restore +
+warm weights pool recognize the shard dirs by suffix.
 
 Handler usage:
 
@@ -47,29 +49,63 @@ def mark_ready(meta: dict | None = None) -> None:
         f.write("1")
 
 
+def _weights_path(name: str) -> str:
+    from ..serving import weights as wfmt
+    return os.path.join(ckpt_dir(), name + wfmt.WEIGHTS_SUFFIX)
+
+
 def save_params(params: Any, name: str = "params") -> str:
-    """Persist a jax pytree with orbax (async-barrier'd, overwrite-safe)."""
-    import orbax.checkpoint as ocp
-    path = os.path.join(ckpt_dir(), name)
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, params, force=True)
-    return path
+    """Persist a jax pytree in the streamable ``.tpu9w`` shard format
+    (tpu9.serving.weights) — raw per-leaf shards the worker's restore can
+    feed straight from cache chunks into host buffers / the warm weights
+    pool, with no container framing to parse.
+
+    Trees the format cannot represent — multi-host sharded ``jax.Array``s
+    (``np.asarray`` raises on non-addressable shards), NamedTuple
+    containers, custom pytree nodes — fall back to the legacy orbax
+    directory, which ``load_params`` still restores."""
+    from ..serving import weights as wfmt
+    path = _weights_path(name)
+    try:
+        # the format's flatten np.asarray's each leaf — device arrays are
+        # pulled to host there, python scalars ride in the index skeleton
+        wfmt.save_params(params, path)
+        return path
+    except Exception as exc:       # noqa: BLE001 — any non-representable
+        import shutil              # tree degrades to the orbax path
+        shutil.rmtree(path, ignore_errors=True)   # a partial .tpu9w dir
+        log.info("params %r not streamable (%s); saving via orbax", name,
+                 exc)                             # would shadow the orbax
+    import orbax.checkpoint as ocp                # dir on load
+    legacy = os.path.join(ckpt_dir(), name)
+    ocp.PyTreeCheckpointer().save(legacy, params, force=True)
+    return legacy
 
 
-def load_params(name: str = "params", template: Any = None) -> Any:
+def load_params(name: str = "params", template: Any = None,
+                mmap: bool = False) -> Any:
+    """Load saved params: ``.tpu9w`` shard dirs first (``mmap=True`` maps
+    shards lazily instead of reading them up front), falling back to a
+    legacy orbax directory from pre-streaming checkpoints. ``template``
+    only shapes LEGACY orbax restores — a ``.tpu9w`` dir reproduces the
+    saved tree structure exactly (tuples included) and ignores it."""
+    path = _weights_path(name)
+    if os.path.isdir(path):
+        from ..serving import weights as wfmt
+        return wfmt.load_params(path, mmap=mmap)
     import orbax.checkpoint as ocp
-    path = os.path.join(ckpt_dir(), name)
+    legacy = os.path.join(ckpt_dir(), name)
     ckptr = ocp.PyTreeCheckpointer()
     if template is not None:
-        return ckptr.restore(path, item=template)
-    return ckptr.restore(path)
+        return ckptr.restore(legacy, item=template)
+    return ckptr.restore(legacy)
 
 
 def maybe_restore(init_fn: Callable[[], Any], name: str = "params") -> Any:
     """Restore saved params when running from a checkpoint; otherwise init
     and save them so the next cold start restores."""
-    path = os.path.join(ckpt_dir(), name)
-    if is_restored() and os.path.exists(path):
+    if is_restored() and (os.path.isdir(_weights_path(name))
+                          or os.path.exists(os.path.join(ckpt_dir(), name))):
         log.info("restoring %s from checkpoint", name)
         return load_params(name)
     params = init_fn()

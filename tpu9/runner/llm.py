@@ -43,9 +43,13 @@ def _build_engine(obj):
         ecfg = obj[2] if len(obj) == 3 else EngineConfig()
         return InferenceEngine(params, cfg, ecfg)
     if isinstance(obj, str):
-        # preset name, optionally "-int8"-suffixed (weight-only quantized)
+        # preset name, optionally "-int8"-suffixed (weight-only quantized).
+        # compile-ahead: the serving graphs AOT-compile from the preset's
+        # abstract shapes concurrently with weight materialization, so the
+        # post-build warmup() below dispatches precompiled executables
+        # instead of serializing XLA behind the weight load
         from ..serving.presets import load_engine
-        return load_engine(obj)
+        return load_engine(obj, compile_ahead=True)
     raise TypeError(f"handler must return an engine, (params, cfg) or a "
                     f"preset name; got {type(obj)}")
 
@@ -152,6 +156,10 @@ async def amain() -> None:
     # must never pay a multi-second XLA compile (readiness == serveable)
     timings = await asyncio.get_event_loop().run_in_executor(
         None, engine.warmup)
+    ahead = getattr(engine, "compile_ahead_timings", None)
+    if ahead:
+        log.info("compile-ahead (overlapped with weight load): %s",
+                 {k: round(v, 2) for k, v in ahead.items()})
     log.info("engine warmup: %s",
              {k: round(v, 2) for k, v in timings.items()})
     await engine.start()

@@ -31,6 +31,14 @@ from ..ops.sampling import sample_logits
 Params = dict[str, Any]
 
 
+def abstract_params(tree: Any) -> Any:
+    """Pytree of arrays (or ShapeDtypeStructs) → matching
+    ``jax.ShapeDtypeStruct`` tree. The compile-ahead contract: everything
+    :meth:`InferenceEngine.precompile` needs from the weights is this."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
 @dataclass(frozen=True)
 class EngineConfig:
     max_batch: int = 8
@@ -439,6 +447,84 @@ class InferenceEngine:
     async def start(self) -> None:
         if self._loop_task is None:
             self._loop_task = asyncio.create_task(self._serve_loop())
+
+    def bind_params(self, params: Params) -> None:
+        """Swap the engine onto real weights. The compile-ahead path
+        constructs the engine with an ABSTRACT param tree
+        (``jax.ShapeDtypeStruct`` leaves — see :func:`abstract_params`),
+        precompiles while the weights stream, then binds the streamed /
+        pooled arrays here. The engine must not serve before this."""
+        self.params = params
+
+    def precompile(self) -> dict:
+        """AOT-compile every steady-state serving graph from SHAPES alone.
+
+        XLA needs param shapes/dtypes, not values — so serving bring-up can
+        run this concurrently with weight streaming (``self.params`` may be
+        a ``jax.ShapeDtypeStruct`` tree from :func:`abstract_params`)
+        instead of serializing a multi-second compile behind the weight
+        load. Each ``.lower(...).compile()`` executable replaces the jitted
+        function under the same cache key the serve loop resolves, so after
+        ``bind_params`` the warmup/serve path dispatches straight into the
+        compiled graph; with ``JAX_COMPILATION_CACHE_DIR`` set (every tpu9
+        container) the executables land in the persistent cache too.
+        Scalar positions are lowered with concrete ints — the weak-typed
+        aval the serve loop's python-int arguments produce."""
+        import time as _time
+        timings: dict[str, float] = {}
+
+        def aot(key, fn, *args) -> None:
+            if not hasattr(fn, "lower"):
+                return                    # already an AOT executable
+            t0 = _time.perf_counter()
+            self._compiled[key] = fn.lower(*args).compile()
+            name = "_".join(str(p) for p in key) \
+                if isinstance(key, tuple) else str(key)
+            timings[f"compile_{name}_s"] = \
+                round(_time.perf_counter() - t0, 4)
+
+        pspec = abstract_params(self.params)
+        b = self.ecfg.max_batch
+        i32 = jnp.int32
+        if self.paged:
+            bs = self.ecfg.kv_block_size
+            c = self._chunk
+            scratch = abstract_params(self._scratch)
+            pool = abstract_params(self.kv_cache["k"])
+            aot(("chunk", c), self._chunk_fn(),
+                pspec, jax.ShapeDtypeStruct((1, c), i32), 0, scratch, 0)
+            aot("splice", self._splice_fn(),
+                pool, pool, scratch["k"], scratch["v"], 0,
+                jax.ShapeDtypeStruct((c // bs,), i32))
+            aot("gather", self._gather_fn(),
+                pool, pool, jax.ShapeDtypeStruct((self._mb,), i32))
+            g = max(1, self.ecfg.admit_group_chunks)
+            if g > 1:
+                aot(("chunkgroup", g), self._chunk_group_fn(g),
+                    pspec, pool, pool, scratch,
+                    jax.ShapeDtypeStruct((g, c), i32),
+                    jax.ShapeDtypeStruct((g,), i32),
+                    jax.ShapeDtypeStruct((g,), i32),
+                    jax.ShapeDtypeStruct((g, c // bs), i32))
+        else:
+            cfg = self.cfg
+            for bucket in self._buckets:
+                pre = jax.ShapeDtypeStruct(
+                    (cfg.n_layers, 1, bucket, cfg.n_kv_heads,
+                     cfg.head_dim), cfg.dtype)
+                aot(bucket, self._prefill_fn(bucket),
+                    pspec, jax.ShapeDtypeStruct((1, bucket), i32), 1)
+                aot(("dsplice", bucket), self._dense_splice_fn(bucket),
+                    abstract_params(self.kv_cache["k"]),
+                    abstract_params(self.kv_cache["v"]), pre, pre, 0)
+        kv_spec = abstract_params(self.kv_cache)
+        for k in self.ecfg.decode_steps:
+            aot(("decode", k), self._decode_k(k),
+                pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b,), jnp.bool_),
+                abstract_params(self._rng))
+        return timings
 
     def warmup(self) -> dict:
         """Precompile every prefill bucket and decode-window graph.

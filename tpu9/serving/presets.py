@@ -44,6 +44,23 @@ def build_params(name: str, seed: int = 0):
     return init_decoder(rng, cfg), cfg
 
 
+def params_spec(name: str):
+    """Abstract (``jax.ShapeDtypeStruct``) param tree for a preset — the
+    shapes compile-ahead needs before a single weight byte has streamed.
+    ``jax.eval_shape`` over the init fn, so spec and real params can never
+    drift apart."""
+    import jax
+    cfg, quantized = resolve_preset(name)
+    if quantized:
+        from ..ops.quant import init_quantized_decoder
+        init = init_quantized_decoder
+    else:
+        from ..models import init_decoder
+        init = init_decoder
+    spec = jax.eval_shape(lambda rng: init(rng, cfg), jax.random.PRNGKey(0))
+    return spec, cfg
+
+
 def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
                 prefill_buckets: tuple = (128, 512, 2048),
                 decode_steps: tuple = (1, 8, 32),
@@ -52,13 +69,21 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
                 kv_pool_blocks: int = 0,
                 prefix_cache_blocks: Optional[int] = None,
                 engine_cfg: Optional[EngineConfig] = None,
-                seed: int = 0) -> InferenceEngine:
+                seed: int = 0,
+                compile_ahead: bool = False) -> InferenceEngine:
     """``paged=None`` (default) enables the paged-KV engine whenever the
     alignment invariants hold (block | chunk | max_seq_len) — the
     production serving path (block allocator + chunked prefill + prefix
     reuse). ``paged=False`` forces the legacy dense cache.
-    ``prefix_cache_blocks=0`` DISABLES the prefix cache (None = auto)."""
-    params, cfg = build_params(name, seed=seed)
+    ``prefix_cache_blocks=0`` DISABLES the prefix cache (None = auto).
+
+    ``compile_ahead=True`` builds the engine on the preset's ABSTRACT param
+    spec and runs :meth:`InferenceEngine.precompile` in a thread WHILE the
+    weights materialize, binding them when both finish — serving bring-up
+    pays max(compile, weight load) instead of their sum (λScale-style
+    pipelined bring-up; the per-graph timings land in
+    ``engine.compile_ahead_timings``)."""
+    cfg, _quantized = resolve_preset(name)
     # the chunk is the smallest prefill bucket; the block size must divide
     # it (a chunk smaller than a block would lose prefill KV — the engine
     # rejects that) AND divide max_seq_len; max_seq_len must also be a
@@ -79,4 +104,33 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
         prefix_cache_blocks=prefix_cache_blocks
         if prefix_cache_blocks is not None
         else (max_seq_len // block if paged else 0))
+    if compile_ahead:
+        import logging
+        import threading
+        spec, _ = params_spec(name)
+        engine = InferenceEngine(spec, cfg, ecfg)
+        timings: dict = {}
+        errors: list = []
+
+        def _precompile() -> None:
+            try:
+                timings.update(engine.precompile())
+            except Exception as exc:   # noqa: BLE001 — surfaced after join
+                errors.append(exc)
+
+        compiler = threading.Thread(target=_precompile,
+                                    name="tpu9-compile-ahead", daemon=True)
+        compiler.start()
+        params, _ = build_params(name, seed=seed)    # ∥ the compile
+        compiler.join()
+        if errors:
+            # lazy compile still serves correctly — but the bring-up stall
+            # compile-ahead exists to hide must be attributable in logs
+            logging.getLogger("tpu9.serving").warning(
+                "compile-ahead failed (%s); graphs compile lazily on "
+                "first use", errors[0])
+        engine.bind_params(params)
+        engine.compile_ahead_timings = timings
+        return engine
+    params, _ = build_params(name, seed=seed)
     return InferenceEngine(params, cfg, ecfg)

@@ -1,0 +1,244 @@
+"""Streamable param-tree weight format (`*.tpu9w` directories).
+
+The checkpoint restore chain used to be ``cache → workdir → np.load →
+device`` — every hop serialized behind the previous one. This format makes
+param trees *streamable*: a pytree is saved as one raw little-endian shard
+file per leaf plus an ``index.json`` describing dtype/shape/order, inside a
+directory whose name ends in ``.tpu9w``. Because shards are raw bytes (no
+container framing), checkpoint chunks can be fed straight from the cache
+into a preallocated host buffer and handed to ``jax.device_put`` the moment
+a shard completes — no workdir round-trip, no deserialization step
+(``tpu9/worker/weightstream.py`` runs that pipeline).
+
+The ``.tpu9w`` suffix is the recognition contract: the worker's streaming
+restore treats any manifest subtree under a ``*.tpu9w`` component as a
+weight group and materializes everything else the classic way.
+
+Scalars (python int/float/bool leaves) ride in the index skeleton directly;
+only array leaves become shards.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+WEIGHTS_SUFFIX = ".tpu9w"
+INDEX_NAME = "index.json"
+FORMAT = "tpu9-weights-v1"
+
+_LEAF = "__leaf__"
+_SCALAR = "__scalar__"
+_TUPLE = "__tuple__"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes                    # jax's extended dtypes (bf16…)
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _flatten(node: Any, path: str, leaves: list) -> Any:
+    """Walk the tree depth-first, building a JSON skeleton whose array
+    leaves are ``{"__leaf__": i}`` markers into ``leaves`` (order = stream
+    order). Dicts keep insertion order — param builders are deterministic."""
+    if isinstance(node, dict):
+        for k in node:
+            if not isinstance(k, str) or k in (_LEAF, _SCALAR, _TUPLE):
+                # int keys (a legal pytree) would come back as strings —
+                # a silent treedef change; marker-named keys would be
+                # misparsed by _unflatten. Refuse both; the runner-level
+                # saver falls back to orbax.
+                raise TypeError(f"{path or '/'}: dict key {k!r} does not "
+                                f"round-trip through {FORMAT}")
+        return {k: _flatten(v, f"{path}/{k}", leaves)
+                for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        if hasattr(node, "_fields"):
+            # a NamedTuple would silently come back as a plain tuple —
+            # a treedef change the restored handler can't tree_map over.
+            # Refuse; the runner-level saver falls back to orbax.
+            raise TypeError(f"{path or '/'}: NamedTuple containers do not "
+                            f"round-trip through {FORMAT}")
+        out = [_flatten(v, f"{path}/{i}", leaves)
+               for i, v in enumerate(node)]
+        # tuples must round-trip as tuples: a restored handler whose
+        # treedef silently changed list-ness would fail tree_map against
+        # a cold-booted one
+        return {_TUPLE: out} if isinstance(node, tuple) else out
+    if isinstance(node, (bool, int, float, str)) or node is None:
+        return {_SCALAR: node}
+    # leaves stay UNMATERIALIZED here (shape/dtype duck-typing covers jax
+    # device arrays): np.asarray of every leaf at once would hold a full
+    # model-sized host copy before the first shard write — the per-leaf
+    # conversion happens in save_params' write loop instead
+    arr = node if hasattr(node, "shape") and hasattr(node, "dtype") \
+        else np.asarray(node)
+    if np.dtype(arr.dtype) == object:
+        # an unrecognized container (custom pytree node, e.g. FrozenDict)
+        # np.asarray'd into an object array would "save" pickle-less junk
+        raise TypeError(f"{path or '/'}: {type(node).__name__} is not a "
+                        f"{FORMAT}-representable node")
+    leaves.append((path.lstrip("/"), arr))
+    return {_LEAF: len(leaves) - 1}
+
+
+def _unflatten(skel: Any, arrays: list) -> Any:
+    if isinstance(skel, dict):
+        if _LEAF in skel:
+            return arrays[skel[_LEAF]]
+        if _SCALAR in skel:
+            return skel[_SCALAR]
+        if _TUPLE in skel:
+            return tuple(_unflatten(v, arrays) for v in skel[_TUPLE])
+        return {k: _unflatten(v, arrays) for k, v in skel.items()}
+    if isinstance(skel, list):
+        return [_unflatten(v, arrays) for v in skel]
+    raise ValueError(f"malformed weights skeleton node: {skel!r}")
+
+
+def flatten_tree(tree: Any) -> tuple[Any, list[tuple[str, np.ndarray]]]:
+    """Return ``(skeleton, [(key, array), ...])`` in stream order."""
+    leaves: list[tuple[str, np.ndarray]] = []
+    skel = _flatten(tree, "", leaves)
+    return skel, leaves
+
+
+def build_index(tree: Any) -> tuple[dict, list[np.ndarray]]:
+    skel, leaves = flatten_tree(tree)
+    entries = []
+    arrays = []
+    for i, (key, arr) in enumerate(leaves):
+        if getattr(arr, "is_fully_addressable", True) is False:
+            # fail BEFORE any shard write: np.asarray would raise on a
+            # multi-host sharded jax.Array anyway, but mid-write the
+            # partial dir would need cleanup at every call site
+            raise TypeError(f"{key}: non-addressable sharded array is not "
+                            f"{FORMAT}-representable")
+        entries.append({"i": i, "key": key, "file": f"{i:06d}.bin",
+                        "dtype": np.dtype(arr.dtype).name,
+                        "shape": list(arr.shape),
+                        "nbytes": int(arr.nbytes)})
+        arrays.append(arr)
+    index = {"format": FORMAT, "skeleton": skel, "leaves": entries,
+             "total_bytes": int(sum(a.nbytes for a in arrays))}
+    return index, arrays
+
+
+def save_params(tree: Any, dest: str) -> dict:
+    """Write ``tree`` as a ``.tpu9w`` directory at ``dest`` (created). The
+    caller picks a ``dest`` ending in :data:`WEIGHTS_SUFFIX` so snapshot
+    manifests of the enclosing workdir are stream-recognizable."""
+    index, arrays = build_index(tree)
+    os.makedirs(dest, exist_ok=True)
+    for entry, arr in zip(index["leaves"], arrays):
+        with open(os.path.join(dest, entry["file"]), "wb") as f:
+            # ONE leaf on host at a time (np.asarray pulls device arrays
+            # here, not in build_index), and a uint8 view, not tobytes():
+            # either would spike peak RSS by up to the model size inside
+            # a container sized to the model (bf16 has no buffer-protocol
+            # char, so the view)
+            host = np.ascontiguousarray(np.asarray(arr))
+            f.write(host.reshape(-1).view("u1").data)
+    with open(os.path.join(dest, INDEX_NAME), "w") as f:
+        json.dump(index, f)
+    return index
+
+
+def shard_to_array(buf, entry: dict) -> np.ndarray:
+    """Zero-copy view of a filled shard buffer as its typed array."""
+    arr = np.frombuffer(buf, dtype=_np_dtype(entry["dtype"]))
+    return arr.reshape(entry["shape"])
+
+
+def assemble(index: dict, arrays: list) -> Any:
+    """Rebuild the pytree from a parsed index + arrays in leaf order."""
+    if index.get("format") != FORMAT:
+        raise ValueError(f"not a {FORMAT} index: {index.get('format')!r}")
+    if len(arrays) != len(index["leaves"]):
+        raise ValueError(f"have {len(arrays)} arrays for "
+                         f"{len(index['leaves'])} leaves")
+    return _unflatten(index["skeleton"], list(arrays))
+
+
+def load_params(src: str, mmap: bool = False) -> Any:
+    """Read a ``.tpu9w`` directory back into a pytree of host arrays.
+    ``mmap=True`` maps shards instead of reading them (lazy page-in)."""
+    with open(os.path.join(src, INDEX_NAME)) as f:
+        index = json.load(f)
+    if index.get("format") != FORMAT:
+        raise ValueError(f"not a {FORMAT} dir: {src}")
+    arrays = []
+    for entry in index["leaves"]:
+        path = os.path.join(src, entry["file"])
+        dt = _np_dtype(entry["dtype"])
+        if mmap:
+            arr = np.memmap(path, dtype=dt, mode="r",
+                            shape=tuple(entry["shape"]))
+        else:
+            with open(path, "rb") as f:
+                arr = shard_to_array(f.read(), entry)
+        arrays.append(arr)
+    return assemble(index, arrays)
+
+
+def is_weights_dir(path: str) -> bool:
+    return path.endswith(WEIGHTS_SUFFIX) and os.path.isfile(
+        os.path.join(path, INDEX_NAME))
+
+
+def weight_group_of(rel_path: str) -> Optional[str]:
+    """The ``.tpu9w`` group prefix of a manifest path, or None. The FIRST
+    matching component wins (nested groups don't exist by construction)."""
+    parts = rel_path.split("/")
+    for i, part in enumerate(parts[:-1]):
+        if part.endswith(WEIGHTS_SUFFIX):
+            return "/".join(parts[: i + 1])
+    return None
+
+
+def manifest_weight_groups(manifest) -> dict[str, list]:
+    """Group an ImageManifest's entries by ``.tpu9w`` directory. Only groups
+    with an ``index.json`` entry qualify — anything else stays on the
+    classic materialize path. Symlink entries disqualify their group (a
+    weights dir is flat regular files by construction; a link smells like
+    tampering)."""
+    groups: dict[str, list] = {}
+    bad: set[str] = set()
+    for entry in manifest.files:
+        group = weight_group_of(entry.path)
+        if group is None:
+            continue
+        if entry.link_target:
+            bad.add(group)
+            continue
+        groups.setdefault(group, []).append(entry)
+    out = {}
+    for group, entries in groups.items():
+        if group in bad:
+            continue
+        if any(os.path.basename(e.path) == INDEX_NAME for e in entries):
+            out[group] = entries
+    return out
+
+
+def content_key(entries) -> str:
+    """Stable content hash of a weight group: the sorted (path, chunks)
+    pairs. Two checkpoints of identical weights share the key — this is
+    what the warm weights pool is keyed on."""
+    h = hashlib.sha256()
+    for entry in sorted(entries, key=lambda e: e.path):
+        # NUL-framed fields: without separators a path ending in hex is
+        # ambiguous against a shorter path plus one more chunk digest,
+        # and the pool key MUST be collision-free across manifests
+        h.update(entry.path.encode() + b"\0")
+        for c in entry.chunks:
+            h.update(c.encode() + b"\0")
+        h.update(b"\0")
+    return h.hexdigest()

@@ -74,12 +74,15 @@ class Dispatcher:
 
     async def stop(self) -> None:
         for t in (self._task, self._exit_task):
-            if t:
+            # re-cancel until done: on py3.10, wait_for can swallow a
+            # cancel that races the inner future's completion (the task
+            # then loops again and the single cancel is lost — observed
+            # as LocalStack teardown hanging the whole suite)
+            while t is not None and not t.done():
                 t.cancel()
-                try:
-                    await t
-                except asyncio.CancelledError:
-                    pass
+                await asyncio.wait({t}, timeout=1.0)
+            if t is not None and not t.cancelled():
+                t.exception()   # retrieve — silence never-retrieved noise
         self._task = self._exit_task = None
 
     async def _exit_loop(self) -> None:
@@ -88,7 +91,10 @@ class Dispatcher:
         sub = self._exit_sub
         try:
             while True:
-                msg = await sub.get(timeout=1.0)
+                # bare get, NO timeout: the 1 s poll bought nothing (None
+                # just looped) and its wait_for is the py3.10 cancel race
+                # stop() defends against
+                msg = await sub.get()
                 if msg is None:
                     continue
                 try:

@@ -122,12 +122,16 @@ class LocalStack:
             self.cfg.cache, f"wc{len(self.workers)}",
             WorkerRepository(self.store),
             source=self._chunk_source, manifest_fetch=self._manifest_fetch)
+        from ..worker.weightpool import WeightPool
+        weight_pool = WeightPool(self.cfg.worker.weight_pool_mb << 20) \
+            if self.cfg.worker.weight_pool_mb > 0 else None
         checkpoints = CheckpointManager(
             cache.client,
             record=self._ckpt_record, update=self.backend.update_checkpoint,
             fetch_manifest=self._ckpt_fetch,
             store_manifest=self._ckpt_store,
-            marker_timeout_s=20.0)
+            marker_timeout_s=20.0,
+            weight_pool=weight_pool)
 
         from ..worker.disks import DiskManager
 

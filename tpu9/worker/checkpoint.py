@@ -21,13 +21,15 @@ Triggers mirror ``types.CheckpointTrigger`` (readiness / manual / interval).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import time
 from typing import Awaitable, Callable, Optional
 
 from ..cache import CacheClient
-from ..images.manifest import ImageManifest, materialize, snapshot_dir
+from ..images.manifest import (ImageManifest, materialize, open_nofollow,
+                               safe_join, snapshot_dir)
 
 log = logging.getLogger("tpu9.worker")
 
@@ -48,30 +50,46 @@ class CheckpointManager:
                  update: Optional[UpdateFn] = None,
                  fetch_manifest: Optional[FetchFn] = None,
                  store_manifest=None,
-                 marker_timeout_s: float = 300.0):
+                 marker_timeout_s: float = 300.0,
+                 weight_pool=None,
+                 stream_weights: bool = True,
+                 marker_poll_s: float = 0.25,
+                 marker_poll_max_s: float = 1.0):
         self.cache = cache
         self.record = record
         self.update = update
         self.fetch_manifest = fetch_manifest
         self.store_manifest = store_manifest   # async (ckpt_id, json) -> None
         self.marker_timeout_s = marker_timeout_s
+        # Optional[tpu9.worker.weightpool.WeightPool] — warm host-param tier
+        self.weight_pool = weight_pool
+        self.stream_weights = stream_weights
+        self.marker_poll_s = marker_poll_s
+        self.marker_poll_max_s = marker_poll_max_s
+        # per-restore phase evidence (bench + tests read this after restore)
+        self.last_restore_metrics: dict = {}
 
     # -- create ---------------------------------------------------------------
 
     async def auto_checkpoint(self, stub_id: str, workspace_id: str,
                               container_id: str, workdir: str) -> Optional[str]:
         """Readiness-trigger checkpoint: wait for the runner's READY marker
-        (it appears once model state is saved), snapshot the workdir."""
+        (it appears once model state is saved), snapshot the workdir. Polls
+        with geometric backoff — model init takes seconds-to-minutes, and a
+        fixed fast poll just burns the worker loop (intervals injectable
+        for tests via ``marker_poll_s``/``marker_poll_max_s``)."""
         if self.record is None:
             return None
         marker = os.path.join(workdir, CKPT_DIR_NAME, READY_MARKER)
         deadline = time.monotonic() + self.marker_timeout_s
+        interval = self.marker_poll_s
         while not os.path.exists(marker):
             if time.monotonic() > deadline:
                 log.info("checkpoint marker never appeared for %s",
                          container_id)
                 return None
-            await asyncio.sleep(0.25)
+            await asyncio.sleep(interval)
+            interval = min(interval * 2, self.marker_poll_max_s)
         return await self.create(stub_id, workspace_id, container_id, workdir)
 
     async def create(self, stub_id: str, workspace_id: str, container_id: str,
@@ -107,7 +125,16 @@ class CheckpointManager:
 
     async def restore(self, checkpoint_id: str, workdir: str) -> bool:
         """Materialize a snapshot into the workdir; False → cold boot
-        (reference attemptRestoreCheckpoint's fallback)."""
+        (reference attemptRestoreCheckpoint's fallback).
+
+        Weight groups (``*.tpu9w`` dirs, tpu9.serving.weights) take the
+        streaming fast path: warm-pool hit → spill straight from host
+        arrays; miss → hedged chunk stream → double-buffered workdir spill,
+        then the deserialized tree enters the warm pool for the next
+        replica. Everything else materializes the classic way, concurrently
+        with the weight stream. A failed group falls back to classic
+        materialization — streaming must never turn a restorable snapshot
+        into a cold boot."""
         if self.fetch_manifest is None:
             return False
         try:
@@ -115,23 +142,300 @@ class CheckpointManager:
             if blob is None:
                 return False
             manifest = ImageManifest.from_json(blob)
-            # stream chunks through a read-ahead window instead of holding
-            # the WHOLE checkpoint (can be tens of GB of params) in RAM,
-            # and NO link_from: a workdir is mutable — hardlinking cache
-            # chunk files into it would let any in-place write corrupt the
-            # shared content-addressed store (local hits are not verified)
-            from ..cache.prefetch import Prefetcher, threadsafe_get
-            loop = asyncio.get_running_loop()
-            pf = Prefetcher(self.cache.get,
-                            list(dict.fromkeys(manifest.all_chunks())))
+            groups: dict = {}
+            if self.stream_weights:
+                try:
+                    # the serving package init pulls the engine (and jax)
+                    # — if that import chain is broken on this worker, the
+                    # whole restore must still succeed the classic way
+                    from ..serving import weights as wfmt
+                    groups = wfmt.manifest_weight_groups(manifest)
+                except Exception as exc:   # noqa: BLE001
+                    log.warning("weight-group scan failed (%s); classic "
+                                "restore for everything", exc)
+                    groups = {}
+            streamed = {e.path for entries in groups.values()
+                        for e in entries}
+            rest = [f for f in manifest.files if f.path not in streamed]
+
+            self.last_restore_metrics = metrics = {
+                "weight_stream_fetch_s": 0.0, "weight_stream_put_s": 0.0,
+                "weight_stream_bytes": 0, "weight_groups": len(groups),
+                "warm_pool_hit": False}
+
+            classic = asyncio.create_task(
+                self._materialize(manifest, rest, workdir))
+            failed: list = []
             try:
-                await asyncio.to_thread(
-                    materialize, manifest, workdir,
-                    threadsafe_get(pf, loop), None)
-            finally:
-                await pf.close()
+                for group, entries in groups.items():
+                    try:
+                        written = await self._restore_group(
+                            group, entries, workdir, metrics)
+                        # anything under the group dir that is not an
+                        # index-listed shard (stale shards from a re-save,
+                        # handler side files) still has to land in the
+                        # workdir — the snapshot holds it, so must we
+                        failed.extend(e for e in entries
+                                      if e.path not in written)
+                    except Exception as exc:   # noqa: BLE001
+                        log.warning(
+                            "weight stream for %s failed (%s); falling "
+                            "back to classic materialize", group, exc)
+                        failed.extend(entries)
+                await classic
+            except BaseException:
+                # cancellation (worker shutdown) — whether it lands in the
+                # group loop or while parked on `await classic` — must take
+                # the concurrent classic materialize down too, not leave it
+                # writing into a workdir the shutdown path may be deleting.
+                # (A classic-task failure re-raises below and still falls
+                # to the cold-boot path via the outer handler.)
+                classic.cancel()
+                await asyncio.gather(classic, return_exceptions=True)
+                raise
+            if failed:
+                await self._materialize(manifest, failed, workdir)
             return True
         except Exception as exc:
             log.warning("checkpoint restore %s failed: %s (cold boot)",
                         checkpoint_id, exc)
             return False
+
+    async def _materialize(self, manifest: ImageManifest, files: list,
+                           workdir: str) -> None:
+        """Classic path for non-weight entries: stream chunks through a
+        read-ahead window instead of holding the WHOLE checkpoint (can be
+        tens of GB of params) in RAM, and NO link_from: a workdir is
+        mutable — hardlinking cache chunk files into it would let any
+        in-place write corrupt the shared content-addressed store (local
+        hits are not verified)."""
+        if not files:
+            return
+        from ..cache.prefetch import Prefetcher, threadsafe_get
+        sub = ImageManifest(image_id=manifest.image_id, files=files,
+                            chunk_bytes=manifest.chunk_bytes)
+        loop = asyncio.get_running_loop()
+        pf = Prefetcher(self.cache.get,
+                        [c for f in files for c in f.chunks])
+        try:
+            await asyncio.to_thread(
+                materialize, sub, workdir, threadsafe_get(pf, loop), None)
+        finally:
+            await pf.close()
+
+    # -- weight streaming ------------------------------------------------
+
+    async def _fetch_entry_bytes(self, entry) -> bytes:
+        parts = []
+        for digest in entry.chunks:
+            data = await self.cache.get(digest)
+            if data is None:
+                raise IOError(f"missing chunk {digest} for {entry.path}")
+            parts.append(data)
+        return b"".join(parts)
+
+    async def _group_plan(self, group: str, entries: list):
+        """Fetch + parse the group's index.json and line its leaf entries
+        up with the manifest's shard files. Returns (index, leaf_entries,
+        digests, by_path) where digests is the concatenated manifest-order
+        chunk stream for the shards."""
+        from ..serving import weights as wfmt
+        by_path = {e.path: e for e in entries}
+        idx_entry = by_path.get(f"{group}/{wfmt.INDEX_NAME}")
+        if idx_entry is None:
+            raise IOError(f"weight group {group} has no index")
+        index = json.loads(await self._fetch_entry_bytes(idx_entry))
+        if index.get("format") != wfmt.FORMAT:
+            raise IOError(f"weight group {group}: unknown format "
+                          f"{index.get('format')!r}")
+        leaf_entries = index["leaves"]
+        digests: list[str] = []
+        for leaf in leaf_entries:
+            fe = by_path.get(f"{group}/{leaf['file']}")
+            if fe is None or fe.size != int(leaf["nbytes"]):
+                raise IOError(
+                    f"weight group {group}: shard {leaf['file']} missing "
+                    f"or size mismatch in manifest")
+            digests.extend(fe.chunks)
+        return index, leaf_entries, digests, by_path
+
+    def _pool_get(self, key: str):
+        return self.weight_pool.get(key) if self.weight_pool is not None \
+            else None
+
+    def _pool_would_accept(self, index: dict) -> bool:
+        """Retention gate, decided from the plan BEFORE streaming: shards
+        are kept for pool insertion only when the pool exists AND the whole
+        group fits its cap — otherwise accumulating them would hold a
+        multi-GB group in host RAM just for WeightPool.put to reject it."""
+        return (self.weight_pool is not None
+                and index.get("total_bytes", 0) <= self.weight_pool.max_bytes)
+
+    @staticmethod
+    def _note_pool_hit(metrics: dict, index: dict, dt: float) -> None:
+        metrics["warm_pool_hit"] = True
+        metrics["weight_stream_put_s"] += dt
+        metrics["weight_stream_bytes"] += index.get("total_bytes", 0)
+
+    async def _stream_group_shards(self, group: str, entries: list,
+                                   consume, metrics: dict, on_plan=None):
+        """Pool-miss skeleton shared by the workdir and direct-to-device
+        restores: plan → hedged chunk stream → double-buffered
+        ``stream_shards(consume)``, phase metrics accumulated in one
+        place. ``on_plan(index)`` fires between plan and stream so callers
+        can set per-group policy (shard retention) from the index. Returns
+        ``(index, leaf_entries, by_path, consumed)``."""
+        from .weightstream import stream_shards
+        index, leaf_entries, digests, by_path = await self._group_plan(
+            group, entries)
+        if on_plan is not None:
+            on_plan(index)
+        chunk_stream = self.cache.get_stream(digests)
+        try:
+            out, st = await stream_shards(leaf_entries, chunk_stream,
+                                          consume=consume)
+        finally:
+            await chunk_stream.aclose()
+        metrics["weight_stream_fetch_s"] += st["fetch_s"]
+        metrics["weight_stream_put_s"] += st["put_s"]
+        metrics["weight_stream_bytes"] += st["bytes"]
+        return index, leaf_entries, by_path, out
+
+    async def _restore_group(self, group: str, entries: list, workdir: str,
+                             metrics: dict) -> set:
+        """One weight group → workdir, via pool or stream; the deserialized
+        host tree enters the pool either way. Returns the manifest paths
+        actually written — the caller materializes the rest classically."""
+        from ..serving import weights as wfmt
+        key = wfmt.content_key(entries)
+        by_path = {e.path: e for e in entries}
+        dest_real = os.path.realpath(workdir)
+        group_dir = safe_join(workdir, group, dest_real)
+
+        retain = [False]       # set from the plan by note_plan below
+
+        def spill_path(fname: str) -> str:
+            target = safe_join(workdir, f"{group}/{fname}", dest_real)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            return target
+
+        def write_shard(entry: dict, arr) -> object:
+            # same O_NOFOLLOW discipline as materialize(): safe_join leaves
+            # the final component unresolved, and this writer runs as root
+            target = spill_path(entry["file"])
+            with os.fdopen(open_nofollow(target, os.O_TRUNC), "wb") as f:
+                # uint8 view, not tobytes(): no copy of a multi-GB shard
+                # (bf16 and friends have no buffer-protocol format char,
+                # so a plain memoryview would raise)
+                f.write(arr.reshape(-1).view("u1").data)
+                fe = by_path.get(f"{group}/{entry['file']}")
+                if fe is not None:
+                    os.fchmod(f.fileno(), fe.mode & 0o777)
+            # returns accumulate for pool insertion ONLY — with the pool
+            # off (or the group over its cap), keeping every shard would
+            # hold the whole multi-GB group in host RAM, the exact
+            # condition streaming exists to avoid
+            return arr if retain[0] else None
+
+        pooled = self._pool_get(key)
+        if pooled is not None:
+            index, arrays = pooled
+            t0 = time.perf_counter()
+
+            def spill_all() -> None:
+                for entry, arr in zip(index["leaves"], arrays):
+                    write_shard(entry, arr)
+                with os.fdopen(open_nofollow(spill_path(wfmt.INDEX_NAME),
+                                             os.O_TRUNC), "w") as f:
+                    json.dump(index, f)
+                    idx_fe = by_path.get(f"{group}/{wfmt.INDEX_NAME}")
+                    if idx_fe is not None:
+                        os.fchmod(f.fileno(), idx_fe.mode & 0o777)
+
+            await asyncio.to_thread(spill_all)
+            self._note_pool_hit(metrics, index, time.perf_counter() - t0)
+            return {f"{group}/{e['file']}" for e in index["leaves"]} \
+                | {f"{group}/{wfmt.INDEX_NAME}"}
+
+        os.makedirs(group_dir, exist_ok=True)
+
+        def note_plan(idx: dict) -> None:
+            retain[0] = self._pool_would_accept(idx)
+
+        index, leaf_entries, by_path, arrays = \
+            await self._stream_group_shards(group, entries, write_shard,
+                                            metrics, on_plan=note_plan)
+        idx_entry = by_path[f"{group}/{wfmt.INDEX_NAME}"]
+        with os.fdopen(open_nofollow(spill_path(wfmt.INDEX_NAME),
+                                     os.O_TRUNC), "w") as f:
+            json.dump(index, f)
+            os.fchmod(f.fileno(), idx_entry.mode & 0o777)
+        if retain[0]:
+            self.weight_pool.put(key, index, arrays)
+        return {f"{group}/{e['file']}" for e in leaf_entries} \
+            | {f"{group}/{wfmt.INDEX_NAME}"}
+
+    async def restore_params(self, checkpoint_id: str, device_put=None
+                             ) -> tuple[Optional[dict], dict]:
+        """Direct-to-device restore: no workdir at all. Streams every
+        weight group of the checkpoint into host buffers and hands each
+        completed shard to ``device_put`` (default ``jax.device_put``,
+        overlapped with the next shard's fetch). Returns ``({group_dir:
+        param_tree}, metrics)`` — trees are device (or ``device_put``'s
+        output) arrays assembled in index order; ``(None, metrics)`` when
+        the checkpoint has no streamable weights.
+
+        A warm-pool hit skips cache + deserialize entirely: pooled host
+        arrays go straight through ``device_put``."""
+        from ..serving import weights as wfmt
+        from .weightstream import default_device_put
+        metrics: dict = {"weight_stream_fetch_s": 0.0,
+                         "weight_stream_put_s": 0.0,
+                         "weight_stream_bytes": 0,
+                         "warm_pool_hit": False}
+        self.last_restore_metrics = metrics
+        if self.fetch_manifest is None:
+            return None, metrics
+        blob = await self.fetch_manifest(checkpoint_id)
+        if blob is None:
+            return None, metrics
+        manifest = ImageManifest.from_json(blob)
+        groups = wfmt.manifest_weight_groups(manifest)
+        if not groups:
+            return None, metrics
+        put = device_put or default_device_put
+        out: dict = {}
+        for group, entries in groups.items():
+            key = wfmt.content_key(entries)
+            pooled = self._pool_get(key)
+            if pooled is not None:
+                index, host_arrays = pooled
+                t0 = time.perf_counter()
+                # ONE thread hop for the whole group — a per-leaf
+                # to_thread would serialize hundreds of scheduling
+                # round-trips on the tier meant to be fastest
+                dev = await asyncio.to_thread(lambda: [
+                    put(entry, arr)
+                    for entry, arr in zip(index["leaves"], host_arrays)])
+                self._note_pool_hit(metrics, index,
+                                    time.perf_counter() - t0)
+                out[group] = wfmt.assemble(index, dev)
+                continue
+            host_arrays: list = []
+            retain = [False]
+
+            def note_plan(idx: dict, _retain=retain) -> None:
+                _retain[0] = self._pool_would_accept(idx)
+
+            def put_and_keep(entry: dict, arr, _retain=retain,
+                             _keep=host_arrays):
+                if _retain[0]:
+                    _keep.append(arr)        # pooled for the next replica
+                return put(entry, arr)
+
+            index, _, _, dev = await self._stream_group_shards(
+                group, entries, put_and_keep, metrics, on_plan=note_plan)
+            out[group] = wfmt.assemble(index, dev)
+            if retain[0]:
+                self.weight_pool.put(key, index, host_arrays)
+        return out, metrics

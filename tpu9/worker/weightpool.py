@@ -1,0 +1,82 @@
+"""Warm weights pool — node-level keep-alive for deserialized param trees.
+
+Reference analogue: λScale's model keep-alive tier (arXiv:2502.09922) and
+DeepServe's host-side model caching (arXiv:2501.14417): the Nth replica of a
+hot model on the same node should pay neither disk nor deserialization. The
+pool holds *already-deserialized host arrays* keyed by the weight group's
+content hash (``tpu9.serving.weights.content_key``), LRU-evicted under a
+byte cap, so a restore that hits skips the cache/network/deserialize chain
+entirely and goes straight to file-write or ``jax.device_put``.
+
+Entries are ``(index, arrays)`` pairs — the parsed ``.tpu9w`` index plus the
+leaf arrays in stream order — because both consumers (workdir spill for
+subprocess runners, device transfer for in-process engines) start from that
+shape. Thread-safe: device-put executors and the event loop both touch it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+
+class WeightPool:
+    def __init__(self, max_bytes: int = 4 * 1024 ** 3):
+        self.max_bytes = max_bytes
+        self._entries: "OrderedDict[str, tuple[dict, list, int]]" = \
+            OrderedDict()
+        self._used = 0
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0,
+                      "rejected": 0, "inserts": 0}
+
+    @property
+    def used_bytes(self) -> int:
+        return self._used
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: str) -> Optional[tuple[dict, list]]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.stats["misses"] += 1
+                return None
+            self._entries.move_to_end(key)          # MRU
+            self.stats["hits"] += 1
+            index, arrays, _nbytes = entry
+            return index, arrays
+
+    def put(self, key: str, index: dict, arrays: list) -> bool:
+        """Insert (or refresh) a weight group; returns False when the group
+        alone exceeds the cap (pooling it would just thrash everything)."""
+        nbytes = int(sum(int(getattr(a, "nbytes", 0)) for a in arrays))
+        with self._lock:
+            if nbytes > self.max_bytes:
+                self.stats["rejected"] += 1
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._used -= old[2]
+            self._entries[key] = (index, arrays, nbytes)
+            self._used += nbytes
+            self.stats["inserts"] += 1
+            # the just-inserted entry is MRU and fits on its own (rejected
+            # above otherwise) — eviction can never pop it
+            while self._used > self.max_bytes and len(self._entries) > 1:
+                _k, (_i, _a, freed) = self._entries.popitem(last=False)
+                self._used -= freed
+                self.stats["evictions"] += 1
+        return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._used = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {**self.stats, "entries": len(self._entries),
+                    "bytes": self._used, "max_bytes": self.max_bytes}
