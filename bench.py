@@ -59,7 +59,7 @@ import time
 PHASE_TIMEOUT_S = {"llm": 1800, "llm_endpoint": 1800, "kernels": 900,
                    "coldstart": 900, "coldstart_native": 900,
                    "coldstart_jax": 900, "coldstart_jax_tpu": 900,
-                   "coldstart_stream": 900}
+                   "coldstart_stream": 900, "router": 300}
 
 # share compiled XLA programs between the in-process llm phase and the
 # runner container in the endpoint phase (identical graphs → second phase
@@ -1097,6 +1097,191 @@ def bench_cold_start_jax_tpu(quick: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# phase: fleet router (ISSUE 2) — p50/p99 TTFT under mixed-tenant load with
+# affinity on vs off, and shed behavior under overload. Drives the REAL
+# FleetRouter (fair queue, affinity table, admission, signals) against a
+# simulated replica fleet whose service time models KV prefix reuse: a
+# replica serving a prompt whose prefix it has cached skips the prefill
+# cost. Pure asyncio, CPU-only, deterministic seed.
+# ---------------------------------------------------------------------------
+
+def bench_router(quick: bool = False) -> dict:
+    import asyncio
+    import random as _random
+
+    from tpu9.abstractions.common.buffer import ForwardResult
+    from tpu9.config import RouterConfig
+    from tpu9.router import FleetRouter
+    from tpu9.statestore import MemoryStore
+    from tpu9.types import ContainerState, ContainerStatus, Stub, StubConfig
+
+    N_REPLICAS = 4
+    N_REQUESTS = 120 if quick else 400
+    N_GROUPS = 12            # distinct shared prefixes in the workload
+    CACHE_GROUPS = 4         # per-replica KV capacity, in prefix groups
+    BASE_MS = 2.0            # decode/dispatch floor per request
+    PREFILL_MS = 10.0        # full prefill when the prefix is NOT cached
+    STAGGER_MS = 1.5         # request inter-arrival
+
+    class FakeFleet:
+        def __init__(self, n):
+            self.states = [ContainerState(
+                container_id=f"r{i}", stub_id="s",
+                status=ContainerStatus.RUNNING.value,
+                address=f"127.0.0.1:{9000 + i}") for i in range(n)]
+
+        async def containers_by_stub(self, stub_id, status=None):
+            return list(self.states)
+
+    def build_workload():
+        """Mixed tenants: one flooding tenant (60% of traffic, long
+        prompts), two light tenants. Seeded — both routing modes see the
+        IDENTICAL sequence."""
+        rng = _random.Random(1994)
+        out = []
+        for i in range(N_REQUESTS):
+            r = rng.random()
+            tenant = "flood" if r < 0.6 else ("chat-b" if r < 0.8 else "chat-c")
+            group = rng.randrange(N_GROUPS)
+            prefix = [group * 1000 + t for t in range(64)]   # 4 blocks of 16
+            body = json.dumps({"tokens": prefix + [90000 + i],
+                               "max_new_tokens": 16,
+                               "_group": group}).encode()
+            out.append((tenant, group, body))
+        return out
+
+    async def run_mode(affinity_on: bool) -> dict:
+        cfg = RouterConfig(default_replica_inflight=4,
+                           max_queue_depth=10000, max_queue_wait_s=30.0,
+                           affinity_block_tokens=16)
+        router = FleetRouter(cfg, MemoryStore(), FakeFleet(N_REPLICAS))
+        if not affinity_on:
+            rng = _random.Random(71)
+
+            def random_order(body, replicas, load, saturated=None):
+                out = list(replicas)
+                rng.shuffle(out)
+                return out
+
+            router.affinity.order = random_order
+        stub = Stub(stub_id="s", name="s", workspace_id="w",
+                    config=StubConfig(timeout_s=60.0))
+        # replica KV caches: group-granular LRU, bounded like a real pool
+        caches: dict[str, list] = {f"r{i}": [] for i in range(N_REPLICAS)}
+        hits = misses = 0
+
+        def forward_for(group):
+            async def forward(prefer):
+                nonlocal hits, misses
+                cid = prefer[0] if prefer else "r0"
+                cache = caches[cid]
+                if group in cache:
+                    hits += 1
+                    cache.remove(group)
+                    cost_ms = BASE_MS
+                else:
+                    misses += 1
+                    cost_ms = BASE_MS + PREFILL_MS
+                    if len(cache) >= CACHE_GROUPS:
+                        cache.pop(0)
+                cache.append(group)
+                await asyncio.sleep(cost_ms / 1000.0)
+                return ForwardResult(status=200, body=b"{}",
+                                     container_id=cid)
+            return forward
+
+        workload = build_workload()
+        ttfts: list[float] = []
+
+        async def one(tenant, group, body):
+            t0 = time.monotonic()
+            res = await router.submit(stub, tenant, body, forward_for(group))
+            assert res.status == 200
+            ttfts.append((time.monotonic() - t0) * 1000.0)
+
+        tasks = []
+        for tenant, group, body in workload:
+            tasks.append(asyncio.create_task(one(tenant, group, body)))
+            await asyncio.sleep(STAGGER_MS / 1000.0)
+        await asyncio.gather(*tasks)
+        await router.stop()
+        ttfts.sort()
+        total = hits + misses
+        return {
+            "ttft_p50_ms": round(ttfts[len(ttfts) // 2], 3),
+            "ttft_p99_ms": round(ttfts[int(len(ttfts) * 0.99) - 1], 3),
+            "kv_hit_rate": round(hits / total, 4) if total else 0.0,
+            "router_hit_rate": round(
+                router.affinity.stats()["hit_rate"], 4),
+        }
+
+    async def run_overload() -> dict:
+        """Burst past a tiny admission window: shed rate + honest 429s."""
+        cfg = RouterConfig(default_replica_inflight=1, max_queue_depth=2,
+                           max_queue_wait_s=10.0)
+        router = FleetRouter(cfg, MemoryStore(), FakeFleet(1))
+        stub = Stub(stub_id="s", name="s", workspace_id="w",
+                    config=StubConfig(timeout_s=60.0))
+
+        async def slow_forward(prefer):
+            await asyncio.sleep(0.05)
+            return ForwardResult(status=200, body=b"{}", container_id="r0")
+
+        body = json.dumps({"tokens": list(range(32))}).encode()
+        results = await asyncio.gather(*[
+            router.submit(stub, "burst", body, slow_forward)
+            for _ in range(12)])
+        await router.stop()
+        shed = [r for r in results if r.status == 429]
+        ok = [r for r in results if r.status == 200]
+        bad_headers = [r for r in shed
+                       if "Retry-After" not in dict(r.headers)]
+        return {"shed_rate": round(len(shed) / len(results), 4),
+                "served": len(ok), "shed": len(shed),
+                "sheds_missing_retry_after": len(bad_headers)}
+
+    async def run_all():
+        return (await run_mode(affinity_on=True),
+                await run_mode(affinity_on=False),
+                await run_overload())
+
+    aff, rand, overload = asyncio.run(run_all())
+
+    out = {
+        "router_ttft_p50_ms": aff["ttft_p50_ms"],
+        "router_ttft_p99_ms": aff["ttft_p99_ms"],
+        "router_ttft_random_p50_ms": rand["ttft_p50_ms"],
+        "router_ttft_random_p99_ms": rand["ttft_p99_ms"],
+        "router_kv_hit_rate": aff["kv_hit_rate"],
+        "router_kv_hit_rate_random": rand["kv_hit_rate"],
+        "router_prefix_hit_rate": aff["router_hit_rate"],
+        "router_shed_rate": overload["shed_rate"],
+        "router_overload_served": overload["served"],
+        "router_requests": N_REQUESTS,
+    }
+    violations = []
+    # affinity must not be slower than random routing (the whole point of
+    # KV-aware placement is a better TTFT; 5% tolerance for jitter)
+    if aff["ttft_p50_ms"] > rand["ttft_p50_ms"] * 1.05:
+        violations.append(
+            f"affinity p50 {aff['ttft_p50_ms']}ms slower than random "
+            f"{rand['ttft_p50_ms']}ms")
+    if aff["kv_hit_rate"] <= rand["kv_hit_rate"]:
+        violations.append(
+            f"affinity kv hit rate {aff['kv_hit_rate']} not better than "
+            f"random {rand['kv_hit_rate']}")
+    if overload["shed"] == 0 or overload["served"] == 0:
+        violations.append("overload phase did not both shed and serve")
+    if overload["sheds_missing_retry_after"]:
+        violations.append(
+            f"{overload['sheds_missing_retry_after']} sheds lacked "
+            "Retry-After")
+    out["violations"] = violations
+    out["valid"] = not violations
+    return out
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
@@ -1106,10 +1291,12 @@ def _run_phase(phase: str, quick: bool, cpu: bool) -> dict:
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", phase]
     if quick:
         cmd.append("--quick")
-    if cpu or (phase.startswith("coldstart") and phase != "coldstart_jax_tpu"):
+    if cpu or phase == "router" \
+            or (phase.startswith("coldstart") and phase != "coldstart_jax_tpu"):
         # the serving stack and its runner children must never dial the chip
         # — ALL cold-start stack phases, not just the original one (round-3
         # advisor finding: coldstart_native/coldstart_jax ran unguarded).
+        # The router phase is a pure-asyncio simulation: always CPU.
         # coldstart_jax_tpu is the exception: like llm_endpoint it forces its
         # own parent CPU and hands ONLY the runner container the tunnel env.
         cmd.append("--cpu")
@@ -1354,6 +1541,9 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
     # cold-start phases are always forced-CPU; between them, keep probing
     # for the chip so a tunnel that comes alive mid-run is still captured
     for phase, keys in (
+            ("router", ("router_ttft_p50_ms", "router_ttft_p99_ms",
+                        "router_shed_rate", "router_prefix_hit_rate",
+                        "router_kv_hit_rate")),
             ("coldstart", ("cold_start_p50_s",)),
             ("coldstart_native", ("cold_start_native_p50_s",
                                   "cold_start_native_pull_p50_s")),
@@ -1415,6 +1605,9 @@ _COMPACT_KEYS = (
     "weight_stream_fetch_s", "weight_stream_put_s",
     "cold_start_jax_restore_tpu_p50_s", "jax_restore_tpu_backend",
     "kernel_flash_ms", "kernel_paged_ms",
+    "router_ttft_p50_ms", "router_ttft_p99_ms", "router_ttft_random_p50_ms",
+    "router_shed_rate", "router_prefix_hit_rate", "router_kv_hit_rate",
+    "router_kv_hit_rate_random",
     "tpu_snapshot_file", "tpu_snapshot_captured_at",
     "tpu_snapshot_engine_tokens_per_sec_per_chip",
     "tpu_snapshot_endpoint_tokens_per_sec_per_chip",
@@ -1472,7 +1665,8 @@ def main() -> None:
     ap.add_argument("--phase",
                     choices=["llm", "llm_endpoint", "kernels", "coldstart",
                              "coldstart_native", "coldstart_jax",
-                             "coldstart_jax_tpu", "coldstart_stream"],
+                             "coldstart_jax_tpu", "coldstart_stream",
+                             "router"],
                     help="run one phase in-process (used by the orchestrator)")
     args = ap.parse_args()
 
@@ -1481,7 +1675,9 @@ def main() -> None:
         # container. Without --cpu, llm_endpoint still forces its own parent
         # process CPU internally while the container gets the chip.
         os.environ["TPU9_BENCH_CPU"] = "1"
-        if args.phase != "llm_endpoint":   # that phase force_cpu()s itself
+        # llm_endpoint force_cpu()s itself; the router phase never imports
+        # jax at all (pure asyncio simulation)
+        if args.phase not in ("llm_endpoint", "router"):
             from tpu9.utils import force_cpu
             force_cpu(host_devices=0 if (args.phase or "")
                       .startswith("coldstart") else 8)
@@ -1492,7 +1688,8 @@ def main() -> None:
               "coldstart_native": bench_cold_start_native,
               "coldstart_jax": bench_cold_start_jax,
               "coldstart_jax_tpu": bench_cold_start_jax_tpu,
-              "coldstart_stream": bench_cold_start_stream}[args.phase]
+              "coldstart_stream": bench_cold_start_stream,
+              "router": bench_router}[args.phase]
         try:
             print(json.dumps(fn(quick=args.quick)))
         except Exception as exc:   # noqa: BLE001 — phase errors are data

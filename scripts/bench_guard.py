@@ -37,6 +37,14 @@ GUARDED_FIELDS = {
     "kernel_paged_ms": "down",
     "engine_tokens_per_sec_per_chip": "up",
     "endpoint_tokens_per_sec_per_chip": "up",
+    # fleet router (ISSUE 2): TTFT under mixed-tenant load must not
+    # regress; shed rate under the fixed overload burst must not grow;
+    # prefix/KV hit rates must not collapse
+    "router_ttft_p50_ms": "down",
+    "router_ttft_p99_ms": "down",
+    "router_shed_rate": "down",
+    "router_prefix_hit_rate": "up",
+    "router_kv_hit_rate": "up",
 }
 
 

@@ -29,6 +29,19 @@ def load_bench():
     return mod
 
 
+def probe_log(line: str) -> None:
+    """Append a timestamped line to the in-repo probe log (VERDICT r05
+    "no evidence trail" gap): BENCH_PROBELOG.txt rides along with the
+    BENCH artifacts, so every round shows WHEN the tunnel was probed and
+    what it answered — a dead-tunnel round is distinguishable from a
+    never-probed one."""
+    try:
+        with open(os.path.join(REPO, "BENCH_PROBELOG.txt"), "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=300.0)
@@ -44,6 +57,7 @@ def main() -> int:
         alive = bench._tpu_alive(timeout_s=args.probe_timeout)
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
         print(f"[{stamp}] probe {attempt}: tpu_alive={alive}", flush=True)
+        probe_log(f"[{stamp}] probe {attempt}: tpu_alive={alive}")
         if alive:
             detail: dict = {"captured_by": "tpu_opportunist",
                             "captured_at": stamp}
@@ -53,6 +67,9 @@ def main() -> int:
             detail["validation"] = v
             print(f"chip phases ok={ok} on_tpu={detail.get('on_tpu')} "
                   f"violations={len(v['violations'])}", flush=True)
+            probe_log(f"[{stamp}] chip phases ok={ok} "
+                      f"on_tpu={detail.get('on_tpu')} "
+                      f"violations={len(v['violations'])}")
             if ok and detail.get("on_tpu"):
                 bench._persist("BENCH_TPU.json", detail)
                 print(json.dumps(bench.compact_line(detail)), flush=True)

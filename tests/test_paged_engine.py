@@ -85,6 +85,7 @@ def test_prefix_cache_longest_match_and_eviction():
     assert pc.held_blocks == 3
     hit = pc.lookup(prompt + [99])
     assert hit is not None and hit.n_tokens == 12
+    pc.release_pin(hit)                   # lookup pins until blocks retained
     # a diverging prompt must not match
     assert pc.lookup([7] + prompt) is None
     a.release(blocks)                     # slot retires; cache refs remain
@@ -101,6 +102,40 @@ def test_prefix_cache_longest_match_and_eviction():
     pc.insert(list(range(50, 58)), b2)    # 2 blocks
     assert pc.held_blocks <= 3
     a.release(b2)
+
+
+def test_prefix_cache_pin_blocks_eviction():
+    """Regression (ISSUE 2 satellite): evict_for_space racing a lookup.
+    An admission's lookup returns an entry; before it retains the blocks,
+    a concurrent admission running dry calls evict_for_space — which used
+    to evict the entry and release its blocks, handing the first
+    admission freed (possibly re-allocated) block ids. The lookup pin
+    must make the entry untouchable until the blocks are retained."""
+    a = BlockAllocator(8, 4)
+    pc = PrefixCache(a, max_blocks=4)
+    blocks = a.alloc(3)
+    prompt = list(range(12))
+    pc.insert(prompt, blocks)
+    a.release(blocks)                     # only the cache holds them now
+    assert a.used_count == 3
+
+    # admission A: lookup returns the (pinned) entry
+    entry = pc.lookup(prompt + [1])
+    assert entry is not None and entry.pins == 1
+
+    # admission B, interleaved: allocator is short — try to evict
+    pc.evict_for_space(8)                 # wants more than exists
+    assert pc._entries, "pinned entry was evicted out from under a lookup"
+    assert a.used_count == 3              # blocks NOT released
+
+    # A retains its shared blocks and drops the pin — now eviction may run
+    a.retain(entry.blocks)
+    pc.release_pin(entry)
+    pc.evict_for_space(8)
+    assert not pc._entries                # unpinned → evictable
+    assert a.used_count == 3              # A's retain keeps them alive
+    a.release(entry.blocks)
+    assert a.used_count == 0
 
 
 # ---------------------------------------------------------------------------

@@ -34,6 +34,9 @@ class BufferedRequest:
     body: bytes = b""
     enqueued_at: float = field(default_factory=time.monotonic)
     future: Optional[asyncio.Future] = None
+    # fleet-router replica preference (container ids, best first) — see
+    # tpu9.router.fleet: affinity/JSQ ordering computed above the buffer
+    prefer: list = field(default_factory=list)
 
 
 @dataclass
@@ -59,6 +62,9 @@ class StreamHandle:
         self.status = resp.status
         self.headers = list(resp.headers.items())
         self._closed = False
+        # optional sync callback fired once after release (the fleet
+        # router's stream budget slot rides the handle's lifetime)
+        self.on_close = None
 
     async def iter_chunks(self):
         async for chunk in self._resp.content.iter_any():
@@ -73,15 +79,22 @@ class StreamHandle:
         except Exception:      # noqa: BLE001
             pass
         await self._release()
+        if self.on_close is not None:
+            self.on_close()
 
 
 class RequestBuffer:
     def __init__(self, stub: Stub, containers: ContainerRepository,
-                 request_timeout_s: float = 180.0, router=None, dialer=None):
+                 request_timeout_s: float = 180.0, router=None, dialer=None,
+                 drain_check=None):
         self.stub = stub
         self.containers = containers
         self.router = router    # optional LlmRouter for pressure/affinity
         self.dialer = dialer    # optional cross-host Dialer (network/relay)
+        # optional (container_id) -> bool: the fleet router marks replicas
+        # draining during graceful scale-down; placing NEW work on one
+        # would be killed mid-flight moments later
+        self.drain_check = drain_check
         self.request_timeout_s = request_timeout_s
         self._queue: asyncio.Queue[BufferedRequest] = asyncio.Queue()
         self._session: Optional[aiohttp.ClientSession] = None
@@ -138,13 +151,15 @@ class RequestBuffer:
     # -- public forwarding API -----------------------------------------------
 
     async def forward(self, method: str = "POST", path: str = "/",
-                      headers=None, body: bytes = b"") -> ForwardResult:
+                      headers=None, body: bytes = b"",
+                      prefer: Optional[list] = None) -> ForwardResult:
         """``headers`` may be a dict or a list of (name, value) pairs
         (duplicates preserved)."""
         from multidict import CIMultiDict
         req = BufferedRequest(method=method, path=path,
                               headers=CIMultiDict(headers or {}), body=body,
-                              future=asyncio.get_running_loop().create_future())
+                              future=asyncio.get_running_loop().create_future(),
+                              prefer=list(prefer or []))
         self._open += 1
         req.future.add_done_callback(lambda _f: self._dec_open())
         await self._queue.put(req)
@@ -159,7 +174,8 @@ class RequestBuffer:
         self._open -= 1
 
     async def forward_stream(self, method: str = "POST", path: str = "/",
-                             headers=None, body: bytes = b""):
+                             headers=None, body: bytes = b"",
+                             prefer: Optional[list] = None):
         """Streaming forward: returns a :class:`StreamHandle` whose chunks
         arrive as the container produces them (LLM token streams), or a
         :class:`ForwardResult` on admission/connect failure. The caller
@@ -173,7 +189,7 @@ class RequestBuffer:
         # a scale-from-zero LLM cold start routinely exceeds 30s and a
         # streaming request must ride it out like any other
         target = await self.acquire(deadline_s=self.request_timeout_s,
-                                    body=body)
+                                    body=body, prefer=prefer)
         if target is None:
             self._dec_open()
             return ForwardResult(status=504,
@@ -248,7 +264,7 @@ class RequestBuffer:
                 req.future.set_result(ForwardResult(
                     status=504, body=b'{"error":"expired in queue"}'))
             return
-        target = await self._acquire_container(req.body)
+        target = await self._acquire_container(req.body, prefer=req.prefer)
         if target is None:
             # no capacity: requeue, then block on the next admission
             # signal (token release / container RUNNING) with a 250 ms
@@ -261,27 +277,38 @@ class RequestBuffer:
         asyncio.create_task(self._forward_one(req, container_id, address))
 
     async def acquire(self, deadline_s: float = 30.0,
-                      body: bytes = b"") -> Optional[tuple[str, str]]:
+                      body: bytes = b"",
+                      prefer: Optional[list] = None) -> Optional[tuple[str, str]]:
         """Public admission: wait for a container with a concurrency token
         until ``deadline_s`` elapses (websocket sessions and other direct
         consumers; HTTP requests ride the buffered _process_loop). Waiting
         is driven by admission wakeups, with a bounded fallback poll."""
         deadline = time.monotonic() + deadline_s
         while time.monotonic() < deadline:
-            target = await self._acquire_container(body)
+            target = await self._acquire_container(body, prefer=prefer)
             if target is not None:
                 return target
             await self._wait_wake(min(0.25, max(deadline
                                                 - time.monotonic(), 0.01)))
         return None
 
-    async def _acquire_container(self,
-                                 body: bytes = b"") -> Optional[tuple[str, str]]:
+    async def _acquire_container(self, body: bytes = b"",
+                                 prefer: Optional[list] = None
+                                 ) -> Optional[tuple[str, str]]:
         """Discover RUNNING containers and grab a concurrency token on one.
         Plain stubs spread randomly; LLM stubs route by pressure + prefix
-        affinity through the router."""
+        affinity through the router; the fleet router's preference order
+        (when given) takes precedence over both."""
         states = await self.containers.containers_by_stub(
             self.stub.stub_id, status=ContainerStatus.RUNNING.value)
+        if self.drain_check is not None:
+            # the router's prefer list never contains draining replicas,
+            # but the token-fallback walk below must not land on one
+            # either — its in-flight work is about to be stopped
+            alive = [s for s in states
+                     if not self.drain_check(s.container_id)]
+            # draining the LAST replica: serving it beats a guaranteed 504
+            states = alive or states
         phash = ""
         if self.router is not None:
             from ..llm import prefix_hash
@@ -290,6 +317,11 @@ class RequestBuffer:
                                             phash=phash)
         else:
             random.shuffle(states)
+        if prefer:
+            # stable sort: preferred replicas in the router's order first,
+            # everything else keeps its rank/shuffle order as fallback
+            pos = {cid: i for i, cid in enumerate(prefer)}
+            states.sort(key=lambda s: pos.get(s.container_id, len(pos)))
         limit = max(self.stub.config.concurrent_requests, 1)
         for s in states:
             address = s.address or await self.containers.get_address(

@@ -50,7 +50,7 @@ class AutoscaledInstance:
                  decide_policy, sample_extra=None,
                  entrypoint: Optional[list[str]] = None,
                  pool_selector: str = "", checkpoint_lookup=None,
-                 secret_env_fn=None, disks=None):
+                 secret_env_fn=None, disks=None, drain_cb=None):
         self.stub = stub
         self.scheduler = scheduler
         self.containers = containers
@@ -63,6 +63,10 @@ class AutoscaledInstance:
         # every container start (rotation applies on next cold start)
         self.secret_env_fn = secret_env_fn
         self.disks = disks               # Optional[DiskService]
+        # async (container_id) -> bool: graceful-drain hook invoked before
+        # a SCALE_DOWN stop (the fleet router stops routing to the replica
+        # and waits for its in-flight requests to complete)
+        self.drain_cb = drain_cb
         self._sample_extra = sample_extra   # async () -> (queue_depth, pressure)
         self.autoscaler = Autoscaler(self._sample, decide_policy, self._apply)
         self._last_active = time.monotonic()
@@ -156,9 +160,24 @@ class AutoscaledInstance:
                 return (not not_started, -s.scheduled_at)
 
             surplus = sorted(running, key=stop_order)[: current - desired]
-            for s in surplus:
+
+            async def drain_one(s) -> None:
+                # drains run CONCURRENTLY: serial waits would stall the
+                # reconcile loop up to N × drain_timeout on a multi-replica
+                # scale-down, freezing further autoscale decisions
+                if (self.drain_cb is not None
+                        and s.status == ContainerStatus.RUNNING.value):
+                    try:
+                        await self.drain_cb(s.container_id)
+                    except Exception as exc:    # noqa: BLE001 — a drain
+                        # failure must never block the scale-down itself
+                        log.warning("drain of %s failed: %s",
+                                    s.container_id, exc)
                 await self.scheduler.stop_container(
                     s.container_id, reason=StopReason.SCALE_DOWN.value)
+
+            if surplus:
+                await asyncio.gather(*(drain_one(s) for s in surplus))
 
     @staticmethod
     def _deliberate(reason: str) -> bool:

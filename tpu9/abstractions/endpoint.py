@@ -36,6 +36,7 @@ class EndpointService:
         self.runner_env = runner_env if runner_env is not None else {}
         self.runner_tokens = runner_tokens
         self.dialer = None       # Optional[tpu9.network.Dialer]
+        self.fleet_router = None  # Optional[tpu9.router.FleetRouter]
         self.instances: dict[str, "EndpointInstance"] = {}
         self._locks: dict[str, asyncio.Lock] = {}
         self._draining: set[str] = set()
@@ -65,7 +66,8 @@ class EndpointService:
                     checkpoint_lookup=latest_ckpt,
                     secret_env_fn=stub_secret_env_fn(self.backend, stub),
                     disks=getattr(self, "disks", None),
-                    dialer=self.dialer)
+                    dialer=self.dialer,
+                    fleet_router=self.fleet_router)
                 # runner env + token so LLM runners can heartbeat pressure
                 # and reach the gateway like taskqueue/function runners do
                 inst.instance.extra_env = dict(self.runner_env)
@@ -87,17 +89,21 @@ class EndpointService:
         return inst
 
     async def forward(self, stub: Stub, method: str, path: str,
-                      headers: dict, body: bytes) -> ForwardResult:
+                      headers: dict, body: bytes,
+                      prefer: Optional[list] = None) -> ForwardResult:
         inst = await self.get_or_create_instance(stub)
         return await inst.buffer.forward(method=method, path=path,
-                                         headers=headers, body=body)
+                                         headers=headers, body=body,
+                                         prefer=prefer)
 
     async def forward_stream(self, stub: Stub, method: str, path: str,
-                             headers: dict, body: bytes):
+                             headers: dict, body: bytes,
+                             prefer: Optional[list] = None):
         """StreamHandle (caller closes) or ForwardResult on failure."""
         inst = await self.get_or_create_instance(stub)
         return await inst.buffer.forward_stream(method=method, path=path,
-                                                headers=headers, body=body)
+                                                headers=headers, body=body,
+                                                prefer=prefer)
 
     async def drain_stub(self, stub_id: str) -> None:
         # mark BEFORE popping and take the creation lock: an in-flight
@@ -110,6 +116,11 @@ class EndpointService:
                 inst = self.instances.pop(stub_id, None)
             if inst:
                 await inst.shutdown()
+            if self.fleet_router is not None:
+                # tear down the router's per-stub state too (dispatcher
+                # task + fair queue) — it would otherwise outlive every
+                # drained deployment for the gateway's lifetime
+                await self.fleet_router.drop_stub(stub_id)
         finally:
             self._draining.discard(stub_id)
 
@@ -123,8 +134,10 @@ class EndpointInstance:
 
     def __init__(self, stub: Stub, scheduler: Scheduler,
                  containers: ContainerRepository, checkpoint_lookup=None,
-                 secret_env_fn=None, disks=None, dialer=None):
+                 secret_env_fn=None, disks=None, dialer=None,
+                 fleet_router=None):
         self.stub = stub
+        self.fleet_router = fleet_router
         a = stub.config.autoscaler
         self.router = None
         if a.type == AutoscalerType.TOKEN_PRESSURE.value:
@@ -138,32 +151,43 @@ class EndpointInstance:
             policy = queue_depth_policy(a.max_containers,
                                         a.tasks_per_container,
                                         a.min_containers)
-        self.buffer = RequestBuffer(stub, containers,
-                                    request_timeout_s=stub.config.timeout_s,
-                                    router=self.router, dialer=dialer)
+        self.buffer = RequestBuffer(
+            stub, containers, request_timeout_s=stub.config.timeout_s,
+            router=self.router, dialer=dialer,
+            drain_check=(fleet_router.admission.is_draining
+                         if fleet_router is not None else None))
         self.instance = AutoscaledInstance(
             stub, scheduler, containers, policy,
             sample_extra=self._sample_extra,
             checkpoint_lookup=checkpoint_lookup,
-            secret_env_fn=secret_env_fn, disks=disks)
+            secret_env_fn=secret_env_fn, disks=disks,
+            drain_cb=(fleet_router.drain_replica
+                      if fleet_router is not None else None))
         self._containers = containers
 
     async def _sample_extra(self):
         """Queue depth + pressure. Pressure prefers the engines' reported
         KV-cache pressure (heartbeated into the router's table); the
         saturation proxy (open requests over concurrency slots) covers stubs
-        without reporting runners."""
+        without reporting runners. The fleet router's front-door state is
+        folded in both ways: requests still in its fair queue are invisible
+        to the buffer, and a shedding router must read as full pressure —
+        scale-up driven by router pressure, not just raw request count."""
         depth = self.buffer.depth
+        router_pressure = 0.0
+        if self.fleet_router is not None:
+            depth += self.fleet_router.queue_depth(self.stub.stub_id)
+            router_pressure = self.fleet_router.pressure(self.stub.stub_id)
         states = await self._containers.containers_by_stub(self.stub.stub_id)
         active = len(states)
         if self.router is not None and active:
             reported = await self.router.mean_pressure(
                 [s.container_id for s in states])
             if reported > 0:
-                return depth, reported
+                return depth, max(reported, router_pressure)
         slots = max(active, 1) * max(self.stub.config.concurrent_requests, 1)
         pressure = min(depth / slots, 1.0) if active else (1.0 if depth else 0.0)
-        return depth, pressure
+        return depth, max(pressure, router_pressure)
 
     async def start(self) -> None:
         await self.buffer.start()

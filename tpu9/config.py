@@ -142,6 +142,40 @@ class ImageConfig:
 
 
 @dataclass
+class RouterConfig:
+    """Fleet inference router (``tpu9/router/`` — ISSUE 2): the front
+    door between the gateway invoke paths and engine replicas."""
+    enabled: bool = True
+    # queue-wait SLO budget: a request queued longer is shed with 503 +
+    # Retry-After (effective budget is min(this, stub timeout))
+    max_queue_wait_s: float = 30.0
+    # per-stub queued-request cap: NEW work past it is shed with 429
+    max_queue_depth: int = 256
+    # deficit-round-robin quantum (tokens) each tenant earns per ring
+    # visit; weights scale it (workspace chip quota / 4, clamped [0.5,16])
+    tenant_quantum_tokens: int = 2048
+    # in-flight budget for replicas that report no KV headroom (plain
+    # endpoints, engines mid-bring-up) — also the cold-start stampede cap
+    default_replica_inflight: int = 8
+    # hard ceiling on any replica's in-flight budget, however much KV
+    # headroom it reports
+    max_replica_inflight: int = 64
+    # worst-case tokens (prompt + decode) one admitted request may pin —
+    # divides reported free KV tokens into an in-flight budget
+    kv_tokens_per_request: int = 2048
+    # Retry-After floor when shedding with no observed service rate yet
+    shed_retry_after_s: float = 1.0
+    # prefix-affinity keying granularity (tokens per block) — match the
+    # serving EngineConfig.kv_block_size or placement and engine-level
+    # prefix reuse diverge
+    affinity_block_tokens: int = 16
+    affinity_ttl_s: float = 300.0
+    # graceful scale-down: how long a draining replica may finish its
+    # in-flight requests before the container is stopped regardless
+    drain_timeout_s: float = 10.0
+
+
+@dataclass
 class MonitoringConfig:
     metrics_enabled: bool = True
     metrics_push_url: str = ""
@@ -164,6 +198,7 @@ class AppConfig:
     cache: CacheConfig = field(default_factory=CacheConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     image: ImageConfig = field(default_factory=ImageConfig)
+    router: RouterConfig = field(default_factory=RouterConfig)
     monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
     debug: bool = False
 

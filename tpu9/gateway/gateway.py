@@ -85,10 +85,19 @@ class Gateway:
         self.runner_tokens = RunnerTokenCache(self.backend)
         # containers read this to reach us; filled once the port is bound
         self.runner_env: dict[str, str] = {}
+        # fleet inference router (ISSUE 2): KV-affinity routing, per-tenant
+        # fair queuing, SLO-aware shedding on the invoke paths
+        self.fleet_router = None
+        if cfg.router.enabled:
+            from ..router import FleetRouter
+            self.fleet_router = FleetRouter(cfg.router, self.store,
+                                            self.containers,
+                                            backend=self.backend)
         self.endpoints = EndpointService(self.backend, self.scheduler,
                                          self.containers,
                                          runner_env=self.runner_env,
                                          runner_tokens=self.runner_tokens)
+        self.endpoints.fleet_router = self.fleet_router
         self.dispatcher = Dispatcher(self.store, self.backend)
 
         async def _container_alive(container_id: str) -> bool:
@@ -459,6 +468,8 @@ class Gateway:
         self._shutting_down.set()       # FIRST: releases every long-poll
         if self.pool_monitor is not None:
             await self.pool_monitor.stop()
+        if self.fleet_router is not None:
+            await self.fleet_router.stop()
         await self.endpoints.shutdown()
         await self.taskqueues.shutdown()
         await self.functions.stop()
@@ -647,6 +658,16 @@ class Gateway:
             raw = await self.store.get(key)
             if raw:
                 out["workers"][key.rsplit(":", 1)[-1]] = json.loads(raw)
+        # per-engine serving stats (ISSUE 2 satellite): queue depth, active
+        # streams, KV headroom, prefix hit rate — heartbeated by runners
+        # into the pressure table, readable here without SSHing a node
+        out["engines"] = {}
+        for key in await self.store.keys("llm:pressure:*"):
+            snap = await self.store.hgetall(key)
+            if snap:
+                out["engines"][key.rsplit(":", 1)[-1]] = snap
+        if self.fleet_router is not None:
+            out["router"] = self.fleet_router.snapshot_all()
         return web.json_response(out)
 
     async def _events(self, request: web.Request) -> web.Response:
@@ -1749,8 +1770,25 @@ class Gateway:
                          attrs={"stub_id": stub.stub_id,
                                 "workspace_id": stub.workspace_id,
                                 "method": request.method}) as sp:
-            result = await self.endpoints.forward(stub, request.method, path,
-                                                  fwd_headers, body)
+            if self.fleet_router is not None:
+                # fleet front door: fair-queue by the CALLING tenant (a
+                # priced endpoint's external callers compete with each
+                # other, not under the owner's lane), place by KV
+                # affinity, shed with 429/503 + Retry-After
+                caller = request.get("workspace")
+                tenant = caller.workspace_id if caller else stub.workspace_id
+
+                async def _fwd(prefer):
+                    return await self.endpoints.forward(
+                        stub, request.method, path, fwd_headers, body,
+                        prefer=prefer)
+
+                result = await self.fleet_router.submit(stub, tenant, body,
+                                                        _fwd)
+            else:
+                result = await self.endpoints.forward(stub, request.method,
+                                                      path, fwd_headers,
+                                                      body)
             sp.attrs["status"] = result.status
         await self.usage.record_request(stub.workspace_id)
         # preserve the container's response headers (ASGI apps set their own
@@ -1776,8 +1814,27 @@ class Gateway:
         import aiohttp as _aiohttp
 
         from ..abstractions.common.buffer import ForwardResult
+        prefer: list = []
+        if self.fleet_router is not None:
+            # streams skip the fair queue (a token stream holds its
+            # replica for minutes) but still shed at the door and carry
+            # the router's affinity preference; their budget slot rides
+            # the handle's lifetime via on_close
+            caller = request.get("workspace")
+            tenant = caller.workspace_id if caller else stub.workspace_id
+            shed, prefer = await self.fleet_router.admit_stream(stub, tenant,
+                                                                body)
+            if shed is not None:
+                # usage records for sheds on BOTH paths: the buffered one
+                # records its 429/503s below, and metrics/billing must not
+                # diverge between the two for identical client behavior
+                await self.usage.record_request(stub.workspace_id)
+                resp = web.Response(status=shed.status, body=shed.body)
+                for k, v in shed.headers:
+                    resp.headers[k] = v
+                return resp
         handle = await self.endpoints.forward_stream(
-            stub, request.method, path, fwd_headers, body)
+            stub, request.method, path, fwd_headers, body, prefer=prefer)
         # usage records for every forwarded attempt, success or failure —
         # the buffered path does, and metrics/billing must not diverge
         # between the two for identical client behavior
@@ -1785,6 +1842,9 @@ class Gateway:
         if isinstance(handle, ForwardResult):
             return web.Response(status=handle.status, body=handle.body,
                                 content_type="application/json")
+        if self.fleet_router is not None and handle.container_id:
+            handle.on_close = self.fleet_router.stream_started(
+                stub, body, handle.container_id)
         sr = web.StreamResponse(status=handle.status)
         skip = {"connection", "transfer-encoding", "content-length",
                 "server", "date", "content-encoding"}

@@ -1,0 +1,18 @@
+"""Fleet inference router (ISSUE 2): the fleet-level front door between
+the gateway's invoke paths and engine replicas — KV-affinity routing,
+per-tenant weighted fair queuing, SLO-aware admission/shedding, and a
+signals bus feeding the metrics registry + autoscaler.
+"""
+
+from .admission import AdmissionController, ReplicaBudgets
+from .affinity import AffinityRouter, block_keys, extract_prompt_tokens
+from .fairness import QueuedRequest, TenantFairQueue, estimate_cost
+from .fleet import FleetRouter
+from .signals import RouterSignals
+
+__all__ = [
+    "AdmissionController", "AffinityRouter", "FleetRouter",
+    "QueuedRequest", "ReplicaBudgets", "RouterSignals",
+    "TenantFairQueue", "block_keys", "estimate_cost",
+    "extract_prompt_tokens",
+]

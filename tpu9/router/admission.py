@@ -1,0 +1,191 @@
+"""Admission control and load shedding for the fleet router.
+
+Three mechanisms, all cheap enough for the per-request hot path:
+
+- **Per-replica in-flight budgets** derived from the engine's OWN
+  admission headroom: the paged engine reserves worst-case KV blocks per
+  request (``paged_kv.BlockAllocator``), runners heartbeat
+  ``kv_blocks_free``/``kv_block_size`` through ``/rpc/llm/pressure``, and
+  the budget is "how many worst-case requests still fit", clamped to a
+  configured ceiling. Replicas that report nothing (plain endpoints,
+  engines mid-bring-up) get the configured default. Admitting past this
+  budget would only move the queue INSIDE the replica where fairness and
+  deadlines can no longer see it — DeepServe's (arxiv 2501.14417) core
+  argument for fleet-level admission.
+
+- **Queue-wait deadlines**: a request that waited longer than the SLO
+  budget is dead weight — serving it wastes chip time on a response the
+  client already abandoned. Shed with 503 + Retry-After.
+
+- **Shedding with honest backpressure**: when the queue is past its
+  depth cap, reject NEW work at the door with 429 + Retry-After derived
+  from observed service rate, instead of accepting it into a queue whose
+  wait already blows the deadline.
+
+Graceful drain: a replica being scaled down is marked draining — routing
+skips it, its in-flight requests complete, and the caller (the instance
+reconciler) waits for the in-flight count to hit zero before stopping
+the container.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+
+class ReplicaBudgets:
+    """In-flight slots per replica, sized from engine KV headroom."""
+
+    def __init__(self, default_inflight: int = 8,
+                 kv_tokens_per_request: int = 2048,
+                 max_inflight: int = 64):
+        self.default_inflight = max(default_inflight, 1)
+        self.kv_tokens_per_request = max(kv_tokens_per_request, 1)
+        self.max_inflight = max(max_inflight, 1)
+        self._inflight: dict[str, int] = {}
+        self._released = asyncio.Event()
+
+    def budget_from_stats(self, stats: Optional[dict]) -> int:
+        """Worst-case requests the replica's free KV pool still admits."""
+        if not stats:
+            return self.default_inflight
+        try:
+            free_blocks = float(stats.get("kv_blocks_free", -1))
+            block_s = float(stats.get("kv_block_size", 0))
+        except (TypeError, ValueError):
+            return self.default_inflight
+        if free_blocks < 0 or block_s <= 0:
+            return self.default_inflight
+        # requests already running hold their reservations, so the free
+        # pool admits headroom/worst_case MORE on top of them: budget =
+        # what's running + what still fits (floor 1 so a full replica
+        # isn't deadlocked out of the rotation forever)
+        headroom = int(free_blocks * block_s // self.kv_tokens_per_request)
+        return min(self.max_inflight,
+                   max(1, self._inflight_floor(stats) + headroom))
+
+    @staticmethod
+    def _inflight_floor(stats: dict) -> int:
+        try:
+            return int(float(stats.get("active_streams", 0)))
+        except (TypeError, ValueError):
+            return 0
+
+    def inflight(self, container_id: str) -> int:
+        return self._inflight.get(container_id, 0)
+
+    def try_acquire(self, container_id: str, budget: int) -> bool:
+        cur = self._inflight.get(container_id, 0)
+        if cur >= max(budget, 1):
+            return False
+        self._inflight[container_id] = cur + 1
+        return True
+
+    def release(self, container_id: str) -> None:
+        cur = self._inflight.get(container_id, 0)
+        if cur <= 1:
+            self._inflight.pop(container_id, None)
+        else:
+            self._inflight[container_id] = cur - 1
+        # wake every waiter; they re-check budgets (event, not condition:
+        # waiters span stubs and a spurious wake only costs one re-check)
+        self._released.set()
+
+    def notify(self) -> None:
+        """Wake budget waiters for capacity freed OUTSIDE the per-replica
+        accounting (the router's cold-start passthrough slots) — without
+        this, dispatchers blocked at the cold cap only notice a freed
+        slot at the 250 ms fallback poll."""
+        self._released.set()
+
+    async def wait_release(self, timeout: float) -> None:
+        # NOT wait_for: py3.10's wait_for swallows a cancellation that
+        # races the inner future's completion (the exact Dispatcher
+        # ._exit_loop hang PR 1 diagnosed) — a dispatcher cancelled while
+        # a release fires would keep looping uncancelled and hang stop().
+        # asyncio.wait never consumes the CancelledError.
+        self._released.clear()
+        waiter = asyncio.ensure_future(self._released.wait())
+        try:
+            await asyncio.wait({waiter}, timeout=timeout)
+        finally:
+            if not waiter.done():
+                waiter.cancel()
+                try:
+                    await waiter
+                except asyncio.CancelledError:
+                    # the waiter's own cancel; an in-flight cancellation
+                    # of THIS task resumes propagating after the finally
+                    pass
+
+
+class AdmissionController:
+    def __init__(self, budgets: ReplicaBudgets,
+                 max_queue_depth: int = 256,
+                 max_queue_wait_s: float = 30.0,
+                 shed_retry_after_s: float = 1.0):
+        self.budgets = budgets
+        self.max_queue_depth = max(max_queue_depth, 1)
+        self.max_queue_wait_s = max_queue_wait_s
+        self.shed_retry_after_s = shed_retry_after_s
+        # container_id -> drain mark expiry (bounded even if a stop never
+        # lands: the mark ages out with the container TTL)
+        self._draining: dict[str, float] = {}
+        # EWMA of request service seconds, per stub — feeds Retry-After
+        self._service_ewma: dict[str, float] = {}
+
+    # -- shedding --------------------------------------------------------------
+
+    def should_shed(self, queue_depth: int) -> bool:
+        return queue_depth >= self.max_queue_depth
+
+    def retry_after_s(self, stub_id: str, queue_depth: int,
+                      replicas: int) -> float:
+        """Honest Retry-After: the time for the current queue to drain at
+        the observed per-replica service rate. Clients that honor it come
+        back when there is actually room, instead of hammering a shedding
+        gateway into a retry storm."""
+        svc = self._service_ewma.get(stub_id, 0.0)
+        if svc <= 0 or replicas <= 0:
+            return self.shed_retry_after_s
+        est = queue_depth * svc / replicas
+        return min(max(est, self.shed_retry_after_s), 30.0)
+
+    def observe_service(self, stub_id: str, seconds: float) -> None:
+        prev = self._service_ewma.get(stub_id, 0.0)
+        self._service_ewma[stub_id] = seconds if prev <= 0 \
+            else prev * 0.8 + seconds * 0.2
+
+    def expired(self, enqueued_at: float, deadline: float = 0.0) -> bool:
+        limit = deadline or (enqueued_at + self.max_queue_wait_s)
+        return time.monotonic() > limit
+
+    # -- draining --------------------------------------------------------------
+
+    def mark_draining(self, container_id: str, ttl_s: float = 120.0) -> None:
+        self._draining[container_id] = time.monotonic() + ttl_s
+
+    def is_draining(self, container_id: str) -> bool:
+        expiry = self._draining.get(container_id)
+        if expiry is None:
+            return False
+        if time.monotonic() > expiry:
+            del self._draining[container_id]
+            return False
+        return True
+
+    async def wait_drained(self, container_id: str,
+                           timeout: float = 10.0) -> bool:
+        """True once the replica's in-flight count reaches zero (its
+        requests completed); False if the timeout elapsed first — the
+        caller stops the container anyway, in-flight requests get 502s
+        like any container death and the buffer's retry semantics apply."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.budgets.inflight(container_id) == 0:
+                return True
+            await self.budgets.wait_release(
+                min(0.25, max(deadline - time.monotonic(), 0.01)))
+        return self.budgets.inflight(container_id) == 0

@@ -1,0 +1,479 @@
+"""FleetRouter: the fleet-level front door between gateway and replicas.
+
+Request lifecycle (buffered invoke path)::
+
+    gateway._serve_stub
+      └─ FleetRouter.submit(stub, tenant, body, forward)
+           ├─ shed check (queue depth cap) ──────────────→ 429 + Retry-After
+           ├─ TenantFairQueue.put (DRR over token cost, quota-weighted)
+           └─ per-stub dispatcher task
+                ├─ queue-wait deadline ─────────────────→ 503 + Retry-After
+                ├─ replica choice: affinity (block-prefix keys) →
+                │  join-shortest-queue fallback; draining skipped
+                ├─ per-replica in-flight budget (KV headroom) gate
+                └─ forward(prefer) → RequestBuffer (per-container
+                   concurrency tokens, retries) → replica engine
+
+Streaming requests ride the same shed check and affinity preference but
+skip the fair queue: a token stream holds its replica for minutes, and
+holding its *admission* in a DRR lane would let one queued stream block
+the lane's chat traffic behind it. Budgets still count them (acquired on
+connect, released on stream close).
+
+The router is deliberately process-local state over the SHARED container
+repository: the gateway is its fleet's single front door, replicas are
+discovered from the store exactly like the request buffer does, and the
+engines' KV headroom arrives via the pressure table runners already
+heartbeat. No new wire protocol, no consensus — λScale's observation is
+that placement quality, not placement coordination, is what moves TTFT.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from ..abstractions.common.buffer import ForwardResult
+from ..types import ContainerStatus, Stub
+from .admission import AdmissionController, ReplicaBudgets
+from .affinity import AffinityRouter
+from .fairness import QueuedRequest, TenantFairQueue, estimate_cost
+from .signals import RouterSignals
+
+log = logging.getLogger("tpu9.router")
+
+PRESSURE_KEY = "llm:pressure:{cid}"     # runner heartbeat table (llm.py)
+
+
+def _shed_result(status: int, error: str, retry_after_s: float) -> ForwardResult:
+    return ForwardResult(
+        status=status,
+        body=json.dumps({"error": error,
+                         "retry_after_s": round(retry_after_s, 3)}).encode(),
+        headers=[("Retry-After", str(max(1, math.ceil(retry_after_s)))),
+                 ("Content-Type", "application/json")])
+
+
+@dataclass
+class _Pending:
+    body: bytes
+    forward: Callable[[list], Awaitable[ForwardResult]]
+    dispatched: bool = False
+
+
+@dataclass
+class _StubState:
+    stub: Stub
+    queue: TenantFairQueue
+    dispatcher: Optional[asyncio.Task] = None
+    cold_inflight: int = 0          # forwards admitted with zero replicas
+    # last observed RUNNING replica count: the shed path reads this
+    # instead of paying a store round-trip per rejected request
+    replica_count: int = 0
+    created_at: float = field(default_factory=time.monotonic)
+
+
+class FleetRouter:
+    def __init__(self, cfg, store, containers, backend=None):
+        """``cfg`` is an AppConfig.router (RouterConfig); ``backend`` is
+        the BackendDB used for workspace quota → tenant weight lookups
+        (None = every tenant weighs 1.0)."""
+        self.cfg = cfg
+        self.store = store
+        self.containers = containers
+        self.backend = backend
+        self.affinity = AffinityRouter(block_tokens=cfg.affinity_block_tokens,
+                                       ttl_s=cfg.affinity_ttl_s)
+        self.budgets = ReplicaBudgets(
+            default_inflight=cfg.default_replica_inflight,
+            kv_tokens_per_request=cfg.kv_tokens_per_request,
+            max_inflight=cfg.max_replica_inflight)
+        self.admission = AdmissionController(
+            self.budgets,
+            max_queue_depth=cfg.max_queue_depth,
+            max_queue_wait_s=cfg.max_queue_wait_s,
+            shed_retry_after_s=cfg.shed_retry_after_s)
+        self.signals = RouterSignals()
+        self._stubs: dict[str, _StubState] = {}
+        # (workspace_id) -> (weight, fetched_at): quota reads are a DB hit
+        self._weights: dict[str, tuple[float, float]] = {}
+        self._stopping = False
+        # strong refs to spawned forward tasks: the event loop only holds
+        # weak ones, and a GC'd mid-flight task would strand its future
+        # AND leak the replica's budget slot (the CacheClient._peer_put
+        # lesson from ISSUE 1)
+        self._bg_tasks: set[asyncio.Task] = set()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for stub_id in list(self._stubs):
+            await self.drop_stub(stub_id)
+
+    async def drop_stub(self, stub_id: str) -> None:
+        """Tear down one stub's router state (deployment drained/deleted):
+        cancel its dispatcher, answer still-queued submitters. Without
+        this, a long-lived gateway leaks a suspended dispatcher task per
+        stub it ever served."""
+        st = self._stubs.pop(stub_id, None)
+        if st is None:
+            return
+        if st.dispatcher is not None:
+            # re-cancel until done (PR 1's Dispatcher.stop lesson): a
+            # cancel racing an in-flight wakeup can be consumed by the
+            # loop body; one unbounded await would hang shutdown
+            while not st.dispatcher.done():
+                st.dispatcher.cancel()
+                await asyncio.wait({st.dispatcher}, timeout=1.0)
+            try:
+                st.dispatcher.exception()
+            except asyncio.CancelledError:
+                pass
+            st.dispatcher = None
+        # flush still-queued requests: their submitters must get an
+        # answer now, not hang out their whole queue-wait budget while
+        # the HTTP runner drains
+        while True:
+            req = st.queue.pop()
+            if req is None:
+                break
+            if req.future is not None and not req.future.done():
+                req.future.set_result(_shed_result(
+                    503, "deployment shutting down",
+                    self.cfg.shed_retry_after_s))
+
+    def _state(self, stub: Stub) -> Optional[_StubState]:
+        """Per-stub router state, or None once stopping — a submit racing
+        shutdown must not respawn a dispatcher nobody will ever cancel."""
+        if self._stopping:
+            return None
+        st = self._stubs.get(stub.stub_id)
+        if st is None:
+            st = _StubState(stub=stub, queue=TenantFairQueue(
+                quantum_tokens=self.cfg.tenant_quantum_tokens))
+            self._stubs[stub.stub_id] = st
+        if st.dispatcher is None or st.dispatcher.done():
+            st.dispatcher = asyncio.create_task(self._dispatch_loop(st))
+        return st
+
+    # -- autoscaler / observability feed --------------------------------------
+
+    def queue_depth(self, stub_id: str) -> int:
+        st = self._stubs.get(stub_id)
+        return st.queue.depth if st else 0
+
+    def pressure(self, stub_id: str) -> float:
+        return self.signals.pressure(stub_id)
+
+    def snapshot(self, stub_id: str) -> dict:
+        out = self.signals.snapshot(stub_id)
+        out["affinity"] = self.affinity.stats()
+        return out
+
+    def snapshot_all(self) -> dict:
+        return {stub_id: self.snapshot(stub_id) for stub_id in self._stubs}
+
+    # -- tenant weights --------------------------------------------------------
+
+    async def _tenant_weight(self, workspace_id: str) -> float:
+        """DRR weight from the workspace concurrency quota: a tenant with
+        a reserved chip cap gets front-door share proportional to it
+        (cap/4, clamped to [0.5, 16]); unlimited/unconfigured tenants
+        weigh 1.0. Cached 30 s — quota edits apply within a refresh."""
+        if self.backend is None or not workspace_id:
+            return 1.0
+        cached = self._weights.get(workspace_id)
+        now = time.monotonic()
+        if cached is not None and now - cached[1] < 30.0:
+            return cached[0]
+        weight = 1.0
+        try:
+            limit = await self.backend.get_concurrency_limit(workspace_id)
+            chips = int((limit or {}).get("tpu_chip_limit") or 0)
+            if chips > 0:
+                weight = min(max(chips / 4.0, 0.5), 16.0)
+        except Exception as exc:    # noqa: BLE001 — fairness degrades to
+            log.debug("tenant weight lookup failed: %s", exc)   # equal share
+        self._weights[workspace_id] = (weight, now)
+        return weight
+
+    # -- submit (buffered path) ------------------------------------------------
+
+    async def submit(self, stub: Stub, tenant: str, body: bytes,
+                     forward: Callable[[list], Awaitable[ForwardResult]]
+                     ) -> ForwardResult:
+        """Admit → fair-queue → dispatch → forward. ``forward`` receives
+        the router's replica preference order (container ids, best first)
+        and performs the actual buffer forward."""
+        st = self._state(stub)
+        if st is None:                  # racing shutdown
+            return _shed_result(503, "gateway shutting down",
+                                self.cfg.shed_retry_after_s)
+        if self.admission.should_shed(st.queue.depth):
+            # no store reads on the reject path: shedding must stay cheap
+            # under exactly the burst that triggers it
+            ra = self.admission.retry_after_s(stub.stub_id, st.queue.depth,
+                                              max(st.replica_count, 1))
+            self.signals.shed(stub.stub_id, tenant, "queue_full")
+            return _shed_result(429, "fleet at capacity, retry later", ra)
+
+        loop = asyncio.get_running_loop()
+        pending = _Pending(body=body, forward=forward)
+        wait_budget = min(self.cfg.max_queue_wait_s,
+                          max(stub.config.timeout_s, 1.0))
+        req = QueuedRequest(tenant=tenant, cost=estimate_cost(body),
+                            item=pending, future=loop.create_future(),
+                            deadline=time.monotonic() + wait_budget)
+        st.queue.put(req, weight=await self._tenant_weight(tenant))
+        self.signals.submitted(stub.stub_id, tenant)
+        try:
+            return await asyncio.wait_for(asyncio.shield(req.future),
+                                          wait_budget)
+        except asyncio.TimeoutError:
+            # Retry-After computed WITHOUT awaiting: an await here opens a
+            # window for the dispatcher to launch the request, and a 503
+            # set after that would double-execute on the client's retry
+            ra = self.admission.retry_after_s(stub.stub_id, st.queue.depth,
+                                              1)
+            if not pending.dispatched and not req.future.done():
+                # still queued past the SLO budget: dead weight — shed it
+                # and purge it (and any other resolved entries) from the
+                # lanes so they stop counting toward the shed depth
+                self.signals.shed(stub.stub_id, tenant, "queue_wait")
+                req.future.set_result(_shed_result(
+                    503, "queue wait exceeded deadline", ra))
+                st.queue.drop_completed()
+            # dispatched (or resolved) meanwhile: the forward's own
+            # timeout governs from here
+            return await req.future
+
+    # -- streaming path --------------------------------------------------------
+
+    async def admit_stream(self, stub: Stub, tenant: str, body: bytes
+                           ) -> tuple[Optional[ForwardResult], list[str]]:
+        """Shed check + preference order for a streaming request.
+        Returns (shed_response, prefer): shed_response is None when
+        admitted. The caller reports the serving replica via
+        :meth:`stream_started` / releases with the returned callback."""
+        st = self._state(stub)
+        if st is None:                  # racing shutdown
+            return (_shed_result(503, "gateway shutting down",
+                                 self.cfg.shed_retry_after_s), [])
+        if self.admission.should_shed(st.queue.depth):
+            ra = self.admission.retry_after_s(stub.stub_id, st.queue.depth,
+                                              max(st.replica_count, 1))
+            self.signals.shed(stub.stub_id, tenant, "queue_full")
+            return (_shed_result(429, "fleet at capacity, retry later", ra),
+                    [])
+        self.signals.submitted(stub.stub_id, tenant)
+        replicas = await self._running(stub.stub_id)
+        order, _, _ = await self._preference(stub.stub_id, body, replicas)
+        return None, order
+
+    def stream_started(self, stub: Stub, body: bytes,
+                       container_id: str) -> Callable[[], None]:
+        """Count a live stream against the replica's budget + record the
+        affinity mapping. Returns the release callback (idempotent —
+        StreamHandle.close may race teardown paths). A failed acquire
+        (replica already at its hard ceiling) must NOT release on close,
+        or every such cycle undercounts in-flight by one and admission
+        drifts past the KV-headroom budget."""
+        acquired = self.budgets.try_acquire(container_id,
+                                            self.budgets.max_inflight)
+        self.affinity.record_served(body, container_id)
+        released = not acquired
+
+        def release() -> None:
+            nonlocal released
+            if not released:
+                released = True
+                self.budgets.release(container_id)
+
+        return release
+
+    # -- drain -----------------------------------------------------------------
+
+    async def drain_replica(self, container_id: str) -> bool:
+        """Graceful scale-down: stop routing to the replica, drop its
+        affinity entries (traffic re-homes now, not at TTL), and wait for
+        its in-flight requests to complete."""
+        self.admission.mark_draining(container_id)
+        self.affinity.forget_replica(container_id)
+        drained = await self.admission.wait_drained(
+            container_id, timeout=self.cfg.drain_timeout_s)
+        if not drained:
+            log.warning("replica %s still has %d in-flight after %.1fs "
+                        "drain window — stopping anyway", container_id,
+                        self.budgets.inflight(container_id),
+                        self.cfg.drain_timeout_s)
+        return drained
+
+    # -- dispatch --------------------------------------------------------------
+
+    async def _running(self, stub_id: str) -> list:
+        states = await self.containers.containers_by_stub(
+            stub_id, status=ContainerStatus.RUNNING.value)
+        return [s for s in states
+                if not self.admission.is_draining(s.container_id)]
+
+    async def _replica_stats(self, container_id: str) -> Optional[dict]:
+        data = await self.store.hgetall(
+            PRESSURE_KEY.format(cid=container_id))
+        return data or None
+
+    async def _preference(self, stub_id: str, body: bytes, replicas: list
+                          ) -> tuple[list[str], dict[str, int], int]:
+        """(ordered container ids, per-replica budgets, fleet capacity).
+        Load for JSQ = router-tracked in-flight plus the replica's OWN
+        reported queue (requests the engine already holds)."""
+        budgets: dict[str, int] = {}
+        load: dict[str, float] = {}
+        saturated: set[str] = set()
+        # pressure snapshots are independent per replica: fetch them
+        # concurrently — N serial store round-trips per dispatch attempt
+        # (re-paid every 250 ms retry pass) would dominate TTFT on a
+        # remote store
+        all_stats = await asyncio.gather(*(
+            self._replica_stats(s.container_id) for s in replicas))
+        for s, stats in zip(replicas, all_stats):
+            cid = s.container_id
+            budgets[cid] = self.budgets.budget_from_stats(stats)
+            queued = 0.0
+            if stats:
+                try:
+                    queued = float(stats.get("queued", 0))
+                except (TypeError, ValueError):
+                    queued = 0.0
+            load[cid] = self.budgets.inflight(cid) + queued
+            if self.budgets.inflight(cid) >= budgets[cid]:
+                saturated.add(cid)
+        order = self.affinity.order(body, [s.container_id for s in replicas],
+                                    load, saturated)
+        return order, budgets, sum(budgets.values())
+
+    async def _dispatch_loop(self, st: _StubState) -> None:
+        stub_id = st.stub.stub_id
+        while True:
+            req = None
+            try:
+                req = await st.queue.get()
+                await self._dispatch_one(st, req)
+            except asyncio.CancelledError:
+                # an in-hand request (popped, not yet launched) must get
+                # an answer — its submitter would otherwise wait out the
+                # full queue budget during shutdown
+                if (req is not None and req.future is not None
+                        and not req.future.done()):
+                    req.future.set_result(_shed_result(
+                        503, "gateway shutting down",
+                        self.cfg.shed_retry_after_s))
+                raise
+            except Exception as exc:    # noqa: BLE001 — one bad request /
+                # store blip must not kill routing for the stub forever
+                log.warning("router dispatch pass failed for %s: %s",
+                            stub_id, exc)
+                # the popped request is no longer in the queue: answer it
+                # NOW with a 502 — abandoning it would hang its submitter
+                # for the whole queue-wait budget over one store blip
+                if (req is not None and req.future is not None
+                        and not req.future.done()):
+                    req.future.set_result(ForwardResult(
+                        status=502,
+                        body=json.dumps(
+                            {"error": type(exc).__name__}).encode()))
+                await asyncio.sleep(0.05)
+
+    async def _dispatch_one(self, st: _StubState, req: QueuedRequest) -> None:
+        stub_id = st.stub.stub_id
+        pending: _Pending = req.item
+        while True:
+            if req.future.done():       # caller shed/abandoned while queued
+                return
+            if self.admission.expired(req.enqueued_at, req.deadline):
+                # resolved by submit's own deadline arm; belt-and-braces
+                # for direct callers (bench drives the router without HTTP)
+                if not req.future.done():
+                    ra = self.admission.retry_after_s(stub_id, st.queue.depth,
+                                                      1)
+                    self.signals.shed(stub_id, req.tenant, "queue_wait")
+                    req.future.set_result(_shed_result(
+                        503, "queue wait exceeded deadline", ra))
+                return
+            replicas = await self._running(stub_id)
+            st.replica_count = len(replicas)
+            if req.future.done():
+                # the submitter's deadline fired during the store read:
+                # launching now would EXECUTE a request whose client was
+                # just told 503-retry — the double-execution this check
+                # exists to prevent
+                return
+            if not replicas:
+                # scale-from-zero: the buffer knows how to wait for the
+                # first container; bound the stampede so one cold stub
+                # can't hold thousands of forwards open at once
+                if st.cold_inflight < self.cfg.default_replica_inflight:
+                    self._launch(st, req, prefer=[], replica="")
+                    return
+            else:
+                order, budgets, capacity = await self._preference(
+                    stub_id, pending.body, replicas)
+                self.signals.queue_sample(stub_id, st.queue.depth, capacity)
+                if req.future.done():    # deadline racing _preference
+                    return
+                for cid in order:
+                    if self.budgets.try_acquire(cid, budgets.get(cid, 1)):
+                        self._launch(st, req, prefer=order, replica=cid)
+                        return
+            # every replica at budget (or cold cap hit): wait for a
+            # release / container event, then re-evaluate
+            await self.budgets.wait_release(0.25)
+
+    def _launch(self, st: _StubState, req: QueuedRequest,
+                prefer: list[str], replica: str) -> None:
+        pending: _Pending = req.item
+        pending.dispatched = True
+        if not replica:                 # replica slots are acquired by the
+            st.cold_inflight += 1       # dispatcher before _launch
+        wait_s = time.monotonic() - req.enqueued_at
+        self.signals.queue_wait(st.stub.stub_id, req.tenant, wait_s)
+        t = asyncio.create_task(self._forward_one(st, req, prefer, replica))
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+
+    async def _forward_one(self, st: _StubState, req: QueuedRequest,
+                           prefer: list[str], replica: str) -> None:
+        stub_id = st.stub.stub_id
+        pending: _Pending = req.item
+        t0 = time.monotonic()
+        try:
+            result = await pending.forward(prefer)
+        except Exception as exc:        # noqa: BLE001 — forward failures
+            # surface as a 502 result, never a lost future
+            log.warning("router forward failed for %s: %s", stub_id, exc)
+            result = ForwardResult(
+                status=502,
+                body=json.dumps({"error": type(exc).__name__}).encode())
+        finally:
+            if replica:
+                self.budgets.release(replica)
+            else:
+                st.cold_inflight = max(0, st.cold_inflight - 1)
+                self.budgets.notify()   # wake dispatchers at the cold cap
+        elapsed = time.monotonic() - t0
+        if result.status < 500:
+            self.admission.observe_service(stub_id, elapsed)
+            # record where the prefix ACTUALLY landed (the buffer may have
+            # fallen past the preferred replica to win a token)
+            if result.container_id:
+                self.affinity.record_served(pending.body,
+                                            result.container_id)
+        self.signals.ttft(stub_id, time.monotonic() - req.enqueued_at)
+        self.signals.affinity_sample(self.affinity.stats())
+        if req.future is not None and not req.future.done():
+            req.future.set_result(result)

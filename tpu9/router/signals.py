@@ -1,0 +1,92 @@
+"""Router signals bus: observability + autoscaler feed.
+
+Everything the router decides is exported two ways:
+
+- into the process-global metrics registry
+  (``tpu9.observability.metrics``) under ``tpu9_router_*`` — visible in
+  the gateway's ``/api/v1/metrics`` (JSON and Prometheus) without
+  SSHing a node;
+- as a live ``pressure(stub_id)`` scalar the endpoint autoscaler mixes
+  into its sample, so scale-up is driven by ROUTER pressure (queued work
+  + shed events at the front door) and not only by requests that already
+  made it into a replica buffer. A fleet that sheds is by definition
+  under-provisioned — the shed counter is the loudest scale-up signal
+  there is.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..observability import metrics
+
+
+class RouterSignals:
+    def __init__(self):
+        # per-stub rolling counters for shed-rate / pressure computation
+        self._submitted: dict[str, int] = {}
+        self._shed: dict[str, int] = {}
+        self._queue_depth: dict[str, int] = {}
+        self._capacity: dict[str, int] = {}     # replicas × budget snapshot
+        self._last_shed_ts: dict[str, float] = {}
+
+    # -- recording -------------------------------------------------------------
+
+    def submitted(self, stub_id: str, tenant: str) -> None:
+        self._submitted[stub_id] = self._submitted.get(stub_id, 0) + 1
+        metrics.inc("tpu9_router_requests_total", labels={"stub": stub_id})
+
+    def shed(self, stub_id: str, tenant: str, reason: str) -> None:
+        self._shed[stub_id] = self._shed.get(stub_id, 0) + 1
+        self._last_shed_ts[stub_id] = time.monotonic()
+        metrics.inc("tpu9_router_shed_total",
+                    labels={"stub": stub_id, "reason": reason})
+
+    def queue_sample(self, stub_id: str, depth: int, capacity: int) -> None:
+        self._queue_depth[stub_id] = depth
+        self._capacity[stub_id] = capacity
+        metrics.set_gauge("tpu9_router_queue_depth", depth,
+                          labels={"stub": stub_id})
+
+    def queue_wait(self, stub_id: str, tenant: str, seconds: float) -> None:
+        metrics.observe("tpu9_router_queue_wait_s", seconds,
+                        labels={"tenant": tenant})
+
+    def ttft(self, stub_id: str, seconds: float) -> None:
+        metrics.observe("tpu9_router_ttft_s", seconds,
+                        labels={"stub": stub_id})
+
+    def affinity_sample(self, stats: dict) -> None:
+        metrics.set_gauge("tpu9_router_prefix_hit_rate",
+                          stats.get("hit_rate", 0.0))
+        metrics.set_gauge("tpu9_router_prefix_entries",
+                          stats.get("entries", 0))
+
+    # -- reading ---------------------------------------------------------------
+
+    def shed_rate(self, stub_id: str) -> float:
+        total = self._submitted.get(stub_id, 0) + self._shed.get(stub_id, 0)
+        return self._shed.get(stub_id, 0) / total if total else 0.0
+
+    def queue_depth(self, stub_id: str) -> int:
+        return self._queue_depth.get(stub_id, 0)
+
+    def pressure(self, stub_id: str) -> float:
+        """Router pressure ∈ [0, 1+]: queued work over fleet capacity,
+        saturating to 1.0 whenever a shed happened in the last 10 s — a
+        front door that is actively turning traffic away must read as
+        fully pressured regardless of instantaneous queue depth."""
+        if time.monotonic() - self._last_shed_ts.get(stub_id, -1e9) < 10.0:
+            return 1.0
+        cap = self._capacity.get(stub_id, 0)
+        depth = self._queue_depth.get(stub_id, 0)
+        if cap <= 0:
+            return 1.0 if depth > 0 else 0.0
+        return min(depth / cap, 1.0)
+
+    def snapshot(self, stub_id: str) -> dict:
+        return {"submitted": self._submitted.get(stub_id, 0),
+                "shed": self._shed.get(stub_id, 0),
+                "shed_rate": self.shed_rate(stub_id),
+                "queue_depth": self.queue_depth(stub_id),
+                "pressure": self.pressure(stub_id)}

@@ -179,11 +179,29 @@ async def amain() -> None:
             while True:
                 try:
                     stats = engine.stats()
+                    # fleet-router / observability extras (ISSUE 2
+                    # satellite): queue depth, KV headroom, prefix-cache
+                    # hit rate — flat scalars only (the pressure table is
+                    # a store hash; nested dicts don't round-trip)
+                    extra = {"queued": stats.get("queued", 0)}
+                    for k in ("kv_blocks_free", "kv_blocks_used",
+                              "kv_blocks_reserved", "kv_block_size"):
+                        if k in stats:
+                            extra[k] = stats[k]
+                    pc = stats.get("prefix_cache")
+                    if isinstance(pc, dict):
+                        hits = pc.get("hits", 0)
+                        misses = pc.get("misses", 0)
+                        extra["prefix_hits"] = hits
+                        extra["prefix_misses"] = misses
+                        extra["prefix_hit_rate"] = (
+                            hits / (hits + misses) if hits + misses else 0.0)
                     async with session.post(
                             gateway_url + "/rpc/llm/pressure",
                             json={"container_id": cfg.container_id,
                                   "token_pressure": stats["token_pressure"],
-                                  "active_streams": stats["active_streams"]},
+                                  "active_streams": stats["active_streams"],
+                                  "extra": extra},
                             timeout=aiohttp.ClientTimeout(total=5)) as resp:
                         if resp.status >= 400 and not rejected_logged:
                             rejected_logged = True

@@ -666,6 +666,9 @@ class InferenceEngine:
             out["kv_blocks_used"] = self.allocator.used_count
             out["kv_blocks_free"] = self.allocator.free_count
             out["kv_blocks_reserved"] = self.allocator.reserved
+            # the fleet router divides free tokens (blocks × size) into
+            # an in-flight admission budget — see tpu9.router.admission
+            out["kv_block_size"] = self.allocator.block_s
             out["queued"] += len(self._wait_room)
             out["prefix_cache"] = self.prefix_cache.stats()
             # admission pressure for the router: reserved fraction is the
@@ -709,6 +712,10 @@ class InferenceEngine:
         # blocks is value-safe.
         p -= p % self._chunk
         self.allocator.retain(shared)
+        if entry is not None:
+            # blocks are retained: a concurrent admission's eviction can
+            # no longer free them under us — drop the lookup pin
+            self.prefix_cache.release_pin(entry)
 
         total_blocks = blocks_for(n + 1, bs)
         fresh = self._alloc_blocks(total_blocks - len(shared))

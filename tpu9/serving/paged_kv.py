@@ -37,6 +37,9 @@ class PrefixEntry:
     blocks: list[int]          # full, block-aligned prefix blocks (shared)
     n_tokens: int
     last_used: float = field(default_factory=time.monotonic)
+    # admissions holding this entry between lookup() and retaining its
+    # blocks: eviction must not release blocks out from under them
+    pins: int = 0
 
 
 class BlockAllocator:
@@ -127,19 +130,30 @@ class PrefixCache:
     def lookup(self, prompt: list[int]) -> Optional[PrefixEntry]:
         """Longest cached block-aligned strict prefix of ``prompt``.
         Strict: at least one prompt token must remain to prefill, because
-        admission samples the first output from the suffix's logits."""
+        admission samples the first output from the suffix's logits.
+
+        The returned entry is PINNED: a concurrent admission's
+        ``evict_for_space`` (interleaved at any await point) must not
+        release the blocks before the caller retains them. Call
+        :meth:`release_pin` once the blocks are retained (or the entry is
+        abandoned)."""
         bs = self.allocator.block_s
         nb = (len(prompt) - 1) // bs
         while nb > 0:
             entry = self._entries.get(self._key(prompt[:nb * bs]))
             if entry is not None:
                 entry.last_used = time.monotonic()
+                entry.pins += 1
                 self.hits += 1
                 self.tokens_reused += entry.n_tokens
                 return entry
             nb -= 1
         self.misses += 1
         return None
+
+    def release_pin(self, entry: PrefixEntry) -> None:
+        entry.pins -= 1
+        assert entry.pins >= 0, "unbalanced prefix-cache pin release"
 
     def insert(self, prompt: list[int], slot_blocks: list[int]) -> None:
         """Register the prompt's full-block prefix, sharing the slot's
@@ -162,13 +176,18 @@ class PrefixCache:
         self._evict_to_budget()
 
     def _evict_to_budget(self) -> None:
-        while self.held_blocks > self.max_blocks and self._entries:
-            self._evict_one()
+        while self.held_blocks > self.max_blocks and self._evict_one():
+            pass
 
     def _evict_one(self) -> bool:
-        if not self._entries:
+        """Evict the LRU *unpinned* entry. Pinned entries (a lookup
+        handed their blocks to an admission that hasn't retained them
+        yet) are untouchable — evicting one would release blocks another
+        coroutine is about to splice into a slot."""
+        victims = [e for e in self._entries.values() if e.pins == 0]
+        if not victims:
             return False
-        oldest = min(self._entries.values(), key=lambda e: e.last_used)
+        oldest = min(victims, key=lambda e: e.last_used)
         del self._entries[oldest.key]
         self.allocator.release(oldest.blocks)
         return True
