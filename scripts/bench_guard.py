@@ -45,6 +45,12 @@ GUARDED_FIELDS = {
     "router_shed_rate": "down",
     "router_prefix_hit_rate": "up",
     "router_kv_hit_rate": "up",
+    # speculative decoding (ISSUE 5): the repetitive-workload uplift must
+    # not decay back toward 1.0, and the adversarial auto-disable must
+    # keep holding the ratio near parity
+    "spec_uplift_repetitive": "up",
+    "spec_adversarial_ratio": "up",
+    "spec_tokens_per_sec_on_repetitive": "up",
 }
 
 

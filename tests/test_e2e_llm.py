@@ -65,6 +65,11 @@ async def test_llm_endpoint_generates_and_heartbeats():
             await asyncio.sleep(0.5)
         assert seen is not None, "no pressure heartbeat arrived"
         assert "token_pressure" in seen
+        # speculative-decoding acceptance rides the same heartbeat (ISSUE
+        # 5): present for every engine (0.0 when speculation is off) so
+        # /api/v1/metrics' engines section and the router's fleet-wide
+        # tpu9_router_spec_* gauges always have the field
+        assert "spec_acceptance_rate" in seen
 
         # bad request surfaces cleanly
         status, bad = await stack.api("POST", "/endpoint/llm",

@@ -526,3 +526,22 @@ async def test_pressure_signal_feeds_autoscaler():
     results = await asyncio.gather(*tasks)
     assert sorted(r.status for r in results) == [200] * 4 + [429] * 2
     await router.stop()
+
+
+def test_spec_sample_aggregates_fleet_acceptance():
+    """ISSUE 5: heartbeated per-engine spec counters fold into one
+    fleet-wide acceptance rate (tpu9_router_spec_* + router snapshot)."""
+    from tpu9.router.signals import RouterSignals
+    sig = RouterSignals()
+    sig.spec_sample([
+        {"spec_proposed": "800", "spec_accepted": "600"},   # store hashes
+        {"spec_proposed": 200, "spec_accepted": 100},       # are stringly
+        None,                                               # dead replica
+        {"queued": 3},                                      # spec off
+    ])
+    snap = sig.snapshot("s")
+    assert snap["fleet_spec_proposed"] == 1000
+    assert snap["fleet_spec_accepted"] == 700
+    assert snap["fleet_spec_acceptance_rate"] == 0.7
+    from tpu9.observability.metrics import metrics
+    assert metrics.gauges.get("tpu9_router_spec_acceptance_rate") == 0.7

@@ -1,0 +1,285 @@
+"""Speculative decoding (ISSUE 5): prompt-lookup drafts + batched
+on-device verify in the serving engine.
+
+The invariant everything here leans on: the verify graph emits the
+MODEL'S OWN tokens at every position and accepts a draft only where it
+equals that output — so the generated stream is exactly what classic
+decode produces, token for token, for any draft quality. These tests run
+the tiny model at float32: bf16 random-weight logits carry exact ties
+whose argmax legitimately breaks differently between the decode and
+verify graph shapes (the bench's oracle-margin check covers that case).
+"""
+
+import asyncio
+import random
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu9.models import init_decoder
+from tpu9.models.llama import LLAMA_PRESETS
+from tpu9.serving.engine import EngineConfig, InferenceEngine
+from tpu9.serving.spec import NGramProposer, SlotSpecState, build_drafts
+
+TINY = replace(LLAMA_PRESETS["llama-tiny"], dtype=jnp.float32)
+
+# a prompt whose greedy trajectory turns repetitive early enough for
+# speculation to engage within a ~200-token generation (the model drifts
+# into a short cycle the n-gram proposer locks onto)
+CYCLER = [7, 8, 9, 7, 8, 9, 7, 8]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_decoder(jax.random.PRNGKey(0), TINY)
+
+
+def _engine(params, spec_len=8, paged=False, max_batch=2, eos_id=-1,
+            **kw):
+    base = dict(max_batch=max_batch, max_seq_len=512,
+                prefill_buckets=(32, 64), decode_steps=(1, 4, 8),
+                spec_len=spec_len, eos_id=eos_id)
+    if paged:
+        base.update(kv_block_size=32, kv_pool_blocks=0, prefill_chunk=32)
+    base.update(kw)
+    return InferenceEngine(params, TINY, EngineConfig(**base))
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _generate(engine, prompts, max_new):
+    async def go():
+        await engine.start()
+        outs = await asyncio.gather(*[
+            engine.generate(list(p), max_new_tokens=max_new)
+            for p in prompts])
+        await engine.stop()
+        return outs
+
+    return _run(go())
+
+
+# ---------------------------------------------------------------------------
+# greedy parity: spec on == spec off, dense and paged
+# ---------------------------------------------------------------------------
+
+def test_greedy_parity_dense(params):
+    prompts = [CYCLER, list(range(2, 40))]
+    classic = _generate(_engine(params, spec_len=0), prompts, 200)
+    spec_eng = _engine(params, spec_len=8)
+    spec = _generate(spec_eng, prompts, 200)
+    assert spec == classic
+    st = spec_eng.stats()
+    # parity is vacuous if speculation never engaged
+    assert st["spec_windows"] > 0 and st["spec_accepted"] > 0, st
+
+
+def test_greedy_parity_paged(params):
+    prompts = [CYCLER, list(range(2, 40))]
+    classic = _generate(_engine(params, spec_len=0, paged=True),
+                        prompts, 200)
+    dense_classic = _generate(_engine(params, spec_len=0), prompts, 200)
+    spec_eng = _engine(params, spec_len=8, paged=True)
+    spec = _generate(spec_eng, prompts, 200)
+    # the same stream three ways: dense classic, paged classic, paged spec
+    assert spec == classic == dense_classic
+    st = spec_eng.stats()
+    assert st["spec_windows"] > 0 and st["spec_accepted"] > 0, st
+
+
+# ---------------------------------------------------------------------------
+# EOS inside an accepted draft run
+# ---------------------------------------------------------------------------
+
+def test_eos_inside_accepted_run(params):
+    # find a token the trajectory emits late enough that speculation is
+    # already engaged, then make it EOS: the verify window accepts a run
+    # CONTAINING the EOS and the host must stop delivery exactly there
+    ref = _generate(_engine(params, spec_len=0), [CYCLER], 200)[0]
+    # the EOS must FIRST occur late enough that speculation has engaged
+    eos = max(set(ref), key=ref.index)
+    stop_at = ref.index(eos)
+    assert stop_at > 60, (eos, stop_at)
+    classic = _generate(_engine(params, spec_len=0, eos_id=eos),
+                        [CYCLER], 200)[0]
+    spec_eng = _engine(params, spec_len=8, eos_id=eos)
+    spec = _generate(spec_eng, [CYCLER], 200)[0]
+    assert spec == classic == ref[:stop_at + 1]
+    assert spec[-1] == eos
+    st = spec_eng.stats()
+    assert st["spec_windows"] > 0, st
+    # the engine is idle again: slot freed, cache reset
+    assert not spec_eng.active.any()
+    assert int(spec_eng._host_len.sum()) == 0
+
+
+# ---------------------------------------------------------------------------
+# cancel mid-stream during speculative windows
+# ---------------------------------------------------------------------------
+
+def test_cancel_during_spec_window(params):
+    eng = _engine(params, spec_len=8)
+
+    async def go():
+        await eng.start()
+        req = await eng.generate(list(CYCLER), max_new_tokens=400,
+                                 stream=True)
+        got = []
+        while len(got) < 40:            # well into speculative territory
+            tok = await req.queue.get()
+            assert tok is not None
+            got.append(tok)
+        eng.cancel_request(req)
+        # drain to the terminator the retire path must deliver
+        while await req.queue.get() is not None:
+            pass
+        await req.done.wait()
+        for _ in range(50):             # serve loop notices at next sync
+            if not eng.active.any():
+                break
+            await asyncio.sleep(0.02)
+        assert not eng.active.any()
+        assert eng.slot_req[0] is None
+        await eng.stop()
+        return got
+
+    got = _run(go())
+    assert len(got) >= 40
+
+
+# ---------------------------------------------------------------------------
+# acceptance-EWMA auto-disable
+# ---------------------------------------------------------------------------
+
+def test_ewma_auto_disable_gate(params):
+    eng = _engine(params, spec_len=8, spec_probe_every=0)
+    # occupy a slot by hand so the gate sees a live, proposing stream
+    from tpu9.serving.spec import make_slot_state
+    from tpu9.serving.engine import _Request
+    req = _Request(request_id="r", prompt=list(CYCLER), max_new_tokens=64)
+    eng.slot_req[0] = req
+    eng.active[0] = True
+    eng._spec_slots[0] = make_slot_state(req.prompt)
+    st = eng._spec_slots[0]
+    assert eng._spec_gate(8) == 8            # optimistic start: speculate
+    for _ in range(8):
+        st.observe(8, 0)                     # drafts keep getting rejected
+    assert st.ewma < eng.ecfg.spec_min_accept
+    assert eng._spec_gate(8) == 0            # auto-disabled
+    # probes force one verify window per spec_probe_every classic windows
+    eng2 = _engine(params, spec_len=8, spec_probe_every=3)
+    eng2.slot_req[0] = req
+    eng2.active[0] = True
+    eng2._spec_slots[0] = make_slot_state(req.prompt)
+    for _ in range(8):
+        eng2._spec_slots[0].observe(8, 0)
+    picks = [eng2._spec_gate(8) for _ in range(6)]
+    assert picks == [0, 0, 8, 0, 0, 8]
+    # recovery without probes: shadow observations of matching drafts
+    for _ in range(8):
+        st.observe(8, 8)
+    assert eng._spec_gate(8) == 8
+
+
+def test_shadow_scoring_recovers_ewma(params):
+    """A stream that TURNS repetitive mid-flight re-enables speculation
+    with no probe windows: classic windows shadow-score the proposer
+    against their own output."""
+    eng = _engine(params, spec_len=8, spec_probe_every=0)
+    out = _generate(eng, [CYCLER], 300)[0]
+    assert len(out) == 300
+    st = eng.stats()
+    # the trajectory cycles late; shadows must have re-opened the gate
+    assert st["spec_windows"] > 0 and st["spec_accepted"] > 0, st
+
+
+def test_adversarial_prompt_mostly_classic(params):
+    """Random prompts leave nothing for prompt lookup: the gate must keep
+    verify passes to a small fraction of the decode work."""
+    rng = random.Random(11)
+    prompts = [[rng.randrange(1, 500) for _ in range(40)]
+               for _ in range(2)]
+    eng = _engine(params, spec_len=8)
+    outs = _generate(eng, prompts, 96)
+    assert all(len(o) == 96 for o in outs)
+    st = eng.stats()
+    spec_tokens = st["spec_windows"] * (eng.ecfg.spec_len + 1)
+    assert spec_tokens <= st["decode_steps"], st
+
+
+# ---------------------------------------------------------------------------
+# n-gram proposer: property tests against a brute-force reference
+# ---------------------------------------------------------------------------
+
+def _brute_propose(tokens, k, max_n=3, min_n=2):
+    end = len(tokens)
+    for n in range(max_n, min_n - 1, -1):
+        if end < n:
+            continue
+        suffix = tokens[end - n:end]
+        pos = None
+        for start in range(end - n - 1, -1, -1):   # latest PRIOR occurrence
+            if tokens[start:start + n] == suffix:
+                pos = start + n
+                break
+        if pos is None:
+            continue
+        draft = tokens[pos:pos + k]
+        period = end - pos
+        while len(draft) < k:
+            draft.append(draft[len(draft) - period])
+        return draft
+    return []
+
+
+def test_proposer_matches_brute_force():
+    rng = random.Random(1994)
+    for trial in range(60):
+        vocab = rng.choice([3, 6, 20])           # small vocab → many repeats
+        n = rng.randrange(4, 120)
+        toks = [rng.randrange(vocab) for _ in range(n)]
+        p = NGramProposer(toks)
+        for k in (1, 4, 8):
+            got = p.propose(k)
+            want = _brute_propose(list(toks), k)
+            assert got == want, (trial, toks, k, got, want)
+            assert len(got) in (0, k)
+
+
+def test_proposer_incremental_equals_bulk():
+    rng = random.Random(7)
+    toks = [rng.randrange(5) for _ in range(200)]
+    bulk = NGramProposer(list(toks))
+    inc = NGramProposer([])
+    for t in toks:
+        inc.append(t)
+    for k in (2, 8):
+        assert bulk.propose(k) == inc.propose(k)
+
+
+def test_proposer_cycle_extrapolation():
+    # period-3 cycle: a draft longer than the remaining history must
+    # continue the cycle, not truncate
+    p = NGramProposer([1, 2, 3, 1, 2, 3, 1, 2, 3])
+    assert p.propose(6) == [1, 2, 3, 1, 2, 3]
+    p2 = NGramProposer([9] * 10)
+    assert p2.propose(4) == [9, 9, 9, 9]
+
+
+def test_build_drafts_padding_and_counts():
+    states = [SlotSpecState(proposer=NGramProposer([1, 2, 3, 1, 2, 3])),
+              None,
+              SlotSpecState(proposer=NGramProposer([4, 5, 6]))]
+    active = np.array([True, True, True])
+    drafts, n_real = build_drafts(states, active, 4)
+    assert drafts.shape == (3, 4)
+    assert n_real.tolist() == [4, 0, 0]      # slot 2 has no prior n-gram
+    assert drafts[1].tolist() == [0, 0, 0, 0]
+    inactive = np.array([False, True, True])
+    drafts2, n_real2 = build_drafts(states, inactive, 4)
+    assert n_real2.tolist() == [0, 0, 0]

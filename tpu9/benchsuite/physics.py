@@ -60,6 +60,16 @@ def chip_spec(device_kind: str) -> ChipSpec:
 # decode (autoregressive, weight-streaming-bound)
 # ---------------------------------------------------------------------------
 
+def _ratio(x: float) -> float:
+    """Round a utilization ratio to 4 SIGNIFICANT digits, not 4 decimal
+    places: a CPU bench run judged against the generous unknown-chip
+    ceiling produces honest ratios in the 1e-5 range, and fixed-point
+    rounding collapsed them to a flat 0.0 in BENCH_DETAIL.json — which
+    reads as 'no evidence' instead of 'tiny but real' (ISSUE 5
+    satellite)."""
+    return float(f"{x:.4g}")
+
+
 def decode_physics(*, step_ms: float, batch: int, streamed_bytes: int,
                    kv_bytes_per_step: int, matmul_params: int,
                    attn_flops_per_step: float = 0.0,
@@ -86,10 +96,10 @@ def decode_physics(*, step_ms: float, batch: int, streamed_bytes: int,
         "step_ms": round(step_ms, 4),
         "bytes_per_step": bytes_per_step,
         "flops_per_step": int(flops_per_step),
-        "achieved_gbps": round(achieved_gbps, 2),
-        "achieved_tflops": round(achieved_tflops, 3),
-        "mbu": round(mbu, 4),
-        "mfu": round(mfu, 4),
+        "achieved_gbps": _ratio(achieved_gbps),
+        "achieved_tflops": _ratio(achieved_tflops),
+        "mbu": _ratio(mbu),
+        "mfu": _ratio(mfu),
         "min_step_ms_bandwidth": round(bytes_per_step / spec.hbm_gbps / 1e6, 4),
     }
 
@@ -103,10 +113,10 @@ def matmul_physics(*, elapsed_ms: float, flops: float, bytes_moved: int,
     return {
         "chip": spec.name,
         "elapsed_ms": round(elapsed_ms, 4),
-        "achieved_tflops": round(achieved_tflops, 3),
-        "achieved_gbps": round(achieved_gbps, 2),
-        "mfu": round(achieved_tflops / spec.peak_bf16_tflops, 4),
-        "mbu": round(achieved_gbps / spec.hbm_gbps, 4),
+        "achieved_tflops": _ratio(achieved_tflops),
+        "achieved_gbps": _ratio(achieved_gbps),
+        "mfu": _ratio(achieved_tflops / spec.peak_bf16_tflops),
+        "mbu": _ratio(achieved_gbps / spec.hbm_gbps),
     }
 
 

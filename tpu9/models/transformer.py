@@ -160,6 +160,22 @@ def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
         v_pool = kv_cache["v"][layer_idx].at[bi, oi].set(v[:, 0])
         out = paged_attention_dispatch(q, k_pool, v_pool, table, cache_len)
         new_cache = (k_pool, v_pool)
+    elif "table" in kv_cache:
+        # paged multi-token VERIFY (speculative decoding): scatter all T
+        # window tokens' k/v into the slots' physical pool blocks in one
+        # shot, then attend each query over its own absolute-position
+        # prefix. Rejected draft positions simply hold garbage KV after
+        # the window — attention masks by position, and the next window's
+        # writes overwrite them (paged scratch re-splice semantics).
+        from ..ops.attention import paged_verify_attention
+        table = kv_cache["table"]                      # [B, MB]
+        bs = kv_cache["k"].shape[2]                    # [L,N,BS,KH,D]
+        bi = jnp.take_along_axis(table, positions // bs, axis=1)  # [B,T]
+        oi = positions % bs
+        k_pool = kv_cache["k"][layer_idx].at[bi, oi].set(k)
+        v_pool = kv_cache["v"][layer_idx].at[bi, oi].set(v)
+        out = paged_verify_attention(q, k_pool, v_pool, table, positions)
+        new_cache = (k_pool, v_pool)
     elif decode:
         # scatter this token's k/v at positions, then attend over the prefix
         k_cache = jax.lax.dynamic_update_slice(

@@ -234,6 +234,32 @@ def chunk_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     return out.astype(q.dtype)
 
 
+def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, block_table: jnp.ndarray,
+                           positions: jnp.ndarray) -> jnp.ndarray:
+    """Multi-token attention against the paged pool for one speculative
+    VERIFY pass: q [B, T, QH, D] are the window's queries at absolute
+    ``positions`` [B, T]; k/v_pool [N, BS, KH, D] already contain the
+    window's keys (scattered by the caller).
+
+    Each slot's block-table row is densified with an XLA gather and the
+    per-query position mask (key_pos <= q_pos) hides everything past each
+    query — including the trash column and table padding, whose key
+    positions exceed every real query position by construction. One
+    forward verifies ``T = 1 + spec_len`` positions for the whole batch,
+    which is the entire point of speculative decoding in the
+    bandwidth-bound decode regime: the weight stream is paid once for T
+    tokens instead of once per token. (A pallas kernel that walks the
+    table without the densify copy is the on-chip optimization path; the
+    gather form is the correctness-first dispatch every backend runs.)"""
+    b = q.shape[0]
+    mb, bs = block_table.shape[1], k_pool.shape[1]
+    kh, d = k_pool.shape[2], k_pool.shape[3]
+    k = k_pool[block_table].reshape(b, mb * bs, kh, d)
+    v = v_pool[block_table].reshape(b, mb * bs, kh, d)
+    return chunk_prefill_attention(q, k, v, positions)
+
+
 def paged_attention_dispatch(q: jnp.ndarray, k_pool: jnp.ndarray,
                              v_pool: jnp.ndarray, block_table: jnp.ndarray,
                              cache_len: jnp.ndarray) -> jnp.ndarray:

@@ -341,6 +341,11 @@ class FleetRouter:
         # remote store
         all_stats = await asyncio.gather(*(
             self._replica_stats(s.container_id) for s in replicas))
+        # fold the heartbeated speculative-decoding counters into the
+        # fleet-wide tpu9_router_spec_* gauges (ISSUE 5) — this is the
+        # dispatch path, so the signal refreshes exactly as often as the
+        # stats it is derived from
+        self.signals.spec_sample(all_stats)
         for s, stats in zip(replicas, all_stats):
             cid = s.container_id
             budgets[cid] = self.budgets.budget_from_stats(stats)

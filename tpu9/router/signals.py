@@ -29,6 +29,9 @@ class RouterSignals:
         self._queue_depth: dict[str, int] = {}
         self._capacity: dict[str, int] = {}     # replicas × budget snapshot
         self._last_shed_ts: dict[str, float] = {}
+        # fleet speculative-decoding counters (latest heartbeat fold)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
     # -- recording -------------------------------------------------------------
 
@@ -62,6 +65,28 @@ class RouterSignals:
         metrics.set_gauge("tpu9_router_prefix_entries",
                           stats.get("entries", 0))
 
+    def spec_sample(self, replica_stats: list) -> None:
+        """Fleet-wide speculative-decoding acceptance (ISSUE 5): fold the
+        heartbeated per-engine ``spec_proposed``/``spec_accepted``
+        counters into one ratio — the signal that says whether the
+        fleet's traffic is actually repetitive enough for prompt-lookup
+        speculation to pay for its verify compute."""
+        proposed = accepted = 0
+        for stats in replica_stats:
+            if not stats:
+                continue
+            try:
+                proposed += int(float(stats.get("spec_proposed", 0)))
+                accepted += int(float(stats.get("spec_accepted", 0)))
+            except (TypeError, ValueError):
+                continue
+        self._spec_proposed = proposed
+        self._spec_accepted = accepted
+        metrics.set_gauge("tpu9_router_spec_proposed", proposed)
+        metrics.set_gauge("tpu9_router_spec_accepted", accepted)
+        metrics.set_gauge("tpu9_router_spec_acceptance_rate",
+                          accepted / proposed if proposed else 0.0)
+
     # -- reading ---------------------------------------------------------------
 
     def shed_rate(self, stub_id: str) -> float:
@@ -89,4 +114,12 @@ class RouterSignals:
                 "shed": self._shed.get(stub_id, 0),
                 "shed_rate": self.shed_rate(stub_id),
                 "queue_depth": self.queue_depth(stub_id),
-                "pressure": self.pressure(stub_id)}
+                "pressure": self.pressure(stub_id),
+                # fleet_ prefix: every other field is per-stub, but the
+                # speculation counters fold ALL heartbeating replicas —
+                # summing snapshots across stubs must not double-count
+                "fleet_spec_proposed": self._spec_proposed,
+                "fleet_spec_accepted": self._spec_accepted,
+                "fleet_spec_acceptance_rate": (
+                    self._spec_accepted / self._spec_proposed
+                    if self._spec_proposed else 0.0)}

@@ -47,9 +47,13 @@ def _build_engine(obj):
         # compile-ahead: the serving graphs AOT-compile from the preset's
         # abstract shapes concurrently with weight materialization, so the
         # post-build warmup() below dispatches precompiled executables
-        # instead of serializing XLA behind the weight load
+        # instead of serializing XLA behind the weight load.
+        # TPU9_SPEC_LEN opts the deployment into self-speculative decoding
+        # (prompt-lookup drafts, ISSUE 5) without a handler change —
+        # greedy output is identical either way, only tokens/sec moves
         from ..serving.presets import load_engine
-        return load_engine(obj, compile_ahead=True)
+        spec_len = int(os.environ.get("TPU9_SPEC_LEN", "0") or 0)
+        return load_engine(obj, compile_ahead=True, spec_len=spec_len)
     raise TypeError(f"handler must return an engine, (params, cfg) or a "
                     f"preset name; got {type(obj)}")
 
@@ -185,7 +189,12 @@ async def amain() -> None:
                     # a store hash; nested dicts don't round-trip)
                     extra = {"queued": stats.get("queued", 0)}
                     for k in ("kv_blocks_free", "kv_blocks_used",
-                              "kv_blocks_reserved", "kv_block_size"):
+                              "kv_blocks_reserved", "kv_block_size",
+                              # speculative-decoding acceptance (ISSUE 5):
+                              # the router aggregates these into the
+                              # fleet-wide tpu9_router_spec_* signals
+                              "spec_proposed", "spec_accepted",
+                              "spec_acceptance_rate"):
                         if k in stats:
                             extra[k] = stats[k]
                     pc = stats.get("prefix_cache")

@@ -1,10 +1,12 @@
 """LLM inference engine: continuous batching over jitted prefill/decode.
 
-TPU-first rationale: the engine compiles exactly two graphs per shape bucket —
-``prefill(tokens[1, Tpad])`` and ``decode(tokens[B,1])`` — and keeps the KV
-cache as a persistent on-device buffer donated through every decode step, so
-steady-state decoding is one fused XLA computation per token across the whole
-batch with zero host↔device traffic except the sampled ids.
+TPU-first rationale: the engine compiles a small fixed set of graphs per
+shape bucket — ``prefill(tokens[1, Tpad])``, ``decode(tokens[B,1])`` windows
+(k steps per host sync) and, with speculation on, ``verify(tokens[B,1+s])``
+(prompt-lookup drafts checked in ONE batched forward, ISSUE 5) — and keeps
+the KV cache as a persistent on-device buffer donated through every step, so
+steady-state decoding is one fused XLA computation per WINDOW across the
+whole batch with zero host↔device traffic except the sampled ids.
 
 Slots: fixed max_batch decode lanes. New requests prefill (bucketed lengths to
 bound compile count), then join the decode batch at their slot index. This is
@@ -73,6 +75,47 @@ class EngineConfig:
     # groups so a long admission doesn't starve the decode batch.
     # 1 = one dispatch per chunk (legacy shape, still no per-chunk sync)
     admit_group_chunks: int = 4
+    # ---- speculative decoding (ISSUE 5) ----
+    # max draft tokens per verify window (prompt-lookup n-gram drafts,
+    # tpu9/serving/spec.py); 0 disables speculation. One batched forward
+    # verifies [B, 1+spec_len] positions — in the bandwidth-bound decode
+    # regime that pass costs ~one decode step of HBM traffic, so every
+    # accepted draft token is nearly free.
+    spec_len: int = 0
+    # acceptance-EWMA floor (mean EFFECTIVE acceptance over active slots,
+    # non-proposing slots counting 0): below it the serve loop falls back
+    # to classic windowed decode so adversarial prompts never regress
+    # past a probe's worth of wasted verify compute. The measured CPU
+    # break-even for spec_len=8 is ~0.25 (verify ≈ 2.6-3 decode steps);
+    # the floor sits above it so the gate only admits windows that WIN,
+    # not ones that tread water while paying scheduling overhead. On TPU
+    # the bandwidth-bound verify is ~1 step, so the floor is conservative
+    spec_min_accept: float = 0.35
+    # after auto-disable, force one speculative window every N classic
+    # windows regardless of the EWMA. 0 (default) disables forced probes:
+    # classic windows SHADOW-SCORE the proposer against their own output
+    # (see _Window.shadow), so the EWMA recovers for free the moment a
+    # stream turns repetitive — blind probe windows would only burn
+    # verify compute re-learning what the shadows already measured
+    spec_probe_every: int = 0
+
+
+@dataclass
+class _Window:
+    """One dispatched decode/verify window whose host fan-out is deferred:
+    the device arrays are fetched later (one transfer per drain) so host
+    work overlaps device compute. ``mask``/``reqs`` snapshot the active
+    set AT DISPATCH — a window must deliver tokens only to the exact
+    request that occupied the slot when it was dispatched (a slot retired
+    and re-admitted while the window was in flight gets nothing)."""
+    kind: str                 # "decode" | "verify"
+    k: int                    # device steps (decode k, or 1 + spec_len)
+    toks: Any                 # device [k, B] (decode) / [B, k] (verify)
+    mask: Any                 # np active snapshot at dispatch
+    reqs: tuple               # slot_req snapshot at dispatch
+    n_acc: Any = None         # device [B] (verify): accepted drafts/slot
+    spec_len: int = 0
+    n_real: Any = None        # np [B] (verify): real (non-pad) drafts
 
 
 @dataclass
@@ -178,14 +221,27 @@ class InferenceEngine:
         self._compiled: dict[Any, Any] = {}
         self._host_len = np.zeros((b,), dtype=np.int64)  # host mirror of
         # cache_len — the loop must not pay a device round-trip to know room
-        # decode windows dispatched DURING admissions (results processed
-        # after the admission sync): [(k, device toks), ...] + steps not
-        # yet host-processed (room accounting must include them)
-        self._deferred_windows: list = []
+        # windows dispatched but not yet host-processed (_Window records):
+        # admission-interleaved decode windows AND the steady-state
+        # in-flight window both ride here; room accounting must include
+        # their steps (_inflight_steps)
+        self._deferred_windows: list[_Window] = []
         self._inflight_steps = 0
+        # ---- speculative decoding (ISSUE 5) ----
+        # verify-graph length buckets (each is one compiled graph). A
+        # single full-size bucket: on the paged path the verify cost is
+        # gather-dominated, so a half-size bucket costs the same and can
+        # never pay — adaptivity lives in the effective-acceptance gate
+        # (_spec_gate), not in shrinking the graph
+        self._spec_lens: tuple = (
+            (engine_cfg.spec_len,) if engine_cfg.spec_len > 0 else ())
+        self._spec_slots: list = [None] * b   # per-slot SlotSpecState
+        self._spec_disabled_windows = 0
         self._stats = {"active_streams": 0, "queued": 0, "tokens_generated": 0,
                        "decode_steps": 0, "admit_dispatches": 0,
-                       "admit_interleaved_windows": 0}
+                       "admit_interleaved_windows": 0,
+                       "spec_windows": 0, "spec_proposed": 0,
+                       "spec_accepted": 0}
 
     # -- compiled steps ------------------------------------------------------
 
@@ -228,26 +284,157 @@ class InferenceEngine:
             fn = self._compiled[key] = self._build_decode(k)
         return fn
 
+    def _build_verify(self, s: int):
+        """Jitted speculative-verify graph (ISSUE 5 tentpole): ONE batched
+        forward over ``[B, 1+s]`` positions — column 0 is the device
+        last_token, columns 1..s the host-proposed draft tokens. The model
+        emits its OWN token at every position; a draft survives only while
+        it equals the model's output, so the emitted stream is exactly
+        what classic decode would have produced (greedy parity is
+        bit-exact — drafts can only be cheap, never wrong). Per slot the
+        graph returns the accepted-prefix length and the model's bonus
+        token, and advances cache_len past accepted positions only —
+        rejected draft positions keep garbage KV that attention masks out
+        and the next window overwrites (paged re-splice / dense
+        re-scatter)."""
+        cfg, ecfg = self.cfg, self.ecfg
+        t = s + 1
+
+        def verify(params, kv_cache, last_token, drafts, cache_len,
+                   active, rng):
+            tokens = jnp.concatenate(
+                [last_token, drafts.astype(jnp.int32)], axis=1)  # [B, t]
+            positions = cache_len[:, None] + jnp.arange(t)[None, :]
+            logits, kv_cache = decoder_forward(
+                params, tokens, cfg, positions=positions,
+                kv_cache=kv_cache, cache_len=cache_len + t, decode=False)
+            rng, sub = jax.random.split(rng)
+            out = sample_logits(logits, sub, temperature=ecfg.temperature,
+                                top_k=ecfg.top_k,
+                                top_p=ecfg.top_p).astype(jnp.int32)  # [B, t]
+            # longest agreeing prefix of the drafts, per slot
+            agree = (tokens[:, 1:] == out[:, :-1]).astype(jnp.int32)
+            n_acc = jnp.cumprod(agree, axis=1).sum(axis=1)        # [B]
+            # the model's own next token after the accepted run
+            bonus = jnp.take_along_axis(out, n_acc[:, None], axis=1)
+            new_len = cache_len + (n_acc + 1) * active.astype(jnp.int32)
+            return bonus, kv_cache, new_len, rng, out, n_acc
+
+        return jax.jit(verify, donate_argnums=(1,))
+
+    def _verify_fn(self, s: int):
+        key = ("verify", s)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._compiled[key] = self._build_verify(s)
+        return fn
+
+    def _admission_can_proceed(self) -> bool:
+        """True only when a waiting request could ACTUALLY be admitted
+        right now (free slot + KV room for the FIFO head) — the only case
+        where shrinking the next window to K=1 buys admission latency.
+        The old check (`not self._queue.empty()`) collapsed throughput to
+        single-step windows under saturation, when the queued head could
+        not be admitted anyway (batch full / pool exhausted) and small
+        windows bought nothing."""
+        if self.active.all():
+            return False
+        head = None
+        if self.paged and self._wait_room:
+            head = self._wait_room[0]
+        else:
+            q = getattr(self._queue, "_queue", None)    # deque peek, no pop
+            if q:
+                head = q[0]
+        return head is not None and self._room_for(head)
+
     def _pick_steps(self) -> int:
         """Largest decode-window bucket every active slot can absorb: no
         slot may outrun its max_new_tokens budget past the window (tokens
         beyond a stop are discarded host-side, so only bounded compute is
-        wasted) nor its cache room. Admission latency wins when work is
-        queued: K=1."""
-        if not self._queue.empty():
+        wasted) nor its cache room. Budget/room subtract steps already in
+        flight (the steady-state overlap window). Admission latency wins
+        when an admission could actually proceed: K=1."""
+        if self._admission_can_proceed():
             return self.ecfg.decode_steps[0]
         limit = max(self.ecfg.decode_steps)
         for slot in range(self.ecfg.max_batch):
             req = self.slot_req[slot]
             if req is None or not self.active[slot]:
                 continue
-            remaining = req.max_new_tokens - len(req.generated)
-            room = self.ecfg.max_seq_len - 1 - self._host_len[slot]
+            remaining = (req.max_new_tokens - len(req.generated)
+                         - self._inflight_steps)
+            room = (self.ecfg.max_seq_len - 1 - self._host_len[slot]
+                    - self._inflight_steps)
             limit = min(limit, max(1, remaining), max(1, room))
         for k in reversed(self.ecfg.decode_steps):
             if k <= limit:
                 return k
         return self.ecfg.decode_steps[0]
+
+    def _spec_room_len(self) -> int:
+        """Largest spec bucket the batch has ROOM for, or 0 when
+        speculation is off or structurally blocked (imminent admission,
+        cache room, exhausted budgets). Slots near their cache limit veto
+        the bucket — a dense write past max_seq_len would clamp backwards
+        over valid KV."""
+        if not self._spec_lens:
+            return 0
+        if self._admission_can_proceed():
+            return 0              # admission latency wins, as for K
+        min_room = self.ecfg.max_seq_len
+        max_remaining = 0
+        any_active = False
+        for slot in range(self.ecfg.max_batch):
+            req = self.slot_req[slot]
+            if req is None or not self.active[slot]:
+                continue
+            any_active = True
+            min_room = min(min_room,
+                           self.ecfg.max_seq_len - 1
+                           - int(self._host_len[slot])
+                           - self._inflight_steps)
+            max_remaining = max(max_remaining,
+                                req.max_new_tokens - len(req.generated)
+                                - self._inflight_steps)
+        if not any_active or max_remaining < 2:
+            return 0
+        for s in sorted(self._spec_lens, reverse=True):
+            if s + 1 <= min_room:
+                return s
+        return 0
+
+    def _spec_gate(self, s: int) -> int:
+        """Acceptance-EWMA gate: speculate only when the mean EFFECTIVE
+        acceptance over active slots clears the floor. Effective means a
+        slot with nothing to propose RIGHT NOW contributes 0 — a verify
+        window hands it ~1 token where a classic K-step window hands it
+        K, so idle proposers must drag the decision toward classic (their
+        optimistic starting EWMA must not). Below the floor speculation
+        auto-disables, except one probe window every ``spec_probe_every``
+        classic windows — which is how a stream that turns repetitive
+        later gets speculation back."""
+        total = 0.0
+        n = 0
+        for slot in range(self.ecfg.max_batch):
+            if self.slot_req[slot] is None or not self.active[slot]:
+                continue
+            n += 1
+            st = self._spec_slots[slot]
+            if st is not None and st.proposer.propose(1):
+                total += st.ewma
+        if n == 0:
+            return 0
+        mean = total / n
+        if mean >= self.ecfg.spec_min_accept:
+            self._spec_disabled_windows = 0
+            return s
+        self._spec_disabled_windows += 1
+        pe = self.ecfg.spec_probe_every
+        if pe > 0 and self._spec_disabled_windows >= pe:
+            self._spec_disabled_windows = 0
+            return s
+        return 0
 
     def _prefill_fn(self, bucket: int):
         if bucket in self._compiled:
@@ -331,12 +518,18 @@ class InferenceEngine:
         if fn is not None:
             return fn
 
+        s = self.ecfg.max_seq_len
+
         def gather(pool_k, pool_v, row):
-            # pool [L, N, BS, KH, D], row [MB] → dense [L, 1, S, KH, D]
+            # pool [L, N, BS, KH, D], row [MB] → dense [L, 1, S, KH, D].
+            # The row's final column is the ALWAYS-TRASH block — slice it
+            # off so the densified prefix has the exact scratch shape
+            # (an S+BS-wide scratch trips the rope-table width validation
+            # when max_seq_len == the model's rope limit)
             def one(pool):
                 g = pool[:, row]                     # [L, MB, BS, KH, D]
                 l, mb, bs, kh, d = g.shape
-                return g.reshape(l, 1, mb * bs, kh, d)
+                return g.reshape(l, 1, mb * bs, kh, d)[:, :, :s]
             return {"k": one(pool_k), "v": one(pool_v)}
 
         fn = self._compiled["gather"] = jax.jit(gather)
@@ -400,11 +593,16 @@ class InferenceEngine:
             self._host_len[slot] = ctx0
 
     def _worst_case_tokens(self, req: _Request) -> int:
-        # prompt + full generation budget + one decode window of overshoot,
+        # prompt + full generation budget + in-flight overshoot slack,
         # clamped to the cache: positions never exceed max_seq_len, so a
-        # near-max prompt must not over-reserve itself into rejection
-        return min(len(req.prompt) + req.max_new_tokens
-                   + max(self.ecfg.decode_steps) + 1,
+        # near-max prompt must not over-reserve itself into rejection.
+        # With speculation on, up to TWO verify windows can be in flight
+        # past the budget check (the steady-state overlap window plus the
+        # one being dispatched), so the slack covers 2·(1+spec_len).
+        slack = max(self.ecfg.decode_steps) + 1
+        if self._spec_lens:
+            slack = max(slack, 2 * (self._spec_lens[-1] + 1) + 1)
+        return min(len(req.prompt) + req.max_new_tokens + slack,
                    self.ecfg.max_seq_len)
 
     def _alloc_blocks(self, n: int) -> list[int]:
@@ -524,6 +722,13 @@ class InferenceEngine:
                 jax.ShapeDtypeStruct((b,), i32),
                 jax.ShapeDtypeStruct((b,), jnp.bool_),
                 abstract_params(self._rng))
+        for s in self._spec_lens:
+            aot(("verify", s), self._verify_fn(s),
+                pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
+                jax.ShapeDtypeStruct((b, s), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b,), jnp.bool_),
+                abstract_params(self._rng))
         return timings
 
     def warmup(self) -> dict:
@@ -595,6 +800,17 @@ class InferenceEngine:
                 self.cache_len, inactive, self._rng)
             np.asarray(jax.device_get(toks[-1, :4]))
             timings[f"decode_k{k}_s"] = _time.perf_counter() - t0
+        for s in self._spec_lens:
+            # speculative verify graphs: a spec window that first occurs
+            # mid-traffic must not stall the batch behind an XLA compile
+            t0 = _time.perf_counter()
+            drafts = jnp.zeros((self.ecfg.max_batch, s), jnp.int32)
+            (self.last_token, self.kv_cache, self.cache_len, self._rng,
+             out, _n) = self._verify_fn(s)(
+                self.params, self.kv_cache, self.last_token, drafts,
+                self.cache_len, inactive, self._rng)
+            np.asarray(jax.device_get(out[:4, 0]))
+            timings[f"verify_s{s}_s"] = _time.perf_counter() - t0
         return timings
 
     async def stop(self) -> None:
@@ -662,6 +878,13 @@ class InferenceEngine:
         out["token_pressure"] = float(
             self._host_len.sum()
             / (self.ecfg.max_batch * self.ecfg.max_seq_len))
+        # speculative-decoding acceptance (ISSUE 5): proposed/accepted are
+        # cumulative; the rate is the fleet-comparable signal the runner
+        # heartbeats and the router aggregates
+        out["spec_enabled"] = bool(self._spec_lens)
+        prop = self._stats["spec_proposed"]
+        out["spec_acceptance_rate"] = (
+            self._stats["spec_accepted"] / prop if prop else 0.0)
         if self.paged:
             out["kv_blocks_used"] = self.allocator.used_count
             out["kv_blocks_free"] = self.allocator.free_count
@@ -806,10 +1029,16 @@ class InferenceEngine:
         first = sample_logits(last, sub, temperature=self.ecfg.temperature,
                               top_k=self.ecfg.top_k, top_p=self.ecfg.top_p)
         self.last_token = self.last_token.at[slot, 0].set(first)
+        self._occupy_slot(req, slot)
+        return first
+
+    def _occupy_slot(self, req: _Request, slot: int) -> None:
         req.slot = slot
         self.active[slot] = True
         self.slot_req[slot] = req
-        return first
+        if self._spec_lens:
+            from .spec import make_slot_state
+            self._spec_slots[slot] = make_slot_state(req.prompt)
 
     def _interleave_decode_window(self) -> None:
         """Dispatch one decode window for the active batch WITHOUT syncing
@@ -853,7 +1082,9 @@ class InferenceEngine:
          toks) = self._decode_k(k)(
             self.params, self.kv_cache, self.last_token, self.cache_len,
             jnp.asarray(self.active), self._rng)
-        self._deferred_windows.append((k, toks, self.active.copy()))
+        self._deferred_windows.append(
+            _Window(kind="decode", k=k, toks=toks, mask=self.active.copy(),
+                    reqs=tuple(self.slot_req)))
         self._inflight_steps += k
         self._stats["decode_steps"] += k
         self._stats["admit_interleaved_windows"] += 1
@@ -884,9 +1115,7 @@ class InferenceEngine:
         first = sample_logits(last, sub, temperature=self.ecfg.temperature,
                               top_k=self.ecfg.top_k, top_p=self.ecfg.top_p)
         self.last_token = self.last_token.at[slot, 0].set(first)
-        req.slot = slot
-        self.active[slot] = True
-        self.slot_req[slot] = req
+        self._occupy_slot(req, slot)
         return first
 
     def _dense_splice_fn(self, bucket: int):
@@ -909,6 +1138,9 @@ class InferenceEngine:
 
     def _deliver_first(self, req: _Request, first: int) -> None:
         req.generated.append(first)
+        st = self._spec_slots[req.slot] if req.slot >= 0 else None
+        if st is not None:
+            st.proposer.append(first)
         if req.queue is not None:
             req.queue.put_nowait(first)
         # the prefill-sampled token may already satisfy the stop conditions
@@ -920,6 +1152,7 @@ class InferenceEngine:
         req = self.slot_req[slot]
         self.active[slot] = False
         self.slot_req[slot] = None
+        self._spec_slots[slot] = None
         self.cache_len = self.cache_len.at[slot].set(0)
         self._host_len[slot] = 0
         if self.paged:
@@ -1000,7 +1233,13 @@ class InferenceEngine:
     async def _serve_loop_inner(self) -> None:
         while True:
             # admit as many queued requests as there are free slots; ALL
-            # their first tokens sync in one device round-trip at the end
+            # their first tokens sync in one device round-trip at the end.
+            # An imminent admission first drains the steady-state overlap
+            # window: its steps occupy the reservation slack the
+            # admission-interleaved decode windows need, and its
+            # retirements may free the very slot being admitted into.
+            if self._deferred_windows and self._admission_can_proceed():
+                self._drain_windows()
             pending: list[tuple[_Request, Any]] = []
             while not self.active.all():
                 req = self._next_admittable()
@@ -1040,75 +1279,224 @@ class InferenceEngine:
                     jnp.stack([f for _, f in pending])))
                 for (req, _), first in zip(pending, firsts):
                     self._deliver_first(req, int(first))
-            # decode windows dispatched during those admissions: their
-            # tokens are ready by now (device work ordered before firsts).
-            # ONE transfer for all of them — N sequential device_gets
-            # would pay N round-trips over a TPU relay
-            if self._deferred_windows:
-                wins, self._deferred_windows = self._deferred_windows, []
-                all_toks = jax.device_get([t for _, t, _ in wins])
-                for (k, _, mask), w in zip(wins, all_toks):
-                    self._inflight_steps -= k
-                    self._process_window_host(k, np.asarray(w), mask)
+                # windows dispatched during those admissions: their tokens
+                # are ready by now (device work ordered before firsts) —
+                # drain them in one transfer
+                self._drain_windows()
 
             if not self.active.any():
+                # retirements can only land at host processing: leftover
+                # in-flight windows must drain before the idle block
+                if self._deferred_windows:
+                    self._drain_windows()
                 continue
 
-            # one decode WINDOW for the whole batch: k steps on-device,
-            # one host sync for all k×B tokens
-            k = self._pick_steps()
-            if self.paged:
-                # lazy physical growth: each active slot gets blocks for
-                # this window's writes (covered by its reservation). Clamp
-                # to max_seq_len: _pick_steps already bounds in-window
-                # positions to the cache, and a near-full slot must not
-                # demand a 17th block of a 16-wide table.
-                for slot in range(self.ecfg.max_batch):
-                    if self.active[slot]:
-                        self._ensure_slot_blocks(
-                            slot, min(int(self._host_len[slot]) + k + 1,
-                                      self.ecfg.max_seq_len))
-            (self.last_token, self.kv_cache,
-             self.cache_len, self._rng, toks) = self._decode_k(k)(
-                self.params, self.kv_cache, self.last_token,
-                self.cache_len, jnp.asarray(self.active), self._rng)
-            self._stats["decode_steps"] += k
-            self._process_window(k, toks, self.active)
+            # one WINDOW for the whole batch — speculative verify when the
+            # acceptance EWMAs justify it, classic k-step decode otherwise
+            win = self._dispatch_window()
+            if win is not None:
+                self._deferred_windows.append(win)
+                # steady-state overlap (ISSUE 5 satellite): keep exactly
+                # ONE window in flight — the host fan-out of every older
+                # window runs WHILE the new one computes on device,
+                # instead of serializing host work behind each sync
+                while len(self._deferred_windows) > 1:
+                    self._process_deferred(self._deferred_windows.pop(0))
             # yield to the event loop so new requests can land
             await asyncio.sleep(0)
 
-    def _process_window(self, k: int, toks, mask) -> None:
-        self._process_window_host(k, np.asarray(jax.device_get(toks)),
-                                  mask)
+    # -- window dispatch / processing ---------------------------------------
 
-    def _process_window_host(self, k: int, window, mask) -> None:
-        """Host-side consumption of one decode window [k, B]: ``mask`` is
-        the active set AT DISPATCH (a deferred window must not deliver its
-        position-0 garbage to a slot admitted after it was dispatched)."""
-        for step in range(k):
+    def _dispatch_window(self) -> Optional[_Window]:
+        s = self._spec_room_len()
+        if s > 0:
+            s = self._spec_gate(s)
+        if s > 0:
+            # drafts must continue the DELIVERED history: drain any
+            # in-flight window first so the proposers' view matches the
+            # device last_token (classic windows keep the overlap; a
+            # verify window instead amortizes the sync over up to 1+s
+            # tokens per slot)
+            while self._deferred_windows:
+                self._process_deferred(self._deferred_windows.pop(0))
+            if not self.active.any():
+                return None
+            from .spec import build_drafts
+            drafts, n_real = build_drafts(self._spec_slots, self.active, s)
+            if int(n_real.sum()) > 0:
+                return self._dispatch_verify(s, drafts, n_real)
+            # nothing to propose anywhere: a verify pass would be a pure
+            # waste — fall through to a classic window
+        k = self._pick_steps()
+        if self.paged:
+            # lazy physical growth: each active slot gets blocks for this
+            # window's writes (covered by its reservation). Clamp to
+            # max_seq_len: _pick_steps already bounds in-window positions
+            # to the cache, and a near-full slot must not demand a 17th
+            # block of a 16-wide table.
             for slot in range(self.ecfg.max_batch):
-                if not (mask[slot] and self.active[slot]):
+                if self.active[slot]:
+                    self._ensure_slot_blocks(
+                        slot, min(int(self._host_len[slot])
+                                  + self._inflight_steps + k + 1,
+                                  self.ecfg.max_seq_len))
+        (self.last_token, self.kv_cache,
+         self.cache_len, self._rng, toks) = self._decode_k(k)(
+            self.params, self.kv_cache, self.last_token,
+            self.cache_len, jnp.asarray(self.active), self._rng)
+        self._stats["decode_steps"] += k
+        self._inflight_steps += k
+        return _Window(kind="decode", k=k, toks=toks,
+                       mask=self.active.copy(), reqs=tuple(self.slot_req))
+
+    def _dispatch_verify(self, s: int, drafts, n_real) -> _Window:
+        t = s + 1
+        if self.paged:
+            for slot in range(self.ecfg.max_batch):
+                if self.active[slot]:
+                    self._ensure_slot_blocks(
+                        slot, min(int(self._host_len[slot]) + t + 1,
+                                  self.ecfg.max_seq_len))
+        (self.last_token, self.kv_cache, self.cache_len, self._rng, out,
+         n_acc) = self._verify_fn(s)(
+            self.params, self.kv_cache, self.last_token,
+            jnp.asarray(drafts), self.cache_len, jnp.asarray(self.active),
+            self._rng)
+        self._stats["spec_windows"] += 1
+        self._inflight_steps += t
+        return _Window(kind="verify", k=t, toks=out, n_acc=n_acc,
+                       mask=self.active.copy(), reqs=tuple(self.slot_req),
+                       spec_len=s, n_real=n_real)
+
+    def _drain_windows(self) -> None:
+        """Host-process every in-flight window. ONE transfer for all of
+        them — N sequential device_gets would pay N round-trips over a
+        TPU relay."""
+        wins, self._deferred_windows = self._deferred_windows, []
+        if not wins:
+            return
+        payload = jax.device_get(
+            [(w.toks,) if w.n_acc is None else (w.toks, w.n_acc)
+             for w in wins])
+        for w, arrs in zip(wins, payload):
+            self._inflight_steps -= w.k
+            self._process_window_host(
+                w, np.asarray(arrs[0]),
+                np.asarray(arrs[1]) if len(arrs) > 1 else None)
+
+    def _process_deferred(self, win: _Window) -> None:
+        if win.n_acc is None:
+            toks, n_acc = jax.device_get(win.toks), None
+        else:
+            toks, n_acc = jax.device_get((win.toks, win.n_acc))
+            n_acc = np.asarray(n_acc)
+        self._inflight_steps -= win.k
+        self._process_window_host(win, np.asarray(toks), n_acc)
+
+    def _deliver_token(self, slot: int, tok: int) -> None:
+        """Deliver ONE generated token to the slot's request, retiring the
+        slot when it satisfies a stop condition (budget / EOS / cache
+        room)."""
+        req = self.slot_req[slot]
+        req.generated.append(tok)
+        self._host_len[slot] += 1
+        self._stats["tokens_generated"] += 1
+        st = self._spec_slots[slot]
+        if st is not None:
+            st.proposer.append(tok)
+        if req.queue is not None:
+            req.queue.put_nowait(tok)
+        hit_eos = self.ecfg.eos_id >= 0 and tok == self.ecfg.eos_id
+        # prompt + generated must fit the cache
+        out_of_room = self._host_len[slot] >= self.ecfg.max_seq_len - 1
+        if (len(req.generated) >= req.max_new_tokens or hit_eos
+                or out_of_room):
+            # remaining window tokens for this slot are noise (the device
+            # kept going); retire discards them by flipping active off
+            self._retire(slot)
+
+    def _slot_live(self, win: _Window, slot: int) -> bool:
+        """A window's tokens belong to a slot only if the request that
+        occupied it AT DISPATCH is still there — identity, not just
+        activity: with a window in flight a slot can retire AND be
+        re-admitted before its tokens are processed, and the old window's
+        tokens must never leak into the new request's stream."""
+        return (bool(win.mask[slot]) and bool(self.active[slot])
+                and self.slot_req[slot] is win.reqs[slot])
+
+    def _process_window_host(self, win: _Window, window,
+                             n_acc=None) -> None:
+        """Host-side consumption of one window's tokens. Decode windows
+        carry [k, B] (every step, every slot); verify windows carry the
+        model outputs [B, 1+s] plus per-slot accepted-draft counts —
+        tokens-per-slot-per-window is VARIABLE (1..1+s)."""
+        if win.kind == "verify":
+            self._process_verify_host(win, window, n_acc)
+            return
+        shadow: dict[int, list[int]] = {}
+        if self._spec_lens:
+            # shadow drafts: what WOULD prompt lookup have proposed for
+            # this window? Proposed HERE — at processing time, before any
+            # of the window's tokens are appended — the proposer history
+            # is exactly the pre-window state, so the drafts align with
+            # the tokens they are graded against (proposing at DISPATCH
+            # would be one in-flight window stale under the steady-state
+            # overlap and misalign by k mod cycle-period). The window's
+            # real tokens grade them below: a free, always-fresh
+            # acceptance estimate that opens the verify gate the moment a
+            # stream turns repetitive, with no blind probe windows.
+            m = min(win.k, self._spec_lens[-1])
+            for slot in range(self.ecfg.max_batch):
+                st = self._spec_slots[slot]
+                if st is not None and self._slot_live(win, slot):
+                    shadow[slot] = st.proposer.propose(m)
+        delivered: list[list[int]] = [[] for _ in range(self.ecfg.max_batch)]
+        for step in range(win.k):
+            for slot in range(self.ecfg.max_batch):
+                if not self._slot_live(win, slot):
                     continue
-                req = self.slot_req[slot]
-                if req.cancelled:
+                if self.slot_req[slot].cancelled:
                     # client gone mid-stream: stop decoding into a queue
                     # nobody reads and free the slot for live work
                     self._retire(slot)
                     continue
                 tok = int(window[step, slot])
-                req.generated.append(tok)
-                self._host_len[slot] += 1
-                self._stats["tokens_generated"] += 1
-                if req.queue is not None:
-                    req.queue.put_nowait(tok)
-                hit_eos = (self.ecfg.eos_id >= 0
-                           and tok == self.ecfg.eos_id)
-                # prompt + generated must fit the cache
-                out_of_room = (self._host_len[slot]
-                               >= self.ecfg.max_seq_len - 1)
-                if (len(req.generated) >= req.max_new_tokens or hit_eos
-                        or out_of_room):
-                    # remaining window tokens for this slot are noise
-                    # (the device kept decoding); retire discards them
-                    # by flipping active off — the cache lanes reset
-                    self._retire(slot)
+                delivered[slot].append(tok)
+                self._deliver_token(slot, tok)
+        for slot, sh in shadow.items():
+            m = min(len(sh), len(delivered[slot]))
+            if m == 0:
+                continue
+            acc = 0
+            while acc < m and sh[acc] == delivered[slot][acc]:
+                acc += 1
+            st = self._spec_slots[slot]
+            if st is not None:
+                st.observe(m, acc)
+
+    def _process_verify_host(self, win: _Window, out, n_acc) -> None:
+        s = win.spec_len
+        for slot in range(self.ecfg.max_batch):
+            if not self._slot_live(win, slot):
+                continue
+            acc = int(n_acc[slot])
+            st = self._spec_slots[slot]
+            n_real = int(win.n_real[slot])
+            if st is not None and n_real > 0:
+                # EWMA and counters see only what this slot actually
+                # proposed — zero-padded lanes (and any padded TAIL of a
+                # partial proposal) must not drag acceptance down for
+                # drafts that were never offered. Padding accepted by
+                # chance is capped off the accounting too; its tokens are
+                # still delivered (they are the model's own outputs).
+                st.observe(n_real, min(acc, n_real))
+                self._stats["spec_proposed"] += n_real
+                self._stats["spec_accepted"] += min(acc, n_real)
+            if self.slot_req[slot].cancelled:
+                self._retire(slot)
+                continue
+            req = self.slot_req[slot]
+            for i in range(acc + 1):
+                self._deliver_token(slot, int(out[slot, i]))
+                if self.slot_req[slot] is not req:
+                    break          # EOS / budget / room hit inside the run

@@ -68,6 +68,8 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
                 kv_block_size: int = 256,
                 kv_pool_blocks: int = 0,
                 prefix_cache_blocks: Optional[int] = None,
+                spec_len: int = 0,
+                spec_min_accept: float = 0.35,
                 engine_cfg: Optional[EngineConfig] = None,
                 seed: int = 0,
                 compile_ahead: bool = False) -> InferenceEngine:
@@ -76,6 +78,14 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
     production serving path (block allocator + chunked prefill + prefix
     reuse). ``paged=False`` forces the legacy dense cache.
     ``prefix_cache_blocks=0`` DISABLES the prefix cache (None = auto).
+
+    ``spec_len`` enables self-speculative decoding (prompt-lookup n-gram
+    drafts verified in one batched forward — ISSUE 5): no draft model, so
+    it works for EVERY preset; ``spec_min_accept`` is the acceptance-EWMA
+    floor below which the engine auto-falls-back to classic windowed
+    decode (adversarial prompts never regress past a probe's worth of
+    wasted verify compute). Greedy output is token-identical with the
+    knob on or off.
 
     ``compile_ahead=True`` builds the engine on the preset's ABSTRACT param
     spec and runs :meth:`InferenceEngine.precompile` in a thread WHILE the
@@ -103,7 +113,8 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
         # re-enable the auto default
         prefix_cache_blocks=prefix_cache_blocks
         if prefix_cache_blocks is not None
-        else (max_seq_len // block if paged else 0))
+        else (max_seq_len // block if paged else 0),
+        spec_len=spec_len, spec_min_accept=spec_min_accept)
     if compile_ahead:
         import logging
         import threading

@@ -1,0 +1,155 @@
+"""Self-speculative decoding: prompt-lookup (n-gram) draft proposal and
+per-slot acceptance control (ISSUE 5 tentpole).
+
+Why no draft model: the serving hot path is memory-bandwidth-bound — one
+decode step streams every weight byte to produce ONE token per sequence.
+A verify pass over ``1 + spec_len`` positions streams the same weight
+bytes, so in the bandwidth-bound regime each accepted draft token is a
+nearly-free extra token. Drafts come from the request's OWN context
+(prompt + generated so far): code, structured output, RAG answers and
+chat histories repeat themselves constantly, and an n-gram lookup catches
+exactly that — for free, for every preset, with zero extra weights to
+load (the DeepServe/λScale cost driver is tokens/sec/chip, not FLOPs).
+
+Correctness does not depend on draft quality: the engine's verify graph
+emits the MODEL'S OWN tokens at every position and accepts a draft token
+only where it equals the model's output, so the emitted stream is exactly
+the stream classic decode would have produced (greedy parity is
+bit-exact; sampled decode emits model samples, never draft inventions).
+Bad drafts cost only wasted verify compute — which is what the
+:class:`SlotSpecState` EWMA controller bounds: acceptance below the floor
+auto-disables speculation for that request (with periodic re-probes, so a
+prompt that BECOMES repetitive later gets another chance).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+class NGramProposer:
+    """Prompt-lookup drafting over one request's token history.
+
+    Keeps an index from the last-``n``-token window to the position right
+    after its most recent PREVIOUS occurrence; a proposal copies the
+    continuation that followed that occurrence. Longest n wins (a 3-gram
+    match is far more predictive than a 1-gram one); ``min_n`` defaults to
+    2 because 1-gram continuations are mostly noise that burns verify
+    compute.
+
+    The index is updated incrementally as tokens append — proposal and
+    update are both O(max_n), independent of history length.
+    """
+
+    def __init__(self, tokens: list[int], max_n: int = 3, min_n: int = 2):
+        self.max_n = max(1, max_n)
+        self.min_n = max(1, min(min_n, self.max_n))
+        self.tokens: list[int] = []
+        # per n: {n-gram tuple: position AFTER its latest occurrence} plus
+        # the occurrence BEFORE that — the suffix's own n-gram is always
+        # the latest occurrence of itself, so proposals read the previous
+        # one (the continuation that followed it last time)
+        self._index: list[dict[tuple, int]] = [
+            {} for _ in range(self.max_n + 1)]
+        self._prev: list[dict[tuple, int]] = [
+            {} for _ in range(self.max_n + 1)]
+        self.extend(tokens)
+
+    def extend(self, tokens: list[int]) -> None:
+        for t in tokens:
+            self.append(int(t))
+
+    def append(self, tok: int) -> None:
+        self.tokens.append(tok)
+        end = len(self.tokens)
+        for n in range(self.min_n, self.max_n + 1):
+            if end >= n:
+                key = tuple(self.tokens[end - n:end])
+                old = self._index[n].get(key)
+                if old is not None:
+                    self._prev[n][key] = old
+                self._index[n][key] = end
+
+    def propose(self, k: int) -> list[int]:
+        """Up to ``k`` draft tokens continuing the current history, or
+        ``[]`` when no n-gram of the suffix has occurred before. When the
+        previous occurrence sits within ``k`` tokens of the end, the
+        history between it and the suffix is a cycle of period
+        ``end - pos`` — the draft extrapolates that cycle instead of
+        truncating, which is exactly the repeated-structure case
+        (tables, code idioms, looping outputs) speculation feeds on."""
+        if k <= 0:
+            return []
+        end = len(self.tokens)
+        for n in range(self.max_n, self.min_n - 1, -1):
+            if end < n:
+                continue
+            pos = self._prev[n].get(tuple(self.tokens[end - n:end]))
+            if pos is None:
+                continue
+            draft = self.tokens[pos:pos + k]
+            period = end - pos
+            while len(draft) < k:
+                draft.append(draft[len(draft) - period])
+            return draft
+        return []
+
+
+# EWMA weight for per-window acceptance updates: ~3-window memory, so a
+# request that turns repetitive (or stops being) re-rates within a few
+# windows, not its whole lifetime — greedy decode drifts into and out of
+# repetitive structure quickly, and a sluggish controller misses the
+# profitable phase entirely
+EWMA_ALPHA = 0.3
+
+
+@dataclass
+class SlotSpecState:
+    """Per-slot speculation state: the proposer plus the acceptance EWMA
+    the serve loop's window chooser reads. Starts optimistic (EWMA 1.0)
+    so every request gets speculation tried; adversarial prompts decay
+    below the floor within a few windows and fall back to classic
+    windowed decode."""
+
+    proposer: NGramProposer
+    ewma: float = 1.0
+    proposed: int = 0
+    accepted: int = 0
+    windows: int = 0
+
+    def observe(self, proposed: int, accepted: int) -> None:
+        self.proposed += proposed
+        self.accepted += accepted
+        self.windows += 1
+        if proposed > 0:
+            rate = accepted / proposed
+            self.ewma = (1.0 - EWMA_ALPHA) * self.ewma + EWMA_ALPHA * rate
+
+
+def make_slot_state(prompt: list[int],
+                    max_n: int = 3) -> SlotSpecState:
+    return SlotSpecState(proposer=NGramProposer(list(prompt), max_n=max_n))
+
+
+def build_drafts(states: list[Optional[SlotSpecState]], active,
+                 spec_len: int):
+    """Draft matrix [B, spec_len] for one verify window. Slots without a
+    proposal (or inactive) get zero-padding — padding never affects
+    correctness (the verify graph emits the model's own tokens; a padded
+    draft is just unlikely to be accepted), so the graph keeps one static
+    shape for any mix of hit/miss slots. Returns (drafts, proposed_mask)
+    where proposed_mask[b] is how many REAL draft tokens slot b supplied
+    (EWMA accounting must not punish a slot for padding it never
+    proposed)."""
+    import numpy as np
+    b = len(states)
+    drafts = np.zeros((b, spec_len), dtype=np.int32)
+    n_real = np.zeros((b,), dtype=np.int32)
+    for slot, st in enumerate(states):
+        if st is None or not active[slot]:
+            continue
+        prop = st.proposer.propose(spec_len)
+        drafts[slot, :len(prop)] = prop
+        n_real[slot] = len(prop)
+    return drafts, n_real
