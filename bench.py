@@ -59,7 +59,8 @@ import time
 PHASE_TIMEOUT_S = {"llm": 1800, "llm_endpoint": 1800, "kernels": 900,
                    "coldstart": 900, "coldstart_native": 900,
                    "coldstart_jax": 900, "coldstart_jax_tpu": 900,
-                   "coldstart_stream": 900, "router": 300, "spec": 900}
+                   "coldstart_stream": 900, "router": 300, "spec": 900,
+                   "quant": 900}
 
 # share compiled XLA programs between the in-process llm phase and the
 # runner container in the endpoint phase (identical graphs → second phase
@@ -1464,6 +1465,214 @@ def bench_spec(quick: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# phase: quantized serving (ISSUE 6) — int8 weights + int8 paged KV vs bf16
+# through the REAL serving engine, plus the two pure bytes-moved headlines:
+# `.tpu9w` shard bytes (cold start / scale-out traffic) and KV-pool
+# capacity at equal HBM (admission headroom). Output parity between the
+# engines is judged with the spec phase's oracle-margin rule — a
+# throughput win from wrong tokens is not a win.
+# ---------------------------------------------------------------------------
+
+def bench_quant(quick: bool = False) -> dict:
+    import asyncio
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu9.models import init_decoder
+    from tpu9.models.llama import LLAMA_PRESETS
+    from tpu9.models.transformer import decoder_forward
+    from tpu9.ops.quant import quantize_decoder, quantized_bytes
+    from tpu9.serving import weights as wfmt
+    from tpu9.serving.engine import EngineConfig, InferenceEngine
+    from tpu9.serving.feasibility import weight_bytes
+    from tpu9.serving.paged_kv import kv_block_bytes
+    from tpu9.serving.presets import resolve_preset
+    from tpu9.utils import on_tpu
+
+    os.makedirs(XLA_CACHE_DIR, exist_ok=True)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", XLA_CACHE_DIR)
+
+    tpu = on_tpu()
+    if tpu and not quick:
+        # standalone on a chip host: the ~1B preset is the smallest config
+        # where decode is genuinely HBM-bandwidth-bound AND the bf16
+        # baseline still fits next to the quantized engine
+        s = dict(preset="llama-1b", batch=8, max_seq=2048,
+                 prefill_buckets=(128,), decode_steps=(1, 8, 32),
+                 kv_block=256, requests=8, max_new=192, passes=2,
+                 dtype=None, tps_gate=1.15)
+    else:
+        # CPU (the orchestrated/regression path): compute-bound, so the
+        # HBM win physically cannot show — the tokens/sec gate here is
+        # only a catastrophe floor; the byte/capacity headlines and the
+        # parity judge are the CPU-verifiable contract. f32 activations
+        # kill bf16 argmax-tie noise in the parity comparison.
+        s = dict(preset="llama-tiny", batch=4, max_seq=512,
+                 prefill_buckets=(32, 64), decode_steps=(1, 4, 8),
+                 kv_block=32, requests=4, max_new=96 if quick else 160,
+                 passes=2 if quick else 3, dtype=jnp.float32,
+                 tps_gate=0.5)
+    out: dict = {"quant_model": s["preset"], "on_tpu": tpu}
+    violations: list[str] = []
+
+    from dataclasses import replace as _replace
+    cfg, _ = resolve_preset(s["preset"])
+    if s["dtype"] is not None:
+        cfg = _replace(cfg, dtype=s["dtype"])
+
+    # -- headline 1: .tpu9w shard bytes (flagship arithmetic + measured) --
+    # the flagship ratio comes from the EXACT abstract-tree byte counts
+    # the feasibility gate uses (jax.eval_shape — nothing materializes);
+    # the measured ratio writes real tiny shards through save_params to
+    # prove the pipeline (quantize → v2 index → shards) delivers it.
+    # Measurements use the preset's REAL dtype (bf16): the f32 override
+    # below exists only so the parity comparison has no argmax-tie noise
+    # — an f32 baseline would inflate the "measured" int8 win ~2x over
+    # the bf16 deployment story the flagship numbers tell.
+    cfg8b, _ = resolve_preset("llama3-8b")
+    mcfg, _ = resolve_preset(s["preset"])
+    out["quant_shard_bytes_ratio"] = round(
+        weight_bytes(cfg8b, False) / weight_bytes(cfg8b, True), 4)
+    mparams = init_decoder(jax.random.PRNGKey(0), mcfg)
+    with tempfile.TemporaryDirectory() as td:
+        di = wfmt.save_params(mparams, os.path.join(td, "d.tpu9w"))
+        qi = wfmt.save_params(mparams, os.path.join(td, "q.tpu9w"),
+                              quantize="int8")
+        out["quant_shard_bytes_ratio_measured"] = round(
+            di["total_bytes"] / qi["total_bytes"], 4)
+        out["quant_shard_index_version"] = qi["version"]
+    if out["quant_shard_bytes_ratio"] < 1.8:
+        violations.append(
+            f"quant: flagship shard-bytes ratio "
+            f"{out['quant_shard_bytes_ratio']} < 1.8")
+    if abs(quantized_bytes(quantize_decoder(mparams)) - qi["total_bytes"]) \
+            > qi["total_bytes"] * 0.01:
+        violations.append("quant: feasibility bytes disagree with the "
+                          "shards actually written")
+    del mparams
+
+    # -- headline 2: KV-pool capacity at equal HBM ------------------------
+    # flagship arithmetic from the SAME helper the engine's auto sizing
+    # divides by; measured from two real engines' allocators below
+    out["quant_kv_capacity_ratio"] = round(
+        kv_block_bytes(cfg8b, 256, False)
+        / kv_block_bytes(cfg8b, 256, True), 4)
+    if out["quant_kv_capacity_ratio"] < 1.9:
+        violations.append(
+            f"quant: flagship KV capacity ratio "
+            f"{out['quant_kv_capacity_ratio']} < 1.9")
+
+    def build(params, bcfg, kv_quant: str, warm: bool = True):
+        eng = InferenceEngine(params, bcfg, EngineConfig(
+            max_batch=s["batch"], max_seq_len=s["max_seq"],
+            prefill_buckets=s["prefill_buckets"],
+            decode_steps=s["decode_steps"],
+            kv_block_size=s["kv_block"], kv_pool_blocks=0,
+            prefill_chunk=min(s["prefill_buckets"]),
+            prefix_cache_blocks=s["max_seq"] // s["kv_block"],
+            kv_quant=kv_quant))
+        if warm:
+            eng.warmup()
+        return eng
+
+    # measured capacity at the preset's REAL dtype: construction alone
+    # sizes the pools — no warmup, no weights touched
+    m_off = build({}, mcfg, "", warm=False)
+    m_on = build({}, mcfg, "int8", warm=False)
+    out["quant_kv_blocks_bf16"] = m_off.allocator.n_blocks - 1
+    out["quant_kv_blocks_int8"] = m_on.allocator.n_blocks - 1
+    out["quant_kv_capacity_ratio_measured"] = round(
+        (m_on.allocator.n_blocks - 1) / (m_off.allocator.n_blocks - 1), 4)
+    del m_off, m_on
+
+    dense_params = init_decoder(jax.random.PRNGKey(0), cfg)
+    quant_params = quantize_decoder(dense_params)
+    del dense_params
+    off = build(quant_params, cfg, "")
+    on = build(quant_params, cfg, "int8")
+
+    # -- tokens/sec + parity: paired passes through both engines ----------
+    import random as _random
+    rng = _random.Random(11)
+    prompts = [[rng.randrange(1, 400) for _ in range(24)]
+               for _ in range(s["requests"])]
+
+    async def one_pass(eng):
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(*[
+            eng.generate(list(p), max_new_tokens=s["max_new"])
+            for p in prompts])
+        return sum(len(o) for o in outs) / (time.perf_counter() - t0), outs
+
+    async def run():
+        await off.start()
+        await on.start()
+        for eng in (off, on):        # untimed admission/graph warm pass
+            await asyncio.gather(*[
+                eng.generate(list(p), max_new_tokens=8) for p in prompts])
+        ratios, offs_t, ons_t = [], [], []
+        outs_off = outs_on = None
+        for _ in range(s["passes"]):
+            tps_off, outs_off = await one_pass(off)
+            tps_on, outs_on = await one_pass(on)
+            offs_t.append(tps_off)
+            ons_t.append(tps_on)
+            ratios.append(tps_on / tps_off)
+        await off.stop()
+        await on.stop()
+        return ratios, offs_t, ons_t, outs_off, outs_on
+
+    ratios, offs_t, ons_t, outs_off, outs_on = asyncio.run(run())
+    out["quant_tokens_per_sec_off"] = round(statistics.median(offs_t), 1)
+    out["quant_tokens_per_sec_on"] = round(statistics.median(ons_t), 1)
+    out["quant_tokens_per_sec_ratio"] = round(statistics.median(ratios), 4)
+    if out["quant_tokens_per_sec_ratio"] < s["tps_gate"]:
+        what = ("int8 not faster than bf16 on the bandwidth-bound preset"
+                if tpu else "int8 pathologically slower on CPU")
+        violations.append(
+            f"quant: tokens/sec ratio {out['quant_tokens_per_sec_ratio']}"
+            f" < {s['tps_gate']} — {what}")
+
+    # -- parity judge (HARD gate): both engines share the same quantized
+    # weights, so any divergence isolates int8-KV noise. At each stream's
+    # first fork, the int8-KV engine's token must be within quantization
+    # noise of the full-context oracle's argmax (same weights, exact KV)
+    # — otherwise it is a pool-write/table bug, not noise.
+    MARGIN = 0.35
+    first_div = None
+    margin_max = 0.0
+    for a, b, p in zip(outs_off, outs_on, prompts):
+        if len(a) != len(b):
+            # per-stream continue, not break: the remaining streams'
+            # margins are diagnostic evidence for the SAME round
+            violations.append("quant: output LENGTHS diverge")
+            continue
+        i = next((i for i, (x, y) in enumerate(zip(a, b)) if x != y), None)
+        if i is None:
+            continue
+        first_div = i if first_div is None else min(first_div, i)
+        logits = decoder_forward(
+            quant_params, jnp.asarray([list(p) + b[:i]], jnp.int32),
+            cfg)[0, -1]
+        margin = float(jnp.max(logits) - logits[b[i]])
+        margin_max = max(margin_max, margin)
+        if margin > MARGIN:
+            violations.append(
+                f"quant: stream forks at token {i} and the int8-KV token "
+                f"is {margin:.3f} below the oracle argmax (gate {MARGIN})"
+                " — KV write/dequant bug, not quantization noise")
+    out["quant_parity_first_divergence"] = (
+        -1 if first_div is None else first_div)
+    out["quant_oracle_margin_max"] = round(margin_max, 4)
+
+    out["violations"] = violations
+    out["valid"] = not violations
+    return out
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
@@ -1473,7 +1682,7 @@ def _run_phase(phase: str, quick: bool, cpu: bool) -> dict:
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", phase]
     if quick:
         cmd.append("--quick")
-    if cpu or phase in ("router", "spec") \
+    if cpu or phase in ("router", "spec", "quant") \
             or (phase.startswith("coldstart") and phase != "coldstart_jax_tpu"):
         # the serving stack and its runner children must never dial the chip
         # — ALL cold-start stack phases, not just the original one (round-3
@@ -1730,6 +1939,13 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
                       "spec_tokens_per_sec_on_repetitive",
                       "spec_tokens_per_sec_off_repetitive",
                       "spec_acceptance_rate_repetitive")),
+            ("quant", ("quant_shard_bytes_ratio",
+                       "quant_shard_bytes_ratio_measured",
+                       "quant_kv_capacity_ratio",
+                       "quant_kv_capacity_ratio_measured",
+                       "quant_tokens_per_sec_ratio",
+                       "quant_tokens_per_sec_on",
+                       "quant_tokens_per_sec_off")),
             ("coldstart", ("cold_start_p50_s",)),
             ("coldstart_native", ("cold_start_native_p50_s",
                                   "cold_start_native_pull_p50_s")),
@@ -1797,6 +2013,11 @@ _COMPACT_KEYS = (
     "spec_uplift_repetitive", "spec_adversarial_ratio",
     "spec_tokens_per_sec_on_repetitive", "spec_tokens_per_sec_off_repetitive",
     "spec_acceptance_rate_repetitive", "spec_acceptance_rate_adversarial",
+    "quant_shard_bytes_ratio", "quant_shard_bytes_ratio_measured",
+    "quant_kv_capacity_ratio", "quant_kv_capacity_ratio_measured",
+    "quant_tokens_per_sec_ratio", "quant_tokens_per_sec_on",
+    "quant_tokens_per_sec_off", "quant_parity_first_divergence",
+    "quant_oracle_margin_max",
     "tpu_snapshot_file", "tpu_snapshot_captured_at",
     "tpu_snapshot_engine_tokens_per_sec_per_chip",
     "tpu_snapshot_endpoint_tokens_per_sec_per_chip",
@@ -1866,7 +2087,7 @@ def main() -> None:
                     choices=["llm", "llm_endpoint", "kernels", "coldstart",
                              "coldstart_native", "coldstart_jax",
                              "coldstart_jax_tpu", "coldstart_stream",
-                             "router", "spec"],
+                             "router", "spec", "quant"],
                     help="run one phase in-process (used by the orchestrator)")
     args = ap.parse_args()
 
@@ -1889,7 +2110,8 @@ def main() -> None:
               "coldstart_jax": bench_cold_start_jax,
               "coldstart_jax_tpu": bench_cold_start_jax_tpu,
               "coldstart_stream": bench_cold_start_stream,
-              "router": bench_router, "spec": bench_spec}[args.phase]
+              "router": bench_router, "spec": bench_spec,
+              "quant": bench_quant}[args.phase]
         try:
             print(json.dumps(fn(quick=args.quick)))
         except Exception as exc:   # noqa: BLE001 — phase errors are data

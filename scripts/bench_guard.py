@@ -51,7 +51,22 @@ GUARDED_FIELDS = {
     "spec_uplift_repetitive": "up",
     "spec_adversarial_ratio": "up",
     "spec_tokens_per_sec_on_repetitive": "up",
+    # quantized serving (ISSUE 6): the bytes-moved headlines must not
+    # decay (a dtype regression shows up here first), and the quant-on
+    # engine must not slow down
+    "quant_shard_bytes_ratio": "up",
+    "quant_kv_capacity_ratio": "up",
+    "quant_tokens_per_sec_ratio": "up",
+    "quant_tokens_per_sec_on": "up",
 }
+
+# HARD-gated fields: the quant phase's oracle-margin parity judge STRIPS
+# these from the round on failure (bench._merge_validated), so — unlike
+# ordinary new/dropped metrics, which are skipped — a base round carrying
+# them and a current round missing them IS the failure signal and must
+# fail the guard, not silently lose coverage.
+HARD_FIELDS = ("quant_shard_bytes_ratio", "quant_kv_capacity_ratio",
+               "quant_tokens_per_sec_ratio")
 
 
 def extract_metrics(path: str) -> dict:
@@ -82,6 +97,17 @@ def compare(base: dict, cur: dict, threshold: float) -> tuple[list, list]:
     current/delta_pct/status; regressions is the failing subset."""
     rows, regressions = [], []
     for field, direction in GUARDED_FIELDS.items():
+        if field in base and field not in cur and field in HARD_FIELDS:
+            # present in the base but stripped from the current round —
+            # for hard-gated fields that means the phase's own validation
+            # rejected the numbers (e.g. parity-judge failure)
+            row = {"field": field, "base": base[field], "current": None,
+                   "delta_pct": None,
+                   "status": "REGRESSION (missing — phase validation "
+                             "stripped it)"}
+            rows.append(row)
+            regressions.append(row)
+            continue
         if field not in base or field not in cur:
             continue
         b, c = base[field], cur[field]
@@ -138,6 +164,10 @@ def main(argv=None) -> int:
         print("  no shared guarded fields — nothing to compare")
         return 0
     for row in rows:
+        if row["current"] is None:
+            print(f"  REGRESSION  {row['field']}: {row['base']:g} → "
+                  f"MISSING (phase validation stripped it)")
+            continue
         print(f"  {row['status']:>10}  {row['field']}: "
               f"{row['base']:g} → {row['current']:g} "
               f"({row['delta_pct']:+.1f}%)")

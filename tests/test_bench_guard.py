@@ -83,3 +83,33 @@ def test_guard_explicit_base_current(tmp_path):
     b = _round(tmp_path, 2, {**BASE, "cold_start_p50_s": 0.8})
     assert bench_guard.main(["--base", a, "--current", b]) == 0
     assert bench_guard.main(["--base", b, "--current", a]) == 1
+
+
+def test_guard_covers_quant_fields(tmp_path):
+    """ISSUE 6 satellite: the quantized-serving headlines are guarded —
+    a decayed shard-bytes or KV-capacity ratio (a dtype regression) or a
+    quant-on throughput drop past 15% fails the round."""
+    quant = {"quant_shard_bytes_ratio": 1.95,
+             "quant_kv_capacity_ratio": 1.94,
+             "quant_tokens_per_sec_ratio": 1.2,
+             "quant_tokens_per_sec_on": 1000.0}
+    _round(tmp_path, 1, quant)
+    _round(tmp_path, 2, {**quant, "quant_kv_capacity_ratio": 1.0})  # -48%
+    assert bench_guard.main(["--dir", str(tmp_path)]) == 1
+    _round(tmp_path, 3, {**quant, "quant_kv_capacity_ratio": 1.0})
+    assert bench_guard.main(["--dir", str(tmp_path)]) == 0  # r2→r3 flat
+
+
+def test_guard_fails_when_hard_quant_fields_stripped(tmp_path, capsys):
+    """The quant phase's parity judge STRIPS headline numbers on failure
+    (bench._merge_validated) — unlike ordinary new/dropped metrics, a
+    hard-gated field present in the base and missing in the current
+    round must FAIL the guard, or a pool-write regression would pass CI
+    by erasing its own evidence."""
+    quant = {"quant_shard_bytes_ratio": 1.95,
+             "quant_kv_capacity_ratio": 1.94,
+             "quant_tokens_per_sec_ratio": 1.2}
+    _round(tmp_path, 1, quant)
+    _round(tmp_path, 2, {"cold_start_p50_s": 1.0})   # quant stripped
+    assert bench_guard.main(["--dir", str(tmp_path)]) == 1
+    assert "stripped" in capsys.readouterr().out

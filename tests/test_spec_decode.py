@@ -92,6 +92,25 @@ def test_greedy_parity_paged(params):
     assert st["spec_windows"] > 0 and st["spec_accepted"] > 0, st
 
 
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+def test_greedy_parity_paged_quantized(params, kv_quant):
+    """Quantized-preset parametrization (ISSUE 6 acceptance): int8
+    weights — and optionally the int8 KV pool — must leave spec parity
+    intact. Decode and verify quantize KV writes with the same per-vector
+    math, so spec-on equals spec-off exactly even on an int8 pool."""
+    from tpu9.ops.quant import quantize_decoder
+    qparams = quantize_decoder(params)
+    prompts = [CYCLER, list(range(2, 40))]
+    classic = _generate(
+        _engine(qparams, spec_len=0, paged=True, kv_quant=kv_quant),
+        prompts, 200)
+    spec_eng = _engine(qparams, spec_len=8, paged=True, kv_quant=kv_quant)
+    spec = _generate(spec_eng, prompts, 200)
+    assert spec == classic
+    st = spec_eng.stats()
+    assert st["spec_windows"] > 0 and st["spec_accepted"] > 0, st
+
+
 # ---------------------------------------------------------------------------
 # EOS inside an accepted draft run
 # ---------------------------------------------------------------------------

@@ -59,12 +59,23 @@ def init_moe_layer(rng: jax.Array, cfg: MoeConfig) -> Params:
 def moe_param_specs(params: Params, axis: str = "ep") -> Params:
     """Sharding: router replicated, expert stacks sharded over ``axis``
     (the expert-parallel axis by default; decoder_param_specs passes tp
-    for mixtral layers on plain serving meshes)."""
+    for mixtral layers on plain serving meshes). Per-expert int8 entries
+    (``{q: [E,in,out], scale: [E,1,out]}`` — tpu9.ops.quant) shard both
+    planes along the expert axis, mirroring sharding._quant_aware for
+    the dense 2-D weights."""
+
+    def stack(leaf):
+        from ..ops.quant import is_quantized_entry
+        spec = P(axis, None, None)
+        if is_quantized_entry(leaf):
+            return {"q": spec, "scale": spec}
+        return spec
+
     return {
         "router": P(),
-        "w_gate": P(axis, None, None),
-        "w_up": P(axis, None, None),
-        "w_down": P(axis, None, None),
+        "w_gate": stack(params["w_gate"]),
+        "w_up": stack(params["w_up"]),
+        "w_down": stack(params["w_down"]),
     }
 
 
@@ -118,13 +129,17 @@ def moe_ffn(params: Params, x: jnp.ndarray, cfg: MoeConfig,
                     xf.astype(cfg.dtype))                        # [E, C, d]
     if ep_sharded:
         xe = jax.lax.with_sharding_constraint(xe, P("ep", None, None))
-    h = jnp.einsum("ecd,edh->ech", xe, params["w_gate"])
+    # maybe_einsum: expert stacks may be per-expert int8 entries
+    # (tpu9.ops.quant.quantize_weight_stacked) — the int8 operand stays
+    # int8 in HBM, scales [E, 1, out] apply on the einsum output
+    from ..ops.quant import maybe_einsum
+    h = maybe_einsum("ecd,edh->ech", xe, params["w_gate"])
     if cfg.act == "silu":
         h = jax.nn.silu(h)
     else:
         h = jax.nn.gelu(h, approximate=True)
-    h = h * jnp.einsum("ecd,edh->ech", xe, params["w_up"])
-    ye = jnp.einsum("ech,ehd->ecd", h, params["w_down"])         # [E, C, d]
+    h = h * maybe_einsum("ecd,edh->ech", xe, params["w_up"])
+    ye = maybe_einsum("ech,ehd->ecd", h, params["w_down"])       # [E, C, d]
     if ep_sharded:
         ye = jax.lax.with_sharding_constraint(ye, P("ep", None, None))
 

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention, decode_attention
 from ..ops.norms import rms_norm
-from ..ops.quant import maybe_matmul
+from ..ops.quant import maybe_matmul, quantize_kv
 from ..ops.rotary import apply_rope, rope_table
 
 Params = dict[str, Any]
@@ -148,7 +148,9 @@ def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
         # paged decode: scatter this token's k/v into the slot's physical
         # pool block, then block-table paged attention over the prefix.
         # Pool layout [N_BLOCKS, BS, KH, D] is shared by all sequences —
-        # prefix blocks can be referenced by many tables (prefix reuse)
+        # prefix blocks can be referenced by many tables (prefix reuse).
+        # An int8 pool ("k_scale" present) quantizes the write per
+        # (token, head) vector and the attention dequantizes in-kernel.
         from ..ops.attention import paged_attention_dispatch
         table = kv_cache["table"]                      # [B, MB]
         bs = kv_cache["k"].shape[2]                    # [L,N,BS,KH,D]
@@ -156,10 +158,22 @@ def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
         rows = jnp.arange(b)
         bi = table[rows, pos // bs]
         oi = pos % bs
-        k_pool = kv_cache["k"][layer_idx].at[bi, oi].set(k[:, 0])
-        v_pool = kv_cache["v"][layer_idx].at[bi, oi].set(v[:, 0])
-        out = paged_attention_dispatch(q, k_pool, v_pool, table, cache_len)
-        new_cache = (k_pool, v_pool)
+        if "k_scale" in kv_cache:
+            qk, sk = quantize_kv(k[:, 0])              # [B,KH,D], [B,KH]
+            qv, sv = quantize_kv(v[:, 0])
+            k_pool = kv_cache["k"][layer_idx].at[bi, oi].set(qk)
+            v_pool = kv_cache["v"][layer_idx].at[bi, oi].set(qv)
+            k_sc = kv_cache["k_scale"][layer_idx].at[bi, oi].set(sk)
+            v_sc = kv_cache["v_scale"][layer_idx].at[bi, oi].set(sv)
+            out = paged_attention_dispatch(q, k_pool, v_pool, table,
+                                           cache_len, k_sc, v_sc)
+            new_cache = (k_pool, v_pool, k_sc, v_sc)
+        else:
+            k_pool = kv_cache["k"][layer_idx].at[bi, oi].set(k[:, 0])
+            v_pool = kv_cache["v"][layer_idx].at[bi, oi].set(v[:, 0])
+            out = paged_attention_dispatch(q, k_pool, v_pool, table,
+                                           cache_len)
+            new_cache = (k_pool, v_pool)
     elif "table" in kv_cache:
         # paged multi-token VERIFY (speculative decoding): scatter all T
         # window tokens' k/v into the slots' physical pool blocks in one
@@ -172,10 +186,22 @@ def _attn_block(layer: Params, x: jnp.ndarray, cfg: DecoderConfig,
         bs = kv_cache["k"].shape[2]                    # [L,N,BS,KH,D]
         bi = jnp.take_along_axis(table, positions // bs, axis=1)  # [B,T]
         oi = positions % bs
-        k_pool = kv_cache["k"][layer_idx].at[bi, oi].set(k)
-        v_pool = kv_cache["v"][layer_idx].at[bi, oi].set(v)
-        out = paged_verify_attention(q, k_pool, v_pool, table, positions)
-        new_cache = (k_pool, v_pool)
+        if "k_scale" in kv_cache:
+            qk, sk = quantize_kv(k)                    # [B,T,KH,D],[B,T,KH]
+            qv, sv = quantize_kv(v)
+            k_pool = kv_cache["k"][layer_idx].at[bi, oi].set(qk)
+            v_pool = kv_cache["v"][layer_idx].at[bi, oi].set(qv)
+            k_sc = kv_cache["k_scale"][layer_idx].at[bi, oi].set(sk)
+            v_sc = kv_cache["v_scale"][layer_idx].at[bi, oi].set(sv)
+            out = paged_verify_attention(q, k_pool, v_pool, table,
+                                         positions, k_sc, v_sc)
+            new_cache = (k_pool, v_pool, k_sc, v_sc)
+        else:
+            k_pool = kv_cache["k"][layer_idx].at[bi, oi].set(k)
+            v_pool = kv_cache["v"][layer_idx].at[bi, oi].set(v)
+            out = paged_verify_attention(q, k_pool, v_pool, table,
+                                         positions)
+            new_cache = (k_pool, v_pool)
     elif decode:
         # scatter this token's k/v at positions, then attend over the prefix
         k_cache = jax.lax.dynamic_update_slice(
@@ -285,14 +311,13 @@ def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
                 f"{rope_len} — positions past it would alias")
     sin, cos = rope_table(rope_len, cfg.head_dim, cfg.rope_theta)
 
-    new_k, new_v = [], []
+    updates: list = []        # per-layer (k, v[, k_scale, v_scale]) tuples
     moe_balance = jnp.zeros((), jnp.float32)
     for i, layer in enumerate(params["layers"]):
         x, updated = _attn_block(layer, x, cfg, positions, sin, cos,
                                  kv_cache, i, cache_len, decode)
         if updated is not None:
-            new_k.append(updated[0])
-            new_v.append(updated[1])
+            updates.append(updated)
         x, aux = _mlp_block(layer, x, cfg)
         if aux is not None:
             moe_balance = moe_balance + aux["balance_loss"]
@@ -311,7 +336,11 @@ def decoder_forward(params: Params, tokens: jnp.ndarray, cfg: DecoderConfig,
     out = x if return_hidden else logits
 
     def _pack_cache():
-        cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        cache = {"k": jnp.stack([u[0] for u in updates]),
+                 "v": jnp.stack([u[1] for u in updates])}
+        if updates and len(updates[0]) == 4:     # int8 pool: scales ride
+            cache["k_scale"] = jnp.stack([u[2] for u in updates])
+            cache["v_scale"] = jnp.stack([u[3] for u in updates])
         if "table" in (kv_cache or {}):
             cache["table"] = kv_cache["table"]   # paged: table rides along
         return cache

@@ -236,7 +236,9 @@ def chunk_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
 
 def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            v_pool: jnp.ndarray, block_table: jnp.ndarray,
-                           positions: jnp.ndarray) -> jnp.ndarray:
+                           positions: jnp.ndarray,
+                           k_scale: jnp.ndarray = None,
+                           v_scale: jnp.ndarray = None) -> jnp.ndarray:
     """Multi-token attention against the paged pool for one speculative
     VERIFY pass: q [B, T, QH, D] are the window's queries at absolute
     ``positions`` [B, T]; k/v_pool [N, BS, KH, D] already contain the
@@ -251,31 +253,42 @@ def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     bandwidth-bound decode regime: the weight stream is paid once for T
     tokens instead of once per token. (A pallas kernel that walks the
     table without the densify copy is the on-chip optimization path; the
-    gather form is the correctness-first dispatch every backend runs.)"""
-    b = q.shape[0]
-    mb, bs = block_table.shape[1], k_pool.shape[1]
-    kh, d = k_pool.shape[2], k_pool.shape[3]
-    k = k_pool[block_table].reshape(b, mb * bs, kh, d)
-    v = v_pool[block_table].reshape(b, mb * bs, kh, d)
+    gather form is the correctness-first dispatch every backend runs.)
+
+    An int8 pool passes ``k_scale``/``v_scale`` [N, BS, KH] — blocks are
+    dequantized right after the gather (per-vector scales, see
+    ``tpu9.ops.quant.quantize_kv``; densify+dequant shared with the
+    decode oracle via ``paged_attention.gather_paged``)."""
+    from .paged_attention import gather_paged
+    k = gather_paged(k_pool, block_table, k_scale, q.dtype)
+    v = gather_paged(v_pool, block_table, v_scale, q.dtype)
     return chunk_prefill_attention(q, k, v, positions)
 
 
 def paged_attention_dispatch(q: jnp.ndarray, k_pool: jnp.ndarray,
                              v_pool: jnp.ndarray, block_table: jnp.ndarray,
-                             cache_len: jnp.ndarray) -> jnp.ndarray:
+                             cache_len: jnp.ndarray,
+                             k_scale: jnp.ndarray = None,
+                             v_scale: jnp.ndarray = None) -> jnp.ndarray:
     """Block-table paged decode dispatch: pallas kernel on TPU (physical
     blocks DMA'd by table lookup in the index map — no densify copy),
-    gather + XLA oracle elsewhere."""
+    gather + XLA oracle elsewhere. ``k_scale``/``v_scale`` [N, BS, KH]
+    mark an int8 pool — the kernel dequantizes in-register after the DMA,
+    so HBM only ever moves the int8 payload + the per-vector scales."""
     from ..utils import on_tpu as _on_tpu
     from .paged_attention import (paged_decode_attention,
+                                  paged_decode_attention_quant,
                                   xla_paged_decode_attention)
     block_s = k_pool.shape[1]
     if (_on_tpu() and block_s % 128 == 0
             and q.shape[-1] in (64, 128, 256)):
+        if k_scale is not None:
+            return paged_decode_attention_quant(
+                q, k_pool, v_pool, k_scale, v_scale, block_table, cache_len)
         return paged_decode_attention(q, k_pool, v_pool, block_table,
                                       cache_len)
     return xla_paged_decode_attention(q, k_pool, v_pool, block_table,
-                                      cache_len)
+                                      cache_len, k_scale, v_scale)
 
 
 def xla_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,

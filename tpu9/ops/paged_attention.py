@@ -28,6 +28,38 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _head_update(h, q, k, v, sb, seq_len, m_scr, l_scr, acc_scr,
+                 block_s: int):
+    """One kv head's online-softmax update for one sequence block — the
+    body shared by the bf16 and int8-dequant kernels (q/k/v arrive f32,
+    q pre-scaled; dequantization, if any, already happened)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    pos = sb * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < seq_len, s, NEG_INF)
+
+    m_prev = m_scr[h]
+    l_prev = l_scr[h]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])
+    p = jnp.where(pos < seq_len, p, 0.0)
+    l_scr[h] = alpha * l_prev + jnp.broadcast_to(
+        jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
+    acc_scr[h] = acc_scr[h] * alpha[:, :1] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[h] = m_new
+
+
+def _finalize_heads(o_ref, m_scr, l_scr, acc_scr, kv_heads: int):
+    for h in range(kv_heads):
+        l = l_scr[h][:, :1]
+        o_ref[0, h] = (acc_scr[h] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype)
+
+
 def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
             scale: float, block_s: int, num_sb: int, kv_heads: int):
     b = pl.program_id(0)
@@ -49,32 +81,12 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
             q = q_ref[0, h].astype(jnp.float32) * scale     # [group, D]
             k = k_ref[0, :, h, :].astype(jnp.float32)       # [block_s, D]
             v = v_ref[0, :, h, :].astype(jnp.float32)
-            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-            pos = sb * block_s + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(pos < seq_len, s, NEG_INF)
-
-            m_prev = m_scr[h]
-            l_prev = l_scr[h]
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
-            alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(s - m_new[:, :1])
-            p = jnp.where(pos < seq_len, p, 0.0)
-            l_scr[h] = alpha * l_prev + jnp.broadcast_to(
-                jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
-            acc_scr[h] = acc_scr[h] * alpha[:, :1] + jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            m_scr[h] = m_new
+            _head_update(h, q, k, v, sb, seq_len, m_scr, l_scr, acc_scr,
+                         block_s)
 
     @pl.when(sb == num_sb - 1)
     def _finalize():
-        for h in range(kv_heads):
-            l = l_scr[h][:, :1]
-            o_ref[0, h] = (acc_scr[h] / jnp.maximum(l, 1e-30)).astype(
-                o_ref.dtype)
+        _finalize_heads(o_ref, m_scr, l_scr, acc_scr, kv_heads)
 
 
 @functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
@@ -138,6 +150,18 @@ def ragged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
 # block-table paged decode: cache lives in a shared block POOL
 # ---------------------------------------------------------------------------
 
+def _table_block(table, b, sb, lens, block_s: int):
+    """Physical pool block for grid step ``sb``: past-the-end steps CLAMP
+    to the sequence's last valid block (same physical index as the
+    previous step ⇒ Mosaic elides the DMA), so only ceil(len/BS) pool
+    blocks are read per sequence regardless of table width. ONE
+    implementation — the bf16 and int8 kernels' index maps (payload AND
+    scale planes) must never diverge on this."""
+    last = jnp.maximum(
+        jax.lax.div(lens[b] + block_s - 1, block_s) - 1, 0)
+    return table[b, jnp.minimum(sb, last)]
+
+
 def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale: float, block_s: int,
                   num_sb: int, kv_heads: int):
@@ -180,13 +204,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                                kv_heads=kv_heads)
 
     def kv_index(b, sb, table, lens):
-        # physical block from the table; past-the-end steps clamp to the
-        # sequence's LAST valid block (same physical index as the previous
-        # step ⇒ Mosaic elides the DMA), so only ceil(len/BS) pool blocks
-        # are read per sequence regardless of MAX_BLOCKS
-        last = jnp.maximum(
-            jax.lax.div(lens[b] + block_s - 1, block_s) - 1, 0)
-        return (table[b, jnp.minimum(sb, last)], 0, 0, 0)
+        return (_table_block(table, b, sb, lens, block_s), 0, 0, 0)
 
     out = pl.pallas_call(
         kernel,
@@ -219,21 +237,139 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     return out.reshape(batch, 1, q_heads, head_dim)
 
 
-def gather_paged(pool: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray:
+def _paged_quant_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                        vs_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                        scale: float, block_s: int, num_sb: int,
+                        kv_heads: int):
+    """int8-pool variant of :func:`_paged_kernel`: the k/v blocks DMA'd by
+    table lookup are int8 and the per-vector scales ride in two small f32
+    side inputs with the SAME index map — dequantization is one in-register
+    multiply per block, so HBM moves half the cache bytes."""
+    del table_ref
+    b = pl.program_id(0)
+    sb = pl.program_id(1)
+    seq_len = len_ref[b]
+
+    @pl.when(sb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(sb * block_s < seq_len)
+    def _compute():
+        for h in range(kv_heads):
+            q = q_ref[0, h].astype(jnp.float32) * scale     # [group, D]
+            k = (k_ref[0, :, h, :].astype(jnp.float32)
+                 * ks_ref[0, :, h][:, None])                # [block_s, D]
+            v = (v_ref[0, :, h, :].astype(jnp.float32)
+                 * vs_ref[0, :, h][:, None])
+            _head_update(h, q, k, v, sb, seq_len, m_scr, l_scr, acc_scr,
+                         block_s)
+
+    @pl.when(sb == num_sb - 1)
+    def _finalize():
+        _finalize_heads(o_ref, m_scr, l_scr, acc_scr, kv_heads)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_quant(q: jnp.ndarray, k_pool: jnp.ndarray,
+                                 v_pool: jnp.ndarray,
+                                 k_scale: jnp.ndarray,
+                                 v_scale: jnp.ndarray,
+                                 block_table: jnp.ndarray,
+                                 cache_len: jnp.ndarray,
+                                 interpret: bool = False) -> jnp.ndarray:
+    """:func:`paged_decode_attention` over an int8 pool: k/v_pool
+    [N_BLOCKS, BS, KH, D] int8, k/v_scale [N_BLOCKS, BS, KH] f32 (one
+    absmax scale per (token, head) vector — ``tpu9.ops.quant.quantize_kv``).
+    Identical masking/softmax semantics; the only difference is the
+    in-kernel dequant multiply after each block DMA."""
+    batch, _, q_heads, head_dim = q.shape
+    n_blocks, block_s, kv_heads, _ = k_pool.shape
+    max_sb = block_table.shape[1]
+    assert q_heads % kv_heads == 0
+    group = q_heads // kv_heads
+
+    qt = q.reshape(batch, kv_heads, group, head_dim)
+    grid = (batch, max_sb)
+    kernel = functools.partial(_paged_quant_kernel, scale=head_dim ** -0.5,
+                               block_s=block_s, num_sb=max_sb,
+                               kv_heads=kv_heads)
+
+    def kv_index(b, sb, table, lens):
+        return (_table_block(table, b, sb, lens, block_s), 0, 0, 0)
+
+    def sc_index(b, sb, table, lens):
+        return (_table_block(table, b, sb, lens, block_s), 0, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, kv_heads, group, head_dim),
+                             lambda b, sb, table, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, block_s, kv_heads, head_dim),
+                             lambda b, sb, table, lens: kv_index(
+                                 b, sb, table, lens)),
+                pl.BlockSpec((1, block_s, kv_heads, head_dim),
+                             lambda b, sb, table, lens: kv_index(
+                                 b, sb, table, lens)),
+                pl.BlockSpec((1, block_s, kv_heads),
+                             lambda b, sb, table, lens: sc_index(
+                                 b, sb, table, lens)),
+                pl.BlockSpec((1, block_s, kv_heads),
+                             lambda b, sb, table, lens: sc_index(
+                                 b, sb, table, lens)),
+            ],
+            out_specs=pl.BlockSpec((1, kv_heads, group, head_dim),
+                                   lambda b, sb, table, lens: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((kv_heads, group, 128), jnp.float32),
+                pltpu.VMEM((kv_heads, group, 128), jnp.float32),
+                pltpu.VMEM((kv_heads, group, head_dim), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), cache_len.astype(jnp.int32),
+      qt, k_pool, v_pool, k_scale, v_scale)
+
+    return out.reshape(batch, 1, q_heads, head_dim)
+
+
+def gather_paged(pool: jnp.ndarray, block_table: jnp.ndarray,
+                 scale: jnp.ndarray = None,
+                 dtype=None) -> jnp.ndarray:
     """Densify a paged cache: pool [N,BS,KH,D] + table [B,MB] →
     [B, MB*BS, KH, D]. The XLA fallback path and the chunked-prefill
-    prefix view both use this."""
+    prefix view both use this. ``scale`` [N,BS,KH] marks an int8 pool:
+    the scale planes are gathered by the SAME table and the result is
+    dequantized to ``dtype`` — one implementation of densify+dequant so
+    the decode-oracle and verify paths cannot drift."""
     b, mb = block_table.shape
     _, bs, kh, d = pool.shape
-    return pool[block_table.reshape(-1)].reshape(b, mb * bs, kh, d)
+    flat = block_table.reshape(-1)
+    dense = pool[flat].reshape(b, mb * bs, kh, d)
+    if scale is not None:
+        from .quant import dequantize_kv
+        sc = scale[flat].reshape(b, mb * bs, kh)
+        dense = dequantize_kv(dense, sc, dtype or jnp.bfloat16)
+    return dense
 
 
 def xla_paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                                v_pool: jnp.ndarray,
                                block_table: jnp.ndarray,
-                               cache_len: jnp.ndarray) -> jnp.ndarray:
-    """Correctness oracle + CPU path: densify then regular ragged decode."""
+                               cache_len: jnp.ndarray,
+                               k_scale: jnp.ndarray = None,
+                               v_scale: jnp.ndarray = None) -> jnp.ndarray:
+    """Correctness oracle + CPU path: densify then regular ragged decode.
+    ``k_scale``/``v_scale`` [N, BS, KH] mark an int8 pool — blocks are
+    dequantized right after the gather."""
     from .attention import xla_decode_attention
-    k = gather_paged(k_pool, block_table)
-    v = gather_paged(v_pool, block_table)
+    k = gather_paged(k_pool, block_table, k_scale, q.dtype)
+    v = gather_paged(v_pool, block_table, v_scale, q.dtype)
     return xla_decode_attention(q, k, v, cache_len)

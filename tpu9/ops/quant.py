@@ -18,14 +18,59 @@ Params = dict[str, Any]
 # decoder projection weights worth quantizing (2-D, large)
 _TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head")
 
+# quantization modes the serving stack understands (fp8 is the ROADMAP
+# follow-up — add it HERE and every knob's validation picks it up)
+SUPPORTED_MODES = ("int8",)
 
-def quantize_weight(w: jnp.ndarray) -> dict:
-    """[in, out] → int8 values + f32 per-output-channel scales."""
+
+def validate_quant_mode(mode, what: str = "quantize") -> str:
+    """Normalize a quantization-mode knob: ``None``/``""`` → ``""`` (off),
+    a supported mode passes through, anything else raises. The ONE
+    validation every layer's knob (`presets.resolve_preset`/`load_engine`,
+    `weights.save_params`, `runner.ckpt.save_params`, `EngineConfig`)
+    funnels through, so a new mode cannot be accepted at one layer and
+    rejected at another."""
+    if mode in (None, ""):
+        return ""
+    if mode not in SUPPORTED_MODES:
+        raise ValueError(f"unknown {what} mode {mode!r} "
+                         f"(supported: {', '.join(SUPPORTED_MODES)})")
+    return mode
+
+
+def _quantize_along(w: jnp.ndarray, axis: int) -> dict:
+    """ONE symmetric-absmax int8 recipe (per-output-channel scales along
+    ``axis``), shared by the 2-D and stacked-expert entry points so a
+    future recipe change (clipping, epsilon) cannot drift between them."""
     wf = w.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(wf), axis=0, keepdims=True) / 127.0
+    scale = jnp.max(jnp.abs(wf), axis=axis, keepdims=True) / 127.0
     scale = jnp.maximum(scale, 1e-8)
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
     return {"q": q, "scale": scale.astype(jnp.float32)}
+
+
+def quantize_weight(w: jnp.ndarray) -> dict:
+    """[in, out] → int8 values + f32 per-output-channel scales [1, out]."""
+    return _quantize_along(w, axis=0)
+
+
+def quantize_weight_stacked(w: jnp.ndarray) -> dict:
+    """Stacked expert weights [E, in, out] → per-expert per-output-channel
+    int8 (scales [E, 1, out]): quantization never mixes experts, so each
+    expert's error bound matches the 2-D recipe exactly."""
+    return _quantize_along(w, axis=1)
+
+
+def quantized_einsum(spec: str, x: jnp.ndarray, entry: dict) -> jnp.ndarray:
+    """Batched (stacked-expert) variant of :func:`quantized_matmul`:
+    ``einsum(spec, x, w)`` where ``w`` is a stacked int8 entry. The scale
+    multiply happens on the OUTPUT (scale broadcasts as [E, 1, out]), so
+    the weight operand stays int8 in HBM — same recipe, one expert axis
+    along for the ride."""
+    acc = jnp.einsum(spec, x.astype(jnp.bfloat16),
+                     entry["q"].astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return (acc * entry["scale"]).astype(x.dtype)
 
 
 def dequantize_weight(entry: dict, dtype=jnp.bfloat16) -> jnp.ndarray:
@@ -42,34 +87,39 @@ def quantized_matmul(x: jnp.ndarray, entry: dict) -> jnp.ndarray:
     return (acc * entry["scale"]).astype(x.dtype)
 
 
+def is_quantized_entry(w) -> bool:
+    """True for a ``{q, scale}`` pair this module produced."""
+    return isinstance(w, dict) and "q" in w
+
+
 def quantize_decoder(params: Params) -> Params:
     """Quantize a decoder param tree's projections in place-shape (norms and
-    embeddings stay high precision; embeddings are gathers, not matmuls)."""
+    embeddings stay high precision; embeddings are gathers, not matmuls).
+    Stacked MoE expert weights (``layer["moe"]["w_*"]`` [E, in, out])
+    quantize per-expert — a Mixtral's bytes are ~85% experts, so skipping
+    them would leave the tree effectively bf16. IDEMPOTENT: already-
+    quantized entries pass through untouched, so mixed trees and double
+    application (e.g. an int8-preset tree saved with TPU9_CKPT_QUANT set)
+    are safe."""
     out = dict(params)
-    if "lm_head" in params:
+    if "lm_head" in params and not is_quantized_entry(params["lm_head"]):
         out["lm_head"] = quantize_weight(params["lm_head"])
     out["layers"] = []
-    skipped_bytes = 0
     for layer in params["layers"]:
         new_layer = dict(layer)
         for name in _TARGETS:
+            # 2-D only: no init path stores stacked 3-D weights flat in a
+            # layer (MoE stacks live under layer["moe"], handled below) —
+            # and the dense forward/sharding paths could not consume one
             if name in layer and getattr(layer[name], "ndim", 0) == 2:
                 new_layer[name] = quantize_weight(layer[name])
-            elif name in layer and getattr(layer[name], "ndim", 0) == 3:
-                # stacked MoE expert weights: per-expert int8 is not yet
-                # wired through the MoE forward — leaving them bf16 is
-                # ~85% of a Mixtral's bytes, so say so LOUDLY (the HBM
-                # feasibility gate accounts these at bf16 for the same
-                # reason)
-                skipped_bytes += (layer[name].size
-                                  * layer[name].dtype.itemsize)
+        if "moe" in layer:
+            moe = dict(layer["moe"])
+            for name in ("w_gate", "w_up", "w_down"):
+                if not is_quantized_entry(moe[name]):
+                    moe[name] = quantize_weight_stacked(moe[name])
+            new_layer["moe"] = moe            # router stays f32 (tiny)
         out["layers"].append(new_layer)
-    if skipped_bytes:
-        import logging
-        logging.getLogger("tpu9.ops").warning(
-            "quantize_decoder: %d MiB of stacked expert weights stay "
-            "bf16 (MoE int8 unsupported) — plan HBM accordingly",
-            skipped_bytes >> 20)
     return out
 
 
@@ -89,12 +139,28 @@ def _random_quantized(rng, in_dim: int, out_dim: int) -> dict:
     return {"q": q, "scale": scale}
 
 
+def _random_quantized_stacked(rng, n_experts: int, in_dim: int,
+                              out_dim: int) -> dict:
+    """Stacked-expert analogue of :func:`_random_quantized`: int8 values
+    [E, in, out] + scales [E, 1, out], synthesized without the bf16
+    intermediate."""
+    rq, rs = jax.random.split(rng)
+    q = jax.random.randint(rq, (n_experts, in_dim, out_dim), -127, 128,
+                           dtype=jnp.int8)
+    std = (2.0 / (in_dim + out_dim)) ** 0.5
+    scale = (jax.random.uniform(rs, (n_experts, 1, out_dim), jnp.float32,
+                                0.8, 1.2) * std / 73.0)
+    return {"q": q, "scale": scale}
+
+
 def init_quantized_decoder(rng, cfg) -> Params:
     """``init_decoder``-shaped tree with int8 projections synthesized
     directly on device. Same tree structure/path names as
     ``tpu9.models.transformer.init_decoder`` so sharding rules and
-    ``decoder_forward`` apply unchanged."""
-    n_rngs = cfg.n_layers * 7 + 3
+    ``decoder_forward`` apply unchanged. MoE configs get per-expert int8
+    stacks under ``layer["moe"]`` (router f32, like ``init_moe_layer``)."""
+    per_layer = 5 if cfg.n_experts else 7   # 4 attn + 1 moe | 4 attn + 3 ffn
+    n_rngs = cfg.n_layers * per_layer + 3
     rngs = jax.random.split(rng, n_rngs)
     it = iter(range(n_rngs))
 
@@ -122,10 +188,28 @@ def init_quantized_decoder(rng, cfg) -> Params:
             "wk": _random_quantized(nxt(), cfg.dim, kv_dim),
             "wv": _random_quantized(nxt(), cfg.dim, kv_dim),
             "wo": _random_quantized(nxt(), q_dim, cfg.dim),
-            "w_gate": _random_quantized(nxt(), cfg.dim, cfg.hidden_dim),
-            "w_up": _random_quantized(nxt(), cfg.dim, cfg.hidden_dim),
-            "w_down": _random_quantized(nxt(), cfg.hidden_dim, cfg.dim),
         }
+        if cfg.n_experts:
+            e = cfg.n_experts
+            r_router, r_gate, r_up, r_down = jax.random.split(nxt(), 4)
+            scale = (2.0 / (cfg.dim + e)) ** 0.5
+            layer["moe"] = {
+                "router": jax.random.normal(
+                    r_router, (cfg.dim, e), jnp.float32) * scale,
+                "w_gate": _random_quantized_stacked(
+                    r_gate, e, cfg.dim, cfg.hidden_dim),
+                "w_up": _random_quantized_stacked(
+                    r_up, e, cfg.dim, cfg.hidden_dim),
+                "w_down": _random_quantized_stacked(
+                    r_down, e, cfg.hidden_dim, cfg.dim),
+            }
+        else:
+            layer["w_gate"] = _random_quantized(nxt(), cfg.dim,
+                                                cfg.hidden_dim)
+            layer["w_up"] = _random_quantized(nxt(), cfg.dim,
+                                              cfg.hidden_dim)
+            layer["w_down"] = _random_quantized(nxt(), cfg.hidden_dim,
+                                                cfg.dim)
         params["layers"].append(layer)
     return params
 
@@ -133,11 +217,45 @@ def init_quantized_decoder(rng, cfg) -> Params:
 def maybe_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """Matmul that accepts either a plain array or a quantized entry —
     lets the decoder forward run on mixed trees."""
-    if isinstance(w, dict) and "q" in w:
+    if is_quantized_entry(w):
         return quantized_matmul(x, w)
     return x @ w
 
 
+def maybe_einsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """Einsum that accepts a plain stacked array or a stacked int8 entry
+    (the MoE forward's mixed-tree analogue of :func:`maybe_matmul`)."""
+    if is_quantized_entry(w):
+        return quantized_einsum(spec, x, w)
+    return jnp.einsum(spec, x, w)
+
+
+# ---------------------------------------------------------------------------
+# int8 KV cache (paged pool)
+# ---------------------------------------------------------------------------
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize KV vectors along the head_dim axis: ``x [..., D]`` →
+    ``(int8 [..., D], f32 scales [...])`` with one symmetric absmax scale
+    per (token, head) vector. Per-vector scales mean a decode write is a
+    PURE LOCAL op — a new token can never force requantization of the
+    blocks already in the pool (a coarser per-block scale would)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray,
+                  dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Inverse of :func:`quantize_kv` (scale broadcasts over head_dim)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def quantized_bytes(params: Params) -> int:
-    return sum(x.size * x.dtype.itemsize
+    """HBM bytes of a (possibly mixed) param tree at its stored dtypes.
+    Works on abstract trees too (``jax.eval_shape`` output) — the
+    feasibility gate prices presets with it without materializing them."""
+    import numpy as np
+    return sum(int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
                for x in jax.tree_util.tree_leaves(params))

@@ -50,10 +50,16 @@ def _build_engine(obj):
         # instead of serializing XLA behind the weight load.
         # TPU9_SPEC_LEN opts the deployment into self-speculative decoding
         # (prompt-lookup drafts, ISSUE 5) without a handler change —
-        # greedy output is identical either way, only tokens/sec moves
+        # greedy output is identical either way, only tokens/sec moves.
+        # TPU9_QUANTIZE / TPU9_KV_QUANT (e.g. "int8") opt into quantized
+        # serving (ISSUE 6): int8 weights / int8 paged KV pool — same
+        # no-handler-change contract, per-deployment.
         from ..serving.presets import load_engine
         spec_len = int(os.environ.get("TPU9_SPEC_LEN", "0") or 0)
-        return load_engine(obj, compile_ahead=True, spec_len=spec_len)
+        quantize = os.environ.get("TPU9_QUANTIZE", "") or None
+        kv_quant = os.environ.get("TPU9_KV_QUANT", "") or None
+        return load_engine(obj, compile_ahead=True, spec_len=spec_len,
+                           quantize=quantize, kv_quant=kv_quant)
     raise TypeError(f"handler must return an engine, (params, cfg) or a "
                     f"preset name; got {type(obj)}")
 
@@ -190,6 +196,10 @@ async def amain() -> None:
                     extra = {"queued": stats.get("queued", 0)}
                     for k in ("kv_blocks_free", "kv_blocks_used",
                               "kv_blocks_reserved", "kv_block_size",
+                              # int8 KV pool flag (ISSUE 6): the block
+                              # counts already reflect the 2x pool, this
+                              # labels WHY a replica reports double
+                              "kv_quant",
                               # speculative-decoding acceptance (ISSUE 5):
                               # the router aggregates these into the
                               # fleet-wide tpu9_router_spec_* signals

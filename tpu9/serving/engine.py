@@ -69,6 +69,14 @@ class EngineConfig:
     # pool blocks the engine-level prefix cache may hold for KV reuse
     # across requests sharing a prompt prefix; 0 disables
     prefix_cache_blocks: int = 0
+    # "int8" stores the paged KV pool as int8 with per-(position, head)
+    # f32 absmax scales alongside it (ISSUE 6): writes quantize, the
+    # decode/verify attention dequantizes in-kernel, and with
+    # kv_pool_blocks=0 (auto) the pool is sized to the SAME HBM bytes the
+    # bf16 pool would have used — i.e. ~2x the blocks, which is directly
+    # more admission headroom (reservations, router kv_blocks signal).
+    # Requires the paged engine ("" = full-precision pool).
+    kv_quant: str = ""
     # chunks per fused admission dispatch (VERDICT r04 #6): a group of G
     # chunks runs as ONE lax.scan graph (chunk prefill + block splice
     # fused), and the serve loop interleaves a decode window between
@@ -141,6 +149,18 @@ class InferenceEngine:
         self.ecfg = engine_cfg
         b, s = engine_cfg.max_batch, engine_cfg.max_seq_len
         self.paged = engine_cfg.kv_block_size > 0
+        from ..ops.quant import validate_quant_mode
+        _kvq = validate_quant_mode(engine_cfg.kv_quant, "kv_quant")
+        if _kvq and _kvq != "int8":
+            # a mode added to SUPPORTED_MODES but not wired here must
+            # fail, not silently serve a full-precision pool the caller
+            # sized admission/HBM around
+            raise NotImplementedError(
+                f"kv_quant mode {_kvq!r} is not wired into the engine")
+        self.kv_quant = _kvq == "int8"
+        if self.kv_quant and not self.paged:
+            raise ValueError("kv_quant='int8' requires the paged engine "
+                             "(kv_block_size > 0)")
         if self.paged:
             from .paged_kv import BlockAllocator, PrefixCache
             bs = engine_cfg.kv_block_size
@@ -164,9 +184,22 @@ class InferenceEngine:
                     f"max_seq_len {s} must be a multiple of "
                     f"prefill_chunk {chunk}")
             self._chunk = chunk     # the validated value IS the used value
+            if engine_cfg.kv_pool_blocks:
+                base_blocks = engine_cfg.kv_pool_blocks
+            else:
+                base_blocks = b * s // bs            # dense parity
+                if self.kv_quant:
+                    # equal-HBM sizing: the int8 pool spends the same
+                    # bytes the bf16 pool would have — ~2x the blocks,
+                    # which is the whole point (capacity == admission
+                    # headroom == the router's kv_blocks signal)
+                    from .paged_kv import kv_block_bytes
+                    base_blocks = (base_blocks
+                                   * kv_block_bytes(cfg, bs, False)
+                                   // kv_block_bytes(cfg, bs, True))
             # +1: one dedicated TRASH block absorbs splice writes of the
             # padded tail of a non-block-aligned final chunk
-            n_blocks = (engine_cfg.kv_pool_blocks or (b * s // bs)) + 1
+            n_blocks = base_blocks + 1
             # table width: +1 ALWAYS-TRASH column — a decode write at
             # position S (cache full; callers should bound it, but a
             # regression must not corrupt data) computes pos // bs == S/bs
@@ -177,10 +210,19 @@ class InferenceEngine:
             pool_shape = (cfg.n_layers, n_blocks, bs, cfg.n_kv_heads,
                           cfg.head_dim)
             self.kv_cache = {
-                "k": jnp.zeros(pool_shape, cfg.dtype),
-                "v": jnp.zeros(pool_shape, cfg.dtype),
+                "k": jnp.zeros(pool_shape,
+                               jnp.int8 if self.kv_quant else cfg.dtype),
+                "v": jnp.zeros(pool_shape,
+                               jnp.int8 if self.kv_quant else cfg.dtype),
                 "table": jnp.zeros((b, self._mb), jnp.int32),
             }
+            if self.kv_quant:
+                # per-(position, head) f32 absmax scales alongside the
+                # pool (ops.quant.quantize_kv) — same [N, BS, KH] indexing
+                # as the payload so every write/read shares the table math
+                sc_shape = pool_shape[:-1]
+                self.kv_cache["k_scale"] = jnp.zeros(sc_shape, jnp.float32)
+                self.kv_cache["v_scale"] = jnp.zeros(sc_shape, jnp.float32)
             self.allocator = BlockAllocator(n_blocks, bs)
             self._trash_block = self.allocator.alloc(1)[0]
             # inactive decode lanes scatter through their (zero-padded)
@@ -480,19 +522,37 @@ class InferenceEngine:
             logits[0], last_idx, axis=0, keepdims=False)
         return last, scratch
 
-    def _traced_splice(self, pool_k, pool_v, scratch_k, scratch_v, offset,
-                       phys):
+    def _pool_dict(self) -> dict:
+        """The kv pool's array view (payload + scales, no table) — the
+        pytree the splice/gather/fused-group graphs take and return."""
+        keys = ("k", "v", "k_scale", "v_scale") if self.kv_quant \
+            else ("k", "v")
+        return {k: self.kv_cache[k] for k in keys}
+
+    def _set_pool(self, pool: dict) -> None:
+        self.kv_cache.update(pool)
+
+    def _traced_splice(self, pool, scratch_k, scratch_v, offset, phys):
         """Traced block copy shared by the splice and fused-group graphs:
-        scratch positions [offset, offset+C) → pool blocks phys[0..C/BS)."""
+        scratch positions [offset, offset+C) → pool blocks phys[0..C/BS).
+        An int8 pool quantizes each block on the way in (per-vector absmax
+        scales land in the scale planes at the same physical index)."""
         bs = self.ecfg.kv_block_size
+        pool = dict(pool)
         for j in range(self._chunk // bs):
             blk_k = jax.lax.dynamic_slice_in_dim(
                 scratch_k[:, 0], offset + j * bs, bs, axis=1)
             blk_v = jax.lax.dynamic_slice_in_dim(
                 scratch_v[:, 0], offset + j * bs, bs, axis=1)
-            pool_k = pool_k.at[:, phys[j]].set(blk_k)
-            pool_v = pool_v.at[:, phys[j]].set(blk_v)
-        return pool_k, pool_v
+            if "k_scale" in pool:
+                from ..ops.quant import quantize_kv
+                blk_k, sk = quantize_kv(blk_k)     # [L,bs,KH,D], [L,bs,KH]
+                blk_v, sv = quantize_kv(blk_v)
+                pool["k_scale"] = pool["k_scale"].at[:, phys[j]].set(sk)
+                pool["v_scale"] = pool["v_scale"].at[:, phys[j]].set(sv)
+            pool["k"] = pool["k"].at[:, phys[j]].set(blk_k)
+            pool["v"] = pool["v"].at[:, phys[j]].set(blk_v)
+        return pool
 
     def _chunk_fn(self):
         """Jitted chunked-prefill step: write one C-token chunk into the
@@ -513,24 +573,30 @@ class InferenceEngine:
 
     def _gather_fn(self):
         """Jitted densify of ONE slot's table row into the scratch (prefix
-        reuse: cached blocks → scratch so chunk prefill can attend them)."""
+        reuse: cached blocks → scratch so chunk prefill can attend them).
+        An int8 pool dequantizes here — the scratch is always the model
+        dtype, so chunk prefill attends exact dequantized values."""
         fn = self._compiled.get("gather")
         if fn is not None:
             return fn
 
         s = self.ecfg.max_seq_len
+        dt = self.cfg.dtype
 
-        def gather(pool_k, pool_v, row):
+        def gather(pool, row):
             # pool [L, N, BS, KH, D], row [MB] → dense [L, 1, S, KH, D].
             # The row's final column is the ALWAYS-TRASH block — slice it
             # off so the densified prefix has the exact scratch shape
             # (an S+BS-wide scratch trips the rope-table width validation
             # when max_seq_len == the model's rope limit)
-            def one(pool):
-                g = pool[:, row]                     # [L, MB, BS, KH, D]
+            def one(p, sc):
+                g = p[:, row]                        # [L, MB, BS, KH, D]
+                if sc is not None:
+                    g = g.astype(jnp.float32) * sc[:, row][..., None]
                 l, mb, bs, kh, d = g.shape
-                return g.reshape(l, 1, mb * bs, kh, d)[:, :, :s]
-            return {"k": one(pool_k), "v": one(pool_v)}
+                return g.astype(dt).reshape(l, 1, mb * bs, kh, d)[:, :, :s]
+            return {"k": one(pool["k"], pool.get("k_scale")),
+                    "v": one(pool["v"], pool.get("v_scale"))}
 
         fn = self._compiled["gather"] = jax.jit(gather)
         return fn
@@ -543,7 +609,7 @@ class InferenceEngine:
             return fn
 
         fn = self._compiled["splice"] = jax.jit(
-            self._traced_splice, donate_argnums=(0, 1))
+            self._traced_splice, donate_argnums=(0,))
         return fn
 
     def _chunk_group_fn(self, g: int):
@@ -558,24 +624,22 @@ class InferenceEngine:
         if fn is not None:
             return fn
 
-        def group(params, pool_k, pool_v, scratch, toks, offsets,
-                  last_idxs, phys):
+        def group(params, pool, scratch, toks, offsets, last_idxs, phys):
             # toks [g, C] offsets [g] last_idxs [g] phys [g, C/BS]
             def body(carry, xs):
-                pool_k, pool_v, scratch = carry
+                pool, scratch = carry
                 tok, off, li, ph = xs
                 last, scratch = self._traced_chunk_step(
                     params, scratch, tok, off, li)
-                pool_k, pool_v = self._traced_splice(
-                    pool_k, pool_v, scratch["k"], scratch["v"], off, ph)
-                return (pool_k, pool_v, scratch), last
+                pool = self._traced_splice(
+                    pool, scratch["k"], scratch["v"], off, ph)
+                return (pool, scratch), last
 
-            (pool_k, pool_v, scratch), lasts = jax.lax.scan(
-                body, (pool_k, pool_v, scratch),
-                (toks, offsets, last_idxs, phys))
-            return pool_k, pool_v, scratch, lasts[-1]
+            (pool, scratch), lasts = jax.lax.scan(
+                body, (pool, scratch), (toks, offsets, last_idxs, phys))
+            return pool, scratch, lasts[-1]
 
-        fn = self._compiled[key] = jax.jit(group, donate_argnums=(1, 2, 3))
+        fn = self._compiled[key] = jax.jit(group, donate_argnums=(1, 2))
         return fn
 
     def bench_reset_slots(self, ctx0: int, budget: int) -> None:
@@ -688,18 +752,18 @@ class InferenceEngine:
             bs = self.ecfg.kv_block_size
             c = self._chunk
             scratch = abstract_params(self._scratch)
-            pool = abstract_params(self.kv_cache["k"])
+            pool = abstract_params(self._pool_dict())
             aot(("chunk", c), self._chunk_fn(),
                 pspec, jax.ShapeDtypeStruct((1, c), i32), 0, scratch, 0)
             aot("splice", self._splice_fn(),
-                pool, pool, scratch["k"], scratch["v"], 0,
+                pool, scratch["k"], scratch["v"], 0,
                 jax.ShapeDtypeStruct((c // bs,), i32))
             aot("gather", self._gather_fn(),
-                pool, pool, jax.ShapeDtypeStruct((self._mb,), i32))
+                pool, jax.ShapeDtypeStruct((self._mb,), i32))
             g = max(1, self.ecfg.admit_group_chunks)
             if g > 1:
                 aot(("chunkgroup", g), self._chunk_group_fn(g),
-                    pspec, pool, pool, scratch,
+                    pspec, pool, scratch,
                     jax.ShapeDtypeStruct((g, c), i32),
                     jax.ShapeDtypeStruct((g,), i32),
                     jax.ShapeDtypeStruct((g,), i32),
@@ -756,11 +820,10 @@ class InferenceEngine:
             bs = self.ecfg.kv_block_size
             phys = jnp.full((self._chunk // bs,), self._trash_block,
                             jnp.int32)
-            self.kv_cache["k"], self.kv_cache["v"] = self._splice_fn()(
-                self.kv_cache["k"], self.kv_cache["v"],
-                self._scratch["k"], self._scratch["v"], 0, phys)
-            dense = self._gather_fn()(self.kv_cache["k"],
-                                      self.kv_cache["v"],
+            self._set_pool(self._splice_fn()(
+                self._pool_dict(), self._scratch["k"], self._scratch["v"],
+                0, phys))
+            dense = self._gather_fn()(self._pool_dict(),
                                       self.kv_cache["table"][0])
             np.asarray(jax.device_get(dense["k"].ravel()[:4]))
             timings["splice_gather_s"] = _time.perf_counter() - t0
@@ -772,15 +835,14 @@ class InferenceEngine:
                 s = self.ecfg.max_seq_len
                 offs = np.minimum(np.arange(g) * self._chunk,
                                   s - self._chunk).astype(np.int32)
-                (self.kv_cache["k"], self.kv_cache["v"], self._scratch,
-                 last) = self._chunk_group_fn(g)(
-                    self.params, self.kv_cache["k"], self.kv_cache["v"],
-                    self._scratch,
+                pool, self._scratch, last = self._chunk_group_fn(g)(
+                    self.params, self._pool_dict(), self._scratch,
                     jnp.zeros((g, self._chunk), jnp.int32),
                     jnp.asarray(offs),
                     jnp.full((g,), self._chunk - 1, jnp.int32),
                     jnp.full((g, self._chunk // bs), self._trash_block,
                              jnp.int32))
+                self._set_pool(pool)
                 np.asarray(jax.device_get(last[:4]))
                 timings[f"chunk_group_{g}_s"] = _time.perf_counter() - t0
         else:
@@ -892,6 +954,12 @@ class InferenceEngine:
             # the fleet router divides free tokens (blocks × size) into
             # an in-flight admission budget — see tpu9.router.admission
             out["kv_block_size"] = self.allocator.block_s
+            # int8 pool (ISSUE 6): the free/used counts above already
+            # reflect the ~2x equal-HBM pool, so the router's admission
+            # math needs no change — this is observability. The MODE
+            # string ("" = off), not a bool: a fleet mixing future modes
+            # must be able to tell which pool format a replica runs
+            out["kv_quant"] = self.ecfg.kv_quant if self.kv_quant else ""
             out["queued"] += len(self._wait_room)
             out["prefix_cache"] = self.prefix_cache.stats()
             # admission pressure for the router: reserved fraction is the
@@ -952,8 +1020,7 @@ class InferenceEngine:
 
         scratch = self._scratch
         if p:
-            dense = self._gather_fn()(self.kv_cache["k"],
-                                      self.kv_cache["v"], jnp.asarray(row))
+            dense = self._gather_fn()(self._pool_dict(), jnp.asarray(row))
             scratch = {"k": dense["k"], "v": dense["v"]}
             self._stats["admit_dispatches"] += 1
 
@@ -995,21 +1062,20 @@ class InferenceEngine:
             g = group if n_chunks - k_chunk >= group else 1
             sl = slice(k_chunk, k_chunk + g)
             if g > 1:
-                (self.kv_cache["k"], self.kv_cache["v"], scratch,
-                 last) = self._chunk_group_fn(g)(
-                    self.params, self.kv_cache["k"], self.kv_cache["v"],
-                    scratch, jnp.asarray(toks_all[sl]),
+                pool, scratch, last = self._chunk_group_fn(g)(
+                    self.params, self._pool_dict(), scratch,
+                    jnp.asarray(toks_all[sl]),
                     jnp.asarray(offsets[sl]), jnp.asarray(last_idxs[sl]),
                     jnp.asarray(phys_all[sl]))
+                self._set_pool(pool)
                 self._stats["admit_dispatches"] += 1
             else:
                 last, scratch = self._chunk_fn()(
                     self.params, jnp.asarray(toks_all[sl]),
                     int(offsets[k_chunk]), scratch, int(last_idxs[k_chunk]))
-                self.kv_cache["k"], self.kv_cache["v"] = self._splice_fn()(
-                    self.kv_cache["k"], self.kv_cache["v"],
-                    scratch["k"], scratch["v"], int(offsets[k_chunk]),
-                    jnp.asarray(phys_all[k_chunk]))
+                self._set_pool(self._splice_fn()(
+                    self._pool_dict(), scratch["k"], scratch["v"],
+                    int(offsets[k_chunk]), jnp.asarray(phys_all[k_chunk])))
                 self._stats["admit_dispatches"] += 2
             k_chunk += g
             if k_chunk < n_chunks:

@@ -37,6 +37,10 @@ class HbmBudget:
     kv_gb_per_chip: float
     scratch_gb_per_chip: float
     overhead_frac: float
+    # sequences the SAME kv_gb holds relative to bf16 (int8 pool: ~1.94x
+    # at head_dim 128). The budget's kv bytes don't shrink under kv_quant
+    # (equal-HBM auto sizing); this factor is where the win shows.
+    kv_capacity_factor: float = 1.0
 
     @property
     def required_gb_per_chip(self) -> float:
@@ -56,6 +60,7 @@ class HbmBudget:
             "kv_gb_per_chip": round(self.kv_gb_per_chip, 3),
             "scratch_gb_per_chip": round(self.scratch_gb_per_chip, 3),
             "overhead_frac": self.overhead_frac,
+            "kv_capacity_factor": round(self.kv_capacity_factor, 3),
             "required_gb_per_chip": round(self.required_gb_per_chip, 3),
             "fits": self.fits,
         }
@@ -78,35 +83,46 @@ def matmul_param_count(cfg) -> int:
 
 
 def weight_bytes(cfg, quantized: bool) -> int:
-    mm = matmul_param_count(cfg)
-    embed = cfg.vocab_size * cfg.dim * 2              # always bf16
+    """EXACT bytes of the preset's served param tree, priced on abstract
+    shapes: ``jax.eval_shape`` over the same init fns ``build_params``
+    uses, summed by ``ops.quant.quantized_bytes``. One source of truth —
+    the HBM gate, the ``.tpu9w`` shard sizes a checkpoint emits, and the
+    warm-pool ``weight_pool_mb`` sizing can no longer disagree about a
+    quantized tree (the old hand-rolled estimate budgeted MoE experts at
+    bf16 because per-expert int8 didn't exist; now it does, and this
+    derivation tracks whatever the quantizer actually emits)."""
+    import jax
     if quantized:
-        # int8 payload + one f32 scale per output column (≈dim⁻¹
-        # relative). Stacked MoE expert weights are NOT yet quantized
-        # (ops/quant.py handles 2D mats only) — budgeting them at 1
-        # byte/param would under-count a Mixtral's HBM ~2x and approve
-        # deploys that OOM, the exact failure this gate exists to stop.
-        moe = 0
-        if getattr(cfg, "n_experts", 0):
-            moe = 3 * cfg.dim * cfg.hidden_dim * cfg.n_experts \
-                * cfg.n_layers
-        dense = mm - moe
-        return dense + dense // max(cfg.dim, 1) * 4 + moe * 2 + embed
-    return mm * 2 + embed
+        from ..ops.quant import init_quantized_decoder as init
+    else:
+        from ..models import init_decoder as init
+    from ..ops.quant import quantized_bytes
+    spec = jax.eval_shape(lambda rng: init(rng, cfg), jax.random.PRNGKey(0))
+    return quantized_bytes(spec)
 
 
-def kv_cache_bytes(cfg, max_batch: int, max_seq: int) -> int:
-    return (2 * cfg.n_layers * max_batch * max_seq
-            * cfg.n_kv_heads * cfg.head_dim * 2)
+def kv_cache_bytes(cfg, max_batch: int, max_seq: int,
+                   kv_quant: bool = False) -> int:
+    """Dense-equivalent KV bytes: ``max_batch`` sequences of ``max_seq``
+    tokens, priced by the SAME helper the engine's pool sizing divides by
+    (``paged_kv.kv_block_bytes`` — one arithmetic, no drift when modes
+    are added)."""
+    from .paged_kv import kv_block_bytes
+    return max_batch * kv_block_bytes(cfg, max_seq, kv_quant)
 
 
 def hbm_budget(preset: str, tpu: "str | TpuSpec", *, max_batch: int = 8,
                max_seq_len: int = 2048, tp: int = 0,
-               overhead_frac: float = 0.10) -> HbmBudget:
+               overhead_frac: float = 0.10,
+               quantize: "str | None" = None,
+               kv_quant: bool = False) -> HbmBudget:
     """Compute the per-chip HBM budget for serving ``preset`` on ``tpu``
-    with tensor parallelism ``tp`` (default: all chips of the slice)."""
+    with tensor parallelism ``tp`` (default: all chips of the slice).
+    ``quantize="int8"`` prices a PLAIN preset name as int8 weights — the
+    same opt-in surface ``load_engine(quantize=)``/TPU9_QUANTIZE uses,
+    so a knob-opted deployment is not mispriced as bf16."""
     from .presets import resolve_preset
-    cfg, quantized = resolve_preset(preset)
+    cfg, quantized = resolve_preset(preset, quantize)
     spec = parse_tpu_spec(tpu) if isinstance(tpu, str) else tpu
     if spec is None:
         raise ValueError("feasibility needs a TPU spec")
@@ -118,9 +134,17 @@ def hbm_budget(preset: str, tpu: "str | TpuSpec", *, max_batch: int = 8,
     # per-chip KV 3x, approving deploys that OOM at runtime
     import math
     kv_shard = math.gcd(tp, cfg.n_kv_heads)
+    # kv_quant does NOT shrink the budget: the engine's auto pool sizing
+    # (kv_pool_blocks=0) deliberately spends the SAME HBM as the bf16
+    # pool on ~2x the blocks — the win is capacity, not bytes. Pricing
+    # the int8 byte count here would under-count the pool the engine
+    # actually allocates ~2x and approve deploys that OOM at engine
+    # construction. Deployments that pin kv_pool_blocks explicitly can
+    # price themselves with kv_cache_bytes(kv_quant=True) directly.
     kv = kv_cache_bytes(cfg, max_batch, max_seq_len) / kv_shard
     # paged engine's batch-1 dense prefill scratch rides on one chip's
-    # shard of the kv lanes
+    # shard of the kv lanes (always model-dtype — the int8 pool
+    # quantizes at splice, the scratch itself stays bf16)
     scratch = kv_cache_bytes(cfg, 1, max_seq_len) / kv_shard
 
     return HbmBudget(
@@ -129,17 +153,25 @@ def hbm_budget(preset: str, tpu: "str | TpuSpec", *, max_batch: int = 8,
         weight_gb_per_chip=w / 1e9,
         kv_gb_per_chip=kv / 1e9,
         scratch_gb_per_chip=scratch / 1e9,
-        overhead_frac=overhead_frac)
+        overhead_frac=overhead_frac,
+        kv_capacity_factor=(
+            kv_cache_bytes(cfg, max_batch, max_seq_len)
+            / kv_cache_bytes(cfg, max_batch, max_seq_len, kv_quant=True)
+            if kv_quant else 1.0))
 
 
 def validate_llm_deployment(preset: str, tpu: "str | TpuSpec", *,
                             max_batch: int = 8, max_seq_len: int = 2048,
-                            tp: int = 0) -> HbmBudget:
+                            tp: int = 0, quantize: "str | None" = None,
+                            kv_quant: bool = False) -> HbmBudget:
     """Deploy-time gate: raises :class:`InfeasibleDeployment` with the
     arithmetic when the configuration cannot fit; returns the budget when
-    it can. Suggests the standard remedies in the message."""
+    it can. Suggests the standard remedies in the message. ``quantize``/
+    ``kv_quant`` mirror the ``load_engine`` opt-ins so knob-opted int8
+    deployments are priced as what they serve."""
     budget = hbm_budget(preset, tpu, max_batch=max_batch,
-                        max_seq_len=max_seq_len, tp=tp)
+                        max_seq_len=max_seq_len, tp=tp,
+                        quantize=quantize, kv_quant=kv_quant)
     if not budget.fits:
         d = budget.as_dict()
         raise InfeasibleDeployment(

@@ -31,6 +31,20 @@ def blocks_for(n_tokens: int, block_s: int) -> int:
     return max(0, -(-n_tokens // block_s))
 
 
+def kv_block_bytes(cfg, block_s: int, quantized: bool = False) -> int:
+    """HBM bytes ONE k+v pool block holds across all layers of ``cfg``.
+    The single source of truth the engine's equal-HBM pool sizing, the
+    feasibility gate, and the quant bench all price blocks with — int8
+    blocks carry 1 byte/element plus one f32 absmax scale per
+    (position, head) vector (``tpu9.ops.quant.quantize_kv``)."""
+    import numpy as np
+    per_vec = cfg.head_dim * (1 if quantized
+                              else np.dtype(cfg.dtype).itemsize)
+    if quantized:
+        per_vec += 4                       # f32 scale alongside the pool
+    return 2 * cfg.n_layers * block_s * cfg.n_kv_heads * per_vec
+
+
 @dataclass
 class PrefixEntry:
     key: bytes

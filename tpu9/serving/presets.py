@@ -16,26 +16,35 @@ from typing import Optional
 from .engine import EngineConfig, InferenceEngine
 
 
-def resolve_preset(name: str):
+def resolve_preset(name: str, quantize: Optional[str] = None):
     """Return (DecoderConfig, quantized: bool) for a preset name.
-    ``<preset>-int8`` suffixes select int8 weight-only quantization."""
+    ``<preset>-int8`` suffixes select int8 weight-only quantization;
+    ``quantize="int8"`` selects it for a plain name (the per-preset
+    opt-in ``load_engine`` exposes — ISSUE 6)."""
+    from ..ops.quant import validate_quant_mode
+    quantize = validate_quant_mode(quantize)
+    if quantize and quantize != "int8":
+        # a mode added to SUPPORTED_MODES but not wired into the init/
+        # quantizer path must fail here, not silently build a bf16 tree
+        raise NotImplementedError(
+            f"quantize mode {quantize!r} is not wired into the presets")
     from ..models.gemma import GEMMA_PRESETS
     from ..models.llama import LLAMA_PRESETS
     from ..models.mixtral import MIXTRAL_PRESETS
     presets = {**LLAMA_PRESETS, **GEMMA_PRESETS, **MIXTRAL_PRESETS}
-    quantized = name.endswith("-int8")
-    base = name[:-len("-int8")] if quantized else name
+    quantized = name.endswith("-int8") or quantize == "int8"
+    base = name[:-len("-int8")] if name.endswith("-int8") else name
     if base not in presets:
         raise KeyError(f"unknown model preset {base!r}; have {sorted(presets)}")
     return presets[base], quantized
 
 
-def build_params(name: str, seed: int = 0):
+def build_params(name: str, seed: int = 0, quantize: Optional[str] = None):
     """Random-initialized params for a preset (weight loading from a real
     checkpoint is ``tpu9.serving.weights``' concern). int8 presets are
     synthesized directly at int8 so the bf16 intermediate never exists."""
     import jax
-    cfg, quantized = resolve_preset(name)
+    cfg, quantized = resolve_preset(name, quantize)
     rng = jax.random.PRNGKey(seed)
     if quantized:
         from ..ops.quant import init_quantized_decoder
@@ -44,13 +53,13 @@ def build_params(name: str, seed: int = 0):
     return init_decoder(rng, cfg), cfg
 
 
-def params_spec(name: str):
+def params_spec(name: str, quantize: Optional[str] = None):
     """Abstract (``jax.ShapeDtypeStruct``) param tree for a preset — the
     shapes compile-ahead needs before a single weight byte has streamed.
     ``jax.eval_shape`` over the init fn, so spec and real params can never
     drift apart."""
     import jax
-    cfg, quantized = resolve_preset(name)
+    cfg, quantized = resolve_preset(name, quantize)
     if quantized:
         from ..ops.quant import init_quantized_decoder
         init = init_quantized_decoder
@@ -70,6 +79,8 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
                 prefix_cache_blocks: Optional[int] = None,
                 spec_len: int = 0,
                 spec_min_accept: float = 0.35,
+                quantize: Optional[str] = None,
+                kv_quant: Optional[str] = None,
                 engine_cfg: Optional[EngineConfig] = None,
                 seed: int = 0,
                 compile_ahead: bool = False) -> InferenceEngine:
@@ -87,13 +98,31 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
     wasted verify compute). Greedy output is token-identical with the
     knob on or off.
 
+    ``quantize="int8"`` opts a PLAIN preset name into int8 weight-only
+    serving (equivalent to the ``-int8`` suffix); ``kv_quant="int8"``
+    stores the paged KV pool as int8 with per-vector scales, sizing the
+    auto pool to the same HBM the bf16 pool would use — ~2x the blocks,
+    so admission headroom and the router's heartbeated ``kv_blocks``
+    double (ISSUE 6). The two knobs are independent; together they are
+    quantized serving end-to-end.
+
     ``compile_ahead=True`` builds the engine on the preset's ABSTRACT param
     spec and runs :meth:`InferenceEngine.precompile` in a thread WHILE the
     weights materialize, binding them when both finish — serving bring-up
     pays max(compile, weight load) instead of their sum (λScale-style
     pipelined bring-up; the per-graph timings land in
     ``engine.compile_ahead_timings``)."""
-    cfg, _quantized = resolve_preset(name)
+    cfg, _quantized = resolve_preset(name, quantize)
+    from ..ops.quant import validate_quant_mode
+    kv_quant = validate_quant_mode(kv_quant, "kv_quant")
+    if engine_cfg is not None and kv_quant \
+            and engine_cfg.kv_quant != kv_quant:
+        # an explicit engine_cfg replaces the whole knob surface — a
+        # kv_quant opt-in it doesn't carry would be silently dropped,
+        # serving a bf16 pool the caller sized admission/HBM around
+        raise ValueError(
+            "kv_quant conflicts with the explicit engine_cfg — set "
+            "EngineConfig(kv_quant=...) there instead")
     # the chunk is the smallest prefill bucket; the block size must divide
     # it (a chunk smaller than a block would lose prefill KV — the engine
     # rejects that) AND divide max_seq_len; max_seq_len must also be a
@@ -103,6 +132,13 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
     if paged is None:
         paged = (max_seq_len % block == 0 and chunk % block == 0
                  and max_seq_len % chunk == 0)
+    if kv_quant and not paged:
+        # silently serving a bf16 pool after an explicit int8-KV opt-in
+        # would fake the capacity win the caller sized admission around
+        raise ValueError(
+            "kv_quant='int8' needs the paged engine, but the alignment "
+            f"invariants rejected paging (block {block}, chunk {chunk}, "
+            f"max_seq_len {max_seq_len})")
     ecfg = engine_cfg or EngineConfig(
         max_batch=max_batch, max_seq_len=max_seq_len,
         prefill_buckets=prefill_buckets, decode_steps=decode_steps,
@@ -114,11 +150,12 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
         prefix_cache_blocks=prefix_cache_blocks
         if prefix_cache_blocks is not None
         else (max_seq_len // block if paged else 0),
-        spec_len=spec_len, spec_min_accept=spec_min_accept)
+        spec_len=spec_len, spec_min_accept=spec_min_accept,
+        kv_quant=kv_quant or "")
     if compile_ahead:
         import logging
         import threading
-        spec, _ = params_spec(name)
+        spec, _ = params_spec(name, quantize)
         engine = InferenceEngine(spec, cfg, ecfg)
         timings: dict = {}
         errors: list = []
@@ -132,7 +169,8 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
         compiler = threading.Thread(target=_precompile,
                                     name="tpu9-compile-ahead", daemon=True)
         compiler.start()
-        params, _ = build_params(name, seed=seed)    # ∥ the compile
+        params, _ = build_params(name, seed=seed,    # ∥ the compile
+                                 quantize=quantize)
         compiler.join()
         if errors:
             # lazy compile still serves correctly — but the bring-up stall
@@ -143,5 +181,5 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
         engine.bind_params(params)
         engine.compile_ahead_timings = timings
         return engine
-    params, _ = build_params(name, seed=seed)
+    params, _ = build_params(name, seed=seed, quantize=quantize)
     return InferenceEngine(params, cfg, ecfg)

@@ -16,6 +16,18 @@ weight group and materializes everything else the classic way.
 
 Scalars (python int/float/bool leaves) ride in the index skeleton directly;
 only array leaves become shards.
+
+Index versions (the ``version`` field; absent == 1, the pre-field layout):
+
+- **v1** — plain param trees, every leaf an independent shard.
+- **v2** — quantized trees (ISSUE 6): leaves come in ``{q: int8,
+  scale: f32}`` pairs (``tpu9.ops.quant``), annotated with a ``role``
+  field on their index entries and ``quantized: true`` at the top level.
+  The byte layout is UNCHANGED — v1 readers stream v2 shards fine — but
+  the version gate means a future incompatible layout fails with a clear
+  error instead of a KeyError mid-restore.
+
+Readers call :func:`check_index` before touching leaves.
 """
 
 from __future__ import annotations
@@ -30,6 +42,8 @@ import numpy as np
 WEIGHTS_SUFFIX = ".tpu9w"
 INDEX_NAME = "index.json"
 FORMAT = "tpu9-weights-v1"
+# index versions this reader understands (absent `version` field == 1)
+SUPPORTED_VERSIONS = (1, 2)
 
 _LEAF = "__leaf__"
 _SCALAR = "__scalar__"
@@ -110,6 +124,41 @@ def flatten_tree(tree: Any) -> tuple[Any, list[tuple[str, np.ndarray]]]:
     return skel, leaves
 
 
+def check_index(index: dict, src: str = "") -> int:
+    """Validate an index's format family AND version; returns the version.
+    Raises a clear :class:`ValueError` for unknown versions — a reader
+    hitting a future layout must fail HERE, not with a KeyError halfway
+    through a multi-GB restore."""
+    where = f"{src}: " if src else ""
+    if index.get("format") != FORMAT:
+        raise ValueError(f"{where}not a {FORMAT} index: "
+                         f"{index.get('format')!r}")
+    version = index.get("version", 1)
+    if version not in SUPPORTED_VERSIONS:
+        raise ValueError(
+            f"{where}.tpu9w index version {version} is not supported by "
+            f"this reader (supported: {SUPPORTED_VERSIONS}) — upgrade "
+            "tpu9 to restore this checkpoint")
+    return version
+
+
+def _mark_quant_pairs(entries: list[dict]) -> int:
+    """Annotate quantized ``{q, scale}`` leaf pairs (tpu9.ops.quant trees):
+    an int8 leaf at ``<path>/q`` whose sibling ``<path>/scale`` exists gets
+    ``role: "q"`` and the sibling ``role: "scale"``. Returns the pair
+    count — a nonzero count is what makes an index version 2."""
+    by_key = {e["key"]: e for e in entries}
+    pairs = 0
+    for e in entries:
+        if e["key"].endswith("/q") and e["dtype"] == "int8":
+            sib = by_key.get(e["key"][:-len("/q")] + "/scale")
+            if sib is not None:
+                e["role"] = "q"
+                sib["role"] = "scale"
+                pairs += 1
+    return pairs
+
+
 def build_index(tree: Any) -> tuple[dict, list[np.ndarray]]:
     skel, leaves = flatten_tree(tree)
     entries = []
@@ -126,15 +175,39 @@ def build_index(tree: Any) -> tuple[dict, list[np.ndarray]]:
                         "shape": list(arr.shape),
                         "nbytes": int(arr.nbytes)})
         arrays.append(arr)
-    index = {"format": FORMAT, "skeleton": skel, "leaves": entries,
+    pairs = _mark_quant_pairs(entries)
+    index = {"format": FORMAT, "version": 2 if pairs else 1,
+             "skeleton": skel, "leaves": entries,
              "total_bytes": int(sum(a.nbytes for a in arrays))}
+    if pairs:
+        index["quantized"] = True
     return index, arrays
 
 
-def save_params(tree: Any, dest: str) -> dict:
+def save_params(tree: Any, dest: str, quantize: Optional[str] = None) -> dict:
     """Write ``tree`` as a ``.tpu9w`` directory at ``dest`` (created). The
     caller picks a ``dest`` ending in :data:`WEIGHTS_SUFFIX` so snapshot
-    manifests of the enclosing workdir are stream-recognizable."""
+    manifests of the enclosing workdir are stream-recognizable.
+
+    ``quantize="int8"`` runs ``tpu9.ops.quant.quantize_decoder`` over the
+    tree first (save-time quantization, ISSUE 6): the shards land ~2x
+    smaller and every downstream consumer — chunk cache, hedged peer
+    reads, warm weights pool, double-buffered device puts — moves half
+    the bytes with zero changes. Trees already holding quantized pairs
+    mark themselves v2 with or without the flag."""
+    if quantize:
+        from ..ops.quant import validate_quant_mode
+        validate_quant_mode(quantize)
+        if quantize != "int8":
+            # validated-but-unwired mode: fail here, never emit shards in
+            # a different format than the caller opted into
+            raise NotImplementedError(
+                f"quantize mode {quantize!r} is not wired into save_params")
+        if not (isinstance(tree, dict) and "layers" in tree):
+            raise ValueError("quantize='int8' needs a decoder param tree "
+                             "(dict with 'layers'); save this tree plain")
+        from ..ops.quant import quantize_decoder
+        tree = quantize_decoder(tree)
     index, arrays = build_index(tree)
     os.makedirs(dest, exist_ok=True)
     for entry, arr in zip(index["leaves"], arrays):
@@ -159,8 +232,7 @@ def shard_to_array(buf, entry: dict) -> np.ndarray:
 
 def assemble(index: dict, arrays: list) -> Any:
     """Rebuild the pytree from a parsed index + arrays in leaf order."""
-    if index.get("format") != FORMAT:
-        raise ValueError(f"not a {FORMAT} index: {index.get('format')!r}")
+    check_index(index)
     if len(arrays) != len(index["leaves"]):
         raise ValueError(f"have {len(arrays)} arrays for "
                          f"{len(index['leaves'])} leaves")
@@ -172,8 +244,7 @@ def load_params(src: str, mmap: bool = False) -> Any:
     ``mmap=True`` maps shards instead of reading them (lazy page-in)."""
     with open(os.path.join(src, INDEX_NAME)) as f:
         index = json.load(f)
-    if index.get("format") != FORMAT:
-        raise ValueError(f"not a {FORMAT} dir: {src}")
+    check_index(index, src)
     arrays = []
     for entry in index["leaves"]:
         path = os.path.join(src, entry["file"])

@@ -245,9 +245,13 @@ class CheckpointManager:
         if idx_entry is None:
             raise IOError(f"weight group {group} has no index")
         index = json.loads(await self._fetch_entry_bytes(idx_entry))
-        if index.get("format") != wfmt.FORMAT:
-            raise IOError(f"weight group {group}: unknown format "
-                          f"{index.get('format')!r}")
+        try:
+            # accepts v1 (plain) and v2 (quantized-pair) indexes; an
+            # unknown future version fails HERE with a clear message, not
+            # with a KeyError halfway through the restore
+            wfmt.check_index(index, group)
+        except ValueError as exc:
+            raise IOError(str(exc)) from None
         leaf_entries = index["leaves"]
         digests: list[str] = []
         for leaf in leaf_entries:
