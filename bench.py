@@ -56,6 +56,8 @@ import subprocess
 import sys
 import time
 
+from tpu9.utils.aio import cancellable_wait
+
 PHASE_TIMEOUT_S = {"llm": 1800, "llm_endpoint": 1800, "kernels": 900,
                    "coldstart": 900, "coldstart_native": 900,
                    "coldstart_jax": 900, "coldstart_jax_tpu": 900,
@@ -743,7 +745,7 @@ def bench_cold_start_native(quick: bool = False) -> dict:
                 # rmtree races nothing and each trial is a clean cold pull
                 f = await fill_of(image_id)
                 if f is not None:
-                    await asyncio.wait_for(f.wait(), 300)
+                    await cancellable_wait(f.wait(), 300)
                 shutil.rmtree(bundle, ignore_errors=True)
                 # benchmark hygiene: the previous trial's 1 GiB fill
                 # leaves dirty pages whose writeback otherwise bleeds
@@ -762,7 +764,7 @@ def bench_cold_start_native(quick: bool = False) -> dict:
                 # alone may show ~0 ops on a healthy lazy pull
                 f = await fill_of(image_id)
                 if f is not None:
-                    await asyncio.wait_for(f.wait(), 300)
+                    await cancellable_wait(f.wait(), 300)
                 fetch_counts.append(cache_ops() - before)
             out["cold_start_native_pull"] = _percentiles(pulls)
             out["cold_start_native_pull_p50_s"] = out[
@@ -802,7 +804,7 @@ def bench_cold_start_native(quick: bool = False) -> dict:
                     "wrong bytes")
             f = await fill_of(image_id)
             if f is not None:
-                await asyncio.wait_for(f.wait(), 600)
+                await cancellable_wait(f.wait(), 600)
                 out["lazy_fill_stats"] = dict(f.stats)
             out["phase_timeline"] = _phase_report()
         out["violations"] = violations

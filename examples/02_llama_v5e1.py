@@ -22,7 +22,7 @@ def load_engine():
     import jax
     from tpu9.models import init_decoder
     from tpu9.models.llama import LLAMA_PRESETS
-    from tpu9.ops.quant import quantize_decoder
+    from tpu9.ops import quantize_decoder
     from tpu9.runner import ckpt
     from tpu9.serving import EngineConfig, InferenceEngine
 

@@ -1,0 +1,225 @@
+"""tpu9.utils.aio (ISSUE 7): the cancellation-correct primitives tpu9lint
+rules ASY001-003 point at."""
+
+import asyncio
+import gc
+
+import pytest
+
+from tpu9.utils.aio import (bg_task_count, cancellable_wait, event_wait,
+                            queue_get, reap, spawn)
+
+
+async def test_queue_get_returns_and_times_out():
+    q = asyncio.Queue()
+    q.put_nowait("x")
+    assert await queue_get(q, 1.0) == "x"
+    with pytest.raises(asyncio.TimeoutError):
+        await queue_get(q, 0.01)
+
+
+async def test_queue_get_timeout_race_requeues_item():
+    """A put landing exactly as the timeout fires must not be eaten."""
+    q = asyncio.Queue()
+    try:
+        await queue_get(q, 0.01)
+    except asyncio.TimeoutError:
+        pass
+    # simulate the race window: the reaped getter may already hold an item
+    q.put_nowait("survivor")
+    assert await queue_get(q, 1.0) == "survivor"
+
+
+async def test_queue_get_outer_cancel_propagates_and_preserves():
+    q = asyncio.Queue()
+    waiter = asyncio.ensure_future(queue_get(q, 10.0))
+    await asyncio.sleep(0)
+    q.put_nowait("item")
+    waiter.cancel()
+    try:
+        got = await waiter
+    except asyncio.CancelledError:
+        got = None
+    if got is None:
+        await asyncio.sleep(0)  # let the done-callback requeue
+        assert q.get_nowait() == "item"
+    else:
+        assert got == "item"
+
+
+async def test_queue_get_requeue_preserves_order():
+    """A raced item re-queued by a cancelled getter must go back to the
+    FRONT: events published after it must not overtake it."""
+    q = asyncio.Queue()
+    waiter = asyncio.ensure_future(queue_get(q, 10.0))
+    await asyncio.sleep(0)
+    q.put_nowait("A")           # the getter wins this
+    await asyncio.sleep(0)      # getter future resolved with A
+    q.put_nowait("B")
+    waiter.cancel()
+    try:
+        got = await waiter
+    except asyncio.CancelledError:
+        got = None
+    if got is None:
+        await asyncio.sleep(0)
+        assert await queue_get(q, 1.0) == "A"
+        assert await queue_get(q, 1.0) == "B"
+    else:
+        assert got == "A"
+        assert await queue_get(q, 1.0) == "B"
+
+
+async def test_reap_crashed_child_reraises_or_logs(caplog):
+    async def boom():
+        raise ValueError("died hours ago")
+
+    t = asyncio.ensure_future(boom())
+    await asyncio.sleep(0.01)   # child already crashed before stop()
+    with pytest.raises(ValueError, match="died hours ago"):
+        await reap(t)           # default: same contract as `await task`
+
+    t2 = asyncio.ensure_future(boom())
+    await asyncio.sleep(0.01)
+    await reap(t2, absorb_errors=True)   # absorbed, but never silently
+    assert any("died hours ago" in r.message for r in caplog.records)
+
+
+async def test_event_wait_set_timeout_and_cancel():
+    ev = asyncio.Event()
+    assert await event_wait(ev, 0.01) is False
+    ev.set()
+    assert await event_wait(ev, 0.01) is True
+    assert await event_wait(ev) is True
+
+    ev2 = asyncio.Event()
+    waiter = asyncio.ensure_future(event_wait(ev2, 10.0))
+    await asyncio.sleep(0)
+    waiter.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await waiter
+
+
+async def test_cancellable_wait_result_timeout_cancel():
+    async def quick():
+        return 42
+
+    assert await cancellable_wait(quick()) == 42
+    assert await cancellable_wait(quick(), 5.0) == 42
+
+    started = asyncio.Event()
+    cancelled = asyncio.Event()
+
+    async def slow():
+        started.set()
+        try:
+            await asyncio.sleep(60)
+        except asyncio.CancelledError:
+            cancelled.set()
+            raise
+
+    with pytest.raises(asyncio.TimeoutError):
+        await cancellable_wait(slow(), 0.01)
+    assert cancelled.is_set()   # inner task was drained, not leaked
+
+    # outer cancel propagates (never traded for the inner result)
+    waiter = asyncio.ensure_future(cancellable_wait(asyncio.sleep(60), 30))
+    await asyncio.sleep(0)
+    waiter.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await waiter
+
+
+async def test_cancellable_wait_timeout_surfaces_cleanup_crash():
+    """bpo-40607 parity: if the inner task's cancellation cleanup raises a
+    real exception, the caller sees IT, not a TimeoutError that hides it."""
+    async def bad_cleanup():
+        try:
+            await asyncio.sleep(60)
+        except asyncio.CancelledError:
+            raise OSError("teardown failed")
+
+    with pytest.raises(OSError, match="teardown failed"):
+        await cancellable_wait(bad_cleanup(), 0.01)
+
+
+def test_spawn_set_prunes_closed_loop_tasks(monkeypatch):
+    """A task stranded by a closed loop must not pin frames forever or
+    pollute bg_task_count for later loops (fresh-loop-per-test harness).
+    The prune is amortized by a high-water mark; force it low here."""
+    from tpu9.utils import aio as aio_mod
+    monkeypatch.setattr(aio_mod, "_prune_watermark", 1)
+
+    async def strand():
+        spawn(asyncio.Event().wait(), name="stranded")
+
+    asyncio.run(strand())       # loop closes with the task still pending
+    assert bg_task_count() == 0     # count never includes dead-loop tasks
+
+    async def next_loop():
+        done = asyncio.Event()
+        done.set()
+        t = spawn(done.wait(), name="fresh")   # watermark hit -> prune
+        await t
+
+    asyncio.run(next_loop())
+    assert all(not t.get_loop().is_closed() for t in aio_mod._BG_TASKS)
+
+
+async def test_spawn_holds_strong_ref_until_done():
+    done = asyncio.Event()
+
+    async def bg():
+        await done.wait()
+        return "ok"
+
+    t = spawn(bg(), name="test-bg")
+    ref = t.get_name()
+    del t
+    gc.collect()                # a weak-ref'd task could be collected here
+    assert bg_task_count() >= 1
+    done.set()
+    await asyncio.sleep(0.05)
+    assert ref == "test-bg"
+
+
+async def test_spawn_logs_crash_without_unraisable(caplog):
+    async def boom():
+        raise RuntimeError("bg crash")
+
+    spawn(boom(), name="crasher")
+    await asyncio.sleep(0.05)
+    gc.collect()   # no 'exception was never retrieved' may escape
+    assert any("bg crash" in r.message for r in caplog.records)
+
+
+async def test_reap_absorbs_child_cancel_but_not_ours():
+    child = asyncio.ensure_future(asyncio.sleep(60))
+    await reap(child)           # returns cleanly, child cancelled
+    assert child.cancelled()
+    await reap(None)            # tolerated
+
+    # a cancelled stop() must abort, not continue past the drain —
+    # the child's slow cleanup keeps reap's gather parked while we cancel
+    async def slow_exit():
+        try:
+            await asyncio.sleep(60)
+        except asyncio.CancelledError:
+            try:
+                await asyncio.sleep(0.5)   # cleanup window
+            except asyncio.CancelledError:
+                pass
+            raise
+
+    async def stopper():
+        blocker = asyncio.ensure_future(slow_exit())
+        await asyncio.sleep(0)             # let the child start
+        await reap(blocker)
+        return "finished"
+
+    s = asyncio.ensure_future(stopper())
+    await asyncio.sleep(0.05)              # child is draining inside reap
+    assert s.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await s
+    assert s.cancelled()        # did NOT swallow our cancel and finish

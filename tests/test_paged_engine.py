@@ -142,7 +142,7 @@ def test_prefix_cache_pin_blocks_eviction():
 # engine behavior
 # ---------------------------------------------------------------------------
 
-def test_paged_matches_dense_greedy(tiny):
+def test_paged_matches_dense_greedy(tiny, check_tracer_leaks):
     cfg, params = tiny
     dense = InferenceEngine(params, cfg, EngineConfig(
         max_batch=2, max_seq_len=256, prefill_buckets=(32, 64),

@@ -79,7 +79,7 @@ def test_greedy_parity_dense(params):
     assert st["spec_windows"] > 0 and st["spec_accepted"] > 0, st
 
 
-def test_greedy_parity_paged(params):
+def test_greedy_parity_paged(params, check_tracer_leaks):
     prompts = [CYCLER, list(range(2, 40))]
     classic = _generate(_engine(params, spec_len=0, paged=True),
                         prompts, 200)

@@ -177,3 +177,63 @@ async def test_ltrim_caps_list_in_one_call():
     finally:
         await client.close()
         await server.stop()
+
+
+async def test_sub_get_cancel_racing_put_preserves_item():
+    """ASY001 regression (ISSUE 7): a subscription waiter cancelled in the
+    same loop tick a publish lands must (a) actually observe cancellation
+    — the pre-fix wait_for could swallow it on py3.10 — and (b) never eat
+    the raced event: it must stay deliverable to the next getter."""
+    s = MemoryStore()
+    sub = s.subscribe("events:*")
+    try:
+        for _ in range(50):
+            waiter = asyncio.ensure_future(sub.get(timeout=5.0))
+            await asyncio.sleep(0)        # park the waiter on the queue
+            await s.publish("events:x", "payload")
+            waiter.cancel()               # cancel races the delivery
+            try:
+                got = await waiter
+            except asyncio.CancelledError:
+                got = None
+            if got is not None:
+                assert got == ("events:x", "payload")
+            else:
+                # cancelled: the raced item must still be in the queue
+                got2 = await sub.get(timeout=1.0)
+                assert got2 == ("events:x", "payload")
+    finally:
+        sub.close()
+
+
+async def test_sub_get_waiter_cancel_terminates():
+    """The stop() shape PR 1 fixed in the Dispatcher: cancel-until-done on
+    a parked waiter must converge (no swallowed-cancel infinite loop)."""
+    s = MemoryStore()
+    sub = s.subscribe("quiet:*")
+    try:
+        waiter = asyncio.ensure_future(sub.get(timeout=30.0))
+        await asyncio.sleep(0)
+        while not waiter.done():
+            waiter.cancel()
+            await asyncio.wait({waiter}, timeout=1.0)
+        assert waiter.cancelled()
+    finally:
+        sub.close()
+
+
+async def test_blpop_cancel_racing_push_keeps_value():
+    s = MemoryStore()
+    waiter = asyncio.ensure_future(s.blpop("q", timeout=5.0))
+    await asyncio.sleep(0)
+    await s.rpush("q", "v")
+    waiter.cancel()
+    try:
+        got = await waiter
+    except asyncio.CancelledError:
+        got = None
+    if got is None:
+        # cancelled cleanly: the pushed value must not have been consumed
+        assert await s.lpop("q") == "v"
+    else:
+        assert got == "v"

@@ -14,6 +14,7 @@ import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional
+from ...utils.aio import reap
 
 SAMPLE_HZ = 1.0
 WINDOW = 60
@@ -96,11 +97,9 @@ class Autoscaler:
 
     async def stop(self) -> None:
         if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # reap: swallows the child's CancelledError but re-raises if
+            # stop() itself is cancelled mid-drain (ASY003)
+            await reap(self._task)
             self._task = None
 
     async def step(self) -> AutoscaleResult:

@@ -21,6 +21,7 @@ from typing import Any, Optional  # noqa: F401
 import aiohttp
 
 from ...repository import ContainerRepository
+from ...utils.aio import reap, spawn
 from ...types import ContainerStatus, Stub
 
 log = logging.getLogger("tpu9.abstractions")
@@ -127,11 +128,9 @@ class RequestBuffer:
 
     async def stop(self) -> None:
         if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # reap: swallows the child's CancelledError but re-raises if
+            # stop() itself is cancelled mid-drain (ASY003)
+            await reap(self._task)
             self._task = None
         if self._wake is not None:
             self._wake.close()
@@ -274,7 +273,10 @@ class RequestBuffer:
             return
         container_id, address = target
         self._inflight += 1
-        asyncio.create_task(self._forward_one(req, container_id, address))
+        # spawn, not bare create_task (ASY002): the loop weak-refs tasks, so
+        # a GC'd forward would strand the request AND leak the inflight slot
+        spawn(self._forward_one(req, container_id, address),
+              name=f"buffer-forward-{container_id[-8:]}")
 
     async def acquire(self, deadline_s: float = 30.0,
                       body: bytes = b"",

@@ -23,6 +23,7 @@ from ..task import Dispatcher
 from ..types import (ContainerRequest, Stub, TaskMessage, TaskPolicy,
                      TaskStatus, new_id)
 from .common.tokens import RunnerTokenCache
+from ..utils.aio import reap
 
 log = logging.getLogger("tpu9.abstractions")
 
@@ -89,11 +90,7 @@ class FunctionService:
 
     async def stop(self) -> None:
         if self._cron_task:
-            self._cron_task.cancel()
-            try:
-                await self._cron_task
-            except asyncio.CancelledError:
-                pass
+            await reap(self._cron_task)   # ASY003: our cancel re-raises
             self._cron_task = None
 
     # -- invocation ------------------------------------------------------------

@@ -118,7 +118,7 @@ class TaskQueueService:
                 msg = None
                 try:
                     msg = await claim
-                except BaseException:   # noqa: BLE001 — incl. cancel
+                except BaseException:   # noqa: BLE001  # tpu9: noqa[ASY003] claim's cancel is the EXPECTED signal; revert must keep going to un-strand the task
                     pass
                 if msg is not None:
                     await self.dispatcher.release(task_id, container_id)
@@ -129,7 +129,7 @@ class TaskQueueService:
             t = asyncio.ensure_future(revert())
             try:
                 await asyncio.shield(t)
-            except asyncio.CancelledError:
+            except asyncio.CancelledError:  # tpu9: noqa[ASY003] shield pierced by a 2nd cancel; the outer `raise` below re-raises the original
                 pass                    # revert continues detached
             raise
 

@@ -27,6 +27,8 @@ from typing import Optional
 
 import aiohttp
 
+from ..utils.aio import cancellable_wait, reap
+
 log = logging.getLogger("tpu9.agent")
 
 RESTART_BACKOFF_S = [1.0, 2.0, 5.0, 15.0, 30.0]
@@ -215,18 +217,16 @@ class Agent:
 
     async def stop(self) -> None:
         if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # reap: swallows the child's CancelledError but re-raises if
+            # stop() itself is cancelled mid-drain (ASY003)
+            await reap(self._task)
             self._task = None
         for p in self.workers:
             if p.returncode is None:
                 p.terminate()
         for p in self.workers:
             try:
-                await asyncio.wait_for(p.wait(), timeout=10.0)
+                await cancellable_wait(p.wait(), timeout=10.0)
             except asyncio.TimeoutError:
                 p.kill()
         self.workers.clear()

@@ -1,0 +1,26 @@
+"""tpu9lint — project-native static analysis for the bug classes this repo
+has shipped: swallowed async cancellation, fire-and-forget tasks, blocking
+calls on the event loop, host-device syncs on the serve hot path, jit
+recompile hazards, and import-boundary violations.
+
+Run it:
+
+    python -m tpu9.analysis                 # gate mode: repo + baseline
+    python -m tpu9.analysis --list-rules
+    python -m tpu9.analysis path/to/file.py --no-baseline
+
+Suppress a reviewed false positive inline (the reason is mandatory):
+
+    loop.create_task(pump())  # tpu9: noqa[ASY002] handle owned by caller
+
+or record it in scripts/lint_baseline.json via scripts/lint_gate.py
+--update-baseline --reason "...". The gate fails on any NEW finding.
+"""
+
+from .findings import Baseline, Finding, load_baseline
+from .runner import (ALL_RULES, DEFAULT_BASELINE, DEFAULT_ROOTS,
+                     AnalysisResult, find_repo_root, run_analysis, run_gate)
+
+__all__ = ["ALL_RULES", "DEFAULT_BASELINE", "DEFAULT_ROOTS",
+           "AnalysisResult", "Baseline", "Finding", "find_repo_root",
+           "load_baseline", "run_analysis", "run_gate"]

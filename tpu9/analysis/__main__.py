@@ -1,0 +1,103 @@
+"""CLI entry: ``python -m tpu9.analysis``.
+
+Exit codes: 0 clean (or everything known/suppressed), 1 new findings,
+2 internal/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .findings import load_baseline
+from .runner import (ALL_RULES, DEFAULT_BASELINE, DEFAULT_ROOTS,
+                     find_repo_root, gate, run_analysis)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu9.analysis",
+        description="tpu9lint: async-cancellation / JAX hot-path / "
+                    "module-boundary static analysis")
+    ap.add_argument("roots", nargs="*", default=None,
+                    help=f"paths to scan (default: {', '.join(DEFAULT_ROOTS)})")
+    ap.add_argument("--repo-root", default=None)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="triaged baseline json (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-known", action="store_true",
+                    help="also print baselined findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in ALL_RULES.items():
+            print(f"{rid}  {desc}")
+        return 0
+
+    repo_root = args.repo_root or find_repo_root()
+    roots = args.roots or DEFAULT_ROOTS
+    select = ({r.strip() for r in args.select.split(",") if r.strip()}
+              or None)
+    result = run_analysis(repo_root, roots, select=select)
+
+    if args.no_baseline:
+        new, known, stale = result.findings, [], []
+    else:
+        import os
+        bl_path = args.baseline
+        if bl_path and not os.path.isabs(bl_path):
+            bl_path = os.path.join(repo_root, bl_path)
+        new, known, stale = gate(result, load_baseline(bl_path))
+        # a scoped/filtered run can't see the whole baseline — only report
+        # staleness for entries the run actually covered
+        if args.roots:
+            stale = [e for e in stale
+                     if any(e.get("path", "") == r.rstrip("/")
+                            or e.get("path", "").startswith(
+                                r.rstrip("/") + "/")
+                            for r in args.roots)]
+        if select:
+            stale = [e for e in stale if e.get("rule") in select]
+
+    if args.as_json:
+        print(json.dumps({
+            "files_scanned": result.files_scanned,
+            "elapsed_s": round(result.elapsed_s, 3),
+            "new": [f.to_dict() | {"line": f.line} for f in new],
+            "known": [f.fingerprint for f in known],
+            "stale": [e["fingerprint"] for e in stale],
+            "suppressed_inline": len(result.suppressed),
+            "parse_errors": result.parse_errors,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        if args.show_known:
+            for f in known:
+                print(f"known    {f.format()}")
+        for e in stale:
+            print(f"stale baseline entry (finding no longer fires — prune "
+                  f"it): {e['rule']} {e['path']} [{e.get('symbol')}] "
+                  f"{e['fingerprint']}")
+        for err in result.parse_errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        counts = ", ".join(f"{r}={n}" for r, n in sorted(
+            {**{}, **result.by_rule()}.items()))
+        print(f"tpu9lint: {result.files_scanned} files in "
+              f"{result.elapsed_s:.2f}s — {len(new)} new, {len(known)} "
+              f"baselined, {len(result.suppressed)} noqa'd"
+              + (f" ({counts})" if counts else ""))
+
+    if result.parse_errors:
+        return 2
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""BND001 — declarative import-boundary contracts (boundaries.toml).
+
+Three contract kinds, all prefix-matched on module paths (most specific
+``allow``/``forbid`` key wins; intra-package imports are always allowed):
+
+- ``[allow]``:  package -> exhaustive list of tpu9 package prefixes it may
+  import. Anything else under ``tpu9.`` is a violation. This is the strong
+  form used for the serving/router/ops layers the engine split must keep
+  clean.
+- ``[forbid]``: package -> explicit prohibitions, for packages whose full
+  import surface is not worth enumerating (gateway, worker).
+- ``[restricted]``: module -> the only importer prefixes allowed to touch
+  it. Used for the raw-KV-dtype boundary: ``tpu9.ops.quant`` is where KV
+  int8 layouts live, and only the model/serving stack may see them.
+
+The checker resolves relative imports to absolute module paths, so ``from
+..ops import quant`` inside ``tpu9/serving/engine.py`` is correctly seen as
+``tpu9.ops.quant``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from . import tomlmini
+from .findings import Finding
+
+
+@dataclass
+class BoundaryConfig:
+    allow: dict[str, list[str]] = field(default_factory=dict)
+    forbid: dict[str, list[str]] = field(default_factory=dict)
+    restricted: dict[str, list[str]] = field(default_factory=dict)
+    jax_hotpath_files: list[str] = field(default_factory=list)
+    jax_roots: list[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "BoundaryConfig":
+        raw = tomlmini.load_file(path)
+        jax = raw.get("jax", {}).get("hotpath", {})
+        return cls(allow=raw.get("allow", {}),
+                   forbid=raw.get("forbid", {}),
+                   restricted=raw.get("restricted", {}),
+                   jax_hotpath_files=jax.get("files", []),
+                   jax_roots=jax.get("roots", []))
+
+
+def module_name(path: str) -> str:
+    """'tpu9/serving/engine.py' -> 'tpu9.serving.engine' (pkg __init__
+    collapses to the package)."""
+    mod = path[:-3].replace(os.sep, ".").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def extract_imports(path: str, tree: ast.AST) -> list[tuple[str, int]]:
+    """Absolute tpu9.* module targets imported by this file, with lineno.
+
+    ``from X import name`` records ``X.name`` (the deepest plausible module
+    path) — prefix matching in the contracts means a rule on ``X`` still
+    covers it, while a rule on a submodule ``X.name`` bites too.
+    """
+    mod = module_name(path)
+    is_pkg = path.endswith("__init__.py")
+    out: list[tuple[str, int]] = []
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for a in n.names:
+                if a.name.startswith("tpu9"):
+                    out.append((a.name, n.lineno))
+        elif isinstance(n, ast.ImportFrom):
+            if n.level:
+                parts = mod.split(".")
+                if not is_pkg:
+                    parts = parts[:-1]
+                parts = parts[: len(parts) - n.level + 1]
+                base = ".".join(parts)
+                target = f"{base}.{n.module}" if n.module else base
+            else:
+                target = n.module or ""
+            if target.startswith("tpu9"):
+                names = [a.name for a in n.names if a.name != "*"]
+                if names:
+                    out.extend((f"{target}.{a}", n.lineno) for a in names)
+                else:
+                    out.append((target, n.lineno))
+    return out
+
+
+def _prefix_of(module: str, prefix: str) -> bool:
+    return module == prefix or module.startswith(prefix + ".")
+
+
+def _best_key(module: str, keys) -> str | None:
+    best = None
+    for k in keys:
+        if _prefix_of(module, k) and (best is None or len(k) > len(best)):
+            best = k
+    return best
+
+
+def check_boundaries(files: dict[str, ast.AST],
+                     cfg: BoundaryConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in sorted(files):
+        mod = module_name(path)
+        seen: set[str] = set()
+        for target, lineno in extract_imports(path, files[path]):
+            if target in seen:
+                continue
+            seen.add(target)
+
+            akey = _best_key(mod, cfg.allow)
+            if (akey is not None and not _prefix_of(target, akey)
+                    and not any(_prefix_of(target, a)
+                                for a in cfg.allow[akey])):
+                findings.append(Finding(
+                    "BND001", path, lineno, 0,
+                    f"`{mod}` imports `{target}` but its contract "
+                    f"([allow] \"{akey}\") only permits "
+                    f"{cfg.allow[akey] or '[] (leaf package)'} — the "
+                    "boundary the engine split depends on",
+                    symbol=target))
+
+            fkey = _best_key(mod, cfg.forbid)
+            if fkey is not None:
+                for bad in cfg.forbid[fkey]:
+                    if _prefix_of(target, bad):
+                        findings.append(Finding(
+                            "BND001", path, lineno, 0,
+                            f"`{mod}` imports `{target}`, forbidden by "
+                            f"[forbid] \"{fkey}\" -> {bad}",
+                            symbol=target))
+                        break
+
+            for rmod, importers in cfg.restricted.items():
+                if _prefix_of(target, rmod) and not any(
+                        _prefix_of(mod, imp) for imp in importers):
+                    findings.append(Finding(
+                        "BND001", path, lineno, 0,
+                        f"`{mod}` imports `{target}`: [restricted] "
+                        f"\"{rmod}\" may only be touched by {importers} "
+                        "(raw KV dtypes / engine internals stay behind "
+                        "their boundary)", symbol=target))
+    return findings

@@ -1,0 +1,178 @@
+"""Finding model, inline suppressions, and the triaged baseline.
+
+A finding's identity (``fingerprint``) is deliberately line-number
+independent — ``rule | path | enclosing symbol | k-th occurrence`` — so the
+baseline survives unrelated edits above the flagged site. Moving a flagged
+call to a different function (or adding a second occurrence in the same
+function) changes identity and re-surfaces it as NEW, which is the point:
+the gate is a ratchet, not a mute button.
+
+Inline suppressions are ``# tpu9: noqa[RULE] reason`` (comma-separated rule
+ids allowed) on the flagged line or the line directly above it. The reason
+is mandatory: a bare noqa does not suppress — it raises SUP001 instead, so
+silencing a checker always leaves a reviewable sentence behind.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+NOQA_RE = re.compile(
+    r"#\s*tpu9:\s*noqa\[(?P<rules>[A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)\]"
+    r"\s*(?P<reason>.*?)\s*$")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative, posix separators
+    line: int            # 1-based
+    col: int
+    message: str
+    symbol: str = "<module>"   # enclosing function/class qualname
+    occurrence: int = 0        # k-th finding of (rule, path, symbol)
+
+    @property
+    def fingerprint(self) -> str:
+        key = f"{self.rule}|{self.path}|{self.symbol}|{self.occurrence}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.symbol}] {self.message}")
+
+    def to_dict(self) -> dict:
+        return {"fingerprint": self.fingerprint, "rule": self.rule,
+                "path": self.path, "line": self.line, "symbol": self.symbol,
+                "message": self.message}
+
+
+def assign_occurrences(findings: list[Finding]) -> list[Finding]:
+    """Number findings within each (rule, path, symbol) group in source
+    order so identical sites in one function get distinct fingerprints."""
+    seen: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.rule, f.path, f.symbol)
+        f.occurrence = seen.get(key, 0)
+        seen[key] = f.occurrence + 1
+    return findings
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: tuple[str, ...]
+    reason: str
+    comment_only: bool = False   # whole line is the comment (covers below)
+
+
+def parse_suppressions(source: str) -> list[Suppression]:
+    out = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = NOQA_RE.search(text)
+        if m:
+            rules = tuple(r.strip() for r in m.group("rules").split(","))
+            out.append(Suppression(i, rules, m.group("reason").strip(),
+                                   comment_only=text.lstrip().startswith("#")))
+    return out
+
+
+def apply_suppressions(findings: list[Finding],
+                       sups: list[Suppression],
+                       path: str) -> tuple[list[Finding], list[Finding]]:
+    """Return (kept, suppressed). An end-of-line suppression covers exactly
+    its own line; a comment-only line covers the line below (comment-above
+    style) — never both, so a new finding on the next line cannot ride an
+    adjacent suppression. Reason-less suppressions suppress nothing and add
+    a SUP001 finding."""
+    kept: list[Finding] = []
+    suppressed: list[Finding] = []
+    by_line: dict[int, list[Suppression]] = {}
+    for s in sups:
+        if not s.reason:
+            kept.append(Finding(
+                "SUP001", path, s.line, 0,
+                "suppression without a reason — `# tpu9: noqa[RULE] why` "
+                "(the reason is mandatory; bare noqa does not suppress)",
+                symbol="<noqa>"))
+            continue
+        by_line.setdefault(s.line + 1 if s.comment_only else s.line,
+                           []).append(s)
+    for f in findings:
+        matched = any(f.rule in s.rules for s in by_line.get(f.line, []))
+        (suppressed if matched else kept).append(f)
+    return kept, suppressed
+
+
+# -- baseline ----------------------------------------------------------------
+
+@dataclass
+class Baseline:
+    """scripts/lint_baseline.json — the triaged debt ledger.
+
+    ``suppressed`` entries match live findings by fingerprint and carry a
+    mandatory reason; ``fixed`` entries are historical record only (the
+    triage that removed a finding) and match nothing.
+    """
+    entries: dict[str, dict] = field(default_factory=dict)  # fp -> entry
+    fixed: list[dict] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as f:
+            raw = json.load(f)
+        bl = cls()
+        for e in raw.get("findings", []):
+            if e.get("status") == "fixed":
+                bl.fixed.append(e)
+                continue
+            if not e.get("reason", "").strip():
+                raise ValueError(
+                    f"baseline entry {e.get('fingerprint')} "
+                    f"({e.get('rule')} {e.get('path')}) has no reason — "
+                    "triaged suppressions must say why")
+            bl.entries[e["fingerprint"]] = e
+        return bl
+
+    def save(self, path: str) -> None:
+        findings = sorted(self.entries.values(),
+                          key=lambda e: (e["path"], e["rule"],
+                                         e["fingerprint"]))
+        findings += self.fixed
+        with open(path, "w") as f:
+            json.dump({"version": 1, "findings": findings}, f, indent=1,
+                      sort_keys=False)
+            f.write("\n")
+
+    def split(self, findings: Iterable[Finding]
+              ) -> tuple[list[Finding], list[Finding], list[dict]]:
+        """(new, baselined, stale-entries)."""
+        live = {f.fingerprint: f for f in findings}
+        new = [f for fp, f in live.items() if fp not in self.entries]
+        old = [f for fp, f in live.items() if fp in self.entries]
+        stale = [e for fp, e in self.entries.items() if fp not in live]
+        new.sort(key=lambda f: (f.path, f.line))
+        return new, old, stale
+
+    def add(self, finding: Finding, reason: str,
+            status: str = "suppressed") -> None:
+        e = finding.to_dict()
+        e["status"] = status
+        e["reason"] = reason
+        if status == "fixed":
+            self.fixed.append(e)
+        else:
+            self.entries[finding.fingerprint] = e
+
+
+def load_baseline(path: Optional[str]) -> Baseline:
+    if not path:
+        return Baseline()
+    try:
+        return Baseline.load(path)
+    except FileNotFoundError:
+        return Baseline()

@@ -1,0 +1,380 @@
+"""AST checkers for the bug classes this repo has actually shipped.
+
+Per-file rules (class ``FileChecker``):
+
+- **ASY001** ``asyncio.wait_for`` wrapping a cancellable ``.get()`` /
+  ``.wait()``. On py3.10, ``wait_for`` can swallow a cancel that races the
+  inner future's completion — the Dispatcher ``_exit_loop`` hang PR 1
+  diagnosed. Use a bare ``await``, or ``tpu9.utils.aio.queue_get`` /
+  ``event_wait`` (``asyncio.wait`` based — it never eats an outer cancel).
+- **ASY002** fire-and-forget ``create_task`` / ``ensure_future`` whose
+  result is discarded: the event loop holds only a weak reference, so GC
+  can collect a *running* task mid-flight. Use ``tpu9.utils.aio.spawn``
+  (module task-set + done-callback discard) or store the task.
+- **ASY003** a handler in a coroutine that catches ``BaseException`` /
+  everything / ``CancelledError`` and never re-raises: cancellation is
+  silently converted into "keep running", which is how shutdowns hang.
+- **ASY004** blocking calls (``time.sleep``, sync subprocess/socket/file
+  IO) directly in an ``async def`` body: stalls every request sharing the
+  loop. Wrap in ``asyncio.to_thread`` or use the async equivalent.
+- **JAX002** jit recompile hazards: ``jax.jit(f)(x)`` immediately invoked
+  (retraces every call) and ``jax.jit``/``pallas_call`` constructed inside
+  a loop body instead of cached at module/object scope.
+
+Whole-program rule (``check_jax_hotpath``):
+
+- **JAX001** host-device sync (``.item()``, ``block_until_ready``,
+  ``jax.device_get``, ``np.asarray``/``np.array`` on device values) in
+  functions reachable from the engine serve loop. Reachability is a
+  name-linked call-graph BFS over the hot-path files declared in
+  ``boundaries.toml`` — over-approximate on purpose: a false positive
+  costs one reviewed suppression, a missed sync costs tokens/sec.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .findings import Finding
+
+ASYNC_RULES = ("ASY001", "ASY002", "ASY003", "ASY004")
+JAX_RULES = ("JAX001", "JAX002")
+
+# ASY004: call names that block the event loop. Dotted names match exact
+# attribute chains; bare names match builtins called by name.
+BLOCKING_CALLS = {
+    "time.sleep": "time.sleep",
+    "os.system": "os.system",
+    "os.popen": "os.popen",
+    "os.wait": "os.wait",
+    "os.waitpid": "os.waitpid",
+    "subprocess.run": "sync subprocess",
+    "subprocess.call": "sync subprocess",
+    "subprocess.check_call": "sync subprocess",
+    "subprocess.check_output": "sync subprocess",
+    "subprocess.getoutput": "sync subprocess",
+    "subprocess.getstatusoutput": "sync subprocess",
+    "subprocess.Popen": "sync subprocess",
+    "socket.create_connection": "sync socket IO",
+    "socket.getaddrinfo": "sync DNS resolution",
+    "urllib.request.urlopen": "sync HTTP",
+    "requests.request": "sync HTTP",
+    "requests.get": "sync HTTP",
+    "requests.post": "sync HTTP",
+    "requests.put": "sync HTTP",
+    "requests.delete": "sync HTTP",
+    "requests.head": "sync HTTP",
+    "shutil.rmtree": "sync file IO",
+    "shutil.copytree": "sync file IO",
+    "shutil.copy": "sync file IO",
+    "shutil.copy2": "sync file IO",
+    "shutil.move": "sync file IO",
+}
+
+# device->host syncs for JAX001 (attribute-method form, zero/any args)
+SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+# dotted-call form
+SYNC_CALLS = {
+    "jax.device_get": "jax.device_get",
+    "jax.block_until_ready": "jax.block_until_ready",
+    "np.asarray": "np.asarray on a device value",
+    "np.array": "np.array on a device value",
+    "numpy.asarray": "numpy.asarray on a device value",
+    "numpy.array": "numpy.array on a device value",
+}
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+@dataclass
+class _Scope:
+    name: str
+    is_async: bool
+    node: ast.AST
+    loop_depth: int = 0
+
+
+class FileChecker(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self._scopes: list[_Scope] = []
+
+    # -- helpers -------------------------------------------------------------
+    def _symbol(self) -> str:
+        return ".".join(s.name for s in self._scopes
+                        if not isinstance(s.node, (ast.For, ast.While,
+                                                   ast.AsyncFor))) or "<module>"
+
+    def _fn_scope(self) -> _Scope | None:
+        """Nearest enclosing function/lambda scope (loops excluded)."""
+        for s in reversed(self._scopes):
+            if isinstance(s.node, (ast.AsyncFunctionDef, ast.FunctionDef,
+                                   ast.Lambda)):
+                return s
+        return None
+
+    def _in_async(self) -> bool:
+        s = self._fn_scope()
+        return s is not None and s.is_async
+
+    def _in_loop(self) -> bool:
+        for s in reversed(self._scopes):
+            if isinstance(s.node, (ast.For, ast.While, ast.AsyncFor)):
+                return True
+            if isinstance(s.node, (ast.AsyncFunctionDef, ast.FunctionDef,
+                                   ast.Lambda)):
+                return False
+        return False
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            rule, self.path, getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0), message, self._symbol()))
+
+    # -- scope tracking ------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scopes.append(_Scope(node.name, False, node))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scopes.append(_Scope(node.name, False, node))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scopes.append(_Scope(node.name, True, node))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._scopes.append(_Scope("<lambda>", False, node))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    def _visit_loop(self, node) -> None:
+        self._scopes.append(_Scope("<loop>", self._in_async(), node))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    visit_For = visit_While = visit_AsyncFor = _visit_loop
+
+    # -- ASY002: discarded task handles --------------------------------------
+    def visit_Expr(self, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Call):
+            name = dotted_name(call.func)
+            tail = name.rsplit(".", 1)[-1]
+            # bare names too: `from asyncio import create_task` is the same
+            # weak-ref'd fire-and-forget (a same-named local helper is a
+            # reviewed noqa, not a hole in the rule)
+            if tail in ("create_task", "ensure_future"):
+                self._emit(
+                    "ASY002", node,
+                    f"fire-and-forget {name}(...): the loop keeps only a "
+                    "weak ref, so GC can collect the RUNNING task — hold "
+                    "the handle or use tpu9.utils.aio.spawn()")
+        self.generic_visit(node)
+
+    # -- ASY001 / ASY004 / JAX002 on calls ------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+
+        # ASY001: asyncio.wait_for(<x>.get()/<x>.wait(), ...)
+        if name in ("asyncio.wait_for", "wait_for") and node.args:
+            inner = node.args[0]
+            if (isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr in ("get", "wait")
+                    and dotted_name(inner.func.value) != "asyncio"):
+                loop_note = (" inside a poll loop" if self._in_loop() else "")
+                self._emit(
+                    "ASY001", node,
+                    f"asyncio.wait_for wrapping .{inner.func.attr}()"
+                    f"{loop_note}: py3.10 wait_for can swallow a cancel "
+                    "racing the inner future (the Dispatcher._exit_loop "
+                    "hang) — use a bare await or "
+                    "tpu9.utils.aio.queue_get/event_wait")
+
+        # ASY004: blocking call in async def
+        if self._in_async():
+            desc = BLOCKING_CALLS.get(name)
+            if desc:
+                self._emit(
+                    "ASY004", node,
+                    f"{desc} ({name}) blocks the event loop inside an "
+                    "async def — wrap in asyncio.to_thread or use the "
+                    "async equivalent")
+            elif name == "open":
+                self._emit(
+                    "ASY004", node,
+                    "sync file IO (open) directly in an async def blocks "
+                    "the event loop — wrap the IO in asyncio.to_thread")
+
+        # JAX002: jax.jit(...)(...) immediately invoked
+        if (isinstance(node.func, ast.Call)
+                and dotted_name(node.func.func) in ("jax.jit", "jit",
+                                                    "jax.pmap", "pmap")):
+            self._emit(
+                "JAX002", node,
+                f"{dotted_name(node.func.func)}(fn)(...) immediately "
+                "invoked: retraces and recompiles on every call — cache "
+                "the jitted callable at module or object scope")
+        # JAX002: jit constructed inside a loop body
+        elif (dotted_name(node.func) in ("jax.jit", "jax.pmap")
+              and self._in_loop()):
+            self._emit(
+                "JAX002", node,
+                f"{dotted_name(node.func)} constructed inside a loop: "
+                "each iteration builds (and retraces) a fresh callable — "
+                "hoist and cache it")
+
+        self.generic_visit(node)
+
+    # -- ASY003: swallowed cancellation ---------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self._in_async():
+            caught = self._cancellation_catchers(node.type)
+            if caught and not self._reraises(node):
+                self._emit(
+                    "ASY003", node,
+                    f"{caught} in a coroutine without re-raising: swallows "
+                    "CancelledError, so cancellation (shutdown, timeout, "
+                    "drain) silently keeps the coroutine alive — re-raise "
+                    "or narrow to `except Exception`")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _cancellation_catchers(typ: ast.AST | None) -> str:
+        """Describe the clause if it catches CancelledError; '' if not."""
+        if typ is None:
+            return "bare `except:`"
+        names = []
+        if isinstance(typ, ast.Tuple):
+            names = [dotted_name(e) for e in typ.elts]
+        else:
+            names = [dotted_name(typ)]
+        for n in names:
+            if n.rsplit(".", 1)[-1] in ("BaseException", "CancelledError"):
+                return f"`except {n}`"
+        return ""
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        # a raise inside a NESTED def/lambda is that function's raise, not
+        # this handler's — don't let it silence the rule
+        nested: set[int] = set()
+        for n in ast.walk(handler):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and id(n) not in nested:
+                nested.update(id(x) for x in ast.walk(n))
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise) and id(n) not in nested:
+                return True
+        return False
+
+
+def check_file(path: str, tree: ast.AST) -> list[Finding]:
+    checker = FileChecker(path)
+    checker.visit(tree)
+    return checker.findings
+
+
+# -- JAX001: whole-program hot-path sync check --------------------------------
+
+@dataclass
+class _FnInfo:
+    path: str
+    qualname: str
+    node: ast.AST
+    calls: set[str] = field(default_factory=set)
+
+
+def _collect_functions(path: str, tree: ast.AST) -> list[_FnInfo]:
+    fns: list[_FnInfo] = []
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                info = _FnInfo(path, qual, child)
+                for n in ast.walk(child):
+                    if isinstance(n, ast.Call):
+                        name = dotted_name(n.func)
+                        if name:
+                            info.calls.add(name.rsplit(".", 1)[-1])
+                fns.append(info)
+                walk(child, qual)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}.{child.name}" if prefix
+                     else child.name)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return fns
+
+
+def check_jax_hotpath(files: dict[str, ast.AST], roots: list[str],
+                      ) -> list[Finding]:
+    """BFS the name-linked call graph from ``roots`` (bare function names)
+    across the hot-path files; flag host-device syncs in reachable fns."""
+    all_fns: list[_FnInfo] = []
+    for path, tree in sorted(files.items()):
+        all_fns.extend(_collect_functions(path, tree))
+    by_bare: dict[str, list[_FnInfo]] = {}
+    for fn in all_fns:
+        by_bare.setdefault(fn.qualname.rsplit(".", 1)[-1], []).append(fn)
+
+    reachable: set[int] = set()
+    frontier = [fn for r in roots for fn in by_bare.get(r, [])]
+    while frontier:
+        fn = frontier.pop()
+        if id(fn) in reachable:
+            continue
+        reachable.add(id(fn))
+        for callee in fn.calls:
+            frontier.extend(by_bare.get(callee, []))
+
+    findings: list[Finding] = []
+    for fn in all_fns:
+        if id(fn) not in reachable:
+            continue
+        # scan only this function's own body, not nested defs (they are
+        # separate graph nodes and may be unreachable trace-time closures)
+        nested_nodes: set[int] = set()
+        for c in ast.walk(fn.node):
+            if (isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and c is not fn.node and id(c) not in nested_nodes):
+                nested_nodes.update(id(x) for x in ast.walk(c))
+
+        for n in ast.walk(fn.node):
+            if not isinstance(n, ast.Call) or id(n) in nested_nodes:
+                continue
+            name = dotted_name(n.func)
+            sync = SYNC_CALLS.get(name)
+            if (not sync and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in SYNC_METHODS
+                    and not n.args):
+                sync = f".{n.func.attr}()"
+            if sync:
+                findings.append(Finding(
+                    "JAX001", fn.path, n.lineno, n.col_offset,
+                    f"host-device sync ({sync}) in `{fn.qualname}`, which "
+                    f"is reachable from the serve loop "
+                    f"({'/'.join(roots)}): every sync stalls the decode "
+                    "pipeline — batch it at the window boundary or keep a "
+                    "host mirror", fn.qualname))
+    return findings

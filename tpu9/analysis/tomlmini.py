@@ -1,0 +1,111 @@
+"""Minimal TOML-subset reader for py3.10 (no stdlib tomllib, and the image
+must not grow deps). Supports exactly what boundaries.toml uses: ``[table]``
+/ ``[table.sub]`` headers, quoted or bare keys, string values, and arrays of
+strings (single-line or multi-line). On 3.11+ the real tomllib is used, so
+this stays a fallback, not a dialect.
+"""
+
+from __future__ import annotations
+
+import re
+
+_HEADER = re.compile(r"^\[(?P<name>[^\]]+)\]\s*$")
+_KEY = re.compile(r'^(?:"(?P<qkey>[^"]+)"|(?P<key>[A-Za-z0-9_.-]+))\s*=\s*'
+                  r'(?P<rest>.*)$')
+_STR = re.compile(r'"((?:[^"\\]|\\.)*)"')
+
+
+def loads(text: str) -> dict:
+    try:
+        import tomllib  # py3.11+
+        return tomllib.loads(text)
+    except ModuleNotFoundError:
+        pass
+    root: dict = {}
+    table = root
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = _strip_comment(lines[i])
+        i += 1
+        if not line:
+            continue
+        m = _HEADER.match(line)
+        if m:
+            table = root
+            for part in _split_header(m.group("name")):
+                table = table.setdefault(part, {})
+            continue
+        m = _KEY.match(line)
+        if not m:
+            raise ValueError(f"tomlmini: cannot parse line: {line!r}")
+        key = m.group("qkey") or m.group("key")
+        rest = m.group("rest").strip()
+        # multi-line array: keep consuming until the bracket closes
+        while rest.startswith("[") and not _array_closed(rest):
+            if i >= len(lines):
+                raise ValueError(f"tomlmini: unterminated array for {key!r}")
+            rest += " " + _strip_comment(lines[i])
+            i += 1
+        table[key] = _value(rest.strip())
+    return root
+
+
+def _split_header(name: str) -> list[str]:
+    parts, buf, inq = [], "", False
+    for ch in name:
+        if ch == '"':
+            inq = not inq
+        elif ch == "." and not inq:
+            parts.append(buf)
+            buf = ""
+        else:
+            buf += ch
+    parts.append(buf)
+    return [p.strip() for p in parts]
+
+
+def _strip_comment(line: str) -> str:
+    out, inq = "", False
+    for ch in line:
+        if ch == '"':
+            inq = not inq
+        if ch == "#" and not inq:
+            break
+        out += ch
+    return out.strip()
+
+
+def _array_closed(rest: str) -> bool:
+    depth, inq = 0, False
+    for ch in rest:
+        if ch == '"':
+            inq = not inq
+        elif not inq and ch == "[":
+            depth += 1
+        elif not inq and ch == "]":
+            depth -= 1
+    return depth == 0
+
+
+def _value(rest: str):
+    if rest.startswith("["):
+        return [_unescape(m) for m in _STR.findall(rest)]
+    m = _STR.fullmatch(rest)
+    if m:
+        return _unescape(m.group(1))
+    if rest in ("true", "false"):
+        return rest == "true"
+    try:
+        return int(rest)
+    except ValueError:
+        raise ValueError(f"tomlmini: unsupported value: {rest!r}")
+
+
+def _unescape(s: str) -> str:
+    return s.replace('\\"', '"').replace("\\\\", "\\")
+
+
+def load_file(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return loads(f.read())

@@ -22,6 +22,7 @@ import click
 
 from ..config import load_config
 from ..sdk.client import Context, GatewayClient
+from ..utils.aio import spawn as aio_spawn
 
 
 def _client() -> GatewayClient:
@@ -285,8 +286,11 @@ def shell(container_id: str, cmd: str) -> None:
                     if not data:
                         loop.remove_reader(sys.stdin.fileno())
                         data = b"\x04"   # PTY EOF: Ctrl-D
-                    asyncio.ensure_future(ws.send_json(
-                        {"d": base64.b64encode(data).decode()}))
+                    # spawn (ASY002): a GC'd send task would eat typed
+                    # keystrokes; ws.send_json serializes internally
+                    aio_spawn(ws.send_json(
+                        {"d": base64.b64encode(data).decode()}),
+                        name="shell-stdin")
 
                 try:
                     if interactive:

@@ -27,6 +27,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..utils.fsio import atomic_write_bytes
 from ..abstractions.endpoint import EndpointService
 from ..abstractions.function import FunctionService
 from ..abstractions.image import ImageService
@@ -459,10 +460,10 @@ class Gateway:
             for t in (wait, stop):
                 if not t.done():
                     t.cancel()
-            try:
-                await wait
-            except BaseException:       # noqa: BLE001 — cancelled poll
-                pass
+            # gather, not `except BaseException: pass` (ASY003): absorbs
+            # the cancelled poll's CancelledError but re-raises if the
+            # handler itself is cancelled while draining
+            await asyncio.gather(wait, return_exceptions=True)
 
     async def stop(self) -> None:
         self._shutting_down.set()       # FIRST: releases every long-poll
@@ -786,8 +787,10 @@ class Gateway:
                                    ws.workspace_id, "objects")
         os.makedirs(objects_dir, exist_ok=True)
         path = os.path.join(objects_dir, f"{obj_hash}.zip")
-        with open(path, "wb") as f:
-            f.write(body)
+        # off-loop tmp+rename (ASY004): zips are MBs, and concurrent
+        # same-hash uploads racing a _rpc_get_object reader must never
+        # see a half-written or re-truncated file
+        await atomic_write_bytes(path, body)
         object_id = await self.backend.create_object(ws.workspace_id, obj_hash,
                                                      len(body), path)
         return web.json_response({"object_id": object_id, "deduped": False})

@@ -41,6 +41,7 @@ from typing import Optional
 
 from ..cache import CacheClient
 from .manifest import FileEntry, ImageManifest, open_nofollow, safe_join
+from ..utils.aio import reap
 
 log = logging.getLogger("tpu9.images")
 
@@ -163,11 +164,9 @@ class LazyFill:
 
     async def close(self) -> None:
         if self._task is not None and not self._task.done():
-            self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            # reap: absorbs the fill's cancel/crash (already logged) but
+            # re-raises OUR cancellation (ASY003)
+            await reap(self._task, absorb_errors=True)
         if self._server is not None:
             self._server.close()
             try:

@@ -17,6 +17,7 @@ import shutil
 from typing import Optional
 
 from ..cache import CacheClient
+from ..utils.aio import event_wait
 from .lazy import LazyFill
 from .manifest import ImageManifest, materialize
 
@@ -70,10 +71,9 @@ class ImagePuller:
     async def boot_gate(self) -> None:
         """Await until no container is cold-starting — bounded so a wedged
         boot can never starve fills forever."""
-        try:
-            await asyncio.wait_for(self._boot_clear.wait(), timeout=15.0)
-        except asyncio.TimeoutError:
-            pass
+        # event_wait, not wait_for (ASY001): a fill cancelled while the
+        # boot gate clears must actually cancel, not start a bulk fetch
+        await event_wait(self._boot_clear, timeout=15.0)
 
     def bundle_path(self, image_id: str) -> str:
         return os.path.join(self.bundles_dir, image_id)
@@ -159,7 +159,10 @@ class ImagePuller:
                     await stale.close()
                 live_refs = self._refs.get(image_id, 0) > 0
                 if not live_refs:
-                    shutil.rmtree(dest, ignore_errors=True)
+                    # off-loop (ASY004): a GB-scale stale bundle rmtree
+                    # would stall every pull/heartbeat on the worker loop
+                    await asyncio.to_thread(
+                        shutil.rmtree, dest, ignore_errors=True)
                 fill = LazyFill(manifest, dest, self.cache,
                                 self.lazy_sock(image_id),
                                 boot_gate=self.boot_gate)
@@ -181,7 +184,8 @@ class ImagePuller:
                     f"image {image_id}: {len(missing)} chunks unavailable")
 
             tmp = dest + ".partial"
-            shutil.rmtree(tmp, ignore_errors=True)
+            await asyncio.to_thread(
+                shutil.rmtree, tmp, ignore_errors=True)   # off-loop (ASY004)
             os.makedirs(tmp, exist_ok=True)
 
             def get_chunk(digest: str) -> Optional[bytes]:
@@ -193,16 +197,23 @@ class ImagePuller:
             os.makedirs(tmp, exist_ok=True)
             # runtime metadata the lifecycle reads when wiring the container
             import json
-            with open(os.path.join(tmp, ".tpu9-env.json"), "w") as f:
-                json.dump(self.runtime_meta(manifest), f)
-            with open(os.path.join(tmp, ".tpu9-complete"), "w") as f:
-                f.write(manifest.manifest_hash)
-            # a crashed worker may have left a FUSE mount at dest — rmtree
-            # can't remove a live mount and the rename would get EBUSY
             import subprocess
-            subprocess.run(["umount", "-l", dest], capture_output=True)
-            shutil.rmtree(dest, ignore_errors=True)
-            os.rename(tmp, dest)
+
+            def publish() -> None:
+                # off-loop (ASY004): metadata writes + lazy-umount +
+                # GB-scale rmtree + rename, all blocking syscalls
+                with open(os.path.join(tmp, ".tpu9-env.json"), "w") as f:
+                    json.dump(self.runtime_meta(manifest), f)
+                with open(os.path.join(tmp, ".tpu9-complete"), "w") as f:
+                    f.write(manifest.manifest_hash)
+                # a crashed worker may have left a FUSE mount at dest —
+                # rmtree can't remove a live mount and the rename would
+                # get EBUSY
+                subprocess.run(["umount", "-l", dest], capture_output=True)
+                shutil.rmtree(dest, ignore_errors=True)
+                os.rename(tmp, dest)
+
+            await asyncio.to_thread(publish)
             self._refs[image_id] = self._refs.get(image_id, 0) + 1
             log.info("pulled %s: %d files, %d chunks", image_id,
                      len(manifest.files), len(chunks))
@@ -278,16 +289,26 @@ class ImagePuller:
         entries.sort(reverse=True)
         removed = 0
         for _mtime, name in entries[keep:]:
-            mount = self._fuse_mounts.pop(name, None)
-            if mount is not None:
-                try:
-                    if self.fusefs is not None:
-                        await self.fusefs.unmount(mount.mountpoint)
-                    else:
-                        await mount.unmount()
-                except Exception:     # noqa: BLE001 — lazy umount below
-                    pass
-            shutil.rmtree(self.bundle_path(name), ignore_errors=True)
-            self._refs.pop(name, None)
-            removed += 1
+            # per-image lock + ref re-check: the rmtree now awaits (to keep
+            # GB-scale deletes off the loop), so a concurrent pull() could
+            # otherwise revive the bundle mid-delete and hand a container a
+            # tree the thread is unlinking under it
+            async with self._locks.setdefault(name, asyncio.Lock()):
+                if (self._refs.get(name, 0) > 0
+                        or self.active_fill(name) is not None):
+                    continue
+                mount = self._fuse_mounts.pop(name, None)
+                if mount is not None:
+                    try:
+                        if self.fusefs is not None:
+                            await self.fusefs.unmount(mount.mountpoint)
+                        else:
+                            await mount.unmount()
+                    except Exception:     # noqa: BLE001 — lazy umount below
+                        pass
+                await asyncio.to_thread(
+                    shutil.rmtree, self.bundle_path(name),
+                    ignore_errors=True)   # off-loop (ASY004)
+                self._refs.pop(name, None)
+                removed += 1
         return removed

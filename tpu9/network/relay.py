@@ -31,6 +31,7 @@ import logging
 import secrets
 import time
 from typing import Optional
+from ..utils.aio import reap
 
 log = logging.getLogger("tpu9.network")
 
@@ -323,11 +324,7 @@ class Dialer:
 
     async def stop(self) -> None:
         if self._gc_task is not None:
-            self._gc_task.cancel()
-            try:
-                await self._gc_task
-            except asyncio.CancelledError:
-                pass
+            await reap(self._gc_task)     # ASY003: our cancel re-raises
             self._gc_task = None
         for t in self._tunnels.values():
             await t.stop()
@@ -355,11 +352,9 @@ class RelayAgent:
     async def stop(self) -> None:
         self._stopping.set()
         if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # reap: swallows the child's CancelledError but re-raises if
+            # stop() itself is cancelled mid-drain (ASY003)
+            await reap(self._task)
             self._task = None
         for t in list(self._conns):
             t.cancel()

@@ -20,6 +20,7 @@ from typing import Callable, Optional
 
 from .metrics import metrics as metrics_registry
 from .trace import tracer as global_tracer
+from ..utils.aio import reap
 
 log = logging.getLogger("tpu9.observability")
 
@@ -146,11 +147,9 @@ class OtlpExporter:
 
     async def stop(self) -> None:
         if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # reap: swallows the child's CancelledError but re-raises if
+            # stop() itself is cancelled mid-drain (ASY003)
+            await reap(self._task)
             self._task = None
         try:
             await self.flush()     # final drain

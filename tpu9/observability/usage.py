@@ -17,6 +17,7 @@ import asyncio
 import logging
 import time
 from typing import Optional
+from ..utils.aio import reap
 
 log = logging.getLogger("tpu9.observability")
 
@@ -111,11 +112,9 @@ class UsageService:
     async def stop(self) -> None:
         self._stopping.set()
         if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # reap: swallows the child's CancelledError but re-raises if
+            # stop() itself is cancelled mid-drain (ASY003)
+            await reap(self._task)
         await self.flush()
 
     async def _flush_loop(self) -> None:

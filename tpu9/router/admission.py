@@ -115,9 +115,7 @@ class ReplicaBudgets:
                 waiter.cancel()
                 try:
                     await waiter
-                except asyncio.CancelledError:
-                    # the waiter's own cancel; an in-flight cancellation
-                    # of THIS task resumes propagating after the finally
+                except asyncio.CancelledError:  # tpu9: noqa[ASY003] the waiter's own cancel, deliberately absorbed; an in-flight cancellation of THIS task resumes propagating after the finally
                     pass
 
 

@@ -133,7 +133,7 @@ class FleetRouter:
                 await asyncio.wait({st.dispatcher}, timeout=1.0)
             try:
                 st.dispatcher.exception()
-            except asyncio.CancelledError:
+            except asyncio.CancelledError:  # tpu9: noqa[ASY003] exception() on a done cancelled task raises its stored CancelledError — retrieval, not a swallowed live cancel
                 pass
             st.dispatcher = None
         # flush still-queued requests: their submitters must get an

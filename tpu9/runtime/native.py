@@ -37,6 +37,7 @@ from .base import ContainerHandle, ContainerSpec, Runtime, RuntimeState
 log = logging.getLogger("tpu9.runtime")
 
 from ..utils import native_binary
+from ..utils.aio import cancellable_wait, spawn
 
 _NATIVE_BIN = native_binary("t9container")
 
@@ -116,7 +117,6 @@ class NativeRuntime(Runtime):
         self._proxies: dict[str, list[asyncio.base_events.Server]] = {}
         self._slots: dict[str, int] = {}      # container -> /30 slot index
         self._ifnames: dict[str, str] = {}    # container -> host veth name
-        self._bg: set[asyncio.Task] = set()   # reap/escalate keepalives
 
     @staticmethod
     def supported() -> bool:
@@ -249,8 +249,10 @@ class NativeRuntime(Runtime):
                             break
                         dst.write(data)
                         await dst.drain()
-                except (ConnectionError, asyncio.CancelledError):
-                    pass
+                except ConnectionError:
+                    pass        # peer went away: close our side (finally)
+                except asyncio.CancelledError:
+                    raise       # proxy teardown — propagate (ASY003)
                 finally:
                     try:
                         dst.close()
@@ -462,11 +464,9 @@ class NativeRuntime(Runtime):
             await asyncio.to_thread(self._cleanup_mounts,
                                     spec.container_id)
 
-        # hold a strong ref: the loop only weakly references tasks, and a
-        # GC'd reap would leak the netns/veth/overlay of a dead container
-        t = asyncio.create_task(reap())
-        self._bg.add(t)
-        t.add_done_callback(self._bg.discard)
+        # spawn: strong ref (a GC'd reap would leak the netns/veth/overlay
+        # of a dead container) + crash logging
+        spawn(reap(), name=f"native-reap-{spec.container_id[-8:]}")
         return handle
 
     async def _close_proxies(self, container_id: str) -> None:
@@ -504,15 +504,15 @@ class NativeRuntime(Runtime):
         if signal_num != signal.SIGKILL:
             async def escalate():
                 try:
-                    await asyncio.wait_for(proc.wait(), timeout=10.0)
+                    # cancellable_wait, not wait_for: a cancel racing the
+                    # exit must cancel the escalation, not be swallowed
+                    await cancellable_wait(proc.wait(), timeout=10.0)
                 except asyncio.TimeoutError:
                     try:
                         os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
                     except ProcessLookupError:
                         pass
-            t = asyncio.create_task(escalate())
-            self._bg.add(t)
-            t.add_done_callback(self._bg.discard)
+            spawn(escalate(), name=f"kill-escalate-{container_id[-8:]}")
         return True
 
     async def state(self, container_id: str) -> Optional[ContainerHandle]:

@@ -20,6 +20,7 @@ from typing import Optional
 from .base import (ContainerHandle, ContainerSpec, Runtime, RuntimeState,
                    ShellSession)
 from .zygote_client import ZygoteClient
+from ..utils.aio import cancellable_wait, spawn
 
 _ENV_ALLOWLIST = ("PATH", "HOME", "LANG", "TERM")
 
@@ -35,7 +36,6 @@ class ProcessRuntime(Runtime):
 
     def __init__(self, base_dir: str = "/tmp/tpu9/containers") -> None:
         self.base_dir = base_dir
-        self._bg_tasks: set[asyncio.Task] = set()
         self._procs: dict[str, asyncio.subprocess.Process] = {}
         self._handles: dict[str, ContainerHandle] = {}
         self._waiters: dict[str, asyncio.Task] = {}
@@ -124,11 +124,24 @@ class ProcessRuntime(Runtime):
 
         async def reap():
             code = await proc.wait()
-            for t in self._log_tasks.get(spec.container_id, []):
-                try:
-                    await asyncio.wait_for(t, timeout=2.0)
-                except (asyncio.TimeoutError, asyncio.CancelledError):
+            tasks = self._log_tasks.get(spec.container_id, [])
+            if tasks:
+                # asyncio.wait (ASY003/ASY001): never consumes a child's
+                # error or converts OUR cancel into a return — a cancelled
+                # reap stops updating state instead of half-finishing
+                done, pending = await asyncio.wait(tasks, timeout=2.0)
+                for t in pending:
                     t.cancel()
+                for t in done:
+                    if not t.cancelled():
+                        exc = t.exception()
+                        if exc is not None:
+                            # readline/decode failures (pump only guards
+                            # the log_cb call) — log loss must be visible
+                            import logging
+                            logging.getLogger("tpu9.worker").warning(
+                                "log pump for %s died: %r",
+                                spec.container_id, exc)
             handle.exit_code = code
             handle.state = (RuntimeState.STOPPED if code == 0
                             else RuntimeState.FAILED)
@@ -151,15 +164,15 @@ class ProcessRuntime(Runtime):
             # believes it stopped
             async def escalate():
                 try:
-                    await asyncio.wait_for(proc.wait(), timeout=10.0)
+                    # cancellable_wait, not wait_for: a cancel racing the
+                    # exit must cancel the escalation, not be swallowed
+                    await cancellable_wait(proc.wait(), timeout=10.0)
                 except asyncio.TimeoutError:
                     try:
                         os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
                     except ProcessLookupError:
                         pass
-            t = asyncio.create_task(escalate())
-            self._bg_tasks.add(t)
-            t.add_done_callback(self._bg_tasks.discard)
+            spawn(escalate(), name=f"kill-escalate-{container_id[-8:]}")
         return True
 
     async def state(self, container_id: str) -> Optional[ContainerHandle]:
@@ -173,10 +186,18 @@ class ProcessRuntime(Runtime):
         code = await proc.wait()
         waiter = self._waiters.get(container_id)
         if waiter:
-            try:
-                await waiter
-            except asyncio.CancelledError:
-                pass
+            # shield: reap owns the container's TERMINAL state transition
+            # and is shared by every wait() caller — cancelling one caller
+            # must not cancel it (pre-existing hazard: the bare `await
+            # waiter` propagated the cancel INTO reap, stranding
+            # handle.state RUNNING forever). gather (ASY003): our cancel
+            # still reaches the caller; a CRASHED reap keeps propagating
+            # like it always did (its state updates never ran).
+            res = (await asyncio.gather(asyncio.shield(waiter),
+                                        return_exceptions=True))[0]
+            if (isinstance(res, BaseException)
+                    and not isinstance(res, asyncio.CancelledError)):
+                raise res
         return code
 
     def _exec_cwd(self, container_id: str) -> str:

@@ -20,6 +20,7 @@ from ..observability import metrics
 from ..repository import ContainerRepository, WorkerRepository
 from ..statestore import StateStore
 from ..types import ContainerRequest, StopReason, WorkerStatus
+from ..utils.aio import reap
 
 log = logging.getLogger("tpu9.scheduler")
 
@@ -62,11 +63,9 @@ class PoolMonitor:
 
     async def stop(self) -> None:
         if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # reap: swallows the child's CancelledError but re-raises if
+            # stop() itself is cancelled mid-drain (ASY003)
+            await reap(self._task)
             self._task = None
 
     async def _loop(self) -> None:

@@ -28,6 +28,7 @@ from ..types import (ContainerRequest, ContainerState, ContainerStatus,
                      GangInfo, StopReason, new_id)
 from .pools import WorkerPoolController
 from .selector import find_slice_gang, select_worker
+from ..utils.aio import reap
 
 log = logging.getLogger("tpu9.scheduler")
 
@@ -99,11 +100,9 @@ class Scheduler:
     async def stop(self) -> None:
         self._stopping.set()
         if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # reap: swallows the child's CancelledError but re-raises if
+            # stop() itself is cancelled mid-drain (ASY003)
+            await reap(self._task)
 
     # -- backlog -------------------------------------------------------------
 

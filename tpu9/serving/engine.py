@@ -29,6 +29,7 @@ import numpy as np
 from ..models.transformer import (DecoderConfig, decoder_forward,
                                   init_kv_cache)
 from ..ops.sampling import sample_logits
+from ..utils.aio import reap
 
 Params = dict[str, Any]
 
@@ -877,13 +878,10 @@ class InferenceEngine:
 
     async def stop(self) -> None:
         if self._loop_task:
-            self._loop_task.cancel()
-            try:
-                await self._loop_task
-            except asyncio.CancelledError:
-                pass
-            except Exception:      # noqa: BLE001 — loop ALREADY died;
-                pass               # its failure was logged + fanned out
+            # reap: absorbs the loop's CancelledError AND an Exception exit
+            # (the loop ALREADY died; its failure was logged + fanned out)
+            # but re-raises if stop() itself is cancelled (ASY003)
+            await reap(self._loop_task, absorb_errors=True)
             self._loop_task = None
         # a clean shutdown must not strand callers: anything still
         # admitted/waiting/queued gets a terminal answer (the loop's
@@ -1341,6 +1339,7 @@ class InferenceEngine:
                 self._admitting = None
 
             if pending:
+                # tpu9: noqa[JAX001] intended sync point: ONE batched read of all admitted prefill first-tokens (TTFT requires delivering them now)
                 firsts = np.asarray(jax.device_get(
                     jnp.stack([f for _, f in pending])))
                 for (req, _), first in zip(pending, firsts):
@@ -1441,23 +1440,25 @@ class InferenceEngine:
         wins, self._deferred_windows = self._deferred_windows, []
         if not wins:
             return
+        # tpu9: noqa[JAX001] intended sync point: the ONE batched window-boundary device_get (PR 5); N sequential reads would pay N round-trips
         payload = jax.device_get(
             [(w.toks,) if w.n_acc is None else (w.toks, w.n_acc)
              for w in wins])
         for w, arrs in zip(wins, payload):
             self._inflight_steps -= w.k
             self._process_window_host(
-                w, np.asarray(arrs[0]),
-                np.asarray(arrs[1]) if len(arrs) > 1 else None)
+                w, np.asarray(arrs[0]),  # tpu9: noqa[JAX001] arrs are already host memory (device_get above); asarray is a no-copy view
+                np.asarray(arrs[1]) if len(arrs) > 1 else None)  # tpu9: noqa[JAX001] host memory, no device sync
 
     def _process_deferred(self, win: _Window) -> None:
         if win.n_acc is None:
+            # tpu9: noqa[JAX001] intended sync point: the window's compute is DONE (one-window-overlap drains here); this read is the host fan-out
             toks, n_acc = jax.device_get(win.toks), None
         else:
-            toks, n_acc = jax.device_get((win.toks, win.n_acc))
-            n_acc = np.asarray(n_acc)
+            toks, n_acc = jax.device_get((win.toks, win.n_acc))  # tpu9: noqa[JAX001] intended sync point: batched toks+n_acc read at the window boundary
+            n_acc = np.asarray(n_acc)  # tpu9: noqa[JAX001] host memory after device_get, no sync
         self._inflight_steps -= win.k
-        self._process_window_host(win, np.asarray(toks), n_acc)
+        self._process_window_host(win, np.asarray(toks), n_acc)  # tpu9: noqa[JAX001] host memory after device_get, no sync
 
     def _deliver_token(self, slot: int, tok: int) -> None:
         """Deliver ONE generated token to the slot's request, retiring the

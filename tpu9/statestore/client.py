@@ -7,6 +7,7 @@ import asyncio
 import itertools
 from typing import Any, AsyncIterator, Optional
 
+from ..utils.aio import queue_get, spawn
 from . import wire
 from .store import StateStore
 
@@ -25,8 +26,10 @@ class RemoteSubscription:
         return await self.queue.get()
 
     async def get(self, timeout: Optional[float] = None) -> Optional[tuple[str, Any]]:
+        # queue_get, not wait_for: the py3.10 swallowed-cancel race (ASY001)
+        # plus item preservation when a cancel races a pushed event
         try:
-            return await asyncio.wait_for(self.queue.get(), timeout)
+            return await queue_get(self.queue, timeout)
         except asyncio.TimeoutError:
             return None
 
@@ -154,10 +157,12 @@ class RemoteStore(StateStore):
         if self._writer is None:
             return
         try:
-            loop = asyncio.get_running_loop()
+            asyncio.get_running_loop()
         except RuntimeError:
             return
-        loop.create_task(self._call(op, *args))
+        # spawn, not bare create_task: the loop only weak-refs tasks, so a
+        # dropped handle can be GC'd while the unsubscribe is in flight
+        spawn(self._call(op, *args), name=f"statestore-{op}")
 
     async def _send_subscribe(self, sub: "RemoteSubscription") -> None:
         assert self._writer is not None
@@ -189,10 +194,10 @@ class RemoteStore(StateStore):
                 sub.queue.put_nowait((None, None))
 
         try:
-            loop = asyncio.get_running_loop()
-            loop.create_task(do_subscribe())
+            asyncio.get_running_loop()
         except RuntimeError:
             raise RuntimeError("RemoteStore.subscribe requires a running event loop")
+        spawn(do_subscribe(), name=f"statestore-subscribe-{pattern}")
         return sub
 
 

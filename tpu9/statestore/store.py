@@ -16,6 +16,8 @@ import time
 from collections import defaultdict
 from typing import Any, AsyncIterator, Optional
 
+from ..utils.aio import event_wait, queue_get
+
 
 class StateStore:
     """Abstract interface. All methods are coroutines so the remote client can
@@ -108,8 +110,12 @@ class Subscription:
         return await self._queue.get()
 
     async def get(self, timeout: Optional[float] = None) -> Optional[tuple[str, Any]]:
+        # NOT wait_for: py3.10 wait_for can swallow a cancel racing a
+        # published item (the Dispatcher._exit_loop hang class) — and a
+        # cancelled bare Queue.get could drop the raced item. queue_get
+        # re-queues it, so a cancelled waiter never eats an event.
         try:
-            return await asyncio.wait_for(self._queue.get(), timeout)
+            return await queue_get(self._queue, timeout)
         except asyncio.TimeoutError:
             return None
 
@@ -358,9 +364,10 @@ class MemoryStore(StateStore):
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     return None
-                await asyncio.wait_for(ev.wait(), remaining)
-            except asyncio.TimeoutError:
-                return None
+                # event_wait, not wait_for: a cancel racing the wakeup must
+                # cancel this pop, not be swallowed into another loop turn
+                if not await event_wait(ev, remaining):
+                    return None
             finally:
                 self._list_waiters[key].remove(ev)
 
@@ -414,9 +421,8 @@ class MemoryStore(StateStore):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return []
-                await asyncio.wait_for(ev.wait(), remaining)
-            except asyncio.TimeoutError:
-                return []
+                if not await event_wait(ev, remaining):
+                    return []
             finally:
                 self._stream_waiters[key].remove(ev)
             out = collect()

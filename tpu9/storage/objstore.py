@@ -22,9 +22,12 @@ transfer maps onto this 1:1).
 
 from __future__ import annotations
 
+import asyncio
 import os
 import shutil
 import time
+
+from ..utils.fsio import atomic_write_bytes
 from typing import Awaitable, Callable, Optional
 
 # async (method, url, headers, body) -> (status, headers, bytes)
@@ -134,19 +137,20 @@ class LocalObjectStore(ObjectStore):
         return full
 
     async def put(self, key: str, data: bytes) -> None:
-        p = self._path(key)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
-        tmp = f"{p}.tmp-{os.getpid()}-{time.monotonic_ns()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.rename(tmp, p)
+        # off-loop tmp+rename (ASY004): multi-MB writes would stall every
+        # request sharing the gateway/worker loop
+        await atomic_write_bytes(self._path(key), data)
 
     async def get(self, key: str) -> Optional[bytes]:
         p = self._path(key)
         if not os.path.isfile(p):
             return None
-        with open(p, "rb") as f:
-            return f.read()
+
+        def read() -> bytes:
+            with open(p, "rb") as f:    # off-loop (ASY004)
+                return f.read()
+
+        return await asyncio.to_thread(read)
 
     async def get_range(self, key: str, offset: int,
                         length: int) -> Optional[bytes]:
@@ -159,7 +163,6 @@ class LocalObjectStore(ObjectStore):
                 f.seek(offset)
                 return f.read(length)
 
-        import asyncio
         return await asyncio.to_thread(read)
 
     async def delete(self, key: str) -> bool:
@@ -205,19 +208,27 @@ class LocalObjectStore(ObjectStore):
 
     async def compose(self, dest_key: str, part_keys: list[str]) -> int:
         dest = self._path(dest_key)
-        os.makedirs(os.path.dirname(dest), exist_ok=True)
-        tmp = f"{dest}.tmp-{os.getpid()}-{time.monotonic_ns()}"
-        total = 0
-        with open(tmp, "wb") as out:
-            for key in part_keys:
-                with open(self._path(key), "rb") as f:
-                    while True:
-                        chunk = f.read(4 << 20)
-                        if not chunk:
-                            break
-                        out.write(chunk)
-                        total += len(chunk)
-        os.rename(tmp, dest)
+        parts = [self._path(key) for key in part_keys]
+
+        def splice() -> int:
+            # off-loop (ASY004): composing GB-scale multiparts would park
+            # the loop for seconds
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            tmp = f"{dest}.tmp-{os.getpid()}-{time.monotonic_ns()}"
+            total = 0
+            with open(tmp, "wb") as out:
+                for part in parts:
+                    with open(part, "rb") as f:
+                        while True:
+                            chunk = f.read(4 << 20)
+                            if not chunk:
+                                break
+                            out.write(chunk)
+                            total += len(chunk)
+            os.rename(tmp, dest)
+            return total
+
+        total = await asyncio.to_thread(splice)
         return total
 
     def local_dir(self, prefix: str) -> str:

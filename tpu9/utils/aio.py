@@ -1,0 +1,236 @@
+"""Cancellation-correct asyncio helpers (the PR 1 Dispatcher lessons,
+packaged).
+
+Why not ``asyncio.wait_for``: on py3.10 it can swallow a cancel that races
+the inner future's completion — the task "wins", ``wait_for`` returns the
+result, and the single CancelledError the canceller sent is lost. Observed
+as the Dispatcher ``_exit_loop``/LocalStack teardown hang (ONE lost cancel
+left ``stop()``'s unbounded await parked forever). ``asyncio.wait`` never
+converts an outer cancel into a return value, so every helper here is
+built on it. tpu9lint rule ASY001 points at this module.
+
+Why ``spawn``: the event loop holds only a *weak* reference to tasks, so a
+fire-and-forget ``asyncio.create_task(...)`` whose handle is dropped can be
+garbage-collected while still running (cpython #88831). ``spawn`` parks the
+handle in a module task-set until done and logs non-cancellation crashes
+that nobody awaited. tpu9lint rule ASY002 points here.
+
+Why ``reap``: ``try: await t / except CancelledError: pass`` in a stop()
+swallows the *caller's own* cancellation too — a drain cancelling the
+stop() keeps running the rest of it. ``gather(..., return_exceptions=True)``
+absorbs the child's CancelledError but re-raises an outer one. tpu9lint
+rule ASY003 points here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Optional, TypeVar
+
+log = logging.getLogger("tpu9.aio")
+
+T = TypeVar("T")
+
+
+async def cancellable_wait(aw: Awaitable[T],
+                           timeout: Optional[float] = None) -> T:
+    """``wait_for`` semantics without the py3.10 swallowed-cancel hazard.
+
+    Returns the awaitable's result, or raises ``asyncio.TimeoutError`` after
+    cancelling (and draining) the inner task. An outer cancel always
+    propagates — it is never traded for the inner result.
+    """
+    fut = asyncio.ensure_future(aw)
+    if timeout is None:
+        return await fut
+    try:
+        done, _ = await asyncio.wait({fut}, timeout=timeout)
+    except BaseException:
+        # outer cancel (or crash) while parked: never leak the inner task,
+        # and retrieve a racing crash so it can't rot as 'never retrieved'
+        fut.cancel()
+        fut.add_done_callback(_retrieve_quietly)
+        raise
+    if done:
+        return fut.result()
+    fut.cancel()
+    try:
+        await asyncio.wait({fut})   # drain the cancellation before reporting
+    except BaseException:
+        # caller cancelled mid-drain: a crash the inner cleanup is about
+        # to raise must not rot as 'never retrieved'
+        fut.add_done_callback(_retrieve_quietly)
+        raise
+    if not fut.cancelled():
+        exc = fut.exception()
+        if exc is not None:
+            # cleanup crashed while being cancelled: surface IT, exactly
+            # like py3.10 wait_for (bpo-40607) — a timeout must not hide
+            # a real failure
+            raise exc
+        return fut.result()     # completed in the cancel race — keep it
+    raise asyncio.TimeoutError(
+        f"cancellable_wait: {timeout}s elapsed")
+
+
+def _retrieve_quietly(fut: asyncio.Future) -> None:
+    if not fut.cancelled() and fut.exception() is not None:
+        log.warning("cancellable_wait: inner task crashed during "
+                    "cancellation: %r", fut.exception())
+
+
+def _reap_getter(queue: asyncio.Queue, getter: asyncio.Future) -> None:
+    """Cancel an in-flight Queue.get without losing an item it may have
+    already won in the race — re-queue it from the done callback."""
+    def _requeue(fut: asyncio.Future) -> None:
+        if not fut.cancelled() and fut.exception() is None:
+            try:
+                queue.put_nowait(fut.result())
+            except asyncio.QueueFull:
+                # bounded queue filled during the race: dropping silently
+                # would break the no-lost-items contract invisibly — the
+                # helper expects unbounded queues (every tpu9 call site)
+                log.error("queue_get: raced item LOST re-queuing into a "
+                          "full bounded queue — use an unbounded queue")
+                return
+            # the raced item belongs at the FRONT: items enqueued while the
+            # getter held it must not overtake it (put_nowait appends, which
+            # would reorder the event stream). Plain asyncio.Queue keeps a
+            # deque; rotate the fresh append back to the head.
+            dq = getattr(queue, "_queue", None)
+            if dq is not None and hasattr(dq, "rotate") and len(dq) > 1:
+                dq.rotate(1)
+    if getter.done():
+        _requeue(getter)
+        return
+    getter.cancel()
+    getter.add_done_callback(_requeue)
+
+
+async def queue_get(queue: asyncio.Queue,
+                    timeout: Optional[float] = None) -> Any:
+    """``Queue.get`` with a timeout, safe against both py3.10 wait_for
+    cancel-swallowing and the cancelled-getter-drops-an-item race: a racing
+    put is re-queued at the front, never lost, preserving order for the
+    single-consumer queues every tpu9 call site uses (with SEVERAL getters
+    on one queue cancelled in the same tick, the relative order of their
+    raced items follows callback completion order and is not guaranteed).
+    Raises ``asyncio.TimeoutError``. Expects an UNBOUNDED queue — on a
+    bounded one that fills during the race, the re-queue would have to
+    drop the item (logged loudly)."""
+    if timeout is None:
+        return await queue.get()
+    getter = asyncio.ensure_future(queue.get())
+    try:
+        done, _ = await asyncio.wait({getter}, timeout=timeout)
+    except BaseException:
+        _reap_getter(queue, getter)
+        raise
+    if done:
+        return getter.result()
+    _reap_getter(queue, getter)
+    raise asyncio.TimeoutError(f"queue_get: {timeout}s elapsed")
+
+
+async def event_wait(event: asyncio.Event,
+                     timeout: Optional[float] = None) -> bool:
+    """``Event.wait`` with a timeout: True if set, False on timeout.
+    Replaces ``wait_for(ev.wait(), t)`` poll loops (ASY001)."""
+    if event.is_set():
+        return True
+    if timeout is None:
+        await event.wait()
+        return True
+    waiter = asyncio.ensure_future(event.wait())
+    try:
+        done, _ = await asyncio.wait({waiter}, timeout=timeout)
+    except BaseException:
+        waiter.cancel()
+        raise
+    if done:
+        waiter.result()
+        return True
+    waiter.cancel()
+    return False
+
+
+# strong refs for fire-and-forget tasks; the loop itself only keeps weak
+# ones, so without this a running task can be garbage-collected mid-flight
+_BG_TASKS: set[asyncio.Task] = set()
+_PRUNE_FLOOR = 64
+_prune_watermark = _PRUNE_FLOOR
+
+
+def _on_bg_done(task: asyncio.Task) -> None:
+    _BG_TASKS.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        log.warning("background task %s crashed: %r",
+                    task.get_name(), exc)
+
+
+def spawn(coro, *, name: Optional[str] = None) -> asyncio.Task:
+    """Fire-and-forget ``create_task`` done right: the handle is held in a
+    module task-set until completion (GC-safe), and an unobserved crash is
+    logged instead of surfacing as 'exception was never retrieved' at
+    interpreter exit. Returns the task, so callers may still await it."""
+    global _prune_watermark
+    task = asyncio.get_running_loop().create_task(coro, name=name)
+    _BG_TASKS.add(task)
+    task.add_done_callback(_on_bg_done)
+    # prune tasks stranded by a CLOSED loop (fresh-loop-per-test harness,
+    # short-lived CLI runs): their done callbacks can never fire, and
+    # pinning their frames for process lifetime is a leak. Amortized via a
+    # high-water mark — spawn() sits on per-log-line hot paths, so an
+    # every-call O(N) scan would be its own event-loop tax.
+    if len(_BG_TASKS) >= _prune_watermark:
+        for t in list(_BG_TASKS):
+            if t is not task and t.get_loop().is_closed():
+                _BG_TASKS.discard(t)
+        _prune_watermark = max(_PRUNE_FLOOR, 2 * len(_BG_TASKS))
+    return task
+
+
+def bg_task_count() -> int:
+    """Live fire-and-forget tasks on live loops (tests assert this drains
+    to zero; tasks stranded by a closed loop don't count)."""
+    return sum(1 for t in _BG_TASKS if not t.get_loop().is_closed())
+
+
+async def reap(*tasks: Optional[asyncio.Task],
+               absorb_errors: bool = False) -> None:
+    """Cancel-and-await child tasks from a stop()/close() path.
+
+    Swallows the children's CancelledError (that is the point of stopping
+    them) but — unlike ``except CancelledError: pass`` — re-raises if the
+    *caller* is cancelled while draining, so a cancelled stop() aborts
+    instead of silently continuing (ASY003).
+
+    A child that had CRASHED (non-cancel exception) re-raises from here by
+    default — same contract as the ``await task`` these sites had before,
+    so a dead loop still surfaces at shutdown. Pass ``absorb_errors=True``
+    where the failure was already handled/logged upstream; it is then
+    logged here, never silent."""
+    live = [t for t in tasks if t is not None]
+    for t in live:
+        t.cancel()
+    if not live:
+        return
+    results = await asyncio.gather(*live, return_exceptions=True)
+    first: Optional[BaseException] = None
+    for t, r in zip(live, results):
+        if (isinstance(r, BaseException)
+                and not isinstance(r, asyncio.CancelledError)):
+            if absorb_errors or first is not None:
+                # every crash beyond the one re-raised is logged — gather
+                # already retrieved them, so this is their only surface
+                log.warning("reaped task %s had crashed: %r",
+                            t.get_name() if hasattr(t, "get_name") else t,
+                            r)
+            elif first is None:
+                first = r
+    if first is not None:
+        raise first

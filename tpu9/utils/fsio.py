@@ -1,0 +1,30 @@
+"""Shared filesystem helpers for async services."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+
+async def atomic_write_bytes(path: str, data: bytes,
+                             mkdirs: bool = True) -> None:
+    """Write ``data`` to ``path`` atomically (tmp + rename) off the event
+    loop: concurrent readers and same-path writers never observe a partial
+    or re-truncated file, and a crashed write leaves no stray tmp."""
+    def write() -> None:
+        if mkdirs:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}-{time.monotonic_ns()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.rename(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    await asyncio.to_thread(write)

@@ -26,6 +26,7 @@ from ..repository import ContainerRepository
 from ..runtime.base import ContainerSpec, Runtime
 from ..types import (ContainerRequest, ContainerState, ContainerStatus,
                      LifecyclePhase, StopReason, StubType)
+from ..utils.aio import spawn
 from ..utils.paths import validate_path_part
 from .tpu_manager import TpuDeviceManager
 
@@ -198,15 +199,16 @@ class ContainerLifecycle:
             def log_cb(line: str, stream: str) -> None:
                 # invoked from the runtime's pump coroutine → loop is running
                 admit, dropped = limiter.admit()
-                loop = asyncio.get_running_loop()
+                # spawn (ASY002): a GC'd append_log task would silently
+                # drop container log lines mid-flight
                 if dropped:
-                    loop.create_task(self.containers.append_log(
+                    spawn(self.containers.append_log(
                         container_id,
                         f"[tpu9] log rate limited: {dropped} lines dropped",
-                        "stderr"))
+                        "stderr"), name="lifecycle-log-drop")
                 if admit:
-                    loop.create_task(self.containers.append_log(
-                        container_id, line, stream))
+                    spawn(self.containers.append_log(
+                        container_id, line, stream), name="lifecycle-log")
 
             check_aborted()
             handle = await self.runtime.run(spec, log_cb=log_cb)
@@ -258,9 +260,9 @@ class ContainerLifecycle:
             # once the runner marks its state saved — skipped for restores
             if (self.checkpoints is not None and not request.checkpoint_id
                     and request.env.get("TPU9_CHECKPOINT_ENABLED") == "1"):
-                asyncio.create_task(self.checkpoints.auto_checkpoint(
+                spawn(self.checkpoints.auto_checkpoint(
                     request.stub_id, request.workspace_id, container_id,
-                    spec.workdir))
+                    spec.workdir), name=f"auto-ckpt-{container_id[-8:]}")
 
             self._active[container_id] = asyncio.create_task(
                 self._supervise(request, state))

@@ -31,6 +31,7 @@ from typing import Awaitable, Callable, Optional
 
 from ..images.manifest import ImageManifest, materialize, snapshot_dir
 from ..types import new_id
+from ..utils.aio import spawn
 
 log = logging.getLogger("tpu9.worker")
 
@@ -153,7 +154,9 @@ class SandboxAgent:
             session = await self.runtime.exec_stream(container_id, cmd)
         proc.session = session
         self.procs[proc.proc_id] = proc
-        asyncio.create_task(self._pump_output(proc))
+        # spawn (ASY002): a GC'd pump would freeze the sandbox's output
+        # stream while the process keeps writing
+        spawn(self._pump_output(proc), name=f"sbx-pump-{proc.proc_id[-8:]}")
         return {"proc_id": proc.proc_id}
 
     async def _pump_output(self, proc: SandboxProcess) -> None:
@@ -227,7 +230,7 @@ class SandboxAgent:
                 self.procs.pop(pid, None)
         client = self._t9proc.pop(container_id, None)
         if client is not None:
-            asyncio.create_task(client.close())
+            spawn(client.close(), name=f"t9proc-close-{container_id[-8:]}")
 
     # -- filesystem ----------------------------------------------------------
 

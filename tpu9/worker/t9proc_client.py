@@ -22,6 +22,7 @@ from typing import Optional
 
 from ..runtime.base import ShellSession
 from ..types import new_id
+from ..utils.aio import reap
 
 log = logging.getLogger("tpu9.worker")
 
@@ -144,8 +145,6 @@ class T9ProcClient:
             except Exception:     # noqa: BLE001
                 pass
         if self._dispatch_task is not None:
-            self._dispatch_task.cancel()
-            try:
-                await self._dispatch_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            # reap: absorbs the dispatcher's cancel/crash but re-raises
+            # OUR cancellation (ASY003)
+            await reap(self._dispatch_task, absorb_errors=True)
