@@ -62,7 +62,7 @@ PHASE_TIMEOUT_S = {"llm": 1800, "llm_endpoint": 1800, "kernels": 900,
                    "coldstart": 900, "coldstart_native": 900,
                    "coldstart_jax": 900, "coldstart_jax_tpu": 900,
                    "coldstart_stream": 900, "router": 300, "spec": 900,
-                   "quant": 900}
+                   "quant": 900, "obs": 900}
 
 # share compiled XLA programs between the in-process llm phase and the
 # runner container in the endpoint phase (identical graphs → second phase
@@ -1675,6 +1675,250 @@ def bench_quant(quick: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# phase: observability overhead (ISSUE 8) — the full request-lifecycle
+# instrumentation (per-request trace spans + flight recorder + latency
+# histograms) priced against the REAL engine, two ways:
+#
+#   1. obs_overhead_frac — the ≤2% gate, deterministic everywhere: the
+#      per-window and per-request instrumentation hooks are microbenched on
+#      the live engine (tight loop, min-of-reps — scheduling noise only ADDS
+#      time, so the min converges on the true cost) and multiplied by the
+#      window/request rates measured in the same run. Wall-clock A/B cannot
+#      resolve 2% on a shared CPU host (measured noise floor here: a NULL
+#      on-vs-off comparison of two IDENTICAL configs swings ±10-15%), and
+#      hiding that behind more passes would be flaky-evidence theater.
+#   2. obs_tokens_per_sec_ratio — paired interleaved tokens/sec with
+#      neighbor-averaged baselines (off,on,off,on,...,off), gated at ≥0.98
+#      ONLY on a real TPU (device windows dominate there and the host-side
+#      hooks overlap device compute); on CPU it is a catastrophe floor, the
+#      same split the quant phase uses for its HBM-bound throughput gate.
+#
+# Plus a decomposition-sanity check that the per-phase spans actually tile
+# the request (queue + prefill + decode ≈ e2e within tolerance) — a cheap
+# recorder that records the wrong timeline is not telemetry.
+# ---------------------------------------------------------------------------
+
+def bench_obs(quick: bool = False) -> dict:
+    import asyncio
+
+    import numpy as _np
+
+    from tpu9.observability.trace import new_trace_id, tracer
+    from tpu9.serving.presets import load_engine
+    from tpu9.utils import on_tpu
+
+    os.makedirs(XLA_CACHE_DIR, exist_ok=True)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", XLA_CACHE_DIR)
+
+    tpu = on_tpu()
+    # mixed-length prompts, paged engine with prefix cache + spec off: the
+    # common serving shape. `repeats` request-sets per timed measurement
+    # stretch each sample past the host's scheduling-jitter timescale.
+    s = dict(preset="llama-tiny", batch=4, max_seq=512,
+             requests=4 if quick else 8, max_new=96 if quick else 160,
+             passes=3 if quick else 5, repeats=2 if quick else 3,
+             prefill_buckets=(32, 64), decode_steps=(1, 4, 8),
+             wall_gate=0.98 if tpu else 0.5)
+    out: dict = {"obs_model": s["preset"], "on_tpu": tpu}
+    violations: list[str] = []
+
+    prompts = [[(7 * i + j) % 490 + 1 for j in range(8 + 6 * i)]
+               for i in range(s["requests"])]
+
+    def build(obs_on: bool):
+        eng = load_engine(s["preset"], max_batch=s["batch"],
+                          max_seq_len=s["max_seq"],
+                          prefill_buckets=s["prefill_buckets"],
+                          decode_steps=s["decode_steps"],
+                          kv_block_size=32, kv_pool_blocks=0,
+                          flight_cap=256 if obs_on else 0)
+        eng.warmup()
+        return eng
+
+    async def measure(eng, traced: bool):
+        """(tokens/sec, seconds, windows dispatched, trace ids) over
+        `repeats` sequential request-sets."""
+        tids: list = []
+        total = 0
+        rec0 = eng.flight.recorded if eng.flight is not None else 0
+        t0 = time.perf_counter()
+        for _ in range(s["repeats"]):
+            batch_tids = [new_trace_id() if traced else ""
+                          for _ in prompts]
+            outs = await asyncio.gather(*[
+                eng.generate(list(p), max_new_tokens=s["max_new"],
+                             trace=(tid, "root") if tid else None)
+                for p, tid in zip(prompts, batch_tids)])
+            total += sum(len(o) for o in outs)
+            tids = batch_tids
+        dt = time.perf_counter() - t0
+        # windows = flight records minus the admit records (one/request)
+        windows = 0
+        if eng.flight is not None:
+            windows = (eng.flight.recorded - rec0
+                       - s["repeats"] * len(prompts))
+        return total / dt, dt, windows, tids
+
+    def _min_time_us(fn, iters: int, reps: int) -> float:
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            best = min(best, time.perf_counter() - t0)
+        return best / iters * 1e6
+
+    def microbench_hooks(eng) -> tuple[float, float]:
+        """(per-window, per-request) instrumentation cost in µs, driven
+        through the REAL hook methods on the live engine — flight record
+        + per-slot decode_window spans + histogram observes, with the
+        metric reservoirs saturated to their steady-state (sorted-insert)
+        cost by the iteration count itself."""
+        from tpu9.serving.engine import _Request, _Window
+        iters, reps = (400, 3) if quick else (1500, 5)
+        trace = ("ab" * 16, "cd" * 8)
+
+        def mk_reqs():
+            reqs = []
+            for i in range(s["batch"]):
+                r = _Request(request_id=f"mb{i}", prompt=[1] * 16,
+                             max_new_tokens=s["max_new"], trace=trace,
+                             t_enqueue_mono=time.monotonic(),
+                             t_enqueue_wall=time.time())
+                r.span_id = "ef" * 8
+                reqs.append(r)
+            return tuple(reqs)
+
+        reqs = mk_reqs()
+        mask = _np.ones(s["batch"], dtype=bool)
+        delivered = {i: max(s["decode_steps"]) for i in range(s["batch"])}
+
+        def one_window():
+            win = _Window(kind="decode", k=max(s["decode_steps"]),
+                          toks=None, mask=mask, reqs=reqs)
+            eng._obs_stamp_window(win)
+            win.delivered = dict(delivered)
+            eng._obs_window(win, time.monotonic())
+
+        def one_request():
+            r = _Request(request_id="mbr", prompt=[1] * 16,
+                         max_new_tokens=s["max_new"], trace=trace,
+                         t_enqueue_mono=time.monotonic(),
+                         t_enqueue_wall=time.time())
+            eng._obs_admit_start(r, time.monotonic(), time.time())
+            eng._obs_admit_end(r, time.monotonic(), time.time(), 0)
+            eng._obs_first_token(r)
+            eng._obs_done(r)
+
+        return (_min_time_us(one_window, iters, reps),
+                _min_time_us(one_request, iters, reps))
+
+    async def run() -> dict:
+        res: dict = {}
+        off, on = build(False), build(True)
+        await off.start()
+        await on.start()
+        for eng in (off, on):         # untimed admission/graph warm pass
+            await asyncio.gather(*[
+                eng.generate(list(p), max_new_tokens=8) for p in prompts])
+
+        # interleaved off,(on,off)* — each ON sample is ratioed against
+        # the MEAN of its two neighboring OFF samples, cancelling linear
+        # host drift to first order
+        offs = [await measure(off, traced=False)]
+        ons = []
+        last_tids: list = []
+        for _ in range(s["passes"]):
+            m = await measure(on, traced=True)
+            ons.append(m)
+            last_tids = m[3]
+            offs.append(await measure(off, traced=False))
+        ratios = [ons[i][0] / ((offs[i][0] + offs[i + 1][0]) / 2)
+                  for i in range(s["passes"])]
+        flight = on.flight_records(limit=256)
+
+        res["obs_tokens_per_sec_off"] = round(
+            statistics.median([m[0] for m in offs]), 1)
+        res["obs_tokens_per_sec_on"] = round(
+            statistics.median([m[0] for m in ons]), 1)
+        res["obs_tokens_per_sec_ratio"] = round(
+            statistics.median(ratios), 4)
+
+        # instrumentation evidence: the ON engine must actually have
+        # produced the records the gates claim to price
+        if not flight or "decode" not in {r["kind"] for r in flight}:
+            violations.append("obs: flight recorder produced no decode "
+                              "records — the ON side measured nothing")
+
+        # decomposition sanity from the REAL span trees of the last ON
+        # measurement: queue_wait + prefill + decode windows ≈ the request
+        # span, per request. The one-window-in-flight overlap
+        # double-counts a little and loop bookkeeping leaks a little, so
+        # the gate brackets ≈1 generously — catching the real failure
+        # modes (spans missing, anchors wrong, windows double-booked) not
+        # scheduler jitter. MUST run before the microbench below, which
+        # floods the process tracer ring.
+        coverage = []
+        for tid in last_tids:
+            spans = tracer.export(trace_id=tid)
+            req = [sp for sp in spans if sp["name"] == "engine.request"]
+            if not req:
+                violations.append(f"obs: no engine.request span for {tid}")
+                continue
+            d = req[0]["durationMs"]
+            parts = sum(sp["durationMs"] for sp in spans
+                        if sp["name"] in ("engine.queue_wait",
+                                          "engine.prefill",
+                                          "engine.decode_window"))
+            if d > 0:
+                coverage.append(parts / d)
+        if coverage:
+            cov = statistics.median(coverage)
+            res["obs_decomposition_coverage"] = round(cov, 4)
+            if not 0.5 <= cov <= 1.7:
+                violations.append(
+                    f"obs: queue+prefill+decode covers {cov:.2f} of the "
+                    "request span (gate 0.5..1.7) — the per-phase spans "
+                    "do not decompose e2e latency")
+        else:
+            violations.append("obs: no span coverage measured")
+
+        # the ≤2% gate: microbenched hook cost × measured rates
+        win_us, req_us = microbench_hooks(on)
+        dur = statistics.median([m[1] for m in ons])
+        windows_ps = statistics.median([m[2] for m in ons]) / dur
+        requests_ps = s["repeats"] * len(prompts) / dur
+        frac = (win_us * windows_ps + req_us * requests_ps) / 1e6
+        res["obs_instr_window_us"] = round(win_us, 2)
+        res["obs_instr_request_us"] = round(req_us, 2)
+        res["obs_windows_per_sec"] = round(windows_ps, 2)
+        res["obs_overhead_frac"] = round(frac, 5)
+        if frac > 0.02:
+            violations.append(
+                f"obs: instrumentation costs {frac:.2%} of serve time "
+                f"({win_us:.1f}µs/window × {windows_ps:.0f} windows/s + "
+                f"{req_us:.1f}µs/request) — over the 2% budget")
+
+        await off.stop()
+        await on.stop()
+        return res
+
+    out.update(asyncio.run(run()))
+    ratio = out.get("obs_tokens_per_sec_ratio", 0.0)
+    if ratio < s["wall_gate"]:
+        violations.append(
+            f"obs: paired tokens/sec ratio {ratio} < {s['wall_gate']}"
+            + (" — tracing + flight recorder slow the TPU serve loop "
+               "beyond the overhead budget" if tpu else
+               " — catastrophe floor on a noise-bound CPU host (NULL "
+               "A/B noise here is ±10-15%; the binding 2% gate is "
+               "obs_overhead_frac)"))
+    out["violations"] = violations
+    out["valid"] = not violations
+    return out
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
@@ -1684,7 +1928,7 @@ def _run_phase(phase: str, quick: bool, cpu: bool) -> dict:
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", phase]
     if quick:
         cmd.append("--quick")
-    if cpu or phase in ("router", "spec", "quant") \
+    if cpu or phase in ("router", "spec", "quant", "obs") \
             or (phase.startswith("coldstart") and phase != "coldstart_jax_tpu"):
         # the serving stack and its runner children must never dial the chip
         # — ALL cold-start stack phases, not just the original one (round-3
@@ -1948,6 +2192,12 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
                        "quant_tokens_per_sec_ratio",
                        "quant_tokens_per_sec_on",
                        "quant_tokens_per_sec_off")),
+            ("obs", ("obs_tokens_per_sec_ratio",
+                     "obs_tokens_per_sec_on",
+                     "obs_tokens_per_sec_off",
+                     "obs_decomposition_coverage",
+                     "obs_overhead_frac", "obs_instr_window_us",
+                     "obs_instr_request_us", "obs_windows_per_sec")),
             ("coldstart", ("cold_start_p50_s",)),
             ("coldstart_native", ("cold_start_native_p50_s",
                                   "cold_start_native_pull_p50_s")),
@@ -2089,7 +2339,7 @@ def main() -> None:
                     choices=["llm", "llm_endpoint", "kernels", "coldstart",
                              "coldstart_native", "coldstart_jax",
                              "coldstart_jax_tpu", "coldstart_stream",
-                             "router", "spec", "quant"],
+                             "router", "spec", "quant", "obs"],
                     help="run one phase in-process (used by the orchestrator)")
     args = ap.parse_args()
 
@@ -2113,7 +2363,7 @@ def main() -> None:
               "coldstart_jax_tpu": bench_cold_start_jax_tpu,
               "coldstart_stream": bench_cold_start_stream,
               "router": bench_router, "spec": bench_spec,
-              "quant": bench_quant}[args.phase]
+              "quant": bench_quant, "obs": bench_obs}[args.phase]
         try:
             print(json.dumps(fn(quick=args.quick)))
         except Exception as exc:   # noqa: BLE001 — phase errors are data

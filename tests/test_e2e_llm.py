@@ -249,3 +249,131 @@ async def test_llm_streaming_scales_from_zero():
                         events.append(_json.loads(frame[6:]))
         final = next(e for e in events if e.get("done"))
         assert final["tokens"] == warm["tokens"]
+
+
+@pytest.mark.slow
+async def test_request_lifecycle_trace_e2e():
+    """ISSUE 8 acceptance: one request through gateway → FleetRouter →
+    engine yields a single trace id whose span tree is gapless —
+    gateway.invoke ⊃ router queue-wait/admission/dispatch ⊃ engine.request
+    ⊃ queue-wait/prefill/≥1 decode window — via /api/v1/traces, with the
+    engine spans arriving on the runner's pressure heartbeat. Also covers
+    the endpoint's limit/since bounding."""
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint(
+            "llmtrace", {"app.py": LLM_APP}, "app:load_engine",
+            config_extra={
+                "timeout_s": 240.0,
+                "extra": {"runner": "llm"},
+                "autoscaler": {"type": "token_pressure",
+                               "max_containers": 1}})
+        status, out = await stack.api(
+            "POST", "/endpoint/llmtrace",
+            json_body={"tokens": [5, 3, 9], "max_new_tokens": 8},
+            timeout=240)
+        assert status == 200, out
+        assert len(out["tokens"]) == 8
+
+        # the engine spans ship on the next pressure heartbeat (~2s);
+        # poll the merged endpoint until the full tree is visible
+        tree: list = []
+        for _ in range(120):
+            status, data = await stack.api(
+                "GET", "/api/v1/traces?limit=4000")
+            assert status == 200
+            invokes = [
+                s for s in data["spans"]
+                if s["name"] == "gateway.invoke"
+                and s["attributes"].get("stub_id") == dep["stub_id"]]
+            if invokes:
+                trace_id = invokes[0]["traceId"]
+                status, filt = await stack.api(
+                    "GET", f"/api/v1/traces?trace_id={trace_id}")
+                assert status == 200
+                tree = filt["spans"]
+                if {"engine.prefill", "engine.decode_window"} <= \
+                        {s["name"] for s in tree}:
+                    break
+            await asyncio.sleep(0.5)
+        by_name: dict = {}
+        for sp in tree:
+            by_name.setdefault(sp["name"], []).append(sp)
+        assert {"engine.prefill", "engine.decode_window"} <= set(by_name), \
+            f"engine spans never arrived: {sorted(by_name)}"
+
+        # ONE trace id across every layer
+        assert len({s["traceId"] for s in tree}) == 1
+
+        invoke = by_name["gateway.invoke"][0]
+        assert invoke["parentSpanId"] == ""          # the root
+        # router children hang off the invoke span
+        for name in ("router.admission", "router.queue_wait",
+                     "router.dispatch"):
+            assert name in by_name, sorted(by_name)
+            for sp in by_name[name]:
+                assert sp["parentSpanId"] == invoke["spanId"], (name, sp)
+        assert by_name["router.admission"][0]["attributes"][
+            "decision"] in ("queued", "admitted")
+        disp = by_name["router.dispatch"][0]["attributes"]
+        assert "replica" in disp and "affinity_hit" in disp
+
+        # engine.request hangs off the invoke span (X-Tpu9-Trace), and
+        # queue-wait/prefill/decode windows hang off engine.request
+        req = by_name["engine.request"][0]
+        assert req["parentSpanId"] == invoke["spanId"]
+        assert req["attributes"]["tokens_generated"] == 8
+        windows = by_name["engine.decode_window"]
+        assert len(windows) >= 1
+        for name in ("engine.queue_wait", "engine.prefill",
+                     "engine.decode_window"):
+            for sp in by_name[name]:
+                assert sp["parentSpanId"] == req["spanId"], (name, sp)
+
+        # gapless containment: every engine child sits inside the
+        # engine.request interval, which sits inside gateway.invoke
+        # (same-host wall anchors; 50ms slack for anchor skew)
+        slack = 50 * 10**6
+        for sp in (by_name["engine.queue_wait"] + by_name["engine.prefill"]
+                   + windows):
+            assert sp["startTimeUnixNano"] >= req["startTimeUnixNano"] - slack
+            assert sp["endTimeUnixNano"] <= req["endTimeUnixNano"] + slack
+        assert req["startTimeUnixNano"] >= \
+            invoke["startTimeUnixNano"] - slack
+        assert req["endTimeUnixNano"] <= invoke["endTimeUnixNano"] + slack
+        # runner spans were workspace-stamped at ingest (tenancy scoping)
+        assert req["attributes"]["workspace_id"] == \
+            invoke["attributes"]["workspace_id"]
+
+        # decomposition sanity at e2e scale: children cover the request
+        # span — queue_wait + prefill + decode windows ≈ engine e2e
+        covered = sum(s["endTimeUnixNano"] - s["startTimeUnixNano"]
+                      for s in (by_name["engine.queue_wait"]
+                                + by_name["engine.prefill"] + windows))
+        span_len = req["endTimeUnixNano"] - req["startTimeUnixNano"]
+        assert covered >= span_len * 0.5, (covered, span_len)
+
+        # ---- limit/since stay bounded (ISSUE 8 satellite) ----
+        status, lim = await stack.api("GET", "/api/v1/traces?limit=3")
+        assert status == 200 and len(lim["spans"]) <= 3
+        import time as _time
+        status, fut = await stack.api(
+            "GET", f"/api/v1/traces?since={_time.time() + 3600}")
+        assert status == 200 and fut["spans"] == []
+        status, past = await stack.api(
+            "GET", f"/api/v1/traces?trace_id={invoke['traceId']}&since=1")
+        assert status == 200 and len(past["spans"]) == len(tree)
+
+        # ---- /api/v1/flight surfaces the engine's ring e2e ----
+        status, fl = await stack.api(
+            "GET", f"/api/v1/flight?stub_id={dep['stub_id']}&limit=32")
+        assert status == 200, fl
+        kinds = [r["kind"] for r in fl["flight"]]
+        assert "admit" in kinds and "decode" in kinds, kinds
+        seqs = [r["seq"] for r in fl["flight"]]
+        assert seqs == sorted(seqs)
+        # incremental poll from the last seq returns only newer records
+        status, fl2 = await stack.api(
+            "GET", f"/api/v1/flight?stub_id={dep['stub_id']}"
+                   f"&since_seq={seqs[-1]}")
+        assert status == 200
+        assert all(r["seq"] > seqs[-1] for r in fl2["flight"])

@@ -198,6 +198,109 @@ class TestJAX002:
         assert "JAX002" not in rule_ids(src)
 
 
+class TestOBS001:
+    """time.time() arithmetic for durations/deadlines in hot-path planes
+    (ISSUE 8 satellite — the trace.py durationMs NTP-step bug class)."""
+
+    PATH = "tpu9/serving/engine.py"
+
+    def test_direct_arithmetic_and_compare_flagged(self):
+        src = """
+        import time
+        def shed(deadline):
+            deadline = time.time() + 30.0
+            if time.time() > deadline:
+                return True
+        """
+        fs = [f for f in check(src, path=self.PATH) if f.rule == "OBS001"]
+        assert len(fs) == 2
+        assert "monotonic" in fs[0].message
+
+    def test_tainted_local_name_flagged(self):
+        src = """
+        import time
+        def measure(fn):
+            t0 = time.time()
+            fn()
+            return time.time() - t0
+        """
+        fs = [f for f in check(src, path=self.PATH) if f.rule == "OBS001"]
+        assert fs, "wall-wall subtraction must be flagged"
+
+    def test_tainted_attribute_flagged_file_wide(self):
+        # the ORIGINAL trace.py bug: start stored from time.time() in one
+        # method, subtracted in another
+        src = """
+        import time
+        class Span:
+            def __init__(self):
+                self.start = time.time()
+            def duration(self, end):
+                return end - self.start
+        """
+        fs = [f for f in check(src, path=self.PATH) if f.rule == "OBS001"]
+        assert len(fs) == 1
+        assert fs[0].symbol == "Span.duration"
+
+    def test_monotonic_and_anchor_not_flagged(self):
+        src = """
+        import time
+        class Span:
+            def __init__(self):
+                self.start = time.time()       # wall ANCHOR: stored only
+                self.t0 = time.monotonic()
+            def duration(self):
+                return time.monotonic() - self.t0
+            def start_nanos(self):
+                return int(self.start * 1e9)   # epoch conversion (mult)
+        """
+        assert "OBS001" not in {f.rule
+                                for f in check(src, path=self.PATH)}
+
+    def test_parallel_tuple_assign_taints_only_wall_half(self):
+        src = """
+        import time
+        def f():
+            t_mono, t_wall = time.monotonic(), time.time()
+            ok = time.monotonic() - t_mono
+            bad = 5.0 + t_wall
+            return ok, bad
+        """
+        fs = [f for f in check(src, path=self.PATH) if f.rule == "OBS001"]
+        assert len(fs) == 1 and "t_wall" in fs[0].message
+
+    def test_out_of_scope_path_not_flagged(self):
+        src = """
+        import time
+        def paid_deadline():
+            return time.time() + 600.0   # store-persisted epoch (gateway)
+        """
+        assert check(src, path="tpu9/gateway/gateway.py") == []
+
+    def test_lambda_bodies_are_scanned(self):
+        # lambdas are scopes of their own (excluded from the enclosing
+        # scan) — wall arithmetic inside one must still be flagged
+        src = """
+        import time
+        f = lambda t0: time.time() - t0
+        def waiter(deadline):
+            expired = lambda: time.time() > deadline
+            return expired
+        """
+        fs = [f for f in check(src, path=self.PATH) if f.rule == "OBS001"]
+        assert len(fs) == 2
+        assert {f.symbol for f in fs} == {"<lambda>", "waiter.<lambda>"}
+
+    def test_monotonic_lambda_not_flagged(self):
+        src = """
+        import time
+        def waiter(deadline_mono):
+            return lambda: time.monotonic() > deadline_mono
+        """
+        assert "OBS001" not in {f.rule
+                                for f in check(src, path=self.PATH)}
+
+
 class TestJAX001:
     HOT = """
     import jax, numpy as np

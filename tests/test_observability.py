@@ -1,5 +1,7 @@
 import asyncio
 
+import pytest
+
 from tpu9.config import WorkerPoolConfig
 from tpu9.observability import EventBus, Metrics
 from tpu9.repository import WorkerRepository
@@ -141,6 +143,102 @@ def test_tracer_spans_nest_and_export():
     assert t.export()[-1]["status"] == "error"
 
 
+def test_span_duration_survives_wall_clock_step(monkeypatch):
+    """ISSUE 8 satellite: durationMs must come from time.monotonic() — an
+    NTP step (wall clock jumping BACKWARDS mid-span) must never produce a
+    negative duration or an end before the start."""
+    import time as _time
+
+    from tpu9.observability import trace as trace_mod
+
+    real_time = _time.time
+    wall = {"offset": 0.0}
+    monkeypatch.setattr(trace_mod.time, "time",
+                        lambda: real_time() + wall["offset"])
+    t = trace_mod.Tracer("steptest")
+    with t.span("stepped") as sp:
+        _time.sleep(0.02)
+        wall["offset"] = -3600.0          # NTP steps the clock back 1h
+    d = sp.to_dict()
+    assert d["durationMs"] >= 20.0, d     # monotonic: the real elapsed time
+    assert d["endTimeUnixNano"] >= d["startTimeUnixNano"]
+    # the wall anchor is the (pre-step) start; end = anchor + duration
+    # (durationMs is rounded to 3 decimals; allow sub-ms slack)
+    assert d["endTimeUnixNano"] - d["startTimeUnixNano"] == \
+        pytest.approx(d["durationMs"] * 1e6, abs=1e6)
+
+    # forward step too: duration reflects sleep, not the +1h jump
+    with t.span("fwd") as sp2:
+        _time.sleep(0.01)
+        wall["offset"] = 3600.0
+    assert sp2.to_dict()["durationMs"] < 1000.0
+
+
+def test_export_new_watermark_is_monotonic(monkeypatch):
+    """The heartbeat ship cursor (export_new) must be immune to wall-clock
+    steps: a span finished after a backward NTP step still ships, and an
+    already-shipped span never re-ships once the watermark advances."""
+    import time as _time
+
+    from tpu9.observability import trace as trace_mod
+
+    t = trace_mod.Tracer("ship")
+    with t.span("first"):
+        pass
+    spans, hi = t.export_new(since_mono=0.0)
+    assert [s["name"] for s in spans] == ["first"]
+    assert hi > 0.0
+    # watermark NOT advanced (gateway rejected the beat): same span again
+    again, _ = t.export_new(since_mono=0.0)
+    assert [s["name"] for s in again] == ["first"]
+
+    # wall clock steps back an hour; the next span must still ship
+    real_time = _time.time
+    monkeypatch.setattr(trace_mod.time, "time",
+                        lambda: real_time() - 3600.0)
+    with t.span("post_step"):
+        pass
+    spans2, hi2 = t.export_new(since_mono=hi)
+    assert [s["name"] for s in spans2] == ["post_step"]
+    assert hi2 > hi
+    # accepted: nothing left to ship, watermark stable
+    spans3, hi3 = t.export_new(since_mono=hi2)
+    assert spans3 == [] and hi3 == hi2
+
+
+def test_tracer_manual_spans_and_context():
+    """Manual start/finish spans (cross-task propagation) + explicit
+    remote parents + record_span backdating."""
+    import time as _time
+
+    from tpu9.observability.trace import Tracer
+    t = Tracer("manual")
+    assert t.context() == ("", "")
+    with t.span("invoke") as root:
+        ctx = t.context()
+        assert ctx == (root.trace_id, root.span_id)
+    # manual span finished OUTSIDE the contextvar scope, explicit parent
+    sp = t.start_span("queue_wait", trace_id=ctx[0], parent_id=ctx[1])
+    _time.sleep(0.01)
+    t.finish_span(sp)
+    d = sp.to_dict()
+    assert d["parentSpanId"] == root.span_id
+    assert d["traceId"] == root.trace_id
+    assert d["durationMs"] >= 10.0
+    # record_span: an already-elapsed interval becomes a span with the
+    # captured anchor pair
+    t0_wall, t0_mono = _time.time() - 5.0, _time.monotonic() - 0.25
+    rec = t.record_span("window", ctx[0], ctx[1], t0_wall, t0_mono,
+                        attrs={"k": 4})
+    d = rec.to_dict()
+    assert 240.0 <= d["durationMs"] <= 2000.0
+    assert d["startTimeUnixNano"] == int(t0_wall * 1e9)
+    # error status propagates through finish_span
+    sp2 = t.start_span("boom", trace_id=ctx[0], parent_id=ctx[1])
+    t.finish_span(sp2, status="error")
+    assert sp2.to_dict()["status"] == "error"
+
+
 # ---------------------------------------------------------------------------
 # log rate limiting
 # ---------------------------------------------------------------------------
@@ -275,3 +373,113 @@ async def test_otlp_flush_survives_transport_failure():
     await __import__("asyncio").sleep(0.1)
     await exp.stop()          # loop survived repeated failures
     assert calls              # and kept trying
+
+
+async def test_otlp_failed_push_does_not_advance_flush_window():
+    """The retry-don't-drop contract (otel.py flush docstring): a rejected
+    or failed trace push must leave the flush window where it was, so the
+    SAME spans go out on the next flush instead of vanishing."""
+    from tpu9.observability.metrics import Metrics
+    from tpu9.observability.otel import OtlpExporter
+    from tpu9.observability.trace import Tracer
+
+    tracer = Tracer("retry")
+    with tracer.span("survivor"):
+        pass
+
+    mode = {"fail": True}
+    pushes = []
+
+    async def transport(path, payload):
+        pushes.append((path, payload))
+        if mode["fail"] and path == "/v1/traces":
+            return 503                      # collector rejecting
+        return 200
+
+    exp = OtlpExporter("http://c", transport=transport, tracer=tracer,
+                       registry=Metrics())
+    exp._last_flush = 0.0
+    window_before = exp._last_flush
+    with pytest.raises(RuntimeError):
+        await exp.flush()
+    assert exp._last_flush == window_before, \
+        "a failed push must not advance the window"
+    # metrics were NOT pushed either (trace failure aborts the flush
+    # before the metrics snapshot — one atomic retry unit)
+    assert [p for p, _ in pushes] == ["/v1/traces"]
+
+    # collector recovers: the SAME span ships
+    mode["fail"] = False
+    pushes.clear()
+    out = await exp.flush()
+    assert out["spans"] == 1 and out["trace_status"] == 200
+    shipped = pushes[0][1]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert [s["name"] for s in shipped] == ["survivor"]
+    assert exp._last_flush > window_before
+
+    # a hard transport error (OSError) must behave the same way
+    with tracer.span("second"):
+        pass
+    window = exp._last_flush
+
+    async def explode(path, payload):
+        raise OSError("down")
+
+    exp.transport = explode
+    with pytest.raises(OSError):
+        await exp.flush()
+    assert exp._last_flush == window
+
+
+def test_otlp_attr_and_field_golden_mapping():
+    """Golden tests for the OTLP/JSON field mapping: every tpu9 attr type
+    → the right OTLP value wrapper; span status/kind/nano fields; counter
+    → monotonic cumulative sum; summary → quantileValues."""
+    from tpu9.observability.otel import _attr, metrics_to_otlp, spans_to_otlp
+
+    assert _attr("b", True) == {"key": "b", "value": {"boolValue": True}}
+    assert _attr("i", 7) == {"key": "i", "value": {"intValue": "7"}}
+    assert _attr("f", 0.5) == {"key": "f", "value": {"doubleValue": 0.5}}
+    assert _attr("s", "x") == {"key": "s", "value": {"stringValue": "x"}}
+    # non-primitive falls back to its string form
+    assert _attr("l", [1, 2]) == \
+        {"key": "l", "value": {"stringValue": "[1, 2]"}}
+
+    span = {"traceId": "t" * 32, "spanId": "s" * 16, "parentSpanId": "p",
+            "name": "gateway.invoke", "startTimeUnixNano": 1_000,
+            "endTimeUnixNano": 3_500, "durationMs": 0.0000025,
+            "attributes": {"stub_id": "st", "ok": True}, "status": "error"}
+    otlp = spans_to_otlp([span], "svc")["resourceSpans"][0]
+    assert otlp["resource"]["attributes"] == \
+        [{"key": "service.name", "value": {"stringValue": "svc"}}]
+    out = otlp["scopeSpans"][0]["spans"][0]
+    assert out["kind"] == 1                              # SPAN_KIND_INTERNAL
+    assert out["status"] == {"code": 2}                  # error → ERROR
+    assert out["startTimeUnixNano"] == "1000"            # stringified nanos
+    assert out["endTimeUnixNano"] == "3500"
+    assert {"key": "ok", "value": {"boolValue": True}} in out["attributes"]
+    ok_span = dict(span, status="ok")
+    assert spans_to_otlp([ok_span], "svc")["resourceSpans"][0][
+        "scopeSpans"][0]["spans"][0]["status"] == {"code": 1}
+
+    snapshot = {
+        "counters": {'tpu9_requests{route="invoke"}': 3.0},
+        "gauges": {"tpu9_depth": 7.0},
+        "summaries": {"tpu9_lat_s": {"count": 4, "mean": 0.375,
+                                     "p50": 0.2, "p95": 0.9, "max": 0.9}},
+    }
+    ms = metrics_to_otlp(snapshot, "svc")["resourceMetrics"][0][
+        "scopeMetrics"][0]["metrics"]
+    by_name = {m["name"]: m for m in ms}
+    s = by_name["tpu9_requests"]["sum"]
+    assert s["isMonotonic"] is True
+    assert s["aggregationTemporality"] == 2              # CUMULATIVE
+    pt = s["dataPoints"][0]
+    assert pt["asDouble"] == 3.0
+    assert {"key": "route", "value": {"stringValue": "invoke"}} \
+        in pt["attributes"]
+    summ = by_name["tpu9_lat_s"]["summary"]["dataPoints"][0]
+    assert summ["count"] == "4"
+    assert summ["sum"] == pytest.approx(1.5)             # mean × count
+    assert {"quantile": 0.5, "value": 0.2} in summ["quantileValues"]
+    assert {"quantile": 1.0, "value": 0.9} in summ["quantileValues"]

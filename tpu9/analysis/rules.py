@@ -20,6 +20,15 @@ Per-file rules (class ``FileChecker``):
 - **JAX002** jit recompile hazards: ``jax.jit(f)(x)`` immediately invoked
   (retraces every call) and ``jax.jit``/``pallas_call`` constructed inside
   a loop body instead of cached at module/object scope.
+- **OBS001** wall-clock arithmetic in serving/router/worker/runner/
+  observability files: ``time.time()`` (directly, or a name/attribute
+  assigned from it) used in +/-/comparison — i.e. as a duration or a
+  deadline. Under an NTP step those go negative or fire early/late (the
+  trace.py durationMs bug, ISSUE 8); durations and deadlines must use
+  ``time.monotonic()``. ``time.time()`` stays legal as a wall ANCHOR
+  (stored, displayed, or multiplied into epoch nanos) — the two
+  legitimate wall-arithmetic sites (anchor + monotonic-duration
+  reconstruction, calendar bucket keys) carry reviewed suppressions.
 
 Whole-program rule (``check_jax_hotpath``):
 
@@ -40,6 +49,12 @@ from .findings import Finding
 
 ASYNC_RULES = ("ASY001", "ASY002", "ASY003", "ASY004")
 JAX_RULES = ("JAX001", "JAX002")
+
+# OBS001 scope: the planes where a stepped wall clock corrupts durations
+# that feed admission/routing/latency evidence. The gateway's paid-request
+# deadlines are store-persisted epochs (wall by design) and stay out.
+OBS_TIME_PATHS = ("tpu9/serving/", "tpu9/router/", "tpu9/worker/",
+                  "tpu9/runner/", "tpu9/observability/")
 
 # ASY004: call names that block the event loop. Dotted names match exact
 # attribute chains; bare names match builtins called by name.
@@ -289,7 +304,111 @@ class FileChecker(ast.NodeVisitor):
 def check_file(path: str, tree: ast.AST) -> list[Finding]:
     checker = FileChecker(path)
     checker.visit(tree)
+    checker.findings.extend(check_obs_time(path, tree))
     return checker.findings
+
+
+# -- OBS001: wall-clock durations/deadlines in hot-path planes ----------------
+
+def _is_walltime_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in ("time.time", "_time.time"))
+
+
+def _assign_pairs(node: ast.Assign):
+    """(target, value) pairs, unpacking parallel tuple assignments so
+    ``a, b = time.monotonic(), time.time()`` taints only ``b``."""
+    for t in node.targets:
+        if (isinstance(t, ast.Tuple) and isinstance(node.value, ast.Tuple)
+                and len(t.elts) == len(node.value.elts)):
+            yield from zip(t.elts, node.value.elts)
+        else:
+            yield t, node.value
+
+
+def check_obs_time(path: str, tree: ast.AST) -> list[Finding]:
+    """OBS001: flag +/-/comparison arithmetic on wall-clock values in the
+    scoped planes. Taint is deliberately over-approximate (an attribute
+    NAME assigned ``time.time()`` anywhere in the file taints that
+    attribute file-wide; a local name taints its enclosing function) — a
+    false positive costs one reviewed suppression, a stepped-clock
+    duration corrupts admission deadlines and latency evidence."""
+    if not path.startswith(OBS_TIME_PATHS):
+        return []
+
+    wall_attrs: set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign):
+            for tgt, val in _assign_pairs(n):
+                if _is_walltime_call(val) and isinstance(tgt, ast.Attribute):
+                    wall_attrs.add(tgt.attr)
+
+    findings: list[Finding] = []
+
+    def scan_scope(owner: ast.AST, qualname: str) -> None:
+        # names assigned from time.time() in THIS scope's own body
+        nested: set[int] = set()
+        for c in ast.walk(owner):
+            if (isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)) and c is not owner
+                    and id(c) not in nested):
+                nested.update(id(x) for x in ast.walk(c))
+        own = [n for n in ast.walk(owner) if id(n) not in nested]
+        wall_names = {tgt.id for n in own if isinstance(n, ast.Assign)
+                      for tgt, val in _assign_pairs(n)
+                      if _is_walltime_call(val) and isinstance(tgt, ast.Name)}
+
+        def tainted(node: ast.AST) -> str:
+            if _is_walltime_call(node):
+                return "time.time()"
+            if isinstance(node, ast.Name) and node.id in wall_names:
+                return f"`{node.id}` (assigned from time.time())"
+            if isinstance(node, ast.Attribute) and node.attr in wall_attrs:
+                return (f"`.{node.attr}` (an attribute assigned from "
+                        "time.time() in this file)")
+            return ""
+
+        for n in own:
+            operands = []
+            if isinstance(n, ast.BinOp) and isinstance(n.op, (ast.Add,
+                                                              ast.Sub)):
+                operands = [n.left, n.right]
+            elif isinstance(n, ast.Compare):
+                operands = [n.left, *n.comparators]
+            for op in operands:
+                hit = tainted(op)
+                if hit:
+                    findings.append(Finding(
+                        "OBS001", path, n.lineno, n.col_offset,
+                        f"wall-clock arithmetic on {hit}: durations and "
+                        "deadlines must come from time.monotonic() — an "
+                        "NTP step makes this negative or fire early/late; "
+                        "keep time.time() only as a stored wall anchor",
+                        qualname))
+                    break           # one finding per expression
+
+    def walk_defs(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                scan_scope(child, qual)
+                walk_defs(child, qual)
+            elif isinstance(child, ast.Lambda):
+                # lambdas are scopes too (scan_scope excludes their bodies
+                # from the enclosing scope): a deadline lambda like
+                # `lambda: time.time() > deadline` must not slip through
+                qual = f"{prefix}.<lambda>" if prefix else "<lambda>"
+                scan_scope(child, qual)
+                walk_defs(child, qual)
+            elif isinstance(child, ast.ClassDef):
+                walk_defs(child, f"{prefix}.{child.name}" if prefix
+                          else child.name)
+            else:
+                walk_defs(child, prefix)
+
+    scan_scope(tree, "<module>")
+    walk_defs(tree, "")
+    return findings
 
 
 # -- JAX001: whole-program hot-path sync check --------------------------------

@@ -30,6 +30,8 @@ ALL_RULES = {
     "ASY004": "blocking call (sleep/subprocess/socket/file IO) in async def",
     "JAX001": "host-device sync reachable from the engine serve loop",
     "JAX002": "jit recompile hazard (inline jit call / jit built in a loop)",
+    "OBS001": "wall-clock (time.time) arithmetic for a duration/deadline "
+              "in serving/router/worker hot-path files",
     "BND001": "import-boundary contract violation (boundaries.toml)",
     "SUP001": "noqa suppression without a mandatory reason",
 }

@@ -624,13 +624,66 @@ def usage_cmd(hours: int) -> None:
 @click.option("--trace-id", default="")
 @click.option("--limit", default=100)
 def traces_cmd(trace_id: str, limit: int) -> None:
-    """Fleet trace spans (gateway + scheduler + worker cold starts)."""
+    """Fleet trace spans (gateway → router → engine, worker cold starts)."""
     q = f"?limit={limit}" + (f"&trace_id={trace_id}" if trace_id else "")
     data = _client()._run(lambda c: c.request("GET", f"/api/v1/traces{q}"))
     for sp in data.get("spans", []):
         indent = "  " if sp.get("parentSpanId") else ""
         click.echo(f"{indent}{sp['traceId'][:8]} {sp['name']:<24} "
                    f"{sp['durationMs']:>9.2f}ms  {sp.get('status','')}")
+
+
+@cli.command("flight")
+@click.argument("stub_id")
+@click.option("--container-id", default="", help="pin one replica")
+@click.option("--limit", default=64)
+@click.option("--since-seq", default=0,
+              help="only records newer than this seq (incremental poll)")
+def flight_cmd(stub_id: str, container_id: str, limit: int,
+               since_seq: int) -> None:
+    """Engine flight-recorder tail: per-window batch composition, K picks,
+    spec accept/rollback, KV churn — the serve loop's black box."""
+    q = f"?stub_id={stub_id}&limit={limit}&since_seq={since_seq}"
+    if container_id:
+        q += f"&container_id={container_id}"
+    data = _client()._run(lambda c: c.request("GET", f"/api/v1/flight{q}"))
+    for rec in data.get("flight", []):
+        base = (f"#{rec['seq']:<6} {rec['kind']:<8}")
+        if rec["kind"] in ("decode", "verify"):
+            base += (f" k={rec.get('k', 0):<3} pick={rec.get('pick', ''):<10}"
+                     f" batch={rec.get('batch', 0)}"
+                     f" wait={rec.get('wait_s', 0) * 1000:7.2f}ms"
+                     f" host={rec.get('host_s', 0) * 1000:6.2f}ms")
+            if rec["kind"] == "verify":
+                base += (f" spec={rec.get('spec_accepted', 0)}"
+                         f"/{rec.get('spec_proposed', 0)}")
+        elif rec["kind"] == "admit":
+            base += (f" req={rec.get('request_id', '')}"
+                     f" prompt={rec.get('prompt_tokens', 0)}"
+                     f" cached={rec.get('cached_tokens', 0)}"
+                     f" dur={rec.get('dur_s', 0) * 1000:7.2f}ms")
+        else:
+            base += f" {json.dumps({k: v for k, v in rec.items() if k not in ('seq', 'kind', 'ts')})}"
+        click.echo(base)
+
+
+@cli.command("profile")
+@click.argument("stub_id")
+@click.option("--windows", default=8, help="windows to profile")
+@click.option("--container-id", default="", help="pin one replica")
+@click.option("--out-dir", default="", help="dump dir on the replica")
+def profile_cmd(stub_id: str, windows: int, container_id: str,
+                out_dir: str) -> None:
+    """Arm jax.profiler on a live replica for the next N engine windows;
+    prints the replica-side dump path."""
+    body = {"stub_id": stub_id, "windows": windows}
+    if container_id:
+        body["container_id"] = container_id
+    if out_dir:
+        body["out_dir"] = out_dir
+    out = _client()._run(lambda c: c.request("POST", "/api/v1/profile",
+                                             json_body=body))
+    click.echo(json.dumps(out, indent=2))
 
 
 @cli.command("metrics")

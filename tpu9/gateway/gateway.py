@@ -326,6 +326,9 @@ class Gateway:
         r.add_get("/api/v1/metrics", self._metrics)
         r.add_get("/api/v1/usage", self._usage_report)
         r.add_get("/api/v1/traces", self._traces)
+        # engine flight recorder + on-demand TPU profiling (ISSUE 8)
+        r.add_get("/api/v1/flight", self._flight)
+        r.add_post("/api/v1/profile", self._profile)
         # per-workspace concurrency quotas (reference concurrencylimit.go);
         # reads are self-service, writes are operator-only
         r.add_get("/api/v1/concurrency-limit", self._get_concurrency_limit)
@@ -628,21 +631,60 @@ class Gateway:
             if visible(sp) and sp.get("spanId") not in seen:
                 seen.add(sp.get("spanId", ""))
                 spans.append(sp)
-        for key in await self.store.keys("worker:traces:*"):
-            raw = await self.store.get(key)
-            if not raw:
-                continue
-            try:
-                for sp in json.loads(raw):
-                    # dedup by spanId: in-process topologies share one ring,
-                    # so every worker ships the same spans
-                    if visible(sp) and sp.get("spanId") not in seen:
-                        seen.add(sp.get("spanId", ""))
-                        spans.append(sp)
-            except (ValueError, TypeError):
-                continue
+        # worker rings (cold-start spans) + runner rings (engine spans
+        # shipped on the pressure heartbeat, ISSUE 8) — one merged,
+        # workspace-scoped timeline per trace id
+        for pattern in ("worker:traces:*", "runner:traces:*"):
+            for key in await self.store.keys(pattern):
+                raw = await self.store.get(key)
+                if not raw:
+                    continue
+                try:
+                    for sp in json.loads(raw):
+                        # dedup by spanId: in-process topologies share one
+                        # ring, so every worker ships the same spans
+                        if visible(sp) and sp.get("spanId") not in seen:
+                            seen.add(sp.get("spanId", ""))
+                            spans.append(sp)
+                except (ValueError, TypeError):
+                    continue
         spans.sort(key=lambda s: s.get("startTimeUnixNano", 0))
         return web.json_response({"spans": spans[:limit]})
+
+    async def _flight(self, request: web.Request) -> web.Response:
+        """Engine flight-recorder tail for one LLM deployment (ISSUE 8):
+        proxies the runner's /flight RPC through the request buffer
+        (?stub_id= required; ?container_id= pins a replica, ?limit= /
+        ?since_seq= page the ring). Workspace-scoped via stub ownership.
+        Routes like any invoke, so a scaled-to-zero deployment cold-starts
+        a replica rather than answering from nothing."""
+        stub = await self._stub_for(request, request.query.get("stub_id", ""))
+        limit = int(self._q_float(request, "limit", 256))
+        since_seq = int(self._q_float(request, "since_seq", 0))
+        cid = request.query.get("container_id", "")
+        result = await self.endpoints.forward(
+            stub, "GET", f"/flight?limit={limit}&since_seq={since_seq}",
+            [], b"", prefer=[cid] if cid else [])
+        return web.Response(status=result.status, body=result.body,
+                            content_type="application/json")
+
+    async def _profile(self, request: web.Request) -> web.Response:
+        """Arm jax.profiler on a live replica for the next N windows
+        (ISSUE 8): body {stub_id, windows, container_id?}; returns the
+        runner-side dump path immediately. The dump lands on the replica's
+        filesystem — fetch it with `tpu9 shell`/volume tooling."""
+        data = await request.json()
+        stub = await self._stub_for(request, data.get("stub_id", ""))
+        windows = int(data.get("windows", 8))
+        cid = data.get("container_id", "")
+        result = await self.endpoints.forward(
+            stub, "POST", "/profile",
+            [("Content-Type", "application/json")],
+            json.dumps({"windows": windows,
+                        "out_dir": data.get("out_dir", "")}).encode(),
+            prefer=[cid] if cid else [])
+        return web.Response(status=result.status, body=result.body,
+                            content_type="application/json")
 
     async def _metrics(self, request: web.Request) -> web.Response:
         # fleet-wide registries (every worker's shipped counters) are
@@ -971,7 +1013,38 @@ class Gateway:
         await router.record_pressure(
             state.container_id, float(d.get("token_pressure", 0.0)),
             int(d.get("active_streams", 0)), extra=d.get("extra"))
+        spans = d.get("spans")
+        if isinstance(spans, list) and spans:
+            await self._ingest_runner_spans(state, spans)
         return web.json_response({"ok": True})
+
+    async def _ingest_runner_spans(self, state, spans: list) -> None:
+        """Engine/runner spans riding the pressure heartbeat (ISSUE 8 —
+        the same channel worker rings use). The workspace stamp is applied
+        HERE from the authenticated container state, never trusted from
+        the runner payload: a tenant container must not be able to plant
+        spans into another workspace's /api/v1/traces view."""
+        cleaned = []
+        for sp in spans[:2048]:         # bound one beat's ingest
+            if not isinstance(sp, dict) or not sp.get("traceId"):
+                continue
+            attrs = sp.get("attributes")
+            if not isinstance(attrs, dict):
+                attrs = {}
+            attrs["workspace_id"] = state.workspace_id
+            attrs["container_id"] = state.container_id
+            sp["attributes"] = attrs
+            cleaned.append(sp)
+        if not cleaned:
+            return
+        key = f"runner:traces:{state.container_id}"
+        existing = await self.store.get(key)
+        try:
+            merged = (json.loads(existing) if existing else [])[-1500:]
+        except (ValueError, TypeError):
+            merged = []
+        merged.extend(cleaned)
+        await self.store.set(key, json.dumps(merged), ttl=3600.0)
 
     # -- handlers: pods ---------------------------------------------------------
 
@@ -1749,9 +1822,12 @@ class Gateway:
             path += f"?{request.query_string}"
         # NEVER forward the platform bearer token into a tenant container
         # (a priced/public endpoint's app would capture the CALLER'S
-        # workspace credential); runners do no inbound auth of their own
+        # workspace credential); runners do no inbound auth of their own.
+        # x-tpu9-trace is stripped too: the trace context is gateway-minted
+        # below, never client-supplied (a forged header would parent a
+        # tenant's engine spans under someone else's trace)
         skip_req = {"host", "connection", "transfer-encoding",
-                    "content-length", "authorization"}
+                    "content-length", "authorization", "x-tpu9-trace"}
         fwd_headers = [(k, v) for k, v in request.headers.items()
                        if k.lower() not in skip_req]
 
@@ -1773,6 +1849,12 @@ class Gateway:
                          attrs={"stub_id": stub.stub_id,
                                 "workspace_id": stub.workspace_id,
                                 "method": request.method}) as sp:
+            # propagate the span context across the runner RPC boundary:
+            # the llm runner parses this header and the engine records its
+            # prefill/decode-window spans under the SAME trace id, shipped
+            # back on the pressure heartbeat (ISSUE 8)
+            fwd_headers.append(("X-Tpu9-Trace",
+                                f"{sp.trace_id}:{sp.span_id}"))
             if self.fleet_router is not None:
                 # fleet front door: fair-queue by the CALLING tenant (a
                 # priced endpoint's external callers compete with each
@@ -1817,27 +1899,45 @@ class Gateway:
         import aiohttp as _aiohttp
 
         from ..abstractions.common.buffer import ForwardResult
-        prefer: list = []
-        if self.fleet_router is not None:
-            # streams skip the fair queue (a token stream holds its
-            # replica for minutes) but still shed at the door and carry
-            # the router's affinity preference; their budget slot rides
-            # the handle's lifetime via on_close
-            caller = request.get("workspace")
-            tenant = caller.workspace_id if caller else stub.workspace_id
-            shed, prefer = await self.fleet_router.admit_stream(stub, tenant,
-                                                                body)
-            if shed is not None:
-                # usage records for sheds on BOTH paths: the buffered one
-                # records its 429/503s below, and metrics/billing must not
-                # diverge between the two for identical client behavior
-                await self.usage.record_request(stub.workspace_id)
-                resp = web.Response(status=shed.status, body=shed.body)
-                for k, v in shed.headers:
-                    resp.headers[k] = v
-                return resp
-        handle = await self.endpoints.forward_stream(
-            stub, request.method, path, fwd_headers, body, prefer=prefer)
+        from ..observability import tracer
+        # the stream-setup span covers admission + placement + connect
+        # (the TTFT-shaped part a stream's caller feels); the engine's own
+        # request span covers the generation that follows. The relay loop
+        # itself is deliberately OUTSIDE — a span held open for a
+        # minutes-long stream would only reach the ring at close.
+        with tracer.span("gateway.invoke",
+                         attrs={"stub_id": stub.stub_id,
+                                "workspace_id": stub.workspace_id,
+                                "method": request.method,
+                                "stream": True}) as sp:
+            fwd_headers = list(fwd_headers)
+            fwd_headers.append(("X-Tpu9-Trace",
+                                f"{sp.trace_id}:{sp.span_id}"))
+            prefer: list = []
+            if self.fleet_router is not None:
+                # streams skip the fair queue (a token stream holds its
+                # replica for minutes) but still shed at the door and carry
+                # the router's affinity preference; their budget slot rides
+                # the handle's lifetime via on_close
+                caller = request.get("workspace")
+                tenant = caller.workspace_id if caller else stub.workspace_id
+                shed, prefer = await self.fleet_router.admit_stream(
+                    stub, tenant, body)
+                if shed is not None:
+                    # usage records for sheds on BOTH paths: the buffered
+                    # one records its 429/503s below, and metrics/billing
+                    # must not diverge between the two for identical
+                    # client behavior
+                    await self.usage.record_request(stub.workspace_id)
+                    sp.attrs["status"] = shed.status
+                    resp = web.Response(status=shed.status, body=shed.body)
+                    for k, v in shed.headers:
+                        resp.headers[k] = v
+                    return resp
+            handle = await self.endpoints.forward_stream(
+                stub, request.method, path, fwd_headers, body,
+                prefer=prefer)
+            sp.attrs["status"] = getattr(handle, "status", 0)
         # usage records for every forwarded attempt, success or failure —
         # the buffered path does, and metrics/billing must not diverge
         # between the two for identical client behavior

@@ -92,6 +92,15 @@ class Metrics:
 
         return _Timer()
 
+    def summary(self, name: str, labels: Optional[dict] = None
+                ) -> Optional[dict]:
+        """One summary's snapshot (p50/p95/max/mean/count), or None —
+        cheaper than to_dict() when a caller (the router's latency
+        snapshot) wants a single series, not the whole registry."""
+        with self._lock:
+            s = self.summaries.get(self._key(name, labels))
+            return s.snapshot() if s else None
+
     # -- export ---------------------------------------------------------------
 
     def to_dict(self) -> dict:

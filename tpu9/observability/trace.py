@@ -1,13 +1,29 @@
-"""Distributed tracing: spans across gateway → scheduler → worker cold
-starts, correlated by a trace id that rides the container request.
+"""Distributed tracing: spans across gateway → router → engine, plus the
+scheduler/worker cold-start path, correlated by a trace id that rides the
+request.
 
 Reference analogue: ``pkg/common/trace.go:12-27`` (OTEL span helpers wired
 through gateway/scheduler/worker). tpu9's redesign avoids an OTEL SDK
 dependency (zero-egress image): each process keeps a bounded ring of
-finished spans; workers ship their ring to the state bus alongside the
-metrics snapshot they already publish, and the gateway merges rings at
-query time (``/api/v1/traces``). Span records use OTLP-shaped field names
-so an exporter can forward them verbatim when an endpoint exists.
+finished spans; workers and LLM runners ship their ring to the gateway
+alongside the metrics/pressure snapshots they already publish, and the
+gateway merges rings at query time (``/api/v1/traces``). Span records use
+OTLP-shaped field names so an exporter can forward them verbatim when an
+endpoint exists.
+
+Clock discipline (ISSUE 8 satellite): every DURATION is computed from
+``time.monotonic()`` — an NTP step mid-span must never produce a negative
+or garbage ``durationMs``. Each span still carries ONE wall-clock anchor
+(``start``) captured at creation; its OTLP epoch-nano timestamps are
+``anchor`` and ``anchor + monotonic_duration``, so cross-process timelines
+line up (same-host wall anchors) while in-span math is step-proof.
+
+Cross-process propagation: a span's ``(trace_id, span_id)`` pair is its
+context. Same-task children inherit via a contextvar; crossing a task or
+process boundary carries the pair explicitly — ``Tracer.context()`` reads
+it, ``start_span(trace_id=..., parent_id=...)`` / ``span(parent_id=...)``
+re-attach under it (the gateway ships it to runners in the
+``X-Tpu9-Trace`` header).
 """
 
 from __future__ import annotations
@@ -31,7 +47,7 @@ def new_trace_id() -> str:
 
 class Span:
     __slots__ = ("trace_id", "span_id", "parent_id", "name", "start",
-                 "end", "attrs", "status")
+                 "start_mono", "end_mono", "attrs", "status")
 
     def __init__(self, trace_id: str, span_id: str, parent_id: str,
                  name: str, attrs: Optional[dict] = None):
@@ -39,17 +55,30 @@ class Span:
         self.span_id = span_id
         self.parent_id = parent_id
         self.name = name
+        # wall anchor (display/merge) + monotonic pair (all duration math)
         self.start = time.time()
-        self.end = 0.0
+        self.start_mono = time.monotonic()
+        self.end_mono = 0.0
         self.attrs: dict[str, Any] = attrs or {}
         self.status = "ok"
+
+    @property
+    def duration_s(self) -> float:
+        return max(self.end_mono - self.start_mono, 0.0)
+
+    @property
+    def end(self) -> float:
+        """Wall-clock end: anchor + monotonic duration (never the raw wall
+        clock at finish time — an NTP step between start and finish would
+        put ``end`` before ``start``)."""
+        return self.start + self.duration_s  # tpu9: noqa[OBS001] THE anchor pattern the rule demands: wall anchor + monotonic duration (not wall-minus-wall)
 
     def to_dict(self) -> dict:
         return {"traceId": self.trace_id, "spanId": self.span_id,
                 "parentSpanId": self.parent_id, "name": self.name,
                 "startTimeUnixNano": int(self.start * 1e9),
                 "endTimeUnixNano": int(self.end * 1e9),
-                "durationMs": round((self.end - self.start) * 1000, 3),
+                "durationMs": round(self.duration_s * 1000, 3),
                 "attributes": self.attrs, "status": self.status}
 
 
@@ -61,15 +90,12 @@ class Tracer:
 
     @contextlib.contextmanager
     def span(self, name: str, trace_id: str = "",
-             attrs: Optional[dict] = None):
+             attrs: Optional[dict] = None, parent_id: str = ""):
         """Start a span as a child of the context's current span (same
-        task/coroutine chain), or as a root of ``trace_id``."""
-        parent = _current_span.get()
-        if parent is not None and not trace_id:
-            trace_id = parent.trace_id
-        sp = Span(trace_id or new_trace_id(), uuid.uuid4().hex[:16],
-                  parent.span_id if parent else "", name, attrs)
-        sp.attrs.setdefault("service", self.service)
+        task/coroutine chain), of an explicit ``(trace_id, parent_id)``
+        remote parent, or as a root of ``trace_id``."""
+        sp = self.start_span(name, trace_id=trace_id, parent_id=parent_id,
+                             attrs=attrs)
         token = _current_span.set(sp)
         try:
             yield sp
@@ -78,12 +104,63 @@ class Tracer:
             raise
         finally:
             _current_span.reset(token)
-            sp.end = time.time()
-            self.finished.append(sp)
+            self.finish_span(sp)
+
+    def start_span(self, name: str, trace_id: str = "",
+                   parent_id: str = "",
+                   attrs: Optional[dict] = None) -> Span:
+        """Manual span start (caller finishes with :meth:`finish_span`).
+        Does NOT bind the contextvar — safe to hold across tasks (the
+        router's queue-wait span outlives the submitting coroutine).
+        Without an explicit parent, inherits the context's current span."""
+        if not parent_id:
+            parent = _current_span.get()
+            if parent is not None:
+                parent_id = parent.span_id
+                if not trace_id:
+                    trace_id = parent.trace_id
+        sp = Span(trace_id or new_trace_id(), uuid.uuid4().hex[:16],
+                  parent_id, name, attrs)
+        sp.attrs.setdefault("service", self.service)
+        return sp
+
+    def finish_span(self, sp: Span, status: str = "") -> Span:
+        """Finish a manually-started span and append it to the ring.
+        Idempotent on the ring only if the caller is — finishing twice
+        appends twice; every span should have exactly one owner."""
+        if status:
+            sp.status = status
+        sp.end_mono = time.monotonic()
+        self.finished.append(sp)
+        return sp
+
+    def record_span(self, name: str, trace_id: str, parent_id: str,
+                    start: float, start_mono: float,
+                    attrs: Optional[dict] = None,
+                    end_mono: float = 0.0, status: str = "") -> Span:
+        """Record an already-elapsed interval as a finished span: the
+        engine's decode windows are timed at dispatch/processing and only
+        become spans afterwards. ``start``/``start_mono`` are the captured
+        anchor pair; ``end_mono`` defaults to now."""
+        sp = self.start_span(name, trace_id=trace_id, parent_id=parent_id,
+                             attrs=attrs)
+        sp.start = start
+        sp.start_mono = start_mono
+        if status:
+            sp.status = status
+        sp.end_mono = end_mono or time.monotonic()
+        self.finished.append(sp)
+        return sp
 
     def current_trace_id(self) -> str:
         sp = _current_span.get()
         return sp.trace_id if sp else ""
+
+    def context(self) -> tuple[str, str]:
+        """(trace_id, span_id) of the context's current span, or ("", "")
+        — the pair a cross-task/cross-process child re-attaches under."""
+        sp = _current_span.get()
+        return (sp.trace_id, sp.span_id) if sp else ("", "")
 
     def export(self, trace_id: str = "", since: float = 0.0,
                limit: int = 1000) -> list[dict]:
@@ -98,6 +175,25 @@ class Tracer:
                 break
         out.reverse()
         return out
+
+    def export_new(self, since_mono: float = 0.0,
+                   limit: int = 1000) -> tuple[list[dict], float]:
+        """Spans finished after the MONOTONIC watermark ``since_mono``,
+        plus the new watermark. This is the ship-on-heartbeat cursor: a
+        wall-clock ``since`` would permanently drop every span finished
+        in the window a backward NTP step rewinds over — the exact bug
+        class the span clocks themselves were fixed for. Callers ship
+        the batch and only advance their watermark once the receiver
+        accepted it (retry-don't-drop)."""
+        out: list[dict] = []
+        hi = since_mono
+        for sp in self.finished:
+            if sp.end_mono > since_mono:
+                out.append(sp.to_dict())
+                hi = max(hi, sp.end_mono)
+                if len(out) >= limit:
+                    break
+        return out, hi
 
 
 # process-wide tracer (mirrors the metrics registry pattern)

@@ -83,6 +83,7 @@ class UsageService:
     async def query(self, workspace_id: str, hours: int = 24) -> dict:
         """Merge durable records with hot buckets for the last N hours."""
         now = time.time()
+        # tpu9: noqa[OBS001] hourly usage buckets are CALENDAR keys (billing is wall-time domain); an NTP step moves at most one edge sample between adjacent buckets
         buckets = [bucket_of(now - h * 3600) for h in range(hours)]
         out: dict[str, dict[str, float]] = {}
         durable = await self.backend.get_usage(workspace_id, buckets)

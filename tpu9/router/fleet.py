@@ -36,9 +36,10 @@ import logging
 import math
 import time
 from dataclasses import dataclass, field
-from typing import Awaitable, Callable, Optional
+from typing import Any, Awaitable, Callable, Optional
 
 from ..abstractions.common.buffer import ForwardResult
+from ..observability.trace import tracer
 from ..types import ContainerStatus, Stub
 from .admission import AdmissionController, ReplicaBudgets
 from .affinity import AffinityRouter
@@ -64,6 +65,13 @@ class _Pending:
     body: bytes
     forward: Callable[[list], Awaitable[ForwardResult]]
     dispatched: bool = False
+    # trace propagation (ISSUE 8): the gateway.invoke span context captured
+    # at submit — the dispatcher runs in a different task, so the
+    # contextvar chain breaks here and the pair is carried explicitly.
+    # ("", "") = untraced (e.g. bench driving the router directly).
+    ctx: tuple = ("", "")
+    ws: str = ""                  # workspace stamp for /api/v1/traces scoping
+    qspan: Any = None             # open router.queue_wait span (one finisher)
 
 
 @dataclass
@@ -143,6 +151,9 @@ class FleetRouter:
             req = st.queue.pop()
             if req is None:
                 break
+            if isinstance(req.item, _Pending):
+                self._finish_qspan(req.item, status="error",
+                                   reason="deployment_shutdown")
             if req.future is not None and not req.future.done():
                 req.future.set_result(_shed_result(
                     503, "deployment shutting down",
@@ -203,6 +214,33 @@ class FleetRouter:
         self._weights[workspace_id] = (weight, now)
         return weight
 
+    # -- trace spans (ISSUE 8) -------------------------------------------------
+
+    def _adm_span(self, ctx: tuple, stub: Stub, tenant: str, decision: str,
+                  reason: str = "", **extra) -> None:
+        """Record the admission DECISION as a (near-instant) child span of
+        the invoke span: admitted/queued vs shed, with the shed reason —
+        the evidence `why did my request 429` queries need. No-op when the
+        request carries no trace context (bench drives the router raw)."""
+        if not ctx[0]:
+            return
+        attrs = {"stub_id": stub.stub_id, "workspace_id": stub.workspace_id,
+                 "tenant": tenant, "decision": decision, **extra}
+        if reason:
+            attrs["reason"] = reason
+        sp = tracer.start_span("router.admission", trace_id=ctx[0],
+                               parent_id=ctx[1], attrs=attrs)
+        tracer.finish_span(sp, status="error" if decision == "shed"
+                           else "ok")
+
+    @staticmethod
+    def _finish_qspan(pending: _Pending, status: str = "ok",
+                      **attrs) -> None:
+        sp, pending.qspan = pending.qspan, None    # exactly one finisher
+        if sp is not None:
+            sp.attrs.update(attrs)
+            tracer.finish_span(sp, status=status)
+
     # -- submit (buffered path) ------------------------------------------------
 
     async def submit(self, stub: Stub, tenant: str, body: bytes,
@@ -211,6 +249,7 @@ class FleetRouter:
         """Admit → fair-queue → dispatch → forward. ``forward`` receives
         the router's replica preference order (container ids, best first)
         and performs the actual buffer forward."""
+        ctx = tracer.context()          # gateway.invoke, when routed via HTTP
         st = self._state(stub)
         if st is None:                  # racing shutdown
             return _shed_result(503, "gateway shutting down",
@@ -221,10 +260,21 @@ class FleetRouter:
             ra = self.admission.retry_after_s(stub.stub_id, st.queue.depth,
                                               max(st.replica_count, 1))
             self.signals.shed(stub.stub_id, tenant, "queue_full")
+            self._adm_span(ctx, stub, tenant, "shed", reason="queue_full",
+                           queue_depth=st.queue.depth,
+                           retry_after_s=round(ra, 3))
             return _shed_result(429, "fleet at capacity, retry later", ra)
 
         loop = asyncio.get_running_loop()
-        pending = _Pending(body=body, forward=forward)
+        pending = _Pending(body=body, forward=forward, ctx=ctx,
+                           ws=stub.workspace_id)
+        self._adm_span(ctx, stub, tenant, "queued",
+                       queue_depth=st.queue.depth)
+        if ctx[0]:
+            pending.qspan = tracer.start_span(
+                "router.queue_wait", trace_id=ctx[0], parent_id=ctx[1],
+                attrs={"stub_id": stub.stub_id,
+                       "workspace_id": stub.workspace_id, "tenant": tenant})
         wait_budget = min(self.cfg.max_queue_wait_s,
                           max(stub.config.timeout_s, 1.0))
         req = QueuedRequest(tenant=tenant, cost=estimate_cost(body),
@@ -246,6 +296,8 @@ class FleetRouter:
                 # and purge it (and any other resolved entries) from the
                 # lanes so they stop counting toward the shed depth
                 self.signals.shed(stub.stub_id, tenant, "queue_wait")
+                self._finish_qspan(pending, status="error",
+                                   reason="queue_wait_deadline")
                 req.future.set_result(_shed_result(
                     503, "queue wait exceeded deadline", ra))
                 st.queue.drop_completed()
@@ -261,6 +313,7 @@ class FleetRouter:
         Returns (shed_response, prefer): shed_response is None when
         admitted. The caller reports the serving replica via
         :meth:`stream_started` / releases with the returned callback."""
+        ctx = tracer.context()
         st = self._state(stub)
         if st is None:                  # racing shutdown
             return (_shed_result(503, "gateway shutting down",
@@ -269,11 +322,17 @@ class FleetRouter:
             ra = self.admission.retry_after_s(stub.stub_id, st.queue.depth,
                                               max(st.replica_count, 1))
             self.signals.shed(stub.stub_id, tenant, "queue_full")
+            self._adm_span(ctx, stub, tenant, "shed", reason="queue_full",
+                           stream=True, queue_depth=st.queue.depth)
             return (_shed_result(429, "fleet at capacity, retry later", ra),
                     [])
         self.signals.submitted(stub.stub_id, tenant)
         replicas = await self._running(stub.stub_id)
-        order, _, _ = await self._preference(stub.stub_id, body, replicas)
+        order, _, _, hit = await self._preference(stub.stub_id, body,
+                                                  replicas)
+        self._adm_span(ctx, stub, tenant, "admitted", stream=True,
+                       affinity_hit=hit,
+                       replica=order[0] if order else "cold")
         return None, order
 
     def stream_started(self, stub: Stub, body: bytes,
@@ -328,10 +387,10 @@ class FleetRouter:
         return data or None
 
     async def _preference(self, stub_id: str, body: bytes, replicas: list
-                          ) -> tuple[list[str], dict[str, int], int]:
-        """(ordered container ids, per-replica budgets, fleet capacity).
-        Load for JSQ = router-tracked in-flight plus the replica's OWN
-        reported queue (requests the engine already holds)."""
+                          ) -> tuple[list[str], dict[str, int], int, bool]:
+        """(ordered container ids, per-replica budgets, fleet capacity,
+        affinity hit). Load for JSQ = router-tracked in-flight plus the
+        replica's OWN reported queue (requests the engine already holds)."""
         budgets: dict[str, int] = {}
         load: dict[str, float] = {}
         saturated: set[str] = set()
@@ -358,9 +417,15 @@ class FleetRouter:
             load[cid] = self.budgets.inflight(cid) + queued
             if self.budgets.inflight(cid) >= budgets[cid]:
                 saturated.add(cid)
+        # affinity hit detection via the counter delta: order() classifies
+        # internally and the call is synchronous, so no other coroutine
+        # can interleave between the read and the call (single-threaded
+        # loop) — cheaper than re-walking the block keys a second time
+        hits0 = self.affinity.hits
         order = self.affinity.order(body, [s.container_id for s in replicas],
                                     load, saturated)
-        return order, budgets, sum(budgets.values())
+        return (order, budgets, sum(budgets.values()),
+                self.affinity.hits > hits0)
 
     async def _dispatch_loop(self, st: _StubState) -> None:
         stub_id = st.stub.stub_id
@@ -375,6 +440,9 @@ class FleetRouter:
                 # full queue budget during shutdown
                 if (req is not None and req.future is not None
                         and not req.future.done()):
+                    if isinstance(req.item, _Pending):
+                        self._finish_qspan(req.item, status="error",
+                                           reason="gateway_shutdown")
                     req.future.set_result(_shed_result(
                         503, "gateway shutting down",
                         self.cfg.shed_retry_after_s))
@@ -388,6 +456,9 @@ class FleetRouter:
                 # for the whole queue-wait budget over one store blip
                 if (req is not None and req.future is not None
                         and not req.future.done()):
+                    if isinstance(req.item, _Pending):
+                        self._finish_qspan(req.item, status="error",
+                                           reason=type(exc).__name__)
                     req.future.set_result(ForwardResult(
                         status=502,
                         body=json.dumps(
@@ -407,6 +478,8 @@ class FleetRouter:
                     ra = self.admission.retry_after_s(stub_id, st.queue.depth,
                                                       1)
                     self.signals.shed(stub_id, req.tenant, "queue_wait")
+                    self._finish_qspan(pending, status="error",
+                                       reason="queue_wait_deadline")
                     req.future.set_result(_shed_result(
                         503, "queue wait exceeded deadline", ra))
                 return
@@ -426,27 +499,43 @@ class FleetRouter:
                     self._launch(st, req, prefer=[], replica="")
                     return
             else:
-                order, budgets, capacity = await self._preference(
+                order, budgets, capacity, hit = await self._preference(
                     stub_id, pending.body, replicas)
                 self.signals.queue_sample(stub_id, st.queue.depth, capacity)
                 if req.future.done():    # deadline racing _preference
                     return
                 for cid in order:
                     if self.budgets.try_acquire(cid, budgets.get(cid, 1)):
-                        self._launch(st, req, prefer=order, replica=cid)
+                        self._launch(st, req, prefer=order, replica=cid,
+                                     affinity_hit=hit)
                         return
             # every replica at budget (or cold cap hit): wait for a
             # release / container event, then re-evaluate
             await self.budgets.wait_release(0.25)
 
     def _launch(self, st: _StubState, req: QueuedRequest,
-                prefer: list[str], replica: str) -> None:
+                prefer: list[str], replica: str,
+                affinity_hit: Optional[bool] = None) -> None:
         pending: _Pending = req.item
         pending.dispatched = True
         if not replica:                 # replica slots are acquired by the
             st.cold_inflight += 1       # dispatcher before _launch
         wait_s = time.monotonic() - req.enqueued_at
         self.signals.queue_wait(st.stub.stub_id, req.tenant, wait_s)
+        self._finish_qspan(pending, wait_s=round(wait_s, 6))
+        if pending.ctx[0]:
+            # the placement decision: affinity hit/miss + chosen replica
+            # (an instant span — it records an outcome, not an interval)
+            now_m = time.monotonic()
+            tracer.record_span(
+                "router.dispatch", pending.ctx[0], pending.ctx[1],
+                time.time(), now_m,
+                attrs={"stub_id": st.stub.stub_id, "workspace_id": pending.ws,
+                       "tenant": req.tenant,
+                       "replica": replica or "cold_start",
+                       "affinity_hit": bool(affinity_hit),
+                       "candidates": len(prefer)},
+                end_mono=now_m)
         t = asyncio.create_task(self._forward_one(st, req, prefer, replica))
         self._bg_tasks.add(t)
         t.add_done_callback(self._bg_tasks.discard)

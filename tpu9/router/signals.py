@@ -54,6 +54,13 @@ class RouterSignals:
     def queue_wait(self, stub_id: str, tenant: str, seconds: float) -> None:
         metrics.observe("tpu9_router_queue_wait_s", seconds,
                         labels={"tenant": tenant})
+        # per-STUB series too (ISSUE 8 latency decomposition): the tenant
+        # series answers fairness questions, this one answers "where did
+        # stub X's TTFT go" next to its ttft series below. Distinct metric
+        # name — reusing tpu9_router_queue_wait_s with a different label
+        # schema would double-count every request in cross-series sums
+        metrics.observe("tpu9_router_stub_queue_wait_s", seconds,
+                        labels={"stub": stub_id})
 
     def ttft(self, stub_id: str, seconds: float) -> None:
         metrics.observe("tpu9_router_ttft_s", seconds,
@@ -109,12 +116,31 @@ class RouterSignals:
             return 1.0 if depth > 0 else 0.0
         return min(depth / cap, 1.0)
 
+    def latency(self, stub_id: str) -> dict:
+        """Front-door latency decomposition for one stub (ISSUE 8): p50/
+        p95/count of router TTFT (submit → response) and queue wait
+        (submit → dispatch), read back from the registry summaries. The
+        engine-side phases (prefill / decode windows / TBT) live in the
+        heartbeated "engines" section — together the two answer where a
+        request's latency went without SSHing anything."""
+        out = {}
+        for phase, metric in (("ttft", "tpu9_router_ttft_s"),
+                              ("queue_wait", "tpu9_router_stub_queue_wait_s")):
+            snap = metrics.summary(metric, labels={"stub": stub_id})
+            if snap:
+                out[phase] = {"p50_s": round(snap["p50"], 6),
+                              "p95_s": round(snap["p95"], 6),
+                              "mean_s": round(snap["mean"], 6),
+                              "count": snap["count"]}
+        return out
+
     def snapshot(self, stub_id: str) -> dict:
         return {"submitted": self._submitted.get(stub_id, 0),
                 "shed": self._shed.get(stub_id, 0),
                 "shed_rate": self.shed_rate(stub_id),
                 "queue_depth": self.queue_depth(stub_id),
                 "pressure": self.pressure(stub_id),
+                "latency": self.latency(stub_id),
                 # fleet_ prefix: every other field is per-stub, but the
                 # speculation counters fold ALL heartbeating replicas —
                 # summing snapshots across stubs must not double-count

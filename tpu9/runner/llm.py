@@ -73,7 +73,10 @@ async def amain() -> None:
     from ..parallel.distributed import initialize_multihost
     initialize_multihost()
 
-    state = {"ready": False, "engine": None}
+    # "beat": request completions set this to nudge the pressure loop into
+    # an immediate heartbeat, so a completed request's engine spans ship
+    # BEFORE an aggressive scale-to-zero can kill the replica (ISSUE 8)
+    state = {"ready": False, "engine": None, "beat": asyncio.Event()}
 
     async def health(request: web.Request) -> web.Response:
         if not state["ready"]:
@@ -84,6 +87,16 @@ async def amain() -> None:
             # keeps routing requests into a black hole
             return web.json_response({"ready": False, **stats}, status=503)
         return web.json_response({"ready": True, **stats})
+
+    def _trace_ctx(request: web.Request):
+        """(trace_id, parent_span_id) from the gateway-minted
+        X-Tpu9-Trace header, or None — the engine records its request/
+        prefill/decode-window spans under this remote parent (ISSUE 8)."""
+        raw = request.headers.get("X-Tpu9-Trace", "")
+        if not raw or ":" not in raw:
+            return None
+        trace_id, _, parent = raw.partition(":")
+        return (trace_id, parent) if trace_id else None
 
     async def generate(request: web.Request) -> web.StreamResponse:
         if not state["ready"]:
@@ -97,11 +110,14 @@ async def amain() -> None:
                     status=400)
             prompt = [int(t) for t in tokens]
             max_new = int(payload.get("max_new_tokens", 32))
+            trace = _trace_ctx(request)
             if payload.get("stream") or \
                     "text/event-stream" in request.headers.get("Accept", ""):
-                return await _generate_sse(request, prompt, max_new)
+                return await _generate_sse(request, prompt, max_new, trace)
             out = await state["engine"].generate(prompt,
-                                                 max_new_tokens=max_new)
+                                                 max_new_tokens=max_new,
+                                                 trace=trace)
+            state["beat"].set()
             return web.json_response({"tokens": out})
         except ValueError as exc:
             return web.json_response({"error": str(exc)}, status=400)
@@ -109,12 +125,12 @@ async def amain() -> None:
             return web.json_response(error_payload(exc), status=500)
 
     async def _generate_sse(request: web.Request, prompt: list,
-                            max_new: int) -> web.StreamResponse:
+                            max_new: int, trace=None) -> web.StreamResponse:
         """Server-sent token stream: one `data: {"token": N}` event per
         generated token, then `data: {"done": true, "tokens": [...]}` —
         relayed incrementally by the gateway's streaming proxy."""
         req = await state["engine"].generate(prompt, max_new_tokens=max_new,
-                                             stream=True)
+                                             stream=True, trace=trace)
         sr = web.StreamResponse(
             status=200, headers={"Content-Type": "text/event-stream",
                                  "Cache-Control": "no-cache",
@@ -137,6 +153,7 @@ async def amain() -> None:
                     f"data: {json.dumps({'done': True, 'tokens': out})}\n\n"
                     .encode())
             await sr.write_eof()
+            state["beat"].set()
         except ConnectionResetError:
             # client went away: tell the ENGINE — otherwise the slot keeps
             # decoding the full budget into a queue nobody reads, pinning
@@ -149,10 +166,43 @@ async def amain() -> None:
             raise
         return sr
 
+    async def flight(request: web.Request) -> web.Response:
+        """Flight-recorder tail (ISSUE 8): the gateway's /api/v1/flight
+        proxies here through the request buffer."""
+        if not state["ready"]:
+            return web.json_response({"error": "not ready"}, status=503)
+        try:
+            limit = int(request.query.get("limit", 256))
+            since_seq = int(request.query.get("since_seq", 0))
+        except ValueError:
+            return web.json_response(
+                {"error": "limit/since_seq must be integers"}, status=400)
+        return web.json_response({
+            "container_id": cfg.container_id,
+            "flight": state["engine"].flight_records(
+                limit=limit, since_seq=since_seq)})
+
+    async def profile(request: web.Request) -> web.Response:
+        """Arm jax.profiler for the next N engine windows (ISSUE 8);
+        returns the dump path on THIS replica immediately."""
+        if not state["ready"]:
+            return web.json_response({"error": "not ready"}, status=503)
+        try:
+            payload = json.loads(await request.read() or b"{}")
+            out = state["engine"].arm_profile(
+                windows=int(payload.get("windows", 8)),
+                out_dir=str(payload.get("out_dir", "") or ""))
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        out["container_id"] = cfg.container_id
+        return web.json_response(out)
+
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app.router.add_get("/health", health)
     app.router.add_post("/", generate)
     app.router.add_post("/generate", generate)
+    app.router.add_get("/flight", flight)
+    app.router.add_post("/profile", profile)
     runner = web.AppRunner(app)
     await runner.setup()
     await web.TCPSite(runner, os.environ.get("TPU9_BIND_HOST", "127.0.0.1"),
@@ -184,6 +234,14 @@ async def amain() -> None:
         if not gateway_url:
             return
         rejected_logged = False
+        from ..utils.aio import event_wait
+        # span-ship watermark (ISSUE 8): MONOTONIC (an NTP step must not
+        # gate shipping), and only advances after a heartbeat the gateway
+        # ACCEPTED — a gateway blip retries the same window next beat
+        # instead of silently dropping engine spans (bounded by the
+        # tracer ring, same honesty as the worker/OTLP paths)
+        last_span_ship = 0.0
+        from ..observability.trace import RING_CAP, tracer
         async with aiohttp.ClientSession(
                 headers={"Authorization": f"Bearer {token}"}) as session:
             while True:
@@ -215,12 +273,24 @@ async def amain() -> None:
                         extra["prefix_misses"] = misses
                         extra["prefix_hit_rate"] = (
                             hits / (hits + misses) if hits + misses else 0.0)
+                    # latency decomposition (ISSUE 8): per-phase p50/p95
+                    # flat scalars → /api/v1/metrics "engines" section
+                    for k, v in (stats.get("latency") or {}).items():
+                        extra[k] = v
+                    fl = stats.get("flight")
+                    if isinstance(fl, dict):
+                        extra["flight_records"] = fl.get("records", 0)
+                        extra["flight_last_seq"] = fl.get("last_seq", 0)
+                    # engine spans ride the heartbeat the way worker rings
+                    # ride the keepalive (worker.py ship analogue)
+                    spans, ship_hi = tracer.export_new(
+                        since_mono=last_span_ship, limit=RING_CAP)
                     async with session.post(
                             gateway_url + "/rpc/llm/pressure",
                             json={"container_id": cfg.container_id,
                                   "token_pressure": stats["token_pressure"],
                                   "active_streams": stats["active_streams"],
-                                  "extra": extra},
+                                  "extra": extra, "spans": spans},
                             timeout=aiohttp.ClientTimeout(total=5)) as resp:
                         if resp.status >= 400 and not rejected_logged:
                             rejected_logged = True
@@ -230,9 +300,14 @@ async def amain() -> None:
                                 resp.status, (await resp.text())[:200])
                         elif resp.status < 400:
                             rejected_logged = False
+                            last_span_ship = ship_hi
                 except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
                     log.debug("pressure heartbeat failed: %s", exc)
-                await asyncio.sleep(2.0)
+                # request completions nudge the next beat immediately: an
+                # aggressive scale-to-zero otherwise kills the replica
+                # before the 2s tick and its engine spans die with it
+                await event_wait(state["beat"], timeout=2.0)
+                state["beat"].clear()
 
     await pressure_loop() if gateway_url else await asyncio.Event().wait()
 

@@ -28,8 +28,11 @@ import numpy as np
 
 from ..models.transformer import (DecoderConfig, decoder_forward,
                                   init_kv_cache)
+from ..observability.metrics import Metrics
+from ..observability.trace import tracer
 from ..ops.sampling import sample_logits
 from ..utils.aio import reap
+from .flight import maybe as flight_maybe
 
 Params = dict[str, Any]
 
@@ -107,6 +110,11 @@ class EngineConfig:
     # stream turns repetitive — blind probe windows would only burn
     # verify compute re-learning what the shadows already measured
     spec_probe_every: int = 0
+    # ---- observability (ISSUE 8) ----
+    # flight-recorder ring capacity, in records (one per dispatched window
+    # or admission — never per token). 0 disables the recorder entirely;
+    # the hot path then pays one `is not None` check per window.
+    flight_cap: int = 256
 
 
 @dataclass
@@ -125,6 +133,18 @@ class _Window:
     n_acc: Any = None         # device [B] (verify): accepted drafts/slot
     spec_len: int = 0
     n_real: Any = None        # np [B] (verify): real (non-pad) drafts
+    # observability (ISSUE 8): monotonic/wall anchor pair captured at
+    # dispatch (durations from monotonic, merge timelines from wall), why
+    # this K was picked, allocator snapshot at dispatch, and the host
+    # fan-out outcome (tokens delivered per live slot) filled in during
+    # processing — everything the flight record and the per-request
+    # decode-window spans need, with zero extra device syncs
+    t_mono: float = 0.0
+    t_wall: float = 0.0
+    pick: str = ""
+    kv_snap: tuple = ()       # (used, free, reserved) at dispatch (paged)
+    delivered: Any = None     # {slot: tokens delivered} (host processing)
+    spec_stats: tuple = ()    # (proposed, accepted) (verify processing)
 
 
 @dataclass
@@ -138,6 +158,18 @@ class _Request:
     queue: Optional[asyncio.Queue] = None   # set for streaming requests
     error: str = ""
     cancelled: bool = False                 # client abandoned the request
+    # observability (ISSUE 8): remote trace context (trace_id, parent
+    # span id) carried across the runner RPC boundary; span is the
+    # engine.request span opened at admission under that parent
+    trace: Optional[tuple] = None
+    span: Any = None
+    span_id: str = ""    # survives _obs_done so the window that RETIRES a
+    #                      request can still parent its decode_window span
+    t_enqueue_mono: float = 0.0
+    t_enqueue_wall: float = 0.0
+    t_first_mono: float = 0.0               # first token delivered
+    admit_cached: int = 0                   # prefix-cache tokens reused
+    admit_chunks: int = 0                   # prefill chunks dispatched
 
 
 class InferenceEngine:
@@ -285,6 +317,24 @@ class InferenceEngine:
                        "admit_interleaved_windows": 0,
                        "spec_windows": 0, "spec_proposed": 0,
                        "spec_accepted": 0}
+        # ---- observability (ISSUE 8) ----
+        # flight recorder: bounded per-window ring (None = disabled)
+        self.flight = flight_maybe(engine_cfg.flight_cap)
+        # per-ENGINE latency registry (TTFT/TBT/queue-wait/prefill/decode
+        # windows): its summaries ride stats() → the runner's pressure
+        # heartbeat → /api/v1/metrics "engines". A process-global registry
+        # would mix engines when two live in one process (bench A/B).
+        self.metrics = Metrics()
+        self._pick_reason = ""
+        self._kv_allocs = 0          # lifetime block allocations
+        self._flight_kv_allocs = 0   # marker for per-record deltas
+        self._flight_evictions = 0
+        # on-demand jax.profiler hook (/rpc/llm/profile): armed for the
+        # next N windows, started/stopped at window boundaries
+        self._profile_remaining = 0
+        self._profile_active = False
+        self._profile_path = ""
+        self._profile_error = ""
 
     # -- compiled steps ------------------------------------------------------
 
@@ -399,6 +449,9 @@ class InferenceEngine:
         flight (the steady-state overlap window). Admission latency wins
         when an admission could actually proceed: K=1."""
         if self._admission_can_proceed():
+            # shrink to the smallest window so the waiting head admits
+            # sooner — the flight recorder's "why was K small" answer
+            self._pick_reason = "admission"
             return self.ecfg.decode_steps[0]
         limit = max(self.ecfg.decode_steps)
         for slot in range(self.ecfg.max_batch):
@@ -410,6 +463,8 @@ class InferenceEngine:
             room = (self.ecfg.max_seq_len - 1 - self._host_len[slot]
                     - self._inflight_steps)
             limit = min(limit, max(1, remaining), max(1, room))
+        self._pick_reason = ("max" if limit >= max(self.ecfg.decode_steps)
+                             else "budget")
         for k in reversed(self.ecfg.decode_steps):
             if k <= limit:
                 return k
@@ -683,6 +738,7 @@ class InferenceEngine:
             raise RuntimeError(
                 f"KV pool exhausted: need {n}, free "
                 f"{self.allocator.free_count} (reservation bug)")
+        self._kv_allocs += n
         return got
 
     def _push_table(self, slot: int) -> None:
@@ -877,6 +933,11 @@ class InferenceEngine:
         return timings
 
     async def stop(self) -> None:
+        if self._profile_active:
+            # a dangling device trace outlives the engine otherwise
+            self._profile_remaining = 0
+            self._deferred_windows.clear()
+            self._profile_maybe_stop()
         if self._loop_task:
             # reap: absorbs the loop's CancelledError AND an Exception exit
             # (the loop ALREADY died; its failure was logged + fanned out)
@@ -902,7 +963,13 @@ class InferenceEngine:
             req.done.set()
 
     async def generate(self, prompt: list[int], max_new_tokens: int = 32,
-                       request_id: str = "", stream: bool = False):
+                       request_id: str = "", stream: bool = False,
+                       trace: Optional[tuple] = None):
+        """``trace`` is an optional remote span context ``(trace_id,
+        parent_span_id)`` — set by the llm runner from the gateway's
+        X-Tpu9-Trace header — under which the engine records its
+        request/prefill/decode-window spans. None (the default) records
+        no spans; latency metrics and the flight recorder are always on."""
         if self._dead_reason is not None:
             raise RuntimeError(
                 f"engine is dead: {self._dead_reason} (restart the "
@@ -917,7 +984,10 @@ class InferenceEngine:
             raise ValueError("empty prompt")
         req = _Request(request_id=request_id or f"r{time.monotonic_ns()}",
                        prompt=list(prompt), max_new_tokens=max_new_tokens,
-                       queue=asyncio.Queue() if stream else None)
+                       queue=asyncio.Queue() if stream else None,
+                       trace=trace if trace and trace[0] else None,
+                       t_enqueue_mono=time.monotonic(),
+                       t_enqueue_wall=time.time())
         await self._queue.put(req)
         self._stats["queued"] = self._queue.qsize()
         if stream:
@@ -926,6 +996,15 @@ class InferenceEngine:
         if req.error:
             raise ValueError(req.error)
         return req.generated
+
+    def flight_records(self, limit: int = 256,
+                       since_seq: int = 0) -> list[dict]:
+        """Flight-recorder tail (newest last); [] when disabled. The
+        runner's /flight RPC and bench read through here so neither needs
+        to know whether the recorder is on."""
+        if self.flight is None:
+            return []
+        return self.flight.snapshot(limit=limit, since_seq=since_seq)
 
     def stats(self) -> dict:
         out = dict(self._stats)
@@ -945,6 +1024,27 @@ class InferenceEngine:
         prop = self._stats["spec_proposed"]
         out["spec_acceptance_rate"] = (
             self._stats["spec_accepted"] / prop if prop else 0.0)
+        # flight recorder + profiling hook + latency decomposition
+        # (ISSUE 8). "latency" is flat p50/p95/count scalars per phase so
+        # the runner heartbeat can forward them into the store hash that
+        # backs /api/v1/metrics "engines" unchanged.
+        if self.flight is not None:
+            out["flight"] = self.flight.summary()
+        out["profile"] = {"armed": self._profile_remaining,
+                          "active": self._profile_active,
+                          "path": self._profile_path,
+                          "error": self._profile_error}
+        lat = {}
+        summaries = self.metrics.to_dict()["summaries"]
+        for phase in ("ttft", "tbt", "queue_wait", "prefill",
+                      "decode_window", "e2e"):
+            snap = summaries.get(f"tpu9_engine_{phase}_s")
+            if snap:
+                lat[f"{phase}_p50_s"] = round(snap["p50"], 6)
+                lat[f"{phase}_p95_s"] = round(snap["p95"], 6)
+                lat[f"{phase}_count"] = snap["count"]
+                lat[f"{phase}_mean_s"] = round(snap["mean"], 6)
+        out["latency"] = lat
         if self.paged:
             out["kv_blocks_used"] = self.allocator.used_count
             out["kv_blocks_free"] = self.allocator.free_count
@@ -1030,6 +1130,8 @@ class InferenceEngine:
         suffix = req.prompt[p:]
         m = len(suffix)
         n_chunks = -(-m // c)
+        req.admit_cached = p
+        req.admit_chunks = n_chunks
         toks_all = np.zeros((n_chunks, c), dtype=np.int32)
         offsets = np.zeros((n_chunks,), dtype=np.int32)
         last_idxs = np.zeros((n_chunks,), dtype=np.int32)
@@ -1096,6 +1198,196 @@ class InferenceEngine:
         self._occupy_slot(req, slot)
         return first
 
+    # -- observability hooks (ISSUE 8) ---------------------------------------
+    # All host-side bookkeeping on state the loop already holds: monotonic
+    # durations, per-engine metric observes (per request / per window,
+    # never per token), and — only for requests carrying a remote trace
+    # context — span records into the process tracer ring the runner ships
+    # on its pressure heartbeat.
+
+    def _obs_admit_start(self, req: _Request, t0_mono: float,
+                         t0_wall: float) -> None:
+        wait = max(t0_mono - req.t_enqueue_mono, 0.0)
+        self.metrics.observe("tpu9_engine_queue_wait_s", wait)
+        if req.trace is None:
+            return
+        trace_id, parent = req.trace
+        req.span = tracer.start_span(
+            "engine.request", trace_id=trace_id, parent_id=parent,
+            attrs={"request_id": req.request_id,
+                   "prompt_tokens": len(req.prompt),
+                   "max_new_tokens": req.max_new_tokens})
+        req.span_id = req.span.span_id
+        # backdate to the enqueue anchor: the request span covers
+        # queue-wait + prefill + every decode window
+        req.span.start, req.span.start_mono = (req.t_enqueue_wall,
+                                               req.t_enqueue_mono)
+        tracer.record_span(
+            "engine.queue_wait", trace_id, req.span.span_id,
+            req.t_enqueue_wall, req.t_enqueue_mono,
+            attrs={"request_id": req.request_id}, end_mono=t0_mono)
+
+    def _obs_admit_end(self, req: _Request, t0_mono: float, t0_wall: float,
+                       il0: int) -> None:
+        dur = max(time.monotonic() - t0_mono, 0.0)
+        self.metrics.observe("tpu9_engine_prefill_s", dur)
+        interleaved = self._stats["admit_interleaved_windows"] - il0
+        if req.trace is not None and req.span is not None:
+            tracer.record_span(
+                "engine.prefill", req.trace[0], req.span.span_id,
+                t0_wall, t0_mono,
+                attrs={"request_id": req.request_id,
+                       "prompt_tokens": len(req.prompt),
+                       "cached_tokens": req.admit_cached,
+                       "chunks": req.admit_chunks,
+                       "interleaved_windows": interleaved})
+        if self.flight is not None:
+            self.flight.record(
+                "admit", request_id=req.request_id, slot=req.slot,
+                prompt_tokens=len(req.prompt),
+                cached_tokens=req.admit_cached, chunks=req.admit_chunks,
+                interleaved=interleaved, dur_s=round(dur, 6))
+
+    def _obs_stamp_window(self, win: _Window) -> _Window:
+        win.t_mono = time.monotonic()
+        win.t_wall = time.time()
+        win.pick = self._pick_reason
+        if self.paged:
+            win.kv_snap = (self.allocator.used_count,
+                           self.allocator.free_count,
+                           self.allocator.reserved)
+        return win
+
+    def _obs_window(self, win: _Window, t_host0: float) -> None:
+        """One flight record + per-traced-request window spans at host
+        processing time. ``wait_s`` (dispatch → fan-out start) includes
+        the deliberate one-window overlap; ``host_s`` is the fan-out."""
+        now_m = time.monotonic()
+        self.metrics.observe("tpu9_engine_decode_window_s",
+                             max(t_host0 - win.t_mono, 0.0))
+        delivered = win.delivered or {}
+        if self.flight is not None:
+            slots = {s: r.request_id
+                     for s, r in enumerate(win.reqs)
+                     if r is not None and win.mask[s]}
+            rec = {"k": win.k, "pick": win.pick,
+                   "batch": int(win.mask.sum()),
+                   "slots": slots, "tokens": delivered,
+                   "wait_s": round(max(t_host0 - win.t_mono, 0.0), 6),
+                   "host_s": round(max(now_m - t_host0, 0.0), 6)}
+            if win.kind == "verify":
+                prop, acc = win.spec_stats or (0, 0)
+                rec.update(spec_proposed=prop, spec_accepted=acc,
+                           spec_rollback=prop - acc,
+                           spec_len=win.spec_len)
+            if win.kv_snap:
+                used, free, reserved = win.kv_snap
+                rec.update(kv_used=used, kv_free=free, kv_reserved=reserved,
+                           kv_alloc=self._kv_allocs - self._flight_kv_allocs)
+                self._flight_kv_allocs = self._kv_allocs
+                if self.prefix_cache is not None:
+                    ev = self.prefix_cache.evictions
+                    rec.update(
+                        prefix_evictions=ev - self._flight_evictions,
+                        prefix_pinned=self.prefix_cache.pinned)
+                    self._flight_evictions = ev
+            self.flight.record(win.kind, **rec)
+        for slot, n_tok in delivered.items():
+            req = win.reqs[slot]
+            if (n_tok > 0 and req is not None and req.trace is not None
+                    and req.span_id):
+                tracer.record_span(
+                    "engine.decode_window", req.trace[0], req.span_id,
+                    win.t_wall, win.t_mono,
+                    attrs={"kind": win.kind, "k": win.k, "tokens": n_tok,
+                           "pick": win.pick})
+
+    def _obs_first_token(self, req: _Request) -> None:
+        req.t_first_mono = time.monotonic()
+        self.metrics.observe(
+            "tpu9_engine_ttft_s",
+            max(req.t_first_mono - req.t_enqueue_mono, 0.0))
+
+    def _obs_done(self, req: _Request) -> None:
+        """Idempotent: reachable from both _retire (slot completion) and
+        _finish (error/cancel paths) — only the FIRST call observes."""
+        now = time.monotonic()
+        n = len(req.generated)
+        if req.t_enqueue_mono:
+            self.metrics.observe("tpu9_engine_e2e_s",
+                                 max(now - req.t_enqueue_mono, 0.0))
+            if req.t_first_mono and n > 1:
+                self.metrics.observe(
+                    "tpu9_engine_tbt_s",
+                    max(now - req.t_first_mono, 0.0) / (n - 1))
+            req.t_enqueue_mono = 0.0
+        if req.span is not None:
+            sp, req.span = req.span, None     # exactly one finish per span
+            sp.attrs["tokens_generated"] = n
+            tracer.finish_span(sp, status="error" if req.error else "ok")
+
+    # -- on-demand profiling (ISSUE 8) ---------------------------------------
+
+    def arm_profile(self, windows: int = 8, out_dir: str = "") -> dict:
+        """Arm ``jax.profiler`` for the next ``windows`` dispatched
+        windows. Returns the dump path immediately; the trace starts at
+        the next window boundary and stops once the armed windows have
+        drained — a live replica gets profiled without a restart or a
+        single out-of-band device sync."""
+        if windows <= 0:
+            raise ValueError(f"windows must be positive, got {windows}")
+        if self._profile_active or self._profile_remaining > 0:
+            return {"path": self._profile_path,
+                    "windows": self._profile_remaining,
+                    "already_armed": True}
+        import tempfile
+        self._profile_path = out_dir or tempfile.mkdtemp(
+            prefix="tpu9-profile-")
+        self._profile_remaining = windows
+        self._profile_error = ""
+        if self.flight is not None:
+            self.flight.record("profile", event="armed",
+                               windows=windows, path=self._profile_path)
+        return {"path": self._profile_path, "windows": windows}
+
+    def _profile_window_start(self) -> None:
+        if self._profile_remaining <= 0 or self._profile_active:
+            return
+        try:
+            jax.profiler.start_trace(self._profile_path)
+            self._profile_active = True
+        except Exception as exc:    # noqa: BLE001 — profiling must never
+            # take the serve loop down; surface the failure in stats()
+            self._profile_error = f"{type(exc).__name__}: {exc}"
+            self._profile_remaining = 0
+
+    def _profile_window_dispatched(self) -> None:
+        if self._profile_active and self._profile_remaining > 0:
+            self._profile_remaining -= 1
+
+    def _profile_maybe_stop(self, idle: bool = False) -> None:
+        """Stop once every armed window has been host-processed (device
+        work complete), so the dump covers the whole window set.
+        ``idle=True`` (the serve loop about to park) stops EARLY even
+        with armed windows left: traffic dried up before the armed count,
+        and a partial dump beats tracing hours of parked silence — which
+        would also leave ``arm_profile`` reporting already_armed forever."""
+        if not self._profile_active or self._deferred_windows:
+            return
+        if self._profile_remaining > 0 and not idle:
+            return
+        left, self._profile_remaining = self._profile_remaining, 0
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 — see start
+            self._profile_error = f"{type(exc).__name__}: {exc}"
+        self._profile_active = False
+        if self.flight is not None:
+            self.flight.record("profile", event="stopped",
+                               path=self._profile_path,
+                               windows_left=left,
+                               error=self._profile_error)
+
     def _occupy_slot(self, req: _Request, slot: int) -> None:
         req.slot = slot
         self.active[slot] = True
@@ -1146,9 +1438,10 @@ class InferenceEngine:
          toks) = self._decode_k(k)(
             self.params, self.kv_cache, self.last_token, self.cache_len,
             jnp.asarray(self.active), self._rng)
-        self._deferred_windows.append(
+        self._pick_reason = "interleave"
+        self._deferred_windows.append(self._obs_stamp_window(
             _Window(kind="decode", k=k, toks=toks, mask=self.active.copy(),
-                    reqs=tuple(self.slot_req)))
+                    reqs=tuple(self.slot_req))))
         self._inflight_steps += k
         self._stats["decode_steps"] += k
         self._stats["admit_interleaved_windows"] += 1
@@ -1158,8 +1451,17 @@ class InferenceEngine:
         first-token DEVICE value — the serve loop syncs a whole admission
         batch in one host round-trip (each blocking ``int()`` here would
         cost a full RTT, brutal over a TPU relay)."""
+        t0_mono, t0_wall = time.monotonic(), time.time()
+        self._obs_admit_start(req, t0_mono, t0_wall)
+        il0 = self._stats["admit_interleaved_windows"]
         if self.paged:
-            return await self._admit_paged(req, slot)
+            first = await self._admit_paged(req, slot)
+        else:
+            first = self._admit_dense(req, slot)
+        self._obs_admit_end(req, t0_mono, t0_wall, il0)
+        return first
+
+    def _admit_dense(self, req: _Request, slot: int):
         n = len(req.prompt)
         bucket = self._bucket_for(n)
         tokens = np.zeros((1, bucket), dtype=np.int32)
@@ -1202,6 +1504,7 @@ class InferenceEngine:
 
     def _deliver_first(self, req: _Request, first: int) -> None:
         req.generated.append(first)
+        self._obs_first_token(req)
         st = self._spec_slots[req.slot] if req.slot >= 0 else None
         if st is not None:
             st.proposer.append(first)
@@ -1228,6 +1531,7 @@ class InferenceEngine:
             self.allocator.unreserve(self._slot_reserved[slot])
             self._slot_reserved[slot] = 0
         if req is not None:
+            self._obs_done(req)
             if req.queue is not None:
                 req.queue.put_nowait(None)
             req.done.set()
@@ -1257,10 +1561,10 @@ class InferenceEngine:
             return None
         return None
 
-    @staticmethod
-    def _finish(req: _Request, error: str = "") -> None:
+    def _finish(self, req: _Request, error: str = "") -> None:
         if error and not req.error:
             req.error = error
+        self._obs_done(req)
         if req.queue is not None:
             req.queue.put_nowait(None)
         req.done.set()
@@ -1296,6 +1600,8 @@ class InferenceEngine:
 
     async def _serve_loop_inner(self) -> None:
         while True:
+            # armed profile done? stop once every profiled window drained
+            self._profile_maybe_stop()
             # admit as many queued requests as there are free slots; ALL
             # their first tokens sync in one device round-trip at the end.
             # An imminent admission first drains the steady-state overlap
@@ -1326,6 +1632,16 @@ class InferenceEngine:
                         head.queue.put_nowait(None)   # release SSE readers
                     head.done.set()
                     continue
+                if self._deferred_windows:
+                    # a zombie overlap window (its slots all retired during
+                    # the previous iteration's drain, with this successor
+                    # already in flight): process it BEFORE parking, or its
+                    # device work goes unaccounted and the armed profiler
+                    # below can never observe an empty flight
+                    self._drain_windows()
+                # an armed profile must stop NOW — even mid-arm-count —
+                # parked-idle time must not leak into the dump
+                self._profile_maybe_stop(idle=True)
                 # idle: block for work
                 req = await self._queue.get()
                 if req.cancelled:
@@ -1358,8 +1674,10 @@ class InferenceEngine:
 
             # one WINDOW for the whole batch — speculative verify when the
             # acceptance EWMAs justify it, classic k-step decode otherwise
+            self._profile_window_start()
             win = self._dispatch_window()
             if win is not None:
+                self._profile_window_dispatched()
                 self._deferred_windows.append(win)
                 # steady-state overlap (ISSUE 5 satellite): keep exactly
                 # ONE window in flight — the host fan-out of every older
@@ -1411,8 +1729,9 @@ class InferenceEngine:
             self.cache_len, jnp.asarray(self.active), self._rng)
         self._stats["decode_steps"] += k
         self._inflight_steps += k
-        return _Window(kind="decode", k=k, toks=toks,
-                       mask=self.active.copy(), reqs=tuple(self.slot_req))
+        return self._obs_stamp_window(
+            _Window(kind="decode", k=k, toks=toks,
+                    mask=self.active.copy(), reqs=tuple(self.slot_req)))
 
     def _dispatch_verify(self, s: int, drafts, n_real) -> _Window:
         t = s + 1
@@ -1429,9 +1748,11 @@ class InferenceEngine:
             self._rng)
         self._stats["spec_windows"] += 1
         self._inflight_steps += t
-        return _Window(kind="verify", k=t, toks=out, n_acc=n_acc,
-                       mask=self.active.copy(), reqs=tuple(self.slot_req),
-                       spec_len=s, n_real=n_real)
+        self._pick_reason = "spec"
+        return self._obs_stamp_window(
+            _Window(kind="verify", k=t, toks=out, n_acc=n_acc,
+                    mask=self.active.copy(), reqs=tuple(self.slot_req),
+                    spec_len=s, n_real=n_real))
 
     def _drain_windows(self) -> None:
         """Host-process every in-flight window. ONE transfer for all of
@@ -1497,9 +1818,15 @@ class InferenceEngine:
         carry [k, B] (every step, every slot); verify windows carry the
         model outputs [B, 1+s] plus per-slot accepted-draft counts —
         tokens-per-slot-per-window is VARIABLE (1..1+s)."""
+        t_host0 = time.monotonic()
+        win.delivered = {}
         if win.kind == "verify":
             self._process_verify_host(win, window, n_acc)
-            return
+        else:
+            self._process_decode_host(win, window)
+        self._obs_window(win, t_host0)
+
+    def _process_decode_host(self, win: _Window, window) -> None:
         shadow: dict[int, list[int]] = {}
         if self._spec_lens:
             # shadow drafts: what WOULD prompt lookup have proposed for
@@ -1540,15 +1867,21 @@ class InferenceEngine:
             st = self._spec_slots[slot]
             if st is not None:
                 st.observe(m, acc)
+        win.delivered = {slot: len(toks)
+                         for slot, toks in enumerate(delivered) if toks}
 
     def _process_verify_host(self, win: _Window, out, n_acc) -> None:
         s = win.spec_len
+        win_proposed = win_accepted = 0
         for slot in range(self.ecfg.max_batch):
             if not self._slot_live(win, slot):
                 continue
             acc = int(n_acc[slot])
             st = self._spec_slots[slot]
             n_real = int(win.n_real[slot])
+            if n_real > 0:
+                win_proposed += n_real
+                win_accepted += min(acc, n_real)
             if st is not None and n_real > 0:
                 # EWMA and counters see only what this slot actually
                 # proposed — zero-padded lanes (and any padded TAIL of a
@@ -1563,7 +1896,12 @@ class InferenceEngine:
                 self._retire(slot)
                 continue
             req = self.slot_req[slot]
+            n_delivered = 0
             for i in range(acc + 1):
                 self._deliver_token(slot, int(out[slot, i]))
+                n_delivered += 1
                 if self.slot_req[slot] is not req:
                     break          # EOS / budget / room hit inside the run
+            if n_delivered:
+                win.delivered[slot] = n_delivered
+        win.spec_stats = (win_proposed, win_accepted)

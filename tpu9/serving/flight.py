@@ -1,0 +1,88 @@
+"""Engine flight recorder: a bounded ring of per-window serve-loop records.
+
+The serving hot path (windows, speculative verify, paged-KV growth) is
+invisible to logs — logging per window would be noise, logging per token
+would be suicide. The flight recorder is the black box instead: every
+dispatched ``_Window`` (and every admission) appends ONE plain dict at
+host-processing time, built exclusively from state the loop already holds
+on the host (monotonic clocks, numpy masks, allocator counters). No device
+syncs beyond the existing window-boundary ones, no per-token records.
+
+Record schema (kind == "decode" | "verify"):
+
+    seq               monotonically increasing record id (per engine)
+    ts                wall anchor at host processing (merge/display only)
+    kind, k           window kind and device steps (verify: 1 + spec_len)
+    pick              why this K was picked ("admission" = shrunk to K=1
+                      for an imminent admission, else "budget"/"max")
+    batch             active slots at dispatch
+    slots             {slot: request_id} snapshot at dispatch
+    tokens            {slot: tokens delivered} (host fan-out outcome)
+    wait_s            dispatch → host processing (device compute + the
+                      one-window overlap the loop deliberately holds)
+    host_s            host fan-out time for this window's processing
+    spec_proposed / spec_accepted / spec_rollback   (verify windows)
+    kv_used/kv_free/kv_reserved                     allocator at dispatch
+    kv_alloc          blocks allocated since the previous record
+    prefix_evictions  prefix-cache evictions since the previous record
+    prefix_pinned     currently pinned prefix-cache entries
+
+Admission records (kind == "admit"): request_id, prompt_tokens,
+cached_tokens (prefix-cache reuse), chunks, interleaved (decode windows
+dispatched during the admission), dur_s.
+
+Profile records (kind == "profile"): armed/stopped markers with the dump
+path, so the flight timeline shows which windows a ``jax.profiler`` dump
+covers.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+from typing import Optional
+
+
+class FlightRecorder:
+    """Bounded ring of plain-dict records; query by tail or by seq."""
+
+    def __init__(self, cap: int = 256):
+        self.cap = cap
+        self._ring: collections.deque[dict] = collections.deque(maxlen=cap)
+        self._seq = itertools.count(1)
+        self.recorded = 0           # lifetime count (dropped = recorded - len)
+
+    def record(self, kind: str, **fields) -> dict:
+        rec = {"seq": next(self._seq), "ts": round(time.time(), 6),
+               "kind": kind, **fields}
+        self._ring.append(rec)
+        self.recorded += 1
+        return rec
+
+    def snapshot(self, limit: int = 256, since_seq: int = 0) -> list[dict]:
+        """Newest-last tail of the ring: up to ``limit`` records with
+        ``seq > since_seq`` (pass the last seen seq to poll incrementally
+        without re-reading the whole ring)."""
+        out = []
+        for rec in reversed(self._ring):
+            if rec["seq"] <= since_seq:
+                break
+            out.append(rec)
+            if len(out) >= max(limit, 1):
+                break
+        out.reverse()
+        return out
+
+    def summary(self) -> dict:
+        last = self._ring[-1] if self._ring else None
+        return {"records": len(self._ring), "cap": self.cap,
+                "recorded": self.recorded,
+                "dropped": self.recorded - len(self._ring),
+                "last_seq": last["seq"] if last else 0}
+
+
+def maybe(cap: int) -> Optional[FlightRecorder]:
+    """Recorder or None — the engine's hot path gates on ``is not None``,
+    so a disabled recorder costs one attribute check per window."""
+    return FlightRecorder(cap) if cap > 0 else None

@@ -130,6 +130,8 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.tokens_reused = 0
+        self.evictions = 0      # lifetime counter (flight-recorder deltas)
+        self.pinned = 0         # live lookup pins (O(1), not an entry scan)
 
     @staticmethod
     def _key(tokens: list[int]) -> bytes:
@@ -158,6 +160,7 @@ class PrefixCache:
             if entry is not None:
                 entry.last_used = time.monotonic()
                 entry.pins += 1
+                self.pinned += 1
                 self.hits += 1
                 self.tokens_reused += entry.n_tokens
                 return entry
@@ -167,6 +170,7 @@ class PrefixCache:
 
     def release_pin(self, entry: PrefixEntry) -> None:
         entry.pins -= 1
+        self.pinned -= 1
         assert entry.pins >= 0, "unbalanced prefix-cache pin release"
 
     def insert(self, prompt: list[int], slot_blocks: list[int]) -> None:
@@ -204,6 +208,7 @@ class PrefixCache:
         oldest = min(victims, key=lambda e: e.last_used)
         del self._entries[oldest.key]
         self.allocator.release(oldest.blocks)
+        self.evictions += 1
         return True
 
     def evict_for_space(self, blocks_needed: int) -> None:
@@ -217,4 +222,5 @@ class PrefixCache:
         return {"entries": len(self._entries),
                 "held_blocks": self.held_blocks,
                 "hits": self.hits, "misses": self.misses,
-                "tokens_reused": self.tokens_reused}
+                "tokens_reused": self.tokens_reused,
+                "evictions": self.evictions, "pinned": self.pinned}

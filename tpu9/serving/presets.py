@@ -81,6 +81,7 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
                 spec_min_accept: float = 0.35,
                 quantize: Optional[str] = None,
                 kv_quant: Optional[str] = None,
+                flight_cap: int = 256,
                 engine_cfg: Optional[EngineConfig] = None,
                 seed: int = 0,
                 compile_ahead: bool = False) -> InferenceEngine:
@@ -151,7 +152,9 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
         if prefix_cache_blocks is not None
         else (max_seq_len // block if paged else 0),
         spec_len=spec_len, spec_min_accept=spec_min_accept,
-        kv_quant=kv_quant or "")
+        kv_quant=kv_quant or "",
+        # flight recorder (ISSUE 8): per-window black box; 0 disables
+        flight_cap=flight_cap)
     if compile_ahead:
         import logging
         import threading
