@@ -62,7 +62,7 @@ PHASE_TIMEOUT_S = {"llm": 1800, "llm_endpoint": 1800, "kernels": 900,
                    "coldstart": 900, "coldstart_native": 900,
                    "coldstart_jax": 900, "coldstart_jax_tpu": 900,
                    "coldstart_stream": 900, "router": 300, "spec": 900,
-                   "quant": 900, "obs": 900}
+                   "quant": 900, "obs": 900, "multichip": 900}
 
 # share compiled XLA programs between the in-process llm phase and the
 # runner container in the endpoint phase (identical graphs → second phase
@@ -1919,6 +1919,238 @@ def bench_obs(quick: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# phase: mesh-sharded multi-chip serving (ISSUE 9) — the tp=2 sharded engine
+# priced against the 1-chip engine it must not fork from:
+#
+#   1. multichip_per_chip_ratio — (tp=2 tokens/sec ÷ 2 chips) / 1-chip
+#      tokens/sec. On a real slice this is the serving-economics gate
+#      (spreading a model must buy throughput, not just capacity). On
+#      forced-CPU virtual devices every "chip" shares the same host cores,
+#      so tp=2 adds partitioning overhead over ZERO extra silicon — the
+#      ratio is reported as evidence but the binding CPU gate is a
+#      catastrophe floor on the TOTAL throughput ratio (the quant/obs
+#      precedent for wins CPU physically cannot show).
+#   2. parity judge (HARD): token-for-token vs the 1-chip engine at f32;
+#      any fork is judged against the full-context oracle's argmax margin
+#      (sharded reductions may reassociate; a table/layout bug may not).
+#   3. planner-vs-actual: the topology planner prices per-chip weights from
+#      feasibility's eval_shape arithmetic; this phase measures the bytes
+#      ACTUALLY resident on one device after placement and fails if the
+#      deploy gate's numbers do not describe the real layout. Plus the
+#      flagship arithmetic: llama3-8b provably infeasible on one v5e chip,
+#      planned onto 2x1 with the 1x1 rejection ledger populated.
+#   4. MFU/MBU under sharding: per-chip decode physics of the tp=2 engine
+#      (streamed bytes / FLOPs divide across the submesh; the ceiling is
+#      per chip, so utilization stays comparable to the 1-chip engine).
+# ---------------------------------------------------------------------------
+
+def bench_multichip(quick: bool = False) -> dict:
+    import asyncio
+    from dataclasses import replace as _replace
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu9.benchsuite.physics import (chip_spec, decode_byte_counts,
+                                         decode_physics)
+    from tpu9.models import init_decoder
+    from tpu9.models.transformer import decoder_forward
+    from tpu9.serving.engine import EngineConfig, InferenceEngine
+    from tpu9.serving.feasibility import weight_bytes
+    from tpu9.serving.presets import resolve_preset
+    from tpu9.serving.shard import Topology, make_policy, plan_topology
+    from tpu9.utils import on_tpu
+
+    os.makedirs(XLA_CACHE_DIR, exist_ok=True)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", XLA_CACHE_DIR)
+
+    tpu = on_tpu()
+    n_dev = jax.device_count()
+    out: dict = {"on_tpu": tpu, "multichip_devices": n_dev}
+    violations: list[str] = []
+    TP = 2
+    if n_dev < TP:
+        raise RuntimeError(
+            f"multichip phase needs >= {TP} devices, have {n_dev} — run "
+            "via bench.py --cpu (forces an 8-device virtual CPU mesh) or "
+            "on a real slice")
+
+    # -- flagship planner arithmetic (pure host math, deterministic) ------
+    plan = plan_topology("llama3-8b", "v5e-8")
+    out["multichip_plan_llama3_8b_v5e"] = str(plan.topology)
+    if plan.topology != Topology(2, 1) or len(plan.rejected) != 1:
+        violations.append(
+            f"multichip: planner put llama3-8b/v5e-8 on {plan.topology} "
+            f"with {len(plan.rejected)} rejections (expected 2x1 after "
+            "rejecting exactly 1x1) — the feasibility pricing moved")
+
+    # f32 kills bf16 argmax-tie noise in the parity judge (spec/quant
+    # precedent); tiny preset so CPU passes stay in budget
+    s = dict(preset="llama-tiny", batch=4, max_seq=512,
+             prefill_buckets=(32, 64), decode_steps=(1, 4, 8), kv_block=32,
+             requests=4, max_new=64 if quick else 128,
+             passes=2 if quick else 3)
+    out["multichip_model"] = s["preset"]
+    cfg, _ = resolve_preset(s["preset"])
+    cfg = _replace(cfg, dtype=jnp.float32)
+    params = init_decoder(jax.random.PRNGKey(0), cfg)
+
+    # -- paired engines: 1-chip vs tp=2 -----------------------------------
+    pol2 = make_policy(f"{TP}x1")
+    def build(policy):
+        eng = InferenceEngine(params, cfg, EngineConfig(
+            max_batch=s["batch"], max_seq_len=s["max_seq"],
+            prefill_buckets=s["prefill_buckets"],
+            decode_steps=s["decode_steps"], kv_block_size=s["kv_block"],
+            kv_pool_blocks=0, prefill_chunk=min(s["prefill_buckets"]),
+            prefix_cache_blocks=s["max_seq"] // s["kv_block"]),
+            policy=policy)
+        eng.warmup()
+        return eng
+
+    one = build(make_policy(None))
+    two = build(pol2)
+    st = two.stats()
+    out["multichip_topology"] = (
+        f"{st['topo_tp']}x{st['topo_fsdp']}")
+
+    # -- planner-vs-actual per-chip weight bytes --------------------------
+    # the deploy-gate contract: feasibility's per-chip pricing (total
+    # eval_shape bytes ÷ n_chips) must describe what the ENGINE actually
+    # leaves resident on each device — measured from the serving engine's
+    # own param tree, so a placement regression (e.g. a constructor path
+    # that skips the policy and serves replicated weights) fails here
+    # rather than silently inflating every other number. Small
+    # non-dividing leaves replicate, so "describe" = within tolerance,
+    # and genuinely ~1/tp of the model.
+    dev0 = pol2.devices()[0]
+    actual = 0
+    for leaf in jax.tree_util.tree_leaves(two.params):
+        for sh in leaf.addressable_shards:
+            if sh.device == dev0:
+                actual += sh.data.nbytes
+    total = weight_bytes(cfg, False)
+    planned = total / TP
+    out["multichip_weight_shard_ratio"] = round(actual / total, 4)
+    out["multichip_planner_weight_err"] = round(
+        abs(actual - planned) / planned, 4)
+    if out["multichip_weight_shard_ratio"] > 0.75:
+        violations.append(
+            f"multichip: tp={TP} leaves "
+            f"{out['multichip_weight_shard_ratio']:.0%} of the weights on "
+            "one chip (gate 75%) — the engine is not actually sharding")
+    if out["multichip_planner_weight_err"] > 0.30:
+        violations.append(
+            f"multichip: planner per-chip weight pricing is off by "
+            f"{out['multichip_planner_weight_err']:.0%} vs resident bytes "
+            "(gate 30%) — the feasibility gate no longer describes the "
+            "real layout")
+    hbm = pol2.hbm_used_gb_per_chip()
+    if hbm > 0.0:       # real backend memory stats (TPU); 0.0 on CPU
+        out["multichip_hbm_used_gb_per_chip"] = hbm
+
+    import random as _random
+    rng = _random.Random(13)
+    prompts = [[rng.randrange(1, 400) for _ in range(24)]
+               for _ in range(s["requests"])]
+
+    async def one_pass(eng):
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(*[
+            eng.generate(list(p), max_new_tokens=s["max_new"])
+            for p in prompts])
+        return sum(len(o) for o in outs) / (time.perf_counter() - t0), outs
+
+    async def run():
+        await one.start()
+        await two.start()
+        for eng in (one, two):       # untimed admission/graph warm pass
+            await asyncio.gather(*[
+                eng.generate(list(p), max_new_tokens=8) for p in prompts])
+        ones_t, twos_t = [], []
+        outs_one = outs_two = None
+        for _ in range(s["passes"]):
+            tps_one, outs_one = await one_pass(one)
+            tps_two, outs_two = await one_pass(two)
+            ones_t.append(tps_one)
+            twos_t.append(tps_two)
+        await one.stop()
+        await two.stop()
+        return ones_t, twos_t, outs_one, outs_two
+
+    ones_t, twos_t, outs_one, outs_two = asyncio.run(run())
+    tps_one = statistics.median(ones_t)
+    tps_two = statistics.median(twos_t)
+    out["multichip_tokens_per_sec_1chip"] = round(tps_one, 1)
+    out["multichip_tokens_per_sec_tp2"] = round(tps_two, 1)
+    out["multichip_total_ratio"] = round(tps_two / tps_one, 4)
+    out["multichip_per_chip_ratio"] = round(tps_two / TP / tps_one, 4)
+    if tpu and out["multichip_per_chip_ratio"] < 0.35:
+        violations.append(
+            f"multichip: per-chip tokens/sec ratio "
+            f"{out['multichip_per_chip_ratio']} < 0.35 on a real slice — "
+            "the sharding tax ate the submesh")
+    if not tpu and out["multichip_total_ratio"] < 0.2:
+        violations.append(
+            f"multichip: tp={TP} total throughput is "
+            f"{out['multichip_total_ratio']}x the 1-chip engine — below "
+            "the CPU catastrophe floor 0.2 (virtual devices share the "
+            "host's cores; per-chip economics only exist on real silicon)")
+
+    # -- parity judge (HARD gate) -----------------------------------------
+    # token-for-token at f32; at each stream's first fork the sharded
+    # engine's token must be within the oracle-argmax margin (sharded
+    # psum reassociation), else it is a layout/table bug, not noise
+    MARGIN = 0.35
+    first_div = None
+    margin_max = 0.0
+    for a, b, p in zip(outs_one, outs_two, prompts):
+        if len(a) != len(b):
+            violations.append("multichip: output LENGTHS diverge")
+            continue
+        i = next((i for i, (x, y) in enumerate(zip(a, b)) if x != y), None)
+        if i is None:
+            continue
+        first_div = i if first_div is None else min(first_div, i)
+        logits = decoder_forward(
+            params, jnp.asarray([list(p) + b[:i]], jnp.int32), cfg)[0, -1]
+        margin = float(jnp.max(logits) - logits[b[i]])
+        margin_max = max(margin_max, margin)
+        if margin > MARGIN:
+            violations.append(
+                f"multichip: stream forks at token {i} and the sharded "
+                f"token is {margin:.3f} below the oracle argmax (gate "
+                f"{MARGIN}) — sharded KV/table bug, not reassociation")
+    out["multichip_parity_first_divergence"] = (
+        -1 if first_div is None else first_div)
+    out["multichip_oracle_margin_max"] = round(margin_max, 4)
+
+    # -- per-chip decode physics under sharding ---------------------------
+    # streamed weights, KV traffic and matmul FLOPs all divide across the
+    # submesh (tp shards both weight matrices and the KV head axis), so
+    # the per-CHIP ceiling ratio is the honest utilization figure
+    counts = decode_byte_counts(two.params, cfg, s["batch"],
+                                24 + s["max_new"] // 2)
+    total_tokens = s["requests"] * s["max_new"] * 1.0
+    steps = total_tokens / s["batch"]
+    step_ms = (total_tokens / tps_two) / max(steps, 1e-9) * 1e3
+    phys = decode_physics(
+        step_ms=step_ms, batch=s["batch"],
+        streamed_bytes=counts["streamed_bytes"] // TP,
+        kv_bytes_per_step=counts["kv_bytes_per_step"] // TP,
+        matmul_params=counts["matmul_params"] // TP,
+        attn_flops_per_step=counts["attn_flops_per_step"] / TP,
+        spec=chip_spec(jax.devices()[0].device_kind))
+    out["multichip_physics"] = phys
+    out["multichip_engine_mbu"] = phys.get("mbu")
+    out["multichip_engine_mfu"] = phys.get("mfu")
+
+    out["violations"] = violations
+    out["valid"] = not violations
+    return out
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
@@ -1928,7 +2160,7 @@ def _run_phase(phase: str, quick: bool, cpu: bool) -> dict:
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", phase]
     if quick:
         cmd.append("--quick")
-    if cpu or phase in ("router", "spec", "quant", "obs") \
+    if cpu or phase in ("router", "spec", "quant", "obs", "multichip") \
             or (phase.startswith("coldstart") and phase != "coldstart_jax_tpu"):
         # the serving stack and its runner children must never dial the chip
         # — ALL cold-start stack phases, not just the original one (round-3
@@ -2192,6 +2424,14 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
                        "quant_tokens_per_sec_ratio",
                        "quant_tokens_per_sec_on",
                        "quant_tokens_per_sec_off")),
+            ("multichip", ("multichip_tokens_per_sec_1chip",
+                           "multichip_tokens_per_sec_tp2",
+                           "multichip_total_ratio",
+                           "multichip_per_chip_ratio",
+                           "multichip_weight_shard_ratio",
+                           "multichip_planner_weight_err",
+                           "multichip_engine_mbu",
+                           "multichip_engine_mfu")),
             ("obs", ("obs_tokens_per_sec_ratio",
                      "obs_tokens_per_sec_on",
                      "obs_tokens_per_sec_off",
@@ -2270,6 +2510,12 @@ _COMPACT_KEYS = (
     "quant_tokens_per_sec_ratio", "quant_tokens_per_sec_on",
     "quant_tokens_per_sec_off", "quant_parity_first_divergence",
     "quant_oracle_margin_max",
+    "multichip_tokens_per_sec_1chip", "multichip_tokens_per_sec_tp2",
+    "multichip_total_ratio", "multichip_per_chip_ratio",
+    "multichip_weight_shard_ratio", "multichip_planner_weight_err",
+    "multichip_plan_llama3_8b_v5e", "multichip_topology",
+    "multichip_parity_first_divergence", "multichip_oracle_margin_max",
+    "multichip_engine_mbu", "multichip_engine_mfu",
     "tpu_snapshot_file", "tpu_snapshot_captured_at",
     "tpu_snapshot_engine_tokens_per_sec_per_chip",
     "tpu_snapshot_endpoint_tokens_per_sec_per_chip",
@@ -2339,7 +2585,7 @@ def main() -> None:
                     choices=["llm", "llm_endpoint", "kernels", "coldstart",
                              "coldstart_native", "coldstart_jax",
                              "coldstart_jax_tpu", "coldstart_stream",
-                             "router", "spec", "quant", "obs"],
+                             "router", "spec", "quant", "obs", "multichip"],
                     help="run one phase in-process (used by the orchestrator)")
     args = ap.parse_args()
 
@@ -2363,7 +2609,8 @@ def main() -> None:
               "coldstart_jax_tpu": bench_cold_start_jax_tpu,
               "coldstart_stream": bench_cold_start_stream,
               "router": bench_router, "spec": bench_spec,
-              "quant": bench_quant, "obs": bench_obs}[args.phase]
+              "quant": bench_quant, "obs": bench_obs,
+              "multichip": bench_multichip}[args.phase]
         try:
             print(json.dumps(fn(quick=args.quick)))
         except Exception as exc:   # noqa: BLE001 — phase errors are data

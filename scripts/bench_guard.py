@@ -58,6 +58,14 @@ GUARDED_FIELDS = {
     "quant_kv_capacity_ratio": "up",
     "quant_tokens_per_sec_ratio": "up",
     "quant_tokens_per_sec_on": "up",
+    # mesh-sharded serving (ISSUE 9): the sharded engine must not slow
+    # down across rounds, the per-chip weight shard must stay ~1/tp (a
+    # creep toward 1.0 means placement stopped sharding), and the planner
+    # pricing must keep describing the resident layout
+    "multichip_tokens_per_sec_tp2": "up",
+    "multichip_total_ratio": "up",
+    "multichip_weight_shard_ratio": "down",
+    "multichip_planner_weight_err": "down",
     # observability overhead (ISSUE 8): the deterministic instrumentation
     # price (microbenched hook cost × measured window/request rates) must
     # not creep. The wall-clock on/off ratio and the decomposition
@@ -74,7 +82,10 @@ GUARDED_FIELDS = {
 # round missing them IS the failure signal and must fail the guard, not
 # silently lose coverage.
 HARD_FIELDS = ("quant_shard_bytes_ratio", "quant_kv_capacity_ratio",
-               "quant_tokens_per_sec_ratio", "obs_overhead_frac")
+               "quant_tokens_per_sec_ratio", "obs_overhead_frac",
+               # the multichip phase's parity judge / planner checks strip
+               # these on failure — a vanished value IS the regression
+               "multichip_weight_shard_ratio", "multichip_total_ratio")
 
 
 def extract_metrics(path: str) -> dict:

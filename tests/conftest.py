@@ -29,7 +29,28 @@ import pytest  # noqa: E402
 def pytest_collection_modifyitems(config, items):
     """Suite tiers (VERDICT r04 #8): the slowest tests are opt-in so the
     default per-commit run stays well under 5 minutes. TPU9_FULL_SUITE=1
-    (CI / pre-round final run) or an explicit ``-m slow`` runs everything."""
+    (CI / pre-round final run) or an explicit ``-m slow`` runs everything.
+
+    ``multichip``-marked tests (ISSUE 9) additionally require the forced
+    8-device CPU mesh the module-top ``force_cpu(host_devices=8)`` sets
+    up. That forcing is a no-op when the caller already pinned
+    ``xla_force_host_platform_device_count`` in XLA_FLAGS (env mutation
+    after jax latches the flag is too late to re-force), so rather than
+    fail 8-device meshes against 1 device, skip LOUDLY with the re-run
+    recipe — a silent pass here would claim multichip coverage we did
+    not run."""
+    if any("multichip" in item.keywords for item in items):
+        import jax
+        n = jax.device_count()
+        if n < 8:
+            skip_mc = pytest.mark.skip(
+                reason=f"multichip tier needs 8 virtual devices, have {n}"
+                       " — re-run with XLA_FLAGS="
+                       "--xla_force_host_platform_device_count=8 (or unset"
+                       " XLA_FLAGS and let conftest force it)")
+            for item in items:
+                if "multichip" in item.keywords:
+                    item.add_marker(skip_mc)
     if os.environ.get("TPU9_FULL_SUITE") == "1" or config.getoption("-m"):
         # an explicit -m expression means the user took marker control —
         # let IT decide (a substring check would silently skip slow tests

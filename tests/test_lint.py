@@ -614,10 +614,18 @@ def test_boundaries_toml_matches_real_import_graph():
                 or any(target == a or target.startswith(a + ".")
                        for a in allowed))
 
-    # 1) every [allow] contract holds against reality
+    # 1) every [allow] contract holds against reality. Most specific key
+    # wins, mirroring the checker: a module governed by a deeper allow
+    # key (tpu9.serving.shard under tpu9.serving — the ONE serving
+    # subtree allowed to reach tpu9.parallel) answers to that contract
+    # alone, not to every enclosing one.
     for pkg, allowed in cfg.allow.items():
         for mod, targets in edges.items():
             if not (mod == pkg or mod.startswith(pkg + ".")):
+                continue
+            if any(k != pkg and len(k) > len(pkg)
+                   and (mod == k or mod.startswith(k + "."))
+                   for k in cfg.allow):
                 continue
             for t in targets:
                 assert covered(t, allowed, pkg), \

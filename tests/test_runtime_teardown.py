@@ -1,0 +1,112 @@
+"""Runtime teardown ordering (ISSUE 9 satellite — the coldstart_native
+container-teardown flake).
+
+``NativeRuntime.run`` spawns a reap task that performs the real teardown
+(close proxies, tear down the netns, unmount the overlay) after the
+container process exits. ``wait()`` used to return at ``proc.wait()`` —
+BEFORE that teardown — so the lifecycle marked the container stopped while
+the unmount was still in flight, and a scale-down that then deleted or
+re-mounted the same image bundle (exactly what the coldstart_native bench
+does between trials) raced it. ``wait()`` must now return only after the
+registered reap task has fully finished.
+
+No root needed: these tests inject a real (trivial) subprocess plus a
+controlled reap task, exercising the wait()/waiter contract directly.
+"""
+
+import asyncio
+
+from tpu9.runtime import NativeRuntime
+from tpu9.utils.aio import spawn
+
+
+async def _spawn_true() -> asyncio.subprocess.Process:
+    return await asyncio.create_subprocess_exec(
+        "true", stdout=asyncio.subprocess.DEVNULL,
+        stderr=asyncio.subprocess.DEVNULL)
+
+
+async def test_wait_returns_only_after_reap_teardown(tmp_path):
+    rt = NativeRuntime(base_dir=str(tmp_path))
+    cid = "nat-teardown-order"
+    proc = await _spawn_true()
+    rt._procs[cid] = proc
+
+    teardown_done = asyncio.Event()
+
+    async def reap():
+        await proc.wait()
+        # simulated slow unmount: the window the flake lived in — the
+        # process is dead (wait() used to return HERE) but the overlay
+        # teardown is still running
+        await asyncio.sleep(0.2)
+        teardown_done.set()
+
+    rt._waiters[cid] = spawn(reap(), name="test-reap")
+    code = await rt.wait(cid)
+    assert code == 0
+    assert teardown_done.is_set(), (
+        "wait() returned before the reap task finished its teardown — "
+        "callers that delete the image bundle on wait() race the unmount")
+
+
+async def test_cancelled_waiter_does_not_cancel_shared_reap(tmp_path):
+    """The reap is shared by every wait() caller and owns the terminal
+    teardown: cancelling one caller must not cancel it (the
+    ProcessRuntime.wait precedent — a cancelled bare `await reap` strands
+    the teardown half-finished)."""
+    rt = NativeRuntime(base_dir=str(tmp_path))
+    cid = "nat-teardown-cancel"
+    proc = await _spawn_true()
+    rt._procs[cid] = proc
+
+    release = asyncio.Event()
+    teardown_done = asyncio.Event()
+
+    async def reap():
+        await proc.wait()
+        await release.wait()
+        teardown_done.set()
+
+    reap_task = spawn(reap(), name="test-reap-cancel")
+    rt._waiters[cid] = reap_task
+
+    waiter = asyncio.ensure_future(rt.wait(cid))
+    await asyncio.sleep(0.05)       # caller parked on the reap
+    waiter.cancel()
+    try:
+        await waiter
+    except asyncio.CancelledError:
+        pass
+    assert not reap_task.cancelled()
+    release.set()
+    await asyncio.wait_for(reap_task, 5)
+    assert teardown_done.is_set()
+
+    # a second caller still observes the completed teardown + exit code
+    assert await rt.wait(cid) == 0
+
+
+async def test_wait_survives_crashed_reap_and_logs(tmp_path, caplog):
+    """A reap that CRASHES mid-teardown must be LOGGED but must not break
+    wait()'s exit-code contract: lifecycle._supervise does its container
+    bookkeeping + tpu.release unconditionally after wait() returns, and
+    an exception here would skip both — leaking the chip reservation
+    forever (worse than a half-torn netns, which the next gc sweeps)."""
+    import logging
+
+    rt = NativeRuntime(base_dir=str(tmp_path))
+    cid = "nat-teardown-crash"
+    proc = await _spawn_true()
+    rt._procs[cid] = proc
+
+    async def reap():
+        await proc.wait()
+        raise RuntimeError("umount exploded")
+
+    rt._waiters[cid] = spawn(reap(), name="test-reap-crash")
+    with caplog.at_level(logging.WARNING, logger="tpu9.runtime"):
+        code = await rt.wait(cid)
+    assert code == 0
+    assert any("umount exploded" in r.getMessage() for r in caplog.records), \
+        "crashed reap was silently absorbed without a log line"

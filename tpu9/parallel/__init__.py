@@ -10,7 +10,7 @@ ride ICI via XLA.
 
 from .mesh import make_mesh, make_named_mesh, mesh_for_spec, MeshAxes
 from .sharding import (decoder_param_specs, fsdp_specs, shard_params,
-                       constrain, replicate_specs)
+                       constrain, replicate_specs, fit_spec)
 from .ring import ring_attention
 from .pipeline import pipeline_forward, stack_layers, stage_specs
 from .distributed import multihost_env, initialize_multihost
@@ -19,4 +19,5 @@ __all__ = ["make_mesh", "make_named_mesh", "mesh_for_spec", "MeshAxes",
            "pipeline_forward", "stack_layers", "stage_specs",
            "decoder_param_specs",
            "fsdp_specs", "shard_params", "constrain", "replicate_specs",
+           "fit_spec",
            "ring_attention", "multihost_env", "initialize_multihost"]

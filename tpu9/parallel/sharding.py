@@ -108,17 +108,22 @@ def shard_params(params: Params, mesh: Mesh, specs: Params) -> Params:
     def place(x, spec):
         if not hasattr(x, "shape"):
             return x
-        fixed = _fit_spec(x, spec, mesh)
+        fixed = fit_spec(x.shape, spec, mesh)
         return jax.device_put(x, NamedSharding(mesh, fixed))
 
     return jax.tree_util.tree_map(place, params, specs,
                                   is_leaf=lambda x: isinstance(x, P))
 
 
-def _fit_spec(x, spec: P, mesh: Mesh) -> P:
+def fit_spec(shape, spec: P, mesh: Mesh) -> P:
+    """Drop spec axes whose mesh size does not divide the dim (replicate
+    that dim instead) — the divisibility fallback ``shard_params`` applies,
+    exposed for callers that build NamedShardings directly (the serving
+    sharding policy sizes KV pools with it)."""
+    ndim = len(shape)
     dims = []
     for i, axis in enumerate(spec):
-        if axis is None or i >= x.ndim:
+        if axis is None or i >= ndim:
             dims.append(None)
             continue
         if isinstance(axis, str):
@@ -133,10 +138,15 @@ def _fit_spec(x, spec: P, mesh: Mesh) -> P:
                 size *= mesh.shape[a]
         else:
             size = 1
-        dims.append(axis if x.shape[i] % size == 0 else None)
-    while len(dims) < x.ndim:
+        dims.append(axis if shape[i] % size == 0 else None)
+    while len(dims) < ndim:
         dims.append(None)
     return P(*dims)
+
+
+# back-compat private alias (array-taking form)
+def _fit_spec(x, spec: P, mesh: Mesh) -> P:
+    return fit_spec(x.shape, spec, mesh)
 
 
 def constrain(x: jnp.ndarray, spec: P) -> jnp.ndarray:

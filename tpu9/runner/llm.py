@@ -262,7 +262,12 @@ async def amain() -> None:
                               # the router aggregates these into the
                               # fleet-wide tpu9_router_spec_* signals
                               "spec_proposed", "spec_accepted",
-                              "spec_acceptance_rate"):
+                              "spec_acceptance_rate",
+                              # serving submesh (ISSUE 9): which topology
+                              # this replica runs and its worst-chip live
+                              # HBM — the fleet view's multichip evidence
+                              "topo_tp", "topo_fsdp", "topo_n_chips",
+                              "hbm_used_gb_per_chip"):
                         if k in stats:
                             extra[k] = stats[k]
                     pc = stats.get("prefix_cache")

@@ -115,6 +115,12 @@ class NativeRuntime(Runtime):
         self._specs: dict[str, ContainerSpec] = {}
         self._log_tasks: dict[str, list[asyncio.Task]] = {}
         self._proxies: dict[str, list[asyncio.base_events.Server]] = {}
+        # reap tasks by container: wait() awaits the FULL teardown
+        # (proxies closed, netns gone, overlay unmounted), not just the
+        # process exit — callers that mark a container stopped on wait()
+        # (lifecycle._supervise → scale-down/bench teardown) must not
+        # race the unmount of a bundle they are about to delete
+        self._waiters: dict[str, asyncio.Task] = {}
         self._slots: dict[str, int] = {}      # container -> /30 slot index
         self._ifnames: dict[str, str] = {}    # container -> host veth name
 
@@ -465,8 +471,13 @@ class NativeRuntime(Runtime):
                                     spec.container_id)
 
         # spawn: strong ref (a GC'd reap would leak the netns/veth/overlay
-        # of a dead container) + crash logging
-        spawn(reap(), name=f"native-reap-{spec.container_id[-8:]}")
+        # of a dead container) + crash logging; also registered as the
+        # container's waiter so wait() returns only after the teardown
+        # (coldstart_native flake: scale-down marked the container gone
+        # while this task was still unmounting the overlay, and the next
+        # trial's rmtree/mount of the same bundle raced it)
+        self._waiters[spec.container_id] = spawn(
+            reap(), name=f"native-reap-{spec.container_id[-8:]}")
         return handle
 
     async def _close_proxies(self, container_id: str) -> None:
@@ -524,7 +535,29 @@ class NativeRuntime(Runtime):
             handle = self._handles.get(container_id)
             return (handle.exit_code if handle
                     and handle.exit_code is not None else -1)
-        return await proc.wait()
+        code = await proc.wait()
+        waiter = self._waiters.get(container_id)
+        if waiter:
+            # await the reap's FULL teardown (proxies/netns/overlay), not
+            # just the exit: callers (lifecycle._supervise) mark the
+            # container stopped when wait() returns, and a scale-down that
+            # then deletes/re-mounts the image bundle must not race the
+            # in-flight lazy umount (the coldstart_native teardown flake).
+            # shield: the reap is shared by every wait() caller and owns
+            # the teardown — one caller's cancel must not cancel it
+            # (ProcessRuntime.wait precedent). gather (ASY003): our cancel
+            # still reaches the caller. A CRASHED teardown is logged, not
+            # raised: wait()'s contract is the exit code, and the primary
+            # caller (lifecycle._supervise) does its container bookkeeping
+            # + tpu.release unconditionally after wait() returns — an
+            # exception here would leak the chip reservation forever.
+            res = (await asyncio.gather(asyncio.shield(waiter),
+                                        return_exceptions=True))[0]
+            if (isinstance(res, BaseException)
+                    and not isinstance(res, asyncio.CancelledError)):
+                log.warning("container %s teardown failed after exit %s: %s",
+                            container_id, code, res)
+        return code
 
     def _nsenter(self, container_id: str) -> Optional[list[str]]:
         pid = self._container_pid(container_id)
@@ -576,6 +609,7 @@ class NativeRuntime(Runtime):
         self._procs.pop(container_id, None)
         self._handles.pop(container_id, None)
         self._specs.pop(container_id, None)
+        self._waiters.pop(container_id, None)
         for t in self._log_tasks.pop(container_id, []):
             t.cancel()
         if remove_sandbox:

@@ -13,6 +13,20 @@ bound compile count), then join the decode batch at their slot index. This is
 the same admission shape the reference's LLM-aware pod router assumes
 (``pkg/abstractions/pod/llm.go`` token-pressure/active-streams), which the
 gateway reads from the engine's ``stats()``.
+
+Decomposition (ISSUE 9): this module is the serve LOOP — admission,
+window dispatch/fan-out, request lifecycle, observability. The three
+split-off responsibilities live next door with an explicit boundary
+(BND001 contracts in ``tpu9/analysis/boundaries.toml``):
+
+- :mod:`tpu9.serving.graphs`   — every traced/compiled XLA computation
+- :mod:`tpu9.serving.schedule` — window-size / spec-gate decisions
+- :mod:`tpu9.serving.kvpool`   — paged-pool sizing + block bookkeeping
+- :mod:`tpu9.serving.shard`    — the sharding POLICY all device placement
+  goes through: ``topology 1x1`` is the identity (this engine, verbatim,
+  bit-identical graphs); ``tp×fsdp`` shards weights and the KV pool's
+  head axis across a submesh while everything host-side here stays
+  topology-oblivious (block ids are global; only resident layout shards).
 """
 
 from __future__ import annotations
@@ -26,13 +40,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.transformer import (DecoderConfig, decoder_forward,
-                                  init_kv_cache)
+from ..models.transformer import DecoderConfig, init_kv_cache
 from ..observability.metrics import Metrics
 from ..observability.trace import tracer
 from ..ops.sampling import sample_logits
 from ..utils.aio import reap
 from .flight import maybe as flight_maybe
+from .graphs import GraphFactory
+from .schedule import WindowScheduler
 
 Params = dict[str, Any]
 
@@ -176,10 +191,41 @@ class InferenceEngine:
     """Continuous-batching engine around a decoder model."""
 
     def __init__(self, params: Params, cfg: DecoderConfig,
-                 engine_cfg: EngineConfig = EngineConfig()):
-        self.params = params
+                 engine_cfg: EngineConfig = EngineConfig(),
+                 policy=None):
         self.cfg = cfg
         self.ecfg = engine_cfg
+        # sharding policy (ISSUE 9): ALL device placement below routes
+        # through it. None → the single-device identity policy, which
+        # makes this constructor byte-for-byte the pre-split engine.
+        if policy is None:
+            from .shard.policy import SingleDevicePolicy
+            policy = SingleDevicePolicy()
+        self.policy = policy
+        # weights route through the policy HERE, not just in load_engine —
+        # a mesh engine handed raw host params would otherwise serve
+        # replicated weights (all the HBM, none of the sharding) the first
+        # time XLA implicitly places them. Identity for 1x1; a no-op
+        # device_put for already-placed trees. Compile-ahead constructs
+        # with abstract ShapeDtypeStruct trees that cannot be placed —
+        # bind_params places the real arrays later.
+        leaves = jax.tree_util.tree_leaves(params)
+        if leaves and not any(isinstance(x, jax.ShapeDtypeStruct)
+                              for x in leaves):
+            params = policy.place_params(params)
+        self.params = params
+        topo = policy.describe()
+        if topo["tp"] > 1 and cfg.n_kv_heads % topo["tp"]:
+            # fit_spec would silently REPLICATE the KV head axis (all the
+            # HBM cost, none of the capacity win) while feasibility priced
+            # the gcd shard — the exact OOM the deploy gate exists to
+            # prevent. The planner only emits dividing tp; an explicit
+            # override that doesn't divide must fail loudly at bind time.
+            raise ValueError(
+                f"topology tp={topo['tp']} does not divide n_kv_heads="
+                f"{cfg.n_kv_heads} — the paged-KV head axis cannot shard "
+                "evenly. Use a tp that divides the KV heads (put excess "
+                "chips on fsdp, e.g. 'tp=2,fsdp=2') or topology='auto'")
         b, s = engine_cfg.max_batch, engine_cfg.max_seq_len
         self.paged = engine_cfg.kv_block_size > 0
         from ..ops.quant import validate_quant_mode
@@ -195,7 +241,7 @@ class InferenceEngine:
             raise ValueError("kv_quant='int8' requires the paged engine "
                              "(kv_block_size > 0)")
         if self.paged:
-            from .paged_kv import BlockAllocator, PrefixCache
+            from .kvpool import KvPool
             bs = engine_cfg.kv_block_size
             if s % bs:
                 raise ValueError(f"max_seq_len {s} % kv_block_size {bs}")
@@ -217,67 +263,32 @@ class InferenceEngine:
                     f"max_seq_len {s} must be a multiple of "
                     f"prefill_chunk {chunk}")
             self._chunk = chunk     # the validated value IS the used value
-            if engine_cfg.kv_pool_blocks:
-                base_blocks = engine_cfg.kv_pool_blocks
-            else:
-                base_blocks = b * s // bs            # dense parity
-                if self.kv_quant:
-                    # equal-HBM sizing: the int8 pool spends the same
-                    # bytes the bf16 pool would have — ~2x the blocks,
-                    # which is the whole point (capacity == admission
-                    # headroom == the router's kv_blocks signal)
-                    from .paged_kv import kv_block_bytes
-                    base_blocks = (base_blocks
-                                   * kv_block_bytes(cfg, bs, False)
-                                   // kv_block_bytes(cfg, bs, True))
-            # +1: one dedicated TRASH block absorbs splice writes of the
-            # padded tail of a non-block-aligned final chunk
-            n_blocks = base_blocks + 1
-            # table width: +1 ALWAYS-TRASH column — a decode write at
-            # position S (cache full; callers should bound it, but a
-            # regression must not corrupt data) computes pos // bs == S/bs
-            # which would otherwise CLAMP onto the last real block and
-            # overwrite valid KV; the extra column absorbs it harmlessly
-            # (attention masks by cache_len, so it is never read)
-            self._mb = s // bs + 1                  # table width
-            pool_shape = (cfg.n_layers, n_blocks, bs, cfg.n_kv_heads,
-                          cfg.head_dim)
-            self.kv_cache = {
-                "k": jnp.zeros(pool_shape,
-                               jnp.int8 if self.kv_quant else cfg.dtype),
-                "v": jnp.zeros(pool_shape,
-                               jnp.int8 if self.kv_quant else cfg.dtype),
-                "table": jnp.zeros((b, self._mb), jnp.int32),
-            }
-            if self.kv_quant:
-                # per-(position, head) f32 absmax scales alongside the
-                # pool (ops.quant.quantize_kv) — same [N, BS, KH] indexing
-                # as the payload so every write/read shares the table math
-                sc_shape = pool_shape[:-1]
-                self.kv_cache["k_scale"] = jnp.zeros(sc_shape, jnp.float32)
-                self.kv_cache["v_scale"] = jnp.zeros(sc_shape, jnp.float32)
-            self.allocator = BlockAllocator(n_blocks, bs)
-            self._trash_block = self.allocator.alloc(1)[0]
-            # inactive decode lanes scatter through their (zero-padded)
-            # table rows every step — _push_table pads rows with the trash
-            # block explicitly, but the freshly-zeroed initial table relies
-            # on the trash block being physical block 0
-            assert self._trash_block == 0, self._trash_block
-            # the trash block is held forever — reservations must not
-            # count on it
-            self.allocator.reserve_capacity = n_blocks - 1
-            self.prefix_cache = PrefixCache(
-                self.allocator, engine_cfg.prefix_cache_blocks)
-            self._slot_blocks: list[list[int]] = [[] for _ in range(b)]
-            self._slot_reserved = [0] * b
-            self._table_np = np.zeros((b, self._mb), dtype=np.int32)
+            # pool sizing + trash-block + slot/block bookkeeping: the
+            # split-off KV-pool manager (serving.kvpool). The aliases
+            # below are the SAME objects, kept so the admission/retire
+            # paths (and tests/bench) read the state where it always was.
+            self.pool = KvPool(cfg, engine_cfg, self.kv_quant, policy)
+            self.kv_cache = self.pool.init_arrays()
+            self.allocator = self.pool.allocator
+            self.prefix_cache = self.pool.prefix_cache
+            self._slot_blocks = self.pool.slot_blocks
+            self._slot_reserved = self.pool.slot_reserved
+            self._table_np = self.pool.table_np
+            self._trash_block = self.pool.trash_block
+            self._mb = self.pool.mb
             # batch-1 dense scratch the chunked prefill writes through
             # before splicing into pool blocks — ONE lane, not B of them
-            self._scratch = init_kv_cache(cfg, 1, s)
+            self._scratch = policy.place_kv(init_kv_cache(cfg, 1, s))
         else:
-            self.kv_cache = init_kv_cache(cfg, b, s)
+            self.pool = None
+            self.kv_cache = policy.place_kv(init_kv_cache(cfg, b, s))
             self.allocator = None
             self.prefix_cache = None
+        # every traced/compiled graph lives in the factory (serving.graphs)
+        self.graphs = GraphFactory(cfg, engine_cfg, policy,
+                                   chunk=self._chunk if self.paged else 0,
+                                   kv_quant=self.kv_quant)
+        self.scheduler = WindowScheduler(self)
         self._buckets = sorted({min(bk, s)
                                 for bk in engine_cfg.prefill_buckets})
         self.cache_len = jnp.zeros((b,), jnp.int32)     # valid prefix per slot
@@ -293,7 +304,9 @@ class InferenceEngine:
         # keeps it empty (shared so failure fan-out/cancel need no mode
         # branches)
         self._wait_room: list[_Request] = []
-        self._compiled: dict[Any, Any] = {}
+        # the compiled-graph cache lives in the factory; alias for the
+        # bench/diagnostic surface that predates the split
+        self._compiled = self.graphs.compiled
         self._host_len = np.zeros((b,), dtype=np.int64)  # host mirror of
         # cache_len — the loop must not pay a device round-trip to know room
         # windows dispatched but not yet host-processed (_Window records):
@@ -326,8 +339,8 @@ class InferenceEngine:
         # would mix engines when two live in one process (bench A/B).
         self.metrics = Metrics()
         self._pick_reason = ""
-        self._kv_allocs = 0          # lifetime block allocations
         self._flight_kv_allocs = 0   # marker for per-record deltas
+        # (lifetime allocation counter lives on the KvPool manager)
         self._flight_evictions = 0
         # on-demand jax.profiler hook (/rpc/llm/profile): armed for the
         # next N windows, started/stopped at window boundaries
@@ -336,221 +349,46 @@ class InferenceEngine:
         self._profile_path = ""
         self._profile_error = ""
 
-    # -- compiled steps ------------------------------------------------------
-
-    def _build_decode(self, k: int = 1):
-        cfg, ecfg = self.cfg, self.ecfg
-
-        def one_step(params, kv_cache, last_token, cache_len, active, rng):
-            positions = cache_len[:, None]              # next position per slot
-            logits, kv_cache = decoder_forward(
-                params, last_token, cfg, positions=positions,
-                kv_cache=kv_cache, cache_len=cache_len + 1, decode=True)
-            rng, sub = jax.random.split(rng)
-            next_tok = sample_logits(logits[:, -1], sub,
-                                     temperature=ecfg.temperature,
-                                     top_k=ecfg.top_k, top_p=ecfg.top_p)
-            # only live slots advance; idle lanes stay parked at 0 so the
-            # token-pressure signal reflects real cache occupancy
-            new_len = cache_len + active.astype(jnp.int32)
-            return next_tok[:, None].astype(jnp.int32), kv_cache, new_len, rng
-
-        def decode(params, kv_cache, last_token, cache_len, active, rng):
-            def body(carry, _):
-                last, kv, clen, r = carry
-                last, kv, clen, r = one_step(params, kv, last, clen,
-                                             active, r)
-                return (last, kv, clen, r), last[:, 0]
-
-            (last, kv_cache, cache_len, rng), toks = jax.lax.scan(
-                body, (last_token, kv_cache, cache_len, rng), None,
-                length=k)
-            # toks [k, B]: the host consumes the whole window in one sync
-            return last, kv_cache, cache_len, rng, toks
-
-        return jax.jit(decode, donate_argnums=(1,))
+    # -- compiled steps (serving.graphs) + scheduling (serving.schedule) ----
+    # Thin delegates: the implementations moved out with the ISSUE 9
+    # engine split; these names are the engine's stable internal surface
+    # (bench and the spec/paged tests exercise them directly).
 
     def _decode_k(self, k: int):
-        key = ("decode", k)
-        fn = self._compiled.get(key)
-        if fn is None:
-            fn = self._compiled[key] = self._build_decode(k)
-        return fn
-
-    def _build_verify(self, s: int):
-        """Jitted speculative-verify graph (ISSUE 5 tentpole): ONE batched
-        forward over ``[B, 1+s]`` positions — column 0 is the device
-        last_token, columns 1..s the host-proposed draft tokens. The model
-        emits its OWN token at every position; a draft survives only while
-        it equals the model's output, so the emitted stream is exactly
-        what classic decode would have produced (greedy parity is
-        bit-exact — drafts can only be cheap, never wrong). Per slot the
-        graph returns the accepted-prefix length and the model's bonus
-        token, and advances cache_len past accepted positions only —
-        rejected draft positions keep garbage KV that attention masks out
-        and the next window overwrites (paged re-splice / dense
-        re-scatter)."""
-        cfg, ecfg = self.cfg, self.ecfg
-        t = s + 1
-
-        def verify(params, kv_cache, last_token, drafts, cache_len,
-                   active, rng):
-            tokens = jnp.concatenate(
-                [last_token, drafts.astype(jnp.int32)], axis=1)  # [B, t]
-            positions = cache_len[:, None] + jnp.arange(t)[None, :]
-            logits, kv_cache = decoder_forward(
-                params, tokens, cfg, positions=positions,
-                kv_cache=kv_cache, cache_len=cache_len + t, decode=False)
-            rng, sub = jax.random.split(rng)
-            out = sample_logits(logits, sub, temperature=ecfg.temperature,
-                                top_k=ecfg.top_k,
-                                top_p=ecfg.top_p).astype(jnp.int32)  # [B, t]
-            # longest agreeing prefix of the drafts, per slot
-            agree = (tokens[:, 1:] == out[:, :-1]).astype(jnp.int32)
-            n_acc = jnp.cumprod(agree, axis=1).sum(axis=1)        # [B]
-            # the model's own next token after the accepted run
-            bonus = jnp.take_along_axis(out, n_acc[:, None], axis=1)
-            new_len = cache_len + (n_acc + 1) * active.astype(jnp.int32)
-            return bonus, kv_cache, new_len, rng, out, n_acc
-
-        return jax.jit(verify, donate_argnums=(1,))
+        return self.graphs.decode_k(k)
 
     def _verify_fn(self, s: int):
-        key = ("verify", s)
-        fn = self._compiled.get(key)
-        if fn is None:
-            fn = self._compiled[key] = self._build_verify(s)
-        return fn
-
-    def _admission_can_proceed(self) -> bool:
-        """True only when a waiting request could ACTUALLY be admitted
-        right now (free slot + KV room for the FIFO head) — the only case
-        where shrinking the next window to K=1 buys admission latency.
-        The old check (`not self._queue.empty()`) collapsed throughput to
-        single-step windows under saturation, when the queued head could
-        not be admitted anyway (batch full / pool exhausted) and small
-        windows bought nothing."""
-        if self.active.all():
-            return False
-        head = None
-        if self.paged and self._wait_room:
-            head = self._wait_room[0]
-        else:
-            q = getattr(self._queue, "_queue", None)    # deque peek, no pop
-            if q:
-                head = q[0]
-        return head is not None and self._room_for(head)
-
-    def _pick_steps(self) -> int:
-        """Largest decode-window bucket every active slot can absorb: no
-        slot may outrun its max_new_tokens budget past the window (tokens
-        beyond a stop are discarded host-side, so only bounded compute is
-        wasted) nor its cache room. Budget/room subtract steps already in
-        flight (the steady-state overlap window). Admission latency wins
-        when an admission could actually proceed: K=1."""
-        if self._admission_can_proceed():
-            # shrink to the smallest window so the waiting head admits
-            # sooner — the flight recorder's "why was K small" answer
-            self._pick_reason = "admission"
-            return self.ecfg.decode_steps[0]
-        limit = max(self.ecfg.decode_steps)
-        for slot in range(self.ecfg.max_batch):
-            req = self.slot_req[slot]
-            if req is None or not self.active[slot]:
-                continue
-            remaining = (req.max_new_tokens - len(req.generated)
-                         - self._inflight_steps)
-            room = (self.ecfg.max_seq_len - 1 - self._host_len[slot]
-                    - self._inflight_steps)
-            limit = min(limit, max(1, remaining), max(1, room))
-        self._pick_reason = ("max" if limit >= max(self.ecfg.decode_steps)
-                             else "budget")
-        for k in reversed(self.ecfg.decode_steps):
-            if k <= limit:
-                return k
-        return self.ecfg.decode_steps[0]
-
-    def _spec_room_len(self) -> int:
-        """Largest spec bucket the batch has ROOM for, or 0 when
-        speculation is off or structurally blocked (imminent admission,
-        cache room, exhausted budgets). Slots near their cache limit veto
-        the bucket — a dense write past max_seq_len would clamp backwards
-        over valid KV."""
-        if not self._spec_lens:
-            return 0
-        if self._admission_can_proceed():
-            return 0              # admission latency wins, as for K
-        min_room = self.ecfg.max_seq_len
-        max_remaining = 0
-        any_active = False
-        for slot in range(self.ecfg.max_batch):
-            req = self.slot_req[slot]
-            if req is None or not self.active[slot]:
-                continue
-            any_active = True
-            min_room = min(min_room,
-                           self.ecfg.max_seq_len - 1
-                           - int(self._host_len[slot])
-                           - self._inflight_steps)
-            max_remaining = max(max_remaining,
-                                req.max_new_tokens - len(req.generated)
-                                - self._inflight_steps)
-        if not any_active or max_remaining < 2:
-            return 0
-        for s in sorted(self._spec_lens, reverse=True):
-            if s + 1 <= min_room:
-                return s
-        return 0
-
-    def _spec_gate(self, s: int) -> int:
-        """Acceptance-EWMA gate: speculate only when the mean EFFECTIVE
-        acceptance over active slots clears the floor. Effective means a
-        slot with nothing to propose RIGHT NOW contributes 0 — a verify
-        window hands it ~1 token where a classic K-step window hands it
-        K, so idle proposers must drag the decision toward classic (their
-        optimistic starting EWMA must not). Below the floor speculation
-        auto-disables, except one probe window every ``spec_probe_every``
-        classic windows — which is how a stream that turns repetitive
-        later gets speculation back."""
-        total = 0.0
-        n = 0
-        for slot in range(self.ecfg.max_batch):
-            if self.slot_req[slot] is None or not self.active[slot]:
-                continue
-            n += 1
-            st = self._spec_slots[slot]
-            if st is not None and st.proposer.propose(1):
-                total += st.ewma
-        if n == 0:
-            return 0
-        mean = total / n
-        if mean >= self.ecfg.spec_min_accept:
-            self._spec_disabled_windows = 0
-            return s
-        self._spec_disabled_windows += 1
-        pe = self.ecfg.spec_probe_every
-        if pe > 0 and self._spec_disabled_windows >= pe:
-            self._spec_disabled_windows = 0
-            return s
-        return 0
+        return self.graphs.verify_fn(s)
 
     def _prefill_fn(self, bucket: int):
-        if bucket in self._compiled:
-            return self._compiled[bucket]
-        cfg = self.cfg
+        return self.graphs.prefill_fn(bucket)
 
-        def prefill(params, tokens, length):
-            # tokens [1, bucket] padded; returns logits at the last real token
-            # and the per-layer k/v for the prefix.
-            logits, cache = decoder_forward(
-                params, tokens, cfg,
-                kv_cache=init_kv_cache(cfg, 1, bucket), decode=False)
-            last = logits[0, length - 1]
-            return last, cache
+    def _dense_splice_fn(self, bucket: int):
+        return self.graphs.dense_splice_fn(bucket)
 
-        fn = jax.jit(prefill)
-        self._compiled[bucket] = fn
-        return fn
+    def _chunk_fn(self):
+        return self.graphs.chunk_fn()
+
+    def _gather_fn(self):
+        return self.graphs.gather_fn()
+
+    def _splice_fn(self):
+        return self.graphs.splice_fn()
+
+    def _chunk_group_fn(self, g: int):
+        return self.graphs.chunk_group_fn(g)
+
+    def _admission_can_proceed(self) -> bool:
+        return self.scheduler.admission_can_proceed()
+
+    def _pick_steps(self) -> int:
+        return self.scheduler.pick_steps()
+
+    def _spec_room_len(self) -> int:
+        return self.scheduler.spec_room_len()
+
+    def _spec_gate(self, s: int) -> int:
+        return self.scheduler.spec_gate(s)
 
     def _bucket_for(self, n: int) -> int:
         # buckets are CLAMPED to max_seq_len: a configured bucket wider
@@ -561,22 +399,8 @@ class InferenceEngine:
                 return b
         return self._buckets[-1]
 
-    # -- paged-KV machinery --------------------------------------------------
-
-    def _traced_chunk_step(self, params, scratch, tok_row, offset,
-                           last_idx):
-        """Traced body shared by the single-chunk and fused-group graphs
-        (one implementation — the two admission paths must never diverge):
-        prefill one C-token chunk into the scratch at ``offset`` and
-        return the logits at ``last_idx``."""
-        c = self._chunk
-        positions = offset + jnp.arange(c)[None, :]
-        logits, scratch = decoder_forward(
-            params, tok_row[None, :], self.cfg, positions=positions,
-            kv_cache=scratch, cache_len=offset + c, decode=False)
-        last = jax.lax.dynamic_index_in_dim(
-            logits[0], last_idx, axis=0, keepdims=False)
-        return last, scratch
+    # -- paged-KV machinery (graphs live in serving.graphs; block/table
+    # bookkeeping in serving.kvpool) ----------------------------------------
 
     def _pool_dict(self) -> dict:
         """The kv pool's array view (payload + scales, no table) — the
@@ -587,116 +411,6 @@ class InferenceEngine:
 
     def _set_pool(self, pool: dict) -> None:
         self.kv_cache.update(pool)
-
-    def _traced_splice(self, pool, scratch_k, scratch_v, offset, phys):
-        """Traced block copy shared by the splice and fused-group graphs:
-        scratch positions [offset, offset+C) → pool blocks phys[0..C/BS).
-        An int8 pool quantizes each block on the way in (per-vector absmax
-        scales land in the scale planes at the same physical index)."""
-        bs = self.ecfg.kv_block_size
-        pool = dict(pool)
-        for j in range(self._chunk // bs):
-            blk_k = jax.lax.dynamic_slice_in_dim(
-                scratch_k[:, 0], offset + j * bs, bs, axis=1)
-            blk_v = jax.lax.dynamic_slice_in_dim(
-                scratch_v[:, 0], offset + j * bs, bs, axis=1)
-            if "k_scale" in pool:
-                from ..ops.quant import quantize_kv
-                blk_k, sk = quantize_kv(blk_k)     # [L,bs,KH,D], [L,bs,KH]
-                blk_v, sv = quantize_kv(blk_v)
-                pool["k_scale"] = pool["k_scale"].at[:, phys[j]].set(sk)
-                pool["v_scale"] = pool["v_scale"].at[:, phys[j]].set(sv)
-            pool["k"] = pool["k"].at[:, phys[j]].set(blk_k)
-            pool["v"] = pool["v"].at[:, phys[j]].set(blk_v)
-        return pool
-
-    def _chunk_fn(self):
-        """Jitted chunked-prefill step: write one C-token chunk into the
-        batch-1 dense scratch at ``offset``, attend over prefix+chunk, and
-        return the logits at ``last_idx`` (the chunk's final real token).
-        Shapes are (C, S) — prompt length never changes the graph."""
-        key = ("chunk", self._chunk)
-        fn = self._compiled.get(key)
-        if fn is not None:
-            return fn
-
-        def chunk(params, tokens, offset, scratch, last_idx):
-            return self._traced_chunk_step(params, scratch, tokens[0],
-                                           offset, last_idx)
-
-        fn = self._compiled[key] = jax.jit(chunk, donate_argnums=(3,))
-        return fn
-
-    def _gather_fn(self):
-        """Jitted densify of ONE slot's table row into the scratch (prefix
-        reuse: cached blocks → scratch so chunk prefill can attend them).
-        An int8 pool dequantizes here — the scratch is always the model
-        dtype, so chunk prefill attends exact dequantized values."""
-        fn = self._compiled.get("gather")
-        if fn is not None:
-            return fn
-
-        s = self.ecfg.max_seq_len
-        dt = self.cfg.dtype
-
-        def gather(pool, row):
-            # pool [L, N, BS, KH, D], row [MB] → dense [L, 1, S, KH, D].
-            # The row's final column is the ALWAYS-TRASH block — slice it
-            # off so the densified prefix has the exact scratch shape
-            # (an S+BS-wide scratch trips the rope-table width validation
-            # when max_seq_len == the model's rope limit)
-            def one(p, sc):
-                g = p[:, row]                        # [L, MB, BS, KH, D]
-                if sc is not None:
-                    g = g.astype(jnp.float32) * sc[:, row][..., None]
-                l, mb, bs, kh, d = g.shape
-                return g.astype(dt).reshape(l, 1, mb * bs, kh, d)[:, :, :s]
-            return {"k": one(pool["k"], pool.get("k_scale")),
-                    "v": one(pool["v"], pool.get("v_scale"))}
-
-        fn = self._compiled["gather"] = jax.jit(gather)
-        return fn
-
-    def _splice_fn(self):
-        """Jitted copy of one chunk's blocks from the scratch into their
-        physical pool blocks. C/BS is static → one graph."""
-        fn = self._compiled.get("splice")
-        if fn is not None:
-            return fn
-
-        fn = self._compiled["splice"] = jax.jit(
-            self._traced_splice, donate_argnums=(0,))
-        return fn
-
-    def _chunk_group_fn(self, g: int):
-        """Fused admission graph (VERDICT r04 #6): lax.scan over ``g``
-        chunks — each step chunk-prefills into the scratch AND splices its
-        blocks into the pool. One dispatch replaces 2g, and the per-chunk
-        host bookkeeping (table math, array uploads) collapses into one
-        transfer of [g, ...] arrays. Returns the final chunk's last-token
-        logits so the caller can sample the first output."""
-        key = ("chunkgroup", g)
-        fn = self._compiled.get(key)
-        if fn is not None:
-            return fn
-
-        def group(params, pool, scratch, toks, offsets, last_idxs, phys):
-            # toks [g, C] offsets [g] last_idxs [g] phys [g, C/BS]
-            def body(carry, xs):
-                pool, scratch = carry
-                tok, off, li, ph = xs
-                last, scratch = self._traced_chunk_step(
-                    params, scratch, tok, off, li)
-                pool = self._traced_splice(
-                    pool, scratch["k"], scratch["v"], off, ph)
-                return (pool, scratch), last
-
-            (pool, scratch), lasts = jax.lax.scan(
-                body, (pool, scratch), (toks, offsets, last_idxs, phys))
-            return pool, scratch, lasts[-1]
-
-        fn = self._compiled[key] = jax.jit(group, donate_argnums=(1, 2))
-        return fn
 
     def bench_reset_slots(self, ctx0: int, budget: int) -> None:
         """Raw-loop benchmarking support: give every slot physical blocks
@@ -726,38 +440,16 @@ class InferenceEngine:
                    self.ecfg.max_seq_len)
 
     def _alloc_blocks(self, n: int) -> list[int]:
-        """Allocate physical blocks; evicts prefix-cache holdings if the
-        free list runs short. Reservations make failure impossible."""
-        if n <= 0:
-            return []
-        got = self.allocator.alloc(n)
-        if got is None:
-            self.prefix_cache.evict_for_space(n)
-            got = self.allocator.alloc(n)
-        if got is None:
-            raise RuntimeError(
-                f"KV pool exhausted: need {n}, free "
-                f"{self.allocator.free_count} (reservation bug)")
-        self._kv_allocs += n
-        return got
+        return self.pool.alloc_blocks(n)
 
     def _push_table(self, slot: int) -> None:
-        # pad with the trash block: inactive/overhang lanes write there
-        row = np.full((self._mb,), self._trash_block, dtype=np.int32)
-        blocks = self._slot_blocks[slot]
-        row[:len(blocks)] = blocks
-        self._table_np[slot] = row
-        self.kv_cache["table"] = jnp.asarray(self._table_np)
+        self.kv_cache["table"] = self.pool.push_table(slot)
 
     def _ensure_slot_blocks(self, slot: int, n_tokens: int) -> bool:
         """Grow the slot's physical block list to cover ``n_tokens``
         positions. Returns True when the table changed."""
-        from .paged_kv import blocks_for
-        need = blocks_for(n_tokens, self.ecfg.kv_block_size)
-        have = len(self._slot_blocks[slot])
-        if need <= have:
+        if not self.pool.ensure_slot_blocks(slot, n_tokens):
             return False
-        self._slot_blocks[slot].extend(self._alloc_blocks(need - have))
         self._push_table(slot)
         return True
 
@@ -772,8 +464,11 @@ class InferenceEngine:
         constructs the engine with an ABSTRACT param tree
         (``jax.ShapeDtypeStruct`` leaves — see :func:`abstract_params`),
         precompiles while the weights stream, then binds the streamed /
-        pooled arrays here. The engine must not serve before this."""
-        self.params = params
+        pooled arrays here. The engine must not serve before this.
+        Placement goes through the sharding policy: a mesh engine shards
+        the tree per ``decoder_param_specs`` here (already-sharded arrays
+        device_put to their own sharding, a no-op)."""
+        self.params = self.policy.place_params(params)
 
     def precompile(self) -> dict:
         """AOT-compile every steady-state serving graph from SHAPES alone.
@@ -788,69 +483,16 @@ class InferenceEngine:
         compiled graph; with ``JAX_COMPILATION_CACHE_DIR`` set (every tpu9
         container) the executables land in the persistent cache too.
         Scalar positions are lowered with concrete ints — the weak-typed
-        aval the serve loop's python-int arguments produce."""
-        import time as _time
-        timings: dict[str, float] = {}
-
-        def aot(key, fn, *args) -> None:
-            if not hasattr(fn, "lower"):
-                return                    # already an AOT executable
-            t0 = _time.perf_counter()
-            self._compiled[key] = fn.lower(*args).compile()
-            name = "_".join(str(p) for p in key) \
-                if isinstance(key, tuple) else str(key)
-            timings[f"compile_{name}_s"] = \
-                round(_time.perf_counter() - t0, 4)
-
-        pspec = abstract_params(self.params)
-        b = self.ecfg.max_batch
-        i32 = jnp.int32
-        if self.paged:
-            bs = self.ecfg.kv_block_size
-            c = self._chunk
-            scratch = abstract_params(self._scratch)
-            pool = abstract_params(self._pool_dict())
-            aot(("chunk", c), self._chunk_fn(),
-                pspec, jax.ShapeDtypeStruct((1, c), i32), 0, scratch, 0)
-            aot("splice", self._splice_fn(),
-                pool, scratch["k"], scratch["v"], 0,
-                jax.ShapeDtypeStruct((c // bs,), i32))
-            aot("gather", self._gather_fn(),
-                pool, jax.ShapeDtypeStruct((self._mb,), i32))
-            g = max(1, self.ecfg.admit_group_chunks)
-            if g > 1:
-                aot(("chunkgroup", g), self._chunk_group_fn(g),
-                    pspec, pool, scratch,
-                    jax.ShapeDtypeStruct((g, c), i32),
-                    jax.ShapeDtypeStruct((g,), i32),
-                    jax.ShapeDtypeStruct((g,), i32),
-                    jax.ShapeDtypeStruct((g, c // bs), i32))
-        else:
-            cfg = self.cfg
-            for bucket in self._buckets:
-                pre = jax.ShapeDtypeStruct(
-                    (cfg.n_layers, 1, bucket, cfg.n_kv_heads,
-                     cfg.head_dim), cfg.dtype)
-                aot(bucket, self._prefill_fn(bucket),
-                    pspec, jax.ShapeDtypeStruct((1, bucket), i32), 1)
-                aot(("dsplice", bucket), self._dense_splice_fn(bucket),
-                    abstract_params(self.kv_cache["k"]),
-                    abstract_params(self.kv_cache["v"]), pre, pre, 0)
-        kv_spec = abstract_params(self.kv_cache)
-        for k in self.ecfg.decode_steps:
-            aot(("decode", k), self._decode_k(k),
-                pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
-                jax.ShapeDtypeStruct((b,), i32),
-                jax.ShapeDtypeStruct((b,), jnp.bool_),
-                abstract_params(self._rng))
-        for s in self._spec_lens:
-            aot(("verify", s), self._verify_fn(s),
-                pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
-                jax.ShapeDtypeStruct((b, s), i32),
-                jax.ShapeDtypeStruct((b,), i32),
-                jax.ShapeDtypeStruct((b,), jnp.bool_),
-                abstract_params(self._rng))
-        return timings
+        aval the serve loop's python-int arguments produce. The AOT logic
+        itself lives with the graphs (``GraphFactory.precompile``); on a
+        mesh policy the lowered specs carry the shardings, so the
+        executables are the exact SPMD programs the serve loop runs."""
+        return self.graphs.precompile(
+            self.params, self.kv_cache,
+            self._pool_dict() if self.paged else {},
+            self._scratch if self.paged else {},
+            self._mb if self.paged else 0,
+            self._buckets, self._spec_lens, self._rng)
 
     def warmup(self) -> dict:
         """Precompile every prefill bucket and decode-window graph.
@@ -1017,6 +659,17 @@ class InferenceEngine:
         out["token_pressure"] = float(
             self._host_len.sum()
             / (self.ecfg.max_batch * self.ecfg.max_seq_len))
+        # topology (ISSUE 9): flat scalars so the runner heartbeat can
+        # forward them into the store hash behind /api/v1/metrics
+        # "engines" unchanged — tp/fsdp/n_chips plus live per-chip HBM
+        # (max across the submesh; 0.0 where the backend has no memory
+        # stats, i.e. CPU). A 1x1 engine reports tp=1 so the fleet view
+        # can tell "single chip" from "not reporting".
+        topo = self.policy.describe()
+        out["topo_tp"] = topo["tp"]
+        out["topo_fsdp"] = topo["fsdp"]
+        out["topo_n_chips"] = topo["n_chips"]
+        out["hbm_used_gb_per_chip"] = self.policy.hbm_used_gb_per_chip()
         # speculative-decoding acceptance (ISSUE 5): proposed/accepted are
         # cumulative; the rate is the fleet-comparable signal the runner
         # heartbeats and the router aggregates
@@ -1212,11 +865,15 @@ class InferenceEngine:
         if req.trace is None:
             return
         trace_id, parent = req.trace
+        topo = self.policy.describe()
         req.span = tracer.start_span(
             "engine.request", trace_id=trace_id, parent_id=parent,
             attrs={"request_id": req.request_id,
                    "prompt_tokens": len(req.prompt),
-                   "max_new_tokens": req.max_new_tokens})
+                   "max_new_tokens": req.max_new_tokens,
+                   # multichip evidence rides the PR-8 observability
+                   # layer (ISSUE 9): which submesh served this request
+                   "tp": topo["tp"], "n_chips": topo["n_chips"]})
         req.span_id = req.span.span_id
         # backdate to the enqueue anchor: the request span covers
         # queue-wait + prefill + every decode window
@@ -1275,6 +932,12 @@ class InferenceEngine:
                    "slots": slots, "tokens": delivered,
                    "wait_s": round(max(t_host0 - win.t_mono, 0.0), 6),
                    "host_s": round(max(now_m - t_host0, 0.0), 6)}
+            topo = self.policy.describe()
+            if topo["n_chips"] > 1:
+                # stamp the submesh onto multichip window records only —
+                # 1x1 flight records stay byte-identical to the pre-split
+                # engine's
+                rec.update(tp=topo["tp"], n_chips=topo["n_chips"])
             if win.kind == "verify":
                 prop, acc = win.spec_stats or (0, 0)
                 rec.update(spec_proposed=prop, spec_accepted=acc,
@@ -1283,8 +946,9 @@ class InferenceEngine:
             if win.kv_snap:
                 used, free, reserved = win.kv_snap
                 rec.update(kv_used=used, kv_free=free, kv_reserved=reserved,
-                           kv_alloc=self._kv_allocs - self._flight_kv_allocs)
-                self._flight_kv_allocs = self._kv_allocs
+                           kv_alloc=self.pool.kv_allocs
+                           - self._flight_kv_allocs)
+                self._flight_kv_allocs = self.pool.kv_allocs
                 if self.prefix_cache is not None:
                     ev = self.prefix_cache.evictions
                     rec.update(
@@ -1484,24 +1148,6 @@ class InferenceEngine:
         self._occupy_slot(req, slot)
         return first
 
-    def _dense_splice_fn(self, bucket: int):
-        """Jitted, cache-donating copy of a prefill's [L,1,bucket,...] KV
-        into one slot's lanes of the dense [L,B,S,...] cache."""
-        key = ("dsplice", bucket)
-        fn = self._compiled.get(key)
-        if fn is not None:
-            return fn
-
-        def splice(k, v, ck, cv, slot):
-            k = jax.lax.dynamic_update_slice(
-                k, ck[:, :, :bucket], (0, slot, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(
-                v, cv[:, :, :bucket], (0, slot, 0, 0, 0))
-            return k, v
-
-        fn = self._compiled[key] = jax.jit(splice, donate_argnums=(0, 1))
-        return fn
-
     def _deliver_first(self, req: _Request, first: int) -> None:
         req.generated.append(first)
         self._obs_first_token(req)
@@ -1525,11 +1171,7 @@ class InferenceEngine:
         if self.paged:
             # physical blocks back to the pool (prefix-cache refs keep
             # shared prefix blocks alive), worst-case reservation released
-            self.allocator.release(self._slot_blocks[slot])
-            self._slot_blocks[slot] = []
-            self._push_table(slot)
-            self.allocator.unreserve(self._slot_reserved[slot])
-            self._slot_reserved[slot] = 0
+            self.kv_cache["table"] = self.pool.release_slot(slot)
         if req is not None:
             self._obs_done(req)
             if req.queue is not None:

@@ -32,6 +32,11 @@ class HbmBudget:
     tpu: str
     chips: int
     tp: int
+    # weight-only sharding on top of tp (ISSUE 9 planner): weights divide
+    # by tp×fsdp, KV/scratch by the tp head shard only — fsdp chips add
+    # zero KV capacity, which is why the planner prefers tp when heads
+    # allow it
+    fsdp: int
     hbm_per_chip_gb: float
     weight_gb_per_chip: float
     kv_gb_per_chip: float
@@ -55,6 +60,7 @@ class HbmBudget:
     def as_dict(self) -> dict:
         return {
             "tpu": self.tpu, "chips": self.chips, "tp": self.tp,
+            "fsdp": self.fsdp,
             "hbm_per_chip_gb": round(self.hbm_per_chip_gb, 2),
             "weight_gb_per_chip": round(self.weight_gb_per_chip, 3),
             "kv_gb_per_chip": round(self.kv_gb_per_chip, 3),
@@ -112,15 +118,17 @@ def kv_cache_bytes(cfg, max_batch: int, max_seq: int,
 
 
 def hbm_budget(preset: str, tpu: "str | TpuSpec", *, max_batch: int = 8,
-               max_seq_len: int = 2048, tp: int = 0,
+               max_seq_len: int = 2048, tp: int = 0, fsdp: int = 1,
                overhead_frac: float = 0.10,
                quantize: "str | None" = None,
                kv_quant: bool = False) -> HbmBudget:
     """Compute the per-chip HBM budget for serving ``preset`` on ``tpu``
-    with tensor parallelism ``tp`` (default: all chips of the slice).
-    ``quantize="int8"`` prices a PLAIN preset name as int8 weights — the
-    same opt-in surface ``load_engine(quantize=)``/TPU9_QUANTIZE uses,
-    so a knob-opted deployment is not mispriced as bf16."""
+    with tensor parallelism ``tp`` (default: all chips of the slice) and
+    optional weight-only ``fsdp`` sharding on top (ISSUE 9 topology
+    planner: weights divide by tp×fsdp; KV divides by the tp head shard
+    only). ``quantize="int8"`` prices a PLAIN preset name as int8 weights
+    — the same opt-in surface ``load_engine(quantize=)``/TPU9_QUANTIZE
+    uses, so a knob-opted deployment is not mispriced as bf16."""
     from .presets import resolve_preset
     cfg, quantized = resolve_preset(preset, quantize)
     spec = parse_tpu_spec(tpu) if isinstance(tpu, str) else tpu
@@ -128,7 +136,7 @@ def hbm_budget(preset: str, tpu: "str | TpuSpec", *, max_batch: int = 8,
         raise ValueError("feasibility needs a TPU spec")
     tp = tp or spec.chips
 
-    w = weight_bytes(cfg, quantized) / tp
+    w = weight_bytes(cfg, quantized) / (tp * max(fsdp, 1))
     # KV is head-sharded; the EVEN shard is gcd(tp, kv_heads) — min()
     # would assume a tp=6 mesh splits 8 heads 6 ways and under-count
     # per-chip KV 3x, approving deploys that OOM at runtime
@@ -148,7 +156,7 @@ def hbm_budget(preset: str, tpu: "str | TpuSpec", *, max_batch: int = 8,
     scratch = kv_cache_bytes(cfg, 1, max_seq_len) / kv_shard
 
     return HbmBudget(
-        tpu=spec.name, chips=spec.chips, tp=tp,
+        tpu=spec.name, chips=spec.chips, tp=tp, fsdp=max(fsdp, 1),
         hbm_per_chip_gb=float(spec.hbm_gb_per_chip),
         weight_gb_per_chip=w / 1e9,
         kv_gb_per_chip=kv / 1e9,

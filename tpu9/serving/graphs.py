@@ -1,0 +1,397 @@
+"""Serving graph factory: every jitted/AOT-compiled XLA computation the
+engine dispatches, in one module (ISSUE 9 engine split).
+
+The engine split's graph-building third: prefill (bucketed dense +
+chunked paged + fused admission groups), windowed decode, speculative
+verify, and the pool splice/gather plumbing. The factory owns the
+compiled-executable cache and is the ONLY place serving code traces jax —
+the engine orchestrates admission/scheduling/fan-out around these
+callables and never opens a ``jax.jit`` itself.
+
+Sharding boundary: the factory is handed a :mod:`tpu9.serving.shard`
+policy and pins every KV-state output with ``policy.constrain_kv`` before
+returning it from a traced body — on a mesh that keeps the donated pool
+head-sharded across every round trip; on the single-device policy the
+hook is the identity, so a ``1x1`` engine traces exactly the graphs the
+pre-split engine did (same cache keys, no constraint ops).
+
+Dtype boundary: int8 KV quantize/dequant stays in ``ops.quant`` +
+``models.transformer``; the factory only routes the scale planes through
+the same physical indices as the payload (``traced_splice``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import decoder_forward, init_kv_cache
+from ..ops.sampling import sample_logits
+
+Params = dict[str, Any]
+
+
+class GraphFactory:
+    """Builds + caches the engine's compiled graphs for one (model,
+    engine-config, sharding-policy) triple. ``chunk`` is the validated
+    chunked-prefill length (0 = dense mode); ``kv_quant`` whether the
+    paged pool carries int8 payload + scale planes."""
+
+    def __init__(self, cfg, ecfg, policy, chunk: int = 0,
+                 kv_quant: bool = False):
+        self.cfg = cfg
+        self.ecfg = ecfg
+        self.policy = policy
+        self.chunk = chunk
+        self.kv_quant = kv_quant
+        self.compiled: dict[Any, Any] = {}
+
+    # -- decode window -------------------------------------------------------
+
+    def build_decode(self, k: int = 1):
+        cfg, ecfg, policy = self.cfg, self.ecfg, self.policy
+
+        def one_step(params, kv_cache, last_token, cache_len, active, rng):
+            positions = cache_len[:, None]          # next position per slot
+            logits, kv_cache = decoder_forward(
+                params, last_token, cfg, positions=positions,
+                kv_cache=kv_cache, cache_len=cache_len + 1, decode=True)
+            rng, sub = jax.random.split(rng)
+            next_tok = sample_logits(logits[:, -1], sub,
+                                     temperature=ecfg.temperature,
+                                     top_k=ecfg.top_k, top_p=ecfg.top_p)
+            # only live slots advance; idle lanes stay parked at 0 so the
+            # token-pressure signal reflects real cache occupancy
+            new_len = cache_len + active.astype(jnp.int32)
+            return next_tok[:, None].astype(jnp.int32), kv_cache, new_len, rng
+
+        def decode(params, kv_cache, last_token, cache_len, active, rng):
+            def body(carry, _):
+                last, kv, clen, r = carry
+                last, kv, clen, r = one_step(params, kv, last, clen,
+                                             active, r)
+                return (last, kv, clen, r), last[:, 0]
+
+            (last, kv_cache, cache_len, rng), toks = jax.lax.scan(
+                body, (last_token, kv_cache, cache_len, rng), None,
+                length=k)
+            # toks [k, B]: the host consumes the whole window in one sync
+            return (last, policy.constrain_kv(kv_cache), cache_len, rng,
+                    toks)
+
+        return jax.jit(decode, donate_argnums=(1,))
+
+    def decode_k(self, k: int):
+        key = ("decode", k)
+        fn = self.compiled.get(key)
+        if fn is None:
+            fn = self.compiled[key] = self.build_decode(k)
+        return fn
+
+    # -- speculative verify --------------------------------------------------
+
+    def build_verify(self, s: int):
+        """Jitted speculative-verify graph (ISSUE 5 tentpole): ONE batched
+        forward over ``[B, 1+s]`` positions — column 0 is the device
+        last_token, columns 1..s the host-proposed draft tokens. The model
+        emits its OWN token at every position; a draft survives only while
+        it equals the model's output, so the emitted stream is exactly
+        what classic decode would have produced (greedy parity is
+        bit-exact — drafts can only be cheap, never wrong). Per slot the
+        graph returns the accepted-prefix length and the model's bonus
+        token, and advances cache_len past accepted positions only —
+        rejected draft positions keep garbage KV that attention masks out
+        and the next window overwrites (paged re-splice / dense
+        re-scatter)."""
+        cfg, ecfg, policy = self.cfg, self.ecfg, self.policy
+        t = s + 1
+
+        def verify(params, kv_cache, last_token, drafts, cache_len,
+                   active, rng):
+            tokens = jnp.concatenate(
+                [last_token, drafts.astype(jnp.int32)], axis=1)  # [B, t]
+            positions = cache_len[:, None] + jnp.arange(t)[None, :]
+            logits, kv_cache = decoder_forward(
+                params, tokens, cfg, positions=positions,
+                kv_cache=kv_cache, cache_len=cache_len + t, decode=False)
+            rng, sub = jax.random.split(rng)
+            out = sample_logits(logits, sub, temperature=ecfg.temperature,
+                                top_k=ecfg.top_k,
+                                top_p=ecfg.top_p).astype(jnp.int32)  # [B, t]
+            # longest agreeing prefix of the drafts, per slot
+            agree = (tokens[:, 1:] == out[:, :-1]).astype(jnp.int32)
+            n_acc = jnp.cumprod(agree, axis=1).sum(axis=1)        # [B]
+            # the model's own next token after the accepted run
+            bonus = jnp.take_along_axis(out, n_acc[:, None], axis=1)
+            new_len = cache_len + (n_acc + 1) * active.astype(jnp.int32)
+            return (bonus, policy.constrain_kv(kv_cache), new_len, rng,
+                    out, n_acc)
+
+        return jax.jit(verify, donate_argnums=(1,))
+
+    def verify_fn(self, s: int):
+        key = ("verify", s)
+        fn = self.compiled.get(key)
+        if fn is None:
+            fn = self.compiled[key] = self.build_verify(s)
+        return fn
+
+    # -- dense prefill -------------------------------------------------------
+
+    def prefill_fn(self, bucket: int):
+        if bucket in self.compiled:
+            return self.compiled[bucket]
+        cfg, policy = self.cfg, self.policy
+
+        def prefill(params, tokens, length):
+            # tokens [1, bucket] padded; returns logits at the last real
+            # token and the per-layer k/v for the prefix.
+            logits, cache = decoder_forward(
+                params, tokens, cfg,
+                kv_cache=init_kv_cache(cfg, 1, bucket), decode=False)
+            last = logits[0, length - 1]
+            return last, policy.constrain_kv(cache)
+
+        fn = jax.jit(prefill)
+        self.compiled[bucket] = fn
+        return fn
+
+    def dense_splice_fn(self, bucket: int):
+        """Jitted, cache-donating copy of a prefill's [L,1,bucket,...] KV
+        into one slot's lanes of the dense [L,B,S,...] cache."""
+        key = ("dsplice", bucket)
+        fn = self.compiled.get(key)
+        if fn is not None:
+            return fn
+        policy = self.policy
+
+        def splice(k, v, ck, cv, slot):
+            k = jax.lax.dynamic_update_slice(
+                k, ck[:, :, :bucket], (0, slot, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(
+                v, cv[:, :, :bucket], (0, slot, 0, 0, 0))
+            out = policy.constrain_kv({"k": k, "v": v})
+            return out["k"], out["v"]
+
+        fn = self.compiled[key] = jax.jit(splice, donate_argnums=(0, 1))
+        return fn
+
+    # -- paged chunked prefill -----------------------------------------------
+
+    def traced_chunk_step(self, params, scratch, tok_row, offset,
+                          last_idx):
+        """Traced body shared by the single-chunk and fused-group graphs
+        (one implementation — the two admission paths must never diverge):
+        prefill one C-token chunk into the scratch at ``offset`` and
+        return the logits at ``last_idx``."""
+        c = self.chunk
+        positions = offset + jnp.arange(c)[None, :]
+        logits, scratch = decoder_forward(
+            params, tok_row[None, :], self.cfg, positions=positions,
+            kv_cache=scratch, cache_len=offset + c, decode=False)
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], last_idx, axis=0, keepdims=False)
+        return last, scratch
+
+    def traced_splice(self, pool, scratch_k, scratch_v, offset, phys):
+        """Traced block copy shared by the splice and fused-group graphs:
+        scratch positions [offset, offset+C) → pool blocks phys[0..C/BS).
+        An int8 pool quantizes each block on the way in (per-vector absmax
+        scales land in the scale planes at the same physical index)."""
+        bs = self.ecfg.kv_block_size
+        pool = dict(pool)
+        for j in range(self.chunk // bs):
+            blk_k = jax.lax.dynamic_slice_in_dim(
+                scratch_k[:, 0], offset + j * bs, bs, axis=1)
+            blk_v = jax.lax.dynamic_slice_in_dim(
+                scratch_v[:, 0], offset + j * bs, bs, axis=1)
+            if "k_scale" in pool:
+                from ..ops.quant import quantize_kv
+                blk_k, sk = quantize_kv(blk_k)   # [L,bs,KH,D], [L,bs,KH]
+                blk_v, sv = quantize_kv(blk_v)
+                pool["k_scale"] = pool["k_scale"].at[:, phys[j]].set(sk)
+                pool["v_scale"] = pool["v_scale"].at[:, phys[j]].set(sv)
+            pool["k"] = pool["k"].at[:, phys[j]].set(blk_k)
+            pool["v"] = pool["v"].at[:, phys[j]].set(blk_v)
+        return self.policy.constrain_kv(pool)
+
+    def chunk_fn(self):
+        """Jitted chunked-prefill step: write one C-token chunk into the
+        batch-1 dense scratch at ``offset``, attend over prefix+chunk, and
+        return the logits at ``last_idx`` (the chunk's final real token).
+        Shapes are (C, S) — prompt length never changes the graph."""
+        key = ("chunk", self.chunk)
+        fn = self.compiled.get(key)
+        if fn is not None:
+            return fn
+        policy = self.policy
+
+        def chunk(params, tokens, offset, scratch, last_idx):
+            last, scratch = self.traced_chunk_step(params, scratch,
+                                                   tokens[0], offset,
+                                                   last_idx)
+            return last, policy.constrain_kv(scratch)
+
+        fn = self.compiled[key] = jax.jit(chunk, donate_argnums=(3,))
+        return fn
+
+    def gather_fn(self):
+        """Jitted densify of ONE slot's table row into the scratch (prefix
+        reuse: cached blocks → scratch so chunk prefill can attend them).
+        An int8 pool dequantizes here — the scratch is always the model
+        dtype, so chunk prefill attends exact dequantized values. The
+        traced body derives the table width from the row argument (one
+        cache entry regardless of width — it never changes mid-lifetime)."""
+        fn = self.compiled.get("gather")
+        if fn is not None:
+            return fn
+
+        s = self.ecfg.max_seq_len
+        dt = self.cfg.dtype
+        policy = self.policy
+
+        def gather(pool, row):
+            # pool [L, N, BS, KH, D], row [MB] → dense [L, 1, S, KH, D].
+            # The row's final column is the ALWAYS-TRASH block — slice it
+            # off so the densified prefix has the exact scratch shape
+            # (an S+BS-wide scratch trips the rope-table width validation
+            # when max_seq_len == the model's rope limit)
+            def one(p, sc):
+                g = p[:, row]                        # [L, MB, BS, KH, D]
+                if sc is not None:
+                    g = g.astype(jnp.float32) * sc[:, row][..., None]
+                l, mb_, bs, kh, d = g.shape
+                return g.astype(dt).reshape(l, 1, mb_ * bs, kh, d)[:, :, :s]
+            return policy.constrain_kv(
+                {"k": one(pool["k"], pool.get("k_scale")),
+                 "v": one(pool["v"], pool.get("v_scale"))})
+
+        fn = self.compiled["gather"] = jax.jit(gather)
+        return fn
+
+    def splice_fn(self):
+        """Jitted copy of one chunk's blocks from the scratch into their
+        physical pool blocks. C/BS is static → one graph."""
+        fn = self.compiled.get("splice")
+        if fn is not None:
+            return fn
+
+        fn = self.compiled["splice"] = jax.jit(
+            self.traced_splice, donate_argnums=(0,))
+        return fn
+
+    def chunk_group_fn(self, g: int):
+        """Fused admission graph (VERDICT r04 #6): lax.scan over ``g``
+        chunks — each step chunk-prefills into the scratch AND splices its
+        blocks into the pool. One dispatch replaces 2g, and the per-chunk
+        host bookkeeping (table math, array uploads) collapses into one
+        transfer of [g, ...] arrays. Returns the final chunk's last-token
+        logits so the caller can sample the first output."""
+        key = ("chunkgroup", g)
+        fn = self.compiled.get(key)
+        if fn is not None:
+            return fn
+        policy = self.policy
+
+        def group(params, pool, scratch, toks, offsets, last_idxs, phys):
+            # toks [g, C] offsets [g] last_idxs [g] phys [g, C/BS]
+            def body(carry, xs):
+                pool, scratch = carry
+                tok, off, li, ph = xs
+                last, scratch = self.traced_chunk_step(
+                    params, scratch, tok, off, li)
+                pool = self.traced_splice(
+                    pool, scratch["k"], scratch["v"], off, ph)
+                return (pool, scratch), last
+
+            (pool, scratch), lasts = jax.lax.scan(
+                body, (pool, scratch), (toks, offsets, last_idxs, phys))
+            return pool, policy.constrain_kv(scratch), lasts[-1]
+
+        fn = self.compiled[key] = jax.jit(group, donate_argnums=(1, 2))
+        return fn
+
+    # -- compile-ahead (AOT) -------------------------------------------------
+
+    def precompile(self, params, kv_cache: Params, pool: Params,
+                   scratch: Params, mb: int, buckets, spec_lens,
+                   rng) -> dict:
+        """AOT-compile every steady-state serving graph from SHAPES alone.
+
+        XLA needs param shapes/dtypes, not values — so serving bring-up
+        can run this concurrently with weight streaming (``params`` may be
+        a ``jax.ShapeDtypeStruct`` tree) instead of serializing a
+        multi-second compile behind the weight load. Each
+        ``.lower(...).compile()`` executable replaces the jitted function
+        under the same cache key the serve loop resolves. On a mesh
+        policy the abstract specs carry NamedShardings, so the lowered
+        executables are the exact SPMD programs the serve loop will
+        dispatch. Scalar positions are lowered with concrete ints — the
+        weak-typed aval the serve loop's python-int arguments produce."""
+        timings: dict[str, float] = {}
+        policy = self.policy
+
+        def aot(key, fn, *args) -> None:
+            if not hasattr(fn, "lower"):
+                return                    # already an AOT executable
+            t0 = time.perf_counter()
+            self.compiled[key] = fn.lower(*args).compile()
+            name = "_".join(str(p) for p in key) \
+                if isinstance(key, tuple) else str(key)
+            timings[f"compile_{name}_s"] = \
+                round(time.perf_counter() - t0, 4)
+
+        pspec = policy.abstract(params)
+        b = self.ecfg.max_batch
+        i32 = jnp.int32
+        if self.chunk:
+            bs = self.ecfg.kv_block_size
+            c = self.chunk
+            ascratch = policy.abstract(scratch, kv=True)
+            apool = policy.abstract(pool, kv=True)
+            aot(("chunk", c), self.chunk_fn(),
+                pspec, jax.ShapeDtypeStruct((1, c), i32), 0, ascratch, 0)
+            aot("splice", self.splice_fn(),
+                apool, ascratch["k"], ascratch["v"], 0,
+                jax.ShapeDtypeStruct((c // bs,), i32))
+            aot("gather", self.gather_fn(),
+                apool, jax.ShapeDtypeStruct((mb,), i32))
+            g = max(1, self.ecfg.admit_group_chunks)
+            if g > 1:
+                aot(("chunkgroup", g), self.chunk_group_fn(g),
+                    pspec, apool, ascratch,
+                    jax.ShapeDtypeStruct((g, c), i32),
+                    jax.ShapeDtypeStruct((g,), i32),
+                    jax.ShapeDtypeStruct((g,), i32),
+                    jax.ShapeDtypeStruct((g, c // bs), i32))
+        else:
+            cfg = self.cfg
+            for bucket in buckets:
+                pre = jax.ShapeDtypeStruct(
+                    (cfg.n_layers, 1, bucket, cfg.n_kv_heads,
+                     cfg.head_dim), cfg.dtype)
+                adense = policy.abstract(
+                    {"k": kv_cache["k"], "v": kv_cache["v"]}, kv=True)
+                aot(bucket, self.prefill_fn(bucket),
+                    pspec, jax.ShapeDtypeStruct((1, bucket), i32), 1)
+                aot(("dsplice", bucket), self.dense_splice_fn(bucket),
+                    adense["k"], adense["v"], pre, pre, 0)
+        kv_spec = policy.abstract(kv_cache, kv=True)
+        arng = policy.abstract(rng)
+        for k in self.ecfg.decode_steps:
+            aot(("decode", k), self.decode_k(k),
+                pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b,), jnp.bool_),
+                arng)
+        for s in spec_lens:
+            aot(("verify", s), self.verify_fn(s),
+                pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
+                jax.ShapeDtypeStruct((b, s), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b,), jnp.bool_),
+                arng)
+        return timings

@@ -1,0 +1,155 @@
+"""Paged KV-pool management for the serving engine (ISSUE 9 engine split).
+
+The engine split's KV third: pool sizing (equal-HBM int8 auto sizing —
+ISSUE 6), the trash-block discipline, slot→physical-block bookkeeping,
+worst-case reservations and the host block table. Everything here is
+HOST-side and topology-OBLIVIOUS: block ids are global integers, tables
+are replicated, and admission/eviction arithmetic is identical on one
+chip and on a tp×fsdp submesh — only the resident layout of the pool
+arrays is sharded, and that placement goes through the
+:mod:`tpu9.serving.shard` policy handed in at construction.
+
+The allocator/prefix-cache primitives stay in :mod:`tpu9.serving.paged_kv`
+(they predate the split and are imported by the router's admission math
+via stats, not by code); this module owns their engine-side composition.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .paged_kv import BlockAllocator, PrefixCache, blocks_for
+
+Params = dict[str, Any]
+
+
+class KvPool:
+    """One engine's paged KV pool: device arrays (built once via
+    :meth:`init_arrays`), the block allocator + prefix cache, and the
+    per-slot physical-block state the serve loop mutates."""
+
+    def __init__(self, cfg, ecfg, kv_quant: bool, policy):
+        b, s = ecfg.max_batch, ecfg.max_seq_len
+        bs = ecfg.kv_block_size
+        self.cfg = cfg
+        self.ecfg = ecfg
+        self.kv_quant = kv_quant
+        self.policy = policy
+        if ecfg.kv_pool_blocks:
+            base_blocks = ecfg.kv_pool_blocks
+        else:
+            base_blocks = b * s // bs            # dense parity
+            if kv_quant:
+                # equal-HBM sizing: the int8 pool spends the same bytes
+                # the bf16 pool would have — ~2x the blocks, which is the
+                # whole point (capacity == admission headroom == the
+                # router's kv_blocks signal)
+                from .paged_kv import kv_block_bytes
+                base_blocks = (base_blocks
+                               * kv_block_bytes(cfg, bs, False)
+                               // kv_block_bytes(cfg, bs, True))
+        # +1: one dedicated TRASH block absorbs splice writes of the
+        # padded tail of a non-block-aligned final chunk
+        self.n_blocks = base_blocks + 1
+        # table width: +1 ALWAYS-TRASH column — a decode write at
+        # position S (cache full; callers should bound it, but a
+        # regression must not corrupt data) computes pos // bs == S/bs
+        # which would otherwise CLAMP onto the last real block and
+        # overwrite valid KV; the extra column absorbs it harmlessly
+        # (attention masks by cache_len, so it is never read)
+        self.mb = s // bs + 1                    # table width
+        self.allocator = BlockAllocator(self.n_blocks, bs)
+        self.trash_block = self.allocator.alloc(1)[0]
+        # inactive decode lanes scatter through their (zero-padded) table
+        # rows every step — push_table pads rows with the trash block
+        # explicitly, but the freshly-zeroed initial table relies on the
+        # trash block being physical block 0
+        assert self.trash_block == 0, self.trash_block
+        # the trash block is held forever — reservations must not count
+        # on it
+        self.allocator.reserve_capacity = self.n_blocks - 1
+        self.prefix_cache = PrefixCache(self.allocator,
+                                        ecfg.prefix_cache_blocks)
+        self.slot_blocks: list[list[int]] = [[] for _ in range(b)]
+        self.slot_reserved = [0] * b
+        self.table_np = np.zeros((b, self.mb), dtype=np.int32)
+        self.kv_allocs = 0           # lifetime block allocations
+
+    def init_arrays(self) -> Params:
+        """The pool's device state: payload (+ int8 scale planes) and the
+        block table — placed through the sharding policy (head axis over
+        tp on a mesh; plain single-device arrays otherwise)."""
+        import jax.numpy as jnp
+        cfg, ecfg = self.cfg, self.ecfg
+        pool_shape = (cfg.n_layers, self.n_blocks, ecfg.kv_block_size,
+                      cfg.n_kv_heads, cfg.head_dim)
+        dt = jnp.int8 if self.kv_quant else cfg.dtype
+        kv = {"k": self.policy.zeros(pool_shape, dt, "k"),
+              "v": self.policy.zeros(pool_shape, dt, "v"),
+              "table": self.policy.device_table(self.table_np)}
+        if self.kv_quant:
+            # per-(position, head) f32 absmax scales alongside the pool
+            # (ops.quant.quantize_kv) — same [N, BS, KH] indexing as the
+            # payload so every write/read shares the table math
+            sc_shape = pool_shape[:-1]
+            kv["k_scale"] = self.policy.zeros(sc_shape, jnp.float32,
+                                              "k_scale")
+            kv["v_scale"] = self.policy.zeros(sc_shape, jnp.float32,
+                                              "v_scale")
+        return kv
+
+    # -- block allocation ----------------------------------------------------
+
+    def alloc_blocks(self, n: int) -> list[int]:
+        """Allocate physical blocks; evicts prefix-cache holdings if the
+        free list runs short. Reservations make failure impossible."""
+        if n <= 0:
+            return []
+        got = self.allocator.alloc(n)
+        if got is None:
+            self.prefix_cache.evict_for_space(n)
+            got = self.allocator.alloc(n)
+        if got is None:
+            raise RuntimeError(
+                f"KV pool exhausted: need {n}, free "
+                f"{self.allocator.free_count} (reservation bug)")
+        self.kv_allocs += n
+        return got
+
+    # -- the host block table ------------------------------------------------
+
+    def device_table(self):
+        return self.policy.device_table(self.table_np)
+
+    def push_table(self, slot: int):
+        """Refresh one slot's table row from its block list (trash-padded)
+        and return the new device table for the engine to install."""
+        row = np.full((self.mb,), self.trash_block, dtype=np.int32)
+        blocks = self.slot_blocks[slot]
+        row[:len(blocks)] = blocks
+        self.table_np[slot] = row
+        return self.device_table()
+
+    def ensure_slot_blocks(self, slot: int, n_tokens: int) -> bool:
+        """Grow the slot's physical block list to cover ``n_tokens``
+        positions. Returns True when the table changed (the caller must
+        install :meth:`device_table` / the value from :meth:`push_table`)."""
+        need = blocks_for(n_tokens, self.ecfg.kv_block_size)
+        have = len(self.slot_blocks[slot])
+        if need <= have:
+            return False
+        self.slot_blocks[slot].extend(self.alloc_blocks(need - have))
+        return True
+
+    def release_slot(self, slot: int):
+        """Retirement: physical blocks back to the pool (prefix-cache refs
+        keep shared prefix blocks alive), worst-case reservation released.
+        Returns the refreshed device table."""
+        self.allocator.release(self.slot_blocks[slot])
+        self.slot_blocks[slot] = []
+        table = self.push_table(slot)
+        self.allocator.unreserve(self.slot_reserved[slot])
+        self.slot_reserved[slot] = 0
+        return table

@@ -84,7 +84,9 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
                 flight_cap: int = 256,
                 engine_cfg: Optional[EngineConfig] = None,
                 seed: int = 0,
-                compile_ahead: bool = False) -> InferenceEngine:
+                compile_ahead: bool = False,
+                topology=None,
+                tpu: Optional[str] = None) -> InferenceEngine:
     """``paged=None`` (default) enables the paged-KV engine whenever the
     alignment invariants hold (block | chunk | max_seq_len) — the
     production serving path (block allocator + chunked prefill + prefix
@@ -112,8 +114,22 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
     weights materialize, binding them when both finish — serving bring-up
     pays max(compile, weight load) instead of their sum (λScale-style
     pipelined bring-up; the per-graph timings land in
-    ``engine.compile_ahead_timings``)."""
+    ``engine.compile_ahead_timings``).
+
+    ``topology`` (ISSUE 9) selects the serving submesh: ``"2x1"`` /
+    ``"tp=2,fsdp=2"`` / a :class:`~tpu9.serving.shard.Topology` shard
+    weights and the paged-KV head axis across tp(×fsdp) local devices;
+    ``"auto"`` plans the smallest submesh that provably fits (needs
+    ``tpu``, e.g. ``"v5e-8"``, for the HBM arithmetic). ``None`` honors
+    the ``TPU9_TOPOLOGY`` env override and otherwise serves single-chip —
+    a ``1x1`` engine compiles bit-identical graphs to a topology-oblivious
+    build."""
     cfg, _quantized = resolve_preset(name, quantize)
+    from .shard import make_policy, resolve_topology
+    topo = resolve_topology(topology, preset=name, tpu=tpu,
+                            max_batch=max_batch, max_seq_len=max_seq_len,
+                            quantize=quantize, kv_quant=bool(kv_quant))
+    policy = make_policy(topo)
     from ..ops.quant import validate_quant_mode
     kv_quant = validate_quant_mode(kv_quant, "kv_quant")
     if engine_cfg is not None and kv_quant \
@@ -159,7 +175,7 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
         import logging
         import threading
         spec, _ = params_spec(name, quantize)
-        engine = InferenceEngine(spec, cfg, ecfg)
+        engine = InferenceEngine(spec, cfg, ecfg, policy=policy)
         timings: dict = {}
         errors: list = []
 
@@ -185,4 +201,7 @@ def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,
         engine.compile_ahead_timings = timings
         return engine
     params, _ = build_params(name, seed=seed, quantize=quantize)
-    return InferenceEngine(params, cfg, ecfg)
+    # placement through the policy BEFORE construction: the engine's pool
+    # arrays and the weights must land on the same submesh
+    return InferenceEngine(policy.place_params(params), cfg, ecfg,
+                           policy=policy)

@@ -1,0 +1,141 @@
+"""Window scheduling for the serving engine (ISSUE 9 engine split).
+
+The engine split's scheduling third: every "what should the next device
+dispatch be" decision — decode-window size (K), speculative-verify
+eligibility and the acceptance-EWMA gate, and the admission-can-proceed
+check that shrinks windows when a queued request could actually land.
+Pure host arithmetic over the engine's scheduling state (host length
+mirrors, budgets, in-flight step counts); it never touches device arrays
+or dispatches anything itself, so it is identical on one chip and on a
+sharded submesh.
+
+The scheduler reads the engine directly (they are one subsystem split by
+responsibility, not an RPC boundary) and records WHY it chose a window in
+``engine._pick_reason`` — the flight recorder's "why was K small" answer.
+"""
+
+from __future__ import annotations
+
+
+class WindowScheduler:
+    """Scheduling brain for one :class:`~tpu9.serving.engine.
+    InferenceEngine` — constructed by, and reading, that engine."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def admission_can_proceed(self) -> bool:
+        """True only when a waiting request could ACTUALLY be admitted
+        right now (free slot + KV room for the FIFO head) — the only case
+        where shrinking the next window to K=1 buys admission latency.
+        The old check (`not queue.empty()`) collapsed throughput to
+        single-step windows under saturation, when the queued head could
+        not be admitted anyway (batch full / pool exhausted) and small
+        windows bought nothing."""
+        e = self.engine
+        if e.active.all():
+            return False
+        head = None
+        if e.paged and e._wait_room:
+            head = e._wait_room[0]
+        else:
+            q = getattr(e._queue, "_queue", None)    # deque peek, no pop
+            if q:
+                head = q[0]
+        return head is not None and e._room_for(head)
+
+    def pick_steps(self) -> int:
+        """Largest decode-window bucket every active slot can absorb: no
+        slot may outrun its max_new_tokens budget past the window (tokens
+        beyond a stop are discarded host-side, so only bounded compute is
+        wasted) nor its cache room. Budget/room subtract steps already in
+        flight (the steady-state overlap window). Admission latency wins
+        when an admission could actually proceed: K=1."""
+        e = self.engine
+        if self.admission_can_proceed():
+            # shrink to the smallest window so the waiting head admits
+            # sooner — the flight recorder's "why was K small" answer
+            e._pick_reason = "admission"
+            return e.ecfg.decode_steps[0]
+        limit = max(e.ecfg.decode_steps)
+        for slot in range(e.ecfg.max_batch):
+            req = e.slot_req[slot]
+            if req is None or not e.active[slot]:
+                continue
+            remaining = (req.max_new_tokens - len(req.generated)
+                         - e._inflight_steps)
+            room = (e.ecfg.max_seq_len - 1 - e._host_len[slot]
+                    - e._inflight_steps)
+            limit = min(limit, max(1, remaining), max(1, room))
+        e._pick_reason = ("max" if limit >= max(e.ecfg.decode_steps)
+                          else "budget")
+        for k in reversed(e.ecfg.decode_steps):
+            if k <= limit:
+                return k
+        return e.ecfg.decode_steps[0]
+
+    def spec_room_len(self) -> int:
+        """Largest spec bucket the batch has ROOM for, or 0 when
+        speculation is off or structurally blocked (imminent admission,
+        cache room, exhausted budgets). Slots near their cache limit veto
+        the bucket — a dense write past max_seq_len would clamp backwards
+        over valid KV."""
+        e = self.engine
+        if not e._spec_lens:
+            return 0
+        if self.admission_can_proceed():
+            return 0              # admission latency wins, as for K
+        min_room = e.ecfg.max_seq_len
+        max_remaining = 0
+        any_active = False
+        for slot in range(e.ecfg.max_batch):
+            req = e.slot_req[slot]
+            if req is None or not e.active[slot]:
+                continue
+            any_active = True
+            min_room = min(min_room,
+                           e.ecfg.max_seq_len - 1
+                           - int(e._host_len[slot])
+                           - e._inflight_steps)
+            max_remaining = max(max_remaining,
+                                req.max_new_tokens - len(req.generated)
+                                - e._inflight_steps)
+        if not any_active or max_remaining < 2:
+            return 0
+        for s in sorted(e._spec_lens, reverse=True):
+            if s + 1 <= min_room:
+                return s
+        return 0
+
+    def spec_gate(self, s: int) -> int:
+        """Acceptance-EWMA gate: speculate only when the mean EFFECTIVE
+        acceptance over active slots clears the floor. Effective means a
+        slot with nothing to propose RIGHT NOW contributes 0 — a verify
+        window hands it ~1 token where a classic K-step window hands it
+        K, so idle proposers must drag the decision toward classic (their
+        optimistic starting EWMA must not). Below the floor speculation
+        auto-disables, except one probe window every ``spec_probe_every``
+        classic windows — which is how a stream that turns repetitive
+        later gets speculation back."""
+        e = self.engine
+        total = 0.0
+        n = 0
+        for slot in range(e.ecfg.max_batch):
+            if e.slot_req[slot] is None or not e.active[slot]:
+                continue
+            n += 1
+            st = e._spec_slots[slot]
+            if st is not None and st.proposer.propose(1):
+                total += st.ewma
+        if n == 0:
+            return 0
+        mean = total / n
+        if mean >= e.ecfg.spec_min_accept:
+            e._spec_disabled_windows = 0
+            return s
+        e._spec_disabled_windows += 1
+        pe = e.ecfg.spec_probe_every
+        if pe > 0 and e._spec_disabled_windows >= pe:
+            e._spec_disabled_windows = 0
+            return s
+        return 0

@@ -1,0 +1,18 @@
+"""Mesh-sharded multi-chip serving (ISSUE 9): the topology planner and the
+sharding policy objects the engine places its device state through.
+
+``plan`` is pure host arithmetic (feasibility-priced submesh choice);
+``policy`` is the only module that touches ``jax.sharding``. The engine
+imports policies, never meshes — sharding lands as a policy object, not a
+fork of the engine.
+"""
+
+from .plan import (Topology, TopologyPlan, candidate_topologies,
+                   parse_topology, plan_topology, resolve_topology,
+                   topology_from_env)
+from .policy import MeshPolicy, SingleDevicePolicy, make_policy
+
+__all__ = ["Topology", "TopologyPlan", "candidate_topologies",
+           "parse_topology", "plan_topology", "resolve_topology",
+           "topology_from_env", "MeshPolicy", "SingleDevicePolicy",
+           "make_policy"]
