@@ -8,6 +8,13 @@ Run it:
     python -m tpu9.analysis                 # gate mode: repo + baseline
     python -m tpu9.analysis --list-rules
     python -m tpu9.analysis path/to/file.py --no-baseline
+    python -m tpu9.analysis --format json   # stable CI schema
+
+The sharding/dtype/donation invariants of the traced serving graphs have
+their own verifier, ``python -m tpu9.analysis.graphcheck`` (ISSUE 11):
+Pass A lowers every serving graph per preset × topology and checks the
+jaxpr/compiled artifact; Pass B contributes the SHD001/SHD002/DTY001
+rules that run here too.
 
 Suppress a reviewed false positive inline (the reason is mandatory):
 

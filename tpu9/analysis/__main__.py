@@ -10,7 +10,7 @@ import argparse
 import json
 import sys
 
-from .findings import load_baseline
+from .findings import (JSON_SCHEMA_VERSION, finding_json, load_baseline)
 from .runner import (ALL_RULES, DEFAULT_BASELINE, DEFAULT_ROOTS,
                      find_repo_root, gate, run_analysis)
 
@@ -29,7 +29,13 @@ def main(argv=None) -> int:
                     help="report every finding, ignore the baseline")
     ap.add_argument("--select", default="",
                     help="comma-separated rule ids to run (default: all)")
-    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="legacy json dump (prefer --format json)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="report format; json emits the stable "
+                         "machine-readable schema shared with graphcheck "
+                         "(file/line/col/rule/symbol/message/fingerprint/"
+                         "status records)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--show-known", action="store_true",
                     help="also print baselined findings")
@@ -65,7 +71,21 @@ def main(argv=None) -> int:
         if select:
             stale = [e for e in stale if e.get("rule") in select]
 
-    if args.as_json:
+    if args.format == "json":
+        # the stable CI schema (ISSUE 11): one record shape for lint +
+        # graphcheck findings, round-trip tested in tests/test_graphcheck
+        print(json.dumps({
+            "version": JSON_SCHEMA_VERSION,
+            "tool": "tpu9lint",
+            "files_scanned": result.files_scanned,
+            "elapsed_s": round(result.elapsed_s, 3),
+            "findings": [finding_json(f, "new") for f in new]
+            + [finding_json(f, "baselined") for f in known],
+            "stale": [e["fingerprint"] for e in stale],
+            "suppressed_inline": len(result.suppressed),
+            "parse_errors": result.parse_errors,
+        }, indent=1))
+    elif args.as_json:
         print(json.dumps({
             "files_scanned": result.files_scanned,
             "elapsed_s": round(result.elapsed_s, 3),

@@ -35,6 +35,9 @@ class BoundaryConfig:
     restricted: dict[str, list[str]] = field(default_factory=dict)
     jax_hotpath_files: list[str] = field(default_factory=list)
     jax_roots: list[str] = field(default_factory=list)
+    # [graphcheck] table: scope/owner/carrier declarations for the
+    # SHD001/DTY001 rules (tpu9.analysis.graphcheck.astrules)
+    graph: dict = field(default_factory=dict)
 
     @classmethod
     def load(cls, path: str) -> "BoundaryConfig":
@@ -44,7 +47,8 @@ class BoundaryConfig:
                    forbid=raw.get("forbid", {}),
                    restricted=raw.get("restricted", {}),
                    jax_hotpath_files=jax.get("files", []),
-                   jax_roots=jax.get("roots", []))
+                   jax_roots=jax.get("roots", []),
+                   graph=raw.get("graphcheck", {}))
 
 
 def module_name(path: str) -> str:

@@ -51,6 +51,34 @@ class Finding:
                 "message": self.message}
 
 
+# The machine-readable finding schema (ISSUE 11): ONE shape for tpu9lint
+# and graphcheck findings so CI log consumers parse a single format.
+# Schema version bumps are a reviewed change here; adding keys is
+# backward-compatible, renaming/removing is not.
+JSON_SCHEMA_VERSION = 1
+JSON_FIELDS = ("file", "line", "col", "rule", "symbol", "occurrence",
+               "message", "fingerprint", "status")
+
+
+def finding_json(f: "Finding", status: str = "new") -> dict:
+    """The stable ``--format json`` record for one finding. ``status`` is
+    ``new`` (gate-failing), ``baselined`` (triaged debt) or ``graph``
+    (Pass A — not file-anchored, so line/col are 0 and ``file`` is the
+    ``graph://cell`` pseudo-path)."""
+    return {"file": f.path, "line": f.line, "col": f.col, "rule": f.rule,
+            "symbol": f.symbol, "occurrence": f.occurrence,
+            "message": f.message, "fingerprint": f.fingerprint,
+            "status": status}
+
+
+def finding_from_json(d: dict) -> "Finding":
+    """Inverse of :func:`finding_json` (round-trip tested): rebuilds a
+    Finding whose computed fingerprint matches the serialized one."""
+    return Finding(d["rule"], d["file"], d["line"], d["col"],
+                   d["message"], symbol=d.get("symbol", "<module>"),
+                   occurrence=d.get("occurrence", 0))
+
+
 def assign_occurrences(findings: list[Finding]) -> list[Finding]:
     """Number findings within each (rule, path, symbol) group in source
     order so identical sites in one function get distinct fingerprints."""

@@ -1,0 +1,42 @@
+"""tpu9 graphcheck — static verification of sharding, dtype, and donation
+invariants in the traced serving graphs (ISSUE 11).
+
+Two passes:
+
+- **Pass A (abstract lowering)** — ``passes.py``: for each preset ×
+  topology cell in the declared matrix (``matrix.py``), drive
+  ``GraphFactory.lowering_jobs`` on a forced CPU mesh and verify, from
+  the jaxpr and the compiled artifact, the invariants the multichip
+  engine split depends on: weights carry their ``MeshPolicy``
+  PartitionSpecs (GRA001), every KV-pool output is pinned by
+  ``constrain_kv`` with the head-axis spec (GRA002), donated buffers are
+  genuinely aliased in the compiled executable (GRA003), int8 storage
+  never reaches a matmul undequantized and scratch stays the model dtype
+  (GRA004), and the executable-cache signature set is closed — the keys
+  the serve loop can request equal the precompile set, so steady-state
+  serving provably cannot recompile (GRA005).
+
+- **Pass B (AST rules)** — ``astrules.py``: tpu9lint rules SHD001
+  (``jax.jit`` outside the GraphFactory in mesh-capable serving modules),
+  SHD002 (use of a donated buffer after the donating call) and DTY001
+  (raw int8 KV symbols imported outside the declared carrier modules).
+  These run inside ``python -m tpu9.analysis`` with the normal
+  suppression/baseline machinery, and again under the graphcheck CLI.
+
+Run it:
+
+    python -m tpu9.analysis.graphcheck              # full matrix + Pass B
+    python -m tpu9.analysis.graphcheck --cell llama3-8b@2x1
+    python -m tpu9.analysis.graphcheck --format json
+
+``scripts/graph_gate.py`` is the tier-1 wiring (budgeted, loud skip with
+a re-run recipe when the forced 8-device CPU mesh is unavailable).
+
+This module stays import-light (no jax): the lint runner imports Pass B
+from here on every lint run; Pass A's jax machinery loads only when a
+matrix actually runs.
+"""
+
+from .astrules import GRAPH_AST_RULES, check_graph_file
+
+__all__ = ["GRAPH_AST_RULES", "check_graph_file"]

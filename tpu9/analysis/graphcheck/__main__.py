@@ -1,0 +1,105 @@
+"""CLI entry: ``python -m tpu9.analysis.graphcheck``.
+
+Runs Pass A (abstract lowering over the preset × topology matrix) and
+Pass B (the SHD001/SHD002/DTY001 AST rules through the normal tpu9lint
+gate, baseline + suppressions applied).
+
+Exit codes: 0 clean, 1 findings, 2 internal errors, 3 device guard
+tripped (no forced 8-device CPU mesh available — the report says how to
+re-run; ``--skip-ok`` maps it to 0 for wrappers that handle the skip
+themselves).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu9.analysis.graphcheck",
+        description="static verification of sharding/dtype/donation "
+                    "invariants in the traced serving graphs")
+    ap.add_argument("--cell", action="append", default=None,
+                    help="run only this matrix cell (repeatable); "
+                         "default: the full matrix")
+    ap.add_argument("--list-cells", action="store_true")
+    ap.add_argument("--no-compile", action="store_true",
+                    help="skip the compiled-artifact checks (aliasing, "
+                         "input shardings) — jaxpr-level only, faster")
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip Pass B (the AST rules)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="report format (json: the stable machine-"
+                         "readable schema shared with tpu9lint)")
+    ap.add_argument("--skip-ok", action="store_true",
+                    help="exit 0 (not 3) when the device guard trips")
+    ap.add_argument("--repo-root", default=None)
+    args = ap.parse_args(argv)
+
+    from .matrix import MATRIX, find_cells
+    if args.list_cells:
+        for c in MATRIX:
+            print(c.name)
+        return 0
+
+    # the 8-device CPU mesh must be forced BEFORE jax latches a platform
+    from tpu9.utils import force_cpu
+    force_cpu(host_devices=8)
+
+    from ..findings import (JSON_SCHEMA_VERSION, finding_json,
+                            load_baseline)
+    from ..runner import (DEFAULT_BASELINE, find_repo_root, gate,
+                          run_analysis)
+    from .astrules import GRAPH_AST_RULES
+    from . import passes
+
+    guard = passes.device_guard()
+    if guard is not None:
+        print(f"graphcheck: SKIP — {guard}", file=sys.stderr)
+        return 0 if args.skip_ok else 3
+
+    try:
+        cells = find_cells(args.cell)
+    except KeyError as exc:
+        print(f"graphcheck: {exc}", file=sys.stderr)
+        return 2
+
+    report = passes.run_matrix(cells, compile_jobs=not args.no_compile)
+    graph_findings = list(report["findings"])
+
+    lint_new = []
+    if not args.no_lint:
+        import os
+        repo_root = args.repo_root or find_repo_root()
+        result = run_analysis(repo_root, select=set(GRAPH_AST_RULES))
+        bl_path = os.path.join(repo_root, DEFAULT_BASELINE)
+        lint_new, _known, _stale = gate(result, load_baseline(bl_path))
+    findings = graph_findings + lint_new
+
+    if args.format == "json":
+        # same record schema as `python -m tpu9.analysis --format json`
+        print(json.dumps({
+            "version": JSON_SCHEMA_VERSION,
+            "tool": "graphcheck",
+            "cells": report["cells"],
+            "elapsed_s": report["elapsed_s"],
+            "findings": [finding_json(f, "graph") for f in graph_findings]
+            + [finding_json(f, "new") for f in lint_new],
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f.format())
+        cells_s = ", ".join(f"{s['cell']}({s['jobs']} graphs, "
+                            f"{s['elapsed_s']}s)" for s in report["cells"])
+        print(f"graphcheck: {len(report['cells'])} cells in "
+              f"{report['elapsed_s']}s — {len(findings)} findings "
+              f"({len(lint_new)} from Pass B)")
+        print(f"  {cells_s}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

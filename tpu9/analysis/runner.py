@@ -33,6 +33,11 @@ ALL_RULES = {
     "OBS001": "wall-clock (time.time) arithmetic for a duration/deadline "
               "in serving/router/worker hot-path files",
     "BND001": "import-boundary contract violation (boundaries.toml)",
+    "SHD001": "jax.jit opened outside the GraphFactory in mesh-capable "
+              "serving modules (no explicit out_shardings)",
+    "SHD002": "donated buffer read after the donating jit call",
+    "DTY001": "raw int8 KV symbol imported outside the declared carrier "
+              "modules (boundaries.toml [graphcheck])",
     "SUP001": "noqa suppression without a mandatory reason",
 }
 
@@ -104,13 +109,17 @@ def run_analysis(repo_root: Optional[str] = None,
             result.parse_errors.append(f"{rel}: {exc}")
     result.files_scanned = len(trees)
 
-    raw: list[Finding] = []
-    for rel, tree in trees.items():
-        raw.extend(rules.check_file(rel, tree))
-
     cfg_path = boundaries_toml or BOUNDARIES_TOML
     cfg = (bnd.BoundaryConfig.load(cfg_path)
            if os.path.exists(cfg_path) else bnd.BoundaryConfig())
+
+    from .graphcheck.astrules import GraphLintConfig, check_graph_file
+    gcfg = GraphLintConfig.from_dict(cfg.graph)
+    raw: list[Finding] = []
+    for rel, tree in trees.items():
+        raw.extend(rules.check_file(rel, tree))
+        raw.extend(check_graph_file(rel, tree, gcfg))
+
     raw.extend(bnd.check_boundaries(trees, cfg))
 
     hot = {rel: tree for rel, tree in trees.items()

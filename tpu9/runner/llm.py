@@ -267,7 +267,13 @@ async def amain() -> None:
                               # this replica runs and its worst-chip live
                               # HBM — the fleet view's multichip evidence
                               "topo_tp", "topo_fsdp", "topo_n_chips",
-                              "hbm_used_gb_per_chip"):
+                              "hbm_used_gb_per_chip",
+                              # recompile sentinel (ISSUE 11): a non-zero
+                              # post_warmup count is a mid-serve XLA
+                              # compile — the closed-signature invariant
+                              # broke at runtime
+                              "graph_compiles",
+                              "graph_compiles_post_warmup"):
                         if k in stats:
                             extra[k] = stats[k]
                     pc = stats.get("prefix_cache")

@@ -528,8 +528,12 @@ class InferenceEngine:
             timings["splice_gather_s"] = _time.perf_counter() - t0
             g = max(1, self.ecfg.admit_group_chunks)
             if g > 1:
-                # fused admission graph for the steady-state group size;
-                # partial tail groups (2..g-1 chunks) compile on first use
+                # fused admission graph for the steady-state group size.
+                # Partial tails never need their own scan shape:
+                # _admit_paged drops to the warmed single-chunk graphs
+                # for them, so this IS the last reachable signature
+                # (graphcheck GRA005 / the recompile sentinel both
+                # assert the set is closed here)
                 t0 = _time.perf_counter()
                 s = self.ecfg.max_seq_len
                 offs = np.minimum(np.arange(g) * self._chunk,
@@ -548,10 +552,22 @@ class InferenceEngine:
             for bucket in self._buckets:
                 t0 = _time.perf_counter()
                 tokens = jnp.zeros((1, bucket), jnp.int32)
-                last, _cache = self._prefill_fn(bucket)(self.params,
-                                                        tokens, 1)
+                last, cache = self._prefill_fn(bucket)(self.params,
+                                                       tokens, 1)
                 np.asarray(jax.device_get(last[:4]))
                 timings[f"prefill_{bucket}_s"] = _time.perf_counter() - t0
+                # the dense splice too (ISSUE 11): warmup previously left
+                # it to compile on the FIRST admission — a post-seal
+                # cache miss the recompile sentinel now counts as a
+                # mid-serve stall. State threads back (slot 0's lanes get
+                # the zero-prompt prefix; cache_len stays 0, so nothing
+                # ever attends it).
+                t0 = _time.perf_counter()
+                self.kv_cache["k"], self.kv_cache["v"] = \
+                    self._dense_splice_fn(bucket)(
+                        self.kv_cache["k"], self.kv_cache["v"],
+                        cache["k"], cache["v"], 0)
+                timings[f"dsplice_{bucket}_s"] = _time.perf_counter() - t0
         inactive = jnp.zeros((self.ecfg.max_batch,), bool)
         for k in self.ecfg.decode_steps:
             t0 = _time.perf_counter()
@@ -572,6 +588,9 @@ class InferenceEngine:
                 self.cache_len, inactive, self._rng)
             np.asarray(jax.device_get(out[:4, 0]))
             timings[f"verify_s{s}_s"] = _time.perf_counter() - t0
+        # recompile sentinel (ISSUE 11): warmup traced every steady-state
+        # graph; from here a cache miss is a mid-serve compile incident
+        self.graphs.seal()
         return timings
 
     async def stop(self) -> None:
@@ -659,6 +678,13 @@ class InferenceEngine:
         out["token_pressure"] = float(
             self._host_len.sum()
             / (self.ecfg.max_batch * self.ecfg.max_seq_len))
+        # recompile sentinel (ISSUE 11): executable-cache misses. A
+        # non-zero post_warmup count after warmup/precompile means a
+        # serve-loop dispatch stalled every stream behind an XLA compile
+        # — the runtime face of graphcheck's closed-signature invariant
+        # (the factory also logs each incident loudly).
+        out["graph_compiles"] = self.graphs.compiles
+        out["graph_compiles_post_warmup"] = self.graphs.post_seal_compiles
         # topology (ISSUE 9): flat scalars so the runner heartbeat can
         # forward them into the store hash behind /api/v1/metrics
         # "engines" unchanged — tp/fsdp/n_chips plus live per-chip HBM

@@ -22,8 +22,9 @@ the same physical indices as the payload (``traced_splice``).
 
 from __future__ import annotations
 
+import logging
 import time
-from typing import Any
+from typing import Any, Iterator
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +33,8 @@ from ..models.transformer import decoder_forward, init_kv_cache
 from ..ops.sampling import sample_logits
 
 Params = dict[str, Any]
+
+log = logging.getLogger("tpu9.serving")
 
 
 class GraphFactory:
@@ -48,6 +51,36 @@ class GraphFactory:
         self.chunk = chunk
         self.kv_quant = kv_quant
         self.compiled: dict[Any, Any] = {}
+        # recompile sentinel (ISSUE 11): executable-cache misses. After
+        # seal() (warmup/precompile done) a miss means steady-state
+        # serving is about to stall every active stream behind an XLA
+        # compile — the runtime face of graphcheck's closed-signature
+        # pass, surfaced via engine.stats()["graph_compiles*"].
+        self.compiles = 0
+        self.post_seal_compiles = 0
+        self._sealed = False
+
+    def _build(self, key, builder):
+        """Cache-or-build a graph under ``key`` — the ONE miss path, so
+        the sentinel can't be bypassed by a new getter."""
+        fn = self.compiled.get(key)
+        if fn is None:
+            self.compiles += 1
+            if self._sealed:
+                self.post_seal_compiles += 1
+                log.warning(
+                    "post-warmup graph compile: key=%r — a steady-state "
+                    "window is stalling behind an XLA compile; the "
+                    "precompile signature set is open (graphcheck GRA005 "
+                    "should have caught this)", key)
+            fn = self.compiled[key] = builder()
+        return fn
+
+    def seal(self) -> None:
+        """Mark the executable cache complete: every signature the serve
+        loop can request is compiled. Called by engine warmup/precompile;
+        later misses are counted + logged as recompile incidents."""
+        self._sealed = True
 
     # -- decode window -------------------------------------------------------
 
@@ -85,11 +118,7 @@ class GraphFactory:
         return jax.jit(decode, donate_argnums=(1,))
 
     def decode_k(self, k: int):
-        key = ("decode", k)
-        fn = self.compiled.get(key)
-        if fn is None:
-            fn = self.compiled[key] = self.build_decode(k)
-        return fn
+        return self._build(("decode", k), lambda: self.build_decode(k))
 
     # -- speculative verify --------------------------------------------------
 
@@ -133,51 +162,44 @@ class GraphFactory:
         return jax.jit(verify, donate_argnums=(1,))
 
     def verify_fn(self, s: int):
-        key = ("verify", s)
-        fn = self.compiled.get(key)
-        if fn is None:
-            fn = self.compiled[key] = self.build_verify(s)
-        return fn
+        return self._build(("verify", s), lambda: self.build_verify(s))
 
     # -- dense prefill -------------------------------------------------------
 
     def prefill_fn(self, bucket: int):
-        if bucket in self.compiled:
-            return self.compiled[bucket]
         cfg, policy = self.cfg, self.policy
 
-        def prefill(params, tokens, length):
-            # tokens [1, bucket] padded; returns logits at the last real
-            # token and the per-layer k/v for the prefix.
-            logits, cache = decoder_forward(
-                params, tokens, cfg,
-                kv_cache=init_kv_cache(cfg, 1, bucket), decode=False)
-            last = logits[0, length - 1]
-            return last, policy.constrain_kv(cache)
+        def build():
+            def prefill(params, tokens, length):
+                # tokens [1, bucket] padded; returns logits at the last
+                # real token and the per-layer k/v for the prefix.
+                logits, cache = decoder_forward(
+                    params, tokens, cfg,
+                    kv_cache=init_kv_cache(cfg, 1, bucket), decode=False)
+                last = logits[0, length - 1]
+                return last, policy.constrain_kv(cache)
 
-        fn = jax.jit(prefill)
-        self.compiled[bucket] = fn
-        return fn
+            return jax.jit(prefill)
+
+        return self._build(bucket, build)
 
     def dense_splice_fn(self, bucket: int):
         """Jitted, cache-donating copy of a prefill's [L,1,bucket,...] KV
         into one slot's lanes of the dense [L,B,S,...] cache."""
-        key = ("dsplice", bucket)
-        fn = self.compiled.get(key)
-        if fn is not None:
-            return fn
         policy = self.policy
 
-        def splice(k, v, ck, cv, slot):
-            k = jax.lax.dynamic_update_slice(
-                k, ck[:, :, :bucket], (0, slot, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(
-                v, cv[:, :, :bucket], (0, slot, 0, 0, 0))
-            out = policy.constrain_kv({"k": k, "v": v})
-            return out["k"], out["v"]
+        def build():
+            def splice(k, v, ck, cv, slot):
+                k = jax.lax.dynamic_update_slice(
+                    k, ck[:, :, :bucket], (0, slot, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    v, cv[:, :, :bucket], (0, slot, 0, 0, 0))
+                out = policy.constrain_kv({"k": k, "v": v})
+                return out["k"], out["v"]
 
-        fn = self.compiled[key] = jax.jit(splice, donate_argnums=(0, 1))
-        return fn
+            return jax.jit(splice, donate_argnums=(0, 1))
+
+        return self._build(("dsplice", bucket), build)
 
     # -- paged chunked prefill -----------------------------------------------
 
@@ -223,20 +245,18 @@ class GraphFactory:
         batch-1 dense scratch at ``offset``, attend over prefix+chunk, and
         return the logits at ``last_idx`` (the chunk's final real token).
         Shapes are (C, S) — prompt length never changes the graph."""
-        key = ("chunk", self.chunk)
-        fn = self.compiled.get(key)
-        if fn is not None:
-            return fn
         policy = self.policy
 
-        def chunk(params, tokens, offset, scratch, last_idx):
-            last, scratch = self.traced_chunk_step(params, scratch,
-                                                   tokens[0], offset,
-                                                   last_idx)
-            return last, policy.constrain_kv(scratch)
+        def build():
+            def chunk(params, tokens, offset, scratch, last_idx):
+                last, scratch = self.traced_chunk_step(params, scratch,
+                                                       tokens[0], offset,
+                                                       last_idx)
+                return last, policy.constrain_kv(scratch)
 
-        fn = self.compiled[key] = jax.jit(chunk, donate_argnums=(3,))
-        return fn
+            return jax.jit(chunk, donate_argnums=(3,))
+
+        return self._build(("chunk", self.chunk), build)
 
     def gather_fn(self):
         """Jitted densify of ONE slot's table row into the scratch (prefix
@@ -245,43 +265,37 @@ class GraphFactory:
         dtype, so chunk prefill attends exact dequantized values. The
         traced body derives the table width from the row argument (one
         cache entry regardless of width — it never changes mid-lifetime)."""
-        fn = self.compiled.get("gather")
-        if fn is not None:
-            return fn
-
         s = self.ecfg.max_seq_len
         dt = self.cfg.dtype
         policy = self.policy
 
-        def gather(pool, row):
-            # pool [L, N, BS, KH, D], row [MB] → dense [L, 1, S, KH, D].
-            # The row's final column is the ALWAYS-TRASH block — slice it
-            # off so the densified prefix has the exact scratch shape
-            # (an S+BS-wide scratch trips the rope-table width validation
-            # when max_seq_len == the model's rope limit)
-            def one(p, sc):
-                g = p[:, row]                        # [L, MB, BS, KH, D]
-                if sc is not None:
-                    g = g.astype(jnp.float32) * sc[:, row][..., None]
-                l, mb_, bs, kh, d = g.shape
-                return g.astype(dt).reshape(l, 1, mb_ * bs, kh, d)[:, :, :s]
-            return policy.constrain_kv(
-                {"k": one(pool["k"], pool.get("k_scale")),
-                 "v": one(pool["v"], pool.get("v_scale"))})
+        def build():
+            def gather(pool, row):
+                # pool [L, N, BS, KH, D], row [MB] → dense [L, 1, S, KH,
+                # D]. The row's final column is the ALWAYS-TRASH block —
+                # slice it off so the densified prefix has the exact
+                # scratch shape (an S+BS-wide scratch trips the rope-table
+                # width validation when max_seq_len == the rope limit)
+                def one(p, sc):
+                    g = p[:, row]                    # [L, MB, BS, KH, D]
+                    if sc is not None:
+                        g = g.astype(jnp.float32) * sc[:, row][..., None]
+                    l, mb_, bs, kh, d = g.shape
+                    return g.astype(dt).reshape(
+                        l, 1, mb_ * bs, kh, d)[:, :, :s]
+                return policy.constrain_kv(
+                    {"k": one(pool["k"], pool.get("k_scale")),
+                     "v": one(pool["v"], pool.get("v_scale"))})
 
-        fn = self.compiled["gather"] = jax.jit(gather)
-        return fn
+            return jax.jit(gather)
+
+        return self._build("gather", build)
 
     def splice_fn(self):
         """Jitted copy of one chunk's blocks from the scratch into their
         physical pool blocks. C/BS is static → one graph."""
-        fn = self.compiled.get("splice")
-        if fn is not None:
-            return fn
-
-        fn = self.compiled["splice"] = jax.jit(
-            self.traced_splice, donate_argnums=(0,))
-        return fn
+        return self._build("splice", lambda: jax.jit(
+            self.traced_splice, donate_argnums=(0,)))
 
     def chunk_group_fn(self, g: int):
         """Fused admission graph (VERDICT r04 #6): lax.scan over ``g``
@@ -290,31 +304,132 @@ class GraphFactory:
         host bookkeeping (table math, array uploads) collapses into one
         transfer of [g, ...] arrays. Returns the final chunk's last-token
         logits so the caller can sample the first output."""
-        key = ("chunkgroup", g)
-        fn = self.compiled.get(key)
-        if fn is not None:
-            return fn
         policy = self.policy
 
-        def group(params, pool, scratch, toks, offsets, last_idxs, phys):
-            # toks [g, C] offsets [g] last_idxs [g] phys [g, C/BS]
-            def body(carry, xs):
-                pool, scratch = carry
-                tok, off, li, ph = xs
-                last, scratch = self.traced_chunk_step(
-                    params, scratch, tok, off, li)
-                pool = self.traced_splice(
-                    pool, scratch["k"], scratch["v"], off, ph)
-                return (pool, scratch), last
+        def build():
+            def group(params, pool, scratch, toks, offsets, last_idxs,
+                      phys):
+                # toks [g, C] offsets [g] last_idxs [g] phys [g, C/BS]
+                def body(carry, xs):
+                    pool, scratch = carry
+                    tok, off, li, ph = xs
+                    last, scratch = self.traced_chunk_step(
+                        params, scratch, tok, off, li)
+                    pool = self.traced_splice(
+                        pool, scratch["k"], scratch["v"], off, ph)
+                    return (pool, scratch), last
 
-            (pool, scratch), lasts = jax.lax.scan(
-                body, (pool, scratch), (toks, offsets, last_idxs, phys))
-            return pool, policy.constrain_kv(scratch), lasts[-1]
+                (pool, scratch), lasts = jax.lax.scan(
+                    body, (pool, scratch), (toks, offsets, last_idxs,
+                                            phys))
+                return pool, policy.constrain_kv(scratch), lasts[-1]
 
-        fn = self.compiled[key] = jax.jit(group, donate_argnums=(1, 2))
-        return fn
+            return jax.jit(group, donate_argnums=(1, 2))
 
-    # -- compile-ahead (AOT) -------------------------------------------------
+        return self._build(("chunkgroup", g), build)
+
+    # -- compile-ahead (AOT) + static verification hooks ---------------------
+
+    def lowering_jobs(self, params, kv_cache: Params, pool: Params,
+                      scratch: Params, mb: int, buckets, spec_lens,
+                      rng) -> Iterator[tuple]:
+        """Enumerate every steady-state serving graph as ``(key, fn,
+        abstract_args)`` — THE introspection surface (ISSUE 11): both
+        :meth:`precompile` (lower+compile each job) and graphcheck's
+        Pass A (lower each job and verify sharding/dtype/donation
+        invariants from the jaxpr and compiled artifact) drive this one
+        enumeration, so the verified signature set and the precompiled
+        signature set cannot drift apart. Arguments may be real arrays or
+        ``jax.ShapeDtypeStruct`` trees — only shapes/dtypes are read.
+        Scalar positions yield concrete ints — the weak-typed aval the
+        serve loop's python-int arguments produce."""
+        policy = self.policy
+        pspec = policy.abstract(params)
+        b = self.ecfg.max_batch
+        i32 = jnp.int32
+        if self.chunk:
+            bs = self.ecfg.kv_block_size
+            c = self.chunk
+            ascratch = policy.abstract(scratch, kv=True)
+            apool = policy.abstract(pool, kv=True)
+            yield (("chunk", c), self.chunk_fn(),
+                   (pspec, jax.ShapeDtypeStruct((1, c), i32), 0, ascratch,
+                    0))
+            yield ("splice", self.splice_fn(),
+                   (apool, ascratch["k"], ascratch["v"], 0,
+                    jax.ShapeDtypeStruct((c // bs,), i32)))
+            yield ("gather", self.gather_fn(),
+                   (apool, jax.ShapeDtypeStruct((mb,), i32)))
+            g = max(1, self.ecfg.admit_group_chunks)
+            if g > 1:
+                yield (("chunkgroup", g), self.chunk_group_fn(g),
+                       (pspec, apool, ascratch,
+                        jax.ShapeDtypeStruct((g, c), i32),
+                        jax.ShapeDtypeStruct((g,), i32),
+                        jax.ShapeDtypeStruct((g,), i32),
+                        jax.ShapeDtypeStruct((g, c // bs), i32)))
+        else:
+            cfg = self.cfg
+            for bucket in buckets:
+                pre = jax.ShapeDtypeStruct(
+                    (cfg.n_layers, 1, bucket, cfg.n_kv_heads,
+                     cfg.head_dim), cfg.dtype)
+                adense = policy.abstract(
+                    {"k": kv_cache["k"], "v": kv_cache["v"]}, kv=True)
+                yield (bucket, self.prefill_fn(bucket),
+                       (pspec, jax.ShapeDtypeStruct((1, bucket), i32), 1))
+                yield (("dsplice", bucket), self.dense_splice_fn(bucket),
+                       (adense["k"], adense["v"], pre, pre, 0))
+        kv_spec = policy.abstract(kv_cache, kv=True)
+        arng = policy.abstract(rng)
+        for k in self.ecfg.decode_steps:
+            yield (("decode", k), self.decode_k(k),
+                   (pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
+                    jax.ShapeDtypeStruct((b,), i32),
+                    jax.ShapeDtypeStruct((b,), jnp.bool_),
+                    arng))
+        for s in spec_lens:
+            yield (("verify", s), self.verify_fn(s),
+                   (pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
+                    jax.ShapeDtypeStruct((b, s), i32),
+                    jax.ShapeDtypeStruct((b,), i32),
+                    jax.ShapeDtypeStruct((b,), jnp.bool_),
+                    arng))
+
+    def reachable_keys(self, buckets, spec_lens) -> set:
+        """Every executable-cache key the serve loop can request in steady
+        state — the OTHER half of graphcheck's closed-signature invariant
+        (GRA005: this set must equal the :meth:`lowering_jobs` key set).
+
+        One entry per dispatch site; when adding a dispatch that resolves
+        a new key shape, extend BOTH this enumeration and
+        ``lowering_jobs`` or the gate fails:
+
+        - ``("decode", k)``: ``WindowScheduler.pick_steps`` and the
+          admission-interleaved window pick only from
+          ``ecfg.decode_steps``.
+        - ``("verify", s)``: ``WindowScheduler.spec_room_len`` picks only
+          from the engine's ``spec_lens`` buckets.
+        - ``("chunk", c)`` / ``"splice"`` / ``"gather"``: paged admission
+          — ONE validated chunk length; partial tail groups reuse these,
+          never a fresh scan shape.
+        - ``("chunkgroup", g)``: paged admission dispatches FULL groups
+          only (``_admit_paged`` drops to the single-chunk graphs for
+          tails).
+        - ``bucket`` / ``("dsplice", bucket)``: dense admission buckets,
+          clamped to max_seq_len by the engine (``_bucket_for``).
+        """
+        keys: set = {("decode", k) for k in self.ecfg.decode_steps}
+        keys |= {("verify", s) for s in spec_lens}
+        if self.chunk:
+            keys |= {("chunk", self.chunk), "splice", "gather"}
+            g = max(1, self.ecfg.admit_group_chunks)
+            if g > 1:
+                keys.add(("chunkgroup", g))
+        else:
+            for bucket in buckets:
+                keys |= {bucket, ("dsplice", bucket)}
+        return keys
 
     def precompile(self, params, kv_cache: Params, pool: Params,
                    scratch: Params, mb: int, buckets, spec_lens,
@@ -329,69 +444,44 @@ class GraphFactory:
         under the same cache key the serve loop resolves. On a mesh
         policy the abstract specs carry NamedShardings, so the lowered
         executables are the exact SPMD programs the serve loop will
-        dispatch. Scalar positions are lowered with concrete ints — the
-        weak-typed aval the serve loop's python-int arguments produce."""
+        dispatch. Seals the cache afterwards: any later miss is a
+        recompile incident (counted + logged loudly)."""
         timings: dict[str, float] = {}
-        policy = self.policy
-
-        def aot(key, fn, *args) -> None:
+        for key, fn, args in self.lowering_jobs(
+                params, kv_cache, pool, scratch, mb, buckets, spec_lens,
+                rng):
             if not hasattr(fn, "lower"):
-                return                    # already an AOT executable
+                continue                  # already an AOT executable
             t0 = time.perf_counter()
             self.compiled[key] = fn.lower(*args).compile()
             name = "_".join(str(p) for p in key) \
                 if isinstance(key, tuple) else str(key)
             timings[f"compile_{name}_s"] = \
                 round(time.perf_counter() - t0, 4)
-
-        pspec = policy.abstract(params)
-        b = self.ecfg.max_batch
-        i32 = jnp.int32
-        if self.chunk:
-            bs = self.ecfg.kv_block_size
-            c = self.chunk
-            ascratch = policy.abstract(scratch, kv=True)
-            apool = policy.abstract(pool, kv=True)
-            aot(("chunk", c), self.chunk_fn(),
-                pspec, jax.ShapeDtypeStruct((1, c), i32), 0, ascratch, 0)
-            aot("splice", self.splice_fn(),
-                apool, ascratch["k"], ascratch["v"], 0,
-                jax.ShapeDtypeStruct((c // bs,), i32))
-            aot("gather", self.gather_fn(),
-                apool, jax.ShapeDtypeStruct((mb,), i32))
-            g = max(1, self.ecfg.admit_group_chunks)
-            if g > 1:
-                aot(("chunkgroup", g), self.chunk_group_fn(g),
-                    pspec, apool, ascratch,
-                    jax.ShapeDtypeStruct((g, c), i32),
-                    jax.ShapeDtypeStruct((g,), i32),
-                    jax.ShapeDtypeStruct((g,), i32),
-                    jax.ShapeDtypeStruct((g, c // bs), i32))
-        else:
-            cfg = self.cfg
-            for bucket in buckets:
-                pre = jax.ShapeDtypeStruct(
-                    (cfg.n_layers, 1, bucket, cfg.n_kv_heads,
-                     cfg.head_dim), cfg.dtype)
-                adense = policy.abstract(
-                    {"k": kv_cache["k"], "v": kv_cache["v"]}, kv=True)
-                aot(bucket, self.prefill_fn(bucket),
-                    pspec, jax.ShapeDtypeStruct((1, bucket), i32), 1)
-                aot(("dsplice", bucket), self.dense_splice_fn(bucket),
-                    adense["k"], adense["v"], pre, pre, 0)
-        kv_spec = policy.abstract(kv_cache, kv=True)
-        arng = policy.abstract(rng)
-        for k in self.ecfg.decode_steps:
-            aot(("decode", k), self.decode_k(k),
-                pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
-                jax.ShapeDtypeStruct((b,), i32),
-                jax.ShapeDtypeStruct((b,), jnp.bool_),
-                arng)
-        for s in spec_lens:
-            aot(("verify", s), self.verify_fn(s),
-                pspec, kv_spec, jax.ShapeDtypeStruct((b, 1), i32),
-                jax.ShapeDtypeStruct((b, s), i32),
-                jax.ShapeDtypeStruct((b,), i32),
-                jax.ShapeDtypeStruct((b,), jnp.bool_),
-                arng)
+        self.seal()
         return timings
+
+
+def abstract_state(cfg, ecfg, policy, kv_quant: bool = False) -> dict:
+    """Device-free abstract serving state for :meth:`GraphFactory.
+    lowering_jobs`: the kv_cache/pool/scratch ``ShapeDtypeStruct`` trees
+    an engine of this (model, engine-config) pair would hold, without
+    allocating a byte. Shapes come from the same sources the engine uses
+    (``KvPool`` for the paged pool, ``init_kv_cache`` via ``eval_shape``
+    for dense/scratch), so graphcheck lowers EXACTLY the engine's graphs.
+    Returns ``{"kv_cache", "pool", "scratch", "mb", "rng"}`` (paged) or
+    the dense equivalents (empty pool/scratch, mb=0)."""
+    rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    if ecfg.kv_block_size:
+        from .kvpool import KvPool
+        mgr = KvPool(cfg, ecfg, kv_quant, policy)
+        kv_cache = mgr.array_specs()
+        pool = {k: v for k, v in kv_cache.items() if k != "table"}
+        scratch = jax.eval_shape(
+            lambda: init_kv_cache(cfg, 1, ecfg.max_seq_len))
+        return {"kv_cache": kv_cache, "pool": pool, "scratch": scratch,
+                "mb": mgr.mb, "rng": rng}
+    kv_cache = jax.eval_shape(
+        lambda: init_kv_cache(cfg, ecfg.max_batch, ecfg.max_seq_len))
+    return {"kv_cache": kv_cache, "pool": {}, "scratch": {}, "mb": 0,
+            "rng": rng}

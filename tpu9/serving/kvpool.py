@@ -77,28 +77,44 @@ class KvPool:
         self.table_np = np.zeros((b, self.mb), dtype=np.int32)
         self.kv_allocs = 0           # lifetime block allocations
 
-    def init_arrays(self) -> Params:
-        """The pool's device state: payload (+ int8 scale planes) and the
-        block table — placed through the sharding policy (head axis over
-        tp on a mesh; plain single-device arrays otherwise)."""
+    def array_shapes(self) -> dict:
+        """``name -> (shape, dtype)`` for every pool array — the ONE shape
+        source :meth:`init_arrays` allocates from and
+        :meth:`array_specs` abstracts from (they cannot drift)."""
         import jax.numpy as jnp
         cfg, ecfg = self.cfg, self.ecfg
         pool_shape = (cfg.n_layers, self.n_blocks, ecfg.kv_block_size,
                       cfg.n_kv_heads, cfg.head_dim)
         dt = jnp.int8 if self.kv_quant else cfg.dtype
-        kv = {"k": self.policy.zeros(pool_shape, dt, "k"),
-              "v": self.policy.zeros(pool_shape, dt, "v"),
-              "table": self.policy.device_table(self.table_np)}
+        shapes = {"k": (pool_shape, dt), "v": (pool_shape, dt),
+                  "table": (self.table_np.shape, jnp.int32)}
         if self.kv_quant:
             # per-(position, head) f32 absmax scales alongside the pool
             # (ops.quant.quantize_kv) — same [N, BS, KH] indexing as the
             # payload so every write/read shares the table math
             sc_shape = pool_shape[:-1]
-            kv["k_scale"] = self.policy.zeros(sc_shape, jnp.float32,
-                                              "k_scale")
-            kv["v_scale"] = self.policy.zeros(sc_shape, jnp.float32,
-                                              "v_scale")
+            shapes["k_scale"] = (sc_shape, jnp.float32)
+            shapes["v_scale"] = (sc_shape, jnp.float32)
+        return shapes
+
+    def init_arrays(self) -> Params:
+        """The pool's device state: payload (+ int8 scale planes) and the
+        block table — placed through the sharding policy (head axis over
+        tp on a mesh; plain single-device arrays otherwise)."""
+        kv = {name: self.policy.zeros(shape, dt, name)
+              for name, (shape, dt) in self.array_shapes().items()
+              if name != "table"}
+        kv["table"] = self.policy.device_table(self.table_np)
         return kv
+
+    def array_specs(self) -> Params:
+        """Abstract (``jax.ShapeDtypeStruct``) twin of :meth:`init_arrays`
+        — the device-free face graphcheck and compile-ahead lower
+        against. Plain structs, no shardings: callers route them through
+        ``policy.abstract(..., kv=True)`` exactly as the engine does."""
+        import jax
+        return {name: jax.ShapeDtypeStruct(shape, dt)
+                for name, (shape, dt) in self.array_shapes().items()}
 
     # -- block allocation ----------------------------------------------------
 

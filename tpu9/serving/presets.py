@@ -53,21 +53,27 @@ def build_params(name: str, seed: int = 0, quantize: Optional[str] = None):
     return init_decoder(rng, cfg), cfg
 
 
-def params_spec(name: str, quantize: Optional[str] = None):
-    """Abstract (``jax.ShapeDtypeStruct``) param tree for a preset — the
-    shapes compile-ahead needs before a single weight byte has streamed.
-    ``jax.eval_shape`` over the init fn, so spec and real params can never
-    drift apart."""
+def abstract_params_for(cfg, quantized: bool = False):
+    """Abstract (``jax.ShapeDtypeStruct``) param tree for an explicit
+    DecoderConfig — ``jax.eval_shape`` over the same init fn real params
+    come from, so spec and params can never drift apart. Exposed for
+    graphcheck's depth-reduced matrix cells (ISSUE 11); presets go
+    through :func:`params_spec`."""
     import jax
-    cfg, quantized = resolve_preset(name, quantize)
     if quantized:
         from ..ops.quant import init_quantized_decoder
         init = init_quantized_decoder
     else:
         from ..models import init_decoder
         init = init_decoder
-    spec = jax.eval_shape(lambda rng: init(rng, cfg), jax.random.PRNGKey(0))
-    return spec, cfg
+    return jax.eval_shape(lambda rng: init(rng, cfg), jax.random.PRNGKey(0))
+
+
+def params_spec(name: str, quantize: Optional[str] = None):
+    """Abstract (``jax.ShapeDtypeStruct``) param tree for a preset — the
+    shapes compile-ahead needs before a single weight byte has streamed."""
+    cfg, quantized = resolve_preset(name, quantize)
+    return abstract_params_for(cfg, quantized), cfg
 
 
 def load_engine(name: str, *, max_batch: int = 8, max_seq_len: int = 2048,

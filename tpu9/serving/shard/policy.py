@@ -71,6 +71,26 @@ class SingleDevicePolicy:
     def constrain_kv(self, tree: Params) -> Params:
         return tree
 
+    # -- spec introspection (graphcheck — ISSUE 11) --------------------------
+    # The declared layout contract, exposed so the static verifier can
+    # check lowered graphs against it without groping mesh internals. On
+    # the identity policy every spec is None: nothing is sharded, and a
+    # verifier must expect NO constraint ops in the traced graphs.
+
+    def kv_spec(self, name: str, ndim: int):
+        """PartitionSpec this policy pins KV-state array ``name`` (rank
+        ``ndim``) to, or None when the policy places nothing."""
+        return None
+
+    def param_specs(self, tree: Any):
+        """``(declared, resolved)`` PartitionSpec trees for a param tree:
+        ``declared`` is the raw layout rule (Megatron TP×FSDP) and
+        ``resolved`` what actually shards after the divisibility
+        fallback — a leaf sharded in ``declared`` but replicated in
+        ``resolved`` is the silent-replication case graphcheck flags.
+        ``(None, None)`` on the identity policy."""
+        return None, None
+
     # -- abstract (compile-ahead) --------------------------------------------
 
     def abstract(self, tree: Any, kv: bool = False) -> Any:
@@ -101,10 +121,12 @@ class MeshPolicy(SingleDevicePolicy):
     def describe(self) -> dict:
         return self.topology.as_dict()
 
-    def _kv_spec(self, name: str, ndim: int):
+    def kv_spec(self, name: str, ndim: int):
         """PartitionSpec for one KV-state array by name/rank: payloads
         ``[..., KH, D]`` and scale planes ``[..., KH]`` shard the head
-        axis; tables (int32 block ids) replicate."""
+        axis; tables (int32 block ids) replicate. Public: this IS the
+        declared KV layout contract graphcheck verifies lowered graphs
+        against (ISSUE 11)."""
         from jax.sharding import PartitionSpec as P
         if name == "table" or ndim < 4:
             return P()
@@ -112,11 +134,25 @@ class MeshPolicy(SingleDevicePolicy):
         dims[ndim - 1 if name.endswith("_scale") else ndim - 2] = _HEAD_AXIS
         return P(*dims)
 
+    def param_specs(self, tree: Any):
+        """Declared + divisibility-resolved weight specs (see base)."""
+        from jax.sharding import PartitionSpec as P
+        from ...parallel import decoder_param_specs, fit_spec
+        try:
+            declared = decoder_param_specs(tree)
+        except (KeyError, TypeError):
+            declared = jax.tree_util.tree_map(lambda _: P(), tree)
+        resolved = jax.tree_util.tree_map(
+            lambda a, s: (fit_spec(a.shape, s, self.mesh)
+                          if hasattr(a, "shape") else s),
+            tree, declared, is_leaf=lambda x: isinstance(x, P))
+        return declared, resolved
+
     def _kv_sharding(self, name: str, shape):
         from jax.sharding import NamedSharding
         from ...parallel import fit_spec
         return NamedSharding(
-            self.mesh, fit_spec(shape, self._kv_spec(name, len(shape)),
+            self.mesh, fit_spec(shape, self.kv_spec(name, len(shape)),
                                 self.mesh))
 
     # -- placement -----------------------------------------------------------
@@ -172,22 +208,17 @@ class MeshPolicy(SingleDevicePolicy):
                         sharding=self._kv_sharding(name, a.shape))
                     for name, a in tree.items()}
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from ...parallel import decoder_param_specs, fit_spec
-        try:
-            specs = decoder_param_specs(tree)
-        except (KeyError, TypeError):
-            specs = jax.tree_util.tree_map(lambda _: P(), tree)
+        _, resolved = self.param_specs(tree)
 
         def one(a, spec):
             if not hasattr(a, "shape"):
                 return a
             return jax.ShapeDtypeStruct(
                 a.shape, a.dtype,
-                sharding=NamedSharding(self.mesh,
-                                       fit_spec(a.shape, spec, self.mesh)))
+                sharding=NamedSharding(self.mesh, spec))
 
         return jax.tree_util.tree_map(
-            one, tree, specs, is_leaf=lambda x: isinstance(x, P))
+            one, tree, resolved, is_leaf=lambda x: isinstance(x, P))
 
     # -- observability -------------------------------------------------------
 
