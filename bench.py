@@ -1813,6 +1813,38 @@ def bench_obs(quick: bool = False) -> dict:
         return (_min_time_us(one_window, iters, reps),
                 _min_time_us(one_request, iters, reps))
 
+    def microbench_fleet() -> tuple[float, float]:
+        """(per-timeline-record, per-SLO-evaluation) cost in µs with the
+        rings SATURATED to steady state (ISSUE 12): a full deque(maxlen)
+        ring is the append cost the gateway actually pays, and the burn
+        evaluator walks full fast/slow windows."""
+        from tpu9.config import SloConfig
+        from tpu9.observability.slo import SloEvaluator
+        from tpu9.observability.timeline import TimelineStore
+        iters, reps = (400, 3) if quick else (1500, 5)
+        cfg = SloConfig()
+        tl = TimelineStore(capacity=cfg.timeline_capacity)
+        # saturate: every series the sampler records per stub/replica,
+        # rings full, monotonic stamps fresh enough to land in windows
+        for name in ("router.st.queue_depth", "router.st.shed_rate",
+                     "router.st.pressure", "router.st.submitted_total",
+                     "router.st.shed_total", "router.st.ttft_p95_s",
+                     "router.st.queue_wait_p95_s",
+                     "engine.c0.tokens_per_sec", "engine.c0.kv_blocks_free",
+                     "engine.c0.spec_acceptance_rate"):
+            for i in range(cfg.timeline_capacity + 8):
+                tl.record(name, float(i))
+        ev = SloEvaluator(tl, cfg.objectives, burn_alert=cfg.burn_alert)
+
+        def one_record():
+            tl.record("router.st.queue_depth", 3.0)
+
+        def one_eval():
+            ev.evaluate("st")
+
+        return (_min_time_us(one_record, iters, reps),
+                _min_time_us(one_eval, iters, reps))
+
     async def run() -> dict:
         res: dict = {}
         off, on = build(False), build(True)
@@ -1889,6 +1921,24 @@ def bench_obs(quick: bool = False) -> dict:
         windows_ps = statistics.median([m[2] for m in ons]) / dur
         requests_ps = s["repeats"] * len(prompts) / dur
         frac = (win_us * windows_ps + req_us * requests_ps) / 1e6
+        # fleet evidence layer (ISSUE 12): the timeline sampler + burn
+        # evaluator run at FIXED cadences, not per token — price them at
+        # their worst per-replica rates (engine series each heartbeat,
+        # router series + one evaluation each sampler tick) and fold
+        # into the same ≤2% budget
+        rec_us, eval_us = microbench_fleet()
+        from tpu9.config import SloConfig as _SloCfg
+        _slo = _SloCfg()
+        heartbeat_series = 10          # engine series per replica beat
+        tick_series = 14               # router+slo series per stub tick
+        records_ps = (heartbeat_series / 2.0   # runner beat cadence
+                      + tick_series / _slo.sample_interval_s)
+        evals_ps = 1.0 / _slo.sample_interval_s
+        sampler_frac = (rec_us * records_ps + eval_us * evals_ps) / 1e6
+        frac += sampler_frac
+        res["obs_timeline_record_us"] = round(rec_us, 3)
+        res["obs_slo_eval_us"] = round(eval_us, 2)
+        res["obs_sampler_frac"] = round(sampler_frac, 6)
         res["obs_instr_window_us"] = round(win_us, 2)
         res["obs_instr_request_us"] = round(req_us, 2)
         res["obs_windows_per_sec"] = round(windows_ps, 2)

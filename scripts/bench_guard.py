@@ -66,12 +66,14 @@ GUARDED_FIELDS = {
     "multichip_total_ratio": "up",
     "multichip_weight_shard_ratio": "down",
     "multichip_planner_weight_err": "down",
-    # observability overhead (ISSUE 8): the deterministic instrumentation
-    # price (microbenched hook cost × measured window/request rates) must
-    # not creep. The wall-clock on/off ratio and the decomposition
-    # coverage are deliberately NOT guarded here — on a shared CPU host
-    # the ratio's cross-round noise is ±10-15% (the phase floors it) and
-    # coverage's goodness is "≈1", not monotonic; the phase gates both.
+    # observability overhead (ISSUE 8 + ISSUE 12): the deterministic
+    # instrumentation price (microbenched hook cost × measured window/
+    # request rates, PLUS the fleet timeline sampler + SLO burn evaluator
+    # at their fixed cadences) must not creep. The wall-clock on/off
+    # ratio and the decomposition coverage are deliberately NOT guarded
+    # here — on a shared CPU host the ratio's cross-round noise is
+    # ±10-15% (the phase floors it) and coverage's goodness is "≈1", not
+    # monotonic; the phase gates both.
     "obs_overhead_frac": "down",
 }
 

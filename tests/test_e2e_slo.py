@@ -1,0 +1,207 @@
+"""E2E: fleet SLO burn-rate monitoring + goodput accounting (ISSUE 12
+acceptance) — an overload driven through the REAL router on a live
+engine must show up as rising timeline series, a fast-window burn > 1
+attributed to shed, autoscaler pressure reflecting the burn, and a
+per-tenant goodput decomposition whose fractions partition 1.
+
+Plus the stale-replica aging satellite: a replica that stops beating
+ages out of the /api/v1/metrics engines merge instead of serving dead
+stats until the store TTL."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from tpu9.testing.localstack import LocalStack
+
+pytestmark = pytest.mark.e2e
+
+LLM_APP = """
+def load_engine():
+    from dataclasses import replace
+    import jax
+    from tpu9.models import init_decoder
+    from tpu9.models.llama import LLAMA_PRESETS
+    from tpu9.serving import EngineConfig, InferenceEngine
+
+    cfg = replace(LLAMA_PRESETS["llama-tiny"])
+    params = init_decoder(jax.random.PRNGKey(0), cfg)
+    return InferenceEngine(params, cfg,
+                           EngineConfig(max_batch=2, max_seq_len=512,
+                                        prefill_buckets=(16, 64)))
+"""
+
+
+async def test_overload_burns_slo_and_goodput_partitions():
+    async with LocalStack() as stack:
+        # tight front door so the burst both QUEUES (a backlog the
+        # sampler can see) and SHEDS (the availability burn's evidence):
+        # 1 in flight, 6 queued, the rest 429
+        router = stack.gateway.fleet_router
+        router.cfg.max_queue_depth = 6
+        router.cfg.default_replica_inflight = 1
+        router.admission.max_queue_depth = 6
+        router.budgets.default_inflight = 1
+        # fast observer ticks so the short burst lands in the windows
+        obs = stack.gateway.fleetobs
+        assert obs is not None
+        obs.cfg.sample_interval_s = 0.1
+
+        dep = await stack.deploy_endpoint(
+            "slollm", {"app.py": LLM_APP}, "app:load_engine",
+            config_extra={
+                "timeout_s": 240.0,
+                "extra": {"runner": "llm"},
+                "autoscaler": {"type": "token_pressure",
+                               "max_containers": 1}})
+        # warm (compiles the engine) — also the first TTFT sample the
+        # "rising" assertion compares the overload against
+        status, warm = await stack.api(
+            "POST", "/endpoint/slollm",
+            json_body={"tokens": [5, 3, 9], "max_new_tokens": 8},
+            timeout=240)
+        assert status == 200, warm
+
+        async def raw_invoke(i):
+            async with aiohttp.ClientSession(headers={
+                    "Authorization":
+                        f"Bearer {stack.gateway.default_token}"}) as s:
+                async with s.post(
+                        stack.base_url + "/endpoint/slollm",
+                        json={"tokens": [7, 11, i % 13 + 1],
+                              "max_new_tokens": 400},
+                        timeout=aiohttp.ClientTimeout(total=120)) as resp:
+                    return resp.status, await resp.text()
+
+        # two waves so the queue stays populated across sampler ticks
+        results = await asyncio.gather(*[raw_invoke(i) for i in range(12)])
+        results += await asyncio.gather(*[raw_invoke(i) for i in range(12)])
+        statuses = [r[0] for r in results]
+        assert 200 in statuses, results
+        assert any(s in (429, 503) for s in statuses), statuses
+
+        sid = dep["stub_id"]
+        # ---- /api/v1/slo: fast-window burn > 1, attributed to shed ----
+        avail = None
+        for _ in range(100):
+            status, slo = await stack.api("GET", "/api/v1/slo")
+            assert status == 200
+            row = slo["stubs"].get(sid)
+            if row:
+                avail = row["objectives"]["availability"]
+                if avail["fast"]["burn"] > 1.0:
+                    break
+            await asyncio.sleep(0.2)
+        assert avail is not None and avail["fast"]["burn"] > 1.0, avail
+        assert avail["fast"]["sheds"] >= 1
+        assert avail["attribution"] == "shed"
+        # declared objectives surface alongside the evaluations
+        assert {o["name"] for o in slo["objectives"]} >= {"ttft",
+                                                          "availability"}
+
+        # ---- autoscaler pressure reflects the burn ----
+        # shed saturation AND the SLO fold both push it to the ceiling;
+        # the slo_pressure field isolates the burn's own contribution
+        assert slo["stubs"][sid]["pressure"] == pytest.approx(1.0)
+        assert slo["stubs"][sid]["slo_pressure"] > 0.0
+        assert router.signals.pressure(sid) == pytest.approx(1.0)
+
+        # ---- /api/v1/timeline: queue-depth/TTFT series rose ----
+        status, tl = await stack.api(
+            "GET", f"/api/v1/timeline?series=router.{sid}.*")
+        assert status == 200
+        series = tl["series"]
+        qd = [v for _, v in series[f"router.{sid}.queue_depth"]]
+        assert max(qd) > 0, qd                      # queue built up
+        ttft = [v for _, v in series.get(f"router.{sid}.ttft_p95_s", [])]
+        assert ttft and max(ttft) > 0.0
+        assert max(ttft) >= ttft[0]                 # rose under overload
+        shed_series = [v for _, v in series[f"router.{sid}.shed_total"]]
+        assert shed_series[-1] >= 1                 # the burn's evidence
+        # listing mode names the engine series too (heartbeat-fed)
+        status, names = await stack.api("GET", "/api/v1/timeline")
+        assert status == 200
+        cids = [c.container_id
+                for c in await stack.running_containers(sid)]
+        assert any(n.startswith(f"engine.{cids[0]}.")
+                   for n in names["series_names"]), names["series_names"]
+
+        # ---- goodput decomposition partitions 1 ----
+        row = None
+        for _ in range(60):                         # ≥2 heartbeats (~4s)
+            status, m = await stack.api("GET", "/api/v1/metrics")
+            assert status == 200
+            for ws, cand in m.get("goodput", {}).items():
+                if cand.get("chip_seconds", 0) > 0 and \
+                        cand.get("useful_tokens", 0) > 0:
+                    row = cand
+                    break
+            if row:
+                break
+            await asyncio.sleep(0.5)
+        assert row is not None, m.get("goodput")
+        total = row["goodput_frac"] + sum(row["waste"].values())
+        assert total == pytest.approx(1.0, abs=1e-4), row
+        for frac in [row["goodput_frac"], *row["waste"].values()]:
+            assert 0.0 <= frac <= 1.0, row
+        assert row["goodput_tokens_per_chip_second"] > 0.0
+        assert sid in row["stubs"]
+        # the engines merge carries freshness stamps (aging satellite)
+        engines = m["engines"]
+        assert engines, m
+        snap = next(iter(engines.values()))
+        assert "age_s" in snap and "last_seen" in snap
+        assert float(snap["tokens_per_sec"]) >= 0.0
+
+
+async def test_replica_that_stops_beating_ages_out_of_metrics():
+    """ISSUE 12 satellite regression: two replicas heartbeat; one goes
+    silent. The engines merge keeps serving the live one and drops the
+    corpse after N beats — and the dead replica's goodput delta base is
+    forgotten so a restart starts a fresh interval."""
+    async with LocalStack() as stack:
+        obs = stack.gateway.fleetobs
+        # 3 beats × 0.2 s: silent > 0.6 s = dead
+        obs.cfg.stale_after_s = 0.6
+
+        dep = await stack.deploy_endpoint(
+            "age", {"app.py": "def handler(**kw):\n    return {'ok': 1}\n"},
+            "app:handler",
+            config_extra={"concurrent_requests": 2,
+                          "autoscaler": {"max_containers": 2,
+                                         "min_containers": 2}})
+        await stack.wait_running(dep["stub_id"], 2, timeout=60.0)
+        cids = [c.container_id
+                for c in await stack.running_containers(dep["stub_id"])]
+        assert len(cids) == 2
+
+        async def beat(cid):
+            status, _ = await stack.api(
+                "POST", "/rpc/llm/pressure",
+                json_body={"container_id": cid, "token_pressure": 0.1,
+                           "active_streams": 0,
+                           "extra": {"queued": 0, "tokens_generated": 10,
+                                     "topo_n_chips": 1}})
+            assert status == 200
+
+        await beat(cids[0])
+        await beat(cids[1])
+        status, m = await stack.api("GET", "/api/v1/metrics")
+        assert status == 200
+        assert set(m["engines"]) == set(cids)       # both fresh
+
+        # replica 1 goes silent; replica 0 keeps beating past the budget
+        for _ in range(5):
+            await asyncio.sleep(0.2)
+            await beat(cids[0])
+        status, m = await stack.api("GET", "/api/v1/metrics")
+        assert status == 200
+        assert cids[0] in m["engines"], m["engines"].keys()
+        assert cids[1] not in m["engines"], \
+            "dead replica still served after going silent > N beats"
+        assert m["engines"][cids[0]]["age_s"] <= 1.0
+        # the corpse's delta base was dropped (restart = fresh interval)
+        assert cids[1] not in obs.goodput._last
+        assert cids[0] in obs.goodput._last

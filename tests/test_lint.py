@@ -301,6 +301,75 @@ class TestOBS001:
                                 for f in check(src, path=self.PATH)}
 
 
+class TestOBS002:
+    """Unbounded metric-label cardinality (ISSUE 12 satellite): request/
+    trace/prompt identity as a metrics.inc/observe/set_gauge label value
+    mints a permanent registry series per request."""
+
+    def test_request_id_label_flagged(self):
+        src = """
+        from tpu9.observability import metrics
+        def record(request_id):
+            metrics.inc("tpu9_requests_total",
+                        labels={"request": request_id})
+        """
+        fs = [f for f in check(src) if f.rule == "OBS002"]
+        assert len(fs) == 1
+        assert "request_id" in fs[0].message
+
+    def test_trace_id_fstring_and_attribute_flagged(self):
+        src = """
+        from tpu9.observability import metrics
+        def record(req, ctx):
+            metrics.observe("tpu9_lat_s", 0.1,
+                            labels={"t": f"trace-{ctx.trace_id}"})
+            metrics.set_gauge("tpu9_depth", 1,
+                              labels={"r": req.request_id})
+        """
+        assert len([f for f in check(src) if f.rule == "OBS002"]) == 2
+
+    def test_prompt_and_minted_id_flagged(self):
+        src = """
+        from tpu9.observability import metrics
+        from tpu9.observability.trace import new_trace_id
+        def record(prompt):
+            metrics.inc("hits", labels={"p": prompt[:64]})
+            metrics.inc("spans", labels={"id": new_trace_id()})
+        """
+        assert len([f for f in check(src) if f.rule == "OBS002"]) == 2
+
+    def test_self_metrics_receiver_and_positional_labels_flagged(self):
+        src = """
+        class Engine:
+            def _obs(self, req):
+                self.metrics.observe("tpu9_engine_ttft_s", 0.2,
+                                     {"request": req.request_id})
+        """
+        assert len([f for f in check(src) if f.rule == "OBS002"]) == 1
+
+    def test_bounded_labels_not_flagged(self):
+        src = """
+        from tpu9.observability import metrics
+        def record(stub_id, tenant, reason, worker_id, phase):
+            metrics.inc("tpu9_router_shed_total",
+                        labels={"stub": stub_id, "reason": reason})
+            metrics.observe("tpu9_router_queue_wait_s", 0.1,
+                            labels={"tenant": tenant})
+            metrics.set_gauge("tpu9_startup_phase_s", 1.0,
+                              labels={"worker": worker_id, "phase": phase})
+        """
+        assert "OBS002" not in rule_ids(src)
+
+    def test_non_metrics_receiver_not_flagged(self):
+        src = """
+        def record(store, request_id):
+            store.inc("hits", labels={"request": request_id})
+            attrs = {"request": request_id}     # span attrs are the
+            span.set_attrs(attrs)               # CORRECT home for ids
+        """
+        assert "OBS002" not in rule_ids(src)
+
+
 class TestJAX001:
     HOT = """
     import jax, numpy as np
@@ -651,6 +720,36 @@ def test_boundaries_toml_matches_real_import_graph():
     #    something (an allow entry for a dead package would be vacuous)
     for pkg in ("tpu9.serving", "tpu9.router", "tpu9.ops"):
         assert any(m == pkg or m.startswith(pkg + ".") for m in edges)
+
+
+def test_slo_observability_contracts_declared_and_live():
+    """ISSUE 12 satellite: the fleet SLO/timeline modules carry explicit
+    boundary contracts — observability is a closed leaf (no reverse edge
+    into serving/router/gateway), and the slo/timeline modules are
+    restricted to the control plane + CLI + bench. The cross-check test
+    above asserts these against the real import graph; this one asserts
+    they are DECLARED (a deleted contract must fail loudly, not vacuously
+    pass) and still live."""
+    cfg = bnd.BoundaryConfig.load(
+        os.path.join(REPO, "tpu9", "analysis", "boundaries.toml"))
+    assert "tpu9.observability" in cfg.allow
+    # the leaf must not be allowed to reach the planes that record into it
+    for banned in ("tpu9.serving", "tpu9.router", "tpu9.gateway",
+                   "tpu9.worker"):
+        assert banned not in cfg.allow["tpu9.observability"]
+    for rmod in ("tpu9.observability.timeline", "tpu9.observability.slo"):
+        assert rmod in cfg.restricted, rmod
+        importers = cfg.restricted[rmod]
+        assert "tpu9.gateway" in importers and "tpu9.cli" in importers
+        # serving must NOT grow a reverse edge into the fleet ledger
+        assert not any(i == "tpu9.serving" or i.startswith("tpu9.serving.")
+                       for i in importers)
+    # liveness: the gateway really imports both restricted modules (via
+    # fleetobs), so the contracts guard a real edge, not a dead name
+    edges = _real_imports()
+    gw = edges.get("tpu9.gateway.fleetobs", set())
+    assert any(t.startswith("tpu9.observability.timeline") for t in gw)
+    assert any(t.startswith("tpu9.observability.slo") for t in gw)
 
 
 def test_tomlmini_parses_boundaries_toml():

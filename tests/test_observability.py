@@ -483,3 +483,44 @@ def test_otlp_attr_and_field_golden_mapping():
     assert summ["sum"] == pytest.approx(1.5)             # mean × count
     assert {"quantile": 0.5, "value": 0.2} in summ["quantileValues"]
     assert {"quantile": 1.0, "value": 0.9} in summ["quantileValues"]
+
+
+def test_prometheus_exposition_golden_slo_goodput_naming():
+    """Golden text exposition for the ISSUE 12 gauge families: stable
+    ``tpu9_slo_*`` / ``tpu9_goodput_*`` naming, deterministic label order
+    (sorted keys), and label-value escaping per the Prometheus text
+    format (backslash, double-quote, newline) — mirroring the otel.py
+    golden-mapping test above."""
+    m = Metrics()
+    m.set_gauge("tpu9_slo_burn_rate", 2.5,
+                labels={"stub": "s1", "objective": "ttft",
+                        "window": "fast"})
+    m.set_gauge("tpu9_slo_burn_rate", 0.25,
+                labels={"stub": "s1", "objective": "ttft",
+                        "window": "slow"})
+    m.set_gauge("tpu9_slo_burning", 1.0,
+                labels={"stub": "s1", "objective": "availability"})
+    m.set_gauge("tpu9_goodput_frac", 0.75, labels={"workspace": "ws-1"})
+    m.set_gauge("tpu9_goodput_tokens_per_chip_second", 12.5,
+                labels={"workspace": "ws-1"})
+    m.set_gauge("tpu9_goodput_waste_frac", 0.1,
+                labels={"workspace": "ws-1", "bucket": "queue_wait"})
+    # hostile label value: quotes, backslash and newline must escape,
+    # not corrupt the exposition line structure
+    m.set_gauge("tpu9_goodput_frac", 0.5,
+                labels={"workspace": 'we"ird\\ws\nname'})
+    assert m.prometheus_text() == (
+        'tpu9_goodput_frac{workspace="we\\"ird\\\\ws\\nname"} 0.5\n'
+        'tpu9_goodput_frac{workspace="ws-1"} 0.75\n'
+        'tpu9_goodput_tokens_per_chip_second{workspace="ws-1"} 12.5\n'
+        'tpu9_goodput_waste_frac{bucket="queue_wait",workspace="ws-1"} 0.1\n'
+        'tpu9_slo_burn_rate{objective="ttft",stub="s1",window="fast"} 2.5\n'
+        'tpu9_slo_burn_rate{objective="ttft",stub="s1",window="slow"} 0.25\n'
+        'tpu9_slo_burning{objective="availability",stub="s1"} 1.0\n')
+    # the exposition stays parseable: every line is `name{labels} value`
+    for line in m.prometheus_text().strip().split("\n"):
+        name, _, rest = line.partition("{")
+        assert name.startswith("tpu9_")
+        labels, _, value = rest.rpartition("} ")
+        float(value)                                     # parses
+        assert "\n" not in labels

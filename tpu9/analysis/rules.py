@@ -29,6 +29,14 @@ Per-file rules (class ``FileChecker``):
   (stored, displayed, or multiplied into epoch nanos) — the two
   legitimate wall-arithmetic sites (anchor + monotonic-duration
   reconstruction, calendar bucket keys) carry reviewed suppressions.
+- **OBS002** unbounded metric-label cardinality: a
+  ``metrics.inc/observe/set_gauge`` call whose ``labels`` value derives
+  from a request id, trace/span id, prompt or task id. Every distinct
+  label value mints a PERMANENT series in the registry (counters,
+  gauges, and a 2048-slot reservoir per summary) — id-valued labels grow
+  it without bound and blow up the Prometheus exposition. Bounded
+  dimensions (stub, tenant, phase, reason, worker) are fine;
+  per-request identity belongs in span attributes or flight records.
 
 Whole-program rule (``check_jax_hotpath``):
 
@@ -86,6 +94,17 @@ BLOCKING_CALLS = {
     "shutil.copy2": "sync file IO",
     "shutil.move": "sync file IO",
 }
+
+# OBS002: metrics-registry recording methods (receiver must look like a
+# Metrics registry: the chain's last segment before the method is
+# "metrics") and the identifier stems whose values are per-request /
+# per-trace identity — unbounded as label values
+METRIC_RECORD_METHODS = ("inc", "observe", "set_gauge")
+OBS2_TAINT_NAMES = frozenset({
+    "request_id", "req_id", "requestid", "trace_id", "traceid", "span_id",
+    "spanid", "parent_id", "task_id", "taskid", "prompt", "prompt_tokens",
+    "message_id", "trace",
+})
 
 # device->host syncs for JAX001 (attribute-method form, zero/any args)
 SYNC_METHODS = {"item", "block_until_ready", "tolist"}
@@ -238,6 +257,35 @@ class FileChecker(ast.NodeVisitor):
                     "sync file IO (open) directly in an async def blocks "
                     "the event loop — wrap the IO in asyncio.to_thread")
 
+        # OBS002: unbounded metric-label cardinality
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in METRIC_RECORD_METHODS
+                and dotted_name(node.func.value)
+                .rsplit(".", 1)[-1] == "metrics"):
+            labels = None
+            for kw in node.keywords:
+                if kw.arg == "labels":
+                    labels = kw.value
+            if labels is None and len(node.args) >= 3:
+                labels = node.args[2]
+            if isinstance(labels, ast.Dict):
+                for key_node, val in zip(labels.keys, labels.values):
+                    hit = self._obs2_tainted(val)
+                    if hit:
+                        key_txt = (repr(key_node.value)
+                                   if isinstance(key_node, ast.Constant)
+                                   else "<computed>")
+                        self._emit(
+                            "OBS002", node,
+                            f"metric label {key_txt} value derives from "
+                            f"{hit}: every distinct id mints a permanent "
+                            "series (registry + Prometheus exposition "
+                            "grow without bound) — put per-request "
+                            "identity in span attributes or flight "
+                            "records, keep label dimensions bounded "
+                            "(stub/tenant/phase/reason)")
+                        break       # one finding per call
+
         # JAX002: jax.jit(...)(...) immediately invoked
         if (isinstance(node.func, ast.Call)
                 and dotted_name(node.func.func) in ("jax.jit", "jit",
@@ -257,6 +305,28 @@ class FileChecker(ast.NodeVisitor):
                 "hoist and cache it")
 
         self.generic_visit(node)
+
+    @staticmethod
+    def _obs2_tainted(expr: ast.AST) -> str:
+        """Describe the unbounded-identity source inside a label-value
+        expression, or ''. Over-approximate by NAME (a false positive
+        costs one reviewed rename/suppression; a missed id-valued label
+        grows the registry forever): any mention of a request/trace/span/
+        task id or prompt identifier — bare, attribute (``req.request_id``),
+        formatted into an f-string, or minted inline (``new_trace_id()``)."""
+        for n in ast.walk(expr):
+            stem = ""
+            if isinstance(n, ast.Name):
+                stem = n.id
+            elif isinstance(n, ast.Attribute):
+                stem = n.attr
+            elif isinstance(n, ast.Call):
+                callee = dotted_name(n.func).rsplit(".", 1)[-1]
+                if callee in ("new_trace_id", "new_id", "uuid4", "uuid1"):
+                    return f"`{callee}()` (a freshly minted id)"
+            if stem.lower() in OBS2_TAINT_NAMES:
+                return f"`{stem}`"
+        return ""
 
     # -- ASY003: swallowed cancellation ---------------------------------------
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:

@@ -32,6 +32,8 @@ ALL_RULES = {
     "JAX002": "jit recompile hazard (inline jit call / jit built in a loop)",
     "OBS001": "wall-clock (time.time) arithmetic for a duration/deadline "
               "in serving/router/worker hot-path files",
+    "OBS002": "unbounded metric-label cardinality (request/trace/prompt "
+              "ids as metrics.inc/observe/set_gauge label values)",
     "BND001": "import-boundary contract violation (boundaries.toml)",
     "SHD001": "jax.jit opened outside the GraphFactory in mesh-capable "
               "serving modules (no explicit out_shardings)",

@@ -686,6 +686,115 @@ def profile_cmd(stub_id: str, windows: int, container_id: str,
     click.echo(json.dumps(out, indent=2))
 
 
+# ---------------------------------------------------------------------------
+# tpu9 top — live fleet SLO / goodput / timeline view (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(samples: list, width: int = 24) -> str:
+    """Unicode sparkline of the newest `width` [ts, value] samples."""
+    vals = [v for _, v in samples[-width:]]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))]
+                   for v in vals)
+
+
+def _render_top(metrics_data: dict, slo_data: dict,
+                timeline_data: dict) -> str:
+    """Pure renderer (unit-testable): the three endpoint payloads → one
+    terminal frame of engine, SLO and goodput tables."""
+    lines: list[str] = []
+    series = timeline_data.get("series", {})
+
+    engines = metrics_data.get("engines", {})
+    lines.append(f"ENGINES ({len(engines)} replicas)")
+    lines.append(f"  {'replica':<14}{'tok/s':>9}{'kv free':>9}"
+                 f"{'spec acc':>9}{'recompiles':>11}{'age':>7}  trend")
+    for cid, snap in sorted(engines.items()):
+        def _f(key, default=0.0):
+            try:
+                return float(snap.get(key, default))
+            except (TypeError, ValueError):
+                return default
+        spark = _sparkline(series.get(f"engine.{cid}.tokens_per_sec", []))
+        lines.append(
+            f"  {cid[:13]:<14}{_f('tokens_per_sec'):>9.1f}"
+            f"{_f('kv_blocks_free'):>9.0f}"
+            f"{_f('spec_acceptance_rate'):>9.2f}"
+            f"{_f('graph_compiles_post_warmup'):>11.0f}"
+            f"{_f('age_s'):>6.1f}s  {spark}")
+
+    lines.append("")
+    lines.append("SLO (burn rate: >1 on fast+slow windows = burning)")
+    lines.append(f"  {'stub':<14}{'objective':<14}{'fast':>8}{'slow':>8}"
+                 f"{'pressure':>9}  status")
+    for sid, row in sorted(slo_data.get("stubs", {}).items()):
+        for name, obj in sorted(row.get("objectives", {}).items()):
+            status = ("BURNING" if obj.get("burning")
+                      else "warning" if obj.get("warning") else "ok")
+            if obj.get("attribution"):
+                status += f" ({obj['attribution']})"
+            lines.append(
+                f"  {sid[:13]:<14}{name[:13]:<14}"
+                f"{obj['fast']['burn']:>8.2f}{obj['slow']['burn']:>8.2f}"
+                f"{row.get('pressure', 0.0):>9.2f}  {status}")
+
+    lines.append("")
+    lines.append("GOODPUT (per workspace; fractions sum to 1)")
+    lines.append(f"  {'workspace':<14}{'tok/chip-s':>11}{'goodput':>9}"
+                 f"{'q-wait':>8}{'shed':>7}{'spec-rb':>8}{'recomp':>8}"
+                 f"{'idle':>7}")
+    for ws, row in sorted(metrics_data.get("goodput", {}).items()):
+        waste = row.get("waste", {})
+        lines.append(
+            f"  {ws[:13]:<14}"
+            f"{row.get('goodput_tokens_per_chip_second', 0.0):>11.2f}"
+            f"{row.get('goodput_frac', 0.0):>9.1%}"
+            f"{waste.get('queue_wait', 0.0):>8.1%}"
+            f"{waste.get('shed', 0.0):>7.1%}"
+            f"{waste.get('spec_rollback', 0.0):>8.1%}"
+            f"{waste.get('recompile_stall', 0.0):>8.1%}"
+            f"{waste.get('idle_reservation', 0.0):>7.1%}")
+
+    lines.append("")
+    lines.append("ROUTER timeline (queue depth / ttft p95)")
+    stubs = sorted({n.split(".")[1] for n in series
+                    if n.startswith("router.")})
+    for sid in stubs:
+        q = _sparkline(series.get(f"router.{sid}.queue_depth", []))
+        t = _sparkline(series.get(f"router.{sid}.ttft_p95_s", []))
+        lines.append(f"  {sid[:13]:<14} queue {q or '-':<26} "
+                     f"ttft {t or '-'}")
+    return "\n".join(lines)
+
+
+@cli.command("top")
+@click.option("--interval", default=2.0, help="refresh seconds")
+@click.option("--once", is_flag=True, help="render one frame and exit")
+def top_cmd(interval: float, once: bool) -> None:
+    """Live fleet view: engine replicas, SLO burn rates and per-tenant
+    goodput on the gateway's metrics timeline (ISSUE 12)."""
+    import time as _time
+    client = _client()
+    while True:
+        m = client._run(lambda c: c.request("GET", "/api/v1/metrics"))
+        s = client._run(lambda c: c.request("GET", "/api/v1/slo"))
+        t = client._run(lambda c: c.request(
+            "GET", "/api/v1/timeline?series=router.*,engine.*&limit=48"))
+        frame = _render_top(m, s, t)
+        if once:
+            click.echo(frame)
+            return
+        click.clear()
+        click.echo(frame)
+        _time.sleep(interval)
+
+
 @cli.command("metrics")
 @click.option("--prometheus", is_flag=True)
 def metrics_cmd(prometheus: bool) -> None:

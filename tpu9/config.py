@@ -173,6 +173,66 @@ class RouterConfig:
     # graceful scale-down: how long a draining replica may finish its
     # in-flight requests before the container is stopped regardless
     drain_timeout_s: float = 10.0
+    # heartbeats older than this are excluded from fleet-wide aggregates
+    # (spec acceptance fold — ISSUE 12 stale-replica aging); the store
+    # TTL (15 s) only bounds how long a dead hash EXISTS, not whether a
+    # fold trusts it. Default = 3 beats of the runner's fixed 2 s
+    # cadence, same budget as SloConfig.stale_after_s (router plane vs
+    # gateway plane of the one staleness policy)
+    heartbeat_stale_s: float = 6.0
+
+
+@dataclass
+class SloObjectiveConfig:
+    """One service-level objective, evaluated per stub at the gateway
+    (``tpu9/observability/slo.py`` — ISSUE 12) over fast + slow burn-rate
+    windows and served at ``/api/v1/slo``."""
+    name: str = ""
+    # "latency": fraction of sampled `metric` estimates must stay ≤ target
+    #            (attainment is the allowed-good fraction, e.g. 0.99);
+    # "availability": 1 − shed rate must stay ≥ target (e.g. 0.999)
+    kind: str = "latency"
+    metric: str = "ttft_p95_s"     # timeline series suffix (latency kind)
+    target: float = 0.0
+    attainment: float = 0.99       # latency kind only
+    fast_window_s: float = 300.0   # page-now window (5m)
+    slow_window_s: float = 3600.0  # sustained-burn window (1h)
+
+
+def _default_slo_objectives() -> list["SloObjectiveConfig"]:
+    return [
+        SloObjectiveConfig(name="ttft", kind="latency",
+                           metric="ttft_p95_s", target=2.0),
+        SloObjectiveConfig(name="availability", kind="availability",
+                           target=0.999),
+    ]
+
+
+@dataclass
+class SloConfig:
+    """Fleet SLO / timeline / goodput layer (ISSUE 12): the in-gateway
+    time-series store, burn-rate evaluation, and per-tenant goodput
+    accounting behind ``/api/v1/{timeline,slo}`` and ``tpu9 top``."""
+    enabled: bool = True
+    # gateway sampler tick: router series + SLO evaluation cadence
+    sample_interval_s: float = 2.0
+    # per-series ring capacity (samples) — the memory bound
+    timeline_capacity: int = 512
+    timeline_max_series: int = 4096
+    timeline_idle_ttl_s: float = 900.0
+    # engines-section aging: a replica silent longer than this is
+    # dropped from /api/v1/metrics "engines" and fleet-wide aggregates.
+    # Default = 3 beats of the llm runner's fixed 2 s pressure-heartbeat
+    # cadence; keep it a multiple of that beat (and keep it aligned with
+    # RouterConfig.heartbeat_stale_s, the router-plane budget for the
+    # same signal)
+    stale_after_s: float = 6.0
+    # goodput accounting window
+    goodput_window_s: float = 3600.0
+    # burn-rate threshold that counts as "burning" (and feeds pressure)
+    burn_alert: float = 1.0
+    objectives: list[SloObjectiveConfig] = field(
+        default_factory=_default_slo_objectives)
 
 
 @dataclass
@@ -199,8 +259,14 @@ class AppConfig:
     storage: StorageConfig = field(default_factory=StorageConfig)
     image: ImageConfig = field(default_factory=ImageConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
     monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
     debug: bool = False
+
+
+# typed list-of-dataclass config fields: overlay replaces the whole list,
+# each element merged over a fresh default instance
+_LIST_FIELDS = {"pools": WorkerPoolConfig, "objectives": SloObjectiveConfig}
 
 
 def _merge_into(obj: Any, data: dict[str, Any]) -> Any:
@@ -214,13 +280,13 @@ def _merge_into(obj: Any, data: dict[str, Any]) -> Any:
         cur = getattr(obj, key)
         if dataclasses.is_dataclass(cur) and isinstance(value, dict):
             _merge_into(cur, value)
-        elif key == "pools" and isinstance(value, list):
-            pools = []
+        elif key in _LIST_FIELDS and isinstance(value, list):
+            items = []
             for item in value:
-                p = WorkerPoolConfig()
-                _merge_into(p, item if isinstance(item, dict) else {})
-                pools.append(p)
-            setattr(obj, key, pools)
+                element = _LIST_FIELDS[key]()
+                _merge_into(element, item if isinstance(item, dict) else {})
+                items.append(element)
+            setattr(obj, key, items)
         else:
             setattr(obj, key, value)
     return obj

@@ -1,0 +1,279 @@
+"""FleetObserver: the gateway's fleet-evidence sampler (ISSUE 12).
+
+Owns the bounded :class:`~tpu9.observability.timeline.TimelineStore`, the
+:class:`~tpu9.observability.slo.SloEvaluator` and the
+:class:`~tpu9.observability.slo.GoodputAccountant`, and wires them to the
+cadences the system already has:
+
+- **pressure-heartbeat cadence** (``/rpc/llm/pressure`` ingest): every
+  accepted engine heartbeat records that replica's timeline series
+  (tokens/sec, KV blocks, spec acceptance, recompile sentinel, MFU/MBU
+  priced from the shipped physics constants) and feeds the goodput
+  accountant's engine counters;
+- **sampler tick** (``slo.sample_interval_s``): per-stub router series
+  (queue depth, shed/submitted counters, TTFT/queue-wait percentiles,
+  pressure), SLO burn-rate evaluation folded into the autoscaler
+  pressure feed via ``RouterSignals.slo_sample``, goodput router
+  counters, Prometheus gauge publication, and timeline pruning.
+
+The observer also owns stale-replica aging for the ``/api/v1/metrics``
+``engines`` merge: a replica silent longer than ``slo.stale_after_s``
+(default 3 runner heartbeats) is dropped (and its accountant delta base forgotten) instead
+of serving dead stats until the store TTL.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ..observability.slo import GoodputAccountant, SloEvaluator
+from ..observability.timeline import TimelineStore
+from ..observability.usage import bucket_of, usage_key
+from ..utils.aio import event_wait, reap
+
+log = logging.getLogger("tpu9.gateway")
+
+# engine heartbeat fields mirrored 1:1 into per-replica timeline series
+ENGINE_SERIES = ("tokens_per_sec", "token_pressure", "queued",
+                 "kv_blocks_free", "kv_blocks_used", "kv_blocks_reserved",
+                 "spec_acceptance_rate", "graph_compiles_post_warmup",
+                 "active_streams")
+# router snapshot fields mirrored into per-stub timeline series
+ROUTER_SERIES = ("queue_depth", "shed_rate", "pressure")
+
+
+def _num(d: dict, key: str, default: float = 0.0) -> float:
+    try:
+        return float(d.get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+
+class FleetObserver:
+    def __init__(self, cfg, store, fleet_router=None):
+        """``cfg`` is an AppConfig.slo (SloConfig)."""
+        self.cfg = cfg
+        self.store = store
+        self.fleet_router = fleet_router
+        self.timeline = TimelineStore(
+            capacity=cfg.timeline_capacity,
+            max_series=cfg.timeline_max_series,
+            idle_ttl_s=cfg.timeline_idle_ttl_s)
+        self.evaluator = SloEvaluator(self.timeline, cfg.objectives,
+                                      burn_alert=cfg.burn_alert)
+        self.goodput = GoodputAccountant(window_s=cfg.goodput_window_s)
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = asyncio.Event()
+
+    @property
+    def stale_after_s(self) -> float:
+        return self.cfg.stale_after_s
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> "FleetObserver":
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        if self._task is not None:
+            await reap(self._task)
+            self._task = None
+
+    async def _loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                await self.sample()
+            except Exception:   # noqa: BLE001 — evidence collection must
+                log.exception("fleet observer tick failed")  # not die
+            await event_wait(self._stopping, self.cfg.sample_interval_s)
+
+    # -- heartbeat-cadence ingest (called from /rpc/llm/pressure) ------------
+
+    def ingest_heartbeat(self, container_id: str, workspace_id: str,
+                         stub_id: str, token_pressure: float,
+                         active_streams: int,
+                         extra: Optional[dict] = None) -> None:
+        """One accepted engine heartbeat → per-replica timeline series +
+        goodput engine counters. Values arrive as the flat scalars the
+        runner ships (strings after a store round-trip are fine)."""
+        stats = dict(extra or {})
+        stats["token_pressure"] = token_pressure
+        stats["active_streams"] = active_streams
+        prefix = f"engine.{container_id}."
+        for key in ENGINE_SERIES:
+            if key in stats:
+                self.timeline.record(prefix + key, _num(stats, key))
+        # MFU/MBU priced control-plane-side from the engine's physics
+        # constants (bytes / FLOPs per token per chip) × tokens/sec,
+        # against the chip's public peaks — honest ~0 on CPU hosts
+        tps = _num(stats, "tokens_per_sec")
+        bpt = _num(stats, "decode_bytes_per_token_per_chip")
+        fpt = _num(stats, "decode_flops_per_token_per_chip")
+        if tps > 0 and (bpt > 0 or fpt > 0):
+            from ..benchsuite.physics import chip_spec
+            spec = chip_spec(str(stats.get("device_kind", "")))
+            self.timeline.record(prefix + "mbu",
+                                 tps * bpt / (spec.hbm_gbps * 1e9))
+            self.timeline.record(prefix + "mfu",
+                                 tps * fpt / (spec.peak_bf16_tflops * 1e12))
+        self.goodput.engine_sample(container_id, workspace_id, stub_id,
+                                   stats)
+
+    # -- sampler tick --------------------------------------------------------
+
+    async def sample(self) -> None:
+        """One observer tick: router series, SLO evaluation + pressure
+        fold, goodput router counters, gauge publication, pruning."""
+        if self.fleet_router is not None:
+            signals = self.fleet_router.signals
+            for stub in self.fleet_router.active_stubs():
+                sid = stub.stub_id
+                snap = signals.snapshot(sid)
+                prefix = f"router.{sid}."
+                # LIVE fair-queue depth, not the last dispatch-time
+                # sample: a burst that sheds between dispatch passes
+                # must still show the queue it built
+                if hasattr(self.fleet_router, "queue_depth"):
+                    snap["queue_depth"] = self.fleet_router.queue_depth(sid)
+                for key in ROUTER_SERIES:
+                    self.timeline.record(prefix + key,
+                                         float(snap.get(key, 0.0)))
+                # cumulative counters the burn windows differentiate
+                self.timeline.record(prefix + "submitted_total",
+                                     float(snap.get("submitted", 0)))
+                self.timeline.record(prefix + "shed_total",
+                                     float(snap.get("shed", 0)))
+                lat = snap.get("latency") or {}
+                qw_total = 0.0
+                for phase, row in lat.items():
+                    self.timeline.record(f"{prefix}{phase}_p50_s",
+                                         row.get("p50_s", 0.0))
+                    self.timeline.record(f"{prefix}{phase}_p95_s",
+                                         row.get("p95_s", 0.0))
+                    if phase == "queue_wait":
+                        # count × mean == cumulative queue-wait seconds
+                        qw_total = (row.get("count", 0)
+                                    * row.get("mean_s", 0.0))
+                # SLO burn: evaluate, publish, fold into pressure
+                evaluated = self.evaluator.evaluate(sid)
+                for name, entry in evaluated.items():
+                    self.timeline.record(
+                        f"slo.{sid}.{name}.burn_fast",
+                        entry["fast"]["burn"])
+                    self.timeline.record(
+                        f"slo.{sid}.{name}.burn_slow",
+                        entry["slow"]["burn"])
+                self.evaluator.publish(sid, evaluated)
+                signals.slo_sample(sid,
+                                   self.evaluator.max_fast_burn(evaluated))
+                self.goodput.router_sample(
+                    sid, stub.workspace_id,
+                    submitted_total=float(snap.get("submitted", 0)),
+                    shed_total=float(snap.get("shed", 0)),
+                    queue_wait_total_s=qw_total)
+        self.goodput.publish(await self.goodput_snapshot())
+        self.timeline.prune()
+
+    # -- engines-section aging (ISSUE 12 satellite) --------------------------
+
+    def filter_engines(self, engines: dict) -> dict:
+        """Stamp ``last_seen``/``age_s`` from each heartbeat's wall stamp
+        and drop replicas silent > N beats — /api/v1/metrics must not
+        serve dead stats until the store TTL. Aged-out replicas also lose
+        their goodput delta base (a restart starts a fresh interval)."""
+        now = time.time()
+        out: dict = {}
+        for cid, snap in engines.items():
+            ts = _num(snap, "ts")
+            age = max(now - ts, 0.0) if ts else 0.0
+            if ts and age > self.stale_after_s:
+                self.goodput.forget_replica(cid)
+                continue
+            row = dict(snap)
+            row["last_seen"] = ts
+            row["age_s"] = round(age, 3)
+            out[cid] = row
+        return out
+
+    # -- endpoint payloads ---------------------------------------------------
+
+    def timeline_payload(self, series: str, since: float,
+                         limit: Optional[int]) -> dict:
+        if not series:
+            return {"series_names": self.timeline.series_names(),
+                    "capacity": self.timeline.capacity,
+                    "samples": self.timeline.sample_count()}
+        names = [s.strip() for s in series.split(",") if s.strip()]
+        return {"series": self.timeline.query(names, since=since,
+                                              limit=limit)}
+
+    def slo_payload(self) -> dict:
+        stubs: dict = {}
+        known = (self.fleet_router.active_stubs()
+                 if self.fleet_router is not None else [])
+        signals = (self.fleet_router.signals
+                   if self.fleet_router is not None else None)
+        for stub in known:
+            sid = stub.stub_id
+            evaluated = self.evaluator.evaluate(sid)
+            row = {"workspace_id": stub.workspace_id,
+                   "objectives": evaluated}
+            if signals is not None:
+                row["slo_pressure"] = signals.slo_pressure(sid)
+                row["pressure"] = signals.pressure(sid)
+            stubs[sid] = row
+        return {
+            "objectives": [{
+                "name": o.name, "kind": o.kind, "target": o.target,
+                "metric": o.metric if o.kind == "latency" else "",
+                "attainment": o.attainment if o.kind == "latency" else None,
+                "fast_window_s": o.fast_window_s,
+                "slow_window_s": o.slow_window_s,
+            } for o in self.cfg.objectives],
+            "burn_alert": self.cfg.burn_alert,
+            "stubs": stubs,
+        }
+
+    async def goodput_snapshot(self) -> dict:
+        """Per-workspace decomposition joined against usage.py's metered
+        chip-second buckets (the billing denominator; the accountant's
+        own replica-seconds stand in when the meter reads zero — CPU dev
+        fleets meter 0 chips)."""
+        workspaces = self.goodput.workspaces()
+        metered: dict[str, float] = {}
+        window_h = max(int(self.goodput.window_s // 3600), 0) + 1
+        now = time.time()
+        window_start = now - self.goodput.window_s
+        for ws in workspaces:
+            total = 0.0
+            for h in range(window_h + 1):
+                bucket_start = (now // 3600 - h) * 3600
+                # prorate by the overlap between the accounting window
+                # and the bucket's DATA span (metering stops at `now`
+                # for the current bucket; chip-seconds assumed uniform
+                # within the span): summing whole buckets would count up
+                # to an extra hour of denominator at the top of each
+                # hour, understating goodput by up to ~2x on a metered
+                # fleet
+                span_end = min(now, bucket_start + 3600)
+                span = span_end - bucket_start
+                overlap = span_end - max(window_start, bucket_start)
+                if overlap <= 0 or span <= 0:
+                    continue
+                hot = await self.store.hgetall(
+                    usage_key(ws, bucket_of(bucket_start)))
+                if hot:
+                    chips = _num(hot, "chip_seconds")
+                    if chips > 0:
+                        total += chips * min(overlap / span, 1.0)
+            metered[ws] = total
+        return self.goodput.snapshot(usage_chip_seconds=metered)
+
+    async def metrics_section(self) -> dict:
+        return await self.goodput_snapshot()

@@ -158,6 +158,14 @@ class Gateway:
                                cluster=cfg.cluster_name)
         from ..observability import UsageService
         self.usage = UsageService(self.store, self.backend)
+        # fleet SLO / timeline / goodput layer (ISSUE 12): bounded
+        # time-series store + burn-rate evaluator + per-tenant goodput
+        # accounting behind /api/v1/{timeline,slo} and `tpu9 top`
+        self.fleetobs = None
+        if cfg.slo.enabled:
+            from .fleetobs import FleetObserver
+            self.fleetobs = FleetObserver(cfg.slo, self.store,
+                                          fleet_router=self.fleet_router)
         self.pool_monitor = PoolMonitor(
             self.store, pools,
             {p.name: p for p in cfg.pools},
@@ -325,6 +333,8 @@ class Gateway:
         r.add_get("/api/v1/scheduler/stats", self._scheduler_stats)
         r.add_get("/api/v1/metrics", self._metrics)
         r.add_get("/api/v1/usage", self._usage_report)
+        r.add_get("/api/v1/timeline", self._timeline)
+        r.add_get("/api/v1/slo", self._slo)
         r.add_get("/api/v1/traces", self._traces)
         # engine flight recorder + on-demand TPU profiling (ISSUE 8)
         r.add_get("/api/v1/flight", self._flight)
@@ -422,6 +432,8 @@ class Gateway:
         await self.dispatcher.start()
         await self.functions.start()
         await self.usage.start()
+        if self.fleetobs is not None:
+            await self.fleetobs.start()
         if self.pool_monitor is not None:
             await self.pool_monitor.start()
         # shutdown grace: long-polls exit instantly via _bounded_longpoll
@@ -479,6 +491,8 @@ class Gateway:
         await self.functions.stop()
         await self.dispatcher.stop()
         await self.scheduler.stop()
+        if self.fleetobs is not None:
+            await self.fleetobs.stop()
         await self.usage.stop()
         if self.otlp is not None:
             await self.otlp.stop()
@@ -709,9 +723,40 @@ class Gateway:
             snap = await self.store.hgetall(key)
             if snap:
                 out["engines"][key.rsplit(":", 1)[-1]] = snap
+        if self.fleetobs is not None:
+            # stale-replica aging (ISSUE 12): stamp last_seen/age_s from
+            # the heartbeat; replicas silent > N beats are dropped rather
+            # than served as live stats until the store TTL
+            out["engines"] = self.fleetobs.filter_engines(out["engines"])
+            # per-tenant / per-stub goodput decomposition joined against
+            # usage.py chip-second buckets
+            out["goodput"] = await self.fleetobs.metrics_section()
         if self.fleet_router is not None:
             out["router"] = self.fleet_router.snapshot_all()
         return web.json_response(out)
+
+    async def _timeline(self, request: web.Request) -> web.Response:
+        """Bounded in-gateway time-series rings (ISSUE 12): fleet history
+        for the snapshot /api/v1/metrics can't answer. ?series=a,b,c
+        (trailing * prefix-matches), ?since= (wall anchor), ?limit= newest
+        N per series; no ?series= lists the available names."""
+        self._require_operator(request)
+        if self.fleetobs is None:
+            return web.json_response({"error": "slo layer disabled"},
+                                     status=404)
+        limit = int(self._q_float(request, "limit", 0)) or None
+        return web.json_response(self.fleetobs.timeline_payload(
+            request.query.get("series", ""),
+            self._q_float(request, "since", 0.0), limit))
+
+    async def _slo(self, request: web.Request) -> web.Response:
+        """Declared objectives + per-stub multi-window burn rates, with
+        the pressure fold the autoscaler sees (ISSUE 12)."""
+        self._require_operator(request)
+        if self.fleetobs is None:
+            return web.json_response({"error": "slo layer disabled"},
+                                     status=404)
+        return web.json_response(self.fleetobs.slo_payload())
 
     async def _events(self, request: web.Request) -> web.Response:
         ws = self._ws(request)
@@ -1013,6 +1058,15 @@ class Gateway:
         await router.record_pressure(
             state.container_id, float(d.get("token_pressure", 0.0)),
             int(d.get("active_streams", 0)), extra=d.get("extra"))
+        if self.fleetobs is not None:
+            # timeline + goodput sampling rides the heartbeat cadence
+            # (ISSUE 12) — same accepted-beat channel the spans use
+            self.fleetobs.ingest_heartbeat(
+                state.container_id, state.workspace_id, state.stub_id,
+                float(d.get("token_pressure", 0.0)),
+                int(d.get("active_streams", 0)),
+                extra=d.get("extra") if isinstance(d.get("extra"), dict)
+                else None)
         spans = d.get("spans")
         if isinstance(spans, list) and spans:
             await self._ingest_runner_spans(state, spans)

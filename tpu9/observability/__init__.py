@@ -1,8 +1,11 @@
 from .metrics import Metrics, metrics
 from .events import EventBus
 from .loglimit import LogLimiter
+from .slo import GoodputAccountant, SloEvaluator
+from .timeline import TimelineStore
 from .trace import Span, Tracer, new_trace_id, tracer
 from .usage import UsageSampler, UsageService
 
 __all__ = ["Metrics", "metrics", "EventBus", "LogLimiter", "Span", "Tracer",
-           "new_trace_id", "tracer", "UsageSampler", "UsageService"]
+           "new_trace_id", "tracer", "UsageSampler", "UsageService",
+           "TimelineStore", "SloEvaluator", "GoodputAccountant"]

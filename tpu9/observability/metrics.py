@@ -56,10 +56,20 @@ class Metrics:
         self.summaries: dict[str, _Summary] = {}
 
     @staticmethod
+    def _escape_label(value) -> str:
+        """Prometheus text-exposition label-value escaping: backslash,
+        double-quote and newline (in that order — escaping the escapes
+        first). Applied at key time so the JSON view and the exposition
+        agree on series identity."""
+        return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    @staticmethod
     def _key(name: str, labels: Optional[dict] = None) -> str:
         if not labels:
             return name
-        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        inner = ",".join(f'{k}="{Metrics._escape_label(v)}"'
+                         for k, v in sorted(labels.items()))
         return f"{name}{{{inner}}}"
 
     def inc(self, name: str, value: float = 1.0,

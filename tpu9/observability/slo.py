@@ -1,0 +1,372 @@
+"""Fleet SLO burn-rate evaluation + per-tenant goodput accounting (ISSUE 12).
+
+Two consumers of the :mod:`timeline` store:
+
+- :class:`SloEvaluator` — multi-window burn rates for declared objectives
+  (``tpu9.config.SloObjectiveConfig``). Burn rate is the SRE-standard
+  ratio *observed error rate / error budget*: 1.0 means the objective
+  spends its budget exactly at the allowed pace; >1 on the fast window is
+  the page-now signal, and the gateway folds it into the autoscaler
+  pressure feed (``router/signals.py``) so a burning SLO raises pressure
+  *before* queue depth explodes.
+
+- :class:`GoodputAccountant` — "what fraction of chip-seconds produced
+  useful tokens for tenant X?" Every heartbeat's cumulative engine
+  counters (tokens generated, spec rollback, phase seconds, recompile
+  stalls) and the router's per-tenant queue-wait/shed signals are folded
+  into per-(workspace, stub) windows, then decomposed against
+  chip-seconds into one goodput fraction plus named waste buckets that
+  sum to exactly 1 (the remainder bucket is ``idle_reservation``).
+
+Neither class imports the router or the serving stack (boundaries.toml
+closes ``tpu9.observability``): the gateway's FleetObserver feeds both
+with plain scalars.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .metrics import metrics
+from .timeline import TimelineStore
+
+# burn rates are capped so an empty error budget (target == 1.0) or a
+# catastrophic window reads as "very burning", not inf/NaN in JSON
+BURN_CAP = 999.0
+
+WASTE_BUCKETS = ("queue_wait", "shed", "spec_rollback", "recompile_stall",
+                 "idle_reservation")
+
+
+def _clamp01(x: float) -> float:
+    return 0.0 if x < 0.0 else (1.0 if x > 1.0 else x)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate evaluation
+# ---------------------------------------------------------------------------
+
+class SloEvaluator:
+    """Evaluates declared objectives over the timeline's router series.
+
+    Series contract (recorded by the gateway sampler):
+
+    - ``router.<stub>.submitted_total`` / ``router.<stub>.shed_total`` —
+      cumulative counters the availability objective differentiates;
+    - ``router.<stub>.<metric>`` (e.g. ``ttft_p95_s``) — the sampled
+      latency estimate a latency objective thresholds.
+    """
+
+    def __init__(self, timeline: TimelineStore, objectives: list,
+                 burn_alert: float = 1.0):
+        self.timeline = timeline
+        self.objectives = list(objectives)
+        self.burn_alert = float(burn_alert)
+
+    # -- one (objective, window) cell ---------------------------------------
+
+    def _window_eval(self, stub_id: str, obj, window_s: float) -> dict:
+        if obj.kind == "availability":
+            shed, n_s = self.timeline.counter_delta(
+                f"router.{stub_id}.shed_total", window_s)
+            sub, n_a = self.timeline.counter_delta(
+                f"router.{stub_id}.submitted_total", window_s)
+            total = shed + sub
+            err = (shed / total) if total > 0 else 0.0
+            budget = max(1.0 - obj.target, 0.0)
+            burn = min(err / budget, BURN_CAP) if budget > 0 else (
+                BURN_CAP if err > 0 else 0.0)
+            return {"window_s": window_s, "burn": round(burn, 4),
+                    "value": round(1.0 - err, 6),      # availability
+                    "error_rate": round(err, 6),
+                    "sheds": int(shed), "submitted": int(sub),
+                    "samples": min(n_s, n_a)}
+        # latency threshold objective: error rate = fraction of sampled
+        # estimates over target; budget = 1 - attainment
+        vals = self.timeline.values_window(
+            f"router.{stub_id}.{obj.metric}", window_s)
+        err = (sum(1 for v in vals if v > obj.target) / len(vals)
+               if vals else 0.0)
+        budget = max(1.0 - obj.attainment, 0.0)
+        burn = min(err / budget, BURN_CAP) if budget > 0 else (
+            BURN_CAP if err > 0 else 0.0)
+        return {"window_s": window_s, "burn": round(burn, 4),
+                "value": round(vals[-1], 6) if vals else None,
+                "error_rate": round(err, 6), "samples": len(vals)}
+
+    def evaluate(self, stub_id: str) -> dict:
+        """Every objective × {fast, slow} window for one stub."""
+        out: dict = {}
+        for obj in self.objectives:
+            fast = self._window_eval(stub_id, obj, obj.fast_window_s)
+            slow = self._window_eval(stub_id, obj, obj.slow_window_s)
+            burning = (fast["burn"] > self.burn_alert
+                       and slow["burn"] > self.burn_alert)
+            entry = {"kind": obj.kind, "target": obj.target,
+                     "fast": fast, "slow": slow,
+                     # fast-window breach alone = early warning; both
+                     # windows = sustained burn (multi-window alerting)
+                     "warning": fast["burn"] > self.burn_alert,
+                     "burning": burning}
+            if obj.kind == "availability":
+                entry["attribution"] = "shed" if fast["sheds"] > 0 else ""
+            else:
+                entry["metric"] = obj.metric
+                entry["attainment"] = obj.attainment
+            out[obj.name] = entry
+        return out
+
+    def max_fast_burn(self, evaluated: dict) -> float:
+        return max((o["fast"]["burn"] for o in evaluated.values()),
+                   default=0.0)
+
+    def publish(self, stub_id: str, evaluated: dict) -> None:
+        """Mirror the evaluation into the process-global registry so the
+        Prometheus exposition carries stable ``tpu9_slo_*`` series."""
+        for name, entry in evaluated.items():
+            for window in ("fast", "slow"):
+                metrics.set_gauge(
+                    "tpu9_slo_burn_rate", entry[window]["burn"],
+                    labels={"stub": stub_id, "objective": name,
+                            "window": window})
+            metrics.set_gauge("tpu9_slo_burning",
+                              1.0 if entry["burning"] else 0.0,
+                              labels={"stub": stub_id, "objective": name})
+
+
+# ---------------------------------------------------------------------------
+# per-tenant / per-stub goodput accounting
+# ---------------------------------------------------------------------------
+
+# cumulative engine counters the accountant differentiates per heartbeat
+ENGINE_COUNTERS = ("tokens_generated", "spec_proposed", "spec_accepted",
+                   "graph_compile_stall_s")
+# cumulative phase seconds arrive as count × mean (the latency summaries
+# the runner already flattens into the heartbeat extras)
+PHASE_SECONDS = ("prefill", "decode_window")
+
+
+@dataclass
+class _WindowAcc:
+    """Per-(workspace, stub) accumulation ring: one entry per sample with
+    its monotonic stamp. Eviction is by AGE against the accounting
+    window, not by count — a count cap silently truncates the window as
+    soon as a stub has a few replicas beating (3 replicas × 2 s beats +
+    2 s router ticks ≈ 7200 samples/h). The maxlen is only a runaway
+    backstop, sized well above any real cadence."""
+    window_s: float = 3600.0
+    samples: deque = field(default_factory=lambda: deque(maxlen=65536))
+
+    def add(self, mono: float, delta: dict) -> None:
+        self.samples.append((mono, delta))
+        cutoff = mono - self.window_s
+        while self.samples and self.samples[0][0] < cutoff:
+            self.samples.popleft()
+
+    def sums(self, window_s: float) -> dict:
+        cutoff = time.monotonic() - window_s
+        out: dict[str, float] = {}
+        for mono, delta in self.samples:
+            if mono < cutoff:
+                continue
+            for k, v in delta.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+
+class GoodputAccountant:
+    def __init__(self, window_s: float = 3600.0):
+        self.window_s = float(window_s)
+        # replica -> last cumulative counters (delta base)
+        self._last: dict[str, dict] = {}
+        # (workspace, stub) -> accumulated deltas
+        self._acc: dict[tuple, _WindowAcc] = {}
+        # stub -> workspace (surfacing joins)
+        self._stub_ws: dict[str, str] = {}
+
+    # -- ingestion -----------------------------------------------------------
+
+    @staticmethod
+    def _num(stats: dict, key: str, default: float = 0.0) -> float:
+        try:
+            return float(stats.get(key, default))
+        except (TypeError, ValueError):
+            return default
+
+    def engine_sample(self, container_id: str, workspace_id: str,
+                      stub_id: str, stats: dict) -> None:
+        """Fold one heartbeat's cumulative engine counters into the
+        (workspace, stub) window. ``stats`` is the flat heartbeat hash
+        (strings allowed — store round-trip)."""
+        mono = time.monotonic()
+        cur = {k: self._num(stats, k) for k in ENGINE_COUNTERS}
+        for phase in PHASE_SECONDS:
+            # count × mean == cumulative observed seconds of that phase
+            cur[f"{phase}_s"] = (self._num(stats, f"{phase}_count")
+                                 * self._num(stats, f"{phase}_mean_s"))
+        chips = max(self._num(stats, "topo_n_chips", 1.0), 1.0)
+        prev = self._last.get(container_id)
+        self._last[container_id] = {"counters": cur, "mono": mono,
+                                    "chips": chips}
+        if prev is None:
+            return                      # first beat: no interval yet
+        dt = mono - prev["mono"]
+        if dt <= 0:
+            return
+        delta = {"chip_seconds": chips * dt}
+        for k, v in cur.items():
+            d = v - prev["counters"].get(k, 0.0)
+            if d < 0:                   # counter reset (replica restart)
+                d = v
+            delta[k] = d
+        self._stub_ws[stub_id] = workspace_id
+        self._acc.setdefault((workspace_id, stub_id),
+                             _WindowAcc(self.window_s)).add(mono, delta)
+
+    def router_sample(self, stub_id: str, workspace_id: str,
+                      submitted_total: float, shed_total: float,
+                      queue_wait_total_s: float) -> None:
+        """Fold the router's cumulative per-stub counters (sampled each
+        gateway tick) into the same window."""
+        mono = time.monotonic()
+        key = f"router:{stub_id}"
+        cur = {"submitted": submitted_total, "shed": shed_total,
+               "queue_wait_s": queue_wait_total_s}
+        prev = self._last.get(key)
+        self._last[key] = {"counters": cur, "mono": mono, "chips": 0.0}
+        if prev is None:
+            return
+        delta = {}
+        for k, v in cur.items():
+            d = v - prev["counters"].get(k, 0.0)
+            delta[k] = v if d < 0 else d
+        self._stub_ws[stub_id] = workspace_id
+        self._acc.setdefault((workspace_id, stub_id),
+                             _WindowAcc(self.window_s)).add(mono, delta)
+
+    def forget_replica(self, container_id: str) -> None:
+        self._last.pop(container_id, None)
+
+    def workspaces(self) -> set[str]:
+        return {ws for (ws, _stub) in self._acc}
+
+    # -- decomposition -------------------------------------------------------
+
+    def _decompose_sums(self, sums: dict,
+                        chip_seconds: Optional[float] = None) -> dict:
+        """One goodput fraction + the named waste buckets, each ∈ [0, 1],
+        summing to exactly 1 (``idle_reservation`` is the remainder)."""
+        t = chip_seconds if chip_seconds and chip_seconds > 0 else \
+            sums.get("chip_seconds", 0.0)
+        useful = sums.get("tokens_generated", 0.0)
+        rollback = max(sums.get("spec_proposed", 0.0)
+                       - sums.get("spec_accepted", 0.0), 0.0)
+        out = {"chip_seconds": round(t, 3),
+               "useful_tokens": int(useful),
+               "rollback_tokens": int(rollback),
+               "sheds": int(sums.get("shed", 0.0)),
+               "submitted": int(sums.get("submitted", 0.0)),
+               "queue_wait_s": round(sums.get("queue_wait_s", 0.0), 3),
+               "goodput_tokens_per_chip_second":
+                   round(useful / t, 3) if t > 0 else 0.0}
+        if t <= 0:
+            # no metered chip time: nothing to decompose — all idle
+            out["goodput_frac"] = 0.0
+            out["waste"] = {b: (1.0 if b == "idle_reservation" else 0.0)
+                            for b in WASTE_BUCKETS}
+            return out
+        # busy chip-seconds: engine phase seconds × the replica's chips.
+        # chips already rode into chip_seconds; phase seconds are wall
+        # seconds of ONE engine — scale by the window's mean chips
+        mean_chips = (sums.get("chip_seconds", 0.0)
+                      / max(sums.get("_wall_s", 0.0), 1e-9)
+                      if sums.get("_wall_s") else 1.0)
+        busy = (sums.get("prefill_s", 0.0)
+                + sums.get("decode_window_s", 0.0)) * max(mean_chips, 1.0)
+        stall = sums.get("graph_compile_stall_s", 0.0) * max(mean_chips, 1.0)
+        # clamp accounting noise: busy + stall can't exceed metered time
+        if busy + stall > t:
+            scale = t / (busy + stall)
+            busy *= scale
+            stall *= scale
+        tok_total = useful + rollback
+        goodput_s = busy * (useful / tok_total) if tok_total > 0 else busy
+        spec_s = busy - goodput_s
+        idle = max(t - busy - stall, 0.0)
+        # attribute idle by demand evidence: queued work (queue-wait
+        # request-seconds), turned-away work (shed fraction), remainder
+        # is genuinely idle reservation
+        w_q = _clamp01(sums.get("queue_wait_s", 0.0) / t)
+        sub = sums.get("submitted", 0.0) + sums.get("shed", 0.0)
+        w_s = _clamp01(sums.get("shed", 0.0) / sub) if sub > 0 else 0.0
+        w_i = max(1.0 - w_q - w_s, 0.0)
+        norm = w_q + w_s + w_i
+        w_q, w_s, w_i = (w / norm for w in (w_q, w_s, w_i)) if norm > 0 \
+            else (0.0, 0.0, 1.0)
+        waste = {"queue_wait": idle * w_q / t,
+                 "shed": idle * w_s / t,
+                 "spec_rollback": spec_s / t,
+                 "recompile_stall": stall / t}
+        goodput_frac = goodput_s / t
+        waste["idle_reservation"] = max(
+            1.0 - goodput_frac - sum(waste.values()), 0.0)
+        out["goodput_frac"] = round(_clamp01(goodput_frac), 6)
+        out["waste"] = {k: round(_clamp01(v), 6) for k, v in waste.items()}
+        return out
+
+    def _window_sums(self, key: tuple) -> dict:
+        acc = self._acc.get(key)
+        if acc is None:
+            return {}
+        sums = acc.sums(self.window_s)
+        if acc.samples:
+            # wall seconds actually covered by the window's samples (for
+            # the mean-chips estimate); monotonic stamps, never wall
+            cutoff = time.monotonic() - self.window_s
+            stamps = [m for m, _ in acc.samples if m >= cutoff]
+            if len(stamps) >= 2:
+                sums["_wall_s"] = stamps[-1] - stamps[0]
+        return sums
+
+    def snapshot(self, usage_chip_seconds: Optional[dict] = None) -> dict:
+        """Per-workspace decomposition with per-stub detail.
+        ``usage_chip_seconds``: workspace -> metered chip-seconds from
+        usage.py's hot buckets (the billing join); when present and
+        positive it becomes the denominator, else the accountant's own
+        replica-seconds accumulation stands in (CPU dev fleets meter 0
+        chips)."""
+        per_ws: dict[str, dict] = {}
+        for (ws, stub), _ in self._acc.items():
+            agg = per_ws.setdefault(ws, {"sums": {}, "stubs": {}})
+            sums = self._window_sums((ws, stub))
+            agg["stubs"][stub] = self._decompose_sums(sums)
+            for k, v in sums.items():
+                agg["sums"][k] = agg["sums"].get(k, 0.0) + v
+        out: dict[str, dict] = {}
+        for ws, agg in per_ws.items():
+            metered = (usage_chip_seconds or {}).get(ws, 0.0)
+            row = self._decompose_sums(
+                agg["sums"], chip_seconds=metered if metered > 0 else None)
+            row["metered_chip_seconds"] = round(metered, 3)
+            row["window_s"] = self.window_s
+            row["stubs"] = agg["stubs"]
+            out[ws] = row
+        return out
+
+    def publish(self, snapshot: dict) -> None:
+        """Per-workspace ``tpu9_goodput_*`` gauges (bounded cardinality:
+        workspaces × buckets)."""
+        for ws, row in snapshot.items():
+            labels = {"workspace": ws}
+            metrics.set_gauge("tpu9_goodput_tokens_per_chip_second",
+                              row["goodput_tokens_per_chip_second"],
+                              labels=labels)
+            metrics.set_gauge("tpu9_goodput_frac", row["goodput_frac"],
+                              labels=labels)
+            for bucket, frac in row["waste"].items():
+                metrics.set_gauge("tpu9_goodput_waste_frac", frac,
+                                  labels={"workspace": ws,
+                                          "bucket": bucket})

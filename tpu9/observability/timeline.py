@@ -1,0 +1,133 @@
+"""Bounded in-gateway time-series store (ISSUE 12).
+
+``/api/v1/metrics`` is an instantaneous snapshot; this module gives the
+fleet a *history* without growing a database: one fixed-capacity ring per
+series, sampled on the cadences the system already has (the runners'
+pressure heartbeat for engine stats, the gateway's SLO sampler tick for
+router signals), queryable at ``/api/v1/timeline?series=...&since=...``.
+
+Memory is bounded three ways:
+
+- each series is a ``deque(maxlen=capacity)`` — old samples fall off;
+- the store holds at most ``max_series`` rings — a new series past the
+  cap evicts the longest-idle ring first (and refuses only if every ring
+  is hot, which means the caller is minting unbounded series names — the
+  OBS002 lint class);
+- rings idle longer than ``idle_ttl_s`` are pruned by the sampler tick,
+  so a scaled-down replica's series don't outlive it forever.
+
+Samples carry BOTH clocks: a wall anchor (display, ``since`` filtering)
+and the monotonic stamp every window/rate computation uses — the OBS001
+rule (a stepped wall clock must never corrupt a duration or a burn-rate
+window).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Iterable, Optional
+
+# (wall_ts, mono_ts, value) triples; wall is an ANCHOR only
+_Sample = tuple
+
+
+class TimelineStore:
+    def __init__(self, capacity: int = 512, max_series: int = 4096,
+                 idle_ttl_s: float = 900.0):
+        self.capacity = max(int(capacity), 1)
+        self.max_series = max(int(max_series), 1)
+        self.idle_ttl_s = float(idle_ttl_s)
+        self._series: dict[str, deque] = {}
+        self._touched: dict[str, float] = {}    # name -> mono of last record
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, name: str, value: float,
+               ts: Optional[float] = None) -> None:
+        """Append one sample. ``ts`` is a wall anchor (defaults to now)."""
+        ring = self._series.get(name)
+        if ring is None:
+            if len(self._series) >= self.max_series:
+                self._evict_one()
+            ring = self._series[name] = deque(maxlen=self.capacity)
+        mono = time.monotonic()
+        ring.append((ts if ts is not None else time.time(), mono,
+                     float(value)))
+        self._touched[name] = mono
+
+    def record_many(self, values: dict, prefix: str = "",
+                    ts: Optional[float] = None) -> None:
+        for key, value in values.items():
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                self.record(f"{prefix}{key}", value, ts=ts)
+
+    def _evict_one(self) -> None:
+        """Drop the longest-idle series to make room for a new one."""
+        if not self._series:
+            return
+        victim = min(self._touched, key=self._touched.get)
+        self._series.pop(victim, None)
+        self._touched.pop(victim, None)
+
+    def prune(self, idle_s: Optional[float] = None) -> int:
+        """Drop series idle longer than ``idle_s`` (default the store's
+        TTL): dead replicas' rings must not accumulate forever."""
+        cutoff = time.monotonic() - (idle_s if idle_s is not None
+                                     else self.idle_ttl_s)
+        victims = [n for n, t in self._touched.items() if t < cutoff]
+        for name in victims:
+            self._series.pop(name, None)
+            self._touched.pop(name, None)
+        return len(victims)
+
+    # -- reading -------------------------------------------------------------
+
+    def series_names(self) -> list[str]:
+        return sorted(self._series)
+
+    def sample_count(self) -> int:
+        return sum(len(r) for r in self._series.values())
+
+    def values_window(self, name: str, window_s: float) -> list[float]:
+        """Values recorded in the last ``window_s`` seconds (monotonic
+        windowing — immune to wall steps)."""
+        ring = self._series.get(name)
+        if not ring:
+            return []
+        cutoff = time.monotonic() - window_s
+        return [v for (_, m, v) in ring if m >= cutoff]
+
+    def counter_delta(self, name: str, window_s: float) -> tuple[float, int]:
+        """(last − first, n_samples) over the window for a CUMULATIVE
+        series; a negative delta (counter reset — replica restart) reads
+        as the final value, not a negative rate."""
+        vals = self.values_window(name, window_s)
+        if len(vals) < 2:
+            return 0.0, len(vals)
+        delta = vals[-1] - vals[0]
+        if delta < 0:
+            delta = vals[-1]
+        return delta, len(vals)
+
+    def query(self, names: Iterable[str], since: float = 0.0,
+              limit: Optional[int] = None) -> dict:
+        """``{name: [[wall_ts, value], ...]}`` for the requested series.
+        A name ending in ``*`` prefix-matches. ``since`` filters on the
+        wall anchor (what HTTP callers have); ``limit`` keeps the newest
+        N samples per series."""
+        wanted: list[str] = []
+        for name in names:
+            if name.endswith("*"):
+                stem = name[:-1]
+                wanted.extend(s for s in self._series if s.startswith(stem))
+            elif name in self._series:
+                wanted.append(name)
+        out: dict[str, list] = {}
+        for name in sorted(set(wanted)):
+            samples = [[w, v] for (w, _, v) in self._series[name]
+                       if w >= since]
+            if limit is not None and limit > 0:
+                samples = samples[-limit:]
+            out[name] = samples
+        return out

@@ -190,6 +190,11 @@ class FleetRouter:
     def snapshot_all(self) -> dict:
         return {stub_id: self.snapshot(stub_id) for stub_id in self._stubs}
 
+    def active_stubs(self) -> list[Stub]:
+        """Stubs with live router state (the gateway's SLO sampler walks
+        these for per-stub timeline series + burn evaluation)."""
+        return [st.stub for st in self._stubs.values()]
+
     # -- tenant weights --------------------------------------------------------
 
     async def _tenant_weight(self, workspace_id: str) -> float:
@@ -403,8 +408,12 @@ class FleetRouter:
         # fold the heartbeated speculative-decoding counters into the
         # fleet-wide tpu9_router_spec_* gauges (ISSUE 5) — this is the
         # dispatch path, so the signal refreshes exactly as often as the
-        # stats it is derived from
-        self.signals.spec_sample(all_stats)
+        # stats it is derived from; replicas silent past the staleness
+        # budget are excluded (ISSUE 12: dead counters must not haunt
+        # the fleet aggregate until the store TTL)
+        self.signals.spec_sample(all_stats,
+                                 max_age_s=getattr(self.cfg,
+                                                   "heartbeat_stale_s", 6.0))
         for s, stats in zip(replicas, all_stats):
             cid = s.container_id
             budgets[cid] = self.budgets.budget_from_stats(stats)

@@ -32,6 +32,11 @@ class RouterSignals:
         # fleet speculative-decoding counters (latest heartbeat fold)
         self._spec_proposed = 0
         self._spec_accepted = 0
+        # SLO burn fold (ISSUE 12): stub -> (fast-window burn rate, mono)
+        # written by the gateway's SLO sampler each tick; pressure() takes
+        # the max of queue pressure and the burn-derived pressure so a
+        # burning SLO scales the fleet BEFORE queue depth explodes
+        self._slo_burn: dict[str, tuple[float, float]] = {}
 
     # -- recording -------------------------------------------------------------
 
@@ -72,16 +77,51 @@ class RouterSignals:
         metrics.set_gauge("tpu9_router_prefix_entries",
                           stats.get("entries", 0))
 
-    def spec_sample(self, replica_stats: list) -> None:
+    def slo_sample(self, stub_id: str, burn_fast: float) -> None:
+        """Record the stub's worst fast-window SLO burn rate (ISSUE 12).
+        Called by the gateway's SLO sampler; feeds :meth:`pressure`."""
+        self._slo_burn[stub_id] = (max(float(burn_fast), 0.0),
+                                   time.monotonic())
+        metrics.set_gauge("tpu9_router_slo_burn", burn_fast,
+                          labels={"stub": stub_id})
+
+    def slo_pressure(self, stub_id: str) -> float:
+        """Pressure contribution of a burning SLO ∈ [0, 1]: burn 1.0 (the
+        budget spending exactly at its allowed pace) reads as half
+        pressure, burn ≥ 2 saturates. Evaluations older than 30 s are
+        ignored — a stopped sampler must not pin pressure forever."""
+        burn, ts = self._slo_burn.get(stub_id, (0.0, 0.0))
+        if ts == 0.0 or time.monotonic() - ts > 30.0:
+            return 0.0
+        return min(burn / 2.0, 1.0)
+
+    def spec_sample(self, replica_stats: list,
+                    max_age_s: float = 0.0) -> None:
         """Fleet-wide speculative-decoding acceptance (ISSUE 5): fold the
         heartbeated per-engine ``spec_proposed``/``spec_accepted``
         counters into one ratio — the signal that says whether the
         fleet's traffic is actually repetitive enough for prompt-lookup
-        speculation to pay for its verify compute."""
+        speculation to pay for its verify compute.
+
+        ``max_age_s`` > 0 excludes stale heartbeats (ISSUE 12 satellite):
+        a replica that stopped beating keeps its last hash in the store
+        until the TTL, and folding that corpse into the fleet ratio
+        misattributes dead counters to live traffic."""
         proposed = accepted = 0
         for stats in replica_stats:
             if not stats:
                 continue
+            if max_age_s > 0:
+                try:
+                    # heartbeat stamps are wall by design (they cross
+                    # hosts via the store); staleness here is coarse
+                    # (seconds vs an NTP step) and fails open
+                    beat_ts = float(stats.get("ts", 0.0))
+                    # tpu9: noqa[OBS001] cross-host heartbeat age must use the wall stamp the runner shipped; a step mis-ages one fold, the next beat self-corrects
+                    if beat_ts and time.time() - beat_ts > max_age_s:
+                        continue
+                except (TypeError, ValueError):
+                    pass
             try:
                 proposed += int(float(stats.get("spec_proposed", 0)))
                 accepted += int(float(stats.get("spec_accepted", 0)))
@@ -107,14 +147,17 @@ class RouterSignals:
         """Router pressure ∈ [0, 1+]: queued work over fleet capacity,
         saturating to 1.0 whenever a shed happened in the last 10 s — a
         front door that is actively turning traffic away must read as
-        fully pressured regardless of instantaneous queue depth."""
+        fully pressured regardless of instantaneous queue depth. A
+        burning SLO (ISSUE 12) raises the floor the same way: objective
+        burn is the leading signal, queue depth the trailing one."""
         if time.monotonic() - self._last_shed_ts.get(stub_id, -1e9) < 10.0:
             return 1.0
+        slo = self.slo_pressure(stub_id)
         cap = self._capacity.get(stub_id, 0)
         depth = self._queue_depth.get(stub_id, 0)
         if cap <= 0:
-            return 1.0 if depth > 0 else 0.0
-        return min(depth / cap, 1.0)
+            return max(1.0 if depth > 0 else 0.0, slo)
+        return max(min(depth / cap, 1.0), slo)
 
     def latency(self, stub_id: str) -> dict:
         """Front-door latency decomposition for one stub (ISSUE 8): p50/
@@ -140,6 +183,8 @@ class RouterSignals:
                 "shed_rate": self.shed_rate(stub_id),
                 "queue_depth": self.queue_depth(stub_id),
                 "pressure": self.pressure(stub_id),
+                "slo_burn": self._slo_burn.get(stub_id, (0.0, 0.0))[0],
+                "slo_pressure": self.slo_pressure(stub_id),
                 "latency": self.latency(stub_id),
                 # fleet_ prefix: every other field is per-stub, but the
                 # speculation counters fold ALL heartbeating replicas —

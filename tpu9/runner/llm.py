@@ -273,7 +273,18 @@ async def amain() -> None:
                               # compile — the closed-signature invariant
                               # broke at runtime
                               "graph_compiles",
-                              "graph_compiles_post_warmup"):
+                              "graph_compiles_post_warmup",
+                              # fleet timeline + goodput accounting
+                              # (ISSUE 12): windowed tokens/sec, the
+                              # cumulative counters the gateway's
+                              # accountant differentiates, and the decode
+                              # physics constants the control plane
+                              # prices MFU/MBU from
+                              "tokens_per_sec", "tokens_generated",
+                              "graph_compile_stall_s",
+                              "decode_bytes_per_token_per_chip",
+                              "decode_flops_per_token_per_chip",
+                              "device_kind"):
                         if k in stats:
                             extra[k] = stats[k]
                     pc = stats.get("prefix_cache")

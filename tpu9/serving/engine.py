@@ -348,6 +348,30 @@ class InferenceEngine:
         self._profile_active = False
         self._profile_path = ""
         self._profile_error = ""
+        # ---- fleet timeline physics (ISSUE 12) ----
+        # tokens/sec window: (monotonic, tokens_generated) pairs appended
+        # on the stats() READ path (heartbeat cadence), zero serve-loop
+        # cost; rate = delta over the retained window
+        self._tps_window: list = []
+        # per-chip decode physics constants: bytes streamed / matmul
+        # FLOPs per generated token, so the CONTROL plane can price
+        # MFU/MBU from heartbeated tokens/sec without importing model
+        # internals. Decode is weight-streaming-bound: every step reads
+        # the whole resident weight shard (KV bytes excluded — second-
+        # order for the fleet-utilization signal this feeds).
+        n_chips = max(int(self.policy.describe().get("n_chips", 1)), 1)
+        wb = nparams = 0
+        for leaf in jax.tree_util.tree_leaves(params):
+            size = getattr(leaf, "size", 0)
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 0)
+            wb += size * itemsize
+            nparams += size
+        self._phys_bytes_per_token_per_chip = wb / n_chips
+        self._phys_flops_per_token_per_chip = 2.0 * nparams / n_chips
+        try:
+            self._device_kind = jax.devices()[0].device_kind
+        except Exception:   # noqa: BLE001 — physics labels are best-effort
+            self._device_kind = ""
 
     # -- compiled steps (serving.graphs) + scheduling (serving.schedule) ----
     # Thin delegates: the implementations moved out with the ISSUE 9
@@ -685,6 +709,32 @@ class InferenceEngine:
         # (the factory also logs each incident loudly).
         out["graph_compiles"] = self.graphs.compiles
         out["graph_compiles_post_warmup"] = self.graphs.post_seal_compiles
+        # cumulative seconds serving stalled behind those compiles — the
+        # goodput accountant's recompile_stall bucket (ISSUE 12)
+        out["graph_compile_stall_s"] = round(
+            self.graphs.post_seal_stall_s, 6)
+        # ---- fleet timeline series (ISSUE 12) ----
+        # tokens/sec over the retained read-path window: each stats()
+        # call (heartbeat cadence) appends the cumulative counter and
+        # rates the delta — no serve-loop instrumentation at all
+        now_m = time.monotonic()
+        self._tps_window.append((now_m, self._stats["tokens_generated"]))
+        while (len(self._tps_window) > 2
+               and now_m - self._tps_window[0][0] > 30.0):
+            self._tps_window.pop(0)
+        t0, c0 = self._tps_window[0]
+        span = now_m - t0
+        out["tokens_per_sec"] = round(
+            (self._stats["tokens_generated"] - c0) / span, 3) \
+            if span > 0.5 else 0.0
+        # decode physics constants + device kind: the gateway prices
+        # MFU/MBU timeline series from these (benchsuite.physics specs
+        # stay control-plane-side; the engine ships raw arithmetic)
+        out["decode_bytes_per_token_per_chip"] = \
+            self._phys_bytes_per_token_per_chip
+        out["decode_flops_per_token_per_chip"] = \
+            self._phys_flops_per_token_per_chip
+        out["device_kind"] = self._device_kind
         # topology (ISSUE 9): flat scalars so the runner heartbeat can
         # forward them into the store hash behind /api/v1/metrics
         # "engines" unchanged — tp/fsdp/n_chips plus live per-chip HBM

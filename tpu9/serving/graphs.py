@@ -58,6 +58,11 @@ class GraphFactory:
         # pass, surfaced via engine.stats()["graph_compiles*"].
         self.compiles = 0
         self.post_seal_compiles = 0
+        # cumulative seconds serving stalled behind post-seal compiles
+        # (ISSUE 12: the goodput accountant's "recompile_stall" waste
+        # bucket) — measured as the first dispatch's wall time, since
+        # jax.jit compiles lazily at that first call
+        self.post_seal_stall_s = 0.0
         self._sealed = False
 
     def _build(self, key, builder):
@@ -73,8 +78,23 @@ class GraphFactory:
                     "window is stalling behind an XLA compile; the "
                     "precompile signature set is open (graphcheck GRA005 "
                     "should have caught this)", key)
+                fn = self.compiled[key] = self._timed_first_call(
+                    key, builder())
+                return fn
             fn = self.compiled[key] = builder()
         return fn
+
+    def _timed_first_call(self, key, real):
+        """Wrap a post-seal-built callable so its FIRST dispatch — the one
+        that pays the XLA compile — is timed into ``post_seal_stall_s``,
+        then unwrap (steady state dispatches the bare executable)."""
+        def timed(*args, **kwargs):
+            t0 = time.perf_counter()
+            out = real(*args, **kwargs)
+            self.post_seal_stall_s += time.perf_counter() - t0
+            self.compiled[key] = real
+            return out
+        return timed
 
     def seal(self) -> None:
         """Mark the executable cache complete: every signature the serve
