@@ -75,6 +75,14 @@ GUARDED_FIELDS = {
     # ±10-15% (the phase floors it) and coverage's goodness is "≈1", not
     # monotonic; the phase gates both.
     "obs_overhead_frac": "down",
+    # cold-start decomposition (ISSUE 13): the fetch∥consume overlap of
+    # the streamed restore must not collapse back toward serial (the
+    # double-buffering win the coldstart report exists to evidence). The
+    # traced-vs-measured disagreement is NOT ratio-guarded (it is a small
+    # noisy number; the phase hard-gates it at 10% and strips the whole
+    # decomposition on failure — the HARD presence check below catches
+    # that via this field).
+    "coldstart_overlap_frac": "up",
 }
 
 # HARD-gated fields: the quant phase's oracle-margin parity judge and the
@@ -87,7 +95,11 @@ HARD_FIELDS = ("quant_shard_bytes_ratio", "quant_kv_capacity_ratio",
                "quant_tokens_per_sec_ratio", "obs_overhead_frac",
                # the multichip phase's parity judge / planner checks strip
                # these on failure — a vanished value IS the regression
-               "multichip_weight_shard_ratio", "multichip_total_ratio")
+               "multichip_weight_shard_ratio", "multichip_total_ratio",
+               # coldstart_stream strips its decomposition when the traced
+               # spans disagree with the measured intervals (>10%) — a
+               # vanished value means the restore evidence went wrong
+               "coldstart_overlap_frac")
 
 
 def extract_metrics(path: str) -> dict:

@@ -141,3 +141,149 @@ async def test_gateway_ckpt_rpc_surface():
                     f"{base}/rpc/internal/ckpt/manifest/..%2F..%2Fetc",
                     headers=wtok) as r:
                 assert r.status in (400, 404)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: streamed restore emits the cold-start evidence layer end to end
+# ---------------------------------------------------------------------------
+
+STREAMED = """
+import os
+import numpy as np
+from tpu9.runner import ckpt
+
+def _init():
+    rng = np.random.default_rng(7)
+    return {"w": [rng.standard_normal(4096).astype(np.float32)
+                  for _ in range(4)]}
+
+if ckpt.is_restored():
+    PARAMS = ckpt.load_params()
+    BUILT = False
+else:
+    PARAMS = _init()
+    ckpt.save_params(PARAMS)
+    BUILT = True
+
+def handler(**kw):
+    return {"built": BUILT,
+            "restored": os.environ.get("TPU9_RESTORED", "0"),
+            "checksum": float(sum(np.asarray(a).sum()
+                                  for a in PARAMS["w"]))}
+"""
+
+
+async def test_streamed_restore_trace_and_coldstart_evidence():
+    """A cold start that streams `.tpu9w` weights must light up every layer
+    of the evidence plane: one gapless restore span tree at /api/v1/traces
+    (worker.cold_start ⊃ restore.request ⊃ restore.fetch ∥ device_put with
+    tier/bytes attrs, wall-anchor containment), a decomposition record at
+    /api/v1/coldstart, and cache.*/weightpool.* timeline series."""
+    stack = LocalStack()
+    # tighten the evidence cadences so the test doesn't wait out defaults
+    stack.cfg.worker.heartbeat_interval_s = 0.2
+    stack.cfg.slo.sample_interval_s = 0.2
+    async with stack:
+        dep = await stack.deploy_endpoint(
+            "ckstream", {"app.py": STREAMED}, "app:handler",
+            config_extra={"checkpoint": {"enabled": True}})
+        out1 = await stack.invoke(dep, {}, timeout=180.0)
+        assert out1["built"] is True and out1["restored"] == "0"
+        for _ in range(200):
+            row = await stack.backend.latest_checkpoint(dep["stub_id"])
+            if row:
+                break
+            await asyncio.sleep(0.1)
+        assert row, "checkpoint never became available"
+
+        await stack.scale_to_zero(dep)
+        out2 = await stack.invoke(dep, {}, timeout=180.0)
+        assert out2["restored"] == "1" and out2["built"] is False
+        assert abs(out2["checksum"] - out1["checksum"]) < 1e-3
+
+        # the restore actually STREAMED a weight group (not classic-only)
+        metrics = next(
+            (w.checkpoints.last_restore_metrics for w in stack.workers
+             if w.checkpoints is not None
+             and w.checkpoints.last_restore_metrics.get("weight_groups")),
+            None)
+        assert metrics, "no worker recorded a streamed restore"
+        assert metrics["weight_stream_bytes"] > 0
+        assert metrics["tiers"]["local"] + metrics["tiers"]["peer"] \
+            + metrics["tiers"]["source"] + metrics["tiers"]["pool"] > 0
+
+        # ---- /api/v1/traces: the gapless restore span tree ----
+        status, data = await stack.api("GET", "/api/v1/traces?limit=3000")
+        assert status == 200
+        spans = data["spans"]
+        reqs = [s for s in spans if s["name"] == "restore.request"]
+        assert reqs, f"no restore.request span in {len(spans)} spans"
+        req = reqs[-1]
+        tree = [s for s in spans if s["traceId"] == req["traceId"]]
+        names = {s["name"] for s in tree}
+        assert "worker.cold_start" in names
+        assert "restore.fetch" in names
+        assert "restore.device_put" in names
+        root = [s for s in tree if s["name"] == "worker.cold_start"][0]
+        assert req["parentSpanId"] == root["spanId"]
+        slack = 50e6                     # 50 ms, the PR-8 e2e convention
+        for sp in tree:
+            if sp["name"] not in ("restore.fetch", "restore.device_put"):
+                continue
+            assert sp["parentSpanId"] == req["spanId"]
+            assert sp["startTimeUnixNano"] >= \
+                req["startTimeUnixNano"] - slack
+            assert sp["endTimeUnixNano"] <= req["endTimeUnixNano"] + slack
+            assert sp["attributes"]["workspace_id"], "tenancy stamp missing"
+        fetch = [s for s in tree if s["name"] == "restore.fetch"][0]
+        assert fetch["attributes"]["bytes"] > 0
+        assert fetch["attributes"]["tier"] in ("local", "peer", "source")
+
+        # traced fetch/put intervals agree with the worker's measured
+        # record (the same ≤10% cross-check the bench gates)
+        from tpu9.observability import coldstart as cs
+        traced = cs.decompose_spans(tree)
+        want_fetch = sum(g["fetch_iv"][1] - g["fetch_iv"][0]
+                         for g in metrics["groups_detail"]
+                         if g.get("fetch_iv"))
+        assert cs.agreement(traced["fetch_s"], want_fetch) < 0.10, \
+            (traced, want_fetch)
+
+        # ---- /api/v1/coldstart: the per-replica decomposition record ----
+        rec = None
+        for _ in range(150):
+            status, cold = await stack.api("GET", "/api/v1/coldstart")
+            assert status == 200
+            for cid, r in cold.get("replicas", {}).items():
+                if r.get("restore", {}).get("weight_groups"):
+                    rec = r
+            if rec:
+                break
+            await asyncio.sleep(0.1)
+        assert rec, "coldstart record never shipped on the heartbeat"
+        assert rec["stub_id"] == dep["stub_id"]
+        assert rec["restore"]["weight_stream_bytes"] > 0
+        assert "overlap_frac" in rec["restore"]
+        assert "hedge" in rec["restore"]
+
+        # ---- /api/v1/timeline: cache.* / weightpool.* series ----
+        series = {}
+        for _ in range(150):
+            status, tl = await stack.api(
+                "GET", "/api/v1/timeline?series=cache.*,weightpool.*")
+            assert status == 200
+            series = tl.get("series", {})
+            if any(k.startswith("cache.") and v
+                   for k, v in series.items()) \
+                    and any(k.startswith("weightpool.")
+                            for k in series):
+                break
+            await asyncio.sleep(0.1)
+        assert any(k.startswith("cache.") and v
+                   for k, v in series.items()), sorted(series)[:20]
+        assert any(k.startswith("weightpool.") for k in series)
+        # /api/v1/metrics carries the cache-plane snapshot section too
+        status, m = await stack.api("GET", "/api/v1/metrics")
+        assert status == 200 and m.get("cache"), "metrics cache section"
+        wsnap = next(iter(m["cache"].values()))
+        assert "weightpool" in wsnap and wsnap["weightpool"]["hits"] >= 0

@@ -524,3 +524,111 @@ def test_prometheus_exposition_golden_slo_goodput_naming():
         labels, _, value = rest.rpartition("} ")
         float(value)                                     # parses
         assert "\n" not in labels
+
+
+# ---------------------------------------------------------------------------
+# cold-start decomposition helpers + cache-plane timeline ingest (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def test_coldstart_interval_helpers():
+    from tpu9.observability import coldstart as cs
+    assert cs.interval_overlap_s((0.0, 2.0), (1.0, 3.0)) == 1.0
+    assert cs.interval_overlap_s((0.0, 1.0), (2.0, 3.0)) == 0.0
+    assert cs.interval_overlap_s(None, (0.0, 1.0)) == 0.0
+    # shorter phase fully hidden → 1.0; serial → 0.0
+    assert cs.overlap_frac((0.0, 10.0), (2.0, 4.0)) == 1.0
+    assert cs.overlap_frac((0.0, 1.0), (1.0, 2.0)) == 0.0
+    assert cs.overlap_frac((0.0, 4.0), (2.0, 6.0)) == 0.5
+    # agreement: relative disagreement vs the larger side
+    assert cs.agreement(1.0, 1.0) == 0.0
+    assert cs.agreement(0.9, 1.0) == pytest.approx(0.1)
+    assert cs.agreement(0.0, 0.0) == 0.0
+
+
+def test_coldstart_decompose_spans_and_merge_record():
+    from tpu9.observability import coldstart as cs
+
+    def sp(name, dur_ms, attrs=None):
+        return {"name": name, "durationMs": dur_ms,
+                "attributes": attrs or {}}
+
+    spans = [sp(cs.SPAN_REQUEST, 1000),
+             sp(cs.SPAN_FETCH, 400, {"bytes": 100}),
+             sp(cs.SPAN_FETCH, 200, {"bytes": 50}),
+             sp(cs.SPAN_DEVICE_PUT, 500),
+             sp(cs.SPAN_COMPILE_AHEAD, 300),
+             sp("engine.request", 777)]        # unrelated span ignored
+    d = cs.decompose_spans(spans)
+    assert d["request_s"] == 1.0
+    assert d["fetch_s"] == pytest.approx(0.6)
+    assert d["device_put_s"] == pytest.approx(0.5)
+    assert d["compile_ahead_s"] == pytest.approx(0.3)
+    assert d["groups"] == 2 and d["bytes"] == 150
+
+    merged = cs.merge_record(
+        {"container_id": "c1", "restore": {"plan_s": 0.1}},
+        {"coldstart_ready_s": 2.5, "coldstart_warmup_s": 0.5,
+         "tokens_per_sec": 99})               # non-coldstart key dropped
+    assert merged["container_id"] == "c1"
+    assert merged["runner"] == {"ready_s": 2.5, "warmup_s": 0.5}
+    assert cs.merge_record(None, None) == {}
+
+
+def test_tracer_record_window_and_inherited_attrs():
+    import time as _time
+
+    from tpu9.observability.trace import Tracer
+    tracer = Tracer("t")
+    wall, mono = 1_000_000.0, _time.monotonic()
+    with tracer.span("root", attrs={"workspace_id": "ws",
+                                    "container_id": "ct",
+                                    "other": "x"}) as root:
+        assert tracer.inherited_attrs("workspace_id", "container_id",
+                                      "missing") == \
+            {"workspace_id": "ws", "container_id": "ct"}
+        sp = tracer.record_window("child", wall, mono, mono + 1.0,
+                                  mono + 3.0, attrs={"k": "v"})
+        # wall start = anchor + monotonic offset; duration from the pair
+        assert sp.start == pytest.approx(wall + 1.0)
+        assert sp.duration_s == pytest.approx(2.0)
+        assert sp.parent_id == root.span_id
+        assert sp.trace_id == root.trace_id
+        # a window that never opened records nothing
+        assert tracer.record_window("none", wall, mono, None, None) is None
+    assert tracer.inherited_attrs("workspace_id") == {}
+
+
+async def test_fleetobs_ingests_cache_plane_series():
+    import json
+
+    from tpu9.config import SloConfig
+    from tpu9.gateway.fleetobs import FleetObserver
+
+    store = MemoryStore()
+    obs = FleetObserver(SloConfig(), store)
+    snap = {"ts": 123.0, "worker_id": "w0",
+            "cache": {"local_hits": 5, "peer_hits": 2,
+                      "hedged_reads": 3, "hedge_wins": 1,
+                      "hedge_wasted_bytes": 4096,
+                      "bytes_local": 1000, "bytes_peer": 2000,
+                      "bytes_source": 0,
+                      "peers": {"10.0.0.2:7400": {"lat_ewma_s": 0.004,
+                                                  "bytes": 2000,
+                                                  "errors": 1}}},
+            "peer_bytes_per_s": 512.0,
+            "weightpool": {"hits": 1, "misses": 2, "evictions": 0,
+                           "entries": 1, "bytes": 777}}
+    await store.set("worker:cache:w0", json.dumps(snap))
+    await obs.sample_cache_plane()
+    tl = obs.timeline
+    q = tl.query(["cache.w0.*", "weightpool.w0.*"])
+    assert q["cache.w0.local_hits"][-1][1] == 5.0
+    assert q["cache.w0.hedge_wasted_bytes"][-1][1] == 4096.0
+    assert q["cache.w0.peer_bytes_per_s"][-1][1] == 512.0
+    # the PER-PEER series the acceptance criterion names
+    assert q["cache.w0.peer.10.0.0.2:7400.lat_ewma_s"][-1][1] == 0.004
+    assert q["cache.w0.peer.10.0.0.2:7400.errors"][-1][1] == 1.0
+    assert q["weightpool.w0.bytes"][-1][1] == 777.0
+    # garbage snapshots are skipped, not fatal
+    await store.set("worker:cache:w1", "not json")
+    await obs.sample_cache_plane()

@@ -110,3 +110,51 @@ async def test_wait_survives_crashed_reap_and_logs(tmp_path, caplog):
     assert code == 0
     assert any("umount exploded" in r.getMessage() for r in caplog.records), \
         "crashed reap was silently absorbed without a log line"
+
+
+# ---------------------------------------------------------------------------
+# stop-after-exit must not resurrect a terminal container state (ISSUE 13 —
+# surfaced by the evidence-plane timing shifts; the race is older)
+# ---------------------------------------------------------------------------
+
+async def test_stop_after_exit_does_not_resurrect_state():
+    """A stop request landing AFTER the supervisor terminalized the
+    container used to write STOPPING back into the store — re-adding the
+    container to the stub index (only terminal update_state removes it)
+    with no supervisor left to ever terminalize it again. A retrying
+    scale-down loop then refreshed the phantom's TTL forever and spun on
+    'containers did not stop'."""
+    from tpu9.config import WorkerConfig
+    from tpu9.repository import ContainerRepository
+    from tpu9.statestore import MemoryStore
+    from tpu9.repository.keys import Keys
+    from tpu9.types import ContainerState, ContainerStatus
+    from tpu9.worker.lifecycle import ContainerLifecycle
+    from tpu9.worker.tpu_manager import TpuDeviceManager
+
+    class DeadRuntime:
+        name = "process"
+
+        async def kill(self, container_id, sig=15):
+            return False          # container already exited / unknown
+
+    store = MemoryStore()
+    containers = ContainerRepository(store)
+    # the supervisor's terminal write: STOPPED state row persists (TTL),
+    # stub index entry removed
+    state = ContainerState(container_id="ct-dead", stub_id="stub-x",
+                           workspace_id="ws-x",
+                           status=ContainerStatus.STOPPED.value)
+    await containers.update_state(state)
+    assert await store.hgetall(Keys.stub_containers("stub-x")) == {}
+
+    lc = ContainerLifecycle("w0", WorkerConfig(), DeadRuntime(),
+                            containers, TpuDeviceManager())
+    assert await lc.stop_container("ct-dead", reason="scale_down") is False
+    # neither resurrected in the index nor flipped off terminal status
+    assert await store.hgetall(Keys.stub_containers("stub-x")) == {}
+    got = await containers.get_state("ct-dead")
+    assert got is not None
+    assert got.status == ContainerStatus.STOPPED.value
+    # and no pending-reason leak for a container with no supervisor
+    assert "ct-dead" not in lc._pending_reasons

@@ -211,13 +211,41 @@ async def test_compile_ahead_abstract_precompile_then_bind():
 
 async def test_load_engine_compile_ahead_overlaps_weight_build():
     """presets.load_engine(compile_ahead=True): the engine comes back
-    bound, precompiled (timings recorded), and servable."""
+    bound, precompiled (timings recorded), and servable — and the bring-up
+    emits its restore.load/compile_ahead/bind span tree + decomposition
+    (ISSUE 13)."""
+    from tpu9.observability import coldstart as cs
+    from tpu9.observability.trace import tracer
     from tpu9.serving.presets import load_engine
 
-    eng = load_engine("llama-tiny", max_batch=2, max_seq_len=128,
-                      prefill_buckets=(16, 64), decode_steps=(1, 4),
-                      compile_ahead=True)
+    with tracer.span("runner.bringup") as root:
+        eng = load_engine("llama-tiny", max_batch=2, max_seq_len=128,
+                          prefill_buckets=(16, 64), decode_steps=(1, 4),
+                          compile_ahead=True)
     assert eng.compile_ahead_timings
+    # bring-up decomposition: every phase recorded, overlap measured, and
+    # the flat coldstart_* scalars ride stats() for the heartbeat
+    for key in ("load_s", "compile_ahead_s", "bind_s",
+                "compile_overlap_s"):
+        assert key in eng.bringup, eng.bringup
+    assert eng.bringup["compile_overlap_s"] <= \
+        eng.bringup["compile_ahead_s"] + 1e-6
+    assert eng.stats()["coldstart_load_s"] == eng.bringup["load_s"]
+    # one gapless tree under the bring-up root, wall-anchor containment
+    spans = tracer.export(trace_id=root.trace_id)
+    names = {sp["name"] for sp in spans}
+    assert {cs.SPAN_LOAD, cs.SPAN_COMPILE_AHEAD, cs.SPAN_BIND} <= names
+    rootd = [sp for sp in spans if sp["name"] == "runner.bringup"][0]
+    for sp in spans:
+        if sp["name"] in (cs.SPAN_LOAD, cs.SPAN_COMPILE_AHEAD,
+                          cs.SPAN_BIND):
+            assert sp["parentSpanId"] == rootd["spanId"]
+            assert sp["startTimeUnixNano"] >= \
+                rootd["startTimeUnixNano"] - 50e6
+            assert sp["endTimeUnixNano"] <= rootd["endTimeUnixNano"] + 50e6
+    traced = cs.decompose_spans(spans)
+    assert cs.agreement(traced["compile_ahead_s"],
+                        eng.bringup["compile_ahead_s"]) < 0.10
     eng.warmup()
     await eng.start()
     try:

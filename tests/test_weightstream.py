@@ -549,3 +549,256 @@ async def test_restore_params_overlap_with_slow_io(tmp_path):
         assert wall < serial * 0.9, (wall, serial, metrics)
     finally:
         await client.close()
+
+
+# ---------------------------------------------------------------------------
+# cache-plane accounting: per-peer EWMAs, hedge outcomes, wasted bytes
+# (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+async def test_per_peer_ewma_diverges_with_one_slow_peer(tmp_path):
+    """One slow peer must inflate ONLY its own EWMA (satellite: the old
+    single global EWMA stretched the adaptive hedge delay for everyone)."""
+    blobs = {chunk_hash(bytes([i]) * 2000): bytes([i]) * 2000
+             for i in range(6)}
+    fast = await FakePeer(dict(blobs), delay=0.0).start()
+    slow = await FakePeer(dict(blobs), delay=0.08).start()
+    client = CacheClient(DiskStore(str(tmp_path)),
+                         peers=lambda: _aret([fast.address, slow.address]))
+    try:
+        for digest in blobs:
+            assert await client._peer_get_verified(fast.address, digest)
+            assert await client._peer_get_verified(slow.address, digest)
+        snap = client.snapshot()
+        lat_fast = snap["peers"][fast.address]["lat_ewma_s"]
+        lat_slow = snap["peers"][slow.address]["lat_ewma_s"]
+        assert lat_slow > lat_fast * 3, (lat_fast, lat_slow)
+        assert client._lat_estimate(slow.address) == \
+            pytest.approx(lat_slow, abs=1e-5)
+        assert client._lat_estimate(fast.address) == \
+            pytest.approx(lat_fast, abs=1e-5)
+        # cold peer falls back to the global prior (which both fed)
+        assert client._lat_estimate("10.9.9.9:1") == \
+            pytest.approx(snap["lat_ewma_global_s"], abs=1e-5)
+        assert snap["lat_ewma_global_s"] > 0
+        # per-peer bytes + histograms populated; slow peer's mass sits in
+        # higher buckets than the fast peer's
+        for peer in (fast.address, slow.address):
+            entry = snap["peers"][peer]
+            assert entry["exchanges"] == len(blobs)
+            assert entry["bytes"] == sum(len(b) for b in blobs.values())
+            assert sum(entry["hist"]) == len(blobs)
+        hist_f = snap["peers"][fast.address]["hist"]
+        hist_s = snap["peers"][slow.address]["hist"]
+        centroid = lambda h: (sum(i * n for i, n in enumerate(h))
+                              / max(sum(h), 1))          # noqa: E731
+        assert centroid(hist_s) > centroid(hist_f)
+    finally:
+        await client.close()
+        await fast.stop()
+        await slow.stop()
+
+
+async def test_hedge_accounting_slow_primary(tmp_path):
+    """End-to-end hedge ledger with an artificially slow primary: the
+    hedge fires, wins, and the per-peer EWMAs diverge (the satellite's
+    acceptance shape)."""
+    from tpu9.cache.client import hrw_order
+    blobs = {chunk_hash(bytes([i]) * 30_000): bytes([i]) * 30_000
+             for i in range(4)}
+    p1 = await FakePeer(dict(blobs)).start()
+    p2 = await FakePeer(dict(blobs)).start()
+    by_addr = {p1.address: p1, p2.address: p2}
+    client = CacheClient(DiskStore(str(tmp_path)),
+                         peers=lambda: _aret([p1.address, p2.address]),
+                         hedge_delay_s=0.02)
+    slow_addr = p1.address      # p1 slow regardless of HRW rank
+    by_addr[slow_addr].delay = 0.5
+    wins_expected = 0
+    try:
+        for digest in blobs:
+            if hrw_order(digest, [p1.address, p2.address])[0] == slow_addr:
+                wins_expected += 1       # hedge must beat the slow primary
+            assert await client.get(digest) == blobs[digest]
+        assert client.stats["hedge_wins"] == wins_expected
+        assert client.stats["hedged_reads"] >= wins_expected
+        snap = client.snapshot()
+        if wins_expected and snap["peers"].get(slow_addr):
+            # any completed exchange on the slow peer fed ITS ewma only
+            fast_addr = p2.address
+            if snap["peers"].get(fast_addr):
+                assert snap["peers"][slow_addr]["lat_ewma_s"] > \
+                    snap["peers"][fast_addr]["lat_ewma_s"]
+    finally:
+        await client.close()
+        await p1.stop()
+        await p2.stop()
+
+
+async def test_hedge_wasted_bytes_counted_for_completed_loser(tmp_path):
+    """A hedge loser that completes with verified data after the race is
+    decided counts its bytes as waste — the cost side of the ledger."""
+    client = CacheClient(DiskStore(str(tmp_path)),
+                         peers=lambda: _aret([]), hedge_delay_s=0.0)
+    blob = b"w" * 12_345
+    release = asyncio.Event()
+
+    async def fake_verified(peer, digest):
+        await release.wait()            # both racers finish together
+        return blob
+
+    client._peer_get_verified = fake_verified
+    task = asyncio.create_task(
+        client._hedged_peer_get(["pA:1", "pB:1"], "d0"))
+    await asyncio.sleep(0.05)           # let both racers launch and park
+    release.set()
+    got = await task
+    assert got == blob
+    # deterministic winner preference: earliest-ranked completed task
+    # wins the same-wakeup tie → the OTHER completed try is pure waste
+    assert client.stats["hedge_wins"] == 0
+    assert client.stats["hedge_wasted_bytes"] == len(blob)
+    assert client.stats["hedged_reads"] == 1
+    await client.close()
+
+
+# ---------------------------------------------------------------------------
+# restore trace span tree + decomposition record (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def _spans_by_name(spans, name):
+    return [s for s in spans if s["name"] == name]
+
+
+async def test_streamed_restore_emits_gapless_span_tree(tmp_path):
+    from tpu9.observability import coldstart as cs
+    from tpu9.observability.trace import tracer
+
+    pool = WeightPool(1 << 30)
+    cm, client = await _make_cm(tmp_path, pool=pool)
+    src = _write_src(tmp_path)
+    ckpt = await cm.create("stub", "ws", "c0", src)
+    try:
+        with tracer.span("worker.cold_start",
+                         attrs={"workspace_id": "ws-1",
+                                "container_id": "ct-1"}) as root:
+            assert await cm.restore(ckpt, str(tmp_path / "r1"))
+        metrics = cm.last_restore_metrics
+        spans = tracer.export(trace_id=root.trace_id)
+        req = _spans_by_name(spans, cs.SPAN_REQUEST)
+        fetch = _spans_by_name(spans, cs.SPAN_FETCH)
+        put = _spans_by_name(spans, cs.SPAN_DEVICE_PUT)
+        assert len(req) == 1 and len(fetch) == 1 and len(put) == 1
+
+        # parentage: request under cold_start, fetch/put under request
+        assert req[0]["parentSpanId"] == root.span_id
+        for sp in fetch + put:
+            assert sp["parentSpanId"] == req[0]["spanId"]
+            # identity stamps inherited from the cold_start attrs — the
+            # per-SPAN tenancy /api/v1/traces scopes on
+            assert sp["attributes"]["workspace_id"] == "ws-1"
+            assert sp["attributes"]["container_id"] == "ct-1"
+
+        # wall-anchor containment (50 ms slack, same as the e2e gate)
+        slack = 50e6
+        for sp in fetch + put:
+            assert sp["startTimeUnixNano"] >= \
+                req[0]["startTimeUnixNano"] - slack
+            assert sp["endTimeUnixNano"] <= \
+                req[0]["endTimeUnixNano"] + slack
+
+        # tier/bytes attributes: everything came from the local store
+        assert fetch[0]["attributes"]["tier"] == "local"
+        assert fetch[0]["attributes"]["bytes"] == \
+            metrics["weight_stream_bytes"] > 0
+        assert fetch[0]["attributes"]["bytes_local"] > 0
+        assert put[0]["attributes"]["consumer"] == "workdir_spill"
+
+        # decomposition record: tiers/hedge/overlap/groups_detail
+        assert metrics["tiers"]["local"] > 0
+        assert metrics["tiers"]["pool"] == 0
+        assert metrics["hedge"] == {"fired": 0, "wins": 0,
+                                    "wasted_bytes": 0}
+        assert metrics["groups_detail"][0]["group"] == "params.tpu9w"
+        assert 0.0 <= metrics["overlap_frac"] <= 1.0
+        assert metrics["trace_id"] == root.trace_id
+
+        # traced intervals agree with the record's intervals (the bench
+        # cross-check, unit-sized): fetch span duration == fetch window
+        g = metrics["groups_detail"][0]
+        traced = cs.decompose_spans(spans)
+        want_fetch = g["fetch_iv"][1] - g["fetch_iv"][0]
+        assert cs.agreement(traced["fetch_s"], want_fetch) < 0.10
+
+        # Nth replica: pool hit → ONE device_put span, tier="pool"
+        with tracer.span("worker.cold_start",
+                         attrs={"workspace_id": "ws-1",
+                                "container_id": "ct-2"}) as root2:
+            assert await cm.restore(ckpt, str(tmp_path / "r2"))
+        spans2 = tracer.export(trace_id=root2.trace_id)
+        assert not _spans_by_name(spans2, cs.SPAN_FETCH)
+        put2 = _spans_by_name(spans2, cs.SPAN_DEVICE_PUT)
+        assert len(put2) == 1
+        assert put2[0]["attributes"]["tier"] == "pool"
+        assert cm.last_restore_metrics["tiers"]["pool"] > 0
+    finally:
+        await client.close()
+
+
+async def test_restore_params_span_tree_direct_to_device(tmp_path):
+    from tpu9.observability import coldstart as cs
+    from tpu9.observability.trace import tracer
+
+    cm, client = await _make_cm(tmp_path)
+    src = _write_src(tmp_path)
+    ckpt = await cm.create("stub", "ws", "c0", src)
+    try:
+        trees, metrics = await cm.restore_params(
+            ckpt, device_put=lambda e, a: a)
+        assert trees
+        spans = tracer.export(trace_id=metrics["trace_id"])
+        req = _spans_by_name(spans, cs.SPAN_REQUEST)
+        assert len(req) == 1
+        assert req[0]["attributes"]["mode"] == "direct_to_device"
+        put = _spans_by_name(spans, cs.SPAN_DEVICE_PUT)
+        assert put and put[0]["attributes"]["consumer"] == "device_put"
+    finally:
+        await client.close()
+
+
+async def test_get_stream_ledger_excludes_concurrent_traffic(tmp_path):
+    """Review regression (ISSUE 13): per-group tier/hedge evidence comes
+    from a per-call ledger, not a global-counter delta — a concurrent
+    caller (the classic materialize task) fetching through the same
+    client must not leak into the group's attribution."""
+    store = DiskStore(str(tmp_path))
+    client = CacheClient(store, peers=lambda: _aret([]))
+    stream_blobs = [bytes([i]) * 1000 for i in range(4)]
+    noise_blobs = [bytes([100 + i]) * 5000 for i in range(8)]
+    stream_d = [await store.put(b) for b in stream_blobs]
+    noise_d = [await store.put(b) for b in noise_blobs]
+
+    async def noise():
+        for d in noise_d:
+            assert await client.get(d) is not None
+
+    ledger: dict = {}
+
+    async def consume_stream():
+        agen = client.get_stream(stream_d, ledger=ledger)
+        try:
+            async for _d, data in agen:
+                assert data is not None
+                await asyncio.sleep(0.001)   # interleave with noise()
+        finally:
+            await agen.aclose()
+
+    await asyncio.gather(consume_stream(), noise())
+    assert ledger["bytes_local"] == sum(len(b) for b in stream_blobs)
+    assert ledger["local_hits"] == len(stream_blobs)
+    assert "bytes_peer" not in ledger and "hedged_reads" not in ledger
+    # the GLOBAL counters saw everything — that is exactly why the
+    # ledger exists
+    assert client.stats["bytes_local"] == \
+        sum(len(b) for b in stream_blobs + noise_blobs)
+    await client.close()

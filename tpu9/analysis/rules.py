@@ -20,7 +20,7 @@ Per-file rules (class ``FileChecker``):
 - **JAX002** jit recompile hazards: ``jax.jit(f)(x)`` immediately invoked
   (retraces every call) and ``jax.jit``/``pallas_call`` constructed inside
   a loop body instead of cached at module/object scope.
-- **OBS001** wall-clock arithmetic in serving/router/worker/runner/
+- **OBS001** wall-clock arithmetic in serving/router/worker/cache/runner/
   observability files: ``time.time()`` (directly, or a name/attribute
   assigned from it) used in +/-/comparison — i.e. as a duration or a
   deadline. Under an NTP step those go negative or fire early/late (the
@@ -62,6 +62,7 @@ JAX_RULES = ("JAX001", "JAX002")
 # that feed admission/routing/latency evidence. The gateway's paid-request
 # deadlines are store-persisted epochs (wall by design) and stay out.
 OBS_TIME_PATHS = ("tpu9/serving/", "tpu9/router/", "tpu9/worker/",
+                  "tpu9/cache/",
                   "tpu9/runner/", "tpu9/observability/")
 
 # ASY004: call names that block the event loop. Dotted names match exact

@@ -61,7 +61,18 @@ class CacheClient:
         # trip a hedge on every chunk and double cache traffic; only
         # stragglers relative to this client's own history do.
         self.hedge_delay_s = hedge_delay_s
+        # global EWMA is the COLD PRIOR only: the adaptive hedge delay for
+        # a peer we have exchanged with uses that peer's own history — one
+        # slow peer must not inflate the delay applied to fast peers
+        # (ISSUE 13 satellite; the global kept a fleet-wide average that
+        # did exactly that)
         self._peer_lat_ewma = 0.0
+        self._peer_lat: dict[str, float] = {}
+        # per-peer accounting surfaced by snapshot(): exchange counts,
+        # bytes, errors and a fixed log-scale latency histogram. Plain
+        # dict/list math only — the per-chunk hot path must not grow a
+        # registry dependency (the worker heartbeat publishes gauges).
+        self._peer_stats: dict[str, dict] = {}
         self._conns: dict[str, tuple[asyncio.StreamReader,
                                      asyncio.StreamWriter]] = {}
         self._conn_locks: dict[str, asyncio.Lock] = {}
@@ -70,7 +81,9 @@ class CacheClient:
         # mid-flight — the set keeps it alive and close() drains it
         self._bg_tasks: set[asyncio.Task] = set()
         self.stats = {"local_hits": 0, "peer_hits": 0, "source_fetches": 0,
-                      "peer_errors": 0, "hedged_reads": 0, "hedge_wins": 0}
+                      "peer_errors": 0, "hedged_reads": 0, "hedge_wins": 0,
+                      "hedge_wasted_bytes": 0, "bytes_local": 0,
+                      "bytes_peer": 0, "bytes_source": 0}
 
     def _spawn_bg(self, coro) -> asyncio.Task:
         task = asyncio.create_task(coro)
@@ -115,6 +128,7 @@ class CacheClient:
             except (OSError, asyncio.TimeoutError,
                     asyncio.IncompleteReadError) as exc:
                 self.stats["peer_errors"] += 1
+                self._peer_entry(peer)["errors"] += 1
                 self._drop_conn(peer)
                 log.debug("peer %s get failed: %s", peer, exc)
                 return None
@@ -171,6 +185,74 @@ class CacheClient:
         head = await wire.read_frame(reader)
         return bool(head.get("ok"))
 
+    # -- accounting ---------------------------------------------------------
+
+    # log-scale exchange-latency buckets (upper edges, seconds); the last
+    # bucket is the +Inf overflow — small enough to ship on every worker
+    # heartbeat, detailed enough to see a peer fall off a cliff
+    LAT_BUCKETS_S = (0.001, 0.005, 0.025, 0.1, 0.5, 2.0)
+
+    def _peer_entry(self, peer: str) -> dict:
+        entry = self._peer_stats.get(peer)
+        if entry is None:
+            entry = self._peer_stats[peer] = {
+                "exchanges": 0, "bytes": 0, "errors": 0, "total_s": 0.0,
+                "hist": [0] * (len(self.LAT_BUCKETS_S) + 1)}
+        return entry
+
+    def _note_exchange(self, peer: str, dt: float, nbytes: int) -> None:
+        """One verified peer exchange: per-peer EWMA + histogram + bytes.
+        This is the hook ``bench.py --phase obs`` prices (µs-scale dict
+        math per multi-MiB chunk)."""
+        prior = self._peer_lat.get(peer)
+        self._peer_lat[peer] = dt if prior is None \
+            else 0.2 * dt + 0.8 * prior
+        self._peer_lat_ewma = dt if self._peer_lat_ewma == 0.0 \
+            else 0.2 * dt + 0.8 * self._peer_lat_ewma
+        entry = self._peer_entry(peer)
+        entry["exchanges"] += 1
+        entry["bytes"] += nbytes
+        entry["total_s"] += dt
+        for i, edge in enumerate(self.LAT_BUCKETS_S):
+            if dt <= edge:
+                entry["hist"][i] += 1
+                break
+        else:
+            entry["hist"][-1] += 1
+
+    def _lat_estimate(self, peer: str) -> float:
+        """This peer's own EWMA, falling back to the global cold prior for
+        a peer we have never exchanged with."""
+        return self._peer_lat.get(peer) or self._peer_lat_ewma
+
+    @staticmethod
+    def _tally(ledger: Optional[dict], key: str, n: int = 1) -> None:
+        """Per-CALL accounting sink: ``get``/``get_stream`` callers that
+        need traffic attributed to THEM (the restore's per-group tier/
+        hedge evidence) pass a ledger dict — the global ``stats`` counters
+        are shared by every concurrent caller (a classic materialize
+        running beside a weight stream), so differencing them would
+        misattribute the neighbor's traffic."""
+        if ledger is not None:
+            ledger[key] = ledger.get(key, 0) + n
+
+    def snapshot(self) -> dict:
+        """Cache-plane evidence for the worker heartbeat → timeline /
+        /api/v1/metrics path: tier counters, hedge outcomes, per-peer
+        EWMAs/bytes/histograms (ISSUE 13)."""
+        peers = {}
+        for peer, entry in self._peer_stats.items():
+            peers[peer] = {
+                "lat_ewma_s": round(self._peer_lat.get(peer, 0.0), 6),
+                "mean_s": round(entry["total_s"] / entry["exchanges"], 6)
+                if entry["exchanges"] else 0.0,
+                "exchanges": entry["exchanges"], "bytes": entry["bytes"],
+                "errors": entry["errors"], "hist": list(entry["hist"])}
+        return {**self.stats,
+                "lat_ewma_global_s": round(self._peer_lat_ewma, 6),
+                "hist_buckets_s": list(self.LAT_BUCKETS_S),
+                "peers": peers}
+
     # -- public API ---------------------------------------------------------
 
     async def _peer_get_verified(self, peer: str,
@@ -181,14 +263,18 @@ class CacheClient:
         t0 = time.monotonic()
         data = await self._peer_get(peer, digest)
         if data is not None and chunk_hash(data) == digest:
-            dt = time.monotonic() - t0
-            self._peer_lat_ewma = dt if self._peer_lat_ewma == 0.0 \
-                else 0.2 * dt + 0.8 * self._peer_lat_ewma
+            self._note_exchange(peer, time.monotonic() - t0, len(data))
             return data
+        if data is not None:           # answered, but corrupt — count it
+            # in BOTH ledgers: the per-peer series and the worker-level
+            # peer_errors counter must not contradict each other
+            self.stats["peer_errors"] += 1
+            self._peer_entry(peer)["errors"] += 1
         return None
 
-    async def _hedged_peer_get(self, ordered: Sequence[str],
-                               digest: str) -> Optional[bytes]:
+    async def _hedged_peer_get(self, ordered: Sequence[str], digest: str,
+                               ledger: Optional[dict] = None
+                               ) -> Optional[bytes]:
         """Race the HRW-ordered peers for one chunk: peer *i+1* launches
         only after peer *i* has had ``hedge_delay_s`` to answer; the first
         verified result wins and every other in-flight try is cancelled
@@ -200,6 +286,7 @@ class CacheClient:
             # costs real throughput on the per-chunk hot path
             return await self._peer_get_verified(ordered[0], digest)
         tasks: list[asyncio.Task] = []
+        task_peer: dict[asyncio.Task, str] = {}
         winner: Optional[bytes] = None
         try:
             nxt = 0
@@ -210,25 +297,52 @@ class CacheClient:
                     task = asyncio.create_task(
                         self._peer_get_verified(ordered[nxt], digest))
                     tasks.append(task)
+                    task_peer[task] = ordered[nxt]
                     pending.add(task)
                     nxt += 1
+                # the head start adapts to the history of the PEER we are
+                # waiting on (best-ranked still pending — tasks is launch
+                # = rank order), not a global average: a slow peer
+                # elsewhere in the fleet must not delay hedging against
+                # THIS peer, and a known-slow primary earns a
+                # proportionally longer window before its hedge fires
+                waiting_on = next(
+                    (task_peer[t] for t in tasks if t in pending),
+                    ordered[0])
                 timeout = None if (nxt >= len(ordered)
                                    or self.hedge_delay_s < 0) \
-                    else max(self.hedge_delay_s, 2.0 * self._peer_lat_ewma)
+                    else max(self.hedge_delay_s,
+                             2.0 * self._lat_estimate(waiting_on))
                 done, pending = await asyncio.wait(
                     pending, timeout=timeout,
                     return_when=asyncio.FIRST_COMPLETED)
                 if not done and nxt < len(ordered):
                     self.stats["hedged_reads"] += 1   # launching a hedge
-                for task in done:
+                    self._tally(ledger, "hedged_reads")
+                # deterministic preference: the EARLIEST-ranked completed
+                # try wins a same-wakeup tie, so hedge_wins attribution is
+                # stable and a completed loser's bytes count as waste
+                for task in tasks:
+                    if task not in done:
+                        continue
                     try:
                         data = task.result()
                     except Exception:   # noqa: BLE001 — a lost racer only
                         data = None     # loses; the race itself survives
-                    if data is not None and winner is None:
+                    if data is None:
+                        continue
+                    if winner is None:
                         winner = data
                         if task is not tasks[0]:
                             self.stats["hedge_wins"] += 1
+                            self._tally(ledger, "hedge_wins")
+                    else:
+                        # a hedge that completed after the race was
+                        # decided moved real bytes for nothing — the
+                        # cost side of the hedging ledger
+                        self.stats["hedge_wasted_bytes"] += len(data)
+                        self._tally(ledger, "hedge_wasted_bytes",
+                                    len(data))
             return winner
         finally:
             for task in tasks:
@@ -236,18 +350,27 @@ class CacheClient:
                     task.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
 
-    async def get(self, digest: str) -> Optional[bytes]:
-        """local → hedged HRW peers → source (populating local + primary)."""
+    async def get(self, digest: str,
+                  ledger: Optional[dict] = None) -> Optional[bytes]:
+        """local → hedged HRW peers → source (populating local + primary).
+        ``ledger`` receives THIS call's tier/hedge accounting (see
+        :meth:`_tally`)."""
         data = await self.store.get(digest)
         if data is not None:
             self.stats["local_hits"] += 1
+            self.stats["bytes_local"] += len(data)
+            self._tally(ledger, "local_hits")
+            self._tally(ledger, "bytes_local", len(data))
             return data
 
         peers = [p for p in await self.peers() if p != self.self_address]
         ordered = hrw_order(digest, peers)[: max(self.replicas, 1) + 1]
-        data = await self._hedged_peer_get(ordered, digest)
+        data = await self._hedged_peer_get(ordered, digest, ledger=ledger)
         if data is not None:
             self.stats["peer_hits"] += 1
+            self.stats["bytes_peer"] += len(data)
+            self._tally(ledger, "peer_hits")
+            self._tally(ledger, "bytes_peer", len(data))
             await self.store.put(data, digest)
             return data
 
@@ -255,6 +378,9 @@ class CacheClient:
             data = await self.source(digest)
             if data is not None:
                 self.stats["source_fetches"] += 1
+                self.stats["bytes_source"] += len(data)
+                self._tally(ledger, "source_fetches")
+                self._tally(ledger, "bytes_source", len(data))
                 await self.store.put(data, digest)
                 # seed the canonical holder so the next reader hits a peer
                 ordered = hrw_order(digest, peers)
@@ -264,14 +390,21 @@ class CacheClient:
         return None
 
     async def get_stream(self, digests: Sequence[str],
-                         window: int = 8) -> AsyncIterator[
+                         window: int = 8,
+                         ledger: Optional[dict] = None) -> AsyncIterator[
                              tuple[str, Optional[bytes]]]:
         """Yield ``(digest, data)`` in the given (manifest) order through a
         read-ahead window — the streaming-restore feed: chunk *i+1* is in
         flight while the consumer deserializes chunk *i*. Duplicate digests
-        are served again (second fetch is a local-store hit)."""
+        are served again (second fetch is a local-store hit). ``ledger``
+        attributes exactly this stream's tier/hedge traffic to the caller
+        (the per-group restore evidence)."""
         from .prefetch import Prefetcher
-        pf = Prefetcher(self.get, list(dict.fromkeys(digests)),
+
+        async def fetch(digest: str) -> Optional[bytes]:
+            return await self.get(digest, ledger=ledger)
+
+        pf = Prefetcher(fetch, list(dict.fromkeys(digests)),
                         window=window)
         try:
             for digest in digests:

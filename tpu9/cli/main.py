@@ -667,6 +667,64 @@ def flight_cmd(stub_id: str, container_id: str, limit: int,
         click.echo(base)
 
 
+@cli.command("coldstart")
+@click.option("--stub-id", default="", help="filter one deployment")
+@click.option("--container-id", default="", help="pin one replica")
+@click.option("--json", "as_json", is_flag=True, help="raw records")
+def coldstart_cmd(stub_id: str, container_id: str, as_json: bool) -> None:
+    """Per-replica cold-start decomposition: plan→fetch→put→compile→ready
+    intervals, bytes by cache tier (pool/local/peer/source), hedge
+    outcomes, fetch∥put overlap — the scale-out evidence layer the
+    `--phase scaleout` bench will gate on (ISSUE 13)."""
+    q = []
+    if stub_id:
+        q.append(f"stub_id={stub_id}")
+    if container_id:
+        q.append(f"container_id={container_id}")
+    qs = ("?" + "&".join(q)) if q else ""
+    data = _client()._run(
+        lambda c: c.request("GET", f"/api/v1/coldstart{qs}"))
+    replicas = data.get("replicas", {})
+    if as_json:
+        click.echo(json.dumps(replicas, indent=2))
+        return
+    if not replicas:
+        click.echo("no coldstart records yet (restore a checkpointed "
+                   "replica, or wait a heartbeat)")
+        return
+    click.echo(f"{'replica':<16}{'plan':>8}{'fetch':>8}{'put':>8}"
+               f"{'compile':>9}{'warmup':>8}{'ready':>8}"
+               f"{'overlap':>8}  tier bytes / hedge")
+    for cid, rec in sorted(replicas.items()):
+        restore = rec.get("restore", {}) or {}
+        runner = rec.get("runner", {}) or {}
+
+        def _f(d, key):
+            try:
+                return float(d.get(key, 0.0) or 0.0)
+            except (TypeError, ValueError):
+                return 0.0
+        tiers = restore.get("tiers", {}) or {}
+        hedge = restore.get("hedge", {}) or {}
+        tier_txt = "/".join(f"{t}:{int(tiers.get(t, 0)) >> 10}K"
+                            for t in ("pool", "local", "peer", "source")
+                            if tiers.get(t))
+        hedge_txt = (f" hedge {int(hedge.get('wins', 0))}/"
+                     f"{int(hedge.get('fired', 0))}"
+                     f" waste {int(hedge.get('wasted_bytes', 0)) >> 10}K"
+                     if hedge.get("fired") else "")
+        click.echo(
+            f"{cid[:15]:<16}"
+            f"{_f(restore, 'plan_s') * 1000:>7.1f}ms"
+            f"{_f(restore, 'weight_stream_fetch_s') * 1000:>7.1f}ms"
+            f"{_f(restore, 'weight_stream_put_s') * 1000:>7.1f}ms"
+            f"{_f(runner, 'compile_ahead_s') * 1000:>8.1f}ms"
+            f"{_f(runner, 'warmup_s') * 1000:>7.1f}ms"
+            f"{_f(runner, 'ready_s') * 1000:>7.1f}ms"
+            f"{_f(restore, 'overlap_frac'):>8.2f}"
+            f"  {tier_txt}{hedge_txt}")
+
+
 @cli.command("profile")
 @click.argument("stub_id")
 @click.option("--windows", default=8, help="windows to profile")

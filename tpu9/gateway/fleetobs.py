@@ -43,6 +43,13 @@ ENGINE_SERIES = ("tokens_per_sec", "token_pressure", "queued",
                  "active_streams")
 # router snapshot fields mirrored into per-stub timeline series
 ROUTER_SERIES = ("queue_depth", "shed_rate", "pressure")
+# worker-heartbeated cache-plane counters mirrored 1:1 into per-worker
+# cache.* timeline series (ISSUE 13)
+CACHE_SERIES = ("local_hits", "peer_hits", "source_fetches", "peer_errors",
+                "hedged_reads", "hedge_wins", "hedge_wasted_bytes",
+                "bytes_local", "bytes_peer", "bytes_source")
+WEIGHTPOOL_SERIES = ("hits", "misses", "evictions", "rejected", "inserts",
+                     "entries", "bytes")
 
 
 def _num(d: dict, key: str, default: float = 0.0) -> float:
@@ -177,8 +184,47 @@ class FleetObserver:
                     submitted_total=float(snap.get("submitted", 0)),
                     shed_total=float(snap.get("shed", 0)),
                     queue_wait_total_s=qw_total)
+        await self.sample_cache_plane()
         self.goodput.publish(await self.goodput_snapshot())
         self.timeline.prune()
+
+    async def sample_cache_plane(self) -> None:
+        """Worker-heartbeated cache/weight-pool snapshots → per-worker
+        (and per-peer) timeline series (ISSUE 13): the restore and
+        weight-distribution plane's history — what the ROADMAP item-3
+        scale-out bench reads to see N replicas share one peer tree."""
+        import json
+        for key in await self.store.keys("worker:cache:*"):
+            raw = await self.store.get(key)
+            if not raw:
+                continue
+            try:
+                snap = json.loads(raw)
+            except (ValueError, TypeError):
+                continue
+            wid = key.rsplit(":", 1)[-1]
+            cache = snap.get("cache") or {}
+            prefix = f"cache.{wid}."
+            for name in CACHE_SERIES:
+                if name in cache:
+                    self.timeline.record(prefix + name, _num(cache, name))
+            for tier in ("local", "peer", "source"):
+                rate = f"{tier}_bytes_per_s"
+                if rate in snap:
+                    self.timeline.record(prefix + rate, _num(snap, rate))
+            # per-peer latency/bytes: bounded by fleet size, the evidence
+            # hedging decisions and KV-shipping (ROADMAP item 2) read
+            for peer, ps in (cache.get("peers") or {}).items():
+                ppre = f"cache.{wid}.peer.{peer}."
+                self.timeline.record(ppre + "lat_ewma_s",
+                                     _num(ps, "lat_ewma_s"))
+                self.timeline.record(ppre + "bytes", _num(ps, "bytes"))
+                self.timeline.record(ppre + "errors", _num(ps, "errors"))
+            pool = snap.get("weightpool") or {}
+            for name in WEIGHTPOOL_SERIES:
+                if name in pool:
+                    self.timeline.record(f"weightpool.{wid}.{name}",
+                                         _num(pool, name))
 
     # -- engines-section aging (ISSUE 12 satellite) --------------------------
 

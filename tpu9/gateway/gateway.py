@@ -336,6 +336,7 @@ class Gateway:
         r.add_get("/api/v1/timeline", self._timeline)
         r.add_get("/api/v1/slo", self._slo)
         r.add_get("/api/v1/traces", self._traces)
+        r.add_get("/api/v1/coldstart", self._coldstart)
         # engine flight recorder + on-demand TPU profiling (ISSUE 8)
         r.add_get("/api/v1/flight", self._flight)
         r.add_post("/api/v1/profile", self._profile)
@@ -665,6 +666,67 @@ class Gateway:
         spans.sort(key=lambda s: s.get("startTimeUnixNano", 0))
         return web.json_response({"spans": spans[:limit]})
 
+    async def _coldstart(self, request: web.Request) -> web.Response:
+        """Per-replica cold-start decomposition records (ISSUE 13):
+        worker-half restore records (coldstart:<container_id> keys shipped
+        on the worker heartbeat — plan/fetch/put intervals, bytes by cache
+        tier, hedge outcomes) merged with the runner-half coldstart_*
+        pressure extras (load/compile_ahead/bind/warmup/ready). Workspace-
+        scoped like /api/v1/traces; ?container_id= pins one replica,
+        ?stub_id= filters a deployment. This record is the artifact the
+        ROADMAP item-3 `--phase scaleout` bench gates on."""
+        ws = self._ws(request)
+        operator = self._is_operator(request)
+        want_cid = request.query.get("container_id", "")
+        want_stub = request.query.get("stub_id", "")
+        from ..observability.coldstart import merge_record
+        # both key families are suffixed by container id — a pinned query
+        # reads exactly two keys instead of scanning the fleet
+        pressure_keys = [f"llm:pressure:{want_cid}"] if want_cid \
+            else await self.store.keys("llm:pressure:*")
+        coldstart_keys = [f"coldstart:{want_cid}"] if want_cid \
+            else await self.store.keys("coldstart:*")
+        # runner halves, keyed by container: the same pressure hashes
+        # /api/v1/metrics "engines" reads
+        runner_halves: dict[str, dict] = {}
+        for key in pressure_keys:
+            snap = await self.store.hgetall(key)
+            if snap:
+                runner_halves[key.rsplit(":", 1)[-1]] = snap
+        replicas: dict[str, dict] = {}
+        for key in coldstart_keys:
+            raw = await self.store.get(key)
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except (ValueError, TypeError):
+                continue
+            cid = rec.get("container_id", key.rsplit(":", 1)[-1])
+            replicas[cid] = rec
+        # runner-only replicas (no streamed restore — cold boot or warm
+        # pool on a fresh node) still get a record from their heartbeat
+        for cid in runner_halves:
+            replicas.setdefault(cid, {"container_id": cid})
+        out: dict[str, dict] = {}
+        for cid, rec in replicas.items():
+            if want_cid and cid != want_cid:
+                continue
+            if not rec.get("workspace_id"):
+                # stamp identity from the authoritative container state —
+                # never trust (or serve) an unattributed record across
+                # tenants (same invariant as _ingest_runner_spans)
+                state = await self.containers.get_state(cid)
+                if state is not None:
+                    rec.setdefault("workspace_id", state.workspace_id)
+                    rec.setdefault("stub_id", state.stub_id)
+            if want_stub and rec.get("stub_id", "") != want_stub:
+                continue
+            if not operator and rec.get("workspace_id") != ws.workspace_id:
+                continue
+            out[cid] = merge_record(rec, runner_halves.get(cid))
+        return web.json_response({"replicas": out})
+
     async def _flight(self, request: web.Request) -> web.Response:
         """Engine flight-recorder tail for one LLM deployment (ISSUE 8):
         proxies the runner's /flight RPC through the request buffer
@@ -715,6 +777,17 @@ class Gateway:
             raw = await self.store.get(key)
             if raw:
                 out["workers"][key.rsplit(":", 1)[-1]] = json.loads(raw)
+        # cache-plane snapshots (ISSUE 13): per-worker tier/hedge/per-peer
+        # evidence + warm weights pool occupancy, heartbeated by workers —
+        # the restore/weight-distribution side of the fleet view
+        out["cache"] = {}
+        for key in await self.store.keys("worker:cache:*"):
+            raw = await self.store.get(key)
+            if raw:
+                try:
+                    out["cache"][key.rsplit(":", 1)[-1]] = json.loads(raw)
+                except (ValueError, TypeError):
+                    continue
         # per-engine serving stats (ISSUE 2 satellite): queue depth, active
         # streams, KV headroom, prefix hit rate — heartbeated by runners
         # into the pressure table, readable here without SSHing a node

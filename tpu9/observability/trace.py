@@ -152,9 +152,38 @@ class Tracer:
         self.finished.append(sp)
         return sp
 
+    def record_window(self, name: str, wall_anchor: float,
+                      anchor_mono: float, first_mono: Optional[float],
+                      last_mono: Optional[float], trace_id: str = "",
+                      parent_id: str = "",
+                      attrs: Optional[dict] = None) -> Optional[Span]:
+        """Record a sub-interval measured as a monotonic window against ONE
+        wall anchor pair (the restore pipeline's fetch/consume windows —
+        ISSUE 13). The child's wall start is the anchor shifted by the
+        monotonic offset, so siblings recorded off the same anchor line up
+        gaplessly even across an NTP step. No-op (None) when the window
+        never opened."""
+        if first_mono is None or last_mono is None:
+            return None
+        start_wall = wall_anchor + (first_mono - anchor_mono)  # tpu9: noqa[OBS001] the sanctioned anchor pattern: one wall anchor + monotonic offsets (never wall-minus-wall)
+        return self.record_span(name, trace_id=trace_id,
+                                parent_id=parent_id, start=start_wall,
+                                start_mono=first_mono, attrs=attrs,
+                                end_mono=last_mono)
+
     def current_trace_id(self) -> str:
         sp = _current_span.get()
         return sp.trace_id if sp else ""
+
+    def inherited_attrs(self, *keys: str) -> dict:
+        """Copies of selected attrs from the context's current span —
+        identity stamps (workspace/container ids) a child span must carry
+        itself, because ``/api/v1/traces`` scopes visibility per SPAN, not
+        per tree."""
+        sp = _current_span.get()
+        if sp is None:
+            return {}
+        return {k: sp.attrs[k] for k in keys if k in sp.attrs}
 
     def context(self) -> tuple[str, str]:
         """(trace_id, span_id) of the context's current span, or ("", "")

@@ -123,8 +123,16 @@ def load_params(name: str = "params", template: Any = None,
     saved tree structure exactly (tuples included) and ignores it."""
     path = _weights_path(name)
     if os.path.isdir(path):
+        from ..observability import coldstart as _cs
+        from ..observability.trace import tracer
         from ..serving import weights as wfmt
-        return wfmt.load_params(path, mmap=mmap)
+        # restore.load (ISSUE 13): the runner-side host load of the
+        # worker-spilled shards — inherits the runner.bringup parent via
+        # the contextvar, so the bring-up trace stays gapless
+        with tracer.span(_cs.SPAN_LOAD,
+                         attrs={"name": name, "source": "tpu9w",
+                                "mmap": mmap}):
+            return wfmt.load_params(path, mmap=mmap)
     import orbax.checkpoint as ocp
     legacy = os.path.join(ckpt_dir(), name)
     ckptr = ocp.PyTreeCheckpointer()

@@ -209,13 +209,35 @@ async def amain() -> None:
                       cfg.port).start()
 
     # build the engine off the loop (model init / weight load can be slow)
+    # — under one runner.bringup span carrying the container's minted
+    # trace id (ISSUE 13), so the handler's restore.load, load_engine's
+    # compile_ahead/bind and the warmup below merge with the worker's
+    # restore.request tree into ONE bring-up trace at /api/v1/traces
+    # (spans ship on the pressure heartbeat; the gateway stamps tenancy)
+    import time as _time
+
+    from ..observability.trace import tracer
+    from ..observability import coldstart as _cs
     handler = FunctionHandler(cfg)
-    result = await handler.call()
-    engine = _build_engine(result)
-    # compile every serving graph BEFORE readiness: the first user request
-    # must never pay a multi-second XLA compile (readiness == serveable)
-    timings = await asyncio.get_event_loop().run_in_executor(
-        None, engine.warmup)
+    t_bring = _time.monotonic()
+    with tracer.span(_cs.SPAN_BRINGUP,
+                     trace_id=os.environ.get("TPU9_TRACE_ID", ""),
+                     attrs={"container_id": cfg.container_id,
+                            "restored":
+                            os.environ.get("TPU9_RESTORED", "0")}):
+        result = await handler.call()
+        engine = _build_engine(result)
+        # handler wall INCLUDES the engine build (load_engine's weight
+        # materialization + overlapped precompile live inside it);
+        # warmup_s below is only the pre-readiness graph warmup
+        t_load_done = _time.monotonic()
+        # compile every serving graph BEFORE readiness: the first user
+        # request must never pay a multi-second XLA compile (readiness ==
+        # serveable)
+        with tracer.span(_cs.SPAN_WARMUP):
+            timings = await asyncio.get_event_loop().run_in_executor(
+                None, engine.warmup)
+        t_warm_done = _time.monotonic()
     ahead = getattr(engine, "compile_ahead_timings", None)
     if ahead:
         log.info("compile-ahead (overlapped with weight load): %s",
@@ -225,6 +247,15 @@ async def amain() -> None:
     await engine.start()
     state["engine"] = engine
     state["ready"] = True
+    # runner-half coldstart record fields (the worker half rides the
+    # coldstart:<cid> store key): handler wall covers restore.load +
+    # load_engine; ready_s is the whole bring-up to serveable
+    bringup = dict(getattr(engine, "bringup", None) or {})
+    bringup["handler_s"] = round(t_load_done - t_bring, 4)
+    bringup["warmup_s"] = round(t_warm_done - t_load_done, 4)
+    bringup["ready_s"] = round(_time.monotonic() - t_bring, 4)
+    bringup["restored"] = int(os.environ.get("TPU9_RESTORED", "0") == "1")
+    engine.bringup = bringup
     if os.environ.get("TPU9_CHECKPOINT_ENABLED") == "1":
         from . import ckpt
         ckpt.mark_ready({"handler": cfg.handler})
@@ -295,6 +326,12 @@ async def amain() -> None:
                         extra["prefix_misses"] = misses
                         extra["prefix_hit_rate"] = (
                             hits / (hits + misses) if hits + misses else 0.0)
+                    # cold-start decomposition (ISSUE 13): the runner half
+                    # of the per-replica readiness record — flat
+                    # coldstart_* scalars merged by /api/v1/coldstart
+                    for k, v in stats.items():
+                        if k.startswith("coldstart_"):
+                            extra[k] = v
                     # latency decomposition (ISSUE 8): per-phase p50/p95
                     # flat scalars → /api/v1/metrics "engines" section
                     for k, v in (stats.get("latency") or {}).items():

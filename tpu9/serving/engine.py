@@ -333,6 +333,11 @@ class InferenceEngine:
         # ---- observability (ISSUE 8) ----
         # flight recorder: bounded per-window ring (None = disabled)
         self.flight = flight_maybe(engine_cfg.flight_cap)
+        # bring-up decomposition (ISSUE 13): load/compile_ahead/bind
+        # seconds set by presets.load_engine, warmup_s by the runner —
+        # stats() forwards them flat so the heartbeat can ship them into
+        # the per-replica coldstart record
+        self.bringup: dict = {}
         # per-ENGINE latency registry (TTFT/TBT/queue-wait/prefill/decode
         # windows): its summaries ride stats() → the runner's pressure
         # heartbeat → /api/v1/metrics "engines". A process-global registry
@@ -763,6 +768,12 @@ class InferenceEngine:
                           "active": self._profile_active,
                           "path": self._profile_path,
                           "error": self._profile_error}
+        # cold-start decomposition (ISSUE 13): flat coldstart_* scalars so
+        # the runner heartbeat forwards them into the pressure hash that
+        # backs /api/v1/metrics "engines" and /api/v1/coldstart unchanged
+        for k, v in self.bringup.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"coldstart_{k}"] = v
         lat = {}
         summaries = self.metrics.to_dict()["summaries"]
         for phase in ("ttft", "tbt", "queue_wait", "prefill",

@@ -89,6 +89,11 @@ class ContainerLifecycle:
         # (a CacheFS-backed bundle read can fault through this very loop)
         self._env_meta: dict[str, dict] = {}
         self.checkpoints = checkpoints   # Optional[CheckpointManager]
+        # per-container cold-start restore records (ISSUE 13): the worker
+        # heartbeat ships these to coldstart:<container_id> store keys,
+        # where /api/v1/coldstart merges them with the runner half.
+        # Bounded: shipped entries are popped by the heartbeat.
+        self.coldstart_records: dict[str, dict] = {}
         self.phase_cb = phase_cb
         self._active: dict[str, asyncio.Task] = {}
         self._exited: dict[str, int] = {}
@@ -363,12 +368,43 @@ class ContainerLifecycle:
         for cid, ts in list(self._stop_requested.items()):
             if now - ts > 600.0:
                 del self._stop_requested[cid]
+        delivered = await self.runtime.kill(container_id, 15)
+        if not delivered and container_id not in self._active \
+                and container_id not in self.requests:
+            # the container already exited (or never existed here): its
+            # supervisor has run — or never will. Writing STOPPING now
+            # would RESURRECT a terminal state row back into the stub
+            # index (update_state re-hsets it; only a terminal write
+            # removes it), and with no supervisor left to terminalize it
+            # the phantom survives every TTL refresh a retrying stop loop
+            # grants it — scale-downs then spin on a container that is
+            # already gone. Kill-first ordering keeps the user-visible
+            # STOPPING status for every genuinely delivered stop.
+            self._pending_reasons.pop(container_id, None)
+            return False
         state = await self.containers.get_state(container_id)
-        if state:
+        if state and state.status not in (ContainerStatus.STOPPED.value,
+                                          ContainerStatus.FAILED.value):
             state.status = ContainerStatus.STOPPING.value
             state.stop_reason = reason
             await self.containers.update_state(state)
-        return await self.runtime.kill(container_id, 15)
+            if container_id in self._exited \
+                    and container_id not in self._active:
+                # TOCTOU repair: a trap-and-exit-fast container can have
+                # its supervisor finish ENTIRELY between our get_state and
+                # the STOPPING write above — then ours was the last write
+                # and just resurrected the row. Both paths run on this
+                # worker's loop, so "exited recorded + supervisor gone"
+                # here proves the terminal write already happened; while
+                # the supervisor is still in _active its terminal write is
+                # still coming and will overwrite ours. Re-assert terminal
+                # state (idempotent with the supervisor's).
+                code = self._exited[container_id]
+                state.status = (ContainerStatus.STOPPED.value if code == 0
+                                else ContainerStatus.FAILED.value)
+                state.exit_code = code   # keep the supervisor's record
+                await self.containers.update_state(state)
+        return delivered
 
     def active_ids(self) -> list[str]:
         return list(self._active.keys())
@@ -437,12 +473,27 @@ class ContainerLifecycle:
         os.makedirs(base, exist_ok=True)
         restored = False
         if request.checkpoint_id and self.checkpoints is not None:
-            restored = await self.checkpoints.restore(request.checkpoint_id,
-                                                      base)
+            # per-container metrics sink: the manager (and its
+            # last_restore_metrics) is shared by every concurrently
+            # starting container on this worker
+            restore_metrics: dict = {}
+            restored = await self.checkpoints.restore(
+                request.checkpoint_id, base, metrics_out=restore_metrics)
             if restored:
                 self._phase(request.container_id,
                             LifecyclePhase.CHECKPOINT_RESTORED,
                             time.monotonic())
+                # worker half of the replica's coldstart record (ISSUE
+                # 13): restore decomposition + identity; the heartbeat
+                # ships it, /api/v1/coldstart merges the runner half
+                self.coldstart_records[request.container_id] = {
+                    "container_id": request.container_id,
+                    "stub_id": request.stub_id,
+                    "workspace_id": request.workspace_id,
+                    "worker_id": self.worker_id,
+                    "checkpoint_id": request.checkpoint_id,
+                    "ts": time.time(),
+                    "restore": restore_metrics}
         if not restored and request.object_id and self.object_resolver:
             archive = await self.object_resolver(request.object_id)
             if archive and os.path.exists(archive):

@@ -47,28 +47,54 @@ async def stream_shards(
     - ``fetch_s``: time spent awaiting the chunk stream
     - ``put_s``: time spent *blocked* on the consumer stage (overlapped
       consumer work costs nothing here — that's the point)
+    - ``consume_s``: total consumer work (in-thread), overlapped or not
     - ``wall_s`` / ``bytes``: totals
+    - interval anchors (ISSUE 13): ``wall_anchor`` (one wall stamp at
+      stream start) plus monotonic pairs ``start_mono``/``end_mono``,
+      ``fetch_{first,last}_mono`` (first chunk await → last chunk landed)
+      and ``put_{first,last}_mono`` (first consume start → last consume
+      end) — the raw material for the ``restore.fetch``/
+      ``restore.device_put`` spans, whose overlap is the pipeline's
+      efficiency evidence. All duration math stays monotonic; the wall
+      stamp is an anchor only (OBS001 discipline).
     """
     # lazy import: tpu9.serving's package init pulls the engine (and jax)
     # — the worker's import path must stay light until weights actually
     # stream
     from ..serving import weights as wfmt
     consume = consume or default_device_put
-    t_wall = time.perf_counter()
+    wall_anchor = time.time()
+    t_wall = time.monotonic()
     fetch_s = 0.0
     put_s = 0.0
     total = 0
+    # [first_mono, last_mono] windows; only ONE consume runs at a time
+    # (double buffering settles i-1 before launching i), so the plain
+    # list mutated from the worker thread is race-free
+    fetch_win: list = [None, None]
+    put_win: list = [None, None]
+    consume_s = [0.0]
     results: list = [None] * len(entries)
     pending: Optional[asyncio.Task] = None
     pending_i = -1
+
+    def timed_consume(entry: dict, arr: np.ndarray) -> Any:
+        t0 = time.monotonic()
+        if put_win[0] is None:
+            put_win[0] = t0
+        try:
+            return consume(entry, arr)
+        finally:
+            put_win[1] = time.monotonic()
+            consume_s[0] += put_win[1] - t0
 
     async def settle() -> None:
         nonlocal pending, pending_i, put_s
         if pending is None:
             return
-        t0 = time.perf_counter()
+        t0 = time.monotonic()
         results[pending_i] = await pending
-        put_s += time.perf_counter() - t0
+        put_s += time.monotonic() - t0
         pending = None
 
     try:
@@ -77,7 +103,9 @@ async def stream_shards(
             buf = bytearray(need)
             fill = 0
             while fill < need:
-                t0 = time.perf_counter()
+                t0 = time.monotonic()
+                if fetch_win[0] is None:
+                    fetch_win[0] = t0
                 try:
                     digest, data = await chunks.__anext__()
                 except StopAsyncIteration:
@@ -85,7 +113,8 @@ async def stream_shards(
                         f"weight stream ended early: shard {entry['file']} "
                         f"has {fill}/{need} bytes") from None
                 finally:
-                    fetch_s += time.perf_counter() - t0
+                    fetch_win[1] = time.monotonic()
+                    fetch_s += fetch_win[1] - t0
                 if data is None:
                     raise IOError(f"missing chunk {digest} for shard "
                                   f"{entry['file']}")
@@ -102,14 +131,22 @@ async def stream_shards(
             await settle()
             pending_i = i
             pending = asyncio.create_task(
-                asyncio.to_thread(consume, entry, arr))
+                asyncio.to_thread(timed_consume, entry, arr))
         await settle()
     except BaseException:
         if pending is not None:
             pending.cancel()
             await asyncio.gather(pending, return_exceptions=True)
         raise
+    end_mono = time.monotonic()
     return results, {"fetch_s": round(fetch_s, 4),
                      "put_s": round(put_s, 4),
-                     "wall_s": round(time.perf_counter() - t_wall, 4),
-                     "bytes": total, "shards": len(entries)}
+                     "consume_s": round(consume_s[0], 4),
+                     "wall_s": round(end_mono - t_wall, 4),
+                     "bytes": total, "shards": len(entries),
+                     "wall_anchor": wall_anchor,
+                     "start_mono": t_wall, "end_mono": end_mono,
+                     "fetch_first_mono": fetch_win[0],
+                     "fetch_last_mono": fetch_win[1],
+                     "put_first_mono": put_win[0],
+                     "put_last_mono": put_win[1]}

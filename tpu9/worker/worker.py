@@ -73,6 +73,11 @@ class Worker:
         self.tpu = TpuDeviceManager(generation=tpu_generation)
         self.runtime = runtime
         self.cache = cache          # Optional[WorkerCache]
+        self.checkpoints = checkpoints   # Optional[CheckpointManager]
+        # cache-plane bandwidth gauges: previous beat's cumulative tier
+        # byte counters, differenced per heartbeat (ISSUE 13)
+        self._cache_bytes_prev: dict[str, int] = {}
+        self._cache_bytes_prev_mono = 0.0
         if phase_cb is None:
             phase_cb = self._default_phase_cb
         if image_resolver is None and cache is not None:
@@ -252,6 +257,13 @@ class Worker:
         metrics.set_gauge("tpu9_worker_active_containers",
                           len(self.lifecycle.active_ids()),
                           {"worker": self.worker_id})
+        # cache-plane gauges BEFORE the registry ships below — setting
+        # them after would leave the fleet-visible tpu9_cache_* values
+        # one heartbeat stale forever (and absent on the first beat)
+        try:
+            await self._ship_cache_plane(metrics)
+        except Exception as exc:   # keepalive must survive hiccups
+            log.debug("cache-plane ship failed: %s", exc)
         # ship this process's registry to the state bus so the gateway's
         # /api/v1/metrics shows the whole fleet (VictoriaMetrics-push
         # equivalent, pkg/metrics/metrics.go:29)
@@ -263,6 +275,56 @@ class Worker:
             await self._ship_usage_and_traces()
         except Exception as exc:   # keepalive must survive hiccups
             log.debug("usage/trace ship failed: %s", exc)
+
+    async def _ship_cache_plane(self, metrics) -> None:
+        """Cache/weight-pool evidence → worker:cache:<id> (the gateway's
+        FleetObserver folds it into the cache.*/weightpool.* timeline
+        series and /api/v1/metrics "cache"), tier-bandwidth gauges into
+        the registry, and per-container coldstart records →
+        coldstart:<container_id> for /api/v1/coldstart (ISSUE 13)."""
+        import json as _json
+        snap: dict = {"ts": time.time(), "worker_id": self.worker_id}
+        if self.cache is not None:
+            cstats = self.cache.client.snapshot()
+            snap["cache"] = cstats
+            now = time.monotonic()
+            dt = now - self._cache_bytes_prev_mono
+            if self._cache_bytes_prev_mono and dt > 0:
+                for tier in ("local", "peer", "source"):
+                    cur = int(cstats.get(f"bytes_{tier}", 0))
+                    rate = max(cur - self._cache_bytes_prev.get(tier, 0),
+                               0) / dt
+                    snap[f"{tier}_bytes_per_s"] = round(rate, 1)
+                    metrics.set_gauge("tpu9_cache_bytes_per_s", rate,
+                                      {"worker": self.worker_id,
+                                       "tier": tier})
+            self._cache_bytes_prev = {
+                t: int(cstats.get(f"bytes_{t}", 0))
+                for t in ("local", "peer", "source")}
+            self._cache_bytes_prev_mono = now
+            for key in ("local_hits", "peer_hits", "source_fetches",
+                        "peer_errors", "hedged_reads", "hedge_wins",
+                        "hedge_wasted_bytes"):
+                metrics.set_gauge(f"tpu9_cache_{key}",
+                                  int(cstats.get(key, 0)),
+                                  {"worker": self.worker_id})
+        pool = getattr(self.checkpoints, "weight_pool", None)
+        if pool is not None:
+            psnap = pool.snapshot()
+            snap["weightpool"] = psnap
+            for key in ("hits", "misses", "evictions", "entries", "bytes"):
+                metrics.set_gauge(f"tpu9_weightpool_{key}",
+                                  int(psnap.get(key, 0)),
+                                  {"worker": self.worker_id})
+        if "cache" in snap or "weightpool" in snap:
+            await self.store.set(f"worker:cache:{self.worker_id}",
+                                 _json.dumps(snap),
+                                 ttl=self.cfg.keepalive_ttl_s * 2)
+        # ship-then-pop: a store blip re-ships the record next beat
+        for cid, rec in list(self.lifecycle.coldstart_records.items()):
+            await self.store.set(f"coldstart:{cid}", _json.dumps(rec),
+                                 ttl=3600.0)
+            self.lifecycle.coldstart_records.pop(cid, None)
 
     async def _ship_usage_and_traces(self) -> None:
         """Fold this beat's container/chip seconds into the hot usage
