@@ -1902,6 +1902,25 @@ def bench_obs(quick: bool = False) -> dict:
         return (_min_time_us(one_record, iters, reps),
                 _min_time_us(one_eval, iters, reps))
 
+    def microbench_health(eng) -> tuple[float, float]:
+        """(per-watchdog-assess, per-HBM-sample) cost in µs (ISSUE 14):
+        the watchdog classifies one stats dict per runner beat; the HBM
+        watermark is one ``memory_stats()`` sweep over the submesh on the
+        stats() read path — both heartbeat-cadence, never per token."""
+        from tpu9.observability.health import EngineWatchdog
+        iters, reps = (400, 3) if quick else (1500, 5)
+        wd = EngineWatchdog()
+        stats = eng.stats()       # the real scalar surface, frozen
+
+        def one_assess():
+            wd.assess(stats)
+
+        def one_hbm():
+            eng.policy.hbm_used_gb_per_chip()
+
+        return (_min_time_us(one_assess, iters, reps),
+                _min_time_us(one_hbm, iters, reps))
+
     def microbench_cache() -> tuple[float, float]:
         """(per-chunk exchange-accounting, per-heartbeat snapshot) cost in
         µs for the cache-plane hooks (ISSUE 13): ``_note_exchange`` runs
@@ -2028,9 +2047,19 @@ def bench_obs(quick: bool = False) -> dict:
         # the RESTORE path, not the serve loop — priced against its own
         # budget below, not folded into serve-time overhead
         account_us, snap_us = microbench_cache()
+        # replica health plane (ISSUE 14): one watchdog assess + one HBM
+        # memory_stats() sweep per runner beat (2 s), plus the health
+        # timeline/gauge records the gateway adds per beat (priced at
+        # the timeline record cost already measured above)
+        assess_us, hbm_us = microbench_health(on)
+        health_records = 8     # hbm_*/liveness/health series per beat
         sampler_frac = (rec_us * records_ps + eval_us * evals_ps
-                        + snap_us / 5.0) / 1e6
+                        + snap_us / 5.0
+                        + (assess_us + hbm_us
+                           + rec_us * health_records) / 2.0) / 1e6
         frac += sampler_frac
+        res["obs_health_assess_us"] = round(assess_us, 3)
+        res["obs_hbm_sample_us"] = round(hbm_us, 3)
         res["obs_timeline_record_us"] = round(rec_us, 3)
         res["obs_slo_eval_us"] = round(eval_us, 2)
         res["obs_cache_account_us"] = round(account_us, 3)
@@ -2591,7 +2620,11 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
                      "obs_tokens_per_sec_off",
                      "obs_decomposition_coverage",
                      "obs_overhead_frac", "obs_instr_window_us",
-                     "obs_instr_request_us", "obs_windows_per_sec")),
+                     "obs_instr_request_us", "obs_windows_per_sec",
+                     # replica health plane (ISSUE 14): watchdog tick +
+                     # HBM sampler, priced microbench×rate like every
+                     # other hook inside the same ≤2% budget
+                     "obs_health_assess_us", "obs_hbm_sample_us")),
             ("coldstart", ("cold_start_p50_s",)),
             ("coldstart_native", ("cold_start_native_p50_s",
                                   "cold_start_native_pull_p50_s")),

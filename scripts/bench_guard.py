@@ -75,6 +75,10 @@ GUARDED_FIELDS = {
     # ±10-15% (the phase floors it) and coverage's goodness is "≈1", not
     # monotonic; the phase gates both.
     "obs_overhead_frac": "down",
+    # (ISSUE 14: the watchdog assess + HBM memory_stats() sweep fold
+    # into obs_overhead_frac above via the obs phase's microbench×rate
+    # pricing; their raw µs fields ride the round unguarded like the
+    # other per-hook prices — host-to-host µs noise is not a regression)
     # cold-start decomposition (ISSUE 13): the fetch∥consume overlap of
     # the streamed restore must not collapse back toward serial (the
     # double-buffering win the coldstart report exists to evidence). The

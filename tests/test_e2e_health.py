@@ -1,0 +1,254 @@
+"""E2E: replica health plane (ISSUE 14 acceptance) — an induced serve-loop
+stall on one of two replicas flips its heartbeated health to `stalled`
+within the beat budget, the router stops dispatching to it (measured
+dispatch counts), a post-mortem black box with flight windows + HBM
+breakdown is retrievable at /api/v1/postmortem, and recovery restores
+routing. An induced engine crash during generation also leaves a
+post-mortem record.
+
+The stall is a real gray failure: the engine's serve loop spins without
+progress while the RUNNER keeps heartbeating — exactly the case the
+fleet's staleness aging can never catch."""
+
+import asyncio
+import os
+import time
+
+import aiohttp
+import pytest
+
+from tpu9.testing.localstack import LocalStack
+
+pytestmark = pytest.mark.e2e
+
+# FaultyEngine: dispatch spins (stall) or raises (crash) while a per-
+# replica flag file exists — the serve LOOP wedges, the event loop (and
+# so the pressure heartbeat) stays alive: a gray failure on demand.
+FAULTY_APP = """
+import os, time
+
+def load_engine():
+    from dataclasses import replace
+    import jax
+    from tpu9.models import init_decoder
+    from tpu9.models.llama import LLAMA_PRESETS
+    from tpu9.serving import EngineConfig, InferenceEngine
+
+    flag_dir = os.environ.get("TPU9_TEST_FLAG_DIR", "")
+    cid = os.environ.get("TPU9_CONTAINER_ID", "")
+
+    class FaultyEngine(InferenceEngine):
+        def _dispatch_window(self):
+            if flag_dir and os.path.exists(
+                    os.path.join(flag_dir, "crash-" + cid)):
+                raise RuntimeError("induced crash for postmortem test")
+            if flag_dir and os.path.exists(
+                    os.path.join(flag_dir, "stall-" + cid)):
+                time.sleep(0.05)   # cheap spin; the loop's sleep(0) still
+                return None        # yields, so heartbeats keep flowing
+            return super()._dispatch_window()
+
+    cfg = replace(LLAMA_PRESETS["llama-tiny"])
+    params = init_decoder(jax.random.PRNGKey(0), cfg)
+    return FaultyEngine(params, cfg,
+                        EngineConfig(max_batch=2, max_seq_len=256,
+                                     prefill_buckets=(16, 64),
+                                     kv_block_size=16))
+"""
+
+
+async def _engine_stats(stack, cid: str) -> dict:
+    return await stack.gateway.store.hgetall(f"llm:pressure:{cid}") or {}
+
+
+async def _wait_health(stack, cid: str, want: str, timeout: float = 25.0):
+    deadline = time.monotonic() + timeout
+    last = {}
+    while time.monotonic() < deadline:
+        last = await _engine_stats(stack, cid)
+        if str(last.get("health", "")) == want:
+            return last
+        await asyncio.sleep(0.2)
+    raise AssertionError(
+        f"replica {cid} never reported health={want}; last beat: "
+        f"{ {k: last.get(k) for k in ('health', 'health_reason', 'queued', 'active_streams', 'last_progress_age_s')} }")
+
+
+async def _direct_generate(address: str, max_new: int, timeout: float):
+    """POST straight to one replica's runner (bypassing the router) —
+    how the test pins work onto the victim."""
+    async with aiohttp.ClientSession() as sess:
+        async with sess.post(
+                f"http://{address}/",
+                json={"tokens": [3, 1, 4, 1, 5], "max_new_tokens": max_new},
+                timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
+            return resp.status, await resp.json()
+
+
+async def test_stall_flips_health_ejects_replica_and_recovers(tmp_path):
+    flag_dir = str(tmp_path)
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint(
+            "healthllm", {"app.py": FAULTY_APP}, "app:load_engine",
+            config_extra={
+                "timeout_s": 240.0,
+                "concurrent_requests": 2,
+                "extra": {"runner": "llm"},
+                "env": {"TPU9_TEST_FLAG_DIR": flag_dir,
+                        # tight beat budget so the e2e stays fast: beats
+                        # at 0.5 s, stalled after 1.5 s of frozen
+                        # watermark with work waiting (3 beats)
+                        "TPU9_PRESSURE_INTERVAL_S": "0.5",
+                        "TPU9_HEALTH_STALL_S": "1.5",
+                        "TPU9_HEALTH_DEGRADED_S": "0.75"},
+                "autoscaler": {"max_containers": 2,
+                               "min_containers": 2}})
+        await stack.wait_running(dep["stub_id"], 2, timeout=120.0)
+        # warm both replicas directly (compiles + first flight records)
+        states = await stack.running_containers(dep["stub_id"])
+        cids = sorted(s.container_id for s in states)
+        addr = {s.container_id: s.address for s in states}
+        for cid in cids:
+            deadline = time.monotonic() + 120.0
+            while True:
+                try:
+                    status, out = await _direct_generate(addr[cid], 4, 120)
+                    assert status == 200, out
+                    break
+                except aiohttp.ClientError:
+                    assert time.monotonic() < deadline, f"{cid} never up"
+                    await asyncio.sleep(0.5)
+        victim, healthy = cids[0], cids[1]
+        router = stack.gateway.fleet_router
+        assert router is not None
+
+        # ---- induce the gray failure -----------------------------------
+        open(os.path.join(flag_dir, f"stall-{victim}"), "w").close()
+        # pin work on the victim: this request admits, then its decode
+        # dispatch spins forever — it completes only after recovery
+        hung = asyncio.create_task(
+            _direct_generate(addr[victim], 64, timeout=180.0))
+        beat = await _wait_health(stack, victim, "stalled")
+        assert beat.get("health_reason") == "no_progress_with_queued_work"
+        # the runner was STILL heartbeating while wedged (gray, not dead):
+        # the beat that carried the verdict is fresh
+        assert float(beat.get("ts", 0)) > time.time() - 5.0
+
+        # the gateway's observer folded the verdict into routing
+        deadline = time.monotonic() + 10.0
+        while (not router.admission.is_stalled(victim)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.1)
+        assert router.admission.is_stalled(victim)
+        assert not router.admission.is_draining(victim)
+
+        # ---- measured dispatch counts: router routes around it ---------
+        dispatches = []
+        orig_launch = router._launch
+
+        def spy_launch(st, req, prefer, replica, affinity_hit=None):
+            dispatches.append(replica)
+            return orig_launch(st, req, prefer, replica,
+                               affinity_hit=affinity_hit)
+
+        router._launch = spy_launch
+        try:
+            results = await asyncio.gather(*[
+                stack.api("POST", "/endpoint/healthllm",
+                          json_body={"tokens": [9, 9, 9, i + 1],
+                                     "max_new_tokens": 4},
+                          timeout=120)
+                for i in range(6)])
+        finally:
+            router._launch = orig_launch
+        assert all(status == 200 for status, _ in results), results
+        assert len(dispatches) == 6
+        assert victim not in dispatches, dispatches
+        assert healthy in dispatches
+
+        # ---- post-mortem black box at /api/v1/postmortem ---------------
+        deadline = time.monotonic() + 15.0
+        records = []
+        while time.monotonic() < deadline:
+            status, pm = await stack.api(
+                "GET", f"/api/v1/postmortem?container_id={victim}")
+            assert status == 200, pm
+            records = pm.get("replicas", {}).get(victim, [])
+            if records:
+                break
+            await asyncio.sleep(0.3)
+        assert records, "watchdog trip never shipped a post-mortem"
+        rec = records[-1]
+        assert rec["reason"] == "watchdog_stall"
+        assert rec["container_id"] == victim
+        assert rec["flight"], "black box carries no flight windows"
+        assert {"hbm_used_gb_per_chip", "hbm_predicted_gb_per_chip"} <= \
+            set(rec["hbm"])
+        # the scheduler snapshot shows the wedged work
+        assert rec["scheduler"]["active_slots"] or \
+            rec["scheduler"]["queued"] > 0 or rec["stats"].get(
+                "active_streams", 0) > 0
+
+        # ---- recovery: health returns to ok, routing restored ----------
+        os.unlink(os.path.join(flag_dir, f"stall-{victim}"))
+        status, out = await hung          # the wedged request completes
+        assert status == 200 and len(out["tokens"]) == 64, out
+        await _wait_health(stack, victim, "ok")
+        deadline = time.monotonic() + 10.0
+        while (router.admission.is_stalled(victim)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.1)
+        assert not router.admission.is_stalled(victim)
+        running = {s.container_id
+                   for s in await router._running(dep["stub_id"])}
+        assert victim in running
+        # and traffic genuinely flows to it again
+        status, out = await _direct_generate(addr[victim], 4, 60)
+        assert status == 200 and len(out["tokens"]) == 4
+
+
+async def test_engine_crash_during_generation_leaves_postmortem(tmp_path):
+    flag_dir = str(tmp_path)
+    async with LocalStack() as stack:
+        dep = await stack.deploy_endpoint(
+            "crashllm", {"app.py": FAULTY_APP}, "app:load_engine",
+            config_extra={
+                "timeout_s": 240.0,
+                "extra": {"runner": "llm"},
+                "env": {"TPU9_TEST_FLAG_DIR": flag_dir,
+                        "TPU9_PRESSURE_INTERVAL_S": "0.5"},
+                "autoscaler": {"max_containers": 1,
+                               "min_containers": 1}})
+        await stack.wait_running(dep["stub_id"], 1, timeout=120.0)
+        status, warm = await stack.api(
+            "POST", "/endpoint/crashllm",
+            json_body={"tokens": [5, 3, 9], "max_new_tokens": 4},
+            timeout=240)
+        assert status == 200, warm
+        (state,) = await stack.running_containers(dep["stub_id"])
+        cid = state.container_id
+
+        # crash the engine mid-generation: the next dispatch raises
+        open(os.path.join(flag_dir, f"crash-{cid}"), "w").close()
+        status, out = await _direct_generate(state.address, 16, 60)
+        assert status != 200, out       # the request saw the failure
+
+        deadline = time.monotonic() + 20.0
+        records = []
+        while time.monotonic() < deadline:
+            status, pm = await stack.api(
+                "GET", f"/api/v1/postmortem?container_id={cid}")
+            assert status == 200, pm
+            records = pm.get("replicas", {}).get(cid, [])
+            if records:
+                break
+            await asyncio.sleep(0.3)
+        assert records, "engine crash never shipped a post-mortem"
+        rec = records[-1]
+        assert rec["reason"] == "engine_crash"
+        assert "induced crash" in rec["exception"]
+        assert rec["flight"], "black box carries no flight windows"
+        assert "hbm_used_gb_per_chip" in rec["hbm"]
+        # the dead engine also reads as stalled on the health plane
+        beat = await _wait_health(stack, cid, "stalled", timeout=10.0)
+        assert beat.get("health_reason") == "engine_dead"

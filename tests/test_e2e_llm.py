@@ -158,7 +158,6 @@ async def test_llm_token_streaming_sse():
     generation finishes, not as one buffered blob)."""
     import aiohttp as _aiohttp
     import json as _json
-    import time as _time
 
     async with LocalStack() as stack:
         await stack.deploy_endpoint(
@@ -174,12 +173,20 @@ async def test_llm_token_streaming_sse():
             timeout=240)
         assert status == 200, warm
 
+        # 64 tokens ⇒ many decode windows ⇒ many SSE flush points spread
+        # over real device compute: the incremental-delivery proof below
+        # is an ORDERING assertion over reads, and needs genuinely
+        # interleaved generation to be load-robust (with only 8 tokens —
+        # one or two windows — a briefly descheduled client coroutine
+        # legitimately receives everything in a single read, which is
+        # the baseline flake this test used to have)
         events = []
-        arrival_times = []
+        read_of_event = []        # read index that delivered each event
+        reads = 0
         async with _aiohttp.ClientSession() as sess:
             async with sess.post(
                     stack.base_url + "/endpoint/llm-sse",
-                    json={"tokens": [5, 3, 9], "max_new_tokens": 8,
+                    json={"tokens": [5, 3, 9], "max_new_tokens": 64,
                           "stream": True},
                     headers={"Accept": "text/event-stream",
                              "Authorization":
@@ -190,22 +197,28 @@ async def test_llm_token_streaming_sse():
                     "Content-Type", "")
                 buf = b""
                 async for chunk in resp.content.iter_any():
-                    arrival_times.append(_time.monotonic())
+                    reads += 1
                     buf += chunk
                     while b"\n\n" in buf:
                         frame, buf = buf.split(b"\n\n", 1)
                         if frame.startswith(b"data: "):
                             events.append(_json.loads(frame[6:]))
+                            read_of_event.append(reads)
 
         toks = [e["token"] for e in events if "token" in e]
         final = next(e for e in events if e.get("done"))
         assert toks == final["tokens"]
-        assert len(toks) == 8
-        # greedy determinism: the stream matches the buffered result
-        assert toks == warm["tokens"]
-        # INCREMENTAL proof: chunks arrived over multiple reads, not one
-        # buffered blob at the end
-        assert len(arrival_times) >= 2, arrival_times
+        assert len(toks) == 64
+        # greedy determinism: the stream's prefix matches the buffered
+        # result (same greedy path, longer budget)
+        assert toks[:len(warm["tokens"])] == warm["tokens"]
+        # INCREMENTAL proof (ordering, not wall-clock): some token event
+        # arrived in an EARLIER read than the done event — i.e. the
+        # gateway relayed tokens while the generation was still running,
+        # instead of buffering the stream into one terminal blob
+        assert read_of_event[0] < read_of_event[-1], (
+            f"all {len(events)} events arrived in read "
+            f"{read_of_event[-1]} of {reads} — stream was buffered")
 
 
 @pytest.mark.slow

@@ -1,0 +1,376 @@
+"""Replica health plane (ISSUE 14): gray-failure watchdog state machine,
+HBM/liveness watermarks in engine stats, the post-mortem black box (build/
+clamp/append), the Prometheus tpu9_health_*/tpu9_hbm_* gauge families
+(golden exposition incl. label escaping), and the router's stalled-replica
+ejection ledger."""
+
+import asyncio
+import json
+
+import jax
+import pytest
+
+from tpu9.models import init_decoder
+from tpu9.models.llama import LLAMA_PRESETS
+from tpu9.observability import health
+from tpu9.observability.health import (EngineWatchdog, WatchdogConfig,
+                                       build_postmortem, clamp_postmortem,
+                                       health_code, load_postmortems,
+                                       publish_health, store_postmortem)
+from tpu9.serving.engine import EngineConfig, InferenceEngine
+
+
+# ---------------------------------------------------------------------------
+# watchdog state machine
+# ---------------------------------------------------------------------------
+
+def _stats(**kw):
+    base = dict(queued=0, active_streams=0, windows_processed=0,
+                tokens_generated=0, admit_dispatches=0,
+                graph_compiles_post_warmup=0)
+    base.update(kw)
+    return base
+
+
+def test_watchdog_idle_is_ok_forever():
+    wd = EngineWatchdog(WatchdogConfig(stall_after_s=1.0,
+                                       degraded_after_s=0.5))
+    assert wd.assess(_stats(), now=0.0) == ("ok", "")
+    # hours of idle: the frozen watermark indicts nothing without work
+    assert wd.assess(_stats(), now=10_000.0) == ("ok", "")
+    assert not wd.pop_stall_trip()
+
+
+def test_watchdog_degrades_then_stalls_with_queued_work():
+    wd = EngineWatchdog(WatchdogConfig(stall_after_s=6.0,
+                                       degraded_after_s=2.5))
+    s = _stats(queued=2)
+    assert wd.assess(s, now=0.0) == ("ok", "")
+    assert wd.assess(s, now=3.0) == ("degraded", "slow_progress")
+    state, reason = wd.assess(s, now=6.5)
+    assert (state, reason) == ("stalled", "no_progress_with_queued_work")
+    # the trip fires exactly once per incident
+    assert wd.pop_stall_trip()
+    assert not wd.pop_stall_trip()
+    assert wd.assess(s, now=8.0)[0] == "stalled"
+    assert not wd.pop_stall_trip()
+
+
+def test_watchdog_recovers_on_watermark_movement():
+    wd = EngineWatchdog(WatchdogConfig(stall_after_s=1.0,
+                                       degraded_after_s=0.5))
+    s = _stats(active_streams=1)
+    wd.assess(s, now=0.0)
+    assert wd.assess(s, now=2.0)[0] == "stalled"
+    # any progress counter moving = alive again
+    assert wd.assess(_stats(active_streams=1, tokens_generated=5),
+                     now=2.5) == ("ok", "")
+    # and a NEW incident trips a NEW post-mortem
+    assert wd.assess(_stats(active_streams=1, tokens_generated=5),
+                     now=5.0)[0] == "stalled"
+    assert wd.pop_stall_trip()
+
+
+def test_watchdog_post_idle_work_does_not_inherit_idle_age():
+    """A replica idle for an hour that then receives a request must get
+    a FRESH stall window — the idle age is not missing progress."""
+    wd = EngineWatchdog(WatchdogConfig(stall_after_s=5.0,
+                                       degraded_after_s=2.0))
+    wd.assess(_stats(), now=0.0)
+    wd.assess(_stats(), now=3600.0)
+    assert wd.assess(_stats(queued=1), now=3601.0) == ("ok", "")
+    assert wd.assess(_stats(queued=1), now=3604.0)[0] == "degraded"
+
+
+def test_watchdog_compile_storm_degrades_without_work():
+    wd = EngineWatchdog(WatchdogConfig(storm_window_s=10.0))
+    # first sample is the BASELINE — a restarted watchdog must not flag
+    # compiles that happened before it was watching
+    assert wd.assess(_stats(graph_compiles_post_warmup=4),
+                     now=0.0) == ("ok", "")
+    state, reason = wd.assess(_stats(graph_compiles_post_warmup=5),
+                              now=1.0)
+    assert (state, reason) == ("degraded", "compile_storm")
+    # sticky for the storm window, then clears
+    assert wd.assess(_stats(graph_compiles_post_warmup=5),
+                     now=9.0)[0] == "degraded"
+    assert wd.assess(_stats(graph_compiles_post_warmup=5),
+                     now=12.0) == ("ok", "")
+
+
+def test_watchdog_engine_dead_is_stalled_immediately():
+    wd = EngineWatchdog()
+    state, reason = wd.assess(_stats(engine_dead=True), now=0.0)
+    assert (state, reason) == ("stalled", "engine_dead")
+    assert wd.pop_stall_trip()
+
+
+def test_watchdog_hbm_pressure_degrades():
+    wd = EngineWatchdog(WatchdogConfig(hbm_pressure_frac=0.97))
+    ok = _stats(hbm_used_gb_per_chip=10.0, hbm_limit_gb_per_chip=16.0)
+    assert wd.assess(ok, now=0.0) == ("ok", "")
+    hot = _stats(hbm_used_gb_per_chip=15.8, hbm_limit_gb_per_chip=16.0)
+    assert wd.assess(hot, now=1.0) == ("degraded", "hbm_pressure")
+    # no limit reported (CPU): never classified on HBM
+    wd2 = EngineWatchdog()
+    assert wd2.assess(_stats(hbm_used_gb_per_chip=15.8),
+                      now=0.0) == ("ok", "")
+
+
+def test_watchdog_config_from_env():
+    cfg = WatchdogConfig.from_env({"TPU9_HEALTH_STALL_S": "1.5",
+                                   "TPU9_HEALTH_DEGRADED_S": "0.4",
+                                   "TPU9_HEALTH_HBM_FRAC": "garbage"})
+    assert cfg.stall_after_s == 1.5
+    assert cfg.degraded_after_s == 0.4
+    assert cfg.hbm_pressure_frac == WatchdogConfig.hbm_pressure_frac
+
+
+def test_health_code_unknown_reads_stalled():
+    assert health_code("ok") == 0
+    assert health_code("degraded") == 1
+    assert health_code("stalled") == 2
+    # an unparseable verdict must never look healthy
+    assert health_code("???") == 2
+    assert health_code(None) == 2
+
+
+# ---------------------------------------------------------------------------
+# post-mortem black box: build / clamp / append
+# ---------------------------------------------------------------------------
+
+def test_build_postmortem_bounds_tails():
+    rec = build_postmortem(
+        reason="watchdog_stall", exception="X" * 5000, container_id="c0",
+        stats={"queued": 3, "nested": {"drop": 1}},
+        flight=[{"seq": i} for i in range(500)],
+        spans=[{"spanId": str(i)} for i in range(500)])
+    assert len(rec["exception"]) == 2000
+    assert len(rec["flight"]) == health.FLIGHT_TAIL
+    assert rec["flight"][-1]["seq"] == 499          # newest survive
+    assert len(rec["spans"]) == health.SPAN_TAIL
+    assert "nested" not in rec["stats"]             # scalars only
+    assert rec["stats"]["queued"] == 3
+
+
+def test_clamp_postmortem_byte_bound_keeps_header():
+    rec = {"reason": "engine_crash", "exception": "boom",
+           "container_id": "c1", "ts": 1.0,
+           "stats": {"big": "x" * 4096},
+           "scheduler": {}, "kv_pool": {}, "hbm": {"u": 1.0},
+           "flight": [{"seq": i, "pad": "y" * 512} for i in range(64)],
+           "spans": [{"spanId": str(i), "pad": "z" * 512}
+                     for i in range(64)]}
+    out = clamp_postmortem(rec, max_bytes=8 * 1024)
+    assert len(json.dumps(out)) <= 8 * 1024
+    # the header always survives, evidence is shed oldest-first
+    assert out["reason"] == "engine_crash" and out["exception"] == "boom"
+    if out["flight"]:
+        assert out["flight"][-1]["seq"] == 63
+
+
+def test_clamp_postmortem_bounds_hostile_records():
+    """Review regression: the byte bound must hold for ANY record a
+    container-token holder ships — payload under novel keys, oversized
+    header-adjacent dicts, garbage types — not just well-formed ones."""
+    rec = {"reason": "x" * 5000, "exception": 12345, "ts": "garbage",
+           "container_id": "c" * 500,
+           "hbm": {"pad": "A" * 3_000_000},
+           "evil_extra": "B" * 2_000_000,
+           "flight": [], "spans": []}
+    out = clamp_postmortem(rec)
+    assert len(json.dumps(out)) <= health.MAX_POSTMORTEM_BYTES
+    assert "evil_extra" not in out                 # schema whitelist
+    assert len(out["reason"]) == 200
+    assert out["exception"] == "12345"
+    assert out["ts"] == 0.0
+    assert len(out["container_id"]) == 128
+    # section TYPES coerced too: every consumer .get()s the dicts and
+    # iterates flight/spans as dicts — shape-hostile values must not
+    # crash `tpu9 postmortem` downstream
+    out = clamp_postmortem({"reason": "x", "hbm": [1, 2],
+                            "scheduler": "nope", "stats": 7,
+                            "flight": ["a", {"seq": 1}], "spans": "zz"})
+    assert out["hbm"] == {} and out["scheduler"] == {} and \
+        out["stats"] == {}
+    assert out["flight"] == [{"seq": 1}] and out["spans"] == []
+
+
+def test_clamp_postmortem_unserializable_keeps_header():
+    out = clamp_postmortem({"reason": "r", "exception": "e",
+                            "stats": {"bad": object()},
+                            "flight": [], "spans": []})
+    assert out["reason"] == "r"
+    assert out["stats"] == {}
+
+
+def test_store_postmortem_atomic_list_caps_and_skips_corrupt():
+    """Storage contract: rpush+ltrim (atomic — the gateway's heartbeat
+    record and the worker's exit record for the same container land from
+    different processes; a get→append→set would let one erase the
+    other), newest MAX_POSTMORTEM_RECORDS retained, corrupt elements
+    skipped on read."""
+    from tpu9.statestore import MemoryStore
+
+    async def run():
+        store = MemoryStore()
+        for i in range(12):
+            await store_postmortem(store, "cX", {"reason": f"r{i}"})
+        records = await load_postmortems(store, "postmortem:cX")
+        assert len(records) == health.MAX_POSTMORTEM_RECORDS
+        assert records[-1]["reason"] == "r11"        # newest win
+        assert records[0]["reason"] == "r4"
+        assert (await store.ttl("postmortem:cX")) > 0
+        # a corrupt element (store damage) is skipped, never fatal
+        await store.rpush("postmortem:cX", "{not json")
+        records = await load_postmortems(store, "postmortem:cX")
+        assert [r["reason"] for r in records][-1] == "r11"
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Prometheus golden exposition: tpu9_health_* / tpu9_hbm_* families
+# (ISSUE 14 satellite, mirroring the tpu9_slo_*/tpu9_goodput_* golden)
+# ---------------------------------------------------------------------------
+
+def test_health_publish_uses_stable_prometheus_names():
+    from tpu9.observability import metrics as global_metrics
+    publish_health("cA", {"health": "stalled",
+                          "hbm_used_gb_per_chip": 12.5,
+                          "hbm_peak_gb_per_chip": 14.0,
+                          "hbm_predicted_gb_per_chip": 13.0,
+                          "hbm_limit_gb_per_chip": 16.0})
+    publish_health("cB", {"health": "ok",
+                          "hbm_used_gb_per_chip": 1.0})
+    text = global_metrics.prometheus_text()
+    for needle in (
+            'tpu9_health_state{replica="cA"} 2',
+            'tpu9_health_stalled{replica="cA"} 1.0',
+            'tpu9_health_state{replica="cB"} 0',
+            'tpu9_health_stalled{replica="cB"} 0.0',
+            'tpu9_hbm_used_gb{replica="cA"} 12.5',
+            'tpu9_hbm_peak_gb{replica="cA"} 14.0',
+            'tpu9_hbm_predicted_gb{replica="cA"} 13.0',
+            'tpu9_hbm_limit_gb{replica="cA"} 16.0',
+            'tpu9_hbm_headroom_frac{replica="cA"} 0.21875',
+            # no limit shipped → no headroom/limit series for cB
+            'tpu9_hbm_used_gb{replica="cB"} 1.0'):
+        assert needle in text, f"missing exposition line: {needle}"
+    assert 'tpu9_hbm_headroom_frac{replica="cB"}' not in text
+
+
+def test_forget_replica_drops_all_health_gauges():
+    """Review regression: a scaled-away replica's last verdict (often
+    `stalled`) must not alert forever, and per-cid gauge series must not
+    accumulate under autoscaler churn — forget_replica drops exactly the
+    families publish_health mints."""
+    from tpu9.observability import metrics as global_metrics
+    health.publish_health("cDead", {"health": "stalled",
+                                    "hbm_used_gb_per_chip": 12.0,
+                                    "hbm_peak_gb_per_chip": 13.0,
+                                    "hbm_predicted_gb_per_chip": 11.0,
+                                    "hbm_limit_gb_per_chip": 16.0})
+    assert 'tpu9_health_stalled{replica="cDead"}' in \
+        global_metrics.prometheus_text()
+    health.forget_replica("cDead")
+    text = global_metrics.prometheus_text()
+    assert 'replica="cDead"' not in text
+    # idempotent on an unknown replica
+    health.forget_replica("cNever")
+
+
+def test_health_publish_escapes_label_values():
+    """Label-value escaping rules (backslash, quote, newline) apply to
+    the replica label exactly as the text exposition format requires —
+    the same Metrics._key contract the SLO golden test pins."""
+    from tpu9.observability import metrics as global_metrics
+    publish_health('c\\evil"id\n', {"health": "degraded"})
+    text = global_metrics.prometheus_text()
+    assert 'tpu9_health_state{replica="c\\\\evil\\"id\\n"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# engine-side: liveness watermark + HBM watermarks + blackbox
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LLAMA_PRESETS["llama-tiny"]
+    return cfg, init_decoder(jax.random.PRNGKey(0), cfg)
+
+
+def _engine(tiny, **kw):
+    cfg, params = tiny
+    base = dict(max_batch=2, max_seq_len=256, prefill_buckets=(32, 64),
+                decode_steps=(1, 4), kv_block_size=32, kv_pool_blocks=16,
+                prefill_chunk=32)
+    base.update(kw)
+    return InferenceEngine(params, cfg, EngineConfig(**base))
+
+
+def test_engine_stats_carry_liveness_and_hbm_watermarks(tiny):
+    eng = _engine(tiny)
+
+    async def run():
+        await eng.start()
+        s0 = eng.stats()
+        assert s0["windows_processed"] == 0
+        assert s0["last_dispatch_age_s"] == -1.0     # never dispatched
+        assert s0["last_progress_age_s"] >= 0.0
+        assert s0["hbm_predicted_gb_per_chip"] > 0.0
+        assert s0["hbm_peak_gb_per_chip"] >= s0["hbm_used_gb_per_chip"]
+        assert "hbm_limit_gb_per_chip" in s0
+        out = await eng.generate([1, 2, 3, 4], max_new_tokens=6)
+        assert len(out) == 6
+        s1 = eng.stats()
+        assert s1["windows_processed"] > 0
+        assert s1["last_dispatch_age_s"] >= 0.0
+        await eng.stop()
+
+    asyncio.run(run())
+
+
+def test_engine_blackbox_snapshot(tiny):
+    eng = _engine(tiny)
+
+    async def run():
+        await eng.start()
+        await eng.generate([5, 6, 7], max_new_tokens=4)
+        bb = eng.blackbox("watchdog_stall", "synthetic")
+        assert bb["reason"] == "watchdog_stall"
+        assert bb["kv_pool"]["n_blocks"] > 0
+        assert bb["scheduler"]["queued"] == 0
+        assert any(r["kind"] == "decode" for r in bb["flight"])
+        assert set(bb["hbm"]) == {"hbm_used_gb_per_chip",
+                                  "hbm_peak_gb_per_chip",
+                                  "hbm_predicted_gb_per_chip",
+                                  "hbm_limit_gb_per_chip"}
+        # the whole record is JSON-serializable after the runner clamp
+        json.dumps(build_postmortem(container_id="c0", **bb))
+        await eng.stop()
+
+    asyncio.run(run())
+
+
+def test_engine_crash_leaves_postmortem(tiny):
+    """A serve-loop death captures the black box BEFORE request fan-out
+    clears the scheduler state — and generate() fails fast afterward."""
+    eng = _engine(tiny)
+
+    async def run():
+        await eng.start()
+        await eng.generate([1, 2], max_new_tokens=2)
+        # break the next dispatch from the inside
+        eng._decode_k = None      # TypeError in the loop = crash
+        with pytest.raises(ValueError, match="engine failure"):
+            await eng.generate([3, 4], max_new_tokens=4)
+        assert eng.last_postmortem is not None
+        assert eng.last_postmortem["reason"] == "engine_crash"
+        assert "TypeError" in eng.last_postmortem["exception"]
+        assert eng.stats()["engine_dead"]
+        with pytest.raises(RuntimeError, match="engine is dead"):
+            await eng.generate([5], max_new_tokens=1)
+        await eng.stop()
+
+    asyncio.run(run())

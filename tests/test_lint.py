@@ -752,6 +752,42 @@ def test_slo_observability_contracts_declared_and_live():
     assert any(t.startswith("tpu9.observability.slo") for t in gw)
 
 
+def test_health_plane_contract_declared_and_live():
+    """ISSUE 14 satellite: the replica health plane is a closed leaf —
+    the watchdog/black-box module is restricted to the runner (watchdog
+    on the heartbeat loop), the gateway (verdict fold + black-box store),
+    the CLI and bench; the serving engine and the router must NOT import
+    it (they exchange plain scalars over the heartbeat). Declared here,
+    asserted against the real import graph by the cross-check test."""
+    cfg = bnd.BoundaryConfig.load(
+        os.path.join(REPO, "tpu9", "analysis", "boundaries.toml"))
+    rmod = "tpu9.observability.health"
+    assert rmod in cfg.restricted
+    importers = cfg.restricted[rmod]
+    for needed in ("tpu9.gateway", "tpu9.runner", "tpu9.worker",
+                   "tpu9.cli"):
+        assert needed in importers, importers
+    # NO reverse edge into the planes the watchdog judges
+    for banned in ("tpu9.serving", "tpu9.router"):
+        assert not any(i == banned or i.startswith(banned + ".")
+                       for i in importers), importers
+    # liveness: the runner (watchdog + post-mortem ship) and the gateway
+    # (gauge publication + black-box clamp) really import the module —
+    # the contract guards real edges, not a dead name
+    edges = _real_imports()
+    assert any(t.startswith(rmod)
+               for t in edges.get("tpu9.runner.llm", set()))
+    gw_edges = (edges.get("tpu9.gateway.fleetobs", set())
+                | edges.get("tpu9.gateway.gateway", set()))
+    assert any(t.startswith(rmod) for t in gw_edges)
+    assert any(t.startswith(rmod)
+               for t in edges.get("tpu9.worker.lifecycle", set()))
+    # and the serving/router planes genuinely do not
+    for mod, targets in edges.items():
+        if mod.startswith("tpu9.serving") or mod.startswith("tpu9.router"):
+            assert not any(t.startswith(rmod) for t in targets), mod
+
+
 def test_tomlmini_parses_boundaries_toml():
     raw = tomlmini.load_file(
         os.path.join(REPO, "tpu9", "analysis", "boundaries.toml"))

@@ -73,3 +73,17 @@ async def test_mean_pressure():
     await r.record_pressure("b", 0.6, 1)
     assert abs(await r.mean_pressure(["a", "b"]) - 0.5) < 1e-9
     assert await r.mean_pressure(["nope"]) == 0.0
+
+
+async def test_mean_pressure_counts_stalled_as_missing_capacity():
+    """ISSUE 14: a stalled replica often reports LOW token pressure
+    (nothing moves through a wedged loop) — the autoscaler must read it
+    as a missing replica (pressure 1.0), not an idle one, or the fleet
+    never backfills the ejected capacity."""
+    store = MemoryStore()
+    r = LlmRouter(store)
+    await r.record_pressure("ok", 0.4, 1)
+    await r.record_pressure("wedged", 0.0, 1,
+                            extra={"health": "stalled",
+                                   "health_reason": "no_progress"})
+    assert abs(await r.mean_pressure(["ok", "wedged"]) - 0.7) < 1e-9

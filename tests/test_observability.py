@@ -632,3 +632,56 @@ async def test_fleetobs_ingests_cache_plane_series():
     # garbage snapshots are skipped, not fatal
     await store.set("worker:cache:w1", "not json")
     await obs.sample_cache_plane()
+
+
+async def test_fleetobs_ingests_health_and_folds_into_router():
+    """ISSUE 14: a heartbeat carrying a health verdict records the
+    numeric engine.<cid>.health series + the hbm_* watermark series,
+    publishes the tpu9_health_*/tpu9_hbm_* gauges, and folds the verdict
+    into the router's stalled ledger (eject on stalled, restore on ok)."""
+    from tpu9.config import SloConfig
+    from tpu9.gateway.fleetobs import FleetObserver
+    from tpu9.observability.metrics import metrics as global_metrics
+
+    class RouterSpy:
+        def __init__(self):
+            self.notes = []
+
+        def note_replica_health(self, cid, state, reason=""):
+            self.notes.append((cid, state, reason))
+
+    store = MemoryStore()
+    spy = RouterSpy()
+    obs = FleetObserver(SloConfig(), store, fleet_router=spy)
+    obs.ingest_heartbeat(
+        "cH", "ws", "st", token_pressure=0.2, active_streams=1,
+        extra={"health": "stalled",
+               "health_reason": "no_progress_with_queued_work",
+               "hbm_used_gb_per_chip": 12.0,
+               "hbm_peak_gb_per_chip": 13.0,
+               "hbm_predicted_gb_per_chip": 11.5,
+               "hbm_limit_gb_per_chip": 16.0,
+               "last_progress_age_s": 7.5,
+               "windows_processed": 42})
+    q = obs.timeline.query(["engine.cH.*"])
+    assert q["engine.cH.health"][-1][1] == 2.0          # stalled code
+    assert q["engine.cH.hbm_used_gb_per_chip"][-1][1] == 12.0
+    assert q["engine.cH.hbm_predicted_gb_per_chip"][-1][1] == 11.5
+    assert q["engine.cH.last_progress_age_s"][-1][1] == 7.5
+    assert q["engine.cH.windows_processed"][-1][1] == 42.0
+    assert spy.notes == [("cH", "stalled",
+                          "no_progress_with_queued_work")]
+    assert global_metrics.gauges.get(
+        'tpu9_health_state{replica="cH"}') == 2
+    # recovery flows through the same path
+    obs.ingest_heartbeat("cH", "ws", "st", token_pressure=0.1,
+                         active_streams=0, extra={"health": "ok",
+                                                  "health_reason": ""})
+    assert spy.notes[-1] == ("cH", "ok", "")
+    assert global_metrics.gauges.get(
+        'tpu9_health_state{replica="cH"}') == 0
+    # a health-less heartbeat (non-LLM runner) records nothing new
+    obs.ingest_heartbeat("cQ", "ws", "st", token_pressure=0.1,
+                         active_streams=0, extra={"queued": 0})
+    assert "engine.cQ.health" not in obs.timeline.series_names()
+    assert len(spy.notes) == 2

@@ -545,3 +545,99 @@ def test_spec_sample_aggregates_fleet_acceptance():
     assert snap["fleet_spec_acceptance_rate"] == 0.7
     from tpu9.observability.metrics import metrics
     assert metrics.gauges.get("tpu9_router_spec_acceptance_rate") == 0.7
+
+
+# ---------------------------------------------------------------------------
+# gray-failure ejection (ISSUE 14): stalled health folds into routing
+# ---------------------------------------------------------------------------
+
+async def test_stalled_health_ejects_like_draining_and_recovers():
+    router = make_router(cids=("r0", "r1"))
+    stub = make_stub()
+    # seed an affinity record onto the soon-to-stall replica
+    body = _body(200)
+    router.affinity.record_served(body, "r1")
+
+    assert router.affinity._table                    # record landed
+    router.note_replica_health("r1", "stalled", reason="no_progress")
+    assert router.admission.is_stalled("r1")
+    assert not router.admission.is_draining("r1")    # separate ledgers
+    # affinity entries dropped: prefix traffic re-homes NOW, not at TTL
+    assert not any(cid == "r1"
+                   for cid, _ in router.affinity._table.values())
+
+    async def forward(prefer):
+        assert "r1" not in prefer, prefer
+        return ForwardResult(status=200, body=b"{}", container_id="r0")
+
+    for _ in range(4):
+        out = await router.submit(stub, "t", _body(8), forward)
+        assert out.status == 200
+
+    # recovery: a healthy heartbeat restores routing immediately
+    router.note_replica_health("r1", "ok")
+    assert not router.admission.is_stalled("r1")
+
+    async def forward_both(prefer):
+        assert set(prefer) == {"r0", "r1"}
+        return ForwardResult(status=200, body=b"{}", container_id="r1")
+
+    out = await router.submit(stub, "t", _body(8), forward_both)
+    assert out.status == 200
+    await router.stop()
+
+
+async def test_stalled_heartbeat_stats_eject_at_dispatch_time():
+    """The dispatch path reads `health` off the pressure stats it already
+    fetches: a stalled verdict ejects the replica even with no gateway
+    observer folding health (bench driving the router directly)."""
+    router = make_router(cids=("r0", "r1"))
+    stub = make_stub()
+    await router.store.hmset("llm:pressure:r1",
+                             {"health": "stalled",
+                              "health_reason": "no_progress_with_queued_work",
+                              "queued": 0, "ts": time.time()})
+    await router.store.hmset("llm:pressure:r0",
+                             {"health": "ok", "queued": 0,
+                              "ts": time.time()})
+
+    async def forward(prefer):
+        assert prefer and "r1" not in prefer, prefer
+        return ForwardResult(status=200, body=b"{}", container_id="r0")
+
+    out = await router.submit(stub, "t", _body(8), forward)
+    assert out.status == 200
+    assert router.admission.is_stalled("r1")
+    # fleet capacity shrank to the healthy replica's budget only — the
+    # autoscaler's queue_sample sees the missing replica as pressure
+    order, budgets, capacity, _ = await router._preference(
+        "s", _body(8), await router._running("s"))
+    assert "r1" not in budgets and "r1" not in order
+    await router.stop()
+
+
+async def test_stalled_mark_ttl_expiry_reprobes_replica():
+    """With no fresh verdict renewing the mark, expiry puts the replica
+    back in the candidate set (the recovery probe for observer-less
+    drivers)."""
+    router = make_router(cids=("r0", "r1"), health_eject_ttl_s=0.05)
+    router.note_replica_health("r1", "stalled")
+    assert [s.container_id for s in await router._running("s")] == ["r0"]
+    await asyncio.sleep(0.08)
+    assert {s.container_id for s in await router._running("s")} == \
+        {"r0", "r1"}
+    await router.stop()
+
+
+async def test_unknown_health_state_ejects_not_restores():
+    """Review regression: the gauges map unknown verdicts to stalled
+    (never-look-healthy); routing must agree — garbage from a
+    version-skewed runner ejects, only known-routable states restore."""
+    router = make_router(cids=("r0", "r1"))
+    router.note_replica_health("r1", "stalled")
+    assert router.admission.is_stalled("r1")
+    router.note_replica_health("r1", "STALLED???")
+    assert router.admission.is_stalled("r1")       # garbage ≠ recovery
+    router.note_replica_health("r1", "degraded")
+    assert not router.admission.is_stalled("r1")   # degraded still routes
+    await router.stop()

@@ -158,3 +158,69 @@ async def test_stop_after_exit_does_not_resurrect_state():
     assert got.status == ContainerStatus.STOPPED.value
     # and no pending-reason leak for a container with no supervisor
     assert "ct-dead" not in lc._pending_reasons
+
+
+async def test_unorchestrated_exit_records_worker_postmortem():
+    """ISSUE 14: an OOM-killed (or plain crashed) container process can
+    never ship its own black box — the worker's supervisor writes the
+    minimal header record under postmortem:<cid>. An orchestrated stop
+    (scale_down) is not an incident and records nothing."""
+    from tpu9.config import WorkerConfig
+    from tpu9.observability.health import load_postmortems
+    from tpu9.repository import ContainerRepository
+    from tpu9.statestore import MemoryStore
+    from tpu9.types import ContainerRequest, ContainerState, ContainerStatus
+    from tpu9.worker.lifecycle import ContainerLifecycle
+    from tpu9.worker.tpu_manager import TpuDeviceManager
+
+    class ExitRuntime:
+        name = "process"
+
+        def __init__(self, code):
+            self.code = code
+
+        async def wait(self, container_id):
+            return self.code
+
+        async def kill(self, container_id, sig=15):
+            return True
+
+    store = MemoryStore()
+    containers = ContainerRepository(store)
+
+    async def run_one(cid, code, reason_noted=""):
+        lc = ContainerLifecycle("w0", WorkerConfig(), ExitRuntime(code),
+                                containers, TpuDeviceManager())
+        state = ContainerState(container_id=cid, stub_id="stub-x",
+                               workspace_id="ws-x",
+                               status=ContainerStatus.RUNNING.value)
+        await containers.update_state(state)
+        if reason_noted:
+            lc.note_stop_reason(cid, reason_noted)
+        await lc._supervise(ContainerRequest(container_id=cid,
+                                             stub_id="stub-x",
+                                             workspace_id="ws-x"), state)
+
+    # SIGKILL (asyncio reports -9) normalizes to OOM → oom_killed record
+    await run_one("ct-oom", -9)
+    records = await load_postmortems(store, "postmortem:ct-oom")
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["reason"] == "oom_killed"
+    assert rec["workspace_id"] == "ws-x" and rec["stub_id"] == "stub-x"
+    assert rec["stats"]["exit_code"] == -9
+    assert "exited with code -9" in rec["exception"]
+
+    # plain non-zero exit → process_exit record
+    await run_one("ct-crash", 3)
+    rec = (await load_postmortems(store, "postmortem:ct-crash"))[0]
+    assert rec["reason"] == "process_exit"
+    assert rec["stats"]["stop_reason"] == "exit"
+
+    # orchestrated scale-down (even with a non-zero code) records nothing
+    await run_one("ct-drain", 1, reason_noted="scale_down")
+    assert await load_postmortems(store, "postmortem:ct-drain") == []
+
+    # clean exit records nothing
+    await run_one("ct-clean", 0)
+    assert await load_postmortems(store, "postmortem:ct-clean") == []

@@ -451,3 +451,35 @@ def test_render_top_composes_engine_slo_goodput_tables():
     assert "▁" in frame or "█" in frame      # sparklines rendered
     # empty payloads must render, not crash (cold gateway)
     assert _render_top({}, {}, {})
+
+
+def test_render_top_health_column_and_hbm_headroom():
+    """ISSUE 14 satellite: the engines table carries the watchdog
+    verdict + HBM headroom; a non-ok replica shows its reason instead of
+    the throughput sparkline."""
+    from tpu9.cli.main import _render_top
+    metrics_data = {"engines": {
+        "c-ok": {"tokens_per_sec": "10.0", "health": "ok",
+                 "hbm_used_gb_per_chip": "12.0",
+                 "hbm_limit_gb_per_chip": "16.0", "age_s": 1.0},
+        "c-bad": {"tokens_per_sec": "0.0", "health": "stalled",
+                  "health_reason": "no_progress_with_queued_work",
+                  "hbm_used_gb_per_chip": "16.0",
+                  "hbm_limit_gb_per_chip": "16.0", "age_s": 1.0},
+        "ccpu": {"tokens_per_sec": "5.0", "health": "ok", "age_s": 1.0},
+    }}
+    frame = _render_top(metrics_data, {}, {})
+    assert "health" in frame and "hbm%" in frame
+    ok_line = next(ln for ln in frame.splitlines() if "c-ok" in ln)
+    bad_line = next(ln for ln in frame.splitlines() if "c-bad" in ln)
+    cpu_line = next(ln for ln in frame.splitlines() if "ccpu" in ln)
+    assert "ok" in ok_line and "25%" in ok_line
+    assert "stalled" in bad_line
+    assert "!! no_progress_with_queued_work" in bad_line
+    assert "0%" in bad_line                  # ~0 headroom
+    # no memory stats (CPU): headroom renders '-', never a fake number
+    # (cid chosen dash-free so this asserts the COLUMN, not the name)
+    assert "-" in cpu_line and "%" not in cpu_line
+    # legacy engines payload without health fields still renders
+    assert _render_top({"engines": {"c0": {"tokens_per_sec": "1.0"}}},
+                       {}, {})

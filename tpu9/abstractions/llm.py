@@ -77,6 +77,18 @@ class LlmRouter:
         for container_id in container_ids:
             p = await self.pressure(container_id)
             if p is not None:
+                health = str(p.get("health", "") or "")
+                if health and health not in ("ok", "degraded"):
+                    # gray failure (ISSUE 14): a wedged serve loop often
+                    # reports LOW token pressure (nothing moves), which
+                    # would read as spare capacity. The router ejects
+                    # any verdict it does not KNOW to be routable
+                    # (stalled or garbage alike — fleet._ROUTABLE_HEALTH)
+                    # so that capacity is gone — the autoscaler must see
+                    # a missing replica, not an idle one, or the fleet
+                    # never backfills the loss.
+                    vals.append(1.0)
+                    continue
                 vals.append(float(p.get("token_pressure", 0)))
         return sum(vals) / len(vals) if vals else 0.0
 

@@ -17,6 +17,7 @@ import asyncio
 import json
 import os
 import sys
+import time
 
 import click
 
@@ -725,6 +726,90 @@ def coldstart_cmd(stub_id: str, container_id: str, as_json: bool) -> None:
             f"  {tier_txt}{hedge_txt}")
 
 
+@cli.command("postmortem")
+@click.argument("container_id", required=False, default="")
+@click.option("--stub-id", default="", help="filter one deployment")
+@click.option("--json", "as_json", is_flag=True, help="raw records")
+def postmortem_cmd(container_id: str, stub_id: str, as_json: bool) -> None:
+    """Replica black-box records (ISSUE 14): the forensic dumps a
+    crashed/OOMed/watchdog-tripped engine leaves behind — last flight
+    windows, KV-pool + scheduler state, HBM breakdown, exception. With
+    no CONTAINER_ID, lists every record; with one, renders its newest
+    record in full."""
+    q = []
+    if container_id:
+        q.append(f"container_id={container_id}")
+    if stub_id:
+        q.append(f"stub_id={stub_id}")
+    qs = ("?" + "&".join(q)) if q else ""
+    data = _client()._run(
+        lambda c: c.request("GET", f"/api/v1/postmortem{qs}"))
+    replicas = data.get("replicas", {})
+    if as_json:
+        click.echo(json.dumps(replicas, indent=2))
+        return
+    if not replicas:
+        click.echo("no post-mortem records (no engine has crashed or "
+                   "tripped the watchdog)")
+        return
+    def _f(d, key):
+        # records arrive from the store unvalidated (any container-token
+        # holder can ship one): a non-numeric value must render as 0,
+        # not kill the whole listing with a format error
+        try:
+            return float(d.get(key, 0) or 0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    if not container_id:
+        click.echo(f"{'replica':<16}{'when':<10}{'reason':<28}"
+                   f"{'hbm used/pred GB':>18}  exception")
+        for cid, records in sorted(replicas.items()):
+            for rec in records:
+                hbm = rec.get("hbm", {}) or {}
+                exc = (rec.get("exception", "") or "").splitlines()
+                click.echo(
+                    f"{cid[:15]:<16}"
+                    f"{time.strftime('%H:%M:%S', time.localtime(_f(rec, 'ts'))):<10}"
+                    f"{(rec.get('reason', '') or '')[:27]:<28}"
+                    f"{_f(hbm, 'hbm_used_gb_per_chip'):>8.2f}/"
+                    f"{_f(hbm, 'hbm_predicted_gb_per_chip'):<8.2f} "
+                    f" {exc[0][:60] if exc else ''}")
+        return
+    records = replicas.get(container_id, [])
+    if not records:
+        click.echo(f"no records for {container_id}")
+        return
+    rec = records[-1]
+    click.echo(f"replica   {container_id}")
+    click.echo(f"reason    {rec.get('reason', '')}")
+    click.echo(f"exception {rec.get('exception', '')}")
+    sched = rec.get("scheduler", {}) or {}
+    click.echo(f"scheduler active={sched.get('active_slots', [])} "
+               f"queued={sched.get('queued', 0)} "
+               f"wait_room={sched.get('wait_room', 0)} "
+               f"inflight_steps={sched.get('inflight_steps', 0)} "
+               f"deferred={sched.get('deferred_windows', 0)}")
+    kv = rec.get("kv_pool", {}) or {}
+    if kv:
+        click.echo(f"kv pool   used={kv.get('used', 0)} "
+                   f"free={kv.get('free', 0)} "
+                   f"reserved={kv.get('reserved', 0)} "
+                   f"blocks={kv.get('n_blocks', 0)}")
+    hbm = rec.get("hbm", {}) or {}
+    click.echo(f"hbm       used={hbm.get('hbm_used_gb_per_chip', 0)}GB "
+               f"peak={hbm.get('hbm_peak_gb_per_chip', 0)}GB "
+               f"predicted={hbm.get('hbm_predicted_gb_per_chip', 0)}GB "
+               f"limit={hbm.get('hbm_limit_gb_per_chip', 0)}GB")
+    flight = rec.get("flight", []) or []
+    click.echo(f"flight    last {len(flight)} windows "
+               f"(spans: {len(rec.get('spans', []) or [])})")
+    for fr in flight[-16:]:
+        click.echo(f"  #{fr.get('seq', 0):<6}{fr.get('kind', ''):<8}"
+                   f"k={fr.get('k', 0):<3} pick={fr.get('pick', ''):<10}"
+                   f"batch={fr.get('batch', 0)}")
+
+
 @cli.command("profile")
 @click.argument("stub_id")
 @click.option("--windows", default=8, help="windows to profile")
@@ -771,8 +856,9 @@ def _render_top(metrics_data: dict, slo_data: dict,
 
     engines = metrics_data.get("engines", {})
     lines.append(f"ENGINES ({len(engines)} replicas)")
-    lines.append(f"  {'replica':<14}{'tok/s':>9}{'kv free':>9}"
-                 f"{'spec acc':>9}{'recompiles':>11}{'age':>7}  trend")
+    lines.append(f"  {'replica':<14}{'health':>9}{'hbm%':>6}{'tok/s':>9}"
+                 f"{'kv free':>9}{'spec acc':>9}{'recompiles':>11}"
+                 f"{'age':>7}  trend")
     for cid, snap in sorted(engines.items()):
         def _f(key, default=0.0):
             try:
@@ -780,12 +866,23 @@ def _render_top(metrics_data: dict, slo_data: dict,
             except (TypeError, ValueError):
                 return default
         spark = _sparkline(series.get(f"engine.{cid}.tokens_per_sec", []))
+        # health plane (ISSUE 14): watchdog verdict + HBM headroom
+        # (free fraction of the chip; '-' where the backend reports no
+        # memory stats). A non-ok replica shows its reason instead of
+        # the throughput sparkline — during an incident, WHY beats trend.
+        health = str(snap.get("health", "") or "-")
+        limit = _f("hbm_limit_gb_per_chip")
+        headroom = (f"{max(1.0 - _f('hbm_used_gb_per_chip') / limit, 0.0):>5.0%}"
+                    if limit > 0 else f"{'-':>5}")
+        tail = spark if health in ("ok", "-") else \
+            f"!! {snap.get('health_reason', '') or health}"
         lines.append(
-            f"  {cid[:13]:<14}{_f('tokens_per_sec'):>9.1f}"
+            f"  {cid[:13]:<14}{health[:8]:>9}{headroom:>6}"
+            f"{_f('tokens_per_sec'):>9.1f}"
             f"{_f('kv_blocks_free'):>9.0f}"
             f"{_f('spec_acceptance_rate'):>9.2f}"
             f"{_f('graph_compiles_post_warmup'):>11.0f}"
-            f"{_f('age_s'):>6.1f}s  {spark}")
+            f"{_f('age_s'):>6.1f}s  {tail}")
 
     lines.append("")
     lines.append("SLO (burn rate: >1 on fast+slow windows = burning)")

@@ -180,6 +180,13 @@ class RouterConfig:
     # cadence, same budget as SloConfig.stale_after_s (router plane vs
     # gateway plane of the one staleness policy)
     heartbeat_stale_s: float = 6.0
+    # gray-failure ejection (ISSUE 14): how long one `stalled` health
+    # verdict keeps a replica out of routing without renewal. Fresh
+    # heartbeats renew (still stalled) or clear (recovered) the mark;
+    # expiry is the recovery probe when no observer is folding health
+    # (bench driving the router directly). Default = 3 runner beats,
+    # aligned with the staleness budgets above.
+    health_eject_ttl_s: float = 6.0
 
 
 @dataclass

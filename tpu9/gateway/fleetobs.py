@@ -40,7 +40,14 @@ log = logging.getLogger("tpu9.gateway")
 ENGINE_SERIES = ("tokens_per_sec", "token_pressure", "queued",
                  "kv_blocks_free", "kv_blocks_used", "kv_blocks_reserved",
                  "spec_acceptance_rate", "graph_compiles_post_warmup",
-                 "active_streams")
+                 "active_streams",
+                 # replica health plane (ISSUE 14): HBM watermarks (live
+                 # vs planner-predicted — the drift graph) + the liveness
+                 # watermark ages behind the watchdog's verdict
+                 "hbm_used_gb_per_chip", "hbm_peak_gb_per_chip",
+                 "hbm_predicted_gb_per_chip", "hbm_limit_gb_per_chip",
+                 "windows_processed", "last_dispatch_age_s",
+                 "last_progress_age_s")
 # router snapshot fields mirrored into per-stub timeline series
 ROUTER_SERIES = ("queue_depth", "shed_rate", "pressure")
 # worker-heartbeated cache-plane counters mirrored 1:1 into per-worker
@@ -116,6 +123,19 @@ class FleetObserver:
         for key in ENGINE_SERIES:
             if key in stats:
                 self.timeline.record(prefix + key, _num(stats, key))
+        # replica health (ISSUE 14): numeric state series (0 ok /
+        # 1 degraded / 2 stalled), tpu9_health_*/tpu9_hbm_* gauges, and
+        # the routing fold — a `stalled` verdict ejects the replica from
+        # affinity/JSQ the way draining does, a recovered one restores it
+        if "health" in stats:
+            from ..observability.health import health_code, publish_health
+            state = str(stats.get("health", ""))
+            self.timeline.record(prefix + "health", health_code(state))
+            publish_health(container_id, stats)
+            note = getattr(self.fleet_router, "note_replica_health", None)
+            if note is not None:     # duck-typed router fakes in tests
+                note(container_id, state,
+                     reason=str(stats.get("health_reason", "")))
         # MFU/MBU priced control-plane-side from the engine's physics
         # constants (bytes / FLOPs per token per chip) × tokens/sec,
         # against the chip's public peaks — honest ~0 on CPU hosts
@@ -240,6 +260,11 @@ class FleetObserver:
             age = max(now - ts, 0.0) if ts else 0.0
             if ts and age > self.stale_after_s:
                 self.goodput.forget_replica(cid)
+                # drop its health/HBM gauges too (ISSUE 14): the dead
+                # replica's last verdict must not alert forever, and
+                # per-cid gauge series must not accumulate under churn
+                from ..observability.health import forget_replica
+                forget_replica(cid)
                 continue
             row = dict(snap)
             row["last_seen"] = ts

@@ -211,6 +211,7 @@ class Gateway:
         r.add_post("/rpc/task/{task_id}/complete", self._rpc_task_complete)
         r.add_post("/rpc/task/{task_id}/cancel", self._rpc_task_cancel)
         r.add_post("/rpc/llm/pressure", self._rpc_llm_pressure)
+        r.add_post("/rpc/llm/postmortem", self._rpc_llm_postmortem)
         # bot (petri-net orchestration)
         r.add_post("/rpc/bot/session", self._rpc_bot_session_create)
         r.add_get("/rpc/bot/{stub_id}/sessions", self._rpc_bot_sessions)
@@ -337,6 +338,7 @@ class Gateway:
         r.add_get("/api/v1/slo", self._slo)
         r.add_get("/api/v1/traces", self._traces)
         r.add_get("/api/v1/coldstart", self._coldstart)
+        r.add_get("/api/v1/postmortem", self._postmortem)
         # engine flight recorder + on-demand TPU profiling (ISSUE 8)
         r.add_get("/api/v1/flight", self._flight)
         r.add_post("/api/v1/profile", self._profile)
@@ -725,6 +727,39 @@ class Gateway:
             if not operator and rec.get("workspace_id") != ws.workspace_id:
                 continue
             out[cid] = merge_record(rec, runner_halves.get(cid))
+        return web.json_response({"replicas": out})
+
+    async def _postmortem(self, request: web.Request) -> web.Response:
+        """Replica black-box records (ISSUE 14): the bounded forensic
+        dumps engines leave behind on crash/OOM/watchdog-trip (last-K
+        flight windows, recent spans, KV-pool + scheduler state, HBM
+        breakdown, exception), shipped by the runner over
+        ``/rpc/llm/postmortem`` and stored per container. Workspace-
+        scoped like /api/v1/traces; ?container_id= pins one replica,
+        ?stub_id= filters a deployment. The evidence survives the
+        process it describes — the whole point of a black box."""
+        ws = self._ws(request)
+        operator = self._is_operator(request)
+        want_cid = request.query.get("container_id", "")
+        want_stub = request.query.get("stub_id", "")
+        from ..observability.health import load_postmortems
+        keys = [f"postmortem:{want_cid}"] if want_cid \
+            else await self.store.keys("postmortem:*")
+        out: dict[str, list] = {}
+        for key in keys:
+            records = await load_postmortems(self.store, key)
+            if not records:
+                continue
+            cid = key.split(":", 1)[-1]
+            # identity was stamped at ingest from the authenticated
+            # container state; filter on it, never trust the payload
+            visible = [r for r in records if isinstance(r, dict)
+                       and (operator
+                            or r.get("workspace_id") == ws.workspace_id)
+                       and (not want_stub
+                            or r.get("stub_id") == want_stub)]
+            if visible:
+                out[cid] = visible
         return web.json_response({"replicas": out})
 
     async def _flight(self, request: web.Request) -> web.Response:
@@ -1143,6 +1178,37 @@ class Gateway:
         spans = d.get("spans")
         if isinstance(spans, list) and spans:
             await self._ingest_runner_spans(state, spans)
+        return web.json_response({"ok": True})
+
+    async def _rpc_llm_postmortem(self, request: web.Request) -> web.Response:
+        """Black-box ingest (ISSUE 14): a dying/wedged engine's forensic
+        record, shipped by the runner. Workspace-scoped like the pressure
+        heartbeat; identity is stamped HERE from the authenticated
+        container state (a tenant must not plant records into another
+        workspace's /api/v1/postmortem view), the record re-clamped to
+        the size bound server-side (the runner's clamp is not trusted),
+        and the per-replica list kept at the last N records."""
+        ws = self._ws(request)
+        d = await request.json()
+        state = await self.containers.get_state(d.get("container_id", ""))
+        if state is None or state.workspace_id != ws.workspace_id:
+            return web.json_response({"error": "container not found"},
+                                     status=404)
+        rec = d.get("record")
+        if not isinstance(rec, dict):
+            return web.json_response({"error": "record must be a dict"},
+                                     status=400)
+        from ..observability.health import (clamp_postmortem,
+                                            store_postmortem)
+        rec = clamp_postmortem(rec)
+        rec["workspace_id"] = state.workspace_id
+        rec["stub_id"] = state.stub_id
+        rec["container_id"] = state.container_id
+        # atomic list append: the worker's exit record for the same
+        # container may land concurrently from another process
+        await store_postmortem(self.store, state.container_id, rec)
+        log.warning("post-mortem stored for %s (%s)",
+                    state.container_id, rec.get("reason", ""))
         return web.json_response({"ok": True})
 
     async def _ingest_runner_spans(self, state, spans: list) -> None:

@@ -82,6 +82,15 @@ class Metrics:
         with self._lock:
             self.gauges[self._key(name, labels)] = value
 
+    def remove_gauge(self, name: str,
+                     labels: Optional[dict] = None) -> None:
+        """Drop one gauge series. Per-entity gauges (replica-labelled
+        health/HBM families) must be removed when the entity dies —
+        set_gauge-only registries grow without bound under autoscaler
+        churn and a dead replica's last value alerts forever."""
+        with self._lock:
+            self.gauges.pop(self._key(name, labels), None)
+
     def observe(self, name: str, value: float,
                 labels: Optional[dict] = None) -> None:
         with self._lock:

@@ -131,6 +131,10 @@ class AdmissionController:
         # container_id -> drain mark expiry (bounded even if a stop never
         # lands: the mark ages out with the container TTL)
         self._draining: dict[str, float] = {}
+        # container_id -> stalled mark expiry (gray-failure ejection,
+        # ISSUE 14): kept separate from draining so health recovery can
+        # clear it without cancelling a genuine scale-down drain
+        self._stalled: dict[str, float] = {}
         # EWMA of request service seconds, per stub — feeds Retry-After
         self._service_ewma: dict[str, float] = {}
 
@@ -171,6 +175,30 @@ class AdmissionController:
             return False
         if time.monotonic() > expiry:
             del self._draining[container_id]
+            return False
+        return True
+
+    # -- gray-failure ejection (ISSUE 14) --------------------------------------
+    # A replica whose heartbeat reports health == "stalled" is ejected
+    # from routing exactly like a draining one — but on its OWN ledger:
+    # clearing it on recovery must never cancel a genuine scale-down
+    # drain mark. The TTL doubles as the recovery probe: when no fresh
+    # heartbeat clears OR renews the mark (e.g. bench driving the router
+    # without the gateway's observer), expiry puts the replica back in
+    # the candidate set and the next dispatch pass re-reads its stats.
+
+    def mark_stalled(self, container_id: str, ttl_s: float = 6.0) -> None:
+        self._stalled[container_id] = time.monotonic() + ttl_s
+
+    def clear_stalled(self, container_id: str) -> None:
+        self._stalled.pop(container_id, None)
+
+    def is_stalled(self, container_id: str) -> bool:
+        expiry = self._stalled.get(container_id)
+        if expiry is None:
+            return False
+        if time.monotonic() > expiry:
+            del self._stalled[container_id]
             return False
         return True
 

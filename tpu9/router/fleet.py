@@ -49,6 +49,10 @@ from .signals import RouterSignals
 log = logging.getLogger("tpu9.router")
 
 PRESSURE_KEY = "llm:pressure:{cid}"     # runner heartbeat table (llm.py)
+# health verdicts the router will route to (ISSUE 14); anything else —
+# including garbage from a version-skewed runner — reads as stalled, the
+# same never-look-healthy contract observability.health.health_code pins
+_ROUTABLE_HEALTH = ("ok", "degraded")
 
 
 def _shed_result(status: int, error: str, retry_after_s: float) -> ForwardResult:
@@ -361,6 +365,39 @@ class FleetRouter:
 
         return release
 
+    # -- replica health (ISSUE 14) ---------------------------------------------
+
+    def note_replica_health(self, container_id: str, state: str,
+                            reason: str = "") -> None:
+        """Fold one replica's heartbeated health verdict into routing: a
+        ``stalled`` replica is ejected like a draining one (skipped by
+        affinity and JSQ, its affinity entries dropped so prefix traffic
+        re-homes NOW, its budget excluded from fleet capacity so the
+        autoscaler sees the missing replica as pressure), and a recovered
+        one is restored. Called by the gateway's FleetObserver on the
+        heartbeat cadence; the dispatch path re-checks the same field on
+        the pressure stats it already fetches, so direct drivers (bench)
+        get the same ejection without the observer.
+
+        Only the states this router KNOWS to be routable restore a
+        replica — an unparseable verdict (version skew, corruption) is
+        treated as stalled, matching ``health_code``'s never-look-healthy
+        contract: the gauges and the routing plane must agree on what a
+        garbage verdict means."""
+        if state not in _ROUTABLE_HEALTH:
+            newly = not self.admission.is_stalled(container_id)
+            self.admission.mark_stalled(
+                container_id, ttl_s=self.cfg.health_eject_ttl_s)
+            if newly:
+                self.affinity.forget_replica(container_id)
+                log.warning("replica %s health=%s (%s) — ejected "
+                            "from routing", container_id,
+                            state or "?", reason)
+        elif self.admission.is_stalled(container_id):
+            self.admission.clear_stalled(container_id)
+            log.warning("replica %s health=%s — restored to routing",
+                        container_id, state or "ok")
+
     # -- drain -----------------------------------------------------------------
 
     async def drain_replica(self, container_id: str) -> bool:
@@ -383,8 +420,11 @@ class FleetRouter:
     async def _running(self, stub_id: str) -> list:
         states = await self.containers.containers_by_stub(
             stub_id, status=ContainerStatus.RUNNING.value)
+        # draining AND stalled replicas are both out of rotation; the
+        # stalled mark's TTL expiry is the recovery probe (ISSUE 14)
         return [s for s in states
-                if not self.admission.is_draining(s.container_id)]
+                if not self.admission.is_draining(s.container_id)
+                and not self.admission.is_stalled(s.container_id)]
 
     async def _replica_stats(self, container_id: str) -> Optional[dict]:
         data = await self.store.hgetall(
@@ -416,6 +456,17 @@ class FleetRouter:
                                                    "heartbeat_stale_s", 6.0))
         for s, stats in zip(replicas, all_stats):
             cid = s.container_id
+            health = str(stats.get("health", "") or "") if stats else ""
+            if health and health not in _ROUTABLE_HEALTH:
+                # dispatch-time defense (ISSUE 14): the heartbeat fold
+                # normally marks this before a dispatch ever sees it, but
+                # a direct driver (bench) or a verdict landing between
+                # passes must still eject HERE — zero budget, no order
+                # slot, capacity shrinks by the whole replica
+                self.note_replica_health(cid, health,
+                                         str(stats.get("health_reason",
+                                                       "")))
+                continue
             budgets[cid] = self.budgets.budget_from_stats(stats)
             queued = 0.0
             if stats:
@@ -431,8 +482,10 @@ class FleetRouter:
         # can interleave between the read and the call (single-threaded
         # loop) — cheaper than re-walking the block keys a second time
         hits0 = self.affinity.hits
-        order = self.affinity.order(body, [s.container_id for s in replicas],
-                                    load, saturated)
+        # candidates = the replicas that survived the health check above
+        # (load preserves replica order); a stalled replica must not even
+        # be an affinity target or it re-enters through the JSQ fallback
+        order = self.affinity.order(body, list(load), load, saturated)
         return (order, budgets, sum(budgets.values()),
                 self.affinity.hits > hits0)
 

@@ -24,6 +24,7 @@ import json
 import logging
 import os
 import sys
+from typing import Optional
 
 import aiohttp
 from aiohttp import web
@@ -273,6 +274,19 @@ async def amain() -> None:
         # tracer ring, same honesty as the worker/OTLP paths)
         last_span_ship = 0.0
         from ..observability.trace import RING_CAP, tracer
+        # replica health plane (ISSUE 14): the watchdog classifies the
+        # engine's liveness watermark each beat and the verdict rides the
+        # heartbeat — this loop is exactly the "runner still alive while
+        # the serve loop is wedged" side of a gray failure, so it must
+        # never await the engine, only read its stats dict
+        from ..observability.health import (EngineWatchdog, WatchdogConfig,
+                                            build_postmortem)
+        watchdog = EngineWatchdog(WatchdogConfig.from_env())
+        beat_s = float(os.environ.get("TPU9_PRESSURE_INTERVAL_S", "")
+                       or 2.0)
+        crash_shipped = False
+        pending_pm: Optional[dict] = None
+        pm_attempts = 0
         async with aiohttp.ClientSession(
                 headers={"Authorization": f"Bearer {token}"}) as session:
             while True:
@@ -299,6 +313,18 @@ async def amain() -> None:
                               # HBM — the fleet view's multichip evidence
                               "topo_tp", "topo_fsdp", "topo_n_chips",
                               "hbm_used_gb_per_chip",
+                              # HBM watermarks + liveness watermark
+                              # (ISSUE 14): peak/predicted/limit make
+                              # planner-vs-reality drift graphable; the
+                              # ages are the watchdog's raw evidence,
+                              # surfaced so `tpu9 top` / the black box
+                              # can show WHY a verdict was reached
+                              "hbm_peak_gb_per_chip",
+                              "hbm_predicted_gb_per_chip",
+                              "hbm_limit_gb_per_chip",
+                              "windows_processed",
+                              "last_dispatch_age_s",
+                              "last_progress_age_s",
                               # recompile sentinel (ISSUE 11): a non-zero
                               # post_warmup count is a mid-serve XLA
                               # compile — the closed-signature invariant
@@ -340,6 +366,56 @@ async def amain() -> None:
                     if isinstance(fl, dict):
                         extra["flight_records"] = fl.get("records", 0)
                         extra["flight_last_seq"] = fl.get("last_seq", 0)
+                    # health verdict (ISSUE 14): classified HERE, shipped
+                    # on the same beat — the gateway folds it into the
+                    # engines merge and the router ejects on `stalled`
+                    health, reason = watchdog.assess(stats)
+                    extra["health"] = health
+                    extra["health_reason"] = reason
+                    extra["health_since_s"] = round(watchdog.in_state_s, 3)
+                    # post-mortem triggers: a watchdog trip (once per
+                    # incident) or the serve loop's own death (the crash
+                    # handler left engine.last_postmortem behind). The
+                    # record is held until the gateway ACCEPTS it — a
+                    # gateway blip must not eat the black box.
+                    if pending_pm is None:
+                        pm_reason = pm_exc = ""
+                        if stats.get("engine_dead") and not crash_shipped:
+                            crash_shipped = True
+                            pm_reason, pm_exc = ("engine_dead",
+                                                 "serve loop dead")
+                            # the dead engine trips the watchdog's stall
+                            # flag too — SAME incident: consume it, or
+                            # the next beat ships a duplicate
+                            # watchdog_stall record for this death
+                            watchdog.pop_stall_trip()
+                        elif watchdog.pop_stall_trip():
+                            pm_reason, pm_exc = "watchdog_stall", reason
+                        if pm_reason:
+                            # blackbox() reads live engine state next to
+                            # a dead/wedged loop — a failing snapshot
+                            # must degrade to a header-only record, never
+                            # kill THIS loop (the replica would fall
+                            # silent, the outcome the watchdog prevents)
+                            try:
+                                raw = (engine.last_postmortem
+                                       if pm_reason == "engine_dead"
+                                       and engine.last_postmortem
+                                       else engine.blackbox(pm_reason,
+                                                            pm_exc))
+                                pending_pm = build_postmortem(
+                                    container_id=cfg.container_id, **raw)
+                            except Exception:   # noqa: BLE001
+                                log.exception(
+                                    "post-mortem snapshot failed")
+                                pending_pm = build_postmortem(
+                                    reason=pm_reason,
+                                    exception=f"{pm_exc} (snapshot "
+                                              "failed; header only)",
+                                    container_id=cfg.container_id,
+                                    stats={k: v for k, v in stats.items()
+                                           if isinstance(v, (int, float,
+                                                             str, bool))})
                     # engine spans ride the heartbeat the way worker rings
                     # ride the keepalive (worker.py ship analogue)
                     spans, ship_hi = tracer.export_new(
@@ -360,12 +436,52 @@ async def amain() -> None:
                         elif resp.status < 400:
                             rejected_logged = False
                             last_span_ship = ship_hi
+                    # black-box ship AFTER the heartbeat, in its own
+                    # error scope: the heartbeat is what keeps this
+                    # replica visible to the fleet — a persistently
+                    # failing postmortem endpoint must never starve it
+                    # (3 missed beats and a HEALTHY replica reads as
+                    # silent, ejected by the very plane observing it).
+                    # Bounded retry on EVERY path: transient errors get
+                    # 30 beats, a gateway that actively REJECTS the
+                    # record (4xx — container state expired) gets 5, then
+                    # the record is dropped so the trigger checks above
+                    # can capture the next incident's evidence.
+                    if pending_pm is not None:
+                        pm_attempts += 1
+                        pm_status = 0
+                        try:
+                            async with session.post(
+                                    gateway_url + "/rpc/llm/postmortem",
+                                    json={"container_id": cfg.container_id,
+                                          "record": pending_pm},
+                                    timeout=aiohttp.ClientTimeout(
+                                        total=5)) as resp:
+                                pm_status = resp.status
+                                if resp.status < 400:
+                                    log.warning(
+                                        "shipped post-mortem record (%s)",
+                                        pending_pm.get("reason"))
+                                    pending_pm, pm_attempts = None, 0
+                        except (aiohttp.ClientError,
+                                asyncio.TimeoutError) as exc:
+                            log.debug("post-mortem ship failed: %s", exc)
+                        if pending_pm is not None and (
+                                (400 <= pm_status < 500
+                                 and pm_attempts >= 5)
+                                or pm_attempts >= 30):
+                            log.error(
+                                "dropping post-mortem record (%s) after "
+                                "%d attempts (last status %d)",
+                                pending_pm.get("reason"), pm_attempts,
+                                pm_status)
+                            pending_pm, pm_attempts = None, 0
                 except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
                     log.debug("pressure heartbeat failed: %s", exc)
                 # request completions nudge the next beat immediately: an
                 # aggressive scale-to-zero otherwise kills the replica
-                # before the 2s tick and its engine spans die with it
-                await event_wait(state["beat"], timeout=2.0)
+                # before the beat tick and its engine spans die with it
+                await event_wait(state["beat"], timeout=beat_s)
                 state["beat"].clear()
 
     await pressure_loop() if gateway_url else await asyncio.Event().wait()

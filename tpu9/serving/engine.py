@@ -377,6 +377,39 @@ class InferenceEngine:
             self._device_kind = jax.devices()[0].device_kind
         except Exception:   # noqa: BLE001 — physics labels are best-effort
             self._device_kind = ""
+        # ---- replica health plane (ISSUE 14) ----
+        # liveness watermark: monotonic progress counters + dispatch/
+        # progress stamps the runner-side watchdog classifies from. All
+        # stamped on host paths the loop already runs — zero new syncs.
+        self._windows_processed = 0
+        self._last_dispatch_mono = 0.0
+        self._last_progress_mono = time.monotonic()
+        # HBM watermarks: live per-chip residency sampled on the stats()
+        # READ path (heartbeat cadence) vs the planned residency computed
+        # from the exact trees this engine holds — weights shard over
+        # tp×fsdp, KV payload over the tp head shard (feasibility.py's
+        # arithmetic, priced against the real leaves)
+        self._hbm_peak_gb = 0.0
+        topo = self.policy.describe()
+        kvb = sum(getattr(leaf, "size", 0)
+                  * getattr(getattr(leaf, "dtype", None), "itemsize", 0)
+                  for leaf in jax.tree_util.tree_leaves(self.kv_cache))
+        if self.paged:
+            kvb += sum(
+                getattr(leaf, "size", 0)
+                * getattr(getattr(leaf, "dtype", None), "itemsize", 0)
+                for leaf in jax.tree_util.tree_leaves(self._scratch))
+        self.hbm_predicted_gb_per_chip = round(
+            (wb / max(topo["tp"] * topo["fsdp"], 1)
+             + kvb / max(topo["tp"], 1)) / 1e9, 3)
+        # chip capacity is hardware-constant: sweep memory_stats() for it
+        # ONCE here, not on every stats() read (the live-usage sweep is
+        # the only per-beat memory_stats cost)
+        self._hbm_limit_gb = self.policy.hbm_limit_gb_per_chip()
+        # black box (ISSUE 14): the serve-loop failure handler snapshots
+        # the forensic record HERE before fan-out clears the evidence;
+        # the runner ships it to the gateway on the next heartbeat
+        self.last_postmortem: Optional[dict] = None
 
     # -- compiled steps (serving.graphs) + scheduling (serving.schedule) ----
     # Thin delegates: the implementations moved out with the ISSUE 9
@@ -696,6 +729,61 @@ class InferenceEngine:
             return []
         return self.flight.snapshot(limit=limit, since_seq=since_seq)
 
+    def blackbox(self, reason: str, exception: str = "") -> dict:
+        """Raw forensic material for a post-mortem record (ISSUE 14):
+        scalar stats, scheduler + KV-pool state, HBM breakdown, the
+        flight-recorder tail and the engine's recent spans. Plain host
+        reads only — safe to call from a failure handler or next to a
+        wedged serve loop. The runner wraps this through
+        ``tpu9.observability.health.build_postmortem`` (the size bound)
+        before shipping; the engine itself never imports the health
+        module, keeping the observability leaf reverse-edge-free."""
+        stats = self.stats()
+        scheduler = {
+            "active_slots": [int(i) for i in range(self.ecfg.max_batch)
+                             if self.active[i]],
+            "slot_requests": {
+                str(i): req.request_id
+                for i, req in enumerate(self.slot_req) if req is not None},
+            "slot_generated": {
+                str(i): len(req.generated)
+                for i, req in enumerate(self.slot_req) if req is not None},
+            "queued": self._queue.qsize(),
+            "wait_room": len(self._wait_room),
+            "admitting": (self._admitting.request_id
+                          if self._admitting else ""),
+            "inflight_steps": self._inflight_steps,
+            "deferred_windows": len(self._deferred_windows),
+            "pick_reason": self._pick_reason,
+        }
+        kv_pool = {}
+        if self.paged:
+            kv_pool = {"n_blocks": self.allocator.n_blocks,
+                       "block_size": self.allocator.block_s,
+                       "used": self.allocator.used_count,
+                       "free": self.allocator.free_count,
+                       "reserved": self.allocator.reserved,
+                       "lifetime_allocs": self.pool.kv_allocs,
+                       "kv_quant": self.ecfg.kv_quant if self.kv_quant
+                       else ""}
+            if self.prefix_cache is not None:
+                kv_pool["prefix_cache"] = self.prefix_cache.stats()
+        hbm = {k: stats.get(k, 0.0)
+               for k in ("hbm_used_gb_per_chip", "hbm_peak_gb_per_chip",
+                         "hbm_predicted_gb_per_chip",
+                         "hbm_limit_gb_per_chip")}
+        return {
+            "reason": reason,
+            "exception": exception,
+            "stats": {k: v for k, v in stats.items()
+                      if isinstance(v, (int, float, str, bool))},
+            "scheduler": scheduler,
+            "kv_pool": kv_pool,
+            "hbm": hbm,
+            "flight": self.flight_records(limit=64),
+            "spans": tracer.export(limit=128),
+        }
+
     def stats(self) -> dict:
         out = dict(self._stats)
         out["active_streams"] = int(self.active.sum())
@@ -751,6 +839,26 @@ class InferenceEngine:
         out["topo_fsdp"] = topo["fsdp"]
         out["topo_n_chips"] = topo["n_chips"]
         out["hbm_used_gb_per_chip"] = self.policy.hbm_used_gb_per_chip()
+        # ---- replica health plane (ISSUE 14) ----
+        # liveness watermark: progress counters + dispatch/progress ages
+        # the runner-side watchdog classifies ok/degraded/stalled from.
+        # Ages are computed here (one clock) so the watchdog never has to
+        # correlate monotonic clocks across the RPC boundary.
+        out["windows_processed"] = self._windows_processed
+        out["last_dispatch_age_s"] = (
+            round(now_m - self._last_dispatch_mono, 3)
+            if self._last_dispatch_mono else -1.0)
+        out["last_progress_age_s"] = round(
+            now_m - self._last_progress_mono, 3)
+        # HBM watermarks: peak tracks the read-path samples (heartbeat
+        # cadence); predicted is the planner-arithmetic residency of the
+        # exact trees this engine holds; limit is the chip's capacity
+        # (0.0 where the backend has no memory stats, i.e. CPU)
+        self._hbm_peak_gb = max(self._hbm_peak_gb,
+                                out["hbm_used_gb_per_chip"])
+        out["hbm_peak_gb_per_chip"] = self._hbm_peak_gb
+        out["hbm_predicted_gb_per_chip"] = self.hbm_predicted_gb_per_chip
+        out["hbm_limit_gb_per_chip"] = self._hbm_limit_gb
         # speculative-decoding acceptance (ISSUE 5): proposed/accepted are
         # cumulative; the rate is the fleet-comparable signal the runner
         # heartbeats and the router aggregates
@@ -974,6 +1082,7 @@ class InferenceEngine:
     def _obs_admit_end(self, req: _Request, t0_mono: float, t0_wall: float,
                        il0: int) -> None:
         dur = max(time.monotonic() - t0_mono, 0.0)
+        self._last_progress_mono = time.monotonic()   # admission = progress
         self.metrics.observe("tpu9_engine_prefill_s", dur)
         interleaved = self._stats["admit_interleaved_windows"] - il0
         if req.trace is not None and req.span is not None:
@@ -995,6 +1104,9 @@ class InferenceEngine:
     def _obs_stamp_window(self, win: _Window) -> _Window:
         win.t_mono = time.monotonic()
         win.t_wall = time.time()
+        # liveness watermark (ISSUE 14): the watchdog's "did the loop
+        # still reach a dispatch" stamp
+        self._last_dispatch_mono = win.t_mono
         win.pick = self._pick_reason
         if self.paged:
             win.kv_snap = (self.allocator.used_count,
@@ -1009,6 +1121,11 @@ class InferenceEngine:
         now_m = time.monotonic()
         self.metrics.observe("tpu9_engine_decode_window_s",
                              max(t_host0 - win.t_mono, 0.0))
+        # liveness watermark (ISSUE 14): a host-processed window IS
+        # progress — the counter the watchdog requires to keep moving
+        # while work is queued
+        self._windows_processed += 1
+        self._last_progress_mono = now_m
         delivered = win.delivered or {}
         if self.flight is not None:
             slots = {s: r.request_id
@@ -1324,6 +1441,15 @@ class InferenceEngine:
             import logging
             logging.getLogger("tpu9.serving").exception("engine loop died")
             self._dead_reason = f"{type(exc).__name__}: {exc}"
+            # black box FIRST (ISSUE 14): _fail_all_requests clears the
+            # scheduler state the record exists to capture. A crashing
+            # snapshot must never mask the original failure.
+            try:
+                self.last_postmortem = self.blackbox(
+                    "engine_crash", f"{type(exc).__name__}: {exc}")
+            except Exception:   # noqa: BLE001 — evidence is best-effort
+                logging.getLogger("tpu9.serving").exception(
+                    "post-mortem snapshot failed")
             self._fail_all_requests(f"engine failure: {exc}")
             raise
 

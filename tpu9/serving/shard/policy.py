@@ -105,6 +105,12 @@ class SingleDevicePolicy:
     def hbm_used_gb_per_chip(self) -> float:
         return _hbm_used_gb(self.devices())
 
+    def hbm_limit_gb_per_chip(self) -> float:
+        """Smallest per-chip HBM capacity across the submesh, GB — the
+        denominator of the health plane's headroom gauges (ISSUE 14).
+        0.0 where the backend has no memory stats (CPU)."""
+        return _hbm_limit_gb(self.devices())
+
 
 class MeshPolicy(SingleDevicePolicy):
     """Mesh-sharded placement for a tp(×fsdp) serving submesh."""
@@ -246,6 +252,20 @@ def _hbm_used_gb(devices: list) -> float:
             return 0.0
         worst = max(worst, stats.get("bytes_in_use", 0) / 1e9)
     return round(worst, 3)
+
+
+def _hbm_limit_gb(devices: list) -> float:
+    """Min per-chip capacity across the submesh, GB (0.0 = no stats)."""
+    best = float("inf")
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:   # noqa: BLE001 — backend-optional API
+            return 0.0
+        if not stats or not stats.get("bytes_limit"):
+            return 0.0
+        best = min(best, stats["bytes_limit"] / 1e9)
+    return round(best, 3) if best != float("inf") else 0.0
 
 
 def make_policy(topology: "Topology | str | None",

@@ -308,6 +308,37 @@ class ContainerLifecycle:
             if _gate_puller is not None:
                 _gate_puller.boot_finished()
 
+    async def _record_exit_postmortem(self, state: ContainerState,
+                                      code: int) -> None:
+        """Worker-witnessed black box for a process-level death (ISSUE
+        14): reason ``oom_killed``/``process_exit`` + exit code, tenancy
+        stamped from the authoritative container state. Merged into the
+        same per-replica list the runner's watchdog/crash records use,
+        so `tpu9 postmortem` shows hard kills next to soft wedges.
+        Evidence is best-effort — a store blip must not break teardown."""
+        try:
+            from ..observability.health import (build_postmortem,
+                                                store_postmortem)
+            rec = build_postmortem(
+                reason=("oom_killed"
+                        if state.stop_reason == StopReason.OOM.value
+                        else "process_exit"),
+                exception=f"container process exited with code {code}",
+                container_id=state.container_id,
+                stats={"exit_code": code,
+                       "stop_reason": state.stop_reason,
+                       "worker_id": self.worker_id})
+            rec["workspace_id"] = state.workspace_id
+            rec["stub_id"] = state.stub_id
+            # atomic list append: the runner's richer engine_crash record
+            # may be landing via the gateway at the same moment — a
+            # get→append→set here could erase it
+            await store_postmortem(self.containers.store,
+                                   state.container_id, rec)
+        except Exception as exc:    # noqa: BLE001 — evidence only
+            log.warning("exit post-mortem for %s failed: %s",
+                        state.container_id, exc)
+
     async def _supervise(self, request: ContainerRequest,
                          state: ContainerState) -> None:
         container_id = request.container_id
@@ -332,6 +363,15 @@ class ContainerLifecycle:
         await self.containers.update_state(state)
         await self.containers.set_exit_code(container_id, code,
                                             state.stop_reason)
+        if code != 0 and state.stop_reason in (StopReason.OOM.value,
+                                               StopReason.EXIT.value):
+            # unorchestrated death (ISSUE 14): an OOM-killed or crashed
+            # process can never ship its own black box — the worker is
+            # the only witness left, so it records the minimal header
+            # (exit code, OOM/exit reason) under the same postmortem:*
+            # key the runner's richer records use. Orchestrated stops
+            # (user/ttl/scale_down) are not incidents and record nothing.
+            await self._record_exit_postmortem(state, code)
         self._active.pop(container_id, None)
         self.memory_limits.pop(container_id, None)
         self.requests.pop(container_id, None)
