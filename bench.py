@@ -1349,6 +1349,240 @@ def bench_router(quick: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# phase: request survivability under induced faults (ISSUE 15) — a
+# simulated replica fleet driven through the REAL FleetRouter, the REAL
+# gateway failover driver (survival.submit_with_failover) and the REAL
+# watermark-splice machinery (survival.StreamResumption), with the
+# deterministic fault plane (tpu9.testing.faults) scheduling replica
+# crashes, stalls and RPC transport errors. Never imports jax.
+#
+# Gates (bench_guard): zero client-visible failed requests is HARD (a
+# violation strips the headline fields, and faults_recovery_p95_s is in
+# HARD_FIELDS so the stripped round FAILS); recovery-time p95 is guarded
+# "down" across rounds.
+# ---------------------------------------------------------------------------
+
+def bench_faults(quick: bool = False) -> dict:
+    import asyncio
+
+    from tpu9.abstractions.common.buffer import ForwardResult
+    from tpu9.config import RouterConfig
+    from tpu9.gateway import survival as sv
+    from tpu9.router import FleetRouter
+    from tpu9.statestore import MemoryStore
+    from tpu9.testing.faults import FaultPlane, parse_spec
+    from tpu9.types import ContainerState, ContainerStatus, Stub, StubConfig
+    from tpu9.utils.backoff import BackoffPolicy
+
+    N_REPLICAS = 3
+    N_REQUESTS = 80 if quick else 240
+    STAGGER_MS = 2.0              # request inter-arrival
+    SERVICE_MS = 2.0              # healthy per-request service floor
+    CRASH_DOWN_S = 0.1            # replica outage window after a crash
+    STALL_S = 0.08                # wedged-dispatch latency (≫ healthy)
+
+    class FakeFleet:
+        def __init__(self, n):
+            self.states = [ContainerState(
+                container_id=f"r{i}", stub_id="s",
+                status=ContainerStatus.RUNNING.value,
+                address=f"127.0.0.1:{9100 + i}") for i in range(n)]
+
+        async def containers_by_stub(self, stub_id, status=None):
+            return list(self.states)
+
+    async def run() -> dict:
+        # deterministic fault plan: replica crashes open a recovery
+        # window, stalls wedge single dispatches, rpc errors reset
+        # transports — all from one seeded plane
+        # times= lifts crash's oneshot default: every crash opens a
+        # CRASH_DOWN_S outage window, so each one fans out into many
+        # per-request failovers. Rates are tuned so the 3-replica fleet
+        # never has every replica down longer than the 5-attempt backoff
+        # schedule can outlast — the phase asserts the recovery machinery
+        # wins a WINNABLE fight; an unwinnable one (whole fleet dark for
+        # seconds) is a capacity incident, not a failover test.
+        plane = FaultPlane(parse_spec(
+            "crash:prob=0.03,times=5;stall:prob=0.04;rpc_error:prob=0.05"),
+            seed=1994)
+        down_until: dict[str, float] = {}
+        # backoff deliberately deterministic (jitter=0) and big enough
+        # (50 ms base) that recovery time is dominated by the schedule,
+        # not host sleep noise — the p95 is guarded across rounds
+        cfg = RouterConfig(default_replica_inflight=8,
+                           max_queue_depth=10000, max_queue_wait_s=10.0,
+                           failover_max_attempts=5,
+                           failover_backoff_base_s=0.05,
+                           failover_backoff_max_s=0.2)
+        router = FleetRouter(cfg, MemoryStore(), FakeFleet(N_REPLICAS))
+        stub = Stub(stub_id="s", name="s", workspace_id="w",
+                    config=StubConfig(timeout_s=30.0))
+        injected = {"crash": 0, "stall": 0, "rpc_error": 0}
+
+        def forward_for(avoid):
+            async def forward(prefer):
+                # the buffer's avoid semantics (gateway failover): failed
+                # replicas deprioritized unless nothing else exists
+                cands = [c for c in (prefer or ["r0"])
+                         if c not in avoid] or list(prefer or ["r0"])
+                cid = cands[0]
+                now = time.monotonic()
+                if down_until.get(cid, 0.0) > now:
+                    # replica still restarting: connect refused
+                    return ForwardResult(
+                        status=502, body=b'{"error":"ConnectRefused"}',
+                        container_id=cid)
+                if plane.fire("crash"):
+                    injected["crash"] += 1
+                    down_until[cid] = now + CRASH_DOWN_S
+                    return ForwardResult(
+                        status=500,
+                        body=b'{"error":"engine failure: induced"}',
+                        container_id=cid)
+                if plane.fire("rpc_error"):
+                    injected["rpc_error"] += 1
+                    return ForwardResult(
+                        status=502,
+                        body=b'{"error":"ConnectionResetError"}',
+                        container_id=cid)
+                svc = SERVICE_MS / 1000.0
+                if plane.fire("stall"):
+                    injected["stall"] += 1
+                    svc += STALL_S    # wedged dispatch, then the
+                    #                   watchdog-shaped 502
+                    await asyncio.sleep(svc)
+                    return ForwardResult(
+                        status=502, body=b'{"error":"stream_gap"}',
+                        container_id=cid)
+                await asyncio.sleep(svc)
+                return ForwardResult(status=200, body=b'{"ok":1}',
+                                     container_id=cid)
+            return forward
+
+        recoveries: list[float] = []
+        outcomes = {"ok": 0, "failed": 0, "failovers": 0}
+
+        async def one(i: int) -> None:
+            body = json.dumps({"tokens": [i % 7, i % 11, i % 13],
+                               "max_new_tokens": 8}).encode()
+            budget = sv.FailoverBudget(
+                cfg.failover_max_attempts,
+                BackoffPolicy(base_s=cfg.failover_backoff_base_s,
+                              max_s=cfg.failover_backoff_max_s,
+                              jitter=0.0))
+
+            async def attempt(attempt, avoid):
+                return await router.submit(stub, "chaos", body,
+                                           forward_for(avoid))
+
+            t_fail = [0.0]
+
+            def on_failover(attempt, failed, delay):
+                outcomes["failovers"] += 1
+                if t_fail[0] == 0.0:
+                    t_fail[0] = time.monotonic()
+
+            res = await sv.submit_with_failover(attempt, budget,
+                                                on_failover=on_failover)
+            if res.status == 200:
+                outcomes["ok"] += 1
+                if t_fail[0]:
+                    recoveries.append(time.monotonic() - t_fail[0])
+            else:
+                outcomes["failed"] += 1
+
+        tasks = []
+        for i in range(N_REQUESTS):
+            tasks.append(asyncio.create_task(one(i)))
+            await asyncio.sleep(STAGGER_MS / 1000.0)
+        await asyncio.gather(*tasks)
+        await router.stop()
+
+        # ---- mid-stream watermark splice, same machinery the gateway
+        # runs: a deterministic 'model' killed mid-generation, resumed
+        # via prompt+delivered replay — the client sequence must equal
+        # the unkilled reference exactly (no dup, no skip)
+        def model_next(prefix):
+            return (sum(prefix) * 31 + len(prefix)) % 997
+
+        def serve(prompt, max_new, die_after=None):
+            toks, prefix = [], list(prompt)
+            for j in range(max_new):
+                if die_after is not None and j >= die_after:
+                    return toks, True
+                t = model_next(prefix)
+                toks.append(t)
+                prefix.append(t)
+            return toks, False
+
+        splice_ok = 0
+        splice_n = 16 if quick else 48
+        for j in range(splice_n):
+            prompt = [j + 1, (j * 3) % 17 + 1]
+            max_new = 8 + (j % 9)
+            die_after = 1 + (j % (max_new - 1)) if max_new > 1 else None
+            reference, _ = serve(prompt, max_new)
+            res = sv.StreamResumption(prompt, max_new,
+                                      {"tokens": prompt,
+                                       "max_new_tokens": max_new})
+            got, died = serve(prompt, max_new, die_after=die_after)
+            for t in got:
+                res.note_token(t)
+            body = json.loads(res.resume_payload())
+            got2, _ = serve(body["tokens"], body["max_new_tokens"])
+            for t in got2:
+                res.note_token(t)
+            if res.delivered == reference:
+                splice_ok += 1
+
+        recoveries.sort()
+
+        def pct(p):
+            if not recoveries:
+                return 0.0
+            return recoveries[min(int(len(recoveries) * p),
+                                  len(recoveries) - 1)]
+
+        return {"outcomes": outcomes, "injected": dict(injected),
+                "recovery_p50_s": round(pct(0.50), 4),
+                "recovery_p95_s": round(pct(0.95), 4),
+                "recovered": len(recoveries),
+                "splice_ok": splice_ok, "splice_n": splice_n}
+
+    r = asyncio.run(run())
+    out = {
+        "faults_requests": N_REQUESTS,
+        "faults_failed_requests": r["outcomes"]["failed"],
+        "faults_failovers": r["outcomes"]["failovers"],
+        "faults_recovered": r["recovered"],
+        "faults_recovery_p50_s": r["recovery_p50_s"],
+        "faults_recovery_p95_s": r["recovery_p95_s"],
+        "faults_injected_crash": r["injected"]["crash"],
+        "faults_injected_stall": r["injected"]["stall"],
+        "faults_injected_rpc_error": r["injected"]["rpc_error"],
+        "faults_stream_splice_ok": r["splice_ok"],
+        "faults_stream_splice_n": r["splice_n"],
+    }
+    violations = []
+    if r["outcomes"]["failed"] > 0:
+        violations.append(
+            f"{r['outcomes']['failed']} client-visible failed requests "
+            "under induced faults (must be ZERO)")
+    if r["outcomes"]["failovers"] == 0 or sum(r["injected"].values()) == 0:
+        violations.append("no faults were actually induced — the chaos "
+                          "phase measured nothing")
+    if r["splice_ok"] != r["splice_n"]:
+        violations.append(
+            f"stream splice produced a duplicated/skipped token in "
+            f"{r['splice_n'] - r['splice_ok']}/{r['splice_n']} resumes")
+    if r["recovered"] == 0:
+        violations.append("no request actually recovered via failover")
+    out["violations"] = violations
+    out["valid"] = not violations
+    return out
+
+
+# ---------------------------------------------------------------------------
 # phase: speculative decoding (ISSUE 5) — tokens/sec spec-on vs spec-off
 # through the REAL serving engine on two workloads: repetitive/code-like
 # generations (prompt-lookup drafts must WIN) and random-token prompts
@@ -2596,6 +2830,14 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
             ("router", ("router_ttft_p50_ms", "router_ttft_p99_ms",
                         "router_shed_rate", "router_prefix_hit_rate",
                         "router_kv_hit_rate")),
+            # chaos phase (ISSUE 15): a violation (any failed request,
+            # a broken splice, or a chaos run that induced nothing)
+            # strips every headline — bench_guard HARD-fails the
+            # vanished faults_recovery_p95_s
+            ("faults", ("faults_failed_requests", "faults_failovers",
+                        "faults_recovered", "faults_recovery_p50_s",
+                        "faults_recovery_p95_s",
+                        "faults_stream_splice_ok")),
             ("spec", ("spec_uplift_repetitive", "spec_adversarial_ratio",
                       "spec_tokens_per_sec_on_repetitive",
                       "spec_tokens_per_sec_off_repetitive",
@@ -2704,6 +2946,11 @@ _COMPACT_KEYS = (
     "spec_uplift_repetitive", "spec_adversarial_ratio",
     "spec_tokens_per_sec_on_repetitive", "spec_tokens_per_sec_off_repetitive",
     "spec_acceptance_rate_repetitive", "spec_acceptance_rate_adversarial",
+    "faults_requests", "faults_failed_requests", "faults_failovers",
+    "faults_recovered", "faults_recovery_p50_s", "faults_recovery_p95_s",
+    "faults_injected_crash", "faults_injected_stall",
+    "faults_injected_rpc_error", "faults_stream_splice_ok",
+    "faults_stream_splice_n",
     "quant_shard_bytes_ratio", "quant_shard_bytes_ratio_measured",
     "quant_kv_capacity_ratio", "quant_kv_capacity_ratio_measured",
     "quant_tokens_per_sec_ratio", "quant_tokens_per_sec_on",
@@ -2784,7 +3031,8 @@ def main() -> None:
                     choices=["llm", "llm_endpoint", "kernels", "coldstart",
                              "coldstart_native", "coldstart_jax",
                              "coldstart_jax_tpu", "coldstart_stream",
-                             "router", "spec", "quant", "obs", "multichip"],
+                             "router", "spec", "quant", "obs", "multichip",
+                             "faults"],
                     help="run one phase in-process (used by the orchestrator)")
     args = ap.parse_args()
 
@@ -2795,7 +3043,7 @@ def main() -> None:
         os.environ["TPU9_BENCH_CPU"] = "1"
         # llm_endpoint force_cpu()s itself; the router phase never imports
         # jax at all (pure asyncio simulation)
-        if args.phase not in ("llm_endpoint", "router"):
+        if args.phase not in ("llm_endpoint", "router", "faults"):
             from tpu9.utils import force_cpu
             force_cpu(host_devices=0 if (args.phase or "")
                       .startswith("coldstart") else 8)
@@ -2809,7 +3057,8 @@ def main() -> None:
               "coldstart_stream": bench_cold_start_stream,
               "router": bench_router, "spec": bench_spec,
               "quant": bench_quant, "obs": bench_obs,
-              "multichip": bench_multichip}[args.phase]
+              "multichip": bench_multichip,
+              "faults": bench_faults}[args.phase]
         try:
             print(json.dumps(fn(quick=args.quick)))
         except Exception as exc:   # noqa: BLE001 — phase errors are data

@@ -45,6 +45,15 @@ GUARDED_FIELDS = {
     "router_shed_rate": "down",
     "router_prefix_hit_rate": "up",
     "router_kv_hit_rate": "up",
+    # request survivability (ISSUE 15): recovery time after an induced
+    # replica failure must not creep up. Zero-failed-requests is enforced
+    # INSIDE the phase (any client-visible failure is a violation that
+    # strips the headline fields) — the HARD presence check below turns a
+    # stripped round into a guard failure rather than silently lost
+    # coverage. The backoff schedule in the phase is deterministic
+    # (jitter=0, 50 ms base) so the p95 is schedule-dominated, not
+    # host-noise-dominated.
+    "faults_recovery_p95_s": "down",
     # speculative decoding (ISSUE 5): the repetitive-workload uplift must
     # not decay back toward 1.0, and the adversarial auto-disable must
     # keep holding the ratio near parity
@@ -97,6 +106,10 @@ GUARDED_FIELDS = {
 # silently lose coverage.
 HARD_FIELDS = ("quant_shard_bytes_ratio", "quant_kv_capacity_ratio",
                "quant_tokens_per_sec_ratio", "obs_overhead_frac",
+               # the faults phase strips its fields when ANY request was
+               # client-visibly lost (zero-failed-requests is HARD) or
+               # the watermark splice duplicated/skipped a token
+               "faults_recovery_p95_s",
                # the multichip phase's parity judge / planner checks strip
                # these on failure — a vanished value IS the regression
                "multichip_weight_shard_ratio", "multichip_total_ratio",

@@ -1,0 +1,78 @@
+"""Unit tests for the shared backoff helper (ISSUE 15 satellite): the
+one implementation behind the gateway failover, the checkpoint READY
+poll, the admission drain fallback and the post-mortem ship loop."""
+
+import random
+
+import pytest
+
+from tpu9.utils.backoff import BackoffPolicy, RetryState
+
+
+def test_deterministic_geometric_series_without_jitter():
+    p = BackoffPolicy(base_s=0.05, factor=2.0, max_s=0.4, jitter=0.0)
+    assert [p.delay(i) for i in range(6)] == \
+        [0.05, 0.1, 0.2, 0.4, 0.4, 0.4]
+
+
+def test_jitter_stays_inside_the_declared_slice():
+    p = BackoffPolicy(base_s=0.1, factor=2.0, max_s=10.0, jitter=0.5)
+    rng = random.Random(7)
+    for attempt in range(8):
+        d_full = min(0.1 * 2 ** attempt, 10.0)
+        for _ in range(50):
+            d = p.delay(attempt, rng)
+            # jitter=0.5: delay ∈ [0.5*d_full, d_full)
+            assert d_full * 0.5 <= d < d_full + 1e-12
+
+
+def test_jitter_is_reproducible_with_a_seeded_rng():
+    p = BackoffPolicy(base_s=0.1, jitter=0.5)
+    a = [p.delay(i, random.Random(42)) for i in range(5)]
+    b = [p.delay(i, random.Random(42)) for i in range(5)]
+    assert a == b
+
+
+def test_delays_iterator_is_finite_under_max_attempts():
+    p = BackoffPolicy(base_s=0.01, factor=2.0, max_s=1.0, jitter=0.0,
+                      max_attempts=4)
+    # 4 total attempts = 3 sleeps between them
+    assert list(p.delays()) == [0.01, 0.02, 0.04]
+
+
+def test_delays_iterator_unbounded_without_max_attempts():
+    p = BackoffPolicy(base_s=0.01, jitter=0.0)
+    it = p.delays()
+    seen = [next(it) for _ in range(100)]
+    assert len(seen) == 100
+    assert seen[-1] == p.max_s         # capped
+
+
+def test_negative_attempt_clamps_to_base():
+    p = BackoffPolicy(base_s=0.05, jitter=0.0)
+    assert p.delay(-3) == pytest.approx(0.05)
+
+
+def test_retry_state_budgets_match_the_postmortem_loop_contract():
+    # the runner's post-mortem ship loop: 5 attempts on a permanent
+    # rejection (4xx), 30 on transport errors — the PR-14 numbers
+    st = RetryState(BackoffPolicy(base_s=1.0, jitter=0.0),
+                    permanent_max=5, transient_max=30)
+    for _ in range(4):
+        st.next_delay()
+    assert not st.give_up(permanent=True)
+    st.next_delay()
+    assert st.give_up(permanent=True)
+    assert not st.give_up(permanent=False)
+    for _ in range(25):
+        st.next_delay()
+    assert st.give_up(permanent=False)
+    st.reset()
+    assert st.attempts == 0
+    assert not st.give_up(permanent=True)
+
+
+def test_retry_state_delays_follow_the_policy():
+    st = RetryState(BackoffPolicy(base_s=0.1, factor=2.0, max_s=1.0,
+                                  jitter=0.0))
+    assert [st.next_delay() for _ in range(4)] == [0.1, 0.2, 0.4, 0.8]

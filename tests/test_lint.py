@@ -370,6 +370,95 @@ class TestOBS002:
         assert "OBS002" not in rule_ids(src)
 
 
+class TestTMO001:
+    """ISSUE 15: network-facing awaits without a timeout/deadline in the
+    gateway/router/runner/worker/cache/statestore planes."""
+
+    PATH = "tpu9/gateway/mod.py"
+
+    def ids(self, src, path=None):
+        tree = ast.parse(textwrap.dedent(src))
+        return sorted({f.rule
+                       for f in rules.check_file(path or self.PATH, tree)})
+
+    def test_awaited_http_call_without_timeout_flagged(self):
+        src = """
+        async def ship(session, url):
+            await session.post(url, json={})
+        """
+        assert "TMO001" in self.ids(src)
+
+    def test_async_with_http_call_without_timeout_flagged(self):
+        # the dominant aiohttp idiom: the request awaits in __aenter__,
+        # not through an Await node
+        src = """
+        async def ship(session, url):
+            async with session.post(url, json={}) as resp:
+                return await resp.read()
+        """
+        assert "TMO001" in self.ids(src)
+
+    def test_timeout_kwarg_satisfies(self):
+        src = """
+        import aiohttp
+        async def ship(session, url):
+            await session.post(url, json={},
+                               timeout=aiohttp.ClientTimeout(total=5))
+            async with session.get(url, timeout=5.0) as resp:
+                return await resp.read()
+        """
+        assert "TMO001" not in self.ids(src)
+
+    def test_direct_open_connection_flagged_wrapped_not(self):
+        src = """
+        import asyncio
+        async def dial(host, port):
+            r, w = await asyncio.open_connection(host, port)
+        async def dial_bounded(host, port):
+            r, w = await asyncio.wait_for(
+                asyncio.open_connection(host, port), 5.0)
+        """
+        fs = [f for f in rules.check_file(
+            self.PATH, ast.parse(textwrap.dedent(src)))
+            if f.rule == "TMO001"]
+        assert len(fs) == 1
+        assert fs[0].symbol == "dial"
+
+    def test_blocking_store_read_without_timeout_flagged(self):
+        src = """
+        async def drain(store, key):
+            item = await store.blpop(key)
+            evs = await store.xread(key, "0")
+        """
+        fs = [f for f in rules.check_file(
+            self.PATH, ast.parse(textwrap.dedent(src)))
+            if f.rule == "TMO001"]
+        assert len(fs) == 2
+
+    def test_blocking_store_read_with_timeout_ok(self):
+        src = """
+        async def drain(store, key):
+            item = await store.blpop(key, 5.0)
+            evs = await store.xread(key, "0", timeout=2.0)
+        """
+        assert "TMO001" not in self.ids(src)
+
+    def test_out_of_scope_path_not_flagged(self):
+        src = """
+        async def ship(session, url):
+            await session.post(url, json={})
+        """
+        assert "TMO001" not in self.ids(src, path="tpu9/sdk/client.py")
+
+    def test_non_session_receiver_not_flagged(self):
+        src = """
+        async def run(queue, repo):
+            await queue.get()
+            await repo.get("key")
+        """
+        assert "TMO001" not in self.ids(src)
+
+
 class TestJAX001:
     HOT = """
     import jax, numpy as np
@@ -786,6 +875,46 @@ def test_health_plane_contract_declared_and_live():
     for mod, targets in edges.items():
         if mod.startswith("tpu9.serving") or mod.startswith("tpu9.router"):
             assert not any(t.startswith(rmod) for t in targets), mod
+
+
+def test_fault_plane_contract_declared_and_live():
+    """ISSUE 15 satellite: the fault-injection plane is chaos tooling —
+    restricted to its declared hook sites (runner/worker/cache, all
+    env-gated lazy imports), the test plane and bench. The gateway/
+    router/serving planes must never import it: the recovery machinery
+    under test cannot depend on the failure injector."""
+    cfg = bnd.BoundaryConfig.load(
+        os.path.join(REPO, "tpu9", "analysis", "boundaries.toml"))
+    rmod = "tpu9.testing.faults"
+    assert rmod in cfg.restricted
+    importers = cfg.restricted[rmod]
+    for needed in ("tpu9.runner", "tpu9.worker", "tpu9.cache",
+                   "tpu9.testing"):
+        assert needed in importers, importers
+    for banned in ("tpu9.gateway", "tpu9.router", "tpu9.serving"):
+        assert not any(i == banned or i.startswith(banned + ".")
+                       for i in importers), importers
+    # liveness: the declared hook sites really import it (lazily)
+    edges = _real_imports()
+    assert any(t.startswith(rmod)
+               for t in edges.get("tpu9.runner.llm", set()))
+    assert any(t.startswith(rmod)
+               for t in edges.get("tpu9.cache.client", set()))
+    assert any(t.startswith(rmod)
+               for t in edges.get("tpu9.worker.worker", set()))
+    # and the production planes genuinely do not
+    for mod, targets in edges.items():
+        if (mod.startswith("tpu9.gateway") or mod.startswith("tpu9.router")
+                or mod.startswith("tpu9.serving")):
+            assert not any(t.startswith(rmod) for t in targets), mod
+    # the hook-site imports are env-GATED: a production container without
+    # TPU9_FAULTS never executes them (source-level check on the gate)
+    for rel in ("tpu9/runner/llm.py", "tpu9/cache/client.py",
+                "tpu9/worker/worker.py"):
+        src = open(os.path.join(REPO, rel)).read()
+        gate = src.index("TPU9_FAULTS")
+        imp = src.index("from ..testing.faults import")
+        assert gate < imp, f"{rel}: faults import is not env-gated"
 
 
 def test_tomlmini_parses_boundaries_toml():

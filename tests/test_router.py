@@ -641,3 +641,84 @@ async def test_unknown_health_state_ejects_not_restores():
     router.note_replica_health("r1", "degraded")
     assert not router.admission.is_stalled("r1")   # degraded still routes
     await router.stop()
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+async def test_expired_deadline_is_504_at_the_door():
+    """A request already past its propagated budget is never queued and
+    never dispatched — 504 without Retry-After (the budget is spent)."""
+    router = make_router()
+    calls = []
+
+    async def forward(prefer):
+        calls.append(prefer)
+        return ForwardResult(status=200, body=b"{}")
+
+    res = await router.submit(make_stub(), "t", _body(4), forward,
+                              deadline_mono=time.monotonic() - 0.1)
+    assert res.status == 504
+    assert b"deadline_exceeded" in res.body
+    assert "Retry-After" not in dict(res.headers)
+    assert calls == []
+    await router.stop()
+
+
+async def test_expired_deadline_stream_shed_at_the_door():
+    router = make_router()
+    shed, prefer = await router.admit_stream(
+        make_stub(), "t", _body(4),
+        deadline_mono=time.monotonic() - 0.1)
+    assert shed is not None and shed.status == 504
+    assert prefer == []
+    await router.stop()
+
+
+async def test_live_deadline_clamps_queue_wait_not_dispatch():
+    """A healthy request with remaining budget dispatches normally; one
+    whose budget expires while QUEUED is shed by the submit deadline arm
+    instead of waiting out the full queue-wait SLO."""
+    router = make_router()
+
+    async def forward(prefer):
+        return ForwardResult(status=200, body=b"{}", container_id="r0")
+
+    res = await router.submit(make_stub(), "t", _body(4), forward,
+                              deadline_mono=time.monotonic() + 30.0)
+    assert res.status == 200
+
+    # saturated fleet: the dispatcher can never launch; the 0.3s budget
+    # must answer the caller LONG before max_queue_wait_s (30s)
+    slow = make_router(cids=("r0",), default_replica_inflight=1,
+                       max_replica_inflight=1)
+    assert slow.budgets.try_acquire("r0", 1)      # eat the only slot
+    t0 = time.monotonic()
+    res = await slow.submit(make_stub(), "t", _body(4), forward,
+                            deadline_mono=time.monotonic() + 0.3)
+    waited = time.monotonic() - t0
+    assert res.status in (503, 504)
+    assert waited < 5.0, waited
+    await router.stop()
+    await slow.stop()
+
+
+def test_note_dispatch_failure_drops_affinity_not_routing():
+    """Gateway failover feedback (ISSUE 15): a failed dispatch drops the
+    replica's affinity entries (repeat prefixes re-home immediately) but
+    does NOT eject it from routing — eligibility is the health plane's
+    verdict, not one failed request's."""
+    router = make_router()
+    body = _body(8)
+    router.affinity.record_served(body, "r0")
+    assert router.affinity.order(body, ["r0", "r1"], {"r0": 0, "r1": 0},
+                                 set())[0] == "r0"
+    router.note_dispatch_failure("r0")
+    # no affinity steer left toward r0 ...
+    hits0 = router.affinity.hits
+    router.affinity.order(body, ["r0", "r1"], {"r0": 0, "r1": 0}, set())
+    assert router.affinity.hits == hits0
+    # ... and r0 is still routable (not stalled, not draining)
+    assert not router.admission.is_stalled("r0")
+    assert not router.admission.is_draining("r0")

@@ -131,7 +131,11 @@ async def test_dead_engine_fails_fast_not_hangs():
             raise RuntimeError("injected engine failure")
 
         eng._admit = boom
-        with __import__("pytest").raises(ValueError, match="engine failure"):
+        # infrastructure failures surface as RuntimeError (ISSUE 15): the
+        # runner maps them to 500 and the gateway failover retries them —
+        # ValueError stays reserved for request-shape problems (400)
+        with __import__("pytest").raises(RuntimeError,
+                                         match="engine failure"):
             await asyncio.wait_for(eng.generate([1, 2, 3]), 30)
         assert eng.stats()["engine_dead"] is True
         with __import__("pytest").raises(RuntimeError, match="dead"):
@@ -251,5 +255,91 @@ async def test_load_engine_compile_ahead_overlaps_weight_build():
     try:
         out = await eng.generate([1, 2, 3], max_new_tokens=4)
         assert len(out) == 4
+    finally:
+        await eng.stop()
+
+
+# -- request deadlines (ISSUE 15) ---------------------------------------------
+
+async def test_non_positive_budget_raises_before_enqueue():
+    import pytest
+    eng = make_engine()
+    await eng.start()
+    try:
+        with pytest.raises(TimeoutError, match="deadline_exceeded"):
+            await eng.generate([1, 2, 3], max_new_tokens=4, budget_s=0.0)
+    finally:
+        await eng.stop()
+
+
+async def test_expired_request_is_never_prefilled():
+    """A request whose deadline passed while queued must be answered
+    WITHOUT a prefill: zero tokens, deadline error, counter bumped."""
+    import asyncio
+    import time as _time
+    eng = make_engine()
+    # enqueue BEFORE the loop starts, then expire the deadline: the
+    # loop's first admission pass must reject it at the door
+    req = await eng.generate([5, 3, 9], max_new_tokens=8, stream=True,
+                             budget_s=60.0)
+    req.deadline_mono = _time.monotonic() - 1.0
+    await eng.start()
+    try:
+        await asyncio.wait_for(req.done.wait(), 30)
+        assert req.error.startswith("deadline_exceeded")
+        assert "before prefill" in req.error
+        assert req.generated == []
+        assert eng.stats()["deadline_expired"] == 1
+        # the stream queue is released (None sentinel), not stranded
+        assert await asyncio.wait_for(req.queue.get(), 5) is None
+    finally:
+        await eng.stop()
+
+
+async def test_deadline_mid_decode_retires_slot_and_frees_kv():
+    """Deadline passing mid-generation retires the slot at the next
+    window boundary: partial tokens delivered, KV blocks back in the
+    pool immediately — not after the remaining budget decodes."""
+    import asyncio
+    import time as _time
+    from tpu9.serving import EngineConfig, InferenceEngine
+    params = init_decoder(jax.random.PRNGKey(0), TINY)
+    eng = InferenceEngine(params, TINY, EngineConfig(
+        max_batch=2, max_seq_len=256, prefill_buckets=(16, 64),
+        kv_block_size=16))
+    base_used = eng.allocator.used_count       # the permanent trash block
+    await eng.start()
+    try:
+        req = await eng.generate([5, 3, 9], max_new_tokens=200,
+                                 stream=True, budget_s=120.0)
+        got = []
+        got.append(await asyncio.wait_for(req.queue.get(), 30))
+        # a few tokens in: expire the deadline under the running slot
+        req.deadline_mono = _time.monotonic() - 0.001
+        while True:
+            t = await asyncio.wait_for(req.queue.get(), 30)
+            if t is None:
+                break
+            got.append(t)
+        assert req.error.startswith("deadline_exceeded")
+        assert "mid-decode" in req.error
+        assert 0 < len(got) < 200
+        assert eng.stats()["deadline_expired"] == 1
+        # slot + KV fully released (no prefix cache configured)
+        assert eng.allocator.used_count == base_used
+        assert eng.allocator.reserved == 0
+        assert eng.stats()["active_streams"] == 0
+    finally:
+        await eng.stop()
+
+
+async def test_generous_budget_changes_nothing():
+    eng = make_engine()
+    await eng.start()
+    try:
+        a = await eng.generate([5, 3, 9], max_new_tokens=8)
+        b = await eng.generate([5, 3, 9], max_new_tokens=8, budget_s=300.0)
+        assert a == b
+        assert eng.stats()["deadline_expired"] == 0
     finally:
         await eng.stop()

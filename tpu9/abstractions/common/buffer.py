@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import os
 import random
 import time
 from dataclasses import dataclass, field
@@ -38,6 +39,14 @@ class BufferedRequest:
     # fleet-router replica preference (container ids, best first) — see
     # tpu9.router.fleet: affinity/JSQ ordering computed above the buffer
     prefer: list = field(default_factory=list)
+    # replicas observed FAILING this request's earlier attempts (gateway
+    # failover, ISSUE 15): deprioritized below every other candidate —
+    # only reused when nothing else exists (serving a maybe-dead replica
+    # beats a guaranteed 502 on a one-replica fleet)
+    avoid: list = field(default_factory=list)
+    # per-request override of the buffer's timeout (gateway↔runner
+    # control RPCs ride RouterConfig.rpc_timeout_s; 0 = buffer default)
+    timeout_s: float = 0.0
 
 
 @dataclass
@@ -151,19 +160,26 @@ class RequestBuffer:
 
     async def forward(self, method: str = "POST", path: str = "/",
                       headers=None, body: bytes = b"",
-                      prefer: Optional[list] = None) -> ForwardResult:
+                      prefer: Optional[list] = None,
+                      avoid: Optional[set] = None,
+                      timeout_s: Optional[float] = None) -> ForwardResult:
         """``headers`` may be a dict or a list of (name, value) pairs
-        (duplicates preserved)."""
+        (duplicates preserved). ``timeout_s`` overrides the buffer's
+        request timeout for this call (control RPCs pass the shorter
+        RouterConfig.rpc_timeout_s bound)."""
         from multidict import CIMultiDict
+        budget = timeout_s or self.request_timeout_s
         req = BufferedRequest(method=method, path=path,
                               headers=CIMultiDict(headers or {}), body=body,
                               future=asyncio.get_running_loop().create_future(),
-                              prefer=list(prefer or []))
+                              prefer=list(prefer or []),
+                              avoid=list(avoid or []),
+                              timeout_s=budget)
         self._open += 1
         req.future.add_done_callback(lambda _f: self._dec_open())
         await self._queue.put(req)
         try:
-            return await asyncio.wait_for(req.future, self.request_timeout_s)
+            return await asyncio.wait_for(req.future, budget)
         except asyncio.TimeoutError:
             if not req.future.done():
                 req.future.cancel()
@@ -174,11 +190,19 @@ class RequestBuffer:
 
     async def forward_stream(self, method: str = "POST", path: str = "/",
                              headers=None, body: bytes = b"",
-                             prefer: Optional[list] = None):
+                             prefer: Optional[list] = None,
+                             avoid: Optional[set] = None,
+                             gap_s: Optional[float] = None):
         """Streaming forward: returns a :class:`StreamHandle` whose chunks
         arrive as the container produces them (LLM token streams), or a
         :class:`ForwardResult` on admission/connect failure. The caller
-        MUST ``close()`` the handle (token + demand are held until then)."""
+        MUST ``close()`` the handle (token + demand are held until then).
+
+        ``gap_s`` bounds the silent gap between chunks (ISSUE 15
+        mid-stream stall detection). Only callers that can RECOVER from
+        the resulting timeout (the gateway's resumable relay) should set
+        it — None keeps the legacy request-timeout bound, so a
+        legitimately quiet non-resumable stream is never truncated."""
         from multidict import CIMultiDict
         # demand registers BEFORE admission: scale-from-zero only triggers
         # if the autoscaler can see this request waiting (same contract as
@@ -188,7 +212,7 @@ class RequestBuffer:
         # a scale-from-zero LLM cold start routinely exceeds 30s and a
         # streaming request must ride it out like any other
         target = await self.acquire(deadline_s=self.request_timeout_s,
-                                    body=body, prefer=prefer)
+                                    body=body, prefer=prefer, avoid=avoid)
         if target is None:
             self._dec_open()
             return ForwardResult(status=504,
@@ -205,6 +229,14 @@ class RequestBuffer:
             await self.containers.release_request_token(self.stub.stub_id,
                                                         container_id)
 
+        # per-chunk gap bound (ISSUE 15): a replica that wedges mid-stream
+        # (gray stall) produces no bytes and no error — without a gap
+        # bound the relay would park for the whole request timeout before
+        # the gateway's failover could resume the stream elsewhere.
+        # TPU9_STREAM_GAP_S overrides for chaos tests.
+        gap_s = float(os.environ.get("TPU9_STREAM_GAP_S", "") or 0) \
+            or min(gap_s or self.request_timeout_s,
+                   self.request_timeout_s)
         try:
             resp = await self._session.request(
                 method, f"http://{address}{path}", data=body or None,
@@ -213,7 +245,7 @@ class RequestBuffer:
                 # sock_read bounds per-chunk gaps instead
                 timeout=aiohttp.ClientTimeout(
                     total=None, sock_connect=10.0,
-                    sock_read=self.request_timeout_s))
+                    sock_read=gap_s))
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
             await release()
             return ForwardResult(
@@ -258,12 +290,14 @@ class RequestBuffer:
     async def _process_one(self, req: "BufferedRequest") -> None:
         if req.future is not None and req.future.done():
             return     # caller gave up (timeout/cancel) while queued
-        if (time.monotonic() - req.enqueued_at) > self.request_timeout_s:
+        if (time.monotonic() - req.enqueued_at) > (req.timeout_s
+                                                   or self.request_timeout_s):
             if req.future and not req.future.done():
                 req.future.set_result(ForwardResult(
                     status=504, body=b'{"error":"expired in queue"}'))
             return
-        target = await self._acquire_container(req.body, prefer=req.prefer)
+        target = await self._acquire_container(req.body, prefer=req.prefer,
+                                               avoid=set(req.avoid))
         if target is None:
             # no capacity: requeue, then block on the next admission
             # signal (token release / container RUNNING) with a 250 ms
@@ -280,14 +314,17 @@ class RequestBuffer:
 
     async def acquire(self, deadline_s: float = 30.0,
                       body: bytes = b"",
-                      prefer: Optional[list] = None) -> Optional[tuple[str, str]]:
+                      prefer: Optional[list] = None,
+                      avoid: Optional[set] = None
+                      ) -> Optional[tuple[str, str]]:
         """Public admission: wait for a container with a concurrency token
         until ``deadline_s`` elapses (websocket sessions and other direct
         consumers; HTTP requests ride the buffered _process_loop). Waiting
         is driven by admission wakeups, with a bounded fallback poll."""
         deadline = time.monotonic() + deadline_s
         while time.monotonic() < deadline:
-            target = await self._acquire_container(body, prefer=prefer)
+            target = await self._acquire_container(body, prefer=prefer,
+                                                   avoid=avoid)
             if target is not None:
                 return target
             await self._wait_wake(min(0.25, max(deadline
@@ -295,7 +332,8 @@ class RequestBuffer:
         return None
 
     async def _acquire_container(self, body: bytes = b"",
-                                 prefer: Optional[list] = None
+                                 prefer: Optional[list] = None,
+                                 avoid: Optional[set] = None
                                  ) -> Optional[tuple[str, str]]:
         """Discover RUNNING containers and grab a concurrency token on one.
         Plain stubs spread randomly; LLM stubs route by pressure + prefix
@@ -311,6 +349,12 @@ class RequestBuffer:
                      if not self.drain_check(s.container_id)]
             # draining the LAST replica: serving it beats a guaranteed 504
             states = alive or states
+        if avoid:
+            # replicas that already failed this request's earlier
+            # attempts (gateway failover): skipped entirely unless
+            # they are ALL that exists
+            fresh = [s for s in states if s.container_id not in avoid]
+            states = fresh or states
         phash = ""
         if self.router is not None:
             from ..llm import prefix_hash
@@ -353,7 +397,8 @@ class RequestBuffer:
             async with self._session.request(
                     req.method, url, data=req.body or None,
                     headers=req.headers,
-                    timeout=aiohttp.ClientTimeout(total=self.request_timeout_s)
+                    timeout=aiohttp.ClientTimeout(
+                        total=req.timeout_s or self.request_timeout_s)
             ) as resp:
                 body = await resp.read()
                 result = ForwardResult(status=resp.status, body=body,

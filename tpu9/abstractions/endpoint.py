@@ -90,20 +90,26 @@ class EndpointService:
 
     async def forward(self, stub: Stub, method: str, path: str,
                       headers: dict, body: bytes,
-                      prefer: Optional[list] = None) -> ForwardResult:
+                      prefer: Optional[list] = None,
+                      avoid: Optional[set] = None,
+                      timeout_s: Optional[float] = None) -> ForwardResult:
         inst = await self.get_or_create_instance(stub)
         return await inst.buffer.forward(method=method, path=path,
                                          headers=headers, body=body,
-                                         prefer=prefer)
+                                         prefer=prefer, avoid=avoid,
+                                         timeout_s=timeout_s)
 
     async def forward_stream(self, stub: Stub, method: str, path: str,
                              headers: dict, body: bytes,
-                             prefer: Optional[list] = None):
+                             prefer: Optional[list] = None,
+                             avoid: Optional[set] = None,
+                             gap_s: Optional[float] = None):
         """StreamHandle (caller closes) or ForwardResult on failure."""
         inst = await self.get_or_create_instance(stub)
         return await inst.buffer.forward_stream(method=method, path=path,
                                                 headers=headers, body=body,
-                                                prefer=prefer)
+                                                prefer=prefer, avoid=avoid,
+                                                gap_s=gap_s)
 
     async def drain_stub(self, stub_id: str) -> None:
         # mark BEFORE popping and take the creation lock: an in-flight

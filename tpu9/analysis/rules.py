@@ -38,6 +38,16 @@ Per-file rules (class ``FileChecker``):
   dimensions (stub, tenant, phase, reason, worker) are fine;
   per-request identity belongs in span attributes or flight records.
 
+- **TMO001** network-facing awaits without a timeout/deadline in the
+  gateway/router/runner/worker/cache/statestore planes (ISSUE 15): an
+  awaited HTTP client call (``session.request/get/post/...``,
+  ``ws_connect``) with no ``timeout=`` argument, a blocking statestore
+  read (``blpop``/``xread``) with no timeout, or a direct
+  ``asyncio.open_connection`` await. A hung peer then parks the caller
+  forever — the gray-failure shape the health plane can detect but
+  never unwedge. Bound the call (``timeout=``/``ClientTimeout``) or
+  wrap it in ``asyncio.wait_for``/``aio.cancellable_wait``.
+
 Whole-program rule (``check_jax_hotpath``):
 
 - **JAX001** host-device sync (``.item()``, ``block_until_ready``,
@@ -95,6 +105,22 @@ BLOCKING_CALLS = {
     "shutil.copy2": "sync file IO",
     "shutil.move": "sync file IO",
 }
+
+# TMO001 scope: the control/serve planes where an unbounded network
+# await parks a request (or a whole dispatcher) behind one hung peer.
+TMO_PATHS = ("tpu9/gateway/", "tpu9/router/", "tpu9/runner/",
+             "tpu9/worker/", "tpu9/cache/", "tpu9/statestore/")
+# aiohttp-style client receivers (last dotted segment) + methods
+TMO_SESSION_RECVS = frozenset({"session", "_session", "sess",
+                               "_proxy_session", "client_session", "http"})
+TMO_HTTP_METHODS = frozenset({"request", "get", "post", "put", "delete",
+                              "patch", "head", "options", "ws_connect"})
+# statestore ops that BLOCK server-side until their own timeout →
+# positional index of that timeout argument (blpop(key, timeout),
+# xread(key, last_id, timeout))
+TMO_STORE_BLOCKING = {"blpop": 1, "xread": 2}
+TMO_TIMEOUT_KWARGS = frozenset({"timeout", "timeout_s", "deadline_s",
+                                "total"})
 
 # OBS002: metrics-registry recording methods (receiver must look like a
 # Metrics registry: the chain's last segment before the method is
@@ -327,6 +353,57 @@ class FileChecker(ast.NodeVisitor):
                     return f"`{callee}()` (a freshly minted id)"
             if stem.lower() in OBS2_TAINT_NAMES:
                 return f"`{stem}`"
+        return ""
+
+    # -- TMO001: unbounded network awaits (ISSUE 15) ---------------------------
+    def _tmo_check(self, call: ast.AST) -> None:
+        if not isinstance(call, ast.Call):
+            return
+        hit = self._tmo_unbounded(call)
+        if hit:
+            self._emit(
+                "TMO001", call,
+                f"{hit} awaited without a timeout/deadline: a "
+                "hung peer parks this caller forever — pass "
+                "timeout=/ClientTimeout, or wrap in "
+                "asyncio.wait_for / tpu9.utils.aio."
+                "cancellable_wait")
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if self.path.startswith(TMO_PATHS):
+            self._tmo_check(node.value)
+        self.generic_visit(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        # `async with session.post(...) as resp:` — the aiohttp idiom —
+        # awaits the request in __aenter__, not through an Await node
+        if self.path.startswith(TMO_PATHS):
+            for item in node.items:
+                self._tmo_check(item.context_expr)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _tmo_unbounded(call: ast.Call) -> str:
+        """Describe the unbounded network call, or ''. Presence of any
+        timeout-ish kwarg (or a positional blocking-timeout for blpop/
+        xread) satisfies the rule — value audit is the reviewer's job."""
+        kwargs = {kw.arg for kw in call.keywords if kw.arg}
+        if kwargs & TMO_TIMEOUT_KWARGS:
+            return ""
+        name = dotted_name(call.func)
+        if name in ("asyncio.open_connection", "open_connection"):
+            return f"`{name}(...)`"
+        if not isinstance(call.func, ast.Attribute):
+            return ""
+        meth = call.func.attr
+        recv_tail = dotted_name(call.func.value).rsplit(".", 1)[-1]
+        if (meth in TMO_HTTP_METHODS
+                and recv_tail.lower() in TMO_SESSION_RECVS):
+            return f"HTTP client call `{recv_tail}.{meth}(...)`"
+        if meth in TMO_STORE_BLOCKING:
+            # a positional block-timeout (blpop(key, 5)) counts
+            if len(call.args) <= TMO_STORE_BLOCKING[meth]:
+                return f"blocking store read `.{meth}(...)`"
         return ""
 
     # -- ASY003: swallowed cancellation ---------------------------------------

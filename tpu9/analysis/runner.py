@@ -34,6 +34,8 @@ ALL_RULES = {
               "in serving/router/worker hot-path files",
     "OBS002": "unbounded metric-label cardinality (request/trace/prompt "
               "ids as metrics.inc/observe/set_gauge label values)",
+    "TMO001": "network-facing await without a timeout/deadline in "
+              "gateway/router/runner/worker/cache/statestore hot paths",
     "BND001": "import-boundary contract violation (boundaries.toml)",
     "SHD001": "jax.jit opened outside the GraphFactory in mesh-capable "
               "serving modules (no explicit out_shardings)",

@@ -84,6 +84,14 @@ class CacheClient:
                       "peer_errors": 0, "hedged_reads": 0, "hedge_wins": 0,
                       "hedge_wasted_bytes": 0, "bytes_local": 0,
                       "bytes_peer": 0, "bytes_source": 0}
+        # fault-injection plane (ISSUE 15): env-gated, None in production
+        # — peer_read_error / peer_read_slow hooks in _peer_get exercise
+        # the hedged-read + failover machinery deterministically
+        self._faults = None
+        import os as _os
+        if _os.environ.get("TPU9_FAULTS"):
+            from ..testing.faults import FaultPlane
+            self._faults = FaultPlane.from_env()
 
     def _spawn_bg(self, coro) -> asyncio.Task:
         task = asyncio.create_task(coro)
@@ -120,6 +128,15 @@ class CacheClient:
     IO_TIMEOUT_S = 30.0
 
     async def _peer_get(self, peer: str, digest: str) -> Optional[bytes]:
+        if self._faults is not None:
+            delay = self._faults.delay_s("peer_read_slow")
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if self._faults.fire("peer_read_error"):
+                self.stats["peer_errors"] += 1
+                self._peer_entry(peer)["errors"] += 1
+                log.debug("fault plane: induced peer read error (%s)", peer)
+                return None
         lock = self._conn_locks.setdefault(peer, asyncio.Lock())
         async with lock:
             try:

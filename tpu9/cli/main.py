@@ -810,6 +810,44 @@ def postmortem_cmd(container_id: str, stub_id: str, as_json: bool) -> None:
                    f"batch={fr.get('batch', 0)}")
 
 
+@cli.command("failover")
+@click.option("--stub-id", default="", help="filter one deployment")
+@click.option("--limit", default=2000, help="trace spans to scan")
+@click.option("--json", "as_json", is_flag=True, help="raw spans")
+def failover_cmd(stub_id: str, limit: int, as_json: bool) -> None:
+    """Recent automatic-failover events (ISSUE 15): every retry the
+    gateway performed on behalf of a request whose replica died or
+    stalled — attempt number, reason, failed replica, and the stream
+    token watermark the resume spliced at. Zero rows on a healthy fleet;
+    rows with a flat shed rate mean replicas are dying under requests,
+    not capacity running out."""
+    data = _client()._run(
+        lambda c: c.request("GET", f"/api/v1/traces?limit={limit}"))
+    spans = [s for s in data.get("spans", [])
+             if s.get("name") == "gateway.failover"
+             and (not stub_id
+                  or s.get("attributes", {}).get("stub_id") == stub_id)]
+    if as_json:
+        click.echo(json.dumps(spans, indent=2))
+        return
+    if not spans:
+        click.echo("no failover events in the trace window (healthy "
+                   "fleet, or the ring already rotated them out)")
+        return
+    click.echo(f"{'when':<10}{'stub':<18}{'att':>4}{'watermark':>10}  "
+               f"{'reason':<22}failed replica")
+    for sp in spans:
+        at = sp.get("attributes", {})
+        ts = sp.get("startTimeUnixNano", 0) / 1e9
+        click.echo(
+            f"{time.strftime('%H:%M:%S', time.localtime(ts)):<10}"
+            f"{str(at.get('stub_id', ''))[:17]:<18}"
+            f"{at.get('attempt', 0):>4}"
+            f"{at.get('watermark', at.get('failed_status', '')):>10}  "
+            f"{str(at.get('reason', at.get('failed_status', '')))[:21]:<22}"
+            f"{at.get('failed_replica', '')}")
+
+
 @cli.command("profile")
 @click.argument("stub_id")
 @click.option("--windows", default=8, help="windows to profile")

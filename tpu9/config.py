@@ -187,6 +187,32 @@ class RouterConfig:
     # (bench driving the router directly). Default = 3 runner beats,
     # aligned with the staleness budgets above.
     health_eject_ttl_s: float = 6.0
+    # ---- request survivability (ISSUE 15) ----
+    # per-call bound on gateway↔runner control RPCs (flight/profile
+    # proxies, ckpt RPC, postmortem forwarding) — the TMO001 audit knob.
+    # Generation forwards keep their own request-timeout budget.
+    rpc_timeout_s: float = 30.0
+    # automatic failover: total attempts per request INCLUDING the first
+    # (1 disables retries); jittered exponential backoff between them
+    failover_max_attempts: int = 3
+    failover_backoff_base_s: float = 0.05
+    failover_backoff_max_s: float = 2.0
+    # request journal TTL: how long an X-Tpu9-Request-Id entry dedupes
+    # client-initiated retries (idempotency window) and how long a
+    # completed request's replayable result is retained
+    journal_ttl_s: float = 600.0
+    # largest completed-response body the journal will retain for replay
+    # (bigger results still dedupe, but replay returns a summary)
+    journal_body_cap: int = 65536
+    # mid-stream failover: max silent gap between SSE chunks from a
+    # RESUMABLE stream before it is declared wedged and failed over
+    # (env override TPU9_STREAM_GAP_S for chaos tests). Deliberately
+    # generous: the gap also covers the pre-first-token window, and a
+    # busy replica can legally hold a connected stream quiet for tens of
+    # seconds behind engine-side queueing — a too-tight gap turns load
+    # into replayed prefills. Non-resumable streams are never gap-bounded
+    # (they keep the full request timeout).
+    stream_gap_s: float = 90.0
 
 
 @dataclass

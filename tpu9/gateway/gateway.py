@@ -99,6 +99,14 @@ class Gateway:
                                          runner_env=self.runner_env,
                                          runner_tokens=self.runner_tokens)
         self.endpoints.fleet_router = self.fleet_router
+        # request survivability (ISSUE 15): idempotency journal for
+        # client-supplied X-Tpu9-Request-Id retries — a client retry of
+        # an in-flight/completed request attaches to the journal instead
+        # of double-executing
+        from .survival import RequestJournal
+        self.journal = RequestJournal(self.store,
+                                      ttl_s=cfg.router.journal_ttl_s,
+                                      body_cap=cfg.router.journal_body_cap)
         self.dispatcher = Dispatcher(self.store, self.backend)
 
         async def _container_alive(container_id: str) -> bool:
@@ -775,7 +783,8 @@ class Gateway:
         cid = request.query.get("container_id", "")
         result = await self.endpoints.forward(
             stub, "GET", f"/flight?limit={limit}&since_seq={since_seq}",
-            [], b"", prefer=[cid] if cid else [])
+            [], b"", prefer=[cid] if cid else [],
+            timeout_s=self.cfg.router.rpc_timeout_s)
         return web.Response(status=result.status, body=result.body,
                             content_type="application/json")
 
@@ -793,7 +802,8 @@ class Gateway:
             [("Content-Type", "application/json")],
             json.dumps({"windows": windows,
                         "out_dir": data.get("out_dir", "")}).encode(),
-            prefer=[cid] if cid else [])
+            prefer=[cid] if cid else [],
+            timeout_s=self.cfg.router.rpc_timeout_s)
         return web.Response(status=result.status, body=result.body,
                             content_type="application/json")
 
@@ -2018,9 +2028,14 @@ class Gateway:
         # workspace credential); runners do no inbound auth of their own.
         # x-tpu9-trace is stripped too: the trace context is gateway-minted
         # below, never client-supplied (a forged header would parent a
-        # tenant's engine spans under someone else's trace)
+        # tenant's engine spans under someone else's trace).
+        # x-tpu9-budget-s / x-tpu9-request-id are gateway-level contracts
+        # (ISSUE 15): the budget is re-emitted per attempt with spent time
+        # deducted; the request id drives the idempotency journal here.
         skip_req = {"host", "connection", "transfer-encoding",
-                    "content-length", "authorization", "x-tpu9-trace"}
+                    "content-length", "authorization", "x-tpu9-trace",
+                    "x-tpu9-budget-s", "x-tpu9-request-id",
+                    "x-tpu9-no-retry"}
         fwd_headers = [(k, v) for k, v in request.headers.items()
                        if k.lower() not in skip_req]
 
@@ -2034,10 +2049,61 @@ class Gateway:
                 wants_stream = bool(json.loads(body).get("stream"))
             except (ValueError, AttributeError):
                 pass
+        from . import survival as sv
+
+        # request survivability context (ISSUE 15): one monotonic
+        # deadline minted from the client's relative budget header, plus
+        # the idempotency journal for client-supplied request ids
+        ctx = sv.RequestContext.from_headers(request.headers)
+        if ctx.expired():
+            return web.json_response(
+                {"error": "deadline_exceeded: budget exhausted at the "
+                          "gateway"}, status=504)
+        if ctx.request_id:
+            dedup = await self._journal_gate(stub, ctx, stream=wants_stream)
+            if dedup is not None:
+                return dedup
+
         if wants_stream:
             return await self._serve_stub_stream(request, stub, path,
-                                                 fwd_headers, body)
+                                                 fwd_headers, body, ctx)
+        try:
+            return await self._serve_stub_buffered(request, stub, path,
+                                                   fwd_headers, body, ctx)
+        except BaseException:
+            # an escaping exception/cancellation between journal-begin
+            # and journal-finish must not strand the entry INFLIGHT (it
+            # would 409 every retry of this id for the whole TTL);
+            # finish(500) CLEARS it so the retry executes afresh. Guarded
+            # by journal_closed: a cancellation AFTER the terminal write
+            # (client already disconnected to retry) must not delete the
+            # DONE entry — that would re-open the double-execution hole
+            if ctx.request_id and not ctx.journal_closed:
+                try:
+                    await self.journal.finish(
+                        stub.workspace_id, ctx.request_id, 500,
+                        stub_id=stub.stub_id)
+                except Exception:   # noqa: BLE001 — best-effort cleanup
+                    pass
+            raise
+
+    async def _serve_stub_buffered(self, request: web.Request, stub: Stub,
+                                   path: str, fwd_headers: list,
+                                   body: bytes, ctx) -> web.Response:
+        from . import survival as sv
         from ..observability import tracer
+        from ..utils.backoff import BackoffPolicy
+        rcfg = self.cfg.router
+        # X-Tpu9-No-Retry: client opt-out for non-idempotent handlers —
+        # at-most-once dispatch, failures surface verbatim
+        attempts = 1 if (self.fleet_router is None
+                         or request.headers.get(sv.NO_RETRY_HEADER)) \
+            else rcfg.failover_max_attempts
+        budget = sv.FailoverBudget(
+            attempts,
+            BackoffPolicy(base_s=rcfg.failover_backoff_base_s,
+                          max_s=rcfg.failover_backoff_max_s),
+            deadline_mono=ctx.deadline_mono)
         with tracer.span("gateway.invoke",
                          attrs={"stub_id": stub.stub_id,
                                 "workspace_id": stub.workspace_id,
@@ -2046,8 +2112,7 @@ class Gateway:
             # the llm runner parses this header and the engine records its
             # prefill/decode-window spans under the SAME trace id, shipped
             # back on the pressure heartbeat (ISSUE 8)
-            fwd_headers.append(("X-Tpu9-Trace",
-                                f"{sp.trace_id}:{sp.span_id}"))
+            trace_hdr = ("X-Tpu9-Trace", f"{sp.trace_id}:{sp.span_id}")
             if self.fleet_router is not None:
                 # fleet front door: fair-queue by the CALLING tenant (a
                 # priced endpoint's external callers compete with each
@@ -2056,18 +2121,70 @@ class Gateway:
                 caller = request.get("workspace")
                 tenant = caller.workspace_id if caller else stub.workspace_id
 
-                async def _fwd(prefer):
-                    return await self.endpoints.forward(
-                        stub, request.method, path, fwd_headers, body,
-                        prefer=prefer)
+                async def _attempt(attempt: int, avoid: set):
+                    hdrs = list(fwd_headers) + [trace_hdr]
+                    rem = ctx.remaining_s()
+                    if rem is not None:
+                        # spent budget is DEDUCTED across attempts —
+                        # the replica sees what is actually left
+                        hdrs.append((sv.BUDGET_HEADER, f"{rem:.3f}"))
 
-                result = await self.fleet_router.submit(stub, tenant, body,
-                                                        _fwd)
+                    async def _fwd(prefer):
+                        return await self.endpoints.forward(
+                            stub, request.method, path, hdrs, body,
+                            prefer=prefer, avoid=avoid or None)
+
+                    return await self.fleet_router.submit(
+                        stub, tenant, body, _fwd,
+                        deadline_mono=ctx.deadline_mono)
+
+                def _on_failover(attempt, failed, delay):
+                    # automatic failover (ISSUE 15): counter + a span on
+                    # the request's existing trace tree; the failed
+                    # replica's affinity entries drop so repeat prefixes
+                    # re-home now
+                    self.fleet_router.signals.failover(
+                        stub.stub_id, reason=f"http_{failed.status}")
+                    if failed.container_id:
+                        self.fleet_router.note_dispatch_failure(
+                            failed.container_id)
+                    now_m = time.monotonic()
+                    tracer.record_span(
+                        "gateway.failover", sp.trace_id, sp.span_id,
+                        time.time(), now_m,
+                        attrs={"stub_id": stub.stub_id,
+                               "workspace_id": stub.workspace_id,
+                               "attempt": attempt,
+                               "failed_status": failed.status,
+                               "failed_replica": failed.container_id or "",
+                               "backoff_s": round(delay, 4)},
+                        end_mono=now_m)
+
+                result = await sv.submit_with_failover(
+                    _attempt, budget, on_failover=_on_failover)
+                if budget.attempt > 1:
+                    self.fleet_router.signals.retry_result(
+                        stub.stub_id, recovered=result.status < 400)
             else:
+                hdrs = list(fwd_headers) + [trace_hdr]
+                rem = ctx.remaining_s()
+                if rem is not None:
+                    hdrs.append((sv.BUDGET_HEADER, f"{rem:.3f}"))
                 result = await self.endpoints.forward(stub, request.method,
-                                                      path, fwd_headers,
+                                                      path, hdrs,
                                                       body)
             sp.attrs["status"] = result.status
+            if budget.attempt > 1:
+                sp.attrs["attempts"] = budget.attempt
+        if ctx.request_id:
+            ctype = next((v for k, v in result.headers
+                          if k.lower() == "content-type"), "")
+            await self.journal.finish(stub.workspace_id, ctx.request_id,
+                                      result.status, result.body,
+                                      attempts=budget.attempt,
+                                      stub_id=stub.stub_id,
+                                      content_type=ctype)
+            ctx.journal_closed = True
         await self.usage.record_request(stub.workspace_id)
         # preserve the container's response headers (ASGI apps set their own
         # content types and custom headers, incl. duplicates like
@@ -2082,65 +2199,389 @@ class Gateway:
         resp.headers.setdefault("Content-Type", "application/json")
         return resp
 
+    async def _journal_gate(self, stub: Stub, ctx,
+                            stream: bool = False) -> Optional[web.Response]:
+        """Idempotency gate for client-supplied request ids (ISSUE 15):
+        None = this caller owns execution; otherwise the dedup response.
+        A retry of an IN-FLIGHT request gets 409 + Retry-After instead of
+        a second execution; a retry of a COMPLETED one gets the stored
+        result replayed (buffered) or a completion summary (streams)."""
+        from . import survival as sv
+        state, rec = await self.journal.begin(stub.workspace_id,
+                                              ctx.request_id,
+                                              stub_id=stub.stub_id)
+        if state == sv.NEW:
+            return None
+        if state == sv.INFLIGHT:
+            resp = web.json_response(
+                {"error": "request already in flight (idempotent retry "
+                          "refused — the original attempt is still "
+                          "executing)",
+                 "request_id": ctx.request_id,
+                 "watermark": rec.get("watermark", 0),
+                 "attempts": rec.get("attempts", 1)}, status=409)
+            resp.headers["Retry-After"] = "1"
+            return resp
+        body = sv.RequestJournal.replay_body(rec)
+        if body is not None and not stream:
+            resp = web.Response(status=int(rec.get("status", 200)),
+                                body=body,
+                                content_type=str(rec.get("ctype", "")
+                                                 or "application/json"))
+            resp.headers[sv.REPLAY_HEADER] = "1"
+            return resp
+        resp = web.json_response(
+            {"error": "request already completed",
+             "request_id": ctx.request_id,
+             "status": rec.get("status", 200),
+             "tokens_delivered": rec.get("watermark", 0),
+             "attempts": rec.get("attempts", 1)}, status=409)
+        resp.headers[sv.REPLAY_HEADER] = "1"
+        return resp
+
     async def _serve_stub_stream(self, request: web.Request, stub: Stub,
                                  path: str, fwd_headers: list,
-                                 body: bytes) -> web.StreamResponse:
+                                 body: bytes, ctx) -> web.StreamResponse:
+        # ctx is REQUIRED: re-minting it from headers here would restart
+        # the monotonic deadline at 'now' and silently grant the full
+        # budget again — the opposite of the deduction invariant
+        try:
+            return await self._serve_stub_stream_inner(
+                request, stub, path, fwd_headers, body, ctx)
+        except BaseException:
+            # same journal hygiene as the buffered path: an escaping
+            # exception must not strand the entry INFLIGHT for the TTL
+            # (journal_closed: never delete a terminal write)
+            if ctx.request_id and not ctx.journal_closed:
+                try:
+                    await self.journal.finish(
+                        stub.workspace_id, ctx.request_id, 500,
+                        stub_id=stub.stub_id)
+                except Exception:   # noqa: BLE001 — best-effort cleanup
+                    pass
+            raise
+
+    async def _serve_stub_stream_inner(self, request: web.Request,
+                                       stub: Stub, path: str,
+                                       fwd_headers: list, body: bytes,
+                                       ctx) -> web.StreamResponse:
         """Incremental relay: container chunks reach the client as they
         are produced (buffer.go:666's streaming proxy role). Used for LLM
         token streams — a buffered proxy would hold every token until the
-        generation finished."""
+        generation finished.
+
+        Survivability (ISSUE 15): for LLM token-stream bodies the relay
+        parses the SSE events it forwards and keeps the token watermark;
+        when the serving replica dies or stalls mid-generation, the
+        stream RESUMES on a healthy replica by replaying
+        ``prompt + delivered`` as a fresh prefill with the budget reduced
+        by the watermark — the client sees one seamless, duplicate-free
+        token sequence. Non-LLM streams keep the legacy single-attempt
+        relay (there is no watermark to splice on)."""
         import aiohttp as _aiohttp
 
         from ..abstractions.common.buffer import ForwardResult
         from ..observability import tracer
-        # the stream-setup span covers admission + placement + connect
-        # (the TTFT-shaped part a stream's caller feels); the engine's own
-        # request span covers the generation that follows. The relay loop
-        # itself is deliberately OUTSIDE — a span held open for a
-        # minutes-long stream would only reach the ring at close.
-        with tracer.span("gateway.invoke",
-                         attrs={"stub_id": stub.stub_id,
-                                "workspace_id": stub.workspace_id,
-                                "method": request.method,
-                                "stream": True}) as sp:
-            fwd_headers = list(fwd_headers)
-            fwd_headers.append(("X-Tpu9-Trace",
-                                f"{sp.trace_id}:{sp.span_id}"))
-            prefer: list = []
+        from ..utils.backoff import BackoffPolicy
+        from . import survival as sv
+
+        rcfg = self.cfg.router
+        llm = sv.parse_llm_stream_body(body) \
+            if self.fleet_router is not None else None
+        resume = sv.StreamResumption(llm["prompt"], llm["max_new"],
+                                     llm["payload"]) if llm else None
+        budget = sv.FailoverBudget(
+            rcfg.failover_max_attempts
+            if (resume is not None
+                and not request.headers.get(sv.NO_RETRY_HEADER)) else 1,
+            BackoffPolicy(base_s=rcfg.failover_backoff_base_s,
+                          max_s=rcfg.failover_backoff_max_s),
+            deadline_mono=ctx.deadline_mono)
+        caller = request.get("workspace")
+        tenant = caller.workspace_id if caller else stub.workspace_id
+        avoid: set = set()
+        sr: Optional[web.StreamResponse] = None
+        trace_ref = ["", ""]           # [trace_id, span_id] for failover
+        finished = False
+        terminal_error = False         # stream ended on a forwarded error
+        last_failure: Optional[sv.AttemptOutcome] = None
+
+        async def _finish_journal(status: int) -> None:
+            if ctx.request_id:
+                await self.journal.finish(
+                    stub.workspace_id, ctx.request_id, status,
+                    watermark=resume.watermark if resume else 0,
+                    attempts=budget.attempt, stub_id=stub.stub_id)
+                ctx.journal_closed = True
+
+        async def _client_error(status: int, payload: dict,
+                                headers=()) -> web.StreamResponse:
+            """Terminal failure: plain response if nothing was sent yet,
+            else an SSE error event on the already-prepared stream."""
+            await _finish_journal(status)
+            if sr is None:
+                resp = web.json_response(payload, status=status)
+                for k, v in headers:
+                    resp.headers[k] = v
+                return resp
+            try:
+                await sr.write(
+                    f"data: {json.dumps(payload)}\n\n".encode())
+                await sr.write_eof()
+            except (ConnectionResetError, OSError) as exc:
+                log.debug("client gone during stream error: %s", exc)
+            return sr
+
+        while True:
+            # all owed tokens already delivered — or the generation
+            # visibly ENDED (client-declared eos_id as the last token) —
+            # but the terminal event was lost with the replica:
+            # synthesize completion, no replay (replaying past EOS would
+            # mint tokens the unfailed stream never produces)
+            if resume is not None and budget.attempt > 1 \
+                    and (resume.remaining == 0 or resume.ended_on_eos):
+                finished = True
+                break
+            attempt_body = resume.resume_payload() \
+                if (resume is not None and budget.attempt > 1) else body
+            hdrs = list(fwd_headers)
+            rem = ctx.remaining_s()
+            if rem is not None:
+                if rem <= 0:
+                    return await _client_error(
+                        504, {"error": "deadline_exceeded: budget "
+                                       "exhausted at the gateway"})
+                hdrs.append((sv.BUDGET_HEADER, f"{rem:.3f}"))
+
+            if budget.attempt == 1:
+                # the stream-setup span covers admission + placement +
+                # connect (the TTFT-shaped part a stream's caller feels);
+                # the relay loop stays OUTSIDE — a span held open for a
+                # minutes-long stream would only reach the ring at close.
+                # Resume attempts parent onto this same context.
+                span_cm = tracer.span("gateway.invoke",
+                                      attrs={"stub_id": stub.stub_id,
+                                             "workspace_id":
+                                             stub.workspace_id,
+                                             "method": request.method,
+                                             "stream": True})
+            else:
+                span_cm = None
+            sp = span_cm.__enter__() if span_cm is not None else None
+            try:
+                if sp is not None:
+                    trace_ref[0], trace_ref[1] = sp.trace_id, sp.span_id
+                hdrs.append(("X-Tpu9-Trace",
+                             f"{trace_ref[0]}:{trace_ref[1]}"))
+                prefer: list = []
+                if self.fleet_router is not None:
+                    # streams skip the fair queue (a token stream holds
+                    # its replica for minutes) but still shed at the door
+                    # and carry the router's affinity preference; their
+                    # budget slot rides the handle's lifetime via on_close
+                    shed, prefer = await self.fleet_router.admit_stream(
+                        stub, tenant, attempt_body,
+                        deadline_mono=ctx.deadline_mono)
+                    if shed is not None:
+                        # usage records for sheds on BOTH paths: metrics/
+                        # billing must not diverge between buffered and
+                        # streaming for identical client behavior (first
+                        # attempt only — failover re-admissions are
+                        # gateway-initiated, not billable)
+                        if budget.attempt == 1:
+                            await self.usage.record_request(
+                                stub.workspace_id)
+                        if sp is not None:
+                            sp.attrs["status"] = shed.status
+                        return await _client_error(
+                            shed.status, json.loads(shed.body),
+                            headers=shed.headers)
+                handle = await self.endpoints.forward_stream(
+                    stub, request.method, path, hdrs, attempt_body,
+                    prefer=prefer, avoid=avoid or None,
+                    # the per-chunk gap bound only applies to RESUMABLE
+                    # streams — the relay recovers from the timeout; a
+                    # legacy stream keeps the full request budget so a
+                    # legitimately quiet app is never truncated
+                    gap_s=rcfg.stream_gap_s if resume is not None
+                    else None)
+                if sp is not None:
+                    sp.attrs["status"] = getattr(handle, "status", 0)
+            finally:
+                if span_cm is not None:
+                    span_cm.__exit__(None, None, None)
+            # usage records ONCE per client request (first attempt) —
+            # gateway-initiated failover attempts must not inflate the
+            # tenant's billing (the buffered path bills once too)
+            if budget.attempt == 1:
+                await self.usage.record_request(stub.workspace_id)
+
+            if isinstance(handle, ForwardResult):
+                failed = sv.AttemptOutcome(
+                    kind="failed", reason=f"connect_{handle.status}",
+                    replica=handle.container_id, error_body=handle.body)
+                verdict = sv.classify_result(handle.status, handle.body)
+            elif handle.status >= 400:
+                # connected but the replica refused (engine dead → 500,
+                # booting → 503): drain the small error body for the
+                # classifier, then treat like a connect failure
+                err = b""
+                try:
+                    async for chunk in handle.iter_chunks():
+                        err += chunk
+                        if len(err) > 4096:
+                            break
+                except (ConnectionResetError, OSError, _aiohttp.ClientError,
+                        asyncio.TimeoutError):
+                    pass
+                await handle.close()
+                failed = sv.AttemptOutcome(
+                    kind="failed", reason=f"http_{handle.status}",
+                    replica=handle.container_id, error_body=err)
+                verdict = sv.classify_result(handle.status, err)
+            else:
+                if self.fleet_router is not None and handle.container_id:
+                    handle.on_close = self.fleet_router.stream_started(
+                        stub, attempt_body, handle.container_id)
+                if resume is None:
+                    # legacy verbatim relay (non-LLM streams): single
+                    # attempt, bytes forwarded untouched. The journal
+                    # entry still closes — leaving it INFLIGHT would
+                    # 409 every retry of this id for the whole TTL
+                    out = await self._relay_stream_legacy(request, handle)
+                    await _finish_journal(getattr(handle, "status", 200))
+                    return out
+                if sr is None:
+                    sr = web.StreamResponse(status=handle.status)
+                    skip = {"connection", "transfer-encoding",
+                            "content-length", "server", "date",
+                            "content-encoding"}
+                    for k, v in handle.headers:
+                        if k.lower() not in skip:
+                            sr.headers.add(k, v)
+                    try:
+                        await sr.prepare(request)
+                    except (ConnectionResetError, OSError) as exc:
+                        log.debug("client gone before stream start: %s",
+                                  exc)
+                        await handle.close()
+                        await _finish_journal(499)
+                        return sr
+                outcome = await self._relay_stream_events(
+                    handle, resume, sr)
+                await handle.close()
+                if outcome.kind == "done":
+                    finished = True
+                    terminal_error = outcome.reason == "error_event"
+                    break
+                if outcome.kind == "client_gone":
+                    await _finish_journal(499)
+                    return sr
+                failed = outcome
+                verdict = sv.RETRYABLE
+
+            # ---- failover decision -------------------------------------
+            last_failure = failed
+            budget.note_failure()
+            delay = budget.next_delay() if verdict == sv.RETRYABLE else None
+            if delay is None:
+                if self.fleet_router is not None and budget.attempt > 1:
+                    self.fleet_router.signals.retry_result(
+                        stub.stub_id, recovered=False)
+                status = 502 if failed.kind == "failed" else 500
+                if failed.reason.startswith(("connect_", "http_")):
+                    try:
+                        status = int(failed.reason.split("_", 1)[1])
+                    except ValueError:
+                        pass
+                payload = None
+                if failed.error_body:
+                    try:
+                        payload = json.loads(failed.error_body)
+                    except ValueError:
+                        payload = {"error": failed.error_body.decode(
+                            errors="replace")[:500]}
+                if verdict != sv.RETRYABLE and payload is not None:
+                    # non-retryable upstream error (request shape, app
+                    # 4xx): forward the ORIGINAL status + body verbatim
+                    # — the legacy relay's contract; a generic
+                    # "failover exhausted" message here would bury the
+                    # actual diagnostic
+                    return await _client_error(status, payload)
+                out_payload = {
+                    "error": "stream failed and failover budget "
+                             f"exhausted ({failed.reason})",
+                    "attempts": budget.attempt,
+                    "tokens_delivered": resume.watermark
+                    if resume else 0}
+                if payload is not None:
+                    out_payload["last_error"] = payload.get(
+                        "error", payload) if isinstance(payload, dict) \
+                        else payload
+                return await _client_error(status, out_payload)
+            if failed.replica:
+                avoid.add(failed.replica)
             if self.fleet_router is not None:
-                # streams skip the fair queue (a token stream holds its
-                # replica for minutes) but still shed at the door and carry
-                # the router's affinity preference; their budget slot rides
-                # the handle's lifetime via on_close
-                caller = request.get("workspace")
-                tenant = caller.workspace_id if caller else stub.workspace_id
-                shed, prefer = await self.fleet_router.admit_stream(
-                    stub, tenant, body)
-                if shed is not None:
-                    # usage records for sheds on BOTH paths: the buffered
-                    # one records its 429/503s below, and metrics/billing
-                    # must not diverge between the two for identical
-                    # client behavior
-                    await self.usage.record_request(stub.workspace_id)
-                    sp.attrs["status"] = shed.status
-                    resp = web.Response(status=shed.status, body=shed.body)
-                    for k, v in shed.headers:
-                        resp.headers[k] = v
-                    return resp
-            handle = await self.endpoints.forward_stream(
-                stub, request.method, path, fwd_headers, body,
-                prefer=prefer)
-            sp.attrs["status"] = getattr(handle, "status", 0)
-        # usage records for every forwarded attempt, success or failure —
-        # the buffered path does, and metrics/billing must not diverge
-        # between the two for identical client behavior
-        await self.usage.record_request(stub.workspace_id)
-        if isinstance(handle, ForwardResult):
-            return web.Response(status=handle.status, body=handle.body,
-                                content_type="application/json")
-        if self.fleet_router is not None and handle.container_id:
-            handle.on_close = self.fleet_router.stream_started(
-                stub, body, handle.container_id)
+                self.fleet_router.signals.failover(stub.stub_id,
+                                                   reason=failed.reason)
+                if failed.replica:
+                    self.fleet_router.note_dispatch_failure(failed.replica)
+            if trace_ref[0]:
+                now_m = time.monotonic()
+                tracer.record_span(
+                    "gateway.failover", trace_ref[0], trace_ref[1],
+                    time.time(), now_m,
+                    attrs={"stub_id": stub.stub_id,
+                           "workspace_id": stub.workspace_id,
+                           "attempt": budget.attempt,
+                           "reason": failed.reason,
+                           "failed_replica": failed.replica,
+                           "watermark": resume.watermark if resume else 0,
+                           "backoff_s": round(delay, 4)},
+                    end_mono=now_m)
+            if ctx.request_id and resume is not None:
+                await self.journal.update(stub.workspace_id,
+                                          ctx.request_id,
+                                          resume.watermark, budget.attempt,
+                                          stub_id=stub.stub_id)
+            log.warning(
+                "stream failover for %s: attempt %d, reason=%s, "
+                "watermark=%d, replica=%s", stub.stub_id, budget.attempt,
+                failed.reason, resume.watermark if resume else 0,
+                failed.replica or "?")
+            await asyncio.sleep(delay)
+
+        # ---- terminal: one seamless done event (or the forwarded error) --
+        if self.fleet_router is not None and budget.attempt > 1:
+            self.fleet_router.signals.retry_result(
+                stub.stub_id, recovered=not terminal_error)
+        # an error-terminal stream (deadline/app error forwarded to the
+        # client) must not journal as a completed 200 — finish(500)
+        # clears the entry so a retry with this id executes afresh
+        await _finish_journal(500 if terminal_error else 200)
+        if sr is None:
+            # finished before anything streamed (resume.remaining == 0 on
+            # a zero-attempt splice) — degenerate but possible
+            sr = web.StreamResponse(status=200)
+            sr.headers["Content-Type"] = "text/event-stream"
+            try:
+                await sr.prepare(request)
+            except (ConnectionResetError, OSError):
+                return sr
+        try:
+            if resume is not None and finished and not terminal_error:
+                await sr.write(
+                    f"data: {json.dumps(resume.done_event())}\n\n"
+                    .encode())
+            await sr.write_eof()
+        except (ConnectionResetError, OSError) as exc:
+            log.debug("client gone at stream end: %s", exc)
+        return sr
+
+    async def _relay_stream_legacy(self, request: web.Request,
+                                   handle) -> web.StreamResponse:
+        """Pre-ISSUE-15 verbatim relay for non-resumable streams."""
+        import aiohttp as _aiohttp
         sr = web.StreamResponse(status=handle.status)
         skip = {"connection", "transfer-encoding", "content-length",
                 "server", "date", "content-encoding"}
@@ -2161,6 +2602,74 @@ class Gateway:
         finally:
             await handle.close()
         return sr
+
+    async def _relay_stream_events(self, handle, resume,
+                                   sr: web.StreamResponse):
+        """Event-aware relay for one attempt of a resumable LLM stream:
+        forward token events (advancing the watermark), swallow the
+        attempt's own done/error events (the terminal event is owned by
+        the failover loop — a resumed attempt's done only knows its own
+        suffix), and classify how the attempt ended."""
+        import aiohttp as _aiohttp
+        from . import survival as sv
+        parser = sv.SseParser()
+        it = handle.iter_chunks().__aiter__()
+        while True:
+            try:
+                chunk = await it.__anext__()
+            except StopAsyncIteration:
+                # upstream closed without a terminal event: the replica
+                # (or its runner process) died mid-stream
+                return sv.AttemptOutcome(kind="failed",
+                                         reason="stream_eof",
+                                         replica=handle.container_id)
+            except asyncio.TimeoutError:
+                return sv.AttemptOutcome(kind="failed",
+                                         reason="stream_gap",
+                                         replica=handle.container_id)
+            except (ConnectionResetError, OSError,
+                    _aiohttp.ClientError) as exc:
+                return sv.AttemptOutcome(
+                    kind="failed", reason=f"transport_"
+                    f"{type(exc).__name__}", replica=handle.container_id)
+            for ev in parser.feed(chunk):
+                if "token" in ev:
+                    resume.note_token(ev["token"])
+                    try:
+                        await sr.write(
+                            f"data: {json.dumps({'token': ev['token']})}"
+                            "\n\n".encode())
+                    except (ConnectionResetError, OSError) as exc:
+                        log.debug("client gone mid-stream: %s", exc)
+                        return sv.AttemptOutcome(kind="client_gone")
+                elif ev.get("done"):
+                    return sv.AttemptOutcome(kind="done")
+                elif "error" in ev:
+                    msg = str(ev.get("error", ""))
+                    if sv.classify_result(
+                            500, msg.encode()) == sv.RETRYABLE:
+                        return sv.AttemptOutcome(
+                            kind="failed", reason="engine_error",
+                            replica=handle.container_id,
+                            error_body=msg.encode())
+                    # non-retryable engine error (deadline, request
+                    # shape): surface it verbatim and end the stream
+                    try:
+                        await sr.write(
+                            f"data: {json.dumps(ev)}\n\n".encode())
+                    except (ConnectionResetError, OSError):
+                        return sv.AttemptOutcome(kind="client_gone")
+                    return sv.AttemptOutcome(kind="done",
+                                             reason="error_event")
+                else:
+                    # unknown/raw frame: forward untouched
+                    raw = ev.get("_raw")
+                    out = raw + b"\n\n" if raw else \
+                        f"data: {json.dumps(ev)}\n\n".encode()
+                    try:
+                        await sr.write(out)
+                    except (ConnectionResetError, OSError):
+                        return sv.AttemptOutcome(kind="client_gone")
 
     async def _ws_proxy(self, stub: Stub, request: web.Request) -> web.StreamResponse:
         """Bidirectional websocket proxy for @realtime deployments
@@ -2185,7 +2694,13 @@ class Gateway:
                 if self._proxy_session is None or self._proxy_session.closed:
                     self._proxy_session = _aiohttp.ClientSession()
                 async with self._proxy_session.ws_connect(
-                        f"http://{address}/") as ws_upstream:
+                        f"http://{address}/",
+                        # bounds the websocket CLOSE handshake (TMO001);
+                        # the session itself is deliberately unbounded —
+                        # realtime sockets live for hours
+                        timeout=_aiohttp.ClientWSTimeout(
+                            ws_close=self.cfg.router.rpc_timeout_s)
+                        ) as ws_upstream:
 
                     async def pump_up():
                         async for msg in ws_client:

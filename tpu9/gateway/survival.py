@@ -1,0 +1,417 @@
+"""Request survivability (ISSUE 15): deadline propagation, automatic
+failover, and mid-stream resumption state for the gateway invoke paths.
+
+The serverless premise only holds if the *request* survives the replica:
+PR 14's health plane detects a dead/stalled replica and routes new work
+around it, but everything in flight there still died with it. This
+module is the recovery half —
+
+- **Deadlines**: a client budget (``X-Tpu9-Budget-S``, relative seconds)
+  becomes one monotonic deadline at ingest; every retry attempt forwards
+  the *remaining* budget, so spent time is deducted, never reset.
+- **Transparent retry** (buffered path): :func:`submit_with_failover`
+  re-submits a failed dispatch through the router with jittered
+  exponential backoff, a total-attempts budget, and the failed replica
+  excluded from placement.
+- **Mid-stream resumption** (SSE path): :class:`StreamResumption` holds
+  the token watermark — tokens already delivered to the client — and
+  builds the replay request (``prompt + delivered`` as the new prefill,
+  budget reduced by the watermark). The prefix cache makes the replay
+  cheap on any replica that has seen the prefix; the watermark guarantees
+  the client never sees a duplicated or skipped token across the splice.
+- **Idempotency journal**: a store-backed per-request entry (request id,
+  watermark, attempt count) so a *client-initiated* retry of an
+  in-flight or completed request attaches to the journal instead of
+  double-executing — the race the router's queue-wait deadline comment
+  has called out since PR 2.
+
+Everything here is pure bookkeeping over plain types (the unit-testable
+core); the gateway's ``_serve_stub``/``_serve_stub_stream`` own the
+actual HTTP/relay plumbing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+from ..utils.backoff import BackoffPolicy
+
+BUDGET_HEADER = "X-Tpu9-Budget-S"
+REQUEST_ID_HEADER = "X-Tpu9-Request-Id"
+REPLAY_HEADER = "X-Tpu9-Replayed"
+# client opt-out of gateway-initiated retries: non-idempotent handlers
+# (a POST with side effects outside the serverless idempotent-handler
+# contract) set this to guarantee at-most-once dispatch
+NO_RETRY_HEADER = "X-Tpu9-No-Retry"
+
+# engine-side deadline error prefix (serving.engine raises it; the runner
+# maps it to 504; classify() treats it as final — the budget is SPENT,
+# retrying would only burn chips on an answer the client stopped waiting
+# for)
+DEADLINE_ERROR = "deadline_exceeded"
+
+OK, RETRYABLE, FATAL = "ok", "retryable", "fatal"
+
+
+def parse_budget_s(raw: str) -> float:
+    """Header value → relative budget seconds (0.0 = absent/invalid —
+    an unparseable budget must not take the request down with a 400:
+    the header is an optimization, not part of the request body)."""
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        return 0.0
+    if v != v:                     # NaN: garbage, not a zero budget
+        return 0.0
+    return v if v > 0 else -1.0 if raw else 0.0
+
+
+@dataclass
+class RequestContext:
+    """Per-request survivability state threaded through every attempt."""
+    request_id: str = ""
+    deadline_mono: float = 0.0     # 0 = no deadline
+    # set once the journal entry reached a TERMINAL write: the gateway's
+    # escape-hatch cleanup (exception/cancellation between begin and
+    # finish) must clear only still-INFLIGHT entries — deleting a DONE
+    # entry because the CLIENT disconnected after completion would let
+    # its retry double-execute
+    journal_closed: bool = False
+
+    @classmethod
+    def from_headers(cls, headers, request_id: str = "") -> "RequestContext":
+        budget = parse_budget_s(headers.get(BUDGET_HEADER, ""))
+        deadline = 0.0
+        if budget > 0:
+            deadline = time.monotonic() + budget
+        elif budget < 0:
+            deadline = time.monotonic()    # explicit non-positive budget:
+            #                                already expired at the door
+        return cls(request_id=request_id
+                   or headers.get(REQUEST_ID_HEADER, ""),
+                   deadline_mono=deadline)
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline_mono <= 0:
+            return None
+        return self.deadline_mono - time.monotonic()
+
+    def expired(self) -> bool:
+        r = self.remaining_s()
+        return r is not None and r <= 0
+
+
+def classify_result(status: int, body: bytes = b"") -> str:
+    """Is this ForwardResult worth a failover attempt?
+
+    - ``502`` — transport-class failure (replica crash mid-request, RPC
+      reset, drain-timeout kill): retry.
+    - ``503`` with a runner "not ready" body — the container exists but
+      its engine is dead/booting: retry (placement will avoid it).
+    - ``500`` naming an engine failure — the serve loop died under this
+      request: retry on another replica.
+    - Everything else is final: router sheds (429/503 + Retry-After) are
+      the CLIENT's retry contract, 4xx are the request's own fault, 504
+      means a budget was already spent, and 200s are 200s.
+    """
+    if status < 400:
+        return OK
+    if status == 502:
+        return RETRYABLE
+    if status == 503 and b"not ready" in body:
+        return RETRYABLE
+    if status == 500 and (b"engine is dead" in body
+                          or b"engine failure" in body
+                          or b"engine stopped" in body):
+        # "engine stopped" is the drain-timeout kill: the replica was
+        # scaled down with this request still on it
+        return RETRYABLE
+    return FATAL
+
+
+class FailoverBudget:
+    """Attempt + backoff accounting for one request. ``attempt`` is the
+    1-based number of the attempt currently in flight."""
+
+    def __init__(self, max_attempts: int, backoff: BackoffPolicy,
+                 deadline_mono: float = 0.0, rng=None):
+        self.max_attempts = max(int(max_attempts), 1)
+        self.backoff = backoff
+        self.deadline_mono = deadline_mono
+        self.rng = rng
+        self.attempt = 1
+        self.first_failure_mono = 0.0
+
+    def note_failure(self) -> None:
+        if self.first_failure_mono == 0.0:
+            self.first_failure_mono = time.monotonic()
+
+    def next_delay(self) -> Optional[float]:
+        """Consume one retry: the backoff delay before the next attempt,
+        or None when the attempts budget (or the deadline) is exhausted.
+        The delay is clamped so a retry never sleeps past the deadline."""
+        if self.attempt >= self.max_attempts:
+            return None
+        d = self.backoff.delay(self.attempt - 1, self.rng)
+        if self.deadline_mono > 0:
+            remaining = self.deadline_mono - time.monotonic()
+            if remaining <= 0:
+                return None
+            d = min(d, max(remaining - 0.001, 0.0))
+        self.attempt += 1
+        return d
+
+
+async def submit_with_failover(
+        attempt_fn: Callable[[int, set], Awaitable[Any]],
+        budget: FailoverBudget,
+        classify: Callable[[int, bytes], str] = classify_result,
+        on_failover: Optional[Callable[[int, Any, float], None]] = None,
+        sleep: Callable[[float], Awaitable[None]] = asyncio.sleep):
+    """Drive ``attempt_fn(attempt, avoid)`` until it returns a
+    non-retryable ForwardResult or the budget runs out. ``avoid``
+    accumulates replicas observed failing (the buffer deprioritizes
+    them); ``on_failover(next_attempt, failed_result, delay)`` fires
+    once per retry for spans/counters. Returns the final result — on
+    exhaustion, the LAST failure (honest, not a synthesized 200)."""
+    avoid: set[str] = set()
+    while True:
+        result = await attempt_fn(budget.attempt, avoid)
+        if classify(result.status, result.body) != RETRYABLE:
+            return result
+        budget.note_failure()
+        delay = budget.next_delay()
+        if delay is None:
+            return result
+        if getattr(result, "container_id", ""):
+            avoid.add(result.container_id)
+        if on_failover is not None:
+            on_failover(budget.attempt, result, delay)
+        await sleep(delay)
+
+
+# -- SSE / stream resumption --------------------------------------------------
+
+class SseParser:
+    """Incremental server-sent-event parser for the runner's token
+    stream: feed raw relay chunks, get parsed ``data:`` JSON events.
+    Non-JSON frames are surfaced as ``{"_raw": <bytes>}`` so the relay
+    can still forward what it does not understand."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> list[dict]:
+        self._buf += chunk
+        events: list[dict] = []
+        while b"\n\n" in self._buf:
+            frame, self._buf = self._buf.split(b"\n\n", 1)
+            frame = frame.strip()
+            if not frame:
+                continue
+            if frame.startswith(b"data: "):
+                try:
+                    events.append(json.loads(frame[6:]))
+                    continue
+                except ValueError:
+                    pass
+            events.append({"_raw": frame})
+        return events
+
+
+def parse_llm_stream_body(body: bytes) -> Optional[dict]:
+    """``{"prompt": [...ints], "max_new": N, "payload": {...}}`` when the
+    request is a resumable LLM token-stream body, else None (non-LLM
+    streams fall back to single-attempt relay — there is no watermark to
+    splice on)."""
+    try:
+        payload = json.loads(body)
+        tokens = payload.get("tokens") or payload.get("prompt_tokens")
+        if not isinstance(tokens, list) or not tokens:
+            return None
+        prompt = [int(t) for t in tokens]
+        max_new = int(payload.get("max_new_tokens", 32))
+    except (ValueError, TypeError, AttributeError):
+        return None
+    if max_new <= 0:
+        return None
+    return {"prompt": prompt, "max_new": max_new, "payload": payload}
+
+
+class StreamResumption:
+    """Token-watermark bookkeeping for one SSE generation.
+
+    The watermark is the number of generated tokens the CLIENT has been
+    sent. A resume attempt replays ``prompt + delivered`` as a fresh
+    prefill (cheap on any replica holding the prefix in its prefix
+    cache) with the generation budget reduced by the watermark — so the
+    spliced stream continues exactly one token after the last one the
+    client saw: no duplicates, no gaps, regardless of how far ahead of
+    the relay the dead replica had decoded."""
+
+    def __init__(self, prompt: list[int], max_new: int,
+                 payload: Optional[dict] = None):
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.payload = dict(payload or {})
+        self.delivered: list[int] = []
+        self.finished = False        # saw a done event
+
+    @property
+    def watermark(self) -> int:
+        return len(self.delivered)
+
+    @property
+    def remaining(self) -> int:
+        return max(self.max_new - self.watermark, 0)
+
+    def note_token(self, tok: int) -> None:
+        self.delivered.append(int(tok))
+
+    @property
+    def ended_on_eos(self) -> bool:
+        """True when the last delivered token is the request's declared
+        EOS — the generation FINISHED even though the replica died
+        before its done event. Only knowable when the client declared
+        ``eos_id`` in the request payload; an engine-config EOS the
+        gateway cannot see is a documented resume limitation (a resumed
+        attempt would sample past it)."""
+        try:
+            eos = int(self.payload.get("eos_id", -1))
+        except (TypeError, ValueError):
+            return False
+        return eos >= 0 and bool(self.delivered) \
+            and self.delivered[-1] == eos
+
+    def resume_payload(self) -> bytes:
+        """Request body for the next attempt: delivered tokens join the
+        prompt, budget is what is still owed."""
+        out = dict(self.payload)
+        out.pop("prompt_tokens", None)
+        out["tokens"] = self.prompt + self.delivered
+        out["max_new_tokens"] = self.remaining
+        out["stream"] = True
+        return json.dumps(out).encode()
+
+    def done_event(self) -> dict:
+        """The client-facing terminal event: the FULL generated sequence
+        (a resumed attempt's own done event only knows its fresh suffix)."""
+        self.finished = True
+        return {"done": True, "tokens": list(self.delivered)}
+
+
+# -- idempotency journal ------------------------------------------------------
+
+NEW, INFLIGHT, DONE = "new", "inflight", "done"
+
+
+class RequestJournal:
+    """Store-backed per-request journal keyed by the client's
+    ``X-Tpu9-Request-Id``. ``begin`` is a compare-and-set so two
+    concurrent submits of the same id resolve to exactly one executor;
+    the loser (and any later client retry) sees the journal state
+    instead of re-executing. Completed entries retain small response
+    bodies for true replay; larger ones dedupe with a summary."""
+
+    def __init__(self, store, ttl_s: float = 600.0,
+                 body_cap: int = 65536):
+        self.store = store
+        self.ttl_s = ttl_s
+        self.body_cap = body_cap
+
+    @staticmethod
+    def _key(workspace_id: str, request_id: str,
+             stub_id: str = "") -> str:
+        # scoped per DEPLOYMENT too: the same client id against two
+        # different stubs is two different requests — without the stub
+        # in the key, stub B's request would replay stub A's response
+        return f"reqjournal:{workspace_id}:{stub_id}:{request_id}"
+
+    async def begin(self, workspace_id: str, request_id: str,
+                    stub_id: str = "") -> tuple[str, dict]:
+        """(state, record): ``new`` = this caller owns execution;
+        ``inflight`` = another attempt is executing; ``done`` = the
+        request already completed (record carries the replay)."""
+        key = self._key(workspace_id, request_id, stub_id)
+        rec = {"state": INFLIGHT, "watermark": 0, "attempts": 1,
+               "ts": time.time()}
+        if await self.store.cas(key, None, rec, ttl=self.ttl_s):
+            return NEW, rec
+        cur = await self.store.get(key)
+        if cur is None:
+            # expired between cas and get: take ownership via a SECOND
+            # cas — an unconditional set here would let two racers both
+            # win and double-execute, the exact race the journal exists
+            # to close
+            if await self.store.cas(key, None, rec, ttl=self.ttl_s):
+                return NEW, rec
+            cur = await self.store.get(key)
+            if cur is None:
+                # pathological churn (entry expiring faster than we can
+                # read it): refuse ownership — a spurious 409 beats a
+                # double execution
+                return INFLIGHT, rec
+        if cur.get("state") == DONE:
+            return DONE, cur
+        return INFLIGHT, cur
+
+    async def update(self, workspace_id: str, request_id: str,
+                     watermark: int, attempts: int,
+                     stub_id: str = "") -> None:
+        """Record a failover: watermark + attempt count (the evidence a
+        post-incident 'did my stream duplicate tokens' query needs)."""
+        key = self._key(workspace_id, request_id, stub_id)
+        await self.store.set(key, {"state": INFLIGHT,
+                                   "watermark": int(watermark),
+                                   "attempts": int(attempts),
+                                   "ts": time.time()}, ttl=self.ttl_s)
+
+    async def finish(self, workspace_id: str, request_id: str,
+                     status: int, body: bytes = b"",
+                     watermark: int = 0, attempts: int = 1,
+                     stub_id: str = "", content_type: str = "") -> None:
+        """Close the entry. Only outcomes worth REPLAYING are kept as
+        DONE: successes and deterministic client errors. Sheds (429),
+        gateway 5xx and spent-budget 504s CLEAR the entry instead — the
+        client was explicitly told to retry (Retry-After) or will retry
+        with a fresh budget, and pinning the stale failure under its
+        request id for the whole TTL would make that retry replay the
+        failure instead of executing."""
+        key = self._key(workspace_id, request_id, stub_id)
+        if status >= 500 or status in (429, 499):
+            await self.store.delete(key)
+            return
+        rec: dict = {"state": DONE, "status": int(status),
+                     "watermark": int(watermark),
+                     "attempts": int(attempts), "ts": time.time()}
+        if body and len(body) <= self.body_cap:
+            rec["body_b64"] = base64.b64encode(body).decode()
+            if content_type:
+                # the replay must not re-label a text/csv body as JSON
+                rec["ctype"] = content_type
+        await self.store.set(key, rec, ttl=self.ttl_s)
+
+    @staticmethod
+    def replay_body(rec: dict) -> Optional[bytes]:
+        raw = rec.get("body_b64")
+        if not raw:
+            return None
+        try:
+            return base64.b64decode(raw)
+        except (ValueError, TypeError):
+            return None
+
+
+@dataclass
+class AttemptOutcome:
+    """What one stream attempt ended as — the relay loop's verdict."""
+    kind: str                      # "done" | "failed" | "client_gone"
+    reason: str = ""
+    replica: str = ""
+    error_body: bytes = b""
+    extras: dict = field(default_factory=dict)

@@ -207,11 +207,18 @@ class AdmissionController:
         """True once the replica's in-flight count reaches zero (its
         requests completed); False if the timeout elapsed first — the
         caller stops the container anyway, in-flight requests get 502s
-        like any container death and the buffer's retry semantics apply."""
+        like any container death and the gateway failover's retry
+        semantics apply. Event-driven on budget releases; the lost-
+        wakeup fallback poll ramps 20→250 ms via the shared backoff
+        helper (ISSUE 15 satellite) instead of a fixed 250 ms spin."""
+        from ..utils.backoff import BackoffPolicy
         deadline = time.monotonic() + timeout
+        delays = BackoffPolicy(base_s=0.02, factor=2.0, max_s=0.25,
+                               jitter=0.0).delays()
         while time.monotonic() < deadline:
             if self.budgets.inflight(container_id) == 0:
                 return True
             await self.budgets.wait_release(
-                min(0.25, max(deadline - time.monotonic(), 0.01)))
+                min(next(delays),
+                    max(deadline - time.monotonic(), 0.01)))
         return self.budgets.inflight(container_id) == 0

@@ -64,6 +64,17 @@ def _shed_result(status: int, error: str, retry_after_s: float) -> ForwardResult
                  ("Content-Type", "application/json")])
 
 
+def _deadline_result() -> ForwardResult:
+    """Request already past its propagated deadline (ISSUE 15): 504
+    without Retry-After — the budget is SPENT, a retry would only burn
+    chips on an answer the client stopped waiting for."""
+    return ForwardResult(
+        status=504,
+        body=json.dumps({"error": "deadline_exceeded: budget exhausted "
+                                  "at the front door"}).encode(),
+        headers=[("Content-Type", "application/json")])
+
+
 @dataclass
 class _Pending:
     body: bytes
@@ -253,16 +264,26 @@ class FleetRouter:
     # -- submit (buffered path) ------------------------------------------------
 
     async def submit(self, stub: Stub, tenant: str, body: bytes,
-                     forward: Callable[[list], Awaitable[ForwardResult]]
-                     ) -> ForwardResult:
+                     forward: Callable[[list], Awaitable[ForwardResult]],
+                     deadline_mono: float = 0.0) -> ForwardResult:
         """Admit → fair-queue → dispatch → forward. ``forward`` receives
         the router's replica preference order (container ids, best first)
-        and performs the actual buffer forward."""
+        and performs the actual buffer forward.
+
+        ``deadline_mono`` (ISSUE 15): the request's propagated monotonic
+        deadline — already-expired requests are answered 504 at the door
+        (never queued, never dispatched), and the queue-wait budget is
+        clamped to the remaining budget so the fair queue cannot hold a
+        request past its own deadline."""
         ctx = tracer.context()          # gateway.invoke, when routed via HTTP
         st = self._state(stub)
         if st is None:                  # racing shutdown
             return _shed_result(503, "gateway shutting down",
                                 self.cfg.shed_retry_after_s)
+        if deadline_mono > 0 and time.monotonic() >= deadline_mono:
+            self.signals.shed(stub.stub_id, tenant, "deadline")
+            self._adm_span(ctx, stub, tenant, "shed", reason="deadline")
+            return _deadline_result()
         if self.admission.should_shed(st.queue.depth):
             # no store reads on the reject path: shedding must stay cheap
             # under exactly the burst that triggers it
@@ -286,6 +307,9 @@ class FleetRouter:
                        "workspace_id": stub.workspace_id, "tenant": tenant})
         wait_budget = min(self.cfg.max_queue_wait_s,
                           max(stub.config.timeout_s, 1.0))
+        if deadline_mono > 0:
+            wait_budget = min(wait_budget,
+                              max(deadline_mono - time.monotonic(), 0.01))
         req = QueuedRequest(tenant=tenant, cost=estimate_cost(body),
                             item=pending, future=loop.create_future(),
                             deadline=time.monotonic() + wait_budget)
@@ -316,7 +340,8 @@ class FleetRouter:
 
     # -- streaming path --------------------------------------------------------
 
-    async def admit_stream(self, stub: Stub, tenant: str, body: bytes
+    async def admit_stream(self, stub: Stub, tenant: str, body: bytes,
+                           deadline_mono: float = 0.0
                            ) -> tuple[Optional[ForwardResult], list[str]]:
         """Shed check + preference order for a streaming request.
         Returns (shed_response, prefer): shed_response is None when
@@ -327,6 +352,11 @@ class FleetRouter:
         if st is None:                  # racing shutdown
             return (_shed_result(503, "gateway shutting down",
                                  self.cfg.shed_retry_after_s), [])
+        if deadline_mono > 0 and time.monotonic() >= deadline_mono:
+            self.signals.shed(stub.stub_id, tenant, "deadline")
+            self._adm_span(ctx, stub, tenant, "shed", reason="deadline",
+                           stream=True)
+            return (_deadline_result(), [])
         if self.admission.should_shed(st.queue.depth):
             ra = self.admission.retry_after_s(stub.stub_id, st.queue.depth,
                                               max(st.replica_count, 1))
@@ -397,6 +427,16 @@ class FleetRouter:
             self.admission.clear_stalled(container_id)
             log.warning("replica %s health=%s — restored to routing",
                         container_id, state or "ok")
+
+    def note_dispatch_failure(self, container_id: str) -> None:
+        """A dispatched request FAILED on this replica (gateway failover,
+        ISSUE 15): drop its affinity entries so repeat-prefix traffic
+        re-homes now instead of riding the TTL back into the same
+        failure. Deliberately NOT a routing ejection — one failed request
+        is not a stall verdict; eligibility stays the health plane's call
+        (`note_replica_health`), this only stops steering warm prefixes
+        at a replica that just dropped one."""
+        self.affinity.forget_replica(container_id)
 
     # -- drain -----------------------------------------------------------------
 

@@ -50,6 +50,24 @@ class RouterSignals:
         metrics.inc("tpu9_router_shed_total",
                     labels={"stub": stub_id, "reason": reason})
 
+    def failover(self, stub_id: str, reason: str) -> None:
+        """One automatic failover attempt (ISSUE 15): a dispatched
+        request failed (replica crash / transport error / stall) and the
+        gateway is re-submitting it. Failovers are the fleet's honest
+        instability signal — a rising rate with a flat shed rate means
+        replicas are dying under requests, not capacity running out."""
+        metrics.inc("tpu9_router_failover_total",
+                    labels={"stub": stub_id, "reason": reason})
+
+    def retry_result(self, stub_id: str, recovered: bool) -> None:
+        """Terminal accounting for a request that needed ≥1 failover:
+        did the retries save it? ``recovered_total`` staying equal to
+        ``exhausted_total + recovered_total``'s recovered share is the
+        zero-client-visible-failures story the faults bench gates."""
+        metrics.inc("tpu9_router_failover_recovered_total"
+                    if recovered else "tpu9_router_failover_exhausted_total",
+                    labels={"stub": stub_id})
+
     def queue_sample(self, stub_id: str, depth: int, capacity: int) -> None:
         self._queue_depth[stub_id] = depth
         self._capacity[stub_id] = capacity

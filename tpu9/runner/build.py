@@ -47,7 +47,8 @@ async def amain() -> int:
         async def finish(ok: bool) -> None:
             await session.post(
                 f"{gateway}/rpc/image/complete/{image_id}",
-                json={"ok": ok, "logs": log_lines[-200:]})
+                json={"ok": ok, "logs": log_lines[-200:]},
+                timeout=aiohttp.ClientTimeout(total=30))
 
         try:
             env_dir = os.path.join(scratch, "env")
@@ -144,7 +145,8 @@ async def amain() -> int:
                         data = f.read()
                     async with session.post(
                             f"{gateway}/rpc/image/chunk/{digest}",
-                            data=data) as resp:
+                            data=data,
+                            timeout=aiohttp.ClientTimeout(total=300)) as resp:
                         if resp.status != 200:
                             raise RuntimeError(
                                 f"chunk upload {digest[:12]} failed: "
@@ -153,7 +155,8 @@ async def amain() -> int:
             await asyncio.gather(*[upload(d) for d in digests])
             async with session.post(
                     f"{gateway}/rpc/image/manifest/{image_id}",
-                    data=manifest.to_json()) as resp:
+                    data=manifest.to_json(),
+                    timeout=aiohttp.ClientTimeout(total=300)) as resp:
                 if resp.status != 200:
                     raise RuntimeError(
                         f"manifest upload failed: {resp.status} "

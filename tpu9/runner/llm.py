@@ -70,6 +70,16 @@ async def amain() -> None:
     gateway_url = os.environ.get("TPU9_GATEWAY_URL", "")
     token = os.environ.get("TPU9_TOKEN", "")
 
+    # fault-injection plane (ISSUE 15): env-gated, None in production.
+    # The import is lazy on purpose — tpu9.testing.faults is restricted
+    # to the declared hook sites (boundaries.toml) and a production
+    # container without TPU9_FAULTS never imports it.
+    faults = None
+    if os.environ.get("TPU9_FAULTS"):
+        from ..testing.faults import FaultPlane
+        faults = FaultPlane.from_env()
+        log.warning("fault plane ACTIVE: %s", sorted(faults.specs))
+
     # multi-host gang? join the slice-wide jax.distributed job first
     from ..parallel.distributed import initialize_multihost
     initialize_multihost()
@@ -99,9 +109,29 @@ async def amain() -> None:
         trace_id, _, parent = raw.partition(":")
         return (trace_id, parent) if trace_id else None
 
+    def _budget_s(request: web.Request):
+        """Remaining deadline budget from the gateway's X-Tpu9-Budget-S
+        header (relative seconds — relative survives clock skew across
+        the RPC boundary; the gateway deducts spent budget per attempt).
+        None = no deadline."""
+        raw = request.headers.get("X-Tpu9-Budget-S", "")
+        if not raw:
+            return None
+        try:
+            return float(raw)
+        except ValueError:
+            return None
+
     async def generate(request: web.Request) -> web.StreamResponse:
         if not state["ready"]:
             return web.json_response({"error": "not ready"}, status=503)
+        if faults is not None and faults.fire("rpc_error"):
+            # induced RPC transport error: the gateway's forward sees a
+            # mid-request connection reset, exactly like a NIC/proxy blip
+            if request.transport is not None:
+                request.transport.close()
+            raise ConnectionResetError(
+                "tpu9.testing.faults: induced rpc transport error")
         try:
             payload = json.loads(await request.read() or b"{}")
             tokens = payload.get("tokens") or payload.get("prompt_tokens")
@@ -112,26 +142,44 @@ async def amain() -> None:
             prompt = [int(t) for t in tokens]
             max_new = int(payload.get("max_new_tokens", 32))
             trace = _trace_ctx(request)
+            budget = _budget_s(request)
+            if budget is not None and budget <= 0:
+                # past budget at the door: never even enqueue (the
+                # engine would reject it too; answering here saves the
+                # queue round-trip)
+                return web.json_response(
+                    {"error": "deadline_exceeded: budget exhausted "
+                              "before dispatch"}, status=504)
             if payload.get("stream") or \
                     "text/event-stream" in request.headers.get("Accept", ""):
-                return await _generate_sse(request, prompt, max_new, trace)
+                return await _generate_sse(request, prompt, max_new, trace,
+                                           budget)
             out = await state["engine"].generate(prompt,
                                                  max_new_tokens=max_new,
-                                                 trace=trace)
+                                                 trace=trace,
+                                                 budget_s=budget)
             state["beat"].set()
             return web.json_response({"tokens": out})
+        except TimeoutError as exc:
+            # engine deadline expiry (ISSUE 15): 504, not 400/500 — the
+            # gateway must neither blame the request nor retry it
+            if "deadline_exceeded" in str(exc):
+                return web.json_response({"error": str(exc)}, status=504)
+            return web.json_response(error_payload(exc), status=500)
         except ValueError as exc:
             return web.json_response({"error": str(exc)}, status=400)
         except Exception as exc:  # noqa: BLE001
             return web.json_response(error_payload(exc), status=500)
 
     async def _generate_sse(request: web.Request, prompt: list,
-                            max_new: int, trace=None) -> web.StreamResponse:
+                            max_new: int, trace=None,
+                            budget=None) -> web.StreamResponse:
         """Server-sent token stream: one `data: {"token": N}` event per
         generated token, then `data: {"done": true, "tokens": [...]}` —
         relayed incrementally by the gateway's streaming proxy."""
         req = await state["engine"].generate(prompt, max_new_tokens=max_new,
-                                             stream=True, trace=trace)
+                                             stream=True, trace=trace,
+                                             budget_s=budget)
         sr = web.StreamResponse(
             status=200, headers={"Content-Type": "text/event-stream",
                                  "Cache-Control": "no-cache",
@@ -144,6 +192,13 @@ async def amain() -> None:
                 if tok is None:
                     break
                 out.append(tok)
+                if faults is not None and faults.fire("proc_exit",
+                                                      tokens=len(out)):
+                    # hard replica death mid-stream: the strongest chaos
+                    # case — transport cut, no error event, no goodbye
+                    log.warning("fault plane: proc_exit after %d tokens",
+                                len(out) - 1)
+                    os._exit(17)
                 await sr.write(
                     f"data: {json.dumps({'token': tok})}\n\n".encode())
             if req.error:
@@ -245,6 +300,10 @@ async def amain() -> None:
                  {k: round(v, 2) for k, v in ahead.items()})
     log.info("engine warmup: %s",
              {k: round(v, 2) for k, v in timings.items()})
+    if faults is not None:
+        # serve-loop fault hooks (crash / stall) patch the INSTANCE —
+        # the plane never imports the serving stack
+        faults.instrument_engine(engine)
     await engine.start()
     state["engine"] = engine
     state["ready"] = True
@@ -286,7 +345,13 @@ async def amain() -> None:
                        or 2.0)
         crash_shipped = False
         pending_pm: Optional[dict] = None
-        pm_attempts = 0
+        # post-mortem ship retry budgets (ISSUE 15 satellite: the shared
+        # backoff helper replaces the hand-rolled 5/30 counters). The
+        # heartbeat paces the loop, so the DELAY side is unused — only
+        # the attempt accounting and give-up classification.
+        from ..utils.backoff import BackoffPolicy, RetryState
+        pm_retry = RetryState(BackoffPolicy(base_s=beat_s, jitter=0.0),
+                              permanent_max=5, transient_max=30)
         async with aiohttp.ClientSession(
                 headers={"Authorization": f"Bearer {token}"}) as session:
             while True:
@@ -420,6 +485,16 @@ async def amain() -> None:
                     # ride the keepalive (worker.py ship analogue)
                     spans, ship_hi = tracer.export_new(
                         since_mono=last_span_ship, limit=RING_CAP)
+                    if faults is not None and faults.active(
+                            "heartbeat_loss"):
+                        # induced heartbeat loss: the replica falls
+                        # SILENT (stale-aging + health plane must catch
+                        # it) without touching the serve loop; the span
+                        # watermark does not advance, so spans re-ship
+                        # once the window clears
+                        await event_wait(state["beat"], timeout=beat_s)
+                        state["beat"].clear()
+                        continue
                     async with session.post(
                             gateway_url + "/rpc/llm/pressure",
                             json={"container_id": cfg.container_id,
@@ -448,7 +523,8 @@ async def amain() -> None:
                     # the record is dropped so the trigger checks above
                     # can capture the next incident's evidence.
                     if pending_pm is not None:
-                        pm_attempts += 1
+                        pm_retry.next_delay()     # count the attempt;
+                        # the heartbeat cadence IS the pacing
                         pm_status = 0
                         try:
                             async with session.post(
@@ -462,20 +538,20 @@ async def amain() -> None:
                                     log.warning(
                                         "shipped post-mortem record (%s)",
                                         pending_pm.get("reason"))
-                                    pending_pm, pm_attempts = None, 0
+                                    pending_pm = None
+                                    pm_retry.reset()
                         except (aiohttp.ClientError,
                                 asyncio.TimeoutError) as exc:
                             log.debug("post-mortem ship failed: %s", exc)
-                        if pending_pm is not None and (
-                                (400 <= pm_status < 500
-                                 and pm_attempts >= 5)
-                                or pm_attempts >= 30):
+                        if pending_pm is not None and pm_retry.give_up(
+                                permanent=400 <= pm_status < 500):
                             log.error(
                                 "dropping post-mortem record (%s) after "
                                 "%d attempts (last status %d)",
-                                pending_pm.get("reason"), pm_attempts,
-                                pm_status)
-                            pending_pm, pm_attempts = None, 0
+                                pending_pm.get("reason"),
+                                pm_retry.attempts, pm_status)
+                            pending_pm = None
+                            pm_retry.reset()
                 except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
                     log.debug("pressure heartbeat failed: %s", exc)
                 # request completions nudge the next beat immediately: an

@@ -51,6 +51,14 @@ from .schedule import WindowScheduler
 
 Params = dict[str, Any]
 
+# deadline-expiry error prefix (ISSUE 15). This string is a WIRE contract:
+# the llm runner maps it to 504 and the gateway's failover classifier
+# treats it as final (the budget is spent — retrying would burn chips on
+# an answer the client stopped waiting for). Keep in sync with
+# tpu9.gateway.survival.DEADLINE_ERROR (the boundary map forbids a
+# shared import in either direction).
+DEADLINE_ERROR = "deadline_exceeded"
+
 
 def abstract_params(tree: Any) -> Any:
     """Pytree of arrays (or ShapeDtypeStructs) → matching
@@ -173,6 +181,9 @@ class _Request:
     queue: Optional[asyncio.Queue] = None   # set for streaming requests
     error: str = ""
     cancelled: bool = False                 # client abandoned the request
+    # request deadline (ISSUE 15): monotonic stamp past which the request
+    # must not be prefilled and a mid-decode slot is retired (0 = none)
+    deadline_mono: float = 0.0
     # observability (ISSUE 8): remote trace context (trace_id, parent
     # span id) carried across the runner RPC boundary; span is the
     # engine.request span opened at admission under that parent
@@ -329,7 +340,7 @@ class InferenceEngine:
                        "decode_steps": 0, "admit_dispatches": 0,
                        "admit_interleaved_windows": 0,
                        "spec_windows": 0, "spec_proposed": 0,
-                       "spec_accepted": 0}
+                       "spec_accepted": 0, "deadline_expired": 0}
         # ---- observability (ISSUE 8) ----
         # flight recorder: bounded per-window ring (None = disabled)
         self.flight = flight_maybe(engine_cfg.flight_cap)
@@ -687,16 +698,26 @@ class InferenceEngine:
 
     async def generate(self, prompt: list[int], max_new_tokens: int = 32,
                        request_id: str = "", stream: bool = False,
-                       trace: Optional[tuple] = None):
+                       trace: Optional[tuple] = None,
+                       budget_s: Optional[float] = None):
         """``trace`` is an optional remote span context ``(trace_id,
         parent_span_id)`` — set by the llm runner from the gateway's
         X-Tpu9-Trace header — under which the engine records its
         request/prefill/decode-window spans. None (the default) records
-        no spans; latency metrics and the flight recorder are always on."""
+        no spans; latency metrics and the flight recorder are always on.
+
+        ``budget_s`` (ISSUE 15) is the request's remaining deadline
+        budget in seconds: a request still queued past it is never
+        prefilled, and a slot still decoding past it is retired at the
+        next window boundary (its KV blocks return to the pool
+        immediately). None disables the deadline."""
         if self._dead_reason is not None:
             raise RuntimeError(
                 f"engine is dead: {self._dead_reason} (restart the "
                 "container — requests would hang forever)")
+        if budget_s is not None and budget_s <= 0:
+            raise TimeoutError(f"{DEADLINE_ERROR}: budget exhausted "
+                               "before admission")
         # chunked prefill (paged mode) has no bucket cap — only the cache
         limit = self.ecfg.max_seq_len - 1 if self.paged else \
             min(self._buckets[-1], self.ecfg.max_seq_len - 1)
@@ -710,13 +731,22 @@ class InferenceEngine:
                        queue=asyncio.Queue() if stream else None,
                        trace=trace if trace and trace[0] else None,
                        t_enqueue_mono=time.monotonic(),
-                       t_enqueue_wall=time.time())
+                       t_enqueue_wall=time.time(),
+                       deadline_mono=(time.monotonic() + budget_s
+                                      if budget_s else 0.0))
         await self._queue.put(req)
         self._stats["queued"] = self._queue.qsize()
         if stream:
             return req  # caller iterates req.queue
         await req.done.wait()
         if req.error:
+            if req.error.startswith(DEADLINE_ERROR):
+                raise TimeoutError(req.error)
+            if req.error.startswith("engine"):
+                # infrastructure failure (serve loop died / engine
+                # stopped), not a request-shape problem: the runner maps
+                # this to 500 and the gateway's failover retries it
+                raise RuntimeError(req.error)
             raise ValueError(req.error)
         return req.generated
 
@@ -1388,18 +1418,39 @@ class InferenceEngine:
         return (not self.paged
                 or self.allocator.can_reserve(self._worst_case_tokens(req)))
 
+    @staticmethod
+    def _req_expired(req: "_Request") -> bool:
+        return (req.deadline_mono > 0
+                and time.monotonic() > req.deadline_mono)
+
+    def _expire_unadmitted(self, req: "_Request") -> None:
+        """Deadline expiry BEFORE prefill (ISSUE 15): the whole point of
+        admission-side deadlines — chips never prefill an answer the
+        client has already stopped waiting for."""
+        self._stats["deadline_expired"] += 1
+        self._finish(req, error=f"{DEADLINE_ERROR}: budget exhausted "
+                                "before prefill")
+
     def _next_admittable(self) -> Optional[_Request]:
         while self.paged and self._wait_room:
-            if self._wait_room[0].cancelled:
-                self._finish(self._wait_room.pop(0))
+            head = self._wait_room[0]
+            if head.cancelled or self._req_expired(head):
+                self._wait_room.pop(0)
+                if head.cancelled:
+                    self._finish(head)
+                else:
+                    self._expire_unadmitted(head)
                 continue
-            if self._room_for(self._wait_room[0]):
+            if self._room_for(head):
                 return self._wait_room.pop(0)
             return None                     # FIFO: don't starve the head
         while not self._queue.empty():
             req = self._queue.get_nowait()
             if req.cancelled:
                 self._finish(req)
+                continue
+            if self._req_expired(req):
+                self._expire_unadmitted(req)
                 continue
             if self._room_for(req):
                 return req
@@ -1501,6 +1552,9 @@ class InferenceEngine:
                 req = await self._queue.get()
                 if req.cancelled:
                     self._finish(req)
+                    continue
+                if self._req_expired(req):
+                    self._expire_unadmitted(req)
                     continue
                 if not self._room_for(req):
                     self._wait_room.append(req)
@@ -1709,6 +1763,16 @@ class InferenceEngine:
                     # nobody reads and free the slot for live work
                     self._retire(slot)
                     continue
+                if self._req_expired(self.slot_req[slot]):
+                    # deadline passed mid-generation: retire NOW — the
+                    # slot's KV blocks return to the pool this window,
+                    # not after the remaining budget decodes into a
+                    # response nobody is waiting for
+                    self._stats["deadline_expired"] += 1
+                    self.slot_req[slot].error = \
+                        f"{DEADLINE_ERROR}: budget exhausted mid-decode"
+                    self._retire(slot)
+                    continue
                 tok = int(window[step, slot])
                 delivered[slot].append(tok)
                 self._deliver_token(slot, tok)
@@ -1748,6 +1812,12 @@ class InferenceEngine:
                 self._stats["spec_proposed"] += n_real
                 self._stats["spec_accepted"] += min(acc, n_real)
             if self.slot_req[slot].cancelled:
+                self._retire(slot)
+                continue
+            if self._req_expired(self.slot_req[slot]):
+                self._stats["deadline_expired"] += 1
+                self.slot_req[slot].error = \
+                    f"{DEADLINE_ERROR}: budget exhausted mid-decode"
                 self._retire(slot)
                 continue
             req = self.slot_req[slot]

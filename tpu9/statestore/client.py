@@ -7,7 +7,7 @@ import asyncio
 import itertools
 from typing import Any, AsyncIterator, Optional
 
-from ..utils.aio import queue_get, spawn
+from ..utils.aio import cancellable_wait, queue_get, spawn
 from . import wire
 from .store import StateStore
 
@@ -39,10 +39,20 @@ class RemoteSubscription:
 
 
 class RemoteStore(StateStore):
-    def __init__(self, address: str, auth_token: str = "") -> None:
+    # default per-op deadline (ISSUE 15 / TMO001): a wedged state server
+    # (accepting but never replying) used to hang EVERY store op forever
+    # — router dispatch, heartbeat folds, the whole control plane.
+    # Blocking ops (blpop/xread/...) extend this by their own requested
+    # timeout; the bound is for the RPC exchange itself.
+    OP_TIMEOUT_S = 30.0
+    CONNECT_TIMEOUT_S = 10.0
+
+    def __init__(self, address: str, auth_token: str = "",
+                 op_timeout_s: float = OP_TIMEOUT_S) -> None:
         host, _, port = address.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
         self.auth_token = auth_token
+        self.op_timeout_s = op_timeout_s
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: dict[int, asyncio.Future] = {}
@@ -61,8 +71,11 @@ class RemoteStore(StateStore):
 
     async def _connect_locked(self) -> None:
         """Establish the connection; caller holds _connect_lock."""
-        self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port)
+        # cancellable_wait, not wait_for (ASY001) — and a bound at all
+        # (TMO001): an unroutable address must fail the op, not park it
+        self._reader, self._writer = await cancellable_wait(
+            asyncio.open_connection(self.host, self.port),
+            self.CONNECT_TIMEOUT_S)
         self._read_task = asyncio.create_task(self._read_loop())
         if self.auth_token:
             await self._call_raw("auth", self.auth_token)
@@ -125,6 +138,25 @@ class RemoteStore(StateStore):
                     fut.set_exception(ConnectionError("state store connection lost"))
             self._pending.clear()
 
+    def _op_deadline_s(self, op: str, args: tuple, kwargs: dict) -> float:
+        """Per-op RPC bound: the base exchange budget, extended by the
+        SERVER-side block the caller explicitly asked for (blpop/xread
+        park on the server until their own timeout — that parking is not
+        an RPC hang)."""
+        budget = self.op_timeout_s
+        # positional index of each blocking op's timeout argument:
+        # blpop(key, timeout) / xread(key, last_id, timeout)
+        block_idx = {"blpop": 1, "xread": 2}.get(op)
+        if block_idx is not None:
+            block = kwargs.get("timeout",
+                               args[block_idx]
+                               if len(args) > block_idx else 0)
+            try:
+                budget += max(float(block or 0), 0.0)
+            except (TypeError, ValueError):
+                pass
+        return budget
+
     async def _call(self, op: str, *args: Any, **kwargs: Any) -> Any:
         if self._writer is None or (self._read_task is not None and self._read_task.done()):
             # serialize the whole check-close-reconnect under the connect
@@ -151,7 +183,21 @@ class RemoteStore(StateStore):
         async with self._write_lock:
             self._writer.write(frame)
             await self._writer.drain()
-        return await fut
+        # bounded wait (TMO001): a server that accepted the frame but
+        # never answers must fail THIS op, not park its caller forever.
+        # The connection is torn down on timeout — its response ordering
+        # can no longer be trusted, and the next op reconnects.
+        try:
+            return await cancellable_wait(
+                fut, self._op_deadline_s(op, args, kwargs))
+        except asyncio.TimeoutError:
+            self._pending.pop(rid, None)
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+            raise asyncio.TimeoutError(
+                f"state store op {op!r} timed out after "
+                f"{self._op_deadline_s(op, args, kwargs):.1f}s")
 
     def _fire_and_forget(self, op: str, *args: Any) -> None:
         if self._writer is None:
@@ -173,7 +219,11 @@ class RemoteStore(StateStore):
         async with self._write_lock:
             self._writer.write(frame)
             await self._writer.drain()
-        await fut
+        try:
+            await cancellable_wait(fut, self.op_timeout_s)
+        except asyncio.TimeoutError:
+            self._pending.pop(sub.sub_id, None)
+            raise
 
     def subscribe(self, pattern: str):
         # register synchronously with a reserved id; server uses request id

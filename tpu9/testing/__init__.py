@@ -1,3 +1,17 @@
-from .localstack import LocalStack
+"""Test-plane helpers: the in-process LocalStack and the deterministic
+fault-injection plane (ISSUE 15).
+
+``LocalStack`` is resolved lazily: ``tpu9.testing.faults`` is imported by
+production *processes* (runner/worker/cache hooks, env-gated) and must
+not drag the whole gateway/worker stack in with it.
+"""
+
+
+def __getattr__(name):
+    if name == "LocalStack":
+        from .localstack import LocalStack
+        return LocalStack
+    raise AttributeError(name)
+
 
 __all__ = ["LocalStack"]

@@ -225,10 +225,12 @@ class LocalStack:
         return f"http://{self.cfg.gateway.host}:{self.gateway.port}"
 
     async def api(self, method: str, path: str, json_body: Any = None,
-                  data: bytes = None, timeout: float = 60.0) -> Any:
+                  data: bytes = None, timeout: float = 60.0,
+                  headers: Optional[dict] = None) -> Any:
         assert self._session is not None
         async with self._session.request(
                 method, self.base_url + path, json=json_body, data=data,
+                headers=headers,
                 timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
             text = await resp.text()
             payload = json.loads(text) if text else {}

@@ -1,0 +1,97 @@
+"""One backoff policy for every retry loop in the serve stack.
+
+Before ISSUE 15 the repo had three hand-rolled retry shapes — the
+checkpoint READY-marker geometric poll (PR 1), the admission
+``wait_drained`` fixed 250 ms fallback poll, and the llm runner's
+post-mortem 5/30-attempt ship loop — each with its own off-by-one and
+none with jitter. Synchronized retries are how a one-replica blip turns
+into a fleet-wide retry storm: every client that failed at the same
+instant comes back at the same instant. This module is the single
+implementation; the gateway's automatic failover (ISSUE 15 tentpole)
+builds on it too.
+
+Design rules:
+
+- **Deterministic when asked**: pass an ``random.Random`` (or
+  ``jitter=0``) and the delay sequence is reproducible — tests and the
+  fault-injection bench assert exact schedules.
+- **Monotonic-clock deadlines only** (OBS001): callers pass relative
+  budgets or ``time.monotonic()`` deadlines, never wall stamps.
+- **No asyncio opinions**: :class:`BackoffPolicy` yields plain floats;
+  :class:`RetryState` counts attempts. ``sleep``/``wait`` live with the
+  caller, so event-driven loops (admission drain) can use the delays as
+  *fallback poll bounds* rather than sleeps.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+
+@dataclass(frozen=True)
+class BackoffPolicy:
+    """Jittered exponential backoff: ``base * factor**n`` capped at
+    ``max_s``, with up to ``jitter`` fraction of each interval
+    randomized (full-jitter on that slice: ``d*(1-j) + U(0,1)*d*j``)."""
+    base_s: float = 0.05
+    factor: float = 2.0
+    max_s: float = 2.0
+    jitter: float = 0.5            # 0 = deterministic geometric series
+    max_attempts: int = 0          # 0 = unbounded (deadline-bound loops)
+
+    def delay(self, attempt: int,
+              rng: Optional[random.Random] = None) -> float:
+        """Delay before retry ``attempt`` (0-based)."""
+        d = min(self.base_s * (self.factor ** max(attempt, 0)), self.max_s)
+        if self.jitter <= 0:
+            return d
+        r = (rng or random).random()
+        return d * (1.0 - self.jitter) + d * self.jitter * r
+
+    def delays(self, rng: Optional[random.Random] = None
+               ) -> Iterator[float]:
+        """Iterator of successive delays; finite when ``max_attempts``
+        is set (one delay per RETRY — an operation with max_attempts=3
+        sleeps at most twice)."""
+        n = 0
+        while self.max_attempts <= 0 or n < self.max_attempts - 1:
+            yield self.delay(n, rng)
+            n += 1
+
+
+class RetryState:
+    """Attempt bookkeeping for loops that retry across *heartbeats*
+    rather than sleeps (the post-mortem ship loop): counts attempts and
+    answers ``give_up`` against two budgets — a short one for permanent
+    rejections (the far side actively said no) and a longer one for
+    transient transport errors."""
+
+    def __init__(self, policy: BackoffPolicy,
+                 permanent_max: int = 5, transient_max: int = 30,
+                 rng: Optional[random.Random] = None):
+        self.policy = policy
+        self.permanent_max = permanent_max
+        self.transient_max = transient_max
+        self.attempts = 0
+        self._rng = rng
+
+    def next_delay(self) -> float:
+        """Record one attempt and return the backoff delay before the
+        next (the caller may ignore it when another cadence — e.g. the
+        heartbeat — already paces the loop)."""
+        d = self.policy.delay(self.attempts, self._rng)
+        self.attempts += 1
+        return d
+
+    def give_up(self, permanent: bool) -> bool:
+        """True once the relevant attempt budget is exhausted.
+        ``permanent`` = the last failure was a definitive rejection
+        (4xx) rather than a transport blip."""
+        if permanent:
+            return self.attempts >= self.permanent_max
+        return self.attempts >= self.transient_max
+
+    def reset(self) -> None:
+        self.attempts = 0

@@ -84,14 +84,18 @@ class CheckpointManager:
             return None
         marker = os.path.join(workdir, CKPT_DIR_NAME, READY_MARKER)
         deadline = time.monotonic() + self.marker_timeout_s
-        interval = self.marker_poll_s
+        # shared backoff helper (ISSUE 15 satellite): deterministic
+        # geometric series, same shape the hand-rolled loop had
+        from ..utils.backoff import BackoffPolicy
+        delays = BackoffPolicy(base_s=self.marker_poll_s, factor=2.0,
+                               max_s=self.marker_poll_max_s,
+                               jitter=0.0).delays()
         while not os.path.exists(marker):
             if time.monotonic() > deadline:
                 log.info("checkpoint marker never appeared for %s",
                          container_id)
                 return None
-            await asyncio.sleep(interval)
-            interval = min(interval * 2, self.marker_poll_max_s)
+            await asyncio.sleep(next(delays))
         return await self.create(stub_id, workspace_id, container_id, workdir)
 
     async def create(self, stub_id: str, workspace_id: str, container_id: str,

@@ -218,9 +218,19 @@ class Worker:
 
     async def _heartbeat_loop(self) -> None:
         from ..observability import metrics
+        # fault-injection plane (ISSUE 15): env-gated worker-keepalive
+        # loss — the scheduler-facing face of a silent worker, so chaos
+        # runs can exercise dead-worker rescheduling deterministically
+        faults = None
+        if os.environ.get("TPU9_FAULTS"):
+            from ..testing.faults import FaultPlane
+            faults = FaultPlane.from_env()
         while not self._stopping.is_set():
             try:
-                await self._heartbeat_once(metrics)
+                if faults is not None and faults.active("heartbeat_loss"):
+                    log.warning("fault plane: skipping worker keepalive")
+                else:
+                    await self._heartbeat_once(metrics)
             except asyncio.CancelledError:
                 raise
             except Exception as exc:    # noqa: BLE001 — a transient store
