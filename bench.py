@@ -52,6 +52,7 @@ import json
 import os
 import signal
 import statistics
+import struct
 import subprocess
 import sys
 import time
@@ -62,7 +63,8 @@ PHASE_TIMEOUT_S = {"llm": 1800, "llm_endpoint": 1800, "kernels": 900,
                    "coldstart": 900, "coldstart_native": 900,
                    "coldstart_jax": 900, "coldstart_jax_tpu": 900,
                    "coldstart_stream": 900, "router": 300, "spec": 900,
-                   "quant": 900, "obs": 900, "multichip": 900}
+                   "quant": 900, "obs": 900, "multichip": 900,
+                   "faults": 300, "disagg": 600}
 
 # share compiled XLA programs between the in-process llm phase and the
 # runner container in the endpoint phase (identical graphs → second phase
@@ -1359,7 +1361,11 @@ def bench_router(quick: bool = False) -> dict:
 # Gates (bench_guard): zero client-visible failed requests is HARD (a
 # violation strips the headline fields, and faults_recovery_p95_s is in
 # HARD_FIELDS so the stripped round FAILS); recovery-time p95 is guarded
-# "down" across rounds.
+# "down" across rounds. ISSUE 16 adds block-ship resume: the headline
+# leg recovers by adopting shipped KV blocks, a kv-ship-off leg prices
+# the re-prefill baseline it must beat at p95, and a kv_ship_error
+# chaos leg proves the fallback degrades to re-prefill — never to a
+# client-visible failure.
 # ---------------------------------------------------------------------------
 
 def bench_faults(quick: bool = False) -> dict:
@@ -1380,6 +1386,16 @@ def bench_faults(quick: bool = False) -> dict:
     SERVICE_MS = 2.0              # healthy per-request service floor
     CRASH_DOWN_S = 0.1            # replica outage window after a crash
     STALL_S = 0.08                # wedged-dispatch latency (≫ healthy)
+    # ISSUE 16: a failover retry must rebuild the victim's KV state on
+    # the survivor — a full re-prefill of the delivered watermark, or an
+    # O(blocks) adopt of shipped kvwire blocks. The gap between the two
+    # is what block-ship resume buys. Magnitudes are the realistic ones
+    # (and deliberately large enough to survive the p95 tail, which the
+    # crash outage windows otherwise dominate): a multi-hundred-token
+    # watermark at single-digit-k tok/s prefill is hundreds of ms; an
+    # adopt is one hedged cache read + a device scatter.
+    REPREFILL_S = 0.25            # watermark re-prefill on the survivor
+    ADOPT_S = 0.004               # kvwire fetch + import_blocks splice
 
     class FakeFleet:
         def __init__(self, n):
@@ -1391,7 +1407,7 @@ def bench_faults(quick: bool = False) -> dict:
         async def containers_by_stub(self, stub_id, status=None):
             return list(self.states)
 
-    async def run() -> dict:
+    async def run(kv_ship: bool, ship_faults: bool) -> dict:
         # deterministic fault plan: replica crashes open a recovery
         # window, stalls wedge single dispatches, rpc errors reset
         # transports — all from one seeded plane
@@ -1402,16 +1418,26 @@ def bench_faults(quick: bool = False) -> dict:
         # schedule can outlast — the phase asserts the recovery machinery
         # wins a WINNABLE fight; an unwinnable one (whole fleet dark for
         # seconds) is a capacity incident, not a failover test.
-        plane = FaultPlane(parse_spec(
-            "crash:prob=0.03,times=5;stall:prob=0.04;rpc_error:prob=0.05"),
-            seed=1994)
+        spec = "crash:prob=0.03,times=5;stall:prob=0.04;rpc_error:prob=0.05"
+        if ship_faults:
+            # ISSUE 16 chaos leg: half the block ships fail before the
+            # fetch (the runner's kv_ship_error hook) — every one must
+            # degrade to re-prefill, never to a client-visible failure
+            spec += ";kv_ship_error:prob=0.5"
+        plane = FaultPlane(parse_spec(spec), seed=1994)
+        kv_counts = {"resumes": 0, "fallbacks": 0}
         down_until: dict[str, float] = {}
         # backoff deliberately deterministic (jitter=0) and big enough
         # (50 ms base) that recovery time is dominated by the schedule,
         # not host sleep noise — the p95 is guarded across rounds
+        # 6 attempts (was 5): the ISSUE 16 chaos leg adds kv_ship_error
+        # on top of the crash/stall/rpc plan, and a failed ship's
+        # re-prefill keeps the retry in flight longer — one more rung on
+        # the schedule keeps the fight winnable without stretching the
+        # (guarded) recovery tail of requests that win earlier
         cfg = RouterConfig(default_replica_inflight=8,
                            max_queue_depth=10000, max_queue_wait_s=10.0,
-                           failover_max_attempts=5,
+                           failover_max_attempts=6,
                            failover_backoff_base_s=0.05,
                            failover_backoff_max_s=0.2)
         router = FleetRouter(cfg, MemoryStore(), FakeFleet(N_REPLICAS))
@@ -1446,6 +1472,17 @@ def bench_faults(quick: bool = False) -> dict:
                         body=b'{"error":"ConnectionResetError"}',
                         container_id=cid)
                 svc = SERVICE_MS / 1000.0
+                if avoid:
+                    # failover retry: the survivor rebuilds the victim's
+                    # KV — adopt shipped blocks when the ship lands,
+                    # re-prefill the watermark when it doesn't (kv ship
+                    # disabled, or the kv_ship_error fault fired)
+                    if kv_ship and not plane.fire("kv_ship_error"):
+                        kv_counts["resumes"] += 1
+                        svc += ADOPT_S
+                    else:
+                        kv_counts["fallbacks"] += 1
+                        svc += REPREFILL_S
                 if plane.fire("stall"):
                     injected["stall"] += 1
                     svc += STALL_S    # wedged dispatch, then the
@@ -1544,12 +1581,20 @@ def bench_faults(quick: bool = False) -> dict:
                                   len(recoveries) - 1)]
 
         return {"outcomes": outcomes, "injected": dict(injected),
+                "kv": dict(kv_counts),
                 "recovery_p50_s": round(pct(0.50), 4),
                 "recovery_p95_s": round(pct(0.95), 4),
                 "recovered": len(recoveries),
                 "splice_ok": splice_ok, "splice_n": splice_n}
 
-    r = asyncio.run(run())
+    # three legs, one seed (ISSUE 16): the headline leg recovers via
+    # block-ship resume; the reprefill leg is the same chaos with kv
+    # ship off (the improvement baseline); the chaos leg fault-injects
+    # the ship itself (kv_ship_error) — every failed ship must degrade
+    # to re-prefill with ZERO client-visible failures
+    r = asyncio.run(run(kv_ship=True, ship_faults=False))
+    r_off = asyncio.run(run(kv_ship=False, ship_faults=False))
+    r_chaos = asyncio.run(run(kv_ship=True, ship_faults=True))
     out = {
         "faults_requests": N_REQUESTS,
         "faults_failed_requests": r["outcomes"]["failed"],
@@ -1562,12 +1607,18 @@ def bench_faults(quick: bool = False) -> dict:
         "faults_injected_rpc_error": r["injected"]["rpc_error"],
         "faults_stream_splice_ok": r["splice_ok"],
         "faults_stream_splice_n": r["splice_n"],
+        "faults_kv_resumes": r["kv"]["resumes"],
+        "faults_recovery_p95_reprefill_s": r_off["recovery_p95_s"],
+        "faults_kv_fallbacks": r_chaos["kv"]["fallbacks"],
+        "faults_kv_chaos_failed_requests": r_chaos["outcomes"]["failed"],
     }
     violations = []
-    if r["outcomes"]["failed"] > 0:
+    failed_total = (r["outcomes"]["failed"] + r_off["outcomes"]["failed"]
+                    + r_chaos["outcomes"]["failed"])
+    if failed_total > 0:
         violations.append(
-            f"{r['outcomes']['failed']} client-visible failed requests "
-            "under induced faults (must be ZERO)")
+            f"{failed_total} client-visible failed requests "
+            "under induced faults (must be ZERO across all legs)")
     if r["outcomes"]["failovers"] == 0 or sum(r["injected"].values()) == 0:
         violations.append("no faults were actually induced — the chaos "
                           "phase measured nothing")
@@ -1577,6 +1628,239 @@ def bench_faults(quick: bool = False) -> dict:
             f"{r['splice_n'] - r['splice_ok']}/{r['splice_n']} resumes")
     if r["recovered"] == 0:
         violations.append("no request actually recovered via failover")
+    if r["kv"]["resumes"] == 0:
+        violations.append("no failover actually resumed via block ship")
+    if r["recovered"] and r_off["recovered"] \
+            and r["recovery_p95_s"] >= r_off["recovery_p95_s"]:
+        violations.append(
+            f"block-ship resume did not improve recovery p95 "
+            f"({r['recovery_p95_s']}s vs re-prefill "
+            f"{r_off['recovery_p95_s']}s)")
+    if r_chaos["kv"]["fallbacks"] == 0:
+        violations.append("kv_ship_error injected nothing — the "
+                          "re-prefill fallback went unexercised")
+    out["violations"] = violations
+    out["valid"] = not violations
+    return out
+
+
+# ---------------------------------------------------------------------------
+# phase: disaggregated prefill/decode + the KV wire format (ISSUE 16).
+#
+# Two legs:
+#
+# 1. kvwire roundtrip bit-exactness through the REAL pool machinery
+#    (KvPool.export_blocks → import_blocks → re-export) on bf16 and
+#    int8(+scale-plane) pools, plus the version gate. Judged HARD the
+#    way quant parity is: a violation strips kvwire_roundtrip_exact
+#    from the round, and bench_guard's HARD presence check fails the
+#    stripped round.
+#
+# 2. TTFT p99 under a mixed long-doc / short-chat workload through the
+#    REAL FleetRouter with the disagg policy on vs off. The replica
+#    model is the continuous-batching interference disagg exists to
+#    remove: prefills serialize per replica, and a prefill slows by
+#    (1 + concurrent decodes) — so with disagg OFF, short chats queue
+#    behind multi-hundred-ms long-doc prefills and long-doc prefills
+#    crawl through decode-heavy replicas. Gates: disagg ON must WIN
+#    long-doc p99 and never lose >2% short-chat p99.
+# ---------------------------------------------------------------------------
+
+def bench_disagg(quick: bool = False) -> dict:
+    import asyncio
+
+    import numpy as np
+
+    out: dict = {}
+    violations: list[str] = []
+
+    # ---- leg 1: kvwire roundtrip bit-exactness ----------------------------
+    import jax.numpy as jnp
+
+    from tpu9.models.llama import LLAMA_PRESETS
+    from tpu9.serving import kvwire
+    from tpu9.serving.engine import EngineConfig
+    from tpu9.serving.kvpool import KvPool
+    from tpu9.serving.paged_kv import PrefixCache
+    from tpu9.serving.shard import make_policy
+
+    cfg = LLAMA_PRESETS["llama-tiny"]
+    ecfg = EngineConfig(max_batch=2, max_seq_len=256,
+                        prefill_buckets=(32, 64), decode_steps=(1, 4),
+                        kv_block_size=32, kv_pool_blocks=16,
+                        prefill_chunk=32, prefix_cache_blocks=8)
+    rng = np.random.default_rng(7)
+    exact = True
+    payload = b""
+    for kv_quant in (False, True):
+        pool_a = KvPool(cfg, ecfg, kv_quant, make_policy(None))
+        kv_a = pool_a.init_arrays()
+        blocks = pool_a.alloc_blocks(3)
+        idx = jnp.asarray(blocks, dtype=jnp.int32)
+        for name in pool_a.wire_names():
+            shape, dt = pool_a.array_shapes()[name]
+            sub = (shape[0], len(blocks)) + tuple(shape[2:])
+            vals = (rng.integers(-127, 128, size=sub, dtype=np.int8)
+                    if np.dtype(dt) == np.dtype(np.int8)
+                    else rng.standard_normal(sub).astype(np.float32))
+            kv_a[name] = kv_a[name].at[:, idx].set(
+                jnp.asarray(vals, dtype=dt))
+        tokens = [(i * 7) % 211 + 1 for i in range(3 * 32)]
+        t0 = time.perf_counter()
+        payload = pool_a.export_blocks(
+            kv_a, blocks, PrefixCache._key(tokens), len(tokens))
+        t_exp = time.perf_counter() - t0
+        pool_b = KvPool(cfg, ecfg, kv_quant, make_policy(None))
+        kv_b = pool_b.init_arrays()
+        t0 = time.perf_counter()
+        kv_b, adopted, _ = pool_b.import_blocks(kv_b, payload)
+        t_imp = time.perf_counter() - t0
+        entry = pool_b.prefix_cache.acquire_for_export(tokens)
+        back = b""
+        if entry is not None:
+            back = pool_b.export_blocks(kv_b, entry.blocks, entry.key,
+                                        entry.n_tokens)
+            pool_b.prefix_cache.release_pin(entry)
+        which = "int8" if kv_quant else "bf16"
+        if not (adopted and back == payload):
+            exact = False
+            violations.append(
+                f"kvwire roundtrip not bit-exact ({which} pool)")
+        out[f"kvwire_payload_kb_{which}"] = round(len(payload) / 1024, 2)
+        out[f"kvwire_export_ms_{which}"] = round(t_exp * 1000, 3)
+        out[f"kvwire_import_ms_{which}"] = round(t_imp * 1000, 3)
+    # version gate: a bumped payload must refuse loudly, not misparse
+    bumped = bytearray(payload)
+    struct.pack_into("<H", bumped, 7, kvwire.FORMAT_VERSION + 1)
+    try:
+        kvwire.decode_header(bytes(bumped))
+        exact = False
+        violations.append("kvwire accepted an unknown format version")
+    except kvwire.KvWireError:
+        pass
+    out["kvwire_roundtrip_exact"] = 1 if exact else 0
+
+    # ---- leg 2: disagg routing, TTFT p99 on vs off ------------------------
+    from tpu9.abstractions.common.buffer import ForwardResult
+    from tpu9.config import RouterConfig
+    from tpu9.router import FleetRouter
+    from tpu9.statestore import MemoryStore
+    from tpu9.types import ContainerState, ContainerStatus, Stub, StubConfig
+
+    N_REPLICAS = 4
+    N_REQUESTS = 160 if quick else 400
+    STAGGER_MS = 4.0
+    LONG_EVERY = 5                    # 20% long-doc, 80% short-chat
+    LONG_PROMPT = 640                 # > disagg_prefill_tokens
+    SHORT_PROMPT = 48
+    PREFILL_S = {"long": 0.025, "short": 0.001}
+    DECODE_S = {"long": 0.005, "short": 0.025}   # chats decode LONG
+
+    class FakeFleet:
+        def __init__(self, n):
+            self.states = [ContainerState(
+                container_id=f"r{i}", stub_id="s",
+                status=ContainerStatus.RUNNING.value,
+                address=f"127.0.0.1:{9200 + i}") for i in range(n)]
+
+        async def containers_by_stub(self, stub_id, status=None):
+            return list(self.states)
+
+    async def run(disagg: bool) -> dict:
+        cfg_r = RouterConfig(default_replica_inflight=8,
+                             max_queue_depth=10000, max_queue_wait_s=30.0,
+                             disagg_enabled=disagg,
+                             disagg_prefill_tokens=512,
+                             disagg_prefill_fraction=0.5)
+        router = FleetRouter(cfg_r, MemoryStore(), FakeFleet(N_REPLICAS))
+        stub = Stub(stub_id="s", name="s", workspace_id="w",
+                    config=StubConfig(timeout_s=60.0))
+        prefill_lock = {f"r{i}": asyncio.Lock() for i in range(N_REPLICAS)}
+        decoding = {f"r{i}": 0 for i in range(N_REPLICAS)}
+        # the deterministic partition _disagg_order computes: sorted ids,
+        # first ceil(0.5 * 4) = 2 lean prefill
+        prefill_part = {"r0", "r1"}
+        ttft = {"long": [], "short": []}
+        placed = {"long_on_prefill": 0, "long": 0}
+
+        def forward_for(kind, t_start):
+            async def forward(prefer):
+                cid = (prefer or ["r0"])[0]
+                if kind == "long":
+                    placed["long"] += 1
+                    placed["long_on_prefill"] += cid in prefill_part
+                async with prefill_lock[cid]:
+                    # continuous-batching interference: a prefill step
+                    # shares the replica with every in-flight decode
+                    slow = 1.0 + decoding[cid]
+                    await asyncio.sleep(PREFILL_S[kind] * slow)
+                ttft[kind].append(time.monotonic() - t_start)
+                decoding[cid] += 1
+                try:
+                    await asyncio.sleep(DECODE_S[kind])
+                finally:
+                    decoding[cid] -= 1
+                return ForwardResult(status=200, body=b'{"ok":1}',
+                                     container_id=cid)
+            return forward
+
+        async def one(i: int) -> int:
+            kind = "long" if i % LONG_EVERY == 0 else "short"
+            n = LONG_PROMPT if kind == "long" else SHORT_PROMPT
+            body = json.dumps({"tokens": [(i + j) % 251 + 1
+                                          for j in range(n)],
+                               "max_new_tokens":
+                                   8 if kind == "long" else 128}).encode()
+            res = await router.submit(stub, "mix", body,
+                                      forward_for(kind, time.monotonic()))
+            return res.status
+
+        tasks = []
+        for i in range(N_REQUESTS):
+            tasks.append(asyncio.create_task(one(i)))
+            await asyncio.sleep(STAGGER_MS / 1000.0)
+        statuses = await asyncio.gather(*tasks)
+        await router.stop()
+
+        def p99(xs):
+            xs = sorted(xs)
+            return xs[min(int(len(xs) * 0.99), len(xs) - 1)] if xs else 0.0
+
+        return {"long_p99_ms": round(p99(ttft["long"]) * 1000, 2),
+                "short_p99_ms": round(p99(ttft["short"]) * 1000, 2),
+                "failed": sum(1 for s in statuses if s != 200),
+                "long_on_prefill_frac": round(
+                    placed["long_on_prefill"] / max(1, placed["long"]), 3)}
+
+    r_on = asyncio.run(run(disagg=True))
+    r_off = asyncio.run(run(disagg=False))
+    out.update({
+        "disagg_longdoc_ttft_p99_ms_on": r_on["long_p99_ms"],
+        "disagg_longdoc_ttft_p99_ms_off": r_off["long_p99_ms"],
+        "disagg_shortchat_ttft_p99_ms_on": r_on["short_p99_ms"],
+        "disagg_shortchat_ttft_p99_ms_off": r_off["short_p99_ms"],
+        "disagg_longdoc_ttft_improvement": round(
+            r_off["long_p99_ms"] / max(r_on["long_p99_ms"], 1e-6), 3),
+        "disagg_shortchat_ttft_ratio": round(
+            r_on["short_p99_ms"] / max(r_off["short_p99_ms"], 1e-6), 3),
+        "disagg_long_on_prefill_frac": r_on["long_on_prefill_frac"],
+    })
+    if r_on["failed"] or r_off["failed"]:
+        violations.append(f"disagg sim dropped requests "
+                          f"(on={r_on['failed']}, off={r_off['failed']})")
+    if out["disagg_longdoc_ttft_improvement"] <= 1.0:
+        violations.append(
+            "disagg ON did not win long-doc TTFT p99 "
+            f"({r_on['long_p99_ms']}ms vs off {r_off['long_p99_ms']}ms)")
+    if out["disagg_shortchat_ttft_ratio"] > 1.02:
+        violations.append(
+            "disagg ON lost >2% short-chat TTFT p99 "
+            f"(ratio {out['disagg_shortchat_ttft_ratio']})")
+    if r_on["long_on_prefill_frac"] < 0.8:
+        violations.append(
+            "disagg placement did nothing — only "
+            f"{r_on['long_on_prefill_frac']:.0%} of long-doc prompts "
+            "landed on the prefill partition")
     out["violations"] = violations
     out["valid"] = not violations
     return out
@@ -2577,7 +2861,8 @@ def _run_phase(phase: str, quick: bool, cpu: bool) -> dict:
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", phase]
     if quick:
         cmd.append("--quick")
-    if cpu or phase in ("router", "spec", "quant", "obs", "multichip") \
+    if cpu or phase in ("router", "spec", "quant", "obs", "multichip",
+                        "faults", "disagg") \
             or (phase.startswith("coldstart") and phase != "coldstart_jax_tpu"):
         # the serving stack and its runner children must never dial the chip
         # — ALL cold-start stack phases, not just the original one (round-3
@@ -2837,7 +3122,27 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
             ("faults", ("faults_failed_requests", "faults_failovers",
                         "faults_recovered", "faults_recovery_p50_s",
                         "faults_recovery_p95_s",
-                        "faults_stream_splice_ok")),
+                        "faults_stream_splice_ok",
+                        # block-ship resume (ISSUE 16): the re-prefill
+                        # baseline it must beat, and proof the
+                        # kv_ship_error fallback was exercised
+                        "faults_kv_resumes", "faults_kv_fallbacks",
+                        "faults_recovery_p95_reprefill_s")),
+            # KV wire + disaggregated prefill/decode (ISSUE 16): a
+            # roundtrip that is not bit-exact strips
+            # kvwire_roundtrip_exact — bench_guard HARD-fails the
+            # vanished field (the quant parity precedent)
+            ("disagg", ("kvwire_roundtrip_exact",
+                        "kvwire_payload_kb_bf16", "kvwire_payload_kb_int8",
+                        "kvwire_export_ms_bf16", "kvwire_import_ms_bf16",
+                        "kvwire_export_ms_int8", "kvwire_import_ms_int8",
+                        "disagg_longdoc_ttft_p99_ms_on",
+                        "disagg_longdoc_ttft_p99_ms_off",
+                        "disagg_shortchat_ttft_p99_ms_on",
+                        "disagg_shortchat_ttft_p99_ms_off",
+                        "disagg_longdoc_ttft_improvement",
+                        "disagg_shortchat_ttft_ratio",
+                        "disagg_long_on_prefill_frac")),
             ("spec", ("spec_uplift_repetitive", "spec_adversarial_ratio",
                       "spec_tokens_per_sec_on_repetitive",
                       "spec_tokens_per_sec_off_repetitive",
@@ -2951,6 +3256,13 @@ _COMPACT_KEYS = (
     "faults_injected_crash", "faults_injected_stall",
     "faults_injected_rpc_error", "faults_stream_splice_ok",
     "faults_stream_splice_n",
+    "faults_kv_resumes", "faults_kv_fallbacks",
+    "faults_recovery_p95_reprefill_s",
+    "kvwire_roundtrip_exact",
+    "disagg_longdoc_ttft_p99_ms_on", "disagg_longdoc_ttft_p99_ms_off",
+    "disagg_shortchat_ttft_p99_ms_on", "disagg_shortchat_ttft_p99_ms_off",
+    "disagg_longdoc_ttft_improvement", "disagg_shortchat_ttft_ratio",
+    "disagg_long_on_prefill_frac",
     "quant_shard_bytes_ratio", "quant_shard_bytes_ratio_measured",
     "quant_kv_capacity_ratio", "quant_kv_capacity_ratio_measured",
     "quant_tokens_per_sec_ratio", "quant_tokens_per_sec_on",
@@ -3032,7 +3344,7 @@ def main() -> None:
                              "coldstart_native", "coldstart_jax",
                              "coldstart_jax_tpu", "coldstart_stream",
                              "router", "spec", "quant", "obs", "multichip",
-                             "faults"],
+                             "faults", "disagg"],
                     help="run one phase in-process (used by the orchestrator)")
     args = ap.parse_args()
 
@@ -3058,7 +3370,7 @@ def main() -> None:
               "router": bench_router, "spec": bench_spec,
               "quant": bench_quant, "obs": bench_obs,
               "multichip": bench_multichip,
-              "faults": bench_faults}[args.phase]
+              "faults": bench_faults, "disagg": bench_disagg}[args.phase]
         try:
             print(json.dumps(fn(quick=args.quick)))
         except Exception as exc:   # noqa: BLE001 — phase errors are data

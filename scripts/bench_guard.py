@@ -54,6 +54,15 @@ GUARDED_FIELDS = {
     # (jitter=0, 50 ms base) so the p95 is schedule-dominated, not
     # host-noise-dominated.
     "faults_recovery_p95_s": "down",
+    # KV wire + disaggregated prefill/decode (ISSUE 16): the roundtrip
+    # bit-exactness bit is binary and HARD (the disagg phase strips it
+    # when any pool roundtrip or the version gate fails — the quant
+    # parity precedent); the long-doc TTFT win of disagg-on routing
+    # must not decay. The short-chat ratio is deliberately NOT guarded
+    # here: the phase hard-gates it at 1.02, and its absolute value
+    # (~0.01-0.1) is far too small for a meaningful 15% ratio guard.
+    "kvwire_roundtrip_exact": "up",
+    "disagg_longdoc_ttft_improvement": "up",
     # speculative decoding (ISSUE 5): the repetitive-workload uplift must
     # not decay back toward 1.0, and the adversarial auto-disable must
     # keep holding the ratio near parity
@@ -116,7 +125,12 @@ HARD_FIELDS = ("quant_shard_bytes_ratio", "quant_kv_capacity_ratio",
                # coldstart_stream strips its decomposition when the traced
                # spans disagree with the measured intervals (>10%) — a
                # vanished value means the restore evidence went wrong
-               "coldstart_overlap_frac")
+               "coldstart_overlap_frac",
+               # the disagg phase strips its kvwire fields when any pool
+               # roundtrip loses bit-exactness or the version gate fails
+               # to refuse a bumped reader — the quant parity precedent:
+               # a stripped round IS the wire-format regression
+               "kvwire_roundtrip_exact")
 
 
 def extract_metrics(path: str) -> dict:

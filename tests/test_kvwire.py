@@ -1,0 +1,352 @@
+"""KV wire format (ISSUE 16): serializable paged-KV blocks.
+
+Roundtrips are judged BIT-exact — the payload is raw pool bytes plus a
+canonical JSON header, so a re-export of imported blocks must reproduce
+the original payload byte-for-byte (bf16 and int8+scales alike). The
+reader is version-gated: an unknown version is a clear refusal before
+any pool mutation, never a mid-import KeyError. Re-shard roundtrips
+(tp=2 exporter ↔ tp=1 importer) ride the multichip tier's forced
+8-device CPU mesh. Greedy parity of a shipped-KV resume against an
+uninterrupted generation is judged at f32 (the multichip/spec/quant
+precedent: no bf16 argmax-tie noise).
+"""
+
+import asyncio
+import struct
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu9.models import init_decoder
+from tpu9.models.llama import LLAMA_PRESETS
+from tpu9.serving import kvwire
+from tpu9.serving.engine import EngineConfig, InferenceEngine
+from tpu9.serving.kvpool import KvPool
+from tpu9.serving.paged_kv import BlockAllocator, PrefixCache
+from tpu9.serving.shard import make_policy
+
+TINY = LLAMA_PRESETS["llama-tiny"]
+TINYF = replace(TINY, dtype=jnp.float32)
+BS = 32
+
+
+def _ecfg(**kw):
+    base = dict(max_batch=2, max_seq_len=256, prefill_buckets=(32, 64),
+                decode_steps=(1, 4), kv_block_size=BS, kv_pool_blocks=16,
+                prefill_chunk=32, prefix_cache_blocks=8)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _pool(kv_quant=False, topology=None, cfg=TINY, **kw):
+    policy = make_policy(topology)
+    pool = KvPool(cfg, _ecfg(**kw), kv_quant, policy)
+    return pool, pool.init_arrays()
+
+
+def _fill(pool, kv, blocks, seed=0):
+    """Deterministic non-trivial content in the given blocks of every
+    wire plane (full int8 range / normal floats — bit patterns that
+    would expose any dtype or byte-order sloppiness)."""
+    rng = np.random.default_rng(seed)
+    idx = jnp.asarray(blocks, dtype=jnp.int32)
+    new = dict(kv)
+    for name in pool.wire_names():
+        shape, dt = pool.array_shapes()[name]
+        sub = (shape[0], len(blocks)) + tuple(shape[2:])
+        if np.dtype(dt) == np.dtype(np.int8):
+            vals = rng.integers(-127, 128, size=sub, dtype=np.int8)
+        else:
+            vals = rng.standard_normal(sub).astype(np.float32)
+        new[name] = new[name].at[:, idx].set(
+            jnp.asarray(vals, dtype=dt))
+    new.update(pool.policy.place_kv({n: new[n] for n in pool.wire_names()}))
+    return new
+
+
+def _export(pool, kv, blocks, tokens):
+    return pool.export_blocks(kv, blocks, PrefixCache._key(tokens),
+                              len(tokens))
+
+
+def _reexport(pool, kv, tokens):
+    """Re-serialize the adopted prefix from a second pool."""
+    entry = pool.prefix_cache.acquire_for_export(tokens)
+    assert entry is not None and entry.n_tokens == len(tokens)
+    try:
+        return pool.export_blocks(kv, entry.blocks, entry.key,
+                                  entry.n_tokens)
+    finally:
+        pool.prefix_cache.release_pin(entry)
+
+
+# ---------------------------------------------------------------------------
+# roundtrip bit-exactness
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_quant", [False, True],
+                         ids=["bf16", "int8+scales"])
+def test_roundtrip_bit_exact(kv_quant):
+    """export → import → re-export reproduces the payload BYTE-for-byte
+    (header included), and the decoded planes match the source arrays
+    bitwise — payload and scale planes alike."""
+    pool_a, kv_a = _pool(kv_quant)
+    blocks = pool_a.alloc_blocks(3)
+    kv_a = _fill(pool_a, kv_a, blocks)
+    tokens = [(i * 7) % 211 + 1 for i in range(3 * BS)]
+    payload = _export(pool_a, kv_a, blocks, tokens)
+
+    header, planes = kvwire.decode_blocks(payload)
+    assert header["n_blocks"] == 3 and header["n_tokens"] == len(tokens)
+    if kv_quant:
+        assert set(planes) == {"k", "v", "k_scale", "v_scale"}
+        assert planes["k_scale"].dtype == np.float32
+    for name in pool_a.wire_names():
+        src = np.asarray(pool_a.policy.gather_kv(
+            name, kv_a[name]))[:, np.asarray(blocks)]
+        assert planes[name].tobytes() == src.tobytes(), name
+
+    pool_b, kv_b = _pool(kv_quant)
+    kv_b, adopted, _ = pool_b.import_blocks(kv_b, payload)
+    assert adopted
+    assert pool_b.prefix_cache.stats()["adopted"] == 1
+    assert _reexport(pool_b, kv_b, tokens) == payload
+
+
+def test_import_is_noop_hit_when_prefix_already_cached():
+    pool_a, kv_a = _pool()
+    blocks = pool_a.alloc_blocks(2)
+    kv_a = _fill(pool_a, kv_a, blocks)
+    tokens = list(range(1, 2 * BS + 1))
+    payload = _export(pool_a, kv_a, blocks, tokens)
+    pool_b, kv_b = _pool()
+    kv_b, adopted, _ = pool_b.import_blocks(kv_b, payload)
+    assert adopted
+    used = pool_b.allocator.used_count
+    kv_b2, adopted2, _ = pool_b.import_blocks(kv_b, payload)
+    assert adopted2 and kv_b2 is kv_b           # raced a local prefill:
+    assert pool_b.allocator.used_count == used  # zero pool work
+
+
+def test_import_over_budget_releases_blocks():
+    """An adopt that cannot fit the prefix budget must hand every block
+    back (caller falls back to re-prefill) — not leak them."""
+    pool_a, kv_a = _pool()
+    blocks = pool_a.alloc_blocks(4)
+    kv_a = _fill(pool_a, kv_a, blocks)
+    tokens = list(range(2, 4 * BS + 2))
+    payload = _export(pool_a, kv_a, blocks, tokens)
+    pool_b, kv_b = _pool(prefix_cache_blocks=2)
+    _, adopted, _ = pool_b.import_blocks(kv_b, payload)
+    assert not adopted
+    assert pool_b.allocator.used_count == 1     # just the trash block
+
+
+# ---------------------------------------------------------------------------
+# version-gated reader: loud refusal BEFORE any pool mutation
+# ---------------------------------------------------------------------------
+
+def _payload():
+    pool, kv = _pool()
+    blocks = pool.alloc_blocks(2)
+    kv = _fill(pool, kv, blocks)
+    return _export(pool, kv, blocks, list(range(1, 2 * BS + 1)))
+
+
+def test_unknown_version_refused_with_clear_error():
+    data = bytearray(_payload())
+    struct.pack_into("<H", data, 7, kvwire.FORMAT_VERSION + 1)
+    with pytest.raises(kvwire.KvWireError, match="unsupported format "
+                       "version 2"):
+        kvwire.decode_header(bytes(data))
+    # the pool path fails identically, and touches nothing
+    pool, kv = _pool()
+    with pytest.raises(kvwire.KvWireError, match="version"):
+        pool.import_blocks(kv, bytes(data))
+    assert pool.allocator.used_count == 1       # just the trash block
+    assert pool.prefix_cache.stats()["adopted"] == 0
+
+
+def test_bad_magic_and_truncation_refused():
+    data = _payload()
+    with pytest.raises(kvwire.KvWireError, match="bad magic"):
+        kvwire.decode_header(b"NOTKV\x00\x00" + data[7:])
+    with pytest.raises(kvwire.KvWireError, match="truncated"):
+        kvwire.decode_header(data[:5])
+    with pytest.raises(kvwire.KvWireError, match="truncated"):
+        kvwire.decode_blocks(data[:-16])
+    with pytest.raises(kvwire.KvWireError, match="truncated"):
+        kvwire.decode_header(data[:kvwire._PRELUDE.size + 4])
+
+
+def test_geometry_mismatch_reads_like_a_diff():
+    payload = _payload()
+    pool16, kv16 = _pool(kv_block_size=16, prefill_buckets=(16, 32),
+                         prefill_chunk=16)
+    with pytest.raises(kvwire.KvWireError, match="kv_block_size"):
+        pool16.import_blocks(kv16, payload)
+    pool_q, kv_q = _pool(kv_quant=True)
+    with pytest.raises(kvwire.KvWireError, match="kv_dtype"):
+        pool_q.import_blocks(kv_q, payload)
+    assert pool_q.allocator.used_count == 1
+
+
+# ---------------------------------------------------------------------------
+# export pin vs concurrent eviction (satellite: the lookup/evict race
+# class, extended to exports)
+# ---------------------------------------------------------------------------
+
+def test_export_pin_blocks_concurrent_eviction():
+    """Regression: an admission running dry calls evict_for_space while
+    an export holds the entry pinned mid-gather — the entry (and its
+    blocks) must be untouchable until the pin is released."""
+    a = BlockAllocator(8, 4)
+    pc = PrefixCache(a, max_blocks=4)
+    blocks = a.alloc(3)
+    tokens = list(range(12))
+    pc.insert(tokens, blocks)
+    a.release(blocks)                   # only the cache holds them now
+    entry = pc.acquire_for_export(tokens)
+    assert entry is not None and entry.blocks == blocks
+    pc.evict_for_space(6)               # the concurrent evictor runs dry
+    assert pc.contains(entry.key)
+    assert a.used_count == 3            # blocks NOT recycled mid-gather
+    pc.release_pin(entry)
+    pc.evict_for_space(6)
+    assert not pc.contains(entry.key)   # unpinned → ordinary LRU victim
+    assert a.used_count == 0
+
+
+def test_acquire_for_export_does_not_skew_admission_signals():
+    a = BlockAllocator(8, 4)
+    pc = PrefixCache(a, max_blocks=4)
+    blocks = a.alloc(2)
+    pc.insert(list(range(8)), blocks)
+    a.release(blocks)
+    before = (pc.hits, pc.misses, pc.tokens_reused)
+    entry = pc.acquire_for_export(list(range(8)))
+    pc.release_pin(entry)
+    assert pc.acquire_for_export([99] * 8) is None
+    assert (pc.hits, pc.misses, pc.tokens_reused) == before
+
+
+# ---------------------------------------------------------------------------
+# re-shard roundtrips (multichip tier: forced 8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+def _assert_reshard(src_topo, dst_topo):
+    pool_a, kv_a = _pool(topology=src_topo)
+    blocks = pool_a.alloc_blocks(3)
+    kv_a = _fill(pool_a, kv_a, blocks)
+    tokens = [(i * 11) % 199 + 1 for i in range(3 * BS)]
+    payload = _export(pool_a, kv_a, blocks, tokens)
+    pool_b, kv_b = _pool(topology=dst_topo)
+    kv_b, adopted, header = pool_b.import_blocks(kv_b, payload)
+    assert adopted
+    # planes are CANONICAL: a re-export from the other topology matches
+    # bitwise everywhere except the informational topology descriptor
+    back = _reexport(pool_b, kv_b, tokens)
+    h1, p1 = kvwire.decode_blocks(payload)
+    h2, p2 = kvwire.decode_blocks(back)
+    assert h1.pop("topology") == (pool_a.policy.describe())
+    assert h2.pop("topology") == (pool_b.policy.describe())
+    assert h1 == h2
+    for name in p1:
+        assert p1[name].tobytes() == p2[name].tobytes(), name
+
+
+@pytest.mark.multichip
+def test_tp2_export_tp1_import_roundtrip():
+    """A tp=2 exporter (head-axis shards gathered through the policy)
+    interoperates byte-for-byte with a tp=1 importer."""
+    _assert_reshard("2x1", None)
+
+
+@pytest.mark.multichip
+def test_tp1_export_tp2_import_roundtrip():
+    """And the reverse: a single-device payload re-places onto the mesh
+    (import scatters, place_kv re-pins the head-axis layout)."""
+    _assert_reshard(None, "2x1")
+
+
+@pytest.mark.multichip
+def test_tp2_int8_scales_reshard_roundtrip():
+    pool_a, kv_a = _pool(kv_quant=True, topology="2x1")
+    blocks = pool_a.alloc_blocks(2)
+    kv_a = _fill(pool_a, kv_a, blocks)
+    tokens = list(range(3, 2 * BS + 3))
+    payload = _export(pool_a, kv_a, blocks, tokens)
+    pool_b, kv_b = _pool(kv_quant=True)
+    kv_b, adopted, _ = pool_b.import_blocks(kv_b, payload)
+    assert adopted
+    _, p1 = kvwire.decode_blocks(payload)
+    _, p2 = kvwire.decode_blocks(_reexport(pool_b, kv_b, tokens))
+    for name in ("k", "v", "k_scale", "v_scale"):
+        assert p1[name].tobytes() == p2[name].tobytes(), name
+
+
+# ---------------------------------------------------------------------------
+# shipped-KV resume: greedy parity vs an uninterrupted generation
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_f32():
+    return init_decoder(jax.random.PRNGKey(0), TINYF)
+
+
+def _engine(params, **kw):
+    return InferenceEngine(params, TINYF, _ecfg(**kw))
+
+
+def _generate(engine, prompt, max_new):
+    async def go():
+        await engine.start()
+        out = await engine.generate(list(prompt), max_new_tokens=max_new)
+        await engine.stop()
+        return out
+
+    return asyncio.run(go())
+
+
+def test_shipped_kv_resume_greedy_parity(tiny_f32):
+    """The failover/drain resume path end to end at the engine layer: a
+    victim generates part way, its prefix KV ships to a survivor via
+    export→adopt, and the survivor's watermark-replay continuation must
+    equal the uninterrupted reference exactly."""
+    prompt = [(i * 5) % 200 + 1 for i in range(80)]     # 2 full blocks
+    ref = _generate(_engine(tiny_f32), prompt, 10)
+
+    victim = _engine(tiny_f32)
+    delivered = _generate(victim, prompt, 4)            # dies at wm=4
+    payload = victim.export_prefix_kv(prompt)
+    assert payload is not None
+    assert victim.stats()["kvwire_exports"] == 1
+
+    survivor = _engine(tiny_f32)
+    assert survivor.adopt_kv(payload)
+    rest = _generate(survivor, prompt + delivered, 10 - len(delivered))
+    assert delivered + rest == ref
+
+    st = survivor.stats()
+    assert st["kvwire_import_hits"] == 1
+    assert st["kvwire_blocks_imported"] == 2
+    assert survivor.prefix_cache.stats()["adopted"] == 1
+    # the adopt really fed admission: the resume hit the shipped prefix
+    assert survivor.prefix_cache.stats()["hits"] >= 1
+
+
+def test_adopt_kv_rejects_malformed_before_any_mutation(tiny_f32):
+    eng = _engine(tiny_f32)
+    with pytest.raises(kvwire.KvWireError):
+        eng.adopt_kv(b"garbage")
+    assert eng.stats()["kvwire_import_hits"] == 0
+    assert eng.allocator.used_count == 1
+
+
+def test_export_miss_counts_and_returns_none(tiny_f32):
+    eng = _engine(tiny_f32)
+    assert eng.export_prefix_kv(list(range(64))) is None
+    assert eng.stats()["kvwire_export_misses"] == 1

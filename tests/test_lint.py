@@ -917,6 +917,40 @@ def test_fault_plane_contract_declared_and_live():
         assert gate < imp, f"{rel}: faults import is not env-gated"
 
 
+def test_kvwire_contract_declared_and_live():
+    """ISSUE 16 satellite: the KV wire format is a serialization boundary
+    — restricted to the two ends of the pipe (serving encodes/decodes,
+    the runner moves payloads between transport and engine), the cache
+    transport and bench. The gateway and router must NEVER import it:
+    they speak keys/flags/token counts, and a payload crossing the
+    control plane is exactly the layering bug this contract catches."""
+    cfg = bnd.BoundaryConfig.load(
+        os.path.join(REPO, "tpu9", "analysis", "boundaries.toml"))
+    rmod = "tpu9.serving.kvwire"
+    assert rmod in cfg.restricted
+    importers = cfg.restricted[rmod]
+    for needed in ("tpu9.serving", "tpu9.runner", "tpu9.cache", "bench"):
+        assert needed in importers, importers
+    for banned in ("tpu9.gateway", "tpu9.router"):
+        assert not any(i == banned or i.startswith(banned + ".")
+                       for i in importers), importers
+    # liveness: the pool (encode/decode) and the runner (header peeks on
+    # publish/drain) really import the module — real edges, not a name
+    edges = _real_imports()
+    assert any(t.startswith(rmod)
+               for t in edges.get("tpu9.serving.kvpool", set()))
+    assert any(t.startswith(rmod)
+               for t in edges.get("tpu9.runner.llm", set()))
+    # and the control plane genuinely does not touch payloads
+    for mod, targets in edges.items():
+        if mod.startswith("tpu9.gateway") or mod.startswith("tpu9.router"):
+            assert not any(t.startswith(rmod) for t in targets), mod
+    # the module runs on the replica: the hot-path policy must cover it
+    raw = tomlmini.load_file(
+        os.path.join(REPO, "tpu9", "analysis", "boundaries.toml"))
+    assert "tpu9/serving/kvwire.py" in raw["jax"]["hotpath"]["files"]
+
+
 def test_tomlmini_parses_boundaries_toml():
     raw = tomlmini.load_file(
         os.path.join(REPO, "tpu9", "analysis", "boundaries.toml"))

@@ -167,9 +167,42 @@ class EndpointInstance:
             sample_extra=self._sample_extra,
             checkpoint_lookup=checkpoint_lookup,
             secret_env_fn=secret_env_fn, disks=disks,
-            drain_cb=(fleet_router.drain_replica
+            drain_cb=(self._drain_replica
                       if fleet_router is not None else None))
         self._containers = containers
+
+    async def _drain_replica(self, container_id: str) -> bool:
+        """Router drain with the kvwire migration hook attached (ISSUE
+        16): the router sequences (eject → migrate → wait) but stays
+        payload-free — the actual /drain RPC lives here."""
+        return await self.fleet_router.drain_replica(
+            container_id, migrate=self._migrate_streams)
+
+    async def _migrate_streams(self, container_id: str) -> None:
+        """Ask a draining-but-still-serving replica to export its
+        in-flight streams' KV blocks (runner POST /drain). The kv_key
+        events it pushes into those streams let the gateway's failover
+        loop resume the generations on a survivor by block ship instead
+        of replaying the whole prefill. Best-effort: any failure just
+        means those streams fall back to re-prefill resume."""
+        import aiohttp
+        address = await self._containers.get_address(container_id)
+        if not address:
+            return
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"http://{address}/drain", json={},
+                        timeout=aiohttp.ClientTimeout(total=10)) as resp:
+                    data = await resp.json(content_type=None)
+                    if resp.status < 400 and data.get("migrated"):
+                        log.info(
+                            "drain migration: exported KV for %d "
+                            "stream(s) on %s", len(data["migrated"]),
+                            container_id)
+        except Exception as exc:    # noqa: BLE001 — best-effort
+            log.debug("drain migration skipped for %s: %s",
+                      container_id, exc)
 
     async def _sample_extra(self):
         """Queue depth + pressure. Pressure prefers the engines' reported

@@ -83,7 +83,13 @@ class CacheClient:
         self.stats = {"local_hits": 0, "peer_hits": 0, "source_fetches": 0,
                       "peer_errors": 0, "hedged_reads": 0, "hedge_wins": 0,
                       "hedge_wasted_bytes": 0, "bytes_local": 0,
-                      "bytes_peer": 0, "bytes_source": 0}
+                      "bytes_peer": 0, "bytes_source": 0,
+                      # kv: namespace (ISSUE 16) — shipped KV-block
+                      # payload traffic, split out from weight chunks so
+                      # the cache-plane evidence can tell a restore storm
+                      # from a migration storm
+                      "kv_puts": 0, "kv_gets": 0, "kv_misses": 0,
+                      "kv_bytes_put": 0, "kv_bytes_get": 0}
         # fault-injection plane (ISSUE 15): env-gated, None in production
         # — peer_read_error / peer_read_slow hooks in _peer_get exercise
         # the hedged-read + failover machinery deterministically
@@ -440,6 +446,31 @@ class CacheClient:
             await asyncio.gather(*[self._peer_put(peer, digest, data)
                                    for peer in ordered])
         return digest
+
+    # -- kv: namespace (ISSUE 16) -------------------------------------------
+    # Shipped paged-KV blocks ride the SAME content-addressed transport
+    # as weight chunks (HRW placement, hedged verified reads, replica
+    # fan-out) — digests stay plain chunk hashes because peer reads
+    # verify `chunk_hash(data) == digest`. The namespace is a ledger
+    # split, not a wire change: these wrappers attribute the traffic.
+
+    async def put_kv(self, payload: bytes) -> str:
+        """Publish one kvwire payload; returns its content digest (the
+        key an SSE ``kv_key`` event / drain hand-off carries)."""
+        digest = await self.put(payload)
+        self.stats["kv_puts"] += 1
+        self.stats["kv_bytes_put"] += len(payload)
+        return digest
+
+    async def get_kv(self, digest: str) -> Optional[bytes]:
+        """Fetch one shipped payload (local → hedged peers → source)."""
+        data = await self.get(digest)
+        if data is None:
+            self.stats["kv_misses"] += 1
+            return None
+        self.stats["kv_gets"] += 1
+        self.stats["kv_bytes_get"] += len(data)
+        return data
 
     async def get_many(self, digests: Sequence[str],
                        max_parallel: int = 8) -> dict[str, Optional[bytes]]:

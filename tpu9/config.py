@@ -213,6 +213,27 @@ class RouterConfig:
     # into replayed prefills. Non-resumable streams are never gap-bounded
     # (they keep the full request timeout).
     stream_gap_s: float = 90.0
+    # ---- disaggregated prefill/decode + KV block shipping (ISSUE 16) ----
+    # Off by default: both features change WHERE work lands, so a fleet
+    # opts in per deployment. env overrides TPU9_DISAGG / TPU9_KV_SHIP
+    # ("1"/"0") for bench and chaos runs.
+    disagg_enabled: bool = False
+    # a request whose prompt is at least this many tokens is "prefill
+    # heavy": routed to the prefill-leaning partition and asked to export
+    # its prefill KV for a decode-side adopt
+    disagg_prefill_tokens: int = 512
+    # fraction of healthy replicas (ceil, always leaving ≥1 decode
+    # replica) that lean prefill; partition is deterministic by sorted
+    # container id so every router instance agrees without coordination
+    disagg_prefill_fraction: float = 0.5
+    # KV block shipping for failover resume + drain migration: when on,
+    # a resumable stream's exporter emits kv_key events and the failover
+    # target tries a block-ship adopt before re-prefilling
+    kv_ship_enabled: bool = True
+    # streams below this many delivered prompt+output tokens re-prefill
+    # instead of shipping (a ship smaller than this costs more than the
+    # prefill it saves)
+    kv_ship_min_tokens: int = 32
 
 
 @dataclass

@@ -47,7 +47,13 @@ ENGINE_SERIES = ("tokens_per_sec", "token_pressure", "queued",
                  "hbm_used_gb_per_chip", "hbm_peak_gb_per_chip",
                  "hbm_predicted_gb_per_chip", "hbm_limit_gb_per_chip",
                  "windows_processed", "last_dispatch_age_s",
-                 "last_progress_age_s")
+                 "last_progress_age_s",
+                 # kvwire block-ship plane (ISSUE 16): export/import
+                 # ledger + ship latency — `tpu9 top`'s migration view
+                 "kvwire_blocks_exported", "kvwire_blocks_imported",
+                 "kvwire_bytes_exported", "kvwire_bytes_imported",
+                 "kvwire_import_hits", "kvwire_import_fallbacks",
+                 "kvwire_ship_p50_s", "kvwire_ship_p95_s")
 # router snapshot fields mirrored into per-stub timeline series
 ROUTER_SERIES = ("queue_depth", "shed_rate", "pressure")
 # worker-heartbeated cache-plane counters mirrored 1:1 into per-worker
@@ -136,6 +142,11 @@ class FleetObserver:
             if note is not None:     # duck-typed router fakes in tests
                 note(container_id, state,
                      reason=str(stats.get("health_reason", "")))
+        # kvwire gauges (ISSUE 16): only for replicas that ship blocks —
+        # a fleet with shipping off mints zero extra series
+        if any(k.startswith("kvwire_") for k in stats):
+            from ..observability.health import publish_kvwire
+            publish_kvwire(container_id, stats)
         # MFU/MBU priced control-plane-side from the engine's physics
         # constants (bytes / FLOPs per token per chip) × tokens/sec,
         # against the chip's public peaks — honest ~0 on CPU hosts

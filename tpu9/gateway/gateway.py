@@ -2290,6 +2290,17 @@ class Gateway:
             if self.fleet_router is not None else None
         resume = sv.StreamResumption(llm["prompt"], llm["max_new"],
                                      llm["payload"]) if llm else None
+        # kvwire block shipping (ISSUE 16): ask the serving replica to
+        # export its prefill KV — the kv_key announcement primes O(1)
+        # failover resume (and the disagg decode handoff reuses the same
+        # request mode). TPU9_KV_SHIP=0/1 overrides for chaos runs.
+        ship_env = os.environ.get("TPU9_KV_SHIP", "")
+        if (resume is not None and not llm["payload"].get("adopt_kv")
+                and len(llm["prompt"]) >= rcfg.kv_ship_min_tokens
+                and (ship_env == "1" if ship_env
+                     else rcfg.kv_ship_enabled)):
+            body = json.dumps({**llm["payload"], "kv_export": True,
+                               "stream": True}).encode()
         budget = sv.FailoverBudget(
             rcfg.failover_max_attempts
             if (resume is not None
@@ -2642,6 +2653,13 @@ class Gateway:
                     except (ConnectionResetError, OSError) as exc:
                         log.debug("client gone mid-stream: %s", exc)
                         return sv.AttemptOutcome(kind="client_gone")
+                elif "kv_key" in ev:
+                    # kvwire announcement (ISSUE 16): the exporting
+                    # replica published this stream's KV blocks —
+                    # remember the key for block-ship resume, never
+                    # forward transport bookkeeping to the client
+                    resume.note_kv(str(ev.get("kv_key", "")),
+                                   int(ev.get("n_tokens", 0) or 0))
                 elif ev.get("done"):
                     return sv.AttemptOutcome(kind="done")
                 elif "error" in ev:

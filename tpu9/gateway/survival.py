@@ -261,6 +261,14 @@ class StreamResumption:
         self.payload = dict(payload or {})
         self.delivered: list[int] = []
         self.finished = False        # saw a done event
+        # kvwire block-ship resume (ISSUE 16): the latest kv_key event
+        # the exporting replica announced, and how many tokens of the
+        # sequence that payload covers. A resume attempt forwards it as
+        # an adopt_kv hint — the target splices the shipped blocks and
+        # the replayed prefill collapses to the unshipped suffix. Always
+        # the LATEST key: drain re-exports supersede the prefill ship.
+        self.kv_key = ""
+        self.kv_tokens = 0
 
     @property
     def watermark(self) -> int:
@@ -272,6 +280,14 @@ class StreamResumption:
 
     def note_token(self, tok: int) -> None:
         self.delivered.append(int(tok))
+
+    def note_kv(self, key: str, n_tokens: int) -> None:
+        """A ``kv_key`` announcement from the serving replica (emitted
+        after prefill, or by a drain re-export). Swallowed by the relay
+        — clients never see transport bookkeeping."""
+        if key:
+            self.kv_key = str(key)
+            self.kv_tokens = int(n_tokens or 0)
 
     @property
     def ended_on_eos(self) -> bool:
@@ -296,6 +312,13 @@ class StreamResumption:
         out["tokens"] = self.prompt + self.delivered
         out["max_new_tokens"] = self.remaining
         out["stream"] = True
+        out.pop("kv_export", None)      # the handoff already happened
+        if self.kv_key:
+            # block-ship resume hint: strictly best-effort on the target
+            # (fetch miss / geometry mismatch / pool pressure all fall
+            # back to the re-prefill this body already encodes)
+            out["adopt_kv"] = {"key": self.kv_key,
+                               "n_tokens": self.kv_tokens}
         return json.dumps(out).encode()
 
     def done_event(self) -> dict:

@@ -191,23 +191,47 @@ class EngineWatchdog:
 
 # -- gauge publication (gateway side, heartbeat cadence) ---------------------
 
-# every per-replica gauge publish_health may mint — forget_replica must
-# drop exactly this set or dead replicas alert forever
+# every per-replica gauge publish_health/publish_kvwire may mint —
+# forget_replica must drop exactly this set or dead replicas alert
+# forever
 _REPLICA_GAUGES = ("tpu9_health_state", "tpu9_health_stalled",
                    "tpu9_hbm_used_gb", "tpu9_hbm_peak_gb",
                    "tpu9_hbm_predicted_gb", "tpu9_hbm_limit_gb",
                    "tpu9_hbm_headroom_frac")
+# kvwire block-ship plane (ISSUE 16): gauge name ↔ heartbeat scalar
+_KVWIRE_GAUGES = (
+    ("tpu9_kvwire_blocks_exported", "kvwire_blocks_exported"),
+    ("tpu9_kvwire_blocks_imported", "kvwire_blocks_imported"),
+    ("tpu9_kvwire_bytes_exported", "kvwire_bytes_exported"),
+    ("tpu9_kvwire_bytes_imported", "kvwire_bytes_imported"),
+    ("tpu9_kvwire_import_hits", "kvwire_import_hits"),
+    ("tpu9_kvwire_import_fallbacks", "kvwire_import_fallbacks"),
+    ("tpu9_kvwire_ship_p50_s", "kvwire_ship_p50_s"),
+    ("tpu9_kvwire_ship_p95_s", "kvwire_ship_p95_s"))
 
 
 def forget_replica(container_id: str) -> None:
-    """Drop a dead replica's health/HBM gauges (called when the fleet
-    observer ages it out of the engines merge): its last verdict —
+    """Drop a dead replica's health/HBM/kvwire gauges (called when the
+    fleet observer ages it out of the engines merge): its last verdict —
     typically ``stalled`` — must not keep alerting for a container that
     no longer exists, and under scale-to-zero churn container ids are
     unbounded, so leaked series grow monotonically."""
     labels = {"replica": container_id}
     for gauge in _REPLICA_GAUGES:
         metrics.remove_gauge(gauge, labels=labels)
+    for gauge, _key in _KVWIRE_GAUGES:
+        metrics.remove_gauge(gauge, labels=labels)
+
+
+def publish_kvwire(container_id: str, stats: dict) -> None:
+    """``tpu9_kvwire_*`` gauges for one replica heartbeat (ISSUE 16):
+    the block-ship ledger — exported/imported blocks+bytes, adopt hits
+    vs re-prefill fallbacks, ship latency percentiles. Same replica-
+    label lifecycle as the health gauges (forget_replica drops them)."""
+    labels = {"replica": container_id}
+    for gauge, key in _KVWIRE_GAUGES:
+        if key in stats:
+            metrics.set_gauge(gauge, _num(stats, key), labels=labels)
 
 
 def publish_health(container_id: str, stats: dict) -> None:

@@ -34,6 +34,7 @@ import asyncio
 import json
 import logging
 import math
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Optional
@@ -440,12 +441,28 @@ class FleetRouter:
 
     # -- drain -----------------------------------------------------------------
 
-    async def drain_replica(self, container_id: str) -> bool:
+    async def drain_replica(self, container_id: str,
+                            migrate: Optional[Callable[
+                                [str], Awaitable[None]]] = None) -> bool:
         """Graceful scale-down: stop routing to the replica, drop its
         affinity entries (traffic re-homes now, not at TTL), and wait for
-        its in-flight requests to complete."""
+        its in-flight requests to complete.
+
+        ``migrate`` (ISSUE 16) is an optional injected hook run AFTER the
+        replica leaves rotation but BEFORE the drain wait — the control
+        plane uses it to ask the still-serving replica to export its
+        in-flight streams' KV blocks, so generations that outlive the
+        drain window resume elsewhere by block ship instead of dying
+        with the container. Injected because the router is payload-free
+        by contract (BND001: no serving/runner imports here)."""
         self.admission.mark_draining(container_id)
         self.affinity.forget_replica(container_id)
+        if migrate is not None:
+            try:
+                await migrate(container_id)
+            except Exception as exc:    # noqa: BLE001 — best-effort
+                log.warning("drain migration hook failed for %s: %s",
+                            container_id, exc)
         drained = await self.admission.wait_drained(
             container_id, timeout=self.cfg.drain_timeout_s)
         if not drained:
@@ -526,8 +543,52 @@ class FleetRouter:
         # (load preserves replica order); a stalled replica must not even
         # be an affinity target or it re-enters through the JSQ fallback
         order = self.affinity.order(body, list(load), load, saturated)
+        order = self._disagg_order(body, order)
         return (order, budgets, sum(budgets.values()),
                 self.affinity.hits > hits0)
+
+    def _disagg_on(self) -> bool:
+        env = os.environ.get("TPU9_DISAGG", "")
+        if env:
+            return env == "1"
+        return bool(getattr(self.cfg, "disagg_enabled", False))
+
+    def _disagg_order(self, body: bytes, order: list[str]) -> list[str]:
+        """Disaggregated prefill/decode placement (ISSUE 16): classify
+        the request by prompt/output shape and bias the candidate order
+        toward the matching partition. The partition is DETERMINISTIC —
+        sorted container ids, the first ``ceil(fraction * n)`` lean
+        prefill, always leaving at least one decode replica — so every
+        router instance agrees without coordination, and the same split
+        is stable across dispatch passes (a long-doc prompt keeps
+        landing where its prefix already is).
+
+        This is a BIAS, not a fence: a saturated preferred partition
+        still falls through to the other one (availability beats
+        placement), and an ``adopt_kv`` resume/handoff body is always
+        decode-leaning regardless of its replayed prompt length — the
+        whole point of the handoff is to get the long sequence OFF the
+        prefill replicas."""
+        if len(order) < 2 or not self._disagg_on():
+            return order
+        try:
+            payload = json.loads(body or b"{}")
+            tokens = payload.get("tokens") \
+                or payload.get("prompt_tokens") or []
+            prompt_len = len(tokens) if isinstance(tokens, list) else 0
+            adopting = bool(payload.get("adopt_kv"))
+        except (ValueError, TypeError, AttributeError):
+            return order
+        ranked = sorted(order)
+        frac = float(getattr(self.cfg, "disagg_prefill_fraction", 0.5))
+        n_prefill = min(max(1, math.ceil(len(ranked) * frac)),
+                        len(ranked) - 1)
+        prefill = set(ranked[:n_prefill])
+        heavy = (not adopting and prompt_len
+                 >= int(getattr(self.cfg, "disagg_prefill_tokens", 512)))
+        want = prefill if heavy else set(ranked) - prefill
+        return ([c for c in order if c in want]
+                + [c for c in order if c not in want])
 
     async def _dispatch_loop(self, st: _StubState) -> None:
         stub_id = st.stub.stub_id

@@ -65,6 +65,29 @@ def _build_engine(obj):
                     f"preset name; got {type(obj)}")
 
 
+def _kv_transport():
+    """CacheClient for shipped paged-KV blocks (ISSUE 16), or None when
+    the deployment has no kv cache plane. TPU9_KV_CACHE_DIR points the
+    replica at its content-addressed store (a shared dir in dev makes
+    every ship a local hit); TPU9_CACHE_PEERS ("host:port,host:port")
+    adds the HRW/hedged peer tier. The engine itself never sees this —
+    the runner moves bytes between transport and engine, keeping the
+    serving stack transport-free (BND001)."""
+    cache_dir = os.environ.get("TPU9_KV_CACHE_DIR", "")
+    if not cache_dir:
+        return None
+    from ..cache.client import CacheClient
+    from ..cache.store import DiskStore
+    peers = [p.strip() for p in
+             os.environ.get("TPU9_CACHE_PEERS", "").split(",") if p.strip()]
+
+    async def peer_fn():
+        return peers
+
+    return CacheClient(DiskStore(cache_dir), peer_fn,
+                       self_address=os.environ.get("TPU9_CACHE_SELF", ""))
+
+
 async def amain() -> None:
     cfg = RunnerConfig.from_env()
     gateway_url = os.environ.get("TPU9_GATEWAY_URL", "")
@@ -83,6 +106,11 @@ async def amain() -> None:
     # multi-host gang? join the slice-wide jax.distributed job first
     from ..parallel.distributed import initialize_multihost
     initialize_multihost()
+
+    # kvwire transport (ISSUE 16): optional, env-gated — block shipping
+    # (disagg handoff / drain migration / failover resume) degrades to
+    # plain re-prefill wherever this is None
+    kv_client = _kv_transport()
 
     # "beat": request completions set this to nudge the pressure loop into
     # an immediate heartbeat, so a completed request's engine spans ship
@@ -122,6 +150,66 @@ async def amain() -> None:
         except ValueError:
             return None
 
+    import time as _now
+
+    async def _kv_adopt(adopt) -> None:
+        """Best-effort pre-generate adopt of shipped KV blocks: fetch by
+        key, splice into the pool, register the exporter's prefix. Every
+        failure path (no transport, fetch miss, induced kv_ship_error,
+        malformed payload, pool pressure) degrades to plain re-prefill —
+        the request itself NEVER fails because a ship did."""
+        key = str((adopt or {}).get("key") or "")
+        if not key:
+            return
+        engine = state["engine"]
+        if kv_client is None:
+            engine.note_kvwire_fallback()
+            return
+        if faults is not None and faults.fire("kv_ship_error"):
+            log.warning("fault plane: induced kv ship error (adopt %s)",
+                        key[:12])
+            engine.note_kvwire_fallback()
+            return
+        t0 = _now.monotonic()
+        try:
+            data = await kv_client.get_kv(key)
+        except Exception as exc:    # noqa: BLE001 — transport, not request
+            log.warning("kv ship fetch failed (%s): %s", key[:12], exc)
+            data = None
+        if data is None:
+            engine.note_kvwire_fallback()
+            return
+        try:
+            if engine.adopt_kv(data):   # False self-counts the fallback
+                engine.note_kvwire_ship(_now.monotonic() - t0)
+        except Exception as exc:    # noqa: BLE001 — KvWireError and kin
+            log.warning("kv adopt rejected (%s): %s", key[:12], exc)
+            engine.note_kvwire_fallback()
+
+    async def _kv_publish(tokens: list) -> Optional[dict]:
+        """export_after_prefill: serialize the prefix-cached blocks the
+        prefill just inserted and publish them under the kv: namespace.
+        Returns the ``{"kv_key", "n_tokens"}`` announcement (the SSE
+        event body / JSON response fields), or None when there is
+        nothing to ship."""
+        if kv_client is None:
+            return None
+        engine = state["engine"]
+        try:
+            payload = engine.export_prefix_kv(tokens)
+            if payload is None:
+                return None
+            from ..serving.kvwire import decode_header
+            header, _ = decode_header(payload)
+            t0 = _now.monotonic()
+            digest = await kv_client.put_kv(payload)
+            engine.note_kvwire_ship(_now.monotonic() - t0)
+            return {"kv_key": digest,
+                    "n_tokens": int(header.get("n_tokens", 0))}
+        except Exception as exc:    # noqa: BLE001 — ship is best-effort
+            log.warning("kv export/publish failed: %s", exc)
+            return None
+
     async def generate(request: web.Request) -> web.StreamResponse:
         if not state["ready"]:
             return web.json_response({"error": "not ready"}, status=503)
@@ -150,16 +238,27 @@ async def amain() -> None:
                 return web.json_response(
                     {"error": "deadline_exceeded: budget exhausted "
                               "before dispatch"}, status=504)
+            # kvwire request modes (ISSUE 16): adopt shipped blocks
+            # BEFORE admission (the prefix cache then serves them to the
+            # ordinary prefix-reuse path); export after prefill when the
+            # router asked for a disagg handoff
+            if payload.get("adopt_kv"):
+                await _kv_adopt(payload.get("adopt_kv"))
+            kv_export = bool(payload.get("kv_export")
+                             or payload.get("export_after_prefill"))
             if payload.get("stream") or \
                     "text/event-stream" in request.headers.get("Accept", ""):
                 return await _generate_sse(request, prompt, max_new, trace,
-                                           budget)
+                                           budget, kv_export=kv_export)
             out = await state["engine"].generate(prompt,
                                                  max_new_tokens=max_new,
                                                  trace=trace,
                                                  budget_s=budget)
             state["beat"].set()
-            return web.json_response({"tokens": out})
+            resp = {"tokens": out}
+            if kv_export:
+                resp.update(await _kv_publish(prompt) or {})
+            return web.json_response(resp)
         except TimeoutError as exc:
             # engine deadline expiry (ISSUE 15): 504, not 400/500 — the
             # gateway must neither blame the request nor retry it
@@ -172,11 +271,13 @@ async def amain() -> None:
             return web.json_response(error_payload(exc), status=500)
 
     async def _generate_sse(request: web.Request, prompt: list,
-                            max_new: int, trace=None,
-                            budget=None) -> web.StreamResponse:
+                            max_new: int, trace=None, budget=None,
+                            kv_export: bool = False) -> web.StreamResponse:
         """Server-sent token stream: one `data: {"token": N}` event per
         generated token, then `data: {"done": true, "tokens": [...]}` —
-        relayed incrementally by the gateway's streaming proxy."""
+        relayed incrementally by the gateway's streaming proxy. Dict
+        items in the request queue (drain-migration ``kv_key``
+        announcements) pass through as their own events."""
         req = await state["engine"].generate(prompt, max_new_tokens=max_new,
                                              stream=True, trace=trace,
                                              budget_s=budget)
@@ -186,11 +287,17 @@ async def amain() -> None:
                                  "X-Accel-Buffering": "no"})
         await sr.prepare(request)
         out: list = []
+        # export_after_prefill (ISSUE 16): announce once, right after the
+        # first token proves prefill (and its prefix-cache insert) is done
+        kv_pending = kv_export and kv_client is not None
         try:
             while True:
                 tok = await req.queue.get()
                 if tok is None:
                     break
+                if isinstance(tok, dict):
+                    await sr.write(f"data: {json.dumps(tok)}\n\n".encode())
+                    continue
                 out.append(tok)
                 if faults is not None and faults.fire("proc_exit",
                                                       tokens=len(out)):
@@ -201,6 +308,12 @@ async def amain() -> None:
                     os._exit(17)
                 await sr.write(
                     f"data: {json.dumps({'token': tok})}\n\n".encode())
+                if kv_pending:
+                    kv_pending = False
+                    ev = await _kv_publish(prompt)
+                    if ev:
+                        await sr.write(
+                            f"data: {json.dumps(ev)}\n\n".encode())
             if req.error:
                 await sr.write(
                     f"data: {json.dumps({'error': req.error})}\n\n".encode())
@@ -253,12 +366,55 @@ async def amain() -> None:
         out["container_id"] = cfg.container_id
         return web.json_response(out)
 
+    async def drain(request: web.Request) -> web.Response:
+        """Graceful-drain migration (ISSUE 16): export every in-flight
+        stream's full-block KV prefix, publish it under the kv:
+        namespace, and push a ``kv_key`` event into each live SSE stream
+        — so when this replica stops, the gateway resumes those
+        generations on a survivor by block ship instead of re-prefill.
+        Best-effort per stream: a failed export just means that stream
+        falls back to re-prefill at failover."""
+        if not state["ready"]:
+            return web.json_response({"error": "not ready"}, status=503)
+        try:
+            payload = json.loads(await request.read() or b"{}")
+        except ValueError:
+            payload = {}
+        min_tokens = int(payload.get("min_tokens", 32))
+        engine = state["engine"]
+        migrated: dict = {}
+        if kv_client is not None:
+            from ..serving.kvwire import decode_header
+            for req in engine.active_stream_requests():
+                if len(req.prompt) + len(req.generated) < min_tokens:
+                    continue
+                try:
+                    blob = engine.export_request_kv(req.request_id)
+                    if blob is None:
+                        continue
+                    header, _ = decode_header(blob)
+                    t0 = _now.monotonic()
+                    digest = await kv_client.put_kv(blob)
+                    engine.note_kvwire_ship(_now.monotonic() - t0)
+                except Exception as exc:    # noqa: BLE001 — per-stream
+                    log.warning("drain export failed (%s): %s",
+                                req.request_id, exc)
+                    continue
+                ev = {"kv_key": digest,
+                      "n_tokens": int(header.get("n_tokens", 0))}
+                migrated[req.request_id] = ev
+                req.queue.put_nowait(dict(ev))
+        return web.json_response({"container_id": cfg.container_id,
+                                  "migrated": migrated,
+                                  "kv_transport": kv_client is not None})
+
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app.router.add_get("/health", health)
     app.router.add_post("/", generate)
     app.router.add_post("/generate", generate)
     app.router.add_get("/flight", flight)
     app.router.add_post("/profile", profile)
+    app.router.add_post("/drain", drain)
     runner = web.AppRunner(app)
     await runner.setup()
     await web.TCPSite(runner, os.environ.get("TPU9_BIND_HOST", "127.0.0.1"),
@@ -422,6 +578,12 @@ async def amain() -> None:
                     # coldstart_* scalars merged by /api/v1/coldstart
                     for k, v in stats.items():
                         if k.startswith("coldstart_"):
+                            extra[k] = v
+                    # kvwire (ISSUE 16): block-ship counters + latency
+                    # percentiles — one prefix covers the whole family
+                    # (engine.stats() keeps them flat on purpose)
+                    for k, v in stats.items():
+                        if k.startswith("kvwire_"):
                             extra[k] = v
                     # latency decomposition (ISSUE 8): per-phase p50/p95
                     # flat scalars → /api/v1/metrics "engines" section

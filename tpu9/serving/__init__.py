@@ -1,3 +1,4 @@
 from .engine import EngineConfig, InferenceEngine
+from .kvwire import KvWireError
 
-__all__ = ["EngineConfig", "InferenceEngine"]
+__all__ = ["EngineConfig", "InferenceEngine", "KvWireError"]

@@ -340,7 +340,16 @@ class InferenceEngine:
                        "decode_steps": 0, "admit_dispatches": 0,
                        "admit_interleaved_windows": 0,
                        "spec_windows": 0, "spec_proposed": 0,
-                       "spec_accepted": 0, "deadline_expired": 0}
+                       "spec_accepted": 0, "deadline_expired": 0,
+                       # kvwire (ISSUE 16): block-ship accounting — flat
+                       # so the runner heartbeat forwards them unchanged
+                       "kvwire_exports": 0, "kvwire_export_misses": 0,
+                       "kvwire_blocks_exported": 0,
+                       "kvwire_bytes_exported": 0,
+                       "kvwire_blocks_imported": 0,
+                       "kvwire_bytes_imported": 0,
+                       "kvwire_import_hits": 0,
+                       "kvwire_import_fallbacks": 0}
         # ---- observability (ISSUE 8) ----
         # flight recorder: bounded per-window ring (None = disabled)
         self.flight = flight_maybe(engine_cfg.flight_cap)
@@ -696,6 +705,15 @@ class InferenceEngine:
                 req.queue.put_nowait(None)
             req.done.set()
 
+    def active_stream_requests(self) -> list:
+        """Live streaming requests (queue-backed, not cancelled) — what a
+        graceful drain walks to migrate in-flight generations (ISSUE 16).
+        The runner pushes dict events (``kv_key`` announcements) straight
+        into these queues; the SSE relay forwards them verbatim."""
+        return [req for slot, req in enumerate(self.slot_req)
+                if req is not None and self.active[slot]
+                and req.queue is not None and not req.cancelled]
+
     async def generate(self, prompt: list[int], max_new_tokens: int = 32,
                        request_id: str = "", stream: bool = False,
                        trace: Optional[tuple] = None,
@@ -749,6 +767,112 @@ class InferenceEngine:
                 raise RuntimeError(req.error)
             raise ValueError(req.error)
         return req.generated
+
+    # -- kvwire export / adopt (ISSUE 16) ------------------------------------
+    # Synchronous by design: these run on the event loop between awaits,
+    # so slot/allocator/prefix-cache state cannot shift underneath them
+    # (the same atomicity the serve loop itself relies on). The device
+    # reads inside block the loop for the gather duration — acceptable
+    # for rare control-plane operations (handoff, drain, failover), and
+    # XLA orders them after any in-flight window on the same arrays.
+
+    def export_prefix_kv(self, tokens: list[int]) -> Optional[bytes]:
+        """Serialize the longest prefix-cached block run covering
+        ``tokens`` into a kvwire payload (None = nothing cached). The
+        entry stays PINNED across the gather so a concurrent admission's
+        eviction cannot recycle a block mid-device_get."""
+        if not self.paged or self.ecfg.prefix_cache_blocks <= 0:
+            return None
+        entry = self.prefix_cache.acquire_for_export(list(tokens))
+        if entry is None:
+            self._stats["kvwire_export_misses"] += 1
+            return None
+        t0 = time.perf_counter()
+        try:
+            payload = self.pool.export_blocks(
+                self.kv_cache, entry.blocks, entry.key, entry.n_tokens)
+        finally:
+            self.prefix_cache.release_pin(entry)
+        self.metrics.observe("tpu9_kvwire_export_s",
+                             time.perf_counter() - t0)
+        self._stats["kvwire_exports"] += 1
+        self._stats["kvwire_blocks_exported"] += len(entry.blocks)
+        self._stats["kvwire_bytes_exported"] += len(payload)
+        return payload
+
+    def export_request_kv(self, request_id: str) -> Optional[bytes]:
+        """Serialize an IN-FLIGHT request's full-block KV prefix (prompt
+        + generated so far) — the drain-migration export. The slot's own
+        block refs keep the blocks alive for the synchronous gather; the
+        in-flight decode window only ever writes positions past the
+        delivered sequence, which land in blocks beyond the shipped run.
+        None = request not active or under one full block."""
+        if not self.paged:
+            return None
+        from .paged_kv import PrefixCache
+        for slot in range(self.ecfg.max_batch):
+            req = self.slot_req[slot]
+            if req is None or not self.active[slot] \
+                    or req.request_id != request_id:
+                continue
+            seq = req.prompt + req.generated
+            bs = self.ecfg.kv_block_size
+            nb = min(len(seq) // bs, len(self._slot_blocks[slot]))
+            if nb <= 0:
+                return None
+            t0 = time.perf_counter()
+            payload = self.pool.export_blocks(
+                self.kv_cache, self._slot_blocks[slot][:nb],
+                PrefixCache._key(seq[:nb * bs]), nb * bs)
+            self.metrics.observe("tpu9_kvwire_export_s",
+                                 time.perf_counter() - t0)
+            self._stats["kvwire_exports"] += 1
+            self._stats["kvwire_blocks_exported"] += nb
+            self._stats["kvwire_bytes_exported"] += len(payload)
+            return payload
+        return None
+
+    def adopt_kv(self, payload: bytes) -> bool:
+        """Splice a kvwire payload into fresh pool blocks and adopt the
+        prefix into the cache, so the next ``generate`` over those tokens
+        admits through the ordinary prefix-reuse path (chunked suffix
+        prefill from the shipped watermark). False = could not adopt
+        (pool pressure / prefix budget) — the caller falls back to plain
+        re-prefill. Malformed payloads raise :class:`KvWireError` before
+        any pool mutation."""
+        if not self.paged or self.ecfg.prefix_cache_blocks <= 0:
+            self._stats["kvwire_import_fallbacks"] += 1
+            return False
+        t0 = time.perf_counter()
+        try:
+            kv, adopted, header = self.pool.import_blocks(
+                self.kv_cache, payload)
+        except RuntimeError:
+            # pool exhausted mid-splice: not an error, just no room —
+            # re-prefill serves the request from scratch
+            self._stats["kvwire_import_fallbacks"] += 1
+            return False
+        self.kv_cache = kv
+        if not adopted:
+            self._stats["kvwire_import_fallbacks"] += 1
+            return False
+        self.metrics.observe("tpu9_kvwire_import_s",
+                             time.perf_counter() - t0)
+        self._stats["kvwire_import_hits"] += 1
+        self._stats["kvwire_blocks_imported"] += int(
+            header.get("n_blocks", 0))
+        self._stats["kvwire_bytes_imported"] += len(payload)
+        return True
+
+    def note_kvwire_ship(self, seconds: float) -> None:
+        """Transport-side ship latency (cache put/get round-trip), fed by
+        the runner — the engine itself never touches the transport."""
+        self.metrics.observe("tpu9_kvwire_ship_s", seconds)
+
+    def note_kvwire_fallback(self) -> None:
+        """A ship that never reached import (fetch failed / fault
+        injected): counted so hit-vs-fallback covers the whole path."""
+        self._stats["kvwire_import_fallbacks"] += 1
 
     def flight_records(self, limit: int = 256,
                        since_seq: int = 0) -> list[dict]:
@@ -923,6 +1047,16 @@ class InferenceEngine:
                 lat[f"{phase}_count"] = snap["count"]
                 lat[f"{phase}_mean_s"] = round(snap["mean"], 6)
         out["latency"] = lat
+        # kvwire (ISSUE 16): ship-path latency percentiles, flat under
+        # the same kvwire_* prefix as the counters so the runner
+        # heartbeat forwards the whole family with one startswith loop.
+        # "export"/"import" are engine-side gather/splice; "ship" is the
+        # transport round-trip the runner observes via note_kvwire_ship.
+        for op in ("export", "import", "ship"):
+            snap = summaries.get(f"tpu9_kvwire_{op}_s")
+            if snap:
+                out[f"kvwire_{op}_p50_s"] = round(snap["p50"], 6)
+                out[f"kvwire_{op}_p95_s"] = round(snap["p95"], 6)
         if self.paged:
             out["kv_blocks_used"] = self.allocator.used_count
             out["kv_blocks_free"] = self.allocator.free_count

@@ -134,6 +134,93 @@ class KvPool:
         self.kv_allocs += n
         return got
 
+    # -- kvwire export / import (ISSUE 16) -----------------------------------
+
+    def wire_names(self) -> list[str]:
+        """Pool arrays that ship on the wire (payload + scale planes;
+        the table is host bookkeeping — block ids are pool-local)."""
+        return [n for n in self.array_shapes() if n != "table"]
+
+    def export_blocks(self, kv, blocks: list[int], prefix_key: bytes,
+                      n_tokens: int) -> bytes:
+        """Gather ``blocks`` of every pool plane into one kvwire payload.
+        Planes come out CANONICAL (full-head) via ``policy.gather_kv``,
+        so the payload is topology-independent. The caller must hold a
+        pin on the blocks for the duration (prefix-cache export pin or a
+        slot's own refs) — the gather syncs the device and an eviction
+        interleaved at that boundary must not recycle them."""
+        from . import kvwire
+        meta = kvwire.geometry(self.cfg, self.ecfg, self.kv_quant)
+        meta.update({"n_blocks": len(blocks), "n_tokens": int(n_tokens),
+                     "prefix_key": prefix_key.hex(),
+                     "topology": self.policy.describe()})
+        idx = np.asarray(blocks, dtype=np.int32)
+        planes = {name: self.policy.gather_kv(name, kv[name])[:, idx]
+                  for name in self.wire_names()}
+        return kvwire.encode_blocks(meta, planes)
+
+    def import_blocks(self, kv, payload: bytes):
+        """Validate + splice a kvwire payload into fresh pool blocks and
+        adopt them into the prefix cache under the exporter's key.
+
+        Returns ``(kv, adopted, header)`` — ``kv`` rebound with the
+        written (and re-placed) planes. All validation happens BEFORE
+        any allocation or write: a bad payload leaves the pool
+        untouched. ``adopted=False`` means the entry could not fit the
+        prefix budget (blocks were released; caller falls back to
+        re-prefill)."""
+        import jax.numpy as jnp
+
+        from . import kvwire
+        header, planes = kvwire.decode_blocks(payload)
+        kvwire.check_geometry(
+            header, kvwire.geometry(self.cfg, self.ecfg, self.kv_quant))
+        try:
+            nb = int(header["n_blocks"])
+            n_tokens = int(header["n_tokens"])
+            key = bytes.fromhex(header["prefix_key"])
+        except (KeyError, TypeError, ValueError) as exc:
+            raise kvwire.KvWireError(
+                f"kvwire: missing/malformed prefix metadata: {exc}") from exc
+        if nb <= 0 or not key:
+            raise kvwire.KvWireError(
+                f"kvwire: empty prefix payload (n_blocks={nb})")
+        shapes = self.array_shapes()
+        for name in self.wire_names():
+            if name not in planes:
+                raise kvwire.KvWireError(
+                    f"kvwire: payload missing plane {name!r}")
+            want = (shapes[name][0][0], nb) + tuple(shapes[name][0][2:])
+            if tuple(planes[name].shape) != want:
+                raise kvwire.KvWireError(
+                    f"kvwire: plane {name!r} shape "
+                    f"{tuple(planes[name].shape)} != pool slice {want}")
+        if self.prefix_cache.contains(key):
+            # this replica already holds the prefix (raced a local
+            # prefill): the adopt is a no-op hit, zero pool work
+            return kv, True, header
+        blocks = self.alloc_blocks(nb)
+        try:
+            idx = jnp.asarray(blocks, dtype=jnp.int32)
+            new_kv = dict(kv)
+            for name in self.wire_names():
+                arr = jnp.asarray(np.ascontiguousarray(planes[name]),
+                                  dtype=shapes[name][1])
+                new_kv[name] = new_kv[name].at[:, idx].set(arr)
+            # re-pin the resident layout: the scatter above lets GSPMD
+            # infer an output sharding; place_kv restores the declared
+            # head-axis layout (identity on one chip)
+            placed = self.policy.place_kv(
+                {n: new_kv[n] for n in self.wire_names()})
+            new_kv.update(placed)
+        except Exception:
+            self.allocator.release(blocks)
+            raise
+        if not self.prefix_cache.adopt(key, blocks, n_tokens):
+            self.allocator.release(blocks)
+            return new_kv, False, header
+        return new_kv, True, header
+
     # -- the host block table ------------------------------------------------
 
     def device_table(self):

@@ -132,6 +132,7 @@ class PrefixCache:
         self.tokens_reused = 0
         self.evictions = 0      # lifetime counter (flight-recorder deltas)
         self.pinned = 0         # live lookup pins (O(1), not an entry scan)
+        self.adopted = 0        # entries imported off the wire (ISSUE 16)
 
     @staticmethod
     def _key(tokens: list[int]) -> bytes:
@@ -142,6 +143,9 @@ class PrefixCache:
     @property
     def held_blocks(self) -> int:
         return sum(len(e.blocks) for e in self._entries.values())
+
+    def contains(self, key: bytes) -> bool:
+        return key in self._entries
 
     def lookup(self, prompt: list[int]) -> Optional[PrefixEntry]:
         """Longest cached block-aligned strict prefix of ``prompt``.
@@ -172,6 +176,48 @@ class PrefixCache:
         entry.pins -= 1
         self.pinned -= 1
         assert entry.pins >= 0, "unbalanced prefix-cache pin release"
+
+    # -- kvwire export/adopt (ISSUE 16) --------------------------------------
+
+    def acquire_for_export(self,
+                           tokens: list[int]) -> Optional[PrefixEntry]:
+        """Longest cached block-aligned prefix of ``tokens`` for a kvwire
+        export, PINNED for the duration of the payload gather — the same
+        race class as the lookup/evict pin fix (PR 2): an eviction
+        interleaved at the device_get await must not recycle a block
+        mid-gather. Deliberately separate from :meth:`lookup`: export
+        traffic is not admission traffic and must not skew the
+        hit/miss/tokens_reused signals the router keys affinity on.
+        Balance with :meth:`release_pin`. Non-strict: a whole-prompt
+        entry is exactly what a handoff wants to ship."""
+        bs = self.allocator.block_s
+        nb = len(tokens) // bs
+        while nb > 0:
+            entry = self._entries.get(self._key(tokens[:nb * bs]))
+            if entry is not None:
+                entry.last_used = time.monotonic()
+                entry.pins += 1
+                self.pinned += 1
+                return entry
+            nb -= 1
+        return None
+
+    def adopt(self, key: bytes, blocks: list[int], n_tokens: int) -> bool:
+        """Register an IMPORTED prefix under the exporter's key, taking
+        ownership of freshly-allocated blocks (ref already 1 from the
+        alloc — no retain; eviction releases them like any entry's).
+        False = an entry under this key already exists (this replica
+        prefilled it concurrently) or the entry cannot fit the budget —
+        the caller must release its duplicate blocks."""
+        nb = len(blocks)
+        if (nb == 0 or self.max_blocks <= 0 or nb > self.max_blocks
+                or key in self._entries):
+            return False
+        self._entries[key] = PrefixEntry(key=key, blocks=list(blocks),
+                                         n_tokens=n_tokens)
+        self.adopted += 1
+        self._evict_to_budget()
+        return True
 
     def insert(self, prompt: list[int], slot_blocks: list[int]) -> None:
         """Register the prompt's full-block prefix, sharing the slot's
@@ -223,4 +269,5 @@ class PrefixCache:
                 "held_blocks": self.held_blocks,
                 "hits": self.hits, "misses": self.misses,
                 "tokens_reused": self.tokens_reused,
-                "evictions": self.evictions, "pinned": self.pinned}
+                "evictions": self.evictions, "pinned": self.pinned,
+                "adopted": self.adopted}

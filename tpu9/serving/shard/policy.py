@@ -71,6 +71,18 @@ class SingleDevicePolicy:
     def constrain_kv(self, tree: Params) -> Params:
         return tree
 
+    # -- kvwire gather (ISSUE 16) --------------------------------------------
+
+    def gather_kv(self, name: str, arr) -> np.ndarray:
+        """Canonical full-head HOST copy of one pool array — the kvwire
+        export gather. ``device_get`` on a head-sharded mesh array
+        assembles the global array (single-process mesh), so a tp=2
+        exporter emits byte-identical planes to a tp=1 one and import
+        re-places through :meth:`place_kv`. Off the serve loop by
+        construction (exports run between windows)."""
+        return np.asarray(
+            jax.device_get(arr))  # tpu9: noqa[JAX001] kvwire export gather — runs between windows, never on the dispatch path
+
     # -- spec introspection (graphcheck — ISSUE 11) --------------------------
     # The declared layout contract, exposed so the static verifier can
     # check lowered graphs against it without groping mesh internals. On

@@ -40,6 +40,8 @@ Fault kinds and their hook points:
                     sees a mid-request connection reset)
 ``peer_read_error`` cache peer chunk read raises (hedged-read path)
 ``peer_read_slow``  cache peer chunk read delayed by ``delay_s``
+``kv_ship_error``   runner's kvwire adopt path fails before the fetch —
+                    block-ship resume degrades to re-prefill (ISSUE 16)
 ==================  ========================================================
 
 The plane is **deliberately dependency-free** (no imports from
