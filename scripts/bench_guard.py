@@ -105,6 +105,13 @@ GUARDED_FIELDS = {
     # decomposition on failure — the HARD presence check below catches
     # that via this field).
     "coldstart_overlap_frac": "up",
+    # scale-out plane (ISSUE 17): concurrent tree bring-up of N joiners
+    # vs the serial no-peer baseline must not decay back toward N×, and
+    # the source-tier byte share must stay sub-linear in N (the O(1)
+    # source story — the phase strips it when the tree degenerates to
+    # everyone-reads-source, so it is HARD below).
+    "scaleout_bringup_ratio": "down",
+    "scaleout_source_bytes_ratio": "down",
 }
 
 # HARD-gated fields: the quant phase's oracle-margin parity judge and the
@@ -130,7 +137,13 @@ HARD_FIELDS = ("quant_shard_bytes_ratio", "quant_kv_capacity_ratio",
                # roundtrip loses bit-exactness or the version gate fails
                # to refuse a bumped reader — the quant parity precedent:
                # a stripped round IS the wire-format regression
-               "kvwire_roundtrip_exact")
+               "kvwire_roundtrip_exact",
+               # the scaleout phase strips its fields when the source
+               # tier served a linear share of joiner bytes (no tree),
+               # any restore failed under the chaos leg, or the
+               # execute-while-scaling leg never admitted early — a
+               # vanished value IS the scale-out regression
+               "scaleout_source_bytes_ratio")
 
 
 def extract_metrics(path: str) -> dict:

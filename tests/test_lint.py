@@ -951,6 +951,48 @@ def test_kvwire_contract_declared_and_live():
     assert "tpu9/serving/kvwire.py" in raw["jax"]["hotpath"]["files"]
 
 
+def test_scaleout_contract_declared_and_live():
+    """ISSUE 17 satellite: the scale-out plane is a closed subsystem —
+    an [allow] contract caps its import surface (cache/observability/
+    config/utils; never serving, router, gateway or worker: the planes
+    CALL it, it calls nobody back), and a [restricted] list names its
+    only importers (gateway coordinator host, abstractions predictive
+    wrapper, CLI tree-hint bootstrap, bench). Declared here, asserted
+    against the real import graph by the cross-check test above."""
+    cfg = bnd.BoundaryConfig.load(
+        os.path.join(REPO, "tpu9", "analysis", "boundaries.toml"))
+    assert "tpu9.scaleout" in cfg.allow
+    for banned in ("tpu9.serving", "tpu9.router", "tpu9.gateway",
+                   "tpu9.worker", "tpu9.abstractions"):
+        assert banned not in cfg.allow["tpu9.scaleout"]
+    assert "tpu9.scaleout" in cfg.restricted
+    importers = cfg.restricted["tpu9.scaleout"]
+    for needed in ("tpu9.gateway", "tpu9.abstractions", "tpu9.cli",
+                   "bench"):
+        assert needed in importers, importers
+    # the serving engine ships flat scaleout_* scalars over the
+    # heartbeat and the router parses plain stats — no import edge
+    for banned in ("tpu9.serving", "tpu9.router"):
+        assert not any(i == banned or i.startswith(banned + ".")
+                       for i in importers), importers
+    # serving's loud forbid list names the reverse edge explicitly
+    assert "tpu9.scaleout" in cfg.forbid["tpu9.serving"]
+    # liveness: the declared importers really import it — the gateway
+    # (coordinator + report), fleetobs (ledger feed + plan publish) and
+    # the abstractions endpoint (predictive policy wrap)
+    edges = _real_imports()
+    gw_edges = (edges.get("tpu9.gateway.gateway", set())
+                | edges.get("tpu9.gateway.fleetobs", set()))
+    assert any(t.startswith("tpu9.scaleout") for t in gw_edges)
+    assert any(t.startswith("tpu9.scaleout")
+               for t in edges.get("tpu9.abstractions.endpoint", set()))
+    # and the serving/router planes genuinely do not
+    for mod, targets in edges.items():
+        if mod.startswith("tpu9.serving") or mod.startswith("tpu9.router"):
+            assert not any(t.startswith("tpu9.scaleout")
+                           for t in targets), mod
+
+
 def test_tomlmini_parses_boundaries_toml():
     raw = tomlmini.load_file(
         os.path.join(REPO, "tpu9", "analysis", "boundaries.toml"))

@@ -674,10 +674,11 @@ async def test_hedge_wasted_bytes_counted_for_completed_loser(tmp_path):
         client._hedged_peer_get(["pA:1", "pB:1"], "d0"))
     await asyncio.sleep(0.05)           # let both racers launch and park
     release.set()
-    got = await task
+    got, served_by = await task
     assert got == blob
     # deterministic winner preference: earliest-ranked completed task
     # wins the same-wakeup tie → the OTHER completed try is pure waste
+    assert served_by == "pA:1"
     assert client.stats["hedge_wins"] == 0
     assert client.stats["hedge_wasted_bytes"] == len(blob)
     assert client.stats["hedged_reads"] == 1

@@ -157,6 +157,26 @@ class EndpointInstance:
             policy = queue_depth_policy(a.max_containers,
                                         a.tasks_per_container,
                                         a.min_containers)
+        # predictive scaling controller (ISSUE 17): when enabled, wrap
+        # the reactive policy — scale up on fast-window burn SLOPE
+        # before the slow window trips, veto scale-downs whose measured
+        # re-acquisition cost exceeds the remaining burn budget. Fed
+        # from the router signals bus (burn history + bring-up EWMA);
+        # without a fleet router there is no burn evidence to predict
+        # from, so the reactive policy stands alone.
+        if fleet_router is not None:
+            from ..scaleout import predictive_on
+            if predictive_on():
+                from ..scaleout.controller import predictive_policy
+                from ..config import ScaleoutConfig
+                signals = fleet_router.signals
+                sid = stub.stub_id
+                policy = predictive_policy(
+                    policy, cfg=ScaleoutConfig(),
+                    burns=lambda: signals.burn_history(sid),
+                    bringup=lambda: signals.bringup_s(sid),
+                    max_containers=a.max_containers,
+                    min_containers=a.min_containers)
         self.buffer = RequestBuffer(
             stub, containers, request_timeout_s=stub.config.timeout_s,
             router=self.router, dialer=dialer,

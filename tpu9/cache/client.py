@@ -80,6 +80,11 @@ class CacheClient:
         # holds no strong reference, so the event loop may GC the task
         # mid-flight — the set keeps it alive and close() drains it
         self._bg_tasks: set[asyncio.Task] = set()
+        # scale-out plane (ISSUE 17): content keys of COMPLETE shard
+        # groups this cache can re-serve to joining peers. The restore
+        # path advertises a group only once its last shard landed — a
+        # half-consumed group must never become a tree parent.
+        self.groups: set[str] = set()
         self.stats = {"local_hits": 0, "peer_hits": 0, "source_fetches": 0,
                       "peer_errors": 0, "hedged_reads": 0, "hedge_wins": 0,
                       "hedge_wasted_bytes": 0, "bytes_local": 0,
@@ -142,6 +147,16 @@ class CacheClient:
                 self.stats["peer_errors"] += 1
                 self._peer_entry(peer)["errors"] += 1
                 log.debug("fault plane: induced peer read error (%s)", peer)
+                return None
+            # tree_peer_loss (ISSUE 17): kill reads against ONE peer —
+            # the tree parent — mid-transfer; the hedged read falls
+            # through the surviving preference list, which IS the
+            # worker-side re-plan the chaos leg proves
+            if self._faults.fire_peer("tree_peer_loss", peer):
+                self.stats["peer_errors"] += 1
+                self._peer_entry(peer)["errors"] += 1
+                self._drop_conn(peer)
+                log.debug("fault plane: induced tree peer loss (%s)", peer)
                 return None
         lock = self._conn_locks.setdefault(peer, asyncio.Lock())
         async with lock:
@@ -259,10 +274,21 @@ class CacheClient:
         if ledger is not None:
             ledger[key] = ledger.get(key, 0) + n
 
+    def advertise_group(self, key: str) -> None:
+        """Scale-out plane (ISSUE 17): mark one COMPLETE shard group
+        (content key) as re-servable from this cache. The restore path
+        calls this after a group's last shard landed; the worker
+        heartbeat ships it via :meth:`snapshot`, and the coordinator
+        turns it into tree edges for joining replicas."""
+        if key:
+            self.groups.add(key)
+
     def snapshot(self) -> dict:
         """Cache-plane evidence for the worker heartbeat → timeline /
         /api/v1/metrics path: tier counters, hedge outcomes, per-peer
-        EWMAs/bytes/histograms (ISSUE 13)."""
+        EWMAs/bytes/histograms (ISSUE 13), plus the complete shard
+        groups this cache re-serves + its serve address (ISSUE 17 —
+        the coordinator's holders/edge-weight inputs)."""
         peers = {}
         for peer, entry in self._peer_stats.items():
             peers[peer] = {
@@ -274,6 +300,8 @@ class CacheClient:
         return {**self.stats,
                 "lat_ewma_global_s": round(self._peer_lat_ewma, 6),
                 "hist_buckets_s": list(self.LAT_BUCKETS_S),
+                "addr": self.self_address,
+                "groups": sorted(self.groups),
                 "peers": peers}
 
     # -- public API ---------------------------------------------------------
@@ -297,20 +325,24 @@ class CacheClient:
 
     async def _hedged_peer_get(self, ordered: Sequence[str], digest: str,
                                ledger: Optional[dict] = None
-                               ) -> Optional[bytes]:
+                               ) -> tuple[Optional[bytes], str]:
         """Race the HRW-ordered peers for one chunk: peer *i+1* launches
         only after peer *i* has had ``hedge_delay_s`` to answer; the first
         verified result wins and every other in-flight try is cancelled
-        (with its connection dropped — see ``_peer_get``)."""
+        (with its connection dropped — see ``_peer_get``). Returns
+        ``(data, winning_peer)`` so the caller can attribute the bytes to
+        the serving replica (the per-edge evidence — ISSUE 17)."""
         if not ordered:
-            return None
+            return None, ""
         if len(ordered) == 1:
             # nobody to hedge with — skip the task/wait machinery, which
             # costs real throughput on the per-chunk hot path
-            return await self._peer_get_verified(ordered[0], digest)
+            return (await self._peer_get_verified(ordered[0], digest),
+                    ordered[0])
         tasks: list[asyncio.Task] = []
         task_peer: dict[asyncio.Task, str] = {}
         winner: Optional[bytes] = None
+        winner_peer = ""
         try:
             nxt = 0
             pending: set[asyncio.Task] = set()
@@ -356,6 +388,7 @@ class CacheClient:
                         continue
                     if winner is None:
                         winner = data
+                        winner_peer = task_peer[task]
                         if task is not tasks[0]:
                             self.stats["hedge_wins"] += 1
                             self._tally(ledger, "hedge_wins")
@@ -366,7 +399,7 @@ class CacheClient:
                         self.stats["hedge_wasted_bytes"] += len(data)
                         self._tally(ledger, "hedge_wasted_bytes",
                                     len(data))
-            return winner
+            return winner, winner_peer
         finally:
             for task in tasks:
                 if not task.done():
@@ -374,10 +407,15 @@ class CacheClient:
             await asyncio.gather(*tasks, return_exceptions=True)
 
     async def get(self, digest: str,
-                  ledger: Optional[dict] = None) -> Optional[bytes]:
+                  ledger: Optional[dict] = None,
+                  prefer: Optional[Sequence[str]] = None) -> Optional[bytes]:
         """local → hedged HRW peers → source (populating local + primary).
         ``ledger`` receives THIS call's tier/hedge accounting (see
-        :meth:`_tally`)."""
+        :meth:`_tally`). ``prefer`` (ISSUE 17) is the distribution tree's
+        parent preference list: those peers are raced FIRST, in order,
+        with the HRW remainder behind them — so a dead parent falls
+        through to surviving holders inside the same hedged read, and
+        the source tier stays the last resort either way."""
         data = await self.store.get(digest)
         if data is not None:
             self.stats["local_hits"] += 1
@@ -388,12 +426,21 @@ class CacheClient:
 
         peers = [p for p in await self.peers() if p != self.self_address]
         ordered = hrw_order(digest, peers)[: max(self.replicas, 1) + 1]
-        data = await self._hedged_peer_get(ordered, digest, ledger=ledger)
+        if prefer:
+            tree = [p for p in prefer
+                    if p in peers and p != self.self_address]
+            ordered = tree + [p for p in ordered if p not in tree]
+        data, served_by = await self._hedged_peer_get(ordered, digest,
+                                                      ledger=ledger)
         if data is not None:
             self.stats["peer_hits"] += 1
             self.stats["bytes_peer"] += len(data)
             self._tally(ledger, "peer_hits")
             self._tally(ledger, "bytes_peer", len(data))
+            if served_by:
+                # per-EDGE attribution (ISSUE 17 satellite: the coldstart
+                # record's one "peer" tier hid which replica served what)
+                self._tally(ledger, f"bytes_peer:{served_by}", len(data))
             await self.store.put(data, digest)
             return data
 
@@ -414,18 +461,21 @@ class CacheClient:
 
     async def get_stream(self, digests: Sequence[str],
                          window: int = 8,
-                         ledger: Optional[dict] = None) -> AsyncIterator[
+                         ledger: Optional[dict] = None,
+                         prefer: Optional[Sequence[str]] = None
+                         ) -> AsyncIterator[
                              tuple[str, Optional[bytes]]]:
         """Yield ``(digest, data)`` in the given (manifest) order through a
         read-ahead window — the streaming-restore feed: chunk *i+1* is in
         flight while the consumer deserializes chunk *i*. Duplicate digests
         are served again (second fetch is a local-store hit). ``ledger``
         attributes exactly this stream's tier/hedge traffic to the caller
-        (the per-group restore evidence)."""
+        (the per-group restore evidence); ``prefer`` carries the tree
+        parents for the group this stream restores (ISSUE 17)."""
         from .prefetch import Prefetcher
 
         async def fetch(digest: str) -> Optional[bytes]:
-            return await self.get(digest, ledger=ledger)
+            return await self.get(digest, ledger=ledger, prefer=prefer)
 
         pf = Prefetcher(fetch, list(dict.fromkeys(digests)),
                         window=window)

@@ -1,7 +1,8 @@
 """Chunk server: raw-TCP data plane over a DiskStore.
 
 Protocol (shares the state-bus framing): request frame
-``{"op": "get"|"put"|"has"|"stats", "hash": ..., "len": n}``; for ``put`` the
+``{"op": "get"|"put"|"has"|"stats"|"groups", "hash": ..., "len": n}``; for
+``put`` the
 raw chunk bytes follow the header frame; ``get`` replies
 ``{"ok": true, "len": n}`` then n raw bytes (zero-copy from the store file
 via loop.sendfile when the transport supports it — the reference uses
@@ -25,10 +26,15 @@ MAX_CHUNK = 64 * 1024 * 1024
 
 class ChunkServer:
     def __init__(self, store: DiskStore, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, groups_fn=None):
         self.store = store
         self.host = host
         self.port = port
+        # scale-out plane (ISSUE 17): () -> sequence of complete shard
+        # group content keys this replica can re-serve — the worker wires
+        # its CacheClient's advertisement set in; joining peers (and the
+        # bench) ask over the wire with op "groups"
+        self.groups_fn = groups_fn
         self._server: Optional[asyncio.base_events.Server] = None
         self._conns: set[asyncio.StreamWriter] = set()
 
@@ -102,6 +108,13 @@ class ChunkServer:
                     writer.write(wire.pack({"ok": True,
                                             "used": self.store.used_bytes,
                                             **self.store.stats}))
+                elif op == "groups":
+                    try:
+                        groups = sorted(self.groups_fn()) \
+                            if self.groups_fn else []
+                    except Exception:   # noqa: BLE001 — advertisement is
+                        groups = []     # best-effort, never a wire error
+                    writer.write(wire.pack({"ok": True, "groups": groups}))
                 else:
                     writer.write(wire.pack({"ok": False,
                                             "error": f"bad op {op!r}"}))

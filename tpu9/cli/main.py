@@ -726,6 +726,62 @@ def coldstart_cmd(stub_id: str, container_id: str, as_json: bool) -> None:
             f"  {tier_txt}{hedge_txt}")
 
 
+@cli.command("scaleout")
+@click.option("--stub-id", default="", help="filter one deployment")
+@click.option("--container-id", default="", help="pin one replica")
+@click.option("--json", "as_json", is_flag=True, help="raw report")
+def scaleout_cmd(stub_id: str, container_id: str, as_json: bool) -> None:
+    """Scale-out plane report (ISSUE 17): per-replica multicast-tree
+    position (parent per group / children re-served), groups held vs
+    serving-ready, execute-while-scaling readiness fraction, and bytes
+    by tree edge — the `tpu9 coldstart` companion for watching N
+    replicas share one peer tree instead of N source reads."""
+    q = []
+    if stub_id:
+        q.append(f"stub_id={stub_id}")
+    if container_id:
+        q.append(f"container_id={container_id}")
+    qs = ("?" + "&".join(q)) if q else ""
+    data = _client()._run(
+        lambda c: c.request("GET", f"/api/v1/scaleout{qs}"))
+    if as_json:
+        click.echo(json.dumps(data, indent=2))
+        return
+    if not data.get("enabled", False):
+        click.echo("scale-out plane disabled (set TPU9_SCALEOUT=1 or "
+                   "scaleout.enabled in config)")
+        return
+    tree = data.get("tree", {}) or {}
+    click.echo(f"tree: fanout={tree.get('fanout', 0)} "
+               f"edges={len(tree.get('edges', []))} "
+               f"source_edges={tree.get('source_edges', 0)}")
+    replicas = data.get("replicas", [])
+    if not replicas:
+        click.echo("no replicas in the group ledger yet (wait a "
+                   "cache-plane heartbeat)")
+        return
+    click.echo(f"{'replica':<16}{'held':>6}{'ready':>7}{'frac':>7}"
+               f"{'children':>10}  parents / bytes by edge")
+    for row in replicas:
+        parents = row.get("tree_parents", {}) or {}
+        edge_bytes = row.get("bytes_by_edge", {}) or {}
+        par_txt = ",".join(sorted({p for p in parents.values()})) \
+            if parents else "-"
+        edge_txt = " ".join(f"{a}:{int(n) >> 10}K"
+                            for a, n in sorted(edge_bytes.items()))
+        src = int(row.get("bytes_source", 0) or 0)
+        if src:
+            edge_txt = (edge_txt + f" source:{src >> 10}K").strip()
+        stale = " (stale)" if row.get("stale") else ""
+        click.echo(
+            f"{str(row.get('replica', ''))[:15]:<16}"
+            f"{len(row.get('groups_held', [])):>6}"
+            f"{len(row.get('groups_ready', [])):>7}"
+            f"{float(row.get('ready_frac', 1.0)):>7.2f}"
+            f"{len(row.get('children', [])):>10}"
+            f"  {par_txt} {edge_txt}{stale}")
+
+
 @cli.command("postmortem")
 @click.argument("container_id", required=False, default="")
 @click.option("--stub-id", default="", help="filter one deployment")
@@ -1449,10 +1505,30 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
             from ..worker.weightpool import WeightPool
             weight_pool = (WeightPool(cfg.worker.weight_pool_mb << 20)
                            if cfg.worker.weight_pool_mb > 0 else None)
+
+            async def tree_hints(group_key: str):
+                # scale-out distribution tree (ISSUE 17): the gateway
+                # coordinator publishes its plan under scaleout:tree;
+                # this replica's preference list for the group is looked
+                # up by its own cache serve address. Best-effort — no
+                # plan (or scaleout off) degrades to HRW order.
+                from ..scaleout import scaleout_on
+                from ..scaleout.coordinator import PLAN_KEY
+                from ..scaleout.tree import TreePlan
+                if not scaleout_on(cfg.scaleout):
+                    return []
+                blob = await store.get(PLAN_KEY)
+                if not blob:
+                    return []
+                plan = TreePlan.from_dict(
+                    blob if isinstance(blob, dict) else json.loads(blob))
+                return plan.peer_prefs(cache.client.self_address,
+                                       group_key)
+
             checkpoints = CheckpointManager(
                 cache.client, record=ckpt_record, update=ckpt_update,
                 store_manifest=ckpt_store, fetch_manifest=ckpt_fetch,
-                weight_pool=weight_pool)
+                weight_pool=weight_pool, tree_hints=tree_hints)
         w = Worker(store, runtime, cfg=cfg.worker, pool=pool,
                    tpu_generation=tpu_gen, slice_id=slice_id,
                    slice_host_rank=slice_rank, slice_host_count=slice_hosts,

@@ -290,6 +290,49 @@ class SloConfig:
 
 
 @dataclass
+class ScaleoutConfig:
+    """Scale-out plane (``tpu9/scaleout/`` — ISSUE 17): multicast weight
+    distribution over the peer-cache tier, execute-while-scaling
+    readiness, and the burn-predictive autoscale controller. Env
+    overrides follow the standard layering (``TPU9_SCALEOUT__<FIELD>``);
+    the master ``TPU9_SCALEOUT`` ("1"/"0") and
+    ``TPU9_SCALEOUT_PREDICTIVE`` shortcuts beat the config for bench and
+    chaos runs, the TPU9_DISAGG precedent."""
+    # distribution tree: on by default — it only biases WHERE a joining
+    # replica fetches from (peer edges before HRW fallback), never
+    # whether a restore succeeds (source stays the floor)
+    enabled: bool = True
+    # max children one parent serves per shard group; the planner chains
+    # extra joiners into deeper tree levels instead of widening a parent
+    tree_fanout: int = 2
+    # predictive controller: OFF by default — it changes WHEN capacity is
+    # added/removed, so a fleet opts in per deployment (disagg precedent)
+    predictive_enabled: bool = False
+    # fast-burn slope is fit over this trailing window of SLO samples
+    slope_window_s: float = 120.0
+    # scale up when the projected fast burn (current + slope × horizon)
+    # crosses 1.0 — i.e. the budget WILL start burning before the slow
+    # window can trip
+    burn_horizon_s: float = 300.0
+    # cap on replicas added by one predictive decision
+    scale_up_max_step: int = 2
+    # scale-down guard: measured bring-up × this safety factor must fit
+    # inside the remaining slow-window burn budget, or capacity is held
+    bringup_safety: float = 2.0
+    # burn samples older than this make the controller HOLD (never grow)
+    # — the PR 12 staleness-guard pattern: a dead sampler must not pin
+    # the fleet at max. Default = 3 gateway sampler ticks.
+    stale_after_s: float = 6.0
+    # bring-up estimate used before any coldstart record has been
+    # measured for the stub (first scale-down decision of a deployment)
+    default_bringup_s: float = 30.0
+    # a replica whose heartbeat readiness is below 1.0 admits only
+    # requests whose declared weight groups are resident; False admits
+    # nothing until the restore completes (the conservative fallback)
+    partial_admission: bool = True
+
+
+@dataclass
 class MonitoringConfig:
     metrics_enabled: bool = True
     metrics_push_url: str = ""
@@ -314,6 +357,7 @@ class AppConfig:
     image: ImageConfig = field(default_factory=ImageConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
     slo: SloConfig = field(default_factory=SloConfig)
+    scaleout: ScaleoutConfig = field(default_factory=ScaleoutConfig)
     monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
     debug: bool = False
 
@@ -371,7 +415,13 @@ def _apply_env(cfg: AppConfig, environ: dict[str, str]) -> None:
                 break
         leaf = path[-1]
         if ok and dataclasses.is_dataclass(obj) and hasattr(obj, leaf):
-            setattr(obj, leaf, _coerce(getattr(obj, leaf), raw))
+            cur = getattr(obj, leaf)
+            if dataclasses.is_dataclass(cur):
+                # a whole section can't be set from a scalar env var —
+                # TPU9_SCALEOUT is the scaleout feature GATE (read by
+                # scaleout_on()), not an overlay of the scaleout section
+                continue
+            setattr(obj, leaf, _coerce(cur, raw))
 
 
 def load_config(path: Optional[str] = None,

@@ -53,7 +53,12 @@ ENGINE_SERIES = ("tokens_per_sec", "token_pressure", "queued",
                  "kvwire_blocks_exported", "kvwire_blocks_imported",
                  "kvwire_bytes_exported", "kvwire_bytes_imported",
                  "kvwire_import_hits", "kvwire_import_fallbacks",
-                 "kvwire_ship_p50_s", "kvwire_ship_p95_s")
+                 "kvwire_ship_p50_s", "kvwire_ship_p95_s",
+                 # scale-out plane (ISSUE 17): execute-while-scaling
+                 # per-group weight readiness — the router's admission
+                 # fence and `tpu9 scaleout`'s readiness fraction
+                 "scaleout_groups_total", "scaleout_groups_ready",
+                 "scaleout_ready_frac")
 # router snapshot fields mirrored into per-stub timeline series
 ROUTER_SERIES = ("queue_depth", "shed_rate", "pressure")
 # worker-heartbeated cache-plane counters mirrored 1:1 into per-worker
@@ -73,11 +78,16 @@ def _num(d: dict, key: str, default: float = 0.0) -> float:
 
 
 class FleetObserver:
-    def __init__(self, cfg, store, fleet_router=None):
-        """``cfg`` is an AppConfig.slo (SloConfig)."""
+    def __init__(self, cfg, store, fleet_router=None, scaleout=None):
+        """``cfg`` is an AppConfig.slo (SloConfig). ``scaleout`` is an
+        optional :class:`~tpu9.scaleout.coordinator.ScaleoutCoordinator`
+        (ISSUE 17): when present, worker cache-plane snapshots and engine
+        heartbeats feed its group ledger, and every sampler tick
+        republishes the refreshed multicast tree plan to the store."""
         self.cfg = cfg
         self.store = store
         self.fleet_router = fleet_router
+        self.scaleout = scaleout
         self.timeline = TimelineStore(
             capacity=cfg.timeline_capacity,
             max_series=cfg.timeline_max_series,
@@ -147,6 +157,17 @@ class FleetObserver:
         if any(k.startswith("kvwire_") for k in stats):
             from ..observability.health import publish_kvwire
             publish_kvwire(container_id, stats)
+        # scale-out plane (ISSUE 17): per-group readiness → coordinator
+        # ledger (serving-plane truth for the report + admission fence),
+        # measured bring-up → router signals (the predictive controller's
+        # scale-down guard must use MEASURED re-acquisition cost)
+        if self.scaleout is not None and "scaleout_ready_frac" in stats:
+            self.scaleout.observe_heartbeat(container_id, stats)
+        ready_s = _num(stats, "coldstart_ready_s")
+        if ready_s > 0 and self.fleet_router is not None:
+            note = getattr(self.fleet_router.signals, "note_bringup", None)
+            if note is not None:    # duck-typed router fakes in tests
+                note(stub_id, ready_s)
         # MFU/MBU priced control-plane-side from the engine's physics
         # constants (bytes / FLOPs per token per chip) × tokens/sec,
         # against the chip's public peaks — honest ~0 on CPU hosts
@@ -208,8 +229,13 @@ class FleetObserver:
                         f"slo.{sid}.{name}.burn_slow",
                         entry["slow"]["burn"])
                 self.evaluator.publish(sid, evaluated)
-                signals.slo_sample(sid,
-                                   self.evaluator.max_fast_burn(evaluated))
+                # worst slow-window burn rides along (ISSUE 17): the
+                # predictive controller projects the FAST burn's slope
+                # against the slow window's remaining budget
+                signals.slo_sample(
+                    sid, self.evaluator.max_fast_burn(evaluated),
+                    max((e["slow"]["burn"] for e in evaluated.values()),
+                        default=0.0))
                 self.goodput.router_sample(
                     sid, stub.workspace_id,
                     submitted_total=float(snap.get("submitted", 0)),
@@ -235,6 +261,11 @@ class FleetObserver:
                 continue
             wid = key.rsplit(":", 1)[-1]
             cache = snap.get("cache") or {}
+            if self.scaleout is not None:
+                # cache-plane truth for the multicast tree (ISSUE 17):
+                # which replica HOLDS which shard groups, and the
+                # per-peer latency EWMAs the edge picker weighs
+                self.scaleout.observe_worker(wid, snap)
             prefix = f"cache.{wid}."
             for name in CACHE_SERIES:
                 if name in cache:
@@ -256,6 +287,15 @@ class FleetObserver:
                 if name in pool:
                     self.timeline.record(f"weightpool.{wid}.{name}",
                                          _num(pool, name))
+        if self.scaleout is not None:
+            # re-plan the multicast tree over fresh holders and publish
+            # it where joining workers' tree_hints read it; short TTL so
+            # a dead gateway's plan ages out instead of steering forever
+            from ..scaleout.coordinator import PLAN_KEY
+            plan = self.scaleout.refresh()
+            await self.store.set(
+                PLAN_KEY, json.dumps(plan.to_dict()),
+                ttl=max(int(self.cfg.sample_interval_s * 6), 30))
 
     # -- engines-section aging (ISSUE 12 satellite) --------------------------
 

@@ -170,10 +170,19 @@ class Gateway:
         # time-series store + burn-rate evaluator + per-tenant goodput
         # accounting behind /api/v1/{timeline,slo} and `tpu9 top`
         self.fleetobs = None
+        # scale-out plane (ISSUE 17): the gateway-side multicast-tree
+        # coordinator — fed by the observer's cache-plane/heartbeat
+        # cadences, publishing the tree plan joining workers read
+        self.scaleout = None
         if cfg.slo.enabled:
+            from ..scaleout import scaleout_on
+            if scaleout_on(cfg.scaleout):
+                from ..scaleout.coordinator import ScaleoutCoordinator
+                self.scaleout = ScaleoutCoordinator(cfg.scaleout)
             from .fleetobs import FleetObserver
             self.fleetobs = FleetObserver(cfg.slo, self.store,
-                                          fleet_router=self.fleet_router)
+                                          fleet_router=self.fleet_router,
+                                          scaleout=self.scaleout)
         self.pool_monitor = PoolMonitor(
             self.store, pools,
             {p.name: p for p in cfg.pools},
@@ -346,6 +355,7 @@ class Gateway:
         r.add_get("/api/v1/slo", self._slo)
         r.add_get("/api/v1/traces", self._traces)
         r.add_get("/api/v1/coldstart", self._coldstart)
+        r.add_get("/api/v1/scaleout", self._scaleout)
         r.add_get("/api/v1/postmortem", self._postmortem)
         # engine flight recorder + on-demand TPU profiling (ISSUE 8)
         r.add_get("/api/v1/flight", self._flight)
@@ -689,6 +699,14 @@ class Gateway:
         operator = self._is_operator(request)
         want_cid = request.query.get("container_id", "")
         want_stub = request.query.get("stub_id", "")
+        out = await self._coldstart_records(ws, operator, want_cid,
+                                            want_stub)
+        return web.json_response({"replicas": out})
+
+    async def _coldstart_records(self, ws, operator: bool, want_cid: str,
+                                 want_stub: str) -> dict:
+        """Workspace-scoped merged coldstart records, shared by
+        /api/v1/coldstart and /api/v1/scaleout (ISSUE 17)."""
         from ..observability.coldstart import merge_record
         # both key families are suffixed by container id — a pinned query
         # reads exactly two keys instead of scanning the fleet
@@ -735,7 +753,38 @@ class Gateway:
             if not operator and rec.get("workspace_id") != ws.workspace_id:
                 continue
             out[cid] = merge_record(rec, runner_halves.get(cid))
-        return web.json_response({"replicas": out})
+        return out
+
+    async def _scaleout(self, request: web.Request) -> web.Response:
+        """Scale-out plane report (ISSUE 17): per replica — multicast
+        tree position (primary parent per group + children it re-serves),
+        groups held/ready, execute-while-scaling readiness fraction, and
+        bytes by tree edge from the coldstart record's per-peer split —
+        joined from the coordinator's group ledger and the same merged
+        coldstart records /api/v1/coldstart serves. Workspace-scoped the
+        same way; ?container_id= / ?stub_id= filter identically."""
+        if self.scaleout is None:
+            return web.json_response(
+                {"enabled": False, "replicas": [], "tree": {}})
+        ws = self._ws(request)
+        operator = self._is_operator(request)
+        want_cid = request.query.get("container_id", "")
+        want_stub = request.query.get("stub_id", "")
+        records = await self._coldstart_records(ws, operator, want_cid,
+                                                want_stub)
+        from ..scaleout.coordinator import build_report
+        snap = self.scaleout.ledger.snapshot()
+        if not operator:
+            # ledger rows carry no workspace; visibility comes from the
+            # workspace-filtered record join (worker-id rows are
+            # operator-only — they aggregate across tenants)
+            snap = {k: v for k, v in snap.items() if k in records}
+        if want_cid:
+            snap = {k: v for k, v in snap.items() if k == want_cid}
+        report = build_report(snap, self.scaleout.plan, records=records)
+        report["enabled"] = True
+        report["coordinator"] = self.scaleout.stats()
+        return web.json_response(report)
 
     async def _postmortem(self, request: web.Request) -> web.Response:
         """Replica black-box records (ISSUE 14): the bounded forensic

@@ -496,6 +496,10 @@ class FleetRouter:
         budgets: dict[str, int] = {}
         load: dict[str, float] = {}
         saturated: set[str] = set()
+        # execute-while-scaling (ISSUE 17): cid -> (ready_frac, ready
+        # group names) off the pressure stats; replicas not reporting
+        # the scaleout family are fully ready (steady state / old beat)
+        readiness: dict[str, tuple[float, set[str]]] = {}
         # pressure snapshots are independent per replica: fetch them
         # concurrently — N serial store round-trips per dispatch attempt
         # (re-paid every 250 ms retry pass) would dominate TTFT on a
@@ -525,6 +529,14 @@ class FleetRouter:
                                                        "")))
                 continue
             budgets[cid] = self.budgets.budget_from_stats(stats)
+            if stats and "scaleout_ready_frac" in stats:
+                try:
+                    frac = float(stats.get("scaleout_ready_frac", 1.0))
+                except (TypeError, ValueError):
+                    frac = 1.0
+                readiness[cid] = (frac, {
+                    g for g in str(stats.get("scaleout_ready_groups",
+                                             "")).split(",") if g})
             queued = 0.0
             if stats:
                 try:
@@ -544,8 +556,42 @@ class FleetRouter:
         # be an affinity target or it re-enters through the JSQ fallback
         order = self.affinity.order(body, list(load), load, saturated)
         order = self._disagg_order(body, order)
+        order = self._scaleout_admit(body, order, readiness)
         return (order, budgets, sum(budgets.values()),
                 self.affinity.hits > hits0)
+
+    @staticmethod
+    def _scaleout_admit(body: bytes, order: list[str],
+                        readiness: dict[str, tuple[float, set[str]]]
+                        ) -> list[str]:
+        """Partial-readiness admission (ISSUE 17 execute-while-scaling):
+        a replica mid-restore reports its bound weight groups on the
+        pressure heartbeat; it may serve a request ONLY when the
+        request's declared ``weight_groups`` are all resident. Unlike
+        the disagg bias this is a FENCE — a half-restored replica
+        serving a request whose groups have not landed would fail it,
+        not slow it. Requests that declare nothing require full
+        readiness (the conservative "admit nothing until complete"
+        fallback); an emptied order falls into the dispatch loop's
+        existing budget-wait, so the request queues rather than fails.
+        ``TPU9_SCALEOUT_PARTIAL=0`` disables group-hint admission
+        entirely (fence on readiness fraction alone)."""
+        partial = [c for c in order
+                   if readiness.get(c, (1.0, set()))[0] < 1.0]
+        if not partial:
+            return order
+        want: set[str] = set()
+        if os.environ.get("TPU9_SCALEOUT_PARTIAL", "") != "0":
+            try:
+                payload = json.loads(body or b"{}")
+                wg = payload.get("weight_groups") or []
+                if isinstance(wg, list):
+                    want = {str(g) for g in wg if g}
+            except (ValueError, TypeError, AttributeError):
+                want = set()
+        return [c for c in order
+                if readiness.get(c, (1.0, set()))[0] >= 1.0
+                or (want and want.issubset(readiness[c][1]))]
 
     def _disagg_on(self) -> bool:
         env = os.environ.get("TPU9_DISAGG", "")

@@ -17,6 +17,7 @@ Everything the router decides is exported two ways:
 from __future__ import annotations
 
 import time
+from collections import deque
 
 from ..observability import metrics
 
@@ -37,6 +38,14 @@ class RouterSignals:
         # the max of queue pressure and the burn-derived pressure so a
         # burning SLO scales the fleet BEFORE queue depth explodes
         self._slo_burn: dict[str, tuple[float, float]] = {}
+        # burn HISTORY (ISSUE 17): stub -> deque of (mono, burn_fast,
+        # burn_slow) — the predictive controller fits its slope over
+        # this; bounded so a chatty sampler cannot grow it
+        self._burn_hist: dict[str, deque] = {}
+        # measured bring-up seconds (ISSUE 17): stub -> EWMA of the
+        # coldstart record's ready_s heartbeat extra — the scale-down
+        # guard's re-acquisition cost
+        self._bringup_s: dict[str, float] = {}
 
     # -- recording -------------------------------------------------------------
 
@@ -95,13 +104,44 @@ class RouterSignals:
         metrics.set_gauge("tpu9_router_prefix_entries",
                           stats.get("entries", 0))
 
-    def slo_sample(self, stub_id: str, burn_fast: float) -> None:
+    def slo_sample(self, stub_id: str, burn_fast: float,
+                   burn_slow: float = 0.0) -> None:
         """Record the stub's worst fast-window SLO burn rate (ISSUE 12).
-        Called by the gateway's SLO sampler; feeds :meth:`pressure`."""
-        self._slo_burn[stub_id] = (max(float(burn_fast), 0.0),
-                                   time.monotonic())
+        Called by the gateway's SLO sampler; feeds :meth:`pressure` and
+        — with the slow-window burn, appended to the bounded history —
+        the predictive scaling controller (ISSUE 17)."""
+        now = time.monotonic()
+        self._slo_burn[stub_id] = (max(float(burn_fast), 0.0), now)
+        hist = self._burn_hist.get(stub_id)
+        if hist is None:
+            hist = self._burn_hist[stub_id] = deque(maxlen=256)
+        hist.append((now, max(float(burn_fast), 0.0),
+                     max(float(burn_slow), 0.0)))
         metrics.set_gauge("tpu9_router_slo_burn", burn_fast,
                           labels={"stub": stub_id})
+
+    def burn_history(self, stub_id: str) -> list:
+        """(mono_ts, burn_fast, burn_slow) series for the predictive
+        controller — staleness judged by the CONSUMER against the last
+        sample's age (the PR 12 guard lives in the controller)."""
+        return list(self._burn_hist.get(stub_id, ()))
+
+    def note_bringup(self, stub_id: str, seconds: float) -> None:
+        """Measured replica bring-up (coldstart ``ready_s`` off the
+        pressure heartbeat): EWMA so one outlier restore neither hides
+        nor dominates the scale-down guard's re-acquisition cost."""
+        s = float(seconds)
+        if s <= 0:
+            return
+        prior = self._bringup_s.get(stub_id)
+        self._bringup_s[stub_id] = s if prior is None \
+            else 0.3 * s + 0.7 * prior
+
+    def bringup_s(self, stub_id: str):
+        """Measured bring-up EWMA, or None before any replica of this
+        stub has reported one (the controller falls back to its
+        configured default)."""
+        return self._bringup_s.get(stub_id)
 
     def slo_pressure(self, stub_id: str) -> float:
         """Pressure contribution of a burning SLO ∈ [0, 1]: burn 1.0 (the

@@ -585,6 +585,13 @@ async def amain() -> None:
                     for k, v in stats.items():
                         if k.startswith("kvwire_"):
                             extra[k] = v
+                    # scale-out readiness (ISSUE 17): per-group bind
+                    # progress of a streaming restore — the router's
+                    # partial-readiness admission reads these off the
+                    # pressure hash, the coordinator off the heartbeat
+                    for k, v in stats.items():
+                        if k.startswith("scaleout_"):
+                            extra[k] = v
                     # latency decomposition (ISSUE 8): per-phase p50/p95
                     # flat scalars → /api/v1/metrics "engines" section
                     for k, v in (stats.get("latency") or {}).items():

@@ -1,0 +1,52 @@
+"""Scale-out plane (ISSUE 17): fleet-level weight distribution and
+predictive scaling.
+
+Three pieces, all control-plane-side and dependency-light:
+
+- :mod:`tpu9.scaleout.tree` — the multicast distribution planner. When
+  the autoscaler jumps 1→N, joining replicas stop fetching shard groups
+  independently from the source tier; the planner assigns each joiner
+  tree edges over the existing peer-cache plane (every replica re-serves
+  the groups it has already consumed), keeping source-tier bytes O(1)
+  in N.
+- :mod:`tpu9.scaleout.ledger` — the group ledger: who holds which shard
+  group (cache-server advertisement) and which groups each replica can
+  *serve* (per-group readiness off the pressure heartbeat).
+- :mod:`tpu9.scaleout.controller` — the burn-predictive autoscale
+  controller, a pure function over the SLO burn series + measured
+  bring-up time: scale up on fast-window burn slope before the slow
+  window trips, never scale down capacity that would take longer to
+  re-acquire than the remaining burn budget allows.
+
+:mod:`tpu9.scaleout.coordinator` glues them behind the gateway's
+heartbeat sampler and builds the ``/api/v1/scaleout`` report.
+
+Feature gates: config ``scaleout.*`` with the ``TPU9_SCALEOUT`` /
+``TPU9_SCALEOUT_PREDICTIVE`` env shortcuts beating config (the
+TPU9_DISAGG precedent — bench and chaos runs flip env, not files).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..config import ScaleoutConfig
+
+
+def scaleout_on(cfg: ScaleoutConfig | None = None) -> bool:
+    """Master gate for the distribution-tree plane. Env beats config."""
+    env = os.environ.get("TPU9_SCALEOUT", "").strip()
+    if env:
+        return env not in ("0", "false", "no", "off")
+    return cfg.enabled if cfg is not None else ScaleoutConfig().enabled
+
+
+def predictive_on(cfg: ScaleoutConfig | None = None) -> bool:
+    """Gate for the burn-predictive controller. Env beats config; the
+    default is OFF (the controller changes *when* capacity moves, so a
+    fleet opts in per deployment — the disagg precedent)."""
+    env = os.environ.get("TPU9_SCALEOUT_PREDICTIVE", "").strip()
+    if env:
+        return env not in ("0", "false", "no", "off")
+    return (cfg.predictive_enabled if cfg is not None
+            else ScaleoutConfig().predictive_enabled)

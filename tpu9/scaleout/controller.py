@@ -1,0 +1,178 @@
+"""Burn-predictive autoscale controller (ISSUE 17 tentpole c).
+
+Promotes PR 12's multi-window SLO burn from a pressure *clamp* into a
+scaling *controller*, as a pure function so the unit suite can drive it
+with synthetic ramps:
+
+- **Scale up on slope, not breach.** Fit the fast-window burn's slope
+  over a trailing window; if the projected burn (current + slope ×
+  horizon) crosses 1.0 while the slow window has NOT yet tripped, add
+  capacity now — the whole point is to move before the slow window
+  (the paging signal) fires.
+- **Scale down against measured bring-up.** A replica is only removable
+  if re-acquiring it (the coldstart record's measured ``ready_s`` ×
+  safety factor) fits inside the remaining slow-window burn budget
+  (≈ ``(1 − slow_burn) × slow_window``). Capacity that takes longer to
+  get back than the budget allows is never released.
+- **Staleness can never pin the fleet.** A burn series whose newest
+  sample is older than ``stale_after_s`` makes the controller decline
+  (action ``fallback``) — the wrapping policy then uses the base
+  reactive decision, the PR 12 "a stopped sampler must not pin pressure
+  forever" pattern applied to scaling.
+
+The :func:`predictive_policy` factory wraps any base ``DecideFn``-shaped
+callable (duck-typed on ``.desired``/``.reason`` — scaleout does not
+import the abstractions layer; the endpoint wires the two together).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..config import ScaleoutConfig
+
+# one burn observation: (monotonic_ts, burn_fast, burn_slow)
+BurnSample = Tuple[float, float, float]
+
+
+@dataclass
+class Decision:
+    """Pure controller verdict for one tick."""
+    action: str          # "up" | "down" | "hold" | "fallback"
+    desired: int         # predictive target replica count
+    reason: str = ""
+
+
+def burn_slope(series: Sequence[BurnSample], *, window_s: float,
+               now: Optional[float] = None) -> float:
+    """Least-squares slope (burn units / second) of the fast-window burn
+    over the trailing ``window_s``. Fewer than two points → 0.0 (no
+    opinion, never an extrapolation from a single sample)."""
+    if not series:
+        return 0.0
+    t1 = series[-1][0] if now is None else now
+    pts = [(ts, fast) for ts, fast, _ in series if t1 - ts <= window_s]
+    if len(pts) < 2:
+        return 0.0
+    n = len(pts)
+    mt = sum(p[0] for p in pts) / n
+    mb = sum(p[1] for p in pts) / n
+    var = sum((p[0] - mt) ** 2 for p in pts)
+    if var <= 0:
+        return 0.0
+    return sum((p[0] - mt) * (p[1] - mb) for p in pts) / var
+
+
+def decide_scale(
+    series: Sequence[BurnSample],
+    *,
+    replicas: int,
+    cfg: ScaleoutConfig,
+    now: Optional[float] = None,
+    bringup_s: Optional[float] = None,
+    slow_window_s: float = 3600.0,
+    min_replicas: int = 0,
+    max_replicas: int = 8,
+) -> Decision:
+    """One predictive tick. Pure: series in, :class:`Decision` out."""
+    t = time.monotonic() if now is None else now
+    if not series:
+        return Decision("fallback", replicas, "no burn samples")
+    age = t - series[-1][0]
+    if age > cfg.stale_after_s:
+        # PR 12 staleness guard, applied to scaling: a dead sampler
+        # yields NO predictive opinion — the reactive base decides
+        return Decision("fallback", replicas,
+                        f"burn series stale ({age:.1f}s > "
+                        f"{cfg.stale_after_s:.1f}s)")
+
+    _, fast, slow = series[-1]
+    slope = burn_slope(series, window_s=cfg.slope_window_s, now=t)
+    projected = fast + slope * cfg.burn_horizon_s
+
+    # -- scale up: projected fast burn crosses 1.0 before the slow
+    # window has tripped (once slow >= 1 the SLO is already lost and the
+    # pressure clamp owns the response; adding capacity still helps, so
+    # fast >= 1 keeps the reactive floor)
+    if (slope > 0 and projected >= 1.0 and slow < 1.0) or fast >= 1.0:
+        # overshoot scales the step: a projection already past 2×budget
+        # earns the full step, a bare crossing earns one replica
+        step = 1 if projected < 2.0 else cfg.scale_up_max_step
+        desired = min(max_replicas, replicas + max(1, step))
+        if desired > replicas:
+            return Decision("up", desired,
+                            f"fast burn {fast:.2f} slope {slope:+.4f}/s "
+                            f"→ {projected:.2f} within "
+                            f"{cfg.burn_horizon_s:.0f}s")
+
+    # -- scale down: quiet fleet AND the bring-up guard passes.
+    # remaining burn-budget time: if burning resumed at full rate the
+    # slow budget lasts about (1 − slow) × slow_window — the replica
+    # must be re-acquirable well inside that.
+    bring = bringup_s if (bringup_s is not None and bringup_s > 0) \
+        else cfg.default_bringup_s
+    budget_s = max(0.0, (1.0 - slow) * slow_window_s)
+    if fast <= 0.0 and slope <= 0.0 and slow < 0.5 \
+            and replicas > min_replicas:
+        if bring * cfg.bringup_safety > budget_s:
+            return Decision("hold", replicas,
+                            f"bringup {bring:.1f}s × {cfg.bringup_safety:g} "
+                            f"exceeds burn budget {budget_s:.1f}s — "
+                            "holding capacity")
+        return Decision("down", max(min_replicas, replicas - 1),
+                        f"idle (fast {fast:.2f}, slope {slope:+.4f}/s); "
+                        f"bringup {bring:.1f}s fits budget {budget_s:.1f}s")
+
+    return Decision("hold", replicas,
+                    f"fast {fast:.2f} slow {slow:.2f} slope {slope:+.4f}/s")
+
+
+def predictive_policy(
+    base: Callable,
+    *,
+    cfg: ScaleoutConfig,
+    burns: Callable[[], List[BurnSample]],
+    bringup: Callable[[], Optional[float]],
+    max_containers: int,
+    min_containers: int = 0,
+    slow_window_s: float = 3600.0,
+    clock: Callable[[], float] = time.monotonic,
+) -> Callable:
+    """Wrap a reactive ``DecideFn`` with the predictive controller.
+
+    Composition rules (each direction keeps its own safety property):
+    - ``up``: take the max of base and predictive targets — predictive
+      only ever ADDS earlier, never suppresses a reactive scale-up.
+    - ``hold``: the bring-up guard vetoes removals — desired is floored
+      at the current replica count even if the base wants fewer.
+    - ``down``: both agree the fleet is quiet — take the min.
+    - ``fallback`` (stale series): the base decision passes through
+      untouched, so a dead sampler can never pin the fleet anywhere.
+    """
+
+    def decide(samples):
+        res = base(samples)
+        replicas = samples[-1].active_containers if samples else 0
+        d = decide_scale(burns(), replicas=replicas, cfg=cfg,
+                         now=clock(), bringup_s=bringup(),
+                         slow_window_s=slow_window_s,
+                         min_replicas=min_containers,
+                         max_replicas=max_containers)
+        if d.action == "fallback":
+            return res
+        desired, reason = res.desired, res.reason
+        if d.action == "up" and d.desired > desired:
+            desired, reason = d.desired, f"predictive: {d.reason}"
+        elif d.action == "hold" and desired < replicas:
+            desired, reason = replicas, f"predictive: {d.reason}"
+        elif d.action == "down" and d.desired < desired:
+            desired, reason = d.desired, f"predictive: {d.reason}"
+        if desired == res.desired:
+            return res
+        res.desired = max(min_containers, min(max_containers, desired))
+        res.reason = reason
+        return res
+
+    return decide

@@ -1,0 +1,135 @@
+"""Gateway-side scale-out coordinator (ISSUE 17).
+
+Heartbeat-driven glue over the pure pieces: the fleet observer's cache
+-plane sampler feeds worker cache snapshots (held groups + per-peer
+latency EWMAs) and pressure-heartbeat readiness extras in; the
+coordinator keeps the :class:`~tpu9.scaleout.ledger.GroupLedger`
+current, re-plans the distribution tree each tick, and publishes the
+plan to the statestore key ``scaleout:tree`` where joining workers'
+checkpoint managers read their edges (`tree_hints`).
+
+The coordinator never blocks a restore: a worker that cannot reach the
+plan (or finds no edge for a group) falls back to plain HRW peer order
+and then the source tier — the plan is a preference, correctness never
+depends on it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Mapping, Optional
+
+from ..config import ScaleoutConfig
+from .ledger import GroupLedger
+from .tree import SOURCE, TreePlan, plan_tree, source_edge_count
+
+# statestore key the plan is published under (JSON TreePlan.to_dict())
+PLAN_KEY = "scaleout:tree"
+
+
+class ScaleoutCoordinator:
+    def __init__(self, cfg: Optional[ScaleoutConfig] = None) -> None:
+        self.cfg = cfg or ScaleoutConfig()
+        self.ledger = GroupLedger(stale_after_s=max(
+            15.0, self.cfg.stale_after_s * 3))
+        self.plan = TreePlan(fanout=self.cfg.tree_fanout)
+        self._peer_lat: Dict[str, float] = {}
+        self._plans = 0
+
+    # -- ingest (called from FleetObserver's samplers) -------------------
+    def observe_worker(self, worker_id: str, snap: Mapping,
+                       now: Optional[float] = None) -> None:
+        """Fold one ``worker:cache:<wid>`` snapshot: the worker's own
+        serve address, the complete groups its cache re-serves, and its
+        per-peer latency EWMAs (the planner's edge weights)."""
+        cache = snap.get("cache") or {}
+        addr = str(snap.get("addr") or cache.get("addr") or "")
+        groups = cache.get("groups") or []
+        self.ledger.note_held(worker_id, addr, groups, now=now)
+        for peer, st in (cache.get("peers") or {}).items():
+            lat = st.get("lat_ewma_s")
+            if isinstance(lat, (int, float)) and lat > 0:
+                # latest vantage wins: each worker's EWMA already smooths
+                self._peer_lat[str(peer)] = float(lat)
+
+    def observe_heartbeat(self, container_id: str, extra: Mapping,
+                          now: Optional[float] = None) -> None:
+        """Fold the ``scaleout_*`` pressure-heartbeat extras (serving
+        -plane readiness, distinct from cache-plane holding)."""
+        if "scaleout_ready_frac" not in extra:
+            return
+        groups = [g for g in str(
+            extra.get("scaleout_ready_groups", "")).split(",") if g]
+        self.ledger.note_ready(
+            container_id, groups,
+            float(extra.get("scaleout_ready_frac", 1.0)),
+            int(extra.get("scaleout_groups_total", 0) or 0), now=now)
+
+    # -- planning --------------------------------------------------------
+    def refresh(self, now: Optional[float] = None) -> TreePlan:
+        """Re-plan the tree from the current ledger. Cheap enough to run
+        every sampler tick; the plan only changes when membership or
+        group availability does (replan-on-peer-death is just this with
+        the dead replica aged out / forgotten)."""
+        holders = self.ledger.holders(now=now)
+        joiners = self.ledger.joiners(sorted(holders.keys()), now=now)
+        self.plan = plan_tree(joiners, holders,
+                              fanout=self.cfg.tree_fanout,
+                              peer_lat=self._peer_lat)
+        self._plans += 1
+        return self.plan
+
+    def forget(self, replica: str, now: Optional[float] = None) -> TreePlan:
+        """Coordinator-side replan on confirmed peer death: drop the
+        replica from the ledger and hand back fresh edges."""
+        self.ledger.forget(replica)
+        return self.refresh(now=now)
+
+    def stats(self) -> dict:
+        return {"plans": self._plans,
+                "edges": len(self.plan.edges()),
+                "source_edges": source_edge_count(self.plan),
+                "replicas": len(self.ledger.snapshot())}
+
+
+def build_report(ledger_snap: Mapping, plan: TreePlan,
+                 records: Optional[Mapping] = None) -> dict:
+    """Shape the ``/api/v1/scaleout`` payload (mirrors the coldstart
+    report): per replica — tree position (primary parent per group),
+    groups held/ready, readiness fraction, and bytes by edge from the
+    coldstart record's per-peer split (satellite 6).
+
+    ``records`` maps container_id -> merged coldstart record (the
+    gateway's ``/api/v1/coldstart`` rows, which carry
+    ``restore.peer_bytes``)."""
+    records = records or {}
+    replicas: List[dict] = []
+    for rid, row in sorted(ledger_snap.items()):
+        addr = row.get("addr", "")
+        parents = {g: ps[0] if ps else SOURCE
+                   for g, ps in plan.prefs.get(addr, {}).items()}
+        rec = records.get(rid) or {}
+        restore = rec.get("restore") or {}
+        edge_bytes = dict(restore.get("peer_bytes") or {})
+        tiers = restore.get("tiers") or {}
+        replicas.append({
+            "replica": rid,
+            "addr": addr,
+            "tree_parents": parents,
+            "children": sorted({c for c, _, p in plan.edges()
+                                if p == addr}),
+            "groups_held": row.get("held", []),
+            "groups_ready": row.get("ready", []),
+            "ready_frac": row.get("ready_frac", 1.0),
+            "stale": bool(row.get("stale", False)),
+            "bytes_by_edge": edge_bytes,
+            "bytes_source": tiers.get("source", 0),
+            "bytes_peer": tiers.get("peer", 0),
+        })
+    return {
+        "replicas": replicas,
+        "tree": {"fanout": plan.fanout,
+                 "edges": [{"child": c, "group": g, "parent": p}
+                           for c, g, p in plan.edges()],
+                 "source_edges": source_edge_count(plan)},
+    }

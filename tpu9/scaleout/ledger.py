@@ -1,0 +1,114 @@
+"""Group ledger (ISSUE 17): who holds which shard group, who can serve
+which groups, and how ready each joining replica is.
+
+Two distinct facts per replica, deliberately kept apart because they
+ride different channels and mean different things:
+
+- **held** groups (content keys) — the cache plane's fact, advertised by
+  the worker cache server / shipped in ``CacheClient.snapshot()`` via
+  the ``worker:cache:*`` store keys. A held group can be RE-SERVED to a
+  joining peer; this is what the tree planner's ``holders`` input is.
+- **ready** groups (weight-group names) + readiness fraction — the
+  serving plane's fact, off the ``scaleout_*`` pressure-heartbeat
+  extras. A ready group can serve REQUESTS; this is what the router's
+  partial-readiness admission reads.
+
+Everything is plain dict/monotonic-timestamp bookkeeping — no I/O, no
+asyncio — so the coordinator, the report builder and the tests all
+drive it directly.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence
+
+
+@dataclass
+class ReplicaGroups:
+    """One replica's view in the ledger."""
+    addr: str = ""                      # peer cache address (host:port)
+    held: List[str] = field(default_factory=list)    # content keys
+    ready: List[str] = field(default_factory=list)   # weight-group names
+    ready_frac: float = 1.0
+    groups_total: int = 0
+    last_seen: float = 0.0              # monotonic
+
+
+class GroupLedger:
+    """Fleet-wide group availability + readiness, aged like the fleet
+    observer's engine map: a replica that stops reporting falls out of
+    the holder sets after ``stale_after_s`` instead of receiving tree
+    children forever."""
+
+    def __init__(self, stale_after_s: float = 15.0) -> None:
+        self.stale_after_s = float(stale_after_s)
+        self._replicas: Dict[str, ReplicaGroups] = {}
+
+    # -- ingest ----------------------------------------------------------
+    def note_held(self, replica: str, addr: str,
+                  groups: Sequence[str],
+                  now: Optional[float] = None) -> None:
+        """Cache-plane fact: this replica's cache holds these content
+        keys (complete groups only — the client advertises a group when
+        its last shard has been consumed)."""
+        r = self._replicas.setdefault(replica, ReplicaGroups())
+        r.addr = addr or r.addr
+        r.held = sorted(set(groups))
+        r.last_seen = time.monotonic() if now is None else now
+
+    def note_ready(self, replica: str, groups: Sequence[str],
+                   frac: float, total: int = 0,
+                   now: Optional[float] = None) -> None:
+        """Serving-plane fact off the pressure heartbeat."""
+        r = self._replicas.setdefault(replica, ReplicaGroups())
+        r.ready = sorted(set(g for g in groups if g))
+        r.ready_frac = max(0.0, min(1.0, float(frac)))
+        r.groups_total = max(int(total), len(r.ready))
+        r.last_seen = time.monotonic() if now is None else now
+
+    def forget(self, replica: str) -> None:
+        self._replicas.pop(replica, None)
+
+    # -- queries ---------------------------------------------------------
+    def _fresh(self, now: Optional[float] = None) -> Dict[str, ReplicaGroups]:
+        t = time.monotonic() if now is None else now
+        return {k: v for k, v in self._replicas.items()
+                if t - v.last_seen <= self.stale_after_s}
+
+    def holders(self, now: Optional[float] = None) -> Dict[str, List[str]]:
+        """group content key -> fresh replica ADDRESSES holding it —
+        the tree planner's input."""
+        out: Dict[str, List[str]] = {}
+        for r in self._fresh(now).values():
+            if not r.addr:
+                continue
+            for g in r.held:
+                out.setdefault(g, []).append(r.addr)
+        return {g: sorted(hs) for g, hs in out.items()}
+
+    def joiners(self, groups: Sequence[str],
+                now: Optional[float] = None) -> List[str]:
+        """Fresh replica addresses still missing any of ``groups``."""
+        want = set(groups)
+        out = []
+        for r in self._fresh(now).values():
+            if r.addr and not want.issubset(set(r.held)):
+                out.append(r.addr)
+        return sorted(out)
+
+    def readiness(self, replica: str) -> float:
+        r = self._replicas.get(replica)
+        return r.ready_frac if r is not None else 1.0
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """Report-shaped dump (``/api/v1/scaleout`` per-replica rows)."""
+        t = time.monotonic() if now is None else now
+        return {k: {"addr": v.addr, "held": list(v.held),
+                    "ready": list(v.ready),
+                    "ready_frac": round(v.ready_frac, 4),
+                    "groups_total": v.groups_total,
+                    "age_s": round(max(0.0, t - v.last_seen), 3),
+                    "stale": (t - v.last_seen) > self.stale_after_s}
+                for k, v in sorted(self._replicas.items())}

@@ -1,0 +1,200 @@
+"""Multicast distribution tree planner (ISSUE 17 tentpole a).
+
+Pure functions: given which replicas already *hold* each shard group
+(cache-server advertisements), which replicas are *joining*, the
+per-peer latency EWMAs the cache clients already maintain, and a fanout
+bound, produce a :class:`TreePlan` — for every (joiner, group) an
+ordered preference list of parents to fetch that group from.
+
+Planner rules (documented in ARCHITECTURE.md "Scale-out plane"):
+
+- **Source stays O(1).** A group with no holder gets exactly ONE
+  source edge (the lexicographically-first joiner); every other joiner
+  chains off replicas, never the source. With a seed replica present the
+  steady state is zero source edges per scale-out wave.
+- **Fanout-bounded cascade.** A parent serves at most ``fanout``
+  children per group per wave; once a wave fills, the joiners assigned
+  in it become parents for the next wave ("every replica re-serves what
+  it has consumed"), so depth grows O(log_fanout N).
+- **Latency-weighted, deterministic.** Among parents with spare fanout
+  the child picks the lowest latency EWMA; ties break on a stable hash
+  of (group, child, parent) so two coordinators with the same inputs
+  plan the same tree, and children spread instead of piling onto one
+  parent.
+- **Preference lists, not single edges.** The plan hands each child its
+  parent FIRST, then the surviving holders by latency, so a
+  mid-transfer peer death falls through to the next preference inside
+  the cache client's hedged read — the worker-side half of re-planning.
+  (:func:`replan` is the coordinator-side half: drop the dead peer and
+  re-run the planner for still-incomplete children.)
+
+No I/O, no asyncio, no tpu9 imports beyond utils — the coordinator and
+the bench both drive this as plain data in / plain data out.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence
+
+# plan marker for "fetch from the source tier" — the cache client treats
+# an empty preference list as plain HRW + source fallback, so SOURCE
+# edges only exist in the plan for *accounting* (the report shows them)
+SOURCE = "@source"
+
+
+@dataclass
+class TreePlan:
+    """Edges for one scale-out wave.
+
+    ``prefs[child][group]`` is the ordered parent preference list for
+    that (child, group) — primary parent first, then surviving holders
+    by latency. ``SOURCE`` appears only as the last resort of the one
+    designated source-edge child per holderless group.
+    """
+    fanout: int = 2
+    prefs: Dict[str, Dict[str, List[str]]] = field(default_factory=dict)
+
+    def parents(self, child: str, group: str) -> List[str]:
+        return list(self.prefs.get(child, {}).get(group, []))
+
+    def peer_prefs(self, child: str, group: str) -> List[str]:
+        """Preference list with the SOURCE marker stripped — what the
+        cache client's ``prefer=`` argument actually wants."""
+        return [p for p in self.parents(child, group) if p != SOURCE]
+
+    def edges(self) -> List[tuple]:
+        """Flat (child, group, primary_parent) list for reports."""
+        out = []
+        for child in sorted(self.prefs):
+            for group in sorted(self.prefs[child]):
+                pref = self.prefs[child][group]
+                out.append((child, group, pref[0] if pref else SOURCE))
+        return out
+
+    def to_dict(self) -> dict:
+        return {"fanout": self.fanout, "prefs": self.prefs}
+
+    @classmethod
+    def from_dict(cls, node: Mapping) -> "TreePlan":
+        prefs = {str(c): {str(g): [str(p) for p in ps]
+                          for g, ps in gm.items()}
+                 for c, gm in dict(node.get("prefs", {})).items()}
+        return cls(fanout=int(node.get("fanout", 2)), prefs=prefs)
+
+
+def _tie(group: str, child: str, parent: str) -> int:
+    """Stable tie-break hash: deterministic across processes (no
+    PYTHONHASHSEED dependence) and different per (group, child) so
+    equal-latency children spread across parents instead of piling."""
+    h = hashlib.blake2b(f"{group}|{child}|{parent}".encode(),
+                        digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+def plan_tree(
+    joiners: Sequence[str],
+    holders: Mapping[str, Sequence[str]],
+    *,
+    fanout: int = 2,
+    peer_lat: Optional[Mapping[str, float]] = None,
+) -> TreePlan:
+    """Plan one scale-out wave.
+
+    joiners: replica cache addresses that still need groups.
+    holders: group key -> addresses that already hold it (advertised).
+    peer_lat: address -> latency EWMA seconds (missing = 50ms default,
+        so un-measured peers neither win nor lose automatically).
+    """
+    fanout = max(1, int(fanout))
+    lat = dict(peer_lat or {})
+    groups = sorted(holders.keys())
+    plan = TreePlan(fanout=fanout)
+    for j in joiners:
+        plan.prefs.setdefault(j, {})
+
+    for group in groups:
+        have = [h for h in holders.get(group, []) if h]
+        need = sorted(j for j in joiners if j not in have)
+        if not need:
+            continue
+        if not have:
+            # holderless group: ONE source edge, everything else chains
+            # off that first joiner in later waves
+            root, rest = need[0], need[1:]
+            plan.prefs[root][group] = [SOURCE]
+            have, need = [root], rest
+        # wave assignment: parents serve ≤ fanout children per group;
+        # children assigned this wave parent the next wave
+        load: Dict[str, int] = {}
+        parents = sorted(have)
+        wave = list(need)
+        while wave:
+            next_wave: List[str] = []
+            for child in wave:
+                open_parents = [p for p in parents
+                                if p != child and load.get(p, 0) < fanout]
+                if not open_parents:
+                    next_wave.append(child)
+                    continue
+                pick = min(open_parents,
+                           key=lambda p: (lat.get(p, 0.050),
+                                          _tie(group, child, p)))
+                load[pick] = load.get(pick, 0) + 1
+                # primary parent first, then the other CURRENT holders
+                # by latency as live fallbacks (not same-wave children:
+                # a sibling may never finish)
+                backups = sorted(
+                    (p for p in parents if p not in (pick, child)),
+                    key=lambda p: (lat.get(p, 0.050),
+                                   _tie(group, child, p)))
+                plan.prefs[child][group] = [pick] + backups
+            if len(next_wave) == len(wave):
+                break  # defensive: no parent made progress
+            # this wave's children re-serve the group next wave
+            parents = sorted(set(parents)
+                             | {c for c in wave if c not in next_wave})
+            wave = next_wave
+    return plan
+
+
+def replan(
+    plan: TreePlan,
+    dead: Sequence[str],
+    holders: Mapping[str, Sequence[str]],
+    *,
+    incomplete: Optional[Mapping[str, Sequence[str]]] = None,
+    peer_lat: Optional[Mapping[str, float]] = None,
+) -> TreePlan:
+    """Coordinator-side re-plan after peer death.
+
+    Children whose remaining (still-incomplete) groups referenced a dead
+    peer get fresh edges over the SURVIVING holders; completed groups
+    keep their (historical) edges for the report. ``incomplete`` maps
+    child -> groups still in flight; when omitted every planned group is
+    treated as in flight.
+    """
+    gone = set(dead)
+    live_holders = {g: [h for h in hs if h not in gone]
+                    for g, hs in holders.items()}
+    out = TreePlan(fanout=plan.fanout,
+                   prefs={c: dict(gm) for c, gm in plan.prefs.items()})
+    for child, gmap in plan.prefs.items():
+        pending = (set(incomplete.get(child, gmap.keys()))
+                   if incomplete is not None else set(gmap.keys()))
+        for group in list(gmap):
+            if group not in pending:
+                continue
+            if not any(p in gone for p in gmap[group]):
+                continue
+            fresh = plan_tree([child], {group: live_holders.get(group, [])},
+                              fanout=plan.fanout, peer_lat=peer_lat)
+            out.prefs[child][group] = fresh.parents(child, group)
+    return out
+
+
+def source_edge_count(plan: TreePlan) -> int:
+    """How many (child, group) edges terminate at the source tier —
+    the number the O(1)-source assertion watches."""
+    return sum(1 for _, _, parent in plan.edges() if parent == SOURCE)

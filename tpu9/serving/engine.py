@@ -358,6 +358,12 @@ class InferenceEngine:
         # stats() forwards them flat so the heartbeat can ship them into
         # the per-replica coldstart record
         self.bringup: dict = {}
+        # execute-while-scaling readiness (ISSUE 17): weight groups bound
+        # so far vs expected — set via note_group_bound() as the restore
+        # streams, forwarded flat (scaleout_*) on the pressure heartbeat
+        # so the router can admit per-group before the restore completes.
+        # Empty = not a partial bring-up: ready_frac reports 1.0.
+        self._scaleout_groups: dict = {"total": 0, "bound": []}
         # per-ENGINE latency registry (TTFT/TBT/queue-wait/prefill/decode
         # windows): its summaries ride stats() → the runner's pressure
         # heartbeat → /api/v1/metrics "engines". A process-global registry
@@ -551,6 +557,18 @@ class InferenceEngine:
         the tree per ``decoder_param_specs`` here (already-sharded arrays
         device_put to their own sharding, a no-op)."""
         self.params = self.policy.place_params(params)
+
+    def note_group_bound(self, group: str, total: int) -> None:
+        """Execute-while-scaling bookkeeping (ISSUE 17): one weight group
+        of a streaming restore has been bound. The engine itself binds a
+        complete tree via :meth:`bind_params`; THIS records which groups
+        have arrived so the pressure heartbeat reports per-group
+        readiness and the router can admit matching requests before the
+        final group lands."""
+        sg = self._scaleout_groups
+        sg["total"] = max(int(total), sg["total"])
+        if group and group not in sg["bound"]:
+            sg["bound"].append(group)
 
     def precompile(self) -> dict:
         """AOT-compile every steady-state serving graph from SHAPES alone.
@@ -1036,6 +1054,16 @@ class InferenceEngine:
         for k, v in self.bringup.items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[f"coldstart_{k}"] = v
+        # execute-while-scaling readiness (ISSUE 17): flat scaleout_*
+        # scalars, same heartbeat-forwarding contract as coldstart_*.
+        # No partial bring-up in flight (total == 0) reports fully ready
+        # so steady-state replicas are indistinguishable from before.
+        sg = self._scaleout_groups
+        out["scaleout_groups_total"] = sg["total"]
+        out["scaleout_groups_ready"] = len(sg["bound"])
+        out["scaleout_ready_frac"] = round(
+            len(sg["bound"]) / sg["total"], 4) if sg["total"] else 1.0
+        out["scaleout_ready_groups"] = ",".join(sg["bound"])
         lat = {}
         summaries = self.metrics.to_dict()["summaries"]
         for phase in ("ttft", "tbt", "queue_wait", "prefill",

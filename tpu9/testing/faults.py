@@ -40,6 +40,12 @@ Fault kinds and their hook points:
                     sees a mid-request connection reset)
 ``peer_read_error`` cache peer chunk read raises (hedged-read path)
 ``peer_read_slow``  cache peer chunk read delayed by ``delay_s``
+``tree_peer_loss``  scale-out tree (ISSUE 17): reads against ONE peer —
+                    selected with the ``peer=<addr substring>`` option —
+                    fail from arming on, simulating a tree parent dying
+                    mid-transfer; the hedged read re-plans onto the
+                    surviving preference list (cache ``_peer_get`` via
+                    :meth:`FaultPlane.fire_peer`)
 ``kv_ship_error``   runner's kvwire adopt path fails before the fetch —
                     block-ship resume degrades to re-prefill (ISSUE 16)
 ==================  ========================================================
@@ -190,6 +196,22 @@ class FaultPlane:
         log.warning("fault plane: firing %r (fired %d, call %d)",
                     kind, spec.fired, spec.calls)
         return True
+
+    def fire_peer(self, kind: str, peer: str,
+                  tokens: Optional[int] = None) -> bool:
+        """Peer-targeted faults (``tree_peer_loss``): fire only when the
+        spec's ``peer=`` option (substring match on the address, empty =
+        any peer) selects this peer. Calls against non-matching peers do
+        NOT advance the spec's call counter — ``after_calls=N`` counts
+        attempts against the victim, which is what "dies after N chunks"
+        means in a multi-peer race."""
+        spec = self.specs.get(kind)
+        if spec is None:
+            return False
+        pat = str(spec.extra.get("peer", ""))
+        if pat and pat not in peer:
+            return False
+        return self.fire(kind, tokens=tokens)
 
     def active(self, kind: str, tokens: Optional[int] = None) -> bool:
         """Window faults (stall / heartbeat_loss): True while the fault

@@ -125,13 +125,29 @@ class LocalStack:
         from ..worker.weightpool import WeightPool
         weight_pool = WeightPool(self.cfg.worker.weight_pool_mb << 20) \
             if self.cfg.worker.weight_pool_mb > 0 else None
+        async def tree_hints(group_key: str):
+            # scale-out tree (ISSUE 17) — same closure as the production
+            # worker bootstrap: look this replica's preference list up in
+            # the gateway-published plan; no plan degrades to HRW order.
+            from ..scaleout import scaleout_on
+            from ..scaleout.coordinator import PLAN_KEY
+            from ..scaleout.tree import TreePlan
+            if not scaleout_on(self.cfg.scaleout):
+                return []
+            blob = await self.store.get(PLAN_KEY)
+            if not blob:
+                return []
+            plan = TreePlan.from_dict(
+                blob if isinstance(blob, dict) else json.loads(blob))
+            return plan.peer_prefs(cache.client.self_address, group_key)
+
         checkpoints = CheckpointManager(
             cache.client,
             record=self._ckpt_record, update=self.backend.update_checkpoint,
             fetch_manifest=self._ckpt_fetch,
             store_manifest=self._ckpt_store,
             marker_timeout_s=20.0,
-            weight_pool=weight_pool)
+            weight_pool=weight_pool, tree_hints=tree_hints)
 
         from ..worker.disks import DiskManager
 

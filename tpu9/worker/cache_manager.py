@@ -32,9 +32,13 @@ class WorkerCache:
         self.workers = workers
         data_dir = os.path.join(cfg.data_dir, worker_id)
         self.store = DiskStore(data_dir, max_bytes=cfg.max_bytes)
-        self.server = ChunkServer(self.store, port=cfg.port)
         self.client = CacheClient(self.store, self._peers, source=source,
                                   replicas=cfg.replicas)
+        # the chunk server advertises the client's complete shard groups
+        # over the wire (op "groups") — the scale-out tree's per-group
+        # availability signal (ISSUE 17)
+        self.server = ChunkServer(self.store, port=cfg.port,
+                                  groups_fn=lambda: self.client.groups)
         fusefs = None
         try:
             from ..cache.fusefs import CacheFsManager

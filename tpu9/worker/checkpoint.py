@@ -44,6 +44,10 @@ RecordFn = Callable[[str, str, str], Awaitable[str]]
 UpdateFn = Callable[[str, str, str, int], Awaitable[None]]
 # async (checkpoint_id) -> manifest json | None
 FetchFn = Callable[[str], Awaitable[Optional[str]]]
+# async (group content key) -> ordered parent peer addresses (ISSUE 17):
+# the scale-out coordinator's tree edges for THIS replica; empty/None =
+# no plan, plain HRW order
+TreeHintFn = Callable[[str], Awaitable[Optional[list]]]
 
 
 class CheckpointManager:
@@ -56,7 +60,8 @@ class CheckpointManager:
                  weight_pool=None,
                  stream_weights: bool = True,
                  marker_poll_s: float = 0.25,
-                 marker_poll_max_s: float = 1.0):
+                 marker_poll_max_s: float = 1.0,
+                 tree_hints: Optional[TreeHintFn] = None):
         self.cache = cache
         self.record = record
         self.update = update
@@ -68,8 +73,32 @@ class CheckpointManager:
         self.stream_weights = stream_weights
         self.marker_poll_s = marker_poll_s
         self.marker_poll_max_s = marker_poll_max_s
+        self.tree_hints = tree_hints
         # per-restore phase evidence (bench + tests read this after restore)
         self.last_restore_metrics: dict = {}
+
+    # -- scale-out tree glue (ISSUE 17) ----------------------------------
+
+    async def _tree_prefer(self, key: str) -> list:
+        """The coordinator's parent preference list for one group — a
+        best-effort hint: any failure (no plan yet, store unreachable)
+        degrades to plain HRW order, never to a failed restore."""
+        if self.tree_hints is None:
+            return []
+        try:
+            return list(await self.tree_hints(key) or [])
+        except Exception as exc:   # noqa: BLE001
+            log.debug("tree hint lookup failed for %s: %s", key, exc)
+            return []
+
+    def _advertise(self, key: str) -> None:
+        """A group restored via the CHUNK stream has all its chunks in
+        the local store — advertise it as re-servable to joining peers.
+        (A warm-pool hit never fetched chunks, so it must NOT advertise:
+        the edge would dangle.)"""
+        adv = getattr(self.cache, "advertise_group", None)
+        if adv is not None:
+            adv(key)
 
     # -- create ---------------------------------------------------------------
 
@@ -304,6 +333,11 @@ class CheckpointManager:
                 "checkpoint_id": checkpoint_id, "trace_id": trace_id,
                 "plan_s": 0.0,
                 "tiers": {"pool": 0, "local": 0, "peer": 0, "source": 0},
+                # per-EDGE split of the peer tier (ISSUE 17 satellite):
+                # serving replica address -> bytes it served this restore
+                # — the one "peer" bucket above hid which replica fed
+                # whom, which the tree-distribution evidence needs
+                "peer_bytes": {},
                 "hedge": {"fired": 0, "wins": 0, "wasted_bytes": 0},
                 "groups_detail": []}
 
@@ -360,12 +394,20 @@ class CheckpointManager:
                    "tier": tier, **ih})
         for t in ("local", "peer", "source"):
             metrics["tiers"][t] += delta.get(f"bytes_{t}", 0)
+        # per-edge attribution: the client ledger tallies
+        # "bytes_peer:<addr>" per winning replica (ISSUE 17 satellite)
+        edge_bytes = {k.split(":", 1)[1]: v for k, v in delta.items()
+                      if k.startswith("bytes_peer:")}
+        for addr, n in edge_bytes.items():
+            metrics["peer_bytes"][addr] = \
+                metrics["peer_bytes"].get(addr, 0) + n
         metrics["hedge"]["fired"] += delta.get("hedged_reads", 0)
         metrics["hedge"]["wins"] += delta.get("hedge_wins", 0)
         metrics["hedge"]["wasted_bytes"] += delta.get("hedge_wasted_bytes",
                                                       0)
         metrics["groups_detail"].append({
             "group": group, "tier": tier, "bytes": st["bytes"],
+            "peer_bytes": edge_bytes,
             "shards": st["shards"], "consumer": consumer,
             "plan_s": st.get("plan_s", 0.0),
             "fetch_s": st["fetch_s"], "put_s": st["put_s"],
@@ -413,7 +455,8 @@ class CheckpointManager:
 
     async def _stream_group_shards(self, group: str, entries: list,
                                    consume, metrics: dict, on_plan=None,
-                                   consumer: str = "consume"):
+                                   consumer: str = "consume",
+                                   prefer: Optional[list] = None):
         """Pool-miss skeleton shared by the workdir and direct-to-device
         restores: plan → hedged chunk stream → double-buffered
         ``stream_shards(consume)``, phase metrics accumulated in one
@@ -433,7 +476,8 @@ class CheckpointManager:
         # classic materialize fetches through the same CacheClient, and
         # its traffic must not leak into this group's tier/hedge evidence
         ledger: dict = {}
-        chunk_stream = self.cache.get_stream(digests, ledger=ledger)
+        chunk_stream = self.cache.get_stream(digests, ledger=ledger,
+                                             prefer=prefer)
         try:
             out, st = await stream_shards(leaf_entries, chunk_stream,
                                           consume=consume)
@@ -515,7 +559,9 @@ class CheckpointManager:
         index, leaf_entries, by_path, arrays = \
             await self._stream_group_shards(group, entries, write_shard,
                                             metrics, on_plan=note_plan,
-                                            consumer="workdir_spill")
+                                            consumer="workdir_spill",
+                                            prefer=await
+                                            self._tree_prefer(key))
         idx_entry = by_path[f"{group}/{wfmt.INDEX_NAME}"]
         with os.fdopen(open_nofollow(spill_path(wfmt.INDEX_NAME),
                                      os.O_TRUNC), "w") as f:
@@ -523,10 +569,14 @@ class CheckpointManager:
             os.fchmod(f.fileno(), idx_entry.mode & 0o777)
         if retain[0]:
             self.weight_pool.put(key, index, arrays)
+        # every chunk of this group is now in the local store — this
+        # replica becomes a tree parent for later joiners (ISSUE 17)
+        self._advertise(key)
         return {f"{group}/{e['file']}" for e in leaf_entries} \
             | {f"{group}/{wfmt.INDEX_NAME}"}
 
-    async def restore_params(self, checkpoint_id: str, device_put=None
+    async def restore_params(self, checkpoint_id: str, device_put=None,
+                             on_group=None
                              ) -> tuple[Optional[dict], dict]:
         """Direct-to-device restore: no workdir at all. Streams every
         weight group of the checkpoint into host buffers and hands each
@@ -537,7 +587,15 @@ class CheckpointManager:
         the checkpoint has no streamable weights.
 
         A warm-pool hit skips cache + deserialize entirely: pooled host
-        arrays go straight through ``device_put``."""
+        arrays go straight through ``device_put``.
+
+        ``on_group(group, tree, done, total)`` (ISSUE 17
+        execute-while-scaling) fires as EACH group's tree is assembled —
+        the runner binds it into the engine and reports per-group
+        readiness while later groups are still in flight, so the first
+        admitted request never waits for the full restore. A callback
+        failure fails the restore (a half-bound engine must not be
+        reported ready)."""
         from ..serving import weights as wfmt
         from .weightstream import default_device_put
         with tracer.span(cs.SPAN_REQUEST, attrs={
@@ -559,6 +617,7 @@ class CheckpointManager:
             metrics["weight_groups"] = len(groups)
             put = device_put or default_device_put
             out: dict = {}
+            total = len(groups)
             for group, entries in groups.items():
                 key = wfmt.content_key(entries)
                 pooled = self._pool_get(key)
@@ -578,6 +637,8 @@ class CheckpointManager:
                     self._note_pool_group(group, index, (t0, t1), wall0,
                                           metrics, consumer="device_put")
                     out[group] = wfmt.assemble(index, dev)
+                    if on_group is not None:
+                        on_group(group, out[group], len(out), total)
                     continue
                 host_arrays: list = []
                 retain = [False]
@@ -593,9 +654,14 @@ class CheckpointManager:
 
                 index, _, _, dev = await self._stream_group_shards(
                     group, entries, put_and_keep, metrics,
-                    on_plan=note_plan, consumer="device_put")
+                    on_plan=note_plan, consumer="device_put",
+                    prefer=await self._tree_prefer(key))
                 out[group] = wfmt.assemble(index, dev)
                 if retain[0]:
                     self.weight_pool.put(key, index, host_arrays)
+                # chunks are local now — re-servable to joining peers
+                self._advertise(key)
+                if on_group is not None:
+                    on_group(group, out[group], len(out), total)
             self._finalize_record(metrics)
             return out, metrics
