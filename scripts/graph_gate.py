@@ -48,6 +48,10 @@ def main(argv=None) -> int:
                          "tier-1 contract)")
     ap.add_argument("--no-compile", action="store_true")
     ap.add_argument("--repo-root", default=None)
+    ap.add_argument("--strict-stale", action="store_true",
+                    help="fail when lint-baseline entries for the graph "
+                         "AST rules no longer fire (shared ratchet "
+                         "semantics with lint_gate/wire_gate)")
     args = ap.parse_args(argv)
 
     from tpu9.analysis import load_baseline, run_analysis
@@ -75,7 +79,11 @@ def main(argv=None) -> int:
     repo_root = args.repo_root or find_repo_root()
     result = run_analysis(repo_root, select=set(GRAPH_AST_RULES))
     baseline = load_baseline(os.path.join(repo_root, DEFAULT_BASELINE))
-    lint_new, _known, _stale = gate(result, baseline)
+    lint_new, _known, lint_stale = gate(result, baseline)
+    # this pass only ran the graph AST rules — staleness elsewhere in
+    # the lint ledger is lint_gate's business, not ours
+    lint_stale = [e for e in lint_stale
+                  if e.get("rule") in set(GRAPH_AST_RULES)]
 
     findings = list(report["findings"]) + lint_new
     for f in findings:
@@ -98,6 +106,14 @@ def main(argv=None) -> int:
         print(f"graph_gate: FAIL — full matrix took {matrix_s:.1f}s > "
               f"budget {args.budget_s:.0f}s (trim the matrix or move a "
               "cell to the slow tier)", file=sys.stderr)
+        return 1
+    if args.strict_stale and lint_stale:
+        for e in lint_stale:
+            print(f"stale baseline entry (prune or lint_gate "
+                  f"--update-baseline): {e['rule']} {e['path']} "
+                  f"[{e.get('symbol')}]")
+        print("graph_gate: FAIL — stale baseline entries (--strict-stale)",
+              file=sys.stderr)
         return 1
     print("graph_gate: OK")
     return 0

@@ -4,7 +4,9 @@
 The fast suite runs this next to bench_guard.py (tests/test_lint.py): the
 triaged debt lives in scripts/lint_baseline.json, inline ``# tpu9:
 noqa[RULE] reason`` suppressions cover reviewed sites, and anything else is
-a regression that fails CI.
+a regression that fails CI. Gate semantics (scoped stale filtering,
+baseline updates that preserve out-of-scope triage, ``--strict-stale``)
+are shared with wire_gate.py via tpu9/analysis/gatelib.py.
 
     python scripts/lint_gate.py                    # gate the repo
     python scripts/lint_gate.py --update-baseline --reason "why"
@@ -16,117 +18,31 @@ Exit codes: 0 clean, 1 new findings (or stale with --strict-stale),
 
 from __future__ import annotations
 
-import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu9.analysis import (DEFAULT_BASELINE, find_repo_root,  # noqa: E402
-                           load_baseline, run_analysis)
-from tpu9.analysis.findings import Baseline  # noqa: E402
-from tpu9.analysis.runner import gate  # noqa: E402
+from tpu9.analysis import DEFAULT_BASELINE, run_analysis  # noqa: E402
+from tpu9.analysis.gatelib import ratchet_main  # noqa: E402
 
 
-def _in_roots(path: str, roots) -> bool:
-    for r in roots:
-        r = r.rstrip("/")
-        if path == r or path.startswith(r + "/"):
-            return True
-    return False
+def _run(repo_root, roots, select, args):
+    kwargs = {}
+    if roots:
+        kwargs["roots"] = roots
+    if args.boundaries:
+        kwargs["boundaries_toml"] = args.boundaries
+    return run_analysis(repo_root, select=select, **kwargs)
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--repo-root", default=None)
-    ap.add_argument("--roots", nargs="*", default=None)
-    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
-    ap.add_argument("--boundaries", default=None,
-                    help="override boundaries.toml (tests)")
-    ap.add_argument("--strict-stale", action="store_true",
-                    help="fail when baseline entries no longer fire")
-    ap.add_argument("--update-baseline", action="store_true",
-                    help="record every NEW finding as suppressed (requires "
-                         "--reason) and prune stale entries")
-    ap.add_argument("--reason", default="",
-                    help="mandatory triage reason for --update-baseline")
-    args = ap.parse_args(argv)
-
-    repo_root = args.repo_root or find_repo_root()
-    # a run over non-default roots sees only a slice of the repo: baseline
-    # entries outside the slice would look "stale" and must not be pruned
-    # or even reported as such
-    scoped = bool(args.roots)
-    kwargs = {}
-    if args.roots:
-        kwargs["roots"] = args.roots
-    if args.boundaries:
-        kwargs["boundaries_toml"] = args.boundaries
-    result = run_analysis(repo_root, **kwargs)
-
-    bl_path = args.baseline
-    if not os.path.isabs(bl_path):
-        bl_path = os.path.join(repo_root, bl_path)
-    baseline = load_baseline(bl_path)
-    new, known, stale = gate(result, baseline)
-    if scoped:
-        # keep only stale entries the narrowed run actually scanned —
-        # entries outside the slice are not evidence of anything
-        stale = [e for e in stale
-                 if _in_roots(e.get("path", ""), args.roots)]
-
-    for err in result.parse_errors:
-        print(f"lint_gate: parse error: {err}", file=sys.stderr)
-    if result.parse_errors:
-        return 2
-
-    if args.update_baseline:
-        if new and not args.reason.strip():
-            print("lint_gate: --update-baseline needs --reason (suppressions "
-                  "without a reason are not triage)", file=sys.stderr)
-            return 2
-        fresh = Baseline()
-        fresh.fixed = baseline.fixed
-        for f in known:
-            fresh.entries[f.fingerprint] = baseline.entries[f.fingerprint]
-        if scoped:
-            # keep everything the narrowed run could not see — a scoped
-            # update must never destroy the rest of the triage ledger
-            # (in-scope stale entries are still pruned)
-            live = {f.fingerprint for f in known}
-            for fp, e in baseline.entries.items():
-                if fp not in live and not _in_roots(e.get("path", ""),
-                                                    args.roots):
-                    fresh.entries[fp] = e
-        for f in new:
-            fresh.add(f, args.reason.strip())
-        fresh.save(bl_path)
-        pruned = len(stale)     # already scope-filtered above
-        print(f"lint_gate: baseline updated — {len(new)} added, "
-              f"{pruned} stale pruned, {len(known)} kept"
-              + (" (scoped run: out-of-scope entries preserved)"
-                 if scoped else ""))
-        return 0
-
-    for f in new:
-        print(f"NEW  {f.format()}")
-    for e in stale:
-        print(f"stale baseline entry (prune or --update-baseline): "
-              f"{e['rule']} {e['path']} [{e.get('symbol')}]")
-    print(f"lint_gate: {result.files_scanned} files in "
-          f"{result.elapsed_s:.2f}s — {len(new)} new, {len(known)} "
-          f"baselined, {len(result.suppressed)} noqa'd, {len(stale)} stale")
-    if new:
-        print("lint_gate: FAIL — new findings above. Fix them, or suppress "
-              "with `# tpu9: noqa[RULE] reason` / --update-baseline "
-              "--reason.", file=sys.stderr)
-        return 1
-    if stale and args.strict_stale:
-        print("lint_gate: FAIL — stale baseline entries (--strict-stale)",
-              file=sys.stderr)
-        return 1
-    print("lint_gate: OK")
-    return 0
+    return ratchet_main(
+        "lint_gate", _run, DEFAULT_BASELINE, argv=argv,
+        doc=__doc__.splitlines()[0],
+        add_args=lambda ap: ap.add_argument(
+            "--boundaries", default=None,
+            help="override boundaries.toml (tests)"))
 
 
 if __name__ == "__main__":

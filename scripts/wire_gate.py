@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""wirecheck ratchet gate (ISSUE 18) — fails on any NEW wire-contract drift.
+
+Tier-1 wiring next to lint_gate.py / graph_gate.py (tests/
+test_wirecheck.py runs it): producer/consumer key sets for the
+string-keyed wire surfaces (heartbeat fields, tpu9_* metrics, store key
+namespaces, TPU9_* env knobs, rpc routes) are AST-extracted and checked
+against tpu9/analysis/contracts.toml. Triaged debt lives in
+scripts/wire_baseline.json; inline ``# tpu9: noqa[RULE] reason``
+suppressions cover reviewed sites; anything else fails CI.
+
+    python scripts/wire_gate.py                    # gate the repo
+    python scripts/wire_gate.py --select WIR001 --roots tpu9/serving
+    python scripts/wire_gate.py --update-baseline --reason "why"
+    python scripts/wire_gate.py --strict-stale     # also fail on stale debt
+
+Exit codes: 0 clean, 1 new findings (or stale with --strict-stale, or
+budget exceeded), 2 contract/parse errors.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu9.analysis.gatelib import ratchet_main  # noqa: E402
+from tpu9.analysis.wirecheck import (DEFAULT_BASELINE,  # noqa: E402
+                                     DEFAULT_CONTRACTS, run_wirecheck)
+
+
+def _run(repo_root, roots, select, args):
+    cpath = args.contracts or DEFAULT_CONTRACTS
+    if not os.path.isabs(cpath):
+        cpath = os.path.join(repo_root, cpath)
+    return run_wirecheck(repo_root, roots=roots, select=select,
+                         contracts_path=cpath)
+
+
+def main(argv=None) -> int:
+    return ratchet_main(
+        "wire_gate", _run, DEFAULT_BASELINE, argv=argv,
+        doc=__doc__.splitlines()[0], budget_s=120.0,
+        add_args=lambda ap: ap.add_argument(
+            "--contracts", default=None,
+            help="override contracts.toml (tests)"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

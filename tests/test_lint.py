@@ -908,13 +908,16 @@ def test_fault_plane_contract_declared_and_live():
                 or mod.startswith("tpu9.serving")):
             assert not any(t.startswith(rmod) for t in targets), mod
     # the hook-site imports are env-GATED: a production container without
-    # TPU9_FAULTS never executes them (source-level check on the gate)
+    # TPU9_FAULTS never executes them (source-level check on the gate —
+    # the raw environ read now lives in config.env_faults_spec, ISSUE 18)
     for rel in ("tpu9/runner/llm.py", "tpu9/cache/client.py",
                 "tpu9/worker/worker.py"):
         src = open(os.path.join(REPO, rel)).read()
-        gate = src.index("TPU9_FAULTS")
+        gate = src.index("if env_faults_spec()")
         imp = src.index("from ..testing.faults import")
         assert gate < imp, f"{rel}: faults import is not env-gated"
+    cfg_src = open(os.path.join(REPO, "tpu9", "config.py")).read()
+    assert 'os.environ.get("TPU9_FAULTS"' in cfg_src
 
 
 def test_kvwire_contract_declared_and_live():

@@ -308,7 +308,8 @@ async def test_otlp_exporter_pushes_spans_and_metrics():
     with tracer.span("outer", attrs={"stub_id": "s1"}):
         with tracer.span("inner"):
             pass
-    registry.inc("tpu9_requests", 3, {"route": "invoke"})
+    registry.inc("tpu9_requests", 3,  # tpu9: noqa[WIR002] local-registry fixture series, not product telemetry
+                 {"route": "invoke"})
     registry.set_gauge("tpu9_pool_workers", 2, {"pool": "default"})
     registry.observe("tpu9_startup_phase_s", 0.25, {"phase": "image"})
 
@@ -464,8 +465,8 @@ def test_otlp_attr_and_field_golden_mapping():
 
     snapshot = {
         "counters": {'tpu9_requests{route="invoke"}': 3.0},
-        "gauges": {"tpu9_depth": 7.0},
-        "summaries": {"tpu9_lat_s": {"count": 4, "mean": 0.375,
+        "gauges": {"tpu9_depth": 7.0},  # tpu9: noqa[WIR002] fixture series name, not product telemetry
+        "summaries": {"tpu9_lat_s": {"count": 4, "mean": 0.375,  # tpu9: noqa[WIR002] fixture series name, not product telemetry
                                      "p50": 0.2, "p95": 0.9, "max": 0.9}},
     }
     ms = metrics_to_otlp(snapshot, "svc")["resourceMetrics"][0][

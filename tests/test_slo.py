@@ -483,3 +483,49 @@ def test_render_top_health_column_and_hbm_headroom():
     # legacy engines payload without health fields still renders
     assert _render_top({"engines": {"c0": {"tokens_per_sec": "1.0"}}},
                        {}, {})
+
+
+# ---------------------------------------------------------------------------
+# stub churn (ISSUE 18 regression): a deleted stub takes its per-stub
+# gauge series and rolling state with it — set_gauge-only registries
+# otherwise hold a dead stub's last value forever and grow without bound
+# ---------------------------------------------------------------------------
+
+def test_router_signals_forget_stub_drops_state_and_gauges():
+    from tpu9.observability import metrics
+    sig = RouterSignals()
+    sig.queue_sample("dead-stub", depth=5, capacity=10)
+    sig.slo_sample("dead-stub", 1.5)
+    assert any("dead-stub" in k for k in metrics.gauges)
+    sig.forget_stub("dead-stub")
+    assert not any("dead-stub" in k for k in metrics.gauges)
+    assert "dead-stub" not in sig._queue_depth
+    assert "dead-stub" not in sig._slo_burn
+    # forgetting is idempotent and unknown stubs are a no-op
+    sig.forget_stub("dead-stub")
+    sig.forget_stub("never-seen")
+
+
+def test_slo_evaluator_forget_stub_removes_published_series():
+    from tpu9.observability import metrics
+    tl = TimelineStore(capacity=64)
+    ev = SloEvaluator(tl, _objectives())
+    for i in range(6):
+        tl.record("replica.s9.ttft_p95_s", 1.0)
+    ev.publish("s9", ev.evaluate("s9"))
+    assert any('stub="s9"' in k for k in metrics.gauges)
+    ev.forget_stub("s9")
+    assert not any('stub="s9"' in k for k in metrics.gauges)
+
+
+def test_goodput_accountant_forget_stub_drops_router_window():
+    acc = GoodputAccountant(window_s=600.0)
+    acc.router_sample("s9", "ws", submitted_total=10.0, shed_total=1.0,
+                      queue_wait_total_s=2.0)
+    acc.router_sample("s9", "ws", submitted_total=20.0, shed_total=1.0,
+                      queue_wait_total_s=3.0)
+    assert ("ws", "s9") in acc._acc
+    acc.forget_stub("s9")
+    assert ("ws", "s9") not in acc._acc
+    assert "router:s9" not in acc._last
+    assert "s9" not in acc._stub_ws

@@ -27,6 +27,7 @@ from typing import Optional
 
 import aiohttp
 
+from ..config import env_tpu_gen
 from ..utils.aio import cancellable_wait, reap
 
 log = logging.getLogger("tpu9.agent")
@@ -49,7 +50,7 @@ def preflight() -> dict:
     chips = len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/[0-9]*"))
     # generation detection mirrors the worker's TpuManager convention
     # (tpu_manager.py:39): TPU9_TPU_GEN env set by the operator / VM image
-    generation = os.environ.get("TPU9_TPU_GEN", "") if chips else ""
+    generation = env_tpu_gen() if chips else ""
     return {"hostname": socket.gethostname(),
             "cpu_millicores": cpu_millicores, "memory_mb": memory_mb,
             "tpu_chips": chips, "tpu_generation": generation,
@@ -77,7 +78,7 @@ async def preflight_checks(gateway_url: str) -> list[dict]:
 
     # TPU devices: only critical when the operator CLAIMS this is a TPU
     # host (TPU9_TPU_GEN set) — a CPU worker box legitimately has none
-    gen = os.environ.get("TPU9_TPU_GEN", "")
+    gen = env_tpu_gen()
     accel = glob.glob("/dev/accel*") + glob.glob("/dev/vfio/[0-9]*")
     add("tpu_devices", bool(accel) or not gen, critical=bool(gen),
         detail=f"gen={gen or 'none'} devices={accel or 'none'}")

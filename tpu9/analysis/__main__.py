@@ -15,6 +15,82 @@ from .runner import (ALL_RULES, DEFAULT_BASELINE, DEFAULT_ROOTS,
                      find_repo_root, gate, run_analysis)
 
 
+def _run_all(args) -> int:
+    """``python -m tpu9.analysis --all`` (ISSUE 18): every analysis plane
+    behind one exit code and one JSON stream on the shared finding
+    schema. Each tool gates against its own triaged baseline; exit is
+    the max of the per-tool codes (0 clean, 1 findings, 2 errors)."""
+    import os
+
+    from .wirecheck import DEFAULT_BASELINE as WIRE_BASELINE
+    from .wirecheck import run_wirecheck
+
+    repo_root = args.repo_root or find_repo_root()
+
+    def _bl(path):
+        return load_baseline(path if os.path.isabs(path)
+                             else os.path.join(repo_root, path))
+
+    tools = []          # (name, result, new, known, extra_findings)
+    rc = 0
+
+    lint_res = run_analysis(repo_root)
+    lnew, lknown, _ = gate(lint_res, _bl(DEFAULT_BASELINE))
+    tools.append(("tpu9lint", lint_res, lnew, lknown, []))
+
+    wire_res = run_wirecheck(repo_root)
+    wnew, wknown, _ = _bl(WIRE_BASELINE).split(wire_res.findings)
+    tools.append(("wirecheck", wire_res, wnew, wknown, []))
+
+    matrix_report = None
+    if not args.static_only:
+        from .graphcheck import passes
+        from .graphcheck.matrix import find_cells
+        guard = passes.device_guard()
+        if guard is not None:
+            print(f"tpu9.analysis --all: graphcheck matrix SKIP — {guard}",
+                  file=sys.stderr)
+        else:
+            matrix_report = passes.run_matrix(find_cells(None))
+            tools.append(("graphcheck", None, [], [],
+                          list(matrix_report["findings"])))
+
+    records = []
+    for name, res, new, known, extra in tools:
+        for f in new + extra:
+            records.append(finding_json(f, "new") | {"tool": name})
+        for f in known:
+            records.append(finding_json(f, "baselined") | {"tool": name})
+        if res is not None and res.parse_errors:
+            rc = max(rc, 2)
+        if new or extra:
+            rc = max(rc, 1)
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": JSON_SCHEMA_VERSION,
+            "tool": "tpu9.analysis",
+            "tools": [name for name, *_ in tools],
+            "findings": records,
+            "parse_errors": [e for _, res, *_ in tools if res
+                             for e in res.parse_errors],
+        }, indent=1))
+    else:
+        for name, res, new, known, extra in tools:
+            for f in new + extra:
+                print(f"{name}: {f.format()}")
+            if res is not None:
+                print(f"{name}: {res.files_scanned} files in "
+                      f"{res.elapsed_s:.2f}s — {len(new)} new, "
+                      f"{len(known)} baselined")
+            elif matrix_report is not None:
+                print(f"graphcheck: {len(matrix_report['cells'])} cells "
+                      f"in {matrix_report['elapsed_s']:.1f}s — "
+                      f"{len(extra)} findings")
+        print(f"tpu9.analysis --all: {'FAIL' if rc else 'OK'}")
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpu9.analysis",
@@ -39,12 +115,23 @@ def main(argv=None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--show-known", action="store_true",
                     help="also print baselined findings")
+    ap.add_argument("--all", action="store_true", dest="run_all",
+                    help="run every analysis plane — tpu9lint (incl. the "
+                         "graphcheck AST rules), wirecheck, and the "
+                         "graphcheck lowering matrix — with one exit code "
+                         "and one JSON stream")
+    ap.add_argument("--static-only", action="store_true",
+                    help="with --all: skip the graphcheck lowering matrix "
+                         "(AST-only, no jax imports)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rid, desc in ALL_RULES.items():
             print(f"{rid}  {desc}")
         return 0
+
+    if args.run_all:
+        return _run_all(args)
 
     repo_root = args.repo_root or find_repo_root()
     roots = args.roots or DEFAULT_ROOTS

@@ -1,0 +1,143 @@
+"""Shared ratchet-gate engine (ISSUE 18).
+
+Every ``scripts/*_gate.py`` used to carry its own copy of the same
+semantics: run the tool, split findings against a triaged baseline,
+filter staleness to the scanned scope, update the baseline without
+destroying out-of-scope triage, ratchet with ``--strict-stale``. One
+drifting copy per gate is exactly the bug class this package exists to
+kill, so the semantics live here once and the gates are thin wrappers.
+
+A tool plugs in as a callable ``run(repo_root, roots, select, args) ->
+result`` where the result carries ``findings`` / ``suppressed`` /
+``parse_errors`` / ``files_scanned`` / ``elapsed_s`` (both
+``AnalysisResult`` and ``WirecheckResult`` do).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .findings import Baseline, load_baseline
+from .runner import find_repo_root
+
+
+def in_roots(path: str, roots) -> bool:
+    for r in roots:
+        r = r.rstrip("/")
+        if path == r or path.startswith(r + "/"):
+            return True
+    return False
+
+
+def build_parser(name: str, doc: str, baseline_default: str,
+                 budget_s: float = 0.0) -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=doc)
+    ap.add_argument("--repo-root", default=None)
+    ap.add_argument("--roots", nargs="*", default=None)
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--baseline", default=baseline_default)
+    ap.add_argument("--strict-stale", action="store_true",
+                    help="fail when baseline entries no longer fire")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="record every NEW finding as suppressed (requires "
+                         "--reason) and prune stale entries")
+    ap.add_argument("--reason", default="",
+                    help="mandatory triage reason for --update-baseline")
+    if budget_s:
+        ap.add_argument("--budget-s", type=float, default=budget_s,
+                        help="fail when a full-repo run exceeds this wall "
+                             "clock (0 disables; default %(default)s)")
+    return ap
+
+
+def ratchet_main(name: str, run, baseline_default: str, argv=None,
+                 doc: str = "", budget_s: float = 0.0, add_args=None) -> int:
+    ap = build_parser(name, doc or f"{name}: baseline ratchet gate",
+                      baseline_default, budget_s=budget_s)
+    if add_args:
+        add_args(ap)
+    args = ap.parse_args(argv)
+
+    repo_root = args.repo_root or find_repo_root()
+    select = ({r.strip() for r in args.select.split(",") if r.strip()}
+              or None)
+    # a run over non-default roots (or a rule subset) sees only a slice
+    # of the repo: baseline entries outside the slice would look "stale"
+    # and must not be pruned or even reported as such
+    scoped = bool(args.roots) or bool(select)
+    result = run(repo_root, args.roots or None, select, args)
+
+    bl_path = args.baseline
+    if not os.path.isabs(bl_path):
+        bl_path = os.path.join(repo_root, bl_path)
+    baseline = load_baseline(bl_path)
+    new, known, stale = baseline.split(result.findings)
+    if args.roots:
+        stale = [e for e in stale
+                 if in_roots(e.get("path", ""), args.roots)]
+    if select:
+        stale = [e for e in stale if e.get("rule") in select]
+
+    for err in result.parse_errors:
+        print(f"{name}: parse error: {err}", file=sys.stderr)
+    if result.parse_errors:
+        return 2
+
+    if args.update_baseline:
+        if new and not args.reason.strip():
+            print(f"{name}: --update-baseline needs --reason (suppressions "
+                  "without a reason are not triage)", file=sys.stderr)
+            return 2
+        fresh = Baseline()
+        fresh.fixed = baseline.fixed
+        for f in known:
+            fresh.entries[f.fingerprint] = baseline.entries[f.fingerprint]
+        if scoped:
+            # keep everything the narrowed run could not see — a scoped
+            # update must never destroy the rest of the triage ledger
+            # (in-scope stale entries are still pruned)
+            live = {f.fingerprint for f in known}
+            for fp, e in baseline.entries.items():
+                unseen = (args.roots
+                          and not in_roots(e.get("path", ""), args.roots)) \
+                    or (select and e.get("rule") not in select)
+                if fp not in live and unseen:
+                    fresh.entries[fp] = e
+        for f in new:
+            fresh.add(f, args.reason.strip())
+        fresh.save(bl_path)
+        print(f"{name}: baseline updated — {len(new)} added, "
+              f"{len(stale)} stale pruned, {len(known)} kept"
+              + (" (scoped run: out-of-scope entries preserved)"
+                 if scoped else ""))
+        return 0
+
+    for f in new:
+        print(f"NEW  {f.format()}")
+    for w in getattr(result, "warnings", []):
+        print(f"warn {w.format()}")
+    for e in stale:
+        print(f"stale baseline entry (prune or --update-baseline): "
+              f"{e['rule']} {e['path']} [{e.get('symbol')}]")
+    print(f"{name}: {result.files_scanned} files in "
+          f"{result.elapsed_s:.2f}s — {len(new)} new, {len(known)} "
+          f"baselined, {len(result.suppressed)} noqa'd, {len(stale)} stale")
+    if new:
+        print(f"{name}: FAIL — new findings above. Fix them, or suppress "
+              "with `# tpu9: noqa[RULE] reason` / --update-baseline "
+              "--reason.", file=sys.stderr)
+        return 1
+    if stale and args.strict_stale:
+        print(f"{name}: FAIL — stale baseline entries (--strict-stale)",
+              file=sys.stderr)
+        return 1
+    budget = getattr(args, "budget_s", 0.0)
+    if budget and not scoped and result.elapsed_s > budget:
+        print(f"{name}: FAIL — full run took {result.elapsed_s:.1f}s > "
+              f"budget {budget:.0f}s", file=sys.stderr)
+        return 1
+    print(f"{name}: OK")
+    return 0

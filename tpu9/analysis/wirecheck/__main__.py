@@ -1,0 +1,123 @@
+"""CLI entry: ``python -m tpu9.analysis.wirecheck``.
+
+Exit codes mirror tpu9lint: 0 clean (or everything known/suppressed),
+1 new findings, 2 contract/parse errors. Warn-tier findings (dead
+telemetry, unasserted metrics) report but never gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..findings import JSON_SCHEMA_VERSION, finding_json, load_baseline
+from ..runner import find_repo_root
+from . import (DEFAULT_BASELINE, DEFAULT_CONTRACTS, WIRE_RULES,
+               run_wirecheck)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu9.analysis.wirecheck",
+        description="wirecheck: static contract verification of the "
+                    "string-keyed wire surfaces (heartbeat fields, "
+                    "tpu9_* metrics, store keys, TPU9_* env, rpc routes)")
+    ap.add_argument("roots", nargs="*", default=None,
+                    help="report findings only under these paths "
+                         "(extraction always sees the whole repo)")
+    ap.add_argument("--repo-root", default=None)
+    ap.add_argument("--contracts", default=DEFAULT_CONTRACTS,
+                    help="contracts toml (default: %(default)s)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="triaged baseline json (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="report format; json emits the stable schema "
+                         "shared with tpu9lint/graphcheck")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-known", action="store_true",
+                    help="also print baselined findings")
+    ap.add_argument("--no-warn", action="store_true",
+                    help="hide warn-tier findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in WIRE_RULES.items():
+            print(f"{rid}  {desc}")
+        return 0
+
+    repo_root = args.repo_root or find_repo_root()
+    select = ({r.strip() for r in args.select.split(",") if r.strip()}
+              or None)
+    contracts = args.contracts
+    if not os.path.isabs(contracts):
+        contracts = os.path.join(repo_root, contracts)
+    result = run_wirecheck(repo_root, roots=args.roots or None,
+                           select=select, contracts_path=contracts)
+
+    if args.no_baseline:
+        new, known, stale = result.findings, [], []
+    else:
+        bl_path = args.baseline
+        if bl_path and not os.path.isabs(bl_path):
+            bl_path = os.path.join(repo_root, bl_path)
+        baseline = load_baseline(bl_path)
+        new, known, stale = baseline.split(result.findings)
+        if args.roots:
+            stale = [e for e in stale
+                     if any(e.get("path", "") == r.rstrip("/")
+                            or e.get("path", "").startswith(
+                                r.rstrip("/") + "/")
+                            for r in args.roots)]
+        if select:
+            stale = [e for e in stale if e.get("rule") in select]
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": JSON_SCHEMA_VERSION,
+            "tool": "wirecheck",
+            "files_scanned": result.files_scanned,
+            "elapsed_s": round(result.elapsed_s, 3),
+            "findings": [finding_json(f, "new") for f in new]
+            + [finding_json(f, "baselined") for f in known]
+            + ([] if args.no_warn
+               else [finding_json(w, "warn") for w in result.warnings]),
+            "stale": [e["fingerprint"] for e in stale],
+            "suppressed_inline": len(result.suppressed),
+            "parse_errors": result.parse_errors,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        if args.show_known:
+            for f in known:
+                print(f"known    {f.format()}")
+        if not args.no_warn:
+            for w in result.warnings:
+                print(f"warn     {w.format()}")
+        for e in stale:
+            print(f"stale baseline entry (finding no longer fires — prune "
+                  f"it): {e['rule']} {e['path']} [{e.get('symbol')}] "
+                  f"{e['fingerprint']}")
+        for err in result.parse_errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        counts = ", ".join(f"{r}={n}" for r, n in sorted(
+            result.by_rule().items()))
+        print(f"wirecheck: {result.files_scanned} files in "
+              f"{result.elapsed_s:.2f}s — {len(new)} new, {len(known)} "
+              f"baselined, {len(result.warnings)} warn, "
+              f"{len(result.suppressed)} noqa'd"
+              + (f" ({counts})" if counts else ""))
+
+    if result.parse_errors:
+        return 2
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,8 +99,8 @@ class CacheClient:
         # — peer_read_error / peer_read_slow hooks in _peer_get exercise
         # the hedged-read + failover machinery deterministically
         self._faults = None
-        import os as _os
-        if _os.environ.get("TPU9_FAULTS"):
+        from ..config import env_faults_spec
+        if env_faults_spec():
             from ..testing.faults import FaultPlane
             self._faults = FaultPlane.from_env()
 

@@ -88,7 +88,8 @@ def deploy(target: str, name: str) -> None:
 def serve(target: str, name: str, watch: bool) -> None:
     """Hot-reload dev loop (reference ``beta9 serve``): start an ephemeral
     serve session, tail its container logs, re-sync on source change. Uses
-    /rpc/serve (no persistent deployment rows) and survives broken edits."""
+    /rpc/deploy for /endpoint/<name> routability; the session deactivates
+    its deployment rows on exit, and it survives broken edits."""
     import time as _time
 
     from ..sdk.sync import _ignored
@@ -383,6 +384,13 @@ def deployments_list() -> None:
     click.echo(json.dumps(out, indent=2))
 
 
+@cli.command("stubs")
+def stubs_list() -> None:
+    """List workspace stubs (all registered functions/endpoints)."""
+    out = _client()._run(lambda c: c.request("GET", "/api/v1/stub"))
+    click.echo(json.dumps(out, indent=2))
+
+
 @cli.group()
 def machine() -> None:
     """BYOC machine fleet (reference pkg/agent + machine API)."""
@@ -502,6 +510,22 @@ def volume_list() -> None:
     click.echo(json.dumps(
         _client()._run(lambda c: c.request("GET", "/api/v1/volume")),
         indent=2))
+
+
+@volume.command("create")
+@click.argument("name")
+def volume_create(name: str) -> None:
+    out = _client()._run(
+        lambda c: c.request("POST", f"/api/v1/volume/{name}"))
+    click.echo(json.dumps(out, indent=2))
+
+
+@volume.command("rm")
+@click.argument("name")
+def volume_rm(name: str) -> None:
+    out = _client()._run(
+        lambda c: c.request("DELETE", f"/api/v1/volume/{name}"))
+    click.echo(json.dumps(out, indent=2))
 
 
 @volume.command("ls")
@@ -1480,10 +1504,11 @@ def worker(gateway_state: str, gateway_url: str, worker_token: str,
                                      snap_put=sbxsnap_put,
                                      snap_get=sbxsnap_get)
 
+            from ..config import env_criu_bin
             from ..worker.criu import CriuManager
             criu = CriuManager(
                 os.path.join(cfg.worker.checkpoint_dir, "criu"),
-                criu_bin=os.environ.get("TPU9_CRIU_BIN", "criu"),
+                criu_bin=env_criu_bin(),
                 chunk_put=disk_chunk_put, chunk_get=disk_chunk_get,
                 snap_put=sbxsnap_put, snap_get=sbxsnap_get)
 

@@ -445,3 +445,79 @@ def load_config(path: Optional[str] = None,
     if overrides:
         _merge_into(cfg, overrides)
     return cfg
+
+
+# ---------------------------------------------------------------------------
+# Shared env-knob accessors (ISSUE 18). A TPU9_* knob read from more
+# than one plane goes through exactly one of these, so its default can
+# never drift between read sites again — wirecheck's ENV001 pins every
+# other module to the reader declared in tpu9/analysis/contracts.toml.
+
+
+def env_faults_spec() -> str:
+    """``TPU9_FAULTS`` chaos spec; empty string = faults plane disarmed.
+
+    Read by the runner serve loop, the cache client and the worker
+    keepalive (each arms its own injector lazily so a container without
+    the knob never imports the faults plane)."""
+    return os.environ.get("TPU9_FAULTS", "")
+
+
+def env_gateway_url(required: bool = False) -> str:
+    """Gateway base url for in-container runners and the SDK."""
+    url = os.environ.get("TPU9_GATEWAY_URL", "")
+    if required and not url:
+        raise KeyError("TPU9_GATEWAY_URL")
+    return url
+
+
+def env_token(required: bool = False) -> str:
+    """Workspace-scoped runner/SDK bearer token."""
+    token = os.environ.get("TPU9_TOKEN", "")
+    if required and not token:
+        raise KeyError("TPU9_TOKEN")
+    return token
+
+
+def env_checkpoint_enabled() -> bool:
+    """``TPU9_CHECKPOINT_ENABLED=1``: arm the CRIU checkpoint plane."""
+    return os.environ.get("TPU9_CHECKPOINT_ENABLED") == "1"
+
+
+def env_bind_host() -> str:
+    """Runner HTTP bind host; the worker sets ``0.0.0.0`` for
+    containerised runtimes, host-shared runtimes stay loopback."""
+    return os.environ.get("TPU9_BIND_HOST", "127.0.0.1")
+
+
+def env_criu_bin() -> str:
+    """CRIU binary path for checkpoint/restore (cli + localstack)."""
+    return os.environ.get("TPU9_CRIU_BIN", "criu")
+
+
+def env_tpu_gen() -> str:
+    """Operator/VM-image declared TPU generation (agent + tpu_manager);
+    empty on CPU worker boxes."""
+    return os.environ.get("TPU9_TPU_GEN", "")
+
+
+def env_no_egress() -> bool:
+    """``TPU9_NO_EGRESS``: hermetic mode — no outbound network from
+    builds or gateway-driven image pulls."""
+    return bool(os.environ.get("TPU9_NO_EGRESS"))
+
+
+def env_scaleout_gate() -> str:
+    """Raw ``TPU9_SCALEOUT`` master-gate string ('' = defer to config)."""
+    return os.environ.get("TPU9_SCALEOUT", "").strip()
+
+
+def env_scaleout_predictive_gate() -> str:
+    """Raw ``TPU9_SCALEOUT_PREDICTIVE`` gate string ('' = defer)."""
+    return os.environ.get("TPU9_SCALEOUT_PREDICTIVE", "").strip()
+
+
+def env_scaleout_partial_on() -> bool:
+    """``TPU9_SCALEOUT_PARTIAL=0`` disables group-hint partial-readiness
+    admission; anything else (including unset) leaves it on."""
+    return os.environ.get("TPU9_SCALEOUT_PARTIAL", "") != "0"

@@ -48,6 +48,13 @@ ENGINE_SERIES = ("tokens_per_sec", "token_pressure", "queued",
                  "hbm_predicted_gb_per_chip", "hbm_limit_gb_per_chip",
                  "windows_processed", "last_dispatch_age_s",
                  "last_progress_age_s",
+                 # replica-level prefix-cache effectiveness (ISSUE 2
+                 # satellite shipped it; ISSUE 18's wirecheck caught that
+                 # no gateway consumer ever read it): the per-replica
+                 # twin of the router-side tpu9_router_prefix_hit_rate —
+                 # divergence between the two is the affinity router
+                 # mis-steering
+                 "prefix_hits", "prefix_misses", "prefix_hit_rate",
                  # kvwire block-ship plane (ISSUE 16): export/import
                  # ledger + ship latency — `tpu9 top`'s migration view
                  "kvwire_blocks_exported", "kvwire_blocks_imported",
@@ -191,8 +198,10 @@ class FleetObserver:
         fold, goodput router counters, gauge publication, pruning."""
         if self.fleet_router is not None:
             signals = self.fleet_router.signals
+            seen_stubs: set = set()
             for stub in self.fleet_router.active_stubs():
                 sid = stub.stub_id
+                seen_stubs.add(sid)
                 snap = signals.snapshot(sid)
                 prefix = f"router.{sid}."
                 # LIVE fair-queue depth, not the last dispatch-time
@@ -241,6 +250,15 @@ class FleetObserver:
                     submitted_total=float(snap.get("submitted", 0)),
                     shed_total=float(snap.get("shed", 0)),
                     queue_wait_total_s=qw_total)
+            # stub churn (ISSUE 18): a stub that left active_stubs()
+            # takes its per-stub gauges and rolling state with it — the
+            # same prune filter_engines applies to replica series, at
+            # the stub granularity
+            for sid in getattr(self, "_sampled_stubs", set()) - seen_stubs:
+                signals.forget_stub(sid)
+                self.evaluator.forget_stub(sid)
+                self.goodput.forget_stub(sid)
+            self._sampled_stubs = seen_stubs
         await self.sample_cache_plane()
         self.goodput.publish(await self.goodput_snapshot())
         self.timeline.prune()

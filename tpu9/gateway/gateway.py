@@ -40,7 +40,7 @@ from ..abstractions.primitives import (MapService, OutputService,
 from ..abstractions.taskqueue import TaskQueueService
 from ..images import ImageBuilder, ImageSpec
 from ..backend import BackendDB
-from ..config import AppConfig
+from ..config import AppConfig, env_no_egress
 from ..repository import ContainerRepository, TaskRepository, WorkerRepository
 from ..repository.keys import Keys
 from ..scheduler import Scheduler
@@ -124,7 +124,7 @@ class Gateway:
         self.images = ImageService(
             self.backend,
             ImageBuilder(cfg.image.registry_dir,
-                         network_ok=not os.environ.get("TPU9_NO_EGRESS")),
+                         network_ok=not env_no_egress()),
             scheduler=self.scheduler,
             runner_env=self.runner_env,
             runner_tokens=self.runner_tokens,
@@ -215,7 +215,6 @@ class Gateway:
         r.add_post("/rpc/object/put", self._rpc_put_object)
         r.add_get("/rpc/object/{object_id}", self._rpc_get_object)
         r.add_post("/rpc/deploy", self._rpc_deploy)
-        r.add_post("/rpc/serve", self._rpc_serve)
         # tasks / queues / functions
         r.add_post("/rpc/taskqueue/put", self._rpc_tq_put)
         r.add_post("/rpc/taskqueue/pop", self._rpc_tq_pop)
@@ -1080,17 +1079,6 @@ class Gateway:
                                   "version": dep.version,
                                   "subdomain": dep.subdomain,
                                   "invoke_url": invoke_url})
-
-    async def _rpc_serve(self, request: web.Request) -> web.Response:
-        """Ephemeral serve session (dev loop): like deploy but not persisted
-        as active; returns the stub routing handle."""
-        ws = self._ws(request)
-        data = await request.json()
-        stub = await self.backend.get_stub(data["stub_id"])
-        if stub is None or stub.workspace_id != ws.workspace_id:
-            return web.json_response({"error": "stub not found"}, status=404)
-        await self.endpoints.get_or_create_instance(stub)
-        return web.json_response({"ok": True, "stub_id": stub.stub_id})
 
     # -- handlers: tasks / queues / functions ---------------------------------
 

@@ -136,6 +136,20 @@ class SloEvaluator:
                               1.0 if entry["burning"] else 0.0,
                               labels={"stub": stub_id, "objective": name})
 
+    def forget_stub(self, stub_id: str) -> None:
+        """Remove a deleted stub's published gauge series (ISSUE 18) —
+        ``publish()`` families are per stub × objective and must not
+        report a dead stub's last burn rate forever."""
+        for obj in self.objectives:
+            for window in ("fast", "slow"):
+                metrics.remove_gauge(
+                    "tpu9_slo_burn_rate",
+                    labels={"stub": stub_id, "objective": obj.name,
+                            "window": window})
+            metrics.remove_gauge(
+                "tpu9_slo_burning",
+                labels={"stub": stub_id, "objective": obj.name})
+
 
 # ---------------------------------------------------------------------------
 # per-tenant / per-stub goodput accounting
@@ -249,6 +263,15 @@ class GoodputAccountant:
 
     def forget_replica(self, container_id: str) -> None:
         self._last.pop(container_id, None)
+
+    def forget_stub(self, stub_id: str) -> None:
+        """Drop a deleted stub's router delta base and window
+        accumulator (ISSUE 18) — stub churn must not grow the
+        accountant's dicts without bound."""
+        self._last.pop(f"router:{stub_id}", None)
+        ws = self._stub_ws.pop(stub_id, None)
+        if ws is not None:
+            self._acc.pop((ws, stub_id), None)
 
     def workspaces(self) -> set[str]:
         return {ws for (ws, _stub) in self._acc}

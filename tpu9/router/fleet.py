@@ -581,7 +581,8 @@ class FleetRouter:
         if not partial:
             return order
         want: set[str] = set()
-        if os.environ.get("TPU9_SCALEOUT_PARTIAL", "") != "0":
+        from ..config import env_scaleout_partial_on
+        if env_scaleout_partial_on():
             try:
                 payload = json.loads(body or b"{}")
                 wg = payload.get("weight_groups") or []

@@ -192,6 +192,22 @@ class RouterSignals:
         metrics.set_gauge("tpu9_router_spec_acceptance_rate",
                           accepted / proposed if proposed else 0.0)
 
+    def forget_stub(self, stub_id: str) -> None:
+        """Drop a deleted stub's rolling state and its per-stub gauge
+        series (ISSUE 18): the fleet observer calls this when a stub
+        leaves ``active_stubs()``. Without it the set_gauge-only series
+        hold their last value forever and every per-stub dict grows
+        monotonically with stub churn — the same unbounded-cardinality
+        class the replica gauges fixed in PR 14."""
+        for d in (self._submitted, self._shed, self._queue_depth,
+                  self._capacity, self._last_shed_ts, self._slo_burn,
+                  self._burn_hist, self._bringup_s):
+            d.pop(stub_id, None)
+        metrics.remove_gauge("tpu9_router_queue_depth",
+                             labels={"stub": stub_id})
+        metrics.remove_gauge("tpu9_router_slo_burn",
+                             labels={"stub": stub_id})
+
     # -- reading ---------------------------------------------------------------
 
     def shed_rate(self, stub_id: str) -> float:

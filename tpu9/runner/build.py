@@ -24,14 +24,15 @@ import sys
 
 import aiohttp
 
+from ..config import env_gateway_url, env_no_egress, env_token
 from ..images import ImageSpec
 from ..images.manifest import snapshot_dir
 
 
 async def amain() -> int:
     spec = ImageSpec.from_dict(json.loads(os.environ["TPU9_BUILD_SPEC"]))
-    gateway = os.environ["TPU9_GATEWAY_URL"].rstrip("/")
-    token = os.environ["TPU9_TOKEN"]
+    gateway = env_gateway_url(required=True).rstrip("/")
+    token = env_token(required=True)
     image_id = spec.image_id
     scratch = os.path.join(os.getcwd(), "build")
     os.makedirs(scratch, exist_ok=True)
@@ -83,7 +84,7 @@ async def amain() -> int:
                 cmd = [sys.executable, "-m", "pip", "install", "--target",
                        site, "--no-compile"]
                 wheel_dir = os.environ.get("TPU9_WHEEL_DIR", "")
-                if os.environ.get("TPU9_NO_EGRESS"):
+                if env_no_egress():
                     if not wheel_dir:
                         raise RuntimeError(
                             "package install requested but no network and "

@@ -149,7 +149,8 @@ def maybe_restore(init_fn: Callable[[], Any], name: str = "params") -> Any:
         log.info("restoring %s from checkpoint", name)
         return load_params(name)
     params = init_fn()
-    if os.environ.get("TPU9_CHECKPOINT_ENABLED") == "1":
+    from ..config import env_checkpoint_enabled
+    if env_checkpoint_enabled():
         log.info("saving %s for future restores", name)
         save_params(params, name)
     return params

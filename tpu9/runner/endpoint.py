@@ -47,7 +47,8 @@ def build_app(cfg: RunnerConfig) -> web.Application:
                 target = await handler.call()
             state["asgi_app"] = target
         state["ready"] = True
-        if os.environ.get("TPU9_CHECKPOINT_ENABLED") == "1":
+        from ..config import env_checkpoint_enabled
+        if env_checkpoint_enabled():
             # handler state is loaded (and saved via ckpt.maybe_restore if
             # the handler opted in) — let the worker snapshot now
             from . import ckpt
@@ -145,7 +146,8 @@ def main() -> None:
     app = build_app(cfg)
     # netns containers (NativeRuntime) are reached over their veth, so the
     # worker sets TPU9_BIND_HOST=0.0.0.0; host-shared runtimes stay loopback
-    web.run_app(app, host=os.environ.get("TPU9_BIND_HOST", "127.0.0.1"),
+    from ..config import env_bind_host
+    web.run_app(app, host=env_bind_host(),
                 port=cfg.port, print=None, handle_signals=True)
 
 

@@ -27,8 +27,9 @@ log = logging.getLogger("tpu9.runner")
 async def run() -> int:
     cfg = RunnerConfig.from_env()
     task_id = os.environ.get("TPU9_TASK_ID", "")
-    gateway_url = os.environ.get("TPU9_GATEWAY_URL", "")
-    token = os.environ.get("TPU9_TOKEN", "")
+    from ..config import env_gateway_url, env_token
+    gateway_url = env_gateway_url()
+    token = env_token()
     if not (cfg.handler and task_id and gateway_url):
         print("missing TPU9_HANDLER/TPU9_TASK_ID/TPU9_GATEWAY_URL",
               file=sys.stderr)

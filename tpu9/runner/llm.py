@@ -29,6 +29,8 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from ..config import (env_bind_host, env_checkpoint_enabled,
+                      env_faults_spec, env_gateway_url, env_token)
 from .common import FunctionHandler, RunnerConfig, error_payload
 
 log = logging.getLogger("tpu9.runner")
@@ -90,15 +92,15 @@ def _kv_transport():
 
 async def amain() -> None:
     cfg = RunnerConfig.from_env()
-    gateway_url = os.environ.get("TPU9_GATEWAY_URL", "")
-    token = os.environ.get("TPU9_TOKEN", "")
+    gateway_url = env_gateway_url()
+    token = env_token()
 
     # fault-injection plane (ISSUE 15): env-gated, None in production.
     # The import is lazy on purpose — tpu9.testing.faults is restricted
     # to the declared hook sites (boundaries.toml) and a production
     # container without TPU9_FAULTS never imports it.
     faults = None
-    if os.environ.get("TPU9_FAULTS"):
+    if env_faults_spec():
         from ..testing.faults import FaultPlane
         faults = FaultPlane.from_env()
         log.warning("fault plane ACTIVE: %s", sorted(faults.specs))
@@ -417,7 +419,7 @@ async def amain() -> None:
     app.router.add_post("/drain", drain)
     runner = web.AppRunner(app)
     await runner.setup()
-    await web.TCPSite(runner, os.environ.get("TPU9_BIND_HOST", "127.0.0.1"),
+    await web.TCPSite(runner, env_bind_host(),
                       cfg.port).start()
 
     # build the engine off the loop (model init / weight load can be slow)
@@ -472,7 +474,7 @@ async def amain() -> None:
     bringup["ready_s"] = round(_time.monotonic() - t_bring, 4)
     bringup["restored"] = int(os.environ.get("TPU9_RESTORED", "0") == "1")
     engine.bringup = bringup
-    if os.environ.get("TPU9_CHECKPOINT_ENABLED") == "1":
+    if env_checkpoint_enabled():
         from . import ckpt
         ckpt.mark_ready({"handler": cfg.handler})
     log.info("llm engine ready")

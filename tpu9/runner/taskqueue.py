@@ -26,8 +26,9 @@ class TaskQueueWorker:
     def __init__(self, cfg: RunnerConfig):
         self.cfg = cfg
         self.handler = FunctionHandler(cfg)
-        self.gateway_url = os.environ.get("TPU9_GATEWAY_URL", "")
-        self.token = os.environ.get("TPU9_TOKEN", "")
+        from ..config import env_gateway_url, env_token
+        self.gateway_url = env_gateway_url()
+        self.token = env_token()
         self.ready = False
         self.processed = 0
         self._session: aiohttp.ClientSession | None = None

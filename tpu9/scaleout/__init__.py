@@ -30,12 +30,13 @@ from __future__ import annotations
 
 import os
 
-from ..config import ScaleoutConfig
+from ..config import (ScaleoutConfig, env_scaleout_gate,
+                      env_scaleout_predictive_gate)
 
 
 def scaleout_on(cfg: ScaleoutConfig | None = None) -> bool:
     """Master gate for the distribution-tree plane. Env beats config."""
-    env = os.environ.get("TPU9_SCALEOUT", "").strip()
+    env = env_scaleout_gate()
     if env:
         return env not in ("0", "false", "no", "off")
     return cfg.enabled if cfg is not None else ScaleoutConfig().enabled
@@ -45,7 +46,7 @@ def predictive_on(cfg: ScaleoutConfig | None = None) -> bool:
     """Gate for the burn-predictive controller. Env beats config; the
     default is OFF (the controller changes *when* capacity moves, so a
     fleet opts in per deployment — the disagg precedent)."""
-    env = os.environ.get("TPU9_SCALEOUT_PREDICTIVE", "").strip()
+    env = env_scaleout_predictive_gate()
     if env:
         return env not in ("0", "false", "no", "off")
     return (cfg.predictive_enabled if cfg is not None

@@ -29,8 +29,9 @@ class Context:
     @classmethod
     def load(cls, name: str = "", path: str = DEFAULT_CONTEXT_PATH) -> "Context":
         # env wins (containers, CI), then the context file
-        env_url = os.environ.get("TPU9_GATEWAY_URL")
-        env_token = os.environ.get("TPU9_TOKEN")
+        from ..config import env_gateway_url, env_token as _env_token
+        env_url = env_gateway_url()
+        env_token = _env_token()
         if env_url:
             return cls(gateway_url=env_url, token=env_token or "")
         p = Path(path).expanduser()

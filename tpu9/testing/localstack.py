@@ -22,7 +22,7 @@ from typing import Any, Optional
 import aiohttp
 
 from ..backend import BackendDB
-from ..config import AppConfig, WorkerPoolConfig
+from ..config import AppConfig, WorkerPoolConfig, env_criu_bin
 from ..gateway import Gateway
 from ..repository import WorkerRepository
 from ..runtime import ProcessRuntime
@@ -190,7 +190,7 @@ class LocalStack:
         from ..worker.criu import CriuManager
         criu = CriuManager(
             os.path.join(self.tmp.name, f"criu-{len(self.workers)}"),
-            criu_bin=os.environ.get("TPU9_CRIU_BIN", "criu"),
+            criu_bin=env_criu_bin(),
             chunk_put=disk_chunk_put, chunk_get=disk_chunk_get,
             snap_put=sbxsnap_put, snap_get=sbxsnap_get)
         worker = Worker(

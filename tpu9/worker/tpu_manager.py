@@ -24,6 +24,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..config import env_tpu_gen
 from ..types import ContainerRequest, TpuSpec
 
 
@@ -36,7 +37,7 @@ class TpuAssignment:
 
 class TpuDeviceManager:
     def __init__(self, generation: str = "", hostnames: str = "") -> None:
-        self.generation = generation or os.environ.get("TPU9_TPU_GEN", "")
+        self.generation = generation or env_tpu_gen()
         self.hostnames = hostnames
         self._devices = self._inventory()
         self._assigned: dict[str, list[int]] = {}   # container_id -> chip ids

@@ -222,7 +222,8 @@ class Worker:
         # loss — the scheduler-facing face of a silent worker, so chaos
         # runs can exercise dead-worker rescheduling deterministically
         faults = None
-        if os.environ.get("TPU9_FAULTS"):
+        from ..config import env_faults_spec
+        if env_faults_spec():
             from ..testing.faults import FaultPlane
             faults = FaultPlane.from_env()
         while not self._stopping.is_set():
@@ -240,6 +241,16 @@ class Worker:
                 log.warning("heartbeat iteration failed: %s", exc)
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
 
+    def _prune_rss_gauges(self, policed: set, metrics) -> None:
+        """Reaped containers must drop their RSS series: the registry
+        ships to worker:metrics:* every beat, so a leaked gauge holds
+        its last value fleet-wide for the worker's whole lifetime and
+        the series set grows with container churn."""
+        for gone in getattr(self, "_rss_gauged", set()) - policed:
+            metrics.remove_gauge("tpu9_container_rss_mb",
+                                 {"container": gone})
+        self._rss_gauged = policed
+
     async def _heartbeat_once(self, metrics) -> None:
         await self.workers.touch_keepalive(self.worker_id)
         try:
@@ -248,8 +259,10 @@ class Worker:
             log.debug("disk-loc refresh failed: %s", exc)
         # police every container with a known limit — including ones
         # still cold-starting (registered at spawn, before readiness)
+        policed: set = set()
         for container_id, limit in list(
                 self.lifecycle.memory_limits.items()):
+            policed.add(container_id)
             try:
                 # cold-starting containers need their state key alive
                 # too: a long image pull must not let the 60 s TTL lapse
@@ -264,6 +277,7 @@ class Worker:
             except Exception as exc:   # keepalive must survive hiccups
                 log.debug("usage sample failed for %s: %s", container_id,
                           exc)
+        self._prune_rss_gauges(policed, metrics)
         metrics.set_gauge("tpu9_worker_active_containers",
                           len(self.lifecycle.active_ids()),
                           {"worker": self.worker_id})
