@@ -2921,6 +2921,45 @@ def bench_obs(quick: bool = False) -> dict:
         return (_min_time_us(one_account, iters, reps),
                 _min_time_us(one_snapshot, iters, reps))
 
+    def microbench_decisions() -> tuple[float, float]:
+        """(per-record hot path, per-new-request index eviction) cost in
+        µs for the decision ledger (ISSUE 19). Saturated to steady state:
+        full global ring, request index at max_requests — the hot path is
+        ring append + index append + metrics inc on an EXISTING chain;
+        the eviction path adds the longest-idle scan paid once per fresh
+        request id once the index is full."""
+        from tpu9.observability.decisions import DecisionLedger, rej
+        iters, reps = (400, 3) if quick else (1500, 5)
+        led = DecisionLedger()
+        for i in range(led.capacity + led.max_requests):
+            led.record("placement", "dispatch", request_id=f"mb{i}",
+                       chosen="c0", rejected=[rej("c1", "saturated")],
+                       signals={"queue_depth": 3.0, "candidates": 2.0},
+                       stub_id="st")
+
+        k = [0]
+
+        def one_record():
+            led.record("placement", "dispatch",
+                       request_id=f"mb{led.capacity + k[0] % 64}",
+                       chosen="c0", rejected=[rej("c1", "saturated")],
+                       signals={"queue_depth": 3.0, "candidates": 2.0},
+                       stub_id="st")
+            k[0] += 1
+
+        j = [led.capacity + led.max_requests]
+
+        def one_fresh():
+            led.record("placement", "dispatch", request_id=f"mb{j[0]}",
+                       chosen="c0", rejected=[rej("c1", "saturated")],
+                       signals={"queue_depth": 3.0, "candidates": 2.0},
+                       stub_id="st")
+            j[0] += 1
+
+        rec = _min_time_us(one_record, iters, reps)
+        fresh = _min_time_us(one_fresh, iters, reps)
+        return rec, max(fresh - rec, 0.0)
+
     async def run() -> dict:
         res: dict = {}
         off, on = build(False), build(True)
@@ -3019,6 +3058,24 @@ def bench_obs(quick: bool = False) -> dict:
         # the RESTORE path, not the serve loop — priced against its own
         # budget below, not folded into serve-time overhead
         account_us, snap_us = microbench_cache()
+        # decision ledger (ISSUE 19): admission + placement records on
+        # every request (failover records only on faults), one eviction
+        # scan per fresh request id at steady state, and autoscaler /
+        # replan records at sampler cadence — all priced against the
+        # same ≤2% serve-time budget
+        dec_rec_us, dec_evict_us = microbench_decisions()
+        dec_frac = ((dec_rec_us * 2.0 + dec_evict_us) * requests_ps
+                    + dec_rec_us / _slo.sample_interval_s) / 1e6
+        frac += dec_frac
+        res["obs_decision_record_us"] = round(dec_rec_us, 3)
+        res["obs_decision_evict_us"] = round(dec_evict_us, 3)
+        res["obs_decision_frac"] = round(dec_frac, 6)
+        if dec_rec_us > 8.0:
+            violations.append(
+                f"obs: decision ledger record costs {dec_rec_us:.1f}µs"
+                " (gate 8µs, same bar as the cache exchange-accounting"
+                " hook) — the admission/placement hot path grew a heavy"
+                " ledger hook")
         # replica health plane (ISSUE 14): one watchdog assess + one HBM
         # memory_stats() sweep per runner beat (2 s), plus the health
         # timeline/gauge records the gateway adds per beat (priced at
@@ -3651,7 +3708,12 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
                      # replica health plane (ISSUE 14): watchdog tick +
                      # HBM sampler, priced microbench×rate like every
                      # other hook inside the same ≤2% budget
-                     "obs_health_assess_us", "obs_hbm_sample_us")),
+                     "obs_health_assess_us", "obs_hbm_sample_us",
+                     # decision ledger (ISSUE 19): the WHY-record hook
+                     # on admission/placement/failover, priced at its
+                     # measured request rate inside the same budget
+                     "obs_decision_record_us", "obs_decision_evict_us",
+                     "obs_decision_frac")),
             ("coldstart", ("cold_start_p50_s",)),
             ("coldstart_native", ("cold_start_native_p50_s",
                                   "cold_start_native_pull_p50_s")),

@@ -97,6 +97,11 @@ GUARDED_FIELDS = {
     # into obs_overhead_frac above via the obs phase's microbench×rate
     # pricing; their raw µs fields ride the round unguarded like the
     # other per-hook prices — host-to-host µs noise is not a regression)
+    # (ISSUE 19: the decision-ledger record hook — admission + placement
+    # on every request, eviction once per fresh request id, autoscaler
+    # records at sampler cadence — folds into the same obs_overhead_frac
+    # budget; the phase additionally hard-fails if the record hot path
+    # exceeds 8 µs, the same bar as the cache exchange-accounting hook)
     # cold-start decomposition (ISSUE 13): the fetch∥consume overlap of
     # the streamed restore must not collapse back toward serial (the
     # double-buffering win the coldstart report exists to evidence). The

@@ -1,0 +1,428 @@
+"""Fleet decision ledger (ISSUE 19): bounded ring + per-request index
+unit coverage, plus the decision sites — admission shed, placement
+dispatch, drain migration, failover retry/give-up, predictive autoscaler
+ticks — asserted against the records they leave. All deterministic fakes
+(no LocalStack); the cross-process half (runner heartbeat ship → gateway
+ingest → /api/v1/decisions merge) rides the e2e failover suite.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from tpu9.config import RouterConfig, ScaleoutConfig
+from tpu9.abstractions.common.buffer import ForwardResult
+from tpu9.observability.decisions import PLANES, DecisionLedger, ledger, rej
+from tpu9.observability.metrics import metrics
+from tpu9.observability.trace import tracer
+from tpu9.router import FleetRouter
+from tpu9.statestore import MemoryStore
+from tpu9.types import ContainerState, ContainerStatus, Stub, StubConfig
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    """The module singleton persists across tests (routers / survival /
+    the autoscaler all record into it); isolate every test."""
+    ledger._ring.clear()
+    ledger._index.clear()
+    ledger._touched.clear()
+    yield
+    ledger._ring.clear()
+    ledger._index.clear()
+    ledger._touched.clear()
+
+
+# ---------------------------------------------------------------------------
+# ledger unit: schema, bounding, pruning, cursors
+# ---------------------------------------------------------------------------
+
+def test_record_schema_and_counter():
+    led = DecisionLedger(capacity=16)
+    before = metrics.counters.get(
+        metrics._key("tpu9_decision_records_total",
+                     {"plane": "admission"}), 0.0)
+    rec = led.record("admission", "shed", request_id="req-1",
+                     chosen="shed", rejected=[rej("admit", "queue_full")],
+                     signals={"queue_depth": 7}, stub_id="s",
+                     workspace_id="ws")
+    # one flat record: everything a reader needs to reconstruct the WHY
+    assert rec["plane"] == "admission" and rec["decision"] == "shed"
+    assert rec["chosen"] == "shed"
+    assert rec["rejected"] == [{"alternative": "admit",
+                               "reason": "queue_full"}]
+    assert rec["signals"] == {"queue_depth": 7}
+    assert rec["request_id"] == "req-1" and rec["stub_id"] == "s"
+    assert rec["workspace_id"] == "ws"
+    assert rec["ts"] > 0 and rec["mono"] > 0 and rec["seq"] == 1
+    assert json.loads(json.dumps(rec)) == rec    # wire-safe as-is
+    after = metrics.counters.get(
+        metrics._key("tpu9_decision_records_total",
+                     {"plane": "admission"}), 0.0)
+    assert after == before + 1
+
+
+def test_plane_inventory_is_closed():
+    assert PLANES == ("admission", "placement", "failover", "migration",
+                      "autoscaler")
+
+
+def test_global_ring_is_bounded():
+    led = DecisionLedger(capacity=32)
+    for i in range(100):
+        led.record("placement", "dispatch", request_id=f"r{i}")
+    assert led.record_count() == 32
+    # oldest fell off; the newest 32 survive in seq order
+    seqs = [r["seq"] for r in led.query(limit=0)]
+    assert seqs == list(range(69, 101))
+
+
+def test_request_index_evicts_longest_idle():
+    led = DecisionLedger(capacity=1000, max_requests=4, per_request=8)
+    for i in range(4):
+        led.record("placement", "dispatch", request_id=f"r{i}",
+                   mono=float(i))
+    led.record("failover", "retry", request_id="r2", mono=10.0)  # touch
+    led.record("placement", "dispatch", request_id="r-new", mono=11.0)
+    assert led.request_count() == 4
+    # r0 was the longest idle — evicted; the touched r2 survives
+    assert led.query(request_id="r0") == []
+    assert len(led.query(request_id="r2")) == 2
+    assert len(led.query(request_id="r-new")) == 1
+
+
+def test_per_request_chain_is_capped():
+    led = DecisionLedger(per_request=4)
+    for i in range(10):
+        led.record("failover", "retry", request_id="r", chosen=f"a{i}")
+    chain = led.query(request_id="r")
+    assert [r["chosen"] for r in chain] == ["a6", "a7", "a8", "a9"]
+
+
+def test_prune_drops_idle_index_entries():
+    import time
+    led = DecisionLedger(idle_ttl_s=900.0)
+    now = time.monotonic()
+    led.record("placement", "dispatch", request_id="old", mono=now - 1000)
+    led.record("placement", "dispatch", request_id="hot", mono=now)
+    assert led.prune() == 1
+    assert led.query(request_id="old") == []
+    assert len(led.query(request_id="hot")) == 1
+    # ring records are untouched — only the index forgets
+    assert led.record_count() == 2
+
+
+def test_query_filters_plane_since_limit():
+    led = DecisionLedger()
+    led.record("admission", "shed", request_id="r", ts=100.0)
+    led.record("placement", "dispatch", request_id="r", ts=200.0)
+    led.record("failover", "retry", request_id="r", ts=300.0)
+    assert [r["plane"] for r in led.query(request_id="r")] == \
+        ["admission", "placement", "failover"]
+    assert [r["plane"] for r in led.query(request_id="r",
+                                          plane="placement")] == \
+        ["placement"]
+    assert [r["plane"] for r in led.query(request_id="r", since=150.0)] \
+        == ["placement", "failover"]
+    assert [r["plane"] for r in led.query(request_id="r", limit=1)] == \
+        ["failover"]
+
+
+def test_export_new_watermark_is_retry_safe():
+    led = DecisionLedger()
+    for i in range(5):
+        led.record("migration", "adopt", chosen=f"c{i}")
+    batch, hi = led.export_new(since_seq=0, limit=3)
+    assert [r["chosen"] for r in batch] == ["c0", "c1", "c2"] and hi == 3
+    # rejected beat: the caller does NOT advance — same batch re-exports
+    again, hi2 = led.export_new(since_seq=0, limit=3)
+    assert [r["seq"] for r in again] == [r["seq"] for r in batch]
+    assert hi2 == hi
+    # accepted beat: the cursor advances past the shipped records
+    rest, hi3 = led.export_new(since_seq=hi, limit=100)
+    assert [r["chosen"] for r in rest] == ["c3", "c4"] and hi3 == 5
+    assert led.export_new(since_seq=hi3) == ([], 5)
+
+
+def test_configure_rebounds_preserving_records():
+    led = DecisionLedger(capacity=100, max_requests=100)
+    for i in range(50):
+        led.record("placement", "dispatch", request_id=f"r{i}",
+                   mono=float(i))
+    led.configure(capacity=10, max_requests=5, per_request=2,
+                  idle_ttl_s=60.0)
+    assert led.record_count() == 10 and led.request_count() == 5
+    assert led.capacity == 10 and led.idle_ttl_s == 60.0
+    # newest survived the re-ring
+    assert led.query(limit=1)[0]["seq"] == 50
+
+
+def test_bounded_memory_under_request_churn():
+    led = DecisionLedger(capacity=256, max_requests=64, per_request=8)
+    for i in range(5000):
+        led.record("admission", "admit", request_id=f"burst-{i}",
+                   signals={"i": i})
+    assert led.record_count() == 256
+    assert led.request_count() == 64
+    assert len(led._touched) == 64
+
+
+# ---------------------------------------------------------------------------
+# decision sites: router (admission / placement / drain)
+# ---------------------------------------------------------------------------
+
+class FakeContainers:
+    def __init__(self, cids):
+        self.states = [ContainerState(container_id=c, stub_id="s",
+                                      status=ContainerStatus.RUNNING.value,
+                                      address=f"127.0.0.1:{4000 + i}")
+                       for i, c in enumerate(cids)]
+
+    async def containers_by_stub(self, stub_id, status=None):
+        return [s for s in self.states
+                if status is None or s.status == status]
+
+
+def make_router(cids=("r0", "r1"), **cfg_kw) -> FleetRouter:
+    return FleetRouter(RouterConfig(**cfg_kw), MemoryStore(),
+                       FakeContainers(list(cids)))
+
+
+def make_stub() -> Stub:
+    return Stub(stub_id="s", name="s", workspace_id="ws-own",
+                config=StubConfig(timeout_s=30.0))
+
+
+def _body(n, max_new=64):
+    return json.dumps({"tokens": list(range(1, n + 1)),
+                       "max_new_tokens": max_new}).encode()
+
+
+async def test_shed_records_admission_with_reason():
+    router = make_router(cids=("r0",), default_replica_inflight=1,
+                         max_queue_depth=1, max_queue_wait_s=10.0)
+    stub = make_stub()
+    release = asyncio.Event()
+
+    async def blocking_forward(prefer):
+        await release.wait()
+        return ForwardResult(status=200, body=b"{}", container_id="r0")
+
+    with tracer.span("gateway.invoke") as sp:
+        req_id = sp.trace_id
+        tasks = [asyncio.create_task(
+            router.submit(stub, "t", _body(8), blocking_forward))
+            for _ in range(4)]
+        await asyncio.sleep(0.05)
+        release.set()
+        await asyncio.gather(*tasks)
+    await router.stop()
+    sheds = [r for r in ledger.query(request_id=req_id)
+             if r["plane"] == "admission" and r["decision"] == "shed"]
+    assert sheds, ledger.query(request_id=req_id)
+    assert sheds[0]["chosen"] == "shed"
+    assert sheds[0]["rejected"] == [rej("admit", "queue_full")]
+    assert sheds[0]["signals"]["tenant"] == "t"
+    assert sheds[0]["workspace_id"] == "ws-own"
+
+
+async def test_dispatch_records_placement_with_evidence():
+    router = make_router(cids=("r0", "r1", "r2"))
+    stub = make_stub()
+
+    async def forward(prefer):
+        return ForwardResult(status=200, body=b"{}",
+                             container_id=prefer[0] if prefer else "r?")
+
+    with tracer.span("gateway.invoke") as sp:
+        req_id = sp.trace_id
+        out = await router.submit(stub, "t", _body(200), forward)
+        assert out.status == 200
+    await router.stop()
+    chain = ledger.query(request_id=req_id)
+    kinds = [(r["plane"], r["decision"]) for r in chain]
+    assert ("admission", "queued") in kinds
+    assert ("placement", "dispatch") in kinds
+    disp = next(r for r in chain if r["decision"] == "dispatch")
+    assert disp["chosen"] in ("r0", "r1", "r2")
+    assert "queue_wait_s" in disp["signals"]
+    assert "candidates" in disp["signals"]
+    assert f"load.{disp['chosen']}" in disp["signals"]
+    # seq strictly increasing: the chain reads in decision order
+    seqs = [r["seq"] for r in chain]
+    assert seqs == sorted(seqs)
+
+
+async def test_drain_records_migration_outcome():
+    router = make_router(cids=("r0", "r1"), drain_timeout_s=2.0)
+    await router.drain_replica("r0")
+    await router.stop()
+    recs = [r for r in ledger.query(plane="migration", limit=0)
+            if r["decision"] == "drain"]
+    assert len(recs) == 1
+    assert recs[0]["chosen"] == "drained"
+    assert recs[0]["signals"]["container_id"] == "r0"
+    assert recs[0]["signals"]["migrate_hook"] == 0
+    assert recs[0]["rejected"] == []
+
+
+# ---------------------------------------------------------------------------
+# decision sites: failover budget loop
+# ---------------------------------------------------------------------------
+
+async def test_failover_retry_then_success_records_chain():
+    from tpu9.gateway import survival as sv
+    from tpu9.utils.backoff import BackoffPolicy
+    results = [ForwardResult(status=502, body=b"", container_id="dead"),
+               ForwardResult(status=200, body=b"{}", container_id="ok")]
+
+    async def attempt(n, avoid):
+        return results.pop(0)
+
+    async def no_sleep(_):
+        pass
+
+    with tracer.span("gateway.invoke") as sp:
+        req_id = sp.trace_id
+        budget = sv.FailoverBudget(3, BackoffPolicy(base_s=0.01))
+        out = await sv.submit_with_failover(attempt, budget,
+                                            sleep=no_sleep)
+    assert out.status == 200
+    chain = ledger.query(request_id=req_id, plane="failover")
+    assert [r["decision"] for r in chain] == ["retry"]
+    assert chain[0]["chosen"] == "attempt_2"
+    assert chain[0]["rejected"] == [rej("dead", "http_502")]
+    sig = chain[0]["signals"]
+    assert sig["failed_attempt"] == 1 and sig["failed_status"] == 502
+    assert sig["verdict"] == sv.RETRYABLE and "backoff_s" in sig
+
+
+async def test_failover_exhaustion_records_give_up():
+    from tpu9.gateway import survival as sv
+    from tpu9.utils.backoff import BackoffPolicy
+
+    async def always_dead(n, avoid):
+        return ForwardResult(status=502, body=b"", container_id="dead")
+
+    async def no_sleep(_):
+        pass
+
+    with tracer.span("gateway.invoke") as sp:
+        req_id = sp.trace_id
+        budget = sv.FailoverBudget(2, BackoffPolicy(base_s=0.01))
+        out = await sv.submit_with_failover(always_dead, budget,
+                                            sleep=no_sleep)
+    assert out.status == 502
+    chain = ledger.query(request_id=req_id, plane="failover")
+    assert [r["decision"] for r in chain] == ["retry", "give_up"]
+    assert chain[-1]["chosen"] == "return_last_failure"
+    assert chain[-1]["rejected"] == [rej("retry", "attempts_exhausted")]
+
+
+async def test_failover_fatal_records_final():
+    from tpu9.gateway import survival as sv
+    from tpu9.utils.backoff import BackoffPolicy
+
+    async def bad_request(n, avoid):
+        return ForwardResult(status=400, body=b"nope")
+
+    with tracer.span("gateway.invoke") as sp:
+        req_id = sp.trace_id
+        out = await sv.submit_with_failover(
+            bad_request, sv.FailoverBudget(3, BackoffPolicy()))
+    assert out.status == 400
+    chain = ledger.query(request_id=req_id, plane="failover")
+    assert [r["decision"] for r in chain] == ["final"]
+    assert chain[0]["rejected"][0]["reason"] == "verdict:fatal"
+
+
+# ---------------------------------------------------------------------------
+# decision sites: predictive autoscaler
+# ---------------------------------------------------------------------------
+
+def _policy(burn_fn, base_desired=1, replicas=1, **cfg_kw):
+    from tpu9.scaleout.controller import predictive_policy
+
+    class _Res:
+        desired = base_desired
+        reason = "reactive"
+
+    class _Sample:
+        active_containers = replicas
+
+    cfg = ScaleoutConfig(**cfg_kw)
+    decide = predictive_policy(
+        lambda samples: _Res(), cfg=cfg, burns=burn_fn,
+        bringup=lambda: 5.0, max_containers=8, min_containers=0,
+        clock=lambda: 100.0, stub_id="stub-a")
+    return decide, [_Sample()]
+
+
+def test_autoscaler_tick_records_verdict_and_signals():
+    # ramping fast burn → predictive scale-up overrides the reactive base
+    series = [(t, 0.3 + 0.07 * t, 0.2) for t in range(90, 101)]
+    decide, samples = _policy(lambda: series)
+    res = decide(samples)
+    assert res.desired > 1
+    recs = ledger.query(plane="autoscaler", limit=0)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["decision"] == "decide_scale"
+    assert rec["stub_id"] == "stub-a"
+    assert rec["chosen"] == f"up:{res.desired}"
+    assert rec["rejected"] == [rej("reactive:1", "predictive_override")]
+    sig = rec["signals"]
+    assert sig["action"] == "up" and sig["base_desired"] == 1
+    assert sig["desired"] == res.desired
+    assert sig["projected"] >= 1.0 and "slope" in sig and "fast" in sig
+
+
+def test_autoscaler_stale_series_records_fallback():
+    series = [(10.0, 0.9, 0.2)]      # newest sample 90s old at clock=100
+    decide, samples = _policy(lambda: series, stale_after_s=30.0)
+    decide(samples)
+    recs = ledger.query(plane="autoscaler", limit=0)
+    assert len(recs) == 1
+    assert recs[0]["chosen"] == "reactive"
+    assert recs[0]["rejected"][0]["alternative"] == "predictive"
+    assert "stale" in recs[0]["rejected"][0]["reason"]
+
+
+def test_autoscaler_quiet_tick_records_reactive():
+    # steady low burn: the controller holds, the reactive base stands
+    series = [(t, 0.1, 0.1) for t in range(90, 101)]
+    decide, samples = _policy(lambda: series, base_desired=1, replicas=1)
+    decide(samples)
+    recs = ledger.query(plane="autoscaler", limit=0)
+    assert len(recs) == 1
+    assert recs[0]["chosen"] == "reactive" and recs[0]["rejected"] == []
+    sig = recs[0]["signals"]
+    assert sig["base_desired"] == sig["desired"] == 1
+    assert sig["action"] == "hold"
+    assert "bringup_s" in sig and "budget_s" in sig
+
+
+# ---------------------------------------------------------------------------
+# fleet observer: decision → scaleout.* timeline series
+# ---------------------------------------------------------------------------
+
+def test_fleetobs_mirrors_autoscaler_decisions_into_timeline():
+    from tpu9.config import SloConfig
+    from tpu9.gateway.fleetobs import FleetObserver
+    obs = FleetObserver(SloConfig(), MemoryStore())
+    ledger.record("autoscaler", "decide_scale", stub_id="stub-a",
+                  signals={"action": "up", "projected": 1.4,
+                           "desired": 3})
+    ledger.record("autoscaler", "decide_scale", stub_id="stub-a",
+                  signals={"action": "hold", "projected": 0.2,
+                           "desired": 3, "bringup_guard": 1})
+    obs.sample_decisions()
+    out = obs.timeline.query(["scaleout.stub-a.*"], limit=None)
+    assert [v for _, v in out["scaleout.stub-a.direction"]] == [1.0, 0.0]
+    assert [v for _, v in out["scaleout.stub-a.projected"]] == [1.4, 0.2]
+    assert [v for _, v in out["scaleout.stub-a.bringup_guard"]] == [1.0]
+    # the cursor is consumed: a second tick mints no duplicate samples
+    obs.sample_decisions()
+    out2 = obs.timeline.query(["scaleout.stub-a.direction"], limit=None)
+    assert len(out2["scaleout.stub-a.direction"]) == 2

@@ -172,6 +172,63 @@ async def test_replica_crash_mid_stream_resumes_seamlessly(tmp_path):
                                    "stream_gap") \
             or attrs["reason"].startswith("transport_"), attrs
 
+        # decision ledger (ISSUE 19): the WHY chain for this same
+        # request id — admission, stream placement, the failover retry
+        # naming the victim, and the resume-mode verdict (no drain ran,
+        # so no KV key was announced: block-ship is the REJECTED
+        # alternative and re-prefill the chosen one) — in seq order
+        trace_id = spans[0]["traceId"]
+        status, dec = await stack.api(
+            "GET", f"/api/v1/decisions?request_id={trace_id}&limit=100")
+        assert status == 200
+        chain = dec["records"]
+        kinds = [(r["plane"], r["decision"]) for r in chain]
+        for want in (("admission", "admitted"),
+                     ("placement", "stream_admit"),
+                     ("failover", "retry"),
+                     ("failover", "resume_mode")):
+            assert want in kinds, kinds
+        assert kinds.index(("admission", "admitted")) \
+            < kinds.index(("placement", "stream_admit")) \
+            < kinds.index(("failover", "retry")) \
+            < kinds.index(("failover", "resume_mode")), kinds
+        seqs = [r["seq"] for r in chain]
+        assert seqs == sorted(seqs)
+        retry = next(r for r in chain if r["decision"] == "retry")
+        assert retry["rejected"][0]["alternative"] == victim
+        assert retry["signals"]["failed_attempt"] == 1
+        resume = next(r for r in chain if r["decision"] == "resume_mode")
+        assert resume["chosen"] == "re_prefill"
+        assert resume["rejected"] == [
+            {"alternative": "block_ship",
+             "reason": "no_kv_key_announced"}]
+        assert resume["signals"]["watermark"] >= 5
+        placed = next(r for r in chain if r["decision"] == "stream_admit")
+        assert placed["chosen"] == victim
+        assert placed["workspace_id"]
+
+        # `tpu9 why <request-id>`: the same chain interleaved with the
+        # span tree, via the real CLI against the live gateway
+        from click.testing import CliRunner
+        from tpu9.cli.main import cli as tpu9_cli
+        env = {"TPU9_GATEWAY_URL": stack.base_url,
+               "TPU9_TOKEN": stack.gateway.default_token}
+        res = await asyncio.to_thread(
+            lambda: CliRunner().invoke(tpu9_cli, ["why", trace_id],
+                                       env=env))
+        assert res.exit_code == 0, res.output
+        lines = res.output.splitlines()
+
+        def _line(snippet):
+            idx = [i for i, ln in enumerate(lines) if snippet in ln]
+            assert idx, (snippet, res.output)
+            return idx[0]
+
+        assert _line("admission") < _line("stream_admit") \
+            < _line("attempt_2") < _line("re_prefill")
+        assert any("gateway.failover" in ln for ln in lines), res.output
+        assert "no_kv_key_announced" in res.output
+
         # the victim's engine really died (the crash was real, not a
         # transport blip) and left a post-mortem behind
         beat = {}

@@ -146,10 +146,12 @@ async def test_stall_flips_health_ejects_replica_and_recovers(tmp_path):
         dispatches = []
         orig_launch = router._launch
 
-        def spy_launch(st, req, prefer, replica, affinity_hit=None):
+        def spy_launch(st, req, prefer, replica, affinity_hit=None,
+                       evidence=None):
             dispatches.append(replica)
             return orig_launch(st, req, prefer, replica,
-                               affinity_hit=affinity_hit)
+                               affinity_hit=affinity_hit,
+                               evidence=evidence)
 
         router._launch = spy_launch
         try:

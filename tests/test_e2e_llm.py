@@ -390,3 +390,26 @@ async def test_request_lifecycle_trace_e2e():
                    f"&since_seq={seqs[-1]}")
         assert status == 200
         assert all(r["seq"] > seqs[-1] for r in fl2["flight"])
+
+        # ---- decision ledger (ISSUE 19): the buffered request's WHY
+        # chain joins on the SAME trace id — the admission verdict, then
+        # the dispatch placement whose chosen replica matches the
+        # router.dispatch span, with the evidence signals attached
+        status, dec = await stack.api(
+            "GET", f"/api/v1/decisions?request_id={invoke['traceId']}")
+        assert status == 200
+        chain = dec["records"]
+        planes = [(r["plane"], r["decision"]) for r in chain]
+        adm = next(r for r in chain if r["plane"] == "admission")
+        assert adm["decision"] in ("queued", "admitted")
+        assert adm["chosen"] == "admit"
+        assert adm["signals"]["tenant"]
+        place = next(r for r in chain if r["decision"] == "dispatch")
+        assert planes.index((adm["plane"], adm["decision"])) \
+            < planes.index(("placement", "dispatch"))
+        assert place["chosen"] == disp["replica"]
+        assert place["signals"]["queue_wait_s"] >= 0.0
+        # a cold-start dispatch (no replicas yet) honestly reports an
+        # empty candidate set; a warm one counts the preference order
+        assert place["signals"]["candidates"] == disp["candidates"]
+        assert place["workspace_id"] == invoke["attributes"]["workspace_id"]

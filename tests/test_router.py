@@ -610,7 +610,7 @@ async def test_stalled_heartbeat_stats_eject_at_dispatch_time():
     assert router.admission.is_stalled("r1")
     # fleet capacity shrank to the healthy replica's budget only — the
     # autoscaler's queue_sample sees the missing replica as pressure
-    order, budgets, capacity, _ = await router._preference(
+    order, budgets, capacity, _, _ = await router._preference(
         "s", _body(8), await router._running("s"))
     assert "r1" not in budgets and "r1" not in order
     await router.stop()

@@ -129,3 +129,33 @@ def test_llm_cli_group_surface():
     r = CliRunner().invoke(cli, ["llm", "deploy", "--model", "llama-nope",
                                  "--tpu", ""])
     assert r.exit_code != 0
+
+
+def test_decisions_cli_surface():
+    """`tpu9 why` / `tpu9 decisions` (ISSUE 19): the commands exist on
+    the group, and the one-line decision renderer shows chosen action,
+    rejected alternatives with reasons, and the signal vector — the
+    parts an operator greps for — in plain ascii."""
+    from click.testing import CliRunner
+
+    from tpu9.cli.main import _fmt_decision, cli
+
+    r = CliRunner().invoke(cli, ["--help"])
+    assert r.exit_code == 0
+    for cmd in ("why", "decisions"):
+        assert cmd in r.output
+    r = CliRunner().invoke(cli, ["why", "--help"])
+    assert r.exit_code == 0
+
+    line = _fmt_decision({
+        "plane": "placement", "decision": "dispatch", "chosen": "c7",
+        "rejected": [{"alternative": "c3", "reason": "health:stalled"},
+                     {"alternative": "c5", "reason": "budget_busy"}],
+        "signals": {"candidates": 3, "queue_wait_s": 0.002}})
+    assert "placement" in line and "dispatch" in line
+    assert "-> c7" in line
+    assert "!c3(health:stalled)" in line and "!c5(budget_busy)" in line
+    assert "candidates=3" in line
+    # renderer survives sparse records (no rejects, no signals)
+    line = _fmt_decision({"plane": "admission", "decision": "shed"})
+    assert "admission" in line and "shed" in line

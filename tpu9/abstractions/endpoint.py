@@ -176,7 +176,8 @@ class EndpointInstance:
                     burns=lambda: signals.burn_history(sid),
                     bringup=lambda: signals.bringup_s(sid),
                     max_containers=a.max_containers,
-                    min_containers=a.min_containers)
+                    min_containers=a.min_containers,
+                    stub_id=sid)
         self.buffer = RequestBuffer(
             stub, containers, request_timeout_s=stub.config.timeout_s,
             router=self.router, dialer=dialer,

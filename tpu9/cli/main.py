@@ -658,6 +658,95 @@ def traces_cmd(trace_id: str, limit: int) -> None:
                    f"{sp['durationMs']:>9.2f}ms  {sp.get('status','')}")
 
 
+def _fmt_decision(rec: dict) -> str:
+    """One ledger record, one line: plane decision → chosen, then the
+    rejected alternatives (!alt(reason)) and the input signals."""
+    rej_txt = " ".join(f"!{r.get('alternative', '')}({r.get('reason', '')})"
+                       for r in rec.get("rejected") or [])
+    sig = rec.get("signals") or {}
+    sig_txt = " ".join(f"{k}={v}" for k, v in list(sig.items())[:8])
+    body = (f"{rec.get('plane', ''):<11}{rec.get('decision', ''):<14}"
+            f"-> {rec.get('chosen', '') or '-'}")
+    if rej_txt:
+        body += f"  {rej_txt}"
+    if sig_txt:
+        body += f"  [{sig_txt}]"
+    return body
+
+
+@cli.command("decisions")
+@click.option("--plane", default="",
+              help="admission|placement|failover|migration|autoscaler")
+@click.option("--request-id", default="", help="one request's chain")
+@click.option("--since", default=0.0, type=float, help="wall-clock floor")
+@click.option("--limit", default=50)
+@click.option("--json", "as_json", is_flag=True, help="raw records")
+def decisions_cmd(plane: str, request_id: str, since: float, limit: int,
+                  as_json: bool) -> None:
+    """Fleet decision ledger (ISSUE 19): WHY the control planes chose
+    what they chose — shed verdicts, placement orders, failover resume
+    modes, drain exports, autoscaler ticks — each with the rejected
+    alternatives and the input signals behind the choice."""
+    q = f"?limit={limit}&since={since}"
+    if plane:
+        q += f"&plane={plane}"
+    if request_id:
+        q += f"&request_id={request_id}"
+    data = _client()._run(
+        lambda c: c.request("GET", f"/api/v1/decisions{q}"))
+    records = data.get("records", [])
+    if as_json:
+        click.echo(json.dumps(records, indent=2))
+        return
+    if not records:
+        click.echo("no decision records (yet)")
+        return
+    for rec in records:
+        stamp = time.strftime("%H:%M:%S",
+                              time.localtime(float(rec.get("ts", 0.0))))
+        click.echo(f"{stamp} {_fmt_decision(rec)}")
+
+
+@cli.command("why")
+@click.argument("request_id")
+@click.option("--json", "as_json", is_flag=True, help="raw chain + spans")
+def why_cmd(request_id: str, as_json: bool) -> None:
+    """The full story of one request: its decision chain (admission →
+    placement → failover → migration) interleaved with the trace span
+    tree. `tpu9 traces` says what happened; this says why."""
+    client = _client()
+    ddata = client._run(lambda c: c.request(
+        "GET", f"/api/v1/decisions?request_id={request_id}&limit=500"))
+    tdata = client._run(lambda c: c.request(
+        "GET", f"/api/v1/traces?trace_id={request_id}&limit=1000"))
+    records = ddata.get("records", [])
+    spans = tdata.get("spans", [])
+    if as_json:
+        click.echo(json.dumps({"records": records, "spans": spans},
+                              indent=2))
+        return
+    # merge on the wall clock; a decision made inside a span sorts after
+    # the span's start, which reads as cause-then-effect
+    events = [(sp.get("startTimeUnixNano", 0) / 1e9, 0, sp)
+              for sp in spans]
+    events += [(float(rec.get("ts", 0.0)), 1, rec) for rec in records]
+    if not events:
+        click.echo(f"no evidence for request {request_id} "
+                   "(expired, or never traced?)")
+        return
+    events.sort(key=lambda e: (e[0], e[1]))
+    t0 = events[0][0]
+    for ts, kind, item in events:
+        if kind == 0:
+            indent = "  " if item.get("parentSpanId") else ""
+            click.echo(f"+{ts - t0:8.3f}s  span       "
+                       f"{indent}{item.get('name', ''):<24}"
+                       f"{item.get('durationMs', 0.0):>9.2f}ms  "
+                       f"{item.get('status', '')}")
+        else:
+            click.echo(f"+{ts - t0:8.3f}s  {_fmt_decision(item)}")
+
+
 @cli.command("flight")
 @click.argument("stub_id")
 @click.option("--container-id", default="", help="pin one replica")
@@ -779,6 +868,7 @@ def scaleout_cmd(stub_id: str, container_id: str, as_json: bool) -> None:
     click.echo(f"tree: fanout={tree.get('fanout', 0)} "
                f"edges={len(tree.get('edges', []))} "
                f"source_edges={tree.get('source_edges', 0)}")
+    _scaleout_decisions()
     replicas = data.get("replicas", [])
     if not replicas:
         click.echo("no replicas in the group ledger yet (wait a "
@@ -804,6 +894,26 @@ def scaleout_cmd(stub_id: str, container_id: str, as_json: bool) -> None:
             f"{float(row.get('ready_frac', 1.0)):>7.2f}"
             f"{len(row.get('children', [])):>10}"
             f"  {par_txt} {edge_txt}{stale}")
+
+
+def _scaleout_decisions(limit: int = 8) -> None:
+    """Trailing autoscaler ledger records (ISSUE 19): the last scaling
+    verdicts with their projection/guard signals, folded into the
+    scale-out report so `tpu9 scaleout` answers 'why this replica
+    count'. Best-effort — a ledger that hasn't seen a tick is silent."""
+    try:
+        data = _client()._run(lambda c: c.request(
+            "GET", f"/api/v1/decisions?plane=autoscaler&limit={limit}"))
+    except Exception:   # noqa: BLE001 — report must render regardless
+        return
+    records = data.get("records", [])
+    if not records:
+        return
+    click.echo("recent autoscaler decisions:")
+    for rec in records:
+        stamp = time.strftime("%H:%M:%S",
+                              time.localtime(float(rec.get("ts", 0.0))))
+        click.echo(f"  {stamp} {_fmt_decision(rec)}")
 
 
 @cli.command("postmortem")

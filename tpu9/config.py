@@ -285,6 +285,13 @@ class SloConfig:
     goodput_window_s: float = 3600.0
     # burn-rate threshold that counts as "burning" (and feeds pressure)
     burn_alert: float = 1.0
+    # decision ledger caps (ISSUE 19): global ring, per-request index
+    # entry cap, records kept per request, and the index idle TTL — the
+    # same three-way bounding as the timeline rings above
+    decisions_capacity: int = 2048
+    decisions_max_requests: int = 1024
+    decisions_per_request: int = 32
+    decisions_idle_ttl_s: float = 900.0
     objectives: list[SloObjectiveConfig] = field(
         default_factory=_default_slo_objectives)
 

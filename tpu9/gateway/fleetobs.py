@@ -260,8 +260,39 @@ class FleetObserver:
                 self.goodput.forget_stub(sid)
             self._sampled_stubs = seen_stubs
         await self.sample_cache_plane()
+        self.sample_decisions()
         self.goodput.publish(await self.goodput_snapshot())
         self.timeline.prune()
+        # decision-ledger index pruning rides the same tick (ISSUE 19):
+        # finished requests' chains age out with timeline retention
+        from ..observability.decisions import ledger as decision_ledger
+        decision_ledger.prune()
+
+    def sample_decisions(self) -> None:
+        """Autoscaler verdicts → ``scaleout.{stub}.*`` timeline series
+        (ISSUE 19 satellite): each predictive tick already left one
+        ledger record; mirror its direction / projection / guard signals
+        into the bounded rings so `tpu9 scaleout` and the dashboards get
+        scaling history, not just the latest verdict. Seq-cursored so a
+        record is sampled exactly once."""
+        from ..observability.decisions import ledger as decision_ledger
+        direction = {"up": 1.0, "down": -1.0, "hold": 0.0, "fallback": 0.0}
+        recs, self._dec_cursor = decision_ledger.export_new(
+            since_seq=getattr(self, "_dec_cursor", 0), limit=1000)
+        for rec in recs:
+            if rec.get("plane") != "autoscaler" \
+                    or rec.get("decision") != "decide_scale":
+                continue
+            sid = rec.get("stub_id") or "fleet"
+            sig = rec.get("signals") or {}
+            prefix = f"scaleout.{sid}."
+            self.timeline.record(prefix + "direction",
+                                 direction.get(sig.get("action", ""), 0.0),
+                                 ts=rec.get("ts"))
+            for name in ("projected", "desired", "bringup_guard"):
+                if name in sig:
+                    self.timeline.record(prefix + name,
+                                         _num(sig, name), ts=rec.get("ts"))
 
     async def sample_cache_plane(self) -> None:
         """Worker-heartbeated cache/weight-pool snapshots → per-worker

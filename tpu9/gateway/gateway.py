@@ -166,6 +166,14 @@ class Gateway:
                                cluster=cfg.cluster_name)
         from ..observability import UsageService
         self.usage = UsageService(self.store, self.backend)
+        # decision ledger caps (ISSUE 19): re-bound the module singleton
+        # from config before any plane records into it
+        from ..observability.decisions import ledger as decision_ledger
+        decision_ledger.configure(
+            capacity=cfg.slo.decisions_capacity,
+            max_requests=cfg.slo.decisions_max_requests,
+            per_request=cfg.slo.decisions_per_request,
+            idle_ttl_s=cfg.slo.decisions_idle_ttl_s)
         # fleet SLO / timeline / goodput layer (ISSUE 12): bounded
         # time-series store + burn-rate evaluator + per-tenant goodput
         # accounting behind /api/v1/{timeline,slo} and `tpu9 top`
@@ -353,6 +361,7 @@ class Gateway:
         r.add_get("/api/v1/timeline", self._timeline)
         r.add_get("/api/v1/slo", self._slo)
         r.add_get("/api/v1/traces", self._traces)
+        r.add_get("/api/v1/decisions", self._decisions)
         r.add_get("/api/v1/coldstart", self._coldstart)
         r.add_get("/api/v1/scaleout", self._scaleout)
         r.add_get("/api/v1/postmortem", self._postmortem)
@@ -684,6 +693,57 @@ class Gateway:
                     continue
         spans.sort(key=lambda s: s.get("startTimeUnixNano", 0))
         return web.json_response({"spans": spans[:limit]})
+
+    async def _decisions(self, request: web.Request) -> web.Response:
+        """Merged fleet decision ledger (ISSUE 19): this process's ring
+        (admission / placement / failover / autoscaler records) + the
+        rings LLM runners ship on the pressure heartbeat (migration
+        adopt/drain evidence). Workspace-scoped like /api/v1/traces —
+        records are stamped with the workspace they served and a caller
+        only sees its own; records with no workspace stamp (autoscaler
+        ticks, tree replans) are fleet history, operator-only."""
+        ws = self._ws(request)
+        operator = self._is_operator(request)
+        from ..observability.decisions import ledger as decision_ledger
+        request_id = request.query.get("request_id", "")
+        plane = request.query.get("plane", "")
+        since = self._q_float(request, "since", 0.0)
+        limit = min(int(self._q_float(request, "limit", 500)), 5000)
+
+        def visible(rec: dict) -> bool:
+            rws = rec.get("workspace_id", "")
+            return operator or rws == ws.workspace_id
+
+        records = [r for r in decision_ledger.query(
+            request_id=request_id, plane=plane, since=since, limit=limit)
+            if visible(r)]
+        # dedup by (container_id, seq): each process numbers its own
+        # records, and only runner-shipped ones carry a container stamp
+        seen = {(r.get("container_id", ""), r.get("seq")) for r in records}
+        for key in await self.store.keys("runner:decisions:*"):
+            raw = await self.store.get(key)
+            if not raw:
+                continue
+            try:
+                ring = json.loads(raw)
+            except (ValueError, TypeError):
+                continue
+            for rec in ring:
+                if not isinstance(rec, dict) or not visible(rec):
+                    continue
+                if request_id and rec.get("request_id") != request_id:
+                    continue
+                if plane and rec.get("plane") != plane:
+                    continue
+                if rec.get("ts", 0.0) < since:
+                    continue
+                k = (rec.get("container_id", ""), rec.get("seq"))
+                if k in seen:
+                    continue
+                seen.add(k)
+                records.append(rec)
+        records.sort(key=lambda r: (r.get("ts", 0.0), r.get("seq", 0)))
+        return web.json_response({"records": records[:limit]})
 
     async def _coldstart(self, request: web.Request) -> web.Response:
         """Per-replica cold-start decomposition records (ISSUE 13):
@@ -1225,6 +1285,9 @@ class Gateway:
         spans = d.get("spans")
         if isinstance(spans, list) and spans:
             await self._ingest_runner_spans(state, spans)
+        decisions = d.get("decisions")
+        if isinstance(decisions, list) and decisions:
+            await self._ingest_runner_decisions(state, decisions)
         return web.json_response({"ok": True})
 
     async def _rpc_llm_postmortem(self, request: web.Request) -> web.Response:
@@ -1281,6 +1344,31 @@ class Gateway:
         existing = await self.store.get(key)
         try:
             merged = (json.loads(existing) if existing else [])[-1500:]
+        except (ValueError, TypeError):
+            merged = []
+        merged.extend(cleaned)
+        await self.store.set(key, json.dumps(merged), ttl=3600.0)
+
+    async def _ingest_runner_decisions(self, state, decisions: list) -> None:
+        """Runner decision records riding the pressure heartbeat (ISSUE
+        19 — the same accepted-beat channel the engine spans use, so the
+        runner's seq watermark only advances on a 2xx). Identity is
+        stamped HERE from the authenticated container state, never
+        trusted from the payload: a tenant container must not plant
+        decision evidence into another workspace's /api/v1/decisions."""
+        cleaned = []
+        for rec in decisions[:1024]:    # bound one beat's ingest
+            if not isinstance(rec, dict) or not rec.get("plane"):
+                continue
+            rec["workspace_id"] = state.workspace_id
+            rec["container_id"] = state.container_id
+            cleaned.append(rec)
+        if not cleaned:
+            return
+        key = f"runner:decisions:{state.container_id}"
+        existing = await self.store.get(key)
+        try:
+            merged = (json.loads(existing) if existing else [])[-1000:]
         except (ValueError, TypeError):
             merged = []
         merged.extend(cleaned)
@@ -2319,6 +2407,7 @@ class Gateway:
 
         from ..abstractions.common.buffer import ForwardResult
         from ..observability import tracer
+        from ..observability.decisions import ledger, rej
         from ..utils.backoff import BackoffPolicy
         from . import survival as sv
 
@@ -2388,10 +2477,33 @@ class Gateway:
             # mint tokens the unfailed stream never produces)
             if resume is not None and budget.attempt > 1 \
                     and (resume.remaining == 0 or resume.ended_on_eos):
+                ledger.record(
+                    "failover", "resume_mode", request_id=trace_ref[0],
+                    chosen="synthesize_done",
+                    rejected=[rej("replay", "all_tokens_delivered"
+                                  if resume.remaining == 0
+                                  else "ended_on_eos")],
+                    signals={"watermark": resume.watermark,
+                             "attempt": budget.attempt},
+                    stub_id=stub.stub_id, workspace_id=stub.workspace_id)
                 finished = True
                 break
-            attempt_body = resume.resume_payload() \
-                if (resume is not None and budget.attempt > 1) else body
+            if resume is not None and budget.attempt > 1:
+                attempt_body = resume.resume_payload()
+                # the ship-vs-reprefill outcome (ISSUE 19): did this
+                # resume splice shipped KV blocks or pay a re-prefill?
+                ledger.record(
+                    "failover", "resume_mode", request_id=trace_ref[0],
+                    chosen="block_ship" if resume.kv_key else "re_prefill",
+                    rejected=[] if resume.kv_key
+                    else [rej("block_ship", "no_kv_key_announced")],
+                    signals={"watermark": resume.watermark,
+                             "remaining": resume.remaining,
+                             "kv_tokens": resume.kv_tokens,
+                             "attempt": budget.attempt},
+                    stub_id=stub.stub_id, workspace_id=stub.workspace_id)
+            else:
+                attempt_body = body
             hdrs = list(fwd_headers)
             rem = ctx.remaining_s()
             if rem is not None:
@@ -2533,6 +2645,19 @@ class Gateway:
             budget.note_failure()
             delay = budget.next_delay() if verdict == sv.RETRYABLE else None
             if delay is None:
+                ledger.record(
+                    "failover",
+                    "final" if verdict != sv.RETRYABLE else "give_up",
+                    request_id=trace_ref[0], chosen="return_error",
+                    rejected=[rej("retry", f"verdict:{verdict}"
+                                  if verdict != sv.RETRYABLE
+                                  else "budget_exhausted")],
+                    signals={"reason": failed.reason,
+                             "attempt": budget.attempt,
+                             "max_attempts": budget.max_attempts,
+                             "watermark": resume.watermark if resume
+                             else 0},
+                    stub_id=stub.stub_id, workspace_id=stub.workspace_id)
                 if self.fleet_router is not None and budget.attempt > 1:
                     self.fleet_router.signals.retry_result(
                         stub.stub_id, recovered=False)
@@ -2587,6 +2712,19 @@ class Gateway:
                            "watermark": resume.watermark if resume else 0,
                            "backoff_s": round(delay, 4)},
                     end_mono=now_m)
+            # next_delay() consumed the retry: budget.attempt is the one
+            # about to run — the record mirrors survival's buffered path
+            ledger.record(
+                "failover", "retry", request_id=trace_ref[0],
+                chosen=f"attempt_{budget.attempt}",
+                rejected=[rej(failed.replica or "replica", failed.reason)],
+                signals={"verdict": verdict,
+                         "failed_attempt": budget.attempt - 1,
+                         "max_attempts": budget.max_attempts,
+                         "watermark": resume.watermark if resume else 0,
+                         "kv_key_known": bool(resume and resume.kv_key),
+                         "backoff_s": round(delay, 4)},
+                stub_id=stub.stub_id, workspace_id=stub.workspace_id)
             if ctx.request_id and resume is not None:
                 await self.journal.update(stub.workspace_id,
                                           ctx.request_id,

@@ -39,6 +39,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Optional
 
+from ..observability.decisions import ledger, rej
+from ..observability.trace import tracer
 from ..utils.backoff import BackoffPolicy
 
 BUDGET_HEADER = "X-Tpu9-Budget-S"
@@ -180,16 +182,50 @@ async def submit_with_failover(
     once per retry for spans/counters. Returns the final result — on
     exhaustion, the LAST failure (honest, not a synthesized 200)."""
     avoid: set[str] = set()
+    # decision ledger (ISSUE 19): the classify verdict + attempt budget
+    # behind every retry / give-up, keyed by the surrounding invoke
+    # span's trace id (the fleet request id)
+    req_id = tracer.current_trace_id()
     while True:
         result = await attempt_fn(budget.attempt, avoid)
-        if classify(result.status, result.body) != RETRYABLE:
+        verdict = classify(result.status, result.body)
+        if verdict != RETRYABLE:
+            if verdict == FATAL:
+                ledger.record(
+                    "failover", "final", request_id=req_id,
+                    chosen="return_error",
+                    rejected=[rej("retry", f"verdict:{verdict}")],
+                    signals={"status": result.status,
+                             "attempt": budget.attempt,
+                             "max_attempts": budget.max_attempts})
             return result
         budget.note_failure()
         delay = budget.next_delay()
         if delay is None:
+            ledger.record(
+                "failover", "give_up", request_id=req_id,
+                chosen="return_last_failure",
+                rejected=[rej("retry",
+                              "attempts_exhausted"
+                              if budget.attempt >= budget.max_attempts
+                              else "deadline_exhausted")],
+                signals={"status": result.status, "verdict": verdict,
+                         "attempt": budget.attempt,
+                         "max_attempts": budget.max_attempts})
             return result
         if getattr(result, "container_id", ""):
             avoid.add(result.container_id)
+        # next_delay() consumed the retry: budget.attempt is now the
+        # attempt about to run, budget.attempt - 1 the one that failed
+        ledger.record(
+            "failover", "retry", request_id=req_id,
+            chosen=f"attempt_{budget.attempt}",
+            rejected=[rej(getattr(result, "container_id", "") or "replica",
+                          f"http_{result.status}")],
+            signals={"verdict": verdict, "failed_status": result.status,
+                     "failed_attempt": budget.attempt - 1,
+                     "max_attempts": budget.max_attempts,
+                     "backoff_s": round(delay, 4)})
         if on_failover is not None:
             on_failover(budget.attempt, result, delay)
         await sleep(delay)

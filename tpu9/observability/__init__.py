@@ -1,3 +1,4 @@
+from .decisions import DecisionLedger, ledger
 from .metrics import Metrics, metrics
 from .events import EventBus
 from .loglimit import LogLimiter
@@ -8,4 +9,5 @@ from .usage import UsageSampler, UsageService
 
 __all__ = ["Metrics", "metrics", "EventBus", "LogLimiter", "Span", "Tracer",
            "new_trace_id", "tracer", "UsageSampler", "UsageService",
-           "TimelineStore", "SloEvaluator", "GoodputAccountant"]
+           "TimelineStore", "SloEvaluator", "GoodputAccountant",
+           "DecisionLedger", "ledger"]

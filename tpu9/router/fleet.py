@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Optional
 
 from ..abstractions.common.buffer import ForwardResult
+from ..observability.decisions import ledger, rej
 from ..observability.trace import tracer
 from ..types import ContainerStatus, Stub
 from .admission import AdmissionController, ReplicaBudgets
@@ -241,8 +242,17 @@ class FleetRouter:
                   reason: str = "", **extra) -> None:
         """Record the admission DECISION as a (near-instant) child span of
         the invoke span: admitted/queued vs shed, with the shed reason —
-        the evidence `why did my request 429` queries need. No-op when the
-        request carries no trace context (bench drives the router raw)."""
+        the evidence `why did my request 429` queries need. The span
+        no-ops when the request carries no trace context (bench drives
+        the router raw); the decision LEDGER record (ISSUE 19) is
+        unconditional — fleet-level shed history must exist even for
+        untraced traffic."""
+        ledger.record(
+            "admission", decision, request_id=ctx[0],
+            chosen="shed" if decision == "shed" else "admit",
+            rejected=[rej("admit", reason)] if decision == "shed" else (),
+            signals={"tenant": tenant, **extra},
+            stub_id=stub.stub_id, workspace_id=stub.workspace_id)
         if not ctx[0]:
             return
         attrs = {"stub_id": stub.stub_id, "workspace_id": stub.workspace_id,
@@ -368,11 +378,18 @@ class FleetRouter:
                     [])
         self.signals.submitted(stub.stub_id, tenant)
         replicas = await self._running(stub.stub_id)
-        order, _, _, hit = await self._preference(stub.stub_id, body,
-                                                  replicas)
+        order, _, _, hit, ev = await self._preference(stub.stub_id, body,
+                                                      replicas)
         self._adm_span(ctx, stub, tenant, "admitted", stream=True,
                        affinity_hit=hit,
                        replica=order[0] if order else "cold")
+        # the stream's placement decision happens HERE (no fair queue /
+        # dispatcher pass): one ledger record with the same evidence
+        # shape as the buffered path's _launch record
+        ledger.record("placement", "stream_admit", request_id=ctx[0],
+                      chosen=order[0] if order else "cold_start",
+                      rejected=ev["rejected"], signals=ev["signals"],
+                      stub_id=stub.stub_id, workspace_id=stub.workspace_id)
         return None, order
 
     def stream_started(self, stub: Stub, body: bytes,
@@ -457,10 +474,13 @@ class FleetRouter:
         by contract (BND001: no serving/runner imports here)."""
         self.admission.mark_draining(container_id)
         self.affinity.forget_replica(container_id)
+        inflight0 = self.budgets.inflight(container_id)
+        migrate_ok = migrate is not None
         if migrate is not None:
             try:
                 await migrate(container_id)
             except Exception as exc:    # noqa: BLE001 — best-effort
+                migrate_ok = False
                 log.warning("drain migration hook failed for %s: %s",
                             container_id, exc)
         drained = await self.admission.wait_drained(
@@ -470,6 +490,21 @@ class FleetRouter:
                         "drain window — stopping anyway", container_id,
                         self.budgets.inflight(container_id),
                         self.cfg.drain_timeout_s)
+        # the control-plane half of the migration story (ISSUE 19): did
+        # this replica leave gracefully, and was a KV export attempted?
+        # (the runner's per-stream export/adopt records are the other
+        # half, keyed by request id over the heartbeat)
+        ledger.record(
+            "migration", "drain",
+            chosen="drained" if drained else "force_stop",
+            rejected=[] if drained else [rej("graceful_drain",
+                                             "drain_timeout")],
+            signals={"container_id": container_id,
+                     "inflight_at_drain": inflight0,
+                     "inflight_left": self.budgets.inflight(container_id),
+                     "migrate_hook": migrate is not None,
+                     "migrate_ok": migrate_ok,
+                     "timeout_s": self.cfg.drain_timeout_s})
         return drained
 
     # -- dispatch --------------------------------------------------------------
@@ -489,13 +524,19 @@ class FleetRouter:
         return data or None
 
     async def _preference(self, stub_id: str, body: bytes, replicas: list
-                          ) -> tuple[list[str], dict[str, int], int, bool]:
+                          ) -> tuple[list[str], dict[str, int], int, bool,
+                                     dict]:
         """(ordered container ids, per-replica budgets, fleet capacity,
-        affinity hit). Load for JSQ = router-tracked in-flight plus the
-        replica's OWN reported queue (requests the engine already holds)."""
+        affinity hit, decision evidence). Load for JSQ = router-tracked
+        in-flight plus the replica's OWN reported queue (requests the
+        engine already holds). The evidence dict carries the
+        rejected-alternatives list + input signals the placement ledger
+        record (ISSUE 19) needs — built here because only this pass
+        knows WHY a replica fell out of the candidate order."""
         budgets: dict[str, int] = {}
         load: dict[str, float] = {}
         saturated: set[str] = set()
+        rejected: list[dict] = []
         # execute-while-scaling (ISSUE 17): cid -> (ready_frac, ready
         # group names) off the pressure stats; replicas not reporting
         # the scaleout family are fully ready (steady state / old beat)
@@ -527,6 +568,7 @@ class FleetRouter:
                 self.note_replica_health(cid, health,
                                          str(stats.get("health_reason",
                                                        "")))
+                rejected.append(rej(cid, f"health:{health}"))
                 continue
             budgets[cid] = self.budgets.budget_from_stats(stats)
             if stats and "scaleout_ready_frac" in stats:
@@ -556,9 +598,20 @@ class FleetRouter:
         # be an affinity target or it re-enters through the JSQ fallback
         order = self.affinity.order(body, list(load), load, saturated)
         order = self._disagg_order(body, order)
+        fenced = list(order)
         order = self._scaleout_admit(body, order, readiness)
-        return (order, budgets, sum(budgets.values()),
-                self.affinity.hits > hits0)
+        rejected.extend(rej(cid, "scaleout_fence") for cid in fenced
+                        if cid not in order)
+        rejected.extend(rej(cid, "saturated") for cid in saturated
+                        if cid not in order)
+        hit = self.affinity.hits > hits0
+        signals = {"candidates": len(order), "affinity_hit": hit,
+                   "capacity": sum(budgets.values()),
+                   "queue_depth": self.queue_depth(stub_id)}
+        for cid, ld in load.items():
+            signals[f"load.{cid}"] = ld
+        return (order, budgets, signals["capacity"], hit,
+                {"rejected": rejected, "signals": signals})
 
     @staticmethod
     def _scaleout_admit(body: bytes, order: list[str],
@@ -709,23 +762,32 @@ class FleetRouter:
                     self._launch(st, req, prefer=[], replica="")
                     return
             else:
-                order, budgets, capacity, hit = await self._preference(
+                order, budgets, capacity, hit, ev = await self._preference(
                     stub_id, pending.body, replicas)
                 self.signals.queue_sample(stub_id, st.queue.depth, capacity)
                 if req.future.done():    # deadline racing _preference
                     return
+                busy: list[str] = []
                 for cid in order:
                     if self.budgets.try_acquire(cid, budgets.get(cid, 1)):
+                        # replicas ranked ahead but at budget were real
+                        # rejections for THIS dispatch — fold them into
+                        # the evidence the _launch record carries
+                        ev["rejected"] = (ev["rejected"]
+                                          + [rej(c, "budget_busy")
+                                             for c in busy])
                         self._launch(st, req, prefer=order, replica=cid,
-                                     affinity_hit=hit)
+                                     affinity_hit=hit, evidence=ev)
                         return
+                    busy.append(cid)
             # every replica at budget (or cold cap hit): wait for a
             # release / container event, then re-evaluate
             await self.budgets.wait_release(0.25)
 
     def _launch(self, st: _StubState, req: QueuedRequest,
                 prefer: list[str], replica: str,
-                affinity_hit: Optional[bool] = None) -> None:
+                affinity_hit: Optional[bool] = None,
+                evidence: Optional[dict] = None) -> None:
         pending: _Pending = req.item
         pending.dispatched = True
         if not replica:                 # replica slots are acquired by the
@@ -733,6 +795,15 @@ class FleetRouter:
         wait_s = time.monotonic() - req.enqueued_at
         self.signals.queue_wait(st.stub.stub_id, req.tenant, wait_s)
         self._finish_qspan(pending, wait_s=round(wait_s, 6))
+        # cold-start launches carry no preference pass: the honest
+        # evidence is an empty candidate set, not a missing signal
+        ev = evidence or {"rejected": [], "signals": {"candidates": 0}}
+        ledger.record("placement", "dispatch", request_id=pending.ctx[0],
+                      chosen=replica or "cold_start",
+                      rejected=ev["rejected"],
+                      signals={**ev["signals"],
+                               "queue_wait_s": round(wait_s, 6)},
+                      stub_id=st.stub.stub_id, workspace_id=pending.ws)
         if pending.ctx[0]:
             # the placement decision: affinity hit/miss + chosen replica
             # (an instant span — it records an outcome, not an interval)

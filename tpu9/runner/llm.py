@@ -114,6 +114,13 @@ async def amain() -> None:
     # plain re-prefill wherever this is None
     kv_client = _kv_transport()
 
+    # KV-motion spans + migration decision records (ISSUE 19): block
+    # movement shows up inline with the request's prefill/decode spans,
+    # and the adopt/drain verdicts ride the pressure heartbeat to the
+    # gateway's decision API exactly like engine spans do
+    from ..observability.decisions import ledger as decision_ledger, rej
+    from ..observability.trace import tracer as _tracer
+
     # "beat": request completions set this to nudge the pressure loop into
     # an immediate heartbeat, so a completed request's engine spans ship
     # BEFORE an aggressive scale-to-zero can kill the replica (ISSUE 8)
@@ -154,7 +161,7 @@ async def amain() -> None:
 
     import time as _now
 
-    async def _kv_adopt(adopt) -> None:
+    async def _kv_adopt(adopt, trace=None) -> None:
         """Best-effort pre-generate adopt of shipped KV blocks: fetch by
         key, splice into the pool, register the exporter's prefix. Every
         failure path (no transport, fetch miss, induced kv_ship_error,
@@ -163,14 +170,37 @@ async def amain() -> None:
         key = str((adopt or {}).get("key") or "")
         if not key:
             return
+        tid, parent = trace or ("", "")
+        t0w, t0m = _now.time(), _now.monotonic()
+        want_tokens = int((adopt or {}).get("n_tokens") or 0)
+
+        def _verdict(outcome: str, reason: str = "") -> None:
+            # kv.adopt span on the request's trace tree + the runner half
+            # of the migration decision chain (ISSUE 19) — both ride the
+            # pressure heartbeat to the gateway
+            if tid:
+                _tracer.record_span(
+                    "kv.adopt", tid, parent, t0w, t0m,
+                    attrs={"key": key[:16], "outcome": outcome,
+                           "n_tokens": want_tokens},
+                    status="ok" if outcome == "adopted" else "error")
+            decision_ledger.record(
+                "migration", "adopt", request_id=tid, chosen=outcome,
+                rejected=[] if outcome == "adopted"
+                else [rej("block_ship", reason)],
+                signals={"n_tokens": want_tokens,
+                         "container_id": cfg.container_id})
+
         engine = state["engine"]
         if kv_client is None:
             engine.note_kvwire_fallback()
+            _verdict("re_prefill", "no_kv_transport")
             return
         if faults is not None and faults.fire("kv_ship_error"):
             log.warning("fault plane: induced kv ship error (adopt %s)",
                         key[:12])
             engine.note_kvwire_fallback()
+            _verdict("re_prefill", "induced_kv_ship_error")
             return
         t0 = _now.monotonic()
         try:
@@ -180,15 +210,20 @@ async def amain() -> None:
             data = None
         if data is None:
             engine.note_kvwire_fallback()
+            _verdict("re_prefill", "fetch_miss")
             return
         try:
             if engine.adopt_kv(data):   # False self-counts the fallback
                 engine.note_kvwire_ship(_now.monotonic() - t0)
+                _verdict("adopted")
+            else:
+                _verdict("re_prefill", "adopt_declined")
         except Exception as exc:    # noqa: BLE001 — KvWireError and kin
             log.warning("kv adopt rejected (%s): %s", key[:12], exc)
             engine.note_kvwire_fallback()
+            _verdict("re_prefill", "adopt_rejected")
 
-    async def _kv_publish(tokens: list) -> Optional[dict]:
+    async def _kv_publish(tokens: list, trace=None) -> Optional[dict]:
         """export_after_prefill: serialize the prefix-cached blocks the
         prefill just inserted and publish them under the kv: namespace.
         Returns the ``{"kv_key", "n_tokens"}`` announcement (the SSE
@@ -196,18 +231,32 @@ async def amain() -> None:
         nothing to ship."""
         if kv_client is None:
             return None
+        tid, parent = trace or ("", "")
         engine = state["engine"]
         try:
+            t0w, t0m = _now.time(), _now.monotonic()
             payload = engine.export_prefix_kv(tokens)
             if payload is None:
                 return None
             from ..serving.kvwire import decode_header
             header, _ = decode_header(payload)
-            t0 = _now.monotonic()
+            n_tok = int(header.get("n_tokens", 0))
+            if tid:
+                # kv.export: serialize time; kv.ship: transport time —
+                # two spans so a slow ship is distinguishable from a
+                # slow pool walk on the trace tree (ISSUE 19)
+                _tracer.record_span(
+                    "kv.export", tid, parent, t0w, t0m,
+                    attrs={"n_tokens": n_tok, "bytes": len(payload)})
+            t1w, t1m = _now.time(), _now.monotonic()
             digest = await kv_client.put_kv(payload)
-            engine.note_kvwire_ship(_now.monotonic() - t0)
-            return {"kv_key": digest,
-                    "n_tokens": int(header.get("n_tokens", 0))}
+            engine.note_kvwire_ship(_now.monotonic() - t1m)
+            if tid:
+                _tracer.record_span(
+                    "kv.ship", tid, parent, t1w, t1m,
+                    attrs={"key": digest[:16], "n_tokens": n_tok,
+                           "bytes": len(payload)})
+            return {"kv_key": digest, "n_tokens": n_tok}
         except Exception as exc:    # noqa: BLE001 — ship is best-effort
             log.warning("kv export/publish failed: %s", exc)
             return None
@@ -245,7 +294,7 @@ async def amain() -> None:
             # ordinary prefix-reuse path); export after prefill when the
             # router asked for a disagg handoff
             if payload.get("adopt_kv"):
-                await _kv_adopt(payload.get("adopt_kv"))
+                await _kv_adopt(payload.get("adopt_kv"), trace)
             kv_export = bool(payload.get("kv_export")
                              or payload.get("export_after_prefill"))
             if payload.get("stream") or \
@@ -259,7 +308,7 @@ async def amain() -> None:
             state["beat"].set()
             resp = {"tokens": out}
             if kv_export:
-                resp.update(await _kv_publish(prompt) or {})
+                resp.update(await _kv_publish(prompt, trace) or {})
             return web.json_response(resp)
         except TimeoutError as exc:
             # engine deadline expiry (ISSUE 15): 504, not 400/500 — the
@@ -312,7 +361,7 @@ async def amain() -> None:
                     f"data: {json.dumps({'token': tok})}\n\n".encode())
                 if kv_pending:
                     kv_pending = False
-                    ev = await _kv_publish(prompt)
+                    ev = await _kv_publish(prompt, trace)
                     if ev:
                         await sr.write(
                             f"data: {json.dumps(ev)}\n\n".encode())
@@ -388,8 +437,18 @@ async def amain() -> None:
         if kv_client is not None:
             from ..serving.kvwire import decode_header
             for req in engine.active_stream_requests():
+                tid, parent = req.trace or ("", "")
                 if len(req.prompt) + len(req.generated) < min_tokens:
+                    decision_ledger.record(
+                        "migration", "drain_export", request_id=tid,
+                        chosen="skip",
+                        rejected=[rej("block_ship",
+                                      f"under_min_tokens_{min_tokens}")],
+                        signals={"tokens": len(req.prompt)
+                                 + len(req.generated),
+                                 "container_id": cfg.container_id})
                     continue
+                t0w, t0m = _now.time(), _now.monotonic()
                 try:
                     blob = engine.export_request_kv(req.request_id)
                     if blob is None:
@@ -401,9 +460,27 @@ async def amain() -> None:
                 except Exception as exc:    # noqa: BLE001 — per-stream
                     log.warning("drain export failed (%s): %s",
                                 req.request_id, exc)
+                    decision_ledger.record(
+                        "migration", "drain_export", request_id=tid,
+                        chosen="re_prefill",
+                        rejected=[rej("block_ship", type(exc).__name__)],
+                        signals={"container_id": cfg.container_id})
                     continue
                 ev = {"kv_key": digest,
                       "n_tokens": int(header.get("n_tokens", 0))}
+                if tid:
+                    # kv.drain: the drain re-export's block motion on the
+                    # stream's own trace tree (ISSUE 19)
+                    _tracer.record_span(
+                        "kv.drain", tid, parent, t0w, t0m,
+                        attrs={"key": digest[:16], "bytes": len(blob),
+                               "n_tokens": ev["n_tokens"]})
+                decision_ledger.record(
+                    "migration", "drain_export", request_id=tid,
+                    chosen="block_ship",
+                    signals={"n_tokens": ev["n_tokens"],
+                             "bytes": len(blob),
+                             "container_id": cfg.container_id})
                 migrated[req.request_id] = ev
                 req.queue.put_nowait(dict(ev))
         return web.json_response({"container_id": cfg.container_id,
@@ -490,6 +567,9 @@ async def amain() -> None:
         # instead of silently dropping engine spans (bounded by the
         # tracer ring, same honesty as the worker/OTLP paths)
         last_span_ship = 0.0
+        # decision-record ship cursor (ISSUE 19): seq-keyed, same
+        # retry-don't-drop contract — a rejected beat re-ships the window
+        last_dec_ship = 0
         from ..observability.trace import RING_CAP, tracer
         # replica health plane (ISSUE 14): the watchdog classifies the
         # engine's liveness watermark each beat and the verdict rides the
@@ -656,6 +736,8 @@ async def amain() -> None:
                     # ride the keepalive (worker.py ship analogue)
                     spans, ship_hi = tracer.export_new(
                         since_mono=last_span_ship, limit=RING_CAP)
+                    decs, dec_hi = decision_ledger.export_new(
+                        since_seq=last_dec_ship, limit=512)
                     if faults is not None and faults.active(
                             "heartbeat_loss"):
                         # induced heartbeat loss: the replica falls
@@ -671,7 +753,8 @@ async def amain() -> None:
                             json={"container_id": cfg.container_id,
                                   "token_pressure": stats["token_pressure"],
                                   "active_streams": stats["active_streams"],
-                                  "extra": extra, "spans": spans},
+                                  "extra": extra, "spans": spans,
+                                  "decisions": decs},
                             timeout=aiohttp.ClientTimeout(total=5)) as resp:
                         if resp.status >= 400 and not rejected_logged:
                             rejected_logged = True
@@ -682,6 +765,7 @@ async def amain() -> None:
                         elif resp.status < 400:
                             rejected_logged = False
                             last_span_ship = ship_hi
+                            last_dec_ship = dec_hi
                     # black-box ship AFTER the heartbeat, in its own
                     # error scope: the heartbeat is what keeps this
                     # replica visible to the fleet — a persistently

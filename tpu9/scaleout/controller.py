@@ -28,10 +28,11 @@ import the abstractions layer; the endpoint wires the two together).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..config import ScaleoutConfig
+from ..observability.decisions import ledger, rej
 
 # one burn observation: (monotonic_ts, burn_fast, burn_slow)
 BurnSample = Tuple[float, float, float]
@@ -43,6 +44,10 @@ class Decision:
     action: str          # "up" | "down" | "hold" | "fallback"
     desired: int         # predictive target replica count
     reason: str = ""
+    # the inputs behind the verdict (fast/slow burn, slope, projection,
+    # bring-up guard numbers) — flat scalars the decision ledger and the
+    # scaleout timeline series carry verbatim (ISSUE 19)
+    signals: dict = field(default_factory=dict)
 
 
 def burn_slope(series: Sequence[BurnSample], *, window_s: float,
@@ -79,18 +84,25 @@ def decide_scale(
     """One predictive tick. Pure: series in, :class:`Decision` out."""
     t = time.monotonic() if now is None else now
     if not series:
-        return Decision("fallback", replicas, "no burn samples")
+        return Decision("fallback", replicas, "no burn samples",
+                        signals={"replicas": replicas})
     age = t - series[-1][0]
     if age > cfg.stale_after_s:
         # PR 12 staleness guard, applied to scaling: a dead sampler
         # yields NO predictive opinion — the reactive base decides
         return Decision("fallback", replicas,
                         f"burn series stale ({age:.1f}s > "
-                        f"{cfg.stale_after_s:.1f}s)")
+                        f"{cfg.stale_after_s:.1f}s)",
+                        signals={"replicas": replicas,
+                                 "age_s": round(age, 3)})
 
     _, fast, slow = series[-1]
     slope = burn_slope(series, window_s=cfg.slope_window_s, now=t)
     projected = fast + slope * cfg.burn_horizon_s
+    signals = {"replicas": replicas, "fast": round(fast, 4),
+               "slow": round(slow, 4), "slope": round(slope, 6),
+               "projected": round(projected, 4),
+               "horizon_s": cfg.burn_horizon_s}
 
     # -- scale up: projected fast burn crosses 1.0 before the slow
     # window has tripped (once slow >= 1 the SLO is already lost and the
@@ -105,7 +117,8 @@ def decide_scale(
             return Decision("up", desired,
                             f"fast burn {fast:.2f} slope {slope:+.4f}/s "
                             f"→ {projected:.2f} within "
-                            f"{cfg.burn_horizon_s:.0f}s")
+                            f"{cfg.burn_horizon_s:.0f}s",
+                            signals=signals)
 
     # -- scale down: quiet fleet AND the bring-up guard passes.
     # remaining burn-budget time: if burning resumed at full rate the
@@ -114,19 +127,24 @@ def decide_scale(
     bring = bringup_s if (bringup_s is not None and bringup_s > 0) \
         else cfg.default_bringup_s
     budget_s = max(0.0, (1.0 - slow) * slow_window_s)
+    signals["bringup_s"] = round(bring, 3)
+    signals["budget_s"] = round(budget_s, 3)
     if fast <= 0.0 and slope <= 0.0 and slow < 0.5 \
             and replicas > min_replicas:
         if bring * cfg.bringup_safety > budget_s:
+            signals["bringup_guard"] = 1
             return Decision("hold", replicas,
                             f"bringup {bring:.1f}s × {cfg.bringup_safety:g} "
                             f"exceeds burn budget {budget_s:.1f}s — "
-                            "holding capacity")
+                            "holding capacity", signals=signals)
         return Decision("down", max(min_replicas, replicas - 1),
                         f"idle (fast {fast:.2f}, slope {slope:+.4f}/s); "
-                        f"bringup {bring:.1f}s fits budget {budget_s:.1f}s")
+                        f"bringup {bring:.1f}s fits budget {budget_s:.1f}s",
+                        signals=signals)
 
     return Decision("hold", replicas,
-                    f"fast {fast:.2f} slow {slow:.2f} slope {slope:+.4f}/s")
+                    f"fast {fast:.2f} slow {slow:.2f} slope {slope:+.4f}/s",
+                    signals=signals)
 
 
 def predictive_policy(
@@ -139,6 +157,7 @@ def predictive_policy(
     min_containers: int = 0,
     slow_window_s: float = 3600.0,
     clock: Callable[[], float] = time.monotonic,
+    stub_id: str = "",
 ) -> Callable:
     """Wrap a reactive ``DecideFn`` with the predictive controller.
 
@@ -154,6 +173,7 @@ def predictive_policy(
 
     def decide(samples):
         res = base(samples)
+        base_desired = res.desired
         replicas = samples[-1].active_containers if samples else 0
         d = decide_scale(burns(), replicas=replicas, cfg=cfg,
                          now=clock(), bringup_s=bringup(),
@@ -161,6 +181,11 @@ def predictive_policy(
                          min_replicas=min_containers,
                          max_replicas=max_containers)
         if d.action == "fallback":
+            ledger.record(
+                "autoscaler", "decide_scale", chosen="reactive",
+                rejected=[rej("predictive", d.reason)],
+                signals={**d.signals, "base_desired": base_desired,
+                         "desired": res.desired}, stub_id=stub_id)
             return res
         desired, reason = res.desired, res.reason
         if d.action == "up" and d.desired > desired:
@@ -169,7 +194,21 @@ def predictive_policy(
             desired, reason = replicas, f"predictive: {d.reason}"
         elif d.action == "down" and d.desired < desired:
             desired, reason = d.desired, f"predictive: {d.reason}"
-        if desired == res.desired:
+        overrode = desired != base_desired
+        # one ledger record per tick (ISSUE 19): direction, projection
+        # and guard signals, and WHICH opinion won — the evidence that
+        # makes a predictive scale-up distinguishable from a reactive one
+        ledger.record(
+            "autoscaler", "decide_scale",
+            chosen=f"{d.action}:{desired if overrode else base_desired}"
+            if overrode else "reactive",
+            rejected=[rej(f"reactive:{base_desired}", "predictive_override")]
+            if overrode else [],
+            signals={**d.signals, "action": d.action,
+                     "base_desired": base_desired,
+                     "desired": desired if overrode else base_desired},
+            stub_id=stub_id)
+        if not overrode:
             return res
         res.desired = max(min_containers, min(max_containers, desired))
         res.reason = reason

@@ -20,6 +20,7 @@ import time
 from typing import Dict, List, Mapping, Optional
 
 from ..config import ScaleoutConfig
+from ..observability.decisions import ledger as decision_ledger, rej
 from .ledger import GroupLedger
 from .tree import SOURCE, TreePlan, plan_tree, source_edge_count
 
@@ -73,16 +74,35 @@ class ScaleoutCoordinator:
         the dead replica aged out / forgotten)."""
         holders = self.ledger.holders(now=now)
         joiners = self.ledger.joiners(sorted(holders.keys()), now=now)
+        old_edges = set(self.plan.edges())
         self.plan = plan_tree(joiners, holders,
                               fanout=self.cfg.tree_fanout,
                               peer_lat=self._peer_lat)
         self._plans += 1
+        new_edges = set(self.plan.edges())
+        if new_edges != old_edges:
+            # replan evidence (ISSUE 19): one record per plan CHANGE —
+            # steady-state ticks re-derive the same tree and stay silent
+            decision_ledger.record(
+                "autoscaler", "replan",
+                chosen=f"tree:{len(new_edges)}_edges",
+                signals={"edges": len(new_edges),
+                         "edges_added": len(new_edges - old_edges),
+                         "edges_dropped": len(old_edges - new_edges),
+                         "source_edges": source_edge_count(self.plan),
+                         "joiners": len(joiners),
+                         "holders": len(holders),
+                         "plans": self._plans})
         return self.plan
 
     def forget(self, replica: str, now: Optional[float] = None) -> TreePlan:
         """Coordinator-side replan on confirmed peer death: drop the
         replica from the ledger and hand back fresh edges."""
         self.ledger.forget(replica)
+        decision_ledger.record(
+            "autoscaler", "forget_peer", chosen="replan",
+            rejected=[rej(replica, "peer_death")],
+            signals={"replicas_left": len(self.ledger.snapshot())})
         return self.refresh(now=now)
 
     def stats(self) -> dict:
