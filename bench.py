@@ -64,7 +64,8 @@ PHASE_TIMEOUT_S = {"llm": 1800, "llm_endpoint": 1800, "kernels": 900,
                    "coldstart_jax": 900, "coldstart_jax_tpu": 900,
                    "coldstart_stream": 900, "router": 300, "spec": 900,
                    "quant": 900, "obs": 900, "multichip": 900,
-                   "faults": 300, "disagg": 600, "scaleout": 600}
+                   "faults": 300, "disagg": 600, "scaleout": 600,
+                   "kvtier": 600}
 
 # share compiled XLA programs between the in-process llm phase and the
 # runner container in the endpoint phase (identical graphs → second phase
@@ -2321,6 +2322,282 @@ def bench_disagg(quick: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# phase: KV tiering + prefix directory (ISSUE 20) — two legs:
+#   1. session-reuse routing under replica churn + a scale-to-zero/restore
+#      round, directory+tiers ON vs affinity-only OFF, through the REAL
+#      FleetRouter (the directory fold, promotion and adopt-hint paths are
+#      the production code; only the serving replicas are simulated). The
+#      prefix hit rate must be STRICTLY above the affinity baseline and
+#      the modeled TTFT p95 no worse — the whole point of the tier ladder.
+#   2. an eviction storm through the REAL KvPool, host tier on vs off:
+#      down-paging must keep prefixes findable that the untiered pool
+#      destroys, and one timed down/up-page cycle prices the paging path.
+# ---------------------------------------------------------------------------
+
+
+def bench_kvtier(quick: bool = False) -> dict:
+    import asyncio
+
+    out: dict = {}
+    violations: list[str] = []
+
+    from tpu9.abstractions.common.buffer import ForwardResult
+    from tpu9.config import RouterConfig
+    from tpu9.router import FleetRouter
+    from tpu9.router.affinity import block_keys
+    from tpu9.statestore import MemoryStore
+    from tpu9.types import ContainerState, ContainerStatus, Stub, StubConfig
+
+    BT = 16                               # affinity_block_tokens
+    N_REPLICAS = 3
+    N_SESSIONS = 12 if quick else 24
+    TURNS = 4 if quick else 6
+    CHURN_EVERY = 2                       # kill a replica every N turns
+    PREFIX_BLOCKS = 12                    # 192-token session prefix
+    BASE_MS = 1.0
+    PREFILL_MS_PER_TOK = 0.02             # recompute price per token
+    ADOPT_MS = 0.4                        # peer pull price (flat)
+
+    class FakeFleet:
+        def __init__(self, n):
+            self.next_id = n
+            self.states = [self._mk(i) for i in range(n)]
+
+        @staticmethod
+        def _mk(i):
+            return ContainerState(
+                container_id=f"r{i}", stub_id="s",
+                status=ContainerStatus.RUNNING.value,
+                address=f"127.0.0.1:{9300 + i}")
+
+        def replace(self, cid: str) -> str:
+            self.states = [st for st in self.states
+                           if st.container_id != cid]
+            st = self._mk(self.next_id)
+            self.next_id += 1
+            self.states.append(st)
+            return st.container_id
+
+        async def containers_by_stub(self, stub_id, status=None):
+            return list(self.states)
+
+    def session_tokens(s: int) -> list:
+        return [(s * 131 + j * 7) % 251 + 1
+                for j in range(PREFIX_BLOCKS * BT)]
+
+    async def run(directory: bool) -> dict:
+        import os
+        os.environ.pop("TPU9_KV_TIER", None)
+        cfg_r = RouterConfig(default_replica_inflight=8,
+                             max_queue_depth=10000, max_queue_wait_s=30.0,
+                             affinity_block_tokens=BT,
+                             prefix_directory=directory)
+        fleet = FakeFleet(N_REPLICAS)
+        router = FleetRouter(cfg_r, MemoryStore(), fleet)
+        if not directory:
+            router.prefix_dir = None      # affinity-only baseline
+        stub = Stub(stub_id="s", name="s", workspace_id="w",
+                    config=StubConfig(timeout_s=60.0))
+        # simulated replica prefix caches: cid -> {key_hex: n_tokens}
+        caches: dict = {st.container_id: {} for st in fleet.states}
+        key_hits: dict = {}               # (cid, key_hex) -> hit count
+        ttft_ms: list = []
+        hits = [0]
+        total = [0]
+
+        def heartbeat():
+            """Fold each live replica's digest (and hot-key peer
+            publications) into the directory — the pressure-beat path."""
+            if router.prefix_dir is None:
+                return
+            for cid, cache in caches.items():
+                stats = {"kvtier_keys": ",".join(
+                    f"{k}:d:{n}" for k, n in cache.items())}
+                peer = [k for k in cache
+                        if key_hits.get((cid, k), 0) >= 2]
+                if peer:
+                    # digest == key in the sim's peer store
+                    stats["kvtier_peer"] = ",".join(
+                        f"{k}:{k}:{cache[k]}" for k in peer)
+                router.prefix_dir.observe_replica(cid, stats)
+
+        def forward_for(body: bytes, adopt):
+            keys = [k.hex()[:16] for k in block_keys(body, BT)]
+            nb_max = len(keys)
+
+            async def forward(prefer):
+                cid = (prefer or [fleet.states[0].container_id])[0]
+                cache = caches.setdefault(cid, {})
+                covered = 0
+                for i, k in enumerate(keys):
+                    if k in cache:
+                        covered = (nb_max - i) * BT
+                        key_hits[(cid, k)] = key_hits.get((cid, k), 0) + 1
+                        break
+                cost = BASE_MS
+                if covered == 0 and adopt is not None:
+                    # peer pull: the runner fetches kv:<digest> and the
+                    # engine adopts — far cheaper than a full re-prefill
+                    covered = adopt["n_tokens"]
+                    cost += ADOPT_MS
+                    cache[adopt["key"]] = adopt["n_tokens"]
+                n_tok = len(json.loads(body)["tokens"])
+                cost += PREFILL_MS_PER_TOK * max(0, n_tok - covered)
+                hits[0] += covered > 0
+                total[0] += 1
+                ttft_ms.append(cost)
+                for i, k in enumerate(keys):
+                    cache[k] = (nb_max - i) * BT
+                await asyncio.sleep(0.0005)
+                return ForwardResult(status=200, body=b'{"ok":1}',
+                                     container_id=cid)
+            return forward
+
+        in_peer = set()                   # sim peer store (key_hex)
+
+        async def one(s: int, turn: int) -> int:
+            toks = session_tokens(s) + [(turn * 13 + j) % 251 + 1
+                                        for j in range(8)]
+            body = json.dumps({"tokens": toks,
+                               "max_new_tokens": 16}).encode()
+            adopt = router.kv_adopt_hint(body)
+            if adopt is not None and adopt["key"] not in in_peer:
+                adopt = None              # stale hint: recompute path
+            res = await router.submit(stub, "kv", body,
+                                      forward_for(body, adopt))
+            return res.status
+
+        failed = 0
+        for turn in range(TURNS):
+            statuses = await asyncio.gather(
+                *[one(s, turn) for s in range(N_SESSIONS)])
+            failed += sum(1 for st in statuses if st != 200)
+            heartbeat()
+            if turn % CHURN_EVERY == CHURN_EVERY - 1:
+                # replica death: hot keys were already peer-published on
+                # the beat; claims die with the replica
+                victim = fleet.states[0].container_id
+                for k, n in caches.get(victim, {}).items():
+                    if key_hits.get((victim, k), 0) >= 2:
+                        in_peer.add(k)
+                caches.pop(victim, None)
+                newb = fleet.replace(victim)
+                caches[newb] = {}
+                router.note_dispatch_failure(victim)
+        # scale-to-zero: every replica dies, fresh fleet restores; only
+        # the peer tier (directory survivors + adopt hints) carries state
+        for st in list(fleet.states):
+            cid = st.container_id
+            for k in caches.get(cid, {}):
+                if key_hits.get((cid, k), 0) >= 2:
+                    in_peer.add(k)
+            caches.pop(cid, None)
+            newb = fleet.replace(cid)
+            caches[newb] = {}
+            router.note_dispatch_failure(cid)
+        statuses = await asyncio.gather(
+            *[one(s, TURNS) for s in range(N_SESSIONS)])
+        failed += sum(1 for st in statuses if st != 200)
+        await router.stop()
+
+        xs = sorted(ttft_ms)
+        p95 = xs[min(int(len(xs) * 0.95), len(xs) - 1)] if xs else 0.0
+        return {"hit_rate": round(hits[0] / max(1, total[0]), 4),
+                "ttft_p95_ms": round(p95, 3), "failed": failed}
+
+    r_on = asyncio.run(run(directory=True))
+    r_off = asyncio.run(run(directory=False))
+    out.update({
+        "kvtier_prefix_hit_rate": r_on["hit_rate"],
+        "kvtier_affinity_hit_rate": r_off["hit_rate"],
+        "kvtier_ttft_p95_ms_on": r_on["ttft_p95_ms"],
+        "kvtier_ttft_p95_ms_off": r_off["ttft_p95_ms"],
+        "kvtier_ttft_p95_ratio": round(
+            r_on["ttft_p95_ms"] / max(r_off["ttft_p95_ms"], 1e-6), 4),
+    })
+    if r_on["failed"] or r_off["failed"]:
+        violations.append(f"kvtier sim dropped requests "
+                          f"(on={r_on['failed']}, off={r_off['failed']})")
+    if r_on["hit_rate"] <= r_off["hit_rate"]:
+        violations.append(
+            "prefix directory + tiers did not beat the affinity-only hit "
+            f"rate ({r_on['hit_rate']} vs {r_off['hit_rate']})")
+    if out["kvtier_ttft_p95_ratio"] > 1.0:
+        violations.append(
+            "tiering-on TTFT p95 regressed vs affinity-only "
+            f"(ratio {out['kvtier_ttft_p95_ratio']})")
+
+    # ---- leg 2: eviction storm through the real pool, tier on vs off ------
+    import numpy as np
+
+    from tpu9.models.llama import LLAMA_PRESETS
+    from tpu9.serving.engine import EngineConfig
+    from tpu9.serving.kvpool import KvPool
+    from tpu9.serving.paged_kv import PrefixCache
+    from tpu9.serving.shard import make_policy
+
+    cfg = LLAMA_PRESETS["llama-tiny"]
+    ecfg = EngineConfig(max_batch=2, max_seq_len=256,
+                        prefill_buckets=(32, 64), decode_steps=(1, 4),
+                        kv_block_size=32, kv_pool_blocks=16,
+                        prefill_chunk=32, prefix_cache_blocks=12)
+    N_PREFIXES = 10 if quick else 20
+
+    def storm(host_mb: int) -> float:
+        pool = KvPool(cfg, ecfg, False, make_policy(None),
+                      host_pool_mb=host_mb)
+        kv = pool.init_arrays()
+        inserted = []
+        for i in range(N_PREFIXES):
+            blocks = pool.alloc_blocks(2)
+            tokens = [(i * 97 + j) % 241 + 1 for j in range(2 * 32)]
+            pool.prefix_cache.insert(tokens, blocks)
+            pool.allocator.release(blocks)
+            inserted.append(PrefixCache._key(tokens))
+            if pool.allocator.free_count < 6:
+                if pool.tiered:
+                    for e in pool.prefix_cache.spill_candidates(2):
+                        pool.downpage(kv, e)
+                pool.prefix_cache.evict_for_space(4)
+        alive = sum(pool.prefix_cache.contains(k) for k in inserted)
+        return alive / N_PREFIXES
+
+    out["kvtier_storm_survival_on"] = round(storm(64), 4)
+    out["kvtier_storm_survival_off"] = round(storm(0), 4)
+    if out["kvtier_storm_survival_on"] <= out["kvtier_storm_survival_off"]:
+        violations.append(
+            "host tier did not improve eviction-storm prefix survival "
+            f"({out['kvtier_storm_survival_on']} vs "
+            f"{out['kvtier_storm_survival_off']})")
+
+    # one timed down/up-page cycle prices the paging path (bit-exactness
+    # is the test suite's job; the bench reports the device-sync cost)
+    pool = KvPool(cfg, ecfg, False, make_policy(None), host_pool_mb=64)
+    kv = pool.init_arrays()
+    blocks = pool.alloc_blocks(3)
+    tokens = [(j * 7) % 211 + 1 for j in range(3 * 32)]
+    pool.prefix_cache.insert(tokens, blocks)
+    pool.allocator.release(blocks)
+    entry = pool.prefix_cache._entries[PrefixCache._key(tokens)]
+    t0 = time.perf_counter()
+    ok_down = pool.downpage(kv, entry)
+    t_down = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    planes = pool.uppage_planes(entry)
+    kv = pool.complete_uppage(kv, entry, planes)
+    np.asarray(kv[pool.wire_names()[0]])  # land the scatter
+    t_up = time.perf_counter() - t0
+    if not ok_down:
+        violations.append("kvtier pricing cycle failed to down-page")
+    out["kvtier_downpage_ms"] = round(t_down * 1000, 3)
+    out["kvtier_uppage_ms"] = round(t_up * 1000, 3)
+
+    out["violations"] = violations
+    out["valid"] = not violations
+    return out
+
+
+# ---------------------------------------------------------------------------
 # phase: speculative decoding (ISSUE 5) — tokens/sec spec-on vs spec-off
 # through the REAL serving engine on two workloads: repetitive/code-like
 # generations (prompt-lookup drafts must WIN) and random-token prompts
@@ -2960,6 +3237,25 @@ def bench_obs(quick: bool = False) -> dict:
         fresh = _min_time_us(one_fresh, iters, reps)
         return rec, max(fresh - rec, 0.0)
 
+    def microbench_kvtier(eng) -> tuple[float, float, float]:
+        """(per-window quota check, per-tier-event journal append,
+        per-beat digest) cost in µs for the KV tiering plane (ISSUE 20).
+        The quota check rides EVERY window boundary — tiered or not;
+        the decision-journal append is bounded at the down-page quota
+        (2 per boundary worst case); the top-48 digest is heartbeat-
+        cadence host work."""
+        import collections as _collections
+        iters, reps = (400, 3) if quick else (1500, 5)
+        quota = _min_time_us(eng.scheduler.downpage_quota, iters, reps)
+        journal = _collections.deque(maxlen=256)
+        rec_d = {"decision": "spill", "chosen": "host:deadbeefdeadbeef",
+                 "signals": {"n_tokens": 64.0, "free_blocks": 3.0,
+                             "downpage_s": 0.002}}
+        append = _min_time_us(lambda: journal.append(dict(rec_d)),
+                              iters, reps)
+        digest = _min_time_us(eng.kvtier_digest, iters, reps)
+        return quota, append, digest
+
     async def run() -> dict:
         res: dict = {}
         off, on = build(False), build(True)
@@ -3070,6 +3366,20 @@ def bench_obs(quick: bool = False) -> dict:
         res["obs_decision_record_us"] = round(dec_rec_us, 3)
         res["obs_decision_evict_us"] = round(dec_evict_us, 3)
         res["obs_decision_frac"] = round(dec_frac, 6)
+        # KV tiering (ISSUE 20): the down-page quota check rides every
+        # window boundary; journal appends are bounded at the quota (2
+        # per boundary); the heartbeat digest is per-beat host work —
+        # all priced against the same ≤2% serve-time budget (the paging
+        # gathers themselves are window-boundary device syncs, priced
+        # as wall time by bench.py --phase kvtier, not serve-loop hooks)
+        kvt_quota_us, kvt_journal_us, kvt_digest_us = microbench_kvtier(on)
+        kvt_frac = ((kvt_quota_us + 2.0 * kvt_journal_us) * windows_ps
+                    + kvt_digest_us / 2.0) / 1e6
+        frac += kvt_frac
+        res["obs_kvtier_quota_us"] = round(kvt_quota_us, 3)
+        res["obs_kvtier_journal_us"] = round(kvt_journal_us, 3)
+        res["obs_kvtier_digest_us"] = round(kvt_digest_us, 3)
+        res["obs_kvtier_frac"] = round(kvt_frac, 6)
         if dec_rec_us > 8.0:
             violations.append(
                 f"obs: decision ledger record costs {dec_rec_us:.1f}µs"
@@ -3373,7 +3683,7 @@ def _run_phase(phase: str, quick: bool, cpu: bool) -> dict:
     if quick:
         cmd.append("--quick")
     if cpu or phase in ("router", "spec", "quant", "obs", "multichip",
-                        "faults", "disagg", "scaleout") \
+                        "faults", "disagg", "scaleout", "kvtier") \
             or (phase.startswith("coldstart") and phase != "coldstart_jax_tpu"):
         # the serving stack and its runner children must never dial the chip
         # — ALL cold-start stack phases, not just the original one (round-3
@@ -3654,6 +3964,19 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
                         "disagg_longdoc_ttft_improvement",
                         "disagg_shortchat_ttft_ratio",
                         "disagg_long_on_prefill_frac")),
+            # KV tiering + prefix directory (ISSUE 20): a violation (a
+            # hit rate not strictly above the affinity baseline, a TTFT
+            # p95 regression, a storm the host tier did not soften, or
+            # any dropped request) strips every headline — bench_guard
+            # HARD-fails the vanished kvtier_prefix_hit_rate
+            ("kvtier", ("kvtier_prefix_hit_rate",
+                        "kvtier_affinity_hit_rate",
+                        "kvtier_ttft_p95_ms_on",
+                        "kvtier_ttft_p95_ms_off",
+                        "kvtier_ttft_p95_ratio",
+                        "kvtier_storm_survival_on",
+                        "kvtier_storm_survival_off",
+                        "kvtier_downpage_ms", "kvtier_uppage_ms")),
             # scale-out plane (ISSUE 17): a violation (linear source
             # bytes, a failed chaos restore, or an execute-while-scaling
             # leg that never admitted early) strips every headline —
@@ -3713,7 +4036,12 @@ def orchestrate(quick: bool, cpu: bool) -> dict:
                      # on admission/placement/failover, priced at its
                      # measured request rate inside the same budget
                      "obs_decision_record_us", "obs_decision_evict_us",
-                     "obs_decision_frac")),
+                     "obs_decision_frac",
+                     # KV tiering (ISSUE 20): quota check + decision
+                     # journal + heartbeat digest, priced at window/
+                     # beat rates inside the same budget
+                     "obs_kvtier_quota_us", "obs_kvtier_journal_us",
+                     "obs_kvtier_digest_us", "obs_kvtier_frac")),
             ("coldstart", ("cold_start_p50_s",)),
             ("coldstart_native", ("cold_start_native_p50_s",
                                   "cold_start_native_pull_p50_s")),
@@ -3897,7 +4225,7 @@ def main() -> None:
                              "coldstart_native", "coldstart_jax",
                              "coldstart_jax_tpu", "coldstart_stream",
                              "router", "spec", "quant", "obs", "multichip",
-                             "faults", "disagg", "scaleout"],
+                             "faults", "disagg", "scaleout", "kvtier"],
                     help="run one phase in-process (used by the orchestrator)")
     args = ap.parse_args()
 
@@ -3924,7 +4252,8 @@ def main() -> None:
               "quant": bench_quant, "obs": bench_obs,
               "multichip": bench_multichip,
               "faults": bench_faults, "disagg": bench_disagg,
-              "scaleout": bench_scaleout}[args.phase]
+              "scaleout": bench_scaleout,
+              "kvtier": bench_kvtier}[args.phase]
         try:
             print(json.dumps(fn(quick=args.quick)))
         except Exception as exc:   # noqa: BLE001 — phase errors are data

@@ -117,6 +117,15 @@ GUARDED_FIELDS = {
     # everyone-reads-source, so it is HARD below).
     "scaleout_bringup_ratio": "down",
     "scaleout_source_bytes_ratio": "down",
+    # KV tiering + prefix directory (ISSUE 20): the directory+tier hit
+    # rate must stay strictly above the affinity-only baseline (the
+    # phase strips every kvtier field when it is not — HARD below), and
+    # the modeled TTFT p95 ratio of tiering-on vs affinity-only must not
+    # creep back toward parity. Storm survival is gated inside the phase
+    # (on > off is binary); the paging µs fields ride unguarded like the
+    # other per-hook prices — host-to-host µs noise is not a regression.
+    "kvtier_prefix_hit_rate": "up",
+    "kvtier_ttft_p95_ratio": "down",
 }
 
 # HARD-gated fields: the quant phase's oracle-margin parity judge and the
@@ -148,7 +157,13 @@ HARD_FIELDS = ("quant_shard_bytes_ratio", "quant_kv_capacity_ratio",
                # any restore failed under the chaos leg, or the
                # execute-while-scaling leg never admitted early — a
                # vanished value IS the scale-out regression
-               "scaleout_source_bytes_ratio")
+               "scaleout_source_bytes_ratio",
+               # the kvtier phase strips its fields when the directory+
+               # tier hit rate fails to beat the affinity-only baseline,
+               # the TTFT p95 ratio regresses, the eviction storm shows
+               # no survival win, or any sim request dropped — a
+               # vanished value IS the tiering regression
+               "kvtier_prefix_hit_rate")
 
 
 def extract_metrics(path: str) -> dict:

@@ -65,7 +65,7 @@ def test_record_schema_and_counter():
 
 def test_plane_inventory_is_closed():
     assert PLANES == ("admission", "placement", "failover", "migration",
-                      "autoscaler")
+                      "autoscaler", "kv_tier")
 
 
 def test_global_ring_is_bounded():

@@ -1112,6 +1112,34 @@ def _render_top(metrics_data: dict, slo_data: dict,
             f"{_f('graph_compiles_post_warmup'):>11.0f}"
             f"{_f('age_s'):>6.1f}s  {tail}")
 
+    # KV tiering plane (ISSUE 20): only rendered when some replica runs a
+    # host tier, so an untiered fleet's frame is unchanged
+    tiered = {cid: snap for cid, snap in engines.items()
+              if "kvtier_host_bytes" in snap
+              or "kvtier_downpages" in snap}
+    if tiered:
+        lines.append("")
+        lines.append("KV TIERS (occupancy / paging / prefix hits by tier)")
+        lines.append(f"  {'replica':<14}{'dev MB':>8}{'host MB':>9}"
+                     f"{'down':>7}{'up':>5}{'spill':>7}"
+                     f"{'hit d/h':>10}{'up p95':>9}")
+        for cid, snap in sorted(tiered.items()):
+            def _f(key, default=0.0):
+                try:
+                    return float(snap.get(key, default))
+                except (TypeError, ValueError):
+                    return default
+            lines.append(
+                f"  {cid[:13]:<14}"
+                f"{_f('kvtier_device_bytes') / 1e6:>8.1f}"
+                f"{_f('kvtier_host_bytes') / 1e6:>9.1f}"
+                f"{_f('kvtier_downpages'):>7.0f}"
+                f"{_f('kvtier_uppages'):>5.0f}"
+                f"{_f('kvtier_peer_spills'):>7.0f}"
+                f"{_f('kvtier_hits_device'):>6.0f}/"
+                f"{_f('kvtier_hits_host'):<3.0f}"
+                f"{_f('kvtier_uppage_p95_s') * 1e3:>8.1f}ms")
+
     lines.append("")
     lines.append("SLO (burn rate: >1 on fast+slow windows = burning)")
     lines.append(f"  {'stub':<14}{'objective':<14}{'fast':>8}{'slow':>8}"

@@ -170,6 +170,10 @@ class RouterConfig:
     # prefix reuse diverge
     affinity_block_tokens: int = 16
     affinity_ttl_s: float = 300.0
+    # fleet prefix directory (ISSUE 20): fold replicas' heartbeated
+    # prefix-key digests + peer-cache residency into placement. False
+    # (or TPU9_KV_TIER=0) reverts to affinity-only routing.
+    prefix_directory: bool = True
     # graceful scale-down: how long a draining replica may finish its
     # in-flight requests before the container is stopped regardless
     drain_timeout_s: float = 10.0
@@ -528,3 +532,24 @@ def env_scaleout_partial_on() -> bool:
     """``TPU9_SCALEOUT_PARTIAL=0`` disables group-hint partial-readiness
     admission; anything else (including unset) leaves it on."""
     return os.environ.get("TPU9_SCALEOUT_PARTIAL", "") != "0"
+
+
+def env_kv_tier_on() -> bool:
+    """``TPU9_KV_TIER=0`` master-gates KV tiering OFF everywhere — the
+    engine's host tier, the runner's directory heartbeat extras and the
+    router's prefix directory (ISSUE 20). Unset/anything else leaves the
+    plane armed; it still only activates where a host pool is sized."""
+    return os.environ.get("TPU9_KV_TIER", "") != "0"
+
+
+def env_kv_host_pool_mb(default: int = 0) -> int:
+    """``TPU9_KV_HOST_POOL_MB``: host-DRAM KV tier capacity in MB (0 =
+    no host tier). Overrides ``EngineConfig.kv_host_pool_mb`` at engine
+    construction; one accessor so every plane sees one default."""
+    raw = os.environ.get("TPU9_KV_HOST_POOL_MB", "").strip()
+    if not raw:
+        return default
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return default

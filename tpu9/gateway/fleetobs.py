@@ -65,7 +65,18 @@ ENGINE_SERIES = ("tokens_per_sec", "token_pressure", "queued",
                  # per-group weight readiness — the router's admission
                  # fence and `tpu9 scaleout`'s readiness fraction
                  "scaleout_groups_total", "scaleout_groups_ready",
-                 "scaleout_ready_frac")
+                 "scaleout_ready_frac",
+                 # KV tiering plane (ISSUE 20): tier occupancy + paging
+                 # traffic — `tpu9 top`'s KV-tier columns and the
+                 # hit-rate-by-tier split
+                 "kvtier_device_blocks", "kvtier_device_bytes",
+                 "kvtier_host_blocks", "kvtier_host_bytes",
+                 "kvtier_host_entries", "kvtier_host_evictions",
+                 "kvtier_downpages", "kvtier_uppages",
+                 "kvtier_uppage_failures", "kvtier_peer_spills",
+                 "kvtier_hits_device", "kvtier_hits_host",
+                 "kvtier_downpage_p50_s", "kvtier_downpage_p95_s",
+                 "kvtier_uppage_p50_s", "kvtier_uppage_p95_s")
 # router snapshot fields mirrored into per-stub timeline series
 ROUTER_SERIES = ("queue_depth", "shed_rate", "pressure")
 # worker-heartbeated cache-plane counters mirrored 1:1 into per-worker
@@ -164,6 +175,17 @@ class FleetObserver:
         if any(k.startswith("kvwire_") for k in stats):
             from ..observability.health import publish_kvwire
             publish_kvwire(container_id, stats)
+        # KV tiering gauges (ISSUE 20): only replicas running a host tier
+        # emit kvtier_* scalars, so an untiered fleet mints zero series
+        if any(k.startswith("kvtier_") for k in stats):
+            from ..observability.health import publish_kvtier
+            publish_kvtier(container_id, stats)
+            # the directory fold also rides the observer path so
+            # heartbeats reach it even between dispatches (the dispatch
+            # path re-folds the same snapshot — observe is idempotent)
+            pdir = getattr(self.fleet_router, "prefix_dir", None)
+            if pdir is not None:
+                pdir.observe_replica(container_id, stats)
         # scale-out plane (ISSUE 17): per-group readiness → coordinator
         # ledger (serving-plane truth for the report + admission fence),
         # measured bring-up → router signals (the predictive controller's

@@ -2427,6 +2427,19 @@ class Gateway:
                      else rcfg.kv_ship_enabled)):
             body = json.dumps({**llm["payload"], "kv_export": True,
                                "stream": True}).encode()
+        # prefix-directory peer adopt (ISSUE 20): when the directory says
+        # this body's longest prefix lives ONLY in the peer cache (its
+        # last serving replica is gone — scale-to-zero, death), hand the
+        # chosen replica the adopt hint so it pulls the tier instead of
+        # recomputing. Reuses the ISSUE 15 adopt_kv splice path verbatim;
+        # the hint is advisory — a lost peer entry degrades to prefill.
+        if (llm is not None and not llm["payload"].get("adopt_kv")
+                and self.fleet_router is not None):
+            adopt = self.fleet_router.kv_adopt_hint(body)
+            if adopt is not None:
+                payload = json.loads(body)
+                payload["adopt_kv"] = adopt
+                body = json.dumps(payload).encode()
         budget = sv.FailoverBudget(
             rcfg.failover_max_attempts
             if (resume is not None

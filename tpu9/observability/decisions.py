@@ -43,7 +43,8 @@ from .metrics import metrics
 
 # the plane inventory — one slug per decision site family; wirecheck's
 # WIR002 assertion for tpu9_decision_records_total enumerates these
-PLANES = ("admission", "placement", "failover", "migration", "autoscaler")
+PLANES = ("admission", "placement", "failover", "migration", "autoscaler",
+          "kv_tier")
 
 
 def rej(alternative: str, reason: str) -> dict:

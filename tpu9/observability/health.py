@@ -208,18 +208,37 @@ _KVWIRE_GAUGES = (
     ("tpu9_kvwire_import_fallbacks", "kvwire_import_fallbacks"),
     ("tpu9_kvwire_ship_p50_s", "kvwire_ship_p50_s"),
     ("tpu9_kvwire_ship_p95_s", "kvwire_ship_p95_s"))
+# KV tiering plane (ISSUE 20): occupancy + paging traffic per replica —
+# gauge name ↔ heartbeat scalar, same lifecycle as the kvwire set
+_KVTIER_GAUGES = (
+    ("tpu9_kvtier_device_blocks", "kvtier_device_blocks"),
+    ("tpu9_kvtier_device_bytes", "kvtier_device_bytes"),
+    ("tpu9_kvtier_host_blocks", "kvtier_host_blocks"),
+    ("tpu9_kvtier_host_bytes", "kvtier_host_bytes"),
+    ("tpu9_kvtier_host_entries", "kvtier_host_entries"),
+    ("tpu9_kvtier_host_evictions", "kvtier_host_evictions"),
+    ("tpu9_kvtier_downpages", "kvtier_downpages"),
+    ("tpu9_kvtier_uppages", "kvtier_uppages"),
+    ("tpu9_kvtier_uppage_failures", "kvtier_uppage_failures"),
+    ("tpu9_kvtier_peer_spills", "kvtier_peer_spills"),
+    ("tpu9_kvtier_hits_device", "kvtier_hits_device"),
+    ("tpu9_kvtier_hits_host", "kvtier_hits_host"),
+    ("tpu9_kvtier_downpage_p95_s", "kvtier_downpage_p95_s"),
+    ("tpu9_kvtier_uppage_p95_s", "kvtier_uppage_p95_s"))
 
 
 def forget_replica(container_id: str) -> None:
-    """Drop a dead replica's health/HBM/kvwire gauges (called when the
-    fleet observer ages it out of the engines merge): its last verdict —
-    typically ``stalled`` — must not keep alerting for a container that
-    no longer exists, and under scale-to-zero churn container ids are
-    unbounded, so leaked series grow monotonically."""
+    """Drop a dead replica's health/HBM/kvwire/kvtier gauges (called when
+    the fleet observer ages it out of the engines merge): its last
+    verdict — typically ``stalled`` — must not keep alerting for a
+    container that no longer exists, and under scale-to-zero churn
+    container ids are unbounded, so leaked series grow monotonically."""
     labels = {"replica": container_id}
     for gauge in _REPLICA_GAUGES:
         metrics.remove_gauge(gauge, labels=labels)
     for gauge, _key in _KVWIRE_GAUGES:
+        metrics.remove_gauge(gauge, labels=labels)
+    for gauge, _key in _KVTIER_GAUGES:
         metrics.remove_gauge(gauge, labels=labels)
 
 
@@ -230,6 +249,18 @@ def publish_kvwire(container_id: str, stats: dict) -> None:
     label lifecycle as the health gauges (forget_replica drops them)."""
     labels = {"replica": container_id}
     for gauge, key in _KVWIRE_GAUGES:
+        if key in stats:
+            metrics.set_gauge(gauge, _num(stats, key), labels=labels)
+
+
+def publish_kvtier(container_id: str, stats: dict) -> None:
+    """``tpu9_kvtier_*`` gauges for one replica heartbeat (ISSUE 20):
+    tier occupancy (device/host bytes + blocks), up/down-page counters
+    and latency percentiles, prefix hits split by serving tier. Same
+    replica-label lifecycle as the kvwire set (forget_replica drops
+    them)."""
+    labels = {"replica": container_id}
+    for gauge, key in _KVTIER_GAUGES:
         if key in stats:
             metrics.set_gauge(gauge, _num(stats, key), labels=labels)
 

@@ -46,6 +46,7 @@ from ..types import ContainerStatus, Stub
 from .admission import AdmissionController, ReplicaBudgets
 from .affinity import AffinityRouter
 from .fairness import QueuedRequest, TenantFairQueue, estimate_cost
+from .prefixdir import PrefixDirectory
 from .signals import RouterSignals
 
 log = logging.getLogger("tpu9.router")
@@ -114,6 +115,14 @@ class FleetRouter:
         self.backend = backend
         self.affinity = AffinityRouter(block_tokens=cfg.affinity_block_tokens,
                                        ttl_s=cfg.affinity_ttl_s)
+        # prefix directory (ISSUE 20): evidence-based placement layered
+        # over the affinity guess. None when disabled — every use site
+        # guards, so TPU9_KV_TIER=0 routes bit-identically to today.
+        from ..config import env_kv_tier_on
+        self.prefix_dir: Optional[PrefixDirectory] = None
+        if getattr(cfg, "prefix_directory", True) and env_kv_tier_on():
+            self.prefix_dir = PrefixDirectory(
+                block_tokens=cfg.affinity_block_tokens)
         self.budgets = ReplicaBudgets(
             default_inflight=cfg.default_replica_inflight,
             kv_tokens_per_request=cfg.kv_tokens_per_request,
@@ -202,6 +211,8 @@ class FleetRouter:
     def snapshot(self, stub_id: str) -> dict:
         out = self.signals.snapshot(stub_id)
         out["affinity"] = self.affinity.stats()
+        if self.prefix_dir is not None:
+            out["prefix_dir"] = self.prefix_dir.stats()
         return out
 
     def snapshot_all(self) -> dict:
@@ -438,6 +449,8 @@ class FleetRouter:
                 container_id, ttl_s=self.cfg.health_eject_ttl_s)
             if newly:
                 self.affinity.forget_replica(container_id)
+                if self.prefix_dir is not None:
+                    self.prefix_dir.forget_replica(container_id)
                 log.warning("replica %s health=%s (%s) — ejected "
                             "from routing", container_id,
                             state or "?", reason)
@@ -455,6 +468,8 @@ class FleetRouter:
         (`note_replica_health`), this only stops steering warm prefixes
         at a replica that just dropped one."""
         self.affinity.forget_replica(container_id)
+        if self.prefix_dir is not None:
+            self.prefix_dir.forget_replica(container_id)
 
     # -- drain -----------------------------------------------------------------
 
@@ -474,6 +489,11 @@ class FleetRouter:
         by contract (BND001: no serving/runner imports here)."""
         self.admission.mark_draining(container_id)
         self.affinity.forget_replica(container_id)
+        if self.prefix_dir is not None:
+            # residency claims die with the replica; its PEER publications
+            # survive inside the directory — that is the scale-to-zero
+            # recovery path (ISSUE 20)
+            self.prefix_dir.forget_replica(container_id)
         inflight0 = self.budgets.inflight(container_id)
         migrate_ok = migrate is not None
         if migrate is not None:
@@ -558,6 +578,10 @@ class FleetRouter:
                                                    "heartbeat_stale_s", 6.0))
         for s, stats in zip(replicas, all_stats):
             cid = s.container_id
+            if self.prefix_dir is not None and stats:
+                # directory fold rides the dispatch-path stats fetch, the
+                # same refresh cadence as every other pressure signal
+                self.prefix_dir.observe_replica(cid, stats)
             health = str(stats.get("health", "") or "") if stats else ""
             if health and health not in _ROUTABLE_HEALTH:
                 # dispatch-time defense (ISSUE 14): the heartbeat fold
@@ -605,13 +629,69 @@ class FleetRouter:
         rejected.extend(rej(cid, "saturated") for cid in saturated
                         if cid not in order)
         hit = self.affinity.hits > hits0
+        order, dir_hit = self._directory_promote(body, order, saturated)
         signals = {"candidates": len(order), "affinity_hit": hit,
                    "capacity": sum(budgets.values()),
                    "queue_depth": self.queue_depth(stub_id)}
+        if dir_hit:
+            signals["prefix_dir_tier"] = dir_hit.get("tier", "p")
+            signals["prefix_dir_tokens"] = dir_hit.get("n_tokens", 0)
         for cid, ld in load.items():
             signals[f"load.{cid}"] = ld
         return (order, budgets, signals["capacity"], hit,
                 {"rejected": rejected, "signals": signals})
+
+    def _directory_promote(self, body: bytes, order: list[str],
+                           saturated: set) -> tuple[list[str],
+                                                    Optional[dict]]:
+        """Directory-informed placement (ISSUE 20): when the prefix
+        directory knows a replica that holds this request's longest
+        prefix — from any tier — move it to the head of the candidate
+        order. Runs AFTER the scale-out fence and the disagg bias, so a
+        directory hit can only promote a replica that already survived
+        every eligibility check; a saturated or fenced claimant is left
+        where JSQ put it (placement quality must not beat availability).
+        A peer-only hit promotes nothing (any replica can pull the tier)
+        but still returns the hit so the adopt path and the ledger see
+        it. Every promotion leaves a ``kv_tier`` "place" record: the
+        'why' evidence for steering past shorter-queue replicas."""
+        if self.prefix_dir is None or not order:
+            return order, None
+        hit = self.prefix_dir.lookup(body, live=set(order))
+        if not hit:
+            return order, None
+        cid = hit.get("cid")
+        if cid and cid in order and cid not in saturated:
+            if order[0] != cid:
+                order = [cid] + [c for c in order if c != cid]
+                ledger.record(
+                    "kv_tier", "place",
+                    chosen=f"{hit['tier']}:{cid}",
+                    rejected=[rej("jsq_head", "shorter_prefix")],
+                    signals={"key": hit["key"],
+                             "tier": hit["tier"],
+                             "n_tokens": hit["n_tokens"]})
+        return order, hit
+
+    def kv_adopt_hint(self, body: bytes) -> Optional[dict]:
+        """Peer-tier adopt hint for the gateway's stream path: when the
+        directory's best residency for this body is ONLY the peer cache
+        (no live replica claims it), return the ``adopt_kv`` payload the
+        chosen replica should pull instead of recomputing the prefix —
+        the scale-to-zero / replica-death recovery path. Returns None on
+        a live-replica hit (tiers pull locally) or a miss."""
+        if self.prefix_dir is None:
+            return None
+        hit = self.prefix_dir.lookup(body)
+        if not hit or "peer_digest" not in hit:
+            return None
+        ledger.record(
+            "kv_tier", "pull",
+            chosen=f"peer:{hit['key']}",
+            rejected=[rej("recompute", "peer_copy_resident")],
+            signals={"key": hit["key"], "digest": hit["peer_digest"],
+                     "n_tokens": hit["n_tokens"]})
+        return {"key": hit["peer_digest"], "n_tokens": hit["n_tokens"]}
 
     @staticmethod
     def _scaleout_admit(body: bytes, order: list[str],

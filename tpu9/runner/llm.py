@@ -30,7 +30,8 @@ import aiohttp
 from aiohttp import web
 
 from ..config import (env_bind_host, env_checkpoint_enabled,
-                      env_faults_spec, env_gateway_url, env_token)
+                      env_faults_spec, env_gateway_url, env_kv_tier_on,
+                      env_token)
 from .common import FunctionHandler, RunnerConfig, error_payload
 
 log = logging.getLogger("tpu9.runner")
@@ -570,6 +571,15 @@ async def amain() -> None:
         # decision-record ship cursor (ISSUE 19): seq-keyed, same
         # retry-don't-drop contract — a rejected beat re-ships the window
         last_dec_ship = 0
+        # kv-tier delta cursor (ISSUE 20): the eviction/spill journal
+        # ships as a heartbeat delta and the cursor only advances on an
+        # ACCEPTED beat — a gateway blip re-ships the same retractions
+        # instead of leaving the directory believing a prefix survived
+        last_tier_delta = 0
+        kvtier_hb = env_kv_tier_on()
+        # peer-cache publications this replica made ((key_hex16, digest,
+        # n_tokens)) — re-advertised each beat, bounded
+        peer_pub: list = []
         from ..observability.trace import RING_CAP, tracer
         # replica health plane (ISSUE 14): the watchdog classifies the
         # engine's liveness watermark each beat and the verdict rides the
@@ -667,6 +677,54 @@ async def amain() -> None:
                     for k, v in stats.items():
                         if k.startswith("kvwire_"):
                             extra[k] = v
+                    # kv tiering (ISSUE 20): occupancy/paging counters
+                    # (same one-startswith-loop contract as kvwire_*),
+                    # then the directory summaries: a bounded top-K
+                    # prefix-key digest, the eviction-delta retractions,
+                    # and this replica's peer-cache publications — never
+                    # full key lists
+                    for k, v in stats.items():
+                        if k.startswith("kvtier_"):
+                            extra[k] = v
+                    tier_hi = last_tier_delta
+                    if kvtier_hb and state["engine"] is not None:
+                        # serving-plane kv_tier choices (spill scoring,
+                        # up-page pulls, lost-copy recomputes) arrive as
+                        # plain journal dicts; the RUNNER records them —
+                        # the serving plane must not import the ledger
+                        # (BND001), same flow as spans/health verdicts
+                        for d in state["engine"].drain_kvtier_decisions():
+                            decision_ledger.record(
+                                "kv_tier", d.pop("decision", "spill"), **d)
+                        if kv_client is not None:
+                            for khex, payload, n_tok in \
+                                    state["engine"].drain_kv_spills():
+                                try:
+                                    t0m = _now.monotonic()
+                                    digest = await kv_client.put_kv(
+                                        payload)
+                                    state["engine"].note_kvwire_ship(
+                                        _now.monotonic() - t0m)
+                                    peer_pub.append(
+                                        (khex, digest, n_tok))
+                                except Exception as exc:  # noqa: BLE001
+                                    log.warning(
+                                        "kv tier peer spill failed: %s",
+                                        exc)
+                            del peer_pub[:-32]
+                        digest_s = state["engine"].kvtier_digest()
+                        if digest_s:
+                            extra["kvtier_keys"] = digest_s
+                        deltas, tier_hi = state["engine"].kvtier_deltas(
+                            last_tier_delta)
+                        lost = [hx for kind, hx in deltas
+                                if kind in ("evict", "peer")]
+                        if lost:
+                            extra["kvtier_evicted"] = ",".join(lost)
+                        if peer_pub:
+                            extra["kvtier_peer"] = ",".join(
+                                f"{hx}:{dig}:{nt}"
+                                for hx, dig, nt in peer_pub)
                     # scale-out readiness (ISSUE 17): per-group bind
                     # progress of a streaming restore — the router's
                     # partial-readiness admission reads these off the
@@ -766,6 +824,7 @@ async def amain() -> None:
                             rejected_logged = False
                             last_span_ship = ship_hi
                             last_dec_ship = dec_hi
+                            last_tier_delta = tier_hi
                     # black-box ship AFTER the heartbeat, in its own
                     # error scope: the heartbeat is what keeps this
                     # replica visible to the fleet — a persistently

@@ -133,6 +133,12 @@ class EngineConfig:
     # stream turns repetitive — blind probe windows would only burn
     # verify compute re-learning what the shadows already measured
     spec_probe_every: int = 0
+    # ---- KV tiering (ISSUE 20) ----
+    # host-DRAM second tier for the paged KV pool, in MB; 0 disables
+    # tiering entirely (the pool is bit-identical to the untiered one).
+    # TPU9_KV_HOST_POOL_MB overrides at engine construction, and the
+    # TPU9_KV_TIER master gate can force tiering off regardless.
+    kv_host_pool_mb: int = 0
     # ---- observability (ISSUE 8) ----
     # flight-recorder ring capacity, in records (one per dispatched window
     # or admission — never per token). 0 disables the recorder entirely;
@@ -278,7 +284,15 @@ class InferenceEngine:
             # split-off KV-pool manager (serving.kvpool). The aliases
             # below are the SAME objects, kept so the admission/retire
             # paths (and tests/bench) read the state where it always was.
-            self.pool = KvPool(cfg, engine_cfg, self.kv_quant, policy)
+            # host-DRAM tier (ISSUE 20): EngineConfig field, env
+            # override, master gate — all resolved here so 0 MB keeps
+            # the pool bit-identical to the untiered build
+            from ..config import env_kv_host_pool_mb, env_kv_tier_on
+            host_mb = env_kv_host_pool_mb(engine_cfg.kv_host_pool_mb)
+            if not env_kv_tier_on() or engine_cfg.prefix_cache_blocks <= 0:
+                host_mb = 0
+            self.pool = KvPool(cfg, engine_cfg, self.kv_quant, policy,
+                               host_pool_mb=host_mb)
             self.kv_cache = self.pool.init_arrays()
             self.allocator = self.pool.allocator
             self.prefix_cache = self.pool.prefix_cache
@@ -315,6 +329,10 @@ class InferenceEngine:
         # keeps it empty (shared so failure fan-out/cancel need no mode
         # branches)
         self._wait_room: list[_Request] = []
+        # host-tier up-pages in flight, keyed by prefix key: concurrent
+        # admissions hitting the same host entry await the first up-page
+        # instead of double-filling fresh blocks (ISSUE 20)
+        self._uppage_inflight: dict = {}
         # the compiled-graph cache lives in the factory; alias for the
         # bench/diagnostic surface that predates the split
         self._compiled = self.graphs.compiled
@@ -349,7 +367,12 @@ class InferenceEngine:
                        "kvwire_blocks_imported": 0,
                        "kvwire_bytes_imported": 0,
                        "kvwire_import_hits": 0,
-                       "kvwire_import_fallbacks": 0}
+                       "kvwire_import_fallbacks": 0,
+                       # kv tiering (ISSUE 20): paging + recompute
+                       # accounting, flat for the heartbeat like kvwire
+                       "kvtier_downpages": 0, "kvtier_uppages": 0,
+                       "kvtier_uppage_failures": 0,
+                       "kvtier_peer_spills": 0}
         # ---- observability (ISSUE 8) ----
         # flight recorder: bounded per-window ring (None = disabled)
         self.flight = flight_maybe(engine_cfg.flight_cap)
@@ -958,6 +981,11 @@ class InferenceEngine:
 
     def stats(self) -> dict:
         out = dict(self._stats)
+        if not self.paged or not self.pool.tiered:
+            # untiered stats surface is byte-identical to pre-tiering:
+            # no kvtier_ family for the heartbeat/directory to chew on
+            for k in [k for k in out if k.startswith("kvtier_")]:
+                del out[k]
         out["active_streams"] = int(self.active.sum())
         out["queued"] = self._queue.qsize()
         out["engine_dead"] = self._dead_reason is not None
@@ -1085,6 +1113,26 @@ class InferenceEngine:
             if snap:
                 out[f"kvwire_{op}_p50_s"] = round(snap["p50"], 6)
                 out[f"kvwire_{op}_p95_s"] = round(snap["p95"], 6)
+        # kv tiering (ISSUE 20): occupancy + paging latency percentiles,
+        # flat under kvtier_* — the same one-startswith-loop heartbeat
+        # contract as kvwire_*. Only emitted when a host tier exists, so
+        # the untiered heartbeat is byte-identical to before.
+        if self.paged and self.pool.tiered:
+            ts = self.pool.tier_stats()
+            out["kvtier_device_blocks"] = ts["device_blocks"]
+            out["kvtier_device_bytes"] = ts["device_bytes"]
+            out["kvtier_host_blocks"] = ts["host_blocks"]
+            out["kvtier_host_bytes"] = ts["host_bytes"]
+            out["kvtier_host_entries"] = ts["host_entries"]
+            out["kvtier_host_evictions"] = ts["host_evictions"]
+            out["kvtier_peer_spills"] = self.pool.peer_spills
+            out["kvtier_hits_device"] = self.prefix_cache.hits_device
+            out["kvtier_hits_host"] = self.prefix_cache.hits_host
+            for op in ("downpage", "uppage"):
+                snap = summaries.get(f"tpu9_kvtier_{op}_s")
+                if snap:
+                    out[f"kvtier_{op}_p50_s"] = round(snap["p50"], 6)
+                    out[f"kvtier_{op}_p95_s"] = round(snap["p95"], 6)
         if self.paged:
             out["kv_blocks_used"] = self.allocator.used_count
             out["kv_blocks_free"] = self.allocator.free_count
@@ -1129,6 +1177,12 @@ class InferenceEngine:
 
         entry = self.prefix_cache.lookup(req.prompt) \
             if self.ecfg.prefix_cache_blocks > 0 else None
+        if entry is not None and entry.tier == "host":
+            # host-tier hit (ISSUE 20): re-place the planes through the
+            # sharding policy before the blocks can be shared. Degrades
+            # to a plain miss (full recompute) if the host copy raced a
+            # reap — never errors.
+            entry = await self._uppage_entry(entry, req.request_id)
         shared: list[int] = list(entry.blocks) if entry else []
         p = entry.n_tokens if entry else 0
         # cached prefixes land on BLOCK boundaries, chunk windows on CHUNK
@@ -1237,6 +1291,152 @@ class InferenceEngine:
         self.last_token = self.last_token.at[slot, 0].set(first)
         self._occupy_slot(req, slot)
         return first
+
+    # -- KV tiering: up-page / down-page (ISSUE 20) --------------------------
+
+    async def _uppage_entry(self, entry, request_id: str = ""):
+        """Re-place a host-tier prefix hit into fresh pool blocks through
+        the sharding policy. The entry arrives PINNED from ``lookup`` and
+        the pin holds for the whole up-page, so eviction pressure (a
+        concurrent admission's ``evict_for_space``) can never reap it
+        mid-copy. Returns the entry, device-resident and still pinned —
+        or None (pin released) when the host copy was lost to a reap:
+        the caller degrades to a plain recompute, never an error.
+
+        Concurrent admissions hitting the same host entry await the
+        first up-page instead of double-filling blocks."""
+        cache = self.prefix_cache
+        key = entry.key
+        fut = self._uppage_inflight.get(key)
+        if fut is not None:
+            cache.release_pin(entry)
+            await fut
+            ent = cache._entries.get(key)
+            if ent is None or ent.tier != "device":
+                return None                 # primary failed: recompute
+            ent.pins += 1                   # re-pin for our admission
+            cache.pinned += 1
+            return ent
+        fut = asyncio.get_running_loop().create_future()
+        self._uppage_inflight[key] = fut
+        t0 = time.perf_counter()
+        try:
+            planes = self.pool.uppage_planes(entry)
+            if planes is None:
+                # the host copy vanished between advertisement and use
+                # (the stale-directory window): recompute, never error
+                self._stats["kvtier_uppage_failures"] += 1
+                self.pool.kv_decisions.append(
+                    {"decision": "recompute", "request_id": request_id,
+                     "chosen": "recompute",
+                     "rejected": [{"alternative": f"host:{key.hex()[:16]}",
+                                   "reason": "host_copy_lost"}],
+                     "signals": {"n_tokens": entry.n_tokens}})
+                cache.release_pin(entry)
+                if entry.pins == 0:
+                    cache.drop(key, kind="evict")
+                return None
+            try:
+                self._set_pool(self.pool.complete_uppage(
+                    self._pool_dict(), entry, planes))
+            except RuntimeError:
+                # pool exhausted mid-up-page: the prefix stays on the
+                # host tier for a calmer window; this admission simply
+                # recomputes — pressure must never error a request
+                self._stats["kvtier_uppage_failures"] += 1
+                self.pool.kv_decisions.append(
+                    {"decision": "recompute", "request_id": request_id,
+                     "chosen": "recompute",
+                     "rejected": [{"alternative": f"host:{key.hex()[:16]}",
+                                   "reason": "pool_exhausted"}],
+                     "signals": {"n_tokens": entry.n_tokens}})
+                cache.release_pin(entry)
+                return None
+            # the scatter is dispatched, not synced: yield so the serve
+            # loop can run while it lands — admission's own data deps
+            # guarantee residency before the blocks are read
+            await asyncio.sleep(0)
+            dt = time.perf_counter() - t0
+            self._stats["kvtier_uppages"] += 1
+            self.metrics.observe("tpu9_kvtier_uppage_s", dt)
+            self.pool.kv_decisions.append(
+                {"decision": "pull", "request_id": request_id,
+                 "chosen": f"host:{key.hex()[:16]}",
+                 "signals": {"n_tokens": entry.n_tokens,
+                             "uppage_s": round(dt, 6)}})
+            return entry
+        except Exception:
+            cache.release_pin(entry)
+            raise
+        finally:
+            self._uppage_inflight.pop(key, None)
+            if not fut.done():
+                fut.set_result(True)
+
+    def _kvtier_tick(self) -> None:
+        """Window-boundary down-paging: when the scheduler's low-water
+        check fires, LRU unpinned prefix entries spill to host DRAM
+        *before* allocation pressure lets ``_evict_one`` destroy them.
+        Runs only at the window boundary — the gather is a device sync
+        and must never ride the per-token path."""
+        quota = self.scheduler.downpage_quota()
+        if not quota:
+            return
+        for entry in self.prefix_cache.spill_candidates(quota):
+            key_hex = entry.key.hex()[:16]
+            n_tok = entry.n_tokens
+            t0 = time.perf_counter()
+            if not self.pool.downpage(self._pool_dict(), entry):
+                continue
+            dt = time.perf_counter() - t0
+            self._stats["kvtier_downpages"] += 1
+            self.metrics.observe("tpu9_kvtier_downpage_s", dt)
+            self.pool.kv_decisions.append(
+                {"decision": "spill", "chosen": f"host:{key_hex}",
+                 "signals": {"n_tokens": n_tok,
+                             "free_blocks": self.allocator.free_count,
+                             "downpage_s": round(dt, 6)}})
+
+    # -- KV tiering: runner-facing surface (ISSUE 20) ------------------------
+    # Event-loop-synchronous like the kvwire methods: pure host state.
+
+    def kvtier_digest(self, top_k: int = 48) -> str:
+        """Bounded top-K prefix-key summary for the directory heartbeat:
+        ``hex16:tier:n_tokens`` comma-joined, MRU first — never the full
+        key list."""
+        if self.prefix_cache is None:
+            return ""
+        ents = sorted(self.prefix_cache._entries.values(),
+                      key=lambda e: -e.last_used)[:top_k]
+        return ",".join(
+            f"{e.key.hex()[:16]}:{'h' if e.tier == 'host' else 'd'}"
+            f":{e.n_tokens}" for e in ents)
+
+    def kvtier_deltas(self, since: int) -> tuple:
+        """Tier-change journal after cursor ``since`` (evictions/spills
+        the directory must retract) + the new cursor. The runner advances
+        its cursor only once a heartbeat is accepted."""
+        if self.prefix_cache is None:
+            return [], 0
+        return self.prefix_cache.deltas_since(since)
+
+    def drain_kv_spills(self) -> list:
+        """Queued peer-cache spill payloads ``(key_hex16, payload,
+        n_tokens)`` — the runner owns the transport."""
+        if self.pool is None:
+            return []
+        return self.pool.drain_peer_spills()
+
+    def drain_kvtier_decisions(self) -> list:
+        """Journaled ``kv_tier`` decision dicts (spill/pull/recompute/
+        evict choices made inside the serving plane). The runner records
+        them into the decision ledger — the one-way evidence flow BND001
+        pins (serving must not import the ledger). Destructive read."""
+        if self.pool is None or not self.pool.kv_decisions:
+            return []
+        out = list(self.pool.kv_decisions)
+        self.pool.kv_decisions.clear()
+        return out
 
     # -- observability hooks (ISSUE 8) ---------------------------------------
     # All host-side bookkeeping on state the loop already holds: monotonic
@@ -1743,6 +1943,10 @@ class InferenceEngine:
                     self._drain_windows()
                 continue
 
+            # window boundary: down-page LRU prefixes to host DRAM when
+            # the pool nears eviction pressure (ISSUE 20; no-op untiered)
+            if self.paged and self.pool.tiered:
+                self._kvtier_tick()
             # one WINDOW for the whole batch — speculative verify when the
             # acceptance EWMAs justify it, classic k-step decode otherwise
             self._profile_window_start()

@@ -16,13 +16,115 @@ via stats, not by code); this module owns their engine-side composition.
 
 from __future__ import annotations
 
-from typing import Any
+import collections
+import time
+from typing import Any, Optional
 
 import numpy as np
 
-from .paged_kv import BlockAllocator, PrefixCache, blocks_for
+from .paged_kv import (BlockAllocator, PrefixCache, blocks_for,
+                       kv_block_bytes)
 
 Params = dict[str, Any]
+
+# host-tier spill scoring (ISSUE 20): a reaped host entry whose
+# hits×recency score clears this goes to the peer cache instead of
+# dying — system prompts and chat-session heads score high, one-shot
+# prompts decay to zero and are simply dropped
+PEER_SPILL_SCORE = 1.0
+PEER_SPILL_HALF_LIFE_S = 300.0
+PEER_SPILL_QUEUE_MAX = 8
+
+
+class HostKvTier:
+    """Host-DRAM second tier for the paged KV pool (ISSUE 20).
+
+    Stores CANONICAL (full-head, topology-independent) pool planes per
+    prefix key as plain numpy — the same layout ``kvwire`` ships — so a
+    down-page is one gather off the device, an up-page is one
+    policy-placed scatter back, and a peer-tier spill is a pure host
+    ``kvwire.encode_blocks`` with zero device work. With ``kv_quant``
+    the planes are int8 (+f32 scales), so host DRAM holds ~2× the
+    prefixes the same bytes would in bf16.
+
+    Byte budget is enforced on insert: LRU entries are reaped (the pool
+    scores them for peer spill first). Pinned prefix-cache entries are
+    never reaped — the ``skip`` predicate wires that in."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = int(capacity_bytes)
+        # key -> {"planes", "n_tokens", "n_blocks", "nbytes"}
+        self._entries: "collections.OrderedDict[bytes, dict]" = \
+            collections.OrderedDict()
+        self.used_bytes = 0
+        self.inserts = 0
+        self.evictions = 0
+        self.rejected = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def get(self, key: bytes) -> Optional[dict]:
+        ent = self._entries.get(key)
+        if ent is not None:
+            self._entries.move_to_end(key)
+        return ent
+
+    def peek(self, key: bytes) -> Optional[dict]:
+        return self._entries.get(key)
+
+    def pop(self, key: bytes) -> Optional[dict]:
+        ent = self._entries.pop(key, None)
+        if ent is not None:
+            self.used_bytes -= ent["nbytes"]
+        return ent
+
+    def put(self, key: bytes, planes: dict, n_tokens: int,
+            n_blocks: int, skip=None) -> tuple[bool, list]:
+        """Insert (or refresh) an entry, reaping LRU entries to fit.
+        Returns ``(stored, reaped)`` where ``reaped`` is the list of
+        ``(key, entry)`` pairs evicted to make room — the pool scores
+        those for peer spill. ``skip(key)`` excludes unpinned-unsafe
+        entries from reaping."""
+        if key in self._entries:
+            self.pop(key)
+        nbytes = sum(int(p.nbytes) for p in planes.values())
+        if nbytes > self.capacity_bytes:
+            self.rejected += 1
+            return False, []
+        reaped: list = []
+        while self.used_bytes + nbytes > self.capacity_bytes:
+            victim = self._reap_one(skip)
+            if victim is None:
+                self.rejected += 1
+                # put back nothing; entry does not fit without touching
+                # skip-protected residents
+                return False, reaped
+            reaped.append(victim)
+        self._entries[key] = {"planes": planes, "n_tokens": int(n_tokens),
+                              "n_blocks": int(n_blocks), "nbytes": nbytes}
+        self.used_bytes += nbytes
+        self.inserts += 1
+        return True, reaped
+
+    def _reap_one(self, skip=None):
+        for key in self._entries:           # OrderedDict: LRU first
+            if skip is not None and skip(key):
+                continue
+            ent = self.pop(key)
+            self.evictions += 1
+            return key, ent
+        return None
+
+    def stats(self) -> dict:
+        return {"entries": len(self._entries),
+                "bytes": self.used_bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "inserts": self.inserts, "evictions": self.evictions,
+                "rejected": self.rejected}
 
 
 class KvPool:
@@ -30,7 +132,8 @@ class KvPool:
     :meth:`init_arrays`), the block allocator + prefix cache, and the
     per-slot physical-block state the serve loop mutates."""
 
-    def __init__(self, cfg, ecfg, kv_quant: bool, policy):
+    def __init__(self, cfg, ecfg, kv_quant: bool, policy,
+                 host_pool_mb: int = 0):
         b, s = ecfg.max_batch, ecfg.max_seq_len
         bs = ecfg.kv_block_size
         self.cfg = cfg
@@ -76,6 +179,25 @@ class KvPool:
         self.slot_reserved = [0] * b
         self.table_np = np.zeros((b, self.mb), dtype=np.int32)
         self.kv_allocs = 0           # lifetime block allocations
+        # -- host-DRAM second tier (ISSUE 20); inert at 0 MB -----------------
+        self.host_pool_mb = int(host_pool_mb)
+        self.host_tier: Optional[HostKvTier] = None
+        self.downpages = 0
+        self.uppages = 0
+        self.peer_spills = 0
+        # (key_hex, payload, n_tokens) encoded for the peer cache; the
+        # runner drains this — the serving plane never touches transport
+        self.peer_spill_queue: collections.deque = \
+            collections.deque(maxlen=PEER_SPILL_QUEUE_MAX)
+        # kv_tier decision journal (ISSUE 19/20): plain dicts the RUNNER
+        # drains into the decision ledger on its heartbeat loop — the
+        # serving plane must not import tpu9.observability.decisions
+        # (BND001), the same one-way evidence flow as spans and health
+        self.kv_decisions: collections.deque = collections.deque(maxlen=256)
+        if self.host_pool_mb > 0:
+            self.host_tier = HostKvTier(self.host_pool_mb * (1 << 20))
+            # an entry re-prefilled on-device drops its stale host copy
+            self.prefix_cache.on_host_drop = self.host_tier.pop
 
     def array_shapes(self) -> dict:
         """``name -> (shape, dtype)`` for every pool array — the ONE shape
@@ -169,8 +291,6 @@ class KvPool:
         untouched. ``adopted=False`` means the entry could not fit the
         prefix budget (blocks were released; caller falls back to
         re-prefill)."""
-        import jax.numpy as jnp
-
         from . import kvwire
         header, planes = kvwire.decode_blocks(payload)
         kvwire.check_geometry(
@@ -201,18 +321,7 @@ class KvPool:
             return kv, True, header
         blocks = self.alloc_blocks(nb)
         try:
-            idx = jnp.asarray(blocks, dtype=jnp.int32)
-            new_kv = dict(kv)
-            for name in self.wire_names():
-                arr = jnp.asarray(np.ascontiguousarray(planes[name]),
-                                  dtype=shapes[name][1])
-                new_kv[name] = new_kv[name].at[:, idx].set(arr)
-            # re-pin the resident layout: the scatter above lets GSPMD
-            # infer an output sharding; place_kv restores the declared
-            # head-axis layout (identity on one chip)
-            placed = self.policy.place_kv(
-                {n: new_kv[n] for n in self.wire_names()})
-            new_kv.update(placed)
+            new_kv = self.place_host_blocks(kv, planes, blocks)
         except Exception:
             self.allocator.release(blocks)
             raise
@@ -220,6 +329,156 @@ class KvPool:
             self.allocator.release(blocks)
             return new_kv, False, header
         return new_kv, True, header
+
+    def place_host_blocks(self, kv, planes: dict, blocks: list[int]):
+        """Splice canonical host planes into ``blocks`` of every pool
+        array and re-pin the resident layout through the sharding policy
+        (head axis over tp on a mesh; identity on one chip). Shared by
+        kvwire import and the host-tier up-page — one scatter path means
+        the MeshPolicy bit-exactness proof covers both."""
+        import jax.numpy as jnp
+        shapes = self.array_shapes()
+        idx = jnp.asarray(blocks, dtype=jnp.int32)
+        new_kv = dict(kv)
+        for name in self.wire_names():
+            arr = jnp.asarray(np.ascontiguousarray(planes[name]),
+                              dtype=shapes[name][1])
+            new_kv[name] = new_kv[name].at[:, idx].set(arr)
+        # the scatter above lets GSPMD infer an output sharding;
+        # place_kv restores the declared head-axis layout
+        placed = self.policy.place_kv(
+            {n: new_kv[n] for n in self.wire_names()})
+        new_kv.update(placed)
+        return new_kv
+
+    # -- host-DRAM tier: down-page / up-page / peer spill (ISSUE 20) ---------
+
+    @property
+    def tiered(self) -> bool:
+        return self.host_tier is not None
+
+    def downpage(self, kv, entry) -> bool:
+        """Move one unpinned device prefix entry to the host tier:
+        gather its blocks' canonical planes to host DRAM, release the
+        pool blocks, keep the entry alive under ``tier="host"``. Called
+        at window boundaries only — the gather is a device sync and must
+        never sit on the per-token path. False = the host tier could not
+        fit it (the caller lets ``_evict_one`` destroy it as before)."""
+        if self.host_tier is None or entry.pins or not entry.blocks:
+            return False
+        idx = np.asarray(entry.blocks, dtype=np.int32)  # tpu9: noqa[JAX001] host-side block-index list, no device value involved
+        planes = {
+            name: np.asarray(self.policy.gather_kv(name, kv[name])[:, idx])  # tpu9: noqa[JAX001] intended sync point: window-boundary down-page gather (same class as the drain's batched device_get)
+            for name in self.wire_names()}
+        stored, reaped = self.host_tier.put(
+            entry.key, planes, entry.n_tokens, len(entry.blocks),
+            skip=self._host_pin_guard)
+        self._reap_to_peer(reaped)
+        if not stored:
+            return False
+        self.prefix_cache.spill_to_host(entry)
+        self.downpages += 1
+        return True
+
+    def _host_pin_guard(self, key: bytes) -> bool:
+        """Host-tier reap skip predicate: a pinned host entry has an
+        up-page in flight — its planes must not vanish mid-copy."""
+        ent = self.prefix_cache._entries.get(key)
+        return ent is not None and ent.pins > 0
+
+    def uppage_planes(self, entry) -> Optional[dict]:
+        """The host planes backing a host-tier entry (None = lost a race
+        with a host reap; caller degrades to recompute)."""
+        if self.host_tier is None:
+            return None
+        ent = self.host_tier.get(entry.key)
+        return None if ent is None else ent["planes"]
+
+    def complete_uppage(self, kv, entry, planes: dict):
+        """Finish an up-page: scatter the planes into freshly-allocated
+        blocks via the sharding policy and promote the entry back to
+        device residency. Returns the rebound ``kv``. The entry must be
+        PINNED by the caller for the whole up-page (lookup pins it)."""
+        blocks = self.alloc_blocks(len(entry.blocks) or
+                                   blocks_for(entry.n_tokens,
+                                              self.ecfg.kv_block_size))
+        try:
+            new_kv = self.place_host_blocks(kv, planes, blocks)
+        except Exception:
+            self.allocator.release(blocks)
+            raise
+        self.prefix_cache.promote_to_device(entry, blocks)
+        if self.host_tier is not None:
+            self.host_tier.pop(entry.key)
+        self.uppages += 1
+        return new_kv
+
+    def _reap_to_peer(self, reaped: list) -> None:
+        """Score host-tier reap victims on the hits×recency clock;
+        winners serialize through kvwire onto the peer-spill queue (the
+        runner ships them under the ``kv:`` namespace), losers die and
+        their prefix-cache entries are journaled as evicted. Either way
+        the choice leaves a ``kv_tier`` decision record."""
+        from . import kvwire
+        now = time.monotonic()
+        for key, ent in reaped:
+            pe = self.prefix_cache._entries.get(key)
+            score = 0.0
+            if pe is not None:
+                age = max(0.0, now - pe.last_used)
+                score = pe.hits * 0.5 ** (age / PEER_SPILL_HALF_LIFE_S)
+            if score >= PEER_SPILL_SCORE:
+                meta = kvwire.geometry(self.cfg, self.ecfg, self.kv_quant)
+                meta.update({"n_blocks": ent["n_blocks"],
+                             "n_tokens": ent["n_tokens"],
+                             "prefix_key": key.hex(),
+                             "topology": self.policy.describe()})
+                payload = kvwire.encode_blocks(meta, ent["planes"])
+                self.peer_spill_queue.append(
+                    (key.hex()[:16], payload, ent["n_tokens"]))
+                self.peer_spills += 1
+                self.prefix_cache.drop(key, kind="peer")
+                self.kv_decisions.append(
+                    {"decision": "spill",
+                     "chosen": f"peer:{key.hex()[:16]}",
+                     "signals": {"score": round(score, 4),
+                                 "n_tokens": ent["n_tokens"]}})
+            else:
+                self.prefix_cache.drop(key, kind="evict")
+                self.kv_decisions.append(
+                    {"decision": "evict", "chosen": "drop",
+                     "rejected": [{"alternative": f"peer:{key.hex()[:16]}",
+                                   "reason": "score_below_spill_threshold"}],
+                     "signals": {"score": round(score, 4),
+                                 "n_tokens": ent["n_tokens"]}})
+
+    def drain_peer_spills(self) -> list:
+        """Hand the queued peer-cache payloads to the transport owner
+        (the runner). Destructive read; bounded by the deque cap."""
+        out = list(self.peer_spill_queue)
+        self.peer_spill_queue.clear()
+        return out
+
+    def tier_stats(self) -> dict:
+        """Flat occupancy/counter snapshot for the ``kvtier_`` stats
+        family (bytes price the DEVICE pool dtype for the device side
+        and actual numpy bytes for the host side)."""
+        bb = kv_block_bytes(self.cfg, self.ecfg.kv_block_size,
+                            self.kv_quant)
+        held = self.prefix_cache.held_blocks
+        out = {"device_blocks": held, "device_bytes": held * bb,
+               "downpages": self.downpages, "uppages": self.uppages,
+               "peer_spills": self.peer_spills,
+               "host_blocks": 0, "host_bytes": 0, "host_entries": 0,
+               "host_evictions": 0}
+        if self.host_tier is not None:
+            hs = self.host_tier.stats()
+            out.update({
+                "host_bytes": hs["bytes"], "host_entries": hs["entries"],
+                "host_blocks": sum(e["n_blocks"] for e in
+                                   self.host_tier._entries.values()),
+                "host_evictions": hs["evictions"]})
+        return out
 
     # -- the host block table ------------------------------------------------
 

@@ -20,6 +20,7 @@ while allocated memory tracks actual live tokens.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import time
 from dataclasses import dataclass, field
@@ -54,6 +55,13 @@ class PrefixEntry:
     # admissions holding this entry between lookup() and retaining its
     # blocks: eviction must not release blocks out from under them
     pins: int = 0
+    # which tier physically holds the KV: "device" (blocks index the HBM
+    # pool) or "host" (blocks is empty; planes live in the pool's
+    # HostKvTier until an up-page re-places them) — ISSUE 20
+    tier: str = "device"
+    # lifetime lookup hits; with last_used this is the hits×recency
+    # clock the host tier scores peer-spill candidates by
+    hits: int = 0
 
 
 class BlockAllocator:
@@ -133,6 +141,32 @@ class PrefixCache:
         self.evictions = 0      # lifetime counter (flight-recorder deltas)
         self.pinned = 0         # live lookup pins (O(1), not an entry scan)
         self.adopted = 0        # entries imported off the wire (ISSUE 16)
+        self.spills = 0         # device→host down-pages (prefix survives)
+        self.hits_device = 0    # lookup hits split by serving tier
+        self.hits_host = 0
+        # tier-change journal for the directory (ISSUE 20 satellite):
+        # every eviction/spill appends (seq, kind, key-hex16) so the next
+        # heartbeat ships a delta — without it, an entry evicted between
+        # two advertisements leaves the fleet believing the prefix is
+        # resident. Bounded; consumers that fall behind resync from the
+        # full digest summary instead.
+        self._delta_seq = 0
+        self._deltas: collections.deque = collections.deque(maxlen=512)
+        # set by KvPool when host tiering is on: called with the entry
+        # key when a host-tier copy must be discarded (entry upgraded
+        # back to device residency, or destroyed)
+        self.on_host_drop = None
+
+    def _note_delta(self, kind: str, key: bytes) -> None:
+        self._delta_seq += 1
+        self._deltas.append((self._delta_seq, kind, key.hex()[:16]))
+
+    def deltas_since(self, seq: int) -> tuple[list[tuple[str, str]], int]:
+        """Tier-change events after journal position ``seq`` (oldest
+        first) plus the new cursor. The caller advances its cursor only
+        once the delta is known-delivered (heartbeat accepted)."""
+        out = [(kind, hx) for s, kind, hx in self._deltas if s > seq]
+        return out, self._delta_seq
 
     @staticmethod
     def _key(tokens: list[int]) -> bytes:
@@ -164,8 +198,13 @@ class PrefixCache:
             if entry is not None:
                 entry.last_used = time.monotonic()
                 entry.pins += 1
+                entry.hits += 1
                 self.pinned += 1
                 self.hits += 1
+                if entry.tier == "host":
+                    self.hits_host += 1
+                else:
+                    self.hits_device += 1
                 self.tokens_reused += entry.n_tokens
                 return entry
             nb -= 1
@@ -194,7 +233,9 @@ class PrefixCache:
         nb = len(tokens) // bs
         while nb > 0:
             entry = self._entries.get(self._key(tokens[:nb * bs]))
-            if entry is not None:
+            # host-tier entries hold no pool blocks to gather — keep
+            # walking down to the longest DEVICE-resident prefix
+            if entry is not None and entry.tier == "device":
                 entry.last_used = time.monotonic()
                 entry.pins += 1
                 self.pinned += 1
@@ -230,8 +271,20 @@ class PrefixCache:
         if nb == 0 or self.max_blocks <= 0 or nb > self.max_blocks:
             return
         key = self._key(prompt[:nb * bs])
-        if key in self._entries:
-            self._entries[key].last_used = time.monotonic()
+        ent = self._entries.get(key)
+        if ent is not None:
+            ent.last_used = time.monotonic()
+            # a host-tier entry re-prefilled on-device (recompute beat the
+            # up-page, or tiering raced admission): upgrade it in place —
+            # share the fresh slot blocks, drop the redundant host copy
+            if ent.tier == "host" and not ent.blocks:
+                blocks = slot_blocks[:nb]
+                self.allocator.retain(blocks)
+                ent.blocks = blocks
+                ent.tier = "device"
+                ent.n_tokens = nb * bs
+                if self.on_host_drop is not None:
+                    self.on_host_drop(key)
             return
         blocks = slot_blocks[:nb]
         self.allocator.retain(blocks)
@@ -244,18 +297,68 @@ class PrefixCache:
             pass
 
     def _evict_one(self) -> bool:
-        """Evict the LRU *unpinned* entry. Pinned entries (a lookup
-        handed their blocks to an admission that hasn't retained them
-        yet) are untouchable — evicting one would release blocks another
-        coroutine is about to splice into a slot."""
-        victims = [e for e in self._entries.values() if e.pins == 0]
+        """Evict the LRU *unpinned* DEVICE entry. Pinned entries (a
+        lookup handed their blocks to an admission that hasn't retained
+        them yet) are untouchable — evicting one would release blocks
+        another coroutine is about to splice into a slot. Host-tier
+        entries hold no pool blocks, so evicting them here would free
+        nothing; the HostKvTier's byte budget reaps those. Every
+        eviction lands in the delta journal so the next heartbeat
+        retracts the directory advertisement (ISSUE 20 satellite — the
+        silent prefix-loss window)."""
+        victims = [e for e in self._entries.values()
+                   if e.pins == 0 and e.tier == "device"]
         if not victims:
             return False
         oldest = min(victims, key=lambda e: e.last_used)
         del self._entries[oldest.key]
         self.allocator.release(oldest.blocks)
         self.evictions += 1
+        self._note_delta("evict", oldest.key)
         return True
+
+    # -- host tier transitions (ISSUE 20) ------------------------------------
+
+    def spill_candidates(self, n: int) -> list[PrefixEntry]:
+        """Up to ``n`` LRU unpinned device entries — what a window-
+        boundary down-page would move to host DRAM instead of letting
+        ``_evict_one`` destroy. Pinned / in-flight entries never move."""
+        victims = [e for e in self._entries.values()
+                   if e.pins == 0 and e.tier == "device" and e.blocks]
+        victims.sort(key=lambda e: e.last_used)
+        return victims[:n]
+
+    def spill_to_host(self, entry: PrefixEntry) -> None:
+        """Transition a device entry to host residency: its pool blocks
+        are released (the host tier already holds the planes), the entry
+        survives for lookup. Caller guarantees the planes were captured
+        first and the entry is unpinned."""
+        assert entry.pins == 0 and entry.tier == "device"
+        self.allocator.release(entry.blocks)
+        entry.blocks = []
+        entry.tier = "host"
+        self.spills += 1
+        self._note_delta("spill", entry.key)
+
+    def promote_to_device(self, entry: PrefixEntry,
+                          blocks: list[int]) -> None:
+        """Complete an up-page: freshly-allocated blocks (ref already 1)
+        now back the entry on-device. The host copy is dropped by the
+        pool, not here."""
+        assert entry.tier == "host" and not entry.blocks
+        entry.blocks = list(blocks)
+        entry.tier = "device"
+
+    def drop(self, key: bytes, kind: str = "evict") -> None:
+        """Destroy an entry outright (host-tier reap, or adoption
+        cleanup), journaling the loss for the directory."""
+        ent = self._entries.pop(key, None)
+        if ent is None:
+            return
+        if ent.blocks:
+            self.allocator.release(ent.blocks)
+        self.evictions += 1
+        self._note_delta(kind, key)
 
     def evict_for_space(self, blocks_needed: int) -> None:
         """Free cache-held blocks until the allocator can satisfy an
@@ -270,4 +373,6 @@ class PrefixCache:
                 "hits": self.hits, "misses": self.misses,
                 "tokens_reused": self.tokens_reused,
                 "evictions": self.evictions, "pinned": self.pinned,
-                "adopted": self.adopted}
+                "adopted": self.adopted, "spills": self.spills,
+                "hits_device": self.hits_device,
+                "hits_host": self.hits_host}

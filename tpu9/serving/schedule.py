@@ -139,3 +139,24 @@ class WindowScheduler:
             e._spec_disabled_windows = 0
             return s
         return 0
+
+    def downpage_quota(self) -> int:
+        """How many prefix-cache entries the current window boundary
+        should down-page to host DRAM (ISSUE 20): 0 unless the free list
+        has sunk under the low-water mark — the point where the NEXT
+        burst of admissions would push ``evict_for_space`` into
+        destroying prefixes the host tier could have kept. Bounded per
+        boundary (each down-page is one device gather) so a pressure
+        spike amortizes over windows instead of stalling one."""
+        e = self.engine
+        pool = e.pool
+        if pool is None or not pool.tiered:
+            return 0
+        alloc = pool.allocator
+        # low water: an eighth of the pool, or at least one admission
+        # chunk's worth of blocks — below it, eviction is imminent
+        chunk_blocks = max(1, e._chunk // e.ecfg.kv_block_size)
+        low = max(2 * chunk_blocks, alloc.n_blocks // 8)
+        if alloc.free_count >= low:
+            return 0
+        return 2

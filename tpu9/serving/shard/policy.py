@@ -80,8 +80,7 @@ class SingleDevicePolicy:
         exporter emits byte-identical planes to a tp=1 one and import
         re-places through :meth:`place_kv`. Off the serve loop by
         construction (exports run between windows)."""
-        return np.asarray(
-            jax.device_get(arr))  # tpu9: noqa[JAX001] kvwire export gather — runs between windows, never on the dispatch path
+        return np.asarray(jax.device_get(arr))  # tpu9: noqa[JAX001] kvwire export / window-boundary down-page gather — never on the per-token path
 
     # -- spec introspection (graphcheck — ISSUE 11) --------------------------
     # The declared layout contract, exposed so the static verifier can
